@@ -1,0 +1,84 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Brand-new JAX/XLA/Pallas implementation with the capability surface of the
+reference framework (PaddlePaddle, /root/reference — see SURVEY.md): an
+imperative ``Tensor`` / ``nn.Layer`` / ``Optimizer`` / ``loss.backward()``
+API with eager + traced dual execution, a single-source YAML op registry,
+AMP, data loading, sharded checkpointing, and Fleet-style hybrid parallelism
+(dp / tp / pp / sharding / sp / cp / ep) over ``jax.sharding`` meshes with
+XLA collectives on ICI/DCN, plus Pallas fused kernels.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import dtypes as _dtypes_mod
+from .core.dtypes import (  # noqa: F401
+    bfloat16, bool_, complex128, complex64, float16, float32, float64,
+    float8_e4m3fn, float8_e5m2, int16, int32, int64, int8, uint8,
+    finfo, iinfo, promote_types,
+)
+from .core.dtypes import bool_ as bool  # noqa: F401
+from .core.device import (  # noqa: F401
+    CPUPlace, Place, TPUPlace, device_count, get_device, is_compiled_with_tpu,
+    set_device,
+)
+from .core.flags import FLAGS, get_flags, set_flags  # noqa: F401
+from .core.rng import get_rng_state, seed, set_rng_state  # noqa: F401
+from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
+from .core.autograd import grad, no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+from .core.dtypes import get_default_dtype, set_default_dtype  # noqa: F401
+
+# functional op namespace (generated from ops.yaml) — both
+# `paddle_tpu.add(x, y)` and `paddle_tpu.tensor.add(x, y)` work.
+from .ops import api as tensor  # noqa: F401
+from .ops.api import *  # noqa: F401,F403
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from .framework import io as _framework_io
+from .framework.io import load, save  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .core.autograd import backward as _backward  # noqa: F401
+
+from . import autograd  # noqa: F401
+
+
+def is_grad_enabled_():  # pragma: no cover - paddle compat shim
+    return is_grad_enabled()
+
+
+def ones_like(x, dtype=None):
+    return tensor.ones_like(x, dtype)
+
+
+def rank(x):
+    return to_tensor(len(x.shape))
+
+
+def numel(x):
+    return to_tensor(x.size)
+
+
+def shape(x):
+    return to_tensor(x.shape)
+
+
+def in_dynamic_mode() -> bool:
+    from .jit.api import in_to_static_mode
+    return not in_to_static_mode()
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static-graph Program mode; use "
+        "paddle_tpu.jit.to_static (whole-function XLA compilation) instead")

@@ -1,0 +1,68 @@
+"""``paddle_tpu.amp.auto_cast`` (reference: python/paddle/amp/auto_cast.py:457
+``amp_guard``; O1/O2 levels with per-op white/black lists,
+amp/amp_lists.py).  TPU default low-precision dtype is bfloat16 — no loss
+scaling needed in the common case (GradScaler exists for fp16 parity)."""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..core import amp_state
+from ..core import dtypes as _dt
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "white_list", "black_list"]
+
+
+def white_list():
+    return set(amp_state.WHITE_LIST)
+
+
+def black_list():
+    return set(amp_state.BLACK_LIST)
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1", dtype: str = "bfloat16",
+              use_promote: bool = True):
+    if not enable:
+        yield
+        return
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"bad amp level {level!r}")
+    white = set(amp_state.WHITE_LIST)
+    black = set(amp_state.BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    prev = amp_state.set_state(level, _dt.canonical_dtype(dtype), white, black)
+    try:
+        yield
+    finally:
+        amp_state.restore_state(prev)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to low precision; optimizer keeps
+    fp32 master weights (reference: amp/auto_cast.py amp_decorate)."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single_model else model_list
+    single_opt = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if single_opt else list(optimizers)
+    if level == "O2" and master_weight is not False:
+        for o in opt_list:
+            o._multi_precision = True
+    return (models if single_model else model_list,
+            optimizers if single_opt else opt_list)

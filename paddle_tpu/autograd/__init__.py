@@ -1,0 +1,6 @@
+"""``paddle_tpu.autograd`` (reference: python/paddle/autograd/__init__.py —
+``backward``, ``PyLayer`` py_layer.py:282, functional jacobian/hessian)."""
+
+from ..core.autograd import backward, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .functional import hessian, jacobian, jvp, vjp  # noqa: F401

@@ -1,0 +1,75 @@
+"""Functional higher-order autograd (reference:
+python/paddle/autograd — jacobian/hessian via double backward; here they are
+direct jax transform compositions over Tensor-level functions)."""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+
+from ..core.tensor import Tensor
+
+__all__ = ["jacobian", "hessian", "jvp", "vjp"]
+
+
+def _lift(fn: Callable) -> Callable:
+    """Tensor-level fn → array-level fn."""
+
+    def array_fn(*vals):
+        t_args = [Tensor(v) for v in vals]
+        out = fn(*t_args)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    return array_fn
+
+
+def _vals(xs):
+    if isinstance(xs, Tensor):
+        return (xs._value,), True
+    return tuple(x._value if isinstance(x, Tensor) else x for x in xs), False
+
+
+def jacobian(func: Callable, xs, create_graph: bool = False):
+    vals, single = _vals(xs)
+    jac = jax.jacrev(_lift(func), argnums=tuple(range(len(vals))))(*vals)
+    out = jax.tree.map(Tensor, jac)
+    return out[0] if single and isinstance(out, tuple) else out
+
+
+def hessian(func: Callable, xs, create_graph: bool = False):
+    vals, single = _vals(xs)
+    hes = jax.hessian(_lift(func), argnums=tuple(range(len(vals))))(*vals)
+    out = jax.tree.map(Tensor, hes)
+    if single and isinstance(out, tuple):
+        inner = out[0]
+        return inner[0] if isinstance(inner, tuple) else inner
+    return out
+
+
+def jvp(func: Callable, xs, v=None):
+    vals, single = _vals(xs)
+    if v is None:
+        import jax.numpy as jnp
+        tangents = tuple(jnp.ones_like(x) for x in vals)
+    else:
+        tangents, _ = _vals(v)
+    out, tangent_out = jax.jvp(_lift(func), vals, tangents)
+    return jax.tree.map(Tensor, out), jax.tree.map(Tensor, tangent_out)
+
+
+def vjp(func: Callable, xs, v=None):
+    vals, single = _vals(xs)
+    out, vjp_fn = jax.vjp(_lift(func), *vals)
+    if v is None:
+        import jax.numpy as jnp
+        cot = jax.tree.map(jnp.ones_like, out)
+    else:
+        cot, _ = _vals(v)
+        if not isinstance(out, tuple):
+            cot = cot[0]
+    grads = vjp_fn(cot)
+    g_out = jax.tree.map(Tensor, grads)
+    return jax.tree.map(Tensor, out), g_out[0] if single else g_out

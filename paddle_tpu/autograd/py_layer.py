@@ -1,0 +1,109 @@
+"""PyLayer — user-defined autograd functions (reference:
+python/paddle/autograd/py_layer.py:282 + C++ eager/pylayer).
+
+A subclass defines ``forward(ctx, *args)`` and ``backward(ctx, *grads)``;
+the tape machinery treats the pair as one op with a custom VJP, so PyLayers
+compose with the generic eager backward, exactly like the reference's
+PyLayerGradNode."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import GradNode, is_grad_enabled
+from ..core.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: List[Tensor] = []
+        self._attrs = {}
+
+    def save_for_backward(self, *tensors) -> None:
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    saved_tensors = property(lambda self: list(self._saved))
+
+    def mark_not_inplace(self, *a):
+        pass
+
+    def mark_non_differentiable(self, *a):
+        pass
+
+    def set_materialize_grads(self, v: bool):
+        pass
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class _PyLayerNode(GradNode):
+    """GradNode whose vjp calls the user's backward."""
+
+    def __init__(self, cls, ctx, in_tensors, out_avals, out_treedef):
+        # bypass GradNode's exec-key machinery: custom apply below
+        super().__init__(f"pylayer:{cls.__name__}", None, None, in_tensors,
+                         [t._value if t is not None else None
+                          for t in in_tensors], out_avals, out_treedef)
+        self._cls = cls
+        self._ctx = ctx
+
+
+class PyLayer:
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        outs_l = [outs] if single else list(outs)
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs = is_grad_enabled() and any(not t.stop_gradient
+                                          for t in tensor_inputs)
+        if needs:
+            out_avals = [jax.ShapeDtypeStruct(tuple(o.shape),
+                                              o.dtype) for o in outs_l]
+            import jax.tree_util as jtu
+            treedef = jtu.tree_structure(tuple(range(len(outs_l))))
+            node = _PyLayerNode(cls, ctx, tensor_inputs, out_avals, treedef)
+            for i, o in enumerate(outs_l):
+                o._node = node
+                o._out_index = i
+                o.stop_gradient = False
+        return outs if not single else outs_l[0]
+
+
+# hook the custom node into the backward executor
+from ..core import autograd as _ag  # noqa: E402
+
+_orig_vjp_executor = _ag._vjp_executor
+
+
+def _vjp_executor(node):
+    if isinstance(node, _PyLayerNode):
+        def run(in_values, cts_flat):
+            grads = node._cls.backward(node._ctx,
+                                       *[Tensor(c) for c in cts_flat])
+            if not isinstance(grads, (tuple, list)):
+                grads = [grads]
+            return [g._value if isinstance(g, Tensor) else g for g in grads]
+        return run
+    return _orig_vjp_executor(node)
+
+
+_ag._vjp_executor = _vjp_executor

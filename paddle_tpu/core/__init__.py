@@ -1,0 +1,5 @@
+from . import autograd, device, dispatch, dtypes, flags, rng  # noqa: F401
+from .autograd import backward, grad, no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+from .flags import FLAGS, set_flags, get_flags  # noqa: F401
+from .rng import seed, get_rng_state, set_rng_state  # noqa: F401
+from .tensor import Parameter, Tensor, to_tensor  # noqa: F401

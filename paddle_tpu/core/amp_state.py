@@ -1,0 +1,80 @@
+"""Thread-local AMP state consumed by the dispatcher.
+
+Analog of the reference's thread_local AmpAttrs
+(/root/reference/paddle/fluid/imperative/amp_auto_cast.h:87,101) and the AMP
+cast block emitted into every generated dygraph forward (eager_gen.py:317).
+Here the cast policy is applied generically in core.dispatch.run_op.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.level = "O0"          # O0 off, O1 mixed, O2 pure
+        self.dtype = jnp.bfloat16  # TPU-native low precision
+        self.white = set()
+        self.black = set()
+
+
+_state = _AmpState()
+
+# Default lists (reference: python/paddle/amp/amp_lists.py — white =
+# matmul/conv ops, black = numerically sensitive reductions).
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "addmm", "scaled_dot_product_attention",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "prod",
+    "softmax", "log_softmax", "cross_entropy", "bce_with_logits",
+    "binary_cross_entropy", "nll_loss", "kl_div", "layer_norm", "batch_norm",
+    "group_norm", "instance_norm", "rms_norm", "norm", "logsumexp",
+    "softmax_with_cross_entropy", "cumsum", "pow", "rsqrt", "sqrt",
+}
+
+
+def get_level() -> str:
+    return _state.level
+
+
+def get_dtype():
+    return _state.dtype
+
+
+def enabled() -> bool:
+    return _state.level in ("O1", "O2")
+
+
+def set_state(level: str, dtype, white=None, black=None):
+    prev = (_state.level, _state.dtype, _state.white, _state.black)
+    _state.level = level
+    _state.dtype = dtype
+    _state.white = set(white) if white is not None else set(WHITE_LIST)
+    _state.black = set(black) if black is not None else set(BLACK_LIST)
+    return prev
+
+
+def restore_state(prev) -> None:
+    _state.level, _state.dtype, _state.white, _state.black = prev
+
+
+def cast_policy(op_name: str):
+    """Return the target dtype for this op's float inputs, or None."""
+    if _state.level == "O0":
+        return None
+    if _state.level == "O2":
+        if op_name in _state.black:
+            return jnp.float32
+        return _state.dtype
+    # O1
+    if op_name in _state.white:
+        return _state.dtype
+    if op_name in _state.black:
+        return jnp.float32
+    return None

@@ -1,0 +1,241 @@
+"""Define-by-run eager autograd.
+
+TPU-native replacement for the reference's C++ eager engine
+(/root/reference/paddle/fluid/eager: ``GradNodeBase`` grad_node_info.h:197,
+``RunBackward`` backward.cc:105, ``GradTensorHolder`` accumulation).  Instead
+of generated per-op C++ grad nodes, every eager op records one
+:class:`GradNode` holding the op's pure function and its dynamic inputs;
+``backward()`` walks the node DAG in reverse creation order and computes
+input cotangents with a cached, jit-compiled ``jax.vjp`` — so the "grad
+kernel" for every op is derived automatically from the forward impl, the same
+single-source property the reference gets from its YAML backward registry
+(phi/ops/yaml/backward.yaml).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "GradNode", "backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+    "is_grad_enabled",
+]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.enabled = bool(mode)
+
+
+class _GradModeCtx:
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with _GradModeCtx(self._mode):
+                return fn(*a, **k)
+
+        return wrapper
+
+
+def no_grad(fn=None):
+    """Context manager / decorator disabling tape recording (Paddle
+    ``paddle.no_grad``)."""
+    ctx = _GradModeCtx(False)
+    return ctx(fn) if fn is not None else ctx
+
+
+def enable_grad(fn=None):
+    ctx = _GradModeCtx(True)
+    return ctx(fn) if fn is not None else ctx
+
+
+_node_counter = [0]
+
+
+class GradNode:
+    """One recorded eager op.
+
+    Attributes:
+      exec_key: hashable key identifying the pure callable (for the vjp cache)
+      call: ``call(dyn_vals) -> out_tree`` pure function of dynamic leaves
+      in_tensors: the Tensor objects among the dynamic leaves (None where the
+        dynamic leaf was a raw array)
+      in_values: concrete values of ALL dynamic leaves (saved primals)
+      out_avals: flat list of jax.ShapeDtypeStruct per output leaf
+      out_treedef: structure of the forward output
+    """
+
+    __slots__ = ("name", "exec_key", "call", "in_tensors", "in_values",
+                 "out_avals", "out_treedef", "id")
+
+    def __init__(self, name, exec_key, call, in_tensors, in_values, out_avals,
+                 out_treedef):
+        self.name = name
+        self.exec_key = exec_key
+        self.call = call
+        self.in_tensors = in_tensors
+        self.in_values = in_values
+        self.out_avals = out_avals
+        self.out_treedef = out_treedef
+        _node_counter[0] += 1
+        self.id = _node_counter[0]
+
+
+# Cache of jitted vjp executors, keyed by the op's exec_key.
+_vjp_cache: Dict[Any, Callable] = {}
+
+
+def _vjp_executor(node: GradNode) -> Callable:
+    fn = _vjp_cache.get(node.exec_key)
+    if fn is None:
+        call = node.call
+        treedef = node.out_treedef
+
+        def run(in_values, cts_flat):
+            out, vjp = jax.vjp(call, in_values)
+            del out
+            cts = jax.tree.unflatten(treedef, cts_flat)
+            (grads,) = vjp(cts)
+            return grads
+
+        from .flags import FLAGS
+        fn = jax.jit(run) if FLAGS.eager_op_jit else run
+        _vjp_cache[node.exec_key] = fn
+    return fn
+
+
+def _accumulate(slot: Optional[jax.Array], g: jax.Array) -> jax.Array:
+    return g if slot is None else slot + g
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
+    """Run reverse-mode accumulation from ``tensors`` (usually a scalar loss),
+    writing ``.grad`` on reachable leaf tensors with ``stop_gradient=False``.
+
+    Mirrors ``egr::Backward`` (eager/backward.cc:439): seed output grads with
+    ones, BFS the node graph in reverse, per-slot accumulation.
+    """
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # node id -> list of output cotangents (flat, per out leaf)
+    pending: Dict[int, List[Optional[jax.Array]]] = {}
+    nodes: Dict[int, GradNode] = {}
+
+    def seed(t: "Tensor", g: Optional[jax.Array]):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            g = jnp.ones(t.shape, t.dtype)
+        node, idx = t._node, t._out_index
+        if node is None:
+            if not t.stop_gradient:
+                t._accumulate_grad(g)
+            return
+        nodes[node.id] = node
+        slots = pending.setdefault(node.id, [None] * len(node.out_avals))
+        slots[idx] = _accumulate(slots[idx], g)
+
+    for t, g in zip(tensors, grad_tensors):
+        seed(t, g._value if isinstance(g, Tensor) else g)
+
+    # Reverse creation order is a valid topological order for a define-by-run
+    # DAG (producers always have smaller ids than consumers).
+    while pending:
+        nid = max(pending)
+        node = nodes.pop(nid)
+        cts = pending.pop(nid)
+        cts_flat = [
+            c if c is not None else jnp.zeros(a.shape, a.dtype)
+            for c, a in zip(cts, node.out_avals)
+        ]
+        grads = _vjp_executor(node)(node.in_values, cts_flat)
+        for t, g in zip(node.in_tensors, grads):
+            if t is None or g is None:
+                continue
+            if getattr(g, "dtype", None) is not None and g.dtype == jax.dtypes.float0:
+                continue
+            if t._node is not None:
+                prod = t._node
+                nodes[prod.id] = prod
+                slots = pending.setdefault(prod.id, [None] * len(prod.out_avals))
+                slots[t._out_index] = _accumulate(slots[t._out_index], g)
+                if t._retain_grads and not t.stop_gradient:
+                    t._accumulate_grad(g)
+            elif not t.stop_gradient:
+                t._accumulate_grad(g)
+        if not retain_graph:
+            node.in_values = None  # free saved primals
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph: bool = False,
+         create_graph: bool = False, allow_unused: bool = False):
+    """``paddle.grad``-style: returns grads of ``outputs`` wrt ``inputs``
+    without touching ``.grad`` slots (reference: GeneralGrad,
+    eager/general_grad.h).  ``create_graph`` is not yet supported in eager
+    mode — use the functional API (``paddle_tpu.incubate.autograd``) for
+    higher order."""
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use functional jax.grad composition via "
+            "paddle_tpu.incubate.autograd")
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    saved = [(t.grad, t._retain_grads, t.stop_gradient) for t in inputs]
+    try:
+        for t in inputs:
+            t.grad = None
+            t._retain_grads = True
+            t.stop_gradient = False
+        backward(outputs, grad_outputs, retain_graph=retain_graph)
+        out = []
+        for t in inputs:
+            if t.grad is None and not allow_unused:
+                raise RuntimeError(
+                    f"input tensor {t.name!r} unused in graph "
+                    "(pass allow_unused=True to get None)")
+            out.append(t.grad)
+        return out
+    finally:
+        for t, (g, r, sg) in zip(inputs, saved):
+            t.grad = g
+            t._retain_grads = r
+            t.stop_gradient = sg

@@ -1,0 +1,103 @@
+"""Device ("place") management.
+
+Analog of the reference's Place/Backend identity layer
+(/root/reference/paddle/phi/common/place.h:58, backend.h:40) and the
+DeviceContext pool (phi/core/device_context.h:37).  On TPU, streams/contexts
+dissolve into XLA; a Place here is a thin wrapper over a ``jax.Device``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+
+__all__ = [
+    "Place", "CPUPlace", "TPUPlace", "set_device", "get_device",
+    "device_count", "is_compiled_with_tpu",
+]
+
+
+class Place:
+    """Device identity: ``Place('tpu', 0)`` / ``Place('cpu')``."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def jax_device(self) -> jax.Device:
+        devs = [d for d in jax.devices() if _platform_matches(d, self.device_type)]
+        if not devs:
+            # graceful fallback: whatever the default backend exposes
+            devs = jax.devices()
+        return devs[min(self.device_id, len(devs) - 1)]
+
+    def __repr__(self) -> str:
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Place) and other.device_type == self.device_type
+                and other.device_id == self.device_id)
+
+    def __hash__(self) -> int:
+        return hash((self.device_type, self.device_id))
+
+
+def _platform_matches(dev: jax.Device, device_type: str) -> bool:
+    p = dev.platform.lower()
+    t = device_type.lower()
+    if t in ("tpu", "axon"):
+        return p in ("tpu", "axon")
+    return p == t
+
+
+def CPUPlace() -> Place:
+    return Place("cpu")
+
+
+def TPUPlace(device_id: int = 0) -> Place:
+    return Place("tpu", device_id)
+
+
+_current_place: Optional[Place] = None
+
+
+def set_device(device: Union[str, Place]) -> Place:
+    """``set_device('tpu:0')`` — sets the default placement for new tensors."""
+    global _current_place
+    if isinstance(device, str):
+        if ":" in device:
+            ty, idx = device.split(":", 1)
+            device = Place(ty, int(idx))
+        else:
+            device = Place(device)
+    _current_place = device
+    jax.config.update("jax_default_device", device.jax_device())
+    return device
+
+
+def get_device() -> str:
+    if _current_place is not None:
+        return f"{_current_place.device_type}:{_current_place.device_id}"
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def default_place() -> Place:
+    if _current_place is not None:
+        return _current_place
+    d = jax.devices()[0]
+    return Place(d.platform, d.id)
+
+
+def device_count(device_type: Optional[str] = None) -> int:
+    if device_type is None:
+        return jax.device_count()
+    return len([d for d in jax.devices() if _platform_matches(d, device_type)])
+
+
+def is_compiled_with_tpu() -> bool:
+    try:
+        return any(d.platform.lower() in ("tpu", "axon") for d in jax.devices())
+    except RuntimeError:
+        return False

@@ -1,0 +1,208 @@
+"""Eager op dispatch.
+
+The TPU-native analog of the reference's generated dygraph path
+(/root/reference/paddle/fluid/pybind/eager_op_function.cc →
+``*_ad_func`` → PHI kernel; SURVEY §3.1).  The per-op C++ machinery collapses
+into one generic :func:`run_op`:
+
+1. flatten ``(args, kwargs)`` into dynamic array leaves + static structure
+   (the static part plays the role of ``KernelKey`` — it keys a jit cache,
+   so each (op, static-args) pair compiles once and replays);
+2. in eager mode, execute through a cached ``jax.jit`` and, when grad is
+   enabled and a differentiable Tensor participates, record a
+   :class:`~paddle_tpu.core.autograd.GradNode` on the tape;
+3. inside a ``jax`` trace (functional/jit/`to_static` path), fall through to
+   a direct call so the op fuses into the enclosing XLA program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .autograd import GradNode, is_grad_enabled
+from .flags import FLAGS
+
+__all__ = ["run_op", "primitive", "register_custom_vjp"]
+
+
+def _is_dynamic(leaf: Any) -> bool:
+    from .tensor import Tensor
+    return isinstance(leaf, (Tensor, jax.Array, np.ndarray)) or (
+        isinstance(leaf, np.generic))
+
+
+def _is_tensor_leaf(x: Any) -> bool:
+    from .tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+# op name -> forward fn (impl); populated by ops.registry
+_FORWARD_CACHE: Dict[Any, Callable] = {}
+
+
+def _exec_cached(exec_key: Tuple, call: Callable) -> Callable:
+    fn = _FORWARD_CACHE.get(exec_key)
+    if fn is None:
+        fn = jax.jit(call) if FLAGS.eager_op_jit else call
+        _FORWARD_CACHE[exec_key] = fn
+    return fn
+
+
+def _check_nan_inf(name: str, leaves: List[Any]) -> None:
+    for v in leaves:
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+            bad = bool(jnp.any(~jnp.isfinite(v)))
+            if bad:
+                msg = f"NaN/Inf detected in output of op {name!r}"
+                if FLAGS.check_nan_inf_level == 0:
+                    raise FloatingPointError(msg)
+                import warnings
+                warnings.warn(msg)
+
+
+def run_op(name: str, fn: Callable, args: tuple, kwargs: dict,
+           differentiable: bool = True):
+    """Execute op ``name`` implemented by pure function ``fn``."""
+    from .tensor import Tensor
+    from . import amp_state
+
+    if amp_state.enabled():
+        tgt = amp_state.cast_policy(name)
+        if tgt is not None:
+            def _amp_cast(x):
+                if isinstance(x, Tensor) and jnp.issubdtype(
+                        jnp.asarray(x._value).dtype, jnp.floating) and \
+                        jnp.asarray(x._value).dtype != tgt:
+                    return x.astype(tgt) if hasattr(x, "astype") else x
+                return x
+            args = tuple(_amp_cast(a) for a in args)
+            kwargs = {k: _amp_cast(v) for k, v in kwargs.items()}
+
+    leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor_leaf)
+
+    dyn_idx: List[int] = []
+    dyn_tensors: List[Optional[Tensor]] = []
+    dyn_values: List[Any] = []
+    static: List[Any] = []
+    any_tracer = False
+    needs_grad = False
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, Tensor):
+            v = leaf._value
+            dyn_idx.append(i)
+            dyn_tensors.append(leaf)
+            dyn_values.append(v)
+            static.append(None)
+            if isinstance(v, jax.core.Tracer):
+                any_tracer = True
+            if (not leaf.stop_gradient
+                    and jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact)):
+                needs_grad = True
+        elif isinstance(leaf, (jax.Array, np.ndarray, np.generic)):
+            dyn_idx.append(i)
+            dyn_tensors.append(None)
+            dyn_values.append(leaf)
+            static.append(None)
+            if isinstance(leaf, jax.core.Tracer):
+                any_tracer = True
+        else:
+            static.append(leaf)
+
+    dyn_set = tuple(dyn_idx)
+
+    def call(dyn_vals):
+        new_leaves = list(static)
+        for j, i in enumerate(dyn_set):
+            new_leaves[i] = dyn_vals[j]
+        a, k = jax.tree.unflatten(treedef, new_leaves)
+        return fn(*a, **k)
+
+    # ---- traced (functional) path: let it fuse into the outer XLA program
+    if any_tracer:
+        out = call(dyn_values)
+        return _wrap_out(out, None)
+
+    # ---- eager path
+    try:
+        static_key = tuple(
+            s if _hashable(s) else repr(s) for s in static)
+        exec_key = (name, fn, treedef, static_key, dyn_set,
+                    tuple(_aval_key(v) for v in dyn_values))
+    except TypeError:
+        exec_key = None
+
+    if exec_key is not None and FLAGS.eager_op_jit:
+        out = _exec_cached(exec_key, call)(dyn_values)
+    else:
+        out = call(dyn_values)
+
+    node = None
+    if differentiable and needs_grad and is_grad_enabled():
+        out_flat, out_treedef = jax.tree.flatten(out)
+        out_avals = [jax.ShapeDtypeStruct(jnp.shape(v), jnp.asarray(v).dtype)
+                     for v in out_flat]
+        node = GradNode(name, exec_key, call, dyn_tensors, dyn_values,
+                        out_avals, out_treedef)
+
+    if FLAGS.check_nan_inf:
+        _check_nan_inf(name, jax.tree.leaves(out))
+
+    return _wrap_out(out, node)
+
+
+def _hashable(x: Any) -> bool:
+    try:
+        hash(x)
+        return True
+    except TypeError:
+        return False
+
+
+def _aval_key(v: Any):
+    a = jnp.asarray(v) if not hasattr(v, "dtype") else v
+    return (tuple(getattr(a, "shape", ())), str(a.dtype))
+
+
+def _wrap_out(out: Any, node: Optional[GradNode]):
+    from .tensor import Tensor
+
+    out_flat, out_treedef = jax.tree.flatten(out)
+    wrapped = []
+    for idx, v in enumerate(out_flat):
+        t = Tensor(v, stop_gradient=(node is None))
+        t._node = node
+        t._out_index = idx
+        wrapped.append(t)
+    if len(wrapped) == 1 and out_treedef.num_leaves == 1 and not isinstance(
+            out, (tuple, list, dict)):
+        return wrapped[0]
+    return jax.tree.unflatten(out_treedef, wrapped)
+
+
+def primitive(name: str, differentiable: bool = True):
+    """Decorator turning a pure jnp function into an eager-dispatch op."""
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return run_op(name, fn, args, kwargs, differentiable=differentiable)
+
+        wrapper.__pt_primitive__ = name
+        wrapper.raw = fn
+        return wrapper
+
+    return deco
+
+
+def register_custom_vjp(fn: Callable, fwd: Callable, bwd: Callable,
+                        nondiff_argnums: Tuple[int, ...] = ()) -> Callable:
+    """Attach a hand-written VJP (e.g. a Pallas backward kernel) to an impl
+    function; the generic tape/vjp machinery then uses it automatically."""
+    wrapped = jax.custom_vjp(fn, nondiff_argnums=nondiff_argnums)
+    wrapped.defvjp(fwd, bwd)
+    return wrapped

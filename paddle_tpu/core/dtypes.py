@@ -1,0 +1,116 @@
+"""Dtype handling and binary-op type promotion.
+
+Analog of the reference's dtype/Scalar value layer
+(/root/reference/paddle/phi/common/data_type.h and the type-promotion logic
+embedded in generated dygraph forwards, eager_gen.py).  On TPU the dtype set
+is the JAX one; bfloat16 is first-class (MXU-native).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "bool_", "uint8", "int8", "int16", "int32", "int64",
+    "float8_e4m3fn", "float8_e5m2", "bfloat16", "float16", "float32",
+    "float64", "complex64", "complex128",
+    "canonical_dtype", "default_float_dtype", "promote_types",
+    "is_floating", "is_integer", "is_complex", "finfo", "iinfo",
+]
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "int": jnp.int64,
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "fp16": jnp.float16,
+    "half": jnp.float16,
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+    "float": jnp.float32,
+    "float64": jnp.float64,
+    "fp64": jnp.float64,
+    "double": jnp.float64,
+    "float8_e4m3fn": jnp.float8_e4m3fn,
+    "float8_e5m2": jnp.float8_e5m2,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+DTypeLike = Union[str, type, np.dtype, Any]
+
+
+def canonical_dtype(dtype: DTypeLike):
+    """Resolve a user dtype spec (string alias / np dtype / jnp type) to a
+    numpy dtype object (what jnp operations accept)."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            dtype = _ALIASES[dtype.lower()]
+        except KeyError:
+            raise ValueError(f"unknown dtype {dtype!r}") from None
+    return jnp.dtype(dtype)
+
+
+def default_float_dtype():
+    from .flags import FLAGS
+    return canonical_dtype(FLAGS.default_dtype)
+
+
+def set_default_dtype(dtype: DTypeLike) -> None:
+    from .flags import FLAGS
+    FLAGS.default_dtype = str(canonical_dtype(dtype))
+
+
+def get_default_dtype() -> str:
+    from .flags import FLAGS
+    return FLAGS.default_dtype
+
+
+def promote_types(a: DTypeLike, b: DTypeLike):
+    return jnp.promote_types(canonical_dtype(a), canonical_dtype(b))
+
+
+def is_floating(dtype: DTypeLike) -> bool:
+    return jnp.issubdtype(canonical_dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype: DTypeLike) -> bool:
+    return jnp.issubdtype(canonical_dtype(dtype), jnp.integer)
+
+
+def is_complex(dtype: DTypeLike) -> bool:
+    return jnp.issubdtype(canonical_dtype(dtype), jnp.complexfloating)
+
+
+def finfo(dtype: DTypeLike):
+    return jnp.finfo(canonical_dtype(dtype))
+
+
+def iinfo(dtype: DTypeLike):
+    return jnp.iinfo(canonical_dtype(dtype))

@@ -1,0 +1,137 @@
+"""Global runtime flag registry.
+
+TPU-native analog of the reference's single flag registry
+(/root/reference/paddle/common/flags.cc — 173 ``PHI_DEFINE_EXPORTED_*`` flags,
+surfaced to Python as ``FLAGS_*`` and settable via env / ``paddle.set_flags``).
+
+Here flags are plain typed Python entries, settable via environment variables
+(``PT_FLAGS_<name>`` or ``FLAGS_<name>``), :func:`set_flags`, or attribute
+access on the :data:`FLAGS` singleton.  XLA-level knobs pass through to
+``XLA_FLAGS`` (see :func:`set_xla_flag`).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["FLAGS", "define_flag", "set_flags", "get_flags", "set_xla_flag"]
+
+
+@dataclass
+class _FlagDef:
+    name: str
+    default: Any
+    type: type
+    help: str
+    on_change: Optional[Callable[[Any], None]] = None
+
+
+_BOOL_TRUE = {"1", "true", "yes", "on"}
+
+
+def _coerce(value: Any, ty: type) -> Any:
+    if ty is bool:
+        if isinstance(value, str):
+            return value.strip().lower() in _BOOL_TRUE
+        return bool(value)
+    if value is None:
+        return None
+    return ty(value)
+
+
+class _Flags:
+    """Process-global flag table (thread-safe for writes)."""
+
+    def __init__(self) -> None:
+        object.__setattr__(self, "_defs", {})
+        object.__setattr__(self, "_values", {})
+        object.__setattr__(self, "_lock", threading.Lock())
+
+    # -- registry ---------------------------------------------------------
+    def define(self, name: str, default: Any, help: str = "",
+               type: Optional[type] = None,
+               on_change: Optional[Callable[[Any], None]] = None) -> None:
+        ty = type or (bool if isinstance(default, bool) else default.__class__)
+        d = _FlagDef(name, default, ty, help, on_change)
+        with self._lock:
+            self._defs[name] = d
+            env = os.environ.get(f"PT_FLAGS_{name}", os.environ.get(f"FLAGS_{name}"))
+            self._values[name] = _coerce(env, ty) if env is not None else default
+
+    # -- access -----------------------------------------------------------
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(f"undefined flag {name!r}") from None
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        self.set(name, value)
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            if name not in self._defs:
+                raise KeyError(f"undefined flag {name!r}; define_flag() it first")
+            d = self._defs[name]
+            self._values[name] = _coerce(value, d.type)
+        if d.on_change is not None:
+            d.on_change(self._values[name])
+
+    def get(self, name: str) -> Any:
+        return self._values[name]
+
+    def defined(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    def describe(self) -> Dict[str, _FlagDef]:
+        return dict(self._defs)
+
+
+FLAGS = _Flags()
+
+
+def define_flag(name: str, default: Any, help: str = "", **kw: Any) -> None:
+    FLAGS.define(name, default, help, **kw)
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Paddle-compatible ``set_flags({'FLAGS_x': v})`` (prefix optional)."""
+    for k, v in flags.items():
+        if k.startswith("FLAGS_"):
+            k = k[len("FLAGS_"):]
+        FLAGS.set(k, v)
+
+
+def get_flags(names) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for k in names:
+        key = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        out[k] = FLAGS.get(key)
+    return out
+
+
+def set_xla_flag(flag: str) -> None:
+    """Append a flag to XLA_FLAGS (effective for processes started after, and
+    for lazily-initialized backends)."""
+    cur = os.environ.get("XLA_FLAGS", "")
+    if flag not in cur.split():
+        os.environ["XLA_FLAGS"] = (cur + " " + flag).strip()
+
+
+# ---------------------------------------------------------------------------
+# Core flags (analogs of the reference's hot-path flags, flags.cc)
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "Check every eager op output for NaN/Inf")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; 1: warn")
+define_flag("eager_op_jit", True, "jit-compile eager per-op executions (cached)")
+define_flag("default_dtype", "float32", "default floating dtype for creation ops")
+define_flag("use_donated_buffers", True, "donate param/opt buffers in jitted train steps")
+define_flag("allocator_strategy", "xla", "memory allocator strategy (informational on TPU)")
+define_flag("pallas_interpret", False, "force pallas kernels to run in interpret mode")
+define_flag("enable_async_trace", False, "record collective timing/debug traces")
+define_flag("log_level", 1, "framework log verbosity (0=quiet)")

@@ -1,0 +1,176 @@
+"""Random number generation.
+
+Replaces the reference's per-device stateful ``Generator``
+(/root/reference/paddle/phi/core/generator.cc) and the model-parallel
+``RNGStatesTracker`` (fleet/layers/mpu/random.py:34) with JAX key folding:
+
+* Eager mode: a process-global :class:`Generator` holds a key and splits it on
+  every random op (stateful convenience, Paddle-style ``paddle.seed``).
+* Traced mode (inside ``jit``): random ops pull keys from an explicit
+  :func:`rng_scope` context, so randomness is a traced input — pure and
+  reproducible.  Modules (e.g. Dropout) call :func:`next_rng_key` and work in
+  both modes transparently.
+* Parallel RNG: :class:`RNGStatesTracker` folds a named-axis index into the
+  key so e.g. tensor-parallel dropout differs per mp rank while weights init
+  identically (semantics of mpu/random.py:34 without state shipping).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "seed", "Generator", "default_generator", "next_rng_key", "rng_scope",
+    "RNGStatesTracker", "get_rng_state", "set_rng_state",
+]
+
+
+class Generator:
+    """Stateful key holder; each draw splits the key."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+        self._seed = seed
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int) -> "Generator":
+        with self._lock:
+            self._seed = seed
+            self._key = jax.random.key(seed)
+        return self
+
+    def split(self) -> jax.Array:
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return jax.random.key_data(self._key)
+
+    def set_state(self, state) -> None:
+        self._key = jax.random.wrap_key_data(jnp.asarray(state))
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int) -> Generator:
+    """Paddle-compatible global seed."""
+    return _default_generator.manual_seed(s)
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state) -> None:
+    _default_generator.set_state(state)
+
+
+# ---------------------------------------------------------------------------
+# Scoped (functional) keys for traced code
+# ---------------------------------------------------------------------------
+class _RngScope(threading.local):
+    def __init__(self):
+        self.stack: List[Dict] = []
+
+
+_scope = _RngScope()
+
+
+class rng_scope:
+    """``with rng_scope(key): ...`` — random ops inside draw from `key` by
+    fold_in counter, making them pure functions of the provided key.  Used by
+    the functional/jit path to thread dropout keys through a traced step."""
+
+    def __init__(self, key):
+        if isinstance(key, int):
+            key = jax.random.key(key)
+        self._frame = {"key": key, "count": 0}
+
+    def __enter__(self):
+        _scope.stack.append(self._frame)
+        return self
+
+    def __exit__(self, *exc):
+        _scope.stack.pop()
+        return False
+
+
+def next_rng_key(generator: Optional[Generator] = None) -> jax.Array:
+    """The single entry point random ops use for a fresh key.
+
+    Inside an :class:`rng_scope` (the traced path) keys derive from the scope
+    key via fold_in of a call counter; otherwise the stateful global
+    generator splits.
+    """
+    if _scope.stack:
+        frame = _scope.stack[-1]
+        frame["count"] += 1
+        return jax.random.fold_in(frame["key"], frame["count"])
+    return (generator or _default_generator).split()
+
+
+class RNGStatesTracker:
+    """Named RNG streams for model parallelism.
+
+    ``add(name, seed)`` registers a stream; ``with tracker.rng_state(name):``
+    makes random ops draw from that stream.  For per-mp-rank divergence fold
+    the axis index into the seed (see parallel/topology).
+    """
+
+    def __init__(self):
+        self._seeds: Dict[str, int] = {}
+        self._gens: Dict[str, Generator] = {}
+
+    def add(self, name: str, seed: int) -> None:
+        if name in self._seeds:
+            raise ValueError(f"rng state {name!r} already added")
+        for n, s in self._seeds.items():
+            if s == seed:
+                raise ValueError(f"seed {seed} already used by stream {n!r}")
+        self._seeds[name] = seed
+        self._gens[name] = Generator(seed)
+
+    def rng_state(self, name: str = "global_seed"):
+        if name not in self._gens:
+            raise ValueError(f"unknown rng stream {name!r}")
+        return _generator_scope(self._gens[name])
+
+    def get_states_tracker(self):
+        return {n: g.get_state() for n, g in self._gens.items()}
+
+    def set_states_tracker(self, states) -> None:
+        for n, s in states.items():
+            self._gens[n].set_state(s)
+
+    def reset(self) -> None:
+        self._seeds.clear()
+        self._gens.clear()
+
+
+class _generator_scope:
+    """Route next_rng_key() through a specific Generator (eager path)."""
+
+    def __init__(self, gen: Generator):
+        self._gen = gen
+
+    def __enter__(self):
+        _scope.stack.append({"key": self._gen.split(), "count": 0})
+        return self
+
+    def __exit__(self, *exc):
+        _scope.stack.pop()
+        return False

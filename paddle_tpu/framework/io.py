@@ -1,0 +1,93 @@
+"""``paddle_tpu.save/load`` (reference: python/paddle/framework/io.py:773
+``paddle.save`` / :1020 ``paddle.load`` — pickle-based state dicts).
+
+Format: a single ``.pdparams``-style file = npz archive of arrays + a JSON
+manifest of the pytree structure (safer and faster than pickle for pure
+tensors; falls back to pickle for arbitrary objects).  Sharded/reshardable
+distributed checkpoints live in paddle_tpu.distributed.checkpoint.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import json
+import os
+import pickle
+import zipfile
+from typing import Any, Dict
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_MAGIC = "paddle_tpu.v1"
+
+
+def _flatten(obj: Any, prefix: str, arrays: Dict[str, np.ndarray]):
+    if isinstance(obj, Tensor):
+        arrays[prefix] = np.asarray(obj._value)
+        return {"__tensor__": prefix, "stop_gradient": obj.stop_gradient}
+    if hasattr(obj, "__array__") and not isinstance(obj, (bool, int, float,
+                                                          str)):
+        arrays[prefix] = np.asarray(obj)
+        return {"__array__": prefix}
+    if isinstance(obj, dict):
+        return {"__dict__": {
+            str(k): _flatten(v, f"{prefix}/{k}", arrays)
+            for k, v in obj.items()}}
+    if isinstance(obj, (list, tuple)):
+        return {"__seq__": [_flatten(v, f"{prefix}/{i}", arrays)
+                            for i, v in enumerate(obj)],
+                "__type__": "tuple" if isinstance(obj, tuple) else "list"}
+    if isinstance(obj, (bool, int, float, str)) or obj is None:
+        return {"__scalar__": obj}
+    # fallback
+    return {"__pickle__": pickle.dumps(obj).hex()}
+
+
+def _unflatten(spec: Any, arrays) -> Any:
+    if "__tensor__" in spec:
+        t = Tensor(np.asarray(arrays[spec["__tensor__"]]))
+        t.stop_gradient = spec.get("stop_gradient", True)
+        return t
+    if "__array__" in spec:
+        return np.asarray(arrays[spec["__array__"]])
+    if "__dict__" in spec:
+        return {k: _unflatten(v, arrays) for k, v in spec["__dict__"].items()}
+    if "__seq__" in spec:
+        seq = [_unflatten(v, arrays) for v in spec["__seq__"]]
+        return tuple(seq) if spec.get("__type__") == "tuple" else seq
+    if "__scalar__" in spec:
+        return spec["__scalar__"]
+    if "__pickle__" in spec:
+        return pickle.loads(bytes.fromhex(spec["__pickle__"]))
+    raise ValueError(f"bad manifest entry {spec!r}")
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    manifest = {"magic": _MAGIC, "tree": _flatten(obj, "root", arrays)}
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
+        zf.writestr("manifest.json", json.dumps(manifest))
+        for name, arr in arrays.items():
+            buf = _io.BytesIO()
+            np.save(buf, arr, allow_pickle=False)
+            zf.writestr(name + ".npy", buf.getvalue())
+
+
+def load(path: str, **configs) -> Any:
+    with zipfile.ZipFile(path, "r") as zf:
+        manifest = json.loads(zf.read("manifest.json"))
+        if manifest.get("magic") != _MAGIC:
+            raise ValueError(f"{path} is not a paddle_tpu checkpoint")
+
+        class _Lazy:
+            def __getitem__(self, name):
+                with zf.open(name + ".npy") as f:
+                    return np.load(_io.BytesIO(f.read()), allow_pickle=False)
+
+        return _unflatten(manifest["tree"], _Lazy())

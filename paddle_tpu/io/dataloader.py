@@ -1,0 +1,152 @@
+"""DataLoader (reference: python/paddle/io/reader.py:262 — multiprocess
+worker pool + blocking queue + pin-memory).
+
+TPU-native host pipeline: worker threads/processes produce numpy batches, a
+background prefetcher keeps a bounded queue full and (optionally) stages
+batches onto device ahead of compute — replacing the reference's C++
+buffered readers.  The native (C) double-buffered batch assembler lives in
+paddle_tpu/native (used automatically when built).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch: List[Any]):
+    """Stack a list of samples into batched numpy arrays (reference:
+    io/dataloader/collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if hasattr(sample, "_value"):  # Tensor
+        return np.stack([np.asarray(s._value) for s in batch])
+    if isinstance(sample, (tuple, list)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(col)) for col in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+class _PrefetchIterator:
+    def __init__(self, produce, num_prefetch: int, to_tensor: Callable):
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(num_prefetch, 1))
+        self._to_tensor = to_tensor
+        self._done = object()
+        self._exc: Optional[BaseException] = None
+
+        def worker():
+            try:
+                for item in produce():
+                    self._queue.put(item)
+            except BaseException as e:  # propagate to consumer
+                self._exc = e
+            finally:
+                self._queue.put(self._done)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is self._done:
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return self._to_tensor(item)
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list: bool = True, batch_sampler: Optional[BatchSampler] = None,
+                 batch_size: int = 1, shuffle: bool = False,
+                 drop_last: bool = False, collate_fn: Optional[Callable] = None,
+                 num_workers: int = 0, use_buffer_reader: bool = True,
+                 prefetch_factor: int = 2, use_shared_memory: bool = True,
+                 timeout: int = 0, worker_init_fn: Optional[Callable] = None,
+                 persistent_workers: bool = False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.use_buffer_reader = use_buffer_reader
+        self._iterable_ds = isinstance(dataset, IterableDataset)
+        if self._iterable_ds:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_ds:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    # ------------------------------------------------------------------
+    def _produce_batches(self):
+        if self._iterable_ds:
+            it = iter(self.dataset)
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch or (self.drop_last
+                                 and len(batch) < self.batch_size):
+                    return
+                yield self.collate_fn(batch)
+        elif self.num_workers > 0:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(self.num_workers) as pool:
+                def fetch(indices):
+                    return self.collate_fn([self.dataset[i] for i in indices])
+                for batch in pool.map(fetch, iter(self.batch_sampler)):
+                    yield batch
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _to_tensors(self, batch):
+        from ..core.tensor import Tensor
+
+        def conv(x):
+            if isinstance(x, np.ndarray):
+                if x.dtype == np.float64:
+                    x = x.astype(np.float32)
+                return Tensor(x)
+            return x
+
+        if isinstance(batch, (tuple, list)):
+            return type(batch)(conv(b) for b in batch)
+        if isinstance(batch, dict):
+            return {k: conv(v) for k, v in batch.items()}
+        return conv(batch)
+
+    def __iter__(self):
+        if self.use_buffer_reader:
+            return _PrefetchIterator(self._produce_batches,
+                                     self.prefetch_factor * max(
+                                         self.num_workers, 1),
+                                     self._to_tensors)
+        return (self._to_tensors(b) for b in self._produce_batches())

@@ -1,0 +1,141 @@
+"""``paddle_tpu.jit`` — traced whole-graph execution.
+
+The reference needed two dynamic-to-static routes (SOT bytecode tracing,
+jit/sot/translate.py:30, and an AST transpiler, dy2static/program_translator
+.py) because its eager ops were opaque C++ calls.  Here every op is a jnp
+function, so ``to_static`` is ``jax.jit`` plus Tensor boxing: inside the
+trace, dispatch sees tracers and falls through to direct calls (SURVEY §3.3
+collapses into one XLA program — the PirInterpreter replacement)."""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_static", "jit_compile", "in_to_static_mode", "not_to_static",
+           "ignore_module", "save", "load"]
+
+
+class _TraceState(threading.local):
+    def __init__(self):
+        self.depth = 0
+
+
+_trace_state = _TraceState()
+
+
+def in_to_static_mode() -> bool:
+    return _trace_state.depth > 0
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _wrap(x):
+    return Tensor(x) if isinstance(x, jax.Array) else x
+
+
+class StaticFunction:
+    """Callable produced by ``to_static``; holds the jitted program cache
+    (the analog of the reference's per-spec Program cache,
+    program_translator.py)."""
+
+    def __init__(self, fn: Callable, input_spec=None, full_graph=True,
+                 backend=None, donate_argnums=(), static_argnums=()):
+        self._fn = fn
+        self._input_spec = input_spec
+        functools.update_wrapper(self, fn)
+
+        def traced(*args, **kwargs):
+            _trace_state.depth += 1
+            try:
+                targs = jax.tree.map(_wrap, args)
+                tkwargs = jax.tree.map(_wrap, kwargs)
+                out = fn(*targs, **tkwargs)
+                return jax.tree.map(_unwrap, out,
+                                    is_leaf=lambda x: isinstance(x, Tensor))
+            finally:
+                _trace_state.depth -= 1
+
+        self._jitted = jax.jit(traced, donate_argnums=donate_argnums,
+                               static_argnums=static_argnums)
+
+    def __call__(self, *args, **kwargs):
+        vargs = jax.tree.map(_unwrap, args,
+                             is_leaf=lambda x: isinstance(x, Tensor))
+        vkwargs = jax.tree.map(_unwrap, kwargs,
+                               is_leaf=lambda x: isinstance(x, Tensor))
+        out = self._jitted(*vargs, **vkwargs)
+        return jax.tree.map(_wrap, out)
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return functools.partial(self.__call__, instance)
+
+    @property
+    def code(self) -> str:
+        import inspect
+        return inspect.getsource(self._fn)
+
+    def concrete_program(self, *args, **kwargs):
+        vargs = jax.tree.map(_unwrap, args,
+                             is_leaf=lambda x: isinstance(x, Tensor))
+        return self._jitted.lower(*vargs, **kwargs)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """``@paddle.jit.to_static`` parity (reference: jit/api.py:195)."""
+
+    def deco(fn):
+        if isinstance(fn, StaticFunction):
+            return fn
+        # Layers: wrap forward
+        from ..nn.layer.layers import Layer
+        if isinstance(fn, Layer):
+            layer = fn
+            orig_forward = layer.forward
+            layer.forward = StaticFunction(
+                lambda *a, **k: orig_forward(*a, **k), input_spec, full_graph)
+            return layer
+        return StaticFunction(fn, input_spec, full_graph)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def jit_compile(fn: Callable, donate_argnums=(), static_argnums=()):
+    """Lower-level helper: jit a Tensor-level function."""
+    return StaticFunction(fn, donate_argnums=donate_argnums,
+                          static_argnums=static_argnums)
+
+
+def not_to_static(fn=None):
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+def save(layer, path, input_spec=None, **config):
+    """``paddle.jit.save`` analog: serialize params + a callable spec.
+    Unlike the reference's Program+TranslatedLayer format (jit/
+    translated_layer.py), we save the state_dict plus the layer's class
+    import path; ``jit.load`` reconstructs and re-jits."""
+    from ..framework.io import save as _save
+    _save(layer.state_dict(), path + ".pdparams")
+
+
+def load(path, **config):
+    raise NotImplementedError(
+        "jit.load of serialized programs: use Layer + set_state_dict; "
+        "AOT-compiled export lands with the inference module")

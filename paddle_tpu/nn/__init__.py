@@ -1,0 +1,8 @@
+"""``paddle_tpu.nn`` (reference: python/paddle/nn/__init__.py)."""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .attr import ParamAttr  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer.layers import Layer, functional_call, functional_call_with_buffers, functional_state, state_arrays  # noqa: F401

@@ -1,0 +1,109 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py —
+``ClipGradByGlobalNorm`` used by every hybrid-parallel optimizer; the
+distributed variant reduces the global norm across mp/pp/sharding groups,
+hybrid_parallel_optimizer.py:255)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grads_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads: List[Tuple[Tensor, Tensor]]):
+        raise NotImplementedError
+
+    def apply_values(self, grads: dict) -> dict:
+        """Pure functional variant over {name: grad array} — used inside
+        jitted train steps."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+    def apply_values(self, grads):
+        return {k: jnp.clip(v, self.min, self.max) for k, v in grads.items()}
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, g):
+        n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+        return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+    def __call__(self, params_grads):
+        return [(p, Tensor(self._clip(g._value)) if g is not None else g)
+                for p, g in params_grads]
+
+    def apply_values(self, grads):
+        return {k: self._clip(v) for k, v in grads.items()}
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Scale all grads by clip_norm/global_norm.  ``group_norm_fn`` lets the
+    hybrid-parallel optimizer inject a cross-group reduction of the squared
+    norm (the jit path does this with a psum over mesh axes)."""
+
+    def __init__(self, clip_norm=1.0, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_norm_fn = None
+
+    def _global_norm_sq(self, values):
+        total = None
+        for g in values:
+            s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            total = s if total is None else total + s
+        if total is None:
+            total = jnp.zeros((), jnp.float32)
+        if self.group_norm_fn is not None:
+            total = self.group_norm_fn(total)
+        return total
+
+    def __call__(self, params_grads):
+        gs = [g._value for _, g in params_grads if g is not None]
+        total = self._global_norm_sq(gs)
+        gn = jnp.sqrt(total)
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._value.astype(jnp.float32)
+                                       * scale).astype(g.dtype))))
+        return out
+
+    def apply_values(self, grads):
+        total = self._global_norm_sq(list(grads.values()))
+        gn = jnp.sqrt(total)
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        return {k: (v.astype(jnp.float32) * scale).astype(v.dtype)
+                for k, v in grads.items()}
+
+
+def clip_grads_(parameters, clip) -> None:
+    pgs = [(p, p.grad) for p in parameters if p.grad is not None]
+    for (p, _), (_, g) in zip(pgs, clip(pgs)):
+        p.grad = g

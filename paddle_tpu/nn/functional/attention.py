@@ -1,0 +1,128 @@
+"""Attention functional ops.
+
+Reference: python/paddle/nn/functional/flash_attention.py:976
+(``scaled_dot_product_attention``), :195 (``flash_attention``).  The jnp
+path here is the numeric reference; when the input is on TPU and shapes
+allow, dispatch goes to the Pallas flash-attention kernel
+(paddle_tpu.ops.pallas.flash_attention).  Layout follows paddle:
+[batch, seq, num_heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op
+from ...core.rng import next_rng_key
+
+
+def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, key=None,
+              scale=None):
+    # q/k/v: [B, S, H, D] → compute in [B, H, S, D]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * s
+    logits = logits.astype(jnp.float32)
+    if causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((qlen, klen), bool), klen - qlen)
+        logits = jnp.where(cm, logits, jnp.finfo(jnp.float32).min)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True):
+    use_pallas = _should_use_pallas(query)
+    rng = next_rng_key() if (dropout_p > 0.0 and training) else None
+
+    def impl(q, k, v, m, rk):
+        if use_pallas and m is None and (dropout_p == 0.0 or not training):
+            from ...ops.pallas.flash_attention import flash_attention_fwd
+            return flash_attention_fwd(q, k, v, causal=is_causal)
+        return _sdpa_ref(q, k, v, m, dropout_p if training else 0.0,
+                         is_causal, rk)
+
+    return run_op("scaled_dot_product_attention", impl,
+                  (query, key, value, attn_mask, rng), {})
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, training=True):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False, training=True):
+    """Varlen flash attention (reference: flash_attn_unpadded
+    nn/functional/flash_attention.py:593).  Packed layout: [total_tokens,
+    num_heads, head_dim] with cu_seqlens prefix sums.  Implemented by
+    segment-masked attention over the packed sequence — O(T^2) reference;
+    the Pallas varlen kernel handles the fused path."""
+
+    def impl(q, k, v, cq, ck):
+        t_q = q.shape[0]
+        t_k = k.shape[0]
+        seg_q = jnp.searchsorted(cq, jnp.arange(t_q), side="right") - 1
+        seg_k = jnp.searchsorted(ck, jnp.arange(t_k), side="right") - 1
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / math.sqrt(d)
+        logits = jnp.einsum("qhd,khd->hqk", q, k) * s
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(t_q) - jnp.take(cq, seg_q)
+            pos_k = jnp.arange(t_k) - jnp.take(ck, seg_k)
+            mask = mask & (pos_q[:, None] >= pos_k[None, :])
+        logits = jnp.where(mask[None], logits.astype(jnp.float32),
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("hqk,khd->qhd", probs, v)
+
+    out = run_op("flash_attn_unpadded", impl,
+                 (query, key, value, cu_seqlens_q, cu_seqlens_k), {})
+    return out, None
+
+
+def _should_use_pallas(query) -> bool:
+    from ...core.flags import FLAGS
+    try:
+        import jax
+        dev = jax.devices()[0].platform.lower()
+    except Exception:
+        return False
+    if FLAGS.pallas_interpret:
+        return True
+    return dev in ("tpu", "axon")
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    from ...core import dtypes as _dt
+
+    def impl(ln):
+        m = maxlen or int(jnp.max(ln))
+        return (jnp.arange(m)[None, :] < ln[:, None]).astype(
+            _dt.canonical_dtype(dtype))
+
+    return run_op("sequence_mask", impl, (lengths,), {}, differentiable=False)

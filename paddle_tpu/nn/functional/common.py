@@ -1,0 +1,257 @@
+"""Common functional ops: linear, dropout, embedding, interpolate, …
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+from ...core.rng import next_rng_key
+
+
+def linear(x, weight, bias=None):
+    """y = x @ W + b with paddle weight layout [in, out] (reference:
+    nn/functional/common.py linear → matmul_v2 + elementwise_add; on TPU a
+    single MXU matmul with fused bias add)."""
+
+    def impl(xv, w, b):
+        out = jnp.matmul(xv, w)
+        if b is not None:
+            out = out + b
+        return out
+
+    return run_op("linear", impl, (x, weight, bias), {})
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
+    if not training or p == 0.0:
+        from ...ops import api as _api
+        return _api.assign(x)
+    key = next_rng_key()
+
+    def impl(xv, k):
+        if axis is None:
+            shape = xv.shape
+        else:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = tuple(xv.shape[i] if i in axes else 1
+                          for i in range(xv.ndim))
+        keep = jax.random.bernoulli(k, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, xv / (1.0 - p), 0.0).astype(xv.dtype)
+        return jnp.where(keep, xv, 0.0).astype(xv.dtype)
+
+    return run_op("dropout", impl, (x, key), {})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    if not training or p == 0.0:
+        from ...ops import api as _api
+        return _api.assign(x)
+    key = next_rng_key()
+
+    def impl(xv, k):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(k, 1.0 - p, xv.shape)
+        a = jnp.power((1.0 - p) * (1.0 + p * alpha_p ** 2), -0.5)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, xv, alpha_p) + b).astype(xv.dtype)
+
+    return run_op("alpha_dropout", impl, (x, key), {})
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    """Lookup rows of ``weight`` (reference: nn/functional/input.py
+    embedding → c_embedding for TP; the TP variant lives in
+    parallel/mp_layers.VocabParallelEmbedding)."""
+
+    def impl(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return run_op("embedding", impl, (x, weight), {})
+
+
+def one_hot(x, num_classes):
+    return run_op("one_hot_f", lambda ids: jax.nn.one_hot(ids, num_classes),
+                  (x,), {}, differentiable=False)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def impl(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+
+    return run_op("cosine_similarity", impl, (x1, x2), {})
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    def impl(a, b):
+        d = jnp.abs(a - b) + epsilon
+        return jnp.power(jnp.sum(jnp.power(d, p), -1, keepdims=keepdim),
+                         1.0 / p)
+
+    return run_op("pairwise_distance", impl, (x, y), {})
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+
+    def impl(xv):
+        if data_format == "NCHW":
+            n, c, h, w = xv.shape
+            oc = c // (r * r)
+            out = jnp.reshape(xv, (n, oc, r, r, h, w))
+            out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+            return jnp.reshape(out, (n, oc, h * r, w * r))
+        n, h, w, c = xv.shape
+        oc = c // (r * r)
+        out = jnp.reshape(xv, (n, h, w, r, r, oc))
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return jnp.reshape(out, (n, h * r, w * r, oc))
+
+    return run_op("pixel_shuffle", impl, (x,), {})
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+
+    def impl(xv):
+        n, c, h, w = xv.shape
+        out = jnp.reshape(xv, (n, c, h // r, r, w // r, r))
+        out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+        return jnp.reshape(out, (n, c * r * r, h // r, w // r))
+
+    return run_op("pixel_unshuffle", impl, (x,), {})
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    def impl(xv):
+        n, c, h, w = xv.shape
+        out = jnp.reshape(xv, (n, groups, c // groups, h, w))
+        out = jnp.swapaxes(out, 1, 2)
+        return jnp.reshape(out, (n, c, h, w))
+
+    return run_op("channel_shuffle", impl, (x,), {})
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW"):
+    def impl(xv):
+        channel_last = not data_format.startswith("NC")
+        spatial = xv.shape[1:-1] if channel_last else xv.shape[2:]
+        if size is not None:
+            out_sp = tuple(int(s) for s in (
+                size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(spatial)
+            out_sp = tuple(int(np.floor(s * f)) for s, f in zip(spatial, sf))
+        if channel_last:
+            new_shape = (xv.shape[0],) + out_sp + (xv.shape[-1],)
+        else:
+            new_shape = xv.shape[:2] + out_sp
+        method = {"nearest": "nearest", "bilinear": "linear",
+                  "trilinear": "linear", "linear": "linear",
+                  "bicubic": "cubic", "area": "linear"}[mode]
+        return jax.image.resize(xv, new_shape, method=method).astype(xv.dtype)
+
+    return run_op("interpolate", impl, (x,), {})
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW"):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (reference: phi unfold kernel)."""
+    def _t(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = _t(kernel_sizes)
+    sh, sw = _t(strides)
+    dh, dw = _t(dilations)
+    if isinstance(paddings, int):
+        ph0 = ph1 = pw0 = pw1 = paddings
+    elif len(paddings) == 2:
+        (ph0, pw0) = paddings
+        ph1, pw1 = ph0, pw0
+    else:
+        ph0, pw0, ph1, pw1 = paddings
+
+    def impl(xv):
+        n, c, h, w = xv.shape
+        xp = jnp.pad(xv, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+        out_h = (h + ph0 + ph1 - (dh * (kh - 1) + 1)) // sh + 1
+        out_w = (w + pw0 + pw1 - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            xp, (kh, kw), (sh, sw), padding=[(0, 0), (0, 0)],
+            rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return jnp.reshape(patches, (n, c * kh * kw, out_h * out_w))
+
+    return run_op("unfold", impl, (x,), {})
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    def _t(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh, ow = _t(output_sizes)
+    kh, kw = _t(kernel_sizes)
+    sh, sw = _t(strides)
+    dh, dw = _t(dilations)
+    p = paddings if isinstance(paddings, int) else None
+    ph0 = ph1 = pw0 = pw1 = p if p is not None else 0
+    if p is None:
+        pd = _t(paddings)
+        ph0 = ph1 = pd[0]
+        pw0 = pw1 = pd[1]
+
+    def impl(xv):
+        n = xv.shape[0]
+        c = xv.shape[1] // (kh * kw)
+        out_h = (oh + ph0 + ph1 - (dh * (kh - 1) + 1)) // sh + 1
+        out_w = (ow + pw0 + pw1 - (dw * (kw - 1) + 1)) // sw + 1
+        cols = jnp.reshape(xv, (n, c, kh, kw, out_h, out_w))
+        out = jnp.zeros((n, c, oh + ph0 + ph1, ow + pw0 + pw1), xv.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wj = j * dw
+                out = out.at[:, :, hi:hi + out_h * sh:sh,
+                             wj:wj + out_w * sw:sw].add(cols[:, :, i, j])
+        return out[:, :, ph0:ph0 + oh, pw0:pw0 + ow]
+
+    return run_op("fold", impl, (x,), {})
+
+
+def bilinear(x1, x2, weight, bias=None):
+    def impl(a, b, w, bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi is not None:
+            out = out + bi
+        return out
+
+    return run_op("bilinear", impl, (x1, x2, weight, bias), {})

@@ -1,0 +1,155 @@
+"""Convolution functional ops.
+
+Reference: python/paddle/nn/functional/conv.py → phi conv kernels (gpudnn).
+TPU-native: ``lax.conv_general_dilated`` lowers directly onto the MXU; no
+cudnn autotuning layer is needed (XLA picks the layout).  Weight layout
+follows paddle: [out_c, in_c/groups, *spatial].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+
+
+def _tupleize(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _pad_spec(padding, n, strides, in_spatial, k_spatial, dilation):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    return [tuple(int(x) for x in p) for p in padding]
+
+
+def _conv_impl(x, weight, bias, stride, padding, dilation, groups,
+               data_format, n):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    sp = "".join("DHW"[3 - n:])
+    if channel_last:
+        dn_in = "N" + sp + "C"
+    else:
+        dn_in = "NC" + sp
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape, (dn_in, "OI" + sp, dn_in))
+    in_spatial = [x.shape[i] for i in range(1, n + 1)] if channel_last else \
+        [x.shape[i] for i in range(2, n + 2)]
+    pad = _pad_spec(padding, n, stride, in_spatial, weight.shape[2:], dilation)
+    out = jax.lax.conv_general_dilated(
+        x, weight,
+        window_strides=_tupleize(stride, n),
+        padding=pad,
+        rhs_dilation=_tupleize(dilation, n),
+        feature_group_count=groups,
+        dimension_numbers=dn,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    )
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if bias is not None:
+        if channel_last:
+            out = out + jnp.reshape(bias, (1,) * (n + 1) + (-1,))
+        else:
+            out = out + jnp.reshape(bias, (1, -1) + (1,) * n)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    return run_op("conv1d", lambda x, w, b: _conv_impl(
+        x, w, b, stride, padding, dilation, groups, data_format, 1),
+        (x, weight, bias), {})
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    return run_op("conv2d", lambda x, w, b: _conv_impl(
+        x, w, b, stride, padding, dilation, groups, data_format, 2),
+        (x, weight, bias), {})
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    return run_op("conv3d", lambda x, w, b: _conv_impl(
+        x, w, b, stride, padding, dilation, groups, data_format, 3),
+        (x, weight, bias), {})
+
+
+def _conv_transpose_impl(x, weight, bias, stride, padding, output_padding,
+                         dilation, groups, data_format, n):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    sp = "".join("DHW"[3 - n:])
+    dn_in = ("N" + sp + "C") if channel_last else ("NC" + sp)
+    if groups != 1:
+        raise NotImplementedError("grouped conv_transpose not yet supported")
+    # paddle transpose-conv weight layout [in_c, out_c/groups, *spatial];
+    # with transpose_kernel=True lax swaps I/O, so declare it as "OI".
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape, (dn_in, "OI" + sp, dn_in))
+    strides = _tupleize(stride, n)
+    dil = _tupleize(dilation, n)
+    k_spatial = weight.shape[2:]
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _tupleize(padding, n) if not isinstance(padding, (list,)) or all(
+            isinstance(v, (int, np.integer)) for v in padding) else padding
+        if isinstance(p, tuple):
+            # paddle pad p → lax pad (k_eff-1-p) so output = (in-1)*s - 2p + k
+            pad = []
+            for i, v in enumerate(p):
+                k_eff = (k_spatial[i] - 1) * dil[i] + 1
+                pad.append((k_eff - 1 - v, k_eff - 1 - v))
+        else:
+            pad = p
+    out = jax.lax.conv_transpose(
+        x, weight, strides=strides, padding=pad, rhs_dilation=dil,
+        dimension_numbers=dn, transpose_kernel=True)
+    opad = _tupleize(output_padding, n) if output_padding else (0,) * n
+    if any(opad):
+        widths = [(0, 0)] * out.ndim
+        for i, o in enumerate(opad):
+            ax = (1 + i) if channel_last else (2 + i)
+            widths[ax] = (0, o)
+        out = jnp.pad(out, widths)
+    if bias is not None:
+        if channel_last:
+            out = out + jnp.reshape(bias, (1,) * (n + 1) + (-1,))
+        else:
+            out = out + jnp.reshape(bias, (1, -1) + (1,) * n)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, data_format="NCL"):
+    return run_op("conv1d_transpose", lambda x, w, b: _conv_transpose_impl(
+        x, w, b, stride, padding, output_padding, dilation, groups,
+        data_format, 1), (x, weight, bias), {})
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    return run_op("conv2d_transpose", lambda x, w, b: _conv_transpose_impl(
+        x, w, b, stride, padding, output_padding, dilation, groups,
+        data_format, 2), (x, weight, bias), {})
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    return run_op("conv3d_transpose", lambda x, w, b: _conv_transpose_impl(
+        x, w, b, stride, padding, output_padding, dilation, groups,
+        data_format, 3), (x, weight, bias), {})

@@ -1,0 +1,243 @@
+"""Loss functional ops (reference: python/paddle/nn/functional/loss.py →
+phi cross_entropy/... kernels).  softmax+CE fuses in XLA; the TP-sharded
+variant (ParallelCrossEntropy) lives in parallel/mp_layers."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    def impl(logits, lab, w):
+        lp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else \
+            jnp.log(jnp.clip(logits, 1e-30, None))
+        n_cls = logits.shape[axis]
+        if soft_label or (lab.ndim == logits.ndim and lab.shape == logits.shape):
+            tgt = lab
+            loss = -jnp.sum(tgt * lp, axis=axis)
+            valid = jnp.ones(loss.shape, bool)
+        else:
+            lab_ = lab
+            if lab_.ndim == logits.ndim:
+                lab_ = jnp.squeeze(lab_, axis)
+            valid = lab_ != ignore_index
+            safe = jnp.where(valid, lab_, 0)
+            if label_smoothing > 0.0:
+                onehot = jax.nn.one_hot(safe, n_cls, dtype=lp.dtype, axis=axis)
+                tgt = onehot * (1 - label_smoothing) + label_smoothing / n_cls
+                loss = -jnp.sum(tgt * lp, axis=axis)
+            else:
+                loss = -jnp.take_along_axis(
+                    lp, jnp.expand_dims(safe, axis), axis=axis)
+                loss = jnp.squeeze(loss, axis)
+            if w is not None:
+                loss = loss * w[safe]
+            loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            if w is not None and not soft_label:
+                lab_ = lab if lab.ndim < logits.ndim else jnp.squeeze(lab, axis)
+                safe = jnp.where(valid, lab_, 0)
+                denom = jnp.sum(jnp.where(valid, w[safe], 0.0))
+            else:
+                denom = jnp.maximum(jnp.sum(valid.astype(lp.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    return run_op("cross_entropy", impl, (input, label, weight), {})
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    out = cross_entropy(logits, label, soft_label=soft_label,
+                        ignore_index=ignore_index, reduction="none", axis=axis)
+    if return_softmax:
+        from ...ops import api as _api
+        return out, _api.softmax(logits, axis=axis)
+    return out
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    def impl(lp, lab, w):
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        loss = -jnp.take_along_axis(lp, safe[..., None], axis=-1)[..., 0] \
+            if lp.ndim == 2 else -jnp.take_along_axis(
+                lp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        if w is not None:
+            loss = loss * w[safe]
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(w[safe] * valid) if w is not None else \
+                jnp.maximum(jnp.sum(valid), 1)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    return run_op("nll_loss", impl, (input, label, weight), {})
+
+
+def mse_loss(input, label, reduction="mean"):
+    return run_op("mse_loss", lambda x, y: _reduce(jnp.square(x - y),
+                                                   reduction),
+                  (input, label), {})
+
+
+def l1_loss(input, label, reduction="mean"):
+    return run_op("l1_loss", lambda x, y: _reduce(jnp.abs(x - y), reduction),
+                  (input, label), {})
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    def impl(x, y):
+        d = jnp.abs(x - y)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return run_op("smooth_l1_loss", impl, (input, label), {})
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    def impl(x, y):
+        d = jnp.abs(x - y)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return run_op("huber_loss", impl, (input, label), {})
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    def impl(p, y, w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return run_op("binary_cross_entropy", impl, (input, label, weight), {})
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    def impl(z, y, w, pw):
+        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+        base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            log_sig = jax.nn.log_sigmoid(z)
+            log_sig_neg = jax.nn.log_sigmoid(-z)
+            base = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        if w is not None:
+            base = base * w
+        return _reduce(base, reduction)
+
+    return run_op("bce_with_logits", impl, (logit, label, weight, pos_weight),
+                  {})
+
+
+def kl_div(input, label, reduction="mean", log_target=False):
+    def impl(lp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - lp)
+        else:
+            loss = jnp.where(y > 0, y * (jnp.log(jnp.clip(y, 1e-30, None)) - lp),
+                             0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+
+    return run_op("kl_div", impl, (input, label), {})
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    def impl(x1, x2, y):
+        cos = jnp.sum(x1 * x2, -1) / (
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1) + 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return run_op("cosine_embedding_loss", impl, (input1, input2, label), {})
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    def impl(x, o, y):
+        return _reduce(jnp.maximum(0.0, -y * (x - o) + margin), reduction)
+
+    return run_op("margin_ranking_loss", impl, (input, other, label), {})
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    def impl(x, y):
+        loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce(loss, reduction)
+
+    return run_op("hinge_embedding_loss", impl, (input, label), {})
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def impl(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p),
+                                     -1), 1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+
+    return run_op("triplet_margin_loss", impl, (input, positive, negative), {})
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    def impl(y, pd):
+        n = y.shape[-1]
+        if pd is not None:
+            return (1 - epsilon) * y + epsilon * pd
+        return (1 - epsilon) * y + epsilon / n
+
+    return run_op("label_smooth", impl, (label, prior_dist), {})
+
+
+def square_error_cost(input, label):
+    return run_op("square_error_cost", lambda x, y: jnp.square(x - y),
+                  (input, label), {})
+
+
+def log_loss(input, label, epsilon=1e-4):
+    def impl(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log1p(epsilon - p)
+
+    return run_op("log_loss", impl, (input, label), {})
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    def impl(z, y, nrm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if nrm is not None:
+            loss = loss / nrm
+        return _reduce(loss, reduction)
+
+    return run_op("sigmoid_focal_loss", impl, (logit, label, normalizer), {})
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    raise NotImplementedError("ctc_loss: planned (lax.scan forward algorithm)")

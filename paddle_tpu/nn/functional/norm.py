@@ -1,0 +1,184 @@
+"""Normalization functional ops (reference:
+python/paddle/nn/functional/norm.py; rms_norm from
+phi/kernels/gpu/rms_norm_kernel.cu).  The jnp forms here are the numeric
+references; the Pallas fused variants live in ops/pallas and are dispatched
+by the incubate fused APIs."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+
+
+def _norm_axes(x_ndim, channel_axis):
+    return tuple(i for i in range(x_ndim) if i != channel_axis and i != 0) \
+        if False else None
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None):
+    """Returns normalized output; in training mode also updates the running
+    stats *in place* on the passed Tensors (eager path) — mirroring the
+    reference's mutable mean/variance outputs (phi batch_norm kernel)."""
+    from ...core.tensor import Tensor
+
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1 \
+        if hasattr(x, "ndim") else 1
+
+    def impl(xv, rm, rv, w, b):
+        axes = tuple(i for i in range(xv.ndim) if i != ch_axis)
+        if training and not use_global_stats:
+            mean = jnp.mean(xv, axis=axes)
+            var = jnp.var(xv, axis=axes)
+        else:
+            mean, var = rm, rv
+        shape = [1] * xv.ndim
+        shape[ch_axis] = -1
+        inv = jax.lax.rsqrt(var + epsilon)
+        out = (xv - jnp.reshape(mean, shape)) * jnp.reshape(inv, shape)
+        if w is not None:
+            out = out * jnp.reshape(w, shape)
+        if b is not None:
+            out = out + jnp.reshape(b, shape)
+        if training and not use_global_stats:
+            n = int(np.prod([xv.shape[a] for a in axes]))
+            unbiased = var * n / max(n - 1, 1)
+            new_rm = momentum * rm + (1 - momentum) * mean
+            new_rv = momentum * rv + (1 - momentum) * unbiased
+            return out, new_rm, new_rv
+        return out, rm, rv
+
+    res = run_op("batch_norm", impl, (x, running_mean, running_var, weight,
+                                      bias), {})
+    out, new_rm, new_rv = res
+    if training and not use_global_stats:
+        if isinstance(running_mean, Tensor):
+            running_mean._value = new_rm._value if isinstance(new_rm, Tensor) \
+                else new_rm
+        if isinstance(running_var, Tensor):
+            running_var._value = new_rv._value if isinstance(new_rv, Tensor) \
+                else new_rv
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n = len(tuple(normalized_shape))
+
+    def impl(xv, w, b):
+        axes = tuple(range(xv.ndim - n, xv.ndim))
+        mean = jnp.mean(xv, axis=axes, keepdims=True)
+        var = jnp.var(xv, axis=axes, keepdims=True)
+        out = (xv - mean) * jax.lax.rsqrt(var + epsilon)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+
+    return run_op("layer_norm", impl, (x, weight, bias), {})
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1):
+    """Pure-jnp RMSNorm reference (fused Pallas variant:
+    paddle_tpu.ops.pallas.rms_norm; reference CUDA:
+    phi/kernels/gpu/rms_norm_kernel.cu)."""
+
+    def impl(xv, w, b):
+        axis = begin_norm_axis if begin_norm_axis >= 0 else xv.ndim + begin_norm_axis
+        axes = tuple(range(axis, xv.ndim))
+        ms = jnp.mean(jnp.square(xv.astype(jnp.float32)), axis=axes,
+                      keepdims=True)
+        out = (xv.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(
+            xv.dtype)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+
+    return run_op("rms_norm", impl, (x, weight, bias), {})
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    channel_last = not data_format.startswith("NC")
+
+    def impl(xv, w, b):
+        if channel_last:
+            xv_ = jnp.moveaxis(xv, -1, 1)
+        else:
+            xv_ = xv
+        N, C = xv_.shape[0], xv_.shape[1]
+        g = num_groups
+        rest = xv_.shape[2:]
+        grouped = jnp.reshape(xv_, (N, g, C // g) + rest)
+        axes = tuple(range(2, grouped.ndim))
+        mean = jnp.mean(grouped, axis=axes, keepdims=True)
+        var = jnp.var(grouped, axis=axes, keepdims=True)
+        outg = (grouped - mean) * jax.lax.rsqrt(var + epsilon)
+        out = jnp.reshape(outg, xv_.shape)
+        shape = (1, C) + (1,) * len(rest)
+        if w is not None:
+            out = out * jnp.reshape(w, shape)
+        if b is not None:
+            out = out + jnp.reshape(b, shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return run_op("group_norm", impl, (x, weight, bias), {})
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW"):
+    def impl(xv, w, b):
+        axes = tuple(range(2, xv.ndim))
+        mean = jnp.mean(xv, axis=axes, keepdims=True)
+        var = jnp.var(xv, axis=axes, keepdims=True)
+        out = (xv - mean) * jax.lax.rsqrt(var + eps)
+        if w is not None:
+            shape = (1, -1) + (1,) * (xv.ndim - 2)
+            out = out * jnp.reshape(w, shape)
+        if b is not None:
+            shape = (1, -1) + (1,) * (xv.ndim - 2)
+            out = out + jnp.reshape(b, shape)
+        return out
+
+    return run_op("instance_norm", impl, (x, weight, bias), {})
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    def impl(xv):
+        ch_axis = 1 if data_format.startswith("NC") else xv.ndim - 1
+        sq = jnp.square(xv)
+        moved = jnp.moveaxis(sq, ch_axis, -1)
+        pad = [(0, 0)] * (moved.ndim - 1) + [(size // 2, (size - 1) // 2)]
+        padded = jnp.pad(moved, pad)
+        win = jax.lax.reduce_window(
+            padded, jnp.asarray(0, xv.dtype), jax.lax.add,
+            (1,) * (moved.ndim - 1) + (size,), (1,) * moved.ndim,
+            [(0, 0)] * moved.ndim)
+        win = jnp.moveaxis(win, -1, ch_axis)
+        return xv / jnp.power(k + alpha * win, beta)
+
+    return run_op("local_response_norm", impl, (x,), {})
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    def impl(xv):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(jnp.square(xv), axis=axis, keepdims=True))
+        else:
+            n = jnp.power(jnp.sum(jnp.power(jnp.abs(xv), p), axis=axis,
+                                  keepdims=True), 1.0 / p)
+        return xv / jnp.maximum(n, epsilon)
+
+    return run_op("normalize", impl, (x,), {})

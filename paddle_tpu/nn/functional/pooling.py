@@ -1,0 +1,187 @@
+"""Pooling functional ops (reference: python/paddle/nn/functional/pooling.py
+→ phi pool kernels).  Implemented with ``lax.reduce_window`` — XLA's native
+windowed reduction, which tiles onto the VPU."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+
+
+def _tup(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _window(x_ndim, ksize, stride, n, channel_last):
+    if channel_last:
+        dims = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        dims = (1, 1) + ksize
+        strides = (1, 1) + stride
+    return dims, strides
+
+
+def _pads(padding, n, channel_last, x_ndim):
+    if isinstance(padding, str):
+        raise ValueError("use explicit int padding for pooling")
+    p = _tup(padding, n)
+    spatial = [(v, v) for v in p]
+    if channel_last:
+        return [(0, 0)] + spatial + [(0, 0)]
+    return [(0, 0), (0, 0)] + spatial
+
+
+def _maxpool(x, ksize, stride, padding, n, channel_last, return_mask=False):
+    dims, strides = _window(x.ndim, ksize, stride, n, channel_last)
+    pads = _pads(padding, n, channel_last, x.ndim)
+    # -inf identity keeps reduce_window on JAX's differentiable max-pool path
+    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    out = jax.lax.reduce_window(x, neg, jax.lax.max, dims, strides, pads)
+    if not return_mask:
+        return out
+    # indices via reduce_window over (value, flat-index) argmax
+    spatial_axes = list(range(1, 1 + n)) if channel_last else list(
+        range(2, 2 + n))
+    sizes = [x.shape[a] for a in spatial_axes]
+    flat = jnp.arange(int(np.prod(sizes))).reshape(sizes)
+    shape = [1] * x.ndim
+    for a, s in zip(spatial_axes, sizes):
+        shape[a] = s
+    idx = jnp.broadcast_to(jnp.reshape(flat, shape), x.shape)
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    _, indices = jax.lax.reduce_window(
+        (x, idx), (jnp.asarray(neg, x.dtype), jnp.asarray(-1, idx.dtype)),
+        reducer, dims, strides, pads)
+    return out, indices
+
+
+def _avgpool(x, ksize, stride, padding, n, channel_last, exclusive=True):
+    dims, strides = _window(x.ndim, ksize, stride, n, channel_last)
+    pads = _pads(padding, n, channel_last, x.ndim)
+    summed = jax.lax.reduce_window(x, jnp.asarray(0, x.dtype), jax.lax.add,
+                                   dims, strides, pads)
+    if exclusive and any(p[0] or p[1] for p in pads):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, jnp.asarray(0, x.dtype),
+                                       jax.lax.add, dims, strides, pads)
+        return summed / counts
+    return summed / np.prod(ksize)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL"):
+    ks = _tup(kernel_size, 1)
+    st = _tup(stride if stride is not None else kernel_size, 1)
+    return run_op("max_pool1d", lambda x: _maxpool(
+        x, ks, st, padding, 1, data_format == "NLC", return_mask), (x,), {})
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW"):
+    ks = _tup(kernel_size, 2)
+    st = _tup(stride if stride is not None else kernel_size, 2)
+    return run_op("max_pool2d", lambda x: _maxpool(
+        x, ks, st, padding, 2, data_format == "NHWC", return_mask), (x,), {})
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW"):
+    ks = _tup(kernel_size, 3)
+    st = _tup(stride if stride is not None else kernel_size, 3)
+    return run_op("max_pool3d", lambda x: _maxpool(
+        x, ks, st, padding, 3, data_format == "NDHWC", return_mask), (x,), {})
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL"):
+    ks = _tup(kernel_size, 1)
+    st = _tup(stride if stride is not None else kernel_size, 1)
+    return run_op("avg_pool1d", lambda x: _avgpool(
+        x, ks, st, padding, 1, data_format == "NLC", exclusive), (x,), {})
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCHW"):
+    ks = _tup(kernel_size, 2)
+    st = _tup(stride if stride is not None else kernel_size, 2)
+    return run_op("avg_pool2d", lambda x: _avgpool(
+        x, ks, st, padding, 2, data_format == "NHWC", exclusive), (x,), {})
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCDHW"):
+    ks = _tup(kernel_size, 3)
+    st = _tup(stride if stride is not None else kernel_size, 3)
+    return run_op("avg_pool3d", lambda x: _avgpool(
+        x, ks, st, padding, 3, data_format == "NDHWC", exclusive), (x,), {})
+
+
+def _adaptive_windows(in_size, out_size):
+    # start/end per output index, matching paddle's adaptive pooling
+    starts = [int(np.floor(i * in_size / out_size)) for i in range(out_size)]
+    ends = [int(np.ceil((i + 1) * in_size / out_size)) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, n, channel_last, op="avg"):
+    spatial_axes = list(range(1, 1 + n)) if channel_last else list(
+        range(2, 2 + n))
+    out_sizes = _tup(output_size, n)
+    # uniform case → plain pooling
+    reduce_fn = jnp.mean if op == "avg" else jnp.max
+    for ax, osz in zip(spatial_axes, out_sizes):
+        isz = x.shape[ax]
+        if isz % osz == 0:
+            k = isz // osz
+            shape = list(x.shape)
+            shape[ax:ax + 1] = [osz, k]
+            x = reduce_fn(jnp.reshape(x, shape), axis=ax + 1)
+        else:
+            starts, ends = _adaptive_windows(isz, osz)
+            segs = [reduce_fn(jax.lax.slice_in_dim(x, s, e, axis=ax), axis=ax,
+                              keepdims=True) for s, e in zip(starts, ends)]
+            x = jnp.concatenate(segs, axis=ax)
+    return x
+
+
+def adaptive_avg_pool1d(x, output_size, data_format="NCL"):
+    return run_op("adaptive_avg_pool1d", lambda x: _adaptive_pool(
+        x, output_size, 1, data_format == "NLC", "avg"), (x,), {})
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return run_op("adaptive_avg_pool2d", lambda x: _adaptive_pool(
+        x, output_size, 2, data_format == "NHWC", "avg"), (x,), {})
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return run_op("adaptive_avg_pool3d", lambda x: _adaptive_pool(
+        x, output_size, 3, data_format == "NDHWC", "avg"), (x,), {})
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, data_format="NCL"):
+    return run_op("adaptive_max_pool1d", lambda x: _adaptive_pool(
+        x, output_size, 1, data_format == "NLC", "max"), (x,), {})
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
+    return run_op("adaptive_max_pool2d", lambda x: _adaptive_pool(
+        x, output_size, 2, data_format == "NHWC", "max"), (x,), {})
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, data_format="NCDHW"):
+    return run_op("adaptive_max_pool3d", lambda x: _adaptive_pool(
+        x, output_size, 3, data_format == "NDHWC", "max"), (x,), {})

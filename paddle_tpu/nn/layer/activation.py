@@ -1,0 +1,98 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+
+from __future__ import annotations
+
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["ReLU", "ReLU6", "GELU", "Sigmoid", "LogSigmoid", "Tanh",
+           "Tanhshrink", "Hardshrink", "Hardsigmoid", "Hardswish", "Hardtanh",
+           "LeakyReLU", "ELU", "SELU", "CELU", "PReLU", "RReLU", "Silu",
+           "Swish", "Mish", "Softmax", "LogSoftmax", "Softmin", "Softplus",
+           "Softshrink", "Softsign", "ThresholdedReLU", "Maxout", "GLU",
+           "Softmax2D"]
+
+
+def _simple(name, fn_name, **defaults):
+    def forward(self, x):
+        fn = getattr(F, fn_name)
+        return fn(x, **self._kw)
+
+    def __init__(self, *args, name=None, **kw):
+        Layer.__init__(self)
+        merged = dict(defaults)
+        keys = list(defaults)
+        for i, a in enumerate(args):
+            merged[keys[i]] = a
+        merged.update({k: v for k, v in kw.items() if k in merged})
+        self._kw = merged
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _simple("ReLU", "relu")
+ReLU6 = _simple("ReLU6", "relu6")
+GELU = _simple("GELU", "gelu", approximate=False)
+Sigmoid = _simple("Sigmoid", "sigmoid")
+LogSigmoid = _simple("LogSigmoid", "log_sigmoid")
+Tanh = _simple("Tanh", "tanh")
+Tanhshrink = _simple("Tanhshrink", "tanhshrink")
+Hardshrink = _simple("Hardshrink", "hardshrink", threshold=0.5)
+Hardsigmoid = _simple("Hardsigmoid", "hardsigmoid")
+Hardswish = _simple("Hardswish", "hardswish")
+Hardtanh = _simple("Hardtanh", "hardtanh", min=-1.0, max=1.0)
+LeakyReLU = _simple("LeakyReLU", "leaky_relu", negative_slope=0.01)
+ELU = _simple("ELU", "elu", alpha=1.0)
+SELU = _simple("SELU", "selu")
+CELU = _simple("CELU", "celu", alpha=1.0)
+Silu = _simple("Silu", "silu")
+Swish = _simple("Swish", "swish")
+Mish = _simple("Mish", "mish")
+Softmax = _simple("Softmax", "softmax", axis=-1)
+LogSoftmax = _simple("LogSoftmax", "log_softmax", axis=-1)
+Softmin = _simple("Softmin", "softmin", axis=-1)
+Softplus = _simple("Softplus", "softplus", beta=1.0, threshold=20.0)
+Softshrink = _simple("Softshrink", "softshrink", threshold=0.5)
+Softsign = _simple("Softsign", "softsign")
+ThresholdedReLU = _simple("ThresholdedReLU", "thresholded_relu",
+                          threshold=1.0, value=0.0)
+GLU = _simple("GLU", "glu", axis=-1)
+
+
+class Softmax2D(Layer):
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups = groups
+        self.axis = axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self.data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

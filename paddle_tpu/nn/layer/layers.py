@@ -1,0 +1,426 @@
+"""``Layer`` — the module base class.
+
+Analog of the reference's ``paddle.nn.Layer``
+(/root/reference/python/paddle/nn/layer/layers.py:353): parameter/buffer/
+sublayer registries, hooks, ``state_dict``/``set_state_dict``, train/eval,
+``to``.  TPU-native addition: the *functional bridge*
+(:func:`state_arrays` / :func:`functional_state` / :func:`functional_call`)
+— a Layer's parameters form a pytree of ``jax.Array``s that can be swapped
+for traced values, so one imperative module definition serves both eager
+execution and whole-graph ``jax.jit`` (the reference needed dy2static/SOT
+bytecode translation for this; here it is a value swap).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtypes as _dt
+from ...core.tensor import Parameter, Tensor
+from ..attr import ParamAttr
+from .. import initializer as I
+
+__all__ = ["Layer", "state_arrays", "functional_state", "functional_call",
+           "functional_call_with_buffers"]
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, key: int):
+        self._hooks = hooks
+        self._key = key
+
+    def remove(self) -> None:
+        self._hooks.pop(self._key, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names", set())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        self.training = True
+        self._dtype = _dt.canonical_dtype(dtype)
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._forward_pre_hooks: Dict[int, Callable] = OrderedDict()
+        self._forward_post_hooks: Dict[int, Callable] = OrderedDict()
+        self._hook_id = 0
+
+    # ------------------------------------------------------------------
+    # attribute routing
+    # ------------------------------------------------------------------
+    def __setattr__(self, name: str, value: Any) -> None:
+        params = self.__dict__.get("_parameters")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ first")
+            self._sub_layers.pop(name, None)
+            self._buffers.pop(name, None)
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            self._parameters.pop(name, None)
+            self._buffers.pop(name, None)
+            self._sub_layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    del params[name]
+                    object.__setattr__(self, name, value)
+                    return
+                raise TypeError(
+                    f"cannot assign non-Parameter to parameter slot {name!r}")
+            if (self.__dict__.get("_buffers") is not None
+                    and name in self._buffers):
+                self._buffers[name] = (value if isinstance(value, Tensor)
+                                       else Tensor(value))
+                return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name: str) -> None:
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # ------------------------------------------------------------------
+    # registration
+    # ------------------------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None) -> Parameter:
+        """Mirror of Layer.create_parameter (layers.py:353 area): initializer
+        precedence attr.initializer > default_initializer > (bias→zeros,
+        weight→Xavier-uniform like the reference's defaults)."""
+        dtype = _dt.canonical_dtype(dtype) or self._dtype
+        attr = ParamAttr._to_attr(attr)
+        init = None
+        if attr is not None and attr.initializer is not None:
+            init = attr.initializer
+        elif default_initializer is not None:
+            init = default_initializer
+        elif is_bias:
+            init = I.Constant(0.0)
+        else:
+            init = I.XavierUniform()
+        value = init(tuple(int(s) for s in shape), dtype)
+        name = attr.name if attr is not None and attr.name else None
+        p = Parameter(value, name=name,
+                      trainable=(attr.trainable if attr is not None else True))
+        if attr is not None:
+            p.optimize_attr = {"learning_rate": attr.learning_rate}
+        return p
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def register_buffer(self, name: str, tensor, persistable: bool = True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    # ------------------------------------------------------------------
+    # traversal
+    # ------------------------------------------------------------------
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else f"{prefix}.{name}"), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for n, p in layer.named_parameters(sub_prefix, True):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_buffers(sub_prefix, True)
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        for name, l in self._sub_layers.items():
+            if l is not None:
+                yield name, l
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, l in self._sub_layers.items():
+            if l is None:
+                continue
+            p = f"{prefix}.{name}" if prefix else name
+            yield p, l
+            yield from l.named_sublayers(p, include_self=False)
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------------------
+    # state dict
+    # ------------------------------------------------------------------
+    def state_dict(self, include_sublayers: bool = True,
+                   structured_name_prefix: str = "",
+                   use_hook: bool = True) -> "OrderedDict[str, Tensor]":
+        out: "OrderedDict[str, Tensor]" = OrderedDict()
+        for n, p in self.named_parameters(structured_name_prefix,
+                                          include_sublayers):
+            out[n] = p
+        skip = self._all_non_persistable_buffer_names(structured_name_prefix)
+        for n, b in self.named_buffers(structured_name_prefix,
+                                       include_sublayers):
+            if n not in skip:
+                out[n] = b
+        return out
+
+    def _all_non_persistable_buffer_names(self, prefix: str = "") -> set:
+        names = {f"{prefix}.{n}" if prefix else n
+                 for n in self._non_persistable_buffer_names}
+        for lname, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            p = f"{prefix}.{lname}" if prefix else lname
+            names |= layer._all_non_persistable_buffer_names(p)
+        return names
+
+    def set_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        own = self.state_dict()
+        missing = []
+        for name, target in own.items():
+            if name in state_dict:
+                src = state_dict[name]
+                v = src._value if isinstance(src, Tensor) else jnp.asarray(src)
+                if tuple(v.shape) != tuple(target.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: checkpoint "
+                        f"{tuple(v.shape)} vs layer {tuple(target.shape)}")
+                target._value = jnp.asarray(v, target.dtype)
+            else:
+                missing.append(name)
+        if missing:
+            import warnings
+            warnings.warn(f"state_dict missing keys: {missing[:8]}"
+                          + ("..." if len(missing) > 8 else ""))
+
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------------
+    # modes / movement
+    # ------------------------------------------------------------------
+    def train(self) -> "Layer":
+        for _, l in self.named_sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self) -> "Layer":
+        for _, l in self.named_sublayers(include_self=True):
+            l.training = False
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None) -> "Layer":
+        if dtype is not None:
+            dtype = _dt.canonical_dtype(dtype)
+            for p in self.parameters():
+                if jnp.issubdtype(p.dtype, jnp.floating):
+                    p._value = jnp.asarray(p._value, dtype)
+            for b in self.buffers():
+                if b is not None and jnp.issubdtype(b.dtype, jnp.floating):
+                    b._value = jnp.asarray(b._value, dtype)
+        if device is not None:
+            import jax
+            from ...core.device import Place
+            if isinstance(device, str):
+                ty, _, idx = device.partition(":")
+                device = Place(ty, int(idx or 0))
+            for t in list(self.parameters()) + list(self.buffers()):
+                if t is not None:
+                    t._value = jax.device_put(t._value, device.jax_device())
+        return self
+
+    def astype(self, dtype) -> "Layer":
+        return self.to(dtype=dtype)
+
+    def float(self) -> "Layer":
+        return self.to(dtype="float32")
+
+    def bfloat16(self) -> "Layer":
+        return self.to(dtype="bfloat16")
+
+    def half(self) -> "Layer":
+        return self.to(dtype="float16")
+
+    # ------------------------------------------------------------------
+    # call / hooks
+    # ------------------------------------------------------------------
+    def register_forward_pre_hook(self, hook: Callable) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook: Callable) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement forward()")
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self) -> str:
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self._sub_layers.items():
+            body = repr(l).split("\n")
+            body = [body[0]] + ["  " + b for b in body[1:]]
+            lines.append(f"  ({name}): " + "\n".join(body))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    def full_name(self) -> str:
+        return self._name_scope
+
+    def clear_gradients(self) -> None:
+        for p in self.parameters():
+            p.clear_grad()
+
+
+# ---------------------------------------------------------------------------
+# Functional bridge — the eager↔jit pivot
+# ---------------------------------------------------------------------------
+def state_arrays(layer: Layer, trainable_only: bool = False) -> Dict[str, Any]:
+    """Extract {name: jax.Array} for all params (and buffers unless
+    trainable_only).  The result is a pytree suitable for jax transforms."""
+    out = {}
+    for n, p in layer.named_parameters():
+        if not trainable_only or p.trainable:
+            out[n] = p._value
+    if not trainable_only:
+        for n, b in layer.named_buffers():
+            if b is not None and n not in out:
+                out[n] = b._value
+    return out
+
+
+@contextlib.contextmanager
+def functional_state(layer: Layer, arrays: Dict[str, Any]):
+    """Temporarily swap the layer's parameter/buffer values for ``arrays``
+    (possibly traced).  Restores originals on exit."""
+    slots: Dict[str, Tensor] = {}
+    for n, p in layer.named_parameters():
+        slots[n] = p
+    for n, b in layer.named_buffers():
+        if b is not None and n not in slots:
+            slots[n] = b
+    saved = {}
+    try:
+        for n, v in arrays.items():
+            if n in slots:
+                saved[n] = slots[n]._value
+                slots[n]._value = v
+        yield layer
+    finally:
+        for n, v in saved.items():
+            slots[n]._value = v
+
+
+def functional_call(layer: Layer, arrays: Dict[str, Any], *args,
+                    rng=None, **kwargs):
+    """Run ``layer(*args)`` with parameters/buffers taken from ``arrays`` —
+    pure w.r.t. ``arrays`` and usable under jax.jit/grad/shard_map."""
+    from ...core.rng import rng_scope
+    ctx = rng_scope(rng) if rng is not None else contextlib.nullcontext()
+    with functional_state(layer, arrays):
+        with ctx:
+            return layer(*args, **kwargs)
+
+
+def functional_call_with_buffers(layer: Layer, arrays: Dict[str, Any], *args,
+                                 rng=None, **kwargs):
+    """Like :func:`functional_call`, but also returns the post-forward buffer
+    values (e.g. BatchNorm running stats updated during the call) so jitted
+    train steps can thread mutable state through as explicit pytrees."""
+    from ...core.rng import rng_scope
+    ctx = rng_scope(rng) if rng is not None else contextlib.nullcontext()
+    with functional_state(layer, arrays):
+        with ctx:
+            out = layer(*args, **kwargs)
+        new_buffers = {}
+        for n, b in layer.named_buffers():
+            if b is not None:
+                new_buffers[n] = b._value
+    return out, new_buffers

@@ -1,0 +1,2 @@
+from . import api  # noqa: F401  (triggers registry install)
+from .registry import all_ops, get_op  # noqa: F401

@@ -1,0 +1,8 @@
+"""Generated functional op namespace (``paddle_tpu.tensor`` equivalent).
+
+Populated at import time from ops.yaml by :mod:`paddle_tpu.ops.registry`.
+"""
+
+from . import registry as _registry
+
+_registry.install(__import__("sys").modules[__name__])

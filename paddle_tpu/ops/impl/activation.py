@@ -1,0 +1,170 @@
+"""Activation ops (reference: phi activation kernels +
+python/paddle/nn/functional/activation.py).  XLA fuses these into adjacent
+matmuls, replacing the reference's fused_bias_act machinery for free."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def mish(x):
+    return jax.nn.mish(x)
+
+
+def hardswish(x):
+    return jax.nn.hard_swish(x)
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, jnp.zeros_like(x))
+
+
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, jnp.zeros_like(x)))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+def prelu(x, weight, data_format="NCHW"):
+    w = weight
+    if jnp.ndim(w) == 1 and jnp.shape(w)[0] > 1:
+        # per-channel
+        nd = jnp.ndim(x)
+        ch_axis = 1 if data_format.startswith("NC") else nd - 1
+        shape = [1] * nd
+        shape[ch_axis] = jnp.shape(w)[0]
+        w = jnp.reshape(w, shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+def rrelu(key, x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True):
+    if training:
+        a = jax.random.uniform(key, jnp.shape(x), jnp.asarray(x).dtype,
+                               lower, upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, a * x)
+
+
+def softmax(x, axis=-1, dtype=None):
+    from ...core import dtypes as _dt
+    if dtype is not None:
+        x = jnp.asarray(x, _dt.canonical_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None):
+    from ...core import dtypes as _dt
+    if dtype is not None:
+        x = jnp.asarray(x, _dt.canonical_dtype(dtype))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def softmin(x, axis=-1):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    bx = beta * x
+    return jnp.where(bx > threshold, x, jnp.log1p(jnp.exp(bx)) / beta)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, jnp.full_like(x, value))
+
+
+def maxout(x, groups, axis=1):
+    shape = list(jnp.shape(x))
+    nd = len(shape)
+    axis = axis % nd
+    c = shape[axis]
+    shape[axis:axis + 1] = [c // groups, groups]
+    return jnp.max(jnp.reshape(x, shape), axis=axis + 1)
+
+
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def swiglu(x, y=None):
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+def gumbel_softmax(key, x, temperature=1.0, hard=False, axis=-1):
+    g = jax.random.gumbel(key, jnp.shape(x), jnp.asarray(x).dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.zeros_like(y).at[...].set(0.0)
+        onehot = jnp.put_along_axis(jnp.zeros_like(y), idx,
+                                    jnp.ones_like(idx, y.dtype), axis=axis,
+                                    inplace=False)
+        y = jax.lax.stop_gradient(onehot - y) + y
+    return y

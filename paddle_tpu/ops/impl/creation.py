@@ -1,0 +1,155 @@
+"""Creation ops (reference: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtypes as _dt
+
+
+def _dtype(dtype, default_float=True):
+    if dtype is None:
+        return _dt.default_float_dtype() if default_float else None
+    return _dt.canonical_dtype(dtype)
+
+
+def _shape(shape):
+    if hasattr(shape, "_value"):
+        shape = shape._value
+    if isinstance(shape, (jnp.ndarray, np.ndarray, jax.Array)):
+        shape = [int(s) for s in np.asarray(shape)]
+    if isinstance(shape, int):
+        shape = [shape]
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(_shape(shape), _dtype(dtype))
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(_shape(shape), _dtype(dtype))
+
+
+def full(shape, fill_value, dtype=None):
+    fv = fill_value
+    if hasattr(fv, "_value"):
+        fv = fv._value
+    if dtype is None and isinstance(fv, (bool, int)):
+        dtype = "bool" if isinstance(fv, bool) else "int64"
+    return jnp.full(_shape(shape), fv, _dtype(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, _dtype(dtype, default_float=False))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, _dtype(dtype, default_float=False))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=_dtype(dtype, default_float=False))
+
+
+def empty(shape, dtype=None):
+    return jnp.zeros(_shape(shape), _dtype(dtype))
+
+
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, _dtype(dtype, default_float=False))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    for v in ("start", "end", "step"):
+        pass
+    if dtype is None:
+        if all(isinstance(v, int) for v in (start, end, step)):
+            dtype = jnp.int64
+        else:
+            dtype = _dt.default_float_dtype()
+    else:
+        dtype = _dt.canonical_dtype(dtype)
+    return jnp.arange(start, end, step, dtype=dtype)
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=_dtype(dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base, dtype=_dtype(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(int(num_rows),
+                   int(num_columns) if num_columns is not None else None,
+                   dtype=_dtype(dtype))
+
+
+def diag(x, offset=0, padding_value=0):
+    x = jnp.asarray(x)
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(offset)
+        out = jnp.full((n, n), padding_value, x.dtype)
+        i = jnp.arange(x.shape[0])
+        r, c = (i, i + offset) if offset >= 0 else (i - offset, i)
+        return out.at[r, c].set(x)
+    return jnp.diag(x, k=offset)
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril_indices(row, col, offset=0):
+    r, c = np.tril_indices(row, offset, col)
+    return jnp.stack([jnp.asarray(r), jnp.asarray(c)])
+
+
+def triu_indices(row, col, offset=0):
+    r, c = np.triu_indices(row, offset, col)
+    return jnp.stack([jnp.asarray(r), jnp.asarray(c)])
+
+
+def meshgrid(*args):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    return tuple(jnp.meshgrid(*args, indexing="ij"))
+
+
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+def clone(x):
+    return jnp.asarray(x)
+
+
+def complex(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+def polar(abs, angle):
+    return jax.lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))
+
+
+def cast(x, dtype):
+    from ...core import dtypes as _dt
+    return jnp.asarray(x, _dt.canonical_dtype(dtype))
+
+
+def real_imag_to_complex(real, imag):
+    return jax.lax.complex(real, imag)

@@ -1,0 +1,264 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py:191 matmul →
+_C_ops.matmul; phi funcs/blas → cuBLAS.  On TPU every matmul lowers straight
+onto the MXU; bf16 accumulation in f32 is XLA's default)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -2, -1) if jnp.ndim(x) > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -2, -1) if jnp.ndim(y) > 1 else y
+    return jnp.matmul(x, y)
+
+
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def einsum(equation, *operands):
+    vals = [o._value if hasattr(o, "_value") else o for o in operands]
+    return jnp.einsum(equation, *vals)
+
+
+def norm(x, p=None, axis=None, keepdim=False):
+    x = jnp.asarray(x)
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+    if axis is None:
+        flat = jnp.reshape(x, (-1,))
+        if p == "fro" or p == 2:
+            out = jnp.sqrt(jnp.sum(jnp.square(jnp.abs(flat))))
+        elif p == np.inf:
+            out = jnp.max(jnp.abs(flat))
+        elif p == -np.inf:
+            out = jnp.min(jnp.abs(flat))
+        elif p == 0:
+            out = jnp.sum((flat != 0).astype(x.dtype))
+        elif p == 1:
+            out = jnp.sum(jnp.abs(flat))
+        else:
+            out = jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p)), 1.0 / p)
+        if keepdim:
+            out = jnp.reshape(out, (1,) * x.ndim)
+        return out
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+        if p == "fro" or p == 2:
+            return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=axis,
+                                    keepdims=keepdim))
+        return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+    # vector norm along a single axis
+    if p == np.inf:
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -np.inf:
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                             keepdims=keepdim), 1.0 / p)
+
+
+def vector_norm(x, p=2, axis=None, keepdim=False):
+    return jnp.linalg.vector_norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.matrix_norm(x, ord=p, keepdims=keepdim)
+
+
+def dist(x, y, p=2):
+    return norm(jnp.asarray(x) - jnp.asarray(y), p=p)
+
+
+def cdist(x, y, p=2.0):
+    diff = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(jnp.square(diff), axis=-1))
+    if p == np.inf:
+        return jnp.max(diff, axis=-1)
+    if p == 1.0:
+        return jnp.sum(diff, axis=-1)
+    return jnp.power(jnp.sum(jnp.power(diff, p), axis=-1), 1.0 / p)
+
+
+def transpose_last(x):
+    return jnp.swapaxes(x, -2, -1)
+
+
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -2, -1).conj() if upper else L
+
+
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+def cholesky_inverse(x, upper=False):
+    n = x.shape[-1]
+    eye = jnp.eye(n, dtype=x.dtype)
+    return jax.scipy.linalg.cho_solve((x, not upper), eye)
+
+
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def svdvals(x):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+def svd_lowrank(x, q=6, niter=2):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=False)
+    return u[..., :q], s[..., :q], jnp.swapaxes(vh, -2, -1)[..., :q]
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    if q is None:
+        q = min(6, *x.shape[-2:])
+    if center:
+        x = x - jnp.mean(x, axis=-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(x, full_matrices=False)
+    return u[..., :q], s[..., :q], jnp.swapaxes(vh, -2, -1)[..., :q]
+
+
+def eig(x):
+    return _np_eig(x)
+
+
+def _np_eig(x):
+    w, v = np.linalg.eig(np.asarray(x))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvals(x):
+    return jnp.asarray(np.linalg.eigvals(np.asarray(x)))
+
+
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def inv(x):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def lu(x, pivot=True):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    # paddle returns pivots as 1-based
+    return lu_mat, piv + 1
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+    n = lu_data.shape[-2]
+    L = jnp.tril(lu_data, -1) + jnp.eye(n, lu_data.shape[-1], dtype=lu_data.dtype)
+    L = L[..., :, :min(lu_data.shape[-2:])]
+    U = jnp.triu(lu_data)[..., :min(lu_data.shape[-2:]), :]
+    piv = lu_pivots - 1
+    perm = jnp.arange(n)
+    def body(i, p):
+        a, b = p[i], p[piv[i]]
+        return p.at[i].set(b).at[piv[i]].set(a)
+    for i in range(n):  # pivots are small; unrolled
+        perm = body(i, perm)
+    P = jax.nn.one_hot(perm, n, dtype=lu_data.dtype).T
+    return P, L, U
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_exp(x):
+    return jax.scipy.linalg.expm(x)
+
+
+def multi_dot(tensors):
+    vals = [t._value if hasattr(t, "_value") else t for t in tensors]
+    return jnp.linalg.multi_dot(vals)
+
+
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    if fweights is not None and hasattr(fweights, "_value"):
+        fweights = fweights._value
+    if aweights is not None and hasattr(aweights, "_value"):
+        aweights = aweights._value
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def householder_product(x, tau):
+    m, n = x.shape[-2], x.shape[-1]
+    eye = jnp.eye(m, dtype=x.dtype)
+    Q = eye
+    for i in range(n):
+        v = jnp.concatenate([jnp.zeros(i, x.dtype), jnp.ones(1, x.dtype),
+                             x[..., i + 1:, i]])
+        H = eye - tau[..., i] * jnp.outer(v, v)
+        Q = Q @ H
+    return Q[..., :, :n]
+
+
+def ormqr(x, tau, y, left=True, transpose=False):
+    Q = householder_product(x, tau)
+    if transpose:
+        Q = jnp.swapaxes(Q, -2, -1)
+    return Q @ y if left else y @ Q

@@ -1,0 +1,549 @@
+"""Shape / layout manipulation ops (reference:
+python/paddle/tensor/manipulation.py; stride/view kernels collapse into XLA
+reshapes/transposes which are free or fused)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import builtins
+builtins_slice = builtins.slice
+builtins_max = builtins.max
+
+
+def _ishape(shape):
+    if hasattr(shape, "_value"):
+        shape = shape._value
+    if isinstance(shape, (jnp.ndarray, np.ndarray, jax.Array)):
+        shape = [int(s) for s in np.asarray(shape)]
+    if isinstance(shape, int):
+        shape = [shape]
+    return tuple(int(s) for s in shape)
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, _ishape(shape))
+
+
+def reshape_(x, shape):
+    return jnp.reshape(x, _ishape(shape))
+
+
+def transpose(x, perm=None):
+    return jnp.transpose(x, perm)
+
+
+def t(x):
+    if jnp.ndim(x) < 2:
+        return x
+    return jnp.swapaxes(x, -2, -1)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = jnp.ndim(x)
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = list(jnp.shape(x))
+    mid = int(np.prod(shape[start:stop + 1], dtype=np.int64))
+    return jnp.reshape(x, tuple(shape[:start]) + (mid,) + tuple(shape[stop + 1:]))
+
+
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = [axis]
+    shape = jnp.shape(x)
+    axis = tuple(a % jnp.ndim(x) for a in axis if shape[a % jnp.ndim(x)] == 1)
+    return jnp.squeeze(x, axis) if axis else x
+
+
+def unsqueeze(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    if hasattr(axis, "_value"):
+        axis = [int(a) for a in np.asarray(axis._value)]
+    return jnp.expand_dims(x, tuple(axis))
+
+
+def concat(x, axis=0):
+    vals = [v._value if hasattr(v, "_value") else v for v in x]
+    if hasattr(axis, "_value"):
+        axis = int(np.asarray(axis._value))
+    return jnp.concatenate(vals, axis=int(axis))
+
+
+def stack(x, axis=0):
+    vals = [v._value if hasattr(v, "_value") else v for v in x]
+    return jnp.stack(vals, axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    total = jnp.shape(x)[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    splits = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, splits, axis=axis))
+
+
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=int(axis)))
+
+
+def unbind(x, axis=0):
+    n = jnp.shape(x)[axis]
+    return tuple(jnp.squeeze(s, axis) for s in jnp.split(x, n, axis=axis))
+
+
+def tile(x, repeat_times):
+    if hasattr(repeat_times, "_value"):
+        repeat_times = [int(v) for v in np.asarray(repeat_times._value)]
+    return jnp.tile(x, tuple(repeat_times))
+
+
+def expand(x, shape):
+    shape = list(_ishape(shape))
+    xshape = list(jnp.shape(x))
+    # paddle semantics: -1 means keep dim
+    offset = len(shape) - len(xshape)
+    for i, s in enumerate(shape):
+        if s == -1:
+            shape[i] = xshape[i - offset]
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, jnp.shape(y))
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, _ishape(shape))
+
+
+def broadcast_tensors(inputs):
+    vals = [v._value if hasattr(v, "_value") else v for v in inputs]
+    return tuple(jnp.broadcast_arrays(*vals))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def flip(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, tuple(axis))
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def roll(x, shifts, axis=None):
+    if isinstance(shifts, (list, tuple)):
+        shifts = tuple(shifts)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def gather(x, index, axis=0):
+    index = jnp.reshape(index, (-1,)) if jnp.ndim(index) > 1 else index
+    return jnp.take(x, index, axis=int(axis))
+
+
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def take_along_axis(x, indices, axis, broadcast=True):
+    if broadcast:
+        # paddle broadcasts indices against x except on `axis`
+        tgt = list(jnp.shape(x))
+        tgt[axis] = jnp.shape(indices)[axis]
+        indices = jnp.broadcast_to(indices, tuple(tgt))
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True):
+    if broadcast:
+        tgt = list(jnp.shape(x))
+        tgt[axis] = jnp.shape(indices)[axis]
+        indices = jnp.broadcast_to(indices, tuple(tgt))
+    values = jnp.broadcast_to(values, jnp.shape(indices))
+    # build full index grid
+    idx = list(jnp.meshgrid(*[jnp.arange(s) for s in jnp.shape(indices)],
+                            indexing="ij"))
+    idx[axis] = indices
+    idx = tuple(idx)
+    if reduce == "assign":
+        return x.at[idx].set(values)
+    if reduce in ("add", "sum"):
+        return x.at[idx].add(values)
+    if reduce in ("mul", "multiply"):
+        return x.at[idx].multiply(values)
+    if reduce == "amax":
+        return x.at[idx].max(values)
+    if reduce == "amin":
+        return x.at[idx].min(values)
+    raise ValueError(f"unknown reduce {reduce!r}")
+
+
+def scatter(x, index, updates, overwrite=True):
+    index = jnp.reshape(index, (-1,))
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle overwrite=False: zero the target rows then accumulate
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    x = jnp.zeros(_ishape(shape), jnp.asarray(updates).dtype)
+    return scatter_nd_add(x, index, updates)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, jnp.reshape(index, (-1,)), axis=int(axis))
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_add(x, index, axis, value):
+    index = jnp.reshape(index, (-1,))
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index].add(vmoved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_put(x, indices, value, accumulate=False):
+    vals = tuple(i._value if hasattr(i, "_value") else i for i in indices)
+    if accumulate:
+        return x.at[vals].add(value)
+    return x.at[vals].set(value)
+
+
+def index_fill(x, index, axis, value):
+    index = jnp.reshape(index, (-1,))
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved.at[index].set(value)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def fill_diagonal(x, value, offset=0, wrap=False):
+    n = min(jnp.shape(x)[-2:])
+    i = jnp.arange(n - (offset if offset > 0 else 0))
+    return x.at[..., i + (0 if offset >= 0 else -offset),
+                i + (offset if offset > 0 else 0)].set(value)
+
+
+def masked_select(x, mask):
+    # dynamic-shape op: executes outside jit (like reference's CPU sync path)
+    xv = np.asarray(x)
+    mv = np.asarray(mask)
+    return jnp.asarray(xv[np.broadcast_to(mv, xv.shape)])
+
+
+def masked_fill(x, mask, value):
+    if hasattr(value, "_value"):
+        value = value._value
+    return jnp.where(mask, jnp.asarray(value, jnp.asarray(x).dtype), x)
+
+
+def masked_scatter(x, mask, value):
+    xv = np.asarray(x)
+    mv = np.broadcast_to(np.asarray(mask), xv.shape)
+    vv = np.asarray(value).reshape(-1)
+    out = xv.copy()
+    out[mv] = vv[:int(mv.sum())]
+    return jnp.asarray(out)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    xv = np.asarray(x)
+    nz = np.nonzero(xv)
+    if as_tuple:
+        return tuple(jnp.asarray(i) for i in nz)
+    return jnp.asarray(np.stack(nz, axis=1)) if nz[0].size else jnp.zeros(
+        (0, xv.ndim), jnp.int64)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    if hasattr(pad, "_value"):
+        pad = [int(v) for v in np.asarray(pad._value)]
+    pad = list(pad)
+    nd = jnp.ndim(x)
+    if len(pad) == 2 * nd:
+        # full per-dim [before,after] pairs in dim order
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle NCHW/NCDHW style: pad applies to trailing spatial dims,
+        # ordered last-dim-first pairs
+        width = [(0, 0)] * nd
+        spatial = len(pad) // 2
+        if data_format.endswith("C") and data_format.startswith("N"):
+            dims = list(range(1, 1 + spatial))
+        else:
+            dims = list(range(nd - spatial, nd))
+        for i, d in enumerate(reversed(dims)):
+            width[d] = (pad[2 * i], pad[2 * i + 1])
+    mode_map = {"constant": "constant", "reflect": "reflect",
+                "replicate": "edge", "circular": "wrap"}
+    kw = {"constant_values": value} if mode == "constant" else {}
+    return jnp.pad(x, width, mode=mode_map[mode], **kw)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    if hasattr(repeats, "_value"):
+        repeats = repeats._value
+    return jnp.repeat(x, repeats, axis=axis,
+                      total_repeat_length=None if not hasattr(repeats, "shape")
+                      or jnp.ndim(repeats) == 0 else int(np.sum(np.asarray(repeats))))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    xv = np.asarray(x)
+    res = np.unique(xv, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return jnp.asarray(res)
+    return tuple(jnp.asarray(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    xv = np.asarray(x)
+    if axis is None:
+        xv = xv.reshape(-1)
+        keep = np.concatenate([[True], xv[1:] != xv[:-1]])
+    else:
+        diff = np.any(np.diff(xv, axis=axis) != 0,
+                      axis=tuple(i for i in range(xv.ndim) if i != axis))
+        keep = np.concatenate([[True], diff])
+        xv = np.take(xv, np.nonzero(keep)[0], axis=axis)
+        outs = [jnp.asarray(xv)]
+        return tuple(outs) if len(outs) > 1 else outs[0]
+    vals = xv[keep]
+    outs = [jnp.asarray(vals)]
+    if return_inverse:
+        outs.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        outs.append(jnp.asarray(np.diff(np.append(idx, xv.shape[0]))))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def as_strided(x, shape, stride, offset=0):
+    xv = np.asarray(x)
+    out = np.lib.stride_tricks.as_strided(
+        xv.reshape(-1)[offset:], shape=tuple(shape),
+        strides=tuple(s * xv.itemsize for s in stride))
+    return jnp.asarray(out)
+
+
+def view(x, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, tuple(shape_or_dtype))
+    from ...core import dtypes as _dt
+    return jnp.asarray(x).view(_dt.canonical_dtype(shape_or_dtype))
+
+
+def view_as(x, other):
+    return jnp.reshape(x, jnp.shape(other))
+
+
+def unfold(x, axis, size, step):
+    nd = jnp.ndim(x)
+    axis = axis % nd
+    n = jnp.shape(x)[axis]
+    num = (n - size) // step + 1
+    starts = jnp.arange(num) * step
+    idx = starts[:, None] + jnp.arange(size)[None, :]
+    out = jnp.take(x, idx.reshape(-1), axis=axis)
+    shape = list(jnp.shape(x))
+    shape[axis:axis + 1] = [num, size]
+    out = jnp.reshape(out, tuple(shape))
+    # paddle puts the window dim last
+    return jnp.moveaxis(out, axis + 1, -1)
+
+
+def tensordot(x, y, axes=2):
+    if hasattr(axes, "_value"):
+        axes = axes._value
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def atleast_1d(*xs):
+    out = tuple(jnp.atleast_1d(x._value if hasattr(x, "_value") else x) for x in xs)
+    return out if len(out) > 1 else out[0]
+
+
+def atleast_2d(*xs):
+    out = tuple(jnp.atleast_2d(x._value if hasattr(x, "_value") else x) for x in xs)
+    return out if len(out) > 1 else out[0]
+
+
+def atleast_3d(*xs):
+    out = tuple(jnp.atleast_3d(x._value if hasattr(x, "_value") else x) for x in xs)
+    return out if len(out) > 1 else out[0]
+
+
+def hsplit(x, num_or_indices):
+    return tuple(jnp.hsplit(x, num_or_indices))
+
+
+def vsplit(x, num_or_indices):
+    return tuple(jnp.vsplit(x, num_or_indices))
+
+
+def dsplit(x, num_or_indices):
+    return tuple(jnp.dsplit(x, num_or_indices))
+
+
+def hstack(x):
+    return jnp.hstack([v._value if hasattr(v, "_value") else v for v in x])
+
+
+def vstack(x):
+    return jnp.vstack([v._value if hasattr(v, "_value") else v for v in x])
+
+
+def dstack(x):
+    return jnp.dstack([v._value if hasattr(v, "_value") else v for v in x])
+
+
+def column_stack(x):
+    return jnp.column_stack([v._value if hasattr(v, "_value") else v for v in x])
+
+
+def row_stack(x):
+    return jnp.vstack([v._value if hasattr(v, "_value") else v for v in x])
+
+
+def crop(x, shape=None, offsets=None):
+    shape = _ishape(shape)
+    if offsets is None:
+        offsets = [0] * len(shape)
+    if hasattr(offsets, "_value"):
+        offsets = [int(v) for v in np.asarray(offsets._value)]
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[slices]
+
+
+def slice(x, axes, starts, ends):
+    slices = [builtins_slice(None)] * jnp.ndim(x)
+    for ax, st, en in zip(axes, starts, ends):
+        slices[ax] = builtins_slice(int(st), int(en))
+    return x[tuple(slices)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    slices = [builtins_slice(None)] * jnp.ndim(x)
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        slices[ax] = builtins_slice(int(st), int(en), int(sd))
+    return x[tuple(slices)]
+
+
+def _getitem(x, idx):
+    return jnp.asarray(x)[idx]
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    xv = jnp.asarray(x)
+    n = xv.shape[-1] + abs(offset)
+    out = jnp.zeros(xv.shape[:-1] + (n, n), xv.dtype)
+    i = jnp.arange(xv.shape[-1])
+    r, c = (i, i + offset) if offset >= 0 else (i - offset, i)
+    out = out.at[..., r, c].set(xv)
+    # move the two new dims into place
+    nd = out.ndim
+    perm = list(range(nd - 2))
+    d1, d2 = dim1 % nd, dim2 % nd
+    for pos, d in sorted([(d1, nd - 2), (d2, nd - 1)]):
+        perm.insert(pos, d)
+    return jnp.transpose(out, perm)
+
+
+def bincount(x, weights=None, minlength=0):
+    if weights is not None and hasattr(weights, "_value"):
+        weights = weights._value
+    xv = np.asarray(x)
+    length = builtins_max(minlength, int(xv.max()) + 1 if xv.size else 0)
+    return jnp.asarray(np.bincount(xv, weights=None if weights is None
+                                   else np.asarray(weights),
+                                   minlength=length))
+
+
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
+    xv = np.asarray(x)
+    if min == 0 and max == 0:
+        min, max = float(xv.min()), float(xv.max())
+    hist, _ = np.histogram(xv, bins=bins, range=(min, max),
+                           weights=None if weight is None else np.asarray(weight),
+                           density=density)
+    return jnp.asarray(hist)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    hist, edges = np.histogramdd(np.asarray(x), bins=bins, range=ranges,
+                                 density=density,
+                                 weights=None if weights is None else np.asarray(weights))
+    return jnp.asarray(hist), tuple(jnp.asarray(e) for e in edges)
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    out = jnp.searchsorted(jnp.asarray(sorted_sequence), jnp.asarray(values),
+                           side="right" if right else "left")
+    return out.astype(jnp.int32) if out_int32 else out
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def tolist(x):
+    return np.asarray(x).tolist()

@@ -1,0 +1,487 @@
+"""Elementwise & scalar math ops (reference: python/paddle/tensor/math.py,
+phi CPU/GPU elementwise kernels).  All functions are pure jnp; broadcasting
+and type promotion follow jnp (XLA fuses chains of these into single
+kernels, which replaces the reference's hand-fused elementwise machinery,
+phi/kernels/funcs/broadcast_function.h)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dtypes as _dt
+
+
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.true_divide(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+def fmod(x, y):
+    return jnp.fmod(x, y)
+
+
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+def float_power(x, y):
+    return jnp.float_power(x, y)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def abs(x):
+    return jnp.abs(x)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def round(x, decimals=0):
+    return jnp.round(x, decimals)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def clip(x, min=None, max=None):
+    if hasattr(min, "_value"):
+        min = min._value
+    if hasattr(max, "_value"):
+        max = max._value
+    return jnp.clip(x, min, max)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    if hasattr(scale, "_value"):
+        scale = scale._value
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    if act is not None:
+        out = getattr(jax.nn, act)(out)
+    return out
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)  # [n, batch, ...]
+    idx = jnp.reshape(index, (-1,))
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+def polygamma(x, n):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+def ldexp(x, y):
+    return jnp.ldexp(x, y)
+
+
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+def cumsum(x, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=_dt.canonical_dtype(dtype))
+
+
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=_dt.canonical_dtype(dtype))
+
+
+def cummax(x, axis=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    vals = jax.lax.cummax(x, axis=axis)
+    eq = jnp.equal(x, vals)
+    n = x.shape[axis]
+    idx_ax = jnp.arange(n)
+    shape = [1] * x.ndim
+    shape[axis] = n
+    idx_ax = jnp.reshape(idx_ax, shape)
+    inds = jax.lax.cummax(jnp.where(eq, idx_ax, 0), axis=axis)
+    return vals, inds
+
+
+def cummin(x, axis=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    vals = jax.lax.cummin(x, axis=axis)
+    eq = jnp.equal(x, vals)
+    n = x.shape[axis]
+    idx_ax = jnp.arange(n)
+    shape = [1] * x.ndim
+    shape[axis] = n
+    idx_ax = jnp.reshape(idx_ax, shape)
+    inds = jax.lax.cummax(jnp.where(eq, idx_ax, 0), axis=axis)
+    return vals, inds
+
+
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    if hasattr(prepend, "_value"):
+        prepend = prepend._value
+    if hasattr(append, "_value"):
+        append = append._value
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if x is not None and hasattr(x, "_value"):
+        x = x._value
+    if x is None:
+        return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+    return jnp.trapezoid(y, x=x, axis=axis)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def cross(x, y, axis=None):
+    return jnp.cross(x, y, axis=-1 if axis is None else axis)
+
+
+def dot(x, y):
+    if jnp.ndim(x) == 2:
+        return jnp.sum(x * y, axis=-1)
+    return jnp.dot(x, y)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def sgn(x):
+    return jnp.sign(x)
+
+
+def take(x, index, mode="raise"):
+    flat = jnp.reshape(x, (-1,))
+    if mode == "wrap":
+        index = jnp.mod(index, flat.shape[0])
+    elif mode == "clip":
+        index = jnp.clip(index, -flat.shape[0], flat.shape[0] - 1)
+    index = jnp.where(index < 0, index + flat.shape[0], index)
+    return flat[index]
+
+
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def combinations(x, r=2, with_replacement=False):
+    import itertools as it
+    n = x.shape[0]
+    gen = it.combinations_with_replacement(range(n), r) if with_replacement \
+        else it.combinations(range(n), r)
+    idx = jnp.asarray(list(gen))
+    return x[idx]
+
+
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def bitwise_left_shift(x, y):
+    return jnp.left_shift(x, y)
+
+
+def bitwise_right_shift(x, y):
+    return jnp.right_shift(x, y)

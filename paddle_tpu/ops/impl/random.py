@@ -1,0 +1,140 @@
+"""Random ops.
+
+Each impl takes an explicit PRNG ``key`` as its first argument; the registry
+wrapper injects a fresh key from :func:`paddle_tpu.core.rng.next_rng_key`, so
+eager calls draw from the stateful global generator (Paddle ``paddle.seed``
+semantics) while traced calls consume the ambient :class:`rng_scope` key —
+reference: phi/core/generator.cc + python/paddle/tensor/random.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtypes as _dt
+
+
+def _shape(shape):
+    if hasattr(shape, "_value"):
+        shape = shape._value
+    if isinstance(shape, (jnp.ndarray, np.ndarray, jax.Array)):
+        shape = [int(s) for s in np.asarray(shape)]
+    if isinstance(shape, int):
+        shape = [shape]
+    return tuple(int(s) for s in shape)
+
+
+def uniform(key, shape, dtype=None, min=-1.0, max=1.0):
+    dtype = _dt.canonical_dtype(dtype) or _dt.default_float_dtype()
+    return jax.random.uniform(key, _shape(shape), dtype, min, max)
+
+
+def rand(key, shape, dtype=None):
+    return uniform(key, shape, dtype, 0.0, 1.0)
+
+
+def normal(key, mean=0.0, std=1.0, shape=None, dtype=None):
+    dtype = _dt.canonical_dtype(dtype) or _dt.default_float_dtype()
+    if hasattr(mean, "_value"):
+        mean = mean._value
+    if hasattr(std, "_value"):
+        std = std._value
+    if shape is None:
+        shape = jnp.broadcast_shapes(jnp.shape(mean), jnp.shape(std))
+    return jax.random.normal(key, _shape(shape), dtype) * std + mean
+
+
+def randn(key, shape, dtype=None):
+    dtype = _dt.canonical_dtype(dtype) or _dt.default_float_dtype()
+    return jax.random.normal(key, _shape(shape), dtype)
+
+
+def standard_normal(key, shape, dtype=None):
+    return randn(key, shape, dtype)
+
+
+def randint(key, low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(key, _shape(shape), low, high,
+                              _dt.canonical_dtype(dtype))
+
+
+def randint_like(key, x, low=0, high=None, dtype=None):
+    dtype = _dt.canonical_dtype(dtype) or jnp.asarray(x).dtype
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(key, jnp.shape(x), low, high, dtype)
+
+
+def randperm(key, n, dtype="int64"):
+    return jax.random.permutation(key, int(n)).astype(_dt.canonical_dtype(dtype))
+
+
+def shuffle(key, x, axis=0):
+    return jax.random.permutation(key, x, axis=axis, independent=False)
+
+
+def bernoulli(key, x):
+    return jax.random.bernoulli(key, jnp.asarray(x)).astype(jnp.asarray(x).dtype)
+
+
+def binomial(key, count, prob):
+    return jax.random.binomial(key, jnp.asarray(count), jnp.asarray(prob)).astype(jnp.int64)
+
+
+def poisson(key, x):
+    return jax.random.poisson(key, jnp.asarray(x)).astype(jnp.asarray(x).dtype)
+
+
+def multinomial(key, x, num_samples=1, replacement=False):
+    x = jnp.asarray(x)
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(num_samples,) + x.shape[:-1])
+        if x.ndim == 1:
+            return out
+        return jnp.moveaxis(out, 0, -1)
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, x.shape, logits.dtype)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int64)
+
+
+def exponential(key, x, lam=1.0):
+    return jax.random.exponential(key, jnp.shape(x), jnp.asarray(x).dtype) / lam
+
+
+def uniform_like(key, x, min=-1.0, max=1.0):
+    return jax.random.uniform(key, jnp.shape(x), jnp.asarray(x).dtype, min, max)
+
+
+def normal_like(key, x, mean=0.0, std=1.0):
+    return jax.random.normal(key, jnp.shape(x), jnp.asarray(x).dtype) * std + mean
+
+
+def rand_like(key, x, dtype=None):
+    dtype = _dt.canonical_dtype(dtype) or jnp.asarray(x).dtype
+    return jax.random.uniform(key, jnp.shape(x), dtype)
+
+
+def randn_like(key, x, dtype=None):
+    dtype = _dt.canonical_dtype(dtype) or jnp.asarray(x).dtype
+    return jax.random.normal(key, jnp.shape(x), dtype)
+
+
+def log_normal(key, mean=1.0, std=2.0, shape=(1,), dtype=None):
+    dtype = _dt.canonical_dtype(dtype) or _dt.default_float_dtype()
+    return jnp.exp(jax.random.normal(key, _shape(shape), dtype) * std + mean)
+
+
+def dirichlet(key, alpha):
+    return jax.random.dirichlet(key, jnp.asarray(alpha))
+
+
+def gumbel(key, shape, dtype=None):
+    dtype = _dt.canonical_dtype(dtype) or _dt.default_float_dtype()
+    return jax.random.gumbel(key, _shape(shape), dtype)

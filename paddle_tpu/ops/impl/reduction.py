@@ -1,0 +1,133 @@
+"""Reduction ops (reference: paddle/phi/kernels/cpu|gpu reduce kernels,
+python/paddle/tensor/math.py sum/mean/...)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dtypes as _dt
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if hasattr(axis, "_value"):
+        axis = axis._value
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    x = jnp.asarray(x)
+    if dtype is None and jnp.issubdtype(x.dtype, jnp.bool_):
+        dtype = jnp.int64
+    return jnp.sum(x, axis=_axis(axis), dtype=_dt.canonical_dtype(dtype),
+                   keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_axis(axis), dtype=_dt.canonical_dtype(dtype),
+                    keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_axis(axis), dtype=_dt.canonical_dtype(dtype),
+                      keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    if hasattr(q, "_value"):
+        q = q._value
+    return jnp.quantile(x, q, axis=_axis(axis), keepdims=keepdim,
+                        method=interpolation)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    if hasattr(q, "_value"):
+        q = q._value
+    return jnp.nanquantile(x, q, axis=_axis(axis), keepdims=keepdim,
+                           method=interpolation)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def mode(x, axis=-1, keepdim=False):
+    axis = _axis(axis)
+    sorted_x = jnp.sort(x, axis=axis)
+
+    def _mode_1d(row):
+        vals, counts = jnp.unique(row, return_counts=True,
+                                  size=row.shape[0], fill_value=row[0])
+        i = jnp.argmax(counts)
+        v = vals[i]
+        idx = jnp.max(jnp.where(row == v, jnp.arange(row.shape[0]), -1))
+        return v, idx
+
+    moved = jnp.moveaxis(x, axis, -1)
+    flat = jnp.reshape(moved, (-1, moved.shape[-1]))
+    vals, idxs = jax.vmap(_mode_1d)(flat)
+    out_shape = moved.shape[:-1]
+    vals = jnp.reshape(vals, out_shape)
+    idxs = jnp.reshape(idxs, out_shape)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idxs = jnp.expand_dims(idxs, axis)
+    return vals, idxs

@@ -1,0 +1,64 @@
+"""Search / sort ops (reference: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.lax
+import jax.numpy as jnp
+import numpy as np
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    from ...core import dtypes as _dt
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(_dt.canonical_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    from ...core import dtypes as _dt
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(_dt.canonical_dtype(dtype))
+
+
+def argsort(x, axis=-1, descending=False, stable=True):
+    return jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+
+
+def sort(x, axis=-1, descending=False, stable=True):
+    return jnp.sort(x, axis=axis, stable=stable, descending=descending)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True):
+    if hasattr(k, "_value"):
+        k = int(np.asarray(k._value))
+    if axis is None:
+        axis = -1
+    moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    moved = jnp.moveaxis(x, axis, -1)
+    s = jnp.sort(moved, axis=-1)
+    si = jnp.argsort(moved, axis=-1)
+    vals = s[..., k - 1]
+    idx = si[..., k - 1]
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx
+
+
+def masked_argmax(x, mask, axis=None, keepdim=False):
+    neg = jnp.finfo(x.dtype).min
+    return jnp.argmax(jnp.where(mask, x, neg), axis=axis, keepdims=keepdim)
+
+
+def masked_argmin(x, mask, axis=None, keepdim=False):
+    pos = jnp.finfo(x.dtype).max
+    return jnp.argmin(jnp.where(mask, x, pos), axis=axis, keepdims=keepdim)

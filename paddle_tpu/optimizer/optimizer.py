@@ -1,0 +1,200 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py:127).
+
+Design: every optimizer defines two pure per-parameter functions
+(`_init_slot_state`, `_update`); the base class derives BOTH execution modes
+from them:
+
+* **eager** — ``opt.step()`` after ``loss.backward()`` applies updates via a
+  cached jitted tree function (mirrors the reference's per-param fused
+  adam_/sgd_ op calls, optimizer.py _add_accumulator machinery);
+* **functional** — ``opt.apply_gradients(params, grads, state, lr)`` is pure
+  and jit/shard_map-compatible: the trainer, pipeline and sharded variants
+  all reuse it.  Optimizer state is a pytree, so sharding-stage-1/2/3
+  becomes a sharding annotation on this pytree (SURVEY §7.5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        self._lr = learning_rate
+        self._parameters: Optional[List[Parameter]] = (
+            list(parameters) if parameters is not None else None)
+        if self._parameters is not None and self._parameters and isinstance(
+                self._parameters[0], dict):
+            # param-group form: [{'params': [...], 'learning_rate': ...}]
+            flat = []
+            for group in self._parameters:
+                flat.extend(group["params"])
+            self._parameters = flat
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._state: Dict[str, Any] = {}          # name -> slot dict
+        self._step_count = 0
+        self._jit_apply: Optional[Callable] = None
+        self._param_index: Dict[str, Parameter] = {}
+        if self._parameters is not None:
+            for p in self._parameters:
+                self._param_index[p.name] = p
+
+    # -- learning rate --------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value: float) -> None:
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = value
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- subclass interface ---------------------------------------------
+    def _init_slot_state(self, value: jax.Array) -> Dict[str, jax.Array]:
+        """Per-param slot init (e.g. Adam moments)."""
+        return {}
+
+    def _update(self, p: jax.Array, g: jax.Array, s: Dict[str, jax.Array],
+                lr: jax.Array, t: jax.Array
+                ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        raise NotImplementedError
+
+    def _wd_coeff(self) -> float:
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if hasattr(wd, "_coeff"):  # regularizer.L2Decay
+            return float(wd._coeff)
+        return float(wd)
+
+    # -- functional API --------------------------------------------------
+    def init_state(self, params: Dict[str, jax.Array]) -> Dict[str, Any]:
+        state = {}
+        for name, v in params.items():
+            s = self._init_slot_state(v)
+            if self._multi_precision and v.dtype in (jnp.bfloat16, jnp.float16):
+                s["master_weight"] = v.astype(jnp.float32)
+            state[name] = s
+        return state
+
+    def apply_gradients(self, params: Dict[str, jax.Array],
+                        grads: Dict[str, jax.Array], state: Dict[str, Any],
+                        lr, step) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Pure update: returns (new_params, new_state).  Used directly
+        inside jitted train steps."""
+        if self._grad_clip is not None:
+            grads = self._grad_clip.apply_values(grads)
+        wd = self._wd_coeff()
+        new_params, new_state = {}, {}
+        lr = jnp.asarray(lr, jnp.float32)
+        t = jnp.asarray(step, jnp.int32)
+        for name, p in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_params[name] = p
+                new_state[name] = state.get(name, {})
+                continue
+            s = dict(state.get(name, {}))
+            master = s.get("master_weight")
+            work_p = master if master is not None else p
+            g32 = g.astype(work_p.dtype)
+            if wd and self._decay_applies(name):
+                g32 = g32 + wd * work_p
+            np_, ns = self._update(work_p, g32, s, lr, t)
+            if master is not None:
+                ns["master_weight"] = np_
+                np_ = np_.astype(p.dtype)
+            new_params[name] = np_
+            new_state[name] = ns
+        return new_params, new_state
+
+    def _decay_applies(self, name: str) -> bool:
+        return True
+
+    # -- eager API --------------------------------------------------------
+    def step(self) -> None:
+        if self._parameters is None:
+            raise RuntimeError("Optimizer created without parameters; use the "
+                               "functional API instead")
+        params, grads = {}, {}
+        for p in self._parameters:
+            if p.grad is not None and p.trainable:
+                params[p.name] = p._value
+                grads[p.name] = p.grad._value
+        if not params:
+            return
+        for name, v in params.items():
+            if name not in self._state:
+                s = self._init_slot_state(v)
+                if self._multi_precision and v.dtype in (jnp.bfloat16,
+                                                         jnp.float16):
+                    s["master_weight"] = v.astype(jnp.float32)
+                self._state[name] = s
+        state = {n: self._state[n] for n in params}
+        if self._jit_apply is None:
+            self._jit_apply = jax.jit(self.apply_gradients)
+        try:
+            new_params, new_state = self._jit_apply(params, grads, state,
+                                                    self.get_lr(),
+                                                    self._step_count + 1)
+        except TypeError:
+            new_params, new_state = self.apply_gradients(
+                params, grads, state, self.get_lr(), self._step_count + 1)
+        for name, v in new_params.items():
+            self._param_index[name]._value = v
+        self._state.update(new_state)
+        self._step_count += 1
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in (self._parameters or [])]
+
+    def clear_grad(self, set_to_zero: bool = False) -> None:
+        for p in self._parameters or []:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- state dict -------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        flat: Dict[str, Any] = {"@step": self._step_count}
+        for pname, slots in self._state.items():
+            for sname, v in slots.items():
+                flat[f"{pname}/{sname}"] = Tensor(v)
+        if isinstance(self._lr, LRScheduler):
+            flat["@lr"] = self._lr.state_dict()
+        return flat
+
+    def set_state_dict(self, state: Dict[str, Any]) -> None:
+        self._step_count = int(state.get("@step", 0))
+        if "@lr" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["@lr"])
+        for key, v in state.items():
+            if key.startswith("@"):
+                continue
+            pname, _, sname = key.rpartition("/")
+            self._state.setdefault(pname, {})[sname] = (
+                v._value if isinstance(v, Tensor) else jnp.asarray(v))
+
+    def _scheduler_step(self) -> None:
+        if isinstance(self._lr, LRScheduler):
+            self._lr.step()

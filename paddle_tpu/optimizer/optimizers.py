@@ -1,0 +1,280 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,
+adam,adamw,adamax,adagrad,adadelta,rmsprop,lamb}.py — each maps to a fused
+phi kernel there; here each is a pure per-param update XLA fuses into one
+kernel per parameter, or one whole-step kernel under jit)."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+           "Adadelta", "RMSProp", "Lamb", "NAdam", "RAdam"]
+
+Arr = jax.Array
+State = Dict[str, Arr]
+
+
+class SGD(Optimizer):
+    def _update(self, p, g, s, lr, t):
+        return p - lr * g, s
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_slot_state(self, v):
+        return {"velocity": jnp.zeros_like(v)}
+
+    def _update(self, p, g, s, lr, t):
+        v = self._momentum * s["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        self._amsgrad = amsgrad
+
+    def _init_slot_state(self, v):
+        s = {"moment1": jnp.zeros(v.shape, jnp.float32),
+             "moment2": jnp.zeros(v.shape, jnp.float32)}
+        if self._amsgrad:
+            s["moment2_max"] = jnp.zeros(v.shape, jnp.float32)
+        return s
+
+    def _update(self, p, g, s, lr, t):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * s["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * s["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        tf = t.astype(jnp.float32)
+        mhat = m / (1 - self._beta1 ** tf)
+        vv = v
+        ns = {"moment1": m, "moment2": v}
+        if self._amsgrad:
+            vv = jnp.maximum(s["moment2_max"], v)
+            ns["moment2_max"] = vv
+        vhat = vv / (1 - self._beta2 ** tf)
+        upd = mhat / (jnp.sqrt(vhat) + self._eps)
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), ns
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad)
+        self._coeff = float(weight_decay) if weight_decay is not None else 0.0
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decay_applies(self, name):
+        if self._apply_decay_param_fun is not None:
+            return self._apply_decay_param_fun(name)
+        return True
+
+    def apply_gradients(self, params, grads, state, lr, step):
+        # decoupled decay: p *= (1 - lr*coeff) before the adam update
+        if self._coeff:
+            lrv = jnp.asarray(lr, jnp.float32)
+            decayed = {}
+            for name, p in params.items():
+                if name in grads and grads[name] is not None and \
+                        self._decay_applies(name):
+                    decayed[name] = (p.astype(jnp.float32)
+                                     * (1.0 - lrv * self._coeff)).astype(p.dtype)
+                    ms = state.get(name, {}).get("master_weight")
+                    if ms is not None:
+                        state[name]["master_weight"] = ms * (1.0 - lrv * self._coeff)
+                else:
+                    decayed[name] = p
+            params = decayed
+        return super().apply_gradients(params, grads, state, lr, step)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+
+    def _init_slot_state(self, v):
+        return {"moment": jnp.zeros(v.shape, jnp.float32),
+                "inf_norm": jnp.zeros(v.shape, jnp.float32)}
+
+    def _update(self, p, g, s, lr, t):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * s["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * s["inf_norm"], jnp.abs(g32))
+        tf = t.astype(jnp.float32)
+        upd = lr / (1 - self._beta1 ** tf) * m / (u + self._eps)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), \
+            {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_slot_state(self, v):
+        return {"moment": jnp.full(v.shape, self._init_acc, jnp.float32)}
+
+    def _update(self, p, g, s, lr, t):
+        g32 = g.astype(jnp.float32)
+        acc = s["moment"] + jnp.square(g32)
+        new_p = p.astype(jnp.float32) - lr * g32 / (jnp.sqrt(acc) + self._eps)
+        return new_p.astype(p.dtype), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._rho = rho
+
+    def _init_slot_state(self, v):
+        return {"avg_squared_grad": jnp.zeros(v.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(v.shape, jnp.float32)}
+
+    def _update(self, p, g, s, lr, t):
+        g32 = g.astype(jnp.float32)
+        asg = self._rho * s["avg_squared_grad"] + (1 - self._rho) * jnp.square(g32)
+        upd = (jnp.sqrt(s["avg_squared_update"] + self._eps)
+               / jnp.sqrt(asg + self._eps)) * g32
+        asu = self._rho * s["avg_squared_update"] + (1 - self._rho) * jnp.square(upd)
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho = rho
+        self._eps = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_slot_state(self, v):
+        s = {"mean_square": jnp.zeros(v.shape, jnp.float32),
+             "momentum": jnp.zeros(v.shape, jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros(v.shape, jnp.float32)
+        return s
+
+    def _update(self, p, g, s, lr, t):
+        g32 = g.astype(jnp.float32)
+        ms = self._rho * s["mean_square"] + (1 - self._rho) * jnp.square(g32)
+        ns = {"mean_square": ms}
+        denom = ms
+        if self._centered:
+            mg = self._rho * s["mean_grad"] + (1 - self._rho) * g32
+            denom = ms - jnp.square(mg)
+            ns["mean_grad"] = mg
+        mom = self._momentum * s["momentum"] + lr * g32 / jnp.sqrt(
+            denom + self._eps)
+        ns["momentum"] = mom
+        return (p.astype(jnp.float32) - mom).astype(p.dtype), ns
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._wd = lamb_weight_decay
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slot_state(self, v):
+        return {"moment1": jnp.zeros(v.shape, jnp.float32),
+                "moment2": jnp.zeros(v.shape, jnp.float32)}
+
+    def _update(self, p, g, s, lr, t):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self._beta1 * s["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * s["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        tf = t.astype(jnp.float32)
+        mhat = m / (1 - self._beta1 ** tf)
+        vhat = v / (1 - self._beta2 ** tf)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + self._wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p32 - lr * trust * r).astype(p.dtype), \
+            {"moment1": m, "moment2": v}
+
+
+class NAdam(Adam):
+    def _update(self, p, g, s, lr, t):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * s["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * s["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        tf = t.astype(jnp.float32)
+        mhat = (self._beta1 * m + (1 - self._beta1) * g32) / (
+            1 - self._beta1 ** tf)
+        vhat = v / (1 - self._beta2 ** tf)
+        upd = mhat / (jnp.sqrt(vhat) + self._eps)
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"moment1": m, "moment2": v}
+
+
+class RAdam(Adam):
+    def _update(self, p, g, s, lr, t):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * s["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * s["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        tf = t.astype(jnp.float32)
+        rho_inf = 2.0 / (1 - self._beta2) - 1
+        beta2t = self._beta2 ** tf
+        rho = rho_inf - 2 * tf * beta2t / (1 - beta2t)
+        mhat = m / (1 - self._beta1 ** tf)
+
+        def rect(_):
+            r = jnp.sqrt(((rho - 4) * (rho - 2) * rho_inf)
+                         / ((rho_inf - 4) * (rho_inf - 2) * rho))
+            vhat = jnp.sqrt(v / (1 - beta2t))
+            return r * mhat / (vhat + self._eps)
+
+        upd = jax.lax.cond(rho > 5.0, rect, lambda _: mhat, None)
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"moment1": m, "moment2": v}

@@ -1,0 +1,1 @@
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized  # noqa: F401

@@ -1,0 +1,86 @@
+"""Distributed environment bootstrap.
+
+Analog of the reference's ``init_parallel_env``
+(/root/reference/python/paddle/distributed/parallel.py:978) + TCPStore
+rendezvous (phi/core/distributed/store/tcp_store.h:121).  On TPU the
+rendezvous/NCCL-id machinery collapses into ``jax.distributed.initialize``
+(coordination service) — env vars follow the launcher contract
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / MASTER_ADDR / MASTER_PORT, with
+PT_* equivalents)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size",
+           "is_initialized", "ParallelEnv"]
+
+_initialized = False
+
+
+def _env(*names, default=None):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            return v
+    return default
+
+
+def get_rank() -> int:
+    if _initialized:
+        return jax.process_index()
+    return int(_env("PADDLE_TRAINER_ID", "PT_RANK", "RANK", default="0"))
+
+
+def get_world_size() -> int:
+    if _initialized:
+        return jax.process_count()
+    return int(_env("PADDLE_TRAINERS_NUM", "PT_WORLD_SIZE", "WORLD_SIZE",
+                    default="1"))
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_parallel_env() -> "ParallelEnv":
+    """Initialize multi-host coordination.  Single-process (world_size==1)
+    is a no-op: all jax.devices() are already visible."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    world = get_world_size()
+    if world > 1 and not _initialized:
+        addr = _env("MASTER_ADDR", "PADDLE_MASTER", default="127.0.0.1")
+        port = _env("MASTER_PORT", default="8476")
+        jax.distributed.initialize(
+            coordinator_address=f"{addr}:{port}",
+            num_processes=world,
+            process_id=get_rank())
+    _initialized = True
+    return ParallelEnv()
+
+
+class ParallelEnv:
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return int(_env("PADDLE_RANK_IN_NODE", "LOCAL_RANK", default="0"))
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    @property
+    def dev_id(self) -> int:
+        return self.local_rank

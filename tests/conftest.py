@@ -1,0 +1,13 @@
+"""Test config: force a virtual 8-device CPU mesh so multi-chip sharding
+paths run without TPU hardware (SURVEY §4 'multi-node without a cluster' —
+the reference simulates multi-node as multi-process on one host; we simulate
+multi-chip as multi-device on one process)."""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

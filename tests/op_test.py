@@ -1,0 +1,93 @@
+"""OpTest harness — analog of the reference's
+test/legacy_test/op_test.py:418 (``check_output`` :2910 numeric comparison,
+``check_grad`` :3114 numeric-vs-analytic gradient diff).
+
+For each op: run the eager path (jit-per-op + tape) AND the traced path
+(inside jax.jit), compare both against a numpy reference, and check the tape
+gradient against central finite differences.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.core.tensor import Tensor
+
+
+def check_output(op: Callable, np_ref: Callable, inputs: Sequence[np.ndarray],
+                 kwargs=None, rtol=1e-5, atol=1e-6):
+    """Run op eager + traced, compare with numpy reference."""
+    kwargs = kwargs or {}
+    tensors = [pt.to_tensor(x) for x in inputs]
+    expected = np_ref(*inputs, **kwargs)
+
+    def assert_close(got, tag):
+        got_flat = jax.tree.leaves(got, is_leaf=lambda x: isinstance(x, Tensor))
+        exp_flat = expected if isinstance(expected, (tuple, list)) else [expected]
+        assert len(got_flat) == len(exp_flat), \
+            f"{tag}: arity {len(got_flat)} vs {len(exp_flat)}"
+        for g, e in zip(got_flat, exp_flat):
+            gv = np.asarray(g._value if isinstance(g, Tensor) else g)
+            np.testing.assert_allclose(gv, np.asarray(e), rtol=rtol, atol=atol,
+                                       err_msg=tag)
+
+    # eager
+    assert_close(op(*tensors, **kwargs), "eager")
+    # traced
+    jitted = pt.jit.to_static(lambda *ts: op(*ts, **kwargs))
+    assert_close(jitted(*tensors), "traced")
+
+
+def check_grad(op: Callable, inputs: Sequence[np.ndarray], kwargs=None,
+               grad_idx: int = 0, eps: float = 1e-3, rtol: float = 5e-2,
+               atol: float = 1e-3, reduce_to_scalar=None):
+    """Central finite differences vs tape gradient (float64 for stability)."""
+    kwargs = kwargs or {}
+    inputs = [np.asarray(x, np.float64 if np.issubdtype(
+        np.asarray(x).dtype, np.floating) else None) for x in inputs]
+
+    if reduce_to_scalar is None:
+        def reduce_to_scalar(out):
+            leaves = jax.tree.leaves(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            total = None
+            for leaf in leaves:
+                v = leaf if isinstance(leaf, Tensor) else pt.to_tensor(leaf)
+                s = v.sum() if hasattr(v, "sum") else v
+                total = s if total is None else total + s
+            return total
+
+    # analytic via tape
+    tensors = [pt.to_tensor(x, stop_gradient=(i != grad_idx))
+               for i, x in enumerate(inputs)]
+    loss = reduce_to_scalar(op(*tensors, **kwargs))
+    loss.backward()
+    analytic = np.asarray(tensors[grad_idx].grad.numpy(), np.float64)
+
+    # numeric
+    x0 = inputs[grad_idx].astype(np.float64)
+    numeric = np.zeros_like(x0)
+    flat = x0.reshape(-1)
+    num_flat = numeric.reshape(-1)
+
+    def eval_loss(xval):
+        args = [pt.to_tensor(v if i != grad_idx else xval)
+                for i, v in enumerate(inputs)]
+        with pt.no_grad():
+            return float(reduce_to_scalar(op(*args, **kwargs)).numpy())
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        up = eval_loss(x0)
+        flat[i] = orig - eps
+        down = eval_loss(x0)
+        flat[i] = orig
+        num_flat[i] = (up - down) / (2 * eps)
+
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)

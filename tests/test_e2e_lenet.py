@@ -1,0 +1,90 @@
+"""End-to-end LeNet smoke test — SURVEY §7 stage-1 milestone
+(BASELINE config 1: 'LeNet MNIST via Model.fit').  Uses synthetic data with
+a learnable class signal; asserts training reduces loss and beats chance."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.io.dataset import TensorDataset
+
+
+class LeNet(nn.Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0), nn.ReLU(),
+            nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(
+            nn.Flatten(), nn.Linear(400, 120), nn.ReLU(),
+            nn.Linear(120, 84), nn.ReLU(), nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        return self.fc(self.features(x))
+
+
+def _make_data(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 1, 28, 28)).astype(np.float32) * 0.3
+    Y = rng.integers(0, 10, size=(n,)).astype(np.int64)
+    for i in range(n):  # strong class-dependent pattern
+        X[i, 0, Y[i], :] += 2.0
+    return TensorDataset([X, Y])
+
+
+def test_lenet_fit_jit():
+    pt.seed(42)
+    ds = _make_data()
+    model = pt.Model(LeNet())
+    model.prepare(
+        optimizer=pt.optimizer.Adam(2e-3, parameters=model.parameters()),
+        loss=nn.CrossEntropyLoss(), metrics=pt.metric.Accuracy())
+    model.fit(ds, batch_size=64, epochs=5, verbose=0)
+    logs = model.evaluate(ds, batch_size=64)
+    assert logs["acc"] > 0.6, logs
+
+
+def test_lenet_eager_matches_jit_one_step():
+    pt.seed(0)
+    ds = _make_data(64)
+    batch = [np.stack([ds[i][0] for i in range(8)]),
+             np.asarray([ds[i][1] for i in range(8)])]
+
+    def one_step(use_jit):
+        pt.seed(123)
+        net = LeNet()
+        model = pt.Model(net)
+        model.prepare(
+            optimizer=pt.optimizer.SGD(0.1, parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(), jit=use_jit)
+        losses, _ = model.train_batch([batch[0]], [batch[1]])
+        return losses[0], {k: v.numpy().copy()
+                           for k, v in net.state_dict().items()}
+
+    loss_j, sd_j = one_step(True)
+    loss_e, sd_e = one_step(False)
+    assert abs(loss_j - loss_e) < 1e-4
+    for k in sd_j:
+        np.testing.assert_allclose(sd_j[k], sd_e[k], rtol=1e-4, atol=1e-5)
+
+
+def test_model_save_load(tmp_path):
+    pt.seed(1)
+    model = pt.Model(LeNet())
+    model.prepare(
+        optimizer=pt.optimizer.Adam(1e-3, parameters=model.parameters()),
+        loss=nn.CrossEntropyLoss())
+    ds = _make_data(64)
+    model.fit(ds, batch_size=32, epochs=1, verbose=0)
+    path = str(tmp_path / "ck")
+    model.save(path)
+    model2 = pt.Model(LeNet())
+    model2.prepare(
+        optimizer=pt.optimizer.Adam(1e-3, parameters=model2.parameters()),
+        loss=nn.CrossEntropyLoss())
+    model2.load(path)
+    for (k1, v1), (k2, v2) in zip(model.network.state_dict().items(),
+                                  model2.network.state_dict().items()):
+        np.testing.assert_allclose(v1.numpy(), v2.numpy())

@@ -1,0 +1,183 @@
+"""Layer system tests (reference: nn.Layer semantics, layers.py:353)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+def test_layer_registration_and_traversal():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.register_buffer("counter", np.zeros(1, np.float32))
+
+        def forward(self, x):
+            return self.fc2(pt.relu(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    assert len(net.sublayers()) == 2
+    sd = net.state_dict()
+    assert "counter" in sd and len(sd) == 5
+    out = net(pt.to_tensor(np.ones((3, 4), np.float32)))
+    assert out.shape == [3, 2]
+
+
+def test_state_dict_roundtrip(tmp_path):
+    net = nn.Linear(3, 3)
+    sd = {k: v.numpy().copy() for k, v in net.state_dict().items()}
+    path = str(tmp_path / "ckpt.pdparams")
+    pt.save(net.state_dict(), path)
+    net2 = nn.Linear(3, 3)
+    net2.set_state_dict(pt.load(path))
+    for k, v in net2.state_dict().items():
+        np.testing.assert_allclose(v.numpy(), sd[k])
+
+
+def test_train_eval_mode_dropout():
+    drop = nn.Dropout(0.5)
+    x = pt.ones([1000])
+    drop.eval()
+    np.testing.assert_allclose(drop(x).numpy(), x.numpy())
+    drop.train()
+    y = drop(x)
+    zeros = float((y.numpy() == 0).mean())
+    assert 0.3 < zeros < 0.7
+
+
+def test_forward_hooks():
+    net = nn.Linear(2, 2)
+    calls = []
+    h1 = net.register_forward_pre_hook(lambda l, inp: calls.append("pre"))
+    h2 = net.register_forward_post_hook(
+        lambda l, inp, out: calls.append("post"))
+    net(pt.ones([1, 2]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    calls.clear()
+    net(pt.ones([1, 2]))
+    assert calls == []
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+    assert len(seq) == 3
+    out = seq(pt.ones([1, 4]))
+    assert out.shape == [1, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll.parameters())) == 8
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm2D(3)
+    x = pt.to_tensor(np.random.default_rng(0).normal(
+        2.0, 3.0, size=(8, 3, 4, 4)).astype(np.float32))
+    bn.train()
+    for _ in range(10):
+        bn(x)
+    mean = bn._mean.numpy()
+    assert np.all(np.abs(mean - 2.0) < 1.5)
+    bn.eval()
+    y = bn(x)
+    assert y.shape == [8, 3, 4, 4]
+
+
+def test_layer_norm_values():
+    ln = nn.LayerNorm(8)
+    x = pt.to_tensor(np.random.default_rng(0).normal(
+        size=(4, 8)).astype(np.float32))
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), 1, atol=1e-2)
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    out = emb(pt.to_tensor(np.array([[0, 1], [2, 0]])))
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], 0)
+    np.testing.assert_allclose(out.numpy()[1, 1], 0)
+
+
+def test_transformer_encoder_shapes():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4,
+                                       dim_feedforward=32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, num_layers=2)
+    x = pt.to_tensor(np.random.default_rng(0).normal(
+        size=(2, 5, 16)).astype(np.float32))
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+    # params of the two layers are distinct objects
+    p = list(enc.parameters())
+    assert len(p) == len(set(id(q) for q in p))
+
+
+def test_multihead_attention_causal_mask():
+    mha = nn.MultiHeadAttention(8, 2, dropout=0.0)
+    mha.eval()
+    x = pt.to_tensor(np.random.default_rng(1).normal(
+        size=(1, 4, 8)).astype(np.float32))
+    mask = np.tril(np.ones((1, 2, 4, 4), bool))
+    out = mha(x, attn_mask=pt.to_tensor(mask))
+    assert out.shape == [1, 4, 8]
+
+
+def test_functional_call_traced():
+    import jax
+    net = nn.Linear(4, 2)
+    arrays = nn.state_arrays(net)
+    x = np.ones((3, 4), np.float32)
+
+    @jax.jit
+    def fwd(params, xv):
+        out = nn.functional_call(net, params, pt.Tensor(xv))
+        return out._value
+
+    got = fwd(arrays, x)
+    exp = net(pt.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+    # originals restored
+    assert not isinstance(net.weight._value, jax.core.Tracer)
+
+
+def test_conv_layers_shapes():
+    x = pt.to_tensor(np.zeros((2, 3, 8, 8), np.float32))
+    assert nn.Conv2D(3, 5, 3, padding=1)(x).shape == [2, 5, 8, 8]
+    assert nn.Conv2D(3, 5, 3, stride=2, padding=1)(x).shape == [2, 5, 4, 4]
+    assert nn.MaxPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 3, 1, 1]
+    xt = pt.to_tensor(np.zeros((2, 3, 8), np.float32))
+    assert nn.Conv1D(3, 4, 3, padding=1)(xt).shape == [2, 4, 8]
+    assert nn.Conv2DTranspose(3, 4, 2, stride=2)(x).shape == [2, 4, 16, 16]
+
+
+def test_clip_grad_by_global_norm():
+    p1 = pt.Parameter(np.zeros(3, np.float32))
+    p2 = pt.Parameter(np.zeros(2, np.float32))
+    p1.grad = pt.to_tensor(np.array([3.0, 0.0, 0.0], np.float32))
+    p2.grad = pt.to_tensor(np.array([0.0, 4.0], np.float32))
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    from paddle_tpu.nn.clip import clip_grads_
+    clip_grads_([p1, p2], clip)
+    total = np.sqrt((p1.grad.numpy() ** 2).sum() + (p2.grad.numpy() ** 2).sum())
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_initializers():
+    from paddle_tpu.nn import initializer as I
+    pt.seed(0)
+    w = I.XavierUniform()((100, 100), np.float32)
+    assert abs(float(np.asarray(w).mean())) < 0.01
+    c = I.Constant(3.0)((4,), np.float32)
+    np.testing.assert_allclose(np.asarray(c), 3.0)
+    o = np.asarray(I.Orthogonal()((16, 16), np.float32))
+    np.testing.assert_allclose(o @ o.T, np.eye(16), atol=1e-4)

@@ -1,0 +1,159 @@
+"""Tensor façade + eager autograd tests (covers SURVEY §3.1/§3.2 semantics)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_to_tensor_basic():
+    t = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert str(t.dtype) == "float32"
+    assert t.stop_gradient
+    np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_coercion_and_cast():
+    t = pt.to_tensor(np.arange(4, dtype=np.int32), dtype="float32")
+    assert str(t.dtype) == "float32"
+    u = t.astype("bfloat16")
+    assert str(u.dtype) == "bfloat16"
+    assert t.item(0) == 0.0
+
+
+def test_operators():
+    a = pt.to_tensor([1.0, 2.0])
+    b = pt.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a - 1).numpy(), [0, 1])
+    np.testing.assert_allclose((2 - a).numpy(), [1, 0])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    assert bool((a < b).all())
+    assert (a @ b).item() == pytest.approx(11.0)
+
+
+def test_indexing():
+    x = pt.to_tensor(np.arange(12.0).reshape(3, 4))
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[1:, ::2].numpy(), [[4, 6], [8, 10]])
+    idx = pt.to_tensor(np.array([0, 2]))
+    np.testing.assert_allclose(x[idx].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+
+
+def test_setitem():
+    x = pt.to_tensor(np.zeros((3, 3), np.float32))
+    x[1] = 5.0
+    np.testing.assert_allclose(x.numpy()[1], [5, 5, 5])
+    x[0, 0] = -1.0
+    assert x.numpy()[0, 0] == -1
+
+
+def test_backward_simple():
+    x = pt.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_backward_chain_and_accumulate():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    z = y * 3 + y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+    # second backward accumulates
+    w = (x * 5.0)
+    w.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [13.0])
+
+
+def test_multi_output_grad():
+    x = pt.to_tensor(np.arange(6.0, dtype=np.float32), stop_gradient=False)
+    parts = pt.split(x, 3)
+    loss = parts[0].sum() + (parts[2] * 2).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 1, 0, 0, 2, 2])
+
+
+def test_no_grad():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    with pt.no_grad():
+        y = x * 2
+    assert y._node is None
+    z = x * 2
+    assert z._node is not None
+
+
+def test_grad_api():
+    x = pt.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (g,) = pt.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [6.0])
+    assert x.grad is None  # .grad slot untouched
+
+
+def test_register_hook():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 10)
+    (x * 2.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+def test_detach():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = x * 2
+    loss = (z + y).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_retain_grads():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.stop_gradient = False
+    y.retain_grads()
+    (y * 3).sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+
+def test_inplace_ops():
+    x = pt.to_tensor([1.0, 2.0])
+    x.add_(pt.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.numpy(), [2, 3])
+    x.scale_(2.0)
+    np.testing.assert_allclose(x.numpy(), [4, 6])
+
+
+def test_pytree_registration():
+    import jax
+    x = pt.to_tensor([1.0, 2.0])
+    leaves, treedef = jax.tree.flatten(x)
+    assert len(leaves) == 1
+    y = jax.tree.unflatten(treedef, leaves)
+    assert isinstance(y, Tensor)
+
+
+def test_random_seed_reproducible():
+    pt.seed(7)
+    a = pt.rand([4])
+    pt.seed(7)
+    b = pt.rand([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+
+
+def test_nan_check_flag():
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = pt.to_tensor([1.0, 0.0])
+        with pytest.raises(FloatingPointError):
+            pt.log(x * 0.0 - 1.0)  # log(-1) = nan
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
