@@ -1,0 +1,106 @@
+"""Benchmark harness — prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Measures GPT causal-LM training throughput (tokens/sec/chip) and MFU on the
+available accelerator (BASELINE.md metric definition).  vs_baseline is
+MFU / 0.45 (the north-star ≥45% MFU target), since the reference publishes
+no absolute numbers (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak FLOP/s for the local accelerator."""
+    import jax
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    platform = d.platform.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if platform in ("tpu", "axon"):
+        return 197e12
+    return 1e12  # CPU fallback: nominal
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    on_accel = jax.devices()[0].platform.lower() in ("tpu", "axon")
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
+    from paddle_tpu import parallel as dist
+
+    if on_accel:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=1024,
+                        dtype="bfloat16")
+        batch, seq, steps = 8, 1024, 10
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
+                        num_heads=4, max_position_embeddings=256)
+        batch, seq, steps = 4, 128, 3
+
+    topo = dist.init_topology()  # single chip
+    step_fn, init_fn = build_gpt_train_step(cfg, topo, num_microbatches=1)
+    state = init_fn(0)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1)
+
+    # warmup / compile (device_get forces a real sync — block_until_ready
+    # does not round-trip through the axon tunnel)
+    state, loss = step_fn(state, ids, labels)
+    jax.device_get(loss)
+    state, loss = step_fn(state, ids, labels)
+    jax.device_get(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step_fn(state, ids, labels)
+    jax.device_get(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tps = tokens / dt
+    n_chips = 1
+    tps_chip = tps / n_chips
+
+    # params (for 6N flops/token) — embeddings included, standard convention
+    h, L, V, f = (cfg.hidden_size, cfg.num_layers, cfg.vocab_size,
+                  cfg.ffn_size)
+    n_params = V * h + cfg.max_position_embeddings * h + L * (
+        4 * h * h + 2 * h * f + 9 * h) + 2 * h
+    flops_per_token = 6 * n_params + 12 * L * h * seq  # + attention term
+    mfu = tps_chip * flops_per_token / peak_flops_per_chip()
+
+    print(json.dumps({
+        "metric": "gpt_train_tokens_per_sec_per_chip",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "model": f"gpt h{h} L{L} V{V}",
+            "batch": batch, "seq": seq, "steps": steps,
+            "loss": float(np.asarray(jax.device_get(loss))),
+            "device": str(jax.devices()[0]),
+            "dtype": cfg.dtype,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

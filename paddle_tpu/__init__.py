@@ -82,3 +82,7 @@ def enable_static():
     raise NotImplementedError(
         "paddle_tpu has no legacy static-graph Program mode; use "
         "paddle_tpu.jit.to_static (whole-function XLA compilation) instead")
+
+from . import models  # noqa: F401
+from . import parallel  # noqa: F401
+from . import distributed  # noqa: F401

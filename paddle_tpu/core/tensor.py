@@ -32,7 +32,8 @@ _name_counter = itertools.count()
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "grad", "name", "persistable",
                  "_node", "_out_index", "_retain_grads", "_grad_hooks",
-                 "trainable", "__weakref__")
+                 "trainable", "process_mesh", "placements", "param_spec",
+                 "optimize_attr", "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True,
                  name: Optional[str] = None, persistable: bool = False):

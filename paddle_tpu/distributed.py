@@ -1,0 +1,6 @@
+"""``paddle_tpu.distributed`` — alias of :mod:`paddle_tpu.parallel` matching
+the reference's ``paddle.distributed`` import path."""
+
+from .parallel import *  # noqa: F401,F403
+from .parallel import collective, fleet  # noqa: F401
+from .parallel.env import init_parallel_env, get_rank, get_world_size  # noqa: F401

@@ -1,0 +1,6 @@
+from . import gpt  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTForCausalLM, GPTModel, build_gpt_train_step, gpt_125m,
+    gpt_13b, gpt_1p3b, gpt_6p7b, gpt_tiny,
+)
+from .lenet import LeNet  # noqa: F401

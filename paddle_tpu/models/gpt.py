@@ -1,0 +1,414 @@
+"""GPT model family — the flagship train config (BASELINE configs 4/6:
+GPT-3 1.3B mp2×pp2, GPT-3 13B north star).
+
+Reference model: the fleet GPT used by auto-parallel tests
+(/root/reference/test/auto_parallel/get_gpt_model.py) built from
+fleet.meta_parallel mp layers.  Two execution paths:
+
+* :class:`GPTForCausalLM` — imperative Layer graph with TP-annotated
+  parameters (Column/RowParallelLinear, VocabParallelEmbedding); runs eager,
+  under the hapi trainer, or sharded via DistributedEngine (dp/mp/sharding).
+* :func:`build_gpt_train_step` — fully-compiled hybrid dp×mp×pp×sp train
+  step: embeddings/head GSPMD-sharded, block stack stacked [pp, per, ...]
+  and scheduled by parallel.pipeline.spmd_pipeline inside a partial-manual
+  shard_map over the ``pp`` axis, sequence dim constrained over ``sep``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..parallel.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                  VocabParallelEmbedding, constrain,
+                                  mark_sharding)
+from ..parallel.topology import (DP_AXIS, MP_AXIS, PP_AXIS, SEP_AXIS,
+                                 SHARDING_AXIS, get_topology)
+
+__all__ = ["GPTConfig", "GPTBlock", "GPTModel", "GPTForCausalLM",
+           "gpt_tiny", "gpt_125m", "gpt_1p3b", "gpt_6p7b", "gpt_13b",
+           "stack_block_params", "block_apply", "build_gpt_train_step"]
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 1024
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    use_mp: bool = False       # build with tensor-parallel layers
+    tie_word_embeddings: bool = True
+    dtype: str = "float32"
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def gpt_tiny(**kw) -> GPTConfig:
+    return GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                     num_heads=4, max_position_embeddings=64, **kw)
+
+
+def gpt_125m(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt_1p3b(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                     max_position_embeddings=2048, **kw)
+
+
+def gpt_6p7b(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                     max_position_embeddings=2048, **kw)
+
+
+def gpt_13b(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=5120, num_layers=40, num_heads=40,
+                     max_position_embeddings=2048, **kw)
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        h = cfg.hidden_size
+        self.ln1 = LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.ln2 = LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        if cfg.use_mp:
+            self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+            self.proj = RowParallelLinear(h, h, input_is_parallel=True)
+            self.fc1 = ColumnParallelLinear(h, cfg.ffn_size,
+                                            gather_output=False)
+            self.fc2 = RowParallelLinear(cfg.ffn_size, h,
+                                         input_is_parallel=True)
+        else:
+            self.qkv = Linear(h, 3 * h)
+            self.proj = Linear(h, h)
+            self.fc1 = Linear(h, cfg.ffn_size)
+            self.fc2 = Linear(cfg.ffn_size, h)
+        self.drop = Dropout(cfg.dropout)
+
+    def forward(self, x):
+        from ..ops import api as _api
+        cfg = self.cfg
+        b, s = x.shape[0], x.shape[1]
+        residual = x
+        y = self.ln1(x)
+        qkv = self.qkv(y)
+        qkv = _api.reshape(qkv, [b, s, cfg.num_heads, 3 * cfg.head_dim])
+        q, k, v = _api.split(qkv, 3, axis=-1)
+        attn = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=cfg.dropout,
+            training=self.training)
+        attn = _api.reshape(attn, [b, s, cfg.hidden_size])
+        x = residual + self.drop(self.proj(attn))
+        residual = x
+        y = self.ln2(x)
+        y = self.fc2(F.gelu(self.fc1(y), approximate=True))
+        return residual + self.drop(y)
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        from ..nn.attr import ParamAttr
+        emb_attr = ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
+        if cfg.use_mp:
+            self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size,
+                                              weight_attr=emb_attr)
+        else:
+            self.wte = Embedding(cfg.vocab_size, cfg.hidden_size,
+                                 weight_attr=emb_attr)
+        self.wpe = Embedding(cfg.max_position_embeddings, cfg.hidden_size,
+                             weight_attr=ParamAttr(
+                                 initializer=I.Normal(
+                                     0.0, cfg.initializer_range)))
+        self.drop = Dropout(cfg.dropout)
+        self.blocks = LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids):
+        from ..ops import api as _api
+        b, s = input_ids.shape[0], input_ids.shape[1]
+        pos = _api.arange(0, s, 1, dtype="int64")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if not cfg.tie_word_embeddings:
+            if cfg.use_mp:
+                self.lm_head = ColumnParallelLinear(
+                    cfg.hidden_size, cfg.vocab_size, has_bias=False)
+            else:
+                self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                                      bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        from ..ops import api as _api
+        h = self.gpt(input_ids)
+        if self.cfg.tie_word_embeddings:
+            logits = _api.matmul(h, self.gpt.wte.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        if labels is not None:
+            loss = F.cross_entropy(
+                _api.reshape(logits, [-1, self.cfg.vocab_size]),
+                _api.reshape(labels, [-1]))
+            return loss
+        return logits
+
+
+# ---------------------------------------------------------------------------
+# Pipelined pure-function path
+# ---------------------------------------------------------------------------
+def init_block_params(cfg: GPTConfig, key) -> Dict[str, jax.Array]:
+    """Pure init of one block's params (names match block_apply)."""
+    h, f = cfg.hidden_size, cfg.ffn_size
+    std = cfg.initializer_range
+    ks = jax.random.split(key, 4)
+    dt = jnp.dtype(cfg.dtype)
+    return {
+        "ln1_w": jnp.ones((h,), dt), "ln1_b": jnp.zeros((h,), dt),
+        "ln2_w": jnp.ones((h,), dt), "ln2_b": jnp.zeros((h,), dt),
+        "qkv_w": jax.random.normal(ks[0], (h, 3 * h), dt) * std,
+        "qkv_b": jnp.zeros((3 * h,), dt),
+        "proj_w": jax.random.normal(ks[1], (h, h), dt) * std,
+        "proj_b": jnp.zeros((h,), dt),
+        "fc1_w": jax.random.normal(ks[2], (h, f), dt) * std,
+        "fc1_b": jnp.zeros((f,), dt),
+        "fc2_w": jax.random.normal(ks[3], (f, h), dt) * std,
+        "fc2_b": jnp.zeros((h,), dt),
+    }
+
+
+def block_param_specs(cfg: GPTConfig, pipeline: bool) -> Dict[str, P]:
+    """TP sharding for block params; with pipeline=True add leading
+    [pp, per] dims."""
+    base = {
+        "ln1_w": P(), "ln1_b": P(), "ln2_w": P(), "ln2_b": P(),
+        "qkv_w": P(None, MP_AXIS), "qkv_b": P(MP_AXIS),
+        "proj_w": P(MP_AXIS, None), "proj_b": P(),
+        "fc1_w": P(None, MP_AXIS), "fc1_b": P(MP_AXIS),
+        "fc2_w": P(MP_AXIS, None), "fc2_b": P(),
+    }
+    if not pipeline:
+        return base
+    return {k: P(PP_AXIS, None, *list(v)) for k, v in base.items()}
+
+
+def block_apply(params: Dict[str, jax.Array], x: jax.Array,
+                cfg: GPTConfig) -> jax.Array:
+    """One transformer block, pure jnp (used stacked under lax.scan)."""
+    b, s, h = x.shape
+
+    def ln(v, w, bia):
+        mean = jnp.mean(v, -1, keepdims=True)
+        var = jnp.var(v, -1, keepdims=True)
+        return (v - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps) * w + bia
+
+    res = x
+    y = ln(x, params["ln1_w"], params["ln1_b"])
+    qkv = y @ params["qkv_w"] + params["qkv_b"]
+    qkv = qkv.reshape(b, s, cfg.num_heads, 3 * cfg.head_dim)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(logits, -1).astype(x.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, h)
+    x = res + attn @ params["proj_w"] + params["proj_b"]
+    res = x
+    y = ln(x, params["ln2_w"], params["ln2_b"])
+    y = jax.nn.gelu(y @ params["fc1_w"] + params["fc1_b"], approximate=True)
+    return res + y @ params["fc2_w"] + params["fc2_b"]
+
+
+def stack_block_params(cfg: GPTConfig, key, num_stages: int
+                       ) -> Dict[str, jax.Array]:
+    """All layers' params stacked to [num_stages, per_stage, ...]."""
+    per = cfg.num_layers // num_stages
+    keys = jax.random.split(key, cfg.num_layers)
+    blocks = [init_block_params(cfg, k) for k in keys]
+    return {name: jnp.stack([b[name] for b in blocks]).reshape(
+        (num_stages, per) + blocks[0][name].shape)
+        for name in blocks[0]}
+
+
+def build_gpt_train_step(cfg: GPTConfig, topo=None,
+                         num_microbatches: int = 4,
+                         learning_rate: float = 1e-4):
+    """Compile a full hybrid-parallel GPT training step: dp×mp×pp×sp.
+
+    Returns (step_fn, init_fn):
+      init_fn(seed) -> state pytree placed on the mesh
+      step_fn(state, batch_ids, batch_labels) -> (state, loss)
+    Embedding/head are GSPMD tp-sharded; the block stack runs through the
+    scan pipeline inside shard_map(axis_names={'pp'}); optimizer is fused
+    Adam over the sharded state (ZeRO via the sharding axis on opt moments).
+    """
+    from ..parallel.pipeline import spmd_pipeline
+    topo = topo or get_topology()
+    S = topo.get_pipe_parallel_world_size()
+    mesh = topo.mesh
+    if cfg.num_layers % S != 0:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by pp degree {S}")
+    per = cfg.num_layers // S
+    data_axes = tuple(a for a in (DP_AXIS, SHARDING_AXIS)
+                      if topo.axis_size(a) > 1) or (DP_AXIS,)
+
+    def sh(spec):
+        return NamedSharding(mesh, spec)
+
+    emb_specs = {
+        "wte": P(MP_AXIS, None), "wpe": P(), "lnf_w": P(), "lnf_b": P(),
+    }
+    blk_specs = block_param_specs(cfg, pipeline=True)
+
+    def init_fn(seed: int = 0):
+        key = jax.random.key(seed)
+        k1, k2, k3 = jax.random.split(key, 3)
+        params = {
+            "wte": jax.device_put(
+                jax.random.normal(k1, (cfg.vocab_size, cfg.hidden_size),
+                                  jnp.dtype(cfg.dtype))
+                * cfg.initializer_range, sh(emb_specs["wte"])),
+            "wpe": jax.device_put(
+                jax.random.normal(k2, (cfg.max_position_embeddings,
+                                       cfg.hidden_size), jnp.dtype(cfg.dtype))
+                * cfg.initializer_range, sh(emb_specs["wpe"])),
+            "lnf_w": jax.device_put(jnp.ones(cfg.hidden_size), sh(P())),
+            "lnf_b": jax.device_put(jnp.zeros(cfg.hidden_size), sh(P())),
+            "blocks": {n: jax.device_put(v, sh(blk_specs[n]))
+                       for n, v in stack_block_params(cfg, k3, S).items()},
+        }
+        opt = {
+            "m": jax.tree.map(lambda v: jnp.zeros_like(v, jnp.float32), params),
+            "v": jax.tree.map(lambda v: jnp.zeros_like(v, jnp.float32), params),
+            "t": jnp.zeros((), jnp.int32),
+        }
+        return {"params": params, "opt": opt}
+
+    def forward_loss(params, ids, labels):
+        b, s = ids.shape
+        x = jnp.take(params["wte"], ids, axis=0) \
+            + params["wpe"][None, :s, :]
+        # sequence-parallel constraint (sep axis shards seq dim)
+        x = jax.lax.with_sharding_constraint(
+            x, sh(P(data_axes, SEP_AXIS, None)))
+
+        if S > 1:
+            M = num_microbatches
+            mbs = x.reshape(M, b // M, s, cfg.hidden_size)
+
+            def stage_fn(blk_local, h):
+                # blk_local leaves: [1(pp-local), per_stage, ...] — drop the
+                # manual-axis dim, then scan over this stage's layers
+                local = jax.tree.map(lambda v: v[0], blk_local)
+
+                def body(carry, layer_params):
+                    return block_apply(layer_params, carry, cfg), None
+                out, _ = jax.lax.scan(body, h, local)
+                return out
+
+            def pp_inner(blk_local, mb_local):
+                outs = spmd_pipeline(stage_fn, blk_local, mb_local, S,
+                                     remat=True)
+                is_last = (jax.lax.axis_index(PP_AXIS) == S - 1)
+                return jax.lax.psum(
+                    outs * is_last.astype(outs.dtype), PP_AXIS)
+
+            blk_in_specs = jax.tree.map(lambda _: P(PP_AXIS),
+                                        params["blocks"])
+            x = jax.shard_map(
+                pp_inner, mesh=mesh,
+                in_specs=(blk_in_specs, P(None)),
+                out_specs=P(None), axis_names={PP_AXIS},
+                check_vma=False)(params["blocks"], mbs)
+            x = x.reshape(b, s, cfg.hidden_size)
+        else:
+            def body(carry, layer_params):
+                return block_apply(layer_params, carry, cfg), None
+            flat_blocks = jax.tree.map(
+                lambda v: v.reshape((cfg.num_layers,) + v.shape[2:]),
+                params["blocks"])
+            x, _ = jax.lax.scan(body, x, flat_blocks)
+
+        mean = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        x = (x - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps) \
+            * params["lnf_w"] + params["lnf_b"]
+        logits = jnp.einsum("bsh,vh->bsv", x, params["wte"])
+        logits = logits.astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits, -1)
+        nll = -jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    b1, b2, eps = 0.9, 0.95, 1e-8
+
+    def step(state, ids, labels):
+        params, opt = state["params"], state["opt"]
+        loss, grads = jax.value_and_grad(forward_loss)(params, ids, labels)
+        t = opt["t"] + 1
+        tf = t.astype(jnp.float32)
+
+        def upd(p, g, m, v):
+            g32 = g.astype(jnp.float32)
+            m2 = b1 * m + (1 - b1) * g32
+            v2 = b2 * v + (1 - b2) * jnp.square(g32)
+            mh = m2 / (1 - b1 ** tf)
+            vh = v2 / (1 - b2 ** tf)
+            p2 = p.astype(jnp.float32) - learning_rate * mh / (
+                jnp.sqrt(vh) + eps)
+            return p2.astype(p.dtype), m2, v2
+
+        new = jax.tree.map(upd, params, grads, opt["m"], opt["v"])
+        new_params = jax.tree.map(lambda x: x[0], new,
+                                  is_leaf=lambda x: isinstance(x, tuple))
+        new_m = jax.tree.map(lambda x: x[1], new,
+                             is_leaf=lambda x: isinstance(x, tuple))
+        new_v = jax.tree.map(lambda x: x[2], new,
+                             is_leaf=lambda x: isinstance(x, tuple))
+        return ({"params": new_params,
+                 "opt": {"m": new_m, "v": new_v, "t": t}}, loss)
+
+    data_sh = sh(P(data_axes))
+    step_fn = jax.jit(step, donate_argnums=(0,),
+                      in_shardings=(None, data_sh, data_sh),
+                      out_shardings=None)
+    return step_fn, init_fn

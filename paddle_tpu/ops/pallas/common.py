@@ -1,0 +1,20 @@
+"""Shared helpers for Pallas TPU kernels."""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["use_interpret", "NEG_INF"]
+
+NEG_INF = -1e30
+
+
+def use_interpret() -> bool:
+    """Run kernels in interpreter mode off-TPU (CPU tests) or when forced."""
+    from ...core.flags import FLAGS
+    if FLAGS.pallas_interpret:
+        return True
+    try:
+        return jax.devices()[0].platform.lower() not in ("tpu", "axon")
+    except Exception:
+        return True

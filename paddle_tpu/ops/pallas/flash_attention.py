@@ -1,0 +1,346 @@
+"""Pallas TPU flash attention (fwd + bwd).
+
+Port target: the reference's FlashAttention integration
+(/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu:536, which
+dynloads an external CUDA library — backends/dynload/flashattn.h:19).  Here
+the kernel is first-party: online-softmax tiling over KV blocks with the
+accumulator carried in VMEM scratch across the (sequential) TPU grid, bwd
+via the standard recompute dq / dkv two-kernel scheme.
+
+Layout: [batch, seq, heads, head_dim] (paddle flash_attention layout).
+Internally processed per (batch, head) with blocks of q/k rows sized to the
+MXU (128).  float32 accumulation; inputs may be bf16.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .common import NEG_INF, use_interpret
+
+__all__ = ["flash_attention_fwd", "flash_attention"]
+
+DEFAULT_BLOCK = 128
+
+
+def _blocks(seq: int) -> int:
+    return min(DEFAULT_BLOCK, seq)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel: grid (B, H, nq, nk) — nk innermost ⇒ scratch carries the
+# running softmax state across k blocks for a fixed q block.
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
+                nk, kv_len):
+    kb = pl.program_id(3)
+    qb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)          # [bk, d]
+        v = v_ref[0].astype(jnp.float32)          # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if kv_len % block_k != 0:
+            s = jnp.where(k_pos < kv_len, s, NEG_INF)   # padded keys
+        m_prev = m_scr[:]                          # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
+        l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    if causal:
+        # skip fully-masked blocks above the diagonal
+        @pl.when(kb * block_k <= qb * block_q + (block_q - 1))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(kb == nk - 1)
+    def _final():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+
+
+def _pad_seq(x, block):
+    pad = (-x.shape[1]) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    return x
+
+
+def _fwd(q, k, v, scale, causal):
+    B, Sq0, H, D = q.shape
+    Sk0 = k.shape[1]
+    bq = _blocks(Sq0)
+    bk = _blocks(Sk0)
+    q = _pad_seq(q, bq)
+    k = _pad_seq(k, bk)
+    v = _pad_seq(v, bk)
+    Sq, Sk = q.shape[1], k.shape[1]
+    nq = Sq // bq
+    nk = Sk // bk
+    # [B, S, H, D] -> [B, H, S, D]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk, nk=nk, kv_len=Sk0)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2)[:, :Sq0], lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (recompute scheme, FlashAttention-2 style)
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, causal, block_q, block_k, nk, kv_len):
+    kb = pl.program_id(3)
+    qb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]                  # [bq, 1]
+        delta = delta_ref[0][:, None]              # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if kv_len % block_k != 0:
+            s = jnp.where(k_pos < kv_len, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(kb * block_k <= qb * block_q + (block_q - 1))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(kb == nk - 1)
+    def _final():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                    block_q, block_k, nq):
+    qb = pl.program_id(3)
+    kb = pl.program_id(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # [bq, bk]
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(qb * block_q + (block_q - 1) >= kb * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qb == nq - 1)
+    def _final():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, res, g):
+    q, k, v, out, lse = res
+    do = g
+    B, Sq0, H, D = q.shape
+    Sk0 = k.shape[1]
+    bq = _blocks(Sq0)
+    bk = _blocks(Sk0)
+    q = _pad_seq(q, bq)
+    k = _pad_seq(k, bk)
+    v = _pad_seq(v, bk)
+    out = _pad_seq(out, bq)
+    do = _pad_seq(do, bq)     # zero-padded ⇒ padded-q rows contribute 0
+    Sq, Sk = q.shape[1], k.shape[1]
+    nq = Sq // bq
+    nk = Sk // bk
+
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    ot = jnp.swapaxes(out, 1, 2)
+    dot_ = jnp.swapaxes(do, 1, 2)
+    delta = jnp.sum(ot.astype(jnp.float32) * dot_.astype(jnp.float32),
+                    axis=-1)                       # [B, H, Sq]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nk=nk, kv_len=Sk0),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=use_interpret(),
+    )(qt, kt, vt, dot_, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nq=nq),
+        grid=(B, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(qt, kt, vt, dot_, lse, delta)
+
+    return (jnp.swapaxes(dq, 1, 2)[:, :Sq0],
+            jnp.swapaxes(dk, 1, 2)[:, :Sk0],
+            jnp.swapaxes(dv, 1, 2)[:, :Sk0])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, scale: Optional[float] = None,
+                    causal: bool = False):
+    """Flash attention, [B, S, H, D] layout.  Differentiable."""
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    out, _ = _fwd(q, k, v, s, causal)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal):
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    out, lse = _fwd(q, k, v, s, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, res, g):
+    s = scale if scale is not None else 1.0 / math.sqrt(res[0].shape[-1])
+    return _bwd(s, causal, res, g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_fwd(q, k, v, scale: Optional[float] = None,
+                        causal: bool = False):
+    """Forward-only convenience entry (used by F.scaled_dot_product_attention
+    dispatch); still differentiable through the custom VJP."""
+    return flash_attention(q, k, v, scale, causal)

@@ -1,0 +1,242 @@
+"""Fused normalization kernels: rms_norm, layer_norm,
+fused_bias_dropout_residual_layer_norm.
+
+Port targets (SURVEY §2.6): phi/kernels/gpu/rms_norm_kernel.cu,
+fusion/gpu/fused_bias_dropout_residual_layer_norm_kernel.cu,
+fusion/gpu/fused_layernorm_kernel.cu.  One VMEM pass per row-block: the
+reference needs separate Welford + scale kernels; here mean/var/normalize/
+affine (+ bias+residual+dropout) fuse into a single kernel with f32 math.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .common import use_interpret
+
+__all__ = ["rms_norm", "layer_norm", "fused_bias_dropout_residual_layer_norm"]
+
+BLOCK_ROWS = 256
+
+
+def _row_grid(n_rows: int) -> Tuple[int, int]:
+    b = min(BLOCK_ROWS, n_rows)
+    while n_rows % b:
+        b //= 2
+    return max(b, 1), n_rows // max(b, 1)
+
+
+# ---------------------------------------------------------------------------
+# rms_norm
+# ---------------------------------------------------------------------------
+def _rms_kernel(x_ref, w_ref, o_ref, inv_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    o_ref[:] = (x * inv * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    inv_ref[:] = inv[:, 0]
+
+
+def _rms_fwd_impl(x, w, eps):
+    orig_shape = x.shape
+    H = x.shape[-1]
+    x2 = x.reshape(-1, H)
+    R = x2.shape[0]
+    br, nr = _row_grid(R)
+    out, inv = pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        grid=(nr,),
+        in_specs=[
+            pl.BlockSpec((br, H), lambda i: (i, 0)),
+            pl.BlockSpec((H,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, H), lambda i: (i, 0)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, H), x.dtype),
+            jax.ShapeDtypeStruct((R,), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(x2, w)
+    return out.reshape(orig_shape), inv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rms_norm(x, weight, epsilon: float = 1e-6):
+    out, _ = _rms_fwd_impl(x, weight, epsilon)
+    return out
+
+
+def _rms_fwd(x, weight, epsilon):
+    out, inv = _rms_fwd_impl(x, weight, epsilon)
+    return out, (x, weight, inv)
+
+
+def _rms_bwd(epsilon, res, g):
+    x, w, inv = res
+    H = x.shape[-1]
+    x2 = x.reshape(-1, H).astype(jnp.float32)
+    g2 = g.reshape(-1, H).astype(jnp.float32)
+    inv = inv[:, None]
+    xhat = x2 * inv
+    wg = g2 * w.astype(jnp.float32)
+    # d xhat/dx through rsqrt(mean(x^2)+eps)
+    dx = inv * (wg - xhat * jnp.mean(wg * xhat, axis=-1, keepdims=True))
+    dw = jnp.sum(g2 * xhat, axis=0)
+    return dx.reshape(x.shape).astype(x.dtype), dw.astype(w.dtype)
+
+
+rms_norm.defvjp(_rms_fwd, _rms_bwd)
+
+
+# ---------------------------------------------------------------------------
+# layer_norm (fused affine)
+# ---------------------------------------------------------------------------
+def _ln_kernel(x_ref, w_ref, b_ref, o_ref, mean_ref, inv_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    o_ref[:] = (xc * inv * w_ref[:].astype(jnp.float32)
+                + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    mean_ref[:] = mean[:, 0]
+    inv_ref[:] = inv[:, 0]
+
+
+def _ln_fwd_impl(x, w, b, eps):
+    orig = x.shape
+    H = x.shape[-1]
+    x2 = x.reshape(-1, H)
+    R = x2.shape[0]
+    br, nr = _row_grid(R)
+    out, mean, inv = pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        grid=(nr,),
+        in_specs=[
+            pl.BlockSpec((br, H), lambda i: (i, 0)),
+            pl.BlockSpec((H,), lambda i: (0,)),
+            pl.BlockSpec((H,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, H), lambda i: (i, 0)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, H), x.dtype),
+            jax.ShapeDtypeStruct((R,), jnp.float32),
+            jax.ShapeDtypeStruct((R,), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(x2, w, b)
+    return out.reshape(orig), mean, inv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def layer_norm(x, weight, bias, epsilon: float = 1e-5):
+    out, _, _ = _ln_fwd_impl(x, weight, bias, epsilon)
+    return out
+
+
+def _ln_fwd(x, weight, bias, epsilon):
+    out, mean, inv = _ln_fwd_impl(x, weight, bias, epsilon)
+    return out, (x, weight, mean, inv)
+
+
+def _ln_bwd(epsilon, res, g):
+    x, w, mean, inv = res
+    H = x.shape[-1]
+    x2 = x.reshape(-1, H).astype(jnp.float32)
+    g2 = g.reshape(-1, H).astype(jnp.float32)
+    xhat = (x2 - mean[:, None]) * inv[:, None]
+    wg = g2 * w.astype(jnp.float32)
+    dx = inv[:, None] * (
+        wg - jnp.mean(wg, axis=-1, keepdims=True)
+        - xhat * jnp.mean(wg * xhat, axis=-1, keepdims=True))
+    dw = jnp.sum(g2 * xhat, axis=0)
+    db = jnp.sum(g2, axis=0)
+    return (dx.reshape(x.shape).astype(x.dtype), dw.astype(w.dtype),
+            db.astype(w.dtype))
+
+
+layer_norm.defvjp(_ln_fwd, _ln_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused bias + dropout + residual-add + layer_norm
+# ---------------------------------------------------------------------------
+def _bdrl_kernel(x_ref, bias_ref, res_ref, w_ref, b_ref, seed_ref,
+                 o_ref, addout_ref, mean_ref, inv_ref, *,
+                 eps, p, training):
+    x = x_ref[:].astype(jnp.float32) + bias_ref[:].astype(jnp.float32)
+    if training and p > 0.0:
+        pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+        bits = pltpu.prng_random_bits(x.shape)
+        # uniform in [0,1) from the top 24 bits
+        u = (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+        keep = u >= p
+        x = jnp.where(keep, x / (1.0 - p), 0.0)
+    x = x + res_ref[:].astype(jnp.float32)
+    addout_ref[:] = x.astype(addout_ref.dtype)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    o_ref[:] = (xc * inv * w_ref[:].astype(jnp.float32)
+                + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    mean_ref[:] = mean[:, 0]
+    inv_ref[:] = inv[:, 0]
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias, ln_weight, ln_bias, dropout_rate: float = 0.0,
+        epsilon: float = 1e-5, training: bool = False,
+        seed: Optional[int] = None):
+    """Returns (ln_out, add_out) like the reference fused op
+    (fused_bias_dropout_residual_layer_norm_kernel.cu).  Dropout uses the
+    on-chip PRNG.  Differentiable via the composed jnp fallback when a grad
+    is needed through dropout (mask not saved) — for training grads use
+    dropout_rate=0 or the composed F.* path."""
+    orig = x.shape
+    H = x.shape[-1]
+    x2 = x.reshape(-1, H)
+    r2 = residual.reshape(-1, H)
+    R = x2.shape[0]
+    br, nr = _row_grid(R)
+    seed_arr = jnp.asarray([seed if seed is not None else 0], jnp.int32)
+    out, addout, mean, inv = pl.pallas_call(
+        functools.partial(_bdrl_kernel, eps=epsilon, p=dropout_rate,
+                          training=training),
+        grid=(nr,),
+        in_specs=[
+            pl.BlockSpec((br, H), lambda i: (i, 0)),
+            pl.BlockSpec((H,), lambda i: (0,)),
+            pl.BlockSpec((br, H), lambda i: (i, 0)),
+            pl.BlockSpec((H,), lambda i: (0,)),
+            pl.BlockSpec((H,), lambda i: (0,)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, H), lambda i: (i, 0)),
+            pl.BlockSpec((br, H), lambda i: (i, 0)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, H), x.dtype),
+            jax.ShapeDtypeStruct((R, H), x.dtype),
+            jax.ShapeDtypeStruct((R,), jnp.float32),
+            jax.ShapeDtypeStruct((R,), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(x2, bias, r2, ln_weight, ln_bias, seed_arr)
+    return out.reshape(orig), addout.reshape(orig)

@@ -1,0 +1,99 @@
+"""Fused rotary position embedding (RoPE).
+
+Port target: phi/kernels/fusion/gpu/fused_rope_kernel.cu:27 (+grad), Python
+API incubate/nn/functional/fused_rotary_position_embedding.py.  One VMEM
+pass applies the rotation to q and k; the VJP is the inverse rotation
+(applied to the cotangent), so no residuals are saved.
+
+Layout: [batch, seq, heads, head_dim]; rotate-half convention
+(use_neox_rotary_style=True in the reference API).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .common import use_interpret
+
+__all__ = ["fused_rope", "rope_cos_sin"]
+
+
+def rope_cos_sin(seq_len: int, head_dim: int, base: float = 10000.0,
+                 dtype=jnp.float32, position_ids=None):
+    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                          / head_dim))
+    pos = (jnp.arange(seq_len, dtype=jnp.float32) if position_ids is None
+           else position_ids.astype(jnp.float32))
+    freqs = jnp.outer(pos, inv)                      # [S, D/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)   # [S, D]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref, *, sign):
+    x = x_ref[0].astype(jnp.float32)                 # [S, D] (one b,h slice)
+    cos = cos_ref[:].astype(jnp.float32)             # [S, D]
+    sin = sin_ref[:].astype(jnp.float32) * sign
+    d2 = x.shape[-1] // 2
+    x1 = x[:, :d2]
+    x2 = x[:, d2:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    o_ref[0] = (x * cos + rot * sin).astype(o_ref.dtype)
+
+
+def _apply(x, cos, sin, sign):
+    B, S, H, D = x.shape
+    xt = jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, S, D)
+    out = pl.pallas_call(
+        functools.partial(_rope_kernel, sign=sign),
+        grid=(B * H,),
+        in_specs=[
+            pl.BlockSpec((1, S, D), lambda i: (i, 0, 0)),
+            pl.BlockSpec((S, D), lambda i: (0, 0)),
+            pl.BlockSpec((S, D), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, S, D), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), x.dtype),
+        interpret=use_interpret(),
+    )(xt, cos, sin)
+    return jnp.transpose(out.reshape(B, H, S, D), (0, 2, 1, 3))
+
+
+@jax.custom_vjp
+def _rope_one(x, cos, sin):
+    return _apply(x, cos, sin, 1.0)
+
+
+def _rope_one_fwd(x, cos, sin):
+    return _apply(x, cos, sin, 1.0), (cos, sin)
+
+
+def _rope_one_bwd(res, g):
+    cos, sin = res
+    # R(θ)ᵀ = R(−θ)
+    return _apply(g, cos, sin, -1.0), None, None
+
+
+_rope_one.defvjp(_rope_one_fwd, _rope_one_bwd)
+
+
+def fused_rope(q, k=None, v=None, sin=None, cos=None, position_ids=None,
+               use_neox_rotary_style: bool = True, base: float = 10000.0
+               ) -> Tuple:
+    """API parity with
+    paddle.incubate.nn.functional.fused_rotary_position_embedding: applies
+    RoPE to q (and k; v passes through untouched when given)."""
+    S, D = q.shape[1], q.shape[-1]
+    if cos is None or sin is None:
+        cos, sin = rope_cos_sin(S, D, base, jnp.float32, position_ids)
+    else:
+        cos = jnp.reshape(cos, (S, D))
+        sin = jnp.reshape(sin, (S, D))
+    out_q = _rope_one(q, cos, sin)
+    out_k = _rope_one(k, cos, sin) if k is not None else None
+    return out_q, out_k, v

@@ -1,1 +1,26 @@
+"""``paddle_tpu.parallel`` — hybrid-parallel over TPU meshes (SURVEY §2.5).
+
+Maps the reference's python/paddle/distributed surface onto jax.sharding:
+process groups → mesh axes, NCCL → XLA collectives on ICI/DCN, TCPStore →
+jax.distributed coordination.
+"""
+
+from . import collective  # noqa: F401
+from . import fleet  # noqa: F401
+from .api import (  # noqa: F401
+    Partial, ProcessMesh, Replicate, Shard, dtensor_from_local, reshard,
+    shard_layer, shard_tensor,
+)
+from .collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_reduce, all_to_all, barrier, broadcast,
+    get_group, new_group, reduce, reduce_scatter, scatter,
+)
+from .engine import DistributedEngine  # noqa: F401
 from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .sharding import ShardingStage, group_sharded_parallel  # noqa: F401
+from .topology import HybridTopology, get_topology, init_topology, set_topology  # noqa: F401
+from .pipeline import LayerDesc, PipelineLayer, SharedLayerDesc, spmd_pipeline  # noqa: F401

@@ -1,0 +1,181 @@
+"""Semi-auto (DistTensor) API.
+
+Reference: python/paddle/distributed/auto_parallel/api.py —
+``shard_tensor`` :134, ``reshard`` :619, ``dtensor_from_local`` :539,
+``shard_layer`` :718; C++ DistTensor/ProcessMesh/reshard functions
+(phi/core/distributed/auto_parallel/).
+
+TPU-native: a "DistTensor" is a Tensor whose value is a global
+``jax.Array`` with a ``NamedSharding``; ``Placement`` types map onto
+PartitionSpec entries; ``reshard`` is a sharded ``device_put`` — XLA
+generates the same r_to_s / s_to_r / p_to_r transfer kernels the reference
+hand-codes per placement pair (reshard/*.cc)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .topology import get_topology
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "dtensor_from_local", "reshard", "shard_layer", "get_placements"]
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    """Shard(dim) — split tensor dim over a mesh axis."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+
+class Partial(Placement):
+    """Pending-reduction placement.  In the single-controller model a
+    Partial tensor materializes as replicated-after-psum; kept for API
+    parity (reference placement_types.h)."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """N-d logical mesh over devices (reference process_mesh.h:34).  Wraps a
+    jax Mesh; ``dim_names`` are the sharding axis names."""
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray, None] = None,
+                 dim_names: Optional[List[str]] = None,
+                 jax_mesh: Optional[Mesh] = None):
+        if jax_mesh is not None:
+            self.mesh = jax_mesh
+            self.dim_names = list(jax_mesh.axis_names)
+            return
+        arr = np.asarray(mesh)
+        dim_names = dim_names or [f"d{i}" for i in range(arr.ndim)]
+        devs = np.asarray(jax.devices())[arr.reshape(-1)].reshape(arr.shape)
+        self.mesh = Mesh(devs, tuple(dim_names))
+        self.dim_names = list(dim_names)
+
+    @property
+    def shape(self):
+        return [self.mesh.shape[n] for n in self.dim_names]
+
+    @property
+    def process_ids(self):
+        return [d.id for d in self.mesh.devices.reshape(-1)]
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+def _spec_from_placements(placements: Sequence[Placement], ndim: int,
+                          dim_names: List[str]) -> P:
+    entries: List[Optional[str]] = [None] * ndim
+    for axis_name, pl in zip(dim_names, placements):
+        if isinstance(pl, Shard):
+            if entries[pl.dim] is not None:
+                entries[pl.dim] = (*((entries[pl.dim],) if isinstance(
+                    entries[pl.dim], str) else entries[pl.dim]), axis_name)
+            else:
+                entries[pl.dim] = axis_name
+    return P(*entries)
+
+
+def shard_tensor(data, mesh: Optional[ProcessMesh] = None,
+                 placements: Optional[Sequence[Placement]] = None,
+                 dtype=None, stop_gradient: Optional[bool] = None) -> Tensor:
+    """Place a (global) tensor onto the mesh with the given placements
+    (reference auto_parallel/api.py:134)."""
+    t = data if isinstance(data, Tensor) else Tensor(np.asarray(data))
+    mesh = mesh or ProcessMesh(jax_mesh=get_topology().mesh)
+    placements = list(placements or [])
+    spec = _spec_from_placements(placements, t.ndim, mesh.dim_names)
+    sharding = NamedSharding(mesh.mesh, spec)
+    v = jax.device_put(t._value, sharding)
+    out = Tensor(v, stop_gradient=(t.stop_gradient if stop_gradient is None
+                                   else stop_gradient), name=t.name)
+    out.process_mesh = mesh
+    out.placements = placements
+    return out
+
+
+def dtensor_from_local(local_tensor, mesh: ProcessMesh,
+                       placements: Sequence[Placement]) -> Tensor:
+    """Assemble a global tensor from per-device local shards (reference
+    api.py:539).  Single-controller: jax.make_array_from_single_device_arrays
+    over the mesh's devices."""
+    t = local_tensor if isinstance(local_tensor, Tensor) else Tensor(
+        np.asarray(local_tensor))
+    spec = _spec_from_placements(placements, t.ndim, mesh.dim_names)
+    sharding = NamedSharding(mesh.mesh, spec)
+    # global shape: local shape scaled by shard counts
+    gshape = list(t.shape)
+    for axis_name, pl in zip(mesh.dim_names, placements):
+        if isinstance(pl, Shard):
+            gshape[pl.dim] *= mesh.mesh.shape[axis_name]
+    v = jax.make_array_from_callback(
+        tuple(gshape), sharding,
+        lambda idx: np.asarray(t._value)[tuple(
+            slice(0, s.stop - s.start) if isinstance(s, slice) else s
+            for s in idx)])
+    out = Tensor(v, stop_gradient=t.stop_gradient)
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def reshard(dist_tensor: Tensor, mesh: ProcessMesh,
+            placements: Sequence[Placement]) -> Tensor:
+    """Change placements (reference api.py:619; C++ reshard functions
+    r_to_s/s_to_r/p_to_r...).  One sharded device_put — XLA picks the
+    minimal collective."""
+    spec = _spec_from_placements(placements, dist_tensor.ndim, mesh.dim_names)
+    v = jax.device_put(dist_tensor._value, NamedSharding(mesh.mesh, spec))
+    out = Tensor(v, stop_gradient=dist_tensor.stop_gradient)
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def shard_layer(layer: Layer, process_mesh: ProcessMesh,
+                shard_fn=None, input_fn=None, output_fn=None) -> Layer:
+    """Apply a shard_fn(name, layer, mesh) over sublayers to annotate/place
+    parameters (reference api.py:718)."""
+    def default_shard_fn(name, sublayer, mesh):
+        for pname, p in sublayer._parameters.items():
+            if p is not None:
+                p._value = jax.device_put(
+                    p._value, NamedSharding(mesh.mesh, P()))
+    fn = shard_fn or default_shard_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    return layer
+
+
+def get_placements(t: Tensor):
+    return getattr(t, "placements", None)

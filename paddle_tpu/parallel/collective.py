@@ -1,0 +1,249 @@
+"""Collective communication API.
+
+Analog of the reference's ``paddle.distributed`` collective surface
+(/root/reference/python/paddle/distributed/communication/ — all_reduce.py:29
+etc.) and the C++ ProcessGroup (phi/core/distributed/collective/
+process_group.h:48).
+
+TPU-native mapping (SURVEY §5 'Distributed communication backend'): a
+"process group" is a mesh axis name; collectives are XLA ops
+(``psum``/``all_gather``/``ppermute``/``all_to_all``) emitted under
+``shard_map``.  Two call modes:
+
+* **in-trace** (inside shard_map'd code): thin wrappers over jax.lax
+  collectives — zero overhead, XLA schedules them async on ICI (the
+  reference's ``sync_op/use_calc_stream`` machinery dissolves here);
+* **eager** (on global Tensors): the call jit-wraps itself in a shard_map
+  over the topology mesh, giving Paddle-API parity for scripts and tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .topology import get_topology
+
+__all__ = [
+    "ReduceOp", "Group", "new_group", "get_group",
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all", "broadcast",
+    "reduce", "scatter", "barrier", "send", "recv",
+    "in_all_reduce", "in_all_gather", "in_reduce_scatter", "in_all_to_all",
+    "in_ppermute", "in_axis_index",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A named communication group = a mesh axis (or tuple of axes)."""
+
+    def __init__(self, axis: Union[str, Sequence[str]] = "dp", topo=None):
+        self.axis = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+        self._topo = topo
+
+    @property
+    def topo(self):
+        return self._topo or get_topology()
+
+    @property
+    def nranks(self) -> int:
+        n = 1
+        for a in self.axis:
+            n *= self.topo.axis_size(a)
+        return n
+
+    world_size = nranks
+
+    def __repr__(self):
+        return f"Group(axis={self.axis}, nranks={self.nranks})"
+
+
+_named_groups = {}
+
+
+def new_group(ranks=None, axis: Union[str, Sequence[str]] = "dp",
+              backend=None) -> Group:
+    g = Group(axis)
+    _named_groups[g.axis] = g
+    return g
+
+
+def get_group(axis="dp") -> Group:
+    key = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    if key not in _named_groups:
+        _named_groups[key] = Group(axis)
+    return _named_groups[key]
+
+
+def _resolve_group(group) -> Group:
+    if group is None:
+        return get_group("dp")
+    if isinstance(group, Group):
+        return group
+    return get_group(group)
+
+
+# ---------------------------------------------------------------------------
+# in-trace primitives (use inside shard_map'd functions)
+# ---------------------------------------------------------------------------
+def in_all_reduce(x, axis: Union[str, Sequence[str]], op: str = ReduceOp.SUM):
+    if op == ReduceOp.SUM:
+        return jax.lax.psum(x, axis)
+    if op == ReduceOp.MAX:
+        return jax.lax.pmax(x, axis)
+    if op == ReduceOp.MIN:
+        return jax.lax.pmin(x, axis)
+    if op == ReduceOp.AVG:
+        return jax.lax.pmean(x, axis)
+    if op == ReduceOp.PROD:
+        gathered = jax.lax.all_gather(x, axis if isinstance(axis, str)
+                                      else axis[0], axis=0)
+        return jnp.prod(gathered, axis=0)
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def in_all_gather(x, axis: str, concat_axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis, axis=concat_axis, tiled=tiled)
+
+
+def in_reduce_scatter(x, axis: str, scatter_axis: int = 0):
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                                tiled=True)
+
+
+def in_all_to_all(x, axis: str, split_axis: int, concat_axis: int):
+    return jax.lax.all_to_all(x, axis, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def in_ppermute(x, axis: str, perm):
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def in_axis_index(axis: str):
+    return jax.lax.axis_index(axis)
+
+
+# ---------------------------------------------------------------------------
+# eager wrappers over global Tensors
+# ---------------------------------------------------------------------------
+def _eager_collective(tensor: Tensor, group, fn, in_spec=None, out_spec=None):
+    g = _resolve_group(group)
+    topo = g.topo
+    mesh = topo.mesh
+    if g.nranks == 1:
+        return tensor
+    in_spec = in_spec if in_spec is not None else P(g.axis)
+    out_spec = out_spec if out_spec is not None else in_spec
+    mapped = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_spec,
+                                   out_specs=out_spec, check_vma=False))
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    out = mapped(v)
+    return Tensor(out) if isinstance(tensor, Tensor) else out
+
+
+def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group=None,
+               sync_op: bool = True):
+    """Eager all-reduce with single-controller semantics: the global Tensor
+    stands for the value every rank holds, so SUM over an N-way group
+    returns ``x * N`` — exactly what the reference produces when all ranks
+    hold identical tensors.  (In-trace code uses in_all_reduce / psum on
+    genuinely per-shard values.)"""
+    g = _resolve_group(group)
+    if g.nranks == 1:
+        return tensor
+    out = _eager_collective(
+        tensor, g, lambda x: in_all_reduce(x, list(g.axis), op),
+        in_spec=P(), out_spec=P())
+    if isinstance(tensor, Tensor):
+        tensor._value = out._value if isinstance(out, Tensor) else out
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor: Tensor, group=None, sync_op: bool = True):
+    """Paddle-compatible: appends nranks shards to tensor_list.  The input is
+    the local shard (replicated globally in single-controller mode), so the
+    gather is a tile."""
+    g = _resolve_group(group)
+    for _ in range(g.nranks):
+        tensor_list.append(tensor.clone() if isinstance(tensor, Tensor)
+                           else Tensor(tensor))
+    return tensor_list
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group=None, sync_op: bool = True):
+    g = _resolve_group(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        from ..ops import api as _api
+        cat = _api.concat(list(src), axis=0)
+    else:
+        cat = src
+    n = g.nranks
+    if n == 1:
+        tensor._value = cat._value
+        return tensor
+    # single-controller semantics: every rank holds the same full tensor;
+    # scatter = take own chunk, reduce = sum over identical copies ⇒ scale
+    tensor._value = (cat._value[: cat.shape[0] // n] * n)
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    g = _resolve_group(group)
+    out_tensor_list.extend(t.clone() for t in in_tensor_list)
+    return out_tensor_list
+
+
+def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op: bool = True):
+    # single-controller: all ranks see the same value already
+    return tensor
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None,
+           sync_op: bool = True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group=None,
+            sync_op: bool = True):
+    if tensor_list:
+        tensor._value = tensor_list[0]._value
+    return tensor
+
+
+def barrier(group=None):
+    """Synchronize: enqueue a trivial computation on every device and wait.
+    Device execution is FIFO per device, so this drains all previously
+    dispatched work (the reference's stream-sync barrier semantics)."""
+    jax.effects_barrier()
+    import jax.numpy as _jnp
+    for d in jax.devices():
+        jax.device_get(jax.device_put(_jnp.zeros(()), d) + 1)
+    return None
+
+
+def send(tensor: Tensor, dst: int = 0, group=None, sync_op: bool = True):
+    raise NotImplementedError(
+        "point-to-point send/recv between ranks is expressed as "
+        "lax.ppermute inside shard_map on TPU (see parallel.pipeline); "
+        "host-level send is not part of the single-controller model")
+
+
+def recv(tensor: Tensor, src: int = 0, group=None, sync_op: bool = True):
+    raise NotImplementedError(
+        "see send(): use parallel.pipeline p2p or shard_map ppermute")

@@ -1,0 +1,171 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Analog of /root/reference/python/paddle/distributed/fleet/layers/mpu/
+mp_layers.py — ``VocabParallelEmbedding`` :47, ``ColumnParallelLinear``
+:334, ``RowParallelLinear`` :541, ``ParallelCrossEntropy`` :742 — and the
+comm PyLayers in mp_ops.py (:91 _c_identity, :293 _mp_allreduce).
+
+TPU-native design: each layer creates its FULL logical parameter but
+annotates it with a PartitionSpec over the ``mp`` mesh axis
+(``param_spec`` attribute).  Under jit with those shardings, XLA's SPMD
+partitioner materializes only the local shard per device and inserts the
+exact Megatron collectives (all-gather for column backward, psum for row
+forward) on ICI — the hand-written _c_identity/_mp_allreduce PyLayers
+dissolve into the compiler.  ``with_sharding_constraint`` pins activation
+layouts at layer boundaries (gather_output / input_is_parallel semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.layers import Layer
+from .topology import MP_AXIS, get_topology
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy", "mark_sharding",
+           "constrain"]
+
+
+def mark_sharding(param, *axes):
+    """Attach a PartitionSpec to a parameter; the parallel engine reads
+    ``param_spec`` when staging state onto the mesh."""
+    param.param_spec = P(*axes)
+    return param
+
+
+def constrain(x, *axes):
+    """with_sharding_constraint on a Tensor/array inside a traced step
+    (no-op outside jit or on meshless values)."""
+    spec = P(*axes)
+    v = x._value if isinstance(x, Tensor) else x
+    try:
+        topo = get_topology()
+        out = jax.lax.with_sharding_constraint(
+            v, jax.sharding.NamedSharding(topo.mesh, spec))
+    except Exception:
+        out = v
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+class ColumnParallelLinear(Layer):
+    """Weight [in, out] split on out (columns) over mp.  Forward: local
+    matmul producing mp-sharded activations; ``gather_output=True`` adds an
+    all-gather (mp_layers.py:334 semantics)."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, gather_output: bool = True,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        topo = get_topology()
+        self.world_size = topo.get_model_parallel_world_size()
+        if out_features % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"out_features {out_features} not divisible by mp degree "
+                f"{self.world_size}")
+        self.weight = self.create_parameter((in_features, out_features),
+                                            attr=weight_attr)
+        mark_sharding(self.weight, None, MP_AXIS)
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            mark_sharding(self.bias, MP_AXIS)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = constrain(out, None)          # replicate (all-gather)
+        else:
+            out = constrain(out, *([None] * (out.ndim - 1) + [MP_AXIS]))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Weight [in, out] split on in (rows) over mp.  With
+    ``input_is_parallel=True`` the input arrives mp-sharded on its last dim;
+    forward is a partial matmul + psum (mp_layers.py:541)."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, input_is_parallel: bool = False,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        topo = get_topology()
+        self.world_size = topo.get_model_parallel_world_size()
+        if in_features % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"in_features {in_features} not divisible by mp degree "
+                f"{self.world_size}")
+        self.weight = self.create_parameter((in_features, out_features),
+                                            attr=weight_attr)
+        mark_sharding(self.weight, MP_AXIS, None)
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            mark_sharding(self.bias)            # replicated
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = constrain(x, *([None] * (x.ndim - 1) + [MP_AXIS]))
+        out = F.linear(x, self.weight, None)
+        out = constrain(out, None)               # psum happens here
+        if self.bias is not None:
+            from ..ops import api as _api
+            out = _api.add(out, self.bias)
+        return out
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table row-sharded over mp (mp_layers.py:47); lookup of
+    out-of-shard ids contributes zero and a psum combines shards — all
+    emitted by XLA from a gather on a row-sharded table."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        topo = get_topology()
+        self.world_size = topo.get_model_parallel_world_size()
+        if num_embeddings % max(self.world_size, 1) != 0:
+            raise ValueError("vocab size not divisible by mp degree")
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        mark_sharding(self.weight, MP_AXIS, None)
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return constrain(out, None)
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over mp-sharded logits (mp_layers.py:742 /
+    c_softmax_with_cross_entropy).  With logits constrained mp-sharded on
+    the class dim, XLA fuses the log-sum-exp psum; numerically identical to
+    the single-device loss."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, label):
+        logits = constrain(logits,
+                           *([None] * (logits.ndim - 1) + [MP_AXIS]))
+        return F.cross_entropy(logits, label, reduction="none",
+                               ignore_index=self.ignore_index)

@@ -1,0 +1,166 @@
+"""Pipeline parallelism.
+
+Reference: fleet/meta_parallel — ``PipelineLayer`` pp_layers.py:257
+(``LayerDesc`` :56, ``SharedLayerDesc`` :76), runtime ``PipelineParallel``
+pipeline_parallel.py:231 with 1F1B ``forward_backward_pipeline`` :547 and
+interleaved VPP :1138, p2p via partial send/recv ops.
+
+TPU-native design: the transformer block stack is *stacked* — one params
+pytree with leading dim [num_stages, layers_per_stage, ...] sharded over the
+``pp`` mesh axis — and the schedule is a ``lax.scan`` under ``shard_map``:
+each scan step every stage applies its block to its current microbatch and
+rotates activations to the next stage with ``lax.ppermute`` (the partial
+send/recv ops dissolve into one ICI collective-permute per step).  Autodiff
+through the scan gives the backward pipeline for free (ppermute's VJP is the
+reverse permute), with per-stage rematerialization via ``jax.checkpoint``
+bounding activation memory like 1F1B.  The reference needed an actor runtime
+(fleet_executor) + five schedule passes for this; here it is ~100 lines that
+XLA software-pipelines.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer, functional_call
+from .topology import PP_AXIS, get_topology
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "spmd_pipeline",
+           "pipeline_stack_specs"]
+
+
+class LayerDesc:
+    """Deferred layer construction (reference pp_layers.py:56)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer across stages (reference pp_layers.py:76, used for
+    tied embeddings).  On TPU tying is a pytree aliasing decision: the tied
+    weight lives outside the pipelined stack, replicated (or mp-sharded)
+    across pp, so no gradient all-reduce between first/last stage is
+    needed — XLA sums the contributions."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
+                 *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.key = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+def spmd_pipeline(stage_fn: Callable, stage_params: Any, microbatches,
+                  num_stages: int, axis_name: str = PP_AXIS,
+                  remat: bool = True):
+    """Run the scan-pipeline INSIDE a shard_map over ``axis_name``.
+
+    stage_fn(params_local, x) -> y : one pipeline stage's computation
+    stage_params: params pytree with leading stage dim already sliced to the
+      local stage (shard_map does the slicing via in_specs)
+    microbatches: [M, mb, ...] array, same on every stage (in_specs P(None))
+    returns [M, mb, ...] outputs valid on the LAST stage (callers psum or
+      ppermute them home).
+    """
+    M = microbatches.shape[0]
+    S = num_stages
+    stage = jax.lax.axis_index(axis_name)
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    state = jnp.zeros_like(microbatches[0])
+    outputs = jnp.zeros_like(microbatches)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def step(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (while t < M); others take the
+        # rotated activation from the previous stage
+        mb = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, M - 1), axis=0, keepdims=False)
+        x = jnp.where(stage == 0, mb, state)
+        y = fn(stage_params, x)
+        # last stage writes its finished microbatch t-(S-1)
+        out_idx = t - (S - 1)
+        write = (stage == S - 1) & (out_idx >= 0)
+        outputs = jax.lax.cond(
+            write,
+            lambda o: jax.lax.dynamic_update_index_in_dim(
+                o, y, jnp.maximum(out_idx, 0), axis=0),
+            lambda o: o, outputs)
+        # rotate activations forward one stage
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return (state, outputs), None
+
+    (state, outputs), _ = jax.lax.scan(step, (state, outputs),
+                                       jnp.arange(M + S - 1))
+    return outputs
+
+
+def pipeline_stack_specs(param_tree, axis_name: str = PP_AXIS):
+    """PartitionSpec for a stacked stage-param pytree: leading dim over pp."""
+    return jax.tree.map(
+        lambda v: P(axis_name, *([None] * (np.ndim(v) - 1))), param_tree)
+
+
+class PipelineLayer(Layer):
+    """API-parity container (reference pp_layers.py:257).
+
+    Built from LayerDescs, segmented into ``num_stages`` contiguous chunks
+    (seg_method="uniform" — layer-count balanced, matching the reference's
+    default :113).  Eager forward runs all stages sequentially (single
+    program semantics); the DistributedEngine detects a PipelineLayer and
+    can lower the homogeneous block stack through :func:`spmd_pipeline`.
+    """
+
+    def __init__(self, layers: List[LayerDesc], num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, name=None):
+        super().__init__()
+        topo = topology or get_topology()
+        self.num_stages = num_stages or topo.get_pipe_parallel_world_size()
+        self.descs = list(layers)
+        from ..nn.layer.container import LayerList
+        built = []
+        self.shared_layers = {}
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.key in self.shared_layers:
+                    layer = self.shared_layers[d.key]
+                else:
+                    layer = d.build()
+                    self.shared_layers[d.key] = layer
+                built.append((layer, getattr(d, "forward_func", None)))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build(), None))
+            else:
+                built.append((d, None))
+        self.runs = built
+        self.stack = LayerList([l for l, _ in built])
+        # uniform segmentation bounds (reference :113-134)
+        n = len(built)
+        per = int(np.ceil(n / self.num_stages))
+        self.segments = [(i * per, min((i + 1) * per, n))
+                         for i in range(self.num_stages)]
+        self.recompute_interval = recompute_interval
+
+    def forward(self, x):
+        for layer, ffn in self.runs:
+            x = ffn(layer, x) if ffn is not None else layer(x)
+        return x
+
+    def get_stage_layers(self, stage: int):
+        lo, hi = self.segments[stage]
+        return [l for l, _ in self.runs[lo:hi]]
