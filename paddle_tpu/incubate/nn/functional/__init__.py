@@ -1,0 +1,125 @@
+"""Fused functional APIs (reference: python/paddle/incubate/nn/functional —
+16 fused entry points).  Each dispatches to the Pallas kernel inventory."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....core.dispatch import run_op
+from ....core.tensor import Tensor
+from ....ops import pallas as _pk
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm",
+    "fused_bias_dropout_residual_layer_norm", "fused_rotary_position_embedding",
+    "fused_bias_act", "fused_dropout_add", "swiglu", "fused_linear",
+    "fused_linear_activation", "fused_multi_head_attention",
+]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None):
+    def impl(xv, w, nb, b, res):
+        if b is not None:
+            xv = xv + b
+        if res is not None:
+            xv = xv + res
+        out = _pk.rms_norm(xv, w, epsilon)
+        if nb is not None:
+            out = out + nb
+        return (out, xv) if res is not None else out
+    return run_op("fused_rms_norm", impl,
+                  (x, norm_weight, norm_bias, bias, residual), {})
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None):
+    def impl(xv, w, b, bias_v, res):
+        if bias_v is not None:
+            xv = xv + bias_v
+        if res is not None:
+            xv = xv + res
+        out = _pk.layer_norm(xv, w, b, epsilon)
+        return (out, xv) if res is not None else out
+    return run_op("fused_layer_norm", impl,
+                  (x, norm_weight, norm_bias, bias, residual), {})
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=False):
+    def impl(xv, res, b, w, lb):
+        if b is None:
+            b = jnp.zeros(xv.shape[-1], xv.dtype)
+        if w is None:
+            w = jnp.ones(xv.shape[-1], jnp.float32)
+        if lb is None:
+            lb = jnp.zeros(xv.shape[-1], jnp.float32)
+        out, _ = _pk.fused_bias_dropout_residual_layer_norm(
+            xv, res, b, w, lb, dropout_rate, ln_epsilon, training)
+        return out
+    return run_op("fused_bias_dropout_residual_ln", impl,
+                  (x, residual, bias, ln_scale, ln_bias), {})
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    def impl(qv, kv, vv, sv, cv, pid):
+        return _pk.fused_rope(qv, kv, vv, sv, cv, pid,
+                              use_neox_rotary_style)
+    return run_op("fused_rope", impl, (q, k, v, sin, cos, position_ids), {})
+
+
+def fused_bias_act(x, bias, act_method="gelu"):
+    return run_op("fused_bias_act",
+                  lambda xv, b: _pk.fused_bias_act(xv, b, act_method),
+                  (x, bias), {})
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    from ....core.rng import next_rng_key
+    import jax as _jax
+    seed = _jax.random.randint(next_rng_key(), (), 0, 2 ** 31 - 1)         if training and p > 0.0 else None
+    return run_op("fused_dropout_add",
+                  lambda xv, yv, sd: _pk.fused_dropout_add(
+                      xv, yv, p, training, seed=sd),
+                  (x, y, seed), {})
+
+
+def swiglu(x, y=None):
+    def impl(xv, yv):
+        if yv is None:
+            h = xv.shape[-1] // 2
+            xv, yv = xv[..., :h], xv[..., h:]
+        return _pk.swiglu(xv, yv)
+    return run_op("fused_swiglu", impl, (x, y), {})
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    def impl(xv, w, b):
+        if transpose_weight:
+            w = jnp.swapaxes(w, -2, -1)
+        out = jnp.matmul(xv, w)
+        if b is not None:
+            out = out + b
+        return out
+    return run_op("fused_linear", impl, (x, weight, bias), {})
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    def impl(xv, w, b):
+        if trans_x:
+            xv = jnp.swapaxes(xv, -2, -1)
+        if trans_y:
+            w = jnp.swapaxes(w, -2, -1)
+        return _pk.fused_bias_act(jnp.matmul(xv, w), b, activation)
+    return run_op("fused_linear_activation", impl, (x, y, bias), {})
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kwargs):
+    raise NotImplementedError(
+        "compose MultiHeadAttention (flash-attention backed) instead; "
+        "monolithic fused MHA arrives with the decode/inference module")

@@ -1,0 +1,10 @@
+"""Pallas TPU fused kernels (SURVEY §2.6 porting list)."""
+
+from .flash_attention import flash_attention, flash_attention_fwd  # noqa: F401
+from .fused import (  # noqa: F401
+    fused_bias_act, fused_dropout_add, fused_softmax_mask, swiglu,
+)
+from .norms import (  # noqa: F401
+    fused_bias_dropout_residual_layer_norm, layer_norm, rms_norm,
+)
+from .rope import fused_rope, rope_cos_sin  # noqa: F401

@@ -1,0 +1,189 @@
+"""Misc fused kernels: swiglu, fused softmax+mask, fused_bias_act,
+fused_dropout_add (SURVEY §2.6: kernels/swiglu_kernel.h,
+fusion/gpu/fused_softmax_mask_kernel.cu, fused_bias_act_kernel.cu,
+fused_dropout_add_kernel.cu)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .common import NEG_INF, use_interpret
+
+__all__ = ["swiglu", "fused_softmax_mask", "fused_bias_act",
+           "fused_dropout_add"]
+
+BLOCK_ROWS = 256
+
+
+def _row_grid(n_rows: int):
+    b = min(BLOCK_ROWS, n_rows)
+    while n_rows % b:
+        b //= 2
+    return max(b, 1), n_rows // max(b, 1)
+
+
+# ---------------------------------------------------------------------------
+# swiglu: silu(x) * y (one pass, no intermediate HBM roundtrip)
+# ---------------------------------------------------------------------------
+def _swiglu_kernel(x_ref, y_ref, o_ref):
+    x = x_ref[:].astype(jnp.float32)
+    y = y_ref[:].astype(jnp.float32)
+    o_ref[:] = (x * jax.nn.sigmoid(x) * y).astype(o_ref.dtype)
+
+
+def _swiglu_impl(x, y):
+    orig = x.shape
+    H = x.shape[-1]
+    x2 = x.reshape(-1, H)
+    y2 = y.reshape(-1, H)
+    R = x2.shape[0]
+    br, nr = _row_grid(R)
+    out = pl.pallas_call(
+        _swiglu_kernel,
+        grid=(nr,),
+        in_specs=[pl.BlockSpec((br, H), lambda i: (i, 0)),
+                  pl.BlockSpec((br, H), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((br, H), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, H), x.dtype),
+        interpret=use_interpret(),
+    )(x2, y2)
+    return out.reshape(orig)
+
+
+@jax.custom_vjp
+def swiglu(x, y):
+    return _swiglu_impl(x, y)
+
+
+def _swiglu_fwd(x, y):
+    return _swiglu_impl(x, y), (x, y)
+
+
+def _swiglu_bwd(res, g):
+    x, y = res
+    x32 = x.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    sig = jax.nn.sigmoid(x32)
+    silu = x32 * sig
+    dsilu = sig * (1 + x32 * (1 - sig))
+    return ((g32 * y.astype(jnp.float32) * dsilu).astype(x.dtype),
+            (g32 * silu).astype(y.dtype))
+
+
+swiglu.defvjp(_swiglu_fwd, _swiglu_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused softmax with additive mask (attention bias path)
+# ---------------------------------------------------------------------------
+def _softmax_mask_kernel(x_ref, m_ref, o_ref):
+    x = x_ref[:].astype(jnp.float32) + m_ref[:].astype(jnp.float32)
+    mx = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - mx)
+    o_ref[:] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+
+def fused_softmax_mask(x, mask):
+    """softmax(x + mask, axis=-1) in one VMEM pass.  x: [..., S]; mask
+    broadcastable to x."""
+    orig = x.shape
+    S = x.shape[-1]
+    x2 = x.reshape(-1, S)
+    m2 = jnp.broadcast_to(mask, x.shape).reshape(-1, S)
+    R = x2.shape[0]
+    br, nr = _row_grid(R)
+    out = pl.pallas_call(
+        _softmax_mask_kernel,
+        grid=(nr,),
+        in_specs=[pl.BlockSpec((br, S), lambda i: (i, 0)),
+                  pl.BlockSpec((br, S), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((br, S), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, S), x.dtype),
+        interpret=use_interpret(),
+    )(x2, m2)
+    return out.reshape(orig)
+
+
+# ---------------------------------------------------------------------------
+# fused bias + activation
+# ---------------------------------------------------------------------------
+_ACTS = {
+    "gelu": lambda x: jax.nn.gelu(x, approximate=True),
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+    "swiglu": None,  # handled by swiglu()
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def _bias_act_kernel(x_ref, b_ref, o_ref, *, act):
+    x = x_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    o_ref[:] = _ACTS[act](x).astype(o_ref.dtype)
+
+
+def fused_bias_act(x, bias, act_method: str = "gelu"):
+    if act_method == "swiglu":
+        h = x.shape[-1] // 2
+        xb = x + bias
+        return swiglu(xb[..., :h], xb[..., h:])
+    orig = x.shape
+    H = x.shape[-1]
+    x2 = x.reshape(-1, H)
+    R = x2.shape[0]
+    br, nr = _row_grid(R)
+    out = pl.pallas_call(
+        functools.partial(_bias_act_kernel, act=act_method),
+        grid=(nr,),
+        in_specs=[pl.BlockSpec((br, H), lambda i: (i, 0)),
+                  pl.BlockSpec((H,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((br, H), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, H), x.dtype),
+        interpret=use_interpret(),
+    )(x2, bias)
+    return out.reshape(orig)
+
+
+# ---------------------------------------------------------------------------
+# fused dropout + residual add
+# ---------------------------------------------------------------------------
+def _dropout_add_kernel(x_ref, y_ref, seed_ref, o_ref, *, p, training):
+    x = x_ref[:].astype(jnp.float32)
+    if training and p > 0.0:
+        pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+        bits = pltpu.prng_random_bits(x.shape)
+        u = (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+        x = jnp.where(u >= p, x / (1.0 - p), 0.0)
+    o_ref[:] = (x + y_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def fused_dropout_add(x, y, p: float = 0.5, training: bool = False,
+                      seed: Optional[int] = None, mode="upscale_in_train"):
+    orig = x.shape
+    H = x.shape[-1]
+    x2 = x.reshape(-1, H)
+    y2 = y.reshape(-1, H)
+    R = x2.shape[0]
+    br, nr = _row_grid(R)
+    if seed is None:
+        from ...core.rng import next_rng_key
+        seed = jax.random.randint(next_rng_key(), (), 0, 2 ** 31 - 1) \
+            if (training and p > 0.0) else 0
+    seed_arr = jnp.asarray(seed, jnp.int32).reshape(1)
+    out = pl.pallas_call(
+        functools.partial(_dropout_add_kernel, p=p, training=training),
+        grid=(nr,),
+        in_specs=[pl.BlockSpec((br, H), lambda i: (i, 0)),
+                  pl.BlockSpec((br, H), lambda i: (i, 0)),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec((br, H), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, H), x.dtype),
+        interpret=use_interpret(),
+    )(x2, y2, seed_arr)
+    return out.reshape(orig)

@@ -40,7 +40,7 @@ def _rms_kernel(x_ref, w_ref, o_ref, inv_ref, *, eps):
     ms = jnp.mean(x * x, axis=-1, keepdims=True)
     inv = jax.lax.rsqrt(ms + eps)
     o_ref[:] = (x * inv * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
-    inv_ref[:] = inv[:, 0]
+    inv_ref[:] = inv
 
 
 def _rms_fwd_impl(x, w, eps):
@@ -58,15 +58,15 @@ def _rms_fwd_impl(x, w, eps):
         ],
         out_specs=[
             pl.BlockSpec((br, H), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((R, H), x.dtype),
-            jax.ShapeDtypeStruct((R,), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
         ],
         interpret=use_interpret(),
     )(x2, w)
-    return out.reshape(orig_shape), inv
+    return out.reshape(orig_shape), inv[:, 0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -108,8 +108,8 @@ def _ln_kernel(x_ref, w_ref, b_ref, o_ref, mean_ref, inv_ref, *, eps):
     inv = jax.lax.rsqrt(var + eps)
     o_ref[:] = (xc * inv * w_ref[:].astype(jnp.float32)
                 + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
-    mean_ref[:] = mean[:, 0]
-    inv_ref[:] = inv[:, 0]
+    mean_ref[:] = mean
+    inv_ref[:] = inv
 
 
 def _ln_fwd_impl(x, w, b, eps):
@@ -128,17 +128,17 @@ def _ln_fwd_impl(x, w, b, eps):
         ],
         out_specs=[
             pl.BlockSpec((br, H), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((R, H), x.dtype),
-            jax.ShapeDtypeStruct((R,), jnp.float32),
-            jax.ShapeDtypeStruct((R,), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
         ],
         interpret=use_interpret(),
     )(x2, w, b)
-    return out.reshape(orig), mean, inv
+    return out.reshape(orig), mean[:, 0], inv[:, 0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -193,8 +193,8 @@ def _bdrl_kernel(x_ref, bias_ref, res_ref, w_ref, b_ref, seed_ref,
     inv = jax.lax.rsqrt(var + eps)
     o_ref[:] = (xc * inv * w_ref[:].astype(jnp.float32)
                 + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
-    mean_ref[:] = mean[:, 0]
-    inv_ref[:] = inv[:, 0]
+    mean_ref[:] = mean
+    inv_ref[:] = inv
 
 
 def fused_bias_dropout_residual_layer_norm(
@@ -212,7 +212,11 @@ def fused_bias_dropout_residual_layer_norm(
     r2 = residual.reshape(-1, H)
     R = x2.shape[0]
     br, nr = _row_grid(R)
-    seed_arr = jnp.asarray([seed if seed is not None else 0], jnp.int32)
+    if seed is None:
+        from ...core.rng import next_rng_key
+        seed = jax.random.randint(next_rng_key(), (), 0, 2 ** 31 - 1) \
+            if (training and dropout_rate > 0.0) else 0
+    seed_arr = jnp.asarray(seed, jnp.int32).reshape(1)
     out, addout, mean, inv = pl.pallas_call(
         functools.partial(_bdrl_kernel, eps=epsilon, p=dropout_rate,
                           training=training),
@@ -228,14 +232,14 @@ def fused_bias_dropout_residual_layer_norm(
         out_specs=[
             pl.BlockSpec((br, H), lambda i: (i, 0)),
             pl.BlockSpec((br, H), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((R, H), x.dtype),
             jax.ShapeDtypeStruct((R, H), x.dtype),
-            jax.ShapeDtypeStruct((R,), jnp.float32),
-            jax.ShapeDtypeStruct((R,), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
         ],
         interpret=use_interpret(),
     )(x2, bias, r2, ln_weight, ln_bias, seed_arr)

@@ -198,8 +198,12 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
         tensor._value = cat._value
         return tensor
     # single-controller semantics: every rank holds the same full tensor;
-    # scatter = take own chunk, reduce = sum over identical copies ⇒ scale
-    tensor._value = (cat._value[: cat.shape[0] // n] * n)
+    # reduce = sum over identical copies (scale by n); scatter = this
+    # process's chunk by its global rank
+    from .env import get_rank
+    r = get_rank() % n
+    chunk = cat.shape[0] // n
+    tensor._value = (cat._value[r * chunk:(r + 1) * chunk] * n)
     return tensor
 
 

@@ -76,10 +76,10 @@ class DistributedEngine:
 
     def _opt_state_specs(self, opt_state):
         specs = {}
+        named = dict(self.network.named_parameters())
         for pname, slots in opt_state.items():
-            base = getattr(
-                dict(self.network.named_parameters()).get(pname), "param_spec",
-                P()) if pname in self._trainable else P()
+            base = getattr(named.get(pname), "param_spec",
+                           P()) if pname in self._trainable else P()
             sspec = {}
             for sname, v in slots.items():
                 sspec[sname] = opt_state_spec_for(
@@ -169,8 +169,10 @@ class DistributedEngine:
 
             train_params = {n: v for n, v in params.items()
                             if n in trainable_names}
+            loss_fn_maybe_remat = (jax.checkpoint(compute_loss)
+                                   if self.recompute else compute_loss)
             (loss_v, new_buffers), grads = jax.value_and_grad(
-                compute_loss, has_aux=True)(train_params)
+                loss_fn_maybe_remat, has_aux=True)(train_params)
             new_train, new_opt = opt.apply_gradients(
                 train_params, grads, opt_state, lr, step_no)
             new_params = dict(params)
@@ -178,12 +180,9 @@ class DistributedEngine:
             kept = {n: new_buffers.get(n, v) for n, v in buffers.items()}
             return new_params, kept, new_opt, loss_v
 
-        if self.recompute:
-            step = jax.checkpoint(step, static_argnums=())  # coarse remat
-
+        named_params = dict(self.network.named_parameters())
         param_sh = {n: self._sharding(self.param_specs[n])
-                    for n in self.param_specs if n in
-                    dict(self.network.named_parameters())}
+                    for n in self.param_specs if n in named_params}
         buffer_sh = {n: self._sharding(P())
                      for n, b in self.network.named_buffers() if b is not None}
         opt_sh = {p: {s: self._sharding(sp) for s, sp in slots.items()}

@@ -149,11 +149,14 @@ class PipelineLayer(Layer):
                 built.append((d, None))
         self.runs = built
         self.stack = LayerList([l for l, _ in built])
-        # uniform segmentation bounds (reference :113-134)
+        # uniform segmentation with remainder spread over leading stages
+        # (reference seg_method="uniform", pp_layers.py:113-134)
         n = len(built)
-        per = int(np.ceil(n / self.num_stages))
-        self.segments = [(i * per, min((i + 1) * per, n))
-                         for i in range(self.num_stages)]
+        base, rem = divmod(n, self.num_stages)
+        bounds = [0]
+        for i in range(self.num_stages):
+            bounds.append(bounds[-1] + base + (1 if i < rem else 0))
+        self.segments = list(zip(bounds[:-1], bounds[1:]))
         self.recompute_interval = recompute_interval
 
     def forward(self, x):

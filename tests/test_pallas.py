@@ -1,0 +1,220 @@
+"""Pallas fused-kernel numeric tests vs pure-jnp references (OpTest
+strategy applied to the §2.6 kernel inventory).  Runs in interpret mode on
+the CPU mesh; identical code compiles on TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.ops import pallas as pk
+
+rng = np.random.default_rng(0)
+
+
+def _sdpa_ref(q, k, v, causal=False):
+    d = q.shape[-1]
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(d)
+    if causal:
+        S, Sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((S, Sk), bool), Sk - S)
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, -1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(128, 128), (256, 256), (200, 200),
+                                   (128, 256)])
+def test_flash_attention_forward(causal, sq, sk):
+    if causal and sq != sk:
+        pytest.skip("causal cross-length not used")
+    B, H, D = 2, 2, 64
+    q = rng.normal(size=(B, sq, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, sk, H, D)).astype(np.float32)
+    v = rng.normal(size=(B, sk, H, D)).astype(np.float32)
+    got = np.asarray(pk.flash_attention(q, k, v, None, causal))
+    exp = np.asarray(_sdpa_ref(q, k, v, causal))
+    np.testing.assert_allclose(got, exp, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grads(causal):
+    B, S, H, D = 1, 128, 2, 32
+    q = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    v = rng.normal(size=(B, S, H, D)).astype(np.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(pk.flash_attention(q, k, v, None, causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_sdpa_ref(q, k, v, causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=5e-3, atol=5e-3, err_msg=name)
+
+
+def test_flash_attention_grad_unaligned_seq():
+    B, S, H, D = 1, 100, 2, 32
+    q = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    v = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    g = jax.grad(lambda a, b, c: jnp.sum(
+        pk.flash_attention(a, b, c, None, False) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: jnp.sum(_sdpa_ref(a, b, c) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for gf, ge in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(ge),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_rms_norm_matches_reference():
+    x = rng.normal(size=(8, 64)).astype(np.float32)
+    w = rng.normal(size=(64,)).astype(np.float32)
+    got = np.asarray(pk.rms_norm(x, w, 1e-6))
+    ms = np.mean(x ** 2, -1, keepdims=True)
+    exp = x / np.sqrt(ms + 1e-6) * w
+    np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-5)
+
+    # grad check vs autodiff of the reference
+    def ref(x, w):
+        ms = jnp.mean(x ** 2, -1, keepdims=True)
+        return jnp.sum((x * jax.lax.rsqrt(ms + 1e-6) * w) ** 2)
+
+    g1 = jax.grad(lambda a, b: jnp.sum(pk.rms_norm(a, b, 1e-6) ** 2),
+                  argnums=(0, 1))(x, w)
+    g2 = jax.grad(ref, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(g1[0]), np.asarray(g2[0]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1[1]), np.asarray(g2[1]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_layer_norm_matches_reference():
+    x = rng.normal(size=(16, 32)).astype(np.float32)
+    w = rng.normal(size=(32,)).astype(np.float32)
+    b = rng.normal(size=(32,)).astype(np.float32)
+    got = np.asarray(pk.layer_norm(x, w, b, 1e-5))
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    exp = (x - mean) / np.sqrt(var + 1e-5) * w + b
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+    g1 = jax.grad(lambda a, ww, bb: jnp.sum(
+        pk.layer_norm(a, ww, bb, 1e-5) ** 3), argnums=(0, 1, 2))(x, w, b)
+
+    def ref(a, ww, bb):
+        m = jnp.mean(a, -1, keepdims=True)
+        v = jnp.var(a, -1, keepdims=True)
+        return jnp.sum(((a - m) * jax.lax.rsqrt(v + 1e-5) * ww + bb) ** 3)
+
+    g2 = jax.grad(ref, argnums=(0, 1, 2))(x, w, b)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_fused_rope_roundtrip_and_ref():
+    B, S, H, D = 2, 16, 2, 32
+    q = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    oq, ok, _ = pk.fused_rope(q, k)
+    # reference rotate-half
+    cos, sin = pk.rope_cos_sin(S, D)
+    cos = np.asarray(cos)[None, :, None, :]
+    sin = np.asarray(sin)[None, :, None, :]
+
+    def ref(x):
+        x1, x2 = x[..., :D // 2], x[..., D // 2:]
+        rot = np.concatenate([-x2, x1], -1)
+        return x * cos + rot * sin
+
+    np.testing.assert_allclose(np.asarray(oq), ref(q), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ok), ref(k), rtol=1e-4, atol=1e-5)
+
+    # VJP is the inverse rotation: grad of sum(rope(q)) == rope^-1(ones)
+    g = jax.grad(lambda x: jnp.sum(pk.fused_rope(x)[0] * q))(q)
+    g_ref = jax.grad(lambda x: jnp.sum(jnp.asarray(ref(np.ones_like(q))) * 0.
+                                       + x * 0.))(q)  # placeholder
+    # numeric check instead
+    def loss(x):
+        return jnp.sum(pk.fused_rope(x)[0] ** 2)
+    def loss_ref(x):
+        x1, x2 = x[..., :D // 2], x[..., D // 2:]
+        rot = jnp.concatenate([-x2, x1], -1)
+        return jnp.sum((x * cos + rot * sin) ** 2)
+    np.testing.assert_allclose(np.asarray(jax.grad(loss)(q)),
+                               np.asarray(jax.grad(loss_ref)(q)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_swiglu_and_grad():
+    x = rng.normal(size=(8, 32)).astype(np.float32)
+    y = rng.normal(size=(8, 32)).astype(np.float32)
+    got = np.asarray(pk.swiglu(x, y))
+    exp = x / (1 + np.exp(-x)) * y
+    np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
+    g1 = jax.grad(lambda a, b: jnp.sum(pk.swiglu(a, b) ** 2),
+                  argnums=(0, 1))(x, y)
+    g2 = jax.grad(lambda a, b: jnp.sum((jax.nn.silu(a) * b) ** 2),
+                  argnums=(0, 1))(x, y)
+    np.testing.assert_allclose(np.asarray(g1[0]), np.asarray(g2[0]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1[1]), np.asarray(g2[1]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_softmax_mask():
+    x = rng.normal(size=(2, 4, 8, 16)).astype(np.float32)
+    mask = np.where(rng.random((2, 1, 8, 16)) > 0.3, 0.0, -1e30).astype(
+        np.float32)
+    got = np.asarray(pk.fused_softmax_mask(x, mask))
+    exp = np.asarray(jax.nn.softmax(x + mask, -1))
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-6)
+
+
+def test_fused_bias_act():
+    x = rng.normal(size=(8, 32)).astype(np.float32)
+    b = rng.normal(size=(32,)).astype(np.float32)
+    got = np.asarray(pk.fused_bias_act(x, b, "gelu"))
+    exp = np.asarray(jax.nn.gelu(x + b, approximate=True))
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_bias_dropout_residual_ln_eval():
+    x = rng.normal(size=(8, 32)).astype(np.float32)
+    res = rng.normal(size=(8, 32)).astype(np.float32)
+    bias = rng.normal(size=(32,)).astype(np.float32)
+    w = np.ones(32, np.float32)
+    b = np.zeros(32, np.float32)
+    out, addout = pk.fused_bias_dropout_residual_layer_norm(
+        x, res, bias, w, b, dropout_rate=0.0, training=False)
+    pre = x + bias + res
+    np.testing.assert_allclose(np.asarray(addout), pre, rtol=1e-5, atol=1e-5)
+    mean = pre.mean(-1, keepdims=True)
+    var = pre.var(-1, keepdims=True)
+    exp = (pre - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(np.asarray(out), exp, rtol=1e-4, atol=1e-5)
+
+
+def test_incubate_api_dispatch():
+    from paddle_tpu.incubate.nn import functional as IF
+    x = pt.to_tensor(rng.normal(size=(4, 64)).astype(np.float32))
+    w = pt.to_tensor(np.ones(64, np.float32))
+    out = IF.fused_rms_norm(x, w)
+    assert out.shape == [4, 64]
+    q = pt.to_tensor(rng.normal(size=(1, 8, 2, 16)).astype(np.float32))
+    oq, ok, ov = IF.fused_rotary_position_embedding(q)
+    assert oq.shape == [1, 8, 2, 16]
+    s = IF.swiglu(pt.to_tensor(rng.normal(size=(4, 32)).astype(np.float32)))
+    assert s.shape == [4, 16]
