@@ -231,8 +231,12 @@ def block_param_specs(cfg: GPTConfig, pipeline: bool) -> Dict[str, P]:
 
 
 def block_apply(params: Dict[str, jax.Array], x: jax.Array,
-                cfg: GPTConfig) -> jax.Array:
-    """One transformer block, pure jnp (used stacked under lax.scan)."""
+                cfg: GPTConfig, attn_fn=None) -> jax.Array:
+    """One transformer block, pure jnp (used stacked under lax.scan).
+
+    ``attn_fn(q, k, v) -> out`` (all [b, s, heads, head_dim]) overrides the
+    attention op — used for ring/Ulysses context parallelism where the seq
+    dim is a manual mesh axis (parallel/context_parallel.py)."""
     b, s, h = x.shape
 
     def ln(v, w, bia):
@@ -245,12 +249,15 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
     qkv = y @ params["qkv_w"] + params["qkv_b"]
     qkv = qkv.reshape(b, s, cfg.num_heads, 3 * cfg.head_dim)
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    scale = 1.0 / math.sqrt(cfg.head_dim)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
-    probs = jax.nn.softmax(logits, -1).astype(x.dtype)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, h)
+    if attn_fn is not None:
+        attn = attn_fn(q, k, v).reshape(b, s, h)
+    else:
+        scale = 1.0 / math.sqrt(cfg.head_dim)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+        probs = jax.nn.softmax(logits, -1).astype(x.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, h)
     x = res + attn @ params["proj_w"] + params["proj_b"]
     res = x
     y = ln(x, params["ln2_w"], params["ln2_b"])
@@ -271,8 +278,14 @@ def stack_block_params(cfg: GPTConfig, key, num_stages: int
 
 def build_gpt_train_step(cfg: GPTConfig, topo=None,
                          num_microbatches: int = 4,
-                         learning_rate: float = 1e-4):
-    """Compile a full hybrid-parallel GPT training step: dp×mp×pp×sp.
+                         learning_rate: float = 1e-4,
+                         cp_mode: str = None):
+    """Compile a full hybrid-parallel GPT training step: dp×mp×pp×sp×cp.
+
+    ``cp_mode``: None (GSPMD sequence sharding via constraint), "ring"
+    (ring flash attention over the sep axis) or "ulysses" (all-to-all heads
+    swap) — the explicit context-parallel paths; see
+    parallel/context_parallel.py.
 
     Returns (step_fn, init_fn):
       init_fn(seed) -> state pytree placed on the mesh
@@ -291,6 +304,23 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
     per = cfg.num_layers // S
     data_axes = tuple(a for a in (DP_AXIS, SHARDING_AXIS)
                       if topo.axis_size(a) > 1) or (DP_AXIS,)
+    sep = topo.get_sep_parallel_world_size()
+    if cp_mode not in (None, "ring", "ulysses"):
+        raise ValueError(f"unknown cp_mode {cp_mode!r}")
+    if cp_mode == "ulysses" and cfg.num_heads % sep != 0:
+        raise ValueError("ulysses needs num_heads % sep == 0")
+    use_cp = cp_mode is not None and sep > 1
+    if use_cp:
+        from ..parallel.context_parallel import (
+            ring_flash_attention, ulysses_attention)
+        if cp_mode == "ring":
+            def cp_attn(q, k, v):
+                return ring_flash_attention(q, k, v, SEP_AXIS, True)
+        else:
+            def cp_attn(q, k, v):
+                return ulysses_attention(q, k, v, SEP_AXIS, True)
+    else:
+        cp_attn = None
 
     def sh(spec):
         return NamedSharding(mesh, spec)
@@ -342,7 +372,8 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                 local = jax.tree.map(lambda v: v[0], blk_local)
 
                 def body(carry, layer_params):
-                    return block_apply(layer_params, carry, cfg), None
+                    return block_apply(layer_params, carry, cfg,
+                                       cp_attn), None
                 out, _ = jax.lax.scan(body, h, local)
                 return out
 
@@ -355,19 +386,35 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
 
             blk_in_specs = jax.tree.map(lambda _: P(PP_AXIS),
                                         params["blocks"])
+            mb_spec = P(None, None, SEP_AXIS, None) if use_cp else P(None)
+            axis_names = {PP_AXIS, SEP_AXIS} if use_cp else {PP_AXIS}
             x = jax.shard_map(
                 pp_inner, mesh=mesh,
-                in_specs=(blk_in_specs, P(None)),
-                out_specs=P(None), axis_names={PP_AXIS},
+                in_specs=(blk_in_specs, mb_spec),
+                out_specs=mb_spec, axis_names=axis_names,
                 check_vma=False)(params["blocks"], mbs)
             x = x.reshape(b, s, cfg.hidden_size)
         else:
-            def body(carry, layer_params):
-                return block_apply(layer_params, carry, cfg), None
             flat_blocks = jax.tree.map(
                 lambda v: v.reshape((cfg.num_layers,) + v.shape[2:]),
                 params["blocks"])
-            x, _ = jax.lax.scan(body, x, flat_blocks)
+            if use_cp:
+                def blocks_inner(blk, x_local):
+                    def body(carry, layer_params):
+                        return block_apply(layer_params, carry, cfg,
+                                           cp_attn), None
+                    out, _ = jax.lax.scan(body, x_local, blk)
+                    return out
+                blk_specs_in = jax.tree.map(lambda _: P(), flat_blocks)
+                x = jax.shard_map(
+                    blocks_inner, mesh=mesh,
+                    in_specs=(blk_specs_in, P(None, SEP_AXIS, None)),
+                    out_specs=P(None, SEP_AXIS, None),
+                    axis_names={SEP_AXIS}, check_vma=False)(flat_blocks, x)
+            else:
+                def body(carry, layer_params):
+                    return block_apply(layer_params, carry, cfg), None
+                x, _ = jax.lax.scan(body, x, flat_blocks)
 
         mean = jnp.mean(x, -1, keepdims=True)
         var = jnp.var(x, -1, keepdims=True)

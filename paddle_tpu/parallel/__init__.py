@@ -15,6 +15,7 @@ from .collective import (  # noqa: F401
     Group, ReduceOp, all_gather, all_reduce, all_to_all, barrier, broadcast,
     get_group, new_group, reduce, reduce_scatter, scatter,
 )
+from .context_parallel import ring_flash_attention, ulysses_attention  # noqa: F401
 from .engine import DistributedEngine  # noqa: F401
 from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized  # noqa: F401
 from .mp_layers import (  # noqa: F401

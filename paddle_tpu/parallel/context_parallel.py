@@ -1,0 +1,225 @@
+"""Context parallelism (CP): ring flash attention + Ulysses all-to-all.
+
+The reference snapshot has NO ring/Ulysses implementation (SURVEY §2.5 "CP /
+ring attention / Ulysses — NOT present"); its long-sequence story is
+Megatron-SP + SEP + FlashAttention.  This module supplies the missing
+capability TPU-first: the sequence dimension is a mesh axis (``sep``), KV
+blocks rotate over the ICI ring via ``jax.lax.ppermute`` (ring attention), or
+heads<->sequence swap via ``jax.lax.all_to_all`` (Ulysses / DeepSpeed-style).
+
+Both entry points are designed to be called INSIDE ``jax.shard_map`` with the
+sequence dimension sharded over ``axis_name``:
+
+    q, k, v : [batch, seq_local, heads, head_dim]   (paddle flash layout)
+
+``ring_flash_attention`` is a ``jax.custom_vjp``: the forward carries the
+online-softmax state (m, l, acc) across ring steps; the backward replays the
+ring, rotating (k, v, dk, dv) together so each chunk's gradient lands back on
+its owner after exactly ``axis_size`` hops.  Causal steps whose KV chunk lies
+entirely in the masked future are skipped via ``lax.cond``.  Math follows the
+blockwise-parallel scheme of the public RingAttention formulation
+(PAPERS.md), computed in fp32.
+
+``ulysses_attention`` is automatically differentiable (all_to_all has a
+transpose rule); it requires num_heads % axis_size == 0.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.pallas.common import NEG_INF
+
+__all__ = ["ring_flash_attention", "ulysses_attention"]
+
+
+def _ring_perm(n: int):
+    # send local KV chunk to the next rank; after s hops rank i holds
+    # chunk (i - s) mod n
+    return [(j, (j + 1) % n) for j in range(n)]
+
+
+def _masked_logits(q, k, *, scale, causal, my_idx, kv_idx, seq_local):
+    # q, k: [B, H, S, D] fp32 -> logits [B, H, S, S]
+    s = lax.dot_general(q, k, (((3,), (3,)), ((0, 1), (0, 1))),
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = my_idx * seq_local + lax.broadcasted_iota(
+            jnp.int32, (seq_local, seq_local), 0)
+        k_pos = kv_idx * seq_local + lax.broadcasted_iota(
+            jnp.int32, (seq_local, seq_local), 1)
+        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+    return s
+
+
+def _ring_fwd_loop(q, k, v, scale, causal, axis_name, axis_size):
+    """q/k/v: [B, H, S, D] (local shard).  Returns (out, lse) fp32."""
+    B, H, S, D = q.shape
+    my_idx = lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32)
+    perm = _ring_perm(axis_size)
+
+    def compute(s_i, m, l, acc, kc, vc):
+        kv_idx = (my_idx - s_i) % axis_size
+        logits = _masked_logits(qf, kc.astype(jnp.float32), scale=scale,
+                                causal=causal, my_idx=my_idx, kv_idx=kv_idx,
+                                seq_local=S)
+        m_cur = jnp.max(logits, -1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + jnp.sum(p, -1, keepdims=True)
+        acc = acc * alpha + lax.dot_general(
+            p, vc.astype(jnp.float32), (((3,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    def step(s_i, carry):
+        m, l, acc, kc, vc = carry
+        if causal:
+            # chunks strictly in the masked future contribute nothing
+            kv_idx = (my_idx - s_i) % axis_size
+            m, l, acc = lax.cond(
+                kv_idx <= my_idx,
+                lambda: compute(s_i, m, l, acc, kc, vc),
+                lambda: (m, l, acc))
+        else:
+            m, l, acc = compute(s_i, m, l, acc, kc, vc)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return m, l, acc, kc, vc
+
+    init = (jnp.full((B, H, S, 1), NEG_INF, jnp.float32),
+            jnp.zeros((B, H, S, 1), jnp.float32),
+            jnp.zeros((B, H, S, D), jnp.float32), k, v)
+    m, l, acc, _, _ = lax.fori_loop(0, axis_size, step, init)
+    l = jnp.maximum(l, 1e-30)
+    return acc / l, m + jnp.log(l)
+
+
+def _ring_bwd_loop(q, k, v, out, lse, do, scale, causal, axis_name,
+                   axis_size):
+    """Backward ring: dq stays local; (k, v, dk, dv) rotate together so each
+    KV chunk accumulates its gradient from every rank and arrives home after
+    axis_size hops."""
+    B, H, S, D = q.shape
+    my_idx = lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(out * dof, -1, keepdims=True)   # [B, H, S, 1] fp32
+    perm = _ring_perm(axis_size)
+
+    def compute(s_i, dq, kc, vc, dk, dv):
+        kv_idx = (my_idx - s_i) % axis_size
+        kf = kc.astype(jnp.float32)
+        vf = vc.astype(jnp.float32)
+        logits = _masked_logits(qf, kf, scale=scale, causal=causal,
+                                my_idx=my_idx, kv_idx=kv_idx, seq_local=S)
+        p = jnp.exp(logits - lse)                    # [B, H, S, Sk]
+        dv = dv + lax.dot_general(p, dof, (((2,), (2,)), ((0, 1), (0, 1))),
+                                  preferred_element_type=jnp.float32)
+        dp = lax.dot_general(dof, vf, (((3,), (3,)), ((0, 1), (0, 1))),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq = dq + lax.dot_general(ds, kf, (((3,), (2,)), ((0, 1), (0, 1))),
+                                  preferred_element_type=jnp.float32)
+        dk = dk + lax.dot_general(ds, qf, (((2,), (2,)), ((0, 1), (0, 1))),
+                                  preferred_element_type=jnp.float32)
+        return dq, dk, dv
+
+    def step(s_i, carry):
+        dq, kc, vc, dk, dv = carry
+        if causal:
+            kv_idx = (my_idx - s_i) % axis_size
+            dq, dk, dv = lax.cond(
+                kv_idx <= my_idx,
+                lambda: compute(s_i, dq, kc, vc, dk, dv),
+                lambda: (dq, dk, dv))
+        else:
+            dq, dk, dv = compute(s_i, dq, kc, vc, dk, dv)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+        return dq, kc, vc, dk, dv
+
+    init = (jnp.zeros((B, H, S, D), jnp.float32), k, v,
+            jnp.zeros((B, H, S, D), jnp.float32),
+            jnp.zeros((B, H, S, D), jnp.float32))
+    dq, _, _, dk, dv = lax.fori_loop(0, axis_size, step, init)
+    return dq, dk, dv
+
+
+def _resolved_scale(scale, d):
+    return scale if scale is not None else 1.0 / math.sqrt(d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_flash_attention(q, k, v, axis_name: str, causal: bool = True,
+                         scale: Optional[float] = None):
+    """Ring attention over a sharded sequence dimension.
+
+    Call inside ``shard_map`` with q/k/v [B, seq_local, H, D] sharded on the
+    seq dim over ``axis_name`` (size derived via ``lax.axis_size``).  Exact
+    (not approximate): equivalent to full softmax attention over the global
+    sequence.  ``causal`` masks with GLOBAL positions.
+    """
+    return _ring_fwd_rule(q, k, v, axis_name, causal, scale)[0]
+
+
+def _ring_fwd_rule(q, k, v, axis_name, causal, scale):
+    s = _resolved_scale(scale, q.shape[-1])
+    axis_size = lax.axis_size(axis_name)
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    out, lse = _ring_fwd_loop(qt, kt, vt, s, causal, axis_name, axis_size)
+    return (jnp.swapaxes(out, 1, 2).astype(q.dtype),
+            (q, k, v, out, lse))
+
+
+def _ring_bwd_rule(axis_name, causal, scale, res, g):
+    q, k, v, out, lse = res
+    s = _resolved_scale(scale, q.shape[-1])
+    axis_size = lax.axis_size(axis_name)
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    dot_ = jnp.swapaxes(g, 1, 2)
+    dq, dk, dv = _ring_bwd_loop(qt, kt, vt, out, lse, dot_, s, causal,
+                                axis_name, axis_size)
+    return (jnp.swapaxes(dq, 1, 2).astype(q.dtype),
+            jnp.swapaxes(dk, 1, 2).astype(k.dtype),
+            jnp.swapaxes(dv, 1, 2).astype(v.dtype))
+
+
+ring_flash_attention.defvjp(_ring_fwd_rule, _ring_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all sequence parallelism)
+# ---------------------------------------------------------------------------
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
+                      scale: Optional[float] = None):
+    """All-to-all context parallelism (Ulysses).
+
+    Inside shard_map with seq sharded over ``axis_name``: swaps the sharded
+    dim from seq to heads (all_to_all), runs full flash attention on the
+    complete sequence locally, swaps back.  Requires
+    num_heads % axis_size == 0.  Fully differentiable (all_to_all transposes
+    to all_to_all).
+    """
+    axis_size = lax.axis_size(axis_name)
+    if q.shape[2] % axis_size != 0:
+        raise ValueError(
+            f"ulysses_attention needs num_heads ({q.shape[2]}) divisible by "
+            f"axis size ({axis_size})")
+    from ..ops.pallas.flash_attention import flash_attention
+    # [B, S_loc, H, D] -> [B, S_full, H_loc, D]
+    qg, kg, vg = (lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                 tiled=True) for x in (q, k, v))
+    out = flash_attention(qg, kg, vg, scale, causal)
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
