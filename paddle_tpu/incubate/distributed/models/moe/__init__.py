@@ -1,0 +1,9 @@
+"""Mixture-of-experts (expert parallelism) — reference surface
+python/paddle/incubate/distributed/models/moe."""
+
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+from .gating import compute_capacity, gshard_aux_loss, topk_capacity_gating  # noqa: F401
+from .moe_layer import MoELayer, expert_alltoall  # noqa: F401
+from .utils import (  # noqa: F401
+    limit_by_capacity, number_count, prune_gate_by_capacity, random_routing,
+)

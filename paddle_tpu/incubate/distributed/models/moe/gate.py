@@ -1,0 +1,116 @@
+"""Gate layers: Naive / GShard (top-2) / Switch (top-1).
+
+Reference surface: python/paddle/incubate/distributed/models/moe/gate/
+{base_gate,naive_gate,gshard_gate,switch_gate}.py.  Semantics mirrored:
+per-expert capacity = ceil(cap_rate * num_tokens) with a (train, eval)
+cap_rate pair (gshard_gate.py:67-68, switch_gate.py:60-61), Switch adds
+uniform routing noise in [1-eps, 1+eps] to the scores during training
+(switch_gate.py:52-55), GShard keeps the 2nd expert with probability 2*w2
+(random routing).  Each gate owns the router linear and produces dense
+(combine, dispatch, aux_loss) via :mod:`.gating` instead of index lists +
+CUDA scatter kernels.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..... import nn
+from .....core.rng import next_rng_key
+from .gating import topk_capacity_gating
+
+__all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
+
+
+class BaseGate(nn.Layer):
+    def __init__(self, d_model: int, num_experts: int):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self._loss = None
+
+    def get_loss(self):
+        """Aux load-balance loss of the last forward."""
+        return self._loss
+
+
+class NaiveGate(BaseGate):
+    """Plain top-k softmax routing without capacity dropping
+    (naive_gate.py): capacity equals the token count."""
+
+    def __init__(self, d_model: int, num_experts: int, top_k: int = 2,
+                 capacity: Optional[Tuple[float, float]] = None,
+                 normalize: bool = True, random_routing: bool = False,
+                 switch_eps: float = 0.0):
+        super().__init__(d_model, num_experts)
+        self.top_k = top_k
+        self.capacity = capacity          # (train, eval) cap_rate or None
+        self.normalize = normalize
+        self.random_routing = random_routing
+        self.switch_eps = switch_eps
+        self.weight = self.create_parameter((d_model, num_experts))
+
+    @property
+    def needs_rng(self) -> bool:
+        return self.random_routing or self.switch_eps > 0.0
+
+    def expert_capacity(self, num_tokens: int) -> int:
+        if self.capacity is None:
+            return max(num_tokens, 1)     # no dropping
+        cap_rate = self.capacity[0 if self.training else 1]
+        return max(math.ceil(cap_rate * num_tokens), self.top_k)
+
+    def gate_impl(self, x, weight, rng_key=None):
+        """Pure function: tokens [T, H] -> (combine, dispatch, aux)."""
+        T = x.shape[0]
+        logits = x.astype(jnp.float32) @ weight.astype(jnp.float32)
+        route_key = None
+        if rng_key is not None and self.training:
+            noise_key, route_key = jax.random.split(rng_key)
+            if self.switch_eps > 0.0:
+                # switch_gate.py:52-55 — additive uniform noise in
+                # [1-eps, 1+eps]
+                noise = jax.random.uniform(noise_key, logits.shape) \
+                    * 2.0 * self.switch_eps + 1.0 - self.switch_eps
+                logits = logits + noise
+            if not self.random_routing:
+                route_key = None
+        return topk_capacity_gating(
+            logits, self.top_k, self.expert_capacity(T),
+            normalize=self.normalize, second_expert_key=route_key)
+
+    def forward(self, x):
+        key = next_rng_key() if (self.needs_rng and self.training) else None
+        combine, dispatch, aux = self.gate_impl(
+            jnp.asarray(getattr(x, "_value", x)).reshape(-1, self.d_model),
+            self.weight._value, key)
+        self._loss = aux
+        return combine, dispatch, aux
+
+
+class GShardGate(NaiveGate):
+    """Top-2 with capacity + random routing (gshard_gate.py)."""
+
+    def __init__(self, d_model: int, num_experts: int, top_k: int = 2,
+                 capacity: Tuple[float, float] = (1.2, 2.4),
+                 random_routing: bool = True):
+        assert top_k == 2, "topk should be 2 in gshard"
+        super().__init__(d_model, num_experts, top_k=top_k,
+                         capacity=capacity, normalize=True,
+                         random_routing=random_routing)
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 Switch-Transformer gate with training noise (switch_gate.py)."""
+
+    def __init__(self, d_model: int, num_experts: int, top_k: int = 1,
+                 capacity: Tuple[float, float] = (1.2, 2.4),
+                 switch_eps: float = 0.1):
+        assert top_k == 1, "topk should be 1 in switch"
+        super().__init__(d_model, num_experts, top_k=top_k,
+                         capacity=capacity, normalize=False,
+                         switch_eps=switch_eps)

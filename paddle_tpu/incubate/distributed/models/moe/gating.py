@@ -1,0 +1,101 @@
+"""Functional MoE gating — pure jnp, jit/shard_map friendly.
+
+TPU-native replacement for the reference's gate implementations
+(python/paddle/incubate/distributed/models/moe/gate/{gshard,switch,naive}_gate.py)
+and their CUDA aux ops.  Instead of the reference's dynamic
+global_scatter/global_gather (variable token counts per expert —
+fluid/operators/collective/global_scatter_op.cu), gating here produces dense
+fixed-capacity dispatch/combine tensors so the whole MoE layer is static-
+shaped einsums that XLA can tile onto the MXU and auto-all_to_all when the
+expert dim is mesh-sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["topk_capacity_gating", "gshard_aux_loss", "compute_capacity"]
+
+
+def compute_capacity(num_tokens: int, num_experts: int, top_k: int,
+                     capacity_factor: float) -> int:
+    """Per-expert token slots: ceil(T * k * factor / E) (GShard recipe).
+    Note the reference's gates use the looser ceil(cap_rate * T) instead —
+    see NaiveGate.expert_capacity."""
+    import math
+    return max(math.ceil(num_tokens * top_k * capacity_factor / num_experts),
+               top_k)
+
+
+def gshard_aux_loss(probs: jax.Array, top1: jax.Array) -> jax.Array:
+    """GShard load-balance loss: E * Σ_e mean(prob_e) * frac_tokens_e."""
+    E = probs.shape[-1]
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top1, E, dtype=probs.dtype), axis=0)
+    return E * jnp.sum(me * ce)
+
+
+def topk_capacity_gating(
+        logits: jax.Array, top_k: int, capacity: int,
+        normalize: bool = True,
+        second_expert_key: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k gating with per-expert capacity.
+
+    Args:
+      logits: [T, E] router logits (any float dtype; softmax in fp32).
+      top_k: experts per token (1 = Switch, 2 = GShard).
+      capacity: token slots per expert; overflow tokens are dropped
+        (the jnp equivalent of the reference's limit_by_capacity /
+        prune_gate_by_capacity kernels).
+      normalize: renormalize the k gate weights to sum to 1 (GShard);
+        Switch keeps the raw top-1 probability.
+      second_expert_key: optional PRNG key — apply GShard's random routing:
+        the 2nd expert is kept with probability 2*w2 (else dropped).
+
+    Returns:
+      combine:  [T, E, C] float — combine weights (0 where not dispatched).
+      dispatch: [T, E, C] bool — dispatch mask (combine > 0).
+      aux_loss: scalar load-balance loss.
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    aux_loss = gshard_aux_loss(probs, jnp.argmax(probs, axis=-1))
+
+    counts = jnp.zeros((E,), jnp.float32)        # kept tokens per expert
+    remaining = probs
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    total_w = jnp.zeros((T,), jnp.float32)
+
+    for j in range(top_k):
+        idx_j = jnp.argmax(remaining, axis=-1)               # [T]
+        oh = jax.nn.one_hot(idx_j, E, dtype=jnp.float32)     # [T, E]
+        w_j = jnp.sum(probs * oh, axis=-1)                   # [T]
+        if j == 1 and second_expert_key is not None:
+            # random routing (reference utils.random_routing): keep the
+            # second expert only with probability 2*w2
+            keep2 = jax.random.uniform(second_expert_key, (T,)) < 2.0 * w_j
+            oh = oh * keep2[:, None]
+            w_j = w_j * keep2
+        # position of each token within its expert's buffer, counting only
+        # previously-kept tokens
+        pos_j = jnp.sum((jnp.cumsum(oh, axis=0) - oh) * oh, axis=-1) \
+            + jnp.sum(counts[None] * oh, axis=-1)            # [T]
+        keep = (pos_j < capacity) & (jnp.sum(oh, -1) > 0)
+        w_kept = w_j * keep
+        loc = jax.nn.one_hot(
+            jnp.clip(pos_j, 0, capacity - 1).astype(jnp.int32), capacity,
+            dtype=jnp.float32)                               # [T, C]
+        combine = combine + (w_kept[:, None, None] * oh[:, :, None]
+                             * loc[:, None, :])
+        counts = counts + jnp.sum(oh * keep[:, None], axis=0)
+        total_w = total_w + w_kept
+        remaining = remaining * (1.0 - oh)
+
+    if normalize and top_k > 1:
+        combine = combine / jnp.maximum(total_w, 1e-9)[:, None, None]
+    dispatch = combine > 0.0
+    return combine, dispatch, aux_loss

@@ -1,0 +1,132 @@
+"""MoELayer — mixture-of-experts FFN with expert parallelism.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:263.
+The reference routes tokens with CUDA global_scatter/global_gather collectives
+(variable counts per expert).  TPU-native design: gating emits dense
+fixed-capacity combine/dispatch tensors (gating.py), the expert FFN is one
+batched einsum over [E, C, ...], and expert parallelism is a sharding
+annotation on the E dim — under jit XLA lowers the dispatch/combine einsums
+to all_to_all over the mesh axis.  An explicit shard_map helper
+(:func:`expert_alltoall`) covers the manual path (parity with
+global_scatter/global_gather, distributed/utils/moe_utils.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..... import nn
+from .....core.dispatch import run_op
+from .gate import BaseGate, NaiveGate, SwitchGate, GShardGate  # noqa: F401
+
+__all__ = ["MoELayer", "expert_alltoall"]
+
+_GATES = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}
+
+
+def expert_alltoall(expert_in: jax.Array, axis_name: str) -> jax.Array:
+    """Manual EP dispatch inside shard_map: [E_local*ep_chunk ...] rearrange.
+
+    Input  [E, C, H] with tokens for ALL experts (locally gathered),
+    sharded call: each rank holds its local tokens' slots for every expert;
+    all_to_all swaps so each rank holds ALL ranks' slots for its LOCAL
+    experts: [E/ep, C*ep, H].  The inverse is the same call with split/concat
+    swapped — global_scatter/global_gather parity
+    (python/paddle/distributed/utils/moe_utils.py).
+    """
+    return lax.all_to_all(expert_in, axis_name, split_axis=0, concat_axis=1,
+                          tiled=True)
+
+
+class MoELayer(nn.Layer):
+    """Mixture of experts over a gated FFN bank.
+
+    Args:
+      d_model: hidden size.
+      d_hidden: expert FFN inner size.
+      num_experts: global expert count.
+      gate: "gshard" | "switch" | "naive" or a BaseGate instance.
+      top_k: experts per token (overrides the gate default).
+      activation: expert nonlinearity (default gelu).
+      ep_axis: optional mesh axis name — expert dim sharded over it via
+        with_sharding_constraint (GSPMD inserts the all_to_alls).
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 gate="gshard", top_k: Optional[int] = None,
+                 activation: Callable = jax.nn.gelu,
+                 ep_axis: Optional[str] = None):
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.activation = activation
+        self.ep_axis = ep_axis
+        if isinstance(gate, str):
+            gate = _GATES[gate](d_model, num_experts,
+                                **({"top_k": top_k} if top_k else {}))
+        assert isinstance(gate, BaseGate)
+        self.gate = gate
+        E, H, F = num_experts, d_model, d_hidden
+        self.w1 = self.create_parameter((E, H, F))
+        self.b1 = self.create_parameter((E, F), is_bias=True)
+        self.w2 = self.create_parameter((E, F, H))
+        self.b2 = self.create_parameter((E, H), is_bias=True)
+
+    def _constrain(self, x):
+        if self.ep_axis is None:
+            return x
+        from .....parallel.topology import get_topology
+        try:
+            mesh = get_topology().mesh
+        except Exception:
+            return x
+        if self.ep_axis not in mesh.axis_names:
+            return x
+        spec = [None] * x.ndim
+        spec[0] = self.ep_axis
+        return lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(*spec)))
+
+    def expert_ffn(self, expert_in, w1, b1, w2, b2):
+        """[E, C, H] -> [E, C, H], batched over experts (one big MXU op)."""
+        h = jnp.einsum("ech,ehf->ecf", expert_in, w1) + b1[:, None, :]
+        h = self.activation(h)
+        return jnp.einsum("ecf,efh->ech", h, w2) + b2[:, None, :]
+
+    def moe_impl(self, x, gate_w, w1, b1, w2, b2, rng_key=None):
+        """Pure function: x [..., H] -> (out [..., H], aux_loss)."""
+        shape = x.shape
+        tokens = x.reshape(-1, self.d_model)
+        combine, dispatch, aux = self.gate.gate_impl(tokens, gate_w, rng_key)
+        dtype = x.dtype
+        expert_in = jnp.einsum("tec,th->ech",
+                               dispatch.astype(jnp.float32),
+                               tokens.astype(jnp.float32)).astype(dtype)
+        expert_in = self._constrain(expert_in)
+        expert_out = self.expert_ffn(expert_in, w1, b1, w2, b2)
+        expert_out = self._constrain(expert_out)
+        out = jnp.einsum("tec,ech->th", combine.astype(jnp.float32),
+                         expert_out.astype(jnp.float32))
+        return out.reshape(shape).astype(dtype), aux
+
+    def forward(self, x):
+        from .....core.rng import next_rng_key
+        key = (next_rng_key()
+               if getattr(self.gate, "needs_rng", False) and self.training
+               else None)
+
+        def impl(x_, gw, w1, b1, w2, b2, k):
+            return self.moe_impl(x_, gw, w1, b1, w2, b2, k)
+
+        out, aux = run_op("moe_layer", impl,
+                          (x, self.gate.weight, self.w1, self.b1, self.w2,
+                           self.b2, key), {})
+        # surface the aux loss like the reference's gate.get_loss()
+        self.gate._loss = aux
+        return out

@@ -1,0 +1,55 @@
+"""MoE aux-op equivalents — jnp ports of the reference CUDA kernels
+(number_count, limit_by_capacity, prune_gate_by_capacity, random_routing;
+python/paddle/distributed/models/moe/utils.py + fluid/operators ``number_count``
+etc.).  These operate on index-form routing (pre-dense-dispatch) for API
+parity; the dense gating in gating.py subsumes them on the hot path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["number_count", "limit_by_capacity", "prune_gate_by_capacity",
+           "random_routing"]
+
+
+def _v(x):
+    return jnp.asarray(getattr(x, "_value", x))
+
+
+def number_count(gate_idx, upper_range: int):
+    """Tokens per expert: histogram of gate_idx over [0, upper_range)."""
+    g = _v(gate_idx).astype(jnp.int32)
+    return jnp.sum(jax.nn.one_hot(g.reshape(-1), upper_range,
+                                  dtype=jnp.int64), axis=0)
+
+
+def limit_by_capacity(expert_count, capacity, n_worker: int = 1):
+    """Clip per-expert counts to capacity (reference limit_by_capacity)."""
+    ec = _v(expert_count)
+    cap = _v(capacity)
+    return jnp.minimum(ec, cap if cap.ndim else cap[None])
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert: int,
+                           n_worker: int = 1):
+    """Set gate index to -1 for tokens beyond their expert's capacity."""
+    g = _v(gate_idx).astype(jnp.int32).reshape(-1)
+    cap = _v(expert_count).astype(jnp.int32)
+    oh = jax.nn.one_hot(g, n_expert, dtype=jnp.int32)
+    pos = jnp.sum((jnp.cumsum(oh, axis=0) - oh) * oh, axis=-1)
+    keep = pos < jnp.take(cap, g)
+    return jnp.where(keep, g, -1)
+
+
+def random_routing(topk_idx, topk_value, prob, topk: int = 2):
+    """GShard random routing: keep the 2nd expert with prob 2*w2, else -1."""
+    if topk != 2:
+        raise ValueError("random_routing supports topk == 2 only")
+    idx = _v(topk_idx)
+    val = _v(topk_value)
+    p = _v(prob)
+    keep = p < 2.0 * val[..., 1]
+    second = jnp.where(keep, idx[..., 1], -1)
+    return jnp.stack([idx[..., 0], second], axis=-1)

@@ -1,0 +1,111 @@
+"""MoE: gating invariants, dense-equivalence, EP sharding, aux ops.
+
+Mirrors the reference's MoE test intent (incubate/distributed/models/moe)
+with the numeric strategy of SURVEY §4: compare against a plain reference
+implementation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.incubate.distributed.models.moe import (
+    GShardGate, MoELayer, NaiveGate, SwitchGate, compute_capacity,
+    number_count, prune_gate_by_capacity, topk_capacity_gating)
+
+T, E, H, F = 32, 4, 16, 32
+
+
+def _logits(seed=0):
+    return jax.random.normal(jax.random.key(seed), (T, E), jnp.float32)
+
+
+def test_gating_invariants():
+    cap = compute_capacity(T, E, 2, 1.5)
+    combine, dispatch, aux = topk_capacity_gating(_logits(), 2, cap)
+    assert combine.shape == (T, E, cap) and dispatch.shape == (T, E, cap)
+    # each (expert, slot) holds at most one token
+    assert int(jnp.max(jnp.sum(dispatch, axis=0))) <= 1
+    # each token dispatched to at most 2 experts
+    assert int(jnp.max(jnp.sum(dispatch, axis=(1, 2)))) <= 2
+    # combine weights of a token sum to 1 (when not dropped) or less
+    sums = np.asarray(jnp.sum(combine, axis=(1, 2)))
+    assert (sums <= 1.0 + 1e-5).all()
+    assert float(aux) > 0.0
+
+
+def test_switch_top1():
+    combine, dispatch, _ = topk_capacity_gating(_logits(), 1, T,
+                                                normalize=False)
+    # top-1: weight equals the softmax prob of the argmax expert
+    probs = jax.nn.softmax(_logits(), -1)
+    w = np.asarray(jnp.sum(combine, axis=(1, 2)))
+    np.testing.assert_allclose(w, np.asarray(jnp.max(probs, -1)), rtol=1e-5)
+
+
+def test_moe_layer_matches_dense_when_one_expert():
+    """E=1, no dropping → MoE == plain FFN with the same weights."""
+    pt.seed(0)
+    layer = MoELayer(H, F, num_experts=1, gate="naive", top_k=1)
+    x = pt.to_tensor(np.random.default_rng(0)
+                     .normal(size=(2, 8, H)).astype(np.float32))
+    out = layer(x)
+    w1 = np.asarray(layer.w1._value[0])
+    b1 = np.asarray(layer.b1._value[0])
+    w2 = np.asarray(layer.w2._value[0])
+    b2 = np.asarray(layer.b2._value[0])
+    xf = np.asarray(x._value)
+    ref = jax.nn.gelu(xf @ w1 + b1) @ w2 + b2
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("gate", ["gshard", "switch", "naive"])
+def test_moe_layer_backward(gate):
+    pt.seed(1)
+    layer = MoELayer(H, F, num_experts=E, gate=gate)
+    layer.eval()   # disable random routing for determinism
+    x = pt.to_tensor(np.random.default_rng(1)
+                     .normal(size=(2, 8, H)).astype(np.float32),
+                     stop_gradient=False)
+    out = layer(x)
+    loss = out.sum()
+    loss.backward()
+    assert x.grad is not None
+    assert np.isfinite(np.asarray(x.grad._value)).all()
+    g = layer.w1.grad
+    assert g is not None and np.isfinite(np.asarray(g._value)).all()
+    # gate exposes aux loss after eager forward
+    assert layer.gate.get_loss() is not None
+
+
+def test_moe_ep_sharded_matches_single_device():
+    """Expert dim sharded over a 4-device axis == unsharded result."""
+    from jax.sharding import Mesh
+    import paddle_tpu.parallel as dist
+    pt.seed(2)
+    topo = dist.init_topology(dp=4)   # use dp axis as the expert axis
+    layer = MoELayer(H, F, num_experts=4, gate="switch", ep_axis="dp")
+    x_np = np.random.default_rng(2).normal(size=(4, 8, H)).astype(np.float32)
+
+    params = {k: v._value for k, v in layer.named_parameters()}
+
+    def f(x, p):
+        return layer.moe_impl(x, p["gate.weight"], p["w1"], p["b1"],
+                              p["w2"], p["b2"])[0]
+
+    sharded = jax.jit(f)(x_np, params)
+    layer.ep_axis = None
+    unsharded = jax.jit(f)(x_np, params)
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(unsharded),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_aux_ops():
+    idx = jnp.array([0, 1, 1, 2, 1, 0])
+    counts = number_count(idx, 4)
+    np.testing.assert_array_equal(np.asarray(counts), [2, 3, 1, 0])
+    pruned = prune_gate_by_capacity(idx, jnp.array([1, 2, 1, 1]), 4)
+    np.testing.assert_array_equal(np.asarray(pruned), [0, 1, 1, 2, -1, -1])
