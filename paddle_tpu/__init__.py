@@ -41,6 +41,7 @@ from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
+from . import vision  # noqa: F401
 from .framework import io as _framework_io
 from .framework.io import load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401

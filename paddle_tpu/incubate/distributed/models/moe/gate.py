@@ -39,8 +39,14 @@ class BaseGate(nn.Layer):
 
 
 class NaiveGate(BaseGate):
-    """Plain top-k softmax routing without capacity dropping
-    (naive_gate.py): capacity equals the token count."""
+    """Plain top-k softmax routing (naive_gate.py).
+
+    With ``capacity=None`` the per-expert capacity defaults to
+    ``ceil(2 * top_k * T / num_experts)`` — a balanced-load bound with 2x
+    headroom — so combine/dispatch tensors stay O(T * E * cap) instead of
+    the O(T^2 * E) a literal no-drop (cap = T) would allocate.  Pass
+    ``capacity=(1.0, 1.0)`` for the reference's strict no-drop behavior.
+    """
 
     def __init__(self, d_model: int, num_experts: int, top_k: int = 2,
                  capacity: Optional[Tuple[float, float]] = None,
@@ -60,7 +66,8 @@ class NaiveGate(BaseGate):
 
     def expert_capacity(self, num_tokens: int) -> int:
         if self.capacity is None:
-            return max(num_tokens, 1)     # no dropping
+            return max(math.ceil(2.0 * self.top_k * num_tokens
+                                 / self.num_experts), self.top_k)
         cap_rate = self.capacity[0 if self.training else 1]
         return max(math.ceil(cap_rate * num_tokens), self.top_k)
 

@@ -22,14 +22,25 @@ def number_count(gate_idx, upper_range: int):
     """Tokens per expert: histogram of gate_idx over [0, upper_range)."""
     g = _v(gate_idx).astype(jnp.int32)
     return jnp.sum(jax.nn.one_hot(g.reshape(-1), upper_range,
-                                  dtype=jnp.int64), axis=0)
+                                  dtype=jnp.int32), axis=0)
 
 
 def limit_by_capacity(expert_count, capacity, n_worker: int = 1):
-    """Clip per-expert counts to capacity (reference limit_by_capacity)."""
+    """Clip per-expert counts to capacity shared across workers.
+
+    ``expert_count`` is [n_worker * n_expert] ordered worker-major (the
+    reference kernel's layout); each expert's capacity is consumed by its
+    workers in order, so the total kept per expert never exceeds capacity.
+    """
     ec = _v(expert_count)
     cap = _v(capacity)
-    return jnp.minimum(ec, cap if cap.ndim else cap[None])
+    if n_worker == 1:
+        return jnp.minimum(ec, cap if cap.ndim else cap[None])
+    n_expert = cap.shape[0]
+    per_worker = ec.reshape(n_worker, n_expert)
+    used_before = jnp.cumsum(per_worker, axis=0) - per_worker
+    remaining = jnp.maximum(cap[None, :] - used_before, 0)
+    return jnp.minimum(per_worker, remaining).reshape(ec.shape)
 
 
 def prune_gate_by_capacity(gate_idx, expert_count, n_expert: int,

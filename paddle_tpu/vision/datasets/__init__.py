@@ -1,0 +1,3 @@
+from .mnist import MNIST, FashionMNIST  # noqa: F401
+from .cifar import Cifar10, Cifar100  # noqa: F401
+from .fake import FakeData  # noqa: F401

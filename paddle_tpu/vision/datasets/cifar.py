@@ -1,0 +1,65 @@
+"""CIFAR-10/100 (reference: python/paddle/vision/datasets/cifar.py).
+
+Reads the standard python-pickle tar archives from a local path
+(``data_file``); ``download=True`` raises (no egress).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["Cifar10", "Cifar100"]
+
+
+class Cifar10(Dataset):
+    NAME = "cifar-10"
+    _train_members = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_members = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        if download and data_file is None:
+            raise NotImplementedError(
+                f"{self.NAME}: no network egress — pass a local data_file "
+                "(the cifar python .tar.gz archive)")
+        if data_file is None:
+            base = os.environ.get("PADDLE_TPU_DATA_HOME",
+                                  os.path.expanduser("~/.cache/paddle_tpu"))
+            data_file = os.path.join(base, f"{self.NAME}-python.tar.gz")
+        self.mode = mode
+        self.transform = transform
+        members = self._train_members if mode == "train" else self._test_members
+        imgs, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                if os.path.basename(m.name) in members:
+                    batch = pickle.load(tf.extractfile(m), encoding="bytes")
+                    imgs.append(batch[b"data"])
+                    labels.extend(batch[self._label_key])
+        self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(np.transpose(img, (1, 2, 0)))
+        else:
+            img = img.astype(np.float32)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NAME = "cifar-100"
+    _train_members = ["train"]
+    _test_members = ["test"]
+    _label_key = b"fine_labels"

@@ -1,0 +1,68 @@
+"""MNIST / FashionMNIST (reference: python/paddle/vision/datasets/mnist.py).
+
+No network egress in this environment: ``image_path``/``label_path`` must
+point at local IDX files (the standard ubyte.gz format); ``download=True``
+raises.  For tests use ``paddle_tpu.vision.datasets.FakeData``.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST"]
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        data = f.read()
+    magic, = struct.unpack(">I", data[:4])
+    ndim = magic & 0xFF
+    dims = struct.unpack(">" + "I" * ndim, data[4:4 + 4 * ndim])
+    arr = np.frombuffer(data, dtype=np.uint8, offset=4 + 4 * ndim)
+    return arr.reshape(dims)
+
+
+class MNIST(Dataset):
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend="cv2"):
+        if download and (image_path is None or label_path is None):
+            raise NotImplementedError(
+                f"{self.NAME}: no network egress — pass local "
+                "image_path/label_path (IDX ubyte[.gz] files)")
+        if image_path is None or label_path is None:
+            base = os.environ.get("PADDLE_TPU_DATA_HOME",
+                                  os.path.expanduser("~/.cache/paddle_tpu"))
+            tag = "train" if mode == "train" else "t10k"
+            image_path = os.path.join(base, self.NAME,
+                                      f"{tag}-images-idx3-ubyte.gz")
+            label_path = os.path.join(base, self.NAME,
+                                      f"{tag}-labels-idx1-ubyte.gz")
+        self.mode = mode
+        self.transform = transform
+        self.backend = backend
+        self.images = _read_idx(image_path)            # [N, 28, 28] uint8
+        self.labels = _read_idx(label_path).astype(np.int64)  # [N]
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None]  # CHW
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"

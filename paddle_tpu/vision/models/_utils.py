@@ -1,0 +1,40 @@
+"""Model utilities (reference: python/paddle/vision/models/_utils.py)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ...nn.layer.layers import Layer
+
+
+class IntermediateLayerGetter(Layer):
+    """Wrap a model to return an OrderedDict of named intermediate outputs.
+
+    ``return_layers`` maps child-layer name -> output key.  Only works for
+    models whose children are used sequentially in forward order (same
+    contract as the reference).
+    """
+
+    def __init__(self, model: Layer, return_layers: dict):
+        if not set(return_layers).issubset(
+                name for name, _ in model.named_children()):
+            raise ValueError("return_layers are not present in model")
+        super().__init__()
+        remaining = dict(return_layers)
+        self.return_layers = dict(return_layers)
+        self._layer_names = []
+        for name, module in model.named_children():
+            self.add_sublayer(name, module)
+            self._layer_names.append(name)
+            if name in remaining:
+                del remaining[name]
+            if not remaining:
+                break
+
+    def forward(self, x):
+        out = OrderedDict()
+        for name in self._layer_names:
+            x = getattr(self, name)(x)
+            if name in self.return_layers:
+                out[self.return_layers[name]] = x
+        return out

@@ -1,0 +1,126 @@
+"""DenseNet family (reference: python/paddle/vision/models/densenet.py)."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer.container import Sequential
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.activation import ReLU
+from ...nn.layer.pooling import MaxPool2D, AvgPool2D, AdaptiveAvgPool2D
+from ...nn.layer.common import Linear
+from ...ops.api import concat
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_cfgs = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class DenseLayer(Layer):
+    def __init__(self, cin, growth_rate, bn_size):
+        super().__init__()
+        self.norm1 = BatchNorm2D(cin)
+        self.relu = ReLU()
+        self.conv1 = Conv2D(cin, bn_size * growth_rate, 1, bias_attr=False)
+        self.norm2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                            bias_attr=False)
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        return concat([x, out], axis=1)
+
+
+class DenseBlock(Layer):
+    def __init__(self, num_layers, cin, growth_rate, bn_size):
+        super().__init__()
+        self.block = Sequential(*[
+            DenseLayer(cin + i * growth_rate, growth_rate, bn_size)
+            for i in range(num_layers)])
+
+    def forward(self, x):
+        return self.block(x)
+
+
+class TransitionLayer(Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.norm = BatchNorm2D(cin)
+        self.relu = ReLU()
+        self.conv = Conv2D(cin, cout, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        num_init_features, growth_rate, block_cfg = _cfgs[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                   bias_attr=False),
+            BatchNorm2D(num_init_features), ReLU(),
+            MaxPool2D(kernel_size=3, stride=2, padding=1))
+        blocks = []
+        nf = num_init_features
+        for i, n in enumerate(block_cfg):
+            blocks.append(DenseBlock(n, nf, growth_rate, bn_size))
+            nf += n * growth_rate
+            if i != len(block_cfg) - 1:
+                blocks.append(TransitionLayer(nf, nf // 2))
+                nf //= 2
+        self.blocks = Sequential(*blocks)
+        self.final_norm = BatchNorm2D(nf)
+        self.final_relu = ReLU()
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Linear(nf, num_classes)
+
+    def forward(self, x):
+        x = self.final_relu(self.final_norm(self.blocks(self.stem(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+def _densenet(layers, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

@@ -1,0 +1,113 @@
+"""GoogLeNet / Inception-v1 (reference: python/paddle/vision/models/googlenet.py).
+
+Returns (main, aux1, aux2) logits like the reference.
+"""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer.container import Sequential
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.activation import ReLU
+from ...nn.layer.pooling import MaxPool2D, AvgPool2D, AdaptiveAvgPool2D
+from ...nn.layer.common import Linear, Dropout
+from ...ops.api import concat
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class ConvLayer(Layer):
+    def __init__(self, cin, cout, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, kernel, stride=stride, padding=padding)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.conv(x))
+
+
+class Inception(Layer):
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.branch1 = ConvLayer(cin, c1, 1)
+        self.branch2 = Sequential(ConvLayer(cin, c3r, 1),
+                                  ConvLayer(c3r, c3, 3, padding=1))
+        self.branch3 = Sequential(ConvLayer(cin, c5r, 1),
+                                  ConvLayer(c5r, c5, 5, padding=2))
+        self.branch4 = Sequential(MaxPool2D(kernel_size=3, stride=1, padding=1),
+                                  ConvLayer(cin, proj, 1))
+
+    def forward(self, x):
+        return concat([self.branch1(x), self.branch2(x), self.branch3(x),
+                       self.branch4(x)], axis=1)
+
+
+class GoogLeNet(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            ConvLayer(3, 64, 7, stride=2, padding=3),
+            MaxPool2D(kernel_size=3, stride=2, padding=1),
+            ConvLayer(64, 64, 1),
+            ConvLayer(64, 192, 3, padding=1),
+            MaxPool2D(kernel_size=3, stride=2, padding=1))
+        self.inc3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.inc4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.inc5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(0.4)
+            self.fc = Linear(1024, num_classes)
+            # aux classifiers (train-time deep supervision)
+            self.aux_pool = AvgPool2D(5, stride=3)
+            self.aux1_conv = ConvLayer(512, 128, 1)
+            self.aux1_fc1 = Linear(128 * 4 * 4, 1024)
+            self.aux1_fc2 = Linear(1024, num_classes)
+            self.aux2_conv = ConvLayer(528, 128, 1)
+            self.aux2_fc1 = Linear(128 * 4 * 4, 1024)
+            self.aux2_fc2 = Linear(1024, num_classes)
+            self.aux_relu = ReLU()
+            self.aux_dropout = Dropout(0.7)
+
+    def _aux(self, x, conv, fc1, fc2):
+        x = conv(self.aux_pool(x))
+        x = x.flatten(1)
+        x = self.aux_relu(fc1(x))
+        return fc2(self.aux_dropout(x))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.inc3b(self.inc3a(x)))
+        x = self.inc4a(x)
+        aux1_in = x
+        x = self.inc4d(self.inc4c(self.inc4b(x)))
+        aux2_in = x
+        x = self.pool4(self.inc4e(x))
+        x = self.inc5b(self.inc5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            out = self.fc(self.dropout(x.flatten(1)))
+            aux1 = self._aux(aux1_in, self.aux1_conv, self.aux1_fc1,
+                             self.aux1_fc2)
+            aux2 = self._aux(aux2_in, self.aux2_conv, self.aux2_fc1,
+                             self.aux2_fc2)
+            return out, aux1, aux2
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return GoogLeNet(**kwargs)

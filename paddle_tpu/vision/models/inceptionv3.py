@@ -1,0 +1,167 @@
+"""Inception-v3 (reference: python/paddle/vision/models/inceptionv3.py)."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer.container import Sequential
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.activation import ReLU
+from ...nn.layer.pooling import MaxPool2D, AvgPool2D, AdaptiveAvgPool2D
+from ...nn.layer.common import Linear, Dropout
+from ...ops.api import concat
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class ConvBN(Layer):
+    def __init__(self, cin, cout, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, kernel, stride=stride, padding=padding,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class InceptionStem(Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = ConvBN(3, 32, 3, stride=2)
+        self.conv2 = ConvBN(32, 32, 3)
+        self.conv3 = ConvBN(32, 64, 3, padding=1)
+        self.pool1 = MaxPool2D(kernel_size=3, stride=2)
+        self.conv4 = ConvBN(64, 80, 1)
+        self.conv5 = ConvBN(80, 192, 3)
+        self.pool2 = MaxPool2D(kernel_size=3, stride=2)
+
+    def forward(self, x):
+        x = self.pool1(self.conv3(self.conv2(self.conv1(x))))
+        return self.pool2(self.conv5(self.conv4(x)))
+
+
+class InceptionA(Layer):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.b1 = ConvBN(cin, 64, 1)
+        self.b5 = Sequential(ConvBN(cin, 48, 1), ConvBN(48, 64, 5, padding=2))
+        self.b3 = Sequential(ConvBN(cin, 64, 1), ConvBN(64, 96, 3, padding=1),
+                             ConvBN(96, 96, 3, padding=1))
+        self.pool = AvgPool2D(3, stride=1, padding=1)
+        self.bp = ConvBN(cin, pool_features, 1)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x),
+                       self.bp(self.pool(x))], axis=1)
+
+
+class InceptionB(Layer):
+    """Grid reduction 35x35 -> 17x17."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = ConvBN(cin, 384, 3, stride=2)
+        self.b3dbl = Sequential(ConvBN(cin, 64, 1), ConvBN(64, 96, 3, padding=1),
+                                ConvBN(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(kernel_size=3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3dbl(x), self.pool(x)], axis=1)
+
+
+class InceptionC(Layer):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = ConvBN(cin, 192, 1)
+        self.b7 = Sequential(
+            ConvBN(cin, c7, 1),
+            ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7dbl = Sequential(
+            ConvBN(cin, c7, 1),
+            ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.pool = AvgPool2D(3, stride=1, padding=1)
+        self.bp = ConvBN(cin, 192, 1)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7dbl(x),
+                       self.bp(self.pool(x))], axis=1)
+
+
+class InceptionD(Layer):
+    """Grid reduction 17x17 -> 8x8."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = Sequential(ConvBN(cin, 192, 1), ConvBN(192, 320, 3, stride=2))
+        self.b7x3 = Sequential(
+            ConvBN(cin, 192, 1),
+            ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            ConvBN(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(kernel_size=3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7x3(x), self.pool(x)], axis=1)
+
+
+class InceptionE(Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = ConvBN(cin, 320, 1)
+        self.b3_stem = ConvBN(cin, 384, 1)
+        self.b3_a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3dbl_stem = Sequential(ConvBN(cin, 448, 1),
+                                     ConvBN(448, 384, 3, padding=1))
+        self.b3dbl_a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3dbl_b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.pool = AvgPool2D(3, stride=1, padding=1)
+        self.bp = ConvBN(cin, 192, 1)
+
+    def forward(self, x):
+        b3 = self.b3_stem(x)
+        b3 = concat([self.b3_a(b3), self.b3_b(b3)], axis=1)
+        b3dbl = self.b3dbl_stem(x)
+        b3dbl = concat([self.b3dbl_a(b3dbl), self.b3dbl_b(b3dbl)], axis=1)
+        return concat([self.b1(x), b3, b3dbl, self.bp(self.pool(x))], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = InceptionStem()
+        self.blocks = Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160), InceptionC(768, 160),
+            InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048))
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.dropout(x.flatten(1))
+            x = self.fc(x)
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,6 @@
+"""LeNet (reference: python/paddle/vision/models/lenet.py) — canonical home
+is paddle_tpu.models.lenet; re-exported here for vision-zoo parity."""
+
+from ...models.lenet import LeNet  # noqa: F401
+
+__all__ = ["LeNet"]

@@ -1,0 +1,78 @@
+"""MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py).
+
+Depthwise convs map to XLA ``feature_group_count``; on TPU these lower to
+efficient fused windows, no special kernel needed.
+"""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer.container import Sequential
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.activation import ReLU
+from ...nn.layer.pooling import AdaptiveAvgPool2D
+from ...nn.layer.common import Linear
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, cin, cout, kernel, stride=1, padding=0, groups=1):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, kernel, stride=stride, padding=padding,
+                           groups=groups, bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class DepthwiseSeparable(Layer):
+    def __init__(self, cin, cout1, cout2, stride, scale):
+        super().__init__()
+        self.dw = ConvBNLayer(int(cin * scale), int(cout1 * scale), 3,
+                              stride=stride, padding=1,
+                              groups=int(cin * scale))
+        self.pw = ConvBNLayer(int(cout1 * scale), int(cout2 * scale), 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1 = ConvBNLayer(3, int(32 * scale), 3, stride=2, padding=1)
+        cfg = [  # cin, c1, c2, stride
+            (32, 32, 64, 1), (64, 64, 128, 2), (128, 128, 128, 1),
+            (128, 128, 256, 2), (256, 256, 256, 1), (256, 256, 512, 2),
+            (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 512, 1),
+            (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 1024, 2),
+            (1024, 1024, 1024, 1)]
+        self.blocks = Sequential(*[
+            DepthwiseSeparable(cin, c1, c2, s, scale) for cin, c1, c2, s in cfg])
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,94 @@
+"""MobileNetV2 (reference: python/paddle/vision/models/mobilenetv2.py)."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer.container import Sequential
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.activation import ReLU6
+from ...nn.layer.pooling import AdaptiveAvgPool2D
+from ...nn.layer.common import Linear, Dropout
+
+__all__ = ["MobileNetV2", "mobilenet_v2", "_make_divisible"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNReLU(Sequential):
+    def __init__(self, cin, cout, kernel=3, stride=1, groups=1):
+        padding = (kernel - 1) // 2
+        super().__init__(
+            Conv2D(cin, cout, kernel, stride=stride, padding=padding,
+                   groups=groups, bias_attr=False),
+            BatchNorm2D(cout), ReLU6())
+
+
+class InvertedResidual(Layer):
+    def __init__(self, cin, cout, stride, expand_ratio):
+        super().__init__()
+        self.stride = stride
+        hidden = int(round(cin * expand_ratio))
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNReLU(cin, hidden, kernel=1))
+        layers += [
+            ConvBNReLU(hidden, hidden, stride=stride, groups=hidden),
+            Conv2D(hidden, cout, 1, bias_attr=False),
+            BatchNorm2D(cout)]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        input_channel = 32
+        last_channel = 1280
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        input_channel = _make_divisible(input_channel * scale)
+        self.last_channel = _make_divisible(last_channel * max(1.0, scale))
+        features = [ConvBNReLU(3, input_channel, stride=2)]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                features.append(InvertedResidual(
+                    input_channel, out_c, s if i == 0 else 1, t))
+                input_channel = out_c
+        features.append(ConvBNReLU(input_channel, self.last_channel, kernel=1))
+        self.features = Sequential(*features)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(0.2), Linear(self.last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return MobileNetV2(scale=scale, **kwargs)

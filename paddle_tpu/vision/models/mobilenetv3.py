@@ -1,0 +1,168 @@
+"""MobileNetV3 Large/Small (reference: python/paddle/vision/models/mobilenetv3.py)."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer.container import Sequential
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.activation import ReLU, Hardswish, Hardsigmoid
+from ...nn.layer.pooling import AdaptiveAvgPool2D
+from ...nn.layer.common import Linear, Dropout, Identity
+from .mobilenetv2 import _make_divisible
+
+__all__ = ["MobileNetV3Large", "MobileNetV3Small",
+           "mobilenet_v3_large", "mobilenet_v3_small"]
+
+
+class SqueezeExcitation(Layer):
+    def __init__(self, input_channels, squeeze_channels):
+        super().__init__()
+        self.avgpool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(input_channels, squeeze_channels, 1)
+        self.fc2 = Conv2D(squeeze_channels, input_channels, 1)
+        self.relu = ReLU()
+        self.hsig = Hardsigmoid()
+
+    def forward(self, x):
+        scale = self.avgpool(x)
+        scale = self.relu(self.fc1(scale))
+        scale = self.hsig(self.fc2(scale))
+        return x * scale
+
+
+class ConvNormActivation(Sequential):
+    def __init__(self, cin, cout, kernel=3, stride=1, groups=1,
+                 activation=ReLU):
+        padding = (kernel - 1) // 2
+        layers = [Conv2D(cin, cout, kernel, stride=stride, padding=padding,
+                         groups=groups, bias_attr=False),
+                  BatchNorm2D(cout)]
+        if activation is not None:
+            layers.append(activation())
+        super().__init__(*layers)
+
+
+class InvertedResidualConfig:
+    def __init__(self, cin, kernel, expanded, cout, use_se, activation,
+                 stride, scale=1.0):
+        self.input_channels = _make_divisible(cin * scale)
+        self.kernel = kernel
+        self.expanded_channels = _make_divisible(expanded * scale)
+        self.output_channels = _make_divisible(cout * scale)
+        self.use_se = use_se
+        self.use_hs = activation == "HS"
+        self.stride = stride
+
+
+class InvertedResidual(Layer):
+    def __init__(self, cfg: InvertedResidualConfig):
+        super().__init__()
+        self.use_res = cfg.stride == 1 and cfg.input_channels == cfg.output_channels
+        act = Hardswish if cfg.use_hs else ReLU
+        layers = []
+        if cfg.expanded_channels != cfg.input_channels:
+            layers.append(ConvNormActivation(
+                cfg.input_channels, cfg.expanded_channels, kernel=1,
+                activation=act))
+        layers.append(ConvNormActivation(
+            cfg.expanded_channels, cfg.expanded_channels, kernel=cfg.kernel,
+            stride=cfg.stride, groups=cfg.expanded_channels, activation=act))
+        if cfg.use_se:
+            layers.append(SqueezeExcitation(
+                cfg.expanded_channels,
+                _make_divisible(cfg.expanded_channels // 4)))
+        layers.append(ConvNormActivation(
+            cfg.expanded_channels, cfg.output_channels, kernel=1,
+            activation=None))
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV3(Layer):
+    def __init__(self, configs, last_channel, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        firstconv_out = configs[0].input_channels
+        layers = [ConvNormActivation(3, firstconv_out, kernel=3, stride=2,
+                                     activation=Hardswish)]
+        layers += [InvertedResidual(c) for c in configs]
+        lastconv_in = configs[-1].output_channels
+        lastconv_out = 6 * lastconv_in
+        layers.append(ConvNormActivation(lastconv_in, lastconv_out, kernel=1,
+                                         activation=Hardswish))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(lastconv_out, last_channel), Hardswish(),
+                Dropout(0.2), Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        C = InvertedResidualConfig
+        configs = [
+            C(16, 3, 16, 16, False, "RE", 1, scale),
+            C(16, 3, 64, 24, False, "RE", 2, scale),
+            C(24, 3, 72, 24, False, "RE", 1, scale),
+            C(24, 5, 72, 40, True, "RE", 2, scale),
+            C(40, 5, 120, 40, True, "RE", 1, scale),
+            C(40, 5, 120, 40, True, "RE", 1, scale),
+            C(40, 3, 240, 80, False, "HS", 2, scale),
+            C(80, 3, 200, 80, False, "HS", 1, scale),
+            C(80, 3, 184, 80, False, "HS", 1, scale),
+            C(80, 3, 184, 80, False, "HS", 1, scale),
+            C(80, 3, 480, 112, True, "HS", 1, scale),
+            C(112, 3, 672, 112, True, "HS", 1, scale),
+            C(112, 5, 672, 160, True, "HS", 2, scale),
+            C(160, 5, 960, 160, True, "HS", 1, scale),
+            C(160, 5, 960, 160, True, "HS", 1, scale)]
+        last_channel = _make_divisible(1280 * scale)
+        super().__init__(configs, last_channel, num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        C = InvertedResidualConfig
+        configs = [
+            C(16, 3, 16, 16, True, "RE", 2, scale),
+            C(16, 3, 72, 24, False, "RE", 2, scale),
+            C(24, 3, 88, 24, False, "RE", 1, scale),
+            C(24, 5, 96, 40, True, "HS", 2, scale),
+            C(40, 5, 240, 40, True, "HS", 1, scale),
+            C(40, 5, 240, 40, True, "HS", 1, scale),
+            C(40, 5, 120, 48, True, "HS", 1, scale),
+            C(48, 5, 144, 48, True, "HS", 1, scale),
+            C(48, 5, 288, 96, True, "HS", 2, scale),
+            C(96, 5, 576, 96, True, "HS", 1, scale),
+            C(96, 5, 576, 96, True, "HS", 1, scale)]
+        last_channel = _make_divisible(1024 * scale)
+        super().__init__(configs, last_channel, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return MobileNetV3Small(scale=scale, **kwargs)

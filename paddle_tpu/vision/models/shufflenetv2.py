@@ -1,0 +1,149 @@
+"""ShuffleNetV2 family (reference: python/paddle/vision/models/shufflenetv2.py)."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer.container import Sequential
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.activation import ReLU, Swish
+from ...nn.layer.pooling import MaxPool2D, AdaptiveAvgPool2D
+from ...nn.layer.common import Linear
+from ...ops.api import concat, reshape, transpose, split
+
+__all__ = ["ShuffleNetV2", "channel_shuffle",
+           "shufflenet_v2_x0_25", "shufflenet_v2_x0_33", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+           "shufflenet_v2_swish"]
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+def _conv_bn(cin, cout, kernel, stride=1, padding=0, groups=1, act=ReLU):
+    layers = [Conv2D(cin, cout, kernel, stride=stride, padding=padding,
+                     groups=groups, bias_attr=False), BatchNorm2D(cout)]
+    if act is not None:
+        layers.append(act())
+    return Sequential(*layers)
+
+
+class InvertedResidual(Layer):
+    """Stride-1 unit: split, transform right half, concat + shuffle."""
+
+    def __init__(self, channels, act=ReLU):
+        super().__init__()
+        half = channels // 2
+        self.branch = Sequential(
+            _conv_bn(half, half, 1, act=act),
+            _conv_bn(half, half, 3, stride=1, padding=1, groups=half, act=None),
+            _conv_bn(half, half, 1, act=act))
+
+    def forward(self, x):
+        x1, x2 = split(x, 2, axis=1)
+        out = concat([x1, self.branch(x2)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class InvertedResidualDS(Layer):
+    """Stride-2 (downsample) unit: both branches transform full input."""
+
+    def __init__(self, cin, cout, act=ReLU):
+        super().__init__()
+        half = cout // 2
+        self.branch1 = Sequential(
+            _conv_bn(cin, cin, 3, stride=2, padding=1, groups=cin, act=None),
+            _conv_bn(cin, half, 1, act=act))
+        self.branch2 = Sequential(
+            _conv_bn(cin, half, 1, act=act),
+            _conv_bn(half, half, 3, stride=2, padding=1, groups=half, act=None),
+            _conv_bn(half, half, 1, act=act))
+
+    def forward(self, x):
+        out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+_stage_repeats = [4, 8, 4]
+_stage_out = {
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        act_layer = Swish if act == "swish" else ReLU
+        out_c = _stage_out[scale]
+        self.conv1 = _conv_bn(3, out_c[0], 3, stride=2, padding=1,
+                              act=act_layer)
+        self.maxpool = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        stages = []
+        cin = out_c[0]
+        for i, reps in enumerate(_stage_repeats):
+            cout = out_c[i + 1]
+            stages.append(InvertedResidualDS(cin, cout, act=act_layer))
+            for _ in range(reps - 1):
+                stages.append(InvertedResidual(cout, act=act_layer))
+            cin = cout
+        self.stages = Sequential(*stages)
+        self.conv_last = _conv_bn(cin, out_c[-1], 1, act=act_layer)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(out_c[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def _shufflenet(scale, act, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "swish", pretrained, **kwargs)

@@ -1,0 +1,90 @@
+"""SqueezeNet v1.0 / v1.1 (reference: python/paddle/vision/models/squeezenet.py)."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer.container import Sequential
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.activation import ReLU
+from ...nn.layer.pooling import MaxPool2D, AdaptiveAvgPool2D
+from ...nn.layer.common import Dropout
+from ...ops.api import concat
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class MakeFire(Layer):
+    def __init__(self, cin, squeeze, expand1x1, expand3x3):
+        super().__init__()
+        self.squeeze = Conv2D(cin, squeeze, 1)
+        self.relu = ReLU()
+        self.expand1x1 = Conv2D(squeeze, expand1x1, 1)
+        self.expand3x3 = Conv2D(squeeze, expand3x3, 3, padding=1)
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        a = self.relu(self.expand1x1(x))
+        b = self.relu(self.expand3x3(x))
+        return concat([a, b], axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.version = version
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(),
+                MaxPool2D(kernel_size=3, stride=2),
+                MakeFire(96, 16, 64, 64),
+                MakeFire(128, 16, 64, 64),
+                MakeFire(128, 32, 128, 128),
+                MaxPool2D(kernel_size=3, stride=2),
+                MakeFire(256, 32, 128, 128),
+                MakeFire(256, 48, 192, 192),
+                MakeFire(384, 48, 192, 192),
+                MakeFire(384, 64, 256, 256),
+                MaxPool2D(kernel_size=3, stride=2),
+                MakeFire(512, 64, 256, 256))
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2, padding=1), ReLU(),
+                MaxPool2D(kernel_size=3, stride=2),
+                MakeFire(64, 16, 64, 64),
+                MakeFire(128, 16, 64, 64),
+                MaxPool2D(kernel_size=3, stride=2),
+                MakeFire(128, 32, 128, 128),
+                MakeFire(256, 32, 128, 128),
+                MaxPool2D(kernel_size=3, stride=2),
+                MakeFire(256, 48, 192, 192),
+                MakeFire(384, 48, 192, 192),
+                MakeFire(384, 64, 256, 256),
+                MakeFire(512, 64, 256, 256))
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(0.5),
+                Conv2D(512, num_classes, 1), ReLU())
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        return x.flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return SqueezeNet("1.1", **kwargs)

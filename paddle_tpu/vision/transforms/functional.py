@@ -1,0 +1,218 @@
+"""Functional image transforms over numpy HWC uint8/float arrays.
+
+Reference: python/paddle/vision/transforms/functional*.py.  TPU-native
+stance: transforms run on the HOST data path (numpy), feeding the device
+pipeline — keeping per-sample branching/resizing off the accelerator, which
+only sees fixed-shape batches.
+"""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = [
+    "to_tensor", "normalize", "resize", "pad", "crop", "center_crop",
+    "hflip", "vflip", "rotate", "adjust_brightness", "adjust_contrast",
+    "adjust_saturation", "adjust_hue", "to_grayscale", "erase",
+]
+
+
+def _as_float(img):
+    if img.dtype == np.uint8:
+        return img.astype(np.float32) / 255.0
+    return img.astype(np.float32)
+
+
+def to_tensor(img, data_format="CHW"):
+    """HWC (or HW) uint8/float image -> float32 array in CHW, scaled to [0,1]."""
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    arr = _as_float(arr)
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return arr
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    return (arr - mean) / std
+
+
+def _interp_resize(img, h, w, interpolation="bilinear"):
+    """Pure-numpy separable resize (nearest / bilinear)."""
+    src_h, src_w = img.shape[:2]
+    if interpolation == "nearest":
+        ys = np.clip(np.round(np.arange(h) * src_h / h).astype(int), 0, src_h - 1)
+        xs = np.clip(np.round(np.arange(w) * src_w / w).astype(int), 0, src_w - 1)
+        return img[ys][:, xs]
+    # bilinear with align_corners=False convention
+    y = (np.arange(h) + 0.5) * src_h / h - 0.5
+    x = (np.arange(w) + 0.5) * src_w / w - 0.5
+    y0 = np.clip(np.floor(y).astype(int), 0, src_h - 1)
+    y1 = np.clip(y0 + 1, 0, src_h - 1)
+    x0 = np.clip(np.floor(x).astype(int), 0, src_w - 1)
+    x1 = np.clip(x0 + 1, 0, src_w - 1)
+    wy = np.clip(y - y0, 0.0, 1.0)[:, None, None]
+    wx = np.clip(x - x0, 0.0, 1.0)[None, :, None]
+    im = _as_float(img if img.ndim == 3 else img[:, :, None])
+    top = im[y0][:, x0] * (1 - wx) + im[y0][:, x1] * wx
+    bot = im[y1][:, x0] * (1 - wx) + im[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    if img.ndim == 2:
+        out = out[:, :, 0]
+    if img.dtype == np.uint8:
+        out = np.clip(out * 255.0, 0, 255).astype(np.uint8)
+    return out
+
+
+def resize(img, size, interpolation="bilinear"):
+    img = np.asarray(img)
+    h, w = img.shape[:2]
+    if isinstance(size, numbers.Number):
+        # resize shorter side to `size`, keep aspect
+        if h < w:
+            nh, nw = int(size), int(size * w / h)
+        else:
+            nh, nw = int(size * h / w), int(size)
+    else:
+        nh, nw = size
+    return _interp_resize(img, nh, nw, interpolation)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = np.asarray(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    pads = [(pt, pb), (pl, pr)] + [(0, 0)] * (img.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(img, pads, mode="constant", constant_values=fill)
+    mode = {"reflect": "reflect", "edge": "edge",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(img, pads, mode=mode)
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = np.asarray(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = img.shape[:2]
+    th, tw = output_size
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(img, top, left, th, tw)
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1]
+
+
+def vflip(img):
+    return np.asarray(img)[::-1]
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate by `angle` degrees counter-clockwise (nearest-neighbour)."""
+    img = np.asarray(img)
+    h, w = img.shape[:2]
+    theta = np.deg2rad(angle)
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else \
+        (center[1], center[0])
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    # inverse-map output coords back to source
+    ys = (yy - cy) * np.cos(theta) - (xx - cx) * np.sin(theta) + cy
+    xs = (yy - cy) * np.sin(theta) + (xx - cx) * np.cos(theta) + cx
+    yi = np.round(ys).astype(int)
+    xi = np.round(xs).astype(int)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full_like(img, fill)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out
+
+
+def adjust_brightness(img, factor):
+    arr = _as_float(np.asarray(img)) * factor
+    return _restore(arr, img)
+
+
+def adjust_contrast(img, factor):
+    arr = _as_float(np.asarray(img))
+    mean = arr.mean()
+    return _restore((arr - mean) * factor + mean, img)
+
+
+def adjust_saturation(img, factor):
+    arr = _as_float(np.asarray(img))
+    gray = arr.mean(axis=-1, keepdims=True)
+    return _restore(gray + (arr - gray) * factor, img)
+
+
+def adjust_hue(img, factor):
+    """Shift hue by `factor` (in [-0.5, 0.5]) via HSV roundtrip."""
+    arr = _as_float(np.asarray(img))
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    maxc = arr.max(-1)
+    minc = arr.min(-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0)
+    dz = np.maximum(delta, 1e-12)
+    rc = (maxc - r) / dz
+    gc = (maxc - g) / dz
+    bc = (maxc - b) / dz
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = (h + factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(int) % 6
+    conds = [i == k for k in range(6)]
+    r2 = np.select(conds, [v, q, p, p, t, v])
+    g2 = np.select(conds, [t, v, v, q, p, p])
+    b2 = np.select(conds, [p, p, t, v, v, q])
+    return _restore(np.stack([r2, g2, b2], axis=-1), img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _as_float(np.asarray(img))
+    gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1] + 0.114 * arr[..., 2])
+    gray = gray[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=-1)
+    return _restore(gray, img)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = np.asarray(img)
+    if not inplace:
+        arr = arr.copy()
+    arr[i:i + h, j:j + w] = v
+    return arr
+
+
+def _restore(arr, ref):
+    ref = np.asarray(ref)
+    if ref.dtype == np.uint8:
+        return np.clip(arr * 255.0, 0, 255).astype(np.uint8)
+    return arr.astype(ref.dtype)

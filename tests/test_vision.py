@@ -1,0 +1,141 @@
+"""Vision zoo tests — forward shape + trainability of each model family
+(small inputs; SURVEY.md §4: API/layer unit tests vs numpy refs)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import models, transforms
+from paddle_tpu.vision.datasets import FakeData
+
+
+def _check_logits(net, in_shape=(2, 3, 64, 64), num_classes=10):
+    x = pt.to_tensor(np.random.default_rng(0).standard_normal(in_shape)
+                     .astype("float32"))
+    net.eval()
+    out = net(x)
+    if isinstance(out, tuple):  # googlenet aux heads
+        out = out[0]
+    assert tuple(out.shape) == (in_shape[0], num_classes)
+    return out
+
+
+@pytest.mark.parametrize("ctor", [
+    models.resnet18, models.resnet50, models.resnext50_32x4d,
+    models.wide_resnet50_2])
+def test_resnet_family(ctor):
+    _check_logits(ctor(num_classes=10))
+
+
+def test_vgg():
+    _check_logits(models.vgg11(num_classes=10), in_shape=(2, 3, 224, 224))
+
+
+def test_alexnet():
+    _check_logits(models.alexnet(num_classes=10), in_shape=(2, 3, 224, 224))
+
+
+def test_mobilenets():
+    _check_logits(models.mobilenet_v1(num_classes=10))
+    _check_logits(models.mobilenet_v2(num_classes=10))
+    _check_logits(models.mobilenet_v3_small(num_classes=10))
+    _check_logits(models.mobilenet_v3_large(num_classes=10))
+
+
+def test_densenet():
+    _check_logits(models.densenet121(num_classes=10))
+
+
+def test_squeezenet():
+    _check_logits(models.squeezenet1_1(num_classes=10),
+                  in_shape=(2, 3, 224, 224))
+
+
+def test_shufflenet():
+    _check_logits(models.shufflenet_v2_x0_25(num_classes=10))
+
+
+def test_googlenet_aux():
+    net = models.googlenet(num_classes=10)
+    x = pt.to_tensor(np.random.default_rng(0)
+                     .standard_normal((2, 3, 224, 224)).astype("float32"))
+    net.eval()
+    out, aux1, aux2 = net(x)
+    assert tuple(out.shape) == (2, 10)
+    assert tuple(aux1.shape) == (2, 10)
+    assert tuple(aux2.shape) == (2, 10)
+
+
+def test_inception_v3():
+    _check_logits(models.inception_v3(num_classes=10),
+                  in_shape=(2, 3, 299, 299))
+
+
+def test_resnet_train_step():
+    """One SGD step decreases loss on a fixed batch (trainability)."""
+    pt.seed(0)
+    net = models.resnet18(num_classes=4)
+    net.train()
+    opt = pt.optimizer.SGD(learning_rate=0.003, parameters=net.parameters())
+    x = pt.to_tensor(np.random.default_rng(1)
+                     .standard_normal((4, 3, 32, 32)).astype("float32"))
+    y = pt.to_tensor(np.array([0, 1, 2, 3], dtype="int64"))
+    losses = []
+    for _ in range(3):
+        logits = net(x)
+        loss = pt.nn.functional.cross_entropy(logits, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_intermediate_layer_getter():
+    net = models.resnet18(num_classes=10)
+    getter = models.IntermediateLayerGetter(
+        net, {"layer1": "feat1", "layer2": "feat2"})
+    x = pt.to_tensor(np.zeros((1, 3, 64, 64), "float32"))
+    out = getter(x)
+    assert set(out.keys()) == {"feat1", "feat2"}
+    assert out["feat1"].shape[1] == 64
+    assert out["feat2"].shape[1] == 128
+
+
+def test_transforms_pipeline():
+    img = (np.random.default_rng(0).integers(0, 256, (40, 50, 3))
+           .astype(np.uint8))
+    tf = transforms.Compose([
+        transforms.Resize(36),
+        transforms.CenterCrop(32),
+        transforms.RandomHorizontalFlip(0.5),
+        transforms.ColorJitter(0.1, 0.1, 0.1, 0.1),
+        transforms.ToTensor(),
+        transforms.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5]),
+    ])
+    out = tf(img)
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+
+
+def test_transforms_functional():
+    from paddle_tpu.vision.transforms import functional as F
+    img = np.arange(24, dtype=np.uint8).reshape(4, 6)
+    assert F.hflip(img)[0, 0] == img[0, -1]
+    assert F.vflip(img)[0, 0] == img[-1, 0]
+    r = F.resize(img, (8, 12), "nearest")
+    assert r.shape == (8, 12)
+    padded = F.pad(img, 2)
+    assert padded.shape == (8, 10)
+    c = F.crop(img, 1, 2, 2, 3)
+    assert c.shape == (2, 3)
+    np.testing.assert_array_equal(c, img[1:3, 2:5])
+
+
+def test_fake_dataset_loader():
+    ds = FakeData(size=8, image_shape=(3, 8, 8), num_classes=3)
+    loader = pt.io.DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 2
+    xb, yb = batches[0]
+    assert tuple(np.asarray(xb).shape) == (4, 3, 8, 8)
