@@ -4,3 +4,13 @@ from .gpt import (  # noqa: F401
     gpt_13b, gpt_1p3b, gpt_6p7b, gpt_tiny,
 )
 from .lenet import LeNet  # noqa: F401
+from . import llama  # noqa: F401
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel, build_llama_train_step,
+    llama_13b, llama_70b, llama_7b, llama_tiny,
+)
+from . import bert  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig, BertForPretraining, BertForSequenceClassification,
+    BertModel, bert_base, bert_large, bert_tiny,
+)

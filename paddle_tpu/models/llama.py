@@ -1,0 +1,523 @@
+"""Llama model family — BASELINE configs 5/6 (Llama-2 7B/13B, sharding
+stage2/3 + fused kernels).
+
+Reference parity: PaddleNLP-style Llama built from the reference's
+fleet.meta_parallel mp layers (mp_layers.py:47/334/541) and the incubate
+fused ops it consumes (fused_rms_norm — incubate/nn/functional/fused_rms_norm.py,
+fused_rotary_position_embedding — fused_rope_kernel.cu:27, swiglu —
+phi/kernels/swiglu_kernel.h).  TPU-first design:
+
+* :class:`LlamaForCausalLM` — imperative ``Layer`` graph (eager / hapi /
+  DistributedEngine).  GQA (``num_kv_heads``), RoPE, RMSNorm, SwiGLU;
+  optionally tensor-parallel via Column/RowParallelLinear.
+* :func:`build_llama_train_step` — compiled hybrid dp×mp×pp×sp train step
+  over the stacked pure-fn block (lax.scan over layers, shard_map pipeline
+  over the pp axis), mirroring models/gpt.py's flagship path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.attr import ParamAttr
+from ..nn.layer.common import Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import RMSNorm
+from ..parallel.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                  VocabParallelEmbedding)
+from ..parallel.topology import (DP_AXIS, MP_AXIS, PP_AXIS, SEP_AXIS,
+                                 SHARDING_AXIS, get_topology)
+
+__all__ = ["LlamaConfig", "LlamaAttention", "LlamaMLP", "LlamaBlock",
+           "LlamaModel", "LlamaForCausalLM", "llama_tiny", "llama_7b",
+           "llama_13b", "llama_70b", "build_llama_train_step"]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None  # None => MHA; < num_heads => GQA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    use_mp: bool = False
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("num_kv_heads", 2)
+    kw.setdefault("max_position_embeddings", 128)
+    return LlamaConfig(**kw)
+
+
+def llama_7b(**kw) -> LlamaConfig:
+    return LlamaConfig(hidden_size=4096, intermediate_size=11008,
+                       num_layers=32, num_heads=32, **kw)
+
+
+def llama_13b(**kw) -> LlamaConfig:
+    return LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                       num_layers=40, num_heads=40, **kw)
+
+
+def llama_70b(**kw) -> LlamaConfig:
+    return LlamaConfig(hidden_size=8192, intermediate_size=28672,
+                       num_layers=80, num_heads=64, num_kv_heads=8, **kw)
+
+
+def _rope_cos_sin(seq_len: int, head_dim: int, theta: float, dtype):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)                      # [s, d/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [s, d]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    d = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., d:], x[..., :d]], axis=-1)
+
+
+def apply_rope(q, k, cos, sin):
+    """q,k: [b, s, h, d]; cos/sin: [s, d]."""
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    return (q * cos + _rotate_half(q) * sin,
+            k * cos + _rotate_half(k) * sin)
+
+
+from ..core.dispatch import primitive
+
+
+@primitive("llama_attention")
+def _rope_gqa_attention(q, k, v, cos, sin):
+    """Taped eager op: RoPE + grouped-query causal attention, pure jnp.
+    q: [b,s,hq,d]; k,v: [b,s,hkv,d]; cos/sin: [s,d]."""
+    q, k = apply_rope(q, k, cos, sin)
+    return _gqa_attention(q, k, v, causal=True)
+
+
+def _gqa_attention(q, k, v, causal=True):
+    """q: [b, s, hq, d]; k,v: [b, s, hkv, d] with hq % hkv == 0."""
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    if hkv != hq:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+    else:
+        logits = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        h, d = cfg.hidden_size, cfg.head_dim
+        kvh = cfg.kv_heads
+        if cfg.use_mp:
+            self.q_proj = ColumnParallelLinear(h, cfg.num_heads * d,
+                                               has_bias=False,
+                                               gather_output=False)
+            self.k_proj = ColumnParallelLinear(h, kvh * d, has_bias=False,
+                                               gather_output=False)
+            self.v_proj = ColumnParallelLinear(h, kvh * d, has_bias=False,
+                                               gather_output=False)
+            self.o_proj = RowParallelLinear(cfg.num_heads * d, h,
+                                            has_bias=False,
+                                            input_is_parallel=True)
+        else:
+            self.q_proj = Linear(h, cfg.num_heads * d, bias_attr=False)
+            self.k_proj = Linear(h, kvh * d, bias_attr=False)
+            self.v_proj = Linear(h, kvh * d, bias_attr=False)
+            self.o_proj = Linear(cfg.num_heads * d, h, bias_attr=False)
+
+    def forward(self, x, cos, sin):
+        from ..ops import api as _api
+        cfg = self.cfg
+        b, s = x.shape[0], x.shape[1]
+        q = _api.reshape(self.q_proj(x), [b, s, cfg.num_heads, cfg.head_dim])
+        k = _api.reshape(self.k_proj(x), [b, s, cfg.kv_heads, cfg.head_dim])
+        v = _api.reshape(self.v_proj(x), [b, s, cfg.kv_heads, cfg.head_dim])
+        out = _rope_gqa_attention(q, k, v, cos, sin)
+        out = _api.reshape(out, [b, s, cfg.num_heads * cfg.head_dim])
+        return self.o_proj(out)
+
+
+class LlamaMLP(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        h, f = cfg.hidden_size, cfg.intermediate_size
+        if cfg.use_mp:
+            self.gate_proj = ColumnParallelLinear(h, f, has_bias=False,
+                                                  gather_output=False)
+            self.up_proj = ColumnParallelLinear(h, f, has_bias=False,
+                                                gather_output=False)
+            self.down_proj = RowParallelLinear(f, h, has_bias=False,
+                                               input_is_parallel=True)
+        else:
+            self.gate_proj = Linear(h, f, bias_attr=False)
+            self.up_proj = Linear(h, f, bias_attr=False)
+            self.down_proj = Linear(f, h, bias_attr=False)
+
+    def forward(self, x):
+        from ..incubate.nn.functional import swiglu
+        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaBlock(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(cfg.hidden_size,
+                                       epsilon=cfg.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(cfg.hidden_size,
+                                                epsilon=cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x, cos, sin):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin)
+        return x + self.mlp(self.post_attention_layernorm(x))
+
+
+class LlamaModel(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        attr = ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
+        if cfg.use_mp:
+            self.embed_tokens = VocabParallelEmbedding(
+                cfg.vocab_size, cfg.hidden_size, weight_attr=attr)
+        else:
+            self.embed_tokens = Embedding(cfg.vocab_size, cfg.hidden_size,
+                                          weight_attr=attr)
+        self.layers = LayerList([LlamaBlock(cfg)
+                                 for _ in range(cfg.num_layers)])
+        self.norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+
+    def forward(self, input_ids):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        cos, sin = _rope_cos_sin(s, cfg.head_dim, cfg.rope_theta,
+                                 jnp.dtype(cfg.dtype))
+        x = self.embed_tokens(input_ids)
+        for blk in self.layers:
+            x = blk(x, cos, sin)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.llama = LlamaModel(cfg)
+        if not cfg.tie_word_embeddings:
+            if cfg.use_mp:
+                self.lm_head = ColumnParallelLinear(
+                    cfg.hidden_size, cfg.vocab_size, has_bias=False)
+            else:
+                self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                                      bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        from ..ops import api as _api
+        h = self.llama(input_ids)
+        if self.cfg.tie_word_embeddings:
+            logits = _api.matmul(h, self.llama.embed_tokens.weight,
+                                 transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        if labels is not None:
+            return F.cross_entropy(
+                _api.reshape(logits, [-1, self.cfg.vocab_size]),
+                _api.reshape(labels, [-1]))
+        return logits
+
+
+# ---------------------------------------------------------------------------
+# Pipelined pure-function path (flagship compiled train step)
+# ---------------------------------------------------------------------------
+def init_block_params(cfg: LlamaConfig, key) -> Dict[str, jax.Array]:
+    h, f, d = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
+    std = cfg.initializer_range
+    ks = jax.random.split(key, 7)
+    dt = jnp.dtype(cfg.dtype)
+    kvd = cfg.kv_heads * d
+    return {
+        "ln1_w": jnp.ones((h,), dt), "ln2_w": jnp.ones((h,), dt),
+        "q_w": jax.random.normal(ks[0], (h, cfg.num_heads * d), dt) * std,
+        "k_w": jax.random.normal(ks[1], (h, kvd), dt) * std,
+        "v_w": jax.random.normal(ks[2], (h, kvd), dt) * std,
+        "o_w": jax.random.normal(ks[3], (cfg.num_heads * d, h), dt) * std,
+        "gate_w": jax.random.normal(ks[4], (h, f), dt) * std,
+        "up_w": jax.random.normal(ks[5], (h, f), dt) * std,
+        "down_w": jax.random.normal(ks[6], (f, h), dt) * std,
+    }
+
+
+def block_param_specs(cfg: LlamaConfig, pipeline: bool) -> Dict[str, P]:
+    base = {
+        "ln1_w": P(), "ln2_w": P(),
+        "q_w": P(None, MP_AXIS), "k_w": P(None, MP_AXIS),
+        "v_w": P(None, MP_AXIS), "o_w": P(MP_AXIS, None),
+        "gate_w": P(None, MP_AXIS), "up_w": P(None, MP_AXIS),
+        "down_w": P(MP_AXIS, None),
+    }
+    if not pipeline:
+        return base
+    return {k: P(PP_AXIS, None, *list(v)) for k, v in base.items()}
+
+
+def block_apply(params: Dict[str, jax.Array], x: jax.Array,
+                cfg: LlamaConfig, cos, sin, attn_fn=None) -> jax.Array:
+    """One Llama block, pure jnp (stacked under lax.scan)."""
+    b, s, h = x.shape
+
+    def rms(v, w):
+        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), -1, keepdims=True)
+        return (v * jax.lax.rsqrt(ms + cfg.rms_norm_eps)).astype(v.dtype) * w
+
+    res = x
+    y = rms(x, params["ln1_w"])
+    q = (y @ params["q_w"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = (y @ params["k_w"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+    v = (y @ params["v_w"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+    q, k = apply_rope(q, k, cos, sin)
+    if attn_fn is not None:
+        if cfg.kv_heads != cfg.num_heads:
+            rep = cfg.num_heads // cfg.kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attn = attn_fn(q, k, v)
+    else:
+        attn = _gqa_attention(q, k, v, causal=True)
+    x = res + attn.reshape(b, s, cfg.num_heads * cfg.head_dim) @ params["o_w"]
+    res = x
+    y = rms(x, params["ln2_w"])
+    y = jax.nn.silu(y @ params["gate_w"]) * (y @ params["up_w"])
+    return res + y @ params["down_w"]
+
+
+def stack_block_params(cfg: LlamaConfig, key, num_stages: int
+                      ) -> Dict[str, jax.Array]:
+    per = cfg.num_layers // num_stages
+    keys = jax.random.split(key, cfg.num_layers)
+    blocks = [init_block_params(cfg, k) for k in keys]
+    return {name: jnp.stack([b[name] for b in blocks]).reshape(
+        (num_stages, per) + blocks[0][name].shape)
+        for name in blocks[0]}
+
+
+def build_llama_train_step(cfg: LlamaConfig, topo=None,
+                           num_microbatches: int = 4,
+                           learning_rate: float = 1e-4,
+                           cp_mode: str = None):
+    """Compiled hybrid dp×mp×pp×sp Llama train step (mirrors
+    models/gpt.py:build_gpt_train_step; see that docstring).
+
+    Returns (step_fn, init_fn)."""
+    from ..parallel.pipeline import spmd_pipeline
+    topo = topo or get_topology()
+    S = topo.get_pipe_parallel_world_size()
+    mesh = topo.mesh
+    if cfg.num_layers % S != 0:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by pp degree {S}")
+    data_axes = tuple(a for a in (DP_AXIS, SHARDING_AXIS)
+                      if topo.axis_size(a) > 1) or (DP_AXIS,)
+    sep = topo.get_sep_parallel_world_size()
+    if cp_mode not in (None, "ring", "ulysses"):
+        raise ValueError(f"unknown cp_mode {cp_mode!r}")
+    use_cp = cp_mode is not None and sep > 1
+    if use_cp:
+        from ..parallel.context_parallel import (
+            ring_flash_attention, ulysses_attention)
+        if cp_mode == "ring":
+            def cp_attn(q, k, v):
+                return ring_flash_attention(q, k, v, SEP_AXIS, True)
+        else:
+            def cp_attn(q, k, v):
+                return ulysses_attention(q, k, v, SEP_AXIS, True)
+    else:
+        cp_attn = None
+
+    def sh(spec):
+        return NamedSharding(mesh, spec)
+
+    blk_specs = block_param_specs(cfg, pipeline=True)
+
+    def init_fn(seed: int = 0):
+        key = jax.random.key(seed)
+        k1, k2, k3 = jax.random.split(key, 3)
+        dt = jnp.dtype(cfg.dtype)
+        params = {
+            "wte": jax.device_put(
+                jax.random.normal(k1, (cfg.vocab_size, cfg.hidden_size), dt)
+                * cfg.initializer_range, sh(P(MP_AXIS, None))),
+            "head": jax.device_put(
+                jax.random.normal(k2, (cfg.hidden_size, cfg.vocab_size), dt)
+                * cfg.initializer_range, sh(P(None, MP_AXIS))),
+            "lnf_w": jax.device_put(jnp.ones(cfg.hidden_size, dt), sh(P())),
+            "blocks": {n: jax.device_put(v, sh(blk_specs[n]))
+                       for n, v in stack_block_params(cfg, k3, S).items()},
+        }
+        opt = {
+            "m": jax.tree.map(lambda v: jnp.zeros_like(v, jnp.float32),
+                              params),
+            "v": jax.tree.map(lambda v: jnp.zeros_like(v, jnp.float32),
+                              params),
+            "t": jnp.zeros((), jnp.int32),
+        }
+        return {"params": params, "opt": opt}
+
+    def forward_loss(params, ids, labels):
+        b, s = ids.shape
+        cos, sin = _rope_cos_sin(s, cfg.head_dim, cfg.rope_theta,
+                                 jnp.dtype(cfg.dtype))
+        x = jnp.take(params["wte"], ids, axis=0)
+        x = jax.lax.with_sharding_constraint(
+            x, sh(P(data_axes, SEP_AXIS, None)))
+
+        if S > 1:
+            M = num_microbatches
+            mbs = x.reshape(M, b // M, s, cfg.hidden_size)
+
+            def stage_fn(blk_local, h):
+                local = jax.tree.map(lambda v: v[0], blk_local)
+                if use_cp:
+                    # seq dim is sep-sharded inside this shard_map: each rank
+                    # sees chunk [sidx*chunk, (sidx+1)*chunk) of positions
+                    sidx = jax.lax.axis_index(SEP_AXIS)
+                    chunk = h.shape[1]
+                    lcos = jax.lax.dynamic_slice_in_dim(
+                        cos, sidx * chunk, chunk, 0)
+                    lsin = jax.lax.dynamic_slice_in_dim(
+                        sin, sidx * chunk, chunk, 0)
+                else:
+                    lcos, lsin = cos, sin
+
+                def body(carry, layer_params):
+                    return block_apply(layer_params, carry, cfg, lcos, lsin,
+                                       cp_attn), None
+                out, _ = jax.lax.scan(body, h, local)
+                return out
+
+            def pp_inner(blk_local, mb_local):
+                outs = spmd_pipeline(stage_fn, blk_local, mb_local, S,
+                                     remat=True)
+                is_last = (jax.lax.axis_index(PP_AXIS) == S - 1)
+                return jax.lax.psum(
+                    outs * is_last.astype(outs.dtype), PP_AXIS)
+
+            blk_in_specs = jax.tree.map(lambda _: P(PP_AXIS),
+                                        params["blocks"])
+            mb_spec = P(None, None, SEP_AXIS, None) if use_cp else P(None)
+            axis_names = {PP_AXIS, SEP_AXIS} if use_cp else {PP_AXIS}
+            x = jax.shard_map(
+                pp_inner, mesh=mesh,
+                in_specs=(blk_in_specs, mb_spec),
+                out_specs=mb_spec, axis_names=axis_names,
+                check_vma=False)(params["blocks"], mbs)
+            x = x.reshape(b, s, cfg.hidden_size)
+        else:
+            flat_blocks = jax.tree.map(
+                lambda v: v.reshape((cfg.num_layers,) + v.shape[2:]),
+                params["blocks"])
+            if use_cp:
+                def blocks_inner(blk, x_local):
+                    sidx = jax.lax.axis_index(SEP_AXIS)
+                    chunk = x_local.shape[1]
+                    lcos = jax.lax.dynamic_slice_in_dim(
+                        cos, sidx * chunk, chunk, 0)
+                    lsin = jax.lax.dynamic_slice_in_dim(
+                        sin, sidx * chunk, chunk, 0)
+
+                    def body(carry, layer_params):
+                        return block_apply(layer_params, carry, cfg,
+                                           lcos, lsin, cp_attn), None
+                    out, _ = jax.lax.scan(body, x_local, blk)
+                    return out
+                blk_specs_in = jax.tree.map(lambda _: P(), flat_blocks)
+                x = jax.shard_map(
+                    blocks_inner, mesh=mesh,
+                    in_specs=(blk_specs_in, P(None, SEP_AXIS, None)),
+                    out_specs=P(None, SEP_AXIS, None),
+                    axis_names={SEP_AXIS}, check_vma=False)(flat_blocks, x)
+            else:
+                def body(carry, layer_params):
+                    return block_apply(layer_params, carry, cfg, cos,
+                                       sin), None
+                x, _ = jax.lax.scan(body, x, flat_blocks)
+
+        ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        x = (x * jax.lax.rsqrt(ms + cfg.rms_norm_eps)).astype(x.dtype) \
+            * params["lnf_w"]
+        logits = (x @ params["head"]).astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits, -1)
+        nll = -jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    b1, b2, eps = 0.9, 0.95, 1e-8
+
+    def step(state, ids, labels):
+        params, opt = state["params"], state["opt"]
+        loss, grads = jax.value_and_grad(forward_loss)(params, ids, labels)
+        t = opt["t"] + 1
+        tf = t.astype(jnp.float32)
+
+        def upd(p, g, m, v):
+            g32 = g.astype(jnp.float32)
+            m2 = b1 * m + (1 - b1) * g32
+            v2 = b2 * v + (1 - b2) * jnp.square(g32)
+            mh = m2 / (1 - b1 ** tf)
+            vh = v2 / (1 - b2 ** tf)
+            p2 = p.astype(jnp.float32) - learning_rate * mh / (
+                jnp.sqrt(vh) + eps)
+            return p2.astype(p.dtype), m2, v2
+
+        new = jax.tree.map(upd, params, grads, opt["m"], opt["v"])
+        pick = lambda i: jax.tree.map(
+            lambda x: x[i], new, is_leaf=lambda x: isinstance(x, tuple))
+        return ({"params": pick(0), "opt": {"m": pick(1), "v": pick(2),
+                                            "t": t}}, loss)
+
+    data_sh = sh(P(data_axes))
+    step_fn = jax.jit(step, donate_argnums=(0,),
+                      in_shardings=(None, data_sh, data_sh),
+                      out_shardings=None)
+    return step_fn, init_fn

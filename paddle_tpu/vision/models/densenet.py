@@ -8,7 +8,7 @@ from ...nn.layer.conv import Conv2D
 from ...nn.layer.norm import BatchNorm2D
 from ...nn.layer.activation import ReLU
 from ...nn.layer.pooling import MaxPool2D, AvgPool2D, AdaptiveAvgPool2D
-from ...nn.layer.common import Linear
+from ...nn.layer.common import Dropout, Linear
 from ...ops.api import concat
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
@@ -24,7 +24,7 @@ _cfgs = {
 
 
 class DenseLayer(Layer):
-    def __init__(self, cin, growth_rate, bn_size):
+    def __init__(self, cin, growth_rate, bn_size, dropout=0.0):
         super().__init__()
         self.norm1 = BatchNorm2D(cin)
         self.relu = ReLU()
@@ -32,18 +32,21 @@ class DenseLayer(Layer):
         self.norm2 = BatchNorm2D(bn_size * growth_rate)
         self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
                             bias_attr=False)
+        self.dropout = Dropout(dropout) if dropout > 0 else None
 
     def forward(self, x):
         out = self.conv1(self.relu(self.norm1(x)))
         out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
         return concat([x, out], axis=1)
 
 
 class DenseBlock(Layer):
-    def __init__(self, num_layers, cin, growth_rate, bn_size):
+    def __init__(self, num_layers, cin, growth_rate, bn_size, dropout=0.0):
         super().__init__()
         self.block = Sequential(*[
-            DenseLayer(cin + i * growth_rate, growth_rate, bn_size)
+            DenseLayer(cin + i * growth_rate, growth_rate, bn_size, dropout)
             for i in range(num_layers)])
 
     def forward(self, x):
@@ -77,7 +80,7 @@ class DenseNet(Layer):
         blocks = []
         nf = num_init_features
         for i, n in enumerate(block_cfg):
-            blocks.append(DenseBlock(n, nf, growth_rate, bn_size))
+            blocks.append(DenseBlock(n, nf, growth_rate, bn_size, dropout))
             nf += n * growth_rate
             if i != len(block_cfg) - 1:
                 blocks.append(TransitionLayer(nf, nf // 2))
