@@ -87,3 +87,13 @@ def enable_static():
 from . import models  # noqa: F401
 from . import parallel  # noqa: F401
 from . import distributed  # noqa: F401
+import importlib as _importlib
+
+# ops.api star-import may have bound same-named functions (e.g. `fft`) on the
+# package; import_module + explicit rebind makes the namespace modules win,
+# matching the reference where paddle.fft / paddle.signal are modules.
+linalg = _importlib.import_module(".linalg", __name__)
+fft = _importlib.import_module(".fft", __name__)
+signal = _importlib.import_module(".signal", __name__)
+from . import distribution  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402

@@ -1,0 +1,154 @@
+"""AMP debugging tools (reference python/paddle/amp/debugging.py —
+TensorCheckerConfig, enable_tensor_checker, collect_operator_stats,
+compare_accuracy; SURVEY §5 race-detection/correctness guards).
+
+TPU-first: NaN/Inf checking hooks into the eager dispatcher's
+``FLAGS.check_nan_inf`` path (core/dispatch.py) rather than per-kernel CUDA
+checks; tensor stats are computed with jnp reductions on device.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+from collections import defaultdict
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.flags import FLAGS
+from ..core.tensor import Tensor
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "compare_accuracy", "tensor_stats"]
+
+
+class DebugMode(enum.Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable: bool = False,
+                 debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir: Optional[str] = None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = list(checked_op_list or [])
+        self.skipped_op_list = list(skipped_op_list or [])
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+
+
+_CURRENT_CONFIG: Optional[TensorCheckerConfig] = None
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    """Turn on per-op NaN/Inf checking in the eager dispatcher."""
+    global _CURRENT_CONFIG
+    _CURRENT_CONFIG = config
+    FLAGS.check_nan_inf = bool(config.enable)
+    FLAGS.check_nan_inf_level = (
+        0 if config.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT else 1)
+
+
+def disable_tensor_checker():
+    global _CURRENT_CONFIG
+    _CURRENT_CONFIG = None
+    FLAGS.check_nan_inf = False
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Explicit numerics check; returns (num_nan, num_inf, num_zero)."""
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    num_nan = int(jnp.sum(jnp.isnan(v)))
+    num_inf = int(jnp.sum(jnp.isinf(v)))
+    num_zero = int(jnp.sum(v == 0))
+    if (num_nan or num_inf) and \
+            debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        raise FloatingPointError(
+            f"[check_numerics] op={op_type} var={var_name}: "
+            f"{num_nan} NaN, {num_inf} Inf")
+    return (Tensor(jnp.asarray(num_nan)), Tensor(jnp.asarray(num_inf)),
+            Tensor(jnp.asarray(num_zero)))
+
+
+def tensor_stats(tensor) -> dict:
+    """min/max/mean/std/num_nan/num_inf for a tensor (debugging aid)."""
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    vf = v.astype(jnp.float32)
+    return {
+        "shape": tuple(v.shape), "dtype": str(v.dtype),
+        "min": float(jnp.min(vf)), "max": float(jnp.max(vf)),
+        "mean": float(jnp.mean(vf)), "std": float(jnp.std(vf)),
+        "num_nan": int(jnp.sum(jnp.isnan(vf))),
+        "num_inf": int(jnp.sum(jnp.isinf(vf))),
+    }
+
+
+# -- operator stats ---------------------------------------------------------
+_OP_STATS: Optional[dict] = None
+
+
+def _record_op(name: str, dtype) -> None:
+    if _OP_STATS is not None:
+        _OP_STATS[name][str(dtype)] += 1
+
+
+def enable_operator_stats_collection():
+    """Count op calls by dtype (reference low-precision op counting)."""
+    global _OP_STATS
+    _OP_STATS = defaultdict(lambda: defaultdict(int))
+    from ..core import dispatch
+    dispatch._op_stats_hook = _record_op
+
+
+def disable_operator_stats_collection():
+    from ..core import dispatch
+    dispatch._op_stats_hook = None
+    stats = _OP_STATS
+    if stats:
+        print("<------------------operator stats------------------>")
+        for op, dtypes in sorted(stats.items()):
+            counts = ", ".join(f"{d}: {c}" for d, c in sorted(
+                dtypes.items()))
+            print(f"  {op:<30} {counts}")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def compare_accuracy(dump_path: str, another_dump_path: str,
+                     output_filename: str, loss_scale=1,
+                     dump_all_tensors=False):
+    """Compare two tensor-stat dumps written by tensor_stats loops; writes
+    a CSV of mismatches.  (Reference writes xlsx; CSV keeps zero deps.)"""
+    import csv
+    import json
+    with open(dump_path) as f:
+        a = json.load(f)
+    with open(another_dump_path) as f:
+        b = json.load(f)
+    keys = sorted(set(a) & set(b))
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["name", "mean_a", "mean_b", "abs_diff"])
+        for k in keys:
+            d = abs(a[k].get("mean", 0) - b[k].get("mean", 0))
+            w.writerow([k, a[k].get("mean"), b[k].get("mean"), d])
+    return output_filename

@@ -44,6 +44,9 @@ def _is_tensor_leaf(x: Any) -> bool:
 # op name -> forward fn (impl); populated by ops.registry
 _FORWARD_CACHE: Dict[Any, Callable] = {}
 
+# optional per-op-call hook set by amp.debugging operator-stats collection
+_op_stats_hook: Optional[Callable] = None
+
 
 def _exec_cached(exec_key: Tuple, call: Callable) -> Callable:
     fn = _FORWARD_CACHE.get(exec_key)
@@ -114,6 +117,12 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict,
             static.append(leaf)
 
     dyn_set = tuple(dyn_idx)
+
+    if _op_stats_hook is not None:
+        _dt = next((jnp.asarray(v).dtype for v in dyn_values
+                    if hasattr(v, "dtype")
+                    or isinstance(v, (np.ndarray, np.generic))), None)
+        _op_stats_hook(name, _dt)
 
     def call(dyn_vals):
         new_leaves = list(static)

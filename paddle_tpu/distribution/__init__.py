@@ -1,0 +1,45 @@
+"""paddle.distribution parity (reference python/paddle/distribution/ — 30
+files: Distribution base, ~20 concrete distributions, transforms,
+kl_divergence registry).
+
+TPU-first: every density/sample is pure jnp (jit-safe under ``to_static``);
+sampling draws keys from the framework RNG (core/rng.py) so seeding via
+``paddle_tpu.seed`` is reproducible.
+"""
+
+from .distribution import Distribution, ExponentialFamily  # noqa: F401
+from .normal import LogNormal, Normal  # noqa: F401
+from .uniform import Uniform  # noqa: F401
+from .categorical import Categorical  # noqa: F401
+from .bernoulli import Bernoulli, ContinuousBernoulli, Geometric  # noqa: F401
+from .beta import Beta  # noqa: F401
+from .dirichlet import Dirichlet  # noqa: F401
+from .gamma import Chi2, Exponential, Gamma  # noqa: F401
+from .laplace import Laplace  # noqa: F401
+from .multinomial import Binomial, Multinomial  # noqa: F401
+from .cauchy import Cauchy  # noqa: F401
+from .gumbel import Gumbel  # noqa: F401
+from .poisson import Poisson  # noqa: F401
+from .student_t import StudentT  # noqa: F401
+from .independent import Independent  # noqa: F401
+from .transformed_distribution import TransformedDistribution  # noqa: F401
+from .transform import (  # noqa: F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+    Transform,
+)
+from .kl import kl_divergence, register_kl  # noqa: F401
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Normal", "LogNormal", "Uniform",
+    "Categorical", "Bernoulli", "ContinuousBernoulli", "Geometric", "Beta",
+    "Dirichlet", "Gamma", "Chi2", "Exponential", "Laplace", "Multinomial",
+    "Binomial", "Cauchy", "Gumbel", "Poisson", "StudentT", "Independent",
+    "TransformedDistribution", "Transform", "AbsTransform",
+    "AffineTransform", "ChainTransform", "ExpTransform",
+    "IndependentTransform", "PowerTransform", "ReshapeTransform",
+    "SigmoidTransform", "SoftmaxTransform", "StackTransform",
+    "StickBreakingTransform", "TanhTransform", "kl_divergence",
+    "register_kl",
+]

@@ -1,0 +1,171 @@
+"""Bernoulli / ContinuousBernoulli / Geometric (reference
+python/paddle/distribution/{bernoulli,continuous_bernoulli,geometric}.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import ExponentialFamily, Distribution, _to_jnp, _wrap
+
+_EPS = 1e-7
+
+
+def _clip_p(p):
+    return jnp.clip(p, _EPS, 1 - _EPS)
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs, name=None):
+        self.probs_param = _clip_p(_to_jnp(probs))
+        super().__init__(self.probs_param.shape, ())
+
+    @property
+    def probs(self):
+        return _wrap(self.probs_param)
+
+    @property
+    def logits(self):
+        p = self.probs_param
+        return _wrap(jnp.log(p) - jnp.log1p(-p))
+
+    @property
+    def mean(self):
+        return _wrap(self.probs_param)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs_param * (1 - self.probs_param))
+
+    def _sample(self, shape, key):
+        out = self._extend_shape(shape)
+        return jax.random.bernoulli(
+            key, self.probs_param, out).astype(self.probs_param.dtype)
+
+    def _log_prob(self, value):
+        p = self.probs_param
+        return value * jnp.log(p) + (1 - value) * jnp.log1p(-p)
+
+    def _entropy(self):
+        p = self.probs_param
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+    def _cdf(self, value):
+        p = self.probs_param
+        return jnp.where(value < 0, 0.0,
+                         jnp.where(value < 1, 1 - p, 1.0))
+
+
+class ContinuousBernoulli(Distribution):
+    """Continuous Bernoulli on [0,1] (Loaiza-Ganem & Cunningham 2019)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs_param = _clip_p(_to_jnp(probs))
+        self._lims = lims
+        super().__init__(self.probs_param.shape, ())
+
+    def _outside(self):
+        lo, hi = self._lims
+        return (self.probs_param < lo) | (self.probs_param > hi)
+
+    def _log_norm_const(self):
+        # C(p) = log |2 atanh(1-2p) / (1-2p)| for p != 0.5, else log 2
+        p = self.probs_param
+        safe = jnp.where(self._outside(), p, 0.4)
+        x = 1 - 2 * safe
+        log_c = jnp.log(jnp.abs(2 * jnp.arctanh(x))) - jnp.log(jnp.abs(x))
+        # Taylor around p=0.5: log 2 + log(1 + x^2/3 + ...)
+        t = 1 - 2 * p
+        taylor = jnp.log(2.0) + (4.0 / 3) * jnp.square(t) / 2
+        return jnp.where(self._outside(), log_c, taylor)
+
+    @property
+    def mean(self):
+        p = self.probs_param
+        safe = jnp.where(self._outside(), p, 0.4)
+        x = 1 - 2 * safe
+        m = safe / x + 1 / (2 * jnp.arctanh(x))
+        return _wrap(jnp.where(self._outside(), m,
+                               0.5 + (p - 0.5) / 3))
+
+    @property
+    def variance(self):
+        # numeric: var = E[v^2]-mean^2 via quadrature is overkill; use the
+        # closed form v = p(1-p)/x^2 + 1/(2 atanh(x))^2 with Taylor fallback
+        p = self.probs_param
+        safe = jnp.where(self._outside(), p, 0.4)
+        x = 1 - 2 * safe
+        v = safe * (1 - safe) / jnp.square(x) \
+            + 1 / jnp.square(2 * jnp.arctanh(x))
+        return _wrap(jnp.where(self._outside(), v,
+                               1.0 / 12 - jnp.square(p - 0.5) / 15))
+
+    def _rsample(self, shape, key):
+        out = self._extend_shape(shape)
+        u = jax.random.uniform(key, out, self.probs_param.dtype,
+                               minval=_EPS, maxval=1 - _EPS)
+        return self._icdf(u)
+
+    def _log_prob(self, value):
+        p = self.probs_param
+        return (value * jnp.log(p) + (1 - value) * jnp.log1p(-p)
+                + self._log_norm_const())
+
+    def _cdf(self, value):
+        p = self.probs_param
+        safe = jnp.where(self._outside(), p, 0.4)
+        num = (jnp.power(safe, value) * jnp.power(1 - safe, 1 - value)
+               + safe - 1)
+        cdf = num / (2 * safe - 1)
+        return jnp.clip(jnp.where(self._outside(), cdf, value), 0., 1.)
+
+    def _icdf(self, value):
+        p = self.probs_param
+        safe = jnp.where(self._outside(), p, 0.4)
+        ratio = jnp.log1p(-safe) - jnp.log(safe)
+        x = (jnp.log1p(value * (2 * safe - 1) / (1 - safe))) / (-ratio)
+        return jnp.where(self._outside(), x, value)
+
+    def _entropy(self):
+        p = self.probs_param
+        m = jnp.asarray(self.mean._value)
+        return -(m * jnp.log(p) + (1 - m) * jnp.log1p(-p)
+                 + self._log_norm_const())
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_param = _clip_p(_to_jnp(probs))
+        super().__init__(self.probs_param.shape, ())
+
+    @property
+    def mean(self):
+        return _wrap((1 - self.probs_param) / self.probs_param)
+
+    @property
+    def variance(self):
+        p = self.probs_param
+        return _wrap((1 - p) / jnp.square(p))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.sqrt(jnp.asarray(self.variance._value)))
+
+    def _sample(self, shape, key):
+        out = self._extend_shape(shape)
+        u = jax.random.uniform(key, out, self.probs_param.dtype,
+                               minval=_EPS, maxval=1 - _EPS)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_param))
+
+    def _log_prob(self, value):
+        p = self.probs_param
+        return value * jnp.log1p(-p) + jnp.log(p)
+
+    def _entropy(self):
+        p = self.probs_param
+        return -((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p
+
+    def _cdf(self, value):
+        return 1 - jnp.power(1 - self.probs_param, jnp.floor(value) + 1)

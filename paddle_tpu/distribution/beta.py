@@ -1,0 +1,40 @@
+"""Beta (reference python/paddle/distribution/beta.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betaln, digamma
+
+from .distribution import ExponentialFamily, _to_jnp, _wrap
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _to_jnp(alpha)
+        self.beta = _to_jnp(beta)
+        batch = jnp.broadcast_shapes(self.alpha.shape, self.beta.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(self.alpha * self.beta / (jnp.square(s) * (s + 1)))
+
+    def _rsample(self, shape, key):
+        out = self._extend_shape(shape)
+        return jax.random.beta(key, self.alpha, self.beta, out)
+
+    def _log_prob(self, value):
+        return ((self.alpha - 1) * jnp.log(value)
+                + (self.beta - 1) * jnp.log1p(-value)
+                - betaln(self.alpha, self.beta))
+
+    def _entropy(self):
+        a, b = self.alpha, self.beta
+        return (betaln(a, b) - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                + (a + b - 2) * digamma(a + b))

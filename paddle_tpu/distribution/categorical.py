@@ -1,0 +1,54 @@
+"""Categorical (reference python/paddle/distribution/categorical.py).
+
+Paddle's Categorical takes unnormalized ``logits`` and normalizes by the
+sum of probabilities."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _to_jnp, _wrap
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _to_jnp(logits)
+        super().__init__(self.logits.shape[:-1], ())
+
+    @property
+    def probs_array(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    @property
+    def probs(self):
+        return _wrap(self.probs_array)
+
+    @property
+    def mean(self):
+        p = self.probs_array
+        k = jnp.arange(p.shape[-1], dtype=p.dtype)
+        return _wrap(jnp.sum(p * k, -1))
+
+    @property
+    def variance(self):
+        p = self.probs_array
+        k = jnp.arange(p.shape[-1], dtype=p.dtype)
+        m = jnp.sum(p * k, -1, keepdims=True)
+        return _wrap(jnp.sum(p * jnp.square(k - m), -1))
+
+    def _sample(self, shape, key):
+        return jax.random.categorical(
+            key, jax.nn.log_softmax(self.logits, -1),
+            shape=tuple(shape) + self.batch_shape)
+
+    def _log_prob(self, value):
+        lp = jax.nn.log_softmax(self.logits, -1)
+        idx = value.astype(jnp.int32)
+        return jnp.take_along_axis(
+            jnp.broadcast_to(lp, idx.shape + lp.shape[-1:]),
+            idx[..., None], axis=-1)[..., 0]
+
+    def _entropy(self):
+        lp = jax.nn.log_softmax(self.logits, -1)
+        return -jnp.sum(jnp.exp(lp) * lp, -1)

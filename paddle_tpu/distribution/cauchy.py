@@ -1,0 +1,46 @@
+"""Cauchy (reference python/paddle/distribution/cauchy.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _to_jnp, _wrap
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _to_jnp(loc)
+        self.scale = _to_jnp(scale)
+        batch = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    def _rsample(self, shape, key):
+        out = self._extend_shape(shape)
+        return self.loc + self.scale * jax.random.cauchy(
+            key, out, self.loc.dtype)
+
+    def _log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -math.log(math.pi) - jnp.log(self.scale) - jnp.log1p(
+            jnp.square(z))
+
+    def _entropy(self):
+        return jnp.broadcast_to(math.log(4 * math.pi) + jnp.log(self.scale),
+                                self.batch_shape)
+
+    def _cdf(self, value):
+        return jnp.arctan((value - self.loc) / self.scale) / math.pi + 0.5
+
+    def _icdf(self, value):
+        return self.loc + self.scale * jnp.tan(math.pi * (value - 0.5))

@@ -1,0 +1,45 @@
+"""Dirichlet (reference python/paddle/distribution/dirichlet.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import digamma, gammaln
+
+from .distribution import ExponentialFamily, _to_jnp, _wrap
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration, name=None):
+        self.concentration = _to_jnp(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return _wrap(c / jnp.sum(c, -1, keepdims=True))
+
+    @property
+    def variance(self):
+        c = self.concentration
+        c0 = jnp.sum(c, -1, keepdims=True)
+        m = c / c0
+        return _wrap(m * (1 - m) / (c0 + 1))
+
+    def _rsample(self, shape, key):
+        return jax.random.dirichlet(key, self.concentration,
+                                    tuple(shape) + self.batch_shape)
+
+    def _log_prob(self, value):
+        c = self.concentration
+        return (jnp.sum((c - 1) * jnp.log(value), -1)
+                + gammaln(jnp.sum(c, -1)) - jnp.sum(gammaln(c), -1))
+
+    def _entropy(self):
+        c = self.concentration
+        c0 = jnp.sum(c, -1)
+        k = c.shape[-1]
+        return (jnp.sum(gammaln(c), -1) - gammaln(c0)
+                + (c0 - k) * digamma(c0)
+                - jnp.sum((c - 1) * digamma(c), -1))

@@ -1,0 +1,138 @@
+"""Distribution base class (reference
+python/paddle/distribution/distribution.py) + shared helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.rng import next_rng_key
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "ExponentialFamily", "_to_jnp", "_wrap",
+           "_shape_tuple"]
+
+
+def _to_jnp(x, dtype=None):
+    """Accept Tensor / ndarray / python scalar, return jnp array."""
+    if isinstance(x, Tensor):
+        v = x._value
+    else:
+        v = x
+    arr = jnp.asarray(v)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    elif arr.dtype in (jnp.int32, jnp.int64) and not jnp.issubdtype(
+            arr.dtype, jnp.floating):
+        arr = arr.astype(jnp.float32)
+    return arr
+
+
+def _wrap(v) -> Tensor:
+    return Tensor(v, stop_gradient=True)
+
+
+def _shape_tuple(shape) -> Tuple[int, ...]:
+    if shape is None:
+        return ()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    """Base API (sample/rsample/prob/log_prob/entropy/kl_divergence),
+    mirroring the reference Distribution (distribution.py:40)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = _shape_tuple(batch_shape)
+        self._event_shape = _shape_tuple(event_shape)
+
+    @property
+    def batch_shape(self) -> Tuple[int, ...]:
+        return self._batch_shape
+
+    @property
+    def event_shape(self) -> Tuple[int, ...]:
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    # -- sampling ---------------------------------------------------------
+    def _next_key(self):
+        return next_rng_key()
+
+    def sample(self, shape=()):
+        return _wrap(jax.lax.stop_gradient(
+            self._sample(_shape_tuple(shape), self._next_key())))
+
+    def rsample(self, shape=()):
+        return _wrap(self._rsample(_shape_tuple(shape), self._next_key()))
+
+    def _sample(self, shape, key):
+        return self._rsample(shape, key)
+
+    def _rsample(self, shape, key):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement rsample")
+
+    # -- densities --------------------------------------------------------
+    def prob(self, value):
+        return _wrap(jnp.exp(self._log_prob(_to_jnp(value))))
+
+    def log_prob(self, value):
+        return _wrap(self._log_prob(_to_jnp(value)))
+
+    def _log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        return _wrap(self._entropy())
+
+    def _entropy(self):
+        raise NotImplementedError
+
+    def cdf(self, value):
+        return _wrap(self._cdf(_to_jnp(value)))
+
+    def _cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        return _wrap(self._icdf(_to_jnp(value)))
+
+    def _icdf(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution"):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(batch_shape={self._batch_shape}, "
+                f"event_shape={self._event_shape})")
+
+
+class ExponentialFamily(Distribution):
+    """Exponential-family base: generic entropy via Bregman identity is not
+    needed on TPU — subclasses give closed forms; kept for API parity
+    (reference exponential_family.py)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError

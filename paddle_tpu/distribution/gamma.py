@@ -1,0 +1,63 @@
+"""Gamma / Exponential / Chi2 (reference
+python/paddle/distribution/{gamma,exponential,chi2}.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import digamma, gammainc, gammaln
+
+from .distribution import ExponentialFamily, _to_jnp, _wrap
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _to_jnp(concentration)
+        self.rate = _to_jnp(rate)
+        batch = jnp.broadcast_shapes(self.concentration.shape,
+                                     self.rate.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.concentration / jnp.square(self.rate))
+
+    def _rsample(self, shape, key):
+        out = self._extend_shape(shape)
+        return jax.random.gamma(key, self.concentration, out) / self.rate
+
+    def _log_prob(self, value):
+        a, b = self.concentration, self.rate
+        return (a * jnp.log(b) + (a - 1) * jnp.log(value) - b * value
+                - gammaln(a))
+
+    def _entropy(self):
+        a, b = self.concentration, self.rate
+        return a - jnp.log(b) + gammaln(a) + (1 - a) * digamma(a)
+
+    def _cdf(self, value):
+        return gammainc(self.concentration, self.rate * value)
+
+
+class Exponential(Gamma):
+    def __init__(self, rate, name=None):
+        rate = _to_jnp(rate)
+        super().__init__(jnp.ones_like(rate), rate)
+
+    def _rsample(self, shape, key):
+        out = self._extend_shape(shape)
+        return jax.random.exponential(key, out, self.rate.dtype) / self.rate
+
+    def _icdf(self, value):
+        return -jnp.log1p(-value) / self.rate
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        df = _to_jnp(df)
+        self.df = df
+        super().__init__(df / 2, jnp.full_like(df, 0.5))

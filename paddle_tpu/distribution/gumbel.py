@@ -1,0 +1,48 @@
+"""Gumbel (reference python/paddle/distribution/gumbel.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _to_jnp, _wrap
+
+_EULER = 0.57721566490153286
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _to_jnp(loc)
+        self.scale = _to_jnp(scale)
+        batch = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap(self.loc + self.scale * _EULER)
+
+    @property
+    def variance(self):
+        return _wrap(jnp.square(math.pi * self.scale) / 6)
+
+    @property
+    def stddev(self):
+        return _wrap(math.pi * self.scale / math.sqrt(6))
+
+    def _rsample(self, shape, key):
+        out = self._extend_shape(shape)
+        return self.loc + self.scale * jax.random.gumbel(
+            key, out, self.loc.dtype)
+
+    def _log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(self.scale) + 1 + _EULER,
+                                self.batch_shape)
+
+    def _cdf(self, value):
+        return jnp.exp(-jnp.exp(-(value - self.loc) / self.scale))

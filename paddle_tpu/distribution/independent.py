@@ -1,0 +1,46 @@
+"""Independent (reference python/paddle/distribution/independent.py):
+reinterpret trailing batch dims of a base distribution as event dims."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _wrap
+
+
+class Independent(Distribution):
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        if self.rank > len(base.batch_shape):
+            raise ValueError(
+                "reinterpreted_batch_rank exceeds base batch rank")
+        split = len(base.batch_shape) - self.rank
+        super().__init__(base.batch_shape[:split],
+                         base.batch_shape[split:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def _sample(self, shape, key):
+        return self.base._sample(shape, key)
+
+    def _rsample(self, shape, key):
+        return self.base._rsample(shape, key)
+
+    def _log_prob(self, value):
+        lp = self.base._log_prob(value)
+        if self.rank == 0:
+            return lp
+        return jnp.sum(lp, axis=tuple(range(-self.rank, 0)))
+
+    def _entropy(self):
+        ent = self.base._entropy()
+        if self.rank == 0:
+            return ent
+        return jnp.sum(ent, axis=tuple(range(-self.rank, 0)))

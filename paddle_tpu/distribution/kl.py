@@ -1,0 +1,141 @@
+"""kl_divergence dispatch registry (reference
+python/paddle/distribution/kl.py — register_kl :40, dispatch by most-derived
+(p,q) class pair)."""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Tuple, Type
+
+import jax.numpy as jnp
+from jax.scipy.special import betaln, digamma, gammaln
+
+from .bernoulli import Bernoulli, Geometric
+from .beta import Beta
+from .categorical import Categorical
+from .dirichlet import Dirichlet
+from .distribution import Distribution, _wrap
+from .gamma import Gamma
+from .gumbel import Gumbel
+from .laplace import Laplace
+from .normal import LogNormal, Normal
+from .poisson import Poisson
+from .uniform import Uniform
+
+_REGISTRY: Dict[Tuple[Type, Type], Callable] = {}
+
+
+def register_kl(p_cls: Type[Distribution], q_cls: Type[Distribution]):
+    def deco(fn):
+        _REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def _dispatch(p_cls, q_cls):
+    matches = [(pc, qc) for (pc, qc) in _REGISTRY
+               if issubclass(p_cls, pc) and issubclass(q_cls, qc)]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL registered for ({p_cls.__name__}, {q_cls.__name__})")
+    # most-derived match: minimal by (mro distance)
+    def depth(pair):
+        pc, qc = pair
+        return (p_cls.__mro__.index(pc) + q_cls.__mro__.index(qc))
+    return _REGISTRY[min(matches, key=depth)]
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    return _wrap(_dispatch(type(p), type(q))(p, q))
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = jnp.square(p.scale / q.scale)
+    t1 = jnp.square((p.loc - q.loc) / q.scale)
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    result = jnp.log((q.high - q.low) / (p.high - p.low))
+    return jnp.where((q.low <= p.low) & (p.high <= q.high), result, jnp.inf)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    import jax
+    lp = jax.nn.log_softmax(p.logits, -1)
+    lq = jax.nn.log_softmax(q.logits, -1)
+    return jnp.sum(jnp.exp(lp) * (lp - lq), -1)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    a, b = p.probs_param, q.probs_param
+    return a * (jnp.log(a) - jnp.log(b)) + (1 - a) * (
+        jnp.log1p(-a) - jnp.log1p(-b))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    return (betaln(q.alpha, q.beta) - betaln(p.alpha, p.beta)
+            + (p.alpha - q.alpha) * digamma(p.alpha)
+            + (p.beta - q.beta) * digamma(p.beta)
+            + (q.alpha - p.alpha + q.beta - p.beta)
+            * digamma(p.alpha + p.beta))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    pc, qc = p.concentration, q.concentration
+    p0 = jnp.sum(pc, -1)
+    return (gammaln(p0) - jnp.sum(gammaln(pc), -1)
+            - gammaln(jnp.sum(qc, -1)) + jnp.sum(gammaln(qc), -1)
+            + jnp.sum((pc - qc) * (digamma(pc)
+                                   - digamma(p0[..., None])), -1))
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    pa, pb, qa, qb = p.concentration, p.rate, q.concentration, q.rate
+    return ((pa - qa) * digamma(pa) - gammaln(pa) + gammaln(qa)
+            + qa * (jnp.log(pb) - jnp.log(qb))
+            + pa * (qb / pb - 1))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    loc_abs = jnp.abs(p.loc - q.loc) / q.scale
+    return (-jnp.log(scale_ratio) + scale_ratio
+            * jnp.exp(-loc_abs / scale_ratio) + loc_abs - 1)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    return p.rate * (jnp.log(p.rate) - jnp.log(q.rate)) \
+        - p.rate + q.rate
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    a, b = p.probs_param, q.probs_param
+    return (jnp.log(a) - jnp.log(b)
+            + (1 - a) / a * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return _kl_normal_normal(p._base, q._base)
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    # E_p[log p - log q]; closed form via Gumbel moments
+    euler = 0.57721566490153286
+    beta_ratio = p.scale / q.scale
+    dloc = (p.loc - q.loc) / q.scale
+    return (jnp.log(q.scale) - jnp.log(p.scale)
+            + euler * (beta_ratio - 1) + dloc
+            + jnp.exp(-dloc + gammaln(1 + beta_ratio)) - 1)

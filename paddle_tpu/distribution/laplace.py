@@ -1,0 +1,54 @@
+"""Laplace (reference python/paddle/distribution/laplace.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _to_jnp, _wrap
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _to_jnp(loc)
+        self.scale = _to_jnp(scale)
+        batch = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(2 * jnp.square(self.scale))
+
+    @property
+    def stddev(self):
+        return _wrap(math.sqrt(2) * self.scale)
+
+    def _rsample(self, shape, key):
+        out = self._extend_shape(shape)
+        u = jax.random.uniform(key, out, self.loc.dtype,
+                               minval=-0.5 + 1e-7, maxval=0.5)
+        return self.loc - self.scale * jnp.sign(u) * jnp.log1p(
+            -2 * jnp.abs(u))
+
+    def _log_prob(self, value):
+        return -jnp.abs(value - self.loc) / self.scale \
+            - jnp.log(2 * self.scale)
+
+    def _entropy(self):
+        return jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                self.batch_shape)
+
+    def _cdf(self, value):
+        z = (value - self.loc) / self.scale
+        return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+
+    def _icdf(self, value):
+        t = value - 0.5
+        return self.loc - self.scale * jnp.sign(t) * jnp.log1p(
+            -2 * jnp.abs(t))

@@ -1,0 +1,83 @@
+"""Normal / LogNormal (reference python/paddle/distribution/normal.py,
+lognormal.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _to_jnp, _wrap
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _to_jnp(loc)
+        self.scale = _to_jnp(scale)
+        batch = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(jnp.square(self.scale),
+                                      self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def _rsample(self, shape, key):
+        out = self._extend_shape(shape)
+        return self.loc + self.scale * jax.random.normal(
+            key, out, self.loc.dtype)
+
+    def _log_prob(self, value):
+        var = jnp.square(self.scale)
+        return (-jnp.square(value - self.loc) / (2 * var)
+                - jnp.log(self.scale) - _HALF_LOG_2PI)
+
+    def _entropy(self):
+        return jnp.broadcast_to(
+            0.5 + _HALF_LOG_2PI + jnp.log(self.scale), self.batch_shape)
+
+    def _cdf(self, value):
+        return 0.5 * (1 + jax.scipy.special.erf(
+            (value - self.loc) / (self.scale * math.sqrt(2))))
+
+    def _icdf(self, value):
+        return self.loc + self.scale * math.sqrt(2) * \
+            jax.scipy.special.erfinv(2 * value - 1)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _to_jnp(loc)
+        self.scale = _to_jnp(scale)
+        self._base = Normal(loc, scale)
+        batch = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.loc + jnp.square(self.scale) / 2))
+
+    @property
+    def variance(self):
+        s2 = jnp.square(self.scale)
+        return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def _rsample(self, shape, key):
+        return jnp.exp(self._base._rsample(shape, key))
+
+    def _log_prob(self, value):
+        return self._base._log_prob(jnp.log(value)) - jnp.log(value)
+
+    def _entropy(self):
+        return self._base._entropy() + self.loc

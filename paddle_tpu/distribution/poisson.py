@@ -1,0 +1,39 @@
+"""Poisson (reference python/paddle/distribution/poisson.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln, xlogy
+
+from .distribution import ExponentialFamily, _to_jnp, _wrap
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _to_jnp(rate)
+        super().__init__(self.rate.shape, ())
+
+    @property
+    def mean(self):
+        return _wrap(self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate)
+
+    def _sample(self, shape, key):
+        out = self._extend_shape(shape)
+        return jax.random.poisson(key, self.rate, out).astype(
+            self.rate.dtype)
+
+    def _log_prob(self, value):
+        return xlogy(value, self.rate) - self.rate - gammaln(value + 1)
+
+    def _entropy(self):
+        # support-sum truncated at rate + 10*sqrt(rate) + 20 terms
+        n = int(jnp.max(self.rate) + 10 * jnp.sqrt(jnp.max(self.rate)) + 20)
+        ks = jnp.arange(n + 1, dtype=jnp.float32)
+        ks = ks.reshape((n + 1,) + tuple(1 for _ in self.batch_shape))
+        lp = self._log_prob(ks)
+        return -jnp.sum(jnp.exp(lp) * lp, axis=0)

@@ -1,0 +1,53 @@
+"""StudentT (reference python/paddle/distribution/student_t.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betaln, digamma
+
+from .distribution import Distribution, _to_jnp, _wrap
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc, scale, name=None):
+        self.df = _to_jnp(df)
+        self.loc = _to_jnp(loc)
+        self.scale = _to_jnp(scale)
+        batch = jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                     self.scale.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap(jnp.where(self.df > 1,
+                               jnp.broadcast_to(self.loc, self.batch_shape),
+                               jnp.nan))
+
+    @property
+    def variance(self):
+        v = jnp.square(self.scale) * self.df / (self.df - 2)
+        return _wrap(jnp.where(self.df > 2, v,
+                               jnp.where(self.df > 1, jnp.inf, jnp.nan)))
+
+    def _rsample(self, shape, key):
+        out = self._extend_shape(shape)
+        return self.loc + self.scale * jax.random.t(
+            key, self.df, out, self.loc.dtype)
+
+    def _log_prob(self, value):
+        # lgamma((d+1)/2) - lgamma(d/2) - 0.5*log(d*pi) collapses to
+        # -betaln(d/2, 1/2) - 0.5*log(d) since B(a,1/2)=G(a)G(1/2)/G(a+1/2)
+        z = (value - self.loc) / self.scale
+        d = self.df
+        return (-0.5 * (d + 1) * jnp.log1p(jnp.square(z) / d)
+                - 0.5 * jnp.log(d)
+                - betaln(0.5 * d, jnp.asarray(0.5)) - jnp.log(self.scale))
+
+    def _entropy(self):
+        d = self.df
+        return (0.5 * (d + 1) * (digamma(0.5 * (d + 1)) - digamma(0.5 * d))
+                + 0.5 * jnp.log(d) + betaln(0.5 * d, jnp.asarray(0.5))
+                + jnp.log(self.scale))

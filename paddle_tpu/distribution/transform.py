@@ -1,0 +1,291 @@
+"""Bijective transforms (reference python/paddle/distribution/transform.py —
+Transform base :96, AbsTransform, AffineTransform, ChainTransform,
+ExpTransform, IndependentTransform, PowerTransform, ReshapeTransform,
+SigmoidTransform, SoftmaxTransform, StackTransform,
+StickBreakingTransform, TanhTransform)."""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .distribution import _to_jnp, _wrap
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+           "ExpTransform", "IndependentTransform", "PowerTransform",
+           "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+           "StackTransform", "StickBreakingTransform", "TanhTransform"]
+
+
+class Transform:
+    """y = f(x); exposes forward/inverse/log-det-Jacobian.  The `_` hooks
+    work on raw jnp arrays; public methods accept/return Tensors."""
+
+    _event_rank = 0  # rank of the event the jacobian determinant covers
+
+    def forward(self, x):
+        return _wrap(self._forward(_to_jnp(x)))
+
+    def inverse(self, y):
+        return _wrap(self._inverse(_to_jnp(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _wrap(self._forward_log_det_jacobian(_to_jnp(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        y = _to_jnp(y)
+        return _wrap(-self._forward_log_det_jacobian(self._inverse(y)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _to_jnp(loc)
+        self.scale = _to_jnp(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _to_jnp(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """Not bijective on R^n; log-det undefined (matches reference which
+    omits it)."""
+
+    _event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    _event_rank = 1
+
+    def _forward(self, x):
+        # R^{K-1} -> simplex^K
+        offset = x.shape[-1] - jnp.cumsum(
+            jnp.ones_like(x), -1) + 1
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zpad = jnp.pad(z, [(0, 0)] * (x.ndim - 1) + [(0, 1)],
+                       constant_values=1.0)
+        one_minus = jnp.cumprod(1 - z, -1)
+        om_pad = jnp.pad(one_minus, [(0, 0)] * (x.ndim - 1) + [(1, 0)],
+                         constant_values=1.0)
+        return zpad * om_pad
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        offset = y_crop.shape[-1] - jnp.cumsum(
+            jnp.ones_like(y_crop), -1) + 1
+        denom = 1 - jnp.cumsum(y_crop, -1) + y_crop
+        z = y_crop / denom
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        offset = x.shape[-1] - jnp.cumsum(jnp.ones_like(x), -1) + 1
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        one_minus = jnp.cumprod(1 - z, -1)
+        om_pad = jnp.pad(one_minus[..., :-1],
+                         [(0, 0)] * (x.ndim - 1) + [(1, 0)],
+                         constant_values=1.0)
+        # dy_k/dx_k = z*(1-z) * prod_{j<k}(1-z_j); offset only shifts the
+        # sigmoid argument and does not scale the Jacobian
+        detj = jnp.log(z) + jnp.log1p(-z) + jnp.log(om_pad)
+        return jnp.sum(detj, -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._event_rank = len(self.in_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:-n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:-n]) + self.in_event_shape
+
+
+class IndependentTransform(Transform):
+    """Reinterpret `reinterpreted_batch_rank` batch dims as event dims: the
+    log-det sums over them."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._event_rank = base._event_rank + self.rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self.base._forward_log_det_jacobian(x)
+        return jnp.sum(ldj, axis=tuple(range(-self.rank, 0)))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+        self._event_rank = max((t._event_rank for t in self.transforms),
+                               default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            ldj = t._forward_log_det_jacobian(x)
+            # reduce finer-grained jacobians to this chain's event rank
+            extra = self._event_rank - t._event_rank
+            if extra > 0 and jnp.ndim(ldj) >= extra:
+                ldj = jnp.sum(ldj, axis=tuple(range(-extra, 0)))
+            total = total + ldj
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along `axis`."""
+
+    def __init__(self, transforms: Sequence[Transform], axis: int = 0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _split(self, x):
+        return [jnp.squeeze(s, self.axis) for s in
+                jnp.split(x, len(self.transforms), self.axis)]
+
+    def _forward(self, x):
+        return jnp.stack([t._forward(s) for t, s in
+                          zip(self.transforms, self._split(x))], self.axis)
+
+    def _inverse(self, y):
+        return jnp.stack([t._inverse(s) for t, s in
+                          zip(self.transforms, self._split(y))], self.axis)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.stack([t._forward_log_det_jacobian(s) for t, s in
+                          zip(self.transforms, self._split(x))], self.axis)

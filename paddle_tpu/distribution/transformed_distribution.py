@@ -1,0 +1,41 @@
+"""TransformedDistribution (reference
+python/paddle/distribution/transformed_distribution.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _to_jnp, _wrap
+from .transform import ChainTransform, Transform
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base: Distribution, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transform = ChainTransform(list(transforms))
+        shape = self.transform.forward_shape(
+            base.batch_shape + base.event_shape)
+        # event rank grows to at least the chain's event rank
+        ev = max(len(base.event_shape), self.transform._event_rank)
+        super().__init__(shape[:len(shape) - ev],
+                         shape[len(shape) - ev:])
+
+    def _sample(self, shape, key):
+        return self.transform._forward(self.base._sample(shape, key))
+
+    def _rsample(self, shape, key):
+        return self.transform._forward(self.base._rsample(shape, key))
+
+    def _log_prob(self, value):
+        x = self.transform._inverse(value)
+        lp = self.base._log_prob(x)
+        ldj = self.transform._forward_log_det_jacobian(x)
+        out = lp - ldj
+        # reduce over event dims the transform's jacobian did not cover
+        red = len(self.event_shape) - self.transform._event_rank \
+            - len(self.base.event_shape)
+        if red > 0:
+            out = jnp.sum(out, axis=tuple(range(-red, 0)))
+        return out

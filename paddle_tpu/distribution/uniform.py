@@ -1,0 +1,44 @@
+"""Uniform (reference python/paddle/distribution/uniform.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _to_jnp, _wrap
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _to_jnp(low)
+        self.high = _to_jnp(high)
+        batch = jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        super().__init__(batch, ())
+
+    @property
+    def mean(self):
+        return _wrap((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return _wrap(jnp.square(self.high - self.low) / 12)
+
+    def _rsample(self, shape, key):
+        out = self._extend_shape(shape)
+        u = jax.random.uniform(key, out, self.low.dtype)
+        return self.low + (self.high - self.low) * u
+
+    def _log_prob(self, value):
+        inside = (value >= self.low) & (value < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(self.high - self.low),
+                                self.batch_shape)
+
+    def _cdf(self, value):
+        return jnp.clip((value - self.low) / (self.high - self.low), 0., 1.)
+
+    def _icdf(self, value):
+        return self.low + (self.high - self.low) * value

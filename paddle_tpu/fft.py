@@ -1,0 +1,15 @@
+"""paddle.fft namespace parity (reference python/paddle/fft.py).  Impls in
+ops/impl/spectral.py (pure jnp.fft — XLA-native FFT), registered via
+ops.yaml so every entry is a taped, jit-cacheable op."""
+
+from .ops.api import (  # noqa: F401
+    fft, fft2, fftfreq, fftn, fftshift, hfft, hfft2, hfftn, ifft, ifft2,
+    ifftn, ifftshift, ihfft, ihfft2, ihfftn, irfft, irfft2, irfftn, rfft,
+    rfft2, rfftfreq, rfftn,
+)
+
+__all__ = [
+    "fft", "fft2", "fftfreq", "fftn", "fftshift", "hfft", "hfft2", "hfftn",
+    "ifft", "ifft2", "ifftn", "ifftshift", "ihfft", "ihfft2", "ihfftn",
+    "irfft", "irfft2", "irfftn", "rfft", "rfft2", "rfftfreq", "rfftn",
+]

@@ -1,0 +1,20 @@
+"""paddle.linalg namespace parity (reference python/paddle/linalg.py —
+re-exports of tensor.linalg).  All impls live in ops/impl/linalg.py and are
+registered through ops.yaml; this module is the public namespace."""
+
+from .ops.api import (  # noqa: F401
+    bmm, cdist, cholesky, cholesky_inverse, cholesky_solve, corrcoef, cov,
+    det, dist, eig, eigh, eigvals, eigvalsh, householder_product, inv,
+    lstsq, lu, lu_unpack, matmul, matrix_exp, matrix_norm, matrix_power,
+    matrix_rank, multi_dot, mv, norm, ormqr, pca_lowrank, pinv, qr, slogdet,
+    solve, svd, svd_lowrank, svdvals, triangular_solve, vector_norm,
+)
+
+__all__ = [
+    "bmm", "cdist", "cholesky", "cholesky_inverse", "cholesky_solve",
+    "corrcoef", "cov", "det", "dist", "eig", "eigh", "eigvals", "eigvalsh",
+    "householder_product", "inv", "lstsq", "lu", "lu_unpack", "matmul",
+    "matrix_exp", "matrix_norm", "matrix_power", "matrix_rank", "multi_dot",
+    "mv", "norm", "ormqr", "pca_lowrank", "pinv", "qr", "slogdet", "solve",
+    "svd", "svd_lowrank", "svdvals", "triangular_solve", "vector_norm",
+]

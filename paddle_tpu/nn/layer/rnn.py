@@ -1,0 +1,397 @@
+"""Recurrent layers (reference python/paddle/nn/layer/rnn.py —
+RNNCellBase:224, SimpleRNNCell:322, LSTMCell:473, GRUCell:663, RNN:820,
+BiRNN:938, SimpleRNN/LSTM/GRU multi-layer classes).
+
+TPU-first: the whole time recurrence is ONE taped op built on
+``jax.lax.scan`` (no Python-per-timestep dispatch — the XLA analog of the
+reference's cudnn fused RNN kernels), with optional sequence-length masking
+and bidirectional stacking.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import primitive
+from ...core.tensor import Tensor
+from .. import initializer as I
+from ..attr import ParamAttr
+from .common import Dropout
+from .container import LayerList
+from .layers import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+# ---------------------------------------------------------------------------
+# pure scan kernels (one taped op per direction per layer)
+# ---------------------------------------------------------------------------
+def _mask_step(new, old, t, seq_len):
+    """Keep `new` while t < seq_len else carry `old` (per batch row)."""
+    if seq_len is None:
+        return new
+    keep = (t < seq_len)[:, None]
+    return jnp.where(keep, new, old)
+
+
+def _simple_rnn_scan(x, h0, w_ih, w_hh, b_ih, b_hh, seq_len=None,
+                     *, activation="tanh", reverse=False):
+    """x: [T, B, I] time-major; h0: [B, H] -> (outputs [T, B, H], h_n)."""
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    T = x.shape[0]
+    # precompute input projections in one big matmul (MXU-friendly)
+    xp = jnp.einsum("tbi,hi->tbh", x, w_ih) + b_ih
+
+    def body(h, inp):
+        t, xpt = inp
+        h_new = act(xpt + h @ w_hh.T + b_hh)
+        h2 = _mask_step(h_new, h, t, seq_len)
+        return h2, h2
+
+    ts = jnp.arange(T) if not reverse else jnp.arange(T - 1, -1, -1)
+    xs = xp if not reverse else xp[::-1]
+    h_n, ys = jax.lax.scan(body, h0, (ts, xs))
+    if reverse:
+        ys = ys[::-1]
+    return ys, h_n
+
+
+def _lstm_scan(x, h0, c0, w_ih, w_hh, b_ih, b_hh, seq_len=None,
+               *, reverse=False):
+    """Gates ordered [i, f, g(cell), o] like the reference."""
+    T, B, _ = x.shape
+    H = h0.shape[-1]
+    xp = jnp.einsum("tbi,gi->tbg", x, w_ih) + b_ih
+
+    def body(carry, inp):
+        h, c = carry
+        t, xpt = inp
+        gates = xpt + h @ w_hh.T + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        h2 = _mask_step(h_new, h, t, seq_len)
+        c2 = _mask_step(c_new, c, t, seq_len)
+        return (h2, c2), h2
+
+    ts = jnp.arange(T) if not reverse else jnp.arange(T - 1, -1, -1)
+    xs = xp if not reverse else xp[::-1]
+    (h_n, c_n), ys = jax.lax.scan(body, (h0, c0), (ts, xs))
+    if reverse:
+        ys = ys[::-1]
+    return ys, h_n, c_n
+
+
+def _gru_scan(x, h0, w_ih, w_hh, b_ih, b_hh, seq_len=None, *, reverse=False):
+    """Gates ordered [r, z, c] (reset, update, candidate) like the
+    reference GRUCell."""
+    T = x.shape[0]
+    xp = jnp.einsum("tbi,gi->tbg", x, w_ih) + b_ih
+
+    def body(h, inp):
+        t, xpt = inp
+        hp = h @ w_hh.T + b_hh
+        xr, xz, xc = jnp.split(xpt, 3, axis=-1)
+        hr, hz, hc = jnp.split(hp, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        c = jnp.tanh(xc + r * hc)
+        h_new = (1 - z) * c + z * h
+        h2 = _mask_step(h_new, h, t, seq_len)
+        return h2, h2
+
+    ts = jnp.arange(T) if not reverse else jnp.arange(T - 1, -1, -1)
+    xs = xp if not reverse else xp[::-1]
+    h_n, ys = jax.lax.scan(body, h0, (ts, xs))
+    if reverse:
+        ys = ys[::-1]
+    return ys, h_n
+
+
+_simple_rnn_op = primitive("rnn_scan")(_simple_rnn_scan)
+_lstm_op = primitive("lstm_scan")(_lstm_scan)
+_gru_op = primitive("gru_scan")(_gru_scan)
+
+
+# ---------------------------------------------------------------------------
+# cells
+# ---------------------------------------------------------------------------
+class RNNCellBase(Layer):
+    """Base: weight creation + single-step `forward(inputs, states)`
+    (reference rnn.py:224)."""
+
+    def __init__(self, input_size: int, hidden_size: int, gates: int,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        G = gates * hidden_size
+        self.weight_ih = self.create_parameter(
+            [G, input_size], attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [G, hidden_size], attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [G], attr=bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [G], attr=bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0):
+        b = batch_ref.shape[0]
+        return Tensor(jnp.full((b, self.hidden_size),
+                               init_value, jnp.float32))
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, 1, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        ys, h_n = _simple_rnn_op(
+            inputs.unsqueeze(0), states, self.weight_ih, self.weight_hh,
+            self.bias_ih, self.bias_hh, activation=self.activation)
+        out = ys.squeeze(0)
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 4, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = (self.get_initial_states(inputs),
+                      self.get_initial_states(inputs))
+        h, c = states
+        ys, h_n, c_n = _lstm_op(inputs.unsqueeze(0), h, c, self.weight_ih,
+                                self.weight_hh, self.bias_ih, self.bias_hh)
+        return ys.squeeze(0), (h_n, c_n)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 3, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        ys, h_n = _gru_op(inputs.unsqueeze(0), states, self.weight_ih,
+                          self.weight_hh, self.bias_ih, self.bias_hh)
+        out = ys.squeeze(0)
+        return out, out
+
+
+# ---------------------------------------------------------------------------
+# sequence wrappers
+# ---------------------------------------------------------------------------
+def _run_cell_over_time(cell, x_tm, h0, seq_len, reverse):
+    """Dispatch the right scan op for a cell. x_tm: [T,B,I] Tensor."""
+    if isinstance(cell, LSTMCell):
+        h, c = h0
+        ys, h_n, c_n = _lstm_op(x_tm, h, c, cell.weight_ih, cell.weight_hh,
+                                cell.bias_ih, cell.bias_hh, seq_len,
+                                reverse=reverse)
+        return ys, (h_n, c_n)
+    if isinstance(cell, GRUCell):
+        ys, h_n = _gru_op(x_tm, h0, cell.weight_ih, cell.weight_hh,
+                          cell.bias_ih, cell.bias_hh, seq_len,
+                          reverse=reverse)
+        return ys, h_n
+    ys, h_n = _simple_rnn_op(x_tm, h0, cell.weight_ih, cell.weight_hh,
+                             cell.bias_ih, cell.bias_hh, seq_len,
+                             activation=cell.activation, reverse=reverse)
+    return ys, h_n
+
+
+def _default_state(cell, x_tm):
+    b = x_tm.shape[1]
+    zero = Tensor(jnp.zeros((b, cell.hidden_size), jnp.float32))
+    if isinstance(cell, LSTMCell):
+        return (zero, Tensor(jnp.zeros((b, cell.hidden_size), jnp.float32)))
+    return zero
+
+
+class RNN(Layer):
+    """Run a cell over a sequence (reference rnn.py:820)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        if initial_states is None:
+            initial_states = _default_state(self.cell, x)
+        ys, final = _run_cell_over_time(self.cell, x, initial_states,
+                                        sequence_length, self.is_reverse)
+        if not self.time_major:
+            ys = ys.transpose([1, 0, 2])
+        return ys, final
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, outputs concatenated (reference
+    rnn.py:938)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import api as _api
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        if initial_states is None:
+            s_fw = _default_state(self.cell_fw, x)
+            s_bw = _default_state(self.cell_bw, x)
+        else:
+            s_fw, s_bw = initial_states
+        y_fw, f_fw = _run_cell_over_time(self.cell_fw, x, s_fw,
+                                         sequence_length, False)
+        y_bw, f_bw = _run_cell_over_time(self.cell_bw, x, s_bw,
+                                         sequence_length, True)
+        ys = _api.concat([y_fw, y_bw], axis=-1)
+        if not self.time_major:
+            ys = ys.transpose([1, 0, 2])
+        return ys, (f_fw, f_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) recurrent network."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"unknown direction {direction!r}")
+        self.mode = mode
+        self.num_layers = num_layers
+        self.bidirectional = direction != "forward"
+        self.time_major = time_major
+        self.dropout_p = dropout
+        self.dropout = Dropout(dropout) if dropout > 0 else None
+        self.hidden_size = hidden_size
+        num_dir = 2 if self.bidirectional else 1
+
+        def make_cell(in_size):
+            kw = dict(weight_ih_attr=weight_ih_attr,
+                      weight_hh_attr=weight_hh_attr,
+                      bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+            if mode == "LSTM":
+                return LSTMCell(in_size, hidden_size, **kw)
+            if mode == "GRU":
+                return GRUCell(in_size, hidden_size, **kw)
+            return SimpleRNNCell(in_size, hidden_size,
+                                 activation=activation, **kw)
+
+        cells = []
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 else hidden_size * num_dir
+            cells.append(make_cell(in_size))
+            if self.bidirectional:
+                cells.append(make_cell(in_size))
+        self.cells = LayerList(cells)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import api as _api
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        num_dir = 2 if self.bidirectional else 1
+        finals = []
+        for layer in range(self.num_layers):
+            cell_fw = self.cells[layer * num_dir]
+            s_fw = self._pick_state(initial_states, layer * num_dir, x,
+                                    cell_fw)
+            y_fw, f_fw = _run_cell_over_time(cell_fw, x, s_fw,
+                                             sequence_length, False)
+            if self.bidirectional:
+                cell_bw = self.cells[layer * num_dir + 1]
+                s_bw = self._pick_state(initial_states,
+                                        layer * num_dir + 1, x, cell_bw)
+                y_bw, f_bw = _run_cell_over_time(cell_bw, x, s_bw,
+                                                 sequence_length, True)
+                x = _api.concat([y_fw, y_bw], axis=-1)
+                finals.extend([f_fw, f_bw])
+            else:
+                x = y_fw
+                finals.append(f_fw)
+            if self.dropout is not None and layer != self.num_layers - 1:
+                x = self.dropout(x)
+        outputs = x if self.time_major else x.transpose([1, 0, 2])
+        final_states = self._stack_finals(finals)
+        return outputs, final_states
+
+    def _pick_state(self, initial_states, idx, x_tm, cell):
+        if initial_states is None:
+            return _default_state(cell, x_tm)
+        if self.mode == "LSTM":
+            h, c = initial_states
+            return (h[idx], c[idx])
+        return initial_states[idx]
+
+    def _stack_finals(self, finals):
+        from ...ops import api as _api
+        if self.mode == "LSTM":
+            hs = _api.stack([f[0] for f in finals], axis=0)
+            cs = _api.stack([f[1] for f in finals], axis=0)
+            return (hs, cs)
+        return _api.stack(finals, axis=0)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)

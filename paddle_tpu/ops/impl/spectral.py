@@ -1,0 +1,241 @@
+"""Spectral ops: FFT family + STFT/ISTFT.
+
+Capability parity with the reference's paddle.fft (python/paddle/fft.py —
+fft/ifft/rfft/irfft/hfft/ihfft + 2/n-dim + helpers) and paddle.signal
+(python/paddle/signal.py: stft:179, istft:363).  TPU-first: thin pure-jnp
+wrappers over jnp.fft (XLA lowers FFT to its native implementation); framing
+for STFT is a gather-free strided reshape so it stays fusible under jit.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# 1-D / N-D FFT family
+# ---------------------------------------------------------------------------
+
+
+def fft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.fft(x, n=n, axis=axis, norm=norm)
+
+
+def ifft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ifft(x, n=n, axis=axis, norm=norm)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.fft2(x, s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.ifft2(x, s=s, axes=axes, norm=norm)
+
+
+def fftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.fftn(x, s=s, axes=axes, norm=norm)
+
+
+def ifftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.ifftn(x, s=s, axes=axes, norm=norm)
+
+
+def rfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.rfft(x, n=n, axis=axis, norm=norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.rfft2(x, s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.irfft2(x, s=s, axes=axes, norm=norm)
+
+
+def rfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.rfftn(x, s=s, axes=axes, norm=norm)
+
+
+def irfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.hfft(x, n=n, axis=axis, norm=norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ihfft(x, n=n, axis=axis, norm=norm)
+
+
+# A Hermitian-input FFT is an *inverse*-shaped transform with the conjugate:
+# hfft(x, n) == irfft(conj(x), n) * n, i.e. irfft with backward<->forward
+# norm swapped; likewise ihfft(y, n) == conj(rfft(y, n)) / n.
+_NORM_SWAP = {None: "forward", "backward": "forward",
+              "forward": "backward", "ortho": "ortho"}
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward"):
+    """N-dim FFT of a signal Hermitian-symmetric in the last given axis
+    (real output)."""
+    return jnp.fft.irfftn(jnp.conj(x), s=s, axes=axes,
+                          norm=_NORM_SWAP[norm])
+
+
+def ihfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.conj(jnp.fft.rfftn(x, s=s, axes=axes,
+                                  norm=_NORM_SWAP[norm]))
+
+
+def fftfreq(n, d=1.0, dtype=None):
+    return jnp.fft.fftfreq(n, d=d).astype(dtype or jnp.float32)
+
+
+def rfftfreq(n, d=1.0, dtype=None):
+    return jnp.fft.rfftfreq(n, d=d).astype(dtype or jnp.float32)
+
+
+def fftshift(x, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+def ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+# ---------------------------------------------------------------------------
+# STFT / ISTFT (paddle.signal parity: signal.py:179/:363)
+# ---------------------------------------------------------------------------
+
+
+def frame(x, frame_length: int, hop_length: int, axis=-1):
+    """Slice x into overlapping frames (reference signal.py:frame):
+    axis=-1: [..., n] -> [..., frame_length, num_frames];
+    axis=0:  [n, ...] -> [num_frames, frame_length, ...]."""
+    nd = jnp.ndim(x)
+    first = axis == 0 or (nd > 1 and axis == -nd)
+    if first:
+        x = jnp.moveaxis(x, 0, -1)
+    elif axis not in (-1, nd - 1):
+        raise ValueError("frame: axis must be 0 or -1")
+    n = x.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[:, None]
+           + hop_length * jnp.arange(num_frames)[None, :])
+    out = x[..., idx]  # [..., frame_length, num_frames]
+    if first:
+        out = jnp.moveaxis(jnp.moveaxis(out, -1, 0), -1, 1)
+    return out
+
+
+def overlap_add(x, hop_length: int, axis=-1):
+    """Inverse of frame (reference signal.py:overlap_add):
+    axis=-1: [..., frame_length, num_frames] -> [..., n];
+    axis=0:  [num_frames, frame_length, ...] -> [n, ...]."""
+    nd = jnp.ndim(x)
+    first = axis == 0 or (nd > 2 and axis == -nd)
+    if first:
+        x = jnp.moveaxis(jnp.moveaxis(x, 0, -1), 0, -2)
+    elif axis not in (-1, nd - 1):
+        raise ValueError("overlap_add: axis must be 0 or -1")
+    fl, nf = x.shape[-2], x.shape[-1]
+    n = fl + hop_length * (nf - 1)
+    batch = x.shape[:-2]
+    xt = jnp.swapaxes(x, -1, -2).reshape((-1, nf, fl))
+    seg = jnp.zeros((xt.shape[0], n), xt.dtype)
+
+    def body(i, acc):
+        return jax.lax.dynamic_update_slice_in_dim(
+            acc, jax.lax.dynamic_slice_in_dim(acc, i * hop_length, fl, 1)
+            + xt[:, i, :], i * hop_length, axis=1)
+
+    seg = jax.lax.fori_loop(0, nf, body, seg)
+    out = seg.reshape(batch + (n,))
+    if first:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True):
+    """Short-time Fourier transform; returns [..., n_fft//2+1 | n_fft,
+    num_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = jnp.asarray(window)
+    if win_length < n_fft:  # center-pad window to n_fft
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (jnp.ndim(x) - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    if jnp.iscomplexobj(x) and onesided:
+        raise ValueError(
+            "stft: onesided=True is incompatible with complex input; "
+            "pass onesided=False")
+    frames = frame(x, n_fft, hop_length)              # [..., n_fft, nf]
+    frames = frames * win[..., :, None]
+    if onesided:
+        spec = jnp.fft.rfft(frames, axis=-2)
+    else:
+        spec = jnp.fft.fft(frames, axis=-2)
+    if normalized:
+        spec = spec * (1.0 / jnp.sqrt(jnp.asarray(n_fft, jnp.float32)))
+    return spec
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False):
+    """Inverse STFT with window-envelope normalized overlap-add."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = jnp.asarray(window)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    if normalized:
+        x = x * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    if onesided:
+        frames = jnp.fft.irfft(x, n=n_fft, axis=-2)
+    else:
+        frames = jnp.fft.ifft(x, axis=-2)
+        if not return_complex:
+            frames = jnp.real(frames)
+    frames = frames * win[..., :, None]
+    y = overlap_add(frames, hop_length)
+    # window envelope for COLA normalization
+    nf = x.shape[-1]
+    env = overlap_add(jnp.broadcast_to((win * win)[:, None], (n_fft, nf)),
+                      hop_length)
+    y = y / jnp.maximum(env, 1e-11)
+    if center:
+        pad = n_fft // 2
+        y = y[..., pad:y.shape[-1] - pad]
+    if length is not None:
+        y = y[..., :length]
+    return y

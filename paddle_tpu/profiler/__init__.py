@@ -1,0 +1,24 @@
+"""paddle.profiler parity (reference python/paddle/profiler/profiler.py:351,
+utils.py:43 RecordEvent, profiler_statistic.py).
+
+TPU-first mapping (SURVEY §5 tracing):
+* host events — our own recorder (start/stop wall-clock ranges, thread-safe),
+  exported as chrome://tracing JSON exactly like the reference's
+  chrometracing_logger.cc;
+* device/XLA events — delegated to ``jax.profiler`` (XPlane/TensorBoard),
+  started alongside when a trace dir is given; ``RecordEvent`` doubles as a
+  ``jax.profiler.TraceAnnotation`` so scopes show up in device timelines.
+"""
+
+from .profiler import (  # noqa: F401
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent,
+    export_chrome_tracing, load_profiler_result, make_scheduler,
+    record_function,
+)
+from .statistics import SortedKeys, StatisticData, summary  # noqa: F401
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+    "export_chrome_tracing", "make_scheduler", "record_function",
+    "SortedKeys", "StatisticData", "summary", "load_profiler_result",
+]

@@ -1,0 +1,300 @@
+"""Profiler core: scheduler-driven host+device tracing."""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+__all__ = ["ProfilerTarget", "ProfilerState", "make_scheduler",
+           "RecordEvent", "record_function", "Profiler",
+           "export_chrome_tracing", "load_profiler_result"]
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1   # accepted for API parity; maps to the accelerator
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Step-indexed state machine (reference profiler.py:200 area)."""
+    period = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat > 0 and step >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = step % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def _default_schedule(_step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+class _HostEvent:
+    __slots__ = ("name", "start", "end", "tid", "event_type")
+
+    def __init__(self, name, start, end, tid, event_type="UserDefined"):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.tid = tid
+        self.event_type = event_type
+
+    @property
+    def duration(self) -> float:
+        return self.end - self.start
+
+
+class _HostRecorder:
+    """Thread-safe range recorder (the reference's HostEventRecorder)."""
+
+    def __init__(self):
+        self._events: List[_HostEvent] = []
+        self._lock = threading.Lock()
+        self.enabled = False
+
+    def add(self, ev: _HostEvent):
+        if self.enabled:
+            with self._lock:
+                self._events.append(ev)
+
+    def drain(self) -> List[_HostEvent]:
+        with self._lock:
+            evs, self._events = self._events, []
+        return evs
+
+
+_RECORDER = _HostRecorder()
+
+
+class RecordEvent:
+    """User scope (reference utils.py:43).  Also opens a
+    jax.profiler.TraceAnnotation so the scope appears in device traces."""
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._begin = None
+        self._annot = None
+
+    def begin(self):
+        self._begin = time.perf_counter()
+        try:
+            import jax.profiler
+            self._annot = jax.profiler.TraceAnnotation(self.name)
+            self._annot.__enter__()
+        except Exception:
+            self._annot = None
+
+    def end(self):
+        if self._annot is not None:
+            self._annot.__exit__(None, None, None)
+            self._annot = None
+        if self._begin is not None:
+            _RECORDER.add(_HostEvent(self.name, self._begin,
+                                     time.perf_counter(),
+                                     threading.get_ident(),
+                                     self.event_type))
+            self._begin = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def record_function(name: str):
+    """Decorator variant of RecordEvent."""
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with RecordEvent(name):
+                return fn(*a, **kw)
+        return wrapper
+    return deco
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready callback writing chrome://tracing JSON."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof: "Profiler"):
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(
+            dir_name, f"{name}_time_{int(time.time())}.paddle_trace.json")
+        prof._export_chrome(path)
+        return path
+
+    return handler
+
+
+def load_profiler_result(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+class Profiler:
+    """Scheduler-driven profiler (reference profiler.py:351).
+
+    targets/scheduler/on_trace_ready/timer_only mirror the reference; the
+    device side starts a jax.profiler trace when ``trace_dir`` (or an
+    export_chrome_tracing handler's dir) is available."""
+
+    def __init__(self, *, targets: Optional[Sequence[ProfilerTarget]] = None,
+                 scheduler=None, on_trace_ready=None, timer_only=False,
+                 record_shapes=False, profile_memory=False,
+                 with_flops=False, trace_dir: Optional[str] = None):
+        if isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            scheduler = make_scheduler(closed=max(start, 0), ready=0,
+                                       record=end - start, repeat=1)
+        self.scheduler = scheduler or _default_schedule
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.trace_dir = trace_dir
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._events: List[_HostEvent] = []      # current un-exported cycle
+        self._all_events: List[_HostEvent] = []  # archive across cycles
+        self._step_begin = None
+        self._step_records: List[float] = []
+        self._jax_trace_active = False
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        self.current_state = self.scheduler(self.step_num)
+        self._transition(ProfilerState.CLOSED, self.current_state)
+        self._step_begin = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._collect()
+        self._stop_device_trace()
+        _RECORDER.enabled = False
+        if self._events:
+            self._fire_trace_ready()
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._step_begin is not None:
+            self._step_records.append(now - self._step_begin)
+        self._step_begin = now
+        old = self.current_state
+        self.step_num += 1
+        self.current_state = self.scheduler(self.step_num)
+        self._transition(old, self.current_state)
+
+    def _transition(self, old: ProfilerState, new: ProfilerState):
+        recording_new = new in (ProfilerState.RECORD,
+                                ProfilerState.RECORD_AND_RETURN)
+        recording_old = old in (ProfilerState.RECORD,
+                                ProfilerState.RECORD_AND_RETURN)
+        if recording_new and not recording_old:
+            _RECORDER.enabled = True
+            self._start_device_trace()
+        if recording_old and (not recording_new
+                              or old == ProfilerState.RECORD_AND_RETURN):
+            self._collect()
+            if not recording_new:
+                _RECORDER.enabled = False
+                self._stop_device_trace()
+            self._fire_trace_ready()
+
+    def _fire_trace_ready(self):
+        """Hand the current cycle to the handler exactly once, then archive
+        it so later exports don't re-include it."""
+        if self.on_trace_ready is not None and self._events:
+            self.on_trace_ready(self)
+        self._all_events.extend(self._events)
+        self._events = []
+
+    def _collect(self):
+        self._events.extend(_RECORDER.drain())
+
+    def _start_device_trace(self):
+        if self.timer_only or self.trace_dir is None \
+                or self._jax_trace_active:
+            return
+        try:
+            import jax.profiler
+            jax.profiler.start_trace(self.trace_dir)
+            self._jax_trace_active = True
+        except Exception:
+            self._jax_trace_active = False
+
+    def _stop_device_trace(self):
+        if self._jax_trace_active:
+            try:
+                import jax.profiler
+                jax.profiler.stop_trace()
+            finally:
+                self._jax_trace_active = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- results ----------------------------------------------------------
+    def events(self) -> List[_HostEvent]:
+        return self._all_events + self._events
+
+    def step_times(self) -> List[float]:
+        return list(self._step_records)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        from .statistics import summary as _summary
+        return _summary(self.events(), self._step_records,
+                        time_unit=time_unit, sorted_by=sorted_by)
+
+    def _export_chrome(self, path: str):
+        # current un-archived cycle if one is pending, else everything
+        evs = self._events or self._all_events
+        events = [{
+            "name": ev.name, "ph": "X", "cat": ev.event_type,
+            "ts": ev.start * 1e6, "dur": ev.duration * 1e6,
+            "pid": os.getpid(), "tid": ev.tid,
+        } for ev in evs]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        return path
+
+    def export(self, path: str, format: str = "json"):
+        return self._export_chrome(path)

@@ -1,0 +1,68 @@
+"""Event statistics report (reference
+python/paddle/profiler/profiler_statistic.py)."""
+
+from __future__ import annotations
+
+import enum
+from collections import defaultdict
+from typing import List, Optional
+
+__all__ = ["SortedKeys", "StatisticData", "summary"]
+
+
+class SortedKeys(enum.Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    Calls = 4
+
+
+class StatisticData:
+    """Aggregated per-name stats: calls, total/avg/min/max duration."""
+
+    def __init__(self, events, step_times=None):
+        agg = defaultdict(lambda: {"calls": 0, "total": 0.0,
+                                   "min": float("inf"), "max": 0.0})
+        for ev in events:
+            row = agg[ev.name]
+            row["calls"] += 1
+            row["total"] += ev.duration
+            row["min"] = min(row["min"], ev.duration)
+            row["max"] = max(row["max"], ev.duration)
+        self.rows = {
+            name: {**row, "avg": row["total"] / row["calls"]}
+            for name, row in agg.items()
+        }
+        self.step_times = list(step_times or [])
+
+    def sorted_rows(self, key: SortedKeys = SortedKeys.CPUTotal):
+        field = {SortedKeys.CPUTotal: "total", SortedKeys.CPUAvg: "avg",
+                 SortedKeys.CPUMax: "max", SortedKeys.CPUMin: "min",
+                 SortedKeys.Calls: "calls"}[key]
+        return sorted(self.rows.items(), key=lambda kv: -kv[1][field])
+
+
+def summary(events, step_times=None, time_unit="ms",
+            sorted_by: Optional[SortedKeys] = None) -> str:
+    """Render the text report table."""
+    scale = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+    data = StatisticData(events, step_times)
+    lines = []
+    if data.step_times:
+        tot = sum(data.step_times)
+        lines.append(
+            f"steps: {len(data.step_times)}  total: {tot * scale:.3f}"
+            f"{time_unit}  avg: {tot / len(data.step_times) * scale:.3f}"
+            f"{time_unit}")
+    header = (f"{'Name':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+              f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}"
+              f"{'Min(' + time_unit + ')':>12}")
+    lines.append(header)
+    lines.append("-" * len(header))
+    for name, row in data.sorted_rows(sorted_by or SortedKeys.CPUTotal):
+        lines.append(
+            f"{name[:39]:<40}{row['calls']:>8}"
+            f"{row['total'] * scale:>14.3f}{row['avg'] * scale:>12.3f}"
+            f"{row['max'] * scale:>12.3f}{row['min'] * scale:>12.3f}")
+    return "\n".join(lines)

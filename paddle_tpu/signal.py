@@ -1,0 +1,6 @@
+"""paddle.signal namespace parity (reference python/paddle/signal.py:
+stft:179, istft:363, frame, overlap_add)."""
+
+from .ops.api import frame, istft, overlap_add, stft  # noqa: F401
+
+__all__ = ["frame", "istft", "overlap_add", "stft"]

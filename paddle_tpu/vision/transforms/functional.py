@@ -129,22 +129,56 @@ def vflip(img):
 
 def rotate(img, angle, interpolation="nearest", expand=False, center=None,
            fill=0):
-    """Rotate by `angle` degrees counter-clockwise (nearest-neighbour)."""
+    """Rotate by `angle` degrees counter-clockwise ("nearest" or
+    "bilinear"; `expand=True` grows the canvas to hold the whole image)."""
     img = np.asarray(img)
     h, w = img.shape[:2]
     theta = np.deg2rad(angle)
     cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else \
         (center[1], center[0])
-    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
-    # inverse-map output coords back to source
-    ys = (yy - cy) * np.cos(theta) - (xx - cx) * np.sin(theta) + cy
-    xs = (yy - cy) * np.sin(theta) + (xx - cx) * np.cos(theta) + cx
-    yi = np.round(ys).astype(int)
-    xi = np.round(xs).astype(int)
-    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
-    out = np.full_like(img, fill)
-    out[valid] = img[yi[valid], xi[valid]]
-    return out
+    if expand:
+        # output canvas bounding the rotated image; keep rotation center
+        oh = int(np.ceil(abs(h * np.cos(theta)) + abs(w * np.sin(theta))))
+        ow = int(np.ceil(abs(h * np.sin(theta)) + abs(w * np.cos(theta))))
+        ocy, ocx = (oh - 1) / 2.0, (ow - 1) / 2.0
+    else:
+        oh, ow, ocy, ocx = h, w, cy, cx
+    yy, xx = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    # inverse-map output coords back to source: rotate by -theta (CCW
+    # convention, positive angle = counter-clockwise like PIL/reference)
+    ys = (yy - ocy) * np.cos(theta) + (xx - ocx) * np.sin(theta) + cy
+    xs = -(yy - ocy) * np.sin(theta) + (xx - ocx) * np.cos(theta) + cx
+    out_shape = (oh, ow) + img.shape[2:]
+    if interpolation == "nearest":
+        yi = np.round(ys).astype(int)
+        xi = np.round(xs).astype(int)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        out = np.full(out_shape, fill, dtype=img.dtype)
+        out[valid] = img[yi[valid], xi[valid]]
+        return out
+    if interpolation != "bilinear":
+        raise ValueError(
+            f"unsupported rotate interpolation {interpolation!r}; "
+            "use 'nearest' or 'bilinear'")
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    wy = (ys - y0)[..., *([None] * (img.ndim - 2))]
+    wx = (xs - x0)[..., *([None] * (img.ndim - 2))]
+    acc = np.zeros(out_shape, np.float64)
+    wsum = np.zeros((oh, ow) + (1,) * (img.ndim - 2), np.float64)
+    for dy, dx, wgt in ((0, 0, (1 - wy) * (1 - wx)), (0, 1, (1 - wy) * wx),
+                        (1, 0, wy * (1 - wx)), (1, 1, wy * wx)):
+        yi, xi = y0 + dy, x0 + dx
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        vv = valid[..., *([None] * (img.ndim - 2))]
+        acc += np.where(vv, img[np.clip(yi, 0, h - 1),
+                                np.clip(xi, 0, w - 1)], 0) * wgt * vv
+        wsum += wgt * vv
+    covered = wsum > 1e-9
+    out = np.where(covered, acc / np.maximum(wsum, 1e-9), fill)
+    if np.issubdtype(img.dtype, np.integer):
+        out = np.rint(out)
+    return out.astype(img.dtype)
 
 
 def adjust_brightness(img, factor):

@@ -1,0 +1,269 @@
+"""paddle.distribution parity tests — numeric checks vs scipy.stats
+(the reference's test strategy: test/distribution/test_distribution_*.py
+compare against scipy) plus Monte-Carlo KL validation."""
+
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as pt
+from paddle_tpu import distribution as D
+
+RNG = np.random.default_rng(0)
+
+
+def _mc_kl(p, q, n=200_000):
+    """Monte-Carlo KL(p||q) from p samples."""
+    x = p.sample((n,))
+    lp = p.log_prob(x).numpy()
+    lq = q.log_prob(x).numpy()
+    return float(np.mean(lp - lq))
+
+
+class TestScalarDists:
+    @pytest.mark.parametrize("dist,sp,params", [
+        (D.Normal, st.norm, {"loc": 1.5, "scale": 2.0}),
+        (D.Laplace, st.laplace, {"loc": -0.5, "scale": 1.5}),
+        (D.Gumbel, st.gumbel_r, {"loc": 0.3, "scale": 0.8}),
+        (D.Cauchy, st.cauchy, {"loc": 0.1, "scale": 1.2}),
+    ])
+    def test_logprob_entropy_cdf(self, dist, sp, params):
+        d = dist(**params)
+        frozen = sp(loc=params["loc"], scale=params["scale"])
+        xs = np.linspace(-3, 4, 11).astype(np.float32)
+        np.testing.assert_allclose(d.log_prob(pt.to_tensor(xs)).numpy(),
+                                   frozen.logpdf(xs), rtol=1e-4, atol=1e-5)
+        if dist is not D.Cauchy:
+            np.testing.assert_allclose(float(d.entropy().numpy()),
+                                       frozen.entropy(), rtol=1e-5)
+        np.testing.assert_allclose(d.cdf(pt.to_tensor(xs)).numpy(),
+                                   frozen.cdf(xs), rtol=1e-4, atol=1e-5)
+
+    def test_normal_icdf_sampling(self):
+        pt.seed(0)
+        d = D.Normal(2.0, 3.0)
+        u = np.array([0.1, 0.5, 0.9], np.float32)
+        np.testing.assert_allclose(d.icdf(pt.to_tensor(u)).numpy(),
+                                   st.norm(2, 3).ppf(u), rtol=1e-4)
+        s = d.sample((50_000,)).numpy()
+        assert abs(s.mean() - 2.0) < 0.05 and abs(s.std() - 3.0) < 0.05
+
+    def test_uniform(self):
+        d = D.Uniform(-1.0, 3.0)
+        xs = np.array([-0.5, 0.0, 2.5], np.float32)
+        np.testing.assert_allclose(d.log_prob(pt.to_tensor(xs)).numpy(),
+                                   st.uniform(-1, 4).logpdf(xs), rtol=1e-5)
+        assert float(d.entropy().numpy()) == pytest.approx(np.log(4.0))
+
+    @pytest.mark.parametrize("dist,sp,params", [
+        (D.Beta, st.beta, {"alpha": 2.0, "beta": 3.0}),
+        (D.Gamma, st.gamma, {"concentration": 2.5, "rate": 1.5}),
+        (D.Exponential, st.expon, {"rate": 2.0}),
+        (D.Chi2, st.chi2, {"df": 4.0}),
+        (D.StudentT, st.t, {"df": 5.0, "loc": 0.5, "scale": 1.2}),
+        (D.LogNormal, st.lognorm, {"loc": 0.2, "scale": 0.7}),
+    ])
+    def test_positive_dists(self, dist, sp, params):
+        d = dist(**params)
+        xs = np.array([0.1, 0.4, 0.9, 1.7], np.float32)
+        if dist is D.Beta:
+            frozen = sp(params["alpha"], params["beta"])
+            xs = np.array([0.1, 0.4, 0.6, 0.9], np.float32)
+        elif dist is D.Gamma:
+            frozen = sp(params["concentration"],
+                        scale=1 / params["rate"])
+        elif dist is D.Exponential:
+            frozen = sp(scale=1 / params["rate"])
+        elif dist is D.Chi2:
+            frozen = sp(params["df"])
+        elif dist is D.StudentT:
+            frozen = sp(params["df"], loc=params["loc"],
+                        scale=params["scale"])
+        else:
+            frozen = sp(params["scale"], scale=np.exp(params["loc"]))
+        np.testing.assert_allclose(d.log_prob(pt.to_tensor(xs)).numpy(),
+                                   frozen.logpdf(xs), rtol=2e-4, atol=1e-4)
+        np.testing.assert_allclose(float(np.asarray(d.entropy().numpy())),
+                                   frozen.entropy(), rtol=1e-3)
+
+    def test_mean_variance(self):
+        for d, m, v in [
+            (D.Beta(2.0, 3.0), 0.4, 0.04),
+            (D.Gamma(2.0, 4.0), 0.5, 0.125),
+            (D.Gumbel(0.0, 1.0), 0.5772156, np.pi ** 2 / 6),
+        ]:
+            assert float(d.mean.numpy()) == pytest.approx(m, rel=1e-4)
+            assert float(d.variance.numpy()) == pytest.approx(v, rel=1e-4)
+
+
+class TestDiscrete:
+    def test_bernoulli(self):
+        d = D.Bernoulli(0.3)
+        frozen = st.bernoulli(0.3)
+        for k in (0.0, 1.0):
+            assert float(d.log_prob(pt.to_tensor(np.float32(k))).numpy()) \
+                == pytest.approx(frozen.logpmf(k), rel=1e-4)
+        assert float(d.entropy().numpy()) == pytest.approx(
+            frozen.entropy(), rel=1e-4)
+
+    def test_categorical(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+        d = D.Categorical(logits)
+        np.testing.assert_allclose(d.probs.numpy(), [0.2, 0.3, 0.5],
+                                   rtol=1e-5)
+        lp = d.log_prob(pt.to_tensor(np.array([0, 2], np.int64))).numpy()
+        np.testing.assert_allclose(lp, np.log([0.2, 0.5]), rtol=1e-5)
+        pt.seed(1)
+        s = d.sample((30_000,)).numpy()
+        freq = np.bincount(s.astype(int), minlength=3) / 30_000
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+
+    def test_poisson(self):
+        d = D.Poisson(3.0)
+        frozen = st.poisson(3.0)
+        ks = np.arange(8, dtype=np.float32)
+        np.testing.assert_allclose(d.log_prob(pt.to_tensor(ks)).numpy(),
+                                   frozen.logpmf(ks), rtol=1e-4, atol=1e-5)
+        assert float(d.entropy().numpy()) == pytest.approx(
+            frozen.entropy(), rel=1e-3)
+
+    def test_geometric(self):
+        d = D.Geometric(0.25)
+        frozen = st.geom(0.25, loc=-1)  # scipy geom counts trials; shift
+        ks = np.arange(6, dtype=np.float32)
+        np.testing.assert_allclose(d.log_prob(pt.to_tensor(ks)).numpy(),
+                                   frozen.logpmf(ks), rtol=1e-4)
+
+    def test_binomial_multinomial(self):
+        d = D.Binomial(10.0, 0.4)
+        frozen = st.binom(10, 0.4)
+        ks = np.arange(11, dtype=np.float32)
+        np.testing.assert_allclose(d.log_prob(pt.to_tensor(ks)).numpy(),
+                                   frozen.logpmf(ks), rtol=1e-4, atol=1e-4)
+        assert float(d.entropy().numpy()) == pytest.approx(
+            frozen.entropy(), rel=1e-3)
+        m = D.Multinomial(5, np.array([0.3, 0.7], np.float32))
+        val = np.array([2.0, 3.0], np.float32)
+        assert float(m.log_prob(pt.to_tensor(val)).numpy()) == pytest.approx(
+            st.multinomial(5, [0.3, 0.7]).logpmf(val), rel=1e-4)
+        pt.seed(2)
+        s = m.sample((2000,)).numpy()
+        assert s.shape == (2000, 2)
+        np.testing.assert_allclose(s.sum(-1), 5.0)
+        assert abs(s[:, 0].mean() - 1.5) < 0.1
+
+
+class TestMultivariate:
+    def test_dirichlet(self):
+        c = np.array([2.0, 3.0, 4.0], np.float32)
+        d = D.Dirichlet(c)
+        frozen = st.dirichlet(c)
+        x = np.array([0.2, 0.3, 0.5], np.float32)
+        assert float(d.log_prob(pt.to_tensor(x)).numpy()) == pytest.approx(
+            frozen.logpdf(x), rel=1e-4)
+        assert float(d.entropy().numpy()) == pytest.approx(
+            frozen.entropy(), rel=1e-3)
+        np.testing.assert_allclose(d.mean.numpy(), frozen.mean(), rtol=1e-5)
+
+
+class TestKL:
+    @pytest.mark.parametrize("p,q", [
+        (D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)),
+        (D.Beta(2.0, 3.0), D.Beta(4.0, 2.0)),
+        (D.Gamma(2.0, 1.0), D.Gamma(3.0, 2.0)),
+        (D.Laplace(0.0, 1.0), D.Laplace(0.5, 2.0)),
+        (D.Gumbel(0.0, 1.0), D.Gumbel(0.5, 1.5)),
+        (D.Geometric(0.3), D.Geometric(0.5)),
+    ])
+    def test_kl_vs_monte_carlo(self, p, q):
+        pt.seed(3)
+        kl = float(np.asarray(D.kl_divergence(p, q).numpy()))
+        mc = _mc_kl(p, q)
+        assert kl == pytest.approx(mc, rel=0.08, abs=0.01)
+
+    def test_kl_categorical_bernoulli_dirichlet(self):
+        p = D.Categorical(np.log(np.array([0.3, 0.7], np.float32)))
+        q = D.Categorical(np.log(np.array([0.5, 0.5], np.float32)))
+        expect = 0.3 * np.log(0.3 / 0.5) + 0.7 * np.log(0.7 / 0.5)
+        assert float(D.kl_divergence(p, q).numpy()) == pytest.approx(
+            expect, rel=1e-5)
+        b1, b2 = D.Bernoulli(0.2), D.Bernoulli(0.6)
+        expect = 0.2 * np.log(0.2 / 0.6) + 0.8 * np.log(0.8 / 0.4)
+        assert float(D.kl_divergence(b1, b2).numpy()) == pytest.approx(
+            expect, rel=1e-5)
+        d1 = D.Dirichlet(np.array([2.0, 3.0], np.float32))
+        d2 = D.Dirichlet(np.array([3.0, 2.0], np.float32))
+        pt.seed(4)
+        assert float(D.kl_divergence(d1, d2).numpy()) == pytest.approx(
+            _mc_kl(d1, d2), rel=0.05, abs=0.01)
+
+
+class TestTransforms:
+    def test_affine_exp_chain(self):
+        t = D.ChainTransform([D.AffineTransform(1.0, 2.0),
+                              D.ExpTransform()])
+        x = np.array([0.0, 0.5], np.float32)
+        y = t.forward(pt.to_tensor(x)).numpy()
+        np.testing.assert_allclose(y, np.exp(1 + 2 * x), rtol=1e-5)
+        np.testing.assert_allclose(t.inverse(pt.to_tensor(y)).numpy(), x,
+                                   rtol=1e-5, atol=1e-6)
+        # ldj = log|2| + (1+2x)
+        np.testing.assert_allclose(
+            t.forward_log_det_jacobian(pt.to_tensor(x)).numpy(),
+            np.log(2) + 1 + 2 * x, rtol=1e-5)
+
+    def test_sigmoid_tanh(self):
+        for tr, fwd in [(D.SigmoidTransform(), lambda v: 1 / (1 + np.exp(-v))),
+                        (D.TanhTransform(), np.tanh)]:
+            x = np.array([-1.0, 0.3, 1.2], np.float32)
+            y = tr.forward(pt.to_tensor(x)).numpy()
+            np.testing.assert_allclose(y, fwd(x), rtol=1e-5)
+            np.testing.assert_allclose(tr.inverse(pt.to_tensor(y)).numpy(),
+                                       x, rtol=1e-4, atol=1e-5)
+            # ldj finite-diff check
+            eps = 1e-3
+            num = (fwd(x + eps) - fwd(x - eps)) / (2 * eps)
+            np.testing.assert_allclose(
+                tr.forward_log_det_jacobian(pt.to_tensor(x)).numpy(),
+                np.log(num), atol=1e-3)
+
+    def test_stickbreaking_roundtrip(self):
+        t = D.StickBreakingTransform()
+        x = np.array([0.3, -0.2, 0.5], np.float32)
+        y = t.forward(pt.to_tensor(x)).numpy()
+        assert y.shape == (4,)
+        np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(t.inverse(pt.to_tensor(y)).numpy(), x,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_transformed_distribution_lognormal(self):
+        pt.seed(5)
+        base = D.Normal(0.2, 0.7)
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        ln = D.LogNormal(0.2, 0.7)
+        xs = np.array([0.5, 1.0, 2.0], np.float32)
+        np.testing.assert_allclose(td.log_prob(pt.to_tensor(xs)).numpy(),
+                                   ln.log_prob(pt.to_tensor(xs)).numpy(),
+                                   rtol=1e-5)
+
+    def test_independent(self):
+        base = D.Normal(np.zeros((3, 4), np.float32),
+                        np.ones((3, 4), np.float32))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+        x = RNG.standard_normal((3, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            ind.log_prob(pt.to_tensor(x)).numpy(),
+            base.log_prob(pt.to_tensor(x)).numpy().sum(-1), rtol=1e-5)
+
+    def test_reshape_stack(self):
+        t = D.ReshapeTransform((4,), (2, 2))
+        x = np.arange(4, dtype=np.float32)
+        y = t.forward(pt.to_tensor(x)).numpy()
+        assert y.shape == (2, 2)
+        st_ = D.StackTransform([D.ExpTransform(),
+                                D.AffineTransform(0.0, 2.0)], axis=0)
+        x2 = np.stack([x, x])
+        y2 = st_.forward(pt.to_tensor(x2)).numpy()
+        np.testing.assert_allclose(y2[0], np.exp(x), rtol=1e-5)
+        np.testing.assert_allclose(y2[1], 2 * x, rtol=1e-5)

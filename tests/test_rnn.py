@@ -1,0 +1,127 @@
+"""RNN layer tests — numeric parity vs torch.nn with copied weights (the
+OpTest strategy: independent reference implementation), plus masking,
+bidirectional stacking, and grad flow."""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+RNG = np.random.default_rng(0)
+
+
+def _copy_weights(pt_net, th_net, mode, num_layers, bidirectional):
+    num_dir = 2 if bidirectional else 1
+    for layer in range(num_layers):
+        for d in range(num_dir):
+            cell = pt_net.cells[layer * num_dir + d]
+            sfx = f"_l{layer}" + ("_reverse" if d else "")
+            for pname, tname in [("weight_ih", f"weight_ih{sfx}"),
+                                 ("weight_hh", f"weight_hh{sfx}"),
+                                 ("bias_ih", f"bias_ih{sfx}"),
+                                 ("bias_hh", f"bias_hh{sfx}")]:
+                w = getattr(th_net, tname).detach().numpy()
+                getattr(cell, pname).set_value(w)
+
+
+@pytest.mark.parametrize("mode,pt_cls,th_cls", [
+    ("RNN", nn.SimpleRNN, torch.nn.RNN),
+    ("LSTM", nn.LSTM, torch.nn.LSTM),
+    ("GRU", nn.GRU, torch.nn.GRU),
+])
+@pytest.mark.parametrize("bidirectional", [False, True])
+def test_rnn_matches_torch(mode, pt_cls, th_cls, bidirectional):
+    I_, H, L, B, T = 3, 5, 2, 2, 7
+    direction = "bidirectional" if bidirectional else "forward"
+    net = pt_cls(I_, H, num_layers=L, direction=direction)
+    th = th_cls(I_, H, num_layers=L, batch_first=True,
+                bidirectional=bidirectional)
+    _copy_weights(net, th, mode, L, bidirectional)
+    x = RNG.standard_normal((B, T, I_)).astype(np.float32)
+    y, _ = net(pt.to_tensor(x))
+    with torch.no_grad():
+        ty, _ = th(torch.from_numpy(x))
+    np.testing.assert_allclose(y.numpy(), ty.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_final_states_match_torch():
+    I_, H, B, T = 4, 3, 2, 6
+    net = nn.LSTM(I_, H)
+    th = torch.nn.LSTM(I_, H, batch_first=True)
+    _copy_weights(net, th, "LSTM", 1, False)
+    x = RNG.standard_normal((B, T, I_)).astype(np.float32)
+    y, (h, c) = net(pt.to_tensor(x))
+    with torch.no_grad():
+        ty, (th_h, th_c) = th(torch.from_numpy(x))
+    np.testing.assert_allclose(h.numpy(), th_h.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), th_c.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_sequence_length_masking():
+    net = nn.GRU(3, 4)
+    x = RNG.standard_normal((2, 5, 3)).astype(np.float32)
+    sl = np.array([3, 5], np.int32)
+    y, h = net(pt.to_tensor(x), sequence_length=pt.to_tensor(sl)._value)
+    # beyond row 0's length, outputs hold the step-2 state
+    np.testing.assert_allclose(y.numpy()[0, 3], y.numpy()[0, 2], atol=1e-6)
+    np.testing.assert_allclose(y.numpy()[0, 4], y.numpy()[0, 2], atol=1e-6)
+    # final state for row 0 equals state at its last valid step
+    np.testing.assert_allclose(h.numpy()[0, 0], y.numpy()[0, 2], atol=1e-6)
+
+
+def test_cells_single_step():
+    for cell_cls, th_cls in [(nn.SimpleRNNCell, torch.nn.RNNCell),
+                             (nn.LSTMCell, torch.nn.LSTMCell),
+                             (nn.GRUCell, torch.nn.GRUCell)]:
+        cell = cell_cls(3, 4)
+        th = th_cls(3, 4)
+        for pname in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+            getattr(cell, pname).set_value(
+                getattr(th, pname).detach().numpy())
+        x = RNG.standard_normal((2, 3)).astype(np.float32)
+        if cell_cls is nn.LSTMCell:
+            out, _ = cell(pt.to_tensor(x))
+            with torch.no_grad():
+                th_h, _ = th(torch.from_numpy(x))
+        else:
+            out, _ = cell(pt.to_tensor(x))
+            with torch.no_grad():
+                th_h = th(torch.from_numpy(x))
+        np.testing.assert_allclose(out.numpy(), th_h.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_grad_flow_and_train():
+    pt.seed(0)
+    net = nn.LSTM(3, 8)
+    head = nn.Linear(8, 1)
+    opt = pt.optimizer.Adam(learning_rate=1e-2,
+                            parameters=net.parameters()
+                            + head.parameters())
+    x = pt.to_tensor(RNG.standard_normal((4, 6, 3)).astype(np.float32))
+    target = pt.to_tensor(RNG.standard_normal((4, 1)).astype(np.float32))
+    losses = []
+    for _ in range(5):
+        y, (h, c) = net(x)
+        pred = head(y[:, -1])
+        loss = ((pred - target) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_rnn_wrapper_and_birnn():
+    cell = nn.GRUCell(3, 4)
+    rnn = nn.RNN(cell)
+    x = RNG.standard_normal((2, 5, 3)).astype(np.float32)
+    y, h = rnn(pt.to_tensor(x))
+    assert tuple(y.shape) == (2, 5, 4)
+    bi = nn.BiRNN(nn.GRUCell(3, 4), nn.GRUCell(3, 4))
+    y2, (hf, hb) = bi(pt.to_tensor(x))
+    assert tuple(y2.shape) == (2, 5, 8)

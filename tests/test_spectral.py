@@ -1,0 +1,133 @@
+"""FFT / signal tests — numeric parity vs numpy.fft (the reference's OpTest
+strategy for spectral kernels: compare against numpy, test/legacy_test
+test_fft.py), plus STFT/ISTFT roundtrip."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _t(x):
+    return pt.to_tensor(x)
+
+
+class TestFFT:
+    def setup_method(self, _):
+        self.rng = np.random.default_rng(0)
+
+    def test_fft_ifft_roundtrip(self):
+        x = self.rng.standard_normal((4, 32)).astype(np.float32)
+        y = pt.fft.fft(_t(x))
+        xr = pt.fft.ifft(y)
+        np.testing.assert_allclose(xr.numpy().real, x, atol=1e-5)
+        np.testing.assert_allclose(y.numpy(), np.fft.fft(x), rtol=2e-4,
+                                   atol=1e-4)
+
+    @pytest.mark.parametrize("norm", ["backward", "forward", "ortho"])
+    def test_norms(self, norm):
+        x = self.rng.standard_normal((16,)).astype(np.float32)
+        np.testing.assert_allclose(pt.fft.fft(_t(x), norm=norm).numpy(),
+                                   np.fft.fft(x, norm=norm), rtol=2e-4,
+                                   atol=1e-4)
+
+    def test_rfft_irfft(self):
+        x = self.rng.standard_normal((3, 20)).astype(np.float32)
+        y = pt.fft.rfft(_t(x))
+        assert y.shape[-1] == 11
+        np.testing.assert_allclose(y.numpy(), np.fft.rfft(x), rtol=2e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(pt.fft.irfft(y, n=20).numpy(), x,
+                                   atol=1e-5)
+
+    def test_fft2_fftn(self):
+        x = self.rng.standard_normal((2, 8, 8)).astype(np.float32)
+        np.testing.assert_allclose(pt.fft.fft2(_t(x)).numpy(),
+                                   np.fft.fft2(x), rtol=2e-4, atol=1e-4)
+        np.testing.assert_allclose(pt.fft.fftn(_t(x)).numpy(),
+                                   np.fft.fftn(x), rtol=2e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            pt.fft.irfftn(pt.fft.rfftn(_t(x))).numpy(), x, atol=1e-5)
+
+    def test_hfft_ihfft(self):
+        x = self.rng.standard_normal((9,)).astype(np.float32)
+        np.testing.assert_allclose(pt.fft.hfft(_t(x)).numpy(),
+                                   np.fft.hfft(x), rtol=2e-4, atol=1e-4)
+        y = np.fft.hfft(x)
+        np.testing.assert_allclose(pt.fft.ihfft(_t(y)).numpy(),
+                                   np.fft.ihfft(y), rtol=2e-4, atol=1e-4)
+
+    def test_hfftn_roundtrip(self):
+        x = self.rng.standard_normal((4, 9)).astype(np.float32)
+        spec = pt.fft.ihfftn(_t(x))
+        back = pt.fft.hfftn(spec, s=list(x.shape))
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-4)
+
+    def test_freq_shift(self):
+        np.testing.assert_allclose(pt.fft.fftfreq(8, 0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5).astype(np.float32))
+        np.testing.assert_allclose(pt.fft.rfftfreq(8).numpy(),
+                                   np.fft.rfftfreq(8).astype(np.float32))
+        x = np.arange(8.0, dtype=np.float32)
+        np.testing.assert_allclose(pt.fft.fftshift(_t(x)).numpy(),
+                                   np.fft.fftshift(x))
+        np.testing.assert_allclose(pt.fft.ifftshift(_t(x)).numpy(),
+                                   np.fft.ifftshift(x))
+
+    def test_fft_grad(self):
+        x = pt.to_tensor(
+            self.rng.standard_normal((8,)).astype(np.float32),
+            stop_gradient=False)
+        y = pt.fft.rfft(x)
+        loss = (y.abs() ** 2).sum()
+        loss.backward()
+        assert x.grad is not None
+        assert np.abs(x.grad.numpy()).sum() > 0
+
+
+class TestSignal:
+    def setup_method(self, _):
+        self.rng = np.random.default_rng(1)
+
+    def test_frame_overlap_add(self):
+        x = self.rng.standard_normal((2, 64)).astype(np.float32)
+        fr = pt.signal.frame(_t(x), 16, 16)  # non-overlapping
+        assert tuple(fr.shape) == (2, 16, 4)
+        back = pt.signal.overlap_add(fr, 16)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-6)
+
+    def test_stft_matches_numpy(self):
+        x = self.rng.standard_normal((48,)).astype(np.float32)
+        n_fft, hop = 16, 8
+        spec = pt.signal.stft(_t(x), n_fft, hop_length=hop,
+                              center=False).numpy()
+        nframes = 1 + (48 - n_fft) // hop
+        ref = np.stack([np.fft.rfft(x[i * hop:i * hop + n_fft])
+                        for i in range(nframes)], axis=-1)
+        np.testing.assert_allclose(spec, ref, rtol=2e-4, atol=1e-4)
+
+    def test_stft_istft_roundtrip(self):
+        x = self.rng.standard_normal((2, 128)).astype(np.float32)
+        n_fft, hop = 32, 8
+        win = np.hanning(n_fft).astype(np.float32)
+        spec = pt.signal.stft(_t(x), n_fft, hop_length=hop,
+                              window=pt.to_tensor(win))
+        y = pt.signal.istft(spec, n_fft, hop_length=hop,
+                            window=pt.to_tensor(win), length=128)
+        np.testing.assert_allclose(y.numpy(), x, atol=1e-4)
+
+
+class TestLinalgNamespace:
+    def test_namespace_complete(self):
+        for name in pt.linalg.__all__:
+            assert callable(getattr(pt.linalg, name)), name
+
+    def test_solve_and_qr(self):
+        rng = np.random.default_rng(2)
+        a = rng.standard_normal((4, 4)).astype(np.float32) + 4 * np.eye(
+            4, dtype=np.float32)
+        b = rng.standard_normal((4, 2)).astype(np.float32)
+        x = pt.linalg.solve(_t(a), _t(b))
+        np.testing.assert_allclose(a @ x.numpy(), b, atol=1e-4)
+        q, r = pt.linalg.qr(_t(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
