@@ -97,3 +97,9 @@ fft = _importlib.import_module(".fft", __name__)
 signal = _importlib.import_module(".signal", __name__)
 from . import distribution  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import audio  # noqa: F401,E402
+from . import native  # noqa: F401,E402

@@ -1,0 +1,16 @@
+"""paddle.audio parity (reference python/paddle/audio/ — features
+Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC, functional window/mel
+helpers, backends).
+
+TPU-first: everything composes the signal.stft op (XLA FFT), mel filter
+banks are precomputed host-side numpy constants folded into one matmul.
+"""
+
+from . import features  # noqa: F401
+from . import functional  # noqa: F401
+from .features import (  # noqa: F401
+    LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram,
+)
+
+__all__ = ["features", "functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

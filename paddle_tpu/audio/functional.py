@@ -1,0 +1,150 @@
+"""Audio functional helpers (reference python/paddle/audio/functional/ —
+window functions, mel/hz conversion, filter banks, power_to_db,
+create_dct)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "fft_frequencies", "compute_fbank_matrix", "power_to_db",
+           "create_dct"]
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True, dtype: str = "float64") -> Tensor:
+    """Window by name (hann/hamming/blackman/bartlett/kaiser/gaussian/
+    taylor via scipy-free numpy impls; reference functional/window.py)."""
+    if isinstance(window, tuple):
+        name, *params = window
+    else:
+        name, params = window, []
+    M = win_length + 1 if fftbins else win_length
+    n = np.arange(M)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * n / (M - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * n / (M - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * n / (M - 1))
+             + 0.08 * np.cos(4 * np.pi * n / (M - 1)))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * n / (M - 1) - 1)
+    elif name == "bohman":
+        x = np.abs(2 * n / (M - 1) - 1)
+        w = (1 - x) * np.cos(np.pi * x) + np.sin(np.pi * x) / np.pi
+    elif name == "kaiser":
+        beta = params[0] if params else 12.0
+        w = np.i0(beta * np.sqrt(1 - (2 * n / (M - 1) - 1) ** 2)) / \
+            np.i0(beta)
+    elif name == "gaussian":
+        std = params[0] if params else 7.0
+        w = np.exp(-0.5 * ((n - (M - 1) / 2) / std) ** 2)
+    elif name in ("rect", "boxcar", "ones"):
+        w = np.ones(M)
+    else:
+        raise ValueError(f"unknown window {name!r}")
+    if fftbins:
+        w = w[:-1]
+    return Tensor(jnp.asarray(w.astype(dtype)))
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = np.asarray(freq, np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        if out.ndim:
+            big = f >= min_log_hz
+            out = np.where(big, min_log_mel
+                           + np.log(np.maximum(f, 1e-10) / min_log_hz)
+                           / logstep, out)
+        elif f >= min_log_hz:
+            out = min_log_mel + math.log(f / min_log_hz) / logstep
+    return float(out) if np.ndim(out) == 0 else out
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = np.asarray(mel, np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        if out.ndim:
+            big = m >= min_log_mel
+            out = np.where(big, min_log_hz
+                           * np.exp(logstep * (m - min_log_mel)), out)
+        elif m >= min_log_mel:
+            out = min_log_hz * math.exp(logstep * (m - min_log_mel))
+    return float(out) if np.ndim(out) == 0 else out
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                       n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    return np.linspace(0, sr / 2, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney",
+                         dtype: str = "float32") -> Tensor:
+    """Triangular mel filter bank [n_mels, 1 + n_fft//2] (reference
+    functional/functional.py compute_fbank_matrix)."""
+    f_max = f_max or sr / 2
+    fftfreqs = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights.astype(dtype)))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """10*log10(S/ref) with clipping (reference power_to_db)."""
+    s = spect._value if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32") -> Tensor:
+    """DCT-II matrix [n_mels, n_mfcc] (reference create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[None, :]
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct.astype(dtype)))

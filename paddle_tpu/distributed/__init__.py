@@ -1,0 +1,15 @@
+"""``paddle_tpu.distributed`` — the reference's ``paddle.distributed``
+import path.  Core collective/topology APIs alias :mod:`paddle_tpu.parallel`
+(the mesh/axis layer); this package adds the process-level tooling: the
+launcher CLI (``python -m paddle_tpu.distributed.launch``), elastic
+manager, and checkpoint save/load."""
+
+from ..parallel import *  # noqa: F401,F403
+from ..parallel import collective, fleet  # noqa: F401
+from ..parallel.env import (  # noqa: F401
+    get_rank, get_world_size, init_parallel_env,
+)
+from ..parallel.checkpoint import (  # noqa: F401
+    load_state_dict, save_state_dict,
+)
+from . import checkpoint  # noqa: F401

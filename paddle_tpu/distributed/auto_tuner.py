@@ -1,0 +1,182 @@
+"""Auto-tuner (reference python/paddle/distributed/auto_tuner/ —
+AutoTuner tuner.py:21, pruning rules prune.py): black-box sweep over hybrid
+parallel configs {dp, mp, pp, sharding-stage, micro-bsz, recompute}.
+
+TPU-first: candidates must factor the chip count into mesh axes; the
+built-in analytic cost model ranks candidates by estimated memory
+feasibility + step time (comm volume over ICI vs compute) before any are
+run, so the measured sweep starts from the most promising configs."""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import itertools
+import math
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["TuneConfig", "AutoTuner", "default_candidates", "prune"]
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sharding_stage: int = 1
+    micro_batch_size: int = 1
+    use_recompute: bool = False
+
+    def degrees_product(self) -> int:
+        return (self.dp_degree * self.mp_degree * self.pp_degree
+                * self.sharding_degree)
+
+    def as_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+def default_candidates(num_devices: int, global_batch_size: int,
+                       num_layers: Optional[int] = None,
+                       num_heads: Optional[int] = None) -> List[TuneConfig]:
+    """All factorizations of num_devices into (dp, mp, pp, sharding) with
+    power-of-two degrees, crossed with micro-bsz and recompute."""
+    def pows(n):
+        return [2 ** i for i in range(int(math.log2(n)) + 1)]
+
+    out = []
+    for dp, mp, pp, sh in itertools.product(pows(num_devices), repeat=4):
+        if dp * mp * pp * sh != num_devices:
+            continue
+        for stage in ([1] if sh == 1 else [1, 2, 3]):
+            for mbs in [1, 2, 4, 8]:
+                for rc in (False, True):
+                    out.append(TuneConfig(dp, mp, pp, sh, stage, mbs, rc))
+    return prune(out, num_devices, global_batch_size, num_layers,
+                 num_heads)
+
+
+def prune(candidates: List[TuneConfig], num_devices: int,
+          global_batch_size: int, num_layers: Optional[int] = None,
+          num_heads: Optional[int] = None) -> List[TuneConfig]:
+    """Validity rules (reference prune.py): degrees factor the device
+    count; data-parallel batch divides; mp divides heads; pp divides
+    layers."""
+    keep = []
+    for c in candidates:
+        if c.degrees_product() != num_devices:
+            continue
+        data_ways = c.dp_degree * c.sharding_degree
+        if global_batch_size % data_ways != 0:
+            continue
+        local_bsz = global_batch_size // data_ways
+        if local_bsz % c.micro_batch_size != 0:
+            continue
+        if num_heads is not None and num_heads % c.mp_degree != 0:
+            continue
+        if num_layers is not None and num_layers % c.pp_degree != 0:
+            continue
+        if c.sharding_stage > 1 and c.sharding_degree == 1:
+            continue
+        keep.append(c)
+    return keep
+
+
+def _estimate(c: TuneConfig, model_params: float, hidden: float,
+              layers: float, global_batch_size: float, seq_len: float,
+              hbm_bytes: float) -> Dict[str, float]:
+    """Analytic memory/time scores (smaller = better time; memory must fit).
+    Rough ZeRO/Megatron accounting in bytes (bf16 params, fp32 opt)."""
+    P = model_params
+    shard_ways = {1: c.sharding_degree, 2: c.sharding_degree,
+                  3: c.sharding_degree}[c.sharding_stage]
+    param_mem = 2 * P / (c.mp_degree * c.pp_degree * (
+        shard_ways if c.sharding_stage == 3 else 1))
+    grad_mem = 2 * P / (c.mp_degree * c.pp_degree * (
+        shard_ways if c.sharding_stage >= 2 else 1))
+    opt_mem = 12 * P / (c.mp_degree * c.pp_degree * shard_ways)
+    local_bsz = global_batch_size / (c.dp_degree * c.sharding_degree)
+    act = (34 * hidden * seq_len * c.micro_batch_size
+           * layers / c.pp_degree / c.mp_degree)
+    if c.use_recompute:
+        act *= 0.25
+    mem = param_mem + grad_mem + opt_mem + act
+    # time score: compute per chip + dp allreduce + pp bubble penalty
+    compute = 6 * P * local_bsz * seq_len / max(c.mp_degree, 1)
+    if c.use_recompute:
+        compute *= 4 / 3
+    comm = 2 * P * (1 if c.dp_degree * c.sharding_degree > 1 else 0)
+    micro_steps = local_bsz / c.micro_batch_size
+    bubble = (c.pp_degree - 1) / max(micro_steps, 1)
+    t = compute * (1 + bubble) + 0.1 * comm
+    return {"memory_bytes": mem, "time_score": t,
+            "fits": mem < hbm_bytes}
+
+
+class AutoTuner:
+    """Sweep runner: ranks candidates by the cost model, then measures
+    each via ``run_fn(config_dict) -> metric`` (higher = better, e.g.
+    tokens/sec); logs history CSV; returns the best config."""
+
+    def __init__(self, num_devices: int, global_batch_size: int,
+                 model_params: float = 1e9, hidden: int = 2048,
+                 layers: int = 24, num_heads: Optional[int] = None,
+                 seq_len: int = 2048, hbm_bytes: float = 95e9,
+                 max_trials: Optional[int] = None,
+                 history_path: Optional[str] = None):
+        self.num_devices = num_devices
+        self.global_batch_size = global_batch_size
+        self.model = dict(model_params=model_params, hidden=hidden,
+                          layers=layers, seq_len=seq_len)
+        self.num_heads = num_heads
+        self.hbm_bytes = hbm_bytes
+        self.max_trials = max_trials
+        self.history_path = history_path
+        self.history: List[Dict] = []
+
+    def candidates(self) -> List[TuneConfig]:
+        cands = default_candidates(self.num_devices,
+                                   self.global_batch_size,
+                                   self.model["layers"], self.num_heads)
+        scored = []
+        for c in cands:
+            est = _estimate(c, self.model["model_params"],
+                            self.model["hidden"], self.model["layers"],
+                            self.global_batch_size, self.model["seq_len"],
+                            self.hbm_bytes)
+            if est["fits"]:
+                scored.append((est["time_score"], c, est))
+        scored.sort(key=lambda x: x[0])
+        return [c for _, c, _ in scored]
+
+    def tune(self, run_fn: Callable[[Dict], Optional[float]]):
+        best, best_metric = None, -float("inf")
+        cands = self.candidates()
+        if self.max_trials:
+            cands = cands[:self.max_trials]
+        for c in cands:
+            start = time.time()
+            try:
+                metric = run_fn(c.as_dict())
+            except Exception as e:  # OOM/compile failure -> prune
+                metric = None
+            rec = {**c.as_dict(),
+                   "metric": metric, "elapsed": time.time() - start}
+            self.history.append(rec)
+            if metric is not None and metric > best_metric:
+                best, best_metric = c, metric
+        if self.history_path:
+            self._dump()
+        return best, best_metric
+
+    def _dump(self):
+        if not self.history:
+            return
+        os.makedirs(os.path.dirname(self.history_path) or ".",
+                    exist_ok=True)
+        with open(self.history_path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(self.history[0]))
+            w.writeheader()
+            w.writerows(self.history)

@@ -1,0 +1,6 @@
+"""``paddle_tpu.distributed.checkpoint`` namespace (reference
+python/paddle/distributed/checkpoint/)."""
+
+from ..parallel.checkpoint import load_state_dict, save_state_dict  # noqa: F401
+
+__all__ = ["save_state_dict", "load_state_dict"]

@@ -1,0 +1,185 @@
+"""Elastic training manager + hang watchdog (reference
+fleet/elastic/manager.py:125 ElasticManager; phi CommTaskManager
+comm_task_manager.h:37 timeout watchdog; SURVEY §5 failure detection).
+
+TPU mapping: etcd membership becomes a pluggable ``Store`` (file-based by
+default — TPU pods share storage; a real deployment points this at GCS);
+collective-timeout detection becomes a step-level watchdog (XLA owns the
+collectives, so hangs surface as a step that never completes).  Recovery is
+restart-from-checkpoint, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["FileStore", "ElasticManager", "StepWatchdog"]
+
+
+class FileStore:
+    """Membership registry on a shared filesystem (the etcd stand-in):
+    one JSON heartbeat file per host with a TTL lease."""
+
+    def __init__(self, root: str, job_id: str = "default",
+                 ttl: float = 30.0):
+        self.dir = os.path.join(root, f"elastic_{job_id}")
+        os.makedirs(self.dir, exist_ok=True)
+        self.ttl = ttl
+
+    def register(self, host_id: str, info: Optional[dict] = None):
+        path = os.path.join(self.dir, f"{host_id}.json")
+        with open(path, "w") as f:
+            json.dump({"ts": time.time(), **(info or {})}, f)
+
+    def hosts(self) -> List[str]:
+        now = time.time()
+        out = []
+        for fn in sorted(os.listdir(self.dir)):
+            if not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.dir, fn)) as f:
+                    info = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if now - info.get("ts", 0) <= self.ttl:
+                out.append(fn[:-5])
+        return out
+
+    def deregister(self, host_id: str):
+        try:
+            os.remove(os.path.join(self.dir, f"{host_id}.json"))
+        except FileNotFoundError:
+            pass
+
+
+class ElasticManager:
+    """Watch membership; decide scale-up/down; trigger relaunch.
+
+    ``on_change(hosts)`` is called whenever the alive-host set changes;
+    the launcher restarts the job (restart-from-checkpoint) in response.
+    ``nnodes="2:4"`` style ranges gate whether a membership change is
+    actionable (reference --nnodes=N:M)."""
+
+    def __init__(self, store: FileStore, host_id: str, nnodes: str = "1",
+                 heartbeat_interval: float = 5.0,
+                 on_change: Optional[Callable[[List[str]], None]] = None):
+        self.store = store
+        self.host_id = host_id
+        if ":" in nnodes:
+            lo, hi = nnodes.split(":")
+            self.min_nodes, self.max_nodes = int(lo), int(hi)
+        else:
+            self.min_nodes = self.max_nodes = int(nnodes)
+        self.interval = heartbeat_interval
+        self.on_change = on_change
+        self._known: Optional[List[str]] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def elastic_enabled(self) -> bool:
+        return self.max_nodes > self.min_nodes
+
+    def scale_decision(self, hosts: List[str]) -> str:
+        n = len(hosts)
+        if n < self.min_nodes:
+            return "wait"      # not enough hosts to run
+        if self._known is not None and set(hosts) != set(self._known):
+            return "restart"   # membership changed -> relaunch
+        return "ok"
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=self.interval * 2)
+        self.store.deregister(self.host_id)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.store.register(self.host_id)
+            hosts = self.store.hosts()
+            decision = self.scale_decision(hosts)
+            if decision == "restart" and self.on_change is not None:
+                self.on_change(hosts)
+            if decision in ("ok", "restart"):
+                self._known = hosts
+            self._stop.wait(self.interval)
+
+
+class StepWatchdog:
+    """Detect hung training steps (the CommTaskManager analog: on TPU a
+    stuck collective shows up as a step that never finishes).
+
+    Usage::
+
+        wd = StepWatchdog(timeout=300, on_timeout=dump_and_abort)
+        wd.start()
+        for batch in loader:
+            with wd.step():
+                train_step(batch)
+    """
+
+    def __init__(self, timeout: float, on_timeout: Optional[Callable] = None,
+                 poll: float = 1.0):
+        self.timeout = timeout
+        self.on_timeout = on_timeout or self._default_handler
+        self.poll = poll
+        self._deadline: Optional[float] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = False
+
+    def _default_handler(self):
+        import faulthandler
+        import sys
+        print(f"[watchdog] step exceeded {self.timeout}s — dumping stacks",
+              file=sys.stderr)
+        faulthandler.dump_traceback()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=self.poll * 2)
+
+    class _Step:
+        def __init__(self, wd):
+            self.wd = wd
+
+        def __enter__(self):
+            with self.wd._lock:
+                self.wd._deadline = time.time() + self.wd.timeout
+            return self
+
+        def __exit__(self, *exc):
+            with self.wd._lock:
+                self.wd._deadline = None
+            return False
+
+    def step(self) -> "_Step":
+        return StepWatchdog._Step(self)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                dl = self._deadline
+            if dl is not None and time.time() > dl:
+                self.fired = True
+                with self._lock:
+                    self._deadline = None
+                self.on_timeout()
+            self._stop.wait(self.poll)

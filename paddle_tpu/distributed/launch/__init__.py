@@ -1,0 +1,5 @@
+"""``python -m paddle_tpu.distributed.launch`` — the reference's launcher
+(launch/main.py:23) re-targeted at TPU pods + local multi-process
+simulation.  See main.py."""
+
+from .main import launch, main  # noqa: F401

@@ -1,0 +1,186 @@
+"""Launcher CLI (reference python/paddle/distributed/launch/main.py:23 +
+controllers/collective.py:22 + watcher; SURVEY §2.5 Launcher, §5 failure
+detection).
+
+Modes
+-----
+* **pod** (default on TPU hosts): one process per host; sets the
+  ``jax.distributed.initialize`` coordination env
+  (COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID) from --master/--nnodes
+  /--rank and execs the training script in-process.
+* **local** (``--nproc_per_node N``): spawns N child processes on this
+  machine with per-rank env (rank/world size/coordinator), used by the
+  collective tests exactly like the reference's TestMultipleGpus harness.
+  On CPU each child gets JAX_PLATFORMS=cpu.
+
+Failure handling (reference elastic/manager.py:125 semantics, coarse TPU
+version): the watcher polls children; if any exits non-zero the pod is torn
+down and — when ``--max_restart > 0`` — relaunched from scratch, resuming
+from the user's checkpoints (restart-from-checkpoint, not in-process
+repair).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["launch", "main"]
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a distributed training job")
+    p.add_argument("--master", type=str, default=None,
+                   help="coordinator ip:port (rank-0 host)")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="number of hosts, or min:max range for elastic")
+    p.add_argument("--rank", type=int, default=None,
+                   help="this host's index (default: from env)")
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="spawn N local processes (simulation/CPU mode); "
+                        "omit on TPU pods (one process per host)")
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--max_restart", type=int, default=0,
+                   help="relaunch the job up to N times on failure")
+    p.add_argument("--devices", type=str, default=None,
+                   help="visible device ids for local mode")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _nnodes_range(spec: str):
+    if ":" in spec:
+        lo, hi = spec.split(":")
+        return int(lo), int(hi)
+    return int(spec), int(spec)
+
+
+class Watcher:
+    """Poll children; on any failure kill the rest (reference
+    controllers/watcher.py)."""
+
+    def __init__(self, procs: List[subprocess.Popen]):
+        self.procs = procs
+
+    @staticmethod
+    def _job_code(codes) -> int:
+        """0 only if every rank exited 0; else the first failing code
+        (signal deaths are negative and must not be masked by max())."""
+        for c in codes:
+            if c not in (None, 0):
+                return c
+        return 0
+
+    def wait(self) -> int:
+        try:
+            while True:
+                codes = [p.poll() for p in self.procs]
+                if all(c is not None for c in codes):
+                    return self._job_code(codes)
+                if any(c not in (None, 0) for c in codes):
+                    self.terminate()
+                    return self._job_code(codes)
+                time.sleep(0.2)
+        except KeyboardInterrupt:
+            self.terminate()
+            raise
+
+    def terminate(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 5
+        for p in self.procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            if p.poll() is None:
+                p.kill()
+
+
+def _spawn_local(args) -> int:
+    n = args.nproc_per_node
+    master = args.master or "127.0.0.1:0"
+    if master.endswith(":0"):
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        master = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(n),
+            "PADDLE_MASTER": master,
+            "COORDINATOR_ADDRESS": master,
+            "NUM_PROCESSES": str(n),
+            "PROCESS_ID": str(rank),
+            "JAX_COORDINATOR_ADDRESS": master,
+            "JAX_NUM_PROCESSES": str(n),
+            "JAX_PROCESS_ID": str(rank),
+        })
+        if args.devices is not None:
+            env["TPU_VISIBLE_DEVICES"] = args.devices
+        cmd = [sys.executable, args.training_script,
+               *args.training_script_args]
+        if args.log_dir:
+            out = open(os.path.join(args.log_dir,
+                                    f"workerlog.{rank}"), "wb")
+        else:
+            out = None
+        procs.append(subprocess.Popen(cmd, env=env, stdout=out,
+                                      stderr=subprocess.STDOUT
+                                      if out else None))
+    return Watcher(procs).wait()
+
+
+def _run_pod(args) -> int:
+    """One process per TPU host: set jax.distributed env and exec the
+    script in this process."""
+    env = os.environ
+    lo, hi = _nnodes_range(args.nnodes)
+    if args.master:
+        env.setdefault("JAX_COORDINATOR_ADDRESS", args.master)
+        env.setdefault("COORDINATOR_ADDRESS", args.master)
+    env.setdefault("JAX_NUM_PROCESSES", str(lo))
+    if args.rank is not None:
+        env.setdefault("JAX_PROCESS_ID", str(args.rank))
+    cmd = [sys.executable, args.training_script,
+           *args.training_script_args]
+    return subprocess.call(cmd, env=dict(env))
+
+
+def launch(argv=None) -> int:
+    args = _parse_args(argv)
+    attempt = 0
+    while True:
+        if args.nproc_per_node is not None:
+            code = _spawn_local(args)
+        else:
+            code = _run_pod(args)
+        if code == 0 or attempt >= args.max_restart:
+            return code
+        attempt += 1
+        print(f"[launch] job failed (exit {code}); restart "
+              f"{attempt}/{args.max_restart} (resume from checkpoint)",
+              file=sys.stderr)
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""paddle.incubate.autograd parity (reference
+python/paddle/incubate/autograd/ — jac/hessian/jvp/vjp + forward_grad).
+
+Higher-order AD is native in JAX; these wrappers keep the reference's
+Tensor-level signatures.  primitive-mode prim flags (enable_prim) are
+no-ops: XLA is always the compiler."""
+
+from ..autograd.functional import hessian, jacobian, jvp, vjp  # noqa: F401
+
+__all__ = ["jacobian", "hessian", "jvp", "vjp", "forward_grad",
+           "enable_prim", "disable_prim", "prim_enabled"]
+
+
+def forward_grad(func, xs, v=None):
+    """Forward-mode gradient (alias of jvp's tangent output)."""
+    _, tangents = jvp(func, xs, v)
+    return tangents
+
+
+def enable_prim():  # pragma: no cover - API parity no-op
+    return None
+
+
+def disable_prim():  # pragma: no cover - API parity no-op
+    return None
+
+
+def prim_enabled() -> bool:
+    return True  # XLA composite lowering is always on

@@ -1,0 +1,172 @@
+"""Fused transformer layer classes (reference python/paddle/incubate/nn/
+layer/fused_transformer.py — FusedMultiHeadAttention, FusedFeedForward,
+FusedTransformerEncoderLayer, FusedMultiTransformer).
+
+TPU-first: "fused" means one taped op whose body XLA/Pallas fuses — the
+functional impls live in incubate.nn.functional (flash attention,
+fused_bias_dropout_residual_layer_norm, swiglu)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ...nn import functional as F
+from ...nn.attr import ParamAttr
+from ...nn.layer.layers import Layer
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """Pre/post-LN attention block with fused residual+dropout+layernorm
+    epilogue (reference fused_attention op semantics)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        self.qkv_weight = self.create_parameter(
+            [embed_dim, 3 * embed_dim], attr=qkv_weight_attr)
+        self.qkv_bias = self.create_parameter(
+            [3 * embed_dim], attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr)
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=linear_bias_attr, is_bias=True)
+        from ...nn import initializer as I
+        one = ParamAttr(initializer=I.Constant(1.0))
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], attr=pre_ln_scale_attr or one)
+        self.pre_ln_bias = self.create_parameter(
+            [embed_dim], attr=pre_ln_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=ln_scale_attr or one)
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=ln_bias_attr, is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        from ... import ops
+        from ..nn import functional as IF
+        x = query
+        residual = x
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.embed_dim], self.pre_ln_scale,
+                             self.pre_ln_bias, self.epsilon)
+        b, s = x.shape[0], x.shape[1]
+        qkv = ops.api.matmul(x, self.qkv_weight) + self.qkv_bias
+        qkv = ops.api.reshape(qkv, [b, s, self.num_heads,
+                                    3 * self.head_dim])
+        q, k, v = ops.api.split(qkv, 3, axis=-1)
+        attn = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate, training=self.training)
+        attn = ops.api.reshape(attn, [b, s, self.embed_dim])
+        out = ops.api.matmul(attn, self.linear_weight)
+        # fused epilogue: bias + dropout + residual + layernorm
+        if self.normalize_before:
+            out = IF.fused_dropout_add(out + self.linear_bias, residual,
+                                       p=self.dropout_rate,
+                                       training=self.training)
+        else:
+            out = IF.fused_bias_dropout_residual_layer_norm(
+                out, residual, self.linear_bias, self.ln_scale,
+                self.ln_bias, dropout_rate=self.dropout_rate,
+                ln_epsilon=self.epsilon, training=self.training)
+        return out
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self.activation = activation
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr)
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr)
+        self.linear2_bias = self.create_parameter(
+            [d_model], attr=linear2_bias_attr, is_bias=True)
+        from ...nn import initializer as I
+        one = ParamAttr(initializer=I.Constant(1.0))
+        self.ln1_scale = self.create_parameter([d_model],
+                                               attr=ln1_scale_attr or one)
+        self.ln1_bias = self.create_parameter([d_model],
+                                              attr=ln1_bias_attr,
+                                              is_bias=True)
+        self.ln2_scale = self.create_parameter([d_model],
+                                               attr=ln2_scale_attr or one)
+        self.ln2_bias = self.create_parameter([d_model],
+                                              attr=ln2_bias_attr,
+                                              is_bias=True)
+
+    def forward(self, src, cache=None):
+        from ... import ops
+        from ..nn import functional as IF
+        residual = src
+        x = src
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.d_model], self.ln1_scale,
+                             self.ln1_bias, self.epsilon)
+        h = ops.api.matmul(x, self.linear1_weight)
+        h = IF.fused_bias_act(h, self.linear1_bias,
+                              act_method=self.activation)
+        h = F.dropout(h, self.act_dropout_rate, training=self.training)
+        out = ops.api.matmul(h, self.linear2_weight)
+        if self.normalize_before:
+            return IF.fused_dropout_add(out + self.linear2_bias, residual,
+                                        p=self.dropout_rate,
+                                        training=self.training)
+        return IF.fused_bias_dropout_residual_layer_norm(
+            out, residual, self.linear2_bias, self.ln2_scale,
+            self.ln2_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward,
+                 dropout_rate=0.1, activation="relu",
+                 attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before=False):
+        super().__init__()
+        attn_dropout_rate = (dropout_rate if attn_dropout_rate is None
+                             else attn_dropout_rate)
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))

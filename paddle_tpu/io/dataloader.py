@@ -25,10 +25,12 @@ __all__ = ["DataLoader", "default_collate_fn"]
 
 def default_collate_fn(batch: List[Any]):
     """Stack a list of samples into batched numpy arrays (reference:
-    io/dataloader/collate.py)."""
+    io/dataloader/collate.py).  Large contiguous samples are assembled by
+    the native C++ collate (threaded memcpy, GIL-free)."""
     sample = batch[0]
     if isinstance(sample, np.ndarray):
-        return np.stack(batch)
+        from .. import native
+        return native.collate_stack(batch)
     if isinstance(sample, (int, np.integer)):
         return np.asarray(batch, np.int64)
     if isinstance(sample, (float, np.floating)):
@@ -44,33 +46,66 @@ def default_collate_fn(batch: List[Any]):
 
 
 class _PrefetchIterator:
+    """Producer thread fills a bounded ring ahead of the consumer.  The
+    handoff uses the native C++ TokenRing when built (blocking waits drop
+    the GIL; batches ride a slot table keyed by token), with a pure-Python
+    queue fallback inside TokenRing itself."""
+
     def __init__(self, produce, num_prefetch: int, to_tensor: Callable):
-        self._queue: "queue.Queue" = queue.Queue(maxsize=max(num_prefetch, 1))
+        from .. import native
+        cap = max(num_prefetch, 1)
+        self._ring = native.TokenRing(cap)
+        self._slots: dict = {}
+        self._slots_lock = threading.Lock()
         self._to_tensor = to_tensor
-        self._done = object()
         self._exc: Optional[BaseException] = None
 
         def worker():
+            token = 0
             try:
                 for item in produce():
-                    self._queue.put(item)
+                    with self._slots_lock:
+                        self._slots[token] = item
+                    if not self._ring.push(token):
+                        return  # consumer closed early
+                    token += 1
             except BaseException as e:  # propagate to consumer
                 self._exc = e
             finally:
-                self._queue.put(self._done)
+                self._ring.close()
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
+
+    def close(self):
+        """Consumer-side shutdown: wake a possibly-blocked producer, wait
+        for it to exit, and only then let the native ring be destroyed
+        (prevents use-after-free on early iteration abandonment)."""
+        self._ring.close()
+        self._thread.join(timeout=2.0)
+        if self._thread.is_alive():
+            # producer stuck: leak the native ring rather than free it
+            # under a live waiter
+            self._ring.leak()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        item = self._queue.get()
-        if item is self._done:
+        token = self._ring.pop()
+        if token is None:
+            self.close()
             if self._exc is not None:
                 raise self._exc
             raise StopIteration
+        with self._slots_lock:
+            item = self._slots.pop(token)
         return self._to_tensor(item)
 
 

@@ -51,7 +51,9 @@ class RandomSampler(Sampler):
         if self.replacement:
             yield from rng.integers(0, n, self.num_samples).tolist()
         else:
-            yield from rng.permutation(n)[:self.num_samples].tolist()
+            from .. import native
+            perm = native.shuffle_indices(n, int(rng.integers(2 ** 62)))
+            yield from perm[:self.num_samples].tolist()
 
     def _seed(self):
         import jax

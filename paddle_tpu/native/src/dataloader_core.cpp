@@ -1,0 +1,132 @@
+// Native data-loader core (the TPU-native analog of the reference's C++
+// buffered readers + BlockingQueue: paddle/fluid/operators/reader,
+// phi DataLoader pin-memory path; SURVEY §2.7 paddle.io).
+//
+// C ABI (consumed via ctypes from paddle_tpu/native/__init__.py):
+//   pt_shuffle_indices   — Fisher-Yates permutation (epoch shuffling)
+//   pt_collate_copy      — multi-threaded sample->batch memcpy (collate)
+//   pt_ring_*            — bounded blocking MPMC token ring (prefetch queue)
+//
+// Everything releases the GIL by construction: ctypes foreign calls drop it,
+// so the copy threads and blocking pops run concurrently with Python.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// splitmix64 -> Fisher-Yates shuffle
+// ---------------------------------------------------------------------------
+static inline uint64_t splitmix64(uint64_t &state) {
+  uint64_t z = (state += 0x9E3779B97F4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+void pt_shuffle_indices(int64_t n, uint64_t seed, int64_t *out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  uint64_t s = seed ? seed : 0x853C49E6748FEA9BULL;
+  for (int64_t i = n - 1; i > 0; --i) {
+    uint64_t j = splitmix64(s) % static_cast<uint64_t>(i + 1);
+    int64_t t = out[i];
+    out[i] = out[j];
+    out[j] = t;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// parallel collate: copy n_samples source buffers of sample_bytes each into
+// one contiguous batch buffer
+// ---------------------------------------------------------------------------
+void pt_collate_copy(const void **srcs, int64_t n_samples,
+                     int64_t sample_bytes, void *dst, int32_t num_threads) {
+  char *d = static_cast<char *>(dst);
+  if (num_threads <= 1 || n_samples < 4) {
+    for (int64_t i = 0; i < n_samples; ++i)
+      std::memcpy(d + i * sample_bytes, srcs[i], sample_bytes);
+    return;
+  }
+  int32_t nt = num_threads;
+  if (nt > n_samples) nt = static_cast<int32_t>(n_samples);
+  std::vector<std::thread> workers;
+  workers.reserve(nt);
+  std::atomic<int64_t> next(0);
+  for (int32_t t = 0; t < nt; ++t) {
+    workers.emplace_back([&]() {
+      int64_t i;
+      while ((i = next.fetch_add(1)) < n_samples)
+        std::memcpy(d + i * sample_bytes, srcs[i], sample_bytes);
+    });
+  }
+  for (auto &w : workers) w.join();
+}
+
+// ---------------------------------------------------------------------------
+// bounded blocking MPMC token ring (prefetch handoff)
+// ---------------------------------------------------------------------------
+struct PtRing {
+  std::vector<int64_t> slots;
+  size_t head = 0, tail = 0, count = 0;
+  bool closed = false;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  explicit PtRing(size_t cap) : slots(cap) {}
+};
+
+void *pt_ring_create(int32_t capacity) {
+  if (capacity <= 0) capacity = 1;
+  return new PtRing(static_cast<size_t>(capacity));
+}
+
+// returns 1 on success, 0 if closed
+int32_t pt_ring_push(void *ring, int64_t token) {
+  PtRing *r = static_cast<PtRing *>(ring);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->not_full.wait(lk, [r] { return r->count < r->slots.size() || r->closed; });
+  if (r->closed) return 0;
+  r->slots[r->tail] = token;
+  r->tail = (r->tail + 1) % r->slots.size();
+  ++r->count;
+  r->not_empty.notify_one();
+  return 1;
+}
+
+// returns 1 on success (token in *out), 0 if closed and drained
+int32_t pt_ring_pop(void *ring, int64_t *out) {
+  PtRing *r = static_cast<PtRing *>(ring);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->not_empty.wait(lk, [r] { return r->count > 0 || r->closed; });
+  if (r->count == 0) return 0;  // closed and drained
+  *out = r->slots[r->head];
+  r->head = (r->head + 1) % r->slots.size();
+  --r->count;
+  r->not_full.notify_one();
+  return 1;
+}
+
+void pt_ring_close(void *ring) {
+  PtRing *r = static_cast<PtRing *>(ring);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closed = true;
+  }
+  r->not_full.notify_all();
+  r->not_empty.notify_all();
+}
+
+int32_t pt_ring_size(void *ring) {
+  PtRing *r = static_cast<PtRing *>(ring);
+  std::lock_guard<std::mutex> lk(r->mu);
+  return static_cast<int32_t>(r->count);
+}
+
+void pt_ring_destroy(void *ring) { delete static_cast<PtRing *>(ring); }
+
+}  // extern "C"

@@ -197,15 +197,69 @@ def _bdrl_kernel(x_ref, bias_ref, res_ref, w_ref, b_ref, seed_ref,
     inv_ref[:] = inv
 
 
+def _ln_composed(x, bias, residual, w, lb, eps):
+    """jnp reference of the kernel body (p=0 path) — used as the VJP."""
+    add = x + bias + residual
+    a32 = add.astype(jnp.float32)
+    mean = jnp.mean(a32, -1, keepdims=True)
+    var = jnp.var(a32, -1, keepdims=True)
+    out = ((a32 - mean) * jax.lax.rsqrt(var + eps)
+           * w.astype(jnp.float32) + lb.astype(jnp.float32))
+    return out.astype(x.dtype), add
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _fbdrln_nodrop(x, bias, residual, ln_weight, ln_bias, epsilon):
+    return _fbdrln_pallas(x, residual, bias, ln_weight, ln_bias, 0.0,
+                          epsilon, False, 0)
+
+
+def _fbdrln_nodrop_fwd(x, bias, residual, ln_weight, ln_bias, epsilon):
+    out = _fbdrln_pallas(x, residual, bias, ln_weight, ln_bias, 0.0,
+                         epsilon, False, 0)
+    return out, (x, bias, residual, ln_weight, ln_bias)
+
+
+def _fbdrln_nodrop_bwd(epsilon, res, g):
+    x, bias, residual, w, lb = res
+    _, vjp_fn = jax.vjp(
+        lambda xx, bb, rr, ww, ll: _ln_composed(xx, bb, rr, ww, ll,
+                                                epsilon),
+        x, bias, residual, w, lb)
+    return vjp_fn(g)
+
+
+_fbdrln_nodrop.defvjp(_fbdrln_nodrop_fwd, _fbdrln_nodrop_bwd)
+
+
 def fused_bias_dropout_residual_layer_norm(
         x, residual, bias, ln_weight, ln_bias, dropout_rate: float = 0.0,
         epsilon: float = 1e-5, training: bool = False,
         seed: Optional[int] = None):
     """Returns (ln_out, add_out) like the reference fused op
-    (fused_bias_dropout_residual_layer_norm_kernel.cu).  Dropout uses the
-    on-chip PRNG.  Differentiable via the composed jnp fallback when a grad
-    is needed through dropout (mask not saved) — for training grads use
-    dropout_rate=0 or the composed F.* path."""
+    (fused_bias_dropout_residual_layer_norm_kernel.cu).
+
+    p=0 / eval: Pallas forward + analytic (composed-jnp) VJP.
+    training with p>0: differentiable composed path with an explicit
+    dropout mask (XLA fuses it; the mask must live outside the kernel for
+    the backward)."""
+    if training and dropout_rate > 0.0:
+        from ...core.rng import next_rng_key
+        key = next_rng_key() if seed is None else jax.random.key(seed)
+        keep = jax.random.bernoulli(key, 1.0 - dropout_rate, x.shape)
+        # reference semantics: dropout applies to (x + bias), matching the
+        # Pallas kernel body (_bdrl_kernel)
+        xb = x + bias
+        xd = jnp.where(keep, xb / (1.0 - dropout_rate), 0.0).astype(x.dtype)
+        return _ln_composed(xd, jnp.zeros_like(bias), residual, ln_weight,
+                            ln_bias, epsilon)
+    return _fbdrln_nodrop(x, bias, residual, ln_weight, ln_bias, epsilon)
+
+
+def _fbdrln_pallas(
+        x, residual, bias, ln_weight, ln_bias, dropout_rate: float = 0.0,
+        epsilon: float = 1e-5, training: bool = False,
+        seed: Optional[int] = None):
     orig = x.shape
     H = x.shape[-1]
     x2 = x.reshape(-1, H)

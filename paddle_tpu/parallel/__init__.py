@@ -25,3 +25,5 @@ from .mp_layers import (  # noqa: F401
 from .sharding import ShardingStage, group_sharded_parallel  # noqa: F401
 from .topology import HybridTopology, get_topology, init_topology, set_topology  # noqa: F401
 from .pipeline import LayerDesc, PipelineLayer, SharedLayerDesc, spmd_pipeline  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401

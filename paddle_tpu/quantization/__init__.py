@@ -1,0 +1,20 @@
+"""paddle.quantization parity (reference python/paddle/quantization/ —
+QuantConfig, QAT/PTQ entry points, quanters; python/paddle/nn/quant fake
+quant ops).
+
+TPU-first: fake-quant is a pure jnp straight-through-estimator op (XLA
+fuses it into the surrounding graph); QAT wraps layers with quanters, PTQ
+runs observers that collect absmax/histogram stats during calibration.
+"""
+
+from .config import QuantConfig  # noqa: F401
+from .quanters import (  # noqa: F401
+    AbsMaxObserver, BaseQuanter, FakeQuanterWithAbsMax,
+    FakeQuanterWithAbsMaxObserver, quant_dequant,
+)
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "BaseQuanter",
+           "FakeQuanterWithAbsMax", "FakeQuanterWithAbsMaxObserver",
+           "AbsMaxObserver", "quant_dequant"]

@@ -1,0 +1,56 @@
+"""QuantConfig (reference python/paddle/quantization/config.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+from ..nn.layer.layers import Layer
+
+__all__ = ["QuantConfig"]
+
+
+class QuantConfig:
+    """Maps layers/layer-types/prefixes to (activation, weight) quanters."""
+
+    def __init__(self, activation=None, weight=None):
+        self.default_activation = activation
+        self.default_weight = weight
+        self._type_configs: Dict[Type[Layer], tuple] = {}
+        self._layer_configs: Dict[int, tuple] = {}
+        self._name_configs: Dict[str, tuple] = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        if not isinstance(layer_type, (list, tuple)):
+            layer_type = [layer_type]
+        for t in layer_type:
+            self._type_configs[t] = (activation, weight)
+        return self
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        if not isinstance(layer, (list, tuple)):
+            layer = [layer]
+        for l in layer:
+            self._layer_configs[id(l)] = (activation, weight)
+        return self
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        if not isinstance(layer_name, (list, tuple)):
+            layer_name = [layer_name]
+        for n in layer_name:
+            self._name_configs[n] = (activation, weight)
+        return self
+
+    def config_for(self, layer: Layer, name: str = ""):
+        """Resolve the (activation, weight) quanter factories for a layer;
+        precedence layer > name > type > default."""
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        for prefix, cfg in self._name_configs.items():
+            if name.startswith(prefix):
+                return cfg
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        if self.default_activation or self.default_weight:
+            return (self.default_activation, self.default_weight)
+        return None

@@ -1,0 +1,45 @@
+"""PTQ (reference python/paddle/quantization/ptq.py) — insert observers,
+calibrate, convert to quantized weights."""
+
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+from .qat import QuantedLayer
+from .quanters import quant_dequant
+
+__all__ = ["PTQ"]
+
+
+class PTQ:
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        """Insert observers on configured layers; run calibration batches
+        through the returned model."""
+        for name, child in list(model.named_children()):
+            cfg = self.config.config_for(child, name)
+            if cfg is not None:
+                act, w = cfg
+                setattr(model, name, QuantedLayer(child, act, w))
+            else:
+                self.quantize(child, inplace=True)
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Apply observed scales: weights are fake-quantized in place and
+        observers removed."""
+        for name, child in list(model.named_children()):
+            if isinstance(child, QuantedLayer):
+                inner = child.inner
+                q = child.weight_quanter
+                if hasattr(inner, "weight") and q is not None and \
+                        hasattr(q, "scales") and q.scales() is not None:
+                    inner.weight.set_value(
+                        quant_dequant(inner.weight,
+                                      q.scales().max()).numpy())
+                setattr(model, name, inner)
+            else:
+                self.convert(child, inplace=True)
+        return model

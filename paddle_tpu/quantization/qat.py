@@ -1,0 +1,71 @@
+"""QAT (reference python/paddle/quantization/qat.py) — wrap configured
+layers with fake-quant on activations and weights."""
+
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+
+__all__ = ["QAT", "QuantedLayer"]
+
+
+class QuantedLayer(Layer):
+    """Wrapper applying activation/weight fake-quant around one layer."""
+
+    def __init__(self, layer: Layer, activation_quanter, weight_quanter):
+        super().__init__()
+        self.inner = layer
+        self.activation_quanter = activation_quanter() \
+            if callable(activation_quanter) and not isinstance(
+                activation_quanter, Layer) else activation_quanter
+        self.weight_quanter = weight_quanter() \
+            if callable(weight_quanter) and not isinstance(
+                weight_quanter, Layer) else weight_quanter
+
+    def forward(self, x, *args, **kwargs):
+        from ..nn import functional as F
+        from ..nn.layer.common import Linear
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None and hasattr(self.inner, "weight"):
+            qw = self.weight_quanter(self.inner.weight)
+            if isinstance(self.inner, Linear):
+                # taped functional path: STE gradient flows through the
+                # fake-quant back to the real weight
+                return F.linear(x, qw, getattr(self.inner, "bias", None))
+            # generic layers: value-level substitution (observer/PTQ use)
+            from ..nn.layer.layers import functional_call
+            return functional_call(self.inner, {"weight": qw._value}, x,
+                                   *args, **kwargs)
+        return self.inner(x, *args, **kwargs)
+
+
+class QAT:
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        """Replace configured sublayers with QuantedLayer wrappers."""
+        for name, child in list(model.named_children()):
+            cfg = self.config.config_for(child, name)
+            if cfg is not None:
+                act, w = cfg
+                setattr(model, name, QuantedLayer(child, act, w))
+            else:
+                self.quantize(child, inplace=True)
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Strip wrappers back to inner layers (deploy form: weights stay
+        fake-quantized by the final scales)."""
+        for name, child in list(model.named_children()):
+            if isinstance(child, QuantedLayer):
+                inner = child.inner
+                if child.weight_quanter is not None and hasattr(
+                        inner, "weight"):
+                    inner.weight.set_value(
+                        child.weight_quanter(inner.weight).numpy())
+                setattr(model, name, inner)
+            else:
+                self.convert(child, inplace=True)
+        return model

@@ -1,0 +1,127 @@
+"""Quanters & observers (reference python/paddle/quantization/quanters/
+abs_max.py FakeQuanterWithAbsMaxObserver, observers/abs_max.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["quant_dequant", "BaseQuanter", "FakeQuanterWithAbsMax",
+           "FakeQuanterWithAbsMaxObserver", "AbsMaxObserver"]
+
+
+def _qdq_raw(x, scale, qmax):
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
+    return q * scale / qmax
+
+
+@jax.custom_vjp
+def _qdq_ste(x, scale, qmax):
+    return _qdq_raw(x, scale, qmax)
+
+
+def _qdq_fwd(x, scale, qmax):
+    return _qdq_raw(x, scale, qmax), (x, scale, qmax)
+
+
+def _qdq_bwd(res, g):
+    x, scale, qmax = res
+    # straight-through: pass grad inside the clip range, zero outside
+    inside = (jnp.abs(x) <= scale).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale), None
+
+
+_qdq_ste.defvjp(_qdq_fwd, _qdq_bwd)
+
+
+@primitive("fake_quant_dequant")
+def _qdq_op(x, scale, *, bit_length):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    return _qdq_ste(x, scale, qmax)
+
+
+def quant_dequant(x, scale, bit_length: int = 8):
+    """Fake-quantize x to bit_length ints and back (STE gradient)."""
+    return _qdq_op(x, scale, bit_length=bit_length)
+
+
+class BaseQuanter(Layer):
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+
+class FakeQuanterWithAbsMax(BaseQuanter):
+    """Static absmax fake quanter (scale from current tensor)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        from ..ops import api as _api
+        scale = _api.abs(x).max()
+        return quant_dequant(x, scale, self.quant_bits)
+
+    def scales(self):
+        return None
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Moving-average absmax quanter for QAT (reference
+    quanters/abs_max.py: FakeQuanterWithAbsMaxObserver)."""
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 dtype="float32", name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        self._scale = self.create_parameter([1], is_bias=True)
+        self._scale.stop_gradient = True
+        self._initialized = False
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        if self.training:
+            cur = float(jnp.max(jnp.abs(x._value)))
+            if not self._initialized:
+                new = cur
+                self._initialized = True
+            else:
+                prev = float(self._scale._value[0])
+                r = self.moving_rate
+                new = r * prev + (1 - r) * cur
+            self._scale.set_value(jnp.asarray([new], jnp.float32))
+        scale = Tensor(jnp.maximum(self._scale._value[0], 1e-9))
+        return quant_dequant(x, scale, self.bit_length)
+
+    def scales(self):
+        return Tensor(self._scale._value)
+
+
+class AbsMaxObserver(BaseQuanter):
+    """PTQ calibration observer: tracks global absmax, then quantizes
+    (reference observers/abs_max.py)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._max = 0.0
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        self._max = max(self._max, float(jnp.max(jnp.abs(x._value))))
+        return x  # observation only during calibration
+
+    def cal_thresholds(self):
+        return self._max
+
+    def scales(self):
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray([max(self._max, 1e-9)], jnp.float32))

@@ -1,0 +1,18 @@
+"""paddle.text parity (reference python/paddle/text/ — datasets Imdb,
+Imikolov, Movielens, UCIHousing, WMT14/16, Conll05 + viterbi_decode,
+ViterbiDecoder from paddle.text.viterbi_decode).
+
+Dataset classes share the reference's contract (len/getitem over
+numpy-encoded samples) but generate/load from local files — the image has
+zero egress, so the download path raises with a clear message unless the
+data file is already present (data_file=... like the reference's cached
+mode).
+"""
+
+from .datasets import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
+           "Conll05st", "viterbi_decode", "ViterbiDecoder"]

@@ -1,0 +1,74 @@
+"""Viterbi decoding (reference python/paddle/text/viterbi_decode.py →
+viterbi_decode op).  Pure lax.scan dynamic program — jit-compiled once."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+@primitive("viterbi_decode", differentiable=False)
+def _viterbi(potentials, transition, lengths, *, include_bos_eos_tag):
+    """potentials: [B, T, N]; transition: [N, N]; lengths: [B].
+    Returns (scores [B], paths [B, T])."""
+    B, T, N = potentials.shape
+
+    if include_bos_eos_tag:
+        # last two tags are BOS(=N-2)/EOS(=N-1) per the reference contract
+        bos, eos = N - 2, N - 1
+        init = potentials[:, 0] + transition[bos][None, :]
+    else:
+        init = potentials[:, 0]
+
+    def body(carry, t):
+        alpha, = carry
+        # alpha: [B, N]; scores of best path ending in each tag
+        trans = alpha[:, :, None] + transition[None, :, :]  # [B, from, to]
+        best_prev = jnp.argmax(trans, axis=1)               # [B, N]
+        alpha_new = jnp.max(trans, axis=1) + potentials[:, t]
+        # only advance rows still inside their length
+        active = (t < lengths)[:, None]
+        alpha_out = jnp.where(active, alpha_new, alpha)
+        bp = jnp.where(active, best_prev, jnp.arange(N)[None, :])
+        return (alpha_out,), bp
+
+    (alpha,), bps = jax.lax.scan(body, (init,), jnp.arange(1, T))
+    if include_bos_eos_tag:
+        alpha = alpha + transition[:, N - 1][None, :]
+
+    last_tag = jnp.argmax(alpha, axis=-1)            # [B]
+    scores = jnp.max(alpha, axis=-1)
+
+    def backtrack(carry, bp_t):
+        tag = carry
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # reverse scan: ys[i] = tag at step i+1, final carry = tag at step 0
+    first_tag, path_tail = jax.lax.scan(backtrack, last_tag, bps,
+                                        reverse=True)
+    paths = jnp.concatenate([first_tag[None, :], path_tail], axis=0).T
+    return scores, paths
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    return _viterbi(potentials, transition_params, lengths,
+                    include_bos_eos_tag=include_bos_eos_tag)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

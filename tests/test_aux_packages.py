@@ -1,0 +1,257 @@
+"""Tests for sparse / geometric / quantization / text / audio packages
+(reference test suites: test/legacy_test sparse+geometric op tests,
+test/quantization, paddle.audio tests compare to librosa — we compare to
+direct numpy math)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import audio, geometric, quantization, sparse
+from paddle_tpu.text.viterbi_decode import viterbi_decode
+
+
+class TestSparse:
+    def setup_method(self, _):
+        self.dense = np.array([[0, 2.0, 0, 4.0],
+                               [1.0, 0, 0, 0],
+                               [0, 0, 3.0, 0]], np.float32)
+        idx = np.array(np.nonzero(self.dense))
+        vals = self.dense[tuple(idx)]
+        self.coo = sparse.sparse_coo_tensor(idx, vals, self.dense.shape)
+
+    def test_coo_roundtrip(self):
+        np.testing.assert_array_equal(self.coo.to_dense().numpy(),
+                                      self.dense)
+        assert self.coo.nnz == 4
+
+    def test_csr_roundtrip(self):
+        csr = self.coo.to_sparse_csr()
+        np.testing.assert_array_equal(csr.to_dense().numpy(), self.dense)
+        np.testing.assert_array_equal(csr.crows().numpy(), [0, 2, 3, 4])
+        back = csr.to_sparse_coo()
+        np.testing.assert_array_equal(back.to_dense().numpy(), self.dense)
+
+    def test_csr_direct(self):
+        csr = sparse.sparse_csr_tensor([0, 2, 3, 4], [1, 3, 0, 2],
+                                       [2.0, 4.0, 1.0, 3.0], [3, 4])
+        np.testing.assert_array_equal(csr.to_dense().numpy(), self.dense)
+
+    def test_matmul_and_mv(self):
+        rng = np.random.default_rng(0)
+        d = rng.standard_normal((4, 5)).astype(np.float32)
+        out = sparse.matmul(self.coo, pt.to_tensor(d))
+        np.testing.assert_allclose(out.numpy(), self.dense @ d, rtol=1e-5)
+        v = rng.standard_normal(4).astype(np.float32)
+        np.testing.assert_allclose(sparse.mv(self.coo,
+                                             pt.to_tensor(v)).numpy(),
+                                   self.dense @ v, rtol=1e-5)
+
+    def test_add_subtract_multiply(self):
+        s = sparse.add(self.coo, self.coo)
+        np.testing.assert_array_equal(s.to_dense().numpy(), 2 * self.dense)
+        z = sparse.subtract(self.coo, self.coo)
+        np.testing.assert_array_equal(z.to_dense().numpy(),
+                                      np.zeros_like(self.dense))
+        m = sparse.multiply(self.coo, self.coo)
+        np.testing.assert_array_equal(m.to_dense().numpy(),
+                                      self.dense * self.dense)
+
+    def test_unary_ops(self):
+        s = sparse.square(self.coo)
+        np.testing.assert_allclose(s.to_dense().numpy(),
+                                   self.dense ** 2, rtol=1e-6)
+        t = sparse.tanh(self.coo)
+        np.testing.assert_allclose(t.to_dense().numpy(),
+                                   np.tanh(self.dense), rtol=1e-6)
+
+    def test_masked_matmul(self):
+        rng = np.random.default_rng(1)
+        a = rng.standard_normal((3, 6)).astype(np.float32)
+        b = rng.standard_normal((6, 4)).astype(np.float32)
+        out = sparse.masked_matmul(pt.to_tensor(a), pt.to_tensor(b),
+                                   self.coo)
+        expect = (a @ b) * (self.dense != 0)
+        np.testing.assert_allclose(out.to_dense().numpy(), expect,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_softmax_rows(self):
+        sm = sparse.softmax(self.coo.to_sparse_csr())
+        d = sm.to_dense().numpy()
+        # row 0 has two nonzeros -> softmax over [2,4]
+        e = np.exp([2.0 - 4.0, 0.0])
+        np.testing.assert_allclose(d[0, [1, 3]], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(d[1, 0], 1.0, rtol=1e-6)
+
+
+class TestGeometric:
+    def test_send_u_recv(self):
+        x = pt.to_tensor(np.array([[1.0], [2.0], [4.0]], np.float32))
+        src = pt.to_tensor(np.array([0, 1, 2, 0], np.int64))
+        dst = pt.to_tensor(np.array([1, 2, 1, 0], np.int64))
+        out = geometric.send_u_recv(x, src, dst, reduce_op="sum")
+        np.testing.assert_allclose(out.numpy(), [[1.0], [5.0], [2.0]])
+        out = geometric.send_u_recv(x, src, dst, reduce_op="max")
+        np.testing.assert_allclose(out.numpy(), [[1.0], [4.0], [2.0]])
+        out = geometric.send_u_recv(x, src, dst, reduce_op="mean")
+        np.testing.assert_allclose(out.numpy(), [[1.0], [2.5], [2.0]])
+
+    def test_send_ue_recv_and_uv(self):
+        x = pt.to_tensor(np.array([[1.0], [2.0]], np.float32))
+        e = pt.to_tensor(np.array([[10.0], [20.0]], np.float32))
+        src = pt.to_tensor(np.array([0, 1], np.int64))
+        dst = pt.to_tensor(np.array([1, 0], np.int64))
+        out = geometric.send_ue_recv(x, e, src, dst, "add", "sum")
+        np.testing.assert_allclose(out.numpy(), [[22.0], [11.0]])
+        uv = geometric.send_uv(x, x, src, dst, "mul")
+        np.testing.assert_allclose(uv.numpy(), [[2.0], [2.0]])
+
+    def test_segment_ops(self):
+        data = pt.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+        ids = pt.to_tensor(np.array([0, 0, 1, 1], np.int64))
+        np.testing.assert_allclose(
+            geometric.segment_sum(data, ids).numpy(), [3.0, 7.0])
+        np.testing.assert_allclose(
+            geometric.segment_mean(data, ids).numpy(), [1.5, 3.5])
+        np.testing.assert_allclose(
+            geometric.segment_min(data, ids).numpy(), [1.0, 3.0])
+        np.testing.assert_allclose(
+            geometric.segment_max(data, ids).numpy(), [2.0, 4.0])
+
+    def test_grad_through_send_u_recv(self):
+        x = pt.to_tensor(np.ones((3, 2), np.float32), stop_gradient=False)
+        src = pt.to_tensor(np.array([0, 1], np.int64))
+        dst = pt.to_tensor(np.array([1, 2], np.int64))
+        out = geometric.send_u_recv(x, src, dst)
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   [[1, 1], [1, 1], [0, 0]])
+
+    def test_reindex_and_sampling(self):
+        x = pt.to_tensor(np.array([5, 9], np.int64))
+        neighbors = pt.to_tensor(np.array([9, 7, 5, 3], np.int64))
+        count = pt.to_tensor(np.array([2, 2], np.int32))
+        src, dst, nodes = geometric.reindex_graph(x, neighbors, count)
+        np.testing.assert_array_equal(nodes.numpy(), [5, 9, 7, 3])
+        np.testing.assert_array_equal(src.numpy(), [1, 2, 0, 3])
+        np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1])
+        # CSC graph: node0 <- {1,2}, node1 <- {0}
+        row = pt.to_tensor(np.array([1, 2, 0], np.int64))
+        colptr = pt.to_tensor(np.array([0, 2, 3], np.int64))
+        nb, cnt = geometric.sample_neighbors(row, colptr,
+                                             pt.to_tensor(
+                                                 np.array([0, 1],
+                                                          np.int64)),
+                                             sample_size=1)
+        assert cnt.numpy().tolist() == [1, 1]
+
+
+class TestQuantization:
+    def test_quant_dequant_values(self):
+        x = pt.to_tensor(np.array([-1.0, -0.5, 0.0, 0.5, 1.0], np.float32))
+        scale = pt.to_tensor(np.float32(1.0))
+        out = quantization.quant_dequant(x, scale, 8).numpy()
+        np.testing.assert_allclose(out, np.round(
+            np.array([-1, -0.5, 0, 0.5, 1]) * 127) / 127, atol=1e-6)
+
+    def test_ste_gradient(self):
+        x = pt.to_tensor(np.array([-2.0, 0.3, 0.9], np.float32),
+                         stop_gradient=False)
+        scale = pt.to_tensor(np.float32(1.0))
+        out = quantization.quant_dequant(x, scale, 8)
+        out.sum().backward()
+        # STE: unit grad inside [-scale, scale], zero outside
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 1.0])
+
+    def test_qat_wrap_and_convert(self):
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import (
+            FakeQuanterWithAbsMaxObserver, QAT, QuantConfig)
+        pt.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        cfg = QuantConfig(
+            activation=lambda: FakeQuanterWithAbsMaxObserver(),
+            weight=lambda: FakeQuanterWithAbsMaxObserver())
+        qat = QAT(cfg)
+        qnet = qat.quantize(net)
+        x = pt.to_tensor(np.random.default_rng(0).standard_normal(
+            (3, 4)).astype(np.float32))
+        y = qnet(x)
+        assert tuple(y.shape) == (3, 2)
+        # converted model runs without wrappers
+        deploy = qat.convert(qnet)
+        y2 = deploy(x)
+        assert tuple(y2.shape) == (3, 2)
+
+
+class TestViterbi:
+    def test_matches_bruteforce(self):
+        rng = np.random.default_rng(0)
+        B, T, N = 2, 4, 3
+        pot = rng.standard_normal((B, T, N)).astype(np.float32)
+        trans = rng.standard_normal((N, N)).astype(np.float32)
+        lengths = np.array([4, 4], np.int64)
+        scores, paths = viterbi_decode(
+            pt.to_tensor(pot), pt.to_tensor(trans),
+            pt.to_tensor(lengths), include_bos_eos_tag=False)
+        # brute force
+        import itertools
+        for b in range(B):
+            best, best_path = -1e30, None
+            for p in itertools.product(range(N), repeat=T):
+                s = pot[b, 0, p[0]]
+                for t in range(1, T):
+                    s += trans[p[t - 1], p[t]] + pot[b, t, p[t]]
+                if s > best:
+                    best, best_path = s, p
+            assert scores.numpy()[b] == pytest.approx(best, rel=1e-5)
+            assert tuple(paths.numpy()[b]) == best_path
+
+
+class TestAudio:
+    def test_window_and_fbank(self):
+        w = audio.functional.get_window("hann", 16).numpy()
+        np.testing.assert_allclose(w, np.hanning(17)[:16], atol=1e-6)
+        fb = audio.functional.compute_fbank_matrix(16000, 512,
+                                                   n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+
+    def test_spectrogram_shapes(self):
+        rng = np.random.default_rng(0)
+        x = pt.to_tensor(rng.standard_normal((2, 2048)).astype(np.float32))
+        spec = audio.Spectrogram(n_fft=256, hop_length=128)(x)
+        assert spec.shape[1] == 129
+        mel = audio.MelSpectrogram(sr=8000, n_fft=256, hop_length=128,
+                                   n_mels=32)(x)
+        assert mel.shape[1] == 32
+        logmel = audio.LogMelSpectrogram(sr=8000, n_fft=256,
+                                         hop_length=128, n_mels=32)(x)
+        assert np.isfinite(logmel.numpy()).all()
+        mfcc = audio.MFCC(sr=8000, n_mfcc=13, n_fft=256, hop_length=128,
+                          n_mels=32)(x)
+        assert mfcc.shape[1] == 13
+
+    def test_power_to_db(self):
+        s = pt.to_tensor(np.array([1.0, 10.0, 100.0], np.float32))
+        db = audio.functional.power_to_db(s, top_db=None).numpy()
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-5)
+
+
+class TestTextDatasets:
+    def test_uci_housing_from_file(self, tmp_path):
+        rng = np.random.default_rng(0)
+        data = rng.standard_normal((50, 14)).astype(np.float32)
+        f = tmp_path / "housing.data"
+        np.savetxt(f, data)
+        from paddle_tpu.text import UCIHousing
+        train = UCIHousing(data_file=str(f), mode="train")
+        test = UCIHousing(data_file=str(f), mode="test")
+        assert len(train) == 40 and len(test) == 10
+        x, y = train[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_missing_file_raises(self):
+        from paddle_tpu.text import Imdb
+        with pytest.raises(RuntimeError, match="data_file"):
+            Imdb()

@@ -1,0 +1,104 @@
+"""Launcher / elastic / watchdog tests (reference test/collective
+launcher harness tests; SURVEY §4 'multi-node without a cluster' —
+multi-process on one host)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed.elastic import (ElasticManager, FileStore,
+                                            StepWatchdog)
+from paddle_tpu.distributed.launch.main import _nnodes_range, launch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_nnodes_range():
+    assert _nnodes_range("4") == (4, 4)
+    assert _nnodes_range("2:6") == (2, 6)
+
+
+def test_local_launch_spawns_ranks(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os\n"
+        "print('rank', os.environ['PADDLE_TRAINER_ID'],\n"
+        "      'of', os.environ['PADDLE_TRAINERS_NUM'])\n")
+    log_dir = tmp_path / "logs"
+    code = launch(["--nproc_per_node", "2", "--log_dir", str(log_dir),
+                   str(script)])
+    assert code == 0
+    logs = sorted(os.listdir(log_dir))
+    assert logs == ["workerlog.0", "workerlog.1"]
+    assert "rank 0 of 2" in (log_dir / "workerlog.0").read_text()
+    assert "rank 1 of 2" in (log_dir / "workerlog.1").read_text()
+
+
+def test_local_launch_failure_propagates(tmp_path):
+    script = tmp_path / "fail.py"
+    script.write_text(
+        "import os, sys\n"
+        "sys.exit(3 if os.environ['PADDLE_TRAINER_ID'] == '1' else 0)\n")
+    code = launch(["--nproc_per_node", "2", str(script)])
+    assert code == 3
+
+
+def test_max_restart(tmp_path):
+    # first attempt fails, then the marker exists and the job succeeds
+    marker = tmp_path / "marker"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        f"import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        f"if os.path.exists(m): sys.exit(0)\n"
+        f"open(m, 'w').close(); sys.exit(1)\n")
+    code = launch(["--nproc_per_node", "1", "--max_restart", "2",
+                   str(script)])
+    assert code == 0
+
+
+class TestElastic:
+    def test_membership_and_ttl(self, tmp_path):
+        store = FileStore(str(tmp_path), ttl=0.5)
+        store.register("host_a")
+        store.register("host_b")
+        assert store.hosts() == ["host_a", "host_b"]
+        time.sleep(0.6)
+        store.register("host_a")
+        assert store.hosts() == ["host_a"]  # b's lease expired
+        store.deregister("host_a")
+        assert store.hosts() == []
+
+    def test_scale_decision(self, tmp_path):
+        store = FileStore(str(tmp_path))
+        m = ElasticManager(store, "h0", nnodes="2:4")
+        assert m.elastic_enabled
+        assert m.scale_decision(["h0"]) == "wait"
+        assert m.scale_decision(["h0", "h1"]) == "ok"
+        m._known = ["h0", "h1"]
+        assert m.scale_decision(["h0", "h1", "h2"]) == "restart"
+        assert m.scale_decision(["h0", "h1"]) == "ok"
+
+
+class TestWatchdog:
+    def test_fires_on_hang(self):
+        fired = []
+        wd = StepWatchdog(timeout=0.3, on_timeout=lambda: fired.append(1),
+                          poll=0.05).start()
+        with wd.step():
+            time.sleep(0.7)
+        wd.stop()
+        assert fired
+
+    def test_quiet_on_fast_steps(self):
+        fired = []
+        wd = StepWatchdog(timeout=1.0, on_timeout=lambda: fired.append(1),
+                          poll=0.05).start()
+        for _ in range(3):
+            with wd.step():
+                time.sleep(0.02)
+        wd.stop()
+        assert not fired

@@ -1,0 +1,114 @@
+"""Auto-tuner + incubate (higher-order autograd, fused layers) tests
+(reference test/auto_tuner, test/legacy_test/test_fused_attention_op.py,
+incubate autograd suites)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed.auto_tuner import (AutoTuner, TuneConfig,
+                                               default_candidates, prune)
+from paddle_tpu.incubate import autograd as ia
+
+
+class TestAutoTuner:
+    def test_candidates_factor_device_count(self):
+        cands = default_candidates(8, global_batch_size=32, num_layers=8,
+                                   num_heads=8)
+        assert cands
+        for c in cands:
+            assert c.degrees_product() == 8
+            assert 32 % (c.dp_degree * c.sharding_degree) == 0
+            assert 8 % c.mp_degree == 0 and 8 % c.pp_degree == 0
+
+    def test_prune_rules(self):
+        bad = [TuneConfig(dp_degree=3),                      # not factor 8
+               TuneConfig(dp_degree=8, micro_batch_size=3),  # mbs not div
+               TuneConfig(dp_degree=4, mp_degree=2,
+                          sharding_stage=2)]                 # stage w/o shard
+        assert prune(bad, 8, 32) == []
+
+    def test_tune_picks_best(self, tmp_path):
+        tuner = AutoTuner(num_devices=8, global_batch_size=32,
+                          model_params=1e8, hidden=512, layers=8,
+                          num_heads=8, max_trials=6,
+                          history_path=str(tmp_path / "hist.csv"))
+
+        def run(cfg):
+            # favor pure dp with bigger micro batches
+            if cfg["mp_degree"] > 1 or cfg["pp_degree"] > 1:
+                return 10.0
+            return 100.0 * cfg["micro_batch_size"]
+
+        best, metric = tuner.tune(run)
+        assert best is not None and metric > 10
+        assert (tmp_path / "hist.csv").exists()
+        assert len(tuner.history) == 6
+
+    def test_failed_trials_skipped(self):
+        tuner = AutoTuner(num_devices=4, global_batch_size=16,
+                          model_params=1e7, layers=4, max_trials=3)
+        calls = []
+
+        def run(cfg):
+            calls.append(cfg)
+            if len(calls) == 1:
+                raise MemoryError("oom")
+            return 1.0
+
+        best, metric = tuner.tune(run)
+        assert best is not None
+        assert tuner.history[0]["metric"] is None
+
+
+class TestHigherOrderAutograd:
+    def test_jacobian_hessian(self):
+        xs = pt.to_tensor(np.array([1.0, 2.0], np.float32),
+                          stop_gradient=False)
+        f = lambda t: (t ** 3).sum()
+        np.testing.assert_allclose(np.asarray(ia.jacobian(f, xs).numpy()),
+                                   [3.0, 12.0], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(ia.hessian(f, xs).numpy()),
+                                   [[6.0, 0.0], [0.0, 12.0]], rtol=1e-5)
+
+    def test_jvp_vjp_roundtrip(self):
+        xs = pt.to_tensor(np.array([0.5, 1.5], np.float32),
+                          stop_gradient=False)
+        f = lambda t: t * t
+        v = pt.to_tensor(np.array([1.0, 1.0], np.float32))
+        _, tangent = ia.jvp(f, xs, v)
+        np.testing.assert_allclose(np.asarray(tangent.numpy()),
+                                   [1.0, 3.0], rtol=1e-5)
+        _, cotangent = ia.vjp(f, xs, v)
+        np.testing.assert_allclose(np.asarray(cotangent.numpy()),
+                                   [1.0, 3.0], rtol=1e-5)
+
+    def test_forward_grad(self):
+        xs = pt.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        t = ia.forward_grad(lambda v: v ** 2, xs)
+        np.testing.assert_allclose(np.asarray(t.numpy()), [4.0], rtol=1e-5)
+
+
+class TestFusedLayers:
+    def test_encoder_layer_matches_unfused_shape(self):
+        from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+        pt.seed(0)
+        net = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+        net.eval()
+        x = pt.to_tensor(np.random.default_rng(0).standard_normal(
+            (2, 6, 32)).astype(np.float32))
+        y = net(x)
+        assert tuple(y.shape) == (2, 6, 32)
+        assert np.isfinite(y.numpy()).all()
+
+    def test_fused_attention_grad(self):
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+        pt.seed(1)
+        net = FusedMultiHeadAttention(16, 2, dropout_rate=0.0,
+                                      attn_dropout_rate=0.0)
+        x = pt.to_tensor(np.random.default_rng(1).standard_normal(
+            (2, 4, 16)).astype(np.float32))
+        out = net(x)
+        out.sum().backward()
+        assert net.qkv_weight.grad is not None
+        assert np.abs(net.qkv_weight.grad.numpy()).sum() > 0
