@@ -279,7 +279,9 @@ def stack_block_params(cfg: GPTConfig, key, num_stages: int
 def build_gpt_train_step(cfg: GPTConfig, topo=None,
                          num_microbatches: int = 4,
                          learning_rate: float = 1e-4,
-                         cp_mode: str = None):
+                         cp_mode: str = None,
+                         use_flash: Optional[bool] = None,
+                         remat: bool = True):
     """Compile a full hybrid-parallel GPT training step: dp×mp×pp×sp×cp.
 
     ``cp_mode``: None (GSPMD sequence sharding via constraint), "ring"
@@ -321,6 +323,19 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                 return ulysses_attention(q, k, v, SEP_AXIS, True)
     else:
         cp_attn = None
+
+    if cp_attn is None:
+        # Pallas flash attention: no [b,h,s,s] probs materialized — the
+        # memory/bandwidth win that lets big batches fit HBM (§2.6 ★).
+        # Auto only on a single-device mesh: under GSPMD sharding a pallas
+        # custom-call has no partitioning rule (the sharded paths use
+        # shard_map + ring/ulysses instead).
+        if use_flash is None:
+            use_flash = (jax.default_backend() not in ("cpu",)
+                         and mesh.size == 1)
+        if use_flash:
+            from ..ops.pallas.flash_attention import flash_attention
+            cp_attn = functools.partial(flash_attention, causal=True)
 
     def sh(spec):
         return NamedSharding(mesh, spec)
@@ -413,7 +428,10 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                     axis_names={SEP_AXIS}, check_vma=False)(flat_blocks, x)
             else:
                 def body(carry, layer_params):
-                    return block_apply(layer_params, carry, cfg), None
+                    return block_apply(layer_params, carry, cfg,
+                                       cp_attn), None
+                if remat:
+                    body = jax.checkpoint(body)
                 x, _ = jax.lax.scan(body, x, flat_blocks)
 
         mean = jnp.mean(x, -1, keepdims=True)

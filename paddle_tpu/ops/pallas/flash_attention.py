@@ -51,9 +51,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def compute():
-        q = q_ref[:].astype(jnp.float32)          # [bq, d]
-        k = k_ref[:].astype(jnp.float32)          # [bk, d]
-        v = v_ref[:].astype(jnp.float32)          # [bk, d]
+        # keep inputs in their native (bf16) dtype: the MXU multiplies
+        # bf16 x bf16 with f32 accumulation natively — casting up first
+        # halves throughput
+        q = q_ref[:]                               # [bq, d]
+        k = k_ref[:]                               # [bk, d]
+        v = v_ref[:]                               # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
@@ -72,7 +75,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = m_new
         l_scr[:] = l_new
