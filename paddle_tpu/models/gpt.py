@@ -8,10 +8,12 @@ fleet.meta_parallel mp layers.  Two execution paths:
 * :class:`GPTForCausalLM` — imperative Layer graph with TP-annotated
   parameters (Column/RowParallelLinear, VocabParallelEmbedding); runs eager,
   under the hapi trainer, or sharded via DistributedEngine (dp/mp/sharding).
-* :func:`build_gpt_train_step` — fully-compiled hybrid dp×mp×pp×sp train
-  step: embeddings/head GSPMD-sharded, block stack stacked [pp, per, ...]
-  and scheduled by parallel.pipeline.spmd_pipeline inside a partial-manual
-  shard_map over the ``pp`` axis, sequence dim constrained over ``sep``.
+* :func:`build_gpt_train_step` — fully-compiled hybrid
+  dp×mp×pp×sharding×sep train step: one fully-MANUAL shard_map over all
+  five mesh axes, Megatron-style tensor parallelism via explicit
+  collectives (parallel/manual.py), the scan pipeline over ``pp``
+  (parallel/pipeline.py), ring/Ulysses context parallelism over ``sep``,
+  and flat ZeRO stage-2 Adam over the ``sharding`` axis.
 """
 
 from __future__ import annotations
@@ -231,12 +233,19 @@ def block_param_specs(cfg: GPTConfig, pipeline: bool) -> Dict[str, P]:
 
 
 def block_apply(params: Dict[str, jax.Array], x: jax.Array,
-                cfg: GPTConfig, attn_fn=None) -> jax.Array:
+                cfg: GPTConfig, attn_fn=None,
+                mp_axis: Optional[str] = None) -> jax.Array:
     """One transformer block, pure jnp (used stacked under lax.scan).
 
-    ``attn_fn(q, k, v) -> out`` (all [b, s, heads, head_dim]) overrides the
-    attention op — used for ring/Ulysses context parallelism where the seq
-    dim is a manual mesh axis (parallel/context_parallel.py)."""
+    ``attn_fn(q, k, v) -> out`` (all [b, s, heads_local, head_dim])
+    overrides the attention op — used for ring/Ulysses context parallelism
+    where the seq dim is a manual mesh axis (parallel/context_parallel.py).
+
+    ``mp_axis``: when set, params are the Megatron-style LOCAL shards of a
+    tensor-parallel block (qkv/fc1 column-split, proj/fc2 row-split,
+    reference fleet/layers/mpu/mp_layers.py:334/541) and the function runs
+    inside a manual shard_map: ``mp_copy`` before column matmuls (identity
+    fwd / psum bwd), ``psum`` after row matmuls, biases added post-psum."""
     b, s, h = x.shape
 
     def ln(v, w, bia):
@@ -244,25 +253,39 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
         var = jnp.var(v, -1, keepdims=True)
         return (v - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps) * w + bia
 
+    def col_in(y):
+        if mp_axis is not None:
+            from ..parallel.manual import mp_copy
+            return mp_copy(y, mp_axis)
+        return y
+
+    def row_out(z):
+        if mp_axis is not None:
+            from ..parallel.manual import fwd_psum
+            return fwd_psum(z, mp_axis)
+        return z
+
     res = x
-    y = ln(x, params["ln1_w"], params["ln1_b"])
+    y = col_in(ln(x, params["ln1_w"], params["ln1_b"]))
     qkv = y @ params["qkv_w"] + params["qkv_b"]
-    qkv = qkv.reshape(b, s, cfg.num_heads, 3 * cfg.head_dim)
+    qkv = qkv.reshape(b, s, -1, 3 * cfg.head_dim)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     if attn_fn is not None:
-        attn = attn_fn(q, k, v).reshape(b, s, h)
+        attn = attn_fn(q, k, v)
+        attn = attn.reshape(b, s, attn.shape[2] * attn.shape[3])
     else:
         scale = 1.0 / math.sqrt(cfg.head_dim)
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
         mask = jnp.tril(jnp.ones((s, s), bool))
         logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
         probs = jax.nn.softmax(logits, -1).astype(x.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, h)
-    x = res + attn @ params["proj_w"] + params["proj_b"]
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        attn = attn.reshape(b, s, attn.shape[2] * attn.shape[3])
+    x = res + row_out(attn @ params["proj_w"]) + params["proj_b"]
     res = x
-    y = ln(x, params["ln2_w"], params["ln2_b"])
+    y = col_in(ln(x, params["ln2_w"], params["ln2_b"]))
     y = jax.nn.gelu(y @ params["fc1_w"] + params["fc1_b"], approximate=True)
-    return res + y @ params["fc2_w"] + params["fc2_b"]
+    return res + row_out(y @ params["fc2_w"]) + params["fc2_b"]
 
 
 def stack_block_params(cfg: GPTConfig, key, num_stages: int
@@ -282,37 +305,54 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                          cp_mode: str = None,
                          use_flash: Optional[bool] = None,
                          remat: bool = True):
-    """Compile a full hybrid-parallel GPT training step: dp×mp×pp×sp×cp.
+    """Compile a full hybrid-parallel GPT training step: dp×mp×pp×sharding×sep.
 
-    ``cp_mode``: None (GSPMD sequence sharding via constraint), "ring"
-    (ring flash attention over the sep axis) or "ulysses" (all-to-all heads
-    swap) — the explicit context-parallel paths; see
-    parallel/context_parallel.py.
+    Fully-MANUAL SPMD: one ``shard_map`` over ALL five mesh axes.  Tensor
+    parallelism is Megatron-style local shards + explicit collectives
+    (parallel/manual.py — vocab-parallel embedding/cross-entropy, mp_copy/
+    psum around column/row matmuls, matching reference mp_layers.py
+    semantics); pp is the scan pipeline (parallel/pipeline.py); sep is
+    ring/Ulysses context parallelism; dp/sharding split the batch, with
+    ZeRO stage-2 semantics on the sharding axis (grads reduce-scattered,
+    fp32 Adam moments stored 1/shard per device, params all-gathered —
+    reference group_sharded_stage2.py:46).
+
+    Round-1 GSPMD-sharded params *around* a partial-manual shard_map, which
+    exploded SPMD partitioning on mp×pp meshes (compile >10min); manual
+    collectives keep compile time flat in mesh size.
+
+    ``cp_mode``: None (auto: "ring" when sep>1), "ring", or "ulysses".
 
     Returns (step_fn, init_fn):
       init_fn(seed) -> state pytree placed on the mesh
       step_fn(state, batch_ids, batch_labels) -> (state, loss)
-    Embedding/head are GSPMD tp-sharded; the block stack runs through the
-    scan pipeline inside shard_map(axis_names={'pp'}); optimizer is fused
-    Adam over the sharded state (ZeRO via the sharding axis on opt moments).
     """
+    from ..parallel import manual as man
     from ..parallel.pipeline import spmd_pipeline
     topo = topo or get_topology()
-    S = topo.get_pipe_parallel_world_size()
     mesh = topo.mesh
+    S = topo.get_pipe_parallel_world_size()
+    mp = topo.get_model_parallel_world_size()
+    sep = topo.get_sep_parallel_world_size()
+    dp = topo.get_data_parallel_world_size()
+    shard = topo.get_sharding_parallel_world_size()
     if cfg.num_layers % S != 0:
         raise ValueError(
             f"num_layers {cfg.num_layers} not divisible by pp degree {S}")
-    per = cfg.num_layers // S
-    data_axes = tuple(a for a in (DP_AXIS, SHARDING_AXIS)
-                      if topo.axis_size(a) > 1) or (DP_AXIS,)
-    sep = topo.get_sep_parallel_world_size()
+    if mp > 1:
+        for name, val in (("vocab_size", cfg.vocab_size),
+                          ("num_heads", cfg.num_heads),
+                          ("ffn_size", cfg.ffn_size)):
+            if val % mp != 0:
+                raise ValueError(f"{name}={val} not divisible by mp={mp}")
     if cp_mode not in (None, "ring", "ulysses"):
         raise ValueError(f"unknown cp_mode {cp_mode!r}")
-    if cp_mode == "ulysses" and cfg.num_heads % sep != 0:
-        raise ValueError("ulysses needs num_heads % sep == 0")
-    use_cp = cp_mode is not None and sep > 1
-    if use_cp:
+    if sep > 1 and cp_mode is None:
+        cp_mode = "ring"
+    if cp_mode == "ulysses" and (cfg.num_heads // mp) % sep != 0:
+        raise ValueError("ulysses needs (num_heads/mp) % sep == 0")
+
+    if sep > 1:
         from ..parallel.context_parallel import (
             ring_flash_attention, ulysses_attention)
         if cp_mode == "ring":
@@ -322,28 +362,28 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
             def cp_attn(q, k, v):
                 return ulysses_attention(q, k, v, SEP_AXIS, True)
     else:
-        cp_attn = None
-
-    if cp_attn is None:
-        # Pallas flash attention: no [b,h,s,s] probs materialized — the
-        # memory/bandwidth win that lets big batches fit HBM (§2.6 ★).
-        # Auto only on a single-device mesh: under GSPMD sharding a pallas
-        # custom-call has no partitioning rule (the sharded paths use
-        # shard_map + ring/ulysses instead).
+        # Pallas flash attention on the device-local shard: inside a fully
+        # manual shard_map the custom-call needs no partitioning rule, so
+        # it is usable on ANY mesh (round-1 limited it to mesh.size==1).
         if use_flash is None:
-            use_flash = (jax.default_backend() not in ("cpu",)
-                         and mesh.size == 1)
+            use_flash = jax.default_backend() not in ("cpu",)
         if use_flash:
             from ..ops.pallas.flash_attention import flash_attention
             cp_attn = functools.partial(flash_attention, causal=True)
-
-    def sh(spec):
-        return NamedSharding(mesh, spec)
+        else:
+            cp_attn = None
 
     emb_specs = {
         "wte": P(MP_AXIS, None), "wpe": P(), "lnf_w": P(), "lnf_b": P(),
     }
     blk_specs = block_param_specs(cfg, pipeline=True)
+    param_specs = dict(emb_specs, blocks=blk_specs)
+    mom_specs = man.tree_map_with_spec(lambda _p, _s: man.MOMENT_SPEC,
+                                       param_specs, param_specs)
+    data_spec = P((DP_AXIS, SHARDING_AXIS), SEP_AXIS)
+
+    def sh(spec):
+        return NamedSharding(mesh, spec)
 
     def init_fn(seed: int = 0):
         key = jax.random.key(seed)
@@ -362,118 +402,114 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
             "blocks": {n: jax.device_put(v, sh(blk_specs[n]))
                        for n, v in stack_block_params(cfg, k3, S).items()},
         }
-        opt = {
-            "m": jax.tree.map(lambda v: jnp.zeros_like(v, jnp.float32), params),
-            "v": jax.tree.map(lambda v: jnp.zeros_like(v, jnp.float32), params),
-            "t": jnp.zeros((), jnp.int32),
-        }
-        return {"params": params, "opt": opt}
+        # flat ZeRO moments: one fp32 chunk per (pp, mp, sharding) coord
+        mom_shapes = man.tree_map_with_spec(
+            lambda p, spec: man.moment_shape(p.shape, spec, topo),
+            params, param_specs)
 
-    def forward_loss(params, ids, labels):
-        b, s = ids.shape
-        x = jnp.take(params["wte"], ids, axis=0) \
-            + params["wpe"][None, :s, :]
-        # sequence-parallel constraint (sep axis shards seq dim)
-        x = jax.lax.with_sharding_constraint(
-            x, sh(P(data_axes, SEP_AXIS, None)))
+        def zeros_moms():
+            return man.tree_map_with_spec(
+                lambda shp, _: jnp.zeros(shp, jnp.float32), mom_shapes,
+                param_specs)
 
-        if S > 1:
-            M = num_microbatches
-            mbs = x.reshape(M, b // M, s, cfg.hidden_size)
-
-            def stage_fn(blk_local, h):
-                # blk_local leaves: [1(pp-local), per_stage, ...] — drop the
-                # manual-axis dim, then scan over this stage's layers
-                local = jax.tree.map(lambda v: v[0], blk_local)
-
-                def body(carry, layer_params):
-                    return block_apply(layer_params, carry, cfg,
-                                       cp_attn), None
-                out, _ = jax.lax.scan(body, h, local)
-                return out
-
-            def pp_inner(blk_local, mb_local):
-                outs = spmd_pipeline(stage_fn, blk_local, mb_local, S,
-                                     remat=True)
-                is_last = (jax.lax.axis_index(PP_AXIS) == S - 1)
-                return jax.lax.psum(
-                    outs * is_last.astype(outs.dtype), PP_AXIS)
-
-            blk_in_specs = jax.tree.map(lambda _: P(PP_AXIS),
-                                        params["blocks"])
-            mb_spec = P(None, None, SEP_AXIS, None) if use_cp else P(None)
-            axis_names = {PP_AXIS, SEP_AXIS} if use_cp else {PP_AXIS}
-            x = jax.shard_map(
-                pp_inner, mesh=mesh,
-                in_specs=(blk_in_specs, mb_spec),
-                out_specs=mb_spec, axis_names=axis_names,
-                check_vma=False)(params["blocks"], mbs)
-            x = x.reshape(b, s, cfg.hidden_size)
-        else:
-            flat_blocks = jax.tree.map(
-                lambda v: v.reshape((cfg.num_layers,) + v.shape[2:]),
-                params["blocks"])
-            if use_cp:
-                def blocks_inner(blk, x_local):
-                    def body(carry, layer_params):
-                        return block_apply(layer_params, carry, cfg,
-                                           cp_attn), None
-                    out, _ = jax.lax.scan(body, x_local, blk)
-                    return out
-                blk_specs_in = jax.tree.map(lambda _: P(), flat_blocks)
-                x = jax.shard_map(
-                    blocks_inner, mesh=mesh,
-                    in_specs=(blk_specs_in, P(None, SEP_AXIS, None)),
-                    out_specs=P(None, SEP_AXIS, None),
-                    axis_names={SEP_AXIS}, check_vma=False)(flat_blocks, x)
-            else:
-                def body(carry, layer_params):
-                    return block_apply(layer_params, carry, cfg,
-                                       cp_attn), None
-                if remat:
-                    body = jax.checkpoint(body)
-                x, _ = jax.lax.scan(body, x, flat_blocks)
-
-        mean = jnp.mean(x, -1, keepdims=True)
-        var = jnp.var(x, -1, keepdims=True)
-        x = (x - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps) \
-            * params["lnf_w"] + params["lnf_b"]
-        logits = jnp.einsum("bsh,vh->bsv", x, params["wte"])
-        logits = logits.astype(jnp.float32)
-        lp = jax.nn.log_softmax(logits, -1)
-        nll = -jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
-        return jnp.mean(nll)
+        mom_sh = man.tree_map_with_spec(lambda _s, _sp: sh(man.MOMENT_SPEC),
+                                        mom_shapes, param_specs)
+        zinit = jax.jit(zeros_moms, out_shardings=mom_sh)
+        m0, v0 = zinit(), zinit()
+        return {"params": params,
+                "opt": {"m": m0, "v": v0, "t": jnp.zeros((), jnp.int32)}}
 
     b1, b2, eps = 0.9, 0.95, 1e-8
+    EMB_KEYS = ("wte", "wpe", "lnf_w", "lnf_b")
+
+    def local_step(params, m, v, t, ids, labels):
+        """Runs per-device inside shard_map; all arrays are local shards."""
+        b_l, s_l = ids.shape
+
+        def loss_fn(params):
+            x = man.vocab_parallel_embedding(ids, params["wte"])
+            pos = jax.lax.axis_index(SEP_AXIS) * s_l + jnp.arange(s_l)
+            x = x + jnp.take(params["wpe"], pos, axis=0)[None]
+            blk = {k: val[0] for k, val in params["blocks"].items()}
+
+            def body(carry, layer_params):
+                return block_apply(layer_params, carry, cfg, cp_attn,
+                                   mp_axis=MP_AXIS), None
+
+            if S > 1:
+                M = num_microbatches
+                mbs = x.reshape(M, b_l // M, s_l, cfg.hidden_size)
+
+                def stage_fn(blk_local, hcarry):
+                    out, _ = jax.lax.scan(body, hcarry, blk_local)
+                    return out
+
+                outs = spmd_pipeline(stage_fn, blk, mbs, S, remat=remat)
+                x = outs.reshape(b_l, s_l, cfg.hidden_size)
+            else:
+                sbody = jax.checkpoint(body) if remat else body
+                x, _ = jax.lax.scan(sbody, x, blk)
+
+            mean = jnp.mean(x, -1, keepdims=True)
+            var = jnp.var(x, -1, keepdims=True)
+            x = (x - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps) \
+                * params["lnf_w"] + params["lnf_b"]
+            xf = man.mp_copy(x, MP_AXIS)
+            logits = jnp.einsum("bsh,vh->bsv", xf, params["wte"],
+                                preferred_element_type=jnp.float32)
+            nll = man.vocab_parallel_nll(logits, labels)
+            # loss lives on the LAST pp stage only (other stages computed
+            # the head on zeros); psum with the mask so grads flow to
+            # exactly one stage's head and the scalar is replicated.
+            is_last = (jax.lax.axis_index(PP_AXIS) == S - 1)
+            total = man.fwd_psum(
+                jnp.sum(nll) * is_last.astype(nll.dtype),
+                (PP_AXIS, DP_AXIS, SHARDING_AXIS, SEP_AXIS))
+            n_tokens = b_l * s_l * dp * shard * sep
+            return total / n_tokens
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        t2 = t + 1
+        tf = t2.astype(jnp.float32)
+
+        def upd(is_emb, p, g, m_leaf, v_leaf):
+            # data-axis grad reduction; emb-family params are replicated
+            # over pp (stage0 embeds, last stage heads) so sum over pp too.
+            # NEVER over mp: Megatron invariant — mp-replicated params get
+            # full grads via mp_copy's bwd psum, mp-sharded ones are local.
+            red = (PP_AXIS, DP_AXIS, SEP_AXIS) if is_emb \
+                else (DP_AXIS, SEP_AXIS)
+            g = jax.lax.psum(g, red)
+            p2, m2, v2 = man.zero_adam_leaf_update(
+                p, g, m_leaf.reshape(-1), v_leaf.reshape(-1), tf,
+                lr=learning_rate, b1=b1, b2=b2, eps=eps)
+            return p2, m2.reshape(m_leaf.shape), v2.reshape(v_leaf.shape)
+
+        new_p = dict(blocks={})
+        new_m = dict(blocks={})
+        new_v = dict(blocks={})
+        for k in EMB_KEYS:
+            new_p[k], new_m[k], new_v[k] = upd(
+                True, params[k], grads[k], m[k], v[k])
+        for k in params["blocks"]:
+            (new_p["blocks"][k], new_m["blocks"][k],
+             new_v["blocks"][k]) = upd(
+                False, params["blocks"][k], grads["blocks"][k],
+                m["blocks"][k], v["blocks"][k])
+        return new_p, new_m, new_v, t2, loss
+
+    shd = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(param_specs, mom_specs, mom_specs, P(), data_spec,
+                  data_spec),
+        out_specs=(param_specs, mom_specs, mom_specs, P(), P()),
+        check_vma=False)
 
     def step(state, ids, labels):
-        params, opt = state["params"], state["opt"]
-        loss, grads = jax.value_and_grad(forward_loss)(params, ids, labels)
-        t = opt["t"] + 1
-        tf = t.astype(jnp.float32)
+        p2, m2, v2, t2, loss = shd(state["params"], state["opt"]["m"],
+                                   state["opt"]["v"], state["opt"]["t"],
+                                   ids, labels)
+        return {"params": p2, "opt": {"m": m2, "v": v2, "t": t2}}, loss
 
-        def upd(p, g, m, v):
-            g32 = g.astype(jnp.float32)
-            m2 = b1 * m + (1 - b1) * g32
-            v2 = b2 * v + (1 - b2) * jnp.square(g32)
-            mh = m2 / (1 - b1 ** tf)
-            vh = v2 / (1 - b2 ** tf)
-            p2 = p.astype(jnp.float32) - learning_rate * mh / (
-                jnp.sqrt(vh) + eps)
-            return p2.astype(p.dtype), m2, v2
-
-        new = jax.tree.map(upd, params, grads, opt["m"], opt["v"])
-        new_params = jax.tree.map(lambda x: x[0], new,
-                                  is_leaf=lambda x: isinstance(x, tuple))
-        new_m = jax.tree.map(lambda x: x[1], new,
-                             is_leaf=lambda x: isinstance(x, tuple))
-        new_v = jax.tree.map(lambda x: x[2], new,
-                             is_leaf=lambda x: isinstance(x, tuple))
-        return ({"params": new_params,
-                 "opt": {"m": new_m, "v": new_v, "t": t}}, loss)
-
-    data_sh = sh(P(data_axes))
-    step_fn = jax.jit(step, donate_argnums=(0,),
-                      in_shardings=(None, data_sh, data_sh),
-                      out_shardings=None)
+    step_fn = jax.jit(step, donate_argnums=(0,))
     return step_fn, init_fn

@@ -1,0 +1,207 @@
+"""Manual-SPMD building blocks for fully-compiled hybrid parallel steps.
+
+Everything here is meant to run INSIDE a ``jax.shard_map`` whose mesh makes
+ALL hybrid axes (pp, dp, sharding, sep, mp) manual.  Round-1 mixed GSPMD
+tensor-parallel sharding with a partial-manual shard_map pipeline, which
+blew up SPMD partitioning / compile time on mp×pp meshes; the cure is to
+express tensor parallelism the Megatron way — local shards + explicit
+collectives — so XLA never has to propagate shardings through the pipeline.
+
+Reference semantics being matched (cited per function):
+* ``mp_copy``   — the Megatron "f" operator ``_c_identity``
+  (/root/reference/python/paddle/distributed/fleet/layers/mpu/mp_ops.py:91):
+  identity forward, all-reduce backward.
+* ``vocab_parallel_embedding`` — masked local lookup + all-reduce
+  (mp_layers.py:47 ``VocabParallelEmbedding`` / ``c_embedding`` op).
+* ``vocab_parallel_nll`` — ``ParallelCrossEntropy`` (mp_layers.py:742,
+  ``c_softmax_with_cross_entropy`` kernel): max/psum over the vocab-sharded
+  logits, never materializing the full softmax.
+* ``zero_adam_leaf_update`` — sharding stage-1/2 semantics
+  (fleet/meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py:44,
+  sharding/group_sharded_stage2.py:46): grads reduce-scattered to the owner
+  shard, optimizer moments stored 1/shard per device, updated params
+  all-gathered — expressed per-leaf on a flattened (padded) vector.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .topology import (DP_AXIS, MP_AXIS, PP_AXIS, SEP_AXIS, SHARDING_AXIS,
+                       HybridTopology)
+
+__all__ = ["mp_copy", "fwd_psum", "vocab_parallel_embedding",
+           "vocab_parallel_nll",
+           "zero_adam_leaf_update", "local_shape", "moment_shape",
+           "MOMENT_SPEC", "tree_map_with_spec"]
+
+# Flat optimizer-moment layout: [pp, mp, shard * chunk] — one fp32 chunk per
+# (pp, mp, sharding) mesh coordinate, replicated over dp/sep.
+MOMENT_SPEC = P(PP_AXIS, MP_AXIS, SHARDING_AXIS)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_copy(x, axis_name: str = MP_AXIS):
+    """Identity forward / psum backward over the tensor-parallel axis.
+
+    Insert before every column-parallel matmul whose input is replicated
+    over mp: each rank's backward contribution through its weight shard is
+    partial, and this operator's VJP all-reduces them (Megatron "f",
+    reference mp_ops.py:91 ``_c_identity``)."""
+    return x
+
+
+def _mp_copy_fwd(x, axis_name):
+    return x, None
+
+
+def _mp_copy_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+mp_copy.defvjp(_mp_copy_fwd, _mp_copy_bwd)
+
+
+def vocab_parallel_embedding(ids, wte_local, axis_name: str = MP_AXIS):
+    """Vocab-parallel embedding lookup (reference mp_layers.py:47).
+
+    ``wte_local``: [vocab/mp, h] local shard; ``ids``: global token ids.
+    Masked local gather + psum over mp.  Returns [..., h].
+    """
+    vpr = wte_local.shape[0]
+    off = lax.axis_index(axis_name) * vpr
+    mask = (ids >= off) & (ids < off + vpr)
+    x = jnp.take(wte_local, jnp.where(mask, ids - off, 0), axis=0)
+    x = jnp.where(mask[..., None], x, jnp.zeros((), x.dtype))
+    return fwd_psum(x, axis_name)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def fwd_psum(x, axis_name):
+    """All-reduce forward / IDENTITY backward (the Megatron "g" operator,
+    reference mp_ops.py:293 ``_mp_allreduce``).
+
+    Use this — not raw ``lax.psum`` — for every forward-path all-reduce
+    that autodiff will flow through inside a ``check_vma=False`` shard_map:
+    there JAX transposes ``psum`` to another ``psum``, which multiplies the
+    (replicated) cotangent by the axis size and silently scales gradients.
+    Each device's summand has unit Jacobian w.r.t. the replicated output,
+    so the correct VJP is the identity."""
+    return lax.psum(x, axis_name)
+
+
+fwd_psum.defvjp(lambda x, a: (lax.psum(x, a), None),
+                lambda a, _, g: (g,))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _pmax_stop(x, axis_name):
+    """pmax with zero gradient (lax.pmax has no differentiation rule;
+    the softmax max-subtraction is a constant shift mathematically)."""
+    return lax.pmax(x, axis_name)
+
+
+_pmax_stop.defvjp(lambda x, a: (lax.pmax(x, a), None),
+                  lambda a, _, g: (jnp.zeros_like(g),))
+
+
+def vocab_parallel_nll(logits_local, labels, axis_name: str = MP_AXIS):
+    """Per-token negative log-likelihood over vocab-sharded logits.
+
+    ``logits_local``: [..., vocab/mp] (fp32 recommended); ``labels``: global
+    ids with the same leading shape.  Equivalent to the reference's
+    ``ParallelCrossEntropy`` (mp_layers.py:742): global max via pmax, global
+    sum-exp and label logit via psum — no full-vocab materialization.
+    """
+    vpr = logits_local.shape[-1]
+    off = lax.axis_index(axis_name) * vpr
+    lmax = _pmax_stop(jnp.max(lax.stop_gradient(logits_local), axis=-1),
+                      axis_name)
+    z = logits_local - lmax[..., None]
+    sumexp = fwd_psum(jnp.sum(jnp.exp(z), axis=-1), axis_name)
+    lse = jnp.log(sumexp)
+    mask = (labels >= off) & (labels < off + vpr)
+    li = jnp.where(mask, labels - off, 0)
+    lab = jnp.take_along_axis(z, li[..., None], axis=-1)[..., 0]
+    lab = fwd_psum(jnp.where(mask, lab, jnp.zeros((), z.dtype)), axis_name)
+    return lse - lab
+
+
+def zero_adam_leaf_update(p, g, m_flat, v_flat, tf, *, lr, b1=0.9, b2=0.95,
+                          eps=1e-8, weight_decay=0.0,
+                          axis_name: str = SHARDING_AXIS):
+    """ZeRO-sharded Adam step for one (local) parameter leaf.
+
+    ``p``/``g``: the device-local shard of the param and its grad (grads
+    must already be reduced over data axes; the sharding-axis reduction
+    happens HERE via psum_scatter).  ``m_flat``/``v_flat``: fp32 moment
+    chunks of size ceil(p.size/shard) — each device owns 1/shard of the
+    optimizer state (stage-1/2 memory behavior,
+    reference group_sharded_stage2.py:46).  Returns (p_new, m_new, v_new).
+    """
+    shard = lax.axis_size(axis_name)
+    shape, n = p.shape, p.size
+    chunk = m_flat.size
+    pad = shard * chunk - n
+    g32 = jnp.pad(g.astype(jnp.float32).reshape(-1), (0, pad))
+    g32 = g32.reshape(shard, chunk)
+    # reduce-scatter: sum over the sharding axis, keep only our chunk
+    g_loc = lax.psum_scatter(g32, axis_name, scatter_dimension=0,
+                             tiled=False)
+    idx = lax.axis_index(axis_name)
+    p32 = jnp.pad(p.astype(jnp.float32).reshape(-1), (0, pad))
+    p_loc = lax.dynamic_index_in_dim(p32.reshape(shard, chunk), idx, 0,
+                                     keepdims=False)
+    m2 = b1 * m_flat + (1 - b1) * g_loc
+    v2 = b2 * v_flat + (1 - b2) * g_loc * g_loc
+    mh = m2 / (1 - b1 ** tf)
+    vh = v2 / (1 - b2 ** tf)
+    upd = mh / (jnp.sqrt(vh) + eps)
+    if weight_decay:
+        upd = upd + weight_decay * p_loc
+    p_loc = p_loc - lr * upd
+    p_new = lax.all_gather(p_loc, axis_name, tiled=False).reshape(-1)
+    p_new = p_new[:n].reshape(shape).astype(p.dtype)
+    return p_new, m2, v2
+
+
+def local_shape(shape: Tuple[int, ...], spec: P,
+                topo: HybridTopology) -> Tuple[int, ...]:
+    """Device-local shape of a global array laid out with ``spec``."""
+    out = list(shape)
+    for i, ax in enumerate(tuple(spec)[:len(out)]):
+        if ax is None:
+            continue
+        for a in (ax if isinstance(ax, tuple) else (ax,)):
+            size = topo.axis_size(a)
+            if out[i] % size != 0:
+                raise ValueError(
+                    f"dim {i} of {shape} not divisible by {a}={size}")
+            out[i] //= size
+    return tuple(out)
+
+
+def moment_shape(param_shape: Tuple[int, ...], spec: P,
+                 topo: HybridTopology) -> Tuple[int, int, int]:
+    """Global shape of the flat ZeRO moment buffer for one param leaf:
+    [pp, mp, shard*chunk] with chunk = ceil(local_numel/shard)."""
+    n = int(np.prod(local_shape(param_shape, spec, topo))) or 1
+    shard = topo.axis_size(SHARDING_AXIS)
+    chunk = -(-n // shard)
+    return (topo.axis_size(PP_AXIS), topo.axis_size(MP_AXIS), shard * chunk)
+
+
+def tree_map_with_spec(fn, tree, specs):
+    """tree_map over a nested dict whose spec tree has PartitionSpec leaves
+    (PartitionSpec is tuple-like, so jax.tree.map can't be trusted here)."""
+    if isinstance(tree, dict):
+        return {k: tree_map_with_spec(fn, tree[k], specs[k]) for k in tree}
+    return fn(tree, specs)
