@@ -328,14 +328,11 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
       step_fn(state, batch_ids, batch_labels) -> (state, loss)
     """
     from ..parallel import manual as man
-    from ..parallel.pipeline import spmd_pipeline
     topo = topo or get_topology()
     mesh = topo.mesh
     S = topo.get_pipe_parallel_world_size()
     mp = topo.get_model_parallel_world_size()
     sep = topo.get_sep_parallel_world_size()
-    dp = topo.get_data_parallel_world_size()
-    shard = topo.get_sharding_parallel_world_size()
     if cfg.num_layers % S != 0:
         raise ValueError(
             f"num_layers {cfg.num_layers} not divisible by pp degree {S}")
@@ -378,17 +375,14 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
     }
     blk_specs = block_param_specs(cfg, pipeline=True)
     param_specs = dict(emb_specs, blocks=blk_specs)
-    mom_specs = man.tree_map_with_spec(lambda _p, _s: man.MOMENT_SPEC,
-                                       param_specs, param_specs)
-    data_spec = P((DP_AXIS, SHARDING_AXIS), SEP_AXIS)
 
     def sh(spec):
         return NamedSharding(mesh, spec)
 
-    def init_fn(seed: int = 0):
+    def init_params_fn(seed: int = 0):
         key = jax.random.key(seed)
         k1, k2, k3 = jax.random.split(key, 3)
-        params = {
+        return {
             "wte": jax.device_put(
                 jax.random.normal(k1, (cfg.vocab_size, cfg.hidden_size),
                                   jnp.dtype(cfg.dtype))
@@ -402,114 +396,28 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
             "blocks": {n: jax.device_put(v, sh(blk_specs[n]))
                        for n, v in stack_block_params(cfg, k3, S).items()},
         }
-        # flat ZeRO moments: one fp32 chunk per (pp, mp, sharding) coord
-        mom_shapes = man.tree_map_with_spec(
-            lambda p, spec: man.moment_shape(p.shape, spec, topo),
-            params, param_specs)
 
-        def zeros_moms():
-            return man.tree_map_with_spec(
-                lambda shp, _: jnp.zeros(shp, jnp.float32), mom_shapes,
-                param_specs)
+    def embed_fn(params, ids):
+        s_l = ids.shape[1]
+        x = man.vocab_parallel_embedding(ids, params["wte"])
+        pos = jax.lax.axis_index(SEP_AXIS) * s_l + jnp.arange(s_l)
+        return x + jnp.take(params["wpe"], pos, axis=0)[None]
 
-        mom_sh = man.tree_map_with_spec(lambda _s, _sp: sh(man.MOMENT_SPEC),
-                                        mom_shapes, param_specs)
-        zinit = jax.jit(zeros_moms, out_shardings=mom_sh)
-        m0, v0 = zinit(), zinit()
-        return {"params": params,
-                "opt": {"m": m0, "v": v0, "t": jnp.zeros((), jnp.int32)}}
+    def block_fn(layer_params, x):
+        return block_apply(layer_params, x, cfg, cp_attn, mp_axis=MP_AXIS)
 
-    b1, b2, eps = 0.9, 0.95, 1e-8
-    EMB_KEYS = ("wte", "wpe", "lnf_w", "lnf_b")
+    def head_nll_fn(params, x, labels):
+        mean = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        x = (x - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps) \
+            * params["lnf_w"] + params["lnf_b"]
+        xf = man.mp_copy(x, MP_AXIS)   # tied head: column-parallel matmul
+        logits = jnp.einsum("bsh,vh->bsv", xf, params["wte"],
+                            preferred_element_type=jnp.float32)
+        return man.vocab_parallel_nll(logits, labels)
 
-    def local_step(params, m, v, t, ids, labels):
-        """Runs per-device inside shard_map; all arrays are local shards."""
-        b_l, s_l = ids.shape
-
-        def loss_fn(params):
-            x = man.vocab_parallel_embedding(ids, params["wte"])
-            pos = jax.lax.axis_index(SEP_AXIS) * s_l + jnp.arange(s_l)
-            x = x + jnp.take(params["wpe"], pos, axis=0)[None]
-            blk = {k: val[0] for k, val in params["blocks"].items()}
-
-            def body(carry, layer_params):
-                return block_apply(layer_params, carry, cfg, cp_attn,
-                                   mp_axis=MP_AXIS), None
-
-            if S > 1:
-                M = num_microbatches
-                mbs = x.reshape(M, b_l // M, s_l, cfg.hidden_size)
-
-                def stage_fn(blk_local, hcarry):
-                    out, _ = jax.lax.scan(body, hcarry, blk_local)
-                    return out
-
-                outs = spmd_pipeline(stage_fn, blk, mbs, S, remat=remat)
-                x = outs.reshape(b_l, s_l, cfg.hidden_size)
-            else:
-                sbody = jax.checkpoint(body) if remat else body
-                x, _ = jax.lax.scan(sbody, x, blk)
-
-            mean = jnp.mean(x, -1, keepdims=True)
-            var = jnp.var(x, -1, keepdims=True)
-            x = (x - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps) \
-                * params["lnf_w"] + params["lnf_b"]
-            xf = man.mp_copy(x, MP_AXIS)
-            logits = jnp.einsum("bsh,vh->bsv", xf, params["wte"],
-                                preferred_element_type=jnp.float32)
-            nll = man.vocab_parallel_nll(logits, labels)
-            # loss lives on the LAST pp stage only (other stages computed
-            # the head on zeros); psum with the mask so grads flow to
-            # exactly one stage's head and the scalar is replicated.
-            is_last = (jax.lax.axis_index(PP_AXIS) == S - 1)
-            total = man.fwd_psum(
-                jnp.sum(nll) * is_last.astype(nll.dtype),
-                (PP_AXIS, DP_AXIS, SHARDING_AXIS, SEP_AXIS))
-            n_tokens = b_l * s_l * dp * shard * sep
-            return total / n_tokens
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        t2 = t + 1
-        tf = t2.astype(jnp.float32)
-
-        def upd(is_emb, p, g, m_leaf, v_leaf):
-            # data-axis grad reduction; emb-family params are replicated
-            # over pp (stage0 embeds, last stage heads) so sum over pp too.
-            # NEVER over mp: Megatron invariant — mp-replicated params get
-            # full grads via mp_copy's bwd psum, mp-sharded ones are local.
-            red = (PP_AXIS, DP_AXIS, SEP_AXIS) if is_emb \
-                else (DP_AXIS, SEP_AXIS)
-            g = jax.lax.psum(g, red)
-            p2, m2, v2 = man.zero_adam_leaf_update(
-                p, g, m_leaf.reshape(-1), v_leaf.reshape(-1), tf,
-                lr=learning_rate, b1=b1, b2=b2, eps=eps)
-            return p2, m2.reshape(m_leaf.shape), v2.reshape(v_leaf.shape)
-
-        new_p = dict(blocks={})
-        new_m = dict(blocks={})
-        new_v = dict(blocks={})
-        for k in EMB_KEYS:
-            new_p[k], new_m[k], new_v[k] = upd(
-                True, params[k], grads[k], m[k], v[k])
-        for k in params["blocks"]:
-            (new_p["blocks"][k], new_m["blocks"][k],
-             new_v["blocks"][k]) = upd(
-                False, params["blocks"][k], grads["blocks"][k],
-                m["blocks"][k], v["blocks"][k])
-        return new_p, new_m, new_v, t2, loss
-
-    shd = jax.shard_map(
-        local_step, mesh=mesh,
-        in_specs=(param_specs, mom_specs, mom_specs, P(), data_spec,
-                  data_spec),
-        out_specs=(param_specs, mom_specs, mom_specs, P(), P()),
-        check_vma=False)
-
-    def step(state, ids, labels):
-        p2, m2, v2, t2, loss = shd(state["params"], state["opt"]["m"],
-                                   state["opt"]["v"], state["opt"]["t"],
-                                   ids, labels)
-        return {"params": p2, "opt": {"m": m2, "v": v2, "t": t2}}, loss
-
-    step_fn = jax.jit(step, donate_argnums=(0,))
-    return step_fn, init_fn
+    return man.build_hybrid_train_step(
+        topo=topo, param_specs=param_specs, init_params_fn=init_params_fn,
+        embed_fn=embed_fn, block_fn=block_fn, head_nll_fn=head_nll_fn,
+        num_microbatches=num_microbatches, learning_rate=learning_rate,
+        remat=remat)

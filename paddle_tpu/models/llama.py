@@ -304,33 +304,47 @@ def block_param_specs(cfg: LlamaConfig, pipeline: bool) -> Dict[str, P]:
 
 
 def block_apply(params: Dict[str, jax.Array], x: jax.Array,
-                cfg: LlamaConfig, cos, sin, attn_fn=None) -> jax.Array:
-    """One Llama block, pure jnp (stacked under lax.scan)."""
+                cfg: LlamaConfig, cos, sin, attn_fn=None,
+                mp_axis: Optional[str] = None) -> jax.Array:
+    """One Llama block, pure jnp (stacked under lax.scan).
+
+    ``mp_axis``: Megatron-style manual tensor parallelism — params are the
+    LOCAL shards (q/k/v/gate/up column-split, o/down row-split), head
+    counts derived from the local shard shapes; ``mp_copy`` before column
+    matmuls, ``fwd_psum`` after row matmuls (see parallel/manual.py)."""
     b, s, h = x.shape
 
     def rms(v, w):
         ms = jnp.mean(jnp.square(v.astype(jnp.float32)), -1, keepdims=True)
         return (v * jax.lax.rsqrt(ms + cfg.rms_norm_eps)).astype(v.dtype) * w
 
+    if mp_axis is not None:
+        from ..parallel.manual import fwd_psum, mp_copy
+        col_in = lambda y: mp_copy(y, mp_axis)
+        row_out = lambda z: fwd_psum(z, mp_axis)
+    else:
+        col_in = row_out = lambda y: y
+
     res = x
-    y = rms(x, params["ln1_w"])
-    q = (y @ params["q_w"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
-    k = (y @ params["k_w"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
-    v = (y @ params["v_w"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+    y = col_in(rms(x, params["ln1_w"]))
+    q = (y @ params["q_w"]).reshape(b, s, -1, cfg.head_dim)
+    k = (y @ params["k_w"]).reshape(b, s, -1, cfg.head_dim)
+    v = (y @ params["v_w"]).reshape(b, s, -1, cfg.head_dim)
     q, k = apply_rope(q, k, cos, sin)
     if attn_fn is not None:
-        if cfg.kv_heads != cfg.num_heads:
-            rep = cfg.num_heads // cfg.kv_heads
+        if k.shape[2] != q.shape[2]:
+            rep = q.shape[2] // k.shape[2]
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
         attn = attn_fn(q, k, v)
     else:
         attn = _gqa_attention(q, k, v, causal=True)
-    x = res + attn.reshape(b, s, cfg.num_heads * cfg.head_dim) @ params["o_w"]
+    attn = attn.reshape(b, s, attn.shape[2] * attn.shape[3])
+    x = res + row_out(attn @ params["o_w"])
     res = x
-    y = rms(x, params["ln2_w"])
+    y = col_in(rms(x, params["ln2_w"]))
     y = jax.nn.silu(y @ params["gate_w"]) * (y @ params["up_w"])
-    return res + y @ params["down_w"]
+    return res + row_out(y @ params["down_w"])
 
 
 def stack_block_params(cfg: LlamaConfig, key, num_stages: int
@@ -346,25 +360,42 @@ def stack_block_params(cfg: LlamaConfig, key, num_stages: int
 def build_llama_train_step(cfg: LlamaConfig, topo=None,
                            num_microbatches: int = 4,
                            learning_rate: float = 1e-4,
-                           cp_mode: str = None):
-    """Compiled hybrid dp×mp×pp×sp Llama train step (mirrors
-    models/gpt.py:build_gpt_train_step; see that docstring).
+                           cp_mode: str = None,
+                           use_flash: Optional[bool] = None,
+                           remat: bool = True):
+    """Compiled hybrid dp×mp×pp×sharding×sep Llama train step.
+
+    Fully-manual SPMD via parallel/manual.py:build_hybrid_train_step
+    (same design as models/gpt.py:build_gpt_train_step — Megatron-style
+    mp collectives, scan pipeline over pp, ring/Ulysses over sep, ZeRO
+    stage-2 Adam over sharding).  Untied vocab-parallel head
+    (column-split) + parallel cross-entropy.
 
     Returns (step_fn, init_fn)."""
-    from ..parallel.pipeline import spmd_pipeline
+    from ..parallel import manual as man
     topo = topo or get_topology()
-    S = topo.get_pipe_parallel_world_size()
     mesh = topo.mesh
+    S = topo.get_pipe_parallel_world_size()
+    mp = topo.get_model_parallel_world_size()
+    sep = topo.get_sep_parallel_world_size()
     if cfg.num_layers % S != 0:
         raise ValueError(
             f"num_layers {cfg.num_layers} not divisible by pp degree {S}")
-    data_axes = tuple(a for a in (DP_AXIS, SHARDING_AXIS)
-                      if topo.axis_size(a) > 1) or (DP_AXIS,)
-    sep = topo.get_sep_parallel_world_size()
+    if mp > 1:
+        for name, val in (("vocab_size", cfg.vocab_size),
+                          ("num_heads", cfg.num_heads),
+                          ("kv_heads", cfg.kv_heads),
+                          ("intermediate_size", cfg.intermediate_size)):
+            if val % mp != 0:
+                raise ValueError(f"{name}={val} not divisible by mp={mp}")
     if cp_mode not in (None, "ring", "ulysses"):
         raise ValueError(f"unknown cp_mode {cp_mode!r}")
-    use_cp = cp_mode is not None and sep > 1
-    if use_cp:
+    if sep > 1 and cp_mode is None:
+        cp_mode = "ring"
+    if cp_mode == "ulysses" and (cfg.num_heads // mp) % sep != 0:
+        raise ValueError("ulysses needs (num_heads/mp) % sep == 0")
+
+    if sep > 1:
         from ..parallel.context_parallel import (
             ring_flash_attention, ulysses_attention)
         if cp_mode == "ring":
@@ -374,150 +405,66 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
             def cp_attn(q, k, v):
                 return ulysses_attention(q, k, v, SEP_AXIS, True)
     else:
-        cp_attn = None
+        if use_flash is None:
+            use_flash = jax.default_backend() not in ("cpu",)
+        if use_flash:
+            import functools
+            from ..ops.pallas.flash_attention import flash_attention
+            cp_attn = functools.partial(flash_attention, causal=True)
+        else:
+            cp_attn = None
+
+    blk_specs = block_param_specs(cfg, pipeline=True)
+    param_specs = {"wte": P(MP_AXIS, None), "head": P(None, MP_AXIS),
+                   "lnf_w": P(), "blocks": blk_specs}
 
     def sh(spec):
         return NamedSharding(mesh, spec)
 
-    blk_specs = block_param_specs(cfg, pipeline=True)
-
-    def init_fn(seed: int = 0):
+    def init_params_fn(seed: int = 0):
         key = jax.random.key(seed)
         k1, k2, k3 = jax.random.split(key, 3)
         dt = jnp.dtype(cfg.dtype)
-        params = {
+        return {
             "wte": jax.device_put(
                 jax.random.normal(k1, (cfg.vocab_size, cfg.hidden_size), dt)
-                * cfg.initializer_range, sh(P(MP_AXIS, None))),
+                * cfg.initializer_range, sh(param_specs["wte"])),
             "head": jax.device_put(
                 jax.random.normal(k2, (cfg.hidden_size, cfg.vocab_size), dt)
-                * cfg.initializer_range, sh(P(None, MP_AXIS))),
+                * cfg.initializer_range, sh(param_specs["head"])),
             "lnf_w": jax.device_put(jnp.ones(cfg.hidden_size, dt), sh(P())),
             "blocks": {n: jax.device_put(v, sh(blk_specs[n]))
                        for n, v in stack_block_params(cfg, k3, S).items()},
         }
-        opt = {
-            "m": jax.tree.map(lambda v: jnp.zeros_like(v, jnp.float32),
-                              params),
-            "v": jax.tree.map(lambda v: jnp.zeros_like(v, jnp.float32),
-                              params),
-            "t": jnp.zeros((), jnp.int32),
-        }
-        return {"params": params, "opt": opt}
 
-    def forward_loss(params, ids, labels):
-        b, s = ids.shape
-        cos, sin = _rope_cos_sin(s, cfg.head_dim, cfg.rope_theta,
+    def embed_fn(params, ids):
+        return man.vocab_parallel_embedding(ids, params["wte"])
+
+    def local_rope(s_l):
+        # global positions for this sep shard: [sidx*s_l, (sidx+1)*s_l)
+        cos, sin = _rope_cos_sin(s_l * sep, cfg.head_dim, cfg.rope_theta,
                                  jnp.dtype(cfg.dtype))
-        x = jnp.take(params["wte"], ids, axis=0)
-        x = jax.lax.with_sharding_constraint(
-            x, sh(P(data_axes, SEP_AXIS, None)))
+        sidx = jax.lax.axis_index(SEP_AXIS)
+        lcos = jax.lax.dynamic_slice_in_dim(cos, sidx * s_l, s_l, 0)
+        lsin = jax.lax.dynamic_slice_in_dim(sin, sidx * s_l, s_l, 0)
+        return lcos, lsin
 
-        if S > 1:
-            M = num_microbatches
-            mbs = x.reshape(M, b // M, s, cfg.hidden_size)
+    def block_fn(layer_params, x):
+        lcos, lsin = local_rope(x.shape[1])
+        return block_apply(layer_params, x, cfg, lcos, lsin, cp_attn,
+                           mp_axis=MP_AXIS)
 
-            def stage_fn(blk_local, h):
-                local = jax.tree.map(lambda v: v[0], blk_local)
-                if use_cp:
-                    # seq dim is sep-sharded inside this shard_map: each rank
-                    # sees chunk [sidx*chunk, (sidx+1)*chunk) of positions
-                    sidx = jax.lax.axis_index(SEP_AXIS)
-                    chunk = h.shape[1]
-                    lcos = jax.lax.dynamic_slice_in_dim(
-                        cos, sidx * chunk, chunk, 0)
-                    lsin = jax.lax.dynamic_slice_in_dim(
-                        sin, sidx * chunk, chunk, 0)
-                else:
-                    lcos, lsin = cos, sin
-
-                def body(carry, layer_params):
-                    return block_apply(layer_params, carry, cfg, lcos, lsin,
-                                       cp_attn), None
-                out, _ = jax.lax.scan(body, h, local)
-                return out
-
-            def pp_inner(blk_local, mb_local):
-                outs = spmd_pipeline(stage_fn, blk_local, mb_local, S,
-                                     remat=True)
-                is_last = (jax.lax.axis_index(PP_AXIS) == S - 1)
-                return jax.lax.psum(
-                    outs * is_last.astype(outs.dtype), PP_AXIS)
-
-            blk_in_specs = jax.tree.map(lambda _: P(PP_AXIS),
-                                        params["blocks"])
-            mb_spec = P(None, None, SEP_AXIS, None) if use_cp else P(None)
-            axis_names = {PP_AXIS, SEP_AXIS} if use_cp else {PP_AXIS}
-            x = jax.shard_map(
-                pp_inner, mesh=mesh,
-                in_specs=(blk_in_specs, mb_spec),
-                out_specs=mb_spec, axis_names=axis_names,
-                check_vma=False)(params["blocks"], mbs)
-            x = x.reshape(b, s, cfg.hidden_size)
-        else:
-            flat_blocks = jax.tree.map(
-                lambda v: v.reshape((cfg.num_layers,) + v.shape[2:]),
-                params["blocks"])
-            if use_cp:
-                def blocks_inner(blk, x_local):
-                    sidx = jax.lax.axis_index(SEP_AXIS)
-                    chunk = x_local.shape[1]
-                    lcos = jax.lax.dynamic_slice_in_dim(
-                        cos, sidx * chunk, chunk, 0)
-                    lsin = jax.lax.dynamic_slice_in_dim(
-                        sin, sidx * chunk, chunk, 0)
-
-                    def body(carry, layer_params):
-                        return block_apply(layer_params, carry, cfg,
-                                           lcos, lsin, cp_attn), None
-                    out, _ = jax.lax.scan(body, x_local, blk)
-                    return out
-                blk_specs_in = jax.tree.map(lambda _: P(), flat_blocks)
-                x = jax.shard_map(
-                    blocks_inner, mesh=mesh,
-                    in_specs=(blk_specs_in, P(None, SEP_AXIS, None)),
-                    out_specs=P(None, SEP_AXIS, None),
-                    axis_names={SEP_AXIS}, check_vma=False)(flat_blocks, x)
-            else:
-                def body(carry, layer_params):
-                    return block_apply(layer_params, carry, cfg, cos,
-                                       sin), None
-                x, _ = jax.lax.scan(body, x, flat_blocks)
-
+    def head_nll_fn(params, x, labels):
         ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
         x = (x * jax.lax.rsqrt(ms + cfg.rms_norm_eps)).astype(x.dtype) \
             * params["lnf_w"]
-        logits = (x @ params["head"]).astype(jnp.float32)
-        lp = jax.nn.log_softmax(logits, -1)
-        nll = -jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
-        return jnp.mean(nll)
+        xf = man.mp_copy(x, MP_AXIS)   # column-parallel head
+        logits = jnp.einsum("bsh,hv->bsv", xf, params["head"],
+                            preferred_element_type=jnp.float32)
+        return man.vocab_parallel_nll(logits, labels)
 
-    b1, b2, eps = 0.9, 0.95, 1e-8
-
-    def step(state, ids, labels):
-        params, opt = state["params"], state["opt"]
-        loss, grads = jax.value_and_grad(forward_loss)(params, ids, labels)
-        t = opt["t"] + 1
-        tf = t.astype(jnp.float32)
-
-        def upd(p, g, m, v):
-            g32 = g.astype(jnp.float32)
-            m2 = b1 * m + (1 - b1) * g32
-            v2 = b2 * v + (1 - b2) * jnp.square(g32)
-            mh = m2 / (1 - b1 ** tf)
-            vh = v2 / (1 - b2 ** tf)
-            p2 = p.astype(jnp.float32) - learning_rate * mh / (
-                jnp.sqrt(vh) + eps)
-            return p2.astype(p.dtype), m2, v2
-
-        new = jax.tree.map(upd, params, grads, opt["m"], opt["v"])
-        pick = lambda i: jax.tree.map(
-            lambda x: x[i], new, is_leaf=lambda x: isinstance(x, tuple))
-        return ({"params": pick(0), "opt": {"m": pick(1), "v": pick(2),
-                                            "t": t}}, loss)
-
-    data_sh = sh(P(data_axes))
-    step_fn = jax.jit(step, donate_argnums=(0,),
-                      in_shardings=(None, data_sh, data_sh),
-                      out_shardings=None)
-    return step_fn, init_fn
+    return man.build_hybrid_train_step(
+        topo=topo, param_specs=param_specs, init_params_fn=init_params_fn,
+        embed_fn=embed_fn, block_fn=block_fn, head_nll_fn=head_nll_fn,
+        num_microbatches=num_microbatches, learning_rate=learning_rate,
+        remat=remat)

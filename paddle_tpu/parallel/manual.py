@@ -173,6 +173,154 @@ def zero_adam_leaf_update(p, g, m_flat, v_flat, tf, *, lr, b1=0.9, b2=0.95,
     return p_new, m2, v2
 
 
+def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
+                            init_params_fn, embed_fn, block_fn, head_nll_fn,
+                            num_microbatches: int = 1,
+                            learning_rate: float = 1e-4,
+                            adam_betas=(0.9, 0.95), adam_eps: float = 1e-8,
+                            weight_decay: float = 0.0, remat: bool = True):
+    """Generic fully-manual hybrid dp×mp×pp×sharding×sep train step.
+
+    The caller provides the model as three per-device closures (all called
+    INSIDE the all-axes-manual shard_map, so they may use mp/sep
+    collectives from this module):
+
+    * ``init_params_fn(seed) -> params`` — global arrays placed per
+      ``param_specs``; structure must be ``{"blocks": {...stacked
+      [pp, per, ...] leaves...}, <other leaves replicated over pp>}``.
+    * ``embed_fn(params_local, ids_local) -> x [b_l, s_l, h]``
+    * ``block_fn(layer_params_local, x) -> x`` — one transformer block
+      (tensor-parallel via mp_copy/fwd_psum, cp attention inside).
+    * ``head_nll_fn(params_local, x, labels_local) -> nll [b_l, s_l]``
+
+    The step runs the block stack through the scan pipeline over ``pp``
+    (parallel/pipeline.py), reduces the masked last-stage loss over
+    (pp, dp, sharding, sep), reduces grads over the data axes (plus pp for
+    the non-block leaves, never mp — Megatron invariant), and applies
+    ZeRO stage-2 Adam over the ``sharding`` axis
+    (:func:`zero_adam_leaf_update`).
+
+    Returns ``(step_fn, init_fn)`` with
+    ``step_fn(state, ids, labels) -> (state, loss)``.
+    """
+    import jax.numpy as _jnp
+    from jax.sharding import NamedSharding
+    from .pipeline import spmd_pipeline
+
+    mesh = topo.mesh
+    S = topo.axis_size(PP_AXIS)
+    dp = topo.axis_size(DP_AXIS)
+    shard = topo.axis_size(SHARDING_AXIS)
+    sep = topo.axis_size(SEP_AXIS)
+    b1, b2 = adam_betas
+    mom_specs = tree_map_with_spec(lambda _p, _s: MOMENT_SPEC,
+                                   param_specs, param_specs)
+    data_spec = P((DP_AXIS, SHARDING_AXIS), SEP_AXIS)
+
+    def sh(spec):
+        return NamedSharding(mesh, spec)
+
+    def init_fn(seed: int = 0):
+        params = init_params_fn(seed)
+        mom_shapes = tree_map_with_spec(
+            lambda p, spec: moment_shape(p.shape, spec, topo),
+            params, param_specs)
+        zinit = jax.jit(
+            lambda: tree_map_with_spec(
+                lambda shp, _: _jnp.zeros(shp, _jnp.float32),
+                mom_shapes, param_specs),
+            out_shardings=tree_map_with_spec(
+                lambda _s, _sp: sh(MOMENT_SPEC), mom_shapes, param_specs))
+        m0, v0 = zinit(), zinit()
+        return {"params": params,
+                "opt": {"m": m0, "v": v0,
+                        "t": _jnp.zeros((), _jnp.int32)}}
+
+    def local_step(params, m, v, t, ids, labels):
+        b_l, s_l = ids.shape
+
+        def loss_fn(params):
+            x = embed_fn(params, ids)
+            hdim = x.shape[-1]
+            blk = {k: val[0] for k, val in params["blocks"].items()}
+
+            def body(carry, layer_params):
+                return block_fn(layer_params, carry), None
+
+            if S > 1:
+                M = num_microbatches
+                mbs = x.reshape(M, b_l // M, s_l, hdim)
+
+                def stage_fn(blk_local, hcarry):
+                    out, _ = lax.scan(body, hcarry, blk_local)
+                    return out
+
+                outs = spmd_pipeline(stage_fn, blk, mbs, S, remat=remat)
+                x = outs.reshape(b_l, s_l, hdim)
+            else:
+                sbody = jax.checkpoint(body) if remat else body
+                x, _ = lax.scan(sbody, x, blk)
+
+            nll = head_nll_fn(params, x, labels)
+            # loss lives on the LAST pp stage only (other stages computed
+            # the head on zeros); psum with the mask so grads flow to
+            # exactly one stage's head and the scalar is replicated.
+            is_last = (lax.axis_index(PP_AXIS) == S - 1)
+            total = fwd_psum(
+                jnp.sum(nll) * is_last.astype(nll.dtype),
+                (PP_AXIS, DP_AXIS, SHARDING_AXIS, SEP_AXIS))
+            return total / (b_l * s_l * dp * shard * sep)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        t2 = t + 1
+        tf = t2.astype(_jnp.float32)
+
+        def upd(is_blocks, p, g, m_leaf, v_leaf):
+            # data-axis grad reduction; non-block leaves are replicated
+            # over pp (stage0 embeds, last stage heads) so sum over pp
+            # too.  NEVER over mp: mp-replicated params get full grads
+            # via mp_copy's bwd psum, mp-sharded ones are local.
+            red = (DP_AXIS, SEP_AXIS) if is_blocks \
+                else (PP_AXIS, DP_AXIS, SEP_AXIS)
+            g = lax.psum(g, red)
+            p2, m2, v2 = zero_adam_leaf_update(
+                p, g, m_leaf.reshape(-1), v_leaf.reshape(-1), tf,
+                lr=learning_rate, b1=b1, b2=b2, eps=adam_eps,
+                weight_decay=weight_decay)
+            return p2, m2.reshape(m_leaf.shape), v2.reshape(v_leaf.shape)
+
+        new_p = dict(blocks={})
+        new_m = dict(blocks={})
+        new_v = dict(blocks={})
+        for k in params:
+            if k == "blocks":
+                continue
+            new_p[k], new_m[k], new_v[k] = upd(
+                False, params[k], grads[k], m[k], v[k])
+        for k in params["blocks"]:
+            (new_p["blocks"][k], new_m["blocks"][k],
+             new_v["blocks"][k]) = upd(
+                True, params["blocks"][k], grads["blocks"][k],
+                m["blocks"][k], v["blocks"][k])
+        return new_p, new_m, new_v, t2, loss
+
+    shd = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(param_specs, mom_specs, mom_specs, P(), data_spec,
+                  data_spec),
+        out_specs=(param_specs, mom_specs, mom_specs, P(), P()),
+        check_vma=False)
+
+    def step(state, ids, labels):
+        p2, m2, v2, t2, loss = shd(state["params"], state["opt"]["m"],
+                                   state["opt"]["v"], state["opt"]["t"],
+                                   ids, labels)
+        return {"params": p2, "opt": {"m": m2, "v": v2, "t": t2}}, loss
+
+    step_fn = jax.jit(step, donate_argnums=(0,))
+    return step_fn, init_fn
+
+
 def local_shape(shape: Tuple[int, ...], spec: P,
                 topo: HybridTopology) -> Tuple[int, ...]:
     """Device-local shape of a global array laid out with ``spec``."""

@@ -71,6 +71,35 @@ def test_hybrid_matches_single_device(axes):
     np.testing.assert_allclose(got, _base(), rtol=2e-4, atol=1e-5)
 
 
+def _llama_losses(steps=3, **axes):
+    from paddle_tpu.models.llama import llama_tiny, build_llama_train_step
+    topo = dist.init_topology(**axes)
+    cfg = llama_tiny()
+    mb = 2 if axes.get("pp", 1) > 1 else 1
+    step_fn, init_fn = build_llama_train_step(cfg, topo,
+                                              num_microbatches=mb)
+    state = init_fn(0)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (4, 32)).astype(np.int64)
+    labels = np.roll(ids, -1, axis=1)
+    out = []
+    for _ in range(steps):
+        state, loss = step_fn(state, ids, labels)
+        out.append(float(np.asarray(jax.device_get(loss))))
+    return out
+
+
+@pytest.mark.parametrize("axes", [
+    dict(mp=2, pp=2, sep=2),
+    dict(mp=2, pp=2, sharding=2),
+])
+def test_llama_hybrid_matches_single_device(axes):
+    base = _llama_losses()
+    got = _llama_losses(**axes)
+    np.testing.assert_allclose(got, base, rtol=2e-4, atol=1e-5)
+    assert base[-1] < base[0]
+
+
 def test_mp2_sharding4_moments_are_sharded():
     """ZeRO stage-1/2: optimizer moments are stored 1/shard per device
     (flat chunk layout over the sharding axis)."""
