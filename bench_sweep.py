@@ -1,0 +1,48 @@
+"""Scratch perf sweep on the real chip (not committed as part of bench)."""
+import sys
+import time
+
+import numpy as np
+
+
+def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1):
+    import jax
+    from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
+    from paddle_tpu import parallel as dist
+
+    cfg = GPTConfig(vocab_size=V, hidden_size=h, num_layers=L,
+                    num_heads=h // 64, max_position_embeddings=seq,
+                    dtype="bfloat16")
+    topo = dist.init_topology(devices=jax.devices()[:1])
+    step_fn, init_fn = build_gpt_train_step(cfg, topo, num_microbatches=mbs,
+                                            remat=remat)
+    state = init_fn(0)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1)
+    state, loss = step_fn(state, ids, labels)
+    jax.device_get(loss)
+    state, loss = step_fn(state, ids, labels)
+    jax.device_get(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step_fn(state, ids, labels)
+    lv = float(np.asarray(jax.device_get(loss)))
+    dt = time.perf_counter() - t0
+    tps = batch * seq * steps / dt
+    f = 4 * h
+    n_params = V * h + seq * h + L * (4 * h * h + 2 * h * f + 9 * h) + 2 * h
+    fpt = 6 * n_params + 12 * L * h * seq
+    from bench import peak_flops_per_chip
+    mfu = tps * fpt / peak_flops_per_chip(jax.devices()[0])
+    print(f"batch={batch} seq={seq} remat={remat} h={h} L={L}: "
+          f"{tps:,.0f} tok/s  MFU={mfu:.3f}  loss={lv:.3f}", flush=True)
+
+
+if __name__ == "__main__":
+    import ast
+    for args in ast.literal_eval(sys.argv[1]):
+        try:
+            run(**args)
+        except Exception as e:
+            print(f"{args}: FAILED {type(e).__name__}: {e}", flush=True)

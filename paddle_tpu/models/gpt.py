@@ -403,7 +403,8 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
         pos = jax.lax.axis_index(SEP_AXIS) * s_l + jnp.arange(s_l)
         return x + jnp.take(params["wpe"], pos, axis=0)[None]
 
-    def block_fn(layer_params, x):
+    def block_fn(layer_params, x, ctx):
+        del ctx
         return block_apply(layer_params, x, cfg, cp_attn, mp_axis=MP_AXIS)
 
     def head_nll_fn(params, x, labels):

@@ -440,8 +440,10 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
     def embed_fn(params, ids):
         return man.vocab_parallel_embedding(ids, params["wte"])
 
-    def local_rope(s_l):
-        # global positions for this sep shard: [sidx*s_l, (sidx+1)*s_l)
+    def step_ctx_fn(s_l):
+        # rope table for this sep shard's global positions
+        # [sidx*s_l, (sidx+1)*s_l) — computed once per step, hoisted out of
+        # the per-layer scan (and out of the remat backward) via step_ctx.
         cos, sin = _rope_cos_sin(s_l * sep, cfg.head_dim, cfg.rope_theta,
                                  jnp.dtype(cfg.dtype))
         sidx = jax.lax.axis_index(SEP_AXIS)
@@ -449,8 +451,8 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
         lsin = jax.lax.dynamic_slice_in_dim(sin, sidx * s_l, s_l, 0)
         return lcos, lsin
 
-    def block_fn(layer_params, x):
-        lcos, lsin = local_rope(x.shape[1])
+    def block_fn(layer_params, x, ctx):
+        lcos, lsin = ctx
         return block_apply(layer_params, x, cfg, lcos, lsin, cp_attn,
                            mp_axis=MP_AXIS)
 
@@ -466,5 +468,6 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
     return man.build_hybrid_train_step(
         topo=topo, param_specs=param_specs, init_params_fn=init_params_fn,
         embed_fn=embed_fn, block_fn=block_fn, head_nll_fn=head_nll_fn,
+        step_ctx_fn=step_ctx_fn,
         num_microbatches=num_microbatches, learning_rate=learning_rate,
         remat=remat)

@@ -175,6 +175,7 @@ def zero_adam_leaf_update(p, g, m_flat, v_flat, tf, *, lr, b1=0.9, b2=0.95,
 
 def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                             init_params_fn, embed_fn, block_fn, head_nll_fn,
+                            step_ctx_fn=None,
                             num_microbatches: int = 1,
                             learning_rate: float = 1e-4,
                             adam_betas=(0.9, 0.95), adam_eps: float = 1e-8,
@@ -189,9 +190,12 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
       ``param_specs``; structure must be ``{"blocks": {...stacked
       [pp, per, ...] leaves...}, <other leaves replicated over pp>}``.
     * ``embed_fn(params_local, ids_local) -> x [b_l, s_l, h]``
-    * ``block_fn(layer_params_local, x) -> x`` — one transformer block
+    * ``block_fn(layer_params_local, x, ctx) -> x`` — one transformer block
       (tensor-parallel via mp_copy/fwd_psum, cp attention inside).
     * ``head_nll_fn(params_local, x, labels_local) -> nll [b_l, s_l]``
+    * ``step_ctx_fn(s_l) -> ctx`` (optional) — per-step loop invariants
+      (e.g. rope cos/sin tables) computed ONCE outside the layer scan and
+      passed to every ``block_fn`` call; ``ctx`` is None when omitted.
 
     The step runs the block stack through the scan pipeline over ``pp``
     (parallel/pipeline.py), reduces the masked last-stage loss over
@@ -243,9 +247,10 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
             x = embed_fn(params, ids)
             hdim = x.shape[-1]
             blk = {k: val[0] for k, val in params["blocks"].items()}
+            ctx = step_ctx_fn(s_l) if step_ctx_fn is not None else None
 
             def body(carry, layer_params):
-                return block_fn(layer_params, carry), None
+                return block_fn(layer_params, carry, ctx), None
 
             if S > 1:
                 M = num_microbatches

@@ -89,12 +89,22 @@ def _llama_losses(steps=3, **axes):
     return out
 
 
+LLAMA_BASE = None
+
+
+def _llama_base():
+    global LLAMA_BASE
+    if LLAMA_BASE is None:
+        LLAMA_BASE = _llama_losses()
+    return LLAMA_BASE
+
+
 @pytest.mark.parametrize("axes", [
     dict(mp=2, pp=2, sep=2),
     dict(mp=2, pp=2, sharding=2),
 ])
 def test_llama_hybrid_matches_single_device(axes):
-    base = _llama_losses()
+    base = _llama_base()
     got = _llama_losses(**axes)
     np.testing.assert_allclose(got, base, rtol=2e-4, atol=1e-5)
     assert base[-1] < base[0]
