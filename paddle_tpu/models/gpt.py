@@ -304,7 +304,8 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                          learning_rate: float = 1e-4,
                          cp_mode: str = None,
                          use_flash: Optional[bool] = None,
-                         remat: bool = True):
+                         remat: bool = True,
+                         schedule: str = "1f1b"):
     """Compile a full hybrid-parallel GPT training step: dp×mp×pp×sharding×sep.
 
     Fully-MANUAL SPMD: one ``shard_map`` over ALL five mesh axes.  Tensor
@@ -421,4 +422,4 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
         topo=topo, param_specs=param_specs, init_params_fn=init_params_fn,
         embed_fn=embed_fn, block_fn=block_fn, head_nll_fn=head_nll_fn,
         num_microbatches=num_microbatches, learning_rate=learning_rate,
-        remat=remat)
+        remat=remat, schedule=schedule)

@@ -362,7 +362,8 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
                            learning_rate: float = 1e-4,
                            cp_mode: str = None,
                            use_flash: Optional[bool] = None,
-                           remat: bool = True):
+                           remat: bool = True,
+                           schedule: str = "1f1b"):
     """Compiled hybrid dp×mp×pp×sharding×sep Llama train step.
 
     Fully-manual SPMD via parallel/manual.py:build_hybrid_train_step
@@ -470,4 +471,4 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
         embed_fn=embed_fn, block_fn=block_fn, head_nll_fn=head_nll_fn,
         step_ctx_fn=step_ctx_fn,
         num_microbatches=num_microbatches, learning_rate=learning_rate,
-        remat=remat)
+        remat=remat, schedule=schedule)

@@ -179,7 +179,8 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                             num_microbatches: int = 1,
                             learning_rate: float = 1e-4,
                             adam_betas=(0.9, 0.95), adam_eps: float = 1e-8,
-                            weight_decay: float = 0.0, remat: bool = True):
+                            weight_decay: float = 0.0, remat: bool = True,
+                            schedule: str = "1f1b"):
     """Generic fully-manual hybrid dp×mp×pp×sharding×sep train step.
 
     The caller provides the model as three per-device closures (all called
@@ -197,20 +198,29 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
       (e.g. rope cos/sin tables) computed ONCE outside the layer scan and
       passed to every ``block_fn`` call; ``ctx`` is None when omitted.
 
-    The step runs the block stack through the scan pipeline over ``pp``
+    The step runs the block stack through the pipeline over ``pp``
     (parallel/pipeline.py), reduces the masked last-stage loss over
     (pp, dp, sharding, sep), reduces grads over the data axes (plus pp for
     the non-block leaves, never mp — Megatron invariant), and applies
     ZeRO stage-2 Adam over the ``sharding`` axis
     (:func:`zero_adam_leaf_update`).
 
+    ``schedule`` (pp>1 only): ``"1f1b"`` (default) interleaves forward and
+    recompute-backward per tick with O(pp) activation memory
+    (:func:`~paddle_tpu.parallel.pipeline.spmd_pipeline_1f1b`, matching the
+    reference's production 1F1B pipeline_parallel.py:547); ``"gpipe"`` is
+    the fill-drain scan differentiated end-to-end (O(M) memory,
+    reference FThenB).
+
     Returns ``(step_fn, init_fn)`` with
     ``step_fn(state, ids, labels) -> (state, loss)``.
     """
     import jax.numpy as _jnp
     from jax.sharding import NamedSharding
-    from .pipeline import spmd_pipeline
+    from .pipeline import spmd_pipeline, spmd_pipeline_1f1b
 
+    if schedule not in ("1f1b", "gpipe"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     mesh = topo.mesh
     S = topo.axis_size(PP_AXIS)
     dp = topo.axis_size(DP_AXIS)
@@ -242,15 +252,18 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
 
     def local_step(params, m, v, t, ids, labels):
         b_l, s_l = ids.shape
+        # per-step loop invariants + the one-layer scan body, shared by
+        # both schedules (ctx never depends on params, so it can live
+        # outside the differentiated region)
+        ctx = step_ctx_fn(s_l) if step_ctx_fn is not None else None
+
+        def body(carry, layer_params):
+            return block_fn(layer_params, carry, ctx), None
 
         def loss_fn(params):
             x = embed_fn(params, ids)
             hdim = x.shape[-1]
             blk = {k: val[0] for k, val in params["blocks"].items()}
-            ctx = step_ctx_fn(s_l) if step_ctx_fn is not None else None
-
-            def body(carry, layer_params):
-                return block_fn(layer_params, carry, ctx), None
 
             if S > 1:
                 M = num_microbatches
@@ -276,7 +289,37 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                 (PP_AXIS, DP_AXIS, SHARDING_AXIS, SEP_AXIS))
             return total / (b_l * s_l * dp * shard * sep)
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        norm = b_l * s_l * dp * shard * sep
+        if S > 1 and schedule == "1f1b":
+            M = num_microbatches
+            other = {k: v for k, v in params.items() if k != "blocks"}
+            blk = {k: v[0] for k, v in params["blocks"].items()}
+            ids_mb = ids.reshape(M, b_l // M, s_l)
+            labels_mb = labels.reshape(M, b_l // M, s_l)
+
+            def mb_fn(other_p, blk_p, x_in, ids1, labels1):
+                p = dict(other_p, blocks=None)
+                x0 = embed_fn(p, ids1)
+                x = jnp.where(lax.axis_index(PP_AXIS) == 0, x0, x_in)
+                sbody = jax.checkpoint(body) if remat else body
+                y, _ = lax.scan(sbody, x, blk_p)
+                nll = head_nll_fn(p, y, labels1)
+                last = (lax.axis_index(PP_AXIS) == S - 1)
+                return y, jnp.sum(nll) * last.astype(nll.dtype)
+
+            xa = jax.eval_shape(
+                lambda o, i: embed_fn(dict(o, blocks=None), i),
+                other, ids_mb[0])
+            nll_sum, d_other, d_blk = spmd_pipeline_1f1b(
+                mb_fn, other, blk, ids_mb, labels_mb,
+                xa.shape, xa.dtype, S)
+            loss = fwd_psum(nll_sum,
+                            (PP_AXIS, DP_AXIS, SHARDING_AXIS, SEP_AXIS)) \
+                / norm
+            grads = {k: v / norm for k, v in d_other.items()}
+            grads["blocks"] = {k: v[None] / norm for k, v in d_blk.items()}
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params)
         t2 = t + 1
         tf = t2.astype(_jnp.float32)
 

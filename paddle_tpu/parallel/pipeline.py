@@ -33,7 +33,7 @@ from ..nn.layer.layers import Layer, functional_call
 from .topology import PP_AXIS, get_topology
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "spmd_pipeline",
-           "pipeline_stack_specs"]
+           "spmd_pipeline_1f1b", "pipeline_stack_specs"]
 
 
 class LayerDesc:
@@ -107,6 +107,113 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, microbatches,
     (state, outputs), _ = jax.lax.scan(step, (state, outputs),
                                        jnp.arange(M + S - 1))
     return outputs
+
+
+def spmd_pipeline_1f1b(mb_fn, other_params, blk_params, ids_mb, labels_mb,
+                       x_shape, x_dtype, num_stages: int,
+                       axis_name: str = PP_AXIS):
+    """1F1B-class pipeline schedule with manually-interleaved backward.
+
+    Matches the MEMORY behavior of the reference's 1F1B runtime
+    (fleet/meta_parallel/pipeline_parallel.py:547): peak activation storage
+    is O(num_stages) in-flight microbatch *stage inputs*, independent of the
+    microbatch count M — unlike differentiating through the GPipe fill-drain
+    scan (:func:`spmd_pipeline`), whose saved residuals grow O(M).
+
+    Design (runs INSIDE an all-manual shard_map over ``axis_name``):
+    one ``lax.scan`` over T = M + 2(S-1) combined ticks.  Each tick every
+    stage
+      1. runs one forward microbatch (F of mb ``m`` at tick ``s + m``),
+         saving only its INPUT into a circular buffer of 2S slots,
+      2. ppermutes the activation forward,
+      3. runs one backward microbatch (B of mb ``m`` at tick
+         ``2(S-1) - s + m``) by re-running the forward from the saved input
+         under ``jax.vjp`` (recompute, like the reference's
+         recompute+1F1B combination) and accumulating fp32 grads,
+      4. ppermutes the input-cotangent backward.
+    The tick scan itself is never differentiated, so NO scan residuals are
+    kept — the only activation state is the 2S-slot buffer and the two
+    message buffers.  Inactive (bubble) slots compute on zeros and their
+    writes are masked out.
+
+    ``mb_fn(other_params, blk_params, x_in, ids1, labels1) -> (y, nll_sum)``
+    must: use ``x_in`` only when ``lax.axis_index(axis_name) > 0`` (stage 0
+    embeds ``ids1`` itself), and mask ``nll_sum`` to the LAST stage.
+
+    Returns ``(nll_total, d_other, d_blk)``: the summed (unnormalized) NLL
+    — nonzero on the last stage only — and fp32 grad pytrees matching
+    ``other_params`` / ``blk_params``.
+    """
+    M = ids_mb.shape[0]
+    S = num_stages
+    T = M + 2 * (S - 1)
+    BUF = 2 * S
+    stage = jax.lax.axis_index(axis_name)
+    is_last = stage == S - 1
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+    f32 = functools.partial(jax.tree.map,
+                            lambda p: jnp.zeros(p.shape, jnp.float32))
+    x0 = jnp.zeros(x_shape, x_dtype)
+    carry0 = (
+        jnp.zeros((BUF,) + x_shape, x_dtype),       # saved stage inputs
+        x0,                                         # fwd activation message
+        x0,                                         # bwd cotangent message
+        f32(other_params), f32(blk_params),         # grad accumulators
+        jnp.zeros((), jnp.float32),                 # nll accumulator
+    )
+
+    def masked_add(acc, g, on):
+        return jax.tree.map(
+            lambda a, gg: a + jnp.where(on, gg.astype(jnp.float32), 0.0),
+            acc, g)
+
+    def tick(carry, t):
+        x_save, y_msg, dx_msg, d_other, d_blk, nll_acc = carry
+
+        # ---- forward phase: F(stage, m_f) at tick t = stage + m_f ----
+        m_f = t - stage
+        on_f = (m_f >= 0) & (m_f < M)
+        m_fc = jnp.clip(m_f, 0, M - 1)
+        ids_f = jax.lax.dynamic_index_in_dim(ids_mb, m_fc, 0, keepdims=False)
+        lab_f = jax.lax.dynamic_index_in_dim(labels_mb, m_fc, 0,
+                                             keepdims=False)
+        y_f, nll_f = mb_fn(other_params, blk_params, y_msg, ids_f, lab_f)
+        x_save = jnp.where(
+            on_f,
+            jax.lax.dynamic_update_index_in_dim(x_save, y_msg, m_fc % BUF, 0),
+            x_save)
+        nll_acc = nll_acc + jnp.where(on_f, nll_f.astype(jnp.float32), 0.0)
+        y_msg = jax.lax.ppermute(y_f, axis_name, perm_fwd)
+
+        # ---- backward phase: B(stage, m_b) at t = 2(S-1) - stage + m_b ----
+        m_b = t - (2 * (S - 1) - stage)
+        on_b = (m_b >= 0) & (m_b < M)
+        m_bc = jnp.clip(m_b, 0, M - 1)
+        ids_b = jax.lax.dynamic_index_in_dim(ids_mb, m_bc, 0, keepdims=False)
+        lab_b = jax.lax.dynamic_index_in_dim(labels_mb, m_bc, 0,
+                                             keepdims=False)
+        x_b = jax.lax.dynamic_index_in_dim(x_save, m_bc % BUF, 0,
+                                           keepdims=False)
+        _, pull = jax.vjp(
+            lambda o, b, x: mb_fn(o, b, x, ids_b, lab_b),
+            other_params, blk_params, x_b)
+        # last stage: y is not consumed downstream (the head ate x), so its
+        # cotangent is zero; the loss cotangent is 1 (mb_fn masks nll_sum
+        # to the last stage, so interior stages get zero head/embed grads
+        # through the same pullback).
+        dy = jnp.where(is_last, jnp.zeros_like(dx_msg), dx_msg)
+        go, gb, dx = pull((dy, jnp.ones((), nll_f.dtype)))
+        d_other = masked_add(d_other, go, on_b)
+        d_blk = masked_add(d_blk, gb, on_b)
+        dx_msg = jax.lax.ppermute(dx, axis_name, perm_bwd)
+
+        return (x_save, y_msg, dx_msg, d_other, d_blk, nll_acc), None
+
+    (x_save, y_msg, dx_msg, d_other, d_blk, nll_acc), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(T))
+    return nll_acc, d_other, d_blk
 
 
 def pipeline_stack_specs(param_tree, axis_name: str = PP_AXIS):
