@@ -332,10 +332,8 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
     v = (y @ params["v_w"]).reshape(b, s, -1, cfg.head_dim)
     q, k = apply_rope(q, k, cos, sin)
     if attn_fn is not None:
-        if k.shape[2] != q.shape[2]:
-            rep = q.shape[2] // k.shape[2]
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        # GQA is native in every attn_fn path (Pallas flash kernel, ring,
+        # Ulysses) — k/v keep their grouped head count, no jnp.repeat.
         attn = attn_fn(q, k, v)
     else:
         attn = _gqa_attention(q, k, v, causal=True)

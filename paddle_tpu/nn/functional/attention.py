@@ -53,9 +53,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     rng = next_rng_key() if (dropout_p > 0.0 and training) else None
 
     def impl(q, k, v, m, rk):
-        if use_pallas and m is None and (dropout_p == 0.0 or not training):
+        no_drop = dropout_p == 0.0 or not training
+        if use_pallas and m is None and no_drop:
             from ...ops.pallas.flash_attention import flash_attention_fwd
             return flash_attention_fwd(q, k, v, causal=is_causal)
+        # masks stay on the dense path: the kernel's bias input is
+        # non-differentiable and only broadcasts on dims 0/1, so routing
+        # arbitrary user masks there would silently drop mask gradients
+        # or mis-index size-1 seq dims
         return _sdpa_ref(q, k, v, m, dropout_p if training else 0.0,
                          is_causal, rk)
 
@@ -78,15 +83,27 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         causal=False, return_softmax=False, training=True):
     """Varlen flash attention (reference: flash_attn_unpadded
     nn/functional/flash_attention.py:593).  Packed layout: [total_tokens,
-    num_heads, head_dim] with cu_seqlens prefix sums.  Implemented by
-    segment-masked attention over the packed sequence — O(T^2) reference;
-    the Pallas varlen kernel handles the fused path."""
+    num_heads, head_dim] with cu_seqlens prefix sums.  Dispatches to the
+    Pallas segment-ids kernel (O(T) memory); dense segment-masked attention
+    is the off-TPU / dropout fallback."""
+    use_pallas = _should_use_pallas(query) and (
+        dropout == 0.0 or not training)
 
     def impl(q, k, v, cq, ck):
         t_q = q.shape[0]
         t_k = k.shape[0]
         seg_q = jnp.searchsorted(cq, jnp.arange(t_q), side="right") - 1
         seg_k = jnp.searchsorted(ck, jnp.arange(t_k), side="right") - 1
+        same_packing = cu_seqlens_q is cu_seqlens_k and t_q == t_k
+        if use_pallas and (not causal or same_packing):
+            # packed self-attention (identical cu_seqlens): global position
+            # order == within-segment order, so kernel-causal + segment
+            # mask == per-segment causal.  Differing q/k packings fall back
+            # to the dense path, whose causal mask is per-segment-local.
+            from ...ops.pallas.flash_attention import flash_attention as fa
+            return fa(q[None], k[None], v[None], scale, causal,
+                      segment_ids=seg_q[None].astype(jnp.int32),
+                      kv_segment_ids=seg_k[None].astype(jnp.int32))[0]
         d = q.shape[-1]
         s = scale if scale is not None else 1.0 / math.sqrt(d)
         logits = jnp.einsum("qhd,khd->hqk", q, k) * s

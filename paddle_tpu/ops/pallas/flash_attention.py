@@ -1,15 +1,32 @@
-"""Pallas TPU flash attention (fwd + bwd).
+"""Pallas TPU flash attention (fwd + bwd): GQA, segment-ids (varlen), bias.
 
 Port target: the reference's FlashAttention integration
 (/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu:536, which
-dynloads an external CUDA library — backends/dynload/flashattn.h:19).  Here
-the kernel is first-party: online-softmax tiling over KV blocks with the
-accumulator carried in VMEM scratch across the (sequential) TPU grid, bwd
-via the standard recompute dq / dkv two-kernel scheme.
+dynloads an external CUDA library — backends/dynload/flashattn.h:19; varlen
+entry flash_attn_kernel.cu:210, Python API
+python/paddle/nn/functional/flash_attention.py:593).  Here the kernel is
+first-party: online-softmax tiling over KV blocks with the accumulator
+carried in VMEM scratch across the (sequential) TPU grid, bwd via the
+standard recompute dq / dkv two-kernel scheme.
+
+Features beyond the round-1 kernel:
+
+* **GQA native** — ``k``/``v`` may have fewer heads than ``q``
+  (``Hq = G * Hkv``); the q-head grid dimension maps onto KV head
+  ``h // G`` (no ``jnp.repeat`` materialization).  The dkv kernel folds the
+  group into its innermost grid dim so each KV-head's gradient block is
+  visited consecutively (TPU Pallas output blocks must not be revisited).
+* **segment_ids** — ``[B, Sq]`` / ``[B, Sk]`` int32; tokens attend only
+  within equal ids (varlen packing à la flash_attn_unpadded / cu_seqlens).
+* **bias** — additive logits bias ``[B|1, Hq|1, Sq, Sk]``, loaded blockwise
+  (broadcast dims resolved in the index map).  Non-differentiable (use for
+  ALiBi/relative-position constants).
+* **lse output** — :func:`flash_attention_with_lse` exposes the softmax
+  normalizer so ring context parallelism (parallel/context_parallel.py) can
+  run this kernel per KV chunk and merge chunks online.
 
 Layout: [batch, seq, heads, head_dim] (paddle flash_attention layout).
-Internally processed per (batch, head) with blocks of q/k rows sized to the
-MXU (128).  float32 accumulation; inputs may be bf16.
+float32 accumulation; inputs may be bf16.
 """
 
 from __future__ import annotations
@@ -25,7 +42,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .common import NEG_INF, use_interpret
 
-__all__ = ["flash_attention_fwd", "flash_attention"]
+__all__ = ["flash_attention_fwd", "flash_attention",
+           "flash_attention_with_lse"]
 
 DEFAULT_BLOCK = 128
 
@@ -34,13 +52,38 @@ def _blocks(seq: int) -> int:
     return min(DEFAULT_BLOCK, seq)
 
 
+def _bias_index(bias_shape, G):
+    """Index map for a [B|1, Hq|1, Sq, Sk] bias block, resolving broadcast
+    dims to block 0."""
+    bb = 0 if bias_shape[0] == 1 else None
+    hb = 0 if bias_shape[1] == 1 else None
+
+    def idx(b, h, i, j):
+        return (bb if bb is not None else b,
+                hb if hb is not None else h, i, j)
+
+    return idx
+
+
 # ---------------------------------------------------------------------------
-# forward kernel: grid (B, H, nq, nk) — nk innermost ⇒ scratch carries the
+# forward kernel: grid (B, Hq, nq, nk) — nk innermost ⇒ scratch carries the
 # running softmax state across k blocks for a fixed q block.
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                nk, kv_len):
+def _fwd_kernel(*refs, scale, causal, block_q, block_k, nk, kv_len,
+                has_seg, has_bias):
+    it = iter(refs)
+    q_ref = next(it)
+    k_ref = next(it)
+    v_ref = next(it)
+    seg_q_ref = next(it) if has_seg else None
+    seg_k_ref = next(it) if has_seg else None
+    bias_ref = next(it) if has_bias else None
+    o_ref = next(it)
+    lse_ref = next(it)
+    m_scr = next(it)
+    l_scr = next(it)
+    acc_scr = next(it)
+
     kb = pl.program_id(3)
     qb = pl.program_id(2)
 
@@ -60,6 +103,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if has_bias:
+            s = s + bias_ref[:].astype(jnp.float32)
         q_pos = qb * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -68,6 +113,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if kv_len % block_k != 0:
             s = jnp.where(k_pos < kv_len, s, NEG_INF)   # padded keys
+        if has_seg:
+            same = seg_q_ref[:] == jnp.transpose(seg_k_ref[:])  # [bq, bk]
+            s = jnp.where(same, s, NEG_INF)
         m_prev = m_scr[:]                          # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -95,16 +143,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[:] = m_scr[:] + jnp.log(l)
 
 
-def _pad_seq(x, block):
-    pad = (-x.shape[1]) % block
+def _pad_seq(x, block, axis=1):
+    pad = (-x.shape[axis]) % block
     if pad:
-        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        widths = [(0, 0)] * x.ndim
+        widths[axis] = (0, pad)
+        x = jnp.pad(x, widths)
     return x
 
 
-def _fwd(q, k, v, scale, causal):
-    B, Sq0, H, D = q.shape
-    Sk0 = k.shape[1]
+def _fwd(q, k, v, scale, causal, seg_q=None, seg_k=None, bias=None):
+    B, Sq0, Hq, D = q.shape
+    Sk0, Hkv = k.shape[1], k.shape[2]
+    if Hq % Hkv != 0:
+        raise ValueError(f"q heads ({Hq}) must be a multiple of kv heads "
+                         f"({Hkv}) for GQA")
+    G = Hq // Hkv
     bq = _blocks(Sq0)
     bk = _blocks(Sk0)
     q = _pad_seq(q, bq)
@@ -113,28 +167,49 @@ def _fwd(q, k, v, scale, causal):
     Sq, Sk = q.shape[1], k.shape[1]
     nq = Sq // bq
     nk = Sk // bk
+    has_seg = seg_q is not None
+    has_bias = bias is not None
     # [B, S, H, D] -> [B, H, S, D]
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
 
+    in_specs = [
+        pl.BlockSpec((None, None, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((None, None, bk, D),
+                     lambda b, h, i, j: (b, h // G, j, 0)),
+        pl.BlockSpec((None, None, bk, D),
+                     lambda b, h, i, j: (b, h // G, j, 0)),
+    ]
+    args = [qt, kt, vt]
+    if has_seg:
+        seg_q = _pad_seq(seg_q.astype(jnp.int32), bq)[..., None]  # [B,Sq,1]
+        seg_k = _pad_seq(seg_k.astype(jnp.int32), bk)[..., None]
+        in_specs += [
+            pl.BlockSpec((None, bq, 1), lambda b, h, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, 1), lambda b, h, i, j: (b, j, 0)),
+        ]
+        args += [seg_q, seg_k]
+    if has_bias:
+        bias = _pad_seq(_pad_seq(bias, bq, axis=2), bk, axis=3)
+        in_specs.append(
+            pl.BlockSpec((None, None, bq, bk), _bias_index(bias.shape, G)))
+        args.append(bias)
+
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=bq, block_k=bk, nk=nk, kv_len=Sk0)
+                               block_q=bq, block_k=bk, nk=nk, kv_len=Sk0,
+                               has_seg=has_seg, has_bias=has_bias)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(B, H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((None, None, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((None, None, bk, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((None, None, bk, D), lambda b, h, i, j: (b, h, j, 0)),
-        ],
+        grid=(B, Hq, nq, nk),
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, None, bq, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((None, None, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, Sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -142,15 +217,30 @@ def _fwd(q, k, v, scale, causal):
             pltpu.VMEM((bq, D), jnp.float32),
         ],
         interpret=use_interpret(),
-    )(qt, kt, vt)
-    return jnp.swapaxes(out, 1, 2)[:, :Sq0], lse
+    )(*args)
+    # slice BOTH outputs to the unpadded length — callers (ring merge)
+    # rely on lse being [B, Hq, Sq0, 1]
+    return jnp.swapaxes(out, 1, 2)[:, :Sq0], lse[:, :, :Sq0]
 
 
 # ---------------------------------------------------------------------------
 # backward kernels (recompute scheme, FlashAttention-2 style)
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, block_q, block_k, nk, kv_len):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, nk, kv_len,
+                   has_seg, has_bias):
+    it = iter(refs)
+    q_ref = next(it)
+    k_ref = next(it)
+    v_ref = next(it)
+    do_ref = next(it)
+    lse_ref = next(it)
+    delta_ref = next(it)
+    seg_q_ref = next(it) if has_seg else None
+    seg_k_ref = next(it) if has_seg else None
+    bias_ref = next(it) if has_bias else None
+    dq_ref = next(it)
+    dq_scr = next(it)
+
     kb = pl.program_id(3)
     qb = pl.program_id(2)
 
@@ -167,6 +257,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         delta = delta_ref[:]                       # [bq, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + bias_ref[:].astype(jnp.float32)
         q_pos = qb * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -175,6 +267,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if kv_len % block_k != 0:
             s = jnp.where(k_pos < kv_len, s, NEG_INF)
+        if has_seg:
+            same = seg_q_ref[:] == jnp.transpose(seg_k_ref[:])
+            s = jnp.where(same, s, NEG_INF)
         p = jnp.exp(s - lse)                       # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -195,13 +290,31 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                    block_q, block_k, nq):
-    qb = pl.program_id(3)
-    kb = pl.program_id(2)
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, nq, G, kv_len,
+                    has_seg, has_bias):
+    """Grid (B, Hkv, nk, nq*G): the q-head group is folded into the
+    innermost dim so the (b, hkv, j) output block is visited consecutively
+    while dk/dv accumulate over every (group member, q block) pair."""
+    it = iter(refs)
+    q_ref = next(it)
+    k_ref = next(it)
+    v_ref = next(it)
+    do_ref = next(it)
+    lse_ref = next(it)
+    delta_ref = next(it)
+    seg_q_ref = next(it) if has_seg else None
+    seg_k_ref = next(it) if has_seg else None
+    bias_ref = next(it) if has_bias else None
+    dk_ref = next(it)
+    dv_ref = next(it)
+    dk_scr = next(it)
+    dv_scr = next(it)
 
-    @pl.when(qb == 0)
+    t = pl.program_id(3)
+    kb = pl.program_id(2)
+    qb = t % nq
+
+    @pl.when(t == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -215,12 +328,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[:]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + bias_ref[:].astype(jnp.float32)
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if kv_len % block_k != 0:
+            s = jnp.where(k_pos < kv_len, s, NEG_INF)
+        if has_seg:
+            same = seg_q_ref[:] == jnp.transpose(seg_k_ref[:])
+            s = jnp.where(same, s, NEG_INF)
         p = jnp.exp(s - lse)                       # [bq, bk]
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -239,24 +359,32 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         compute()
 
-    @pl.when(qb == nq - 1)
+    @pl.when(t == nq * G - 1)
     def _final():
         dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, res, g):
-    q, k, v, out, lse = res
+def _bwd(scale, causal, has_seg, has_bias, res, g):
+    q, k, v, out, lse, seg_q, seg_k, bias = res
     do = g
-    B, Sq0, H, D = q.shape
-    Sk0 = k.shape[1]
+    B, Sq0, Hq, D = q.shape
+    Sk0, Hkv = k.shape[1], k.shape[2]
+    G = Hq // Hkv
     bq = _blocks(Sq0)
     bk = _blocks(Sk0)
+    if Hq % Hkv != 0:
+        raise ValueError(f"q heads ({Hq}) must be a multiple of kv heads "
+                         f"({Hkv}) for GQA")
     q = _pad_seq(q, bq)
     k = _pad_seq(k, bk)
     v = _pad_seq(v, bk)
     out = _pad_seq(out, bq)
     do = _pad_seq(do, bq)     # zero-padded ⇒ padded-q rows contribute 0
+    # lse arrives at the unpadded length; padded-q rows see lse=0, which is
+    # harmless: their do rows are zero, so dv/dk/ds contributions vanish
+    # and their dq rows are sliced away below.
+    lse = _pad_seq(lse, bq, axis=2)
     Sq, Sk = q.shape[1], k.shape[1]
     nq = Sq // bq
     nk = Sk // bk
@@ -267,52 +395,99 @@ def _bwd(scale, causal, res, g):
     ot = jnp.swapaxes(out, 1, 2)
     dot_ = jnp.swapaxes(do, 1, 2)
     delta = jnp.sum(ot.astype(jnp.float32) * dot_.astype(jnp.float32),
-                    axis=-1, keepdims=True)        # [B, H, Sq, 1]
+                    axis=-1, keepdims=True)        # [B, Hq, Sq, 1]
+
+    seg_args = []
+    if has_seg:
+        seg_q = _pad_seq(seg_q.astype(jnp.int32), bq)[..., None]
+        seg_k = _pad_seq(seg_k.astype(jnp.int32), bk)[..., None]
+        seg_args = [seg_q, seg_k]
+    bias_args = []
+    if has_bias:
+        bias = _pad_seq(_pad_seq(bias, bq, axis=2), bk, axis=3)
+        bias_args = [bias]
+
+    # ---- dq: grid (B, Hq, nq, nk) ----
+    dq_specs = [
+        pl.BlockSpec((None, None, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((None, None, bk, D),
+                     lambda b, h, i, j: (b, h // G, j, 0)),
+        pl.BlockSpec((None, None, bk, D),
+                     lambda b, h, i, j: (b, h // G, j, 0)),
+        pl.BlockSpec((None, None, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((None, None, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((None, None, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
+    ]
+    if has_seg:
+        dq_specs += [
+            pl.BlockSpec((None, bq, 1), lambda b, h, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, 1), lambda b, h, i, j: (b, j, 0)),
+        ]
+    if has_bias:
+        dq_specs.append(
+            pl.BlockSpec((None, None, bq, bk), _bias_index(bias.shape, G)))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, nk=nk, kv_len=Sk0),
-        grid=(B, H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((None, None, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((None, None, bk, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((None, None, bk, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((None, None, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((None, None, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((None, None, bq, 1), lambda b, h, i, j: (b, h, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, None, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+                          block_q=bq, block_k=bk, nk=nk, kv_len=Sk0,
+                          has_seg=has_seg, has_bias=has_bias),
+        grid=(B, Hq, nq, nk),
+        in_specs=dq_specs,
+        out_specs=pl.BlockSpec((None, None, bq, D),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=use_interpret(),
-    )(qt, kt, vt, dot_, lse, delta)
+    )(qt, kt, vt, dot_, lse, delta, *seg_args, *bias_args)
+
+    # ---- dk/dv: grid (B, Hkv, nk, nq*G), group folded innermost ----
+    def qmap(b, h, j, t):
+        return (b, h * G + t // nq, t % nq, 0)
+
+    dkv_specs = [
+        pl.BlockSpec((None, None, bq, D), qmap),
+        pl.BlockSpec((None, None, bk, D), lambda b, h, j, t: (b, h, j, 0)),
+        pl.BlockSpec((None, None, bk, D), lambda b, h, j, t: (b, h, j, 0)),
+        pl.BlockSpec((None, None, bq, D), qmap),
+        pl.BlockSpec((None, None, bq, 1), qmap),
+        pl.BlockSpec((None, None, bq, 1), qmap),
+    ]
+    if has_seg:
+        dkv_specs += [
+            pl.BlockSpec((None, bq, 1), lambda b, h, j, t: (b, t % nq, 0)),
+            pl.BlockSpec((None, bk, 1), lambda b, h, j, t: (b, j, 0)),
+        ]
+    if has_bias:
+        bi = _bias_index(bias.shape, G)
+
+        def bias_map(b, h, j, t):
+            bb, hh, _, _ = bi(b, h * G + t // nq, t % nq, j)
+            return (bb, hh, t % nq, j)
+
+        dkv_specs.append(pl.BlockSpec((None, None, bq, bk), bias_map))
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, nq=nq),
-        grid=(B, H, nk, nq),
-        in_specs=[
-            pl.BlockSpec((None, None, bq, D), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((None, None, bk, D), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((None, None, bk, D), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((None, None, bq, D), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((None, None, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((None, None, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
-        ],
+                          block_q=bq, block_k=bk, nq=nq, G=G, kv_len=Sk0,
+                          has_seg=has_seg, has_bias=has_bias),
+        grid=(B, Hkv, nk, nq * G),
+        in_specs=dkv_specs,
         out_specs=[
-            pl.BlockSpec((None, None, bk, D), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((None, None, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((None, None, bk, D),
+                         lambda b, h, j, t: (b, h, j, 0)),
+            pl.BlockSpec((None, None, bk, D),
+                         lambda b, h, j, t: (b, h, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sk, D), k.dtype),
-            jax.ShapeDtypeStruct((B, H, Sk, D), v.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, Sk, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, D), jnp.float32),
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=use_interpret(),
-    )(qt, kt, vt, dot_, lse, delta)
+    )(qt, kt, vt, dot_, lse, delta, *seg_args, *bias_args)
 
     return (jnp.swapaxes(dq, 1, 2)[:, :Sq0],
             jnp.swapaxes(dk, 1, 2)[:, :Sk0],
@@ -321,25 +496,80 @@ def _bwd(scale, causal, res, g):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, scale: Optional[float] = None,
-                    causal: bool = False):
-    """Flash attention, [B, S, H, D] layout.  Differentiable."""
+                    causal: bool = False, segment_ids=None,
+                    kv_segment_ids=None, bias=None):
+    """Flash attention, [B, S, H, D] layout.  Differentiable (not w.r.t.
+    ``bias``).  ``k``/``v`` may have fewer (grouped) heads than ``q``.
+
+    ``segment_ids``/``kv_segment_ids``: [B, S] int — varlen packing masks
+    (kv_segment_ids defaults to segment_ids when Sq == Sk).
+    ``bias``: [B|1, Hq|1, Sq, Sk] additive logits bias.
+    """
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    out, _ = _fwd(q, k, v, s, causal)
+    if segment_ids is not None and kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+    out, _ = _fwd(q, k, v, s, causal, segment_ids, kv_segment_ids, bias)
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, causal):
+def _flash_fwd_rule(q, k, v, scale, causal, segment_ids=None,
+                    kv_segment_ids=None, bias=None):
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    out, lse = _fwd(q, k, v, s, causal)
-    return out, (q, k, v, out, lse)
+    kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
+    out, lse = _fwd(q, k, v, s, causal, segment_ids, kv_seg, bias)
+    # residuals keep the ORIGINAL kv_segment_ids (may be None) so the bwd
+    # cotangent structure matches the primal arguments exactly.
+    return out, (q, k, v, out, lse, segment_ids, kv_segment_ids, bias)
+
+
+def _zero_cotangent(x):
+    if x is None:
+        return None
+    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer):
+        import numpy as np
+        return np.zeros(jnp.shape(x), jax.dtypes.float0)
+    return jnp.zeros_like(x)
 
 
 def _flash_bwd_rule(scale, causal, res, g):
-    s = scale if scale is not None else 1.0 / math.sqrt(res[0].shape[-1])
-    return _bwd(s, causal, res, g)
+    q, k, v, out, lse, seg_q, seg_k_orig, bias = res
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    seg_k = seg_k_orig if seg_k_orig is not None else seg_q
+    res2 = (q, k, v, out, lse, seg_q, seg_k, bias)
+    dq, dk, dv = _bwd(s, causal, seg_q is not None, bias is not None,
+                      res2, g)
+    return (dq, dk, dv, _zero_cotangent(seg_q), _zero_cotangent(seg_k_orig),
+            _zero_cotangent(bias))
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_with_lse(q, k, v, scale: Optional[float] = None,
+                             causal: bool = False, segment_ids=None,
+                             kv_segment_ids=None, bias=None
+                             ) -> Tuple[jax.Array, jax.Array]:
+    """Forward-only: returns (out [B,Sq,Hq,D], lse [B,Hq,Sq,1] fp32).
+
+    The lse output lets callers merge partial-KV results online (ring
+    attention) or build their own VJPs via :func:`flash_attention_bwd`."""
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if segment_ids is not None and kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+    return _fwd(q, k, v, s, causal, segment_ids, kv_segment_ids, bias)
+
+
+def flash_attention_bwd(q, k, v, out, lse, do,
+                        scale: Optional[float] = None,
+                        causal: bool = False):
+    """Standalone backward given forward residuals (ring attention inner).
+
+    out/do: [B, Sq, Hq, D]; lse: [B, Hq, Sq, 1] fp32 (GLOBAL normalizer —
+    callers doing chunked/ring attention pass the merged lse so per-chunk
+    contributions sum to the exact gradient).  Returns (dq, dk, dv)."""
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    res = (q, k, v, out, lse, None, None, None)
+    return _bwd(s, causal, False, False, res, do)
 
 
 def flash_attention_fwd(q, k, v, scale: Optional[float] = None,

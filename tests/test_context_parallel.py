@@ -80,6 +80,51 @@ def test_cp_attention_matches_reference(impl, causal):
                                    atol=2e-4, err_msg=f"d{name} ({impl})")
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_gqa_and_unaligned_shard(causal):
+    """GQA kv heads ride the ring natively, and a local shard length that
+    is >128 and block-unaligned exercises the kernel's padding + lse
+    slicing (regression: lse was returned at padded length)."""
+    S_un, Hkv, cp = 2 * 200, 2, 2
+    key = jax.random.key(1)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, S_un, H, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S_un, Hkv, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S_un, Hkv, D), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:cp]).reshape(cp), ("sep",))
+    spec = P(None, "sep", None, None)
+    sharded = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_flash_attention(q, k, v, "sep", causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False))
+
+    def ref(q, k, v):
+        kf = jnp.repeat(k, H // Hkv, axis=2)
+        vf = jnp.repeat(v, H // Hkv, axis=2)
+        scale = 1.0 / math.sqrt(D)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf).astype(
+            jnp.float32) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((S_un, S_un), bool))
+            logits = jnp.where(mask[None, None], logits, -1e30)
+        p = jax.nn.softmax(logits, -1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, vf.astype(jnp.float32))
+
+    np.testing.assert_allclose(np.asarray(sharded(q, k, v)),
+                               np.asarray(ref(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+
+    g_cp = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(jnp.sin(sharded(q, k, v))),
+        argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(jnp.sin(ref(q, k, v))),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_cp, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=2e-4, err_msg=f"d{name}")
+
+
 def test_ring_bf16_runs():
     q, k, v = (x.astype(jnp.bfloat16) for x in _rand())
     mesh = _mesh()
