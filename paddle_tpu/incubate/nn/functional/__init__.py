@@ -14,6 +14,7 @@ __all__ = [
     "fused_bias_dropout_residual_layer_norm", "fused_rotary_position_embedding",
     "fused_bias_act", "fused_dropout_add", "swiglu", "fused_linear",
     "fused_linear_activation", "fused_multi_head_attention",
+    "masked_multihead_attention",
 ]
 
 
@@ -119,7 +120,130 @@ def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
     return run_op("fused_linear_activation", impl, (x, y, bias), {})
 
 
-def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kwargs):
-    raise NotImplementedError(
-        "compose MultiHeadAttention (flash-attention backed) instead; "
-        "monolithic fused MHA arrives with the decode/inference module")
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=-1,
+                               transpose_qkv_wb=False, name=None):
+    """Monolithic fused MHA block (reference
+    incubate/nn/functional/fused_transformer.py / fused_attention op):
+    [pre-LN →] fused QKV proj → attention → out proj → dropout →
+    [+residual →] [post-LN].  qkv_weight: [3, H, D, E] (paddle layout), or
+    [E, 3*E] with ``transpose_qkv_wb``.  Attention dispatches to the flash
+    kernel via F.scaled_dot_product_attention."""
+    from ....core.rng import next_rng_key
+    from ....nn import functional as F
+
+    # rng keys are operands, not trace-time constants: run_op caches the
+    # traced executable per shape, so a key drawn inside impl would bake
+    # one dropout mask forever (same convention as fused_dropout_add)
+    drop_key = (next_rng_key() if dropout_rate > 0.0 and training else None)
+
+    def impl(xv, qkvw, lw, plns, plnb, lns, lnb, qkvb, lb, mask, dkey):
+        B, S, E = xv.shape
+        if transpose_qkv_wb:
+            nh = num_heads
+            qkvw_ = qkvw.reshape(E, 3, nh, E // nh)
+            qkvw_ = jnp.transpose(qkvw_, (1, 2, 3, 0))
+            if qkvb is not None:
+                qkvb = qkvb.reshape(3, nh, E // nh)
+        else:
+            qkvw_ = qkvw
+            nh = qkvw_.shape[1]
+        hd = qkvw_.shape[2]
+        y = xv
+        if pre_layer_norm:
+            mu = jnp.mean(y, -1, keepdims=True)
+            var = jnp.var(y, -1, keepdims=True)
+            y = (y - mu) * jax.lax.rsqrt(var + pre_ln_epsilon)
+            if plns is not None:
+                y = y * plns
+            if plnb is not None:
+                y = y + plnb
+        qkv = jnp.einsum("bse,thde->bsthd", y, qkvw_)
+        if qkvb is not None:
+            qkv = qkv + qkvb[None, None]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D]
+        attn = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=mask,
+            dropout_p=attn_dropout_rate if training else 0.0,
+            is_causal=False, training=training)
+        attn = jnp.asarray(attn._value if hasattr(attn, "_value") else attn)
+        out = attn.reshape(B, S, nh * hd) @ lw
+        if lb is not None:
+            out = out + lb
+        if dkey is not None:
+            keep = jax.random.bernoulli(dkey, 1.0 - dropout_rate, out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout_rate), 0.0)
+        if add_residual:
+            out = xv + out
+        if not pre_layer_norm:
+            mu = jnp.mean(out, -1, keepdims=True)
+            var = jnp.var(out, -1, keepdims=True)
+            out = (out - mu) * jax.lax.rsqrt(var + ln_epsilon)
+            if lns is not None:
+                out = out * lns
+            if lnb is not None:
+                out = out + lnb
+        return out
+
+    return run_op("fused_multi_head_attention", impl,
+                  (x, qkv_weight, linear_weight, pre_ln_scale, pre_ln_bias,
+                   ln_scale, ln_bias, qkv_bias, linear_bias, attn_mask,
+                   drop_key), {})
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """Decode-step MMHA (reference
+    incubate/nn/functional/masked_multihead_attention.py →
+    masked_multihead_attention_kernel.cu): one token's fused QKV attends to
+    a preallocated cache.  x: [B, 3*H*D]; cache_kv: [2, B, H, T_max, D].
+    Returns (out [B, H*D], updated cache_kv).  Dispatches to the Pallas
+    decode kernel on TPU (ops/pallas/decode_attention.py)."""
+    from ....ops.pallas.decode_attention import decode_attention
+
+    if rotary_tensor is not None or rotary_emb_dims:
+        raise NotImplementedError(
+            "masked_multihead_attention: apply RoPE to q/k before the fused "
+            "qkv input (models/generation.py does); in-kernel rotary is not "
+            "implemented")
+    if src_mask is not None:
+        raise NotImplementedError(
+            "masked_multihead_attention: src_mask is not implemented; "
+            "decode masking is by sequence_lengths")
+
+    def impl(xv, cache, b, seqlens):
+        B = xv.shape[0]
+        H, T, D = cache.shape[2], cache.shape[3], cache.shape[4]
+        if b is not None:
+            xv = xv + b
+        q, k, v = (a[:, 0] for a in jnp.split(
+            xv.reshape(B, 3, H, D), 3, axis=1))
+        if seqlens is None:
+            raise ValueError("masked_multihead_attention needs "
+                             "sequence_lengths (cache fill per row)")
+        lens = seqlens.reshape(B).astype(jnp.int32)
+        # scatter this step's k/v at each row's current length
+        tpos = lens  # [B]
+        bidx = jnp.arange(B)
+        kc = cache[0].at[bidx, :, tpos].set(k)     # [B, H, T, D]
+        vc = cache[1].at[bidx, :, tpos].set(v)
+        out = decode_attention(q, jnp.swapaxes(kc, 1, 2),
+                               jnp.swapaxes(vc, 1, 2), lens + 1)
+        return out.reshape(B, H * D), jnp.stack([kc, vc])
+
+    return run_op("masked_multihead_attention", impl,
+                  (x, cache_kv, bias, sequence_lengths), {},
+                  differentiable=False)

@@ -1,4 +1,4 @@
 from .api import (  # noqa: F401
-    StaticFunction, ignore_module, in_to_static_mode, jit_compile, load,
-    not_to_static, save, to_static,
+    InputSpec, StaticFunction, TranslatedLayer, ignore_module,
+    in_to_static_mode, jit_compile, load, not_to_static, save, to_static,
 )

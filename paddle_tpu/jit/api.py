@@ -126,16 +126,109 @@ def ignore_module(modules):
     return None
 
 
+class InputSpec:
+    """``paddle.static.InputSpec`` parity (shape/dtype/name), used to
+    describe ``jit.save`` example inputs."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype!r})"
+
+
+class TranslatedLayer:
+    """Callable returned by :func:`load` — the analog of the reference's
+    ``TranslatedLayer`` (jit/translated_layer.py): a deserialized program
+    plus its parameters, executable without the original Python class."""
+
+    def __init__(self, exported, params):
+        self._exported = exported
+        self._params = params
+
+    def __call__(self, *args):
+        vals = [a._value if isinstance(a, Tensor) else jax.numpy.asarray(a)
+                for a in args]
+        out = self._exported.call(self._params, *vals)
+        return jax.tree.map(_wrap, out)
+
+    def state_dict(self):
+        return dict(self._params)
+
+    eval = train = lambda self: self
+
+
 def save(layer, path, input_spec=None, **config):
-    """``paddle.jit.save`` analog: serialize params + a callable spec.
-    Unlike the reference's Program+TranslatedLayer format (jit/
-    translated_layer.py), we save the state_dict plus the layer's class
-    import path; ``jit.load`` reconstructs and re-jits."""
+    """``paddle.jit.save`` analog (reference jit/api.py).
+
+    TPU-native format: instead of the reference's Program protobuf +
+    TranslatedLayer, the traced computation is serialized as STABLEHLO via
+    ``jax.export`` (path.pdmodel) next to the parameters (path.pdparams) —
+    loadable by :func:`load` in a fresh process with no access to the
+    original Python class."""
+    import pickle
+
+    import numpy as np
+
     from ..framework.io import save as _save
-    _save(layer.state_dict(), path + ".pdparams")
+    from ..nn.layer.layers import functional_call, state_arrays
+
+    if input_spec is None:
+        raise ValueError("jit.save needs input_spec (list of InputSpec or "
+                         "example Tensors) to trace the layer")
+    params = state_arrays(layer)   # params + buffers, the traced pytree
+    _save({k: np.asarray(v) for k, v in params.items()}, path + ".pdparams")
+
+    scope = jax.export.SymbolicScope()
+    counter = [0]
+
+    def spec_to_sds(s):
+        if isinstance(s, InputSpec):
+            from ..core.dtypes import canonical_dtype
+            if any(d is None for d in s.shape):
+                # None dims (paddle's dynamic-batch idiom) become jax.export
+                # symbolic dimensions — the exported program accepts any
+                # concrete size at call time
+                parts = []
+                for d in s.shape:
+                    if d is None:
+                        parts.append(f"_dyn{counter[0]}")
+                        counter[0] += 1
+                    else:
+                        parts.append(str(d))
+                shape = jax.export.symbolic_shape(",".join(parts),
+                                                  scope=scope)
+                return jax.ShapeDtypeStruct(shape, canonical_dtype(s.dtype))
+            return jax.ShapeDtypeStruct(s.shape, canonical_dtype(s.dtype))
+        v = s._value if isinstance(s, Tensor) else jax.numpy.asarray(s)
+        return jax.ShapeDtypeStruct(v.shape, v.dtype)
+
+    def pure(params, *xs):
+        out = functional_call(layer, params, *[Tensor(x) for x in xs])
+        return jax.tree.map(_unwrap, out,
+                            is_leaf=lambda x: isinstance(x, Tensor))
+
+    sds = [spec_to_sds(s) for s in input_spec]
+    params_sds = jax.tree.map(
+        lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params)
+    exported = jax.export.export(jax.jit(pure))(params_sds, *sds)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump({"stablehlo": exported.serialize(),
+                     "param_keys": sorted(params.keys())}, f)
 
 
 def load(path, **config):
-    raise NotImplementedError(
-        "jit.load of serialized programs: use Layer + set_state_dict; "
-        "AOT-compiled export lands with the inference module")
+    """``paddle.jit.load`` analog: deserialize the STABLEHLO program +
+    params saved by :func:`save`; returns a :class:`TranslatedLayer`."""
+    import pickle
+
+    from ..framework.io import load as _load
+
+    with open(path + ".pdmodel", "rb") as f:
+        blob = pickle.load(f)
+    exported = jax.export.deserialize(blob["stablehlo"])
+    state = _load(path + ".pdparams")
+    params = {k: jax.numpy.asarray(v) for k, v in state.items()}
+    return TranslatedLayer(exported, params)

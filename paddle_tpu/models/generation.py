@@ -1,0 +1,308 @@
+"""Autoregressive decoding: KV-cache prefill + per-token decode + sampling.
+
+Reference surface being matched:
+* decode attention — masked_multihead_attention_kernel.cu (MMHA): one query
+  token vs. a growing KV cache; here ops/pallas/decode_attention.py.
+* generation loop — the reference serves generation through
+  fused_multi_transformer + model-zoo ``generate()`` helpers; here a single
+  jitted ``lax.scan`` over decode steps with STATIC shapes (prompt padded to
+  its length, cache preallocated to ``max_len``) so XLA compiles one
+  program for the whole rollout.
+* sampling — greedy / temperature / top-k / top-p, matching
+  ``paddle.tensor.search.top_p_sampling`` semantics.
+
+Functions take the SAME pure param pytrees as the compiled train steps
+(models/gpt.py / models/llama.py ``init_fn``), with stacked block leaves
+``[S, per, ...]`` collapsed to ``[L, ...]`` — so a trained single-host
+state plugs in directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.pallas.decode_attention import decode_attention
+
+__all__ = ["sample_logits", "gpt_generate", "llama_generate",
+           "build_gpt_decoder", "build_llama_decoder"]
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+def sample_logits(logits, key, *, temperature: float = 1.0,
+                  top_k: Optional[int] = None, top_p: Optional[float] = None):
+    """Sample token ids from [B, V] logits.  temperature<=0 → greedy."""
+    if temperature is None or temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
+                                     axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def _collapse_blocks(blocks: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """[S, per, ...] (pipeline-stacked) -> [L, ...]."""
+    return {k: v.reshape((-1,) + v.shape[2:]) for k, v in blocks.items()}
+
+
+# ---------------------------------------------------------------------------
+# GPT decoder
+# ---------------------------------------------------------------------------
+def build_gpt_decoder(cfg, max_len: int, use_pallas: Optional[bool] = None):
+    """Returns (prefill, step).
+
+    prefill(params, ids [B,T0]) -> (cache, logits_last [B,V])
+    step(params, cache, token [B], pos scalar) -> (cache, logits [B,V])
+
+    cache = {"k": [L,B,max_len,H,D], "v": ...} preallocated, static shape.
+    """
+    H, D, L = cfg.num_heads, cfg.head_dim, cfg.num_layers
+    eps = cfg.layer_norm_eps
+
+    def ln(x, w, b):
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+    def final_logits(params, x):
+        x = ln(x, params["lnf_w"], params["lnf_b"])
+        return jnp.einsum("bh,vh->bv", x, params["wte"],
+                          preferred_element_type=jnp.float32)
+
+    def prefill(params, ids):
+        """Run the full prompt through the (non-cached) forward, filling
+        the cache from the per-layer K/V projections."""
+        B, T0 = ids.shape
+        blocks = _collapse_blocks(params["blocks"])
+        pos = jnp.arange(T0)
+        x = jnp.take(params["wte"], ids, axis=0) \
+            + jnp.take(params["wpe"], pos, axis=0)[None]
+
+        def body(x, lp):
+            y = ln(x, lp["ln1_w"], lp["ln1_b"])
+            qkv = y @ lp["qkv_w"] + lp["qkv_b"]
+            qkv = qkv.reshape(B, T0, H, 3 * D)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            scale = 1.0 / math.sqrt(D)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            mask = jnp.tril(jnp.ones((T0, T0), bool))
+            logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+            p = jax.nn.softmax(logits, -1).astype(x.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T0, -1)
+            x = x + attn @ lp["proj_w"] + lp["proj_b"]
+            y = ln(x, lp["ln2_w"], lp["ln2_b"])
+            y = jax.nn.gelu(y @ lp["fc1_w"] + lp["fc1_b"], approximate=True)
+            x = x + y @ lp["fc2_w"] + lp["fc2_b"]
+            return x, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(body, x, blocks)
+        # ks: [L, B, T0, H, D] -> preallocated cache
+        pad = max_len - T0
+        cache = {
+            "k": jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+            "v": jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+        }
+        return cache, final_logits(params, x[:, -1])
+
+    def step(params, cache, token, pos):
+        """One decode step at position ``pos`` (0-based global index)."""
+        B = token.shape[0]
+        blocks = _collapse_blocks(params["blocks"])
+        x = jnp.take(params["wte"], token, axis=0) \
+            + jax.lax.dynamic_index_in_dim(params["wpe"], pos, 0,
+                                           keepdims=False)[None]
+        lengths = jnp.full((B,), pos + 1, jnp.int32)
+
+        def body(carry, inp):
+            x = carry
+            lp, k_l, v_l = inp
+            y = ln(x, lp["ln1_w"], lp["ln1_b"])
+            qkv = y @ lp["qkv_w"] + lp["qkv_b"]
+            qkv = qkv.reshape(B, H, 3 * D)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            k_l = jax.lax.dynamic_update_slice(
+                k_l, k[:, None], (0, pos, 0, 0))
+            v_l = jax.lax.dynamic_update_slice(
+                v_l, v[:, None], (0, pos, 0, 0))
+            attn = decode_attention(q, k_l, v_l, lengths,
+                                    use_pallas=use_pallas)
+            x = x + attn.reshape(B, -1) @ lp["proj_w"] + lp["proj_b"]
+            y = ln(x, lp["ln2_w"], lp["ln2_b"])
+            y = jax.nn.gelu(y @ lp["fc1_w"] + lp["fc1_b"], approximate=True)
+            x = x + y @ lp["fc2_w"] + lp["fc2_b"]
+            return x, (k_l, v_l)
+
+        x, (ks, vs) = jax.lax.scan(body, x, (blocks, cache["k"], cache["v"]))
+        return {"k": ks, "v": vs}, final_logits(params, x)
+
+    return prefill, step
+
+
+# ---------------------------------------------------------------------------
+# Llama decoder
+# ---------------------------------------------------------------------------
+def build_llama_decoder(cfg, max_len: int,
+                        use_pallas: Optional[bool] = None):
+    """Same contract as :func:`build_gpt_decoder` for the Llama family
+    (RMSNorm, RoPE, GQA cache [L,B,T,Hkv,D], SwiGLU, untied head)."""
+    from .llama import _rope_cos_sin, apply_rope
+    H, Hkv, D, L = (cfg.num_heads, cfg.kv_heads, cfg.head_dim,
+                    cfg.num_layers)
+    eps = cfg.rms_norm_eps
+
+    def rms(x, w):
+        ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        return (x * jax.lax.rsqrt(ms + eps).astype(x.dtype)) * w
+
+    def final_logits(params, x):
+        x = rms(x, params["lnf_w"])
+        return jnp.einsum("bh,hv->bv", x, params["head"],
+                          preferred_element_type=jnp.float32)
+
+    cos_full, sin_full = _rope_cos_sin(max_len, D, cfg.rope_theta,
+                                       jnp.dtype(cfg.dtype))
+
+    def prefill(params, ids):
+        B, T0 = ids.shape
+        blocks = _collapse_blocks(params["blocks"])
+        x = jnp.take(params["wte"], ids, axis=0)
+        cos, sin = cos_full[:T0], sin_full[:T0]
+
+        def body(x, lp):
+            y = rms(x, lp["ln1_w"])
+            q = (y @ lp["q_w"]).reshape(B, T0, H, D)
+            k = (y @ lp["k_w"]).reshape(B, T0, Hkv, D)
+            v = (y @ lp["v_w"]).reshape(B, T0, Hkv, D)
+            q, k = apply_rope(q, k, cos, sin)
+            kr = jnp.repeat(k, H // Hkv, axis=2)
+            vr = jnp.repeat(v, H // Hkv, axis=2)
+            scale = 1.0 / math.sqrt(D)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, kr) * scale
+            mask = jnp.tril(jnp.ones((T0, T0), bool))
+            logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+            p = jax.nn.softmax(logits, -1).astype(x.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", p, vr).reshape(B, T0, -1)
+            x = x + attn @ lp["o_w"]
+            y = rms(x, lp["ln2_w"])
+            y = jax.nn.silu(y @ lp["gate_w"]) * (y @ lp["up_w"])
+            x = x + y @ lp["down_w"]
+            return x, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(body, x, blocks)
+        pad = max_len - T0
+        cache = {
+            "k": jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+            "v": jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+        }
+        return cache, final_logits(params, x[:, -1])
+
+    def step(params, cache, token, pos):
+        B = token.shape[0]
+        blocks = _collapse_blocks(params["blocks"])
+        x = jnp.take(params["wte"], token, axis=0)
+        cos_t = jax.lax.dynamic_slice_in_dim(cos_full, pos, 1, 0)
+        sin_t = jax.lax.dynamic_slice_in_dim(sin_full, pos, 1, 0)
+        lengths = jnp.full((B,), pos + 1, jnp.int32)
+
+        def body(carry, inp):
+            x = carry
+            lp, k_l, v_l = inp
+            y = rms(x, lp["ln1_w"])
+            q = (y @ lp["q_w"]).reshape(B, 1, H, D)
+            k = (y @ lp["k_w"]).reshape(B, 1, Hkv, D)
+            v = (y @ lp["v_w"]).reshape(B, 1, Hkv, D)
+            q, k = apply_rope(q, k, cos_t, sin_t)
+            k_l = jax.lax.dynamic_update_slice(k_l, k, (0, pos, 0, 0))
+            v_l = jax.lax.dynamic_update_slice(v_l, v, (0, pos, 0, 0))
+            attn = decode_attention(q[:, 0], k_l, v_l, lengths,
+                                    use_pallas=use_pallas)
+            x = x + attn.reshape(B, -1) @ lp["o_w"]
+            y = rms(x, lp["ln2_w"])
+            y = jax.nn.silu(y @ lp["gate_w"]) * (y @ lp["up_w"])
+            x = x + y @ lp["down_w"]
+            return x, (k_l, v_l)
+
+        xin = x  # [B, h]
+        x, (ks, vs) = jax.lax.scan(body, xin, (blocks, cache["k"],
+                                               cache["v"]))
+        return {"k": ks, "v": vs}, final_logits(params, x)
+
+    return prefill, step
+
+
+# ---------------------------------------------------------------------------
+# generate loop (shared)
+# ---------------------------------------------------------------------------
+def _generate(decoder_builder, cfg, params, input_ids, max_new_tokens,
+              *, temperature=0.0, top_k=None, top_p=None, seed=0,
+              eos_token_id=None, use_pallas=None):
+    ids = jnp.asarray(input_ids)
+    B, T0 = ids.shape
+    max_len = T0 + max_new_tokens
+    max_pos = getattr(cfg, "max_position_embeddings", None)
+    if max_pos is not None and max_len > max_pos:
+        raise ValueError(
+            f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_position_embeddings ({max_pos}); later positions would "
+            f"silently clamp to the last learned position embedding")
+    prefill, step = decoder_builder(cfg, max_len, use_pallas=use_pallas)
+
+    @jax.jit
+    def run(params, ids, key):
+        key0, key_loop = jax.random.split(key)
+        cache, logits = prefill(params, ids)
+        tok0 = sample_logits(logits, key0, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
+
+        def scan_step(carry, i):
+            cache, tok, key, done = carry
+            key, sub = jax.random.split(key)
+            cache, logits = step(params, cache, tok, T0 + i)
+            nxt = sample_logits(logits, sub, temperature=temperature,
+                                top_k=top_k, top_p=top_p)
+            if eos_token_id is not None:
+                done_now = done | (tok == eos_token_id)
+                nxt = jnp.where(done_now, eos_token_id, nxt)
+            else:
+                done_now = done
+            return (cache, nxt, key, done_now), tok
+
+        done0 = jnp.zeros((B,), bool)
+        (_, last, _, _), toks = jax.lax.scan(
+            scan_step, (cache, tok0, key_loop, done0),
+            jnp.arange(max_new_tokens - 1))
+        toks = jnp.moveaxis(toks, 0, 1)          # [B, max_new-1]
+        return jnp.concatenate([toks, last[:, None]], axis=1)
+
+    new = run(params, ids, jax.random.key(seed))
+    return jnp.concatenate([ids.astype(new.dtype), new], axis=1)
+
+
+def gpt_generate(params, cfg, input_ids, max_new_tokens: int, **kw):
+    """Greedy/sampled generation for the GPT param pytree.  Returns
+    [B, T0 + max_new_tokens] ids (prompt included)."""
+    return _generate(build_gpt_decoder, cfg, params, input_ids,
+                     max_new_tokens, **kw)
+
+
+def llama_generate(params, cfg, input_ids, max_new_tokens: int, **kw):
+    return _generate(build_llama_decoder, cfg, params, input_ids,
+                     max_new_tokens, **kw)

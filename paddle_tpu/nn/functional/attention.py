@@ -94,7 +94,8 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         t_k = k.shape[0]
         seg_q = jnp.searchsorted(cq, jnp.arange(t_q), side="right") - 1
         seg_k = jnp.searchsorted(ck, jnp.arange(t_k), side="right") - 1
-        same_packing = cu_seqlens_q is cu_seqlens_k and t_q == t_k
+        same_packing = t_q == t_k and (
+            cu_seqlens_q is cu_seqlens_k or _values_equal(cq, ck))
         if use_pallas and (not causal or same_packing):
             # packed self-attention (identical cu_seqlens): global position
             # order == within-segment order, so kernel-causal + segment
@@ -120,6 +121,16 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     out = run_op("flash_attn_unpadded", impl,
                  (query, key, value, cu_seqlens_q, cu_seqlens_k), {})
     return out, None
+
+
+def _values_equal(a, b) -> bool:
+    """Concrete-value equality for dispatch decisions; False under trace."""
+    import numpy as np
+    try:
+        return a.shape == b.shape and bool(np.array_equal(np.asarray(a),
+                                                          np.asarray(b)))
+    except Exception:   # traced values — can't decide, stay conservative
+        return False
 
 
 def _should_use_pallas(query) -> bool:
