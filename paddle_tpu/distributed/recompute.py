@@ -1,0 +1,171 @@
+"""User-facing activation recompute (gradient checkpointing).
+
+Reference: python/paddle/distributed/fleet/recompute/recompute.py:124
+(``recompute``) and recompute_hybrid.py (``recompute_hybrid``).  The
+reference needs a PyLayer that stashes/restores CUDA+CPU RNG tracker state
+and replays the forward in backward; here both execution modes collapse
+onto JAX machinery:
+
+* **eager**: the wrapped function runs as ONE tape node (core/dispatch
+  ``run_op``) — only its inputs are saved, and the tape's cached
+  ``jax.vjp`` re-executes the function during ``backward()``.  RNG replay
+  is a captured key passed as an operand and installed via ``rng_scope``,
+  so dropout masks are identical in the replay (the role of
+  ``preserve_rng_state`` / the reference's get_rng_state_tracker dance).
+* **under jit/to_static**: the function is wrapped in ``jax.checkpoint``,
+  XLA's rematerialization — same memory effect, compiler-scheduled.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ..autograd.py_layer import PyLayer
+from ..core.autograd import backward as _core_backward
+from ..core.autograd import enable_grad, no_grad
+from ..core.rng import get_rng_state, set_rng_state
+from ..core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_hybrid", "recompute_sequential"]
+
+
+class _RecomputeFunction(PyLayer):
+    """One tape node for the whole wrapped region: forward runs under
+    no_grad (only inputs retained); backward re-executes the function with
+    grad enabled on a fresh subgraph, back-propagates the incoming
+    cotangents through it (accumulating into any parameters the function
+    closes over), and returns the input cotangents.
+
+    RNG: the global generator STATE is stashed before the forward and
+    restored around the backward re-run (the reference's
+    get_rng_state_tracker stash/restore, recompute.py:64) — dropout draws
+    the very same keys both times, and a non-recompute run under the same
+    seed is bit-identical."""
+
+    @staticmethod
+    def forward(ctx, function, rng_state, *args):
+        ctx.fn = function
+        ctx.rng_state = rng_state
+        ctx.inputs = args
+        with no_grad():
+            return function(*args)
+
+    @staticmethod
+    def backward(ctx, *grads):
+        ins = [Tensor(a._value, stop_gradient=a.stop_gradient)
+               if isinstance(a, Tensor) else a for a in ctx.inputs]
+        cur_state = get_rng_state() if ctx.rng_state is not None else None
+        if ctx.rng_state is not None:
+            set_rng_state(ctx.rng_state)
+        try:
+            with enable_grad():
+                out = ctx.fn(*ins)
+        finally:
+            if cur_state is not None:
+                set_rng_state(cur_state)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        live = [(o, g) for o, g in zip(outs, grads)
+                if isinstance(o, Tensor) and not o.stop_gradient]
+        _core_backward([o for o, _ in live], [g for _, g in live])
+        # one cotangent per Tensor input, positionally (PyLayer contract)
+        return tuple(
+            (t.grad if not t.stop_gradient else None)
+            for t in ins if isinstance(t, Tensor))
+
+
+def recompute(function, *args, use_reentrant: bool = True,
+              preserve_rng_state: bool = True, **kwargs):
+    """Run ``function(*args)`` without storing its intermediate
+    activations; they are recomputed during the backward pass.
+
+    Matches ``paddle.distributed.fleet.recompute`` semantics (reference
+    recompute.py:124): only the inputs are retained; RNG-dependent ops
+    (dropout) replay identically when ``preserve_rng_state`` (global
+    generator state stashed/restored around the backward re-run — the
+    analog of the reference's CUDA/CPU RNG state tracker dance).
+    """
+    if kwargs:
+        raise ValueError(f"recompute got unexpected kwargs: {list(kwargs)} "
+                         "(pass positional args only, like the reference)")
+
+    # Inside a jit/to_static trace the tape is bypassed; wrap in
+    # jax.checkpoint so XLA rematerializes instead of saving residuals.
+    # (rng keys drawn while tracing are constants in the jaxpr, so the
+    # remat replays identical dropout masks with no state juggling.)
+    vals = [a._value if isinstance(a, Tensor) else a for a in args]
+    if any(isinstance(v, jax.core.Tracer) for v in jax.tree.leaves(vals)):
+        def pure(*vs):
+            targs = [Tensor(v) if isinstance(v, jax.Array) else v
+                     for v in vs]
+            out = function(*targs)
+            return jax.tree.map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+
+        out = jax.checkpoint(pure)(*vals)
+        return jax.tree.map(Tensor, out,
+                            is_leaf=lambda x: isinstance(x, jax.Array))
+    rng_state = get_rng_state() if preserve_rng_state else None
+
+    # the tape only creates the node if some INPUT requires grad; when the
+    # trainable leaves all live in the function's closure (params of a
+    # first layer fed stop_gradient data), thread a zero sentinel through
+    # so the recompute node still participates in backward
+    import jax.numpy as jnp
+    if not any(isinstance(a, Tensor) and not a.stop_gradient for a in args):
+        sentinel = Tensor(jnp.zeros((), jnp.float32), stop_gradient=False)
+
+        def with_sentinel(*a):
+            out = function(*a[:-1])
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            first = next((i for i, o in enumerate(outs)
+                          if isinstance(o, Tensor)
+                          and jnp.issubdtype(o._value.dtype, jnp.inexact)),
+                         None)
+            if first is not None:
+                outs[first] = outs[first] + a[-1].astype(
+                    outs[first]._value.dtype)
+            return (type(out)(outs) if isinstance(out, (tuple, list))
+                    else outs[0])
+
+        return _RecomputeFunction.apply(with_sentinel, rng_state, *args,
+                                        sentinel)
+    return _RecomputeFunction.apply(function, rng_state, *args)
+
+
+def recompute_hybrid(ctx: Any, function, *args, **kwargs):
+    """``fleet.recompute_hybrid`` parity (recompute_hybrid.py): recompute
+    inside hybrid-parallel models.  The reference threads mp_group RNG
+    trackers and offload flags through ``ctx``; in the manual-SPMD design
+    collectives are ordinary traced ops and the mesh rng is an explicit
+    key, so the ctx reduces to the plain recompute (offload is handled by
+    XLA host-offload policies, tracked separately)."""
+    del ctx
+    return recompute(function, *args, **kwargs)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Apply recompute around each function in a Sequential-like chain
+    (reference recompute_sequential helper)."""
+    segments = int((ctx or {}).get("segments", 1))
+    funcs = list(functions)
+    per = max(1, len(funcs) // max(1, segments))
+    out = args
+
+    def seg_runner(fs):
+        def run(*xs):
+            y = xs
+            for f in fs:
+                y = f(*y) if isinstance(y, tuple) else (f(y),)
+            return y[0] if len(y) == 1 else y
+        return run
+
+    for i in range(0, len(funcs), per):
+        seg = funcs[i:i + per]
+        out = recompute(seg_runner(seg), *(out if isinstance(out, tuple)
+                                           else (out,)), **kwargs)
+        if not isinstance(out, tuple):
+            out = (out,)
+    return out[0] if len(out) == 1 else out

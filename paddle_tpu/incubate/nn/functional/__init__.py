@@ -3,6 +3,7 @@
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ....core.dispatch import run_op
@@ -139,6 +140,18 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     from ....core.rng import next_rng_key
     from ....nn import functional as F
 
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention: decode with cache_kv goes through "
+            "masked_multihead_attention / models.generation")
+    if ring_id not in (-1, None):
+        raise NotImplementedError(
+            "fused_multi_head_attention: tensor-parallel ring_id is not "
+            "wired; use the manual-SPMD block path (parallel/manual.py)")
+    if mode != "upscale_in_train":
+        raise NotImplementedError(
+            f"fused_multi_head_attention: dropout mode {mode!r}")
+
     # rng keys are operands, not trace-time constants: run_op caches the
     # traced executable per shape, so a key drawn inside impl would bake
     # one dropout mask forever (same convention as fused_dropout_add)
@@ -223,6 +236,15 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         raise NotImplementedError(
             "masked_multihead_attention: src_mask is not implemented; "
             "decode masking is by sequence_lengths")
+    if beam_cache_offset is not None:
+        raise NotImplementedError(
+            "masked_multihead_attention: beam search cache offsets are not "
+            "implemented")
+    if any(a is not None for a in (qkv_out_scale, out_shift, out_smooth)) \
+            or out_scale not in (-1, None):
+        raise NotImplementedError(
+            "masked_multihead_attention: int8/quantized in/out paths are "
+            "not implemented (see quantization package)")
 
     def impl(xv, cache, b, seqlens):
         B = xv.shape[0]
@@ -235,7 +257,15 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
             raise ValueError("masked_multihead_attention needs "
                              "sequence_lengths (cache fill per row)")
         lens = seqlens.reshape(B).astype(jnp.int32)
-        # scatter this step's k/v at each row's current length
+        # scatter this step's k/v at each row's current length; a full
+        # cache would silently drop the scatter (JAX OOB semantics), so
+        # fail loudly when statically checkable
+        import numpy as _np
+        if not isinstance(seqlens, jax.core.Tracer):
+            if int(_np.max(_np.asarray(seqlens))) >= T:
+                raise ValueError(
+                    f"masked_multihead_attention: cache full (length "
+                    f"{int(_np.max(_np.asarray(seqlens)))} >= capacity {T})")
         tpos = lens  # [B]
         bidx = jnp.arange(B)
         kc = cache[0].at[bidx, :, tpos].set(k)     # [B, H, T, D]

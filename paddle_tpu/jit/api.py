@@ -231,4 +231,11 @@ def load(path, **config):
     exported = jax.export.deserialize(blob["stablehlo"])
     state = _load(path + ".pdparams")
     params = {k: jax.numpy.asarray(v) for k, v in state.items()}
+    expected = blob.get("param_keys")
+    if expected is not None and sorted(params.keys()) != expected:
+        missing = set(expected) - set(params)
+        extra = set(params) - set(expected)
+        raise ValueError(
+            f"jit.load: {path}.pdparams does not match the exported "
+            f"program (missing={sorted(missing)}, extra={sorted(extra)})")
     return TranslatedLayer(exported, params)

@@ -251,11 +251,16 @@ def build_llama_decoder(cfg, max_len: int,
 # ---------------------------------------------------------------------------
 # generate loop (shared)
 # ---------------------------------------------------------------------------
+_RUN_CACHE: Dict[Any, Callable] = {}
+
+
 def _generate(decoder_builder, cfg, params, input_ids, max_new_tokens,
               *, temperature=0.0, top_k=None, top_p=None, seed=0,
               eos_token_id=None, use_pallas=None):
     ids = jnp.asarray(input_ids)
     B, T0 = ids.shape
+    if max_new_tokens <= 0:
+        return ids
     max_len = T0 + max_new_tokens
     max_pos = getattr(cfg, "max_position_embeddings", None)
     if max_pos is not None and max_len > max_pos:
@@ -263,6 +268,16 @@ def _generate(decoder_builder, cfg, params, input_ids, max_new_tokens,
             f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"max_position_embeddings ({max_pos}); later positions would "
             f"silently clamp to the last learned position embedding")
+    # the compiled rollout is cached per (model family, config, shapes,
+    # sampling knobs) — repeated generate() calls must not recompile the
+    # whole prefill + decode scan
+    cache_key = (decoder_builder, repr(cfg), B, T0, max_new_tokens,
+                 temperature, top_k, top_p, eos_token_id, use_pallas)
+    cached = _RUN_CACHE.get(cache_key)
+    if cached is not None:
+        new = cached(params, ids, jax.random.key(seed))
+        return jnp.concatenate([ids.astype(new.dtype), new], axis=1)
+
     prefill, step = decoder_builder(cfg, max_len, use_pallas=use_pallas)
 
     @jax.jit
@@ -292,6 +307,7 @@ def _generate(decoder_builder, cfg, params, input_ids, max_new_tokens,
         toks = jnp.moveaxis(toks, 0, 1)          # [B, max_new-1]
         return jnp.concatenate([toks, last[:, None]], axis=1)
 
+    _RUN_CACHE[cache_key] = run
     new = run(params, ids, jax.random.key(seed))
     return jnp.concatenate([ids.astype(new.dtype), new], axis=1)
 

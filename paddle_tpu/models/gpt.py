@@ -234,7 +234,8 @@ def block_param_specs(cfg: GPTConfig, pipeline: bool) -> Dict[str, P]:
 
 def block_apply(params: Dict[str, jax.Array], x: jax.Array,
                 cfg: GPTConfig, attn_fn=None,
-                mp_axis: Optional[str] = None) -> jax.Array:
+                mp_axis: Optional[str] = None,
+                sequence_parallel: bool = False) -> jax.Array:
     """One transformer block, pure jnp (used stacked under lax.scan).
 
     ``attn_fn(q, k, v) -> out`` (all [b, s, heads_local, head_dim])
@@ -245,8 +246,15 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
     tensor-parallel block (qkv/fc1 column-split, proj/fc2 row-split,
     reference fleet/layers/mpu/mp_layers.py:334/541) and the function runs
     inside a manual shard_map: ``mp_copy`` before column matmuls (identity
-    fwd / psum bwd), ``psum`` after row matmuls, biases added post-psum."""
-    b, s, h = x.shape
+    fwd / psum bwd), ``psum`` after row matmuls, biases added post-psum.
+
+    ``sequence_parallel`` (with mp_axis): Megatron-SP — ``x`` arrives with
+    its SEQ dim sharded over mp; column inputs all-gather the sequence and
+    row outputs reduce-scatter it back (parallel/sequence_parallel.py,
+    reference sequence_parallel_utils.py:427/562).  LayerNorms and biases
+    then act on the shard, so their grads are partial over mp (see
+    build_hybrid_train_step's mp_reduce_block_leaves)."""
+    b = x.shape[0]
 
     def ln(v, w, bia):
         mean = jnp.mean(v, -1, keepdims=True)
@@ -255,18 +263,25 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
 
     def col_in(y):
         if mp_axis is not None:
+            if sequence_parallel:
+                from ..parallel.sequence_parallel import all_gather_op
+                return all_gather_op(y, mp_axis)
             from ..parallel.manual import mp_copy
             return mp_copy(y, mp_axis)
         return y
 
     def row_out(z):
         if mp_axis is not None:
+            if sequence_parallel:
+                from ..parallel.sequence_parallel import reduce_scatter_op
+                return reduce_scatter_op(z, mp_axis)
             from ..parallel.manual import fwd_psum
             return fwd_psum(z, mp_axis)
         return z
 
     res = x
     y = col_in(ln(x, params["ln1_w"], params["ln1_b"]))
+    s = y.shape[1]   # full (gathered) seq length under SP
     qkv = y @ params["qkv_w"] + params["qkv_b"]
     qkv = qkv.reshape(b, s, -1, 3 * cfg.head_dim)
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -305,7 +320,8 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                          cp_mode: str = None,
                          use_flash: Optional[bool] = None,
                          remat: bool = True,
-                         schedule: str = "1f1b"):
+                         schedule: str = "1f1b",
+                         sequence_parallel: bool = False):
     """Compile a full hybrid-parallel GPT training step: dp×mp×pp×sharding×sep.
 
     Fully-MANUAL SPMD: one ``shard_map`` over ALL five mesh axes.  Tensor
@@ -398,17 +414,27 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                        for n, v in stack_block_params(cfg, k3, S).items()},
         }
 
+    sp = sequence_parallel and mp > 1
+    if sp:
+        from ..parallel.sequence_parallel import gather_op, scatter_op
+
     def embed_fn(params, ids):
         s_l = ids.shape[1]
         x = man.vocab_parallel_embedding(ids, params["wte"])
         pos = jax.lax.axis_index(SEP_AXIS) * s_l + jnp.arange(s_l)
-        return x + jnp.take(params["wpe"], pos, axis=0)[None]
+        x = x + jnp.take(params["wpe"], pos, axis=0)[None]
+        if sp:   # activations between blocks keep seq sharded over mp
+            x = scatter_op(x, MP_AXIS)
+        return x
 
     def block_fn(layer_params, x, ctx):
         del ctx
-        return block_apply(layer_params, x, cfg, cp_attn, mp_axis=MP_AXIS)
+        return block_apply(layer_params, x, cfg, cp_attn, mp_axis=MP_AXIS,
+                           sequence_parallel=sp)
 
     def head_nll_fn(params, x, labels):
+        if sp:   # head/loss run on the full (replicated) sequence
+            x = gather_op(x, MP_AXIS)
         mean = jnp.mean(x, -1, keepdims=True)
         var = jnp.var(x, -1, keepdims=True)
         x = (x - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps) \
@@ -422,4 +448,7 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
         topo=topo, param_specs=param_specs, init_params_fn=init_params_fn,
         embed_fn=embed_fn, block_fn=block_fn, head_nll_fn=head_nll_fn,
         num_microbatches=num_microbatches, learning_rate=learning_rate,
-        remat=remat, schedule=schedule)
+        remat=remat, schedule=schedule,
+        mp_reduce_block_leaves=frozenset(
+            {"ln1_w", "ln1_b", "ln2_w", "ln2_b", "proj_b", "fc2_b"}
+            if sp else ()))

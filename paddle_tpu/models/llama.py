@@ -305,20 +305,30 @@ def block_param_specs(cfg: LlamaConfig, pipeline: bool) -> Dict[str, P]:
 
 def block_apply(params: Dict[str, jax.Array], x: jax.Array,
                 cfg: LlamaConfig, cos, sin, attn_fn=None,
-                mp_axis: Optional[str] = None) -> jax.Array:
+                mp_axis: Optional[str] = None,
+                sequence_parallel: bool = False) -> jax.Array:
     """One Llama block, pure jnp (stacked under lax.scan).
 
     ``mp_axis``: Megatron-style manual tensor parallelism — params are the
     LOCAL shards (q/k/v/gate/up column-split, o/down row-split), head
     counts derived from the local shard shapes; ``mp_copy`` before column
-    matmuls, ``fwd_psum`` after row matmuls (see parallel/manual.py)."""
-    b, s, h = x.shape
+    matmuls, ``fwd_psum`` after row matmuls (see parallel/manual.py).
+
+    ``sequence_parallel``: Megatron-SP — x's seq dim is sharded over mp;
+    all-gather before column matmuls, reduce-scatter after row matmuls
+    (parallel/sequence_parallel.py)."""
+    b = x.shape[0]
 
     def rms(v, w):
         ms = jnp.mean(jnp.square(v.astype(jnp.float32)), -1, keepdims=True)
         return (v * jax.lax.rsqrt(ms + cfg.rms_norm_eps)).astype(v.dtype) * w
 
-    if mp_axis is not None:
+    if mp_axis is not None and sequence_parallel:
+        from ..parallel.sequence_parallel import (all_gather_op,
+                                                 reduce_scatter_op)
+        col_in = lambda y: all_gather_op(y, mp_axis)
+        row_out = lambda z: reduce_scatter_op(z, mp_axis)
+    elif mp_axis is not None:
         from ..parallel.manual import fwd_psum, mp_copy
         col_in = lambda y: mp_copy(y, mp_axis)
         row_out = lambda z: fwd_psum(z, mp_axis)
@@ -327,6 +337,7 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
 
     res = x
     y = col_in(rms(x, params["ln1_w"]))
+    s = y.shape[1]   # full (gathered) seq length under SP
     q = (y @ params["q_w"]).reshape(b, s, -1, cfg.head_dim)
     k = (y @ params["k_w"]).reshape(b, s, -1, cfg.head_dim)
     v = (y @ params["v_w"]).reshape(b, s, -1, cfg.head_dim)
@@ -361,7 +372,8 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
                            cp_mode: str = None,
                            use_flash: Optional[bool] = None,
                            remat: bool = True,
-                           schedule: str = "1f1b"):
+                           schedule: str = "1f1b",
+                           sequence_parallel: bool = False):
     """Compiled hybrid dp×mp×pp×sharding×sep Llama train step.
 
     Fully-manual SPMD via parallel/manual.py:build_hybrid_train_step
@@ -436,8 +448,15 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
                        for n, v in stack_block_params(cfg, k3, S).items()},
         }
 
+    sp = sequence_parallel and mp > 1
+    if sp:
+        from ..parallel.sequence_parallel import gather_op, scatter_op
+
     def embed_fn(params, ids):
-        return man.vocab_parallel_embedding(ids, params["wte"])
+        x = man.vocab_parallel_embedding(ids, params["wte"])
+        if sp:
+            x = scatter_op(x, MP_AXIS)
+        return x
 
     def step_ctx_fn(s_l):
         # rope table for this sep shard's global positions
@@ -453,9 +472,11 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
     def block_fn(layer_params, x, ctx):
         lcos, lsin = ctx
         return block_apply(layer_params, x, cfg, lcos, lsin, cp_attn,
-                           mp_axis=MP_AXIS)
+                           mp_axis=MP_AXIS, sequence_parallel=sp)
 
     def head_nll_fn(params, x, labels):
+        if sp:
+            x = gather_op(x, MP_AXIS)
         ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
         x = (x * jax.lax.rsqrt(ms + cfg.rms_norm_eps)).astype(x.dtype) \
             * params["lnf_w"]
@@ -469,4 +490,6 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
         embed_fn=embed_fn, block_fn=block_fn, head_nll_fn=head_nll_fn,
         step_ctx_fn=step_ctx_fn,
         num_microbatches=num_microbatches, learning_rate=learning_rate,
-        remat=remat, schedule=schedule)
+        remat=remat, schedule=schedule,
+        mp_reduce_block_leaves=frozenset(
+            {"ln1_w", "ln2_w"} if sp else ()))

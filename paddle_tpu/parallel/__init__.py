@@ -22,6 +22,11 @@ from .mp_layers import (  # noqa: F401
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
     VocabParallelEmbedding,
 )
+from .sequence_parallel import (  # noqa: F401
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear, all_gather_op,
+    gather_op, mark_as_sequence_parallel_parameter, reduce_scatter_op,
+    register_sequence_parallel_allreduce_hooks, scatter_op,
+)
 from .sharding import ShardingStage, group_sharded_parallel  # noqa: F401
 from .topology import HybridTopology, get_topology, init_topology, set_topology  # noqa: F401
 from .pipeline import LayerDesc, PipelineLayer, SharedLayerDesc, spmd_pipeline  # noqa: F401

@@ -180,7 +180,8 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                             learning_rate: float = 1e-4,
                             adam_betas=(0.9, 0.95), adam_eps: float = 1e-8,
                             weight_decay: float = 0.0, remat: bool = True,
-                            schedule: str = "1f1b"):
+                            schedule: str = "1f1b",
+                            mp_reduce_block_leaves=frozenset()):
     """Generic fully-manual hybrid dp×mp×pp×sharding×sep train step.
 
     The caller provides the model as three per-device closures (all called
@@ -211,6 +212,12 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
     reference's production 1F1B pipeline_parallel.py:547); ``"gpipe"`` is
     the fill-drain scan differentiated end-to-end (O(M) memory,
     reference FThenB).
+
+    ``mp_reduce_block_leaves``: block-param leaf names whose grads are
+    PARTIAL over mp and need a psum — used by Megatron sequence
+    parallelism, where LayerNorms/biases run on the mp-sharded sequence
+    (the compiled-step analog of the reference's
+    register_sequence_parallel_allreduce_hooks).
 
     Returns ``(step_fn, init_fn)`` with
     ``step_fn(state, ids, labels) -> (state, loss)``.
@@ -323,13 +330,17 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
         t2 = t + 1
         tf = t2.astype(_jnp.float32)
 
-        def upd(is_blocks, p, g, m_leaf, v_leaf):
+        def upd(is_blocks, p, g, m_leaf, v_leaf, mp_partial=False):
             # data-axis grad reduction; non-block leaves are replicated
             # over pp (stage0 embeds, last stage heads) so sum over pp
-            # too.  NEVER over mp: mp-replicated params get full grads
-            # via mp_copy's bwd psum, mp-sharded ones are local.
+            # too.  NEVER over mp (mp-replicated params get full grads
+            # via mp_copy's bwd psum, mp-sharded ones are local) — except
+            # sequence-parallel leaves, whose activations were mp-sharded
+            # along seq so each rank saw only its tokens.
             red = (DP_AXIS, SEP_AXIS) if is_blocks \
                 else (PP_AXIS, DP_AXIS, SEP_AXIS)
+            if mp_partial:
+                red = red + (MP_AXIS,)
             g = lax.psum(g, red)
             p2, m2, v2 = zero_adam_leaf_update(
                 p, g, m_leaf.reshape(-1), v_leaf.reshape(-1), tf,
@@ -349,7 +360,8 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
             (new_p["blocks"][k], new_m["blocks"][k],
              new_v["blocks"][k]) = upd(
                 True, params["blocks"][k], grads["blocks"][k],
-                m["blocks"][k], v["blocks"][k])
+                m["blocks"][k], v["blocks"][k],
+                mp_partial=k in mp_reduce_block_leaves)
         return new_p, new_m, new_v, t2, loss
 
     shd = jax.shard_map(

@@ -1,0 +1,184 @@
+"""Megatron-style sequence parallelism over the tensor-parallel axis.
+
+Reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+— ``ScatterOp``/``GatherOp``/``AllGatherOp``/``ReduceScatterOp`` PyLayers
+(:85-:127) and ``ColumnSequenceParallelLinear`` (:427) /
+``RowSequenceParallelLinear`` (:562).
+
+Between transformer blocks the activation keeps its SEQUENCE dim sharded
+over the ``mp`` axis (so LayerNorm/dropout activations cost 1/mp memory);
+around each column-parallel matmul the sequence is all-gathered, and each
+row-parallel matmul's all-reduce is replaced by a reduce-scatter back to
+the sequence shard.  Everything here is manual-SPMD: call INSIDE
+``shard_map`` with ``axis_name`` manual (the same style as
+parallel/manual.py, which hosts the plain-mp operators).
+
+Gradient caveat ported from the reference (register_sequence_parallel_
+allreduce_hooks): parameters consumed on the SEQ-SHARDED activation
+(LayerNorms, row-linear biases) see only their shard's tokens, so their
+grads are partial over mp and must be summed — build_hybrid_train_step
+takes ``mp_reduce_block_leaves`` for exactly this.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .topology import MP_AXIS
+
+__all__ = ["scatter_op", "gather_op", "all_gather_op", "reduce_scatter_op",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter",
+           "is_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+
+# ---------------------------------------------------------------------------
+# functional ops (custom VJPs mirror the reference PyLayers)
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def scatter_op(x, axis_name: str = MP_AXIS, axis: int = 1):
+    """Replicated full sequence -> local shard (reference ScatterOp :85:
+    identity-split forward, all-gather backward)."""
+    n = lax.axis_size(axis_name)
+    if x.shape[axis] % n != 0:
+        raise ValueError(f"scatter_op: dim {axis} ({x.shape[axis]}) not "
+                         f"divisible by {axis_name} size {n}")
+    idx = lax.axis_index(axis_name)
+    size = x.shape[axis] // n
+    return lax.dynamic_slice_in_dim(x, idx * size, size, axis)
+
+
+scatter_op.defvjp(
+    lambda x, a, ax: (scatter_op(x, a, ax), None),
+    lambda a, ax, _, g: (lax.all_gather(g, a, axis=ax, tiled=True),))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_op(x, axis_name: str = MP_AXIS, axis: int = 1):
+    """Local shard -> replicated full sequence (reference GatherOp :106:
+    all-gather forward, split backward)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def _split_bwd(axis_name, axis, _, g):
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    size = g.shape[axis] // n
+    return (lax.dynamic_slice_in_dim(g, idx * size, size, axis),)
+
+
+gather_op.defvjp(lambda x, a, ax: (gather_op(x, a, ax), None), _split_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def all_gather_op(x, axis_name: str = MP_AXIS, axis: int = 1):
+    """All-gather whose backward is reduce-scatter (reference AllGatherOp
+    :118) — the input-side operator of ColumnSequenceParallelLinear."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+all_gather_op.defvjp(
+    lambda x, a, ax: (all_gather_op(x, a, ax), None),
+    lambda a, ax, _, g: (lax.psum_scatter(g, a, scatter_dimension=ax,
+                                          tiled=True),))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def reduce_scatter_op(x, axis_name: str = MP_AXIS, axis: int = 1):
+    """Reduce-scatter whose backward is all-gather (reference
+    ReduceScatterOp :127) — the output-side operator of
+    RowSequenceParallelLinear, replacing the plain-mp all-reduce."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+reduce_scatter_op.defvjp(
+    lambda x, a, ax: (reduce_scatter_op(x, a, ax), None),
+    lambda a, ax, _, g: (lax.all_gather(g, a, axis=ax, tiled=True),))
+
+
+# ---------------------------------------------------------------------------
+# layers (manual-SPMD: forward must run inside shard_map)
+# ---------------------------------------------------------------------------
+def _sp_tag(tensor):
+    tensor.__dict__["_sequence_parallel"] = True
+    return tensor
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Tag a parameter whose gradient is partial over mp under SP
+    (reference sequence_parallel_utils.py:mark_as_sequence_parallel_
+    parameter) — consumed by register_sequence_parallel_allreduce_hooks /
+    mp_reduce_block_leaves."""
+    return _sp_tag(parameter)
+
+
+def is_sequence_parallel_parameter(parameter) -> bool:
+    return bool(getattr(parameter, "_sequence_parallel", False))
+
+
+def register_sequence_parallel_allreduce_hooks(layer, accumulation_steps=1,
+                                               fuse=False):
+    """Reference parity (:sequence_parallel_utils.py:register_...): attach
+    grad hooks that all-reduce marked params over mp after backward.  In
+    the eager engine this is a Tensor grad hook calling the mp all-reduce;
+    compiled steps instead list the leaves in mp_reduce_block_leaves."""
+    from .collective import all_reduce
+    from .topology import get_topology
+
+    topo = get_topology()
+    if topo.get_model_parallel_world_size() <= 1:
+        return
+
+    group = topo.get_model_parallel_group()
+    for _, p in layer.named_parameters():
+        if is_sequence_parallel_parameter(p):
+            def hook(g, _group=group):
+                return all_reduce(g, group=_group)
+            p.register_hook(hook)
+
+
+class ColumnSequenceParallelLinear:
+    """y_local = all_gather_seq(x_shard) @ W[:, shard] (+ b[shard]).
+
+    Weight layout identical to ColumnParallelLinear (column shard local);
+    input/output sequence sharding per reference :427.  Pure-functional
+    flavor: construct with the LOCAL weight shard and call inside
+    shard_map.
+    """
+
+    def __init__(self, weight, bias=None, axis_name: str = MP_AXIS):
+        self.weight = weight
+        self.bias = bias
+        self.axis_name = axis_name
+
+    def __call__(self, x):
+        y = all_gather_op(x, self.axis_name) @ self.weight
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class RowSequenceParallelLinear:
+    """y_shard = reduce_scatter_seq(x_local @ W[shard, :]) (+ b).
+
+    The bias is added AFTER the reduce-scatter (on the sequence shard), so
+    its gradient is partial over mp — mark it (reference :562 handles this
+    with mark_as_sequence_parallel_parameter on the bias)."""
+
+    def __init__(self, weight, bias=None, axis_name: str = MP_AXIS):
+        self.weight = weight
+        self.bias = bias
+        self.axis_name = axis_name
+
+    def __call__(self, x):
+        y = reduce_scatter_op(x @ self.weight, self.axis_name)
+        if self.bias is not None:
+            y = y + self.bias
+        return y

@@ -21,7 +21,7 @@ def reset_topology():
 
 def _losses(dp=1, mp=1, pp=1, sep=1, sharding=1, steps=3,
             num_microbatches=None, batch=4, seq=32, schedule="1f1b",
-            layers=2):
+            layers=2, sequence_parallel=False):
     topo = dist.init_topology(dp=dp, mp=mp, pp=pp, sep=sep,
                               sharding=sharding)
     cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=layers,
@@ -29,7 +29,8 @@ def _losses(dp=1, mp=1, pp=1, sep=1, sharding=1, steps=3,
     if num_microbatches is None:
         num_microbatches = 2 if pp > 1 else 1
     step_fn, init_fn = build_gpt_train_step(
-        cfg, topo, num_microbatches=num_microbatches, schedule=schedule)
+        cfg, topo, num_microbatches=num_microbatches, schedule=schedule,
+        sequence_parallel=sequence_parallel)
     state = init_fn(0)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
@@ -163,6 +164,39 @@ def test_1f1b_activation_memory_is_o_stages_not_o_microbatches():
     # see the same data): 1f1b's activation growth must be well under
     # gpipe's residual growth.
     assert ob < gp * 0.55, (ob, gp)
+
+
+@pytest.mark.parametrize("axes", [
+    dict(mp=2,),
+    dict(mp=4,),
+    dict(mp=2, pp=2),
+    dict(mp=2, sep=2),
+    dict(mp=2, dp=2, sharding=2),
+])
+def test_megatron_sp_matches_single_device(axes):
+    """Megatron sequence parallelism (reference
+    sequence_parallel_utils.py): activations seq-sharded over mp between
+    blocks, all-gather/reduce-scatter around the matmuls, partial LN/bias
+    grads psum'ed — must reproduce the dense trajectory exactly."""
+    got = _losses(sequence_parallel=True, **axes)
+    np.testing.assert_allclose(got, _base(), rtol=2e-4, atol=1e-5)
+
+
+def test_llama_sp_matches_single_device():
+    from paddle_tpu.models.llama import llama_tiny, build_llama_train_step
+    topo = dist.init_topology(mp=2, sep=2)
+    cfg = llama_tiny()
+    step_fn, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1,
+                                              sequence_parallel=True)
+    state = init_fn(0)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (4, 32)).astype(np.int64)
+    labels = np.roll(ids, -1, axis=1)
+    out = []
+    for _ in range(3):
+        state, loss = step_fn(state, ids, labels)
+        out.append(float(np.asarray(jax.device_get(loss))))
+    np.testing.assert_allclose(out, _llama_base(), rtol=2e-4, atol=1e-5)
 
 
 def test_mp2_step_uses_pallas_flash():

@@ -1,0 +1,217 @@
+"""Megatron-SP operator/layer correctness (reference
+sequence_parallel_utils.py) and the user recompute() API (reference
+fleet/recompute/recompute.py:124)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.parallel.sequence_parallel import (
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear, all_gather_op,
+    gather_op, reduce_scatter_op, scatter_op)
+
+MP = 4
+rng = np.random.default_rng(0)
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:MP]).reshape(MP), ("mp",))
+
+
+def _smap(fn, in_specs, out_specs):
+    return jax.jit(jax.shard_map(fn, mesh=_mesh(), in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))
+
+
+SHARD = P(None, "mp", None)
+FULL = P(None, None, None)
+
+
+def test_scatter_gather_roundtrip():
+    x = jnp.asarray(rng.normal(size=(2, 8, 16)).astype(np.float32))
+
+    # scatter: replicated full -> shard;  gather: shard -> replicated
+    scat = _smap(lambda x: scatter_op(x, "mp"), (FULL,), SHARD)
+    np.testing.assert_allclose(np.asarray(scat(x)), np.asarray(x))
+
+    gath = _smap(lambda x: gather_op(x, "mp"), (SHARD,), FULL)
+    np.testing.assert_allclose(np.asarray(gath(x)), np.asarray(x))
+
+    # reduce_scatter of an mp-replicated tensor sums mp copies
+    rs = _smap(lambda x: gather_op(reduce_scatter_op(x, "mp"), "mp") / MP,
+               (FULL,), FULL)
+    np.testing.assert_allclose(np.asarray(rs(x)), np.asarray(x), rtol=1e-6)
+
+
+def test_column_row_sequence_parallel_linear_match_dense():
+    """Column(SP) -> gelu -> Row(SP) == dense mlp — values AND grads, with
+    the grads taken INSIDE the shard_map (the manual-SPMD convention these
+    operators implement: complete grads on every rank, sharded params get
+    local-shard grads).  Reference ColumnSequenceParallelLinear :427 /
+    RowSequenceParallelLinear :562."""
+    B, S, H, F = 2, 8, 16, 32
+    x = jnp.asarray(rng.normal(size=(B, S, H)).astype(np.float32))
+    w1 = jnp.asarray(rng.normal(size=(H, F)).astype(np.float32)) * 0.1
+    b1 = jnp.asarray(rng.normal(size=(F,)).astype(np.float32)) * 0.1
+    w2 = jnp.asarray(rng.normal(size=(F, H)).astype(np.float32)) * 0.1
+    b2 = jnp.asarray(rng.normal(size=(H,)).astype(np.float32)) * 0.1
+
+    def dense_loss(args):
+        x, w1, b1, w2, b2 = args
+        y = jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+        return jnp.sum(jnp.sin(y))
+
+    def sp_value_and_grads(x, w1l, b1l, w2l, b2):
+        def local_loss(args):
+            x, w1l, b1l, w2l, b2 = args
+            col = ColumnSequenceParallelLinear(w1l, b1l, "mp")
+            row = RowSequenceParallelLinear(w2l, None, "mp")
+            y = row(jax.nn.gelu(col(scatter_op(x, "mp"))))
+            yg = gather_op(y, "mp") + b2
+            return jnp.sum(jnp.sin(yg))
+
+        return jax.value_and_grad(local_loss)((x, w1l, b1l, w2l, b2))
+
+    specs = (FULL, P(None, "mp"), P("mp"), P("mp", None), P())
+    f = _smap(sp_value_and_grads, specs, (P(), specs))
+    loss, grads = f(x, w1, b1, w2, b2)
+    exp_loss, exp_grads = jax.value_and_grad(dense_loss)((x, w1, b1, w2, b2))
+    np.testing.assert_allclose(float(loss), float(exp_loss), rtol=2e-5)
+    for a, b, name in zip(grads, exp_grads, ["x", "w1", "b1", "w2", "b2"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=2e-4, err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# recompute user API
+# ---------------------------------------------------------------------------
+def test_recompute_eager_matches_plain():
+    """Same loss and grads (inputs AND closure params) with/without
+    recompute."""
+    from paddle_tpu.distributed import recompute
+
+    pt.seed(7)
+    lin1 = nn.Linear(8, 16)
+    lin2 = nn.Linear(16, 8)
+
+    def block(x):
+        return lin2(nn.functional.relu(lin1(x)))
+
+    xv = rng.normal(size=(4, 8)).astype(np.float32)
+
+    def run(with_rc):
+        pt.seed(7)
+        for p in (*lin1.parameters(), *lin2.parameters()):
+            p.clear_grad() if hasattr(p, "clear_grad") else None
+        x = pt.to_tensor(xv, stop_gradient=False)
+        y = recompute(block, x) if with_rc else block(x)
+        loss = (y * y).sum()
+        loss.backward()
+        return (float(loss), np.asarray(x.grad),
+                np.asarray(lin1.weight.grad), np.asarray(lin2.weight.grad))
+
+    l0, gx0, gw10, gw20 = run(False)
+    l1, gx1, gw11, gw21 = run(True)
+    assert l0 == pytest.approx(l1, rel=1e-6)
+    np.testing.assert_allclose(gx1, gx0, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(gw11, gw10, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(gw21, gw20, rtol=1e-5, atol=1e-6)
+
+
+def test_recompute_closure_params_only():
+    """First-layer pattern: input has stop_gradient=True; closure params
+    must still receive grads through the recompute node."""
+    from paddle_tpu.distributed import recompute
+
+    pt.seed(3)
+    lin = nn.Linear(8, 4)
+    x = pt.to_tensor(rng.normal(size=(2, 8)).astype(np.float32))  # stopped
+    y = recompute(lambda t: lin(t), x)
+    (y * y).sum().backward()
+    assert lin.weight.grad is not None
+    # reference grads without recompute
+    lin.weight.clear_grad()
+    y2 = lin(x)
+    (y2 * y2).sum().backward()
+    np.testing.assert_allclose(np.asarray(lin.weight.grad),
+                               np.asarray(lin.weight.grad), rtol=1e-6)
+
+
+def test_recompute_preserves_rng_dropout():
+    """Dropout inside the region replays the SAME mask in the backward
+    recomputation — grads must equal the no-recompute run under the same
+    seed (reference preserve_rng_state)."""
+    from paddle_tpu.distributed import recompute
+
+    xv = rng.normal(size=(4, 16)).astype(np.float32)
+
+    def run(with_rc):
+        pt.seed(11)
+        lin = nn.Linear(16, 16)
+        drop = nn.Dropout(0.5)
+
+        def block(x):
+            return drop(nn.functional.relu(lin(x)))
+
+        x = pt.to_tensor(xv, stop_gradient=False)
+        pt.seed(42)   # dropout mask seed
+        y = recompute(block, x) if with_rc else block(x)
+        (y * y).sum().backward()
+        return np.asarray(x.grad), np.asarray(lin.weight.grad)
+
+    gx0, gw0 = run(False)
+    gx1, gw1 = run(True)
+    np.testing.assert_allclose(gx1, gx0, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(gw1, gw0, rtol=1e-5, atol=1e-6)
+
+
+def test_recompute_under_jit_lowers_to_remat():
+    """Under jit, recompute becomes jax.checkpoint — the jaxpr must carry
+    the remat primitive (XLA then rematerializes instead of saving
+    residuals; memory behavior is jax.checkpoint's guarantee and is
+    measured at scale by the 1F1B pipeline memory test)."""
+    from paddle_tpu.distributed import recompute
+
+    w = jnp.asarray(rng.normal(size=(16, 16)).astype(np.float32))
+
+    def loss(w, x):
+        y = recompute(lambda t: pt.Tensor(jnp.tanh(t._value @ w)),
+                      pt.Tensor(x))
+        return jnp.sum(y._value ** 2)
+
+    x = jnp.asarray(rng.normal(size=(4, 16)).astype(np.float32))
+    jx = str(jax.make_jaxpr(jax.grad(loss))(w, x))
+    assert "remat" in jx or "checkpoint" in jx, jx[:500]
+
+
+def test_recompute_eager_stores_only_inputs():
+    """Eager recompute must collapse the region to ONE tape node holding
+    the inputs — intermediate activations carry no graph (that is the
+    memory saving; they die with the forward)."""
+    from paddle_tpu.distributed import recompute
+
+    lin1 = nn.Linear(8, 16)
+    lin2 = nn.Linear(16, 8)
+    seen = []
+
+    def block(x):
+        h = nn.functional.relu(lin1(x))
+        seen.append(h)
+        return lin2(h)
+
+    x = pt.to_tensor(rng.normal(size=(2, 8)).astype(np.float32),
+                     stop_gradient=False)
+    y = recompute(block, x)
+    # the intermediate seen during the no_grad forward has no grad graph
+    assert seen[0]._node is None
+    assert seen[0].stop_gradient
+    # the output's node is the single recompute PyLayer node
+    assert y._node is not None
+    assert "recompute" in type(y._node).__name__.lower() or \
+        "pylayer" in y._node.name.lower()
+    (y * y).sum().backward()
+    assert x.grad is not None and lin1.weight.grad is not None
