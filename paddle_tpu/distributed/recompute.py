@@ -151,7 +151,8 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
     (reference recompute_sequential helper)."""
     segments = int((ctx or {}).get("segments", 1))
     funcs = list(functions)
-    per = max(1, len(funcs) // max(1, segments))
+    # exactly `segments` chunks (remainder folded in), like the reference
+    per = max(1, -(-len(funcs) // max(1, segments)))
     out = args
 
     def seg_runner(fs):

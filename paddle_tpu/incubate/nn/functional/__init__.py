@@ -245,6 +245,21 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         raise NotImplementedError(
             "masked_multihead_attention: int8/quantized in/out paths are "
             "not implemented (see quantization package)")
+    # capacity check must run on the CONCRETE lengths out here — inside
+    # impl they are tracers under the default eager-op jit cache, and a
+    # full cache would silently drop the scatter (JAX OOB semantics)
+    if sequence_lengths is not None and cache_kv is not None:
+        import numpy as _np
+        _sl = sequence_lengths
+        _sl = _sl._value if isinstance(_sl, Tensor) else _sl
+        cap = (cache_kv._value if isinstance(cache_kv, Tensor)
+               else cache_kv).shape[3]
+        if not isinstance(_sl, jax.core.Tracer):
+            mx = int(_np.max(_np.asarray(_sl)))
+            if mx >= cap:
+                raise ValueError(
+                    f"masked_multihead_attention: cache full (length {mx} "
+                    f">= capacity {cap})")
 
     def impl(xv, cache, b, seqlens):
         B = xv.shape[0]
@@ -257,15 +272,8 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
             raise ValueError("masked_multihead_attention needs "
                              "sequence_lengths (cache fill per row)")
         lens = seqlens.reshape(B).astype(jnp.int32)
-        # scatter this step's k/v at each row's current length; a full
-        # cache would silently drop the scatter (JAX OOB semantics), so
-        # fail loudly when statically checkable
-        import numpy as _np
-        if not isinstance(seqlens, jax.core.Tracer):
-            if int(_np.max(_np.asarray(seqlens))) >= T:
-                raise ValueError(
-                    f"masked_multihead_attention: cache full (length "
-                    f"{int(_np.max(_np.asarray(seqlens)))} >= capacity {T})")
+        # scatter this step's k/v at each row's current length (capacity
+        # validated on the concrete lengths in the outer function)
         tpos = lens  # [B]
         bidx = jnp.arange(B)
         kc = cache[0].at[bidx, :, tpos].set(k)     # [B, H, T, D]

@@ -19,6 +19,7 @@ state plugs in directly.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import math
@@ -159,14 +160,58 @@ def build_gpt_decoder(cfg, max_len: int, use_pallas: Optional[bool] = None):
 # ---------------------------------------------------------------------------
 # Llama decoder
 # ---------------------------------------------------------------------------
+def quantize_llama_params(params, algo: str = "weight_only_int8"):
+    """Quantize every block matmul weight of a Llama param pytree for
+    weight-only decode (BASELINE config 5's fused weight-only path).
+    Returns a params pytree whose block leaves ``<name>`` are replaced by
+    ``<name>__q`` (int8/packed-int4) + ``<name>__s`` (scales)."""
+    from ..nn.quant import weight_quantize
+    blocks = params["blocks"]
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    qblocks = {}
+    for name, v in blocks.items():
+        if name.endswith("_w") and v.ndim >= 3 and not name.startswith("ln"):
+            flat = v.reshape((-1,) + v.shape[2:])   # [L, K, N]
+            qs = [weight_quantize(flat[i], algo) for i in range(
+                flat.shape[0])]
+            qblocks[name + "__q"] = jnp.stack(
+                [jnp.asarray(q[0]._value if hasattr(q[0], "_value")
+                             else q[0]) for q in qs])[None]
+            qblocks[name + "__s"] = jnp.stack(
+                [jnp.asarray(q[1]._value if hasattr(q[1], "_value")
+                             else q[1]) for q in qs])[None]
+        else:
+            qblocks[name] = v
+    out["blocks"] = qblocks
+    return out
+
+
 def build_llama_decoder(cfg, max_len: int,
-                        use_pallas: Optional[bool] = None):
+                        use_pallas: Optional[bool] = None,
+                        quant: Optional[str] = None):
     """Same contract as :func:`build_gpt_decoder` for the Llama family
-    (RMSNorm, RoPE, GQA cache [L,B,T,Hkv,D], SwiGLU, untied head)."""
+    (RMSNorm, RoPE, GQA cache [L,B,T,Hkv,D], SwiGLU, untied head).
+
+    ``quant``: "weight_only_int8" / "weight_only_int4" — params must come
+    from :func:`quantize_llama_params`; block matmuls then run through
+    nn.quant.weight_only_linear (Pallas streaming-dequant on TPU)."""
     from .llama import _rope_cos_sin, apply_rope
     H, Hkv, D, L = (cfg.num_heads, cfg.kv_heads, cfg.head_dim,
                     cfg.num_layers)
     eps = cfg.rms_norm_eps
+
+    if quant is None:
+        def mm(lp, name, y):
+            return y @ lp[name]
+    else:
+        wdt = "int4" if quant == "weight_only_int4" else "int8"
+
+        def mm(lp, name, y):
+            from ..nn.quant import weight_only_linear
+            out = weight_only_linear(y, lp[name + "__q"],
+                                     weight_scale=lp[name + "__s"],
+                                     weight_dtype=wdt)
+            return out._value if hasattr(out, "_value") else out
 
     def rms(x, w):
         ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
@@ -188,9 +233,9 @@ def build_llama_decoder(cfg, max_len: int,
 
         def body(x, lp):
             y = rms(x, lp["ln1_w"])
-            q = (y @ lp["q_w"]).reshape(B, T0, H, D)
-            k = (y @ lp["k_w"]).reshape(B, T0, Hkv, D)
-            v = (y @ lp["v_w"]).reshape(B, T0, Hkv, D)
+            q = mm(lp, "q_w", y).reshape(B, T0, H, D)
+            k = mm(lp, "k_w", y).reshape(B, T0, Hkv, D)
+            v = mm(lp, "v_w", y).reshape(B, T0, Hkv, D)
             q, k = apply_rope(q, k, cos, sin)
             kr = jnp.repeat(k, H // Hkv, axis=2)
             vr = jnp.repeat(v, H // Hkv, axis=2)
@@ -200,10 +245,10 @@ def build_llama_decoder(cfg, max_len: int,
             logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
             p = jax.nn.softmax(logits, -1).astype(x.dtype)
             attn = jnp.einsum("bhqk,bkhd->bqhd", p, vr).reshape(B, T0, -1)
-            x = x + attn @ lp["o_w"]
+            x = x + mm(lp, "o_w", attn)
             y = rms(x, lp["ln2_w"])
-            y = jax.nn.silu(y @ lp["gate_w"]) * (y @ lp["up_w"])
-            x = x + y @ lp["down_w"]
+            y = jax.nn.silu(mm(lp, "gate_w", y)) * mm(lp, "up_w", y)
+            x = x + mm(lp, "down_w", y)
             return x, (k, v)
 
         x, (ks, vs) = jax.lax.scan(body, x, blocks)
@@ -226,18 +271,18 @@ def build_llama_decoder(cfg, max_len: int,
             x = carry
             lp, k_l, v_l = inp
             y = rms(x, lp["ln1_w"])
-            q = (y @ lp["q_w"]).reshape(B, 1, H, D)
-            k = (y @ lp["k_w"]).reshape(B, 1, Hkv, D)
-            v = (y @ lp["v_w"]).reshape(B, 1, Hkv, D)
+            q = mm(lp, "q_w", y).reshape(B, 1, H, D)
+            k = mm(lp, "k_w", y).reshape(B, 1, Hkv, D)
+            v = mm(lp, "v_w", y).reshape(B, 1, Hkv, D)
             q, k = apply_rope(q, k, cos_t, sin_t)
             k_l = jax.lax.dynamic_update_slice(k_l, k, (0, pos, 0, 0))
             v_l = jax.lax.dynamic_update_slice(v_l, v, (0, pos, 0, 0))
             attn = decode_attention(q[:, 0], k_l, v_l, lengths,
                                     use_pallas=use_pallas)
-            x = x + attn.reshape(B, -1) @ lp["o_w"]
+            x = x + mm(lp, "o_w", attn.reshape(B, -1))
             y = rms(x, lp["ln2_w"])
-            y = jax.nn.silu(y @ lp["gate_w"]) * (y @ lp["up_w"])
-            x = x + y @ lp["down_w"]
+            y = jax.nn.silu(mm(lp, "gate_w", y)) * mm(lp, "up_w", y)
+            x = x + mm(lp, "down_w", y)
             return x, (k_l, v_l)
 
         xin = x  # [B, h]
@@ -251,7 +296,11 @@ def build_llama_decoder(cfg, max_len: int,
 # ---------------------------------------------------------------------------
 # generate loop (shared)
 # ---------------------------------------------------------------------------
-_RUN_CACHE: Dict[Any, Callable] = {}
+# bounded compiled-rollout cache (serving loops vary B/T0 freely; each
+# entry pins a jitted closure + XLA executables)
+_RUN_CACHE: "collections.OrderedDict[Any, Callable]" = \
+    collections.OrderedDict()
+_RUN_CACHE_MAX = 16
 
 
 def _generate(decoder_builder, cfg, params, input_ids, max_new_tokens,
@@ -275,6 +324,7 @@ def _generate(decoder_builder, cfg, params, input_ids, max_new_tokens,
                  temperature, top_k, top_p, eos_token_id, use_pallas)
     cached = _RUN_CACHE.get(cache_key)
     if cached is not None:
+        _RUN_CACHE.move_to_end(cache_key)
         new = cached(params, ids, jax.random.key(seed))
         return jnp.concatenate([ids.astype(new.dtype), new], axis=1)
 
@@ -308,6 +358,8 @@ def _generate(decoder_builder, cfg, params, input_ids, max_new_tokens,
         return jnp.concatenate([toks, last[:, None]], axis=1)
 
     _RUN_CACHE[cache_key] = run
+    while len(_RUN_CACHE) > _RUN_CACHE_MAX:
+        _RUN_CACHE.popitem(last=False)
     new = run(params, ids, jax.random.key(seed))
     return jnp.concatenate([ids.astype(new.dtype), new], axis=1)
 
@@ -319,6 +371,20 @@ def gpt_generate(params, cfg, input_ids, max_new_tokens: int, **kw):
                      max_new_tokens, **kw)
 
 
-def llama_generate(params, cfg, input_ids, max_new_tokens: int, **kw):
-    return _generate(build_llama_decoder, cfg, params, input_ids,
+_QUANT_BUILDERS: Dict[str, Callable] = {}
+
+
+def llama_generate(params, cfg, input_ids, max_new_tokens: int,
+                   quant: Optional[str] = None, **kw):
+    """``quant``: pass "weight_only_int8"/"weight_only_int4" with params
+    from :func:`quantize_llama_params` (BASELINE config 5 weight-only
+    decode)."""
+    if quant is None:
+        builder = build_llama_decoder
+    else:
+        # stable builder identity per algo so the compiled-rollout cache
+        # in _generate keeps hitting
+        builder = _QUANT_BUILDERS.setdefault(
+            quant, functools.partial(build_llama_decoder, quant=quant))
+    return _generate(builder, cfg, params, input_ids,
                      max_new_tokens, **kw)

@@ -1,0 +1,171 @@
+"""``paddle_tpu.nn.quant`` — weight-only quantization.
+
+Reference: python/paddle/nn/quant/quantized_linear.py (``weight_quantize``,
+``weight_dequantize``, ``weight_only_linear``, ``llm_int8_linear``) backed
+by phi/kernels/weight_only_linear_kernel.h + fusion/cutlass gemms.
+
+Layout note: the reference's weight_quantize returns a CUTLASS-tiled
+layout; here the quantized weight keeps the LOGICAL [in, out] layout of
+``paddle_tpu.nn.Linear`` (the Pallas kernel does its own tiling), so
+quantized checkpoints are human-readable and resharding-friendly.
+
+int4 is stored two nibbles per int8 byte along the input dim (rows 2k and
+2k+1 packed), halving HBM again; the unpack happens at dequant.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+
+def _unwrap(t):
+    return t._value if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def weight_quantize(x, algo: str = "weight_only_int8", arch=None,
+                    group_size: int = -1):
+    """Per-output-channel absmax quantization.  Returns (out, scale).
+
+    algo: "weight_only_int8" | "llm.int8" -> int8 [K, N];
+          "weight_only_int4" -> packed int8 [ceil(K/2), N] (two rows per
+          byte: low nibble = even row, high nibble = odd row).
+    """
+    if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
+        raise ValueError(f"unknown quantize algo {algo!r}")
+    if group_size not in (-1, None):
+        raise NotImplementedError("grouped scales not implemented")
+
+    def impl(w):
+        absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+        if algo == "weight_only_int4":
+            scale = jnp.maximum(absmax, 1e-8) / 7.0
+            q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -8, 7)
+            q = q.astype(jnp.int8)
+            if q.shape[0] % 2:
+                q = jnp.pad(q, ((0, 1), (0, 0)))
+            half = q.shape[0] // 2
+            # HALVES packing: rows [0, K/2) in the low nibble, rows
+            # [K/2, K) in the high nibble — lets the matmul kernel unpack
+            # as two contiguous nibble-plane matmuls (x_lo @ lo + x_hi @ hi)
+            # with no row interleave.
+            lo = q[:half]
+            hi = q[half:]
+            packed = (lo & 0x0F) | (hi << 4)
+            return packed.astype(jnp.int8), scale
+        scale = jnp.maximum(absmax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+        return q.astype(jnp.int8), scale
+
+    return run_op("weight_quantize", impl, (x,), {}, differentiable=False)
+
+
+def _unpack_int4(packed, k_orig):
+    lo = (packed << 4).astype(jnp.int8) >> 4       # sign-extend low nibble
+    hi = packed >> 4                               # arithmetic shift
+    q = jnp.concatenate([lo, hi], axis=0)          # halves packing
+    return q[:k_orig]
+
+
+def weight_dequantize(x, scale, algo: str = "weight_only_int8",
+                      out_dtype="float32", k: Optional[int] = None):
+    """Inverse of :func:`weight_quantize` (reference weight_dequantize)."""
+
+    def impl(q, s):
+        if algo == "weight_only_int4":
+            kk = k if k is not None else q.shape[0] * 2
+            qq = _unpack_int4(q, kk)
+        else:
+            qq = q
+        return (qq.astype(jnp.float32) * s.astype(jnp.float32)).astype(
+            jnp.dtype(out_dtype))
+
+    return run_op("weight_dequantize", impl, (x, scale), {},
+                  differentiable=False)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype: str = "int8", arch=None,
+                       group_size: int = -1):
+    """y = x @ dequant(weight) + bias (reference
+    nn/quant/quantized_linear.py:weight_only_linear).
+
+    weight: int8 [K, N] ("int8") or packed int4 [ceil(K/2), N] ("int4").
+    Dispatches to the Pallas streaming-dequant matmul on TPU
+    (ops/pallas/quant_linear.py); jnp dequant+matmul elsewhere.
+    """
+    if weight_dtype not in ("int8", "int4"):
+        raise ValueError(f"weight_dtype must be int8/int4, got "
+                         f"{weight_dtype!r}")
+    if weight_scale is None:
+        raise ValueError("weight_only_linear needs weight_scale from "
+                         "weight_quantize")
+
+    def impl(xv, wq, s, b):
+        K = xv.shape[-1]
+        try:
+            on_tpu = jax.devices()[0].platform.lower() in ("tpu", "axon")
+        except Exception:
+            on_tpu = False
+        from ...core.flags import FLAGS
+        if on_tpu or FLAGS.pallas_interpret:
+            if weight_dtype == "int4":
+                # packed nibbles stream straight into the kernel — half
+                # the HBM bytes of int8; unpack happens in VMEM
+                from ...ops.pallas.quant_linear import (
+                    weight_only_matmul_int4)
+                y = weight_only_matmul_int4(xv, wq, s)
+            else:
+                from ...ops.pallas.quant_linear import weight_only_matmul
+                y = weight_only_matmul(xv, wq, s)
+        else:
+            wd = _unpack_int4(wq, K) if weight_dtype == "int4" else wq
+            y = (xv @ wd.astype(xv.dtype)) * s.astype(xv.dtype)
+        if b is not None:
+            y = y + b
+        return y
+
+    return run_op("weight_only_linear", impl, (x, weight, weight_scale,
+                                               bias), {})
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold: float = 6.0):
+    """LLM.int8() mixed decomposition (reference llm_int8_linear):
+    outlier activation columns (|x| > threshold) run in fp, the rest on
+    the int8 weight path, summed."""
+    if weight_scale is None:
+        raise ValueError("llm_int8_linear needs weight_scale")
+
+    def impl(xv, wq, s, b):
+        wf = wq.astype(jnp.float32) * s.astype(jnp.float32)
+        col_amax = jnp.max(jnp.abs(xv.astype(jnp.float32)), axis=tuple(
+            range(xv.ndim - 1)))
+        outlier = col_amax > threshold                     # [K]
+        x_in = jnp.where(outlier, 0.0, xv.astype(jnp.float32))
+        # inlier path: quantize activations to int8 per-row absmax and run
+        # an integer dot (LLM.int8()'s vector-wise scheme); outliers stay fp
+        row_amax = jnp.max(jnp.abs(x_in), axis=-1, keepdims=True)
+        xs = jnp.maximum(row_amax, 1e-8) / 127.0
+        x8 = jnp.clip(jnp.round(x_in / xs), -127, 127).astype(jnp.int8)
+        y_in = jax.lax.dot_general(
+            x8, wq, (((x8.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.float32)
+        y_in = y_in * xs * s.astype(jnp.float32)
+        x_out = jnp.where(outlier, xv.astype(jnp.float32), 0.0)
+        y = y_in + (x_out @ wf)
+        if b is not None:
+            y = y + b
+        return y.astype(xv.dtype)
+
+    return run_op("llm_int8_linear", impl, (x, weight, weight_scale, bias),
+                  {})

@@ -1,0 +1,168 @@
+"""Pallas TPU weight-only quantized matmul.
+
+Port target: the reference's weight-only linear stack —
+/root/reference/paddle/phi/kernels/weight_only_linear_kernel.h (API),
+fusion/cutlass/ (int8/int4 CUTLASS gemms), and the Python surface
+python/paddle/nn/quant/quantized_linear.py.
+
+TPU design: activations stay bf16/fp32; the weight is stored int8 (half
+the HBM bytes of bf16 — the point of weight-only quantization is
+bandwidth, not MXU int ops).  The kernel streams int8 weight blocks into
+VMEM, upcasts in-register, accumulates fp32 on the MXU, and applies the
+per-output-channel scale once at the final K block.
+
+Layouts (logical, matching paddle_tpu.nn.Linear):
+    x:      [..., K]
+    wq:     [K, N] int8
+    scale:  [N] fp32 — per output channel absmax / 127
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .common import use_interpret
+
+__all__ = ["weight_only_matmul", "weight_only_matmul_int4"]
+
+BM, BN, BK = 256, 256, 512
+
+
+def _pad_to(a, mult, axis):
+    p = (-a.shape[axis]) % mult
+    if p:
+        widths = [(0, 0)] * a.ndim
+        widths[axis] = (0, p)
+        a = jnp.pad(a, widths)
+    return a
+
+
+def _wo_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr, *, nk):
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    x = x_ref[:]                                  # [bm, bk]
+    w = w_ref[:].astype(x.dtype)                  # [bk, bn] int8 -> x dtype
+    acc_scr[:] += jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kb == nk - 1)
+    def _final():
+        o_ref[:] = (acc_scr[:] * s_ref[:].astype(jnp.float32)).astype(
+            o_ref.dtype)
+
+
+def weight_only_matmul(x, wq, scale, out_dtype=None):
+    """x [..., K] @ dequant(wq [K, N] int8, scale [N]) -> [..., N]."""
+    out_dtype = out_dtype or x.dtype
+    lead = x.shape[:-1]
+    K = x.shape[-1]
+    N = wq.shape[1]
+    x2 = x.reshape(-1, K)
+    M = x2.shape[0]
+
+    bm = min(BM, max(8, M))
+    bn = min(BN, N)
+    bk = min(BK, K)
+
+    x2 = _pad_to(_pad_to(x2, bm, 0), bk, 1)
+    wqp = _pad_to(_pad_to(wq, bk, 0), bn, 1)
+    sp = _pad_to(scale.astype(jnp.float32)[None, :], bn, 1)   # [1, N]
+    Mp, Kp = x2.shape
+    Np = wqp.shape[1]
+    nk = Kp // bk
+
+    out = pl.pallas_call(
+        functools.partial(_wo_kernel, nk=nk),
+        grid=(Mp // bm, Np // bn, nk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((Mp, Np), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=use_interpret(),
+    )(x2, wqp, sp)
+    return out[:M, :N].reshape(*lead, N)
+
+
+def _wo4_kernel(xlo_ref, xhi_ref, w_ref, s_ref, o_ref, acc_scr, *, nk):
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    xlo = xlo_ref[:]                              # [bm, bkp]
+    xhi = xhi_ref[:]
+    w = w_ref[:]                                  # [bkp, bn] packed int8
+    lo = ((w << 4).astype(jnp.int8) >> 4).astype(xlo.dtype)  # sign-extend
+    hi = (w >> 4).astype(xlo.dtype)               # arithmetic shift
+    acc_scr[:] += jax.lax.dot_general(
+        xlo, lo, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_scr[:] += jax.lax.dot_general(
+        xhi, hi, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kb == nk - 1)
+    def _final():
+        o_ref[:] = (acc_scr[:] * s_ref[:].astype(jnp.float32)).astype(
+            o_ref.dtype)
+
+
+def weight_only_matmul_int4(x, wq_packed, scale, out_dtype=None):
+    """x [..., K] @ dequant(int4 halves-packed wq [ceil(K/2), N]) — the
+    nibble planes are unpacked in VMEM (two matmuls per block), so HBM
+    streams only K*N/2 bytes of weight."""
+    out_dtype = out_dtype or x.dtype
+    lead = x.shape[:-1]
+    K = x.shape[-1]
+    N = wq_packed.shape[1]
+    half = wq_packed.shape[0]            # ceil(K/2)
+    x2 = x.reshape(-1, K)
+    M = x2.shape[0]
+    if K < 2 * half:                     # odd K: pad x to the packed rows
+        x2 = _pad_to(x2, 2 * half, 1)
+
+    bm = min(BM, max(8, M))
+    bn = min(BN, N)
+    bkp = min(BK // 2, half)
+
+    # pad packed rows to a block multiple; x halves pad to match
+    wqp = _pad_to(_pad_to(wq_packed, bkp, 0), bn, 1)
+    half_p = wqp.shape[0]
+    x_lo = _pad_to(_pad_to(x2[:, :half], bm, 0), bkp, 1)
+    x_hi = _pad_to(_pad_to(x2[:, half:2 * half], bm, 0), bkp, 1)
+    sp = _pad_to(scale.astype(jnp.float32)[None, :], bn, 1)
+    Mp = x_lo.shape[0]
+    Np = wqp.shape[1]
+    nk = half_p // bkp
+
+    out = pl.pallas_call(
+        functools.partial(_wo4_kernel, nk=nk),
+        grid=(Mp // bm, Np // bn, nk),
+        in_specs=[
+            pl.BlockSpec((bm, bkp), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bm, bkp), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bkp, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((Mp, Np), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=use_interpret(),
+    )(x_lo, x_hi, wqp, sp)
+    return out[:M, :N].reshape(*lead, N)

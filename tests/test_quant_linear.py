@@ -1,0 +1,120 @@
+"""Weight-only quantization (reference nn/quant/quantized_linear.py +
+weight_only_linear_kernel.h): quantize/dequantize round-trip, the Pallas
+streaming-dequant matmul, and the quantized Llama decode config."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.flags import FLAGS, set_flags
+from paddle_tpu.nn.quant import (llm_int8_linear, weight_dequantize,
+                                 weight_only_linear, weight_quantize)
+
+rng = np.random.default_rng(0)
+
+
+def test_weight_quantize_roundtrip_int8():
+    w = rng.normal(size=(64, 32)).astype(np.float32)
+    q, s = weight_quantize(pt.to_tensor(w))
+    assert np.asarray(q).dtype == np.int8
+    back = np.asarray(weight_dequantize(q, s))
+    # per-channel absmax int8: max error <= scale/2 per element
+    scale = np.abs(w).max(0) / 127.0
+    assert np.max(np.abs(back - w) / scale[None, :]) <= 0.5 + 1e-3
+
+
+def test_weight_quantize_roundtrip_int4():
+    w = rng.normal(size=(63, 32)).astype(np.float32)   # odd K: packing pad
+    q, s = weight_quantize(pt.to_tensor(w), algo="weight_only_int4")
+    assert np.asarray(q).shape == (32, 32)             # ceil(63/2)
+    back = np.asarray(weight_dequantize(q, s, algo="weight_only_int4",
+                                        k=63))
+    scale = np.abs(w).max(0) / 7.0
+    assert back.shape == w.shape
+    assert np.max(np.abs(back - w) / scale[None, :]) <= 0.5 + 1e-3
+
+
+@pytest.mark.parametrize("wdt", ["int8", "int4"])
+def test_weight_only_linear_matches_fp(wdt):
+    x = rng.normal(size=(4, 10, 64)).astype(np.float32)
+    w = (rng.normal(size=(64, 48)) * 0.1).astype(np.float32)
+    b = rng.normal(size=(48,)).astype(np.float32) * 0.1
+    algo = f"weight_only_{wdt}"
+    q, s = weight_quantize(pt.to_tensor(w), algo=algo)
+    y = np.asarray(weight_only_linear(pt.to_tensor(x), q, pt.to_tensor(b),
+                                      s, weight_dtype=wdt))
+    ref = x @ w + b
+    # quantization noise accumulates ~ sqrt(K) * scale/2 * E|x|
+    tol = 0.03 if wdt == "int8" else 0.6
+    assert np.max(np.abs(y - ref)) < tol, np.max(np.abs(y - ref))
+    # and the linear must be EXACT against its own dequantized weight
+    back = np.asarray(weight_dequantize(
+        q, s, algo=algo, k=64)) if wdt == "int4" else np.asarray(
+        weight_dequantize(q, s))
+    np.testing.assert_allclose(y, x @ back + b, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("wdt", ["int8", "int4"])
+def test_weight_only_linear_pallas_matches_jnp(wdt):
+    """The Pallas streaming-dequant kernels (incl. in-VMEM int4 nibble
+    unpack) == the dense dequant matmul."""
+    x = rng.normal(size=(300, 129)).astype(np.float32)   # unaligned shapes
+    w = (rng.normal(size=(129, 70)) * 0.1).astype(np.float32)
+    algo = f"weight_only_{wdt}"
+    q, s = weight_quantize(pt.to_tensor(w), algo=algo)
+    old = FLAGS.pallas_interpret
+    try:
+        set_flags({"pallas_interpret": True})
+        got = np.asarray(weight_only_linear(pt.to_tensor(x), q, None, s,
+                                            weight_dtype=wdt))
+    finally:
+        set_flags({"pallas_interpret": old})
+    exp = np.asarray(weight_only_linear(pt.to_tensor(x), q, None, s,
+                                        weight_dtype=wdt))
+    np.testing.assert_allclose(got, exp, rtol=2e-4, atol=2e-4)
+
+
+def test_llm_int8_linear_close_to_fp():
+    x = rng.normal(size=(8, 64)).astype(np.float32)
+    x[:, 5] *= 20.0   # outlier column
+    w = (rng.normal(size=(64, 32)) * 0.1).astype(np.float32)
+    q, s = weight_quantize(pt.to_tensor(w), algo="llm.int8")
+    y = np.asarray(llm_int8_linear(pt.to_tensor(x), q, None, s))
+    ref = x @ w
+    assert np.max(np.abs(y - ref)) < 0.05
+
+
+def test_llama_weight_only_decode():
+    """Quantized Llama decode (BASELINE config 5): prefill logits close to
+    fp, generation runs and matches fp tokens on a strong-signal prompt."""
+    from paddle_tpu import parallel as dist
+    from paddle_tpu.models.llama import llama_tiny, build_llama_train_step
+    from paddle_tpu.models.generation import (build_llama_decoder,
+                                              llama_generate,
+                                              quantize_llama_params)
+    from paddle_tpu.parallel.topology import HybridTopology, set_topology
+
+    cfg = llama_tiny()
+    topo = dist.init_topology()
+    _, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1)
+    params = init_fn(0)["params"]
+    set_topology(HybridTopology())
+    qparams = quantize_llama_params(params)
+
+    ids = rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    pre_fp, _ = build_llama_decoder(cfg, 12, use_pallas=False)
+    pre_q, _ = build_llama_decoder(cfg, 12, use_pallas=False,
+                                   quant="weight_only_int8")
+    _, logits_fp = pre_fp(params, jnp.asarray(ids))
+    _, logits_q = pre_q(qparams, jnp.asarray(ids))
+    # int8 weight error is ~1%; logits must track closely
+    err = np.max(np.abs(np.asarray(logits_q) - np.asarray(logits_fp)))
+    ref = np.max(np.abs(np.asarray(logits_fp))) + 1e-6
+    assert err / ref < 0.1, (err, ref)
+
+    out = llama_generate(qparams, cfg, ids, 4, temperature=0.0,
+                         use_pallas=False, quant="weight_only_int8")
+    assert out.shape == (2, 12)
+    assert np.isfinite(np.asarray(out)).all()
