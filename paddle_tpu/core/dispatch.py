@@ -69,7 +69,7 @@ def _check_nan_inf(name: str, leaves: List[Any]) -> None:
 
 
 def run_op(name: str, fn: Callable, args: tuple, kwargs: dict,
-           differentiable: bool = True):
+           differentiable: bool = True, jit: bool = True):
     """Execute op ``name`` implemented by pure function ``fn``."""
     from .tensor import Tensor
     from . import amp_state
@@ -145,7 +145,7 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict,
     except TypeError:
         exec_key = None
 
-    if exec_key is not None and FLAGS.eager_op_jit:
+    if exec_key is not None and FLAGS.eager_op_jit and jit:
         out = _exec_cached(exec_key, call)(dyn_values)
     else:
         out = call(dyn_values)

@@ -87,13 +87,35 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         (x, weight, bias), {})
 
 
+
+def _add_channel_bias(out, bias, channel_last, n):
+    if bias is None:
+        return out
+    if channel_last:
+        return out + jnp.reshape(bias, (1,) * (n + 1) + (-1,))
+    return out + jnp.reshape(bias, (1, -1) + (1,) * n)
+
 def _conv_transpose_impl(x, weight, bias, stride, padding, output_padding,
                          dilation, groups, data_format, n):
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     sp = "".join("DHW"[3 - n:])
     dn_in = ("N" + sp + "C") if channel_last else ("NC" + sp)
     if groups != 1:
-        raise NotImplementedError("grouped conv_transpose not yet supported")
+        # lax.conv_transpose has no feature_group_count: run each group's
+        # transpose conv separately (groups is small and static — the
+        # unrolled concat fuses fine under XLA)
+        ic = x.shape[-1] if channel_last else x.shape[1]
+        icg = ic // groups
+        outs = []
+        for g in range(groups):
+            xs = (x[..., g * icg:(g + 1) * icg] if channel_last
+                  else x[:, g * icg:(g + 1) * icg])
+            ws = weight[g * icg:(g + 1) * icg]
+            outs.append(_conv_transpose_impl(
+                xs, ws, None, stride, padding, output_padding, dilation, 1,
+                data_format, n))
+        out = jnp.concatenate(outs, axis=-1 if channel_last else 1)
+        return _add_channel_bias(out, bias, channel_last, n)
     # paddle transpose-conv weight layout [in_c, out_c/groups, *spatial];
     # with transpose_kernel=True lax swaps I/O, so declare it as "OI".
     dn = jax.lax.conv_dimension_numbers(
@@ -124,11 +146,7 @@ def _conv_transpose_impl(x, weight, bias, stride, padding, output_padding,
             ax = (1 + i) if channel_last else (2 + i)
             widths[ax] = (0, o)
         out = jnp.pad(out, widths)
-    if bias is not None:
-        if channel_last:
-            out = out + jnp.reshape(bias, (1,) * (n + 1) + (-1,))
-        else:
-            out = out + jnp.reshape(bias, (1, -1) + (1,) * n)
+    out = _add_channel_bias(out, bias, channel_last, n)
     return out
 
 

@@ -240,4 +240,81 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
-    raise NotImplementedError("ctc_loss: planned (lax.scan forward algorithm)")
+    """Connectionist temporal classification loss.
+
+    Reference: python/paddle/nn/functional/loss.py ``ctc_loss`` backed by
+    warpctc (phi/kernels/impl/warpctc_kernel_impl.h).  TPU-native: the
+    standard log-space forward algorithm as one ``lax.scan`` over time —
+    static shapes, fully batched, differentiable by autodiff (no
+    hand-written warpctc gradient needed).
+
+    log_probs: [T, B, C] (log-softmaxed); labels: [B, L] int; returns per
+    paddle semantics (reduction "mean" divides by label_lengths first).
+    """
+    NEG = -1e30
+
+    def impl(lp, lab, in_len, lab_len):
+        T, B, C = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        lab = lab.astype(jnp.int32)
+        in_len = in_len.reshape(B).astype(jnp.int32)
+        lab_len = lab_len.reshape(B).astype(jnp.int32)
+        # extended label sequence: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        # emission log-probs for the extended sequence: [T, B, S]
+        lp_ext = jnp.take_along_axis(
+            lp, jnp.broadcast_to(ext[None], (T, B, S)), axis=2)
+        # transition mask: s -> s allowed from s-2 when ext[s] != blank and
+        # ext[s] != ext[s-2]
+        ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=blank)[:, :S]
+        allow_skip = (ext != blank) & (ext != ext_m2)
+        pos = jnp.arange(S)[None]                       # [1, S]
+        valid_s = pos < (2 * lab_len[:, None] + 1)      # states in range
+
+        alpha0 = jnp.full((B, S), NEG, jnp.float32)
+        alpha0 = alpha0.at[:, 0].set(lp_ext[0, :, 0].astype(jnp.float32))
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, lp_ext[0, :, 1].astype(jnp.float32),
+                      NEG))
+
+        def lse(*xs):
+            stacked = jnp.stack(xs)
+            m = jnp.max(stacked, 0)
+            return m + jnp.log(jnp.sum(jnp.exp(stacked - m), 0))
+
+        def step(alpha, inp):
+            lp_t, t = inp
+            a1 = alpha
+            a2 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                         constant_values=NEG)[:, :S]
+            a3 = jnp.where(allow_skip,
+                           jnp.pad(alpha, ((0, 0), (2, 0)),
+                                   constant_values=NEG)[:, :S], NEG)
+            new = lse(a1, a2, a3) + lp_t.astype(jnp.float32)
+            new = jnp.where(valid_s, new, NEG)
+            # rows past their input length keep their final alpha
+            new = jnp.where((t < in_len)[:, None], new, alpha)
+            return new, None
+
+        alpha, _ = jax.lax.scan(step, alpha0,
+                                (lp_ext[1:], jnp.arange(1, T)))
+        # nll = -log(alpha[last blank] + alpha[last label])
+        sB = 2 * lab_len                                 # index of last blank
+        a_last = jnp.take_along_axis(alpha, sB[:, None], 1)[:, 0]
+        a_prev = jnp.take_along_axis(
+            alpha, jnp.maximum(sB - 1, 0)[:, None], 1)[:, 0]
+        a_prev = jnp.where(lab_len > 0, a_prev, NEG)
+        nll = -lse(a_last, a_prev)
+        if norm_by_times:
+            nll = nll / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            return jnp.mean(
+                nll / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return run_op("ctc_loss", impl,
+                  (log_probs, labels, input_lengths, label_lengths), {})

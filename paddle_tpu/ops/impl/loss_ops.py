@@ -1,0 +1,84 @@
+"""Loss-op tail (reference phi/ops/yaml/ops.yaml loss entries).
+
+Pure jnp; the nn.functional layer may wrap these with reduction plumbing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def huber_loss(input, label, delta=1.0):
+    r = input - label
+    a = jnp.abs(r)
+    return jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
+
+
+def kldiv_loss(x, label, reduction="mean", log_target=False):
+    if log_target:
+        out = jnp.exp(label) * (label - x)
+    else:
+        out = label * (jnp.log(jnp.clip(label, 1e-12)) - x)
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "batchmean":
+        return jnp.sum(out) / x.shape[0]
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def hinge_loss(logits, labels):
+    return jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return -label * jnp.log(input + epsilon) \
+        - (1.0 - label) * jnp.log(1.0 - input + epsilon)
+
+
+def bce_loss(input, label):
+    eps = 1e-12
+    return -(label * jnp.log(jnp.clip(input, eps))
+             + (1.0 - label) * jnp.log(jnp.clip(1.0 - input, eps)))
+
+
+def sigmoid_cross_entropy_with_logits(x, label, normalize=False,
+                                      ignore_index=-100):
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = label != ignore_index
+    loss = jnp.where(mask, loss, 0.0)
+    if normalize:
+        loss = loss / jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+    return loss
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1.0 - epsilon) * label + epsilon * prior_dist
+    return (1.0 - epsilon) * label + epsilon / n
+
+
+def identity_loss(x, reduction="none"):
+    if reduction in ("mean", 0):
+        return jnp.mean(x)
+    if reduction in ("sum", 1):
+        return jnp.sum(x)
+    return x
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, return_softmax=False):
+    """ArcFace/CosFace-family margin softmax (reference
+    margin_cross_entropy op, single-rank path)."""
+    onehot = jax.nn.one_hot(label, logits.shape[-1], dtype=logits.dtype)
+    theta = jnp.arccos(jnp.clip(logits, -1.0 + 1e-7, 1.0 - 1e-7))
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    adj = jnp.where(onehot > 0, target, logits) * scale
+    logp = jax.nn.log_softmax(adj, axis=-1)
+    loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss

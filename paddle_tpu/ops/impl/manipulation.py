@@ -547,3 +547,67 @@ def bucketize(x, sorted_sequence, out_int32=False, right=False):
 
 def tolist(x):
     return np.asarray(x).tolist()
+
+
+# ---- round-2 op tail ----
+def reverse(x, axis):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return jnp.flip(x, axis=ax)
+
+
+def unstack(x, axis=0, num=None):
+    n = num or x.shape[axis]
+    return tuple(jnp.squeeze(a, axis) for a in jnp.split(x, n, axis=axis))
+
+
+def split_with_num(x, num, axis=0):
+    return tuple(jnp.split(x, num, axis=axis))
+
+
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def view_dtype(x, dtype):
+    from ...core.dtypes import canonical_dtype
+    return x.view(canonical_dtype(dtype)) if hasattr(x, "view") else \
+        jax.lax.bitcast_convert_type(x, canonical_dtype(dtype))
+
+
+def view_shape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def tensor_unfold(x, axis, size, step):
+    idx = jnp.arange(0, x.shape[axis] - size + 1, step)
+    windows = jnp.arange(size)
+    gather = idx[:, None] + windows[None, :]          # [n, size]
+    moved = jnp.moveaxis(x, axis, 0)[gather]          # [n, size, ...rest]
+    out = jnp.moveaxis(moved, 1, -1)                  # size to the end
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_select_strided(x, index, axis=0):
+    return jnp.take(x, jnp.asarray(index).astype(jnp.int32), axis=axis)
+
+
+def repeat_interleave_with_tensor_index(x, repeats, axis=0):
+    """Per-element repeat counts (static total required under jit; eager
+    computes the concrete total)."""
+    reps = np.asarray(repeats)
+    total = int(reps.sum())
+    idx = np.repeat(np.arange(reps.shape[0]), reps)
+    idx = jnp.asarray(idx[:total])
+    return jnp.take(x, idx, axis=axis)
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    # ceil split, matching reference shard_index_kernel.cc:59
+    per = -(-index_num // nshards)
+    lo = shard_id * per
+    inside = (x >= lo) & (x < lo + per)
+    return jnp.where(inside, x - lo, ignore_value)

@@ -485,3 +485,93 @@ def bitwise_left_shift(x, y):
 
 def bitwise_right_shift(x, y):
     return jnp.right_shift(x, y)
+
+
+# ---- round-2 op tail (reference phi/ops/yaml/ops.yaml parity) ----
+def gammaln(x):
+    from jax.scipy.special import gammaln as _g
+    return _g(x)
+
+
+def gammaincc(x, y):
+    from jax.scipy.special import gammaincc as _g
+    return _g(x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def renorm(x, p, axis, max_norm):
+    axis = axis % x.ndim
+    norms = jnp.sum(jnp.abs(x) ** p, axis=tuple(
+        i for i in range(x.ndim) if i != axis), keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def reduce_as(x, target):
+    """Sum x down to target's shape (reference reduce_as op)."""
+    tshape = jnp.shape(target)
+    extra = x.ndim - len(tshape)
+    axes = tuple(range(extra)) + tuple(
+        extra + i for i, (a, b) in enumerate(
+            zip(x.shape[extra:], tshape)) if a != b)
+    out = jnp.sum(x, axis=axes, keepdims=False)
+    return out.reshape(tshape)
+
+
+def frobenius_norm(x, axis=None, keepdim=False):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdim))
+
+
+def p_norm(x, porder=2.0, axis=None, epsilon=1e-12, keepdim=False,
+           asvector=False):
+    if asvector or axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if porder == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if porder == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** porder, axis=axis,
+                   keepdims=keepdim) ** (1.0 / porder)
+
+
+def squared_l2_norm(x):
+    return jnp.sum(jnp.square(x)).reshape(1)
+
+
+def l1_norm(x):
+    return jnp.sum(jnp.abs(x))
+
+
+def mean_all(x):
+    return jnp.mean(x)
+
+
+def increment(x, value=1.0):
+    return x + jnp.asarray(value, x.dtype)
+
+
+def fill(x, value):
+    return jnp.full_like(x, value)
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    xm = jnp.moveaxis(x, (dim1, dim2), (-2, -1))
+    rows, cols = xm.shape[-2], xm.shape[-1]
+    # diagonal length per reference fill_diagonal_tensor_kernel.cc
+    # CalMatDims: offset>=0 -> min(rows, cols-offset); else min(rows+offset,
+    # cols)
+    if offset >= 0:
+        n = min(rows, cols - offset)
+        r = jnp.arange(n)
+        c = r + offset
+    else:
+        n = min(rows + offset, cols)
+        c = jnp.arange(n)
+        r = c - offset
+    xm = xm.at[..., r, c].set(jnp.asarray(y))
+    return jnp.moveaxis(xm, (-2, -1), (dim1, dim2))

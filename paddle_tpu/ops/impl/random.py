@@ -138,3 +138,25 @@ def dirichlet(key, alpha):
 def gumbel(key, shape, dtype=None):
     dtype = _dt.canonical_dtype(dtype) or _dt.default_float_dtype()
     return jax.random.gumbel(key, _shape(shape), dtype)
+
+
+# ---- round-2 op tail ----
+def gaussian(key, shape, mean=0.0, std=1.0, dtype=None):
+    dt = _dt.canonical_dtype(dtype) or _dt.default_float_dtype()
+    return mean + std * jax.random.normal(key, _shape(shape), dt)
+
+
+def standard_gamma(key, x):
+    return jax.random.gamma(key, jnp.asarray(x))
+
+
+def truncated_gaussian_random(key, shape, mean=0.0, std=1.0, a=-2.0, b=2.0,
+                              dtype=None):
+    dt = _dt.canonical_dtype(dtype) or _dt.default_float_dtype()
+    return mean + std * jax.random.truncated_normal(key, a, b, _shape(shape),
+                                                    dt)
+
+
+def exponential_(key, x, lam=1.0):
+    return jax.random.exponential(key, jnp.shape(x),
+                                  jnp.asarray(x).dtype) / lam

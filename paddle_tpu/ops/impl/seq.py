@@ -1,0 +1,166 @@
+"""Sequence/decoding op tail: gather_tree, edit_distance, top_p_sampling,
+max-pool-with-index family (reference phi kernels of the same names)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference gather_tree op).
+
+    ids/parents: [T, B, beam] — walk parents from the last step backward so
+    each beam's full path is materialized."""
+    T = ids.shape[0]
+
+    def step(carry, t):
+        beams = carry                                   # [B, beam]
+        out = jnp.take_along_axis(ids[t], beams, axis=-1)
+        parent = jnp.take_along_axis(parents[t], beams, axis=-1)
+        return parent, out
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2])[None],
+                            ids.shape[1:]).astype(ids.dtype)
+    _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return outs[::-1]
+
+
+def edit_distance(hyps, refs, hyp_lengths=None, ref_lengths=None,
+                  normalized=True):
+    """Levenshtein distance, batched DP over the (static) length grid."""
+    B, Lh = hyps.shape
+    Lr = refs.shape[1]
+    hl = (hyp_lengths if hyp_lengths is not None
+          else jnp.full((B,), Lh)).astype(jnp.int32)
+    rl = (ref_lengths if ref_lengths is not None
+          else jnp.full((B,), Lr)).astype(jnp.int32)
+
+    # dp over ref prefix length; row i of the DP table via scan over hyps
+    row0 = jnp.broadcast_to(jnp.arange(Lr + 1, dtype=jnp.float32)[None],
+                            (B, Lr + 1))
+
+    def outer(row, i):
+        tok = hyps[:, i]                                 # [B]
+        sub_cost = (refs != tok[:, None]).astype(jnp.float32)  # [B, Lr]
+
+        def inner(carry, j):
+            left = carry                                 # dp[i+1][j]
+            diag = row[:, j] + sub_cost[:, j]
+            up = row[:, j + 1] + 1.0
+            val = jnp.minimum(jnp.minimum(left + 1.0, up), diag)
+            return val, val
+
+        first = row[:, 0] + 1.0
+        _, rest = jax.lax.scan(inner, first, jnp.arange(Lr))
+        new_row = jnp.concatenate([first[None], rest], axis=0).T  # [B,Lr+1]
+        # rows beyond the hyp length keep the previous row
+        return jnp.where((i < hl)[:, None], new_row, row), None
+
+    row, _ = jax.lax.scan(outer, row0, jnp.arange(Lh))
+    dist = jnp.take_along_axis(row, rl[:, None], axis=1)[:, 0]
+    seq_num = jnp.asarray(B)
+    if normalized:
+        dist = dist / jnp.maximum(rl.astype(jnp.float32), 1.0)
+    return dist, seq_num
+
+
+def top_p_sampling(key, x, ps, threshold=None, seed=None):
+    """Nucleus sampling over probability rows (reference top_p_sampling op).
+    x: [B, V] probabilities; ps: [B] or scalar cumulative threshold.
+    Returns (out_ids [B, 1], out_probs [B, 1])."""
+    ps = jnp.broadcast_to(jnp.asarray(ps).reshape(-1), (x.shape[0],))
+    order = jnp.argsort(-x, axis=-1)
+    sorted_p = jnp.take_along_axis(x, order, axis=-1)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    keep = cum - sorted_p < ps[:, None]     # smallest prefix reaching ps
+    keep = keep.at[:, 0].set(True)
+    filt = jnp.where(keep, sorted_p, 0.0)
+    filt = filt / jnp.sum(filt, axis=-1, keepdims=True)
+    choice = jax.random.categorical(key, jnp.log(jnp.clip(filt, 1e-30)),
+                                    axis=-1)
+    ids = jnp.take_along_axis(order, choice[:, None], axis=-1)
+    probs = jnp.take_along_axis(x, ids, axis=-1)
+    return ids, probs
+
+
+def _pool_patches(x, ksize, stride, padding):
+    """Extract pooling windows: [N, C, Ho, Wo, kh*kw] via gather."""
+    n, c, h, w = x.shape
+    kh, kw = ksize
+    sh, sw = stride
+    ph, pw = padding
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=-jnp.inf)
+    ho = (h + 2 * ph - kh) // sh + 1
+    wo = (w + 2 * pw - kw) // sw + 1
+    iy = (jnp.arange(ho) * sh)[:, None] + jnp.arange(kh)[None]   # [Ho, kh]
+    ix = (jnp.arange(wo) * sw)[:, None] + jnp.arange(kw)[None]   # [Wo, kw]
+    patches = xp[:, :, iy[:, None, :, None], ix[None, :, None, :]]
+    # -> [N, C, Ho, Wo, kh, kw]
+    return patches.reshape(n, c, ho, wo, kh * kw), (ho, wo), (iy, ix)
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False):
+    """Returns (out, indices) with indices FLAT over the input H*W plane
+    (reference max_pool2d_with_index semantics)."""
+    if adaptive:
+        raise NotImplementedError("adaptive max_pool_with_index")
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    if global_pooling:
+        ks = x.shape[2:]
+    st = ks if stride is None else ((stride, stride)
+                                    if isinstance(stride, int)
+                                    else tuple(stride))
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    n, c, h, w = x.shape
+    patches, (ho, wo), (iy, ix) = _pool_patches(x, ks, st, pd)
+    arg = jnp.argmax(patches, axis=-1)                # [N, C, Ho, Wo]
+    out = jnp.max(patches, axis=-1)
+    ky = arg // ks[1]
+    kx = arg % ks[1]
+    src_y = (jnp.arange(ho) * st[0])[None, None, :, None] + ky - pd[0]
+    src_x = (jnp.arange(wo) * st[1])[None, None, None, :] + kx - pd[1]
+    flat = jnp.clip(src_y, 0, h - 1) * w + jnp.clip(src_x, 0, w - 1)
+    return out, flat.astype(jnp.int32)
+
+
+def unpool(x, indices, ksize=None, strides=None, paddings=None,
+           output_size=None):
+    """Max-unpool 2D using flat indices from max_pool2d_with_index."""
+    n, c, ho, wo = x.shape
+    if output_size is not None:
+        h, w = int(output_size[-2]), int(output_size[-1])
+    else:
+        st = strides or ksize
+        h = ho * (st[0] if isinstance(st, (tuple, list)) else st)
+        w = wo * (st[1] if isinstance(st, (tuple, list)) else st)
+    out = jnp.zeros((n, c, h * w), x.dtype)
+    flat_idx = indices.reshape(n, c, ho * wo)
+    vals = x.reshape(n, c, ho * wo)
+    bi = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    out = out.at[bi, ci, flat_idx].add(vals)
+    return out.reshape(n, c, h, w)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW"):
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else ((stride, stride)
+                                    if isinstance(stride, int)
+                                    else tuple(stride))
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    patches, _, _ = _pool_patches(x, ks, st, pd)
+    patches = jnp.where(jnp.isfinite(patches), patches, 0.0)
+    p = float(norm_type)
+    out = jnp.sum(jnp.abs(patches) ** p, axis=-1) ** (1.0 / p)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out

@@ -1,0 +1,154 @@
+"""Vision-op tail (reference phi/kernels: grid_sample, affine_grid,
+pixel/channel shuffle, temporal_shift, nms).  Pure jnp/lax — gather-based
+sampling vectorizes straight onto the VPU; nms is a lax.fori_loop over a
+static box count.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _grid_sample_2d(x, grid, align_corners=True, padding_mode="zeros"):
+    # x: [N, C, H, W]; grid: [N, Ho, Wo, 2] in [-1, 1] (x, y order)
+    N, C, H, W = x.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+    if align_corners:
+        fx = (gx + 1.0) * 0.5 * (W - 1)
+        fy = (gy + 1.0) * 0.5 * (H - 1)
+    else:
+        fx = ((gx + 1.0) * W - 1.0) * 0.5
+        fy = ((gy + 1.0) * H - 1.0) * 0.5
+    x0 = jnp.floor(fx)
+    y0 = jnp.floor(fy)
+    wx = fx - x0
+    wy = fy - y0
+
+    def gather(yy, xx):
+        inside = (xx >= 0) & (xx < W) & (yy >= 0) & (yy < H)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        batch = jnp.arange(N)[:, None, None]
+        v = x[batch, :, yi, xi]                     # [N, Ho, Wo, C]
+        if padding_mode == "zeros":
+            v = jnp.where(inside[..., None], v, 0.0)
+        return v
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wx_ = wx[..., None]
+    wy_ = wy[..., None]
+    out = (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_)
+           + v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
+    return jnp.moveaxis(out, -1, 1)                 # [N, C, Ho, Wo]
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    if mode == "nearest":
+        # snap to nearest integer source pixel via the bilinear machinery
+        N, C, H, W = x.shape
+        gx = grid[..., 0]
+        gy = grid[..., 1]
+        if align_corners:
+            fx = (gx + 1.0) * 0.5 * (W - 1)
+            fy = (gy + 1.0) * 0.5 * (H - 1)
+        else:
+            fx = ((gx + 1.0) * W - 1.0) * 0.5
+            fy = ((gy + 1.0) * H - 1.0) * 0.5
+        xi = jnp.round(fx)
+        yi = jnp.round(fy)
+        inside = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+        xi = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yi = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        batch = jnp.arange(N)[:, None, None]
+        v = x[batch, :, yi, xi]
+        if padding_mode == "zeros":
+            v = jnp.where(inside[..., None], v, 0.0)
+        return jnp.moveaxis(v, -1, 1)
+    return _grid_sample_2d(x, grid, align_corners, padding_mode)
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """theta: [N, 2, 3]; out_shape: [N, C, H, W] -> grid [N, H, W, 2]."""
+    N = theta.shape[0]
+    H, W = int(out_shape[-2]), int(out_shape[-1])
+    if align_corners:
+        xs = jnp.linspace(-1.0, 1.0, W)
+        ys = jnp.linspace(-1.0, 1.0, H)
+    else:
+        xs = (jnp.arange(W) * 2 + 1) / W - 1.0
+        ys = (jnp.arange(H) * 2 + 1) / H - 1.0
+    gx, gy = jnp.meshgrid(xs, ys)                   # [H, W]
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    grid = jnp.einsum("hwk,njk->nhwj", base, theta.astype(jnp.float32))
+    return grid
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        return x.reshape(n, groups, c // groups, h, w).swapaxes(1, 2) \
+            .reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    return x.reshape(n, h, w, groups, c // groups).swapaxes(3, 4) \
+        .reshape(n, h, w, c)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        return x.transpose(0, 1, 3, 5, 2, 4).reshape(
+            n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    return x.transpose(0, 1, 3, 5, 2, 4).reshape(
+        n, h // r, w // r, c * r * r)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.pad(x5[:, 1:, :fold], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                      (0, 0)))
+    right = jnp.pad(x5[:, :-1, fold:2 * fold],
+                    ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    rest = x5[:, :, 2 * fold:]
+    out = jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+    if data_format != "NCHW":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def nms(boxes, iou_threshold=0.3):
+    """Greedy hard-NMS over [N, 4] (x1, y1, x2, y2) boxes sorted by the
+    caller's score order; returns keep mask [N] (static shape — callers
+    boolean-index eagerly or mask under jit)."""
+    N = boxes.shape[0]
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    areas = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    iou = inter / jnp.maximum(areas[:, None] + areas[None, :] - inter,
+                              1e-10)
+
+    def body(i, keep):
+        # drop i if it overlaps any kept earlier box
+        earlier = (jnp.arange(N) < i) & keep
+        sup = jnp.any(earlier & (iou[i] > iou_threshold))
+        return keep.at[i].set(~sup)
+
+    return jax.lax.fori_loop(1, N, body, jnp.ones((N,), bool))

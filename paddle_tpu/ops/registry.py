@@ -35,6 +35,8 @@ class OpDef:
     inplace: bool = False
     diff: bool = True
     rng: bool = False
+    nojit: bool = False   # output shape depends on input VALUES: run the
+    #                       impl eagerly (no per-op jit cache)
     alias: List[str] = field(default_factory=list)
     fn: Optional[Callable] = None  # resolved public wrapper
 
@@ -64,11 +66,12 @@ def _make_wrapper(op: OpDef, raw: Callable) -> Callable:
             if key is None:
                 key = next_rng_key()
             return run_op(op.name, raw, (key,) + args, kwargs,
-                          differentiable=op.diff)
+                          differentiable=op.diff, jit=not op.nojit)
     else:
         @functools.wraps(raw)
         def wrapper(*args, **kwargs):
-            return run_op(op.name, raw, args, kwargs, differentiable=op.diff)
+            return run_op(op.name, raw, args, kwargs,
+                          differentiable=op.diff, jit=not op.nojit)
     wrapper.__name__ = op.name
     wrapper.__qualname__ = op.name
     wrapper.raw = raw
@@ -97,7 +100,8 @@ def load_registry() -> Dict[str, OpDef]:
     for e in entries:
         op = OpDef(name=e["op"], impl=e["impl"], method=e.get("method", False),
                    inplace=e.get("inplace", False), diff=e.get("diff", True),
-                   rng=e.get("rng", False), alias=e.get("alias", []))
+                   rng=e.get("rng", False), nojit=e.get("nojit", False),
+                   alias=e.get("alias", []))
         raw = _resolve_impl(op.impl)
         op.fn = _make_wrapper(op, raw)
         _REGISTRY[op.name] = op

@@ -5,6 +5,8 @@ import jax
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as pt
 from paddle_tpu import parallel as dist
 from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step

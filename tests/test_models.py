@@ -8,6 +8,8 @@ grad flow, and the compiled hybrid train step on the 8-device CPU mesh.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as pt
 from paddle_tpu import nn
 from paddle_tpu.models.bert import (BertForPretraining,

@@ -181,3 +181,116 @@ def test_initializers():
     np.testing.assert_allclose(np.asarray(c), 3.0)
     o = np.asarray(I.Orthogonal()((16, 16), np.float32))
     np.testing.assert_allclose(o @ o.T, np.eye(16), atol=1e-4)
+
+
+def test_ctc_loss_matches_torch():
+    """CTC forward algorithm vs torch.nn.functional.ctc_loss (values and
+    input grads) — reference warpctc semantics."""
+    import torch
+    import paddle_tpu as pt
+    from paddle_tpu.nn import functional as F
+
+    rng = np.random.default_rng(0)
+    T, B, C, L = 12, 3, 6, 4
+    logits = rng.normal(size=(T, B, C)).astype(np.float32)
+    labels = rng.integers(1, C, (B, L)).astype(np.int32)
+    in_len = np.array([12, 9, 7], np.int32)
+    lab_len = np.array([4, 3, 2], np.int32)
+
+    lp_np = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+
+    t_lp = torch.tensor(lp_np, requires_grad=True)
+    t_loss = torch.nn.functional.ctc_loss(
+        t_lp, torch.tensor(labels.astype(np.int64)),
+        torch.tensor(in_len.astype(np.int64)),
+        torch.tensor(lab_len.astype(np.int64)), blank=0, reduction="none")
+    # paddle 'none' = per-batch nll (same as torch 'none')
+    got_none = F.ctc_loss(pt.to_tensor(lp_np), pt.to_tensor(labels),
+                          pt.to_tensor(in_len), pt.to_tensor(lab_len),
+                          reduction="none")
+    np.testing.assert_allclose(np.asarray(got_none),
+                               t_loss.detach().numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+    # grads compared at the LOGITS level (torch's ctc backward is defined
+    # w.r.t. log_softmax inputs — the softmax Jacobian is folded in)
+    t_logits = torch.tensor(logits, requires_grad=True)
+    t_loss2 = torch.nn.functional.ctc_loss(
+        torch.nn.functional.log_softmax(t_logits, -1),
+        torch.tensor(labels.astype(np.int64)),
+        torch.tensor(in_len.astype(np.int64)),
+        torch.tensor(lab_len.astype(np.int64)), blank=0, reduction="sum")
+    t_loss2.backward()
+
+    x = pt.to_tensor(logits, stop_gradient=False)
+    loss = F.ctc_loss(F.log_softmax(x, axis=-1), pt.to_tensor(labels),
+                      pt.to_tensor(in_len), pt.to_tensor(lab_len),
+                      reduction="sum")
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(x.grad), t_logits.grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_grouped_conv_transpose_matches_torch():
+    import torch
+    import paddle_tpu as pt
+    from paddle_tpu.nn import functional as F
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 8, 10, 10)).astype(np.float32)
+    w = rng.normal(size=(8, 3, 3, 3)).astype(np.float32)  # groups=2: out 6
+    b = rng.normal(size=(6,)).astype(np.float32)
+    got = np.asarray(F.conv2d_transpose(
+        pt.to_tensor(x), pt.to_tensor(w), bias=pt.to_tensor(b), stride=2,
+        padding=1, groups=2))
+    exp = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=2,
+        padding=1, groups=2).numpy()
+    np.testing.assert_allclose(got, exp, rtol=2e-4, atol=2e-4)
+
+
+def test_grid_sample_matches_torch():
+    import torch
+    import paddle_tpu as pt
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    grid = np.clip(rng.normal(size=(2, 5, 5, 2)) * 0.5, -1, 1).astype(
+        np.float32)
+    for align in (True, False):
+        got = np.asarray(pt.grid_sample(pt.to_tensor(x),
+                                        pt.to_tensor(grid),
+                                        align_corners=align))
+        exp = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), mode="bilinear",
+            padding_mode="zeros", align_corners=align).numpy()
+        np.testing.assert_allclose(got, exp, rtol=2e-5, atol=2e-5)
+
+
+def test_edit_distance_reference():
+    import paddle_tpu as pt
+
+    hyp = np.array([[1, 2, 3, 4, 0]], np.int64)
+    ref = np.array([[1, 3, 3, 5, 6]], np.int64)
+    d, n = pt.edit_distance(pt.to_tensor(hyp), pt.to_tensor(ref),
+                            pt.to_tensor(np.array([4])),
+                            pt.to_tensor(np.array([5])), normalized=False)
+    # hyp [1,2,3,4] vs ref [1,3,3,5,6]: sub 2->3, sub 4->5, ins 6 = 3 edits
+    assert float(np.asarray(d)[0]) == 3.0
+
+
+def test_max_pool_with_index_unpool_roundtrip():
+    import torch
+    import paddle_tpu as pt
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    out, idx = pt.max_pool2d_with_index(pt.to_tensor(x), 2)
+    t_out, t_idx = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, return_indices=True)
+    np.testing.assert_allclose(np.asarray(out), t_out.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idx), t_idx.numpy())
+    un = np.asarray(pt.unpool(out, idx, ksize=(2, 2),
+                              output_size=(2, 3, 8, 8)))
+    t_un = torch.nn.functional.max_unpool2d(t_out, t_idx, 2).numpy()
+    np.testing.assert_allclose(un, t_un, rtol=1e-6)

@@ -4,6 +4,8 @@ test/legacy_test/op_test.py — forward vs numpy + numeric grad check)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as pt
 from op_test import check_grad, check_output
 

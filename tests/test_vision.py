@@ -4,6 +4,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as pt
 from paddle_tpu.vision import models, transforms
 from paddle_tpu.vision.datasets import FakeData
