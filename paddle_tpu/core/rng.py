@@ -22,6 +22,7 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = [
     "seed", "Generator", "default_generator", "next_rng_key", "rng_scope",
@@ -30,29 +31,39 @@ __all__ = [
 
 
 class Generator:
-    """Stateful key holder; each draw splits the key."""
+    """Stateful key source.
+
+    State is (seed, draw counter) — plain Python ints; each draw derives
+    ``fold_in(key(seed), counter)``.  Keeping the state off-device means a
+    draw that happens to run under a jit trace (an op impl delegating to a
+    keyed kernel) can never leak a tracer into global state — the traced
+    fold_in result stays local to the trace."""
 
     def __init__(self, seed: int = 0):
-        self._key = jax.random.key(seed)
-        self._seed = seed
+        self._seed = int(seed) % (2 ** 63)   # key() wants a non-neg int64
+        self._count = 0
         self._lock = threading.Lock()
 
     def manual_seed(self, seed: int) -> "Generator":
         with self._lock:
-            self._seed = seed
-            self._key = jax.random.key(seed)
+            self._seed = int(seed) % (2 ** 63)
+            self._count = 0
         return self
 
     def split(self) -> jax.Array:
         with self._lock:
-            self._key, sub = jax.random.split(self._key)
-            return sub
+            self._count += 1
+            c = self._count
+        return jax.random.fold_in(jax.random.key(self._seed), c)
 
     def get_state(self):
-        return jax.random.key_data(self._key)
+        return np.asarray([self._seed, self._count], np.uint64)
 
     def set_state(self, state) -> None:
-        self._key = jax.random.wrap_key_data(jnp.asarray(state))
+        s = np.asarray(state).ravel()
+        with self._lock:
+            self._seed = int(s[0]) % (2 ** 63)
+            self._count = int(s[1])
 
     @property
     def initial_seed(self) -> int:

@@ -37,9 +37,26 @@ def _pads(padding, n, channel_last, x_ndim):
     return [(0, 0), (0, 0)] + spatial
 
 
-def _maxpool(x, ksize, stride, padding, n, channel_last, return_mask=False):
+def _apply_ceil(pads, x_shape, ksize, stride, n, channel_last):
+    """ceil_mode: grow the hi padding so reduce_window's floor-division
+    output size equals the reference's pure ceil division
+    (phi/kernels/funcs/pooling.h:501 PoolOutputSize)."""
+    axes = range(1, 1 + n) if channel_last else range(2, 2 + n)
+    for (k, s, ax) in zip(ksize, stride, axes):
+        lo, hi = pads[ax]
+        size = x_shape[ax]
+        out = -(-(size + lo + hi - k) // s) + 1     # ceil
+        extra = max(0, (out - 1) * s + k - size - lo - hi)
+        pads[ax] = (lo, hi + extra)
+    return pads
+
+
+def _maxpool(x, ksize, stride, padding, n, channel_last, return_mask=False,
+             ceil_mode=False):
     dims, strides = _window(x.ndim, ksize, stride, n, channel_last)
     pads = _pads(padding, n, channel_last, x.ndim)
+    if ceil_mode:
+        pads = _apply_ceil(pads, x.shape, ksize, stride, n, channel_last)
     # -inf identity keeps reduce_window on JAX's differentiable max-pool path
     neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
            else jnp.iinfo(x.dtype).min)
@@ -62,15 +79,22 @@ def _maxpool(x, ksize, stride, padding, n, channel_last, return_mask=False):
         take_b = bv > av
         return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
 
+    # stop_gradient severs the variadic reduce_window from the autodiff
+    # graph (its transpose chokes on the symbolic-zero index cotangent);
+    # grads flow through the plain max reduce_window above
     _, indices = jax.lax.reduce_window(
-        (x, idx), (jnp.asarray(neg, x.dtype), jnp.asarray(-1, idx.dtype)),
+        (jax.lax.stop_gradient(x), idx),
+        (jnp.asarray(neg, x.dtype), jnp.asarray(-1, idx.dtype)),
         reducer, dims, strides, pads)
     return out, indices
 
 
-def _avgpool(x, ksize, stride, padding, n, channel_last, exclusive=True):
+def _avgpool(x, ksize, stride, padding, n, channel_last, exclusive=True,
+             ceil_mode=False):
     dims, strides = _window(x.ndim, ksize, stride, n, channel_last)
     pads = _pads(padding, n, channel_last, x.ndim)
+    if ceil_mode:
+        pads = _apply_ceil(pads, x.shape, ksize, stride, n, channel_last)
     summed = jax.lax.reduce_window(x, jnp.asarray(0, x.dtype), jax.lax.add,
                                    dims, strides, pads)
     if exclusive and any(p[0] or p[1] for p in pads):
@@ -86,7 +110,8 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     ks = _tup(kernel_size, 1)
     st = _tup(stride if stride is not None else kernel_size, 1)
     return run_op("max_pool1d", lambda x: _maxpool(
-        x, ks, st, padding, 1, data_format == "NLC", return_mask), (x,), {})
+        x, ks, st, padding, 1, data_format == "NLC", return_mask,
+        ceil_mode), (x,), {})
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -94,7 +119,8 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
     ks = _tup(kernel_size, 2)
     st = _tup(stride if stride is not None else kernel_size, 2)
     return run_op("max_pool2d", lambda x: _maxpool(
-        x, ks, st, padding, 2, data_format == "NHWC", return_mask), (x,), {})
+        x, ks, st, padding, 2, data_format == "NHWC", return_mask,
+        ceil_mode), (x,), {})
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -102,7 +128,8 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
     ks = _tup(kernel_size, 3)
     st = _tup(stride if stride is not None else kernel_size, 3)
     return run_op("max_pool3d", lambda x: _maxpool(
-        x, ks, st, padding, 3, data_format == "NDHWC", return_mask), (x,), {})
+        x, ks, st, padding, 3, data_format == "NDHWC", return_mask,
+        ceil_mode), (x,), {})
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -110,7 +137,8 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
     ks = _tup(kernel_size, 1)
     st = _tup(stride if stride is not None else kernel_size, 1)
     return run_op("avg_pool1d", lambda x: _avgpool(
-        x, ks, st, padding, 1, data_format == "NLC", exclusive), (x,), {})
+        x, ks, st, padding, 1, data_format == "NLC", exclusive,
+        ceil_mode), (x,), {})
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -118,7 +146,8 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
     ks = _tup(kernel_size, 2)
     st = _tup(stride if stride is not None else kernel_size, 2)
     return run_op("avg_pool2d", lambda x: _avgpool(
-        x, ks, st, padding, 2, data_format == "NHWC", exclusive), (x,), {})
+        x, ks, st, padding, 2, data_format == "NHWC", exclusive,
+        ceil_mode), (x,), {})
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -126,7 +155,8 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
     ks = _tup(kernel_size, 3)
     st = _tup(stride if stride is not None else kernel_size, 3)
     return run_op("avg_pool3d", lambda x: _avgpool(
-        x, ks, st, padding, 3, data_format == "NDHWC", exclusive), (x,), {})
+        x, ks, st, padding, 3, data_format == "NDHWC", exclusive,
+        ceil_mode), (x,), {})
 
 
 def _adaptive_windows(in_size, out_size):

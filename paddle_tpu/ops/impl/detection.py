@@ -1,0 +1,530 @@
+"""Detection-op family (reference phi/kernels: roi_align, roi_pool,
+psroi_pool, box_coder, box_clip, prior_box, yolo_box, matrix_nms,
+bipartite_match, deformable_conv; Python API python/paddle/vision/ops.py).
+
+TPU-first: everything is gather/mask vectorized — per-ROI work is a
+static-shape einsum/reduce over the full feature map (masked) or a fixed
+bilinear sampling grid, so XLA tiles it onto the VPU/MXU with no dynamic
+shapes.  Ops whose output length is data-dependent (matrix_nms) run eagerly
+(nojit) and return dense numpy, matching the reference's LoD outputs with a
+(kept, index, rois_num) triple.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------- box_coder
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0):
+    """Center-size box encode/decode (reference
+    phi/kernels/impl/box_coder.h, python/paddle/vision/ops.py:584)."""
+    pb = jnp.asarray(prior_box)
+    tb = jnp.asarray(target_box)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[..., 2] - pb[..., 0] + norm
+    ph = pb[..., 3] - pb[..., 1] + norm
+    px = pb[..., 0] + pw * 0.5
+    py = pb[..., 1] + ph * 0.5
+
+    if prior_box_var is None:
+        var = jnp.ones((4,), pb.dtype)
+    else:
+        var = jnp.asarray(prior_box_var, pb.dtype)
+
+    if code_type == "encode_center_size":
+        # tb: [N,4] targets vs pb: [M,4] priors -> [N,M,4]
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tx = tb[:, 0] + tw * 0.5
+        ty = tb[:, 1] + th * 0.5
+        ox = (tx[:, None] - px[None, :]) / pw[None, :]
+        oy = (ty[:, None] - py[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        if var.ndim == 1:
+            out = out / var
+        else:
+            out = out / var[None, :, :]
+        return out
+    if code_type != "decode_center_size":
+        raise ValueError(f"box_coder: unknown code_type {code_type!r}")
+    # decode: tb [N,M,4]; pb [N,4] (axis=0, broadcast over M) or
+    # [M,4] (axis=1, broadcast over N)
+    exp = (slice(None), None) if axis == 0 else (None, slice(None))
+    px, py, pw, ph = (v[exp] for v in (px, py, pw, ph))
+    if var.ndim == 1:
+        v0, v1, v2, v3 = var[0], var[1], var[2], var[3]
+    else:
+        v = var[exp + (slice(None),)]
+        v0, v1, v2, v3 = v[..., 0], v[..., 1], v[..., 2], v[..., 3]
+    ox = v0 * tb[..., 0] * pw + px
+    oy = v1 * tb[..., 1] * ph + py
+    ow = jnp.exp(v2 * tb[..., 2]) * pw
+    oh = jnp.exp(v3 * tb[..., 3]) * ph
+    return jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
+                      ox + ow * 0.5 - norm, oy + oh * 0.5 - norm], axis=-1)
+
+
+# ----------------------------------------------------------------- box_clip
+def box_clip(input, im_info):
+    """Clip boxes to image bounds (reference phi/kernels/box_clip_kernel).
+    im_info rows are (height, width, scale); boxes are in the scaled image."""
+    b = jnp.asarray(input)
+    info = jnp.asarray(im_info, b.dtype)
+    # accept [M,4] boxes with a single-row im_info, or [N,M,4] with [N,3]
+    squeeze = b.ndim == 2
+    if squeeze:
+        b = b[None]
+        info = info.reshape(1, -1)
+    hmax = info[:, 0] / info[:, 2] - 1.0
+    wmax = info[:, 1] / info[:, 2] - 1.0
+    x = jnp.clip(b[..., 0::2], 0.0, wmax[:, None, None])
+    y = jnp.clip(b[..., 1::2], 0.0, hmax[:, None, None])
+    out = jnp.stack([x[..., 0], y[..., 0], x[..., 1], y[..., 1]], axis=-1)
+    return out[0] if squeeze else out
+
+
+# ---------------------------------------------------------------- prior_box
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variances=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False):
+    """SSD prior boxes (reference phi/kernels/prior_box_kernel,
+    python/paddle/vision/ops.py:438).  Returns (boxes, vars) each
+    [H, W, num_priors, 4]."""
+    _, _, H, W = input.shape
+    _, _, imH, imW = image.shape
+    ratios = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - r) < 1e-6 for r in ratios):
+            ratios.append(float(ar))
+            if flip:
+                ratios.append(1.0 / float(ar))
+    min_sizes = [float(m) for m in np.atleast_1d(min_sizes)]
+    max_sizes = [float(m) for m in np.atleast_1d(max_sizes)] if max_sizes \
+        else []
+    step_w = steps[0] or imW / W
+    step_h = steps[1] or imH / H
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)          # [H, W]
+
+    whs = []
+    for k, ms in enumerate(min_sizes):
+        box_ar = []
+        for ar in ratios:
+            box_ar.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if min_max_aspect_ratios_order:
+            # (min, sqrt(min*max), then remaining ratios) reference order
+            ordered = [box_ar[0]]
+            if max_sizes:
+                mx = max_sizes[k]
+                ordered.append((np.sqrt(ms * mx),) * 2)
+            ordered += box_ar[1:]
+            whs += ordered
+        else:
+            whs += box_ar
+            if max_sizes:
+                mx = max_sizes[k]
+                whs.append((np.sqrt(ms * mx),) * 2)
+    wh = jnp.asarray(whs, jnp.float32)       # [P, 2]
+    P = wh.shape[0]
+
+    bx = jnp.stack([
+        (cxg[..., None] - wh[None, None, :, 0] * 0.5) / imW,
+        (cyg[..., None] - wh[None, None, :, 1] * 0.5) / imH,
+        (cxg[..., None] + wh[None, None, :, 0] * 0.5) / imW,
+        (cyg[..., None] + wh[None, None, :, 1] * 0.5) / imH,
+    ], axis=-1)                               # [H, W, P, 4]
+    if clip:
+        bx = jnp.clip(bx, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, P, 4))
+    return bx, var
+
+
+# ----------------------------------------------------------------- yolo_box
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """YOLOv3 head decode (reference phi/kernels/yolo_box_kernel,
+    ops.yaml:5047).  x: [N, A*(5+C), H, W] -> boxes [N, H*W*A, 4],
+    scores [N, H*W*A, C]."""
+    x = jnp.asarray(x)
+    N, _, H, W = x.shape
+    A = len(anchors) // 2
+    anc = jnp.asarray(anchors, x.dtype).reshape(A, 2)
+    if iou_aware:
+        ious = jax.nn.sigmoid(x[:, :A].reshape(N, A, 1, H, W))
+        x = x[:, A:]
+    x = x.reshape(N, A, 5 + class_num, H, W)
+
+    gx = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    gy = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    bias = 0.5 * (scale_x_y - 1.0)
+    cx = (jax.nn.sigmoid(x[:, :, 0]) * scale_x_y - bias + gx) / W
+    cy = (jax.nn.sigmoid(x[:, :, 1]) * scale_x_y - bias + gy) / H
+    in_w = downsample_ratio * W
+    in_h = downsample_ratio * H
+    bw = jnp.exp(x[:, :, 2]) * anc[None, :, 0, None, None] / in_w
+    bh = jnp.exp(x[:, :, 3]) * anc[None, :, 1, None, None] / in_h
+
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1.0 - iou_aware_factor) \
+            * ious[:, :, 0] ** iou_aware_factor
+    cls = jax.nn.sigmoid(x[:, :, 5:])                 # [N, A, C, H, W]
+    score = conf[:, :, None] * cls
+    keep = conf > conf_thresh
+
+    imh = jnp.asarray(img_size, x.dtype)[:, 0][:, None, None, None]
+    imw = jnp.asarray(img_size, x.dtype)[:, 1][:, None, None, None]
+    x0 = (cx - bw * 0.5) * imw
+    y0 = (cy - bh * 0.5) * imh
+    x1 = (cx + bw * 0.5) * imw
+    y1 = (cy + bh * 0.5) * imh
+    if clip_bbox:
+        x0 = jnp.clip(x0, 0.0, imw - 1)
+        y0 = jnp.clip(y0, 0.0, imh - 1)
+        x1 = jnp.clip(x1, 0.0, imw - 1)
+        y1 = jnp.clip(y1, 0.0, imh - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1)      # [N, A, H, W, 4]
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    score = jnp.where(keep[:, :, None], score, 0.0)
+    # anchor-major flatten (reference yolo_box_kernel: j*H*W + k*W + l)
+    boxes = boxes.reshape(N, A * H * W, 4)
+    score = score.transpose(0, 1, 3, 4, 2).reshape(N, A * H * W, class_num)
+    return boxes, score
+
+
+# ---------------------------------------------------------------- roi_align
+def _roi_batch_index(boxes_num, R):
+    ends = jnp.cumsum(jnp.asarray(boxes_num))
+    return jnp.searchsorted(ends, jnp.arange(R), side="right").astype(
+        jnp.int32)
+
+
+def roi_align(x, boxes, boxes_num, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, aligned=True):
+    """RoIAlign with bilinear sampling (reference
+    phi/kernels/roi_align_kernel, vision/ops.py:1705).  sampling_ratio<=0
+    uses a fixed 2x2 grid per bin (the adaptive ceil(roi/out) of the
+    reference is value-dependent, which would force dynamic shapes)."""
+    x = jnp.asarray(x)
+    b = jnp.asarray(boxes)
+    N, C, H, W = x.shape
+    R = b.shape[0]
+    ph, pw = int(pooled_height), int(pooled_width)
+    s = int(sampling_ratio) if sampling_ratio and sampling_ratio > 0 else 2
+    bidx = _roi_batch_index(boxes_num, R)
+
+    off = 0.5 if aligned else 0.0
+    x0 = b[:, 0] * spatial_scale - off
+    y0 = b[:, 1] * spatial_scale - off
+    rw = b[:, 2] * spatial_scale - off - x0
+    rh = b[:, 3] * spatial_scale - off - y0
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+
+    # sample grid: [ph*s] x [pw*s] fractional positions inside the roi
+    iy = (jnp.arange(ph * s) + 0.5) / s          # in bin-height units
+    ix = (jnp.arange(pw * s) + 0.5) / s
+    sy = y0[:, None] + bin_h[:, None] * iy[None]   # [R, ph*s]
+    sx = x0[:, None] + bin_w[:, None] * ix[None]   # [R, pw*s]
+
+    # gather all (R, ph*s, pw*s) sample points at once
+    yy = jnp.clip(sy, 0.0, H - 1)
+    xx = jnp.clip(sx, 0.0, W - 1)
+    validy = (sy > -1.0) & (sy < H)
+    validx = (sx > -1.0) & (sx < W)
+    yl = jnp.floor(yy).astype(jnp.int32)
+    xl = jnp.floor(xx).astype(jnp.int32)
+    yh = jnp.minimum(yl + 1, H - 1)
+    xh = jnp.minimum(xl + 1, W - 1)
+    wy = (yy - yl)[:, :, None]                   # [R, ph*s, 1]
+    wx = (xx - xl)[:, None, :]                   # [R, 1, pw*s]
+
+    def g(yi, xi):
+        return x[bidx[:, None, None], :, yi[:, :, None], xi[:, None, :]]
+
+    v = (g(yl, xl) * ((1 - wy) * (1 - wx))[..., None]
+         + g(yl, xh) * ((1 - wy) * wx)[..., None]
+         + g(yh, xl) * (wy * (1 - wx))[..., None]
+         + g(yh, xh) * (wy * wx)[..., None])     # [R, ph*s, pw*s, C]
+    v = v * (validy[:, :, None] & validx[:, None, :])[..., None]
+    v = v.reshape(R, ph, s, pw, s, C).mean(axis=(2, 4))
+    return v.transpose(0, 3, 1, 2)               # [R, C, ph, pw]
+
+
+# ----------------------------------------------------------------- roi_pool
+def roi_pool(x, boxes, boxes_num, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    """Exact max RoI pooling (reference phi/kernels/roi_pool_kernel).
+    Vectorized as a masked max over the full H.W map per output bin —
+    static shapes, O(R.ph.pw.HW) VPU work, no dynamic slicing."""
+    x = jnp.asarray(x)
+    b = jnp.asarray(boxes)
+    N, C, H, W = x.shape
+    R = b.shape[0]
+    ph, pw = int(pooled_height), int(pooled_width)
+    bidx = _roi_batch_index(boxes_num, R)
+
+    x0 = jnp.round(b[:, 0] * spatial_scale).astype(jnp.int32)
+    y0 = jnp.round(b[:, 1] * spatial_scale).astype(jnp.int32)
+    x1 = jnp.round(b[:, 2] * spatial_scale).astype(jnp.int32)
+    y1 = jnp.round(b[:, 3] * spatial_scale).astype(jnp.int32)
+    rh = jnp.maximum(y1 - y0 + 1, 1)
+    rw = jnp.maximum(x1 - x0 + 1, 1)
+
+    i = jnp.arange(ph)[None, :]                  # bin row
+    hs = y0[:, None] + jnp.floor(i * rh[:, None] / ph).astype(jnp.int32)
+    he = y0[:, None] + jnp.ceil((i + 1) * rh[:, None] / ph).astype(jnp.int32)
+    j = jnp.arange(pw)[None, :]
+    ws = x0[:, None] + jnp.floor(j * rw[:, None] / pw).astype(jnp.int32)
+    we = x0[:, None] + jnp.ceil((j + 1) * rw[:, None] / pw).astype(jnp.int32)
+
+    rows = jnp.arange(H)[None, None, :]          # [1,1,H]
+    cols = jnp.arange(W)[None, None, :]
+    rmask = (rows >= jnp.clip(hs, 0, H)[:, :, None]) \
+        & (rows < jnp.clip(he, 0, H)[:, :, None])    # [R, ph, H]
+    cmask = (cols >= jnp.clip(ws, 0, W)[:, :, None]) \
+        & (cols < jnp.clip(we, 0, W)[:, :, None])    # [R, pw, W]
+    mask = rmask[:, :, None, :, None] & cmask[:, None, :, None, :]
+    feat = x[bidx]                               # [R, C, H, W]
+    neg = jnp.finfo(x.dtype).min
+    masked = jnp.where(mask[:, None], feat[:, :, None, None], neg)
+    out = masked.max(axis=(-2, -1))              # [R, C, ph, pw]
+    empty = ~mask.any(axis=(-2, -1))             # [R, ph, pw]
+    return jnp.where(empty[:, None], 0.0, out)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Position-sensitive RoI average pooling (reference
+    phi/kernels/psroi_pool_kernel): bin (i,j) reads channel group i*pw+j."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x = jnp.asarray(x)
+    b = jnp.asarray(boxes)
+    N, C, H, W = x.shape
+    R = b.shape[0]
+    assert C % (ph * pw) == 0, "channels must divide ph*pw"
+    Cout = C // (ph * pw)
+    bidx = _roi_batch_index(boxes_num, R)
+
+    x0 = jnp.round(b[:, 0] * spatial_scale)
+    y0 = jnp.round(b[:, 1] * spatial_scale)
+    x1 = jnp.round(b[:, 2] * spatial_scale + 1.0)
+    y1 = jnp.round(b[:, 3] * spatial_scale + 1.0)
+    rw = jnp.maximum(x1 - x0, 0.1)
+    rh = jnp.maximum(y1 - y0, 0.1)
+    bh = rh / ph
+    bw = rw / pw
+
+    i = jnp.arange(ph)[None, :]
+    hs = jnp.floor(y0[:, None] + i * bh[:, None]).astype(jnp.int32)
+    he = jnp.ceil(y0[:, None] + (i + 1) * bh[:, None]).astype(jnp.int32)
+    j = jnp.arange(pw)[None, :]
+    ws = jnp.floor(x0[:, None] + j * bw[:, None]).astype(jnp.int32)
+    we = jnp.ceil(x0[:, None] + (j + 1) * bw[:, None]).astype(jnp.int32)
+
+    rows = jnp.arange(H)[None, None, :]
+    cols = jnp.arange(W)[None, None, :]
+    rmask = (rows >= jnp.clip(hs, 0, H)[:, :, None]) \
+        & (rows < jnp.clip(he, 0, H)[:, :, None])
+    cmask = (cols >= jnp.clip(ws, 0, W)[:, :, None]) \
+        & (cols < jnp.clip(we, 0, W)[:, :, None])
+    mask = (rmask[:, :, None, :, None] & cmask[:, None, :, None, :]
+            ).astype(x.dtype)                    # [R, ph, pw, H, W]
+    feat = x[bidx].reshape(R, ph * pw, Cout, H, W)
+    feat = feat.reshape(R, ph, pw, Cout, H, W)
+    s = jnp.einsum("rijchw,rijhw->rijc", feat, mask)
+    cnt = mask.sum(axis=(-2, -1))
+    out = jnp.where(cnt[..., None] > 0, s / jnp.maximum(cnt[..., None], 1.0),
+                    0.0)
+    return out.transpose(0, 3, 1, 2)             # [R, Cout, ph, pw]
+
+
+# --------------------------------------------------------------- matrix_nms
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=-1, keep_top_k=-1, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True):
+    """SOLOv2 matrix NMS (reference phi/kernels/matrix_nms_kernel,
+    vision/ops.py:2358).  Decay-based soft suppression — no sequential
+    dependence, so it vectorizes; output count is data-dependent so this op
+    runs eagerly (nojit) and returns (out [K,6], index [K], rois_num [N])."""
+    bb = np.asarray(bboxes)     # [N, M, 4]
+    sc = np.asarray(scores)     # [N, C, M]
+    N, M, _ = bb.shape
+    C = sc.shape[1]
+    outs, idxs, nums = [], [], []
+    norm = 0.0 if normalized else 1.0
+    for n in range(N):
+        dets, det_idx = [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            keep = np.nonzero(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])]
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
+            b = bb[n, order]
+            ss = s[order]
+            # IoU matrix (upper triangle: j suppressed by i<j)
+            area = (b[:, 2] - b[:, 0] + norm) * (b[:, 3] - b[:, 1] + norm)
+            xx0 = np.maximum(b[:, None, 0], b[None, :, 0])
+            yy0 = np.maximum(b[:, None, 1], b[None, :, 1])
+            xx1 = np.minimum(b[:, None, 2], b[None, :, 2])
+            yy1 = np.minimum(b[:, None, 3], b[None, :, 3])
+            inter = np.clip(xx1 - xx0 + norm, 0, None) \
+                * np.clip(yy1 - yy0 + norm, 0, None)
+            iou = inter / (area[:, None] + area[None, :] - inter)
+            iou = np.triu(iou, k=1)
+            # comp[i] = suppressor i's own max IoU with boxes above it
+            comp = iou.max(axis=0)
+            if use_gaussian:
+                # reference matrix_nms_kernel: exp((max_iou^2 - iou^2)*sigma)
+                decay = np.exp((comp[:, None] ** 2 - iou ** 2)
+                               * gaussian_sigma)
+            else:
+                decay = (1.0 - iou) / np.maximum(1.0 - comp[:, None], 1e-10)
+            decay = np.where(np.triu(np.ones_like(iou), k=1) > 0,
+                             decay, np.inf).min(axis=0)
+            decay = np.minimum(decay, 1.0)   # reference min_decay starts at 1
+            new_s = ss * decay
+            ok = new_s > post_threshold      # reference drops ds <= thresh
+            for o, v in zip(order[ok], new_s[ok]):
+                dets.append([c, v, *bb[n, o]])
+                det_idx.append(n * M + o)
+        if dets:
+            dets = np.asarray(dets, np.float32)
+            det_idx = np.asarray(det_idx, np.int64)
+            srt = np.argsort(-dets[:, 1])
+            if keep_top_k > 0:
+                srt = srt[:keep_top_k]
+            dets = dets[srt]
+            det_idx = det_idx[srt]
+        else:
+            dets = np.zeros((0, 6), np.float32)
+            det_idx = np.zeros((0,), np.int64)
+        outs.append(dets)
+        idxs.append(det_idx)
+        nums.append(len(dets))
+    return (np.concatenate(outs, axis=0), np.concatenate(idxs, axis=0),
+            np.asarray(nums, np.int32))
+
+
+# ---------------------------------------------------------- bipartite_match
+def bipartite_match(dist_mat, match_type="bipartite", dist_threshold=0.5):
+    """Greedy bipartite matching (reference
+    phi/kernels/bipartite_match_kernel): repeatedly take the global max of
+    the [N_rows, N_cols] distance matrix; optional per_prediction argmax
+    backfill.  Returns (match_indices [1, N_cols], match_dist [1, N_cols]).
+    Output values are data-dependent but shapes are static; runs eagerly for
+    the sequential greedy loop."""
+    d = np.array(dist_mat, np.float32, copy=True)
+    if d.ndim == 3:     # batched LoD form: process each independently
+        outs = [bipartite_match(d[i], match_type, dist_threshold)
+                for i in range(d.shape[0])]
+        return (np.concatenate([o[0] for o in outs]),
+                np.concatenate([o[1] for o in outs]))
+    rows, cols = d.shape
+    midx = np.full((cols,), -1, np.int64)
+    mdist = np.zeros((cols,), np.float32)
+    work = d.copy()
+    for _ in range(min(rows, cols)):
+        r, c = np.unravel_index(np.argmax(work), work.shape)
+        if work[r, c] <= 0:
+            break
+        midx[c] = r
+        mdist[c] = work[r, c]
+        work[r, :] = -1.0
+        work[:, c] = -1.0
+    if match_type == "per_prediction":
+        thr = dist_threshold
+        for c in range(cols):
+            if midx[c] == -1:
+                r = int(np.argmax(d[:, c]))
+                if d[r, c] >= thr:
+                    midx[c] = r
+                    mdist[c] = d[r, c]
+    return midx[None, :], mdist[None, :]
+
+
+# ---------------------------------------------------------- deformable_conv
+def deformable_conv(x, offset, weight, mask=None, stride=(1, 1),
+                    padding=(0, 0), dilation=(1, 1), deformable_groups=1,
+                    groups=1):
+    """Deformable conv v1/v2 (reference phi/kernels/deformable_conv_kernel,
+    vision/ops.py deform_conv2d).  Implemented as bilinear gather per static
+    kernel tap -> modulated im2col -> one big einsum on the MXU; the
+    kh*kw loop is a trace-time Python loop over static taps."""
+    x = jnp.asarray(x)
+    off = jnp.asarray(offset)
+    w = jnp.asarray(weight)
+    N, Cin, H, W = x.shape
+    Cout, Cin_g, kh, kw = w.shape
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph_, pw_ = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    Ho = (H + 2 * ph_ - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw_ - dw * (kw - 1) - 1) // sw + 1
+    G = deformable_groups
+    off = off.reshape(N, G, kh * kw, 2, Ho, Wo)
+    if mask is not None:
+        m = jnp.asarray(mask).reshape(N, G, kh * kw, Ho, Wo)
+
+    base_y = (jnp.arange(Ho) * sh - ph_)[:, None]      # [Ho,1]
+    base_x = (jnp.arange(Wo) * sw - pw_)[None, :]      # [1,Wo]
+    cols = []
+    xg = x.reshape(N, G, Cin // G, H, W)
+    for k in range(kh * kw):
+        ki, kj = divmod(k, kw)
+        # offset layout [.., 2, ..] is (dy, dx) per reference
+        py = base_y + ki * dh + off[:, :, k, 0]        # [N,G,Ho,Wo]
+        px = base_x + kj * dw + off[:, :, k, 1]
+        valid = (py > -1.0) & (py < H) & (px > -1.0) & (px < W)
+        y0 = jnp.floor(py).astype(jnp.int32)
+        x0 = jnp.floor(px).astype(jnp.int32)
+        y1 = y0 + 1
+        x1 = x0 + 1
+        wy = (py - y0)[:, :, None]                     # [N,G,1,Ho,Wo]
+        wx = (px - x0)[:, :, None]
+
+        ni = jnp.arange(N)[:, None, None, None]
+        gi = jnp.arange(G)[None, :, None, None]
+
+        def g(yi, xi):
+            # out-of-bounds corners contribute 0 while keeping their
+            # fractional weight (reference DmcnIm2colBilinear,
+            # funcs/deformable_conv_functor.h:29) — gather clamped, zero
+            # masked, instead of clamping the sample coordinate
+            ok = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))[:, :, None]
+            vals = xg[ni, gi, :, jnp.clip(yi, 0, H - 1),
+                      jnp.clip(xi, 0, W - 1)].transpose(0, 1, 4, 2, 3)
+            return vals * ok.astype(vals.dtype)
+
+        v = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x1) * (1 - wy) * wx
+             + g(y1, x0) * wy * (1 - wx) + g(y1, x1) * wy * wx)
+        v = v * valid[:, :, None].astype(v.dtype)
+        if mask is not None:
+            v = v * m[:, :, k][:, :, None]
+        cols.append(v)                                 # [N,G,Cg,Ho,Wo]
+    col = jnp.stack(cols, axis=3)       # [N, G, Cg, kh*kw, Ho, Wo]
+    col = col.reshape(N, Cin, kh * kw, Ho, Wo)
+    # grouped conv contraction
+    col = col.reshape(N, groups, Cin // groups, kh * kw, Ho, Wo)
+    wg = w.reshape(groups, Cout // groups, Cin_g, kh * kw)
+    out = jnp.einsum("ngckhw,gdck->ngdhw", col, wg)
+    return out.reshape(N, Cout, Ho, Wo)

@@ -62,9 +62,11 @@ def label_smooth(label, prior_dist=None, epsilon=0.1):
 
 
 def identity_loss(x, reduction="none"):
-    if reduction in ("mean", 0):
+    # integer codes follow the reference identity_loss_kernel:
+    # 0 = sum, 1 = mean, 2 = none
+    if reduction in ("mean", 1):
         return jnp.mean(x)
-    if reduction in ("sum", 1):
+    if reduction in ("sum", 0):
         return jnp.sum(x)
     return x
 

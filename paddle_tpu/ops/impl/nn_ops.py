@@ -1,0 +1,542 @@
+"""Registry entries for the nn-kernel op tail (reference
+phi/ops/yaml/ops.yaml: conv2d/conv3d/pool2d/*_interp/layer_norm/... — ops
+whose kernels already exist in ``paddle_tpu.nn.functional``).
+
+Each function here is the raw jnp-level op body the registry dispatches to.
+Where the kernel already lives in nn.functional (itself built on run_op),
+the delegation is safe under nesting: the outer registry ``run_op`` traces
+this body, the inner ``run_op`` sees tracers and falls through to a direct
+call, so the op fuses into one compiled program with a single tape entry.
+
+New kernels implemented here: spectral_norm (power iteration),
+hsigmoid_loss (complete-binary-tree hierarchical sigmoid),
+fractional_max_pool2d/3d, unpool3d, pool2d/pool3d (paddle op-form
+dispatchers), sync_batch_norm_.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _v(x):
+    return x._value if hasattr(x, "_value") else x
+
+
+def _F():
+    from ...nn import functional as F
+    return F
+
+
+# ----------------------------------------------------------------- convs
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    return _v(_F().conv2d(x, weight, bias, stride, padding, dilation,
+                          groups, data_format))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    return _v(_F().conv3d(x, weight, bias, stride, padding, dilation,
+                          groups, data_format))
+
+
+def depthwise_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                     groups=None, data_format="NCHW"):
+    cin = x.shape[3] if data_format == "NHWC" else x.shape[1]
+    return _v(_F().conv2d(x, weight, bias, stride, padding, dilation,
+                          groups or cin, data_format))
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def _out_pad_from_size(x, weight, stride, padding, dilation, output_size, n,
+                       data_format):
+    """Paddle's ``output_size`` picks among the stride-ambiguous transpose
+    output sizes; express it as output_padding for the functional kernel."""
+    if output_size is None:
+        return 0
+    spatial = (x.shape[2:2 + n] if data_format.startswith("NC")
+               else x.shape[1:1 + n])
+    k = weight.shape[2:2 + n]
+    st, pd, dl = _tup(stride, n), _tup(padding, n), _tup(dilation, n)
+    base = tuple((s - 1) * t - 2 * p + d * (kk - 1) + 1
+                 for s, t, p, d, kk in zip(spatial, st, pd, dl, k))
+    return tuple(o - b for o, b in zip(_tup(output_size, n), base))
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCHW"):
+    if output_size is not None:
+        output_padding = _out_pad_from_size(x, weight, stride, padding,
+                                            dilation, output_size, 2,
+                                            data_format)
+    return _v(_F().conv2d_transpose(
+        x, weight, bias, stride, padding, output_padding, dilation, groups,
+        data_format))
+
+
+def conv2d_transpose_bias(x, weight, bias, stride=1, padding=0,
+                          output_padding=0, dilation=1, groups=1,
+                          output_size=None, data_format="NCHW"):
+    return conv2d_transpose(x, weight, bias, stride, padding, output_padding,
+                            dilation, groups, output_size, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCDHW"):
+    if output_size is not None:
+        output_padding = _out_pad_from_size(x, weight, stride, padding,
+                                            dilation, output_size, 3,
+                                            data_format)
+    return _v(_F().conv3d_transpose(
+        x, weight, bias, stride, padding, output_padding, dilation, groups,
+        data_format))
+
+
+def depthwise_conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                               output_padding=0, dilation=1, groups=None,
+                               output_size=None, data_format="NCHW"):
+    cin = x.shape[3] if data_format == "NHWC" else x.shape[1]
+    return conv2d_transpose(x, weight, bias, stride, padding, output_padding,
+                            dilation, groups or cin, output_size, data_format)
+
+
+# ----------------------------------------------------------------- pools
+def pool2d(x, kernel_size=1, stride=1, padding=0, pooling_type="max",
+           global_pooling=False, adaptive=False, exclusive=True,
+           ceil_mode=False, data_format="NCHW"):
+    """Paddle pool2d op form (phi/kernels/pool_kernel) — dispatches to the
+    max/avg/adaptive/global pooling kernels."""
+    F = _F()
+    if global_pooling:
+        axes = (2, 3) if data_format == "NCHW" else (1, 2)
+        red = jnp.max if pooling_type == "max" else jnp.mean
+        return red(jnp.asarray(_v(x)), axis=axes, keepdims=True)
+    if adaptive:
+        fn = (F.adaptive_max_pool2d if pooling_type == "max"
+              else F.adaptive_avg_pool2d)
+        return _v(fn(x, kernel_size, data_format=data_format))
+    if pooling_type == "max":
+        return _v(F.max_pool2d(x, kernel_size, stride, padding,
+                               ceil_mode=ceil_mode, data_format=data_format))
+    return _v(F.avg_pool2d(x, kernel_size, stride, padding,
+                           exclusive=exclusive, ceil_mode=ceil_mode,
+                           data_format=data_format))
+
+
+def pool3d(x, kernel_size=1, stride=1, padding=0, pooling_type="max",
+           global_pooling=False, adaptive=False, exclusive=True,
+           ceil_mode=False, data_format="NCDHW"):
+    F = _F()
+    if global_pooling:
+        axes = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
+        red = jnp.max if pooling_type == "max" else jnp.mean
+        return red(jnp.asarray(_v(x)), axis=axes, keepdims=True)
+    if adaptive:
+        fn = (F.adaptive_max_pool3d if pooling_type == "max"
+              else F.adaptive_avg_pool3d)
+        return _v(fn(x, kernel_size, data_format=data_format))
+    if pooling_type == "max":
+        return _v(F.max_pool3d(x, kernel_size, stride, padding,
+                               ceil_mode=ceil_mode, data_format=data_format))
+    return _v(F.avg_pool3d(x, kernel_size, stride, padding,
+                           exclusive=exclusive, ceil_mode=ceil_mode,
+                           data_format=data_format))
+
+
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0):
+    out = _F().max_pool3d(x, kernel_size, stride, padding, return_mask=True)
+    return tuple(_v(o) for o in out)
+
+
+def _fractional_bounds(in_size, out_size, k, u):
+    """Per-axis fractional windows, matching the reference exactly
+    (phi/kernels/funcs/pooling.h:142-176 FractionalRationalU/Start/End):
+    alpha=(in-k)/(out-[k>0]); start_i=int((i+u')alpha)-int(u'alpha);
+    end = start+k when a kernel_size is given, else the next start."""
+    k = int(k or 0)
+    alpha = (in_size - k) / (out_size - (1 if k > 0 else 0))
+    if k > 0:
+        uu = u
+    else:
+        base = in_size // out_size
+        u_max1 = (base + 2) / alpha - 1
+        u_max2 = (in_size + 1 - base) / alpha - (out_size - 1)
+        uu = u * min(u_max1, u_max2)
+    off = int(uu * alpha)
+    starts, ends = [], []
+    for i in range(out_size):
+        s = int((i + uu) * alpha) - off
+        e = (s + k) if k > 0 else (int((i + 1 + uu) * alpha) - off)
+        starts.append(max(s, 0))
+        ends.append(min(e, in_size))
+    return starts, ends
+
+
+def _axis_mask(in_size, starts, ends):
+    pos = np.arange(in_size)
+    return jnp.asarray(
+        np.stack([(pos >= s) & (pos < e) for s, e in zip(starts, ends)]))
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False):
+    """Fractional max pooling (reference
+    phi/kernels/funcs/pooling.cc:1908 FractionalMaxPool2dFunctor, Graham
+    arXiv:1412.6071).  ``random_u`` fixes the pseudorandom offset
+    (defaults to 0.5 = deterministic mid); mask indices are flat over the
+    input H*W plane like the reference."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ks = ((None, None) if kernel_size is None else
+          ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+           else tuple(kernel_size)))
+    u = 0.5 if random_u is None else float(random_u)
+    xv = jnp.asarray(_v(x))
+    N, C, H, W = xv.shape
+    mh = _axis_mask(H, *_fractional_bounds(H, output_size[0], ks[0], u))
+    mw = _axis_mask(W, *_fractional_bounds(W, output_size[1], ks[1], u))
+    m = mh[:, None, :, None] & mw[None, :, None, :]   # [Oh, Ow, H, W]
+    neg = jnp.finfo(xv.dtype).min
+    masked = jnp.where(m, xv[:, :, None, None], neg)  # [N,C,Oh,Ow,H,W]
+    flat = masked.reshape(N, C, *m.shape[:2], H * W)
+    out = flat.max(axis=-1)
+    if not return_mask:
+        return out
+    return out, flat.argmax(axis=-1).astype(jnp.int32)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False):
+    """3-D variant of :func:`fractional_max_pool2d` (reference
+    FractionalMaxPool3dFunctor); mask indices flat over D*H*W."""
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    ks = ((None,) * 3 if kernel_size is None else
+          ((kernel_size,) * 3 if isinstance(kernel_size, int)
+           else tuple(kernel_size)))
+    u = 0.5 if random_u is None else float(random_u)
+    xv = jnp.asarray(_v(x))
+    N, C, D, H, W = xv.shape
+    md = _axis_mask(D, *_fractional_bounds(D, output_size[0], ks[0], u))
+    mh = _axis_mask(H, *_fractional_bounds(H, output_size[1], ks[1], u))
+    mw = _axis_mask(W, *_fractional_bounds(W, output_size[2], ks[2], u))
+    m = (md[:, None, None, :, None, None]
+         & mh[None, :, None, None, :, None]
+         & mw[None, None, :, None, None, :])     # [Od,Oh,Ow,D,H,W]
+    neg = jnp.finfo(xv.dtype).min
+    masked = jnp.where(m, xv[:, :, None, None, None], neg)
+    flat = masked.reshape(N, C, *m.shape[:3], D * H * W)
+    out = flat.max(axis=-1)
+    if not return_mask:
+        return out
+    return out, flat.argmax(axis=-1).astype(jnp.int32)
+
+
+def unpool3d(x, indices, kernel_size, stride=None, padding=0,
+             output_size=None):
+    """Inverse of max_pool3d_with_index: scatter pooled values back to their
+    argmax positions (reference phi/kernels/unpool_kernel Unpool3d)."""
+    xv = jnp.asarray(_v(x))
+    idx = jnp.asarray(_v(indices)).astype(jnp.int32)
+    N, C, D, H, W = xv.shape
+    if output_size is None:
+        ks = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        st = ks if stride is None else (
+            (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+        pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+        output_size = tuple((s - 1) * t - 2 * p + k for s, t, p, k
+                            in zip((D, H, W), st, pd, ks))
+    Do, Ho, Wo = output_size
+    flat = jnp.zeros((N, C, Do * Ho * Wo), xv.dtype)
+    # assignment, not accumulation: two pooled cells can share an argmax
+    # index (overlapping windows), and the reference writes the value once
+    flat = flat.at[jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None],
+                   idx.reshape(N, C, -1)].set(xv.reshape(N, C, -1))
+    return flat.reshape(N, C, Do, Ho, Wo)
+
+
+# ------------------------------------------------------------- interp ops
+def _interp(x, mode, size=None, scale_factor=None, align_corners=False,
+            align_mode=0, data_format=None):
+    return _v(_F().interpolate(x, size=size, scale_factor=scale_factor,
+                               mode=mode, align_corners=align_corners,
+                               align_mode=align_mode,
+                               data_format=data_format))
+
+
+def bilinear_interp(x, size=None, scale_factor=None, align_corners=False,
+                    align_mode=0, data_format="NCHW"):
+    return _interp(x, "bilinear", size, scale_factor, align_corners,
+                   align_mode, data_format)
+
+
+def nearest_interp(x, size=None, scale_factor=None, align_corners=False,
+                   align_mode=0, data_format="NCHW"):
+    return _interp(x, "nearest", size, scale_factor, align_corners,
+                   align_mode, data_format)
+
+
+def bicubic_interp(x, size=None, scale_factor=None, align_corners=False,
+                   align_mode=0, data_format="NCHW"):
+    return _interp(x, "bicubic", size, scale_factor, align_corners,
+                   align_mode, data_format)
+
+
+def linear_interp(x, size=None, scale_factor=None, align_corners=False,
+                  align_mode=0, data_format="NCL"):
+    return _interp(x, "linear", size, scale_factor, align_corners,
+                   align_mode, data_format)
+
+
+def trilinear_interp(x, size=None, scale_factor=None, align_corners=False,
+                     align_mode=0, data_format="NCDHW"):
+    return _interp(x, "trilinear", size, scale_factor, align_corners,
+                   align_mode, data_format)
+
+
+# -------------------------------------------------------------- norm ops
+def layer_norm(x, weight=None, bias=None, epsilon=1e-5, begin_norm_axis=1):
+    xv = jnp.asarray(_v(x))
+    shape = xv.shape[begin_norm_axis:]
+    return _v(_F().layer_norm(x, shape, weight, bias, epsilon))
+
+
+def group_norm(x, weight=None, bias=None, epsilon=1e-5, groups=1,
+               data_format="NCHW"):
+    return _v(_F().group_norm(x, groups, weight, bias, epsilon, data_format))
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    return _v(_F().instance_norm(x, weight=weight, bias=bias, eps=epsilon))
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1):
+    return _v(_F().rms_norm(x, weight, bias, epsilon, begin_norm_axis))
+
+
+def spectral_norm(weight, u, v, dim=0, power_iters=1, epsilon=1e-12):
+    """Spectral normalization (reference phi/kernels/spectral_norm_kernel):
+    estimate the top singular value sigma of ``weight`` (reshaped to 2-D
+    around ``dim``) with ``power_iters`` rounds of power iteration seeded by
+    (u, v), and return weight / sigma."""
+    w = jnp.asarray(_v(weight))
+    uv_ = jnp.asarray(_v(u)).reshape(-1)
+    vv_ = jnp.asarray(_v(v)).reshape(-1)
+    wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)   # [h, wcols]
+
+    def _l2(x):
+        return x / (jnp.linalg.norm(x) + epsilon)
+
+    def body(_, uv):
+        uu, _ = uv
+        vv = _l2(wm.T @ uu)
+        uu = _l2(wm @ vv)
+        return uu, vv
+
+    uu, vv = jax.lax.fori_loop(0, max(power_iters, 1), body, (uv_, vv_))
+    sigma = uu @ wm @ vv
+    return w / sigma
+
+
+def sync_batch_norm_(x, mean, variance, weight, bias, axis_name=None,
+                     momentum=0.9, epsilon=1e-5, training=True,
+                     data_format="NCHW"):
+    """Cross-replica batch norm (reference sync_batch_norm_kernel /
+    python/paddle/nn/SyncBatchNorm).  Inside shard_map/pmap the batch
+    statistics are psum-averaged over ``axis_name`` — the XLA-collective
+    analog of the reference's NCCL allreduce of (sum, sum_sq)."""
+    xv = jnp.asarray(_v(x))
+    red = tuple(i for i in range(xv.ndim)
+                if i != (1 if data_format == "NCHW" else xv.ndim - 1))
+    if not training:
+        mu, var = jnp.asarray(_v(mean)), jnp.asarray(_v(variance))
+    else:
+        mu = jnp.mean(xv, axis=red)
+        m2 = jnp.mean(xv * xv, axis=red)
+        if axis_name is not None:
+            mu = jax.lax.pmean(mu, axis_name)
+            m2 = jax.lax.pmean(m2, axis_name)
+        var = m2 - mu * mu
+    shape = [1] * xv.ndim
+    shape[1 if data_format == "NCHW" else xv.ndim - 1] = -1
+    y = (xv - mu.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        y = y * jnp.asarray(_v(weight)).reshape(shape)
+    if bias is not None:
+        y = y + jnp.asarray(_v(bias)).reshape(shape)
+    new_mean = momentum * jnp.asarray(_v(mean)) + (1 - momentum) * mu
+    new_var = momentum * jnp.asarray(_v(variance)) + (1 - momentum) * var
+    return y, new_mean, new_var
+
+
+def fused_batch_norm_act(x, mean, variance, scale, bias, momentum=0.9,
+                         epsilon=1e-5, act_type="relu"):
+    """BN + activation in one op (reference fused_batch_norm_act op) — XLA
+    fuses the chain; the op exists for API parity."""
+    y, nm, nv = sync_batch_norm_(x, mean, variance, scale, bias, None,
+                                 momentum, epsilon, training=True)
+    return getattr(jax.nn, act_type)(y), nm, nv
+
+
+def fused_bn_add_activation(x, z, mean, variance, scale, bias, momentum=0.9,
+                            epsilon=1e-5, act_type="relu"):
+    y, nm, nv = sync_batch_norm_(x, mean, variance, scale, bias, None,
+                                 momentum, epsilon, training=True)
+    return getattr(jax.nn, act_type)(y + jnp.asarray(_v(z))), nm, nv
+
+
+# -------------------------------------------------------------- misc nn
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
+    return _v(_F().dropout(x, p, axis=axis, training=training, mode=mode))
+
+
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
+    """5-D pad (reference phi/kernels/pad3d_kernel).  paddings is the paddle
+    order [left, right, top, bottom, front, back] on the spatial dims."""
+    xv = jnp.asarray(_v(x))
+    l, r, t, b, f, k = [int(p) for p in paddings]
+    if data_format == "NCDHW":
+        widths = [(0, 0), (0, 0), (f, k), (t, b), (l, r)]
+    else:
+        widths = [(0, 0), (f, k), (t, b), (l, r), (0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(xv, widths, mode=jmode, constant_values=value)
+    return jnp.pad(xv, widths, mode=jmode)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    return _v(_F().sequence_mask(lengths, maxlen, dtype))
+
+
+def cross_entropy_with_softmax(logits, label, soft_label=False,
+                               use_softmax=True, numeric_stable_mode=True,
+                               ignore_index=-100, axis=-1):
+    return _v(_F().softmax_with_cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        axis=axis))
+
+
+def hsigmoid_loss(x, label, weight, bias=None, num_classes=2,
+                  path_table=None, path_code=None):
+    """Hierarchical sigmoid loss over a complete binary tree (reference
+    phi/kernels/hsigmoid_loss_kernel, nn/functional/loss.py hsigmoid_loss).
+    Each class c is the leaf ``c + num_classes`` of a heap-indexed tree;
+    internal node k (1-indexed, k>=1) owns row k-1 of ``weight``/``bias``.
+    The loss is the sum of binary logistic losses along the root path,
+    unrolled to the static depth ceil(log2(C)) — no data-dependent loops."""
+    xv = jnp.asarray(_v(x))                    # [N, D]
+    lab = jnp.asarray(_v(label)).reshape(-1)   # [N]
+    w = jnp.asarray(_v(weight))                # [C-1, D] (or C rows)
+    bv = None if bias is None else jnp.asarray(_v(bias)).reshape(-1)
+    if path_table is not None:
+        pt = jnp.asarray(_v(path_table)).astype(jnp.int32)   # [N, L]
+        pc = jnp.asarray(_v(path_code)).astype(xv.dtype)     # [N, L]
+        valid = (pt >= 0).astype(xv.dtype)
+        pt = jnp.maximum(pt, 0)
+    else:
+        depth = max(1, math.ceil(math.log2(max(num_classes, 2))))
+        code = lab + num_classes               # heap leaf id
+        nodes, bits = [], []
+        for _ in range(depth):
+            bits.append((code % 2).astype(xv.dtype))
+            code = code // 2
+            nodes.append(code)                 # internal node (heap id)
+        pt = jnp.stack(nodes, axis=1).astype(jnp.int32)      # [N, L]
+        pc = jnp.stack(bits, axis=1)
+        valid = (pt >= 1).astype(xv.dtype)
+        pt = jnp.maximum(pt - 1, 0)            # heap id -> weight row
+    wp = w[pt]                                 # [N, L, D]
+    pre = jnp.einsum("nd,nld->nl", xv, wp)
+    if bv is not None:
+        pre = pre + bv[pt]
+    # binary logistic with target bit: log(1+e^pre) - bit*pre, masked
+    loss = (jnp.logaddexp(0.0, pre) - pc * pre) * valid
+    return loss.sum(axis=1, keepdims=True)
+
+
+def clip_by_norm(x, max_norm):
+    """Per-tensor L2 clip (reference phi/kernels/clip_by_norm_kernel)."""
+    xv = jnp.asarray(_v(x))
+    n = jnp.sqrt(jnp.sum(xv * xv))
+    return jnp.where(n > max_norm, xv * (max_norm / jnp.maximum(n, 1e-12)),
+                     xv)
+
+
+def fused_softmax_mask(x, mask):
+    """softmax(x + mask) fused (reference fused_softmax_mask op); XLA fuses
+    the add into the softmax."""
+    return jax.nn.softmax(jnp.asarray(_v(x)) + jnp.asarray(_v(mask)),
+                          axis=-1)
+
+
+def fused_softmax_mask_upper_triangle(x):
+    """Causal-masked softmax (reference
+    fused_softmax_mask_upper_triangle_op): upper triangle (j > i) is -inf."""
+    xv = jnp.asarray(_v(x))
+    S, L = xv.shape[-2], xv.shape[-1]
+    m = jnp.tril(jnp.ones((S, L), bool))
+    return jax.nn.softmax(jnp.where(m, xv, jnp.finfo(xv.dtype).min), axis=-1)
+
+
+# ------------------------------------------------------------ attention
+def flash_attn(query, key, value, fixed_seed_offset=None, attn_mask=None,
+               dropout=0.0, causal=False, return_softmax=False):
+    if attn_mask is not None:
+        out = _F().scaled_dot_product_attention(
+            query, key, value, attn_mask=attn_mask, dropout_p=dropout,
+            is_causal=causal)
+    else:
+        out = _F().flash_attention(query, key, value, dropout=dropout,
+                                   causal=causal)
+    o = out[0] if isinstance(out, tuple) else out
+    return _v(o)
+
+
+def flash_attn_qkvpacked(qkv, fixed_seed_offset=None, attn_mask=None,
+                         dropout=0.0, causal=False, return_softmax=False):
+    q, k, vv = (jnp.asarray(_v(qkv))[:, :, i] for i in range(3))
+    return flash_attn(q, k, vv, fixed_seed_offset, attn_mask, dropout,
+                      causal, return_softmax)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False):
+    out = _F().flash_attn_unpadded(query, key, value, cu_seqlens_q,
+                                   cu_seqlens_k, max_seqlen_q, max_seqlen_k,
+                                   scale=scale, dropout=dropout,
+                                   causal=causal)
+    return _v(out[0] if isinstance(out, tuple) else out)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q=None, max_seqlen_k=None,
+                                scale=None, dropout=0.0, causal=False,
+                                return_softmax=False):
+    q, k, vv = (jnp.asarray(_v(qkv))[:, i] for i in range(3))
+    return flash_attn_unpadded(q, k, vv, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale, dropout,
+                               causal, return_softmax)
+
+
+def memory_efficient_attention(query, key, value, bias=None, causal=False,
+                               dropout_p=0.0, scale=None, training=True):
+    """xformers-style API (reference memory_efficient_attention op) — on
+    TPU the flash kernel IS the memory-efficient path."""
+    out = _F().scaled_dot_product_attention(
+        query, key, value, attn_mask=bias, dropout_p=dropout_p,
+        is_causal=causal, training=training)
+    return _v(out)

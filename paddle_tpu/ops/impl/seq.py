@@ -85,16 +85,18 @@ def top_p_sampling(key, x, ps, threshold=None, seed=None):
     return ids, probs
 
 
-def _pool_patches(x, ksize, stride, padding):
-    """Extract pooling windows: [N, C, Ho, Wo, kh*kw] via gather."""
+def _pool_patches(x, ksize, stride, padding, extra_hi=(0, 0)):
+    """Extract pooling windows: [N, C, Ho, Wo, kh*kw] via gather.
+    ``extra_hi`` grows the hi padding (ceil_mode)."""
     n, c, h, w = x.shape
     kh, kw = ksize
     sh, sw = stride
     ph, pw = padding
-    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+    eh, ew = extra_hi
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew)),
                  constant_values=-jnp.inf)
-    ho = (h + 2 * ph - kh) // sh + 1
-    wo = (w + 2 * pw - kw) // sw + 1
+    ho = (h + 2 * ph + eh - kh) // sh + 1
+    wo = (w + 2 * pw + ew - kw) // sw + 1
     iy = (jnp.arange(ho) * sh)[:, None] + jnp.arange(kh)[None]   # [Ho, kh]
     ix = (jnp.arange(wo) * sw)[:, None] + jnp.arange(kw)[None]   # [Wo, kw]
     patches = xp[:, :, iy[:, None, :, None], ix[None, :, None, :]]
@@ -105,27 +107,17 @@ def _pool_patches(x, ksize, stride, padding):
 def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
                           global_pooling=False, adaptive=False):
     """Returns (out, indices) with indices FLAT over the input H*W plane
-    (reference max_pool2d_with_index semantics)."""
+    (reference max_pool2d_with_index semantics).  Delegates to the
+    reduce_window argmax kernel (nn/functional/pooling.py:_maxpool) — one
+    source of truth for max-with-index pooling."""
     if adaptive:
         raise NotImplementedError("adaptive max_pool_with_index")
-    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
-        else tuple(kernel_size)
-    if global_pooling:
-        ks = x.shape[2:]
-    st = ks if stride is None else ((stride, stride)
-                                    if isinstance(stride, int)
-                                    else tuple(stride))
-    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
-    n, c, h, w = x.shape
-    patches, (ho, wo), (iy, ix) = _pool_patches(x, ks, st, pd)
-    arg = jnp.argmax(patches, axis=-1)                # [N, C, Ho, Wo]
-    out = jnp.max(patches, axis=-1)
-    ky = arg // ks[1]
-    kx = arg % ks[1]
-    src_y = (jnp.arange(ho) * st[0])[None, None, :, None] + ky - pd[0]
-    src_x = (jnp.arange(wo) * st[1])[None, None, None, :] + kx - pd[1]
-    flat = jnp.clip(src_y, 0, h - 1) * w + jnp.clip(src_x, 0, w - 1)
-    return out, flat.astype(jnp.int32)
+    from ...nn.functional.pooling import _maxpool, _tup
+    ks = tuple(x.shape[2:]) if global_pooling else _tup(kernel_size, 2)
+    st = ks if stride is None else _tup(stride, 2)
+    out, idx = _maxpool(jnp.asarray(x), ks, st, padding, 2, False,
+                        return_mask=True)
+    return out, idx.astype(jnp.int32)
 
 
 def unpool(x, indices, ksize=None, strides=None, paddings=None,
@@ -135,15 +127,23 @@ def unpool(x, indices, ksize=None, strides=None, paddings=None,
     if output_size is not None:
         h, w = int(output_size[-2]), int(output_size[-1])
     else:
-        st = strides or ksize
-        h = ho * (st[0] if isinstance(st, (tuple, list)) else st)
-        w = wo * (st[1] if isinstance(st, (tuple, list)) else st)
+        # inverse of the pool output-size formula (reference
+        # _unpool_output_size): (in-1)*stride + ksize - 2*padding
+        ks = (ksize, ksize) if isinstance(ksize, int) else tuple(ksize or (1, 1))
+        st = strides if strides is not None else ks
+        st = (st, st) if isinstance(st, int) else tuple(st)
+        pd = paddings if paddings is not None else 0
+        pd = (pd, pd) if isinstance(pd, int) else tuple(pd)
+        h = (ho - 1) * st[0] + ks[0] - 2 * pd[0]
+        w = (wo - 1) * st[1] + ks[1] - 2 * pd[1]
     out = jnp.zeros((n, c, h * w), x.dtype)
     flat_idx = indices.reshape(n, c, ho * wo)
     vals = x.reshape(n, c, ho * wo)
     bi = jnp.arange(n)[:, None, None]
     ci = jnp.arange(c)[None, :, None]
-    out = out.at[bi, ci, flat_idx].add(vals)
+    # assignment, not accumulation: overlapping windows can hand two pooled
+    # cells the same argmax index; the reference writes the value once
+    out = out.at[bi, ci, flat_idx].set(vals)
     return out.reshape(n, c, h, w)
 
 
@@ -157,7 +157,14 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
     pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
     if data_format == "NHWC":
         x = jnp.moveaxis(x, -1, 1)
-    patches, _, _ = _pool_patches(x, ks, st, pd)
+    extra = (0, 0)
+    if ceil_mode:
+        # padded elements enter the windows as 0 ( |0|^p contributes
+        # nothing), so ceil_mode is exact here
+        extra = tuple(
+            max(0, (-(-(size + 2 * p - k) // s)) * s + k - size - 2 * p)
+            for size, k, s, p in zip(x.shape[2:], ks, st, pd))
+    patches, _, _ = _pool_patches(x, ks, st, pd, extra)
     patches = jnp.where(jnp.isfinite(patches), patches, 0.0)
     p = float(norm_type)
     out = jnp.sum(jnp.abs(patches) ** p, axis=-1) ** (1.0 / p)

@@ -21,6 +21,21 @@ def _grid_sample_2d(x, grid, align_corners=True, padding_mode="zeros"):
     else:
         fx = ((gx + 1.0) * W - 1.0) * 0.5
         fy = ((gy + 1.0) * H - 1.0) * 0.5
+    if padding_mode == "reflection":
+        # reflect off the borders: [0, size-1] when align_corners else
+        # [-0.5, size-0.5] (reference grid_sample_kernel ComputePositions)
+        def _reflect(v, size):
+            if align_corners:
+                span = max(size - 1, 1)
+                m = jnp.mod(jnp.abs(v), 2 * span)
+                return span - jnp.abs(m - span)
+            # reflect v+0.5 over [0, size] (period 2*size), shift back, then
+            # clamp into the valid sample range like the reference
+            m = jnp.mod(jnp.abs(v + 0.5), 2 * size)
+            return jnp.clip(size - jnp.abs(m - size) - 0.5, 0.0, size - 1)
+
+        fx = _reflect(fx, W)
+        fy = _reflect(fy, H)
     x0 = jnp.floor(fx)
     y0 = jnp.floor(fy)
     wx = fx - x0

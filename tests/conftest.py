@@ -5,8 +5,17 @@ multi-chip as multi-device on one process)."""
 
 import os
 
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
+# Sequential thunk order: XLA:CPU's concurrency-optimized scheduler can run
+# independent collectives in different orders on different virtual devices
+# and deadlock the in-process rendezvous (see __graft_entry__.py).
+_FLAGS = ("--xla_force_host_platform_device_count=8 "
+          "--xla_cpu_enable_concurrency_optimized_scheduler=false "
+          "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120 "
+          "--xla_cpu_collective_call_terminate_timeout_seconds=480")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " "
+                               + _FLAGS).strip()
 
 import jax
 

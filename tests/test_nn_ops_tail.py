@@ -1,0 +1,203 @@
+"""Op-form nn kernels (reference phi ops: pool2d/conv2d/*_interp/
+spectral_norm/hsigmoid_loss/fractional pools/pad3d/...; test model
+test/legacy_test/test_pool2d_op.py etc.)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _np(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+rng = np.random.default_rng(0)
+
+
+class TestPoolConvForms:
+    def test_pool2d_forms(self):
+        x = rng.normal(size=(1, 2, 6, 6)).astype(np.float32)
+        mx = _np(pt.pool2d(pt.Tensor(x), kernel_size=2, stride=2,
+                           pooling_type="max"))
+        av = _np(pt.pool2d(pt.Tensor(x), kernel_size=2, stride=2,
+                           pooling_type="avg"))
+        assert mx.shape == av.shape == (1, 2, 3, 3)
+        assert (mx >= av - 1e-6).all()
+        g = _np(pt.pool2d(pt.Tensor(x), pooling_type="avg",
+                          global_pooling=True))
+        np.testing.assert_allclose(g[..., 0, 0], x.mean(axis=(2, 3)),
+                                   rtol=1e-6)
+        a = _np(pt.pool2d(pt.Tensor(x), kernel_size=3, adaptive=True,
+                          pooling_type="avg"))
+        assert a.shape == (1, 2, 3, 3)
+
+    def test_conv_forms(self):
+        x = rng.normal(size=(1, 3, 8, 8)).astype(np.float32)
+        w = rng.normal(size=(6, 3, 3, 3)).astype(np.float32)
+        out = _np(pt.conv2d(pt.Tensor(x), pt.Tensor(w), padding=1))
+        assert out.shape == (1, 6, 8, 8)
+        wd = rng.normal(size=(3, 1, 3, 3)).astype(np.float32)
+        dw = _np(pt.depthwise_conv2d(pt.Tensor(x), pt.Tensor(wd), padding=1))
+        assert dw.shape == (1, 3, 8, 8)
+        wt = rng.normal(size=(3, 4, 2, 2)).astype(np.float32)
+        tr = _np(pt.conv2d_transpose(pt.Tensor(x), pt.Tensor(wt), stride=2))
+        assert tr.shape == (1, 4, 16, 16)
+
+    def test_max_pool3d_with_index(self):
+        x = rng.normal(size=(1, 1, 4, 4, 4)).astype(np.float32)
+        out, idx = pt.max_pool3d_with_index(pt.Tensor(x), kernel_size=2,
+                                            stride=2)
+        assert _np(out).shape == (1, 1, 2, 2, 2)
+        assert _np(idx).shape == (1, 1, 2, 2, 2)
+
+    def test_fractional_max_pool2d(self):
+        x = np.arange(49, dtype=np.float32).reshape(1, 1, 7, 7)
+        out = _np(pt.fractional_max_pool2d(pt.Tensor(x), output_size=3))
+        assert out.shape == (1, 1, 3, 3)
+        # windows are disjoint and cover the input: last bin holds the max
+        assert out[0, 0, 2, 2] == 48.0
+        # constant input pools to the constant
+        c = _np(pt.fractional_max_pool2d(
+            pt.Tensor(np.full((1, 1, 7, 7), 2.5, np.float32)), 3))
+        np.testing.assert_allclose(c, 2.5)
+
+    def test_unpool3d_roundtrip(self):
+        x = rng.normal(size=(1, 1, 4, 4, 4)).astype(np.float32)
+        out, idx = pt.max_pool3d_with_index(pt.Tensor(x), 2, 2)
+        up = _np(pt.unpool3d(out, idx, 2, 2))
+        assert up.shape == (1, 1, 4, 4, 4)
+        # scattered values are exactly the pooled maxima
+        np.testing.assert_allclose(np.sort(up[up != 0]),
+                                   np.sort(_np(out).ravel()))
+
+
+class TestInterpNorm:
+    def test_interp_ops(self):
+        x = rng.normal(size=(1, 2, 4, 4)).astype(np.float32)
+        for op, sz in [(pt.bilinear_interp, (8, 8)),
+                       (pt.nearest_interp, (8, 8)),
+                       (pt.bicubic_interp, (8, 8))]:
+            out = _np(op(pt.Tensor(x), size=sz))
+            assert out.shape == (1, 2, 8, 8)
+        x1 = rng.normal(size=(1, 2, 4)).astype(np.float32)
+        assert _np(pt.linear_interp(pt.Tensor(x1), size=(8,),
+                                    data_format="NCL")).shape == (1, 2, 8)
+        x3 = rng.normal(size=(1, 2, 4, 4, 4)).astype(np.float32)
+        assert _np(pt.trilinear_interp(
+            pt.Tensor(x3), size=(8, 8, 8))).shape == (1, 2, 8, 8, 8)
+
+    def test_norm_op_forms(self):
+        x = rng.normal(size=(2, 4, 3, 3)).astype(np.float32)
+        ln = _np(pt.layer_norm(pt.Tensor(x), begin_norm_axis=1))
+        np.testing.assert_allclose(ln.reshape(2, -1).mean(-1), 0.0,
+                                   atol=1e-5)
+        gn = _np(pt.group_norm(pt.Tensor(x), groups=2))
+        assert gn.shape == x.shape
+        inn = _np(pt.instance_norm(pt.Tensor(x)))
+        np.testing.assert_allclose(inn.mean(axis=(2, 3)), 0.0, atol=1e-5)
+
+    def test_spectral_norm(self):
+        w = rng.normal(size=(4, 6)).astype(np.float32)
+        u = rng.normal(size=(4,)).astype(np.float32)
+        v = rng.normal(size=(6,)).astype(np.float32)
+        out = _np(pt.spectral_norm(pt.Tensor(w), pt.Tensor(u), pt.Tensor(v),
+                                   power_iters=20))
+        # after normalization the top singular value is ~1
+        s = np.linalg.svd(out, compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+    def test_sync_batch_norm_single(self):
+        x = rng.normal(size=(4, 3, 2, 2)).astype(np.float32)
+        m = np.zeros(3, np.float32)
+        va = np.ones(3, np.float32)
+        y, nm, nv = pt.sync_batch_norm_(pt.Tensor(x), pt.Tensor(m),
+                                        pt.Tensor(va), None, None)
+        np.testing.assert_allclose(_np(y).mean(axis=(0, 2, 3)), 0.0,
+                                   atol=1e-5)
+        # running stats move toward batch stats
+        assert not np.allclose(_np(nm), m)
+
+
+class TestMiscNN:
+    def test_pad3d_modes(self):
+        x = np.arange(8, dtype=np.float32).reshape(1, 1, 2, 2, 2)
+        out = _np(pt.pad3d(pt.Tensor(x), [1, 1, 0, 0, 0, 0], value=9.0))
+        assert out.shape == (1, 1, 2, 2, 4)
+        assert out[0, 0, 0, 0, 0] == 9.0
+        r = _np(pt.pad3d(pt.Tensor(x), [1, 1, 1, 1, 1, 1], mode="reflect"))
+        assert r.shape == (1, 1, 4, 4, 4)
+
+    def test_hsigmoid_loss_learns_sign(self):
+        # loss is differentiable and positive; grad check vs finite diff
+        x = rng.normal(size=(5, 3)).astype(np.float32)
+        lab = np.array([0, 1, 2, 3, 1], np.int64)
+        w = rng.normal(size=(3, 3)).astype(np.float32) * 0.1
+        b = np.zeros(3, np.float32)
+        raw = pt.ops.get_op("hsigmoid_loss").fn.raw
+        loss = raw(x, lab, w, b, num_classes=4)
+        assert loss.shape == (5, 1) and (np.asarray(loss) > 0).all()
+        g = jax.grad(lambda ww: raw(x, lab, ww, b, num_classes=4).sum())(w)
+        eps = 1e-3
+        w2 = w.copy()
+        w2[0, 0] += eps
+        fd = (np.asarray(raw(x, lab, w2, b, num_classes=4)).sum()
+              - np.asarray(raw(x, lab, w, b, num_classes=4)).sum()) / eps
+        np.testing.assert_allclose(np.asarray(g)[0, 0], fd, rtol=1e-2,
+                                   atol=1e-3)
+
+    def test_clip_by_norm(self):
+        x = np.ones(16, np.float32) * 2.0          # norm = 8
+        out = _np(pt.clip_by_norm(pt.Tensor(x), 4.0))
+        np.testing.assert_allclose(np.linalg.norm(out), 4.0, rtol=1e-5)
+        small = np.ones(4, np.float32) * 0.1
+        np.testing.assert_allclose(_np(pt.clip_by_norm(pt.Tensor(small),
+                                                       4.0)), small)
+
+    def test_fused_softmax_masks(self):
+        x = rng.normal(size=(1, 1, 4, 4)).astype(np.float32)
+        m = np.where(np.arange(4)[None, None, None] > 1, -1e9,
+                     0.0).astype(np.float32)
+        out = _np(pt.fused_softmax_mask(pt.Tensor(x), pt.Tensor(m)))
+        np.testing.assert_allclose(out[..., 2:].sum(), 0.0, atol=1e-6)
+        tri = _np(pt.fused_softmax_mask_upper_triangle(pt.Tensor(x)))
+        assert tri[0, 0, 0, 1] == pytest.approx(0.0, abs=1e-6)
+        np.testing.assert_allclose(tri.sum(-1), 1.0, rtol=1e-5)
+
+    def test_cross_entropy_with_softmax_op(self):
+        logits = rng.normal(size=(4, 7)).astype(np.float32)
+        lab = np.array([[1], [2], [3], [0]], np.int64)
+        out = _np(pt.cross_entropy_with_softmax(pt.Tensor(logits),
+                                                pt.Tensor(lab)))
+        ref = -np.log(np.exp(logits) / np.exp(logits).sum(-1, keepdims=True))
+        np.testing.assert_allclose(
+            out.ravel(), ref[np.arange(4), lab.ravel()], rtol=1e-5)
+
+
+class TestAttentionOpForms:
+    def test_flash_attn_op(self):
+        q = rng.normal(size=(2, 8, 2, 16)).astype(np.float32)
+        out = _np(pt.flash_attn(pt.Tensor(q), pt.Tensor(q), pt.Tensor(q),
+                                causal=True))
+        assert out.shape == q.shape
+
+    def test_flash_attn_qkvpacked(self):
+        qkv = rng.normal(size=(2, 8, 3, 2, 16)).astype(np.float32)
+        out = _np(pt.flash_attn_qkvpacked(pt.Tensor(qkv)))
+        assert out.shape == (2, 8, 2, 16)
+
+    def test_flash_attn_unpadded_op(self):
+        q = rng.normal(size=(10, 2, 8)).astype(np.float32)
+        cu = np.array([0, 4, 10], np.int32)
+        out = _np(pt.flash_attn_unpadded(pt.Tensor(q), pt.Tensor(q),
+                                         pt.Tensor(q), pt.Tensor(cu),
+                                         pt.Tensor(cu), 6, 6))
+        assert out.shape == q.shape
+
+    def test_memory_efficient_attention(self):
+        q = rng.normal(size=(2, 8, 2, 16)).astype(np.float32)
+        out = _np(pt.memory_efficient_attention(pt.Tensor(q), pt.Tensor(q),
+                                                pt.Tensor(q), causal=True))
+        assert out.shape == q.shape

@@ -306,8 +306,8 @@ def test_sweep_fp32_eager_vs_traced():
         od = ops[name]
         found = _runnable(name, od, np.float32)
         args, kwargs = found
-        if od.rng:
-            continue   # fresh keys per call: eager/traced draws differ
+        if od.rng or od.nojit:
+            continue   # fresh keys per call / value-dependent output shapes
         if not any(isinstance(a, np.ndarray) for a in args):
             continue   # creation ops: shape args must stay concrete
         # only ndarray args become traced operands; ints/axes/shapes stay

@@ -1,0 +1,112 @@
+"""Regressions for the round-2 review findings: yolo_box flatten order,
+matrix_nms gaussian sigma, identity_loss reduction codes, unpool default
+output size, grid_sample reflection padding, pool ceil_mode."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nn import functional as F
+
+
+def _np(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+def test_yolo_box_anchor_major_order():
+    A, C, H, W = 2, 1, 2, 2
+    x = np.zeros((1, A * (5 + C), H, W), np.float32)
+    # make anchor 1's conf higher so its rows are distinguishable
+    x[0, (5 + C) + 4] = 3.0
+    img = np.array([[64, 64]], np.int32)
+    boxes, scores = pt.yolo_box(pt.Tensor(x), pt.Tensor(img),
+                                anchors=[8, 8, 32, 32], class_num=C,
+                                conf_thresh=0.01, downsample_ratio=32)
+    b, s = _np(boxes), _np(scores)
+    # reference layout: m = a*H*W + i*W + j — first H*W rows are anchor 0
+    w0 = b[0, 0, 2] - b[0, 0, 0]                 # anchor 0 width (8/64*64)
+    w1 = b[0, H * W, 2] - b[0, H * W, 0]         # anchor 1 width
+    assert w0 == pytest.approx(8.0, rel=1e-5)
+    assert w1 == pytest.approx(32.0, rel=1e-5)
+    # anchor-1 rows carry the boosted confidence
+    assert (s[0, H * W:] > s[0, :H * W]).all()
+
+
+def test_matrix_nms_gaussian_sigma_direction():
+    bb = np.array([[[0, 0, 10, 10], [0, 0.5, 10, 10.5],
+                    [30, 30, 40, 40]]], np.float32)
+    sc = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+    out_hi, _, _ = pt.matrix_nms(bb, sc, 0.1, use_gaussian=True,
+                                 gaussian_sigma=8.0, background_label=-1)
+    out_lo, _, _ = pt.matrix_nms(bb, sc, 0.1, use_gaussian=True,
+                                 gaussian_sigma=0.5, background_label=-1)
+    hi = {tuple(r[2:]): r[1] for r in _np(out_hi)}
+    lo = {tuple(r[2:]): r[1] for r in _np(out_lo)}
+    k = (0.0, 0.5, 10.0, 10.5)
+    # larger sigma -> stronger decay of the overlapping box
+    assert hi[k] < lo[k] < 0.8
+    # far-away box never decayed
+    assert hi[(30., 30., 40., 40.)] == pytest.approx(0.7, abs=1e-6)
+
+
+def test_identity_loss_integer_codes():
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    assert _np(pt.identity_loss(pt.Tensor(x), 0)) == pytest.approx(6.0)
+    assert _np(pt.identity_loss(pt.Tensor(x), 1)) == pytest.approx(2.0)
+    np.testing.assert_allclose(_np(pt.identity_loss(pt.Tensor(x), 2)), x)
+
+
+def test_unpool_default_output_size_roundtrip():
+    # 7x7 pooled with k=3, s=2 -> 3x3; default unpool must rebuild 7x7
+    x = np.random.default_rng(0).normal(size=(1, 1, 7, 7)).astype(np.float32)
+    out, idx = F.max_pool2d(pt.Tensor(x), 3, 2, return_mask=True)
+    up = _np(pt.unpool(out, idx, ksize=3, strides=2))
+    assert up.shape == (1, 1, 7, 7)
+    # every pooled max landed at its original flat position
+    o, i = _np(out).ravel(), _np(idx).ravel().astype(int)
+    for v, fi in zip(o, i):
+        assert up[0, 0, fi // 7, fi % 7] == pytest.approx(v)
+
+
+def test_grid_sample_reflection():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 1, 4)
+    # x-coords beyond the right edge reflect back inside
+    grid = np.zeros((1, 1, 3, 2), np.float32)
+    grid[0, 0, :, 0] = [1.0, 1.5, 2.0]   # 1.0 -> col 3; beyond reflects
+    grid[0, 0, :, 1] = -1.0 if False else 0.0
+    grid[..., 1] = -1.0  # single-row input: y pinned to the only row
+    out_r = _np(pt.grid_sample(pt.Tensor(x), pt.Tensor(grid),
+                               padding_mode="reflection",
+                               align_corners=True))
+    # align_corners grid 1.5 maps to fx=3.75 -> reflect(3.75, span 3)=2.25
+    np.testing.assert_allclose(out_r[0, 0, 0],
+                               [3.0, 2.25, 1.5], rtol=1e-5)
+    out_z = _np(pt.grid_sample(pt.Tensor(x), pt.Tensor(grid),
+                               padding_mode="zeros", align_corners=True))
+    assert out_z[0, 0, 0, 2] == pytest.approx(0.0)  # fully outside -> 0
+
+
+def test_pool_ceil_mode():
+    # 7 with k=2,s=2: floor -> 3 outputs, ceil -> 4 (tail window = col 6)
+    x = np.random.default_rng(1).normal(size=(1, 1, 7, 7)).astype(np.float32)
+    f = _np(F.max_pool2d(pt.Tensor(x), 2, 2))
+    c = _np(F.max_pool2d(pt.Tensor(x), 2, 2, ceil_mode=True))
+    assert f.shape == (1, 1, 3, 3) and c.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(c[:, :, :3, :3], f)
+    # ceil bins pool the remaining tail elements
+    assert c[0, 0, 3, 3] == pytest.approx(x[0, 0, 6, 6])
+    a = _np(F.avg_pool2d(pt.Tensor(x), 2, 2, ceil_mode=True))
+    # exclusive counting: tail bin averages only the single real element
+    assert a[0, 0, 3, 3] == pytest.approx(x[0, 0, 6, 6])
+    # op-form dispatch honors ceil_mode too
+    p = _np(pt.pool2d(pt.Tensor(x), kernel_size=2, stride=2,
+                      pooling_type="avg", ceil_mode=True))
+    np.testing.assert_allclose(p, a)
+
+
+def test_lp_pool2d_ceil_mode():
+    x = np.abs(np.random.default_rng(2).normal(
+        size=(1, 1, 7, 7))).astype(np.float32)
+    out = _np(pt.lp_pool2d(pt.Tensor(x), 2.0, 2, 2, ceil_mode=True))
+    assert out.shape == (1, 1, 4, 4)
+    assert out[0, 0, 3, 3] == pytest.approx(x[0, 0, 6, 6], rel=1e-5)
