@@ -15,7 +15,7 @@ __all__ = [
     "fused_bias_dropout_residual_layer_norm", "fused_rotary_position_embedding",
     "fused_bias_act", "fused_dropout_add", "swiglu", "fused_linear",
     "fused_linear_activation", "fused_multi_head_attention",
-    "masked_multihead_attention",
+    "masked_multihead_attention", "fused_multi_transformer",
 ]
 
 
@@ -285,3 +285,166 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     return run_op("masked_multihead_attention", impl,
                   (x, cache_kv, bias, sequence_lengths), {},
                   differentiable=False)
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, pre_caches=None,
+                            rotary_embs=None, time_step=None, attn_mask=None,
+                            dropout_rate=0.0, rotary_emb_dims=0,
+                            activation="gelu", training=False,
+                            mode="upscale_in_train", trans_qkvw=True,
+                            ring_id=-1, name=None):
+    """Whole-stack fused transformer (reference
+    incubate/nn/functional/fused_transformer.py fused_multi_transformer →
+    fused_multi_transformer_op.cu).  N pre/post-LN blocks in one op:
+    [LN →] fused-QKV → attention (flash for context, MMHA decode-step when
+    ``time_step`` is set) → out-proj → +residual → [LN →] ffn1 → act →
+    ffn2 → +residual.
+
+    The reference hand-fuses this chain into one CUDA kernel per block;
+    under XLA one traced op body compiles to the same fusion, and the layer
+    loop is a static Python loop so each block inlines.  Decode mode
+    scatters into the caller's preallocated ``cache_kvs``
+    ([2, B, H, T_max, D] per layer) and returns (out, updated_caches).
+
+    qkv_weight layout: [3, H, D, E] when ``trans_qkvw`` (reference default)
+    else [E, 3, H, D].
+    """
+    from ....nn import functional as F
+    from ....ops.pallas.decode_attention import decode_attention
+
+    if pre_caches is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer: pre_caches (prefix-tuning prompts) "
+            "not implemented")
+    if dropout_rate and training:
+        raise NotImplementedError(
+            "fused_multi_transformer: training-mode dropout not "
+            "implemented (the op is a serving path; reference defaults "
+            "dropout_rate=0)")
+    decode = time_step is not None
+    t_step = int(getattr(time_step, "_value", time_step)) if decode else None
+    n_layers = len(qkv_weights)
+    caches = list(cache_kvs) if cache_kvs is not None else None
+    rot = None
+    if rotary_embs is not None:
+        rot = jnp.asarray(getattr(rotary_embs, "_value", rotary_embs))
+
+    def _ln(y, s, b):
+        mu = jnp.mean(y, -1, keepdims=True)
+        var = jnp.var(y, -1, keepdims=True)
+        y = (y - mu) * jax.lax.rsqrt(var + epsilon)
+        if s is not None:
+            y = y * s
+        if b is not None:
+            y = y + b
+        return y
+
+    def impl(xv, mask, rot, *flat):
+        it = iter(flat)
+
+        def nxt():
+            return next(it)
+
+        lns, lnb = [nxt() for _ in range(n_layers)], \
+            [nxt() for _ in range(n_layers)]
+        qkvw = [nxt() for _ in range(n_layers)]
+        qkvb = [nxt() for _ in range(n_layers)]
+        lw = [nxt() for _ in range(n_layers)]
+        lb = [nxt() for _ in range(n_layers)]
+        flns = [nxt() for _ in range(n_layers)]
+        flnb = [nxt() for _ in range(n_layers)]
+        f1w = [nxt() for _ in range(n_layers)]
+        f1b = [nxt() for _ in range(n_layers)]
+        f2w = [nxt() for _ in range(n_layers)]
+        f2b = [nxt() for _ in range(n_layers)]
+        kv = [nxt() for _ in range(n_layers)] if caches is not None else \
+            [None] * n_layers
+
+        B, S, E = xv.shape
+        new_caches = []
+        y = xv
+        for i in range(n_layers):
+            w = qkvw[i]
+            if trans_qkvw:
+                H, D = w.shape[1], w.shape[2]
+            else:
+                H, D = w.shape[2], w.shape[3]
+                w = jnp.transpose(w, (1, 2, 3, 0))
+            resid = y
+            h = _ln(y, lns[i], lnb[i]) if pre_layer_norm else y
+            qkv = jnp.einsum("bse,thde->bsthd", h, w)
+            if qkvb[i] is not None:
+                qkv = qkv + qkvb[i][None, None]
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D]
+            if rot is not None:
+                # rotary_embs: [2, B, 1, S_max, D] (cos, sin) — reference
+                # fused_multi_transformer neox-half rotation on q/k
+                pos0 = t_step if decode else 0
+                cos = jax.lax.dynamic_slice_in_dim(rot[0], pos0, S,
+                                                   axis=2)[:, 0][:, :, None]
+                sin = jax.lax.dynamic_slice_in_dim(rot[1], pos0, S,
+                                                   axis=2)[:, 0][:, :, None]
+
+                def _rot_half(t):
+                    t1, t2 = jnp.split(t, 2, axis=-1)
+                    return jnp.concatenate([-t2, t1], axis=-1)
+
+                q = q * cos + _rot_half(q) * sin
+                k = k * cos + _rot_half(k) * sin
+            if decode:
+                lens = jnp.full((B,), t_step, jnp.int32)
+                bidx = jnp.arange(B)
+                kc = kv[i][0].at[bidx, :, t_step].set(k[:, 0])
+                vc = kv[i][1].at[bidx, :, t_step].set(v[:, 0])
+                new_caches.append(jnp.stack([kc, vc]))
+                attn = decode_attention(q[:, 0], jnp.swapaxes(kc, 1, 2),
+                                        jnp.swapaxes(vc, 1, 2), lens + 1)
+                attn = attn[:, None]                       # [B, 1, H, D]
+            else:
+                if kv[i] is not None:
+                    bidx = jnp.arange(B)[:, None]
+                    spos = jnp.arange(S)[None, :]
+                    kc = kv[i][0].at[bidx, :, spos].set(k)
+                    vc = kv[i][1].at[bidx, :, spos].set(v)
+                    new_caches.append(jnp.stack([kc, vc]))
+                att = F.scaled_dot_product_attention(
+                    q, k, v, attn_mask=mask, is_causal=mask is None,
+                    training=False)
+                attn = jnp.asarray(getattr(att, "_value", att))
+            out = attn.reshape(B, S, H * D) @ lw[i]
+            if lb[i] is not None:
+                out = out + lb[i]
+            y = resid + out
+            if not pre_layer_norm:
+                y = _ln(y, lns[i], lnb[i])
+            resid = y
+            h = _ln(y, flns[i], flnb[i]) if pre_layer_norm else y
+            h = h @ f1w[i]
+            if f1b[i] is not None:
+                h = h + f1b[i]
+            h = getattr(jax.nn, activation)(h)
+            h = h @ f2w[i]
+            if f2b[i] is not None:
+                h = h + f2b[i]
+            y = resid + h
+            if not pre_layer_norm:
+                y = _ln(y, flns[i], flnb[i])
+        return (y, *new_caches) if new_caches else y
+
+    flat_args = (list(ln_scales) + list(ln_biases) + list(qkv_weights)
+                 + list(qkv_biases) + list(linear_weights)
+                 + list(linear_biases) + list(ffn_ln_scales)
+                 + list(ffn_ln_biases) + list(ffn1_weights)
+                 + list(ffn1_biases) + list(ffn2_weights)
+                 + list(ffn2_biases))
+    if caches is not None:
+        flat_args += caches
+    out = run_op("fused_multi_transformer", impl,
+                 (x, attn_mask, rot, *flat_args), {}, differentiable=False)
+    if caches is not None:
+        return out[0], list(out[1:])
+    return out

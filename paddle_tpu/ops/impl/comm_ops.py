@@ -1,0 +1,135 @@
+"""Collective op forms (reference ops.yaml c_allreduce_*/c_allgather/
+c_broadcast/c_concat/c_identity/c_reduce_sum/c_scatter/all_gather/
+reduce_scatter/*sync_stream — the static-graph communication ops the
+NCCL backend registers per-op).
+
+TPU-first mapping: inside a traced ``shard_map``/``pjit`` region the op
+lowers to the XLA collective over the named mesh ``axis`` (psum /
+all_gather / ppermute ride ICI); eagerly it goes through
+``parallel.collective``'s Group machinery (single-process world: the
+collective is the identity / concat over one shard).  The reference's
+``ring_id`` becomes the mesh axis name; stream-sync ops are no-ops because
+XLA orders collectives by data flow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _v(x):
+    return jnp.asarray(getattr(x, "_value", x))
+
+
+def _axis_or_none(axis):
+    """axis name when tracing inside shard_map, else None (eager world)."""
+    return axis
+
+
+def _in_trace(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _reduce(x, op, axis):
+    x = _v(x)
+    if axis is not None and _in_trace(x):
+        if op == "sum":
+            return jax.lax.psum(x, axis)
+        if op == "max":
+            return jax.lax.pmax(x, axis)
+        if op == "min":
+            return jax.lax.pmin(x, axis)
+        if op == "prod":
+            # gather-then-multiply: a log/exp trick would NaN on negatives
+            return jnp.prod(jax.lax.all_gather(x, axis), axis=0)
+    return x          # eager single-process world
+
+
+def c_allreduce_sum(x, ring_id=0, use_calc_stream=False, axis=None):
+    return _reduce(x, "sum", axis)
+
+
+def c_allreduce_max(x, ring_id=0, use_calc_stream=False, axis=None):
+    return _reduce(x, "max", axis)
+
+
+def c_allreduce_min(x, ring_id=0, use_calc_stream=False, axis=None):
+    return _reduce(x, "min", axis)
+
+
+def c_allreduce_prod(x, ring_id=0, use_calc_stream=False, axis=None):
+    return _reduce(x, "prod", axis)
+
+
+def c_reduce_sum(x, ring_id=0, root_id=0, use_calc_stream=False, axis=None):
+    return _reduce(x, "sum", axis)
+
+
+def c_allgather(x, ring_id=0, nranks=1, use_calc_stream=False, axis=None):
+    x = _v(x)
+    if axis is not None and _in_trace(x):
+        return jax.lax.all_gather(x, axis, tiled=False).reshape(
+            (-1,) + x.shape[1:])
+    return x
+
+
+def all_gather(x, ring_id=0, nranks=1, axis=None):
+    return c_allgather(x, ring_id, nranks, False, axis)
+
+
+def c_concat(x, rank=0, nranks=1, ring_id=0, use_calc_stream=False,
+             use_model_parallel=True, axis=None):
+    """Gather shards and concat on the LAST dim (mp row-parallel output)."""
+    x = _v(x)
+    if axis is not None and _in_trace(x):
+        return jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
+    return x
+
+
+def c_broadcast(x, ring_id=0, root=0, use_calc_stream=False, axis=None):
+    x = _v(x)
+    if axis is not None and _in_trace(x):
+        idx = jax.lax.axis_index(axis)
+        return jax.lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)),
+                            axis)
+    return x
+
+
+def c_scatter(x, ring_id=0, root=0, nranks=1, use_calc_stream=False,
+              axis=None):
+    x = _v(x)
+    if axis is not None and _in_trace(x):
+        idx = jax.lax.axis_index(axis)
+        full = c_broadcast(x, ring_id, root, use_calc_stream, axis)
+        shard = full.shape[0] // jax.lax.axis_size(axis)
+        return jax.lax.dynamic_slice_in_dim(full, idx * shard, shard, 0)
+    return x
+
+
+def c_identity(x, ring_id=0, use_calc_stream=False, use_model_parallel=True):
+    """Forward identity whose GRAD is all-reduce (mp column-parallel input).
+    The manual-SPMD layers (parallel/manual.py mp_copy) carry the real
+    semantics; this op form is the eager/API-parity surface."""
+    return _v(x)
+
+
+def reduce_scatter(x, ring_id=0, nranks=1, axis=None, scatter_axis=0):
+    x = _v(x)
+    if axis is not None and _in_trace(x):
+        return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                                    tiled=True)
+    return x
+
+
+def c_sync_calc_stream(x):
+    """XLA orders collectives by data dependence; stream sync is identity."""
+    return _v(x)
+
+
+def c_sync_comm_stream(x, ring_id=0):
+    return _v(x)
+
+
+def sync_calc_stream(x):
+    return _v(x)

@@ -153,3 +153,61 @@ def cast(x, dtype):
 
 def real_imag_to_complex(real, imag):
     return jax.lax.complex(real, imag)
+
+
+# ---- op-form creation tail (reference ops.yaml: full_/full_int_array/
+# full_with_tensor/full_batch_size_like/assign_value_/assign_out_/data/
+# shape/numel) ----
+def full_(x, shape=None, fill_value=0.0, dtype=None):
+    """In-place full (reference full_ op): refill x's buffer; the registry's
+    functional form returns the new value."""
+    x = jnp.asarray(getattr(x, "_value", x))
+    return jnp.full(x.shape if shape is None else _shape(shape), fill_value,
+                    _dtype(dtype) if dtype else x.dtype)
+
+
+def full_int_array(value, dtype=None):
+    return jnp.asarray(value, _dtype(dtype, default_float=False)
+                       if dtype else jnp.int64)
+
+
+def full_with_tensor(fill_value, shape, dtype=None):
+    v = jnp.asarray(getattr(fill_value, "_value", fill_value)).reshape(())
+    out = jnp.broadcast_to(v, _shape(shape))
+    return out.astype(_dtype(dtype)) if dtype else out
+
+
+def full_batch_size_like(input, shape, fill_value, input_dim_idx=0,
+                         output_dim_idx=0, dtype=None):
+    x = jnp.asarray(getattr(input, "_value", input))
+    s = list(_shape(shape))
+    s[output_dim_idx] = x.shape[input_dim_idx]
+    return jnp.full(tuple(s), fill_value,
+                    _dtype(dtype) if dtype else x.dtype)
+
+
+def assign_value_(shape, dtype, values):
+    return jnp.asarray(values, _dtype(dtype)).reshape(_shape(shape))
+
+
+def assign_out_(x, output=None):
+    return jnp.asarray(getattr(x, "_value", x))
+
+
+def data(name="", shape=(), dtype="float32", place=None):
+    """Graph-input placeholder (reference data_op / pir data).  Eager mode
+    has no feed stage, so it materializes zeros of the declared spec —
+    jit tracing replaces it with a real traced input."""
+    concrete = tuple(max(d, 1) if d is not None and d >= 0 else 1
+                     for d in _shape(shape))
+    return jnp.zeros(concrete, _dtype(dtype))
+
+
+def shape_op(x):
+    x = jnp.asarray(getattr(x, "_value", x))
+    return jnp.asarray(x.shape, jnp.int32)
+
+
+def numel(x):
+    x = jnp.asarray(getattr(x, "_value", x))
+    return jnp.asarray(int(np.prod(x.shape)), jnp.int64)

@@ -1,0 +1,112 @@
+"""Graph op forms (reference send_u_recv/send_ue_recv/send_uv/segment_pool/
+reindex_graph/graph_sample_neighbors/weighted_sample_neighbors/
+graph_khop_sampler ops) — kernels live in paddle_tpu.geometric (XLA
+segment_* scatter/gather); these are the registry dispatch points.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _geo():
+    from ... import geometric as g
+    return g
+
+
+def _v(x):
+    return jnp.asarray(getattr(x, "_value", x))
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="SUM", out_size=None):
+    out = _geo().send_u_recv(x, src_index, dst_index, reduce_op.lower(),
+                             out_size)
+    return getattr(out, "_value", out)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="ADD",
+                 reduce_op="SUM", out_size=None):
+    out = _geo().send_ue_recv(x, y, src_index, dst_index, message_op.lower(),
+                              reduce_op.lower(), out_size)
+    return getattr(out, "_value", out)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="ADD"):
+    out = _geo().send_uv(x, y, src_index, dst_index, message_op.lower())
+    return getattr(out, "_value", out)
+
+
+def segment_pool(x, segment_ids, pooltype="SUM"):
+    """Segment reduction op form (reference segment_pool_op); also returns
+    the per-segment counts the reference emits for MEAN's backward."""
+    fn = getattr(_geo(), f"segment_{pooltype.lower()}")
+    out = fn(x, segment_ids)
+    ids = _v(segment_ids)
+    n = int(jnp.max(ids)) + 1 if ids.size else 0
+    counts = jax.ops.segment_sum(jnp.ones_like(ids, jnp.float32), ids,
+                                 num_segments=n)
+    return getattr(out, "_value", out), counts
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None):
+    outs = _geo().reindex_graph(x, neighbors, count, value_buffer,
+                                index_buffer)
+    return tuple(getattr(o, "_value", o) for o in outs)
+
+
+def graph_sample_neighbors(row, colptr, x, eids=None, perm_buffer=None,
+                           sample_size=-1, return_eids=False,
+                           flag_perm_buffer=False):
+    outs = _geo().sample_neighbors(row, colptr, x, sample_size,
+                                   eids=eids, return_eids=return_eids)
+    return tuple(getattr(o, "_value", o) for o in outs) \
+        if isinstance(outs, tuple) else getattr(outs, "_value", outs)
+
+
+def weighted_sample_neighbors(key, row, colptr, edge_weight, x, eids=None,
+                              sample_size=-1, return_eids=False):
+    """Weight-biased neighbor sampling (reference
+    weighted_sample_neighbors op): per-node weighted choice without
+    replacement, numpy-side like the reference CPU kernel.  The injected
+    PRNG ``key`` (rng: true) seeds numpy so draws follow the global
+    paddle.seed stream and differ per call."""
+    import jax as _jax
+    r = np.asarray(getattr(row, "_value", row)).reshape(-1)
+    cp = np.asarray(getattr(colptr, "_value", colptr)).reshape(-1)
+    w = np.asarray(getattr(edge_weight, "_value", edge_weight)).reshape(-1)
+    nodes = np.asarray(getattr(x, "_value", x)).reshape(-1)
+    rng = np.random.default_rng(
+        np.asarray(_jax.random.key_data(key)).astype(np.uint32))
+    out_nb, out_cnt = [], []
+    for n in nodes:
+        s, e = int(cp[n]), int(cp[n + 1])
+        nbrs, ws = r[s:e], w[s:e]
+        k = len(nbrs) if sample_size < 0 else min(sample_size, len(nbrs))
+        if k == 0:
+            out_cnt.append(0)
+            continue
+        p = ws / ws.sum() if ws.sum() > 0 else None
+        out_nb.append(rng.choice(nbrs, size=k, replace=False, p=p))
+        out_cnt.append(k)
+    nb = np.concatenate(out_nb) if out_nb else np.empty(0, r.dtype)
+    return nb, np.asarray(out_cnt, np.int32)
+
+
+def graph_khop_sampler(row, colptr, x, eids=None, sample_sizes=(5,),
+                       return_eids=False):
+    """K-hop sampling by chaining one-hop sampling per layer (reference
+    graph_khop_sampler op)."""
+    g = _geo()
+    cur = x
+    all_nb, all_cnt = [], []
+    for k in sample_sizes:
+        nb, cnt = (g.sample_neighbors(row, colptr, cur, k)[:2])
+        all_nb.append(np.asarray(getattr(nb, "_value", nb)))
+        all_cnt.append(np.asarray(getattr(cnt, "_value", cnt)))
+        cur = np.unique(np.concatenate(
+            [np.asarray(getattr(cur, "_value", cur)).reshape(-1),
+             all_nb[-1].reshape(-1)]))
+    return (np.concatenate(all_nb) if all_nb else np.empty(0, np.int64),
+            np.concatenate(all_cnt) if all_cnt else np.empty(0, np.int32))

@@ -262,3 +262,11 @@ def ormqr(x, tau, y, left=True, transpose=False):
     if transpose:
         Q = jnp.swapaxes(Q, -2, -1)
     return Q @ y if left else y @ Q
+
+
+def matrix_rank_tol(x, atol_tensor, use_default_tol=True, hermitian=False):
+    """matrix_rank with a tensor tolerance operand (reference
+    matrix_rank_tol op)."""
+    tol = jnp.asarray(getattr(atol_tensor, "_value", atol_tensor))
+    return matrix_rank(x, tol=None if use_default_tol else tol,
+                       hermitian=hermitian)

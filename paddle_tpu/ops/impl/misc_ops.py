@@ -1,0 +1,588 @@
+"""Miscellaneous op tail (reference phi/ops/yaml/ops.yaml entries without a
+natural home module): sequence ops, legacy CTR ops (cvm, batch_fc,
+partial_*), data-movement ops (share_data, memcpy, trans_layout), metric
+ops (auc, accuracy_check), decode ops (crf_decoding, ctc_align, warprnnt),
+MoE aux op forms, and the tree-based sampling ops (tdm_child, tdm_sampler).
+
+Sequence (LoD) ops take a ``lengths``/cu-seqlen representation instead of
+the reference's LoD tensors — padded dense + lengths is the static-shape
+form XLA wants.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _v(x):
+    return jnp.asarray(getattr(x, "_value", x))
+
+
+# ----------------------------------------------------------- sequence ops
+def sequence_pool(x, lengths, pool_type="SUM", pad_value=0.0):
+    """Pool each sequence to one vector (reference sequence_pool_op).
+    x: [B, T, D] padded; lengths: [B].  pool_type: SUM/MEAN/MAX/MIN/
+    SQRT/FIRST/LAST."""
+    x = _v(x)
+    ln = _v(lengths).astype(jnp.int32)
+    B, T = x.shape[0], x.shape[1]
+    mask = (jnp.arange(T)[None, :] < ln[:, None])
+    me = mask.reshape(B, T, *(1,) * (x.ndim - 2))
+    pt = pool_type.upper()
+    if pt == "SUM":
+        out = jnp.where(me, x, 0).sum(axis=1)
+    elif pt == "MEAN":
+        out = jnp.where(me, x, 0).sum(axis=1) / jnp.maximum(
+            ln.reshape(B, *(1,) * (x.ndim - 2)), 1)
+    elif pt == "SQRT":
+        out = jnp.where(me, x, 0).sum(axis=1) / jnp.sqrt(jnp.maximum(
+            ln.reshape(B, *(1,) * (x.ndim - 2)), 1).astype(x.dtype))
+    elif pt == "MAX":
+        out = jnp.where(me, x, jnp.finfo(x.dtype).min).max(axis=1)
+        out = jnp.where(ln.reshape(B, *(1,) * (x.ndim - 2)) > 0, out,
+                        pad_value)
+    elif pt == "MIN":
+        out = jnp.where(me, x, jnp.finfo(x.dtype).max).min(axis=1)
+        out = jnp.where(ln.reshape(B, *(1,) * (x.ndim - 2)) > 0, out,
+                        pad_value)
+    elif pt == "FIRST":
+        out = x[:, 0]
+    elif pt == "LAST":
+        out = jnp.take_along_axis(
+            x, jnp.maximum(ln - 1, 0).reshape(B, 1, *(1,) * (x.ndim - 2)),
+            axis=1)[:, 0]
+    else:
+        raise ValueError(f"sequence_pool: unknown pool_type {pool_type!r}")
+    return out
+
+
+def sequence_conv(x, lengths, filter, context_length=3, context_start=None,
+                  context_stride=1):
+    """Context-window conv over each sequence (reference sequence_conv_op):
+    im2col of [context_length] neighbors (zero beyond sequence bounds) then
+    one matmul with filter [context_length*D, M]."""
+    x = _v(x)                                  # [B, T, D]
+    ln = _v(lengths).astype(jnp.int32)
+    w = _v(filter)
+    B, T, D = x.shape
+    start = -((context_length - 1) // 2) if context_start is None \
+        else context_start
+    cols = []
+    pos = jnp.arange(T)
+    valid_t = pos[None, :] < ln[:, None]       # [B, T]
+    for c in range(context_length):
+        o = start + c * context_stride
+        shifted = jnp.roll(x, -o, axis=1)
+        src = pos + o
+        ok = (src >= 0) & (src < T) & valid_t \
+            & (src[None, :] < ln[:, None])
+        cols.append(jnp.where(ok[..., None], shifted, 0.0))
+    col = jnp.concatenate(cols, axis=-1)       # [B, T, C*D]
+    return jnp.einsum("btk,km->btm", col, w)
+
+
+def im2sequence(x, kernels, strides=(1, 1), paddings=(0, 0, 0, 0)):
+    """Image patches as rows (reference im2sequence_op): [N, C, H, W] ->
+    [N*Ho*Wo, C*kh*kw]."""
+    x = _v(x)
+    N, C, H, W = x.shape
+    kh, kw = kernels
+    sh, sw = strides
+    pu, pl, pd, pr = paddings if len(paddings) == 4 else (
+        paddings[0], paddings[1], paddings[0], paddings[1])
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pu, pd), (pl, pr)))
+    Ho = (H + pu + pd - kh) // sh + 1
+    Wo = (W + pl + pr - kw) // sw + 1
+    iy = (jnp.arange(Ho) * sh)[:, None] + jnp.arange(kh)[None]
+    ix = (jnp.arange(Wo) * sw)[:, None] + jnp.arange(kw)[None]
+    patches = xp[:, :, iy[:, None, :, None], ix[None, :, None, :]]
+    # [N, C, Ho, Wo, kh, kw] -> [N, Ho, Wo, C, kh, kw]
+    patches = patches.transpose(0, 2, 3, 1, 4, 5)
+    return patches.reshape(N * Ho * Wo, C * kh * kw)
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0):
+    """x*alpha + sinusoidal positions*beta (reference
+    add_position_encoding_op)."""
+    x = _v(x)
+    B, T, D = x.shape
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    half = D // 2
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=-1)
+    return x * alpha + pe[None].astype(x.dtype) * beta
+
+
+# --------------------------------------------------------- legacy CTR ops
+def partial_concat(xs, start_index=0, length=-1):
+    """Concat a column slice of every input (reference partial_concat_op)."""
+    parts = []
+    for x in xs:
+        x = _v(x)
+        end = x.shape[1] if length < 0 else start_index + length
+        parts.append(x[:, start_index:end])
+    return jnp.concatenate(parts, axis=1)
+
+
+def partial_sum(xs, start_index=0, length=-1):
+    parts = []
+    for x in xs:
+        x = _v(x)
+        end = x.shape[1] if length < 0 else start_index + length
+        parts.append(x[:, start_index:end])
+    return sum(parts[1:], parts[0])
+
+
+def batch_fc(input, w, bias=None):
+    """Per-slot batched FC (reference batch_fc_op): input [S, B, D],
+    w [S, D, M] -> [S, B, M]."""
+    out = jnp.einsum("sbd,sdm->sbm", _v(input), _v(w))
+    if bias is not None:
+        out = out + _v(bias)[:, None, :]
+    return out
+
+
+def cvm(x, cvm_in, use_cvm=True):
+    """Click-through feature op (reference cvm_op): first two columns are
+    (show, click); use_cvm keeps log-transformed counters, else drops
+    them."""
+    x = _v(x)
+    c = _v(cvm_in)
+    logs = jnp.log1p(jnp.maximum(c, 0.0))
+    ctr = logs[:, 1:2] - logs[:, 0:1]
+    head = jnp.concatenate([logs[:, 0:1], ctr], axis=1).astype(x.dtype)
+    if use_cvm:
+        return jnp.concatenate([head, x[:, 2:]], axis=1)
+    return x[:, 2:]
+
+
+def match_matrix_tensor(x, y, w, lengths_x=None, lengths_y=None, dim_t=None):
+    """Semantic match tensor (reference match_matrix_tensor_op):
+    out[b, t, i, j] = x[b, i] · W_t · y[b, j]."""
+    x = _v(x)                                  # [B, Lx, D1]
+    y = _v(y)                                  # [B, Ly, D2]
+    w = _v(w)                                  # [D1, T, D2]
+    return jnp.einsum("bid,dtk,bjk->btij", x, w, y)
+
+
+def shuffle_batch(key, x, startup_seed=0):
+    """Random row shuffle returning (out, seed, order) (reference
+    shuffle_batch_op)."""
+    x = _v(x)
+    order = jax.random.permutation(key, x.shape[0])
+    return jnp.take(x, order, axis=0), jnp.zeros((1,), jnp.int64), order
+
+
+def shuffle_channel(x, group=1):
+    from .vision_ops import channel_shuffle
+    return channel_shuffle(_v(x), group)
+
+
+def affine_channel(x, scale, bias, data_format="NCHW"):
+    """Per-channel affine (reference affine_channel_op)."""
+    x = _v(x)
+    shape = [1] * x.ndim
+    shape[1 if data_format == "NCHW" else x.ndim - 1] = -1
+    return x * _v(scale).reshape(shape) + _v(bias).reshape(shape)
+
+
+# -------------------------------------------------------------- metric ops
+def auc(predict, label, num_thresholds=4095):
+    """Batch ROC-AUC by thresholded confusion counts (reference auc_op's
+    stat computation collapsed to a single batch)."""
+    p = _v(predict)
+    pos_score = p[:, -1] if p.ndim == 2 else p.reshape(-1)
+    y = _v(label).reshape(-1).astype(jnp.float32)
+    bins = jnp.clip((pos_score * num_thresholds).astype(jnp.int32), 0,
+                    num_thresholds)
+    pos_hist = jax.ops.segment_sum(y, bins, num_segments=num_thresholds + 1)
+    neg_hist = jax.ops.segment_sum(1.0 - y, bins,
+                                   num_segments=num_thresholds + 1)
+    # sweep thresholds high->low accumulating TP/FP (trapezoid rule)
+    tp = jnp.cumsum(pos_hist[::-1])
+    fp = jnp.cumsum(neg_hist[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp = jnp.concatenate([jnp.zeros(1), tp])
+    fp = jnp.concatenate([jnp.zeros(1), fp])
+    area = jnp.sum((fp[1:] - fp[:-1]) * (tp[1:] + tp[:-1]) / 2.0)
+    return jnp.where(tot_pos * tot_neg > 0, area / (tot_pos * tot_neg), 0.5)
+
+
+def accuracy_check(x, y, fn_name="", rtol=1e-5, atol=1e-8, equal_nan=False):
+    """Elementwise closeness verdict (reference accuracy_check_op)."""
+    return jnp.all(jnp.isclose(_v(x), _v(y), rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def check_numerics(x, op_type="", var_name="", stack_height_limit=-1,
+                   path="", check_nan=True, check_inf=True):
+    """Count nan/inf (reference check_numerics_kernel): returns
+    (stats [3] = #nan,#inf,#zero, values [3] = max,min,mean)."""
+    x = _v(x)
+    xf = x.astype(jnp.float32)
+    stats = jnp.stack([jnp.sum(jnp.isnan(xf)), jnp.sum(jnp.isinf(xf)),
+                       jnp.sum(xf == 0.0)]).astype(jnp.int64)
+    finite = jnp.where(jnp.isfinite(xf), xf, 0.0)
+    vals = jnp.stack([finite.max(), finite.min(), finite.mean()])
+    return stats, vals
+
+
+# --------------------------------------------------------------- decoding
+def crf_decoding(emission, transition, lengths=None, label=None):
+    """Viterbi decode with learned start/stop rows (reference
+    crf_decoding_op).  transition: [D+2, D] — rows 0/1 are start/stop
+    weights, like linear_chain_crf.  Delegates to text.viterbi_decode for
+    the recursion."""
+    from ...text.viterbi_decode import viterbi_decode
+    em = _v(emission)                           # [B, T, D]
+    tr = _v(transition)
+    B, T, D = em.shape
+    start, stop, trans = tr[0], tr[1], tr[2:]
+    em = em.at[:, 0].add(start[None])
+    if lengths is None:
+        lengths = jnp.full((B,), T, jnp.int32)
+    ln = _v(lengths).astype(jnp.int32)
+    # stop weights land on each sequence's last real step
+    last = jax.nn.one_hot(jnp.maximum(ln - 1, 0), T, dtype=em.dtype)
+    em = em + last[:, :, None] * stop[None, None, :]
+    _, path = viterbi_decode(em, trans, ln, include_bos_eos_tag=False)
+    return getattr(path, "_value", path)
+
+
+def ctc_align(input, input_length=None, blank=0, merge_repeated=True,
+              padding_value=0):
+    """Collapse CTC paths: drop repeats then blanks (reference
+    ctc_align_op).  Output is padded dense [B, T] plus lengths.  Shapes are
+    static; runs eagerly (nojit) like the reference's CPU kernel."""
+    x = np.asarray(getattr(input, "_value", input))
+    B, T = x.shape[0], x.shape[1]
+    ln = (np.asarray(getattr(input_length, "_value", input_length)).reshape(-1)
+          if input_length is not None else np.full(B, T))
+    out = np.full((B, T), padding_value, x.dtype)
+    out_len = np.zeros(B, np.int32)
+    for b in range(B):
+        prev = None
+        k = 0
+        for t in range(int(ln[b])):
+            tok = x[b, t]
+            if merge_repeated and prev is not None and tok == prev:
+                prev = tok
+                continue
+            prev = tok
+            if tok != blank:
+                out[b, k] = tok
+                k += 1
+        out_len[b] = k
+    return out, out_len
+
+
+def warpctc(logits, label, logits_length=None, labels_length=None, blank=0,
+            norm_by_times=False):
+    """CTC loss op form (reference warpctc_op) — same DP as
+    nn.functional.ctc_loss's kernel."""
+    from ...nn.functional.loss import ctc_loss
+    out = ctc_loss(logits, label, logits_length, labels_length, blank=blank,
+                   reduction="none")
+    return getattr(out, "_value", out)
+
+
+def warprnnt(input, label, input_lengths, label_lengths, blank=0,
+             fastemit_lambda=0.0):
+    """RNN-T transducer loss (reference warprnnt_op, Graves 2012).
+    input: [B, T, U+1, V] joint log-probs (log-softmaxed here); the
+    forward variable recursion runs as a lax.scan over T with an inner
+    scan over U — O(T·U) sequential steps, each a [B] vector op."""
+    x = jax.nn.log_softmax(_v(input), axis=-1)
+    y = _v(label).astype(jnp.int32)             # [B, U]
+    tl = _v(input_lengths).astype(jnp.int32)    # [B]
+    ul = _v(label_lengths).astype(jnp.int32)    # [B]
+    B, T, U1, V = x.shape
+    U = U1 - 1
+    NEG = -1e30
+
+    blank_lp = x[..., blank]                    # [B, T, U+1]
+    lab_lp = jnp.take_along_axis(
+        x[:, :, :U], y[:, None, :, None], axis=-1)[..., 0]   # [B, T, U]
+
+    def row_step(prev_row, t):
+        # prev_row: alpha[t-1, :] [B, U+1]
+        from_blank = prev_row + blank_lp[:, t - 1]           # emit blank
+
+        def u_step(carry, u):
+            # carry: alpha[t, u-1] [B]
+            left = jnp.where(u == 0, NEG,
+                             carry + lab_lp[jnp.arange(B), t,
+                                            jnp.maximum(u - 1, 0)])
+            cur = jnp.logaddexp(from_blank[:, u], left)
+            return cur, cur
+
+        # alpha[t, 0] has no label transition
+        first = from_blank[:, 0]
+        _, rest = jax.lax.scan(
+            lambda c, u: u_step(c, u), first, jnp.arange(1, U1))
+        row = jnp.concatenate([first[:, None], rest.T], axis=1)
+        return row, row
+
+    # t = 0 row: only label transitions from alpha[0,0]=0
+    def u0(carry, u):
+        cur = carry + lab_lp[jnp.arange(B), 0, u]
+        return cur, cur
+
+    a00 = jnp.zeros((B,))
+    _, r0rest = jax.lax.scan(u0, a00, jnp.arange(U))
+    row0 = jnp.concatenate([a00[:, None], r0rest.T], axis=1)
+
+    def scan_t(prev, t):
+        row, _ = row_step(prev, t)
+        return row, row
+
+    _, rows = jax.lax.scan(scan_t, row0, jnp.arange(1, T))
+    alpha = jnp.concatenate([row0[None], rows], axis=0)      # [T, B, U+1]
+    alpha = alpha.transpose(1, 0, 2)                         # [B, T, U+1]
+    bidx = jnp.arange(B)
+    tl_c = jnp.clip(tl - 1, 0, T - 1)
+    final = alpha[bidx, tl_c, jnp.clip(ul, 0, U)] \
+        + blank_lp[bidx, tl_c, jnp.clip(ul, 0, U)]
+    return -final
+
+
+# ----------------------------------------------------------- MoE op forms
+def number_count(numbers, upper_range):
+    from ...incubate.distributed.models.moe.utils import number_count as f
+    return _v(f(numbers, upper_range))
+
+
+def limit_by_capacity(expert_count, capacity, n_worker=1):
+    from ...incubate.distributed.models.moe.utils import (
+        limit_by_capacity as f)
+    return _v(f(expert_count, capacity, n_worker))
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker=1):
+    from ...incubate.distributed.models.moe.utils import (
+        prune_gate_by_capacity as f)
+    return _v(f(gate_idx, expert_count, n_expert, n_worker))
+
+
+def random_routing(prob, topk_value, topk_idx):
+    from ...incubate.distributed.models.moe.utils import random_routing as f
+    return _v(f(topk_idx, topk_value, prob))
+
+
+def assign_pos(x, cum_count, eff_num_len=None):
+    """Token positions grouped by expert (reference assign_pos_op): tokens
+    sorted stably by expert id; output[j] = token index of the j-th slot.
+    Static output length = len(x); pruned tokens (gate id < 0) sort LAST so
+    expert buckets line up with cum_count offsets, and their slots hold
+    -1."""
+    g = _v(x).astype(jnp.int32).reshape(-1)
+    sort_key = jnp.where(g >= 0, g, jnp.iinfo(jnp.int32).max)
+    order = jnp.argsort(sort_key, stable=True)
+    keep = jnp.take(g, order) >= 0
+    return jnp.where(keep, order, -1)
+
+
+# ------------------------------------------------------------- tree ops
+def tdm_child(x, tree_info, child_nums=2):
+    """Children lookup in a flat tree table (reference tdm_child_op).
+    tree_info rows: [item_id, layer, parent, child_0..child_n-1]."""
+    ids = _v(x).astype(jnp.int32)
+    info = _v(tree_info).astype(jnp.int32)
+    kids = info[:, 3:3 + child_nums]
+    child = kids[ids]                          # [..., child_nums]
+    item = info[:, 0]
+    leaf = jnp.where(child > 0, (item[child] != 0).astype(jnp.int32), 0)
+    return child, leaf
+
+
+def tdm_sampler(key, x, travel_list, layer_list, neg_samples_num_list,
+                layer_node_num_list, leaf_node_num, output_positive=True):
+    """Per-layer negative sampling along each item's tree path (reference
+    tdm_sampler_op).  Returns (out, label, mask) with layout
+    [B, sum(neg+pos) per layer]."""
+    ids = _v(x).astype(jnp.int32).reshape(-1)
+    travel = _v(travel_list).astype(jnp.int32)   # [leaf_num, n_layer]
+    layers = [jnp.asarray(l, jnp.int32) for l in layer_list]
+    B = ids.shape[0]
+    outs, labels, masks = [], [], []
+    for li, (layer_nodes, neg_n) in enumerate(
+            zip(layers, neg_samples_num_list)):
+        pos = travel[ids, li]                    # [B]
+        if output_positive:
+            outs.append(pos[:, None])
+            labels.append(jnp.ones((B, 1), jnp.int32))
+            masks.append((pos > 0).astype(jnp.int32)[:, None])
+        key, sub = jax.random.split(key)
+        n_nodes = layer_nodes.shape[0]
+        jdx = jax.random.randint(sub, (B, neg_n), 0, n_nodes)
+        neg = layer_nodes[jdx]
+        # collision with the positive: step to the next node in the layer
+        neg = jnp.where(neg == pos[:, None],
+                        layer_nodes[(jdx + 1) % n_nodes], neg)
+        outs.append(neg)
+        labels.append(jnp.zeros((B, neg_n), jnp.int32))
+        masks.append(jnp.ones((B, neg_n), jnp.int32))
+    return (jnp.concatenate(outs, axis=1),
+            jnp.concatenate(labels, axis=1),
+            jnp.concatenate(masks, axis=1))
+
+
+# ------------------------------------------------------- data movement ops
+def share_data(x):
+    return _v(x)
+
+
+def copy_to(x, place=None, blocking=True):
+    return _v(x)
+
+
+def memcpy_h2d(x, dst_place_type=0):
+    return jax.device_put(_v(x))
+
+
+def memcpy_d2h(x, dst_place_type=0):
+    return _v(x)
+
+
+def npu_identity(x, format=-1):
+    return _v(x)
+
+
+def trans_layout(x, perm):
+    return jnp.transpose(_v(x), perm)
+
+
+def depend(x, dep=None):
+    """Scheduling-edge no-op (reference depend_op); XLA's data-flow order
+    replaces explicit dependency edges."""
+    return _v(x)
+
+
+def coalesce_tensor(inputs, dtype=None, copy_data=True, set_constant=False,
+                    constant=0.0, persist_output=False, use_align=True,
+                    align_size=-1, size_of_dtype=-1):
+    """Fuse tensors into one flat buffer (reference coalesce_tensor_op,
+    used by DP grad fusion).  Returns (outputs, fused): XLA already fuses
+    collectives, so outputs alias reshaped views of the flat buffer."""
+    vals = [_v(x) for x in inputs]
+    flat = jnp.concatenate([v.reshape(-1) for v in vals]) if copy_data \
+        else jnp.zeros(sum(int(np.prod(v.shape)) for v in vals),
+                       vals[0].dtype)
+    if set_constant:
+        flat = jnp.full_like(flat, constant)
+    outs = []
+    off = 0
+    for v in vals:
+        n = int(np.prod(v.shape))
+        outs.append(flat[off:off + n].reshape(v.shape))
+        off += n
+    return tuple(outs), flat
+
+
+def class_center_sample(label, num_classes, num_samples, ring_id=0, rank=0,
+                        nranks=1, fix_seed=False, seed=0):
+    """Sample negative class centers (reference class_center_sample_op,
+    PartialFC).  Positive classes always kept; negatives fill up to
+    num_samples.  Deterministic remap (sorted unique positives first)."""
+    lab = np.asarray(getattr(label, "_value", label)).reshape(-1)
+    pos = np.unique(lab)
+    rng = np.random.default_rng(seed if fix_seed else None)
+    neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+    n_extra = max(0, num_samples - pos.size)
+    extra = rng.choice(neg_pool, size=min(n_extra, neg_pool.size),
+                       replace=False) if n_extra else np.empty(0, np.int64)
+    sampled = np.concatenate([pos, np.sort(extra)]).astype(np.int64)
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(sampled.size)
+    return remap[lab], sampled
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True):
+    from ...text.viterbi_decode import viterbi_decode as f
+    scores, path = f(potentials, transition_params, lengths,
+                     include_bos_eos_tag)
+    return (getattr(scores, "_value", scores),
+            getattr(path, "_value", path))
+
+
+def accuracy(x, indices, label):
+    """Top-k accuracy op form (reference accuracy_op): x are top-k scores,
+    indices the top-k predicted ids, label [N, 1]."""
+    idx = _v(indices)
+    lab = _v(label).reshape(-1, 1)
+    hit = jnp.any(idx == lab, axis=1).astype(jnp.float32)
+    acc = hit.mean()
+    return acc, hit.sum(), jnp.asarray(hit.shape[0], jnp.int64)
+
+
+def enable_check_model_nan_inf(flag=1):
+    """Toggle the per-op NaN/Inf checker (reference
+    enable_check_model_nan_inf_op → FLAGS.check_nan_inf here)."""
+    from ...core.flags import FLAGS
+    FLAGS.check_nan_inf = bool(flag)
+    return jnp.asarray(bool(flag))
+
+
+def disable_check_model_nan_inf(flag=0):
+    from ...core.flags import FLAGS
+    FLAGS.check_nan_inf = bool(flag)
+    return jnp.asarray(bool(flag))
+
+
+def read_file(filename):
+    """Raw file bytes as a uint8 tensor (reference read_file_op)."""
+    with open(filename if isinstance(filename, str) else str(filename),
+              "rb") as f:
+        return np.frombuffer(f.read(), np.uint8).copy()
+
+
+def decode_jpeg(x, mode="unchanged"):
+    """JPEG decode via PIL (reference decode_jpeg_op's CPU path; the CUDA
+    nvjpeg path collapses to host-side decode feeding the device)."""
+    import io
+    try:
+        from PIL import Image
+    except ImportError as e:   # pragma: no cover
+        raise RuntimeError("decode_jpeg needs PIL") from e
+    buf = np.asarray(getattr(x, "_value", x)).astype(np.uint8).tobytes()
+    img = Image.open(io.BytesIO(buf))
+    if mode == "gray":
+        img = img.convert("L")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+def set_value_with_tensor(x, value, starts, ends, steps=None, axes=None,
+                          decrease_axes=(), none_axes=()):
+    """Strided slice assignment with a tensor value (reference
+    set_value_with_tensor op)."""
+    xv = _v(x)
+    vv = _v(value)
+    idx = [slice(None)] * xv.ndim
+    axes = list(axes) if axes is not None else list(range(len(starts)))
+    steps = list(steps) if steps is not None else [1] * len(starts)
+    for a, s, e, st in zip(axes, starts, ends, steps):
+        idx[a] = slice(int(s), int(e), int(st))
+    return xv.at[tuple(idx)].set(vv)
+
+
+def lookup_table_dequant(w, ids, scale=None, padding_idx=-1):
+    """Embedding lookup over a quantized table (reference
+    lookup_table_dequant_op): rows of int8 codes dequantized by per-row
+    scale on gather."""
+    wv = _v(w)
+    iv = _v(ids).astype(jnp.int32).reshape(-1)
+    rows = jnp.take(wv, iv, axis=0).astype(jnp.float32)
+    if scale is not None:
+        rows = rows * jnp.take(_v(scale), iv, axis=0)[:, None]
+    if padding_idx is not None and padding_idx >= 0:
+        rows = jnp.where((iv == padding_idx)[:, None], 0.0, rows)
+    return rows.reshape(tuple(_v(ids).shape) + (wv.shape[1],))

@@ -540,3 +540,44 @@ def memory_efficient_attention(query, key, value, bias=None, causal=False,
         query, key, value, attn_mask=bias, dropout_p=dropout_p,
         is_causal=causal, training=training)
     return _v(out)
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, **kw):
+    from ...incubate.nn import functional as IF
+    out = IF.fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, **kw)
+    if isinstance(out, tuple):
+        return tuple(_v(o) if not isinstance(o, list) else
+                     [_v(c) for c in o] for o in out)
+    return _v(out)
+
+
+def masked_multihead_attention_(x, cache_kv=None, bias=None, src_mask=None,
+                                sequence_lengths=None, **kw):
+    from ...incubate.nn import functional as IF
+    out = IF.masked_multihead_attention(x, cache_kv, bias, src_mask,
+                                        sequence_lengths, **kw)
+    return tuple(_v(o) for o in out) if isinstance(out, tuple) else _v(out)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    return _v(_F().fold(x, output_sizes, kernel_sizes, strides, paddings,
+                        dilations))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    return _v(_F().pixel_shuffle(x, upscale_factor, data_format))
+
+
+def bilinear(x1, x2, weight, bias=None):
+    return _v(_F().bilinear(x1, x2, weight, bias))
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100,
+             reduction="mean"):
+    return _v(_F().nll_loss(input, label, weight, ignore_index, reduction))

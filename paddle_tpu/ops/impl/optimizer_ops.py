@@ -1,0 +1,308 @@
+"""Optimizer-update op family (reference phi/kernels: sgd_kernel,
+momentum_kernel, adam_kernel, adamw, adagrad, adadelta, adamax, rmsprop,
+lamb, nadam, radam, asgd, rprop, ftrl, dpsgd, decayed_adagrad, merged_*,
+average_accumulates — ops.yaml's ``*_`` in-place optimizer ops).
+
+TPU-first shape: the reference mutates buffers in place inside per-param
+CUDA kernels; here each op is a PURE update function returning the new
+(param, moments...) pytree — the caller (optimizer classes, or a jitted
+train step via donate) rebinds.  All updates are elementwise VPU work that
+XLA fuses into one kernel per parameter; the optimizer classes in
+paddle_tpu/optimizer compose these same formulas over whole pytrees.
+
+All ops are non-differentiable (diff: false) like the reference's.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sgd_(param, learning_rate, grad, master_param=None):
+    return param - jnp.asarray(learning_rate) * grad
+
+
+def momentum_(param, grad, velocity, learning_rate, mu=0.9,
+              use_nesterov=False, regularization_method="",
+              regularization_coeff=0.0, master_param=None):
+    if regularization_method == "l2_decay":
+        grad = grad + regularization_coeff * param
+    v = mu * velocity + grad
+    lr = jnp.asarray(learning_rate)
+    if use_nesterov:
+        p = param - (grad + mu * v) * lr
+    else:
+        p = param - lr * v
+    return p, v
+
+
+def adam_(param, grad, learning_rate, moment1, moment2, beta1_pow,
+          beta2_pow, beta1=0.9, beta2=0.999, epsilon=1e-8,
+          master_param=None, skip_update=False):
+    """Adam update with running beta-power accumulators (reference
+    adam_kernel.h AdamDenseKernel)."""
+    m1 = beta1 * moment1 + (1 - beta1) * grad
+    m2 = beta2 * moment2 + (1 - beta2) * grad * grad
+    b1p = jnp.asarray(beta1_pow) * beta1
+    b2p = jnp.asarray(beta2_pow) * beta2
+    lr = jnp.asarray(learning_rate) * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p = param - lr * m1 / (jnp.sqrt(m2) + epsilon)
+    return p, m1, m2, b1p, b2p
+
+
+def adamw_(param, grad, learning_rate, moment1, moment2, beta1_pow,
+           beta2_pow, beta1=0.9, beta2=0.999, epsilon=1e-8,
+           coeff=0.01, lr_ratio=1.0, with_decay=True, master_param=None):
+    """AdamW: decoupled decay applied to the param before the Adam step
+    (reference adamw_kernel)."""
+    lr = jnp.asarray(learning_rate) * lr_ratio
+    if with_decay:
+        param = param * (1.0 - lr * coeff)
+    m1 = beta1 * moment1 + (1 - beta1) * grad
+    m2 = beta2 * moment2 + (1 - beta2) * grad * grad
+    b1p = jnp.asarray(beta1_pow) * beta1
+    b2p = jnp.asarray(beta2_pow) * beta2
+    step = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p = param - step * m1 / (jnp.sqrt(m2) + epsilon)
+    return p, m1, m2, b1p, b2p
+
+
+def adagrad_(param, grad, moment, learning_rate, epsilon=1e-6,
+             master_param=None):
+    mom = moment + grad * grad
+    p = param - jnp.asarray(learning_rate) * grad / (jnp.sqrt(mom) + epsilon)
+    return p, mom
+
+
+def decayed_adagrad(param, grad, moment, learning_rate, decay=0.95,
+                    epsilon=1e-6):
+    mom = decay * moment + (1 - decay) * grad * grad
+    p = param - jnp.asarray(learning_rate) * grad / (jnp.sqrt(mom) + epsilon)
+    return p, mom
+
+
+def adadelta_(param, grad, avg_squared_grad, avg_squared_update,
+              learning_rate=1.0, rho=0.95, epsilon=1e-6, master_param=None):
+    e_g = rho * avg_squared_grad + (1 - rho) * grad * grad
+    upd = jnp.sqrt(avg_squared_update + epsilon) / jnp.sqrt(e_g + epsilon) \
+        * grad
+    e_u = rho * avg_squared_update + (1 - rho) * upd * upd
+    p = param - jnp.asarray(learning_rate) * upd
+    return p, e_g, e_u
+
+
+def adamax_(param, grad, learning_rate, moment, inf_norm, beta1_pow,
+            beta1=0.9, beta2=0.999, epsilon=1e-8, master_param=None):
+    m = beta1 * moment + (1 - beta1) * grad
+    u = jnp.maximum(beta2 * inf_norm, jnp.abs(grad))
+    b1p = jnp.asarray(beta1_pow) * beta1
+    p = param - jnp.asarray(learning_rate) / (1 - b1p) * m / (u + epsilon)
+    return p, m, u, b1p
+
+
+def rmsprop_(param, mean_square, grad, moment, learning_rate,
+             mean_grad=None, epsilon=1e-10, decay=0.9, momentum=0.0,
+             centered=False, master_param=None):
+    ms = decay * mean_square + (1 - decay) * grad * grad
+    if centered:
+        mg = decay * mean_grad + (1 - decay) * grad
+        denom = ms - mg * mg
+    else:
+        mg = mean_grad
+        denom = ms
+    mom = momentum * moment + jnp.asarray(learning_rate) * grad \
+        / jnp.sqrt(denom + epsilon)
+    p = param - mom
+    return (p, ms, mom, mg) if centered else (p, ms, mom)
+
+
+def lamb_(param, grad, learning_rate, moment1, moment2, beta1_pow,
+          beta2_pow, weight_decay=0.01, beta1=0.9, beta2=0.999,
+          epsilon=1e-6, always_adapt=False, master_param=None):
+    """LAMB: layer-adaptive trust ratio on top of Adam (reference
+    lamb_kernel, You et al. arXiv:1904.00962)."""
+    m1 = beta1 * moment1 + (1 - beta1) * grad
+    m2 = beta2 * moment2 + (1 - beta2) * grad * grad
+    b1p = jnp.asarray(beta1_pow) * beta1
+    b2p = jnp.asarray(beta2_pow) * beta2
+    mhat = m1 / (1 - b1p)
+    vhat = m2 / (1 - b2p)
+    r = mhat / (jnp.sqrt(vhat) + epsilon) + weight_decay * param
+    p_norm = jnp.linalg.norm(param.astype(jnp.float32))
+    r_norm = jnp.linalg.norm(r.astype(jnp.float32))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    p = param - jnp.asarray(learning_rate) * trust * r
+    return p, m1, m2, b1p, b2p
+
+
+def nadam_(param, grad, learning_rate, moment1, moment2, beta1_pow,
+           beta2_pow, beta1=0.9, beta2=0.999, epsilon=1e-8,
+           master_param=None):
+    m1 = beta1 * moment1 + (1 - beta1) * grad
+    m2 = beta2 * moment2 + (1 - beta2) * grad * grad
+    b1p = jnp.asarray(beta1_pow) * beta1
+    b2p = jnp.asarray(beta2_pow) * beta2
+    mhat = beta1 * m1 / (1 - b1p) + (1 - beta1) * grad / (1 - b1p)
+    vhat = m2 / (1 - b2p)
+    p = param - jnp.asarray(learning_rate) * mhat / (jnp.sqrt(vhat) + epsilon)
+    return p, m1, m2, b1p, b2p
+
+
+def radam_(param, grad, learning_rate, moment1, moment2, beta1_pow,
+           beta2_pow, rho=None, beta1=0.9, beta2=0.999, epsilon=1e-8,
+           master_param=None):
+    """Rectified Adam (reference radam_kernel, Liu et al.
+    arXiv:1908.03265).  The step index derives from beta2_pow."""
+    m1 = beta1 * moment1 + (1 - beta1) * grad
+    m2 = beta2 * moment2 + (1 - beta2) * grad * grad
+    b1p = jnp.asarray(beta1_pow) * beta1
+    b2p = jnp.asarray(beta2_pow) * beta2
+    t = jnp.log(b2p) / jnp.log(beta2)          # step count
+    rho_inf = 2.0 / (1.0 - beta2) - 1.0
+    rho_t = rho_inf - 2.0 * t * b2p / (1.0 - b2p)
+    mhat = m1 / (1 - b1p)
+    r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                 / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-12))
+    lr = jnp.asarray(learning_rate)
+    adaptive = lr * r * mhat / (jnp.sqrt(m2 / (1 - b2p)) + epsilon)
+    sgd_step = lr * mhat
+    p = param - jnp.where(rho_t > 4.0, adaptive, sgd_step)
+    return p, m1, m2, b1p, b2p
+
+
+def asgd_(param, grad, learning_rate, d, y, n, master_param=None):
+    """Averaged SGD (reference asgd_kernel): d += g - y; y = g;
+    p -= lr/n * d."""
+    d_new = d - y + grad
+    p = param - jnp.asarray(learning_rate) / jnp.asarray(n) * d_new
+    return p, d_new, grad
+
+
+def rprop_(param, grad, prev, learning_rate, learning_rate_range=(1e-5, 50.0),
+           etas=(0.5, 1.2), master_param=None):
+    """Rprop with per-element step sizes (reference rprop_kernel).
+    ``learning_rate`` here is the per-element step tensor."""
+    sign = jnp.sign(grad * prev)
+    eta_minus, eta_plus = etas
+    lr = jnp.asarray(learning_rate)
+    lr = jnp.where(sign > 0, lr * eta_plus,
+                   jnp.where(sign < 0, lr * eta_minus, lr))
+    lr = jnp.clip(lr, learning_rate_range[0], learning_rate_range[1])
+    g_eff = jnp.where(sign < 0, 0.0, grad)
+    p = param - jnp.sign(g_eff) * lr
+    return p, g_eff, lr
+
+
+def ftrl(param, squared_accumulator, linear_accumulator, grad,
+         learning_rate, l1=0.0, l2=0.0, lr_power=-0.5):
+    """FTRL-proximal (reference ftrl_op, McMahan et al. 2013)."""
+    lr = jnp.asarray(learning_rate)
+    new_sq = squared_accumulator + grad * grad
+    sigma = (new_sq ** (-lr_power) - squared_accumulator ** (-lr_power)) / lr
+    lin = linear_accumulator + grad - sigma * param
+    quad = new_sq ** (-lr_power) / lr + 2.0 * l2
+    pre = jnp.clip(lin, -l1, l1) - lin
+    p = jnp.where(jnp.abs(lin) > l1, pre / quad, jnp.zeros_like(param))
+    return p, new_sq, lin
+
+
+def dpsgd(key, param, grad, learning_rate, clip=10.0, batch_size=16.0,
+          sigma=1.0):
+    """Differentially-private SGD (reference dpsgd_op): per-batch gradient
+    clip + gaussian noise.  key injected by the registry (rng: true)."""
+    gnorm = jnp.linalg.norm(grad.astype(jnp.float32))
+    scale = jnp.minimum(1.0, clip / jnp.maximum(gnorm, 1e-12))
+    noise = jax.random.normal(key, grad.shape, jnp.float32) * sigma * clip
+    g = (grad * scale + noise.astype(grad.dtype)) / batch_size
+    return param - jnp.asarray(learning_rate) * g
+
+
+def merged_adam_(params, grads, learning_rate, moments1, moments2,
+                 beta1_pows, beta2_pows, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, master_params=None):
+    """Multi-tensor Adam (reference merged_adam_kernel) — one fused update
+    over a list of params; XLA fuses the whole batch into few kernels."""
+    outs = [adam_(p, g, learning_rate, m1, m2, b1p, b2p, beta1, beta2,
+                  epsilon)
+            for p, g, m1, m2, b1p, b2p in zip(params, grads, moments1,
+                                              moments2, beta1_pows,
+                                              beta2_pows)]
+    return (tuple(o[0] for o in outs), tuple(o[1] for o in outs),
+            tuple(o[2] for o in outs), tuple(o[3] for o in outs),
+            tuple(o[4] for o in outs))
+
+
+def merged_momentum_(params, grads, velocitys, learning_rate, mu=0.9,
+                     use_nesterov=False, master_params=None):
+    outs = [momentum_(p, g, v, learning_rate, mu, use_nesterov)
+            for p, g, v in zip(params, grads, velocitys)]
+    return tuple(o[0] for o in outs), tuple(o[1] for o in outs)
+
+
+def average_accumulates_(param, in_sum_1, in_sum_2, in_sum_3,
+                         in_num_accumulates, in_old_num_accumulates,
+                         in_num_updates, average_window=10000,
+                         max_average_window=10000, min_average_window=10000):
+    """Sliding-window parameter averaging accumulators (reference
+    average_accumulates_op, used by ModelAverage)."""
+    num_upd = in_num_updates + 1
+    num_acc = in_num_accumulates + 1
+    s1 = in_sum_1 + param
+    s2 = in_sum_2
+    s3 = in_sum_3
+    old = in_old_num_accumulates
+    # window boundary: fold sum_1 into sum_2
+    boundary = num_upd % average_window == 0
+    s2 = jnp.where(boundary, s2 + s1, s2)
+    s1 = jnp.where(boundary, jnp.zeros_like(s1), s1)
+    # overflow: snapshot the window into sum_3 and restart accumulation
+    overflow = num_acc >= max_average_window
+    s3 = jnp.where(overflow, s1 + s2, s3)
+    s1 = jnp.where(overflow, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(overflow, jnp.zeros_like(s2), s2)
+    old = jnp.where(overflow, num_acc, old)
+    num_acc = jnp.where(overflow, 0, num_acc)
+    return s1, s2, s3, num_acc, old, num_upd
+
+
+# ----------------------------------------------------------------- AMP ops
+def check_finite_and_unscale_(xs, scale):
+    """Unscale grads by 1/scale and flag non-finite values (reference
+    check_finite_and_unscale_kernel; used by amp.GradScaler)."""
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    inv = 1.0 / jnp.asarray(scale)
+    found = jnp.zeros((), jnp.bool_)
+    outs = []
+    for x in xs:
+        x = jnp.asarray(x)
+        bad = ~jnp.all(jnp.isfinite(x))
+        found = found | bad
+        outs.append(x * inv.astype(x.dtype))
+    return tuple(outs), found
+
+
+def update_loss_scaling_(xs, found_infinite, prev_loss_scaling,
+                         in_good_steps, in_bad_steps, incr_every_n_steps=2000,
+                         decr_every_n_nan_or_inf=2, incr_ratio=2.0,
+                         decr_ratio=0.5, stop_update=False):
+    """Dynamic loss-scale update (reference update_loss_scaling_kernel):
+    grow after N clean steps, shrink after M bad ones; zero grads on a bad
+    step."""
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    bad = jnp.asarray(found_infinite)
+    good = jnp.where(bad, 0, in_good_steps + 1)
+    bads = jnp.where(bad, in_bad_steps + 1, 0)
+    scale = jnp.asarray(prev_loss_scaling)
+    grow = good >= incr_every_n_steps
+    shrink = bads >= decr_every_n_nan_or_inf
+    new_scale = jnp.where(grow, scale * incr_ratio,
+                          jnp.where(shrink, jnp.maximum(scale * decr_ratio,
+                                                        1.0), scale))
+    good = jnp.where(grow, 0, good)
+    bads = jnp.where(shrink, 0, bads)
+    if stop_update:
+        new_scale, good, bads = scale, in_good_steps, in_bad_steps
+    outs = tuple(jnp.where(bad, jnp.zeros_like(jnp.asarray(x)),
+                           jnp.asarray(x)) for x in xs)
+    return outs, new_scale, good, bads

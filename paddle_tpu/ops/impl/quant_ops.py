@@ -1,0 +1,186 @@
+"""Quantization op family (reference phi/kernels:
+fake_quantize_abs_max & friends — fluid/operators/fake_quantize_op.h —
+plus dequantize_abs_max, dequantize_log, apply_per_channel_scale).
+
+Fake-quant forward math mirrors quantization/quanters.py's STE kernel;
+these op forms expose the reference's per-op API (returning the scale
+outputs the static-graph quant passes consume).  All elementwise — XLA
+fuses each into a single VPU kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _qmax(bit_length):
+    return float(2 ** (bit_length - 1) - 1)
+
+
+def _quant(x, scale, qmax, round_type=1):
+    s = jnp.maximum(jnp.asarray(scale), 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q
+
+
+def fake_quantize_abs_max(x, bit_length=8, round_type=1):
+    """out = round(x/absmax * qmax); returns (out, out_scale=absmax)."""
+    x = jnp.asarray(x)
+    qmax = _qmax(bit_length)
+    scale = jnp.max(jnp.abs(x))
+    return _quant(x, scale, qmax, round_type), scale.reshape(1)
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length=8, round_type=1):
+    x = jnp.asarray(x)
+    qmax = _qmax(bit_length)
+    scale = jnp.max(jnp.abs(x))
+    q = _quant(x, scale, qmax, round_type)
+    return q * jnp.maximum(scale, 1e-9) / qmax, scale.reshape(1)
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length=8, round_type=1,
+                                       quant_axis=0):
+    x = jnp.asarray(x)
+    qmax = _qmax(bit_length)
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes)
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    return _quant(x, scale.reshape(shape), qmax, round_type), scale
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  round_type=1,
+                                                  quant_axis=0):
+    x = jnp.asarray(x)
+    qmax = _qmax(bit_length)
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes)
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    sb = jnp.maximum(scale.reshape(shape), 1e-9)
+    q = _quant(x, sb, qmax, round_type)
+    return q * sb / qmax, scale
+
+
+def fake_channel_wise_dequantize_max_abs(x, scales, quant_bits=(8,),
+                                         quant_axis=0, x_num_col_dims=1):
+    """Dequantize channel-wise-quantized ints back to float (reference
+    fake_dequantize_op.h).  ``scales`` is a list; the last entry is the
+    activation scale when two are given."""
+    x = jnp.asarray(x, jnp.float32)
+    scales = scales if isinstance(scales, (list, tuple)) else [scales]
+    qmax0 = _qmax(quant_bits[0])
+    s0 = jnp.asarray(scales[0])
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    out = x * s0.reshape(shape) / qmax0
+    if len(scales) > 1 and scales[1] is not None:
+        qmax1 = _qmax(quant_bits[1])
+        out = out * jnp.asarray(scales[1]).reshape(()) / qmax1
+    return out
+
+
+def fake_dequantize_max_abs(x, scale, max_range):
+    return jnp.asarray(x, jnp.float32) * jnp.asarray(scale) / max_range
+
+
+def fake_quantize_range_abs_max(x, in_scale, iter=None, window_size=10000,
+                                bit_length=8, is_test=False, round_type=1):
+    """Windowed running abs-max scale (reference FakeQuantizeRangeAbsMax).
+    Returns (out, out_scale).  The windowed scale history collapses to a
+    running max here — the history buffer exists for the static-graph pass,
+    which this framework replaces with recompilation."""
+    x = jnp.asarray(x)
+    qmax = _qmax(bit_length)
+    cur = jnp.max(jnp.abs(x))
+    scale = jnp.where(is_test, jnp.asarray(in_scale).reshape(()),
+                      jnp.maximum(cur, jnp.asarray(in_scale).reshape(())))
+    return _quant(x, scale, qmax, round_type), scale.reshape(1)
+
+
+def fake_quantize_moving_average_abs_max(x, in_scale, in_accum=None,
+                                         in_state=None, moving_rate=0.9,
+                                         bit_length=8, is_test=False,
+                                         round_type=1):
+    """EMA abs-max scale (reference FakeQuantizeMovingAverageAbsMax):
+    state = rate*state + 1; accum = rate*accum + absmax; scale =
+    accum/state.  Returns (out, out_scale, out_state, out_accum)."""
+    x = jnp.asarray(x)
+    qmax = _qmax(bit_length)
+    cur = jnp.max(jnp.abs(x))
+    state = jnp.asarray(1.0 if in_state is None else in_state).reshape(())
+    accum = jnp.asarray(0.0 if in_accum is None else in_accum).reshape(())
+    new_state = jnp.where(is_test, state, moving_rate * state + 1.0)
+    new_accum = jnp.where(is_test, accum, moving_rate * accum + cur)
+    scale = jnp.where(is_test, jnp.asarray(in_scale).reshape(()),
+                      new_accum / new_state)
+    out = _quant(x, scale, qmax, round_type)
+    return out, scale.reshape(1), new_state.reshape(1), new_accum.reshape(1)
+
+
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, in_scale, in_accum=None, in_state=None, moving_rate=0.9,
+        bit_length=8, is_test=False, round_type=1):
+    q, scale, st, acc = fake_quantize_moving_average_abs_max(
+        x, in_scale, in_accum, in_state, moving_rate, bit_length, is_test,
+        round_type)
+    qmax = _qmax(bit_length)
+    return q * jnp.maximum(scale, 1e-9) / qmax, scale, st, acc
+
+
+def dequantize_abs_max(x, scale, max_range):
+    return jnp.asarray(x, jnp.float32) * jnp.asarray(scale) / max_range
+
+
+def dequantize_log(x, dict):
+    """Log-quantization decode (reference dequantize_log_op): x holds int8
+    codes, ``dict`` the 128-entry magnitude table; sign in the high bit."""
+    x = jnp.asarray(x).astype(jnp.int32)
+    table = jnp.asarray(dict).reshape(-1)
+    neg = x < 0
+    idx = jnp.where(neg, x + 128, x)
+    mag = table[jnp.clip(idx, 0, table.shape[0] - 1)]
+    return jnp.where(neg, -mag, mag)
+
+
+def apply_per_channel_scale(x, scales):
+    """x * scales broadcast over the last dim (reference
+    apply_per_channel_scale_kernel, smooth-quant prelude)."""
+    x = jnp.asarray(x)
+    return x * jnp.asarray(scales).reshape((1,) * (x.ndim - 1) + (-1,))
+
+
+# weight-only / llm.int8 linear op forms (kernels in nn/quant — Pallas
+# streaming-dequant matmul; reference weight_only_linear_kernel.h,
+# fusion/cutlass llm_int8)
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    from ...nn.quant import weight_quantize as f
+    out = f(x, algo, arch, group_size)
+    return tuple(jnp.asarray(getattr(o, "_value", o)) for o in out)
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float32",
+                      group_size=-1):
+    if group_size not in (-1, None):
+        raise NotImplementedError(
+            "weight_dequantize: grouped scales not implemented")
+    from ...nn.quant import weight_dequantize as f
+    out = f(x, scale, algo, out_dtype=out_dtype or "float32")
+    return jnp.asarray(getattr(out, "_value", out))
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    from ...nn.quant import weight_only_linear as f
+    out = f(x, weight, bias, weight_scale, weight_dtype, arch, group_size)
+    return jnp.asarray(getattr(out, "_value", out))
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    from ...nn.quant import llm_int8_linear as f
+    out = f(x, weight, bias, weight_scale, threshold)
+    return jnp.asarray(getattr(out, "_value", out))

@@ -160,3 +160,25 @@ def truncated_gaussian_random(key, shape, mean=0.0, std=1.0, a=-2.0, b=2.0,
 def exponential_(key, x, lam=1.0):
     return jax.random.exponential(key, jnp.shape(x),
                                   jnp.asarray(x).dtype) / lam
+
+
+def uniform_inplace(key, x, min=-1.0, max=1.0, seed=0, diag_num=0,
+                    diag_step=0, diag_val=1.0):
+    """Refill with U(min, max) (reference uniform_inplace op)."""
+    x = jnp.asarray(getattr(x, "_value", x))
+    return jax.random.uniform(key, x.shape, x.dtype, min, max)
+
+
+def gaussian_inplace(key, x, mean=0.0, std=1.0, seed=0):
+    x = jnp.asarray(getattr(x, "_value", x))
+    return jax.random.normal(key, x.shape, x.dtype) * std + mean
+
+
+def uniform_random_batch_size_like(key, input, shape, input_dim_idx=0,
+                                   output_dim_idx=0, min=-1.0, max=1.0,
+                                   seed=0, dtype=None):
+    x = jnp.asarray(getattr(input, "_value", input))
+    s = list(_shape(shape))
+    s[output_dim_idx] = x.shape[input_dim_idx]
+    dt = _dt.canonical_dtype(dtype) or x.dtype
+    return jax.random.uniform(key, tuple(s), dt, min, max)

@@ -1,0 +1,244 @@
+"""RNN op family (reference phi rnn_kernel — the cudnn-backed fused
+multi-layer RNN op — plus legacy gru/lstm/gru_unit/attention_lstm ops).
+
+TPU-first: every recurrence is the same ``lax.scan`` core the nn.layer.rnn
+cells use (one big input-projection matmul per layer on the MXU, then a
+scan of [B, H] steps), stacked over layers/directions in a static Python
+loop.  The reference's cudnn descriptor plumbing and workspace management
+collapse — XLA handles scheduling and memory.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _v(x):
+    return jnp.asarray(getattr(x, "_value", x))
+
+
+def _scan_one(mode, x, h0, c0, w_ih, w_hh, b_ih, b_hh, seq_len, reverse):
+    from ...nn.layer.rnn import _simple_rnn_scan, _lstm_scan, _gru_scan
+    if mode == "LSTM":
+        ys, h, c = _lstm_scan(x, h0, c0, w_ih, w_hh, b_ih, b_hh, seq_len,
+                              reverse=reverse)
+        return ys, h, c
+    if mode == "GRU":
+        ys, h = _gru_scan(x, h0, w_ih, w_hh, b_ih, b_hh, seq_len,
+                          reverse=reverse)
+        return ys, h, None
+    act = "tanh" if mode in ("RNN_TANH", "RNN") else "relu"
+    ys, h = _simple_rnn_scan(x, h0, w_ih, w_hh, b_ih, b_hh, seq_len,
+                             activation=act, reverse=reverse)
+    return ys, h, None
+
+
+def rnn(x, pre_state, weight_list, sequence_length=None, dropout_prob=0.0,
+        is_bidirec=False, input_size=-1, hidden_size=-1, num_layers=1,
+        mode="LSTM", seed=0, is_test=True):
+    """Fused multi-layer (bi)directional RNN (reference phi/kernels/
+    rnn_kernel.cc / cudnn_lstm).  x: [T, B, I] time-major.  pre_state:
+    [init_h] or [init_h, init_c], each [L*D, B, H].  weight_list: per
+    (layer, direction): w_ih [G*H, I], w_hh [G*H, H], b_ih, b_hh —
+    reference flat-weight order.  Returns (out [T, B, D*H], state list).
+
+    Inter-layer dropout is taken at trace time from the global generator
+    when training (is_test=False)."""
+    x = _v(x)
+    D = 2 if is_bidirec else 1
+    hs = [_v(h) for h in (pre_state if isinstance(pre_state, (list, tuple))
+                          else [pre_state])]
+    init_h = hs[0]
+    init_c = hs[1] if mode == "LSTM" else None
+    ws = [_v(w) for w in weight_list]
+    seq_len = None if sequence_length is None \
+        else _v(sequence_length).astype(jnp.int32)
+
+    out = x
+    h_n, c_n = [], []
+    for layer in range(num_layers):
+        dir_outs = []
+        for d in range(D):
+            i = (layer * D + d) * 4
+            w_ih, w_hh, b_ih, b_hh = ws[i], ws[i + 1], ws[i + 2], ws[i + 3]
+            h0 = init_h[layer * D + d]
+            c0 = init_c[layer * D + d] if init_c is not None else None
+            ys, h, c = _scan_one(mode, out, h0, c0, w_ih, w_hh, b_ih, b_hh,
+                                 seq_len, reverse=(d == 1))
+            dir_outs.append(ys)
+            h_n.append(h)
+            if c is not None:
+                c_n.append(c)
+        out = (jnp.concatenate(dir_outs, axis=-1) if D == 2
+               else dir_outs[0])
+        if dropout_prob > 0.0 and not is_test and layer < num_layers - 1:
+            from ...core.rng import next_rng_key
+            keep = jax.random.bernoulli(next_rng_key(), 1.0 - dropout_prob,
+                                        out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout_prob), 0.0)
+    state = [jnp.stack(h_n)]
+    if mode == "LSTM":
+        state.append(jnp.stack(c_n))
+    return out, state
+
+
+def cudnn_lstm(x, init_h, init_c, weight_list, sequence_length=None,
+               dropout_prob=0.0, is_bidirec=False, hidden_size=-1,
+               num_layers=1, is_test=True, seed=0):
+    """cudnn_lstm op form — the rnn kernel with mode=LSTM (reference
+    cudnn_lstm_op; on TPU there is no separate cudnn path)."""
+    out, (h, c) = rnn(x, [init_h, init_c], weight_list, sequence_length,
+                      dropout_prob, is_bidirec, -1, hidden_size, num_layers,
+                      "LSTM", seed, is_test)
+    return out, h, c
+
+
+def lstm(x, h0, c0, weight, bias, sequence_length=None, use_peepholes=False,
+         is_reverse=False, gate_activation="sigmoid",
+         cell_activation="tanh", candidate_activation="tanh"):
+    """Legacy single-layer LSTM op (reference lstm_op).  x: [T, B, 4H]
+    pre-projected gate inputs (the legacy op fuses the input projection
+    outside); weight: [H, 4H] recurrent weights."""
+    x = _v(x)
+    w = _v(weight)
+    b = _v(bias).reshape(-1)
+    H = w.shape[0]
+    T, B = x.shape[0], x.shape[1]
+    h0 = jnp.zeros((B, H), x.dtype) if h0 is None else _v(h0)
+    c0 = jnp.zeros((B, H), x.dtype) if c0 is None else _v(c0)
+    seq_len = None if sequence_length is None \
+        else _v(sequence_length).astype(jnp.int32)
+    from ...nn.layer.rnn import _mask_step
+
+    def body(carry, inp):
+        h, c = carry
+        t, xt = inp
+        gates = xt + h @ w + b[:4 * H]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        h2 = _mask_step(h_new, h, t, seq_len)
+        c2 = _mask_step(c_new, c, t, seq_len)
+        return (h2, c2), h2
+
+    ts = jnp.arange(T) if not is_reverse else jnp.arange(T - 1, -1, -1)
+    xs = x if not is_reverse else x[::-1]
+    (h_n, c_n), ys = jax.lax.scan(body, (h0, c0), (ts, xs))
+    if is_reverse:
+        ys = ys[::-1]
+    return ys, h_n, c_n
+
+
+def gru(x, h0, weight, bias=None, sequence_length=None, is_reverse=False,
+        activation="tanh", gate_activation="sigmoid",
+        origin_mode=False):
+    """Legacy single-layer GRU op (reference gru_op).  x: [T, B, 3H]
+    pre-projected; weight [H, 3H] recurrent (gates [u, r] then candidate)."""
+    x = _v(x)
+    w = _v(weight)
+    H = w.shape[0]
+    T, B = x.shape[0], x.shape[1]
+    h0 = jnp.zeros((B, H), x.dtype) if h0 is None else _v(h0)
+    b = jnp.zeros((3 * H,), x.dtype) if bias is None \
+        else _v(bias).reshape(-1)
+    w_g = w[:, :2 * H]
+    w_c = w[:, 2 * H:]
+    seq_len = None if sequence_length is None \
+        else _v(sequence_length).astype(jnp.int32)
+    from ...nn.layer.rnn import _mask_step
+
+    def body(h, inp):
+        t, xt = inp
+        xg = xt[:, :2 * H] + h @ w_g + b[:2 * H]
+        u = jax.nn.sigmoid(xg[:, :H])
+        r = jax.nn.sigmoid(xg[:, H:])
+        c = jnp.tanh(xt[:, 2 * H:] + (r * h) @ w_c + b[2 * H:])
+        if origin_mode:
+            h_new = u * h + (1 - u) * c
+        else:
+            h_new = (1 - u) * h + u * c
+        h2 = _mask_step(h_new, h, t, seq_len)
+        return h2, h2
+
+    ts = jnp.arange(T) if not is_reverse else jnp.arange(T - 1, -1, -1)
+    xs = x if not is_reverse else x[::-1]
+    h_n, ys = jax.lax.scan(body, h0, (ts, xs))
+    if is_reverse:
+        ys = ys[::-1]
+    return ys, h_n
+
+
+def gru_unit(input, hidden_prev, weight, bias=None, activation="tanh",
+             gate_activation="sigmoid", origin_mode=False):
+    """One GRU step (reference gru_unit_op): input [B, 3H] pre-projected,
+    weight [H, 3H]."""
+    x = _v(input)
+    h = _v(hidden_prev)
+    w = _v(weight)
+    H = h.shape[-1]
+    b = jnp.zeros((3 * H,), x.dtype) if bias is None \
+        else _v(bias).reshape(-1)
+    xg = x[:, :2 * H] + h @ w[:, :2 * H] + b[:2 * H]
+    u = jax.nn.sigmoid(xg[:, :H])
+    r = jax.nn.sigmoid(xg[:, H:])
+    c = jnp.tanh(x[:, 2 * H:] + (r * h) @ w[:, 2 * H:] + b[2 * H:])
+    if origin_mode:
+        h_new = u * h + (1 - u) * c
+    else:
+        h_new = (1 - u) * h + u * c
+    return h_new, r * h, c
+
+
+def attention_lstm(x, lengths, c0, h0, attention_weight, attention_bias,
+                   lstm_weight, lstm_bias, use_peepholes=False,
+                   gate_activation="sigmoid", cell_activation="tanh",
+                   candidate_activation="tanh"):
+    """Attention LSTM (reference attention_lstm_op): at each step the
+    attention MLP scores every encoder input against h_{t-1}, softmaxes
+    into a context vector, and the LSTM consumes it.
+
+    Dense form: x [B, T, M] + lengths (the reference takes LoD).
+    attention_weight: [M + D, 1]; lstm_weight: [D + M, 4D]."""
+    x = _v(x)
+    B, T, M = x.shape
+    aw = _v(attention_weight)
+    ab = None if attention_bias is None else _v(attention_bias).reshape(-1)
+    lw = _v(lstm_weight)
+    lb = _v(lstm_bias).reshape(-1)
+    D = lw.shape[1] // 4
+    h = jnp.zeros((B, D), x.dtype) if h0 is None else _v(h0)
+    c = jnp.zeros((B, D), x.dtype) if c0 is None else _v(c0)
+    ln = None if lengths is None else _v(lengths).astype(jnp.int32)
+    valid = (jnp.arange(T)[None, :] < ln[:, None]) if ln is not None \
+        else jnp.ones((B, T), bool)
+
+    aw_x, aw_h = aw[:M, 0], aw[M:, 0]
+
+    from ...nn.layer.rnn import _mask_step
+
+    def step(carry, t):
+        h, c = carry
+        score = x @ aw_x + (h @ aw_h[:, None])[:, 0:1]     # [B, T]
+        if ab is not None:
+            score = score + ab[0]
+        score = jnp.where(valid, score, -1e30)
+        a = jax.nn.softmax(score, axis=-1)
+        ctx = jnp.einsum("bt,btm->bm", a, x)               # [B, M]
+        inp = jnp.concatenate([h, ctx], axis=-1)           # [B, D+M]
+        gates = inp @ lw + lb
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        # freeze state past each row's own length (reference stops at the
+        # sequence end; padding steps must not advance h/c)
+        h2 = _mask_step(h_new, h, t, ln)
+        c2 = _mask_step(c_new, c, t, ln)
+        return (h2, c2), h2
+
+    (h_n, c_n), ys = jax.lax.scan(step, (h, c), jnp.arange(T))
+    return jnp.swapaxes(ys, 0, 1), h_n, c_n

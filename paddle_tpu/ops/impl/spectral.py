@@ -239,3 +239,51 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
     if length is not None:
         y = y[..., :length]
     return y
+
+
+# phi op forms (reference fft_c2c/fft_r2c/fft_c2r ops): thin over the
+# namespace kernels above with the axes/normalization arg order of the op
+def fft_c2c(x, axes=None, normalization="backward", forward=True):
+    x = jnp.asarray(getattr(x, "_value", x))
+    fn = jnp.fft.fftn if forward else jnp.fft.ifftn
+    return fn(x, axes=axes, norm=normalization)
+
+
+def fft_r2c(x, axes=None, normalization="backward", forward=True,
+            onesided=True):
+    x = jnp.asarray(getattr(x, "_value", x))
+    if not forward:
+        # inverse transform of a real signal (ihfft-style): full ifft,
+        # truncated to the one-sided spectrum when requested
+        full = jnp.fft.ifftn(x.astype(jnp.complex64), axes=axes,
+                             norm=normalization)
+        if onesided:
+            ax = (axes[-1] if axes else -1)
+            n = x.shape[ax] // 2 + 1
+            full = jax.lax.slice_in_dim(full, 0, n, axis=ax if ax >= 0
+                                        else full.ndim + ax)
+        return full
+    if onesided:
+        return jnp.fft.rfftn(x, axes=axes, norm=normalization)
+    return jnp.fft.fftn(x.astype(jnp.complex64), axes=axes,
+                        norm=normalization)
+
+
+def fft_c2r(x, axes=None, normalization="backward", forward=True,
+            last_dim_size=0):
+    x = jnp.asarray(getattr(x, "_value", x))
+    s = None
+    if last_dim_size:
+        s = [last_dim_size]
+    if forward:
+        # forward complex->real (hfft-style): conjugate-symmetric input
+        return jnp.fft.irfftn(jnp.conj(x), s=s, axes=axes,
+                              norm=_HFFT_NORM.get(normalization,
+                                                  normalization))
+    return jnp.fft.irfftn(x, s=s, axes=axes, norm=normalization)
+
+
+# hfft uses the inverse transform with the conjugate, so the norm mode
+# flips (numpy hfft convention: forward <-> backward)
+_HFFT_NORM = {"backward": "forward", "forward": "backward",
+              "ortho": "ortho"}

@@ -9,6 +9,7 @@ COO-oriented; ``to_dense`` round-trips are exact.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional, Sequence
 
 import jax
@@ -23,7 +24,36 @@ __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
            "multiply", "divide", "matmul", "masked_matmul", "mv", "sum",
            "abs", "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh",
            "atanh", "sqrt", "square", "log1p", "expm1", "pow", "cast",
-           "neg", "coalesce", "relu", "softmax", "to_dense"]
+           "neg", "coalesce", "relu", "softmax", "to_dense",
+           "SelectedRows"]
+
+
+@dataclasses.dataclass
+class SelectedRows:
+    """Row-sparse gradient container (reference phi/core/selected_rows.h):
+    ``values[i]`` is the dense row for global row id ``rows[i]`` of a
+    [height, ...] tensor.  The reference threads these through embedding
+    grads and the *_sr optimizer kernels; here the eager tape densifies by
+    default and SelectedRows is the explicit opt-in form
+    (merge_selected_rows / to_dense)."""
+    rows: "np.ndarray"
+    values: "np.ndarray"
+    height: int
+
+    def to_dense(self):
+        rows = np.asarray(getattr(self.rows, "_value", self.rows))
+        vals = np.asarray(getattr(self.values, "_value", self.values))
+        out = np.zeros((self.height,) + tuple(vals.shape[1:]), vals.dtype)
+        np.add.at(out, rows, vals)
+        return Tensor(jnp.asarray(out))
+
+
+# pytree registration lets SelectedRows flow through run_op / jit like any
+# other container (rows/values are leaves, height is static structure)
+jax.tree_util.register_pytree_node(
+    SelectedRows,
+    lambda sr: ((sr.rows, sr.values), sr.height),
+    lambda height, children: SelectedRows(children[0], children[1], height))
 
 
 class SparseCooTensor:

@@ -1,0 +1,328 @@
+"""Optimizer/quant/misc/graph op-tail tests (reference
+test/legacy_test/test_adam_op.py, test_fake_quantize_op.py,
+test_sequence_pool.py, test_auc_op.py, test_warprnnt_op.py, ...)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _np(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+rng = np.random.default_rng(0)
+
+
+class TestOptimizerOps:
+    def test_sgd(self):
+        p = rng.normal(size=(4,)).astype(np.float32)
+        g = rng.normal(size=(4,)).astype(np.float32)
+        out = _np(pt.sgd_(pt.Tensor(p), 0.1, pt.Tensor(g)))
+        np.testing.assert_allclose(out, p - 0.1 * g, rtol=1e-6)
+
+    def test_momentum_nesterov(self):
+        p = rng.normal(size=(4,)).astype(np.float32)
+        g = rng.normal(size=(4,)).astype(np.float32)
+        v = np.zeros(4, np.float32)
+        out, v1 = pt.momentum_(pt.Tensor(p), pt.Tensor(g), pt.Tensor(v),
+                               0.1, mu=0.9, use_nesterov=True)
+        np.testing.assert_allclose(_np(v1), g, rtol=1e-6)
+        np.testing.assert_allclose(_np(out), p - 0.1 * (g + 0.9 * g),
+                                   rtol=1e-6)
+
+    def test_adam_matches_manual(self):
+        p = rng.normal(size=(6,)).astype(np.float32)
+        g = rng.normal(size=(6,)).astype(np.float32)
+        m = np.zeros(6, np.float32)
+        v = np.zeros(6, np.float32)
+        out = pt.adam_(pt.Tensor(p), pt.Tensor(g), 0.01, pt.Tensor(m),
+                       pt.Tensor(v), 1.0, 1.0)
+        pn, m1, v1, b1p, b2p = (_np(o) for o in out)
+        em = 0.1 * g
+        ev = 0.001 * g * g
+        lr = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+        np.testing.assert_allclose(m1, em, rtol=1e-5)
+        np.testing.assert_allclose(v1, ev, rtol=1e-5)
+        np.testing.assert_allclose(pn, p - lr * em / (np.sqrt(ev) + 1e-8),
+                                   rtol=1e-5)
+        assert b1p == pytest.approx(0.9) and b2p == pytest.approx(0.999)
+
+    def test_adamw_decay(self):
+        p = np.ones(4, np.float32)
+        g = np.zeros(4, np.float32)
+        out = pt.adamw_(pt.Tensor(p), pt.Tensor(g), 0.1, pt.Tensor(g),
+                        pt.Tensor(g), 1.0, 1.0, coeff=0.5)
+        np.testing.assert_allclose(_np(out[0]), p * (1 - 0.1 * 0.5),
+                                   rtol=1e-6)
+
+    def test_optimizer_ops_run(self):
+        p = rng.normal(size=(4,)).astype(np.float32)
+        g = rng.normal(size=(4,)).astype(np.float32)
+        z = np.zeros(4, np.float32)
+        o = np.ones(4, np.float32)
+        pt.adagrad_(pt.Tensor(p), pt.Tensor(g), pt.Tensor(z), 0.1)
+        pt.adadelta_(pt.Tensor(p), pt.Tensor(g), pt.Tensor(z), pt.Tensor(z))
+        pt.adamax_(pt.Tensor(p), pt.Tensor(g), 0.1, pt.Tensor(z),
+                   pt.Tensor(z), 1.0)
+        pt.rmsprop_(pt.Tensor(p), pt.Tensor(z), pt.Tensor(g), pt.Tensor(z),
+                    0.1)
+        pt.lamb_(pt.Tensor(p), pt.Tensor(g), 0.1, pt.Tensor(z),
+                 pt.Tensor(z), 1.0, 1.0)
+        pt.nadam_(pt.Tensor(p), pt.Tensor(g), 0.1, pt.Tensor(z),
+                  pt.Tensor(z), 1.0, 1.0)
+        pt.radam_(pt.Tensor(p), pt.Tensor(g), 0.1, pt.Tensor(z),
+                  pt.Tensor(z), 1.0, 1.0)
+        pt.asgd_(pt.Tensor(p), pt.Tensor(g), 0.1, pt.Tensor(z),
+                 pt.Tensor(z), 4.0)
+        pt.rprop_(pt.Tensor(p), pt.Tensor(g), pt.Tensor(g),
+                  pt.Tensor(o * 0.01))
+        pt.ftrl(pt.Tensor(p), pt.Tensor(o), pt.Tensor(z), pt.Tensor(g), 0.1)
+        pt.dpsgd(pt.Tensor(p), pt.Tensor(g), 0.1)
+        pt.decayed_adagrad(pt.Tensor(p), pt.Tensor(g), pt.Tensor(z), 0.1)
+
+    def test_merged_adam(self):
+        ps = [rng.normal(size=(3,)).astype(np.float32) for _ in range(2)]
+        gs = [rng.normal(size=(3,)).astype(np.float32) for _ in range(2)]
+        zs = [np.zeros(3, np.float32) for _ in range(2)]
+        outs = pt.merged_adam_([pt.Tensor(p) for p in ps],
+                               [pt.Tensor(g) for g in gs], 0.01,
+                               [pt.Tensor(z) for z in zs],
+                               [pt.Tensor(z) for z in zs],
+                               [1.0, 1.0], [1.0, 1.0])
+        single = pt.adam_(pt.Tensor(ps[1]), pt.Tensor(gs[1]), 0.01,
+                          pt.Tensor(zs[1]), pt.Tensor(zs[1]), 1.0, 1.0)
+        np.testing.assert_allclose(_np(outs[0][1]), _np(single[0]),
+                                   rtol=1e-6)
+
+
+class TestAmpOps:
+    def test_check_finite_and_unscale(self):
+        xs = [np.array([2.0, 4.0], np.float32)]
+        outs, found = pt.check_finite_and_unscale_(
+            [pt.Tensor(x) for x in xs], 2.0)
+        assert not bool(_np(found))
+        np.testing.assert_allclose(_np(outs[0]), [1.0, 2.0])
+        bad = [np.array([np.inf, 1.0], np.float32)]
+        _, found = pt.check_finite_and_unscale_(
+            [pt.Tensor(x) for x in bad], 2.0)
+        assert bool(_np(found))
+
+    def test_update_loss_scaling(self):
+        xs = [np.ones(3, np.float32)]
+        outs, scale, good, bads = pt.update_loss_scaling_(
+            [pt.Tensor(x) for x in xs], False, 1024.0, 0, 0,
+            incr_every_n_steps=1)
+        assert float(_np(scale)) == pytest.approx(2048.0)
+        outs, scale, good, bads = pt.update_loss_scaling_(
+            [pt.Tensor(x) for x in xs], True, 1024.0, 0, 1,
+            decr_every_n_nan_or_inf=2)
+        assert float(_np(scale)) == pytest.approx(512.0)
+        np.testing.assert_allclose(_np(outs[0]), 0.0)   # bad step zeros
+
+
+class TestQuantOps:
+    def test_fake_quantize_abs_max(self):
+        x = np.array([-1.0, 0.5, 0.25], np.float32)
+        q, scale = pt.fake_quantize_abs_max(pt.Tensor(x))
+        assert float(_np(scale)[0]) == pytest.approx(1.0)
+        np.testing.assert_allclose(_np(q), [-127, 64, 32])
+
+    def test_fake_qdq_roundtrip_error_bounded(self):
+        x = rng.normal(size=(32,)).astype(np.float32)
+        out, scale = pt.fake_quantize_dequantize_abs_max(pt.Tensor(x))
+        assert np.abs(_np(out) - x).max() <= np.abs(x).max() / 127 + 1e-6
+
+    def test_channel_wise(self):
+        x = rng.normal(size=(3, 8)).astype(np.float32)
+        q, scales = pt.fake_channel_wise_quantize_abs_max(pt.Tensor(x),
+                                                          quant_axis=0)
+        np.testing.assert_allclose(_np(scales), np.abs(x).max(1), rtol=1e-6)
+        deq = pt.fake_channel_wise_dequantize_max_abs(q, [scales])
+        np.testing.assert_allclose(_np(deq), x, atol=np.abs(x).max() / 100)
+
+    def test_moving_average(self):
+        x = np.array([2.0, -4.0], np.float32)
+        q, scale, state, accum = pt.fake_quantize_moving_average_abs_max(
+            pt.Tensor(x), 1.0, 0.0, 0.0, moving_rate=0.5)
+        # state = 0.5*0+1 = 1; accum = 0.5*0+4 = 4 -> scale 4
+        assert float(_np(scale)[0]) == pytest.approx(4.0)
+
+    def test_apply_per_channel_scale(self):
+        x = np.ones((2, 3), np.float32)
+        s = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(_np(pt.apply_per_channel_scale(
+            pt.Tensor(x), pt.Tensor(s))), [[1, 2, 3], [1, 2, 3]])
+
+
+class TestSequenceOps:
+    def test_sequence_pool(self):
+        x = np.arange(12, dtype=np.float32).reshape(2, 3, 2)
+        ln = np.array([2, 3])
+        mean = _np(pt.sequence_pool(pt.Tensor(x), pt.Tensor(ln), "MEAN"))
+        np.testing.assert_allclose(mean[0], x[0, :2].mean(0), rtol=1e-6)
+        np.testing.assert_allclose(mean[1], x[1].mean(0), rtol=1e-6)
+        mx = _np(pt.sequence_pool(pt.Tensor(x), pt.Tensor(ln), "MAX"))
+        np.testing.assert_allclose(mx[0], x[0, :2].max(0))
+        last = _np(pt.sequence_pool(pt.Tensor(x), pt.Tensor(ln), "LAST"))
+        np.testing.assert_allclose(last[0], x[0, 1])
+
+    def test_sequence_conv_window(self):
+        x = rng.normal(size=(1, 4, 2)).astype(np.float32)
+        ln = np.array([4])
+        w = rng.normal(size=(3 * 2, 5)).astype(np.float32)
+        out = _np(pt.sequence_conv(pt.Tensor(x), pt.Tensor(ln), pt.Tensor(w),
+                                   context_length=3))
+        assert out.shape == (1, 4, 5)
+        # middle position sees [t-1, t, t+1]
+        col = np.concatenate([x[0, 0], x[0, 1], x[0, 2]])
+        np.testing.assert_allclose(out[0, 1], col @ w, rtol=2e-5)
+
+    def test_im2sequence(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = _np(pt.im2sequence(pt.Tensor(x), (2, 2), (2, 2)))
+        assert out.shape == (4, 4)
+        np.testing.assert_allclose(out[0], [0, 1, 4, 5])
+
+    def test_add_position_encoding(self):
+        x = np.zeros((1, 4, 8), np.float32)
+        out = _np(pt.add_position_encoding(pt.Tensor(x), beta=1.0))
+        np.testing.assert_allclose(out[0, 0, 0], 0.0, atol=1e-6)  # sin(0)
+        np.testing.assert_allclose(out[0, 0, 4], 1.0, atol=1e-6)  # cos(0)
+
+
+class TestMetricDecodeOps:
+    def test_auc_matches_pairwise(self):
+        score = rng.uniform(size=24).astype(np.float32)
+        label = (rng.uniform(size=24) > 0.5).astype(np.int64)
+        a = float(_np(pt.auc(pt.Tensor(score), pt.Tensor(label),
+                             num_thresholds=100000)))
+        pos = score[label == 1]
+        neg = score[label == 0]
+        pairs = (pos[:, None] > neg[None, :]).mean() \
+            + 0.5 * (pos[:, None] == neg[None, :]).mean()
+        assert a == pytest.approx(float(pairs), abs=2e-2)
+
+    def test_accuracy_op(self):
+        idx = np.array([[1, 2], [0, 3], [4, 5]], np.int64)
+        lab = np.array([[2], [1], [4]], np.int64)
+        acc, correct, total = pt.accuracy(
+            pt.Tensor(np.zeros_like(idx, np.float32)), pt.Tensor(idx),
+            pt.Tensor(lab))
+        assert float(_np(acc)) == pytest.approx(2 / 3)
+
+    def test_ctc_align(self):
+        x = np.array([[1, 1, 0, 2, 2, 0]], np.int32)
+        out, ln = pt.ctc_align(pt.Tensor(x), blank=0)
+        np.testing.assert_array_equal(_np(out)[0, :2], [1, 2])
+        assert _np(ln)[0] == 2
+
+    def test_warprnnt_brute_force(self):
+        # T=2, U=1: paths are (lab, blank, blank) orderings over the
+        # [T, U] lattice; enumerate exactly
+        B, T, U, V = 1, 2, 1, 3
+        x = rng.normal(size=(B, T, U + 1, V)).astype(np.float32)
+        lp = np.asarray(jax.nn.log_softmax(jnp.asarray(x), -1))
+        y = np.array([[2]], np.int32)
+        loss = float(_np(pt.warprnnt(pt.Tensor(x), pt.Tensor(y),
+                                     pt.Tensor(np.array([T], np.int32)),
+                                     pt.Tensor(np.array([U], np.int32)))))
+        # path A: emit label at t=0 then blanks: lab(0,0)+bl(0,1)+bl(1,1)
+        pa = lp[0, 0, 0, 2] + lp[0, 0, 1, 0] + lp[0, 1, 1, 0]
+        # path B: blank, label at t=1, blank: bl(0,0)+lab(1,0)+bl(1,1)
+        pb = lp[0, 0, 0, 0] + lp[0, 1, 0, 2] + lp[0, 1, 1, 0]
+        expect = -np.logaddexp(pa, pb)
+        assert loss == pytest.approx(float(expect), rel=1e-4)
+
+    def test_crf_decoding_matches_viterbi(self):
+        B, T, D = 2, 5, 3
+        em = rng.normal(size=(B, T, D)).astype(np.float32)
+        tr = rng.normal(size=(D + 2, D)).astype(np.float32)
+        path = _np(pt.crf_decoding(pt.Tensor(em), pt.Tensor(tr)))
+        assert path.shape == (B, T)
+        # brute force over all paths for batch 0
+        best, best_p = None, -1e30
+        import itertools
+        for p in itertools.product(range(D), repeat=T):
+            s = tr[0, p[0]] + em[0, 0, p[0]]
+            for t in range(1, T):
+                s += tr[2 + p[t - 1], p[t]] + em[0, t, p[t]]
+            s += tr[1, p[-1]]
+            if s > best_p:
+                best_p, best = s, p
+        np.testing.assert_array_equal(path[0], best)
+
+
+class TestMoeGraphCreationOps:
+    def test_moe_aux_ops(self):
+        g = np.array([0, 1, 1, 2, 1], np.int64)
+        cnt = _np(pt.number_count(pt.Tensor(g), 4))
+        np.testing.assert_array_equal(cnt, [1, 3, 1, 0])
+        lim = _np(pt.limit_by_capacity(pt.Tensor(cnt),
+                                       pt.Tensor(np.array([2, 2, 2, 2])), 1))
+        np.testing.assert_array_equal(lim, [1, 2, 1, 0])
+        pruned = _np(pt.prune_gate_by_capacity(pt.Tensor(g), pt.Tensor(
+            np.array([2, 2, 2, 2], np.int64)), 4, 1))
+        np.testing.assert_array_equal(pruned, [0, 1, 1, 2, -1])
+        pos = _np(pt.assign_pos(pt.Tensor(g), pt.Tensor(np.cumsum(cnt))))
+        assert set(pos.tolist()) == {0, 1, 2, 3, 4}
+
+    def test_graph_ops(self):
+        x = rng.normal(size=(4, 3)).astype(np.float32)
+        src = np.array([0, 1, 2], np.int64)
+        dst = np.array([1, 2, 3], np.int64)
+        out = _np(pt.send_u_recv(pt.Tensor(x), pt.Tensor(src),
+                                 pt.Tensor(dst), "SUM"))
+        np.testing.assert_allclose(out[1], x[0], rtol=1e-6)
+        seg, cnt = pt.segment_pool(pt.Tensor(x), pt.Tensor(
+            np.array([0, 0, 1, 1])), "MEAN")
+        np.testing.assert_allclose(_np(seg)[0], x[:2].mean(0), rtol=1e-6)
+
+    def test_creation_tail(self):
+        assert _np(pt.full_int_array([2, 3])).tolist() == [2, 3]
+        out = _np(pt.full_with_tensor(pt.Tensor(np.float32(7.0)), (2, 2)))
+        np.testing.assert_allclose(out, 7.0)
+        x = np.zeros((5, 2), np.float32)
+        fb = _np(pt.full_batch_size_like(pt.Tensor(x), (1, 3), 2.0))
+        assert fb.shape == (5, 3) and (fb == 2.0).all()
+        assert _np(pt.shape(pt.Tensor(x))).tolist() == [5, 2]
+        assert int(_np(pt.numel(pt.Tensor(x)))) == 10
+        u = _np(pt.uniform_random_batch_size_like(pt.Tensor(x), (1, 4)))
+        assert u.shape == (5, 4)
+
+    def test_data_movement(self):
+        x = rng.normal(size=(3,)).astype(np.float32)
+        for op in (pt.share_data, pt.copy_to, pt.memcpy_d2h, pt.memcpy_h2d,
+                   pt.npu_identity, pt.depend):
+            np.testing.assert_allclose(_np(op(pt.Tensor(x))), x)
+        tl = _np(pt.trans_layout(pt.Tensor(x.reshape(1, 3)), (1, 0)))
+        assert tl.shape == (3, 1)
+        outs, fused = pt.coalesce_tensor([pt.Tensor(x), pt.Tensor(x)])
+        assert _np(fused).shape == (6,)
+
+    def test_fft_op_forms(self):
+        x = rng.normal(size=(8,)).astype(np.float32)
+        c = _np(pt.fft_r2c(pt.Tensor(x)))
+        np.testing.assert_allclose(c, np.fft.rfft(x), rtol=1e-4, atol=1e-5)
+        # irfft = c2r with forward=False (paddle fft stack convention);
+        # forward=True is the hfft path
+        back = _np(pt.fft_c2r(pt.Tensor(c), forward=False, last_dim_size=8))
+        np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+        h = _np(pt.fft_c2r(pt.Tensor(c), forward=True, last_dim_size=8))
+        np.testing.assert_allclose(h, np.fft.hfft(c, 8), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_tdm_child(self):
+        # heap tree: node ids 1..7; items at leaves 4..7
+        info = np.zeros((8, 5), np.int64)
+        for n in range(1, 8):
+            info[n] = [n if n >= 4 else 0, 0, n // 2,
+                       2 * n if 2 * n < 8 else 0,
+                       2 * n + 1 if 2 * n + 1 < 8 else 0]
+        child, leaf = pt.tdm_child(pt.Tensor(np.array([2], np.int64)),
+                                   pt.Tensor(info))
+        np.testing.assert_array_equal(_np(child)[0], [4, 5])
+        np.testing.assert_array_equal(_np(leaf)[0], [1, 1])

@@ -1,0 +1,267 @@
+"""Final op-tail batch: detection post-ops, DGC, legacy decode/metric ops,
+sparse attention, RNN op family (reference test/legacy_test counterparts)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _np(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+rng = np.random.default_rng(0)
+
+
+class TestDetectionTail:
+    def test_multiclass_nms3(self):
+        bb = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                        [20, 20, 30, 30]]], np.float32)
+        sc = np.zeros((1, 2, 3), np.float32)
+        sc[0, 1] = [0.9, 0.8, 0.7]
+        out, idx, num = pt.multiclass_nms3(bb, sc, score_threshold=0.1,
+                                           nms_threshold=0.3)
+        out = _np(out)
+        # box 1 suppressed by box 0 (IoU > 0.3); far box kept
+        assert _np(num)[0] == 2
+        np.testing.assert_allclose(sorted(out[:, 1]), [0.7, 0.9], rtol=1e-6)
+
+    def test_yolo_box_head_post(self):
+        A, C, H, W = 1, 2, 2, 2
+        x = np.zeros((1, A * (5 + C), H, W), np.float32)
+        head = _np(pt.yolo_box_head(pt.Tensor(x), [16, 16], C))
+        assert head.shape == x.shape
+        np.testing.assert_allclose(head[0, 4], 0.5)      # sigmoid(0)
+        out, num = pt.yolo_box_post(
+            x, x, x, np.array([[64, 64]], np.float32),
+            np.array([[1.0, 1.0]], np.float32),
+            [16, 16], [16, 16], [16, 16], C, conf_thresh=0.3,
+            nms_threshold=0.5)
+        assert _np(out).ndim == 2 and _np(out).shape[1] == 6
+
+    def test_yolo_loss_decreases_on_fit(self):
+        # loss with a gt-matching prediction < loss with zeros
+        N, A, C, H, W = 1, 3, 2, 4, 4
+        anchors = [10, 13, 16, 30, 33, 23]
+        x = np.zeros((N, A * (5 + C), H, W), np.float32)
+        gt = np.zeros((N, 2, 4), np.float32)
+        gt[0, 0] = [0.4, 0.4, 0.2, 0.2]
+        gl = np.zeros((N, 2), np.int64)
+        l0 = _np(pt.yolo_loss(pt.Tensor(x), pt.Tensor(gt), pt.Tensor(gl),
+                              anchors=anchors, anchor_mask=[0, 1, 2],
+                              class_num=C, downsample_ratio=8))
+        assert l0.shape == (N,) and np.isfinite(l0).all() and l0[0] > 0
+        g = jax.grad(lambda xx: pt.ops.get_op("yolo_loss").fn.raw(
+            xx, gt, gl, anchors=anchors, anchor_mask=[0, 1, 2],
+            class_num=C, downsample_ratio=8).sum())(x)
+        assert np.abs(np.asarray(g)).sum() > 0
+
+    def test_generate_proposals(self):
+        N, A, H, W = 1, 2, 4, 4
+        scores = rng.uniform(size=(N, A, H, W)).astype(np.float32)
+        deltas = rng.normal(size=(N, A * 4, H, W)).astype(np.float32) * 0.1
+        anchors = np.zeros((H, W, A, 4), np.float32)
+        for i in range(H):
+            for j in range(W):
+                anchors[i, j, :, 0] = j * 8
+                anchors[i, j, :, 1] = i * 8
+                anchors[i, j, :, 2] = j * 8 + 15
+                anchors[i, j, :, 3] = i * 8 + 15
+        var = np.ones((H, W, A, 4), np.float32)
+        rois, probs, num = pt.generate_proposals(
+            scores, deltas, np.array([[32.0, 32.0]], np.float32),
+            anchors, var, pre_nms_top_n=16, post_nms_top_n=8,
+            nms_thresh=0.7, min_size=2.0)
+        rois = _np(rois)
+        assert rois.shape[1] == 4 and _np(num)[0] == rois.shape[0] > 0
+        assert (rois >= 0).all() and (rois <= 31).all()
+
+    def test_detection_map_perfect(self):
+        det = np.array([[1, 0.9, 0, 0, 10, 10]], np.float32)
+        gt = np.array([[1, 0, 0, 10, 10]], np.float32)
+        m = _np(pt.detection_map(det, gt, class_num=2))
+        assert m == pytest.approx(1.0)
+
+
+class TestDgc:
+    def test_dgc_topk(self):
+        g = np.array([0.1, -5.0, 0.2, 3.0], np.float32)
+        z = np.zeros(4, np.float32)
+        u, v, enc, gout, k, _ = pt.dgc(z, z, g, z, np.array([10.0]),
+                                       np.array([1.0]), sparsity=[0.5])
+        enc = _np(enc)
+        # top-50%: the two largest |v| entries are shipped
+        assert (enc != 0).sum() == 2
+        assert enc[1] != 0 and enc[3] != 0
+        # residual keeps the rest
+        assert _np(v)[0] != 0 and _np(v)[2] != 0
+
+    def test_dgc_momentum_pre_rampup_is_sgd(self):
+        p = np.ones(3, np.float32)
+        g = np.ones(3, np.float32)
+        vel = np.zeros(3, np.float32)
+        out, v2 = pt.dgc_momentum(p, g, vel, 0.1,
+                                  current_step_tensor=np.array([0.0]),
+                                  mu=0.9, rampup_begin_step=5.0)
+        np.testing.assert_allclose(_np(out), p - 0.1)
+        np.testing.assert_allclose(_np(v2), vel)
+
+
+class TestAttnTail:
+    def test_correlation_self_peak(self):
+        x = rng.normal(size=(1, 4, 6, 6)).astype(np.float32)
+        out = _np(pt.correlation(pt.Tensor(x), pt.Tensor(x), pad_size=2,
+                                 max_displacement=2))
+        assert out.shape == (1, 25, 6, 6)
+        # zero displacement (center channel 12) is the channel-mean
+        # self-energy
+        np.testing.assert_allclose(out[0, 12], (x[0] ** 2).mean(0),
+                                   rtol=1e-5)
+        # displacement (+1, 0) = channel 17 correlates x[i,j] with y[i+1,j]
+        np.testing.assert_allclose(
+            out[0, 17, :5], (x[0, :, :5] * x[0, :, 1:]).mean(0), rtol=1e-5)
+
+    def test_sparse_attention_matches_dense_full(self):
+        B, H, T, D = 1, 1, 4, 8
+        q = rng.normal(size=(B, H, T, D)).astype(np.float32)
+        k = rng.normal(size=(B, H, T, D)).astype(np.float32)
+        v = rng.normal(size=(B, H, T, D)).astype(np.float32)
+        # full CSR pattern == dense attention
+        offset = np.arange(0, (T + 1) * T, T).reshape(1, 1, T + 1)
+        cols = np.tile(np.arange(T), T).reshape(1, 1, -1)
+        out, sdd, sm = pt.sparse_attention(q, k, v, offset, cols)
+        logits = q[0, 0] @ k[0, 0].T / np.sqrt(D)
+        ref = np.exp(logits - logits.max(-1, keepdims=True))
+        ref = ref / ref.sum(-1, keepdims=True)
+        np.testing.assert_allclose(_np(out)[0, 0], ref @ v[0, 0],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_calc_reduced_attn_scores(self):
+        B, S, H, D = 1, 5, 2, 8
+        q = rng.normal(size=(B, S, H, D)).astype(np.float32)
+        k = rng.normal(size=(B, S, H, D)).astype(np.float32)
+        s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+        lse = np.log(np.exp(s).sum(-1))
+        red = _np(pt.calc_reduced_attn_scores(q, k, lse))
+        # softmax rows sum to 1 -> total key mass sums to Sq per head
+        np.testing.assert_allclose(red.sum(-1), S, rtol=1e-4)
+
+    def test_flash_attn_with_sparse_mask(self):
+        B, S, H, D = 1, 6, 1, 8
+        q = rng.normal(size=(B, S, H, D)).astype(np.float32)
+        start = np.full((B, 1, S), S, np.int32)   # no extra masking
+        out = _np(pt.flash_attn_with_sparse_mask(q, q, q, start))
+        assert out.shape == q.shape
+
+
+class TestLegacyTail:
+    def test_beam_search_step(self):
+        pre_ids = np.array([[1], [2]], np.int64)
+        pre_sc = np.array([0.0, -1.0], np.float32)
+        ids = np.array([[3, 4], [5, 6]], np.int64)
+        sc = np.array([[-0.1, -0.5], [-0.2, -0.9]], np.float32)
+        sel, ssc, parent = pt.beam_search(pre_ids, pre_sc, ids, sc,
+                                          beam_size=2, end_id=0)
+        np.testing.assert_array_equal(_np(sel).ravel(), [3, 4])
+        np.testing.assert_array_equal(_np(parent), [0, 0])
+
+    def test_chunk_eval_iob(self):
+        # tags: B-0=0, I-0=1 (IOB, 1 type => O is outside id space here)
+        lab = np.array([0, 1, 0, 1])
+        inf = np.array([0, 1, 0, 0])   # second chunk predicted as two Bs
+        p, r, f1, ni, nl, nc = pt.chunk_eval(inf, lab,
+                                             num_chunk_types=1)
+        assert int(_np(nl)) == 2 and int(_np(nc)) == 1
+        assert float(_np(p)) == pytest.approx(1 / 3)
+
+    def test_rank_attention_gather_semantics(self):
+        N, D, P, R = 2, 3, 2, 2
+        x = rng.normal(size=(N, D)).astype(np.float32)
+        # ins 0: rank 1, one valid pair (rank 1 -> index 1)
+        ro = np.array([[1, 1, 1, 0, 0],
+                       [2, 1, 0, 2, 1]], np.int32)
+        par = rng.normal(size=(R * R * D, P)).astype(np.float32)
+        ih, out, ins_rank = pt.rank_attention(x, ro, par, max_rank=R)
+        ih = _np(ih)
+        np.testing.assert_allclose(ih[0, :D], x[1])     # gathered row 1
+        np.testing.assert_allclose(ih[0, D:], 0.0)      # invalid slot
+        np.testing.assert_array_equal(_np(ins_rank), [1, 2])
+        # manual block matmul for ins 0, k=0: block (lower*R + faster)
+        blk = par.reshape(R * R, D, P)[(1 - 1) * R + 0]
+        np.testing.assert_allclose(_np(out)[0], x[1] @ blk, rtol=1e-5)
+
+    def test_pyramid_hash_shape(self):
+        ids = np.array([3, 7, 11, 13], np.int64)
+        w = rng.normal(size=(1000, 16)).astype(np.float32)
+        out = _np(pt.pyramid_hash(ids, w, num_emb=8, space_len=1000,
+                                  pyramid_layer=2))
+        assert out.shape == (4, 8)
+        assert np.abs(out[0]).sum() > 0
+
+    def test_moe_top1(self):
+        T, E, Hh, X = 4, 6, 8, 2
+        x = rng.normal(size=(T, E)).astype(np.float32)
+        gate = np.zeros((T, X), np.float32)
+        gate[:, 1] = 5.0                       # all tokens -> expert 1
+        w0 = rng.normal(size=(X, E, Hh)).astype(np.float32) * 0.1
+        b0 = np.zeros((X, 1, Hh), np.float32)
+        w1 = rng.normal(size=(X, Hh, E)).astype(np.float32) * 0.1
+        b1 = np.zeros((X, 1, E), np.float32)
+        out = _np(pt.moe(x, gate, w0, b0, w1, b1))
+        man = np.asarray(jax.nn.gelu(x @ w0[1])) @ w1[1]
+        wsel = np.asarray(jax.nn.softmax(jnp.asarray(gate), -1))[:, 1:2]
+        np.testing.assert_allclose(out, man * wsel, rtol=1e-4, atol=1e-5)
+
+    def test_merge_selected_rows(self):
+        from paddle_tpu.sparse import SelectedRows
+        sr = SelectedRows(rows=np.array([2, 0, 2]),
+                          values=np.ones((3, 4), np.float32), height=5)
+        m = pt.merge_selected_rows(sr)
+        assert isinstance(m, SelectedRows)
+        np.testing.assert_array_equal(np.asarray(m.rows), [0, 2])
+        np.testing.assert_allclose(np.asarray(m.values)[1], 2.0)
+        dense = np.asarray(m.to_dense()._value)
+        assert dense.shape == (5, 4) and dense[2, 0] == 2.0
+
+
+class TestRnnOpFamily:
+    def test_rnn_lstm_matches_layer_scan(self):
+        from paddle_tpu.nn.layer.rnn import _lstm_scan
+        T, B, I, H = 4, 2, 3, 5
+        x = rng.normal(size=(T, B, I)).astype(np.float32)
+        w_ih = rng.normal(size=(4 * H, I)).astype(np.float32) * 0.2
+        w_hh = rng.normal(size=(4 * H, H)).astype(np.float32) * 0.2
+        b = np.zeros(4 * H, np.float32)
+        out, (h, c) = pt.rnn(pt.Tensor(x),
+                             [np.zeros((1, B, H), np.float32),
+                              np.zeros((1, B, H), np.float32)],
+                             [w_ih, w_hh, b, b], mode="LSTM")
+        ys, h_ref, c_ref = _lstm_scan(jnp.asarray(x),
+                                      jnp.zeros((B, H)), jnp.zeros((B, H)),
+                                      w_ih, w_hh, b, b)
+        np.testing.assert_allclose(_np(out), np.asarray(ys), rtol=1e-5)
+        np.testing.assert_allclose(_np(h)[0], np.asarray(h_ref), rtol=1e-5)
+
+    def test_gru_unit_step_matches_gru(self):
+        B, H = 2, 4
+        x3 = rng.normal(size=(1, B, 3 * H)).astype(np.float32)
+        w = rng.normal(size=(H, 3 * H)).astype(np.float32) * 0.2
+        ys, hn = pt.gru(pt.Tensor(x3), None, pt.Tensor(w))
+        h1, _, _ = pt.gru_unit(pt.Tensor(x3[0]),
+                               pt.Tensor(np.zeros((B, H), np.float32)),
+                               pt.Tensor(w))
+        np.testing.assert_allclose(_np(ys)[0], _np(h1), rtol=1e-5)
+
+    def test_cudnn_lstm_wrapper(self):
+        T, B, I, H = 3, 2, 3, 4
+        x = rng.normal(size=(T, B, I)).astype(np.float32)
+        ws = [rng.normal(size=(4 * H, I)).astype(np.float32) * 0.1,
+              rng.normal(size=(4 * H, H)).astype(np.float32) * 0.1,
+              np.zeros(4 * H, np.float32), np.zeros(4 * H, np.float32)]
+        out, h, c = pt.cudnn_lstm(pt.Tensor(x),
+                                  np.zeros((1, B, H), np.float32),
+                                  np.zeros((1, B, H), np.float32), ws)
+        assert _np(out).shape == (T, B, H)
