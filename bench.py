@@ -162,7 +162,10 @@ def main() -> None:
     hang a device op indefinitely); on timeout/failure retry once, then
     force CPU.  Prints exactly one JSON line."""
     import subprocess
-    budget = int(os.environ.get("BENCH_TIMEOUT", "1500"))
+    # 900s first attempt -> worst case 900+450+450 = 30min to a JSON line
+    # even with the axon tunnel wedged (observed blocking jax.devices()
+    # indefinitely in rounds 1 and 2)
+    budget = int(os.environ.get("BENCH_TIMEOUT", "900"))
     attempts = [({}, budget), ({}, budget // 2),
                 ({"JAX_PLATFORMS": "cpu"}, budget // 2)]
     note = None

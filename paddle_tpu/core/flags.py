@@ -133,5 +133,10 @@ define_flag("default_dtype", "float32", "default floating dtype for creation ops
 define_flag("use_donated_buffers", True, "donate param/opt buffers in jitted train steps")
 define_flag("allocator_strategy", "xla", "memory allocator strategy (informational on TPU)")
 define_flag("pallas_interpret", False, "force pallas kernels to run in interpret mode")
+define_flag("use_autotune", False,
+            "Time Pallas block-size candidates per shape and cache the "
+            "fastest (reference FLAGS_use_autotune)")
+define_flag("autotune_cache_file", "",
+            "Optional JSON file persisting autotune winners across processes")
 define_flag("enable_async_trace", False, "record collective timing/debug traces")
 define_flag("log_level", 1, "framework log verbosity (0=quiet)")

@@ -321,6 +321,7 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                          use_flash: Optional[bool] = None,
                          remat: bool = True,
                          schedule: str = "1f1b",
+                         sharding_stage: int = 2,
                          sequence_parallel: bool = False):
     """Compile a full hybrid-parallel GPT training step: dp×mp×pp×sharding×sep.
 
@@ -448,7 +449,7 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
         topo=topo, param_specs=param_specs, init_params_fn=init_params_fn,
         embed_fn=embed_fn, block_fn=block_fn, head_nll_fn=head_nll_fn,
         num_microbatches=num_microbatches, learning_rate=learning_rate,
-        remat=remat, schedule=schedule,
+        remat=remat, schedule=schedule, sharding_stage=sharding_stage,
         mp_reduce_block_leaves=frozenset(
             {"ln1_w", "ln1_b", "ln2_w", "ln2_b", "proj_b", "fc2_b"}
             if sp else ()))

@@ -373,6 +373,7 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
                            use_flash: Optional[bool] = None,
                            remat: bool = True,
                            schedule: str = "1f1b",
+                           sharding_stage: int = 2,
                            sequence_parallel: bool = False):
     """Compiled hybrid dp×mp×pp×sharding×sep Llama train step.
 
@@ -490,6 +491,6 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
         embed_fn=embed_fn, block_fn=block_fn, head_nll_fn=head_nll_fn,
         step_ctx_fn=step_ctx_fn,
         num_microbatches=num_microbatches, learning_rate=learning_rate,
-        remat=remat, schedule=schedule,
+        remat=remat, schedule=schedule, sharding_stage=sharding_stage,
         mp_reduce_block_leaves=frozenset(
             {"ln1_w", "ln2_w"} if sp else ()))

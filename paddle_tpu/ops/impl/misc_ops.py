@@ -295,6 +295,11 @@ def warprnnt(input, label, input_lengths, label_lengths, blank=0,
     input: [B, T, U+1, V] joint log-probs (log-softmaxed here); the
     forward variable recursion runs as a lax.scan over T with an inner
     scan over U — O(T·U) sequential steps, each a [B] vector op."""
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "warprnnt: FastEmit regularization (fastemit_lambda != 0) is "
+            "not implemented; the plain transducer loss would silently "
+            "ignore it")
     x = jax.nn.log_softmax(_v(input), axis=-1)
     y = _v(label).astype(jnp.int32)             # [B, U]
     tl = _v(input_lengths).astype(jnp.int32)    # [B]

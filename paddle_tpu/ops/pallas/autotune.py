@@ -1,0 +1,139 @@
+"""Pallas kernel autotune (reference paddle/phi/kernels/autotune/cache.h +
+auto_tune_base.h: per-(op, shape-signature) timed config selection with a
+process cache, gated by FLAGS_use_autotune).
+
+TPU-first shape: candidates are Pallas grid/block configurations; each is
+compiled + timed with ``block_until_ready`` on the live device and the
+winner is memo-cached per (kernel, key, device kind) — in memory and in an
+optional JSON file so later processes skip the sweep (the reference
+serializes its cache the same way).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from ...core.flags import FLAGS   # use_autotune / autotune_cache_file
+#                                   are defined in core/flags.py
+
+_CACHE: Dict[str, Any] = {}
+_LOADED_PATH: Optional[str] = None   # which file the cache was loaded from
+
+
+def _cache_path() -> Optional[str]:
+    return FLAGS.autotune_cache_file or os.environ.get(
+        "PADDLE_TPU_AUTOTUNE_CACHE") or None
+
+
+def _load_disk() -> None:
+    """(Re)load when the configured path changes — a boolean latch would
+    permanently skip a cache file configured after the first pick()."""
+    global _LOADED_PATH
+    path = _cache_path()
+    if path == _LOADED_PATH:
+        return
+    _LOADED_PATH = path
+    if path and os.path.exists(path):
+        try:
+            with open(path) as f:
+                _CACHE.update(json.load(f))
+        except Exception:
+            pass
+
+
+def _save_disk() -> None:
+    path = _cache_path()
+    if not path:
+        return
+    try:
+        # merge-then-replace: concurrent tuners of disjoint shapes must not
+        # clobber each other, and a crash mid-dump must not truncate
+        merged: Dict[str, Any] = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    merged.update(json.load(f))
+            except Exception:
+                pass
+        merged.update(_CACHE)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(merged, f)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+def _device_kind() -> str:
+    try:
+        return jax.devices()[0].device_kind
+    except Exception:
+        return "unknown"
+
+
+def cache_key(name: str, key: Tuple) -> str:
+    return f"{name}|{_device_kind()}|{key}"
+
+
+def _time_once(fn: Callable, args) -> float:
+    out = fn(*args)
+    jax.tree.map(lambda t: t.block_until_ready()
+                 if hasattr(t, "block_until_ready") else t, out)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = fn(*args)
+    jax.tree.map(lambda t: t.block_until_ready()
+                 if hasattr(t, "block_until_ready") else t, out)
+    return (time.perf_counter() - t0) / 3
+
+
+def pick(name: str, key: Tuple, candidates: Sequence[Any],
+         run: Callable[[Any], Callable], args,
+         default: Any) -> Any:
+    """Return the winning candidate for (name, key).
+
+    ``run(candidate)`` returns a callable taking ``args``; each candidate is
+    timed once per unseen key when FLAGS.use_autotune is on, else
+    ``default`` is returned immediately.  Winners persist in the process
+    cache (+ optional JSON file)."""
+    if not FLAGS.use_autotune or len(candidates) <= 1:
+        return default
+    _load_disk()
+    ck = cache_key(name, key)
+    if ck in _CACHE:
+        got = _CACHE[ck]
+        got = tuple(got) if isinstance(got, list) else got
+        return got if got in [tuple(c) if isinstance(c, list) else c
+                              for c in candidates] else default
+    best, best_t = default, float("inf")
+    for cand in candidates:
+        try:
+            t = _time_once(run(cand), args)
+        except Exception:
+            continue          # config invalid for this shape/VMEM: skip
+        if t < best_t:
+            best, best_t = cand, t
+    _CACHE[ck] = best
+    _save_disk()
+    return best
+
+
+def clear_cache() -> None:
+    _CACHE.clear()
+
+
+def lookup(name: str, key: Tuple, default: Any) -> Any:
+    """Trace-time cache consultation (no timing — a traced call can't
+    execute candidates; run :func:`pick` eagerly, e.g. via a warmup)."""
+    if not FLAGS.use_autotune:
+        return default
+    _load_disk()
+    got = _CACHE.get(cache_key(name, key))
+    if got is None:
+        return default
+    return tuple(got) if isinstance(got, list) else got

@@ -47,9 +47,47 @@ __all__ = ["flash_attention_fwd", "flash_attention",
 
 DEFAULT_BLOCK = 128
 
+# candidate (block_q, block_k) grid for the autotuner (reference
+# phi/kernels/autotune: per-shape timed algorithm pick).  128 is the MXU
+# tile edge; bigger q blocks amortize the softmax state, bigger k blocks
+# amortize the kv loads.
+_BLOCK_CANDIDATES = ((128, 128), (256, 128), (128, 256), (256, 256),
+                     (512, 128))
+
 
 def _blocks(seq: int) -> int:
     return min(DEFAULT_BLOCK, seq)
+
+
+def _pow2_ceil(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _tuned_blocks(q, k, v, scale, causal, seg_q, seg_k, bias):
+    """Pick (block_q, block_k): FLAGS.use_autotune times the candidates
+    eagerly (first unseen shape) and caches; traced calls read the cache
+    (ops/pallas/autotune.py)."""
+    from .autotune import FLAGS, lookup, pick
+    B, Sq0, Hq, D = q.shape
+    default = (_blocks(Sq0), _blocks(k.shape[1]))
+    if not FLAGS.use_autotune:
+        return default
+    key = (B, Sq0, k.shape[1], Hq, k.shape[2], D, str(q.dtype), causal,
+           seg_q is not None, bias is not None)
+    if isinstance(q, jax.core.Tracer):
+        return lookup("flash_fwd", key, default)
+
+    def run(cand):
+        bq, bk = cand
+        return jax.jit(functools.partial(
+            _fwd, scale=scale, causal=causal, seg_q=seg_q, seg_k=seg_k,
+            bias=bias, block_q=bq, block_k=bk))
+
+    return pick("flash_fwd", key, _BLOCK_CANDIDATES, run, (q, k, v),
+                default)
 
 
 def _bias_index(bias_shape, G):
@@ -152,15 +190,19 @@ def _pad_seq(x, block, axis=1):
     return x
 
 
-def _fwd(q, k, v, scale, causal, seg_q=None, seg_k=None, bias=None):
+def _fwd(q, k, v, scale, causal, seg_q=None, seg_k=None, bias=None,
+         block_q=None, block_k=None):
     B, Sq0, Hq, D = q.shape
     Sk0, Hkv = k.shape[1], k.shape[2]
     if Hq % Hkv != 0:
         raise ValueError(f"q heads ({Hq}) must be a multiple of kv heads "
                          f"({Hkv}) for GQA")
     G = Hq // Hkv
-    bq = _blocks(Sq0)
-    bk = _blocks(Sk0)
+    if block_q is None or block_k is None:
+        block_q, block_k = _tuned_blocks(q, k, v, scale, causal,
+                                         seg_q, seg_k, bias)
+    bq = min(block_q, _pow2_ceil(Sq0))
+    bk = min(block_k, _pow2_ceil(Sk0))
     q = _pad_seq(q, bq)
     k = _pad_seq(k, bk)
     v = _pad_seq(v, bk)

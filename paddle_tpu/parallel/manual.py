@@ -173,6 +173,46 @@ def zero_adam_leaf_update(p, g, m_flat, v_flat, tf, *, lr, b1=0.9, b2=0.95,
     return p_new, m2, v2
 
 
+def pack_leaf(p_local, chunk: int, axis_name: str = SHARDING_AXIS):
+    """Flat-shard a device-local param leaf over the sharding axis:
+    keep only this device's ``chunk`` of the padded flat view (ZeRO
+    stage-3 at-rest layout, reference group_sharded_stage3.py:85
+    _param_storage)."""
+    shard = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    flat = jnp.pad(p_local.reshape(-1), (0, shard * chunk - p_local.size))
+    return lax.dynamic_index_in_dim(flat.reshape(shard, chunk), idx, 0,
+                                    keepdims=False)
+
+
+def unpack_leaf(p_flat, shape, dtype=None, axis_name: str = SHARDING_AXIS):
+    """Gather-at-use: reassemble the full local leaf from the per-device
+    flat shards (stage-3 ``_gather`` before forward use).  Differentiating
+    through this all_gather transposes into exactly the stage-3
+    reduce-scatter of the gradient — no separate grad plumbing."""
+    full = lax.all_gather(p_flat, axis_name, tiled=False).reshape(-1)
+    n = int(np.prod(shape))
+    out = full[:n].reshape(shape)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def zero3_adam_leaf_update(p_flat, g_flat, m, v, tf, *, lr, b1=0.9, b2=0.95,
+                           eps=1e-8, weight_decay=0.0):
+    """Adam on the flat-sharded stage-3 layout: everything device-local
+    elementwise (the sharding-axis grad reduction already happened in the
+    all_gather transpose), params stay sharded — no post-update gather."""
+    g32 = g_flat.astype(jnp.float32)
+    p32 = p_flat.astype(jnp.float32)
+    m2 = b1 * m + (1 - b1) * g32
+    v2 = b2 * v + (1 - b2) * g32 * g32
+    mh = m2 / (1 - b1 ** tf)
+    vh = v2 / (1 - b2 ** tf)
+    upd = mh / (jnp.sqrt(vh) + eps)
+    if weight_decay:
+        upd = upd + weight_decay * p32
+    return (p32 - lr * upd).astype(p_flat.dtype), m2, v2
+
+
 def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                             init_params_fn, embed_fn, block_fn, head_nll_fn,
                             step_ctx_fn=None,
@@ -181,6 +221,7 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                             adam_betas=(0.9, 0.95), adam_eps: float = 1e-8,
                             weight_decay: float = 0.0, remat: bool = True,
                             schedule: str = "1f1b",
+                            sharding_stage: int = 2,
                             mp_reduce_block_leaves=frozenset()):
     """Generic fully-manual hybrid dp×mp×pp×sharding×sep train step.
 
@@ -228,30 +269,95 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
 
     if schedule not in ("1f1b", "gpipe"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if sharding_stage not in (2, 3):
+        raise ValueError(f"sharding_stage must be 2 or 3, got "
+                         f"{sharding_stage}")
     mesh = topo.mesh
     S = topo.axis_size(PP_AXIS)
     dp = topo.axis_size(DP_AXIS)
     shard = topo.axis_size(SHARDING_AXIS)
     sep = topo.axis_size(SEP_AXIS)
+    mp_deg = topo.axis_size(MP_AXIS)
     b1, b2 = adam_betas
-    mom_specs = tree_map_with_spec(lambda _p, _s: MOMENT_SPEC,
-                                   param_specs, param_specs)
     data_spec = P((DP_AXIS, SHARDING_AXIS), SEP_AXIS)
+
+    # stage-3: params live flat-sharded at rest (same chunk layout as the
+    # moments) and are all_gather'ed AT USE — per layer inside the scan,
+    # so off-layer weights cost 1/shard of their size.  The AD transpose
+    # of that gather is the stage-3 grad reduce-scatter for free.
+    BLOCK_FLAT_SPEC = P(PP_AXIS, None, MP_AXIS, SHARDING_AXIS)
+    stage3 = sharding_stage == 3
+    if stage3:
+        p_abs = jax.eval_shape(init_params_fn, 0)
+
+        def _leaf_info(leaf, spec, is_block):
+            ls = local_shape(leaf.shape, spec, topo)
+            if is_block:
+                layer = tuple(ls[2:])
+                n = int(np.prod(layer)) or 1
+                return {"local": layer, "per": ls[1],
+                        "chunk": -(-n // shard), "dtype": leaf.dtype}
+            n = int(np.prod(ls)) or 1
+            return {"local": tuple(ls), "chunk": -(-n // shard),
+                    "dtype": leaf.dtype}
+
+        info = {k: _leaf_info(p_abs[k], param_specs[k], False)
+                for k in p_abs if k != "blocks"}
+        info["blocks"] = {k: _leaf_info(p_abs["blocks"][k],
+                                        param_specs["blocks"][k], True)
+                          for k in p_abs["blocks"]}
+        flat_specs = {k: MOMENT_SPEC for k in p_abs if k != "blocks"}
+        flat_specs["blocks"] = {k: BLOCK_FLAT_SPEC
+                                for k in p_abs["blocks"]}
+        store_specs = flat_specs
+        mom_specs = flat_specs
+    else:
+        store_specs = param_specs
+        mom_specs = tree_map_with_spec(lambda _p, _s: MOMENT_SPEC,
+                                       param_specs, param_specs)
 
     def sh(spec):
         return NamedSharding(mesh, spec)
 
+    def _flat_shape(k, k2=None):
+        if k2 is None:
+            return (S, mp_deg, shard * info[k]["chunk"])
+        return (S, info["blocks"][k2]["per"], mp_deg,
+                shard * info["blocks"][k2]["chunk"])
+
     def init_fn(seed: int = 0):
         params = init_params_fn(seed)
-        mom_shapes = tree_map_with_spec(
-            lambda p, spec: moment_shape(p.shape, spec, topo),
-            params, param_specs)
+        if stage3:
+            def pack_local(prm):
+                out = {"blocks": {}}
+                for k in prm:
+                    if k == "blocks":
+                        continue
+                    out[k] = pack_leaf(prm[k], info[k]["chunk"])[None, None]
+                for k, val in prm["blocks"].items():
+                    c = info["blocks"][k]["chunk"]
+                    packed = jax.vmap(lambda lv, c=c: pack_leaf(lv, c))(
+                        val[0])
+                    out["blocks"][k] = packed[:, None][None]
+                return out
+
+            pack = jax.jit(jax.shard_map(
+                pack_local, mesh=mesh, in_specs=(param_specs,),
+                out_specs=flat_specs, check_vma=False))
+            params = pack(params)
+            mom_shapes = {k: _flat_shape(k) for k in info if k != "blocks"}
+            mom_shapes["blocks"] = {k: _flat_shape("blocks", k)
+                                    for k in info["blocks"]}
+        else:
+            mom_shapes = tree_map_with_spec(
+                lambda p, spec: moment_shape(p.shape, spec, topo),
+                params, param_specs)
         zinit = jax.jit(
             lambda: tree_map_with_spec(
                 lambda shp, _: _jnp.zeros(shp, _jnp.float32),
-                mom_shapes, param_specs),
+                mom_shapes, mom_specs),
             out_shardings=tree_map_with_spec(
-                lambda _s, _sp: sh(MOMENT_SPEC), mom_shapes, param_specs))
+                lambda _s, sp: sh(sp), mom_shapes, mom_specs))
         m0, v0 = zinit(), zinit()
         return {"params": params,
                 "opt": {"m": m0, "v": v0,
@@ -264,10 +370,48 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
         # outside the differentiated region)
         ctx = step_ctx_fn(s_l) if step_ctx_fn is not None else None
 
+        def _unpack_other(prm):
+            return {k: unpack_leaf(v[0, 0], info[k]["local"],
+                                   info[k]["dtype"])
+                    for k, v in prm.items() if k != "blocks"}
+
         def body(carry, layer_params):
+            if stage3:
+                layer_params = {
+                    k: unpack_leaf(v.reshape(-1),
+                                   info["blocks"][k]["local"],
+                                   info["blocks"][k]["dtype"])
+                    for k, v in layer_params.items()}
             return block_fn(layer_params, carry, ctx), None
 
+        def run_stack(x, blk, use_remat):
+            """The per-stage layer stack.  Stage 2 scans (one traced
+            block); stage 3 UNROLLS so each layer's weight all_gather is a
+            distinct collective — a scanned gather is one HLO op executed
+            per iteration with no cross-iteration data dependence, which
+            XLA overlaps: on TPU that just prefetches weights early, but
+            XLA:CPU's in-process rendezvous aborts on the repeated joins.
+            Unrolling also lets the TPU scheduler hide each gather behind
+            the previous layer's compute (the stage-3 prefetch pattern,
+            reference group_sharded_stage3 _prefetch)."""
+            if stage3:
+                def one(c, lp):
+                    return body(c, lp)[0]
+
+                fn = jax.checkpoint(one) if use_remat else one
+                per = next(iter(blk.values())).shape[0]
+                for i in range(per):
+                    x = fn(x, {k: lax.index_in_dim(v, i, 0, keepdims=False)
+                               for k, v in blk.items()})
+                return x
+            sbody = jax.checkpoint(body) if use_remat else body
+            x, _ = lax.scan(sbody, x, blk)
+            return x
+
         def loss_fn(params):
+            if stage3:
+                params = dict(_unpack_other(params),
+                              blocks=params["blocks"])
             x = embed_fn(params, ids)
             hdim = x.shape[-1]
             blk = {k: val[0] for k, val in params["blocks"].items()}
@@ -277,14 +421,14 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                 mbs = x.reshape(M, b_l // M, s_l, hdim)
 
                 def stage_fn(blk_local, hcarry):
-                    out, _ = lax.scan(body, hcarry, blk_local)
-                    return out
+                    # spmd_pipeline applies its own remat around the stage
+                    return run_stack(hcarry, blk_local,
+                                     use_remat=stage3 and remat)
 
                 outs = spmd_pipeline(stage_fn, blk, mbs, S, remat=remat)
                 x = outs.reshape(b_l, s_l, hdim)
             else:
-                sbody = jax.checkpoint(body) if remat else body
-                x, _ = lax.scan(sbody, x, blk)
+                x = run_stack(x, blk, use_remat=remat)
 
             nll = head_nll_fn(params, x, labels)
             # loss lives on the LAST pp stage only (other stages computed
@@ -305,18 +449,22 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
             labels_mb = labels.reshape(M, b_l // M, s_l)
 
             def mb_fn(other_p, blk_p, x_in, ids1, labels1):
+                if stage3:
+                    other_p = _unpack_other(other_p)
                 p = dict(other_p, blocks=None)
                 x0 = embed_fn(p, ids1)
                 x = jnp.where(lax.axis_index(PP_AXIS) == 0, x0, x_in)
-                sbody = jax.checkpoint(body) if remat else body
-                y, _ = lax.scan(sbody, x, blk_p)
+                y = run_stack(x, blk_p, use_remat=remat)
                 nll = head_nll_fn(p, y, labels1)
                 last = (lax.axis_index(PP_AXIS) == S - 1)
                 return y, jnp.sum(nll) * last.astype(nll.dtype)
 
-            xa = jax.eval_shape(
-                lambda o, i: embed_fn(dict(o, blocks=None), i),
-                other, ids_mb[0])
+            def _embed_probe(o, i):
+                if stage3:
+                    o = _unpack_other(o)
+                return embed_fn(dict(o, blocks=None), i)
+
+            xa = jax.eval_shape(_embed_probe, other, ids_mb[0])
             nll_sum, d_other, d_blk = spmd_pipeline_1f1b(
                 mb_fn, other, blk, ids_mb, labels_mb,
                 xa.shape, xa.dtype, S)
@@ -342,6 +490,12 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
             if mp_partial:
                 red = red + (MP_AXIS,)
             g = lax.psum(g, red)
+            if stage3:
+                # flat layout end to end: the sharding-axis reduction
+                # already happened in the unpack_leaf transpose
+                return zero3_adam_leaf_update(
+                    p, g, m_leaf, v_leaf, tf, lr=learning_rate, b1=b1,
+                    b2=b2, eps=adam_eps, weight_decay=weight_decay)
             p2, m2, v2 = zero_adam_leaf_update(
                 p, g, m_leaf.reshape(-1), v_leaf.reshape(-1), tf,
                 lr=learning_rate, b1=b1, b2=b2, eps=adam_eps,
@@ -366,9 +520,9 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
 
     shd = jax.shard_map(
         local_step, mesh=mesh,
-        in_specs=(param_specs, mom_specs, mom_specs, P(), data_spec,
+        in_specs=(store_specs, mom_specs, mom_specs, P(), data_spec,
                   data_spec),
-        out_specs=(param_specs, mom_specs, mom_specs, P(), P()),
+        out_specs=(store_specs, mom_specs, mom_specs, P(), P()),
         check_vma=False)
 
     def step(state, ids, labels):
