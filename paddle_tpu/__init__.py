@@ -72,19 +72,37 @@ def shape(x):
 
 def in_dynamic_mode() -> bool:
     from .jit.api import in_to_static_mode
-    return not in_to_static_mode()
+    return not in_to_static_mode() and not _static_mode
 
 
 def disable_static(place=None):
+    global _static_mode
+    if _static_mode:
+        from . import static as _st
+        _st._bind_recording(False)
+    _static_mode = False
     return None
 
 
+_static_mode = False
+
+
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no legacy static-graph Program mode; use "
-        "paddle_tpu.jit.to_static (whole-function XLA compilation) instead")
+    """Switch to static-graph building (reference paddle.enable_static).
+    Ops touching ``static.data`` Variables record into the active Program;
+    ``static.Executor.run`` jits the recording (see paddle_tpu/static)."""
+    global _static_mode
+    from . import static as _st
+    _st._bind_recording(True)
+    _static_mode = True
+
+
+def in_static_mode():
+    return _static_mode
 
 from . import models  # noqa: F401
+from . import static  # noqa: F401
+from . import utils  # noqa: F401
 from . import parallel  # noqa: F401
 from . import distributed  # noqa: F401
 import importlib as _importlib

@@ -44,6 +44,53 @@ def _is_tensor_leaf(x: Any) -> bool:
 # op name -> forward fn (impl); populated by ops.registry
 _FORWARD_CACHE: Dict[Any, Callable] = {}
 
+# bound by paddle_tpu.static on import: the symbolic Variable class; any op
+# touching one records a Program node instead of executing
+_static_variable_cls: Optional[type] = None
+
+
+def _record_static(name: str, fn: Callable, treedef, leaves):
+    """Record this op call into the owning static Program (reference:
+    op append into framework.Program's global block)."""
+    from ..static import Variable
+
+    static_leaves: List[Any] = []
+    dyn_idx: List[int] = []
+    markers: List[Any] = []
+    consts: List[Any] = []
+    avals: List[Any] = []
+    prog = None
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, Variable):
+            prog = prog or leaf.program
+            dyn_idx.append(i)
+            markers.append(leaf)
+            avals.append(leaf.aval())
+            static_leaves.append(None)
+        elif _is_dynamic(leaf):
+            from .tensor import Tensor
+            v = jnp.asarray(leaf._value if isinstance(leaf, Tensor)
+                            else leaf)
+            dyn_idx.append(i)
+            markers.append(None)
+            consts.append(v)
+            avals.append(jax.ShapeDtypeStruct(v.shape, v.dtype))
+            static_leaves.append(None)
+        else:
+            static_leaves.append(leaf)
+    dyn_set = tuple(dyn_idx)
+
+    def call(dyn_vals):
+        new_leaves = list(static_leaves)
+        for j, i in enumerate(dyn_set):
+            new_leaves[i] = dyn_vals[j]
+        a, k = jax.tree.unflatten(treedef, new_leaves)
+        return fn(*a, **k)
+
+    out_abs = jax.eval_shape(call, avals)
+    out_flat, out_treedef = jax.tree.flatten(out_abs)
+    return prog.record(name, call, markers, consts, out_flat, out_treedef)
+
 # optional per-op-call hook set by amp.debugging operator-stats collection
 _op_stats_hook: Optional[Callable] = None
 
@@ -87,6 +134,10 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict,
             kwargs = {k: _amp_cast(v) for k, v in kwargs.items()}
 
     leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor_leaf)
+
+    if _static_variable_cls is not None and any(
+            isinstance(l, _static_variable_cls) for l in leaves):
+        return _record_static(name, fn, treedef, leaves)
 
     dyn_idx: List[int] = []
     dyn_tensors: List[Optional[Tensor]] = []

@@ -13,4 +13,5 @@ from ..parallel.checkpoint import (  # noqa: F401
     load_state_dict, save_state_dict,
 )
 from . import checkpoint  # noqa: F401
+from . import rpc  # noqa: F401
 from .recompute import recompute, recompute_hybrid, recompute_sequential  # noqa: F401

@@ -1,0 +1,226 @@
+"""paddle.distributed.rpc parity (reference python/paddle/distributed/rpc/
+rpc.py: init_rpc / rpc_sync / rpc_async / shutdown over a C++ brpc agent).
+
+Host-side infra, so plain Python: a socket server thread per worker
+executes pickled (fn, args, kwargs) requests; the master endpoint doubles
+as the name→endpoint directory (the reference keeps the worker table in
+the master's store the same way).  Device work stays in the XLA
+collectives path — RPC is for control-plane calls exactly like the
+reference positions it.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+           "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_state: Dict[str, Any] = {}
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    n = struct.unpack("!Q", hdr)[0]
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            req = pickle.loads(_recv_msg(self.request))
+        except ConnectionError:
+            return
+        kind = req[0]
+        if kind == "call":
+            _, fn, args, kwargs = req
+            try:
+                out = ("ok", fn(*args, **kwargs))
+            except Exception as e:      # ship the failure to the caller
+                out = ("err", e)
+            _send_msg(self.request, pickle.dumps(out))
+        elif kind == "register":
+            _, info = req
+            with self.server.pt_lock:
+                self.server.pt_workers[info.name] = info
+            _send_msg(self.request, pickle.dumps(("ok", None)))
+        elif kind == "lookup":
+            _, expect = req
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                with self.server.pt_lock:
+                    if len(self.server.pt_workers) >= expect:
+                        break
+                time.sleep(0.05)
+            with self.server.pt_lock:
+                n = len(self.server.pt_workers)
+                if n < expect:
+                    _send_msg(self.request, pickle.dumps(
+                        ("err", TimeoutError(
+                            f"rpc rendezvous: only {n}/{expect} workers "
+                            "registered within 60s"))))
+                else:
+                    _send_msg(self.request, pickle.dumps(
+                        ("ok", dict(self.server.pt_workers))))
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr):
+        super().__init__(addr, _Handler)
+        self.pt_workers: Dict[str, WorkerInfo] = {}
+        self.pt_lock = threading.Lock()
+
+
+def _client_call(ip: str, port: int, payload, timeout: float = 120.0) -> Any:
+    with socket.create_connection((ip, port), timeout=timeout) as s:
+        _send_msg(s, pickle.dumps(payload))
+        status, out = pickle.loads(_recv_msg(s))
+    if status == "err":
+        raise out
+    return out
+
+
+def _reachable_ip() -> str:
+    """This host's address as peers can reach it (reference workers
+    advertise PADDLE_CURRENT_ENDPOINT the same way)."""
+    import os
+    ep = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+    if ":" in ep:
+        return ep.split(":")[0]
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """Start this worker's RPC agent and register with the master
+    (reference rpc.init_rpc).  rank 0's agent doubles as the directory."""
+    import os
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", "127.0.0.1:18765")
+    mip, mport = master_endpoint.split(":")
+    mport = int(mport)
+
+    if rank == 0:
+        server = _Server((mip, mport))
+        me = WorkerInfo(name, 0, mip, mport)
+    else:
+        ip = _reachable_ip()
+        server = _Server((ip, 0))
+        me = WorkerInfo(name, rank, ip, server.server_address[1])
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+
+    if rank == 0:
+        with server.pt_lock:
+            server.pt_workers[name] = me
+    else:
+        deadline = time.time() + 60
+        while True:
+            try:
+                _client_call(mip, mport, ("register", me))
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.1)
+
+    workers = _client_call(mip, mport, ("lookup", world_size)) \
+        if world_size > 1 else {name: me}
+    _state.update(server=server, thread=thread, me=me, workers=workers,
+                  master=(mip, mport), world_size=world_size)
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    if name is None:
+        return _state["me"]
+    _refresh()
+    return _state["workers"][name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    _refresh()
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def _refresh():
+    if len(_state.get("workers", {})) < _state.get("world_size", 1):
+        mip, mport = _state["master"]
+        _state["workers"] = _client_call(
+            mip, mport, ("lookup", _state["world_size"]))
+
+
+def rpc_async(to: str, fn: Callable, args: Tuple = (), kwargs=None,
+              timeout: float = 120.0) -> Future:
+    """Run ``fn(*args, **kwargs)`` on worker ``to``; returns a Future
+    (reference rpc.rpc_async)."""
+    kwargs = kwargs or {}
+    info = get_worker_info(to)
+    fut: Future = Future()
+
+    def work():
+        try:
+            fut.set_result(_client_call(info.ip, info.port,
+                                        ("call", fn, args, kwargs),
+                                        timeout=timeout))
+        except Exception as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=work, daemon=True).start()
+    return fut
+
+
+def rpc_sync(to: str, fn: Callable, args: Tuple = (), kwargs=None,
+             timeout: float = 120.0) -> Any:
+    return rpc_async(to, fn, args, kwargs, timeout).result(timeout)
+
+
+def shutdown() -> None:
+    """Stop this worker's agent (reference rpc.shutdown)."""
+    server = _state.pop("server", None)
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+    _state.clear()

@@ -1,0 +1,291 @@
+"""paddle.static parity: Program / program_guard / data / Executor
+(reference python/paddle/static/__init__.py, framework.Program,
+executor.Executor — SURVEY §1 layer 3).
+
+TPU-first design: a Program is a RECORDED op graph, not an IR.  Under
+``enable_static`` + ``program_guard``, every framework op that touches a
+symbolic :class:`Variable` appends a node (shape/dtype inferred with
+``jax.eval_shape``) instead of executing.  ``Executor.run`` replays the
+recording as one pure function of the feeds and ``jax.jit``s it — the
+Program/Executor pair collapses onto XLA exactly like ``jit.to_static``,
+but through the reference's build-then-run API shape.
+
+Supported surface: inference-style programs (data → ops → fetch).  The
+legacy in-graph training loop (append_backward/minimize) is out of scope —
+training is the compiled dygraph path (SURVEY §7 design decision).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch as _dispatch
+from ..core.tensor import Tensor
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "default_startup_program", "data", "Executor", "Variable",
+           "InputSpec", "CPUPlace", "CUDAPlace", "TPUPlace"]
+
+
+class Variable:
+    """Symbolic tensor inside a Program (reference framework.Variable):
+    knows shape/dtype, produced by a recorded node or a ``data`` feed."""
+
+    def __init__(self, program: "Program", name: str, shape, dtype):
+        self.program = program
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+        self.stop_gradient = True
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def aval(self):
+        concrete = tuple(1 if d in (None, -1) else int(d)
+                         for d in self.shape)
+        return jax.ShapeDtypeStruct(concrete, self.dtype)
+
+    def __repr__(self):
+        return f"Variable(name={self.name!r}, shape={self.shape}, " \
+               f"dtype={self.dtype.name})"
+
+    # arithmetic sugar routes through the recorded ops
+    def _binop(self, op, other, swap=False):
+        from ..ops import api
+        return getattr(api, op)(other, self) if swap \
+            else getattr(api, op)(self, other)
+
+    def __add__(self, o):
+        return self._binop("add", o)
+
+    def __radd__(self, o):
+        return self._binop("add", o, swap=True)
+
+    def __sub__(self, o):
+        return self._binop("subtract", o)
+
+    def __mul__(self, o):
+        return self._binop("multiply", o)
+
+    def __rmul__(self, o):
+        return self._binop("multiply", o, swap=True)
+
+    def __matmul__(self, o):
+        return self._binop("matmul", o)
+
+    def __truediv__(self, o):
+        return self._binop("divide", o)
+
+    def __rtruediv__(self, o):
+        return self._binop("divide", o, swap=True)
+
+    def __rsub__(self, o):
+        return self._binop("subtract", o, swap=True)
+
+    def __neg__(self):
+        from ..ops import api
+        return api.neg(self)
+
+    def __pow__(self, o):
+        return self._binop("pow", o)
+
+    def __lt__(self, o):
+        return self._binop("less_than", o)
+
+    def __le__(self, o):
+        return self._binop("less_equal", o)
+
+    def __gt__(self, o):
+        return self._binop("greater_than", o)
+
+    def __ge__(self, o):
+        return self._binop("greater_equal", o)
+
+
+class _Node:
+    __slots__ = ("call", "in_vars", "const_args", "out_vars")
+
+    def __init__(self, call, in_vars, const_args, out_vars):
+        self.call = call            # fn(dyn_values_list) -> outputs
+        self.in_vars = in_vars      # Variable inputs, positional in call
+        self.const_args = const_args
+        self.out_vars = out_vars
+
+
+class Program:
+    """An ordered recording of op nodes (reference framework.Program;
+    blocks/ops collapse to one linear node list — control flow inside a
+    recorded op is a lax construct, not a sub-block)."""
+
+    _counter = 0
+
+    def __init__(self):
+        Program._counter += 1
+        self.id = Program._counter
+        self.nodes: List[_Node] = []
+        self.feeds: Dict[str, Variable] = {}
+        self._name_i = 0
+
+    def _fresh(self, prefix="tmp"):
+        self._name_i += 1
+        return f"{prefix}_{self.id}_{self._name_i}"
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program()
+        p.nodes = list(self.nodes)
+        p.feeds = dict(self.feeds)
+        return p
+
+    # ---- recording hook used by core.dispatch ----
+    def record(self, name, call, markers, consts, out_avals, out_treedef):
+        """Append a node.  ``markers``: per-dynamic-slot Variable or None
+        (None slots read from ``consts`` in order at replay)."""
+        outs = [Variable(self, self._fresh(name), a.shape, a.dtype)
+                for a in out_avals]
+        self.nodes.append(_Node(call, markers, consts, outs))
+        return jax.tree.unflatten(out_treedef, outs)
+
+    # ---- replay ----
+    def build_fn(self, fetch_vars: Sequence[Variable]):
+        feed_names = list(self.feeds)
+
+        def run(feed_values: Dict[str, Any]):
+            env: Dict[int, Any] = {}
+            for n in feed_names:
+                env[id(self.feeds[n])] = jnp.asarray(feed_values[n])
+            for node in self.nodes:
+                dyn = []
+                it_const = iter(node.const_args)
+                for v in node.in_vars:
+                    if isinstance(v, Variable):
+                        if id(v) not in env:
+                            raise KeyError(
+                                f"variable {v.name!r} used before "
+                                "definition (missing feed?)")
+                        dyn.append(env[id(v)])
+                    else:
+                        dyn.append(next(it_const))
+                outs = node.call(dyn)
+                flat = jax.tree.leaves(outs)
+                for var, val in zip(node.out_vars, flat):
+                    env[id(var)] = val
+            outs = []
+            for v in fetch_vars:
+                if id(v) not in env:
+                    raise KeyError(
+                        f"fetch variable {v.name!r} was not produced by "
+                        "this program (wrong Program or missing feed?)")
+                outs.append(env[id(v)])
+            return outs
+
+        return run
+
+
+_default_main: Program = Program()
+_default_startup: Program = Program()
+_guard_stack: List[Program] = []
+
+
+def default_main_program() -> Program:
+    return _guard_stack[-1] if _guard_stack else _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+class program_guard:
+    """``with program_guard(main, startup):`` — ops recorded into main
+    (reference static.program_guard)."""
+
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        _guard_stack.append(self.main)
+        return self
+
+    def __exit__(self, *exc):
+        _guard_stack.pop()
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Variable:
+    """Feed placeholder (reference static.data)."""
+    prog = default_main_program()
+    v = Variable(prog, name, shape, dtype)
+    prog.feeds[name] = v
+    return v
+
+
+class InputSpec:
+    """paddle.static.InputSpec (shared with jit.to_static signatures)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+
+class CPUPlace:
+    pass
+
+
+class CUDAPlace:
+    def __init__(self, _id=0):
+        self.id = _id
+
+
+class TPUPlace:
+    def __init__(self, _id=0):
+        self.id = _id
+
+
+class Executor:
+    """Program runner (reference executor.Executor → here: replay the
+    recording as a pure function and jit it, cached per fetch set)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Any, Any] = {}
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list: Sequence[Variable] = (), return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        if not program.nodes and not fetch_list:
+            return []          # startup program: params are eager here
+        key = (id(program), len(program.nodes),
+               tuple(id(v) for v in fetch_list))
+        fn = self._cache.get(key)
+        if fn is None:
+            raw = program.build_fn(list(fetch_list))
+            fn = jax.jit(raw)
+            self._cache[key] = fn
+        outs = fn({k: np.asarray(v._value if isinstance(v, Tensor) else v)
+                   for k, v in feed.items()})
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+
+def is_static_variable(x) -> bool:
+    return isinstance(x, Variable)
+
+
+def _bind_recording(on: bool) -> None:
+    """Install/remove the dispatch recording hook.  Bound only while
+    enable_static is active so pure-dygraph dispatch pays zero cost for
+    the Variable scan."""
+    _dispatch._static_variable_cls = Variable if on else None

@@ -1,0 +1,4 @@
+"""paddle.utils parity: cpp_extension custom-op toolchain (and room for
+the misc utils the reference keeps here)."""
+
+from . import cpp_extension  # noqa: F401

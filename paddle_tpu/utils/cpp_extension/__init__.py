@@ -1,0 +1,166 @@
+"""Custom C++ op toolchain (reference python/paddle/utils/cpp_extension —
+``load``/``setup``/``CppExtension`` — and the phi/capi custom-op ABI).
+
+TPU-native shape: the extension's kernels run on the HOST and enter the
+XLA program as ``jax.pure_callback`` custom calls, so a loaded op works in
+eager mode, under ``jax.jit``, and inside compiled train steps.  Device-side
+custom kernels are written in Pallas (ops/pallas/) — the reference's CUDA
+custom-op path maps to that, not to this loader.
+
+JIT compile + load (the reference's ``load``):
+
+    from paddle_tpu.utils.cpp_extension import load
+    mod = load(name="my_ops", sources=["my_ops.cc"])
+    y = mod.relu_cubed(x)          # registered via PT_REGISTER_OP
+
+The C ABI lives in ``pt_extension.h`` (shipped next to this file); ops
+receive float32 tensors and write one float32 output whose shape is the
+first input's unless ``out_shape_fn`` overrides it at wrap time.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["load", "CppExtension", "get_include", "CustomOpModule"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory containing ``pt_extension.h`` (reference
+    ``paddle.utils.cpp_extension.get_include``)."""
+    return _HERE
+
+
+def CppExtension(sources: Sequence[str], *args, **kwargs):
+    """setuptools-style descriptor for parity with the reference's
+    ``setup(ext_modules=CppExtension(...))`` flow; ``load`` consumes it."""
+    return {"sources": list(sources), "args": args, "kwargs": kwargs}
+
+
+class CustomOpModule:
+    """Loaded extension: one attribute per registered op."""
+
+    def __init__(self, name: str, lib: ctypes.CDLL,
+                 op_names: Sequence[str],
+                 out_shape_fns: Optional[Dict[str, Callable]] = None):
+        self.name = name
+        self._lib = lib
+        self.op_names = list(op_names)
+        shape_fns = out_shape_fns or {}
+        for op in self.op_names:
+            setattr(self, op, self._make(op, shape_fns.get(op)))
+
+    def _compute(self, op: str, out_shape, *arrays):
+        arrays = [np.ascontiguousarray(np.asarray(a, np.float32))
+                  for a in arrays]
+        n = len(arrays)
+        data = (ctypes.POINTER(ctypes.c_float) * n)(*[
+            a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+            for a in arrays])
+        shapes = np.concatenate([np.asarray(a.shape, np.int64)
+                                 for a in arrays]) if n else \
+            np.zeros(0, np.int64)
+        ndims = np.asarray([a.ndim for a in arrays], np.int32)
+        out = np.zeros(out_shape, np.float32)
+        oshape = np.asarray(out_shape, np.int64)
+        rc = self._lib.pt_op_compute(
+            op.encode(), n, data,
+            shapes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ndims.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            oshape.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(out_shape))
+        if rc != 0:
+            raise RuntimeError(f"custom op {op!r} not found in "
+                               f"extension {self.name!r}")
+        return out
+
+    def _make(self, op: str, out_shape_fn: Optional[Callable]):
+        def call(*xs, **kwargs):
+            from ...core.dispatch import run_op
+            vals = [jnp.asarray(getattr(x, "_value", x)) for x in xs]
+            shp = (tuple(out_shape_fn(*[v.shape for v in vals]))
+                   if out_shape_fn else tuple(vals[0].shape))
+
+            def impl(*vs):
+                # host callback: runs the C++ kernel; inside jit it lowers
+                # to an XLA custom call (the capi custom-op execution path)
+                return jax.pure_callback(
+                    lambda *arrs: self._compute(op, shp, *arrs),
+                    jax.ShapeDtypeStruct(shp, jnp.float32), *vs,
+                    vmap_method="sequential")
+
+            return run_op(f"{self.name}.{op}", impl, tuple(vals), {},
+                          differentiable=False)
+
+        call.__name__ = op
+        return call
+
+
+def _build(name: str, sources: Sequence[str], extra_cflags: Sequence[str],
+           extra_include_paths: Sequence[str], build_directory: str,
+           verbose: bool) -> str:
+    os.makedirs(build_directory, exist_ok=True)
+    tag = hashlib.sha1()
+    hdrs = [os.path.join(_HERE, "pt_extension.h")]
+    for d in extra_include_paths:
+        for fn in sorted(os.listdir(d)):
+            if fn.endswith((".h", ".hpp", ".hh")):
+                hdrs.append(os.path.join(d, fn))
+    for s in list(sources) + hdrs:
+        with open(s, "rb") as f:
+            tag.update(f.read())
+    tag.update(" ".join(extra_cflags).encode())
+    so = os.path.join(build_directory, f"{name}_{tag.hexdigest()[:12]}.so")
+    if not os.path.exists(so):
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+               f"-I{_HERE}"]
+        cmd += [f"-I{p}" for p in extra_include_paths]
+        cmd += list(extra_cflags) + list(sources) + ["-o", so]
+        if verbose:
+            print("cpp_extension:", " ".join(cmd), file=sys.stderr)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{proc.stderr}")
+    return so
+
+
+def load(name: str, sources: Sequence[str], extra_cflags: Sequence[str] = (),
+         extra_cuda_cflags: Sequence[str] = (),
+         extra_include_paths: Sequence[str] = (),
+         build_directory: Optional[str] = None, verbose: bool = False,
+         out_shape_fns: Optional[Dict[str, Callable]] = None
+         ) -> CustomOpModule:
+    """Compile ``sources`` with g++, load the .so, and wrap every
+    ``PT_REGISTER_OP`` op as a framework op (reference
+    cpp_extension.load → _jit_compile → import).  ``extra_cuda_cflags``
+    is accepted for source compatibility and ignored (no CUDA here)."""
+    if isinstance(sources, dict):    # a CppExtension descriptor
+        sources = sources["sources"]
+    build_directory = build_directory or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    so = _build(name, sources, extra_cflags, extra_include_paths,
+                build_directory, verbose)
+    lib = ctypes.CDLL(so)
+    lib.pt_num_ops.restype = ctypes.c_int
+    lib.pt_op_name.restype = ctypes.c_char_p
+    lib.pt_op_name.argtypes = [ctypes.c_int]
+    lib.pt_op_compute.restype = ctypes.c_int
+    ops = [lib.pt_op_name(i).decode() for i in range(lib.pt_num_ops())]
+    if not ops:
+        raise RuntimeError(
+            f"extension {name!r} registered no ops (did the sources "
+            "include pt_extension.h and use PT_REGISTER_OP?)")
+    return CustomOpModule(name, lib, ops, out_shape_fns)

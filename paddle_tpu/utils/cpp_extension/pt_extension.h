@@ -1,0 +1,78 @@
+// paddle_tpu custom-op C ABI (the cpp_extension analog of the reference's
+// paddle/phi/capi + python/paddle/utils/cpp_extension PD_BUILD_OP).
+//
+// An extension registers ops into a static table via PT_REGISTER_OP; the
+// Python loader enumerates the table through three exported symbols and
+// invokes kernels through a single dispatch entry.  Tensors cross the
+// boundary as raw float32 buffers + shapes — the host-callback form that
+// composes with XLA via jax.pure_callback (device-side custom kernels are
+// written in Pallas instead; see ops/pallas/).
+#pragma once
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace pt_ext {
+
+struct Tensor {
+  const float* data;
+  const int64_t* shape;
+  int ndim;
+  int64_t numel() const {
+    int64_t n = 1;
+    for (int i = 0; i < ndim; ++i) n *= shape[i];
+    return n;
+  }
+};
+
+using KernelFn = void (*)(int n_in, const Tensor* ins, float* out,
+                          const int64_t* out_shape, int out_ndim);
+
+struct OpEntry {
+  const char* name;
+  KernelFn fn;
+};
+
+inline std::vector<OpEntry>& registry() {
+  static std::vector<OpEntry> r;
+  return r;
+}
+
+struct Registrar {
+  Registrar(const char* name, KernelFn fn) {
+    registry().push_back({name, fn});
+  }
+};
+
+}  // namespace pt_ext
+
+#define PT_REGISTER_OP(opname, fn) \
+  static ::pt_ext::Registrar pt_reg_##opname(#opname, fn);
+
+// weak + default visibility: emitted (and deduplicated) wherever the
+// header lands, so multi-TU extensions link cleanly
+#define PT_EXPORT extern "C" __attribute__((weak, visibility("default")))
+PT_EXPORT int pt_num_ops() {
+  return static_cast<int>(pt_ext::registry().size());
+}
+PT_EXPORT const char* pt_op_name(int i) {
+  return pt_ext::registry()[static_cast<size_t>(i)].name;
+}
+PT_EXPORT int pt_op_compute(const char* name, int n_in, const float** in_data,
+                         const int64_t* in_shapes, const int* in_ndims,
+                         float* out, const int64_t* out_shape,
+                         int out_ndim) {
+  std::vector<pt_ext::Tensor> ins;
+  const int64_t* sp = in_shapes;
+  for (int i = 0; i < n_in; ++i) {
+    ins.push_back({in_data[i], sp, in_ndims[i]});
+    sp += in_ndims[i];
+  }
+  for (auto& e : pt_ext::registry()) {
+    if (std::strcmp(e.name, name) == 0) {
+      e.fn(n_in, ins.data(), out, out_shape, out_ndim);
+      return 0;
+    }
+  }
+  return 1;
+}

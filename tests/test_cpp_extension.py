@@ -1,0 +1,82 @@
+"""Custom-op toolchain (reference python/paddle/utils/cpp_extension
+test/custom_op/test_custom_relu_op_jit.py model): compile a real C++
+extension with g++ at test time, load it, run eager + jitted."""
+
+import os
+import textwrap
+
+import jax
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from paddle_tpu.utils.cpp_extension import get_include, load
+
+SRC = textwrap.dedent("""
+    #include "pt_extension.h"
+    #include <cmath>
+
+    static void relu_cubed(int n_in, const pt_ext::Tensor* ins, float* out,
+                           const int64_t*, int) {
+      const pt_ext::Tensor& x = ins[0];
+      for (int64_t i = 0; i < x.numel(); ++i) {
+        float v = x.data[i] > 0.f ? x.data[i] : 0.f;
+        out[i] = v * v * v;
+      }
+    }
+    PT_REGISTER_OP(relu_cubed, relu_cubed)
+
+    static void pairwise_add(int n_in, const pt_ext::Tensor* ins,
+                             float* out, const int64_t*, int) {
+      for (int64_t i = 0; i < ins[0].numel(); ++i)
+        out[i] = ins[0].data[i] + ins[1].data[i];
+    }
+    PT_REGISTER_OP(pairwise_add, pairwise_add)
+""")
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "my_ops.cc"
+    src.write_text(SRC)
+    return load(name="my_ops", sources=[str(src)],
+                build_directory=str(d / "build"))
+
+
+def test_registers_ops(ext):
+    assert set(ext.op_names) == {"relu_cubed", "pairwise_add"}
+
+
+def test_eager_call(ext):
+    x = np.array([-1.0, 0.5, 2.0], np.float32)
+    out = np.asarray(ext.relu_cubed(x)._value)
+    np.testing.assert_allclose(out, [0.0, 0.125, 8.0], rtol=1e-6)
+
+
+def test_two_input_op(ext):
+    a = np.ones((2, 3), np.float32)
+    b = np.full((2, 3), 2.0, np.float32)
+    np.testing.assert_allclose(np.asarray(ext.pairwise_add(a, b)._value),
+                               3.0)
+
+
+def test_under_jit(ext):
+    x = np.array([[1.0, -2.0], [3.0, 0.0]], np.float32)
+
+    @jax.jit
+    def f(a):
+        h = ext.relu_cubed(a)
+        return np.pi * (h._value if hasattr(h, "_value") else h)
+
+    np.testing.assert_allclose(np.asarray(f(x)),
+                               np.pi * np.maximum(x, 0) ** 3, rtol=1e-6)
+
+
+def test_build_cache_reused(ext, tmp_path):
+    # same sources -> same hashed .so, no rebuild (mtime unchanged)
+    import paddle_tpu.utils.cpp_extension as ce
+    sos = [f for f in os.listdir(os.path.dirname(ext._lib._name))
+           if f.endswith(".so")]
+    assert len(sos) == 1

@@ -1,0 +1,77 @@
+"""distributed.rpc (reference python/paddle/distributed/rpc — brpc agent
+replaced with a socket agent; test model test/rpc/test_rpc_base.py)."""
+
+import multiprocessing as mp
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import rpc
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _square(x):
+    return x * x
+
+
+def _boom():
+    raise ValueError("remote failure")
+
+
+def _worker1(ep, q):
+    try:
+        rpc.init_rpc("worker1", rank=1, world_size=2, master_endpoint=ep)
+        # worker1 calls back into worker0
+        got = rpc.rpc_sync("worker0", _square, args=(7,))
+        q.put(("ok", got))
+        time.sleep(1.0)       # stay alive to serve worker0's calls
+        rpc.shutdown()
+    except Exception as e:
+        q.put(("err", repr(e)))
+
+
+class TestRpc:
+    def test_two_worker_round_trip(self):
+        ep = f"127.0.0.1:{_free_port()}"
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_worker1, args=(ep, q))
+        p.start()
+        try:
+            rpc.init_rpc("worker0", rank=0, world_size=2,
+                         master_endpoint=ep)
+            infos = rpc.get_all_worker_infos()
+            assert [w.name for w in infos] == ["worker0", "worker1"]
+            # worker0 -> worker1 call
+            out = rpc.rpc_sync("worker1", _square, args=(5,))
+            assert out == 25
+            fut = rpc.rpc_async("worker1", _square, args=(np.arange(3),))
+            np.testing.assert_array_equal(fut.result(60), [0, 1, 4])
+            # and worker1's call into us completed
+            status, got = q.get(timeout=60)
+            assert status == "ok" and got == 49
+        finally:
+            rpc.shutdown()
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+
+    def test_remote_exception_propagates(self):
+        ep = f"127.0.0.1:{_free_port()}"
+        rpc.init_rpc("solo", rank=0, world_size=1, master_endpoint=ep)
+        try:
+            # like the reference, callables must be importable (pickled)
+            with pytest.raises(ValueError, match="remote failure"):
+                rpc.rpc_sync("solo", _boom)
+        finally:
+            rpc.shutdown()

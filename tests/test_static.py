@@ -1,0 +1,98 @@
+"""paddle.static Program/Executor facade (reference
+test/legacy_test/test_executor_and_use_program_cache.py flavor: build a
+program with static.data, run it with Executor over feeds)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def static_mode():
+    pt.enable_static()
+    yield
+    pt.disable_static()
+
+
+def test_build_and_run_program():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 3], "float32")
+        y = static.data("y", [4, 3], "float32")
+        z = pt.add(pt.multiply(x, y), pt.ones((4, 3)))
+        out = pt.sum(z)
+    exe = static.Executor()
+    xs = np.full((4, 3), 2.0, np.float32)
+    ys = np.full((4, 3), 3.0, np.float32)
+    res = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[z, out])
+    np.testing.assert_allclose(res[0], 7.0)
+    assert res[1] == pytest.approx(84.0)
+
+
+def test_program_records_not_executes():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        h = pt.exp(x)
+    assert isinstance(h, static.Variable)
+    assert h.shape == (2, 2)
+    assert len(main.nodes) == 1
+
+
+def test_nn_layer_in_static_program():
+    from paddle_tpu import nn
+    lin = nn.Linear(4, 2)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [3, 4], "float32")
+        y = lin(x)
+        assert isinstance(y, static.Variable) and y.shape == (3, 2)
+    exe = static.Executor()
+    xs = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+    res = exe.run(main, feed={"x": xs}, fetch_list=[y])
+    w = np.asarray(lin.weight._value)
+    b = np.asarray(lin.bias._value)
+    np.testing.assert_allclose(res[0], xs @ w + b, rtol=1e-5)
+
+
+def test_executor_caches_compiled_program():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        y = x * 2.0
+    exe = static.Executor()
+    r1 = exe.run(main, feed={"x": np.ones(2, np.float32)}, fetch_list=[y])
+    r2 = exe.run(main, feed={"x": np.full(2, 3.0, np.float32)},
+                 fetch_list=[y])
+    np.testing.assert_allclose(r1[0], 2.0)
+    np.testing.assert_allclose(r2[0], 6.0)
+    assert len(exe._cache) == 1
+
+
+def test_default_main_program_guarded():
+    base = static.default_main_program()
+    p = static.Program()
+    with static.program_guard(p):
+        assert static.default_main_program() is p
+    assert static.default_main_program() is base
+
+
+def test_chained_softmax_matmul():
+    from paddle_tpu.nn import functional as F
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 5], "float32")
+        w = static.data("w", [5, 5], "float32")
+        h = pt.matmul(x, w)
+        p = F.softmax(h, axis=-1)
+    exe = static.Executor()
+    rng = np.random.default_rng(1)
+    xs = rng.normal(size=(2, 5)).astype(np.float32)
+    ws = rng.normal(size=(5, 5)).astype(np.float32)
+    (got,) = exe.run(main, feed={"x": xs, "w": ws}, fetch_list=[p])
+    ref = xs @ ws
+    ref = np.exp(ref - ref.max(-1, keepdims=True))
+    ref = ref / ref.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
