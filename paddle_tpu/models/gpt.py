@@ -321,6 +321,7 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                          use_flash: Optional[bool] = None,
                          remat: bool = True,
                          schedule: str = "1f1b",
+                         num_model_chunks: int = 1,
                          sharding_stage: int = 2,
                          sequence_parallel: bool = False):
     """Compile a full hybrid-parallel GPT training step: dp×mp×pp×sharding×sep.
@@ -391,8 +392,26 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
     emb_specs = {
         "wte": P(MP_AXIS, None), "wpe": P(), "lnf_w": P(), "lnf_b": P(),
     }
+    vpp = num_model_chunks if schedule == "interleave" else 1
+    if vpp > 1 and cfg.num_layers % (S * vpp) != 0:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by pp*chunks "
+            f"{S}*{vpp}")
     blk_specs = block_param_specs(cfg, pipeline=True)
+    if vpp > 1:
+        # [S, v, per_v, ...]: element [s, c] holds virtual stage s + S*c
+        blk_specs = {k: P(*(tuple(sp)[:1] + (None,) + tuple(sp)[1:]))
+                     for k, sp in blk_specs.items()}
     param_specs = dict(emb_specs, blocks=blk_specs)
+
+    def _stacked_blocks(k3):
+        if vpp == 1:
+            return stack_block_params(cfg, k3, S)
+        stacked = stack_block_params(cfg, k3, S * vpp)   # [Sv, per_v, ...]
+        return {n: jnp.transpose(
+                    val.reshape((vpp, S) + val.shape[1:]),
+                    (1, 0) + tuple(range(2, val.ndim + 1)))
+                for n, val in stacked.items()}
 
     def sh(spec):
         return NamedSharding(mesh, spec)
@@ -412,7 +431,7 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
             "lnf_w": jax.device_put(jnp.ones(cfg.hidden_size), sh(P())),
             "lnf_b": jax.device_put(jnp.zeros(cfg.hidden_size), sh(P())),
             "blocks": {n: jax.device_put(v, sh(blk_specs[n]))
-                       for n, v in stack_block_params(cfg, k3, S).items()},
+                       for n, v in _stacked_blocks(k3).items()},
         }
 
     sp = sequence_parallel and mp > 1
@@ -450,6 +469,7 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
         embed_fn=embed_fn, block_fn=block_fn, head_nll_fn=head_nll_fn,
         num_microbatches=num_microbatches, learning_rate=learning_rate,
         remat=remat, schedule=schedule, sharding_stage=sharding_stage,
+        num_model_chunks=num_model_chunks,
         mp_reduce_block_leaves=frozenset(
             {"ln1_w", "ln1_b", "ln2_w", "ln2_b", "proj_b", "fc2_b"}
             if sp else ()))

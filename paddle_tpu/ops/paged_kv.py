@@ -1,0 +1,147 @@
+"""Paged KV cache + block attention (reference
+paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu +
+python/paddle/incubate/nn/functional/block_multihead_attention.py).
+
+TPU-first: the physical cache is one pooled array
+``[num_blocks, block_size, H_kv, D]`` per k/v; sequences own logical pages
+through an int32 ``block_table [B, max_blocks]``.  The decode step gathers
+a sequence's pages with one XLA gather (rides HBM at full bandwidth; no
+pointer chasing like the CUDA kernel — the gather IS the page walk) and
+runs the same online-softmax math as the dense MMHA.  The host-side
+:class:`BlockAllocator` mirrors the reference's block manager: free-list
+allocate/extend/release so unrelated sequences share the pool.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["BlockAllocator", "PagedKVCache", "paged_decode_attention",
+           "paged_append"]
+
+NEG_INF = -1e30
+
+
+class BlockAllocator:
+    """Free-list page allocator (reference BlockManager semantics)."""
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._owned: Dict[int, List[int]] = {}
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, seq_id: int, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"paged KV pool exhausted: need {n} blocks, "
+                f"{len(self._free)} free")
+        got = [self._free.pop() for _ in range(n)]
+        self._owned.setdefault(seq_id, []).extend(got)
+        return got
+
+    def blocks_of(self, seq_id: int) -> List[int]:
+        return list(self._owned.get(seq_id, []))
+
+    def release(self, seq_id: int) -> None:
+        self._free.extend(reversed(self._owned.pop(seq_id, [])))
+
+
+class PagedKVCache:
+    """Pooled paged cache for one attention layer set.
+
+    ``k/v``: [L, num_blocks, block_size, H_kv, D]; ``block_table``
+    [B, max_blocks] (-1 = unmapped); ``lengths`` [B].
+    """
+
+    def __init__(self, num_layers: int, num_blocks: int, block_size: int,
+                 num_kv_heads: int, head_dim: int, max_batch: int,
+                 dtype=jnp.float32):
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.max_blocks_per_seq = num_blocks  # upper bound
+        self.k = jnp.zeros((num_layers, num_blocks, block_size,
+                            num_kv_heads, head_dim), dtype)
+        self.v = jnp.zeros_like(self.k)
+        self.block_table = np.full((max_batch, num_blocks), -1, np.int32)
+        self.lengths = np.zeros((max_batch,), np.int32)
+        self.alloc = BlockAllocator(num_blocks)
+
+    def ensure_capacity(self, seq_id: int, new_len: int) -> None:
+        """Map enough pages for ``new_len`` tokens of ``seq_id``."""
+        have = len(self.alloc.blocks_of(seq_id))
+        need = -(-new_len // self.block_size)
+        if need > have:
+            fresh = self.alloc.allocate(seq_id, need - have)
+            self.block_table[seq_id, have:need] = fresh
+
+    def free(self, seq_id: int) -> None:
+        self.alloc.release(seq_id)
+        self.block_table[seq_id] = -1
+        self.lengths[seq_id] = 0
+
+
+def paged_append(pool_k, pool_v, k_new, v_new, block_table, lengths,
+                 block_size: int):
+    """Scatter this step's per-sequence k/v token into its current page.
+
+    pool_k/pool_v: [NB, BS, H, D]; k_new/v_new: [B, H, D];
+    block_table: [B, MB] int32; lengths: [B] (tokens already stored).
+    Returns updated (pool_k, pool_v).
+    """
+    lengths = jnp.asarray(lengths)
+    bt = jnp.asarray(block_table)
+    pos = lengths                              # write slot per sequence
+    blk_idx = pos // block_size
+    off = pos % block_size
+    phys = jnp.take_along_axis(bt, blk_idx[:, None], axis=1)[:, 0]
+    # unmapped page (-1) must not wrap to the pool's last block and
+    # corrupt another sequence: route it out of bounds so the scatter
+    # drops it (callers are expected to ensure_capacity first)
+    phys = jnp.where(phys < 0, pool_k.shape[0], phys)
+    pool_k = pool_k.at[phys, off].set(k_new, mode="drop")
+    pool_v = pool_v.at[phys, off].set(v_new, mode="drop")
+    return pool_k, pool_v
+
+
+def paged_decode_attention(q, pool_k, pool_v, block_table, lengths,
+                           scale: Optional[float] = None):
+    """One decode step over a paged cache (reference
+    block_multi_head_attention decode phase).
+
+    q: [B, Hq, D]; pool_k/pool_v: [NB, BS, Hkv, D];
+    block_table: [B, MB]; lengths: [B] tokens valid (AFTER appending the
+    current token).  Returns [B, Hq, D].
+
+    The per-sequence page walk is ``jnp.take(pool, table)`` — one gather
+    producing [B, MB, BS, H, D] views; XLA fuses the mask+softmax chain
+    behind it, so HBM traffic is the same as a contiguous cache of length
+    MB*BS.
+    """
+    B, Hq, D = q.shape
+    NB, BS, Hkv, _ = pool_k.shape
+    MB = block_table.shape[1]
+    G = Hq // Hkv
+    s = scale if scale is not None else 1.0 / math.sqrt(D)
+    bt = jnp.maximum(jnp.asarray(block_table), 0)     # -1 -> page 0 (masked)
+    k = jnp.take(pool_k, bt, axis=0)                  # [B, MB, BS, Hkv, D]
+    v = jnp.take(pool_v, bt, axis=0)
+    k = k.reshape(B, MB * BS, Hkv, D)
+    v = v.reshape(B, MB * BS, Hkv, D)
+    qg = q.reshape(B, Hkv, G, D)
+    logits = jnp.einsum("bkgd,btkd->bkgt", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * s
+    mask = jnp.arange(MB * BS)[None, None, None, :] \
+        < jnp.asarray(lengths)[:, None, None, None]
+    logits = jnp.where(mask, logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgt,btkd->bkgd", p, v.astype(jnp.float32))
+    return out.reshape(B, Hq, D).astype(q.dtype)

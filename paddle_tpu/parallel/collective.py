@@ -139,7 +139,8 @@ def in_axis_index(axis: str):
 # ---------------------------------------------------------------------------
 # eager wrappers over global Tensors
 # ---------------------------------------------------------------------------
-def _eager_collective(tensor: Tensor, group, fn, in_spec=None, out_spec=None):
+def _eager_collective(tensor: Tensor, group, fn, in_spec=None,
+                      out_spec=None, op_name: str = "collective"):
     g = _resolve_group(group)
     topo = g.topo
     mesh = topo.mesh
@@ -150,7 +151,7 @@ def _eager_collective(tensor: Tensor, group, fn, in_spec=None, out_spec=None):
     mapped = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_spec,
                                    out_specs=out_spec, check_vma=False))
     v = tensor._value if isinstance(tensor, Tensor) else tensor
-    out = mapped(v)
+    out = _monitored(op_name, g.axis, lambda: mapped(v))
     return Tensor(out) if isinstance(tensor, Tensor) else out
 
 
@@ -166,7 +167,7 @@ def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group=None,
         return tensor
     out = _eager_collective(
         tensor, g, lambda x: in_all_reduce(x, list(g.axis), op),
-        in_spec=P(), out_spec=P())
+        in_spec=P(), out_spec=P(), op_name=f"all_reduce[{op}]")
     if isinstance(tensor, Tensor):
         tensor._value = out._value if isinstance(out, Tensor) else out
         return tensor
@@ -251,3 +252,65 @@ def send(tensor: Tensor, dst: int = 0, group=None, sync_op: bool = True):
 def recv(tensor: Tensor, src: int = 0, group=None, sync_op: bool = True):
     raise NotImplementedError(
         "see send(): use parallel.pipeline p2p or shard_map ppermute")
+
+
+# ---------------------------------------------------------------------------
+# per-collective monitoring (reference distributed/fleet comm-op timeout
+# tracking / FLAGS_distributed_timeout; most hang detection dissolves into
+# XLA, so the surface here is eager collectives + completion timing)
+# ---------------------------------------------------------------------------
+class CollectiveMonitor:
+    """Times every eager collective and warns past a soft deadline.
+
+    ``with CollectiveMonitor(warn_after=30.0) as mon:`` — each eager
+    collective's wall time is recorded in ``mon.events`` as
+    (name, group_axis, seconds); calls slower than ``warn_after`` log a
+    warning with the collective's identity — the reference's per-op comm
+    watchdog (comm monitoring in ProcessGroupNCCL) adapted to the
+    compiled-collective world: in-jit collectives are covered by the step
+    watchdog (distributed/elastic.py), eager ones by this monitor."""
+
+    _active = None
+
+    def __init__(self, warn_after: float = 30.0):
+        self.warn_after = warn_after
+        self.events = []
+
+    def __enter__(self):
+        CollectiveMonitor._active = self
+        return self
+
+    def __exit__(self, *exc):
+        CollectiveMonitor._active = None
+        return False
+
+    def record(self, name, axis, seconds):
+        self.events.append((name, axis, seconds))
+        if seconds > self.warn_after:
+            import warnings
+            warnings.warn(
+                f"collective {name!r} over axis {axis!r} took "
+                f"{seconds:.1f}s (> {self.warn_after:.1f}s) — possible "
+                "straggler or hang")
+
+    def summary(self):
+        """Total time and call count per collective name."""
+        agg = {}
+        for name, axis, sec in self.events:
+            t, n = agg.get(name, (0.0, 0))
+            agg[name] = (t + sec, n + 1)
+        return agg
+
+
+def _monitored(name, axis, fn):
+    mon = CollectiveMonitor._active
+    if mon is None:
+        return fn()
+    import time as _time
+    t0 = _time.perf_counter()
+    out = fn()
+    jax.tree.map(lambda t: t.block_until_ready()
+                 if hasattr(t, "block_until_ready") else t,
+                 getattr(out, "_value", out))
+    mon.record(name, axis, _time.perf_counter() - t0)
+    return out

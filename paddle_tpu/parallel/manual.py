@@ -221,6 +221,7 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                             adam_betas=(0.9, 0.95), adam_eps: float = 1e-8,
                             weight_decay: float = 0.0, remat: bool = True,
                             schedule: str = "1f1b",
+                            num_model_chunks: int = 1,
                             sharding_stage: int = 2,
                             mp_reduce_block_leaves=frozenset()):
     """Generic fully-manual hybrid dp×mp×pp×sharding×sep train step.
@@ -267,8 +268,11 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
     from jax.sharding import NamedSharding
     from .pipeline import spmd_pipeline, spmd_pipeline_1f1b
 
-    if schedule not in ("1f1b", "gpipe"):
+    if schedule not in ("1f1b", "gpipe", "interleave"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if schedule == "interleave" and sharding_stage == 3:
+        raise NotImplementedError(
+            "interleaved schedule with sharding_stage=3 is not wired yet")
     if sharding_stage not in (2, 3):
         raise ValueError(f"sharding_stage must be 2 or 3, got "
                          f"{sharding_stage}")
@@ -441,7 +445,34 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
             return total / (b_l * s_l * dp * shard * sep)
 
         norm = b_l * s_l * dp * shard * sep
-        if S > 1 and schedule == "1f1b":
+        if S > 1 and schedule == "interleave":
+            from .pipeline import spmd_pipeline_interleaved
+            M = num_microbatches
+            n_chunks = num_model_chunks
+            other = {k: val for k, val in params.items() if k != "blocks"}
+            blk = {k: val[0] for k, val in params["blocks"].items()}
+            ids_mb = ids.reshape(M, b_l // M, s_l)
+            labels_mb = labels.reshape(M, b_l // M, s_l)
+
+            def mb_fn_v(other_p, blk_c, x_in, ids1, labels1, first, last):
+                p = dict(other_p, blocks=None)
+                x0 = embed_fn(p, ids1)
+                x = jnp.where(first, x0, x_in)
+                y = run_stack(x, blk_c, use_remat=remat)
+                nll = head_nll_fn(p, y, labels1)
+                return y, jnp.sum(nll) * last.astype(nll.dtype)
+
+            xa = jax.eval_shape(
+                lambda o, i: embed_fn(dict(o, blocks=None), i),
+                other, ids_mb[0])
+            nll_sum, d_other, d_blk = spmd_pipeline_interleaved(
+                mb_fn_v, other, blk, ids_mb, labels_mb, xa.shape, xa.dtype,
+                S, n_chunks)
+            loss = fwd_psum(nll_sum,
+                            (PP_AXIS, DP_AXIS, SHARDING_AXIS, SEP_AXIS))                 / norm
+            grads = {k: g / norm for k, g in d_other.items()}
+            grads["blocks"] = {k: g[None] / norm for k, g in d_blk.items()}
+        elif S > 1 and schedule == "1f1b":
             M = num_microbatches
             other = {k: v for k, v in params.items() if k != "blocks"}
             blk = {k: v[0] for k, v in params["blocks"].items()}

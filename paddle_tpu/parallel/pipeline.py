@@ -274,3 +274,137 @@ class PipelineLayer(Layer):
     def get_stage_layers(self, stage: int):
         lo, hi = self.segments[stage]
         return [l for l, _ in self.runs[lo:hi]]
+
+
+def spmd_pipeline_interleaved(mb_fn_v, other_params, blk_params, ids_mb,
+                              labels_mb, x_shape, x_dtype, num_stages: int,
+                              num_chunks: int, axis_name: str = PP_AXIS):
+    """Interleaved (virtual-pipeline / VPP) 1F1B schedule.
+
+    Reference: pipeline_parallel.py:1138 ``_forward_backward_pipeline``'s
+    interleaved mode + pipeline_scheduler_pass VPP — each physical stage
+    hosts ``num_chunks`` model chunks, so the virtual pipeline has
+    ``Sv = S * v`` stages and the warmup/drain bubble shrinks ~1/v.
+
+    Layout: virtual stage ``vs`` lives on device ``vs % S`` as chunk
+    ``vs // S``; consecutive virtual stages are therefore ALWAYS on
+    ring-adjacent devices, so each chunk's activations ride the same +1
+    ppermute ring, with the device-(S-1) → device-0 hop also advancing the
+    chunk index (handled by shifting the send stream below).
+
+    ``mb_fn_v(other, blk_chunk, x_in, ids, labels, first, last)`` runs ONE
+    chunk: ``first``/``last`` say whether this (device, chunk) is virtual
+    stage 0 (embed instead of consuming ``x_in``) / Sv-1 (head + nll).
+    ``blk_params`` leaves are stacked ``[v, per_chunk, ...]`` device-local.
+
+    Same memory design as :func:`spmd_pipeline_1f1b`: the tick scan is not
+    differentiated; backward recomputes each chunk-forward from its saved
+    input (buffer of 2*Sv slots per chunk).
+    """
+    M = ids_mb.shape[0]
+    S = num_stages
+    v = num_chunks
+    Sv = S * v
+    T = M + 2 * (Sv - 1)
+    BUF = 2 * Sv
+    stage = jax.lax.axis_index(axis_name)
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+    is_last_dev = stage == S - 1
+    is_first_dev = stage == 0
+
+    f32 = functools.partial(jax.tree.map,
+                            lambda p: jnp.zeros(p.shape, jnp.float32))
+    x0 = jnp.zeros(x_shape, x_dtype)
+    chunk_blk = [jax.tree.map(lambda l, c=c: l[c], blk_params)
+                 for c in range(v)]
+
+    carry0 = (
+        jnp.zeros((v, BUF) + x_shape, x_dtype),    # saved chunk inputs
+        jnp.zeros((v,) + x_shape, x_dtype),        # fwd messages per chunk
+        jnp.zeros((v,) + x_shape, x_dtype),        # bwd messages per chunk
+        f32(other_params), f32(blk_params),
+        jnp.zeros((), jnp.float32),
+    )
+
+    def masked_add(acc, g, on):
+        return jax.tree.map(
+            lambda a, gg: a + jnp.where(on, gg.astype(jnp.float32), 0.0),
+            acc, g)
+
+    def tick(carry, t):
+        x_save, y_msg, dx_msg, d_other, d_blk, nll_acc = carry
+
+        new_y = []
+        for c in range(v):
+            vs = stage + S * c
+            m_f = t - vs
+            on_f = (m_f >= 0) & (m_f < M)
+            m_fc = jnp.clip(m_f, 0, M - 1)
+            ids_f = jax.lax.dynamic_index_in_dim(ids_mb, m_fc, 0,
+                                                 keepdims=False)
+            lab_f = jax.lax.dynamic_index_in_dim(labels_mb, m_fc, 0,
+                                                 keepdims=False)
+            first = is_first_dev & (c == 0)
+            last = is_last_dev & (c == v - 1)
+            y_c, nll_c = mb_fn_v(other_params, chunk_blk[c], y_msg[c],
+                                 ids_f, lab_f, first, last)
+            x_save = jnp.where(
+                on_f,
+                x_save.at[c].set(jax.lax.dynamic_update_index_in_dim(
+                    x_save[c], y_msg[c], m_fc % BUF, 0)),
+                x_save)
+            nll_acc = nll_acc + jnp.where(on_f, nll_c.astype(jnp.float32),
+                                          0.0)
+            new_y.append(y_c)
+
+        # device S-1's output on chunk c feeds device 0's chunk c+1: shift
+        # the send stream down by one chunk there so every stream rides
+        # the same +1 ring
+        sends = [jnp.where(is_last_dev,
+                           new_y[c - 1] if c > 0 else jnp.zeros_like(x0),
+                           new_y[c]) for c in range(v)]
+        y_msg = jnp.stack(
+            [jax.lax.ppermute(s, axis_name, perm_fwd) for s in sends])
+
+        new_dx = []
+        for c in range(v):
+            vs = stage + S * c
+            m_b = t - (2 * (Sv - 1) - vs)
+            on_b = (m_b >= 0) & (m_b < M)
+            m_bc = jnp.clip(m_b, 0, M - 1)
+            ids_b = jax.lax.dynamic_index_in_dim(ids_mb, m_bc, 0,
+                                                 keepdims=False)
+            lab_b = jax.lax.dynamic_index_in_dim(labels_mb, m_bc, 0,
+                                                 keepdims=False)
+            x_b = jax.lax.dynamic_index_in_dim(x_save[c], m_bc % BUF, 0,
+                                               keepdims=False)
+            first = is_first_dev & (c == 0)
+            last = is_last_dev & (c == v - 1)
+            _, pull = jax.vjp(
+                lambda o, b, x: mb_fn_v(o, b, x, ids_b, lab_b, first,
+                                        last),
+                other_params, chunk_blk[c], x_b)
+            # cotangent of this chunk's output: the final virtual stage's
+            # head consumed its own activation (dy = 0); device S-1's
+            # other chunks read the NEXT chunk stream from device 0
+            dy_c = jnp.where(is_last_dev,
+                             dx_msg[c + 1] if c < v - 1
+                             else jnp.zeros_like(x0),
+                             dx_msg[c])
+            go, gb_c, dx = pull((dy_c, jnp.ones((), jnp.float32)))
+            d_other = masked_add(d_other, go, on_b)
+            d_blk = jax.tree.map(
+                lambda a, gg, c=c, on=on_b: a.at[c].add(
+                    jnp.where(on, gg.astype(jnp.float32), 0.0)),
+                d_blk, gb_c)
+            new_dx.append(dx)
+
+        dx_msg = jnp.stack(
+            [jax.lax.ppermute(d, axis_name, perm_bwd) for d in new_dx])
+
+        return (x_save, y_msg, dx_msg, d_other, d_blk, nll_acc), None
+
+    (x_save, y_msg, dx_msg, d_other, d_blk, nll_acc), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(T))
+    return nll_acc, d_other, d_blk

@@ -1,0 +1,78 @@
+"""TensorArray (reference phi/core/tensor_array.h + python
+paddle.tensor.array_* — the LoDTensorArray used by legacy control flow).
+
+TPU-first: a Python list of Tensors with integer indices — exactly how
+the reference's dygraph mode implements it.  Inside traced (``jit``/lax)
+control flow use :meth:`TensorArray.stack` + ``dynamic_update_slice`` on
+the stacked array instead: traced indices cannot address a Python list,
+and the stacked [n, ...] form is the static-shape representation XLA
+needs.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+
+__all__ = ["TensorArray", "create_array", "array_write", "array_read",
+           "array_length"]
+
+
+class TensorArray:
+    def __init__(self, dtype="float32"):
+        self.dtype = dtype
+        self._items: List[Tensor] = []
+
+    def append(self, x) -> "TensorArray":
+        self._items.append(x if isinstance(x, Tensor) else Tensor(x))
+        return self
+
+    def write(self, i: int, x) -> "TensorArray":
+        i = int(i)
+        if i == len(self._items):
+            self.append(x)
+        else:
+            self._items[i] = x if isinstance(x, Tensor) else Tensor(x)
+        return self
+
+    def read(self, i: int) -> Tensor:
+        return self._items[int(i)]
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+    def stack(self, axis: int = 0) -> Tensor:
+        return Tensor(jnp.stack([t._value for t in self._items], axis=axis))
+
+    def pop(self, i: int = -1) -> Tensor:
+        return self._items.pop(i)
+
+
+def create_array(dtype="float32", initialized_list=None) -> TensorArray:
+    arr = TensorArray(dtype)
+    for x in initialized_list or ():
+        arr.append(x)
+    return arr
+
+
+def array_write(x, i, array: Optional[TensorArray] = None) -> TensorArray:
+    if array is None:
+        array = TensorArray()
+    idx = int(getattr(i, "_value", i))
+    array.write(idx, x)
+    return array
+
+
+def array_read(array: TensorArray, i) -> Tensor:
+    return array.read(int(getattr(i, "_value", i)))
+
+
+def array_length(array: TensorArray):
+    return Tensor(jnp.asarray(len(array), jnp.int64))

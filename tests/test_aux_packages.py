@@ -255,3 +255,156 @@ class TestTextDatasets:
         from paddle_tpu.text import Imdb
         with pytest.raises(RuntimeError, match="data_file"):
             Imdb()
+
+
+class TestSparseOpTail:
+    """Sparse op tail vs reference sparse_ops.yaml (51 ops)."""
+
+    def _coo(self, dense):
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+        from paddle_tpu.sparse import SparseCooTensor
+        return SparseCooTensor(jsparse.BCOO.fromdense(jnp.asarray(dense)))
+
+    def test_unary_tail_and_scale(self):
+        import numpy as np
+        import paddle_tpu.sparse as sp
+        d = np.array([[0.0, 0.5], [-0.25, 0.0]], np.float32)
+        x = self._coo(d)
+        np.testing.assert_allclose(
+            np.asarray(sp.leaky_relu(x, 0.1).to_dense()._value),
+            np.where(d >= 0, d, d * 0.1), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sp.scale(x, 3.0).to_dense()._value), d * 3,
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sp.relu6(self._coo(d * 20)).to_dense()._value),
+            np.clip(d * 20, 0, 6), rtol=1e-6)
+
+    def test_transpose_reshape_slice(self):
+        import numpy as np
+        import paddle_tpu.sparse as sp
+        rng = np.random.default_rng(0)
+        d = np.where(rng.uniform(size=(3, 4)) > 0.5,
+                     rng.normal(size=(3, 4)), 0.0).astype(np.float32)
+        x = self._coo(d)
+        np.testing.assert_allclose(
+            np.asarray(sp.transpose(x, [1, 0]).to_dense()._value), d.T)
+        np.testing.assert_allclose(
+            np.asarray(sp.reshape(x, (4, 3)).to_dense()._value),
+            d.reshape(4, 3))
+        np.testing.assert_allclose(
+            np.asarray(sp.slice(x, [0, 1], [1, 1], [3, 3])
+                       .to_dense()._value), d[1:3, 1:3])
+
+    def test_mask_as_and_addmm(self):
+        import numpy as np
+        import paddle_tpu as pt
+        import paddle_tpu.sparse as sp
+        rng = np.random.default_rng(1)
+        dense = rng.normal(size=(3, 3)).astype(np.float32)
+        pattern = np.array([[1.0, 0, 0], [0, 1.0, 0], [0, 0, 1.0]],
+                           np.float32)
+        m = sp.mask_as(pt.Tensor(dense), self._coo(pattern))
+        got = np.asarray(m.to_dense()._value)
+        np.testing.assert_allclose(got, dense * (pattern != 0))
+        a = rng.normal(size=(3, 2)).astype(np.float32)
+        inp = rng.normal(size=(3, 2)).astype(np.float32)
+        out = sp.addmm(pt.Tensor(inp), self._coo(dense), pt.Tensor(a),
+                       beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   0.5 * inp + 2.0 * dense @ a, rtol=1e-5)
+
+    def test_sparse_conv3d_matches_dense(self):
+        import jax, numpy as np
+        import paddle_tpu.sparse as sp
+        rng = np.random.default_rng(2)
+        d = np.where(rng.uniform(size=(1, 4, 4, 4, 2)) > 0.7,
+                     rng.normal(size=(1, 4, 4, 4, 2)), 0.0).astype(
+            np.float32)
+        w = rng.normal(size=(2, 2, 2, 2, 3)).astype(np.float32)
+        out = sp.conv3d(self._coo(d), w)
+        ref = jax.lax.conv_general_dilated(
+            d, w, (1, 1, 1), [(0, 0)] * 3,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        np.testing.assert_allclose(np.asarray(out.to_dense()._value),
+                                   np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    def test_sparse_batch_norm_and_maxpool(self):
+        import numpy as np
+        import paddle_tpu.sparse as sp
+        rng = np.random.default_rng(3)
+        d = np.where(rng.uniform(size=(1, 4, 4, 4, 3)) > 0.5,
+                     rng.normal(size=(1, 4, 4, 4, 3)), 0.0).astype(
+            np.float32)
+        x = self._coo(d)
+        y, rm, rv = sp.batch_norm_(x, np.zeros(3, np.float32),
+                                   np.ones(3, np.float32))
+        vals = np.asarray(y._bcoo.data)
+        np.testing.assert_allclose(vals.mean(0), 0.0, atol=1e-5)
+        mp = sp.max_pool3d(x, 2, 2)
+        assert mp.to_dense()._value.shape == (1, 2, 2, 2, 3)
+
+    def test_fused_attention_sparse_mask(self):
+        import numpy as np
+        import paddle_tpu as pt
+        import paddle_tpu.sparse as sp
+        rng = np.random.default_rng(4)
+        B, H, T, D = 1, 1, 4, 8
+        q = rng.normal(size=(B, H, T, D)).astype(np.float32)
+        mask = np.tril(np.ones((T, T), np.float32))
+        out = sp.fused_attention(pt.Tensor(q), pt.Tensor(q), pt.Tensor(q),
+                                 self._coo(mask))
+        # equals dense causal attention
+        logits = (q[0, 0] @ q[0, 0].T) / np.sqrt(D)
+        logits = np.where(mask != 0, logits, np.finfo(np.float32).min)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(out._value)[0, 0], p @ q[0, 0],
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_values_indices_full_like(self):
+        import numpy as np
+        import paddle_tpu.sparse as sp
+        d = np.array([[1.0, 0.0], [0.0, 2.0]], np.float32)
+        x = self._coo(d)
+        assert np.asarray(sp.values(x)._value).shape == (2,)
+        assert np.asarray(sp.indices(x)._value).shape == (2, 2)
+        fl = sp.full_like(x, 5.0)
+        np.testing.assert_allclose(np.asarray(fl._bcoo.data), 5.0)
+
+
+class TestTensorArrayAndMonitor:
+    def test_tensor_array_api(self):
+        import numpy as np
+        import paddle_tpu as pt
+        arr = pt.create_array()
+        pt.array_write(np.ones(3, np.float32), 0, arr)
+        pt.array_write(np.full(3, 2.0, np.float32), 1, arr)
+        assert int(np.asarray(pt.array_length(arr)._value)) == 2
+        np.testing.assert_allclose(
+            np.asarray(pt.array_read(arr, 1)._value), 2.0)
+        st = arr.stack()
+        assert np.asarray(st._value).shape == (2, 3)
+        arr.write(0, np.zeros(3, np.float32))   # overwrite
+        np.testing.assert_allclose(np.asarray(arr.read(0)._value), 0.0)
+
+    def test_collective_monitor_records(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu import parallel as dist
+        from paddle_tpu.parallel.collective import (CollectiveMonitor,
+                                                    all_reduce)
+        from paddle_tpu.parallel.topology import (HybridTopology,
+                                                  set_topology)
+        dist.init_topology(dp=2)
+        try:
+            with CollectiveMonitor(warn_after=1e9) as mon:
+                out = all_reduce(pt.Tensor(np.ones(4, np.float32)))
+            assert len(mon.events) == 1
+            name, axis, sec = mon.events[0]
+            assert sec >= 0
+            assert name.startswith("all_reduce")
+            assert any(k.startswith("all_reduce") for k in mon.summary())
+        finally:
+            set_topology(HybridTopology())

@@ -24,7 +24,7 @@ def reset_topology():
 def _losses(dp=1, mp=1, pp=1, sep=1, sharding=1, steps=3,
             num_microbatches=None, batch=4, seq=32, schedule="1f1b",
             layers=2, sequence_parallel=False, sharding_stage=2,
-            return_state=False):
+            num_model_chunks=1, return_state=False):
     topo = dist.init_topology(dp=dp, mp=mp, pp=pp, sep=sep,
                               sharding=sharding)
     cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=layers,
@@ -33,7 +33,7 @@ def _losses(dp=1, mp=1, pp=1, sep=1, sharding=1, steps=3,
         num_microbatches = 2 if pp > 1 else 1
     step_fn, init_fn = build_gpt_train_step(
         cfg, topo, num_microbatches=num_microbatches, schedule=schedule,
-        sharding_stage=sharding_stage,
+        sharding_stage=sharding_stage, num_model_chunks=num_model_chunks,
         sequence_parallel=sequence_parallel)
     state = init_fn(0)
     rng = np.random.default_rng(0)
@@ -279,3 +279,23 @@ def test_stage3_state_roundtrips_through_step():
     # flat leaves stay flat (no silent re-densification)
     wte = st["params"]["wte"]
     assert wte.ndim == 3, wte.shape
+
+
+# ---------------------------------------------------------------------------
+# Interleaved / VPP schedule (reference pipeline_parallel.py:1138)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("axes,layers", [(dict(pp=2), 4),
+                                         (dict(pp=2, mp=2), 4),
+                                         (dict(pp=4), 8)])
+def test_interleave_matches_single_device(axes, layers):
+    ref = _losses(layers=layers)
+    got = _losses(**axes, layers=layers, schedule="interleave",
+                  num_microbatches=4, num_model_chunks=2)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_interleave_three_chunks():
+    ref = _losses(layers=6)
+    got = _losses(pp=2, layers=6, schedule="interleave",
+                  num_microbatches=4, num_model_chunks=3)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)

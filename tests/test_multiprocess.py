@@ -16,13 +16,15 @@ import pytest
 
 pytestmark = pytest.mark.slow
 
-WORKER = textwrap.dedent("""
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_TMPL = textwrap.dedent("""
     import os
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     import sys
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(0, {repo_root!r})
     import jax
     import numpy as np
     import paddle_tpu as pt
@@ -55,6 +57,9 @@ WORKER = textwrap.dedent("""
 """)
 
 
+WORKER = WORKER_TMPL.replace("{repo_root!r}", repr(_REPO_ROOT))
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -76,7 +81,7 @@ def test_two_process_rendezvous_and_psum(tmp_path):
         procs.append(subprocess.Popen(
             [sys.executable, str(script)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            cwd="/root/repo"))
+            cwd=_REPO_ROOT))
     outs = []
     for rank, p in enumerate(procs):
         try:

@@ -1,0 +1,93 @@
+"""Paged KV cache (reference block_multi_head_attention /
+test_block_multihead_attention.py): paged decode must equal dense-cache
+decode; the allocator must share and reclaim pages."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.paged_kv import (BlockAllocator, PagedKVCache,
+                                     paged_append, paged_decode_attention)
+from paddle_tpu.ops.pallas.decode_attention import decode_attention_ref
+
+rng = np.random.default_rng(0)
+
+
+class TestAllocator:
+    def test_allocate_release_reuse(self):
+        a = BlockAllocator(4)
+        b0 = a.allocate(0, 2)
+        b1 = a.allocate(1, 2)
+        assert len(set(b0) | set(b1)) == 4 and a.free_blocks == 0
+        with pytest.raises(RuntimeError):
+            a.allocate(2, 1)
+        a.release(0)
+        assert a.free_blocks == 2
+        b2 = a.allocate(2, 2)
+        assert set(b2) == set(b0)    # pages recycled
+
+
+class TestPagedAttention:
+    def test_matches_dense_decode(self):
+        B, Hq, Hkv, D, BS, NB = 2, 4, 2, 16, 4, 8
+        T = 10                         # tokens already cached per seq
+        q = rng.normal(size=(B, Hq, D)).astype(np.float32)
+        dense_k = rng.normal(size=(B, 16, Hkv, D)).astype(np.float32)
+        dense_v = rng.normal(size=(B, 16, Hkv, D)).astype(np.float32)
+        lengths = np.array([T, 7], np.int32)
+
+        # build the paged pool holding the same tokens
+        pool_k = jnp.zeros((NB, BS, Hkv, D), jnp.float32)
+        pool_v = jnp.zeros((NB, BS, Hkv, D), jnp.float32)
+        table = np.full((B, 4), -1, np.int32)
+        alloc = BlockAllocator(NB)
+        for b in range(B):
+            n = -(-int(lengths[b]) // BS)
+            table[b, :n] = alloc.allocate(b, n)
+            for t in range(int(lengths[b])):
+                phys, off = table[b, t // BS], t % BS
+                pool_k = pool_k.at[phys, off].set(dense_k[b, t])
+                pool_v = pool_v.at[phys, off].set(dense_v[b, t])
+
+        got = paged_decode_attention(q, pool_k, pool_v, table, lengths)
+        ref = decode_attention_ref(jnp.asarray(q), jnp.asarray(dense_k),
+                                   jnp.asarray(dense_v),
+                                   jnp.asarray(lengths))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_append_then_attend(self):
+        B, Hq, Hkv, D, BS, NB = 1, 2, 2, 8, 2, 4
+        pool_k = jnp.zeros((NB, BS, Hkv, D), jnp.float32)
+        pool_v = jnp.zeros((NB, BS, Hkv, D), jnp.float32)
+        table = np.array([[0, 1, -1, -1]], np.int32)
+        toks_k = rng.normal(size=(3, Hkv, D)).astype(np.float32)
+        toks_v = rng.normal(size=(3, Hkv, D)).astype(np.float32)
+        for t in range(3):            # crosses a page boundary at t=2
+            pool_k, pool_v = paged_append(
+                pool_k, pool_v, toks_k[None, t], toks_v[None, t], table,
+                np.array([t], np.int32), BS)
+        # page 0 holds tokens 0..1, page 1 holds token 2
+        np.testing.assert_allclose(np.asarray(pool_k[0, 1]), toks_k[1])
+        np.testing.assert_allclose(np.asarray(pool_k[1, 0]), toks_k[2])
+        q = rng.normal(size=(B, Hq, D)).astype(np.float32)
+        got = paged_decode_attention(q, pool_k, pool_v, table,
+                                     np.array([3], np.int32))
+        ref = decode_attention_ref(
+            jnp.asarray(q), jnp.asarray(toks_k)[None],
+            jnp.asarray(toks_v)[None], jnp.asarray([3]))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_cache_manager_flow(self):
+        c = PagedKVCache(num_layers=1, num_blocks=8, block_size=4,
+                        num_kv_heads=2, head_dim=8, max_batch=2)
+        c.ensure_capacity(0, 10)       # 3 pages
+        assert (c.block_table[0] >= 0).sum() == 3
+        c.ensure_capacity(0, 11)       # still 3
+        assert (c.block_table[0] >= 0).sum() == 3
+        c.ensure_capacity(1, 20)       # 5 pages
+        assert c.alloc.free_blocks == 0
+        c.free(0)
+        assert c.alloc.free_blocks == 3
+        assert (c.block_table[0] == -1).all()
