@@ -169,3 +169,71 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None,
 
     return run_op("llm_int8_linear", impl, (x, weight, weight_scale, bias),
                   {})
+
+
+# ---------------------------------------------------------------------------
+# fp8 gemm (reference paddle/phi/kernels/fusion/fp8_gemm/ +
+# incubate fp8_fp8_half_gemm_fused): e4m3 storage with per-tensor scales,
+# MXU matmul in fp8 with fp32 accumulation.
+# ---------------------------------------------------------------------------
+_FP8_E4M3_MAX = 448.0
+
+
+def quantize_to_fp8(x, dtype="float8_e4m3fn"):
+    """Per-tensor absmax scaling into fp8.  Returns (x_fp8, scale) with
+    ``x ≈ x_fp8.astype(f32) * scale``."""
+    from ...core.dispatch import run_op
+
+    def impl(xv):
+        absmax = jnp.max(jnp.abs(xv.astype(jnp.float32)))
+        scale = jnp.maximum(absmax, 1e-12) / _FP8_E4M3_MAX
+        q = (xv.astype(jnp.float32) / scale).astype(jnp.dtype(dtype))
+        return q, scale
+
+    return run_op("quantize_to_fp8", impl, (x,), {}, differentiable=False)
+
+
+def fp8_gemm(x, y, x_scale=None, y_scale=None, bias=None,
+             transpose_x=False, transpose_y=False, activation=None,
+             output_dtype="float32"):
+    """out = act((x_fp8 @ y_fp8) * x_scale * y_scale + bias) (reference
+    fp8_fp8_half_gemm_fused).  Inputs may be pre-quantized fp8 (+ scales)
+    or float tensors (quantized here).  The dot runs in fp8 with fp32
+    accumulation — the MXU's native fp8 path on v5p+; elsewhere XLA
+    emulates, numerics identical."""
+    from ...core.dispatch import run_op
+
+    def impl(xv, yv, xs, ys, b):
+        def prep(v, s):
+            if v.dtype in (jnp.float8_e4m3fn, jnp.float8_e5m2):
+                return v, (jnp.asarray(1.0, jnp.float32) if s is None
+                           else s.astype(jnp.float32))
+            absmax = jnp.max(jnp.abs(v.astype(jnp.float32)))
+            sc = jnp.maximum(absmax, 1e-12) / _FP8_E4M3_MAX
+            return ((v.astype(jnp.float32) / sc).astype(jnp.float8_e4m3fn),
+                    sc)
+
+        xq, xsc = prep(xv, xs)
+        yq, ysc = prep(yv, ys)
+        if transpose_x:
+            xq = jnp.swapaxes(xq, -1, -2)
+        if transpose_y:
+            yq = jnp.swapaxes(yq, -1, -2)
+        out = jax.lax.dot_general(
+            xq, yq, (((xq.ndim - 1,), (yq.ndim - 2,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out = out * xsc * ysc
+        if b is not None:
+            out = out + b.astype(jnp.float32)
+        if activation in ("gelu", "relu", "silu", "sigmoid", "tanh"):
+            out = getattr(jax.nn, activation)(out) \
+                if activation != "tanh" else jnp.tanh(out)
+        elif activation not in (None, "", "identity"):
+            raise ValueError(f"fp8_gemm: unknown activation {activation!r}")
+        return out.astype(jnp.dtype(output_dtype))
+
+    return run_op("fp8_gemm", impl, (x, y, x_scale, y_scale, bias), {},
+                  differentiable=False)
+
+
+__all__ += ["quantize_to_fp8", "fp8_gemm"]

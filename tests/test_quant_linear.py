@@ -118,3 +118,46 @@ def test_llama_weight_only_decode():
                          use_pallas=False, quant="weight_only_int8")
     assert out.shape == (2, 12)
     assert np.isfinite(np.asarray(out)).all()
+
+class TestFp8Gemm:
+    """fp8 gemm (reference fusion/fp8_gemm): e4m3 storage + fp32 accum."""
+
+    def test_quantize_roundtrip(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.nn.quant import quantize_to_fp8
+        x = np.random.default_rng(0).normal(size=(16, 16)).astype(np.float32)
+        q, scale = quantize_to_fp8(pt.Tensor(x))
+        import jax.numpy as jnp
+        back = np.asarray(q._value).astype(np.float32) * float(
+            np.asarray(scale._value))
+        # e4m3 has ~2 decimal digits; absmax scaling bounds rel error
+        assert np.abs(back - x).max() <= np.abs(x).max() * 0.08
+
+    def test_fp8_gemm_close_to_fp32(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.nn.quant import fp8_gemm
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(8, 32)).astype(np.float32)
+        w = rng.normal(size=(32, 16)).astype(np.float32)
+        b = rng.normal(size=(16,)).astype(np.float32)
+        out = np.asarray(fp8_gemm(pt.Tensor(x), pt.Tensor(w),
+                                  bias=pt.Tensor(b))._value)
+        ref = x @ w + b
+        err = np.abs(out - ref).max() / np.abs(ref).max()
+        assert err < 0.12, err
+
+    def test_fp8_gemm_prequantized_and_act(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.nn.quant import fp8_gemm, quantize_to_fp8
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(4, 8)).astype(np.float32)
+        w = rng.normal(size=(8, 8)).astype(np.float32)
+        xq, xs = quantize_to_fp8(pt.Tensor(x))
+        wq, ws = quantize_to_fp8(pt.Tensor(w))
+        out = np.asarray(fp8_gemm(xq, wq, x_scale=xs, y_scale=ws,
+                                  activation="relu")._value)
+        ref = np.maximum(x @ w, 0)
+        assert np.abs(out - ref).max() / max(np.abs(ref).max(), 1) < 0.15
