@@ -457,12 +457,15 @@ def row_stack(x):
 
 
 def crop(x, shape=None, offsets=None):
+    if shape is None:
+        shape = tuple(jnp.shape(x))   # reference: default = input shape
     shape = _ishape(shape)
     if offsets is None:
         offsets = [0] * len(shape)
     if hasattr(offsets, "_value"):
         offsets = [int(v) for v in np.asarray(offsets._value)]
-    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    # builtins_slice: the module's own `slice` op shadows the builtin here
+    slices = tuple(builtins_slice(o, o + s) for o, s in zip(offsets, shape))
     return x[slices]
 
 

@@ -47,11 +47,12 @@ def rnn(x, pre_state, weight_list, sequence_length=None, dropout_prob=0.0,
     when training (is_test=False)."""
     x = _v(x)
     D = 2 if is_bidirec else 1
-    hs = [_v(h) for h in (pre_state if isinstance(pre_state, (list, tuple))
-                          else [pre_state])]
+    hs = [_v(h).astype(x.dtype)
+          for h in (pre_state if isinstance(pre_state, (list, tuple))
+                    else [pre_state])]
     init_h = hs[0]
     init_c = hs[1] if mode == "LSTM" else None
-    ws = [_v(w) for w in weight_list]
+    ws = [_v(w).astype(x.dtype) for w in weight_list]
     seq_len = None if sequence_length is None \
         else _v(sequence_length).astype(jnp.int32)
 

@@ -248,7 +248,10 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
     ZeRO stage-2 Adam over the ``sharding`` axis
     (:func:`zero_adam_leaf_update`).
 
-    ``schedule`` (pp>1 only): ``"1f1b"`` (default) interleaves forward and
+    ``schedule`` (pp>1 only): ``"1f1b"`` (default), ``"gpipe"``,
+    ``"interleave"`` (virtual-pipeline chunks via ``num_model_chunks``),
+    or ``"zbh1"`` (zero-bubble: weight-grad deferred into the drain
+    bubble).  ``"1f1b"`` interleaves forward and
     recompute-backward per tick with O(pp) activation memory
     (:func:`~paddle_tpu.parallel.pipeline.spmd_pipeline_1f1b`, matching the
     reference's production 1F1B pipeline_parallel.py:547); ``"gpipe"`` is
@@ -266,9 +269,10 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
     """
     import jax.numpy as _jnp
     from jax.sharding import NamedSharding
-    from .pipeline import spmd_pipeline, spmd_pipeline_1f1b
+    from .pipeline import (spmd_pipeline, spmd_pipeline_1f1b,
+                           spmd_pipeline_zbh1)
 
-    if schedule not in ("1f1b", "gpipe", "interleave"):
+    if schedule not in ("1f1b", "gpipe", "interleave", "zbh1"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if schedule == "interleave" and sharding_stage == 3:
         raise NotImplementedError(
@@ -472,7 +476,7 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                             (PP_AXIS, DP_AXIS, SHARDING_AXIS, SEP_AXIS))                 / norm
             grads = {k: g / norm for k, g in d_other.items()}
             grads["blocks"] = {k: g[None] / norm for k, g in d_blk.items()}
-        elif S > 1 and schedule == "1f1b":
+        elif S > 1 and schedule in ("1f1b", "zbh1"):
             M = num_microbatches
             other = {k: v for k, v in params.items() if k != "blocks"}
             blk = {k: v[0] for k, v in params["blocks"].items()}
@@ -496,7 +500,9 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                 return embed_fn(dict(o, blocks=None), i)
 
             xa = jax.eval_shape(_embed_probe, other, ids_mb[0])
-            nll_sum, d_other, d_blk = spmd_pipeline_1f1b(
+            sched_fn = spmd_pipeline_1f1b if schedule == "1f1b" \
+                else spmd_pipeline_zbh1
+            nll_sum, d_other, d_blk = sched_fn(
                 mb_fn, other, blk, ids_mb, labels_mb,
                 xa.shape, xa.dtype, S)
             loss = fwd_psum(nll_sum,

@@ -33,7 +33,7 @@ from ..nn.layer.layers import Layer, functional_call
 from .topology import PP_AXIS, get_topology
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "spmd_pipeline",
-           "spmd_pipeline_1f1b", "pipeline_stack_specs"]
+           "spmd_pipeline_1f1b", "spmd_pipeline_interleaved", "spmd_pipeline_zbh1", "pipeline_stack_specs"]
 
 
 class LayerDesc:
@@ -407,4 +407,123 @@ def spmd_pipeline_interleaved(mb_fn_v, other_params, blk_params, ids_mb,
 
     (x_save, y_msg, dx_msg, d_other, d_blk, nll_acc), _ = jax.lax.scan(
         tick, carry0, jnp.arange(T))
+    return nll_acc, d_other, d_blk
+
+
+def spmd_pipeline_zbh1(mb_fn, other_params, blk_params, ids_mb, labels_mb,
+                       x_shape, x_dtype, num_stages: int,
+                       axis_name: str = PP_AXIS):
+    """ZBH1 zero-bubble-class schedule (reference
+    pipeline_scheduler_pass ZBH1, Qi et al. arXiv:2401.10241): the
+    backward splits into **B** (activation gradient — the only part on the
+    pipeline's critical path, since dx must ppermute upstream) and **W**
+    (weight gradient — no inter-stage dependence), and W is deferred S
+    ticks to run inside what would otherwise be the drain bubble.
+
+    Same recompute design as :func:`spmd_pipeline_1f1b` (tick scan never
+    differentiated).  The compute split is real under XLA: the B phase
+    pulls only the input cotangent, so dead-code elimination drops the
+    wgrad outer-product matmuls from that executable; W pulls only the
+    param cotangents.  Cost of the split in this remat design: the chunk
+    forward is recomputed in both phases (+1 fwd per microbatch vs 1F1B) —
+    the schedule buys bubble time with FLOPs, profitable when the bubble
+    fraction (S-1)/M is large.
+
+    Extra state vs 1F1B: the output-cotangent W-queue (``S+1`` slots —
+    a cotangent lives exactly S ticks between its B and W) on top of the
+    deeper ``3S``-slot input buffer (an input must survive from its F tick
+    to its W tick, up to 3S-2 ticks on stage 0).
+    """
+    M = ids_mb.shape[0]
+    S = num_stages
+    T = M + 2 * (S - 1) + S          # +S ticks to drain the deferred Ws
+    # a saved input must survive from its F tick (stage+m) to its W tick
+    # (2(S-1)-stage+m+S): up to 3S-2 ticks on stage 0
+    BUF = 3 * S
+    DBUF = S + 1          # dy lives exactly S ticks (B tick -> W tick)
+    stage = jax.lax.axis_index(axis_name)
+    is_last = stage == S - 1
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+    f32 = functools.partial(jax.tree.map,
+                            lambda p: jnp.zeros(p.shape, jnp.float32))
+    x0 = jnp.zeros(x_shape, x_dtype)
+    carry0 = (
+        jnp.zeros((BUF,) + x_shape, x_dtype),   # saved stage inputs (fwd)
+        jnp.zeros((DBUF,) + x_shape, x_dtype),  # W queue: dy per microbatch
+        x0, x0,                                 # fwd / bwd messages
+        f32(other_params), f32(blk_params),
+        jnp.zeros((), jnp.float32),
+    )
+
+    def masked_add(acc, g, on):
+        return jax.tree.map(
+            lambda a, gg: a + jnp.where(on, gg.astype(jnp.float32), 0.0),
+            acc, g)
+
+    def tick(carry, t):
+        x_save, dy_save, y_msg, dx_msg, d_other, d_blk, nll_acc = carry
+
+        # ---- F(stage, m) at t = stage + m --------------------------------
+        m_f = t - stage
+        on_f = (m_f >= 0) & (m_f < M)
+        m_fc = jnp.clip(m_f, 0, M - 1)
+        ids_f = jax.lax.dynamic_index_in_dim(ids_mb, m_fc, 0, keepdims=False)
+        lab_f = jax.lax.dynamic_index_in_dim(labels_mb, m_fc, 0,
+                                             keepdims=False)
+        y_f, nll_f = mb_fn(other_params, blk_params, y_msg, ids_f, lab_f)
+        x_save = jnp.where(
+            on_f,
+            jax.lax.dynamic_update_index_in_dim(x_save, y_msg, m_fc % BUF,
+                                                0),
+            x_save)
+        nll_acc = nll_acc + jnp.where(on_f, nll_f.astype(jnp.float32), 0.0)
+        y_msg = jax.lax.ppermute(y_f, axis_name, perm_fwd)
+
+        # ---- B(stage, m) at t = 2(S-1) - stage + m: dgrad only -----------
+        m_b = t - (2 * (S - 1) - stage)
+        on_b = (m_b >= 0) & (m_b < M)
+        m_bc = jnp.clip(m_b, 0, M - 1)
+        ids_b = jax.lax.dynamic_index_in_dim(ids_mb, m_bc, 0, keepdims=False)
+        lab_b = jax.lax.dynamic_index_in_dim(labels_mb, m_bc, 0,
+                                             keepdims=False)
+        x_b = jax.lax.dynamic_index_in_dim(x_save, m_bc % BUF, 0,
+                                           keepdims=False)
+        dy = jnp.where(is_last, jnp.zeros_like(dx_msg), dx_msg)
+        # params enter as CONSTANTS: the pullback computes dx only, and
+        # XLA's DCE drops the wgrad outer products from this phase
+        _, pull_x = jax.vjp(
+            lambda x: mb_fn(other_params, blk_params, x, ids_b, lab_b), x_b)
+        (dx,) = pull_x((dy, jnp.ones((), nll_f.dtype)))
+        dy_save = jnp.where(
+            on_b,
+            jax.lax.dynamic_update_index_in_dim(dy_save, dy, m_bc % DBUF,
+                                                0),
+            dy_save)
+        dx_msg = jax.lax.ppermute(dx, axis_name, perm_bwd)
+
+        # ---- W(stage, m) at t = B-tick + S: wgrad in the bubble ----------
+        m_w = t - (2 * (S - 1) - stage) - S
+        on_w = (m_w >= 0) & (m_w < M)
+        m_wc = jnp.clip(m_w, 0, M - 1)
+        ids_w = jax.lax.dynamic_index_in_dim(ids_mb, m_wc, 0, keepdims=False)
+        lab_w = jax.lax.dynamic_index_in_dim(labels_mb, m_wc, 0,
+                                             keepdims=False)
+        x_w = jax.lax.dynamic_index_in_dim(x_save, m_wc % BUF, 0,
+                                           keepdims=False)
+        dy_w = jax.lax.dynamic_index_in_dim(dy_save, m_wc % DBUF, 0,
+                                            keepdims=False)
+        _, pull_p = jax.vjp(
+            lambda o, b: mb_fn(o, b, x_w, ids_w, lab_w),
+            other_params, blk_params)
+        go, gb = pull_p((dy_w, jnp.ones((), nll_f.dtype)))
+        d_other = masked_add(d_other, go, on_w)
+        d_blk = masked_add(d_blk, gb, on_w)
+
+        return (x_save, dy_save, y_msg, dx_msg, d_other, d_blk,
+                nll_acc), None
+
+    (x_save, dy_save, y_msg, dx_msg, d_other, d_blk, nll_acc), _ = \
+        jax.lax.scan(tick, carry0, jnp.arange(T))
     return nll_acc, d_other, d_blk

@@ -299,3 +299,15 @@ def test_interleave_three_chunks():
     got = _losses(pp=2, layers=6, schedule="interleave",
                   num_microbatches=4, num_model_chunks=3)
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# ZBH1 zero-bubble schedule (reference pipeline_scheduler_pass ZBH1)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("axes,layers", [
+    (dict(pp=2), 2),
+    (dict(pp=4, batch=8, num_microbatches=4), 4)])
+def test_zbh1_matches_single_device(axes, layers):
+    base = _base8() if axes.get("batch") == 8 else _base()
+    got = _losses(schedule="zbh1", layers=layers, **axes)
+    np.testing.assert_allclose(got, base, rtol=2e-4, atol=1e-5)
