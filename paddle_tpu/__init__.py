@@ -102,6 +102,7 @@ def in_static_mode():
 
 from . import models  # noqa: F401
 from . import static  # noqa: F401
+from .core.string_tensor import StringTensor, to_string_tensor  # noqa: F401
 from .tensor_array import (  # noqa: F401
     TensorArray, create_array, array_write, array_read, array_length)
 from . import utils  # noqa: F401

@@ -1,0 +1,68 @@
+"""StringTensor (reference paddle/phi/core/string_tensor.h — the dtype
+pstring tensor that backs the faster-tokenizer ops).
+
+Strings never reach the device: XLA has no string dtype, and the reference
+runs its string kernels on host too.  This is a shaped numpy object-array
+wrapper with the Tensor-like surface the tokenizer path needs; downstream
+numeric outputs (ids/offsets) become ordinary device Tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Union
+
+import numpy as np
+
+__all__ = ["StringTensor", "to_string_tensor"]
+
+
+class StringTensor:
+    def __init__(self, data):
+        arr = np.asarray(data, dtype=object)
+        self._data = arr
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def dtype(self) -> str:
+        return "pstring"
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, np.ndarray):
+            return StringTensor(out)
+        return out
+
+    def __len__(self):
+        return self._data.shape[0] if self._data.ndim else 1
+
+    def __iter__(self):
+        return iter(self._data.tolist())
+
+    def __eq__(self, other):
+        o = other._data if isinstance(other, StringTensor) else other
+        return np.asarray(self._data == o)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self.tolist()!r})"
+
+    def lower(self) -> "StringTensor":
+        return StringTensor(np.vectorize(str.lower, otypes=[object])(
+            self._data))
+
+    def encode(self, encoding="utf-8"):
+        return [s.encode(encoding) for s in self._data.reshape(-1)]
+
+
+def to_string_tensor(data: Union[str, Iterable]) -> StringTensor:
+    if isinstance(data, str):
+        data = [data]
+    return StringTensor(list(data))

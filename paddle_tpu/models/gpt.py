@@ -323,6 +323,7 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                          schedule: str = "1f1b",
                          num_model_chunks: int = 1,
                          sharding_stage: int = 2,
+                         offload_optimizer: bool = False,
                          sequence_parallel: bool = False):
     """Compile a full hybrid-parallel GPT training step: dp×mp×pp×sharding×sep.
 
@@ -470,6 +471,7 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
         num_microbatches=num_microbatches, learning_rate=learning_rate,
         remat=remat, schedule=schedule, sharding_stage=sharding_stage,
         num_model_chunks=num_model_chunks,
+        offload_optimizer=offload_optimizer,
         mp_reduce_block_leaves=frozenset(
             {"ln1_w", "ln1_b", "ln2_w", "ln2_b", "proj_b", "fc2_b"}
             if sp else ()))

@@ -35,6 +35,9 @@ from .loss import (  # noqa: F401
     huber_loss, kl_div, l1_loss, label_smooth, log_loss, margin_ranking_loss,
     mse_loss, nll_loss, sigmoid_focal_loss, smooth_l1_loss,
     softmax_with_cross_entropy, square_error_cost, triplet_margin_loss,
+    soft_margin_loss, multi_margin_loss, multi_label_soft_margin_loss,
+    gaussian_nll_loss, poisson_nll_loss, triplet_margin_with_distance_loss,
+    rnnt_loss,
 )
 from .attention import (  # noqa: F401
     flash_attention, flash_attn_unpadded, scaled_dot_product_attention,

@@ -4,6 +4,8 @@ variant (ParallelCrossEntropy) lives in parallel/mp_layers."""
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -318,3 +320,100 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     return run_op("ctc_loss", impl,
                   (log_probs, labels, input_lengths, label_lengths), {})
+
+
+def soft_margin_loss(input, label, reduction="mean"):
+    """log(1 + exp(-label * input)) (reference soft_margin_loss)."""
+    def impl(x, y):
+        # -log_sigmoid(y*x) == log(1+exp(-y*x)) without the overflow
+        return _reduce(-jax.nn.log_sigmoid(y * x), reduction)
+    return run_op("soft_margin_loss", impl, (input, label), {})
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    """Multi-class margin loss (reference multi_margin_loss):
+    mean_j max(0, margin - x[y] + x[j])^p, j != y."""
+    def impl(x, y, w):
+        C = x.shape[1]
+        xy = jnp.take_along_axis(x, y[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - xy + x) ** p
+        if w is not None:
+            m = m * jnp.take(w, y)[:, None]
+        mask = jax.nn.one_hot(y, C, dtype=m.dtype)
+        return _reduce(((m * (1 - mask)).sum(axis=1)) / C, reduction)
+    return run_op("multi_margin_loss", impl, (input, label, weight), {})
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    """Per-class BCE-with-logits averaged over classes (reference
+    multi_label_soft_margin_loss)."""
+    def impl(x, y, w):
+        l = y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x)
+        if w is not None:
+            l = l * w
+        return _reduce(-l.mean(axis=-1), reduction)
+    return run_op("multi_label_soft_margin_loss", impl,
+                 (input, label, weight), {})
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    """Gaussian negative log likelihood (reference gaussian_nll_loss)."""
+    def impl(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        out = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            out = out + 0.5 * math.log(2 * math.pi)
+        return _reduce(out, reduction)
+    return run_op("gaussian_nll_loss", impl, (input, label, variance), {})
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):
+    """Poisson negative log likelihood (reference poisson_nll_loss)."""
+    def impl(x, y):
+        if log_input:
+            out = jnp.exp(x) - y * x
+        else:
+            out = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            out = out + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(out, reduction)
+    return run_op("poisson_nll_loss", impl, (input, label), {})
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean"):
+    """Triplet loss with a custom distance callable (reference
+    triplet_margin_with_distance_loss)."""
+    from .common import pairwise_distance
+    dist = distance_function or (lambda a, b: pairwise_distance(a, b))
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    from ...ops import api as _api
+    if swap:
+        d_neg = _api.minimum(d_neg, dist(positive, negative))
+    diff = d_pos - d_neg + margin
+    out = _api.maximum(diff, _api.zeros_like(diff))
+    if reduction == "mean":
+        return _api.mean(out)
+    if reduction == "sum":
+        return _api.sum(out)
+    return out
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean"):
+    """RNN-T transducer loss (reference rnnt_loss -> warprnnt op)."""
+    from ...ops import api as _api
+    out = _api.warprnnt(input, label, input_lengths, label_lengths,
+                        blank=blank, fastemit_lambda=fastemit_lambda)
+    if reduction == "mean":
+        return _api.mean(out)
+    if reduction == "sum":
+        return _api.sum(out)
+    return out

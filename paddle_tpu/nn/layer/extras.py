@@ -1,0 +1,463 @@
+"""Layer-class tail (reference python/paddle/nn/__init__.py — the last 26
+classes to full name parity): pad layers, unpool/fractional/LP pools,
+remaining losses, Unflatten, FeatureAlphaDropout, AdaptiveLogSoftmaxWithLoss,
+BeamSearchDecoder.  All are thin Layer wrappers over existing kernels."""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+from ...core.tensor import Tensor
+from .. import functional as F
+from .layers import Layer
+
+__all__ = [
+    "Pad1D", "Pad2D", "Pad3D", "ZeroPad1D", "ZeroPad2D", "ZeroPad3D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "LPPool1D", "LPPool2D",
+    "FractionalMaxPool2D", "FractionalMaxPool3D", "Unflatten",
+    "FeatureAlphaDropout", "SoftMarginLoss", "MultiMarginLoss",
+    "MultiLabelSoftMarginLoss", "GaussianNLLLoss", "PoissonNLLLoss",
+    "TripletMarginWithDistanceLoss", "CTCLoss", "RNNTLoss", "HSigmoidLoss",
+    "AdaptiveLogSoftmaxWithLoss", "BeamSearchDecoder",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+# ------------------------------------------------------------------- pads
+class _PadNd(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format=None, n=2):
+        super().__init__()
+        self.padding = ([padding] * (2 * n) if isinstance(padding, int)
+                        else list(padding))
+        self.mode = mode
+        self.value = value
+        self.n = n
+        self.data_format = data_format
+
+    def forward(self, x):
+        from ...ops import api
+        if self.n == 3:
+            return api.pad3d(x, self.padding, self.mode, self.value,
+                             self.data_format or "NCDHW")
+        return api.pad(x, self.padding, mode=self.mode, value=self.value,
+                       data_format=self.data_format
+                       or ("NCL" if self.n == 1 else "NCHW"))
+
+
+class Pad1D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL", name=None):
+        super().__init__(padding, mode, value, data_format, n=1)
+
+
+class Pad2D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW", name=None):
+        super().__init__(padding, mode, value, data_format, n=2)
+
+
+class Pad3D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__(padding, mode, value, data_format, n=3)
+
+
+class ZeroPad1D(Pad1D):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class ZeroPad2D(Pad2D):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class ZeroPad3D(Pad3D):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+# ------------------------------------------------------------------ pools
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride if stride is not None else kernel_size
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        from ...ops import api
+        return api.unpool(x, indices, self.kernel_size, self.stride,
+                          self.padding, self.output_size)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride if stride is not None else kernel_size
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        from ...ops import api
+        xv, iv = _v(x), _v(indices)
+        x4 = jnp.expand_dims(jnp.asarray(xv), 2)      # [N, C, 1, L]
+        i4 = jnp.expand_dims(jnp.asarray(iv), 2)
+        osz = None if self.output_size is None else \
+            (1, self.output_size[-1])
+        out = api.unpool(Tensor(x4), Tensor(i4), (1, self.kernel_size),
+                         (1, self.stride), (0, self.padding), osz)
+        return Tensor(jnp.squeeze(_v(out), 2))
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride if stride is not None else kernel_size
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        from ...ops import api
+        return api.unpool3d(x, indices, self.kernel_size, self.stride,
+                            self.padding, self.output_size)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                     data_format)
+
+    def forward(self, x):
+        from ...ops import api
+        return api.lp_pool2d(x, *self.args)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.norm_type = norm_type
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+
+    def forward(self, x):
+        from ...ops import api
+        x4 = jnp.expand_dims(jnp.asarray(_v(x)), 2)
+        out = api.lp_pool2d(Tensor(x4), self.norm_type,
+                            (1, self.kernel_size),
+                            (1, self.stride or self.kernel_size),
+                            (0, self.padding), self.ceil_mode)
+        return Tensor(jnp.squeeze(_v(out), 2))
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        from ...ops import api
+        return api.fractional_max_pool2d(x, *self.args)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        from ...ops import api
+        return api.fractional_max_pool3d(x, *self.args)
+
+
+# ------------------------------------------------------------------- misc
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = tuple(shape)
+
+    def forward(self, x):
+        xv = jnp.asarray(_v(x))
+        ax = self.axis % xv.ndim
+        new = xv.shape[:ax] + self.shape + xv.shape[ax + 1:]
+        from ...ops import api
+        return api.reshape(x, new)
+
+
+class FeatureAlphaDropout(Layer):
+    """Alpha dropout that drops whole channels (reference
+    FeatureAlphaDropout; SELU-preserving statistics)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        from ...core.rng import next_rng_key
+        alpha = -1.7580993408473766
+
+        def impl(xv, key):
+            shape = (xv.shape[0], xv.shape[1]) + (1,) * (xv.ndim - 2)
+            keep = jax.random.bernoulli(key, 1.0 - self.p, shape)
+            a = (1.0 / math.sqrt((1 - self.p)
+                                 * (1 + self.p * alpha ** 2))) \
+                if self.p < 1 else 0.0
+            b = -a * alpha * self.p
+            return jnp.where(keep, xv, alpha) * a + b
+
+        return run_op("feature_alpha_dropout", impl,
+                      (x, next_rng_key()), {})
+
+
+# ------------------------------------------------------------------ losses
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin, self.weight = p, margin, weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, self.full,
+                                   self.epsilon, self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.a = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, *self.a)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.a = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(input, positive,
+                                                   negative, *self.a)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean", name=None):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.a = (blank, fastemit_lambda, reduction)
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           *self.a)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid over a complete binary tree (reference
+    nn.HSigmoidLoss; kernel in ops/impl/nn_ops.py:hsigmoid_loss)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter((num_classes - 1, feature_size))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (num_classes - 1,), is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        from ...ops import api
+        return api.hsigmoid_loss(input, label, self.weight, self.bias,
+                                 num_classes=self.num_classes,
+                                 path_table=path_table,
+                                 path_code=path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax (reference AdaptiveLogSoftmaxWithLoss, Grave et
+    al. arXiv:1609.04309): frequent head classes scored directly, tail
+    clusters through down-projected tails."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs) + [n_classes]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.cutoffs[0] + self.n_clusters
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.head_weight = self.create_parameter(
+            (in_features, self.head_size))
+        self.head_bias = (self.create_parameter((self.head_size,))
+                          if head_bias else None)
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features // (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w1 = self.create_parameter((in_features, hsz))
+            w2 = self.create_parameter((hsz, osz))
+            self.tail_weights.append((w1, w2))
+            setattr(self, f"tail_{i}_proj", w1)
+            setattr(self, f"tail_{i}_out", w2)
+
+    def _full_log_prob(self, x):
+        xv = jnp.asarray(_v(x))
+        head = xv @ jnp.asarray(_v(self.head_weight))
+        if self.head_bias is not None:
+            head = head + jnp.asarray(_v(self.head_bias))
+        head_lp = jax.nn.log_softmax(head, axis=-1)
+        parts = [head_lp[:, :self.cutoffs[0]]]
+        for i, (w1, w2) in enumerate(self.tail_weights):
+            tail = (xv @ jnp.asarray(_v(w1))) @ jnp.asarray(_v(w2))
+            tail_lp = jax.nn.log_softmax(tail, axis=-1)
+            parts.append(tail_lp
+                         + head_lp[:, self.cutoffs[0] + i][:, None])
+        return jnp.concatenate(parts, axis=1)
+
+    def forward(self, input, label):
+        # adaptive path: score the head once plus each tail cluster's
+        # [B, cluster] block — never materialize [B, n_classes]
+        xv = jnp.asarray(_v(input))
+        lab = jnp.asarray(_v(label)).reshape(-1)
+        head = xv @ jnp.asarray(_v(self.head_weight))
+        if self.head_bias is not None:
+            head = head + jnp.asarray(_v(self.head_bias))
+        head_lp = jax.nn.log_softmax(head, axis=-1)
+        in_head = lab < self.cutoffs[0]
+        out = jnp.take_along_axis(
+            head_lp, jnp.where(in_head, lab, 0)[:, None], axis=1)[:, 0]
+        out = jnp.where(in_head, out, 0.0)
+        for i, (w1, w2) in enumerate(self.tail_weights):
+            lo, hi = self.cutoffs[i], self.cutoffs[i + 1]
+            hit = (lab >= lo) & (lab < hi)
+            tail = (xv @ jnp.asarray(_v(w1))) @ jnp.asarray(_v(w2))
+            tail_lp = jax.nn.log_softmax(tail, axis=-1)
+            tgt = jnp.take_along_axis(
+                tail_lp, jnp.where(hit, lab - lo, 0)[:, None], axis=1)[:, 0]
+            cluster_lp = head_lp[:, self.cutoffs[0] + i]
+            out = out + jnp.where(hit, tgt + cluster_lp, 0.0)
+        return Tensor(out), Tensor(-out.mean())
+
+    def log_prob(self, input):
+        return Tensor(self._full_log_prob(input))
+
+    def predict(self, input):
+        return Tensor(jnp.argmax(self._full_log_prob(input), axis=-1))
+
+
+class BeamSearchDecoder:
+    """Beam-search decoding driver over an RNN cell (reference
+    nn.BeamSearchDecoder + dynamic_decode).  Host-side loop using the
+    beam_search op per step — decode is a serving path, not a compiled
+    training step."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def decode(self, init_state, max_steps=32):
+        from ...ops import api
+        W = self.beam_size
+        tok = np.full((W, 1), self.start_token, np.int64)
+        scores = np.zeros((W,), np.float32)
+        scores[1:] = -1e9                  # all beams start identical
+        state = jax.tree.map(
+            lambda s: jnp.repeat(jnp.asarray(_v(s)), W, axis=0), init_state)
+        seq = [tok.copy()]
+        for _ in range(max_steps):
+            inp = (self.embedding_fn(Tensor(jnp.asarray(tok[:, 0])))
+                   if self.embedding_fn else
+                   Tensor(jnp.asarray(tok[:, 0], jnp.float32)[:, None]))
+            out, state = self.cell(inp, state)
+            logits = self.output_fn(out) if self.output_fn else out
+            logp = jax.nn.log_softmax(jnp.asarray(_v(logits)), axis=-1)
+            K = min(W, logp.shape[-1])
+            topv, topi = jax.lax.top_k(logp, K)
+            sel, ssc, parent = api.beam_search(
+                tok, scores, np.asarray(topi), np.asarray(topv),
+                beam_size=W, end_id=self.end_token)
+            sel = np.asarray(_v(sel))
+            parent = np.asarray(_v(parent)).reshape(-1)
+            scores = np.asarray(_v(ssc)).reshape(-1)
+            state = jax.tree.map(lambda s: jnp.asarray(_v(s))[parent],
+                                 state)
+            seq = [s[parent] for s in seq] + [sel]
+            tok = sel
+            if (sel == self.end_token).all():
+                break
+        return np.concatenate(seq, axis=1), scores

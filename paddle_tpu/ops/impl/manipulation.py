@@ -464,8 +464,12 @@ def crop(x, shape=None, offsets=None):
         offsets = [0] * len(shape)
     if hasattr(offsets, "_value"):
         offsets = [int(v) for v in np.asarray(offsets._value)]
-    # builtins_slice: the module's own `slice` op shadows the builtin here
-    slices = tuple(builtins_slice(o, o + s) for o, s in zip(offsets, shape))
+    # builtins_slice: the module's own `slice` op shadows the builtin here;
+    # shape entries of -1 extend to the end of the dim (reference crop)
+    dims = jnp.shape(x)
+    slices = tuple(
+        builtins_slice(o, dims[i] if s == -1 else o + s)
+        for i, (o, s) in enumerate(zip(offsets, shape)))
     return x[slices]
 
 

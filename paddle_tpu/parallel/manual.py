@@ -223,6 +223,7 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                             schedule: str = "1f1b",
                             num_model_chunks: int = 1,
                             sharding_stage: int = 2,
+                            offload_optimizer: bool = False,
                             mp_reduce_block_leaves=frozenset()):
     """Generic fully-manual hybrid dp×mp×pp×sharding×sep train step.
 
@@ -569,7 +570,55 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
         return {"params": p2, "opt": {"m": m2, "v": v2, "t": t2}}, loss
 
     step_fn = jax.jit(step, donate_argnums=(0,))
+    if offload_optimizer:
+        return _offload_opt_state(step_fn, init_fn)
     return step_fn, init_fn
+
+
+def _offload_opt_state(step_fn, init_fn):
+    """Optimizer-state host offload (reference group_sharded offload=True /
+    sharding_offload: fp32 moments live in HOST RAM between steps and are
+    shipped to the device around each update).  The explicit
+    device_put/device_get pair outside jit is the backend-portable form of
+    the reference's pinned-memory optimizer; the per-step transfer is the
+    price of the HBM savings, exactly as in the reference."""
+    import numpy as _np
+
+    # shardings are constant across steps; captured here (not in the state
+    # pytree) so the user-visible state stays arrays-only
+    _sh_cell = {}
+
+    def init2(seed: int = 0):
+        state = init_fn(seed)
+        opt = state["opt"]
+        _sh_cell.update(jax.tree.map(
+            lambda a: a.sharding if hasattr(a, "sharding") else None,
+            {"m": opt["m"], "v": opt["v"]}))
+        host = {"m": jax.tree.map(lambda a: _np.asarray(a), opt["m"]),
+                "v": jax.tree.map(lambda a: _np.asarray(a), opt["v"])}
+        state["opt"] = {"m": host["m"], "v": host["v"], "t": opt["t"]}
+        return state
+
+    def step2(state, ids, labels):
+        sh = _sh_cell
+        dev_state = {
+            "params": state["params"],
+            "opt": {"m": jax.tree.map(jax.device_put, state["opt"]["m"],
+                                      sh["m"]),
+                    "v": jax.tree.map(jax.device_put, state["opt"]["v"],
+                                      sh["v"]),
+                    "t": state["opt"]["t"]},
+        }
+        new_state, loss = step_fn(dev_state, ids, labels)
+        new_state["opt"] = {
+            "m": jax.tree.map(lambda a: _np.asarray(a),
+                              new_state["opt"]["m"]),
+            "v": jax.tree.map(lambda a: _np.asarray(a),
+                              new_state["opt"]["v"]),
+            "t": new_state["opt"]["t"]}
+        return new_state, loss
+
+    return step2, init2
 
 
 def local_shape(shape: Tuple[int, ...], spec: P,

@@ -408,3 +408,16 @@ class TestTensorArrayAndMonitor:
             assert any(k.startswith("all_reduce") for k in mon.summary())
         finally:
             set_topology(HybridTopology())
+
+
+class TestStringTensor:
+    def test_surface(self):
+        import numpy as np
+        import paddle_tpu as pt
+        st = pt.to_string_tensor(["Hello", "World"])
+        assert st.shape == [2] and st.dtype == "pstring"
+        assert st[0] == "Hello"
+        assert st.lower().tolist() == ["hello", "world"]
+        assert list(st) == ["Hello", "World"]
+        eq = st == pt.to_string_tensor(["Hello", "x"])
+        np.testing.assert_array_equal(eq, [True, False])

@@ -1,0 +1,156 @@
+"""Layer-class tail (reference nn/__init__.py parity set: pads, unpools,
+LP/fractional pools, remaining losses, AdaptiveLogSoftmaxWithLoss,
+BeamSearchDecoder)."""
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def _np(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+rng = np.random.default_rng(0)
+
+
+class TestPadsPools:
+    def test_pad_layers(self):
+        x = np.ones((1, 1, 2, 2), np.float32)
+        out = _np(nn.ZeroPad2D(1)(pt.Tensor(x)))
+        assert out.shape == (1, 1, 4, 4) and out[0, 0, 0, 0] == 0
+        x3 = np.ones((1, 1, 2, 2, 2), np.float32)
+        out3 = _np(nn.Pad3D(1, value=5.0)(pt.Tensor(x3)))
+        assert out3.shape == (1, 1, 4, 4, 4) and out3[0, 0, 0, 0, 0] == 5.0
+        x1 = np.ones((1, 1, 3), np.float32)
+        assert _np(nn.ZeroPad1D(2)(pt.Tensor(x1))).shape == (1, 1, 7)
+
+    def test_max_unpool_roundtrip(self):
+        x = rng.normal(size=(1, 1, 4, 4)).astype(np.float32)
+        out, idx = F.max_pool2d(pt.Tensor(x), 2, 2, return_mask=True)
+        up = _np(nn.MaxUnPool2D(2, 2)(out, idx))
+        assert up.shape == (1, 1, 4, 4)
+        np.testing.assert_allclose(np.sort(up[up != 0]),
+                                   np.sort(_np(out).ravel()))
+
+    def test_unpool_1d_3d(self):
+        x1 = rng.normal(size=(1, 1, 6)).astype(np.float32)
+        o1, i1 = F.max_pool1d(pt.Tensor(x1), 2, 2, return_mask=True)
+        assert _np(nn.MaxUnPool1D(2, 2)(o1, i1)).shape == (1, 1, 6)
+        x3 = rng.normal(size=(1, 1, 4, 4, 4)).astype(np.float32)
+        o3, i3 = pt.max_pool3d_with_index(pt.Tensor(x3), 2, 2)
+        assert _np(nn.MaxUnPool3D(2, 2)(o3, i3)).shape == (1, 1, 4, 4, 4)
+
+    def test_lp_and_fractional(self):
+        x = np.abs(rng.normal(size=(1, 1, 8, 8))).astype(np.float32)
+        assert _np(nn.LPPool2D(2.0, 2, 2)(pt.Tensor(x))).shape == \
+            (1, 1, 4, 4)
+        x1 = np.abs(rng.normal(size=(1, 1, 8))).astype(np.float32)
+        assert _np(nn.LPPool1D(2.0, 2, 2)(pt.Tensor(x1))).shape == (1, 1, 4)
+        assert _np(nn.FractionalMaxPool2D(3)(pt.Tensor(x))).shape == \
+            (1, 1, 3, 3)
+        x3 = np.abs(rng.normal(size=(1, 1, 6, 6, 6))).astype(np.float32)
+        assert _np(nn.FractionalMaxPool3D(2)(pt.Tensor(x3))).shape == \
+            (1, 1, 2, 2, 2)
+
+
+class TestLosses:
+    def test_soft_margin(self):
+        x = rng.normal(size=(4, 3)).astype(np.float32)
+        y = np.sign(rng.normal(size=(4, 3))).astype(np.float32)
+        got = float(_np(nn.SoftMarginLoss()(pt.Tensor(x), pt.Tensor(y))))
+        ref = np.log1p(np.exp(-y * x)).mean()
+        assert got == pytest.approx(ref, rel=1e-5)
+
+    def test_multi_margin(self):
+        x = rng.normal(size=(3, 5)).astype(np.float32)
+        y = np.array([0, 2, 4])
+        got = float(_np(nn.MultiMarginLoss()(pt.Tensor(x), pt.Tensor(y))))
+        ref = 0.0
+        for i, c in enumerate(y):
+            m = np.maximum(0, 1.0 - x[i, c] + x[i]) ** 1
+            m[c] = 0
+            ref += m.sum() / 5
+        assert got == pytest.approx(ref / 3, rel=1e-5)
+
+    def test_multilabel_gaussian_poisson(self):
+        x = rng.normal(size=(4, 3)).astype(np.float32)
+        y = (rng.uniform(size=(4, 3)) > 0.5).astype(np.float32)
+        assert np.isfinite(_np(nn.MultiLabelSoftMarginLoss()(
+            pt.Tensor(x), pt.Tensor(y))))
+        var = np.abs(x) + 0.1
+        g = nn.GaussianNLLLoss()(pt.Tensor(x), pt.Tensor(y),
+                                 pt.Tensor(var))
+        ref = 0.5 * (np.log(var) + (y - x) ** 2 / var)
+        assert float(_np(g)) == pytest.approx(ref.mean(), rel=1e-4)
+        p = nn.PoissonNLLLoss()(pt.Tensor(x), pt.Tensor(y))
+        assert float(_np(p)) == pytest.approx((np.exp(x) - y * x).mean(),
+                                              rel=1e-4)
+
+    def test_triplet_with_distance(self):
+        a = rng.normal(size=(4, 8)).astype(np.float32)
+        p = a + 0.01
+        n = rng.normal(size=(4, 8)).astype(np.float32)
+        loss = nn.TripletMarginWithDistanceLoss(margin=0.5)(
+            pt.Tensor(a), pt.Tensor(p), pt.Tensor(n))
+        assert np.isfinite(_np(loss)) and _np(loss) >= 0
+
+    def test_rnnt_loss_layer(self):
+        x = rng.normal(size=(1, 3, 2, 4)).astype(np.float32)
+        lab = np.array([[2]], np.int32)
+        out = nn.RNNTLoss()(pt.Tensor(x), pt.Tensor(lab),
+                            pt.Tensor(np.array([3], np.int32)),
+                            pt.Tensor(np.array([1], np.int32)))
+        assert np.isfinite(_np(out))
+
+    def test_hsigmoid_layer(self):
+        layer = nn.HSigmoidLoss(8, 6)
+        x = rng.normal(size=(5, 8)).astype(np.float32)
+        lab = np.array([0, 1, 2, 3, 5], np.int64)
+        out = layer(pt.Tensor(x), pt.Tensor(lab))
+        assert _np(out).shape == (5, 1) and (_np(out) > 0).all()
+
+
+class TestAdaptiveBeam:
+    def test_adaptive_log_softmax(self):
+        m = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 10])
+        x = rng.normal(size=(6, 16)).astype(np.float32)
+        lab = np.array([0, 4, 7, 12, 19, 2], np.int64)
+        out, loss = m(pt.Tensor(x), pt.Tensor(lab))
+        lp = _np(m.log_prob(pt.Tensor(x)))
+        assert lp.shape == (6, 20)
+        # log-probs normalize over the full vocab
+        np.testing.assert_allclose(np.exp(lp).sum(-1), 1.0, rtol=1e-4)
+        np.testing.assert_allclose(_np(out), lp[np.arange(6), lab],
+                                   rtol=1e-5)
+        pred = _np(m.predict(pt.Tensor(x)))
+        np.testing.assert_array_equal(pred, lp.argmax(-1))
+
+    def test_beam_search_decoder(self):
+        cell = nn.GRUCell(4, 8)
+        proj = nn.Linear(8, 10)
+        emb = nn.Embedding(10, 4)
+        dec = nn.BeamSearchDecoder(
+            cell, start_token=1, end_token=2, beam_size=3,
+            embedding_fn=emb, output_fn=proj)
+        h0 = pt.Tensor(np.zeros((1, 8), np.float32))
+        seqs, scores = dec.decode(h0, max_steps=5)
+        assert seqs.shape[0] == 3 and seqs.shape[1] >= 2
+        assert np.isfinite(scores).all()
+
+    def test_unflatten_feature_dropout(self):
+        x = rng.normal(size=(2, 6)).astype(np.float32)
+        out = _np(nn.Unflatten(1, (2, 3))(pt.Tensor(x)))
+        assert out.shape == (2, 2, 3)
+        drop = nn.FeatureAlphaDropout(0.5)
+        drop.train()
+        y = _np(drop(pt.Tensor(rng.normal(size=(4, 8, 3)).astype(
+            np.float32))))
+        assert y.shape == (4, 8, 3)
+        drop.eval()
+        z = rng.normal(size=(4, 8, 3)).astype(np.float32)
+        np.testing.assert_allclose(_np(drop(pt.Tensor(z))), z)
