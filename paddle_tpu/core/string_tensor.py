@@ -51,6 +51,10 @@ class StringTensor:
         o = other._data if isinstance(other, StringTensor) else other
         return np.asarray(self._data == o)
 
+    # identity hash: __eq__ returns an elementwise array (numpy-style),
+    # so value hashing is impossible — keep instances usable as keys
+    __hash__ = object.__hash__
+
     def __repr__(self):
         return f"StringTensor(shape={self.shape}, data={self.tolist()!r})"
 

@@ -1,4 +1,7 @@
 from . import functional  # noqa: F401
 from .layer import (  # noqa: F401
     FusedFeedForward, FusedMultiHeadAttention, FusedTransformerEncoderLayer,
+    FusedLinear, FusedDropout, FusedDropoutAdd,
+    FusedBiasDropoutResidualLayerNorm, FusedEcMoe, FusedMultiTransformer,
+    FusedTransformer,
 )

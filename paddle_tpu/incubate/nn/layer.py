@@ -170,3 +170,204 @@ class FusedTransformerEncoderLayer(Layer):
 
     def forward(self, src, src_mask=None, cache=None):
         return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedLinear(Layer):
+    """Linear whose matmul+bias is one fused op (reference
+    incubate FusedLinear -> fused_gemm_epilogue)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (out_features,), attr=bias_attr, is_bias=True))
+
+    def forward(self, x):
+        from . import functional as IF
+        return IF.fused_linear(x, self.weight, self.bias,
+                               transpose_weight=self.transpose_weight)
+
+
+class FusedDropout(Layer):
+    """Dropout as a single taped op (reference incubate FusedDropout)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, self.p, training=self.training, mode=self.mode)
+
+
+class FusedDropoutAdd(Layer):
+    """dropout(x) + y in one op (reference FusedDropoutAdd)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        from . import functional as IF
+        return IF.fused_dropout_add(x, y, self.p, training=self.training,
+                                    mode=self.mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """out = LN(residual + dropout(x + bias)) in one op (reference
+    FusedBiasDropoutResidualLayerNorm)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.p = dropout_rate
+        self.epsilon = epsilon
+        # bias_attr=False disables the linear bias like the reference
+        self.linear_bias = (None if bias_attr is False else
+                            self.create_parameter((embed_dim,),
+                                                  attr=bias_attr,
+                                                  is_bias=True))
+        self.ln_scale = self.create_parameter((embed_dim,),
+                                              attr=weight_attr)
+        self.ln_bias = self.create_parameter((embed_dim,), is_bias=True)
+
+    def forward(self, x, residual):
+        from . import functional as IF
+        return IF.fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            dropout_rate=self.p, ln_epsilon=self.epsilon,
+            training=self.training)
+
+
+class FusedEcMoe(Layer):
+    """Expert-choice style fused MoE FFN (reference FusedEcMoe ->
+    fused_ec_moe op; compute path = the fused ``moe`` op: dense expert
+    batch gemms + gather)."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu",
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.act_type = act_type
+        self.bmm0 = self.create_parameter(
+            (num_experts, hidden_size, inter_size), attr=weight_attr)
+        self.bias0 = self.create_parameter((num_experts, 1, inter_size),
+                                           attr=bias_attr, is_bias=True)
+        self.bmm1 = self.create_parameter(
+            (num_experts, inter_size, hidden_size), attr=weight_attr)
+        self.bias1 = self.create_parameter((num_experts, 1, hidden_size),
+                                           attr=bias_attr, is_bias=True)
+
+    def forward(self, x, gate):
+        from ...ops import api
+        return api.moe(x, gate, self.bmm0, self.bias0, self.bmm1,
+                       self.bias1, act_type=self.act_type)
+
+
+class FusedMultiTransformer(Layer):
+    """Whole-stack serving transformer (reference FusedMultiTransformer):
+    holds per-layer weights and drives the fused_multi_transformer op for
+    prefill + cached decode."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None, qkv_weight_attrs=None,
+                 qkv_bias_attrs=None, linear_weight_attrs=None,
+                 linear_bias_attrs=None, ffn_ln_scale_attrs=None,
+                 ffn_ln_bias_attrs=None, ffn1_weight_attrs=None,
+                 ffn1_bias_attrs=None, ffn2_weight_attrs=None,
+                 ffn2_bias_attrs=None, epsilon=1e-5, num_layers=-1,
+                 nranks=1, trans_qkvw=True, ring_id=-1, name=None):
+        super().__init__()
+        if num_layers < 0:
+            num_layers = (len(qkv_weight_attrs)
+                          if isinstance(qkv_weight_attrs, (list, tuple))
+                          else 1)
+        self.num_layers = num_layers
+        self.dropout_rate = dropout_rate
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.epsilon = epsilon
+        self.trans_qkvw = trans_qkvw
+        head_dim = embed_dim // num_heads
+        self.ln_scales, self.ln_biases = [], []
+        self.qkv_weights, self.qkv_biases = [], []
+        self.linear_weights, self.linear_biases = [], []
+        self.ffn_ln_scales, self.ffn_ln_biases = [], []
+        self.ffn1_weights, self.ffn1_biases = [], []
+        self.ffn2_weights, self.ffn2_biases = [], []
+        for i in range(num_layers):
+            mk = self.create_parameter
+            self.ln_scales.append(mk((embed_dim,)))
+            self.ln_biases.append(mk((embed_dim,), is_bias=True))
+            self.qkv_weights.append(
+                mk((3, num_heads, head_dim, embed_dim) if trans_qkvw
+                   else (embed_dim, 3, num_heads, head_dim)))
+            self.qkv_biases.append(mk((3, num_heads, head_dim),
+                                      is_bias=True))
+            self.linear_weights.append(mk((embed_dim, embed_dim)))
+            self.linear_biases.append(mk((embed_dim,), is_bias=True))
+            self.ffn_ln_scales.append(mk((embed_dim,)))
+            self.ffn_ln_biases.append(mk((embed_dim,), is_bias=True))
+            self.ffn1_weights.append(mk((embed_dim, dim_feedforward)))
+            self.ffn1_biases.append(mk((dim_feedforward,), is_bias=True))
+            self.ffn2_weights.append(mk((dim_feedforward, embed_dim)))
+            self.ffn2_biases.append(mk((embed_dim,), is_bias=True))
+            for name_, lst in [("ln_s", self.ln_scales),
+                               ("ln_b", self.ln_biases),
+                               ("qkvw", self.qkv_weights),
+                               ("qkvb", self.qkv_biases),
+                               ("lw", self.linear_weights),
+                               ("lb", self.linear_biases),
+                               ("flns", self.ffn_ln_scales),
+                               ("flnb", self.ffn_ln_biases),
+                               ("f1w", self.ffn1_weights),
+                               ("f1b", self.ffn1_biases),
+                               ("f2w", self.ffn2_weights),
+                               ("f2b", self.ffn2_biases)]:
+                self.add_parameter(f"{name_}_{i}", lst[i])
+
+    def forward(self, x, attn_mask=None, caches=None, time_step=None,
+                rotary_embs=None):
+        from . import functional as IF
+        return IF.fused_multi_transformer(
+            x, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=self.normalize_before, epsilon=self.epsilon,
+            cache_kvs=caches, time_step=time_step, attn_mask=attn_mask,
+            rotary_embs=rotary_embs, activation=self.activation,
+            dropout_rate=self.dropout_rate, training=self.training,
+            trans_qkvw=self.trans_qkvw)
+
+
+class FusedTransformer(Layer):
+    """Encoder stack of FusedTransformerEncoderLayer (reference
+    FusedTransformer)."""
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 dim_feedforward=2048, dropout=0.1, activation="gelu",
+                 name=None):
+        super().__init__()
+        from ...nn.layer.container import LayerList
+        self.layers = LayerList([
+            FusedTransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout,
+                activation=activation)
+            for _ in range(num_encoder_layers)])
+
+    def forward(self, src, src_mask=None):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask)
+        return out
+
+
+__all__ += ["FusedLinear", "FusedDropout", "FusedDropoutAdd",
+            "FusedBiasDropoutResidualLayerNorm", "FusedEcMoe",
+            "FusedMultiTransformer", "FusedTransformer"]

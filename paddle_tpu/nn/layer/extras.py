@@ -117,8 +117,11 @@ class MaxUnPool1D(Layer):
         xv, iv = _v(x), _v(indices)
         x4 = jnp.expand_dims(jnp.asarray(xv), 2)      # [N, C, 1, L]
         i4 = jnp.expand_dims(jnp.asarray(iv), 2)
-        osz = None if self.output_size is None else \
-            (1, self.output_size[-1])
+        if self.output_size is None:
+            osz = None
+        else:
+            o = self.output_size
+            osz = (1, o if isinstance(o, int) else o[-1])
         out = api.unpool(Tensor(x4), Tensor(i4), (1, self.kernel_size),
                          (1, self.stride), (0, self.padding), osz)
         return Tensor(jnp.squeeze(_v(out), 2))
@@ -358,7 +361,8 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
         self.n_classes = n_classes
         self.head_weight = self.create_parameter(
             (in_features, self.head_size))
-        self.head_bias = (self.create_parameter((self.head_size,))
+        self.head_bias = (self.create_parameter((self.head_size,),
+                                                is_bias=True)
                           if head_bias else None)
         self.tail_weights = []
         for i in range(self.n_clusters):

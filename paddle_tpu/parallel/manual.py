@@ -571,11 +571,14 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
 
     step_fn = jax.jit(step, donate_argnums=(0,))
     if offload_optimizer:
-        return _offload_opt_state(step_fn, init_fn)
+        mom_shardings = tree_map_with_spec(lambda _s, sp: sh(sp),
+                                           mom_specs, mom_specs)
+        return _offload_opt_state(step_fn, init_fn,
+                                  {"m": mom_shardings, "v": mom_shardings})
     return step_fn, init_fn
 
 
-def _offload_opt_state(step_fn, init_fn):
+def _offload_opt_state(step_fn, init_fn, mom_shardings):
     """Optimizer-state host offload (reference group_sharded offload=True /
     sharding_offload: fp32 moments live in HOST RAM between steps and are
     shipped to the device around each update).  The explicit
@@ -584,23 +587,18 @@ def _offload_opt_state(step_fn, init_fn):
     price of the HBM savings, exactly as in the reference."""
     import numpy as _np
 
-    # shardings are constant across steps; captured here (not in the state
-    # pytree) so the user-visible state stays arrays-only
-    _sh_cell = {}
-
     def init2(seed: int = 0):
         state = init_fn(seed)
         opt = state["opt"]
-        _sh_cell.update(jax.tree.map(
-            lambda a: a.sharding if hasattr(a, "sharding") else None,
-            {"m": opt["m"], "v": opt["v"]}))
         host = {"m": jax.tree.map(lambda a: _np.asarray(a), opt["m"]),
                 "v": jax.tree.map(lambda a: _np.asarray(a), opt["v"])}
         state["opt"] = {"m": host["m"], "v": host["v"], "t": opt["t"]}
         return state
 
     def step2(state, ids, labels):
-        sh = _sh_cell
+        # shardings come from the builder's moment specs, so a state
+        # restored from a checkpoint (no init_fn call) steps fine
+        sh = mom_shardings
         dev_state = {
             "params": state["params"],
             "opt": {"m": jax.tree.map(jax.device_put, state["opt"]["m"],

@@ -112,3 +112,74 @@ class TestFusedLayers:
         out.sum().backward()
         assert net.qkv_weight.grad is not None
         assert np.abs(net.qkv_weight.grad.numpy()).sum() > 0
+
+
+class TestIncubateFusedLayers:
+    """The 7 fused layer classes added for full incubate.nn parity."""
+
+    def test_fused_linear_and_dropout_add(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.incubate.nn import (FusedDropoutAdd, FusedLinear)
+        rng = np.random.default_rng(0)
+        lin = FusedLinear(8, 4)
+        x = rng.normal(size=(3, 8)).astype(np.float32)
+        out = np.asarray(lin(pt.Tensor(x))._value)
+        ref = x @ np.asarray(lin.weight._value) + np.asarray(
+            lin.bias._value)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+        da = FusedDropoutAdd(0.5)
+        da.eval()
+        y = rng.normal(size=(3, 8)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(da(pt.Tensor(x), pt.Tensor(y))._value), x + y,
+            rtol=1e-6)
+
+    def test_fused_bias_dropout_residual_ln(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.incubate.nn import FusedBiasDropoutResidualLayerNorm
+        rng = np.random.default_rng(1)
+        m = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+        x = rng.normal(size=(2, 5, 8)).astype(np.float32)
+        r = rng.normal(size=(2, 5, 8)).astype(np.float32)
+        out = np.asarray(m(pt.Tensor(x), pt.Tensor(r))._value)
+        h = x + np.asarray(m.linear_bias._value) + r
+        mu = h.mean(-1, keepdims=True)
+        ref = (h - mu) / np.sqrt(h.var(-1, keepdims=True) + 1e-5)
+        ref = ref * np.asarray(m.ln_scale._value) + np.asarray(
+            m.ln_bias._value)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+    def test_fused_ec_moe(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.incubate.nn import FusedEcMoe
+        rng = np.random.default_rng(2)
+        m = FusedEcMoe(8, 16, num_experts=2)
+        x = rng.normal(size=(4, 8)).astype(np.float32)
+        gate = rng.normal(size=(4, 2)).astype(np.float32)
+        out = np.asarray(m(pt.Tensor(x), pt.Tensor(gate))._value)
+        assert out.shape == (4, 8) and np.isfinite(out).all()
+
+    def test_fused_multi_transformer_layer(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        rng = np.random.default_rng(3)
+        m = FusedMultiTransformer(16, 2, 32, num_layers=2)
+        x = rng.normal(size=(1, 5, 16)).astype(np.float32)
+        out = np.asarray(m(pt.Tensor(x))._value)
+        assert out.shape == (1, 5, 16) and np.isfinite(out).all()
+
+    def test_fused_transformer_stack(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.incubate.nn import FusedTransformer
+        m = FusedTransformer(d_model=16, nhead=2, num_encoder_layers=2,
+                             dim_feedforward=32, dropout=0.0)
+        m.eval()
+        x = np.random.default_rng(4).normal(size=(2, 6, 16)).astype(
+            np.float32)
+        out = np.asarray(m(pt.Tensor(x))._value)
+        assert out.shape == (2, 6, 16) and np.isfinite(out).all()
