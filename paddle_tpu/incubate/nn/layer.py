@@ -195,13 +195,16 @@ class FusedLinear(Layer):
 class FusedDropout(Layer):
     """Dropout as a single taped op (reference incubate FusedDropout)."""
 
-    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train",
+                 name=None):
         super().__init__()
         self.p = p
+        self.axis = axis
         self.mode = mode
 
     def forward(self, x):
-        return F.dropout(x, self.p, training=self.training, mode=self.mode)
+        return F.dropout(x, self.p, axis=self.axis,
+                         training=self.training, mode=self.mode)
 
 
 class FusedDropoutAdd(Layer):
@@ -232,8 +235,10 @@ class FusedBiasDropoutResidualLayerNorm(Layer):
                             self.create_parameter((embed_dim,),
                                                   attr=bias_attr,
                                                   is_bias=True))
+        from ...nn import initializer as I
+        one = ParamAttr(initializer=I.Constant(1.0))
         self.ln_scale = self.create_parameter((embed_dim,),
-                                              attr=weight_attr)
+                                              attr=weight_attr or one)
         self.ln_bias = self.create_parameter((embed_dim,), is_bias=True)
 
     def forward(self, x, residual):
@@ -294,6 +299,16 @@ class FusedMultiTransformer(Layer):
         self.epsilon = epsilon
         self.trans_qkvw = trans_qkvw
         head_dim = embed_dim // num_heads
+        from ...nn import initializer as I
+        one = ParamAttr(initializer=I.Constant(1.0))
+
+        def _at(attrs, i, default=None):
+            if attrs is None:
+                return default
+            if isinstance(attrs, (list, tuple)):
+                return attrs[i]
+            return attrs
+
         self.ln_scales, self.ln_biases = [], []
         self.qkv_weights, self.qkv_biases = [], []
         self.linear_weights, self.linear_biases = [], []
@@ -302,21 +317,41 @@ class FusedMultiTransformer(Layer):
         self.ffn2_weights, self.ffn2_biases = [], []
         for i in range(num_layers):
             mk = self.create_parameter
-            self.ln_scales.append(mk((embed_dim,)))
-            self.ln_biases.append(mk((embed_dim,), is_bias=True))
+            self.ln_scales.append(mk((embed_dim,),
+                                     attr=_at(ln_scale_attrs, i, one)))
+            self.ln_biases.append(mk((embed_dim,),
+                                     attr=_at(ln_bias_attrs, i),
+                                     is_bias=True))
             self.qkv_weights.append(
                 mk((3, num_heads, head_dim, embed_dim) if trans_qkvw
-                   else (embed_dim, 3, num_heads, head_dim)))
+                   else (embed_dim, 3, num_heads, head_dim),
+                   attr=_at(qkv_weight_attrs, i)))
             self.qkv_biases.append(mk((3, num_heads, head_dim),
+                                      attr=_at(qkv_bias_attrs, i),
                                       is_bias=True))
-            self.linear_weights.append(mk((embed_dim, embed_dim)))
-            self.linear_biases.append(mk((embed_dim,), is_bias=True))
-            self.ffn_ln_scales.append(mk((embed_dim,)))
-            self.ffn_ln_biases.append(mk((embed_dim,), is_bias=True))
-            self.ffn1_weights.append(mk((embed_dim, dim_feedforward)))
-            self.ffn1_biases.append(mk((dim_feedforward,), is_bias=True))
-            self.ffn2_weights.append(mk((dim_feedforward, embed_dim)))
-            self.ffn2_biases.append(mk((embed_dim,), is_bias=True))
+            self.linear_weights.append(
+                mk((embed_dim, embed_dim),
+                   attr=_at(linear_weight_attrs, i)))
+            self.linear_biases.append(mk((embed_dim,),
+                                         attr=_at(linear_bias_attrs, i),
+                                         is_bias=True))
+            self.ffn_ln_scales.append(
+                mk((embed_dim,), attr=_at(ffn_ln_scale_attrs, i, one)))
+            self.ffn_ln_biases.append(mk((embed_dim,),
+                                         attr=_at(ffn_ln_bias_attrs, i),
+                                         is_bias=True))
+            self.ffn1_weights.append(
+                mk((embed_dim, dim_feedforward),
+                   attr=_at(ffn1_weight_attrs, i)))
+            self.ffn1_biases.append(mk((dim_feedforward,),
+                                       attr=_at(ffn1_bias_attrs, i),
+                                       is_bias=True))
+            self.ffn2_weights.append(
+                mk((dim_feedforward, embed_dim),
+                   attr=_at(ffn2_weight_attrs, i)))
+            self.ffn2_biases.append(mk((embed_dim,),
+                                       attr=_at(ffn2_bias_attrs, i),
+                                       is_bias=True))
             for name_, lst in [("ln_s", self.ln_scales),
                                ("ln_b", self.ln_biases),
                                ("qkvw", self.qkv_weights),

@@ -374,6 +374,8 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
                            remat: bool = True,
                            schedule: str = "1f1b",
                            sharding_stage: int = 2,
+                           num_model_chunks: int = 1,
+                           offload_optimizer: bool = False,
                            sequence_parallel: bool = False):
     """Compiled hybrid dp×mp×pp×sharding×sep Llama train step.
 
@@ -426,7 +428,16 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
         else:
             cp_attn = None
 
+    vpp = num_model_chunks if schedule == "interleave" else 1
+    if vpp > 1 and cfg.num_layers % (S * vpp) != 0:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by pp*chunks "
+            f"{S}*{vpp}")
     blk_specs = block_param_specs(cfg, pipeline=True)
+    if vpp > 1:
+        # [S, v, per_v, ...]: element [s, c] holds virtual stage s + S*c
+        blk_specs = {k: P(*(tuple(sp_)[:1] + (None,) + tuple(sp_)[1:]))
+                     for k, sp_ in blk_specs.items()}
     param_specs = {"wte": P(MP_AXIS, None), "head": P(None, MP_AXIS),
                    "lnf_w": P(), "blocks": blk_specs}
 
@@ -446,8 +457,17 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
                 * cfg.initializer_range, sh(param_specs["head"])),
             "lnf_w": jax.device_put(jnp.ones(cfg.hidden_size, dt), sh(P())),
             "blocks": {n: jax.device_put(v, sh(blk_specs[n]))
-                       for n, v in stack_block_params(cfg, k3, S).items()},
+                       for n, v in _stacked_blocks(k3).items()},
         }
+
+    def _stacked_blocks(k3):
+        if vpp == 1:
+            return stack_block_params(cfg, k3, S)
+        stacked = stack_block_params(cfg, k3, S * vpp)   # [Sv, per_v, ...]
+        return {n: jnp.transpose(
+                    val.reshape((vpp, S) + val.shape[1:]),
+                    (1, 0) + tuple(range(2, val.ndim + 1)))
+                for n, val in stacked.items()}
 
     sp = sequence_parallel and mp > 1
     if sp:
@@ -492,5 +512,7 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
         step_ctx_fn=step_ctx_fn,
         num_microbatches=num_microbatches, learning_rate=learning_rate,
         remat=remat, schedule=schedule, sharding_stage=sharding_stage,
+        num_model_chunks=num_model_chunks,
+        offload_optimizer=offload_optimizer,
         mp_reduce_block_leaves=frozenset(
             {"ln1_w", "ln2_w"} if sp else ()))

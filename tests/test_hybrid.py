@@ -334,3 +334,29 @@ def test_offload_optimizer_matches_and_lives_on_host():
         assert isinstance(jax.tree.leaves(state["opt"]["m"])[0],
                           np.ndarray)
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=1e-5)
+
+
+def test_llama_interleave_matches_single_device():
+    from paddle_tpu.models.llama import llama_tiny, build_llama_train_step
+
+    def run(**kw):
+        topo = dist.init_topology(**{k: v for k, v in kw.items()
+                                     if k in ("pp", "mp")})
+        cfg = llama_tiny(num_layers=4)
+        step_fn, init_fn = build_llama_train_step(
+            cfg, topo, num_microbatches=kw.get("mb", 1),
+            schedule=kw.get("schedule", "1f1b"),
+            num_model_chunks=kw.get("chunks", 1))
+        state = init_fn(0)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (4, 32)).astype(np.int64)
+        out = []
+        for _ in range(3):
+            state, loss = step_fn(state, ids, np.roll(ids, -1, 1))
+            out.append(float(np.asarray(jax.device_get(loss))))
+        set_topology(HybridTopology())
+        return out
+
+    ref = run()
+    got = run(pp=2, mb=4, schedule="interleave", chunks=2)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)

@@ -168,6 +168,10 @@ class TestIncubateFusedLayers:
         from paddle_tpu.incubate.nn import FusedMultiTransformer
         rng = np.random.default_rng(3)
         m = FusedMultiTransformer(16, 2, 32, num_layers=2)
+        # LN scales must initialize to ones (reference convention)
+        np.testing.assert_allclose(np.asarray(m.ln_scales[0]._value), 1.0)
+        np.testing.assert_allclose(np.asarray(m.ffn_ln_scales[1]._value),
+                                   1.0)
         x = rng.normal(size=(1, 5, 16)).astype(np.float32)
         out = np.asarray(m(pt.Tensor(x))._value)
         assert out.shape == (1, 5, 16) and np.isfinite(out).all()
