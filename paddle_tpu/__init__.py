@@ -103,6 +103,19 @@ def in_static_mode():
 from . import models  # noqa: F401
 from . import static  # noqa: F401
 from .core.string_tensor import StringTensor, to_string_tensor  # noqa: F401
+import jax.numpy as _jnp
+dtype = _jnp.dtype    # paddle.dtype: the dtype constructor/type alias
+del _jnp
+from .framework_misc import (  # noqa: F401
+    ParamAttr, CUDAPlace, CUDAPinnedPlace, LazyGuard, DataParallel,
+    is_tensor, is_complex, is_integer, is_floating_point, clone, tolist,
+    floor_mod, set_printoptions, check_shape, disable_signal_handler,
+    get_cuda_rng_state, set_cuda_rng_state, create_parameter, summary,
+    flops, batch)
+from . import framework_misc as _fm
+import sys as _sys
+_fm.install_inplace_api(_sys.modules[__name__])
+del _fm, _sys
 from .tensor_array import (  # noqa: F401
     TensorArray, create_array, array_write, array_read, array_length)
 from . import utils  # noqa: F401

@@ -1,0 +1,299 @@
+"""Top-level namespace tail (reference python/paddle/__init__.py __all__):
+module-level in-place op variants, type predicates, places, summary/flops,
+DataParallel, and small utilities.  Everything routes to existing kernels —
+this module is the name surface, not new compute.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+from .nn.attr import ParamAttr  # noqa: F401  (re-exported at top level)
+
+__all__ = [
+    "ParamAttr", "CUDAPlace", "CUDAPinnedPlace", "LazyGuard",
+    "DataParallel", "is_tensor", "is_complex", "is_integer",
+    "is_floating_point", "clone", "tolist", "floor_mod", "add_n",
+    "set_printoptions", "check_shape", "disable_signal_handler",
+    "get_cuda_rng_state", "set_cuda_rng_state", "create_parameter",
+    "summary", "flops", "batch", "install_inplace_api",
+]
+
+
+# ---- places (aliases of static's; CUDA names map to the accelerator) ----
+from .static import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
+
+
+class CUDAPinnedPlace:
+    pass
+
+
+class LazyGuard:
+    """reference paddle.LazyGuard: delayed parameter materialization.  Our
+    parameters are cheap jnp arrays created eagerly; the guard is a no-op
+    context kept for API compatibility."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def DataParallel(layers, *args, **kwargs):
+    """reference paddle.DataParallel: dygraph DP wrapper.  Under the
+    single-controller XLA model, data parallelism is the dp mesh axis in
+    the compiled step; eager layers already see replicated values, so the
+    wrapper returns the layer unchanged (grad sync happens inside the
+    compiled step / DistributedEngine)."""
+    return layers
+
+
+# ---- type predicates -----------------------------------------------------
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def _dtype_of(x):
+    return jnp.asarray(x._value if isinstance(x, Tensor) else x).dtype
+
+
+def is_complex(x) -> bool:
+    return jnp.issubdtype(_dtype_of(x), jnp.complexfloating)
+
+
+def is_integer(x) -> bool:
+    return jnp.issubdtype(_dtype_of(x), jnp.integer)
+
+
+def is_floating_point(x) -> bool:
+    return jnp.issubdtype(_dtype_of(x), jnp.floating)
+
+
+# ---- small functions -----------------------------------------------------
+def clone(x):
+    return x.clone() if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def tolist(x):
+    return x.tolist() if isinstance(x, Tensor) else np.asarray(x).tolist()
+
+
+def floor_mod(x, y):
+    from .ops import api
+    return api.mod(x, y)
+
+
+def add_n(inputs):
+    from .ops import api
+    return api.add_n(inputs)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def check_shape(x, expected):
+    got = tuple(jnp.shape(x._value if isinstance(x, Tensor) else x))
+    exp = tuple(expected)
+    ok = len(got) == len(exp) and all(
+        e in (-1, None) or g == e for g, e in zip(got, exp))
+    if not ok:
+        raise ValueError(f"check_shape: got {got}, expected {exp}")
+    return True
+
+
+def disable_signal_handler():
+    """reference disables its C++ fatal-signal dumper; nothing to disable
+    here (faulthandler is Python's)."""
+    return None
+
+
+def get_cuda_rng_state():
+    from .core.rng import get_rng_state
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(state):
+    from .core.rng import set_rng_state
+    set_rng_state(state[0] if isinstance(state, (list, tuple)) else state)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone parameter factory (reference paddle.create_parameter)."""
+    from .nn.layer.layers import Layer
+
+    class _Holder(Layer):
+        pass
+
+    h = _Holder()
+    return h.create_parameter(shape, attr=attr, dtype=dtype,
+                              is_bias=is_bias,
+                              default_initializer=default_initializer)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """reference paddle.summary → hapi Model.summary."""
+    from .hapi.model import Model
+    return Model(net).summary(input_size=input_size)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough per-layer FLOPs count (reference paddle.flops): matmul-bearing
+    layers counted as 2*m*n*k on the given input size; returns total."""
+    from .nn.layer.layers import Layer
+    total = 0
+    x = np.zeros(input_size, np.float32)
+    shapes = {}
+
+    def hook(layer, inputs, output):
+        try:
+            inp = inputs[0]
+            ishape = tuple(jnp.shape(inp._value if isinstance(inp, Tensor)
+                                     else inp))
+            w = getattr(layer, "weight", None)
+            if w is not None and hasattr(w, "shape") and len(w.shape) == 2:
+                m = int(np.prod(ishape[:-1]))
+                k, n = int(w.shape[0]), int(w.shape[1])
+                shapes[id(layer)] = 2 * m * k * n
+        except Exception:
+            pass
+
+    handles = []
+    for sub in net.sublayers(include_self=True):
+        handles.append(sub.register_forward_post_hook(hook))
+    try:
+        net(Tensor(jnp.asarray(x)))
+    finally:
+        for h in handles:
+            h.remove()
+    total = sum(shapes.values())
+    if print_detail:
+        print(f"Total FLOPs: {total}")
+    return total
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference paddle.batch: wrap a sample reader into a batch reader."""
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+# ---- module-level in-place variants -------------------------------------
+# reference exports `<op>_` at the top level for the dygraph in-place API;
+# the registry already generates Tensor METHOD in-place variants, these are
+# the free-function forms
+_INPLACE_EXPORTS = [
+    "abs", "acos", "addmm", "asin", "asinh", "atan", "atanh", "cast",
+    "floor_mod",
+    "ceil", "clip", "copysign", "cos", "cosh", "cumprod", "cumsum",
+    "digamma", "divide", "equal", "erf", "erfinv", "exp", "expm1",
+    "flatten", "floor", "floor_divide", "frac", "gammainc", "gammaincc",
+    "gammaln", "gcd", "greater_equal", "greater_than", "hypot", "i0",
+    "lcm", "ldexp", "lerp", "less_equal", "less_than", "lgamma", "log",
+    "log10", "log1p", "log2", "logical_and", "logical_not", "logical_or",
+    "logical_xor", "logit", "masked_fill", "masked_scatter", "mod",
+    "multigammaln", "multiply", "nan_to_num", "neg", "polygamma", "pow",
+    "reciprocal", "remainder", "renorm", "reshape", "round", "rsqrt",
+    "scale", "scatter", "sign", "sin", "sinc", "sinh", "sqrt", "square",
+    "squeeze", "subtract", "t", "tan", "tanh", "transpose", "tril",
+    "triu", "trunc", "unsqueeze", "where", "zero", "bitwise_and",
+    "bitwise_not", "bitwise_or", "bitwise_xor", "bitwise_left_shift",
+    "bitwise_right_shift", "fill_diagonal",
+]
+
+_RANDOM_INPLACE = ["normal", "uniform", "exponential", "bernoulli",
+                   "cauchy", "geometric", "log_normal"]
+
+
+def _random_refill(kind):
+    def fn(x, *args, **kwargs):
+        from .core.rng import next_rng_key
+        v = jnp.asarray(x._value)
+        key = next_rng_key()
+        if kind == "normal":
+            mean = args[0] if args else kwargs.get("mean", 0.0)
+            std = args[1] if len(args) > 1 else kwargs.get("std", 1.0)
+            new = jax.random.normal(key, v.shape, v.dtype) * std + mean
+        elif kind == "uniform":
+            lo = args[0] if args else kwargs.get("min", -1.0)
+            hi = args[1] if len(args) > 1 else kwargs.get("max", 1.0)
+            new = jax.random.uniform(key, v.shape, v.dtype, lo, hi)
+        elif kind == "exponential":
+            lam = args[0] if args else kwargs.get("lam", 1.0)
+            new = jax.random.exponential(key, v.shape, v.dtype) / lam
+        elif kind == "bernoulli":
+            p = args[0] if args else kwargs.get("p", 0.5)
+            new = jax.random.bernoulli(key, p, v.shape).astype(v.dtype)
+        elif kind == "cauchy":
+            loc = args[0] if args else kwargs.get("loc", 0.0)
+            scale_ = args[1] if len(args) > 1 else kwargs.get("scale", 1.0)
+            u = jax.random.uniform(key, v.shape, jnp.float32, 1e-6,
+                                   1 - 1e-6)
+            new = (loc + scale_ * jnp.tan(jnp.pi * (u - 0.5))).astype(
+                v.dtype)
+        elif kind == "geometric":
+            # reference geometric_ is CONTINUOUS: log(u)/log1p(-p), no floor
+            p = args[0] if args else kwargs.get("probs", 0.5)
+            u = jax.random.uniform(key, v.shape, jnp.float32, 1e-6,
+                                   1 - 1e-6)
+            new = (jnp.log(u) / jnp.log1p(-p)).astype(v.dtype)
+        else:  # log_normal
+            mean = args[0] if args else kwargs.get("mean", 1.0)
+            std = args[1] if len(args) > 1 else kwargs.get("std", 2.0)
+            new = jnp.exp(jax.random.normal(key, v.shape, jnp.float32)
+                          * std + mean).astype(v.dtype)
+        x._value = new
+        # the refilled value no longer depends on x's producer: make x a
+        # leaf so backward doesn't flow into the stale graph
+        x._node = None
+        x._out_index = 0
+        return x
+
+    fn.__name__ = kind + "_"
+    return fn
+
+
+def install_inplace_api(root_module) -> None:
+    """Bind ``<op>_`` free functions onto the top-level namespace (one
+    source of truth: the registry's _make_inplace, whose first parameter
+    is positional so the method doubles as a free function)."""
+    from .ops.registry import _make_inplace, all_ops
+    reg = all_ops()
+    for name in _INPLACE_EXPORTS:
+        od = reg.get(name)
+        if od is None:
+            continue
+        setattr(root_module, name + "_", _make_inplace(od, od.fn))
+    for kind in _RANDOM_INPLACE:
+        setattr(root_module, kind + "_", _random_refill(kind))
+    if hasattr(root_module, "mod_"):
+        root_module.floor_mod_ = root_module.mod_

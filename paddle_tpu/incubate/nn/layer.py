@@ -236,9 +236,9 @@ class FusedBiasDropoutResidualLayerNorm(Layer):
                                                   attr=bias_attr,
                                                   is_bias=True))
         from ...nn import initializer as I
-        one = ParamAttr(initializer=I.Constant(1.0))
-        self.ln_scale = self.create_parameter((embed_dim,),
-                                              attr=weight_attr or one)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
         self.ln_bias = self.create_parameter((embed_dim,), is_bias=True)
 
     def forward(self, x, residual):
@@ -300,7 +300,7 @@ class FusedMultiTransformer(Layer):
         self.trans_qkvw = trans_qkvw
         head_dim = embed_dim // num_heads
         from ...nn import initializer as I
-        one = ParamAttr(initializer=I.Constant(1.0))
+        _ones = I.Constant(1.0)
 
         def _at(attrs, i, default=None):
             if attrs is None:
@@ -318,7 +318,8 @@ class FusedMultiTransformer(Layer):
         for i in range(num_layers):
             mk = self.create_parameter
             self.ln_scales.append(mk((embed_dim,),
-                                     attr=_at(ln_scale_attrs, i, one)))
+                                     attr=_at(ln_scale_attrs, i),
+                                     default_initializer=_ones))
             self.ln_biases.append(mk((embed_dim,),
                                      attr=_at(ln_bias_attrs, i),
                                      is_bias=True))
@@ -336,7 +337,8 @@ class FusedMultiTransformer(Layer):
                                          attr=_at(linear_bias_attrs, i),
                                          is_bias=True))
             self.ffn_ln_scales.append(
-                mk((embed_dim,), attr=_at(ffn_ln_scale_attrs, i, one)))
+                mk((embed_dim,), attr=_at(ffn_ln_scale_attrs, i),
+                   default_initializer=_ones))
             self.ffn_ln_biases.append(mk((embed_dim,),
                                          attr=_at(ffn_ln_bias_attrs, i),
                                          is_bias=True))

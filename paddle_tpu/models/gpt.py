@@ -394,25 +394,14 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
         "wte": P(MP_AXIS, None), "wpe": P(), "lnf_w": P(), "lnf_b": P(),
     }
     vpp = num_model_chunks if schedule == "interleave" else 1
-    if vpp > 1 and cfg.num_layers % (S * vpp) != 0:
-        raise ValueError(
-            f"num_layers {cfg.num_layers} not divisible by pp*chunks "
-            f"{S}*{vpp}")
-    blk_specs = block_param_specs(cfg, pipeline=True)
-    if vpp > 1:
-        # [S, v, per_v, ...]: element [s, c] holds virtual stage s + S*c
-        blk_specs = {k: P(*(tuple(sp)[:1] + (None,) + tuple(sp)[1:]))
-                     for k, sp in blk_specs.items()}
+    blk_specs, _vpp_restack = man.vpp_block_layout(
+        block_param_specs(cfg, pipeline=True), S, vpp, cfg.num_layers)
     param_specs = dict(emb_specs, blocks=blk_specs)
 
     def _stacked_blocks(k3):
         if vpp == 1:
             return stack_block_params(cfg, k3, S)
-        stacked = stack_block_params(cfg, k3, S * vpp)   # [Sv, per_v, ...]
-        return {n: jnp.transpose(
-                    val.reshape((vpp, S) + val.shape[1:]),
-                    (1, 0) + tuple(range(2, val.ndim + 1)))
-                for n, val in stacked.items()}
+        return _vpp_restack(stack_block_params(cfg, k3, S * vpp))
 
     def sh(spec):
         return NamedSharding(mesh, spec)

@@ -429,15 +429,8 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
             cp_attn = None
 
     vpp = num_model_chunks if schedule == "interleave" else 1
-    if vpp > 1 and cfg.num_layers % (S * vpp) != 0:
-        raise ValueError(
-            f"num_layers {cfg.num_layers} not divisible by pp*chunks "
-            f"{S}*{vpp}")
-    blk_specs = block_param_specs(cfg, pipeline=True)
-    if vpp > 1:
-        # [S, v, per_v, ...]: element [s, c] holds virtual stage s + S*c
-        blk_specs = {k: P(*(tuple(sp_)[:1] + (None,) + tuple(sp_)[1:]))
-                     for k, sp_ in blk_specs.items()}
+    blk_specs, _vpp_restack = man.vpp_block_layout(
+        block_param_specs(cfg, pipeline=True), S, vpp, cfg.num_layers)
     param_specs = {"wte": P(MP_AXIS, None), "head": P(None, MP_AXIS),
                    "lnf_w": P(), "blocks": blk_specs}
 
@@ -463,11 +456,7 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
     def _stacked_blocks(k3):
         if vpp == 1:
             return stack_block_params(cfg, k3, S)
-        stacked = stack_block_params(cfg, k3, S * vpp)   # [Sv, per_v, ...]
-        return {n: jnp.transpose(
-                    val.reshape((vpp, S) + val.shape[1:]),
-                    (1, 0) + tuple(range(2, val.ndim + 1)))
-                for n, val in stacked.items()}
+        return _vpp_restack(stack_block_params(cfg, k3, S * vpp))
 
     sp = sequence_parallel and mp > 1
     if sp:

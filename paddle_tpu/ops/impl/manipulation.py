@@ -618,3 +618,66 @@ def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
     lo = shard_id * per
     inside = (x >= lo) & (x < lo + per)
     return jnp.where(inside, x - lo, ignore_value)
+
+
+# --- top-level tail (reference python/paddle/tensor/manipulation.py) ---
+def block_diag(inputs):
+    vals = [jnp.asarray(getattr(v, "_value", v)) for v in inputs]
+    vals = [v.reshape(1, -1) if v.ndim == 1 else v for v in vals]
+    return jax.scipy.linalg.block_diag(*vals)
+
+
+def cartesian_prod(x):
+    vals = [jnp.asarray(getattr(v, "_value", v)).reshape(-1) for v in x]
+    grids = jnp.meshgrid(*vals, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    x = jnp.asarray(getattr(x, "_value", x))
+    if isinstance(num_or_indices, int):
+        return tuple(jnp.array_split(x, num_or_indices, axis=int(axis)))
+    return tuple(jnp.split(x, list(num_or_indices), axis=int(axis)))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides):
+    x = jnp.asarray(getattr(x, "_value", x))
+    v = jnp.asarray(getattr(value, "_value", value))
+    idx = [builtins_slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = builtins_slice(int(s), int(e), int(st))
+    return x.at[tuple(idx)].set(v)
+
+
+def select_scatter(x, value, axis, index):
+    x = jnp.asarray(getattr(x, "_value", x))
+    v = jnp.asarray(getattr(value, "_value", value))
+    idx = [builtins_slice(None)] * x.ndim
+    idx[axis] = int(index)
+    return x.at[tuple(idx)].set(v)
+
+
+def diagonal_scatter(x, value, offset=0, axis1=0, axis2=1):
+    x = jnp.asarray(getattr(x, "_value", x))
+    v = jnp.asarray(getattr(value, "_value", value))
+    moved = jnp.moveaxis(x, (axis1, axis2), (-2, -1))
+    n, m = moved.shape[-2:]
+    if offset >= 0:
+        rows = jnp.arange(min(n, m - offset))
+        cols = rows + offset
+    else:
+        cols = jnp.arange(min(m, n + offset))
+        rows = cols - offset
+    out = moved.at[..., rows, cols].set(v)
+    return jnp.moveaxis(out, (-2, -1), (axis1, axis2))
+
+
+def unflatten(x, axis, shape):
+    x = jnp.asarray(getattr(x, "_value", x))
+    ax = axis % x.ndim
+    shape = tuple(int(s) for s in shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape = tuple(x.shape[ax] // known if s == -1 else s
+                      for s in shape)
+    return x.reshape(x.shape[:ax] + shape + x.shape[ax + 1:])

@@ -575,3 +575,91 @@ def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
         r = c - offset
     xm = xm.at[..., r, c].set(jnp.asarray(y))
     return jnp.moveaxis(xm, (-2, -1), (dim1, dim2))
+
+
+# --- top-level tail (reference python/paddle/tensor/math.py) ---
+def sinc(x):
+    x = jnp.asarray(getattr(x, "_value", x))
+    return jnp.sinc(x)
+
+
+def signbit(x):
+    return jnp.signbit(jnp.asarray(getattr(x, "_value", x)))
+
+
+def isneginf(x):
+    x = jnp.asarray(getattr(x, "_value", x))
+    return jnp.isneginf(x)
+
+
+def isposinf(x):
+    x = jnp.asarray(getattr(x, "_value", x))
+    return jnp.isposinf(x)
+
+
+def isreal(x):
+    x = jnp.asarray(getattr(x, "_value", x))
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        return x.imag == 0
+    return jnp.ones(x.shape, bool)
+
+
+def isin(x, test_x, assume_unique=False, invert=False):
+    x = jnp.asarray(getattr(x, "_value", x))
+    t = jnp.asarray(getattr(test_x, "_value", test_x))
+    return jnp.isin(x, t, invert=invert)
+
+
+def gammainc(x, y):
+    from jax.scipy.special import gammainc as f
+    return f(jnp.asarray(getattr(x, "_value", x)),
+             jnp.asarray(getattr(y, "_value", y)))
+
+
+def multigammaln(x, p):
+    from jax.scipy.special import multigammaln as f
+    return f(jnp.asarray(getattr(x, "_value", x)), int(p))
+
+
+def frexp(x):
+    x = jnp.asarray(getattr(x, "_value", x))
+    m, e = jnp.frexp(x)
+    return m, e
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    y = jnp.asarray(getattr(y, "_value", y))
+    if x is not None:
+        x = jnp.asarray(getattr(x, "_value", x))
+        if x.ndim == 1 and y.ndim > 1:
+            # broadcast the 1-D sample grid along `axis` (scipy semantics)
+            shape = [1] * y.ndim
+            shape[axis] = x.shape[0]
+            x = x.reshape(shape)
+        d = jnp.diff(x, axis=axis)
+    else:
+        d = dx if dx is not None else 1.0
+    ya = jax.lax.slice_in_dim(y, 0, y.shape[axis] - 1, axis=axis)
+    yb = jax.lax.slice_in_dim(y, 1, y.shape[axis], axis=axis)
+    avg = (ya + yb) / 2.0
+    return jnp.cumsum(avg * d, axis=axis)
+
+
+def add_n(inputs):
+    vals = [jnp.asarray(getattr(v, "_value", v)) for v in (
+        inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+    out = vals[0]
+    for v in vals[1:]:
+        out = out + v
+    return out
+
+
+def pdist(x, p=2.0):
+    """Condensed pairwise distance (reference pdist)."""
+    x = jnp.asarray(getattr(x, "_value", x))
+    n = x.shape[0]
+    iu, ju = jnp.triu_indices(n, k=1)
+    diff = x[iu] - x[ju]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)

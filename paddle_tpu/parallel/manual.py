@@ -173,6 +173,31 @@ def zero_adam_leaf_update(p, g, m_flat, v_flat, tf, *, lr, b1=0.9, b2=0.95,
     return p_new, m2, v2
 
 
+def vpp_block_layout(blk_specs, S: int, vpp: int, num_layers: int):
+    """Interleaved-schedule block layout shared by the model builders:
+    validates divisibility, inserts the chunk axis into each block spec
+    ([S, v, per_v, ...]), and returns a restacker mapping a
+    [S*v, per_v, ...] vs-major stack to [S, v, per_v, ...] where element
+    [s, c] holds virtual stage s + S*c (the layout
+    spmd_pipeline_interleaved expects)."""
+    if vpp <= 1:
+        return blk_specs, None
+    if num_layers % (S * vpp) != 0:
+        raise ValueError(
+            f"num_layers {num_layers} not divisible by pp*chunks "
+            f"{S}*{vpp}")
+    specs = {k: P(*(tuple(sp)[:1] + (None,) + tuple(sp)[1:]))
+             for k, sp in blk_specs.items()}
+
+    def restack(stacked):
+        return {n: jnp.transpose(
+                    val.reshape((vpp, S) + val.shape[1:]),
+                    (1, 0) + tuple(range(2, val.ndim + 1)))
+                for n, val in stacked.items()}
+
+    return specs, restack
+
+
 def pack_leaf(p_local, chunk: int, axis_name: str = SHARDING_AXIS):
     """Flat-shard a device-local param leaf over the sharding axis:
     keep only this device's ``chunk`` of the padded flat view (ZeRO

@@ -421,3 +421,74 @@ class TestStringTensor:
         assert list(st) == ["Hello", "World"]
         eq = st == pt.to_string_tensor(["Hello", "x"])
         np.testing.assert_array_equal(eq, [True, False])
+
+
+class TestTopLevelParity:
+    def test_reference_top_level_names_all_present(self):
+        import os
+        import re
+        import pytest
+        import paddle_tpu as pt
+        path = "/root/reference/python/paddle/__init__.py"
+        if not os.path.exists(path):
+            pytest.skip("reference tree not mounted")
+        ref_init = open(path).read()
+        m = re.search(r"__all__ = \[(.*?)\]", ref_init, re.S)
+        names = re.findall(r"'([a-zA-Z_][a-zA-Z0-9_]*)'", m.group(1))
+        missing = [n for n in names if not hasattr(pt, n)]
+        assert not missing, missing
+
+    def test_inplace_free_functions(self):
+        import numpy as np
+        import paddle_tpu as pt
+        x = pt.Tensor(np.array([[4.0, 9.0]], np.float32))
+        out = pt.sqrt_(x)
+        assert out is x
+        np.testing.assert_allclose(np.asarray(x._value), [[2.0, 3.0]])
+        pt.transpose_(x, [1, 0])
+        assert np.asarray(x._value).shape == (2, 1)
+        pt.uniform_(x, 0.0, 1.0)
+        v = np.asarray(x._value)
+        assert ((v >= 0) & (v <= 1)).all()
+
+    def test_new_tensor_ops(self):
+        import numpy as np
+        import paddle_tpu as pt
+        a = np.ones((2, 2), np.float32)
+        b = np.full((3, 3), 2.0, np.float32)
+        bd = np.asarray(pt.block_diag([pt.Tensor(a), pt.Tensor(b)])._value)
+        assert bd.shape == (5, 5) and bd[0, 0] == 1 and bd[4, 4] == 2
+        cp = np.asarray(pt.cartesian_prod(
+            [pt.Tensor(np.arange(2)), pt.Tensor(np.arange(3))])._value)
+        assert cp.shape == (6, 2)
+        ts = pt.tensor_split(pt.Tensor(np.arange(7)), 3)
+        assert [len(np.asarray(t._value)) for t in ts] == [3, 2, 2]
+        x = pt.Tensor(np.zeros((4, 4), np.float32))
+        ds = np.asarray(pt.diagonal_scatter(
+            x, pt.Tensor(np.ones(4, np.float32)))._value)
+        np.testing.assert_allclose(np.diag(ds), 1.0)
+        ss = np.asarray(pt.select_scatter(
+            x, pt.Tensor(np.full(4, 7.0, np.float32)), 0, 1)._value)
+        np.testing.assert_allclose(ss[1], 7.0)
+        uf = np.asarray(pt.unflatten(pt.Tensor(np.zeros((2, 6))), 1,
+                                     (2, -1))._value)
+        assert uf.shape == (2, 2, 3)
+        pd = np.asarray(pt.pdist(pt.Tensor(np.eye(3, dtype=np.float32)))
+                        ._value)
+        np.testing.assert_allclose(pd, np.sqrt(2.0), rtol=1e-6)
+
+    def test_misc_utilities(self):
+        import numpy as np
+        import paddle_tpu as pt
+        assert pt.is_tensor(pt.Tensor(np.ones(1)))
+        assert pt.is_floating_point(pt.Tensor(np.ones(1, np.float32)))
+        assert not pt.is_integer(pt.Tensor(np.ones(1, np.float32)))
+        with pt.LazyGuard():
+            pass
+        p = pt.create_parameter((3, 4))
+        assert np.asarray(p._value).shape == (3, 4)
+        reader = pt.batch(lambda: iter(range(5)), 2)
+        assert [len(b) for b in reader()] == [2, 2, 1]
+        st = pt.get_cuda_rng_state()
+        pt.set_cuda_rng_state(st)
+        pt.check_shape(pt.Tensor(np.ones((2, 3))), (2, -1))
