@@ -121,6 +121,8 @@ EXPLICIT = {
     "label_smooth": lambda d: ((np.full((4, 5), 0.2, d),), {}),
     "gammaincc": lambda d: ((_t((4, 5), d, positive=True),
                              _t((4, 5), d, positive=True)), {}),
+    "gammainc": lambda d: ((_t((4, 5), d, positive=True),
+                            _t((4, 5), d, positive=True)), {}),
     # shape/axis-arg ops
     "reshape": lambda d: ((_t((4, 6), d), (6, 4)), {}),
     "expand": lambda d: ((_t((1, 6), d), (4, 6)), {}),
