@@ -113,6 +113,10 @@ class GradNode:
 # Cache of jitted vjp executors, keyed by the op's exec_key.
 _vjp_cache: Dict[Any, Callable] = {}
 
+# create_graph path: recorded-vjp closures, keyed by (exec_key, diff_slots)
+# so run_op sees a stable fn identity (stable jit cache key) across steps.
+_recorded_vjp_cache: Dict[Any, Callable] = {}
+
 
 def _vjp_executor(node: GradNode) -> Callable:
     fn = _vjp_cache.get(node.exec_key)
@@ -137,7 +141,56 @@ def _accumulate(slot: Optional[jax.Array], g: jax.Array) -> jax.Array:
     return g if slot is None else slot + g
 
 
-def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
+def _node_grads_recorded(node: "GradNode", cts_flat):
+    """create_graph=True: compute ``node``'s input cotangents THROUGH the
+    eager dispatcher so the vjp computation is itself recorded on the tape
+    (recorded-vjp recursion — the TPU-native analog of the reference's
+    double-grad nodes, eager/general_grad.h).  Returns a list aligned with
+    node.in_tensors; None at non-differentiable slots."""
+    from .dispatch import run_op
+    from .tensor import Tensor
+
+    n_in = len(node.in_values)
+    diff_slots = tuple(
+        i for i, (t, v) in enumerate(zip(node.in_tensors, node.in_values))
+        if t is not None and jnp.issubdtype(jnp.asarray(v).dtype,
+                                            jnp.inexact))
+    if not diff_slots:
+        return [None] * n_in
+    # cache the closure by exec_key so run_op's fn-identity jit key repeats
+    # across steps (a fresh closure per backward would re-jit every grad op
+    # every iteration and grow the jit caches unboundedly)
+    cache_key = (node.exec_key, diff_slots) if node.exec_key is not None \
+        else None
+    vjp_fn = _recorded_vjp_cache.get(cache_key) if cache_key else None
+    if vjp_fn is None:
+        call = node.call
+        treedef = node.out_treedef
+
+        def vjp_fn(*flat):
+            in_vals = list(flat[:n_in])
+            cts = jax.tree.unflatten(treedef, list(flat[n_in:]))
+            _, vjp = jax.vjp(call, in_vals)
+            (gs,) = vjp(cts)
+            return tuple(gs[i] for i in diff_slots)
+
+        if cache_key is not None:
+            _recorded_vjp_cache[cache_key] = vjp_fn
+
+    args = [t if t is not None else v
+            for t, v in zip(node.in_tensors, node.in_values)]
+    args.extend(cts_flat)
+    out = run_op(node.name + "_grad", vjp_fn, tuple(args), {})
+    if isinstance(out, Tensor):
+        out = (out,)
+    grads: List[Optional["Tensor"]] = [None] * n_in
+    for slot, g in zip(diff_slots, out):
+        grads[slot] = g
+    return grads
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False,
+             create_graph: bool = False) -> None:
     """Run reverse-mode accumulation from ``tensors`` (usually a scalar loss),
     writing ``.grad`` on reachable leaf tensors with ``stop_gradient=False``.
 
@@ -164,6 +217,8 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
                     "grad can be implicitly created only for scalar outputs; "
                     f"got shape {t.shape}")
             g = jnp.ones(t.shape, t.dtype)
+        if create_graph and not isinstance(g, Tensor):
+            g = Tensor(g, stop_gradient=True)
         node, idx = t._node, t._out_index
         if node is None:
             if not t.stop_gradient:
@@ -173,8 +228,14 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
         slots = pending.setdefault(node.id, [None] * len(node.out_avals))
         slots[idx] = _accumulate(slots[idx], g)
 
+    if create_graph:
+        retain_graph = True          # grads-of-grads revisit saved primals
+
     for t, g in zip(tensors, grad_tensors):
-        seed(t, g._value if isinstance(g, Tensor) else g)
+        if create_graph:
+            seed(t, g)               # keep Tensor boxes (graph-linked)
+        else:
+            seed(t, g._value if isinstance(g, Tensor) else g)
 
     # Reverse creation order is a valid topological order for a define-by-run
     # DAG (producers always have smaller ids than consumers).
@@ -186,11 +247,16 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
             c if c is not None else jnp.zeros(a.shape, a.dtype)
             for c, a in zip(cts, node.out_avals)
         ]
-        grads = _vjp_executor(node)(node.in_values, cts_flat)
+        if create_graph:
+            grads = _node_grads_recorded(node, cts_flat)
+        else:
+            grads = _vjp_executor(node)(node.in_values, cts_flat)
         for t, g in zip(node.in_tensors, grads):
             if t is None or g is None:
                 continue
-            if getattr(g, "dtype", None) is not None and g.dtype == jax.dtypes.float0:
+            gv = g._value if isinstance(g, Tensor) else g
+            if getattr(gv, "dtype", None) is not None and \
+                    gv.dtype == jax.dtypes.float0:
                 continue
             if t._node is not None:
                 prod = t._node
@@ -209,15 +275,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph: bool = False,
          create_graph: bool = False, allow_unused: bool = False):
     """``paddle.grad``-style: returns grads of ``outputs`` wrt ``inputs``
     without touching ``.grad`` slots (reference: GeneralGrad,
-    eager/general_grad.h).  ``create_graph`` is not yet supported in eager
-    mode — use the functional API (``paddle_tpu.incubate.autograd``) for
-    higher order."""
+    eager/general_grad.h).  With ``create_graph=True`` the vjp computations
+    are themselves recorded on the tape (recorded-vjp recursion), so the
+    returned grads are differentiable — double-grad / gradient-penalty
+    training works exactly like the reference's eager double grad."""
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use functional jax.grad composition via "
-            "paddle_tpu.incubate.autograd")
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
     saved = [(t.grad, t._retain_grads, t.stop_gradient) for t in inputs]
     try:
@@ -225,7 +288,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph: bool = False,
             t.grad = None
             t._retain_grads = True
             t.stop_gradient = False
-        backward(outputs, grad_outputs, retain_graph=retain_graph)
+        backward(outputs, grad_outputs, retain_graph=retain_graph,
+                 create_graph=create_graph)
         out = []
         for t in inputs:
             if t.grad is None and not allow_unused:

@@ -154,6 +154,17 @@ class Tensor:
         return remove
 
     def _accumulate_grad(self, g) -> None:
+        if isinstance(g, Tensor) and g._node is not None:
+            # create_graph path: keep the graph-linked grad Tensor so the
+            # grad itself stays differentiable (double grad)
+            for hook in self._grad_hooks:
+                out = hook(g)
+                if out is not None:
+                    g = out
+            self.grad = g if self.grad is None else self.grad + g
+            return
+        if isinstance(g, Tensor):
+            g = g._value
         for hook in self._grad_hooks:
             out = hook(Tensor(g))
             if out is not None:
@@ -320,3 +331,36 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tenso
             place = Place(ty, int(idx or 0))
         v = jax.device_put(v, place.jax_device())
     return Tensor(v, stop_gradient=stop_gradient)
+
+
+def inplace_rebind(x: "Tensor", out: "Tensor") -> "Tensor":
+    """Make ``x`` observe in-place op result ``out`` (reference: inplace ops
+    + eager/tensor_wrapper.h inplace-version semantics).
+
+    The autograd node of ``out`` recorded ``x`` as an input box; rebinding
+    ``x`` to ``out`` would alias that input to the node's own output and
+    create a self-cycle in backward.  Snapshot the producer link into a
+    fresh box first, then rebind."""
+    node = getattr(out, "_node", None)
+    if node is not None and x._node is None and not x.stop_gradient:
+        # reference parity: in-place on a grad-requiring leaf is an error
+        # (the leaf's gradient would silently accumulate into the hidden
+        # pre-inplace snapshot and be dropped)
+        raise RuntimeError(
+            "a leaf Tensor with stop_gradient=False cannot be used in an "
+            "in-place operation; detach() it or wrap in no_grad()")
+    if node is not None and node.in_tensors is not None:
+        for i, t in enumerate(node.in_tensors):
+            if t is x:
+                snap = Tensor(x._value, stop_gradient=x.stop_gradient,
+                              name=x.name + ".preinplace")
+                snap._node = x._node
+                snap._out_index = x._out_index
+                snap._retain_grads = False
+                node.in_tensors[i] = snap
+    x._value = out._value
+    x._node = out._node
+    x._out_index = out._out_index
+    if not out.stop_gradient:
+        x.stop_gradient = False
+    return x

@@ -30,6 +30,10 @@ from .transform import (  # noqa: F401
     Transform,
 )
 from .kl import kl_divergence, register_kl  # noqa: F401
+from .multivariate_normal import MultivariateNormal  # noqa: F401
+from .lkj_cholesky import LKJCholesky  # noqa: F401
+from . import constraint  # noqa: F401
+from . import variable  # noqa: F401
 
 __all__ = [
     "Distribution", "ExponentialFamily", "Normal", "LogNormal", "Uniform",
@@ -41,5 +45,5 @@ __all__ = [
     "IndependentTransform", "PowerTransform", "ReshapeTransform",
     "SigmoidTransform", "SoftmaxTransform", "StackTransform",
     "StickBreakingTransform", "TanhTransform", "kl_divergence",
-    "register_kl",
+    "register_kl", "MultivariateNormal", "LKJCholesky",
 ]

@@ -1,0 +1,46 @@
+"""Value constraints for distribution supports (reference:
+python/paddle/distribution/constraint.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import _to_jnp, _wrap
+
+
+class Constraint:
+    def __call__(self, value):
+        raise NotImplementedError
+
+
+class Real(Constraint):
+    def __call__(self, value):
+        v = _to_jnp(value)
+        return _wrap(v == v)
+
+
+class Range(Constraint):
+    def __init__(self, lower, upper):
+        self._lower = lower
+        self._upper = upper
+
+    def __call__(self, value):
+        v = _to_jnp(value)
+        return _wrap((self._lower <= v) & (v <= self._upper))
+
+
+class Positive(Constraint):
+    def __call__(self, value):
+        return _wrap(_to_jnp(value) >= 0.0)
+
+
+class Simplex(Constraint):
+    def __call__(self, value):
+        v = _to_jnp(value)
+        return _wrap(jnp.all(v >= 0, axis=-1)
+                     & (jnp.abs(v.sum(-1) - 1.0) < 1e-6))
+
+
+real = Real()
+positive = Positive()
+simplex = Simplex()

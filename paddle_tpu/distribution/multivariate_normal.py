@@ -1,0 +1,119 @@
+"""MultivariateNormal (reference:
+python/paddle/distribution/multivariate_normal.py).
+
+Parameterized by one of covariance_matrix / precision_matrix / scale_tril;
+internally everything routes through the Cholesky factor L (TPU-friendly:
+triangular solves + one matmul per op, no explicit inverse).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _to_jnp, _wrap
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        given = sum(x is not None for x in
+                    (covariance_matrix, precision_matrix, scale_tril))
+        if given != 1:
+            raise ValueError("Exactly one of covariance_matrix / "
+                             "precision_matrix / scale_tril must be given")
+        self.loc = _to_jnp(loc)
+        if self.loc.ndim < 1:
+            raise ValueError("loc must be at least 1-D")
+        d = self.loc.shape[-1]
+
+        if scale_tril is not None:
+            st = _to_jnp(scale_tril)
+            self._unbroadcasted_scale_tril = jnp.tril(st)
+            self.scale_tril = st
+        elif covariance_matrix is not None:
+            cov = _to_jnp(covariance_matrix)
+            self._unbroadcasted_scale_tril = jnp.linalg.cholesky(cov)
+            self.covariance_matrix = cov
+        else:
+            prec = _to_jnp(precision_matrix)
+            # chol(P^-1) via the flipped-Cholesky identity: if P = U Uᵀ with
+            # U upper-tri (from reversing chol of reversed P), then
+            # Σ = P⁻¹ = U⁻ᵀ U⁻¹ and L = U⁻ᵀ is lower-tri.
+            lp = jnp.linalg.cholesky(prec[..., ::-1, ::-1])[..., ::-1, ::-1]
+            eye = jnp.eye(d, dtype=prec.dtype)
+            self._unbroadcasted_scale_tril = jnp.linalg.solve(
+                jnp.swapaxes(lp, -1, -2), eye)
+            self.precision_matrix = prec
+
+        batch = jnp.broadcast_shapes(
+            self.loc.shape[:-1], self._unbroadcasted_scale_tril.shape[:-2])
+        self.loc = jnp.broadcast_to(self.loc, batch + (d,))
+        self._unbroadcasted_scale_tril = jnp.broadcast_to(
+            self._unbroadcasted_scale_tril, batch + (d, d))
+        super().__init__(batch, (d,))
+
+    # -- moments ----------------------------------------------------------
+    @property
+    def mean(self):
+        return _wrap(self.loc)
+
+    @property
+    def variance(self):
+        return _wrap(jnp.sum(jnp.square(self._unbroadcasted_scale_tril),
+                             axis=-1))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.sqrt(jnp.sum(
+            jnp.square(self._unbroadcasted_scale_tril), axis=-1)))
+
+    # -- sampling ---------------------------------------------------------
+    def _rsample(self, shape, key):
+        out = tuple(shape) + self.batch_shape + self.event_shape
+        eps = jax.random.normal(key, out, self.loc.dtype)
+        return self.loc + jnp.einsum(
+            "...ij,...j->...i", self._unbroadcasted_scale_tril, eps)
+
+    # -- density ----------------------------------------------------------
+    def _mahalanobis_sq(self, value):
+        diff = value - self.loc
+        L = jnp.broadcast_to(self._unbroadcasted_scale_tril,
+                             diff.shape[:-1] + self._unbroadcasted_scale_tril
+                             .shape[-2:])
+        z = jax.scipy.linalg.solve_triangular(L, diff[..., None], lower=True)
+        return jnp.sum(jnp.square(z[..., 0]), axis=-1)
+
+    def _half_log_det(self):
+        return jnp.sum(jnp.log(jnp.diagonal(
+            self._unbroadcasted_scale_tril, axis1=-2, axis2=-1)), axis=-1)
+
+    def _log_prob(self, value):
+        d = self.event_shape[0]
+        return (-0.5 * (d * _LOG_2PI + self._mahalanobis_sq(value))
+                - self._half_log_det())
+
+    def _entropy(self):
+        d = self.event_shape[0]
+        return jnp.broadcast_to(
+            0.5 * d * (1.0 + _LOG_2PI) + self._half_log_det(),
+            self.batch_shape)
+
+    def kl_divergence(self, other):
+        """KL(self || other) for two MVNs (reference
+        multivariate_normal.py kl_divergence)."""
+        if not isinstance(other, MultivariateNormal):
+            raise TypeError("kl_divergence expects MultivariateNormal")
+        d = self.event_shape[0]
+        l_p = self._unbroadcasted_scale_tril
+        l_q = other._unbroadcasted_scale_tril
+        # tr(Σq⁻¹ Σp) = ||Lq⁻¹ Lp||_F²
+        m = jax.scipy.linalg.solve_triangular(l_q, l_p, lower=True)
+        tr = jnp.sum(jnp.square(m), axis=(-2, -1))
+        mah = other._mahalanobis_sq(self.loc)
+        logdet = 2.0 * (other._half_log_det() - self._half_log_det())
+        return _wrap(0.5 * (tr + mah - d + logdet))

@@ -1,0 +1,75 @@
+"""Random-variable descriptors: event dimensionality + support constraint
+(reference: python/paddle/distribution/variable.py)."""
+
+from __future__ import annotations
+
+from . import constraint
+
+
+class Variable:
+    def __init__(self, is_discrete=False, event_rank=0, constraint=None):
+        self._is_discrete = is_discrete
+        self._event_rank = event_rank
+        self._constraint = constraint
+
+    @property
+    def is_discrete(self):
+        return self._is_discrete
+
+    @property
+    def event_rank(self):
+        return self._event_rank
+
+    def constraint(self, value):
+        return self._constraint(value)
+
+
+class Real(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, constraint.real)
+
+
+class Positive(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, constraint.positive)
+
+
+class Independent(Variable):
+    """Reinterprets batch dims of a base variable as event dims
+    (reference: variable.py:72)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._reinterpreted_batch_rank = reinterpreted_batch_rank
+        super().__init__(
+            base.is_discrete,
+            base.event_rank + reinterpreted_batch_rank)
+
+    def constraint(self, value):
+        return self._base.constraint(value)
+
+
+class Stack(Variable):
+    def __init__(self, vars, axis=0):
+        self._vars = vars
+        self._axis = axis
+        super().__init__(
+            any(v.is_discrete for v in vars),
+            max(v.event_rank for v in vars))
+
+    @property
+    def is_discrete(self):
+        return self._is_discrete
+
+    def constraint(self, value):
+        import jax.numpy as jnp
+        from .distribution import _to_jnp, _wrap
+        v = _to_jnp(value)
+        parts = jnp.split(v, len(self._vars), axis=self._axis)
+        outs = [_to_jnp(var.constraint(p))
+                for var, p in zip(self._vars, parts)]
+        return _wrap(jnp.concatenate(outs, axis=self._axis))
+
+
+real = Real()
+positive = Positive()

@@ -7,3 +7,4 @@ from .attr import ParamAttr  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .layer import *  # noqa: F401,F403
 from .layer.layers import Layer, functional_call, functional_call_with_buffers, functional_state, state_arrays  # noqa: F401
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401

@@ -43,3 +43,26 @@ from .attention import (  # noqa: F401
     flash_attention, flash_attn_unpadded, scaled_dot_product_attention,
     sequence_mask,
 )
+
+# ---- round-3 API tail (VERDICT r2 item 5) ----
+from .loss import (  # noqa: F401
+    adaptive_log_softmax_with_loss, dice_loss, hsigmoid_loss,
+    margin_cross_entropy, npair_loss,
+)
+from .attention import (  # noqa: F401
+    flash_attn_qkvpacked, flash_attn_varlen_qkvpacked, sparse_attention,
+    flash_attention_with_sparse_mask,
+)
+from .pooling import (  # noqa: F401
+    fractional_max_pool2d, fractional_max_pool3d, lp_pool1d, lp_pool2d,
+    max_unpool1d, max_unpool2d, max_unpool3d,
+)
+from .vision import (  # noqa: F401
+    affine_grid, grid_sample, temporal_shift,
+)
+from .common import (  # noqa: F401
+    class_center_sample, feature_alpha_dropout, gather_tree, zeropad2d,
+)
+from ._inplace import (  # noqa: F401
+    elu_, hardtanh_, leaky_relu_, relu_, softmax_, tanh_, thresholded_relu_,
+)

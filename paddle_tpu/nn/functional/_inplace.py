@@ -1,0 +1,41 @@
+"""In-place functional activation variants (reference:
+python/paddle/nn/functional/activation.py relu_ / softmax_ / ...).
+
+TPU tensors are immutable jax.Arrays; "in-place" here means rebinding the
+Tensor box's value/autograd node — same API contract as the reference's
+inplace ops (the input Tensor observes the new value), zero-copy under jit.
+"""
+
+from ...core.tensor import inplace_rebind as _rebind
+from ...ops import api as _api
+
+
+def relu_(x, name=None):
+    return _rebind(x, _api.relu(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    return _rebind(x, _api.elu(x, alpha))
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    return _rebind(x, _api.hardtanh(x, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    return _rebind(x, _api.leaky_relu(x, negative_slope))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = _api.softmax(x, axis)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return _rebind(x, out)
+
+
+def tanh_(x, name=None):
+    return _rebind(x, _api.tanh(x))
+
+
+def thresholded_relu_(x, threshold=1.0, name=None):
+    return _rebind(x, _api.thresholded_relu(x, threshold))

@@ -255,3 +255,102 @@ def bilinear(x1, x2, weight, bias=None):
         return out
 
     return run_op("bilinear", impl, (x1, x2, weight, bias), {})
+
+
+# ---------------------------------------------------------------------------
+# round-3 API tail (VERDICT r2 item 5)
+# ---------------------------------------------------------------------------
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad H/W dims; padding = [left, right, top, bottom] (reference:
+    nn/functional/common.py zeropad2d → pad3d kernel)."""
+    l, r, t, b = (int(v) for v in padding)
+
+    def impl(xv):
+        if data_format == "NCHW":
+            cfg = ((0, 0), (0, 0), (t, b), (l, r))
+        else:
+            cfg = ((0, 0), (t, b), (l, r), (0, 0))
+        return jnp.pad(xv, cfg)
+
+    return run_op("zeropad2d", impl, (x,), {})
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout that drops whole channels (reference:
+    nn/functional/common.py feature_alpha_dropout; SELU-preserving noise)."""
+    if not training or p == 0.0:
+        from ...ops import api as _api
+        return _api.assign(x)
+    from ...core.rng import next_rng_key
+    key = next_rng_key()
+
+    def impl(xv, k):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        shape = (xv.shape[0], xv.shape[1]) + (1,) * (xv.ndim - 2)
+        keep = jax.random.bernoulli(k, 1.0 - p, shape)
+        a = jnp.power((1.0 - p) * (1.0 + p * alpha_p ** 2), -0.5)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, xv, alpha_p) + b).astype(xv.dtype)
+
+    return run_op("feature_alpha_dropout", impl, (x, key), {})
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference: nn/functional/extension.py:149 →
+    phi gather_tree kernel).  ids/parents: [max_time, batch, beam]; walk
+    parent pointers from the last step backwards via ``lax.scan``."""
+
+    def impl(idv, par):
+        t = idv.shape[0]
+        batch = idv.shape[1]
+        beam = idv.shape[2]
+        bidx = jnp.arange(batch)[:, None]
+        bidx = jnp.broadcast_to(bidx, (batch, beam))
+
+        def step(carry, xs):
+            beam_ptr = carry                        # [batch, beam]
+            ids_t, par_t = xs                       # each [batch, beam]
+            out = ids_t[bidx, beam_ptr]
+            nxt = par_t[bidx, beam_ptr]
+            return nxt, out
+
+        init = jnp.broadcast_to(jnp.arange(beam)[None, :], (batch, beam))
+        # scan from the last time step backwards
+        _, outs = jax.lax.scan(step, init, (idv[::-1], par[::-1]))
+        return outs[::-1]
+
+    return run_op("gather_tree", impl, (ids, parents), {})
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """PartialFC class-center sampling (reference:
+    nn/functional/common.py:2360).  Keeps every positive class center,
+    fills to ``num_samples`` with uniformly sampled negatives, remaps
+    labels to the compacted id space.  Host-side (data-dependent output
+    size) — eager only, like the reference's CPU path."""
+    import numpy as np
+    from ...core.tensor import Tensor
+    from ...core.rng import next_rng_key
+    import jax.random as jrandom
+
+    lab = np.asarray(label._value if isinstance(label, Tensor) else label)
+    lab = lab.reshape(-1)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos,
+                                assume_unique=True)
+        k = next_rng_key()
+        perm = np.asarray(jrandom.permutation(k, len(neg_pool)))
+        fill = neg_pool[perm[: num_samples - len(pos)]]
+        sampled = np.sort(np.concatenate([pos, fill]))
+    remap = np.full((num_classes,), -1, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    remapped = remap[lab]
+    return (Tensor(jnp.asarray(remapped), stop_gradient=True),
+            Tensor(jnp.asarray(sampled.astype(np.int64)),
+                   stop_gradient=True))

@@ -215,3 +215,181 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
 def adaptive_max_pool3d(x, output_size, return_mask=False, data_format="NCDHW"):
     return run_op("adaptive_max_pool3d", lambda x: _adaptive_pool(
         x, output_size, 3, data_format == "NDHWC", "max"), (x,), {})
+
+
+# ---------------------------------------------------------------------------
+# round-3 API tail (VERDICT r2 item 5)
+# ---------------------------------------------------------------------------
+
+def _lp_pool(x, norm_type, ksize, stride, padding, n, channel_last,
+             ceil_mode):
+    """Power-average pooling: (sum |x|^p)^(1/p) over the window (reference:
+    nn/functional/pooling.py:2403 lp_pool1d / :2534 lp_pool2d)."""
+    def impl(xv):
+        p = float(norm_type)
+        dims, strides = _window(xv.ndim, ksize, stride, n, channel_last)
+        pads = _pads(padding, n, channel_last, xv.ndim)
+        if ceil_mode:
+            pads = _apply_ceil(pads, xv.shape, ksize, stride, n, channel_last)
+        if p == float("inf"):
+            neg = -jnp.inf
+            return jax.lax.reduce_window(jnp.abs(xv), neg, jax.lax.max,
+                                         dims, strides, pads)
+        # reference kernel uses x^p with NO abs (funcs/pooling.h LPPool
+        # 'powf(x, norm_type)'); negative inputs propagate sign/NaN as there
+        powed = jnp.power(xv, p)
+        summed = jax.lax.reduce_window(powed, jnp.asarray(0, xv.dtype),
+                                       jax.lax.add, dims, strides, pads)
+        return jnp.power(summed, 1.0 / p)
+
+    return run_op("lp_pool", impl, (x,), {})
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    ks = _tup(kernel_size, 1)
+    st = ks if stride is None else _tup(stride, 1)
+    return _lp_pool(x, norm_type, ks, st, padding, 1,
+                    data_format == "NLC", ceil_mode)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    ks = _tup(kernel_size, 2)
+    st = ks if stride is None else _tup(stride, 2)
+    return _lp_pool(x, norm_type, ks, st, padding, 2,
+                    data_format == "NHWC", ceil_mode)
+
+
+def _max_unpool(x, indices, ksize, stride, padding, n, output_size,
+                data_format):
+    """Scatter pooled values back to the argmax positions (reference:
+    nn/functional/pooling.py:750/873/1005 → phi unpool kernels).  `indices`
+    are the flat spatial indices produced by max_poolNd(return_mask=True)."""
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    if channel_last:
+        raise ValueError("max_unpool supports channel-first layouts only "
+                         "(matches reference NCL/NCHW/NCDHW)")
+
+    def impl(xv, idx):
+        in_spatial = xv.shape[2:]
+        if output_size is not None:
+            out_spatial = tuple(int(s) for s in output_size)[-n:]
+        else:
+            out_spatial = tuple(
+                (i - 1) * s - 2 * p + k for i, s, p, k in zip(
+                    in_spatial, stride, _tup(padding, n), ksize))
+        nb, c = xv.shape[:2]
+        flat_out = int(np.prod(out_spatial))
+        xflat = xv.reshape(nb, c, -1)
+        iflat = idx.reshape(nb, c, -1).astype(jnp.int32)
+        out = jnp.zeros((nb, c, flat_out), xv.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda o, i, v: o.at[i].set(v)))(out, iflat, xflat)
+        return out.reshape((nb, c) + out_spatial)
+
+    return run_op("max_unpool", impl, (x, indices), {})
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    ks = _tup(kernel_size, 1)
+    st = ks if stride is None else _tup(stride, 1)
+    return _max_unpool(x, indices, ks, st, padding, 1, output_size,
+                       data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    ks = _tup(kernel_size, 2)
+    st = ks if stride is None else _tup(stride, 2)
+    return _max_unpool(x, indices, ks, st, padding, 2, output_size,
+                       data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    ks = _tup(kernel_size, 3)
+    st = ks if stride is None else _tup(stride, 3)
+    return _max_unpool(x, indices, ks, st, padding, 3, output_size,
+                       data_format)
+
+
+def _fractional_regions(in_size, out_size, kernel, u):
+    """Fractional pooling split points (reference:
+    nn/functional/pooling.py:2087 formula; phi funcs/pooling.h:139):
+    start = ceil(alpha*(i+u) - 1), end = ceil(alpha*(i+1+u) - 1)."""
+    alpha = in_size / out_size
+    starts, ends = [], []
+    for i in range(out_size):
+        s = int(np.ceil(alpha * (i + u) - 1.0))
+        e = int(np.ceil(alpha * (i + 1 + u) - 1.0))
+        s = max(0, min(s, in_size - 1))
+        if kernel:
+            e = min(s + kernel, in_size)
+        e = max(s + 1, min(e, in_size))
+        starts.append(s)
+        ends.append(e)
+    return starts, ends
+
+
+def _fractional_max_pool(x, output_size, kernel_size, random_u, return_mask,
+                         n):
+    if random_u is None:
+        from ...core.rng import next_rng_key
+        import jax.random as jrandom
+        u = float(jrandom.uniform(next_rng_key(), ()))
+    else:
+        u = float(random_u)
+        if not (0 < u < 1):
+            raise ValueError("random_u must be in (0, 1)")
+    out_sz = _tup(output_size, n)
+    ker = _tup(kernel_size, n) if kernel_size is not None else (None,) * n
+
+    def impl(xv):
+        spatial = xv.shape[2:]
+        regions = [
+            _fractional_regions(spatial[d], out_sz[d], ker[d], u)
+            for d in range(n)]
+        # gather max per (cartesian) region; python loops run at trace
+        # time over static out sizes — XLA sees only slices + maxes
+        sizes = spatial
+        flat_idx = jnp.arange(int(np.prod(sizes))).reshape(sizes)
+        outs = np.empty(tuple(out_sz), object)
+        idxs = np.empty(tuple(out_sz), object)
+        for pos in np.ndindex(*out_sz):
+            sl = tuple(slice(regions[d][0][pos[d]], regions[d][1][pos[d]])
+                       for d in range(n))
+            region = xv[(slice(None), slice(None)) + sl]
+            red = tuple(range(2, 2 + n))
+            m = jnp.max(region, axis=red)
+            outs[pos] = m
+            if return_mask:
+                rflat = region.reshape(region.shape[:2] + (-1,))
+                am = jnp.argmax(rflat, axis=-1)
+                ridx = flat_idx[sl].reshape(-1)
+                idxs[pos] = jnp.take(ridx, am)
+        out = jnp.stack([outs[p] for p in np.ndindex(*out_sz)], -1)
+        out = out.reshape(out.shape[:2] + tuple(out_sz))
+        if not return_mask:
+            return out
+        idx = jnp.stack([idxs[p] for p in np.ndindex(*out_sz)], -1)
+        idx = idx.reshape(idx.shape[:2] + tuple(out_sz))
+        return out, idx
+
+    return run_op("fractional_max_pool", impl, (x,), {})
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling 2D (reference: nn/functional/pooling.py:2087,
+    Graham 2015)."""
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 2)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling 3D (reference: nn/functional/pooling.py:2242)."""
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 3)

@@ -1,0 +1,151 @@
+"""Vision functional ops (reference: python/paddle/nn/functional/vision.py
+-> phi affine_grid / grid_sample kernels).  Pure-jnp gather formulations —
+XLA fuses the index arithmetic; no CUDA texture units needed on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op
+
+
+def _unnormalize(coord, size, align_corners):
+    """Map [-1, 1] grid coords to pixel space (vision.py:140 grid_sample)."""
+    if align_corners:
+        return (coord + 1.0) * 0.5 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) * 0.5
+
+
+def _reflect(coord, low, high):
+    """Reflection padding: fold coordinates into [low, high] by reflecting
+    at the boundaries (phi grid_sample_utils reflect semantics)."""
+    span = high - low
+    if span <= 0:
+        return jnp.zeros_like(coord)
+    coord = jnp.abs(coord - low) % (2 * span)
+    return low + jnp.where(coord > span, 2 * span - coord, coord)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D/3D affine sampling grid (reference: nn/functional/vision.py:38).
+
+    theta [N,2,3] -> grid [N,H,W,2]; theta [N,3,4] -> grid [N,D,H,W,3].
+    """
+    def impl(th):
+        shape = [int(s) for s in np.asarray(out_shape).reshape(-1)]
+        nd = 2 if th.shape[-2:] == (2, 3) else 3
+        spatial = shape[2:]            # (H, W) or (D, H, W)
+
+        def axis_coords(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size, dtype=th.dtype)
+            step = 2.0 / size
+            return -1.0 + step / 2 + step * jnp.arange(size, dtype=th.dtype)
+
+        if nd == 2:
+            h, w = spatial
+            ys, xs = jnp.meshgrid(axis_coords(h), axis_coords(w),
+                                  indexing="ij")
+            base = jnp.stack([xs, ys, jnp.ones_like(xs)], -1)  # [H,W,3]
+            grid = jnp.einsum("hwk,nck->nhwc", base, th)       # [N,H,W,2]
+        else:
+            d, h, w = spatial
+            zs, ys, xs = jnp.meshgrid(axis_coords(d), axis_coords(h),
+                                      axis_coords(w), indexing="ij")
+            base = jnp.stack([xs, ys, zs, jnp.ones_like(xs)], -1)
+            grid = jnp.einsum("dhwk,nck->ndhwc", base, th)
+        return grid
+
+    return run_op("affine_grid", impl, (theta,), {})
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x at grid locations (reference: nn/functional/vision.py:140,
+    phi/kernels/cpu/grid_sample_kernel.cc).  4-D: x [N,C,H,W], grid
+    [N,Ho,Wo,2]; 5-D: x [N,C,D,H,W], grid [N,Do,Ho,Wo,3]."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode must be bilinear|nearest, "
+                         f"got {mode}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"bad padding_mode {padding_mode}")
+
+    def impl(xv, gv):
+        nd = xv.ndim - 2
+        sizes = xv.shape[2:]                       # spatial, slow→fast
+        # grid stores (x, y[, z]) = fast→slow axes; flip to match sizes
+        coords = [gv[..., i] for i in range(nd)][::-1]
+        pix = []
+        for c, s in zip(coords, sizes):
+            p = _unnormalize(c.astype(jnp.float32), s, align_corners)
+            if padding_mode == "border":
+                p = jnp.clip(p, 0, s - 1)
+            elif padding_mode == "reflection":
+                p = _reflect(p, 0.0 if align_corners else -0.5,
+                             (s - 1.0) if align_corners else (s - 0.5))
+                p = jnp.clip(p, 0, s - 1)
+            pix.append(p)
+
+        def gather(idx_list):
+            """x[n, :, i0, i1, ...] with zero padding outside."""
+            valid = jnp.ones(idx_list[0].shape, dtype=bool)
+            clipped = []
+            for i, s in zip(idx_list, sizes):
+                valid &= (i >= 0) & (i <= s - 1)
+                clipped.append(jnp.clip(i, 0, s - 1).astype(jnp.int32))
+            n = xv.shape[0]
+            bidx = jnp.arange(n).reshape((n,) + (1,) * (gv.ndim - 2))
+            bidx = jnp.broadcast_to(bidx, clipped[0].shape)
+            xs = jnp.moveaxis(xv, 1, -1)           # [N, *spatial, C]
+            out = xs[(bidx,) + tuple(clipped)]     # [N, out..., C]
+            out = jnp.where(valid[..., None], out, 0.0)
+            return out, valid
+
+        if mode == "nearest":
+            idx = [jnp.floor(p + 0.5) for p in pix]
+            out, _ = gather(idx)
+        else:
+            lo = [jnp.floor(p) for p in pix]
+            frac = [p - l for p, l in zip(pix, lo)]
+            out = 0.0
+            for corner in range(2 ** nd):
+                idx, w = [], 1.0
+                for a in range(nd):
+                    hi_bit = (corner >> a) & 1
+                    idx.append(lo[a] + hi_bit)
+                    w = w * (frac[a] if hi_bit else (1.0 - frac[a]))
+                g, _ = gather(idx)
+                out = out + g * w[..., None]
+        out = jnp.moveaxis(out, -1, 1)             # [N, C, out...]
+        return out.astype(xv.dtype)
+
+    return run_op("grid_sample", impl, (x, grid), {})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """Temporal Shift Module (reference: nn/functional/extension.py:247,
+    phi/kernels/impl/temporal_shift_kernel_impl.h)."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"bad data_format {data_format}")
+
+    def impl(xv):
+        v = jnp.moveaxis(xv, -1, 1) if data_format == "NHWC" else xv
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        pad = jnp.pad(v, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+        slice1 = pad[:, :seg_num, :c1]             # shift left  (past)
+        slice2 = pad[:, 2:seg_num + 2, c1:c2]      # shift right (future)
+        slice3 = pad[:, 1:seg_num + 1, c2:]        # no shift
+        out = jnp.concatenate([slice1, slice2, slice3], 2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return run_op("temporal_shift", impl, (x,), {})

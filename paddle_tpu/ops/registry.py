@@ -79,14 +79,10 @@ def _make_wrapper(op: OpDef, raw: Callable) -> Callable:
 
 
 def _make_inplace(op: OpDef, wrapper: Callable) -> Callable:
+    from ..core.tensor import inplace_rebind
+
     def inplace(self, *args, **kwargs):
-        out = wrapper(self, *args, **kwargs)
-        self._value = out._value
-        self._node = out._node
-        self._out_index = out._out_index
-        if not out.stop_gradient:
-            self.stop_gradient = False
-        return self
+        return inplace_rebind(self, wrapper(self, *args, **kwargs))
 
     inplace.__name__ = op.name + "_"
     return inplace

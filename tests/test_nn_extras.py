@@ -131,6 +131,8 @@ class TestAdaptiveBeam:
         np.testing.assert_array_equal(pred, lp.argmax(-1))
 
     def test_beam_search_decoder(self):
+        # reference-parity route: BeamSearchDecoder + dynamic_decode
+        # (nn/decode.py; replaces the round-2 stand-in .decode() API)
         cell = nn.GRUCell(4, 8)
         proj = nn.Linear(8, 10)
         emb = nn.Embedding(10, 4)
@@ -138,9 +140,10 @@ class TestAdaptiveBeam:
             cell, start_token=1, end_token=2, beam_size=3,
             embedding_fn=emb, output_fn=proj)
         h0 = pt.Tensor(np.zeros((1, 8), np.float32))
-        seqs, scores = dec.decode(h0, max_steps=5)
-        assert seqs.shape[0] == 3 and seqs.shape[1] >= 2
-        assert np.isfinite(scores).all()
+        ids, _, lens = nn.dynamic_decode(dec, inits=h0, max_step_num=5,
+                                         return_length=True)
+        assert _np(ids).shape[0] == 1 and _np(ids).shape[2] == 3
+        assert np.isfinite(_np(lens)).all()
 
     def test_unflatten_feature_dropout(self):
         x = rng.normal(size=(2, 6)).astype(np.float32)

@@ -1,0 +1,37 @@
+"""TPU chip-acquisition probe (VERDICT r2 item 1).
+
+Runs ``jax.devices()`` in a subprocess under a wall-clock timeout and
+appends a timestamped JSON line to ``tools/tpu_probe.log``. Run this
+repeatedly through the round; the log is the evidence trail either way.
+"""
+import json, os, subprocess, sys, time
+
+LOG = os.path.join(os.path.dirname(__file__), "tpu_probe.log")
+SNIPPET = (
+    "import jax, json;"
+    "d = jax.devices();"
+    "print(json.dumps({'platform': d[0].platform, 'n': len(d),"
+    " 'kind': getattr(d[0], 'device_kind', '?')}))"
+)
+
+def probe(timeout=240):
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", SNIPPET], capture_output=True,
+            text=True, timeout=timeout)
+        ok = out.returncode == 0
+        detail = (out.stdout.strip().splitlines() or ["?"])[-1] if ok \
+            else (out.stderr.strip().splitlines() or ["?"])[-1]
+    except subprocess.TimeoutExpired:
+        ok, detail = False, f"timeout after {timeout}s (jax.devices() blocked)"
+    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "ok": ok, "elapsed_s": round(time.time() - t0, 1),
+           "detail": detail}
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+    return ok
+
+if __name__ == "__main__":
+    probe(int(sys.argv[1]) if len(sys.argv) > 1 else 240)
