@@ -1,4 +1,5 @@
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .worker import WorkerInfo, get_worker_info  # noqa: F401
 from .dataset import (  # noqa: F401
     ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset,
     Subset, TensorDataset, random_split,

@@ -124,6 +124,11 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._pool = None                 # lazily-built WorkerPool
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if self._iterable_ds:
             self.batch_sampler = None
@@ -142,8 +147,50 @@ class DataLoader:
         return len(self.batch_sampler)
 
     # ------------------------------------------------------------------
+    def _get_pool(self):
+        from .worker import WorkerPool
+        if self._pool is None:
+            self._pool = WorkerPool(
+                self.dataset, self.collate_fn, self.num_workers,
+                use_shared_memory=self.use_shared_memory,
+                worker_init_fn=self.worker_init_fn, timeout=self.timeout,
+                iterable=self._iterable_ds)
+        return self._pool
+
+    def _release_pool(self):
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self._release_pool()
+        except Exception:
+            pass
+
     def _produce_batches(self):
-        if self._iterable_ds:
+        if self.num_workers > 0:
+            # subprocess workers (reference reader.py:262 multiprocess
+            # mode): index-fed, shared-memory transport, sampler order
+            pool = self._get_pool()
+            if self._iterable_ds:
+                # each worker owns a stream shard (get_worker_info-style);
+                # feed per-worker batch-size tasks round-robin
+                def sizes():
+                    while True:
+                        yield self.batch_size
+                index_iter = sizes()
+            else:
+                index_iter = iter(self.batch_sampler)
+            try:
+                yield from pool.run_epoch(index_iter, self.prefetch_factor,
+                                          drop_last=(self.drop_last
+                                                     if self._iterable_ds
+                                                     else False))
+            finally:
+                if not self.persistent_workers:
+                    self._release_pool()
+        elif self._iterable_ds:
             it = iter(self.dataset)
             while True:
                 batch = list(itertools.islice(it, self.batch_size))
@@ -151,13 +198,6 @@ class DataLoader:
                                  and len(batch) < self.batch_size):
                     return
                 yield self.collate_fn(batch)
-        elif self.num_workers > 0:
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(self.num_workers) as pool:
-                def fetch(indices):
-                    return self.collate_fn([self.dataset[i] for i in indices])
-                for batch in pool.map(fetch, iter(self.batch_sampler)):
-                    yield batch
         else:
             for indices in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in indices])
@@ -179,6 +219,14 @@ class DataLoader:
         return conv(batch)
 
     def __iter__(self):
+        if self.num_workers > 0:
+            # fork the worker pool from the MAIN thread (forking from the
+            # prefetch thread deadlocks: the child inherits locks held by
+            # sibling threads — queue feeders, jax internals).  The pool
+            # prefetches across processes itself, so the extra thread
+            # prefetcher adds nothing here.
+            self._get_pool()
+            return (self._to_tensors(b) for b in self._produce_batches())
         if self.use_buffer_reader:
             return _PrefetchIterator(self._produce_batches,
                                      self.prefetch_factor * max(

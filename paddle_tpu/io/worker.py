@@ -1,0 +1,342 @@
+"""Multiprocess DataLoader workers (reference: python/paddle/io/reader.py:262
++ python/paddle/io/dataloader/worker.py — subprocess workers, worker seeds,
+shared-memory batch transport, persistent_workers).
+
+Design: a shared index queue feeds forked worker processes; each worker maps
+``indices -> collate_fn([dataset[i]])`` with NumPy only (no JAX in workers —
+the device belongs to the trainer process), ships the batch back over a
+result queue, large arrays riding POSIX shared memory instead of the pipe.
+The parent reorders by batch index so iteration order matches the sampler.
+Forked workers + SHM is the TPU-host analog of the reference's C++ shared
+-memory LoDTensor transport (use_shared_memory=True default there too).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue as _queue
+import sys
+import traceback
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+__all__ = ["get_worker_info", "WorkerInfo"]
+
+_SHM_MIN_BYTES = 1 << 16          # arrays smaller than 64 KiB ride the pipe
+
+
+@dataclass
+class WorkerInfo:
+    """Visible to dataset code inside a worker (reference:
+    io/dataloader/worker.py WorkerInfo: id/num_workers/seed/dataset)."""
+    id: int
+    num_workers: int
+    seed: int
+    dataset: Any
+
+
+_worker_info: Optional[WorkerInfo] = None
+
+
+def get_worker_info() -> Optional[WorkerInfo]:
+    """None in the main process; the worker's WorkerInfo inside a worker
+    (reference: paddle.io.get_worker_info)."""
+    return _worker_info
+
+
+class _ExcInfo:
+    """Picklable carrier for a worker-side exception."""
+
+    def __init__(self, exc: BaseException):
+        self.type_name = type(exc).__name__
+        self.msg = str(exc)
+        self.tb = traceback.format_exc()
+
+    def reraise(self):
+        raise RuntimeError(
+            f"DataLoader worker raised {self.type_name}: {self.msg}\n"
+            f"--- worker traceback ---\n{self.tb}")
+
+
+# ---------------------------------------------------------------------------
+# shared-memory batch transport
+# ---------------------------------------------------------------------------
+
+def _shm_pack(obj, segments):
+    """Replace large ndarrays with shared-memory descriptors; collect the
+    created segments so the worker can close its handles after send."""
+    from multiprocessing import shared_memory
+
+    if isinstance(obj, np.ndarray) and obj.nbytes >= _SHM_MIN_BYTES:
+        seg = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        np.ndarray(obj.shape, obj.dtype, buffer=seg.buf)[...] = obj
+        segments.append(seg)
+        return ("__shm__", seg.name, obj.shape, str(obj.dtype))
+    if isinstance(obj, tuple):
+        return tuple(_shm_pack(v, segments) for v in obj)
+    if isinstance(obj, list):
+        return [_shm_pack(v, segments) for v in obj]
+    if isinstance(obj, dict):
+        return {k: _shm_pack(v, segments) for k, v in obj.items()}
+    return obj
+
+
+def _shm_unpack(obj):
+    from multiprocessing import shared_memory
+
+    if isinstance(obj, tuple):
+        if len(obj) == 4 and obj[0] == "__shm__":
+            _, name, shape, dtype = obj
+            seg = shared_memory.SharedMemory(name=name)
+            try:
+                return np.ndarray(shape, np.dtype(dtype),
+                                  buffer=seg.buf).copy()
+            finally:
+                seg.close()
+                try:
+                    seg.unlink()
+                except FileNotFoundError:
+                    pass
+        return tuple(_shm_unpack(v) for v in obj)
+    if isinstance(obj, list):
+        return [_shm_unpack(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: _shm_unpack(v) for k, v in obj.items()}
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# worker loop
+# ---------------------------------------------------------------------------
+
+def _worker_loop(dataset, collate_fn, index_q, result_q, worker_id,
+                 num_workers, base_seed, worker_init_fn, use_shared_memory,
+                 iterable):
+    global _worker_info
+    seed = base_seed + worker_id
+    np.random.seed(seed % (2 ** 32))
+    import random
+    random.seed(seed)
+    _worker_info = WorkerInfo(worker_id, num_workers, seed, dataset)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        ds_iter = None
+        cur_epoch = -1
+        while True:
+            task = index_q.get()
+            if task is None:
+                break
+            bidx, indices, epoch, drop_last = task
+            try:
+                if iterable:
+                    if epoch != cur_epoch:
+                        # fresh stream per epoch (persistent_workers keeps
+                        # the process; the reference re-creates the
+                        # iterator each epoch too)
+                        ds_iter = iter(dataset)
+                        cur_epoch = epoch
+                    batch = []
+                    for _ in range(indices):          # indices = batch size
+                        try:
+                            batch.append(next(ds_iter))
+                        except StopIteration:
+                            break
+                    if not batch or (drop_last and len(batch) < indices):
+                        result_q.put((bidx, "__iter_end__", worker_id))
+                        continue
+                    out = collate_fn(batch)
+                else:
+                    out = collate_fn([dataset[i] for i in indices])
+                segments = []
+                if use_shared_memory:
+                    out = _shm_pack(out, segments)
+                result_q.put((bidx, out, worker_id))
+                for seg in segments:
+                    seg.close()                        # parent unlinks
+            except Exception as e:  # per-batch errors propagate to parent
+                result_q.put((bidx, _ExcInfo(e), worker_id))
+    except KeyboardInterrupt:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# parent-side pool
+# ---------------------------------------------------------------------------
+
+class WorkerPool:
+    """Owns the worker processes + queues; yields batches in sampler order.
+
+    persistent_workers=True keeps processes alive across epochs (reference
+    reader.py persistent_workers); otherwise the pool is torn down when an
+    epoch's iterator is exhausted or closed.
+    """
+
+    def __init__(self, dataset, collate_fn: Callable, num_workers: int,
+                 use_shared_memory: bool = True,
+                 worker_init_fn: Optional[Callable] = None,
+                 timeout: float = 0, iterable: bool = False):
+        self._ctx = mp.get_context("fork" if sys.platform != "win32"
+                                   else "spawn")
+        # start the parent's resource tracker BEFORE forking so every
+        # worker shares it: create(+)/attach(+, set no-op)/unlink(-) then
+        # balance in ONE tracker instead of leaking per-worker trackers
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.ensure_running()
+        except Exception:
+            pass
+        self._num_workers = num_workers
+        self._timeout = timeout or None
+        self._iterable = iterable
+        # one index queue PER worker (reference reader.py worker loop):
+        # a shared queue lets one fast worker starve the others — fatal
+        # for IterableDataset stream sharding, where each worker owns a
+        # distinct shard of the data
+        self._index_qs = [self._ctx.Queue() for _ in range(num_workers)]
+        self._result_q = self._ctx.Queue()
+        base_seed = int.from_bytes(os.urandom(4), "little")
+        self._procs = []
+        for wid in range(num_workers):
+            p = self._ctx.Process(
+                target=_worker_loop,
+                args=(dataset, collate_fn, self._index_qs[wid],
+                      self._result_q, wid, num_workers, base_seed,
+                      worker_init_fn, use_shared_memory, iterable),
+                daemon=True)
+            p.start()
+            self._procs.append(p)
+        self._alive = True
+        self._epoch = -1
+
+    # -- epoch iteration --------------------------------------------------
+    def run_epoch(self, index_iter, prefetch: int, drop_last: bool = False):
+        """Feed index batches, yield collated batches in order.  Guarantees
+        no in-flight task survives into the next epoch (a finally-drain
+        covers early exits — consumer break, iterable end — so persistent
+        workers can't cross-contaminate batch indices across epochs)."""
+        self._epoch += 1
+        epoch = self._epoch
+        reorder: dict = {}
+        next_out = 0
+        next_in = 0
+        received = 0
+        exhausted = False
+        ended_workers = set()
+
+        def feed_one():
+            nonlocal next_in, exhausted
+            if exhausted:
+                return False
+            try:
+                idx = next(index_iter)
+            except StopIteration:
+                exhausted = True
+                return False
+            self._index_qs[next_in % self._num_workers].put(
+                (next_in, idx, epoch, drop_last))
+            next_in += 1
+            return True
+
+        def get_result(user_timeout):
+            """Poll the result queue in short slices so dead workers are
+            detected instead of blocking forever (timeout=0 -> unbounded
+            user wait but still supervised)."""
+            waited = 0.0
+            while True:
+                try:
+                    return self._result_q.get(timeout=5.0)
+                except _queue.Empty:
+                    self._check_workers()
+                    waited += 5.0
+                    if user_timeout and waited >= user_timeout:
+                        raise RuntimeError(
+                            f"DataLoader timed out after {waited:.0f}s "
+                            "waiting for a worker batch")
+
+        try:
+            for _ in range(max(prefetch, 1) * self._num_workers):
+                if not feed_one():
+                    break
+
+            while next_out < next_in:
+                bidx, payload, wid = get_result(self._timeout)
+                received += 1
+                if isinstance(payload, _ExcInfo):
+                    payload.reraise()
+                if isinstance(payload, str) and payload == "__iter_end__":
+                    ended_workers.add(wid)
+                    reorder[bidx] = None
+                else:
+                    reorder[bidx] = _shm_unpack(payload)
+                while next_out in reorder:
+                    item = reorder.pop(next_out)
+                    next_out += 1
+                    feed_one()
+                    if item is not None:
+                        yield item
+                if self._iterable and \
+                        len(ended_workers) >= self._num_workers:
+                    break
+        finally:
+            # drain every outstanding task so SHM segments are unlinked and
+            # the next epoch starts from an empty result queue
+            self._drain(next_in - received)
+
+    def _drain(self, outstanding: int):
+        import time
+        deadline = time.time() + 30
+        while outstanding > 0 and time.time() < deadline:
+            try:
+                _, payload, _ = self._result_q.get(timeout=1.0)
+            except _queue.Empty:
+                if not any(p.is_alive() for p in self._procs):
+                    break
+                continue
+            if not isinstance(payload, (_ExcInfo, str)):
+                _shm_unpack(payload)       # attach+copy+unlink, then drop
+            outstanding -= 1
+
+    def _check_workers(self):
+        dead = [p.pid for p in self._procs if not p.is_alive()]
+        if dead:
+            raise RuntimeError(
+                f"DataLoader worker(s) {dead} exited unexpectedly")
+
+    # -- shutdown ---------------------------------------------------------
+    def shutdown(self):
+        if not self._alive:
+            return
+        self._alive = False
+        try:
+            for q in self._index_qs:
+                q.put(None)
+            for p in self._procs:
+                p.join(timeout=5)
+            for p in self._procs:
+                if p.is_alive():
+                    p.terminate()
+            # unlink SHM of any never-delivered batches
+            while True:
+                try:
+                    _, payload, _ = self._result_q.get_nowait()
+                except _queue.Empty:
+                    break
+                if not isinstance(payload, (_ExcInfo, str)):
+                    try:
+                        _shm_unpack(payload)
+                    except Exception:
+                        pass
+        finally:
+            for q in self._index_qs:
+                q.close()
+            self._result_q.close()
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

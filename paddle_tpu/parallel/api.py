@@ -114,6 +114,12 @@ def shard_tensor(data, mesh: Optional[ProcessMesh] = None,
     t = data if isinstance(data, Tensor) else Tensor(np.asarray(data))
     mesh = mesh or ProcessMesh(jax_mesh=get_topology().mesh)
     placements = list(placements or [])
+    if any(isinstance(p, Partial) for p in placements):
+        raise ValueError(
+            "shard_tensor cannot create a Partial tensor from global data "
+            "(there is nothing to be partial over); build one with "
+            "dtensor_from_local(partial_stack=...) or receive one from a "
+            "sharded op")
     spec = _spec_from_placements(placements, t.ndim, mesh.dim_names)
     sharding = NamedSharding(mesh.mesh, spec)
     v = jax.device_put(t._value, sharding)
@@ -124,11 +130,67 @@ def shard_tensor(data, mesh: Optional[ProcessMesh] = None,
     return out
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=128)
+def _resolve_partial(reduce_type: str, dst_sharding):
+    """Compiled-once Partial resolver: fold the hidden leading contribution
+    dim with the placement's reduce op, constrained to the destination
+    sharding (XLA lowers this to the all-reduce / reduce-scatter the
+    reference's p_to_r / p_to_s emit).  lru-cached so a per-step reshard
+    doesn't re-trace."""
+    import jax.numpy as jnp
+    reducers = {"sum": jnp.sum, "avg": jnp.mean, "mean": jnp.mean,
+                "max": jnp.max, "min": jnp.min}
+    try:
+        red = reducers[reduce_type]
+    except KeyError:
+        raise ValueError(f"unsupported Partial reduce_type {reduce_type!r}")
+
+    @jax.jit
+    def resolve(v):
+        return jax.lax.with_sharding_constraint(red(v, axis=0),
+                                                dst_sharding)
+
+    return resolve
+
+
+def _partial_axes(placements, dim_names):
+    return [ax for ax, pl in zip(dim_names, placements)
+            if isinstance(pl, Partial)]
+
+
 def dtensor_from_local(local_tensor, mesh: ProcessMesh,
-                       placements: Sequence[Placement]) -> Tensor:
+                       placements: Sequence[Placement],
+                       partial_stack=None) -> Tensor:
     """Assemble a global tensor from per-device local shards (reference
     api.py:539).  Single-controller: jax.make_array_from_single_device_arrays
-    over the mesh's devices."""
+    over the mesh's devices.
+
+    Partial placements: pass ``partial_stack`` — an array of shape
+    ``[axis_size, *logical_shape]`` holding each mesh-position's unreduced
+    contribution (the per-rank partial values of the reference's Partial
+    state).  The dtensor carries it sharded on the hidden leading dim;
+    ``reshard`` to Replicate/Shard resolves it with the all-reduce /
+    reduce-scatter the reference's p_to_r / p_to_s functions emit."""
+    p_axes = _partial_axes(placements, mesh.dim_names)
+    if p_axes:
+        if partial_stack is None:
+            raise ValueError("Partial placement needs partial_stack "
+                             "[axis_size, *shape] of per-rank contributions")
+        if len(p_axes) != 1:
+            raise NotImplementedError("one Partial axis supported")
+        data = np.asarray(partial_stack._value if isinstance(
+            partial_stack, Tensor) else partial_stack)
+        base = _spec_from_placements(placements, data.ndim - 1,
+                                     mesh.dim_names)
+        spec = P(p_axes[0], *base)
+        v = jax.device_put(data, NamedSharding(mesh.mesh, spec))
+        out = Tensor(v, stop_gradient=True)
+        out.process_mesh = mesh
+        out.placements = list(placements)   # Partial here marks the hidden
+        return out                          # leading contribution dim
     t = local_tensor if isinstance(local_tensor, Tensor) else Tensor(
         np.asarray(local_tensor))
     spec = _spec_from_placements(placements, t.ndim, mesh.dim_names)
@@ -152,10 +214,30 @@ def dtensor_from_local(local_tensor, mesh: ProcessMesh,
 def reshard(dist_tensor: Tensor, mesh: ProcessMesh,
             placements: Sequence[Placement]) -> Tensor:
     """Change placements (reference api.py:619; C++ reshard functions
-    r_to_s/s_to_r/p_to_r...).  One sharded device_put — XLA picks the
-    minimal collective."""
-    spec = _spec_from_placements(placements, dist_tensor.ndim, mesh.dim_names)
-    v = jax.device_put(dist_tensor._value, NamedSharding(mesh.mesh, spec))
+    phi/core/distributed/auto_parallel/reshard/ r_to_s/s_to_r/p_to_r/
+    p_to_s/s_to_s/nd_mesh).
+
+    Placement-pair → collective mapping (asserted against the compiled
+    HLO in tests/test_reshard_matrix.py):
+      r_to_s  local slice (no collective)     s_to_r  all-gather
+      s_to_s  all-to-all (dim move)           p_to_r  all-reduce
+      p_to_s  reduce-scatter
+    A Partial source resolves its hidden per-rank contribution dim by
+    summation; XLA lowers sum-over-mesh-axis + output sharding to the
+    all-reduce / reduce-scatter pair above."""
+    src_partials = [p for p in (get_placements(dist_tensor) or [])
+                    if isinstance(p, Partial)]
+    if src_partials:
+        dst_base = _spec_from_placements(placements, dist_tensor.ndim - 1,
+                                         mesh.dim_names)
+        dst_sharding = NamedSharding(mesh.mesh, dst_base)
+        v = _resolve_partial(src_partials[0].reduce_type,
+                             dst_sharding)(dist_tensor._value)
+    else:
+        spec = _spec_from_placements(placements, dist_tensor.ndim,
+                                     mesh.dim_names)
+        v = jax.device_put(dist_tensor._value,
+                           NamedSharding(mesh.mesh, spec))
     out = Tensor(v, stop_gradient=dist_tensor.stop_gradient)
     out.process_mesh = mesh
     out.placements = list(placements)

@@ -1,0 +1,285 @@
+"""Per-op SPMD sharding-propagation rules for the semi-auto API.
+
+Reference: paddle/phi/infermeta/spmd_rules/ (46 C++ rule files — e.g.
+``MatmulInferSpmd`` matmul.h:25, embedding.cc, elementwise.cc,
+softmax.cc, flash_attention.cc, reduction.cc) and the completion pass
+(python/paddle/distributed/auto_parallel/static/completion.py).
+
+TPU-native shape: a rule is a pure function over :class:`TensorDistAttr`
+(dims_mapping + partial axes, same representation as the reference's
+``TensorDistAttr``) that returns (a) the input attrs each operand must be
+reshard-ed to and (b) the inferred output attr.  GSPMD does the actual
+partitioning; the rule layer makes propagation *explicit and testable* —
+each rule is pinned against GSPMD's observed behavior in
+tests/test_spmd_rules.py, which is the analog of the reference's
+spmd-rule unit suite (test/auto_parallel/spmd_rules).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Set, Tuple
+
+__all__ = ["TensorDistAttr", "matmul_rule", "elementwise_rule",
+           "embedding_rule", "reduction_rule", "softmax_rule",
+           "transpose_rule", "reshape_rule", "flash_attention_rule",
+           "cross_entropy_rule", "layer_norm_rule"]
+
+
+@dataclass
+class TensorDistAttr:
+    """dims_mapping[i] = mesh-axis name sharding tensor dim i (None =
+    replicated on that dim); partial = mesh axes holding unreduced
+    partial sums (reference: phi/core/distributed/auto_parallel/
+    dist_attr.h TensorDistAttr)."""
+    dims_mapping: List[Optional[str]]
+    partial: Set[str] = field(default_factory=set)
+
+    @property
+    def ndim(self) -> int:
+        return len(self.dims_mapping)
+
+    def replicate(self) -> "TensorDistAttr":
+        return TensorDistAttr([None] * self.ndim)
+
+    def with_dim(self, dim: int, axis: Optional[str]) -> "TensorDistAttr":
+        dm = list(self.dims_mapping)
+        dm[dim] = axis
+        return TensorDistAttr(dm, set(self.partial))
+
+    def __repr__(self):
+        p = f", partial={sorted(self.partial)}" if self.partial else ""
+        return f"DistAttr({self.dims_mapping}{p})"
+
+
+def _merge_dim(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    """Merge two proposals for one tensor dim: agreement wins, conflict
+    (or one-sided) prefers the sharded proposal; hard conflict -> None
+    (replicate), matching the reference's ShardingMergeForTensors."""
+    if a == b:
+        return a
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return None          # conflicting axes: fall back to replicated
+
+
+def _used_axes(*attrs: TensorDistAttr) -> Set[str]:
+    used = set()
+    for at in attrs:
+        used |= {a for a in at.dims_mapping if a is not None}
+        used |= at.partial
+    return used
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+def matmul_rule(x: TensorDistAttr, y: TensorDistAttr,
+                trans_x: bool = False, trans_y: bool = False
+                ) -> Tuple[TensorDistAttr, TensorDistAttr, TensorDistAttr]:
+    """[..., m, k] @ [..., k, n] (reference MatmulInferSpmd matmul.h:25).
+
+    Returns (x_required, y_required, out).  Einsum-notation alignment:
+    batch dims merge elementwise; m from x, n from y; a shared contracted
+    axis makes the output PARTIAL on that mesh axis (the caller's reshard
+    of the output inserts the all-reduce — reference partial semantics).
+    """
+    xm = list(x.dims_mapping)
+    ym = list(y.dims_mapping)
+    if trans_x:
+        xm[-1], xm[-2] = xm[-2], xm[-1]
+    if trans_y:
+        ym[-1], ym[-2] = ym[-2], ym[-1]
+    nb = max(len(xm), len(ym)) - 2
+    xb = [None] * (nb - (len(xm) - 2)) + xm[:-2]
+    yb = [None] * (nb - (len(ym) - 2)) + ym[:-2]
+    batch = [_merge_dim(a, b) for a, b in zip(xb, yb)]
+    m, kx = xm[-2], xm[-1]
+    ky, n = ym[-2], ym[-1]
+    k = _merge_dim(kx, ky)
+    # m/n may not reuse an axis already taken by k or each other
+    taken = {k} if k else set()
+    m = None if m in taken else m
+    taken.add(m)
+    n = None if n in taken or n == m else n
+
+    x_req = TensorDistAttr(batch[nb - (len(xm) - 2):] + [m, k])
+    y_req = TensorDistAttr(batch[nb - (len(ym) - 2):] + [k, n])
+    if trans_x:
+        x_req.dims_mapping[-1], x_req.dims_mapping[-2] = \
+            x_req.dims_mapping[-2], x_req.dims_mapping[-1]
+    if trans_y:
+        y_req.dims_mapping[-1], y_req.dims_mapping[-2] = \
+            y_req.dims_mapping[-2], y_req.dims_mapping[-1]
+    out = TensorDistAttr(batch + [m, n],
+                         partial={k} if k is not None else set())
+    return x_req, y_req, out
+
+
+def elementwise_rule(*attrs: TensorDistAttr
+                     ) -> Tuple[List[TensorDistAttr], TensorDistAttr]:
+    """Broadcast-aware elementwise (reference elementwise.cc).  Output dim
+    mapping = merge of (right-aligned) input mappings; inputs required to
+    match on non-broadcast dims.  Partial inputs stay partial only if ALL
+    inputs share the same partial axes (else require reshard-to-full)."""
+    ndim = max(a.ndim for a in attrs)
+    out_dm: List[Optional[str]] = [None] * ndim
+    for a in attrs:
+        off = ndim - a.ndim
+        for i, ax in enumerate(a.dims_mapping):
+            out_dm[off + i] = _merge_dim(out_dm[off + i], ax)
+    reqs = []
+    partials = [frozenset(a.partial) for a in attrs]
+    same_partial = len(set(partials)) == 1
+    for a in attrs:
+        off = ndim - a.ndim
+        # each input aligns to the merged mapping on its trailing dims;
+        # size-1 broadcast dims are masked to None by the caller (the rule
+        # sees only mappings, not shapes)
+        dm = [out_dm[off + i] for i in range(a.ndim)]
+        reqs.append(TensorDistAttr(
+            dm, set(a.partial) if same_partial else set()))
+    out = TensorDistAttr(out_dm,
+                         set(attrs[0].partial) if same_partial else set())
+    return reqs, out
+
+
+def embedding_rule(table: TensorDistAttr, ids: TensorDistAttr
+                   ) -> Tuple[TensorDistAttr, TensorDistAttr,
+                              TensorDistAttr]:
+    """table [V, H], ids [...] -> out [..., H] (reference embedding.cc).
+    Row-parallel table (V sharded on axis a) -> out PARTIAL on a (the
+    vocab-parallel masked-lookup pattern, c_embedding); col-parallel table
+    (H sharded) -> out last dim sharded."""
+    v_ax, h_ax = table.dims_mapping
+    ids_req = TensorDistAttr(list(ids.dims_mapping))
+    table_req = TensorDistAttr([v_ax, h_ax])
+    out = TensorDistAttr(list(ids.dims_mapping) + [h_ax],
+                         partial={v_ax} if v_ax is not None else set())
+    return table_req, ids_req, out
+
+
+def reduction_rule(x: TensorDistAttr, axis: Sequence[int], keepdim=False
+                   ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """sum/mean over ``axis`` (reference reduction.cc): reducing a sharded
+    dim turns its mesh axis into a PARTIAL on the output."""
+    axes = {a % x.ndim for a in axis}
+    new_partial = set(x.partial)
+    out_dm = []
+    for i, ax in enumerate(x.dims_mapping):
+        if i in axes:
+            if ax is not None:
+                new_partial.add(ax)
+            if keepdim:
+                out_dm.append(None)
+        else:
+            out_dm.append(ax)
+    return TensorDistAttr(list(x.dims_mapping), set(x.partial)), \
+        TensorDistAttr(out_dm, new_partial)
+
+
+def softmax_rule(x: TensorDistAttr, axis: int = -1
+                 ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """softmax dim must be unsharded (reference softmax.cc): the rule
+    requires the input resharded so dims_mapping[axis] is None."""
+    req = x.with_dim(axis % x.ndim, None)
+    req.partial = set()
+    return req, TensorDistAttr(list(req.dims_mapping))
+
+
+def transpose_rule(x: TensorDistAttr, perm: Sequence[int]
+                   ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    out = TensorDistAttr([x.dims_mapping[p] for p in perm], set(x.partial))
+    return TensorDistAttr(list(x.dims_mapping), set(x.partial)), out
+
+
+def reshape_rule(x: TensorDistAttr, src_shape: Sequence[int],
+                 dst_shape: Sequence[int]
+                 ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """Split/merge-aware reshape (reference reshape.cc): a sharded src dim
+    survives if it maps to the MAJOR position of a merged/split group;
+    otherwise the rule requires it replicated."""
+    src = list(src_shape)
+    dst = list(dst_shape)
+    req = list(x.dims_mapping)
+    out_dm: List[Optional[str]] = [None] * len(dst)
+    si = di = 0
+    while si < len(src) and di < len(dst):
+        s_sz, d_sz = src[si], dst[di]
+        if s_sz == d_sz:
+            out_dm[di] = x.dims_mapping[si]
+            si += 1
+            di += 1
+        elif s_sz > d_sz:
+            # split: src dim si -> dst dims di.. ; shard maps to major part
+            if s_sz % d_sz == 0:
+                out_dm[di] = x.dims_mapping[si]
+                run = d_sz
+                di += 1
+                while run < s_sz and di < len(dst):
+                    run *= dst[di]
+                    di += 1
+                si += 1
+            else:
+                req[si] = None
+                si += 1
+                di += 1
+        else:
+            # merge: src dims si.. -> dst dim di; only the major src dim's
+            # sharding survives; minor sharded dims must be replicated
+            out_dm[di] = x.dims_mapping[si]
+            run = s_sz
+            si += 1
+            while run < d_sz and si < len(src):
+                if x.dims_mapping[si] is not None:
+                    req[si] = None
+                run *= src[si]
+                si += 1
+            di += 1
+    return TensorDistAttr(req, set(x.partial)), \
+        TensorDistAttr(out_dm, set(x.partial))
+
+
+def flash_attention_rule(q: TensorDistAttr, k: TensorDistAttr,
+                         v: TensorDistAttr, sep_axis: Optional[str] = None
+                         ) -> Tuple[TensorDistAttr, TensorDistAttr,
+                                    TensorDistAttr, TensorDistAttr]:
+    """q/k/v [b, s, n, d] (reference flash_attention.cc): batch and head
+    dims may shard; head_dim must be replicated.  The sequence dim may
+    shard ONLY on ``sep_axis`` (ring/Ulysses context parallelism handles
+    the KV exchange); otherwise it must be replicated."""
+    b = _merge_dim(_merge_dim(q.dims_mapping[0], k.dims_mapping[0]),
+                   v.dims_mapping[0])
+    n = _merge_dim(_merge_dim(q.dims_mapping[2], k.dims_mapping[2]),
+                   v.dims_mapping[2])
+    s_q = q.dims_mapping[1]
+    s = s_q if (sep_axis is not None and s_q == sep_axis) else None
+    req = TensorDistAttr([b, s, n, None])
+    return req, req, req, TensorDistAttr([b, s, n, None])
+
+
+def cross_entropy_rule(logits: TensorDistAttr, label: TensorDistAttr
+                       ) -> Tuple[TensorDistAttr, TensorDistAttr,
+                                  TensorDistAttr]:
+    """softmax CE over the class dim (reference
+    cross_entropy_with_softmax.cc): a class-dim shard is ALLOWED (vocab-
+    parallel CE computes with psum of max/denominator) and yields a
+    PARTIAL loss; batch dims propagate."""
+    cls_ax = logits.dims_mapping[-1]
+    batch = logits.dims_mapping[:-1]
+    lbl_req = TensorDistAttr(list(batch))
+    out = TensorDistAttr(list(batch),
+                         partial={cls_ax} if cls_ax is not None else set())
+    return TensorDistAttr(list(logits.dims_mapping)), lbl_req, out
+
+
+def layer_norm_rule(x: TensorDistAttr, begin_norm_axis: int = -1
+                    ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """Normalized dims must be replicated (reference layer_norm.cc)."""
+    bn = begin_norm_axis % x.ndim
+    req = TensorDistAttr([ax if i < bn else None
+                          for i, ax in enumerate(x.dims_mapping)])
+    return req, TensorDistAttr(list(req.dims_mapping))

@@ -1,0 +1,205 @@
+"""Multiprocess DataLoader workers (VERDICT r2 item 7; reference:
+io/reader.py:262 + io/dataloader/worker.py — subprocess workers, worker
+seeds, SHM transport, persistent_workers).
+
+Note on throughput: CI hosts here expose a single core (``nproc`` = 1), so
+process workers can only overlap with consumer idle time, not parallelize;
+the throughput check asserts bounded overhead rather than speedup.  On a
+multi-core TPU host the same pipeline fans out across cores (the GIL-bound
+thread prefetcher could not — that was the round-2 MFU risk).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, get_worker_info
+from paddle_tpu.io.dataset import Dataset, IterableDataset
+
+
+def _np(x):
+    return np.asarray(x._value)
+
+
+class _IdxDS(Dataset):
+    def __init__(self, n=32):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        # big enough that the array rides shared memory, not the pipe
+        return np.full((64, 64), i, np.float32), np.int64(i)
+
+
+class TestMultiprocessWorkers:
+    def test_order_and_shm_content(self):
+        dl = DataLoader(_IdxDS(32), batch_size=4, num_workers=3,
+                        shuffle=False)
+        seen = []
+        for xb, yb in dl:
+            seen.extend(_np(yb).tolist())
+            assert _np(xb).shape == (4, 64, 64)
+            np.testing.assert_allclose(_np(xb)[:, 0, 0], _np(yb))
+        assert seen == list(range(32))
+
+    def test_persistent_workers_two_epochs(self):
+        dl = DataLoader(_IdxDS(32), batch_size=8, num_workers=2,
+                        persistent_workers=True)
+        try:
+            for _ in range(2):
+                assert sum(1 for _ in dl) == 4
+            assert dl._pool is not None        # pool survived the epoch
+        finally:
+            dl._release_pool()
+
+    def test_non_persistent_pool_released(self):
+        dl = DataLoader(_IdxDS(8), batch_size=4, num_workers=2)
+        list(dl)
+        assert dl._pool is None
+
+    def test_get_worker_info_inside_workers(self):
+        class ProbeDS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                wi = get_worker_info()
+                assert wi is not None and wi.num_workers == 2
+                return np.int64(wi.id)
+
+        ids = set()
+        for b in DataLoader(ProbeDS(), batch_size=2, num_workers=2):
+            ids.update(_np(b).tolist())
+        assert ids.issubset({0, 1})
+
+    def test_worker_seeds_differ(self):
+        class RandDS(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                # np seeded per worker from base_seed + worker_id
+                return np.float64(np.random.rand())
+
+        vals = [float(_np(b)[0]) for b in
+                DataLoader(RandDS(), batch_size=1, num_workers=2)]
+        assert len(set(vals)) > 1              # not all identical
+
+    def test_worker_init_fn_runs(self, tmp_path):
+        marker = str(tmp_path / "w{}.txt")
+
+        def init_fn(wid):
+            open(marker.format(wid), "w").write("up")
+
+        list(DataLoader(_IdxDS(4), batch_size=2, num_workers=2,
+                        worker_init_fn=init_fn))
+        assert os.path.exists(marker.format(0))
+        assert os.path.exists(marker.format(1))
+
+    def test_error_propagates_with_traceback(self):
+        class BadDS(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                if i == 2:
+                    raise ValueError("boom")
+                return np.int64(i)
+
+        with pytest.raises(RuntimeError, match="boom"):
+            list(DataLoader(BadDS(), batch_size=1, num_workers=2))
+
+    def test_iterable_dataset_sharded_by_worker(self):
+        class Stream(IterableDataset):
+            def __iter__(self):
+                wi = get_worker_info()
+                base = wi.id * 100
+                for i in range(5):
+                    yield np.int64(base + i)
+
+        vals = []
+        for b in DataLoader(Stream(), batch_size=2, num_workers=2):
+            vals.extend(_np(b).tolist())
+        assert sorted(vals) == [0, 1, 2, 3, 4, 100, 101, 102, 103, 104]
+
+    @pytest.mark.slow
+    def test_throughput_overhead_bounded(self):
+        class Heavy(Dataset):
+            def __len__(self):
+                return 24
+
+            def __getitem__(self, i):
+                acc = 0
+                for k in range(150000):      # pure-Python, GIL-holding
+                    acc += k * k
+                return np.float32(acc % 7 + i)
+
+        t0 = time.time()
+        list(DataLoader(Heavy(), batch_size=4, num_workers=0))
+        single = time.time() - t0
+        dl = DataLoader(Heavy(), batch_size=4, num_workers=2,
+                        persistent_workers=True)
+        try:
+            list(dl)                         # warm pool (fork cost)
+            t0 = time.time()
+            list(dl)
+            multi = time.time() - t0
+        finally:
+            dl._release_pool()
+        cores = os.cpu_count() or 1
+        if cores > 1:
+            assert multi < single, (single, multi)
+        else:
+            # single core: only assert the pipeline adds bounded overhead
+            assert multi < single * 1.6, (single, multi)
+
+
+class TestEpochIsolation:
+    def test_iterable_persistent_multiple_epochs(self):
+        class Stream(IterableDataset):
+            def __iter__(self):
+                wi = get_worker_info()
+                for i in range(4):
+                    yield np.int64(wi.id * 10 + i)
+
+        dl = DataLoader(Stream(), batch_size=2, num_workers=2,
+                        persistent_workers=True)
+        try:
+            for _ in range(3):   # every epoch must see the FULL stream
+                vals = []
+                for b in dl:
+                    vals.extend(_np(b).tolist())
+                assert sorted(vals) == [0, 1, 2, 3, 10, 11, 12, 13], vals
+        finally:
+            dl._release_pool()
+
+    def test_early_break_does_not_corrupt_next_epoch(self):
+        dl = DataLoader(_IdxDS(32), batch_size=4, num_workers=2,
+                        persistent_workers=True)
+        try:
+            it = iter(dl)
+            next(it)            # abandon epoch after one batch
+            del it
+            seen = []
+            for _, yb in dl:    # fresh epoch must be in order from 0
+                seen.extend(_np(yb).tolist())
+            assert seen == list(range(32)), seen[:8]
+        finally:
+            dl._release_pool()
+
+    def test_iterable_drop_last_multiprocess(self):
+        class Stream5(IterableDataset):
+            def __iter__(self):
+                for i in range(5):
+                    yield np.int64(i)
+
+        batches = [
+            _np(b).shape[0] for b in
+            DataLoader(Stream5(), batch_size=2, num_workers=2,
+                       drop_last=True)]
+        assert all(s == 2 for s in batches), batches

@@ -1,0 +1,101 @@
+"""Scale-shape smokes (VERDICT r2 item 3): real-model-size compiles on the
+8-device CPU mesh with compile-time and memory budgets asserted, so
+mp×pp compile explosions (round-1 regression, commit ffb31ca) can't recur
+silently.  AOT only — state comes from ``jax.eval_shape`` (no 20 GB
+materialization) and the step is ``.lower().compile()``d, never executed.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import parallel as dist
+from paddle_tpu.models.gpt import gpt_1p3b, build_gpt_train_step
+from paddle_tpu.models.llama import llama_7b, build_llama_train_step
+
+pytestmark = pytest.mark.slow
+
+GB = 1 << 30
+
+
+def _aot(step_fn, init_fn, batch, seq):
+    state_avals = jax.eval_shape(init_fn, 0)
+    ids = jax.ShapeDtypeStruct((batch, seq), jnp.int64)
+    t0 = time.time()
+    compiled = step_fn.lower(state_avals, ids, ids).compile()
+    compile_s = time.time() - t0
+    return state_avals, compiled, compile_s
+
+
+class TestGPT13BCompile:
+    def test_mp2_pp2_dp2_compile_and_memory(self):
+        cfg = gpt_1p3b()
+        topo = dist.init_topology(dp=2, mp=2, pp=2, sep=1, sharding=1)
+        step_fn, init_fn = build_gpt_train_step(
+            cfg, topo, num_microbatches=4, sharding_stage=2)
+        state_avals, compiled, compile_s = _aot(step_fn, init_fn, 8, 1024)
+
+        # compile budget: round-1's mp×pp explosion was >10 min; the manual
+        # shard_map + scan design keeps it seconds (measured ~5 s)
+        assert compile_s < 120, f"compile took {compile_s:.0f}s"
+
+        # parameter count ~= 1.3B (h2048 L24 + tied 50304-vocab embedding)
+        n_state = sum(int(np.prod(l.shape))
+                      for l in jax.tree.leaves(state_avals))
+        # state = params + 2 fp32 Adam moments (sharded) + counters
+        assert 3.5e9 < n_state < 5.0e9, n_state
+
+        ma = compiled.memory_analysis()
+        per_dev = (ma.argument_size_in_bytes + ma.temp_size_in_bytes)
+        # per-device footprint must fit a single v5p chip (95 GB HBM) with
+        # extreme margin at these shapes; regression guard at 24 GB
+        assert per_dev < 24 * GB, f"{per_dev / GB:.1f} GB per device"
+
+    def test_seq2048_microbatch8_still_compiles(self):
+        cfg = gpt_1p3b()
+        topo = dist.init_topology(dp=1, mp=2, pp=2, sep=2, sharding=1)
+        step_fn, init_fn = build_gpt_train_step(
+            cfg, topo, num_microbatches=8, sharding_stage=2)
+        _, compiled, compile_s = _aot(step_fn, init_fn, 8, 2048)
+        assert compile_s < 180, f"compile took {compile_s:.0f}s"
+        ma = compiled.memory_analysis()
+        per_dev = ma.argument_size_in_bytes + ma.temp_size_in_bytes
+        assert per_dev < 48 * GB, f"{per_dev / GB:.1f} GB per device"
+
+
+class TestLlama7BStage3Memory:
+    def _build(self, stage):
+        cfg = llama_7b()
+        topo = dist.init_topology(dp=1, mp=1, pp=1, sep=1, sharding=8)
+        step_fn, init_fn = build_llama_train_step(
+            cfg, topo, num_microbatches=1, sharding_stage=stage)
+        return _aot(step_fn, init_fn, 8, 512)
+
+    def test_stage3_param_residency_vs_stage2(self):
+        """Stage-3 shards PARAMS over the sharding axis (reference
+        group_sharded_stage3.py:85); stage-2 replicates params and shards
+        only grads+optimizer state.  Assert the per-device argument
+        footprint drops accordingly (VERDICT r2: 'stage-3 vs stage-2
+        param-residency' at real 7B shape)."""
+        _, c2, t2 = self._build(2)
+        _, c3, t3 = self._build(3)
+        assert t2 < 240 and t3 < 240, (t2, t3)
+        a2 = c2.memory_analysis().argument_size_in_bytes
+        a3 = c3.memory_analysis().argument_size_in_bytes
+
+        # llama-7b fp32: params ~27 GB, moments ~54 GB (fp32 ×2).
+        # stage2/device = params + moments/8  ~= 33.7 GB
+        # stage3/device = (params + moments)/8 ~= 10.1 GB
+        assert a2 > 28 * GB, f"stage2 args {a2 / GB:.1f} GB"
+        assert a3 < 16 * GB, f"stage3 args {a3 / GB:.1f} GB"
+        assert a3 < a2 * 0.45, (a2 / GB, a3 / GB)
+
+    def test_stage3_total_state_not_replicated(self):
+        state_avals, _, _ = self._build(3)
+        n_state = sum(int(np.prod(l.shape))
+                      for l in jax.tree.leaves(state_avals))
+        # params + 2 moments of a 6.7B model, NOT multiplied by 8 shards
+        assert n_state < 2.5e10, n_state

@@ -1,0 +1,180 @@
+"""Per-op SPMD rule layer (VERDICT r2 item 8; reference:
+phi/infermeta/spmd_rules/ — MatmulInferSpmd matmul.h:25, embedding.cc,
+elementwise.cc, reduction.cc, softmax.cc, reshape.cc,
+flash_attention.cc — and test/auto_parallel/spmd_rules).
+
+Two layers of checks: (1) the rule outputs themselves (dims_mapping +
+partial propagation), (2) rules vs GSPMD — for key rules we compile the
+op with rule-derived input shardings and assert the output sharding XLA
+actually picks matches the rule's inference.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.parallel.spmd_rules import (
+    TensorDistAttr as DA, cross_entropy_rule, elementwise_rule,
+    embedding_rule, flash_attention_rule, layer_norm_rule, matmul_rule,
+    reduction_rule, reshape_rule, softmax_rule, transpose_rule)
+
+
+def mesh_2d():
+    return Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("x", "y"))
+
+
+class TestMatmulRule:
+    def test_mk_times_kn_plain(self):
+        xr, yr, out = matmul_rule(DA(["x", None]), DA([None, "y"]))
+        assert out.dims_mapping == ["x", "y"] and not out.partial
+
+    def test_contracted_dim_makes_partial(self):
+        # Megatron row-parallel: x [m, k/x], w [k/x, n] -> out partial(x)
+        xr, yr, out = matmul_rule(DA([None, "x"]), DA(["x", None]))
+        assert out.dims_mapping == [None, None]
+        assert out.partial == {"x"}
+
+    def test_one_sided_k_propagates(self):
+        xr, yr, out = matmul_rule(DA([None, "x"]), DA([None, None]))
+        assert yr.dims_mapping == ["x", None]       # y must reshard to k/x
+        assert out.partial == {"x"}
+
+    def test_conflict_m_vs_k_prefers_k(self):
+        xr, yr, out = matmul_rule(DA(["x", "x"]), DA(["x", None]))
+        # x axis can't shard both m and k; k keeps it
+        assert xr.dims_mapping[-1] == "x" and xr.dims_mapping[-2] is None
+
+    def test_trans_y(self):
+        # y given as [n, k] with trans_y: k is its LAST dim
+        xr, yr, out = matmul_rule(DA([None, "x"]), DA([None, "x"]),
+                                  trans_y=True)
+        assert out.partial == {"x"}
+        assert yr.dims_mapping == [None, "x"]
+
+    def test_batch_dims_merge(self):
+        xr, yr, out = matmul_rule(DA(["x", None, None]),
+                                  DA(["x", None, "y"]))
+        assert out.dims_mapping == ["x", None, "y"]
+
+    def test_rule_matches_gspmd(self):
+        """Compile x@y with rule-required input shardings; XLA's chosen
+        output sharding must equal the rule's inference."""
+        m = mesh_2d()
+        xr, yr, out = matmul_rule(DA(["x", None]), DA([None, "y"]))
+        sx = NamedSharding(m, P(*xr.dims_mapping))
+        sy = NamedSharding(m, P(*yr.dims_mapping))
+        f = jax.jit(lambda a, b: a @ b)
+        args = (jax.ShapeDtypeStruct((8, 16), jnp.float32, sharding=sx),
+                jax.ShapeDtypeStruct((16, 8), jnp.float32, sharding=sy))
+        got = f.lower(*args).compile().output_shardings
+        assert got.spec == P(*out.dims_mapping), got
+
+    def test_partial_rule_matches_gspmd_allreduce(self):
+        """Contracted-dim sharding: rule says partial(x); GSPMD resolves
+        a replicated output request with exactly one all-reduce."""
+        m = mesh_2d()
+        xr, yr, out = matmul_rule(DA([None, "x"]), DA(["x", None]))
+        assert out.partial == {"x"}
+        sx = NamedSharding(m, P(*xr.dims_mapping))
+        sy = NamedSharding(m, P(*yr.dims_mapping))
+        f = jax.jit(lambda a, b: a @ b,
+                    out_shardings=NamedSharding(m, P()))
+        args = (jax.ShapeDtypeStruct((8, 16), jnp.float32, sharding=sx),
+                jax.ShapeDtypeStruct((16, 8), jnp.float32, sharding=sy))
+        hlo = f.lower(*args).compile().as_text()
+        assert "all-reduce" in hlo
+
+
+class TestElementwiseRule:
+    def test_merge(self):
+        reqs, out = elementwise_rule(DA(["x", None]), DA([None, "y"]))
+        assert out.dims_mapping == ["x", "y"]
+
+    def test_broadcast_rank(self):
+        reqs, out = elementwise_rule(DA(["x", None, "y"]), DA([None, "y"]))
+        assert out.dims_mapping == ["x", None, "y"]
+        assert reqs[1].dims_mapping == [None, "y"]
+
+    def test_conflict_replicates(self):
+        reqs, out = elementwise_rule(DA(["x"]), DA(["y"]))
+        assert out.dims_mapping == [None]
+
+    def test_partial_preserved_when_same(self):
+        reqs, out = elementwise_rule(DA([None], {"x"}), DA([None], {"x"}))
+        assert out.partial == {"x"}
+
+    def test_partial_dropped_when_mixed(self):
+        reqs, out = elementwise_rule(DA([None], {"x"}), DA([None]))
+        assert out.partial == set()
+
+
+class TestEmbeddingRule:
+    def test_row_parallel_gives_partial(self):
+        tr, ir, out = embedding_rule(DA(["x", None]), DA([None, None]))
+        assert out.partial == {"x"}
+        assert out.dims_mapping == [None, None, None]
+
+    def test_col_parallel_shards_hidden(self):
+        tr, ir, out = embedding_rule(DA([None, "y"]), DA(["x", None]))
+        assert out.dims_mapping == ["x", None, "y"] and not out.partial
+
+
+class TestReductionSoftmaxNorm:
+    def test_reduce_sharded_axis_partial(self):
+        xr, out = reduction_rule(DA(["x", "y"]), axis=[1])
+        assert out.dims_mapping == ["x"] and out.partial == {"y"}
+
+    def test_reduce_keepdim(self):
+        xr, out = reduction_rule(DA(["x", "y"]), axis=[1], keepdim=True)
+        assert out.dims_mapping == ["x", None]
+
+    def test_softmax_forces_replicated_axis(self):
+        req, out = softmax_rule(DA(["x", "y"]), axis=-1)
+        assert req.dims_mapping == ["x", None]
+
+    def test_layer_norm(self):
+        req, out = layer_norm_rule(DA(["x", "y", "y"]), begin_norm_axis=1)
+        assert req.dims_mapping == ["x", None, None]
+
+    def test_cross_entropy_vocab_parallel(self):
+        lr, lbr, out = cross_entropy_rule(DA(["x", None, "y"]),
+                                          DA(["x", None]))
+        assert out.partial == {"y"} and out.dims_mapping == ["x", None]
+
+
+class TestLayoutRules:
+    def test_transpose(self):
+        xr, out = transpose_rule(DA(["x", None, "y"]), [2, 0, 1])
+        assert out.dims_mapping == ["y", "x", None]
+
+    def test_reshape_split_keeps_major(self):
+        xr, out = reshape_rule(DA(["x", None]), [8, 16], [2, 4, 16])
+        assert out.dims_mapping == ["x", None, None]
+
+    def test_reshape_merge_keeps_major(self):
+        xr, out = reshape_rule(DA(["x", None, "y"]), [2, 4, 16], [8, 16])
+        assert out.dims_mapping == ["x", "y"]
+
+    def test_reshape_minor_shard_requires_replicate(self):
+        xr, out = reshape_rule(DA([None, "x", None]), [2, 4, 16], [8, 16])
+        assert xr.dims_mapping == [None, None, None]
+
+
+class TestFlashAttentionRule:
+    def test_batch_head_shard_ok(self):
+        q = DA(["x", None, "y", None])
+        r, _, _, out = flash_attention_rule(q, q, q)
+        assert out.dims_mapping == ["x", None, "y", None]
+
+    def test_seq_shard_needs_sep_axis(self):
+        q = DA([None, "x", None, None])
+        r, _, _, out = flash_attention_rule(q, q, q)
+        assert r.dims_mapping[1] is None          # no CP axis: replicate
+        r2, _, _, out2 = flash_attention_rule(q, q, q, sep_axis="x")
+        assert out2.dims_mapping[1] == "x"        # ring CP keeps seq shard
+
+    def test_head_dim_always_replicated(self):
+        q = DA([None, None, None, "y"])
+        r, _, _, out = flash_attention_rule(q, q, q)
+        assert r.dims_mapping[3] is None
