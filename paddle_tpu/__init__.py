@@ -101,6 +101,7 @@ def in_static_mode():
     return _static_mode
 
 from . import models  # noqa: F401
+from . import inference  # noqa: F401
 from . import static  # noqa: F401
 from .core.string_tensor import StringTensor, to_string_tensor  # noqa: F401
 import jax.numpy as _jnp

@@ -60,12 +60,23 @@ def _record_static(name: str, fn: Callable, treedef, leaves):
     consts: List[Any] = []
     avals: List[Any] = []
     prog = None
+    from .tensor import Parameter
     for i, leaf in enumerate(leaves):
         if isinstance(leaf, Variable):
             prog = prog or leaf.program
             dyn_idx.append(i)
             markers.append(leaf)
             avals.append(leaf.aval())
+            static_leaves.append(None)
+        elif isinstance(leaf, Parameter) and leaf.trainable:
+            # live param ref (NOT a frozen const): replay reads the box's
+            # current value, and the static training path (append_backward
+            # /minimize) differentiates + updates through this slot
+            # (reference: Parameter vars in the Program's global block)
+            v = jnp.asarray(leaf._value)
+            dyn_idx.append(i)
+            markers.append(leaf)
+            avals.append(jax.ShapeDtypeStruct(v.shape, v.dtype))
             static_leaves.append(None)
         elif _is_dynamic(leaf):
             from .tensor import Tensor

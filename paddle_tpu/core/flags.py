@@ -133,6 +133,9 @@ define_flag("default_dtype", "float32", "default floating dtype for creation ops
 define_flag("use_donated_buffers", True, "donate param/opt buffers in jitted train steps")
 define_flag("allocator_strategy", "xla", "memory allocator strategy (informational on TPU)")
 define_flag("pallas_interpret", False, "force pallas kernels to run in interpret mode")
+define_flag("pallas_force_compile", False,
+            "force pallas kernels onto the Mosaic compile path even off-TPU "
+            "(cross-platform lowering/export, e.g. jax.export platforms=['tpu'])")
 define_flag("use_autotune", False,
             "Time Pallas block-size candidates per shape and cache the "
             "fastest (reference FLAGS_use_autotune)")

@@ -1,0 +1,222 @@
+"""paddle.inference parity — the deployment predictor facade.
+
+Reference: AnalysisPredictor (fluid/inference/api/analysis_predictor.h:105)
++ the C API surface (Config / create_predictor / get_input_handle / run /
+get_output_handle, paddle_inference_api.h).
+
+TPU-native scope: the reference predictor's pass pipeline (framework/ir
+fusion passes, TRT subgraphs) collapses into XLA — a saved model here is a
+serialized STABLEHLO program (jit.save), so load = deserialize + jit, and
+every fusion the reference applies post-hoc is already in the compiled
+artifact.  The facade keeps the reference's handle-style API so deployment
+code ports 1:1, and adds the LLM serving path: ``LLMPredictor`` drives the
+paged-KV / fused-decode generate() loop (MMHA + fused_multi_transformer
+analog, models/generation.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorHandle",
+           "LLMPredictor", "create_llm_predictor"]
+
+
+class Config:
+    """Predictor configuration (reference: paddle_analysis_config.h).
+
+    ``Config(prog_file, params_file)`` or ``Config(model_dir)`` with the
+    jit.save naming convention (<prefix>.pdmodel / <prefix>.pdparams)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+        self._params_file = params_file
+        self._device = None          # None = default backend
+        self._memory_pool_mb = None
+        self._enable_profile = False
+
+    # -- device selection (reference enable_use_gpu / disable_gpu) --------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # accepted for API parity; device selection on TPU is the JAX
+        # platform, not a predictor flag
+        self._memory_pool_mb = memory_pool_init_size_mb
+        self._device = ("accel", device_id)
+
+    def disable_gpu(self):
+        self._device = ("cpu", 0)
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def model_dir(self):
+        return self._prefix
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_file or (self._prefix or "") + ".pdparams"
+
+    def summary(self) -> str:
+        return (f"Config(prefix={self._prefix!r}, device={self._device}, "
+                f"profile={self._enable_profile})")
+
+
+class PredictorHandle:
+    """Input/output tensor handle (reference: ZeroCopyTensor /
+    paddle_infer::Tensor — copy_from_cpu / copy_to_cpu)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.asarray(arr)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"handle {self.name!r} holds no data yet "
+                               "(run() first)")
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else None
+
+
+class Predictor:
+    """Deployment predictor over a jit.save'd STABLEHLO artifact
+    (reference AnalysisPredictor: load program -> optimize -> run;
+    optimization here happened at export)."""
+
+    def __init__(self, config: Config):
+        from ..jit.api import load as _jit_load
+        if config._prefix is None:
+            raise ValueError("Config needs the saved-model prefix")
+        if not os.path.exists(config.prog_file()):
+            raise FileNotFoundError(config.prog_file())
+        self._layer = _jit_load(config._prefix)
+        self._config = config
+        n_in = self._n_program_inputs()
+        self._inputs = [PredictorHandle(f"input_{i}") for i in range(n_in)]
+        self._outputs: List[PredictorHandle] = []
+
+    def _n_program_inputs(self) -> int:
+        exported = self._layer._exported
+        n_params = len(jax_tree_leaves(self._layer._params))
+        return len(exported.in_avals) - n_params
+
+    # -- handle API -------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return [h.name for h in self._inputs]
+
+    def get_input_handle(self, name: str) -> PredictorHandle:
+        for h in self._inputs:
+            if h.name == name:
+                return h
+        raise KeyError(name)
+
+    def get_output_names(self) -> List[str]:
+        return [h.name for h in self._outputs]
+
+    def get_output_handle(self, name: str) -> PredictorHandle:
+        for h in self._outputs:
+            if h.name == name:
+                return h
+        raise KeyError(name)
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """Execute the program.  Either pass arrays directly (returns
+        outputs, the python-API style) or pre-fill input handles and read
+        output handles (the C-API style)."""
+        if inputs is None:
+            inputs = [h.copy_to_cpu() if h._value is not None else None
+                      for h in self._inputs]
+            if any(v is None for v in inputs):
+                missing = [h.name for h, v in zip(self._inputs, inputs)
+                           if v is None]
+                raise RuntimeError(f"inputs not set: {missing}")
+        outs = self._layer(*inputs)
+        flat = outs if isinstance(outs, (list, tuple)) else [outs]
+        vals = [np.asarray(o._value if hasattr(o, "_value") else o)
+                for o in flat]
+        self._outputs = [PredictorHandle(f"output_{i}")
+                         for i in range(len(vals))]
+        for h, v in zip(self._outputs, vals):
+            h._value = v
+        return vals
+
+
+def jax_tree_leaves(tree):
+    import jax
+    return jax.tree.leaves(tree)
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Reference: paddle_infer::CreatePredictor."""
+    return Predictor(config)
+
+
+# ---------------------------------------------------------------------------
+# LLM serving path (paged-KV generate)
+# ---------------------------------------------------------------------------
+
+class LLMPredictor:
+    """Serving facade for causal-LM generation (the reference's
+    fused_multi_transformer + masked_multihead_attention serving stack,
+    SURVEY §2.6): loads <prefix>.pdparams + a pickled config, drives the
+    compiled prefill + decode-scan rollout (models/generation.py) with the
+    fused-decode cache (MMHA analog) — no Python-per-token dispatch."""
+
+    def __init__(self, model_family: str, cfg, params):
+        from ..models import generation as gen
+        self.family = model_family
+        self.cfg = cfg
+        self.params = params
+        self._gen = {"gpt": gen.gpt_generate,
+                     "llama": gen.llama_generate}[model_family]
+
+    @classmethod
+    def from_dir(cls, path: str) -> "LLMPredictor":
+        import pickle
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..framework.io import load as _load
+        with open(os.path.join(path, "llm_config.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        state = _load(os.path.join(path, "model.pdparams"))
+        params = jax.tree.map(jnp.asarray, state)
+        return cls(meta["family"], meta["cfg"], params)
+
+    def save(self, path: str):
+        import pickle
+
+        import jax
+        import numpy as np
+
+        from ..framework.io import save as _save
+        os.makedirs(path, exist_ok=True)
+        # framework.io.save handles the nested dict tree natively
+        _save(jax.tree.map(np.asarray, self.params),
+              os.path.join(path, "model.pdparams"))
+        with open(os.path.join(path, "llm_config.pkl"), "wb") as f:
+            pickle.dump({"family": self.family, "cfg": self.cfg}, f)
+
+    def generate(self, input_ids, max_new_tokens: int, **kw):
+        return self._gen(self.params, self.cfg, input_ids,
+                         max_new_tokens, **kw)
+
+
+def create_llm_predictor(path: str) -> LLMPredictor:
+    return LLMPredictor.from_dir(path)

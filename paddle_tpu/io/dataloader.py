@@ -147,14 +147,22 @@ class DataLoader:
         return len(self.batch_sampler)
 
     # ------------------------------------------------------------------
-    def _get_pool(self):
+    def _new_pool(self):
         from .worker import WorkerPool
+        return WorkerPool(
+            self.dataset, self.collate_fn, self.num_workers,
+            use_shared_memory=self.use_shared_memory,
+            worker_init_fn=self.worker_init_fn, timeout=self.timeout,
+            iterable=self._iterable_ds)
+
+    def _get_pool(self):
+        # one pool serves ONE live epoch: concurrent iterators must not
+        # share a result queue (their batch indices would interleave), so
+        # a busy persistent pool spawns a dedicated throwaway sibling
         if self._pool is None:
-            self._pool = WorkerPool(
-                self.dataset, self.collate_fn, self.num_workers,
-                use_shared_memory=self.use_shared_memory,
-                worker_init_fn=self.worker_init_fn, timeout=self.timeout,
-                iterable=self._iterable_ds)
+            self._pool = self._new_pool()
+        if getattr(self._pool, "_in_epoch", False):
+            return self._new_pool()
         return self._pool
 
     def _release_pool(self):
@@ -173,6 +181,7 @@ class DataLoader:
             # subprocess workers (reference reader.py:262 multiprocess
             # mode): index-fed, shared-memory transport, sampler order
             pool = self._get_pool()
+            dedicated = pool is not self._pool
             if self._iterable_ds:
                 # each worker owns a stream shard (get_worker_info-style);
                 # feed per-worker batch-size tasks round-robin
@@ -188,7 +197,9 @@ class DataLoader:
                                                      if self._iterable_ds
                                                      else False))
             finally:
-                if not self.persistent_workers:
+                if dedicated:
+                    pool.shutdown()
+                elif not self.persistent_workers:
                     self._release_pool()
         elif self._iterable_ds:
             it = iter(self.dataset)

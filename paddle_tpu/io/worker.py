@@ -211,6 +211,7 @@ class WorkerPool:
             self._procs.append(p)
         self._alive = True
         self._epoch = -1
+        self._in_epoch = False
 
     # -- epoch iteration --------------------------------------------------
     def run_epoch(self, index_iter, prefetch: int, drop_last: bool = False):
@@ -219,6 +220,7 @@ class WorkerPool:
         covers early exits — consumer break, iterable end — so persistent
         workers can't cross-contaminate batch indices across epochs)."""
         self._epoch += 1
+        self._in_epoch = True
         epoch = self._epoch
         reorder: dict = {}
         next_out = 0
@@ -285,6 +287,7 @@ class WorkerPool:
             # drain every outstanding task so SHM segments are unlinked and
             # the next epoch starts from an empty result queue
             self._drain(next_in - received)
+            self._in_epoch = False
 
     def _drain(self, outstanding: int):
         import time

@@ -126,17 +126,10 @@ def ignore_module(modules):
     return None
 
 
-class InputSpec:
-    """``paddle.static.InputSpec`` parity (shape/dtype/name), used to
-    describe ``jit.save`` example inputs."""
-
-    def __init__(self, shape, dtype="float32", name=None):
-        self.shape = tuple(shape)
-        self.dtype = dtype
-        self.name = name
-
-    def __repr__(self):
-        return f"InputSpec(shape={self.shape}, dtype={self.dtype!r})"
+# ONE InputSpec across jit and static (the reference exposes a single
+# paddle.static.InputSpec) — duplicated classes broke isinstance checks
+# when users imported the "other" one
+from ..static import InputSpec  # noqa: E402,F401
 
 
 class TranslatedLayer:

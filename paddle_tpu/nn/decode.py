@@ -16,6 +16,7 @@ import collections
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dtypes import index_dtype
 from ..core.tensor import Tensor
 from ..ops import api as ops
 from .. import utils as _nest
@@ -107,7 +108,8 @@ class BeamSearchDecoder(Decoder):
         cell_states = _nest.map_structure(self._expand_to_beam_size,
                                           initial_cell_states)
         init_inputs = Tensor(jnp.full(
-            (self.batch_size, self.beam_size), self.start_token, jnp.int64),
+            (self.batch_size, self.beam_size), self.start_token,
+            index_dtype()),
             stop_gradient=True)
         row = jnp.asarray([[0.0] + [-self.kinf] * (self.beam_size - 1)],
                           jnp.float32)
@@ -116,7 +118,7 @@ class BeamSearchDecoder(Decoder):
         finished = Tensor(jnp.zeros((self.batch_size, self.beam_size), bool),
                           stop_gradient=True)
         lengths = Tensor(jnp.zeros((self.batch_size, self.beam_size),
-                                   jnp.int64), stop_gradient=True)
+                                   index_dtype()), stop_gradient=True)
         if self.embedding_fn is not None:
             init_inputs = self.embedding_fn(init_inputs)
         return (init_inputs,
@@ -135,7 +137,7 @@ class BeamSearchDecoder(Decoder):
                                    self.beam_size * vocab)
         topk_scores, topk_idx = jax.lax.top_k(scores, self.beam_size)
         beam_indices = topk_idx // vocab
-        token_indices = (topk_idx % vocab).astype(jnp.int64)
+        token_indices = (topk_idx % vocab).astype(index_dtype())
         next_log_probs = jnp.take_along_axis(scores, topk_idx, axis=1)
 
         def regather(x):
@@ -152,7 +154,7 @@ class BeamSearchDecoder(Decoder):
         out = self.OutputWrapper(
             Tensor(topk_scores, stop_gradient=True),
             Tensor(token_indices, stop_gradient=True),
-            Tensor(beam_indices.astype(jnp.int64), stop_gradient=True))
+            Tensor(beam_indices.astype(index_dtype()), stop_gradient=True))
         state = self.StateWrapper(
             next_cell_states,
             Tensor(next_log_probs, stop_gradient=True),
@@ -205,9 +207,9 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     time = 0
     limit = int(max_step_num) if max_step_num is not None else 10 ** 9
 
-    seq_lens = jnp.zeros(finished.shape, jnp.int64)
+    seq_lens = jnp.zeros(finished.shape, index_dtype())
     while time < limit:
-        t = Tensor(jnp.asarray([time], jnp.int64), stop_gradient=True)
+        t = Tensor(jnp.asarray([time], index_dtype()), stop_gradient=True)
         outputs, next_states, next_inputs, next_finished = decoder.step(
             t, inputs, states, **kwargs)
         nf = (next_finished._value if isinstance(next_finished, Tensor)

@@ -167,8 +167,9 @@ def full_(x, shape=None, fill_value=0.0, dtype=None):
 
 
 def full_int_array(value, dtype=None):
+    from ...core.dtypes import index_dtype
     return jnp.asarray(value, _dtype(dtype, default_float=False)
-                       if dtype else jnp.int64)
+                       if dtype else index_dtype())
 
 
 def full_with_tensor(fill_value, shape, dtype=None):

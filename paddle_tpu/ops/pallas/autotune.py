@@ -137,3 +137,8 @@ def lookup(name: str, key: Tuple, default: Any) -> Any:
     if got is None:
         return default
     return tuple(got) if isinstance(got, list) else got
+
+
+def cache_summary():
+    """Recorded winners (kernel/shape key -> chosen config)."""
+    return dict(_CACHE)

@@ -139,10 +139,11 @@ def decode_attention(q, k_cache, v_cache, lengths,
                          f"({Hkv})")
     s = scale if scale is not None else 1.0 / math.sqrt(D)
     if use_pallas is None:
-        # same dispatch as every other kernel: real accelerator, or
-        # interpret-mode forced via FLAGS (how CPU tests exercise kernels)
+        # same dispatch as every other kernel: real accelerator, forced
+        # interpret (CPU tests), or forced Mosaic compile (TPU cross-
+        # lowering lane)
         from ...core.flags import FLAGS
-        if FLAGS.pallas_interpret:
+        if FLAGS.pallas_interpret or FLAGS.pallas_force_compile:
             use_pallas = True
         else:
             try:

@@ -164,6 +164,16 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static import is_static_variable
+        if is_static_variable(loss):
+            # static-graph training (reference Optimizer.minimize →
+            # append_backward + optimizer ops): register on the Program;
+            # Executor.run executes the fused grad+update step
+            prog = loss.program
+            prog._loss = loss
+            prog._optimizer = self
+            params = list(prog.params.values())
+            return None, [(p, p.name + "@GRAD") for p in params]
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in (self._parameters or [])]

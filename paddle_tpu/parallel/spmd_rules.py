@@ -100,8 +100,12 @@ def matmul_rule(x: TensorDistAttr, y: TensorDistAttr,
     m, kx = xm[-2], xm[-1]
     ky, n = ym[-2], ym[-1]
     k = _merge_dim(kx, ky)
-    # m/n may not reuse an axis already taken by k or each other
-    taken = {k} if k else set()
+    # m/n may not reuse an axis already taken by k, a batch dim, or each
+    # other (a mesh axis can appear at most once in a PartitionSpec —
+    # reference ShardingMergeForTensors resolves the same conflicts)
+    taken = {a for a in batch if a is not None}
+    if k is not None:
+        taken.add(k)
     m = None if m in taken else m
     taken.add(m)
     n = None if n in taken or n == m else n

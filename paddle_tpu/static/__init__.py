@@ -10,9 +10,14 @@ recording as one pure function of the feeds and ``jax.jit``s it — the
 Program/Executor pair collapses onto XLA exactly like ``jit.to_static``,
 but through the reference's build-then-run API shape.
 
-Supported surface: inference-style programs (data → ops → fetch).  The
-legacy in-graph training loop (append_backward/minimize) is out of scope —
-training is the compiled dygraph path (SURVEY §7 design decision).
+Supported surface: inference programs (data → ops → fetch) AND the
+static training loop — ``optimizer.minimize(loss)`` under
+``program_guard`` registers the optimizer on the Program, and
+``Executor.run`` then executes one fused jitted step: loss +
+``jax.value_and_grad`` over the Parameter slots + the optimizer's pure
+``apply_gradients``, writing updated weights back to the live
+Parameter boxes (reference: base/backward.py append_backward +
+optimizer ops + PirInterpreter, collapsed into one XLA program).
 """
 
 from __future__ import annotations
@@ -28,7 +33,8 @@ from ..core.tensor import Tensor
 
 __all__ = ["Program", "program_guard", "default_main_program",
            "default_startup_program", "data", "Executor", "Variable",
-           "InputSpec", "CPUPlace", "CUDAPlace", "TPUPlace"]
+           "InputSpec", "CPUPlace", "CUDAPlace", "TPUPlace",
+           "append_backward"]
 
 
 class Variable:
@@ -130,6 +136,9 @@ class Program:
         self.id = Program._counter
         self.nodes: List[_Node] = []
         self.feeds: Dict[str, Variable] = {}
+        self.params: Dict[str, Any] = {}    # name -> live Parameter box
+        self._loss: Optional[Variable] = None
+        self._optimizer = None
         self._name_i = 0
 
     def _fresh(self, prefix="tmp"):
@@ -143,12 +152,18 @@ class Program:
         p = Program()
         p.nodes = list(self.nodes)
         p.feeds = dict(self.feeds)
+        p.params = dict(self.params)
         return p
 
     # ---- recording hook used by core.dispatch ----
     def record(self, name, call, markers, consts, out_avals, out_treedef):
-        """Append a node.  ``markers``: per-dynamic-slot Variable or None
-        (None slots read from ``consts`` in order at replay)."""
+        """Append a node.  ``markers``: per-dynamic-slot Variable,
+        Parameter (live box), or None (None slots read from ``consts``
+        in order at replay)."""
+        from ..core.tensor import Parameter
+        for m in markers:
+            if isinstance(m, Parameter):
+                self.params.setdefault(m.name, m)
         outs = [Variable(self, self._fresh(name), a.shape, a.dtype)
                 for a in out_avals]
         self.nodes.append(_Node(call, markers, consts, outs))
@@ -156,9 +171,14 @@ class Program:
 
     # ---- replay ----
     def build_fn(self, fetch_vars: Sequence[Variable]):
+        """Replay as ``run(feed_values, param_values=None)``.  Parameters
+        read from ``param_values`` (name -> array) when given — the static
+        training path differentiates wrt that dict — else from the live
+        Parameter boxes (inference replay sees updated weights)."""
+        from ..core.tensor import Parameter
         feed_names = list(self.feeds)
 
-        def run(feed_values: Dict[str, Any]):
+        def run(feed_values: Dict[str, Any], param_values=None):
             env: Dict[int, Any] = {}
             for n in feed_names:
                 env[id(self.feeds[n])] = jnp.asarray(feed_values[n])
@@ -166,7 +186,12 @@ class Program:
                 dyn = []
                 it_const = iter(node.const_args)
                 for v in node.in_vars:
-                    if isinstance(v, Variable):
+                    if isinstance(v, Parameter):
+                        if param_values is not None:
+                            dyn.append(param_values[v.name])
+                        else:
+                            dyn.append(jnp.asarray(v._value))
+                    elif isinstance(v, Variable):
                         if id(v) not in env:
                             raise KeyError(
                                 f"variable {v.name!r} used before "
@@ -252,13 +277,35 @@ class TPUPlace:
         self.id = _id
 
 
+def append_backward(loss: Variable, parameter_list=None, no_grad_set=None):
+    """Mark ``loss`` for in-graph training (reference base/backward.py
+    append_backward).  TPU-native: no grad ops are appended — the replay
+    function is differentiated with ``jax.value_and_grad`` wrt the
+    program's Parameter slots when the Executor runs the train step.
+    Returns [(param, grad_name)] for API parity."""
+    prog = loss.program
+    prog._loss = loss
+    params = list(parameter_list) if parameter_list else \
+        list(prog.params.values())
+    return [(p, p.name + "@GRAD") for p in params]
+
+
 class Executor:
     """Program runner (reference executor.Executor → here: replay the
-    recording as a pure function and jit it, cached per fetch set)."""
+    recording as a pure function and jit it, cached per fetch set).
+
+    Training programs (``optimizer.minimize(loss)`` called under
+    ``program_guard``) run a jitted (loss, grads, apply) step per
+    ``run()`` call: gradients via ``jax.value_and_grad`` over the
+    Parameter slots, updates via the optimizer's pure
+    ``apply_gradients``, new weights written back to the live boxes —
+    the PirInterpreter + optimizer-op path collapsed into one XLA
+    program."""
 
     def __init__(self, place=None):
         self.place = place
         self._cache: Dict[Any, Any] = {}
+        self._train_state: Dict[int, Dict[str, Any]] = {}
 
     def run(self, program: Optional[Program] = None, feed=None,
             fetch_list: Sequence[Variable] = (), return_numpy=True):
@@ -266,18 +313,65 @@ class Executor:
         feed = feed or {}
         if not program.nodes and not fetch_list:
             return []          # startup program: params are eager here
-        key = (id(program), len(program.nodes),
-               tuple(id(v) for v in fetch_list))
-        fn = self._cache.get(key)
-        if fn is None:
-            raw = program.build_fn(list(fetch_list))
-            fn = jax.jit(raw)
-            self._cache[key] = fn
-        outs = fn({k: np.asarray(v._value if isinstance(v, Tensor) else v)
-                   for k, v in feed.items()})
+        feed_vals = {k: np.asarray(v._value if isinstance(v, Tensor) else v)
+                     for k, v in feed.items()}
+        if program._optimizer is not None and program._loss is not None:
+            outs = self._run_train(program, feed_vals, list(fetch_list))
+        else:
+            key = (id(program), len(program.nodes),
+                   tuple(id(v) for v in fetch_list))
+            fn = self._cache.get(key)
+            if fn is None:
+                raw = program.build_fn(list(fetch_list))
+                fn = jax.jit(raw)
+                self._cache[key] = fn
+            # params ride as traced ARGUMENTS — reading p._value inside
+            # the traced fn would constant-fold the weights into the
+            # cached executable and serve stale values after training
+            param_vals = {n: jnp.asarray(p._value)
+                          for n, p in program.params.items()}
+            outs = fn(feed_vals, param_vals)
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
+
+    def _run_train(self, program: Program, feed_vals, fetch_vars):
+        opt = program._optimizer
+        loss_var = program._loss
+        key = (id(program), len(program.nodes), id(loss_var),
+               tuple(id(v) for v in fetch_vars))
+        cached = self._cache.get(key)
+        if cached is None:
+            replay = program.build_fn([loss_var] + fetch_vars)
+
+            def step(param_vals, slots, t, lr, feeds):
+                def loss_fn(pv):
+                    outs = replay(feeds, pv)
+                    return outs[0], outs
+                grads, outs = jax.grad(loss_fn, has_aux=True)(param_vals)
+                new_p, new_s = opt.apply_gradients(param_vals, grads,
+                                                   slots, lr, t)
+                return outs, new_p, new_s
+
+            cached = jax.jit(step, donate_argnums=(0, 1))
+            self._cache[key] = cached
+        st = self._train_state.get(id(program))
+        if st is None:
+            slots = {name: opt._init_slot_state(jnp.asarray(p._value))
+                     for name, p in program.params.items()}
+            st = {"slots": slots, "t": 0}
+            self._train_state[id(program)] = st
+        param_vals = {name: jnp.asarray(p._value)
+                      for name, p in program.params.items()}
+        st["t"] += 1
+        outs, new_p, new_s = cached(param_vals, st["slots"], st["t"],
+                                    float(opt.get_lr()), feed_vals)
+        st["slots"] = new_s
+        for name, p in program.params.items():
+            p._value = new_p[name]
+        if hasattr(opt, "_step_count"):
+            opt._step_count += 1
+        return outs[1:]         # user fetches (loss itself if requested)
 
 
 def is_static_variable(x) -> bool:

@@ -75,4 +75,5 @@ def array_read(array: TensorArray, i) -> Tensor:
 
 
 def array_length(array: TensorArray):
-    return Tensor(jnp.asarray(len(array), jnp.int64))
+    from .core.dtypes import index_dtype
+    return Tensor(jnp.asarray(len(array), index_dtype()))

@@ -1,0 +1,128 @@
+"""AMP O2 master-weights + GradScaler found_inf dynamics (VERDICT r2 weak
+#8; reference: amp/auto_cast.py amp_decorate O2 master weights,
+grad_scaler.py check_finite_and_unscale / update_loss_scaling kernels).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import amp
+
+
+def _np(x):
+    return np.asarray(x._value)
+
+
+class TestO2MasterWeights:
+    def _decorated(self, dtype="bfloat16"):
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                    parameters=net.parameters())
+        net, opt = amp.decorate(net, opt, level="O2", dtype=dtype)
+        return net, opt
+
+    def test_params_cast_low_precision(self):
+        net, opt = self._decorated()
+        assert net.weight.dtype == jnp.bfloat16
+        assert opt._multi_precision is True
+
+    def test_master_weights_kept_fp32_and_updated(self):
+        net, opt = self._decorated()
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(8, 4).astype(np.float32))
+        loss = (net(x) ** 2).mean()
+        opt.clear_grad()
+        loss.backward()
+        opt.step()
+        st = opt._state[net.weight.name]
+        assert "master_weight" in st
+        assert st["master_weight"].dtype == jnp.float32
+        # low-precision param tracks the fp32 master (cast)
+        np.testing.assert_allclose(
+            _np(net.weight).astype(np.float32),
+            np.asarray(st["master_weight"]).astype(np.float32),
+            atol=0.02)
+
+    def test_o2_accumulates_in_master_not_bf16(self):
+        """Many tiny updates that individually underflow bf16 rounding
+        must still accumulate through the fp32 master copy."""
+        net, opt = self._decorated()
+        opt._lr = 1e-3
+        w0 = np.asarray(_np(net.weight), np.float32).copy()
+        x = paddle.to_tensor(np.full((4, 4), 0.01, np.float32))
+        for _ in range(10):
+            loss = (net(x)).sum()
+            opt.clear_grad()
+            loss.backward()
+            opt.step()
+        master = np.asarray(opt._state[net.weight.name]["master_weight"])
+        assert not np.allclose(master, w0, atol=1e-4)   # progress made
+
+    def test_o1_forward_bf16_matmul(self):
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        with amp.auto_cast(level="O1"):
+            out = paddle.matmul(x, x)
+        assert out.dtype == jnp.bfloat16
+
+    def test_black_list_stays_fp32(self):
+        x = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+        with amp.auto_cast(level="O1", custom_black_list={"matmul"}):
+            out = paddle.matmul(x, x)
+        assert out.dtype == jnp.float32
+
+
+class TestGradScalerFoundInf:
+    def _setup(self, scale=16.0):
+        net = nn.Linear(2, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        scaler = amp.GradScaler(init_loss_scaling=scale, incr_ratio=2.0,
+                                decr_ratio=0.5, incr_every_n_steps=2,
+                                decr_every_n_nan_or_inf=1)
+        return net, opt, scaler
+
+    def test_scaled_loss_unscales_to_true_grad(self):
+        net, opt, scaler = self._setup(scale=16.0)
+        x = paddle.to_tensor(np.ones((4, 2), np.float32))
+        loss = net(x).sum()
+        scaled = scaler.scale(loss)
+        np.testing.assert_allclose(float(_np(scaled)), 16 * float(_np(loss)),
+                                   rtol=1e-6)
+        opt.clear_grad()
+        scaled.backward()
+        scaler.unscale_(opt)
+        # d(sum(xW+b))/dW = sum of x rows = 4 per entry, after unscale
+        np.testing.assert_allclose(_np(net.weight.grad),
+                                   np.full((2, 1), 4.0), rtol=1e-5)
+        assert scaler._found_inf is False
+
+    def test_inf_grad_skips_step_and_decays_scale(self):
+        net, opt, scaler = self._setup(scale=16.0)
+        w_before = _np(net.weight).copy()
+        x = paddle.to_tensor(np.array([[np.inf, 1.0]], np.float32))
+        loss = net(x).sum()
+        opt.clear_grad()
+        scaler.scale(loss).backward()
+        scaler.step(opt)                 # must SKIP the update
+        scaler.update()
+        np.testing.assert_array_equal(_np(net.weight), w_before)
+        assert scaler._scale == 8.0      # decayed by decr_ratio
+
+    def test_scale_grows_after_n_good_steps(self):
+        net, opt, scaler = self._setup(scale=4.0)
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        for _ in range(2):               # incr_every_n_steps = 2
+            loss = net(x).sum()
+            opt.clear_grad()
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            scaler.update()
+        assert scaler._scale == 8.0
+
+    def test_disabled_scaler_passthrough(self):
+        net, opt, scaler = self._setup()
+        scaler._enable = False
+        loss = net(paddle.to_tensor(np.ones((1, 2), np.float32))).sum()
+        assert scaler.scale(loss) is loss

@@ -1,0 +1,94 @@
+"""LARS / DGC / LocalSGD meta-optimizers (SURVEY §2.5 static
+meta-optimizers row; reference fleet/meta_optimizers/{lars,dgc,localsgd}
+_optimizer.py, phi dgc_kernel.h)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.optimizer import DGCMomentum, LarsMomentum, LocalSGD
+
+
+def _np(x):
+    return np.asarray(x._value)
+
+
+def _problem():
+    np.random.seed(0)
+    paddle.seed(7)              # param init must not depend on test order
+    x = paddle.to_tensor(np.random.randn(16, 4).astype(np.float32))
+    b = paddle.to_tensor(np.random.randn(16, 2).astype(np.float32))
+    w = paddle.create_parameter([4, 2], "float32")
+    return x, b, w
+
+
+def test_lars_converges():
+    x, b, w = _problem()
+    opt = LarsMomentum(learning_rate=1.0, lars_coeff=0.1, parameters=[w])
+    first = None
+    for _ in range(80):
+        loss = ((paddle.matmul(x, w) - b) ** 2).mean()
+        if first is None:
+            first = float(_np(loss))
+        opt.clear_grad()
+        loss.backward()
+        opt.step()
+    assert float(_np(loss)) < first * 0.6
+
+
+def test_lars_trust_ratio_scales_update():
+    # huge-gradient layer must get a damped effective lr vs plain momentum
+    w = paddle.create_parameter([4], "float32")
+    w._value = np.ones(4, np.float32) * 0.01
+    opt = LarsMomentum(learning_rate=1.0, lars_coeff=0.001, parameters=[w])
+    w.grad = paddle.to_tensor(np.full(4, 100.0, np.float32))
+    before = _np(w).copy()
+    opt.step()
+    delta = np.abs(_np(w) - before).max()
+    assert delta < 0.01       # trust ratio ~ coeff*|w|/|g| shrinks step
+
+
+def test_dgc_residual_carry_and_convergence():
+    x, b, w = _problem()
+    opt = DGCMomentum(learning_rate=0.05, sparsity=(0.5,), parameters=[w])
+    first = None
+    for _ in range(100):
+        loss = ((paddle.matmul(x, w) - b) ** 2).mean()
+        if first is None:
+            first = float(_np(loss))
+        opt.clear_grad()
+        loss.backward()
+        opt.step()
+    # despite sending only half the entries per step, residual carry
+    # preserves convergence (DGC paper claim; dgc_kernel.h residual path)
+    assert float(_np(loss)) < first * 0.3
+    st = opt._state[w.name]
+    assert "u" in st and "v" in st
+
+
+def test_dgc_sparsifies_update():
+    w = paddle.create_parameter([100], "float32")
+    w._value = np.zeros(100, np.float32)
+    opt = DGCMomentum(learning_rate=1.0, sparsity=(0.9,), parameters=[w])
+    g = np.zeros(100, np.float32)
+    g[:20] = np.arange(20, 0, -1)       # 20 nonzero entries
+    w.grad = paddle.to_tensor(g)
+    opt.step()
+    # only ~top-10 entries applied this step
+    changed = np.abs(_np(w)) > 1e-9
+    assert 5 <= changed.sum() <= 15, changed.sum()
+    # the rest remained in the residual
+    assert float(np.abs(np.asarray(opt._state[w.name]["v"])).sum()) > 0
+
+
+def test_localsgd_wraps_and_steps():
+    x, b, w = _problem()
+    inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    opt = LocalSGD(inner, k_steps=2)
+    for _ in range(4):
+        loss = ((paddle.matmul(x, w) - b) ** 2).mean()
+        opt.clear_grad()
+        loss.backward()
+        opt.step()
+    assert opt._local_steps == 4
+    assert inner._step_count == 4

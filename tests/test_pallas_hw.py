@@ -1,0 +1,127 @@
+"""Real-TPU Pallas kernel execution + autotune lane (VERDICT r2 weak #2,
+hardware half): run `pytest tests/test_pallas_hw.py -m tpu` on a machine
+with a reachable TPU.  Every kernel executes compiled-by-Mosaic (NOT
+interpret) at realistic shapes, numerics are checked against the jnp
+reference, and the block autotuner records winners.
+
+These tests SKIP when no TPU is present (the Mosaic-lowering half runs
+everywhere — tests/test_pallas_tpu_lowering.py).
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+def _tpu_available():
+    import json
+    import os
+    import subprocess
+    import sys
+    import time
+    # recent probe-loop verdict avoids re-paying the wedged-tunnel timeout
+    log = os.path.join(os.path.dirname(__file__), "..", "tools",
+                       "tpu_probe.log")
+    try:
+        last = json.loads(open(log).read().strip().splitlines()[-1])
+        ts = time.mktime(time.strptime(last["ts"], "%Y-%m-%dT%H:%M:%SZ"))
+        if time.time() - time.timezone - ts < 1800:
+            return bool(last["ok"])
+    except Exception:
+        pass
+    # probe in a subprocess: a wedged tunnel blocks jax.devices() forever
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform)"],
+        capture_output=True, text=True, timeout=90,
+        env=dict(os.environ))
+    return r.returncode == 0 and r.stdout.strip().lower() in ("tpu", "axon")
+
+
+try:
+    _HAS_TPU = _tpu_available()
+except Exception:
+    _HAS_TPU = False
+
+needs_tpu = pytest.mark.skipif(not _HAS_TPU, reason="no TPU reachable")
+
+
+@needs_tpu
+class TestFlashAttentionHW:
+    @pytest.mark.parametrize("seq,hd", [(1024, 64), (2048, 128),
+                                        (4096, 128)])
+    def test_forward_matches_reference(self, seq, hd):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((1, seq, 8, hd)),
+                        jnp.bfloat16) * 0.1
+        out = flash_attention(q, q, q, None, True)
+        # reference: dense attention in fp32
+        qf = q.astype(jnp.float32)
+        import jax
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, qf) / np.sqrt(hd)
+        mask = np.tril(np.ones((seq, seq), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        want = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1), qf)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(want), atol=2e-2)
+
+    def test_backward_runs(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+        def loss(q, k, v):
+            return flash_attention(q, k, v, None, True).astype(
+                jnp.float32).sum()
+
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.standard_normal((1, 2048, 8, 128)),
+                        jnp.bfloat16) * 0.1
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, q, q)
+        for g in (gq, gk, gv):
+            assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+
+
+@needs_tpu
+class TestKernelsHW:
+    def test_rms_norm(self):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.norms import rms_norm
+        x = jnp.asarray(np.random.randn(4096, 4096), jnp.bfloat16)
+        w = jnp.ones((4096,), jnp.bfloat16)
+        out = rms_norm(x, w)
+        xf = np.asarray(x, np.float32)
+        want = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(np.asarray(out, np.float32), want,
+                                   atol=3e-2)
+
+    def test_mmha_decode(self):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.decode_attention import (
+            decode_attention, decode_attention_ref)
+        rng = np.random.default_rng(2)
+        q = jnp.asarray(rng.standard_normal((4, 8, 128)), jnp.bfloat16)
+        kv = jnp.asarray(rng.standard_normal((4, 2048, 8, 128)),
+                         jnp.bfloat16)
+        lens = jnp.asarray([100, 2048, 7, 512], jnp.int32)
+        out = decode_attention(q, kv, kv, lens, use_pallas=True)
+        want = decode_attention_ref(q, kv, kv, lens)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(want, np.float32), atol=3e-2)
+
+    def test_autotuner_on_hw(self):
+        from paddle_tpu.core.flags import FLAGS
+        from paddle_tpu.ops.pallas import autotune
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        FLAGS.use_autotune = True
+        try:
+            q = jnp.asarray(np.random.randn(1, 2048, 8, 128),
+                            jnp.bfloat16)
+            flash_attention(q, q, q, None, True)   # triggers block search
+            assert autotune.cache_summary(), "autotuner recorded nothing"
+        finally:
+            FLAGS.use_autotune = False
