@@ -100,7 +100,8 @@ def _record_static(name: str, fn: Callable, treedef, leaves):
 
     out_abs = jax.eval_shape(call, avals)
     out_flat, out_treedef = jax.tree.flatten(out_abs)
-    return prog.record(name, call, markers, consts, out_flat, out_treedef)
+    return prog.record(name, call, markers, consts, out_flat, out_treedef,
+                       statics=[s for s in static_leaves if s is not None])
 
 # optional per-op-call hook set by amp.debugging operator-stats collection
 _op_stats_hook: Optional[Callable] = None

@@ -4,6 +4,7 @@
 from __future__ import annotations
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 from ....core.dispatch import run_op
@@ -16,6 +17,7 @@ __all__ = [
     "fused_bias_act", "fused_dropout_add", "swiglu", "fused_linear",
     "fused_linear_activation", "fused_multi_head_attention",
     "masked_multihead_attention", "fused_multi_transformer",
+    "fused_conv_bn_act", "fused_adam",
 ]
 
 
@@ -448,3 +450,92 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
     if caches is not None:
         return out[0], list(out[1:])
     return out
+
+
+def fused_conv_bn_act(x, conv_weight, bn_scale, bn_bias, bn_mean, bn_var,
+                      stride=1, padding=0, epsilon=1e-5,
+                      act: str = "relu", data_format="NCHW"):
+    """Fused conv + batch-norm (inference stats) + activation (reference:
+    phi/kernels/fusion/gpu/fused_scale_bias_relu_conv_bn_kernel.cu).
+
+    TPU-native: BN folds INTO the conv weights algebraically —
+    w' = w * scale/sqrt(var+eps) per out-channel, b' = bias - mean*scale/
+    sqrt(var+eps) — so the whole op is ONE conv plus a bias-activation
+    epilogue XLA fuses; no separate normalization pass ever runs."""
+    from ....nn import functional as F
+
+    def impl(w, sc, bb, mu, var):
+        inv = sc * jax.lax.rsqrt(var + epsilon)
+        w_f = w * inv[:, None, None, None]            # fold into OIHW
+        b_f = bb - mu * inv
+        return w_f, b_f
+
+    # x is NOT an input of the fold — keeping it out of the op keys the
+    # jit cache on the (tiny) weight shapes only, not the batch shape
+    w_f, b_f = run_op("conv_bn_fold", impl,
+                      (conv_weight, bn_scale, bn_bias, bn_mean, bn_var),
+                      {})
+    out = F.conv2d(x, w_f, bias=b_f, stride=stride, padding=padding,
+                   data_format=data_format)
+    if act == "relu":
+        from ....ops import api as _api
+        out = _api.relu(out)
+    elif act not in (None, "identity", "none"):
+        raise ValueError(f"unsupported act {act!r}")
+    return out
+
+
+def fused_adam(params, grads, lrs, moments1, moments2, beta1_pows,
+               beta2_pows, master_weights=None, skip_update=None,
+               beta1=0.9, beta2=0.999, epsilon=1e-8,
+               multi_precision=False, use_adamw=False, weight_decay=0.01):
+    """Multi-tensor Adam (reference phi/kernels/fused_adam_kernel.h): one
+    fused update over a list of params, following the reference contract:
+    ``beta1_pows``/``beta2_pows`` hold beta^t (bias correction divides by
+    ``1 - pow``) and are RETURNED advanced by one factor; with
+    ``master_weights`` the update runs on the fp32 master and the param
+    gets the cast-down copy.
+
+    Returns (params, moments1, moments2, beta1_pows, beta2_pows,
+    master_weights)."""
+    n = len(params)
+
+    def pick(seq, i):
+        return seq[i] if isinstance(seq, (list, tuple)) else seq
+
+    outs = ([], [], [], [], [], [])
+    for i in range(n):
+        if skip_update is not None and bool(
+                np.asarray(getattr(skip_update[i], "_value",
+                                   skip_update[i]))):
+            outs[0].append(params[i])
+            outs[1].append(moments1[i])
+            outs[2].append(moments2[i])
+            outs[3].append(pick(beta1_pows, i))
+            outs[4].append(pick(beta2_pows, i))
+            outs[5].append(None if master_weights is None
+                           else master_weights[i])
+            continue
+
+        def impl(pv, gv, m1v, m2v, b1p, b2p, lr, mw):
+            g32 = gv.astype(jnp.float32)
+            work = mw if mw is not None else pv.astype(jnp.float32)
+            if use_adamw:
+                work = work * (1.0 - lr * weight_decay)
+            nm1 = beta1 * m1v + (1 - beta1) * g32
+            nm2 = beta2 * m2v + (1 - beta2) * g32 * g32
+            mhat = nm1 / (1 - b1p)            # pows hold beta^t already
+            vhat = nm2 / (1 - b2p)
+            new_work = work - lr * mhat / (jnp.sqrt(vhat) + epsilon)
+            return (new_work.astype(pv.dtype), nm1, nm2,
+                    b1p * beta1, b2p * beta2,
+                    new_work if mw is not None else None)
+
+        mw = None if master_weights is None else master_weights[i]
+        res = run_op("fused_adam", impl,
+                     (params[i], grads[i], moments1[i], moments2[i],
+                      pick(beta1_pows, i), pick(beta2_pows, i),
+                      pick(lrs, i), mw), {})
+        for acc, v in zip(outs, res):
+            acc.append(v)
+    return outs

@@ -1,7 +1,7 @@
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
 from .extra import ASGD, LBFGS, Rprop  # noqa: F401
-from .meta import DGCMomentum, LarsMomentum, LocalSGD  # noqa: F401
+from .meta import DGCMomentum, DistributedFusedLamb, LarsMomentum, LocalSGD  # noqa: F401
 from .optimizers import (  # noqa: F401
     SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, NAdam,
     RAdam, RMSProp,

@@ -228,7 +228,28 @@ class Lamb(Optimizer):
         return {"moment1": jnp.zeros(v.shape, jnp.float32),
                 "moment2": jnp.zeros(v.shape, jnp.float32)}
 
-    def _update(self, p, g, s, lr, t):
+    def apply_gradients(self, params, grads, state, lr, step):
+        # per-name exclusion needs the param NAME (reference lamb.py
+        # exclude_from_weight_decay_fn), so run the loop here
+        if self._grad_clip is not None:
+            grads = self._grad_clip.apply_values(grads)
+        lr = jnp.asarray(lr, jnp.float32)
+        t = jnp.asarray(step, jnp.int32)
+        new_params, new_state = {}, {}
+        for name, p in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_params[name] = p
+                new_state[name] = state.get(name, {})
+                continue
+            wd = 0.0 if (self._exclude_fn is not None
+                         and self._exclude_fn(name)) else self._wd
+            s = dict(state.get(name, {}))
+            new_params[name], new_state[name] = self._lamb_update(
+                p, g, s, lr, t, wd)
+        return new_params, new_state
+
+    def _lamb_update(self, p, g, s, lr, t, wd):
         g32 = g.astype(jnp.float32)
         p32 = p.astype(jnp.float32)
         m = self._beta1 * s["moment1"] + (1 - self._beta1) * g32
@@ -236,12 +257,15 @@ class Lamb(Optimizer):
         tf = t.astype(jnp.float32)
         mhat = m / (1 - self._beta1 ** tf)
         vhat = v / (1 - self._beta2 ** tf)
-        r = mhat / (jnp.sqrt(vhat) + self._eps) + self._wd * p32
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + wd * p32
         w_norm = jnp.linalg.norm(p32)
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return (p32 - lr * trust * r).astype(p.dtype), \
             {"moment1": m, "moment2": v}
+
+    def _update(self, p, g, s, lr, t):          # functional-API fallback
+        return self._lamb_update(p, g, s, lr, t, self._wd)
 
 
 class NAdam(Adam):

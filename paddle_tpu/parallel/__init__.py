@@ -7,6 +7,8 @@ jax.distributed coordination.
 
 from . import collective  # noqa: F401
 from . import spmd_rules  # noqa: F401
+from . import completion  # noqa: F401
+from .completion import CompletionPlan, complete_program  # noqa: F401
 from . import fleet  # noqa: F401
 from .api import (  # noqa: F401
     Partial, ProcessMesh, Replicate, Shard, dtensor_from_local, reshard,

@@ -115,13 +115,17 @@ class Variable:
 
 
 class _Node:
-    __slots__ = ("call", "in_vars", "const_args", "out_vars")
+    __slots__ = ("name", "call", "in_vars", "const_args", "out_vars",
+                 "statics")
 
-    def __init__(self, call, in_vars, const_args, out_vars):
+    def __init__(self, name, call, in_vars, const_args, out_vars,
+                 statics=()):
+        self.name = name            # op name (completion-pass rule lookup)
         self.call = call            # fn(dyn_values_list) -> outputs
         self.in_vars = in_vars      # Variable inputs, positional in call
         self.const_args = const_args
         self.out_vars = out_vars
+        self.statics = list(statics)  # non-tensor args (axis/perm/...)
 
 
 class Program:
@@ -156,17 +160,19 @@ class Program:
         return p
 
     # ---- recording hook used by core.dispatch ----
-    def record(self, name, call, markers, consts, out_avals, out_treedef):
+    def record(self, name, call, markers, consts, out_avals, out_treedef,
+               statics=()):
         """Append a node.  ``markers``: per-dynamic-slot Variable,
         Parameter (live box), or None (None slots read from ``consts``
-        in order at replay)."""
+        in order at replay).  ``statics``: the op's non-tensor arguments
+        (axis, perm, ...) for the completion pass."""
         from ..core.tensor import Parameter
         for m in markers:
             if isinstance(m, Parameter):
                 self.params.setdefault(m.name, m)
         outs = [Variable(self, self._fresh(name), a.shape, a.dtype)
                 for a in out_avals]
-        self.nodes.append(_Node(call, markers, consts, outs))
+        self.nodes.append(_Node(name, call, markers, consts, outs, statics))
         return jax.tree.unflatten(out_treedef, outs)
 
     # ---- replay ----

@@ -1,0 +1,102 @@
+"""Sharding-completion pass + cost model (reference:
+auto_parallel/static/completion.py + static/cost/; VERDICT r2 'no
+sharding-completion pass, no cost model' partial row)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu import static
+from paddle_tpu.parallel.completion import (complete_program,
+                                            estimate_plan_cost,
+                                            estimate_reshard_cost)
+from paddle_tpu.parallel.spmd_rules import TensorDistAttr as DA
+
+
+def _record_mlp():
+    pt.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [32, 16], "float32")
+        lin1 = nn.Linear(16, 64)
+        lin2 = nn.Linear(64, 8)
+        h = lin1(x)
+        h = pt.relu(h)
+        out = lin2(h)
+        sm = pt.softmax(out)
+    pt.disable_static()
+    return main, x, out, sm, lin1, lin2
+
+
+class TestCompletion:
+    def test_batch_shard_propagates_through_mlp(self):
+        main, x, out, sm, lin1, lin2 = _record_mlp()
+        plan = complete_program(
+            main, {"x": DA(["dp", None])}, mesh_shape={"dp": 8})
+        # every activation stays batch-sharded on dp
+        assert plan.attrs[out.name].dims_mapping == ["dp", None]
+        assert plan.attrs[sm.name].dims_mapping == ["dp", None]
+        # replicated weights + dp-sharded batch need NO reshards
+        assert plan.reshards == [], plan.summary()
+        assert plan.total_comm_bytes() == 0
+
+    def test_column_parallel_weight_shards_activation(self):
+        main, x, out, sm, lin1, lin2 = _record_mlp()
+        plan = complete_program(
+            main, {"x": DA(["dp", None])},
+            param_attrs={lin1.weight.name: DA([None, "mp"])},
+            mesh_shape={"dp": 4, "mp": 2})
+        # col-parallel first linear -> activation sharded [dp, mp]
+        first_lin = [n for n in plan.attrs if n.startswith("linear")][0]
+        assert plan.attrs[first_lin].dims_mapping == ["dp", "mp"]
+
+    def test_row_parallel_contracted_dim_needs_reshard(self):
+        main, x, out, sm, lin1, lin2 = _record_mlp()
+        plan = complete_program(
+            main, {"x": DA(["dp", None])},
+            param_attrs={lin2.weight.name: DA(["mp", None])},
+            mesh_shape={"dp": 4, "mp": 2})
+        # second matmul contracts over mp -> its input must reshard to
+        # k-sharded OR the output is partial; the pass records the edge
+        kinds = {r.kind for r in plan.reshards}
+        assert kinds & {"r_to_s", "s_to_s", "p_to_r"}, plan.summary()
+
+    def test_softmax_forces_replicated_class_dim(self):
+        pt.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [8, 32], "float32")
+            sm = pt.softmax(x)
+        pt.disable_static()
+        plan = complete_program(main, {"x": DA([None, "mp"])},
+                                mesh_shape={"mp": 8})
+        # class-dim shard must reshard away before softmax
+        assert any(r.kind == "s_to_r" for r in plan.reshards), \
+            plan.summary()
+        assert plan.attrs[sm.name].dims_mapping == [None, None]
+
+    def test_plan_summary_and_cost(self):
+        main, x, out, sm, lin1, lin2 = _record_mlp()
+        plan = complete_program(main, {"x": DA([None, "mp"])},
+                                mesh_shape={"mp": 8})
+        s = plan.summary()
+        assert "vars annotated" in s
+        assert estimate_plan_cost(plan) >= 0.0
+
+
+class TestReshardCostModel:
+    def test_allgather_cost(self):
+        # ring all-gather moves (n-1)/n of the full tensor
+        assert estimate_reshard_cost(800, "s_to_r", 8) == 700
+
+    def test_allreduce_twice_allgather(self):
+        assert estimate_reshard_cost(800, "p_to_r", 8) == 1400
+
+    def test_slice_free(self):
+        assert estimate_reshard_cost(800, "r_to_s", 8) == 0
+
+    def test_alltoall_cheapest_collective(self):
+        a2a = estimate_reshard_cost(800, "s_to_s", 8)
+        ag = estimate_reshard_cost(800, "s_to_r", 8)
+        assert 0 < a2a < ag

@@ -92,3 +92,78 @@ def test_localsgd_wraps_and_steps():
         opt.step()
     assert opt._local_steps == 4
     assert inner._step_count == 4
+
+
+def test_distributed_fused_lamb_converges():
+    from paddle_tpu.optimizer import DistributedFusedLamb
+    x, b, w = _problem()
+    opt = DistributedFusedLamb(learning_rate=0.05, parameters=[w])
+    first = None
+    for _ in range(80):
+        loss = ((paddle.matmul(x, w) - b) ** 2).mean()
+        first = first or float(_np(loss))
+        opt.clear_grad()
+        loss.backward()
+        opt.step()
+    assert float(_np(loss)) < first * 0.5
+
+
+def test_fused_conv_bn_act_matches_unfused():
+    import paddle_tpu.incubate.nn.functional as IF
+    import paddle_tpu.nn.functional as F
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(5, 3, 3, 3).astype(np.float32) * 0.2)
+    sc = paddle.to_tensor(np.abs(rng.randn(5)).astype(np.float32) + 0.5)
+    bb = paddle.to_tensor(rng.randn(5).astype(np.float32))
+    mu = paddle.to_tensor(rng.randn(5).astype(np.float32) * 0.1)
+    var = paddle.to_tensor(np.abs(rng.randn(5)).astype(np.float32) + 1.0)
+    got = _np(IF.fused_conv_bn_act(x, w, sc, bb, mu, var, padding=1))
+    conv = F.conv2d(x, w, padding=1)
+    inv = _np(sc) / np.sqrt(_np(var) + 1e-5)
+    want = (_np(conv) - _np(mu)[None, :, None, None]) \
+        * inv[None, :, None, None] + _np(bb)[None, :, None, None]
+    want = np.maximum(want, 0.0)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_fused_adam_multi_tensor():
+    import paddle_tpu.incubate.nn.functional as IF
+    p = [paddle.to_tensor(np.ones(4, np.float32)),
+         paddle.to_tensor(np.full(3, 2.0, np.float32))]
+    g = [paddle.to_tensor(np.full(4, 0.5, np.float32)),
+         paddle.to_tensor(np.full(3, -0.5, np.float32))]
+    m1 = [paddle.to_tensor(np.zeros(4, np.float32)),
+          paddle.to_tensor(np.zeros(3, np.float32))]
+    m2 = [paddle.to_tensor(np.zeros(4, np.float32)),
+          paddle.to_tensor(np.zeros(3, np.float32))]
+    # reference convention: pows hold beta^t at the CURRENT step
+    new_p, new_m1, new_m2, b1p, b2p, mw = IF.fused_adam(
+        p, g, 0.1, m1, m2, 0.9, 0.999)
+    assert len(new_p) == 2
+    assert _np(new_p[0])[0] < 1.0          # moved against grad
+    assert _np(new_p[1])[0] > 2.0
+    # step 1, zero moments: mhat = g, vhat = g^2 -> update = lr * sign(g)
+    np.testing.assert_allclose(_np(new_p[0])[0], 1.0 - 0.1, rtol=1e-5)
+    # pows advance by one factor
+    np.testing.assert_allclose(float(_np(b1p[0])), 0.81, rtol=1e-6)
+
+
+def test_fused_adam_master_weights_and_skip():
+    import paddle_tpu.incubate.nn.functional as IF
+    import jax.numpy as jnp
+    p = [paddle.to_tensor(np.ones(4, np.float32).astype(np.float16))]
+    mw = [paddle.to_tensor(np.ones(4, np.float32))]
+    g = [paddle.to_tensor(np.full(4, 0.5, np.float16))]
+    m1 = [paddle.to_tensor(np.zeros(4, np.float32))]
+    m2 = [paddle.to_tensor(np.zeros(4, np.float32))]
+    new_p, _, _, _, _, new_mw = IF.fused_adam(
+        p, g, 0.01, m1, m2, 0.9, 0.999, master_weights=mw)
+    assert _np(new_mw[0]).dtype == np.float32
+    assert _np(new_p[0]).dtype == np.float16
+    np.testing.assert_allclose(_np(new_p[0]),
+                               _np(new_mw[0]).astype(np.float16))
+    # skip_update freezes everything for that slot
+    out = IF.fused_adam(p, g, 0.01, m1, m2, 0.9, 0.999,
+                        skip_update=[True])
+    assert out[0][0] is p[0]
