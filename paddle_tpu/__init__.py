@@ -22,7 +22,7 @@ from .core.dtypes import (  # noqa: F401
 from .core.dtypes import bool_ as bool  # noqa: F401
 from .core.device import (  # noqa: F401
     CPUPlace, Place, TPUPlace, device_count, get_device, is_compiled_with_tpu,
-    set_device,
+    max_memory_allocated, memory_allocated, memory_stats, set_device,
 )
 from .core.flags import FLAGS, get_flags, set_flags  # noqa: F401
 from .core.rng import get_rng_state, seed, set_rng_state  # noqa: F401

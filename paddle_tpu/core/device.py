@@ -14,7 +14,8 @@ import jax
 
 __all__ = [
     "Place", "CPUPlace", "TPUPlace", "set_device", "get_device",
-    "device_count", "is_compiled_with_tpu",
+    "device_count", "is_compiled_with_tpu", "memory_stats",
+    "memory_allocated", "max_memory_allocated",
 ]
 
 
@@ -101,3 +102,42 @@ def is_compiled_with_tpu() -> bool:
         return any(d.platform.lower() in ("tpu", "axon") for d in jax.devices())
     except RuntimeError:
         return False
+
+
+def memory_stats(device: Optional[Union[str, "Place"]] = None) -> dict:
+    """Device memory statistics (reference: phi/core/memory/stats.cc
+    DEVICE_MEMORY_STAT / paddle.device.cuda.memory_* APIs).
+
+    TPU-native: surfaces the PJRT allocator's live counters
+    (``jax.Device.memory_stats()``) under the reference's key names.
+    ``device`` accepts a Place or a 'tpu:1'-style string; default is the
+    current ``set_device`` place."""
+    if isinstance(device, str):
+        if ":" in device:
+            ty, idx = device.split(":", 1)
+            device = Place(ty, int(idx))
+        else:
+            device = Place(device)
+    elif device is None:
+        device = default_place()
+    dev = device.jax_device()
+    raw = dev.memory_stats() or {}
+    return {
+        "memory.allocated.current": raw.get("bytes_in_use", 0),
+        "memory.allocated.peak": raw.get("peak_bytes_in_use", 0),
+        "memory.reserved.current": raw.get("bytes_reserved",
+                                           raw.get("bytes_in_use", 0)),
+        "memory.limit": raw.get("bytes_limit", 0),
+        "raw": dict(raw),
+    }
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak bytes allocated (reference paddle.device.cuda
+    .max_memory_allocated)."""
+    return int(memory_stats(device)["memory.allocated.peak"])
+
+
+def memory_allocated(device=None) -> int:
+    """Current bytes allocated (reference memory_allocated)."""
+    return int(memory_stats(device)["memory.allocated.current"])

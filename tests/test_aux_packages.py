@@ -492,3 +492,14 @@ class TestTopLevelParity:
         st = pt.get_cuda_rng_state()
         pt.set_cuda_rng_state(st)
         pt.check_shape(pt.Tensor(np.ones((2, 3))), (2, -1))
+
+
+def test_memory_stats_api():
+    """Device memory counters (reference phi/core/memory/stats.cc)."""
+    import paddle_tpu as p
+    st = p.memory_stats()
+    assert set(st) >= {"memory.allocated.current", "memory.allocated.peak",
+                       "memory.limit"}
+    assert p.memory_allocated() >= 0
+    assert p.max_memory_allocated() >= p.memory_allocated() or \
+        p.max_memory_allocated() == 0

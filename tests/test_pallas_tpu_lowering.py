@@ -156,3 +156,52 @@ class TestQuantLinearLowering:
         wq = jax.ShapeDtypeStruct((4096, 4096), jnp.int8)
         s = jax.ShapeDtypeStruct((4096,), jnp.float32)
         _lower_tpu(weight_only_matmul, x, wq, s)
+
+
+class TestHybridTrainStepTPULowering:
+    """End-to-end evidence: the FULL 5-axis hybrid train step — manual
+    shard_map over (dp, mp, pp, sep, sharding), 1F1B pipeline scan, ring
+    context-parallel Pallas flash attention, ZeRO Adam — Mosaic-compiles
+    for TPU as ONE program (collectives + tpu_custom_call kernels), via
+    cross-platform export on the 8-device CPU host."""
+
+    def _export(self, degrees, extra):
+        import jax.numpy as jnp
+        from paddle_tpu import parallel as dist
+        from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
+        cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=4,
+                        num_heads=8, max_position_embeddings=512)
+        topo = dist.init_topology(**degrees)
+        step_fn, init_fn = build_gpt_train_step(
+            cfg, topo, num_microbatches=2,
+            cp_mode="ring" if degrees.get("sep", 1) > 1 else None,
+            use_flash=True, **extra)
+        state_avals = jax.eval_shape(init_fn, 0)
+        batch = max(4, 2 * degrees.get("dp", 1) * degrees.get("sharding", 1)
+                    * 2)                       # 2 rows/microbatch/device
+        ids = jax.ShapeDtypeStruct((batch, 256), jnp.int64)
+        exp = jax.export.export(step_fn, platforms=["tpu"])(
+            state_avals, ids, ids)
+        return exp.mlir_module()
+
+    def test_mp_pp_sep_ring_cp(self):
+        txt = self._export(dict(dp=1, mp=2, pp=2, sep=2, sharding=1), {})
+        assert txt.count("tpu_custom_call") >= 4     # flash fwd+bwd blocks
+        assert "collective_permute" in txt           # ring CP / pipeline
+
+    def test_mp_sharding_dp_stage2(self):
+        txt = self._export(dict(dp=2, mp=2, pp=1, sep=1, sharding=2),
+                           dict(sharding_stage=2))
+        assert txt.count("tpu_custom_call") >= 2
+        assert "all_gather" in txt or "all-gather" in txt
+
+    def test_pp_sharding_stage3(self):
+        degrees = dict(dp=2, mp=1, pp=2, sep=1, sharding=2)
+        txt3 = self._export(degrees, dict(sharding_stage=3))
+        assert txt3.count("tpu_custom_call") >= 2
+        # stage-3 signature: params live sharded at rest and are gathered
+        # AT USE, so the module carries strictly more all_gathers than the
+        # same config at stage 2 (which keeps params replicated)
+        txt2 = self._export(degrees, dict(sharding_stage=2))
+        assert txt3.count("all_gather") > txt2.count("all_gather"), (
+            txt3.count("all_gather"), txt2.count("all_gather"))
