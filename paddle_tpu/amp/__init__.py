@@ -1,5 +1,6 @@
 from .auto_cast import (  # noqa: F401
-    amp_guard, auto_cast, black_list, decorate, white_list,
+    amp_guard, auto_cast, black_list, decorate, is_bfloat16_supported,
+    is_float16_supported, white_list,
 )
 from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
 from . import debugging  # noqa: F401

@@ -10,7 +10,8 @@ import contextlib
 from ..core import amp_state
 from ..core import dtypes as _dt
 
-__all__ = ["auto_cast", "amp_guard", "decorate", "white_list", "black_list"]
+__all__ = ["auto_cast", "amp_guard", "decorate", "white_list", "black_list",
+           "is_bfloat16_supported", "is_float16_supported"]
 
 
 def white_list():
@@ -66,3 +67,15 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
             o._multi_precision = True
     return (models if single_model else model_list,
             optimizers if single_opt else opt_list)
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    """bf16 is native on every TPU generation and XLA:CPU (reference:
+    amp/auto_cast.py is_bfloat16_supported probes CUDA arch)."""
+    return True
+
+
+def is_float16_supported(device=None) -> bool:
+    """fp16 compute is supported by XLA on TPU (reference probes CUDA
+    compute capability >= 5.3)."""
+    return True

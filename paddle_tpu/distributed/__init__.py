@@ -15,3 +15,16 @@ from ..parallel.checkpoint import (  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import rpc  # noqa: F401
 from .recompute import recompute, recompute_hybrid, recompute_sequential  # noqa: F401
+from . import launch  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from .extras import (  # noqa: F401,E402
+    CountFilterEntry, DistAttr, DistModel, InMemoryDataset, ParallelMode,
+    Placement, ProbabilityEntry, QueueDataset, ReduceType, ShardingStage1,
+    ShardingStage2, ShardingStage3, ShowClickEntry, Strategy,
+    all_gather_object, alltoall, alltoall_single, broadcast_object_list,
+    destroy_process_group, dtensor_from_fn, gather, get_backend,
+    gloo_barrier, gloo_init_parallel_env, gloo_release, irecv,
+    is_available, isend, recv, scatter_object_list, send,
+    shard_dataloader, shard_optimizer, shard_scaler, spawn, split,
+    to_static, unshard_dtensor, wait,
+)

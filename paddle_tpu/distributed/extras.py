@@ -1,0 +1,419 @@
+"""paddle.distributed surface tail (reference
+python/paddle/distributed/__init__.py __all__): point-to-point + object
+collectives, process-group lifecycle, semi-auto sugar (DistModel,
+shard_optimizer/scaler/dataloader, dtensor helpers), launch/spawn, and
+the PS-era dataset/entry configs.
+
+Single-controller mappings: an async "task" is already complete when the
+collective returns (XLA schedules async under jit), so isend/irecv return
+a completed-Task shim; object collectives move pickled bytes; the gloo_*
+CPU rendezvous trio maps onto the in-process barrier.  Parameter-server
+entries (CountFilterEntry & co.) are config descriptors — the PS runtime
+itself is an explicit non-goal (SURVEY §7).
+"""
+
+from __future__ import annotations
+
+import copy
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..parallel import collective as C
+from ..parallel.api import (Partial, Placement, ProcessMesh, Replicate,
+                            Shard, dtensor_from_local, get_placements,
+                            reshard, shard_layer, shard_tensor)
+from ..parallel.sharding import ShardingStage
+
+__all__ = [
+    "send", "recv", "isend", "irecv", "wait", "gather", "alltoall",
+    "alltoall_single", "split", "all_gather_object",
+    "broadcast_object_list", "scatter_object_list", "get_backend",
+    "is_available", "destroy_process_group", "gloo_init_parallel_env",
+    "gloo_barrier", "gloo_release", "spawn", "ParallelMode", "ReduceType",
+    "Placement", "Strategy", "DistAttr", "DistModel", "to_static",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    "shard_optimizer", "shard_scaler", "shard_dataloader",
+    "dtensor_from_fn", "unshard_dtensor", "InMemoryDataset",
+    "QueueDataset", "CountFilterEntry", "ProbabilityEntry",
+    "ShowClickEntry",
+]
+
+send = C.send
+recv = C.recv
+gather = getattr(C, "gather", None)
+alltoall = C.all_to_all
+
+
+class _DoneTask:
+    """Completed-communication handle (reference distributed.communication
+    returns a Task with .wait(); under the single-controller model the
+    dispatch IS the completion — XLA overlaps internally)."""
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None):
+    C.send(tensor, dst, group)
+    return _DoneTask()
+
+
+def irecv(tensor, src=0, group=None):
+    C.recv(tensor, src, group)
+    return _DoneTask()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Reference dist.wait — stream sync; jax arrays sync on use."""
+    import jax
+    v = getattr(tensor, "_value", tensor)
+    try:
+        jax.block_until_ready(v)
+    except Exception:
+        pass
+    return None
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Even-split all-to-all over the group axis (reference
+    communication/all_to_all.py alltoall_single)."""
+    from ..core.tensor import Tensor
+    n = (group.nranks if group is not None and hasattr(group, "nranks")
+         else C.get_group().nranks)
+    v = getattr(in_tensor, "_value", in_tensor)
+    parts = list(np.split(np.asarray(v), n, axis=0))
+    out = np.concatenate(parts, axis=0)        # world=1 view: identity
+    out_tensor._value = Tensor(out)._value
+    return _DoneTask()
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Reference fleet mp_ops split() — builds a row/column-parallel
+    linear/embedding over the mp group.  Routes to the mp layer zoo."""
+    from ..parallel import mp_layers as mpl
+    raise NotImplementedError(
+        "dist.split: construct parallel layers directly — "
+        "paddle_tpu.parallel.ColumnParallelLinear / RowParallelLinear / "
+        "VocabParallelEmbedding (parallel/mp_layers.py) are the TPU-native "
+        "equivalents with explicit mesh axes")
+
+
+# -- object collectives ------------------------------------------------------
+
+def all_gather_object(object_list: List[Any], obj: Any,
+                      group=None) -> None:
+    """Reference all_gather_object: every rank contributes one pickled
+    object.  Single-controller: the calling process IS every rank's
+    driver, so the gathered list is world_size copies."""
+    n = C.get_group().nranks if group is None else getattr(group, "nranks", 1)
+    object_list.clear()
+    object_list.extend(copy.deepcopy(obj) for _ in range(max(n, 1)))
+
+
+def broadcast_object_list(object_list: List[Any], src: int = 0,
+                          group=None) -> None:
+    data = pickle.dumps(object_list)
+    object_list[:] = pickle.loads(data)
+
+
+def scatter_object_list(out_object_list: List[Any],
+                        in_object_list: Optional[List[Any]] = None,
+                        src: int = 0, group=None) -> None:
+    if in_object_list:
+        out_object_list[:] = [copy.deepcopy(in_object_list[0])]
+
+
+# -- lifecycle / backend -----------------------------------------------------
+
+def get_backend(group=None) -> str:
+    return "xla"                  # ICI/DCN collectives compiled by XLA
+
+
+def is_available() -> bool:
+    return True
+
+
+def destroy_process_group(group=None) -> None:
+    """Reference destroy_process_group; jax.distributed shutdown when the
+    coordination service was initialized."""
+    try:
+        import jax
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int,
+                           server_endpoint: str) -> None:
+    from ..parallel.env import init_parallel_env
+    init_parallel_env()
+
+
+def gloo_barrier() -> None:
+    C.barrier()
+
+
+def gloo_release() -> None:
+    return None
+
+
+def spawn(func: Callable, args=(), nprocs: int = -1, join=True,
+          daemon=False, **options):
+    """Reference dist.spawn — launch ``func`` in per-rank processes.
+    Routes through the launcher's local multi-process path."""
+    import multiprocessing as mp
+    n = nprocs if nprocs > 0 else 1
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(n):
+        p = ctx.Process(target=func, args=args, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode]
+        if bad:
+            raise RuntimeError(f"spawned ranks failed: {bad}")
+    return procs
+
+
+# -- enums / config ----------------------------------------------------------
+
+class ParallelMode:
+    """Reference base/topology.py ParallelMode enum."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ReduceType:
+    """Reference placement ReduceType (auto_parallel placements)."""
+    kRedSum = "sum"
+    kRedMax = "max"
+    kRedMin = "min"
+    kRedProd = "prod"
+    kRedAvg = "avg"
+
+
+class Strategy:
+    """Semi-auto strategy config (reference auto_parallel/strategy.py):
+    typed sub-configs for sharding/amp/recompute/pipeline."""
+
+    class _Sub:
+        def __init__(self, **kw):
+            self.enable = False
+            self.__dict__.update(kw)
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        self.sharding = Strategy._Sub(degree=1, stage=1)
+        self.amp = Strategy._Sub(dtype="bfloat16", level="O1")
+        self.recompute = Strategy._Sub()
+        self.pipeline = Strategy._Sub(schedule_mode="1F1B",
+                                      micro_batch_size=1,
+                                      accumulate_steps=1)
+        self.gradient_merge = Strategy._Sub(k_steps=1)
+        for k, v in (config or {}).items():
+            setattr(self, k, v)
+
+
+class DistAttr:
+    """Tensor dist attribute sugar (reference DistAttr(mesh, sharding
+    specs)); carries (process_mesh, placements) for shard_tensor."""
+
+    def __init__(self, mesh=None, sharding_specs=None, placements=None):
+        self.process_mesh = mesh
+        if placements is None and sharding_specs is not None:
+            placements = []
+            for i, spec in enumerate(sharding_specs):
+                if spec is None:
+                    continue
+            # sharding_specs name mesh dims per tensor dim; build Shard
+            placements = [
+                Shard(i) for i, spec in enumerate(sharding_specs)
+                if spec is not None]
+        self.placements = placements or [Replicate()]
+
+
+# ShardingStage policy markers (reference auto_parallel/api.py
+# ShardingStage1/2/3 classes passed to shard_optimizer)
+class _ShardingStagePolicy:
+    stage = 1
+
+    def __init__(self, mesh=None, axis=None):
+        self.mesh = mesh
+        self.axis = axis
+
+
+class ShardingStage1(_ShardingStagePolicy):
+    stage = 1
+
+
+class ShardingStage2(_ShardingStagePolicy):
+    stage = 2
+
+
+class ShardingStage3(_ShardingStagePolicy):
+    stage = 3
+
+
+# -- semi-auto sugar ---------------------------------------------------------
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh,
+                    placements: Sequence[Placement], *args, **kwargs):
+    """Reference auto_parallel/api.py dtensor_from_fn: build the tensor
+    with ``fn`` then place it."""
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, mesh, placements)
+
+
+def unshard_dtensor(dist_tensor):
+    """Reference unshard_dtensor: gather to a replicated dense tensor."""
+    mesh = getattr(dist_tensor, "process_mesh", None)
+    if mesh is None:
+        return dist_tensor
+    return reshard(dist_tensor, mesh,
+                   [Replicate() for _ in mesh.dim_names])
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Reference shard_optimizer(opt, ShardingStage1/2/3(...)): annotate
+    the optimizer for sharded states.  TPU-native: DistributedEngine +
+    the sharding axis do the real partitioning; this marks the stage so
+    engine construction picks it up."""
+    stage = getattr(shard_fn, "stage", 1) if shard_fn is not None else 1
+    optimizer._sharding_stage = stage
+    return optimizer
+
+
+def shard_scaler(scaler):
+    """Reference shard_scaler: the GradScaler's found_inf reduction rides
+    the compiled step's psum already — marker for parity."""
+    return scaler
+
+
+def shard_dataloader(dataloader, meshes=None, shard_dims=None,
+                     input_keys=None, is_dataset_splitted=False):
+    """Reference shard_dataloader: per-rank sharding of the loader; under
+    the single-controller model the global batch is already mesh-placed
+    by the train step's in_shardings, so the loader passes through."""
+    return dataloader
+
+
+# -- semi-auto DistModel / to_static ----------------------------------------
+
+class DistModel:
+    """Reference auto_parallel DistModel (static semi-auto engine handle,
+    static/engine.py): wraps layer+loss+optimizer, runs compiled dist
+    train/eval steps."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy: Optional[Strategy] = None, metrics=None):
+        from ..parallel.engine import DistributedEngine
+        self.network = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._mode = "train"
+        stage = getattr(optimizer, "_sharding_stage", None) or (
+            self._strategy.sharding.stage
+            if self._strategy.sharding.enable else 0)
+        self._engine = DistributedEngine(
+            layer, optimizer=optimizer, loss_fn=loss,
+            sharding_stage=stage or 0,
+            recompute=self._strategy.recompute.enable)
+
+    def train(self):
+        self._mode = "train"
+
+    def eval(self):
+        self._mode = "eval"
+
+    def __call__(self, *inputs):
+        if self._mode == "train":
+            loss = self._engine.train_batch(*inputs)
+            return loss
+        return self._engine.eval_batch(*inputs)
+
+    def state_dict(self):
+        return self.network.state_dict()
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None,
+              strategy=None):
+    """Reference paddle.distributed.to_static → DistModel + dist loader
+    pair (we return the DistModel; the loader passes through)."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+# -- PS-era datasets / entries ----------------------------------------------
+
+class InMemoryDataset:
+    """Reference InMemoryDataset (fleet dataset; PS ingestion).  TPU
+    build: a thin in-memory sample store usable with paddle_tpu.io; the
+    brpc/PS pipeline itself is a non-goal (SURVEY §7)."""
+
+    def __init__(self):
+        self._samples: List[Any] = []
+        self._pipe_command = None
+        self._use_var = []
+
+    def init(self, use_var=None, pipe_command=None, **kw):
+        self._use_var = use_var or []
+        self._pipe_command = pipe_command
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def load_into_memory(self):
+        self._samples = []
+        for path in getattr(self, "_filelist", []):
+            with open(path) as f:
+                self._samples.extend(line.rstrip("\n") for line in f)
+
+    def get_memory_data_size(self):
+        return len(self._samples)
+
+    def local_shuffle(self):
+        import random
+        random.shuffle(self._samples)
+
+    def release_memory(self):
+        self._samples = []
+
+
+class QueueDataset(InMemoryDataset):
+    """Reference QueueDataset — streaming variant; here the same store
+    read lazily."""
+
+    def load_into_memory(self):  # queue datasets stream; keep filelist
+        return None
+
+
+class _SparseEntry:
+    def __init__(self, *args):
+        self._args = args
+
+    def __repr__(self):
+        return f"{type(self).__name__}{self._args}"
+
+
+class CountFilterEntry(_SparseEntry):
+    """Reference PS sparse-table admission policy (count filter)."""
+
+
+class ProbabilityEntry(_SparseEntry):
+    """Reference PS sparse-table admission policy (probability)."""
+
+
+class ShowClickEntry(_SparseEntry):
+    """Reference PS show/click decay entry."""

@@ -1,0 +1,47 @@
+"""paddle.distributed.io parity (reference python/paddle/distributed/io.py:
+save/load_persistables for distributed programs).
+
+TPU-native: persistables are the recorded Program's live Parameters (or a
+Layer's state_dict); sharded state routes through
+paddle_tpu.parallel.checkpoint (reshard-on-load)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable",
+           "load_inference_model_distributed"]
+
+
+def is_persistable(var) -> bool:
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor=None, dirname: str = ".", main_program=None,
+                      filename=None) -> None:
+    from ..static import default_main_program
+    prog = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    state = {n: np.asarray(p._value) for n, p in prog.params.items()}
+    with open(os.path.join(dirname, filename or "__params__"), "wb") as f:
+        pickle.dump(state, f)
+
+
+def load_persistables(executor=None, dirname: str = ".", main_program=None,
+                      filename=None) -> None:
+    import jax.numpy as jnp
+    from ..static import default_main_program
+    prog = main_program or default_main_program()
+    with open(os.path.join(dirname, filename or "__params__"), "rb") as f:
+        state = pickle.load(f)
+    for n, p in prog.params.items():
+        if n in state:
+            p._value = jnp.asarray(state[n])
+
+
+def load_inference_model_distributed(dirname, executor=None, **kw):
+    from ..static import load_inference_model
+    return load_inference_model(os.path.join(dirname, "model"), executor)

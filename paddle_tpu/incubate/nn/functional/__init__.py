@@ -17,7 +17,10 @@ __all__ = [
     "fused_bias_act", "fused_dropout_add", "swiglu", "fused_linear",
     "fused_linear_activation", "fused_multi_head_attention",
     "masked_multihead_attention", "fused_multi_transformer",
-    "fused_conv_bn_act", "fused_adam",
+    "fused_conv_bn_act", "fused_adam", "fused_matmul_bias",
+    "fused_feedforward", "blha_get_max_len", "block_multihead_attention",
+    "variable_length_memory_efficient_attention", "fused_moe",
+    "fused_ec_moe",
 ]
 
 
@@ -539,3 +542,216 @@ def fused_adam(params, grads, lrs, moments1, moments2, beta1_pows,
         for acc, v in zip(outs, res):
             acc.append(v)
     return outs
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False, name=None):
+    """matmul + bias epilogue (reference fused_matmul_bias →
+    fused_gemm_epilogue cublasLt kernel; XLA fuses the epilogue natively)."""
+    def impl(xv, yv, b):
+        a = jnp.swapaxes(xv, -1, -2) if transpose_x else xv
+        w = jnp.swapaxes(yv, -1, -2) if transpose_y else yv
+        out = a @ w
+        return out if b is None else out + b
+    return run_op("fused_matmul_bias", impl, (x, y, bias), {})
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1,
+                      add_residual=True, name=None):
+    """Transformer FFN block as one fused region (reference
+    incubate/nn/functional/fused_transformer.py:36 →
+    fused_feedforward kernel): [pre-]LN → linear1 → act → dropout →
+    linear2 → dropout → residual [→ post-LN]."""
+    from ....core.rng import next_rng_key
+    keys = (next_rng_key(), next_rng_key()) if (
+        training and (dropout1_rate or dropout2_rate)) else (None, None)
+
+    def ln(v, scale, b, eps):
+        mu = v.mean(-1, keepdims=True)
+        var = ((v - mu) ** 2).mean(-1, keepdims=True)
+        out = (v - mu) * jax.lax.rsqrt(var + eps)
+        if scale is not None:
+            out = out * scale
+        if b is not None:
+            out = out + b
+        return out
+
+    def drop(v, rate, key):
+        if rate == 0.0:
+            return v
+        if not training or key is None:
+            # downscale_in_infer applies the (1-p) factor at INFERENCE
+            # (reference nn/functional/common.py dropout mode semantics)
+            return v * (1.0 - rate) if mode == "downscale_in_infer" else v
+        keep = jax.random.bernoulli(key, 1.0 - rate, v.shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - rate), 0.0)
+        return jnp.where(keep, v, 0.0)
+
+    acts = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}
+    if activation not in acts:
+        raise ValueError(f"unsupported activation {activation!r}")
+
+    def impl(xv, w1, w2, b1, b2, s1, lb1, s2, lb2, k1, k2):
+        h = ln(xv, s1, lb1, ln1_epsilon) if pre_layer_norm else xv
+        h = h @ w1
+        if b1 is not None:
+            h = h + b1
+        h = acts[activation](h)
+        h = drop(h, dropout1_rate, k1)
+        h = h @ w2
+        if b2 is not None:
+            h = h + b2
+        h = drop(h, dropout2_rate, k2)
+        out = xv + h if add_residual else h
+        if not pre_layer_norm:
+            out = ln(out, s2, lb2, ln2_epsilon)
+        return out.astype(xv.dtype)
+
+    return run_op("fused_feedforward", impl,
+                  (x, linear1_weight, linear2_weight, linear1_bias,
+                   linear2_bias, ln1_scale, ln1_bias, ln2_scale, ln2_bias,
+                   keys[0], keys[1]), {})
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size=None,
+                     name=None):
+    """Max enc/dec lengths for block attention scheduling (reference
+    fusion/gpu blha_get_max_len kernel)."""
+    def impl(enc, dec):
+        return jnp.max(enc), jnp.max(dec)
+    return run_op("blha_get_max_len", impl,
+                  (seq_lens_encoder, seq_lens_decoder), {})
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets=None, cum_offsets=None,
+                              cu_seqlens_q=None, cu_seqlens_k=None,
+                              block_tables=None, *, max_seq_len=None,
+                              block_size=None, use_neox_style=False,
+                              name=None, **kw):
+    """Paged-KV block attention, decode phase (reference
+    fusion/gpu/block_multi_head_attention_kernel.cu).
+
+    TPU scope: the decode step over a paged pool — qkv [B, 3, H, D] (one
+    new token per sequence), caches are page pools [NB, BS, H, D],
+    block_tables [B, MB].  Appends the new K/V to the pages, then runs
+    the paged gather + masked attention (ops/paged_kv.py).  Returns
+    (out [B, H, D], key_cache, value_cache)."""
+    from ....ops.paged_kv import paged_append, paged_decode_attention
+    bs = block_size or key_cache.shape[1] if hasattr(
+        key_cache, "shape") else block_size
+
+    def impl(p, kc, vc, dec_lens, bt):
+        q, k_new, v_new = p[:, 0], p[:, 1], p[:, 2]
+        kc, vc = paged_append(kc, vc, k_new, v_new, bt, dec_lens,
+                              int(bs))
+        out = paged_decode_attention(q, kc, vc, bt, dec_lens + 1)
+        return out, kc, vc
+
+    return run_op("block_multihead_attention", impl,
+                  (qkv, key_cache, value_cache, seq_lens_decoder,
+                   block_tables), {})
+
+
+def variable_length_memory_efficient_attention(query, key, value,
+                                               seq_lens, kv_seq_lens,
+                                               mask=None, scale=None,
+                                               causal=False,
+                                               pre_cache_length=0):
+    """Varlen memory-efficient attention (reference fusion/gpu
+    variable_length_memory_efficient_attention + cutlass): per-sequence
+    lengths mask a padded batch; the flash kernel path gives O(T)
+    memory, the dense fallback masks explicitly.  q/k/v: [B, H, S, D];
+    seq_lens/kv_seq_lens: [B]."""
+    import math as _math
+
+    def impl(q, k, v, ql, kl, m):
+        B, H, S, D = q.shape
+        s = scale if scale is not None else 1.0 / _math.sqrt(D)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * s
+        kmask = jnp.arange(k.shape[2])[None, None, None, :] \
+            < kl[:, None, None, None]
+        qmask = jnp.arange(S)[None, None, :, None] < ql[:, None, None, None]
+        mask_all = kmask & qmask
+        if causal:
+            # query i may see the full pre-cache prefix plus keys up to
+            # its own (cache-offset) position
+            rows = jnp.arange(S)[:, None] + int(pre_cache_length)
+            tri = rows >= jnp.arange(k.shape[2])[None, :]
+            mask_all = mask_all & tri[None, None]
+        logits = jnp.where(mask_all, logits, jnp.finfo(jnp.float32).min)
+        if m is not None:
+            logits = logits + m.astype(jnp.float32)
+        p = jax.nn.softmax(logits, axis=-1)
+        p = jnp.where(mask_all, p, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+    return run_op("var_len_mem_efficient_attention", impl,
+                  (query, key, value, seq_lens, kv_seq_lens, mask), {})
+
+
+def fused_moe(x, gate_weight, expert_weights1, expert_biases1,
+              expert_weights2, expert_biases2, *, moe_topk=2,
+              norm_topk_prob=True, name=None, **kw):
+    """Fused MoE FFN (reference incubate fused_moe → fused_moe kernel):
+    softmax gate → top-k dispatch → per-expert FFN → weighted combine.
+    Dense einsum formulation — every token visits every expert and the
+    top-k mask zeroes the rest, which on TPU trades FLOPs for zero
+    all-to-all and perfect load balance at small expert counts."""
+    def impl(xv, gw, w1, b1, w2, b2):
+        B = xv.shape[:-1]
+        d = xv.shape[-1]
+        t = xv.reshape(-1, d)                      # [T, d]
+        gate = jax.nn.softmax(t @ gw, axis=-1)     # [T, E]
+        E = gate.shape[-1]
+        topv, topi = jax.lax.top_k(gate, moe_topk)
+        if norm_topk_prob:
+            topv = topv / jnp.sum(topv, -1, keepdims=True)
+        w_dense = jnp.zeros((t.shape[0], E), gate.dtype)
+        w_dense = w_dense.at[jnp.arange(t.shape[0])[:, None],
+                             topi].set(topv)
+        h = jnp.einsum("td,edf->tef", t, w1)
+        if b1 is not None:
+            h = h + b1[None]
+        h = jax.nn.gelu(h)
+        h = jnp.einsum("tef,efd->ted", h, w2)
+        if b2 is not None:
+            h = h + b2[None]
+        out = jnp.einsum("ted,te->td", h, w_dense)
+        return out.reshape(*B, d).astype(xv.dtype)
+
+    return run_op("fused_moe", impl,
+                  (x, gate_weight, expert_weights1, expert_biases1,
+                   expert_weights2, expert_biases2), {})
+
+
+def fused_ec_moe(x, gate, expert_weights1, expert_biases1, expert_weights2,
+                 expert_biases2, act_type="gelu", name=None):
+    """Expert-choice MoE (reference fused_ec_moe kernel): same fused
+    dense formulation with a precomputed gate tensor."""
+    def impl(xv, g, w1, b1, w2, b2):
+        B = xv.shape[:-1]
+        d = xv.shape[-1]
+        t = xv.reshape(-1, d)
+        gate_p = jax.nn.softmax(g.reshape(t.shape[0], -1), axis=-1)
+        h = jnp.einsum("td,edf->tef", t, w1) + (
+            b1[None] if b1 is not None else 0.0)
+        h = jax.nn.gelu(h) if act_type == "gelu" else jax.nn.relu(h)
+        h = jnp.einsum("tef,efd->ted", h, w2) + (
+            b2[None] if b2 is not None else 0.0)
+        out = jnp.einsum("ted,te->td", h, gate_p)
+        return out.reshape(*B, d).astype(xv.dtype)
+
+    return run_op("fused_ec_moe", impl,
+                  (x, gate, expert_weights1, expert_biases1,
+                   expert_weights2, expert_biases2), {})

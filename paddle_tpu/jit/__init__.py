@@ -1,4 +1,5 @@
 from .api import (  # noqa: F401
-    InputSpec, StaticFunction, TranslatedLayer, ignore_module,
-    in_to_static_mode, jit_compile, load, not_to_static, save, to_static,
+    InputSpec, StaticFunction, TranslatedLayer, enable_to_static,
+    ignore_module, in_to_static_mode, jit_compile, load, not_to_static,
+    save, set_code_level, set_verbosity, to_static,
 )

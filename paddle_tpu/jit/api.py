@@ -67,6 +67,8 @@ class StaticFunction:
                                static_argnums=static_argnums)
 
     def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED:
+            return self._fn(*args, **kwargs)   # eager fallback (debug)
         vargs = jax.tree.map(_unwrap, args,
                              is_leaf=lambda x: isinstance(x, Tensor))
         vkwargs = jax.tree.map(_unwrap, kwargs,
@@ -232,3 +234,30 @@ def load(path, **config):
             f"jit.load: {path}.pdparams does not match the exported "
             f"program (missing={sorted(missing)}, extra={sorted(extra)})")
     return TranslatedLayer(exported, params)
+
+
+_TO_STATIC_ENABLED = True
+
+
+def enable_to_static(enable: bool = True):
+    """Globally toggle to_static conversion (reference jit/api.py
+    enable_to_static): when off, StaticFunction calls run the original
+    eager function (no tracing) for debugging."""
+    global _TO_STATIC_ENABLED
+    _TO_STATIC_ENABLED = bool(enable)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """Reference sot/dy2static logging knob; our single-route to_static
+    has no transformed-code dump, so this only records the level."""
+    global _CODE_LEVEL
+    _CODE_LEVEL = level
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    global _VERBOSITY
+    _VERBOSITY = level
+
+
+_CODE_LEVEL = 0
+_VERBOSITY = 0

@@ -3,18 +3,32 @@ re-exports of tensor.linalg).  All impls live in ops/impl/linalg.py and are
 registered through ops.yaml; this module is the public namespace."""
 
 from .ops.api import (  # noqa: F401
-    bmm, cdist, cholesky, cholesky_inverse, cholesky_solve, corrcoef, cov,
+    bmm, cond, cdist, cholesky, cholesky_inverse, cholesky_solve, corrcoef, cov,
     det, dist, eig, eigh, eigvals, eigvalsh, householder_product, inv,
     lstsq, lu, lu_unpack, matmul, matrix_exp, matrix_norm, matrix_power,
     matrix_rank, multi_dot, mv, norm, ormqr, pca_lowrank, pinv, qr, slogdet,
     solve, svd, svd_lowrank, svdvals, triangular_solve, vector_norm,
 )
+from .nn.quant import fp8_gemm as _fp8_gemm
+
+
+def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
+                            bias=None, scale=1.0, output_dtype="float16",
+                            act="identity"):
+    """Reference signature (tensor/linalg.py:329) adapted onto the fp8
+    gemm kernel path (nn/quant fp8_gemm)."""
+    out = _fp8_gemm(x, y, x_scale=scale, y_scale=1.0, bias=bias,
+                    transpose_x=transpose_x, transpose_y=transpose_y,
+                    activation=None if act == "identity" else act,
+                    output_dtype=output_dtype)
+    return out
 
 __all__ = [
-    "bmm", "cdist", "cholesky", "cholesky_inverse", "cholesky_solve",
+    "bmm", "cond", "cdist", "cholesky", "cholesky_inverse", "cholesky_solve",
     "corrcoef", "cov", "det", "dist", "eig", "eigh", "eigvals", "eigvalsh",
     "householder_product", "inv", "lstsq", "lu", "lu_unpack", "matmul",
     "matrix_exp", "matrix_norm", "matrix_power", "matrix_rank", "multi_dot",
     "mv", "norm", "ormqr", "pca_lowrank", "pinv", "qr", "slogdet", "solve",
     "svd", "svd_lowrank", "svdvals", "triangular_solve", "vector_norm",
+    "fp8_fp8_half_gemm_fused",
 ]

@@ -270,3 +270,28 @@ def matrix_rank_tol(x, atol_tensor, use_default_tol=True, hermitian=False):
     tol = jnp.asarray(getattr(atol_tensor, "_value", atol_tensor))
     return matrix_rank(x, tol=None if use_default_tol else tol,
                        hermitian=hermitian)
+
+
+def cond(x, p=None):
+    """Condition number (reference tensor/linalg.py cond → phi svd/norm
+    kernels).  p in {None/2, -2, 'fro', 'nuc', 1, -1, inf, -inf}."""
+    if p is None or p == 2 or p == -2:
+        s = jnp.linalg.svd(x, compute_uv=False)
+        smax, smin = s[..., 0], s[..., -1]
+        return smax / smin if (p is None or p == 2) else smin / smax
+    if p == "fro":
+        return (jnp.linalg.norm(x, ord="fro", axis=(-2, -1))
+                * jnp.linalg.norm(jnp.linalg.inv(x), ord="fro",
+                                  axis=(-2, -1)))
+    if p == "nuc":
+        s = jnp.linalg.svd(x, compute_uv=False)
+        si = jnp.linalg.svd(jnp.linalg.inv(x), compute_uv=False)
+        return s.sum(-1) * si.sum(-1)
+    import numpy as _np
+    ordv = p
+    if p in (float("inf"), _np.inf):
+        ordv = _np.inf
+    elif p in (float("-inf"), -_np.inf):
+        ordv = -_np.inf
+    return (jnp.linalg.norm(x, ord=ordv, axis=(-2, -1))
+            * jnp.linalg.norm(jnp.linalg.inv(x), ord=ordv, axis=(-2, -1)))

@@ -314,3 +314,14 @@ def _monitored(name, axis, fn):
                  getattr(out, "_value", out))
     mon.record(name, axis, _time.perf_counter() - t0)
     return out
+
+
+def gather(tensor, gather_list=None, dst: int = 0, group=None,
+           sync_op: bool = True):
+    """Gather tensors to dst (reference communication/gather.py).
+    Single-controller: all shards are addressable, so gather = the
+    all_gather list (dst distinction has no process boundary here)."""
+    if gather_list is None:
+        gather_list = []
+    all_gather(gather_list, tensor, group=group, sync_op=sync_op)
+    return gather_list

@@ -19,7 +19,8 @@ from jax.experimental import sparse as jsparse
 
 from ..core.tensor import Tensor
 
-__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+__all__ = [
+    "deg2rad", "rad2deg", "pca_lowrank","sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
            "SparseCsrTensor", "is_same_shape", "add", "subtract",
            "multiply", "divide", "matmul", "masked_matmul", "mv", "sum",
            "abs", "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh",
@@ -305,6 +306,8 @@ def _unary(fn):
 
 
 abs = _unary(jnp.abs)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
 sin = _unary(jnp.sin)
 tan = _unary(jnp.tan)
 asin = _unary(jnp.arcsin)
@@ -619,3 +622,19 @@ __all__ += ["acos", "acosh", "isnan", "leaky_relu", "relu6", "scale",
             "slice", "addmm", "batch_norm_", "sync_batch_norm_", "conv3d",
             "conv3d_implicit_gemm", "max_pool3d", "maxpool",
             "fused_attention"]
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Low-rank PCA of a (sparse or dense) matrix (reference sparse
+    pca_lowrank → svd_lowrank).  Densifies the input (PCA output is dense
+    by nature) then rides the shared randomized svd_lowrank path — one
+    implementation, ``niter`` honored."""
+    from ..core.tensor import Tensor
+    from ..ops import api as _api
+    v = x.to_dense() if hasattr(x, "to_dense") else (
+        x if isinstance(x, Tensor) else Tensor(x))
+    m, n = v.shape[-2:]
+    q = q if q is not None else min(6, m, n)
+    if center:
+        v = v - _api.mean(v, -2, True)
+    return _api.svd_lowrank(v, q=q, niter=niter)

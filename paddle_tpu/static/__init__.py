@@ -184,11 +184,31 @@ class Program:
         from ..core.tensor import Parameter
         feed_names = list(self.feeds)
 
+        # prune to the fetch subgraph (reference Program pruning /
+        # normalize_program): walk producers backward from the fetches so
+        # dead branches (e.g. the loss side of a train program when only
+        # the prediction is fetched) neither execute nor demand feeds
+        needed = {id(v) for v in fetch_vars}
+        live_nodes = []
+        for node in reversed(self.nodes):
+            if any(id(ov) in needed for ov in node.out_vars):
+                live_nodes.append(node)
+                for v in node.in_vars:
+                    if isinstance(v, Variable) and not isinstance(
+                            v, Parameter):
+                        needed.add(id(v))
+        live_nodes.reverse()
+
         def run(feed_values: Dict[str, Any], param_values=None):
             env: Dict[int, Any] = {}
             for n in feed_names:
-                env[id(self.feeds[n])] = jnp.asarray(feed_values[n])
-            for node in self.nodes:
+                # bind only supplied feeds: a fetch subgraph (e.g. the
+                # inference slice of a train program) may not consume
+                # every recorded feed; truly-needed misses surface below
+                # as used-before-definition
+                if n in feed_values:
+                    env[id(self.feeds[n])] = jnp.asarray(feed_values[n])
+            for node in live_nodes:
                 dyn = []
                 it_const = iter(node.const_args)
                 for v in node.in_vars:
@@ -317,6 +337,9 @@ class Executor:
             fetch_list: Sequence[Variable] = (), return_numpy=True):
         program = program or default_main_program()
         feed = feed or {}
+        if hasattr(program, "fetch_names") and hasattr(program, "_exported"):
+            outs = program.run(feed)      # ExportedProgram (loaded model)
+            return [np.asarray(o) for o in outs] if return_numpy else                 [Tensor(o) for o in outs]
         if not program.nodes and not fetch_list:
             return []          # startup program: params are eager here
         feed_vals = {k: np.asarray(v._value if isinstance(v, Tensor) else v)
@@ -389,3 +412,17 @@ def _bind_recording(on: bool) -> None:
     enable_static is active so pure-dygraph dispatch pays zero cost for
     the Variable scan."""
     _dispatch._static_variable_cls = Variable if on else None
+
+
+from .extras import (  # noqa: F401,E402
+    BuildStrategy, CompiledProgram, ExponentialMovingAverage,
+    IpuCompiledProgram, IpuStrategy, Print, WeightNormParamAttr, accuracy,
+    auc, cpu_places, create_global_var, create_parameter,
+    ctr_metric_bundle, cuda_places, deserialize_persistables,
+    deserialize_program, device_guard, global_scope, gradients,
+    ipu_shard_guard, load, load_from_file, load_inference_model,
+    load_program_state, name_scope, normalize_program, py_func, save,
+    save_inference_model, save_to_file, scope_guard, serialize_persistables,
+    serialize_program, set_ipu_shard, set_program_state, xpu_places,
+)
+from . import nn  # noqa: F401,E402

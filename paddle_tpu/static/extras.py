@@ -1,0 +1,491 @@
+"""paddle.static surface tail (reference python/paddle/static/__init__.py
+__all__): program save/load + serialization, scopes/guards, metric ops,
+parameter creation, EMA, strategies.
+
+TPU-native mappings: a "serialized program" is the feed→fetch replay
+lowered to STABLEHLO (the portable artifact — the recorded closures are
+process-local, so bytes-level fidelity lives at the XLA layer, same
+family as jit.save); scopes collapse into the live Parameter boxes;
+device guards are jax default-device scopes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pickle
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+from . import (Executor, Program, Variable, append_backward,
+               default_main_program)
+
+__all__ = [
+    "save", "load", "save_inference_model", "load_inference_model",
+    "serialize_program", "deserialize_program", "serialize_persistables",
+    "deserialize_persistables", "normalize_program", "save_to_file",
+    "load_from_file", "load_program_state", "set_program_state",
+    "global_scope", "scope_guard", "device_guard", "name_scope",
+    "ipu_shard_guard", "set_ipu_shard", "cpu_places", "cuda_places",
+    "xpu_places", "create_parameter", "create_global_var", "gradients",
+    "accuracy", "auc", "ctr_metric_bundle", "py_func", "Print",
+    "BuildStrategy", "CompiledProgram", "IpuCompiledProgram",
+    "IpuStrategy", "ExponentialMovingAverage", "WeightNormParamAttr",
+]
+
+
+# ---------------------------------------------------------------------------
+# program persistence (reference static/io.py)
+# ---------------------------------------------------------------------------
+
+def _program_state(program: Program) -> Dict[str, np.ndarray]:
+    return {n: np.asarray(p._value) for n, p in program.params.items()}
+
+
+def _export_program(program: Program, feed_vars, fetch_vars):
+    """Lower the feed→fetch replay to serialized STABLEHLO (the recorded
+    closures are process-local; STABLEHLO is the portable form — same
+    artifact family as jit.save)."""
+    feed_names = [v.name for v in feed_vars]
+    fetch_list = list(fetch_vars)
+    raw = program.build_fn(fetch_list)
+
+    def pure(param_vals, *feed_vals):
+        feeds = dict(zip(feed_names, feed_vals))
+        return tuple(raw(feeds, param_vals))
+
+    param_avals = {n: jax.ShapeDtypeStruct(
+        jnp.asarray(p._value).shape, jnp.asarray(p._value).dtype)
+        for n, p in program.params.items()}
+    scope = jax.export.SymbolicScope()
+    feed_avals = []
+    for v in feed_vars:
+        if any(d in (None, -1) for d in v.shape):
+            parts = [f"_d{i}" if d in (None, -1) else str(d)
+                     for i, d in enumerate(v.shape)]
+            shape = jax.export.symbolic_shape(",".join(parts), scope=scope)
+        else:
+            shape = tuple(v.shape)
+        feed_avals.append(jax.ShapeDtypeStruct(shape, v.dtype))
+    exported = jax.export.export(jax.jit(pure))(param_avals, *feed_avals)
+    return exported
+
+
+class ExportedProgram:
+    """Deserialized inference program (reference: the Program returned by
+    load_inference_model).  Executor.run detects and calls it."""
+
+    def __init__(self, exported, state, feed_names, fetch_names):
+        self._exported = exported
+        self._state = {k: jnp.asarray(v) for k, v in state.items()}
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+
+    def run(self, feed: Dict[str, Any]):
+        vals = [jnp.asarray(feed[n]) for n in self.feed_names]
+        return list(self._exported.call(self._state, *vals))
+
+
+def serialize_program(program: Optional[Program] = None, feed_vars=None,
+                      fetch_vars=None, **kw) -> bytes:
+    """Reference static/io.py serialize_program (ProgramDesc bytes →
+    serialized STABLEHLO of the feed→fetch replay here)."""
+    program = program or default_main_program()
+    feed_vars = feed_vars or list(program.feeds.values())
+    fetch_vars = fetch_vars or [program.nodes[-1].out_vars[0]]
+    exported = _export_program(program, feed_vars, fetch_vars)
+    return pickle.dumps({"stablehlo": exported.serialize(),
+                         "feed_names": [v.name for v in feed_vars],
+                         "fetch_names": [v.name for v in fetch_vars]})
+
+
+def deserialize_program(data: bytes) -> "ExportedProgram":
+    blob = pickle.loads(data)
+    exported = jax.export.deserialize(blob["stablehlo"])
+    return ExportedProgram(exported, blob.get("state", {}),
+                           blob["feed_names"], blob["fetch_names"])
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None,
+                           executor=None, program=None, **kw) -> bytes:
+    program = program or default_main_program()
+    return pickle.dumps(_program_state(program))
+
+
+def deserialize_persistables(program: Program, data: bytes,
+                             executor=None) -> None:
+    state = pickle.loads(data)
+    set_program_state(program, state)
+
+
+def save_to_file(path: str, content: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program: Program, feed_vars, fetch_vars, **kw
+                      ) -> Program:
+    """Reference normalize_program prunes to the feed→fetch subgraph; our
+    replay prunes lazily at build_fn time, so this records the io vars."""
+    p = program.clone(for_test=True)
+    p._io_vars = (list(feed_vars), list(fetch_vars))
+    return p
+
+
+def save(program: Program, model_path: str, protocol: int = 4, **kw):
+    """paddle.static.save: parameter state (reference saves persistables;
+    program structure goes via save_inference_model)."""
+    save_to_file(model_path + ".pdparams",
+                 pickle.dumps(_program_state(program), protocol=protocol))
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    state = pickle.loads(load_from_file(model_path + ".pdparams"))
+    set_program_state(program, state)
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
+                         executor=None, program: Optional[Program] = None,
+                         **kw) -> None:
+    """Reference static/io.py:469 — persists the feed→fetch subgraph as
+    STABLEHLO + the parameter values; loadable by
+    :func:`load_inference_model` in a fresh process."""
+    import os
+    program = program or default_main_program()
+    exported = _export_program(program, feed_vars, fetch_vars)
+    blob = {"stablehlo": exported.serialize(),
+            "feed_names": [v.name for v in feed_vars],
+            "fetch_names": [v.name for v in fetch_vars],
+            "state": _program_state(program)}
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    save_to_file(path_prefix + ".pdmodel", pickle.dumps(blob))
+
+
+def load_inference_model(path_prefix: str, executor=None, **kw):
+    """Reference static/io.py:787 — returns
+    [program, feed_names, fetch_names]; the program is an
+    :class:`ExportedProgram` the Executor can run."""
+    blob = pickle.loads(load_from_file(path_prefix + ".pdmodel"))
+    exported = jax.export.deserialize(blob["stablehlo"])
+    prog = ExportedProgram(exported, blob["state"], blob["feed_names"],
+                           blob["fetch_names"])
+    return [prog, blob["feed_names"], blob["fetch_names"]]
+
+
+def load_program_state(model_path: str, var_list=None
+                       ) -> Dict[str, np.ndarray]:
+    return pickle.loads(load_from_file(model_path + ".pdparams"))
+
+
+def set_program_state(program: Program, state: Dict[str, Any]) -> None:
+    for n, p in program.params.items():
+        if n in state:
+            p._value = jnp.asarray(state[n])
+
+
+# ---------------------------------------------------------------------------
+# scopes / guards / places
+# ---------------------------------------------------------------------------
+
+class _Scope:
+    """Live-parameter view (the reference's Scope holds persistables; our
+    Parameters ARE the storage, so the scope reads through them)."""
+
+    def find_var(self, name: str):
+        for prog in (default_main_program(),):
+            if name in prog.params:
+                return prog.params[name]
+        return None
+
+    def var_names(self):
+        return list(default_main_program().params)
+
+
+_global_scope = _Scope()
+
+
+def global_scope() -> _Scope:
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield scope
+
+
+@contextlib.contextmanager
+def device_guard(device: Optional[str] = None):
+    """Reference device_guard('cpu'/'gpu:0') — maps to a jax default
+    device scope."""
+    if device is None:
+        yield
+        return
+    ty = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    plat = {"gpu": None, "cuda": None, "npu": None}.get(ty, ty)
+    try:
+        devs = [d for d in jax.devices()] if plat is None else \
+            [d for d in jax.devices() if d.platform == plat]
+        target = devs[idx] if devs else None
+    except Exception:
+        target = None
+    if target is None:
+        yield
+        return
+    with jax.default_device(target):
+        yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix: Optional[str] = None):
+    """Reference name_scope — names ops for debugging; maps onto
+    jax.named_scope so the prefix shows in XLA profiles."""
+    with jax.named_scope(prefix or "scope"):
+        yield
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    yield                      # IPU is out of scope; guard is a no-op
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+def cpu_places(device_count: Optional[int] = None) -> List:
+    from . import CPUPlace
+    n = device_count or max(
+        len([d for d in jax.devices() if d.platform == "cpu"]), 1)
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None) -> List:
+    from . import CUDAPlace
+    ids = device_ids if device_ids is not None else range(
+        max(len(jax.devices()), 1))
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None) -> List:
+    return cuda_places(device_ids)
+
+
+# ---------------------------------------------------------------------------
+# parameter / variable creation
+# ---------------------------------------------------------------------------
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Reference static.nn.create_parameter — eager Parameter registered
+    with the current Program when recording."""
+    from .. import create_parameter as _cp
+    p = _cp(shape, dtype, name=name, attr=attr,
+            default_initializer=default_initializer, is_bias=is_bias)
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    v = jnp.full(tuple(shape), value, dtype)
+    p = Parameter(v, name=name, trainable=False)
+    p.persistable = persistable
+    return p
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference base/backward.py gradients: grads of targets wrt inputs
+    in the static program.  Marks the program for training and returns
+    grad placeholders; the Executor's fused step computes them."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    pairs = append_backward(targets[0])
+    wanted = {getattr(i, "name", None) for i in (
+        inputs if isinstance(inputs, (list, tuple)) else [inputs])}
+    return [g for p, g in pairs if p.name in wanted or not wanted]
+
+
+# ---------------------------------------------------------------------------
+# metric ops
+# ---------------------------------------------------------------------------
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Reference static accuracy op."""
+    from ..ops import api as _api
+    from ..core.dispatch import run_op
+
+    def impl(x, lab):
+        topk = jax.lax.top_k(x, k)[1]
+        lab_ = lab.reshape(-1, 1)
+        hit = jnp.any(topk == lab_, axis=1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return run_op("accuracy", impl, (input, label), {})
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, name=None):
+    """Reference static auc op (batch AUC, trapezoidal)."""
+    from ..core.dispatch import run_op
+
+    def impl(x, lab):
+        score = x[:, 1] if x.ndim == 2 and x.shape[1] == 2 else \
+            x.reshape(-1)
+        lab_ = lab.reshape(-1).astype(jnp.float32)
+        order = jnp.argsort(-score)
+        lab_sorted = lab_[order]
+        tp = jnp.cumsum(lab_sorted)
+        fp = jnp.cumsum(1.0 - lab_sorted)
+        p = jnp.maximum(tp[-1], 1e-6)
+        n = jnp.maximum(fp[-1], 1e-6)
+        tpr = jnp.concatenate([jnp.zeros(1), tp / p])
+        fpr = jnp.concatenate([jnp.zeros(1), fp / n])
+        return jnp.trapezoid(tpr, fpr)
+
+    return run_op("auc", impl, (input, label), {})
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """Reference ctr_metric_bundle: (auc, batch_auc, ...) bundle — the
+    TPU build surfaces the core AUC pair."""
+    a = auc(input, label)
+    return a, a
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference static py_func op → jax.pure_callback."""
+    from ..core.dispatch import run_op
+
+    def impl(*vals):
+        outs = func(*vals)
+        return outs
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return run_op("py_func", impl, tuple(xs), {})
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Reference Print op → jax.debug.print inside the replay."""
+    from ..core.dispatch import run_op
+
+    def impl(v):
+        jax.debug.print((message or "") + "{}", v)
+        return v
+
+    return run_op("print", impl, (input,), {})
+
+
+# ---------------------------------------------------------------------------
+# strategies / compiled program / EMA
+# ---------------------------------------------------------------------------
+
+class BuildStrategy:
+    """Reference BuildStrategy — pass toggles; XLA owns fusion here, so
+    the knobs are accepted and recorded for introspection."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_auto_fusion = False
+        self.memory_optimize = None
+        self.reduce_strategy = None
+        self.build_cinn_pass = False
+
+
+class CompiledProgram:
+    """Reference CompiledProgram(program).with_data_parallel(...) — the
+    Executor already jit-compiles replays, so this wraps the Program and
+    keeps the API shape."""
+
+    def __init__(self, program: Program, build_strategy: Optional[
+            BuildStrategy] = None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+
+class IpuStrategy:          # IPU backend is an explicit non-goal
+    def __init__(self):
+        self.is_training = True
+
+    def set_graph_config(self, **kw):
+        return None
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, ipu_strategy=None, scope=None):
+        raise NotImplementedError(
+            "IPU backend is out of scope for the TPU build "
+            "(SURVEY §7 non-goals)")
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference static ExponentialMovingAverage):
+    update() after each step; apply()/restore() swap EMA weights in and
+    out for evaluation."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._ema: Dict[str, jax.Array] = {}
+        self._backup: Dict[str, jax.Array] = {}
+        self._step = 0
+
+    def _params(self):
+        return default_main_program().params
+
+    def update(self):
+        self._step += 1
+        # reference uses min(decay, (1+t)/(10+t)) warmup
+        d = min(self._decay, (1.0 + self._step) / (10.0 + self._step))
+        for n, p in self._params().items():
+            v = jnp.asarray(p._value, jnp.float32)
+            prev = self._ema.get(n)
+            self._ema[n] = v if prev is None else d * prev + (1 - d) * v
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {n: p._value for n, p in self._params().items()}
+        for n, p in self._params().items():
+            if n in self._ema:
+                p._value = self._ema[n].astype(
+                    jnp.asarray(self._backup[n]).dtype)
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for n, p in self._params().items():
+            if n in self._backup:
+                p._value = self._backup[n]
+        self._backup = {}
+
+
+class WeightNormParamAttr:
+    """Reference WeightNormParamAttr (weight-normalized parameterization
+    attr).  Carried on the param; the normalization itself is the
+    nn.utils.weight_norm transform."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable

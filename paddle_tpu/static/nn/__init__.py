@@ -1,0 +1,379 @@
+"""paddle.static.nn — build-time layer functions for static programs
+(reference python/paddle/static/nn/__init__.py).
+
+TPU-native: each function creates its Parameters eagerly (so they land in
+the recorded Program as live refs — see static/__init__.py) and routes the
+math through the ordinary functional ops, which record nodes when handed
+symbolic Variables.  The LoD `sequence_*` family needs variable-length
+LoD semantics the recording design intentionally dropped (SURVEY §7 — pad
++ mask is the TPU idiom); those raise with that guidance.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ...core.tensor import Parameter
+
+__all__ = [
+    "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose",
+    "conv3d_transpose", "batch_norm", "layer_norm", "group_norm",
+    "instance_norm", "data_norm", "spectral_norm", "prelu",
+    "bilinear_tensor_product", "deform_conv2d", "row_conv", "nce",
+    "sparse_embedding", "cond", "case", "switch_case", "while_loop",
+    "static_pylayer", "py_func", "sequence_conv", "sequence_pool",
+    "sequence_softmax", "sequence_pad", "sequence_unpad",
+    "sequence_expand", "sequence_expand_as", "sequence_first_step",
+    "sequence_last_step", "sequence_reshape", "sequence_scatter",
+    "sequence_slice", "sequence_enumerate",
+]
+
+
+def _mk_param(shape, dtype="float32", is_bias=False, name=None):
+    from ... import create_parameter
+    return create_parameter(list(shape), dtype, is_bias=is_bias, name=name)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Reference static.nn.fc: flatten trailing dims, linear, optional
+    activation."""
+    from ...nn import functional as F
+    from ...ops import api
+
+    in_dim = int(np.prod([d for d in x.shape[num_flatten_dims:]]))
+    w = _mk_param((in_dim, size))
+    b = None if bias_attr is False else _mk_param((size,), is_bias=True)
+    h = x
+    if len(x.shape) > num_flatten_dims + 1:
+        h = api.reshape(h, list(x.shape[:num_flatten_dims]) + [in_dim])
+    out = F.linear(h, w, b)
+    if activation:
+        out = getattr(api, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    from ...nn import functional as F
+    w = _mk_param(size, dtype)
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+sparse_embedding = embedding
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    from ...nn import functional as F
+    from ...ops import api
+    fs = (filter_size,) * 2 if isinstance(filter_size, int) else \
+        tuple(filter_size)
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    w = _mk_param((num_filters, int(cin) // groups) + fs)
+    b = None if bias_attr is False else _mk_param((num_filters,),
+                                                  is_bias=True)
+    out = F.conv2d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   data_format=data_format)
+    if act:
+        out = getattr(api, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    from ...nn import functional as F
+    from ...ops import api
+    fs = (filter_size,) * 3 if isinstance(filter_size, int) else \
+        tuple(filter_size)
+    cin = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    w = _mk_param((num_filters, int(cin) // groups) + fs)
+    b = None if bias_attr is False else _mk_param((num_filters,),
+                                                  is_bias=True)
+    out = F.conv3d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   data_format=data_format)
+    if act:
+        out = getattr(api, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    from ...nn import functional as F
+    from ...ops import api
+    fs = (filter_size,) * 2 if isinstance(filter_size, int) else \
+        tuple(filter_size)
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    w = _mk_param((int(cin), num_filters // groups) + fs)
+    b = None if bias_attr is False else _mk_param((num_filters,),
+                                                  is_bias=True)
+    out = F.conv2d_transpose(input, w, bias=b, stride=stride,
+                             padding=padding, groups=groups,
+                             output_size=output_size,
+                             data_format=data_format)
+    if act:
+        out = getattr(api, act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    from ...nn import functional as F
+    fs = (filter_size,) * 3 if isinstance(filter_size, int) else \
+        tuple(filter_size)
+    cin = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    w = _mk_param((int(cin), num_filters // groups) + fs)
+    b = None if bias_attr is False else _mk_param((num_filters,),
+                                                  is_bias=True)
+    return F.conv3d_transpose(input, w, bias=b, stride=stride,
+                              padding=padding, data_format=data_format)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, moving_mean_name=None, moving_variance_name=None,
+               do_model_average_for_mean_and_var=True, use_global_stats=False):
+    from ...nn import functional as F
+    from ...ops import api
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    w = _mk_param((c,))
+    b = _mk_param((c,), is_bias=True)
+    mean = _mk_param((c,))
+    var = _mk_param((c,))
+    mean.trainable = False
+    var.trainable = False
+    out = F.batch_norm(input, mean, var, weight=w, bias=b,
+                       training=not is_test, momentum=momentum,
+                       epsilon=epsilon, data_format=data_layout)
+    if act:
+        out = getattr(api, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ...nn import functional as F
+    from ...ops import api
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    w = _mk_param(norm_shape) if scale else None
+    b = _mk_param(norm_shape, is_bias=True) if shift else None
+    out = F.layer_norm(input, input.shape[begin_norm_axis:], weight=w,
+                       bias=b, epsilon=epsilon)
+    if act:
+        out = getattr(api, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from ...nn import functional as F
+    from ...ops import api
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    w = _mk_param((c,))
+    b = _mk_param((c,), is_bias=True)
+    out = F.group_norm(input, groups, weight=w, bias=b, epsilon=epsilon,
+                       data_format=data_layout)
+    if act:
+        out = getattr(api, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from ...nn import functional as F
+    c = input.shape[1]
+    w = _mk_param((c,)) if param_attr is not False else None
+    b = _mk_param((c,), is_bias=True) if bias_attr is not False else None
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, name=None, **kw):
+    """Reference data_norm: normalization by accumulated batch statistics
+    (PS-era); maps to instance-free batch normalization over dim 0."""
+    from ...ops import api
+    mean = api.mean(input, 0, True)
+    var = api.mean((input - mean) ** 2, 0, True)
+    out = (input - mean) / api.sqrt(var + epsilon)
+    if act:
+        out = getattr(api, act)(out)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ...nn import functional as F
+    return F.spectral_norm(weight, dim=dim, power_iters=power_iters,
+                           eps=eps) if hasattr(F, "spectral_norm") else \
+        _spectral_norm_impl(weight, dim, power_iters, eps)
+
+
+def _spectral_norm_impl(weight, dim, power_iters, eps):
+    from ...core.dispatch import run_op
+    import jax.numpy as jnp
+
+    def impl(w):
+        mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((mat.shape[0],), mat.dtype) / np.sqrt(mat.shape[0])
+        for _ in range(max(power_iters, 1)):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ mat @ v
+        return w / sigma
+
+    return run_op("spectral_norm", impl, (weight,), {})
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from ...ops import api
+    if mode == "all":
+        alpha = _mk_param((1,))
+    elif mode == "channel":
+        c = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+        alpha = _mk_param((c,))
+    else:
+        alpha = _mk_param([int(np.prod(x.shape[1:]))])
+    return api.prelu(x, alpha)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from ...nn import functional as F
+    w = _mk_param((size, int(x.shape[-1]), int(y.shape[-1])))
+    b = None if bias_attr is False else _mk_param((size,), is_bias=True)
+    return F.bilinear(x, y, w, b)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, **kw):
+    from ...ops import api
+    if not hasattr(api, "deformable_conv"):
+        raise NotImplementedError(
+            "deform_conv2d: use paddle_tpu.vision.ops.deform_conv2d")
+    raise NotImplementedError("use paddle_tpu.vision.ops.deform_conv2d")
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Reference row_conv (lookahead conv for streaming ASR)."""
+    from ...core.dispatch import run_op
+    import jax.numpy as jnp
+    d = int(input.shape[-1])
+    w = _mk_param((future_context_size + 1, d))
+
+    def impl(x, wv):
+        t = x.shape[-2]
+        outs = 0.0
+        for k in range(future_context_size + 1):
+            shifted = jnp.roll(x, -k, axis=-2)
+            mask = (jnp.arange(t) + k < t).astype(x.dtype)
+            outs = outs + shifted * mask[..., :, None] * wv[k]
+        return outs
+
+    return run_op("row_conv", impl, (input, w), {})
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    raise NotImplementedError(
+        "nce: PS-era negative sampling head; use "
+        "nn.functional.margin_cross_entropy or sampled softmax via "
+        "class_center_sample (SURVEY §7 parameter-server non-goal)")
+
+
+# control flow: under the recording design these run eagerly at build
+# time on Variables via lax constructs inside ops; expose the dygraph
+# equivalents (which ARE jit-compatible) for parity
+def cond(pred, true_fn=None, false_fn=None, name=None,
+         return_names=None):
+    from ...ops import api
+    return api.cond(pred, true_fn, false_fn) if hasattr(api, "cond") \
+        else (true_fn() if bool(pred) else
+              (false_fn() if false_fn else None))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        if bool(getattr(pred, "_value", pred)):
+            return fn()
+    return default() if default else None
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = int(getattr(branch_index, "_value", branch_index))
+    table = dict(branch_fns) if not isinstance(branch_fns, dict) \
+        else branch_fns
+    fn = table.get(idx, default)
+    return fn() if fn else None
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Reference static while_loop → eager loop over Tensors (each body
+    iteration is jit-cached op dispatch; data-dependent trip counts
+    cannot live inside one XLA program by design)."""
+    vars_ = list(loop_vars)
+    while bool(getattr(cond(*vars_), "_value", cond(*vars_))):
+        out = body(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vars_
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    from ...autograd.py_layer import PyLayer
+
+    class _P(PyLayer):
+        @staticmethod
+        def forward(ctx, *xs):
+            return forward_fn(*xs)
+
+        @staticmethod
+        def backward(ctx, *gs):
+            if backward_fn is None:
+                raise RuntimeError("static_pylayer without backward_fn "
+                                   "cannot be differentiated")
+            return backward_fn(*gs)
+
+    return _P.apply(*inputs)
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from ..extras import py_func as _pf
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+def _sequence_unsupported(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"static.nn.{name}: LoD sequence ops are out of scope on TPU "
+            "(variable-length rows break static shapes); use padded "
+            "tensors + masks, e.g. nn.functional.sequence_mask + the "
+            "varlen flash-attention path (SURVEY §7)")
+    fn.__name__ = name
+    return fn
+
+
+sequence_conv = _sequence_unsupported("sequence_conv")
+sequence_pool = _sequence_unsupported("sequence_pool")
+sequence_softmax = _sequence_unsupported("sequence_softmax")
+sequence_pad = _sequence_unsupported("sequence_pad")
+sequence_unpad = _sequence_unsupported("sequence_unpad")
+sequence_expand = _sequence_unsupported("sequence_expand")
+sequence_expand_as = _sequence_unsupported("sequence_expand_as")
+sequence_first_step = _sequence_unsupported("sequence_first_step")
+sequence_last_step = _sequence_unsupported("sequence_last_step")
+sequence_reshape = _sequence_unsupported("sequence_reshape")
+sequence_scatter = _sequence_unsupported("sequence_scatter")
+sequence_slice = _sequence_unsupported("sequence_slice")
+sequence_enumerate = _sequence_unsupported("sequence_enumerate")

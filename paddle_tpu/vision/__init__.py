@@ -6,3 +6,35 @@ Reference: python/paddle/vision (models/, transforms/, datasets/).
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
+
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str) -> None:
+    """Select the image-decoding backend for datasets (reference:
+    vision/image.py set_image_backend; 'pil' or 'cv2')."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"backend must be 'pil'/'cv2'/'tensor', got {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file (reference vision/image.py image_load)."""
+    backend = backend or _image_backend
+    if backend == "cv2":
+        raise ImportError("cv2 backend not available in this build; use "
+                          "'pil'")
+    from PIL import Image
+    img = Image.open(path)
+    if backend == "tensor":
+        import numpy as np
+        from ..core.tensor import Tensor
+        return Tensor(np.asarray(img))
+    return img

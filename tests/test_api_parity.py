@@ -25,6 +25,20 @@ def ref_all(relpath):
     ("nn/functional/__init__.py", "paddle_tpu.nn.functional"),
     ("optimizer/__init__.py", "paddle_tpu.optimizer"),
     ("distribution/__init__.py", "paddle_tpu.distribution"),
+    ("distributed/__init__.py", "paddle_tpu.distributed"),
+    ("static/__init__.py", "paddle_tpu.static"),
+    ("static/nn/__init__.py", "paddle_tpu.static.nn"),
+    ("jit/__init__.py", "paddle_tpu.jit"),
+    ("amp/__init__.py", "paddle_tpu.amp"),
+    ("vision/__init__.py", "paddle_tpu.vision"),
+    ("io/__init__.py", "paddle_tpu.io"),
+    ("sparse/__init__.py", "paddle_tpu.sparse"),
+    ("linalg.py", "paddle_tpu.linalg"),
+    ("fft.py", "paddle_tpu.fft"),
+    ("signal.py", "paddle_tpu.signal"),
+    ("metric/__init__.py", "paddle_tpu.metric"),
+    ("incubate/nn/functional/__init__.py",
+     "paddle_tpu.incubate.nn.functional"),
 ])
 def test_namespace_parity_100pct(relpath, modname):
     import importlib

@@ -14,6 +14,19 @@ SNIPPET = (
     " 'kind': getattr(d[0], 'device_kind', '?')}))"
 )
 
+def _relay_tcp_up(port=2024) -> bool:
+    """Distinguish 'relay down' from 'relay up but chip claim blocks':
+    the axon relay listens on 127.0.0.1:2024; a TCP connect succeeding
+    while jax.devices() still blocks means the wedge is upstream (grant
+    leg / pool), not local connectivity."""
+    import socket
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=3):
+            return True
+    except OSError:
+        return False
+
+
 def probe(timeout=240):
     t0 = time.time()
     try:
@@ -27,7 +40,7 @@ def probe(timeout=240):
         ok, detail = False, f"timeout after {timeout}s (jax.devices() blocked)"
     rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
            "ok": ok, "elapsed_s": round(time.time() - t0, 1),
-           "detail": detail}
+           "detail": detail, "relay_tcp": _relay_tcp_up()}
     with open(LOG, "a") as f:
         f.write(json.dumps(rec) + "\n")
     print(json.dumps(rec))
