@@ -12,5 +12,10 @@ from .features import (  # noqa: F401
     LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram,
 )
 
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends import info, load, save  # noqa: F401
+
 __all__ = ["features", "functional", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC"]
+           "LogMelSpectrogram", "MFCC", "backends", "datasets", "info",
+           "load", "save"]

@@ -1,0 +1,104 @@
+"""paddle.audio.backends parity (reference python/paddle/audio/backends):
+wave-backend load/save/info.  Pure-stdlib WAV codec (PCM16/PCM8/float32)
+— the reference's default in-tree backend is the same wave-based one."""
+
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+
+__all__ = ["list_available_backends", "get_current_backend", "set_backend",
+           "load", "save", "info", "AudioInfo"]
+
+_BACKEND = "wave_backend"
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend() -> str:
+    return _BACKEND
+
+
+def set_backend(backend_name: str) -> None:
+    global _BACKEND
+    if backend_name not in list_available_backends():
+        raise ValueError(f"unknown audio backend {backend_name!r}; "
+                         f"available: {list_available_backends()}")
+    _BACKEND = backend_name
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as w:
+        return AudioInfo(w.getframerate(), w.getnframes(), w.getnchannels(),
+                         w.getsampwidth() * 8,
+                         f"PCM_{'S' if w.getsampwidth() > 1 else 'U'}")
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Returns (Tensor [C, T] float32 in [-1, 1], sample_rate)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    with wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        ch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(min(frame_offset, n))
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(count)
+    if width == 2:
+        data = np.frombuffer(raw, "<i2").astype(np.float32)
+        if normalize:
+            data = data / 32768.0
+    elif width == 1:
+        data = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0)
+        if normalize:
+            data = data / 128.0
+    elif width == 4:
+        data = np.frombuffer(raw, "<i4").astype(np.float32)
+        if normalize:
+            data = data / 2147483648.0
+    else:
+        raise ValueError(f"unsupported sample width {width}")
+    data = data.reshape(-1, ch)
+    out = data.T if channels_first else data
+    return Tensor(jnp.asarray(out)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16) -> None:
+    arr = np.asarray(getattr(src, "_value", src), np.float32)
+    if channels_first:
+        arr = arr.T
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    pcm = np.clip(arr, -1.0, 1.0)
+    if bits_per_sample == 16:
+        frames = (pcm * 32767.0).astype("<i2").tobytes()
+        width = 2
+    elif bits_per_sample == 8:
+        frames = ((pcm * 127.0) + 128.0).astype(np.uint8).tobytes()
+        width = 1
+    else:
+        raise ValueError("bits_per_sample must be 8 or 16")
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(arr.shape[1])
+        w.setsampwidth(width)
+        w.setframerate(int(sample_rate))
+        w.writeframes(frames)

@@ -1,0 +1,99 @@
+"""paddle.audio.datasets parity (reference python/paddle/audio/datasets:
+TESS, ESC50).  Zero-egress build: both read an already-extracted local
+archive directory."""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from ..io.dataset import Dataset
+from . import backends
+from .features import LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram
+
+__all__ = ["TESS", "ESC50"]
+
+_FEATS = {"raw": None, "spectrogram": Spectrogram,
+          "melspectrogram": MelSpectrogram,
+          "logmelspectrogram": LogMelSpectrogram, "mfcc": MFCC}
+
+
+class _AudioClsDataset(Dataset):
+    sample_rate = 16000
+
+    def __init__(self, files, labels, feat_type="raw", **feat_conf):
+        self.files = files
+        self.labels = labels
+        if feat_type not in _FEATS:
+            raise ValueError(f"feat_type must be one of {list(_FEATS)}")
+        cls = _FEATS[feat_type]
+        # features are signal-domain transforms; sr-dependent confs (mel
+        # bins etc.) pass through feat_conf
+        self.feature_extractor = cls(**feat_conf) if cls else None
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        wav, _sr = backends.load(self.files[idx])
+        wav = wav[0] if wav.shape[0] >= 1 else wav   # mono channel
+        if self.feature_extractor is not None:
+            wav = self.feature_extractor(wav)
+        return wav, np.int64(self.labels[idx])
+
+
+class TESS(_AudioClsDataset):
+    """Toronto Emotional Speech Set (reference audio/datasets/tess.py).
+    ``data_dir`` = extracted archive (…/<speaker>_<word>_<emotion>.wav)."""
+
+    emotions = ["angry", "disgust", "fear", "happy", "neutral",
+                "ps", "sad"]
+    sample_rate = 24414
+
+    def __init__(self, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feat_type: str = "raw",
+                 data_dir: str = None, archive=None, **kw):
+        if data_dir is None:
+            raise ValueError("TESS: zero-egress build — pass data_dir= "
+                             "pointing at the extracted dataset")
+        files, labels = [], []
+        for dirpath, _, names in sorted(os.walk(data_dir)):
+            for fn in sorted(names):
+                if not fn.lower().endswith(".wav"):
+                    continue
+                emo = fn.rsplit("_", 1)[-1][:-4].lower()
+                if emo in self.emotions:
+                    files.append(os.path.join(dirpath, fn))
+                    labels.append(self.emotions.index(emo))
+        fold = np.arange(len(files)) % n_folds + 1
+        keep = (fold != split) if mode == "train" else (fold == split)
+        files = [f for f, k in zip(files, keep) if k]
+        labels = [l for l, k in zip(labels, keep) if k]
+        super().__init__(files, labels, feat_type, **kw)
+
+
+class ESC50(_AudioClsDataset):
+    """ESC-50 environmental sounds (reference audio/datasets/esc50.py).
+    ``data_dir`` = extracted archive containing meta/esc50.csv + audio/."""
+
+    sample_rate = 44100
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", data_dir: str = None, **kw):
+        if data_dir is None:
+            raise ValueError("ESC50: zero-egress build — pass data_dir= "
+                             "pointing at the extracted dataset")
+        meta = os.path.join(data_dir, "meta", "esc50.csv")
+        files, labels = [], []
+        with open(meta) as f:
+            for row in csv.DictReader(f):
+                if mode == "train" and int(row["fold"]) == split:
+                    continue
+                if mode != "train" and int(row["fold"]) != split:
+                    continue
+                files.append(os.path.join(data_dir, "audio",
+                                          row["filename"]))
+                labels.append(int(row["target"]))
+        super().__init__(files, labels, feat_type, **kw)

@@ -4,3 +4,30 @@
 from ..core.autograd import backward, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 from .functional import hessian, jacobian, jvp, vjp  # noqa: F401
+
+
+class saved_tensors_hooks:
+    """Context manager installing pack/unpack hooks on tensors saved for
+    backward (reference autograd/saved_tensors_hooks.py — the activation
+    offload/compression hook point).
+
+    TPU-native: the eager tape saves primal VALUES on each GradNode
+    (core/dispatch.py); inside this context every node records
+    ``pack_hook(value)`` instead and backward resolves values through
+    ``unpack_hook`` — same contract, e.g. offload-to-host via
+    ``jax.device_put(x, cpu)`` in pack and back in unpack."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from ..core import autograd as _ag
+        self._prev = getattr(_ag, "_saved_tensor_hooks", None)
+        _ag._saved_tensor_hooks = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import autograd as _ag
+        _ag._saved_tensor_hooks = self._prev
+        return False

@@ -80,6 +80,11 @@ def enable_grad(fn=None):
 
 _node_counter = [0]
 
+# (pack, unpack) hooks for primals saved on GradNodes
+# (paddle.autograd.saved_tensors_hooks — offload/compress saved
+# activations); None = save values directly
+_saved_tensor_hooks = None
+
 
 class GradNode:
     """One recorded eager op.
@@ -95,7 +100,7 @@ class GradNode:
     """
 
     __slots__ = ("name", "exec_key", "call", "in_tensors", "in_values",
-                 "out_avals", "out_treedef", "id")
+                 "out_avals", "out_treedef", "id", "unpack_hook")
 
     def __init__(self, name, exec_key, call, in_tensors, in_values, out_avals,
                  out_treedef):
@@ -103,7 +108,13 @@ class GradNode:
         self.exec_key = exec_key
         self.call = call
         self.in_tensors = in_tensors
-        self.in_values = in_values
+        hooks = _saved_tensor_hooks
+        if hooks is not None:
+            self.in_values = [hooks[0](v) for v in in_values]
+            self.unpack_hook = hooks[1]
+        else:
+            self.in_values = in_values
+            self.unpack_hook = None
         self.out_avals = out_avals
         self.out_treedef = out_treedef
         _node_counter[0] += 1
@@ -247,6 +258,9 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
             c if c is not None else jnp.zeros(a.shape, a.dtype)
             for c, a in zip(cts, node.out_avals)
         ]
+        if node.unpack_hook is not None and node.in_values is not None:
+            node.in_values = [node.unpack_hook(v) for v in node.in_values]
+            node.unpack_hook = None
         if create_graph:
             grads = _node_grads_recorded(node, cts_flat)
         else:

@@ -227,7 +227,8 @@ _INPLACE_EXPORTS = [
     "squeeze", "subtract", "t", "tan", "tanh", "transpose", "tril",
     "triu", "trunc", "unsqueeze", "where", "zero", "bitwise_and",
     "bitwise_not", "bitwise_or", "bitwise_xor", "bitwise_left_shift",
-    "bitwise_right_shift", "fill_diagonal",
+    "bitwise_right_shift", "fill_diagonal", "index_add", "index_fill",
+    "index_put",
 ]
 
 _RANDOM_INPLACE = ["normal", "uniform", "exponential", "bernoulli",

@@ -19,7 +19,8 @@ from ..core.tensor import Tensor
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
            "segment_mean", "segment_min", "segment_max", "reindex_graph",
-           "sample_neighbors"]
+           "sample_neighbors", "reindex_heter_graph",
+           "weighted_sample_neighbors"]
 
 _REDUCERS = {
     "sum": jax.ops.segment_sum,
@@ -160,3 +161,74 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
     neighbors = np.concatenate(out_nb) if out_nb else np.zeros(0, rv.dtype)
     return (Tensor(jnp.asarray(neighbors)),
             Tensor(jnp.asarray(np.array(out_cnt, np.int32))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous-graph reindex (reference geometric/reindex.py
+    reindex_heter_graph): per-edge-type neighbor lists share ONE node
+    renumbering keyed on x."""
+    import numpy as np
+
+    xs = np.asarray(getattr(x, "_value", x))
+    neigh_list = [np.asarray(getattr(n, "_value", n)) for n in neighbors]
+    cnt_list = [np.asarray(getattr(c, "_value", c)) for c in count]
+    mapping = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+
+    def map_id(v):
+        v = int(v)
+        if v not in mapping:
+            mapping[v] = len(out_nodes)
+            out_nodes.append(v)
+        return mapping[v]
+
+    reindexed = []
+    rows = []
+    for neigh, cnt in zip(neigh_list, cnt_list):
+        reindexed.append(np.asarray([map_id(v) for v in neigh], np.int64))
+        rows.append(np.repeat(np.arange(len(cnt)), cnt).astype(np.int64))
+    import jax.numpy as _jnp
+    out_src = [Tensor(_jnp.asarray(r)) for r in reindexed]
+    out_dst = [Tensor(_jnp.asarray(r)) for r in rows]
+    return (out_src, out_dst,
+            Tensor(_jnp.asarray(np.asarray(out_nodes, np.int64))))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None,
+                              return_eids=False, name=None):
+    """Weight-biased neighbor sampling (reference geometric/sampling/
+    neighbors.py weighted_sample_neighbors): sample w/o replacement with
+    probability proportional to edge weight."""
+    import numpy as np
+
+    rows = np.asarray(getattr(row, "_value", row))
+    cp = np.asarray(getattr(colptr, "_value", colptr))
+    wts = np.asarray(getattr(edge_weight, "_value", edge_weight),
+                     np.float64)
+    nodes = np.asarray(getattr(input_nodes, "_value", input_nodes))
+    rng = np.random.default_rng(0 if name is None else abs(hash(name)))
+    out, counts, out_eids = [], [], []
+    for v in nodes:
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        neigh = rows[lo:hi]
+        w = wts[lo:hi]
+        if sample_size < 0 or len(neigh) <= sample_size:
+            pick = np.arange(len(neigh))
+        else:
+            p = w / w.sum() if w.sum() > 0 else None
+            pick = rng.choice(len(neigh), size=sample_size, replace=False,
+                              p=p)
+        out.append(neigh[pick])
+        counts.append(len(pick))
+        out_eids.append(lo + pick)
+    import jax.numpy as _jnp
+    res = (Tensor(_jnp.asarray(np.concatenate(out) if out else
+                               np.zeros(0, rows.dtype))),
+           Tensor(_jnp.asarray(np.asarray(counts, np.int32))))
+    if return_eids:
+        res = res + (Tensor(_jnp.asarray(
+            np.concatenate(out_eids) if out_eids else
+            np.zeros(0, np.int64))),)
+    return res

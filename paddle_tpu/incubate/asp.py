@@ -1,0 +1,104 @@
+"""Automatic SParsity (reference python/paddle/incubate/asp/*): 2:4
+structured sparsity — mask computation, model pruning, and mask
+re-application after optimizer steps.
+
+TPU note: 2:4 sparse tensor cores are a GPU feature; on TPU the masks
+still deliver model compression + the training-time regularization
+semantics, computed with the same best-2-of-4 magnitude rule."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density",
+           "check_sparsity", "create_mask"]
+
+_EXCLUDED: set = set()
+_MASKS: Dict[str, jnp.ndarray] = {}
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(getattr(x, "_value", x))
+    return float((arr != 0).sum() / arr.size)
+
+
+def create_mask(tensor, func_name="mask_1d", n=2, m=4):
+    """Best-n-of-m magnitude mask along the last dim (reference
+    asp/utils.py create_mask mask_1d)."""
+    arr = np.asarray(getattr(tensor, "_value", tensor))
+    flat = arr.reshape(-1, m) if arr.size % m == 0 else None
+    if flat is None:
+        return np.ones_like(arr)
+    idx = np.argsort(-np.abs(flat), axis=1)[:, :n]
+    mask = np.zeros_like(flat)
+    np.put_along_axis(mask, idx, 1.0, axis=1)
+    return mask.reshape(arr.shape)
+
+
+def check_sparsity(tensor, func_name="check_1d", n=2, m=4) -> bool:
+    arr = np.asarray(getattr(tensor, "_value", tensor))
+    if arr.size % m:
+        return False
+    flat = (arr.reshape(-1, m) != 0).sum(axis=1)
+    return bool((flat <= n).all())
+
+
+def _prunable(layer):
+    from ..nn import Conv2D, Linear
+    return isinstance(layer, (Linear, Conv2D))
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to every prunable layer's weight (reference
+    asp/asp.py prune_model)."""
+    masks = {}
+    for name, sub in model.named_sublayers(include_self=True):
+        if not _prunable(sub):
+            continue
+        w = getattr(sub, "weight", None)
+        if w is None or w.name in _EXCLUDED:
+            continue
+        mask = create_mask(w, mask_algo, n, m)
+        w._value = jnp.asarray(np.asarray(w._value) * mask)
+        masks[w.name] = jnp.asarray(mask)
+    if with_mask:
+        _MASKS.update(masks)
+    return masks
+
+
+def decorate(optimizer):
+    """Wrap the optimizer so masks re-apply after each step (reference
+    asp/asp.py decorate → OptimizerWithSparsityGuarantee)."""
+
+    class _ASPOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def step(self):
+            self._inner.step()
+            for p in self._inner._parameters or []:
+                mask = _MASKS.get(p.name)
+                if mask is not None:
+                    p._value = p._value * mask
+
+        def minimize(self, loss, **kw):
+            loss.backward()
+            self.step()
+            return None, []
+
+    return _ASPOptimizer(optimizer)

@@ -1,0 +1,199 @@
+"""paddle.incubate surface tail (reference python/paddle/incubate/
+__init__.py __all__): graph ops (aliases of paddle.geometric), segment
+reductions, fused softmax-mask, LookAhead/ModelAverage optimizers,
+identity_loss."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..geometric import (reindex_graph, sample_neighbors, segment_max,
+                         segment_mean, segment_min, segment_sum,
+                         send_u_recv)
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["graph_send_recv", "graph_reindex", "graph_sample_neighbors",
+           "graph_khop_sampler", "segment_sum", "segment_mean",
+           "segment_max", "segment_min", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle", "identity_loss",
+           "LookAhead", "ModelAverage"]
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Deprecated incubate name for paddle.geometric.send_u_recv."""
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, flag_buffer_hashtable=False,
+                  name=None):
+    return reindex_graph(x, neighbors, count, value_buffer, index_buffer)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop sampling (reference incubate/operators/graph_khop_sampler):
+    chained sample_neighbors + reindex over each hop."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    nodes = input_nodes
+    all_edges_src, all_edges_dst = [], []
+    frontier = nodes
+    for k in sample_sizes:
+        neigh, counts = sample_neighbors(row, colptr, frontier,
+                                         sample_size=k)
+        nv = np.asarray(neigh._value)
+        cv = np.asarray(counts._value)
+        fv = np.asarray(frontier._value)
+        dst = np.repeat(fv, cv)
+        all_edges_src.append(nv)
+        all_edges_dst.append(dst)
+        frontier = Tensor(jnp.asarray(
+            np.unique(np.concatenate([fv, nv]))))
+    src = np.concatenate(all_edges_src) if all_edges_src else \
+        np.zeros(0, np.int64)
+    dst = np.concatenate(all_edges_dst) if all_edges_dst else \
+        np.zeros(0, np.int64)
+    uniq, inv = np.unique(np.concatenate([np.asarray(
+        input_nodes._value), src, dst]), return_inverse=True)
+    n_in = len(np.asarray(input_nodes._value))
+    src_r = inv[n_in:n_in + len(src)]
+    dst_r = inv[n_in + len(src):]
+    out = (Tensor(jnp.asarray(uniq)), Tensor(jnp.asarray(src_r)),
+           Tensor(jnp.asarray(dst_r)))
+    if return_eids:
+        return out + (Tensor(jnp.zeros(len(src_r), jnp.int64)),)
+    return out
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused softmax(x + mask) (reference incubate/operators/
+    softmax_mask_fuse → Pallas fused_softmax_mask)."""
+    from ..ops.pallas.fused import fused_softmax_mask
+    from ..core.dispatch import run_op
+
+    def impl(xv, mv):
+        return fused_softmax_mask(xv, mv)
+
+    return run_op("softmax_mask_fuse", impl, (x, mask), {})
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Fused causal-masked softmax (reference softmax_mask_fuse_upper_
+    triangle: adds -inf above the diagonal — the GPT attention mask)."""
+    from ..core.dispatch import run_op
+
+    def impl(xv):
+        import jax
+        s_q, s_k = xv.shape[-2], xv.shape[-1]
+        tri = jnp.tril(jnp.ones((s_q, s_k), bool))
+        logits = jnp.where(tri, xv.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(logits, axis=-1).astype(xv.dtype)
+
+    return run_op("softmax_mask_fuse_upper_triangle", impl, (x,), {})
+
+
+def identity_loss(x, reduction="none"):
+    """Reference incubate identity_loss (IPU host-loss marker): reduce
+    and mark as the loss value."""
+    from ..ops import api
+    if reduction in ("none", 2):
+        return api.assign(x)
+    if reduction in ("mean", 1):
+        return api.mean(x)
+    if reduction in ("sum", 0):
+        return api.sum(x)
+    raise ValueError(f"bad reduction {reduction!r}")
+
+
+class LookAhead(Optimizer):
+    """Lookahead optimizer wrapper (reference incubate/optimizer/
+    lookahead.py; Zhang et al. 2019): every k steps pull fast weights
+    toward slow weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self._alpha = float(alpha)
+        self._k = int(k)
+        self._slow = {}
+        self._steps = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._steps += 1
+        params = self.inner_optimizer._parameters or []
+        if self._steps % self._k == 0:
+            for p in params:
+                slow = self._slow.get(p.name)
+                if slow is None:
+                    slow = p._value
+                slow = slow + self._alpha * (p._value - slow)
+                self._slow[p.name] = slow
+                p._value = slow
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, []
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+
+class ModelAverage(Optimizer):
+    """Model averaging (reference incubate/optimizer/modelaverage.py):
+    running average of parameters; apply()/restore() swap it in for
+    evaluation."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(0.0, parameters, None, None, False)
+        self._sum = {}
+        self._cnt = 0
+        self._backup = {}
+
+    def step(self):
+        self._cnt += 1
+        for p in self._parameters or []:
+            cur = self._sum.get(p.name)
+            self._sum[p.name] = p._value if cur is None else cur + p._value
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._backup = {p.name: p._value
+                            for p in self._parameters or []}
+            for p in self._parameters or []:
+                if p.name in self._sum and self._cnt:
+                    p._value = self._sum[p.name] / self._cnt
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        for p in self._parameters or []:
+            if p.name in self._backup:
+                p._value = self._backup[p.name]
+        self._backup = {}

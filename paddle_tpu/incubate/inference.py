@@ -1,0 +1,8 @@
+"""paddle.incubate.inference parity — the experimental predictor sugar
+routes to the stable paddle_tpu.inference facade."""
+
+from ..inference import (Config, LLMPredictor, Predictor,  # noqa: F401
+                         create_llm_predictor, create_predictor)
+
+__all__ = ["Config", "Predictor", "create_predictor", "LLMPredictor",
+           "create_llm_predictor"]

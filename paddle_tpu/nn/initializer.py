@@ -189,3 +189,37 @@ class Dirac(Initializer):
             for i in range(min(per, ic)):
                 out[(g * per + i, i) + spatial_center] = 1.0
         return jnp.asarray(out, dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsample kernel init for transposed convs (reference
+    nn/initializer/Bilinear): weights implement bilinear interpolation."""
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as _np
+        c_out, c_in, kh, kw = shape
+        f = _np.ceil(kw / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        w = _np.zeros(shape, _np.float32)
+        for i in range(kh):
+            for j in range(kw):
+                v = (1 - abs(i / f - c)) * (1 - abs(j / f - c))
+                w[:, :, i, j] = v
+        import jax.numpy as _jnp
+        from ..core.dtypes import canonical_dtype
+        return _jnp.asarray(w, canonical_dtype(dtype))
+
+
+_GLOBAL_INITIALIZER = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference set_global_initializer: default init for subsequently
+    created parameters (create_parameter consults this when no
+    default_initializer is given)."""
+    _GLOBAL_INITIALIZER["weight"] = weight_init
+    _GLOBAL_INITIALIZER["bias"] = bias_init
+
+
+def get_global_initializer(is_bias=False):
+    return _GLOBAL_INITIALIZER["bias" if is_bias else "weight"]

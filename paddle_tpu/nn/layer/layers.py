@@ -114,6 +114,8 @@ class Layer:
             init = attr.initializer
         elif default_initializer is not None:
             init = default_initializer
+        elif I.get_global_initializer(is_bias) is not None:
+            init = I.get_global_initializer(is_bias)
         elif is_bias:
             init = I.Constant(0.0)
         else:

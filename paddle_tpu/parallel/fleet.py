@@ -13,6 +13,10 @@ from .env import get_rank, get_world_size, init_parallel_env
 from .topology import HybridTopology, get_topology, set_topology
 
 __all__ = ["DistributedStrategy", "init", "distributed_model",
+           "Fleet", "UtilBase", "Role", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "CommunicateTopology",
+           "HybridCommunicateGroup", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator",
            "distributed_optimizer", "get_hybrid_communicate_group"]
 
 
@@ -88,3 +92,182 @@ worker_num = get_world_size
 
 def is_first_worker() -> bool:
     return get_rank() == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet __all__ tail (reference distributed/fleet/__init__.py)
+# ---------------------------------------------------------------------------
+
+# the reference's CommunicateTopology / HybridCommunicateGroup
+# (fleet/base/topology.py:65/:178) are the rank-grid + per-axis comm-group
+# objects — HybridTopology plays both roles here (mesh + axis groups)
+CommunicateTopology = HybridTopology
+HybridCommunicateGroup = HybridTopology
+
+
+class Role:
+    """Reference role_maker.Role enum."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """Reference PaddleCloudRoleMaker: derives the rank/role from launcher
+    environment variables (PADDLE_TRAINER_ID & co. — the same env our
+    launcher sets)."""
+
+    def __init__(self, is_collective: bool = True, **kwargs):
+        import os
+        self._is_collective = is_collective
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    def worker_index(self) -> int:
+        return self._rank
+
+    def worker_num(self) -> int:
+        return self._size
+
+    def is_worker(self) -> bool:
+        return True
+
+    def is_server(self) -> bool:
+        return False
+
+    def is_first_worker(self) -> bool:
+        return self._rank == 0
+
+    def role(self):
+        return Role.WORKER
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Reference UserDefinedRoleMaker: explicit rank/size."""
+
+    def __init__(self, is_collective=True, current_id=0, worker_num=1,
+                 role=Role.WORKER, **kwargs):
+        super().__init__(is_collective)
+        self._rank = current_id
+        self._size = worker_num
+        self._role = role
+
+    def role(self):
+        return self._role
+
+
+class UtilBase:
+    """Reference UtilBase: small cross-rank helpers over the collective
+    API."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from ..core.tensor import Tensor
+        from . import collective as C
+        t = input if isinstance(input, Tensor) else Tensor(np.asarray(input))
+        C.all_reduce(t, op=mode)
+        return np.asarray(t._value)
+
+    def barrier(self, comm_world="worker"):
+        from . import collective as C
+        C.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        import numpy as np
+
+        from ..core.tensor import Tensor
+        from . import collective as C
+        out = []
+        C.all_gather(out, Tensor(np.asarray(input)))
+        return [np.asarray(o._value) for o in out]
+
+    def get_file_shard(self, files):
+        from .env import get_rank, get_world_size
+        n, r = get_world_size(), get_rank()
+        return files[r::n]
+
+    def print_on_rank(self, message, rank_id=0):
+        from .env import get_rank
+        if get_rank() == rank_id:
+            print(message)
+
+
+class MultiSlotDataGenerator:
+    """Reference fleet.MultiSlotDataGenerator (PS data-ingest protocol):
+    subclass, implement generate_sample(line) yielding
+    [(slot_name, [values]), ...]; run_from_stdin()/run_from_files()
+    emit the multi-slot text protocol."""
+
+    def __init__(self):
+        self._proto_info = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "implement generate_sample(line) -> iterator of "
+            "[(slot, values), ...]")
+
+    def _format(self, sample) -> str:
+        parts = []
+        for _slot, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            for sample in self.generate_sample(line):
+                sys.stdout.write(self._format(sample) + "\n")
+
+    def run_from_files(self, filelist):
+        out = []
+        for path in filelist:
+            with open(path) as f:
+                for line in f:
+                    for sample in self.generate_sample(line):
+                        out.append(self._format(sample))
+        return out
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-slot variant (values emitted verbatim)."""
+
+
+class Fleet:
+    """Reference fleet.Fleet facade class (fleet/fleet.py:99).  The
+    module-level functions (init/distributed_model/...) are the singleton
+    instance's methods, matching how the reference exposes
+    ``paddle.distributed.fleet`` as a Fleet() instance."""
+
+    def __init__(self):
+        self.util = UtilBase()
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        return init(is_collective=is_collective, role_maker=role_maker,
+                    strategy=strategy)
+
+    def distributed_model(self, model, **kw):
+        return distributed_model(model, **kw)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    @property
+    def worker_index(self):
+        from .env import get_rank
+        return get_rank
+
+    @property
+    def worker_num(self):
+        from .env import get_world_size
+        return get_world_size
+
+    def barrier_worker(self):
+        from . import collective as C
+        C.barrier()

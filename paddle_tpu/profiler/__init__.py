@@ -11,9 +11,9 @@ TPU-first mapping (SURVEY §5 tracing):
 """
 
 from .profiler import (  # noqa: F401
-    Profiler, ProfilerState, ProfilerTarget, RecordEvent,
-    export_chrome_tracing, load_profiler_result, make_scheduler,
-    record_function,
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent, SummaryView,
+    export_chrome_tracing, export_protobuf, load_profiler_result,
+    make_scheduler, record_function,
 )
 from .statistics import SortedKeys, StatisticData, summary  # noqa: F401
 

@@ -11,7 +11,8 @@ from typing import Callable, List, Optional, Sequence
 
 __all__ = ["ProfilerTarget", "ProfilerState", "make_scheduler",
            "RecordEvent", "record_function", "Profiler",
-           "export_chrome_tracing", "load_profiler_result"]
+           "export_chrome_tracing", "load_profiler_result",
+           "SummaryView", "export_protobuf"]
 
 
 class ProfilerTarget(enum.Enum):
@@ -298,3 +299,37 @@ class Profiler:
 
     def export(self, path: str, format: str = "json"):
         return self._export_chrome(path)
+
+
+class SummaryView(enum.Enum):
+    """Statistic table views (reference profiler/profiler.py SummaryView)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready factory writing the host-event records as a
+    serialized protobuf-style blob (reference export_protobuf; XPlane on
+    TPU comes from jax.profiler.trace)."""
+    import os
+    import pickle
+    import socket
+    import time
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{socket.gethostname()}_{os.getpid()}"
+        path = os.path.join(dir_name,
+                            f"{name}_{int(time.time() * 1000)}.pb")
+        with open(path, "wb") as f:
+            pickle.dump({"events": [e.__dict__ for e in prof._events]}, f)
+        return path
+
+    return handler

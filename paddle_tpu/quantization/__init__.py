@@ -9,12 +9,14 @@ runs observers that collect absmax/histogram stats during calibration.
 
 from .config import QuantConfig  # noqa: F401
 from .quanters import (  # noqa: F401
-    AbsMaxObserver, BaseQuanter, FakeQuanterWithAbsMax,
+    AbsMaxObserver, BaseObserver, BaseQuanter, FakeQuanterWithAbsMax,
+    quanter,
     FakeQuanterWithAbsMaxObserver, quant_dequant,
 )
 from .qat import QAT  # noqa: F401
 from .ptq import PTQ  # noqa: F401
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "BaseQuanter",
+           "BaseObserver", "quanter",
            "FakeQuanterWithAbsMax", "FakeQuanterWithAbsMaxObserver",
            "AbsMaxObserver", "quant_dequant"]

@@ -125,3 +125,26 @@ class AbsMaxObserver(BaseQuanter):
     def scales(self):
         import jax.numpy as jnp
         return Tensor(jnp.asarray([max(self._max, 1e-9)], jnp.float32))
+
+
+class BaseObserver(BaseQuanter):
+    """Observer base (reference paddle/quantization/base_observer.py):
+    a quanter that only COLLECTS statistics during calibration; PTQ
+    observers (AbsMaxObserver etc.) subclass this."""
+
+    def cal_thresholds(self):
+        raise NotImplementedError
+
+
+def quanter(name: str):
+    """Class decorator registering a custom quanter under ``name``
+    (reference quantization/factory.py quanter): the QuantConfig factory
+    can then instantiate it by name."""
+    def deco(cls):
+        _QUANTER_REGISTRY[name] = cls
+        cls.quanter_name = name
+        return cls
+    return deco
+
+
+_QUANTER_REGISTRY = {}

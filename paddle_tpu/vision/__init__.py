@@ -3,6 +3,7 @@
 Reference: python/paddle/vision (models/, transforms/, datasets/).
 """
 
+from . import ops  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401

@@ -1,3 +1,4 @@
 from .mnist import MNIST, FashionMNIST  # noqa: F401
 from .cifar import Cifar10, Cifar100  # noqa: F401
 from .fake import FakeData  # noqa: F401
+from .folder import DatasetFolder, Flowers, ImageFolder, VOC2012  # noqa: F401

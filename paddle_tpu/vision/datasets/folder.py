@@ -1,0 +1,194 @@
+"""Folder-based datasets + downloadable-zoo tails (reference:
+python/paddle/vision/datasets/{folder,flowers,voc2012}.py).
+
+DatasetFolder/ImageFolder are fully local; Flowers/VOC2012 read an
+already-downloaded data_file (this build has zero egress — download=True
+raises with instructions, matching the capability minus the network
+fetch)."""
+
+from __future__ import annotations
+
+import os
+import tarfile
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def _pil_loader(path):
+    from PIL import Image
+    with open(path, "rb") as f:
+        return Image.open(f).convert("RGB")
+
+
+def has_valid_extension(filename: str, extensions=IMG_EXTENSIONS) -> bool:
+    return filename.lower().endswith(tuple(extensions))
+
+
+class DatasetFolder(Dataset):
+    """root/class_x/xxx.ext layout (reference folder.py DatasetFolder)."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions=IMG_EXTENSIONS, transform=None,
+                 is_valid_file: Optional[Callable] = None):
+        self.root = root
+        self.loader = loader or _pil_loader
+        self.transform = transform
+        classes = sorted(d.name for d in os.scandir(root) if d.is_dir())
+        if not classes:
+            raise FileNotFoundError(f"no class folders in {root!r}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        check = is_valid_file or (
+            lambda p: has_valid_extension(p, extensions))
+        self.samples: List[Tuple[str, int]] = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    path = os.path.join(dirpath, fn)
+                    if check(path):
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise FileNotFoundError(
+                f"no valid files under {root!r} (extensions {extensions})")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive image folder WITHOUT labels (reference folder.py
+    ImageFolder — returns [img] lists)."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions=IMG_EXTENSIONS, transform=None,
+                 is_valid_file: Optional[Callable] = None):
+        self.root = root
+        self.loader = loader or _pil_loader
+        self.transform = transform
+        check = is_valid_file or (
+            lambda p: has_valid_extension(p, extensions))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                path = os.path.join(dirpath, fn)
+                if check(path):
+                    self.samples.append(path)
+        if not self.samples:
+            raise FileNotFoundError(f"no valid files under {root!r}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+
+_NO_EGRESS = ("this build has no network egress; pass data_file= pointing "
+              "at the already-downloaded archive (reference dataset URL in "
+              "the class docstring)")
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference flowers.py; data from
+    https://www.robots.ox.ac.uk/~vgg/data/flowers/102/).  Requires local
+    ``data_file``/``label_file``/``setid_file`` archives."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True,
+                 backend=None):
+        if data_file is None:
+            raise ValueError(f"Flowers: {_NO_EGRESS}")
+        import scipy.io as sio  # scipy is available with jax
+        self.transform = transform
+        labels = sio.loadmat(label_file)["labels"][0]
+        setid = sio.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.indexes = setid[key][0]
+        self._tar = tarfile.open(data_file)
+        self._names = {os.path.basename(m.name): m
+                       for m in self._tar.getmembers() if m.isfile()}
+        self._labels = labels
+
+    def __len__(self):
+        return len(self.indexes)
+
+    def __getitem__(self, idx):
+        import io as _io
+        from PIL import Image
+        img_idx = int(self.indexes[idx])
+        name = f"image_{img_idx:05d}.jpg"
+        data = self._tar.extractfile(self._names[name]).read()
+        img = Image.open(_io.BytesIO(data)).convert("RGB")
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self._labels[img_idx - 1] - 1)
+
+
+class VOC2012(Dataset):
+    """PASCAL VOC2012 segmentation (reference voc2012.py).  Requires the
+    local VOCtrainval archive via ``data_file``."""
+
+    _LIST = {"train": "ImageSets/Segmentation/train.txt",
+             "valid": "ImageSets/Segmentation/val.txt",
+             "test": "ImageSets/Segmentation/val.txt"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if data_file is None:
+            raise ValueError(f"VOC2012: {_NO_EGRESS}")
+        self.transform = transform
+        self._tar = tarfile.open(data_file)
+        members = {m.name: m for m in self._tar.getmembers()}
+        root = next(n.split("/")[0] for n in members)
+        lst = self._tar.extractfile(
+            members[f"{root}/VOCdevkit/VOC2012/{self._LIST[mode]}"]) \
+            if f"{root}/VOCdevkit/VOC2012/{self._LIST[mode]}" in members \
+            else None
+        if lst is None:
+            # archives differ in nesting; search for the list file
+            cand = [n for n in members if n.endswith(self._LIST[mode])]
+            lst = self._tar.extractfile(members[cand[0]])
+            root = cand[0][: -len(self._LIST[mode])].rstrip("/")
+        else:
+            root = f"{root}/VOCdevkit/VOC2012"
+        self._root = root
+        self._members = members
+        self.ids = [l.strip() for l in
+                    lst.read().decode().splitlines() if l.strip()]
+
+    def __len__(self):
+        return len(self.ids)
+
+    def __getitem__(self, idx):
+        import io as _io
+        from PIL import Image
+        name = self.ids[idx]
+        img = Image.open(_io.BytesIO(self._tar.extractfile(
+            self._members[f"{self._root}/JPEGImages/{name}.jpg"]).read()))
+        lab = Image.open(_io.BytesIO(self._tar.extractfile(
+            self._members[
+                f"{self._root}/SegmentationClass/{name}.png"]).read()))
+        img = np.asarray(img.convert("RGB"))
+        lab = np.asarray(lab)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lab

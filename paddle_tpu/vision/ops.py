@@ -1,0 +1,284 @@
+"""paddle.vision.ops parity (reference python/paddle/vision/ops.py):
+detection ops + their Layer wrappers, deformable conv, FPN utilities,
+image-file ops.
+
+Most functional ops live in the registry (ops/impl/detection.py);
+this module adds deform_conv2d (bilinear tap sampling — the TPU
+formulation of the deformable-conv gather), distribute_fpn_proposals,
+matrix_nms, read_file/decode_jpeg, and the Layer classes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.api import (box_coder, generate_proposals, nms, prior_box,
+                       psroi_pool, roi_align, roi_pool, yolo_box,
+                       yolo_loss)
+
+__all__ = ["yolo_loss", "yolo_box", "prior_box", "box_coder",
+           "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
+           "generate_proposals", "matrix_nms", "read_file", "decode_jpeg",
+           "roi_pool", "RoIPool", "psroi_pool", "PSRoIPool", "roi_align",
+           "RoIAlign", "nms"]
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference vision/ops.py deform_conv2d →
+    deformable_conv kernel; Dai et al. 2017 / Zhu et al. 2019).
+
+    TPU formulation: for every output location and kernel tap, bilinearly
+    sample the input at (base + learned offset) — one fused gather —
+    then contract taps×channels with the weight on the MXU.
+    x [N,Cin,H,W]; offset [N, 2*G_d*kh*kw, Ho, Wo];
+    mask [N, G_d*kh*kw, Ho, Wo] (v2) or None (v1)."""
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+
+    def impl(xv, off, w, b, m):
+        n, cin, h, wd = xv.shape
+        cout, cin_g, kh, kw = w.shape
+        ho = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        wo = (wd + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        gd = deformable_groups
+        # base sampling positions per output loc per tap
+        ys = jnp.arange(ho) * sh - ph
+        xs = jnp.arange(wo) * sw - pw
+        ky = jnp.arange(kh) * dh
+        kx = jnp.arange(kw) * dw
+        base_y = ys[:, None, None, None] + ky[None, None, :, None]
+        base_x = xs[None, :, None, None] + kx[None, None, None, :]
+        base_y = jnp.broadcast_to(base_y, (ho, wo, kh, kw))
+        base_x = jnp.broadcast_to(base_x, (ho, wo, kh, kw))
+        off = off.reshape(n, gd, kh * kw, 2, ho, wo)
+        off_y = off[:, :, :, 0].transpose(0, 1, 3, 4, 2).reshape(
+            n, gd, ho, wo, kh, kw)
+        off_x = off[:, :, :, 1].transpose(0, 1, 3, 4, 2).reshape(
+            n, gd, ho, wo, kh, kw)
+        py = base_y[None, None] + off_y          # [N,gd,Ho,Wo,kh,kw]
+        px = base_x[None, None] + off_x
+
+        def bilinear(img, yy, xx):
+            """img [C,H,W]; yy/xx [...]: zero outside."""
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy = yy - y0
+            wx = xx - x0
+            out = 0.0
+            for ddy, ddx in ((0, 0), (0, 1), (1, 0), (1, 1)):
+                iy = (y0 + ddy).astype(jnp.int32)
+                ix = (x0 + ddx).astype(jnp.int32)
+                valid = ((iy >= 0) & (iy < h) & (ix >= 0) & (ix < wd))
+                iyc = jnp.clip(iy, 0, h - 1)
+                ixc = jnp.clip(ix, 0, wd - 1)
+                v = img[:, iyc, ixc]             # [C, ...]
+                wgt = ((wy if ddy else 1 - wy) *
+                       (wx if ddx else 1 - wx)) * valid
+                out = out + v * wgt[None]
+            return out
+
+        cpg = cin // gd                           # channels per deform group
+
+        def one_sample(img, yy, xx):
+            # img [Cin,H,W]; yy/xx [gd,Ho,Wo,kh,kw]
+            groups_out = []
+            for g in range(gd):
+                sub = img[g * cpg:(g + 1) * cpg]
+                groups_out.append(bilinear(sub, yy[g], xx[g]))
+            return jnp.concatenate(groups_out, 0)  # [Cin,Ho,Wo,kh,kw]
+
+        sampled = jax.vmap(one_sample)(xv, py, px)  # [N,Cin,Ho,Wo,kh,kw]
+        if m is not None:
+            mm = m.reshape(n, gd, kh, kw, ho, wo).transpose(
+                0, 1, 4, 5, 2, 3)
+            mm = jnp.repeat(mm, cpg, axis=1)
+            sampled = sampled * mm
+        # contract (Cin_g, kh, kw) per output channel group
+        sampled = sampled.reshape(n, groups, cin // groups, ho, wo, kh, kw)
+        wg = w.reshape(groups, cout // groups, cin_g, kh, kw)
+        out = jnp.einsum("ngchwyx,gocyx->ngohw", sampled, wg)
+        out = out.reshape(n, cout, ho, wo)
+        if b is not None:
+            out = out + b[None, :, None, None]
+        return out.astype(xv.dtype)
+
+    return run_op("deform_conv2d", impl, (x, offset, weight, bias, mask),
+                  {})
+
+
+class DeformConv2D(Layer):
+    """Layer wrapper (reference vision/ops.py DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size,) * 2 if isinstance(kernel_size, int) else \
+            tuple(kernel_size)
+        from .. import create_parameter
+        self.weight = create_parameter(
+            [out_channels, in_channels // groups, *ks], "float32")
+        self.bias = None if bias_attr is False else create_parameter(
+            [out_channels], "float32", is_bias=True)
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._cfg)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference vision/ops.py
+    distribute_fpn_proposals; FPN paper eq.1).  Eager (data-dependent
+    output sizes), like the reference's CPU path."""
+    rois = np.asarray(getattr(fpn_rois, "_value", fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(ws * hs, 0.0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs = [], []
+    for level in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == level)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+    order = np.concatenate(idxs) if idxs else np.zeros((0,), np.int64)
+    restore = np.argsort(order)
+    rois_num_per = None
+    if rois_num is not None:
+        rois_num_per = [Tensor(jnp.asarray(np.asarray([len(i)])))
+                        for i in idxs]
+    return outs, Tensor(jnp.asarray(restore.reshape(-1, 1))), rois_num_per
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference vision/ops.py matrix_nms; SOLOv2 paper):
+    decay scores by pairwise IoU instead of hard suppression."""
+    bb = np.asarray(getattr(bboxes, "_value", bboxes))
+    sc = np.asarray(getattr(scores, "_value", scores))
+    out_boxes, out_idx, out_num = [], [], []
+    B, C, M = sc.shape
+    for b in range(B):
+        cand = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            keep = np.nonzero(sc[b, c] > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[b, c, keep])][:nms_top_k]
+            boxes = bb[b, order]
+            s = sc[b, c, order].copy()
+            # pairwise IoU (upper triangle)
+            x1 = np.maximum(boxes[:, None, 0], boxes[None, :, 0])
+            y1 = np.maximum(boxes[:, None, 1], boxes[None, :, 1])
+            x2 = np.minimum(boxes[:, None, 2], boxes[None, :, 2])
+            y2 = np.minimum(boxes[:, None, 3], boxes[None, :, 3])
+            add = 0.0 if normalized else 1.0
+            inter = np.clip(x2 - x1 + add, 0, None) * \
+                np.clip(y2 - y1 + add, 0, None)
+            area = (boxes[:, 2] - boxes[:, 0] + add) * \
+                (boxes[:, 3] - boxes[:, 1] + add)
+            iou = inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                     1e-10)
+            iou = np.triu(iou, 1)
+            comp = iou.max(axis=0)              # max IoU with higher-scored
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - comp[None, :] ** 2)
+                               / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - comp[None, :],
+                                                1e-10)).min(axis=0)
+            s = s * decay
+            ok = s > post_threshold
+            for i in np.nonzero(ok)[0]:
+                cand.append((float(s[i]), c, boxes[i], order[i]))
+        cand.sort(key=lambda t: -t[0])
+        cand = cand[:keep_top_k]
+        for scv, c, box, oi in cand:
+            out_boxes.append([c, scv, *box.tolist()])
+            out_idx.append(b * M + oi)
+        out_num.append(len(cand))
+    boxes_t = Tensor(jnp.asarray(np.asarray(out_boxes, np.float32)
+                                 .reshape(-1, 6)))
+    rets = [boxes_t]
+    if return_rois_num:
+        rets.append(Tensor(jnp.asarray(np.asarray(out_num, np.int32))))
+    if return_index:
+        rets.append(Tensor(jnp.asarray(np.asarray(out_idx, np.int64)
+                                       .reshape(-1, 1))))
+    return tuple(rets) if len(rets) > 1 else boxes_t
+
+
+def read_file(filename, name=None):
+    """Read file bytes as a uint8 tensor (reference vision/ops.py
+    read_file)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor (reference decode_jpeg → nvjpeg; PIL
+    here)."""
+    import io
+    from PIL import Image
+    data = bytes(np.asarray(getattr(x, "_value", x)).astype(np.uint8))
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._args[0], self._args[1])
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num, sampling_ratio=-1, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._args[0], self._args[1],
+                         sampling_ratio, aligned)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._args[0],
+                          self._args[1])

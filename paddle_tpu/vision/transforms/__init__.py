@@ -3,10 +3,11 @@ from .transforms import (  # noqa: F401
     BaseTransform, BrightnessTransform, CenterCrop, ColorJitter, Compose,
     ContrastTransform, Grayscale, HueTransform, Normalize, Pad, RandomCrop,
     RandomErasing, RandomHorizontalFlip, RandomResizedCrop, RandomRotation,
-    RandomVerticalFlip, Resize, SaturationTransform, ToTensor, Transpose,
+    RandomAffine, RandomPerspective, RandomVerticalFlip, Resize,
+    SaturationTransform, ToTensor, Transpose,
 )
 from .functional import (  # noqa: F401
     adjust_brightness, adjust_contrast, adjust_hue, adjust_saturation,
-    center_crop, crop, erase, hflip, normalize, pad, resize, rotate,
-    to_grayscale, to_tensor, vflip,
+    affine, center_crop, crop, erase, hflip, normalize, pad, perspective,
+    resize, rotate, to_grayscale, to_tensor, vflip,
 )

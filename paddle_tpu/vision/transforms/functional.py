@@ -250,3 +250,111 @@ def _restore(arr, ref):
     if ref.dtype == np.uint8:
         return np.clip(arr * 255.0, 0, 255).astype(np.uint8)
     return arr.astype(ref.dtype)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    """Inverse affine matrix mapping OUTPUT coords to INPUT coords
+    (torchvision/paddle convention: parameters describe the forward
+    transform about `center`)."""
+    import math as _m
+    rot = _m.radians(angle)
+    sx, sy = (_m.radians(s) for s in shear)
+    cx, cy = center
+    tx, ty = translate
+    # forward: T(center) R S Sh T(-center) + translate; build inverse
+    a = _m.cos(rot - sy) / _m.cos(sy)
+    b = -_m.cos(rot - sy) * _m.tan(sx) / _m.cos(sy) - _m.sin(rot)
+    c = _m.sin(rot - sy) / _m.cos(sy)
+    d = -_m.sin(rot - sy) * _m.tan(sx) / _m.cos(sy) + _m.cos(rot)
+    m = np.array([[a * scale, b * scale, 0.0],
+                  [c * scale, d * scale, 0.0]], np.float64)
+    m[0, 2] = cx + tx - (m[0, 0] * cx + m[0, 1] * cy)
+    m[1, 2] = cy + ty - (m[1, 0] * cx + m[1, 1] * cy)
+    # invert the 2x3 affine
+    det = m[0, 0] * m[1, 1] - m[0, 1] * m[1, 0]
+    inv = np.array([[m[1, 1], -m[0, 1], 0.0],
+                    [-m[1, 0], m[0, 0], 0.0]], np.float64) / det
+    inv[0, 2] = -(inv[0, 0] * m[0, 2] + inv[0, 1] * m[1, 2])
+    inv[1, 2] = -(inv[1, 0] * m[0, 2] + inv[1, 1] * m[1, 2])
+    return inv
+
+
+def _sample_hw(img, map_fn, interpolation="nearest", fill=0):
+    """Warp an HWC numpy image by sampling input at map_fn(out coords)."""
+    arr = np.asarray(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    sx, sy = map_fn(xs.astype(np.float64), ys.astype(np.float64))
+    if interpolation == "nearest":
+        ix = np.round(sx).astype(np.int64)
+        iy = np.round(sy).astype(np.int64)
+        valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        out = np.full_like(arr, fill)
+        out[valid] = arr[iy[valid], ix[valid]]
+    else:                                   # bilinear
+        x0 = np.floor(sx); y0 = np.floor(sy)
+        out = np.zeros(arr.shape, np.float64)
+        wsum = np.zeros((h, w, 1), np.float64)
+        for dy in (0, 1):
+            for dx in (0, 1):
+                ix = (x0 + dx).astype(np.int64)
+                iy = (y0 + dy).astype(np.int64)
+                wgt = (1 - np.abs(sx - ix)) * (1 - np.abs(sy - iy))
+                valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+                wv = np.where(valid, wgt, 0.0)[:, :, None]
+                ixc = np.clip(ix, 0, w - 1); iyc = np.clip(iy, 0, h - 1)
+                out += arr[iyc, ixc] * wv
+                wsum += wv
+        out = np.where(wsum > 0, out / np.maximum(wsum, 1e-12), fill)
+        out = out.astype(arr.dtype)
+    return out[:, :, 0] if squeeze else out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine warp (reference vision/transforms/functional.py affine)."""
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    if isinstance(shear, (int, float)):
+        shear = (float(shear), 0.0)
+    inv = _affine_matrix(angle, translate, scale, shear, center)
+
+    def map_fn(xs, ys):
+        sx = inv[0, 0] * xs + inv[0, 1] * ys + inv[0, 2]
+        sy = inv[1, 0] * xs + inv[1, 1] * ys + inv[1, 2]
+        return sx, sy
+
+    return _sample_hw(img, map_fn, interpolation, fill)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Solve the 8 perspective coefficients mapping endpoints→startpoints
+    (the inverse warp, torchvision convention)."""
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b.extend([sx, sy])
+    coeffs = np.linalg.solve(np.asarray(a, np.float64),
+                             np.asarray(b, np.float64))
+    return coeffs
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective warp (reference functional.py perspective)."""
+    c = _perspective_coeffs(startpoints, endpoints)
+
+    def map_fn(xs, ys):
+        den = c[6] * xs + c[7] * ys + 1.0
+        sx = (c[0] * xs + c[1] * ys + c[2]) / den
+        sy = (c[3] * xs + c[4] * ys + c[5]) / den
+        return sx, sy
+
+    return _sample_hw(img, map_fn, interpolation, fill)

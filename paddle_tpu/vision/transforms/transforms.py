@@ -17,6 +17,7 @@ __all__ = [
     "RandomVerticalFlip", "RandomRotation", "ColorJitter", "Grayscale",
     "Pad", "RandomErasing", "Transpose", "BrightnessTransform",
     "ContrastTransform", "SaturationTransform", "HueTransform",
+    "RandomAffine", "RandomPerspective",
 ]
 
 
@@ -285,3 +286,70 @@ class Transpose(BaseTransform):
         if img.ndim == 2:
             img = img[:, :, None]
         return np.transpose(img, self.order)
+
+
+class RandomAffine(BaseTransform):
+    """Random affine transform (reference transforms.py RandomAffine)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        import numpy as _np
+        h, w = _np.asarray(img).shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale) if self.scale else 1.0
+        if self.shear is None:
+            sh = (0.0, 0.0)
+        elif isinstance(self.shear, numbers.Number):
+            sh = (random.uniform(-self.shear, self.shear), 0.0)
+        elif len(self.shear) == 2:
+            sh = (random.uniform(self.shear[0], self.shear[1]), 0.0)
+        else:
+            sh = (random.uniform(self.shear[0], self.shear[1]),
+                  random.uniform(self.shear[2], self.shear[3]))
+        return F.affine(img, angle, (tx, ty), sc, sh, self.interpolation,
+                        self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """Random perspective (reference transforms.py RandomPerspective)."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        import numpy as _np
+        if random.random() >= self.prob:
+            return img
+        h, w = _np.asarray(img).shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = h // 2, w // 2
+        tl = (random.randint(0, int(d * half_w)),
+              random.randint(0, int(d * half_h)))
+        tr = (w - 1 - random.randint(0, int(d * half_w)),
+              random.randint(0, int(d * half_h)))
+        br = (w - 1 - random.randint(0, int(d * half_w)),
+              h - 1 - random.randint(0, int(d * half_h)))
+        bl = (random.randint(0, int(d * half_w)),
+              h - 1 - random.randint(0, int(d * half_h)))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return F.perspective(img, start, [tl, tr, br, bl],
+                             self.interpolation, self.fill)

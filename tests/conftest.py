@@ -20,3 +20,17 @@ if "--xla_force_host_platform_device_count" not in os.environ.get(
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache: the slow lane is dominated by
+# whole-model compiles on one CPU core; caching executables across test
+# processes/runs makes warm reruns minutes instead of ~an hour.  Keyed by
+# computation fingerprint, so code changes invalidate naturally.
+_cache_dir = os.environ.get("PT_TEST_COMPILE_CACHE",
+                            "/tmp/paddle_tpu_xla_cache")
+try:
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+except Exception:
+    pass

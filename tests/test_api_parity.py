@@ -21,6 +21,7 @@ def ref_all(relpath):
 
 
 @pytest.mark.parametrize("relpath,modname", [
+    ("__init__.py", "paddle_tpu"),
     ("nn/__init__.py", "paddle_tpu.nn"),
     ("nn/functional/__init__.py", "paddle_tpu.nn.functional"),
     ("optimizer/__init__.py", "paddle_tpu.optimizer"),
@@ -39,6 +40,17 @@ def ref_all(relpath):
     ("metric/__init__.py", "paddle_tpu.metric"),
     ("incubate/nn/functional/__init__.py",
      "paddle_tpu.incubate.nn.functional"),
+    ("incubate/__init__.py", "paddle_tpu.incubate"),
+    ("distributed/fleet/__init__.py", "paddle_tpu.parallel.fleet"),
+    ("vision/transforms/__init__.py", "paddle_tpu.vision.transforms"),
+    ("vision/datasets/__init__.py", "paddle_tpu.vision.datasets"),
+    ("vision/ops.py", "paddle_tpu.vision.ops"),
+    ("profiler/__init__.py", "paddle_tpu.profiler"),
+    ("audio/__init__.py", "paddle_tpu.audio"),
+    ("geometric/__init__.py", "paddle_tpu.geometric"),
+    ("quantization/__init__.py", "paddle_tpu.quantization"),
+    ("autograd/__init__.py", "paddle_tpu.autograd"),
+    ("nn/initializer/__init__.py", "paddle_tpu.nn.initializer"),
 ])
 def test_namespace_parity_100pct(relpath, modname):
     import importlib
