@@ -164,6 +164,12 @@ def gloo_release() -> None:
     return None
 
 
+def _spawn_entry(func, args, env):
+    import os as _os
+    _os.environ.update(env)
+    func(*args)
+
+
 def spawn(func: Callable, args=(), nprocs: int = -1, join=True,
           daemon=False, **options):
     """Reference dist.spawn — launch ``func`` in per-rank processes.
@@ -173,7 +179,15 @@ def spawn(func: Callable, args=(), nprocs: int = -1, join=True,
     ctx = mp.get_context("spawn")
     procs = []
     for rank in range(n):
-        p = ctx.Process(target=func, args=args, daemon=daemon)
+        # per-process rank identity (reference spawn wires trainer env
+        # before calling func — distributed/spawn.py _func_wrapper)
+        env = {"PADDLE_TRAINER_ID": str(rank),
+               "PADDLE_TRAINERS_NUM": str(n),
+               "PADDLE_RANK_IN_NODE": str(rank),
+               "PADDLE_LOCAL_RANK": str(rank),
+               "PADDLE_WORLD_SIZE": str(n)}
+        p = ctx.Process(target=_spawn_entry, args=(func, args, env),
+                        daemon=daemon)
         p.start()
         procs.append(p)
     if join:

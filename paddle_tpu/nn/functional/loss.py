@@ -450,7 +450,9 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
         sim = a @ p.T
         lp = jax.nn.log_softmax(sim, axis=1)
         ce = jnp.mean(jnp.sum(-tgt * lp, axis=1))
-        reg = jnp.mean(jnp.sum(a * a, 1) + jnp.sum(p * p, 1)) * (l2_reg * 0.5)
+        # reference Beta = 0.25: l2loss = (mean_a + mean_p) * 0.25 * l2_reg
+        reg = (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) \
+            * (l2_reg * 0.25)
         return ce + reg
 
     return run_op("npair_loss", impl, (anchor, positive, labels), {})

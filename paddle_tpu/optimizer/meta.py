@@ -72,7 +72,9 @@ class LarsMomentum(Optimizer):
         return new_params, new_state
 
     def _lars_update(self, p, g, s, lr, wd):
-        p32 = p.astype(jnp.float32)
+        # multi_precision: compute from / update the fp32 master weight
+        p32 = s["master_weight"] if "master_weight" in s \
+            else p.astype(jnp.float32)
         g32 = g.astype(jnp.float32)
         w_norm = jnp.linalg.norm(p32)
         g_norm = jnp.linalg.norm(g32)
@@ -82,7 +84,11 @@ class LarsMomentum(Optimizer):
             1.0)
         local_lr = lr * trust
         v = self._momentum * s["velocity"] + local_lr * (g32 + wd * p32)
-        return (p32 - v).astype(p.dtype), {"velocity": v}
+        new_p32 = p32 - v
+        out_s = {"velocity": v}
+        if "master_weight" in s:
+            out_s["master_weight"] = new_p32
+        return new_p32.astype(p.dtype), out_s
 
     def _update(self, p, g, s, lr, t):          # functional-API fallback
         return self._lars_update(p, g, s, lr, self._lars_wd)

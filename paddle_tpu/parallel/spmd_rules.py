@@ -87,8 +87,17 @@ def matmul_rule(x: TensorDistAttr, y: TensorDistAttr,
     axis makes the output PARTIAL on that mesh axis (the caller's reshard
     of the output inserts the all-reduce — reference partial semantics).
     """
+    # vector operands: pad to rank 2 the way MatmulInferSpmd does —
+    # x gains an m dim in front, y gains an n dim at the back
     xm = list(x.dims_mapping)
     ym = list(y.dims_mapping)
+    x_vec, y_vec = len(xm) == 1, len(ym) == 1
+    if x_vec:
+        xm = [None] + xm
+        trans_x = False  # reference resets trans flags for 1-D operands
+    if y_vec:
+        ym = ym + [None]
+        trans_y = False
     if trans_x:
         xm[-1], xm[-2] = xm[-2], xm[-1]
     if trans_y:
@@ -118,7 +127,15 @@ def matmul_rule(x: TensorDistAttr, y: TensorDistAttr,
     if trans_y:
         y_req.dims_mapping[-1], y_req.dims_mapping[-2] = \
             y_req.dims_mapping[-2], y_req.dims_mapping[-1]
-    out = TensorDistAttr(batch + [m, n],
+    out_map = batch + [m, n]
+    # strip the vector-padding dims back off (MatmulInferSpmd squeeze)
+    if x_vec:
+        x_req = TensorDistAttr(x_req.dims_mapping[-1:])
+        out_map = [d for i, d in enumerate(out_map) if i != len(out_map) - 2]
+    if y_vec:
+        y_req = TensorDistAttr(y_req.dims_mapping[:-1])
+        out_map = out_map[:-1]
+    out = TensorDistAttr(out_map,
                          partial={k} if k is not None else set())
     return x_req, y_req, out
 

@@ -165,11 +165,16 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
                name=None):
     from ...nn import functional as F
     from ...ops import api
-    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
-    w = _mk_param(norm_shape) if scale else None
-    b = _mk_param(norm_shape, is_bias=True) if shift else None
-    out = F.layer_norm(input, input.shape[begin_norm_axis:], weight=w,
-                       bias=b, epsilon=epsilon)
+    from ... import create_parameter
+    from ...nn import initializer as I
+    norm_shape = tuple(int(d) for d in input.shape[begin_norm_axis:])
+    # reference defaults: scale init Constant(1.0), bias Constant(0.0)
+    w = create_parameter(list(norm_shape), "float32",
+                         default_initializer=I.Constant(1.0)) \
+        if scale else None
+    b = create_parameter(list(norm_shape), "float32", is_bias=True) \
+        if shift else None
+    out = F.layer_norm(input, norm_shape, weight=w, bias=b, epsilon=epsilon)
     if act:
         out = getattr(api, act)(out)
     return out

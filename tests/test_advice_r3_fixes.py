@@ -1,0 +1,105 @@
+"""Regressions for the round-3 advisor findings: npair_loss Beta=0.25,
+static.nn.layer_norm multi-dim normalized shape, LarsMomentum
+multi_precision master weights, matmul SPMD rule with rank-1 operands,
+dist.spawn per-rank env."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nn import functional as F
+
+
+def _np(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+def test_npair_loss_beta_quarter():
+    # reference loss.py:401-415: l2loss = (mean_a + mean_p) * 0.25 * l2_reg
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((4, 8)).astype(np.float32)
+    p = rng.standard_normal((4, 8)).astype(np.float32)
+    lab = np.array([0, 1, 0, 1], np.int64)
+    got = float(F.npair_loss(pt.Tensor(a), pt.Tensor(p), pt.Tensor(lab),
+                             l2_reg=0.5))
+    # numpy reference
+    same = (lab[:, None] == lab[None, :]).astype(np.float32)
+    tgt = same / same.sum(1, keepdims=True)
+    sim = a @ p.T
+    lp = sim - np.log(np.exp(sim).sum(1, keepdims=True))
+    ce = np.mean((-tgt * lp).sum(1))
+    l2 = (np.mean((a * a).sum(1)) + np.mean((p * p).sum(1))) * 0.25 * 0.5
+    assert got == pytest.approx(ce + l2, rel=1e-4)
+
+
+def test_static_layer_norm_multidim_axis():
+    from paddle_tpu import static
+    pt.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 3, 4, 5], "float32")
+            out = static.nn.layer_norm(x, begin_norm_axis=1)
+        exe = static.Executor()
+        r = exe.run(prog,
+                    feed={"x": np.random.default_rng(1).standard_normal(
+                        (2, 3, 4, 5)).astype(np.float32)},
+                    fetch_list=[out])
+        assert r[0].shape == (2, 3, 4, 5)
+        # per-sample normalization over all trailing dims
+        flat = r[0].reshape(2, -1)
+        np.testing.assert_allclose(flat.mean(1), 0.0, atol=1e-4)
+    finally:
+        pt.disable_static()
+
+
+def test_lars_momentum_multi_precision_master_weight():
+    from paddle_tpu.optimizer import LarsMomentum
+    w = pt.Tensor(np.full((4,), 1.0, np.float16))
+    w.stop_gradient = False
+    opt = LarsMomentum(learning_rate=0.1, lars_coeff=0.01,
+                       parameters=[w], multi_precision=True)
+    for _ in range(3):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    name = next(iter(opt._state))
+    s = opt._state[name]
+    assert "master_weight" in s, "fp32 master must survive the update"
+    # master tracks the fp16 param at fp32 precision
+    np.testing.assert_allclose(_np(s["master_weight"]),
+                               _np(w).astype(np.float32), atol=1e-2)
+
+
+def test_matmul_rule_rank1_operands():
+    from paddle_tpu.parallel.spmd_rules import matmul_rule, TensorDistAttr
+    # vec @ mat: contracted axis sharded -> partial output, rank-1 out map
+    xr, yr, out = matmul_rule(TensorDistAttr(["mp"]),
+                              TensorDistAttr(["mp", None]))
+    assert xr.dims_mapping == ["mp"]
+    assert len(out.dims_mapping) == 1 and out.partial == {"mp"}
+    # mat @ vec
+    xr, yr, out = matmul_rule(TensorDistAttr([None, "mp"]),
+                              TensorDistAttr(["mp"]))
+    assert yr.dims_mapping == ["mp"]
+    assert len(out.dims_mapping) == 1 and out.partial == {"mp"}
+    # vec @ vec -> scalar (rank-0) mapping
+    xr, yr, out = matmul_rule(TensorDistAttr(["mp"]),
+                              TensorDistAttr(["mp"]))
+    assert out.dims_mapping == [] and out.partial == {"mp"}
+
+
+def _spawn_probe(path):
+    import os
+    with open(os.path.join(path,
+                           f"rank{os.environ['PADDLE_TRAINER_ID']}"),
+              "w") as f:
+        f.write(os.environ["PADDLE_TRAINERS_NUM"])
+
+
+def test_spawn_sets_rank_env(tmp_path):
+    import paddle_tpu.distributed as dist
+    dist.spawn(_spawn_probe, args=(str(tmp_path),), nprocs=2)
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["rank0", "rank1"]
+    assert (tmp_path / "rank0").read_text() == "2"

@@ -1,10 +1,23 @@
-"""TPU chip-acquisition probe (VERDICT r2 item 1).
+"""TPU chip-acquisition probe (VERDICT r2 item 1; auto-seize r4 item 1a).
 
 Runs ``jax.devices()`` in a subprocess under a wall-clock timeout and
 appends a timestamped JSON line to ``tools/tpu_probe.log``. Run this
 repeatedly through the round; the log is the evidence trail either way.
+
+On the FIRST successful probe (``--seize``, the default when run as a
+script), it immediately runs the full hardware evidence suite with zero
+human latency:
+  1. ``bench.py``                    -> tools/bench_tpu.json
+  2. ``bench_sweep.py``              -> tools/bench_sweep_tpu.json
+  3. ``pytest tests -m tpu``         -> tools/pytest_tpu.log
+and appends a results section to BASELINE.md.  A sentinel file
+(tools/tpu_seized.json) prevents double-runs.
 """
 import json, os, subprocess, sys, time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SENTINEL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tpu_seized.json")
 
 LOG = os.path.join(os.path.dirname(__file__), "tpu_probe.log")
 SNIPPET = (
@@ -38,6 +51,13 @@ def probe(timeout=240):
             else (out.stderr.strip().splitlines() or ["?"])[-1]
     except subprocess.TimeoutExpired:
         ok, detail = False, f"timeout after {timeout}s (jax.devices() blocked)"
+    if ok:
+        # rc==0 is not enough: a soft CPU fallback must not count as the
+        # chip being back (it would fire seize() and fabricate evidence)
+        try:
+            ok = json.loads(detail).get("platform") in ("tpu", "axon")
+        except Exception:
+            ok = False
     rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
            "ok": ok, "elapsed_s": round(time.time() - t0, 1),
            "detail": detail, "relay_tcp": _relay_tcp_up()}
@@ -46,5 +66,74 @@ def probe(timeout=240):
     print(json.dumps(rec))
     return ok
 
+def seize():
+    """Run the full hardware-evidence suite once the chip is reachable.
+    Idempotent via the sentinel file; every artifact lands in tools/ and
+    BASELINE.md so the round's evidence exists even if the tunnel wedges
+    again minutes later."""
+    if os.path.exists(SENTINEL):
+        return
+    tdir = os.path.dirname(os.path.abspath(__file__))
+    results = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+               "status": "in_progress"}
+    # claim the sentinel BEFORE the multi-hour suite: overlapping probe
+    # invocations must not start a second concurrent seize on the chip
+    with open(SENTINEL, "w") as f:
+        json.dump(results, f)
+
+    def _run(cmd, out_file, timeout):
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=timeout, cwd=REPO)
+            # keep .json artifacts pure JSON; stderr goes to a .log sibling
+            with open(os.path.join(tdir, out_file), "w") as f:
+                f.write(r.stdout)
+            if r.stderr:
+                with open(os.path.join(tdir, out_file + ".stderr.log"),
+                          "w") as f:
+                    f.write(r.stderr)
+            return {"rc": r.returncode,
+                    "tail": r.stdout.strip().splitlines()[-1:]}
+        except subprocess.TimeoutExpired:
+            return {"rc": -1, "tail": [f"timeout {timeout}s"]}
+        except Exception as e:
+            return {"rc": -2, "tail": [str(e)]}
+
+    results["bench"] = _run([sys.executable, "bench.py"],
+                            "bench_tpu.json", 1800)
+    results["bench_sweep"] = _run([sys.executable, "bench_sweep.py"],
+                                  "bench_sweep_tpu.json", 3600)
+    results["pytest_tpu"] = _run(
+        [sys.executable, "-m", "pytest", "tests", "-m", "tpu", "-q",
+         "--timeout", "1200"], "pytest_tpu.log", 2400)
+    results["status"] = "done"
+    with open(SENTINEL, "w") as f:
+        json.dump(results, f, indent=1)
+    with open(os.path.join(REPO, "BASELINE.md"), "a") as f:
+        f.write("\n## TPU seize results (auto-appended by tools/tpu_probe.py"
+                f" at {results['ts']})\n\n```json\n"
+                + json.dumps(results, indent=1) + "\n```\n")
+    try:
+        # commit ONLY the artifacts this function produced — never the
+        # whole working tree (edits may be in progress)
+        artifacts = ["BASELINE.md", "tools/tpu_seized.json",
+                     "tools/tpu_probe.log"]
+        artifacts += [os.path.join("tools", f) for f in os.listdir(tdir)
+                      if f.startswith(("bench_tpu", "bench_sweep_tpu",
+                                       "pytest_tpu"))]
+        subprocess.run(["git", "add", "--"] + artifacts, cwd=REPO,
+                       timeout=60)
+        subprocess.run(["git", "commit", "-m",
+                        "TPU seized: hardware bench + sweep + pallas-hw "
+                        "test evidence", "--"] + artifacts,
+                       cwd=REPO, timeout=60)
+    except Exception:
+        pass
+    print(json.dumps({"seized": True, **results}))
+
+
 if __name__ == "__main__":
-    probe(int(sys.argv[1]) if len(sys.argv) > 1 else 240)
+    argv = [a for a in sys.argv[1:] if a != "--no-seize"]
+    ok = probe(int(argv[0]) if argv else 240)
+    if ok and "--no-seize" not in sys.argv:
+        seize()
