@@ -71,6 +71,153 @@ def peak_flops_per_chip(device) -> float:
     return 1e12  # CPU fallback: nominal
 
 
+def _layer_train_bench(net, x, y, steps: int, items_per_step: float,
+                       unit: str, metric: str, devices):
+    """Measure a jitted functional AdamW train step over an eager Layer
+    (the Model.fit compute path, jit-compiled once)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nn import functional_call_with_buffers, state_arrays
+    from paddle_tpu.nn import functional as F
+    import paddle_tpu as pt
+
+    # differentiate ONLY trainable params; buffers (BN running stats)
+    # thread through the aux output, never through Adam
+    params = state_arrays(net, trainable_only=True)
+    buffers = {k: v for k, v in state_arrays(net).items()
+               if k not in params}
+
+    @jax.jit
+    def step(params, buffers, moments, xv, yv):
+        def loss_fn(p):
+            logits, new_buf = functional_call_with_buffers(
+                net, {**buffers, **p}, pt.Tensor(xv))
+            loss = F.cross_entropy(logits, pt.Tensor(yv))
+            return getattr(loss, "_value", loss).astype(jnp.float32), \
+                new_buf
+
+        (loss, new_buf), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        m, v, t = moments
+        t = t + 1
+        new_m, new_v, new_p = {}, {}, {}
+        for k, g in grads.items():
+            g32 = g.astype(jnp.float32)
+            new_m[k] = 0.9 * m[k] + 0.1 * g32
+            new_v[k] = 0.999 * v[k] + 0.001 * g32 * g32
+            upd = 1e-3 * (new_m[k] / (1 - 0.9 ** t)) / (
+                jnp.sqrt(new_v[k] / (1 - 0.999 ** t)) + 1e-8)
+            new_p[k] = (params[k].astype(jnp.float32) - upd).astype(
+                params[k].dtype)
+        new_buffers = {k: new_buf.get(k, val)
+                       for k, val in buffers.items()}
+        return new_p, new_buffers, (new_m, new_v, t), loss
+
+    moments = ({k: jnp.zeros(v.shape, jnp.float32)
+                for k, v in params.items()},
+               {k: jnp.zeros(v.shape, jnp.float32)
+                for k, v in params.items()},
+               jnp.zeros((), jnp.int32))
+    params, buffers, moments, loss = step(params, buffers, moments,
+                                          x, y)   # compile
+
+    jax.device_get(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, buffers, moments, loss = step(params, buffers, moments,
+                                              x, y)
+    loss_val = float(np.asarray(jax.device_get(loss)))
+    dt = time.perf_counter() - t0
+    rate = items_per_step * steps / dt
+    return {
+        "metric": metric, "value": round(rate, 1), "unit": unit,
+        "vs_baseline": 0.0,   # no reference-published number (BASELINE.md)
+        "extra": {"steps": steps, "loss": loss_val,
+                  "device": str(devices[0])},
+    }
+
+
+def run_config_bench(config: str):
+    """BASELINE configs 1/2/3/5 (VERDICT r3 item 5): every BASELINE.md row
+    gets a measured number — full shapes on the accelerator, scaled-down
+    liveness shapes on the CPU fallback."""
+    import jax
+
+    devices, err_note = _acquire_devices()
+    on_accel = devices[0].platform.lower() in ("tpu", "axon")
+    rng = np.random.default_rng(0)
+
+    if config == "lenet":
+        from paddle_tpu.models.lenet import LeNet
+        net = LeNet()
+        b = 256 if on_accel else 32
+        x = rng.standard_normal((b, 1, 28, 28)).astype(np.float32)
+        y = rng.integers(0, 10, (b,)).astype(np.int32)
+        out = _layer_train_bench(net, x, y, 10 if on_accel else 3, b,
+                                 "samples/s/chip",
+                                 "lenet_train_samples_per_sec", devices)
+    elif config == "resnet50":
+        from paddle_tpu.vision import models
+        if on_accel:
+            net, b, hw = models.resnet50(), 64, 224
+        else:
+            net, b, hw = models.resnet18(), 4, 32   # CPU liveness shapes
+        net.train()
+        x = rng.standard_normal((b, 3, hw, hw)).astype(np.float32)
+        y = rng.integers(0, 1000, (b,)).astype(np.int32)
+        out = _layer_train_bench(net, x, y, 5 if on_accel else 2, b,
+                                 "samples/s/chip",
+                                 "resnet50_train_samples_per_sec", devices)
+        if not on_accel:
+            out["extra"]["model"] = "resnet18@32px CPU-liveness proxy"
+    elif config == "bert":
+        from paddle_tpu.models.bert import (BertForSequenceClassification,
+                                            bert_base, bert_tiny)
+        cfg = bert_base() if on_accel else bert_tiny()
+        net = BertForSequenceClassification(cfg, num_classes=2)
+        b, s = (32, 128) if on_accel else (2, 32)
+        x = rng.integers(0, cfg.vocab_size, (b, s)).astype(np.int32)
+        y = rng.integers(0, 2, (b,)).astype(np.int32)
+        out = _layer_train_bench(net, x, y, 5 if on_accel else 2, b * s,
+                                 "tokens/s/chip",
+                                 "bert_finetune_tokens_per_sec", devices)
+        if not on_accel:
+            out["extra"]["model"] = "bert_tiny CPU-liveness proxy"
+    elif config == "llama":
+        from paddle_tpu.models.llama import (build_llama_train_step,
+                                             llama_7b, llama_tiny)
+        from paddle_tpu import parallel as dist
+        cfg = llama_7b(dtype="bfloat16") if on_accel else llama_tiny()
+        b, s, steps = (4, 2048, 5) if on_accel else (2, 128, 2)
+        topo = dist.init_topology(devices=devices[:1])
+        step_fn, init_fn = build_llama_train_step(
+            cfg, topo, num_microbatches=1, remat=True, sharding_stage=2)
+        state = init_fn(0)
+        ids = rng.integers(0, cfg.vocab_size, (b, s)).astype(np.int32)
+        labels = np.roll(ids, -1, axis=1)
+        state, loss = step_fn(state, ids, labels)
+        jax.device_get(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = step_fn(state, ids, labels)
+        loss_val = float(np.asarray(jax.device_get(loss)))
+        dt = time.perf_counter() - t0
+        out = {
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": round(b * s * steps / dt, 1),
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "extra": {"steps": steps, "loss": loss_val,
+                      "device": str(devices[0]),
+                      "model": "llama_7b" if on_accel
+                               else "llama_tiny CPU-liveness proxy"},
+        }
+    else:
+        raise SystemExit(f"unknown --config {config!r}")
+    if err_note:
+        out["extra"]["error"] = err_note
+    return out
+
+
 def run_bench():
     import jax
 
@@ -147,8 +294,9 @@ def run_bench():
 
 
 def _child_main() -> None:
+    cfg = os.environ.get("BENCH_CONFIG", "")
     try:
-        out = run_bench()
+        out = run_config_bench(cfg) if cfg else run_bench()
     except Exception as e:
         out = {
             "metric": "gpt_train_tokens_per_sec_per_chip",
@@ -232,6 +380,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    # --config lenet|resnet50|bert|llama selects a BASELINE row benchmark;
+    # no flag = the flagship GPT metric (driver contract: ONE JSON line).
+    if "--config" in sys.argv:
+        os.environ["BENCH_CONFIG"] = sys.argv[sys.argv.index(
+            "--config") + 1]
     if os.environ.get("_BENCH_CHILD") == "1":
         _child_main()
     else:

@@ -116,11 +116,14 @@ def _exec_cached(exec_key: Tuple, call: Callable) -> Callable:
 
 
 def _check_nan_inf(name: str, leaves: List[Any]) -> None:
-    for v in leaves:
+    for i, v in enumerate(leaves):
         if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
-            bad = bool(jnp.any(~jnp.isfinite(v)))
-            if bad:
-                msg = f"NaN/Inf detected in output of op {name!r}"
+            n_bad = int(jnp.sum(~jnp.isfinite(v)))
+            if n_bad:
+                from .enforce import summarize_leaf
+                msg = (f"NaN/Inf detected in output [{i}] of op {name!r}: "
+                       f"{n_bad} non-finite element(s) in "
+                       f"{summarize_leaf(v)}")
                 if FLAGS.check_nan_inf_level == 0:
                     raise FloatingPointError(msg)
                 import warnings
@@ -196,7 +199,11 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict,
 
     # ---- traced (functional) path: let it fuse into the outer XLA program
     if any_tracer:
-        out = call(dyn_values)
+        try:
+            out = call(dyn_values)
+        except BaseException as e:
+            from .enforce import op_error_context
+            raise op_error_context(name, dyn_values, "traced", e) from e
         return _wrap_out(out, None)
 
     # ---- eager path
@@ -208,10 +215,14 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict,
     except TypeError:
         exec_key = None
 
-    if exec_key is not None and FLAGS.eager_op_jit and jit:
-        out = _exec_cached(exec_key, call)(dyn_values)
-    else:
-        out = call(dyn_values)
+    try:
+        if exec_key is not None and FLAGS.eager_op_jit and jit:
+            out = _exec_cached(exec_key, call)(dyn_values)
+        else:
+            out = call(dyn_values)
+    except BaseException as e:
+        from .enforce import op_error_context
+        raise op_error_context(name, dyn_values, "eager", e) from e
 
     node = None
     if differentiable and needs_grad and is_grad_enabled():

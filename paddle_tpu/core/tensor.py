@@ -115,6 +115,18 @@ class Tensor:
     def __int__(self):
         return int(np.asarray(self._value))
 
+    def __index__(self):
+        # lets range(t)/list[t] work eagerly; under trace the jax tracer
+        # raises TracerIntegerConversionError (dy2static fallback catches)
+        if isinstance(self._value, jax.core.Tracer):
+            return self._value.__index__()
+        v = np.asarray(self._value)
+        if not (np.issubdtype(v.dtype, np.integer)
+                or v.dtype == np.bool_):
+            raise TypeError(
+                f"'{v.dtype}' tensor cannot be interpreted as an integer")
+        return int(v)
+
     def __bool__(self):
         return bool(np.asarray(self._value))
 
@@ -291,12 +303,27 @@ def _unwrap_index(idx):
 # pytree registration: Tensors flow through jax transforms
 # ---------------------------------------------------------------------------
 def _tensor_flatten(t: Tensor):
-    return (t._value,), (t.stop_gradient, t.name)
+    # aux must NOT carry identity data (e.g. the auto name): treedef
+    # equality gates lax.cond/while_loop branch matching, and two Tensors
+    # computed on different branches must flatten identically
+    return (t._value,), (t.stop_gradient,)
 
 
 def _tensor_unflatten(aux, children):
-    sg, name = aux
-    return Tensor(children[0], stop_gradient=sg, name=name)
+    # well-behaved pytree: jax unflattens with sentinel/placeholder
+    # children (error rendering, transposes) — no asarray validation here
+    t = object.__new__(Tensor)
+    t._value = children[0]
+    t.stop_gradient = aux[0]
+    t.grad = None
+    t.name = f"tensor_{next(_name_counter)}"
+    t.persistable = False
+    t.trainable = True
+    t._node = None
+    t._out_index = 0
+    t._retain_grads = False
+    t._grad_hooks = []
+    return t
 
 
 jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)

@@ -50,14 +50,22 @@ class StaticFunction:
                  backend=None, donate_argnums=(), static_argnums=()):
         self._fn = fn
         self._input_spec = input_spec
+        self._full_graph = full_graph
+        self._fell_back = False
         functools.update_wrapper(self, fn)
+
+        # dy2static: rewrite tensor-dependent if/while/for into
+        # lax.cond/while_loop/fori_loop via runtime-dispatched helpers
+        from .dy2static import convert_control_flow
+        conv_fn = convert_control_flow(fn)
+        self._conv_fn = conv_fn
 
         def traced(*args, **kwargs):
             _trace_state.depth += 1
             try:
                 targs = jax.tree.map(_wrap, args)
                 tkwargs = jax.tree.map(_wrap, kwargs)
-                out = fn(*targs, **tkwargs)
+                out = conv_fn(*targs, **tkwargs)
                 return jax.tree.map(_unwrap, out,
                                     is_leaf=lambda x: isinstance(x, Tensor))
             finally:
@@ -73,7 +81,30 @@ class StaticFunction:
                              is_leaf=lambda x: isinstance(x, Tensor))
         vkwargs = jax.tree.map(_unwrap, kwargs,
                                is_leaf=lambda x: isinstance(x, Tensor))
-        out = self._jitted(*vargs, **vkwargs)
+        from .dy2static import ConversionFallback
+        try:
+            out = self._jitted(*vargs, **vkwargs)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.ConcretizationTypeError,
+                ConversionFallback) as e:
+            # SOT graph-break semantics (reference jit/sot/translate.py:30):
+            # a construct the AST pass left unconverted concretized a
+            # tracer.  With full_graph=True that's an error; otherwise run
+            # the whole call eagerly — correct, just uncompiled.
+            if self._full_graph:
+                raise
+            if not self._fell_back:
+                self._fell_back = True
+                import warnings
+                warnings.warn(
+                    f"to_static: {getattr(self._fn, '__qualname__', '?')} "
+                    f"uses untraceable control flow ({type(e).__name__}); "
+                    "falling back to eager execution (graph break). Pass "
+                    "full_graph=True to make this an error.",
+                    stacklevel=2)
+            return self._fn(*args, **kwargs)
         return jax.tree.map(_wrap, out)
 
     def __get__(self, instance, owner):
@@ -93,8 +124,13 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
-    """``@paddle.jit.to_static`` parity (reference: jit/api.py:195)."""
+              backend=None, full_graph=False, **kwargs):
+    """``@paddle.jit.to_static`` parity (reference: jit/api.py:195).
+
+    Tensor-dependent ``if``/``while``/``for`` are AST-converted to
+    ``lax.cond``/``while_loop``/``fori_loop`` (jit/dy2static.py); anything
+    unconvertible triggers a graph-break eager fallback unless
+    ``full_graph=True`` (reference SOT vs AST route split)."""
 
     def deco(fn):
         if isinstance(fn, StaticFunction):

@@ -47,6 +47,10 @@ def _conv_impl(x, weight, bias, stride, padding, dilation, groups,
     in_spatial = [x.shape[i] for i in range(1, n + 1)] if channel_last else \
         [x.shape[i] for i in range(2, n + 2)]
     pad = _pad_spec(padding, n, stride, in_spatial, weight.shape[2:], dilation)
+    # NOTE: no preferred_element_type here — the TPU MXU accumulates bf16
+    # convs in fp32 natively, and jax's conv transpose rule emits a
+    # mixed-dtype conv (bf16 activations x fp32 cotangent) when the flag
+    # is set, breaking grad-of-conv under AMP.
     out = jax.lax.conv_general_dilated(
         x, weight,
         window_strides=_tupleize(stride, n),
@@ -54,7 +58,6 @@ def _conv_impl(x, weight, bias, stride, padding, dilation, groups,
         rhs_dilation=_tupleize(dilation, n),
         feature_group_count=groups,
         dimension_numbers=dn,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
     )
     if out.dtype != x.dtype:
         out = out.astype(x.dtype)

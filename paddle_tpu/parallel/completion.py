@@ -22,10 +22,17 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .spmd_rules import (TensorDistAttr, elementwise_rule, embedding_rule,
-                         flash_attention_rule, layer_norm_rule, matmul_rule,
-                         reduction_rule, reshape_rule, softmax_rule,
-                         transpose_rule)
+from .spmd_rules import (TensorDistAttr, add_n_rule, argmax_rule,
+                         concat_rule, cumsum_rule, elementwise_rule,
+                         embedding_rule, expand_rule, flash_attention_rule,
+                         flatten_rule, full_like_rule, fused_rope_rule,
+                         gather_nd_rule, gather_rule, layer_norm_rule,
+                         matmul_rule, numel_rule, one_hot_rule,
+                         reduction_rule, reshape_rule, rms_norm_rule,
+                         scale_rule, scatter_rule, slice_rule, softmax_rule,
+                         split_rule, squared_l2_norm_rule, squeeze_rule,
+                         stack_rule, swiglu_rule, tile_rule, transpose_rule,
+                         triu_rule, unbind_rule, unsqueeze_rule, where_rule)
 
 __all__ = ["CompletionPlan", "Reshard", "complete_program",
            "estimate_reshard_cost", "estimate_plan_cost", "ICI_BW_GBPS"]
@@ -51,6 +58,13 @@ class Reshard:
 class CompletionPlan:
     attrs: Dict[str, TensorDistAttr] = field(default_factory=dict)
     reshards: List[Reshard] = field(default_factory=list)
+    # op name -> SPMD rule that fired ("replicate_fallback" = no rule and
+    # no rank to merge: the silent perf cliff VERDICT r3 item 3 tracks)
+    node_rules: List[Tuple[str, str]] = field(default_factory=list)
+
+    def fallback_nodes(self) -> List[str]:
+        return [n for n, r in self.node_rules
+                if r == "replicate_fallback"]
 
     def total_comm_bytes(self) -> int:
         return sum(r.comm_bytes for r in self.reshards)
@@ -153,8 +167,48 @@ def _find_static_perm(node, nd: int) -> Optional[Sequence[int]]:
     return None
 
 
+def _static_axis(node, default: int = 0) -> int:
+    """First int-like static arg (the ``axis`` of concat/stack/split…)."""
+    for s in getattr(node, "statics", ()):
+        ax = _int_like(s)
+        if ax is not None and len(ax) == 1:
+            return ax[0]
+    return default
+
+
+def _static_axes(node) -> Optional[List[int]]:
+    for s in getattr(node, "statics", ()):
+        ax = _int_like(s)
+        if ax is not None:
+            return ax
+    return None
+
+
+def _static_ints_flat(node) -> List[int]:
+    """ALL int-like static leaves in order (flatten's (start, stop) are
+    two separate scalars, unlike slice's single axes list)."""
+    out: List[int] = []
+    for s in getattr(node, "statics", ()):
+        ax = _int_like(s)
+        if ax is not None:
+            out.extend(ax)
+    return out
+
+
+def _split_axis(node) -> int:
+    """split/chunk record (num_or_sections, axis): the axis is the LAST
+    single-int static when two int-like statics exist; a lone static is
+    the section count (axis defaults to 0)."""
+    ints = [_int_like(s) for s in getattr(node, "statics", ())]
+    ints = [i for i in ints if i is not None]
+    if len(ints) >= 2 and len(ints[-1]) == 1:
+        return ints[-1][0]
+    return 0
+
+
 def _infer_node(name: str, in_attrs: List[TensorDistAttr], node):
-    """Dispatch an op to its SPMD rule; returns (required_in, out_attrs).
+    """Dispatch an op to its SPMD rule; returns (required_in, out_attrs,
+    rule_name).
 
     Unknown ops fall back to the elementwise merge when ranks match, else
     replicate — the reference completion's default strategy."""
@@ -162,7 +216,7 @@ def _infer_node(name: str, in_attrs: List[TensorDistAttr], node):
     outs = node.out_vars
     if base == "matmul" and len(in_attrs) >= 2:
         xr, yr, o = matmul_rule(in_attrs[0], in_attrs[1])
-        return [xr, yr] + in_attrs[2:], [o] * len(outs)
+        return [xr, yr] + in_attrs[2:], [o] * len(outs), "matmul"
     if base == "linear" and len(in_attrs) >= 2:
         # linear(x, w[, b]) = matmul + bias broadcast; bias follows the
         # weight's n-dim sharding (reference fused_gemm_epilogue rule)
@@ -171,18 +225,22 @@ def _infer_node(name: str, in_attrs: List[TensorDistAttr], node):
         if len(in_attrs) > 2:
             reqs.append(TensorDistAttr([yr.dims_mapping[-1]]))
             reqs.extend(in_attrs[3:])
-        return reqs, [o] * len(outs)
+        return reqs, [o] * len(outs), "matmul"
     if base == "softmax":
         req, o = softmax_rule(in_attrs[0])
-        return [req] + in_attrs[1:], [o] * len(outs)
+        return [req] + in_attrs[1:], [o] * len(outs), "softmax"
     if base == "layer_norm":
         req, o = layer_norm_rule(in_attrs[0])
         return [req] + [a.replicate() for a in in_attrs[1:]], \
-            [o] * len(outs)
+            [o] * len(outs), "layer_norm"
+    if base == "rms_norm" and in_attrs:
+        req, o = rms_norm_rule(in_attrs[0])
+        return [req] + [a.replicate() for a in in_attrs[1:]], \
+            [o] * len(outs), "rms_norm"
     if base == "embedding" and len(in_attrs) >= 2:
         # our embedding op takes (ids, table)
         tr, ir, o = embedding_rule(in_attrs[1], in_attrs[0])
-        return [ir, tr] + in_attrs[2:], [o] * len(outs)
+        return [ir, tr] + in_attrs[2:], [o] * len(outs), "embedding"
     if base in _REDUCTIONS and in_attrs:
         ndim_in = len(in_attrs[0].dims_mapping)
         ndim_out = len(outs[0].shape)
@@ -190,12 +248,12 @@ def _infer_node(name: str, in_attrs: List[TensorDistAttr], node):
         keepdim = ndim_out == ndim_in and ndim_in > 0 and axes != []
         req, o = reduction_rule(in_attrs[0], axes or
                                 list(range(ndim_in)), keepdim=keepdim)
-        return [req] + in_attrs[1:], [o] * len(outs)
+        return [req] + in_attrs[1:], [o] * len(outs), "reduction"
     if base == "transpose" and in_attrs:
         nd = len(in_attrs[0].dims_mapping)
         perm = _find_static_perm(node, nd) or tuple(range(nd))[::-1]
         req, o = transpose_rule(in_attrs[0], perm)
-        return [req] + in_attrs[1:], [o] * len(outs)
+        return [req] + in_attrs[1:], [o] * len(outs), "transpose"
     if base == "reshape" and in_attrs:
         src_shape = [1 if d in (None, -1) else int(d)
                      for d in node.in_vars[0].shape] \
@@ -204,11 +262,135 @@ def _infer_node(name: str, in_attrs: List[TensorDistAttr], node):
                      for d in outs[0].shape]
         if src_shape is not None:
             req, o = reshape_rule(in_attrs[0], src_shape, dst_shape)
-            return [req] + in_attrs[1:], [o] * len(outs)
+            return [req] + in_attrs[1:], [o] * len(outs), "reshape"
     if base in ("flash_attention", "scaled_dot_product_attention") \
             and len(in_attrs) >= 3:
         q, k, v, o = flash_attention_rule(*in_attrs[:3])
-        return [q, k, v] + in_attrs[3:], [o] * len(outs)
+        return [q, k, v] + in_attrs[3:], [o] * len(outs), "flash_attention"
+    # ---- round-4 rule tail ------------------------------------------------
+    if base == "concat" and in_attrs:
+        nd = in_attrs[0].ndim
+        same = [a for a in in_attrs if a.ndim == nd]
+        if len(same) == len(in_attrs):
+            reqs, o = concat_rule(in_attrs, _static_axis(node))
+            return reqs, [o] * len(outs), "concat"
+    if base in ("split", "chunk") and in_attrs:
+        req, outs_a = split_rule(in_attrs[0], _split_axis(node),
+                                 len(outs))
+        return [req] + in_attrs[1:], outs_a, "split"
+    if base == "stack" and in_attrs:
+        nd = in_attrs[0].ndim
+        if all(a.ndim == nd for a in in_attrs):
+            reqs, o = stack_rule(in_attrs, _static_axis(node))
+            return reqs, [o] * len(outs), "stack"
+    if base == "unbind" and in_attrs:
+        req, outs_a = unbind_rule(in_attrs[0], _static_axis(node),
+                                  len(outs))
+        return [req] + in_attrs[1:], outs_a, "unbind"
+    if base in ("slice", "strided_slice") and in_attrs:
+        axes = _static_axes(node) or list(range(in_attrs[0].ndim))
+        axes = [a for a in axes if -in_attrs[0].ndim <= a
+                < in_attrs[0].ndim]
+        req, o = slice_rule(in_attrs[0], axes)
+        return [req] + in_attrs[1:], [o] * len(outs), "slice"
+    if base == "squeeze" and in_attrs and outs:
+        nd_in, nd_out = in_attrs[0].ndim, len(outs[0].shape)
+        axes = _static_axes(node)
+        if axes is None and hasattr(node.in_vars[0], "shape"):
+            axes = [i for i, d in enumerate(node.in_vars[0].shape)
+                    if d == 1][: nd_in - nd_out]
+        if axes and nd_in - len(axes) == nd_out:
+            req, o = squeeze_rule(in_attrs[0], axes)
+            return [req] + in_attrs[1:], [o] * len(outs), "squeeze"
+    if base == "unsqueeze" and in_attrs and outs:
+        axes = _static_axes(node)
+        if axes and in_attrs[0].ndim + len(axes) == len(outs[0].shape):
+            req, o = unsqueeze_rule(in_attrs[0], axes)
+            return [req] + in_attrs[1:], [o] * len(outs), "unsqueeze"
+    if base == "flatten" and in_attrs and outs:
+        axes = _static_ints_flat(node) or [1, -1]
+        if len(axes) >= 2:
+            req, o = flatten_rule(in_attrs[0], axes[0], axes[1])
+            if o.ndim == len(outs[0].shape):
+                return [req] + in_attrs[1:], [o] * len(outs), "flatten"
+    if base in ("gather", "take_along_axis", "index_select") \
+            and len(in_attrs) >= 2:
+        xr, ir, o = gather_rule(in_attrs[0], in_attrs[1],
+                                _static_axis(node))
+        return [xr, ir] + in_attrs[2:], [o] * len(outs), "gather"
+    if base == "gather_nd" and len(in_attrs) >= 2:
+        xr, ir, o = gather_nd_rule(in_attrs[0], in_attrs[1])
+        return [xr, ir] + in_attrs[2:], [o] * len(outs), "gather_nd"
+    if base in ("scatter", "put_along_axis") and len(in_attrs) >= 3:
+        xr, ir, ur, o = scatter_rule(in_attrs[0], in_attrs[1],
+                                     in_attrs[2])
+        return [xr, ir, ur] + in_attrs[3:], [o] * len(outs), "scatter"
+    if base in ("cumsum", "cumprod", "cummax", "cummin") and in_attrs:
+        req, o = cumsum_rule(in_attrs[0], _static_axis(node))
+        return [req] + in_attrs[1:], [o] * len(outs), "cumsum"
+    if base in ("argmax", "argmin") and in_attrs and outs:
+        nd_in, nd_out = in_attrs[0].ndim, len(outs[0].shape)
+        req, o = argmax_rule(in_attrs[0], _static_axis(node),
+                             keepdim=nd_in == nd_out)
+        if o.ndim == nd_out:
+            return [req] + in_attrs[1:], [o] * len(outs), "argmax"
+    if base == "one_hot" and in_attrs:
+        req, o = one_hot_rule(in_attrs[0])
+        return [req] + in_attrs[1:], [o] * len(outs), "one_hot"
+    if base == "tile" and in_attrs and outs:
+        reps = _static_axes(node)
+        if reps:
+            req, o = tile_rule(in_attrs[0], reps)
+            if o.ndim == len(outs[0].shape):
+                return [req] + in_attrs[1:], [o] * len(outs), "tile"
+    if base in ("expand", "broadcast_to", "expand_as") and in_attrs \
+            and outs and hasattr(node.in_vars[0], "shape"):
+        src = [1 if d in (None, -1) else int(d)
+               for d in node.in_vars[0].shape]
+        dst = [1 if d in (None, -1) else int(d) for d in outs[0].shape]
+        req, o = expand_rule(in_attrs[0], src, dst)
+        return [req] + in_attrs[1:], [o] * len(outs), "expand"
+    if base in ("triu", "tril") and in_attrs and in_attrs[0].ndim >= 2:
+        req, o = triu_rule(in_attrs[0])
+        return [req] + in_attrs[1:], [o] * len(outs), "triu"
+    if base in ("fused_rope", "fused_rotary_position_embedding") \
+            and in_attrs:
+        reqs, os_ = [], []
+        for a in in_attrs:
+            r, o = fused_rope_rule(a)
+            reqs.append(r)
+            os_.append(o)
+        return reqs, os_[:len(outs)] + [os_[0]] * max(
+            0, len(outs) - len(os_)), "fused_rope"
+    if base == "swiglu" and in_attrs:
+        reqs, o = swiglu_rule(*in_attrs[:2])
+        return list(reqs) + in_attrs[2:], [o] * len(outs), "swiglu"
+    if base == "squared_l2_norm" and in_attrs:
+        req, o = squared_l2_norm_rule(in_attrs[0])
+        return [req] + in_attrs[1:], [o] * len(outs), "squared_l2_norm"
+    if base == "add_n" and in_attrs:
+        nd = in_attrs[0].ndim
+        if all(a.ndim == nd for a in in_attrs):
+            reqs, o = add_n_rule(in_attrs)
+            return reqs, [o] * len(outs), "add_n"
+    if base in ("scale", "cast") and in_attrs:
+        req, o = scale_rule(in_attrs[0])
+        return [req] + in_attrs[1:], [o] * len(outs), "scale"
+    if base == "increment" and in_attrs:
+        # x+1 does NOT commute with a pending cross-shard sum: require
+        # the partial resolved (p_to_r reshard) before the op
+        req = TensorDistAttr(list(in_attrs[0].dims_mapping), set())
+        return [req] + in_attrs[1:], \
+            [TensorDistAttr(list(req.dims_mapping))] * len(outs), "scale"
+    if base == "numel" and in_attrs:
+        req, o = numel_rule(in_attrs[0])
+        return [req] + in_attrs[1:], [o] * len(outs), "numel"
+    if base in ("full_like", "zeros_like", "ones_like") and in_attrs:
+        req, o = full_like_rule(in_attrs[0])
+        return [req] + in_attrs[1:], [o] * len(outs), "full_like"
+    if base == "where" and len(in_attrs) >= 3:
+        reqs, o = where_rule(in_attrs[0], in_attrs[1], in_attrs[2])
+        return list(reqs) + in_attrs[3:], [o] * len(outs), "where"
 
     # default: broadcast-aware elementwise over rank-matching inputs
     ranked = [a for a in in_attrs if a.ndim > 0]
@@ -219,9 +401,11 @@ def _infer_node(name: str, in_attrs: List[TensorDistAttr], node):
             nd = len(ov.shape)
             out_attrs.append(TensorDistAttr(o.dims_mapping[-nd:] if nd
                                             else [], o.partial))
-        return reqs, out_attrs
+        rule = "elementwise" if base in _ELEMENTWISE \
+            else "elementwise_default"
+        return reqs, out_attrs, rule
     return in_attrs, [TensorDistAttr([None] * len(ov.shape))
-                      for ov in outs]
+                      for ov in outs], "replicate_fallback"
 
 
 def complete_program(program, input_attrs: Dict[str, TensorDistAttr],
@@ -267,7 +451,8 @@ def complete_program(program, input_attrs: Dict[str, TensorDistAttr],
                 in_attrs.append(env.get(
                     id(v), TensorDistAttr([None] * len(v.shape))))
                 holders.append(v)
-        req_attrs, out_attrs = _infer_node(node.name, in_attrs, node)
+        req_attrs, out_attrs, rule = _infer_node(node.name, in_attrs, node)
+        plan.node_rules.append((node.name, rule))
         for v, have, want in zip(holders, in_attrs, req_attrs):
             if v is None or want is None:
                 continue

@@ -304,3 +304,312 @@ def layer_norm_rule(x: TensorDistAttr, begin_norm_axis: int = -1
     req = TensorDistAttr([ax if i < bn else None
                           for i, ax in enumerate(x.dims_mapping)])
     return req, TensorDistAttr(list(req.dims_mapping))
+
+
+# ---------------------------------------------------------------------------
+# round-4 rule tail: reference breadth (phi/infermeta/spmd_rules/, 46
+# files).  Same contract as above: (required_input_attrs..., out_attr).
+# ---------------------------------------------------------------------------
+
+def concat_rule(attrs: Sequence[TensorDistAttr], axis: int
+                ) -> Tuple[List[TensorDistAttr], TensorDistAttr]:
+    """Concat axis replicated on every input; other dims merged
+    (reference concat.cc builds the einsum notation EXCLUDING the concat
+    axis, i.e. it cannot stay sharded — shards would be interleaved)."""
+    nd = attrs[0].ndim
+    ax = axis % nd
+    merged = [None] * nd
+    for i in range(nd):
+        if i == ax:
+            continue
+        m = attrs[0].dims_mapping[i]
+        for a in attrs[1:]:
+            m = _merge_dim(m, a.dims_mapping[i])
+        merged[i] = m
+    reqs = [TensorDistAttr([merged[i] if i != ax else None
+                            for i in range(nd)]) for _ in attrs]
+    return reqs, TensorDistAttr([merged[i] if i != ax else None
+                                 for i in range(nd)])
+
+
+def split_rule(x: TensorDistAttr, axis: int, num_out: int
+               ) -> Tuple[TensorDistAttr, List[TensorDistAttr]]:
+    """Split axis replicated (reference split.cc); outputs inherit."""
+    ax = axis % x.ndim
+    req = x.with_dim(ax, None)
+    req.partial = set()
+    return req, [TensorDistAttr(list(req.dims_mapping))
+                 for _ in range(num_out)]
+
+
+def stack_rule(attrs: Sequence[TensorDistAttr], axis: int
+               ) -> Tuple[List[TensorDistAttr], TensorDistAttr]:
+    """Merge input mappings; the NEW stacked dim is replicated
+    (reference stack.cc)."""
+    nd = attrs[0].ndim
+    merged = [attrs[0].dims_mapping[i] for i in range(nd)]
+    for a in attrs[1:]:
+        merged = [_merge_dim(m, d) for m, d in zip(merged, a.dims_mapping)]
+    reqs = [TensorDistAttr(list(merged)) for _ in attrs]
+    ax = axis % (nd + 1)
+    out = merged[:ax] + [None] + merged[ax:]
+    return reqs, TensorDistAttr(out)
+
+
+def unbind_rule(x: TensorDistAttr, axis: int, num_out: int
+                ) -> Tuple[TensorDistAttr, List[TensorDistAttr]]:
+    """Unbind axis replicated; outputs drop it (reference unbind.cc)."""
+    ax = axis % x.ndim
+    req = x.with_dim(ax, None)
+    req.partial = set()
+    out_dm = [d for i, d in enumerate(req.dims_mapping) if i != ax]
+    return req, [TensorDistAttr(list(out_dm)) for _ in range(num_out)]
+
+
+def slice_rule(x: TensorDistAttr, axes: Sequence[int]
+               ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """Sliced axes must be replicated (reference slice.cc) — a slice range
+    spans shard boundaries; untouched dims propagate."""
+    req = TensorDistAttr(list(x.dims_mapping), set())
+    for a in axes:
+        req.dims_mapping[a % x.ndim] = None
+    return req, TensorDistAttr(list(req.dims_mapping))
+
+
+def squeeze_rule(x: TensorDistAttr, axes: Sequence[int]
+                 ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """Dropped size-1 dims can't be sharded; the rest map through
+    (reference squeeze.cc)."""
+    drop = {a % x.ndim for a in axes}
+    req = TensorDistAttr([None if i in drop else d
+                          for i, d in enumerate(x.dims_mapping)],
+                         set(x.partial))
+    out = [d for i, d in enumerate(req.dims_mapping) if i not in drop]
+    return req, TensorDistAttr(out, set(x.partial))
+
+
+def unsqueeze_rule(x: TensorDistAttr, axes: Sequence[int]
+                   ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """New size-1 dims are replicated (reference unsqueeze.cc)."""
+    nd_out = x.ndim + len(axes)
+    ins = sorted(a % nd_out for a in axes)
+    out: List[Optional[str]] = []
+    src = iter(x.dims_mapping)
+    for i in range(nd_out):
+        out.append(None if i in ins else next(src))
+    return TensorDistAttr(list(x.dims_mapping), set(x.partial)), \
+        TensorDistAttr(out, set(x.partial))
+
+
+def flatten_rule(x: TensorDistAttr, start: int, stop: int
+                 ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """Merge [start, stop] into one dim: only the MAJOR (first) merged
+    dim's sharding survives (reference flatten.cc == reshape merge)."""
+    s, e = start % x.ndim, stop % x.ndim
+    req = TensorDistAttr(list(x.dims_mapping), set(x.partial))
+    for i in range(s + 1, e + 1):
+        req.dims_mapping[i] = None
+    out = (req.dims_mapping[:s] + [req.dims_mapping[s]]
+           + req.dims_mapping[e + 1:])
+    return req, TensorDistAttr(out, set(x.partial))
+
+
+def gather_rule(x: TensorDistAttr, index: TensorDistAttr, axis: int
+                ) -> Tuple[TensorDistAttr, TensorDistAttr, TensorDistAttr]:
+    """x's gather axis replicated (arbitrary global indices); index
+    shardings replace it in the output (reference gather.cc)."""
+    ax = axis % x.ndim
+    x_req = x.with_dim(ax, None)
+    x_req.partial = set()
+    idx_req = TensorDistAttr(list(index.dims_mapping))
+    out = (list(x_req.dims_mapping[:ax]) + list(idx_req.dims_mapping)
+           + list(x_req.dims_mapping[ax + 1:]))
+    return x_req, idx_req, TensorDistAttr(out)
+
+
+def scatter_rule(x: TensorDistAttr, index: TensorDistAttr,
+                 updates: TensorDistAttr
+                 ) -> Tuple[TensorDistAttr, TensorDistAttr, TensorDistAttr,
+                            TensorDistAttr]:
+    """Scatter writes along dim 0: dim 0 of x/updates and index must be
+    replicated (reference scatter.cc); trailing dims merge."""
+    tail = [_merge_dim(a, b) for a, b in zip(x.dims_mapping[1:],
+                                             updates.dims_mapping[1:])]
+    x_req = TensorDistAttr([None] + tail)
+    upd_req = TensorDistAttr([None] + tail)
+    idx_req = TensorDistAttr([None] * index.ndim)
+    return x_req, idx_req, upd_req, TensorDistAttr([None] + tail)
+
+
+def gather_nd_rule(x: TensorDistAttr, index: TensorDistAttr
+                   ) -> Tuple[TensorDistAttr, TensorDistAttr,
+                              TensorDistAttr]:
+    """index dims (minus the last, the coordinate depth) lead the output;
+    x dims beyond the coordinate depth trail (reference gather_nd.cc);
+    indexed x dims replicated."""
+    depth = 1  # conservative without static index shape: first x dim
+    x_req = TensorDistAttr([None] * depth
+                           + list(x.dims_mapping[depth:]), set())
+    idx_req = TensorDistAttr(list(index.dims_mapping[:-1]) + [None])
+    out = list(idx_req.dims_mapping[:-1]) + list(x_req.dims_mapping[depth:])
+    return x_req, idx_req, TensorDistAttr(out)
+
+
+def cumsum_rule(x: TensorDistAttr, axis: int
+                ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """Scan axis must be replicated (reference cumsum.cc:42)."""
+    req = x.with_dim(axis % x.ndim, None)
+    req.partial = set()
+    return req, TensorDistAttr(list(req.dims_mapping))
+
+
+def argmax_rule(x: TensorDistAttr, axis: int, keepdim: bool = False
+                ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """Arg-reduction axis replicated (cross-shard argmax needs global
+    compare — reference argmax.cc); other dims propagate."""
+    ax = axis % x.ndim
+    req = x.with_dim(ax, None)
+    req.partial = set()
+    if keepdim:
+        out = list(req.dims_mapping)
+    else:
+        out = [d for i, d in enumerate(req.dims_mapping) if i != ax]
+    return req, TensorDistAttr(out)
+
+
+def one_hot_rule(x: TensorDistAttr
+                 ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """Input dims propagate; the new class dim is replicated
+    (reference one_hot.cc)."""
+    req = TensorDistAttr(list(x.dims_mapping), set())
+    return req, TensorDistAttr(list(x.dims_mapping) + [None])
+
+
+def tile_rule(x: TensorDistAttr, repeats: Sequence[int]
+              ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """A tiled (repeat>1) dim must be replicated — its global layout
+    interleaves copies (reference tile.cc); repeat==1 dims propagate."""
+    nd_out = max(x.ndim, len(repeats))
+    reps = [1] * (nd_out - len(repeats)) + list(repeats)
+    dm = [None] * (nd_out - x.ndim) + list(x.dims_mapping)
+    req_dm = list(x.dims_mapping)
+    out = []
+    for i in range(nd_out):
+        if reps[i] == 1:
+            out.append(dm[i])
+        else:
+            out.append(None)
+            xi = i - (nd_out - x.ndim)
+            if xi >= 0:
+                req_dm[xi] = None
+    return TensorDistAttr(req_dm, set()), TensorDistAttr(out)
+
+
+def expand_rule(x: TensorDistAttr, src_shape: Sequence[int],
+                dst_shape: Sequence[int]
+                ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """Broadcast (1 -> n) dims are replicated in the output; matching
+    dims propagate (reference expand_as.cc)."""
+    nd_out = len(dst_shape)
+    pad = nd_out - x.ndim
+    out: List[Optional[str]] = [None] * nd_out
+    for i in range(x.ndim):
+        if src_shape[i] == dst_shape[pad + i]:
+            out[pad + i] = x.dims_mapping[i]
+    return TensorDistAttr(list(x.dims_mapping), set(x.partial)), \
+        TensorDistAttr(out)
+
+
+def where_rule(cond: TensorDistAttr, x: TensorDistAttr, y: TensorDistAttr
+               ) -> Tuple[List[TensorDistAttr], TensorDistAttr]:
+    """Three-way broadcast-aware elementwise merge (reference where.cc)."""
+    reqs, out = elementwise_rule(cond, x, y)
+    return reqs, out
+
+
+def triu_rule(x: TensorDistAttr
+              ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """The two matrix dims must be replicated — the mask depends on
+    GLOBAL row/col indices (reference triu.cc); batch dims propagate."""
+    req = TensorDistAttr(list(x.dims_mapping[:-2]) + [None, None],
+                         set(x.partial))
+    return req, TensorDistAttr(list(req.dims_mapping), set(x.partial))
+
+
+def rms_norm_rule(x: TensorDistAttr
+                  ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """Normalized (last) dim replicated (reference rms_norm.cc)."""
+    req = x.with_dim(x.ndim - 1, None)
+    req.partial = set()
+    return req, TensorDistAttr(list(req.dims_mapping))
+
+
+def fused_rope_rule(q: TensorDistAttr
+                    ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """[b, s, h, d]: the rotary (last) dim must be intact — the rotation
+    mixes its halves (reference fused_rope.cc); b/s/h may shard (the seq
+    dim's global offset is the context-parallel kernel's job)."""
+    req = q.with_dim(q.ndim - 1, None)
+    req.partial = set()
+    return req, TensorDistAttr(list(req.dims_mapping))
+
+
+def swiglu_rule(x: TensorDistAttr, y: Optional[TensorDistAttr] = None
+                ) -> Tuple[List[TensorDistAttr], TensorDistAttr]:
+    """Elementwise gate*up — mappings merge, any dim may shard
+    (reference swiglu.cc)."""
+    if y is None:
+        return [TensorDistAttr(list(x.dims_mapping))], \
+            TensorDistAttr(list(x.dims_mapping))
+    reqs, out = elementwise_rule(x, y)
+    return reqs, out
+
+
+def squared_l2_norm_rule(x: TensorDistAttr
+                         ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """Full reduction: output is a PARTIAL scalar on every input shard
+    axis (reference squared_l2_norm.cc) — the caller's reshard inserts
+    the cross-shard sum."""
+    shard_axes = {a for a in x.dims_mapping if a is not None}
+    return TensorDistAttr(list(x.dims_mapping)), \
+        TensorDistAttr([], partial=shard_axes)
+
+
+def add_n_rule(attrs: Sequence[TensorDistAttr]
+               ) -> Tuple[List[TensorDistAttr], TensorDistAttr]:
+    """N-way elementwise merge; partials UNION (summing partials is
+    legal — reference add_n spmd)."""
+    reqs, out = elementwise_rule(*attrs)
+    partial = set()
+    for a in attrs:
+        partial |= a.partial
+    out = TensorDistAttr(list(out.dims_mapping), partial)
+    return reqs, out
+
+
+def scale_rule(x: TensorDistAttr) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """Pure elementwise passthrough INCLUDING partial state (scaling
+    commutes with the pending sum — reference scale.cc)."""
+    keep = TensorDistAttr(list(x.dims_mapping), set(x.partial))
+    return keep, TensorDistAttr(list(x.dims_mapping), set(x.partial))
+
+
+cast_rule = scale_rule          # same passthrough semantics (cast.cc)
+pow_rule = scale_rule           # pow.cc (partial does NOT commute through
+                                # pow in general; reference keeps mapping,
+                                # clears partial — handled by caller)
+
+
+def numel_rule(x: TensorDistAttr) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """Metadata op: output is a replicated scalar regardless of input
+    sharding (reference numel.cc)."""
+    return TensorDistAttr(list(x.dims_mapping), set(x.partial)), \
+        TensorDistAttr([])
+
+
+def full_like_rule(x: TensorDistAttr
+                   ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """Output keeps the input's mapping, never its partial (the fill
+    value is dense — reference full_like.cc)."""
+    return TensorDistAttr(list(x.dims_mapping), set(x.partial)), \
+        TensorDistAttr(list(x.dims_mapping))

@@ -1,0 +1,89 @@
+"""Enforce-style error layer (VERDICT r3 item 9; reference
+common/enforce.h EnforceNotMet): dispatch failures carry op name, mode,
+and input shapes/dtypes; the NaN checker names the producing op."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.enforce import EnforceNotMet
+
+
+def ten(x):
+    return pt.to_tensor(np.asarray(x, "float32"))
+
+
+class TestEnforceNotMet:
+    def test_shape_mismatch_carries_context(self):
+        a = ten(np.zeros((2, 3)))
+        b = ten(np.zeros((4, 5)))
+        with pytest.raises(EnforceNotMet) as ei:
+            pt.matmul(a, b)
+        msg = str(ei.value)
+        assert "matmul" in msg
+        assert "eager mode" in msg
+        assert "(2, 3)" in msg and "(4, 5)" in msg
+        assert "float32" in msg
+        assert ei.value.op_name == "matmul"
+
+    def test_traced_mode_tagged(self):
+        import paddle_tpu.jit as jit
+
+        @jit.to_static(full_graph=True)
+        def f(a, b):
+            return pt.matmul(a, b)
+
+        with pytest.raises(EnforceNotMet) as ei:
+            f(ten(np.zeros((2, 3))), ten(np.zeros((4, 5))))
+        assert "traced mode" in str(ei.value)
+
+    def test_cause_chained(self):
+        with pytest.raises(EnforceNotMet) as ei:
+            pt.matmul(ten(np.zeros((2, 3))), ten(np.zeros((4, 5))))
+        assert ei.value.__cause__ is not None
+        assert ei.value.cause_type == type(ei.value.__cause__).__name__
+
+    def test_no_double_wrap(self):
+        # composite ops dispatch through nested run_op calls; the message
+        # must name ONE op, not a matryoshka of EnforceNotMet
+        with pytest.raises(EnforceNotMet) as ei:
+            pt.matmul(ten(np.zeros((2, 3))), ten(np.zeros((4, 5))))
+        assert str(ei.value).count("PreconditionNotMet") == 1
+
+
+class TestTypePreservation:
+    def test_original_exception_type_still_catchable(self):
+        # the wrapper subclasses the cause's type: existing
+        # `except TypeError` / ValueError call sites keep working
+        a = ten(np.zeros((2, 3)))
+        b = ten(np.zeros((4, 5)))
+        try:
+            pt.matmul(a, b)
+            assert False, "should have raised"
+        except EnforceNotMet as e:
+            assert isinstance(e, type(e.__cause__))
+
+
+class TestIndexContract:
+    def test_float_tensor_index_raises(self):
+        with pytest.raises(TypeError):
+            range(pt.to_tensor(np.float32(2.9)))
+
+    def test_int_tensor_index_works(self):
+        assert list(range(pt.to_tensor(np.int32(3)))) == [0, 1, 2]
+
+
+class TestNaNCheckerNamesOp:
+    def test_nan_reports_op_and_shape(self):
+        from paddle_tpu.core.flags import FLAGS
+        old = FLAGS.check_nan_inf
+        FLAGS.check_nan_inf = True
+        try:
+            with pytest.raises(FloatingPointError) as ei:
+                pt.log(ten([-1.0, 2.0]))
+            msg = str(ei.value)
+            assert "log" in msg
+            assert "non-finite" in msg
+            assert "(2,)" in msg
+        finally:
+            FLAGS.check_nan_inf = old

@@ -1,0 +1,274 @@
+"""Round-4 SPMD rule tail (VERDICT r3 item 3): the ~25 rules closing the
+gap to the reference's phi/infermeta/spmd_rules/ (46 files), plus the
+no-replicate-fallback completion criterion on GPT/Llama programs.
+
+Test style mirrors the reference's test/auto_parallel/spmd_rules suite:
+assert required-input mappings, output mapping, and partial state."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu import static
+from paddle_tpu.parallel import spmd_rules as R
+from paddle_tpu.parallel.completion import complete_program
+from paddle_tpu.parallel.spmd_rules import TensorDistAttr as DA
+
+
+class TestConcatSplitStack:
+    def test_concat_axis_replicated(self):
+        reqs, out = R.concat_rule([DA(["dp", "mp"]), DA(["dp", None])],
+                                  axis=1)
+        assert all(r.dims_mapping == ["dp", None] for r in reqs)
+        assert out.dims_mapping == ["dp", None]
+
+    def test_concat_merges_other_dims(self):
+        reqs, out = R.concat_rule([DA([None, "mp"]), DA(["dp", "mp"])],
+                                  axis=0)
+        assert out.dims_mapping == [None, "mp"]
+
+    def test_split_axis_replicated(self):
+        req, outs = R.split_rule(DA(["dp", "mp"]), axis=1, num_out=4)
+        assert req.dims_mapping == ["dp", None]
+        assert len(outs) == 4
+        assert all(o.dims_mapping == ["dp", None] for o in outs)
+
+    def test_stack_new_dim_replicated(self):
+        reqs, out = R.stack_rule([DA(["dp", None]), DA(["dp", None])],
+                                 axis=1)
+        assert out.dims_mapping == ["dp", None, None]
+
+    def test_unbind_drops_axis(self):
+        req, outs = R.unbind_rule(DA(["dp", None, "mp"]), axis=1,
+                                  num_out=3)
+        assert req.dims_mapping == ["dp", None, "mp"]
+        assert all(o.dims_mapping == ["dp", "mp"] for o in outs)
+
+
+class TestSliceSqueezeFlatten:
+    def test_slice_replicates_sliced_axes(self):
+        req, out = R.slice_rule(DA(["dp", "mp", None]), axes=[1])
+        assert req.dims_mapping == ["dp", None, None]
+        assert out.dims_mapping == ["dp", None, None]
+
+    def test_squeeze_maps_through(self):
+        req, out = R.squeeze_rule(DA(["dp", None, "mp"]), axes=[1])
+        assert out.dims_mapping == ["dp", "mp"]
+
+    def test_unsqueeze_inserts_replicated(self):
+        req, out = R.unsqueeze_rule(DA(["dp", "mp"]), axes=[1])
+        assert out.dims_mapping == ["dp", None, "mp"]
+
+    def test_flatten_keeps_major(self):
+        req, out = R.flatten_rule(DA(["dp", "mp", None]), 1, 2)
+        assert req.dims_mapping == ["dp", "mp", None]
+        assert out.dims_mapping == ["dp", "mp"]
+
+    def test_flatten_minor_sharded_replicates(self):
+        req, out = R.flatten_rule(DA(["dp", None, "mp"]), 1, 2)
+        assert req.dims_mapping == ["dp", None, None]
+        assert out.dims_mapping == ["dp", None]
+
+
+class TestGatherScatter:
+    def test_gather_axis_replicated_index_propagates(self):
+        xr, ir, out = R.gather_rule(DA(["mp", None]), DA(["dp"]), axis=0)
+        assert xr.dims_mapping == [None, None]
+        assert ir.dims_mapping == ["dp"]
+        assert out.dims_mapping == ["dp", None]
+
+    def test_scatter_dim0_replicated(self):
+        xr, ir, ur, out = R.scatter_rule(DA(["dp", "mp"]), DA([None]),
+                                         DA([None, "mp"]))
+        assert xr.dims_mapping == [None, "mp"]
+        assert out.dims_mapping == [None, "mp"]
+
+    def test_gather_nd(self):
+        xr, ir, out = R.gather_nd_rule(DA(["mp", "dp"]), DA([None, None]))
+        assert xr.dims_mapping == [None, "dp"]
+        assert out.dims_mapping == [None, "dp"]
+
+
+class TestScanArgTriu:
+    def test_cumsum_axis_replicated(self):
+        req, out = R.cumsum_rule(DA(["dp", "mp"]), axis=1)
+        assert req.dims_mapping == ["dp", None]
+        assert out.dims_mapping == ["dp", None]
+
+    def test_argmax_drops_dim(self):
+        req, out = R.argmax_rule(DA(["dp", "mp"]), axis=1)
+        assert req.dims_mapping == ["dp", None]
+        assert out.dims_mapping == ["dp"]
+
+    def test_triu_replicates_matrix_dims(self):
+        req, out = R.triu_rule(DA(["dp", "mp", None]))
+        assert req.dims_mapping == ["dp", None, None]
+
+    def test_one_hot_appends_replicated(self):
+        req, out = R.one_hot_rule(DA(["dp"]))
+        assert out.dims_mapping == ["dp", None]
+
+
+class TestBroadcasting:
+    def test_tile_repeated_dim_replicated(self):
+        req, out = R.tile_rule(DA(["dp", "mp"]), repeats=[1, 3])
+        assert req.dims_mapping == ["dp", None]
+        assert out.dims_mapping == ["dp", None]
+
+    def test_tile_rank_extension(self):
+        req, out = R.tile_rule(DA(["mp"]), repeats=[4, 1])
+        assert out.dims_mapping == [None, "mp"]
+
+    def test_expand_broadcast_dims_replicated(self):
+        req, out = R.expand_rule(DA(["dp", None]), [8, 1], [8, 16])
+        assert out.dims_mapping == ["dp", None]
+
+    def test_where_merges(self):
+        reqs, out = R.where_rule(DA(["dp", None]), DA(["dp", "mp"]),
+                                 DA([None, "mp"]))
+        assert out.dims_mapping == ["dp", "mp"]
+
+
+class TestNormsAndFused:
+    def test_rms_norm_last_dim_replicated(self):
+        req, out = R.rms_norm_rule(DA(["dp", None, "mp"]))
+        assert req.dims_mapping == ["dp", None, None]
+
+    def test_fused_rope_keeps_heads(self):
+        req, out = R.fused_rope_rule(DA(["dp", "sep", "mp", None]))
+        assert req.dims_mapping == ["dp", "sep", "mp", None]
+
+    def test_fused_rope_rotary_dim_replicated(self):
+        req, out = R.fused_rope_rule(DA(["dp", None, None, "mp"]))
+        assert req.dims_mapping == ["dp", None, None, None]
+
+    def test_swiglu(self):
+        reqs, out = R.swiglu_rule(DA(["dp", "mp"]), DA(["dp", "mp"]))
+        assert out.dims_mapping == ["dp", "mp"]
+
+    def test_squared_l2_norm_partial_output(self):
+        req, out = R.squared_l2_norm_rule(DA(["dp", "mp"]))
+        assert out.dims_mapping == []
+        assert out.partial == {"dp", "mp"}
+
+    def test_add_n_unions_partial(self):
+        reqs, out = R.add_n_rule([DA(["dp"], partial={"mp"}),
+                                  DA(["dp"], partial={"mp"})])
+        assert out.partial == {"mp"}
+
+    def test_scale_keeps_partial(self):
+        req, out = R.scale_rule(DA(["dp"], partial={"mp"}))
+        assert out.partial == {"mp"}
+
+    def test_numel_replicated_scalar(self):
+        req, out = R.numel_rule(DA(["dp", "mp"]))
+        assert out.dims_mapping == [] and not out.partial
+
+    def test_full_like_drops_partial(self):
+        req, out = R.full_like_rule(DA(["dp"], partial={"mp"}))
+        assert out.dims_mapping == ["dp"] and not out.partial
+
+
+class TestDispatchStaticArgs:
+    """Review findings: split's axis is the LAST int static (after
+    num_or_sections); flatten's (start, stop) are separate scalars."""
+
+    def _plan(self, record_fn, feeds, **kw):
+        pt.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                record_fn()
+        finally:
+            pt.disable_static()
+        return complete_program(main, feeds, **kw)
+
+    def test_split_axis_not_num_sections(self):
+        def build():
+            x = static.data("x", [4, 6, 8], "float32")
+            a, b = pt.split(x, 2, axis=1)
+            out = pt.sum(a)
+
+        plan = self._plan(build, {"x": DA(["dp", "mp", None])},
+                          mesh_shape={"dp": 4, "mp": 2})
+        # split axis 1 (mp-sharded) must be replicated in the split
+        # outputs; dim 0 keeps dp
+        split_outs = [n for n in plan.attrs if "split" in n]
+        assert split_outs, list(plan.attrs)
+        for n in split_outs:
+            assert plan.attrs[n].dims_mapping == ["dp", None, None], \
+                (n, plan.attrs[n])
+
+    def test_flatten_start_stop_scalars(self):
+        def build():
+            x = static.data("x", [4, 6, 8], "float32")
+            f = pt.flatten(x, 1, 2)
+            out = pt.sum(f)
+
+        plan = self._plan(build, {"x": DA(["dp", "mp", None])},
+                          mesh_shape={"dp": 4, "mp": 2})
+        assert ("flatten", "flatten") in [
+            (n.split("_\n")[0], r) for n, r in plan.node_rules], \
+            plan.node_rules
+
+
+class TestNoFallbackOnModels:
+    """VERDICT done-criterion: completion of a GPT/Llama-shaped program
+    hits a real rule on every op — no replicate fallbacks."""
+
+    def _complete(self, record_fn, feeds, **kw):
+        pt.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                record_fn()
+        finally:
+            pt.disable_static()
+        return complete_program(main, feeds, **kw)
+
+    def test_gpt_block_no_fallback(self):
+        def build():
+            x = static.data("x", [8, 128, 64], "float32")
+            h = 64
+            ln_w = pt.create_parameter([h], "float32")
+            qkv = nn.Linear(h, 3 * h)
+            proj = nn.Linear(h, h)
+            fc1 = nn.Linear(h, 4 * h)
+            fc2 = nn.Linear(4 * h, h)
+            y = pt.nn.functional.layer_norm(x, [h], weight=ln_w)
+            a = qkv(y)
+            q, k, v = pt.split(a, 3, axis=-1)
+            att = pt.matmul(q, k, transpose_y=True)
+            att = pt.softmax(att)
+            o = pt.matmul(att, v)
+            o = proj(o)
+            x2 = x + o
+            z = fc2(pt.nn.functional.gelu(fc1(x2)))
+            out = x2 + z
+            loss = pt.mean(out)
+
+        plan = self._complete(build, {"x": DA(["dp", None, None])},
+                              mesh_shape={"dp": 8})
+        assert plan.fallback_nodes() == [], (
+            plan.fallback_nodes(), [r for r in plan.node_rules])
+
+    def test_llama_style_ops_no_fallback(self):
+        def build():
+            x = static.data("x", [4, 64, 32], "float32")
+            ids = static.data("ids", [4, 64], "int64")
+            table = pt.create_parameter([1000, 32], "float32")
+            emb = pt.nn.functional.embedding(ids, table)
+            g = pt.concat([x, emb], axis=-1)
+            s = pt.slice(g, axes=[1], starts=[0], ends=[32])
+            t = pt.tile(s, repeat_times=[1, 2, 1])
+            u = pt.cumsum(t, axis=0)
+            w = pt.unsqueeze(u, axis=1)
+            z = pt.squeeze(w, axis=1)
+            out = pt.sum(z)
+
+        plan = self._complete(build, {"x": DA(["dp", None, None]),
+                                      "ids": DA(["dp", None])},
+                              mesh_shape={"dp": 8})
+        assert plan.fallback_nodes() == [], (
+            plan.fallback_nodes(), [r for r in plan.node_rules])

@@ -1,0 +1,384 @@
+"""Compile-time perf evidence without hardware (VERDICT r3 item 1b).
+
+For every BASELINE.md config this tool lowers + compiles the full train
+step (abstract inputs only — nothing executes), reads XLA's cost analysis
+(FLOPs, bytes accessed, per device: verified that manual-shard_map modules
+report per-device numbers — dp2 halves flops), and evaluates a TPU v5p
+roofline:
+
+    t_step  >= max(flops / PEAK_BF16, bytes / HBM_BW)
+    tput    <= work_items / t_step          (tokens or samples)
+    MFU_bound = flops / (t_step * PEAK_BF16)
+              = min(1, arithmetic_intensity / machine_balance)
+
+This is an UPPER bound on achievable throughput (perfect overlap, no
+launch/ICI/host overheads) and the first perf-engineering artifact that
+needs no chip.  Usage:
+
+    python tools/bench_proxy.py                # all configs -> BENCH_PROXY.md
+    python tools/bench_proxy.py --config NAME  # child: one JSON line
+
+Each config runs in a subprocess so XLA_FLAGS (virtual device count) and
+wedged-tunnel isolation apply per config.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# TPU v5p per-chip peaks (public spec: 459 TFLOP/s bf16, 2765 GB/s HBM)
+PEAK_BF16 = 459e12
+HBM_BW = 2765e9
+
+CONFIGS = ["lenet", "resnet50", "bert_base", "gpt_1p3b", "llama_7b",
+           "gpt_13b"]
+
+
+# ---------------------------------------------------------------------------
+# child-side: build + lower + cost-analyse one config
+# ---------------------------------------------------------------------------
+
+def _adam_layer_step(net, loss_of_logits, x_sds, extra_args=()):
+    """Functional AdamW train step over an eager Layer (bf16 params,
+    fp32 moments — AMP-O2 style), returning (lowered, work_items)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.nn import (functional_call_with_buffers, state_arrays)
+
+    # fp32 masters, AMP O1 casts at use; differentiate only trainable
+    # params — buffers (BN stats) thread through aux, never through Adam
+    params = state_arrays(net, trainable_only=True)
+    buffers = {k: v for k, v in state_arrays(net).items()
+               if k not in params}
+
+    def step(params, buffers, moments, x, *extra):
+        def loss_fn(p):
+            # the framework's own AMP path: matmuls/convs run bf16
+            # (box raw tracers as Tensors — AMP casts at the Tensor level)
+            with pt.amp.auto_cast(level="O1"):
+                logits, new_buf = functional_call_with_buffers(
+                    net, {**buffers, **p}, pt.Tensor(x))
+                loss = loss_of_logits(logits, *extra)
+            loss = getattr(loss, "_value", loss)  # unbox framework Tensor
+            return loss.astype(jnp.float32), new_buf
+
+        (loss, new_buf), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        m, v, t = moments
+        t = t + 1
+        new_m, new_v, new_p = {}, {}, {}
+        for k, g in grads.items():
+            g32 = g.astype(jnp.float32)
+            new_m[k] = 0.9 * m[k] + 0.1 * g32
+            new_v[k] = 0.999 * v[k] + 0.001 * g32 * g32
+            mh = new_m[k] / (1 - 0.9 ** t)
+            vh = new_v[k] / (1 - 0.999 ** t)
+            upd = 1e-3 * mh / (jnp.sqrt(vh) + 1e-8)
+            new_p[k] = (params[k].astype(jnp.float32) - upd).astype(
+                params[k].dtype)
+        new_buffers = {k: new_buf.get(k, v) for k, v in buffers.items()}
+        return new_p, new_buffers, (new_m, new_v, t), loss
+
+    m0 = {k: jax.ShapeDtypeStruct(v.shape, jnp.float32)
+          for k, v in params.items()}
+    params_sds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                  for k, v in params.items()}
+    buffers_sds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                   for k, v in buffers.items()}
+    moments_sds = (m0, dict(m0), jax.ShapeDtypeStruct((), jnp.int32))
+    return jax.jit(step).lower(params_sds, buffers_sds, moments_sds,
+                               x_sds, *extra_args)
+
+
+def _lm_analytic_flops(n_params: float, tokens_per_chip: float,
+                       L: int, h: int, s: int, remat: bool) -> float:
+    """Standard 6N + attention train-step FLOPs (PaLM appendix formula),
+    x4/3 under full rematerialization (one extra forward)."""
+    per_tok = 6.0 * n_params + 12.0 * L * h * s
+    f = per_tok * tokens_per_chip
+    return f * (4.0 / 3.0) if remat else f
+
+
+def build_config(name: str):
+    """Returns (lowered, work_items, work_unit, note, analytic_flops).
+    ``analytic_flops`` (hybrid LM configs only) cross-checks XLA cost
+    analysis, which counts lax.scan/while bodies ONCE — pipeline-schedule
+    steps under-report by ~the microbatch trip count without it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.nn import functional as F
+
+    if name == "lenet":
+        from paddle_tpu.models.lenet import LeNet
+        net = LeNet()
+        b = 256
+        x = jax.ShapeDtypeStruct((b, 1, 28, 28), jnp.float32)
+        y = jax.ShapeDtypeStruct((b,), jnp.int32)
+
+        def loss(logits, y):
+            return F.cross_entropy(logits, y)
+
+        return (_adam_layer_step(net, loss, x, (y,)), b, "samples",
+                "Model.fit-equivalent step, b256, bf16 fwd/bwd + fp32 Adam",
+                None)
+
+    if name == "resnet50":
+        from paddle_tpu.vision import models
+        net = models.resnet50()
+        net.train()
+        b = 128
+        x = jax.ShapeDtypeStruct((b, 3, 224, 224), jnp.float32)
+        y = jax.ShapeDtypeStruct((b,), jnp.int32)
+
+        def loss(logits, y):
+            return F.cross_entropy(logits, y)
+
+        return (_adam_layer_step(net, loss, x, (y,)), b, "samples",
+                "ImageNet shapes b128x224x224, bf16, BN buffers threaded",
+                None)
+
+    if name == "bert_base":
+        from paddle_tpu.models.bert import bert_base, \
+            BertForSequenceClassification
+        net = BertForSequenceClassification(bert_base(), num_classes=2)
+        b, s = 32, 128
+        x = jax.ShapeDtypeStruct((b, s), jnp.int32)
+        y = jax.ShapeDtypeStruct((b,), jnp.int32)
+
+        def loss(logits, y):
+            return F.cross_entropy(logits, y)
+
+        return (_adam_layer_step(net, loss, x, (y,)), b * s, "tokens",
+                "fine-tune shapes b32 x s128, bf16 encoder", None)
+
+    # hybrid builders (manual shard_map over the virtual mesh)
+    from paddle_tpu import parallel as dist
+
+    if name == "gpt_1p3b":
+        from paddle_tpu.models.gpt import gpt_1p3b, build_gpt_train_step
+        topo = dist.init_topology(dp=2, mp=2, pp=2,
+                                  devices=jax.devices()[:8])
+        cfg = gpt_1p3b(dtype="bfloat16")
+        b, s = 8, 1024
+        step, init = build_gpt_train_step(cfg, topo, num_microbatches=4,
+                                          remat=True)
+        st = jax.eval_shape(init, 0)
+        ids = jax.ShapeDtypeStruct((b, s), np.int32)
+        lo = jax.jit(step).lower(st, ids, ids)
+        h, L, V, f = (cfg.hidden_size, cfg.num_layers, cfg.vocab_size,
+                      cfg.ffn_size)
+        n_params = V * h + cfg.max_position_embeddings * h + L * (
+            4 * h * h + 2 * h * f + 9 * h) + 2 * h
+        return (lo, b * s / 8, "tokens",
+                "BASELINE config 4: mp2 x pp2 x dp2, b8 x s1024, mb4, "
+                "remat, ZeRO-2 (per-chip work items = batch tokens / 8)",
+                _lm_analytic_flops(n_params, b * s / 8, L, h, s, True))
+
+    if name == "llama_7b":
+        from paddle_tpu.models.llama import llama_7b, build_llama_train_step
+        topo = dist.init_topology(sharding=8, devices=jax.devices()[:8])
+        cfg = llama_7b(dtype="bfloat16")
+        b, s = 8, 2048
+        step, init = build_llama_train_step(cfg, topo, num_microbatches=1,
+                                            remat=True, sharding_stage=3)
+        st = jax.eval_shape(init, 0)
+        ids = jax.ShapeDtypeStruct((b, s), np.int32)
+        lo = jax.jit(step).lower(st, ids, ids)
+        h, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+        f, kv = cfg.intermediate_size, (cfg.num_kv_heads or cfg.num_heads)
+        hd = h // cfg.num_heads
+        n_params = 2 * V * h + L * (
+            2 * h * h + 2 * h * kv * hd + 3 * h * f + 2 * h) + h
+        return (lo, b * s / 8, "tokens",
+                "BASELINE config 5: sharding8 stage-3, b8 x s2048, remat "
+                "(per-chip work items = batch tokens / 8)",
+                _lm_analytic_flops(n_params, b * s / 8, L, h, s, True))
+
+    if name == "gpt_13b":
+        from paddle_tpu.models.gpt import gpt_13b, build_gpt_train_step
+        topo = dist.init_topology(mp=4, pp=2, devices=jax.devices()[:8])
+        cfg = gpt_13b(dtype="bfloat16")
+        b, s = 8, 1024
+        step, init = build_gpt_train_step(cfg, topo, num_microbatches=8,
+                                          remat=True, sharding_stage=2)
+        st = jax.eval_shape(init, 0)
+        ids = jax.ShapeDtypeStruct((b, s), np.int32)
+        lo = jax.jit(step).lower(st, ids, ids)
+        h, L, V, f = (cfg.hidden_size, cfg.num_layers, cfg.vocab_size,
+                      cfg.ffn_size)
+        n_params = V * h + cfg.max_position_embeddings * h + L * (
+            4 * h * h + 2 * h * f + 9 * h) + 2 * h
+        return (lo, b * s / 8, "tokens",
+                "north-star model at 8-chip scale: mp4 x pp2, b8 x s1024, "
+                "mb8, remat, ZeRO-2",
+                _lm_analytic_flops(n_params, b * s / 8, L, h, s, True))
+
+    raise SystemExit(f"unknown config {name!r}")
+
+
+def child(name: str) -> None:
+    t0 = time.time()
+    lo, items, unit, note, analytic = build_config(name)
+    t_lower = time.time() - t0
+    t0 = time.time()
+    ca = lo.compile().cost_analysis()
+    t_compile = time.time() - t0
+    flops = float(ca.get("flops", 0.0))
+    byts = float(ca.get("bytes accessed", 0.0))
+    out = {
+        "config": name,
+        "xla_flops_per_step_per_chip": flops,
+        "xla_bytes_per_step_per_chip": byts,
+        "compile_s": round(t_compile, 1),
+        "lower_s": round(t_lower, 1),
+        "note": note,
+    }
+    # XLA's HLO cost analysis counts lax.scan/while BODIES once; pipeline
+    # steps (scan over microbatches) under-report by ~the trip count.
+    # Cross-check against the analytic 6N formula and scale both streams
+    # by the same factor when the undercount is evident.
+    if analytic is not None:
+        out["analytic_flops_per_step_per_chip"] = analytic
+        if flops < 0.55 * analytic:
+            scale = analytic / flops
+            out["scan_undercount_corrected"] = round(scale, 2)
+            flops, byts = analytic, byts * scale
+    t_bound = max(flops / PEAK_BF16, byts / HBM_BW)
+    out.update({
+        "flops_per_step_per_chip": flops,
+        "bytes_per_step_per_chip": byts,
+        "arithmetic_intensity": round(flops / byts, 2) if byts else None,
+        "bound": "compute" if flops / PEAK_BF16 >= byts / HBM_BW
+                 else "memory",
+        "v5p_step_time_lower_bound_ms": round(t_bound * 1e3, 3),
+        "v5p_throughput_upper_bound": round(items / t_bound, 1),
+        "unit": unit + "/s/chip",
+        "v5p_mfu_upper_bound": round(flops / (t_bound * PEAK_BF16), 4),
+    })
+    print("PROXY" + json.dumps(out))
+
+
+# ---------------------------------------------------------------------------
+# parent-side: fan out, aggregate, write BENCH_PROXY.md
+# ---------------------------------------------------------------------------
+
+def main() -> None:
+    rows = []
+    for name in CONFIGS:
+        env = dict(os.environ,
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8",
+                   JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the tunnel
+        t0 = time.time()
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--config", name],
+                capture_output=True, text=True, timeout=1500, cwd=REPO,
+                env=env)
+            line = next((ln[5:] for ln in reversed(r.stdout.splitlines())
+                         if ln.startswith("PROXY")), None)
+            rows.append(json.loads(line) if line else
+                        {"config": name, "error":
+                         (r.stderr or "no output").strip()[-500:]})
+        except subprocess.TimeoutExpired:
+            rows.append({"config": name,
+                         "error": f"timeout {int(time.time() - t0)}s"})
+        print(f"[{name}] done in {time.time() - t0:.0f}s", file=sys.stderr)
+
+    with open(os.path.join(REPO, "tools", "bench_proxy.json"), "w") as f:
+        json.dump(rows, f, indent=1)
+    _write_md(rows)
+
+
+def _write_md(rows) -> None:
+    ts = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+    lines = [
+        "# BENCH_PROXY — compile-time roofline evidence (no hardware)",
+        "",
+        f"Generated by `tools/bench_proxy.py` at {ts}.",
+        "",
+        "Every number below comes from compiling the REAL train step"
+        " (abstract inputs, nothing executed) and reading XLA's cost"
+        " analysis of the optimized module; multi-chip configs lower the"
+        " actual manual-shard_map hybrid program on an 8-device virtual"
+        " mesh and report PER-CHIP work (verified: dp2 halves reported"
+        " flops).  Roofline: TPU v5p, 459 TFLOP/s bf16, 2765 GB/s HBM.",
+        "",
+        "`t_step >= max(flops/peak, bytes/bw)`;  throughput and MFU are"
+        " UPPER bounds (perfect overlap, zero ICI/host overhead); real"
+        " numbers land when the chip tunnel heals"
+        " (tools/tpu_probe.py auto-seize).",
+        "",
+        "| config | per-chip GFLOPs/step | per-chip MB/step | intensity"
+        " (FLOP/B) | bound | min step ms | max throughput | MFU bound |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        if "error" in r:
+            lines.append(f"| {r['config']} | compile failed: "
+                         f"{r['error'][:80]} | | | | | | |")
+            continue
+        lines.append(
+            "| {config} | {gf:.1f} | {mb:.1f} | {ai} | {bound} |"
+            " {ms} | {tp} {unit} | {mfu} |".format(
+                config=r["config"],
+                gf=r["flops_per_step_per_chip"] / 1e9,
+                mb=r["bytes_per_step_per_chip"] / 1e6,
+                ai=r["arithmetic_intensity"], bound=r["bound"],
+                ms=r["v5p_step_time_lower_bound_ms"],
+                tp=r["v5p_throughput_upper_bound"], unit=r["unit"],
+                mfu=r["v5p_mfu_upper_bound"]))
+    lines += ["", "## Per-config notes", ""]
+    for r in rows:
+        if "note" in r:
+            extra = ""
+            if "scan_undercount_corrected" in r:
+                extra = (f" XLA cost analysis counted the microbatch scan"
+                         f" body once (x{r['scan_undercount_corrected']}"
+                         " undercount); corrected via the analytic 6N+"
+                         "attention formula, bytes scaled by the same"
+                         " factor.")
+            lines.append(f"- **{r['config']}** — {r['note']}; lower"
+                         f" {r['lower_s']}s, compile {r['compile_s']}s."
+                         + extra)
+    lines += [
+        "",
+        "## Reading the table",
+        "",
+        "- A `compute`-bound config can reach its MFU bound only if every"
+        " HBM byte overlaps the MXU; `memory`-bound configs need larger"
+        " batch, more fusion, or lower-precision weights to climb.",
+        "- Remat configs trade extra FLOPs for memory, which *lowers* the"
+        " MFU bound but keeps the activation footprint inside HBM — the"
+        " bound is per-design, not per-implementation-quality.",
+        "- CPU-backend compilation means Pallas flash-attention custom"
+        " calls are not in these modules (plain-XLA attention instead);"
+        " flash raises arithmetic intensity further on the real chip.",
+        "- `bytes accessed` counts every HLO op's operands on the"
+        " CPU-compiled module, whose fusion is far weaker than the TPU"
+        " backend's — real HBM traffic on-chip is lower, so the MFU"
+        " bounds here are CONSERVATIVE (true ceilings sit higher).",
+    ]
+    with open(os.path.join(REPO, "BENCH_PROXY.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config")
+    a = ap.parse_args()
+    if a.config:
+        child(a.config)
+    else:
+        main()

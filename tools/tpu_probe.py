@@ -101,6 +101,10 @@ def seize():
 
     results["bench"] = _run([sys.executable, "bench.py"],
                             "bench_tpu.json", 1800)
+    for cfg in ("lenet", "resnet50", "bert", "llama"):
+        results[f"bench_{cfg}"] = _run(
+            [sys.executable, "bench.py", "--config", cfg],
+            f"bench_tpu_{cfg}.json", 1800)
     results["bench_sweep"] = _run([sys.executable, "bench_sweep.py"],
                                   "bench_sweep_tpu.json", 3600)
     results["pytest_tpu"] = _run(
