@@ -17,7 +17,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["FileStore", "ElasticManager", "StepWatchdog"]
+__all__ = ["FileStore", "KVLeaseStore", "ElasticManager", "StepWatchdog"]
 
 
 class FileStore:
@@ -55,6 +55,31 @@ class FileStore:
             os.remove(os.path.join(self.dir, f"{host_id}.json"))
         except FileNotFoundError:
             pass
+
+
+class KVLeaseStore:
+    """Membership on the launcher's rendezvous KV (launch/kv.py) with
+    server-side TTL leases — the etcd analog for multi-host pods where
+    hosts share no filesystem (reference fleet/elastic etcd leases,
+    manager.py:218-251).  Same interface as :class:`FileStore`."""
+
+    def __init__(self, master: str, job_id: str = "default",
+                 ttl: float = 30.0):
+        from .launch.kv import KVClient
+        self.kv = KVClient(master)
+        self.prefix = f"elastic/{job_id}/"
+        self.ttl = ttl
+
+    def register(self, host_id: str, info: Optional[dict] = None):
+        self.kv.set(self.prefix + host_id,
+                    {"ts": time.time(), **(info or {})}, ttl=self.ttl)
+
+    def hosts(self) -> List[str]:
+        n = len(self.prefix)
+        return sorted(k[n:] for k in self.kv.list(self.prefix))
+
+    def deregister(self, host_id: str):
+        self.kv.delete(self.prefix + host_id)
 
 
 class ElasticManager:
@@ -105,8 +130,15 @@ class ElasticManager:
 
     def _loop(self):
         while not self._stop.is_set():
-            self.store.register(self.host_id)
-            hosts = self.store.hosts()
+            try:
+                self.store.register(self.host_id)
+                hosts = self.store.hosts()
+            except Exception:      # noqa: BLE001 — transient store outage
+                # (KV master restarting, shared FS blip): keep the
+                # heartbeat thread ALIVE and retry next tick; dying here
+                # silently would get this healthy host declared dead
+                self._stop.wait(self.interval)
+                continue
             decision = self.scale_decision(hosts)
             if decision == "restart" and self.on_change is not None:
                 self.on_change(hosts)

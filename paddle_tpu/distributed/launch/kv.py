@@ -1,0 +1,187 @@
+"""Rendezvous KV service for the multi-host launcher.
+
+Reference: python/paddle/distributed/launch/utils/kv_server.py (the
+master's HTTP KV) + fleet/elastic's etcd usage (TTL leases, membership
+watches).  TPU-native shape: one tiny line-JSON-over-TCP server hosted by
+the rank-0 controller (the reference's ``--master``), speaking five ops:
+
+    set(k, v, ttl)   — write, optional lease; expired keys vanish
+    get(k)           — read or None
+    add(k, n)        — atomic counter increment -> new value (rank grab)
+    cas(k, old, new) — compare-and-swap (epoch bump without races)
+    list(prefix)     — {k: v} of unexpired keys under prefix
+
+Every mutation stamps a monotonic server time; TTL expiry is evaluated
+server-side so client clocks don't matter (etcd lease semantics)."""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["KVServer", "KVClient", "start_server"]
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.data: Dict[str, Tuple[Any, Optional[float]]] = {}
+
+    def _alive(self, k: str, now: float) -> bool:
+        v = self.data.get(k)
+        return v is not None and (v[1] is None or v[1] > now)
+
+    def handle(self, req: dict) -> dict:
+        op = req.get("op")
+        now = time.monotonic()
+        with self.lock:
+            if op == "set":
+                ttl = req.get("ttl")
+                self.data[req["k"]] = (
+                    req.get("v"), now + ttl if ttl else None)
+                return {"ok": True}
+            if op == "get":
+                k = req["k"]
+                if self._alive(k, now):
+                    return {"ok": True, "v": self.data[k][0]}
+                return {"ok": True, "v": None}
+            if op == "add":
+                k = req["k"]
+                cur = self.data[k][0] if self._alive(k, now) else 0
+                new = int(cur) + int(req.get("n", 1))
+                self.data[k] = (new, None)
+                return {"ok": True, "v": new}
+            if op == "cas":
+                k = req["k"]
+                cur = self.data[k][0] if self._alive(k, now) else None
+                if cur == req.get("old"):
+                    self.data[k] = (req.get("new"), None)
+                    return {"ok": True, "v": True}
+                return {"ok": True, "v": False, "cur": cur}
+            if op == "list":
+                pre = req.get("prefix", "")
+                return {"ok": True, "v": {
+                    k: v for k, (v, exp) in self.data.items()
+                    if k.startswith(pre) and (exp is None or exp > now)}}
+            if op == "del":
+                self.data.pop(req["k"], None)
+                return {"ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+class KVServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr: Tuple[str, int]):
+        self.state = _State()
+        super().__init__(addr, _Handler)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+                resp = self.server.state.handle(req)
+            except Exception as e:  # noqa: BLE001 — protocol boundary
+                resp = {"ok": False, "error": str(e)}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+def start_server(host: str = "127.0.0.1", port: int = 0) -> KVServer:
+    srv = KVServer((host, port))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv
+
+
+class KVClient:
+    """One persistent connection, auto-reconnect, blocking request/reply."""
+
+    def __init__(self, addr: str, timeout: float = 10.0,
+                 connect_retries: int = 40, retry_delay: float = 0.25):
+        host, port = addr.rsplit(":", 1)
+        self.addr = (host, int(port))
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._file = None
+        self._lock = threading.Lock()
+        self._connect_retries = connect_retries
+        self._retry_delay = retry_delay
+
+    def _connect(self):
+        last = None
+        for _ in range(self._connect_retries):
+            try:
+                s = socket.create_connection(self.addr,
+                                             timeout=self.timeout)
+                self._sock = s
+                self._file = s.makefile("rwb")
+                return
+            except OSError as e:
+                last = e
+                time.sleep(self._retry_delay)
+        raise ConnectionError(
+            f"KV master {self.addr} unreachable: {last}")
+
+    def _req(self, req: dict) -> Any:
+        with self._lock:
+            for attempt in (0, 1):
+                if self._file is None:
+                    self._connect()
+                try:
+                    self._file.write((json.dumps(req) + "\n").encode())
+                    self._file.flush()
+                    line = self._file.readline()
+                    if not line:
+                        raise ConnectionError("KV connection closed")
+                    resp = json.loads(line)
+                    if not resp.get("ok"):
+                        raise RuntimeError(resp.get("error", "KV error"))
+                    return resp.get("v")
+                except (OSError, ConnectionError):
+                    self.close()
+                    if attempt:
+                        raise
+        return None
+
+    def set(self, k: str, v: Any = "", ttl: Optional[float] = None):
+        self._req({"op": "set", "k": k, "v": v, "ttl": ttl})
+
+    def get(self, k: str) -> Any:
+        return self._req({"op": "get", "k": k})
+
+    def add(self, k: str, n: int = 1) -> int:
+        return self._req({"op": "add", "k": k, "n": n})
+
+    def cas(self, k: str, old: Any, new: Any) -> bool:
+        return bool(self._req({"op": "cas", "k": k, "old": old,
+                               "new": new}))
+
+    def list(self, prefix: str) -> Dict[str, Any]:
+        return self._req({"op": "list", "prefix": prefix}) or {}
+
+    def delete(self, k: str):
+        self._req({"op": "del", "k": k})
+
+    def close(self):
+        try:
+            if self._sock:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = None
+        self._file = None

@@ -4,20 +4,20 @@ detection).
 
 Modes
 -----
-* **pod** (default on TPU hosts): one process per host; sets the
-  ``jax.distributed.initialize`` coordination env
-  (COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID) from --master/--nnodes
-  /--rank and execs the training script in-process.
-* **local** (``--nproc_per_node N``): spawns N child processes on this
-  machine with per-rank env (rank/world size/coordinator), used by the
-  collective tests exactly like the reference's TestMultipleGpus harness.
-  On CPU each child gets JAX_PLATFORMS=cpu.
-
-Failure handling (reference elastic/manager.py:125 semantics, coarse TPU
-version): the watcher polls children; if any exits non-zero the pod is torn
-down and — when ``--max_restart > 0`` — relaunched from scratch, resuming
-from the user's checkpoints (restart-from-checkpoint, not in-process
-repair).
+* **pod** (``--master`` given or ``--nnodes`` > 1): the MULTI-HOST path.
+  Every host runs the same command; the rank-0 host serves the rendezvous
+  KV (launch/kv.py — the reference master/etcd), each controller grabs a
+  node rank from an atomic counter, barriers until the ``--nnodes=N`` (or
+  ``N:M`` elastic range) is met, then spawns ``--nproc_per_node`` workers
+  with dense global ranks and ``jax.distributed`` coordinator env.  While
+  training runs, controllers heartbeat TTL-leased keys and watch peers:
+  a dead host (lease expiry) or a non-zero worker tears the POD down
+  everywhere, bumps the job epoch (CAS — no double-bump races) and, within
+  ``--max_restart``, re-rendezvouses for a fresh attempt that resumes from
+  the user's checkpoints — reference elastic/manager.py:125 semantics.
+* **local** (``--nproc_per_node N`` alone): spawns N children on this
+  machine with per-rank env, used by the collective tests exactly like
+  the reference's TestMultipleGpus harness.
 """
 
 from __future__ import annotations
@@ -25,6 +25,7 @@ from __future__ import annotations
 import argparse
 import os
 import signal
+import socket
 import subprocess
 import sys
 import time
@@ -52,6 +53,10 @@ def _parse_args(argv=None):
     p.add_argument("--devices", type=str, default=None,
                    help="visible device ids for local mode")
     p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--rdzv_timeout", type=float, default=300.0,
+                   help="seconds to wait for --nnodes hosts to join")
+    p.add_argument("--heartbeat_ttl", type=float, default=10.0,
+                   help="host lease TTL; a host silent this long is dead")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -106,70 +111,288 @@ class Watcher:
                 p.kill()
 
 
+def _spawn_procs(args, rank_envs) -> List[subprocess.Popen]:
+    """Shared worker-spawn loop: one child per (global_rank, env_update)
+    pair, with log-dir + device plumbing (both the local and the pod
+    paths call this — one place to fix)."""
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    cmd = [sys.executable, args.training_script,
+           *args.training_script_args]
+    procs = []
+    for grank, extra in rank_envs:
+        env = dict(os.environ)
+        env.update(extra)
+        if args.devices is not None:
+            env["TPU_VISIBLE_DEVICES"] = args.devices
+        out = None
+        if args.log_dir:
+            out = open(os.path.join(args.log_dir,
+                                    f"workerlog.{grank}"), "wb")
+        procs.append(subprocess.Popen(cmd, env=env, stdout=out,
+                                      stderr=subprocess.STDOUT
+                                      if out else None))
+    return procs
+
+
+def _rank_env(grank: int, world: int, master: str, coord: str) -> dict:
+    return {
+        "PADDLE_TRAINER_ID": str(grank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_MASTER": master,
+        "COORDINATOR_ADDRESS": coord,
+        "NUM_PROCESSES": str(world),
+        "PROCESS_ID": str(grank),
+        "JAX_COORDINATOR_ADDRESS": coord,
+        "JAX_NUM_PROCESSES": str(world),
+        "JAX_PROCESS_ID": str(grank),
+    }
+
+
 def _spawn_local(args) -> int:
     n = args.nproc_per_node
     master = args.master or "127.0.0.1:0"
     if master.endswith(":0"):
-        import socket
         s = socket.socket()
         s.bind(("127.0.0.1", 0))
         master = f"127.0.0.1:{s.getsockname()[1]}"
         s.close()
-    if args.log_dir:
-        os.makedirs(args.log_dir, exist_ok=True)
-    procs = []
-    for rank in range(n):
-        env = dict(os.environ)
-        env.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(n),
-            "PADDLE_MASTER": master,
-            "COORDINATOR_ADDRESS": master,
-            "NUM_PROCESSES": str(n),
-            "PROCESS_ID": str(rank),
-            "JAX_COORDINATOR_ADDRESS": master,
-            "JAX_NUM_PROCESSES": str(n),
-            "JAX_PROCESS_ID": str(rank),
-        })
-        if args.devices is not None:
-            env["TPU_VISIBLE_DEVICES"] = args.devices
-        cmd = [sys.executable, args.training_script,
-               *args.training_script_args]
-        if args.log_dir:
-            out = open(os.path.join(args.log_dir,
-                                    f"workerlog.{rank}"), "wb")
-        else:
-            out = None
-        procs.append(subprocess.Popen(cmd, env=env, stdout=out,
-                                      stderr=subprocess.STDOUT
-                                      if out else None))
+    procs = _spawn_procs(
+        args, [(r, _rank_env(r, n, master, master)) for r in range(n)])
     return Watcher(procs).wait()
 
 
-def _run_pod(args) -> int:
-    """One process per TPU host: set jax.distributed env and exec the
-    script in this process."""
-    env = os.environ
-    lo, hi = _nnodes_range(args.nnodes)
-    if args.master:
-        env.setdefault("JAX_COORDINATOR_ADDRESS", args.master)
-        env.setdefault("COORDINATOR_ADDRESS", args.master)
-    env.setdefault("JAX_NUM_PROCESSES", str(lo))
-    if args.rank is not None:
-        env.setdefault("JAX_PROCESS_ID", str(args.rank))
-    cmd = [sys.executable, args.training_script,
-           *args.training_script_args]
-    return subprocess.call(cmd, env=dict(env))
+class PodController:
+    """One per host (reference controllers/collective.py Controller +
+    pod model).  Owns rendezvous, worker spawn, heartbeats, peer watch,
+    and the epoch-bump restart protocol."""
+
+    RESTART = -255      # internal code: peer/local failure, try again
+
+    def __init__(self, args):
+        from .kv import KVClient, start_server
+        self.args = args
+        self.lo, self.hi = _nnodes_range(args.nnodes)
+        self.nproc = args.nproc_per_node or 1
+        master = args.master or "127.0.0.1:0"
+        host, port = master.rsplit(":", 1)
+        self.server = None
+        if int(port) == 0:          # single-host convenience
+            self.server = start_server(host, 0)
+            master = f"{host}:{self.server.port}"
+        else:
+            try:                    # first host to bind serves the KV
+                self.server = start_server(host, int(port))
+            except OSError:
+                pass
+        self.master = master
+        self.kv = KVClient(master)
+        self.job = args.job_id
+        # initialize the epoch counter exactly once (first host wins);
+        # the restart CAS then always compares against a real int
+        self.kv.cas(f"{self.job}/epoch", None, 0)
+
+    # -- rendezvous --------------------------------------------------------
+    def rendezvous(self):
+        """Join the current epoch and return (epoch, node_rank, roster).
+
+        The KV-SERVING host always takes node rank 0 — its machine is the
+        one every process can reach at the master address, so the
+        jax.distributed coordinator (master_port+1) really is bindable by
+        global rank 0.  Rank 0 runs the barrier and SEALS the membership
+        under a roster key; every other host waits for that sealed roster
+        (all pods agree on world size — no per-host snapshots).  A host
+        that joins after sealing waits for the next epoch."""
+        kv, job = self.kv, self.job
+        ttl = self.args.heartbeat_ttl
+        deadline = time.time() + self.args.rdzv_timeout
+        me = {"host": socket.gethostname(), "pid": os.getpid()}
+        while True:
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"rendezvous: no roster after "
+                    f"{self.args.rdzv_timeout}s")
+            epoch = kv.get(f"{job}/epoch") or 0
+            pre = f"{job}/e{epoch}"
+            if self.args.rank is not None:
+                rank = self.args.rank
+            elif self.server is not None:
+                rank = 0
+            else:
+                rank = kv.add(f"{pre}/next_rank")   # 1, 2, ... (0 = master)
+            kv.set(f"{pre}/node/{rank}", me, ttl=ttl)
+            if rank == 0:
+                roster = self._barrier_and_seal(epoch, rank, me, deadline)
+            else:
+                roster = self._await_roster(epoch, rank, me, deadline)
+            if roster is None:          # epoch moved on: rejoin
+                continue
+            if rank in roster:
+                return epoch, rank, roster
+            # joined too late for this epoch — wait for the next one
+            while (kv.get(f"{job}/epoch") or 0) == epoch:
+                if time.time() > deadline:
+                    raise TimeoutError("rendezvous: sealed out and no "
+                                       "new epoch")
+                time.sleep(0.5)
+
+    def _barrier_and_seal(self, epoch, rank, me, deadline):
+        kv, job, ttl = self.kv, self.job, self.args.heartbeat_ttl
+        pre = f"{job}/e{epoch}"
+        stable_since = None
+        n_seen = -1
+        while True:
+            kv.set(f"{pre}/node/{rank}", me, ttl=ttl)
+            nodes = kv.list(f"{pre}/node/")
+            n = len(nodes)
+            if n >= self.hi:
+                break
+            if n >= self.lo:
+                if n != n_seen:
+                    stable_since, n_seen = time.time(), n
+                elif time.time() - stable_since > min(2.0, ttl / 3):
+                    break               # elastic range satisfied + settled
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"rendezvous: {n}/{self.lo} hosts after "
+                    f"{self.args.rdzv_timeout}s")
+            time.sleep(0.2)
+        nodes = kv.list(f"{pre}/node/")
+        roster = sorted(int(k.rsplit("/", 1)[1]) for k in nodes)
+        kv.set(f"{pre}/roster", roster)
+        return roster
+
+    def _await_roster(self, epoch, rank, me, deadline):
+        kv, job, ttl = self.kv, self.job, self.args.heartbeat_ttl
+        pre = f"{job}/e{epoch}"
+        while True:
+            kv.set(f"{pre}/node/{rank}", me, ttl=ttl)
+            roster = kv.get(f"{pre}/roster")
+            if roster is not None:
+                return [int(r) for r in roster]
+            if (kv.get(f"{job}/epoch") or 0) != epoch:
+                return None             # epoch bumped while waiting
+            if time.time() > deadline:
+                raise TimeoutError("rendezvous: roster never sealed")
+            time.sleep(0.2)
+
+    # -- workers -----------------------------------------------------------
+    def spawn_workers(self, epoch: int, node_rank: int,
+                      n_nodes: int) -> List[subprocess.Popen]:
+        world = n_nodes * self.nproc
+        coord_host, kv_port = self.master.rsplit(":", 1)
+        coord = f"{coord_host}:{int(kv_port) + 1}"
+        rank_envs = []
+        for lr in range(self.nproc):
+            grank = node_rank * self.nproc + lr
+            env = _rank_env(grank, world, self.master, coord)
+            env.update({
+                "PADDLE_LOCAL_RANK": str(lr),
+                "PADDLE_NNODES": str(n_nodes),
+                "PADDLE_NODE_RANK": str(node_rank),
+                "PADDLE_JOB_EPOCH": str(epoch),
+            })
+            rank_envs.append((grank, env))
+        return _spawn_procs(self.args, rank_envs)
+
+    # -- watch -------------------------------------------------------------
+    def watch(self, epoch: int, rank: int, ranks: List[int],
+              procs: List[subprocess.Popen]) -> int:
+        """Heartbeat + poll children + watch peer leases.  Returns the
+        job exit code, or RESTART when this epoch must be retried."""
+        kv, job, ttl = self.kv, self.job, self.args.heartbeat_ttl
+        hb = f"{job}/e{epoch}/hb/"
+        done = f"{job}/e{epoch}/done/"
+        fail_key = f"{job}/e{epoch}/fail"
+        poll = max(0.2, ttl / 5)
+        grace = time.time() + ttl          # let peers post first leases
+        w = Watcher(procs)
+
+        def dead_peer() -> Optional[int]:
+            if time.time() <= grace:
+                return None
+            alive = kv.list(hb)
+            finished = kv.list(done)
+            for r in ranks:
+                if r != rank and (hb + str(r)) not in alive and \
+                        (done + str(r)) not in finished:
+                    return r
+            return None
+
+        local_done = False
+        while True:
+            try:
+                kv.set(hb + str(rank), time.time(), ttl=ttl)
+                if not local_done:
+                    codes = [p.poll() for p in procs]
+                    bad = w._job_code(codes)
+                    if bad:
+                        kv.set(fail_key, {"rank": rank, "code": bad})
+                        w.terminate()
+                        return bad      # real code; run() decides restart
+                    if all(c == 0 for c in codes):
+                        local_done = True
+                        kv.set(done + str(rank), True)
+                if kv.get(fail_key):
+                    w.terminate()
+                    return self.RESTART
+                if local_done and len(kv.list(done)) >= len(ranks):
+                    return 0           # every host finished clean
+                r = dead_peer()
+                if r is not None:
+                    print(f"[launch] host {r} lease expired — tearing "
+                          "down for restart", file=sys.stderr)
+                    kv.set(fail_key, {"rank": r, "code": "lost"})
+                    w.terminate()
+                    return self.RESTART
+            except (OSError, ConnectionError):
+                # master gone: its controller only exits after seeing
+                # EVERY host done (success) or after posting fail_key
+                # (teardown).  With our own workers done, that's success;
+                # otherwise treat it as a lost peer.
+                w.terminate()
+                return 0 if local_done else self.RESTART
+            time.sleep(poll)
+
+    # -- top-level ---------------------------------------------------------
+    def run(self) -> int:
+        attempt = 0
+        while True:
+            epoch, rank, ranks = self.rendezvous()
+            # global ranks come from the roster POSITION, so they stay
+            # dense even if a node died between joining and sealing
+            procs = self.spawn_workers(epoch, ranks.index(rank),
+                                       len(ranks))
+            code = self.watch(epoch, rank, ranks, procs)
+            if code == 0:
+                return 0
+            # bump the epoch exactly once across all controllers (CAS)
+            self.kv.cas(f"{self.job}/epoch", epoch, epoch + 1)
+            attempt += 1
+            if attempt > self.args.max_restart:
+                # budget exhausted: surface the REAL failure code (peer
+                # loss has no local code; report 1)
+                return code if code != self.RESTART else 1
+            print(f"[launch] epoch {epoch} failed; restart "
+                  f"{attempt}/{self.args.max_restart} (resume from "
+                  "checkpoint)", file=sys.stderr)
 
 
 def launch(argv=None) -> int:
     args = _parse_args(argv)
+    _, hi = _nnodes_range(args.nnodes)
+    if hi > 1 and args.master is None:
+        print("[launch] --nnodes > 1 requires --master <host:port> "
+              "(the rendezvous address every host can reach)",
+              file=sys.stderr)
+        return 2
+    if args.master is not None:
+        return PodController(args).run()
     attempt = 0
     while True:
-        if args.nproc_per_node is not None:
-            code = _spawn_local(args)
-        else:
-            code = _run_pod(args)
+        code = _spawn_local(args) if args.nproc_per_node is not None \
+            else subprocess.call([sys.executable, args.training_script,
+                                  *args.training_script_args])
         if code == 0 or attempt >= args.max_restart:
             return code
         attempt += 1
