@@ -10,13 +10,23 @@ runs observers that collect absmax/histogram stats during calibration.
 from .config import QuantConfig  # noqa: F401
 from .quanters import (  # noqa: F401
     AbsMaxObserver, BaseObserver, BaseQuanter, FakeQuanterWithAbsMax,
-    quanter,
+    quanter, get_quanter, register_quanter,
     FakeQuanterWithAbsMaxObserver, quant_dequant,
+)
+from .observers import (  # noqa: F401
+    EMAAbsMaxObserver, GroupWiseWeightObserver, HistPercentileObserver,
+    PerChannelAbsMaxObserver,
 )
 from .qat import QAT  # noqa: F401
 from .ptq import PTQ  # noqa: F401
+from .export import (  # noqa: F401
+    QuantizedLinear, convert_to_deploy, export_quantized,
+)
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "BaseQuanter",
-           "BaseObserver", "quanter",
+           "BaseObserver", "quanter", "get_quanter", "register_quanter",
            "FakeQuanterWithAbsMax", "FakeQuanterWithAbsMaxObserver",
-           "AbsMaxObserver", "quant_dequant"]
+           "AbsMaxObserver", "EMAAbsMaxObserver",
+           "PerChannelAbsMaxObserver", "HistPercentileObserver",
+           "GroupWiseWeightObserver", "quant_dequant",
+           "QuantizedLinear", "convert_to_deploy", "export_quantized"]

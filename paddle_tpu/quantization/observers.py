@@ -1,0 +1,158 @@
+"""Observer library (reference python/paddle/quantization/observers/ —
+abs_max.py, groupwise.py — plus the imperative PTQ observers: moving
+average, histogram/percentile).
+
+Observers COLLECT statistics during calibration forwards and expose
+``scales()`` / ``cal_thresholds()``; they never alter the tensor.  All
+stat updates happen host-side on concrete values (calibration is an
+eager loop by construction), so none of this enters the compiled graph.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .quanters import BaseObserver, register_quanter
+
+__all__ = ["EMAAbsMaxObserver", "PerChannelAbsMaxObserver",
+           "HistPercentileObserver", "GroupWiseWeightObserver"]
+
+
+def _val(x):
+    return np.asarray(getattr(x, "_value", x))
+
+
+@register_quanter("ema_abs_max")
+class EMAAbsMaxObserver(BaseObserver):
+    """Exponential-moving-average absmax (imperative moving-average
+    observer): smoother than global max under outlier batches."""
+
+    def __init__(self, moving_rate: float = 0.9, quant_bits: int = 8):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.quant_bits = quant_bits
+        self._ema = None
+
+    def forward(self, x):
+        cur = float(np.abs(_val(x)).max())
+        self._ema = cur if self._ema is None else \
+            self.moving_rate * self._ema + (1 - self.moving_rate) * cur
+        return x
+
+    def cal_thresholds(self):
+        return self._ema or 0.0
+
+    def scales(self):
+        return Tensor(jnp.asarray([max(self._ema or 0.0, 1e-9)],
+                                  jnp.float32))
+
+
+@register_quanter("per_channel_abs_max")
+class PerChannelAbsMaxObserver(BaseObserver):
+    """Per-output-channel absmax over ``axis`` (reference per-channel
+    weight observers): one scale per channel."""
+
+    def __init__(self, axis: int = -1, quant_bits: int = 8):
+        super().__init__()
+        self.axis = axis
+        self.quant_bits = quant_bits
+        self._max = None
+
+    def forward(self, x):
+        v = np.abs(_val(x))
+        ax = tuple(i for i in range(v.ndim) if i != self.axis % v.ndim)
+        cur = v.max(axis=ax) if ax else v
+        self._max = cur if self._max is None else np.maximum(self._max,
+                                                             cur)
+        return x
+
+    def cal_thresholds(self):
+        return self._max
+
+    def scales(self):
+        return Tensor(jnp.asarray(np.maximum(self._max, 1e-9),
+                                  jnp.float32))
+
+
+@register_quanter("hist_percentile")
+class HistPercentileObserver(BaseObserver):
+    """Histogram + percentile threshold (imperative HistObserver /
+    PercentileObserver): clips the absmax tail at ``percentile`` of the
+    observed magnitude mass — robust to activation outliers."""
+
+    def __init__(self, percentile: float = 0.999, bins: int = 2048,
+                 quant_bits: int = 8):
+        super().__init__()
+        self.percentile = percentile
+        self.bins = bins
+        self.quant_bits = quant_bits
+        self._hist = None
+        self._edges = None
+
+    def forward(self, x):
+        v = np.abs(_val(x)).reshape(-1)
+        hi = float(v.max()) if v.size else 0.0
+        if self._hist is None:
+            self._edges = np.linspace(0.0, max(hi, 1e-9), self.bins + 1)
+            self._hist = np.histogram(v, bins=self._edges)[0].astype(
+                np.float64)
+        else:
+            if hi > self._edges[-1]:
+                # grow the range: re-bin the old histogram into new edges
+                new_edges = np.linspace(0.0, hi, self.bins + 1)
+                centers = (self._edges[:-1] + self._edges[1:]) / 2
+                re_binned = np.histogram(
+                    centers, bins=new_edges, weights=self._hist)[0]
+                self._hist, self._edges = re_binned, new_edges
+            self._hist += np.histogram(v, bins=self._edges)[0]
+        return x
+
+    def cal_thresholds(self):
+        if self._hist is None or self._hist.sum() == 0:
+            return 0.0
+        cdf = np.cumsum(self._hist) / self._hist.sum()
+        idx = int(np.searchsorted(cdf, self.percentile))
+        return float(self._edges[min(idx + 1, self.bins)])
+
+    def scales(self):
+        return Tensor(jnp.asarray([max(self.cal_thresholds(), 1e-9)],
+                                  jnp.float32))
+
+
+@register_quanter("groupwise_weight")
+class GroupWiseWeightObserver(BaseObserver):
+    """Group-wise weight absmax (reference observers/groupwise.py): the
+    K dim is chunked into ``group_size`` groups, one scale each — the
+    stat layer for grouped weight-only kernels."""
+
+    def __init__(self, group_size: int = 128, quant_bits: int = 4):
+        super().__init__()
+        self.group_size = group_size
+        self.quant_bits = quant_bits
+        self._max = None
+
+    def forward(self, x):
+        v = np.abs(_val(x))            # [K, N]
+        if v.ndim != 2:
+            raise ValueError(
+                "GroupWiseWeightObserver requires 2-D [K, N] weights "
+                f"(got shape {v.shape}); use PerChannelAbsMaxObserver "
+                "for conv weights or activations")
+        k, n = v.shape
+        g = self.group_size
+        pad = (-k) % g
+        if pad:
+            v = np.concatenate([v, np.zeros((pad, n), v.dtype)], axis=0)
+        cur = v.reshape(-1, g, n).max(axis=1)      # [K/g, N]
+        self._max = cur if self._max is None else np.maximum(self._max,
+                                                             cur)
+        return x
+
+    def cal_thresholds(self):
+        return self._max
+
+    def scales(self):
+        return Tensor(jnp.asarray(np.maximum(self._max, 1e-9),
+                                  jnp.float32))

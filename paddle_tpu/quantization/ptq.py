@@ -6,7 +6,6 @@ from __future__ import annotations
 from ..nn.layer.layers import Layer
 from .config import QuantConfig
 from .qat import QuantedLayer
-from .quanters import quant_dequant
 
 __all__ = ["PTQ"]
 
@@ -27,19 +26,21 @@ class PTQ:
                 self.quantize(child, inplace=True)
         return model
 
-    def convert(self, model: Layer, inplace: bool = False) -> Layer:
-        """Apply observed scales: weights are fake-quantized in place and
-        observers removed."""
+    def convert(self, model: Layer, inplace: bool = False,
+                deploy: bool = False, weight_dtype: str = "int8"
+                ) -> Layer:
+        """Apply observed scales.  ``deploy=True`` produces
+        :class:`~paddle_tpu.quantization.QuantizedLinear` layers with
+        real integer weights (weight_only_linear path); default bakes
+        fake-quantized fp weights and removes observers."""
+        if deploy:
+            from .export import convert_to_deploy
+            return convert_to_deploy(model, weight_dtype)
+        from .export import bake_fake_quant
         for name, child in list(model.named_children()):
             if isinstance(child, QuantedLayer):
-                inner = child.inner
-                q = child.weight_quanter
-                if hasattr(inner, "weight") and q is not None and \
-                        hasattr(q, "scales") and q.scales() is not None:
-                    inner.weight.set_value(
-                        quant_dequant(inner.weight,
-                                      q.scales().max()).numpy())
-                setattr(model, name, inner)
+                bake_fake_quant(child.inner, child.weight_quanter)
+                setattr(model, name, child.inner)
             else:
                 self.convert(child, inplace=True)
         return model

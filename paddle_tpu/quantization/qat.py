@@ -15,12 +15,19 @@ class QuantedLayer(Layer):
     def __init__(self, layer: Layer, activation_quanter, weight_quanter):
         super().__init__()
         self.inner = layer
-        self.activation_quanter = activation_quanter() \
-            if callable(activation_quanter) and not isinstance(
-                activation_quanter, Layer) else activation_quanter
-        self.weight_quanter = weight_quanter() \
-            if callable(weight_quanter) and not isinstance(
-                weight_quanter, Layer) else weight_quanter
+        self.activation_quanter = self._resolve(activation_quanter)
+        self.weight_quanter = self._resolve(weight_quanter)
+
+    @staticmethod
+    def _resolve(q):
+        """Accept an instance, a factory/class, or a REGISTERED NAME
+        (quanters.get_quanter — the factory.py name path)."""
+        if isinstance(q, str):
+            from .quanters import get_quanter
+            return get_quanter(q)
+        if callable(q) and not isinstance(q, Layer):
+            return q()
+        return q
 
     def forward(self, x, *args, **kwargs):
         from ..nn import functional as F
@@ -55,17 +62,22 @@ class QAT:
                 self.quantize(child, inplace=True)
         return model
 
-    def convert(self, model: Layer, inplace: bool = False) -> Layer:
-        """Strip wrappers back to inner layers (deploy form: weights stay
-        fake-quantized by the final scales)."""
+    def convert(self, model: Layer, inplace: bool = False,
+                deploy: bool = False, weight_dtype: str = "int8"
+                ) -> Layer:
+        """Strip wrappers back to inner layers.  ``deploy=True`` goes the
+        whole way: Linear layers become :class:`QuantizedLinear` with
+        real int8/int4 weights feeding weight_only_linear (export.py);
+        default keeps fp weights baked at the trained scales (the
+        reference convert() behavior)."""
+        if deploy:
+            from .export import convert_to_deploy
+            return convert_to_deploy(model, weight_dtype)
+        from .export import bake_fake_quant
         for name, child in list(model.named_children()):
             if isinstance(child, QuantedLayer):
-                inner = child.inner
-                if child.weight_quanter is not None and hasattr(
-                        inner, "weight"):
-                    inner.weight.set_value(
-                        child.weight_quanter(inner.weight).numpy())
-                setattr(model, name, inner)
+                bake_fake_quant(child.inner, child.weight_quanter)
+                setattr(model, name, child.inner)
             else:
                 self.convert(child, inplace=True)
         return model

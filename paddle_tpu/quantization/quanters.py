@@ -136,6 +136,9 @@ class BaseObserver(BaseQuanter):
         raise NotImplementedError
 
 
+_QUANTER_REGISTRY = {}
+
+
 def quanter(name: str):
     """Class decorator registering a custom quanter under ``name``
     (reference quantization/factory.py quanter): the QuantConfig factory
@@ -147,4 +150,20 @@ def quanter(name: str):
     return deco
 
 
-_QUANTER_REGISTRY = {}
+register_quanter = quanter          # observer-side alias
+
+
+def get_quanter(name: str, **kwargs):
+    """Instantiate a registered quanter/observer by name (the factory
+    entry point — reference factory.QuanterFactory._instance)."""
+    if name not in _QUANTER_REGISTRY:
+        raise KeyError(
+            f"unknown quanter {name!r}; registered: "
+            f"{sorted(_QUANTER_REGISTRY)}")
+    return _QUANTER_REGISTRY[name](**kwargs)
+
+
+# built-ins are addressable by name too
+quanter("abs_max")(FakeQuanterWithAbsMax)
+quanter("abs_max_observer")(AbsMaxObserver)
+quanter("moving_abs_max")(FakeQuanterWithAbsMaxObserver)
