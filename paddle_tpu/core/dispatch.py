@@ -59,7 +59,7 @@ def _record_static(name: str, fn: Callable, treedef, leaves):
     markers: List[Any] = []
     consts: List[Any] = []
     avals: List[Any] = []
-    prog = None
+    prog = None               # param-only ops fall back to the default
     from .tensor import Parameter
     for i, leaf in enumerate(leaves):
         if isinstance(leaf, Variable):
@@ -98,6 +98,9 @@ def _record_static(name: str, fn: Callable, treedef, leaves):
         a, k = jax.tree.unflatten(treedef, new_leaves)
         return fn(*a, **k)
 
+    if prog is None:
+        from ..static import default_main_program
+        prog = default_main_program()
     out_abs = jax.eval_shape(call, avals)
     out_flat, out_treedef = jax.tree.flatten(out_abs)
     return prog.record(name, call, markers, consts, out_flat, out_treedef,
@@ -150,9 +153,16 @@ def run_op(name: str, fn: Callable, args: tuple, kwargs: dict,
 
     leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor_leaf)
 
-    if _static_variable_cls is not None and any(
-            isinstance(l, _static_variable_cls) for l in leaves):
-        return _record_static(name, fn, treedef, leaves)
+    if _static_variable_cls is not None:
+        from .tensor import Parameter as _Param
+        # record ops touching a Variable OR a trainable Parameter: an op
+        # on params alone (e.g. wpe(arange(s)) — position embedding with
+        # a concrete index) must still enter the Program, else the param
+        # is constant-folded and silently excluded from static training
+        if any(isinstance(l, _static_variable_cls)
+               or (isinstance(l, _Param) and l.trainable)
+               for l in leaves):
+            return _record_static(name, fn, treedef, leaves)
 
     dyn_idx: List[int] = []
     dyn_tensors: List[Optional[Tensor]] = []

@@ -153,8 +153,68 @@ def _conv_transpose_impl(x, weight, bias, stride, padding, output_padding,
     return out
 
 
+def _resolve_output_size(x, weight, stride, padding, output_padding,
+                         dilation, output_size, data_format, n):
+    """Reference F.conv*_transpose ``output_size``: the transpose-conv
+    output length is ambiguous by up to stride-1; output_size picks one
+    by deriving the per-dim output_padding."""
+    if output_size is None:
+        return output_padding
+    st = _tupleize(stride, n)
+    di = _tupleize(dilation, n)
+    os_ = _tupleize(output_size, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    in_sp = x.shape[1:1 + n] if channel_last else x.shape[2:2 + n]
+    k_sp = weight.shape[2:]
+
+    pd = padding
+    if isinstance(pd, str):
+        up = pd.upper()
+        if up == "SAME":
+            # transpose-conv SAME: out = in * stride
+            pd = None
+            bases = [int(in_sp[i]) * st[i] for i in range(n)]
+        else:                          # VALID: zero pads
+            pd = [(0, 0)] * n
+    if pd is not None:
+        if isinstance(pd, (int, np.integer)):
+            pd = [(int(pd), int(pd))] * n
+        elif isinstance(pd, (list, tuple)) and len(pd) == n and all(
+                isinstance(p, (int, np.integer)) for p in pd):
+            pd = [(int(p), int(p)) for p in pd]
+        elif isinstance(pd, (list, tuple)) and len(pd) == 2 * n and all(
+                isinstance(p, (int, np.integer)) for p in pd):
+            pd = [(int(pd[2 * i]), int(pd[2 * i + 1]))
+                  for i in range(n)]
+        elif isinstance(pd, (list, tuple)) and len(pd) == n + 2:
+            # full-dim pair list incl. batch/channel: slice the SPATIAL
+            # entries per data_format
+            sp = pd[1:1 + n] if channel_last else pd[2:2 + n]
+            pd = [(int(p[0]), int(p[1])) for p in sp]
+        else:
+            pd = [(int(p[0]), int(p[1])) for p in pd]
+        bases = [
+            (int(in_sp[i]) - 1) * st[i] - pd[i][0] - pd[i][1]
+            + di[i] * (int(k_sp[i]) - 1) + 1
+            for i in range(n)]
+    out_pad = []
+    for i in range(n):
+        op = int(os_[i]) - bases[i]
+        if not 0 <= op < st[i]:
+            raise ValueError(
+                f"output_size[{i}]={os_[i]} unreachable (base "
+                f"{bases[i]}, stride {st[i]}: valid range "
+                f"[{bases[i]}, {bases[i] + st[i] - 1}])")
+        out_pad.append(op)
+    return tuple(out_pad)
+
+
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
-                     output_padding=0, dilation=1, groups=1, data_format="NCL"):
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCL", *, output_size=None):
+    output_padding = _resolve_output_size(
+        x, weight, stride, padding, output_padding, dilation, output_size,
+        data_format, 1)
     return run_op("conv1d_transpose", lambda x, w, b: _conv_transpose_impl(
         x, w, b, stride, padding, output_padding, dilation, groups,
         data_format, 1), (x, weight, bias), {})
@@ -162,7 +222,10 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
-                     data_format="NCHW"):
+                     data_format="NCHW", *, output_size=None):
+    output_padding = _resolve_output_size(
+        x, weight, stride, padding, output_padding, dilation, output_size,
+        data_format, 2)
     return run_op("conv2d_transpose", lambda x, w, b: _conv_transpose_impl(
         x, w, b, stride, padding, output_padding, dilation, groups,
         data_format, 2), (x, weight, bias), {})
@@ -170,7 +233,10 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
-                     data_format="NCDHW"):
+                     data_format="NCDHW", *, output_size=None):
+    output_padding = _resolve_output_size(
+        x, weight, stride, padding, output_padding, dilation, output_size,
+        data_format, 3)
     return run_op("conv3d_transpose", lambda x, w, b: _conv_transpose_impl(
         x, w, b, stride, padding, output_padding, dilation, groups,
         data_format, 3), (x, weight, bias), {})

@@ -15,10 +15,15 @@ from .profiler import (  # noqa: F401
     export_chrome_tracing, export_protobuf, load_profiler_result,
     make_scheduler, record_function,
 )
-from .statistics import SortedKeys, StatisticData, summary  # noqa: F401
+from .statistics import (  # noqa: F401
+    DeviceStatistics, SortedKeys, StatisticData, TracerEventType,
+    classify_event, merged_chrome_trace, overview_summary, summary,
+)
 
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
     "export_chrome_tracing", "make_scheduler", "record_function",
     "SortedKeys", "StatisticData", "summary", "load_profiler_result",
+    "TracerEventType", "classify_event", "DeviceStatistics",
+    "overview_summary", "merged_chrome_trace",
 ]

@@ -55,6 +55,10 @@ class QuantizedLinear(Layer):
             w = jnp.asarray(linear.weight._value, jnp.float32)   # [K, N]
             th = jnp.asarray(getattr(thresholds, "_value", thresholds),
                              jnp.float32).reshape(-1)
+            if th.size not in (1, w.shape[-1]):
+                # e.g. a group-wise [K/g, N] grid: the flat int8 deploy
+                # path can't consume it — fall back to raw absmax
+                return cls.from_linear(linear, weight_dtype)
             scale = jnp.maximum(jnp.broadcast_to(th, (w.shape[-1],)),
                                 1e-8) / 127.0
             wq = jnp.clip(jnp.round(w / scale), -127, 127).astype(

@@ -72,6 +72,8 @@ class PerChannelAbsMaxObserver(BaseObserver):
         return self._max
 
     def scales(self):
+        if self._max is None:          # never calibrated: no claim
+            return None
         return Tensor(jnp.asarray(np.maximum(self._max, 1e-9),
                                   jnp.float32))
 
@@ -154,5 +156,7 @@ class GroupWiseWeightObserver(BaseObserver):
         return self._max
 
     def scales(self):
+        if self._max is None:          # never calibrated: no claim
+            return None
         return Tensor(jnp.asarray(np.maximum(self._max, 1e-9),
                                   jnp.float32))

@@ -367,39 +367,83 @@ class Executor:
     def _run_train(self, program: Program, feed_vals, fetch_vars):
         opt = program._optimizer
         loss_var = program._loss
+        merge_k = int(getattr(program, "_grad_merge_k", 1))
+        merge_avg = bool(getattr(program, "_grad_merge_avg", True))
         key = (id(program), len(program.nodes), id(loss_var),
-               tuple(id(v) for v in fetch_vars))
+               tuple(id(v) for v in fetch_vars), merge_k, merge_avg)
         cached = self._cache.get(key)
         if cached is None:
             replay = program.build_fn([loss_var] + fetch_vars)
 
-            def step(param_vals, slots, t, lr, feeds):
+            def grads_of(param_vals, feeds):
                 def loss_fn(pv):
                     outs = replay(feeds, pv)
                     return outs[0], outs
-                grads, outs = jax.grad(loss_fn, has_aux=True)(param_vals)
+                return jax.grad(loss_fn, has_aux=True)(param_vals)
+
+            def step(param_vals, slots, t, lr, feeds):
+                grads, outs = grads_of(param_vals, feeds)
                 new_p, new_s = opt.apply_gradients(param_vals, grads,
                                                    slots, lr, t)
                 return outs, new_p, new_s
 
-            cached = jax.jit(step, donate_argnums=(0, 1))
+            def accum(param_vals, acc, feeds):
+                # gradient-merge pass: add this micro-step's grads into
+                # the persistent accumulators (reference
+                # auto_parallel_gradient_merge's @GradientMerge buffers)
+                grads, outs = grads_of(param_vals, feeds)
+                new_acc = jax.tree.map(jnp.add, acc, grads)
+                return outs, new_acc
+
+            def apply_merged(param_vals, acc, slots, t, lr):
+                if getattr(program, "_grad_merge_avg", True):
+                    acc = jax.tree.map(lambda g: g / merge_k, acc)
+                return opt.apply_gradients(param_vals, acc, slots, lr, t)
+
+            cached = {
+                "step": jax.jit(step, donate_argnums=(0, 1)),
+                "accum": jax.jit(accum, donate_argnums=(1,)),
+                "apply": jax.jit(apply_merged, donate_argnums=(0, 2)),
+            }
             self._cache[key] = cached
         st = self._train_state.get(id(program))
         if st is None:
             slots = {name: opt._init_slot_state(jnp.asarray(p._value))
                      for name, p in program.params.items()}
-            st = {"slots": slots, "t": 0}
+            st = {"slots": slots, "t": 0, "micro": 0, "acc": None}
             self._train_state[id(program)] = st
         param_vals = {name: jnp.asarray(p._value)
                       for name, p in program.params.items()}
-        st["t"] += 1
-        outs, new_p, new_s = cached(param_vals, st["slots"], st["t"],
-                                    float(opt.get_lr()), feed_vals)
-        st["slots"] = new_s
-        for name, p in program.params.items():
-            p._value = new_p[name]
-        if hasattr(opt, "_step_count"):
-            opt._step_count += 1
+        if merge_k <= 1:
+            st["t"] += 1
+            outs, new_p, new_s = cached["step"](
+                param_vals, st["slots"], st["t"], float(opt.get_lr()),
+                feed_vals)
+            st["slots"] = new_s
+            for name, p in program.params.items():
+                p._value = new_p[name]
+            if hasattr(opt, "_step_count"):
+                opt._step_count += 1
+            return outs[1:]
+        # ---- gradient-merge window ----
+        if st["acc"] is None:
+            st["acc"] = {n: jnp.zeros_like(v)
+                         for n, v in param_vals.items()}
+        outs, st["acc"] = cached["accum"](param_vals, st["acc"],
+                                          feed_vals)
+        st["micro"] += 1
+        if st["micro"] >= merge_k:
+            st["t"] += 1
+            new_p, new_s = cached["apply"](param_vals, st["acc"],
+                                           st["slots"], st["t"],
+                                           float(opt.get_lr()))
+            st["slots"] = new_s
+            st["acc"] = None
+            st["micro"] = 0
+            for name, p in program.params.items():
+                p._value = new_p[name]
+            if hasattr(opt, "_step_count"):
+                opt._step_count += 1
         return outs[1:]         # user fetches (loss itself if requested)
 
 
@@ -426,3 +470,5 @@ from .extras import (  # noqa: F401,E402
     serialize_program, set_ipu_shard, set_program_state, xpu_places,
 )
 from . import nn  # noqa: F401,E402
+from . import passes  # noqa: F401,E402
+from .passes import apply_amp_pass, apply_gradient_merge_pass  # noqa: F401,E402

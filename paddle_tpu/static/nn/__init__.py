@@ -261,12 +261,22 @@ def bilinear_tensor_product(x, y, size, act=None, name=None,
     return F.bilinear(x, y, w, b)
 
 
-def deform_conv2d(input, offset, mask, num_filters, filter_size, **kw):
-    from ...ops import api
-    if not hasattr(api, "deformable_conv"):
-        raise NotImplementedError(
-            "deform_conv2d: use paddle_tpu.vision.ops.deform_conv2d")
-    raise NotImplementedError("use paddle_tpu.vision.ops.deform_conv2d")
+def deform_conv2d(input, offset, mask, num_filters, filter_size,
+                  stride=1, padding=0, dilation=1, groups=1,
+                  deformable_groups=1, im2col_step=1, param_attr=None,
+                  bias_attr=None, name=None):
+    """Static deformable conv: creates the weight/bias params and runs
+    the vision.ops kernel (reference static.nn.deform_conv2d)."""
+    from ...vision.ops import deform_conv2d as _dcn
+    fs = (filter_size,) * 2 if isinstance(filter_size, int) else \
+        tuple(filter_size)
+    cin = int(input.shape[1])
+    w = _mk_param((num_filters, cin // groups) + fs)
+    b = None if bias_attr is False else _mk_param((num_filters,),
+                                                  is_bias=True)
+    return _dcn(input, offset, w, bias=b, stride=stride, padding=padding,
+                dilation=dilation, deformable_groups=deformable_groups,
+                groups=groups, mask=mask)
 
 
 def row_conv(input, future_context_size, param_attr=None, act=None):
@@ -302,10 +312,37 @@ def nce(input, label, num_total_classes, sample_weight=None,
 # equivalents (which ARE jit-compatible) for parity
 def cond(pred, true_fn=None, false_fn=None, name=None,
          return_names=None):
-    from ...ops import api
-    return api.cond(pred, true_fn, false_fn) if hasattr(api, "cond") \
-        else (true_fn() if bool(pred) else
-              (false_fn() if false_fn else None))
+    """Control-flow cond (reference static.nn.cond — NOT paddle.cond the
+    matrix condition number, whose name this used to collide with):
+    concrete predicates branch in Python; traced predicates lower to
+    ``lax.cond``; a record-mode Variable predicate records BOTH branches
+    and multiplexes with ``where`` (both branches' side effects run —
+    the dense analog of the reference's sub-block select)."""
+    from ... import static as _static
+    if isinstance(pred, _static.Variable):
+        import jax
+        t_out = true_fn()
+        f_out = false_fn() if false_fn is not None else None
+        if f_out is None:
+            return t_out
+        from ...ops import api as _api
+
+        def _sel(t, f):
+            nd = len(getattr(t, "shape", ()))
+            p = _api.reshape(_api.cast(pred, "bool"), [1] * nd) if nd \
+                else _api.cast(pred, "bool")
+            return _api.where(_api.broadcast_to(p, list(t.shape)), t, f)
+
+        if isinstance(t_out, (tuple, list)):
+            return type(t_out)(_sel(t, f) for t, f in zip(t_out, f_out))
+        return _sel(t_out, f_out)
+    from ...jit.dy2static import convert_to_bool
+    b = convert_to_bool(pred)
+    if isinstance(b, bool):
+        return true_fn() if b else (false_fn() if false_fn else None)
+    import jax
+    return jax.lax.cond(b, lambda _: true_fn(),
+                        lambda _: false_fn() if false_fn else None, None)
 
 
 def case(pred_fn_pairs, default=None, name=None):
