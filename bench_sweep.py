@@ -1,21 +1,36 @@
-"""Scratch perf sweep on the real chip (not committed as part of bench)."""
+"""Perf sweep matrix for the real chip (VERDICT r3 item 1a).
+
+Default matrix (no argv): GPT-125M and GPT-1.3B-width configs x remat
+on/off x Pallas-flash on/off, plus one autotuned flash point — each row
+printed as a JSON line so ``tools/tpu_probe.py``'s auto-seize archives
+the whole table the moment the chip returns.
+
+Explicit override: ``python bench_sweep.py "[{'batch':8,'seq':1024,...}]"``
+(the round-3 scratch form, kept for interactive use).
+"""
+
+import json
 import sys
 import time
 
 import numpy as np
 
 
-def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1):
+def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
+        flash=None, autotune=False):
     import jax
     from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
     from paddle_tpu import parallel as dist
 
+    if autotune:
+        from paddle_tpu.core.flags import FLAGS
+        FLAGS.use_autotune = True
     cfg = GPTConfig(vocab_size=V, hidden_size=h, num_layers=L,
                     num_heads=h // 64, max_position_embeddings=seq,
                     dtype="bfloat16")
     topo = dist.init_topology(devices=jax.devices()[:1])
     step_fn, init_fn = build_gpt_train_step(cfg, topo, num_microbatches=mbs,
-                                            remat=remat)
+                                            remat=remat, use_flash=flash)
     state = init_fn(0)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
@@ -32,17 +47,50 @@ def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1):
     tps = batch * seq * steps / dt
     f = 4 * h
     n_params = V * h + seq * h + L * (4 * h * h + 2 * h * f + 9 * h) + 2 * h
-    fpt = 6 * n_params + 12 * L * h * seq
+    fpt = 6 * n_params + 12 * L * h * seq      # MODEL flops (MFU basis,
+    # same definition as bench.py / the BASELINE 45% target)
     from bench import peak_flops_per_chip
-    mfu = tps * fpt / peak_flops_per_chip(jax.devices()[0])
-    print(f"batch={batch} seq={seq} remat={remat} h={h} L={L}: "
-          f"{tps:,.0f} tok/s  MFU={mfu:.3f}  loss={lv:.3f}", flush=True)
+    peak = peak_flops_per_chip(jax.devices()[0])
+    mfu = tps * fpt / peak
+    row = {
+        "batch": batch, "seq": seq, "h": h, "L": L, "remat": remat,
+        "flash": flash, "autotune": autotune,
+        "tokens_per_sec": round(tps, 1), "mfu": round(mfu, 4),
+        "loss": round(lv, 4), "device": str(jax.devices()[0]),
+    }
+    if remat:
+        # hardware FLOP utilization incl. the recompute forward —
+        # reported SEPARATELY so mfu stays comparable across rows
+        row["hfu"] = round(tps * (fpt * 4 // 3) / peak, 4)
+    print(json.dumps(row), flush=True)
+
+
+# GPT-125M (h768 L12) and a 1.3B-width single-chip config (h2048 L12 —
+# the full 24-layer 1.3B wants multi-chip; the 12-layer variant isolates
+# per-layer perf at the 1.3B width on one chip)
+DEFAULT_MATRIX = [
+    dict(batch=8, seq=1024, steps=10, remat=False, flash=False),
+    dict(batch=8, seq=1024, steps=10, remat=False, flash=True),
+    dict(batch=8, seq=1024, steps=10, remat=True, flash=True),
+    dict(batch=8, seq=1024, steps=10, remat=False, flash=True,
+         autotune=True),
+    dict(batch=4, seq=2048, steps=5, remat=True, flash=True,
+         h=2048, L=12, V=51200),
+    dict(batch=4, seq=2048, steps=5, remat=True, flash=False,
+         h=2048, L=12, V=51200),
+]
 
 
 if __name__ == "__main__":
-    import ast
-    for args in ast.literal_eval(sys.argv[1]):
+    if len(sys.argv) > 1:
+        import ast
+        matrix = ast.literal_eval(sys.argv[1])
+    else:
+        matrix = DEFAULT_MATRIX
+    for args in matrix:
         try:
             run(**args)
-        except Exception as e:
-            print(f"{args}: FAILED {type(e).__name__}: {e}", flush=True)
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            print(json.dumps({"args": {k: str(v) for k, v in args.items()},
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)

@@ -472,3 +472,5 @@ from .extras import (  # noqa: F401,E402
 from . import nn  # noqa: F401,E402
 from . import passes  # noqa: F401,E402
 from .passes import apply_amp_pass, apply_gradient_merge_pass  # noqa: F401,E402
+from . import pass_manager  # noqa: F401,E402
+from .pass_manager import PassManager, register_pass  # noqa: F401,E402

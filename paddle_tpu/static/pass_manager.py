@@ -1,0 +1,219 @@
+"""Program pass manager + pattern-rewrite engine.
+
+Reference: the PIR pass ecosystem — pass registry/manager
+(paddle/pir/include/pass/pass_manager.h, python/paddle/distributed/passes/
+pass_base.py PassManager) and the declarative rewrite rules (DRR,
+paddle/fluid/pir/drr/) that fuse op patterns in the IR.
+
+TPU-native shape: a pass is a callable ``(Program) -> Program`` over the
+RECORDED node list; the rewrite engine matches straight-line producer→
+consumer chains by op name and replaces them with one fused node.  The
+fused node keeps BOTH chains' output Variables (replay-time pruning drops
+dead ones), so downstream references and fetches stay valid without any
+use-def surgery.  XLA refuses nothing here — these rewrites exist for
+the cases where the op boundary itself carries semantics (AMP casting,
+gradient-merge windows, explicit fused kernels), exactly the passes the
+reference keeps OUTSIDE its compiler too.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["register_pass", "get_pass", "PassManager", "fuse_chain_pass",
+           "dead_code_elimination", "REGISTRY"]
+
+REGISTRY: Dict[str, Callable] = {}
+
+
+def register_pass(name: str):
+    """Register ``fn(program, **opts) -> program`` under ``name``
+    (reference pass_base.py register_pass)."""
+    def deco(fn):
+        REGISTRY[name] = fn
+        fn.pass_name = name
+        return fn
+    return deco
+
+
+def get_pass(name: str) -> Callable:
+    if name not in REGISTRY:
+        raise KeyError(f"unknown pass {name!r}; registered: "
+                       f"{sorted(REGISTRY)}")
+    return REGISTRY[name]
+
+
+class PassManager:
+    """Ordered pass pipeline (reference PassManager): passes are names
+    from the registry or raw callables; ``apply`` threads the program
+    through all of them."""
+
+    def __init__(self, passes: Sequence = (), opts: Optional[dict] = None):
+        self._passes: List[Callable] = []
+        self._opts = opts or {}
+        for p in passes:
+            self.add(p)
+
+    def add(self, p) -> "PassManager":
+        self._passes.append(get_pass(p) if isinstance(p, str) else p)
+        return self
+
+    @property
+    def names(self) -> List[str]:
+        return [getattr(p, "pass_name", getattr(p, "__name__", "?"))
+                for p in self._passes]
+
+    def apply(self, program):
+        consumed = set()
+        for p in self._passes:
+            name = getattr(p, "pass_name",
+                           getattr(p, "__name__", ""))
+            kwargs = self._opts.get(name, {})
+            if kwargs:
+                consumed.add(name)
+            program = p(program, **kwargs) or program
+        unknown = set(self._opts) - consumed - \
+            {n for n in self._opts if not self._opts[n]}
+        if unknown:
+            raise KeyError(f"PassManager opts for passes not in the "
+                           f"pipeline: {sorted(unknown)}")
+        return program
+
+
+# ---------------------------------------------------------------------------
+# the rewrite engine (DRR analog)
+# ---------------------------------------------------------------------------
+
+def fuse_chain_pass(program, pattern: Sequence[str],
+                    fused_name: Optional[str] = None):
+    """Fuse straight-line chains ``pattern[0] -> pattern[1] -> ...``
+    where each link's FIRST dynamic input is the previous node's first
+    output.  The fused node emits every chain output (replay pruning
+    drops the dead intermediates), composing the original calls — the
+    declarative-rewrite analog over recorded nodes."""
+    from . import _Node
+
+    nodes = program.nodes
+    fused_name = fused_name or "_".join(pattern)
+    i = 0
+    out_nodes: List = []
+    while i < len(nodes):
+        chain = _match_chain(nodes, i, pattern)
+        if chain is None:
+            out_nodes.append(nodes[i])
+            i += 1
+            continue
+        out_nodes.append(_build_fused(chain, fused_name))
+        i = chain[-1][0] + 1
+    program.nodes = out_nodes
+    return program
+
+
+def _match_chain(nodes, start: int, pattern: Sequence[str]):
+    """Match pattern anchored at nodes[start]; links must be CONSECUTIVE
+    recorded nodes (the recording is in execution order, so real chains
+    are adjacent) and each link's first Variable input must be the
+    previous link's first output."""
+    if nodes[start].name != pattern[0]:
+        return None
+    chain = [(start, nodes[start])]
+    for step, want in enumerate(pattern[1:], 1):
+        idx = start + step
+        if idx >= len(nodes):
+            return None
+        node = nodes[idx]
+        if node.name != want:
+            return None
+        prev_out = chain[-1][1].out_vars[0]
+        first_var = next((v for v in node.in_vars if v is not None), None)
+        if first_var is not prev_out:
+            return None
+        chain.append((idx, node))
+    return chain
+
+
+def _build_fused(chain, fused_name: str):
+    from . import _Node
+
+    nodes = [n for _, n in chain]
+    # the fused node's inputs: first node's inputs + every later node's
+    # inputs EXCEPT the chained intermediate
+    in_vars: List = list(nodes[0].in_vars)
+    const_args: List = list(nodes[0].const_args)
+    extra_slots: List[tuple] = []      # (node_idx, positions in its call)
+    for k, node in enumerate(nodes[1:], 1):
+        chained = chain[k - 1][1].out_vars[0]
+        positions = []
+        for pos, v in enumerate(node.in_vars):
+            if v is chained:
+                positions.append(None)          # EVERY occurrence wires
+                # to the previous link's output (add(m, m) is legal)
+            else:
+                positions.append(len(in_vars))
+                in_vars.append(v)
+        const_args.extend(node.const_args)
+        extra_slots.append((k, positions))
+    out_vars = [ov for n in nodes for ov in n.out_vars]
+    calls = [n.call for n in nodes]
+    n_in0 = len(nodes[0].in_vars)
+    import jax
+
+    def fused_call(dyn):
+        outs0 = calls[0](dyn[:n_in0])
+        flat = jax.tree.leaves(outs0)
+        all_outs = list(flat)
+        prev_first = flat[0]
+        for (k, positions) in extra_slots:
+            vals = [prev_first if p is None else dyn[p]
+                    for p in positions]
+            outs = calls[k](vals)
+            flat = jax.tree.leaves(outs)
+            all_outs.extend(flat)
+            prev_first = flat[0]
+        return tuple(all_outs)
+
+    return _Node(fused_name, fused_call, in_vars, const_args, out_vars,
+                 statics=[s for n in nodes for s in n.statics])
+
+
+@register_pass("dead_code_elimination")
+def dead_code_elimination(program, keep=()):
+    """Drop nodes whose outputs reach neither the loss nor ``keep``
+    (replay prunes at build_fn time anyway; this makes the PROGRAM
+    itself small — reference DCE pass).  Without any anchor (no loss,
+    no keep) the pass is a no-op: it can't know the fetch set."""
+    needed = {id(v) for v in keep}
+    if program._loss is not None:
+        needed.add(id(program._loss))
+    if not needed:
+        return program
+    live: List = []
+    for node in reversed(program.nodes):
+        if any(id(ov) in needed for ov in node.out_vars):
+            live.append(node)
+            for v in node.in_vars:
+                if v is not None:
+                    needed.add(id(v))
+    live.reverse()
+    program.nodes = live
+    return program
+
+
+@register_pass("fuse_matmul_add")
+def fuse_matmul_add(program):
+    """matmul + add -> one fused linear node (the fused_gemm_epilogue
+    pass analog; XLA fuses the math anyway — the pass keeps the op
+    BOUNDARY fused so per-op hooks/AMP see one linear)."""
+    return fuse_chain_pass(program, ("matmul", "add"), "linear")
+
+
+@register_pass("amp")
+def amp_pass(program, level: str = "O1", **kw):
+    from .passes import apply_amp_pass
+    return apply_amp_pass(program, level=level, **kw)
+
+
+@register_pass("gradient_merge")
+def gradient_merge_pass(program, k_steps: int = 1, avg: bool = True):
+    from .passes import apply_gradient_merge_pass
+    return apply_gradient_merge_pass(program, k_steps, avg)

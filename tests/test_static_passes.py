@@ -194,3 +194,101 @@ class TestStaticCondVariablePredicate:
             pt.disable_static()
         np.testing.assert_allclose(hi, [1, 1])
         np.testing.assert_allclose(lo, [0, 0])
+
+
+class TestPassManager:
+    """Pass registry/manager + DRR-style chain rewrite (reference
+    pass_base.py PassManager + pir/drr fusion rules)."""
+
+    def _matmul_add_prog(self):
+        pt.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            w = static.data("w", [8, 8], "float32")
+            b = static.data("b", [8], "float32")
+            h = pt.add(pt.matmul(x, w), b)
+            out = pt.sum(h)
+        pt.disable_static()
+        return main, h, out
+
+    def test_registry_and_names(self):
+        pm = static.PassManager(["fuse_matmul_add",
+                                 "dead_code_elimination"])
+        assert pm.names == ["fuse_matmul_add", "dead_code_elimination"]
+        with pytest.raises(KeyError):
+            static.PassManager(["not_a_pass"])
+
+    def test_fuse_matmul_add_preserves_results(self):
+        main, h, out = self._matmul_add_prog()
+        n0 = len(main.nodes)
+        static.PassManager(["fuse_matmul_add"]).apply(main)
+        assert len(main.nodes) == n0 - 1
+        assert any(n.name == "linear" for n in main.nodes)
+        exe = static.Executor()
+        rng = np.random.RandomState(0)
+        X, W, B = (rng.randn(4, 8).astype("f4"),
+                   rng.randn(8, 8).astype("f4"),
+                   rng.randn(8).astype("f4"))
+        hv, ov = exe.run(main, feed={"x": X, "w": W, "b": B},
+                         fetch_list=[h, out])
+        np.testing.assert_allclose(hv, X @ W + B, rtol=1e-5)
+
+    def test_custom_pass_registration(self):
+        from paddle_tpu.static.pass_manager import register_pass
+
+        @register_pass("test_count_nodes")
+        def count_pass(program):
+            program._node_count = len(program.nodes)
+            return program
+
+        main, _, _ = self._matmul_add_prog()
+        static.PassManager(["test_count_nodes"]).apply(main)
+        assert main._node_count == 3
+
+    def test_dce_requires_anchor(self):
+        main, h, out = self._matmul_add_prog()
+        n0 = len(main.nodes)
+        from paddle_tpu.static.pass_manager import dead_code_elimination
+        dead_code_elimination(main)          # no loss, no keep: no-op
+        assert len(main.nodes) == n0
+        dead_code_elimination(main, keep=[h])
+        assert len(main.nodes) == 2          # sum(out) dropped
+
+    def test_pipeline_with_amp(self):
+        main, h, out = self._matmul_add_prog()
+        pm = static.PassManager(["fuse_matmul_add", "amp"],
+                                opts={"amp": {"level": "O1"}})
+        pm.apply(main)
+        exe = static.Executor()
+        rng = np.random.RandomState(1)
+        hv, = exe.run(main, feed={"x": rng.randn(4, 8).astype("f4"),
+                                  "w": rng.randn(8, 8).astype("f4"),
+                                  "b": rng.randn(8).astype("f4")},
+                      fetch_list=[h])
+        assert str(hv.dtype) == "bfloat16"   # fused linear is white-listed
+
+    def test_fuse_handles_repeated_intermediate(self):
+        # review finding: add(m, m) must wire BOTH slots to the chained
+        # output, not create a self-dependency
+        pt.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x2", [4, 8], "float32")
+            w = static.data("w2", [8, 8], "float32")
+            m = pt.matmul(x, w)
+            h = pt.add(m, m)
+        pt.disable_static()
+        static.PassManager(["fuse_matmul_add"]).apply(main)
+        exe = static.Executor()
+        rng = np.random.RandomState(2)
+        X, W = rng.randn(4, 8).astype("f4"), rng.randn(8, 8).astype("f4")
+        hv, = exe.run(main, feed={"x2": X, "w2": W}, fetch_list=[h])
+        np.testing.assert_allclose(hv, 2 * (X @ W), rtol=1e-5)
+
+    def test_unknown_opts_rejected(self):
+        main, _, _ = self._matmul_add_prog()
+        pm = static.PassManager(["fuse_matmul_add"],
+                                opts={"not_in_pipeline": {"x": 1}})
+        with pytest.raises(KeyError):
+            pm.apply(main)
