@@ -31,6 +31,7 @@ correctness first, compiled speed where convertible).
 from __future__ import annotations
 
 import ast
+import copy
 import functools
 import inspect
 import linecache
@@ -347,6 +348,72 @@ def _has_escape(stmts) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# break/continue lowering (reference dy2static break_continue_transformer:
+# rewrite into boolean guard flags so the loop closure conversion applies)
+# ---------------------------------------------------------------------------
+
+def _ctl_kinds(stmts):
+    """(has_break, has_continue) bound to THIS loop level (not nested
+    loops / defs)."""
+    has_b = has_c = False
+
+    def walk(node):
+        nonlocal has_b, has_c
+        if isinstance(node, (ast.For, ast.While, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.Lambda,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, ast.Break):
+            has_b = True
+        elif isinstance(node, ast.Continue):
+            has_c = True
+        for c in ast.iter_child_nodes(node):
+            walk(c)
+
+    for s in stmts:
+        walk(s)
+    return has_b, has_c
+
+
+def _flag_assign(name: str, value: bool):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _lower_break_continue(stmts, brk: str, cont: str):
+    """Rewrite ``break``/``continue`` into flag assignments, wrapping the
+    statements after any flag-setting construct in a plain ``if not (brk
+    or cont):`` guard — which the NORMAL If conversion then lowers to
+    lax.cond when the flags are traced.  Descends only into If branches
+    (the shapes the reference transformer handles); anything else keeps
+    its raw break and the caller bails out via _has_escape."""
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.append(_flag_assign(brk, True))
+            return out                      # rest is unreachable
+        if isinstance(s, ast.Continue):
+            out.append(_flag_assign(cont, True))
+            return out
+        sb, sc = _ctl_kinds([s])
+        if (sb or sc) and isinstance(s, ast.If):
+            s.body = _lower_break_continue(s.body, brk, cont)
+            s.orelse = _lower_break_continue(s.orelse, brk, cont)
+            out.append(s)
+            rest = _lower_break_continue(list(stmts[i + 1:]), brk, cont)
+            if rest:
+                guard = ast.If(
+                    test=ast.UnaryOp(op=ast.Not(), operand=ast.BoolOp(
+                        op=ast.Or(),
+                        values=[_load(brk), _load(cont)])),
+                    body=rest, orelse=[])
+                out.append(guard)
+            return out
+        out.append(s)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # the transformer
 # ---------------------------------------------------------------------------
 
@@ -404,6 +471,53 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self._uid += 1
         return f"_pt_{kind}_{self._uid}"
 
+    def _flag_name(self, kind):
+        # loop-control flags must survive the `_pt_` carried-vars filter
+        # (they ARE loop-carried state, unlike generated function names)
+        self._uid += 1
+        return f"_d2s_{kind}_{self._uid}"
+
+    # -- break/continue lowering (shared by while/for) ---------------------
+    def _lower_loop_ctl(self, node, allow_break: bool):
+        """Lower break/continue in ``node.body`` to guard flags.  Returns
+        (prelude_stmts, saved) where ``saved`` holds the pre-lowering
+        body/test for :meth:`_restore_loop` — every bail-out path after
+        this MUST restore, or the mutated loop would reference flags
+        whose prelude was dropped."""
+        has_b, has_c = _ctl_kinds(node.body)
+        if not (has_b or has_c) or (has_b and not allow_break):
+            return [], None
+        saved = (copy.deepcopy(node.body),
+                 copy.deepcopy(node.test)
+                 if isinstance(node, ast.While) else None)
+        brk, cont = self._flag_name("brk"), self._flag_name("cont")
+        new_body = _lower_break_continue(node.body, brk, cont)
+        if _has_escape(new_body):
+            node.body = saved[0]     # unlowerable shape: nothing mutated
+            return [], None
+        # continue resets every iteration; break persists via the carry
+        node.body = [_flag_assign(cont, False)] + new_body
+        if has_b and isinstance(node, ast.While):
+            node.test = ast.BoolOp(
+                op=ast.And(),
+                values=[ast.UnaryOp(op=ast.Not(), operand=_load(brk)),
+                        node.test])
+        ast.fix_missing_locations(node)
+        return [_flag_assign(brk, False), _flag_assign(cont, False)], \
+            saved
+
+    def _restore_loop(self, node, saved):
+        """Undo :meth:`_lower_loop_ctl` on a bail-out path and convert
+        the restored (unlowered) children so nested constructs still
+        transform — the pre-lowering behavior."""
+        if saved is None:
+            return node
+        node.body = saved[0]
+        if saved[1] is not None:
+            node.test = saved[1]
+        self.generic_visit(node)
+        return node
+
     # -- if ----------------------------------------------------------------
     def visit_If(self, node: ast.If):
         self.generic_visit(node)
@@ -435,15 +549,17 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     # -- while -------------------------------------------------------------
     def visit_While(self, node: ast.While):
-        self.generic_visit(node)
         if node.orelse:
+            self.generic_visit(node)
             return node
+        prelude, saved = self._lower_loop_ctl(node, allow_break=True)
+        self.generic_visit(node)
         try:
             mod = sorted(_assigned_names(node.body))
         except _NoTransform:
-            return node
+            return self._restore_loop(node, saved)
         if _has_escape(node.body):
-            return node
+            return self._restore_loop(node, saved)
         mod = [m for m in mod if not m.startswith("_pt_")]
         cname, bname = self._name("cond"), self._name("body")
         cond_fn = ast.FunctionDef(
@@ -457,8 +573,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         call = _helper("convert_while", _load(cname), _load(bname),
                        ast.Tuple(elts=[_getvar_expr(m) for m in mod],
                                  ctx=ast.Load()))
-        stmts = [cond_fn, body_fn,
-                 _unpack_assign(mod, call) if mod else ast.Expr(value=call)]
+        stmts = prelude + [
+            cond_fn, body_fn,
+            _unpack_assign(mod, call) if mod else ast.Expr(value=call)]
         for s in stmts:
             ast.copy_location(s, node)
             ast.fix_missing_locations(s)
@@ -466,19 +583,24 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     # -- for ---------------------------------------------------------------
     def visit_For(self, node: ast.For):
-        self.generic_visit(node)
         if node.orelse:
+            self.generic_visit(node)
             return node
+        # continue-only lowers cleanly into per-iteration guards (a
+        # fori_loop still runs every trip); break needs early exit,
+        # which a fixed-trip-count fori can't express — graph-break
+        prelude, saved = self._lower_loop_ctl(node, allow_break=False)
+        self.generic_visit(node)
         try:
             mod_set = _assigned_names(node.body)
         except _NoTransform:
-            return node
+            return self._restore_loop(node, saved)
         if _has_escape(node.body):
-            return node
+            return self._restore_loop(node, saved)
         tgt: set = set()
         _target_names(node.target, tgt)
         if not tgt or not all(isinstance(n, str) for n in tgt):
-            return node
+            return self._restore_loop(node, saved)
         # a single-Name target is CARRIED so it stays bound after the
         # loop, as in plain Python (tuple targets stay body-local)
         carry_target = isinstance(node.target, ast.Name)
@@ -516,8 +638,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         else:
             call = _helper("convert_for_iter", it, _load(bname), vals,
                            tgt_arg)
-        stmts = [body_fn,
-                 _unpack_assign(mod, call) if mod else ast.Expr(value=call)]
+        stmts = prelude + [
+            body_fn,
+            _unpack_assign(mod, call) if mod else ast.Expr(value=call)]
         for s in stmts:
             ast.copy_location(s, node)
             ast.fix_missing_locations(s)

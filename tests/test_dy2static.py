@@ -310,3 +310,121 @@ class TestConverterMechanics:
             return a + b
 
         assert convert_control_flow(f) is f
+
+
+class TestBreakContinue:
+    """Reference break_continue_transformer semantics: break/continue in
+    tensor loops lower to guard flags (break -> loop-exit carry, continue
+    -> per-iteration guard); for-loops with break graph-break to eager."""
+
+    def test_while_break(self):
+        @jit.to_static
+        def f(x):
+            while x.sum() < 1000:
+                x = x * 2
+                if x.max() > 50:
+                    break
+            return x
+
+        assert float(f(ten([1.0])).sum()) == 64.0
+
+    def test_while_continue(self):
+        @jit.to_static
+        def f(x):
+            n = x * 0
+            s = x * 0
+            while n.sum() < 6:
+                n = n + 1
+                if (n.sum() % 2) > 0:
+                    continue
+                s = s + n
+            return s
+
+        assert float(f(ten([0.0])).sum()) == 12.0
+
+    def test_break_mid_body_skips_rest(self):
+        @jit.to_static
+        def f(x):
+            total = x * 0
+            while total.sum() < 100:
+                total = total + 10
+                if total.sum() >= 30:
+                    break
+                total = total + 1
+            return total
+
+        assert float(f(ten([0.0])).sum()) == 32.0
+
+    def test_for_continue(self):
+        @jit.to_static
+        def f(x, k):
+            acc = x
+            for i in range(k):
+                if (i % 2) == 0:
+                    continue
+                acc = acc + i
+            return acc
+
+        assert float(f(ten([0.0]), ten(6, "int32")).sum()) == 9.0
+
+    def test_for_break_falls_back(self):
+        # fixed-trip fori can't early-exit: graph-break, correct eagerly
+        @jit.to_static
+        def f(x, k):
+            acc = x
+            for i in range(k):
+                if i >= 2:
+                    break
+                acc = acc + 10
+            return acc
+
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            out = f(ten([0.0]), ten(5, "int32"))
+        assert float(out.sum()) == 20.0
+
+    def test_python_loop_break_untouched(self):
+        @jit.to_static
+        def f(x):
+            for i in range(10):
+                if i == 3:
+                    break
+                x = x + 1
+            return x
+
+        assert float(f(ten([0.0])).sum()) == 3.0
+
+    def test_unlowerable_after_break_restores(self):
+        # review finding: a `del` after lowering must RESTORE the loop
+        # (graph-break), not leave a half-lowered body referencing flags
+        @jit.to_static
+        def f(x):
+            while x.sum() < 10:
+                t = x * 2
+                if t.max() > 5:
+                    break
+                del t
+                x = x + 1
+            return x
+
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            out = f(ten([1.0]))
+        assert float(out.sum()) >= 1.0      # correct eager semantics
+
+    def test_unlowerable_for_continue_restores(self):
+        @jit.to_static
+        def f(x):
+            acc = x
+            for i in range(6):
+                t = acc * 2
+                if i % 2 == 0:
+                    continue
+                del t
+                acc = acc + i
+            return acc
+
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            out = f(ten([0.0]))
+        assert float(out.sum()) == 9.0
