@@ -1,6 +1,8 @@
 """``paddle_tpu.distributed.checkpoint`` namespace (reference
 python/paddle/distributed/checkpoint/)."""
 
-from ..parallel.checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from ..parallel.checkpoint import (  # noqa: F401
+    clear_async_save_task_queue, load_state_dict, save_state_dict)
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict",
+           "clear_async_save_task_queue"]

@@ -23,7 +23,77 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict",
+           "clear_async_save_task_queue"]
+
+# -- async save (reference distributed/checkpoint/save_state_dict.py
+#    async_save=True + async_save_queue / clear_async_save_task_queue) ----
+class _AsyncSaveTask:
+    """Background checkpoint writer: records its exception (surfaced by
+    :func:`clear_async_save_task_queue`) and remembers its target path
+    (saves to the same path serialize instead of racing)."""
+
+    def __init__(self, path: str, fn, args):
+        import threading
+        self.path = os.path.abspath(path)
+        self.exc: BaseException | None = None
+
+        def run():
+            try:
+                fn(*args)
+            except BaseException as e:  # noqa: BLE001 — surfaced on join
+                self.exc = e
+
+        # non-daemon: interpreter exit must not truncate a half-written
+        # shard file (atexit below also drains the queue)
+        self._thread = threading.Thread(target=run, daemon=False)
+
+    def start(self):
+        self._thread.start()
+
+    def join(self):
+        self._thread.join()
+        if self.exc is not None:
+            raise RuntimeError(
+                f"async checkpoint save to {self.path!r} failed"
+            ) from self.exc
+
+    def is_alive(self):
+        return self._thread.is_alive()
+
+
+_async_tasks: list = []
+
+
+def _drain_done() -> None:
+    done = [t for t in _async_tasks if not t.is_alive()]
+    _async_tasks[:] = [t for t in _async_tasks if t.is_alive()]
+    for t in done:
+        t.join()                       # raises if the write failed
+
+
+def _join_same_path(path: str) -> None:
+    """Serialize saves targeting one directory (reference semantics):
+    a pending write to the same path must finish before a new one
+    starts, or both would interleave into the same shard files."""
+    ap = os.path.abspath(path)
+    same = [t for t in _async_tasks if t.path == ap]
+    for t in same:
+        t.join()
+    _async_tasks[:] = [t for t in _async_tasks if t.path != ap]
+
+
+def clear_async_save_task_queue() -> None:
+    """Block until every pending async checkpoint write finishes; raises
+    if any write failed (reference clear_async_save_task_queue)."""
+    while _async_tasks:
+        t = _async_tasks.pop(0)
+        t.join()
+
+
+import atexit  # noqa: E402
+
+atexit.register(clear_async_save_task_queue)
 
 _META = "metadata.json"
 
@@ -54,14 +124,33 @@ def _index_to_offsets(index: Tuple[slice, ...], shape) -> List[List[int]]:
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
-                    process_group=None, coordinator_rank: int = 0) -> None:
+                    process_group=None, coordinator_rank: int = 0,
+                    async_save: bool = False) -> None:
     """Write each value's addressable shards + global metadata under
     ``path``.  Multi-process: every process writes its own shard file and
     its own metadata slice; process 0's metadata merge happens at load time
-    (all metadata_*.json files are read)."""
-    os.makedirs(path, exist_ok=True)
+    (all metadata_*.json files are read).
+
+    ``async_save=True`` (reference async checkpoint): device->host shard
+    copies happen NOW (so training can mutate the arrays immediately),
+    the disk writes on a background thread;
+    ``clear_async_save_task_queue()`` joins all pending writes."""
     rank = getattr(jax, "process_index", lambda: 0)()
-    flat = _flatten(state_dict)
+    meta, arrays = _snapshot(_flatten(state_dict), rank)
+    if async_save:
+        _drain_done()
+        _join_same_path(path)
+        t = _AsyncSaveTask(path, _write_snapshot,
+                           (path, rank, meta, arrays, coordinator_rank))
+        _async_tasks.append(t)
+        t.start()
+        return
+    _write_snapshot(path, rank, meta, arrays, coordinator_rank)
+
+
+def _snapshot(flat: Dict[str, Any], rank: int):
+    """Device->host copy of this process's addressable shards (the part
+    that must happen synchronously before training continues)."""
     arrays = {}
     meta: Dict[str, Any] = {"arrays": {}, "chunks": []}
     for key, val in flat.items():
@@ -88,6 +177,12 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
                 "key": key, "npz": f"shard_rank{rank}.npz",
                 "name": name, "offsets": offs,
             })
+    return meta, arrays
+
+
+def _write_snapshot(path: str, rank: int, meta, arrays,
+                    coordinator_rank: int) -> None:
+    os.makedirs(path, exist_ok=True)
     np.savez(os.path.join(path, f"shard_rank{rank}.npz"), **arrays)
     with open(os.path.join(path, f"metadata_rank{rank}.json"), "w") as f:
         json.dump(meta, f)

@@ -354,7 +354,14 @@ def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
         path = os.path.join(dir_name,
                             f"{name}_{int(time.time() * 1000)}.pb")
         with open(path, "wb") as f:
-            pickle.dump({"events": [e.__dict__ for e in prof._events]}, f)
+            # _HostEvent uses __slots__, so build the dict explicitly.
+            # Per-cycle semantics (same as _export_chrome): the pending
+            # cycle if one exists, else the archive — never both, or
+            # later cycles would re-dump earlier ones.
+            evs = prof._events or prof._all_events
+            pickle.dump({"events": [
+                {s: getattr(e, s) for s in e.__slots__}
+                for e in evs]}, f)
         return path
 
     return handler

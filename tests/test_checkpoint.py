@@ -75,3 +75,62 @@ def test_missing_key_raises(tmp_path):
     with pytest.raises(KeyError):
         ck.load_state_dict({"nope": pt.Tensor(jnp.zeros((2, 2)))},
                            str(tmp_path))
+
+
+class TestAsyncSave:
+    """Reference async checkpoint (save_state_dict.py async_save_queue):
+    shard copies synchronous, disk writes on a background thread."""
+
+    def test_async_save_round_trips(self, tmp_path):
+        import paddle_tpu as pt
+        import paddle_tpu.distributed.checkpoint as ckpt
+        import numpy as np
+        w = pt.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+        sd = {"w": w}
+        ckpt.save_state_dict(sd, str(tmp_path / "ck"), async_save=True)
+        # mutating AFTER the call must not affect the snapshot
+        w.set_value(np.zeros((3, 4), "float32"))
+        ckpt.clear_async_save_task_queue()
+        target = {"w": pt.zeros([3, 4])}
+        ckpt.load_state_dict(target, str(tmp_path / "ck"))
+        np.testing.assert_allclose(
+            np.asarray(target["w"]._value),
+            np.arange(12, dtype="float32").reshape(3, 4))
+
+    def test_queue_drains(self, tmp_path):
+        import paddle_tpu as pt
+        import paddle_tpu.distributed.checkpoint as ckpt
+        import numpy as np
+        for i in range(3):
+            ckpt.save_state_dict({"x": pt.ones([4])},
+                                 str(tmp_path / f"c{i}"), async_save=True)
+        ckpt.clear_async_save_task_queue()
+        from paddle_tpu.parallel.checkpoint import _async_tasks
+        assert _async_tasks == []
+        for i in range(3):
+            assert (tmp_path / f"c{i}" / "shard_rank0.npz").exists()
+
+    def test_failed_async_write_surfaces(self, tmp_path):
+        import paddle_tpu as pt
+        import paddle_tpu.distributed.checkpoint as ckpt
+        import pytest
+        bad = tmp_path / "f"
+        bad.write_text("")                 # a FILE where a dir is needed
+        ckpt.save_state_dict({"x": pt.ones([2])}, str(bad / "ck"),
+                             async_save=True)
+        with pytest.raises(RuntimeError):
+            ckpt.clear_async_save_task_queue()
+
+    def test_same_path_saves_serialize(self, tmp_path):
+        import numpy as np
+        import paddle_tpu as pt
+        import paddle_tpu.distributed.checkpoint as ckpt
+        p = str(tmp_path / "latest")
+        for i in range(4):                  # racing saves to one dir
+            ckpt.save_state_dict(
+                {"x": pt.to_tensor(np.full((4,), float(i), "float32"))},
+                p, async_save=True)
+        ckpt.clear_async_save_task_queue()
+        tgt = {"x": pt.zeros([4])}
+        ckpt.load_state_dict(tgt, p)
+        np.testing.assert_allclose(np.asarray(tgt["x"]._value), 3.0)

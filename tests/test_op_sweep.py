@@ -614,7 +614,11 @@ def test_sweep_coverage_ratchet():
     frac = len(covered) / len(ops)
     print(f"\nop sweep coverage: {len(covered)}/{len(ops)} "
           f"({frac:.1%}); uncovered: {sorted(uncovered)}")
-    assert frac >= 0.95, (frac, sorted(uncovered))
+    # round-4 ratchet: measured 97.1% — the ~15 ops the GENERIC probes
+    # can't drive (multi-output detection post-ops, file IO, DGC
+    # optimizer ops) have dedicated tests (test_detection_ops,
+    # test_review_fixes, test_meta_optimizers) or are mode toggles
+    assert frac >= 0.97, (frac, sorted(uncovered))
 
 
 def test_sweep_fp32_eager_vs_traced():
