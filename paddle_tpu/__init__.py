@@ -103,6 +103,13 @@ def in_static_mode():
 from . import models  # noqa: F401
 from . import inference  # noqa: F401
 from . import static  # noqa: F401
+from . import device  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import hub  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import onnx  # noqa: F401
+from . import version  # noqa: F401
+from . import callbacks  # noqa: F401
 from .core.string_tensor import StringTensor, to_string_tensor  # noqa: F401
 import jax.numpy as _jnp
 dtype = _jnp.dtype    # paddle.dtype: the dtype constructor/type alias

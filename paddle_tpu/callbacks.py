@@ -1,0 +1,11 @@
+"""paddle.callbacks parity (reference python/paddle/callbacks.py — a
+re-export of the hapi callback classes)."""
+
+from .hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    ReduceLROnPlateau, VisualDL, WandbCallback,
+)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+           "LRScheduler", "EarlyStopping", "ReduceLROnPlateau",
+           "WandbCallback"]

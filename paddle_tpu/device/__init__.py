@@ -1,0 +1,168 @@
+"""paddle.device parity (reference python/paddle/device/__init__.py).
+
+TPU-first mapping: device selection delegates to the framework's Place
+handling (core/device.py); streams/events collapse into XLA's async
+dispatch — ``synchronize`` blocks on all pending device work, a
+``Stream`` is an ordering no-op (XLA already executes one program
+stream per device), matching the SURVEY §2.4 collapse."""
+
+from __future__ import annotations
+
+from ..core.device import get_device, set_device  # noqa: F401
+
+__all__ = ["set_device", "get_device", "get_available_device",
+           "get_available_custom_device", "get_all_device_type",
+           "get_all_custom_device_type", "get_cudnn_version",
+           "is_compiled_with_cuda", "is_compiled_with_rocm",
+           "is_compiled_with_xpu", "is_compiled_with_ipu",
+           "is_compiled_with_cinn", "is_compiled_with_custom_device",
+           "is_compiled_with_distribute", "XPUPlace", "IPUPlace",
+           "Stream", "Event", "current_stream", "set_stream",
+           "stream_guard", "synchronize"]
+
+
+def get_available_device():
+    import jax
+    try:
+        return [f"{d.platform}:{d.id}" for d in jax.devices()]
+    except Exception:
+        return ["cpu:0"]
+
+
+def get_available_custom_device():
+    return []
+
+
+def get_all_device_type():
+    import jax
+    try:
+        return sorted({d.platform for d in jax.devices()})
+    except Exception:
+        return ["cpu"]
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_cudnn_version():
+    return None                    # no cuDNN in the TPU stack
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str = "") -> bool:
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    return True                    # jax.distributed is always available
+
+
+class XPUPlace:
+    def __init__(self, _id: int = 0):
+        self.id = _id
+
+
+class IPUPlace:
+    def __init__(self, _id: int = 0):
+        self.id = _id
+
+
+class Event:
+    """XLA orders work per device; an Event is a recorded sync point."""
+
+    def __init__(self, device=None, enable_timing: bool = False,
+                 blocking: bool = False, interprocess: bool = False):
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+class Stream:
+    """XLA executes one program stream per device; Stream is an
+    API-compatible ordering no-op."""
+
+    def __init__(self, device=None, priority: int = 2):
+        self.device = device
+
+    def wait_event(self, event: Event):
+        return None
+
+    def wait_stream(self, stream: "Stream"):
+        return None
+
+    def record_event(self, event: Event = None) -> Event:
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self) -> bool:
+        return True
+
+
+_current = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _current
+
+
+def set_stream(stream: Stream) -> Stream:
+    global _current
+    prev, _current = _current, stream
+    return prev
+
+
+class stream_guard:
+    def __init__(self, stream: Stream):
+        self.stream = stream
+
+    def __enter__(self):
+        self._prev = set_stream(self.stream)
+        return self.stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False
+
+
+def synchronize(device=None):
+    """Block until all pending device work completes (the reference's
+    device synchronize; here: fence via a tiny device round-trip —
+    jax has no global barrier, but a device_get orders after all
+    previously enqueued work on the default device)."""
+    import jax
+    import jax.numpy as jnp
+    try:
+        jax.device_get(jnp.zeros(()))
+    except Exception:
+        pass

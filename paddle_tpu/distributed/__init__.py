@@ -6,6 +6,19 @@ manager, and checkpoint save/load."""
 
 from ..parallel import *  # noqa: F401,F403
 from ..parallel import collective, fleet  # noqa: F401
+
+# make `import paddle_tpu.distributed.fleet` (and .fleet.utils) work as
+# MODULE paths (the reference ships distributed/fleet/ as a package;
+# ours lives in parallel.fleet — register aliases so reference-style
+# imports one level deep resolve too)
+import sys as _sys
+
+from ..parallel import fleet_utils as _fleet_utils
+
+fleet.utils = _fleet_utils
+_sys.modules[__name__ + ".fleet"] = fleet
+_sys.modules[__name__ + ".fleet.utils"] = _fleet_utils
+_sys.modules[__name__ + ".collective"] = collective
 from ..parallel.env import (  # noqa: F401
     get_rank, get_world_size, init_parallel_env,
 )

@@ -173,3 +173,177 @@ class LRSchedulerCallback(Callback):
         opt = getattr(self.model, "_optimizer", None)
         if opt is not None:
             opt._scheduler_step()
+
+
+LRScheduler = LRSchedulerCallback     # reference callbacks.LRScheduler
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer LR when a monitored metric plateaus
+    (reference callbacks.ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "min" if "loss" in monitor or "err" in monitor \
+                else "max"
+        self.mode = mode
+        self._best = None
+        self._wait = 0
+        self._cooldown_left = 0
+
+    def _better(self, cur) -> bool:
+        if self._best is None:
+            return True
+        if self.mode == "min":
+            return cur < self._best - self.min_delta
+        return cur > self._best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        try:
+            cur = float(cur[0] if isinstance(cur, (list, tuple))
+                        else cur)
+        except (TypeError, ValueError):
+            return
+        if self._cooldown_left > 0:
+            # in cooldown: track the best but never reduce (reference
+            # semantics — reductions are suppressed for `cooldown` epochs)
+            self._cooldown_left -= 1
+            self._wait = 0
+            if self._better(cur):
+                self._best = cur
+            return
+        if self._better(cur):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                old = float(opt.get_lr())
+                new = max(old * self.factor, self.min_lr)
+                if new < old:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {old:g} -> {new:g}")
+            self._cooldown_left = self.cooldown
+            self._wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logger (reference callbacks.VisualDL writes VisualDL
+    records).  The visualdl package isn't in this image, so scalars land
+    as JSON lines under ``log_dir`` — same call sites, greppable/
+    plottable output."""
+
+    def __init__(self, log_dir: str = "./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._f = None
+        self._step = 0
+
+    def _write(self, tag, logs, step):
+        if self._f is None:
+            import os
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._f = open(f"{self.log_dir}/scalars.jsonl", "a")
+        import json as _json
+        for k, v in (logs or {}).items():
+            try:
+                v = float(v[0] if isinstance(v, (list, tuple)) else v)
+            except (TypeError, ValueError):
+                continue
+            self._f.write(_json.dumps(
+                {"tag": f"{tag}/{k}", "step": step, "value": v}) + "\n")
+        self._f.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._write("train", logs, self._step)
+
+    def on_eval_end(self, logs=None):
+        # eval gets its own monotonic step so standalone evaluate() runs
+        # stay distinguishable; close after each eval (fit() keeps the
+        # handle open across batches and closes at on_train_end)
+        self._eval_i = getattr(self, "_eval_i", 0) + 1
+        self._write("eval", logs, self._step or self._eval_i)
+        if self._step == 0:
+            self._close()
+
+    def _close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def on_train_end(self, logs=None):
+        self._close()
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logger (reference callbacks.WandbCallback):
+    init/log/finish when the wandb package exists; without it (no
+    network egress here) construction raises the documented guard."""
+
+    def __init__(self, project=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb
+        except ImportError as e:
+            raise NotImplementedError(
+                "WandbCallback needs the `wandb` package (network "
+                "egress); use the VisualDL callback's local JSON-lines "
+                "scalars instead") from e
+        self._wandb = wandb
+        self._project = project
+        self._kwargs = kwargs
+        self._run = None
+
+    def _log(self, tag, logs, step=None):
+        if self._run is None:
+            return
+        payload = {}
+        for k, v in (logs or {}).items():
+            try:
+                payload[f"{tag}/{k}"] = float(
+                    v[0] if isinstance(v, (list, tuple)) else v)
+            except (TypeError, ValueError):
+                continue
+        if payload:
+            self._run.log(payload, step=step)
+
+    def on_train_begin(self, logs=None):
+        if self._run is None:
+            self._run = self._wandb.init(project=self._project,
+                                         **self._kwargs)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._log("train", logs, step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log("epoch", logs, epoch)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs)
+
+    def on_train_end(self, logs=None):
+        if self._run is not None:
+            self._run.finish()
+            self._run = None
+
+
+__all__ += ["LRScheduler", "ReduceLROnPlateau", "VisualDL",
+            "WandbCallback"]

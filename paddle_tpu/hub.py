@@ -1,0 +1,63 @@
+"""paddle.hub parity (reference python/paddle/hub.py): load/list/help
+over a ``hubconf.py`` in a LOCAL directory.  The github/gitee sources
+require network egress this environment doesn't have — they raise a
+documented guard; local-source repos (the reference's ``source='local'``)
+work fully."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_builtin_list = list
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source: str):
+    if source not in ("local",):
+        raise NotImplementedError(
+            f"hub source {source!r} needs network egress; use "
+            "source='local' with a checked-out repo directory "
+            "(reference hub.py github/gitee download path)")
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):
+    """Entrypoint names exposed by the repo's hubconf.py."""
+    _check_source(source if os.path.isdir(repo_dir) is False else "local")
+    mod = _load_hubconf(repo_dir)
+    return _builtin_list(
+        n for n in dir(mod)
+        if callable(getattr(mod, n)) and not n.startswith("_"))
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False):
+    _check_source(source if os.path.isdir(repo_dir) is False else "local")
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"hubconf has no entrypoint {model!r}")
+    return fn.__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    _check_source(source if os.path.isdir(repo_dir) is False else "local")
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"hubconf has no entrypoint {model!r}")
+    return fn(**kwargs)

@@ -1,0 +1,205 @@
+"""paddle.nn.utils parity (reference python/paddle/nn/utils/:
+weight_norm_hook.py, spectral_norm_hook.py, clip_grad_norm_.py,
+clip_grad_value_.py, transform_parameters.py).
+
+TPU-first shape: the reparametrizations are forward-PRE-hooks that
+recompute the derived weight from the decomposed Parameters each call —
+the tape differentiates straight through the recompute (the reference
+needs dedicated hook classes wrapping C++ norm ops)."""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Parameter, Tensor
+from ...ops import api as _api
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters"]
+
+
+# ---------------------------------------------------------------------------
+# grad clipping (in-place over .grad)
+# ---------------------------------------------------------------------------
+
+def clip_grad_norm_(parameters, max_norm: float, norm_type: float = 2.0,
+                    error_if_nonfinite: bool = False):
+    """Scale all grads so their GLOBAL norm is <= max_norm (reference
+    clip_grad_norm_.py); returns the pre-clip total norm."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.asarray(0.0, jnp.float32))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g._value)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._value.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"grad norm is non-finite ({float(total)}); gradients cannot "
+            "be clipped (error_if_nonfinite=True)")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for g in grads:
+        g._value = (g._value.astype(jnp.float32) * scale).astype(
+            g._value.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value: float):
+    """Clamp every grad element into [-clip_value, clip_value]
+    (reference clip_grad_value_.py)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    cv = abs(float(clip_value))
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -cv, cv)
+
+
+# ---------------------------------------------------------------------------
+# parameter <-> flat vector (reference transform_parameters.py)
+# ---------------------------------------------------------------------------
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    vals = [jnp.reshape(p._value, (-1,)) for p in parameters]
+    return Tensor(jnp.concatenate(vals) if vals
+                  else jnp.zeros((0,), jnp.float32))
+
+
+def vector_to_parameters(vec: Tensor, parameters, name=None) -> None:
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p._value.shape)) if p._value.shape else 1
+        chunk = v[off:off + n].reshape(p._value.shape).astype(
+            p._value.dtype)
+        p.set_value(chunk)
+        off += n
+
+
+# ---------------------------------------------------------------------------
+# weight norm (reference weight_norm_hook.py)
+# ---------------------------------------------------------------------------
+
+def _norm_except_dim(w, dim: int):
+    axes = tuple(i for i in range(len(w.shape)) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w.astype(jnp.float32)), axis=axes,
+                            keepdims=True))
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """Reparametrize ``layer.<name>`` as g * v/||v|| (Salimans & Kingma
+    2016): the optimizer sees ``<name>_g``/``<name>_v``; a pre-forward
+    hook recomputes the derived weight, and the tape differentiates
+    through the recompute."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = -1          # treat whole tensor as one group
+    wv = jnp.asarray(w._value)
+    if dim == -1:
+        g0 = jnp.sqrt(jnp.sum(jnp.square(wv)))
+        g_shape = ()
+    else:
+        g0 = _norm_except_dim(wv, dim)
+        g_shape = g0.shape
+    g = Parameter(g0.astype(wv.dtype), name=f"{w.name}_g")
+    v = Parameter(wv, name=f"{w.name}_v")
+    layer._parameters.pop(name, None)
+    object.__setattr__(layer, name, w)      # placeholder until first fwd
+    setattr(layer, f"{name}_g", g)
+    setattr(layer, f"{name}_v", v)
+
+    def _recompute(lyr, _inputs):
+        vv = getattr(lyr, f"{name}_v")
+        gg = getattr(lyr, f"{name}_g")
+        if dim == -1:
+            norm = _api.sqrt(_api.sum(_api.square(vv)))
+        else:
+            axes = [i for i in range(len(vv.shape)) if i != dim]
+            norm = _api.sqrt(_api.sum(_api.square(vv), axis=axes,
+                                      keepdim=True))
+        object.__setattr__(lyr, name, vv / norm * gg)
+        return None
+
+    helper = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (helper, dim)
+    _recompute(layer, ())                   # materialize immediately
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    """Bake the current derived weight back into a plain Parameter and
+    drop the g/v decomposition (reference remove_weight_norm)."""
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"weight_norm was not applied to {name!r}")
+    helper, dim = hooks.pop(name)
+    helper.remove()
+    derived = getattr(layer, name)
+    for suffix in ("_g", "_v"):
+        layer._parameters.pop(name + suffix, None)
+        if hasattr(layer, name + suffix):
+            object.__delattr__(layer, name + suffix)
+    setattr(layer, name, Parameter(jnp.asarray(derived._value)))
+    return layer
+
+
+# ---------------------------------------------------------------------------
+# spectral norm (reference spectral_norm_hook.py)
+# ---------------------------------------------------------------------------
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim=None):
+    """Divide the weight by its largest singular value, estimated by
+    power iteration on persistent u/v buffers (Miyato et al. 2018)."""
+    w = getattr(layer, name)
+    wv = jnp.asarray(w._value)
+    if dim is None:
+        dim = 0
+    mat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+    h, wdim = mat.shape
+    rng = np.random.default_rng(0)
+    u0 = rng.standard_normal(h).astype(np.float32)
+    v0 = rng.standard_normal(wdim).astype(np.float32)
+    orig = Parameter(wv, name=f"{w.name}_orig")
+    layer._parameters.pop(name, None)
+    setattr(layer, f"{name}_orig", orig)
+    layer.register_buffer(f"{name}_u",
+                          Tensor(u0 / (np.linalg.norm(u0) + eps)))
+    layer.register_buffer(f"{name}_v",
+                          Tensor(v0 / (np.linalg.norm(v0) + eps)))
+
+    def _recompute(lyr, _inputs):
+        ww = getattr(lyr, f"{name}_orig")
+        m = jnp.moveaxis(jnp.asarray(ww._value), dim, 0).reshape(h, -1)
+        u = jnp.asarray(getattr(lyr, f"{name}_u")._value)
+        v = jnp.asarray(getattr(lyr, f"{name}_v")._value)
+        for _ in range(max(1, n_power_iterations)):
+            v = m.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = m @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        getattr(lyr, f"{name}_u")._value = u
+        getattr(lyr, f"{name}_v")._value = v
+        sigma = u @ (m @ v)
+        # divide the LIVE Parameter so grads flow to weight_orig; sigma
+        # is a stop-gradient estimate (reference detaches u/v too)
+        object.__setattr__(lyr, name,
+                           ww / Tensor(jnp.maximum(sigma, eps)))
+        return None
+
+    helper = layer.register_forward_pre_hook(_recompute)
+    layer._spectral_norm_hooks = getattr(layer, "_spectral_norm_hooks",
+                                         {})
+    layer._spectral_norm_hooks[name] = helper
+    _recompute(layer, ())
+    return layer

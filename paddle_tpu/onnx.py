@@ -1,0 +1,18 @@
+"""paddle.onnx facade (reference python/paddle/onnx.py -> paddle2onnx).
+
+ONNX export is a SURVEY §7 non-goal for the TPU build (the serving
+format here is STABLEHLO via ``paddle.jit.save`` — portable across
+XLA backends the way ONNX is across GPU runtimes); ``export`` raises a
+guard pointing at the native path."""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "onnx export is out of scope on the TPU build (SURVEY §7): use "
+        "paddle.jit.save(layer, path, input_spec=...) — the STABLEHLO "
+        "artifact is the portable serving format here, loadable by "
+        "paddle.jit.load / paddle.inference.create_predictor")

@@ -1,0 +1,103 @@
+"""fleet.utils parity (reference distributed/fleet/utils/__init__.py:
+LocalFS, recompute, DistributedInfer, HDFSClient).
+
+Mounted as both ``paddle_tpu.parallel.fleet_utils`` and the reference
+import path ``paddle_tpu.distributed.fleet.utils``."""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List, Tuple
+
+from ..distributed.recompute import recompute  # noqa: F401
+
+__all__ = ["LocalFS", "recompute", "DistributedInfer", "HDFSClient"]
+
+
+class LocalFS:
+    """Local filesystem client (reference fleet/utils/fs.py LocalFS —
+    the FS abstraction checkpoint/elastic tooling writes through)."""
+
+    def ls_dir(self, fs_path: str) -> Tuple[List[str], List[str]]:
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for e in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, e))
+             else files).append(e)
+        return dirs, files
+
+    def mkdirs(self, fs_path: str) -> None:
+        os.makedirs(fs_path, exist_ok=True)
+
+    def is_exist(self, fs_path: str) -> bool:
+        return os.path.exists(fs_path)
+
+    def is_file(self, fs_path: str) -> bool:
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path: str) -> bool:
+        return os.path.isdir(fs_path)
+
+    def delete(self, fs_path: str) -> None:
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path, ignore_errors=True)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, fs_src_path: str, fs_dst_path: str) -> None:
+        os.replace(fs_src_path, fs_dst_path)
+
+    def touch(self, fs_path: str, exist_ok: bool = True) -> None:
+        if os.path.exists(fs_path) and not exist_ok:
+            raise FileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def upload(self, local_path: str, fs_path: str) -> None:
+        self._copy(local_path, fs_path)
+
+    def download(self, fs_path: str, local_path: str) -> None:
+        self._copy(fs_path, local_path)
+
+    def mv(self, src_path: str, dst_path: str, overwrite: bool = False,
+           test_exists: bool = False) -> None:
+        if not overwrite and os.path.exists(dst_path):
+            raise FileExistsError(dst_path)
+        os.replace(src_path, dst_path)
+
+    @staticmethod
+    def _copy(src: str, dst: str) -> None:
+        if os.path.isdir(src):
+            shutil.copytree(src, dst, dirs_exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+            shutil.copy2(src, dst)
+
+    def list_dirs(self, fs_path: str) -> List[str]:
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """Reference fleet/utils/fs.py HDFSClient: needs a hadoop
+    installation this image doesn't ship — the constructor raises the
+    documented guard (use LocalFS / a mounted GCS fuse path on TPU)."""
+
+    def __init__(self, hadoop_home: str = "", configs=None, **kw):
+        raise NotImplementedError(
+            "HDFSClient needs a hadoop runtime; on TPU pods use LocalFS "
+            "over a shared/FUSE-mounted path instead (SURVEY §7 stance "
+            "on vendor storage clients)")
+
+
+class DistributedInfer:
+    """Reference fleet/utils/__init__.py DistributedInfer — a parameter-
+    server-era inference splitter (SURVEY §7: PS is a non-goal).  The
+    TPU serving path is paddle.inference.create_predictor over a
+    STABLEHLO artifact."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        raise NotImplementedError(
+            "DistributedInfer is parameter-server-era (SURVEY §7 "
+            "non-goal); serve with paddle.inference.create_predictor "
+            "instead")

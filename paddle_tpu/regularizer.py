@@ -1,0 +1,40 @@
+"""paddle.regularizer parity (reference python/paddle/regularizer.py).
+
+The optimizers consume these via ``weight_decay=`` (Optimizer._wd_coeff
+reads ``_coeff``); ``__call__`` also computes the penalty directly for
+manual-loss use."""
+
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class _Decay:
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self) -> float:
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L2Decay(_Decay):
+    """coeff/2 * sum(w^2) — the decoupled form the optimizers apply as
+    weight decay (reference L2DecayRegularizer)."""
+
+    def __call__(self, param):
+        from .ops import api
+        return api.sum(api.square(param)) * (self._coeff * 0.5)
+
+
+class L1Decay(_Decay):
+    """coeff * sum(|w|) (reference L1DecayRegularizer).  NOTE: the
+    built-in optimizers apply ``weight_decay`` as L2-style decay; pass
+    an L1Decay penalty into the loss directly for true L1."""
+
+    def __call__(self, param):
+        from .ops import api
+        return api.sum(api.abs(param)) * self._coeff

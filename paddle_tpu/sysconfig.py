@@ -1,0 +1,19 @@
+"""paddle.sysconfig parity (reference python/paddle/sysconfig.py):
+include/lib dirs for the custom-op toolchain (utils.cpp_extension
+consumes these)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    return os.path.join(_ROOT, "native", "include")
+
+
+def get_lib() -> str:
+    return os.path.join(_ROOT, "native")

@@ -1,0 +1,53 @@
+"""paddle.version parity (reference generated python/paddle/version).
+
+The capability target is the reference snapshot's API line; the version
+numbers mirror that claim with a TPU-build local tag."""
+
+full_version = "3.0.0+tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+commit = "paddle-tpu"
+istaged = False
+with_pip_cuda_libraries = "OFF"
+
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+xpu_xccl_version = "False"
+xpu_xhpc_version = "False"
+tensorrt_version = "False"
+cinn_version = "False"
+
+__all__ = ["cuda", "cudnn", "nccl", "show", "xpu", "xpu_xccl", "xpu_xhpc"]
+
+
+def show() -> None:
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("tpu: True (jax/XLA backend)")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False
+
+
+def nccl():
+    return 0
+
+
+def xpu():
+    return False
+
+
+def xpu_xccl():
+    return False
+
+
+def xpu_xhpc():
+    return False
