@@ -716,6 +716,14 @@ def _convert(fn: Callable) -> Callable:
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
     fdef.decorator_list = []        # don't re-apply @to_static etc.
+    # default-arg EXPRESSIONS evaluate at def time in the exec namespace,
+    # where names from the original enclosing scope (e.g. `_args=args` in
+    # a loop-local closure) don't exist.  Neutralize them — the real
+    # default VALUES are restored from fn.__defaults__ after the exec.
+    fdef.args.defaults = [ast.Constant(value=None)
+                          for _ in fdef.args.defaults]
+    fdef.args.kw_defaults = [None if d is None else ast.Constant(value=None)
+                             for d in fdef.args.kw_defaults]
 
     # transform the BODY statements (visit(fdef) itself would hit the
     # don't-descend-into-nested-defs guard)

@@ -51,6 +51,12 @@ def ref_all(relpath):
     ("quantization/__init__.py", "paddle_tpu.quantization"),
     ("autograd/__init__.py", "paddle_tpu.autograd"),
     ("nn/initializer/__init__.py", "paddle_tpu.nn.initializer"),
+    ("nn/utils/__init__.py", "paddle_tpu.nn.utils"),
+    ("device/__init__.py", "paddle_tpu.device"),
+    ("regularizer.py", "paddle_tpu.regularizer"),
+    ("hub.py", "paddle_tpu.hub"),
+    ("sysconfig.py", "paddle_tpu.sysconfig"),
+    ("callbacks.py", "paddle_tpu.callbacks"),
 ])
 def test_namespace_parity_100pct(relpath, modname):
     import importlib

@@ -428,3 +428,32 @@ class TestBreakContinue:
             warnings.simplefilter("always")
             out = f(ten([0.0]))
         assert float(out.sum()) == 9.0
+
+
+class TestClosureDefaults:
+    def test_loop_local_closure_defaults_survive_conversion(self):
+        # slow-lane regression: default-arg EXPRESSIONS referencing
+        # enclosing loop variables must not re-evaluate in the exec
+        # namespace at conversion time
+        payload = [np.ones((2, 2), "float32")]
+        pos = [0]
+
+        def traced_fn(*ts, _args=payload, _tpos=pos):
+            full = list(_args)
+            for i, t in zip(_tpos, ts):
+                full[i] = t
+            return pt.zeros_like(full[0])
+
+        out = jit.to_static(traced_fn)(ten(payload[0]))
+        np.testing.assert_allclose(_n(out), 0)
+
+    def test_defaults_still_work_when_omitted(self):
+        def f(x, scale=3.0):
+            if x.sum() > 0:
+                y = x * scale
+            else:
+                y = x
+            return y
+
+        g = jit.to_static(f)
+        np.testing.assert_allclose(_n(g(ten([2.0]))), [6.0])
