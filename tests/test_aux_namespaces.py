@@ -210,3 +210,29 @@ class TestReviewFixesR4Aux:
         rows = [json.loads(l) for l in
                 (tmp_path / "scalars.jsonl").read_text().splitlines()]
         assert [r["step"] for r in rows] == [1, 2]   # distinguishable
+
+
+class TestCallbacksInModelFit:
+    def test_fit_with_plateau_and_visualdl(self, tmp_path):
+        import paddle_tpu.callbacks as C
+        from paddle_tpu.io import TensorDataset
+        pt.seed(0)
+        net = nn.Linear(4, 2)
+        model = pt.Model(net)
+        model.prepare(
+            pt.optimizer.SGD(learning_rate=0.5,
+                             parameters=net.parameters()),
+            pt.nn.CrossEntropyLoss())
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((32, 4)).astype("float32")
+        Y = rng.integers(0, 2, (32, 1)).astype("int64")
+        vdl = C.VisualDL(log_dir=str(tmp_path))
+        plateau = C.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                      patience=1, verbose=0)
+        model.fit(TensorDataset([X, Y]), batch_size=8, epochs=3,
+                  verbose=0, callbacks=[vdl, plateau])
+        assert (tmp_path / "scalars.jsonl").exists()
+        import json
+        rows = [json.loads(l) for l in
+                (tmp_path / "scalars.jsonl").read_text().splitlines()]
+        assert any(r["tag"] == "train/loss" for r in rows)

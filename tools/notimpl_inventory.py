@@ -88,7 +88,9 @@ def scan():
                                     kind = "abstract"
                                 elif msg and ("out of scope" in msg
                                               or "no closed" in msg.lower()
+                                              or "non-goal" in msg
                                               or "use " in msg
+                                              or "serve with" in msg
                                               or "expressed as" in msg
                                               or "see " in msg
                                               or "implement " in msg):
