@@ -116,6 +116,12 @@ def getvar(thunk: Callable[[], Any]):
         return UNDEF
 
 
+def seed_if_undef(value, default):
+    """``value`` unless it's the UNDEF sentinel (loop-target pre-seed:
+    a previously bound name must keep its value)."""
+    return default if isinstance(value, UndefinedVar) else value
+
+
 # ---------------------------------------------------------------------------
 # runtime helpers (the converted code calls these)
 # ---------------------------------------------------------------------------
@@ -251,6 +257,18 @@ class _NoTransform(Exception):
     """Raised by analysis when a construct can't be converted soundly; the
     enclosing statement is left as-is (trace failure later -> eager
     fallback in StaticFunction)."""
+
+
+def _range_args(it, max_args: int):
+    """The args of a plain ``range(...)`` call (no keywords/starred, at
+    most ``max_args``), or None when ``it`` isn't that shape — the ONE
+    predicate both for-conversion paths share."""
+    if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+            and it.func.id == "range" and not it.keywords
+            and 1 <= len(it.args) <= max_args
+            and not any(isinstance(a, ast.Starred) for a in it.args)):
+        return it.args
+    return None
 
 
 def _target_names(t: ast.AST, out: set) -> None:
@@ -582,13 +600,68 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return stmts
 
     # -- for ---------------------------------------------------------------
+    def _range_for_to_while(self, node: ast.For):
+        """``for t in range(stop)`` / ``range(start, stop)`` containing a
+        ``break``: rewrite as an index WHILE loop (whose break lowering
+        joins the loop condition) — a fixed-trip fori can't early-exit.
+        Returns replacement statements or None when the shape doesn't
+        apply (explicit step, tuple target, non-range iter)."""
+        rargs = _range_args(node.iter, max_args=2)
+        if rargs is None or not isinstance(node.target, ast.Name):
+            return None
+        start = rargs[0] if len(rargs) == 2 else ast.Constant(value=0)
+        stop = rargs[1] if len(rargs) == 2 else rargs[0]
+        # the range-arg EXPRESSIONS never pass through generic_visit on
+        # this path: convert their own tensor bool-ops etc. here
+        start = self.visit(start)
+        stop = self.visit(stop)
+        cur, stop_n = self._flag_name("it"), self._flag_name("stop")
+        tgt_name = node.target.id
+        init = [
+            ast.Assign(targets=[_store(cur)], value=start),
+            ast.Assign(targets=[_store(stop_n)], value=stop),
+            # pre-seed the target so the while carry has a stable pytree
+            # — but ONLY when currently unbound (a previously bound name
+            # keeps its value through a 0-trip loop, like plain Python)
+            ast.Assign(targets=[ast.Name(id=tgt_name, ctx=ast.Store())],
+                       value=_helper("seed_if_undef",
+                                     _getvar_expr(tgt_name),
+                                     _load(cur))),
+        ]
+        # increment BEFORE the user body: a lowered `continue` guards the
+        # statements after it, and must never skip the index advance
+        body = ([ast.Assign(targets=[ast.Name(id=node.target.id,
+                                              ctx=ast.Store())],
+                            value=_load(cur)),
+                 ast.AugAssign(target=_store(cur), op=ast.Add(),
+                               value=ast.Constant(value=1))]
+                + list(node.body))
+        loop = ast.While(
+            test=ast.Compare(left=_load(cur), ops=[ast.Lt()],
+                             comparators=[_load(stop_n)]),
+            body=body, orelse=[])
+        for s in init + [loop]:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        converted = self.visit_While(loop)
+        out = init + (converted if isinstance(converted, list)
+                      else [converted])
+        for s in out:
+            ast.fix_missing_locations(s)
+        return out
+
     def visit_For(self, node: ast.For):
         if node.orelse:
             self.generic_visit(node)
             return node
+        has_b, _has_c = _ctl_kinds(node.body)
+        if has_b:
+            rewritten = self._range_for_to_while(node)
+            if rewritten is not None:
+                return rewritten
         # continue-only lowers cleanly into per-iteration guards (a
-        # fori_loop still runs every trip); break needs early exit,
-        # which a fixed-trip-count fori can't express — graph-break
+        # fori_loop still runs every trip); break over a non-range iter
+        # can't early-exit a fixed-trip fori — graph-break
         prelude, saved = self._lower_loop_ctl(node, allow_break=False)
         self.generic_visit(node)
         try:
@@ -627,17 +700,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         vals = ast.Tuple(elts=[_getvar_expr(m) for m in mod],
                          ctx=ast.Load())
         tgt_arg = ast.Constant(value=target_idx)
-        it = node.iter
-        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
-                and it.func.id == "range" and not it.keywords
-                and 1 <= len(it.args) <= 3
-                and not any(isinstance(a, ast.Starred) for a in it.args)):
+        rargs = _range_args(node.iter, max_args=3)
+        if rargs is not None:
             call = _helper("convert_for_range",
-                           ast.Tuple(elts=it.args, ctx=ast.Load()),
+                           ast.Tuple(elts=rargs, ctx=ast.Load()),
                            _load(bname), vals, tgt_arg)
         else:
-            call = _helper("convert_for_iter", it, _load(bname), vals,
-                           tgt_arg)
+            call = _helper("convert_for_iter", node.iter, _load(bname),
+                           vals, tgt_arg)
         stmts = prelude + [
             body_fn,
             _unpack_assign(mod, call) if mod else ast.Expr(value=call)]

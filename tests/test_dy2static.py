@@ -367,8 +367,9 @@ class TestBreakContinue:
 
         assert float(f(ten([0.0]), ten(6, "int32")).sum()) == 9.0
 
-    def test_for_break_falls_back(self):
-        # fixed-trip fori can't early-exit: graph-break, correct eagerly
+    def test_for_range_break_compiles(self):
+        # range-for with break rewrites to an index WHILE whose break
+        # lowering joins the loop condition — no graph break
         @jit.to_static
         def f(x, k):
             acc = x
@@ -378,10 +379,54 @@ class TestBreakContinue:
                 acc = acc + 10
             return acc
 
-        with warnings.catch_warnings(record=True):
+        with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
             out = f(ten([0.0]), ten(5, "int32"))
+        assert not any("graph break" in str(x.message) for x in w)
         assert float(out.sum()) == 20.0
+
+    def test_for_range_break_and_continue_mixed(self):
+        @jit.to_static
+        def f(x, n):
+            s = x
+            for i in range(n):
+                if (i % 2) == 0:
+                    continue
+                s = s + i
+                if s.sum() > 6:
+                    break
+            return s
+
+        assert float(f(ten([0.0]), ten(100, "int32")).sum()) == 9.0
+
+    def test_for_range_two_arg_break(self):
+        @jit.to_static
+        def f(x, a, b):
+            s = x
+            for i in range(a, b):
+                s = s + i
+                if s.sum() > 12:
+                    break
+            return s
+
+        out = f(ten([0.0]), ten(3, "int32"), ten(100, "int32"))
+        assert float(out.sum()) == 18.0
+
+    def test_for_iter_break_falls_back(self):
+        # break over a TENSOR iterable still graph-breaks (no index form)
+        @jit.to_static
+        def f(m):
+            acc = m[0] * 0
+            for row in m:
+                acc = acc + row
+                if acc.sum() > 3:
+                    break
+            return acc
+
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            out = f(ten([[1.0], [2.0], [3.0], [4.0]]))
+        assert float(out.sum()) == 6.0
 
     def test_python_loop_break_untouched(self):
         @jit.to_static
@@ -457,3 +502,17 @@ class TestClosureDefaults:
 
         g = jit.to_static(f)
         np.testing.assert_allclose(_n(g(ten([2.0]))), [6.0])
+
+    def test_prebound_target_survives_zero_trip_break_loop(self):
+        # review finding: `i = 7; for i in range(0): ...` must keep i==7
+        @jit.to_static
+        def f(x, n):
+            i = 7
+            for i in range(n):
+                x = x + 1
+                if x.sum() > 100:
+                    break
+            return x * i
+
+        out = f(ten([1.0]), ten(0, "int32"))
+        assert float(out.sum()) == 7.0
