@@ -30,6 +30,10 @@ from .sequence_parallel import (  # noqa: F401
     gather_op, mark_as_sequence_parallel_parameter, reduce_scatter_op,
     register_sequence_parallel_allreduce_hooks, scatter_op,
 )
+from . import overlap  # noqa: F401
+from .overlap import (  # noqa: F401
+    all_gather_matmul, matmul_all_reduce, matmul_reduce_scatter,
+)
 from .sharding import ShardingStage, group_sharded_parallel  # noqa: F401
 from .topology import HybridTopology, get_topology, init_topology, set_topology  # noqa: F401
 from .pipeline import LayerDesc, PipelineLayer, SharedLayerDesc, spmd_pipeline  # noqa: F401

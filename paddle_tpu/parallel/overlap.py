@@ -1,0 +1,126 @@
+"""Collective-matmul overlap (ring-decomposed TP/SP linears).
+
+Reference anchors: the all-gather-overlap path of
+``ColumnSequenceParallelLinear`` (python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py:255 — splits the all-gather into chunked
+broadcasts overlapped with the gemm) and the comm/compute overlap that
+``fused_linear_param_grad_add`` (phi/kernels/fusion/gpu/
+fused_linear_param_grad_add_kernel.cu) exists to serve.
+
+TPU-native design: instead of issuing one big ``all_gather`` (or
+``psum``/``reduce_scatter``) *around* a matmul, decompose the pair into a
+ring of ``lax.ppermute`` steps interleaved with per-chunk matmuls.  On
+TPU, collective-permute is an async ICI operation (start/done pairs in
+HLO), so XLA's latency-hiding scheduler overlaps every hop with the
+matmul of the chunk already on-chip — the classic "collective matmul"
+(Wang et al., "Overlap communication with dependent computation via
+decomposition", ASPLOS'23; the same recipe the scaling-book derives for
+Megatron linears).  Peak benefit: weight-stationary TP linears whose
+gather/scatter time is comparable to their gemm time.
+
+Everything here is manual-SPMD: call INSIDE ``shard_map`` with
+``axis_name`` manual, same convention as parallel/manual.py.  All
+functions are differentiable (ppermute/dynamic-slice autodiff; the
+transpose of a ring is the reverse ring), so they drop into existing
+training steps — ``test_overlap.py`` asserts fwd+bwd equivalence against
+the un-decomposed collectives on an 8-device virtual mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .topology import MP_AXIS
+
+__all__ = ["all_gather_matmul", "matmul_reduce_scatter",
+           "matmul_all_reduce"]
+
+
+def _ring_perm(n, reverse=False):
+    if reverse:
+        return [(i, (i - 1) % n) for i in range(n)]
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def all_gather_matmul(x_shard, w, axis_name: str = MP_AXIS, axis: int = 1):
+    """``all_gather(x_shard, axis) @ w`` as a ppermute ring.
+
+    ``x_shard``: local sequence shard (…, s_local, K) sharded on ``axis``
+    over ``axis_name``; ``w``: (K, N_local) — any local weight (column
+    shard for SP column-linear).  Returns the full-sequence product
+    (…, s_local * n, N_local), bit-identical (up to fp reassociation) to
+    gathering first.
+
+    Ring schedule: at step t the chip multiplies the chunk that
+    originated on rank (i + t) mod n while its ppermute of the buffer to
+    rank i-1 is in flight; XLA overlaps the two because the matmul does
+    not depend on the permute result.
+    """
+    n = lax.axis_size(axis_name)
+    i = lax.axis_index(axis_name)
+    s_local = x_shard.shape[axis]
+
+    out_shape = list(x_shard.shape[:-1]) + [w.shape[-1]]
+    out_shape[axis] = s_local * n
+    y = jnp.zeros(out_shape, dtype=jnp.result_type(x_shard.dtype, w.dtype))
+
+    def body(t, carry):
+        y, buf = carry
+        src = (i + t) % n                     # chunk origin of current buf
+        chunk = buf @ w
+        y = lax.dynamic_update_slice_in_dim(y, chunk.astype(y.dtype),
+                                            src * s_local, axis)
+        # send buf around the ring so next step holds rank (i+t+1)'s chunk
+        buf = lax.ppermute(buf, axis_name, _ring_perm(n, reverse=True))
+        return y, buf
+
+    y, _ = lax.fori_loop(0, n, body, (y, x_shard))
+    return y
+
+
+def matmul_reduce_scatter(x, w, axis_name: str = MP_AXIS, axis: int = 1):
+    """``reduce_scatter(x @ w, axis)`` as a ppermute ring.
+
+    ``x``: full-sequence local input (…, S, K_local); ``w``: (K_local, N)
+    row shard.  Each rank's partial product is reduce-scattered along
+    ``axis`` so rank i returns chunk i of the sum, shape (…, S/n, N).
+
+    The accumulator destined for rank j starts at rank j+1 and travels
+    the +1 ring for n-1 hops, each receiving rank adding its OWN partial
+    of chunk j — and critically, computing that partial's matmul while
+    the previous hop is in flight.
+    """
+    n = lax.axis_size(axis_name)
+    i = lax.axis_index(axis_name)
+    S = x.shape[axis]
+    if S % n:
+        raise ValueError(f"matmul_reduce_scatter: dim {axis} ({S}) not "
+                         f"divisible by {axis_name} size {n}")
+    s_local = S // n
+
+    def part(c):
+        """matmul of sequence chunk c only (keeps each step's gemm 1/n)."""
+        xc = lax.dynamic_slice_in_dim(x, c * s_local, s_local, axis)
+        return xc @ w
+
+    acc = part((i - 1) % n)
+
+    def body(t, acc):
+        acc = lax.ppermute(acc, axis_name, _ring_perm(n))
+        return acc + part((i - 1 - t) % n)
+
+    return lax.fori_loop(1, n, body, acc)
+
+
+def matmul_all_reduce(x, w, axis_name: str = MP_AXIS, axis: int = 1):
+    """``psum(x @ w)`` via ring reduce-scatter + all-gather.
+
+    Only the reduce-scatter half rides the overlapped ring; the trailing
+    ``all_gather`` is issued after the chunked gemms finish, so its
+    latency is NOT hidden behind compute.  Prefer keeping the activation
+    sequence-sharded (plain ``matmul_reduce_scatter``) when the consumer
+    allows it — that is the SP design point."""
+    y_shard = matmul_reduce_scatter(x, w, axis_name, axis)
+    return lax.all_gather(y_shard, axis_name, axis=axis, tiled=True)

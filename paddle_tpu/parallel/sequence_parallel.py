@@ -153,13 +153,21 @@ class ColumnSequenceParallelLinear:
     shard_map.
     """
 
-    def __init__(self, weight, bias=None, axis_name: str = MP_AXIS):
+    def __init__(self, weight, bias=None, axis_name: str = MP_AXIS,
+                 overlap: bool = False):
         self.weight = weight
         self.bias = bias
         self.axis_name = axis_name
+        self.overlap = overlap
 
     def __call__(self, x):
-        y = all_gather_op(x, self.axis_name) @ self.weight
+        if self.overlap:
+            # ring-decomposed gather+gemm (reference :255 overlap path);
+            # see parallel/overlap.py
+            from .overlap import all_gather_matmul
+            y = all_gather_matmul(x, self.weight, self.axis_name)
+        else:
+            y = all_gather_op(x, self.axis_name) @ self.weight
         if self.bias is not None:
             y = y + self.bias
         return y
@@ -172,13 +180,19 @@ class RowSequenceParallelLinear:
     its gradient is partial over mp — mark it (reference :562 handles this
     with mark_as_sequence_parallel_parameter on the bias)."""
 
-    def __init__(self, weight, bias=None, axis_name: str = MP_AXIS):
+    def __init__(self, weight, bias=None, axis_name: str = MP_AXIS,
+                 overlap: bool = False):
         self.weight = weight
         self.bias = bias
         self.axis_name = axis_name
+        self.overlap = overlap
 
     def __call__(self, x):
-        y = reduce_scatter_op(x @ self.weight, self.axis_name)
+        if self.overlap:
+            from .overlap import matmul_reduce_scatter
+            y = matmul_reduce_scatter(x, self.weight, self.axis_name)
+        else:
+            y = reduce_scatter_op(x @ self.weight, self.axis_name)
         if self.bias is not None:
             y = y + self.bias
         return y
