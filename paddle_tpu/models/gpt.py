@@ -235,7 +235,8 @@ def block_param_specs(cfg: GPTConfig, pipeline: bool) -> Dict[str, P]:
 def block_apply(params: Dict[str, jax.Array], x: jax.Array,
                 cfg: GPTConfig, attn_fn=None,
                 mp_axis: Optional[str] = None,
-                sequence_parallel: bool = False) -> jax.Array:
+                sequence_parallel: bool = False,
+                tp_overlap: bool = False) -> jax.Array:
     """One transformer block, pure jnp (used stacked under lax.scan).
 
     ``attn_fn(q, k, v) -> out`` (all [b, s, heads_local, head_dim])
@@ -253,7 +254,13 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
     row outputs reduce-scatter it back (parallel/sequence_parallel.py,
     reference sequence_parallel_utils.py:427/562).  LayerNorms and biases
     then act on the shard, so their grads are partial over mp (see
-    build_hybrid_train_step's mp_reduce_block_leaves)."""
+    build_hybrid_train_step's mp_reduce_block_leaves).
+
+    ``tp_overlap`` (with sequence_parallel): decompose each seq
+    all-gather + column matmul and row matmul + reduce-scatter into a
+    ppermute ring (parallel/overlap.py) so XLA hides the ICI hops behind
+    the chunked gemms — the reference's sequence_parallel_utils.py:255
+    overlap path, TPU-native."""
     b = x.shape[0]
 
     def ln(v, w, bia):
@@ -279,10 +286,15 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
             return fwd_psum(z, mp_axis)
         return z
 
+    from ..parallel.overlap import sp_matmul_helpers
+    col_mm, row_mm = sp_matmul_helpers(mp_axis, sequence_parallel,
+                                       tp_overlap, col_in, row_out)
+
     res = x
-    y = col_in(ln(x, params["ln1_w"], params["ln1_b"]))
-    s = y.shape[1]   # full (gathered) seq length under SP
-    qkv = y @ params["qkv_w"] + params["qkv_b"]
+    (qkv,) = col_mm(ln(x, params["ln1_w"], params["ln1_b"]),
+                    params["qkv_w"])
+    qkv = qkv + params["qkv_b"]
+    s = qkv.shape[1]   # full (gathered) seq length under SP
     qkv = qkv.reshape(b, s, -1, 3 * cfg.head_dim)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     if attn_fn is not None:
@@ -296,11 +308,11 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
         probs = jax.nn.softmax(logits, -1).astype(x.dtype)
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         attn = attn.reshape(b, s, attn.shape[2] * attn.shape[3])
-    x = res + row_out(attn @ params["proj_w"]) + params["proj_b"]
+    x = res + row_mm(attn, params["proj_w"]) + params["proj_b"]
     res = x
-    y = col_in(ln(x, params["ln2_w"], params["ln2_b"]))
-    y = jax.nn.gelu(y @ params["fc1_w"] + params["fc1_b"], approximate=True)
-    return res + row_out(y @ params["fc2_w"]) + params["fc2_b"]
+    (y,) = col_mm(ln(x, params["ln2_w"], params["ln2_b"]), params["fc1_w"])
+    y = jax.nn.gelu(y + params["fc1_b"], approximate=True)
+    return res + row_mm(y, params["fc2_w"]) + params["fc2_b"]
 
 
 def stack_block_params(cfg: GPTConfig, key, num_stages: int
@@ -324,7 +336,8 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                          num_model_chunks: int = 1,
                          sharding_stage: int = 2,
                          offload_optimizer: bool = False,
-                         sequence_parallel: bool = False):
+                         sequence_parallel: bool = False,
+                         tp_overlap: bool = False):
     """Compile a full hybrid-parallel GPT training step: dp×mp×pp×sharding×sep.
 
     Fully-MANUAL SPMD: one ``shard_map`` over ALL five mesh axes.  Tensor
@@ -364,6 +377,13 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                 raise ValueError(f"{name}={val} not divisible by mp={mp}")
     if cp_mode not in (None, "ring", "ulysses"):
         raise ValueError(f"unknown cp_mode {cp_mode!r}")
+    if tp_overlap and not (sequence_parallel and mp > 1):
+        # the ring decomposes the SP gather/scatter around each matmul;
+        # plain-TP psum has no correct autodiff ring yet (the fwd_psum
+        # custom-VJP convention would double-count) — fail loudly rather
+        # than silently not overlapping
+        raise ValueError("tp_overlap=True requires sequence_parallel=True "
+                         "and mp>1")
     if sep > 1 and cp_mode is None:
         cp_mode = "ring"
     if cp_mode == "ulysses" and (cfg.num_heads // mp) % sep != 0:
@@ -440,7 +460,7 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
     def block_fn(layer_params, x, ctx):
         del ctx
         return block_apply(layer_params, x, cfg, cp_attn, mp_axis=MP_AXIS,
-                           sequence_parallel=sp)
+                           sequence_parallel=sp, tp_overlap=tp_overlap)
 
     def head_nll_fn(params, x, labels):
         if sp:   # head/loss run on the full (replicated) sequence

@@ -306,7 +306,8 @@ def block_param_specs(cfg: LlamaConfig, pipeline: bool) -> Dict[str, P]:
 def block_apply(params: Dict[str, jax.Array], x: jax.Array,
                 cfg: LlamaConfig, cos, sin, attn_fn=None,
                 mp_axis: Optional[str] = None,
-                sequence_parallel: bool = False) -> jax.Array:
+                sequence_parallel: bool = False,
+                tp_overlap: bool = False) -> jax.Array:
     """One Llama block, pure jnp (stacked under lax.scan).
 
     ``mp_axis``: Megatron-style manual tensor parallelism — params are the
@@ -316,7 +317,12 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
 
     ``sequence_parallel``: Megatron-SP — x's seq dim is sharded over mp;
     all-gather before column matmuls, reduce-scatter after row matmuls
-    (parallel/sequence_parallel.py)."""
+    (parallel/sequence_parallel.py).
+
+    ``tp_overlap`` (with sequence_parallel): ring-decompose each
+    gather+matmul / matmul+reduce-scatter pair (parallel/overlap.py);
+    sibling column weights (q/k/v, gate/up) are concatenated so each
+    gather rides ONE ring regardless of how many matmuls consume it."""
     b = x.shape[0]
 
     def rms(v, w):
@@ -335,12 +341,17 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
     else:
         col_in = row_out = lambda y: y
 
+    from ..parallel.overlap import sp_matmul_helpers
+    col_mm, row_mm = sp_matmul_helpers(mp_axis, sequence_parallel,
+                                       tp_overlap, col_in, row_out)
+
     res = x
-    y = col_in(rms(x, params["ln1_w"]))
-    s = y.shape[1]   # full (gathered) seq length under SP
-    q = (y @ params["q_w"]).reshape(b, s, -1, cfg.head_dim)
-    k = (y @ params["k_w"]).reshape(b, s, -1, cfg.head_dim)
-    v = (y @ params["v_w"]).reshape(b, s, -1, cfg.head_dim)
+    qh, kh, vh = col_mm(rms(x, params["ln1_w"]),
+                        params["q_w"], params["k_w"], params["v_w"])
+    s = qh.shape[1]   # full (gathered) seq length under SP
+    q = qh.reshape(b, s, -1, cfg.head_dim)
+    k = kh.reshape(b, s, -1, cfg.head_dim)
+    v = vh.reshape(b, s, -1, cfg.head_dim)
     q, k = apply_rope(q, k, cos, sin)
     if attn_fn is not None:
         # GQA is native in every attn_fn path (Pallas flash kernel, ring,
@@ -349,11 +360,12 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
     else:
         attn = _gqa_attention(q, k, v, causal=True)
     attn = attn.reshape(b, s, attn.shape[2] * attn.shape[3])
-    x = res + row_out(attn @ params["o_w"])
+    x = res + row_mm(attn, params["o_w"])
     res = x
-    y = col_in(rms(x, params["ln2_w"]))
-    y = jax.nn.silu(y @ params["gate_w"]) * (y @ params["up_w"])
-    return res + row_out(y @ params["down_w"])
+    g, u = col_mm(rms(x, params["ln2_w"]),
+                  params["gate_w"], params["up_w"])
+    y = jax.nn.silu(g) * u
+    return res + row_mm(y, params["down_w"])
 
 
 def stack_block_params(cfg: LlamaConfig, key, num_stages: int
@@ -376,7 +388,8 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
                            sharding_stage: int = 2,
                            num_model_chunks: int = 1,
                            offload_optimizer: bool = False,
-                           sequence_parallel: bool = False):
+                           sequence_parallel: bool = False,
+                           tp_overlap: bool = False):
     """Compiled hybrid dp×mp×pp×sharding×sep Llama train step.
 
     Fully-manual SPMD via parallel/manual.py:build_hybrid_train_step
@@ -404,6 +417,9 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
                 raise ValueError(f"{name}={val} not divisible by mp={mp}")
     if cp_mode not in (None, "ring", "ulysses"):
         raise ValueError(f"unknown cp_mode {cp_mode!r}")
+    if tp_overlap and not (sequence_parallel and mp > 1):
+        raise ValueError("tp_overlap=True requires sequence_parallel=True "
+                         "and mp>1")
     if sep > 1 and cp_mode is None:
         cp_mode = "ring"
     if cp_mode == "ulysses" and (cfg.num_heads // mp) % sep != 0:
@@ -482,7 +498,8 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
     def block_fn(layer_params, x, ctx):
         lcos, lsin = ctx
         return block_apply(layer_params, x, cfg, lcos, lsin, cp_attn,
-                           mp_axis=MP_AXIS, sequence_parallel=sp)
+                           mp_axis=MP_AXIS, sequence_parallel=sp,
+                           tp_overlap=tp_overlap)
 
     def head_nll_fn(params, x, labels):
         if sp:

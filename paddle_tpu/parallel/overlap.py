@@ -35,7 +35,7 @@ from jax import lax
 from .topology import MP_AXIS
 
 __all__ = ["all_gather_matmul", "matmul_reduce_scatter",
-           "matmul_all_reduce"]
+           "matmul_all_reduce", "sp_matmul_helpers"]
 
 
 def _ring_perm(n, reverse=False):
@@ -66,18 +66,25 @@ def all_gather_matmul(x_shard, w, axis_name: str = MP_AXIS, axis: int = 1):
     out_shape[axis] = s_local * n
     y = jnp.zeros(out_shape, dtype=jnp.result_type(x_shard.dtype, w.dtype))
 
-    def body(t, carry):
-        y, buf = carry
+    def write(y, buf, t):
         src = (i + t) % n                     # chunk origin of current buf
         chunk = buf @ w
-        y = lax.dynamic_update_slice_in_dim(y, chunk.astype(y.dtype),
-                                            src * s_local, axis)
-        # send buf around the ring so next step holds rank (i+t+1)'s chunk
+        return lax.dynamic_update_slice_in_dim(y, chunk.astype(y.dtype),
+                                               src * s_local, axis)
+
+    def body(t, carry):
+        y, buf = carry
+        y = write(y, buf, t)
+        # send buf around the ring so next step holds rank (i+t+1)'s chunk;
+        # the permute shares no deps with the matmul, so the scheduler
+        # starts it first and hides the hop behind the gemm
         buf = lax.ppermute(buf, axis_name, _ring_perm(n, reverse=True))
         return y, buf
 
-    y, _ = lax.fori_loop(0, n, body, (y, x_shard))
-    return y
+    # n-1 hops total: the final chunk's matmul runs outside the loop so no
+    # dead permute executes on the last iteration
+    y, buf = lax.fori_loop(0, n - 1, body, (y, x_shard))
+    return write(y, buf, n - 1)
 
 
 def matmul_reduce_scatter(x, w, axis_name: str = MP_AXIS, axis: int = 1):
@@ -112,6 +119,43 @@ def matmul_reduce_scatter(x, w, axis_name: str = MP_AXIS, axis: int = 1):
         return acc + part((i - 1 - t) % n)
 
     return lax.fori_loop(1, n, body, acc)
+
+
+def sp_matmul_helpers(mp_axis, sequence_parallel: bool, tp_overlap: bool,
+                      col_in, row_out):
+    """Build the (col_mm, row_mm) pair a Megatron-style block uses for its
+    column/row matmuls, ring-decomposed when ``tp_overlap`` applies.
+
+    ``col_in(y)``/``row_out(z)`` are the model's un-decomposed fallbacks
+    (mp_copy / all_gather_op before columns; fwd_psum / reduce_scatter_op
+    after rows).  ``col_mm(y, *ws)`` always returns a tuple, one product
+    per weight; sibling column weights (q/k/v, gate/up) share ONE ring by
+    concatenation.  Shared by models/gpt.py and models/llama.py so ring
+    dispatch lives in exactly one place.
+    """
+    ring = mp_axis is not None and sequence_parallel and tp_overlap
+
+    def col_mm(y, *ws):
+        if ring:
+            w = jnp.concatenate(ws, axis=1) if len(ws) > 1 else ws[0]
+            out = all_gather_matmul(y, w, mp_axis)
+            if len(ws) == 1:
+                return (out,)
+            splits = []
+            off = 0
+            for w_ in ws[:-1]:
+                off += w_.shape[1]
+                splits.append(off)
+            return tuple(jnp.split(out, splits, axis=-1))
+        yg = col_in(y)
+        return tuple(yg @ w_ for w_ in ws)
+
+    def row_mm(z, w):
+        if ring:
+            return matmul_reduce_scatter(z, w, mp_axis)
+        return row_out(z @ w)
+
+    return col_mm, row_mm
 
 
 def matmul_all_reduce(x, w, axis_name: str = MP_AXIS, axis: int = 1):

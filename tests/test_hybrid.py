@@ -24,7 +24,7 @@ def reset_topology():
 def _losses(dp=1, mp=1, pp=1, sep=1, sharding=1, steps=3,
             num_microbatches=None, batch=4, seq=32, schedule="1f1b",
             layers=2, sequence_parallel=False, sharding_stage=2,
-            num_model_chunks=1, return_state=False):
+            num_model_chunks=1, return_state=False, tp_overlap=False):
     topo = dist.init_topology(dp=dp, mp=mp, pp=pp, sep=sep,
                               sharding=sharding)
     cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=layers,
@@ -34,7 +34,7 @@ def _losses(dp=1, mp=1, pp=1, sep=1, sharding=1, steps=3,
     step_fn, init_fn = build_gpt_train_step(
         cfg, topo, num_microbatches=num_microbatches, schedule=schedule,
         sharding_stage=sharding_stage, num_model_chunks=num_model_chunks,
-        sequence_parallel=sequence_parallel)
+        sequence_parallel=sequence_parallel, tp_overlap=tp_overlap)
     state = init_fn(0)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
@@ -188,12 +188,27 @@ def test_megatron_sp_matches_single_device(axes):
     np.testing.assert_allclose(got, _base(), rtol=2e-4, atol=1e-5)
 
 
-def test_llama_sp_matches_single_device():
+@pytest.mark.parametrize("axes", [
+    dict(mp=2,),
+    dict(mp=4,),
+    dict(mp=2, pp=2),
+])
+def test_megatron_sp_tp_overlap_matches_single_device(axes):
+    """SP with the collective-matmul ring (tp_overlap=True,
+    parallel/overlap.py): the gather/scatter-decomposed matmuls must
+    reproduce the same training trajectory as dense single-device."""
+    got = _losses(sequence_parallel=True, tp_overlap=True, **axes)
+    np.testing.assert_allclose(got, _base(), rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("tp_overlap", [False, True])
+def test_llama_sp_matches_single_device(tp_overlap):
     from paddle_tpu.models.llama import llama_tiny, build_llama_train_step
     topo = dist.init_topology(mp=2, sep=2)
     cfg = llama_tiny()
     step_fn, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1,
-                                              sequence_parallel=True)
+                                              sequence_parallel=True,
+                                              tp_overlap=tp_overlap)
     state = init_fn(0)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (4, 32)).astype(np.int64)

@@ -1,0 +1,445 @@
+"""Numeric correctness vs numpy/scipy references — round-4 expansion of
+tests/test_op_numeric.py (VERDICT r3 weak #5): pins VALUES for the op
+tail beyond the original ~105 — special functions, cumulative ops,
+bitwise, reductions incl. nan-variants, manipulation, linalg, fft,
+activations, and tuple-output ops (topk/unique/slogdet/frexp/...)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+rng = np.random.default_rng(1234)
+A = rng.standard_normal((3, 4)).astype("float32")
+B = rng.standard_normal((3, 4)).astype("float32")
+P = (rng.random((3, 4)).astype("float32") + 0.1)        # positive
+U = (rng.random((3, 4)).astype("float32") * 1.8 - 0.9)  # in (-0.9, 0.9)
+Q = (rng.random((3, 4)).astype("float32") * 0.6 + 0.2)  # in (0.2, 0.8)
+SQ = rng.standard_normal((4, 4)).astype("float32")
+PSD = (SQ @ SQ.T + 4 * np.eye(4)).astype("float32")     # pos-def
+M1 = rng.standard_normal((3, 5)).astype("float32")
+M2 = rng.standard_normal((5, 2)).astype("float32")
+V = rng.standard_normal((5,)).astype("float32")
+W = rng.standard_normal((5,)).astype("float32")
+V3 = rng.standard_normal((3,)).astype("float32")
+W3 = rng.standard_normal((3,)).astype("float32")
+I32 = rng.integers(1, 10, (3, 4)).astype("int32")
+J32 = rng.integers(1, 10, (3, 4)).astype("int32")
+NANA = A.copy(); NANA[0, 1] = np.nan; NANA[2, 3] = np.nan
+CPLX = (A + 1j * B).astype("complex64")
+IDX0 = np.array([2, 0, 1], dtype="int64")
+IDX_COL = rng.integers(0, 4, (3, 4)).astype("int64")
+UF = rng.standard_normal((3, 6)).astype("float32")
+
+
+def T(x):
+    return pt.to_tensor(x)
+
+
+def _sp(name, *args):
+    import scipy.special as sp
+    return getattr(sp, name)(*args).astype(np.float32)
+
+
+CASES = {
+    # -- special / elementwise --------------------------------------------
+    "neg": (lambda: pt.neg(T(A)), lambda: -A),
+    "sgn": (lambda: pt.sgn(T(A)), lambda: np.sign(A)),
+    "acosh": (lambda: pt.acosh(T(P + 1)), lambda: np.arccosh(P + 1)),
+    "frac": (lambda: pt.frac(T(A * 3)),
+             lambda: A * 3 - np.trunc(A * 3)),
+    "scale": (lambda: pt.scale(T(A), scale=2.0, bias=1.0),
+              lambda: 2.0 * A + 1.0),
+    "erfinv": (lambda: pt.erfinv(T(U)), lambda: _sp("erfinv", U)),
+    "lgamma": (lambda: pt.lgamma(T(P)), lambda: _sp("gammaln", P)),
+    "gammaln": (lambda: pt.gammaln(T(P)), lambda: _sp("gammaln", P)),
+    "digamma": (lambda: pt.digamma(T(P)), lambda: _sp("psi", P)),
+    "polygamma": (lambda: pt.polygamma(T(P), 1),
+                  lambda: _sp("polygamma", 1, P)),
+    "i0": (lambda: pt.i0(T(U)), lambda: _sp("i0", U)),
+    "i0e": (lambda: pt.i0e(T(U)), lambda: _sp("i0e", U)),
+    "i1": (lambda: pt.i1(T(U)), lambda: _sp("i1", U)),
+    "i1e": (lambda: pt.i1e(T(U)), lambda: _sp("i1e", U)),
+    "logit": (lambda: pt.logit(T(Q)), lambda: np.log(Q / (1 - Q))),
+    "logaddexp": (lambda: pt.logaddexp(T(A), T(B)),
+                  lambda: np.logaddexp(A, B)),
+    "heaviside": (lambda: pt.heaviside(T(A), T(B)),
+                  lambda: np.heaviside(A, B).astype(np.float32)),
+    "nan_to_num": (lambda: pt.nan_to_num(T(NANA), nan=0.5),
+                   lambda: np.nan_to_num(NANA, nan=0.5)),
+    "deg2rad": (lambda: pt.deg2rad(T(A * 90)), lambda: np.deg2rad(A * 90)),
+    "rad2deg": (lambda: pt.rad2deg(T(A)), lambda: np.rad2deg(A)),
+    "angle": (lambda: pt.angle(T(CPLX)), lambda: np.angle(CPLX)),
+    "conj": (lambda: pt.conj(T(CPLX)), lambda: np.conj(CPLX)),
+    "real": (lambda: pt.real(T(CPLX)), lambda: np.real(CPLX)),
+    "imag": (lambda: pt.imag(T(CPLX)), lambda: np.imag(CPLX)),
+    "gcd": (lambda: pt.gcd(T(I32), T(J32)), lambda: np.gcd(I32, J32)),
+    "lcm": (lambda: pt.lcm(T(I32), T(J32)), lambda: np.lcm(I32, J32)),
+    "copysign": (lambda: pt.copysign(T(A), T(B)),
+                 lambda: np.copysign(A, B)),
+    "nextafter": (lambda: pt.nextafter(T(A), T(B)),
+                  lambda: np.nextafter(A, B)),
+    "ldexp": (lambda: pt.ldexp(T(A), T(I32)),
+              lambda: np.ldexp(A, I32)),
+    "float_power": (lambda: pt.float_power(T(P), 2.5),
+                    lambda: np.float_power(P, 2.5)),
+    "mod": (lambda: pt.mod(T(I32), T(J32)), lambda: I32 % J32),
+    "fmod": (lambda: pt.fmod(T(A), T(P)), lambda: np.fmod(A, P)),
+    "sinc": (lambda: pt.sinc(T(A)), lambda: np.sinc(A)),
+    "signbit": (lambda: pt.signbit(T(A)), lambda: np.signbit(A)),
+    "isneginf": (lambda: pt.isneginf(T(A / (A - A + 1e-9) * -1)),
+                 lambda: np.isneginf(A / (A - A + 1e-9) * -1)),
+    "isreal": (lambda: pt.isreal(T(CPLX * np.array([1, 0, 1, 0]))),
+               lambda: np.isreal(CPLX * np.array([1, 0, 1, 0]))),
+    "isin": (lambda: pt.isin(T(I32), T(np.array([1, 3, 5], "int32"))),
+             lambda: np.isin(I32, [1, 3, 5])),
+    "gammainc": (lambda: pt.gammainc(T(P), T(P + 0.5)),
+                 lambda: _sp("gammainc", P, P + 0.5)),
+    "gammaincc": (lambda: pt.gammaincc(T(P), T(P + 0.5)),
+                  lambda: _sp("gammaincc", P, P + 0.5)),
+    "multigammaln": (lambda: pt.multigammaln(T(P + 2), 2),
+                     lambda: _sp("multigammaln", P + 2, 2)),
+    "stanh": (lambda: pt.stanh(T(A), 0.7, 0.9),
+              lambda: 0.9 * np.tanh(0.7 * A)),
+    # -- cumulative / diff ------------------------------------------------
+    "cummax": (lambda: pt.cummax(T(A), axis=1)[0],
+               lambda: np.maximum.accumulate(A, 1)),
+    "cummin": (lambda: pt.cummin(T(A), axis=1)[0],
+               lambda: np.minimum.accumulate(A, 1)),
+    "logcumsumexp": (lambda: pt.logcumsumexp(T(A), axis=1),
+                     lambda: np.log(np.cumsum(np.exp(A), 1))),
+    "diff": (lambda: pt.diff(T(A), axis=1), lambda: np.diff(A, axis=1)),
+    "trapezoid": (lambda: pt.trapezoid(T(A), dx=0.5),
+                  lambda: np.trapezoid(A, dx=0.5).astype(np.float32)),
+    "cumulative_trapezoid": (
+        lambda: pt.cumulative_trapezoid(T(A), dx=0.5),
+        lambda: 0.5 * np.cumsum((A[:, 1:] + A[:, :-1]) / 2, 1)),
+    # -- bitwise ----------------------------------------------------------
+    "bitwise_and": (lambda: pt.bitwise_and(T(I32), T(J32)),
+                    lambda: I32 & J32),
+    "bitwise_or": (lambda: pt.bitwise_or(T(I32), T(J32)),
+                   lambda: I32 | J32),
+    "bitwise_xor": (lambda: pt.bitwise_xor(T(I32), T(J32)),
+                    lambda: I32 ^ J32),
+    "bitwise_not": (lambda: pt.bitwise_not(T(I32)), lambda: ~I32),
+    "bitwise_left_shift": (lambda: pt.bitwise_left_shift(T(I32), T(J32 % 4)),
+                           lambda: I32 << (J32 % 4)),
+    "bitwise_right_shift": (lambda: pt.bitwise_right_shift(T(I32), T(J32 % 4)),
+                            lambda: I32 >> (J32 % 4)),
+    # -- reductions -------------------------------------------------------
+    "sum_axis": (lambda: pt.sum(T(A), axis=1), lambda: A.sum(1)),
+    "mean_axis": (lambda: pt.mean(T(A), axis=0), lambda: A.mean(0)),
+    "max_axis": (lambda: pt.max(T(A), axis=1), lambda: A.max(1)),
+    "min_axis": (lambda: pt.min(T(A), axis=0), lambda: A.min(0)),
+    "amin": (lambda: pt.amin(T(A), axis=1), lambda: A.min(1)),
+    "any": (lambda: pt.any(T(A > 0), axis=1), lambda: (A > 0).any(1)),
+    "all": (lambda: pt.all(T(A > -10), axis=1), lambda: (A > -10).all(1)),
+    "nanmean": (lambda: pt.nanmean(T(NANA), axis=1),
+                lambda: np.nanmean(NANA, 1)),
+    "nanmedian": (lambda: pt.nanmedian(T(NANA), axis=1),
+                  lambda: np.nanmedian(NANA, 1).astype(np.float32)),
+    "quantile": (lambda: pt.quantile(T(A), 0.3, axis=1),
+                 lambda: np.quantile(A, 0.3, axis=1).astype(np.float32)),
+    "nanquantile": (lambda: pt.nanquantile(T(NANA), 0.3, axis=1),
+                    lambda: np.nanquantile(NANA, 0.3, 1).astype(np.float32)),
+    "count_nonzero": (lambda: pt.count_nonzero(T(I32 % 3), axis=1),
+                      lambda: np.count_nonzero(I32 % 3, axis=1)),
+    # -- comparison / logic ----------------------------------------------
+    "isclose": (lambda: pt.isclose(T(A), T(A + 1e-7)),
+                lambda: np.isclose(A, A + 1e-7)),
+    "equal_all": (lambda: pt.equal_all(T(A), T(A)),
+                  lambda: np.array(True)),
+    # -- manipulation -----------------------------------------------------
+    "t": (lambda: pt.t(T(M1)), lambda: M1.T),
+    "moveaxis": (lambda: pt.moveaxis(T(A), 0, 1),
+                 lambda: np.moveaxis(A, 0, 1)),
+    "swapaxes": (lambda: pt.swapaxes(T(A), 0, 1),
+                 lambda: np.swapaxes(A, 0, 1)),
+    "expand": (lambda: pt.expand(T(V), [2, 5]),
+               lambda: np.broadcast_to(V, (2, 5))),
+    "broadcast_to": (lambda: pt.broadcast_to(T(V), [2, 5]),
+                     lambda: np.broadcast_to(V, (2, 5))),
+    "rot90": (lambda: pt.rot90(T(A)), lambda: np.rot90(A)),
+    "gather": (lambda: pt.gather(T(A), T(IDX0)), lambda: A[IDX0]),
+    "take_along_axis": (lambda: pt.take_along_axis(T(A), T(IDX_COL), 1),
+                        lambda: np.take_along_axis(A, IDX_COL, 1)),
+    "index_sample": (lambda: pt.index_sample(T(A), T(IDX_COL)),
+                     lambda: np.take_along_axis(A, IDX_COL, 1)),
+    "take": (lambda: pt.take(T(A), T(np.array([0, 5, 11], "int64"))),
+             lambda: A.flatten()[[0, 5, 11]]),
+    "nonzero": (lambda: pt.nonzero(T(I32 % 2)),
+                lambda: np.stack(np.nonzero(I32 % 2), 1).astype("int64")),
+    "pad": (lambda: pt.nn.functional.pad(T(A), [1, 2], value=0.0),
+            lambda: np.pad(A, ((0, 0), (1, 2)))),
+    "repeat_interleave": (lambda: pt.repeat_interleave(T(A), 2, axis=1),
+                          lambda: np.repeat(A, 2, axis=1)),
+    "hstack": (lambda: pt.hstack([T(A), T(B)]), lambda: np.hstack([A, B])),
+    "vstack": (lambda: pt.vstack([T(A), T(B)]), lambda: np.vstack([A, B])),
+    "dstack": (lambda: pt.dstack([T(A), T(B)]), lambda: np.dstack([A, B])),
+    "column_stack": (lambda: pt.column_stack([T(V), T(W)]),
+                     lambda: np.column_stack([V, W])),
+    "diagonal": (lambda: pt.diagonal(T(SQ)), lambda: np.diagonal(SQ)),
+    "diag_embed": (lambda: pt.diag_embed(T(V)), lambda: np.diag(V)),
+    "bincount": (lambda: pt.bincount(T(I32.flatten().astype("int64"))),
+                 lambda: np.bincount(I32.flatten())),
+    "one_hot": (lambda: pt.nn.functional.one_hot(T(IDX0), 4),
+                lambda: np.eye(4, dtype=np.float32)[IDX0]),
+    "searchsorted": (lambda: pt.searchsorted(T(np.sort(V)), T(W)),
+                     lambda: np.searchsorted(np.sort(V), W)),
+    "bucketize": (lambda: pt.bucketize(T(A), T(np.array([-1., 0., 1.],
+                                                        "float32"))),
+                  lambda: np.searchsorted([-1., 0., 1.], A)),
+    "masked_fill": (lambda: pt.masked_fill(T(A), T(A > 0), 9.0),
+                    lambda: np.where(A > 0, 9.0, A)),
+    "tensordot": (lambda: pt.tensordot(T(A), T(B), axes=[[1], [1]]),
+                  lambda: np.tensordot(A, B, axes=[[1], [1]])),
+    "atleast_2d": (lambda: pt.atleast_2d(T(V)), lambda: V[None]),
+    "block_diag": (lambda: pt.block_diag([T(A), T(SQ)]),
+                   lambda: _np_block_diag(A, SQ)),
+    "unflatten": (lambda: pt.unflatten(T(UF), 1, [2, 3]),
+                  lambda: UF.reshape(3, 2, 3)),
+    "vander": (lambda: pt.vander(T(V), 3),
+               lambda: np.vander(V, 3)),   # decreasing, reference default
+    "inner": (lambda: pt.inner(T(A), T(B)), lambda: np.inner(A, B)),
+    "cross": (lambda: pt.cross(T(V3), T(W3)), lambda: np.cross(V3, W3)),
+    "addmm": (lambda: pt.addmm(T(np.zeros((3, 2), "float32")), T(M1), T(M2),
+                               beta=1.0, alpha=1.0),
+              lambda: M1 @ M2),
+    # -- linalg -----------------------------------------------------------
+    "mm": (lambda: pt.mm(T(M1), T(M2)), lambda: M1 @ M2),
+    "einsum": (lambda: pt.einsum("ij,jk->ik", T(M1), T(M2)),
+               lambda: np.einsum("ij,jk->ik", M1, M2)),
+    "norm_fro": (lambda: pt.linalg.norm(T(A)),
+                 lambda: np.linalg.norm(A).astype(np.float32)),
+    "vector_norm": (lambda: pt.linalg.vector_norm(T(V), 2),
+                    lambda: np.linalg.norm(V).astype(np.float32)),
+    "dist": (lambda: pt.dist(T(A), T(B), 2),
+             lambda: np.linalg.norm((A - B).flatten()).astype(np.float32)),
+    "cdist": (lambda: pt.cdist(T(M1), T(M1)),
+              lambda: _np_cdist(M1, M1)),
+    "cholesky": (lambda: pt.linalg.cholesky(T(PSD)),
+                 lambda: np.linalg.cholesky(PSD)),
+    "cholesky_solve": (lambda: pt.linalg.cholesky_solve(
+        T(V3[:, None] * np.ones((3, 1), "float32")),
+        T(np.linalg.cholesky(PSD[:3, :3]).astype("float32")), upper=False),
+        lambda: np.linalg.solve(PSD[:3, :3], V3[:, None])),
+    "inverse": (lambda: pt.linalg.inv(T(PSD)),
+                lambda: np.linalg.inv(PSD)),
+    "pinv": (lambda: pt.linalg.pinv(T(M1)), lambda: np.linalg.pinv(M1)),
+    "solve": (lambda: pt.linalg.solve(T(PSD), T(SQ[:, :2])),
+              lambda: np.linalg.solve(PSD, SQ[:, :2])),
+    "triangular_solve": (
+        lambda: pt.linalg.triangular_solve(
+            T(np.tril(PSD).astype("float32")), T(SQ[:, :2]), upper=False),
+        lambda: np.linalg.solve(np.tril(PSD), SQ[:, :2])),
+    "det": (lambda: pt.linalg.det(T(PSD)),
+            lambda: np.array(np.linalg.det(PSD), np.float32)),
+    "matrix_power": (lambda: pt.linalg.matrix_power(T(PSD), 3),
+                     lambda: np.linalg.matrix_power(PSD, 3)),
+    "matrix_exp": (lambda: pt.linalg.matrix_exp(T(SQ * 0.1)),
+                   lambda: _sp_expm(SQ * 0.1)),
+    "multi_dot": (lambda: pt.linalg.multi_dot([T(M1), T(M2),
+                                               T(M2.T.copy())]),
+                  lambda: M1 @ M2 @ M2.T),
+    "corrcoef": (lambda: pt.linalg.corrcoef(T(M1)),
+                 lambda: np.corrcoef(M1).astype(np.float32)),
+    "cov": (lambda: pt.linalg.cov(T(M1)),
+            lambda: np.cov(M1).astype(np.float32)),
+    # -- fft --------------------------------------------------------------
+    "fft": (lambda: pt.fft.fft(T(V)), lambda: np.fft.fft(V)),
+    "ifft": (lambda: pt.fft.ifft(T(V)), lambda: np.fft.ifft(V)),
+    "fft2": (lambda: pt.fft.fft2(T(SQ)), lambda: np.fft.fft2(SQ)),
+    "fftn": (lambda: pt.fft.fftn(T(A)), lambda: np.fft.fftn(A)),
+    "rfft": (lambda: pt.fft.rfft(T(V)), lambda: np.fft.rfft(V)),
+    "irfft": (lambda: pt.fft.irfft(T(np.fft.rfft(V))),
+              lambda: np.fft.irfft(np.fft.rfft(V))),
+    "hfft": (lambda: pt.fft.hfft(T(np.fft.rfft(V))),
+             lambda: np.fft.hfft(np.fft.rfft(V))),
+    "fftfreq": (lambda: pt.fft.fftfreq(8, 0.5),
+                lambda: np.fft.fftfreq(8, 0.5).astype(np.float32)),
+    "rfftfreq": (lambda: pt.fft.rfftfreq(8, 0.5),
+                 lambda: np.fft.rfftfreq(8, 0.5).astype(np.float32)),
+    "fftshift": (lambda: pt.fft.fftshift(T(V)), lambda: np.fft.fftshift(V)),
+    "ifftshift": (lambda: pt.fft.ifftshift(T(V)),
+                  lambda: np.fft.ifftshift(V)),
+    # -- activations ------------------------------------------------------
+    "relu6": (lambda: pt.nn.functional.relu6(T(A * 4)),
+              lambda: np.clip(A * 4, 0, 6)),
+    "log_sigmoid": (lambda: pt.nn.functional.log_sigmoid(T(A)),
+                    lambda: -np.logaddexp(0, -A)),
+    "tanhshrink": (lambda: pt.nn.functional.tanhshrink(T(A)),
+                   lambda: A - np.tanh(A)),
+    "silu": (lambda: pt.nn.functional.silu(T(A)),
+             lambda: A / (1 + np.exp(-A))),
+    "mish": (lambda: pt.nn.functional.mish(T(A)),
+             lambda: A * np.tanh(np.logaddexp(0, A))),
+    "hardswish": (lambda: pt.nn.functional.hardswish(T(A * 4)),
+                  lambda: A * 4 * np.clip(A * 4 + 3, 0, 6) / 6),
+    "hardsigmoid": (lambda: pt.nn.functional.hardsigmoid(T(A * 4)),
+                    lambda: np.clip(A * 4 / 6 + 0.5, 0, 1)),
+    "hardshrink": (lambda: pt.nn.functional.hardshrink(T(A)),
+                   lambda: np.where(np.abs(A) > 0.5, A, 0)),
+    "softshrink": (lambda: pt.nn.functional.softshrink(T(A)),
+                   lambda: np.sign(A) * np.maximum(np.abs(A) - 0.5, 0)),
+    "leaky_relu": (lambda: pt.nn.functional.leaky_relu(T(A), 0.1),
+                   lambda: np.where(A > 0, A, 0.1 * A)),
+    "selu": (lambda: pt.nn.functional.selu(T(A)),
+             lambda: np.where(
+                 A > 0, 1.0507009873554805 * A,
+                 1.0507009873554805 * 1.6732632423543772 * np.expm1(A))),
+    "celu": (lambda: pt.nn.functional.celu(T(A), 1.2),
+             lambda: np.maximum(A, 0) + np.minimum(
+                 1.2 * np.expm1(A / 1.2), 0)),
+    "softsign": (lambda: pt.nn.functional.softsign(T(A)),
+                 lambda: A / (1 + np.abs(A))),
+    "softmin": (lambda: pt.nn.functional.softmin(T(A), axis=1),
+                lambda: np.exp(-A) / np.exp(-A).sum(1, keepdims=True)),
+    "glu": (lambda: pt.nn.functional.glu(T(A), axis=1),
+            lambda: A[:, :2] / (1 + np.exp(-A[:, 2:]))),
+    "thresholded_relu": (lambda: pt.nn.functional.thresholded_relu(T(A)),
+                         lambda: np.where(A > 1.0, A, 0)),
+    "gelu_exact": (lambda: pt.nn.functional.gelu(T(A)),
+                   lambda: A * 0.5 * (1 + _sp("erf", A / np.sqrt(2)))),
+    # -- losses -----------------------------------------------------------
+    "huber_loss": (
+        lambda: pt.nn.functional.smooth_l1_loss(T(A), T(B), delta=1.0),
+        lambda: np.mean(np.where(np.abs(A - B) < 1,
+                                 0.5 * (A - B) ** 2,
+                                 np.abs(A - B) - 0.5)).astype(np.float32)),
+    "kldiv_loss": (
+        lambda: pt.nn.functional.kl_div(T(np.log(Q)), T(Q), "mean"),
+        lambda: np.mean(Q * (np.log(Q) - np.log(Q))).astype(np.float32)),
+    "bce_loss": (
+        lambda: pt.nn.functional.binary_cross_entropy(T(Q), T((A > 0)
+                                                              .astype("float32"))),
+        lambda: np.mean(-((A > 0) * np.log(Q) + (1 - (A > 0))
+                          * np.log(1 - Q))).astype(np.float32)),
+    # reference loss.py log_loss applies epsilon INSIDE both logs
+    "log_loss": (
+        lambda: pt.nn.functional.log_loss(T(Q), T((A > 0).astype("float32")),
+                                          epsilon=1e-4),
+        lambda: -((A > 0) * np.log(Q + 1e-4)
+                  + (1 - (A > 0)) * np.log(1 - Q + 1e-4))),
+}
+
+
+def _np_block_diag(*ms):
+    r = sum(m.shape[0] for m in ms)
+    c = sum(m.shape[1] for m in ms)
+    out = np.zeros((r, c), ms[0].dtype)
+    i = j = 0
+    for m in ms:
+        out[i:i + m.shape[0], j:j + m.shape[1]] = m
+        i += m.shape[0]
+        j += m.shape[1]
+    return out
+
+
+def _np_cdist(a, b):
+    return np.sqrt(((a[:, None] - b[None]) ** 2).sum(-1)).astype(np.float32)
+
+
+def _sp_expm(m):
+    import scipy.linalg
+    return scipy.linalg.expm(m).astype(np.float32)
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_numeric_matches_numpy(name):
+    op, ref = CASES[name]
+    got = np.asarray(op()._value)
+    want = np.asarray(ref())
+    assert got.shape == want.shape, (got.shape, want.shape)
+    if got.dtype.kind in "fc":
+        np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+    else:
+        np.testing.assert_array_equal(got, want)
+
+
+# -- tuple-output ops ------------------------------------------------------
+def _v(x):
+    return np.asarray(x._value)
+
+
+def test_topk_values_indices():
+    vals, idx = pt.topk(T(A), 2, axis=1)
+    order = np.argsort(-A, 1)[:, :2]
+    np.testing.assert_allclose(_v(vals), np.take_along_axis(A, order, 1),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(_v(idx), order)
+
+
+def test_kthvalue():
+    vals, idx = pt.kthvalue(T(A), 2, axis=1)
+    want = np.sort(A, 1)[:, 1]
+    np.testing.assert_allclose(_v(vals), want, rtol=1e-6)
+
+
+def test_mode():
+    X = np.array([[1, 2, 2, 3], [4, 4, 5, 6]], "int64")
+    vals, _ = pt.mode(T(X), axis=1)
+    np.testing.assert_array_equal(_v(vals), [2, 4])
+
+
+def test_unique():
+    X = np.array([3, 1, 2, 3, 1], "int64")
+    out = pt.unique(T(X))
+    got = out[0] if isinstance(out, (tuple, list)) else out
+    np.testing.assert_array_equal(_v(got), [1, 2, 3])
+
+
+def test_slogdet():
+    out = pt.linalg.slogdet(T(PSD))
+    sign, logdet = (out[0], out[1])
+    s, l = np.linalg.slogdet(PSD)
+    np.testing.assert_allclose(float(_v(sign)), s, rtol=1e-5)
+    np.testing.assert_allclose(float(_v(logdet)), l, rtol=1e-5)
+
+
+def test_frexp():
+    m, e = pt.frexp(T(P))
+    wm, we = np.frexp(P)
+    np.testing.assert_allclose(_v(m), wm, rtol=1e-6)
+    np.testing.assert_array_equal(_v(e).astype("int32"), we)
+
+
+def test_qr_reconstructs():
+    q, r = pt.linalg.qr(T(M1))
+    np.testing.assert_allclose(_v(q) @ _v(r), M1, rtol=1e-4, atol=1e-4)
+
+
+def test_svd_reconstructs():
+    u, s, vh = pt.linalg.svd(T(M1), full_matrices=False)
+    np.testing.assert_allclose(_v(u) @ np.diag(_v(s)) @ _v(vh), M1,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.sort(_v(s))[::-1],
+                               np.linalg.svd(M1, compute_uv=False),
+                               rtol=1e-5)
+
+
+def test_lu_reconstructs():
+    lu, piv = pt.linalg.lu(T(SQ))[:2]
+    # P @ A = L @ U — verify by unpacking
+    l = np.tril(_v(lu), -1) + np.eye(4, dtype=np.float32)
+    u = np.triu(_v(lu))
+    perm = np.asarray(_v(piv))
+    a = SQ.copy()
+    # apply pivots the LAPACK way
+    for i, p in enumerate(perm):
+        a[[i, p - 1]] = a[[p - 1, i]]
+    np.testing.assert_allclose(l @ u, a, rtol=1e-4, atol=1e-4)
+
+
+def test_histogram():
+    h = pt.histogram(T(A), bins=5, min=-2, max=2)
+    want, _ = np.histogram(A, bins=5, range=(-2, 2))
+    np.testing.assert_array_equal(_v(h), want)
+
+
+def test_eigh():
+    w, v = pt.linalg.eigh(T(PSD))
+    wr = np.linalg.eigvalsh(PSD)
+    np.testing.assert_allclose(np.sort(_v(w)), np.sort(wr), rtol=1e-4)
+    # eigen-equation residual
+    np.testing.assert_allclose(PSD @ _v(v), _v(v) * _v(w)[None, :],
+                               rtol=1e-3, atol=1e-3)

@@ -132,3 +132,18 @@ def test_sp_linear_overlap_flag_matches_default():
 
     np.testing.assert_allclose(np.asarray(run(True)), np.asarray(run(False)),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_tp_overlap_requires_sequence_parallel():
+    import paddle_tpu.parallel as dist
+    from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
+    from paddle_tpu.parallel.topology import HybridTopology, set_topology
+    topo = dist.init_topology(mp=2)
+    try:
+        with pytest.raises(ValueError, match="tp_overlap"):
+            build_gpt_train_step(GPTConfig(vocab_size=64, hidden_size=16,
+                                           num_layers=1, num_heads=2),
+                                 topo, tp_overlap=True,
+                                 sequence_parallel=False)
+    finally:
+        set_topology(HybridTopology())
