@@ -32,39 +32,62 @@ def _unwrap(t):
     return t._value if isinstance(t, Tensor) else jnp.asarray(t)
 
 
+def _group_expand(scale, K, group_size):
+    """[G, N] group scales -> [K, N] per-row scales."""
+    s = jnp.repeat(scale, group_size, axis=0)
+    return s[:K]
+
+
 def weight_quantize(x, algo: str = "weight_only_int8", arch=None,
                     group_size: int = -1):
-    """Per-output-channel absmax quantization.  Returns (out, scale).
+    """Absmax quantization.  Returns (out, scale).
 
     algo: "weight_only_int8" | "llm.int8" -> int8 [K, N];
           "weight_only_int4" -> packed int8 [ceil(K/2), N] (two rows per
           byte: low nibble = even row, high nibble = odd row).
+
+    group_size: -1 = one scale per output channel (scale [N]); 64/128 =
+    group-wise — one scale per (group of input rows x output channel)
+    (scale [ceil(K/group_size), N], the reference weight_quantize's
+    group_size semantics).
     """
     if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
         raise ValueError(f"unknown quantize algo {algo!r}")
-    if group_size not in (-1, None):
-        raise NotImplementedError("grouped scales not implemented")
+    if group_size not in (-1, None, 64, 128):
+        raise ValueError(f"group_size must be -1/64/128, got {group_size}")
+    grouped = group_size in (64, 128)
+    if grouped and algo == "llm.int8":
+        # llm_int8_linear's vector-wise int8 dot consumes a [N] scale;
+        # grouped scales belong to the weight_only_* paths
+        raise ValueError("group_size is only supported for "
+                         "weight_only_int8/int4, not llm.int8")
 
     def impl(w):
-        absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
-        if algo == "weight_only_int4":
-            scale = jnp.maximum(absmax, 1e-8) / 7.0
-            q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -8, 7)
-            q = q.astype(jnp.int8)
-            if q.shape[0] % 2:
-                q = jnp.pad(q, ((0, 1), (0, 0)))
-            half = q.shape[0] // 2
-            # HALVES packing: rows [0, K/2) in the low nibble, rows
-            # [K/2, K) in the high nibble — lets the matmul kernel unpack
-            # as two contiguous nibble-plane matmuls (x_lo @ lo + x_hi @ hi)
-            # with no row interleave.
-            lo = q[:half]
-            hi = q[half:]
-            packed = (lo & 0x0F) | (hi << 4)
-            return packed.astype(jnp.int8), scale
-        scale = jnp.maximum(absmax, 1e-8) / 127.0
-        q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
-        return q.astype(jnp.int8), scale
+        wf = w.astype(jnp.float32)
+        K = wf.shape[0]
+        if grouped:
+            G = -(-K // group_size)
+            wp = jnp.pad(wf, ((0, G * group_size - K), (0, 0)))
+            absmax = jnp.max(jnp.abs(wp.reshape(G, group_size, -1)), axis=1)
+        else:
+            absmax = jnp.max(jnp.abs(wf), axis=0)
+        qmax = 7.0 if algo == "weight_only_int4" else 127.0
+        scale = jnp.maximum(absmax, 1e-8) / qmax
+        srow = _group_expand(scale, K, group_size) if grouped else scale
+        q = jnp.clip(jnp.round(wf / srow), -qmax - 1, qmax).astype(jnp.int8)
+        if algo != "weight_only_int4":
+            return q, scale
+        if q.shape[0] % 2:
+            q = jnp.pad(q, ((0, 1), (0, 0)))
+        half = q.shape[0] // 2
+        # HALVES packing: rows [0, K/2) in the low nibble, rows
+        # [K/2, K) in the high nibble — lets the matmul kernel unpack
+        # as two contiguous nibble-plane matmuls (x_lo @ lo + x_hi @ hi)
+        # with no row interleave.
+        lo = q[:half]
+        hi = q[half:]
+        packed = (lo & 0x0F) | (hi << 4)
+        return packed.astype(jnp.int8), scale
 
     return run_op("weight_quantize", impl, (x,), {}, differentiable=False)
 
@@ -77,8 +100,13 @@ def _unpack_int4(packed, k_orig):
 
 
 def weight_dequantize(x, scale, algo: str = "weight_only_int8",
-                      out_dtype="float32", k: Optional[int] = None):
-    """Inverse of :func:`weight_quantize` (reference weight_dequantize)."""
+                      out_dtype="float32", k: Optional[int] = None,
+                      group_size: int = -1):
+    """Inverse of :func:`weight_quantize` (reference weight_dequantize),
+    incl. group-wise scales ([G, N] with ``group_size`` rows/group)."""
+    if group_size not in (-1, None, 64, 128):
+        raise ValueError(f"group_size must be -1/64/128, got {group_size}")
+    grouped = group_size in (64, 128)
 
     def impl(q, s):
         if algo == "weight_only_int4":
@@ -86,8 +114,10 @@ def weight_dequantize(x, scale, algo: str = "weight_only_int8",
             qq = _unpack_int4(q, kk)
         else:
             qq = q
-        return (qq.astype(jnp.float32) * s.astype(jnp.float32)).astype(
-            jnp.dtype(out_dtype))
+        sf = s.astype(jnp.float32)
+        if grouped:
+            sf = _group_expand(sf, qq.shape[0], group_size)
+        return (qq.astype(jnp.float32) * sf).astype(jnp.dtype(out_dtype))
 
     return run_op("weight_dequantize", impl, (x, scale), {},
                   differentiable=False)
@@ -109,6 +139,9 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     if weight_scale is None:
         raise ValueError("weight_only_linear needs weight_scale from "
                          "weight_quantize")
+    if group_size not in (-1, None, 64, 128):
+        raise ValueError(f"group_size must be -1/64/128, got {group_size}")
+    grouped = group_size in (64, 128)
 
     def impl(xv, wq, s, b):
         K = xv.shape[-1]
@@ -117,19 +150,28 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
         except Exception:
             on_tpu = False
         from ...core.flags import FLAGS
-        if on_tpu or FLAGS.pallas_interpret:
+        # the int4 grouped kernel needs nibble planes aligned to groups
+        int4_ok = (not grouped) or (wq.shape[0] % group_size == 0)
+        if (on_tpu or FLAGS.pallas_interpret) and \
+                (weight_dtype == "int8" or int4_ok):
+            gs = group_size if grouped else -1
             if weight_dtype == "int4":
                 # packed nibbles stream straight into the kernel — half
                 # the HBM bytes of int8; unpack happens in VMEM
                 from ...ops.pallas.quant_linear import (
                     weight_only_matmul_int4)
-                y = weight_only_matmul_int4(xv, wq, s)
+                y = weight_only_matmul_int4(xv, wq, s, group_size=gs)
             else:
                 from ...ops.pallas.quant_linear import weight_only_matmul
-                y = weight_only_matmul(xv, wq, s)
+                y = weight_only_matmul(xv, wq, s, group_size=gs)
         else:
             wd = _unpack_int4(wq, K) if weight_dtype == "int4" else wq
-            y = (xv @ wd.astype(xv.dtype)) * s.astype(xv.dtype)
+            sf = s.astype(xv.dtype)
+            if grouped:
+                y = xv @ (wd.astype(xv.dtype)
+                          * _group_expand(sf, wd.shape[0], group_size))
+            else:
+                y = (xv @ wd.astype(xv.dtype)) * sf
         if b is not None:
             y = y + b
         return y

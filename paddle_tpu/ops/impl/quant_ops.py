@@ -164,11 +164,9 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
 
 def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float32",
                       group_size=-1):
-    if group_size not in (-1, None):
-        raise NotImplementedError(
-            "weight_dequantize: grouped scales not implemented")
     from ...nn.quant import weight_dequantize as f
-    out = f(x, scale, algo, out_dtype=out_dtype or "float32")
+    out = f(x, scale, algo, out_dtype=out_dtype or "float32",
+            group_size=group_size if group_size else -1)
     return jnp.asarray(getattr(out, "_value", out))
 
 

@@ -9,12 +9,21 @@ TPU design: activations stay bf16/fp32; the weight is stored int8 (half
 the HBM bytes of bf16 — the point of weight-only quantization is
 bandwidth, not MXU int ops).  The kernel streams int8 weight blocks into
 VMEM, upcasts in-register, accumulates fp32 on the MXU, and applies the
-per-output-channel scale once at the final K block.
+scale per k-block (post-multiplying the block's partial product, or
+dequantizing the weight tile in VMEM when a block spans several groups —
+see _block_scale); the final K block just casts the accumulator out.
 
 Layouts (logical, matching paddle_tpu.nn.Linear):
     x:      [..., K]
     wq:     [K, N] int8
-    scale:  [N] fp32 — per output channel absmax / 127
+    scale:  [N] fp32 — per output channel absmax / 127, or [G, N] for
+            group-wise scales (group_size input rows per scale row, the
+            reference's group_size=64/128 weight_only path)
+
+Group-wise design: the k-grid block size is chosen to divide the group
+size, so each streamed weight block lies inside ONE scale group and the
+scale is applied to that block's partial product before accumulation —
+no per-row gather, one extra VMEM row per block.
 """
 
 from __future__ import annotations
@@ -43,7 +52,24 @@ def _pad_to(a, mult, axis):
     return a
 
 
-def _wo_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr, *, nk):
+def _block_scale(s_ref, kb, kpg, gpb, gs, bk, row_off, dtype):
+    """Scale factor(s) for one k-block, from the whole-in-VMEM scale ref
+    ([G, bn]; Mosaic's sublane rule forbids 1-row moving blocks, so rows
+    are selected dynamically instead of via the BlockSpec).
+
+    Returns (post, tile): ``post`` [1, bn] multiplies the block's partial
+    product AFTER the matmul (block inside one group); ``tile`` [bk, bn]
+    dequantizes the weight BEFORE the matmul (block spans ``gpb`` > 1
+    groups).  Exactly one is non-None."""
+    if gpb == 1:
+        return s_ref[pl.dslice(row_off + kb // kpg, 1), :], None
+    rows = s_ref[pl.dslice(row_off + kb * gpb, gpb), :]    # [gpb, bn]
+    tile = jnp.broadcast_to(rows[:, None, :], (gpb, gs, rows.shape[-1]))
+    return None, tile.reshape(bk, rows.shape[-1]).astype(dtype)
+
+
+def _wo_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr, *, nk, kpg, gpb, gs,
+               bk):
     kb = pl.program_id(2)
 
     @pl.when(kb == 0)
@@ -52,18 +78,26 @@ def _wo_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr, *, nk):
 
     x = x_ref[:]                                  # [bm, bk]
     w = w_ref[:].astype(x.dtype)                  # [bk, bn] int8 -> x dtype
-    acc_scr[:] += jax.lax.dot_general(
+    post, tile = _block_scale(s_ref, kb, kpg, gpb, gs, bk, 0, x.dtype)
+    if tile is not None:
+        w = w * tile
+    part = jax.lax.dot_general(
         x, w, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
+    if post is not None:
+        part = part * post.astype(jnp.float32)
+    acc_scr[:] += part
 
     @pl.when(kb == nk - 1)
     def _final():
-        o_ref[:] = (acc_scr[:] * s_ref[:].astype(jnp.float32)).astype(
-            o_ref.dtype)
+        o_ref[:] = acc_scr[:].astype(o_ref.dtype)
 
 
-def weight_only_matmul(x, wq, scale, out_dtype=None):
-    """x [..., K] @ dequant(wq [K, N] int8, scale [N]) -> [..., N]."""
+def weight_only_matmul(x, wq, scale, out_dtype=None, group_size: int = -1):
+    """x [..., K] @ dequant(wq [K, N] int8, scale) -> [..., N].
+
+    scale: [N] per-channel, or [G, N] with ``group_size`` rows per group
+    (G = ceil(K / group_size))."""
     out_dtype = out_dtype or x.dtype
     lead = x.shape[:-1]
     K = x.shape[-1]
@@ -73,22 +107,55 @@ def weight_only_matmul(x, wq, scale, out_dtype=None):
 
     bm = min(BM, max(8, M))
     bn = min(BN, N)
-    bk = min(BK, K)
+    if group_size in (-1, None):
+        bk = min(BK, K)
+        kpg = None                          # one scale row for all blocks
+        gpb = 1
+    else:
+        # keep the k block lane-divisible (>=128) even for group_size 64;
+        # a block then spans gpb whole groups, dequantized in VMEM
+        bk = min(BK, max(group_size, 128))
+        if bk % group_size == 0:
+            gpb = bk // group_size          # groups per k-block
+            kpg = 1
+        elif group_size % bk == 0:
+            gpb = 1
+            kpg = group_size // bk          # k-blocks per scale group
+        else:
+            raise ValueError(f"group_size {group_size} incompatible with "
+                             f"block k {bk}")
 
     x2 = _pad_to(_pad_to(x2, bm, 0), bk, 1)
     wqp = _pad_to(_pad_to(wq, bk, 0), bn, 1)
-    sp = _pad_to(scale.astype(jnp.float32)[None, :], bn, 1)   # [1, N]
     Mp, Kp = x2.shape
     Np = wqp.shape[1]
     nk = Kp // bk
 
+    s2 = scale.astype(jnp.float32)
+    if s2.ndim == 1:
+        s2 = s2[None, :]
+    if group_size not in (-1, None) and s2.shape[0] < -(-K // group_size):
+        # zero-padding below is ONLY for groups added by K padding — an
+        # undersized scale (e.g. a per-channel [N] scale passed with
+        # group_size set) would silently zero real weight groups
+        raise ValueError(f"grouped scale has {s2.shape[0]} rows, need "
+                         f"ceil({K}/{group_size})")
+    kpg_eff = nk if kpg is None else kpg
+    need_rows = gpb * nk if gpb > 1 else -(-nk // kpg_eff)
+    sp = _pad_to(s2, bn, 1)
+    if sp.shape[0] < need_rows:             # K padding may add groups
+        sp = jnp.pad(sp, ((0, need_rows - sp.shape[0]), (0, 0)))
+    G_rows = sp.shape[0]
+
     out = pl.pallas_call(
-        functools.partial(_wo_kernel, nk=nk),
+        functools.partial(_wo_kernel, nk=nk, kpg=kpg_eff, gpb=gpb,
+                          gs=group_size if gpb > 1 else 0, bk=bk),
         grid=(Mp // bm, Np // bn, nk),
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
             pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
-            pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
+            # whole scale column-block resident in VMEM (rows = full dim)
+            pl.BlockSpec((G_rows, bn), lambda i, j, k: (0, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
         out_shape=jax.ShapeDtypeStruct((Mp, Np), out_dtype),
@@ -98,7 +165,8 @@ def weight_only_matmul(x, wq, scale, out_dtype=None):
     return out[:M, :N].reshape(*lead, N)
 
 
-def _wo4_kernel(xlo_ref, xhi_ref, w_ref, s_ref, o_ref, acc_scr, *, nk):
+def _wo4_kernel(xlo_ref, xhi_ref, w_ref, s_ref, o_ref, acc_scr,
+                *, nk, kpg, gpb, gs, bkp, hi_off):
     kb = pl.program_id(2)
 
     @pl.when(kb == 0)
@@ -110,23 +178,33 @@ def _wo4_kernel(xlo_ref, xhi_ref, w_ref, s_ref, o_ref, acc_scr, *, nk):
     w = w_ref[:]                                  # [bkp, bn] packed int8
     lo = ((w << 4).astype(jnp.int8) >> 4).astype(xlo.dtype)  # sign-extend
     hi = (w >> 4).astype(xlo.dtype)               # arithmetic shift
-    acc_scr[:] += jax.lax.dot_general(
-        xlo, lo, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    acc_scr[:] += jax.lax.dot_general(
-        xhi, hi, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    # the two nibble planes cover different original-row ranges, so each
+    # selects its own scale row(s) (same when ungrouped: hi_off == 0)
+    for xv, wv, off in ((xlo, lo, 0), (xhi, hi, hi_off)):
+        post, tile = _block_scale(s_ref, kb, kpg, gpb, gs, bkp, off,
+                                  xv.dtype)
+        if tile is not None:
+            wv = wv * tile
+        part = jax.lax.dot_general(
+            xv, wv, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if post is not None:
+            part = part * post.astype(jnp.float32)
+        acc_scr[:] += part
 
     @pl.when(kb == nk - 1)
     def _final():
-        o_ref[:] = (acc_scr[:] * s_ref[:].astype(jnp.float32)).astype(
-            o_ref.dtype)
+        o_ref[:] = acc_scr[:].astype(o_ref.dtype)
 
 
-def weight_only_matmul_int4(x, wq_packed, scale, out_dtype=None):
+def weight_only_matmul_int4(x, wq_packed, scale, out_dtype=None,
+                            group_size: int = -1):
     """x [..., K] @ dequant(int4 halves-packed wq [ceil(K/2), N]) — the
     nibble planes are unpacked in VMEM (two matmuls per block), so HBM
-    streams only K*N/2 bytes of weight."""
+    streams only K*N/2 bytes of weight.
+
+    scale: [N], or [G, N] grouped (requires half = ceil(K/2) divisible by
+    ``group_size`` so each nibble plane's block maps to one group)."""
     out_dtype = out_dtype or x.dtype
     lead = x.shape[:-1]
     K = x.shape[-1]
@@ -139,26 +217,60 @@ def weight_only_matmul_int4(x, wq_packed, scale, out_dtype=None):
 
     bm = min(BM, max(8, M))
     bn = min(BN, N)
-    bkp = min(BK // 2, half)
+    if group_size in (-1, None):
+        bkp = min(BK // 2, half)
+        kpg = None
+        gpb = 1
+        hi_off = 0
+    else:
+        if half % group_size:
+            raise ValueError(f"int4 grouped kernel needs ceil(K/2) "
+                             f"({half}) divisible by group_size "
+                             f"{group_size}")
+        bkp = min(BK // 2, max(group_size, 128))
+        if bkp % group_size == 0:
+            gpb = bkp // group_size
+            kpg = 1
+        elif group_size % bkp == 0:
+            gpb = 1
+            kpg = group_size // bkp
+        else:
+            raise ValueError(f"group_size {group_size} incompatible with "
+                             f"block k {bkp}")
+        hi_off = half // group_size      # hi plane's first group index
 
     # pad packed rows to a block multiple; x halves pad to match
     wqp = _pad_to(_pad_to(wq_packed, bkp, 0), bn, 1)
     half_p = wqp.shape[0]
     x_lo = _pad_to(_pad_to(x2[:, :half], bm, 0), bkp, 1)
     x_hi = _pad_to(_pad_to(x2[:, half:2 * half], bm, 0), bkp, 1)
-    sp = _pad_to(scale.astype(jnp.float32)[None, :], bn, 1)
     Mp = x_lo.shape[0]
     Np = wqp.shape[1]
     nk = half_p // bkp
 
+    s2 = scale.astype(jnp.float32)
+    if s2.ndim == 1:
+        s2 = s2[None, :]
+    if group_size not in (-1, None) and s2.shape[0] < -(-K // group_size):
+        raise ValueError(f"grouped scale has {s2.shape[0]} rows, need "
+                         f"ceil({K}/{group_size})")
+    kpg_eff = nk if kpg is None else kpg
+    sp = _pad_to(s2, bn, 1)
+    need = hi_off + (gpb * nk if gpb > 1 else -(-nk // kpg_eff))
+    if sp.shape[0] < need:
+        sp = jnp.pad(sp, ((0, need - sp.shape[0]), (0, 0)))
+    G_rows = sp.shape[0]
+
     out = pl.pallas_call(
-        functools.partial(_wo4_kernel, nk=nk),
+        functools.partial(_wo4_kernel, nk=nk, kpg=kpg_eff, gpb=gpb,
+                          gs=group_size if gpb > 1 else 0, bkp=bkp,
+                          hi_off=hi_off),
         grid=(Mp // bm, Np // bn, nk),
         in_specs=[
             pl.BlockSpec((bm, bkp), lambda i, j, k: (i, k)),
             pl.BlockSpec((bm, bkp), lambda i, j, k: (i, k)),
             pl.BlockSpec((bkp, bn), lambda i, j, k: (k, j)),
-            pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
+            pl.BlockSpec((G_rows, bn), lambda i, j, k: (0, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
         out_shape=jax.ShapeDtypeStruct((Mp, Np), out_dtype),

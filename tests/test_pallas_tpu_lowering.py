@@ -157,6 +157,23 @@ class TestQuantLinearLowering:
         s = jax.ShapeDtypeStruct((4096,), jnp.float32)
         _lower_tpu(weight_only_matmul, x, wq, s)
 
+    def test_weight_only_int8_grouped(self):
+        from paddle_tpu.ops.pallas.quant_linear import weight_only_matmul
+        x = _sds((1024, 4096))
+        wq = jax.ShapeDtypeStruct((4096, 4096), jnp.int8)
+        s = jax.ShapeDtypeStruct((4096 // 128, 4096), jnp.float32)
+        _lower_tpu(lambda a, w, sc: weight_only_matmul(
+            a, w, sc, group_size=128), x, wq, s)
+
+    def test_weight_only_int4_grouped(self):
+        from paddle_tpu.ops.pallas.quant_linear import (
+            weight_only_matmul_int4)
+        x = _sds((1024, 4096))
+        wq = jax.ShapeDtypeStruct((2048, 4096), jnp.int8)   # packed halves
+        s = jax.ShapeDtypeStruct((4096 // 64, 4096), jnp.float32)
+        _lower_tpu(lambda a, w, sc: weight_only_matmul_int4(
+            a, w, sc, group_size=64), x, wq, s)
+
 
 class TestHybridTrainStepTPULowering:
     """End-to-end evidence: the FULL 5-axis hybrid train step — manual

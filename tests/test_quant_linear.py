@@ -161,3 +161,83 @@ class TestFp8Gemm:
                                   activation="relu")._value)
         ref = np.maximum(x @ w, 0)
         assert np.abs(out - ref).max() / max(np.abs(ref).max(), 1) < 0.15
+
+
+# -- group-wise scales (r4: reference weight_quantize group_size=64/128) ---
+@pytest.mark.parametrize("gs", [64, 128])
+def test_weight_quantize_grouped_roundtrip(gs):
+    """Group-wise scales track per-group magnitude: round-trip error stays
+    within scale/2 of each group's OWN scale, even when magnitudes vary
+    wildly across row groups (where per-channel scales would blow up)."""
+    K, N = 256, 32
+    w = rng.normal(size=(K, N)).astype(np.float32)
+    w[:gs] *= 100.0                      # hot first group
+    q, s = weight_quantize(pt.to_tensor(w), group_size=gs)
+    G = K // gs
+    assert np.asarray(s).shape == (G, N)
+    back = np.asarray(weight_dequantize(q, s, group_size=gs))
+    srow = np.repeat(np.asarray(s), gs, axis=0)
+    assert np.max(np.abs(back - w) / srow) <= 0.5 + 1e-3
+    # per-channel quantization of the same matrix is catastrophically
+    # worse on the cold groups — the point of grouping
+    q1, s1 = weight_quantize(pt.to_tensor(w))
+    back1 = np.asarray(weight_dequantize(q1, s1))
+    err_g = np.abs(back - w)[gs:].max()
+    err_c = np.abs(back1 - w)[gs:].max()
+    assert err_g < err_c / 10
+
+
+@pytest.mark.parametrize("wdt,gs", [("int8", 64), ("int8", 128),
+                                    ("int4", 64), ("int4", 128)])
+def test_weight_only_linear_grouped_matches_dequant(wdt, gs):
+    K, N = 256, 48
+    x = rng.normal(size=(4, 10, K)).astype(np.float32)
+    w = (rng.normal(size=(K, N)) * 0.1).astype(np.float32)
+    algo = f"weight_only_{wdt}"
+    q, s = weight_quantize(pt.to_tensor(w), algo=algo, group_size=gs)
+    y = np.asarray(weight_only_linear(pt.to_tensor(x), q, None, s,
+                                      weight_dtype=wdt, group_size=gs))
+    back = np.asarray(weight_dequantize(q, s, algo=algo, k=K,
+                                        group_size=gs))
+    np.testing.assert_allclose(y, x @ back, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("wdt,gs", [("int8", 64), ("int4", 64),
+                                    ("int8", 128), ("int4", 128)])
+def test_weight_only_linear_grouped_pallas_matches_jnp(wdt, gs):
+    """The grouped Pallas kernels (per-k-block scale rows; int4 hi-plane
+    group offset) == the dense grouped dequant matmul."""
+    K, N = 256, 40
+    x = rng.normal(size=(30, K)).astype(np.float32)
+    w = (rng.normal(size=(K, N)) * 0.1).astype(np.float32)
+    w[:gs] *= 10.0
+    algo = f"weight_only_{wdt}"
+    q, s = weight_quantize(pt.to_tensor(w), algo=algo, group_size=gs)
+    old = FLAGS.pallas_interpret
+    try:
+        set_flags({"pallas_interpret": True})
+        got = np.asarray(weight_only_linear(pt.to_tensor(x), q, None, s,
+                                            weight_dtype=wdt, group_size=gs))
+    finally:
+        set_flags({"pallas_interpret": old})
+    exp = np.asarray(weight_only_linear(pt.to_tensor(x), q, None, s,
+                                        weight_dtype=wdt, group_size=gs))
+    np.testing.assert_allclose(got, exp, rtol=2e-4, atol=2e-4)
+
+
+def test_grouped_misuse_raises():
+    """r4 review: misuse fails loudly, not silently-wrong."""
+    w = rng.normal(size=(128, 16)).astype(np.float32)
+    with pytest.raises(ValueError, match="llm.int8"):
+        weight_quantize(pt.to_tensor(w), algo="llm.int8", group_size=64)
+    with pytest.raises(ValueError, match="group_size"):
+        weight_dequantize(pt.to_tensor(w).astype("int8"),
+                          np.ones(16, "float32"), group_size=256)
+    # per-channel [N] scale with group_size set must raise in the kernel,
+    # not zero out weight groups
+    from paddle_tpu.ops.pallas.quant_linear import weight_only_matmul
+    import jax.numpy as _jnp
+    with pytest.raises(ValueError, match="grouped scale"):
+        weight_only_matmul(_jnp.ones((4, 256), _jnp.float32),
+                           _jnp.ones((256, 16), _jnp.int8),
+                           _jnp.ones((16,), _jnp.float32), group_size=64)
