@@ -232,15 +232,14 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     decode kernel on TPU (ops/pallas/decode_attention.py)."""
     from ....ops.pallas.decode_attention import decode_attention
 
-    if rotary_tensor is not None or rotary_emb_dims:
-        raise NotImplementedError(
-            "masked_multihead_attention: apply RoPE to q/k before the fused "
-            "qkv input (models/generation.py does); in-kernel rotary is not "
-            "implemented")
-    if src_mask is not None:
-        raise NotImplementedError(
-            "masked_multihead_attention: src_mask is not implemented; "
-            "decode masking is by sequence_lengths")
+    if rotary_tensor is not None and not rotary_emb_dims:
+        rotary_emb_dims = 1
+    if rotary_emb_dims and rotary_tensor is None:
+        raise ValueError("masked_multihead_attention: rotary_emb_dims set "
+                         "but rotary_tensor is None")
+    if rotary_emb_dims not in (0, 1, 2):
+        raise ValueError(f"rotary_emb_dims must be 0/1/2, got "
+                         f"{rotary_emb_dims}")
     if beam_cache_offset is not None:
         raise NotImplementedError(
             "masked_multihead_attention: beam search cache offsets are not "
@@ -266,7 +265,55 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
                     f"masked_multihead_attention: cache full (length {mx} "
                     f">= capacity {cap})")
 
-    def impl(xv, cache, b, seqlens):
+    def _apply_mmha_rope(q, k, rot, lens):
+        """Reference mmha kernel rotary (masked_multihead_attention_
+        kernel.cu:247-): ``rot`` packs a cos plane then a sin plane
+        ([2, B, rotary_seq_len, 1, dim_head], the kernel comment's
+        layout).  rotary_seq_len == 1 means the caller pre-gathered the
+        row at the current position; a full table (rotary_seq_len > 1)
+        is gathered here at each row's current length.  non-neox:
+        interleaved per-element transform (q2i, q2i+1 rotated with
+        cos/sin at those same elements); neox: half-rotation within each
+        of ``rotary_emb_dims`` sections."""
+        B, H, D = q.shape
+        rot = rot.astype(jnp.float32)
+        if rot.shape[0] != 2 or rot.size % (2 * B * D):
+            raise ValueError("rotary_tensor must pack [2 (cos,sin), B, "
+                             f"rotary_seq_len, 1, {D}]; got shape "
+                             f"{rot.shape}")
+        table = rot.reshape(2, B, -1, D)            # [2, B, S_rot, D]
+        if table.shape[2] == 1:
+            table = table[:, :, 0]                  # pre-gathered row
+        else:                                       # gather at position
+            pos = jnp.clip(lens, 0, table.shape[2] - 1)
+            table = table[:, jnp.arange(B), pos]    # [2, B, D]
+        cos = table[0][:, None]                     # [B, 1, D]
+        sin = table[1][:, None]
+
+        def tr(t):
+            tf = t.astype(jnp.float32)
+            if not use_neox_rotary_style:
+                x = tf[..., 0::2]
+                y = tf[..., 1::2]
+                x2 = x * cos[..., 0::2] - y * sin[..., 0::2]
+                y2 = y * cos[..., 1::2] + x * sin[..., 1::2]
+                out = jnp.stack([x2, y2], axis=-1).reshape(B, H, D)
+            else:
+                last = D // rotary_emb_dims
+                half = last // 2
+                sec = tf.reshape(B, H, rotary_emb_dims, last)
+                cs = cos.reshape(B, 1, rotary_emb_dims, last)
+                sn = sin.reshape(B, 1, rotary_emb_dims, last)
+                x = sec[..., :half]
+                y = sec[..., half:]
+                x2 = x * cs[..., :half] - y * sn[..., :half]
+                y2 = y * cs[..., half:] + x * sn[..., half:]
+                out = jnp.concatenate([x2, y2], -1).reshape(B, H, D)
+            return out.astype(t.dtype)
+
+        return tr(q), tr(k)
+
+    def impl(xv, cache, b, seqlens, rot, smask):
         B = xv.shape[0]
         H, T, D = cache.shape[2], cache.shape[3], cache.shape[4]
         if b is not None:
@@ -277,19 +324,38 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
             raise ValueError("masked_multihead_attention needs "
                              "sequence_lengths (cache fill per row)")
         lens = seqlens.reshape(B).astype(jnp.int32)
+        if rot is not None:
+            q, k = _apply_mmha_rope(q, k, rot, lens)
         # scatter this step's k/v at each row's current length (capacity
         # validated on the concrete lengths in the outer function)
         tpos = lens  # [B]
         bidx = jnp.arange(B)
         kc = cache[0].at[bidx, :, tpos].set(k)     # [B, H, T, D]
         vc = cache[1].at[bidx, :, tpos].set(v)
-        out = decode_attention(q, jnp.swapaxes(kc, 1, 2),
-                               jnp.swapaxes(vc, 1, 2), lens + 1)
+        if smask is not None:
+            # additive score mask over cache positions (reference
+            # mmha_naive: product + src_mask before softmax) — the masked
+            # path runs as one fused XLA step instead of the Pallas
+            # decode kernel
+            m = smask.astype(jnp.float32).reshape(B, 1, -1)
+            scores = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32),
+                                kc.astype(jnp.float32)) * (D ** -0.5)
+            if m.shape[-1] < T:
+                m = jnp.pad(m, ((0, 0), (0, 0), (0, T - m.shape[-1])))
+            scores = scores + m[..., :T]
+            valid = jnp.arange(T)[None, None, :] <= lens[:, None, None]
+            scores = jnp.where(valid, scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bht,bhtd->bhd", probs,
+                             vc.astype(jnp.float32)).astype(xv.dtype)
+        else:
+            out = decode_attention(q, jnp.swapaxes(kc, 1, 2),
+                                   jnp.swapaxes(vc, 1, 2), lens + 1)
         return out.reshape(B, H * D), jnp.stack([kc, vc])
 
     return run_op("masked_multihead_attention", impl,
-                  (x, cache_kv, bias, sequence_lengths), {},
-                  differentiable=False)
+                  (x, cache_kv, bias, sequence_lengths, rotary_tensor,
+                   src_mask), {}, differentiable=False)
 
 
 def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,

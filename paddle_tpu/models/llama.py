@@ -79,18 +79,28 @@ def llama_tiny(**kw) -> LlamaConfig:
 
 
 def llama_7b(**kw) -> LlamaConfig:
-    return LlamaConfig(hidden_size=4096, intermediate_size=11008,
-                       num_layers=32, num_heads=32, **kw)
+    kw.setdefault("hidden_size", 4096)
+    kw.setdefault("intermediate_size", 11008)
+    kw.setdefault("num_layers", 32)
+    kw.setdefault("num_heads", 32)
+    return LlamaConfig(**kw)
 
 
 def llama_13b(**kw) -> LlamaConfig:
-    return LlamaConfig(hidden_size=5120, intermediate_size=13824,
-                       num_layers=40, num_heads=40, **kw)
+    kw.setdefault("hidden_size", 5120)
+    kw.setdefault("intermediate_size", 13824)
+    kw.setdefault("num_layers", 40)
+    kw.setdefault("num_heads", 40)
+    return LlamaConfig(**kw)
 
 
 def llama_70b(**kw) -> LlamaConfig:
-    return LlamaConfig(hidden_size=8192, intermediate_size=28672,
-                       num_layers=80, num_heads=64, num_kv_heads=8, **kw)
+    kw.setdefault("hidden_size", 8192)
+    kw.setdefault("intermediate_size", 28672)
+    kw.setdefault("num_layers", 80)
+    kw.setdefault("num_heads", 64)
+    kw.setdefault("num_kv_heads", 8)
+    return LlamaConfig(**kw)
 
 
 def _rope_cos_sin(seq_len: int, head_dim: int, theta: float, dtype):

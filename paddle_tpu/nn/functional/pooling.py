@@ -207,9 +207,48 @@ def adaptive_max_pool1d(x, output_size, return_mask=False, data_format="NCL"):
         x, output_size, 1, data_format == "NLC", "max"), (x,), {})
 
 
+def _adaptive_maxpool2d_with_index(x, output_size):
+    """NCHW adaptive max pooling returning (out, flat H*W indices) —
+    reference max_pool2d_with_index(adaptive=True) semantics.  Non-uniform
+    windows are padded to the max window size with -inf and argmaxed."""
+    n, c, h, w = x.shape
+    oh, ow = _tup(output_size, 2)
+    rs, re = _adaptive_windows(h, oh)
+    cs, ce = _adaptive_windows(w, ow)
+    kh = max(e - s for s, e in zip(rs, re))
+    kw = max(e - s for s, e in zip(cs, ce))
+    iy = np.minimum(np.array(rs)[:, None] + np.arange(kh)[None], h - 1)
+    ix = np.minimum(np.array(cs)[:, None] + np.arange(kw)[None], w - 1)
+    vy = (np.arange(kh)[None] < (np.array(re) - np.array(rs))[:, None])
+    vx = (np.arange(kw)[None] < (np.array(ce) - np.array(cs))[:, None])
+    patches = x[:, :, iy[:, None, :, None], ix[None, :, None, :]]
+    # -> [N, C, Oh, Ow, kh, kw]
+    valid = (vy[:, None, :, None] & vx[None, :, None, :])[None, None]
+    masked = jnp.where(valid, patches, -jnp.inf)
+    flat = masked.reshape(n, c, oh, ow, kh * kw)
+    amax = jnp.argmax(flat, axis=-1)
+    out = jnp.max(flat, axis=-1)
+    # recover input coordinates of the argmax
+    ky = amax // kw                                   # [N, C, Oh, Ow]
+    kx = amax % kw
+    iy_t = jnp.asarray(iy)                            # [Oh, kh]
+    ix_t = jnp.asarray(ix)                            # [Ow, kw]
+    row = iy_t[jnp.arange(oh)[None, None, :, None], ky]
+    col = ix_t[jnp.arange(ow)[None, None, None, :], kx]
+    return out, (row * w + col).astype(jnp.int32)
+
+
 def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
-    return run_op("adaptive_max_pool2d", lambda x: _adaptive_pool(
-        x, output_size, 2, data_format == "NHWC", "max"), (x,), {})
+    def impl(x):
+        if return_mask:
+            if data_format == "NHWC":
+                o, i = _adaptive_maxpool2d_with_index(
+                    jnp.moveaxis(x, -1, 1), output_size)
+                return jnp.moveaxis(o, 1, -1), jnp.moveaxis(i, 1, -1)
+            return _adaptive_maxpool2d_with_index(x, output_size)
+        return _adaptive_pool(x, output_size, 2, data_format == "NHWC",
+                              "max")
+    return run_op("adaptive_max_pool2d", impl, (x,), {})
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, data_format="NCDHW"):

@@ -295,11 +295,6 @@ def warprnnt(input, label, input_lengths, label_lengths, blank=0,
     input: [B, T, U+1, V] joint log-probs (log-softmaxed here); the
     forward variable recursion runs as a lax.scan over T with an inner
     scan over U — O(T·U) sequential steps, each a [B] vector op."""
-    if fastemit_lambda:
-        raise NotImplementedError(
-            "warprnnt: FastEmit regularization (fastemit_lambda != 0) is "
-            "not implemented; the plain transducer loss would silently "
-            "ignore it")
     x = jax.nn.log_softmax(_v(input), axis=-1)
     y = _v(label).astype(jnp.int32)             # [B, U]
     tl = _v(input_lengths).astype(jnp.int32)    # [B]
@@ -307,6 +302,35 @@ def warprnnt(input, label, input_lengths, label_lengths, blank=0,
     B, T, U1, V = x.shape
     U = U1 - 1
     NEG = -1e30
+
+    if fastemit_lambda:
+        # FastEmit (Yu et al. 2021, eq. 12-14; warp-transducer's
+        # fastemit_lambda): the loss VALUE is unchanged, but the gradient
+        # w.r.t. each label-emission log-prob y(t,u) is scaled by
+        # (1 + lambda) while blank gradients stay as-is — pushing the
+        # model to emit labels earlier.  Expressed as an identity-forward
+        # custom VJP on the log-prob lattice.
+        lam = float(fastemit_lambda)
+        emit_mask = jnp.zeros((B, 1, U1, V), x.dtype)
+        if U > 0:
+            oh = jax.nn.one_hot(y, V, dtype=x.dtype)         # [B, U, V]
+            emit_mask = jnp.pad(oh, ((0, 0), (0, 1), (0, 0)))[:, None]
+
+        # the mask rides the primals/residuals (NOT a closure capture):
+        # labels may be tracers under the jitted vjp executor, and a
+        # tracer captured in a custom-vjp bwd closure is a trace-time error
+        @jax.custom_vjp
+        def _fastemit(xlp, mask):
+            return xlp
+
+        def _fe_fwd(xlp, mask):
+            return xlp, mask
+
+        def _fe_bwd(mask, g):
+            return g * (1.0 + lam * mask), None
+
+        _fastemit.defvjp(_fe_fwd, _fe_bwd)
+        x = _fastemit(x, emit_mask)
 
     blank_lp = x[..., blank]                    # [B, T, U+1]
     lab_lp = jnp.take_along_axis(

@@ -111,7 +111,11 @@ def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
     reduce_window argmax kernel (nn/functional/pooling.py:_maxpool) — one
     source of truth for max-with-index pooling."""
     if adaptive:
-        raise NotImplementedError("adaptive max_pool_with_index")
+        # reference adaptive path: kernel_size is the OUTPUT size
+        from ...nn.functional.pooling import (_adaptive_maxpool2d_with_index,
+                                              _tup)
+        return _adaptive_maxpool2d_with_index(jnp.asarray(x),
+                                              _tup(kernel_size, 2))
     from ...nn.functional.pooling import _maxpool, _tup
     ks = tuple(x.shape[2:]) if global_pooling else _tup(kernel_size, 2)
     st = ks if stride is None else _tup(stride, 2)

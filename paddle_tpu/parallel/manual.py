@@ -300,9 +300,6 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
 
     if schedule not in ("1f1b", "gpipe", "interleave", "zbh1"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
-    if schedule == "interleave" and sharding_stage == 3:
-        raise NotImplementedError(
-            "interleaved schedule with sharding_stage=3 is not wired yet")
     if sharding_stage not in (2, 3):
         raise ValueError(f"sharding_stage must be 2 or 3, got "
                          f"{sharding_stage}")
@@ -319,7 +316,13 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
     # moments) and are all_gather'ed AT USE — per layer inside the scan,
     # so off-layer weights cost 1/shard of their size.  The AD transpose
     # of that gather is the stage-3 grad reduce-scatter for free.
-    BLOCK_FLAT_SPEC = P(PP_AXIS, None, MP_AXIS, SHARDING_AXIS)
+    # Under the interleaved schedule blocks carry an extra chunk axis
+    # ([S, v, per, ...] — vpp_block_layout), so the flat at-rest layout
+    # keeps ALL leading axes between pp and the layer dims ("lead").
+    vpp_deg = num_model_chunks if schedule == "interleave" else 1
+    n_lead = 2 if vpp_deg > 1 else 1
+    BLOCK_FLAT_SPEC = P(PP_AXIS, *((None,) * n_lead + (MP_AXIS,)),
+                        SHARDING_AXIS)
     stage3 = sharding_stage == 3
     if stage3:
         p_abs = jax.eval_shape(init_params_fn, 0)
@@ -327,9 +330,9 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
         def _leaf_info(leaf, spec, is_block):
             ls = local_shape(leaf.shape, spec, topo)
             if is_block:
-                layer = tuple(ls[2:])
+                layer = tuple(ls[1 + n_lead:])
                 n = int(np.prod(layer)) or 1
-                return {"local": layer, "per": ls[1],
+                return {"local": layer, "lead": tuple(ls[1:1 + n_lead]),
                         "chunk": -(-n // shard), "dtype": leaf.dtype}
             n = int(np.prod(ls)) or 1
             return {"local": tuple(ls), "chunk": -(-n // shard),
@@ -356,8 +359,8 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
     def _flat_shape(k, k2=None):
         if k2 is None:
             return (S, mp_deg, shard * info[k]["chunk"])
-        return (S, info["blocks"][k2]["per"], mp_deg,
-                shard * info["blocks"][k2]["chunk"])
+        return (S,) + info["blocks"][k2]["lead"] + (
+            mp_deg, shard * info["blocks"][k2]["chunk"])
 
     def init_fn(seed: int = 0):
         params = init_params_fn(seed)
@@ -369,10 +372,12 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                         continue
                     out[k] = pack_leaf(prm[k], info[k]["chunk"])[None, None]
                 for k, val in prm["blocks"].items():
-                    c = info["blocks"][k]["chunk"]
-                    packed = jax.vmap(lambda lv, c=c: pack_leaf(lv, c))(
-                        val[0])
-                    out["blocks"][k] = packed[:, None][None]
+                    inf = info["blocks"][k]
+                    c = inf["chunk"]
+                    lv = val[0].reshape((-1,) + inf["local"])
+                    packed = jax.vmap(lambda l, c=c: pack_leaf(l, c))(lv)
+                    out["blocks"][k] = packed.reshape(
+                        (1,) + inf["lead"] + (1, c))
                 return out
 
             pack = jax.jit(jax.shard_map(
@@ -485,6 +490,8 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
             labels_mb = labels.reshape(M, b_l // M, s_l)
 
             def mb_fn_v(other_p, blk_c, x_in, ids1, labels1, first, last):
+                if stage3:
+                    other_p = _unpack_other(other_p)
                 p = dict(other_p, blocks=None)
                 x0 = embed_fn(p, ids1)
                 x = jnp.where(first, x0, x_in)
@@ -492,9 +499,12 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                 nll = head_nll_fn(p, y, labels1)
                 return y, jnp.sum(nll) * last.astype(nll.dtype)
 
-            xa = jax.eval_shape(
-                lambda o, i: embed_fn(dict(o, blocks=None), i),
-                other, ids_mb[0])
+            def _embed_probe_v(o, i):
+                if stage3:
+                    o = _unpack_other(o)
+                return embed_fn(dict(o, blocks=None), i)
+
+            xa = jax.eval_shape(_embed_probe_v, other, ids_mb[0])
             nll_sum, d_other, d_blk = spmd_pipeline_interleaved(
                 mb_fn_v, other, blk, ids_mb, labels_mb, xa.shape, xa.dtype,
                 S, n_chunks)

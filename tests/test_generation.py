@@ -187,3 +187,105 @@ def test_jit_save_load_dynamic_batch(tmp_path):
         x = pt.to_tensor(rng.normal(size=(b, 8)).astype(np.float32))
         np.testing.assert_allclose(np.asarray(loaded(x)),
                                    np.asarray(net(x)), rtol=1e-5, atol=1e-5)
+
+def test_mmha_src_mask_matches_reference_naive():
+    """src_mask path == reference test_masked_multihead_attention_op.py
+    mmha_naive: scores + src_mask before softmax over the cache."""
+    from paddle_tpu.incubate.nn import functional as IF
+    B, H, D, T = 2, 3, 8, 12
+    L = 6                                       # filled cache length
+    x = rng.normal(size=(B, 3 * H * D)).astype(np.float32)
+    cache = np.zeros((2, B, H, T, D), np.float32)
+    cache[:, :, :, :L] = rng.normal(size=(2, B, H, L, D))
+    lens = np.full((B,), L, np.int32)
+    mask = rng.normal(size=(B, 1, 1, L + 1)).astype(np.float32)
+
+    out, _ = IF.masked_multihead_attention(
+        pt.to_tensor(x), pt.to_tensor(cache),
+        src_mask=pt.to_tensor(mask),
+        sequence_lengths=pt.to_tensor(lens))
+
+    # naive: concat step k/v after the filled cache, full softmax
+    qkv = x.reshape(B, 3, H, D)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    kc = np.concatenate([cache[0][:, :, :L], k[:, :, None]], axis=2)
+    vc = np.concatenate([cache[1][:, :, :L], v[:, :, None]], axis=2)
+    scores = np.einsum("bhd,bhtd->bht", q, kc) * (D ** -0.5)
+    scores = scores + mask[:, 0]
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bht,bhtd->bhd", p, vc).reshape(B, H * D)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("neox", [False, True])
+def test_mmha_rotary(neox):
+    """In-op rotary (reference mmha kernel :247-): cos/sin planes applied
+    to q and k before the cache scatter; verified against a hand-rolled
+    rotation + the no-rotary op on pre-rotated inputs."""
+    from paddle_tpu.incubate.nn import functional as IF
+    B, H, D, T = 2, 2, 8, 10
+    L = 3
+    x = rng.normal(size=(B, 3 * H * D)).astype(np.float32)
+    cache = np.zeros((2, B, H, T, D), np.float32)
+    cache[:, :, :, :L] = rng.normal(size=(2, B, H, L, D))
+    lens = np.full((B,), L, np.int32)
+    theta = rng.normal(size=(B, D)).astype(np.float32)
+    rot = np.stack([np.cos(theta), np.sin(theta)])    # [2, B, D]
+
+    out, nc = IF.masked_multihead_attention(
+        pt.to_tensor(x), pt.to_tensor(cache),
+        sequence_lengths=pt.to_tensor(lens),
+        rotary_tensor=pt.to_tensor(rot.reshape(2, B, 1, 1, D)),
+        use_neox_rotary_style=neox, rotary_emb_dims=1)
+
+    # rotate q/k by hand, run the op WITHOUT rotary on the edited qkv
+    qkv = x.reshape(B, 3, H, D).copy()
+    cos, sin = rot[0][:, None], rot[1][:, None]       # [B, 1, D]
+    for i in (0, 1):
+        t = qkv[:, i]
+        if not neox:
+            xs, ys = t[..., 0::2], t[..., 1::2]
+            x2 = xs * cos[..., 0::2] - ys * sin[..., 0::2]
+            y2 = ys * cos[..., 1::2] + xs * sin[..., 1::2]
+            qkv[:, i] = np.stack([x2, y2], -1).reshape(B, H, D)
+        else:
+            h = D // 2
+            xs, ys = t[..., :h], t[..., h:]
+            x2 = xs * cos[..., :h] - ys * sin[..., :h]
+            y2 = ys * cos[..., h:] + xs * sin[..., h:]
+            qkv[:, i] = np.concatenate([x2, y2], -1)
+    out2, nc2 = IF.masked_multihead_attention(
+        pt.to_tensor(qkv.reshape(B, -1)), pt.to_tensor(cache),
+        sequence_lengths=pt.to_tensor(lens))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(nc), np.asarray(nc2),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_mmha_rotary_full_table_gathers_at_position():
+    """A reference-shaped full rotary table [2, B, S, 1, D] is gathered at
+    each row's current length — same result as pre-gathering by hand."""
+    from paddle_tpu.incubate.nn import functional as IF
+    B, H, D, T, S = 2, 2, 8, 10, 6
+    L = np.array([3, 5], np.int32)
+    x = rng.normal(size=(B, 3 * H * D)).astype(np.float32)
+    cache = np.zeros((2, B, H, T, D), np.float32)
+    cache[:, :, :, :5] = rng.normal(size=(2, B, H, 5, D))
+    theta = rng.normal(size=(B, S, D)).astype(np.float32)
+    table = np.stack([np.cos(theta), np.sin(theta)])  # [2, B, S, D]
+
+    out_full, _ = IF.masked_multihead_attention(
+        pt.to_tensor(x), pt.to_tensor(cache),
+        sequence_lengths=pt.to_tensor(L),
+        rotary_tensor=pt.to_tensor(table.reshape(2, B, S, 1, D)),
+        rotary_emb_dims=1)
+    pre = table[:, np.arange(B), L]                   # [2, B, D]
+    out_pre, _ = IF.masked_multihead_attention(
+        pt.to_tensor(x), pt.to_tensor(cache),
+        sequence_lengths=pt.to_tensor(L),
+        rotary_tensor=pt.to_tensor(pre.reshape(2, B, 1, 1, D)),
+        rotary_emb_dims=1)
+    np.testing.assert_allclose(np.asarray(out_full), np.asarray(out_pre),
+                               rtol=1e-5, atol=1e-5)

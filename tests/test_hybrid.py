@@ -316,6 +316,18 @@ def test_interleave_three_chunks():
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.parametrize("axes", [dict(pp=2, sharding=2),
+                                  dict(pp=2, mp=2, sharding=2)])
+def test_interleave_stage3_matches_single_device(axes):
+    """VPP + ZeRO stage-3 (r4: the last unwired schedule x sharding
+    combination): flat-at-rest params with the chunk axis, gather-at-use
+    inside each virtual chunk's stack."""
+    ref = _losses(layers=4, batch=8)
+    got = _losses(**axes, layers=4, batch=8, schedule="interleave",
+                  num_microbatches=4, num_model_chunks=2, sharding_stage=3)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
 # ---------------------------------------------------------------------------
 # ZBH1 zero-bubble schedule (reference pipeline_scheduler_pass ZBH1)
 # ---------------------------------------------------------------------------

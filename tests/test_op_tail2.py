@@ -326,3 +326,92 @@ class TestMoeGraphCreationOps:
                                    pt.Tensor(info))
         np.testing.assert_array_equal(_np(child)[0], [4, 5])
         np.testing.assert_array_equal(_np(leaf)[0], [1, 1])
+
+
+class TestR4GuardBurndown:
+    """NOTIMPL guards removed in round 4 (fastemit, adaptive max-index)."""
+
+    def test_warprnnt_fastemit_gradient_scaling(self):
+        """FastEmit (Yu 2021 eq.14): loss value unchanged; label-emission
+        grads scaled by (1+lambda), blank grads untouched."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.impl.misc_ops import warprnnt as wr
+        B, T, U, V = 2, 3, 2, 4
+        x = rng.normal(size=(B, T, U + 1, V)).astype(np.float32)
+        y = rng.integers(1, V, (B, U)).astype(np.int32)
+        tl = np.array([T, T], np.int32)
+        ul = np.array([U, U], np.int32)
+        lam = 0.5
+
+        def loss0(xv):
+            return jnp.sum(wr(xv, y, tl, ul, blank=0, fastemit_lambda=0.0))
+
+        def loss1(xv):
+            return jnp.sum(wr(xv, y, tl, ul, blank=0, fastemit_lambda=lam))
+
+        np.testing.assert_allclose(float(loss0(x)), float(loss1(x)),
+                                   rtol=1e-6)
+        # label positions: the (b, :, u, y[b,u]) entries of the lattice
+        mask = np.zeros((B, T, U + 1, V), bool)
+        for b in range(B):
+            for u in range(U):
+                mask[b, :, u, y[b, u]] = True
+        # differentiate on an already-normalized lattice: wr's internal
+        # log_softmax is then numerically the identity, so input grads
+        # approximate the lattice grads up to the softmax jacobian's
+        # mixing term
+        lp = np.asarray(jax.nn.log_softmax(jnp.asarray(x), -1))
+        gl0 = np.asarray(jax.grad(
+            lambda v: jnp.sum(wr(v, y, tl, ul, blank=0,
+                                 fastemit_lambda=0.0)))(jnp.asarray(lp)))
+        gl1 = np.asarray(jax.grad(
+            lambda v: jnp.sum(wr(v, y, tl, ul, blank=0,
+                                 fastemit_lambda=lam)))(jnp.asarray(lp)))
+        # FastEmit must change the label-position grads...
+        assert not np.allclose(gl1[mask], gl0[mask])
+        # ...by the (1+lam) factor, up to the jacobian mixing
+        ratio = gl1[mask] / np.where(np.abs(gl0[mask]) < 1e-12, 1,
+                                     gl0[mask])
+        assert np.median(ratio) == pytest.approx(1 + lam, rel=0.25)
+
+    def test_max_pool2d_with_index_adaptive(self):
+        x = rng.normal(size=(2, 3, 7, 5)).astype(np.float32)
+        out, idx = pt.max_pool2d_with_index(pt.Tensor(x), 3, adaptive=True)
+        assert _np(out).shape == (2, 3, 3, 3)
+        assert _np(idx).shape == (2, 3, 3, 3)
+        # indices are flat H*W positions of the max; values must agree
+        flat = x.reshape(2, 3, -1)
+        picked = np.take_along_axis(flat, _np(idx).reshape(2, 3, -1),
+                                    -1).reshape(2, 3, 3, 3)
+        np.testing.assert_allclose(_np(out), picked)
+        # and out equals torch-style adaptive max pooling
+        import torch
+        ref = torch.nn.functional.adaptive_max_pool2d(
+            torch.tensor(x), 3).numpy()
+        np.testing.assert_allclose(_np(out), ref, rtol=1e-6)
+
+    def test_adaptive_max_pool2d_return_mask(self):
+        x = rng.normal(size=(1, 2, 8, 6)).astype(np.float32)
+        out, idx = pt.nn.functional.adaptive_max_pool2d(
+            pt.Tensor(x), [4, 3], return_mask=True)
+        flat = x.reshape(1, 2, -1)
+        picked = np.take_along_axis(flat, _np(idx).reshape(1, 2, -1),
+                                    -1).reshape(1, 2, 4, 3)
+        np.testing.assert_allclose(_np(out), picked)
+
+    def test_warprnnt_fastemit_traced_labels(self):
+        """r4 review: labels are tracers under the jitted vjp executor —
+        the FastEmit mask must ride residuals, not a bwd closure."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.impl.misc_ops import warprnnt as wr
+        B, T, U, V = 1, 3, 2, 4
+        x = rng.normal(size=(B, T, U + 1, V)).astype(np.float32)
+        y = np.array([[1, 2]], np.int32)
+        tl = np.array([T], np.int32)
+        ul = np.array([U], np.int32)
+        g = jax.jit(jax.grad(lambda xv, yv: jnp.sum(
+            wr(xv, yv, tl, ul, blank=0, fastemit_lambda=0.4))))(
+                jnp.asarray(x), jnp.asarray(y))
+        assert np.isfinite(np.asarray(g)).all()
