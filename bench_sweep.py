@@ -22,9 +22,10 @@ def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
     from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
     from paddle_tpu import parallel as dist
 
-    if autotune:
-        from paddle_tpu.core.flags import FLAGS
-        FLAGS.use_autotune = True
+    # always assign (not just set-on-True): rows run in one process, so a
+    # stale True from an earlier autotune row would mislabel later rows
+    from paddle_tpu.core.flags import FLAGS
+    FLAGS.use_autotune = bool(autotune)
     cfg = GPTConfig(vocab_size=V, hidden_size=h, num_layers=L,
                     num_heads=h // 64, max_position_embeddings=seq,
                     dtype="bfloat16")
@@ -71,11 +72,17 @@ def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
 DEFAULT_MATRIX = [
     dict(batch=8, seq=1024, steps=10, remat=False, flash=False),
     dict(batch=8, seq=1024, steps=10, remat=False, flash=True),
+    dict(batch=8, seq=1024, steps=10, remat=False, flash=None),  # auto
     dict(batch=8, seq=1024, steps=10, remat=True, flash=True),
     dict(batch=8, seq=1024, steps=10, remat=False, flash=True,
          autotune=True),
+    # b16 without remat: dense residuals outgrow HBM — the auto policy
+    # must flip to flash here (dense OOM'd in the round-4 seize)
+    dict(batch=16, seq=1024, steps=10, remat=False, flash=None),
     dict(batch=4, seq=2048, steps=5, remat=True, flash=True,
          h=2048, L=12, V=51200),
+    dict(batch=4, seq=2048, steps=5, remat=True, flash=True,
+         h=2048, L=12, V=51200, autotune=True),
     dict(batch=4, seq=2048, steps=5, remat=True, flash=False,
          h=2048, L=12, V=51200),
 ]

@@ -232,6 +232,20 @@ def block_param_specs(cfg: GPTConfig, pipeline: bool) -> Dict[str, P]:
     return {k: P(PP_AXIS, None, *list(v)) for k, v in base.items()}
 
 
+def dense_causal_attention(q: jax.Array, k: jax.Array,
+                           v: jax.Array) -> jax.Array:
+    """Plain-XLA causal attention, [B, S, H, D] in/out.  XLA fuses this
+    into its own attention kernel; on v5e it beats the Pallas flash path
+    whenever the f32 logit residuals fit HBM (see ops/attention_policy)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = q.shape[1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(logits, -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 def block_apply(params: Dict[str, jax.Array], x: jax.Array,
                 cfg: GPTConfig, attn_fn=None,
                 mp_axis: Optional[str] = None,
@@ -301,12 +315,7 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
         attn = attn_fn(q, k, v)
         attn = attn.reshape(b, s, attn.shape[2] * attn.shape[3])
     else:
-        scale = 1.0 / math.sqrt(cfg.head_dim)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
-        probs = jax.nn.softmax(logits, -1).astype(x.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        attn = dense_causal_attention(q, k, v)
         attn = attn.reshape(b, s, attn.shape[2] * attn.shape[3])
     x = res + row_mm(attn, params["proj_w"]) + params["proj_b"]
     res = x
@@ -402,9 +411,23 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
         # Pallas flash attention on the device-local shard: inside a fully
         # manual shard_map the custom-call needs no partitioning rule, so
         # it is usable on ANY mesh (round-1 limited it to mesh.size==1).
-        if use_flash is None:
-            use_flash = jax.default_backend() not in ("cpu",)
-        if use_flash:
+        if use_flash is None and jax.default_backend() not in ("cpu",):
+            # auto: dense XLA attention while its residuals fit HBM, the
+            # Pallas flash kernel once they don't (ops/attention_policy —
+            # decided at trace time on the device-LOCAL q/k shapes)
+            from ..ops.attention_policy import prefer_flash
+            from ..ops.pallas.flash_attention import flash_attention
+            # residuals live per stage = resident layers x in-flight
+            # microbatches (1F1B keeps up to S in flight; GPipe all)
+            in_flight = num_microbatches if schedule == "gpipe" \
+                else min(num_microbatches, S)
+            L_live = (cfg.num_layers // S) * max(1, in_flight)
+
+            def cp_attn(q, k, v):
+                if prefer_flash(q.shape, k.shape, L_live, remat):
+                    return flash_attention(q, k, v, causal=True)
+                return dense_causal_attention(q, k, v)
+        elif use_flash:
             from ..ops.pallas.flash_attention import flash_attention
             cp_attn = functools.partial(flash_attention, causal=True)
         else:

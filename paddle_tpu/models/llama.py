@@ -445,9 +445,23 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
             def cp_attn(q, k, v):
                 return ulysses_attention(q, k, v, SEP_AXIS, True)
     else:
-        if use_flash is None:
-            use_flash = jax.default_backend() not in ("cpu",)
-        if use_flash:
+        if use_flash is None and jax.default_backend() not in ("cpu",):
+            # auto backend (ops/attention_policy): dense XLA attention
+            # while its residuals fit HBM, Pallas flash once they don't —
+            # decided at trace time on the device-local q/k shapes
+            from ..ops.attention_policy import prefer_flash
+            from ..ops.pallas.flash_attention import flash_attention
+            # residuals live per stage = resident layers x in-flight
+            # microbatches (1F1B keeps up to S in flight; GPipe all)
+            in_flight = num_microbatches if schedule == "gpipe" \
+                else min(num_microbatches, S)
+            L_live = (cfg.num_layers // S) * max(1, in_flight)
+
+            def cp_attn(q, k, v):
+                if prefer_flash(q.shape, k.shape, L_live, remat):
+                    return flash_attention(q, k, v, causal=True)
+                return _gqa_attention(q, k, v, causal=True)
+        elif use_flash:
             import functools
             from ..ops.pallas.flash_attention import flash_attention
             cp_attn = functools.partial(flash_attention, causal=True)

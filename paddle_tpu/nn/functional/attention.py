@@ -49,7 +49,13 @@ def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, key=None,
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True):
+    # backend policy (ops/attention_policy): at the single-op level the
+    # dense path pins one [B, H, Sq, Sk] f32 residual; XLA's fused dense
+    # attention is faster than the flash kernel until that outgrows HBM
     use_pallas = _should_use_pallas(query)
+    if use_pallas and not _interpret_forced():
+        from ...ops.attention_policy import prefer_flash
+        use_pallas = prefer_flash(query.shape, key.shape, 1, False)
     rng = next_rng_key() if (dropout_p > 0.0 and training) else None
 
     def impl(q, k, v, m, rk):
@@ -131,6 +137,13 @@ def _values_equal(a, b) -> bool:
                                                           np.asarray(b)))
     except Exception:   # traced values — can't decide, stay conservative
         return False
+
+
+def _interpret_forced() -> bool:
+    """Tests force the Pallas interpret path off-TPU; the perf-based
+    backend policy must not override that routing."""
+    from ...core.flags import FLAGS
+    return bool(FLAGS.pallas_interpret)
 
 
 def _should_use_pallas(query) -> bool:

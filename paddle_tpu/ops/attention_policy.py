@@ -1,0 +1,65 @@
+"""Attention backend selection: XLA dense fused attention vs Pallas flash.
+
+Mirror of the reference's per-shape scaled-dot-product backend dispatch
+(/root/reference/python/paddle/nn/functional/flash_attention.py:976 picks
+flash / mem-efficient / math per shape+dtype support), grounded in this
+repo's v5e measurements (BASELINE.md round-4 sweep):
+
+* XLA's fused dense attention is 15-47% FASTER than the in-tree flash
+  kernel whenever its softmax residuals fit in HBM (56.9k vs 48.0k tok/s
+  at GPT-125M b8 s1024; 11.4k vs 7.8k tok/s at h2048 s2048 remat).
+* The dense path OOMs once the saved [L, B, H, Sq, Sk] f32 logits outgrow
+  HBM (observed at b>=16 GPT-125M s1024 without remat: ~19 GB at b32).
+
+So flash is the memory-ENABLING path and dense the speed path until the
+flash kernel itself beats XLA (block tuning is ongoing): ``prefer_flash``
+returns True only when the dense residual footprint would crowd HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+_DEFAULT_HBM = 16e9          # v5e per-chip HBM; used when stats are absent
+_DENSE_BUDGET_FRAC = 0.35    # leave room for params/grads/opt state
+
+
+def hbm_bytes_per_device() -> float:
+    """Per-device HBM capacity; falls back to the v5e size on TPU and to
+    'unbounded' (so dense always wins) on CPU hosts."""
+    try:
+        import jax
+        dev = jax.local_devices()[0]
+        if dev.platform.lower() not in ("tpu", "axon"):
+            return float("inf")
+        stats = dev.memory_stats() or {}
+        return float(stats.get("bytes_limit") or _DEFAULT_HBM)
+    except Exception:
+        return _DEFAULT_HBM
+
+
+def dense_residual_bytes(q_shape: Sequence[int], k_shape: Sequence[int],
+                         layers_live: int) -> float:
+    """HBM the dense path pins for backward: f32 logits/probs of every
+    live layer ([B, Hq, Sq, Sk] per layer; XLA saves them at f32 — the
+    b32 OOM measured 19 GB, exactly L*B*H*S*S*4)."""
+    b, sq, hq = q_shape[0], q_shape[1], q_shape[2]
+    sk = k_shape[1]
+    return 4.0 * b * hq * sq * sk * max(1, layers_live)
+
+
+def prefer_flash(q_shape: Sequence[int], k_shape: Sequence[int],
+                 num_layers: int, remat: bool = False,
+                 hbm_bytes: Optional[float] = None,
+                 budget_frac: float = _DENSE_BUDGET_FRAC) -> bool:
+    """Decide the attention backend for a training step.
+
+    ``q_shape``/``k_shape``: [B, S, H, D] (device-LOCAL shapes — call
+    inside shard_map so dp/mp/sep sharding is already applied).
+    ``num_layers``: layers resident on this device (num_layers / pp).
+    ``remat``: under rematerialization only ~2 layers of residuals are
+    live at once (the recomputed layer + the one being differentiated).
+    """
+    live = 2 if remat else num_layers
+    hbm = hbm_bytes if hbm_bytes is not None else hbm_bytes_per_device()
+    return dense_residual_bytes(q_shape, k_shape, live) > budget_frac * hbm

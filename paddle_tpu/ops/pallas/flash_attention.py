@@ -50,9 +50,19 @@ DEFAULT_BLOCK = 128
 # candidate (block_q, block_k) grid for the autotuner (reference
 # phi/kernels/autotune: per-shape timed algorithm pick).  128 is the MXU
 # tile edge; bigger q blocks amortize the softmax state, bigger k blocks
-# amortize the kv loads.
+# amortize the kv loads.  Large blocks matter most at head_dim 64, where
+# a 128x128 tile only half-fills the MXU depth.
 _BLOCK_CANDIDATES = ((128, 128), (256, 128), (128, 256), (256, 256),
-                     (512, 128))
+                     (512, 128), (512, 256), (256, 512), (512, 512))
+
+
+def _grid_params():
+    """Mosaic annotations shared by the fwd/dq/dkv grids: in each, ONLY
+    the innermost dim carries cross-iteration state (the VMEM scratch
+    accumulators sweep over it); the three outer dims are parallel.
+    Reordering any grid must preserve that invariant."""
+    return pltpu.CompilerParams(dimension_semantics=(
+        "parallel", "parallel", "parallel", "arbitrary"))
 
 
 def _blocks(seq: int) -> int:
@@ -211,7 +221,10 @@ def _fwd(q, k, v, scale, causal, seg_q=None, seg_k=None, bias=None,
     nk = Sk // bk
     has_seg = seg_q is not None
     has_bias = bias is not None
-    # [B, S, H, D] -> [B, H, S, D]
+    # [B, S, H, D] -> [B, H, S, D].  Head-major is forced by Mosaic's
+    # tiling rule (last two block dims must be 8/128-aligned or full-size,
+    # so the head dim cannot be squeezed mid-shape); XLA fuses these
+    # transposes into the producing matmul fusions.
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
@@ -258,6 +271,7 @@ def _fwd(q, k, v, scale, causal, seg_q=None, seg_k=None, bias=None,
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, D), jnp.float32),
         ],
+        compiler_params=_grid_params(),
         interpret=use_interpret(),
     )(*args)
     # slice BOTH outputs to the unpadded length — callers (ring merge)
@@ -407,17 +421,45 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, nq, G, kv_len,
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, has_seg, has_bias, res, g):
+def _tuned_blocks_bwd(res, g, scale, causal, has_seg, has_bias):
+    """Autotune the backward blocks like the forward's _tuned_blocks —
+    bwd is ~2/3 of training-attention FLOPs, so a fixed 128x128 leaves
+    the most time on the table exactly where it hurts most."""
+    from .autotune import FLAGS, lookup, pick
+    q, k = res[0], res[1]
+    B, Sq0, Hq, D = q.shape
+    default = (_blocks(Sq0), _blocks(k.shape[1]))
+    if not FLAGS.use_autotune:
+        return default
+    key = ("bwd", B, Sq0, k.shape[1], Hq, k.shape[2], D, str(q.dtype),
+           causal, has_seg, has_bias)
+    if isinstance(q, jax.core.Tracer):
+        return lookup("flash_bwd", key, default)
+
+    def run(cand):
+        bq, bk = cand
+        return jax.jit(functools.partial(
+            _bwd, scale, causal, has_seg, has_bias,
+            block_q=bq, block_k=bk))
+
+    return pick("flash_bwd", key, _BLOCK_CANDIDATES, run, (res, g), default)
+
+
+def _bwd(scale, causal, has_seg, has_bias, res, g,
+         block_q=None, block_k=None):
     q, k, v, out, lse, seg_q, seg_k, bias = res
     do = g
     B, Sq0, Hq, D = q.shape
     Sk0, Hkv = k.shape[1], k.shape[2]
     G = Hq // Hkv
-    bq = _blocks(Sq0)
-    bk = _blocks(Sk0)
     if Hq % Hkv != 0:
         raise ValueError(f"q heads ({Hq}) must be a multiple of kv heads "
                          f"({Hkv}) for GQA")
+    if block_q is None or block_k is None:
+        block_q, block_k = _tuned_blocks_bwd(res, g, scale, causal,
+                                             has_seg, has_bias)
+    bq = min(block_q, _pow2_ceil(Sq0))
+    bk = min(block_k, _pow2_ceil(Sk0))
     q = _pad_seq(q, bq)
     k = _pad_seq(k, bk)
     v = _pad_seq(v, bk)
@@ -434,10 +476,10 @@ def _bwd(scale, causal, has_seg, has_bias, res, g):
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    ot = jnp.swapaxes(out, 1, 2)
     dot_ = jnp.swapaxes(do, 1, 2)
-    delta = jnp.sum(ot.astype(jnp.float32) * dot_.astype(jnp.float32),
-                    axis=-1, keepdims=True)        # [B, Hq, Sq, 1]
+    delta = jnp.swapaxes(
+        jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                axis=-1), 1, 2)[..., None]         # [B, Hq, Sq, 1]
 
     seg_args = []
     if has_seg:
@@ -479,6 +521,7 @@ def _bwd(scale, causal, has_seg, has_bias, res, g):
                                lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=_grid_params(),
         interpret=use_interpret(),
     )(qt, kt, vt, dot_, lse, delta, *seg_args, *bias_args)
 
@@ -528,6 +571,7 @@ def _bwd(scale, causal, has_seg, has_bias, res, g):
             pltpu.VMEM((bk, D), jnp.float32),
             pltpu.VMEM((bk, D), jnp.float32),
         ],
+        compiler_params=_grid_params(),
         interpret=use_interpret(),
     )(qt, kt, vt, dot_, lse, delta, *seg_args, *bias_args)
 

@@ -1,0 +1,78 @@
+"""Attention backend policy (ops/attention_policy) — decision table
+pinned to the round-4 v5e measurements in BASELINE.md."""
+
+import pytest
+
+from paddle_tpu.ops.attention_policy import (
+    dense_residual_bytes, prefer_flash)
+
+HBM = 16e9   # v5e
+
+
+class TestDenseResidualBytes:
+    def test_formula(self):
+        # one layer, [B=2, H=4, Sq=128, Sk=256] f32 logits
+        assert dense_residual_bytes((2, 128, 4, 64), (2, 256, 4, 64),
+                                    1) == 4 * 2 * 4 * 128 * 256
+
+    def test_layers_multiply(self):
+        one = dense_residual_bytes((2, 128, 4, 64), (2, 128, 4, 64), 1)
+        twelve = dense_residual_bytes((2, 128, 4, 64), (2, 128, 4, 64), 12)
+        assert twelve == 12 * one
+
+
+class TestPreferFlash:
+    """Each row reproduces a measured v5e outcome (BASELINE.md round 4)."""
+
+    def test_gpt125m_b8_dense(self):
+        # b8 s1024: dense ran AND was 18% faster -> policy must pick dense
+        assert not prefer_flash((8, 1024, 12, 64), (8, 1024, 12, 64),
+                                12, remat=False, hbm_bytes=HBM)
+
+    def test_gpt125m_b16_flash(self):
+        # b16 s1024 without remat OOM'd the dense path -> flash
+        assert prefer_flash((16, 1024, 12, 64), (16, 1024, 12, 64),
+                            12, remat=False, hbm_bytes=HBM)
+
+    def test_h2048_s2048_remat_dense(self):
+        # h2048 s2048 remat: dense fit and was 47% faster -> dense
+        assert not prefer_flash((4, 2048, 32, 64), (4, 2048, 32, 64),
+                                12, remat=True, hbm_bytes=HBM)
+
+    def test_long_context_flash(self):
+        # s8192: residuals blow HBM even under remat -> flash
+        assert prefer_flash((2, 8192, 32, 128), (2, 8192, 32, 128),
+                            12, remat=True, hbm_bytes=HBM)
+
+    def test_cpu_unbounded_dense(self):
+        # inf HBM (CPU host) -> always dense
+        assert not prefer_flash((64, 4096, 32, 128), (64, 4096, 32, 128),
+                                48, remat=False, hbm_bytes=float("inf"))
+
+    def test_pp_divides_layers(self):
+        # fewer resident layers (pp sharding) tips the same shape to dense
+        shape = (12, 1024, 12, 64)
+        assert prefer_flash(shape, shape, 12, False, HBM)
+        assert not prefer_flash(shape, shape, 3, False, HBM)
+
+
+class TestModelWiring:
+    def test_gpt_auto_builds_on_cpu(self):
+        # use_flash=None on a CPU host must fall back to the dense path
+        # (no Pallas import) and still train — covered by building a tiny
+        # step; the TPU branch is exercised by bench_sweep flash=None rows
+        import numpy as np
+        import jax
+        from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
+        from paddle_tpu import parallel as dist
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, max_position_embeddings=32,
+                        dtype="float32")
+        topo = dist.init_topology(devices=jax.devices()[:1])
+        step, init = build_gpt_train_step(cfg, topo, num_microbatches=1,
+                                          remat=False, use_flash=None)
+        st = init(0)
+        ids = np.random.default_rng(0).integers(
+            0, 64, (2, 32)).astype(np.int32)
+        st, loss = step(st, ids, np.roll(ids, -1, 1))
+        assert np.isfinite(float(loss))

@@ -66,19 +66,25 @@ def probe(timeout=240):
     print(json.dumps(rec))
     return ok
 
-def seize():
+def seize(tag=""):
     """Run the full hardware-evidence suite once the chip is reachable.
     Idempotent via the sentinel file; every artifact lands in tools/ and
     BASELINE.md so the round's evidence exists even if the tunnel wedges
-    again minutes later."""
-    if os.path.exists(SENTINEL):
+    again minutes later.
+
+    ``tag``: names a measurement generation (e.g. ``r4b`` after a kernel
+    change) — each tag gets its own sentinel + artifact suffix, so the
+    suite re-runs once per generation while staying idempotent within it."""
+    sentinel = SENTINEL.replace(".json", f"_{tag}.json") if tag else SENTINEL
+    if os.path.exists(sentinel):
         return
+    suffix = f"_{tag}" if tag else ""
     tdir = os.path.dirname(os.path.abspath(__file__))
     results = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-               "status": "in_progress"}
+               "tag": tag, "status": "in_progress"}
     # claim the sentinel BEFORE the multi-hour suite: overlapping probe
     # invocations must not start a second concurrent seize on the chip
-    with open(SENTINEL, "w") as f:
+    with open(sentinel, "w") as f:
         json.dump(results, f)
 
     def _run(cmd, out_file, timeout):
@@ -100,18 +106,18 @@ def seize():
             return {"rc": -2, "tail": [str(e)]}
 
     results["bench"] = _run([sys.executable, "bench.py"],
-                            "bench_tpu.json", 1800)
+                            f"bench_tpu{suffix}.json", 1800)
     for cfg in ("lenet", "resnet50", "bert", "llama"):
         results[f"bench_{cfg}"] = _run(
             [sys.executable, "bench.py", "--config", cfg],
-            f"bench_tpu_{cfg}.json", 1800)
+            f"bench_tpu_{cfg}{suffix}.json", 1800)
     results["bench_sweep"] = _run([sys.executable, "bench_sweep.py"],
-                                  "bench_sweep_tpu.json", 3600)
+                                  f"bench_sweep_tpu{suffix}.json", 3600)
     results["pytest_tpu"] = _run(
         [sys.executable, "-m", "pytest", "tests", "-m", "tpu", "-q"],
-        "pytest_tpu.log", 2400)
+        f"pytest_tpu{suffix}.log", 2400)
     results["status"] = "done"
-    with open(SENTINEL, "w") as f:
+    with open(sentinel, "w") as f:
         json.dump(results, f, indent=1)
     with open(os.path.join(REPO, "BASELINE.md"), "a") as f:
         f.write("\n## TPU seize results (auto-appended by tools/tpu_probe.py"
@@ -120,7 +126,7 @@ def seize():
     try:
         # commit ONLY the artifacts this function produced — never the
         # whole working tree (edits may be in progress)
-        artifacts = ["BASELINE.md", "tools/tpu_seized.json",
+        artifacts = ["BASELINE.md", os.path.relpath(sentinel, REPO),
                      "tools/tpu_probe.log"]
         artifacts += [os.path.join("tools", f) for f in os.listdir(tdir)
                       if f.startswith(("bench_tpu", "bench_sweep_tpu",
@@ -138,6 +144,11 @@ def seize():
 
 if __name__ == "__main__":
     argv = [a for a in sys.argv[1:] if a != "--no-seize"]
+    tag = ""
+    if "--tag" in argv:
+        i = argv.index("--tag")
+        tag = argv[i + 1] if i + 1 < len(argv) else ""
+        del argv[i:i + 2]
     ok = probe(int(argv[0]) if argv else 240)
     if ok and "--no-seize" not in sys.argv:
-        seize()
+        seize(tag)
