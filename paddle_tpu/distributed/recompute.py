@@ -76,7 +76,8 @@ class _RecomputeFunction(PyLayer):
 
 
 def recompute(function, *args, use_reentrant: bool = True,
-              preserve_rng_state: bool = True, **kwargs):
+              preserve_rng_state: bool = True,
+              checkpoint_policy=None, **kwargs):
     """Run ``function(*args)`` without storing its intermediate
     activations; they are recomputed during the backward pass.
 
@@ -85,10 +86,18 @@ def recompute(function, *args, use_reentrant: bool = True,
     (dropout) replay identically when ``preserve_rng_state`` (global
     generator state stashed/restored around the backward re-run — the
     analog of the reference's CUDA/CPU RNG state tracker dance).
+
+    ``checkpoint_policy`` (TPU-native extension, traced mode only): a
+    parallel.remat policy name ("dots", "dots_saveable", ...) selecting
+    what jax.checkpoint saves vs recomputes.
     """
     if kwargs:
         raise ValueError(f"recompute got unexpected kwargs: {list(kwargs)} "
                          "(pass positional args only, like the reference)")
+    # validate eagerly so a typo'd policy fails on the dygraph path too
+    # (where the policy itself is a no-op — tape recompute saves nothing)
+    from ..parallel.remat import resolve_policy
+    resolve_policy(checkpoint_policy)
 
     # Inside a jit/to_static trace the tape is bypassed; wrap in
     # jax.checkpoint so XLA rematerializes instead of saving residuals.
@@ -104,7 +113,8 @@ def recompute(function, *args, use_reentrant: bool = True,
                 lambda t: t._value if isinstance(t, Tensor) else t, out,
                 is_leaf=lambda t: isinstance(t, Tensor))
 
-        out = jax.checkpoint(pure)(*vals)
+        from ..parallel.remat import remat_wrap
+        out = remat_wrap(pure, True, checkpoint_policy)(*vals)
         return jax.tree.map(Tensor, out,
                             is_leaf=lambda x: isinstance(x, jax.Array))
     rng_state = get_rng_state() if preserve_rng_state else None

@@ -341,6 +341,7 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                          cp_mode: str = None,
                          use_flash: Optional[bool] = None,
                          remat: bool = True,
+                         remat_policy=None,
                          schedule: str = "1f1b",
                          num_model_chunks: int = 1,
                          sharding_stage: int = 2,
@@ -415,18 +416,12 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
             # auto: dense XLA attention while its residuals fit HBM, the
             # Pallas flash kernel once they don't (ops/attention_policy —
             # decided at trace time on the device-LOCAL q/k shapes)
-            from ..ops.attention_policy import prefer_flash
+            from ..ops.attention_policy import make_auto_attn
             from ..ops.pallas.flash_attention import flash_attention
-            # residuals live per stage = resident layers x in-flight
-            # microbatches (1F1B keeps up to S in flight; GPipe all)
-            in_flight = num_microbatches if schedule == "gpipe" \
-                else min(num_microbatches, S)
-            L_live = (cfg.num_layers // S) * max(1, in_flight)
-
-            def cp_attn(q, k, v):
-                if prefer_flash(q.shape, k.shape, L_live, remat):
-                    return flash_attention(q, k, v, causal=True)
-                return dense_causal_attention(q, k, v)
+            cp_attn = make_auto_attn(
+                cfg.num_layers, S, num_microbatches, schedule, remat,
+                remat_policy, functools.partial(flash_attention, causal=True),
+                dense_causal_attention)
         elif use_flash:
             from ..ops.pallas.flash_attention import flash_attention
             cp_attn = functools.partial(flash_attention, causal=True)
@@ -501,7 +496,8 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
         topo=topo, param_specs=param_specs, init_params_fn=init_params_fn,
         embed_fn=embed_fn, block_fn=block_fn, head_nll_fn=head_nll_fn,
         num_microbatches=num_microbatches, learning_rate=learning_rate,
-        remat=remat, schedule=schedule, sharding_stage=sharding_stage,
+        remat=remat, remat_policy=remat_policy,
+        schedule=schedule, sharding_stage=sharding_stage,
         num_model_chunks=num_model_chunks,
         offload_optimizer=offload_optimizer,
         mp_reduce_block_leaves=frozenset(

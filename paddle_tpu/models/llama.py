@@ -394,6 +394,7 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
                            cp_mode: str = None,
                            use_flash: Optional[bool] = None,
                            remat: bool = True,
+                           remat_policy=None,
                            schedule: str = "1f1b",
                            sharding_stage: int = 2,
                            num_model_chunks: int = 1,
@@ -449,18 +450,13 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
             # auto backend (ops/attention_policy): dense XLA attention
             # while its residuals fit HBM, Pallas flash once they don't —
             # decided at trace time on the device-local q/k shapes
-            from ..ops.attention_policy import prefer_flash
+            import functools
+            from ..ops.attention_policy import make_auto_attn
             from ..ops.pallas.flash_attention import flash_attention
-            # residuals live per stage = resident layers x in-flight
-            # microbatches (1F1B keeps up to S in flight; GPipe all)
-            in_flight = num_microbatches if schedule == "gpipe" \
-                else min(num_microbatches, S)
-            L_live = (cfg.num_layers // S) * max(1, in_flight)
-
-            def cp_attn(q, k, v):
-                if prefer_flash(q.shape, k.shape, L_live, remat):
-                    return flash_attention(q, k, v, causal=True)
-                return _gqa_attention(q, k, v, causal=True)
+            cp_attn = make_auto_attn(
+                cfg.num_layers, S, num_microbatches, schedule, remat,
+                remat_policy, functools.partial(flash_attention, causal=True),
+                functools.partial(_gqa_attention, causal=True))
         elif use_flash:
             import functools
             from ..ops.pallas.flash_attention import flash_attention
@@ -541,7 +537,8 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
         embed_fn=embed_fn, block_fn=block_fn, head_nll_fn=head_nll_fn,
         step_ctx_fn=step_ctx_fn,
         num_microbatches=num_microbatches, learning_rate=learning_rate,
-        remat=remat, schedule=schedule, sharding_stage=sharding_stage,
+        remat=remat, remat_policy=remat_policy,
+        schedule=schedule, sharding_stage=sharding_stage,
         num_model_chunks=num_model_chunks,
         offload_optimizer=offload_optimizer,
         mp_reduce_block_leaves=frozenset(

@@ -49,13 +49,12 @@ def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, key=None,
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True):
-    # backend policy (ops/attention_policy): at the single-op level the
-    # dense path pins one [B, H, Sq, Sk] f32 residual; XLA's fused dense
-    # attention is faster than the flash kernel until that outgrows HBM
+    # NOTE on backends: the per-op API cannot see how many layers will
+    # hold residuals (a 12-layer model calls this once per layer), so the
+    # memory-based dense/flash policy (ops/attention_policy) is applied
+    # only in the model builders where layer count is known; here flash
+    # stays the TPU default — the memory-safe choice.
     use_pallas = _should_use_pallas(query)
-    if use_pallas and not _interpret_forced():
-        from ...ops.attention_policy import prefer_flash
-        use_pallas = prefer_flash(query.shape, key.shape, 1, False)
     rng = next_rng_key() if (dropout_p > 0.0 and training) else None
 
     def impl(q, k, v, m, rk):

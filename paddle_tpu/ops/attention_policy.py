@@ -18,15 +18,18 @@ returns True only when the dense residual footprint would crowd HBM.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import functools
+from typing import Callable, Optional, Sequence
 
 _DEFAULT_HBM = 16e9          # v5e per-chip HBM; used when stats are absent
 _DENSE_BUDGET_FRAC = 0.35    # leave room for params/grads/opt state
 
 
+@functools.lru_cache(maxsize=1)
 def hbm_bytes_per_device() -> float:
     """Per-device HBM capacity; falls back to the v5e size on TPU and to
-    'unbounded' (so dense always wins) on CPU hosts."""
+    'unbounded' (so dense always wins) on CPU hosts.  Cached: capacity is
+    fixed for the process lifetime and prefer_flash sits on hot paths."""
     try:
         import jax
         dev = jax.local_devices()[0]
@@ -63,3 +66,32 @@ def prefer_flash(q_shape: Sequence[int], k_shape: Sequence[int],
     live = 2 if remat else num_layers
     hbm = hbm_bytes if hbm_bytes is not None else hbm_bytes_per_device()
     return dense_residual_bytes(q_shape, k_shape, live) > budget_frac * hbm
+
+
+def make_auto_attn(num_layers: int, pp_degree: int, num_microbatches: int,
+                   schedule: str, remat: bool, remat_policy,
+                   flash_fn: Callable, dense_fn: Callable) -> Callable:
+    """Build the shared ``attn(q, k, v)`` auto-backend closure for the
+    model train-step builders (gpt.py / llama.py — single source so the
+    residency model cannot diverge between them).
+
+    Residency model: residuals live per stage = resident layers x
+    in-flight microbatches (1F1B keeps up to ``pp_degree`` in flight,
+    GPipe all of them).  A remat_policy that SAVES batched-dot outputs
+    ("dots_saveable"/"everything", or any unknown callable — assumed
+    saving) pins the dense logits despite remat, so it is treated as
+    remat=False for the decision.
+    """
+    in_flight = num_microbatches if schedule == "gpipe" \
+        else min(num_microbatches, pp_degree)
+    live = (num_layers // max(1, pp_degree)) * max(1, in_flight)
+    saves_logits = callable(remat_policy) or \
+        remat_policy in ("dots_saveable", "everything")
+    eff_remat = remat and not saves_logits
+
+    def attn(q, k, v):
+        if prefer_flash(q.shape, k.shape, live, eff_remat):
+            return flash_fn(q, k, v)
+        return dense_fn(q, k, v)
+
+    return attn

@@ -35,6 +35,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .remat import remat_wrap
 from .topology import (DP_AXIS, MP_AXIS, PP_AXIS, SEP_AXIS, SHARDING_AXIS,
                        HybridTopology)
 
@@ -245,6 +246,7 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                             learning_rate: float = 1e-4,
                             adam_betas=(0.9, 0.95), adam_eps: float = 1e-8,
                             weight_decay: float = 0.0, remat: bool = True,
+                            remat_policy=None,
                             schedule: str = "1f1b",
                             num_model_chunks: int = 1,
                             sharding_stage: int = 2,
@@ -437,13 +439,13 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                 def one(c, lp):
                     return body(c, lp)[0]
 
-                fn = jax.checkpoint(one) if use_remat else one
+                fn = remat_wrap(one, use_remat, remat_policy)
                 per = next(iter(blk.values())).shape[0]
                 for i in range(per):
                     x = fn(x, {k: lax.index_in_dim(v, i, 0, keepdims=False)
                                for k, v in blk.items()})
                 return x
-            sbody = jax.checkpoint(body) if use_remat else body
+            sbody = remat_wrap(body, use_remat, remat_policy)
             x, _ = lax.scan(sbody, x, blk)
             return x
 
@@ -464,7 +466,8 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                     return run_stack(hcarry, blk_local,
                                      use_remat=stage3 and remat)
 
-                outs = spmd_pipeline(stage_fn, blk, mbs, S, remat=remat)
+                outs = spmd_pipeline(stage_fn, blk, mbs, S, remat=remat,
+                                     remat_policy=remat_policy)
                 x = outs.reshape(b_l, s_l, hdim)
             else:
                 x = run_stack(x, blk, use_remat=remat)

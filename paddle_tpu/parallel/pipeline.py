@@ -65,7 +65,7 @@ class SharedLayerDesc(LayerDesc):
 
 def spmd_pipeline(stage_fn: Callable, stage_params: Any, microbatches,
                   num_stages: int, axis_name: str = PP_AXIS,
-                  remat: bool = True):
+                  remat: bool = True, remat_policy=None):
     """Run the scan-pipeline INSIDE a shard_map over ``axis_name``.
 
     stage_fn(params_local, x) -> y : one pipeline stage's computation
@@ -78,7 +78,8 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, microbatches,
     M = microbatches.shape[0]
     S = num_stages
     stage = jax.lax.axis_index(axis_name)
-    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    from .remat import remat_wrap
+    fn = remat_wrap(stage_fn, remat, remat_policy)
 
     state = jnp.zeros_like(microbatches[0])
     outputs = jnp.zeros_like(microbatches)

@@ -1,0 +1,57 @@
+"""Selective rematerialization policies for the hybrid train step.
+
+The reference's recompute offers per-layer granularity plus an "offload"
+variant (fleet/recompute/recompute.py:124, recompute_hybrid.py); on TPU
+the equivalent lever is ``jax.checkpoint``'s *policy*: instead of
+recompute-everything (the round-3 default, which the v5e sweep priced at
+~25% throughput — HFU 0.378 vs MFU 0.284 at GPT-1.3B-width), a policy can
+save the cheap-to-store / expensive-to-recompute values and recompute
+only the rest:
+
+* ``"full"`` / ``None`` — save nothing, recompute the whole block (max
+  memory savings, ~4/3 FLOP cost).
+* ``"dots"`` — save non-batched matmul outputs (qkv/proj/fc1/fc2
+  projections, each O(b*s*h)); recompute elementwise ops AND batched
+  attention einsums (the O(b*h*s^2) logits stay unsaved).  The usual
+  sweet spot: near-dense speed at a fraction of the memory.
+* ``"dots_saveable"`` — additionally saves batched dots (attention
+  logits); memory approaches the no-remat path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+
+POLICIES = {
+    None: None,
+    "full": None,
+    "dots": "dots_with_no_batch_dims_saveable",
+    "dots_saveable": "dots_saveable",
+    "everything": "everything_saveable",
+}
+
+
+def resolve_policy(policy: Union[str, Callable, None]):
+    """Map a policy name to a jax.checkpoint policy callable (None =
+    save-nothing).  Callables pass through for power users."""
+    if callable(policy):
+        return policy
+    if policy not in POLICIES:
+        raise ValueError(
+            f"unknown remat policy {policy!r}; one of {sorted(k for k in POLICIES if k)} "
+            "or a jax.checkpoint_policies callable")
+    name = POLICIES[policy]
+    return getattr(jax.checkpoint_policies, name) if name else None
+
+
+def remat_wrap(fn: Callable, remat: bool,
+               policy: Union[str, Callable, None] = None) -> Callable:
+    """``jax.checkpoint`` ``fn`` under the named policy (no-op when
+    ``remat`` is False)."""
+    if not remat:
+        return fn
+    p = resolve_policy(policy)
+    return jax.checkpoint(fn, policy=p) if p is not None else \
+        jax.checkpoint(fn)

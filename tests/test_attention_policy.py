@@ -56,6 +56,38 @@ class TestPreferFlash:
         assert not prefer_flash(shape, shape, 3, False, HBM)
 
 
+class TestMakeAutoAttn:
+    def _fns(self):
+        calls = []
+        return calls, (lambda q, k, v: calls.append("flash")), \
+            (lambda q, k, v: calls.append("dense"))
+
+    def test_saveable_policy_counts_as_no_remat(self, monkeypatch):
+        # dots_saveable pins every live layer's logits despite remat=True
+        from paddle_tpu.ops import attention_policy as ap
+        monkeypatch.setattr(ap, "hbm_bytes_per_device", lambda: 16e9)
+        q = type("A", (), {"shape": (16, 1024, 12, 64)})()
+        calls, flash, dense = self._fns()
+        ap.make_auto_attn(12, 1, 1, "1f1b", True, "dots_saveable",
+                          flash, dense)(q, q, q)
+        assert calls == ["flash"]
+        calls, flash, dense = self._fns()
+        ap.make_auto_attn(12, 1, 1, "1f1b", True, "dots",
+                          flash, dense)(q, q, q)
+        assert calls == ["dense"]   # dots recomputes logits -> remat-like
+
+    def test_pp_in_flight_microbatches(self, monkeypatch):
+        # pp=4 divides resident layers but 1F1B keeps pp mbs in flight,
+        # so the per-stage division cancels and b16 stays on flash
+        from paddle_tpu.ops import attention_policy as ap
+        monkeypatch.setattr(ap, "hbm_bytes_per_device", lambda: 16e9)
+        q = type("A", (), {"shape": (16, 1024, 12, 64)})()
+        calls, flash, dense = self._fns()
+        ap.make_auto_attn(12, 4, 4, "1f1b", False, None,
+                          flash, dense)(q, q, q)
+        assert calls == ["flash"]
+
+
 class TestModelWiring:
     def test_gpt_auto_builds_on_cpu(self):
         # use_flash=None on a CPU host must fall back to the dense path

@@ -105,14 +105,70 @@ def seize(tag=""):
         except Exception as e:
             return {"rc": -2, "tail": [str(e)]}
 
+    def _chip_alive() -> bool:
+        """Cheap re-probe between suite sections: the tunnel's healthy
+        windows can be minutes long (04:02 window on 2026-07-31 closed
+        before the first bench finished), and grinding through CPU
+        fallbacks would burn this tag on junk evidence."""
+        try:
+            out = subprocess.run([sys.executable, "-c", SNIPPET],
+                                 capture_output=True, text=True, timeout=90)
+            return out.returncode == 0 and \
+                json.loads(out.stdout.strip().splitlines()[-1]
+                           ).get("platform") in ("tpu", "axon")
+        except Exception:
+            return False
+
+    def _abort_rearm(stage):
+        # chip gone mid-suite: drop the sentinel so the NEXT healthy
+        # window re-runs this tag from scratch; keep no partial commit
+        try:
+            os.remove(sentinel)
+        except OSError:
+            pass
+        rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+               "ok": False, "elapsed_s": 0,
+               "detail": f"seize[{tag}] aborted at {stage}: chip vanished "
+                         "mid-suite; tag re-armed", "relay_tcp": _relay_tcp_up()}
+        with open(LOG, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec))
+
+    def _headline_on_tpu() -> bool:
+        # a fallback row means the window closed mid-bench (bench.py
+        # stamps the measuring device into its JSON line)
+        try:
+            with open(os.path.join(tdir, f"bench_tpu{suffix}.json")) as f:
+                return '"device": "TPU' in f.read()
+        except OSError:
+            return False
+
     results["bench"] = _run([sys.executable, "bench.py"],
                             f"bench_tpu{suffix}.json", 1800)
+    if not _headline_on_tpu():
+        if _chip_alive():
+            # transient flap: the chip is back — re-measure rather than
+            # committing a CPU-fallback row as hardware evidence
+            results["bench"] = _run([sys.executable, "bench.py"],
+                                    f"bench_tpu{suffix}.json", 1800)
+        if not _headline_on_tpu():
+            _abort_rearm("headline")
+            return
     for cfg in ("lenet", "resnet50", "bert", "llama"):
+        if not _chip_alive():
+            _abort_rearm(f"before {cfg}")
+            return
         results[f"bench_{cfg}"] = _run(
             [sys.executable, "bench.py", "--config", cfg],
             f"bench_tpu_{cfg}{suffix}.json", 1800)
+    if not _chip_alive():
+        _abort_rearm("before sweep")
+        return
     results["bench_sweep"] = _run([sys.executable, "bench_sweep.py"],
                                   f"bench_sweep_tpu{suffix}.json", 3600)
+    if not _chip_alive():
+        _abort_rearm("before pytest")
+        return
     results["pytest_tpu"] = _run(
         [sys.executable, "-m", "pytest", "tests", "-m", "tpu", "-q"],
         f"pytest_tpu{suffix}.log", 2400)
