@@ -40,8 +40,12 @@ def _relay_tcp_up(port=2024) -> bool:
         return False
 
 
-def probe(timeout=240):
-    t0 = time.time()
+def _raw_probe(timeout):
+    """One jax.devices() probe in a subprocess.  Returns (ok, detail);
+    ok is True only for a REAL accelerator platform — a soft CPU fallback
+    must not count as the chip being back (it would fire seize() and
+    fabricate evidence).  Single source of the liveness criterion for
+    both probe() and seize()'s mid-suite checks."""
     try:
         out = subprocess.run(
             [sys.executable, "-c", SNIPPET], capture_output=True,
@@ -50,14 +54,18 @@ def probe(timeout=240):
         detail = (out.stdout.strip().splitlines() or ["?"])[-1] if ok \
             else (out.stderr.strip().splitlines() or ["?"])[-1]
     except subprocess.TimeoutExpired:
-        ok, detail = False, f"timeout after {timeout}s (jax.devices() blocked)"
+        return False, f"timeout after {timeout}s (jax.devices() blocked)"
     if ok:
-        # rc==0 is not enough: a soft CPU fallback must not count as the
-        # chip being back (it would fire seize() and fabricate evidence)
         try:
             ok = json.loads(detail).get("platform") in ("tpu", "axon")
         except Exception:
             ok = False
+    return ok, detail
+
+
+def probe(timeout=240):
+    t0 = time.time()
+    ok, detail = _raw_probe(timeout)
     rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
            "ok": ok, "elapsed_s": round(time.time() - t0, 1),
            "detail": detail, "relay_tcp": _relay_tcp_up()}
@@ -88,6 +96,14 @@ def seize(tag=""):
         json.dump(results, f)
 
     def _run(cmd, out_file, timeout):
+        # drop any prior artifact first: on timeout nothing is written,
+        # and a stale file from an earlier aborted run must not be
+        # committed (or pass device checks) as THIS run's evidence
+        for stale in (out_file, out_file + ".stderr.log"):
+            try:
+                os.remove(os.path.join(tdir, stale))
+            except OSError:
+                pass
         try:
             r = subprocess.run(cmd, capture_output=True, text=True,
                                timeout=timeout, cwd=REPO)
@@ -110,14 +126,7 @@ def seize(tag=""):
         windows can be minutes long (04:02 window on 2026-07-31 closed
         before the first bench finished), and grinding through CPU
         fallbacks would burn this tag on junk evidence."""
-        try:
-            out = subprocess.run([sys.executable, "-c", SNIPPET],
-                                 capture_output=True, text=True, timeout=90)
-            return out.returncode == 0 and \
-                json.loads(out.stdout.strip().splitlines()[-1]
-                           ).get("platform") in ("tpu", "axon")
-        except Exception:
-            return False
+        return _raw_probe(90)[0]
 
     def _abort_rearm(stage):
         # chip gone mid-suite: drop the sentinel so the NEXT healthy
@@ -134,38 +143,47 @@ def seize(tag=""):
             f.write(json.dumps(rec) + "\n")
         print(json.dumps(rec))
 
-    def _headline_on_tpu() -> bool:
-        # a fallback row means the window closed mid-bench (bench.py
-        # stamps the measuring device into its JSON line)
+    def _on_tpu(fname) -> bool:
+        # result-based check (closes the TOCTOU gap a liveness probe
+        # leaves open): bench.py stamps the measuring device into every
+        # JSON row, so the artifact itself proves where it was measured
         try:
-            with open(os.path.join(tdir, f"bench_tpu{suffix}.json")) as f:
+            with open(os.path.join(tdir, fname)) as f:
                 return '"device": "TPU' in f.read()
         except OSError:
             return False
 
-    results["bench"] = _run([sys.executable, "bench.py"],
-                            f"bench_tpu{suffix}.json", 1800)
-    if not _headline_on_tpu():
+    def _bench(cmd, fname, timeout):
+        """One bench section with a result-based device check: re-run
+        once on a CPU-fallback artifact if the chip looks back (transient
+        flap), else report failure so the caller aborts + re-arms."""
+        res = _run(cmd, fname, timeout)
+        if _on_tpu(fname):
+            return res, True
         if _chip_alive():
-            # transient flap: the chip is back — re-measure rather than
-            # committing a CPU-fallback row as hardware evidence
-            results["bench"] = _run([sys.executable, "bench.py"],
-                                    f"bench_tpu{suffix}.json", 1800)
-        if not _headline_on_tpu():
-            _abort_rearm("headline")
-            return
+            res = _run(cmd, fname, timeout)
+            if _on_tpu(fname):
+                return res, True
+        return res, False
+
+    results["bench"], ok = _bench([sys.executable, "bench.py"],
+                                  f"bench_tpu{suffix}.json", 1800)
+    if not ok:
+        _abort_rearm("headline")
+        return
     for cfg in ("lenet", "resnet50", "bert", "llama"):
-        if not _chip_alive():
-            _abort_rearm(f"before {cfg}")
-            return
-        results[f"bench_{cfg}"] = _run(
+        results[f"bench_{cfg}"], ok = _bench(
             [sys.executable, "bench.py", "--config", cfg],
             f"bench_tpu_{cfg}{suffix}.json", 1800)
-    if not _chip_alive():
-        _abort_rearm("before sweep")
+        if not ok:
+            _abort_rearm(f"bench_{cfg}")
+            return
+    results["bench_sweep"], ok = _bench(
+        [sys.executable, "bench_sweep.py"],
+        f"bench_sweep_tpu{suffix}.json", 3600)
+    if not ok:
+        _abort_rearm("bench_sweep")
         return
-    results["bench_sweep"] = _run([sys.executable, "bench_sweep.py"],
-                                  f"bench_sweep_tpu{suffix}.json", 3600)
     if not _chip_alive():
         _abort_rearm("before pytest")
         return
