@@ -222,6 +222,42 @@ def run_config_bench(config: str):
                                "BASELINE sharding8 config)" if on_accel
                                else "llama_tiny CPU-liveness proxy"},
         }
+    elif config == "decode":
+        # inference: autoregressive decode through the KV-cache decoder
+        # (prefill + lax.scan step loop; Pallas MMHA on TPU) — the
+        # serving-side metric the train rows don't cover
+        from paddle_tpu.models.llama import (build_llama_train_step,
+                                             llama_7b, llama_tiny)
+        from paddle_tpu.models.generation import llama_generate
+        from paddle_tpu import parallel as dist
+        if on_accel:
+            cfg = llama_7b(dtype="bfloat16", num_layers=4)
+            b, t0, new, reps = 8, 128, 128, 3
+        else:
+            cfg = llama_tiny()
+            b, t0, new, reps = 2, 8, 8, 1
+        topo = dist.init_topology(devices=devices[:1])
+        _, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1)
+        params = init_fn(0)["params"]
+        ids = rng.integers(0, cfg.vocab_size, (b, t0)).astype(np.int32)
+        got = llama_generate(params, cfg, ids, max_new_tokens=new,
+                             temperature=0.0)     # compile + warm
+        jax.device_get(got)
+        t_start = time.perf_counter()
+        for _ in range(reps):
+            got = llama_generate(params, cfg, ids, max_new_tokens=new,
+                                 temperature=0.0)
+        jax.device_get(got)
+        dt = time.perf_counter() - t_start
+        out = {
+            "metric": "llama_decode_tokens_per_sec_per_chip",
+            "value": round(b * new * reps / dt, 1),
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "extra": {"batch": b, "prompt": t0, "new_tokens": new,
+                      "device": str(devices[0]),
+                      "model": "llama_7b-width L4 proxy decode" if on_accel
+                               else "llama_tiny CPU-liveness proxy"},
+        }
     else:
         raise SystemExit(f"unknown --config {config!r}")
     if err_note:

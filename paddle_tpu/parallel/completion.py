@@ -348,7 +348,9 @@ def _infer_node(name: str, in_attrs: List[TensorDistAttr], node):
         src = [1 if d in (None, -1) else int(d)
                for d in node.in_vars[0].shape]
         dst = [1 if d in (None, -1) else int(d) for d in outs[0].shape]
-        req, o = expand_rule(in_attrs[0], src, dst)
+        from .spmd_rules import expand_as_rule
+        fn = expand_as_rule if base == "expand_as" else expand_rule
+        req, o = fn(in_attrs[0], src, dst)
         return [req] + in_attrs[1:], [o] * len(outs), "expand"
     if base in ("triu", "tril") and in_attrs and in_attrs[0].ndim >= 2:
         req, o = triu_rule(in_attrs[0])
@@ -365,6 +367,50 @@ def _infer_node(name: str, in_attrs: List[TensorDistAttr], node):
     if base == "swiglu" and in_attrs:
         reqs, o = swiglu_rule(*in_attrs[:2])
         return list(reqs) + in_attrs[2:], [o] * len(outs), "swiglu"
+    if base in ("check_finite_and_unscale_", "check_finite_and_unscale",
+                "update_loss_scaling_", "update_loss_scaling") and in_attrs:
+        from .spmd_rules import amp_ops_rule
+        reqs, outs_a, found = amp_ops_rule(in_attrs)
+        # found_inf is the LAST output slot of both amp ops; the scaled
+        # tensors fill the slots before it
+        if len(outs) >= 1:
+            o_list = outs_a[:len(outs) - 1] + [found]
+        else:
+            o_list = []
+        return reqs, o_list, "amp_ops"
+    if base == "fused_linear_param_grad_add" and len(in_attrs) >= 2:
+        from .spmd_rules import fused_linear_param_grad_add_rule
+        reqs, dw, dbias = fused_linear_param_grad_add_rule(
+            in_attrs[0], in_attrs[1])
+        # accumulator inputs (dweight/dbias being added into) must sit in
+        # the OUTPUT's layout, partial included — a replicated accumulator
+        # summed into per-rank partials would be multiplied by world size
+        # at the closing p_to_r
+        accs = []
+        for a in in_attrs[2:]:
+            like = dw if a.ndim == dw.ndim else dbias
+            accs.append(TensorDistAttr(list(like.dims_mapping),
+                                       set(like.partial)))
+        o_list = [dw, dbias][:len(outs)] or [dw]
+        return reqs + accs, o_list, "fused_linear_param_grad_add"
+    if base in ("sgd_", "momentum_", "adam_", "adamw_", "adamax_",
+                "lamb_", "nadam_", "radam_", "asgd_", "rmsprop_",
+                "adagrad_", "adadelta_", "rprop_") and in_attrs:
+        from .spmd_rules import optimizer_rule
+        in_shapes = [getattr(v, "shape", None)
+                     for v in getattr(node, "in_vars", [])][1:]
+        reqs, o = optimizer_rule(in_attrs[0], in_attrs[1:],
+                                 in_shapes or None)
+        # scalar state outputs (beta pows, lr) stay replicated at their
+        # own rank; tensor state mirrors the param
+        o_list = []
+        for ov in outs:
+            nd = len(getattr(ov, "shape", ()) or ())
+            if nd == o.ndim:
+                o_list.append(TensorDistAttr(list(o.dims_mapping)))
+            else:
+                o_list.append(TensorDistAttr([None] * nd))
+        return reqs, o_list, "optimizer"
     if base == "squared_l2_norm" and in_attrs:
         req, o = squared_l2_norm_rule(in_attrs[0])
         return [req] + in_attrs[1:], [o] * len(outs), "squared_l2_norm"

@@ -613,3 +613,78 @@ def full_like_rule(x: TensorDistAttr
     value is dense — reference full_like.cc)."""
     return TensorDistAttr(list(x.dims_mapping), set(x.partial)), \
         TensorDistAttr(list(x.dims_mapping))
+
+
+# ---------------------------------------------------------------------------
+# round-4b tail: the last capability rules from the reference inventory
+# (phi/infermeta/spmd_rules/: amp_ops.cc, expand_as.cc,
+#  fused_linear_param_grad_add.cc, optimizer.cc)
+# ---------------------------------------------------------------------------
+
+def amp_ops_rule(xs: Sequence[TensorDistAttr]
+                 ) -> Tuple[List[TensorDistAttr], List[TensorDistAttr],
+                            TensorDistAttr]:
+    """check_finite_and_unscale / update_loss_scaling (amp_ops.cc): every
+    tensor keeps its sharding (the scale is elementwise), scaled outputs
+    mirror the inputs, and found_inf is a REPLICATED scalar — it feeds a
+    host-side branch, so leaving it partial would diverge across ranks."""
+    keep = [TensorDistAttr(list(x.dims_mapping), set(x.partial))
+            for x in xs]
+    outs = [TensorDistAttr(list(x.dims_mapping), set(x.partial))
+            for x in xs]
+    return keep, outs, TensorDistAttr([])
+
+
+def expand_as_rule(x: TensorDistAttr, src_shape: Sequence[int],
+                   dst_shape: Sequence[int]
+                   ) -> Tuple[TensorDistAttr, TensorDistAttr]:
+    """expand_as.cc: identical propagation to expand — broadcast dims
+    replicate, matching dims carry the input axis."""
+    return expand_rule(x, src_shape, dst_shape)
+
+
+def fused_linear_param_grad_add_rule(
+        x: TensorDistAttr, dout: TensorDistAttr
+) -> Tuple[List[TensorDistAttr], TensorDistAttr, TensorDistAttr]:
+    """fused_linear_param_grad_add.cc — dweight (+= x^T @ dout) used by
+    the TP/SP overlap path: dw maps [k from x's last dim, n from dout's
+    last dim] and is PARTIAL over every axis sharding the contracted
+    batch/sequence dims; dbias mirrors dout's last dim with the same
+    partial set."""
+    contracted = {a for a in x.dims_mapping[:-1] if a is not None}
+    contracted |= {a for a in dout.dims_mapping[:-1] if a is not None}
+    contracted |= x.partial | dout.partial
+    dw = TensorDistAttr([x.dims_mapping[-1], dout.dims_mapping[-1]],
+                        set(contracted))
+    dbias = TensorDistAttr([dout.dims_mapping[-1]], set(contracted))
+    return [TensorDistAttr(list(x.dims_mapping), set(x.partial)),
+            TensorDistAttr(list(dout.dims_mapping), set(dout.partial))], \
+        dw, dbias
+
+
+def optimizer_rule(param: TensorDistAttr,
+                   others: Sequence[TensorDistAttr],
+                   other_shapes: Optional[Sequence] = None
+                   ) -> Tuple[List[TensorDistAttr], TensorDistAttr]:
+    """optimizer.cc (adam/adamw/sgd/momentum SPMD): the param's sharding
+    is authoritative — grad and every moment/accumulator reshard to it
+    (partials on the grad must be reduced first: a sharded optimizer
+    update of a partial grad would apply the update twice); scalars
+    (lr, beta pows — identified by SHAPE when provided, since a [1]
+    tensor has the same rank as a 1-D param) replicate.  Output state
+    mirrors the param."""
+    def _numel(shape):
+        n = 1
+        for d in shape:
+            n *= (1 if d in (None, -1) else int(d))
+        return n
+
+    reqs = [TensorDistAttr(list(param.dims_mapping))]
+    for i, o in enumerate(others):
+        shp = other_shapes[i] if other_shapes is not None             and i < len(other_shapes) else None
+        scalar = (_numel(shp) <= 1) if shp is not None             else o.ndim != param.ndim
+        if not scalar and o.ndim == param.ndim:
+            reqs.append(TensorDistAttr(list(param.dims_mapping)))
+        else:              # lr / beta1_pow / ... scalars
+            reqs.append(o.replicate())
+    return reqs, TensorDistAttr(list(param.dims_mapping))

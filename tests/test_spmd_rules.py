@@ -178,3 +178,48 @@ class TestFlashAttentionRule:
         q = DA([None, None, None, "y"])
         r, _, _, out = flash_attention_rule(q, q, q)
         assert r.dims_mapping[3] is None
+
+
+class TestRound4bRuleTail:
+    """amp_ops / expand_as / fused_linear_param_grad_add / optimizer —
+    the last capability rules from the reference inventory
+    (phi/infermeta/spmd_rules/{amp_ops,expand_as,
+    fused_linear_param_grad_add,optimizer}.cc)."""
+
+    def test_amp_ops_found_inf_replicated(self):
+        from paddle_tpu.parallel.spmd_rules import amp_ops_rule
+        xs = [DA(["x", None]), DA([None, "y"])]
+        reqs, outs, found = amp_ops_rule(xs)
+        assert [r.dims_mapping for r in reqs] == [["x", None], [None, "y"]]
+        assert [o.dims_mapping for o in outs] == [["x", None], [None, "y"]]
+        assert found.dims_mapping == [] and not found.partial
+
+    def test_expand_as_matches_expand(self):
+        from paddle_tpu.parallel.spmd_rules import expand_as_rule
+        xr, out = expand_as_rule(DA(["x", None]), [4, 1], [2, 4, 8])
+        assert out.dims_mapping == [None, "x", None]
+
+    def test_fused_linear_param_grad_add_partial(self):
+        from paddle_tpu.parallel.spmd_rules import (
+            fused_linear_param_grad_add_rule)
+        # x [b(s=dp), s, k(mp-sharded? no: k axis)], dout [b, s, n]
+        x = DA(["dp", None, None])
+        dout = DA(["dp", None, "mp"])
+        reqs, dw, dbias = fused_linear_param_grad_add_rule(x, dout)
+        assert dw.dims_mapping == [None, "mp"]
+        assert dw.partial == {"dp"}       # contracted batch dim was sharded
+        assert dbias.dims_mapping == ["mp"] and dbias.partial == {"dp"}
+
+    def test_optimizer_moments_follow_param(self):
+        from paddle_tpu.parallel.spmd_rules import optimizer_rule
+        param = DA(["sh", None])
+        grad = DA(["sh", None], partial={"dp"})
+        m1, m2 = DA([None, None]), DA([None, None])
+        lr = DA([])
+        reqs, out = optimizer_rule(param, [grad, m1, m2, lr])
+        assert reqs[0].dims_mapping == ["sh", None]
+        # grad resharded to param mapping with partial CLEARED (p_to_r)
+        assert reqs[1].dims_mapping == ["sh", None] and not reqs[1].partial
+        assert reqs[2].dims_mapping == ["sh", None]
+        assert reqs[4].dims_mapping == []          # lr replicated scalar
+        assert out.dims_mapping == ["sh", None]

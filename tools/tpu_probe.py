@@ -55,6 +55,8 @@ def _raw_probe(timeout):
             else (out.stderr.strip().splitlines() or ["?"])[-1]
     except subprocess.TimeoutExpired:
         return False, f"timeout after {timeout}s (jax.devices() blocked)"
+    except Exception as e:   # fork/ENOMEM etc. — a probe failure is
+        return False, f"probe error: {e}"   # never fatal to the caller
     if ok:
         try:
             ok = json.loads(detail).get("platform") in ("tpu", "axon")
@@ -146,10 +148,14 @@ def seize(tag=""):
     def _on_tpu(fname) -> bool:
         # result-based check (closes the TOCTOU gap a liveness probe
         # leaves open): bench.py stamps the measuring device into every
-        # JSON row, so the artifact itself proves where it was measured
+        # JSON row, so the artifact itself proves where it was measured.
+        # Accept both device-string spellings the accelerator produces
+        # ("TPU v5 lite0" via libtpu, "axon:..." via the tunnel shim) —
+        # _raw_probe treats both platforms as the chip, so must we.
         try:
             with open(os.path.join(tdir, fname)) as f:
-                return '"device": "TPU' in f.read()
+                txt = f.read()
+            return '"device": "TPU' in txt or '"device": "axon' in txt
         except OSError:
             return False
 
@@ -171,7 +177,7 @@ def seize(tag=""):
     if not ok:
         _abort_rearm("headline")
         return
-    for cfg in ("lenet", "resnet50", "bert", "llama"):
+    for cfg in ("lenet", "resnet50", "bert", "llama", "decode"):
         results[f"bench_{cfg}"], ok = _bench(
             [sys.executable, "bench.py", "--config", cfg],
             f"bench_tpu_{cfg}{suffix}.json", 1800)
@@ -202,9 +208,17 @@ def seize(tag=""):
         # whole working tree (edits may be in progress)
         artifacts = ["BASELINE.md", os.path.relpath(sentinel, REPO),
                      "tools/tpu_probe.log"]
-        artifacts += [os.path.join("tools", f) for f in os.listdir(tdir)
-                      if f.startswith(("bench_tpu", "bench_sweep_tpu",
-                                       "pytest_tpu"))]
+        # exact names this run wrote — a glob would sweep in stale
+        # artifacts left behind by aborted runs of OTHER tags
+        produced = [f"bench_tpu{suffix}.json",
+                    f"bench_sweep_tpu{suffix}.json",
+                    f"pytest_tpu{suffix}.log"]
+        produced += [f"bench_tpu_{c}{suffix}.json"
+                     for c in ("lenet", "resnet50", "bert", "llama",
+                               "decode")]
+        produced += [f + ".stderr.log" for f in list(produced)]
+        artifacts += [os.path.join("tools", f) for f in produced
+                      if os.path.exists(os.path.join(tdir, f))]
         subprocess.run(["git", "add", "--"] + artifacts, cwd=REPO,
                        timeout=60)
         subprocess.run(["git", "commit", "-m",
