@@ -222,6 +222,47 @@ def run_config_bench(config: str):
                                "BASELINE sharding8 config)" if on_accel
                                else "llama_tiny CPU-liveness proxy"},
         }
+    elif config == "moe":
+        # GPT-MoE: single-chip measurement of the expert FFN path (scatter
+        # dispatch + batched expert einsums + top-2 routing); multi-chip
+        # EP adds one all_to_all each way over dp (dryrun-gated)
+        from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
+        from paddle_tpu import parallel as dist
+        if on_accel:
+            cfg = GPTConfig(vocab_size=32768, hidden_size=768,
+                            num_layers=12, num_heads=12,
+                            max_position_embeddings=1024, dtype="bfloat16",
+                            moe_num_experts=8)
+            b, s, steps = 8, 1024, 10
+        else:
+            cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                            num_heads=4, max_position_embeddings=128,
+                            moe_num_experts=4)
+            b, s, steps = 2, 64, 2
+        topo = dist.init_topology(devices=devices[:1])
+        step_fn, init_fn = build_gpt_train_step(
+            cfg, topo, num_microbatches=1, remat=not on_accel)
+        state = init_fn(0)
+        ids = rng.integers(0, cfg.vocab_size, (b, s)).astype(np.int32)
+        labels = np.roll(ids, -1, axis=1)
+        state, loss = step_fn(state, ids, labels)
+        jax.device_get(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = step_fn(state, ids, labels)
+        loss_val = float(np.asarray(jax.device_get(loss)))
+        dt = time.perf_counter() - t0
+        out = {
+            "metric": "gpt_moe_train_tokens_per_sec_per_chip",
+            "value": round(b * s * steps / dt, 1),
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "extra": {"steps": steps, "loss": loss_val,
+                      "experts": cfg.moe_num_experts,
+                      "top_k": cfg.moe_top_k,
+                      "device": str(devices[0]),
+                      "model": f"gpt-moe h{cfg.hidden_size} "
+                               f"L{cfg.num_layers} E{cfg.moe_num_experts}"},
+        }
     elif config == "decode":
         # inference: autoregressive decode through the KV-cache decoder
         # (prefill + lax.scan step loop; Pallas MMHA on TPU) — the

@@ -60,6 +60,13 @@ class GPTConfig:
     use_mp: bool = False       # build with tensor-parallel layers
     tie_word_embeddings: bool = True
     dtype: str = "float32"
+    # Mixture-of-experts FFN (0 = dense).  Experts are sharded over the
+    # dp mesh axis in the compiled hybrid step (expert parallelism, the
+    # reference's moe_layer.py:263 EP group) with all_to_all dispatch.
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 1e-2
 
     @property
     def ffn_size(self) -> int:
@@ -104,13 +111,22 @@ class GPTBlock(Layer):
         if cfg.use_mp:
             self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
             self.proj = RowParallelLinear(h, h, input_is_parallel=True)
+        else:
+            self.qkv = Linear(h, 3 * h)
+            self.proj = Linear(h, h)
+        if cfg.moe_num_experts:
+            # eager MoE path: the incubate MoELayer (GShard gate, dense
+            # capacity dispatch); expert TP/EP belong to the compiled
+            # hybrid step (build_gpt_train_step + parallel/moe.py)
+            from ..incubate.distributed.models.moe import MoELayer
+            self.moe = MoELayer(h, cfg.ffn_size, cfg.moe_num_experts,
+                                gate="gshard", top_k=cfg.moe_top_k)
+        elif cfg.use_mp:
             self.fc1 = ColumnParallelLinear(h, cfg.ffn_size,
                                             gather_output=False)
             self.fc2 = RowParallelLinear(cfg.ffn_size, h,
                                          input_is_parallel=True)
         else:
-            self.qkv = Linear(h, 3 * h)
-            self.proj = Linear(h, h)
             self.fc1 = Linear(h, cfg.ffn_size)
             self.fc2 = Linear(cfg.ffn_size, h)
         self.drop = Dropout(cfg.dropout)
@@ -131,7 +147,10 @@ class GPTBlock(Layer):
         x = residual + self.drop(self.proj(attn))
         residual = x
         y = self.ln2(x)
-        y = self.fc2(F.gelu(self.fc1(y), approximate=True))
+        if cfg.moe_num_experts:
+            y = self.moe(y)
+        else:
+            y = self.fc2(F.gelu(self.fc1(y), approximate=True))
         return residual + self.drop(y)
 
 
@@ -201,20 +220,37 @@ def init_block_params(cfg: GPTConfig, key) -> Dict[str, jax.Array]:
     """Pure init of one block's params (names match block_apply)."""
     h, f = cfg.hidden_size, cfg.ffn_size
     std = cfg.initializer_range
+    # 4-way split as always — the dense init streams must stay stable
+    # across versions (recorded bench losses); the MoE gate key is derived
+    # separately via fold_in so moe_num_experts=0 reproduces exactly
     ks = jax.random.split(key, 4)
     dt = jnp.dtype(cfg.dtype)
-    return {
+    out = {
         "ln1_w": jnp.ones((h,), dt), "ln1_b": jnp.zeros((h,), dt),
         "ln2_w": jnp.ones((h,), dt), "ln2_b": jnp.zeros((h,), dt),
         "qkv_w": jax.random.normal(ks[0], (h, 3 * h), dt) * std,
         "qkv_b": jnp.zeros((3 * h,), dt),
         "proj_w": jax.random.normal(ks[1], (h, h), dt) * std,
         "proj_b": jnp.zeros((h,), dt),
-        "fc1_w": jax.random.normal(ks[2], (h, f), dt) * std,
-        "fc1_b": jnp.zeros((f,), dt),
-        "fc2_w": jax.random.normal(ks[3], (f, h), dt) * std,
-        "fc2_b": jnp.zeros((h,), dt),
     }
+    if cfg.moe_num_experts:
+        E = cfg.moe_num_experts
+        gate_key = jax.random.fold_in(key, 4)
+        out.update({
+            "gate_w": jax.random.normal(gate_key, (h, E), dt) * std,
+            "e_w1": jax.random.normal(ks[2], (E, h, f), dt) * std,
+            "e_b1": jnp.zeros((E, f), dt),
+            "e_w2": jax.random.normal(ks[3], (E, f, h), dt) * std,
+            "e_b2": jnp.zeros((E, h), dt),
+        })
+    else:
+        out.update({
+            "fc1_w": jax.random.normal(ks[2], (h, f), dt) * std,
+            "fc1_b": jnp.zeros((f,), dt),
+            "fc2_w": jax.random.normal(ks[3], (f, h), dt) * std,
+            "fc2_b": jnp.zeros((h,), dt),
+        })
+    return out
 
 
 def block_param_specs(cfg: GPTConfig, pipeline: bool) -> Dict[str, P]:
@@ -224,9 +260,20 @@ def block_param_specs(cfg: GPTConfig, pipeline: bool) -> Dict[str, P]:
         "ln1_w": P(), "ln1_b": P(), "ln2_w": P(), "ln2_b": P(),
         "qkv_w": P(None, MP_AXIS), "qkv_b": P(MP_AXIS),
         "proj_w": P(MP_AXIS, None), "proj_b": P(),
-        "fc1_w": P(None, MP_AXIS), "fc1_b": P(MP_AXIS),
-        "fc2_w": P(MP_AXIS, None), "fc2_b": P(),
     }
+    if cfg.moe_num_experts:
+        # expert parallelism: expert dim over dp (each data rank owns
+        # E/dp experts), Megatron TP inside each expert over mp
+        base.update({
+            "gate_w": P(),
+            "e_w1": P(DP_AXIS, None, MP_AXIS), "e_b1": P(DP_AXIS, MP_AXIS),
+            "e_w2": P(DP_AXIS, MP_AXIS, None), "e_b2": P(DP_AXIS, None),
+        })
+    else:
+        base.update({
+            "fc1_w": P(None, MP_AXIS), "fc1_b": P(MP_AXIS),
+            "fc2_w": P(MP_AXIS, None), "fc2_b": P(),
+        })
     if not pipeline:
         return base
     return {k: P(PP_AXIS, None, *list(v)) for k, v in base.items()}
@@ -250,7 +297,9 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
                 cfg: GPTConfig, attn_fn=None,
                 mp_axis: Optional[str] = None,
                 sequence_parallel: bool = False,
-                tp_overlap: bool = False) -> jax.Array:
+                tp_overlap: bool = False,
+                ep_axis: Optional[str] = None,
+                moe_aux_coef: Optional[float] = None) -> jax.Array:
     """One transformer block, pure jnp (used stacked under lax.scan).
 
     ``attn_fn(q, k, v) -> out`` (all [b, s, heads_local, head_dim])
@@ -319,7 +368,24 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
         attn = attn.reshape(b, s, attn.shape[2] * attn.shape[3])
     x = res + row_mm(attn, params["proj_w"]) + params["proj_b"]
     res = x
-    (y,) = col_mm(ln(x, params["ln2_w"], params["ln2_b"]), params["fc1_w"])
+    y_in = ln(x, params["ln2_w"], params["ln2_b"])
+    if cfg.moe_num_experts:
+        from ..parallel.moe import moe_ffn_ep
+        if mp_axis is not None and sequence_parallel:
+            from ..parallel.sequence_parallel import (all_gather_op,
+                                                      scatter_op)
+            y_in = all_gather_op(y_in, mp_axis)
+        out = moe_ffn_ep(
+            y_in, params["gate_w"], params["e_w1"], params["e_b1"],
+            params["e_w2"], params["e_b2"], top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor, ep_axis=ep_axis,
+            mp_axis=mp_axis, sequence_parallel=sequence_parallel,
+            aux_coef=(cfg.moe_aux_coef if moe_aux_coef is None
+                      else moe_aux_coef))
+        if mp_axis is not None and sequence_parallel:
+            out = scatter_op(out, mp_axis)
+        return res + out
+    (y,) = col_mm(y_in, params["fc1_w"])
     y = jax.nn.gelu(y + params["fc1_b"], approximate=True)
     return res + row_mm(y, params["fc2_w"]) + params["fc2_b"]
 
@@ -376,9 +442,15 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
     S = topo.get_pipe_parallel_world_size()
     mp = topo.get_model_parallel_world_size()
     sep = topo.get_sep_parallel_world_size()
+    dp = topo.axis_size(DP_AXIS)
+    shard = topo.axis_size(SHARDING_AXIS)
     if cfg.num_layers % S != 0:
         raise ValueError(
             f"num_layers {cfg.num_layers} not divisible by pp degree {S}")
+    if cfg.moe_num_experts and cfg.moe_num_experts % dp != 0:
+        raise ValueError(
+            f"moe_num_experts={cfg.moe_num_experts} not divisible by the "
+            f"expert-parallel (dp) degree {dp}")
     if mp > 1:
         for name, val in (("vocab_size", cfg.vocab_size),
                           ("num_heads", cfg.num_heads),
@@ -475,10 +547,36 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
             x = scatter_op(x, MP_AXIS)
         return x
 
+    # MoE aux-loss injection coefficient: inject_aux_grad adds a CONSTANT
+    # cotangent per site (layer x microbatch x data rank), while the two
+    # schedule families normalize grads differently — the pipeline paths
+    # divide the summed vjp by norm = b_l*s_l*dp*shard*sep afterwards,
+    # the S==1 path divides the loss (but not the injected constant)
+    # inside loss_fn.  These factors make both equal an effective
+    #   loss += moe_aux_coef * mean_over_sites(aux)
+    step_ctx_fn = None
+    if cfg.moe_num_experts:
+        def step_ctx_fn(s_l):
+            return {"s_l": s_l}
+
+    def _moe_coef(x, ctx):
+        if not cfg.moe_num_experts:
+            return None
+        if S > 1 and schedule in ("1f1b", "zbh1", "interleave"):
+            # manual-vjp schedules divide the summed grads by
+            # norm = b_l*s_l*R afterwards; sites = L x M x R
+            return cfg.moe_aux_coef * x.shape[0] * ctx["s_l"] \
+                / cfg.num_layers
+        # value_and_grad paths (S==1, gpipe): the /norm inside loss_fn
+        # does not touch the injected constant
+        M = num_microbatches if S > 1 else 1
+        return cfg.moe_aux_coef / (cfg.num_layers * M * dp * shard * sep)
+
     def block_fn(layer_params, x, ctx):
-        del ctx
         return block_apply(layer_params, x, cfg, cp_attn, mp_axis=MP_AXIS,
-                           sequence_parallel=sp, tp_overlap=tp_overlap)
+                           sequence_parallel=sp, tp_overlap=tp_overlap,
+                           ep_axis=DP_AXIS if cfg.moe_num_experts else None,
+                           moe_aux_coef=_moe_coef(x, ctx))
 
     def head_nll_fn(params, x, labels):
         if sp:   # head/loss run on the full (replicated) sequence
@@ -492,14 +590,20 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                             preferred_element_type=jnp.float32)
         return man.vocab_parallel_nll(logits, labels)
 
+    # Under SP, biases added on the mp-sharded sequence have mp-partial
+    # grads.  The MoE block adds its expert biases BEFORE the scatter
+    # back to the sequence shard (replicated over mp), so only proj_b
+    # stays partial there.
+    sp_reduce = {"ln1_w", "ln1_b", "ln2_w", "ln2_b", "proj_b"}
+    if not cfg.moe_num_experts:
+        sp_reduce.add("fc2_b")
     return man.build_hybrid_train_step(
         topo=topo, param_specs=param_specs, init_params_fn=init_params_fn,
         embed_fn=embed_fn, block_fn=block_fn, head_nll_fn=head_nll_fn,
+        step_ctx_fn=step_ctx_fn,
         num_microbatches=num_microbatches, learning_rate=learning_rate,
         remat=remat, remat_policy=remat_policy,
         schedule=schedule, sharding_stage=sharding_stage,
         num_model_chunks=num_model_chunks,
         offload_optimizer=offload_optimizer,
-        mp_reduce_block_leaves=frozenset(
-            {"ln1_w", "ln1_b", "ln2_w", "ln2_b", "proj_b", "fc2_b"}
-            if sp else ()))
+        mp_reduce_block_leaves=frozenset(sp_reduce if sp else ()))

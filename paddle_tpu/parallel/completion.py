@@ -348,9 +348,9 @@ def _infer_node(name: str, in_attrs: List[TensorDistAttr], node):
         src = [1 if d in (None, -1) else int(d)
                for d in node.in_vars[0].shape]
         dst = [1 if d in (None, -1) else int(d) for d in outs[0].shape]
-        from .spmd_rules import expand_as_rule
-        fn = expand_as_rule if base == "expand_as" else expand_rule
-        req, o = fn(in_attrs[0], src, dst)
+        # expand_as_rule is a pure alias of expand_rule (kept for
+        # reference-inventory parity in spmd_rules); route both here
+        req, o = expand_rule(in_attrs[0], src, dst)
         return [req] + in_attrs[1:], [o] * len(outs), "expand"
     if base in ("triu", "tril") and in_attrs and in_attrs[0].ndim >= 2:
         req, o = triu_rule(in_attrs[0])
@@ -401,12 +401,18 @@ def _infer_node(name: str, in_attrs: List[TensorDistAttr], node):
                      for v in getattr(node, "in_vars", [])][1:]
         reqs, o = optimizer_rule(in_attrs[0], in_attrs[1:],
                                  in_shapes or None)
-        # scalar state outputs (beta pows, lr) stay replicated at their
-        # own rank; tensor state mirrors the param
+        # scalar state outputs (beta pows, lr) stay replicated; tensor
+        # state mirrors the param.  Classify by NUMEL, not ndim — a
+        # [1]-shaped beta-pow output on a 1-D param must not inherit the
+        # param's sharded mapping (its aliased input is replicated).
         o_list = []
         for ov in outs:
-            nd = len(getattr(ov, "shape", ()) or ())
-            if nd == o.ndim:
+            shp = getattr(ov, "shape", ()) or ()
+            nd = len(shp)
+            numel = 1
+            for d in shp:
+                numel *= 1 if d in (None, -1) else int(d)
+            if nd == o.ndim and numel > 1:
                 o_list.append(TensorDistAttr(list(o.dims_mapping)))
             else:
                 o_list.append(TensorDistAttr([None] * nd))

@@ -47,6 +47,20 @@ __all__ = ["mp_copy", "fwd_psum", "vocab_parallel_embedding",
 # Flat optimizer-moment layout: [pp, mp, shard * chunk] — one fp32 chunk per
 # (pp, mp, sharding) mesh coordinate, replicated over dp/sep.
 MOMENT_SPEC = P(PP_AXIS, MP_AXIS, SHARDING_AXIS)
+# Expert-parallel leaves (param spec carries the dp axis — MoE expert
+# banks): every (dp, sharding) coordinate owns distinct state, so the
+# flat dim is sharded over both and NOT replicated over dp.
+MOMENT_SPEC_EP = P(PP_AXIS, MP_AXIS, (DP_AXIS, SHARDING_AXIS))
+
+
+def spec_has_axis(spec: P, axis: str) -> bool:
+    """True if the PartitionSpec mentions ``axis`` (incl. tuple entries)."""
+    for ax in tuple(spec):
+        if ax is None:
+            continue
+        if axis in (ax if isinstance(ax, tuple) else (ax,)):
+            return True
+    return False
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
@@ -325,6 +339,13 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
     n_lead = 2 if vpp_deg > 1 else 1
     BLOCK_FLAT_SPEC = P(PP_AXIS, *((None,) * n_lead + (MP_AXIS,)),
                         SHARDING_AXIS)
+    BLOCK_FLAT_SPEC_EP = P(PP_AXIS, *((None,) * n_lead + (MP_AXIS,)),
+                           (DP_AXIS, SHARDING_AXIS))
+    # expert-parallel leaves: the param spec shards them over dp, so their
+    # grads are NOT reduced over dp (each data rank owns distinct experts)
+    # and their moments/flat storage carry a dp dimension
+    ep_leaves = {k for k, s in param_specs.get("blocks", {}).items()
+                 if spec_has_axis(s, DP_AXIS)}
     stage3 = sharding_stage == 3
     if stage3:
         p_abs = jax.eval_shape(init_params_fn, 0)
@@ -346,14 +367,17 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
                                         param_specs["blocks"][k], True)
                           for k in p_abs["blocks"]}
         flat_specs = {k: MOMENT_SPEC for k in p_abs if k != "blocks"}
-        flat_specs["blocks"] = {k: BLOCK_FLAT_SPEC
+        flat_specs["blocks"] = {k: BLOCK_FLAT_SPEC_EP if k in ep_leaves
+                                else BLOCK_FLAT_SPEC
                                 for k in p_abs["blocks"]}
         store_specs = flat_specs
         mom_specs = flat_specs
     else:
         store_specs = param_specs
-        mom_specs = tree_map_with_spec(lambda _p, _s: MOMENT_SPEC,
-                                       param_specs, param_specs)
+        mom_specs = tree_map_with_spec(
+            lambda _p, s: (MOMENT_SPEC_EP if spec_has_axis(s, DP_AXIS)
+                           else MOMENT_SPEC),
+            param_specs, param_specs)
 
     def sh(spec):
         return NamedSharding(mesh, spec)
@@ -361,8 +385,9 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
     def _flat_shape(k, k2=None):
         if k2 is None:
             return (S, mp_deg, shard * info[k]["chunk"])
+        dpf = dp if k2 in ep_leaves else 1
         return (S,) + info["blocks"][k2]["lead"] + (
-            mp_deg, shard * info["blocks"][k2]["chunk"])
+            mp_deg, dpf * shard * info["blocks"][k2]["chunk"])
 
     def init_fn(seed: int = 0):
         params = init_params_fn(seed)
@@ -554,15 +579,18 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
         t2 = t + 1
         tf = t2.astype(_jnp.float32)
 
-        def upd(is_blocks, p, g, m_leaf, v_leaf, mp_partial=False):
+        def upd(is_blocks, p, g, m_leaf, v_leaf, mp_partial=False,
+                ep=False):
             # data-axis grad reduction; non-block leaves are replicated
             # over pp (stage0 embeds, last stage heads) so sum over pp
             # too.  NEVER over mp (mp-replicated params get full grads
             # via mp_copy's bwd psum, mp-sharded ones are local) — except
             # sequence-parallel leaves, whose activations were mp-sharded
-            # along seq so each rank saw only its tokens.
-            red = (DP_AXIS, SEP_AXIS) if is_blocks \
-                else (PP_AXIS, DP_AXIS, SEP_AXIS)
+            # along seq so each rank saw only its tokens.  Expert leaves
+            # (``ep``) skip the dp reduction: each data rank's expert
+            # grads are complete after the all_to_all routing round-trip.
+            red = ((SEP_AXIS,) if ep else (DP_AXIS, SEP_AXIS)) \
+                if is_blocks else (PP_AXIS, DP_AXIS, SEP_AXIS)
             if mp_partial:
                 red = red + (MP_AXIS,)
             g = lax.psum(g, red)
@@ -591,7 +619,8 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
              new_v["blocks"][k]) = upd(
                 True, params["blocks"][k], grads["blocks"][k],
                 m["blocks"][k], v["blocks"][k],
-                mp_partial=k in mp_reduce_block_leaves)
+                mp_partial=k in mp_reduce_block_leaves,
+                ep=k in ep_leaves)
         return new_p, new_m, new_v, t2, loss
 
     shd = jax.shard_map(
@@ -676,11 +705,15 @@ def local_shape(shape: Tuple[int, ...], spec: P,
 def moment_shape(param_shape: Tuple[int, ...], spec: P,
                  topo: HybridTopology) -> Tuple[int, int, int]:
     """Global shape of the flat ZeRO moment buffer for one param leaf:
-    [pp, mp, shard*chunk] with chunk = ceil(local_numel/shard)."""
+    [pp, mp, shard*chunk] with chunk = ceil(local_numel/shard).  Expert
+    (dp-sharded) leaves get a dp factor on the flat dim to match
+    MOMENT_SPEC_EP — each data rank's experts carry their own moments."""
     n = int(np.prod(local_shape(param_shape, spec, topo))) or 1
     shard = topo.axis_size(SHARDING_AXIS)
     chunk = -(-n // shard)
-    return (topo.axis_size(PP_AXIS), topo.axis_size(MP_AXIS), shard * chunk)
+    dpf = topo.axis_size(DP_AXIS) if spec_has_axis(spec, DP_AXIS) else 1
+    return (topo.axis_size(PP_AXIS), topo.axis_size(MP_AXIS),
+            dpf * shard * chunk)
 
 
 def tree_map_with_spec(fn, tree, specs):

@@ -1,0 +1,189 @@
+"""Expert-parallel MoE FFN for the compiled hybrid train step.
+
+The eager :class:`~paddle_tpu.incubate.distributed.models.moe.MoELayer`
+covers the reference's imperative MoE API (moe_layer.py:263) with dense
+[T, E, C] dispatch/combine einsums.  This module is the MANUAL-SPMD
+counterpart used inside the all-axes shard_map of
+:func:`~paddle_tpu.parallel.manual.build_hybrid_train_step`:
+
+* Routing is scatter-based — positions come from a [T*k, E] cumsum and
+  tokens are scattered straight into the [E, C, h] expert buffers — so
+  memory is O(T*E + E*C*h) instead of the O(T*E*C) one-hot dispatch mask
+  (which is quadratic in tokens at fixed expert count).
+* Expert parallelism follows the reference's distributed design
+  (global_scatter/global_gather over the expert-parallel group,
+  moe_layer.py:55): expert weights are SHARDED over the ``dp`` mesh axis
+  (each data rank owns E/ep experts) and tokens move with ONE
+  ``lax.all_to_all`` each way.  The all_to_all rides ICI inside the
+  compiled step — no host round trip, unlike the reference's NCCL
+  global_scatter.
+* Tensor parallelism inside experts is Megatron-style (w1 column-split,
+  w2 row-split over ``mp``) with the same mp_copy / fwd_psum collectives
+  as the dense block.
+* The GShard load-balance loss enters training through
+  :func:`inject_aux_grad` — a custom-VJP identity that contributes
+  ``coef * d(aux)/dparams`` to the backward pass without threading an
+  extra scalar through the pipeline schedules (the compiled-step analog
+  of the reference gate's ``get_loss()`` being added to the model loss).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..incubate.distributed.models.moe.gating import (compute_capacity,
+                                                      gshard_aux_loss)
+from .manual import fwd_psum, mp_copy
+
+__all__ = ["inject_aux_grad", "topk_scatter_routing", "moe_ffn_ep",
+           "compute_capacity"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def inject_aux_grad(x, aux, coef: float):
+    """Identity on ``x`` whose backward adds ``coef`` as the cotangent of
+    ``aux`` — exactly as if ``coef * aux`` had been added to the final
+    scalar loss, without changing any forward value or signature.
+
+    This lets per-layer auxiliary losses (MoE load balance) reach the
+    optimizer through pipeline schedules whose carries are activation
+    tensors only.  The forward loss value deliberately EXCLUDES the aux
+    term (monitor it separately if needed); gradients include it exactly.
+    """
+    del aux, coef
+    return x
+
+
+def _inject_fwd(x, aux, coef):
+    del aux
+    return x, None
+
+
+def _inject_bwd(coef, _, g):
+    return g, jnp.asarray(coef, jnp.float32)
+
+
+inject_aux_grad.defvjp(_inject_fwd, _inject_bwd)
+
+
+def topk_scatter_routing(logits: jax.Array, top_k: int, capacity: int,
+                         normalize: bool = True
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                    jax.Array]:
+    """Top-k router emitting scatter indices instead of dispatch masks.
+
+    Same semantics as :func:`...moe.gating.topk_capacity_gating` (GShard
+    priority: every token's k-th choice is ranked after all (k-1)-th
+    choices; overflow beyond ``capacity`` is dropped), but O(T*E) memory.
+
+    Args:
+      logits: [T, E] router logits (softmaxed in fp32).
+    Returns:
+      idx:  [T, k] int32 — expert id per assignment.
+      pos:  [T, k] int32 — slot in the expert buffer; == ``capacity``
+            where the assignment was dropped (out-of-range on purpose so
+            mode="drop"/"fill" scatters/gathers ignore it).
+      w:    [T, k] fp32 — combine weights (0 where dropped).
+      aux:  scalar GShard load-balance loss.
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    aux = gshard_aux_loss(probs, jnp.argmax(probs, axis=-1))
+    w, idx = lax.top_k(probs, top_k)                    # [T, k]
+    idx = idx.astype(jnp.int32)
+    # slot = number of earlier assignments to the same expert, counting
+    # k-major (all 1st choices in token order, then all 2nd choices)
+    oh = jax.nn.one_hot(idx, E, dtype=jnp.int32)        # [T, k, E]
+    ohf = oh.transpose(1, 0, 2).reshape(top_k * T, E)
+    prior = jnp.cumsum(ohf, axis=0) - ohf
+    pos = jnp.sum(prior * ohf, axis=-1).reshape(top_k, T).T  # [T, k]
+    keep = pos < capacity
+    w = w * keep
+    if normalize and top_k > 1:
+        w = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-9)
+    pos = jnp.where(keep, pos, capacity).astype(jnp.int32)
+    return idx, pos, w, aux
+
+
+def moe_ffn_ep(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
+               b1: jax.Array, w2: jax.Array, b2: jax.Array, *,
+               top_k: int = 2, capacity_factor: float = 1.25,
+               ep_axis: Optional[str] = None,
+               mp_axis: Optional[str] = None,
+               sequence_parallel: bool = False,
+               aux_coef: float = 0.0,
+               activation: Callable = functools.partial(jax.nn.gelu,
+                                                        approximate=True),
+               normalize: bool = True) -> jax.Array:
+    """Mixture-of-experts FFN, expert-parallel over ``ep_axis``.
+
+    Args:
+      x: [..., h] device-local tokens (the FULL gathered sequence when
+         the caller runs Megatron sequence parallelism).
+      gate_w: [h, E] router weights (math in fp32).
+      w1/b1/w2/b2: LOCAL expert shards — [E/ep, h, f/mp], [E/ep, f/mp],
+         [E/ep, f/mp, h], [E/ep, h].  With no mesh axes these are the
+         full [E, ...] banks and the function is a plain jit MoE FFN.
+      ep_axis: mesh axis the expert dim is sharded over (the hybrid step
+         passes ``dp``); None = experts all local.
+      mp_axis: Megatron TP axis inside each expert (column w1 / row w2).
+      sequence_parallel: caller gathered the sequence over ``mp_axis``;
+         the mp-input reduction then lives in the caller's all_gather
+         transpose, so no mp_copy here, and the caller reduce-scatters
+         after (the fwd psum here keeps outputs replicated over mp).
+      aux_coef: weight on the GShard balance loss, injected via
+         :func:`inject_aux_grad` (0 = off).
+    """
+    shape = x.shape
+    h = shape[-1]
+    tokens = x.reshape(-1, h)
+    T = tokens.shape[0]
+    ep = 1 if ep_axis is None else lax.axis_size(ep_axis)
+    E_local = w1.shape[0]
+    E = E_local * ep
+    if gate_w.shape[1] != E:
+        raise ValueError(f"gate_w experts {gate_w.shape[1]} != "
+                         f"{E_local}x{ep} sharded expert bank")
+    C = compute_capacity(T, E, top_k, capacity_factor)
+
+    logits = tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    idx, pos, w, aux = topk_scatter_routing(logits, top_k, C, normalize)
+
+    # dispatch: scatter each kept assignment's token into its expert slot
+    tok_rep = jnp.broadcast_to(tokens[:, None, :],
+                               (T, top_k, h)).reshape(T * top_k, h)
+    buf = jnp.zeros((E, C, h), x.dtype)
+    buf = buf.at[idx.reshape(-1), pos.reshape(-1)].set(tok_rep, mode="drop")
+
+    if ep_axis is not None:
+        # [E, C, h] -> [E/ep, ep*C, h]: every rank's slots for MY experts
+        # (global_scatter parity, reference moe_utils.py global routing)
+        buf = lax.all_to_all(buf, ep_axis, split_axis=0, concat_axis=1,
+                             tiled=True)
+    y = buf
+    if mp_axis is not None and not sequence_parallel:
+        y = mp_copy(y, mp_axis)           # identity fwd / psum bwd (col in)
+    hdn = jnp.einsum("gch,ghf->gcf", y, w1) + b1[:, None, :]
+    hdn = activation(hdn)
+    out = jnp.einsum("gcf,gfh->gch", hdn, w2)
+    if mp_axis is not None:
+        out = fwd_psum(out, mp_axis)      # row out: sum the f/mp partials
+    out = out + b2[:, None, :]
+    if ep_axis is not None:
+        # inverse all_to_all: my slots come home from every expert rank
+        out = lax.all_to_all(out, ep_axis, split_axis=1, concat_axis=0,
+                             tiled=True)
+
+    got = out.at[idx, pos].get(mode="fill", fill_value=0)   # [T, k, h]
+    res = jnp.sum(w[..., None].astype(jnp.float32)
+                  * got.astype(jnp.float32), axis=1)
+    res = res.astype(x.dtype).reshape(shape)
+    if aux_coef:
+        res = inject_aux_grad(res, aux, aux_coef)
+    return res

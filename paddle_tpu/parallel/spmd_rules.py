@@ -626,13 +626,21 @@ def amp_ops_rule(xs: Sequence[TensorDistAttr]
                             TensorDistAttr]:
     """check_finite_and_unscale / update_loss_scaling (amp_ops.cc): every
     tensor keeps its sharding (the scale is elementwise), scaled outputs
-    mirror the inputs, and found_inf is a REPLICATED scalar — it feeds a
-    host-side branch, so leaving it partial would diverge across ranks."""
+    mirror the inputs, and found_inf is PARTIAL over every axis sharding
+    any checked tensor — each rank tests only its local shard, so a
+    cross-rank reduction (any/max) is REQUIRED before the scalar feeds
+    the host-side skip-step branch.  The reference marks found_infinite
+    partial for exactly this reason; declaring it replicated would be an
+    assertion, not an operation, and ranks would silently diverge."""
     keep = [TensorDistAttr(list(x.dims_mapping), set(x.partial))
             for x in xs]
     outs = [TensorDistAttr(list(x.dims_mapping), set(x.partial))
             for x in xs]
-    return keep, outs, TensorDistAttr([])
+    sharded = set()
+    for x in xs:
+        sharded |= {a for a in x.dims_mapping if a is not None}
+        sharded |= x.partial
+    return keep, outs, TensorDistAttr([], sharded)
 
 
 def expand_as_rule(x: TensorDistAttr, src_shape: Sequence[int],

@@ -1,0 +1,193 @@
+"""MoE expert parallelism in the compiled hybrid step.
+
+Reference surface: incubate MoE layer + EP process groups
+(python/paddle/incubate/distributed/models/moe/moe_layer.py:263,
+distributed/utils/moe_utils.py global_scatter/global_gather).  TPU-native
+design under test: experts sharded over the dp mesh axis with one
+lax.all_to_all each way inside the all-axes-manual shard_map
+(parallel/moe.py), GShard aux loss entering training via gradient
+injection (inject_aux_grad), and dp-exempt grad reduction + dp-sharded
+optimizer moments for expert leaves (parallel/manual.py ep_leaves).
+
+Equivalence pins: any EP/TP/PP/sharding layout must reproduce the
+single-device loss trajectory (capacity_factor set high enough that no
+tokens drop, so routing is layout-invariant)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from paddle_tpu import parallel as dist
+from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
+from paddle_tpu.parallel.moe import (inject_aux_grad, moe_ffn_ep,
+                                     topk_scatter_routing)
+from paddle_tpu.parallel.topology import HybridTopology, set_topology
+from paddle_tpu.incubate.distributed.models.moe.gating import (
+    compute_capacity, topk_capacity_gating)
+
+
+@pytest.fixture(autouse=True)
+def reset_topology():
+    yield
+    set_topology(HybridTopology())
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+                max_position_embeddings=64, moe_num_experts=4,
+                moe_capacity_factor=2.0, moe_aux_coef=0.0)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def _losses(cfg, steps=3, batch=8, seq=32, **kw):
+    axes = {k: kw.pop(k) for k in ("dp", "mp", "pp", "sep", "sharding")
+            if k in kw}
+    topo = dist.init_topology(**axes)
+    kw.setdefault("num_microbatches", 2 if axes.get("pp", 1) > 1 else 1)
+    step_fn, init_fn = build_gpt_train_step(cfg, topo, **kw)
+    state = init_fn(0)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    labels = np.roll(ids, -1, axis=1)
+    out = []
+    for _ in range(steps):
+        state, loss = step_fn(state, ids, labels)
+        out.append(float(np.asarray(jax.device_get(loss))))
+    return out
+
+
+_BASE = {}
+
+
+def _base(aux=0.0):
+    if aux not in _BASE:
+        _BASE[aux] = _losses(_cfg(moe_aux_coef=aux))
+    return _BASE[aux]
+
+
+def test_moe_single_device_trains():
+    losses = _base()
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("axes,extra", [
+    (dict(dp=4), {}),                             # pure EP (1 expert/rank)
+    (dict(dp=2, mp=2), {}),                       # EP x expert-TP
+    (dict(dp=2, mp=2), dict(sequence_parallel=True)),   # EP x TP-SP
+    (dict(dp=2, pp=2), {}),                       # EP x pipeline (1f1b)
+    (dict(dp=2, sharding=2), dict(sharding_stage=2)),
+    (dict(dp=2, sharding=2), dict(sharding_stage=3)),
+    (dict(dp=2, sep=2), {}),                      # EP x context parallel
+])
+def test_moe_layout_equivalence(axes, extra):
+    losses = _losses(_cfg(), **axes, **extra)
+    np.testing.assert_allclose(losses, _base(), rtol=2e-3)
+
+
+def test_moe_aux_coef_changes_training():
+    """aux injection must alter the trajectory (gradients) while leaving
+    the step-0 forward loss untouched (inject_aux_grad is identity fwd)."""
+    on = _losses(_cfg(moe_aux_coef=1e-1))
+    off = _base()
+    assert on[0] == pytest.approx(off[0], rel=1e-6)
+    assert any(abs(a - b) > 1e-6 for a, b in zip(on[1:], off[1:]))
+
+
+def test_moe_aux_equivalence_across_layouts():
+    """With aux ON, dp4 EP must still track the single-device run:
+    pins the injection-coefficient normalization (sites x /norm paths)."""
+    losses = _losses(_cfg(moe_aux_coef=1e-2), dp=4)
+    np.testing.assert_allclose(losses, _base(1e-2), rtol=2e-3)
+
+
+def test_moe_pp_aux_equivalence():
+    """Manual-vjp pipeline path normalizes grads by /norm AFTER the vjp;
+    the injected coefficient compensates (models/gpt.py _moe_coef)."""
+    losses = _losses(_cfg(moe_aux_coef=1e-2), dp=2, pp=2)
+    np.testing.assert_allclose(losses, _base(1e-2), rtol=2e-3)
+
+
+def test_inject_aux_grad_matches_explicit_loss():
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (4, 3))
+
+    def loss_inject(x):
+        aux = jnp.sum(x ** 2)          # stand-in aux depending on params
+        y = inject_aux_grad(x * 2.0, aux, 0.3)
+        return jnp.sum(y)
+
+    def loss_explicit(x):
+        aux = jnp.sum(x ** 2)
+        return jnp.sum(x * 2.0) + 0.3 * aux
+
+    g1 = jax.grad(loss_inject)(x)
+    g2 = jax.grad(loss_explicit)(x)
+    np.testing.assert_allclose(g1, g2, rtol=1e-6)
+    # forward value excludes the aux term by design
+    assert float(loss_inject(x)) == pytest.approx(
+        float(jnp.sum(x * 2.0)), rel=1e-6)
+
+
+def test_eager_gpt_moe_forward_backward():
+    """GPTBlock routes its FFN through the incubate MoELayer when
+    cfg.moe_num_experts is set (eager parity with the compiled path)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    cfg = _cfg()
+    net = GPTForCausalLM(cfg)
+    ids = pt.Tensor(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (2, 16)).astype(np.int32))
+    loss = net(ids, ids)
+    loss.backward()
+    g = net.gpt.blocks[0].moe.w1.grad
+    arr = np.asarray(g._value if hasattr(g, "_value") else g)
+    assert np.isfinite(float(loss._value)) and np.isfinite(arr).all()
+
+
+def test_scatter_routing_matches_dense_gating():
+    """idx/pos/w reconstruct exactly the dense [T, E, C] combine tensor of
+    the eager gate (incubate gating.topk_capacity_gating)."""
+    T, E, k = 16, 4, 2
+    logits = jax.random.normal(jax.random.key(1), (T, E))
+    C = compute_capacity(T, E, k, 1.25)
+    combine_ref, dispatch_ref, aux_ref = topk_capacity_gating(logits, k, C)
+    idx, pos, w, aux = topk_scatter_routing(logits, k, C)
+    combine = jnp.zeros((T, E, C))
+    for t in range(T):
+        for j in range(k):
+            if int(pos[t, j]) < C:
+                combine = combine.at[t, int(idx[t, j]),
+                                     int(pos[t, j])].set(w[t, j])
+    np.testing.assert_allclose(combine, combine_ref, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(aux, aux_ref, rtol=1e-6)
+
+
+def test_moe_ffn_ep_local_matches_reference():
+    """Single-process moe_ffn_ep == a straightforward dense-mask MoE on
+    the same params (independent formulation: einsum dispatch/combine)."""
+    T, h, f, E, k = 12, 8, 16, 4, 2
+    keys = jax.random.split(jax.random.key(2), 6)
+    x = jax.random.normal(keys[0], (T, h))
+    gate_w = jax.random.normal(keys[1], (h, E)) * 0.1
+    w1 = jax.random.normal(keys[2], (E, h, f)) * 0.1
+    b1 = jax.random.normal(keys[3], (E, f)) * 0.1
+    w2 = jax.random.normal(keys[4], (E, f, h)) * 0.1
+    b2 = jax.random.normal(keys[5], (E, h)) * 0.1
+    C = compute_capacity(T, E, k, 2.0)
+
+    got = moe_ffn_ep(x, gate_w, w1, b1, w2, b2, top_k=k,
+                     capacity_factor=2.0)
+
+    combine, dispatch, _ = topk_capacity_gating(
+        (x.astype(jnp.float32) @ gate_w), k, C)
+    ein = jnp.einsum("tec,th->ech", dispatch.astype(jnp.float32), x)
+    hdn = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", ein, w1)
+                      + b1[:, None, :], approximate=True)
+    out = jnp.einsum("ecf,efh->ech", hdn, w2) + b2[:, None, :]
+    want = jnp.einsum("tec,ech->th", combine, out)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)

@@ -186,13 +186,20 @@ class TestRound4bRuleTail:
     (phi/infermeta/spmd_rules/{amp_ops,expand_as,
     fused_linear_param_grad_add,optimizer}.cc)."""
 
-    def test_amp_ops_found_inf_replicated(self):
+    def test_amp_ops_found_inf_partial_over_sharded_axes(self):
+        """found_inf must be PARTIAL over every axis sharding a checked
+        tensor (forces the cross-rank any-reduction, amp_ops.cc) — a
+        'replicated' declaration would let per-rank isfinite verdicts
+        diverge and ranks disagree on skipping the optimizer step."""
         from paddle_tpu.parallel.spmd_rules import amp_ops_rule
         xs = [DA(["x", None]), DA([None, "y"])]
         reqs, outs, found = amp_ops_rule(xs)
         assert [r.dims_mapping for r in reqs] == [["x", None], [None, "y"]]
         assert [o.dims_mapping for o in outs] == [["x", None], [None, "y"]]
-        assert found.dims_mapping == [] and not found.partial
+        assert found.dims_mapping == [] and found.partial == {"x", "y"}
+        # fully-replicated inputs need no reduction
+        _, _, found2 = amp_ops_rule([DA([None, None])])
+        assert not found2.partial
 
     def test_expand_as_matches_expand(self):
         from paddle_tpu.parallel.spmd_rules import expand_as_rule

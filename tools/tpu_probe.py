@@ -177,7 +177,8 @@ def seize(tag=""):
     if not ok:
         _abort_rearm("headline")
         return
-    for cfg in ("lenet", "resnet50", "bert", "llama", "decode"):
+    for cfg in ("lenet", "resnet50", "bert", "llama", "decode",
+                "moe"):
         results[f"bench_{cfg}"], ok = _bench(
             [sys.executable, "bench.py", "--config", cfg],
             f"bench_tpu_{cfg}{suffix}.json", 1800)
@@ -215,7 +216,7 @@ def seize(tag=""):
                     f"pytest_tpu{suffix}.log"]
         produced += [f"bench_tpu_{c}{suffix}.json"
                      for c in ("lenet", "resnet50", "bert", "llama",
-                               "decode")]
+                               "decode", "moe")]
         produced += [f + ".stderr.log" for f in list(produced)]
         artifacts += [os.path.join("tools", f) for f in produced
                       if os.path.exists(os.path.join(tdir, f))]
