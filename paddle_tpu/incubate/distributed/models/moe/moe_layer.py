@@ -59,13 +59,18 @@ class MoELayer(nn.Layer):
     def __init__(self, d_model: int, d_hidden: int, num_experts: int,
                  gate="gshard", top_k: Optional[int] = None,
                  activation: Callable = jax.nn.gelu,
-                 ep_axis: Optional[str] = None):
+                 ep_axis: Optional[str] = None,
+                 aux_coef: float = 0.0):
         super().__init__()
         self.d_model = d_model
         self.d_hidden = d_hidden
         self.num_experts = num_experts
         self.activation = activation
         self.ep_axis = ep_axis
+        # aux_coef > 0: the GShard balance loss reaches gradients via
+        # inject_aux_grad (loss += aux_coef * aux per call) — in addition
+        # to being surfaced on gate._loss for reference-style collection
+        self.aux_coef = aux_coef
         if isinstance(gate, str):
             gate = _GATES[gate](d_model, num_experts,
                                 **({"top_k": top_k} if top_k else {}))
@@ -113,7 +118,11 @@ class MoELayer(nn.Layer):
         expert_out = self._constrain(expert_out)
         out = jnp.einsum("tec,ech->th", combine.astype(jnp.float32),
                          expert_out.astype(jnp.float32))
-        return out.reshape(shape).astype(dtype), aux
+        out = out.reshape(shape).astype(dtype)
+        if self.aux_coef:
+            from .....parallel.moe import inject_aux_grad
+            out = inject_aux_grad(out, aux, self.aux_coef)
+        return out, aux
 
     def forward(self, x):
         from .....core.rng import next_rng_key

@@ -120,7 +120,8 @@ class GPTBlock(Layer):
             # hybrid step (build_gpt_train_step + parallel/moe.py)
             from ..incubate.distributed.models.moe import MoELayer
             self.moe = MoELayer(h, cfg.ffn_size, cfg.moe_num_experts,
-                                gate="gshard", top_k=cfg.moe_top_k)
+                                gate="gshard", top_k=cfg.moe_top_k,
+                                aux_coef=cfg.moe_aux_coef)
         elif cfg.use_mp:
             self.fc1 = ColumnParallelLinear(h, cfg.ffn_size,
                                             gather_output=False)
@@ -562,15 +563,10 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
     def _moe_coef(x, ctx):
         if not cfg.moe_num_experts:
             return None
-        if S > 1 and schedule in ("1f1b", "zbh1", "interleave"):
-            # manual-vjp schedules divide the summed grads by
-            # norm = b_l*s_l*R afterwards; sites = L x M x R
-            return cfg.moe_aux_coef * x.shape[0] * ctx["s_l"] \
-                / cfg.num_layers
-        # value_and_grad paths (S==1, gpipe): the /norm inside loss_fn
-        # does not touch the injected constant
-        M = num_microbatches if S > 1 else 1
-        return cfg.moe_aux_coef / (cfg.num_layers * M * dp * shard * sep)
+        from ..parallel.moe import schedule_aux_coef
+        return schedule_aux_coef(
+            cfg.moe_aux_coef, cfg.num_layers, schedule, S,
+            num_microbatches, dp * shard * sep, x.shape[0] * ctx["s_l"])
 
     def block_fn(layer_params, x, ctx):
         return block_apply(layer_params, x, cfg, cp_attn, mp_axis=MP_AXIS,

@@ -37,7 +37,8 @@ from ..parallel.mp_layers import (ColumnParallelLinear, RowParallelLinear,
 from ..parallel.topology import (DP_AXIS, MP_AXIS, PP_AXIS, SEP_AXIS,
                                  SHARDING_AXIS, get_topology)
 
-__all__ = ["LlamaConfig", "LlamaAttention", "LlamaMLP", "LlamaBlock",
+__all__ = ["LlamaConfig", "LlamaAttention", "LlamaMLP", "LlamaMoEMLP",
+           "LlamaBlock",
            "LlamaModel", "LlamaForCausalLM", "llama_tiny", "llama_7b",
            "llama_13b", "llama_70b", "build_llama_train_step"]
 
@@ -57,6 +58,12 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     use_mp: bool = False
     dtype: str = "float32"
+    # Mixtral-style sparse MoE FFN (0 = dense): SwiGLU experts sharded
+    # over the dp axis in the compiled step (parallel/moe.py)
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 1e-2
 
     @property
     def head_dim(self) -> int:
@@ -210,6 +217,37 @@ class LlamaMLP(Layer):
         return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
 
 
+class LlamaMoEMLP(Layer):
+    """Eager Mixtral-style sparse FFN: SwiGLU expert bank + top-k router
+    (compiled-path parity lives in parallel/moe.py:moe_swiglu_ffn_ep;
+    expert parallelism belongs to build_llama_train_step)."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        E, h, f = cfg.moe_num_experts, cfg.hidden_size, cfg.intermediate_size
+        self.router_w = self.create_parameter((h, E))
+        self.e_gate = self.create_parameter((E, h, f))
+        self.e_up = self.create_parameter((E, h, f))
+        self.e_down = self.create_parameter((E, f, h))
+
+    def forward(self, x):
+        from ..core.dispatch import run_op
+        from ..parallel.moe import moe_swiglu_ffn_ep
+        cfg = self.cfg
+
+        def impl(x_, rw, wg, wu, wd):
+            # eager semantics: loss += moe_aux_coef * aux per layer
+            return moe_swiglu_ffn_ep(
+                x_, rw, wg, wu, wd, top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                aux_coef=cfg.moe_aux_coef)
+
+        return run_op("llama_moe_mlp", impl,
+                      (x, self.router_w, self.e_gate, self.e_up,
+                       self.e_down), {})
+
+
 class LlamaBlock(Layer):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
@@ -218,7 +256,8 @@ class LlamaBlock(Layer):
         self.post_attention_layernorm = RMSNorm(cfg.hidden_size,
                                                 epsilon=cfg.rms_norm_eps)
         self.self_attn = LlamaAttention(cfg)
-        self.mlp = LlamaMLP(cfg)
+        self.mlp = LlamaMoEMLP(cfg) if cfg.moe_num_experts \
+            else LlamaMLP(cfg)
 
     def forward(self, x, cos, sin):
         x = x + self.self_attn(self.input_layernorm(x), cos, sin)
@@ -288,16 +327,29 @@ def init_block_params(cfg: LlamaConfig, key) -> Dict[str, jax.Array]:
     ks = jax.random.split(key, 7)
     dt = jnp.dtype(cfg.dtype)
     kvd = cfg.kv_heads * d
-    return {
+    out = {
         "ln1_w": jnp.ones((h,), dt), "ln2_w": jnp.ones((h,), dt),
         "q_w": jax.random.normal(ks[0], (h, cfg.num_heads * d), dt) * std,
         "k_w": jax.random.normal(ks[1], (h, kvd), dt) * std,
         "v_w": jax.random.normal(ks[2], (h, kvd), dt) * std,
         "o_w": jax.random.normal(ks[3], (cfg.num_heads * d, h), dt) * std,
-        "gate_w": jax.random.normal(ks[4], (h, f), dt) * std,
-        "up_w": jax.random.normal(ks[5], (h, f), dt) * std,
-        "down_w": jax.random.normal(ks[6], (f, h), dt) * std,
     }
+    if cfg.moe_num_experts:
+        E = cfg.moe_num_experts
+        out.update({
+            "router_w": jax.random.normal(jax.random.fold_in(key, 7),
+                                          (h, E), dt) * std,
+            "e_gate": jax.random.normal(ks[4], (E, h, f), dt) * std,
+            "e_up": jax.random.normal(ks[5], (E, h, f), dt) * std,
+            "e_down": jax.random.normal(ks[6], (E, f, h), dt) * std,
+        })
+    else:
+        out.update({
+            "gate_w": jax.random.normal(ks[4], (h, f), dt) * std,
+            "up_w": jax.random.normal(ks[5], (h, f), dt) * std,
+            "down_w": jax.random.normal(ks[6], (f, h), dt) * std,
+        })
+    return out
 
 
 def block_param_specs(cfg: LlamaConfig, pipeline: bool) -> Dict[str, P]:
@@ -305,9 +357,19 @@ def block_param_specs(cfg: LlamaConfig, pipeline: bool) -> Dict[str, P]:
         "ln1_w": P(), "ln2_w": P(),
         "q_w": P(None, MP_AXIS), "k_w": P(None, MP_AXIS),
         "v_w": P(None, MP_AXIS), "o_w": P(MP_AXIS, None),
-        "gate_w": P(None, MP_AXIS), "up_w": P(None, MP_AXIS),
-        "down_w": P(MP_AXIS, None),
     }
+    if cfg.moe_num_experts:
+        base.update({
+            "router_w": P(),
+            "e_gate": P(DP_AXIS, None, MP_AXIS),
+            "e_up": P(DP_AXIS, None, MP_AXIS),
+            "e_down": P(DP_AXIS, MP_AXIS, None),
+        })
+    else:
+        base.update({
+            "gate_w": P(None, MP_AXIS), "up_w": P(None, MP_AXIS),
+            "down_w": P(MP_AXIS, None),
+        })
     if not pipeline:
         return base
     return {k: P(PP_AXIS, None, *list(v)) for k, v in base.items()}
@@ -317,7 +379,9 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
                 cfg: LlamaConfig, cos, sin, attn_fn=None,
                 mp_axis: Optional[str] = None,
                 sequence_parallel: bool = False,
-                tp_overlap: bool = False) -> jax.Array:
+                tp_overlap: bool = False,
+                ep_axis: Optional[str] = None,
+                moe_aux_coef: Optional[float] = None) -> jax.Array:
     """One Llama block, pure jnp (stacked under lax.scan).
 
     ``mp_axis``: Megatron-style manual tensor parallelism — params are the
@@ -372,8 +436,24 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
     attn = attn.reshape(b, s, attn.shape[2] * attn.shape[3])
     x = res + row_mm(attn, params["o_w"])
     res = x
-    g, u = col_mm(rms(x, params["ln2_w"]),
-                  params["gate_w"], params["up_w"])
+    y_in = rms(x, params["ln2_w"])
+    if cfg.moe_num_experts:
+        from ..parallel.moe import moe_swiglu_ffn_ep
+        if mp_axis is not None and sequence_parallel:
+            from ..parallel.sequence_parallel import (all_gather_op,
+                                                      scatter_op)
+            y_in = all_gather_op(y_in, mp_axis)
+        out = moe_swiglu_ffn_ep(
+            y_in, params["router_w"], params["e_gate"], params["e_up"],
+            params["e_down"], top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor, ep_axis=ep_axis,
+            mp_axis=mp_axis, sequence_parallel=sequence_parallel,
+            aux_coef=(cfg.moe_aux_coef if moe_aux_coef is None
+                      else moe_aux_coef))
+        if mp_axis is not None and sequence_parallel:
+            out = scatter_op(out, mp_axis)
+        return res + out
+    g, u = col_mm(y_in, params["gate_w"], params["up_w"])
     y = jax.nn.silu(g) * u
     return res + row_mm(y, params["down_w"])
 
@@ -416,9 +496,15 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
     S = topo.get_pipe_parallel_world_size()
     mp = topo.get_model_parallel_world_size()
     sep = topo.get_sep_parallel_world_size()
+    dp = topo.axis_size(DP_AXIS)
+    shard = topo.axis_size(SHARDING_AXIS)
     if cfg.num_layers % S != 0:
         raise ValueError(
             f"num_layers {cfg.num_layers} not divisible by pp degree {S}")
+    if cfg.moe_num_experts and cfg.moe_num_experts % dp != 0:
+        raise ValueError(
+            f"moe_num_experts={cfg.moe_num_experts} not divisible by the "
+            f"expert-parallel (dp) degree {dp}")
     if mp > 1:
         for name, val in (("vocab_size", cfg.vocab_size),
                           ("num_heads", cfg.num_heads),
@@ -515,11 +601,23 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
         lsin = jax.lax.dynamic_slice_in_dim(sin, sidx * s_l, s_l, 0)
         return lcos, lsin
 
+    def _moe_coef(x, lcos):
+        # lcos rows == the local seq length s_l
+        if not cfg.moe_num_experts:
+            return None
+        from ..parallel.moe import schedule_aux_coef
+        return schedule_aux_coef(
+            cfg.moe_aux_coef, cfg.num_layers, schedule, S,
+            num_microbatches, dp * shard * sep,
+            x.shape[0] * lcos.shape[0])
+
     def block_fn(layer_params, x, ctx):
         lcos, lsin = ctx
         return block_apply(layer_params, x, cfg, lcos, lsin, cp_attn,
                            mp_axis=MP_AXIS, sequence_parallel=sp,
-                           tp_overlap=tp_overlap)
+                           tp_overlap=tp_overlap,
+                           ep_axis=DP_AXIS if cfg.moe_num_experts else None,
+                           moe_aux_coef=_moe_coef(x, lcos))
 
     def head_nll_fn(params, x, labels):
         if sp:

@@ -42,7 +42,8 @@ from ..incubate.distributed.models.moe.gating import (compute_capacity,
 from .manual import fwd_psum, mp_copy
 
 __all__ = ["inject_aux_grad", "topk_scatter_routing", "moe_ffn_ep",
-           "compute_capacity"]
+           "moe_swiglu_ffn_ep", "moe_dispatch_combine", "compute_capacity",
+           "schedule_aux_coef"]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -70,6 +71,35 @@ def _inject_bwd(coef, _, g):
 
 
 inject_aux_grad.defvjp(_inject_fwd, _inject_bwd)
+
+
+def schedule_aux_coef(coef: float, num_layers: int, schedule: str,
+                      pp_degree: int, num_microbatches: int,
+                      data_replicas: int, mb_tokens: int
+                      ) -> Optional[float]:
+    """Per-site injection coefficient so every schedule path realizes the
+    same effective term ``loss += coef * mean_over_sites(aux)`` (sites =
+    layers x microbatches x data ranks).
+
+    Single source of the contract with build_hybrid_train_step's grad
+    normalization (shared by the gpt/llama builders — do not fork):
+    the manual-vjp pipeline schedules (1f1b/zbh1/interleave) divide the
+    summed vjp by ``norm = b_l*s_l*R`` AFTER the fact, which also scales
+    the injected constant, while the value_and_grad paths (pp==1, gpipe)
+    divide the loss inside loss_fn, which the injected constant bypasses.
+
+    Args:
+      data_replicas: dp * sharding * sep (each rank's aux is a distinct
+        site whose grads later sum across these axes).
+      mb_tokens: per-microbatch local tokens b_mb * s_l (only used by the
+        manual-vjp branch; pass 0 otherwise).
+    """
+    if not coef:
+        return None
+    if pp_degree > 1 and schedule in ("1f1b", "zbh1", "interleave"):
+        return coef * mb_tokens / num_layers
+    M = num_microbatches if pp_degree > 1 else 1
+    return coef / (num_layers * M * data_replicas)
 
 
 def topk_scatter_routing(logits: jax.Array, top_k: int, capacity: int,
@@ -111,32 +141,28 @@ def topk_scatter_routing(logits: jax.Array, top_k: int, capacity: int,
     return idx, pos, w, aux
 
 
-def moe_ffn_ep(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
-               b1: jax.Array, w2: jax.Array, b2: jax.Array, *,
-               top_k: int = 2, capacity_factor: float = 1.25,
-               ep_axis: Optional[str] = None,
-               mp_axis: Optional[str] = None,
-               sequence_parallel: bool = False,
-               aux_coef: float = 0.0,
-               activation: Callable = functools.partial(jax.nn.gelu,
-                                                        approximate=True),
-               normalize: bool = True) -> jax.Array:
-    """Mixture-of-experts FFN, expert-parallel over ``ep_axis``.
+def moe_dispatch_combine(x: jax.Array, gate_w: jax.Array,
+                         expert_apply: Callable, n_experts_local: int, *,
+                         top_k: int = 2, capacity_factor: float = 1.25,
+                         ep_axis: Optional[str] = None,
+                         aux_coef: float = 0.0,
+                         normalize: bool = True) -> jax.Array:
+    """Shared routing + EP transport around any expert function.
+
+    Routes device-local tokens into fixed-capacity per-expert buffers,
+    moves them to the owning expert rank with one ``lax.all_to_all``
+    (global_scatter parity, reference moe_utils.py), applies
+    ``expert_apply(buf [E_local, slots, h]) -> [E_local, slots, h]``
+    (which embeds its own mp collectives), brings the slots home with the
+    inverse all_to_all, and combines with the routing weights.
 
     Args:
       x: [..., h] device-local tokens (the FULL gathered sequence when
          the caller runs Megatron sequence parallelism).
       gate_w: [h, E] router weights (math in fp32).
-      w1/b1/w2/b2: LOCAL expert shards — [E/ep, h, f/mp], [E/ep, f/mp],
-         [E/ep, f/mp, h], [E/ep, h].  With no mesh axes these are the
-         full [E, ...] banks and the function is a plain jit MoE FFN.
+      n_experts_local: experts held by THIS rank (E/ep).
       ep_axis: mesh axis the expert dim is sharded over (the hybrid step
          passes ``dp``); None = experts all local.
-      mp_axis: Megatron TP axis inside each expert (column w1 / row w2).
-      sequence_parallel: caller gathered the sequence over ``mp_axis``;
-         the mp-input reduction then lives in the caller's all_gather
-         transpose, so no mp_copy here, and the caller reduce-scatters
-         after (the fwd psum here keeps outputs replicated over mp).
       aux_coef: weight on the GShard balance loss, injected via
          :func:`inject_aux_grad` (0 = off).
     """
@@ -145,11 +171,10 @@ def moe_ffn_ep(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
     tokens = x.reshape(-1, h)
     T = tokens.shape[0]
     ep = 1 if ep_axis is None else lax.axis_size(ep_axis)
-    E_local = w1.shape[0]
-    E = E_local * ep
+    E = n_experts_local * ep
     if gate_w.shape[1] != E:
         raise ValueError(f"gate_w experts {gate_w.shape[1]} != "
-                         f"{E_local}x{ep} sharded expert bank")
+                         f"{n_experts_local}x{ep} sharded expert bank")
     C = compute_capacity(T, E, top_k, capacity_factor)
 
     logits = tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32)
@@ -163,18 +188,9 @@ def moe_ffn_ep(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
 
     if ep_axis is not None:
         # [E, C, h] -> [E/ep, ep*C, h]: every rank's slots for MY experts
-        # (global_scatter parity, reference moe_utils.py global routing)
         buf = lax.all_to_all(buf, ep_axis, split_axis=0, concat_axis=1,
                              tiled=True)
-    y = buf
-    if mp_axis is not None and not sequence_parallel:
-        y = mp_copy(y, mp_axis)           # identity fwd / psum bwd (col in)
-    hdn = jnp.einsum("gch,ghf->gcf", y, w1) + b1[:, None, :]
-    hdn = activation(hdn)
-    out = jnp.einsum("gcf,gfh->gch", hdn, w2)
-    if mp_axis is not None:
-        out = fwd_psum(out, mp_axis)      # row out: sum the f/mp partials
-    out = out + b2[:, None, :]
+    out = expert_apply(buf)
     if ep_axis is not None:
         # inverse all_to_all: my slots come home from every expert rank
         out = lax.all_to_all(out, ep_axis, split_axis=1, concat_axis=0,
@@ -187,3 +203,76 @@ def moe_ffn_ep(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
     if aux_coef:
         res = inject_aux_grad(res, aux, aux_coef)
     return res
+
+
+def moe_ffn_ep(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
+               b1: jax.Array, w2: jax.Array, b2: jax.Array, *,
+               top_k: int = 2, capacity_factor: float = 1.25,
+               ep_axis: Optional[str] = None,
+               mp_axis: Optional[str] = None,
+               sequence_parallel: bool = False,
+               aux_coef: float = 0.0,
+               activation: Callable = functools.partial(jax.nn.gelu,
+                                                        approximate=True),
+               normalize: bool = True) -> jax.Array:
+    """GELU-MLP mixture of experts (the GPT block's FFN), expert-parallel
+    over ``ep_axis``.
+
+    w1/b1/w2/b2: LOCAL expert shards — [E/ep, h, f/mp], [E/ep, f/mp],
+    [E/ep, f/mp, h], [E/ep, h].  With no mesh axes these are the full
+    [E, ...] banks and the function is a plain jit MoE FFN.
+
+    mp_axis: Megatron TP inside each expert — w1 column-split (mp_copy
+    on the input: identity fwd / psum bwd), w2 row-split (fwd_psum on
+    the output).  Under ``sequence_parallel`` the caller gathered the
+    sequence over mp, so the input reduction lives in that all_gather's
+    transpose and no mp_copy is inserted; the caller scatters after
+    (outputs here are replicated over mp post-psum, biases included —
+    hence full, not mp-partial, bias grads)."""
+    def expert_apply(buf):
+        y = buf
+        if mp_axis is not None and not sequence_parallel:
+            y = mp_copy(y, mp_axis)       # identity fwd / psum bwd (col in)
+        hdn = jnp.einsum("gch,ghf->gcf", y, w1) + b1[:, None, :]
+        hdn = activation(hdn)
+        out = jnp.einsum("gcf,gfh->gch", hdn, w2)
+        if mp_axis is not None:
+            out = fwd_psum(out, mp_axis)  # row out: sum the f/mp partials
+        return out + b2[:, None, :]
+
+    return moe_dispatch_combine(
+        x, gate_w, expert_apply, w1.shape[0], top_k=top_k,
+        capacity_factor=capacity_factor, ep_axis=ep_axis,
+        aux_coef=aux_coef, normalize=normalize)
+
+
+def moe_swiglu_ffn_ep(x: jax.Array, router_w: jax.Array, wg: jax.Array,
+                      wu: jax.Array, wd: jax.Array, *,
+                      top_k: int = 2, capacity_factor: float = 1.25,
+                      ep_axis: Optional[str] = None,
+                      mp_axis: Optional[str] = None,
+                      sequence_parallel: bool = False,
+                      aux_coef: float = 0.0,
+                      normalize: bool = True) -> jax.Array:
+    """SwiGLU mixture of experts (Mixtral-style Llama FFN): per-expert
+    gate/up column-split + down row-split over ``mp_axis``, biasless.
+
+    wg/wu: [E/ep, h, f/mp]; wd: [E/ep, f/mp, h].  Routing normalization
+    follows the GShard convention (renormalize kept top-k weights) —
+    numerically equivalent to Mixtral's softmax-over-top-k when no token
+    overflows capacity."""
+    def expert_apply(buf):
+        y = buf
+        if mp_axis is not None and not sequence_parallel:
+            y = mp_copy(y, mp_axis)
+        g = jnp.einsum("gch,ghf->gcf", y, wg)
+        u = jnp.einsum("gch,ghf->gcf", y, wu)
+        out = jnp.einsum("gcf,gfh->gch", jax.nn.silu(g) * u, wd)
+        if mp_axis is not None:
+            out = fwd_psum(out, mp_axis)
+        return out
+
+    return moe_dispatch_combine(
+        x, router_w, expert_apply, wg.shape[0], top_k=top_k,
+        capacity_factor=capacity_factor, ep_axis=ep_axis,
+        aux_coef=aux_coef, normalize=normalize)
