@@ -425,9 +425,13 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
             out_shardings=tree_map_with_spec(
                 lambda _s, sp: sh(sp), mom_shapes, mom_specs))
         m0, v0 = zinit(), zinit()
+        # t is committed to the mesh (replicated) like every other leaf:
+        # a checkpoint load preserves leaf shardings, and a state whose
+        # leaves mix mesh-committed and single-device-committed arrays is
+        # rejected by jit
+        t0 = jax.device_put(_jnp.zeros((), _jnp.int32), sh(P()))
         return {"params": params,
-                "opt": {"m": m0, "v": v0,
-                        "t": _jnp.zeros((), _jnp.int32)}}
+                "opt": {"m": m0, "v": v0, "t": t0}}
 
     def local_step(params, m, v, t, ids, labels):
         b_l, s_l = ids.shape
