@@ -17,7 +17,7 @@ import numpy as np
 
 
 def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
-        flash=None, autotune=False, remat_policy=None):
+        flash=None, autotune=False, remat_policy=None, experts=0):
     import jax
     from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
     from paddle_tpu import parallel as dist
@@ -28,7 +28,7 @@ def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
     FLAGS.use_autotune = bool(autotune)
     cfg = GPTConfig(vocab_size=V, hidden_size=h, num_layers=L,
                     num_heads=h // 64, max_position_embeddings=seq,
-                    dtype="bfloat16")
+                    dtype="bfloat16", moe_num_experts=experts)
     topo = dist.init_topology(devices=jax.devices()[:1])
     step_fn, init_fn = build_gpt_train_step(cfg, topo, num_microbatches=mbs,
                                             remat=remat, use_flash=flash,
@@ -48,7 +48,11 @@ def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
     dt = time.perf_counter() - t0
     tps = batch * seq * steps / dt
     f = 4 * h
-    n_params = V * h + seq * h + L * (4 * h * h + 2 * h * f + 9 * h) + 2 * h
+    # ACTIVE params per token (MFU basis): MoE replaces the dense FFN's
+    # 2hf with top_k expert FFNs + the router, regardless of total E
+    ffn_p = (cfg.moe_top_k * 2 * h * f + h * experts) if experts \
+        else 2 * h * f
+    n_params = V * h + seq * h + L * (4 * h * h + ffn_p + 9 * h) + 2 * h
     fpt = 6 * n_params + 12 * L * h * seq      # MODEL flops (MFU basis,
     # same definition as bench.py / the BASELINE 45% target)
     from bench import peak_flops_per_chip
@@ -60,6 +64,8 @@ def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
         "tokens_per_sec": round(tps, 1), "mfu": round(mfu, 4),
         "loss": round(lv, 4), "device": str(jax.devices()[0]),
     }
+    if experts:
+        row["experts"] = experts
     if remat:
         # hardware FLOP utilization incl. the recompute forward —
         # reported SEPARATELY so mfu stays comparable across rows
@@ -92,6 +98,9 @@ DEFAULT_MATRIX = [
          h=2048, L=12, V=51200, remat_policy="dots"),
     dict(batch=8, seq=1024, steps=10, remat=True, flash=None,
          remat_policy="dots"),
+    # GPT-MoE (E8 top-2, single chip): scatter routing + batched expert
+    # einsums; MFU basis = ACTIVE params (top-k experts + router)
+    dict(batch=8, seq=1024, steps=10, remat=False, flash=None, experts=8),
 ]
 
 

@@ -199,6 +199,25 @@ def build_llama_decoder(cfg, max_len: int,
     H, Hkv, D, L = (cfg.num_heads, cfg.kv_heads, cfg.head_dim,
                     cfg.num_layers)
     eps = cfg.rms_norm_eps
+    moe = getattr(cfg, "moe_num_experts", 0)
+    if moe and quant is not None:
+        raise NotImplementedError(
+            "weight-only quantization is not supported with "
+            "moe_num_experts > 0 (expert banks are not wired into "
+            "quantize_llama_params)")
+
+    def ffn(lp, y):
+        """Post-ln2 FFN: dense SwiGLU or Mixtral MoE.  Inference passes
+        capacity = token count so no token is EVER dropped (capacity
+        truncation is a training regularizer, not a decode behavior)."""
+        if moe:
+            from ..parallel.moe import moe_swiglu_ffn_ep
+            t = math.prod(y.shape[:-1])
+            return moe_swiglu_ffn_ep(
+                y, lp["router_w"], lp["e_gate"], lp["e_up"], lp["e_down"],
+                top_k=cfg.moe_top_k, capacity=t)
+        return mm(lp, "down_w", jax.nn.silu(mm(lp, "gate_w", y))
+                  * mm(lp, "up_w", y))
 
     if quant is None:
         def mm(lp, name, y):
@@ -246,9 +265,7 @@ def build_llama_decoder(cfg, max_len: int,
             p = jax.nn.softmax(logits, -1).astype(x.dtype)
             attn = jnp.einsum("bhqk,bkhd->bqhd", p, vr).reshape(B, T0, -1)
             x = x + mm(lp, "o_w", attn)
-            y = rms(x, lp["ln2_w"])
-            y = jax.nn.silu(mm(lp, "gate_w", y)) * mm(lp, "up_w", y)
-            x = x + mm(lp, "down_w", y)
+            x = x + ffn(lp, rms(x, lp["ln2_w"]))
             return x, (k, v)
 
         x, (ks, vs) = jax.lax.scan(body, x, blocks)
@@ -280,9 +297,7 @@ def build_llama_decoder(cfg, max_len: int,
             attn = decode_attention(q[:, 0], k_l, v_l, lengths,
                                     use_pallas=use_pallas)
             x = x + mm(lp, "o_w", attn.reshape(B, -1))
-            y = rms(x, lp["ln2_w"])
-            y = jax.nn.silu(mm(lp, "gate_w", y)) * mm(lp, "up_w", y)
-            x = x + mm(lp, "down_w", y)
+            x = x + ffn(lp, rms(x, lp["ln2_w"]))
             return x, (k_l, v_l)
 
         xin = x  # [B, h]

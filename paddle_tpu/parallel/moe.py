@@ -146,7 +146,8 @@ def moe_dispatch_combine(x: jax.Array, gate_w: jax.Array,
                          top_k: int = 2, capacity_factor: float = 1.25,
                          ep_axis: Optional[str] = None,
                          aux_coef: float = 0.0,
-                         normalize: bool = True) -> jax.Array:
+                         normalize: bool = True,
+                         capacity: Optional[int] = None) -> jax.Array:
     """Shared routing + EP transport around any expert function.
 
     Routes device-local tokens into fixed-capacity per-expert buffers,
@@ -165,6 +166,10 @@ def moe_dispatch_combine(x: jax.Array, gate_w: jax.Array,
          passes ``dp``); None = experts all local.
       aux_coef: weight on the GShard balance loss, injected via
          :func:`inject_aux_grad` (0 = off).
+      capacity: explicit per-expert slot count overriding the GShard
+         formula — inference paths pass the token count so NO token is
+         ever dropped (capacity truncation is a training regularizer;
+         at decode time a drop silently corrupts the output).
     """
     shape = x.shape
     h = shape[-1]
@@ -175,7 +180,8 @@ def moe_dispatch_combine(x: jax.Array, gate_w: jax.Array,
     if gate_w.shape[1] != E:
         raise ValueError(f"gate_w experts {gate_w.shape[1]} != "
                          f"{n_experts_local}x{ep} sharded expert bank")
-    C = compute_capacity(T, E, top_k, capacity_factor)
+    C = capacity if capacity is not None \
+        else compute_capacity(T, E, top_k, capacity_factor)
 
     logits = tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32)
     idx, pos, w, aux = topk_scatter_routing(logits, top_k, C, normalize)
@@ -253,7 +259,8 @@ def moe_swiglu_ffn_ep(x: jax.Array, router_w: jax.Array, wg: jax.Array,
                       mp_axis: Optional[str] = None,
                       sequence_parallel: bool = False,
                       aux_coef: float = 0.0,
-                      normalize: bool = True) -> jax.Array:
+                      normalize: bool = True,
+                      capacity: Optional[int] = None) -> jax.Array:
     """SwiGLU mixture of experts (Mixtral-style Llama FFN): per-expert
     gate/up column-split + down row-split over ``mp_axis``, biasless.
 
@@ -275,4 +282,4 @@ def moe_swiglu_ffn_ep(x: jax.Array, router_w: jax.Array, wg: jax.Array,
     return moe_dispatch_combine(
         x, router_w, expert_apply, wg.shape[0], top_k=top_k,
         capacity_factor=capacity_factor, ep_axis=ep_axis,
-        aux_coef=aux_coef, normalize=normalize)
+        aux_coef=aux_coef, normalize=normalize, capacity=capacity)

@@ -101,6 +101,43 @@ def test_llama_greedy_generate_matches_no_cache():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
 
 
+def test_llama_moe_greedy_generate_matches_no_cache():
+    """Mixtral-style MoE decode: the KV-cache prefill+step loop must
+    reproduce repeated full-forward greedy decoding exactly (capacity is
+    overridden to the token count at inference, so routing never drops —
+    a drop would break this equality)."""
+    from paddle_tpu.models.llama import llama_tiny, build_llama_train_step
+    from paddle_tpu import parallel as dist
+    from paddle_tpu.parallel.topology import HybridTopology, set_topology
+    cfg = llama_tiny(moe_num_experts=4)
+    topo = dist.init_topology()
+    _, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1)
+    params = init_fn(0)["params"]
+    set_topology(HybridTopology())
+
+    ids = rng.integers(0, cfg.vocab_size, (2, 5)).astype(np.int32)
+    out = llama_generate(params, cfg, ids, max_new_tokens=5,
+                         temperature=0.0, use_pallas=False)
+    assert out.shape == (2, 10)
+
+    cur = jnp.asarray(ids)
+    for t in range(5):
+        prefill, _ = build_llama_decoder(cfg, cur.shape[1],
+                                         use_pallas=False)
+        _, logits = prefill(params, cur)
+        nxt = jnp.argmax(logits, -1).astype(cur.dtype)
+        cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+
+def test_llama_moe_quant_decode_guard():
+    from paddle_tpu.models.generation import build_llama_decoder
+    from paddle_tpu.models.llama import llama_tiny
+    with pytest.raises(NotImplementedError):
+        build_llama_decoder(llama_tiny(moe_num_experts=4), 16,
+                            quant="weight_only_int8")
+
+
 def test_decode_attention_pallas_matches_ref():
     from paddle_tpu.core.flags import FLAGS, set_flags
     from paddle_tpu.ops.pallas.decode_attention import (
