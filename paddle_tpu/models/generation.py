@@ -77,11 +77,26 @@ def build_gpt_decoder(cfg, max_len: int, use_pallas: Optional[bool] = None):
     """
     H, D, L = cfg.num_heads, cfg.head_dim, cfg.num_layers
     eps = cfg.layer_norm_eps
+    moe = getattr(cfg, "moe_num_experts", 0)
+    if moe and getattr(cfg, "moe_router", "topk") != "topk":
+        raise NotImplementedError(
+            "decode serves token-choice routing only (expert choice "
+            "competes across the batch — non-causal at decode)")
 
     def ln(x, w, b):
         mu = jnp.mean(x, -1, keepdims=True)
         var = jnp.var(x, -1, keepdims=True)
         return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+    def ffn(lp, y):
+        """Dense GELU MLP or the dropless grouped-GEMM MoE bank."""
+        if moe:
+            from ..parallel.moe import moe_gelu_ffn_grouped
+            return moe_gelu_ffn_grouped(
+                y, lp["gate_w"], lp["e_w1"], lp["e_b1"], lp["e_w2"],
+                lp["e_b2"], top_k=cfg.moe_top_k)
+        return jax.nn.gelu(y @ lp["fc1_w"] + lp["fc1_b"],
+                           approximate=True) @ lp["fc2_w"] + lp["fc2_b"]
 
     def final_logits(params, x):
         x = ln(x, params["lnf_w"], params["lnf_b"])
@@ -109,9 +124,7 @@ def build_gpt_decoder(cfg, max_len: int, use_pallas: Optional[bool] = None):
             p = jax.nn.softmax(logits, -1).astype(x.dtype)
             attn = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T0, -1)
             x = x + attn @ lp["proj_w"] + lp["proj_b"]
-            y = ln(x, lp["ln2_w"], lp["ln2_b"])
-            y = jax.nn.gelu(y @ lp["fc1_w"] + lp["fc1_b"], approximate=True)
-            x = x + y @ lp["fc2_w"] + lp["fc2_b"]
+            x = x + ffn(lp, ln(x, lp["ln2_w"], lp["ln2_b"]))
             return x, (k, v)
 
         x, (ks, vs) = jax.lax.scan(body, x, blocks)
@@ -146,9 +159,7 @@ def build_gpt_decoder(cfg, max_len: int, use_pallas: Optional[bool] = None):
             attn = decode_attention(q, k_l, v_l, lengths,
                                     use_pallas=use_pallas)
             x = x + attn.reshape(B, -1) @ lp["proj_w"] + lp["proj_b"]
-            y = ln(x, lp["ln2_w"], lp["ln2_b"])
-            y = jax.nn.gelu(y @ lp["fc1_w"] + lp["fc1_b"], approximate=True)
-            x = x + y @ lp["fc2_w"] + lp["fc2_b"]
+            x = x + ffn(lp, ln(x, lp["ln2_w"], lp["ln2_b"]))
             return x, (k_l, v_l)
 
         x, (ks, vs) = jax.lax.scan(body, x, (blocks, cache["k"], cache["v"]))
@@ -205,17 +216,25 @@ def build_llama_decoder(cfg, max_len: int,
             "weight-only quantization is not supported with "
             "moe_num_experts > 0 (expert banks are not wired into "
             "quantize_llama_params)")
+    if moe and getattr(cfg, "moe_router", "topk") != "topk":
+        raise NotImplementedError(
+            "decode serves token-choice routing only; a model trained "
+            "with moe_router='expert_choice' would be silently served a "
+            "different forward (expert choice competes across the batch, "
+            "which is non-causal at decode)")
 
     def ffn(lp, y):
-        """Post-ln2 FFN: dense SwiGLU or Mixtral MoE.  Inference passes
-        capacity = token count so no token is EVER dropped (capacity
-        truncation is a training regularizer, not a decode behavior)."""
+        """Post-ln2 FFN: dense SwiGLU or Mixtral MoE.  The MoE branch is
+        the DROPLESS grouped-GEMM serving path (sorted assignments +
+        lax.ragged_dot, Mosaic grouped-matmul on TPU): top_k*T slot cost
+        instead of E*C dispatch buffers, and no token is ever dropped
+        (capacity truncation is a training regularizer, not a decode
+        behavior)."""
         if moe:
-            from ..parallel.moe import moe_swiglu_ffn_ep
-            t = math.prod(y.shape[:-1])
-            return moe_swiglu_ffn_ep(
+            from ..parallel.moe import moe_swiglu_ffn_grouped
+            return moe_swiglu_ffn_grouped(
                 y, lp["router_w"], lp["e_gate"], lp["e_up"], lp["e_down"],
-                top_k=cfg.moe_top_k, capacity=t)
+                top_k=cfg.moe_top_k)
         return mm(lp, "down_w", jax.nn.silu(mm(lp, "gate_w", y))
                   * mm(lp, "up_w", y))
 

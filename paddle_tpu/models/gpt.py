@@ -67,6 +67,10 @@ class GPTConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 1e-2
+    # "topk" (GShard-style token choice) or "expert_choice" (experts pick
+    # their top-C tokens — perfectly balanced, no aux loss; best for
+    # encoder-style training, routing is batch-global so NOT causal)
+    moe_router: str = "topk"
 
     @property
     def ffn_size(self) -> int:
@@ -118,6 +122,13 @@ class GPTBlock(Layer):
             # eager MoE path: the incubate MoELayer (GShard gate, dense
             # capacity dispatch); expert TP/EP belong to the compiled
             # hybrid step (build_gpt_train_step + parallel/moe.py)
+            if cfg.moe_router != "topk":
+                # the incubate MoELayer serves GShard/Switch token-choice
+                # gates only; failing loudly beats silently training a
+                # different router than the compiled step would
+                raise NotImplementedError(
+                    "eager GPTBlock supports moe_router='topk' only; "
+                    "expert_choice lives in the compiled hybrid step")
             from ..incubate.distributed.models.moe import MoELayer
             self.moe = MoELayer(h, cfg.ffn_size, cfg.moe_num_experts,
                                 gate="gshard", top_k=cfg.moe_top_k,
@@ -382,7 +393,8 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
             capacity_factor=cfg.moe_capacity_factor, ep_axis=ep_axis,
             mp_axis=mp_axis, sequence_parallel=sequence_parallel,
             aux_coef=(cfg.moe_aux_coef if moe_aux_coef is None
-                      else moe_aux_coef))
+                      else moe_aux_coef),
+            router=cfg.moe_router)
         if mp_axis is not None and sequence_parallel:
             out = scatter_op(out, mp_axis)
         return res + out

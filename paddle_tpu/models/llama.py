@@ -64,6 +64,7 @@ class LlamaConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 1e-2
+    moe_router: str = "topk"   # "topk" | "expert_choice" (see gpt.py)
 
     @property
     def head_dim(self) -> int:
@@ -238,10 +239,11 @@ class LlamaMoEMLP(Layer):
 
         def impl(x_, rw, wg, wu, wd):
             # eager semantics: loss += moe_aux_coef * aux per layer
+            # (aux does not apply under the expert_choice router)
             return moe_swiglu_ffn_ep(
                 x_, rw, wg, wu, wd, top_k=cfg.moe_top_k,
                 capacity_factor=cfg.moe_capacity_factor,
-                aux_coef=cfg.moe_aux_coef)
+                aux_coef=cfg.moe_aux_coef, router=cfg.moe_router)
 
         return run_op("llama_moe_mlp", impl,
                       (x, self.router_w, self.e_gate, self.e_up,
@@ -449,7 +451,8 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
             capacity_factor=cfg.moe_capacity_factor, ep_axis=ep_axis,
             mp_axis=mp_axis, sequence_parallel=sequence_parallel,
             aux_coef=(cfg.moe_aux_coef if moe_aux_coef is None
-                      else moe_aux_coef))
+                      else moe_aux_coef),
+            router=cfg.moe_router)
         if mp_axis is not None and sequence_parallel:
             out = scatter_op(out, mp_axis)
         return res + out

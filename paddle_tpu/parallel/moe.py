@@ -43,7 +43,9 @@ from .manual import fwd_psum, mp_copy
 
 __all__ = ["inject_aux_grad", "topk_scatter_routing", "moe_ffn_ep",
            "moe_swiglu_ffn_ep", "moe_dispatch_combine", "compute_capacity",
-           "schedule_aux_coef"]
+           "schedule_aux_coef", "expert_choice_routing",
+           "moe_expert_choice_ffn", "moe_swiglu_ffn_grouped",
+           "moe_gelu_ffn_grouped"]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -141,6 +143,151 @@ def topk_scatter_routing(logits: jax.Array, top_k: int, capacity: int,
     return idx, pos, w, aux
 
 
+def expert_choice_routing(logits: jax.Array, capacity: int
+                          ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Expert-choice routing (Zhou et al. 2022): EXPERTS pick their top-C
+    tokens instead of tokens picking experts — perfect load balance by
+    construction (every expert processes exactly C tokens), no aux loss
+    and no dropped-capacity heuristics.  Complements the GShard/Switch
+    token-choice gates the reference ships (gshard_gate.py/switch_gate.py).
+
+    Args:
+      logits: [T, E] router logits (softmax over experts in fp32).
+      capacity: tokens per expert C (typically T * cf * k / E).
+    Returns:
+      sel: [E, C] int32 — token index chosen per expert slot.
+      w:   [E, C] fp32 — combine weight (the token's gate prob for this
+           expert).
+      probs: [T, E] fp32 — full router probabilities (for monitoring).
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    w, sel = lax.top_k(probs.T, min(capacity, T))        # [E, C]
+    return sel.astype(jnp.int32), w, probs
+
+
+def moe_expert_choice_ffn(x: jax.Array, gate_w: jax.Array,
+                          expert_apply: Callable, n_experts_local: int, *,
+                          capacity_factor: float = 2.0,
+                          ep_axis: Optional[str] = None) -> jax.Array:
+    """MoE FFN under expert-choice routing, expert-parallel over
+    ``ep_axis``.
+
+    Dispatch is a plain gather (each expert's C chosen tokens), combine a
+    weighted scatter-add back to token positions; both are linear, so AD
+    handles the transposes.  With ``ep_axis`` the gathered buffers move
+    with the same pair of all_to_alls as the token-choice path.
+
+    ``capacity_factor`` here means AVERAGE EXPERTS PER TOKEN (the
+    expert-choice paper's c): C = T * c / E.
+    """
+    shape = x.shape
+    h = shape[-1]
+    tokens = x.reshape(-1, h)
+    T = tokens.shape[0]
+    ep = 1 if ep_axis is None else lax.axis_size(ep_axis)
+    E = n_experts_local * ep
+    if gate_w.shape[1] != E:
+        raise ValueError(f"gate_w experts {gate_w.shape[1]} != "
+                         f"{n_experts_local}x{ep} sharded expert bank")
+    C = max(1, min(T, int(T * capacity_factor / E)))
+
+    logits = tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    sel, w, _ = expert_choice_routing(logits, C)          # [E, C]
+
+    buf = tokens[sel]                                     # [E, C, h] gather
+    if ep_axis is not None:
+        buf = lax.all_to_all(buf, ep_axis, split_axis=0, concat_axis=1,
+                             tiled=True)
+    out = expert_apply(buf)
+    if ep_axis is not None:
+        out = lax.all_to_all(out, ep_axis, split_axis=1, concat_axis=0,
+                             tiled=True)
+    # combine: weighted scatter-add back to token slots
+    res = jnp.zeros((T, h), jnp.float32)
+    res = res.at[sel.reshape(-1)].add(
+        (w[..., None].astype(jnp.float32)
+         * out.astype(jnp.float32)).reshape(E * C, h))
+    return res.astype(x.dtype).reshape(shape)
+
+
+def moe_swiglu_ffn_grouped(x: jax.Array, router_w: jax.Array,
+                           wg: jax.Array, wu: jax.Array, wd: jax.Array, *,
+                           top_k: int = 2,
+                           normalize: bool = True) -> jax.Array:
+    """Exact SwiGLU MoE via sorted grouped GEMM (`lax.ragged_dot`) — the
+    SERVING formulation: assignments are sorted by expert and each expert
+    multiplies only its own contiguous row block, so there is no capacity
+    padding (top_k*T slot cost, vs E*C for the dispatch-buffer path) and
+    no token is ever dropped.  On TPU ragged_dot lowers to the Mosaic
+    grouped-matmul; this is the MegaBlocks-style dropless MoE.
+
+    Single-device only (no ep/mp axes) and forward-only by intent — the
+    training path keeps the fixed-capacity dispatch buffers whose shapes
+    the pipeline schedules and EP all_to_alls need.
+    """
+    shape = x.shape
+    h = shape[-1]
+    tokens = x.reshape(-1, h)
+    T = tokens.shape[0]
+    E = wg.shape[0]
+    logits = tokens.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    w, idx = lax.top_k(probs, top_k)                     # [T, k]
+    if normalize and top_k > 1:
+        w = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-9)
+
+    e_flat = idx.reshape(-1)                             # [T*k]
+    order = jnp.argsort(e_flat)
+    tok_rep = jnp.broadcast_to(tokens[:, None, :],
+                               (T, top_k, h)).reshape(T * top_k, h)
+    sorted_tok = tok_rep[order]
+    gs = jnp.bincount(e_flat, length=E).astype(jnp.int32)
+    gate = lax.ragged_dot(sorted_tok, wg, gs)
+    up = lax.ragged_dot(sorted_tok, wu, gs)
+    out_sorted = lax.ragged_dot(jax.nn.silu(gate) * up, wd, gs)
+    inv = jnp.argsort(order)
+    out = out_sorted[inv].reshape(T, top_k, h)
+    res = jnp.sum(w[..., None] * out.astype(jnp.float32), axis=1)
+    return res.astype(x.dtype).reshape(shape)
+
+
+def moe_gelu_ffn_grouped(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
+                         b1: jax.Array, w2: jax.Array, b2: jax.Array, *,
+                         top_k: int = 2, normalize: bool = True,
+                         activation: Callable = functools.partial(
+                             jax.nn.gelu, approximate=True)) -> jax.Array:
+    """GELU-MLP counterpart of :func:`moe_swiglu_ffn_grouped` (the GPT
+    expert bank with per-expert biases): per-assignment biases come from
+    a gather on the sorted expert ids, everything else is the same
+    sorted ragged_dot pipeline.  Serving path — single device, no
+    ep/mp axes, dropless by construction."""
+    shape = x.shape
+    h = shape[-1]
+    tokens = x.reshape(-1, h)
+    T = tokens.shape[0]
+    E = w1.shape[0]
+    logits = tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    w, idx = lax.top_k(probs, top_k)
+    if normalize and top_k > 1:
+        w = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-9)
+
+    e_flat = idx.reshape(-1)
+    order = jnp.argsort(e_flat)
+    e_sorted = e_flat[order]
+    tok_rep = jnp.broadcast_to(tokens[:, None, :],
+                               (T, top_k, h)).reshape(T * top_k, h)
+    sorted_tok = tok_rep[order]
+    gs = jnp.bincount(e_flat, length=E).astype(jnp.int32)
+    hdn = lax.ragged_dot(sorted_tok, w1, gs) + b1[e_sorted]
+    out_sorted = lax.ragged_dot(activation(hdn), w2, gs) + b2[e_sorted]
+    inv = jnp.argsort(order)
+    out = out_sorted[inv].reshape(T, top_k, h)
+    res = jnp.sum(w[..., None] * out.astype(jnp.float32), axis=1)
+    return res.astype(x.dtype).reshape(shape)
+
+
 def moe_dispatch_combine(x: jax.Array, gate_w: jax.Array,
                          expert_apply: Callable, n_experts_local: int, *,
                          top_k: int = 2, capacity_factor: float = 1.25,
@@ -220,7 +367,8 @@ def moe_ffn_ep(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
                aux_coef: float = 0.0,
                activation: Callable = functools.partial(jax.nn.gelu,
                                                         approximate=True),
-               normalize: bool = True) -> jax.Array:
+               normalize: bool = True,
+               router: str = "topk") -> jax.Array:
     """GELU-MLP mixture of experts (the GPT block's FFN), expert-parallel
     over ``ep_axis``.
 
@@ -246,6 +394,10 @@ def moe_ffn_ep(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
             out = fwd_psum(out, mp_axis)  # row out: sum the f/mp partials
         return out + b2[:, None, :]
 
+    if router == "expert_choice":
+        return moe_expert_choice_ffn(
+            x, gate_w, expert_apply, w1.shape[0],
+            capacity_factor=capacity_factor, ep_axis=ep_axis)
     return moe_dispatch_combine(
         x, gate_w, expert_apply, w1.shape[0], top_k=top_k,
         capacity_factor=capacity_factor, ep_axis=ep_axis,
@@ -260,7 +412,8 @@ def moe_swiglu_ffn_ep(x: jax.Array, router_w: jax.Array, wg: jax.Array,
                       sequence_parallel: bool = False,
                       aux_coef: float = 0.0,
                       normalize: bool = True,
-                      capacity: Optional[int] = None) -> jax.Array:
+                      capacity: Optional[int] = None,
+                      router: str = "topk") -> jax.Array:
     """SwiGLU mixture of experts (Mixtral-style Llama FFN): per-expert
     gate/up column-split + down row-split over ``mp_axis``, biasless.
 
@@ -279,6 +432,15 @@ def moe_swiglu_ffn_ep(x: jax.Array, router_w: jax.Array, wg: jax.Array,
             out = fwd_psum(out, mp_axis)
         return out
 
+    if router == "expert_choice":
+        if capacity is not None:
+            raise ValueError(
+                "capacity override is a token-choice (no-drop) contract; "
+                "expert_choice routing sizes its own buffers and can "
+                "leave tokens unrouted — use router='topk' for serving")
+        return moe_expert_choice_ffn(
+            x, router_w, expert_apply, wg.shape[0],
+            capacity_factor=capacity_factor, ep_axis=ep_axis)
     return moe_dispatch_combine(
         x, router_w, expert_apply, wg.shape[0], top_k=top_k,
         capacity_factor=capacity_factor, ep_axis=ep_axis,

@@ -101,6 +101,30 @@ def test_llama_greedy_generate_matches_no_cache():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
 
 
+def test_gpt_moe_greedy_generate_matches_no_cache():
+    """GPT-MoE decode through the grouped-GEMM (ragged_dot) serving FFN:
+    KV-cache rollout == full-prefix recompute rollout."""
+    from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
+    from paddle_tpu import parallel as dist
+    from paddle_tpu.parallel.topology import HybridTopology, set_topology
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    moe_num_experts=4)
+    topo = dist.init_topology()
+    _, init_fn = build_gpt_train_step(cfg, topo, num_microbatches=1)
+    params = init_fn(0)["params"]
+    set_topology(HybridTopology())
+    ids = rng.integers(0, cfg.vocab_size, (2, 6)).astype(np.int32)
+    out = gpt_generate(params, cfg, ids, max_new_tokens=6, temperature=0.0,
+                       use_pallas=False)
+    cur = jnp.asarray(ids)
+    for _ in range(6):
+        logits = _gpt_full_logits(cfg, params, cur)
+        nxt = jnp.argmax(logits, -1).astype(cur.dtype)
+        cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+
 def test_llama_moe_greedy_generate_matches_no_cache():
     """Mixtral-style MoE decode: the KV-cache prefill+step loop must
     reproduce repeated full-forward greedy decoding exactly (capacity is

@@ -276,6 +276,69 @@ def test_scatter_routing_matches_dense_gating():
     np.testing.assert_allclose(aux, aux_ref, rtol=1e-6)
 
 
+def test_expert_choice_routing_perfect_balance():
+    """Every expert selects exactly C tokens — balance by construction
+    (Zhou et al. 2022), no aux loss needed."""
+    from paddle_tpu.parallel.moe import expert_choice_routing
+    T, E, C = 32, 4, 8
+    logits = jax.random.normal(jax.random.key(0), (T, E))
+    sel, w, probs = expert_choice_routing(logits, C)
+    assert sel.shape == (E, C) and w.shape == (E, C)
+    # weights are the actual router probs of the selected tokens
+    for e in range(E):
+        np.testing.assert_allclose(w[e], probs[sel[e], e], rtol=1e-6)
+    # per-expert top-C: selected probs >= every unselected prob
+    for e in range(E):
+        unsel = np.setdiff1d(np.arange(T), np.asarray(sel[e]))
+        assert float(np.min(np.asarray(w[e]))) >= \
+            float(np.max(np.asarray(probs)[unsel, e]))
+
+
+def test_expert_choice_gpt_trains():
+    """Compiled hybrid step with the expert-choice router (dp2 EP):
+    trains without aux loss, loss decreases."""
+    losses = _losses(_cfg(moe_router="expert_choice",
+                          moe_capacity_factor=2.0), dp=2)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_grouped_gemm_matches_nodrop_dispatch():
+    """The ragged_dot serving path must equal the capacity=T dispatch
+    buffers bit-for-bit in routing semantics (both dropless)."""
+    from paddle_tpu.parallel.moe import (moe_swiglu_ffn_ep,
+                                         moe_swiglu_ffn_grouped)
+    T, h, f, E, k = 20, 8, 16, 4, 2
+    ks = jax.random.split(jax.random.key(3), 5)
+    x = jax.random.normal(ks[0], (T, h))
+    rw = jax.random.normal(ks[1], (h, E)) * 0.1
+    wg = jax.random.normal(ks[2], (E, h, f)) * 0.1
+    wu = jax.random.normal(ks[3], (E, h, f)) * 0.1
+    wd = jax.random.normal(ks[4], (E, f, h)) * 0.1
+    a = moe_swiglu_ffn_ep(x, rw, wg, wu, wd, top_k=k, capacity=T)
+    b = moe_swiglu_ffn_grouped(x, rw, wg, wu, wd, top_k=k)
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+
+
+def test_expert_choice_capacity_override_rejected():
+    from paddle_tpu.parallel.moe import moe_swiglu_ffn_ep
+    x = jnp.zeros((4, 8))
+    rw = jnp.zeros((8, 2))
+    wg = wu = jnp.zeros((2, 8, 4))
+    wd = jnp.zeros((2, 4, 8))
+    with pytest.raises(ValueError, match="no-drop"):
+        moe_swiglu_ffn_ep(x, rw, wg, wu, wd, capacity=4,
+                          router="expert_choice")
+
+
+def test_expert_choice_decode_guard():
+    from paddle_tpu.models.generation import build_llama_decoder
+    from paddle_tpu.models.llama import llama_tiny
+    with pytest.raises(NotImplementedError, match="expert_choice"):
+        build_llama_decoder(llama_tiny(moe_num_experts=4,
+                                       moe_router="expert_choice"), 16)
+
+
 def test_moe_ffn_ep_local_matches_reference():
     """Single-process moe_ffn_ep == a straightforward dense-mask MoE on
     the same params (independent formulation: einsum dispatch/combine)."""
