@@ -17,7 +17,8 @@ import numpy as np
 
 
 def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
-        flash=None, autotune=False, remat_policy=None, experts=0):
+        flash=None, autotune=False, remat_policy=None, experts=0,
+        dropless=False):
     import jax
     from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
     from paddle_tpu import parallel as dist
@@ -28,7 +29,8 @@ def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
     FLAGS.use_autotune = bool(autotune)
     cfg = GPTConfig(vocab_size=V, hidden_size=h, num_layers=L,
                     num_heads=h // 64, max_position_embeddings=seq,
-                    dtype="bfloat16", moe_num_experts=experts)
+                    dtype="bfloat16", moe_num_experts=experts,
+                    moe_dropless=dropless)
     topo = dist.init_topology(devices=jax.devices()[:1])
     step_fn, init_fn = build_gpt_train_step(cfg, topo, num_microbatches=mbs,
                                             remat=remat, use_flash=flash,
@@ -66,6 +68,7 @@ def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
     }
     if experts:
         row["experts"] = experts
+        row["dropless"] = dropless
     if remat:
         # hardware FLOP utilization incl. the recompute forward —
         # reported SEPARATELY so mfu stays comparable across rows
@@ -101,6 +104,10 @@ DEFAULT_MATRIX = [
     # GPT-MoE (E8 top-2, single chip): scatter routing + batched expert
     # einsums; MFU basis = ACTIVE params (top-k experts + router)
     dict(batch=8, seq=1024, steps=10, remat=False, flash=None, experts=8),
+    # dropless (sorted ragged_dot / Mosaic grouped-matmul) vs the
+    # fixed-capacity dispatch buffers, same model
+    dict(batch=8, seq=1024, steps=10, remat=False, flash=None, experts=8,
+         dropless=True),
 ]
 
 

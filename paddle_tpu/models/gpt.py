@@ -71,6 +71,8 @@ class GPTConfig:
     # their top-C tokens — perfectly balanced, no aux loss; best for
     # encoder-style training, routing is batch-global so NOT causal)
     moe_router: str = "topk"
+    moe_dropless: bool = False  # sorted ragged_dot experts (no drops;
+    # local banks only — mutually exclusive with dp-EP / mp expert TP)
 
     @property
     def ffn_size(self) -> int:
@@ -122,6 +124,11 @@ class GPTBlock(Layer):
             # eager MoE path: the incubate MoELayer (GShard gate, dense
             # capacity dispatch); expert TP/EP belong to the compiled
             # hybrid step (build_gpt_train_step + parallel/moe.py)
+            if cfg.moe_dropless:
+                raise NotImplementedError(
+                    "eager GPTBlock's MoELayer uses capacity dispatch; "
+                    "moe_dropless lives in the compiled hybrid step and "
+                    "the eager Llama path")
             if cfg.moe_router != "topk":
                 # the incubate MoELayer serves GShard/Switch token-choice
                 # gates only; failing loudly beats silently training a
@@ -394,7 +401,8 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
             mp_axis=mp_axis, sequence_parallel=sequence_parallel,
             aux_coef=(cfg.moe_aux_coef if moe_aux_coef is None
                       else moe_aux_coef),
-            router=cfg.moe_router)
+            router=cfg.moe_router,
+            dropless=getattr(cfg, "moe_dropless", False))
         if mp_axis is not None and sequence_parallel:
             out = scatter_op(out, mp_axis)
         return res + out
@@ -464,6 +472,14 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
         raise ValueError(
             f"moe_num_experts={cfg.moe_num_experts} not divisible by the "
             f"expert-parallel (dp) degree {dp}")
+    if cfg.moe_num_experts and cfg.moe_dropless:
+        if cfg.moe_router != "topk":
+            raise ValueError("moe_dropless applies to token-choice "
+                             "routing only (moe_router='topk')")
+        if dp > 1 or mp > 1:
+            raise ValueError("moe_dropless needs local expert banks: "
+                             "dp==1 and mp==1 (got dp=%d mp=%d)"
+                             % (dp, mp))
     if mp > 1:
         for name, val in (("vocab_size", cfg.vocab_size),
                           ("num_heads", cfg.num_heads),

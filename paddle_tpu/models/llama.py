@@ -65,6 +65,8 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 1e-2
     moe_router: str = "topk"   # "topk" | "expert_choice" (see gpt.py)
+    moe_dropless: bool = False  # sorted ragged_dot experts (no drops;
+    # local banks only — mutually exclusive with dp-EP / mp expert TP)
 
     @property
     def head_dim(self) -> int:
@@ -243,7 +245,8 @@ class LlamaMoEMLP(Layer):
             return moe_swiglu_ffn_ep(
                 x_, rw, wg, wu, wd, top_k=cfg.moe_top_k,
                 capacity_factor=cfg.moe_capacity_factor,
-                aux_coef=cfg.moe_aux_coef, router=cfg.moe_router)
+                aux_coef=cfg.moe_aux_coef, router=cfg.moe_router,
+                dropless=cfg.moe_dropless)
 
         return run_op("llama_moe_mlp", impl,
                       (x, self.router_w, self.e_gate, self.e_up,
@@ -452,7 +455,7 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
             mp_axis=mp_axis, sequence_parallel=sequence_parallel,
             aux_coef=(cfg.moe_aux_coef if moe_aux_coef is None
                       else moe_aux_coef),
-            router=cfg.moe_router)
+            router=cfg.moe_router, dropless=cfg.moe_dropless)
         if mp_axis is not None and sequence_parallel:
             out = scatter_op(out, mp_axis)
         return res + out
@@ -508,6 +511,14 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
         raise ValueError(
             f"moe_num_experts={cfg.moe_num_experts} not divisible by the "
             f"expert-parallel (dp) degree {dp}")
+    if cfg.moe_num_experts and cfg.moe_dropless:
+        if cfg.moe_router != "topk":
+            raise ValueError("moe_dropless applies to token-choice "
+                             "routing only (moe_router='topk')")
+        if dp > 1 or mp > 1:
+            raise ValueError("moe_dropless needs local expert banks: "
+                             "dp==1 and mp==1 (got dp=%d mp=%d)"
+                             % (dp, mp))
     if mp > 1:
         for name, val in (("vocab_size", cfg.vocab_size),
                           ("num_heads", cfg.num_heads),

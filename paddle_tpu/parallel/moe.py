@@ -213,8 +213,8 @@ def moe_expert_choice_ffn(x: jax.Array, gate_w: jax.Array,
 
 def moe_swiglu_ffn_grouped(x: jax.Array, router_w: jax.Array,
                            wg: jax.Array, wu: jax.Array, wd: jax.Array, *,
-                           top_k: int = 2,
-                           normalize: bool = True) -> jax.Array:
+                           top_k: int = 2, normalize: bool = True,
+                           with_aux: bool = False):
     """Exact SwiGLU MoE via sorted grouped GEMM (`lax.ragged_dot`) — the
     SERVING formulation: assignments are sorted by expert and each expert
     multiplies only its own contiguous row block, so there is no capacity
@@ -222,9 +222,10 @@ def moe_swiglu_ffn_grouped(x: jax.Array, router_w: jax.Array,
     no token is ever dropped.  On TPU ragged_dot lowers to the Mosaic
     grouped-matmul; this is the MegaBlocks-style dropless MoE.
 
-    Single-device only (no ep/mp axes) and forward-only by intent — the
-    training path keeps the fixed-capacity dispatch buffers whose shapes
-    the pipeline schedules and EP all_to_alls need.
+    Single-device only (no ep/mp axes).  ragged_dot differentiates, so
+    this serves AND trains (the ``dropless`` mode of the ffn wrappers);
+    EP/TP layouts keep the fixed-capacity dispatch buffers whose static
+    shapes the all_to_alls need.
     """
     shape = x.shape
     h = shape[-1]
@@ -249,14 +250,18 @@ def moe_swiglu_ffn_grouped(x: jax.Array, router_w: jax.Array,
     inv = jnp.argsort(order)
     out = out_sorted[inv].reshape(T, top_k, h)
     res = jnp.sum(w[..., None] * out.astype(jnp.float32), axis=1)
-    return res.astype(x.dtype).reshape(shape)
+    res = res.astype(x.dtype).reshape(shape)
+    if with_aux:
+        return res, gshard_aux_loss(probs, jnp.argmax(probs, axis=-1))
+    return res
 
 
 def moe_gelu_ffn_grouped(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
                          b1: jax.Array, w2: jax.Array, b2: jax.Array, *,
                          top_k: int = 2, normalize: bool = True,
                          activation: Callable = functools.partial(
-                             jax.nn.gelu, approximate=True)) -> jax.Array:
+                             jax.nn.gelu, approximate=True),
+                         with_aux: bool = False):
     """GELU-MLP counterpart of :func:`moe_swiglu_ffn_grouped` (the GPT
     expert bank with per-expert biases): per-assignment biases come from
     a gather on the sorted expert ids, everything else is the same
@@ -285,7 +290,10 @@ def moe_gelu_ffn_grouped(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
     inv = jnp.argsort(order)
     out = out_sorted[inv].reshape(T, top_k, h)
     res = jnp.sum(w[..., None] * out.astype(jnp.float32), axis=1)
-    return res.astype(x.dtype).reshape(shape)
+    res = res.astype(x.dtype).reshape(shape)
+    if with_aux:
+        return res, gshard_aux_loss(probs, jnp.argmax(probs, axis=-1))
+    return res
 
 
 def moe_dispatch_combine(x: jax.Array, gate_w: jax.Array,
@@ -368,7 +376,8 @@ def moe_ffn_ep(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
                activation: Callable = functools.partial(jax.nn.gelu,
                                                         approximate=True),
                normalize: bool = True,
-               router: str = "topk") -> jax.Array:
+               router: str = "topk",
+               dropless: bool = False) -> jax.Array:
     """GELU-MLP mixture of experts (the GPT block's FFN), expert-parallel
     over ``ep_axis``.
 
@@ -395,9 +404,28 @@ def moe_ffn_ep(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
         return out + b2[:, None, :]
 
     if router == "expert_choice":
+        if dropless:
+            raise ValueError(
+                "moe_dropless applies to token-choice routing only; "
+                "expert_choice is capacity-shaped by construction")
         return moe_expert_choice_ffn(
             x, gate_w, expert_apply, w1.shape[0],
             capacity_factor=capacity_factor, ep_axis=ep_axis)
+    if dropless:
+        ep_d = 1 if ep_axis is None else lax.axis_size(ep_axis)
+        mp_d = 1 if mp_axis is None else lax.axis_size(mp_axis)
+        if ep_d > 1 or mp_d > 1:
+            raise ValueError("dropless=True requires local expert banks "
+                             "(ep/mp degree 1) — capacity buffers carry "
+                             "the static shapes collectives need")
+        if aux_coef:
+            out, aux = moe_gelu_ffn_grouped(
+                x, gate_w, w1, b1, w2, b2, top_k=top_k,
+                normalize=normalize, activation=activation, with_aux=True)
+            return inject_aux_grad(out, aux, aux_coef)
+        return moe_gelu_ffn_grouped(x, gate_w, w1, b1, w2, b2,
+                                    top_k=top_k, normalize=normalize,
+                                    activation=activation)
     return moe_dispatch_combine(
         x, gate_w, expert_apply, w1.shape[0], top_k=top_k,
         capacity_factor=capacity_factor, ep_axis=ep_axis,
@@ -413,7 +441,8 @@ def moe_swiglu_ffn_ep(x: jax.Array, router_w: jax.Array, wg: jax.Array,
                       aux_coef: float = 0.0,
                       normalize: bool = True,
                       capacity: Optional[int] = None,
-                      router: str = "topk") -> jax.Array:
+                      router: str = "topk",
+                      dropless: bool = False) -> jax.Array:
     """SwiGLU mixture of experts (Mixtral-style Llama FFN): per-expert
     gate/up column-split + down row-split over ``mp_axis``, biasless.
 
@@ -433,6 +462,10 @@ def moe_swiglu_ffn_ep(x: jax.Array, router_w: jax.Array, wg: jax.Array,
         return out
 
     if router == "expert_choice":
+        if dropless:
+            raise ValueError(
+                "moe_dropless applies to token-choice routing only; "
+                "expert_choice is capacity-shaped by construction")
         if capacity is not None:
             raise ValueError(
                 "capacity override is a token-choice (no-drop) contract; "
@@ -441,6 +474,24 @@ def moe_swiglu_ffn_ep(x: jax.Array, router_w: jax.Array, wg: jax.Array,
         return moe_expert_choice_ffn(
             x, router_w, expert_apply, wg.shape[0],
             capacity_factor=capacity_factor, ep_axis=ep_axis)
+    if dropless:
+        # MegaBlocks-style dropless training: sorted grouped GEMM, exact.
+        # ragged_dot differentiates, so this trains; EP/TP need the
+        # static fixed-capacity buffers (all_to_all shapes), so dropless
+        # is a local-expert-bank mode.
+        ep_d = 1 if ep_axis is None else lax.axis_size(ep_axis)
+        mp_d = 1 if mp_axis is None else lax.axis_size(mp_axis)
+        if ep_d > 1 or mp_d > 1:
+            raise ValueError("dropless=True requires local expert banks "
+                             "(ep/mp degree 1) — capacity buffers carry "
+                             "the static shapes collectives need")
+        if aux_coef:
+            out, aux = moe_swiglu_ffn_grouped(
+                x, router_w, wg, wu, wd, top_k=top_k,
+                normalize=normalize, with_aux=True)
+            return inject_aux_grad(out, aux, aux_coef)
+        return moe_swiglu_ffn_grouped(x, router_w, wg, wu, wd,
+                                      top_k=top_k, normalize=normalize)
     return moe_dispatch_combine(
         x, router_w, expert_apply, wg.shape[0], top_k=top_k,
         capacity_factor=capacity_factor, ep_axis=ep_axis,

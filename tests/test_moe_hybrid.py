@@ -303,6 +303,22 @@ def test_expert_choice_gpt_trains():
     assert losses[-1] < losses[0]
 
 
+def test_dropless_training_matches_capacity_path():
+    """moe_dropless=True (differentiable ragged_dot experts) must track
+    the capacity-dispatch trajectory when capacity is high enough that
+    the capacity path drops nothing either."""
+    losses = _losses(_cfg(moe_dropless=True))
+    np.testing.assert_allclose(losses, _base(), rtol=2e-3)
+
+
+def test_dropless_requires_local_banks():
+    from paddle_tpu.models.gpt import build_gpt_train_step
+    topo = dist.init_topology(dp=2)
+    with pytest.raises(ValueError, match="local expert banks"):
+        build_gpt_train_step(_cfg(moe_dropless=True), topo,
+                             num_microbatches=1)
+
+
 def test_grouped_gemm_matches_nodrop_dispatch():
     """The ragged_dot serving path must equal the capacity=T dispatch
     buffers bit-for-bit in routing semantics (both dropless)."""
