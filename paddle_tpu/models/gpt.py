@@ -402,7 +402,7 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
             aux_coef=(cfg.moe_aux_coef if moe_aux_coef is None
                       else moe_aux_coef),
             router=cfg.moe_router,
-            dropless=getattr(cfg, "moe_dropless", False))
+            dropless=cfg.moe_dropless)
         if mp_axis is not None and sequence_parallel:
             out = scatter_op(out, mp_axis)
         return res + out

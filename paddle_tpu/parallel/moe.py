@@ -296,6 +296,27 @@ def moe_gelu_ffn_grouped(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
     return res
 
 
+def _run_dropless(grouped_fn, ep_axis, mp_axis, aux_coef, router):
+    """Shared dropless-branch contract for the ffn wrappers: reject the
+    expert_choice combination, require degree-1 ep/mp (capacity buffers
+    carry the static shapes collectives need), then run the grouped fn
+    and inject the aux loss it already computed."""
+    if router == "expert_choice":
+        raise ValueError(
+            "moe_dropless applies to token-choice routing only; "
+            "expert_choice is capacity-shaped by construction")
+    ep_d = 1 if ep_axis is None else lax.axis_size(ep_axis)
+    mp_d = 1 if mp_axis is None else lax.axis_size(mp_axis)
+    if ep_d > 1 or mp_d > 1:
+        raise ValueError("dropless=True requires local expert banks "
+                         "(ep/mp degree 1) — capacity buffers carry "
+                         "the static shapes collectives need")
+    if aux_coef:
+        out, aux = grouped_fn(True)
+        return inject_aux_grad(out, aux, aux_coef)
+    return grouped_fn(False)
+
+
 def moe_dispatch_combine(x: jax.Array, gate_w: jax.Array,
                          expert_apply: Callable, n_experts_local: int, *,
                          top_k: int = 2, capacity_factor: float = 1.25,
@@ -412,20 +433,11 @@ def moe_ffn_ep(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
             x, gate_w, expert_apply, w1.shape[0],
             capacity_factor=capacity_factor, ep_axis=ep_axis)
     if dropless:
-        ep_d = 1 if ep_axis is None else lax.axis_size(ep_axis)
-        mp_d = 1 if mp_axis is None else lax.axis_size(mp_axis)
-        if ep_d > 1 or mp_d > 1:
-            raise ValueError("dropless=True requires local expert banks "
-                             "(ep/mp degree 1) — capacity buffers carry "
-                             "the static shapes collectives need")
-        if aux_coef:
-            out, aux = moe_gelu_ffn_grouped(
+        return _run_dropless(
+            lambda wa: moe_gelu_ffn_grouped(
                 x, gate_w, w1, b1, w2, b2, top_k=top_k,
-                normalize=normalize, activation=activation, with_aux=True)
-            return inject_aux_grad(out, aux, aux_coef)
-        return moe_gelu_ffn_grouped(x, gate_w, w1, b1, w2, b2,
-                                    top_k=top_k, normalize=normalize,
-                                    activation=activation)
+                normalize=normalize, activation=activation, with_aux=wa),
+            ep_axis, mp_axis, aux_coef, router)
     return moe_dispatch_combine(
         x, gate_w, expert_apply, w1.shape[0], top_k=top_k,
         capacity_factor=capacity_factor, ep_axis=ep_axis,
@@ -475,23 +487,12 @@ def moe_swiglu_ffn_ep(x: jax.Array, router_w: jax.Array, wg: jax.Array,
             x, router_w, expert_apply, wg.shape[0],
             capacity_factor=capacity_factor, ep_axis=ep_axis)
     if dropless:
-        # MegaBlocks-style dropless training: sorted grouped GEMM, exact.
-        # ragged_dot differentiates, so this trains; EP/TP need the
-        # static fixed-capacity buffers (all_to_all shapes), so dropless
-        # is a local-expert-bank mode.
-        ep_d = 1 if ep_axis is None else lax.axis_size(ep_axis)
-        mp_d = 1 if mp_axis is None else lax.axis_size(mp_axis)
-        if ep_d > 1 or mp_d > 1:
-            raise ValueError("dropless=True requires local expert banks "
-                             "(ep/mp degree 1) — capacity buffers carry "
-                             "the static shapes collectives need")
-        if aux_coef:
-            out, aux = moe_swiglu_ffn_grouped(
+        # MegaBlocks-style dropless training (ragged_dot differentiates)
+        return _run_dropless(
+            lambda wa: moe_swiglu_ffn_grouped(
                 x, router_w, wg, wu, wd, top_k=top_k,
-                normalize=normalize, with_aux=True)
-            return inject_aux_grad(out, aux, aux_coef)
-        return moe_swiglu_ffn_grouped(x, router_w, wg, wu, wd,
-                                      top_k=top_k, normalize=normalize)
+                normalize=normalize, with_aux=wa),
+            ep_axis, mp_axis, aux_coef, router)
     return moe_dispatch_combine(
         x, router_w, expert_apply, wg.shape[0], top_k=top_k,
         capacity_factor=capacity_factor, ep_axis=ep_axis,

@@ -204,6 +204,77 @@ class TestBertParity:
         assert errp < ATOL, f"BERT pooler diverges: max err {errp}"
 
 
+class TestMixtralParity:
+    def test_logits_match_hf_mixtral_moe(self):
+        """Sparse-MoE cross-framework pin: our Llama-MoE (GShard-style
+        renormalized top-k over full-softmax probs) equals Mixtral's
+        softmax-over-top-k-logits EXACTLY when no token drops —
+        exp(l_i)/sum_topk exp(l_j) is the same ratio either way — so with
+        capacity_factor = E/k (capacity == T) the two implementations
+        must agree to fp tolerance under identical weights."""
+        import torch
+        from transformers import MixtralConfig as HFMixtralConfig
+        from transformers import MixtralForCausalLM as HFMixtral
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        import paddle_tpu as paddle
+
+        V, h, f, L, H, KV, S, E, K = 128, 64, 128, 2, 4, 2, 32, 4, 2
+        torch.manual_seed(0)
+        hf = HFMixtral(HFMixtralConfig(
+            vocab_size=V, hidden_size=h, intermediate_size=f,
+            num_hidden_layers=L, num_attention_heads=H,
+            num_key_value_heads=KV, max_position_embeddings=S,
+            num_local_experts=E, num_experts_per_tok=K,
+            rope_theta=10000.0, rms_norm_eps=1e-5,
+            tie_word_embeddings=False,
+            attn_implementation="eager")).eval()
+
+        ours = LlamaForCausalLM(LlamaConfig(
+            vocab_size=V, hidden_size=h, intermediate_size=f, num_layers=L,
+            num_heads=H, num_kv_heads=KV, max_position_embeddings=S,
+            rope_theta=10000.0, rms_norm_eps=1e-5, dtype="float32",
+            moe_num_experts=E, moe_top_k=K,
+            moe_capacity_factor=float(E) / K))   # capacity == T: no drops
+
+        hsd = hf.state_dict()
+        sd = {"llama.embed_tokens.weight":
+              _to_np(hsd["model.embed_tokens.weight"]),
+              "llama.norm.weight": _to_np(hsd["model.norm.weight"]),
+              "lm_head.weight": _to_np(hsd["lm_head.weight"]).T}
+        for i in range(L):
+            p = f"model.layers.{i}."
+            q = f"llama.layers.{i}."
+            sd[q + "input_layernorm.weight"] = \
+                _to_np(hsd[p + "input_layernorm.weight"])
+            sd[q + "post_attention_layernorm.weight"] = \
+                _to_np(hsd[p + "post_attention_layernorm.weight"])
+            for w in ("self_attn.q_proj", "self_attn.k_proj",
+                      "self_attn.v_proj", "self_attn.o_proj"):
+                sd[q + w + ".weight"] = _to_np(hsd[p + w + ".weight"]).T
+            moe = p + "block_sparse_moe."
+            sd[q + "mlp.router_w"] = _to_np(hsd[moe + "gate.weight"]).T
+            # HF experts: w1 = gate [f, h], w3 = up [f, h], w2 = down [h, f]
+            sd[q + "mlp.e_gate"] = np.stack(
+                [_to_np(hsd[f"{moe}experts.{e}.w1.weight"]).T
+                 for e in range(E)])
+            sd[q + "mlp.e_up"] = np.stack(
+                [_to_np(hsd[f"{moe}experts.{e}.w3.weight"]).T
+                 for e in range(E)])
+            sd[q + "mlp.e_down"] = np.stack(
+                [_to_np(hsd[f"{moe}experts.{e}.w2.weight"]).T
+                 for e in range(E)])
+        missing = set(ours.state_dict()) - set(sd)
+        assert not missing, f"unmapped params: {missing}"
+        ours.set_state_dict(sd)
+        ours.eval()
+
+        ids = np.random.default_rng(3).integers(0, V, (2, S))
+        ref = _to_np(hf(torch.tensor(ids)).logits)
+        got = np.asarray(ours(paddle.to_tensor(ids.astype("int64"))).numpy())
+        err = np.max(np.abs(got - ref))
+        assert err < ATOL, f"Mixtral logits diverge: max err {err}"
+
+
 class TestLlamaParity:
     def test_logits_match_hf_llama_gqa(self):
         import torch

@@ -39,7 +39,7 @@ PEAK_BF16 = 459e12
 HBM_BW = 2765e9
 
 CONFIGS = ["lenet", "resnet50", "bert_base", "gpt_1p3b", "llama_7b",
-           "gpt_13b"]
+           "gpt_13b", "gpt_moe_8e"]
 
 
 # ---------------------------------------------------------------------------
@@ -223,6 +223,33 @@ def build_config(name: str):
                 "mb8, remat, ZeRO-2",
                 _lm_analytic_flops(n_params, b * s / 8, L, h, s, True))
 
+    if name == "gpt_moe_8e":
+        # GPT-MoE: 125M-width dense trunk, E8 top-2 experts, EP over dp4
+        # — the expert all_to_all pair + batched expert einsums under the
+        # same cost-analysis lens as the dense configs.  MFU basis uses
+        # ACTIVE params (top-k experts + router).
+        from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
+        topo = dist.init_topology(dp=4, mp=2, devices=jax.devices()[:8])
+        cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=1024,
+                        dtype="bfloat16", moe_num_experts=8)
+        b, s = 32, 1024
+        step, init = build_gpt_train_step(cfg, topo, num_microbatches=1,
+                                          remat=False)
+        st = jax.eval_shape(init, 0)
+        ids = jax.ShapeDtypeStruct((b, s), np.int32)
+        lo = jax.jit(step).lower(st, ids, ids)
+        h, L, V, f = (cfg.hidden_size, cfg.num_layers, cfg.vocab_size,
+                      cfg.ffn_size)
+        active = V * h + cfg.max_position_embeddings * h + L * (
+            4 * h * h + cfg.moe_top_k * 2 * h * f
+            + h * cfg.moe_num_experts + 9 * h) + 2 * h
+        return (lo, b * s / 8, "tokens",
+                "GPT-MoE E8 top-2: EP over dp4 x mp2, b32 x s1024 "
+                "(per-chip work items = batch tokens / 8; active-params "
+                "MFU basis)",
+                _lm_analytic_flops(active, b * s / 8, L, h, s, False))
+
     raise SystemExit(f"unknown config {name!r}")
 
 
@@ -344,8 +371,9 @@ def _write_md(rows) -> None:
         if "note" in r:
             extra = ""
             if "scan_undercount_corrected" in r:
-                extra = (f" XLA cost analysis counted the microbatch scan"
-                         f" body once (x{r['scan_undercount_corrected']}"
+                extra = (f" XLA cost analysis counted lax.scan bodies"
+                         f" (layer/microbatch scans) once"
+                         f" (x{r['scan_undercount_corrected']}"
                          " undercount); corrected via the analytic 6N+"
                          "attention formula, bytes scaled by the same"
                          " factor.")
