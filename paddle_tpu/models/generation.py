@@ -232,9 +232,13 @@ def build_llama_decoder(cfg, max_len: int,
         behavior)."""
         if moe:
             from ..parallel.moe import moe_swiglu_ffn_grouped
-            return moe_swiglu_ffn_grouped(
+            out = moe_swiglu_ffn_grouped(
                 y, lp["router_w"], lp["e_gate"], lp["e_up"], lp["e_down"],
                 top_k=cfg.moe_top_k)
+            if getattr(cfg, "moe_num_shared_experts", 0):
+                out = out + (jax.nn.silu(y @ lp["s_gate"])
+                             * (y @ lp["s_up"])) @ lp["s_down"]
+            return out
         return mm(lp, "down_w", jax.nn.silu(mm(lp, "gate_w", y))
                   * mm(lp, "up_w", y))
 

@@ -67,6 +67,11 @@ class LlamaConfig:
     moe_router: str = "topk"   # "topk" | "expert_choice" (see gpt.py)
     moe_dropless: bool = False  # sorted ragged_dot experts (no drops;
     # local banks only — mutually exclusive with dp-EP / mp expert TP)
+    # DeepSeek-style always-on shared experts: every token also runs a
+    # dense SwiGLU of width moe_num_shared_experts * intermediate_size
+    # (sum over shared experts == one wide block-diagonal SwiGLU), added
+    # to the routed output; rides the dense TP/SP machinery
+    moe_num_shared_experts: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -233,6 +238,11 @@ class LlamaMoEMLP(Layer):
         self.e_gate = self.create_parameter((E, h, f))
         self.e_up = self.create_parameter((E, h, f))
         self.e_down = self.create_parameter((E, f, h))
+        if cfg.moe_num_shared_experts:
+            fs = cfg.moe_num_shared_experts * f
+            self.s_gate = self.create_parameter((h, fs))
+            self.s_up = self.create_parameter((h, fs))
+            self.s_down = self.create_parameter((fs, h))
 
     def forward(self, x):
         from ..core.dispatch import run_op
@@ -248,9 +258,21 @@ class LlamaMoEMLP(Layer):
                 aux_coef=cfg.moe_aux_coef, router=cfg.moe_router,
                 dropless=cfg.moe_dropless)
 
-        return run_op("llama_moe_mlp", impl,
-                      (x, self.router_w, self.e_gate, self.e_up,
-                       self.e_down), {})
+        out = run_op("llama_moe_mlp", impl,
+                     (x, self.router_w, self.e_gate, self.e_up,
+                      self.e_down), {})
+        if cfg.moe_num_shared_experts:
+            import jax.numpy as jnp
+
+            def shared(x_, sg, su, sd):
+                g = x_ @ sg
+                u = x_ @ su
+                return jnp.asarray(jax.nn.silu(g) * u) @ sd
+
+            out = out + run_op("llama_moe_shared", shared,
+                               (x, self.s_gate, self.s_up, self.s_down),
+                               {})
+        return out
 
 
 class LlamaBlock(Layer):
@@ -348,6 +370,14 @@ def init_block_params(cfg: LlamaConfig, key) -> Dict[str, jax.Array]:
             "e_up": jax.random.normal(ks[5], (E, h, f), dt) * std,
             "e_down": jax.random.normal(ks[6], (E, f, h), dt) * std,
         })
+        if cfg.moe_num_shared_experts:
+            fs = cfg.moe_num_shared_experts * f
+            k8, k9, k10 = jax.random.split(jax.random.fold_in(key, 8), 3)
+            out.update({
+                "s_gate": jax.random.normal(k8, (h, fs), dt) * std,
+                "s_up": jax.random.normal(k9, (h, fs), dt) * std,
+                "s_down": jax.random.normal(k10, (fs, h), dt) * std,
+            })
     else:
         out.update({
             "gate_w": jax.random.normal(ks[4], (h, f), dt) * std,
@@ -370,6 +400,11 @@ def block_param_specs(cfg: LlamaConfig, pipeline: bool) -> Dict[str, P]:
             "e_up": P(DP_AXIS, None, MP_AXIS),
             "e_down": P(DP_AXIS, MP_AXIS, None),
         })
+        if cfg.moe_num_shared_experts:
+            base.update({
+                "s_gate": P(None, MP_AXIS), "s_up": P(None, MP_AXIS),
+                "s_down": P(MP_AXIS, None),
+            })
     else:
         base.update({
             "gate_w": P(None, MP_AXIS), "up_w": P(None, MP_AXIS),
@@ -441,13 +476,14 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
     attn = attn.reshape(b, s, attn.shape[2] * attn.shape[3])
     x = res + row_mm(attn, params["o_w"])
     res = x
-    y_in = rms(x, params["ln2_w"])
+    y_ln = rms(x, params["ln2_w"])   # pre-gather: shared by both paths
+    y_in = y_ln
     if cfg.moe_num_experts:
         from ..parallel.moe import moe_swiglu_ffn_ep
         if mp_axis is not None and sequence_parallel:
             from ..parallel.sequence_parallel import (all_gather_op,
                                                       scatter_op)
-            y_in = all_gather_op(y_in, mp_axis)
+            y_in = all_gather_op(y_ln, mp_axis)
         out = moe_swiglu_ffn_ep(
             y_in, params["router_w"], params["e_gate"], params["e_up"],
             params["e_down"], top_k=cfg.moe_top_k,
@@ -458,6 +494,13 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
             router=cfg.moe_router, dropless=cfg.moe_dropless)
         if mp_axis is not None and sequence_parallel:
             out = scatter_op(out, mp_axis)
+        if cfg.moe_num_shared_experts:
+            # dense always-on experts ride the standard column/row TP
+            # machinery (incl. SP gather/scatter and tp_overlap rings);
+            # output sharding matches the routed 'out'; y_ln reuses the
+            # single pre-gather RMSNorm
+            sg, su = col_mm(y_ln, params["s_gate"], params["s_up"])
+            out = out + row_mm(jax.nn.silu(sg) * su, params["s_down"])
         return res + out
     g, u = col_mm(y_in, params["gate_w"], params["up_w"])
     y = jax.nn.silu(g) * u

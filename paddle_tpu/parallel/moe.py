@@ -296,15 +296,12 @@ def moe_gelu_ffn_grouped(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
     return res
 
 
-def _run_dropless(grouped_fn, ep_axis, mp_axis, aux_coef, router):
-    """Shared dropless-branch contract for the ffn wrappers: reject the
-    expert_choice combination, require degree-1 ep/mp (capacity buffers
-    carry the static shapes collectives need), then run the grouped fn
-    and inject the aux loss it already computed."""
-    if router == "expert_choice":
-        raise ValueError(
-            "moe_dropless applies to token-choice routing only; "
-            "expert_choice is capacity-shaped by construction")
+def _run_dropless(grouped_fn, ep_axis, mp_axis, aux_coef):
+    """Shared dropless-branch contract for the ffn wrappers: require
+    degree-1 ep/mp (capacity buffers carry the static shapes collectives
+    need), then run the grouped fn and inject the aux loss it already
+    computed.  (The expert_choice x dropless conflict is rejected in the
+    wrappers' expert_choice branches, which return before this runs.)"""
     ep_d = 1 if ep_axis is None else lax.axis_size(ep_axis)
     mp_d = 1 if mp_axis is None else lax.axis_size(mp_axis)
     if ep_d > 1 or mp_d > 1:
@@ -437,7 +434,7 @@ def moe_ffn_ep(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
             lambda wa: moe_gelu_ffn_grouped(
                 x, gate_w, w1, b1, w2, b2, top_k=top_k,
                 normalize=normalize, activation=activation, with_aux=wa),
-            ep_axis, mp_axis, aux_coef, router)
+            ep_axis, mp_axis, aux_coef)
     return moe_dispatch_combine(
         x, gate_w, expert_apply, w1.shape[0], top_k=top_k,
         capacity_factor=capacity_factor, ep_axis=ep_axis,
@@ -492,7 +489,7 @@ def moe_swiglu_ffn_ep(x: jax.Array, router_w: jax.Array, wg: jax.Array,
             lambda wa: moe_swiglu_ffn_grouped(
                 x, router_w, wg, wu, wd, top_k=top_k,
                 normalize=normalize, with_aux=wa),
-            ep_axis, mp_axis, aux_coef, router)
+            ep_axis, mp_axis, aux_coef)
     return moe_dispatch_combine(
         x, router_w, expert_apply, wg.shape[0], top_k=top_k,
         capacity_factor=capacity_factor, ep_axis=ep_axis,

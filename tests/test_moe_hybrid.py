@@ -204,6 +204,46 @@ def test_llama_moe_layout_equivalence(axes, extra):
     np.testing.assert_allclose(losses, base, rtol=2e-3)
 
 
+def test_llama_shared_experts_layout_equivalence():
+    """DeepSeek-style shared experts (dense always-on SwiGLU added to the
+    routed output) must preserve layout equivalence — the shared path
+    rides the dense col/row TP machinery incl. SP."""
+    from paddle_tpu.models.llama import llama_tiny
+    cfg = llama_tiny(moe_num_experts=4, moe_capacity_factor=2.0,
+                     moe_aux_coef=0.0, moe_num_shared_experts=2)
+    base = _llama_losses(cfg)
+    assert base[-1] < base[0]
+    for axes, extra in ((dict(dp=2, mp=2), {}),
+                        (dict(dp=2, mp=2), dict(sequence_parallel=True))):
+        losses = _llama_losses(cfg, **axes, **extra)
+        np.testing.assert_allclose(losses, base, rtol=2e-3)
+
+
+def test_llama_shared_experts_decode_parity():
+    """Serving path computes the same shared+routed FFN as training."""
+    from paddle_tpu.models.llama import llama_tiny, build_llama_train_step
+    from paddle_tpu.models.generation import (build_llama_decoder,
+                                              llama_generate)
+    cfg = llama_tiny(moe_num_experts=4, moe_num_shared_experts=2)
+    topo = dist.init_topology()
+    _, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1)
+    params = init_fn(0)["params"]
+    set_topology(HybridTopology())
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 5)).astype(np.int32)
+    out = llama_generate(params, cfg, ids, max_new_tokens=4,
+                         temperature=0.0, use_pallas=False)
+    cur = jnp.asarray(ids)
+    for _ in range(4):
+        prefill, _ = build_llama_decoder(cfg, cur.shape[1],
+                                         use_pallas=False)
+        _, logits = prefill(params, cur)
+        cur = jnp.concatenate(
+            [cur, jnp.argmax(logits, -1).astype(cur.dtype)[:, None]],
+            axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+
 def test_eager_llama_moe_forward_backward():
     import paddle_tpu as pt
     from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
