@@ -73,6 +73,13 @@ class LlamaConfig:
     # to the routed output; rides the dense TP/SP machinery
     moe_num_shared_experts: int = 0
 
+    def __post_init__(self):
+        if self.moe_num_shared_experts and not self.moe_num_experts:
+            raise ValueError(
+                "moe_num_shared_experts requires moe_num_experts > 0 "
+                "(shared experts augment a routed MoE FFN; for a plain "
+                "dense FFN just widen intermediate_size)")
+
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
@@ -262,12 +269,8 @@ class LlamaMoEMLP(Layer):
                      (x, self.router_w, self.e_gate, self.e_up,
                       self.e_down), {})
         if cfg.moe_num_shared_experts:
-            import jax.numpy as jnp
-
             def shared(x_, sg, su, sd):
-                g = x_ @ sg
-                u = x_ @ su
-                return jnp.asarray(jax.nn.silu(g) * u) @ sd
+                return (jax.nn.silu(x_ @ sg) * (x_ @ su)) @ sd
 
             out = out + run_op("llama_moe_shared", shared,
                                (x, self.s_gate, self.s_up, self.s_down),

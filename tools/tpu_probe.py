@@ -107,8 +107,14 @@ def seize(tag=""):
             except OSError:
                 pass
         try:
+            env = dict(os.environ)
+            # persist autotune winners across suite processes AND into the
+            # repo as evidence (ops/pallas/autotune.py merge-writes it);
+            # later windows skip the timed sweeps entirely
+            env.setdefault("PADDLE_TPU_AUTOTUNE_CACHE",
+                           os.path.join(tdir, "autotune_cache.json"))
             r = subprocess.run(cmd, capture_output=True, text=True,
-                               timeout=timeout, cwd=REPO)
+                               timeout=timeout, cwd=REPO, env=env)
             # keep .json artifacts pure JSON; stderr goes to a .log sibling
             with open(os.path.join(tdir, out_file), "w") as f:
                 f.write(r.stdout)
@@ -209,6 +215,8 @@ def seize(tag=""):
         # whole working tree (edits may be in progress)
         artifacts = ["BASELINE.md", os.path.relpath(sentinel, REPO),
                      "tools/tpu_probe.log"]
+        if os.path.exists(os.path.join(tdir, "autotune_cache.json")):
+            artifacts.append("tools/autotune_cache.json")
         # exact names this run wrote — a glob would sweep in stale
         # artifacts left behind by aborted runs of OTHER tags
         produced = [f"bench_tpu{suffix}.json",
