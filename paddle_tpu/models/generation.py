@@ -32,6 +32,7 @@ import numpy as np
 from ..ops.pallas.decode_attention import decode_attention
 
 __all__ = ["sample_logits", "gpt_generate", "llama_generate",
+           "llama_speculative_generate",
            "build_gpt_decoder", "build_llama_decoder"]
 
 
@@ -168,6 +169,21 @@ def build_gpt_decoder(cfg, max_len: int, use_pallas: Optional[bool] = None):
     return prefill, step
 
 
+def _dense_masked_attention(q, k, v, mask, scale):
+    """q [B,Q,H,D] vs k/v [B,T,Hkv,D] (GQA-repeat inside) under a
+    broadcastable boolean mask [.,.,Q,T]; fp32 softmax.  Shared by the
+    llama prefill and the speculative chunk verify so masking/precision
+    semantics cannot drift between them."""
+    rep = q.shape[2] // k.shape[2]
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+    p = jax.nn.softmax(logits, -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
 # ---------------------------------------------------------------------------
 # Llama decoder
 # ---------------------------------------------------------------------------
@@ -199,7 +215,8 @@ def quantize_llama_params(params, algo: str = "weight_only_int8"):
 
 def build_llama_decoder(cfg, max_len: int,
                         use_pallas: Optional[bool] = None,
-                        quant: Optional[str] = None):
+                        quant: Optional[str] = None,
+                        with_chunk: bool = False):
     """Same contract as :func:`build_gpt_decoder` for the Llama family
     (RMSNorm, RoPE, GQA cache [L,B,T,Hkv,D], SwiGLU, untied head).
 
@@ -279,14 +296,9 @@ def build_llama_decoder(cfg, max_len: int,
             k = mm(lp, "k_w", y).reshape(B, T0, Hkv, D)
             v = mm(lp, "v_w", y).reshape(B, T0, Hkv, D)
             q, k = apply_rope(q, k, cos, sin)
-            kr = jnp.repeat(k, H // Hkv, axis=2)
-            vr = jnp.repeat(v, H // Hkv, axis=2)
-            scale = 1.0 / math.sqrt(D)
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, kr) * scale
             mask = jnp.tril(jnp.ones((T0, T0), bool))
-            logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
-            p = jax.nn.softmax(logits, -1).astype(x.dtype)
-            attn = jnp.einsum("bhqk,bkhd->bqhd", p, vr).reshape(B, T0, -1)
+            attn = _dense_masked_attention(
+                q, k, v, mask, 1.0 / math.sqrt(D)).reshape(B, T0, -1)
             x = x + mm(lp, "o_w", attn)
             x = x + ffn(lp, rms(x, lp["ln2_w"]))
             return x, (k, v)
@@ -328,6 +340,49 @@ def build_llama_decoder(cfg, max_len: int,
                                                cache["v"]))
         return {"k": ks, "v": vs}, final_logits(params, x)
 
+    def chunk_step(params, cache, toks, pos):
+        """Verify step for speculative decoding: run ``K1`` consecutive
+        tokens (``toks`` [B, K1] at positions pos..pos+K1-1) through the
+        cached forward in ONE pass, returning per-position logits
+        [B, K1, V].  Attention is dense q-vs-cache with a per-query
+        length mask (query i sees cache[j] iff j <= pos+i), so the MXU
+        sees a K1-row matmul instead of K1 vector passes — the
+        arithmetic-intensity win speculative decoding banks on."""
+        B, K1 = toks.shape
+        blocks = _collapse_blocks(params["blocks"])
+        x = jnp.take(params["wte"], toks, axis=0)          # [B, K1, h]
+        cos = jax.lax.dynamic_slice_in_dim(cos_full, pos, K1, 0)
+        sin = jax.lax.dynamic_slice_in_dim(sin_full, pos, K1, 0)
+        jpos = jnp.arange(max_len)[None, None, None, :]
+        qpos = (pos + jnp.arange(K1))[None, None, :, None]
+        mask = jpos <= qpos                                # [1,1,K1,T]
+        scale = 1.0 / math.sqrt(D)
+
+        def body(carry, inp):
+            x = carry
+            lp, k_l, v_l = inp
+            y = rms(x, lp["ln1_w"])
+            q = mm(lp, "q_w", y).reshape(B, K1, H, D)
+            k = mm(lp, "k_w", y).reshape(B, K1, Hkv, D)
+            v = mm(lp, "v_w", y).reshape(B, K1, Hkv, D)
+            q, k = apply_rope(q, k, cos, sin)
+            k_l = jax.lax.dynamic_update_slice(k_l, k, (0, pos, 0, 0))
+            v_l = jax.lax.dynamic_update_slice(v_l, v, (0, pos, 0, 0))
+            attn = _dense_masked_attention(
+                q, k_l, v_l, mask, scale).reshape(B, K1, -1)
+            x = x + mm(lp, "o_w", attn)
+            x = x + ffn(lp, rms(x, lp["ln2_w"]))
+            return x, (k_l, v_l)
+
+        x, (ks, vs) = jax.lax.scan(body, x, (blocks, cache["k"],
+                                             cache["v"]))
+        xf = rms(x, params["lnf_w"])
+        logits = jnp.einsum("bkh,hv->bkv", xf, params["head"],
+                            preferred_element_type=jnp.float32)
+        return {"k": ks, "v": vs}, logits
+
+    if with_chunk:
+        return prefill, step, chunk_step
     return prefill, step
 
 
@@ -400,6 +455,109 @@ def _generate(decoder_builder, cfg, params, input_ids, max_new_tokens,
         _RUN_CACHE.popitem(last=False)
     new = run(params, ids, jax.random.key(seed))
     return jnp.concatenate([ids.astype(new.dtype), new], axis=1)
+
+
+def llama_speculative_generate(params, cfg, draft_params, draft_cfg,
+                               input_ids, max_new_tokens: int, *,
+                               num_draft: int = 4,
+                               use_pallas: Optional[bool] = None):
+    """Greedy speculative decoding (Leviathan et al. 2023, greedy case):
+    a small DRAFT model proposes ``num_draft`` tokens per round; the
+    target model scores all of them in ONE chunk_step (K+1-row matmuls
+    instead of K+1 vector decodes) and accepts the longest matching
+    prefix plus its own correction token.
+
+    Greedy acceptance means every emitted token is an argmax of the
+    TARGET's chunk logits, so the output equals a greedy rollout of the
+    target evaluated with the chunked (dense-masked) attention — the
+    draft changes speed, never content.  Agreement with llama_generate's
+    single-token decode path additionally requires the two attention
+    evaluations to agree at argmax, which holds except on floating-point
+    near-ties (real models; random-init weights sit near ties often).
+
+    Batch 1 only: acceptance length is data-dependent per sequence, so
+    rows would need divergent cache positions.  Returns
+    ([1, T0 + max_new_tokens] ids, stats dict with rounds/accept rate).
+    """
+    ids = jnp.asarray(input_ids)
+    B, T0 = ids.shape
+    if B != 1:
+        raise NotImplementedError(
+            "speculative decoding serves one sequence at a time "
+            "(per-row acceptance lengths diverge cache positions)")
+    if max_new_tokens <= 0:
+        return ids, {"rounds": 0, "accepted_drafts": 0,
+                     "proposed": 0, "accept_rate": 0.0}
+    K = int(num_draft)
+    max_len = T0 + max_new_tokens + K + 1   # slack for overshoot writes
+    for c in (cfg, draft_cfg):
+        mp = getattr(c, "max_position_embeddings", None)
+        if mp is not None and max_len > mp:
+            raise ValueError(
+                f"speculative window needs {max_len} positions, config "
+                f"allows {mp} (prompt {T0} + new {max_new_tokens} + "
+                f"draft slack {K + 1})")
+
+    # reuse jitted closures across calls (same keyed-cache policy as
+    # _generate's _RUN_CACHE — a serving loop must not recompile four
+    # decoder programs per request)
+    ck = ("spec", repr(cfg), repr(draft_cfg), max_len, use_pallas)
+    cached = _RUN_CACHE.get(ck)
+    if cached is None:
+        prefill_t, _, chunk_t = build_llama_decoder(
+            cfg, max_len, use_pallas=use_pallas, with_chunk=True)
+        prefill_d, step_d = build_llama_decoder(draft_cfg, max_len,
+                                                use_pallas=use_pallas)
+        cached = (jax.jit(prefill_t), jax.jit(chunk_t),
+                  jax.jit(prefill_d), jax.jit(step_d))
+        _RUN_CACHE[ck] = cached
+        while len(_RUN_CACHE) > _RUN_CACHE_MAX:
+            _RUN_CACHE.popitem(last=False)
+    else:
+        _RUN_CACHE.move_to_end(ck)
+    jprefill_t, jchunk, jprefill_d, jstep_d = cached
+
+    t_cache, t_logits = jprefill_t(params, ids)
+    d_cache, _ = jprefill_d(draft_params, ids)
+    last = jnp.argmax(t_logits, -1).astype(jnp.int32)     # [1]
+
+    out = [int(last[0])]
+    pos = T0            # next unwritten target-cache position == seq len
+    rounds = accepted = proposed = 0
+    while len(out) < max_new_tokens:
+        # draft proposes K tokens (positions pos .. pos+K-1)
+        props = []
+        dtok = last
+        for i in range(K):
+            d_cache, dl = jstep_d(draft_params, d_cache, dtok,
+                                  jnp.int32(pos + i))
+            dtok = jnp.argmax(dl, -1).astype(jnp.int32)
+            props.append(dtok)
+        # target verifies [last, d1..dK] in one pass at positions
+        # pos..pos+K; argmax[i] is the target's token AFTER chunk[i]
+        chunk = jnp.stack([last] + props, axis=1)          # [1, K+1]
+        t_cache, cl = jchunk(params, t_cache, chunk, jnp.int32(pos))
+        tgt = np.asarray(jnp.argmax(cl, -1))[0]            # [K+1]
+        props_np = [int(p[0]) for p in props]
+        n = 0
+        while n < K and props_np[n] == int(tgt[n]) \
+                and len(out) + n + 1 < max_new_tokens:
+            n += 1
+        new_toks = props_np[:n] + [int(tgt[n])]
+        out.extend(new_toks)
+        rounds += 1
+        accepted += n
+        proposed += K
+        pos += n + 1
+        last = jnp.asarray([new_toks[-1]], jnp.int32)
+        # draft cache: positions pos.. hold rejected-token KV; they are
+        # masked until overwritten, so only the position counter resets
+
+    toks = jnp.asarray([out[:max_new_tokens]], ids.dtype)
+    stats = {"rounds": rounds, "accepted_drafts": accepted,
+             "proposed": proposed,
+             "accept_rate": round(accepted / max(proposed, 1), 4)}
+    return jnp.concatenate([ids, toks], axis=1), stats
 
 
 def gpt_generate(params, cfg, input_ids, max_new_tokens: int, **kw):

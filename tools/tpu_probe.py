@@ -90,6 +90,7 @@ def seize(tag=""):
         return
     suffix = f"_{tag}" if tag else ""
     tdir = os.path.dirname(os.path.abspath(__file__))
+    suite_t0 = time.time()
     results = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                "tag": tag, "status": "in_progress"}
     # claim the sentinel BEFORE the multi-hour suite: overlapping probe
@@ -215,7 +216,13 @@ def seize(tag=""):
         # whole working tree (edits may be in progress)
         artifacts = ["BASELINE.md", os.path.relpath(sentinel, REPO),
                      "tools/tpu_probe.log"]
-        if os.path.exists(os.path.join(tdir, "autotune_cache.json")):
+        # commit the autotune table only if THIS suite wrote it (the env
+        # default points here unless the operator overrode it, and a
+        # stale file from an aborted run must not pass as fresh evidence)
+        at_cache = os.path.join(tdir, "autotune_cache.json")
+        if (os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE", at_cache)
+                == at_cache and os.path.exists(at_cache)
+                and os.path.getmtime(at_cache) >= suite_t0):
             artifacts.append("tools/autotune_cache.json")
         # exact names this run wrote — a glob would sweep in stale
         # artifacts left behind by aborted runs of OTHER tags
