@@ -277,8 +277,11 @@ def build_llama_decoder(cfg, max_len: int,
         return (x * jax.lax.rsqrt(ms + eps).astype(x.dtype)) * w
 
     def final_logits(params, x):
+        """Final RMSNorm + untied head for [B, h] or [B, K, h] — the
+        chunk verify and single-token paths share ONE head so logits
+        semantics cannot drift between them."""
         x = rms(x, params["lnf_w"])
-        return jnp.einsum("bh,hv->bv", x, params["head"],
+        return jnp.einsum("...h,hv->...v", x, params["head"],
                           preferred_element_type=jnp.float32)
 
     cos_full, sin_full = _rope_cos_sin(max_len, D, cfg.rope_theta,
@@ -376,10 +379,7 @@ def build_llama_decoder(cfg, max_len: int,
 
         x, (ks, vs) = jax.lax.scan(body, x, (blocks, cache["k"],
                                              cache["v"]))
-        xf = rms(x, params["lnf_w"])
-        logits = jnp.einsum("bkh,hv->bkv", xf, params["head"],
-                            preferred_element_type=jnp.float32)
-        return {"k": ks, "v": vs}, logits
+        return {"k": ks, "v": vs}, final_logits(params, x)
 
     if with_chunk:
         return prefill, step, chunk_step
@@ -538,7 +538,7 @@ def llama_speculative_generate(params, cfg, draft_params, draft_cfg,
         chunk = jnp.stack([last] + props, axis=1)          # [1, K+1]
         t_cache, cl = jchunk(params, t_cache, chunk, jnp.int32(pos))
         tgt = np.asarray(jnp.argmax(cl, -1))[0]            # [K+1]
-        props_np = [int(p[0]) for p in props]
+        props_np = np.asarray(chunk)[0, 1:].tolist()   # one host sync
         n = 0
         while n < K and props_np[n] == int(tgt[n]) \
                 and len(out) + n + 1 < max_new_tokens:
@@ -548,10 +548,18 @@ def llama_speculative_generate(params, cfg, draft_params, draft_cfg,
         rounds += 1
         accepted += n
         proposed += K
+        if n == K:
+            # full acceptance: d_K was proposed but never PROCESSED by
+            # the draft (its inputs were last, d_1..d_{K-1}); feed it at
+            # pos+K or a permanent zero-KV hole forms there — the draft
+            # would silently degrade more the better it predicts
+            d_cache, _ = jstep_d(draft_params, d_cache,
+                                 jnp.asarray([props_np[K - 1]], jnp.int32),
+                                 jnp.int32(pos + K))
         pos += n + 1
         last = jnp.asarray([new_toks[-1]], jnp.int32)
-        # draft cache: positions pos.. hold rejected-token KV; they are
-        # masked until overwritten, so only the position counter resets
+        # draft cache now covers every position < pos; slots >= pos hold
+        # rejected-token KV, masked until the next proposals overwrite
 
     toks = jnp.asarray([out[:max_new_tokens]], ids.dtype)
     stats = {"rounds": rounds, "accepted_drafts": accepted,

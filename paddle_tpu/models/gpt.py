@@ -486,7 +486,7 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                           ("ffn_size", cfg.ffn_size)):
             if val % mp != 0:
                 raise ValueError(f"{name}={val} not divisible by mp={mp}")
-    if cp_mode not in (None, "ring", "ulysses"):
+    if cp_mode not in (None, "ring", "ulysses", "zigzag"):
         raise ValueError(f"unknown cp_mode {cp_mode!r}")
     if tp_overlap and not (sequence_parallel and mp > 1):
         # the ring decomposes the SP gather/scatter around each matmul;
@@ -502,10 +502,14 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
 
     if sep > 1:
         from ..parallel.context_parallel import (
-            ring_flash_attention, ulysses_attention)
+            ring_flash_attention, ulysses_attention,
+            zigzag_ring_flash_attention)
         if cp_mode == "ring":
             def cp_attn(q, k, v):
                 return ring_flash_attention(q, k, v, SEP_AXIS, True)
+        elif cp_mode == "zigzag":
+            def cp_attn(q, k, v):
+                return zigzag_ring_flash_attention(q, k, v, SEP_AXIS)
         else:
             def cp_attn(q, k, v):
                 return ulysses_attention(q, k, v, SEP_AXIS, True)
@@ -570,7 +574,13 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
     def embed_fn(params, ids):
         s_l = ids.shape[1]
         x = man.vocab_parallel_embedding(ids, params["wte"])
-        pos = jax.lax.axis_index(SEP_AXIS) * s_l + jnp.arange(s_l)
+        if cp_mode == "zigzag":
+            # zigzag CP: this rank holds original blocks (i, 2R-1-i) —
+            # learned position embeddings must use ORIGINAL positions
+            from ..parallel.context_parallel import zigzag_positions
+            pos = zigzag_positions(s_l, SEP_AXIS)
+        else:
+            pos = jax.lax.axis_index(SEP_AXIS) * s_l + jnp.arange(s_l)
         x = x + jnp.take(params["wpe"], pos, axis=0)[None]
         if sp:   # activations between blocks keep seq sharded over mp
             x = scatter_op(x, MP_AXIS)

@@ -572,7 +572,7 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
                           ("intermediate_size", cfg.intermediate_size)):
             if val % mp != 0:
                 raise ValueError(f"{name}={val} not divisible by mp={mp}")
-    if cp_mode not in (None, "ring", "ulysses"):
+    if cp_mode not in (None, "ring", "ulysses", "zigzag"):
         raise ValueError(f"unknown cp_mode {cp_mode!r}")
     if tp_overlap and not (sequence_parallel and mp > 1):
         raise ValueError("tp_overlap=True requires sequence_parallel=True "
@@ -584,10 +584,14 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
 
     if sep > 1:
         from ..parallel.context_parallel import (
-            ring_flash_attention, ulysses_attention)
+            ring_flash_attention, ulysses_attention,
+            zigzag_ring_flash_attention)
         if cp_mode == "ring":
             def cp_attn(q, k, v):
                 return ring_flash_attention(q, k, v, SEP_AXIS, True)
+        elif cp_mode == "zigzag":
+            def cp_attn(q, k, v):
+                return zigzag_ring_flash_attention(q, k, v, SEP_AXIS)
         else:
             def cp_attn(q, k, v):
                 return ulysses_attention(q, k, v, SEP_AXIS, True)
@@ -651,11 +655,16 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
         return x
 
     def step_ctx_fn(s_l):
-        # rope table for this sep shard's global positions
-        # [sidx*s_l, (sidx+1)*s_l) — computed once per step, hoisted out of
-        # the per-layer scan (and out of the remat backward) via step_ctx.
+        # rope table for this sep shard's ORIGINAL global positions —
+        # contiguous [sidx*s_l, (sidx+1)*s_l), or the two zigzag blocks
+        # (i, 2R-1-i) — computed once per step, hoisted out of the
+        # per-layer scan (and out of the remat backward) via step_ctx.
         cos, sin = _rope_cos_sin(s_l * sep, cfg.head_dim, cfg.rope_theta,
                                  jnp.dtype(cfg.dtype))
+        if cp_mode == "zigzag":
+            from ..parallel.context_parallel import zigzag_positions
+            pos = zigzag_positions(s_l, SEP_AXIS)
+            return jnp.take(cos, pos, 0), jnp.take(sin, pos, 0)
         sidx = jax.lax.axis_index(SEP_AXIS)
         lcos = jax.lax.dynamic_slice_in_dim(cos, sidx * s_l, s_l, 0)
         lsin = jax.lax.dynamic_slice_in_dim(sin, sidx * s_l, s_l, 0)

@@ -15,7 +15,8 @@ import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.parallel.context_parallel import (
-    ring_flash_attention, ulysses_attention)
+    ring_flash_attention, ulysses_attention, zigzag_permutation,
+    zigzag_positions, zigzag_ring_flash_attention)
 
 B, S, H, D = 2, 64, 4, 8
 CP = 4
@@ -135,3 +136,72 @@ def test_ring_bf16_runs():
         check_vma=False))(q, k, v)
     assert out.dtype == jnp.bfloat16
     assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
+class TestZigzag:
+    def test_permutation_and_positions_agree(self):
+        """zigzag_positions == the slice of zigzag_permutation this rank
+        receives under contiguous sharding of the permuted sequence."""
+        R, S = 4, 64
+        perm = zigzag_permutation(S, R)
+        assert sorted(perm.tolist()) == list(range(S))
+        s_l = S // R
+        mesh = Mesh(np.array(jax.devices()[:R]).reshape(R), ("sep",))
+        pos = jax.jit(jax.shard_map(
+            lambda: zigzag_positions(s_l, "sep")[None],
+            mesh=mesh, in_specs=(), out_specs=P("sep"),
+            check_vma=False))()
+        np.testing.assert_array_equal(np.asarray(pos).reshape(-1), perm)
+
+    def test_zigzag_matches_reference(self):
+        """Balanced zigzag ring == full causal attention on the
+        un-permuted sequence (output AND grads)."""
+        q, k, v = _rand()
+        perm = zigzag_permutation(S, CP)
+        inv = np.argsort(perm)
+        mesh = _mesh()
+        spec = P(None, "sep", None, None)
+        sharded = jax.jit(jax.shard_map(
+            lambda q, k, v: zigzag_ring_flash_attention(q, k, v, "sep"),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False))
+
+        def loss_zz(q, k, v):
+            out_p = sharded(q[:, perm], k[:, perm], v[:, perm])
+            return jnp.sum(jnp.sin(out_p[:, inv].astype(jnp.float32)))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(jnp.sin(_ref_attention(q, k, v, True)))
+
+        out = sharded(q[:, perm], k[:, perm], v[:, perm])[:, inv]
+        ref = _ref_attention(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        g_zz = jax.jit(jax.grad(loss_zz, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g_zz, g_ref, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=f"d{name} (zigzag)")
+
+    def test_zigzag_gqa(self):
+        Hkv = 2
+        key = jax.random.key(2)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (B, S, H, D), jnp.float32)
+        k = jax.random.normal(kk, (B, S, Hkv, D), jnp.float32)
+        v = jax.random.normal(kv, (B, S, Hkv, D), jnp.float32)
+        perm = zigzag_permutation(S, CP)
+        inv = np.argsort(perm)
+        mesh = _mesh()
+        spec = P(None, "sep", None, None)
+        sharded = jax.jit(jax.shard_map(
+            lambda q, k, v: zigzag_ring_flash_attention(q, k, v, "sep"),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False))
+        out = sharded(q[:, perm], k[:, perm], v[:, perm])[:, inv]
+        kr = jnp.repeat(k, H // Hkv, axis=2)
+        vr = jnp.repeat(v, H // Hkv, axis=2)
+        ref = _ref_attention(q, kr, vr, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)

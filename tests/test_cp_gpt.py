@@ -54,3 +54,57 @@ def test_bad_cp_mode_raises():
                     num_heads=2, max_position_embeddings=32)
     with pytest.raises(ValueError, match="cp_mode"):
         build_gpt_train_step(cfg, topo, cp_mode="ulises")
+
+
+def test_gpt_zigzag_cp_matches_no_cp():
+    """Zigzag (load-balanced) CP: feed ids/labels permuted by
+    zigzag_permutation; positions/attention restore ORIGINAL order
+    internally, so the loss must equal the un-permuted no-CP run (token
+    losses are permutation-invariant)."""
+    from paddle_tpu.parallel.context_parallel import zigzag_permutation
+    base = _run(None, 1)
+
+    topo = dist.init_topology(dp=1, mp=1, pp=1, sep=4, sharding=1)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position_embeddings=64)
+    step_fn, init_fn = build_gpt_train_step(cfg, topo, num_microbatches=1,
+                                            cp_mode="zigzag")
+    state = init_fn(0)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (4, 64)).astype(np.int64)
+    labels = np.roll(ids, -1, axis=1)
+    perm = zigzag_permutation(64, 4)
+    losses = []
+    for _ in range(3):
+        state, loss = step_fn(state, ids[:, perm], labels[:, perm])
+        losses.append(float(np.asarray(jax.device_get(loss))))
+    np.testing.assert_allclose(losses, base, rtol=2e-4, atol=1e-5)
+
+
+def test_llama_zigzag_cp_matches_no_cp():
+    """Same pin for the Llama builder (rope tables gathered at the
+    zigzag blocks' original positions)."""
+    from paddle_tpu.models.llama import llama_tiny, build_llama_train_step
+    from paddle_tpu.parallel.context_parallel import zigzag_permutation
+
+    def run(cp_mode, sep, permute):
+        topo = dist.init_topology(dp=1, mp=1, pp=1, sep=sep, sharding=1)
+        cfg = llama_tiny()
+        step_fn, init_fn = build_llama_train_step(
+            cfg, topo, num_microbatches=1, cp_mode=cp_mode)
+        state = init_fn(0)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (4, 64)).astype(np.int64)
+        labels = np.roll(ids, -1, axis=1)
+        if permute:
+            perm = zigzag_permutation(64, sep)
+            ids, labels = ids[:, perm], labels[:, perm]
+        out = []
+        for _ in range(3):
+            state, loss = step_fn(state, ids, labels)
+            out.append(float(np.asarray(jax.device_get(loss))))
+        return out
+
+    base = run(None, 1, False)
+    zz = run("zigzag", 4, True)
+    np.testing.assert_allclose(zz, base, rtol=2e-4, atol=1e-5)
