@@ -22,7 +22,16 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorHandle",
-           "LLMPredictor", "create_llm_predictor"]
+           "LLMPredictor", "create_llm_predictor",
+           "ContinuousBatchingEngine"]
+
+
+def __getattr__(name):
+    # lazy: the serving engine pulls in the decode stack
+    if name == "ContinuousBatchingEngine":
+        from .serving import ContinuousBatchingEngine
+        return ContinuousBatchingEngine
+    raise AttributeError(name)
 
 
 class Config:

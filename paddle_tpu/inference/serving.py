@@ -1,0 +1,287 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+Iteration-level scheduling (the vLLM recipe) on TPU terms: ONE jitted
+decode step advances every active sequence in a fixed-size batch; between
+steps the host scheduler admits queued requests into free slots, maps
+pages from the shared block pool, and retires finished sequences —
+requests join and leave the batch without recompilation (all shapes are
+static: [max_batch] tokens/lengths, [max_batch, max_blocks] tables).
+
+Relation to the reference: its serving stack is fused ops driven by an
+external server (fused_multi_transformer + block_multihead_attention,
+SURVEY §2.6); the block/page machinery here is ops/paged_kv.py (same
+design as the reference's block attention), and this module adds the
+in-framework scheduler the reference leaves to the serving layer.
+
+Greedy decoding only (batched sampling would need per-slot RNG streams);
+per-sequence results are independent of WHO ELSE shares the batch —
+pinned by tests/test_serving_engine.py against a batch-of-one engine.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import _rotate_half
+from ..ops.paged_kv import BlockAllocator, paged_append, \
+    paged_decode_attention
+
+__all__ = ["ContinuousBatchingEngine", "GenRequest"]
+
+
+@dataclass
+class GenRequest:
+    req_id: int
+    prompt: np.ndarray                 # [T0] int32
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    out: List[int] = field(default_factory=list)
+    slot: Optional[int] = None         # batch slot while active
+
+
+class ContinuousBatchingEngine:
+    """Llama-family continuous-batching engine (greedy).
+
+    Args:
+      cfg: LlamaConfig (dense or MoE — the FFN follows the config).
+      params: train-step param pytree (wte/head/lnf_w + stacked blocks).
+      max_batch: decode-batch slots (static jit shape).
+      block_size / num_blocks: shared KV page pool geometry.
+      max_blocks_per_seq: page-table width per slot (caps per-sequence
+        length at block_size * max_blocks_per_seq).
+    """
+
+    def __init__(self, cfg, params, *, max_batch: int = 4,
+                 block_size: int = 16, num_blocks: int = 256,
+                 max_blocks_per_seq: Optional[int] = None):
+        if getattr(cfg, "moe_num_experts", 0) and \
+                getattr(cfg, "moe_router", "topk") != "topk":
+            raise NotImplementedError("decode serves token-choice only")
+        self.cfg = cfg
+        self.params = params
+        self.B = max_batch
+        self.BS = block_size
+        self.MB = max_blocks_per_seq or \
+            -(-cfg.max_position_embeddings // block_size)
+        L = cfg.num_layers
+        kvh, hd = cfg.kv_heads, cfg.head_dim
+        dt = jnp.dtype(cfg.dtype)
+        self.pool_k = jnp.zeros((L, num_blocks, block_size, kvh, hd), dt)
+        self.pool_v = jnp.zeros_like(self.pool_k)
+        self.block_table = np.full((max_batch, self.MB), -1, np.int32)
+        self.lengths = np.zeros((max_batch,), np.int32)
+        self.tokens = np.zeros((max_batch,), np.int32)
+        self.alloc = BlockAllocator(num_blocks)
+        self.slots: List[Optional[GenRequest]] = [None] * max_batch
+        self.queue: "collections.deque[GenRequest]" = collections.deque()
+        self.finished: Dict[int, np.ndarray] = {}
+        self._next_id = 0
+        self._step = jax.jit(self._build_step())
+        self._prefill_cache: Dict[int, object] = {}
+        self.last_logits: Optional[np.ndarray] = None   # [B, V] debug/test
+
+    # ------------------------------------------------------------------
+    # compiled per-iteration decode over every slot
+    # ------------------------------------------------------------------
+    def _build_step(self):
+        cfg = self.cfg
+        from ..models.llama import _rope_cos_sin
+        from ..models.generation import _collapse_blocks
+        H, Hkv, D = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        eps = cfg.rms_norm_eps
+        BS = self.BS
+        cos_full, sin_full = _rope_cos_sin(
+            cfg.max_position_embeddings, D, cfg.rope_theta,
+            jnp.dtype(cfg.dtype))
+        moe = getattr(cfg, "moe_num_experts", 0)
+
+        def rms(x, w):
+            ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1,
+                          keepdims=True)
+            return (x * jax.lax.rsqrt(ms + eps).astype(x.dtype)) * w
+
+        def ffn(lp, y):
+            if moe:
+                from ..parallel.moe import moe_swiglu_ffn_grouped
+                out = moe_swiglu_ffn_grouped(
+                    y, lp["router_w"], lp["e_gate"], lp["e_up"],
+                    lp["e_down"], top_k=cfg.moe_top_k)
+                if getattr(cfg, "moe_num_shared_experts", 0):
+                    out = out + (jax.nn.silu(y @ lp["s_gate"])
+                                 * (y @ lp["s_up"])) @ lp["s_down"]
+                return out
+            return (jax.nn.silu(y @ lp["gate_w"])
+                    * (y @ lp["up_w"])) @ lp["down_w"]
+
+        def step(params, pool_k, pool_v, bt, lengths, tokens):
+            B = tokens.shape[0]
+            blocks = _collapse_blocks(params["blocks"])
+            x = jnp.take(params["wte"], tokens, axis=0)       # [B, h]
+            # per-slot rope position = current length (0-based slot of
+            # the incoming token)
+            cos = jnp.take(cos_full, lengths, axis=0)         # [B, D]
+            sin = jnp.take(sin_full, lengths, axis=0)
+
+            def rope1(t):                                     # [B, h?, D]
+                return t * cos[:, None, :] \
+                    + _rotate_half(t) * sin[:, None, :]
+
+            def body(carry, inp):
+                x = carry
+                lp, pk, pv = inp
+                y = rms(x, lp["ln1_w"])
+                q = (y @ lp["q_w"]).reshape(B, H, D)
+                k = (y @ lp["k_w"]).reshape(B, Hkv, D)
+                v = (y @ lp["v_w"]).reshape(B, Hkv, D)
+                q, k = rope1(q), rope1(k)
+                pk, pv = paged_append(pk, pv, k, v, bt, lengths, BS)
+                attn = paged_decode_attention(q, pk, pv, bt, lengths + 1)
+                x = x + attn.reshape(B, -1) @ lp["o_w"]
+                x = x + ffn(lp, rms(x, lp["ln2_w"]))
+                return x, (pk, pv)
+
+            x, (pk2, pv2) = jax.lax.scan(body, x,
+                                         (blocks, pool_k, pool_v))
+            xf = rms(x, params["lnf_w"])
+            logits = jnp.einsum("bh,hv->bv", xf, params["head"],
+                                preferred_element_type=jnp.float32)
+            return pk2, pv2, logits
+
+        return step
+
+    # ------------------------------------------------------------------
+    # host-side scheduler
+    # ------------------------------------------------------------------
+    def add_request(self, prompt_ids, max_new_tokens: int,
+                    eos_token_id: Optional[int] = None) -> int:
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        total = len(prompt) + max_new_tokens
+        if total > self.MB * self.BS:
+            raise ValueError(f"request needs {total} tokens, engine caps "
+                             f"at {self.MB * self.BS} per sequence")
+        if self._blocks_needed(total) > self.alloc.num_blocks:
+            raise ValueError(
+                f"request needs {self._blocks_needed(total)} pages, the "
+                f"whole pool has {self.alloc.num_blocks} — it could never "
+                f"admit (raise num_blocks or shrink the request)")
+        if total > self.cfg.max_position_embeddings:
+            raise ValueError("request exceeds max_position_embeddings")
+        req = GenRequest(self._next_id, prompt, max_new_tokens,
+                         eos_token_id)
+        self._next_id += 1
+        self.queue.append(req)
+        return req.req_id
+
+    def _blocks_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.BS)
+
+    def _admit(self) -> None:
+        """Admit queued requests into free slots while pages allow —
+        prefill runs densely once per request, then its KV moves into
+        the pool pages."""
+        from ..models.generation import build_llama_decoder
+        for slot in range(self.B):
+            if not self.queue or self.slots[slot] is not None:
+                continue
+            req = self.queue[0]
+            total = len(req.prompt) + req.max_new_tokens
+            need = self._blocks_needed(total)
+            if need > self.alloc.free_blocks:
+                break                      # head-of-line waits for pages
+            self.queue.popleft()
+            phys = self.alloc.allocate(("slot", slot), need)
+            self.block_table[slot, :] = -1
+            self.block_table[slot, :need] = phys
+            T0 = len(req.prompt)
+            # dense prefill, jitted once per distinct prompt length
+            jprefill = self._prefill_cache.get(T0)
+            if jprefill is None:
+                prefill, _ = build_llama_decoder(self.cfg, T0,
+                                                 use_pallas=False)
+                jprefill = jax.jit(prefill)
+                self._prefill_cache[T0] = jprefill
+            cache, logits = jprefill(self.params, req.prompt[None, :])
+            # move prompt KV into the pool pages ON DEVICE — only the
+            # admitted request's pages are touched (a host round trip of
+            # the whole pool would stall every admission)
+            kc, vc = cache["k"][:, 0], cache["v"][:, 0]  # [L, T0, Hkv, D]
+            for b in range(self._blocks_needed(T0)):
+                lo, hi = b * self.BS, min((b + 1) * self.BS, T0)
+                self.pool_k = self.pool_k.at[:, phys[b], :hi - lo].set(
+                    kc[:, lo:hi].astype(self.pool_k.dtype))
+                self.pool_v = self.pool_v.at[:, phys[b], :hi - lo].set(
+                    vc[:, lo:hi].astype(self.pool_v.dtype))
+            first = int(np.asarray(jnp.argmax(logits, -1))[0])
+            req.out.append(first)
+            req.slot = slot
+            self.slots[slot] = req
+            self.lengths[slot] = T0
+            self.tokens[slot] = first
+
+    def _retire_done(self) -> None:
+        for s in range(self.B):
+            req = self.slots[s]
+            if req is not None and (
+                    len(req.out) >= req.max_new_tokens
+                    or (req.eos_token_id is not None and req.out
+                        and req.eos_token_id in req.out)):
+                # truncate anything after the first eos
+                if req.eos_token_id is not None \
+                        and req.eos_token_id in req.out:
+                    req.out = req.out[:req.out.index(req.eos_token_id) + 1]
+                self._retire(s)
+
+    def _retire(self, slot: int) -> None:
+        req = self.slots[slot]
+        self.finished[req.req_id] = np.concatenate(
+            [req.prompt, np.asarray(req.out, np.int32)])
+        self.alloc.release(("slot", slot))
+        self.block_table[slot, :] = -1
+        self.lengths[slot] = 0
+        self.slots[slot] = None
+
+    def step(self) -> Dict[int, np.ndarray]:
+        """One scheduler iteration: admit, decode every active slot,
+        collect tokens, retire finished.  Returns newly finished
+        {req_id: full ids} (empty dict when idle)."""
+        # retire first so freed slots/pages admit this very iteration;
+        # then AGAIN after admission — the prefill's first token can
+        # already satisfy the budget (max_new_tokens=1) or hit eos, and
+        # such a request must not enter the decode batch
+        self._retire_done()
+        self._admit()
+        self._retire_done()
+        active = [s for s in range(self.B) if self.slots[s] is not None]
+        if not active:
+            self.last_logits = None     # nothing decoded this iteration
+            out = self.finished
+            self.finished = {}
+            return out
+        self.pool_k, self.pool_v, logits = self._step(
+            self.params, self.pool_k, self.pool_v,
+            jnp.asarray(self.block_table), jnp.asarray(self.lengths),
+            jnp.asarray(self.tokens))
+        self.last_logits = np.asarray(logits)
+        nxt = np.asarray(jnp.argmax(logits, -1).astype(jnp.int32))
+        for s in active:
+            req = self.slots[s]
+            self.lengths[s] += 1            # the fed token's KV is stored
+            req.out.append(int(nxt[s]))
+            self.tokens[s] = int(nxt[s])
+        out = self.finished
+        self.finished = {}
+        return out
+
+    def run_to_completion(self) -> Dict[int, np.ndarray]:
+        """Drive steps until queue and batch drain; returns all results."""
+        results: Dict[int, np.ndarray] = {}
+        while self.queue or any(s is not None for s in self.slots):
+            results.update(self.step())
+        return results
