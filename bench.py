@@ -263,6 +263,47 @@ def run_config_bench(config: str):
                       "model": f"gpt-moe h{cfg.hidden_size} "
                                f"L{cfg.num_layers} E{cfg.moe_num_experts}"},
         }
+    elif config == "serve":
+        # continuous-batching engine throughput: staggered requests
+        # through the paged-KV scheduler (inference/serving.py) — the
+        # serving-side metric the single-rollout decode row doesn't cover
+        from paddle_tpu.models.llama import (build_llama_train_step,
+                                             llama_7b, llama_tiny)
+        from paddle_tpu.inference.serving import ContinuousBatchingEngine
+        from paddle_tpu import parallel as dist
+        if on_accel:
+            cfg = llama_7b(dtype="bfloat16", num_layers=4)
+            n_req, t0, new, mb = 8, 128, 96, 4
+        else:
+            cfg = llama_tiny()
+            n_req, t0, new, mb = 3, 8, 6, 2
+        topo = dist.init_topology(devices=devices[:1])
+        _, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1)
+        params = init_fn(0)["params"]
+        eng = ContinuousBatchingEngine(
+            cfg, params, max_batch=mb, block_size=16,
+            num_blocks=max(64, mb * ((t0 + new) // 16 + 2)))
+        for i in range(n_req):
+            eng.add_request(
+                rng.integers(0, cfg.vocab_size, (t0,)).astype(np.int32),
+                new)
+        # warm the compiles with one scheduler iteration; tokens
+        # produced before t_start are excluded from the rate
+        eng.step()
+        warm = sum(len(r.out) for r in eng.slots if r is not None)
+        t_start = time.perf_counter()
+        results = eng.run_to_completion()
+        dt = time.perf_counter() - t_start
+        total_new = sum(len(v) - t0 for v in results.values()) - warm
+        out = {
+            "metric": "llama_serve_tokens_per_sec_per_chip",
+            "value": round(total_new / dt, 1),
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "extra": {"requests": n_req, "prompt": t0, "new_tokens": new,
+                      "max_batch": mb, "device": str(devices[0]),
+                      "model": "llama_7b-width L4 proxy serving"
+                               if on_accel else "llama_tiny CPU proxy"},
+        }
     elif config == "decode":
         # inference: autoregressive decode through the KV-cache decoder
         # (prefill + lax.scan step loop; Pallas MMHA on TPU) — the

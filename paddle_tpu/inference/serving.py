@@ -21,7 +21,6 @@ pinned by tests/test_serving_engine.py against a batch-of-one engine.
 from __future__ import annotations
 
 import collections
-import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -43,7 +42,6 @@ class GenRequest:
     max_new_tokens: int
     eos_token_id: Optional[int] = None
     out: List[int] = field(default_factory=list)
-    slot: Optional[int] = None         # batch slot while active
 
 
 class ContinuousBatchingEngine:
@@ -56,6 +54,12 @@ class ContinuousBatchingEngine:
       block_size / num_blocks: shared KV page pool geometry.
       max_blocks_per_seq: page-table width per slot (caps per-sequence
         length at block_size * max_blocks_per_seq).
+
+    The engine keeps its own page table rather than reusing
+    ops/paged_kv.PagedKVCache: that class sizes its table [B, num_blocks]
+    (every slot could own the whole pool), while the decode gather cost
+    scales with TABLE WIDTH — the engine's [B, max_blocks_per_seq] table
+    keeps the per-step gather at the per-sequence cap, not the pool size.
     """
 
     def __init__(self, cfg, params, *, max_batch: int = 4,
@@ -83,7 +87,10 @@ class ContinuousBatchingEngine:
         self.queue: "collections.deque[GenRequest]" = collections.deque()
         self.finished: Dict[int, np.ndarray] = {}
         self._next_id = 0
-        self._step = jax.jit(self._build_step())
+        # pools are donated: the decode step rewrites them every
+        # iteration and the old buffers must not stay live
+        self._step = jax.jit(self._build_step(),
+                             donate_argnums=(1, 2))
         self._prefill_cache: Dict[int, object] = {}
         self.last_logits: Optional[np.ndarray] = None   # [B, V] debug/test
 
@@ -162,6 +169,9 @@ class ContinuousBatchingEngine:
     def add_request(self, prompt_ids, max_new_tokens: int,
                     eos_token_id: Optional[int] = None) -> int:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1 (the prefill "
+                             "argmax is already one generated token)")
         total = len(prompt) + max_new_tokens
         if total > self.MB * self.BS:
             raise ValueError(f"request needs {total} tokens, engine caps "
@@ -208,19 +218,30 @@ class ContinuousBatchingEngine:
                 jprefill = jax.jit(prefill)
                 self._prefill_cache[T0] = jprefill
             cache, logits = jprefill(self.params, req.prompt[None, :])
-            # move prompt KV into the pool pages ON DEVICE — only the
-            # admitted request's pages are touched (a host round trip of
-            # the whole pool would stall every admission)
+            # move prompt KV into the pool pages ON DEVICE with ONE
+            # scatter per pool (a per-block loop would dispatch a full
+            # pool-sized update per page; a host round trip would stall
+            # every admission).  The padded tail of the last page holds
+            # zeros, masked by lengths.
+            nb = self._blocks_needed(T0)
+            pad = nb * self.BS - T0
             kc, vc = cache["k"][:, 0], cache["v"][:, 0]  # [L, T0, Hkv, D]
-            for b in range(self._blocks_needed(T0)):
-                lo, hi = b * self.BS, min((b + 1) * self.BS, T0)
-                self.pool_k = self.pool_k.at[:, phys[b], :hi - lo].set(
-                    kc[:, lo:hi].astype(self.pool_k.dtype))
-                self.pool_v = self.pool_v.at[:, phys[b], :hi - lo].set(
-                    vc[:, lo:hi].astype(self.pool_v.dtype))
+            pages = np.asarray(phys[:nb])
+
+            def paged_view(x):
+                x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                return jnp.swapaxes(
+                    x.reshape(x.shape[0], nb, self.BS, *x.shape[2:]),
+                    0, 1)                          # [nb, L, BS, Hkv, D]
+
+            self.pool_k = self.pool_k.at[:, pages].set(
+                jnp.swapaxes(paged_view(kc), 0, 1)
+                .astype(self.pool_k.dtype))
+            self.pool_v = self.pool_v.at[:, pages].set(
+                jnp.swapaxes(paged_view(vc), 0, 1)
+                .astype(self.pool_v.dtype))
             first = int(np.asarray(jnp.argmax(logits, -1))[0])
             req.out.append(first)
-            req.slot = slot
             self.slots[slot] = req
             self.lengths[slot] = T0
             self.tokens[slot] = first

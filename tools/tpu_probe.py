@@ -185,7 +185,7 @@ def seize(tag=""):
         _abort_rearm("headline")
         return
     for cfg in ("lenet", "resnet50", "bert", "llama", "decode",
-                "moe"):
+                "moe", "serve"):
         results[f"bench_{cfg}"], ok = _bench(
             [sys.executable, "bench.py", "--config", cfg],
             f"bench_tpu_{cfg}{suffix}.json", 1800)
@@ -231,7 +231,7 @@ def seize(tag=""):
                     f"pytest_tpu{suffix}.log"]
         produced += [f"bench_tpu_{c}{suffix}.json"
                      for c in ("lenet", "resnet50", "bert", "llama",
-                               "decode", "moe")]
+                               "decode", "moe", "serve")]
         produced += [f + ".stderr.log" for f in list(produced)]
         artifacts += [os.path.join("tools", f) for f in produced
                       if os.path.exists(os.path.join(tdir, f))]
