@@ -228,18 +228,14 @@ class ContinuousBatchingEngine:
             kc, vc = cache["k"][:, 0], cache["v"][:, 0]  # [L, T0, Hkv, D]
             pages = np.asarray(phys[:nb])
 
-            def paged_view(x):
+            def paged_view(x):                 # [L, nb, BS, Hkv, D]
                 x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
-                return jnp.swapaxes(
-                    x.reshape(x.shape[0], nb, self.BS, *x.shape[2:]),
-                    0, 1)                          # [nb, L, BS, Hkv, D]
+                return x.reshape(x.shape[0], nb, self.BS, *x.shape[2:])
 
             self.pool_k = self.pool_k.at[:, pages].set(
-                jnp.swapaxes(paged_view(kc), 0, 1)
-                .astype(self.pool_k.dtype))
+                paged_view(kc).astype(self.pool_k.dtype))
             self.pool_v = self.pool_v.at[:, pages].set(
-                jnp.swapaxes(paged_view(vc), 0, 1)
-                .astype(self.pool_v.dtype))
+                paged_view(vc).astype(self.pool_v.dtype))
             first = int(np.asarray(jnp.argmax(logits, -1))[0])
             req.out.append(first)
             self.slots[slot] = req

@@ -24,9 +24,31 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent XLA compilation cache: the slow lane is dominated by
 # whole-model compiles on one CPU core; caching executables across test
 # processes/runs makes warm reruns minutes instead of ~an hour.  Keyed by
-# computation fingerprint, so code changes invalidate naturally.
-_cache_dir = os.environ.get("PT_TEST_COMPILE_CACHE",
-                            "/tmp/paddle_tpu_xla_cache")
+# computation fingerprint, so code changes invalidate naturally — but
+# the fingerprint does NOT cover the HOST CPU: XLA:CPU AOT executables
+# compiled on a different machine load with missing ISA features and
+# SIGSEGV/SIGILL at run time (observed: resnet conv compile crashed the
+# slow lane after the round migrated hosts).  Namespace the cache by a
+# machine fingerprint so each host keeps its own executables.
+import hashlib as _hashlib
+import platform as _platform
+
+
+def _machine_tag() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next((ln for ln in f if ln.startswith("flags")), "")
+    except OSError:
+        flags = ""
+    raw = _platform.machine() + _platform.processor() + flags
+    return _hashlib.sha1(raw.encode()).hexdigest()[:12]
+
+
+_cache_base = os.environ.get("PT_TEST_COMPILE_CACHE",
+                             "/tmp/paddle_tpu_xla_cache")
+# the machine tag applies to overrides too — a shared persistent path
+# would otherwise reintroduce the cross-host crash
+_cache_dir = f"{_cache_base}_{_machine_tag()}"
 try:
     os.makedirs(_cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", _cache_dir)

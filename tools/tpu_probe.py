@@ -20,6 +20,10 @@ SENTINEL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "tpu_seized.json")
 
 LOG = os.path.join(os.path.dirname(__file__), "tpu_probe.log")
+# one source for the bench.py --config rows the seize suite runs AND
+# whose artifacts it commits — keep these in lockstep by construction
+BENCH_CONFIGS = ("lenet", "resnet50", "bert", "llama", "decode",
+                 "moe", "serve")
 SNIPPET = (
     "import jax, json;"
     "d = jax.devices();"
@@ -184,8 +188,7 @@ def seize(tag=""):
     if not ok:
         _abort_rearm("headline")
         return
-    for cfg in ("lenet", "resnet50", "bert", "llama", "decode",
-                "moe", "serve"):
+    for cfg in BENCH_CONFIGS:
         results[f"bench_{cfg}"], ok = _bench(
             [sys.executable, "bench.py", "--config", cfg],
             f"bench_tpu_{cfg}{suffix}.json", 1800)
@@ -230,8 +233,7 @@ def seize(tag=""):
                     f"bench_sweep_tpu{suffix}.json",
                     f"pytest_tpu{suffix}.log"]
         produced += [f"bench_tpu_{c}{suffix}.json"
-                     for c in ("lenet", "resnet50", "bert", "llama",
-                               "decode", "moe", "serve")]
+                     for c in BENCH_CONFIGS]
         produced += [f + ".stderr.log" for f in list(produced)]
         artifacts += [os.path.join("tools", f) for f in produced
                       if os.path.exists(os.path.join(tdir, f))]
