@@ -58,6 +58,10 @@ try:
     # persist too or every module pays their recompiles from scratch
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # LRU-bound the directory: with the 0.0 threshold every tiny jit
+    # persists, and nothing else ever prunes /tmp caches
+    jax.config.update("jax_compilation_cache_max_size",
+                      8 * 1024 ** 3)
 except Exception:
     pass
 
