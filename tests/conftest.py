@@ -50,7 +50,9 @@ _cache_base = os.environ.get("PT_TEST_COMPILE_CACHE",
                              "/tmp/paddle_tpu_xla_cache")
 # the machine tag applies to overrides too — a shared persistent path
 # would otherwise reintroduce the cross-host crash
-_cache_dir = f"{_cache_base}_{_machine_tag()}"
+# "v2": entries written before LRU sizing lack the -atime companions
+# the eviction scan needs — a stale dir breaks every new cache write
+_cache_dir = f"{_cache_base}_{_machine_tag()}_v2"
 try:
     os.makedirs(_cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", _cache_dir)

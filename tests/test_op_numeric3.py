@@ -207,9 +207,8 @@ def _np_index_put(a, idx, val):
     return out
 
 
-@pytest.mark.parametrize("name", sorted(CASES))
-def test_numeric_matches_numpy(name):
-    op, ref = CASES[name]
+def _run_case(case):
+    op, ref = case
     got = _v(op())
     want = np.asarray(ref())
     assert got.shape == want.shape, (got.shape, want.shape)
@@ -217,6 +216,11 @@ def test_numeric_matches_numpy(name):
         np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
     else:
         np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_numeric_matches_numpy(name):
+    _run_case(CASES[name])
 
 
 # -- tuple-output / structural ops ----------------------------------------
@@ -328,3 +332,113 @@ def test_random_ops_shapes_and_stats():
     m = _v(pt.multinomial(T(np.array([0.0, 0.7, 0.3], "f4")), 64,
                           replacement=True))
     assert m.min() >= 1 and m.max() <= 2
+
+
+# -- wave 4: utility / vision / norm tails --------------------------------
+
+N4 = rng.standard_normal((1, 4, 2, 2)).astype("f4")
+W4 = rng.standard_normal((4,)).astype("f4")
+
+
+def _rms_ref(x, w, eps=1e-5):
+    ms = (x.astype("f8") ** 2).mean(-1, keepdims=True)
+    return (x / np.sqrt(ms + eps) * w).astype("f4")
+
+
+CASES4 = {
+    "isposinf": (lambda: pt.isposinf(
+        T(np.array([1.0, np.inf, -np.inf], "f4"))),
+        lambda: np.array([False, True, False])),
+    "add_n": (lambda: pt.add_n([T(A), T(B), T(A)]), lambda: A + B + A),
+    "pdist": (lambda: pt.pdist(T(A)),
+              lambda: np.array([np.linalg.norm(A[i] - A[j])
+                                for i in range(3) for j in range(i + 1, 3)],
+                               "f4")),
+    "cartesian_prod": (lambda: pt.cartesian_prod(
+        [T(V6[:2]), T(V6[2:4])]),
+        lambda: np.array([[V6[0], V6[2]], [V6[0], V6[3]],
+                          [V6[1], V6[2]], [V6[1], V6[3]]], "f4")),
+    "slice_scatter": (lambda: pt.slice_scatter(
+        T(A), T(np.ones((3, 2), "f4")), axes=[1], starts=[1], ends=[3],
+        strides=[1]),
+        lambda: np.concatenate([A[:, :1], np.ones((3, 2), "f4"),
+                                A[:, 3:]], 1)),
+    "select_scatter": (lambda: pt.select_scatter(
+        T(A), T(np.ones((4,), "f4")), 0, 1),
+        lambda: np.concatenate([A[:1], np.ones((1, 4), "f4"), A[2:]], 0)),
+    "diagonal_scatter": (lambda: pt.diagonal_scatter(
+        T(SQ), T(np.ones((4,), "f4"))),
+        lambda: SQ - np.diag(np.diag(SQ)) + np.eye(4, dtype="f4")),
+    "pixel_shuffle": (lambda: pt.nn.functional.pixel_shuffle(
+        T(np.arange(16, dtype="f4").reshape(1, 4, 2, 2)), 2),
+        lambda: _pixel_shuffle_ref(
+            np.arange(16, dtype="f4").reshape(1, 4, 2, 2), 2)),
+    "sequence_mask": (lambda: pt.nn.functional.sequence_mask(
+        T(np.array([1, 3], "i4")), maxlen=4),
+        lambda: np.array([[1, 0, 0, 0], [1, 1, 1, 0]], bool)),
+    "clip_by_norm": (lambda: pt.clip_by_norm(T(A), 1.0),
+                     lambda: A / max(np.linalg.norm(A), 1.0)),
+    "nll_loss": (lambda: pt.nn.functional.nll_loss(
+        T(np.log(np.array([[0.2, 0.3, 0.5], [0.6, 0.3, 0.1]], "f4"))),
+        T(np.array([2, 0], "i8"))),
+        lambda: np.float32(-(np.log(0.5) + np.log(0.6)) / 2)),
+    "bilinear": (lambda: pt.nn.functional.bilinear(
+        T(A[:2, :3]), T(B[:2]), T(np.ones((5, 3, 4), "f4"))),
+        lambda: np.einsum("bi,oij,bj->bo", A[:2, :3],
+                          np.ones((5, 3, 4), "f4"), B[:2])),
+    "edit_distance": (lambda: pt.edit_distance(
+        T(np.array([[1, 2, 3]], "i8")), T(np.array([[1, 3, 3]], "i8")))[0],
+        lambda: np.array([1 / 3], "f4")),   # normalized levenshtein
+    "shuffle_channel": (lambda: pt.shuffle_channel(T(N4), 2),
+                        lambda: N4.reshape(1, 2, 2, 2, 2).transpose(
+                            0, 2, 1, 3, 4).reshape(1, 4, 2, 2)),
+    "affine_channel": (lambda: pt.affine_channel(
+        T(N4), T(W4), T(V6[:4])),
+        lambda: N4 * W4[None, :, None, None]
+        + V6[:4][None, :, None, None]),
+    "partial_sum": (lambda: pt.partial_sum([T(A), T(B)], start_index=0,
+                                           length=2),
+                    lambda: A[:, :2] + B[:, :2]),
+    "partial_concat": (lambda: pt.partial_concat(
+        [T(A), T(B)], start_index=1, length=2),
+        lambda: np.concatenate([A[:, 1:3], B[:, 1:3]], 1)),
+    "fused_rms_norm": (lambda: pt.incubate.nn.functional.fused_rms_norm(
+        T(A), T(np.ones(4, "f4") * 1.5), None, 1e-5, 1),
+        lambda: _rms_ref(A, 1.5 * np.ones(4, "f4"))),
+    "layer_norm_f": (lambda: pt.nn.functional.layer_norm(
+        T(A), [4], weight=T(W4), bias=T(V6[:4])),
+        lambda: ((A - A.mean(-1, keepdims=True))
+                 / np.sqrt(A.var(-1, keepdims=True) + 1e-5) * W4
+                 + V6[:4]).astype("f4")),
+    "fold": (lambda: pt.nn.functional.fold(
+        T(np.ones((1, 4, 4), "f4")), output_sizes=[3, 3],
+        kernel_sizes=[2, 2]),
+        lambda: _fold_ones_ref()),
+}
+
+
+def _pixel_shuffle_ref(x, r):
+    n, c, h, w = x.shape
+    return x.reshape(n, c // r**2, r, r, h, w).transpose(
+        0, 1, 4, 2, 5, 3).reshape(n, c // r**2, h * r, w * r)
+
+
+def _fold_ones_ref():
+    # sum of overlapping 2x2 ones patches over a 3x3 output
+    out = np.zeros((1, 1, 3, 3), "f4")
+    for i in range(2):
+        for j in range(2):
+            out[0, 0, i:i + 2, j:j + 2] += 1
+    return out
+
+
+@pytest.mark.parametrize("name", sorted(CASES4))
+def test_numeric_wave4(name):
+    _run_case(CASES4[name])
+
+
+def test_tensor_split_uneven():
+    parts = pt.tensor_split(T(V6[:5]), 2)
+    refs = np.array_split(V6[:5], 2)
+    for p, r in zip(parts, refs):
+        np.testing.assert_allclose(_v(p), r)
