@@ -29,10 +29,43 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.llama import _rotate_half
-from ..ops.paged_kv import BlockAllocator, paged_append, \
-    paged_decode_attention
+from ..ops.paged_kv import paged_append, paged_decode_attention
 
 __all__ = ["ContinuousBatchingEngine", "GenRequest"]
+
+
+class _RefPool:
+    """Refcounted page pool: prefix-cached blocks are shared read-only
+    between sequences and the prefix index, freed when the last reference
+    drops (the vLLM block-refcount scheme)."""
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, -1, -1))
+        self.ref: Dict[int, int] = {}
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def acquire(self, n: int) -> Optional[List[int]]:
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self.ref[p] = 1
+        return out
+
+    def share(self, phys: List[int]) -> None:
+        for p in phys:
+            self.ref[p] += 1
+
+    def release(self, phys: List[int]) -> None:
+        for p in phys:
+            self.ref[p] -= 1
+            if self.ref[p] == 0:
+                del self.ref[p]
+                self._free.append(p)
 
 
 @dataclass
@@ -42,6 +75,35 @@ class GenRequest:
     max_new_tokens: int
     eos_token_id: Optional[int] = None
     out: List[int] = field(default_factory=list)
+
+
+def _make_rms_ffn(cfg):
+    """One source for the per-layer RMSNorm and FFN closures shared by
+    the decode step and the prefix-cache chunk fill — the two compiled
+    paths must never drift numerically (same convention as
+    generation._dense_masked_attention)."""
+    eps = cfg.rms_norm_eps
+    moe = getattr(cfg, "moe_num_experts", 0)
+
+    def rms(x, w):
+        ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1,
+                      keepdims=True)
+        return (x * jax.lax.rsqrt(ms + eps).astype(x.dtype)) * w
+
+    def ffn(lp, y):
+        if moe:
+            from ..parallel.moe import moe_swiglu_ffn_grouped
+            out = moe_swiglu_ffn_grouped(
+                y, lp["router_w"], lp["e_gate"], lp["e_up"],
+                lp["e_down"], top_k=cfg.moe_top_k)
+            if getattr(cfg, "moe_num_shared_experts", 0):
+                out = out + (jax.nn.silu(y @ lp["s_gate"])
+                             * (y @ lp["s_up"])) @ lp["s_down"]
+            return out
+        return (jax.nn.silu(y @ lp["gate_w"])
+                * (y @ lp["up_w"])) @ lp["down_w"]
+
+    return rms, ffn
 
 
 class ContinuousBatchingEngine:
@@ -64,7 +126,8 @@ class ContinuousBatchingEngine:
 
     def __init__(self, cfg, params, *, max_batch: int = 4,
                  block_size: int = 16, num_blocks: int = 256,
-                 max_blocks_per_seq: Optional[int] = None):
+                 max_blocks_per_seq: Optional[int] = None,
+                 enable_prefix_caching: bool = True):
         if getattr(cfg, "moe_num_experts", 0) and \
                 getattr(cfg, "moe_router", "topk") != "topk":
             raise NotImplementedError("decode serves token-choice only")
@@ -82,7 +145,17 @@ class ContinuousBatchingEngine:
         self.block_table = np.full((max_batch, self.MB), -1, np.int32)
         self.lengths = np.zeros((max_batch,), np.int32)
         self.tokens = np.zeros((max_batch,), np.int32)
-        self.alloc = BlockAllocator(num_blocks)
+        self.alloc = _RefPool(num_blocks)
+        self.slot_pages: List[List[int]] = [[] for _ in range(max_batch)]
+        # automatic prefix caching: exact prompt-prefix bytes (block
+        # aligned) -> phys page; the index holds one reference per entry
+        # and is evicted LRU under page pressure
+        self.enable_prefix_caching = bool(enable_prefix_caching)
+        self.prefix_index: "collections.OrderedDict[bytes, int]" = \
+            collections.OrderedDict()
+        self.stats = {"prefix_blocks_reused": 0,
+                      "prefix_blocks_registered": 0,
+                      "pages_allocated": 0}
         self.slots: List[Optional[GenRequest]] = [None] * max_batch
         self.queue: "collections.deque[GenRequest]" = collections.deque()
         self.finished: Dict[int, np.ndarray] = {}
@@ -92,6 +165,7 @@ class ContinuousBatchingEngine:
         self._step = jax.jit(self._build_step(),
                              donate_argnums=(1, 2))
         self._prefill_cache: Dict[int, object] = {}
+        self._chunk_fill_cache: Dict[int, object] = {}
         self.last_logits: Optional[np.ndarray] = None   # [B, V] debug/test
 
     # ------------------------------------------------------------------
@@ -102,30 +176,11 @@ class ContinuousBatchingEngine:
         from ..models.llama import _rope_cos_sin
         from ..models.generation import _collapse_blocks
         H, Hkv, D = cfg.num_heads, cfg.kv_heads, cfg.head_dim
-        eps = cfg.rms_norm_eps
         BS = self.BS
         cos_full, sin_full = _rope_cos_sin(
             cfg.max_position_embeddings, D, cfg.rope_theta,
             jnp.dtype(cfg.dtype))
-        moe = getattr(cfg, "moe_num_experts", 0)
-
-        def rms(x, w):
-            ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1,
-                          keepdims=True)
-            return (x * jax.lax.rsqrt(ms + eps).astype(x.dtype)) * w
-
-        def ffn(lp, y):
-            if moe:
-                from ..parallel.moe import moe_swiglu_ffn_grouped
-                out = moe_swiglu_ffn_grouped(
-                    y, lp["router_w"], lp["e_gate"], lp["e_up"],
-                    lp["e_down"], top_k=cfg.moe_top_k)
-                if getattr(cfg, "moe_num_shared_experts", 0):
-                    out = out + (jax.nn.silu(y @ lp["s_gate"])
-                                 * (y @ lp["s_up"])) @ lp["s_down"]
-                return out
-            return (jax.nn.silu(y @ lp["gate_w"])
-                    * (y @ lp["up_w"])) @ lp["down_w"]
+        rms, ffn = _make_rms_ffn(cfg)
 
         def step(params, pool_k, pool_v, bt, lengths, tokens):
             B = tokens.shape[0]
@@ -163,6 +218,77 @@ class ContinuousBatchingEngine:
 
         return step
 
+    def _build_chunk_fill(self, Ts: int):
+        """Suffix prefill against the paged pool: runs ``Ts`` prompt
+        tokens starting at a cached prefix of length ``start``, writing
+        their KV into the (private) pages and returning next-token
+        logits.  This is what makes a prefix-cache hit SKIP the prefix
+        compute, not just dedupe its storage."""
+        cfg = self.cfg
+        from ..models.llama import _rope_cos_sin
+        from ..models.generation import (_collapse_blocks,
+                                         _dense_masked_attention)
+        H, Hkv, D = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        BS = self.BS
+        cos_full, sin_full = _rope_cos_sin(
+            cfg.max_position_embeddings, D, cfg.rope_theta,
+            jnp.dtype(cfg.dtype))
+        scale = 1.0 / (D ** 0.5)
+        rms, ffn = _make_rms_ffn(cfg)
+
+        def fill(params, pool_k, pool_v, bt_row, start, toks):
+            # toks [Ts]; bt_row [MB]; start: prefix length
+            blocks = _collapse_blocks(params["blocks"])
+            pos = start + jnp.arange(Ts)                     # [Ts]
+            x = jnp.take(params["wte"], toks, axis=0)[None]  # [1, Ts, h]
+            cos = jnp.take(cos_full, pos, axis=0)
+            sin = jnp.take(sin_full, pos, axis=0)
+            blk = jnp.take(jnp.maximum(bt_row, 0), pos // BS)
+            off = pos % BS
+            jpos = jnp.arange(bt_row.shape[0] * BS)[None, None, None, :]
+            mask = jpos <= pos[None, None, :, None]
+
+            def rope1(t):                                    # [1,Ts,*,D]
+                return t * cos[None, :, None, :] \
+                    + _rotate_half(t) * sin[None, :, None, :]
+
+            def body(carry, inp):
+                x = carry
+                lp, pk, pv = inp
+                y = rms(x, lp["ln1_w"])
+                q = (y @ lp["q_w"]).reshape(1, Ts, H, D)
+                k = (y @ lp["k_w"]).reshape(1, Ts, Hkv, D)
+                v = (y @ lp["v_w"]).reshape(1, Ts, Hkv, D)
+                q, k = rope1(q), rope1(k)
+                pk = pk.at[blk, off].set(k[0])
+                pv = pv.at[blk, off].set(v[0])
+                k_all = jnp.take(pk, jnp.maximum(bt_row, 0), axis=0)
+                v_all = jnp.take(pv, jnp.maximum(bt_row, 0), axis=0)
+                k_all = k_all.reshape(1, -1, Hkv, D)
+                v_all = v_all.reshape(1, -1, Hkv, D)
+                attn = _dense_masked_attention(
+                    q, k_all, v_all, mask, scale).reshape(1, Ts, -1)
+                x = x + attn @ lp["o_w"]
+                x = x + ffn(lp, rms(x, lp["ln2_w"]))
+                return x, (pk, pv)
+
+            x, (pk2, pv2) = jax.lax.scan(body, x,
+                                         (blocks, pool_k, pool_v))
+            xf = rms(x[:, -1], params["lnf_w"])
+            logits = jnp.einsum("bh,hv->bv", xf, params["head"],
+                                preferred_element_type=jnp.float32)
+            return pk2, pv2, logits
+
+        return fill
+
+    def _chunk_fill(self, Ts: int):
+        fn = self._chunk_fill_cache.get(Ts)
+        if fn is None:
+            fn = jax.jit(self._build_chunk_fill(Ts),
+                         donate_argnums=(1, 2))
+            self._chunk_fill_cache[Ts] = fn
+        return fn
+
     # ------------------------------------------------------------------
     # host-side scheduler
     # ------------------------------------------------------------------
@@ -192,50 +318,134 @@ class ContinuousBatchingEngine:
     def _blocks_needed(self, n_tokens: int) -> int:
         return -(-n_tokens // self.BS)
 
+    def _cached_prefix(self, prompt: np.ndarray):
+        """Longest indexed block-aligned prefix.  When the prompt is an
+        exact multiple of BS, at least one block is left uncached so the
+        suffix prefill has >= 1 token to produce next-token logits."""
+        if not self.enable_prefix_caching:
+            return 0, []
+        full = len(prompt) // self.BS
+        lookup = full - 1 if len(prompt) % self.BS == 0 else full
+        shared: List[int] = []
+        for key in self._block_keys(prompt, lookup):
+            phys = self.prefix_index.get(key)
+            if phys is None:
+                break
+            self.prefix_index.move_to_end(key)
+            shared.append(phys)
+        return len(shared), shared
+
+    def _block_keys(self, prompt: np.ndarray, n: int) -> List[bytes]:
+        """Chained per-block digests (the vLLM scheme): key_b =
+        H(key_{b-1} || block_b tokens) — O(T0) total instead of the
+        O(T0^2) cumulative-bytes keys, same exact-prefix semantics."""
+        import hashlib
+        keys, prev = [], b""
+        for b in range(n):
+            h = hashlib.sha1(
+                prev + prompt[b * self.BS:(b + 1) * self.BS].tobytes())
+            prev = h.digest()
+            keys.append(prev)
+        return keys
+
+    def _acquire_with_eviction(self, n: int) -> Optional[List[int]]:
+        """Acquire pages, LRU-evicting prefix-index entries on
+        pressure.  Only entries whose page is held SOLELY by the index
+        (ref == 1) are evicted — popping a shared entry frees nothing and
+        would throw away prefixes other requests still hit.  Callers must
+        take their own reference on reused pages BEFORE acquiring, or an
+        evicted twin of a 'shared' page could be handed back as private
+        and the chunk fill would overwrite cached prefix KV."""
+        while True:
+            got = self.alloc.acquire(n)
+            if got is not None:
+                self.stats["pages_allocated"] += n
+                return got
+            evictable = next(
+                (k for k, p in self.prefix_index.items()
+                 if self.alloc.ref.get(p) == 1), None)
+            if evictable is None:
+                return None
+            self.alloc.release([self.prefix_index.pop(evictable)])
+
+    def _register_prefix(self, prompt: np.ndarray,
+                         table: List[int]) -> None:
+        """Index every read-only (full, decode-untouched) prompt block.
+        Decode writes start at position len(prompt), so all ``full``
+        blocks are immutable for the sequence's lifetime."""
+        if not self.enable_prefix_caching:
+            return
+        for b, key in enumerate(self._block_keys(prompt,
+                                                 len(prompt) // self.BS)):
+            if key in self.prefix_index:
+                continue
+            self.prefix_index[key] = table[b]
+            self.alloc.share([table[b]])
+            self.stats["prefix_blocks_registered"] += 1
+
     def _admit(self) -> None:
-        """Admit queued requests into free slots while pages allow —
-        prefill runs densely once per request, then its KV moves into
-        the pool pages."""
+        """Admit queued requests into free slots while pages allow.
+        On a prefix-cache hit the shared pages are reused and only the
+        SUFFIX runs (paged chunk fill); cold prompts prefill densely and
+        their KV moves into the pool pages."""
         from ..models.generation import build_llama_decoder
         for slot in range(self.B):
             if not self.queue or self.slots[slot] is not None:
                 continue
             req = self.queue[0]
-            total = len(req.prompt) + req.max_new_tokens
-            need = self._blocks_needed(total)
-            if need > self.alloc.free_blocks:
-                break                      # head-of-line waits for pages
-            self.queue.popleft()
-            phys = self.alloc.allocate(("slot", slot), need)
-            self.block_table[slot, :] = -1
-            self.block_table[slot, :need] = phys
             T0 = len(req.prompt)
-            # dense prefill, jitted once per distinct prompt length
-            jprefill = self._prefill_cache.get(T0)
-            if jprefill is None:
-                prefill, _ = build_llama_decoder(self.cfg, T0,
-                                                 use_pallas=False)
-                jprefill = jax.jit(prefill)
-                self._prefill_cache[T0] = jprefill
-            cache, logits = jprefill(self.params, req.prompt[None, :])
-            # move prompt KV into the pool pages ON DEVICE with ONE
-            # scatter per pool (a per-block loop would dispatch a full
-            # pool-sized update per page; a host round trip would stall
-            # every admission).  The padded tail of the last page holds
-            # zeros, masked by lengths.
-            nb = self._blocks_needed(T0)
-            pad = nb * self.BS - T0
-            kc, vc = cache["k"][:, 0], cache["v"][:, 0]  # [L, T0, Hkv, D]
-            pages = np.asarray(phys[:nb])
+            total = T0 + req.max_new_tokens
+            need = self._blocks_needed(total)
+            L, shared = self._cached_prefix(req.prompt)
+            # take the slot's reference FIRST: eviction under pressure
+            # must never free (and re-hand-out) a page we are reusing
+            self.alloc.share(shared)
+            priv = self._acquire_with_eviction(need - L)
+            if priv is None:
+                self.alloc.release(shared)
+                break                      # head-of-line waits for pages
+            self.stats["prefix_blocks_reused"] += L
+            self.queue.popleft()
+            table = shared + priv
+            self.block_table[slot, :] = -1
+            self.block_table[slot, :need] = table
+            self.slot_pages[slot] = table
 
-            def paged_view(x):                 # [L, nb, BS, Hkv, D]
-                x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
-                return x.reshape(x.shape[0], nb, self.BS, *x.shape[2:])
+            if L:
+                # suffix-only prefill against the cached pages
+                suffix = req.prompt[L * self.BS:]
+                fill = self._chunk_fill(len(suffix))
+                self.pool_k, self.pool_v, logits = fill(
+                    self.params, self.pool_k, self.pool_v,
+                    jnp.asarray(self.block_table[slot]),
+                    jnp.int32(L * self.BS), jnp.asarray(suffix))
+            else:
+                # dense prefill, jitted once per distinct prompt length
+                jprefill = self._prefill_cache.get(T0)
+                if jprefill is None:
+                    prefill, _ = build_llama_decoder(self.cfg, T0,
+                                                     use_pallas=False)
+                    jprefill = jax.jit(prefill)
+                    self._prefill_cache[T0] = jprefill
+                cache, logits = jprefill(self.params, req.prompt[None, :])
+                # move prompt KV into the pool pages ON DEVICE with ONE
+                # scatter per pool; the padded tail of the last page
+                # holds zeros, masked by lengths
+                nb = self._blocks_needed(T0)
+                pad = nb * self.BS - T0
+                kc, vc = cache["k"][:, 0], cache["v"][:, 0]
+                pages = np.asarray(table[:nb])
 
-            self.pool_k = self.pool_k.at[:, pages].set(
-                paged_view(kc).astype(self.pool_k.dtype))
-            self.pool_v = self.pool_v.at[:, pages].set(
-                paged_view(vc).astype(self.pool_v.dtype))
+                def paged_view(x):             # [L, nb, BS, Hkv, D]
+                    x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                    return x.reshape(x.shape[0], nb, self.BS,
+                                     *x.shape[2:])
+
+                self.pool_k = self.pool_k.at[:, pages].set(
+                    paged_view(kc).astype(self.pool_k.dtype))
+                self.pool_v = self.pool_v.at[:, pages].set(
+                    paged_view(vc).astype(self.pool_v.dtype))
+            self._register_prefix(req.prompt, table)
             first = int(np.asarray(jnp.argmax(logits, -1))[0])
             req.out.append(first)
             self.slots[slot] = req
@@ -259,7 +469,8 @@ class ContinuousBatchingEngine:
         req = self.slots[slot]
         self.finished[req.req_id] = np.concatenate(
             [req.prompt, np.asarray(req.out, np.int32)])
-        self.alloc.release(("slot", slot))
+        self.alloc.release(self.slot_pages[slot])
+        self.slot_pages[slot] = []
         self.block_table[slot, :] = -1
         self.lengths[slot] = 0
         self.slots[slot] = None

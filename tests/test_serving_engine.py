@@ -153,3 +153,67 @@ def test_one_token_budget_and_prefill_eos(model):
     rid2 = eng2.add_request(prompt, 10, eos_token_id=first)
     out2 = eng2.run_to_completion()[rid2]
     np.testing.assert_array_equal(out2, out)   # stopped at the eos
+
+
+def test_prefix_cache_reuses_and_preserves_output(model):
+    """Two requests sharing a 2-block prompt prefix: the second admission
+    must reuse the indexed pages (stats) and produce exactly the output
+    of a caching-disabled engine."""
+    cfg, params = model
+    prefix = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    p1 = np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (5,))
+                         .astype(np.int32)])
+    p2 = np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (3,))
+                         .astype(np.int32)])
+
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=1,
+                                   block_size=8, num_blocks=64)
+    a = eng.add_request(p1, 4)
+    res = eng.run_to_completion()
+    assert eng.stats["prefix_blocks_registered"] >= 2
+    b = eng.add_request(p2, 4)
+    res.update(eng.run_to_completion())
+    assert eng.stats["prefix_blocks_reused"] >= 2
+
+    for rid, p in ((a, p1), (b, p2)):
+        cold = ContinuousBatchingEngine(cfg, params, max_batch=1,
+                                        block_size=8, num_blocks=64,
+                                        enable_prefix_caching=False)
+        cold.add_request(p, 4)
+        want = list(cold.run_to_completion().values())[0]
+        np.testing.assert_array_equal(res[rid], want)
+
+
+def test_chunk_fill_logits_match_dense_prefill(model):
+    """The paged suffix prefill must reproduce dense-prefill next-token
+    logits when the prefix pages hold the same KV."""
+    from paddle_tpu.models.generation import build_llama_decoder
+    cfg, params = model
+    prompt = rng.integers(0, cfg.vocab_size, (20,)).astype(np.int32)
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=1,
+                                   block_size=8, num_blocks=64)
+    eng.add_request(prompt, 2)       # registers blocks 0..1 (16 tokens)
+    eng.run_to_completion()
+    # same prompt again: suffix fill runs the last 4 tokens only
+    eng.add_request(prompt, 2)
+    eng.step()
+    assert eng.stats["prefix_blocks_reused"] >= 2
+    req = next(r for r in eng.slots if r is not None)
+    first_cached = req.out[0]
+    prefill, _ = build_llama_decoder(cfg, 20, use_pallas=False)
+    _, ref_logits = jax.jit(prefill)(params, prompt[None, :])
+    assert first_cached == int(np.asarray(jnp.argmax(ref_logits, -1))[0])
+
+
+def test_prefix_index_evicts_under_pressure(model):
+    """A full index must LRU-evict to admit new work rather than wedge."""
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=1,
+                                   block_size=8, num_blocks=6)
+    outs = {}
+    for i in range(4):
+        p = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+        rid = eng.add_request(p, 3)
+        outs.update(eng.run_to_completion())
+        assert rid in outs
+    assert eng.alloc.free_blocks + len(eng.prefix_index) > 0
