@@ -13,8 +13,10 @@ SURVEY §2.6); the block/page machinery here is ops/paged_kv.py (same
 design as the reference's block attention), and this module adds the
 in-framework scheduler the reference leaves to the serving layer.
 
-Greedy decoding only (batched sampling would need per-slot RNG streams);
-per-sequence results are independent of WHO ELSE shares the batch —
+Decoding is greedy by default; per-request sampling (temperature /
+top-k / top-p) runs on per-slot PRNG streams folded per position, so a
+sampled request's tokens depend only on its seed and its own content —
+per-sequence results are independent of WHO ELSE shares the batch,
 pinned by tests/test_serving_engine.py against a batch-of-one engine.
 """
 
@@ -74,6 +76,10 @@ class GenRequest:
     prompt: np.ndarray                 # [T0] int32
     max_new_tokens: int
     eos_token_id: Optional[int] = None
+    temperature: float = 0.0           # <= 0: greedy
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: int = 0
     out: List[int] = field(default_factory=list)
 
 
@@ -107,7 +113,8 @@ def _make_rms_ffn(cfg):
 
 
 class ContinuousBatchingEngine:
-    """Llama-family continuous-batching engine (greedy).
+    """Llama-family continuous-batching engine (greedy by default,
+    per-request sampling via temperature/top_k/top_p on add_request).
 
     Args:
       cfg: LlamaConfig (dense or MoE — the FFN follows the config).
@@ -293,7 +300,11 @@ class ContinuousBatchingEngine:
     # host-side scheduler
     # ------------------------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens: int,
-                    eos_token_id: Optional[int] = None) -> int:
+                    eos_token_id: Optional[int] = None, *,
+                    temperature: float = 0.0,
+                    top_k: Optional[int] = None,
+                    top_p: Optional[float] = None,
+                    seed: int = 0) -> int:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
@@ -310,10 +321,53 @@ class ContinuousBatchingEngine:
         if total > self.cfg.max_position_embeddings:
             raise ValueError("request exceeds max_position_embeddings")
         req = GenRequest(self._next_id, prompt, max_new_tokens,
-                         eos_token_id)
+                         eos_token_id, temperature=temperature,
+                         top_k=top_k, top_p=top_p, seed=seed)
         self._next_id += 1
         self.queue.append(req)
         return req.req_id
+
+    def _pick_token(self, req: GenRequest, logits: np.ndarray,
+                    position: int) -> int:
+        """Greedy, or sample on the request's own PRNG stream folded by
+        ABSOLUTE position — reproducible per (seed, content), independent
+        of batch composition and admission timing."""
+        if req.temperature is None or req.temperature <= 0.0:
+            return int(logits.argmax())
+        tok = self._sampler()(jnp.asarray(logits)[None],
+                              jnp.int32(req.seed), jnp.int32(position),
+                              jnp.float32(req.temperature),
+                              jnp.int32(req.top_k or 0),
+                              jnp.float32(req.top_p or 0.0))
+        return int(np.asarray(tok)[0])
+
+    def _sampler(self):
+        """One jitted fold-in + filter + categorical program shared by
+        every sampled slot (the eager per-token chain was ~8 dispatches
+        per slot per step on the host hot path)."""
+        fn = getattr(self, "_sampler_fn", None)
+        if fn is None:
+            def sample(logits, seed, position, temperature, top_k, top_p):
+                key = jax.random.fold_in(jax.random.key(seed), position)
+                x = logits.astype(jnp.float32) / temperature
+                srt = jnp.sort(x, axis=-1)[:, ::-1]      # descending
+                # traced ranks must be POSITIVE take_along indices — a
+                # traced negative index clamps to 0 under jit and would
+                # silently disable the filter
+                kidx = jnp.full((x.shape[0], 1),
+                                jnp.maximum(top_k, 1) - 1)
+                kth = jnp.take_along_axis(srt, kidx, axis=-1)
+                x = jnp.where((top_k > 0) & (x < kth), -jnp.inf, x)
+                probs = jax.nn.softmax(srt, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                cidx = jnp.sum(cum < top_p, axis=-1)
+                cutoff = jnp.take_along_axis(srt, cidx[:, None], axis=-1)
+                x = jnp.where((top_p > 0.0) & (x < cutoff), -jnp.inf, x)
+                return jax.random.categorical(key, x, axis=-1)
+
+            fn = jax.jit(sample)
+            self._sampler_fn = fn
+        return fn
 
     def _blocks_needed(self, n_tokens: int) -> int:
         return -(-n_tokens // self.BS)
@@ -446,7 +500,8 @@ class ContinuousBatchingEngine:
                 self.pool_v = self.pool_v.at[:, pages].set(
                     paged_view(vc).astype(self.pool_v.dtype))
             self._register_prefix(req.prompt, table)
-            first = int(np.asarray(jnp.argmax(logits, -1))[0])
+            first = self._pick_token(req, np.asarray(logits)[0],
+                                     position=T0)
             req.out.append(first)
             self.slots[slot] = req
             self.lengths[slot] = T0
@@ -497,12 +552,13 @@ class ContinuousBatchingEngine:
             jnp.asarray(self.block_table), jnp.asarray(self.lengths),
             jnp.asarray(self.tokens))
         self.last_logits = np.asarray(logits)
-        nxt = np.asarray(jnp.argmax(logits, -1).astype(jnp.int32))
         for s in active:
             req = self.slots[s]
             self.lengths[s] += 1            # the fed token's KV is stored
-            req.out.append(int(nxt[s]))
-            self.tokens[s] = int(nxt[s])
+            tok = self._pick_token(req, self.last_logits[s],
+                                   position=int(self.lengths[s]))
+            req.out.append(tok)
+            self.tokens[s] = tok
         out = self.finished
         self.finished = {}
         return out

@@ -217,3 +217,44 @@ def test_prefix_index_evicts_under_pressure(model):
         outs.update(eng.run_to_completion())
         assert rid in outs
     assert eng.alloc.free_blocks + len(eng.prefix_index) > 0
+
+
+def test_sampled_requests_independent_of_batch(model):
+    """A sampled request (per-slot PRNG folded by absolute position)
+    produces the same tokens whether it runs alone or next to other
+    requests — and different seeds diverge."""
+    cfg, params = model
+    prompt = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+
+    def run(batchmates, seed):
+        eng = ContinuousBatchingEngine(cfg, params, max_batch=2,
+                                       block_size=8, num_blocks=64)
+        rid = eng.add_request(prompt, 6, temperature=0.8, top_k=20,
+                              seed=seed)
+        for bp in batchmates:
+            eng.add_request(bp, 4)
+        return eng.run_to_completion()[rid]
+
+    solo = run([], seed=7)
+    mate = rng.integers(0, cfg.vocab_size, (9,)).astype(np.int32)
+    shared = run([mate], seed=7)
+    np.testing.assert_array_equal(solo, shared)
+    other = run([], seed=8)
+    assert not np.array_equal(solo, other)
+
+
+def test_sampler_topk_filter_actually_filters(model):
+    """top_k=2 with near-zero temperature must only ever emit one of the
+    two highest-logit tokens (regression: a traced negative sort index
+    clamps to 0 under jit and silently disables the filter)."""
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=1,
+                                   block_size=8, num_blocks=32)
+    logits = np.full((cfg.vocab_size,), -10.0, np.float32)
+    logits[5], logits[9] = 4.0, 3.9
+    from paddle_tpu.inference.serving import GenRequest
+    req = GenRequest(0, np.zeros(1, np.int32), 4, temperature=1.0,
+                     top_k=2, seed=0)
+    picks = {eng._pick_token(req, logits, position=p)
+             for p in range(64)}
+    assert picks <= {5, 9} and len(picks) == 2, picks
