@@ -138,6 +138,13 @@ class ContinuousBatchingEngine:
         if getattr(cfg, "moe_num_experts", 0) and \
                 getattr(cfg, "moe_router", "topk") != "topk":
             raise NotImplementedError("decode serves token-choice only")
+        rs = getattr(cfg, "rope_scaling", None)
+        if rs and rs.get("rope_type", rs.get("type")) == "dynamic":
+            raise NotImplementedError(
+                "dynamic-NTK rope depends on the CURRENT sequence length; "
+                "the engine bakes one table at max_position_embeddings, "
+                "which would mis-scale every shorter sequence — use "
+                "'linear' or 'llama3' scaling for serving")
         self.cfg = cfg
         self.params = params
         self.B = max_batch
@@ -186,7 +193,7 @@ class ContinuousBatchingEngine:
         BS = self.BS
         cos_full, sin_full = _rope_cos_sin(
             cfg.max_position_embeddings, D, cfg.rope_theta,
-            jnp.dtype(cfg.dtype))
+            jnp.dtype(cfg.dtype), getattr(cfg, "rope_scaling", None))
         rms, ffn = _make_rms_ffn(cfg)
 
         def step(params, pool_k, pool_v, bt, lengths, tokens):
@@ -239,7 +246,7 @@ class ContinuousBatchingEngine:
         BS = self.BS
         cos_full, sin_full = _rope_cos_sin(
             cfg.max_position_embeddings, D, cfg.rope_theta,
-            jnp.dtype(cfg.dtype))
+            jnp.dtype(cfg.dtype), getattr(cfg, "rope_scaling", None))
         scale = 1.0 / (D ** 0.5)
         rms, ffn = _make_rms_ffn(cfg)
 
@@ -335,37 +342,39 @@ class ContinuousBatchingEngine:
         if req.temperature is None or req.temperature <= 0.0:
             return int(logits.argmax())
         tok = self._sampler()(jnp.asarray(logits)[None],
-                              jnp.int32(req.seed), jnp.int32(position),
-                              jnp.float32(req.temperature),
-                              jnp.int32(req.top_k or 0),
-                              jnp.float32(req.top_p or 0.0))
+                              jnp.asarray([req.seed], jnp.int32),
+                              jnp.asarray([position], jnp.int32),
+                              jnp.asarray([req.temperature], jnp.float32),
+                              jnp.asarray([req.top_k or 0], jnp.int32),
+                              jnp.asarray([req.top_p or 0.0],
+                                          jnp.float32))
         return int(np.asarray(tok)[0])
 
     def _sampler(self):
-        """One jitted fold-in + filter + categorical program shared by
-        every sampled slot (the eager per-token chain was ~8 dispatches
-        per slot per step on the host hot path)."""
+        """One jitted row-vmapped fold-in + filter + categorical program
+        — the whole sampled sub-batch runs in a single dispatch per step.
+        HF sequential-warper semantics: top-p mass is computed over the
+        top-k-FILTERED distribution, not the raw one."""
         fn = getattr(self, "_sampler_fn", None)
         if fn is None:
-            def sample(logits, seed, position, temperature, top_k, top_p):
+            def one(logits, seed, position, temperature, top_k, top_p):
                 key = jax.random.fold_in(jax.random.key(seed), position)
                 x = logits.astype(jnp.float32) / temperature
-                srt = jnp.sort(x, axis=-1)[:, ::-1]      # descending
-                # traced ranks must be POSITIVE take_along indices — a
-                # traced negative index clamps to 0 under jit and would
+                srt = jnp.sort(x)[::-1]                  # descending
+                # traced ranks must be POSITIVE take indices — a traced
+                # negative index clamps to 0 under jit and would
                 # silently disable the filter
-                kidx = jnp.full((x.shape[0], 1),
-                                jnp.maximum(top_k, 1) - 1)
-                kth = jnp.take_along_axis(srt, kidx, axis=-1)
+                kth = jnp.take(srt, jnp.maximum(top_k, 1) - 1)
                 x = jnp.where((top_k > 0) & (x < kth), -jnp.inf, x)
-                probs = jax.nn.softmax(srt, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                cidx = jnp.sum(cum < top_p, axis=-1)
-                cutoff = jnp.take_along_axis(srt, cidx[:, None], axis=-1)
+                srt2 = jnp.sort(x)[::-1]                 # filtered dist
+                probs = jax.nn.softmax(srt2)
+                cum = jnp.cumsum(probs)
+                cidx = jnp.sum(cum < top_p)
+                cutoff = jnp.take(srt2, cidx)
                 x = jnp.where((top_p > 0.0) & (x < cutoff), -jnp.inf, x)
-                return jax.random.categorical(key, x, axis=-1)
+                return jax.random.categorical(key, x)
 
-            fn = jax.jit(sample)
+            fn = jax.jit(jax.vmap(one))
             self._sampler_fn = fn
         return fn
 
@@ -553,12 +562,29 @@ class ContinuousBatchingEngine:
             jnp.asarray(self.tokens))
         self.last_logits = np.asarray(logits)
         for s in active:
-            req = self.slots[s]
             self.lengths[s] += 1            # the fed token's KV is stored
-            tok = self._pick_token(req, self.last_logits[s],
-                                   position=int(self.lengths[s]))
-            req.out.append(tok)
-            self.tokens[s] = tok
+        sampled = [s for s in active
+                   if (self.slots[s].temperature or 0.0) > 0.0]
+        picks: Dict[int, int] = {}
+        if sampled:
+            # ONE dispatch + sync for the whole sampled sub-batch
+            reqs = [self.slots[s] for s in sampled]
+            toks = self._sampler()(
+                jnp.asarray(self.last_logits[sampled]),
+                jnp.asarray([r.seed for r in reqs], jnp.int32),
+                jnp.asarray([int(self.lengths[s]) for s in sampled],
+                            jnp.int32),
+                jnp.asarray([r.temperature for r in reqs], jnp.float32),
+                jnp.asarray([r.top_k or 0 for r in reqs], jnp.int32),
+                jnp.asarray([r.top_p or 0.0 for r in reqs], jnp.float32))
+            picks = dict(zip(sampled, np.asarray(toks).tolist()))
+        for s in active:
+            req = self.slots[s]
+            tok = picks.get(s)
+            if tok is None:
+                tok = int(self.last_logits[s].argmax())
+            req.out.append(int(tok))
+            self.tokens[s] = int(tok)
         out = self.finished
         self.finished = {}
         return out

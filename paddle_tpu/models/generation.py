@@ -239,6 +239,12 @@ def build_llama_decoder(cfg, max_len: int,
             "with moe_router='expert_choice' would be silently served a "
             "different forward (expert choice competes across the batch, "
             "which is non-causal at decode)")
+    rs = getattr(cfg, "rope_scaling", None)
+    if rs and rs.get("rope_type", rs.get("type")) == "dynamic":
+        raise NotImplementedError(
+            "dynamic-NTK rope depends on the current sequence length; "
+            "the decoder bakes one table at max_len, which would "
+            "mis-scale shorter prefixes — use 'linear' or 'llama3'")
 
     def ffn(lp, y):
         """Post-ln2 FFN: dense SwiGLU or Mixtral MoE.  The MoE branch is
@@ -285,7 +291,8 @@ def build_llama_decoder(cfg, max_len: int,
                           preferred_element_type=jnp.float32)
 
     cos_full, sin_full = _rope_cos_sin(max_len, D, cfg.rope_theta,
-                                       jnp.dtype(cfg.dtype))
+                                       jnp.dtype(cfg.dtype),
+                                       getattr(cfg, "rope_scaling", None))
 
     def prefill(params, ids):
         B, T0 = ids.shape

@@ -65,6 +65,13 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 1e-2
     moe_router: str = "topk"   # "topk" | "expert_choice" (see gpt.py)
+    # RoPE scaling for long-context extension (HF-compatible dict):
+    #   {"rope_type": "linear", "factor": f}
+    #   {"rope_type": "dynamic", "factor": f,
+    #    "original_max_position_embeddings": n}
+    #   {"rope_type": "llama3", "factor": f, "low_freq_factor": lo,
+    #    "high_freq_factor": hi, "original_max_position_embeddings": n}
+    rope_scaling: Optional[dict] = None
     moe_dropless: bool = False  # sorted ragged_dot experts (no drops;
     # local banks only — mutually exclusive with dp-EP / mp expert TP)
     # DeepSeek-style always-on shared experts: every token also runs a
@@ -125,9 +132,40 @@ def llama_70b(**kw) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
-def _rope_cos_sin(seq_len: int, head_dim: int, theta: float, dtype):
+def _rope_cos_sin(seq_len: int, head_dim: int, theta: float, dtype,
+                  scaling: Optional[dict] = None):
+    """RoPE tables, optionally rescaled for long-context extension with
+    HuggingFace-compatible semantics (transformers modeling_rope_utils):
+    linear position interpolation, dynamic NTK theta adjustment, and
+    llama3 per-frequency wavelength interpolation."""
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
     t = jnp.arange(seq_len, dtype=jnp.float32)
+    if scaling:
+        kind = scaling.get("rope_type", scaling.get("type", "linear"))
+        factor = float(scaling.get("factor", 1.0))
+        if kind == "linear":
+            t = t / factor
+        elif kind == "dynamic":
+            orig = int(scaling["original_max_position_embeddings"])
+            if seq_len > orig:
+                base = theta * (factor * seq_len / orig
+                                - (factor - 1)) ** (head_dim /
+                                                    (head_dim - 2))
+                inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                                 jnp.float32) / head_dim))
+        elif kind == "llama3":
+            orig = int(scaling["original_max_position_embeddings"])
+            lo = float(scaling["low_freq_factor"])
+            hi = float(scaling["high_freq_factor"])
+            low_wl = orig / lo
+            high_wl = orig / hi
+            wl = 2.0 * math.pi / inv
+            smooth = (orig / wl - lo) / (hi - lo)
+            interp = (1 - smooth) * inv / factor + smooth * inv
+            inv = jnp.where(wl > low_wl, inv / factor,
+                            jnp.where(wl < high_wl, inv, interp))
+        else:
+            raise ValueError(f"unknown rope_type {kind!r}")
     freqs = jnp.outer(t, inv)                      # [s, d/2]
     emb = jnp.concatenate([freqs, freqs], axis=-1)  # [s, d]
     return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
@@ -313,7 +351,8 @@ class LlamaModel(Layer):
         cfg = self.cfg
         s = input_ids.shape[1]
         cos, sin = _rope_cos_sin(s, cfg.head_dim, cfg.rope_theta,
-                                 jnp.dtype(cfg.dtype))
+                                 jnp.dtype(cfg.dtype),
+                                 cfg.rope_scaling)
         x = self.embed_tokens(input_ids)
         for blk in self.layers:
             x = blk(x, cos, sin)
@@ -660,7 +699,8 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
         # (i, 2R-1-i) — computed once per step, hoisted out of the
         # per-layer scan (and out of the remat backward) via step_ctx.
         cos, sin = _rope_cos_sin(s_l * sep, cfg.head_dim, cfg.rope_theta,
-                                 jnp.dtype(cfg.dtype))
+                                 jnp.dtype(cfg.dtype),
+                                 cfg.rope_scaling)
         if cp_mode == "zigzag":
             from ..parallel.context_parallel import zigzag_positions
             pos = zigzag_positions(s_l, SEP_AXIS)

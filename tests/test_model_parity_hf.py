@@ -204,6 +204,66 @@ class TestBertParity:
         assert errp < ATOL, f"BERT pooler diverges: max err {errp}"
 
 
+class TestRopeScalingParity:
+    @pytest.mark.parametrize("scaling", [
+        {"rope_type": "linear", "factor": 2.0},
+        {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+         "high_freq_factor": 4.0,
+         "original_max_position_embeddings": 16},
+    ])
+    def test_logits_match_hf_llama_with_rope_scaling(self, scaling):
+        """Long-context RoPE scaling (linear position interpolation and
+        llama3 per-frequency wavelength interpolation) must match the HF
+        implementation bitwise-close under identical weights."""
+        import torch
+        from transformers import LlamaConfig as HFLlamaConfig
+        from transformers import LlamaForCausalLM as HFLlama
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        import paddle_tpu as paddle
+
+        V, h, f, L, H, KV, S = 128, 64, 128, 2, 4, 2, 32
+        torch.manual_seed(0)
+        hf = HFLlama(HFLlamaConfig(
+            vocab_size=V, hidden_size=h, intermediate_size=f,
+            num_hidden_layers=L, num_attention_heads=H,
+            num_key_value_heads=KV, max_position_embeddings=S,
+            rope_theta=10000.0, rms_norm_eps=1e-5,
+            rope_scaling=dict(scaling), tie_word_embeddings=False,
+            attn_implementation="eager")).eval()
+
+        ours = LlamaForCausalLM(LlamaConfig(
+            vocab_size=V, hidden_size=h, intermediate_size=f, num_layers=L,
+            num_heads=H, num_kv_heads=KV, max_position_embeddings=S,
+            rope_theta=10000.0, rms_norm_eps=1e-5, dtype="float32",
+            rope_scaling=dict(scaling)))
+
+        hsd = hf.state_dict()
+        sd = {"llama.embed_tokens.weight":
+              _to_np(hsd["model.embed_tokens.weight"]),
+              "llama.norm.weight": _to_np(hsd["model.norm.weight"]),
+              "lm_head.weight": _to_np(hsd["lm_head.weight"]).T}
+        for i in range(L):
+            p = f"model.layers.{i}."
+            q = f"llama.layers.{i}."
+            sd[q + "input_layernorm.weight"] = \
+                _to_np(hsd[p + "input_layernorm.weight"])
+            sd[q + "post_attention_layernorm.weight"] = \
+                _to_np(hsd[p + "post_attention_layernorm.weight"])
+            for w in ("self_attn.q_proj", "self_attn.k_proj",
+                      "self_attn.v_proj", "self_attn.o_proj",
+                      "mlp.gate_proj", "mlp.up_proj", "mlp.down_proj"):
+                sd[q + w + ".weight"] = _to_np(hsd[p + w + ".weight"]).T
+        ours.set_state_dict(sd)
+        ours.eval()
+
+        ids = np.random.default_rng(4).integers(0, V, (2, S))
+        ref = _to_np(hf(torch.tensor(ids)).logits)
+        got = np.asarray(ours(paddle.to_tensor(ids.astype("int64"))).numpy())
+        err = np.max(np.abs(got - ref))
+        assert err < ATOL, \
+            f"rope-scaled logits diverge ({scaling['rope_type']}): {err}"
+
+
 class TestMixtralParity:
     def test_logits_match_hf_mixtral_moe(self):
         """Sparse-MoE cross-framework pin: our Llama-MoE (GShard-style

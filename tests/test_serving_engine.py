@@ -258,3 +258,29 @@ def test_sampler_topk_filter_actually_filters(model):
     picks = {eng._pick_token(req, logits, position=p)
              for p in range(64)}
     assert picks <= {5, 9} and len(picks) == 2, picks
+
+
+def test_topp_applies_after_topk(model):
+    """HF sequential-warper semantics: top-p mass is computed over the
+    top-k-FILTERED distribution.  With a dominant argmax, top_k=2 +
+    top_p=0.9 must keep ONLY the argmax (over the raw distribution the
+    cutoff would fall below both survivors and top-p would no-op)."""
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=1,
+                                   block_size=8, num_blocks=32)
+    logits = np.zeros((cfg.vocab_size,), np.float32)
+    logits[5], logits[9] = 8.0, 4.0        # p(5|top2) ~ 0.98 >= 0.9
+    from paddle_tpu.inference.serving import GenRequest
+    req = GenRequest(0, np.zeros(1, np.int32), 4, temperature=1.0,
+                     top_k=2, top_p=0.9, seed=0)
+    picks = {eng._pick_token(req, logits, position=p) for p in range(64)}
+    assert picks == {5}, picks
+
+
+def test_dynamic_rope_rejected_in_engine(model):
+    cfg, params = model
+    from paddle_tpu.models.llama import llama_tiny
+    c = llama_tiny(rope_scaling={"rope_type": "dynamic", "factor": 2.0,
+                                 "original_max_position_embeddings": 16})
+    with pytest.raises(NotImplementedError, match="dynamic"):
+        ContinuousBatchingEngine(c, params, max_batch=1)
