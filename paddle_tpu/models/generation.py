@@ -32,7 +32,7 @@ import numpy as np
 from ..ops.pallas.decode_attention import decode_attention
 
 __all__ = ["sample_logits", "gpt_generate", "llama_generate",
-           "llama_speculative_generate",
+           "llama_speculative_generate", "gpt_speculative_generate",
            "build_gpt_decoder", "build_llama_decoder"]
 
 
@@ -68,7 +68,8 @@ def _collapse_blocks(blocks: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
 # ---------------------------------------------------------------------------
 # GPT decoder
 # ---------------------------------------------------------------------------
-def build_gpt_decoder(cfg, max_len: int, use_pallas: Optional[bool] = None):
+def build_gpt_decoder(cfg, max_len: int, use_pallas: Optional[bool] = None,
+                      with_chunk: bool = False):
     """Returns (prefill, step).
 
     prefill(params, ids [B,T0]) -> (cache, logits_last [B,V])
@@ -166,6 +167,43 @@ def build_gpt_decoder(cfg, max_len: int, use_pallas: Optional[bool] = None):
         x, (ks, vs) = jax.lax.scan(body, x, (blocks, cache["k"], cache["v"]))
         return {"k": ks, "v": vs}, final_logits(params, x)
 
+    def chunk_step(params, cache, toks, pos):
+        """Speculative verify: K1 consecutive tokens in one cached pass
+        (see build_llama_decoder.chunk_step; GPT uses learned position
+        embeddings instead of rope)."""
+        B, K1 = toks.shape
+        blocks = _collapse_blocks(params["blocks"])
+        pos_ids = pos + jnp.arange(K1)
+        x = jnp.take(params["wte"], toks, axis=0) \
+            + jnp.take(params["wpe"], pos_ids, axis=0)[None]
+        jpos = jnp.arange(max_len)[None, None, None, :]
+        mask = jpos <= pos_ids[None, None, :, None]
+        scale = 1.0 / math.sqrt(D)
+
+        def body(carry, inp):
+            x = carry
+            lp, k_l, v_l = inp
+            y = ln(x, lp["ln1_w"], lp["ln1_b"])
+            qkv = y @ lp["qkv_w"] + lp["qkv_b"]
+            qkv = qkv.reshape(B, K1, H, 3 * D)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            k_l = jax.lax.dynamic_update_slice(k_l, k, (0, pos, 0, 0))
+            v_l = jax.lax.dynamic_update_slice(v_l, v, (0, pos, 0, 0))
+            attn = _dense_masked_attention(
+                q, k_l, v_l, mask, scale).reshape(B, K1, -1)
+            x = x + attn @ lp["proj_w"] + lp["proj_b"]
+            x = x + ffn(lp, ln(x, lp["ln2_w"], lp["ln2_b"]))
+            return x, (k_l, v_l)
+
+        x, (ks, vs) = jax.lax.scan(body, x, (blocks, cache["k"],
+                                             cache["v"]))
+        xf = ln(x, params["lnf_w"], params["lnf_b"])
+        logits = jnp.einsum("bkh,vh->bkv", xf, params["wte"],
+                            preferred_element_type=jnp.float32)
+        return {"k": ks, "v": vs}, logits
+
+    if with_chunk:
+        return prefill, step, chunk_step
     return prefill, step
 
 
@@ -468,6 +506,28 @@ def llama_speculative_generate(params, cfg, draft_params, draft_cfg,
                                input_ids, max_new_tokens: int, *,
                                num_draft: int = 4,
                                use_pallas: Optional[bool] = None):
+    return _speculative_generate(
+        build_llama_decoder, params, cfg, draft_params, draft_cfg,
+        input_ids, max_new_tokens, num_draft=num_draft,
+        use_pallas=use_pallas)
+
+
+def gpt_speculative_generate(params, cfg, draft_params, draft_cfg,
+                             input_ids, max_new_tokens: int, *,
+                             num_draft: int = 4,
+                             use_pallas: Optional[bool] = None):
+    """GPT-family speculative decoding — same greedy-exact contract as
+    :func:`llama_speculative_generate`."""
+    return _speculative_generate(
+        build_gpt_decoder, params, cfg, draft_params, draft_cfg,
+        input_ids, max_new_tokens, num_draft=num_draft,
+        use_pallas=use_pallas)
+
+
+def _speculative_generate(builder, params, cfg, draft_params, draft_cfg,
+                          input_ids, max_new_tokens: int, *,
+                          num_draft: int = 4,
+                          use_pallas: Optional[bool] = None):
     """Greedy speculative decoding (Leviathan et al. 2023, greedy case):
     a small DRAFT model proposes ``num_draft`` tokens per round; the
     target model scores all of them in ONE chunk_step (K+1-row matmuls
@@ -508,13 +568,14 @@ def llama_speculative_generate(params, cfg, draft_params, draft_cfg,
     # reuse jitted closures across calls (same keyed-cache policy as
     # _generate's _RUN_CACHE — a serving loop must not recompile four
     # decoder programs per request)
-    ck = ("spec", repr(cfg), repr(draft_cfg), max_len, use_pallas)
+    ck = ("spec", builder, repr(cfg), repr(draft_cfg), max_len,
+          use_pallas)
     cached = _RUN_CACHE.get(ck)
     if cached is None:
-        prefill_t, _, chunk_t = build_llama_decoder(
+        prefill_t, _, chunk_t = builder(
             cfg, max_len, use_pallas=use_pallas, with_chunk=True)
-        prefill_d, step_d = build_llama_decoder(draft_cfg, max_len,
-                                                use_pallas=use_pallas)
+        prefill_d, step_d = builder(draft_cfg, max_len,
+                                    use_pallas=use_pallas)
         cached = (jax.jit(prefill_t), jax.jit(chunk_t),
                   jax.jit(prefill_d), jax.jit(step_d))
         _RUN_CACHE[ck] = cached

@@ -141,12 +141,24 @@ def _rope_cos_sin(seq_len: int, head_dim: int, theta: float, dtype,
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
     t = jnp.arange(seq_len, dtype=jnp.float32)
     if scaling:
-        kind = scaling.get("rope_type", scaling.get("type", "linear"))
+        kind = scaling.get("rope_type", scaling.get("type"))
+        if kind is None:
+            raise ValueError(
+                "rope_scaling needs a 'rope_type' (or legacy 'type') key "
+                "— refusing to guess (a silently-applied default would "
+                "mis-scale every position)")
         factor = float(scaling.get("factor", 1.0))
         if kind == "linear":
             t = t / factor
         elif kind == "dynamic":
-            orig = int(scaling["original_max_position_embeddings"])
+            orig = int(scaling.get("original_max_position_embeddings",
+                                   0))
+            if not orig:
+                raise ValueError(
+                    "dynamic rope_scaling needs "
+                    "'original_max_position_embeddings' (HF derives it "
+                    "from config.max_position_embeddings; set it "
+                    "explicitly here)")
             if seq_len > orig:
                 base = theta * (factor * seq_len / orig
                                 - (factor - 1)) ** (head_dim /
@@ -154,7 +166,12 @@ def _rope_cos_sin(seq_len: int, head_dim: int, theta: float, dtype,
                 inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
                                                  jnp.float32) / head_dim))
         elif kind == "llama3":
-            orig = int(scaling["original_max_position_embeddings"])
+            orig = int(scaling.get("original_max_position_embeddings",
+                                   0))
+            if not orig:
+                raise ValueError(
+                    "llama3 rope_scaling needs "
+                    "'original_max_position_embeddings'")
             lo = float(scaling["low_freq_factor"])
             hi = float(scaling["high_freq_factor"])
             low_wl = orig / lo

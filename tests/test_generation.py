@@ -421,3 +421,27 @@ class TestSpeculativeDecoding:
         ids = rng.integers(0, cfg.vocab_size, (2, 4)).astype(np.int32)
         with pytest.raises(NotImplementedError):
             llama_speculative_generate(params, cfg, dparams, dcfg, ids, 4)
+
+
+def test_gpt_speculative_exact_match():
+    """GPT speculative decode == plain GPT greedy decode (random tiny
+    draft; learned-position chunk verify)."""
+    from paddle_tpu.models.generation import gpt_speculative_generate
+    from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
+    from paddle_tpu import parallel as dist
+    from paddle_tpu.parallel.topology import HybridTopology, set_topology
+    cfg, params = _gpt_setup()
+    dcfg = GPTConfig(vocab_size=97, hidden_size=16, num_layers=1,
+                     num_heads=2, max_position_embeddings=64)
+    topo = dist.init_topology()
+    _, dinit = build_gpt_train_step(dcfg, topo, num_microbatches=1)
+    dparams = dinit(1)["params"]
+    set_topology(HybridTopology())
+    ids = rng.integers(0, cfg.vocab_size, (1, 5)).astype(np.int32)
+    want = np.asarray(gpt_generate(params, cfg, ids, max_new_tokens=9,
+                                   temperature=0.0, use_pallas=False))
+    got, stats = gpt_speculative_generate(params, cfg, dparams, dcfg,
+                                          ids, 9, num_draft=3,
+                                          use_pallas=False)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["rounds"] >= 1

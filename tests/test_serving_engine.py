@@ -269,10 +269,13 @@ def test_topp_applies_after_topk(model):
     eng = ContinuousBatchingEngine(cfg, params, max_batch=1,
                                    block_size=8, num_blocks=32)
     logits = np.zeros((cfg.vocab_size,), np.float32)
-    logits[5], logits[9] = 8.0, 4.0        # p(5|top2) ~ 0.98 >= 0.9
+    # raw cum mass of token 5 is ~0.906 (< 0.95) but its top-2-filtered
+    # mass is ~0.982 (>= 0.95): only the sequential-warper semantics
+    # reduce the keep-set to {5}
+    logits[5], logits[9] = 8.0, 4.0
     from paddle_tpu.inference.serving import GenRequest
     req = GenRequest(0, np.zeros(1, np.int32), 4, temperature=1.0,
-                     top_k=2, top_p=0.9, seed=0)
+                     top_k=2, top_p=0.95, seed=0)
     picks = {eng._pick_token(req, logits, position=p) for p in range(64)}
     assert picks == {5}, picks
 
