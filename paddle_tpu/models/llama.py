@@ -151,8 +151,8 @@ def _rope_cos_sin(seq_len: int, head_dim: int, theta: float, dtype,
         if kind == "linear":
             t = t / factor
         elif kind == "dynamic":
-            orig = int(scaling.get("original_max_position_embeddings",
-                                   0))
+            orig = int(scaling.get("original_max_position_embeddings")
+                       or 0)
             if not orig:
                 raise ValueError(
                     "dynamic rope_scaling needs "
@@ -166,8 +166,8 @@ def _rope_cos_sin(seq_len: int, head_dim: int, theta: float, dtype,
                 inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
                                                  jnp.float32) / head_dim))
         elif kind == "llama3":
-            orig = int(scaling.get("original_max_position_embeddings",
-                                   0))
+            orig = int(scaling.get("original_max_position_embeddings")
+                       or 0)
             if not orig:
                 raise ValueError(
                     "llama3 rope_scaling needs "

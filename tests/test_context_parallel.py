@@ -205,3 +205,22 @@ class TestZigzag:
         ref = _ref_attention(q, kr, vr, True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
+
+
+    def test_zigzag_full_mesh_r8(self):
+        """Degree-8 zigzag (every virtual device): 16 blocks, balanced
+        pair counts on all ranks, still exact."""
+        R = 8
+        q, k, v = _rand()
+        perm = zigzag_permutation(S, R)
+        inv = np.argsort(perm)
+        mesh = Mesh(np.array(jax.devices()[:R]).reshape(R), ("sep",))
+        spec = P(None, "sep", None, None)
+        sharded = jax.jit(jax.shard_map(
+            lambda q, k, v: zigzag_ring_flash_attention(q, k, v, "sep"),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False))
+        out = sharded(q[:, perm], k[:, perm], v[:, perm])[:, inv]
+        ref = _ref_attention(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)

@@ -287,3 +287,30 @@ def test_dynamic_rope_rejected_in_engine(model):
                                  "original_max_position_embeddings": 16})
     with pytest.raises(NotImplementedError, match="dynamic"):
         ContinuousBatchingEngine(c, params, max_batch=1)
+
+
+def test_moe_engine_with_prefix_cache(model):
+    """MoE serving + automatic prefix caching compose: the chunk fill
+    runs the grouped-GEMM FFN over the suffix and outputs stay exact."""
+    cfg = llama_tiny(moe_num_experts=4)
+    topo = dist.init_topology(devices=jax.devices()[:1])
+    _, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1)
+    params = init_fn(0)["params"]
+    set_topology(HybridTopology())
+    prefix = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    p1 = np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (4,))
+                         .astype(np.int32)])
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=1,
+                                   block_size=8, num_blocks=64)
+    a = eng.add_request(p1, 4)
+    res = eng.run_to_completion()
+    b = eng.add_request(p1, 4)          # full prefix hit
+    res.update(eng.run_to_completion())
+    assert eng.stats["prefix_blocks_reused"] >= 2
+    np.testing.assert_array_equal(res[a], res[b])
+    cold = ContinuousBatchingEngine(cfg, params, max_batch=1,
+                                    block_size=8, num_blocks=64,
+                                    enable_prefix_caching=False)
+    cold.add_request(p1, 4)
+    want = list(cold.run_to_completion().values())[0]
+    np.testing.assert_array_equal(res[b], want)
