@@ -18,23 +18,43 @@ import numpy as np
 
 def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
         flash=None, autotune=False, remat_policy=None, experts=0,
-        dropless=False):
+        dropless=False, family="gpt", kv_heads=None):
     import jax
-    from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
     from paddle_tpu import parallel as dist
 
     # always assign (not just set-on-True): rows run in one process, so a
     # stale True from an earlier autotune row would mislabel later rows
     from paddle_tpu.core.flags import FLAGS
     FLAGS.use_autotune = bool(autotune)
-    cfg = GPTConfig(vocab_size=V, hidden_size=h, num_layers=L,
-                    num_heads=h // 64, max_position_embeddings=seq,
-                    dtype="bfloat16", moe_num_experts=experts,
-                    moe_dropless=dropless)
+    if family not in ("gpt", "llama"):
+        raise ValueError(f"unknown family {family!r}")
+    if family == "llama" and (experts or dropless):
+        raise ValueError("MoE sweep rows use family='gpt' (the llama "
+                         "branch does not thread moe knobs; a row must "
+                         "never claim a MoE measurement that did not run)")
     topo = dist.init_topology(devices=jax.devices()[:1])
-    step_fn, init_fn = build_gpt_train_step(cfg, topo, num_microbatches=mbs,
-                                            remat=remat, use_flash=flash,
-                                            remat_policy=remat_policy)
+    if family == "llama":
+        # GQA path: flash has native grouped KV, dense repeats kv heads —
+        # the tradeoff the GPT rows can't measure
+        from paddle_tpu.models.llama import (LlamaConfig,
+                                             build_llama_train_step)
+        cfg = LlamaConfig(vocab_size=V, hidden_size=h,
+                          intermediate_size=int(h * 8 / 3) // 128 * 128,
+                          num_layers=L, num_heads=h // 64,
+                          num_kv_heads=kv_heads,
+                          max_position_embeddings=seq, dtype="bfloat16")
+        step_fn, init_fn = build_llama_train_step(
+            cfg, topo, num_microbatches=mbs, remat=remat, use_flash=flash,
+            remat_policy=remat_policy)
+    else:
+        from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
+        cfg = GPTConfig(vocab_size=V, hidden_size=h, num_layers=L,
+                        num_heads=h // 64, max_position_embeddings=seq,
+                        dtype="bfloat16", moe_num_experts=experts,
+                        moe_dropless=dropless)
+        step_fn, init_fn = build_gpt_train_step(
+            cfg, topo, num_microbatches=mbs, remat=remat, use_flash=flash,
+            remat_policy=remat_policy)
     state = init_fn(0)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
@@ -49,12 +69,19 @@ def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
     lv = float(np.asarray(jax.device_get(loss)))
     dt = time.perf_counter() - t0
     tps = batch * seq * steps / dt
-    f = 4 * h
-    # ACTIVE params per token (MFU basis): MoE replaces the dense FFN's
-    # 2hf with top_k expert FFNs + the router, regardless of total E
-    ffn_p = (cfg.moe_top_k * 2 * h * f + h * experts) if experts \
-        else 2 * h * f
-    n_params = V * h + seq * h + L * (4 * h * h + ffn_p + 9 * h) + 2 * h
+    if family == "llama":
+        f = cfg.intermediate_size
+        kvd = cfg.kv_heads * cfg.head_dim
+        n_params = 2 * V * h + L * (2 * h * h + 2 * h * kvd
+                                    + 3 * h * f + 2 * h) + h
+    else:
+        f = 4 * h
+        # ACTIVE params per token (MFU basis): MoE replaces the dense
+        # FFN's 2hf with top_k expert FFNs + the router
+        ffn_p = (cfg.moe_top_k * 2 * h * f + h * experts) if experts \
+            else 2 * h * f
+        n_params = V * h + seq * h + L * (4 * h * h + ffn_p + 9 * h) \
+            + 2 * h
     fpt = 6 * n_params + 12 * L * h * seq      # MODEL flops (MFU basis,
     # same definition as bench.py / the BASELINE 45% target)
     from bench import peak_flops_per_chip
@@ -66,6 +93,9 @@ def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
         "tokens_per_sec": round(tps, 1), "mfu": round(mfu, 4),
         "loss": round(lv, 4), "device": str(jax.devices()[0]),
     }
+    if family != "gpt":
+        row["family"] = family
+        row["kv_heads"] = cfg.kv_heads
     if experts:
         row["experts"] = experts
         row["dropless"] = dropless
@@ -108,6 +138,12 @@ DEFAULT_MATRIX = [
     # fixed-capacity dispatch buffers, same model
     dict(batch=8, seq=1024, steps=10, remat=False, flash=None, experts=8,
          dropless=True),
+    # llama GQA at 1.3B width: flash (native grouped KV) vs dense
+    # (jnp.repeat'ed kv) — the GQA tradeoff the GPT rows can't see
+    dict(batch=4, seq=2048, steps=5, remat=True, flash=True, h=2048,
+         L=12, V=32000, family="llama", kv_heads=8),
+    dict(batch=4, seq=2048, steps=5, remat=True, flash=False, h=2048,
+         L=12, V=32000, family="llama", kv_heads=8),
 ]
 
 
