@@ -28,6 +28,9 @@ def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
     FLAGS.use_autotune = bool(autotune)
     if family not in ("gpt", "llama"):
         raise ValueError(f"unknown family {family!r}")
+    if family == "gpt" and kv_heads is not None:
+        raise ValueError("kv_heads applies to family='llama' only (GQA); "
+                         "a GPT row must not silently drop the knob")
     if family == "llama" and (experts or dropless):
         raise ValueError("MoE sweep rows use family='gpt' (the llama "
                          "branch does not thread moe knobs; a row must "

@@ -529,15 +529,33 @@ class ContinuousBatchingEngine:
                     req.out = req.out[:req.out.index(req.eos_token_id) + 1]
                 self._retire(s)
 
-    def _retire(self, slot: int) -> None:
-        req = self.slots[slot]
-        self.finished[req.req_id] = np.concatenate(
-            [req.prompt, np.asarray(req.out, np.int32)])
+    def _free_slot(self, slot: int) -> None:
         self.alloc.release(self.slot_pages[slot])
         self.slot_pages[slot] = []
         self.block_table[slot, :] = -1
         self.lengths[slot] = 0
         self.slots[slot] = None
+
+    def _retire(self, slot: int) -> None:
+        req = self.slots[slot]
+        self.finished[req.req_id] = np.concatenate(
+            [req.prompt, np.asarray(req.out, np.int32)])
+        self._free_slot(slot)
+
+    def cancel(self, req_id: int) -> bool:
+        """Abort a queued or in-flight request.  Its pages free
+        immediately; no result is reported.  Returns False when the id
+        is unknown or already finished."""
+        for i, req in enumerate(self.queue):
+            if req.req_id == req_id:
+                del self.queue[i]
+                return True
+        for slot in range(self.B):
+            req = self.slots[slot]
+            if req is not None and req.req_id == req_id:
+                self._free_slot(slot)
+                return True
+        return False
 
     def step(self) -> Dict[int, np.ndarray]:
         """One scheduler iteration: admit, decode every active slot,

@@ -314,3 +314,20 @@ def test_moe_engine_with_prefix_cache(model):
     cold.add_request(p1, 4)
     want = list(cold.run_to_completion().values())[0]
     np.testing.assert_array_equal(res[b], want)
+
+
+def test_cancel_queued_and_active(model):
+    cfg, params = model
+    p = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=1,
+                                   block_size=8, num_blocks=16)
+    a = eng.add_request(p, 6)
+    b = eng.add_request(p, 6)            # queued behind a
+    eng.step()
+    assert eng.cancel(b)                 # cancel while queued
+    assert eng.cancel(a)                 # cancel while active
+    assert not eng.cancel(a)             # idempotent-false
+    assert eng.alloc.free_blocks + len(eng.prefix_index) >= 14
+    c = eng.add_request(p, 3)            # engine still serves
+    out = eng.run_to_completion()
+    assert c in out and a not in out and b not in out
