@@ -115,6 +115,18 @@ def run(batch, seq, steps, remat, h=768, L=12, V=32768, mbs=1,
 DEFAULT_MATRIX = [
     dict(batch=8, seq=1024, steps=10, remat=False, flash=False),
     dict(batch=8, seq=1024, steps=10, remat=False, flash=True),
+    # flash backend head-to-head at the headline shape (VERDICT r4 item 1:
+    # "done = flash >= dense-XLA at s1024 AND s2048"): the in-tree kernel
+    # vs the platform-tuned Pallas kernels shipped inside JAX
+    dict(batch=8, seq=1024, steps=10, remat=False, flash="ours"),
+    dict(batch=8, seq=1024, steps=10, remat=False, flash="jax_flash"),
+    dict(batch=8, seq=1024, steps=10, remat=False, flash="splash"),
+    dict(batch=4, seq=2048, steps=5, remat=True, flash="ours",
+         h=2048, L=12, V=51200),
+    dict(batch=4, seq=2048, steps=5, remat=True, flash="jax_flash",
+         h=2048, L=12, V=51200),
+    dict(batch=4, seq=2048, steps=5, remat=True, flash="splash",
+         h=2048, L=12, V=51200),
     dict(batch=8, seq=1024, steps=10, remat=False, flash=None),  # auto
     dict(batch=8, seq=1024, steps=10, remat=True, flash=True),
     dict(batch=8, seq=1024, steps=10, remat=False, flash=True,
@@ -146,6 +158,12 @@ DEFAULT_MATRIX = [
     dict(batch=4, seq=2048, steps=5, remat=True, flash=True, h=2048,
          L=12, V=32000, family="llama", kv_heads=8),
     dict(batch=4, seq=2048, steps=5, remat=True, flash=False, h=2048,
+         L=12, V=32000, family="llama", kv_heads=8),
+    # GQA backend head-to-head: ours (native grouped KV) vs splash (MQA
+    # form) vs jax_flash (KV repeat)
+    dict(batch=4, seq=2048, steps=5, remat=True, flash="splash", h=2048,
+         L=12, V=32000, family="llama", kv_heads=8),
+    dict(batch=4, seq=2048, steps=5, remat=True, flash="jax_flash", h=2048,
          L=12, V=32000, family="llama", kv_heads=8),
 ]
 

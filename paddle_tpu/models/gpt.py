@@ -66,6 +66,10 @@ class GPTConfig:
     moe_num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # expert_choice capacity is a DIFFERENT quantity (average experts per
+    # token, not GShard slack); moe_ec_capacity names it explicitly and
+    # falls back to moe_capacity_factor when unset (ADVICE r4)
+    moe_ec_capacity: "Optional[float]" = None
     moe_aux_coef: float = 1e-2
     # "topk" (GShard-style token choice) or "expert_choice" (experts pick
     # their top-C tokens — perfectly balanced, no aux loss; best for
@@ -73,6 +77,13 @@ class GPTConfig:
     moe_router: str = "topk"
     moe_dropless: bool = False  # sorted ragged_dot experts (no drops;
     # local banks only — mutually exclusive with dp-EP / mp expert TP)
+
+
+    def moe_capacity(self) -> float:
+        if self.moe_router == "expert_choice" and \
+                self.moe_ec_capacity is not None:
+            return self.moe_ec_capacity
+        return self.moe_capacity_factor
 
     @property
     def ffn_size(self) -> int:
@@ -397,7 +408,7 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
         out = moe_ffn_ep(
             y_in, params["gate_w"], params["e_w1"], params["e_b1"],
             params["e_w2"], params["e_b2"], top_k=cfg.moe_top_k,
-            capacity_factor=cfg.moe_capacity_factor, ep_axis=ep_axis,
+            capacity_factor=cfg.moe_capacity(), ep_axis=ep_axis,
             mp_axis=mp_axis, sequence_parallel=sequence_parallel,
             aux_coef=(cfg.moe_aux_coef if moe_aux_coef is None
                       else moe_aux_coef),
@@ -519,17 +530,27 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
         # it is usable on ANY mesh (round-1 limited it to mesh.size==1).
         if use_flash is None and jax.default_backend() not in ("cpu",):
             # auto: dense XLA attention while its residuals fit HBM, the
-            # Pallas flash kernel once they don't (ops/attention_policy —
-            # decided at trace time on the device-LOCAL q/k shapes)
+            # best tuned flash backend once they don't (ops/attention_policy
+            # + ops/pallas/flash_backends — decided at trace time on the
+            # device-LOCAL q/k shapes)
             from ..ops.attention_policy import make_auto_attn
-            from ..ops.pallas.flash_attention import flash_attention
+            from ..ops.pallas.flash_backends import tuned_flash
             cp_attn = make_auto_attn(
                 cfg.num_layers, S, num_microbatches, schedule, remat,
-                remat_policy, functools.partial(flash_attention, causal=True),
+                remat_policy, functools.partial(tuned_flash, causal=True),
                 dense_causal_attention)
+        elif isinstance(use_flash, str):
+            # explicit backend pin ("ours" / "jax_flash" / "splash") —
+            # the bench sweep's per-backend rows
+            from ..ops.pallas.flash_backends import run_backend
+            import math as _math
+
+            def cp_attn(q, k, v, _b=use_flash):
+                return run_backend(_b, q, k, v,
+                                   1.0 / _math.sqrt(q.shape[-1]), True)
         elif use_flash:
-            from ..ops.pallas.flash_attention import flash_attention
-            cp_attn = functools.partial(flash_attention, causal=True)
+            from ..ops.pallas.flash_backends import tuned_flash
+            cp_attn = functools.partial(tuned_flash, causal=True)
         else:
             cp_attn = None
 

@@ -63,6 +63,10 @@ class LlamaConfig:
     moe_num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # expert_choice capacity is a DIFFERENT quantity (average experts per
+    # token, not GShard slack); moe_ec_capacity names it explicitly and
+    # falls back to moe_capacity_factor when unset (ADVICE r4)
+    moe_ec_capacity: "Optional[float]" = None
     moe_aux_coef: float = 1e-2
     moe_router: str = "topk"   # "topk" | "expert_choice" (see gpt.py)
     # RoPE scaling for long-context extension (HF-compatible dict):
@@ -86,6 +90,13 @@ class LlamaConfig:
                 "moe_num_shared_experts requires moe_num_experts > 0 "
                 "(shared experts augment a routed MoE FFN; for a plain "
                 "dense FFN just widen intermediate_size)")
+
+
+    def moe_capacity(self) -> float:
+        if self.moe_router == "expert_choice" and \
+                self.moe_ec_capacity is not None:
+            return self.moe_ec_capacity
+        return self.moe_capacity_factor
 
     @property
     def head_dim(self) -> int:
@@ -316,7 +327,7 @@ class LlamaMoEMLP(Layer):
             # (aux does not apply under the expert_choice router)
             return moe_swiglu_ffn_ep(
                 x_, rw, wg, wu, wd, top_k=cfg.moe_top_k,
-                capacity_factor=cfg.moe_capacity_factor,
+                capacity_factor=cfg.moe_capacity(),
                 aux_coef=cfg.moe_aux_coef, router=cfg.moe_router,
                 dropless=cfg.moe_dropless)
 
@@ -546,7 +557,7 @@ def block_apply(params: Dict[str, jax.Array], x: jax.Array,
         out = moe_swiglu_ffn_ep(
             y_in, params["router_w"], params["e_gate"], params["e_up"],
             params["e_down"], top_k=cfg.moe_top_k,
-            capacity_factor=cfg.moe_capacity_factor, ep_axis=ep_axis,
+            capacity_factor=cfg.moe_capacity(), ep_axis=ep_axis,
             mp_axis=mp_axis, sequence_parallel=sequence_parallel,
             aux_coef=(cfg.moe_aux_coef if moe_aux_coef is None
                       else moe_aux_coef),
@@ -654,19 +665,27 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
     else:
         if use_flash is None and jax.default_backend() not in ("cpu",):
             # auto backend (ops/attention_policy): dense XLA attention
-            # while its residuals fit HBM, Pallas flash once they don't —
-            # decided at trace time on the device-local q/k shapes
+            # while its residuals fit HBM, the best tuned flash backend
+            # once they don't — decided at trace time on the device-local
+            # q/k shapes (ops/pallas/flash_backends)
             import functools
             from ..ops.attention_policy import make_auto_attn
-            from ..ops.pallas.flash_attention import flash_attention
+            from ..ops.pallas.flash_backends import tuned_flash
             cp_attn = make_auto_attn(
                 cfg.num_layers, S, num_microbatches, schedule, remat,
-                remat_policy, functools.partial(flash_attention, causal=True),
+                remat_policy, functools.partial(tuned_flash, causal=True),
                 functools.partial(_gqa_attention, causal=True))
+        elif isinstance(use_flash, str):
+            import math as _math
+            from ..ops.pallas.flash_backends import run_backend
+
+            def cp_attn(q, k, v, _b=use_flash):
+                return run_backend(_b, q, k, v,
+                                   1.0 / _math.sqrt(q.shape[-1]), True)
         elif use_flash:
             import functools
-            from ..ops.pallas.flash_attention import flash_attention
-            cp_attn = functools.partial(flash_attention, causal=True)
+            from ..ops.pallas.flash_backends import tuned_flash
+            cp_attn = functools.partial(tuned_flash, causal=True)
         else:
             cp_attn = None
 

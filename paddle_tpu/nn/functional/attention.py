@@ -60,8 +60,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     def impl(q, k, v, m, rk):
         no_drop = dropout_p == 0.0 or not training
         if use_pallas and m is None and no_drop:
-            from ...ops.pallas.flash_attention import flash_attention_fwd
-            return flash_attention_fwd(q, k, v, causal=is_causal)
+            from ...ops.pallas.flash_backends import tuned_flash
+            return tuned_flash(q, k, v, causal=is_causal)
         # masks stay on the dense path: the kernel's bias input is
         # non-differentiable and only broadcasts on dims 0/1, so routing
         # arbitrary user masks there would silently drop mask gradients
@@ -106,7 +106,7 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
             # order == within-segment order, so kernel-causal + segment
             # mask == per-segment causal.  Differing q/k packings fall back
             # to the dense path, whose causal mask is per-segment-local.
-            from ...ops.pallas.flash_attention import flash_attention as fa
+            from ...ops.pallas.flash_backends import tuned_flash as fa
             return fa(q[None], k[None], v[None], scale, causal,
                       segment_ids=seg_q[None].astype(jnp.int32),
                       kv_segment_ids=seg_k[None].astype(jnp.int32))[0]

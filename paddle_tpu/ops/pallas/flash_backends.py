@@ -1,0 +1,186 @@
+"""Flash-attention backend selection: in-tree kernel vs platform-tuned.
+
+The reference does NOT hand-roll its production flash kernel — it dynloads
+an external tuned library (/root/reference/paddle/phi/kernels/gpu/
+flash_attn_kernel.cu:536 via backends/dynload/flashattn.h:19) and keeps a
+per-shape dispatch layer in front of it.  The TPU analog of that tuned
+library is the Pallas kernel suite that ships inside JAX itself
+(``jax.experimental.pallas.ops.tpu.flash_attention`` and
+``splash_attention`` — Mosaic kernels tuned by the platform vendor).  This
+module is the dispatch layer: it exposes :func:`tuned_flash` which picks,
+per shape signature, the fastest of
+
+* ``ours``      — the first-party kernel (flash_attention.py): full feature
+                  set (GQA-native, segment ids, bias, lse out) and the only
+                  backend that runs in interpret mode on CPU;
+* ``jax_flash`` — the platform flash kernel (equal-head MHA; GQA served by
+                  repeating KV heads);
+* ``splash``    — the platform splash kernel (causal/full masks, segment
+                  ids, native grouped-KV via its MQA form).
+
+Selection is autotuned (ops/pallas/autotune.py: timed fwd+bwd once per
+unseen shape, winners persisted) with a static heuristic fallback, mirroring
+the reference's per-shape flash/mem-efficient/math dispatch
+(python/paddle/nn/functional/flash_attention.py:976).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .common import use_interpret
+
+__all__ = ["tuned_flash", "available_backends", "run_backend"]
+
+
+# ---------------------------------------------------------------------------
+# backend wrappers — all take/return the paddle [B, S, H, D] layout and are
+# differentiable end to end (each underlying kernel defines its own VJP)
+# ---------------------------------------------------------------------------
+
+def _ours(q, k, v, scale, causal, seg_q=None, seg_k=None, bias=None):
+    from .flash_attention import flash_attention
+    return flash_attention(q, k, v, scale, causal, segment_ids=seg_q,
+                           kv_segment_ids=seg_k, bias=bias)
+
+
+def _jax_flash(q, k, v, scale, causal, seg_q=None, seg_k=None, bias=None):
+    from jax.experimental.pallas.ops.tpu import flash_attention as _fa
+    Hq, Hkv = q.shape[2], k.shape[2]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    if Hq != Hkv:                       # GQA: the platform kernel is
+        g = Hq // Hkv                   # equal-heads only — repeat KV
+        kt = jnp.repeat(kt, g, axis=1)
+        vt = jnp.repeat(vt, g, axis=1)
+    seg = None
+    if seg_q is not None:
+        seg = _fa.SegmentIds(q=seg_q.astype(jnp.int32),
+                             kv=seg_k.astype(jnp.int32))
+    ab = None
+    if bias is not None:
+        ab = jnp.broadcast_to(
+            bias, (q.shape[0], Hq, q.shape[1], k.shape[1])).astype(q.dtype)
+    out = _fa.flash_attention(qt, kt, vt, ab=ab, segment_ids=seg,
+                              causal=causal, sm_scale=float(scale))
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _splash(q, k, v, scale, causal, seg_q=None, seg_k=None, bias=None):
+    from jax.experimental.pallas.ops.tpu import splash_attention as _sa
+    if bias is not None:
+        raise NotImplementedError("splash backend has no bias input")
+    B, Sq, Hq, D = q.shape
+    Sk, Hkv = k.shape[1], k.shape[2]
+    # splash has no sm_scale knob: fold the scale into q
+    qt = (jnp.swapaxes(q, 1, 2) * jnp.asarray(scale, q.dtype))
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    mk = _sa.CausalMask((Sq, Sk)) if causal else _sa.FullMask((Sq, Sk))
+    seg = None
+    if seg_q is not None:
+        seg = _sa.SegmentIds(q=seg_q.astype(jnp.int32),
+                             kv=seg_k.astype(jnp.int32))
+    interp = use_interpret()
+    if Hq == Hkv:
+        kernel = _sa.make_splash_mha_single_device(
+            _sa.MultiHeadMask([mk] * Hq), interpret=interp)
+        if seg is None:
+            out = jax.vmap(lambda qq, kk, vv: kernel(qq, kk, vv))(qt, kt, vt)
+        else:
+            out = jax.vmap(kernel)(qt, kt, vt, seg)
+    else:
+        # GQA via the MQA form: group q heads per KV head and vmap the
+        # (batch, kv-head) axes; the mask covers one group
+        g = Hq // Hkv
+        kernel = _sa.make_splash_mqa_single_device(
+            _sa.MultiHeadMask([mk] * g), interpret=interp)
+        qg = qt.reshape(B, Hkv, g, Sq, D)
+        if seg is None:
+            out = jax.vmap(jax.vmap(lambda qq, kk, vv: kernel(qq, kk, vv)))(
+                qg, kt, vt)
+        else:
+            out = jax.vmap(
+                lambda qb, kb, vb, sb: jax.vmap(
+                    lambda qq, kk, vv: kernel(qq, kk, vv, sb))(qb, kb, vb)
+            )(qg, kt, vt, seg)
+        out = out.reshape(B, Hq, Sq, D)
+    return jnp.swapaxes(out, 1, 2)
+
+
+_IMPLS = {"ours": _ours, "jax_flash": _jax_flash, "splash": _splash}
+
+
+def available_backends(q_shape, k_shape, causal, has_seg, has_bias,
+                       interpret: bool) -> tuple:
+    """Statically-valid backends for this signature, best-guess first.
+
+    The ordering IS the no-autotune heuristic: the platform kernels are
+    vendor-tuned, so they lead whenever their constraints hold; ``ours``
+    is always last-resort-valid (full feature set + interpret mode)."""
+    B, Sq, Hq, D = q_shape
+    Sk, Hkv = k_shape[1], k_shape[2]
+    if interpret:
+        # CPU test lane: splash honors interpret=, jax_flash does not
+        return ("ours",)
+    cands = []
+    aligned = Sq % 128 == 0 and Sk % 128 == 0 and D in (64, 128, 256)
+    if aligned and not has_bias and causal:
+        cands.append("splash")
+    if aligned and Sq >= 128:
+        cands.append("jax_flash")
+    cands.append("ours")
+    return tuple(cands)
+
+
+def run_backend(name, q, k, v, scale, causal, seg_q=None, seg_k=None,
+                bias=None):
+    return _IMPLS[name](q, k, v, scale, causal, seg_q, seg_k, bias)
+
+
+def _pick_backend(q, k, v, scale, causal, seg_q, seg_k, bias) -> str:
+    from .autotune import FLAGS, lookup, pick
+    interp = use_interpret()
+    cands = available_backends(q.shape, k.shape, causal,
+                               seg_q is not None, bias is not None, interp)
+    default = cands[0]
+    if len(cands) == 1 or not FLAGS.use_autotune:
+        return default
+    key = (tuple(q.shape), tuple(k.shape), str(q.dtype), causal,
+           seg_q is not None, bias is not None)
+    if isinstance(q, jax.core.Tracer):
+        return lookup("flash_backend", key, default)
+
+    def run(cand):
+        impl = functools.partial(run_backend, cand, scale=scale,
+                                 causal=causal, seg_q=seg_q, seg_k=seg_k,
+                                 bias=bias)
+
+        # time fwd+bwd: the training step pays ~2/3 of attention FLOPs in
+        # the backward, so a fwd-only ranking can pick the wrong kernel
+        def loss(qq, kk, vv):
+            return jnp.sum(impl(qq, kk, vv).astype(jnp.float32))
+
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    return pick("flash_backend", key, cands, run, (q, k, v), default)
+
+
+def tuned_flash(q, k, v, scale: Optional[float] = None,
+                causal: bool = False, segment_ids=None,
+                kv_segment_ids=None, bias=None):
+    """Drop-in for ``flash_attention`` that routes to the fastest backend
+    for this shape signature ([B, S, H, D] layout, differentiable)."""
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if segment_ids is not None and kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+    name = _pick_backend(q, k, v, s, causal, segment_ids, kv_segment_ids,
+                         bias)
+    return run_backend(name, q, k, v, s, causal, segment_ids,
+                       kv_segment_ids, bias)
