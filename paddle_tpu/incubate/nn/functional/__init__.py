@@ -240,15 +240,23 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     if rotary_emb_dims not in (0, 1, 2):
         raise ValueError(f"rotary_emb_dims must be 0/1/2, got "
                          f"{rotary_emb_dims}")
+    if beam_cache_offset is not None and cache_kv is None:
+        raise ValueError("masked_multihead_attention: beam_cache_offset "
+                         "requires cache_kv")
+    if (out_shift is None) != (out_smooth is None):
+        raise ValueError("masked_multihead_attention: out_shift and "
+                         "out_smooth must be provided together (the "
+                         "reference store applies (out+shift)*smooth)")
+    quant_out = out_scale is not None and out_scale > 0
     if beam_cache_offset is not None:
-        raise NotImplementedError(
-            "masked_multihead_attention: beam search cache offsets are not "
-            "implemented")
-    if any(a is not None for a in (qkv_out_scale, out_shift, out_smooth)) \
-            or out_scale not in (-1, None):
-        raise NotImplementedError(
-            "masked_multihead_attention: int8/quantized in/out paths are "
-            "not implemented (see quantization package)")
+        _bo = getattr(beam_cache_offset, "_value", beam_cache_offset)
+        _ck = getattr(cache_kv, "_value", cache_kv)
+        if _bo.ndim != 3 or _bo.shape[0] * _bo.shape[1] != _ck.shape[1]:
+            raise ValueError(
+                "beam_cache_offset must be [batch, beam_size, "
+                "max_seq_len + max_dec_len] with batch*beam_size == "
+                f"cache rows; got {tuple(_bo.shape)} vs cache "
+                f"{tuple(_ck.shape)}")
     # capacity check must run on the CONCRETE lengths out here — inside
     # impl they are tracers under the default eager-op jit cache, and a
     # full cache would silently drop the scatter (JAX OOB semantics)
@@ -313,9 +321,16 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
 
         return tr(q), tr(k)
 
-    def impl(xv, cache, b, seqlens, rot, smask):
+    def impl(xv, cache, b, seqlens, rot, smask, beam_off, qkv_scale,
+             oshift, osmooth):
         B = xv.shape[0]
         H, T, D = cache.shape[2], cache.shape[3], cache.shape[4]
+        if qkv_scale is not None:
+            # int32 fused-QKV-matmul output dequantized per channel
+            # (reference MMHALoad<T, int32_t>: x * dequant_scales[c],
+            # scale layout [3, H, D] == the flat 3HD channel axis)
+            xv = xv.astype(jnp.float32) * \
+                qkv_scale.astype(jnp.float32).reshape(-1)[None, :]
         if b is not None:
             xv = xv + b
         q, k, v = (a[:, 0] for a in jnp.split(
@@ -330,32 +345,74 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         # validated on the concrete lengths in the outer function)
         tpos = lens  # [B]
         bidx = jnp.arange(B)
-        kc = cache[0].at[bidx, :, tpos].set(k)     # [B, H, T, D]
-        vc = cache[1].at[bidx, :, tpos].set(v)
-        if smask is not None:
-            # additive score mask over cache positions (reference
-            # mmha_naive: product + src_mask before softmax) — the masked
-            # path runs as one fused XLA step instead of the Pallas
-            # decode kernel
-            m = smask.astype(jnp.float32).reshape(B, 1, -1)
+        kc = cache[0].at[bidx, :, tpos].set(k.astype(cache.dtype))
+        vc = cache[1].at[bidx, :, tpos].set(v.astype(cache.dtype))
+        if smask is not None or beam_off is not None:
+            # dense masked path, one fused XLA step (reference mmha_naive:
+            # product + src_mask before softmax).  Beam search also lands
+            # here: per past position t, row (bbi, beami) reads the cache
+            # row of beam beam_off[bbi, beami, t] within its real batch
+            # (kernel.cu:417-441 k_cache_batch + beam_offset indexing),
+            # so KV is no longer a per-row [H, T, D] block.
+            if beam_off is not None:
+                bw = beam_off.shape[1]
+                offT = beam_off.reshape(B, -1)[:, :T].astype(jnp.int32)
+                if offT.shape[1] < T:      # offsets shorter than capacity:
+                    offT = jnp.pad(offT, ((0, 0), (0, T - offT.shape[1])))
+                src = (jnp.arange(B)[:, None] // bw) * bw + offT   # [B, T]
+                # beam offsets cover PAST positions only (kernel.cu:423:
+                # ti < tlength); the current step's K/V — scattered above
+                # at each row's own length — always reads the own row
+                src = src.at[jnp.arange(B), lens].set(jnp.arange(B))
+                # k_eff[b, t] = kc[src[b, t], :, t]
+                k_eff = kc[src, :, jnp.arange(T)[None, :]]   # [B, T, H, D]
+                v_eff = vc[src, :, jnp.arange(T)[None, :]]
+                kd = jnp.swapaxes(k_eff, 1, 2)               # [B, H, T, D]
+                vd = jnp.swapaxes(v_eff, 1, 2)
+            else:
+                kd, vd = kc, vc
             scores = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32),
-                                kc.astype(jnp.float32)) * (D ** -0.5)
-            if m.shape[-1] < T:
-                m = jnp.pad(m, ((0, 0), (0, 0), (0, T - m.shape[-1])))
-            scores = scores + m[..., :T]
+                                kd.astype(jnp.float32)) * (D ** -0.5)
+            if smask is not None:
+                m = smask.astype(jnp.float32).reshape(B, 1, -1)
+                if m.shape[-1] < T:
+                    m = jnp.pad(m, ((0, 0), (0, 0), (0, T - m.shape[-1])))
+                scores = scores + m[..., :T]
             valid = jnp.arange(T)[None, None, :] <= lens[:, None, None]
             scores = jnp.where(valid, scores, -jnp.inf)
             probs = jax.nn.softmax(scores, axis=-1)
             out = jnp.einsum("bht,bhtd->bhd", probs,
-                             vc.astype(jnp.float32)).astype(xv.dtype)
+                             vd.astype(jnp.float32))
         else:
             out = decode_attention(q, jnp.swapaxes(kc, 1, 2),
                                    jnp.swapaxes(vc, 1, 2), lens + 1)
-        return out.reshape(B, H * D), jnp.stack([kc, vc])
+        out = out.reshape(B, H * D)
+        if oshift is not None:
+            # reference MMHAStore<T, T, true>: (out + shift) * smooth,
+            # per output channel
+            out = (out.astype(jnp.float32)
+                   + oshift.astype(jnp.float32).reshape(-1)[None, :]) \
+                * osmooth.astype(jnp.float32).reshape(-1)[None, :]
+        if quant_out:
+            # reference QuantHelperFunc: clip(round(max_bound * scale *
+            # v)) -> int8; round_type 0 = ties-to-even, 1 = half-away
+            qv = quant_max_bound * out_scale * out.astype(jnp.float32)
+            qv = jnp.rint(qv) if quant_round_type == 0 else \
+                jnp.sign(qv) * jnp.floor(jnp.abs(qv) + 0.5)
+            out = jnp.clip(qv, quant_min_bound, quant_max_bound).astype(
+                jnp.int8)
+        else:
+            out = out.astype(cache.dtype)
+        return out, jnp.stack([kc, vc])
 
-    return run_op("masked_multihead_attention", impl,
-                  (x, cache_kv, bias, sequence_lengths, rotary_tensor,
-                   src_mask), {}, differentiable=False)
+    res = run_op("masked_multihead_attention", impl,
+                 (x, cache_kv, bias, sequence_lengths, rotary_tensor,
+                  src_mask, beam_cache_offset, qkv_out_scale, out_shift,
+                  out_smooth), {}, differentiable=False)
+    if beam_cache_offset is not None:
+        # reference returns beam_cache_offset_out (inplace passthrough)
+        return res[0], res[1], beam_cache_offset
+    return res
 
 
 def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
@@ -387,10 +444,17 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
     from ....nn import functional as F
     from ....ops.pallas.decode_attention import decode_attention
 
-    if pre_caches is not None:
-        raise NotImplementedError(
-            "fused_multi_transformer: pre_caches (prefix-tuning prompts) "
-            "not implemented")
+    # pre_caches (prefix-tuning prompts, [2, B, H, P, D] per layer):
+    # context phase — queries attend to prefix + causal-current, and the
+    # prefix KV is written into cache_kvs ahead of the context KV
+    # (reference fused_multi_transformer_op.cu:199-277 cache_offset).
+    # Decode phase — RE-PASS pre_caches every step (the reference API
+    # shape): ``time_step`` counts context + generated tokens EXCLUDING
+    # the prefix, and the write slot is time_step + P.  Omitting
+    # pre_caches on decode after a prefixed context call would scatter
+    # into the middle of the filled cache, so P is rederived from the
+    # argument each call rather than guessed.
+    pres = list(pre_caches) if pre_caches is not None else None
     if dropout_rate and training:
         raise NotImplementedError(
             "fused_multi_transformer: training-mode dropout not "
@@ -434,6 +498,8 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         f2b = [nxt() for _ in range(n_layers)]
         kv = [nxt() for _ in range(n_layers)] if caches is not None else \
             [None] * n_layers
+        pc = [nxt() for _ in range(n_layers)] if pres is not None else \
+            [None] * n_layers
 
         B, S, E = xv.shape
         new_caches = []
@@ -467,24 +533,53 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                 q = q * cos + _rot_half(q) * sin
                 k = k * cos + _rot_half(k) * sin
             if decode:
-                lens = jnp.full((B,), t_step, jnp.int32)
+                # cache slot = prefix length + t_step (prefix KV occupies
+                # cache[:P] from the context phase); RoPE position above
+                # stays t_step — prefix prompts carry no positions
+                # (reference fused_multi_transformer_op.cu: out_seq_len =
+                # seq + cache_offset while rotary indexes the timestep)
+                P_dec = pc[i].shape[3] if pc[i] is not None else 0
+                slot = t_step + P_dec
+                lens = jnp.full((B,), slot, jnp.int32)
                 bidx = jnp.arange(B)
-                kc = kv[i][0].at[bidx, :, t_step].set(k[:, 0])
-                vc = kv[i][1].at[bidx, :, t_step].set(v[:, 0])
+                kc = kv[i][0].at[bidx, :, slot].set(k[:, 0])
+                vc = kv[i][1].at[bidx, :, slot].set(v[:, 0])
                 new_caches.append(jnp.stack([kc, vc]))
                 attn = decode_attention(q[:, 0], jnp.swapaxes(kc, 1, 2),
                                         jnp.swapaxes(vc, 1, 2), lens + 1)
                 attn = attn[:, None]                       # [B, 1, H, D]
             else:
+                k_full, v_full, amask = k, v, mask
+                if pc[i] is not None:
+                    pk = jnp.swapaxes(pc[i][0], 1, 2)   # [B, P, H, D]
+                    pv = jnp.swapaxes(pc[i][1], 1, 2)
+                    P = pk.shape[1]
+                    k_full = jnp.concatenate([pk.astype(k.dtype), k], 1)
+                    v_full = jnp.concatenate([pv.astype(v.dtype), v], 1)
+                    if amask is None:
+                        # prefix always visible; causal over current
+                        amask = jnp.tril(
+                            jnp.ones((S, P + S), bool), P)[None, None]
+                    elif amask.shape[-1] == S:
+                        # caller mask sized for the current tokens only:
+                        # extend with an always-visible prefix band
+                        if amask.dtype == jnp.bool_:
+                            band = jnp.ones(
+                                (*amask.shape[:-1], P), jnp.bool_)
+                        else:
+                            band = jnp.zeros(
+                                (*amask.shape[:-1], P), amask.dtype)
+                        amask = jnp.concatenate([band, amask], -1)
                 if kv[i] is not None:
-                    bidx = jnp.arange(B)[:, None]
-                    spos = jnp.arange(S)[None, :]
-                    kc = kv[i][0].at[bidx, :, spos].set(k)
-                    vc = kv[i][1].at[bidx, :, spos].set(v)
+                    Tfill = k_full.shape[1]
+                    kc = kv[i][0].at[:, :, :Tfill].set(
+                        jnp.swapaxes(k_full, 1, 2))
+                    vc = kv[i][1].at[:, :, :Tfill].set(
+                        jnp.swapaxes(v_full, 1, 2))
                     new_caches.append(jnp.stack([kc, vc]))
                 att = F.scaled_dot_product_attention(
-                    q, k, v, attn_mask=mask, is_causal=mask is None,
-                    training=False)
+                    q, k_full, v_full, attn_mask=amask,
+                    is_causal=amask is None, training=False)
                 attn = jnp.asarray(getattr(att, "_value", att))
             out = attn.reshape(B, S, H * D) @ lw[i]
             if lb[i] is not None:
@@ -514,6 +609,8 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                  + list(ffn2_biases))
     if caches is not None:
         flat_args += caches
+    if pres is not None:
+        flat_args += pres
     out = run_op("fused_multi_transformer", impl,
                  (x, attn_mask, rot, *flat_args), {}, differentiable=False)
     if caches is not None:

@@ -445,3 +445,167 @@ def test_gpt_speculative_exact_match():
                                           use_pallas=False)
     np.testing.assert_array_equal(np.asarray(got), want)
     assert stats["rounds"] >= 1
+
+
+def test_mmha_beam_cache_offset_gather():
+    """Beam path: per past position t, row (bb, beam) reads the cache row
+    of beam beam_cache_offset[bb, beam, t] (reference
+    masked_multihead_attention_kernel.cu:417-441 k_cache_batch indexing)."""
+    from paddle_tpu.incubate.nn import functional as IF
+    bbz, bw, H, D, T = 1, 2, 2, 8, 16
+    B = bbz * bw
+    x = rng.normal(size=(B, 3 * H * D)).astype(np.float32)
+    cache = np.zeros((2, B, H, T, D), np.float32)
+    cache[:, :, :, :4] = rng.normal(size=(2, B, H, 4, D))
+    lens = np.full((B,), 4, np.int32)
+    # beam 1 reads all past positions from beam 0's cache
+    off = np.zeros((bbz, bw, T), np.int32)
+    out, new_cache, off_out = IF.masked_multihead_attention(
+        pt.to_tensor(x), pt.to_tensor(cache),
+        sequence_lengths=pt.to_tensor(lens),
+        beam_cache_offset=pt.to_tensor(off))
+    assert np.asarray(off_out).shape == (bbz, bw, T)
+    # manual reference: every row attends to beam-0's past KV + its OWN
+    # current step (scattered at position 4 of its own row)
+    xr = np.asarray(x).reshape(B, 3, H, D)
+    q, k, v = xr[:, 0], xr[:, 1], xr[:, 2]
+    kc = cache[0].copy()
+    vc = cache[1].copy()
+    for b in range(B):
+        kc[b, :, 4] = k[b]
+        vc[b, :, 4] = v[b]
+    want = np.zeros((B, H, D), np.float32)
+    for b in range(B):
+        src = (b // bw) * bw + off.reshape(B, T)[b]     # [T]
+        src[4] = b          # current step always reads the own row
+        k_eff = kc[src, :, np.arange(T)]                # [T, H, D]
+        v_eff = vc[src, :, np.arange(T)]
+        sc = np.einsum("hd,thd->ht", q[b], k_eff) / np.sqrt(D)
+        sc[:, 5:] = -np.inf                             # lens+1 positions
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want[b] = np.einsum("ht,thd->hd", p, v_eff)
+    np.testing.assert_allclose(np.asarray(out).reshape(B, H, D), want,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_mmha_quant_in_out():
+    """int32 dequant in (qkv_out_scale) + int8 quant out (out_scale with
+    shift/smooth), reference MMHALoad<T,int32>/QuantHelperFunc formulas."""
+    from paddle_tpu.incubate.nn import functional as IF
+    B, H, D, T = 2, 2, 8, 16
+    x_int = rng.integers(-1000, 1000, (B, 3 * H * D)).astype(np.int32)
+    qkv_scale = rng.uniform(1e-4, 1e-3, (3, H, D)).astype(np.float32)
+    cache = np.zeros((2, B, H, T, np.int32(D)), np.float32)
+    cache[:, :, :, :3] = rng.normal(size=(2, B, H, 3, D)) * 0.1
+    lens = np.full((B,), 3, np.int32)
+    shift = rng.normal(size=(H * D,)).astype(np.float32) * 0.01
+    smooth = rng.uniform(0.9, 1.1, (H * D,)).astype(np.float32)
+    out, _ = IF.masked_multihead_attention(
+        pt.to_tensor(x_int), pt.to_tensor(cache),
+        sequence_lengths=pt.to_tensor(lens),
+        qkv_out_scale=pt.to_tensor(qkv_scale),
+        out_shift=pt.to_tensor(shift), out_smooth=pt.to_tensor(smooth),
+        out_scale=0.05, quant_round_type=1)
+    out = np.asarray(out)
+    assert out.dtype == np.int8
+    # reference float path, then quantize by hand
+    ref_f, _ = IF.masked_multihead_attention(
+        pt.to_tensor((x_int.astype(np.float32)
+                      * qkv_scale.reshape(-1)[None, :])),
+        pt.to_tensor(cache), sequence_lengths=pt.to_tensor(lens))
+    v = (np.asarray(ref_f) + shift[None]) * smooth[None]
+    qv = 127.0 * 0.05 * v
+    qv = np.sign(qv) * np.floor(np.abs(qv) + 0.5)
+    want = np.clip(qv, -127.0, 127.0).astype(np.int8)
+    # rounding at the .5 boundary may differ by 1 ulp on accumulated sums
+    assert (np.abs(out.astype(np.int32) - want.astype(np.int32)) <= 1).all()
+
+
+def test_fused_multi_transformer_pre_caches():
+    """Context phase with prefix-tuning pre_caches: queries see prefix +
+    causal current, and the cache holds [prefix, context] (reference
+    fused_multi_transformer_op.cu cache_offset path)."""
+    from paddle_tpu.incubate.nn import functional as IF
+    B, S, H, D, P = 2, 4, 2, 8, 3
+    E = H * D
+    Tmax = 16
+    ln_s = np.ones((E,), np.float32)
+    ln_b = np.zeros((E,), np.float32)
+    qkvw = rng.normal(size=(3, H, D, E)).astype(np.float32) * 0.05
+    lw = rng.normal(size=(E, E)).astype(np.float32) * 0.05
+    f1 = rng.normal(size=(E, 2 * E)).astype(np.float32) * 0.05
+    f2 = rng.normal(size=(2 * E, E)).astype(np.float32) * 0.05
+    x = rng.normal(size=(B, S, E)).astype(np.float32)
+    pre = rng.normal(size=(2, B, H, P, D)).astype(np.float32) * 0.3
+    cache = np.zeros((2, B, H, Tmax, D), np.float32)
+    t = pt.to_tensor
+    out, caches = IF.fused_multi_transformer(
+        t(x), [t(ln_s)], [t(ln_b)], [t(qkvw)], [None], [t(lw)], [None],
+        [t(ln_s)], [t(ln_b)], [t(f1)], [None], [t(f2)], [None],
+        cache_kvs=[t(cache)], pre_caches=[t(pre)])
+    got_cache = np.asarray(caches[0])
+    # prefix occupies cache[:P], context KV comes next
+    np.testing.assert_allclose(got_cache[0][:, :, :P],
+                               pre[0], rtol=1e-5, atol=1e-5)
+    # manual: q from LN(x); attends over [pre_k, k]
+    y = np.asarray(x)
+    mu = y.mean(-1, keepdims=True)
+    var = y.var(-1, keepdims=True)
+    h = (y - mu) / np.sqrt(var + 1e-5)
+    qkv = np.einsum("bse,thde->bsthd", h, qkvw)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    kf = np.concatenate([np.swapaxes(pre[0], 1, 2), k], 1)  # [B,P+S,H,D]
+    vf = np.concatenate([np.swapaxes(pre[1], 1, 2), v], 1)
+    np.testing.assert_allclose(got_cache[0][:, :, P:P + S],
+                               np.swapaxes(k, 1, 2), rtol=1e-5, atol=1e-5)
+    sc = np.einsum("bqhd,bkhd->bhqk", q, kf) / np.sqrt(D)
+    mask = np.tril(np.ones((S, P + S)), P).astype(bool)
+    sc = np.where(mask[None, None], sc, -1e30)
+    p = np.exp(sc - sc.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    attn = np.einsum("bhqk,bkhd->bqhd", p, vf).reshape(B, S, E)
+    resid = np.asarray(x) + attn @ lw
+    hh = (resid - resid.mean(-1, keepdims=True)) / np.sqrt(
+        resid.var(-1, keepdims=True) + 1e-5)
+    act = 0.5 * (hh @ f1) * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                         * ((hh @ f1)
+                                            + 0.044715 * (hh @ f1) ** 3)))
+    want = resid + act @ f2
+    np.testing.assert_allclose(np.asarray(out), want, rtol=2e-3, atol=2e-3)
+
+
+def test_fused_multi_transformer_pre_caches_decode():
+    """Decode convention (pinned): re-pass pre_caches each step;
+    time_step counts context+generated tokens EXCLUDING the prefix, so
+    the write slot is time_step + P and attention covers the prefix."""
+    from paddle_tpu.incubate.nn import functional as IF
+    B, S, H, D, P = 1, 4, 2, 8, 3
+    E = H * D
+    Tmax = 16
+    t = pt.to_tensor
+    ln_s, ln_b = np.ones((E,), np.float32), np.zeros((E,), np.float32)
+    qkvw = rng.normal(size=(3, H, D, E)).astype(np.float32) * 0.05
+    lw = rng.normal(size=(E, E)).astype(np.float32) * 0.05
+    f1 = rng.normal(size=(E, 2 * E)).astype(np.float32) * 0.05
+    f2 = rng.normal(size=(2 * E, E)).astype(np.float32) * 0.05
+    pre = rng.normal(size=(2, B, H, P, D)).astype(np.float32) * 0.3
+    cache = np.zeros((2, B, H, Tmax, D), np.float32)
+    x_ctx = rng.normal(size=(B, S, E)).astype(np.float32)
+    args = ([t(ln_s)], [t(ln_b)], [t(qkvw)], [None], [t(lw)], [None],
+            [t(ln_s)], [t(ln_b)], [t(f1)], [None], [t(f2)], [None])
+    _, caches = IF.fused_multi_transformer(
+        t(x_ctx), *args, cache_kvs=[t(cache)], pre_caches=[t(pre)])
+    # decode one token at time_step = S (context length, prefix excluded)
+    x_dec = rng.normal(size=(B, 1, E)).astype(np.float32)
+    out_d, caches2 = IF.fused_multi_transformer(
+        t(x_dec), *args, cache_kvs=[t(np.asarray(caches[0]))],
+        pre_caches=[t(pre)], time_step=S)
+    c2 = np.asarray(caches2[0])
+    # new token lands at slot P + S; prefix/context slots untouched
+    np.testing.assert_allclose(c2[0][:, :, :P + S],
+                               np.asarray(caches[0])[0][:, :, :P + S],
+                               rtol=1e-6)
+    assert np.abs(c2[0][:, :, P + S]).sum() > 0
+    assert np.abs(c2[0][:, :, P + S + 1:]).sum() == 0
+    assert np.isfinite(np.asarray(out_d)).all()
