@@ -432,23 +432,65 @@ def _child_main() -> None:
             "value": 0.0,
             "unit": "tokens/s/chip",
             "vs_baseline": 0.0,
+            "failed": True,
             "error": f"{type(e).__name__}: {e}",
             "traceback": traceback.format_exc(limit=5),
         }
+    # a CPU-platform measurement is a liveness proxy, never hardware
+    # evidence — stamp it unambiguously (VERDICT r4 weak #1)
+    dev = str(out.get("extra", {}).get("device", ""))
+    if dev and not (dev.startswith("TPU") or dev.lower().startswith("axon")):
+        out.setdefault("extra", {})["fallback"] = True
     print(json.dumps(out))
+
+
+def _chip_probe(timeout: int) -> bool:
+    """Cheap jax.devices() liveness check in a throwaway subprocess: a
+    wedged tunnel must cost seconds here, not the full watchdog budget
+    (VERDICT r4 item 10 — maximize the chance the driver's capture lands
+    on hardware by probing cheaply and retrying, falling back late)."""
+    import signal
+    import subprocess
+    snippet = ("import jax,json;d=jax.devices();"
+               "print(json.dumps(d[0].platform))")
+    # Popen + new session + killpg (same lesson as main()'s watchdog):
+    # an axon helper grandchild inherits the pipes and can hold them open
+    # past the child's exit, so communicate() must be bounded and the
+    # whole process GROUP killed, keeping any partial stdout.
+    try:
+        with open(os.devnull) as devnull:
+            proc = subprocess.Popen(
+                [sys.executable, "-c", snippet], stdin=devnull,
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, start_new_session=True)
+        try:
+            stdout, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            stdout, _ = proc.communicate()
+        return any(p in (stdout or "") for p in ('"tpu"', '"axon"'))
+    except Exception:
+        return False
 
 
 def main() -> None:
     """Watchdog wrapper: run the measurement in a subprocess (the tunnel can
-    hang a device op indefinitely); on timeout/failure retry once, then
-    force CPU.  Prints exactly one JSON line."""
+    hang a device op indefinitely).  Probe the chip cheaply first; while it
+    answers, spend the budget on accelerator attempts (re-probing between
+    them); only then fall back to CPU.  Prints exactly one JSON line."""
     import subprocess
-    # 900s first attempt -> worst case 900+450+450 = 30min to a JSON line
-    # even with the axon tunnel wedged (observed blocking jax.devices()
-    # indefinitely in rounds 1 and 2)
     budget = int(os.environ.get("BENCH_TIMEOUT", "900"))
-    attempts = [({}, budget), ({}, budget // 2),
-                ({"JAX_PLATFORMS": "cpu"}, budget // 2)]
+    if _chip_probe(60) or _chip_probe(30):
+        attempts = [({}, budget), ({}, budget // 2),
+                    ({"JAX_PLATFORMS": "cpu"}, budget // 2)]
+    else:
+        # tunnel dead right now (two probes failed): go straight to the
+        # CPU liveness row — marked fallback:true — so the driver gets its
+        # JSON line quickly; chip windows are captured by tools/tpu_probe.py
+        attempts = [({"JAX_PLATFORMS": "cpu"}, budget // 2)]
     note = None
     for extra_env, tmo in attempts:
         env = dict(os.environ, _BENCH_CHILD="1", **extra_env)
@@ -486,26 +528,36 @@ def main() -> None:
                 d = json.loads(line)
                 d.setdefault("extra", {})["watchdog"] = note
                 print(json.dumps(d))
-                return
+                _exit_by_row(d)
             except Exception:
                 continue
         line = next((ln for ln in reversed(stdout.splitlines())
                      if ln.startswith("{")), None)
         if line:
-            if note:
-                try:
-                    d = json.loads(line)
-                    d.setdefault("extra", {})["watchdog"] = note
-                    line = json.dumps(d)
-                except Exception:
-                    pass
+            try:
+                d = json.loads(line)
+            except Exception:
+                d = None
+            if d is not None and note:
+                d.setdefault("extra", {})["watchdog"] = note
+                line = json.dumps(d)
             print(line)
-            return
+            _exit_by_row(d)
         note = f"bench subprocess rc={proc.returncode}: {stderr[-400:]}"
     print(json.dumps({
         "metric": "gpt_train_tokens_per_sec_per_chip", "value": 0.0,
-        "unit": "tokens/s/chip", "vs_baseline": 0.0,
+        "unit": "tokens/s/chip", "vs_baseline": 0.0, "failed": True,
         "error": note or "no output"}))
+    sys.exit(1)
+
+
+def _exit_by_row(d) -> None:
+    """A zero-value / errored row must not exit rc=0 (VERDICT r4 weak #5:
+    the llama SIGKILL row masqueraded as a measurement)."""
+    failed = (not isinstance(d, dict) or d.get("failed")
+              or (float(d.get("value") or 0.0) == 0.0 and
+                  ("error" in d or "error" in d.get("extra", {}))))
+    sys.exit(1 if failed else 0)
 
 
 if __name__ == "__main__":

@@ -81,6 +81,9 @@ class GenRequest:
     top_p: Optional[float] = None
     seed: int = 0
     out: List[int] = field(default_factory=list)
+    # index of the first EOS in ``out`` (set by the scheduler the step the
+    # token is appended — O(1) per step instead of rescanning the list)
+    eos_pos: Optional[int] = None
 
 
 def _make_rms_ffn(cfg):
@@ -178,8 +181,11 @@ class ContinuousBatchingEngine:
         # iteration and the old buffers must not stay live
         self._step = jax.jit(self._build_step(),
                              donate_argnums=(1, 2))
-        self._prefill_cache: Dict[int, object] = {}
-        self._chunk_fill_cache: Dict[int, object] = {}
+        # LRU-bounded (a serving workload with many distinct prompt
+        # lengths must not retain unboundedly many XLA executables)
+        from ..utils.lru import LRUCache
+        self._prefill_cache = LRUCache(16)
+        self._chunk_fill_cache = LRUCache(16)
         self.last_logits: Optional[np.ndarray] = None   # [B, V] debug/test
 
     # ------------------------------------------------------------------
@@ -300,7 +306,7 @@ class ContinuousBatchingEngine:
         if fn is None:
             fn = jax.jit(self._build_chunk_fill(Ts),
                          donate_argnums=(1, 2))
-            self._chunk_fill_cache[Ts] = fn
+            self._chunk_fill_cache.put(Ts, fn)
         return fn
 
     # ------------------------------------------------------------------
@@ -313,6 +319,10 @@ class ContinuousBatchingEngine:
                     top_p: Optional[float] = None,
                     seed: int = 0) -> int:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if len(prompt) < 1:
+            raise ValueError("prompt must contain at least one token "
+                             "(an empty prompt has no last position for "
+                             "the prefill to sample from)")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill "
                              "argmax is already one generated token)")
@@ -489,7 +499,7 @@ class ContinuousBatchingEngine:
                     prefill, _ = build_llama_decoder(self.cfg, T0,
                                                      use_pallas=False)
                     jprefill = jax.jit(prefill)
-                    self._prefill_cache[T0] = jprefill
+                    self._prefill_cache.put(T0, jprefill)
                 cache, logits = jprefill(self.params, req.prompt[None, :])
                 # move prompt KV into the pool pages ON DEVICE with ONE
                 # scatter per pool; the padded tail of the last page
@@ -511,22 +521,26 @@ class ContinuousBatchingEngine:
             self._register_prefix(req.prompt, table)
             first = self._pick_token(req, np.asarray(logits)[0],
                                      position=T0)
-            req.out.append(first)
+            self._append_tok(req, first)
             self.slots[slot] = req
             self.lengths[slot] = T0
             self.tokens[slot] = first
 
+    @staticmethod
+    def _append_tok(req: GenRequest, tok: int) -> None:
+        req.out.append(tok)
+        if req.eos_token_id is not None and req.eos_pos is None \
+                and tok == req.eos_token_id:
+            req.eos_pos = len(req.out) - 1
+
     def _retire_done(self) -> None:
         for s in range(self.B):
             req = self.slots[s]
-            if req is not None and (
-                    len(req.out) >= req.max_new_tokens
-                    or (req.eos_token_id is not None and req.out
-                        and req.eos_token_id in req.out)):
+            if req is not None and (len(req.out) >= req.max_new_tokens
+                                    or req.eos_pos is not None):
                 # truncate anything after the first eos
-                if req.eos_token_id is not None \
-                        and req.eos_token_id in req.out:
-                    req.out = req.out[:req.out.index(req.eos_token_id) + 1]
+                if req.eos_pos is not None:
+                    req.out = req.out[:req.eos_pos + 1]
                 self._retire(s)
 
     def _free_slot(self, slot: int) -> None:
@@ -601,7 +615,7 @@ class ContinuousBatchingEngine:
             tok = picks.get(s)
             if tok is None:
                 tok = int(self.last_logits[s].argmax())
-            req.out.append(int(tok))
+            self._append_tok(req, int(tok))
             self.tokens[s] = int(tok)
         out = self.finished
         self.finished = {}

@@ -436,9 +436,9 @@ def build_llama_decoder(cfg, max_len: int,
 # ---------------------------------------------------------------------------
 # bounded compiled-rollout cache (serving loops vary B/T0 freely; each
 # entry pins a jitted closure + XLA executables)
-_RUN_CACHE: "collections.OrderedDict[Any, Callable]" = \
-    collections.OrderedDict()
-_RUN_CACHE_MAX = 16
+from ..utils.lru import LRUCache as _LRUCache
+
+_RUN_CACHE = _LRUCache(16)
 
 
 def _generate(decoder_builder, cfg, params, input_ids, max_new_tokens,
@@ -462,7 +462,6 @@ def _generate(decoder_builder, cfg, params, input_ids, max_new_tokens,
                  temperature, top_k, top_p, eos_token_id, use_pallas)
     cached = _RUN_CACHE.get(cache_key)
     if cached is not None:
-        _RUN_CACHE.move_to_end(cache_key)
         new = cached(params, ids, jax.random.key(seed))
         return jnp.concatenate([ids.astype(new.dtype), new], axis=1)
 
@@ -495,9 +494,7 @@ def _generate(decoder_builder, cfg, params, input_ids, max_new_tokens,
         toks = jnp.moveaxis(toks, 0, 1)          # [B, max_new-1]
         return jnp.concatenate([toks, last[:, None]], axis=1)
 
-    _RUN_CACHE[cache_key] = run
-    while len(_RUN_CACHE) > _RUN_CACHE_MAX:
-        _RUN_CACHE.popitem(last=False)
+    _RUN_CACHE.put(cache_key, run)
     new = run(params, ids, jax.random.key(seed))
     return jnp.concatenate([ids.astype(new.dtype), new], axis=1)
 
@@ -578,11 +575,7 @@ def _speculative_generate(builder, params, cfg, draft_params, draft_cfg,
                                     use_pallas=use_pallas)
         cached = (jax.jit(prefill_t), jax.jit(chunk_t),
                   jax.jit(prefill_d), jax.jit(step_d))
-        _RUN_CACHE[ck] = cached
-        while len(_RUN_CACHE) > _RUN_CACHE_MAX:
-            _RUN_CACHE.popitem(last=False)
-    else:
-        _RUN_CACHE.move_to_end(ck)
+        _RUN_CACHE.put(ck, cached)
     jprefill_t, jchunk, jprefill_d, jstep_d = cached
 
     t_cache, t_logits = jprefill_t(params, ids)

@@ -1,0 +1,37 @@
+"""Small bounded LRU used to cap per-shape XLA-executable caches.
+
+One shared implementation for every site that jits per shape signature
+(models/generation.py rollout cache, inference/serving.py prefill and
+chunk-fill caches): a serving workload with many distinct prompt lengths
+must not retain unboundedly many compiled programs.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Optional
+
+
+class LRUCache:
+    def __init__(self, maxsize: int = 16):
+        self.maxsize = maxsize
+        self._d: "collections.OrderedDict[Any, Any]" = \
+            collections.OrderedDict()
+
+    def get(self, key) -> Optional[Any]:
+        val = self._d.get(key)
+        if val is not None:
+            self._d.move_to_end(key)
+        return val
+
+    def put(self, key, val) -> None:
+        self._d[key] = val
+        self._d.move_to_end(key)
+        while len(self._d) > self.maxsize:
+            self._d.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def clear(self) -> None:
+        self._d.clear()
