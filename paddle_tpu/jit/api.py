@@ -52,7 +52,25 @@ class StaticFunction:
         self._input_spec = input_spec
         self._full_graph = full_graph
         self._fell_back = False
+        self._hybrid = None        # lazy graph-break segmentation
         functools.update_wrapper(self, fn)
+        if not full_graph:
+            # try-handlers can swallow tracer errors MID-TRACE and make a
+            # broken trace look successful (wrong branch, wrong result) —
+            # those functions graph-break up front (jit/graph_break.py)
+            from .graph_break import build_hybrid, needs_proactive_break
+            if needs_proactive_break(fn):
+                self._hybrid = build_hybrid(fn)
+                self._fell_back = self._hybrid is not None
+                if self._fell_back:
+                    import warnings
+                    warnings.warn(
+                        f"to_static: {getattr(fn, '__qualname__', '?')} "
+                        "has a try-handler broad enough to swallow tracer "
+                        "errors mid-trace; running as compiled subgraphs "
+                        "with the try interpreted (graph break). Narrow "
+                        "the except clause or pass full_graph=True to "
+                        "compile whole-graph.", stacklevel=3)
 
         # dy2static: rewrite tensor-dependent if/while/for into
         # lax.cond/while_loop/fori_loop via runtime-dispatched helpers
@@ -77,6 +95,12 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if not _TO_STATIC_ENABLED:
             return self._fn(*args, **kwargs)   # eager fallback (debug)
+        if self._fell_back:
+            # memoized graph break: don't re-pay a failing whole-graph
+            # trace every call; segments stay jitted inside the hybrid
+            if self._hybrid is not None:
+                return self._hybrid(*args, **kwargs)
+            return self._fn(*args, **kwargs)
         vargs = jax.tree.map(_unwrap, args,
                              is_leaf=lambda x: isinstance(x, Tensor))
         vkwargs = jax.tree.map(_unwrap, kwargs,
@@ -91,19 +115,29 @@ class StaticFunction:
                 ConversionFallback) as e:
             # SOT graph-break semantics (reference jit/sot/translate.py:30):
             # a construct the AST pass left unconverted concretized a
-            # tracer.  With full_graph=True that's an error; otherwise run
-            # the whole call eagerly — correct, just uncompiled.
+            # tracer.  With full_graph=True that's an error; otherwise
+            # split the function at the break and keep the compilable
+            # segments jitted (jit/graph_break.py); whole-call eager only
+            # when the function cannot be segmented at all.
             if self._full_graph:
                 raise
+            if self._hybrid is None and not self._fell_back:
+                from .graph_break import build_hybrid
+                self._hybrid = build_hybrid(self._fn)
             if not self._fell_back:
                 self._fell_back = True
                 import warnings
+                mode = ("subgraph (graph break: compilable segments stay "
+                        "jitted)") if self._hybrid is not None else \
+                    "whole-call eager (graph break)"
                 warnings.warn(
                     f"to_static: {getattr(self._fn, '__qualname__', '?')} "
                     f"uses untraceable control flow ({type(e).__name__}); "
-                    "falling back to eager execution (graph break). Pass "
+                    f"falling back to {mode} execution. Pass "
                     "full_graph=True to make this an error.",
                     stacklevel=2)
+            if self._hybrid is not None:
+                return self._hybrid(*args, **kwargs)
             return self._fn(*args, **kwargs)
         return jax.tree.map(_wrap, out)
 

@@ -139,13 +139,18 @@ def build_gpt_decoder(cfg, max_len: int, use_pallas: Optional[bool] = None,
         return cache, final_logits(params, x[:, -1])
 
     def step(params, cache, token, pos):
-        """One decode step at position ``pos`` (0-based global index)."""
+        """One decode step at position ``pos`` — a 0-based global index,
+        scalar (all rows aligned) or [B] vector (per-row positions, the
+        batched-speculative case)."""
         B = token.shape[0]
+        vec = jnp.ndim(pos) == 1
         blocks = _collapse_blocks(params["blocks"])
-        x = jnp.take(params["wte"], token, axis=0) \
-            + jax.lax.dynamic_index_in_dim(params["wpe"], pos, 0,
-                                           keepdims=False)[None]
-        lengths = jnp.full((B,), pos + 1, jnp.int32)
+        wpe_t = jnp.take(params["wpe"], pos, axis=0) if vec else \
+            jax.lax.dynamic_index_in_dim(params["wpe"], pos, 0,
+                                         keepdims=False)[None]
+        x = jnp.take(params["wte"], token, axis=0) + wpe_t
+        lengths = (pos + 1).astype(jnp.int32) if vec else \
+            jnp.full((B,), pos + 1, jnp.int32)
 
         def body(carry, inp):
             x = carry
@@ -154,10 +159,14 @@ def build_gpt_decoder(cfg, max_len: int, use_pallas: Optional[bool] = None,
             qkv = y @ lp["qkv_w"] + lp["qkv_b"]
             qkv = qkv.reshape(B, H, 3 * D)
             q, k, v = jnp.split(qkv, 3, axis=-1)
-            k_l = jax.lax.dynamic_update_slice(
-                k_l, k[:, None], (0, pos, 0, 0))
-            v_l = jax.lax.dynamic_update_slice(
-                v_l, v[:, None], (0, pos, 0, 0))
+            if vec:
+                k_l = k_l.at[jnp.arange(B), pos].set(k)
+                v_l = v_l.at[jnp.arange(B), pos].set(v)
+            else:
+                k_l = jax.lax.dynamic_update_slice(
+                    k_l, k[:, None], (0, pos, 0, 0))
+                v_l = jax.lax.dynamic_update_slice(
+                    v_l, v[:, None], (0, pos, 0, 0))
             attn = decode_attention(q, k_l, v_l, lengths,
                                     use_pallas=use_pallas)
             x = x + attn.reshape(B, -1) @ lp["proj_w"] + lp["proj_b"]
@@ -170,14 +179,22 @@ def build_gpt_decoder(cfg, max_len: int, use_pallas: Optional[bool] = None,
     def chunk_step(params, cache, toks, pos):
         """Speculative verify: K1 consecutive tokens in one cached pass
         (see build_llama_decoder.chunk_step; GPT uses learned position
-        embeddings instead of rope)."""
+        embeddings instead of rope).  ``pos`` scalar or [B] vector."""
         B, K1 = toks.shape
+        vec = jnp.ndim(pos) == 1
         blocks = _collapse_blocks(params["blocks"])
-        pos_ids = pos + jnp.arange(K1)
-        x = jnp.take(params["wte"], toks, axis=0) \
-            + jnp.take(params["wpe"], pos_ids, axis=0)[None]
-        jpos = jnp.arange(max_len)[None, None, None, :]
-        mask = jpos <= pos_ids[None, None, :, None]
+        if vec:
+            pos_ids = pos[:, None] + jnp.arange(K1)[None, :]   # [B, K1]
+            x = jnp.take(params["wte"], toks, axis=0) \
+                + jnp.take(params["wpe"], pos_ids, axis=0)
+            mask = jnp.arange(max_len)[None, None, None, :] \
+                <= pos_ids[:, None, :, None]               # [B,1,K1,T]
+        else:
+            pos_ids = pos + jnp.arange(K1)
+            x = jnp.take(params["wte"], toks, axis=0) \
+                + jnp.take(params["wpe"], pos_ids, axis=0)[None]
+            jpos = jnp.arange(max_len)[None, None, None, :]
+            mask = jpos <= pos_ids[None, None, :, None]
         scale = 1.0 / math.sqrt(D)
 
         def body(carry, inp):
@@ -187,8 +204,12 @@ def build_gpt_decoder(cfg, max_len: int, use_pallas: Optional[bool] = None,
             qkv = y @ lp["qkv_w"] + lp["qkv_b"]
             qkv = qkv.reshape(B, K1, H, 3 * D)
             q, k, v = jnp.split(qkv, 3, axis=-1)
-            k_l = jax.lax.dynamic_update_slice(k_l, k, (0, pos, 0, 0))
-            v_l = jax.lax.dynamic_update_slice(v_l, v, (0, pos, 0, 0))
+            if vec:
+                k_l = k_l.at[jnp.arange(B)[:, None], pos_ids].set(k)
+                v_l = v_l.at[jnp.arange(B)[:, None], pos_ids].set(v)
+            else:
+                k_l = jax.lax.dynamic_update_slice(k_l, k, (0, pos, 0, 0))
+                v_l = jax.lax.dynamic_update_slice(v_l, v, (0, pos, 0, 0))
             attn = _dense_masked_attention(
                 q, k_l, v_l, mask, scale).reshape(B, K1, -1)
             x = x + attn @ lp["proj_w"] + lp["proj_b"]
@@ -205,6 +226,16 @@ def build_gpt_decoder(cfg, max_len: int, use_pallas: Optional[bool] = None,
     if with_chunk:
         return prefill, step, chunk_step
     return prefill, step
+
+
+def _rope_rows(q, k, cos_bt, sin_bt):
+    """Per-row RoPE: q,k [B, S, h, d]; cos/sin [B, S, d] gathered at each
+    row's own positions (batched speculative decoding, where rows sit at
+    divergent cache positions)."""
+    from .llama import _rotate_half
+    c = cos_bt[:, :, None, :]
+    s = sin_bt[:, :, None, :]
+    return q * c + _rotate_half(q) * s, k * c + _rotate_half(k) * s
 
 
 def _dense_masked_attention(q, k, v, mask, scale):
@@ -360,12 +391,20 @@ def build_llama_decoder(cfg, max_len: int,
         return cache, final_logits(params, x[:, -1])
 
     def step(params, cache, token, pos):
+        """``pos``: scalar (aligned rows) or [B] vector (per-row
+        positions, the batched-speculative case)."""
         B = token.shape[0]
+        vec = jnp.ndim(pos) == 1
         blocks = _collapse_blocks(params["blocks"])
         x = jnp.take(params["wte"], token, axis=0)
-        cos_t = jax.lax.dynamic_slice_in_dim(cos_full, pos, 1, 0)
-        sin_t = jax.lax.dynamic_slice_in_dim(sin_full, pos, 1, 0)
-        lengths = jnp.full((B,), pos + 1, jnp.int32)
+        if vec:
+            cos_t = jnp.take(cos_full, pos, axis=0)[:, None]  # [B, 1, d]
+            sin_t = jnp.take(sin_full, pos, axis=0)[:, None]
+            lengths = (pos + 1).astype(jnp.int32)
+        else:
+            cos_t = jax.lax.dynamic_slice_in_dim(cos_full, pos, 1, 0)
+            sin_t = jax.lax.dynamic_slice_in_dim(sin_full, pos, 1, 0)
+            lengths = jnp.full((B,), pos + 1, jnp.int32)
 
         def body(carry, inp):
             x = carry
@@ -374,9 +413,14 @@ def build_llama_decoder(cfg, max_len: int,
             q = mm(lp, "q_w", y).reshape(B, 1, H, D)
             k = mm(lp, "k_w", y).reshape(B, 1, Hkv, D)
             v = mm(lp, "v_w", y).reshape(B, 1, Hkv, D)
-            q, k = apply_rope(q, k, cos_t, sin_t)
-            k_l = jax.lax.dynamic_update_slice(k_l, k, (0, pos, 0, 0))
-            v_l = jax.lax.dynamic_update_slice(v_l, v, (0, pos, 0, 0))
+            if vec:
+                q, k = _rope_rows(q, k, cos_t, sin_t)
+                k_l = k_l.at[jnp.arange(B), pos].set(k[:, 0])
+                v_l = v_l.at[jnp.arange(B), pos].set(v[:, 0])
+            else:
+                q, k = apply_rope(q, k, cos_t, sin_t)
+                k_l = jax.lax.dynamic_update_slice(k_l, k, (0, pos, 0, 0))
+                v_l = jax.lax.dynamic_update_slice(v_l, v, (0, pos, 0, 0))
             attn = decode_attention(q[:, 0], k_l, v_l, lengths,
                                     use_pallas=use_pallas)
             x = x + mm(lp, "o_w", attn.reshape(B, -1))
@@ -395,15 +439,24 @@ def build_llama_decoder(cfg, max_len: int,
         [B, K1, V].  Attention is dense q-vs-cache with a per-query
         length mask (query i sees cache[j] iff j <= pos+i), so the MXU
         sees a K1-row matmul instead of K1 vector passes — the
-        arithmetic-intensity win speculative decoding banks on."""
+        arithmetic-intensity win speculative decoding banks on.
+        ``pos`` scalar or [B] vector (per-row positions)."""
         B, K1 = toks.shape
+        vec = jnp.ndim(pos) == 1
         blocks = _collapse_blocks(params["blocks"])
         x = jnp.take(params["wte"], toks, axis=0)          # [B, K1, h]
-        cos = jax.lax.dynamic_slice_in_dim(cos_full, pos, K1, 0)
-        sin = jax.lax.dynamic_slice_in_dim(sin_full, pos, K1, 0)
-        jpos = jnp.arange(max_len)[None, None, None, :]
-        qpos = (pos + jnp.arange(K1))[None, None, :, None]
-        mask = jpos <= qpos                                # [1,1,K1,T]
+        if vec:
+            pos_ids = pos[:, None] + jnp.arange(K1)[None, :]   # [B, K1]
+            cos = jnp.take(cos_full, pos_ids, axis=0)      # [B, K1, d]
+            sin = jnp.take(sin_full, pos_ids, axis=0)
+            mask = jnp.arange(max_len)[None, None, None, :] \
+                <= pos_ids[:, None, :, None]               # [B,1,K1,T]
+        else:
+            cos = jax.lax.dynamic_slice_in_dim(cos_full, pos, K1, 0)
+            sin = jax.lax.dynamic_slice_in_dim(sin_full, pos, K1, 0)
+            jpos = jnp.arange(max_len)[None, None, None, :]
+            qpos = (pos + jnp.arange(K1))[None, None, :, None]
+            mask = jpos <= qpos                            # [1,1,K1,T]
         scale = 1.0 / math.sqrt(D)
 
         def body(carry, inp):
@@ -413,9 +466,14 @@ def build_llama_decoder(cfg, max_len: int,
             q = mm(lp, "q_w", y).reshape(B, K1, H, D)
             k = mm(lp, "k_w", y).reshape(B, K1, Hkv, D)
             v = mm(lp, "v_w", y).reshape(B, K1, Hkv, D)
-            q, k = apply_rope(q, k, cos, sin)
-            k_l = jax.lax.dynamic_update_slice(k_l, k, (0, pos, 0, 0))
-            v_l = jax.lax.dynamic_update_slice(v_l, v, (0, pos, 0, 0))
+            if vec:
+                q, k = _rope_rows(q, k, cos, sin)
+                k_l = k_l.at[jnp.arange(B)[:, None], pos_ids].set(k)
+                v_l = v_l.at[jnp.arange(B)[:, None], pos_ids].set(v)
+            else:
+                q, k = apply_rope(q, k, cos, sin)
+                k_l = jax.lax.dynamic_update_slice(k_l, k, (0, pos, 0, 0))
+                v_l = jax.lax.dynamic_update_slice(v_l, v, (0, pos, 0, 0))
             attn = _dense_masked_attention(
                 q, k_l, v_l, mask, scale).reshape(B, K1, -1)
             x = x + mm(lp, "o_w", attn)
@@ -539,16 +597,14 @@ def _speculative_generate(builder, params, cfg, draft_params, draft_cfg,
     evaluations to agree at argmax, which holds except on floating-point
     near-ties (real models; random-init weights sit near ties often).
 
-    Batch 1 only: acceptance length is data-dependent per sequence, so
-    rows would need divergent cache positions.  Returns
-    ([1, T0 + max_new_tokens] ids, stats dict with rounds/accept rate).
+    Batched: per-row acceptance lengths diverge, so every draft/verify
+    step runs at per-row cache positions ([B] pos vectors through the
+    builders' vector-pos path); rows that finish early keep riding the
+    batch with frozen positions until the slowest row completes.
+    Returns ([B, T0 + max_new_tokens] ids, stats dict).
     """
     ids = jnp.asarray(input_ids)
     B, T0 = ids.shape
-    if B != 1:
-        raise NotImplementedError(
-            "speculative decoding serves one sequence at a time "
-            "(per-row acceptance lengths diverge cache positions)")
     if max_new_tokens <= 0:
         return ids, {"rounds": 0, "accepted_drafts": 0,
                      "proposed": 0, "accept_rate": 0.0}
@@ -580,49 +636,60 @@ def _speculative_generate(builder, params, cfg, draft_params, draft_cfg,
 
     t_cache, t_logits = jprefill_t(params, ids)
     d_cache, _ = jprefill_d(draft_params, ids)
-    last = jnp.argmax(t_logits, -1).astype(jnp.int32)     # [1]
+    last = jnp.argmax(t_logits, -1).astype(jnp.int32)     # [B]
 
-    out = [int(last[0])]
-    pos = T0            # next unwritten target-cache position == seq len
+    outs = [[int(t)] for t in np.asarray(last)]           # per-row tokens
+    pos = np.full((B,), T0, np.int64)   # next unwritten cache position
     rounds = accepted = proposed = 0
-    while len(out) < max_new_tokens:
-        # draft proposes K tokens (positions pos .. pos+K-1)
+    while any(len(o) < max_new_tokens for o in outs):
+        pos_v = jnp.asarray(pos, jnp.int32)
+        # draft proposes K tokens per row (positions pos_b .. pos_b+K-1)
         props = []
         dtok = last
         for i in range(K):
             d_cache, dl = jstep_d(draft_params, d_cache, dtok,
-                                  jnp.int32(pos + i))
+                                  pos_v + jnp.int32(i))
             dtok = jnp.argmax(dl, -1).astype(jnp.int32)
             props.append(dtok)
-        # target verifies [last, d1..dK] in one pass at positions
-        # pos..pos+K; argmax[i] is the target's token AFTER chunk[i]
-        chunk = jnp.stack([last] + props, axis=1)          # [1, K+1]
-        t_cache, cl = jchunk(params, t_cache, chunk, jnp.int32(pos))
-        tgt = np.asarray(jnp.argmax(cl, -1))[0]            # [K+1]
-        props_np = np.asarray(chunk)[0, 1:].tolist()   # one host sync
-        n = 0
-        while n < K and props_np[n] == int(tgt[n]) \
-                and len(out) + n + 1 < max_new_tokens:
-            n += 1
-        new_toks = props_np[:n] + [int(tgt[n])]
-        out.extend(new_toks)
+        # target verifies [last, d1..dK] in one pass at per-row positions
+        # pos_b..pos_b+K; argmax[i] is the target's token AFTER chunk[i]
+        chunk = jnp.stack([last] + props, axis=1)          # [B, K+1]
+        t_cache, cl = jchunk(params, t_cache, chunk, pos_v)
+        tgt = np.asarray(jnp.argmax(cl, -1))               # [B, K+1]
+        props_np = np.asarray(chunk)[:, 1:]            # one host sync
+        last_np = np.array(last)     # writable copy
         rounds += 1
-        accepted += n
-        proposed += K
-        if n == K:
-            # full acceptance: d_K was proposed but never PROCESSED by
-            # the draft (its inputs were last, d_1..d_{K-1}); feed it at
-            # pos+K or a permanent zero-KV hole forms there — the draft
-            # would silently degrade more the better it predicts
+        any_full = False
+        for b in range(B):
+            if len(outs[b]) >= max_new_tokens:
+                continue       # finished row rides along, pos frozen
+            n = 0
+            while n < K and props_np[b, n] == tgt[b, n] \
+                    and len(outs[b]) + n + 1 < max_new_tokens:
+                n += 1
+            if n == K:
+                any_full = True
+            new_toks = props_np[b, :n].tolist() + [int(tgt[b, n])]
+            outs[b].extend(new_toks)
+            accepted += n
+            proposed += K
+            pos[b] += n + 1
+            last_np[b] = new_toks[-1]
+        if any_full:
+            # full acceptance on some row: d_K was proposed but never
+            # PROCESSED by the draft (its inputs were last, d_1..d_{K-1});
+            # feed it at old_pos+K or a permanent zero-KV hole forms
+            # there.  Batched over every row is safe: rows with n < K
+            # write a slot >= their new pos that the next round's
+            # proposals overwrite before any read.
             d_cache, _ = jstep_d(draft_params, d_cache,
-                                 jnp.asarray([props_np[K - 1]], jnp.int32),
-                                 jnp.int32(pos + K))
-        pos += n + 1
-        last = jnp.asarray([new_toks[-1]], jnp.int32)
+                                 jnp.asarray(props_np[:, K - 1], jnp.int32),
+                                 pos_v + jnp.int32(K))
+        last = jnp.asarray(last_np, jnp.int32)
         # draft cache now covers every position < pos; slots >= pos hold
         # rejected-token KV, masked until the next proposals overwrite
 
-    toks = jnp.asarray([out[:max_new_tokens]], ids.dtype)
+    toks = jnp.asarray([o[:max_new_tokens] for o in outs], ids.dtype)
     stats = {"rounds": rounds, "accepted_drafts": accepted,
              "proposed": proposed,
              "accept_rate": round(accepted / max(proposed, 1), 4)}

@@ -327,11 +327,18 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
 
 
 def repeat_interleave(x, repeats, axis=None):
+    """Scalar repeats: one jnp.repeat.  Tensor repeats (paddle accepts a
+    per-element count Tensor) route to the host-concrete
+    repeat_interleave_with_tensor_index — the total is data-dependent, so
+    the op is registered nojit and the gather index is built eagerly."""
     if hasattr(repeats, "_value"):
         repeats = repeats._value
-    return jnp.repeat(x, repeats, axis=axis,
-                      total_repeat_length=None if not hasattr(repeats, "shape")
-                      or jnp.ndim(repeats) == 0 else int(np.sum(np.asarray(repeats))))
+    if hasattr(repeats, "shape") and jnp.ndim(repeats) > 0:
+        xr = jnp.ravel(jnp.asarray(getattr(x, "_value", x))) \
+            if axis is None else x
+        return repeat_interleave_with_tensor_index(
+            xr, repeats, axis=0 if axis is None else axis)
+    return jnp.repeat(x, repeats, axis=axis)
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False,

@@ -109,3 +109,69 @@ def test_aux_ops():
     np.testing.assert_array_equal(np.asarray(counts), [2, 3, 1, 0])
     pruned = prune_gate_by_capacity(idx, jnp.array([1, 2, 1, 1]), 4)
     np.testing.assert_array_equal(np.asarray(pruned), [0, 1, 1, 2, -1, -1])
+
+
+def test_eager_moelayer_expert_choice_matches_compiled():
+    """VERDICT r4 item 7: the eager MoELayer's expert_choice router must
+    produce the same logits as the compiled step's moe_ffn_ep (it
+    delegates to that routine, jitted here to stand in for the compiled
+    step)."""
+    import jax
+    import numpy as np
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    from paddle_tpu.parallel.moe import moe_ffn_ep
+    rng = np.random.default_rng(0)
+    layer = MoELayer(16, 32, 4, gate="naive", top_k=2,
+                     router="expert_choice", capacity_factor=2.0)
+    layer.eval()
+    x = rng.normal(size=(2, 8, 16)).astype(np.float32)
+    import paddle_tpu as pt
+    got = np.asarray(layer(pt.to_tensor(x)))
+    want = np.asarray(jax.jit(
+        lambda xv, gw, w1, b1, w2, b2: moe_ffn_ep(
+            xv, gw, w1, b1, w2, b2, top_k=2, capacity_factor=2.0,
+            router="expert_choice", activation=layer.activation))(
+        x, layer.gate.weight._value, layer.w1._value, layer.b1._value,
+        layer.w2._value, layer.b2._value))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_eager_moelayer_dropless_matches_compiled():
+    import jax
+    import numpy as np
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    from paddle_tpu.parallel.moe import moe_ffn_ep
+    rng = np.random.default_rng(1)
+    layer = MoELayer(16, 32, 4, gate="naive", top_k=2, dropless=True)
+    layer.eval()
+    x = rng.normal(size=(2, 8, 16)).astype(np.float32)
+    import paddle_tpu as pt
+    got = np.asarray(layer(pt.to_tensor(x)))
+    want = np.asarray(jax.jit(
+        lambda xv, gw, w1, b1, w2, b2: moe_ffn_ep(
+            xv, gw, w1, b1, w2, b2, top_k=2, dropless=True,
+            activation=layer.activation))(
+        x, layer.gate.weight._value, layer.w1._value, layer.b1._value,
+        layer.w2._value, layer.b2._value))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_eager_gptblock_expert_choice_and_dropless():
+    """The eager GPTBlock now builds for expert_choice and dropless MoE
+    configs (guards lifted) and runs finite forward/backward."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTBlock, GPTConfig
+    rng = np.random.default_rng(2)
+    for kw in (dict(moe_router="expert_choice"), dict(moe_dropless=True)):
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=2, max_position_embeddings=32,
+                        moe_num_experts=4, **kw)
+        blk = GPTBlock(cfg)
+        blk.eval()
+        x = pt.to_tensor(rng.normal(size=(2, 8, 32)).astype(np.float32),
+                         stop_gradient=False)
+        out = blk(x)
+        assert np.isfinite(np.asarray(out)).all()
+        out.sum().backward()
+        assert x.grad is not None

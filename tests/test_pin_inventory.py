@@ -1,0 +1,27 @@
+"""Ratchet for the op value-pin inventory (VERDICT r4 item 9: every
+ops.yaml entry is value-pinned, tested in a named file, or on the
+committed justified list — and the justified list may only shrink)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def test_every_op_categorized():
+    import pin_inventory
+    out = pin_inventory.collect()
+    bad = sorted(n for n, (k, _) in out.items() if k == "UNCATEGORIZED")
+    assert not bad, f"ops with no pin, named test, or justification: {bad}"
+
+
+def test_justified_ratchet():
+    import pin_inventory
+    out = pin_inventory.collect()
+    counts = {}
+    for n, (k, _) in out.items():
+        counts[k] = counts.get(k, 0) + 1
+    # r5 baseline: 375 CASES-pinned / 166 named-file / 82 justified.
+    # justified may only SHRINK; cases may only GROW.
+    assert counts.get("justified", 0) <= 82, counts
+    assert counts.get("cases", 0) >= 375, counts

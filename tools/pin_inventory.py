@@ -1,0 +1,190 @@
+"""Op value-pin inventory + ratchet source (VERDICT r4 item 9).
+
+Classifies every ops.yaml entry into exactly one bucket:
+
+* ``cases``     — value-pinned against a numpy/scipy reference in a
+                  CASES dict (tests/test_op_numeric*.py), detected
+                  automatically from the AST.
+* ``tested``    — exercised with assertions in a NAMED non-sweep test
+                  file (conv/pool/interp in test_nn*, detection ops in
+                  test_detection_ops, fft in test_spectral, ...),
+                  detected by word-boundary grep over the pinning test
+                  files and spot-curated.
+* ``justified`` — no value pin BY DESIGN, with a per-op reason
+                  (sampling ops, collectives, io/no-egress, debug
+                  flags); the curated dict below IS the committed
+                  justification list.
+
+Writes PINNED.md and prints the counts.  tests/test_pin_inventory.py
+ratchets: no op may be uncategorized, and the justified bucket may only
+shrink.  Run: ``python tools/pin_inventory.py``.
+"""
+
+import ast
+import glob
+import json
+import os
+import re
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# test files that exercise ops WITHOUT pinning values (excluded as
+# "tested" evidence)
+NON_PINNING = {
+    "test_op_sweep.py", "test_invocation_parity.py", "test_api_parity.py",
+    "test_review_fixes.py", "test_pin_inventory.py",
+}
+
+# ops with no value pin, by design — reason committed here (the VERDICT
+# asks that the unpinned remainder be NAMED and JUSTIFIED, ratcheted)
+JUSTIFIED = {
+    # sampling / random: output is draw-dependent; covered by the
+    # finite-output sweep + seeded-determinism and distribution tests
+    **{op: "sampling op (random output; sweep + seeded-determinism)"
+       for op in (
+           "binomial", "exponential", "exponential_", "gaussian",
+           "gaussian_inplace", "gumbel", "log_normal", "normal_like",
+           "rand_like", "randint_like", "randn_like", "random_routing",
+           "rrelu", "shuffle_batch", "standard_gamma",
+           "truncated_gaussian_random", "uniform_inplace", "uniform_like",
+           "graph_khop_sampler", "graph_sample_neighbors",
+           "weighted_sample_neighbors", "tdm_sampler", "top_p_sampling",
+       )},
+    # legacy collective aliases: semantics pinned through the Group
+    # facade 2-process tests; single-process value is identity
+    **{op: "legacy collective alias (Group facade tests pin semantics)"
+       for op in (
+           "c_allgather", "c_allreduce_max", "c_allreduce_min",
+           "c_allreduce_prod", "c_allreduce_sum", "c_broadcast",
+           "c_concat", "c_identity", "c_reduce_sum", "c_scatter",
+           "c_sync_calc_stream", "c_sync_comm_stream",
+           "sync_calc_stream",
+       )},
+    # io: need local media files — the no-egress environment has none
+    "read_file": "file io (no-egress env: no fixture media)",
+    "decode_jpeg": "file io (no-egress env: no fixture media)",
+    # debug/flag toggles: no tensor output to pin
+    "disable_check_model_nan_inf": "flag toggle (no tensor output)",
+    "enable_check_model_nan_inf": "flag toggle (no tensor output)",
+    # pervasive structural ops: exercised by virtually every test via
+    # indexing/assignment; a dedicated pin adds no information
+    "_getitem": "structural (exercised by all indexing tests)",
+    "assign_out_": "alias of assign (pinned) with out-buffer plumbing",
+    "assign_value_": "alias of assign (pinned) writing in place",
+    "share_data": "aliasing no-op (same buffer out)",
+    "copy_to": "device placement no-op on single-host XLA",
+    "memcpy_d2h": "device placement no-op on single-host XLA",
+    "memcpy_h2d": "device placement no-op on single-host XLA",
+    "npu_identity": "identity for non-TPU hardware path",
+    "data": "graph input placeholder (static program builder)",
+    "depend": "scheduling edge marker (no value semantics)",
+    "shuffle": "random permutation (seeded-determinism only)",
+    # legacy fused CPU ops: deterministic but with no public reference
+    # formula beyond the C++ kernel; finite-output sweep + shape checks
+    "attention_lstm": "legacy fused lite op (sweep-covered)",
+    "match_matrix_tensor": "legacy fused lite op (sweep-covered)",
+    "im2sequence": "legacy fused lite op (sweep-covered)",
+    "pyramid_hash": "legacy fused lite op (sweep-covered)",
+    "rank_attention": "legacy fused lite op (sweep-covered)",
+    "tdm_child": "legacy tree-index op (sweep-covered)",
+    "average_accumulates_": "trainer state op (sweep + optimizer tests)",
+    "merged_momentum_": "fused multi-param momentum (per-param momentum_"
+                        " pinned in optimizer tests)",
+    "merged_adam_": "fused multi-param adam (per-param adam_ pinned)",
+    "coalesce_tensor": "buffer fusion utility (layout-only)",
+    "merge_selected_rows": "selected-rows legacy format utility",
+    "dgc": "deep gradient compression (sweep + meta-optimizer test)",
+    "dgc_momentum": "deep gradient compression (sweep-covered)",
+    "dgc_clip_by_norm": "deep gradient compression (sweep-covered)",
+    "dpsgd": "differentially-private sgd (noise draw; sweep-covered)",
+    "decayed_adagrad": "legacy optimizer (sweep-covered)",
+    "ftrl": "legacy optimizer (sweep-covered)",
+    "asgd_": "legacy optimizer (sweep-covered)",
+    "rprop_": "legacy optimizer (sweep-covered)",
+    "cond": "higher-order control flow (tested via dy2static)",
+    "beam_search": "decode search state op (beam tests in op_tail3)",
+    "gather_tree": "beam decode utility (tested in op_tail files)",
+    "moe": "composite op (MoE layer equivalence tests pin the path)",
+    "number_count": "MoE dispatch counter (moe tests exercise)",
+    "limit_by_capacity": "MoE dispatch helper (moe tests exercise)",
+    "prune_gate_by_capacity": "MoE dispatch helper (moe tests exercise)",
+    "assign_pos": "MoE dispatch helper (moe tests exercise)",
+    "class_center_sample": "distributed sampling op (random)",
+    "gumbel_softmax": "random relaxation (hard-mode shape pinned in "
+                      "numeric wave 4)",
+    "empty": "uninitialized alloc (shape/dtype pinned in wave 4)",
+    "empty_like": "uninitialized alloc (shape/dtype pinned in wave 4)",
+    "accuracy_check": "debug comparator (behavior pinned in wave 4)",
+    "check_numerics": "debug guard (no stable value contract)",
+    "masked_multihead_attention_": "inplace alias of "
+        "masked_multihead_attention (pinned in test_generation.py)",
+    "collect_fpn_proposals": "legacy detection aggregation "
+        "(sweep-covered; component ops pinned in test_detection_ops)",
+}
+
+# case-sensitive grep misses (class names differ from op names)
+TESTED_EXTRA = {
+    "lstm": "test_rnn.py",       # nn.LSTM numeric tests
+}
+
+
+def collect(repo=REPO):
+    ops = yaml.safe_load(open(os.path.join(
+        repo, "paddle_tpu/ops/ops.yaml")))
+    names = sorted(set(o["op"] if isinstance(o, dict) else o for o in ops))
+    cases = set()
+    for f in glob.glob(os.path.join(repo, "tests/test_op_numeric*.py")):
+        for node in ast.walk(ast.parse(open(f).read())):
+            if isinstance(node, ast.Dict):
+                for k in node.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        cases.add(k.value.split("@")[0])
+    test_files = [f for f in glob.glob(os.path.join(repo, "tests/test_*.py"))
+                  if os.path.basename(f) not in NON_PINNING]
+    blobs = {os.path.basename(f): open(f).read() for f in test_files}
+    out = {}
+    for n in names:
+        if n in cases:
+            out[n] = ("cases", "tests/test_op_numeric*.py")
+            continue
+        if n in JUSTIFIED:
+            out[n] = ("justified", JUSTIFIED[n])
+            continue
+        if n in TESTED_EXTRA:
+            out[n] = ("tested", TESTED_EXTRA[n])
+            continue
+        pat = re.compile(r"\b%s\b" % re.escape(n))
+        hits = [f for f, s in blobs.items() if pat.search(s)]
+        if hits:
+            out[n] = ("tested", hits[0])
+        else:
+            out[n] = ("UNCATEGORIZED", "")
+    return out
+
+
+def main():
+    out = collect()
+    counts = {}
+    for n, (kind, _) in out.items():
+        counts[kind] = counts.get(kind, 0) + 1
+    lines = ["# Op value-pin inventory (generated by tools/pin_inventory.py)",
+             "", f"Counts: {json.dumps(counts, sort_keys=True)}", ""]
+    for kind in ("cases", "tested", "justified", "UNCATEGORIZED"):
+        rows = [(n, ev) for n, (k, ev) in sorted(out.items()) if k == kind]
+        if not rows:
+            continue
+        lines.append(f"## {kind} ({len(rows)})\n")
+        for n, ev in rows:
+            lines.append(f"- `{n}` — {ev}")
+        lines.append("")
+    with open(os.path.join(REPO, "PINNED.md"), "w") as f:
+        f.write("\n".join(lines))
+    print(json.dumps(counts, sort_keys=True))
+    return out
+
+
+if __name__ == "__main__":
+    main()
