@@ -74,11 +74,16 @@ def peak_flops_per_chip(device) -> float:
 def _layer_train_bench(net, x, y, steps: int, items_per_step: float,
                        unit: str, metric: str, devices):
     """Measure a jitted functional AdamW train step over an eager Layer
-    (the Model.fit compute path, jit-compiled once)."""
+    (the Model.fit compute path, jit-compiled once).  The update runs
+    through the optimizer's FUSED multi-tensor apply (one bucketed kernel
+    per dtype group, flat moments donated in place) and the input batch
+    is staged host→device by the io device-prefetch pipeline."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.nn import functional_call_with_buffers, state_arrays
     from paddle_tpu.nn import functional as F
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.io import device_prefetch_iterator
     import paddle_tpu as pt
 
     # differentiate ONLY trainable params; buffers (BN running stats)
@@ -86,9 +91,12 @@ def _layer_train_bench(net, x, y, steps: int, items_per_step: float,
     params = state_arrays(net, trainable_only=True)
     buffers = {k: v for k, v in state_arrays(net).items()
                if k not in params}
+    opt = AdamW(learning_rate=1e-3, weight_decay=0.0)
 
-    @jax.jit
-    def step(params, buffers, moments, xv, yv):
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0, 2))
+    def step(params, buffers, opt_state, step_no, xv, yv):
         def loss_fn(p):
             logits, new_buf = functional_call_with_buffers(
                 net, {**buffers, **p}, pt.Tensor(xv))
@@ -98,34 +106,26 @@ def _layer_train_bench(net, x, y, steps: int, items_per_step: float,
 
         (loss, new_buf), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
-        m, v, t = moments
-        t = t + 1
-        new_m, new_v, new_p = {}, {}, {}
-        for k, g in grads.items():
-            g32 = g.astype(jnp.float32)
-            new_m[k] = 0.9 * m[k] + 0.1 * g32
-            new_v[k] = 0.999 * v[k] + 0.001 * g32 * g32
-            upd = 1e-3 * (new_m[k] / (1 - 0.9 ** t)) / (
-                jnp.sqrt(new_v[k] / (1 - 0.999 ** t)) + 1e-8)
-            new_p[k] = (params[k].astype(jnp.float32) - upd).astype(
-                params[k].dtype)
+        new_p, new_state = opt.apply_gradients_fused(
+            params, grads, opt_state, 1e-3, step_no)
         new_buffers = {k: new_buf.get(k, val)
                        for k, val in buffers.items()}
-        return new_p, new_buffers, (new_m, new_v, t), loss
+        return new_p, new_buffers, new_state, loss
 
-    moments = ({k: jnp.zeros(v.shape, jnp.float32)
-                for k, v in params.items()},
-               {k: jnp.zeros(v.shape, jnp.float32)
-                for k, v in params.items()},
-               jnp.zeros((), jnp.int32))
-    params, buffers, moments, loss = step(params, buffers, moments,
-                                          x, y)   # compile
+    opt_state = opt.init_state(params)
+    params, buffers, opt_state, loss = step(params, buffers, opt_state,
+                                            1, x, y)   # compile (1/2)
+    # second compile: opt_state is now in fused (flat) form
+    params, buffers, opt_state, loss = step(params, buffers, opt_state,
+                                            2, x, y)
 
     jax.device_get(loss)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        params, buffers, moments, loss = step(params, buffers, moments,
-                                              x, y)
+    sn = 3
+    for xv, yv in device_prefetch_iterator([(x, y)] * steps, size=2):
+        params, buffers, opt_state, loss = step(params, buffers,
+                                                opt_state, sn, xv, yv)
+        sn += 1
     loss_val = float(np.asarray(jax.device_get(loss)))
     dt = time.perf_counter() - t0
     rate = items_per_step * steps / dt
@@ -133,6 +133,7 @@ def _layer_train_bench(net, x, y, steps: int, items_per_step: float,
         "metric": metric, "value": round(rate, 1), "unit": unit,
         "vs_baseline": 0.0,   # no reference-published number (BASELINE.md)
         "extra": {"steps": steps, "loss": loss_val,
+                  "optimizer_fused": True, "device_prefetch": True,
                   "device": str(devices[0])},
     }
 
@@ -340,6 +341,51 @@ def run_config_bench(config: str):
                       "model": "llama_7b-width L4 proxy decode" if on_accel
                                else "llama_tiny CPU-liveness proxy"},
         }
+    elif config == "optimizer":
+        # fused multi-tensor optimizer microbench (optimizer/fused.py):
+        # many small params is exactly where the per-param loop drowns in
+        # tiny kernels; the fused path runs one bucketed kernel with flat
+        # moments held in place across steps
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.optimizer import AdamW
+
+        n_params, reps = (512, 100) if on_accel else (256, 50)
+        params = {f"p{i}": jnp.asarray(
+            rng.standard_normal(64 + (i % 7) * 16).astype(np.float32))
+            for i in range(n_params)}
+        grads = {k: jnp.asarray(
+            rng.standard_normal(v.shape).astype(np.float32))
+            for k, v in params.items()}
+        opt = AdamW(learning_rate=1e-3, weight_decay=0.01)
+        fused = opt.build_jit_apply(donate=False)
+        perparam = jax.jit(opt.apply_gradients)
+
+        def run(fn):
+            p = dict(params)
+            s = opt.init_state(params)
+            p, s = fn(p, grads, s, 1e-3, 1)
+            p, s = fn(p, grads, s, 1e-3, 2)     # steady-state structure
+            jax.block_until_ready(p)
+            t0 = time.perf_counter()
+            for i in range(reps):
+                p, s = fn(p, grads, s, 1e-3, 3 + i)
+            jax.block_until_ready(p)
+            return (time.perf_counter() - t0) / reps
+
+        t_fused = run(fused)
+        t_pp = run(perparam)
+        out = {
+            "metric": "optimizer_fused_steps_per_sec",
+            "value": round(1.0 / t_fused, 1),
+            "unit": "steps/s", "vs_baseline": round(t_pp / t_fused, 4),
+            "extra": {"params": n_params, "steps": reps,
+                      "fused_us": round(t_fused * 1e6, 1),
+                      "per_param_us": round(t_pp * 1e6, 1),
+                      "speedup_vs_per_param": round(t_pp / t_fused, 2),
+                      "optimizer_fused": True,
+                      "device": str(devices[0])},
+        }
     else:
         raise SystemExit(f"unknown --config {config!r}")
     if err_note:
@@ -383,9 +429,13 @@ def run_bench():
     state, loss = step_fn(state, ids, labels)
     jax.device_get(loss)
 
+    # measured loop consumes batches staged host→device ahead of compute
+    # by the io device-prefetch pipeline (dataloader.py)
+    from paddle_tpu.io import device_prefetch_iterator
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, loss = step_fn(state, ids, labels)
+    for ids_d, labels_d in device_prefetch_iterator(
+            [(ids, labels)] * steps, size=2):
+        state, loss = step_fn(state, ids_d, labels_d)
     loss_val = float(np.asarray(jax.device_get(loss)))
     dt = time.perf_counter() - t0
 
@@ -412,6 +462,11 @@ def run_bench():
             "loss": loss_val,
             "device": str(devices[0]),
             "dtype": cfg.dtype,
+            # attribution for BENCH rounds: the GPT step keeps its own
+            # in-graph ZeRO leaf Adam (not the optimizer/fused.py path);
+            # batches go through the device-prefetch pipeline
+            "optimizer_fused": False,
+            "device_prefetch": True,
         },
     }
     if err_note:
@@ -561,8 +616,9 @@ def _exit_by_row(d) -> None:
 
 
 if __name__ == "__main__":
-    # --config lenet|resnet50|bert|llama selects a BASELINE row benchmark;
-    # no flag = the flagship GPT metric (driver contract: ONE JSON line).
+    # --config lenet|resnet50|bert|llama|moe|serve|decode|optimizer
+    # selects a BASELINE row / subsystem benchmark; no flag = the
+    # flagship GPT metric (driver contract: ONE JSON line).
     if "--config" in sys.argv:
         os.environ["BENCH_CONFIG"] = sys.argv[sys.argv.index(
             "--config") + 1]

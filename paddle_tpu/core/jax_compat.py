@@ -1,0 +1,46 @@
+"""JAX version compatibility shims.
+
+The tree targets the current public JAX API surface; older jaxlib builds
+(this container ships 0.4.x) predate some promotions out of
+``jax.experimental``.  Each shim installs the modern name when missing so
+the rest of the tree (and the tests) can use one spelling.
+
+* ``jax.shard_map`` — promoted from ``jax.experimental.shard_map`` (whose
+  ``check_rep`` kwarg was renamed ``check_vma``).
+* ``jax.lax.axis_size`` — newer helper; ``lax.psum(1, axis)`` of a python
+  scalar is evaluated statically and returns the same int.
+* ``jax.export`` — the submodule exists but older ``jax/__init__`` does
+  not import it, so attribute access raises; importing it binds it.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax as _lax
+
+try:
+    import jax.export  # noqa: F401  (binds the jax.export attribute)
+except ImportError:
+    pass
+
+if not hasattr(_lax, "axis_size"):
+    def _axis_size(axis_name):
+        return _lax.psum(1, axis_name)
+
+    _lax.axis_size = _axis_size
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=None,
+                  **kwargs):
+        if check_vma is not None and "check_rep" not in kwargs:
+            kwargs["check_rep"] = check_vma
+
+        def bind(fn):
+            return _shard_map(fn, mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kwargs)
+
+        return bind if f is None else bind(f)
+
+    jax.shard_map = shard_map

@@ -96,7 +96,10 @@ class Model:
                             if n in trainable_names}
             (loss_v, (outs_v, new_buffers)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(train_params)
-            new_train, new_opt_state = opt.apply_gradients(
+            # fused multi-tensor update (optimizer/fused.py): one bucketed
+            # kernel instead of a per-param loop; opt_state comes back in
+            # fused (flat) form and is threaded through unchanged
+            new_train, new_opt_state = opt.apply_gradients_fused(
                 train_params, grads, opt_state, lr, step_no)
             new_params = dict(params)
             new_params.update(new_train)
@@ -135,9 +138,17 @@ class Model:
             self._opt_state = self._optimizer.init_state(trainable)
         lr = self._optimizer.get_lr()
         rng = next_rng_key()
-        params, buffers, self._opt_state, loss_v, outs_v = self._jit_step(
-            params, buffers, self._opt_state, self._step_count + 1, lr, rng,
-            inputs, labels)
+        import warnings
+        with warnings.catch_warnings():
+            # step 1 donates per-name opt state but returns FUSED (flat)
+            # state — those buffers legitimately can't be reused once;
+            # every later step aliases them in place
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            params, buffers, self._opt_state, loss_v, outs_v = \
+                self._jit_step(params, buffers, self._opt_state,
+                               self._step_count + 1, lr, rng, inputs,
+                               labels)
         self._write_state(params, buffers)
         self._step_count += 1
         self._optimizer._scheduler_step()
@@ -205,14 +216,15 @@ class Model:
             save_dir: Optional[str] = None, save_freq: int = 1,
             verbose: int = 2, drop_last: bool = False, shuffle: bool = True,
             num_workers: int = 0, callbacks=None, accumulate_grad_batches=1,
-            num_iters: Optional[int] = None):
+            num_iters: Optional[int] = None, device_prefetch: int = 0):
         from ..io import DataLoader
         from ..io.dataset import Dataset
 
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
                                       shuffle=shuffle, drop_last=drop_last,
-                                      num_workers=num_workers)
+                                      num_workers=num_workers,
+                                      device_prefetch=device_prefetch)
         else:
             train_loader = train_data
         if isinstance(eval_data, Dataset):
@@ -330,7 +342,8 @@ class Model:
         if training and self._optimizer is not None:
             opt_sd = self._optimizer.state_dict()
             if self._opt_state is not None:
-                for pname, slots in self._opt_state.items():
+                per_name = self._optimizer.unflatten_state(self._opt_state)
+                for pname, slots in per_name.items():
                     for sname, v in slots.items():
                         opt_sd[f"{pname}/{sname}"] = Tensor(v)
             _save(opt_sd, path + ".pdopt")

@@ -257,6 +257,15 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
                 "max_seq_len + max_dec_len] with batch*beam_size == "
                 f"cache rows; got {tuple(_bo.shape)} vs cache "
                 f"{tuple(_ck.shape)}")
+        if _bo.shape[-1] != _ck.shape[3]:
+            # the kernel reads offsets at every past position, so the
+            # offset table must cover exactly the cache capacity — a
+            # short table would silently zero-pad (reading beam 0's
+            # cache) and a long one silently truncate
+            raise ValueError(
+                "beam_cache_offset last dim must equal the cache "
+                f"capacity (cache_kv.shape[3] == {_ck.shape[3]}); got "
+                f"{_bo.shape[-1]}")
     # capacity check must run on the CONCRETE lengths out here — inside
     # impl they are tracers under the default eager-op jit cache, and a
     # full cache would silently drop the scatter (JAX OOB semantics)

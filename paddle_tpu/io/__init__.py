@@ -1,4 +1,6 @@
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import (  # noqa: F401
+    DataLoader, default_collate_fn, device_prefetch_iterator,
+)
 from .worker import WorkerInfo, get_worker_info  # noqa: F401
 from .dataset import (  # noqa: F401
     ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset,

@@ -20,7 +20,134 @@ import numpy as np
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
-__all__ = ["DataLoader", "default_collate_fn"]
+__all__ = ["DataLoader", "default_collate_fn", "device_prefetch_iterator"]
+
+
+def _prefetch_sharding(explicit=None):
+    """Sharding for staged batches: the explicitly-passed one, else the
+    active ``parallel`` topology's data-parallel sharding (batch dim
+    split over dp+sharding axes) when a multi-device topology has been
+    initialized, else None (commit to the default device)."""
+    if explicit is not None:
+        return explicit
+    from ..parallel import topology as _topo
+    t = _topo._topology
+    if t is not None and t.world_size > 1:
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(t.mesh, PartitionSpec(t.data_axes()))
+    return None
+
+
+class _DevicePrefetcher:
+    """Bounded background thread that stages the next N host batches onto
+    device (``jax.device_put``) so host→device transfer overlaps step
+    execution.  Yields batches IN ORDER; ``close()`` (or abandoning the
+    iterator mid-epoch) wakes and joins the producer thread."""
+
+    _END = object()
+
+    def __init__(self, produce, size: int, sharding=None,
+                 convert: Optional[Callable] = None):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(size)))
+        self._closed = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._sharding = sharding
+        self._convert = convert
+        self._thread = threading.Thread(
+            target=self._worker, args=(produce,), daemon=True)
+        self._thread.start()
+
+    # -- producer side -------------------------------------------------
+    def _stage(self, item):
+        import jax
+
+        sh = self._sharding
+        if sh is None:
+            # no sharding: still COMMIT to the default device (a bare
+            # device_put leaves the array uncommitted and the transfer
+            # can be deferred to first use — the opposite of prefetch)
+            sh = jax.local_devices()[0]
+            self._sharding = sh
+
+        def put(x):
+            if isinstance(x, np.ndarray):
+                if x.dtype == np.float64:
+                    x = x.astype(np.float32)
+                return jax.device_put(x, sh)
+            if hasattr(x, "_value"):        # Tensor
+                x._value = jax.device_put(x._value, sh)
+                return x
+            if isinstance(x, jax.Array):
+                return jax.device_put(x, sh)
+            return x
+
+        if isinstance(item, (tuple, list)):
+            return type(item)(self._stage(b) for b in item)
+        if isinstance(item, dict):
+            return {k: self._stage(v) for k, v in item.items()}
+        return put(item)
+
+    def _enqueue(self, item) -> bool:
+        while not self._closed.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self, produce):
+        try:
+            for item in produce():
+                if self._convert is not None:
+                    item = self._convert(item)
+                if not self._enqueue(self._stage(item)):
+                    return                   # consumer closed early
+        except BaseException as e:           # propagate to consumer
+            self._exc = e
+        finally:
+            self._enqueue(self._END)
+
+    # -- consumer side -------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if item is self._END:
+            self.close()
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        """Mid-epoch shutdown: wake the (possibly blocked) producer,
+        drain the queue, and join the thread."""
+        self._closed.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def device_prefetch_iterator(iterable, size: int = 2, sharding=None):
+    """Stage batches from any host iterable onto device ``size`` batches
+    ahead of the consumer (used by ``DataLoader(device_prefetch=N)`` and
+    the bench harness).  ``sharding`` defaults to the active parallel
+    topology's data sharding when one is initialized."""
+    return _DevicePrefetcher(lambda: iter(iterable), size,
+                             sharding=_prefetch_sharding(sharding))
 
 
 def default_collate_fn(batch: List[Any]):
@@ -117,8 +244,13 @@ class DataLoader:
                  num_workers: int = 0, use_buffer_reader: bool = True,
                  prefetch_factor: int = 2, use_shared_memory: bool = True,
                  timeout: int = 0, worker_init_fn: Optional[Callable] = None,
-                 persistent_workers: bool = False):
+                 persistent_workers: bool = False, device_prefetch: int = 0,
+                 device_prefetch_sharding=None):
         self.dataset = dataset
+        # stage the next N batches onto device in a background thread so
+        # host→device transfer overlaps step compute (0 = off)
+        self.device_prefetch = device_prefetch
+        self.device_prefetch_sharding = device_prefetch_sharding
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
@@ -237,7 +369,20 @@ class DataLoader:
             # prefetches across processes itself, so the extra thread
             # prefetcher adds nothing here.
             self._get_pool()
+            if self.device_prefetch > 0:
+                return _DevicePrefetcher(
+                    self._produce_batches, self.device_prefetch,
+                    sharding=_prefetch_sharding(
+                        self.device_prefetch_sharding),
+                    convert=self._to_tensors)
             return (self._to_tensors(b) for b in self._produce_batches())
+        if self.device_prefetch > 0:
+            # the device prefetcher pulls host batches ahead itself, so it
+            # subsumes the host-side _PrefetchIterator
+            return _DevicePrefetcher(
+                self._produce_batches, self.device_prefetch,
+                sharding=_prefetch_sharding(self.device_prefetch_sharding),
+                convert=self._to_tensors)
         if self.use_buffer_reader:
             return _PrefetchIterator(self._produce_batches,
                                      self.prefetch_factor * max(

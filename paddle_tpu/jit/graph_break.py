@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 
 from ..core.tensor import Tensor
+from ..utils.lru import LRUCache
 
 __all__ = ["HybridFunction", "build_hybrid"]
 
@@ -108,6 +109,13 @@ def _is_arraylike(v) -> bool:
     return isinstance(v, (jax.Array, Tensor, np.ndarray, np.generic))
 
 
+def _is_dynamic_scalar(v) -> bool:
+    """int/float live-ins ride as ARRAY inputs by default: a varying
+    scalar (step counter, accumulated loss) in the static signature
+    would recompile the segment on every call."""
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
 class _Segment:
     """One maximal run of compilable statements, jitted per live-in
     signature with a memoized eager fallback."""
@@ -120,8 +128,11 @@ class _Segment:
         loads, stores = _names(stmts)
         self.reads = loads
         self.writes = sorted(stores)
-        self._jit_cache: Dict[Tuple, Callable] = {}
+        # bounded: distinct static signatures must not retain unboundedly
+        # many compiled programs (ADVICE r5 #2)
+        self._jit_cache: LRUCache = LRUCache(maxsize=32)
         self._eager = False        # memoized dynamic graph-break
+        self._scalars_static = False   # memoized scalar-as-array failure
         self.tag = tag
         self.compiled_calls = 0
         self.eager_calls = 0
@@ -174,7 +185,7 @@ class _Segment:
                 is_leaf=lambda x: isinstance(x, Tensor))
 
         jfn = jax.jit(traced)
-        self._jit_cache[key] = jfn
+        self._jit_cache.put(key, jfn)
         return jfn
 
     # -- running -------------------------------------------------------
@@ -183,9 +194,19 @@ class _Segment:
         where ret is non-None only for a trailing-return segment."""
         if not self._eager:
             live = {n: ns[n] for n in self.reads if n in ns}
-            arr_names = tuple(sorted(n for n, v in live.items()
-                                     if _is_arraylike(v)))
-            static_names = tuple(sorted(set(live) - set(arr_names)))
+            arr = {n for n, v in live.items() if _is_arraylike(v)}
+            has_dyn_scalars = False
+            if not self._scalars_static:
+                # scalar live-ins join the ARRAY signature so a varying
+                # step counter hits ONE compiled program instead of
+                # recompiling per value (ADVICE r5 #2); segments that
+                # consume the scalar statically (shape, range bound) fail
+                # the trace once and pin scalars static below
+                scal = {n for n, v in live.items() if _is_dynamic_scalar(v)}
+                has_dyn_scalars = bool(scal)
+                arr |= scal
+            arr_names = tuple(sorted(arr))
+            static_names = tuple(sorted(set(live) - arr))
             static_vals = tuple(live[n] for n in static_names)
             try:
                 hash(static_vals)
@@ -212,6 +233,13 @@ class _Segment:
                         jax.errors.TracerIntegerConversionError,
                         jax.errors.ConcretizationTypeError,
                         ConversionFallback, NameError, TypeError):
+                    if has_dyn_scalars:
+                        # the scalar-as-array promotion broke the trace:
+                        # retry once with scalars pinned static (the old
+                        # per-value-signature behavior) before giving up
+                        # on compilation
+                        self._scalars_static = True
+                        return self.run(ns)
                     # dynamic graph break INSIDE the segment (or a live
                     # set this splitter cannot type): isolate it by
                     # splitting, or — single statement — run eagerly
@@ -385,17 +413,22 @@ def needs_proactive_break(fn: Callable) -> bool:
     successful (observed: user ``except Exception`` catches
     TracerBoolConversionError and the trace "succeeds" with the wrong
     branch — a wrong ANSWER, not an exception the caller could fall back
-    on).  Only broad handlers are dangerous: the tracer errors are
-    TypeError subclasses, so ``except KeyError``/``except ValueError``
-    blocks let them propagate and the normal reactive fallback handles
-    those functions — they keep whole-graph compilation."""
+    on).  Triggers on bare ``except:`` / ``except Exception`` /
+    ``except BaseException`` only.  ``except TypeError`` *can* also
+    swallow a tracer error (ConcretizationTypeError subclasses
+    TypeError), but real-world ``except TypeError`` blocks guard
+    argument validation, not tensor branches — proactively graph-breaking
+    every such function cost whole-graph jit far more often than it
+    prevented a wrong trace, so it is deliberately excluded (ADVICE r5
+    #1); narrow handlers like ``except KeyError`` were never
+    dangerous."""
     try:
         src = textwrap.dedent(inspect.getsource(fn))
         tree = ast.parse(src)
     except (OSError, TypeError, SyntaxError):
         return False
 
-    BROAD = {"Exception", "BaseException", "TypeError"}
+    BROAD = {"Exception", "BaseException"}
 
     def handler_is_broad(h: ast.ExceptHandler) -> bool:
         t = h.type

@@ -61,7 +61,8 @@ def _grid_params():
     the innermost dim carries cross-iteration state (the VMEM scratch
     accumulators sweep over it); the three outer dims are parallel.
     Reordering any grid must preserve that invariant."""
-    return pltpu.CompilerParams(dimension_semantics=(
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(dimension_semantics=(
         "parallel", "parallel", "parallel", "arbitrary"))
 
 

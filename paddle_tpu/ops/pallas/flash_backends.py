@@ -182,5 +182,16 @@ def tuned_flash(q, k, v, scale: Optional[float] = None,
         kv_segment_ids = segment_ids
     name = _pick_backend(q, k, v, s, causal, segment_ids, kv_segment_ids,
                          bias)
-    return run_backend(name, q, k, v, s, causal, segment_ids,
-                       kv_segment_ids, bias)
+    try:
+        return run_backend(name, q, k, v, s, causal, segment_ids,
+                           kv_segment_ids, bias)
+    except Exception:
+        # traced path: the autotune timing never ran here (tracers can't
+        # be timed), so a platform kernel that rejects this signature at
+        # trace time must not kill the whole trace — fall back to the
+        # in-tree kernel, matching the eager autotune path's
+        # skip-on-failure behavior (ADVICE r5 #4)
+        if name == "ours":
+            raise
+        return run_backend("ours", q, k, v, s, causal, segment_ids,
+                           kv_segment_ids, bias)

@@ -67,6 +67,13 @@ class Optimizer:
         return self._lr
 
     # -- subclass interface ---------------------------------------------
+    #: Subclasses whose ``_update`` is purely ELEMENT-WISE (each output
+    #: element depends only on the same input element + scalars) set this
+    #: True to enable the fused multi-tensor path (fused.py): the update
+    #: applied to a concatenation of same-bucket params is then
+    #: bit-identical to the per-param loop.
+    _fused_elementwise = False
+
     def _init_slot_state(self, value: jax.Array) -> Dict[str, jax.Array]:
         """Per-param slot init (e.g. Adam moments)."""
         return {}
@@ -128,6 +135,79 @@ class Optimizer:
     def _decay_applies(self, name: str) -> bool:
         return True
 
+    # -- fused multi-tensor path ------------------------------------------
+    def _fused_decay_coeff(self) -> float:
+        """Weight-decay coefficient the fused planner buckets by (AdamW's
+        decoupled coeff lives outside ``_wd_coeff``)."""
+        return self._wd_coeff()
+
+    def _fused_pre_update(self, flat_work: jax.Array, lr: jax.Array,
+                          decay: bool) -> jax.Array:
+        """Hook applied to each bucket's flattened working params before
+        ``_update`` (AdamW's decoupled decay overrides this)."""
+        return flat_work
+
+    def _fused_supported(self) -> bool:
+        if not self._fused_elementwise:
+            return False
+        # an apply_gradients override changes per-step semantics the fused
+        # path would silently skip — unless that same class declares (in
+        # its own __dict__, so subclasses re-overriding lose the marker)
+        # that its override is fully captured by the fused hooks.
+        owner = next(c for c in type(self).__mro__
+                     if "apply_gradients" in c.__dict__)
+        return owner is Optimizer or owner.__dict__.get(
+            "_fused_handles_apply", False)
+
+    def apply_gradients_fused(self, params: Dict[str, jax.Array],
+                              grads: Dict[str, jax.Array],
+                              state: Dict[str, Any], lr, step
+                              ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Pure update like :meth:`apply_gradients`, but through the
+        multi-tensor fused path (one kernel per bucket instead of one per
+        parameter) whenever this optimizer supports it; exotic slot
+        states fall back to the per-param loop.  This is the default
+        entry point for jitted train steps.
+
+        The returned state is in FUSED form (flat per-bucket slot
+        buffers) and is accepted back on the next call — thread it
+        through the train loop unchanged and call
+        :meth:`unflatten_state` when per-name slots are needed
+        (checkpointing)."""
+        if self._fused_supported():
+            from .fused import apply_fused, is_fused_state
+            out = apply_fused(self, params, grads, state, lr, step)
+            if out is not None:
+                return out
+            if is_fused_state(state):
+                raise ValueError(
+                    "optimizer received fused state but cannot fuse this "
+                    "parameter set; unflatten_state it first")
+        return self.apply_gradients(params, grads, state, lr, step)
+
+    def unflatten_state(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        """Per-name slot dicts from a (possibly fused) state pytree."""
+        from .fused import is_fused_state, unflatten_state
+        if not is_fused_state(state):
+            return state
+        plan = getattr(self, "_fused_active_plan", None)
+        if plan is None:
+            raise ValueError("no active fused plan on this optimizer; "
+                             "fused state cannot be unflattened")
+        return unflatten_state(plan, state)
+
+    def build_jit_apply(self, donate: bool = True) -> Callable:
+        """Jitted fused apply with params/grads/moments DONATED: optimizer
+        state is updated in place (no double-buffering) — the old buffers
+        are deleted after the call.  Cached per optimizer."""
+        key = ("_jit_apply_donated" if donate else "_jit_apply_undonated")
+        fn = getattr(self, key, None)
+        if fn is None:
+            fn = jax.jit(self.apply_gradients_fused,
+                         donate_argnums=(0, 1, 2) if donate else ())
+            setattr(self, key, fn)
+        return fn
+
     # -- eager API --------------------------------------------------------
     def step(self) -> None:
         if self._parameters is None:
@@ -149,7 +229,15 @@ class Optimizer:
                 self._state[name] = s
         state = {n: self._state[n] for n in params}
         if self._jit_apply is None:
-            self._jit_apply = jax.jit(self.apply_gradients)
+            # donate params + moments: the eager step updates optimizer
+            # state in place instead of double-buffering it.  Grads stay
+            # undonated — ``p.grad`` remains readable after ``step()``
+            # (and accumulable by a later ``backward()``).  Per-param
+            # (not fused) on purpose: ``self._state`` keeps its per-name
+            # contract for state_dict(), and one whole-step XLA program
+            # has no per-op dispatch to save anyway.
+            self._jit_apply = jax.jit(self.apply_gradients,
+                                      donate_argnums=(0, 2))
         try:
             new_params, new_state = self._jit_apply(params, grads, state,
                                                     self.get_lr(),

@@ -20,11 +20,15 @@ State = Dict[str, Arr]
 
 
 class SGD(Optimizer):
+    _fused_elementwise = True
+
     def _update(self, p, g, s, lr, t):
         return p - lr * g, s
 
 
 class Momentum(Optimizer):
+    _fused_elementwise = True
+
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
                  multi_precision=False, name=None):
@@ -46,6 +50,8 @@ class Momentum(Optimizer):
 
 
 class Adam(Optimizer):
+    _fused_elementwise = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
@@ -83,6 +89,11 @@ class Adam(Optimizer):
 class AdamW(Adam):
     """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
 
+    # the apply_gradients override below is fully captured by the fused
+    # hooks (_fused_decay_coeff + _fused_pre_update), so the fused path
+    # may bypass it
+    _fused_handles_apply = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
@@ -98,6 +109,17 @@ class AdamW(Adam):
         if self._apply_decay_param_fun is not None:
             return self._apply_decay_param_fun(name)
         return True
+
+    def _fused_decay_coeff(self):
+        return self._coeff
+
+    def _fused_pre_update(self, flat_work, lr, decay):
+        # decoupled decay on the flattened working (master-or-param)
+        # buffer: p *= (1 - lr*coeff), cast back like the per-param path
+        if decay and self._coeff:
+            return (flat_work.astype(jnp.float32)
+                    * (1.0 - lr * self._coeff)).astype(flat_work.dtype)
+        return flat_work
 
     def apply_gradients(self, params, grads, state, lr, step):
         # decoupled decay: p *= (1 - lr*coeff) before the adam update
@@ -119,6 +141,8 @@ class AdamW(Adam):
 
 
 class Adamax(Optimizer):
+    _fused_elementwise = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -142,6 +166,8 @@ class Adamax(Optimizer):
 
 
 class Adagrad(Optimizer):
+    _fused_elementwise = True
+
     def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
                  weight_decay=None, grad_clip=None,
                  initial_accumulator_value=0.0, name=None):
@@ -160,6 +186,8 @@ class Adagrad(Optimizer):
 
 
 class Adadelta(Optimizer):
+    _fused_elementwise = True
+
     def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
                  parameters=None, weight_decay=None, grad_clip=None,
                  name=None):
@@ -182,6 +210,8 @@ class Adadelta(Optimizer):
 
 
 class RMSProp(Optimizer):
+    _fused_elementwise = True
+
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):

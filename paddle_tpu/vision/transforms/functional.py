@@ -162,15 +162,16 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
             "use 'nearest' or 'bilinear'")
     y0 = np.floor(ys).astype(int)
     x0 = np.floor(xs).astype(int)
-    wy = (ys - y0)[..., *([None] * (img.ndim - 2))]
-    wx = (xs - x0)[..., *([None] * (img.ndim - 2))]
+    _exp = (Ellipsis,) + (None,) * (img.ndim - 2)
+    wy = (ys - y0)[_exp]
+    wx = (xs - x0)[_exp]
     acc = np.zeros(out_shape, np.float64)
     wsum = np.zeros((oh, ow) + (1,) * (img.ndim - 2), np.float64)
     for dy, dx, wgt in ((0, 0, (1 - wy) * (1 - wx)), (0, 1, (1 - wy) * wx),
                         (1, 0, wy * (1 - wx)), (1, 1, wy * wx)):
         yi, xi = y0 + dy, x0 + dx
         valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
-        vv = valid[..., *([None] * (img.ndim - 2))]
+        vv = valid[_exp]
         acc += np.where(vv, img[np.clip(yi, 0, h - 1),
                                 np.clip(xi, 0, w - 1)], 0) * wgt * vv
         wsum += wgt * vv

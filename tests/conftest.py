@@ -9,11 +9,32 @@ import os
 # independent collectives in different orders on different virtual devices
 # and deadlock the in-process rendezvous (see __graft_entry__.py).
 _FLAGS = ("--xla_force_host_platform_device_count=8 "
-          "--xla_cpu_enable_concurrency_optimized_scheduler=false "
-          "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120 "
-          "--xla_cpu_collective_call_terminate_timeout_seconds=480")
+          "--xla_cpu_enable_concurrency_optimized_scheduler=false")
+# Collective stuck/terminate watchdogs are only known to newer XLA builds;
+# an UNKNOWN flag in XLA_FLAGS is a FATAL abort at first backend init
+# (parse_flags_from_env.cc CHECK), taking the whole pytest process down —
+# so probe them in a throwaway subprocess before adopting them.
+_OPT_FLAGS = ("--xla_cpu_collective_call_warn_stuck_timeout_seconds=120 "
+              "--xla_cpu_collective_call_terminate_timeout_seconds=480")
+
+
+def _flags_supported(flags: str) -> bool:
+    import subprocess
+    import sys
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", "import jax; jax.local_devices()"],
+            env=dict(os.environ, XLA_FLAGS=flags, JAX_PLATFORMS="cpu"),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=120).returncode == 0
+    except Exception:
+        return False
+
+
 if "--xla_force_host_platform_device_count" not in os.environ.get(
         "XLA_FLAGS", ""):
+    if _flags_supported(_FLAGS + " " + _OPT_FLAGS):
+        _FLAGS = _FLAGS + " " + _OPT_FLAGS
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " "
                                + _FLAGS).strip()
 

@@ -1,0 +1,192 @@
+"""ADVICE r5 satellite fixes.
+
+#1 — proactive graph-break trigger narrowed to bare/Exception/BaseException
+     handlers (``except TypeError`` keeps whole-graph jit);
+#2 — segment jit caches are LRU-bounded and int/float scalar live-ins ride
+     as ARRAY inputs (a varying step counter no longer recompiles);
+#4 — ``tuned_flash``'s dispatched backend call falls back to the in-tree
+     ``ours`` kernel when a platform kernel rejects the signature;
+#5 — ``masked_multihead_attention`` validates the beam-offset table covers
+     exactly the cache capacity.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.graph_break import build_hybrid, needs_proactive_break
+
+rng = np.random.default_rng(0)
+
+
+# ---------------------------------------------------------------------------
+# ADVICE #2 — scalar live-ins + bounded caches
+# ---------------------------------------------------------------------------
+
+def _jit_segments(hf):
+    return [seg for kind, seg in hf.segments if kind == "jit"]
+
+
+def test_varying_scalar_live_in_does_not_recompile():
+    def f(x, n):
+        import math  # noqa: F401  — static break splits the function
+        y = x * n + 1.0
+        return y
+
+    hf = build_hybrid(f)
+    assert hf is not None
+    for i in range(6):
+        out = hf(Tensor(jnp.ones((3,))), i)
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   np.full((3,), i + 1.0), rtol=1e-6)
+    seg = _jit_segments(hf)[-1]
+    # one compiled program serves every value of the scalar
+    assert len(seg._jit_cache) == 1
+    assert seg.compiled_calls == 6
+    assert seg.eager_calls == 0
+
+
+def test_scalar_used_statically_falls_back_and_stays_correct():
+    def g(x, n):
+        import math  # noqa: F401
+        y = x.reshape([n, 2]) * 1.0  # n must be CONCRETE: shape argument
+        return y
+
+    hf = build_hybrid(g)
+    assert hf is not None
+    out2 = hf(Tensor(jnp.zeros((4,))), 2)
+    out3 = hf(Tensor(jnp.zeros((6,))), 3)
+    assert np.asarray(getattr(out2, "_value", out2)).shape == (2, 2)
+    assert np.asarray(getattr(out3, "_value", out3)).shape == (3, 2)
+    # the failed scalar-as-array trace memoized scalars back to static
+    assert any(getattr(seg, "_scalars_static", False) or seg._eager
+               for seg in _jit_segments(hf))
+
+
+def test_segment_jit_cache_is_lru_bounded():
+    from paddle_tpu.utils.lru import LRUCache
+
+    def f(x, tag):
+        import math  # noqa: F401
+        y = x + (1.0 if tag == "a" else 2.0)
+        return y
+
+    hf = build_hybrid(f)
+    seg = _jit_segments(hf)[-1]
+    assert isinstance(seg._jit_cache, LRUCache)
+    for i in range(40):          # distinct static signatures
+        hf(Tensor(jnp.ones(())), f"t{i}")
+    assert len(seg._jit_cache) <= seg._jit_cache.maxsize
+
+
+# ---------------------------------------------------------------------------
+# ADVICE #1 — narrowed proactive-break trigger
+# ---------------------------------------------------------------------------
+
+def test_proactive_break_trigger_narrowed():
+    def broad_exc(x):
+        try:
+            return x + 1
+        except Exception:
+            return x
+
+    def broad_bare(x):
+        try:
+            return x + 1
+        except:  # noqa: E722
+            return x
+
+    def broad_base(x):
+        try:
+            return x + 1
+        except BaseException:
+            return x
+
+    def narrow_type(x):
+        try:
+            return x + 1
+        except TypeError:
+            return x
+
+    def narrow_key(x):
+        try:
+            return x + 1
+        except (KeyError, ValueError):
+            return x
+
+    assert needs_proactive_break(broad_exc)
+    assert needs_proactive_break(broad_bare)
+    assert needs_proactive_break(broad_base)
+    assert not needs_proactive_break(narrow_type)
+    assert not needs_proactive_break(narrow_key)
+
+
+# ---------------------------------------------------------------------------
+# ADVICE #4 — tuned_flash platform-backend fallback
+# ---------------------------------------------------------------------------
+
+def test_tuned_flash_falls_back_to_ours_on_backend_failure(monkeypatch):
+    from paddle_tpu.ops.pallas import flash_backends as fb
+
+    def boom(*a, **k):
+        raise RuntimeError("platform kernel rejected signature")
+
+    monkeypatch.setitem(fb._IMPLS, "boom", boom)
+    monkeypatch.setattr(fb, "_pick_backend",
+                        lambda *a, **k: "boom")
+    q = jnp.asarray(rng.standard_normal((1, 8, 2, 16)).astype(np.float32))
+    k_ = jnp.asarray(rng.standard_normal((1, 8, 2, 16)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((1, 8, 2, 16)).astype(np.float32))
+    out = fb.tuned_flash(q, k_, v, causal=True)
+    ref = fb.run_backend("ours", q, k_, v,
+                         1.0 / np.sqrt(16), True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_tuned_flash_ours_failure_still_raises(monkeypatch):
+    from paddle_tpu.ops.pallas import flash_backends as fb
+
+    def boom(*a, **k):
+        raise RuntimeError("ours broke")
+
+    monkeypatch.setitem(fb._IMPLS, "ours", boom)
+    monkeypatch.setattr(fb, "_pick_backend", lambda *a, **k: "ours")
+    q = jnp.ones((1, 4, 1, 8), jnp.float32)
+    with pytest.raises(RuntimeError, match="ours broke"):
+        fb.tuned_flash(q, q, q, causal=True)
+
+
+# ---------------------------------------------------------------------------
+# ADVICE #5 — mmha beam-offset capacity validation
+# ---------------------------------------------------------------------------
+
+def test_mmha_beam_offset_capacity_mismatch_raises():
+    from paddle_tpu.incubate.nn import functional as IF
+    bbz, bw, H, D, T = 1, 2, 2, 8, 16
+    B = bbz * bw
+    x = rng.standard_normal((B, 3 * H * D)).astype(np.float32)
+    cache = np.zeros((2, B, H, T, D), np.float32)
+    lens = np.full((B,), 4, np.int32)
+    off_short = np.zeros((bbz, bw, T - 4), np.int32)
+    with pytest.raises(ValueError, match="cache capacity"):
+        IF.masked_multihead_attention(
+            pt.to_tensor(x), pt.to_tensor(cache),
+            sequence_lengths=pt.to_tensor(lens),
+            beam_cache_offset=pt.to_tensor(off_short))
+    off_long = np.zeros((bbz, bw, T + 4), np.int32)
+    with pytest.raises(ValueError, match="cache capacity"):
+        IF.masked_multihead_attention(
+            pt.to_tensor(x), pt.to_tensor(cache),
+            sequence_lengths=pt.to_tensor(lens),
+            beam_cache_offset=pt.to_tensor(off_long))
+    # exact capacity still works
+    off_ok = np.zeros((bbz, bw, T), np.int32)
+    out, new_cache, off_out = IF.masked_multihead_attention(
+        pt.to_tensor(x), pt.to_tensor(cache),
+        sequence_lengths=pt.to_tensor(lens),
+        beam_cache_offset=pt.to_tensor(off_ok))
+    assert np.asarray(off_out).shape == (bbz, bw, T)

@@ -1,0 +1,287 @@
+"""Fused multi-tensor optimizer path (optimizer/fused.py) + device-prefetch
+input pipeline (io/dataloader.py).
+
+Parity contract: the fused bucketed update is numerically IDENTICAL to the
+per-parameter loop (zero tolerance) for every element-wise optimizer —
+including bf16 master-weight and weight-decay-exempt params — because the
+update math is element-wise over the concatenation.  The one documented
+exception is global-norm grad clipping, where the reduction ORDER differs
+(per-bucket flat sums vs per-tensor sums): tolerance 1e-6.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer
+from paddle_tpu.optimizer.fused import (build_fused_plan, is_fused_state,
+                                        FUSED_STATE_KEY)
+
+rng = np.random.default_rng(0)
+
+
+def _make_params(n=9, bf16_idx=(2, 5), shapes=((5,), (3, 4), (2, 2, 3))):
+    params, grads = {}, {}
+    for i in range(n):
+        shape = shapes[i % len(shapes)]
+        dt = jnp.bfloat16 if i in bf16_idx else jnp.float32
+        params[f"p{i}"] = jnp.asarray(
+            rng.standard_normal(shape).astype(np.float32)).astype(dt)
+        grads[f"p{i}"] = jnp.asarray(
+            rng.standard_normal(shape).astype(np.float32)).astype(dt)
+    return params, grads
+
+
+def _max_diff(a, b):
+    return max(
+        float(jnp.max(jnp.abs(a[k].astype(jnp.float32)
+                              - b[k].astype(jnp.float32))))
+        for k in a)
+
+
+def _run_both(opt, params, grads, steps=3, lr=0.01):
+    state = opt.init_state(params)
+    p1, s1 = dict(params), {k: dict(v) for k, v in state.items()}
+    p2, s2 = dict(params), {k: dict(v) for k, v in state.items()}
+    for t in range(1, steps + 1):
+        p1, s1 = opt.apply_gradients(p1, grads, s1, lr, t)
+        p2, s2 = opt.apply_gradients_fused(p2, grads, s2, lr, t)
+    return p1, s1, p2, opt.unflatten_state(s2)
+
+
+@pytest.mark.parametrize("opt_fn", [
+    lambda: optimizer.SGD(0.1),
+    lambda: optimizer.Momentum(0.1, momentum=0.9),
+    lambda: optimizer.Momentum(0.1, momentum=0.9, use_nesterov=True),
+    lambda: optimizer.Adam(0.01),
+    lambda: optimizer.Adam(0.01, weight_decay=0.02),   # coupled decay
+    lambda: optimizer.Adam(0.01, amsgrad=True),
+    lambda: optimizer.AdamW(0.01, weight_decay=0.05),
+    lambda: optimizer.AdamW(0.01, weight_decay=0.05,
+                            apply_decay_param_fun=lambda n: n != "p1"),
+], ids=["sgd", "momentum", "nesterov", "adam", "adam_l2", "amsgrad",
+        "adamw", "adamw_exempt"])
+def test_fused_matches_per_param(opt_fn):
+    opt = opt_fn()
+    params, grads = _make_params()
+    p1, s1, p2, s2 = _run_both(opt, params, grads)
+    assert _max_diff(p1, p2) == 0.0
+    for k in s1:
+        for sk in s1[k]:
+            np.testing.assert_array_equal(np.asarray(s1[k][sk], np.float32),
+                                          np.asarray(s2[k][sk], np.float32))
+
+
+def test_fused_single_step_f32_bitwise():
+    # acceptance pin: zero tolerance for one f32 step
+    opt = optimizer.AdamW(0.01, weight_decay=0.01)
+    params, grads = _make_params(bf16_idx=())
+    p1, s1, p2, s2 = _run_both(opt, params, grads, steps=1)
+    assert _max_diff(p1, p2) == 0.0
+
+
+def test_fused_bf16_master_weights():
+    opt = optimizer.AdamW(0.01, weight_decay=0.02, multi_precision=True)
+    params, grads = _make_params(bf16_idx=(0, 1, 2))
+    p1, s1, p2, s2 = _run_both(opt, params, grads, steps=4)
+    # bf16 master-weight path: documented tolerance for multi-step
+    assert _max_diff(p1, p2) <= 1e-6
+    for k in s1:
+        assert ("master_weight" in s1[k]) == ("master_weight" in s2[k])
+        for sk in s1[k]:
+            np.testing.assert_allclose(
+                np.asarray(s1[k][sk], np.float32),
+                np.asarray(s2[k][sk], np.float32), atol=1e-6)
+
+
+def test_fused_global_norm_clip():
+    opt = optimizer.Adam(0.05, grad_clip=nn.ClipGradByGlobalNorm(0.25))
+    params, grads = _make_params(bf16_idx=())
+    p1, s1, p2, s2 = _run_both(opt, params, grads)
+    # reduction-order difference only
+    assert _max_diff(p1, p2) <= 1e-6
+
+
+def test_fused_state_representation_and_roundtrip():
+    opt = optimizer.Adam(0.01)
+    params, grads = _make_params()
+    state = opt.init_state(params)
+    new_p, fused_state = opt.apply_gradients_fused(params, grads, state,
+                                                   0.01, 1)
+    assert is_fused_state(fused_state)
+    assert FUSED_STATE_KEY in fused_state
+    # fused state feeds the next step directly
+    new_p2, fused2 = opt.apply_gradients_fused(new_p, grads, fused_state,
+                                               0.01, 2)
+    assert is_fused_state(fused2)
+    per_name = opt.unflatten_state(fused2)
+    assert set(per_name) == set(params)
+    assert set(per_name["p0"]) == {"moment1", "moment2"}
+    assert per_name["p0"]["moment1"].shape == params["p0"].shape
+
+
+def test_fused_exotic_state_falls_back_per_param():
+    opt = optimizer.Adam(0.01)
+    params, grads = _make_params(n=3, bf16_idx=())
+    state = opt.init_state(params)
+    state["p0"]["weird_slot"] = jnp.zeros_like(state["p0"]["moment1"])
+    plan = build_fused_plan(opt, params, grads, state)
+    assert plan is None
+    new_p, new_s = opt.apply_gradients_fused(params, grads, state, 0.01, 1)
+    assert not is_fused_state(new_s)         # per-param fallback
+    init = opt.init_state(params)
+    init["p0"]["weird_slot"] = jnp.zeros_like(init["p0"]["moment1"])
+    ref_p, ref_s = opt.apply_gradients(params, grads, init, 0.01, 1)
+    # fallback == the per-param path, bit for bit
+    assert _max_diff(new_p, ref_p) == 0.0
+
+
+def test_lamb_not_fused():
+    # per-tensor trust ratio is NOT element-wise: Lamb must refuse fusion
+    opt = optimizer.Lamb(0.01)
+    assert not opt._fused_supported()
+    params, grads = _make_params(n=3, bf16_idx=())
+    state = opt.init_state(params)
+    _, new_s = opt.apply_gradients_fused(params, grads, state, 0.01, 1)
+    assert not is_fused_state(new_s)
+
+
+def _donation_supported():
+    f = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+    a = jnp.ones((4,))
+    f(a)
+    return a.is_deleted()
+
+
+def test_donated_fused_apply_deletes_old_buffers():
+    if not _donation_supported():
+        pytest.skip("buffer donation unsupported on this backend")
+    opt = optimizer.AdamW(0.01, weight_decay=0.01)
+    params, grads = _make_params(bf16_idx=())
+    state = opt.init_state(params)
+    fn = opt.build_jit_apply(donate=True)
+    p, s = fn(params, grads, state, 0.01, 1)
+    p, s = fn(p, {k: v + 0 for k, v in grads.items()}, s, 0.01, 2)
+    old_params = p
+    old_moments = [s[FUSED_STATE_KEY][b]["moment1"] for b in
+                   s[FUSED_STATE_KEY]]
+    p, s = fn(p, {k: v + 0 for k, v in grads.items()}, s, 0.01, 3)
+    # donated params / grads / moments: the OLD buffers are gone — the
+    # optimizer state is updated in place, not double-buffered
+    assert all(v.is_deleted() for v in old_params.values())
+    assert all(m.is_deleted() for m in old_moments)
+
+
+def test_fused_beats_per_param_many_small_params():
+    # acceptance: >=200 small params, fused wall-clock beats the loop
+    n = 220
+    params = {f"p{i}": jnp.asarray(
+        rng.standard_normal((48 + (i % 5) * 16,)).astype(np.float32))
+        for i in range(n)}
+    grads = {k: jnp.asarray(rng.standard_normal(v.shape).astype(np.float32))
+             for k, v in params.items()}
+    opt = optimizer.AdamW(1e-3, weight_decay=0.01)
+
+    fused = opt.build_jit_apply(donate=False)
+    perparam = jax.jit(opt.apply_gradients)
+
+    def run(fn, reps=20):
+        p = dict(params)
+        s = opt.init_state(params)
+        p, s = fn(p, grads, s, 1e-3, 1)
+        p, s = fn(p, grads, s, 1e-3, 2)       # steady-state structure
+        jax.block_until_ready(p)
+        t0 = time.perf_counter()
+        for i in range(reps):
+            p, s = fn(p, grads, s, 1e-3, 3 + i)
+        jax.block_until_ready(p)
+        return time.perf_counter() - t0
+
+    t_fused = min(run(fused) for _ in range(2))
+    t_pp = min(run(perparam) for _ in range(2))
+    assert t_fused < t_pp, (t_fused, t_pp)
+
+
+def test_hapi_jit_step_uses_fused_state():
+    from paddle_tpu.hapi.model import Model
+
+    class DS(pt.io.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return (np.full((4,), i, np.float32),
+                    np.int64(i % 3))
+
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    m = Model(net)
+    m.prepare(optimizer=optimizer.AdamW(
+        0.01, weight_decay=0.01, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    m.fit(DS(), batch_size=4, epochs=1, verbose=0, device_prefetch=2)
+    assert is_fused_state(m._opt_state)
+    per = m._optimizer.unflatten_state(m._opt_state)
+    assert all("moment1" in slots for slots in per.values())
+
+
+# ---------------------------------------------------------------------------
+# device-prefetch input pipeline
+# ---------------------------------------------------------------------------
+
+class _RangeDS(pt.io.Dataset):
+    def __len__(self):
+        return 24
+
+    def __getitem__(self, i):
+        return np.full((4,), i, np.float32), np.int64(i)
+
+
+def test_device_prefetch_yields_committed_device_arrays_in_order():
+    from paddle_tpu.io import DataLoader
+    dl = DataLoader(_RangeDS(), batch_size=4, device_prefetch=2)
+    batches = list(iter(dl))
+    assert len(batches) == 6
+    for j, (x, y) in enumerate(batches):
+        assert isinstance(x._value, jax.Array)
+        assert x._value.committed            # staged, not lazily deferred
+        assert float(np.asarray(x._value)[0, 0]) == float(4 * j)
+
+
+def test_device_prefetch_mid_epoch_shutdown():
+    from paddle_tpu.io import DataLoader
+    dl = DataLoader(_RangeDS(), batch_size=4, device_prefetch=2)
+    it = iter(dl)
+    next(it)
+    next(it)
+    it.close()
+    assert not it._thread.is_alive()
+    # a fresh epoch after an abandoned one still yields from the start
+    x, _ = next(iter(dl))
+    assert float(np.asarray(x._value)[0, 0]) == 0.0
+
+
+def test_device_prefetch_iterator_helper():
+    from paddle_tpu.io import device_prefetch_iterator
+    src = [(np.ones((2,), np.float32) * i,) for i in range(5)]
+    got = [float(np.asarray(x)[0])
+           for (x,) in device_prefetch_iterator(src, size=3)]
+    assert got == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+
+def test_device_prefetch_propagates_producer_error():
+    from paddle_tpu.io import device_prefetch_iterator
+
+    def gen():
+        yield (np.zeros((2,), np.float32),)
+        raise RuntimeError("boom")
+
+    it = device_prefetch_iterator(gen(), size=2)
+    next(it)
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+    assert not it._thread.is_alive()
