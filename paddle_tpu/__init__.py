@@ -39,12 +39,13 @@ from .ops.api import *  # noqa: F401,F403
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import amp  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
 from . import vision  # noqa: F401
 from .framework import io as _framework_io
-from .framework.io import load, save  # noqa: F401
+from .framework.io import CheckpointCorruptError, load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .core.autograd import backward as _backward  # noqa: F401
 

@@ -99,9 +99,19 @@ class AmpScaler:
                 "good_steps": self._good_steps, "bad_steps": self._bad_steps}
 
     def load_state_dict(self, state: dict) -> None:
-        self._scale = state.get("scale", self._scale)
-        self._good_steps = state.get("good_steps", 0)
-        self._bad_steps = state.get("bad_steps", 0)
+        """Restore everything :meth:`state_dict` saves (reference
+        GradScaler.load_state_dict restores the scaling POLICY too, not
+        just the scale) — a resumed run must keep backing off/growing at
+        the configured cadence."""
+        self._scale = float(state.get("scale", self._scale))
+        self._incr_ratio = float(state.get("incr_ratio", self._incr_ratio))
+        self._decr_ratio = float(state.get("decr_ratio", self._decr_ratio))
+        self._incr_every = int(state.get("incr_every_n_steps",
+                                         self._incr_every))
+        self._decr_every = int(state.get("decr_every_n_nan_or_inf",
+                                         self._decr_every))
+        self._good_steps = int(state.get("good_steps", 0))
+        self._bad_steps = int(state.get("bad_steps", 0))
 
 
 class GradScaler(AmpScaler):

@@ -1,0 +1,35 @@
+"""Fault-tolerance subsystem (ISSUE 2): atomic/async checkpointing with a
+verified ``latest`` pointer, retention, auto-resume payload helpers, and
+jit-compatible anomaly step-guards.
+
+Reference semantics: ``paddle.save``/fleet checkpointing +
+``GradScaler``'s check_finite/update_loss_scaling skip-step machinery,
+rebuilt TPU-native: checkpoints are single atomic archives
+(framework/io.py) published under a manager that rotates old ones and
+only ever advances ``latest`` to a checksum-verified file; the async
+variant snapshots device state to host in the caller's thread and does
+disk I/O on ONE bounded background thread (the dataloader-prefetcher
+idiom); the step guard skips non-finite updates inside the compiled
+train step via where-select so donated buffers stay untouched.
+"""
+
+from .manager import (CheckpointManager, latest_checkpoint,
+                      LATEST_POINTER, CKPT_PREFIX, CKPT_SUFFIX)
+from .async_checkpointer import AsyncCheckpointer
+from .step_guard import (NonFiniteError, StepGuard, guard_select,
+                         nonfinite_guard)
+from ..framework.io import CheckpointCorruptError
+
+
+class TrainingPreempted(RuntimeError):
+    """SIGTERM arrived during ``Model.fit`` with checkpointing active: a
+    final checkpoint was flushed to disk before this was raised.  Restart
+    the job and call ``fit(resume="auto")`` to continue."""
+
+
+__all__ = [
+    "CheckpointManager", "AsyncCheckpointer", "latest_checkpoint",
+    "CheckpointCorruptError", "NonFiniteError", "StepGuard",
+    "guard_select", "nonfinite_guard", "TrainingPreempted",
+    "LATEST_POINTER", "CKPT_PREFIX", "CKPT_SUFFIX",
+]

@@ -1,0 +1,142 @@
+"""Async checkpointing: overlap the disk write with training.
+
+One bounded background thread (the io/dataloader prefetcher idiom — a
+daemon worker behind a ``queue.Queue(maxsize=N)``) performs the
+manager's atomic save+verify+publish, while the TRAINING thread only
+pays for the device→host snapshot.  The snapshot must be synchronous:
+the train step donates its param/moment buffers, so by the time the
+writer thread runs, the live arrays have been overwritten in place —
+the checkpoint serializes the host copy taken at call time.
+
+Failures on the writer thread are sticky: the next ``save``/``wait``/
+``close`` re-raises them on the caller's thread (exactly once), so a
+full disk cannot silently drop every subsequent checkpoint.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from .manager import CheckpointManager
+
+__all__ = ["AsyncCheckpointer"]
+
+
+def _snapshot(obj: Any):
+    """Deep-copy a checkpoint payload to host memory.  Device arrays
+    (and Tensors wrapping them) are fetched; host containers are
+    rebuilt so later in-place mutation by the caller cannot alias."""
+    from ..core.tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        t = Tensor(np.asarray(obj._value))
+        t.stop_gradient = obj.stop_gradient
+        return t
+    if isinstance(obj, dict):
+        return {k: _snapshot(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_snapshot(v) for v in obj)
+    if isinstance(obj, (bool, int, float, str)) or obj is None:
+        return obj
+    if hasattr(obj, "__array__") or hasattr(obj, "device"):
+        return np.asarray(obj)
+    return obj
+
+
+class AsyncCheckpointer:
+    """Wraps a :class:`CheckpointManager`; ``save`` returns as soon as
+    the state is snapshotted to host and enqueued.  The queue is bounded:
+    when ``queue_size`` saves are already pending, ``save`` blocks until
+    the writer catches up (bounding host memory to queue_size+1
+    snapshots)."""
+
+    _STOP = object()
+
+    def __init__(self, manager: CheckpointManager, queue_size: int = 1):
+        self.manager = manager
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(queue_size)))
+        self._exc: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._idle = threading.Event()
+        self._idle.set()
+        self._closed = False
+        self.last_saved_step: Optional[int] = None
+        self._thread = threading.Thread(target=self._writer, daemon=True,
+                                        name="paddle-tpu-ckpt-writer")
+        self._thread.start()
+
+    # -- writer thread --------------------------------------------------
+    def _writer(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is self._STOP:
+                return
+            state, step = item
+            try:
+                self.manager.save(state, step)
+                self.last_saved_step = step
+            except BaseException as e:
+                with self._lock:
+                    self._exc = e
+            finally:
+                with self._lock:
+                    self._pending -= 1
+                    if self._pending == 0:
+                        self._idle.set()
+
+    def _raise_pending(self) -> None:
+        with self._lock:
+            exc, self._exc = self._exc, None
+        if exc is not None:
+            raise exc
+
+    # -- caller side ----------------------------------------------------
+    def save(self, state: Any, step: int) -> None:
+        """Snapshot ``state`` to host and enqueue the disk write.  Blocks
+        only when ``queue_size`` writes are already pending."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        self._raise_pending()
+        snap = _snapshot(state)
+        with self._lock:
+            self._pending += 1
+            self._idle.clear()
+        self._q.put((snap, int(step)))
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every enqueued checkpoint is on disk; re-raises a
+        writer failure.  Returns False on timeout."""
+        done = self._idle.wait(timeout)
+        self._raise_pending()
+        return done
+
+    def close(self, timeout: float = 60.0) -> None:
+        """Drain pending writes and stop the writer.  Idempotent and
+        join-safe (a second close, or one racing the writer's own exit,
+        is a no-op)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._idle.wait(timeout)
+        self._q.put(self._STOP)
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout)
+        self._raise_pending()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close(timeout=5.0)
+        except Exception:
+            pass

@@ -1,0 +1,105 @@
+"""Anomaly step-guards: skip non-finite updates inside the compiled
+train step.
+
+Reference semantics: ``check_finite_and_unscale`` +
+``update_loss_scaling`` (python/paddle/amp/grad_scaler.py) — a step whose
+loss or gradients contain NaN/Inf must not touch params or optimizer
+moments, must back off the dynamic loss scale, and repeated occurrences
+must abort with a diagnosis instead of silently training on garbage.
+
+TPU-native shape: the check and the skip both live INSIDE the jitted
+step.  ``nonfinite_guard`` reduces loss+grads to one boolean scalar;
+``guard_select`` where-selects every output leaf between the computed
+update and the carried-in state.  A select keeps the program a single
+branch-free XLA executable (no retrace, donation-safe: XLA may alias the
+output to either operand) — exactly the ``lax.cond``-free formulation
+the fused optimizer's donated flat buffers need, since params and
+moments then come back bit-identical on a skipped step.
+
+Host side, :class:`StepGuard` counts consecutive skips and raises
+:class:`NonFiniteError` past a threshold.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["NonFiniteError", "StepGuard", "nonfinite_guard",
+           "guard_select"]
+
+
+class NonFiniteError(FloatingPointError):
+    """Training diverged: too many consecutive steps produced a
+    non-finite loss or gradients and were skipped."""
+
+
+def nonfinite_guard(loss, grads) -> jax.Array:
+    """Scalar bool: True when ``loss`` and every gradient element are
+    finite (the update may be applied).  jit-compatible; grads may be any
+    pytree.  Uses all-isfinite rather than an isfinite(norm) check so a
+    large-but-finite gradient whose SQUARE overflows is not a false
+    positive."""
+    ok = jnp.isfinite(jnp.asarray(loss)).all()
+    for g in jax.tree_util.tree_leaves(grads):
+        if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
+            ok = ok & jnp.isfinite(g).all()
+    return ok
+
+
+def guard_select(ok, new_tree, old_tree):
+    """``new_tree`` where ``ok`` else ``old_tree``, leaf-wise.  Both trees
+    must share structure/dtypes; with ``ok`` scalar this lowers to one
+    select per leaf and is donation-safe."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new_tree, old_tree)
+
+
+class StepGuard:
+    """Host-side skip accounting for the in-graph guard.
+
+    ``record(skipped)`` after each step; raises :class:`NonFiniteError`
+    once ``max_consecutive`` skips occur back to back.  ``scaler`` (an
+    ``amp.GradScaler``) is optional — when present, each skip counts as a
+    found-inf step (backing off the dynamic loss scale) and each good
+    step as a growth step."""
+
+    def __init__(self, max_consecutive: int = 50, scaler=None):
+        if max_consecutive < 1:
+            raise ValueError("max_consecutive must be >= 1")
+        self.max_consecutive = max_consecutive
+        self.scaler = scaler
+        self.consecutive = 0
+        self.total_skipped = 0
+
+    def record(self, skipped: bool, step: Optional[int] = None,
+               loss: Any = None) -> None:
+        if self.scaler is not None and self.scaler.is_enable():
+            self.scaler._found_inf = bool(skipped)
+            self.scaler.update()
+        if not skipped:
+            self.consecutive = 0
+            return
+        self.consecutive += 1
+        self.total_skipped += 1
+        if self.consecutive >= self.max_consecutive:
+            where = f" at step {step}" if step is not None else ""
+            lossmsg = f" (last loss: {loss})" if loss is not None else ""
+            raise NonFiniteError(
+                f"{self.consecutive} consecutive training steps{where} "
+                f"produced non-finite loss or gradients and were skipped"
+                f"{lossmsg}. The model state was NOT updated by any of "
+                "them. Likely causes: learning rate too high, fp16 "
+                "overflow with too large an initial loss scale, or bad "
+                "input data. Lower the LR / loss scale, or raise "
+                "max_consecutive_skips if spikes are expected.")
+
+    def state_dict(self) -> dict:
+        return {"consecutive": self.consecutive,
+                "total_skipped": self.total_skipped}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.consecutive = int(state.get("consecutive", 0))
+        self.total_skipped = int(state.get("total_skipped", 0))

@@ -5,6 +5,15 @@ Format: a single ``.pdparams``-style file = npz archive of arrays + a JSON
 manifest of the pytree structure (safer and faster than pickle for pure
 tensors; falls back to pickle for arbitrary objects).  Sharded/reshardable
 distributed checkpoints live in paddle_tpu.distributed.checkpoint.
+
+Durability contract (ISSUE 2): ``save`` is ATOMIC — the archive is built
+in memory, written to a same-directory temp file, fsynced, and
+``os.replace``d over the target, so a crash mid-save can never leave a
+truncated file at ``path``; at worst a stale ``.tmp-*`` straggler remains
+(cleaned up opportunistically by the next save).  Every array member
+carries a CRC32 in the manifest, verified on read — ``load`` raises
+:class:`CheckpointCorruptError` (never a raw ``zipfile.BadZipFile``) on
+truncation, bit-rot, or checksum mismatch.
 """
 
 from __future__ import annotations
@@ -13,16 +22,26 @@ import io as _io
 import json
 import os
 import pickle
+import tempfile
 import zipfile
+import zlib
 from typing import Any, Dict
 
 import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["save", "load"]
+__all__ = ["save", "load", "verify", "CheckpointCorruptError"]
 
 _MAGIC = "paddle_tpu.v1"
+_TMP_PREFIX = ".tmp-"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file is truncated, unreadable, or fails checksum
+    verification.  The file should be discarded; recovery is the previous
+    checkpoint (checkpoint.CheckpointManager keeps ``latest`` pointing at
+    a verified-complete one)."""
 
 
 def _flatten(obj: Any, prefix: str, arrays: Dict[str, np.ndarray]):
@@ -66,28 +85,149 @@ def _unflatten(spec: Any, arrays) -> Any:
     raise ValueError(f"bad manifest entry {spec!r}")
 
 
-def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
+# -- injectable durability seams (tests/faults.py monkeypatches these to
+#    simulate a crash mid-write / a failed rename) ------------------------
+def _write_bytes(f, data: bytes) -> None:
+    f.write(data)
+
+
+def _replace(tmp: str, path: str) -> None:
+    os.replace(tmp, path)
+
+
+def _fsync_dir(d: str) -> None:
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return                      # e.g. platforms without dir fds
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(data: bytes, path: str) -> None:
+    """Durably publish ``data`` at ``path``: same-dir temp file + fsync +
+    ``os.replace`` + directory fsync.  Readers never observe a partial
+    file; a crash leaves only a ``.tmp-*`` straggler."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=_TMP_PREFIX,
+                               suffix="-" + os.path.basename(path))
+    try:
+        with os.fdopen(fd, "wb") as f:
+            _write_bytes(f, data)
+            f.flush()
+            os.fsync(f.fileno())
+        _replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(d)
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
     arrays: Dict[str, np.ndarray] = {}
-    manifest = {"magic": _MAGIC, "tree": _flatten(obj, "root", arrays)}
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
+    tree = _flatten(obj, "root", arrays)
+    payloads: Dict[str, bytes] = {}
+    checksums: Dict[str, int] = {}
+    for name, arr in arrays.items():
+        buf = _io.BytesIO()
+        np.save(buf, arr, allow_pickle=False)
+        data = buf.getvalue()
+        payloads[name] = data
+        checksums[name] = zlib.crc32(data)
+    manifest = {"magic": _MAGIC, "tree": tree, "checksums": checksums}
+    zbuf = _io.BytesIO()
+    with zipfile.ZipFile(zbuf, "w", zipfile.ZIP_STORED) as zf:
         zf.writestr("manifest.json", json.dumps(manifest))
-        for name, arr in arrays.items():
-            buf = _io.BytesIO()
-            np.save(buf, arr, allow_pickle=False)
-            zf.writestr(name + ".npy", buf.getvalue())
+        for name, data in payloads.items():
+            zf.writestr(name + ".npy", data)
+    atomic_write_bytes(zbuf.getvalue(), path)
+
+
+def _open_checkpoint(path: str) -> "zipfile.ZipFile":
+    try:
+        zf = zipfile.ZipFile(path, "r")
+    except (zipfile.BadZipFile, EOFError, OSError) as e:
+        if isinstance(e, FileNotFoundError):
+            raise
+        raise CheckpointCorruptError(
+            f"{path}: not a readable checkpoint archive (truncated save or "
+            f"on-disk corruption): {e}") from e
+    return zf
+
+
+def _read_manifest(zf: "zipfile.ZipFile", path: str) -> dict:
+    try:
+        manifest = json.loads(zf.read("manifest.json"))
+    except (KeyError, zipfile.BadZipFile, json.JSONDecodeError,
+            EOFError, OSError) as e:
+        raise CheckpointCorruptError(
+            f"{path}: checkpoint manifest missing or unreadable: {e}"
+        ) from e
+    if manifest.get("magic") != _MAGIC:
+        raise ValueError(f"{path} is not a paddle_tpu checkpoint")
+    return manifest
 
 
 def load(path: str, **configs) -> Any:
-    with zipfile.ZipFile(path, "r") as zf:
-        manifest = json.loads(zf.read("manifest.json"))
-        if manifest.get("magic") != _MAGIC:
-            raise ValueError(f"{path} is not a paddle_tpu checkpoint")
+    with _open_checkpoint(path) as zf:
+        manifest = _read_manifest(zf, path)
+        checksums = manifest.get("checksums", {})
 
         class _Lazy:
             def __getitem__(self, name):
-                with zf.open(name + ".npy") as f:
-                    return np.load(_io.BytesIO(f.read()), allow_pickle=False)
+                try:
+                    with zf.open(name + ".npy") as f:
+                        data = f.read()
+                except (KeyError, zipfile.BadZipFile, EOFError,
+                        OSError) as e:
+                    raise CheckpointCorruptError(
+                        f"{path}: array member {name!r} missing or "
+                        f"unreadable: {e}") from e
+                want = checksums.get(name)
+                if want is not None and zlib.crc32(data) != want:
+                    raise CheckpointCorruptError(
+                        f"{path}: checksum mismatch on array {name!r} — "
+                        "checkpoint is corrupt")
+                try:
+                    return np.load(_io.BytesIO(data), allow_pickle=False)
+                except ValueError as e:
+                    raise CheckpointCorruptError(
+                        f"{path}: array {name!r} failed to decode: {e}"
+                    ) from e
 
         return _unflatten(manifest["tree"], _Lazy())
+
+
+def verify(path: str) -> bool:
+    """Full integrity check without materializing the pytree: manifest
+    parses, every member's zip CRC passes, and every array payload matches
+    its manifest checksum.  Raises :class:`CheckpointCorruptError` (or
+    ``FileNotFoundError``) on failure; returns True otherwise.  Used by
+    CheckpointManager before advancing the ``latest`` pointer."""
+    with _open_checkpoint(path) as zf:
+        manifest = _read_manifest(zf, path)
+        bad = zf.testzip()
+        if bad is not None:
+            raise CheckpointCorruptError(
+                f"{path}: member {bad!r} fails zip CRC — checkpoint is "
+                "corrupt")
+        for name, want in manifest.get("checksums", {}).items():
+            try:
+                data = zf.read(name + ".npy")
+            except (KeyError, zipfile.BadZipFile, EOFError, OSError) as e:
+                raise CheckpointCorruptError(
+                    f"{path}: array member {name!r} missing or "
+                    f"unreadable: {e}") from e
+            if zlib.crc32(data) != want:
+                raise CheckpointCorruptError(
+                    f"{path}: checksum mismatch on array {name!r} — "
+                    "checkpoint is corrupt")
+    return True

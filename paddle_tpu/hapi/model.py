@@ -56,15 +56,53 @@ class Model:
         self._jit_eval = None
         self._opt_state = None
         self._step_count = 0
+        self._scaler = None
+        self._step_guard = None
+        self._skip_nonfinite = True
+        self._preempted = False
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None, jit: bool = True):
+                amp_configs=None, jit: bool = True,
+                skip_nonfinite: bool = True,
+                max_consecutive_skips: int = 50):
+        """``skip_nonfinite`` arms the in-graph anomaly guard (see
+        checkpoint/step_guard.py): a step whose loss or grads contain
+        NaN/Inf leaves params/moments untouched, backs off the dynamic
+        loss scale (when amp is configured), and after
+        ``max_consecutive_skips`` back-to-back skips raises
+        NonFiniteError.  ``amp_configs`` may be a GradScaler, or a dict
+        of GradScaler kwargs (optionally under a ``"scaler"`` key)."""
+        from ..checkpoint.step_guard import StepGuard
+
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
         self._use_jit = jit
+        self._scaler = self._make_scaler(amp_configs)
+        self._skip_nonfinite = skip_nonfinite
+        self._step_guard = StepGuard(max_consecutive_skips,
+                                     scaler=self._scaler)
+        self._jit_step = None      # guard/scaler config changes the program
         return self
+
+    @staticmethod
+    def _make_scaler(amp_configs):
+        from ..amp.grad_scaler import GradScaler
+
+        if amp_configs is None:
+            return None
+        if isinstance(amp_configs, GradScaler):
+            return amp_configs
+        if isinstance(amp_configs, dict):
+            if isinstance(amp_configs.get("scaler"), GradScaler):
+                return amp_configs["scaler"]
+            import inspect as _inspect
+            keys = set(_inspect.signature(GradScaler).parameters)
+            kwargs = {k: v for k, v in amp_configs.items() if k in keys}
+            if kwargs:
+                return GradScaler(**kwargs)
+        return None
 
     # ------------------------------------------------------------------
     # jitted step machinery
@@ -73,10 +111,12 @@ class Model:
         net = self.network
         opt = self._optimizer
         loss_layer = self._loss
+        guard = self._skip_nonfinite
 
         trainable_names = {n for n, p in net.named_parameters() if p.trainable}
 
-        def step(params, buffers, opt_state, step_no, lr, rng, inputs, labels):
+        def step(params, buffers, opt_state, step_no, lr, rng, loss_scale,
+                 inputs, labels):
             def loss_fn(train_params):
                 arrays = {**buffers, **params, **train_params}
                 net.train()
@@ -90,22 +130,54 @@ class Model:
                 lv = loss._value if isinstance(loss, Tensor) else loss
                 outs_v = [o._value if isinstance(o, Tensor) else o
                           for o in outs_l]
-                return lv, (outs_v, new_buffers)
+                # dynamic loss scaling: differentiate scale*loss, unscale
+                # grads below.  scale == 1.0 (amp off) seeds the backward
+                # pass with exactly 1.0, so numerics are bit-identical to
+                # an unscaled step.
+                return lv * loss_scale, (lv, outs_v, new_buffers)
 
             train_params = {n: v for n, v in params.items()
                             if n in trainable_names}
-            (loss_v, (outs_v, new_buffers)), grads = jax.value_and_grad(
+            (_, (loss_v, outs_v, new_buffers)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(train_params)
+            inv_scale = 1.0 / loss_scale
+            grads = {n: g * inv_scale for n, g in grads.items()}
             # fused multi-tensor update (optimizer/fused.py): one bucketed
             # kernel instead of a per-param loop; opt_state comes back in
             # fused (flat) form and is threaded through unchanged
             new_train, new_opt_state = opt.apply_gradients_fused(
                 train_params, grads, opt_state, lr, step_no)
-            new_params = dict(params)
-            new_params.update(new_train)
             kept_buffers = {n: new_buffers.get(n, v)
                             for n, v in buffers.items()}
-            return new_params, kept_buffers, new_opt_state, loss_v, outs_v
+            if guard:
+                # anomaly step-guard (checkpoint/step_guard.py): a scalar
+                # where-select keeps the program branch-free and donation-
+                # safe — on a non-finite step every param/moment/buffer
+                # comes back bit-identical to its input
+                from ..checkpoint.step_guard import (guard_select,
+                                                     nonfinite_guard)
+                from ..optimizer.fused import flatten_state, is_fused_state
+                ok = nonfinite_guard(loss_v, grads)
+                old_state = opt_state
+                if (jax.tree_util.tree_structure(new_opt_state)
+                        != jax.tree_util.tree_structure(opt_state)):
+                    # first fused step: input state is per-name, output is
+                    # flat — express "unchanged" in the output's layout
+                    old_state = (flatten_state(opt._fused_active_plan,
+                                               opt_state)
+                                 if is_fused_state(new_opt_state) else None)
+                new_train = guard_select(ok, new_train, train_params)
+                if old_state is not None:
+                    new_opt_state = guard_select(ok, new_opt_state,
+                                                 old_state)
+                kept_buffers = guard_select(ok, kept_buffers, buffers)
+                notfinite = ~ok
+            else:
+                notfinite = jnp.zeros((), bool)
+            new_params = dict(params)
+            new_params.update(new_train)
+            return (new_params, kept_buffers, new_opt_state, loss_v,
+                    outs_v, notfinite)
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -138,6 +210,9 @@ class Model:
             self._opt_state = self._optimizer.init_state(trainable)
         lr = self._optimizer.get_lr()
         rng = next_rng_key()
+        scale = (self._scaler.get_loss_scaling()
+                 if self._scaler is not None and self._scaler.is_enable()
+                 else 1.0)
         import warnings
         with warnings.catch_warnings():
             # step 1 donates per-name opt state but returns FUSED (flat)
@@ -145,15 +220,29 @@ class Model:
             # every later step aliases them in place
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
-            params, buffers, self._opt_state, loss_v, outs_v = \
+            params, buffers, self._opt_state, loss_v, outs_v, notfin = \
                 self._jit_step(params, buffers, self._opt_state,
-                               self._step_count + 1, lr, rng, inputs,
-                               labels)
+                               self._step_count + 1, lr, rng, scale,
+                               inputs, labels)
         self._write_state(params, buffers)
-        self._step_count += 1
+        loss = float(np.asarray(loss_v))
+        skipped = self._skip_nonfinite and bool(np.asarray(notfin))
+        if skipped:
+            # update applied nothing (where-select kept old state); the
+            # guard backs off the loss scale and errors out after too
+            # many consecutive skips
+            self._record_step_outcome(True, loss)
+        else:
+            self._record_step_outcome(False, loss)
+            self._step_count += 1
         self._optimizer._scheduler_step()
         metrics = self._update_metrics(outs_v, labels)
-        return [float(np.asarray(loss_v))], metrics
+        return [loss], metrics
+
+    def _record_step_outcome(self, skipped: bool, loss: float) -> None:
+        if self._step_guard is not None:
+            self._step_guard.record(skipped, step=self._step_count + 1,
+                                    loss=loss)
 
     def _train_batch_eager(self, inputs, labels):
         self.network.train()
@@ -163,12 +252,23 @@ class Model:
         outs_l = _to_list(outs)
         loss = self._loss(*outs_l, *t_lab) if self._loss else outs_l[0]
         loss.backward()
-        self._optimizer.step()
+        loss_f = float(loss.numpy())
+        skipped = False
+        if self._skip_nonfinite:
+            skipped = not np.isfinite(loss_f) or any(
+                not bool(np.all(np.isfinite(np.asarray(p.grad._value))))
+                for p in (self._optimizer._parameters or [])
+                if p.grad is not None)
+        if skipped:
+            self._record_step_outcome(True, loss_f)
+        else:
+            self._optimizer.step()
+            self._record_step_outcome(False, loss_f)
         self._optimizer.clear_grad()
         self._optimizer._scheduler_step()
         metrics = self._update_metrics([o._value for o in outs_l],
                                        [t._value for t in t_lab])
-        return [float(loss.numpy())], metrics
+        return [loss_f], metrics
 
     def _update_metrics(self, outs_v, labels_v):
         res = []
@@ -216,7 +316,19 @@ class Model:
             save_dir: Optional[str] = None, save_freq: int = 1,
             verbose: int = 2, drop_last: bool = False, shuffle: bool = True,
             num_workers: int = 0, callbacks=None, accumulate_grad_batches=1,
-            num_iters: Optional[int] = None, device_prefetch: int = 0):
+            num_iters: Optional[int] = None, device_prefetch: int = 0,
+            resume=None, keep_last: int = 5, async_save: bool = False):
+        """``save_dir`` additionally maintains rotating fault-tolerant
+        checkpoints (checkpoint/CheckpointManager: atomic files, verified
+        ``latest`` pointer, ``keep_last`` retention; ``async_save``
+        overlaps the disk write with training).  ``resume="auto"``
+        restarts from the latest verified checkpoint in ``save_dir``
+        (no-op when none exists); ``resume=<path-or-dir>`` restarts from
+        an explicit checkpoint.  Restores params, optimizer slots, loss
+        scale, step counters, and the sampler/RNG position, continuing
+        bit-exact with the uninterrupted run.  While checkpointing is
+        active a SIGTERM (preemption notice) flushes a final checkpoint
+        at the next batch boundary and raises TrainingPreempted."""
         from ..io import DataLoader
         from ..io.dataset import Dataset
 
@@ -232,6 +344,15 @@ class Model:
         else:
             eval_loader = eval_data
 
+        ckpt = None
+        if save_dir is not None:
+            from ..checkpoint import AsyncCheckpointer, CheckpointManager
+            manager = CheckpointManager(save_dir, keep_last=keep_last)
+            ckpt = AsyncCheckpointer(manager) if async_save else manager
+
+        start_epoch, skip_steps, resume_rng = self._apply_resume(
+            resume, save_dir)
+
         cbks = CallbackList(_to_list(callbacks) or [ProgBarLogger(log_freq,
                                                                   verbose)])
         cbks.set_model(self)
@@ -243,31 +364,192 @@ class Model:
                          "verbose": verbose,
                          "metrics": ["loss"] + self._metric_names()})
 
+        sig_state = self._install_sigterm(enabled=ckpt is not None)
         cbks.on_train_begin()
         it = 0
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            for step, batch in enumerate(train_loader):
-                inputs, labels = self._unpack(batch)
-                cbks.on_train_batch_begin(step)
-                losses, metrics = self.train_batch(inputs, labels)
-                logs = self._make_logs(losses, metrics)
-                cbks.on_train_batch_end(step, logs)
-                it += 1
+        logs = {}
+        try:
+            for epoch in range(start_epoch, epochs):
+                from ..core.rng import get_rng_state, set_rng_state
+                rng_epoch_start = np.array(get_rng_state())
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                for step, batch in enumerate(train_loader):
+                    if skip_steps:
+                        # mid-epoch resume: replay the epoch's sampler
+                        # order and fast-forward past already-trained
+                        # batches; the checkpointed RNG state then takes
+                        # over so later draws match the original run
+                        skip_steps -= 1
+                        if skip_steps == 0 and resume_rng is not None:
+                            set_rng_state(resume_rng)
+                            resume_rng = None
+                        continue
+                    inputs, labels = self._unpack(batch)
+                    cbks.on_train_batch_begin(step)
+                    losses, metrics = self.train_batch(inputs, labels)
+                    logs = self._make_logs(losses, metrics)
+                    cbks.on_train_batch_end(step, logs)
+                    it += 1
+                    if self._preempted and ckpt is not None:
+                        self._flush_preempt_checkpoint(
+                            ckpt, epoch, step + 1, rng_epoch_start)
+                    if num_iters is not None and it >= num_iters:
+                        break
+                cbks.on_epoch_end(epoch, logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_loader, callbacks=callbacks,
+                                  verbose=verbose)
+                if save_dir is not None and (epoch + 1) % save_freq == 0:
+                    self.save(f"{save_dir}/epoch_{epoch}")
+                    if ckpt is not None:
+                        ckpt.save(self._checkpoint_payload(
+                            epoch + 1, 0, rng_epoch_start),
+                            self._step_count)
                 if num_iters is not None and it >= num_iters:
                     break
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_loader, callbacks=callbacks,
-                              verbose=verbose)
-            if save_dir is not None and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/epoch_{epoch}")
-            if num_iters is not None and it >= num_iters:
-                break
-        cbks.on_train_end(logs)
+            cbks.on_train_end(logs)
+        finally:
+            self._restore_sigterm(sig_state)
+            if ckpt is not None and hasattr(ckpt, "close"):
+                ckpt.close()
         return self
+
+    # -- fault tolerance machinery (checkpoint/) -----------------------
+    def _checkpoint_payload(self, epoch: int, step_in_epoch: int,
+                            rng_epoch_start) -> Dict[str, Any]:
+        """Everything fit(resume=...) needs to continue bit-exact: model
+        arrays, per-name optimizer slots (fused flat buckets are
+        unflattened for portability), loss-scaler state, step counters,
+        and the RNG position (current + at epoch start, so a mid-epoch
+        resume can replay the epoch's shuffle then fast-forward)."""
+        from ..core.rng import get_rng_state
+
+        opt_sd = {}
+        if self._optimizer is not None:
+            opt_sd = self._optimizer.state_dict()
+            if self._opt_state is not None:
+                per_name = self._optimizer.unflatten_state(self._opt_state)
+                for pname, slots in per_name.items():
+                    for sname, v in slots.items():
+                        opt_sd[f"{pname}/{sname}"] = Tensor(v)
+        return {
+            "model": self.network.state_dict(),
+            "optimizer": opt_sd,
+            "scaler": (self._scaler.state_dict()
+                       if self._scaler is not None else None),
+            "guard": (self._step_guard.state_dict()
+                      if self._step_guard is not None else None),
+            "meta": {"version": 1, "epoch": int(epoch),
+                     "step_in_epoch": int(step_in_epoch),
+                     "global_step": int(self._step_count),
+                     "rng_state": np.array(get_rng_state()),
+                     "rng_epoch_start": np.array(rng_epoch_start)},
+        }
+
+    def _restore_checkpoint_payload(self, payload: Dict[str, Any]) -> dict:
+        self.network.set_state_dict(payload["model"])
+        opt_sd = payload.get("optimizer") or {}
+        if self._optimizer is not None and opt_sd:
+            self._optimizer.set_state_dict(opt_sd)
+            self._opt_state = self._per_name_opt_state(opt_sd)
+        if payload.get("scaler") is not None and self._scaler is not None:
+            self._scaler.load_state_dict(payload["scaler"])
+        if payload.get("guard") is not None and \
+                self._step_guard is not None:
+            self._step_guard.load_state_dict(payload["guard"])
+        meta = payload.get("meta", {})
+        self._step_count = int(meta.get("global_step", 0))
+        return meta
+
+    @staticmethod
+    def _per_name_opt_state(flat_sd: Dict[str, Any]):
+        """'pname/sname' flat checkpoint keys → the per-name slot pytree
+        the jitted step threads through (re-fused on the next step).
+        Leaves are committed to device: the step donates this pytree, and
+        donating host-numpy leaves is where corruption hides."""
+        per: Dict[str, Dict[str, Any]] = {}
+        for key, v in flat_sd.items():
+            if key.startswith("@"):
+                continue
+            pname, _, sname = key.rpartition("/")
+            per.setdefault(pname, {})[sname] = jnp.asarray(
+                v._value if isinstance(v, Tensor) else v)
+        return per or None
+
+    def _apply_resume(self, resume, save_dir):
+        """Returns (start_epoch, steps_to_skip, rng_state_after_skip).
+
+        Also restores the RNG: an epoch-boundary resume places the
+        generator exactly where the interrupted run left it; a mid-epoch
+        resume first rewinds it to the interrupted EPOCH's start so the
+        sampler replays the same shuffle, and the checkpointed mid-epoch
+        state is re-applied once the trained batches have been skipped."""
+        if resume is None:
+            return 0, 0, None
+        import os
+        from ..checkpoint import latest_checkpoint
+        from ..core.rng import set_rng_state
+        from ..framework.io import load as _load
+
+        if resume == "auto":
+            path = (latest_checkpoint(save_dir)
+                    if save_dir is not None else None)
+            if path is None:
+                return 0, 0, None       # fresh run
+        elif isinstance(resume, str) and os.path.isdir(resume):
+            path = latest_checkpoint(resume)
+            if path is None:
+                raise FileNotFoundError(
+                    f"resume: no usable checkpoint found in {resume}")
+        else:
+            path = resume
+        meta = self._restore_checkpoint_payload(_load(path))
+        skip = int(meta.get("step_in_epoch", 0))
+        rng_now = meta.get("rng_state")
+        if skip > 0 and meta.get("rng_epoch_start") is not None:
+            set_rng_state(meta["rng_epoch_start"])
+            return int(meta.get("epoch", 0)), skip, rng_now
+        if rng_now is not None:
+            set_rng_state(rng_now)
+        return int(meta.get("epoch", 0)), skip, None
+
+    def _install_sigterm(self, enabled: bool):
+        """Preemption notice → flush a final checkpoint at the next batch
+        boundary.  Only installable on the main thread; elsewhere (or
+        when checkpointing is off) this is a no-op."""
+        self._preempted = False
+        if not enabled:
+            return None
+        import signal
+
+        def _on_sigterm(signum, frame):
+            self._preempted = True
+
+        try:
+            prev = signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:          # not the main thread
+            return None
+        return (signal, prev)
+
+    def _restore_sigterm(self, sig_state) -> None:
+        if sig_state is not None:
+            signal, prev = sig_state
+            signal.signal(signal.SIGTERM, prev)
+
+    def _flush_preempt_checkpoint(self, ckpt, epoch, next_step,
+                                  rng_epoch_start) -> None:
+        from ..checkpoint import TrainingPreempted
+        ckpt.save(self._checkpoint_payload(epoch, next_step,
+                                           rng_epoch_start),
+                  self._step_count)
+        if hasattr(ckpt, "wait"):
+            ckpt.wait()             # the drain must hit disk before exit
+        raise TrainingPreempted(
+            f"SIGTERM received: checkpoint flushed at epoch {epoch}, "
+            f"step {next_step} (global step {self._step_count}); "
+            "resume with fit(resume='auto').")
 
     def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
                  verbose: int = 2, num_workers: int = 0, callbacks=None,
@@ -346,6 +628,11 @@ class Model:
                 for pname, slots in per_name.items():
                     for sname, v in slots.items():
                         opt_sd[f"{pname}/{sname}"] = Tensor(v)
+            opt_sd["@global_step"] = self._step_count
+            if self._scaler is not None:
+                # resumed runs keep the dynamic loss scale instead of
+                # resetting to the 2**15 default
+                opt_sd["@scaler"] = self._scaler.state_dict()
             _save(opt_sd, path + ".pdopt")
 
     def load(self, path: str, skip_mismatch: bool = False, reset_optimizer=False):
@@ -355,7 +642,16 @@ class Model:
         import os
         if not reset_optimizer and self._optimizer is not None and \
                 os.path.exists(path + ".pdopt"):
-            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+            opt_sd = _load(path + ".pdopt")
+            scaler_sd = opt_sd.pop("@scaler", None)
+            if scaler_sd is not None and self._scaler is not None:
+                self._scaler.load_state_dict(scaler_sd)
+            self._step_count = int(opt_sd.pop("@global_step",
+                                              self._step_count))
+            self._optimizer.set_state_dict(opt_sd)
+            # the jitted step threads its own opt-state pytree; rebuild
+            # it from the restored slots so resume keeps the moments
+            self._opt_state = self._per_name_opt_state(opt_sd)
         return self
 
     def parameters(self, *args, **kwargs):

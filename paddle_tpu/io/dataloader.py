@@ -42,14 +42,28 @@ class _DevicePrefetcher:
     """Bounded background thread that stages the next N host batches onto
     device (``jax.device_put``) so host→device transfer overlaps step
     execution.  Yields batches IN ORDER; ``close()`` (or abandoning the
-    iterator mid-epoch) wakes and joins the producer thread."""
+    iterator mid-epoch) wakes and joins the producer thread.
+
+    Robustness contract (ISSUE 2): transient staging failures (device
+    transfer hiccups — RuntimeError/OSError and jax runtime errors) are
+    retried with bounded exponential backoff before propagating; a
+    producer exception surfaces on the CONSUMER thread exactly once (the
+    iterator then terminates — it does not re-raise on every
+    subsequent ``next``); ``close()`` is idempotent and join-safe."""
 
     _END = object()
+    #: transient-staging retry schedule: attempt k sleeps BACKOFF_BASE*2^k
+    STAGE_RETRIES = 3
+    BACKOFF_BASE = 0.05
+
+    _RETRYABLE = (RuntimeError, OSError)
 
     def __init__(self, produce, size: int, sharding=None,
                  convert: Optional[Callable] = None):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(size)))
         self._closed = threading.Event()
+        self._close_lock = threading.Lock()
+        self._joined = False
         self._exc: Optional[BaseException] = None
         self._sharding = sharding
         self._convert = convert
@@ -96,12 +110,28 @@ class _DevicePrefetcher:
                 continue
         return False
 
+    def _stage_with_retry(self, item):
+        """Retry transient staging failures with bounded exponential
+        backoff; give up (and propagate) after STAGE_RETRIES attempts or
+        on a non-transient error type."""
+        import time
+
+        attempt = 0
+        while True:
+            try:
+                return self._stage(item)
+            except self._RETRYABLE:
+                if attempt >= self.STAGE_RETRIES or self._closed.is_set():
+                    raise
+                time.sleep(self.BACKOFF_BASE * (2 ** attempt))
+                attempt += 1
+
     def _worker(self, produce):
         try:
             for item in produce():
                 if self._convert is not None:
                     item = self._convert(item)
-                if not self._enqueue(self._stage(item)):
+                if not self._enqueue(self._stage_with_retry(item)):
                     return                   # consumer closed early
         except BaseException as e:           # propagate to consumer
             self._exc = e
@@ -118,21 +148,30 @@ class _DevicePrefetcher:
         item = self._q.get()
         if item is self._END:
             self.close()
-            if self._exc is not None:
-                raise self._exc
+            exc, self._exc = self._exc, None
+            if exc is not None:
+                raise exc        # exactly once; later nexts StopIterate
             raise StopIteration
         return item
 
     def close(self) -> None:
         """Mid-epoch shutdown: wake the (possibly blocked) producer,
-        drain the queue, and join the thread."""
+        drain the queue, and join the thread.  Idempotent (second close
+        is a no-op) and join-safe (never joins the current thread, and
+        never joins the same thread twice)."""
         self._closed.set()
-        while True:
-            try:
-                self._q.get_nowait()
-            except queue.Empty:
-                break
-        self._thread.join(timeout=5.0)
+        with self._close_lock:
+            if self._joined:
+                return
+            while True:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+            if self._thread is threading.current_thread():
+                return
+            self._thread.join(timeout=5.0)
+            self._joined = not self._thread.is_alive()
 
     def __del__(self):
         try:
@@ -207,8 +246,11 @@ class _PrefetchIterator:
     def close(self):
         """Consumer-side shutdown: wake a possibly-blocked producer, wait
         for it to exit, and only then let the native ring be destroyed
-        (prevents use-after-free on early iteration abandonment)."""
+        (prevents use-after-free on early iteration abandonment).
+        Idempotent and join-safe."""
         self._ring.close()
+        if self._thread is threading.current_thread():
+            return
         self._thread.join(timeout=2.0)
         if self._thread.is_alive():
             # producer stuck: leak the native ring rather than free it
@@ -228,8 +270,9 @@ class _PrefetchIterator:
         token = self._ring.pop()
         if token is None:
             self.close()
-            if self._exc is not None:
-                raise self._exc
+            exc, self._exc = self._exc, None
+            if exc is not None:
+                raise exc        # exactly once; later nexts StopIterate
             raise StopIteration
         with self._slots_lock:
             item = self._slots.pop(token)

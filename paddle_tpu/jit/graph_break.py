@@ -466,6 +466,13 @@ def build_hybrid(fn: Callable) -> Optional[HybridFunction]:
         return None
     if _contains(fdef, (ast.Yield, ast.YieldFrom)):
         return None
+    if _contains(fdef, (ast.Global, ast.Nonlocal)):
+        # eager segments exec against a COPY of fn.__globals__, so a
+        # ``global x`` rebind inside a segment would never reach the real
+        # module global (ADVICE r5) — such functions must run whole-call
+        # eager, where the original function object (and its true
+        # globals dict) executes
+        return None
     body = list(fdef.body)
     segments: List[Tuple[str, _Segment]] = []
     run: List[ast.stmt] = []

@@ -45,8 +45,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["FusedPlan", "build_fused_plan", "apply_fused",
-           "is_fused_state", "unflatten_state", "FUSED_STATE_KEY",
-           "PASSTHROUGH_KEY"]
+           "is_fused_state", "unflatten_state", "flatten_state",
+           "FUSED_STATE_KEY", "PASSTHROUGH_KEY"]
 
 #: reserved keys marking the flat (fused) optimizer-state representation
 FUSED_STATE_KEY = "@fused"
@@ -246,6 +246,24 @@ def unflatten_state(plan: FusedPlan, state: Dict[str, Any]
                 per[name][k] = val
         out.update(per)
     return out
+
+
+def flatten_state(plan: FusedPlan, state: Dict[str, Any]
+                  ) -> Dict[str, Any]:
+    """Per-name slot dicts → fused form under ``plan`` (inverse of
+    :func:`unflatten_state`).  Trace-compatible: used by the anomaly
+    step-guard to express "state unchanged" in fused layout on the very
+    first step, whose input state is still per-name while the computed
+    output is already flat."""
+    fused: Dict[str, Dict[str, Any]] = {}
+    for i, b in enumerate(plan.buckets):
+        keys = set(b.slot_keys) | ({"master_weight"} if b.has_master
+                                   else set())
+        fused[f"b{i}"] = {k: _flatten([state[n][k] for n in b.names])
+                          for k in keys}
+    return {FUSED_STATE_KEY: fused,
+            PASSTHROUGH_KEY: {n: dict(state.get(n, {}))
+                              for n in plan.passthrough}}
 
 
 def apply_fused(opt, params: Dict[str, Any], grads: Dict[str, Any],

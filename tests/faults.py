@@ -1,0 +1,95 @@
+"""Fault-injection harness for checkpoint durability tests (ISSUE 2).
+
+Simulates the two ways a preemption can interrupt ``framework.io.save``:
+
+* :func:`crash_mid_write` — the process dies while the checkpoint's temp
+  file is being written: only the first ``at_bytes`` bytes ever reach the
+  file and ``os.replace`` never runs (a truncated ``.tmp-*`` straggler is
+  all that's left).
+* :func:`fail_replace` — the write completes but the atomic rename
+  itself fails/never happens (kill between fsync and rename, or an
+  ENOSPC/EIO at publish time).
+
+Both patch the narrow seams ``framework.io`` exposes for exactly this
+purpose (``_write_bytes`` / ``_replace``) rather than global ``os``
+state, so the rest of the test process keeps working.  ``corrupt_file``
+models post-crash bit-rot on an already-published checkpoint.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from paddle_tpu.framework import io as fio
+
+__all__ = ["SimulatedCrash", "crash_mid_write", "fail_replace",
+           "corrupt_file", "truncate_file"]
+
+
+class SimulatedCrash(BaseException):
+    """Stands in for the process dying mid-write.  Derives from
+    BaseException so production code's ``except Exception`` recovery
+    paths cannot accidentally swallow the injected kill."""
+
+
+@contextlib.contextmanager
+def crash_mid_write(monkeypatch, at_bytes: int = 64, crashes: int = 1):
+    """Kill the checkpoint writer after ``at_bytes`` bytes of the temp
+    file for the next ``crashes`` saves; later saves succeed.  Yields a
+    stats dict (``stats['crashed']`` = number of injected kills)."""
+    stats = {"crashed": 0}
+    real = fio._write_bytes
+
+    def patched(f, data):
+        if stats["crashed"] < crashes:
+            stats["crashed"] += 1
+            real(f, data[:at_bytes])
+            f.flush()
+            raise SimulatedCrash(
+                f"simulated kill after {at_bytes} bytes of "
+                f"{len(data)}-byte checkpoint write")
+        real(f, data)
+
+    monkeypatch.setattr(fio, "_write_bytes", patched)
+    try:
+        yield stats
+    finally:
+        monkeypatch.setattr(fio, "_write_bytes", real)
+
+
+@contextlib.contextmanager
+def fail_replace(monkeypatch, failures: int = 1):
+    """Make the atomic publish rename fail for the next ``failures``
+    saves (completed temp file, no visible checkpoint)."""
+    stats = {"failed": 0}
+    real = fio._replace
+
+    def patched(tmp, path):
+        if stats["failed"] < failures:
+            stats["failed"] += 1
+            raise SimulatedCrash(
+                f"simulated crash before rename {tmp!r} -> {path!r}")
+        real(tmp, path)
+
+    monkeypatch.setattr(fio, "_replace", patched)
+    try:
+        yield stats
+    finally:
+        monkeypatch.setattr(fio, "_replace", real)
+
+
+def corrupt_file(path: str, offset: int = 96, garbage: bytes = b"\xde\xad"
+                 ) -> None:
+    """Flip bytes inside an already-published file (bit-rot model)."""
+    size = os.path.getsize(path)
+    offset = min(offset, max(size - len(garbage), 0))
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        f.write(garbage)
+
+
+def truncate_file(path: str, keep_bytes: int) -> None:
+    """Cut a published file short (torn write / partial flush model)."""
+    with open(path, "r+b") as f:
+        f.truncate(keep_bytes)

@@ -149,3 +149,48 @@ def test_return_bearing_tensor_if_graph_breaks_correctly():
                                np.eye(3, dtype=np.float32) * 2.0)
     assert f._hybrid is not None
     assert f._hybrid.stats["compiled_calls"] >= 1
+
+
+_GLOBAL_COUNTER = 0
+
+
+def test_global_rebind_falls_back_whole_call_eager():
+    """A graph-breaking function containing ``global`` must run
+    WHOLE-CALL eager (ADVICE r5): segment execution execs against a copy
+    of fn.__globals__, so a ``global x`` rebind inside a segment would
+    silently never reach the real module global."""
+
+    @jit.to_static
+    def f(x):
+        global _GLOBAL_COUNTER
+        _GLOBAL_COUNTER = _GLOBAL_COUNTER + 1
+        y = x * 2.0
+        if float(y.sum()) > 0:    # dynamic break -> hybrid attempt
+            y = y + 1.0
+        return y
+
+    x = pt.to_tensor(np.ones((2,), np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = f(x)
+        out = f(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((2,), 3.0))
+    # whole-call eager: NOT segmented, and the rebind reached the real
+    # module global on every call (trace-time call may add one more)
+    assert f._hybrid is None
+    assert f._fell_back
+    assert _GLOBAL_COUNTER >= 2, _GLOBAL_COUNTER
+
+
+def test_build_hybrid_refuses_global():
+    from paddle_tpu.jit.graph_break import build_hybrid
+
+    def g(x):
+        global _GLOBAL_COUNTER
+        _GLOBAL_COUNTER = 0
+        try:
+            return x
+        except ValueError:
+            return None
+
+    assert build_hybrid(g) is None
