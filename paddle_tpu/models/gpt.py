@@ -77,6 +77,10 @@ class GPTConfig:
     moe_router: str = "topk"
     moe_dropless: bool = False  # sorted ragged_dot experts (no drops;
     # local banks only — mutually exclusive with dp-EP / mp expert TP)
+    # logits-free fused cross-entropy head (ops/fused_cross_entropy):
+    # the eager CausalLM loss and build_gpt_train_step's head_nll_fn
+    # stream vocab chunks instead of materializing [B, S, V] logits
+    fused_head: bool = True
 
 
     def moe_capacity(self) -> float:
@@ -116,6 +120,13 @@ def gpt_6p7b(**kw) -> GPTConfig:
 def gpt_13b(**kw) -> GPTConfig:
     return GPTConfig(hidden_size=5120, num_layers=40, num_heads=40,
                      max_position_embeddings=2048, **kw)
+
+
+def _pallas_epilogue_gate() -> bool:
+    """Same dispatch rule as attention: Pallas on TPU/axon, or when
+    interpret mode is forced (CPU kernel tests)."""
+    from ..nn.functional.attention import _should_use_pallas
+    return _should_use_pallas(None)
 
 
 class GPTBlock(Layer):
@@ -164,8 +175,17 @@ class GPTBlock(Layer):
         from ..ops import api as _api
         cfg = self.cfg
         b, s = x.shape[0], x.shape[1]
+        # Pallas epilogues (norms.py kernels) on the eager path: fused
+        # layer_norm for ln1 and bias+dropout+residual+layer_norm for the
+        # attention epilogue — gated exactly like attention dispatch
+        # (_should_use_pallas: TPU, or interpret forced for tests) and
+        # off under eager tensor parallelism (Row/ColumnParallelLinear
+        # own their collectives and bias placement).
+        fuse = (not cfg.use_mp) and _pallas_epilogue_gate()
         residual = x
-        y = self.ln1(x)
+        y = F.fused_layer_norm(x, self.ln1.weight, self.ln1.bias,
+                               epsilon=cfg.layer_norm_eps) if fuse \
+            else self.ln1(x)
         qkv = self.qkv(y)
         qkv = _api.reshape(qkv, [b, s, cfg.num_heads, 3 * cfg.head_dim])
         q, k, v = _api.split(qkv, 3, axis=-1)
@@ -173,9 +193,17 @@ class GPTBlock(Layer):
             q, k, v, is_causal=True, dropout_p=cfg.dropout,
             training=self.training)
         attn = _api.reshape(attn, [b, s, cfg.hidden_size])
-        x = residual + self.drop(self.proj(attn))
+        if fuse:
+            proj = _api.matmul(attn, self.proj.weight)
+            y, x = F.fused_bias_dropout_residual_layer_norm(
+                proj, residual, self.proj.bias, self.ln2.weight,
+                self.ln2.bias, dropout_rate=cfg.dropout,
+                epsilon=cfg.layer_norm_eps, training=self.training,
+                return_add_out=True)
+        else:
+            x = residual + self.drop(self.proj(attn))
+            y = self.ln2(x)
         residual = x
-        y = self.ln2(x)
         if cfg.moe_num_experts:
             y = self.moe(y)
         else:
@@ -230,6 +258,15 @@ class GPTForCausalLM(Layer):
     def forward(self, input_ids, labels=None):
         from ..ops import api as _api
         h = self.gpt(input_ids)
+        if labels is not None and self.cfg.fused_head \
+                and not self.cfg.use_mp:
+            # logits-free loss: the head matmul fuses into the chunked
+            # softmax-CE reduction — [B, S, V] never materializes
+            w = self.gpt.wte.weight if self.cfg.tie_word_embeddings \
+                else self.lm_head.weight
+            layout = "vh" if self.cfg.tie_word_embeddings else "hv"
+            return F.fused_linear_cross_entropy(h, w, labels,
+                                                w_layout=layout)
         if self.cfg.tie_word_embeddings:
             logits = _api.matmul(h, self.gpt.wte.weight, transpose_y=True)
         else:
@@ -444,7 +481,9 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                          sharding_stage: int = 2,
                          offload_optimizer: bool = False,
                          sequence_parallel: bool = False,
-                         tp_overlap: bool = False):
+                         tp_overlap: bool = False,
+                         fused_head: Optional[bool] = None,
+                         head_chunk: Optional[int] = None):
     """Compile a full hybrid-parallel GPT training step: dp×mp×pp×sharding×sep.
 
     Fully-MANUAL SPMD: one ``shard_map`` over ALL five mesh axes.  Tensor
@@ -462,6 +501,11 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
     collectives keep compile time flat in mesh size.
 
     ``cp_mode``: None (auto: "ring" when sep>1), "ring", or "ulysses".
+
+    ``fused_head`` (default: ``cfg.fused_head``, i.e. on): compute the
+    loss through the logits-free chunked linear+softmax-CE head
+    (``ops/fused_cross_entropy``) instead of materializing [b, s, V]
+    fp32 logits; ``head_chunk`` overrides the vocab chunk width.
 
     Returns (step_fn, init_fn):
       init_fn(seed) -> state pytree placed on the mesh
@@ -632,6 +676,8 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
                            ep_axis=DP_AXIS if cfg.moe_num_experts else None,
                            moe_aux_coef=_moe_coef(x, ctx))
 
+    use_fused_head = cfg.fused_head if fused_head is None else fused_head
+
     def head_nll_fn(params, x, labels):
         if sp:   # head/loss run on the full (replicated) sequence
             x = gather_op(x, MP_AXIS)
@@ -639,6 +685,18 @@ def build_gpt_train_step(cfg: GPTConfig, topo=None,
         var = jnp.var(x, -1, keepdims=True)
         x = (x - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps) \
             * params["lnf_w"] + params["lnf_b"]
+        if use_fused_head:
+            # logits-free fused head (ops/fused_cross_entropy): no
+            # [b, s, V] tensor, no mp_copy — its dx psum lives in the
+            # fused VJP.  mp==1 runs the dense tier (Pallas on TPU);
+            # mp>1 the vocab-parallel chunk loop with fused collectives.
+            if mp > 1:
+                return man.vocab_parallel_linear_nll(
+                    x, params["wte"], labels, w_layout="vh",
+                    chunk=head_chunk)
+            from ..ops.fused_cross_entropy import linear_cross_entropy
+            return linear_cross_entropy(x, params["wte"], labels,
+                                        w_layout="vh", chunk=head_chunk)
         xf = man.mp_copy(x, MP_AXIS)   # tied head: column-parallel matmul
         logits = jnp.einsum("bsh,vh->bsv", xf, params["wte"],
                             preferred_element_type=jnp.float32)

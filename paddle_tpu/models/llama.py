@@ -83,6 +83,9 @@ class LlamaConfig:
     # (sum over shared experts == one wide block-diagonal SwiGLU), added
     # to the routed output; rides the dense TP/SP machinery
     moe_num_shared_experts: int = 0
+    # logits-free fused cross-entropy head (ops/fused_cross_entropy) —
+    # see GPTConfig.fused_head
+    fused_head: bool = True
 
     def __post_init__(self):
         if self.moe_num_shared_experts and not self.moe_num_experts:
@@ -403,6 +406,15 @@ class LlamaForCausalLM(Layer):
     def forward(self, input_ids, labels=None):
         from ..ops import api as _api
         h = self.llama(input_ids)
+        if labels is not None and self.cfg.fused_head \
+                and not self.cfg.use_mp:
+            # logits-free loss (ops/fused_cross_entropy): head matmul
+            # fused into the chunked softmax-CE reduction
+            w = self.llama.embed_tokens.weight \
+                if self.cfg.tie_word_embeddings else self.lm_head.weight
+            layout = "vh" if self.cfg.tie_word_embeddings else "hv"
+            return F.fused_linear_cross_entropy(h, w, labels,
+                                                w_layout=layout)
         if self.cfg.tie_word_embeddings:
             logits = _api.matmul(h, self.llama.embed_tokens.weight,
                                  transpose_y=True)
@@ -599,7 +611,9 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
                            num_model_chunks: int = 1,
                            offload_optimizer: bool = False,
                            sequence_parallel: bool = False,
-                           tp_overlap: bool = False):
+                           tp_overlap: bool = False,
+                           fused_head: Optional[bool] = None,
+                           head_chunk: Optional[int] = None):
     """Compiled hybrid dp×mp×pp×sharding×sep Llama train step.
 
     Fully-manual SPMD via parallel/manual.py:build_hybrid_train_step
@@ -764,12 +778,24 @@ def build_llama_train_step(cfg: LlamaConfig, topo=None,
                            ep_axis=DP_AXIS if cfg.moe_num_experts else None,
                            moe_aux_coef=_moe_coef(x, lcos))
 
+    use_fused_head = cfg.fused_head if fused_head is None else fused_head
+
     def head_nll_fn(params, x, labels):
         if sp:
             x = gather_op(x, MP_AXIS)
         ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
         x = (x * jax.lax.rsqrt(ms + cfg.rms_norm_eps)).astype(x.dtype) \
             * params["lnf_w"]
+        if use_fused_head:
+            # logits-free fused head: untied Linear-layout ([h, V/mp])
+            # column-parallel shard streams through the chunk loop
+            if mp > 1:
+                return man.vocab_parallel_linear_nll(
+                    x, params["head"], labels, w_layout="hv",
+                    chunk=head_chunk)
+            from ..ops.fused_cross_entropy import linear_cross_entropy
+            return linear_cross_entropy(x, params["head"], labels,
+                                        w_layout="hv", chunk=head_chunk)
         xf = man.mp_copy(x, MP_AXIS)   # column-parallel head
         logits = jnp.einsum("bsh,hv->bsv", xf, params["head"],
                             preferred_element_type=jnp.float32)

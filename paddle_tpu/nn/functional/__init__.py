@@ -27,7 +27,8 @@ from .pooling import (  # noqa: F401
 )
 from .norm import (  # noqa: F401
     batch_norm, group_norm, instance_norm, layer_norm, local_response_norm,
-    normalize, rms_norm,
+    normalize, rms_norm, fused_layer_norm,
+    fused_bias_dropout_residual_layer_norm,
 )
 from .loss import (  # noqa: F401
     binary_cross_entropy, binary_cross_entropy_with_logits,
@@ -37,7 +38,7 @@ from .loss import (  # noqa: F401
     softmax_with_cross_entropy, square_error_cost, triplet_margin_loss,
     soft_margin_loss, multi_margin_loss, multi_label_soft_margin_loss,
     gaussian_nll_loss, poisson_nll_loss, triplet_margin_with_distance_loss,
-    rnnt_loss,
+    rnnt_loss, fused_linear_cross_entropy,
 )
 from .attention import (  # noqa: F401
     flash_attention, flash_attn_unpadded, scaled_dot_product_attention,

@@ -25,6 +25,27 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0):
     def impl(logits, lab, w):
+        # large-vocab 3-D hard-label case: chunked softmax-CE
+        # (ops/fused_cross_entropy) — never builds the fp32 log-prob
+        # copy or its softmax backward residual over the class dim
+        from ...ops import fused_cross_entropy as _fce
+        n_cls_ = logits.shape[axis]
+        if (use_softmax and not soft_label and w is None
+                and logits.ndim >= 3 and n_cls_ >= _fce.MIN_FUSED_VOCAB
+                and axis in (-1, logits.ndim - 1)
+                and not (lab.ndim == logits.ndim
+                         and lab.shape == logits.shape)):
+            lab_ = lab
+            if lab_.ndim == logits.ndim:
+                lab_ = jnp.squeeze(lab_, axis)
+            loss = _fce.softmax_nll_chunked(
+                logits, lab_, ignore_index=ignore_index,
+                label_smoothing=label_smoothing)
+            valid = lab_ != ignore_index
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+                return jnp.sum(loss) / denom
+            return _reduce(loss, reduction)
         lp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else \
             jnp.log(jnp.clip(logits, 1e-30, None))
         n_cls = logits.shape[axis]
@@ -65,12 +86,62 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
 def softmax_with_cross_entropy(logits, label, soft_label=False,
                                ignore_index=-100, numeric_stable_mode=True,
                                return_softmax=False, axis=-1):
-    out = cross_entropy(logits, label, soft_label=soft_label,
-                        ignore_index=ignore_index, reduction="none", axis=axis)
-    if return_softmax:
-        from ...ops import api as _api
-        return out, _api.softmax(logits, axis=axis)
-    return out
+    """softmax + CE as one op (reference c_softmax_with_cross_entropy).
+
+    With ``return_softmax=True`` the softmax is ``exp`` of the log-probs
+    the loss already computed — the class-dim reduction runs ONCE (the
+    old form recomputed a second full softmax from the logits)."""
+    def impl(lg, lab):
+        lp = jax.nn.log_softmax(lg, axis=axis)
+        if soft_label or (lab.ndim == lg.ndim and lab.shape == lg.shape):
+            loss = -jnp.sum(lab * lp, axis=axis, keepdims=True)
+        else:
+            lab_ = lab
+            squeeze = lab_.ndim == lg.ndim
+            if squeeze:
+                lab_ = jnp.squeeze(lab_, axis)
+            valid = lab_ != ignore_index
+            safe = jnp.where(valid, lab_, 0)
+            loss = -jnp.take_along_axis(
+                lp, jnp.expand_dims(safe, axis), axis=axis)
+            loss = jnp.where(jnp.expand_dims(valid, axis), loss, 0.0)
+            if not squeeze:
+                loss = jnp.squeeze(loss, axis)
+        if return_softmax:
+            return loss, jnp.exp(lp)     # reuse lp: vocab work done once
+        return loss
+
+    return run_op("softmax_with_cross_entropy", impl, (logits, label), {})
+
+
+def fused_linear_cross_entropy(input, weight, label, *, w_layout="vh",
+                               chunk=None, ignore_index=-100,
+                               reduction="mean", label_smoothing=0.0,
+                               backend=None):
+    """Logits-free fused LM-head loss: cross-entropy of
+    ``softmax(input @ head)`` computed by streaming vocab chunks
+    (ops/fused_cross_entropy.linear_cross_entropy) — the ``[..., V]``
+    logits tensor is never materialized, forward or backward.
+
+    ``input``: [..., H] activations; ``weight``: [V, H]
+    (``w_layout="vh"``, tied-embedding layout) or [H, V] (``"hv"``,
+    Linear layout); ``label``: [...] int.  Reduction semantics match
+    :func:`cross_entropy` ("mean" divides by the number of
+    non-``ignore_index`` tokens)."""
+    def impl(xv, wv, lab):
+        from ...ops.fused_cross_entropy import linear_cross_entropy
+        nll = linear_cross_entropy(
+            xv, wv, lab, w_layout=w_layout, chunk=chunk,
+            ignore_index=ignore_index, label_smoothing=label_smoothing,
+            backend=backend)
+        if reduction == "mean":
+            valid = lab != ignore_index
+            denom = jnp.maximum(jnp.sum(valid.astype(nll.dtype)), 1.0)
+            return jnp.sum(nll) / denom
+        return _reduce(nll, reduction)
+
+    return run_op("fused_linear_cross_entropy", impl,
+                  (input, weight, label), {})
 
 
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):

@@ -105,6 +105,36 @@ def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1):
     return run_op("rms_norm", impl, (x, weight, bias), {})
 
 
+def fused_layer_norm(x, weight, bias, epsilon=1e-5):
+    """Single-pass Pallas layer_norm (ops/pallas/norms.py): mean/var/
+    normalize/affine in one VMEM sweep with an analytic VJP.  Call sites
+    gate on the Pallas dispatch rule (models.gpt._pallas_epilogue_gate);
+    the jnp reference is :func:`layer_norm`."""
+    def impl(xv, w, b):
+        from ...ops import pallas as _pk
+        return _pk.layer_norm(xv, w, b, epsilon)
+
+    return run_op("fused_layer_norm_f", impl, (x, weight, bias), {})
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias, ln_weight, ln_bias, dropout_rate=0.0,
+        epsilon=1e-5, training=False, return_add_out=False):
+    """Pallas epilogue ``ln(residual + dropout(x + bias))`` in one kernel
+    (ops/pallas/norms.py): the transformer residual-add and the next
+    layer norm never round-trip HBM separately.  With
+    ``return_add_out=True`` also returns the pre-norm residual stream
+    (what the unfused path calls ``residual + drop(proj(...))``)."""
+    def impl(xv, res, b, w, lb):
+        from ...ops import pallas as _pk
+        out, add = _pk.fused_bias_dropout_residual_layer_norm(
+            xv, res, b, w, lb, dropout_rate, epsilon, training)
+        return (out, add) if return_add_out else out
+
+    return run_op("fused_bias_dropout_residual_ln_f", impl,
+                  (x, residual, bias, ln_weight, ln_bias), {})
+
+
 def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
                data_format="NCHW"):
     channel_last = not data_format.startswith("NC")

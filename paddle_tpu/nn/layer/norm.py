@@ -133,6 +133,12 @@ class RMSNorm(Layer):
             default_initializer=I.Constant(1.0))
 
     def forward(self, x):
+        from ..functional.attention import _should_use_pallas
+        if _should_use_pallas(x):
+            # single-VMEM-pass Pallas kernel with analytic VJP
+            # (ops/pallas/norms.py) — same gate as attention dispatch
+            from ...incubate.nn import functional as IF
+            return IF.fused_rms_norm(x, self.weight, None, self.epsilon)
         return F.rms_norm(x, self.weight, None, self.epsilon)
 
 

@@ -7,4 +7,7 @@ from .fused import (  # noqa: F401
 from .norms import (  # noqa: F401
     fused_bias_dropout_residual_layer_norm, layer_norm, rms_norm,
 )
+from .linear_ce import (  # noqa: F401
+    linear_cross_entropy_pallas, tune_linear_ce,
+)
 from .rope import fused_rope, rope_cos_sin  # noqa: F401

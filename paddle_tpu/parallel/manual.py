@@ -40,7 +40,7 @@ from .topology import (DP_AXIS, MP_AXIS, PP_AXIS, SEP_AXIS, SHARDING_AXIS,
                        HybridTopology)
 
 __all__ = ["mp_copy", "fwd_psum", "vocab_parallel_embedding",
-           "vocab_parallel_nll",
+           "vocab_parallel_nll", "vocab_parallel_linear_nll",
            "zero_adam_leaf_update", "local_shape", "moment_shape",
            "MOMENT_SPEC", "tree_map_with_spec"]
 
@@ -148,6 +148,28 @@ def vocab_parallel_nll(logits_local, labels, axis_name: str = MP_AXIS):
     lab = jnp.take_along_axis(z, li[..., None], axis=-1)[..., 0]
     lab = fwd_psum(jnp.where(mask, lab, jnp.zeros((), z.dtype)), axis_name)
     return lse - lab
+
+
+def vocab_parallel_linear_nll(x, w_local, labels, *, w_layout: str = "vh",
+                              chunk=None, axis_name: str = MP_AXIS,
+                              ignore_index=None, label_smoothing: float = 0.0):
+    """Logits-free fused head for mp-sharded vocab: per-token NLL of the
+    column-parallel ``x @ head`` computed by streaming vocab chunks —
+    replaces the ``mp_copy`` → full-logits einsum → :func:`vocab_parallel_nll`
+    pipeline.  The reference's two all-reduce passes (max, then sum-exp +
+    label pick) fuse into one pmax + one stacked psum inside the chunk
+    loop, and the backward's dx psum subsumes ``mp_copy``'s VJP.
+
+    ``w_local``: [V/mp, h] (``w_layout="vh"``, tied-embedding layout) or
+    [h, V/mp] (``"hv"``, Linear layout).  Must run inside the all-manual
+    ``shard_map`` (``axis_name`` collectives); grads are meant to be taken
+    INSIDE the shard_map (the ``fwd_psum`` convention).
+    """
+    from ..ops.fused_cross_entropy import linear_cross_entropy
+    return linear_cross_entropy(
+        x, w_local, labels, w_layout=w_layout, chunk=chunk,
+        ignore_index=ignore_index, label_smoothing=label_smoothing,
+        axis_name=axis_name, backend="xla")
 
 
 def zero_adam_leaf_update(p, g, m_flat, v_flat, tf, *, lr, b1=0.9, b2=0.95,
@@ -278,7 +300,13 @@ def build_hybrid_train_step(*, topo: HybridTopology, param_specs,
     * ``embed_fn(params_local, ids_local) -> x [b_l, s_l, h]``
     * ``block_fn(layer_params_local, x, ctx) -> x`` — one transformer block
       (tensor-parallel via mp_copy/fwd_psum, cp attention inside).
-    * ``head_nll_fn(params_local, x, labels_local) -> nll [b_l, s_l]``
+    * ``head_nll_fn(params_local, x, labels_local) -> nll [b_l, s_l]`` —
+      model builders pass the logits-free fused head here
+      (:func:`vocab_parallel_linear_nll` /
+      ``ops.fused_cross_entropy.linear_cross_entropy``); being a
+      ``custom_vjp`` closure it flows unchanged through every schedule
+      (gpipe scan, 1f1b/zbh1, interleave) and under remat, so no
+      pipeline path ever materializes ``[b, s, V]`` logits.
     * ``step_ctx_fn(s_l) -> ctx`` (optional) — per-step loop invariants
       (e.g. rope cos/sin tables) computed ONCE outside the layer scan and
       passed to every ``block_fn`` call; ``ctx`` is None when omitted.
