@@ -39,8 +39,8 @@ def _acquire_devices():
         try:
             from jax.extend.backend import clear_backends
             clear_backends()
-        except Exception:
-            pass
+        except (ImportError, AttributeError, RuntimeError):
+            pass    # clear_backends moved across jax versions; best-effort
 
     err = None
     for _ in range(2):

@@ -1,0 +1,10 @@
+"""tracelint — trace-safety static analysis for jit/shard_map/donation
+code (``python -m paddle_tpu.analysis``; rule catalogue in
+``docs/static_analysis.md``; committed debt ledger in TRACELINT.md).
+"""
+
+from .core import (Finding, Module, Rule, all_rules, collect_files,
+                   load_module, register, repo_root, run)
+
+__all__ = ["Finding", "Module", "Rule", "all_rules", "collect_files",
+           "load_module", "register", "repo_root", "run"]

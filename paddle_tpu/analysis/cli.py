@@ -1,0 +1,136 @@
+"""``python -m paddle_tpu.analysis`` — the tracelint CLI.
+
+Modes:
+
+* file/dir:  ``python -m paddle_tpu.analysis paddle_tpu/ bench.py``
+  (no paths: the repo's lint surface — paddle_tpu/, bench.py, tools/)
+* diff:      ``python -m paddle_tpu.analysis --diff HEAD~1`` — only
+  files changed versus the git ref
+* output:    human (default) or ``--json``
+  (``{"version": 1, "findings": [...], "counts": {...}}``)
+
+When a committed TRACELINT.md exists (override: ``--baseline PATH``,
+opt out: ``--no-baseline``) the exit code reports the RATCHET, not raw
+findings: 0 at-or-below baseline, 2 above.  Without a baseline, any
+finding exits 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+from . import baseline as baseline_mod
+from . import core
+
+DEFAULT_LINT_SURFACE = ("paddle_tpu", "bench.py", "tools")
+
+
+def default_paths() -> List[str]:
+    root = core.repo_root()
+    return [os.path.join(root, p) for p in DEFAULT_LINT_SURFACE
+            if os.path.exists(os.path.join(root, p))]
+
+
+def _diff_paths(ref: str) -> List[str]:
+    root = core.repo_root()
+    proc = subprocess.run(
+        ["git", "-C", root, "diff", "--name-only", ref, "--", "*.py"],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise SystemExit(f"tracelint: git diff {ref} failed: "
+                         f"{proc.stderr.strip()}")
+    out = []
+    for rel in proc.stdout.splitlines():
+        p = os.path.join(root, rel.strip())
+        if os.path.exists(p):
+            out.append(p)
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="tracelint: trace-safety static analysis for "
+                    "jit/shard_map/donation code")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the repo lint "
+                         "surface: paddle_tpu/, bench.py, tools/)")
+    ap.add_argument("--diff", metavar="REF",
+                    help="analyze only .py files changed vs the git ref")
+    ap.add_argument("--select", metavar="IDS",
+                    help="comma-separated rule ids (e.g. TL001,TL006)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", metavar="PATH",
+                    help="baseline file (default: repo TRACELINT.md "
+                         "when it exists)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline; exit 1 on any finding")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in core.all_rules():
+            print(f"{rule.id} {rule.name} [{rule.severity}] — {rule.doc}")
+        return 0
+
+    if args.diff:
+        paths = _diff_paths(args.diff)
+    elif args.paths:
+        paths = args.paths
+    else:
+        paths = default_paths()
+
+    select = None
+    if args.select:
+        select = {t.strip() for t in args.select.split(",") if t.strip()}
+
+    findings = core.run(paths, select=select)
+
+    regressions: Optional[List[str]] = None
+    base_path = args.baseline or (
+        baseline_mod.default_path()
+        if os.path.exists(baseline_mod.default_path()) else None)
+    if base_path and not args.no_baseline:
+        base = baseline_mod.load(base_path)
+        if select:
+            base = {k: v for k, v in base.items() if k[0] in select}
+        regressions = baseline_mod.compare(
+            baseline_mod.counts(findings), base)
+
+    if args.as_json:
+        payload = {
+            "version": 1,
+            "findings": [f.to_json() for f in findings],
+            "counts": {rule: sum(1 for f in findings if f.rule == rule)
+                       for rule in sorted({f.rule for f in findings})},
+            "baseline": base_path if regressions is not None else None,
+            "above_baseline": regressions or [],
+        }
+        print(json.dumps(payload, indent=1))
+    else:
+        for f in findings:
+            print(f.format())
+        n = len(findings)
+        if regressions is None:
+            print(f"tracelint: {n} finding{'s' if n != 1 else ''}")
+        else:
+            print(f"tracelint: {n} finding{'s' if n != 1 else ''}, "
+                  f"{len(regressions)} above baseline "
+                  f"({os.path.relpath(base_path, core.repo_root())})")
+            for r in regressions:
+                print(f"  ABOVE BASELINE: {r}")
+
+    if regressions is not None:
+        return 2 if regressions else 0
+    return 1 if findings else 0

@@ -1,0 +1,329 @@
+"""tracelint engine: modules, findings, rules, suppressions, reachability.
+
+The analyzer is a single-parse AST walker: every ``.py`` file under the
+requested paths is parsed exactly once into a :class:`Module`, then every
+registered :class:`Rule` runs over the shared module list.  Rules that
+need cross-file context (TL005 collects the project's axis-name
+vocabulary) get a ``prepare(modules)`` phase before per-module checks.
+
+Trace-reachability — the analysis TL001/TL002 hang off — is computed
+here, once per module: a function is *traced* if it is decorated with a
+trace wrapper (``jit`` / ``to_static`` / ``partial(jax.jit, ...)`` /
+``custom_vjp``), passed callable-first to one (``jax.jit(f)``,
+``shard_map(f, ...)``, ``lax.scan(body, ...)``), or transitively called
+by a traced function through a module-local name.  Anything XLA cannot
+see — host syncs, side effects — inside that set is a latent hazard the
+runtime only pays for later (recompiles, silent staleness, donation
+corruption), which is exactly why it is checked at review time.
+
+Suppressions use one syntax everywhere (including the NOTIMPL backend):
+
+* ``# tracelint: disable=TL001,TL004`` on the finding's line
+* ``# tracelint: disable`` on the line — every rule
+* ``# tracelint: disable-file=TL006`` anywhere — whole file
+
+A suppression should carry a justification in the same comment or the
+line above; ``docs/static_analysis.md`` documents the convention.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding", "Module", "Rule", "register", "all_rules", "load_module",
+    "collect_files", "run", "SEVERITIES", "repo_root",
+]
+
+SEVERITIES = ("error", "warning", "info")
+
+# names whose call traces the callable handed to them (or decorates one)
+TRACE_WRAPPERS = {
+    "jit", "to_static", "jit_compile", "shard_map", "scan", "vmap",
+    "pmap", "grad", "value_and_grad", "vjp", "jvp", "custom_vjp",
+    "custom_jvp", "checkpoint", "remat", "cond", "while_loop",
+    "fori_loop", "switch", "associative_scan", "build_hybrid",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tracelint:\s*disable(?:-file)?\s*(?:=\s*([A-Z0-9, ]+))?")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*tracelint:\s*disable-file\s*=\s*([A-Z0-9, ]+)")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str                  # "TL001"
+    severity: str              # error | warning | info
+    path: str                  # repo-relative, "/"-separated
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+
+    @property
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        s = f"{self.path}:{self.line}:{self.col} {self.rule} " \
+            f"[{self.severity}] {self.message}"
+        if self.hint:
+            s += f" → {self.hint}"
+        return s
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for an Attribute/Name chain, '' for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def tail_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+class Module:
+    """One parsed source file plus the derived facts rules share."""
+
+    def __init__(self, path: str, rel: str, source: str, tree: ast.Module):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.imports = self._collect_imports()
+        self.functions = self._collect_functions()
+        self.traced = self._trace_reachable()
+        self._line_disables, self._file_disables = self._collect_suppressions()
+
+    # -- imports --------------------------------------------------------
+    def _collect_imports(self) -> Dict[str, str]:
+        """local alias -> full dotted module path (``np`` -> ``numpy``,
+        ``random`` -> ``jax.random`` after ``from jax import random``)."""
+        out: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and not node.level:
+                for a in node.names:
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+        return out
+
+    def resolve(self, node: ast.AST) -> str:
+        """Fully-qualified dotted path of a Name/Attribute chain, with the
+        root import alias expanded (``np.random.rand`` -> ``numpy.random.rand``)."""
+        dotted = dotted_name(node)
+        if not dotted:
+            return ""
+        root, _, rest = dotted.partition(".")
+        full = self.imports.get(root, root)
+        return f"{full}.{rest}" if rest else full
+
+    # -- functions ------------------------------------------------------
+    def _collect_functions(self):
+        """Every (Async)FunctionDef keyed by bare name (last def wins),
+        including nested defs — calls are resolved by bare name."""
+        funcs: Dict[str, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs[node.name] = node
+        return funcs
+
+    # -- trace reachability --------------------------------------------
+    def _is_trace_decorator(self, dec: ast.AST) -> bool:
+        if isinstance(dec, ast.Call):
+            fn = dec.func
+            if tail_name(fn) == "partial" and dec.args:
+                return tail_name(dec.args[0]) in TRACE_WRAPPERS
+            return tail_name(fn) in TRACE_WRAPPERS
+        return tail_name(dec) in TRACE_WRAPPERS
+
+    def _trace_reachable(self) -> Set[ast.AST]:
+        entries: Set[str] = set()
+        for name, fn in self.functions.items():
+            if any(self._is_trace_decorator(d) for d in fn.decorator_list):
+                entries.add(name)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) \
+                    and tail_name(node.func) in TRACE_WRAPPERS:
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name):
+                        entries.add(arg.id)
+                    elif isinstance(arg, ast.Call) \
+                            and tail_name(arg.func) == "partial" and arg.args \
+                            and isinstance(arg.args[0], ast.Name):
+                        entries.add(arg.args[0].id)
+        reach: Set[str] = set(entries)
+        frontier = list(entries)
+        while frontier:
+            fn = self.functions.get(frontier.pop())
+            if fn is None:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                    if callee in self.functions and callee not in reach:
+                        reach.add(callee)
+                        frontier.append(callee)
+        return {self.functions[n] for n in reach if n in self.functions}
+
+    def traced_functions(self):
+        """Traced function nodes, sorted by line for stable output."""
+        return sorted(self.traced, key=lambda f: f.lineno)
+
+    # -- suppressions ---------------------------------------------------
+    def _collect_suppressions(self):
+        line_dis: Dict[int, Optional[Set[str]]] = {}
+        file_dis: Set[str] = set()
+        for i, text in enumerate(self.lines, start=1):
+            if "tracelint" not in text:
+                continue
+            mf = _SUPPRESS_FILE_RE.search(text)
+            if mf:
+                file_dis.update(
+                    t.strip() for t in mf.group(1).split(",") if t.strip())
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                ids = m.group(1)
+                if ids:
+                    line_dis[i] = {t.strip() for t in ids.split(",")
+                                   if t.strip()}
+                else:
+                    line_dis[i] = None       # all rules on this line
+        return line_dis, file_dis
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_disables:
+            return True
+        if line in self._line_disables:
+            ids = self._line_disables[line]
+            return ids is None or rule in ids
+        return False
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``severity``/``doc``/``hint``
+    and implement ``check(module)``.  ``prepare(modules)`` runs once
+    before any check for rules needing cross-file context."""
+
+    id = "TL000"
+    name = "unnamed"
+    severity = "warning"
+    doc = ""
+    hint = ""
+
+    def prepare(self, modules: Sequence[Module]) -> None:
+        pass
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: Module, node: ast.AST, message: str,
+                hint: Optional[str] = None,
+                severity: Optional[str] = None) -> Finding:
+        return Finding(rule=self.id, severity=severity or self.severity,
+                       path=module.rel, line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0),
+                       message=message,
+                       hint=self.hint if hint is None else hint)
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding a rule to the global registry."""
+    _REGISTRY[cls.id] = cls()
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    # import side effect: rule modules self-register
+    from . import rules as _rules            # noqa: F401
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+# -- file collection / engine ------------------------------------------
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith(".py"))
+        elif p.endswith(".py") and os.path.exists(p):
+            files.append(p)
+    seen: Set[str] = set()
+    out = []
+    for f in files:
+        a = os.path.abspath(f)
+        if a not in seen:
+            seen.add(a)
+            out.append(f)
+    return out
+
+
+def load_module(path: str, root: Optional[str] = None) -> Optional[Module]:
+    root = root or repo_root()
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source)
+    except (OSError, SyntaxError, UnicodeDecodeError, ValueError):
+        return None
+    ap = os.path.abspath(path)
+    rel = os.path.relpath(ap, root)
+    if rel.startswith(".."):
+        rel = ap
+    return Module(ap, rel, source, tree)
+
+
+def run(paths: Sequence[str], select: Optional[Set[str]] = None,
+        root: Optional[str] = None) -> List[Finding]:
+    """Analyze ``paths`` (files/dirs) with the selected rules; returns
+    suppression-filtered findings sorted by (path, line, col, rule)."""
+    modules = [m for m in (load_module(f, root)
+                           for f in collect_files(paths)) if m]
+    rules = [r for r in all_rules() if not select or r.id in select]
+    for rule in rules:
+        rule.prepare(modules)
+    findings: List[Finding] = []
+    for mod in modules:
+        for rule in rules:
+            for f in rule.check(mod):
+                if not mod.suppressed(f.rule, f.line):
+                    findings.append(f)
+    findings.sort(key=lambda f: f.sort_key)
+    return findings

@@ -1,0 +1,15 @@
+"""tracelint rule set — importing this package registers every rule.
+
+Add a rule by dropping a module here that defines a ``core.Rule``
+subclass decorated with ``@core.register`` and importing it below
+(``docs/static_analysis.md`` walks through the steps).
+"""
+
+from . import tl001_host_sync      # noqa: F401
+from . import tl002_purity         # noqa: F401
+from . import tl003_recompile      # noqa: F401
+from . import tl004_donation       # noqa: F401
+from . import tl005_collectives    # noqa: F401
+from . import tl006_excepts        # noqa: F401
+from . import tl007_pytree         # noqa: F401
+from . import tl008_notimpl        # noqa: F401

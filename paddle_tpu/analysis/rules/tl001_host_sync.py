@@ -1,0 +1,69 @@
+"""TL001 — host synchronization inside traced code.
+
+``.item()`` / ``.tolist()`` / ``.numpy()`` / ``jax.device_get`` /
+``block_until_ready`` on a tracer aborts tracing (ConcretizationError)
+or, worse, silently bakes a stale value into the compiled program when
+it happens on a closed-over concrete array.  ``bool()/int()/float()``
+and ``np.asarray`` on a traced value are flagged only when the receiver
+is a formal parameter of the traced function — the conservative subset
+we can resolve without type inference.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import core
+
+_SYNC_METHODS = {"item", "tolist", "numpy"}
+_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+_CASTS = {"bool", "int", "float"}
+_NP_TO_HOST = {"numpy.asarray", "numpy.array", "numpy.copy"}
+
+
+@core.register
+class HostSyncRule(core.Rule):
+    id = "TL001"
+    name = "host-sync-in-trace"
+    severity = "error"
+    doc = ("host synchronization (.item()/.tolist()/.numpy()/"
+           "jax.device_get/bool()/int()/float()/np.asarray on a traced "
+           "value) inside a function reachable from "
+           "jit/to_static/scan/shard_map")
+    hint = ("keep the value on device (jnp ops / lax.cond), or move the "
+            "read outside the traced function")
+
+    def check(self, module):
+        for fn in module.traced_functions():
+            params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                      + fn.args.kwonlyargs)}
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) and not node.args \
+                        and f.attr in _SYNC_METHODS:
+                    yield self.finding(
+                        module, node,
+                        f"`.{f.attr}()` in traced `{fn.name}` forces a "
+                        f"device→host sync under tracing")
+                    continue
+                resolved = module.resolve(f)
+                if resolved in _SYNC_CALLS:
+                    yield self.finding(
+                        module, node,
+                        f"`{resolved}` in traced `{fn.name}` blocks on "
+                        f"device values under tracing")
+                    continue
+                arg0 = node.args[0] if node.args else None
+                on_param = isinstance(arg0, ast.Name) and arg0.id in params
+                if isinstance(f, ast.Name) and f.id in _CASTS and on_param:
+                    yield self.finding(
+                        module, node,
+                        f"`{f.id}({arg0.id})` on a parameter of traced "
+                        f"`{fn.name}` concretizes the tracer")
+                elif resolved in _NP_TO_HOST and on_param:
+                    yield self.finding(
+                        module, node,
+                        f"`{resolved}({arg0.id})` on a parameter of "
+                        f"traced `{fn.name}` pulls the value to host")

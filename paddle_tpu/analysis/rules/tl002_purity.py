@@ -1,0 +1,75 @@
+"""TL002 — impure traced functions.
+
+A traced function runs ONCE, at trace time; ``print`` fires once and
+never again, ``time.time()``/stdlib ``random``/``np.random`` freeze a
+single value into the compiled program, and ``global``/``nonlocal``
+writes mutate Python state the compiled executable will never see (the
+exact hazards ``jit/graph_break.py`` pays exec-based eager interludes
+for at runtime — see ADVICE r5 and the PR 2 Global/Nonlocal fallback).
+``jax.random`` is functional and explicitly allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import core
+
+_CLOCKS = {"time.time", "time.perf_counter", "time.monotonic",
+           "time.process_time", "time.time_ns", "time.perf_counter_ns"}
+
+
+@core.register
+class PurityRule(core.Rule):
+    id = "TL002"
+    name = "impure-trace"
+    severity = "error"
+    doc = ("side effects inside traced code: print, wall-clock reads, "
+           "stdlib/np RNG, global/nonlocal writes — executed once at "
+           "trace time, then baked in or silently dropped")
+    hint = ("use jax.debug.print / jax.random with an explicit key / "
+            "thread state through arguments instead")
+
+    def check(self, module):
+        for fn in module.traced_functions():
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    kw = "global" if isinstance(node, ast.Global) else \
+                        "nonlocal"
+                    yield self.finding(
+                        module, node,
+                        f"`{kw} {', '.join(node.names)}` in traced "
+                        f"`{fn.name}` — rebinding is invisible to the "
+                        f"compiled program",
+                        hint="return the new value instead of rebinding")
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id == "print":
+                    yield self.finding(
+                        module, node,
+                        f"`print` in traced `{fn.name}` fires once at "
+                        f"trace time, never per step",
+                        hint="use jax.debug.print (or io_callback)")
+                    continue
+                resolved = module.resolve(node.func)
+                if resolved in _CLOCKS:
+                    yield self.finding(
+                        module, node,
+                        f"`{resolved}()` in traced `{fn.name}` freezes "
+                        f"one timestamp into the compiled program",
+                        hint="time outside the traced function")
+                elif resolved.startswith("random.") \
+                        and module.imports.get("random", "") == "random":
+                    yield self.finding(
+                        module, node,
+                        f"stdlib `{resolved}` in traced `{fn.name}` "
+                        f"draws once at trace time",
+                        hint="use jax.random with a threaded key")
+                elif resolved.startswith("numpy.random."):
+                    yield self.finding(
+                        module, node,
+                        f"`{resolved}` in traced `{fn.name}` draws once "
+                        f"at trace time",
+                        hint="use jax.random with a threaded key")

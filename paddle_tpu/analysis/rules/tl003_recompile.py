@@ -1,0 +1,111 @@
+"""TL003 — recompile / unbounded-cache hazards.
+
+Generalizes the ADVICE r5 ``_jit_cache`` finding (unbounded dict keyed
+on static live-in VALUES → a varying Python scalar recompiles every
+call and grows the cache forever; fixed in PR 1 with utils.lru).
+Flags:
+
+* ``jit(f)(...)`` — a fresh jit wrapper built and immediately invoked
+  inside a function body: a new cache entry (and trace) per call.
+* module-level ``*cache*`` dicts that store jit/lower/compile results
+  by subscript with no eviction anywhere in the module.
+* ``functools.lru_cache(maxsize=None)`` — unbounded by declaration.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import core
+
+_JIT_NAMES = {"jit", "jit_compile"}
+_CACHED_BUILD_MARKERS = ("jit", "lower", "compile")
+
+
+@core.register
+class RecompileRule(core.Rule):
+    id = "TL003"
+    name = "recompile-hazard"
+    severity = "warning"
+    doc = ("patterns that defeat jit caching: per-call jit(f)(...) "
+           "invocation, unbounded value-keyed caches of compiled "
+           "callables, lru_cache(maxsize=None)")
+    hint = ("hoist the jit wrapper out of the hot path, or bound the "
+            "cache (utils.lru.LRUCache) and key it on shapes/dtypes, "
+            "not scalar values")
+
+    def _module_level_cache_dicts(self, module):
+        names = set()
+        for node in module.tree.body:
+            tgt = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                tgt, val = node.target, node.value
+            else:
+                continue
+            if isinstance(tgt, ast.Name) and "cache" in tgt.id.lower() \
+                    and isinstance(val, ast.Dict) and not val.keys:
+                names.add(tgt.id)
+        return names
+
+    def check(self, module):
+        caches = self._module_level_cache_dicts(module)
+        evicted = set()
+        if caches:
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("pop", "popitem") \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id in caches:
+                    evicted.add(node.func.value.id)
+
+        in_function = set()
+        for fn in module.functions.values():
+            for node in ast.walk(fn):
+                in_function.add(id(node))
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # jit(f)(...) immediately invoked inside a function body
+            if isinstance(node.func, ast.Call) \
+                    and core.tail_name(node.func.func) in _JIT_NAMES \
+                    and id(node) in in_function:
+                yield self.finding(
+                    module, node,
+                    "`jit(...)(...)` builds a fresh jit wrapper per "
+                    "call — every invocation re-traces",
+                    hint="build the jitted callable once (module level "
+                         "or cached) and reuse it")
+                continue
+            # lru_cache(maxsize=None)
+            if core.tail_name(node.func) == "lru_cache":
+                for kw in node.keywords:
+                    if kw.arg == "maxsize" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and kw.value.value is None:
+                        yield self.finding(
+                            module, node,
+                            "`lru_cache(maxsize=None)` — unbounded "
+                            "cache; long-running training leaks host "
+                            "memory",
+                            hint="set a finite maxsize")
+            # cache[key] = <jit/lower/compile result>
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Subscript) \
+                    and isinstance(node.targets[0].value, ast.Name) \
+                    and node.targets[0].value.id in caches - evicted \
+                    and isinstance(node.value, ast.Call):
+                callee = core.tail_name(node.value.func).lower()
+                if any(m in callee for m in _CACHED_BUILD_MARKERS):
+                    yield self.finding(
+                        module, node,
+                        f"unbounded module-level cache "
+                        f"`{node.targets[0].value.id}` stores compiled "
+                        f"callables with no eviction — value-varying "
+                        f"keys grow it every call (ADVICE r5 _jit_cache)",
+                        hint="bound it with utils.lru.LRUCache or key "
+                             "strictly on shapes/dtypes")

@@ -1,0 +1,232 @@
+"""TL004 — use-after-donate.
+
+``donate_argnums`` lets XLA alias an input buffer into an output: after
+the jitted call the donated array is DELETED (or, with the PR 2
+compilation-cache bug, silently corrupted) — reading it again is the
+donation bug class that manifests as flaky corruption, not a clean
+error.  The rule resolves, lexically per scope:
+
+* donating callables — ``g = jax.jit(f, donate_argnums=(0, 2))`` /
+  ``jit(f, donate_argnames=...)`` assignments, and defs decorated with
+  ``partial(jax.jit, donate_argnums=...)`` —
+* their call sites, marking the argument names passed at donated
+  positions dead,
+* any later load of a dead name before it is rebound.  Loop bodies are
+  scanned twice so a donation in iteration N caught by a load at the
+  top of iteration N+1 (the canonical un-rebound training loop) is
+  reported.
+
+Dotted receivers (``self._opt_state``) participate like plain names.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import core
+
+
+def _donate_spec(call: ast.Call) -> Optional[Tuple[Set[int], Set[str]]]:
+    """(positions, argnames) from a jit-like call, or None if it does
+    not donate."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    nums.add(e.value)
+        elif kw.arg == "donate_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.add(e.value)
+    return (nums, names) if (nums or names) else None
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) \
+        and core.tail_name(node.func) in ("jit", "jit_compile")
+
+
+class _ScopeScanner:
+    """Linear dead-name scan of one function (or module) body."""
+
+    def __init__(self, rule, module, donators: Dict[str, Tuple[Set[int],
+                                                               Set[str]]],
+                 local_funcs):
+        self.rule = rule
+        self.module = module
+        self.donators = dict(donators)
+        self.local_funcs = local_funcs
+        self.dead: Dict[str, int] = {}        # name -> donation line
+        self.findings: List[core.Finding] = []
+        self._reported: Set[Tuple[int, str]] = set()
+
+    # -- helpers --------------------------------------------------------
+    def _param_names(self, fname: str) -> List[str]:
+        fn = self.local_funcs.get(fname)
+        if fn is None:
+            return []
+        return [a.arg for a in fn.args.posonlyargs + fn.args.args]
+
+    def _donated_args(self, call: ast.Call, spec) -> List[ast.AST]:
+        nums, argnames = spec
+        out = []
+        for i, a in enumerate(call.args):
+            if i in nums:
+                out.append(a)
+        if argnames:
+            callee = core.tail_name(call.func)
+            positional = self._param_names(callee)
+            for i, a in enumerate(call.args):
+                if i < len(positional) and positional[i] in argnames:
+                    out.append(a)
+            for kw in call.keywords:
+                if kw.arg in argnames:
+                    out.append(kw.value)
+        return out
+
+    def _flag(self, name: str, node: ast.AST):
+        key = (getattr(node, "lineno", 0), name)
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        self.findings.append(self.rule.finding(
+            self.module, node,
+            f"`{name}` is read after being donated on line "
+            f"{self.dead[name]} — the buffer no longer holds the value",
+            hint="rebind the name to the call's result (or drop "
+                 "donation for buffers you must keep)"))
+
+    # -- event emission -------------------------------------------------
+    def _expr_events(self, node: ast.AST):
+        """Process loads and donations inside an expression."""
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(sub, "ctx", None), ast.Load):
+                name = core.dotted_name(sub)
+                if name in self.dead:
+                    # attribute loads of a dead dotted name, and plain
+                    # names, both count; skip sub-chains of longer names
+                    self._flag(name, sub)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                callee = core.dotted_name(sub.func)
+                spec = self.donators.get(callee)
+                if spec is None and _is_jit_call(sub):
+                    continue      # building the wrapper donates nothing
+                if spec is not None:
+                    for a in self._donated_args(sub, spec):
+                        nm = core.dotted_name(a)
+                        if nm:
+                            self.dead[nm] = getattr(sub, "lineno", 0)
+
+    def _store(self, target: ast.AST):
+        for sub in ast.walk(target):
+            if isinstance(sub, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(sub, "ctx", None), ast.Store):
+                self.dead.pop(core.dotted_name(sub), None)
+
+    # -- statement walk -------------------------------------------------
+    def run(self, body: List[ast.stmt]):
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, ast.Assign):
+            # donating-callable binding? g = jax.jit(f, donate_argnums=..)
+            if _is_jit_call(stmt.value):
+                spec = _donate_spec(stmt.value)
+                if spec and len(stmt.targets) == 1:
+                    nm = core.dotted_name(stmt.targets[0])
+                    if nm:
+                        self.donators[nm] = spec
+            self._expr_events(stmt.value)
+            for t in stmt.targets:
+                self._store(t)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None:
+                self._expr_events(stmt.value)
+            self._store(stmt.target)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr_events(stmt.iter)
+            self._store(stmt.target)
+            # two passes over the body: the second catches iteration-N+1
+            # loads of names donated (and never rebound) in iteration N
+            self.run(stmt.body)
+            self.run(stmt.body)
+            self.run(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self._expr_events(stmt.test)
+            self.run(stmt.body)
+            self.run(stmt.body)
+            self.run(stmt.orelse)
+        elif isinstance(stmt, ast.If):
+            self._expr_events(stmt.test)
+            self.run(stmt.body)
+            self.run(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr_events(item.context_expr)
+                if item.optional_vars is not None:
+                    self._store(item.optional_vars)
+            self.run(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self.run(stmt.body)
+            for h in stmt.handlers:
+                self.run(h.body)
+            self.run(stmt.orelse)
+            self.run(stmt.finalbody)
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self._expr_events(stmt.value)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            pass     # nested scopes are scanned separately
+        else:
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.expr):
+                    self._expr_events(sub)
+
+
+@core.register
+class DonationRule(core.Rule):
+    id = "TL004"
+    name = "use-after-donate"
+    severity = "error"
+    doc = ("a name passed at a donate_argnums/donate_argnames position "
+           "of a jitted call is read again before being rebound")
+    hint = ("rebind the name to the call's result (or drop donation "
+            "for buffers you must keep)")
+
+    def _decorated_donators(self, module):
+        out: Dict[str, Tuple[Set[int], Set[str]]] = {}
+        for name, fn in module.functions.items():
+            for dec in fn.decorator_list:
+                if isinstance(dec, ast.Call):
+                    target = dec
+                    if core.tail_name(dec.func) == "partial" and dec.args \
+                            and core.tail_name(dec.args[0]) in ("jit",
+                                                                "jit_compile"):
+                        target = dec
+                    elif core.tail_name(dec.func) not in ("jit",
+                                                          "jit_compile"):
+                        continue
+                    spec = _donate_spec(target)
+                    if spec:
+                        out[name] = spec
+        return out
+
+    def check(self, module):
+        decorated = self._decorated_donators(module)
+        scopes = [module.tree] + list(module.functions.values())
+        for scope in scopes:
+            body = scope.body if hasattr(scope, "body") else []
+            sc = _ScopeScanner(self, module, decorated, module.functions)
+            sc.run(body)
+            yield from sc.findings

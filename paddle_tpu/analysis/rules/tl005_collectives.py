@@ -1,0 +1,93 @@
+"""TL005 — collective axis-name consistency.
+
+``lax.psum(x, "pm")`` inside a shard_map whose mesh has axes
+``("dp", "mp")`` fails at trace time at best and, with partial-manual
+meshes, silently reduces over the wrong group at worst.  The project
+convention (``parallel/topology.py``: DP_AXIS/MP_AXIS/PP_AXIS/
+SEP_AXIS/SHARDING_AXIS, threaded through ``parallel/manual.py``) is to
+never hard-code an axis string at a collective call site.
+
+``prepare`` builds the project-wide axis vocabulary from every scanned
+file: ``*_AXIS = "..."`` module constants plus ``axis_names=(...)``
+mesh arguments.  A collective called with a string LITERAL not in that
+vocabulary is flagged as drift/typo; known literals pass (they can be
+deliberate single-file conventions).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import core
+
+_COLLECTIVES = {"psum", "pmax", "pmin", "pmean", "ppermute", "pshuffle",
+                "all_gather", "all_to_all", "psum_scatter", "axis_index",
+                "axis_size"}
+
+
+@core.register
+class CollectiveAxisRule(core.Rule):
+    id = "TL005"
+    name = "collective-axis-drift"
+    severity = "warning"
+    doc = ("a lax collective is called with a string-literal axis name "
+           "that matches no *_AXIS constant or mesh axis_names entry "
+           "anywhere in the scanned tree")
+    hint = ("use the topology constants (parallel/topology.py MP_AXIS "
+            "et al.) — or add the new axis to the mesh that names it")
+
+    def __init__(self):
+        self.vocab = set()
+
+    def prepare(self, modules):
+        self.vocab = set()
+        for mod in modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id.endswith("_AXIS") \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    self.vocab.add(node.value.value)
+                elif isinstance(node, ast.Call):
+                    for kw in node.keywords:
+                        if kw.arg == "axis_names" and isinstance(
+                                kw.value, (ast.Tuple, ast.List)):
+                            for e in kw.value.elts:
+                                if isinstance(e, ast.Constant) \
+                                        and isinstance(e.value, str):
+                                    self.vocab.add(e.value)
+
+    def _axis_literals(self, call: ast.Call):
+        cands = []
+        if len(call.args) >= 2:
+            cands.append(call.args[1])
+        elif call.args and core.tail_name(call.func) in ("axis_index",
+                                                         "axis_size"):
+            cands.append(call.args[0])
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                cands.append(kw.value)
+        out = []
+        for c in cands:
+            elts = c.elts if isinstance(c, (ast.Tuple, ast.List)) else [c]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.append((e, e.value))
+        return out
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if core.tail_name(node.func) not in _COLLECTIVES:
+                continue
+            for expr, value in self._axis_literals(node):
+                if value not in self.vocab:
+                    yield self.finding(
+                        module, expr,
+                        f"collective `{core.tail_name(node.func)}` uses "
+                        f"axis name {value!r} which matches no *_AXIS "
+                        f"constant or mesh axis_names in the scanned "
+                        f"tree")

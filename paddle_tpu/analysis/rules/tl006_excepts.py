@@ -1,0 +1,62 @@
+"""TL006 — silent broad exception swallows.
+
+``except Exception: pass`` hides real failures (a full disk in a
+checkpoint writer, a poisoned shared-memory segment in the loader) as
+non-events.  The triage contract for core subsystems (checkpoint/, io/,
+optimizer/, parallel/): narrow the clause to the exception the code
+actually expects, or log-and-continue with an explicit comment; only a
+finalizer racing interpreter shutdown (``__del__``) earns an inline
+``# tracelint: disable=TL006`` with its justification.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import core
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if core.tail_name(t) in _BROAD:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(core.tail_name(e) in _BROAD for e in t.elts)
+    return False
+
+
+def _is_silent(body) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue                      # docstring / ellipsis
+        return False
+    return True
+
+
+@core.register
+class SilentExceptRule(core.Rule):
+    id = "TL006"
+    name = "silent-broad-except"
+    severity = "warning"
+    doc = ("`except Exception:`/`except:`/`except BaseException:` whose "
+           "body is only `pass` — the failure disappears without a trace")
+    hint = ("narrow to the intended exception type, or log-and-continue "
+            "with an explicit comment; suppress (with justification) "
+            "only genuine shutdown-race finalizers")
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ExceptHandler) and _is_broad(node) \
+                    and _is_silent(node.body):
+                what = "bare except" if node.type is None else \
+                    f"except {core.dotted_name(node.type) or 'Exception'}"
+                yield self.finding(
+                    module, node,
+                    f"`{what}: pass` silently swallows every failure")

@@ -1,0 +1,73 @@
+"""TL007 — nondeterministic structure in pytree-building code.
+
+jax flattens dicts in sorted-key order, but a ``set`` iterated to build
+a param list (or a mutable default accumulating across calls) produces
+a structure that can differ between processes — which shows up as a
+cross-host pytree-structure mismatch or a donation plan keyed on the
+wrong leaf order, not as a local error.  Flags:
+
+* mutable default arguments (``def f(x=[], y={}, z=set())`` and the
+  ``list()/dict()/set()`` call forms) — anywhere;
+* ``for``/comprehension iteration directly over a ``set`` literal or
+  ``set(...)`` call — unordered iteration.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import core
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Set):
+        return True
+    return isinstance(node, ast.Call) \
+        and isinstance(node.func, ast.Name) and node.func.id == "set"
+
+
+@core.register
+class PytreeOrderRule(core.Rule):
+    id = "TL007"
+    name = "pytree-order-hazard"
+    severity = "warning"
+    doc = ("mutable default arguments, and iteration directly over a "
+           "set — order differs across processes, so pytree structures "
+           "built from it diverge across hosts")
+    hint = ("default to None and create inside the function; iterate "
+            "`sorted(...)` instead of the raw set")
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defaults = list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None]
+                for d in defaults:
+                    if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                            isinstance(d, ast.Call)
+                            and isinstance(d.func, ast.Name)
+                            and d.func.id in ("list", "dict", "set")):
+                        yield self.finding(
+                            module, d,
+                            f"mutable default argument in `{node.name}` "
+                            f"— shared across every call",
+                            hint="default to None and create inside "
+                                 "the function")
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if _is_set_expr(node.iter):
+                    yield self.finding(
+                        module, node.iter,
+                        "iteration over a set — order is "
+                        "process-dependent",
+                        hint="iterate sorted(...) for a deterministic "
+                             "order")
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                for gen in node.generators:
+                    if _is_set_expr(gen.iter):
+                        yield self.finding(
+                            module, gen.iter,
+                            "comprehension over a set — order is "
+                            "process-dependent",
+                            hint="iterate sorted(...) for a "
+                                 "deterministic order")

@@ -1,0 +1,37 @@
+"""TL008 — NotImplementedError stubs (the NOTIMPL ratchet as a rule).
+
+The classification is the one ``tools/notimpl_inventory.py`` has
+ratcheted since VERDICT r3 — abstract contracts and documented guards
+pass; a function whose whole body is the raise is parity debt and
+becomes a finding.  ``analysis.notimpl`` reuses the same classifier to
+write NOTIMPL.md, so one walker and one suppression syntax produce
+both reports.
+"""
+
+from __future__ import annotations
+
+from .. import core
+from ..notimpl import classify_module
+
+
+@core.register
+class NotImplStubRule(core.Rule):
+    id = "TL008"
+    name = "notimpl-stub"
+    severity = "info"
+    doc = ("a function whose entire body is `raise NotImplementedError` "
+           "— a parity name with no behavior behind it")
+    hint = ("implement it, or turn it into a documented guard/redirect "
+            "(see NOTIMPL.md)")
+
+    def check(self, module):
+        for site in classify_module(module):
+            if site["kind"] != "stub":
+                continue
+            yield core.Finding(
+                rule=self.id, severity=self.severity, path=module.rel,
+                line=site["line"], col=0,
+                message=f"`{site['function']}` is a whole-body "
+                        f"NotImplementedError stub"
+                        + (f" — {site['msg']}" if site["msg"] else ""),
+                hint=self.hint)

@@ -84,8 +84,8 @@ class Tensor:
             try:
                 d = list(v.devices())[0]
                 return Place(d.platform, d.id)
-            except Exception:
-                pass
+            except (IndexError, RuntimeError):
+                pass    # deleted/donated array: fall to default_place
         from .device import default_place
         return default_place()
 

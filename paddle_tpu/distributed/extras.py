@@ -74,8 +74,8 @@ def wait(tensor, group=None, use_calc_stream=True):
     v = getattr(tensor, "_value", tensor)
     try:
         jax.block_until_ready(v)
-    except Exception:
-        pass
+    except (RuntimeError, TypeError):
+        pass    # deleted/non-array value: nothing to wait on
     return None
 
 
@@ -146,8 +146,8 @@ def destroy_process_group(group=None) -> None:
     try:
         import jax
         jax.distributed.shutdown()
-    except Exception:
-        pass
+    except (ImportError, RuntimeError):
+        pass    # coordination service was never initialized
 
 
 def gloo_init_parallel_env(rank_id: int, rank_num: int,

@@ -174,8 +174,8 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
                 m = int(np.prod(ishape[:-1]))
                 k, n = int(w.shape[0]), int(w.shape[1])
                 shapes[id(layer)] = 2 * m * k * n
-        except Exception:
-            pass
+        except (AttributeError, TypeError, ValueError):
+            pass    # layer without a conventional 2-D weight: no FLOPs
 
     handles = []
     for sub in net.sublayers(include_self=True):

@@ -176,7 +176,8 @@ class _DevicePrefetcher:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        # finalizer racing interpreter shutdown: anything may be torn down
+        except Exception:  # tracelint: disable=TL006
             pass
 
 
@@ -260,7 +261,8 @@ class _PrefetchIterator:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        # finalizer racing interpreter shutdown: anything may be torn down
+        except Exception:  # tracelint: disable=TL006
             pass
 
     def __iter__(self):
@@ -348,7 +350,8 @@ class DataLoader:
     def __del__(self):
         try:
             self._release_pool()
-        except Exception:
+        # finalizer racing interpreter shutdown: anything may be torn down
+        except Exception:  # tracelint: disable=TL006
             pass
 
     def _produce_batches(self):

@@ -187,8 +187,8 @@ class WorkerPool:
         try:
             from multiprocessing import resource_tracker
             resource_tracker.ensure_running()
-        except Exception:
-            pass
+        except (ImportError, OSError):
+            pass    # no tracker: workers fall back to per-process ones
         self._num_workers = num_workers
         self._timeout = timeout or None
         self._iterable = iterable
@@ -331,8 +331,8 @@ class WorkerPool:
                 if not isinstance(payload, (_ExcInfo, str)):
                     try:
                         _shm_unpack(payload)
-                    except Exception:
-                        pass
+                    except (OSError, ValueError):
+                        pass    # segment already unlinked by the worker
         finally:
             for q in self._index_qs:
                 q.close()
@@ -341,5 +341,6 @@ class WorkerPool:
     def __del__(self):
         try:
             self.shutdown()
-        except Exception:
+        # finalizer racing interpreter shutdown: anything may be torn down
+        except Exception:  # tracelint: disable=TL006
             pass

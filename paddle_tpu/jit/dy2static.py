@@ -835,8 +835,9 @@ def _convert(fn: Callable) -> Callable:
         rendered = ast.unparse(mod)
         linecache.cache[filename] = (len(rendered), None,
                                      rendered.splitlines(True), filename)
-    except Exception:
-        pass
+    except ValueError:
+        pass    # ast.unparse rejects the tree: tracebacks lose the
+                # rendered source but the compiled function still works
     ns: dict = {}
     exec(code, glb, ns)
     cell_by_name = dict(zip(fn.__code__.co_freevars, fn.__closure__ or ()))

@@ -188,6 +188,7 @@ class TokenRing:
             try:
                 self._lib.pt_ring_close(self._ring)
                 self._lib.pt_ring_destroy(self._ring)
-            except Exception:
+            # finalizer: ctypes lib handle may already be unloaded at exit
+            except Exception:  # tracelint: disable=TL006
                 pass
             self._ring = None

@@ -42,8 +42,8 @@ def _load_disk() -> None:
         try:
             with open(path) as f:
                 _CACHE.update(json.load(f))
-        except Exception:
-            pass
+        except (OSError, ValueError):
+            pass    # unreadable/corrupt cache file: tune from scratch
 
 
 def _save_disk() -> None:
@@ -58,15 +58,16 @@ def _save_disk() -> None:
             try:
                 with open(path) as f:
                     merged.update(json.load(f))
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                pass    # corrupt on-disk cache: overwrite with ours
         merged.update(_CACHE)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(merged, f)
         os.replace(tmp, path)
-    except Exception:
-        pass
+    except (OSError, TypeError, ValueError):
+        pass    # cache persistence is best-effort; tuning results stay
+                # in-process even when the disk write fails
 
 
 def _device_kind() -> str:

@@ -243,8 +243,13 @@ class Optimizer:
                                                     self.get_lr(),
                                                     self._step_count + 1)
         except TypeError:
+            # safe despite the donation above: TypeError from jit means
+            # apply_gradients could not be TRACED (e.g. a Python-object
+            # lr schedule) — tracing precedes execution, so no buffer
+            # was actually donated when we reach this fallback
             new_params, new_state = self.apply_gradients(
-                params, grads, state, self.get_lr(), self._step_count + 1)
+                params, grads, state,  # tracelint: disable=TL004
+                self.get_lr(), self._step_count + 1)
         for name, v in new_params.items():
             self._param_index[name]._value = v
         self._state.update(new_state)

@@ -275,8 +275,9 @@ def merged_chrome_trace(host_events, trace_dir: Optional[str],
                                 "dur": ev.duration_ns / 1e3,
                                 "cat": "Kernel",
                             })
-            except Exception:
-                pass
+            except (ImportError, AttributeError, OSError, ValueError):
+                pass    # ProfileData is an unstable jax API: missing or
+                        # reshaped → export the host-side events only
     meta = [
         {"name": "process_name", "ph": "M", "pid": 0,
          "args": {"name": "host"}},

@@ -1,0 +1,242 @@
+"""tracelint unit tests: per-rule fixtures, suppressions, CLI modes.
+
+Fixture files under tests/tracelint_fixtures/ are ANALYZED, never
+imported — each rule has a positive (must fire) and negative (must stay
+quiet) snippet.  CPU-only, no jax execution anywhere.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.analysis import core
+from paddle_tpu.analysis import baseline as baseline_mod
+from paddle_tpu.analysis.cli import main as cli_main
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "tracelint_fixtures")
+REPO = os.path.dirname(HERE)
+
+RULE_IDS = ("TL001", "TL002", "TL003", "TL004", "TL005", "TL006",
+            "TL007", "TL008")
+
+
+def run_fixture(name, select=None):
+    return core.run([os.path.join(FIXTURES, name)], select=select)
+
+
+def rules_hit(findings):
+    return {f.rule for f in findings}
+
+
+# -- rule registry ------------------------------------------------------
+
+def test_all_rules_registered():
+    ids = [r.id for r in core.all_rules()]
+    assert ids == sorted(ids)
+    for rid in RULE_IDS:
+        assert rid in ids
+
+
+def test_rules_carry_metadata():
+    for rule in core.all_rules():
+        assert rule.severity in core.SEVERITIES
+        assert rule.doc and rule.hint and rule.name
+
+
+# -- per-rule positive/negative fixtures --------------------------------
+
+@pytest.mark.parametrize("rid", RULE_IDS)
+def test_rule_fires_on_positive_fixture(rid):
+    findings = run_fixture(f"{rid.lower()}_pos.py", select={rid})
+    assert findings, f"{rid} found nothing in its positive fixture"
+    assert rules_hit(findings) == {rid}
+
+
+@pytest.mark.parametrize("rid", RULE_IDS)
+def test_rule_quiet_on_negative_fixture(rid):
+    findings = run_fixture(f"{rid.lower()}_neg.py", select={rid})
+    assert not findings, [f.format() for f in findings]
+
+
+def test_tl001_counts_each_sync_site():
+    findings = run_fixture("tl001_pos.py", select={"TL001"})
+    assert len(findings) >= 5           # float/item/asarray/device_get +
+    assert any("tolist" in f.message for f in findings)   # transitive
+
+
+def test_tl004_flags_loop_without_rebind():
+    findings = run_fixture("tl004_pos.py", select={"TL004"})
+    lines = {f.line for f in findings}
+    assert len(findings) >= 3
+    # the loop body call site itself is the iteration-2 read
+    assert any("params" in f.message for f in findings)
+    assert any("state" in f.message for f in findings)
+    assert all(f.severity == "error" for f in findings)
+    assert lines
+
+
+def test_tl005_names_the_drifted_axis():
+    findings = run_fixture("tl005_pos.py", select={"TL005"})
+    msgs = " ".join(f.message for f in findings)
+    assert "'modelp'" in msgs and "'tensor'" in msgs
+    assert len(findings) == 2
+
+
+# -- suppressions -------------------------------------------------------
+
+def test_inline_suppression_silences_one_site_only():
+    findings = run_fixture("suppressed.py", select={"TL006"})
+    assert len(findings) == 1
+    assert "unjustified" in "".join(
+        open(os.path.join(FIXTURES, "suppressed.py")).readlines()
+        [findings[0].line - 4:findings[0].line])
+
+
+def test_file_level_suppression():
+    findings = run_fixture("suppressed.py", select={"TL007"})
+    assert findings == []
+
+
+# -- engine plumbing ----------------------------------------------------
+
+def test_collect_files_skips_pycache_and_dedups():
+    files = core.collect_files([FIXTURES, os.path.join(FIXTURES,
+                                                       "tl001_pos.py")])
+    assert all("__pycache__" not in f for f in files)
+    assert len(files) == len(set(map(os.path.abspath, files)))
+
+
+def test_load_module_survives_syntax_error(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    assert core.load_module(str(bad)) is None
+    assert core.run([str(bad)]) == []
+
+
+def test_findings_sorted_and_json_roundtrip():
+    findings = run_fixture("tl006_pos.py")
+    assert findings == sorted(findings, key=lambda f: f.sort_key)
+    for f in findings:
+        d = f.to_json()
+        assert {"rule", "severity", "path", "line", "col", "message",
+                "hint"} <= set(d)
+
+
+# -- baseline render/parse/compare --------------------------------------
+
+def test_baseline_roundtrip_and_compare():
+    findings = run_fixture("tl006_pos.py") + run_fixture("tl007_pos.py")
+    md = baseline_mod.render_md(findings)
+    parsed = baseline_mod.parse_md(md)
+    assert parsed == baseline_mod.counts(findings)
+    # identical findings: no regression
+    assert baseline_mod.compare(baseline_mod.counts(findings),
+                                parsed) == []
+    # one extra finding in a known file: regression
+    grown = dict(parsed)
+    key = next(iter(grown))
+    grown[key] += 1
+    assert baseline_mod.compare(grown, parsed)
+    # a brand-new (rule, file) pair: regression
+    fresh = dict(parsed)
+    fresh[("TL001", "somewhere/new.py")] = 1
+    assert baseline_mod.compare(fresh, parsed)
+
+
+def test_baseline_parse_rejects_blockless_text():
+    with pytest.raises(ValueError):
+        baseline_mod.parse_md("# not a baseline\n")
+
+
+# -- CLI ----------------------------------------------------------------
+
+def test_cli_json_schema(capsys):
+    rc = cli_main([os.path.join(FIXTURES, "tl006_pos.py"), "--json",
+                   "--no-baseline"])
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["version"] == 1
+    assert rc == 1                       # findings, no baseline
+    assert payload["counts"].get("TL006", 0) >= 3
+    for f in payload["findings"]:
+        assert {"rule", "severity", "path", "line", "col",
+                "message", "hint"} <= set(f)
+    assert payload["above_baseline"] == []
+
+
+def test_cli_select_filters_rules(capsys):
+    rc = cli_main([os.path.join(FIXTURES, "tl007_pos.py"),
+                   "--select", "TL006", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "0 findings" in out
+
+
+def test_cli_clean_file_exits_zero(capsys):
+    rc = cli_main([os.path.join(FIXTURES, "tl006_neg.py"),
+                   "--select", "TL006", "--no-baseline"])
+    assert rc == 0
+
+
+def test_cli_baseline_gates_exit_code(tmp_path, capsys):
+    target = os.path.join(FIXTURES, "tl006_pos.py")
+    findings = core.run([target])
+    base = tmp_path / "TRACELINT.md"
+    base.write_text(baseline_mod.render_md(findings))
+    # findings == baseline: ratchet passes
+    assert cli_main([target, "--baseline", str(base)]) == 0
+    capsys.readouterr()
+    # empty baseline: everything is above it
+    empty = tmp_path / "EMPTY.md"
+    empty.write_text(baseline_mod.render_md([]))
+    rc = cli_main([target, "--baseline", str(empty)])
+    out = capsys.readouterr().out
+    assert rc == 2 and "ABOVE BASELINE" in out
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RULE_IDS:
+        assert rid in out
+
+
+def test_cli_diff_mode_runs_and_emits_json():
+    # diff vs HEAD exercises the git plumbing end-to-end; the changed
+    # set varies with workspace state, so only the contract is checked
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--diff", "HEAD",
+         "--json", "--no-baseline"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode in (0, 1)
+    payload = json.loads(proc.stdout)
+    assert payload["version"] == 1
+
+
+def test_cli_diff_bad_ref_fails_cleanly():
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--diff",
+         "no-such-ref-xyz"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode not in (0, None)
+    assert "git diff" in proc.stderr
+
+
+# -- notimpl backend fold-in --------------------------------------------
+
+def test_notimpl_classifier_matches_rule():
+    from paddle_tpu.analysis.notimpl import classify_module
+    mod = core.load_module(os.path.join(FIXTURES, "tl008_neg.py"))
+    kinds = sorted(s["kind"] for s in classify_module(mod))
+    assert kinds == ["abstract", "guard", "guard"]
+
+
+def test_notimpl_shim_cli_ratchet_green():
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "notimpl_inventory.py"),
+         "--check", "0"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "stubs=0" in proc.stdout

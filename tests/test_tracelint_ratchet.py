@@ -1,0 +1,88 @@
+"""tracelint ratchet: the real package versus the committed baseline.
+
+Tier-1 and CPU-only: pure AST analysis, no jax execution.  The ratchet
+fails when any (rule, file) finding count exceeds TRACELINT.md — the
+same comparison `python tools/tracelint_baseline.py --check` runs
+standalone (pre-commit style).
+"""
+
+import os
+import subprocess
+import sys
+
+from paddle_tpu.analysis import baseline as baseline_mod
+from paddle_tpu.analysis import core
+from paddle_tpu.analysis.cli import default_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CORE_TREES = ("paddle_tpu/checkpoint/", "paddle_tpu/io/",
+              "paddle_tpu/optimizer/", "paddle_tpu/parallel/")
+
+
+def _current_findings():
+    return core.run(default_paths())
+
+
+def test_package_at_or_below_baseline():
+    findings = _current_findings()
+    base = baseline_mod.load()
+    regressions = baseline_mod.compare(baseline_mod.counts(findings),
+                                       base)
+    assert regressions == [], (
+        "tracelint findings grew beyond TRACELINT.md:\n  "
+        + "\n  ".join(regressions)
+        + "\nfix or suppress (with justification), or regenerate the "
+          "baseline via `python tools/tracelint_baseline.py` with "
+          "reviewer sign-off")
+
+
+def test_core_subsystems_have_zero_tl006():
+    """The ISSUE 4 triage contract: checkpoint/, io/, optimizer/ and
+    parallel/ carry NO un-triaged silent-except debt — in the live scan
+    AND in the committed ledger."""
+    findings = _current_findings()
+    live = [f for f in findings if f.rule == "TL006"
+            and f.path.startswith(CORE_TREES)]
+    assert live == [], [f.format() for f in live]
+    for (rule, path), n in baseline_mod.load().items():
+        if rule == "TL006" and path.startswith(CORE_TREES):
+            assert n == 0, f"baseline carries TL006 debt in {path}"
+
+
+def test_ratchet_fails_on_injected_violation(tmp_path):
+    """A synthetic violation in the analyzed tree must trip the
+    comparison: the ratchet is live, not vacuously green."""
+    bad = tmp_path / "injected.py"
+    bad.write_text(
+        "def leaky(q):\n"
+        "    try:\n"
+        "        q.get_nowait()\n"
+        "    except Exception:\n"
+        "        pass\n")
+    findings = _current_findings() + core.run([str(bad)])
+    assert any(f.rule == "TL006" and "injected.py" in f.path
+               for f in findings)
+    regressions = baseline_mod.compare(baseline_mod.counts(findings),
+                                       baseline_mod.load())
+    assert regressions, "injected TL006 violation did not trip the ratchet"
+
+
+def test_standalone_checker_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "tracelint_baseline.py"),
+         "--check"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ratchet OK" in proc.stdout
+
+
+def test_module_cli_reports_zero_above_baseline():
+    """Acceptance criterion: `python -m paddle_tpu.analysis paddle_tpu/`
+    reports zero above-baseline findings."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis",
+         os.path.join(REPO, "paddle_tpu")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 above baseline" in proc.stdout

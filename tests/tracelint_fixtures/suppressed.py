@@ -1,0 +1,27 @@
+"""Suppression fixture: inline and file-level disables.
+
+The file-level disable below turns off TL007 everywhere in this file;
+the inline disable silences exactly one TL006 finding; the second TL006
+site carries no suppression and must still be reported.
+"""
+# tracelint: disable-file=TL007
+
+
+def collect(name, acc=[]):                 # TL007 — file-suppressed
+    acc.append(name)
+    return acc
+
+
+def finalizer(handle):
+    try:
+        handle.close()
+    # shutdown-race finalizer: justified
+    except Exception:  # tracelint: disable=TL006
+        pass
+
+
+def unjustified(handle):
+    try:
+        handle.flush()
+    except Exception:                      # still reported
+        pass

@@ -1,0 +1,17 @@
+"""TL001 negative fixture: the same host syncs are fine OUTSIDE traced
+code, and traced code doing pure jnp work is clean."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(params, x):
+    return jnp.sum(params["w"] * x)       # pure on-device math
+
+
+def eager_report(arr):
+    # untraced: syncing is the point
+    v = arr.item()
+    host = np.asarray(arr)
+    return float(v), host.tolist()

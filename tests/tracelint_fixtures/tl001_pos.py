@@ -1,0 +1,26 @@
+"""TL001 positive fixture: host syncs inside traced code (analyzed,
+never executed)."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(params, x):
+    if float(x) > 0:                      # cast on a traced parameter
+        x = x + 1
+    v = params["w"].item()                # device->host sync
+    a = np.asarray(x)                     # pulls the tracer to host
+    jax.device_get(v)                     # blocks on device values
+    return v, a
+
+
+def helper(t):
+    return t.tolist()                     # reached from scan below
+
+
+def body(c, t):
+    return c, helper(t)
+
+
+def outer(x):
+    return jax.lax.scan(body, x, x)

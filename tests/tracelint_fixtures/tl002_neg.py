@@ -1,0 +1,20 @@
+"""TL002 negative fixture: jax.random and jax.debug.print are the
+trace-safe spellings; impurity outside traced code is not our business."""
+import time
+
+import jax
+from jax import random
+
+
+@jax.jit
+def step(x, key):
+    k1, k2 = random.split(key)             # jax.random: functional
+    jax.debug.print("x = {}", x)           # per-execution print
+    return x + random.normal(k1, x.shape), k2
+
+
+def time_a_step(fn, x):
+    t0 = time.time()                       # untraced host timing
+    fn(x)
+    print("took", time.time() - t0)
+    return t0

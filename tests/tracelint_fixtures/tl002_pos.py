@@ -1,0 +1,19 @@
+"""TL002 positive fixture: impurity inside traced code."""
+import random
+import time
+
+import jax
+import numpy as np
+
+_calls = 0
+
+
+@jax.jit
+def step(x):
+    global _calls                          # invisible to the program
+    _calls += 1
+    print("step!", x)                      # fires once, at trace time
+    t = time.time()                        # one frozen timestamp
+    noise = random.random()                # stdlib RNG drawn once
+    jitter = np.random.rand()              # np RNG drawn once
+    return x + t + noise + jitter

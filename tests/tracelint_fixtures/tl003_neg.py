@@ -1,0 +1,29 @@
+"""TL003 negative fixture: bounded caches and hoisted jit wrappers."""
+import functools
+
+import jax
+
+
+def _impl(x):
+    return x * 2
+
+
+_jitted = jax.jit(_impl)                   # built once, module level
+
+_plan_cache = {}
+
+
+def lookup(key, f):
+    if len(_plan_cache) > 64:
+        _plan_cache.pop(next(iter(_plan_cache)))    # evicts: bounded
+    _plan_cache[key] = jax.jit(f)
+    return _plan_cache[key]
+
+
+@functools.lru_cache(maxsize=32)
+def shape_table(n):
+    return (n, n)
+
+
+def hot_path(x):
+    return _jitted(x)

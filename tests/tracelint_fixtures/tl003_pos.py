@@ -1,0 +1,21 @@
+"""TL003 positive fixture: recompile / unbounded-cache hazards."""
+import functools
+
+import jax
+
+_plan_cache = {}
+
+
+def lookup(key, f):
+    # unbounded module-level cache of compiled callables, no eviction
+    _plan_cache[key] = jax.jit(f)
+    return _plan_cache[key]
+
+
+def hot_path(f, x):
+    return jax.jit(f)(x)                   # fresh wrapper every call
+
+
+@functools.lru_cache(maxsize=None)         # unbounded by declaration
+def shape_table(n):
+    return (n, n)

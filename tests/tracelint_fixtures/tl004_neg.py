@@ -1,0 +1,32 @@
+"""TL004 negative fixture: donation with the names properly rebound."""
+import functools
+
+import jax
+
+
+def update(p, s, b):
+    return p, s
+
+
+def training_loop(params, opt_state, batches):
+    step = jax.jit(update, donate_argnums=(0, 1))
+    for b in batches:
+        params, opt_state = step(params, opt_state, b)   # rebound
+    return params, opt_state
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def fused_step(state, x):
+    return state
+
+
+def decorated_caller(state, xs):
+    for x in xs:
+        state = fused_step(state, x)       # rebound each iteration
+    return state
+
+
+def undonated(params, batch):
+    g = jax.jit(update)                    # no donation at all
+    out = g(params, None, batch)
+    return params, out

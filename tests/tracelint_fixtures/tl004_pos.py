@@ -1,0 +1,33 @@
+"""TL004 positive fixture: donated buffers read after the call."""
+import functools
+
+import jax
+
+
+def update(p, s, b):
+    return p
+
+
+def straight_line(params, batch):
+    g = jax.jit(update, donate_argnums=(0,))
+    out = g(params, None, batch)
+    return params, out                     # params was donated above
+
+
+def training_loop(params, opt_state, batches):
+    step = jax.jit(update, donate_argnums=(0, 1))
+    loss = None
+    for b in batches:
+        # never rebound: iteration 2 passes deleted buffers
+        loss = step(params, opt_state, b)
+    return loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def fused_step(state, x):
+    return state
+
+
+def decorated_caller(state, x):
+    new_state = fused_step(state, x)
+    return state, new_state                # state was donated

@@ -1,0 +1,21 @@
+"""TL005 negative fixture: axis names resolved from constants or
+declared by a mesh in the scanned tree."""
+import jax
+from jax import lax
+
+DP_AXIS = "dp"
+MP_AXIS = "mp"
+
+mesh = jax.make_mesh((1, 1), axis_names=("dp", "mp"))
+
+
+def reduce_const(x):
+    return lax.psum(x, MP_AXIS)            # constant, not a literal
+
+
+def reduce_known(x):
+    return lax.pmax(x, "dp")               # literal, but mesh-declared
+
+
+def reduce_pair(x):
+    return lax.psum(x, ("dp", "mp"))       # tuple of known axes

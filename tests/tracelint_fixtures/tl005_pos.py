@@ -1,0 +1,17 @@
+"""TL005 positive fixture: a collective whose literal axis name matches
+no axis constant / mesh axis anywhere in the scanned tree."""
+from jax import lax
+
+MP_AXIS = "mp"
+
+
+def reduce_local(x):
+    return lax.psum(x, MP_AXIS)            # constant: fine
+
+
+def reduce_drifted(x):
+    return lax.psum(x, "modelp")           # typo'd literal: flagged
+
+
+def index_drifted():
+    return lax.axis_index(axis_name="tensor")   # unknown axis: flagged

@@ -1,0 +1,27 @@
+"""TL006 negative fixture: narrowed, logged, or re-raising handlers."""
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def load_cache(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except (OSError, ValueError):          # narrowed to the expected set
+        pass
+
+
+def risky(fn):
+    try:
+        return fn()
+    except Exception:
+        log.warning("fn failed; continuing")   # logged, not silent
+        return None
+
+
+def propagate(fn):
+    try:
+        return fn()
+    except Exception:
+        raise

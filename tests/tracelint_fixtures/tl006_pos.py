@@ -1,0 +1,24 @@
+"""TL006 positive fixture: silent broad exception swallows."""
+
+
+def load_cache(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except Exception:
+        pass
+
+
+def close_all(handles):
+    for h in handles:
+        try:
+            h.close()
+        except:                            # noqa: E722 — bare
+            pass
+
+
+def drain(q):
+    try:
+        q.get_nowait()
+    except BaseException:
+        ...

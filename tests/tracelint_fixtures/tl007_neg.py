@@ -1,0 +1,23 @@
+"""TL007 negative fixture: deterministic spellings of the same code."""
+
+
+def collect(name, acc=None):
+    if acc is None:
+        acc = []
+    acc.append(name)
+    return acc
+
+
+def flatten_params(names):
+    leaves = []
+    for n in sorted(set(names)):           # deterministic order
+        leaves.append(n)
+    return leaves
+
+
+def spec_list(axes):
+    return [a for a in sorted(set(axes))]
+
+
+def iterate_list(items):
+    return [i for i in items]              # lists keep their order

@@ -1,0 +1,25 @@
+"""TL007 positive fixture: mutable defaults and set-order iteration."""
+
+
+def collect(name, acc=[]):                 # shared across calls
+    acc.append(name)
+    return acc
+
+
+def index(table={}):                       # shared across calls
+    return table
+
+
+def tags(extra=set()):                     # shared across calls
+    return extra
+
+
+def flatten_params(names):
+    leaves = []
+    for n in set(names):                   # process-dependent order
+        leaves.append(n)
+    return leaves
+
+
+def spec_list(axes):
+    return [a for a in set(axes)]          # process-dependent order

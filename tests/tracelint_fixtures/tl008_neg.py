@@ -1,0 +1,18 @@
+"""TL008 negative fixture: abstract contracts and documented guards are
+not stubs."""
+
+
+class BaseQuanter:
+    def scales(self):
+        raise NotImplementedError          # abstract: subclass contract
+
+
+def load_pretrained(name, pretrained=False):
+    if pretrained:
+        # guard: explicit unsupported-mode branch in a working function
+        raise NotImplementedError("no weights hub; pass weights=...")
+    return name
+
+
+def spectral_op(x):
+    raise NotImplementedError("use paddle_tpu.fft instead")   # redirect

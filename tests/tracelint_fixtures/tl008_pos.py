@@ -1,0 +1,5 @@
+"""TL008 positive fixture: a whole-body NotImplementedError stub."""
+
+
+def sparse_attention(q, k, v):
+    raise NotImplementedError

@@ -1,159 +1,24 @@
-"""NotImplementedError inventory (VERDICT r3 item 7).
+"""NotImplementedError inventory (VERDICT r3 item 7) — thin shim.
 
-AST-scans the package for every ``raise NotImplementedError`` site and
-writes NOTIMPL.md — the committed burn-down list the judge asked for —
-classifying each site:
-
-* ``abstract``  — base-class contract (``BaseQuanter.scales``): fine.
-* ``guard``     — explicit unsupported-MODE branch inside an otherwise
-  working function (e.g. ``pretrained=True`` with no weights hub, a
-  sparse layout an op doesn't take): each is a real, documented limit.
-* ``stub``      — a function whose whole body is the raise: a parity
-  name with no behavior behind it.  These are the debt to burn down.
+The walker/classifier now lives in ``paddle_tpu/analysis/notimpl.py``
+(rule TL008): NOTIMPL.md and TRACELINT.md are produced by ONE AST walk
+with one suppression syntax (``# tracelint: disable=TL008``).  The CLI
+contract is unchanged:
 
 Usage: ``python tools/notimpl_inventory.py [--check N]`` — ``--check``
 exits non-zero if the stub count exceeds N (the ratchet used by
-tests/test_notimpl_ratchet.py).
+tests/test_invocation_parity.py).
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "paddle_tpu")
+sys.path.insert(0, REPO)
 
-
-def _enclosing_function(stack):
-    for node in reversed(stack):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            return node
-    return None
-
-
-def _is_whole_body_raise(fn: ast.FunctionDef) -> bool:
-    body = [s for s in fn.body
-            if not isinstance(s, ast.Expr)
-            or not isinstance(s.value, ast.Constant)]   # skip docstring
-    return len(body) == 1 and isinstance(body[0], ast.Raise)
-
-
-def scan():
-    sites = []
-    for root, _dirs, files in os.walk(PKG):
-        if "__pycache__" in root:
-            continue
-        for f in sorted(files):
-            if not f.endswith(".py"):
-                continue
-            path = os.path.join(root, f)
-            rel = os.path.relpath(path, REPO)
-            try:
-                tree = ast.parse(open(path).read())
-            except SyntaxError:
-                continue
-
-            stack = []
-
-            def walk(node):
-                stack.append(node)
-                for child in ast.iter_child_nodes(node):
-                    if isinstance(child, ast.Raise):
-                        exc = child.exc
-                        name = ""
-                        if isinstance(exc, ast.Call) and isinstance(
-                                exc.func, ast.Name):
-                            name = exc.func.id
-                        elif isinstance(exc, ast.Name):
-                            name = exc.id
-                        if name == "NotImplementedError":
-                            fn = _enclosing_function(stack + [node])
-                            msg = ""
-                            if isinstance(exc, ast.Call) and exc.args:
-                                a0 = exc.args[0]
-                                if isinstance(a0, ast.Constant):
-                                    msg = str(a0.value)
-                                elif isinstance(a0, ast.JoinedStr):
-                                    msg = "".join(
-                                        v.value for v in a0.values
-                                        if isinstance(v, ast.Constant))
-                            in_class = any(isinstance(s, ast.ClassDef)
-                                           for s in stack)
-                            if fn is None:
-                                kind = "guard"
-                            elif _is_whole_body_raise(fn):
-                                if in_class and not msg:
-                                    kind = "abstract"
-                                elif msg and ("out of scope" in msg
-                                              or "no closed" in msg.lower()
-                                              or "non-goal" in msg
-                                              or "use " in msg
-                                              or "serve with" in msg
-                                              or "expressed as" in msg
-                                              or "see " in msg
-                                              or "implement " in msg):
-                                    # documented design redirect / math
-                                    # impossibility, not missing work
-                                    kind = "guard"
-                                else:
-                                    kind = "stub"
-                            else:
-                                kind = "guard"
-                            sites.append({
-                                "file": rel,
-                                "line": child.lineno,
-                                "function": fn.name if fn else "<module>",
-                                "kind": kind,
-                                "msg": msg[:100],
-                            })
-                    walk(child)
-                stack.pop()
-
-            walk(tree)
-    return sites
-
-
-def write_md(sites):
-    by_kind = {}
-    for s in sites:
-        by_kind.setdefault(s["kind"], []).append(s)
-    lines = [
-        "# NotImplementedError inventory",
-        "",
-        "Generated by `tools/notimpl_inventory.py`; the ratchet test"
-        " (tests/test_notimpl_ratchet.py) fails if the STUB count grows.",
-        "",
-        f"Totals: {len(sites)} sites — "
-        + ", ".join(f"{k}: {len(v)}" for k, v in sorted(by_kind.items())),
-        "",
-    ]
-    for kind in ("stub", "guard", "abstract"):
-        rows = by_kind.get(kind, [])
-        lines += [f"## {kind} ({len(rows)})", ""]
-        for s in rows:
-            lines.append(f"- `{s['file']}:{s['line']}` "
-                         f"`{s['function']}` — {s['msg'] or '(no message)'}")
-        lines.append("")
-    with open(os.path.join(REPO, "NOTIMPL.md"), "w") as f:
-        f.write("\n".join(lines))
-    return by_kind
-
-
-def main():
-    sites = scan()
-    by_kind = write_md(sites)
-    n_stub = len(by_kind.get("stub", []))
-    print(f"{len(sites)} sites; stubs={n_stub} "
-          f"guards={len(by_kind.get('guard', []))} "
-          f"abstract={len(by_kind.get('abstract', []))}")
-    if "--check" in sys.argv:
-        limit = int(sys.argv[sys.argv.index("--check") + 1])
-        if n_stub > limit:
-            print(f"RATCHET FAIL: {n_stub} stubs > limit {limit}")
-            sys.exit(1)
-
+from paddle_tpu.analysis.notimpl import main    # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

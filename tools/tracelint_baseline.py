@@ -1,0 +1,59 @@
+"""TRACELINT.md baseline generator / standalone ratchet.
+
+* ``python tools/tracelint_baseline.py``          — regenerate TRACELINT.md
+  from the current findings (use after fixing debt: the ledger ratchets
+  DOWN; growing it requires explanation in review).
+* ``python tools/tracelint_baseline.py --check``  — exit non-zero if any
+  (rule, file) count exceeds the committed baseline; the pre-commit-style
+  one-liner for the same ratchet tests/test_tracelint_ratchet.py runs
+  under pytest.
+
+The lint surface is the repo default: ``paddle_tpu/``, ``bench.py``,
+``tools/`` (including this file).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.analysis import baseline, core       # noqa: E402
+from paddle_tpu.analysis.cli import default_paths    # noqa: E402
+
+
+def generate() -> int:
+    findings = core.run(default_paths())
+    path = baseline.default_path()
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(baseline.render_md(findings))
+    print(f"wrote {os.path.relpath(path, REPO)}: "
+          f"{len(findings)} findings")
+    return 0
+
+
+def check() -> int:
+    findings = core.run(default_paths())
+    try:
+        base = baseline.load()
+    except (OSError, ValueError) as e:
+        print(f"RATCHET FAIL: cannot load baseline: {e}")
+        return 1
+    regressions = baseline.compare(baseline.counts(findings), base)
+    if regressions:
+        print(f"RATCHET FAIL: {len(regressions)} (rule, file) pairs "
+              f"above the committed TRACELINT.md baseline:")
+        for r in regressions:
+            print(f"  {r}")
+        print("fix the findings (preferred), suppress with an inline "
+              "justification, or — with reviewer sign-off — regenerate "
+              "the baseline via `python tools/tracelint_baseline.py`.")
+        return 1
+    print(f"ratchet OK: {len(findings)} findings, none above baseline")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(check() if "--check" in sys.argv[1:] else generate())
