@@ -55,20 +55,11 @@ def _acquire_devices():
 
 
 def peak_flops_per_chip(device) -> float:
-    """bf16 peak FLOP/s for the local accelerator."""
-    kind = getattr(device, "device_kind", "").lower()
-    platform = device.platform.lower()
-    if "v5 lite" in kind or "v5e" in kind:
-        return 197e12
-    if "v5p" in kind or "v5" in kind:
-        return 459e12
-    if "v4" in kind:
-        return 275e12
-    if "v6" in kind or "trillium" in kind:
-        return 918e12
-    if platform in ("tpu", "axon"):
-        return 197e12
-    return 1e12  # CPU fallback: nominal
+    """bf16 peak FLOP/s for the local accelerator (single source of
+    truth: observability/hw.py — Model.fit's MFU telemetry uses the
+    same table)."""
+    from paddle_tpu.observability.hw import peak_flops_per_chip as _pf
+    return _pf(device)
 
 
 def _layer_train_bench(net, x, y, steps: int, items_per_step: float,
@@ -564,10 +555,68 @@ def run_bench():
     return out
 
 
+def _bench_telemetry_start():
+    """Observability wiring for the measurement child (ISSUE 5): a
+    dedicated registry + MemorySink the metric row is routed through,
+    and a jax.monitoring CompileMonitor so the row carries the compile-
+    time trajectory (extra.n_compiles / extra.compile_secs).  The
+    listener only fires during compilation, so the measured steady-state
+    loop is untouched.  Optional: BENCH_TELEMETRY_DIR=<dir> additionally
+    streams every record to <dir>/bench_metrics.jsonl; BENCH_TELEMETRY=0
+    disables the wiring entirely (overhead A/B)."""
+    if os.environ.get("BENCH_TELEMETRY") == "0":
+        return None
+    try:
+        from paddle_tpu.observability import (CompileMonitor, JsonlSink,
+                                              MemorySink, MetricsRegistry)
+    except ImportError:
+        return None
+    reg = MetricsRegistry(enabled=True)
+    sink = MemorySink()
+    reg.add_sink(sink)
+    jsink = None
+    jdir = os.environ.get("BENCH_TELEMETRY_DIR")
+    if jdir:
+        jsink = JsonlSink(os.path.join(jdir, "bench_metrics.jsonl"))
+        reg.add_sink(jsink)
+    monitor = CompileMonitor(reg).install()
+    return {"registry": reg, "sink": sink, "jsonl": jsink,
+            "monitor": monitor}
+
+
+def _bench_telemetry_finish(tele, out):
+    """Stamp compile telemetry onto the row, then route the row itself
+    through the registry's event stream — what gets printed is the
+    record read back from the sink, so the registry is ON the reporting
+    path, not beside it."""
+    if tele is None or not isinstance(out, dict):
+        return out
+    monitor = tele["monitor"]
+    monitor.uninstall()
+    s = monitor.summary()
+    extra = out.setdefault("extra", {})
+    extra["n_compiles"] = s["n_compiles"]
+    extra["compile_secs"] = s["compile_secs"]
+    if s["cache_hits"]:
+        extra["compile_cache_hits"] = s["cache_hits"]
+    tele["registry"].event("bench_row", **out)
+    if tele["jsonl"] is not None:
+        tele["jsonl"].close()
+    rows = tele["sink"].by_kind("bench_row")
+    if rows:
+        row = dict(rows[-1])
+        row.pop("ts", None)
+        row.pop("kind", None)
+        return row
+    return out
+
+
 def _child_main() -> None:
     cfg = os.environ.get("BENCH_CONFIG", "")
+    tele = _bench_telemetry_start()
     try:
         out = run_config_bench(cfg) if cfg else run_bench()
+        out = _bench_telemetry_finish(tele, out)
     except Exception as e:
         out = {
             "metric": "gpt_train_tokens_per_sec_per_chip",

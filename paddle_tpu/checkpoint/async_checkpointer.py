@@ -17,11 +17,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Optional
 
 import numpy as np
 
 from .manager import CheckpointManager
+from ..observability import REGISTRY as _METRICS
 
 __all__ = ["AsyncCheckpointer"]
 
@@ -83,11 +85,19 @@ class AsyncCheckpointer:
             except BaseException as e:
                 with self._lock:
                     self._exc = e
+                if _METRICS.enabled:
+                    _METRICS.counter("checkpoint.async_failures_total"
+                                     ).inc()
             finally:
                 with self._lock:
                     self._pending -= 1
-                    if self._pending == 0:
+                    pending = self._pending
+                    if pending == 0:
                         self._idle.set()
+                # thread-safe by registry contract: the writer thread
+                # updates the queue gauge as saves drain
+                if _METRICS.enabled:
+                    _METRICS.gauge("checkpoint.queue_depth").set(pending)
 
     def _raise_pending(self) -> None:
         with self._lock:
@@ -102,10 +112,19 @@ class AsyncCheckpointer:
         if self._closed:
             raise RuntimeError("AsyncCheckpointer is closed")
         self._raise_pending()
+        t0 = time.perf_counter()
         snap = _snapshot(state)
         with self._lock:
             self._pending += 1
+            pending = self._pending
             self._idle.clear()
+        if _METRICS.enabled:
+            # the snapshot is the only cost the TRAINING thread pays
+            _METRICS.histogram("checkpoint.snapshot_secs", unit="s",
+                               desc="device→host state snapshot").record(
+                                   time.perf_counter() - t0)
+            _METRICS.counter("checkpoint.async_saves_total").inc()
+            _METRICS.gauge("checkpoint.queue_depth").set(pending)
         self._q.put((snap, int(step)))
 
     def wait(self, timeout: Optional[float] = None) -> bool:

@@ -19,10 +19,12 @@ from __future__ import annotations
 
 import os
 import re
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..framework import io as fio
 from ..framework.io import CheckpointCorruptError
+from ..observability import REGISTRY as _METRICS
 
 __all__ = ["CheckpointManager", "latest_checkpoint", "LATEST_POINTER",
            "CKPT_PREFIX", "CKPT_SUFFIX"]
@@ -107,12 +109,31 @@ class CheckpointManager:
         interrupted save (even one that corrupted its own file) never
         changes what ``latest`` resolves to."""
         path = self.path_for(step)
+        t0 = time.perf_counter()
         fio.save(state, path)
+        t_save = time.perf_counter()
         fio.verify(path)
+        t_verify = time.perf_counter()
         fio.atomic_write_bytes(os.path.basename(path).encode(),
                                os.path.join(self.directory, LATEST_POINTER))
         self._rotate(keep_name=os.path.basename(path))
         self._sweep_stragglers()
+        if _METRICS.enabled:        # host-side telemetry (ISSUE 5)
+            t_publish = time.perf_counter()
+            _METRICS.counter("checkpoint.saves_total").inc()
+            _METRICS.histogram("checkpoint.save_secs", unit="s",
+                               desc="write+fsync+rename").record(
+                                   t_save - t0)
+            _METRICS.histogram("checkpoint.verify_secs", unit="s").record(
+                t_verify - t_save)
+            _METRICS.event(
+                "checkpoint", phase="save", step=int(step),
+                path=os.path.basename(path),
+                save_secs=round(t_save - t0, 6),
+                verify_secs=round(t_verify - t_save, 6),
+                publish_secs=round(t_publish - t_verify, 6),
+                total_secs=round(t_publish - t0, 6),
+                bytes=os.path.getsize(path))
         return path
 
     def restore(self, path: Optional[str] = None) -> Optional[Any]:

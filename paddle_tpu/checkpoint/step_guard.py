@@ -64,26 +64,41 @@ class StepGuard:
     once ``max_consecutive`` skips occur back to back.  ``scaler`` (an
     ``amp.GradScaler``) is optional — when present, each skip counts as a
     found-inf step (backing off the dynamic loss scale) and each good
-    step as a growth step."""
+    step as a growth step.
 
-    def __init__(self, max_consecutive: int = 50, scaler=None):
+    ``metrics`` (an ``observability.MetricsRegistry``, optional) routes
+    skip and loss-scale-backoff events through the telemetry layer —
+    previously these only surfaced as the terminal raise after
+    ``max_consecutive`` skips; with telemetry on, every skip is a
+    counter increment plus an event record the flight recorder keeps."""
+
+    def __init__(self, max_consecutive: int = 50, scaler=None,
+                 metrics=None):
         if max_consecutive < 1:
             raise ValueError("max_consecutive must be >= 1")
         self.max_consecutive = max_consecutive
         self.scaler = scaler
+        self.metrics = metrics
         self.consecutive = 0
         self.total_skipped = 0
+        self.total_backoffs = 0
 
     def record(self, skipped: bool, step: Optional[int] = None,
                loss: Any = None) -> None:
+        scale_before = None
         if self.scaler is not None and self.scaler.is_enable():
+            scale_before = self.scaler.get_loss_scaling()
             self.scaler._found_inf = bool(skipped)
             self.scaler.update()
+            if skipped and self.scaler.get_loss_scaling() < scale_before:
+                self.total_backoffs += 1
+                self._emit_backoff(step, scale_before)
         if not skipped:
             self.consecutive = 0
             return
         self.consecutive += 1
         self.total_skipped += 1
+        self._emit_skip(step, loss)
         if self.consecutive >= self.max_consecutive:
             where = f" at step {step}" if step is not None else ""
             lossmsg = f" (last loss: {loss})" if loss is not None else ""
@@ -96,10 +111,37 @@ class StepGuard:
                 "input data. Lower the LR / loss scale, or raise "
                 "max_consecutive_skips if spikes are expected.")
 
+    # -- telemetry (host-side; no-ops unless a registry is wired and
+    # enabled, so the guarded step path costs one attribute check) ------
+    def _emit_skip(self, step, loss) -> None:
+        m = self.metrics
+        if m is None or not m.enabled:
+            return
+        m.counter("train.skipped_steps_total",
+                  desc="non-finite steps skipped by the guard").inc()
+        m.gauge("train.consecutive_skips").set(self.consecutive)
+        m.event("step_skip", step=step,
+                loss=(None if loss is None else float(loss)),
+                consecutive=self.consecutive,
+                total_skipped=self.total_skipped)
+
+    def _emit_backoff(self, step, scale_before) -> None:
+        m = self.metrics
+        if m is None or not m.enabled:
+            return
+        scale = self.scaler.get_loss_scaling()
+        m.counter("train.scale_backoff_total",
+                  desc="dynamic loss-scale reductions").inc()
+        m.gauge("train.loss_scale").set(scale)
+        m.event("scale_backoff", step=step, scale_before=scale_before,
+                scale=scale)
+
     def state_dict(self) -> dict:
         return {"consecutive": self.consecutive,
-                "total_skipped": self.total_skipped}
+                "total_skipped": self.total_skipped,
+                "total_backoffs": self.total_backoffs}
 
     def load_state_dict(self, state: dict) -> None:
         self.consecutive = int(state.get("consecutive", 0))
         self.total_skipped = int(state.get("total_skipped", 0))
+        self.total_backoffs = int(state.get("total_backoffs", 0))

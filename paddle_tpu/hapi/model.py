@@ -60,6 +60,10 @@ class Model:
         self._step_guard = None
         self._skip_nonfinite = True
         self._preempted = False
+        # telemetry (observability/): None unless fit(observe=True) is
+        # live — the disabled step path pays exactly one `is None` check
+        self._telemetry = None
+        self._last_step_skipped = False
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -213,17 +217,16 @@ class Model:
         scale = (self._scaler.get_loss_scaling()
                  if self._scaler is not None and self._scaler.is_enable()
                  else 1.0)
-        import warnings
-        with warnings.catch_warnings():
-            # step 1 donates per-name opt state but returns FUSED (flat)
-            # state — those buffers legitimately can't be reused once;
-            # every later step aliases them in place
-            warnings.filterwarnings(
-                "ignore", message="Some donated buffers were not usable")
-            params, buffers, self._opt_state, loss_v, outs_v, notfin = \
-                self._jit_step(params, buffers, self._opt_state,
-                               self._step_count + 1, lr, rng, scale,
-                               inputs, labels)
+        if self._telemetry is not None:
+            # attribute any (re)compile of the step program to its label
+            with self._telemetry.compile_monitor.label("jit_train_step"):
+                params, buffers, loss_v, outs_v, notfin = \
+                    self._invoke_jit_step(params, buffers, lr, rng, scale,
+                                          inputs, labels)
+        else:
+            params, buffers, loss_v, outs_v, notfin = \
+                self._invoke_jit_step(params, buffers, lr, rng, scale,
+                                      inputs, labels)
         self._write_state(params, buffers)
         loss = float(np.asarray(loss_v))
         skipped = self._skip_nonfinite and bool(np.asarray(notfin))
@@ -239,7 +242,23 @@ class Model:
         metrics = self._update_metrics(outs_v, labels)
         return [loss], metrics
 
+    def _invoke_jit_step(self, params, buffers, lr, rng, scale, inputs,
+                         labels):
+        import warnings
+        with warnings.catch_warnings():
+            # step 1 donates per-name opt state but returns FUSED (flat)
+            # state — those buffers legitimately can't be reused once;
+            # every later step aliases them in place
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            params, buffers, self._opt_state, loss_v, outs_v, notfin = \
+                self._jit_step(params, buffers, self._opt_state,
+                               self._step_count + 1, lr, rng, scale,
+                               inputs, labels)
+        return params, buffers, loss_v, outs_v, notfin
+
     def _record_step_outcome(self, skipped: bool, loss: float) -> None:
+        self._last_step_skipped = skipped
         if self._step_guard is not None:
             self._step_guard.record(skipped, step=self._step_count + 1,
                                     loss=loss)
@@ -317,7 +336,9 @@ class Model:
             verbose: int = 2, drop_last: bool = False, shuffle: bool = True,
             num_workers: int = 0, callbacks=None, accumulate_grad_batches=1,
             num_iters: Optional[int] = None, device_prefetch: int = 0,
-            resume=None, keep_last: int = 5, async_save: bool = False):
+            resume=None, keep_last: int = 5, async_save: bool = False,
+            observe=False, observe_dir: Optional[str] = None,
+            flight_capacity: int = 256):
         """``save_dir`` additionally maintains rotating fault-tolerant
         checkpoints (checkpoint/CheckpointManager: atomic files, verified
         ``latest`` pointer, ``keep_last`` retention; ``async_save``
@@ -328,7 +349,20 @@ class Model:
         scale, step counters, and the sampler/RNG position, continuing
         bit-exact with the uninterrupted run.  While checkpointing is
         active a SIGTERM (preemption notice) flushes a final checkpoint
-        at the next batch boundary and raises TrainingPreempted."""
+        at the next batch boundary and raises TrainingPreempted.
+
+        ``observe=True`` lights up the runtime telemetry subsystem
+        (observability/): a JSONL metrics stream with per-step loss /
+        tokens-per-second / MFU, StepGuard skip and loss-scale-backoff
+        events, checkpoint save/verify latency, prefetch queue depth,
+        and jax compile/recompile counts — plus a crash flight recorder
+        that dumps the last ``flight_capacity`` events to disk when the
+        run dies (NonFiniteError, TrainingPreempted/SIGTERM, or any
+        other escaping exception).  Files land in ``observe_dir``
+        (default: ``<save_dir>/telemetry`` when ``save_dir`` is set,
+        else ``./telemetry``); ``observe`` may also BE the directory
+        path.  All recording is host-side; with ``observe`` left False
+        the step path does no telemetry work."""
         from ..io import DataLoader
         from ..io.dataset import Dataset
 
@@ -350,6 +384,11 @@ class Model:
             manager = CheckpointManager(save_dir, keep_last=keep_last)
             ckpt = AsyncCheckpointer(manager) if async_save else manager
 
+        session = None
+        if observe:
+            session = self._start_telemetry(observe, observe_dir,
+                                            save_dir, flight_capacity)
+
         start_epoch, skip_steps, resume_rng = self._apply_resume(
             resume, save_dir)
 
@@ -364,7 +403,8 @@ class Model:
                          "verbose": verbose,
                          "metrics": ["loss"] + self._metric_names()})
 
-        sig_state = self._install_sigterm(enabled=ckpt is not None)
+        sig_state = self._install_sigterm(
+            enabled=ckpt is not None or session is not None)
         cbks.on_train_begin()
         it = 0
         logs = {}
@@ -388,13 +428,30 @@ class Model:
                         continue
                     inputs, labels = self._unpack(batch)
                     cbks.on_train_batch_begin(step)
+                    if session is not None:
+                        t_step = time.perf_counter()
                     losses, metrics = self.train_batch(inputs, labels)
+                    if session is not None:
+                        self._emit_step_telemetry(
+                            session, losses[0],
+                            time.perf_counter() - t_step, inputs)
                     logs = self._make_logs(losses, metrics)
                     cbks.on_train_batch_end(step, logs)
                     it += 1
-                    if self._preempted and ckpt is not None:
-                        self._flush_preempt_checkpoint(
-                            ckpt, epoch, step + 1, rng_epoch_start)
+                    if self._preempted:
+                        if ckpt is not None:
+                            self._flush_preempt_checkpoint(
+                                ckpt, epoch, step + 1, rng_epoch_start)
+                        elif session is not None:
+                            # no checkpointing configured: the SIGTERM
+                            # contract is still "leave a black box" —
+                            # raising here reaches the dump below
+                            from ..checkpoint import TrainingPreempted
+                            raise TrainingPreempted(
+                                "SIGTERM received: no checkpoint "
+                                "directory configured; telemetry flight "
+                                "record dumped, training state NOT "
+                                "saved.")
                     if num_iters is not None and it >= num_iters:
                         break
                 cbks.on_epoch_end(epoch, logs)
@@ -410,11 +467,105 @@ class Model:
                 if num_iters is not None and it >= num_iters:
                     break
             cbks.on_train_end(logs)
+        except BaseException as e:
+            # crash flight recorder: NonFiniteError (step-guard abort),
+            # TrainingPreempted (the SIGTERM path), or anything else
+            # escaping the loop flushes the last N telemetry records.
+            # dedup_key keeps the session excepthook from re-dumping the
+            # same exception if it also goes unhandled.
+            if session is not None:
+                session.dump_flight(f"{type(e).__name__}: {e}",
+                                    dedup_key=id(e))
+            raise
         finally:
             self._restore_sigterm(sig_state)
             if ckpt is not None and hasattr(ckpt, "close"):
                 ckpt.close()
+            if session is not None:
+                self._telemetry = None
+                session.close()
         return self
+
+    # -- telemetry machinery (observability/) --------------------------
+    def _start_telemetry(self, observe, observe_dir, save_dir,
+                         flight_capacity):
+        """Open a TelemetrySession and wire it into the per-step path:
+        the compiled-step label for compile attribution, and the
+        StepGuard so skip/backoff events reach the registry."""
+        import os
+        from ..observability import TelemetrySession
+
+        directory = (observe_dir
+                     or (observe if isinstance(observe, str) else None)
+                     or (os.path.join(save_dir, "telemetry")
+                         if save_dir is not None else "telemetry"))
+        session = TelemetrySession(directory,
+                                   flight_capacity=flight_capacity)
+        self._telemetry = session
+        if self._step_guard is not None:
+            self._step_guard.metrics = session.registry
+        # cache what MFU needs so the per-step path does no discovery
+        self._tele_n_params = sum(
+            int(p.size) for p in self.network.parameters())
+        try:
+            import jax
+            from ..observability import peak_flops_per_chip
+            self._tele_peak_flops = peak_flops_per_chip(
+                jax.local_devices()[0])
+        except RuntimeError:        # backend init failure: MFU off
+            self._tele_peak_flops = 0.0
+        return session
+
+    @staticmethod
+    def _batch_items(inputs):
+        """(examples, items) for rate metrics: ``items`` counts tokens
+        (leading two dims) for 2-D+ integer inputs — the LM case —
+        else examples.  Shape/dtype are metadata reads; nothing here
+        syncs the device."""
+        if not inputs:
+            return 0, 0
+        x = inputs[0]
+        v = getattr(x, "_value", x)
+        shape = getattr(v, "shape", None)
+        if not shape:
+            return 1, 1
+        examples = int(shape[0])
+        dt = getattr(v, "dtype", None)
+        try:
+            is_int = dt is not None and np.issubdtype(dt, np.integer)
+        except TypeError:
+            is_int = False
+        if is_int and len(shape) >= 2:
+            return examples, examples * int(shape[1])
+        return examples, examples
+
+    def _emit_step_telemetry(self, session, loss, step_secs, inputs):
+        """One host-side record per trained batch: loss, rates, MFU,
+        guard state.  Runs AFTER train_batch's device sync (loss is
+        already a float), so it adds no extra device round-trip."""
+        reg = session.registry
+        examples, items = self._batch_items(inputs)
+        tokens_per_s = items / step_secs if step_secs > 0 else 0.0
+        mfu = (tokens_per_s * 6.0 * self._tele_n_params
+               / self._tele_peak_flops) if self._tele_peak_flops else 0.0
+        guard = self._step_guard
+        reg.counter("train.steps_total").inc()
+        reg.histogram("train.step_secs", unit="s").record(step_secs)
+        reg.gauge("train.loss").set(loss)
+        reg.gauge("train.tokens_per_s").set(round(tokens_per_s, 3))
+        if self._scaler is not None and self._scaler.is_enable():
+            reg.gauge("train.loss_scale").set(
+                self._scaler.get_loss_scaling())
+        reg.event(
+            "step", step=self._step_count, loss=loss,
+            step_secs=round(step_secs, 6),
+            examples_per_s=round(examples / step_secs, 3)
+            if step_secs > 0 else 0.0,
+            tokens_per_s=round(tokens_per_s, 3),
+            mfu=round(mfu, 8),
+            skipped=self._last_step_skipped,
+            consecutive_skips=(guard.consecutive if guard else 0),
+            skipped_total=(guard.total_skipped if guard else 0))
 
     # -- fault tolerance machinery (checkpoint/) -----------------------
     def _checkpoint_payload(self, epoch: int, step_in_epoch: int,

@@ -19,6 +19,7 @@ import numpy as np
 
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
+from ..observability import REGISTRY as _METRICS
 
 __all__ = ["DataLoader", "default_collate_fn", "device_prefetch_iterator"]
 
@@ -122,7 +123,14 @@ class _DevicePrefetcher:
                 return self._stage(item)
             except self._RETRYABLE:
                 if attempt >= self.STAGE_RETRIES or self._closed.is_set():
+                    if _METRICS.enabled:
+                        _METRICS.counter(
+                            "io.prefetch_stage_failures_total").inc()
                     raise
+                if _METRICS.enabled:
+                    _METRICS.counter("io.prefetch_retries_total",
+                                     desc="transient staging retries"
+                                     ).inc()
                 time.sleep(self.BACKOFF_BASE * (2 ** attempt))
                 attempt += 1
 
@@ -145,7 +153,19 @@ class _DevicePrefetcher:
     def __next__(self):
         if self._closed.is_set():
             raise StopIteration
-        item = self._q.get()
+        if _METRICS.enabled:
+            # queue depth BEFORE the blocking get: 0 here means the
+            # consumer is about to stall on the producer (prefetch is
+            # not keeping up); wait_secs measures that stall directly
+            import time as _time
+            _METRICS.gauge("io.prefetch_queue_depth").set(self._q.qsize())
+            t0 = _time.perf_counter()
+            item = self._q.get()
+            _METRICS.histogram("io.prefetch_wait_secs", unit="s",
+                               desc="consumer wait on staged batches"
+                               ).record(_time.perf_counter() - t0)
+        else:
+            item = self._q.get()
         if item is self._END:
             self.close()
             exc, self._exc = self._exc, None

@@ -1,0 +1,195 @@
+"""Compile/recompile telemetry via ``jax.monitoring``.
+
+jax emits per-phase duration events while building an executable —
+trace (python → jaxpr), lower (jaxpr → MLIR module), and compile
+(backend/XLA) — plus persistent-compilation-cache hit/miss counts.  A
+:class:`CompileMonitor` listens to all of them, keeps host-side
+aggregates, and (when wired to a registry) forwards each phase as a
+counter + histogram + event record, so per-step recompile churn (the
+failure mode PR 1 shipped with) is visible in the same JSONL stream as
+loss and checkpoint latency.
+
+Attribution: jax 0.4.37's duration events carry no function name, so
+the monitor supports a thread-local label (``with monitor.label("train_
+step"):``) that instrumented call sites set around their jitted calls;
+events recorded with a label accumulate per-label, and a label whose
+backend-compile count exceeds 1 is counted as a RECOMPILE.
+
+Listener lifecycle: jax only exposes ``register_*`` publicly, so
+``uninstall`` flips the monitor inert (the callback early-returns) and
+then best-effort removes the callback through the private listener list
+to avoid unbounded listener growth across sessions.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, Optional
+
+__all__ = ["CompileMonitor", "TRACE_EVENT", "LOWER_EVENT", "COMPILE_EVENT"]
+
+TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+LOWER_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_PHASES = {TRACE_EVENT: "trace", LOWER_EVENT: "lower",
+           COMPILE_EVENT: "compile"}
+
+
+class CompileMonitor:
+    """Aggregates jax compile telemetry; optionally forwards to a
+    :class:`~paddle_tpu.observability.registry.MetricsRegistry`."""
+
+    def __init__(self, registry=None):
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._active = False
+        self._installed = False
+        self.counts: Dict[str, int] = {"trace": 0, "lower": 0,
+                                       "compile": 0}
+        self.secs: Dict[str, float] = {"trace": 0.0, "lower": 0.0,
+                                       "compile": 0.0}
+        self.cache_hits = 0
+        self.cache_misses = 0
+        #: label -> {"compiles": n, "secs": s} (backend compiles only)
+        self.per_label: Dict[str, Dict[str, Any]] = {}
+
+    # -- label attribution ---------------------------------------------
+    @contextlib.contextmanager
+    def label(self, name: str):
+        """Attribute compile events fired on this thread to ``name``."""
+        prev = getattr(self._tls, "name", None)
+        self._tls.name = name
+        try:
+            yield self
+        finally:
+            self._tls.name = prev
+
+    def current_label(self) -> Optional[str]:
+        return getattr(self._tls, "name", None)
+
+    # -- jax.monitoring callbacks --------------------------------------
+    def _on_duration(self, event: str, duration: float, **kw) -> None:
+        if not self._active:
+            return
+        phase = _PHASES.get(event)
+        if phase is None:
+            return
+        label = self.current_label() or "<unlabeled>"
+        with self._lock:
+            self.counts[phase] += 1
+            self.secs[phase] += duration
+            if phase == "compile":
+                row = self.per_label.setdefault(
+                    label, {"compiles": 0, "secs": 0.0})
+                row["compiles"] += 1
+                row["secs"] += duration
+        reg = self._registry
+        if reg is not None and reg.enabled:
+            reg.counter(f"jax.{phase}_total",
+                        desc=f"jax {phase} phases entered").inc()
+            reg.histogram(f"jax.{phase}_secs", unit="s",
+                          desc=f"{phase} duration").record(duration)
+            reg.event("compile", phase=phase, secs=round(duration, 6),
+                      fn=label)
+
+    def _on_event(self, event: str, **kw) -> None:
+        if not self._active:
+            return
+        if event == CACHE_HIT_EVENT:
+            with self._lock:
+                self.cache_hits += 1
+            reg = self._registry
+            if reg is not None and reg.enabled:
+                reg.counter("jax.compile_cache_hits_total").inc()
+        elif event == CACHE_MISS_EVENT:
+            with self._lock:
+                self.cache_misses += 1
+            reg = self._registry
+            if reg is not None and reg.enabled:
+                reg.counter("jax.compile_cache_misses_total").inc()
+
+    # -- lifecycle ------------------------------------------------------
+    def install(self) -> "CompileMonitor":
+        """Start listening (idempotent)."""
+        if not self._installed:
+            from jax import monitoring as _mon
+            _mon.register_event_duration_secs_listener(self._on_duration)
+            _mon.register_event_listener(self._on_event)
+            self._installed = True
+        self._active = True
+        return self
+
+    def uninstall(self) -> None:
+        """Stop listening.  The callback goes inert immediately; the
+        registration itself is removed when jax's private listener list
+        is reachable (public API only grows the list)."""
+        self._active = False
+        if not self._installed:
+            return
+        try:
+            from jax._src import monitoring as _priv
+            dur = _priv._event_duration_secs_listeners
+            if self._on_duration in dur:
+                dur.remove(self._on_duration)
+            ev = _priv._event_listeners
+            if self._on_event in ev:
+                ev.remove(self._on_event)
+            self._installed = False
+        except (ImportError, AttributeError, ValueError):
+            # private layout moved: stay registered-but-inert
+            self._installed = True
+
+    # -- results --------------------------------------------------------
+    @property
+    def n_traces(self) -> int:
+        return self.counts["trace"]
+
+    @property
+    def n_compiles(self) -> int:
+        return self.counts["compile"]
+
+    @property
+    def compile_secs(self) -> float:
+        """End-to-end seconds spent building executables
+        (trace + lower + backend compile)."""
+        return self.secs["trace"] + self.secs["lower"] + \
+            self.secs["compile"]
+
+    def recompiles(self, label: Optional[str] = None) -> int:
+        """Backend compiles beyond the first per label — per-step
+        retrace churn shows up here."""
+        with self._lock:
+            rows = ([self.per_label.get(label)] if label is not None
+                    else list(self.per_label.values()))
+        return sum(max(0, r["compiles"] - 1) for r in rows
+                   if r is not None)
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "n_traces": self.counts["trace"],
+                "n_lowers": self.counts["lower"],
+                "n_compiles": self.counts["compile"],
+                "trace_secs": round(self.secs["trace"], 4),
+                "lower_secs": round(self.secs["lower"], 4),
+                "backend_compile_secs": round(self.secs["compile"], 4),
+                "compile_secs": round(self.secs["trace"]
+                                      + self.secs["lower"]
+                                      + self.secs["compile"], 4),
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+                "per_label": {k: dict(v)
+                              for k, v in self.per_label.items()},
+            }
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False

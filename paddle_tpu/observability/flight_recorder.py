@@ -1,0 +1,153 @@
+"""Crash flight recorder: a bounded in-memory ring of telemetry events,
+flushed to disk when training dies.
+
+The recorder is a registry SINK — every event (per-step loss/tokens-per-
+second records, StepGuard skips, checkpoint latencies, compile events,
+prefetch stalls) lands in a ``deque(maxlen=capacity)``, so steady-state
+memory is O(capacity) regardless of run length.  ``dump(reason)`` writes
+the last N records plus a full aggregate-metrics snapshot as one JSON
+file for post-mortem.
+
+Dump triggers (ISSUE 5): ``Model.fit`` dumps explicitly when
+``NonFiniteError`` / ``TrainingPreempted`` / any other exception escapes
+the train loop (this also covers the SIGTERM path — the preemption
+handler raises ``TrainingPreempted`` at the batch boundary); a
+``TelemetrySession`` additionally chains ``sys.excepthook`` so a crash
+outside ``fit`` still leaves a black box on disk.  Dumps are
+deduplicated per exception object so the excepthook does not re-dump
+what ``fit`` already flushed.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .sinks import _jsonable
+
+__all__ = ["FlightRecorder"]
+
+FORMAT_VERSION = 1
+
+
+class FlightRecorder:
+    """Bounded ring of event records with a one-call disk dump."""
+
+    def __init__(self, capacity: int = 256,
+                 directory: Optional[str] = None,
+                 registry=None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.directory = directory
+        self._registry = registry
+        self._ring: "collections.deque" = collections.deque(
+            maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._dump_seq = 0
+        self._last_dump_key: Optional[int] = None
+        self.dumps: List[str] = []
+        self._prev_excepthook = None
+        self._hook = None
+
+    # -- sink protocol --------------------------------------------------
+    def write(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            self._ring.append(record)
+
+    def record(self, kind: str, **fields) -> None:
+        rec = {"ts": round(time.time(), 6), "kind": kind}
+        rec.update(fields)
+        self.write(rec)
+
+    def last(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            records = list(self._ring)
+        return records if n is None else records[-n:]
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    # -- dump -----------------------------------------------------------
+    def dump(self, reason: str, path: Optional[str] = None,
+             dedup_key: Optional[int] = None) -> Optional[str]:
+        """Write the black box to ``path`` (default: a fresh
+        ``flightrec-<pid>-<seq>.json`` under ``directory``).  Returns the
+        path, or None when there is nowhere to write or ``dedup_key``
+        matches the previous dump (same exception observed twice, e.g.
+        by ``fit`` and then the excepthook)."""
+        with self._lock:
+            if dedup_key is not None and dedup_key == self._last_dump_key:
+                return None
+            if dedup_key is not None:
+                self._last_dump_key = dedup_key
+            records = list(self._ring)
+            self._dump_seq += 1
+            seq = self._dump_seq
+        if path is None:
+            if self.directory is None:
+                return None
+            path = os.path.join(
+                self.directory, f"flightrec-{os.getpid()}-{seq:03d}.json")
+        parent = os.path.dirname(os.path.abspath(path))
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        payload = {
+            "version": FORMAT_VERSION,
+            "reason": str(reason),
+            "dumped_at": round(time.time(), 6),
+            "pid": os.getpid(),
+            "capacity": self.capacity,
+            "n_records": len(records),
+            "records": [{k: _jsonable(v) for k, v in r.items()}
+                        for r in records],
+        }
+        if self._registry is not None:
+            payload["metrics"] = {
+                k: {kk: _jsonable(vv) for kk, vv in v.items()}
+                for k, v in self._registry.snapshot().items()}
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self.dumps.append(path)
+        return path
+
+    # -- crash hooks ----------------------------------------------------
+    def install_excepthook(self) -> None:
+        """Chain ``sys.excepthook``: dump on any unhandled exception,
+        then defer to the previous hook.  Idempotent."""
+        if self._prev_excepthook is not None:
+            return
+        prev = sys.excepthook
+
+        def hook(exc_type, exc, tb):
+            try:
+                self.dump(f"unhandled {exc_type.__name__}: {exc}",
+                          dedup_key=id(exc))
+            except OSError:
+                sys.stderr.write(
+                    "paddle_tpu.observability: flight-recorder dump "
+                    "failed during crash handling\n")
+            prev(exc_type, exc, tb)
+
+        self._prev_excepthook = prev
+        self._hook = hook
+        sys.excepthook = hook
+
+    def uninstall_excepthook(self) -> None:
+        """Restore the previous hook (only when ours is still the
+        active one — a later-installed hook wins)."""
+        if self._prev_excepthook is None:
+            return
+        if sys.excepthook is self._hook:
+            sys.excepthook = self._prev_excepthook
+        self._prev_excepthook = None
+        self._hook = None

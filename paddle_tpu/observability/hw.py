@@ -1,0 +1,44 @@
+"""Hardware peak-FLOPs table + MFU estimation (shared by Model.fit
+telemetry and the bench harness)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["peak_flops_per_chip", "estimate_mfu"]
+
+
+def peak_flops_per_chip(device) -> float:
+    """bf16 peak FLOP/s for a local accelerator device (TPU generations
+    by device_kind; non-TPU platforms get a nominal 1e12 so MFU stays a
+    comparable, clearly-approximate number on the CPU fallback)."""
+    kind = getattr(device, "device_kind", "").lower()
+    platform = getattr(device, "platform", "").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    if platform in ("tpu", "axon"):
+        return 197e12
+    return 1e12  # CPU fallback: nominal
+
+
+def estimate_mfu(items_per_sec: float, n_params: int,
+                 device=None, peak_flops: Optional[float] = None) -> float:
+    """Model-FLOPs utilization from the standard 6N FLOPs-per-token
+    approximation (fwd 2N + bwd 4N; attention term omitted — fit-level
+    telemetry does not know the sequence length, so this slightly
+    UNDER-estimates transformer MFU).  ``items`` are tokens for LM
+    training, samples otherwise."""
+    if peak_flops is None:
+        if device is None:
+            import jax
+            device = jax.local_devices()[0]
+        peak_flops = peak_flops_per_chip(device)
+    if peak_flops <= 0 or n_params <= 0:
+        return 0.0
+    return items_per_sec * 6.0 * float(n_params) / float(peak_flops)

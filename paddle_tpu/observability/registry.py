@@ -1,0 +1,366 @@
+"""Unified metrics registry: counters, gauges, histograms with bounded
+reservoirs.
+
+Design constraints (ISSUE 5):
+
+* **Host-side only.**  Every instrument mutates plain Python state under
+  a small lock — never call these from inside a traced/jit region (a
+  metrics call there is a TL001 host-sync hazard by construction; the
+  tracelint ratchet enforces zero TL001 findings for this package).
+* **Thread-safe.**  The async checkpoint writer, the device prefetcher,
+  and the training thread all record concurrently; counters must not
+  lose increments and histogram reservoirs must stay bounded.
+* **Zero cost when disabled.**  Every recording entry point checks one
+  boolean attribute and returns before touching locks or allocating
+  registry state, so a run without ``observe=True`` pays one branch per
+  instrumented site.  Hot loops additionally cache ``registry.enabled``
+  (or a ``None`` telemetry handle) so the disabled step path does no
+  per-step work at all.
+
+Aggregates (counter/gauge/histogram) answer "what is the rate/latency
+now"; the :meth:`MetricsRegistry.event` stream feeds sinks (JSONL file,
+flight-recorder ring) with discrete records for post-mortem timelines.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY"]
+
+
+class _Instrument:
+    """Common bits: identity, lock, and the enabled fast path."""
+
+    __slots__ = ("name", "unit", "desc", "_lock", "_registry")
+
+    kind = "instrument"
+
+    def __init__(self, name: str, unit: str = "", desc: str = "",
+                 registry: Optional["MetricsRegistry"] = None):
+        self.name = name
+        self.unit = unit
+        self.desc = desc
+        self._lock = threading.Lock()
+        self._registry = registry
+
+    def _off(self) -> bool:
+        reg = self._registry
+        return reg is not None and not reg.enabled
+
+
+class Counter(_Instrument):
+    """Monotonically increasing count (events, retries, skips)."""
+
+    __slots__ = ("_value",)
+
+    kind = "counter"
+
+    def __init__(self, name: str, unit: str = "", desc: str = "",
+                 registry: Optional["MetricsRegistry"] = None):
+        super().__init__(name, unit, desc, registry)
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if self._off():
+            return
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"value": self._value}
+
+
+class Gauge(_Instrument):
+    """Last-written value (queue depth, loss scale, current loss)."""
+
+    __slots__ = ("_value",)
+
+    kind = "gauge"
+
+    def __init__(self, name: str, unit: str = "", desc: str = "",
+                 registry: Optional["MetricsRegistry"] = None):
+        super().__init__(name, unit, desc, registry)
+        self._value: Optional[float] = None
+
+    def set(self, v: float) -> None:
+        if self._off():
+            return
+        # single attribute store: atomic under the GIL, no lock needed
+        self._value = v
+
+    @property
+    def value(self) -> Optional[float]:
+        return self._value
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"value": self._value}
+
+
+class Histogram(_Instrument):
+    """Latency/size distribution with a BOUNDED reservoir.
+
+    Exact count/sum/min/max plus an Algorithm-R uniform sample of at
+    most ``reservoir`` values for percentile estimates — memory stays
+    O(reservoir) no matter how many observations arrive.  The sampler's
+    RNG is seeded from the metric name so runs are reproducible (and so
+    nothing here touches global random state)."""
+
+    __slots__ = ("_count", "_sum", "_min", "_max", "_sample", "_cap",
+                 "_rng")
+
+    kind = "histogram"
+
+    def __init__(self, name: str, unit: str = "", desc: str = "",
+                 registry: Optional["MetricsRegistry"] = None,
+                 reservoir: int = 512):
+        super().__init__(name, unit, desc, registry)
+        if reservoir < 1:
+            raise ValueError(f"reservoir must be >= 1, got {reservoir}")
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._sample: List[float] = []
+        self._cap = int(reservoir)
+        self._rng = random.Random(zlib.crc32(name.encode()))
+
+    def record(self, v: float) -> None:
+        if self._off():
+            return
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            if len(self._sample) < self._cap:
+                self._sample.append(v)
+            else:
+                j = self._rng.randrange(self._count)
+                if j < self._cap:
+                    self._sample[j] = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def reservoir_len(self) -> int:
+        return len(self._sample)
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Estimate the q-th percentile (q in [0, 100]) from the
+        reservoir; None when nothing has been recorded."""
+        with self._lock:
+            sample = sorted(self._sample)
+        if not sample:
+            return None
+        idx = min(len(sample) - 1,
+                  max(0, int(round(q / 100.0 * (len(sample) - 1)))))
+        return sample[idx]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            sample = sorted(self._sample)
+            count, total = self._count, self._sum
+            lo, hi = self._min, self._max
+
+        def pct(q):
+            if not sample:
+                return None
+            return sample[min(len(sample) - 1,
+                              max(0, int(round(q / 100.0
+                                               * (len(sample) - 1)))))]
+
+        return {"count": count, "sum": total,
+                "min": (None if count == 0 else lo),
+                "max": (None if count == 0 else hi),
+                "mean": (total / count if count else None),
+                "p50": pct(50), "p95": pct(95), "p99": pct(99)}
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Names → instruments, plus the event stream fan-out to sinks.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create (idempotent
+    per name; a kind clash raises).  ``event(kind, **fields)`` stamps a
+    wall-clock timestamp and hands the record to every attached sink —
+    when disabled it returns before building the record."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = bool(enabled)
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, _Instrument] = {}
+        # replaced wholesale under _lock, read without it (atomic ref)
+        self._sinks: Tuple[Any, ...] = ()
+
+    # -- lifecycle ------------------------------------------------------
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        """Drop every instrument and sink (tests / bench isolation)."""
+        with self._lock:
+            self._metrics.clear()
+            self._sinks = ()
+
+    # -- instruments ----------------------------------------------------
+    def _get_or_create(self, cls, name: str, unit: str, desc: str,
+                       **kw) -> _Instrument:
+        m = self._metrics.get(name)
+        if m is not None:
+            if not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, unit, desc, registry=self, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name: str, unit: str = "", desc: str = "") -> Counter:
+        return self._get_or_create(Counter, name, unit, desc)
+
+    def gauge(self, name: str, unit: str = "", desc: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, unit, desc)
+
+    def histogram(self, name: str, unit: str = "", desc: str = "",
+                  reservoir: int = 512) -> Histogram:
+        return self._get_or_create(Histogram, name, unit, desc,
+                                   reservoir=reservoir)
+
+    def get(self, name: str) -> Optional[_Instrument]:
+        return self._metrics.get(name)
+
+    def metrics(self) -> List[_Instrument]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    # -- event stream ---------------------------------------------------
+    def add_sink(self, sink) -> None:
+        """``sink`` needs a ``write(record: dict)`` method; ``flush`` /
+        ``close`` are honored when present."""
+        with self._lock:
+            if sink not in self._sinks:
+                self._sinks = self._sinks + (sink,)
+
+    def remove_sink(self, sink) -> None:
+        with self._lock:
+            self._sinks = tuple(s for s in self._sinks if s is not sink)
+
+    @property
+    def sinks(self) -> Tuple[Any, ...]:
+        return self._sinks
+
+    def event(self, kind: str, **fields) -> None:
+        """Emit one discrete record to every sink (JSONL line, flight-
+        recorder ring entry).  No-op (no allocation of registry state,
+        no lock) when disabled."""
+        if not self.enabled:
+            return
+        sinks = self._sinks
+        if not sinks:
+            return
+        rec = {"ts": round(time.time(), 6), "kind": kind}
+        rec.update(fields)
+        for s in sinks:
+            s.write(rec)
+
+    def flush(self) -> None:
+        for s in self._sinks:
+            fl = getattr(s, "flush", None)
+            if fl is not None:
+                fl()
+
+    # -- export ---------------------------------------------------------
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """{name: {kind, unit, ...stats}} for every instrument — the
+        blob the flight recorder embeds in its dump."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for m in self.metrics():
+            d = {"kind": m.kind, "unit": m.unit}
+            d.update(m.snapshot())
+            out[m.name] = d
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text-format dump of every instrument (counters and
+        gauges verbatim; histograms as summary-style quantiles plus
+        ``_count``/``_sum``)."""
+        lines: List[str] = []
+        for m in self.metrics():
+            pname = _prom_name(m.name)
+            if m.kind == "counter":
+                lines.append(f"# TYPE {pname} counter")
+                lines.append(f"{pname} {_prom_value(m.value)}")
+            elif m.kind == "gauge":
+                if m.value is None:
+                    continue
+                lines.append(f"# TYPE {pname} gauge")
+                lines.append(f"{pname} {_prom_value(m.value)}")
+            else:
+                snap = m.snapshot()
+                lines.append(f"# TYPE {pname} summary")
+                for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                    v = snap[key]
+                    if v is not None:
+                        lines.append(
+                            f'{pname}{{quantile="{q}"}} {_prom_value(v)}')
+                lines.append(f"{pname}_count {snap['count']}")
+                lines.append(f"{pname}_sum {_prom_value(snap['sum'])}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _prom_name(name: str) -> str:
+    safe = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    if not safe or safe[0].isdigit():
+        safe = "_" + safe
+    return "paddle_tpu_" + safe
+
+
+def _prom_value(v: float) -> str:
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"                # a NaN loss gauge must not kill the dump
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+#: process-wide default registry — the one instrumented framework sites
+#: (Model.fit, CheckpointManager, _DevicePrefetcher, StepGuard,
+#: profiler.RecordEvent) record into.  Disabled until a
+#: TelemetrySession (or a caller) enables it.
+REGISTRY = MetricsRegistry(enabled=False)

@@ -1,0 +1,132 @@
+"""TelemetrySession — the single ``observe=True`` knob.
+
+One object that turns the whole measurement layer on: enables the
+default registry, attaches a JSONL sink and the flight-recorder ring,
+installs the jax compile listener, and (optionally) chains the crash
+excepthook.  ``close()`` unwinds everything and restores the registry's
+prior enabled state, so sessions nest safely and tests cannot leak
+global telemetry state.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .compile_monitor import CompileMonitor
+from .flight_recorder import FlightRecorder
+from .registry import REGISTRY, MetricsRegistry
+from .sinks import JsonlSink, write_prometheus
+
+__all__ = ["TelemetrySession", "observe"]
+
+METRICS_FILENAME = "metrics.jsonl"
+PROM_FILENAME = "metrics.prom"
+
+
+class TelemetrySession:
+    """Wires registry + sinks + flight recorder + compile monitor.
+
+    Parameters
+    ----------
+    directory:
+        Where the JSONL stream, flight-recorder dumps, and the
+        Prometheus text dump land.  Created on demand.
+    registry:
+        Defaults to the process-wide :data:`REGISTRY` (which is what the
+        instrumented framework sites record into).
+    flight_capacity:
+        Ring size — how many trailing events a crash dump preserves.
+    jsonl / crash_hooks / prom_on_close:
+        Feature toggles for the file stream, the ``sys.excepthook``
+        chain, and the Prometheus dump written at ``close()``.
+    """
+
+    def __init__(self, directory: str,
+                 registry: Optional[MetricsRegistry] = None,
+                 flight_capacity: int = 256, jsonl: bool = True,
+                 crash_hooks: bool = True, prom_on_close: bool = True):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.registry = REGISTRY if registry is None else registry
+        self._prom_on_close = prom_on_close
+        self._closed = False
+
+        self.jsonl: Optional[JsonlSink] = None
+        if jsonl:
+            # buffered: crash durability comes from the flight-recorder
+            # dump (fsync'd), not from flushing the stream per record —
+            # a per-line flush costs a syscall on every step
+            self.jsonl = JsonlSink(
+                os.path.join(self.directory, METRICS_FILENAME),
+                flush_every=32)
+            self.registry.add_sink(self.jsonl)
+
+        self.flight = FlightRecorder(capacity=flight_capacity,
+                                     directory=self.directory,
+                                     registry=self.registry)
+        self.registry.add_sink(self.flight)
+        if crash_hooks:
+            self.flight.install_excepthook()
+
+        self.compile_monitor = CompileMonitor(self.registry)
+        self.compile_monitor.install()
+
+        self._was_enabled = self.registry.enabled
+        self.registry.enable()
+        self.registry.event("session", phase="start",
+                            directory=self.directory)
+
+    # ------------------------------------------------------------------
+    def event(self, kind: str, **fields) -> None:
+        self.registry.event(kind, **fields)
+
+    def metrics_path(self) -> Optional[str]:
+        return self.jsonl.path if self.jsonl is not None else None
+
+    def dump_flight(self, reason: str, dedup_key: Optional[int] = None
+                    ) -> Optional[str]:
+        path = self.flight.dump(reason, dedup_key=dedup_key)
+        if self.jsonl is not None:
+            self.jsonl.flush()      # complete the stream for post-mortem
+        return path
+
+    def write_prometheus(self, path: Optional[str] = None) -> str:
+        return write_prometheus(
+            self.registry,
+            path or os.path.join(self.directory, PROM_FILENAME))
+
+    # ------------------------------------------------------------------
+    def close(self) -> None:
+        """Flush + detach everything; idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self.registry.event("session", phase="end")
+        self.compile_monitor.uninstall()
+        self.flight.uninstall_excepthook()
+        if self._prom_on_close:
+            try:
+                self.write_prometheus()
+            except OSError:
+                pass  # telemetry teardown must not mask the run's result
+        self.registry.remove_sink(self.flight)
+        if self.jsonl is not None:
+            self.registry.remove_sink(self.jsonl)
+            self.jsonl.close()
+        if not self._was_enabled:
+            self.registry.disable()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def observe(directory: str = "telemetry", **kw) -> TelemetrySession:
+    """Convenience constructor: ``with observability.observe("runs/t1")
+    as obs: ...`` lights up the registry, JSONL stream, flight recorder,
+    and compile monitor in one call."""
+    return TelemetrySession(directory, **kw)

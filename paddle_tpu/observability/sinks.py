@@ -1,0 +1,104 @@
+"""Event-stream sinks for the metrics registry.
+
+A sink is anything with ``write(record: dict)``; ``flush``/``close``
+are optional.  Sinks must tolerate concurrent writers (the registry
+fans out from the training thread, the async checkpoint writer, and
+the prefetcher thread).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+__all__ = ["JsonlSink", "MemorySink", "write_prometheus"]
+
+
+def _jsonable(obj):
+    """Best-effort coercion so a stray numpy scalar/array in an event
+    record cannot kill the telemetry stream."""
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        item = getattr(obj, "item", None)
+        if item is not None:
+            try:
+                return item()
+            except (TypeError, ValueError):
+                return str(obj)
+        return str(obj)
+
+
+class JsonlSink:
+    """Append-only JSON-lines file: one record per line, flushed every
+    ``flush_every`` writes (1 = every record survives a crash at the
+    cost of a syscall per event)."""
+
+    def __init__(self, path: str, flush_every: int = 1):
+        self.path = os.path.abspath(path)
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._flush_every = max(1, int(flush_every))
+        self._lock = threading.Lock()
+        self._since_flush = 0
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    def write(self, record: Dict[str, Any]) -> None:
+        try:
+            line = json.dumps(record)
+        except (TypeError, ValueError):
+            # rare path: a numpy scalar/array leaked into the record
+            line = json.dumps({k: _jsonable(v)
+                               for k, v in record.items()})
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(line + "\n")
+            self._since_flush += 1
+            if self._since_flush >= self._flush_every:
+                self._f.flush()
+                self._since_flush = 0
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
+
+
+class MemorySink:
+    """In-memory record list (tests and the bench harness, which reads
+    its own row back instead of re-parsing a file)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.records: List[Dict[str, Any]] = []
+
+    def write(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            self.records.append(record)
+
+    def by_kind(self, kind: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r for r in self.records if r.get("kind") == kind]
+
+
+def write_prometheus(registry, path: str) -> str:
+    """Dump ``registry`` as a Prometheus text-format file (node-exporter
+    textfile-collector style); returns the path."""
+    parent = os.path.dirname(os.path.abspath(path))
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    text = registry.prometheus_text()
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(text)
+    return path

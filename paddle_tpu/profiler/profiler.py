@@ -9,6 +9,8 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
+from ..observability import REGISTRY as _METRICS
+
 __all__ = ["ProfilerTarget", "ProfilerState", "make_scheduler",
            "RecordEvent", "record_function", "Profiler",
            "export_chrome_tracing", "load_profiler_result",
@@ -117,10 +119,16 @@ class RecordEvent:
             self._annot.__exit__(None, None, None)
             self._annot = None
         if self._begin is not None:
-            _RECORDER.add(_HostEvent(self.name, self._begin,
-                                     time.perf_counter(),
+            now = time.perf_counter()
+            _RECORDER.add(_HostEvent(self.name, self._begin, now,
                                      threading.get_ident(),
                                      self.event_type))
+            if _METRICS.enabled:
+                # spans feed the same registry the rest of the telemetry
+                # layer uses (ISSUE 5: one observe=True knob) — aggregate
+                # histogram only, the event stream stays step-granular
+                _METRICS.histogram(f"profiler.span_secs.{self.name}",
+                                   unit="s").record(now - self._begin)
             self._begin = None
 
     def __enter__(self):
@@ -146,10 +154,14 @@ def record_function(name: str):
 
 
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
-    """on_trace_ready callback writing chrome://tracing JSON."""
+    """on_trace_ready callback writing chrome://tracing JSON.  Parent
+    directories are (re)created at EXPORT time, not just when the
+    factory runs — the profile dir may not exist yet, or may have been
+    cleaned between cycles."""
     os.makedirs(dir_name, exist_ok=True)
 
     def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"host_{os.getpid()}"
         path = os.path.join(
             dir_name, f"{name}_time_{int(time.time())}.paddle_trace.json")
@@ -317,9 +329,20 @@ class Profiler:
             "ts": ev.start * 1e6, "dur": ev.duration * 1e6,
             "pid": os.getpid(), "tid": ev.tid,
         } for ev in evs]
+        # a zero-event capture must still yield a loadable trace:
+        # chrome://tracing rejects files without any event/metadata
+        # entries, so always carry the process_name metadata row
+        meta = [{"name": "process_name", "ph": "M", "pid": os.getpid(),
+                 "args": {"name": "host"}}]
+        parent = os.path.dirname(os.path.abspath(path))
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         with open(path, "w") as f:
-            json.dump({"traceEvents": events,
+            json.dump({"traceEvents": meta + events,
                        "displayTimeUnit": "ms"}, f)
+        if _METRICS.enabled:
+            _METRICS.counter("profiler.trace_exports_total").inc()
+            _METRICS.event("trace_export", path=path, n_events=len(events))
         return path
 
     def export(self, path: str, format: str = "json"):

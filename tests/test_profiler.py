@@ -71,6 +71,57 @@ class TestProfiler:
         assert any(e.name == "decorated" for e in p.events())
 
 
+class TestChromeExportRegressions:
+    """ISSUE 5 satellites: a zero-event capture must still export a
+    loadable chrome trace, and exports must create parent directories."""
+
+    def test_empty_capture_exports_valid_trace(self, tmp_path):
+        p = prof.Profiler()
+        p.start()
+        p.stop()                                # nothing recorded
+        out = str(tmp_path / "empty.json")
+        p.export(out)
+        data = json.loads(open(out).read())
+        assert isinstance(data["traceEvents"], list)
+        # the metadata row keeps chrome://tracing happy on zero events
+        assert any(e.get("ph") == "M" for e in data["traceEvents"])
+        assert data["displayTimeUnit"] == "ms"
+
+    def test_export_creates_parent_dirs(self, tmp_path):
+        p = prof.Profiler()
+        with p:
+            with prof.RecordEvent("deep_scope"):
+                pass
+            p.step()
+        out = str(tmp_path / "a" / "b" / "c" / "trace.json")
+        p.export(out)
+        data = json.loads(open(out).read())
+        assert any(e["name"] == "deep_scope" for e in data["traceEvents"])
+
+    def test_handler_recreates_deleted_dir(self, tmp_path):
+        import shutil
+        d = tmp_path / "gone"
+        handler = prof.export_chrome_tracing(str(d))
+        shutil.rmtree(d)                        # dir vanished after factory
+        p = prof.Profiler(on_trace_ready=handler)
+        with p:
+            with prof.RecordEvent("scope_b"):
+                pass
+            p.step()
+        assert list(d.glob("*.json")), "handler did not recreate the dir"
+
+    def test_record_event_feeds_metrics_registry(self):
+        from paddle_tpu.observability import REGISTRY
+        REGISTRY.enable()
+        try:
+            with prof.RecordEvent("telemetry_scope"):
+                pass
+        finally:
+            REGISTRY.disable()
+        h = REGISTRY.histogram("profiler.span_secs.telemetry_scope")
+        assert h.count >= 1
+
+
 class TestDebugging:
     def test_check_numerics_ok(self):
         x = pt.to_tensor(np.array([1.0, 2.0, 0.0], np.float32))
