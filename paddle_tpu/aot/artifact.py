@@ -1,0 +1,354 @@
+"""Versioned compile-artifact store (ISSUE 6).
+
+An artifact directory holds XLA executables serialized AHEAD of time —
+the jitted train step and the serving engine's decode / chunked-prefill
+steps — so a fleet restart deserializes a ready-to-run program instead
+of paying trace+lower+backend-compile per process.  Layout:
+
+    <dir>/manifest.json      versioned manifest (atomic publish)
+    <dir>/<name>.xbin        one pickled (payload, in_tree, out_tree)
+                             per executable, CRC32'd in the manifest
+
+The manifest records everything that makes an executable UNSAFE to
+reuse somewhere else: jax/jaxlib versions and backend platform (XLA
+executables are not portable across either), a caller-supplied config
+hash (model/engine geometry), each executable's input signature, its
+donation signature, and the declared shape buckets.  ``load`` verifies
+all of it and raises a typed :class:`AotError` subclass on any
+mismatch — callers fall back to a fresh compile (with a telemetry
+event) rather than run a wrong or corrupt program.
+
+Donation gate: jax 0.4.37's XLA:CPU client mis-executes programs with
+donated buffers when they are DESERIALIZED rather than freshly compiled
+(flaky param corruption / SIGSEGV — found and documented in ISSUE 2
+against the persistent compilation cache, which round-trips executables
+through the same serialize path).  :func:`donation_deserialize_safe`
+encodes the known-bad (platform, jax version) set; ``load`` refuses a
+donated artifact on an unsafe platform instead of risking silent
+corruption.  Exporters on such platforms should compile undonated
+(numerics are identical; the cost is double-buffering the donated
+operands).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import pickle
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from ..core import jax_compat  # noqa: F401  (binds jax.export et al.)
+
+__all__ = [
+    "AotError", "AotArtifactCorruptError", "AotManifestMismatchError",
+    "AotDonationError", "ArtifactStore", "environment_fingerprint",
+    "donation_deserialize_safe", "config_hash", "args_signature",
+    "fresh_backend_compile", "MANIFEST_MAGIC",
+]
+
+MANIFEST_MAGIC = "paddle_tpu.aot.v1"
+_MANIFEST = "manifest.json"
+
+#: (platform, jax.__version__) pairs where deserialized DONATED
+#: executables are known to mis-execute (ISSUE 2 / CHANGES PR 2).
+KNOWN_BAD_DONATED_DESERIALIZE = {("cpu", "0.4.37")}
+
+
+class AotError(RuntimeError):
+    """Base: an AOT artifact cannot be used; fall back to fresh compile."""
+
+
+class AotArtifactCorruptError(AotError):
+    """Artifact payload or manifest is truncated, unreadable, or fails
+    its CRC — the directory should be re-exported."""
+
+
+class AotManifestMismatchError(AotError):
+    """The artifact was built for a different environment/config
+    (jax/jaxlib version skew, different platform, changed model geometry,
+    missing executable).  Not corruption — just not OURS."""
+
+
+class AotDonationError(AotError):
+    """A donated executable was refused on a platform where deserialized
+    donated programs are known to mis-execute (jax-0.4.37 XLA:CPU)."""
+
+
+def environment_fingerprint() -> Dict[str, str]:
+    """Everything an XLA executable is specialized to besides its
+    inputs: jax/jaxlib versions and the backend platform."""
+    import jaxlib
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": jax.default_backend(),
+    }
+
+
+def donation_deserialize_safe(platform: Optional[str] = None,
+                              jax_version: Optional[str] = None) -> bool:
+    """True when a DESERIALIZED executable with donated buffers is safe
+    to run here (see module docstring / KNOWN_BAD_DONATED_DESERIALIZE)."""
+    platform = platform or jax.default_backend()
+    jax_version = jax_version or jax.__version__
+    return (platform, jax_version) not in KNOWN_BAD_DONATED_DESERIALIZE
+
+
+@contextlib.contextmanager
+def fresh_backend_compile():
+    """Disable jax's persistent compilation cache for the duration.
+
+    Serializing an executable that ``compile()`` LOADED from the
+    persistent cache (rather than freshly built) yields a payload that
+    fails to deserialize on XLA:CPU with ``Symbols not found: [...]``
+    — the round-trip through the cache drops the jitted aux functions.
+    Every export path compiles inside this guard so the serialized
+    artifact always comes from a fresh backend compile; the in-memory
+    jit caches are untouched.
+
+    Clearing the config flag alone is NOT enough on jax 0.4.37:
+    ``compilation_cache.is_cache_used`` memoizes its decision in module
+    globals at the first compile of the process, so a process that ever
+    compiled with the cache enabled keeps using it regardless of the
+    flag.  ``reset_cache()`` drops only that in-memory memo (the disk
+    cache is untouched); we reset on entry so the disabled flag is
+    re-read, and on exit so later compiles re-enable the cache."""
+    import jax as _jax
+    from jax._src import compilation_cache as _cc
+    prev = _jax.config.jax_compilation_cache_dir
+    try:
+        _jax.config.update("jax_compilation_cache_dir", None)
+        _cc.reset_cache()
+        yield
+    finally:
+        _jax.config.update("jax_compilation_cache_dir", prev)
+        _cc.reset_cache()
+
+
+def config_hash(config: Dict[str, Any]) -> str:
+    """Stable digest of a JSON-able config dict."""
+    blob = json.dumps(config, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _leaf_sig(x) -> List:
+    shape = getattr(x, "shape", None)
+    if shape is None:
+        return [[], type(x).__name__]
+    return [list(shape), str(getattr(x, "dtype", "?"))]
+
+
+def args_signature(args: Tuple) -> Tuple[str, List]:
+    """(treedef-str, per-leaf [shape, dtype]) for a call-args tuple —
+    cheap (no tracing), used both at export time (recorded in the
+    manifest) and at load/dispatch time (matched against it)."""
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return str(treedef), [_leaf_sig(v) for v in leaves]
+
+
+def _sig_matches(entry_sig, args) -> bool:
+    td, leaves = args_signature(args)
+    return entry_sig == [td, leaves] or tuple(entry_sig) == (td, leaves)
+
+
+class ArtifactStore:
+    """One artifact directory: a CRC'd manifest plus serialized
+    executables, written atomically (framework.io durability seams) and
+    verified on read.
+
+    ``registry`` (an observability MetricsRegistry; defaults to the
+    process-wide REGISTRY) receives ``aot`` events for loads and
+    refusals so warm-start behavior shows up in the same stream as
+    compile telemetry."""
+
+    def __init__(self, directory: str, registry=None):
+        self.directory = directory
+        if registry is None:
+            from ..observability import REGISTRY
+            registry = REGISTRY
+        self._registry = registry
+        self._manifest: Optional[Dict[str, Any]] = None
+
+    # -- telemetry -----------------------------------------------------
+    def _event(self, action: str, **kw) -> None:
+        reg = self._registry
+        if reg is not None and reg.enabled:
+            reg.counter(f"aot.{action}_total").inc()
+            reg.event("aot", action=action, dir=self.directory, **kw)
+
+    # -- write side ----------------------------------------------------
+    def begin(self, *, config: Dict[str, Any],
+              buckets: Optional[Dict[str, Any]] = None) -> "ArtifactStore":
+        """Start a fresh manifest for this export run."""
+        self._manifest = {
+            "magic": MANIFEST_MAGIC,
+            "version": 1,
+            "env": environment_fingerprint(),
+            "config": config,
+            "config_hash": config_hash(config),
+            "buckets": buckets,
+            "executables": {},
+        }
+        return self
+
+    def put(self, name: str, compiled, example_args: Tuple, *,
+            donate_argnums: Tuple[int, ...] = ()) -> None:
+        """Serialize one compiled executable (``jax.jit(f).lower(*args)
+        .compile()``) under ``name``.  ``example_args`` must be the
+        exact call signature the executable was compiled for — its
+        signature is recorded so loaders can dispatch without a failed
+        call."""
+        if self._manifest is None:
+            raise AotError("ArtifactStore.put before begin()")
+        from jax.experimental import serialize_executable as se
+        payload, in_tree, out_tree = se.serialize(compiled)
+        blob = pickle.dumps((payload, in_tree, out_tree))
+        from ..framework.io import atomic_write_bytes
+        os.makedirs(self.directory, exist_ok=True)
+        fname = f"{name}.xbin"
+        atomic_write_bytes(blob, os.path.join(self.directory, fname))
+        td, leaves = args_signature(example_args)
+        self._manifest["executables"][name] = {
+            "file": fname,
+            "crc32": zlib.crc32(blob),
+            "size": len(blob),
+            "donate_argnums": list(donate_argnums),
+            "in_sig": [td, leaves],
+        }
+        self._flush()
+
+    def _flush(self) -> None:
+        from ..framework.io import atomic_write_bytes
+        os.makedirs(self.directory, exist_ok=True)
+        atomic_write_bytes(
+            json.dumps(self._manifest, indent=1, default=str).encode(),
+            os.path.join(self.directory, _MANIFEST))
+
+    # -- read side -----------------------------------------------------
+    def exists(self) -> bool:
+        return os.path.exists(os.path.join(self.directory, _MANIFEST))
+
+    def manifest(self) -> Dict[str, Any]:
+        """Parse + structurally validate the manifest (cached)."""
+        if self._manifest is not None:
+            return self._manifest
+        path = os.path.join(self.directory, _MANIFEST)
+        try:
+            with open(path, "rb") as f:
+                m = json.loads(f.read())
+        except FileNotFoundError:
+            raise AotManifestMismatchError(
+                f"{self.directory}: no AOT manifest")
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise AotArtifactCorruptError(
+                f"{path}: manifest unreadable: {e}") from e
+        if m.get("magic") != MANIFEST_MAGIC:
+            raise AotManifestMismatchError(
+                f"{path}: not a {MANIFEST_MAGIC} manifest "
+                f"(magic={m.get('magic')!r})")
+        if not isinstance(m.get("executables"), dict):
+            raise AotArtifactCorruptError(
+                f"{path}: manifest has no executables table")
+        self._manifest = m
+        return m
+
+    def check_env(self) -> None:
+        """Version/platform skew gate: an executable compiled by another
+        jax/jaxlib or for another backend must never be deserialized."""
+        want = self.manifest().get("env") or {}
+        have = environment_fingerprint()
+        drift = {k: (want.get(k), have[k]) for k in have
+                 if want.get(k) != have[k]}
+        if drift:
+            raise AotManifestMismatchError(
+                f"{self.directory}: environment skew {drift} — artifacts "
+                "must be re-exported for this environment")
+
+    def check_config(self, config: Dict[str, Any]) -> None:
+        m = self.manifest()
+        want = config_hash(config)
+        if m.get("config_hash") != want:
+            raise AotManifestMismatchError(
+                f"{self.directory}: config hash {m.get('config_hash')!r} "
+                f"!= expected {want!r} (model/engine geometry changed)")
+
+    def buckets(self) -> Optional[Dict[str, Any]]:
+        return self.manifest().get("buckets")
+
+    def entry(self, name: str) -> Dict[str, Any]:
+        entry = self.manifest()["executables"].get(name)
+        if entry is None:
+            raise AotManifestMismatchError(
+                f"{self.directory}: no executable {name!r} in manifest")
+        return entry
+
+    def matches_signature(self, name: str, args: Tuple) -> bool:
+        """Does ``name``'s recorded input signature match ``args``?"""
+        return _sig_matches(self.entry(name)["in_sig"], args)
+
+    def get(self, name: str, *, allow_donated: Optional[bool] = None
+            ) -> Callable:
+        """CRC-verify, donation-gate, and deserialize ``name``; returns
+        the loaded executable as a callable.  Raises AotError subclasses
+        on any reason the artifact cannot be used here."""
+        entry = self.entry(name)
+        if entry["donate_argnums"]:
+            safe = (allow_donated if allow_donated is not None
+                    else donation_deserialize_safe())
+            if not safe:
+                self._event("donation_refused", name=name)
+                raise AotDonationError(
+                    f"{self.directory}/{entry['file']}: donated executable "
+                    f"refused — deserialized donated programs mis-execute "
+                    f"on jax {jax.__version__} {jax.default_backend()} "
+                    "(ISSUE 2 cache bug); re-export undonated or fresh-"
+                    "compile")
+        path = os.path.join(self.directory, entry["file"])
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            raise AotArtifactCorruptError(
+                f"{path}: executable payload unreadable: {e}") from e
+        if zlib.crc32(blob) != entry["crc32"]:
+            self._event("crc_mismatch", name=name)
+            raise AotArtifactCorruptError(
+                f"{path}: CRC mismatch — artifact is corrupt (bit-rot or "
+                "torn write); re-export")
+        from jax.experimental import serialize_executable as se
+        try:
+            payload, in_tree, out_tree = pickle.loads(blob)
+            loaded = se.deserialize_and_load(payload, in_tree, out_tree)
+        except AotError:
+            raise
+        except Exception as e:
+            # the payload passed its CRC, so this is version skew inside
+            # the serialized executable itself (e.g. an xla runtime that
+            # no longer accepts the proto) — surface as mismatch
+            raise AotManifestMismatchError(
+                f"{path}: executable failed to deserialize on jax "
+                f"{jax.__version__}: {type(e).__name__}: {e}") from e
+        self._event("load", name=name)
+        return loaded
+
+
+def export_compiled(directory: str, name: str, jitted, example_args: Tuple,
+                    *, config: Dict[str, Any],
+                    donate_argnums: Tuple[int, ...] = (),
+                    buckets: Optional[Dict[str, Any]] = None,
+                    registry=None) -> ArtifactStore:
+    """One-call export of a single jitted function: trace → lower →
+    compile ``jitted`` at ``example_args`` and store it under ``name``.
+    ``donate_argnums`` must mirror what ``jitted`` was built with — it
+    is recorded for the load-side donation gate, not applied here."""
+    store = ArtifactStore(directory, registry=registry)
+    store.begin(config=config, buckets=buckets)
+    with fresh_backend_compile():
+        compiled = jitted.lower(*example_args).compile()
+    store.put(name, compiled, example_args, donate_argnums=donate_argnums)
+    return store

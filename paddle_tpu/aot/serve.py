@@ -1,0 +1,138 @@
+"""AOT export/load for the continuous-batching serving engine.
+
+A fleet restart constructs thousands of ``ContinuousBatchingEngine``
+instances over the same weights and geometry; per-process tracing of
+the decode step plus one chunk-fill per bucket is pure waste.  One
+process exports once::
+
+    eng = ContinuousBatchingEngine(cfg, params, prefill_buckets=(16, 64))
+    aot.export_engine(eng, "artifacts/serve")
+
+and every other process warm-starts::
+
+    eng = ContinuousBatchingEngine(cfg, params, aot_dir="artifacts/serve")
+
+with ZERO backend compiles (pinned by the compile-budget ratchet's
+``serve_aot_warm`` scenario).  The manifest's config hash covers the
+model config, batch/pool geometry, and the parameter tree signature,
+so a mismatched engine falls back to fresh compiles instead of running
+a wrong program.
+
+Donation note: the fresh engine donates the KV pools into its compiled
+steps.  Exports only record donation where deserialized donated
+executables are safe (see artifact.donation_deserialize_safe) — on the
+known-broken jax-0.4.37 CPU path the exported steps are compiled
+UNDONATED (identical numerics, double-buffered pools).
+
+Not covered: the per-request SAMPLER program is jitted over the varying
+sampled-sub-batch width and stays a runtime compile; greedy decode — the
+fleet-restart hot path — is fully AOT.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .artifact import (ArtifactStore, AotManifestMismatchError,
+                       args_signature, donation_deserialize_safe,
+                       fresh_backend_compile)
+from .buckets import DEFAULT_CHUNK_BUCKETS, ShapeBucketRegistry
+
+__all__ = ["export_engine", "load_engine_artifacts", "engine_config"]
+
+_DECODE = "decode"
+_FILL = "chunk_fill_{c}"
+
+
+def engine_config(engine) -> Dict[str, Any]:
+    """Everything the compiled serve programs are specialized to:
+    model config, batch/pool geometry, and the weight-tree signature."""
+    params_td, params_leaves = args_signature((engine.params,))
+    return {
+        "kind": "continuous_batching_engine",
+        "model": dataclasses.asdict(engine.cfg),
+        "max_batch": engine.B,
+        "block_size": engine.BS,
+        "max_blocks_per_seq": engine.MB,
+        "num_blocks": engine.alloc.num_blocks,
+        "pool_dtype": str(engine.pool_k.dtype),
+        "params_treedef": params_td,
+        "params_leaves": params_leaves,
+    }
+
+
+def _decode_args(engine) -> Tuple:
+    """The exact decode-step call signature ``Engine.step`` uses."""
+    return (engine.params, engine.pool_k, engine.pool_v,
+            jnp.asarray(engine.block_table), jnp.asarray(engine.lengths),
+            jnp.asarray(engine.tokens))
+
+
+def _fill_args(engine, size: int) -> Tuple:
+    """The exact bucketed chunk-fill call signature the scheduler uses."""
+    return (engine.params, engine.pool_k, engine.pool_v,
+            jnp.asarray(engine.block_table[0]), jnp.int32(0),
+            jnp.asarray(np.zeros((size,), np.int32)), jnp.int32(1))
+
+
+def export_engine(engine, directory: str, *,
+                  buckets: Optional[ShapeBucketRegistry] = None,
+                  registry=None) -> ArtifactStore:
+    """Trace, lower, compile, and serialize the engine's decode step
+    plus one bucketed chunk-fill per declared prefill bucket."""
+    breg = buckets or getattr(engine, "_buckets", None) or \
+        ShapeBucketRegistry(DEFAULT_CHUNK_BUCKETS)
+    if breg.max_batch is None:
+        breg = ShapeBucketRegistry(breg.chunk_sizes, max_batch=engine.B)
+    donate = (1, 2) if donation_deserialize_safe() else ()
+    store = ArtifactStore(directory, registry=registry)
+    store.begin(config=engine_config(engine),
+                buckets=breg.to_manifest())
+
+    with fresh_backend_compile():
+        args = _decode_args(engine)
+        compiled = jax.jit(engine._build_step(),
+                           donate_argnums=donate).lower(*args).compile()
+        store.put(_DECODE, compiled, args, donate_argnums=donate)
+
+        for c in breg.chunk_sizes:
+            args = _fill_args(engine, c)
+            compiled = jax.jit(engine._build_chunk_fill(c),
+                               donate_argnums=donate
+                               ).lower(*args).compile()
+            store.put(_FILL.format(c=c), compiled, args,
+                      donate_argnums=donate)
+    return store
+
+
+def load_engine_artifacts(engine, directory: str, *, registry=None):
+    """Verify + deserialize the serve executables for ``engine``.
+
+    Returns ``(decode_step, {bucket: fill}, ShapeBucketRegistry)``;
+    raises an :class:`~paddle_tpu.aot.artifact.AotError` subclass on
+    version skew, geometry mismatch, corruption, or a donation-unsafe
+    artifact — the engine falls back to fresh compiles."""
+    store = ArtifactStore(directory, registry=registry)
+    store.check_env()
+    store.check_config(engine_config(engine))
+    bm = store.buckets()
+    if not bm:
+        raise AotManifestMismatchError(
+            f"{directory}: manifest declares no serve buckets")
+    breg = ShapeBucketRegistry.from_manifest(bm)
+    if breg.max_batch is not None and breg.max_batch != engine.B:
+        raise AotManifestMismatchError(
+            f"{directory}: exported for max_batch={breg.max_batch}, "
+            f"engine has {engine.B}")
+    if not store.matches_signature(_DECODE, _decode_args(engine)):
+        raise AotManifestMismatchError(
+            f"{directory}: decode-step signature drifted from this "
+            "engine's call shapes — re-export")
+    decode = store.get(_DECODE)
+    fills = {c: store.get(_FILL.format(c=c)) for c in breg.chunk_sizes}
+    return decode, fills, breg

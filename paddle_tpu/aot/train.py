@@ -1,0 +1,174 @@
+"""AOT export/load for jitted train steps.
+
+Two entry points, mirroring the two ways the tree builds train steps:
+
+* :func:`export_train_step` / :func:`load_train_step` — the hapi
+  ``Model`` path (``Model._build_jit_step``): forward+backward+fused
+  optimizer in one donated XLA program.  The step has TWO signatures
+  over its life — the first call takes per-name optimizer state and
+  returns it in fused (flat-bucket) form; every later call threads the
+  fused form — so the exporter serializes BOTH programs
+  (``train_step_init`` / ``train_step``) and the loader dispatches per
+  call on the recorded input signature, falling back to a fresh
+  ``jax.jit`` (with a telemetry event) for anything else, e.g. a
+  restored checkpoint with exotic slot state.
+
+* :func:`export_jit_apply` — the raw ``Optimizer.build_jit_apply``
+  fused-apply program, for callers that run their own step loop.
+
+Donation: by default the export donates exactly when a deserialized
+donated program is safe on this platform
+(:func:`~paddle_tpu.aot.artifact.donation_deserialize_safe`); the
+jax-0.4.37 XLA:CPU path exports undonated so its artifacts remain
+loadable (identical numerics, double-buffered state).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from .artifact import (ArtifactStore, _sig_matches, args_signature,
+                       donation_deserialize_safe, fresh_backend_compile)
+
+__all__ = ["export_train_step", "load_train_step", "AotTrainStep",
+           "export_jit_apply"]
+
+_INIT = "train_step_init"
+_STEADY = "train_step"
+
+
+def _example_rng():
+    """Same aval as ``core.rng.next_rng_key()`` (a folded typed key)
+    without advancing the process generator — exporting must not shift
+    the training run's RNG stream."""
+    return jax.random.fold_in(jax.random.key(0), 0)
+
+
+def _example_args(model, inputs, labels) -> Tuple:
+    """Reconstruct ``Model.train_batch``'s exact jit-step call
+    signature from one example batch (first-step form: per-name
+    optimizer state)."""
+    from ..hapi.model import _np
+    inputs = _np(inputs)
+    labels = _np(labels)
+    params, buffers = model._split_state()
+    trainable = {n: params[n]
+                 for n, p in model.network.named_parameters()
+                 if p.trainable}
+    opt_state = model._optimizer.init_state(trainable)
+    lr = model._optimizer.get_lr()
+    scale = (model._scaler.get_loss_scaling()
+             if model._scaler is not None and model._scaler.is_enable()
+             else 1.0)
+    return (params, buffers, opt_state, model._step_count + 1, lr,
+            _example_rng(), scale, inputs, labels)
+
+
+def train_config(model, args: Tuple) -> Dict[str, Any]:
+    td, leaves = args_signature(args)
+    return {
+        "kind": "hapi_train_step",
+        "network": type(model.network).__name__,
+        "optimizer": type(model._optimizer).__name__,
+        "loss": type(model._loss).__name__ if model._loss else None,
+        "skip_nonfinite": bool(model._skip_nonfinite),
+        "amp": bool(model._scaler is not None
+                    and model._scaler.is_enable()),
+        "args_treedef": td,
+        "args_leaves": leaves,
+    }
+
+
+def export_train_step(model, inputs, labels, directory: str, *,
+                      donate: Optional[bool] = None,
+                      registry=None) -> ArtifactStore:
+    """Trace, lower, compile, and serialize the prepared ``model``'s
+    jitted train step for one example batch shape — both the first-step
+    (per-name optimizer state) and steady-state (fused state)
+    programs."""
+    if model._optimizer is None:
+        raise ValueError("export_train_step needs a prepared Model "
+                         "(call prepare(optimizer=..., loss=...) first)")
+    if donate is None:
+        donate = donation_deserialize_safe()
+    donate_argnums = (0, 1, 2) if donate else ()
+    jit_step = model._build_jit_step(donate=donate)
+    args_init = _example_args(model, inputs, labels)
+    store = ArtifactStore(directory, registry=registry)
+    store.begin(config=train_config(model, args_init))
+
+    with fresh_backend_compile():
+        compiled = jit_step.lower(*args_init).compile()
+        store.put(_INIT, compiled, args_init,
+                  donate_argnums=donate_argnums)
+
+        # steady state: the fused opt-state layout is whatever the
+        # first step RETURNS — take its avals abstractly and compile
+        # that program
+        fused_sds = jax.eval_shape(jit_step, *args_init)[2]
+        args_steady = args_init[:2] + (fused_sds,) + args_init[3:]
+        compiled = jit_step.lower(*args_steady).compile()
+        store.put(_STEADY, compiled, args_steady,
+                  donate_argnums=donate_argnums)
+    return store
+
+
+class AotTrainStep:
+    """Drop-in for ``Model._jit_step``: dispatches each call to the
+    deserialized executable whose recorded input signature matches,
+    fresh-compiling (once, with a telemetry event) for anything the
+    artifacts don't cover."""
+
+    def __init__(self, model, store: ArtifactStore):
+        self._model = model
+        self._store = store
+        self._entries = []
+        for name in (_INIT, _STEADY):
+            self._entries.append((store.entry(name)["in_sig"],
+                                  store.get(name)))
+        self._fresh = None
+
+    def __call__(self, *args):
+        for sig, fn in self._entries:
+            if _sig_matches(sig, args):
+                return fn(*args)
+        if self._fresh is None:
+            self._store._event("signature_fallback",
+                               name="train_step")
+            self._fresh = self._model._build_jit_step()
+        return self._fresh(*args)
+
+
+def load_train_step(model, directory: str, *, registry=None
+                    ) -> AotTrainStep:
+    """Verify + deserialize the train-step artifacts for ``model``.
+    Raises an AotError subclass (skew/corrupt/donation-refused) — the
+    Model falls back to a fresh ``jax.jit``."""
+    store = ArtifactStore(directory, registry=registry)
+    store.check_env()
+    return AotTrainStep(model, store)
+
+
+def export_jit_apply(opt, params, grads, state, directory: str, *,
+                     lr=1e-3, step: int = 1,
+                     donate: Optional[bool] = None,
+                     registry=None) -> ArtifactStore:
+    """Serialize ``Optimizer.build_jit_apply``'s fused-apply program at
+    the given (params, grads, state) signature — the raw-step-loop
+    analog of :func:`export_train_step`."""
+    if donate is None:
+        donate = donation_deserialize_safe()
+    fused = opt.build_jit_apply(donate=donate)
+    args = (params, grads, state, lr, step)
+    store = ArtifactStore(directory, registry=registry)
+    td, leaves = args_signature(args)
+    store.begin(config={"kind": "fused_jit_apply",
+                        "optimizer": type(opt).__name__,
+                        "args_treedef": td, "args_leaves": leaves})
+    with fresh_backend_compile():
+        compiled = fused.lower(*args).compile()
+    store.put("jit_apply", compiled, args,
+              donate_argnums=(0, 1, 2) if donate else ())
+    return store

@@ -59,6 +59,8 @@ class Model:
         self._scaler = None
         self._step_guard = None
         self._skip_nonfinite = True
+        self._aot_dir = None
+        self._aot_error = None
         self._preempted = False
         # telemetry (observability/): None unless fit(observe=True) is
         # live — the disabled step path pays exactly one `is None` check
@@ -69,14 +71,23 @@ class Model:
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None, jit: bool = True,
                 skip_nonfinite: bool = True,
-                max_consecutive_skips: int = 50):
+                max_consecutive_skips: int = 50,
+                aot_dir: Optional[str] = None):
         """``skip_nonfinite`` arms the in-graph anomaly guard (see
         checkpoint/step_guard.py): a step whose loss or grads contain
         NaN/Inf leaves params/moments untouched, backs off the dynamic
         loss scale (when amp is configured), and after
         ``max_consecutive_skips`` back-to-back skips raises
         NonFiniteError.  ``amp_configs`` may be a GradScaler, or a dict
-        of GradScaler kwargs (optionally under a ``"scaler"`` key)."""
+        of GradScaler kwargs (optionally under a ``"scaler"`` key).
+
+        ``aot_dir`` warm-starts the jitted train step from a compile
+        artifact written by ``paddle_tpu.aot.export_train_step``:
+        matching calls run the DESERIALIZED executable (no trace/lower/
+        backend-compile at first step); version skew, corruption, a
+        donation-unsafe artifact, or a signature the artifacts don't
+        cover falls back to a fresh ``jax.jit`` with an ``aot``
+        telemetry event (reason kept on ``self._aot_error``)."""
         from ..checkpoint.step_guard import StepGuard
 
         self._optimizer = optimizer
@@ -87,6 +98,8 @@ class Model:
         self._skip_nonfinite = skip_nonfinite
         self._step_guard = StepGuard(max_consecutive_skips,
                                      scaler=self._scaler)
+        self._aot_dir = aot_dir
+        self._aot_error = None
         self._jit_step = None      # guard/scaler config changes the program
         return self
 
@@ -111,7 +124,7 @@ class Model:
     # ------------------------------------------------------------------
     # jitted step machinery
     # ------------------------------------------------------------------
-    def _build_jit_step(self):
+    def _build_jit_step(self, donate: bool = True):
         net = self.network
         opt = self._optimizer
         loss_layer = self._loss
@@ -183,7 +196,29 @@ class Model:
             return (new_params, kept_buffers, new_opt_state, loss_v,
                     outs_v, notfinite)
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        # donate=False is the AOT-export path on platforms where a
+        # deserialized DONATED program is unsafe (aot/artifact.py)
+        return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+
+    def _make_jit_step(self):
+        """AOT warm start when prepare(aot_dir=) was given: deserialize
+        the exported train-step executables (aot/train.py) and dispatch
+        per call signature; ANY artifact problem falls back to a fresh
+        jit with the reason recorded + a telemetry event."""
+        if self._aot_dir is not None:
+            from ..aot.artifact import AotError
+            from ..aot.train import load_train_step
+            try:
+                return load_train_step(self, self._aot_dir)
+            except AotError as e:
+                self._aot_error = str(e)
+                from ..observability import REGISTRY
+                if REGISTRY.enabled:
+                    REGISTRY.counter("aot.fallback_total").inc()
+                    REGISTRY.event("aot", action="fallback",
+                                   dir=self._aot_dir,
+                                   reason=str(e)[:300])
+        return self._build_jit_step()
 
     def _split_state(self):
         params = {n: p._value for n, p in self.network.named_parameters()}
@@ -205,7 +240,7 @@ class Model:
         if not self._use_jit:
             return self._train_batch_eager(inputs, labels)
         if self._jit_step is None:
-            self._jit_step = self._build_jit_step()
+            self._jit_step = self._make_jit_step()
         params, buffers = self._split_state()
         if self._opt_state is None:
             trainable = {n: params[n]

@@ -126,6 +126,18 @@ class ContinuousBatchingEngine:
       block_size / num_blocks: shared KV page pool geometry.
       max_blocks_per_seq: page-table width per slot (caps per-sequence
         length at block_size * max_blocks_per_seq).
+      prefill_buckets: declared prefill chunk lengths (aot/buckets.py).
+        When set, EVERY prompt/suffix prefill is decomposed into these
+        fixed-size chunk fills (last chunk zero-padded), so variable
+        load runs on a fixed set of compiled programs instead of one
+        jit per distinct prompt length.
+      aot_dir: warm-start from a compile-artifact directory written by
+        ``paddle_tpu.aot.export_engine`` — the decode step and the
+        bucketed chunk fills are DESERIALIZED (zero backend compiles)
+        instead of traced.  Any manifest mismatch (version skew,
+        geometry drift, corruption, donation-unsafe artifact) falls
+        back to fresh compiles with an ``aot`` telemetry event; the
+        reason is kept on ``self.aot_error``.
 
     The engine keeps its own page table rather than reusing
     ops/paged_kv.PagedKVCache: that class sizes its table [B, num_blocks]
@@ -137,7 +149,8 @@ class ContinuousBatchingEngine:
     def __init__(self, cfg, params, *, max_batch: int = 4,
                  block_size: int = 16, num_blocks: int = 256,
                  max_blocks_per_seq: Optional[int] = None,
-                 enable_prefix_caching: bool = True):
+                 enable_prefix_caching: bool = True,
+                 prefill_buckets=None, aot_dir: Optional[str] = None):
         if getattr(cfg, "moe_num_experts", 0) and \
                 getattr(cfg, "moe_router", "topk") != "topk":
             raise NotImplementedError("decode serves token-choice only")
@@ -177,15 +190,42 @@ class ContinuousBatchingEngine:
         self.queue: "collections.deque[GenRequest]" = collections.deque()
         self.finished: Dict[int, np.ndarray] = {}
         self._next_id = 0
-        # pools are donated: the decode step rewrites them every
-        # iteration and the old buffers must not stay live
-        self._step = jax.jit(self._build_step(),
-                             donate_argnums=(1, 2))
         # LRU-bounded (a serving workload with many distinct prompt
         # lengths must not retain unboundedly many XLA executables)
         from ..utils.lru import LRUCache
         self._prefill_cache = LRUCache(16)
         self._chunk_fill_cache = LRUCache(16)
+        # declared-bucket prefill + AOT warm start (paddle_tpu/aot)
+        self._buckets = None
+        self._bucket_fills: Dict[int, object] = {}
+        self.aot_loaded = False
+        self.aot_error: Optional[str] = None
+        self._step = None
+        if aot_dir is not None:
+            from ..aot.artifact import AotError
+            from ..aot.serve import load_engine_artifacts
+            try:
+                self._step, self._bucket_fills, self._buckets = \
+                    load_engine_artifacts(self, aot_dir)
+                self.aot_loaded = True
+            except AotError as e:
+                # fresh-compile fallback, loudly: the reason stays on
+                # the engine and goes to the telemetry event stream
+                self.aot_error = str(e)
+                from ..observability import REGISTRY
+                if REGISTRY.enabled:
+                    REGISTRY.counter("aot.fallback_total").inc()
+                    REGISTRY.event("aot", action="fallback", dir=aot_dir,
+                                   reason=str(e)[:300])
+        if self._buckets is None and prefill_buckets is not None:
+            from ..aot.buckets import ShapeBucketRegistry
+            self._buckets = ShapeBucketRegistry(prefill_buckets,
+                                                max_batch=max_batch)
+        if self._step is None:
+            # pools are donated: the decode step rewrites them every
+            # iteration and the old buffers must not stay live
+            self._step = jax.jit(self._build_step(),
+                                 donate_argnums=(1, 2))
         self.last_logits: Optional[np.ndarray] = None   # [B, V] debug/test
 
     # ------------------------------------------------------------------
@@ -243,7 +283,15 @@ class ContinuousBatchingEngine:
         tokens starting at a cached prefix of length ``start``, writing
         their KV into the (private) pages and returning next-token
         logits.  This is what makes a prefix-cache hit SKIP the prefix
-        compute, not just dedupe its storage."""
+        compute, not just dedupe its storage.
+
+        Called with the optional trailing ``valid`` argument (the
+        declared-bucket path), only the first ``valid`` tokens are
+        real: padded rows write their KV to an out-of-range block index
+        (scatter drops out-of-bounds updates, so the pool is untouched)
+        and the returned logits come from row ``valid - 1`` instead of
+        the last row.  With ``valid == Ts`` the computation is
+        identical to the unpadded call."""
         cfg = self.cfg
         from ..models.llama import _rope_cos_sin
         from ..models.generation import (_collapse_blocks,
@@ -256,7 +304,7 @@ class ContinuousBatchingEngine:
         scale = 1.0 / (D ** 0.5)
         rms, ffn = _make_rms_ffn(cfg)
 
-        def fill(params, pool_k, pool_v, bt_row, start, toks):
+        def fill(params, pool_k, pool_v, bt_row, start, toks, valid=None):
             # toks [Ts]; bt_row [MB]; start: prefix length
             blocks = _collapse_blocks(params["blocks"])
             pos = start + jnp.arange(Ts)                     # [Ts]
@@ -264,6 +312,11 @@ class ContinuousBatchingEngine:
             cos = jnp.take(cos_full, pos, axis=0)
             sin = jnp.take(sin_full, pos, axis=0)
             blk = jnp.take(jnp.maximum(bt_row, 0), pos // BS)
+            if valid is not None:
+                # bucketed call: padded rows scatter out of range (the
+                # update is dropped) so stale pool pages stay intact
+                blk = jnp.where(jnp.arange(Ts) < valid, blk,
+                                pool_k.shape[1])
             off = pos % BS
             jpos = jnp.arange(bt_row.shape[0] * BS)[None, None, None, :]
             mask = jpos <= pos[None, None, :, None]
@@ -294,7 +347,9 @@ class ContinuousBatchingEngine:
 
             x, (pk2, pv2) = jax.lax.scan(body, x,
                                          (blocks, pool_k, pool_v))
-            xf = rms(x[:, -1], params["lnf_w"])
+            last = x[:, -1] if valid is None \
+                else jnp.take(x, valid - 1, axis=1)
+            xf = rms(last, params["lnf_w"])
             logits = jnp.einsum("bh,hv->bv", xf, params["head"],
                                 preferred_element_type=jnp.float32)
             return pk2, pv2, logits
@@ -308,6 +363,39 @@ class ContinuousBatchingEngine:
                          donate_argnums=(1, 2))
             self._chunk_fill_cache.put(Ts, fn)
         return fn
+
+    def _bucket_fill(self, size: int):
+        """Compiled bucketed fill for a DECLARED chunk size: AOT-loaded
+        when the engine warm-started, else jitted once per bucket (the
+        key set is the fixed declared-bucket set, so this cache is
+        bounded by construction)."""
+        fn = self._bucket_fills.get(size)
+        if fn is None:
+            fn = jax.jit(self._build_chunk_fill(size),
+                         donate_argnums=(1, 2))
+            self._bucket_fills[size] = fn
+        return fn
+
+    def _fill_prompt_bucketed(self, slot: int, req: "GenRequest",
+                              start: int) -> np.ndarray:
+        """Run the prompt suffix (``start`` = cached-prefix tokens)
+        through declared-bucket chunk fills; returns the logits at the
+        prompt's final token (from the last chunk's ``valid - 1``
+        row)."""
+        suffix = req.prompt[start:]
+        bt_row = jnp.asarray(self.block_table[slot])
+        pos, off = start, 0
+        logits = None
+        for size, valid in self._buckets.plan_chunks(len(suffix)):
+            toks = np.zeros((size,), np.int32)
+            toks[:valid] = suffix[off:off + valid]
+            fill = self._bucket_fill(size)
+            self.pool_k, self.pool_v, logits = fill(
+                self.params, self.pool_k, self.pool_v, bt_row,
+                jnp.int32(pos), jnp.asarray(toks), jnp.int32(valid))
+            pos += valid
+            off += valid
+        return logits
 
     # ------------------------------------------------------------------
     # host-side scheduler
@@ -484,7 +572,12 @@ class ContinuousBatchingEngine:
             self.block_table[slot, :need] = table
             self.slot_pages[slot] = table
 
-            if L:
+            if self._buckets is not None:
+                # declared-bucket prefill (cold prompts AND cache-hit
+                # suffixes): fixed chunk programs, no per-length jit
+                logits = self._fill_prompt_bucketed(slot, req,
+                                                    L * self.BS)
+            elif L:
                 # suffix-only prefill against the cached pages
                 suffix = req.prompt[L * self.BS:]
                 fill = self._chunk_fill(len(suffix))
@@ -627,3 +720,14 @@ class ContinuousBatchingEngine:
         while self.queue or any(s is not None for s in self.slots):
             results.update(self.step())
         return results
+
+    def aot_stats(self) -> Dict[str, object]:
+        """Warm-start observability for bench rows/telemetry: whether
+        artifacts loaded (and why not), plus declared-bucket hit/miss
+        counts."""
+        s: Dict[str, object] = {"aot_loaded": self.aot_loaded}
+        if self.aot_error is not None:
+            s["aot_error"] = self.aot_error
+        if self._buckets is not None:
+            s.update(self._buckets.stats())
+        return s

@@ -207,16 +207,28 @@ from ..static import InputSpec  # noqa: E402,F401
 class TranslatedLayer:
     """Callable returned by :func:`load` — the analog of the reference's
     ``TranslatedLayer`` (jit/translated_layer.py): a deserialized program
-    plus its parameters, executable without the original Python class."""
+    plus its parameters, executable without the original Python class.
 
-    def __init__(self, exported, params):
+    ``aot_call`` (when the archive embeds a compile artifact and it
+    passed the environment/CRC gates) is the READY XLA executable —
+    calls run with zero trace/lower/backend-compile work."""
+
+    def __init__(self, exported, params, aot_call=None):
         self._exported = exported
         self._params = params
+        self._aot_call = aot_call
+
+    @property
+    def aot_loaded(self) -> bool:
+        return self._aot_call is not None
 
     def __call__(self, *args):
         vals = [a._value if isinstance(a, Tensor) else jax.numpy.asarray(a)
                 for a in args]
-        out = self._exported.call(self._params, *vals)
+        if self._aot_call is not None:
+            out = self._aot_call(self._params, *vals)
+        else:
+            out = self._exported.call(self._params, *vals)
         return jax.tree.map(_wrap, out)
 
     def state_dict(self):
@@ -225,15 +237,26 @@ class TranslatedLayer:
     eval = train = lambda self: self
 
 
-def save(layer, path, input_spec=None, **config):
+def save(layer, path, input_spec=None, aot=False, **config):
     """``paddle.jit.save`` analog (reference jit/api.py).
 
     TPU-native format: instead of the reference's Program protobuf +
     TranslatedLayer, the traced computation is serialized as STABLEHLO via
     ``jax.export`` (path.pdmodel) next to the parameters (path.pdparams) —
     loadable by :func:`load` in a fresh process with no access to the
-    original Python class."""
+    original Python class.
+
+    ``aot=True`` additionally embeds the fully COMPILED executable
+    (serialized via ``paddle_tpu.aot``, CRC'd, with an environment
+    fingerprint): :func:`load` on a matching jax/jaxlib/platform runs it
+    with zero compile work, and transparently falls back to the portable
+    STABLEHLO program anywhere else.  Requires a fully static
+    ``input_spec`` (an XLA executable is shape-specialized; use the
+    plain STABLEHLO path for dynamic batch dims).  This is the
+    deployment-export story — the reference's onnx/inference-model path
+    is out of scope on the TPU build (see NOTIMPL.md)."""
     import pickle
+    import zlib
 
     import numpy as np
 
@@ -279,15 +302,37 @@ def save(layer, path, input_spec=None, **config):
     params_sds = jax.tree.map(
         lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params)
     exported = jax.export.export(jax.jit(pure))(params_sds, *sds)
+    blob = {"stablehlo": exported.serialize(),
+            "param_keys": sorted(params.keys())}
+    if aot:
+        if counter[0]:
+            raise ValueError(
+                "jit.save(aot=True): input_spec has dynamic (None) dims; "
+                "an XLA executable is shape-specialized — pass concrete "
+                "shapes, or drop aot=True for the symbolic-shape "
+                "STABLEHLO export")
+        from jax.experimental import serialize_executable as se
+        from ..aot.artifact import (environment_fingerprint,
+                                    fresh_backend_compile)
+        with fresh_backend_compile():
+            compiled = jax.jit(pure).lower(params_sds, *sds).compile()
+        payload = pickle.dumps(se.serialize(compiled))
+        blob["aot"] = {"env": environment_fingerprint(),
+                       "crc32": zlib.crc32(payload),
+                       "payload": payload}
     with open(path + ".pdmodel", "wb") as f:
-        pickle.dump({"stablehlo": exported.serialize(),
-                     "param_keys": sorted(params.keys())}, f)
+        pickle.dump(blob, f)
 
 
 def load(path, **config):
     """``paddle.jit.load`` analog: deserialize the STABLEHLO program +
-    params saved by :func:`save`; returns a :class:`TranslatedLayer`."""
+    params saved by :func:`save`; returns a :class:`TranslatedLayer`.
+    An embedded ``aot=True`` executable is used when its environment
+    fingerprint matches and its CRC verifies — otherwise the portable
+    STABLEHLO program is used (version skew is a fallback, corruption
+    of the aot payload raises)."""
     import pickle
+    import zlib
 
     from ..framework.io import load as _load
 
@@ -303,7 +348,21 @@ def load(path, **config):
         raise ValueError(
             f"jit.load: {path}.pdparams does not match the exported "
             f"program (missing={sorted(missing)}, extra={sorted(extra)})")
-    return TranslatedLayer(exported, params)
+    aot_call = None
+    aot_blob = blob.get("aot")
+    if aot_blob is not None:
+        from ..aot.artifact import (AotArtifactCorruptError,
+                                    environment_fingerprint)
+        if zlib.crc32(aot_blob["payload"]) != aot_blob["crc32"]:
+            raise AotArtifactCorruptError(
+                f"{path}.pdmodel: embedded AOT executable fails its CRC "
+                "— archive is corrupt (the STABLEHLO program shares the "
+                "same file; re-export)")
+        if aot_blob.get("env") == environment_fingerprint():
+            from jax.experimental import serialize_executable as se
+            aot_call = se.deserialize_and_load(
+                *pickle.loads(aot_blob["payload"]))
+    return TranslatedLayer(exported, params, aot_call=aot_call)
 
 
 _TO_STATIC_ENABLED = True

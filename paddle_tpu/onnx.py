@@ -2,7 +2,9 @@
 
 ONNX export is a SURVEY §7 non-goal for the TPU build (the serving
 format here is STABLEHLO via ``paddle.jit.save`` — portable across
-XLA backends the way ONNX is across GPU runtimes); ``export`` raises a
+XLA backends the way ONNX is across GPU runtimes — and, since ISSUE 6,
+``paddle.jit.save(..., aot=True)`` embeds the fully compiled
+executable for zero-compile fleet warm starts); ``export`` raises a
 guard pointing at the native path."""
 
 from __future__ import annotations
@@ -14,5 +16,7 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
     raise NotImplementedError(
         "onnx export is out of scope on the TPU build (SURVEY §7): use "
         "paddle.jit.save(layer, path, input_spec=...) — the STABLEHLO "
-        "artifact is the portable serving format here, loadable by "
-        "paddle.jit.load / paddle.inference.create_predictor")
+        "artifact is the portable serving format here (add aot=True to "
+        "also embed the compiled executable for zero-compile warm "
+        "starts), loadable by paddle.jit.load / "
+        "paddle.inference.create_predictor")

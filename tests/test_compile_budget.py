@@ -1,0 +1,92 @@
+"""Recompile-budget ratchet: measured backend-compile counts versus the
+committed COMPILE_BUDGET.md (ISSUE 6).
+
+Tier-1 and CPU-only.  Counts are upper bounds — an in-process pytest
+run may measure FEWER compiles than a fresh process (jax's op-by-op
+executable cache is already warm), and the ratchet only fails on MORE.
+``serve_aot_warm`` is exact: an engine warm-started from an AOT
+artifact directory must record ZERO backend compiles, in any process.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import compile_budget  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def measured():
+    from paddle_tpu.parallel.topology import HybridTopology, set_topology
+    try:
+        return compile_budget.measure()
+    finally:
+        set_topology(HybridTopology())   # scenarios re-pin the topology
+
+
+def test_scenarios_at_or_below_budget(measured):
+    ledger = compile_budget.load_ledger()
+    regressions = compile_budget.compare(measured, ledger)
+    assert regressions == [], (
+        "backend-compile counts grew beyond COMPILE_BUDGET.md:\n  "
+        + "\n  ".join(regressions)
+        + "\nfind the new compile (CompileMonitor attributes per-label "
+          "counts), or regenerate the ledger via `python "
+          "tools/compile_budget.py` with reviewer sign-off")
+
+
+def test_aot_warm_start_is_zero_compiles(measured):
+    """ISSUE 6 acceptance: after artifact load the engine's decode and
+    bucketed prefill run deserialized executables — zero backend_compile
+    events, exactly, even in a warm process."""
+    assert measured["serve_aot_warm"] == 0, measured
+
+
+def test_every_scenario_has_a_budget(measured):
+    budgets = compile_budget.load_ledger()["budgets"]
+    assert set(measured) <= set(budgets), (set(measured), set(budgets))
+
+
+def test_injected_compile_trips_ratchet(measured):
+    """+1 synthetic compile on every scenario must regress: the ratchet
+    is live, not vacuously green."""
+    ledger = compile_budget.load_ledger()
+    bumped = {k: v + 1 for k, v in measured.items()}
+    regressions = compile_budget.compare(bumped, ledger)
+    # serve_aot_warm's budget is 0, so at minimum that row must trip
+    assert any("serve_aot_warm" in r for r in regressions), regressions
+
+
+def test_unknown_scenario_is_a_regression():
+    """A scenario added to the tool without a committed budget must fail
+    the compare, not silently pass."""
+    ledger = compile_budget.load_ledger()
+    regressions = compile_budget.compare({"brand_new_path": 1}, ledger)
+    assert regressions and "no committed budget" in regressions[0]
+
+
+def test_standalone_checker_exits_zero():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "compile_budget.py"),
+         "--check"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "budget OK" in proc.stdout
+
+
+def test_standalone_injected_check_fails():
+    """`--check --inject 1` on the zero-budget warm scenario exits
+    non-zero (the acceptance-criterion CLI proof)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "compile_budget.py"),
+         "--check", "--scenarios", "serve_aot_warm", "--inject", "1"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert "BUDGET FAIL" in proc.stdout

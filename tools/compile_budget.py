@@ -1,0 +1,279 @@
+"""COMPILE_BUDGET.md generator / recompile-budget ratchet (ISSUE 6).
+
+* ``python tools/compile_budget.py``          — regenerate the ledger
+  from the current per-scenario backend-compile counts (regenerating to
+  ratchet DOWN is routine; growing a budget requires explanation in
+  review).
+* ``python tools/compile_budget.py --check``  — exit non-zero if any
+  scenario compiles MORE than its committed budget; the pre-commit-style
+  one-liner for the ratchet tests/test_compile_budget.py runs under
+  pytest.
+* ``--scenarios a,b`` restricts either mode; ``--inject N`` adds N
+  synthetic compiles to every measured count (proves the ratchet trips —
+  used by the tier-1 test and for CI smoke).
+
+Each scenario mirrors a bench.py config at CPU liveness shapes and
+counts ``backend_compile`` events (observability.CompileMonitor) over
+its WORKLOAD phase only — setup (weight init, AOT export) is excluded.
+``serve_aot_warm`` is the acceptance scenario: an engine warm-started
+from an AOT artifact directory must record ZERO backend compiles.
+
+Counts are upper bounds: in-process runs (pytest) may measure fewer
+compiles than the committed budget because earlier tests already
+populated jax's op-by-op executable cache — the ratchet only fails on
+MORE.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from typing import Callable, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LEDGER = os.path.join(REPO, "COMPILE_BUDGET.md")
+MAGIC = "compile-budget v1"
+
+
+# ---------------------------------------------------------------------
+# scenarios: setup returns the workload callable; only the workload is
+# measured
+# ---------------------------------------------------------------------
+def _tiny_llama():
+    import jax
+    import numpy as np
+    from paddle_tpu import parallel as dist
+    from paddle_tpu.models.llama import build_llama_train_step, llama_tiny
+    from paddle_tpu.parallel.topology import HybridTopology, set_topology
+
+    cfg = llama_tiny()
+    topo = dist.init_topology(devices=jax.devices()[:1])
+    _, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1)
+    params = init_fn(0)["params"]
+    set_topology(HybridTopology())
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 9, 17)]
+    return cfg, params, prompts
+
+
+def _engine(cfg, params, aot_dir=None):
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    return ContinuousBatchingEngine(
+        cfg, params, max_batch=2, block_size=8, num_blocks=64,
+        prefill_buckets=(8,), aot_dir=aot_dir)
+
+
+def gpt_train() -> Callable[[], None]:
+    """The flagship bench (no --config): GPT train step, steady loop."""
+    import jax
+    import numpy as np
+    from paddle_tpu import parallel as dist
+    from paddle_tpu.models.gpt import GPTConfig, build_gpt_train_step
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=64)
+    topo = dist.init_topology(devices=jax.devices()[:1])
+    step_fn, init_fn = build_gpt_train_step(cfg, topo, num_microbatches=1)
+    state = init_fn(0)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 32)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1)
+
+    def workload():
+        s, loss = state, None
+        for _ in range(3):
+            s, loss = step_fn(s, ids, labels)
+        jax.device_get(loss)
+
+    return workload
+
+
+def serve_fresh() -> Callable[[], None]:
+    """bench.py --config serve at liveness shapes: cold engine start
+    (decode step + one declared-bucket fill compile) + full drain."""
+    cfg, params, prompts = _tiny_llama()
+
+    def workload():
+        eng = _engine(cfg, params)
+        for p in prompts:
+            eng.add_request(p, 4)
+        eng.run_to_completion()
+
+    return workload
+
+
+def serve_aot_warm() -> Callable[[], None]:
+    """The fleet-restart path: engine warm-started from an AOT artifact
+    directory.  Budget is ZERO backend compiles — any compile here means
+    warm start silently fell back to tracing."""
+    import tempfile
+    from paddle_tpu.aot.serve import export_engine
+
+    cfg, params, prompts = _tiny_llama()
+    aot_dir = tempfile.mkdtemp(prefix="aot_budget_")
+    export_engine(_engine(cfg, params), aot_dir)
+
+    def workload():
+        eng = _engine(cfg, params, aot_dir=aot_dir)
+        for p in prompts:
+            eng.add_request(p, 4)
+        eng.run_to_completion()
+        if not eng.aot_loaded:
+            raise RuntimeError(f"warm start fell back: {eng.aot_error}")
+
+    return workload
+
+
+SCENARIOS: Dict[str, Callable[[], Callable[[], None]]] = {
+    "gpt_train": gpt_train,
+    "serve_fresh": serve_fresh,
+    "serve_aot_warm": serve_aot_warm,
+}
+
+
+def measure(names: Optional[List[str]] = None,
+            inject: int = 0) -> Dict[str, int]:
+    """Run scenarios (fixed declaration order) and return their
+    backend-compile counts; ``inject`` adds synthetic compiles to every
+    count (ratchet self-test)."""
+    from paddle_tpu.observability import CompileMonitor
+
+    out: Dict[str, int] = {}
+    for name, setup in SCENARIOS.items():
+        if names is not None and name not in names:
+            continue
+        workload = setup()
+        monitor = CompileMonitor()
+        monitor.install()
+        try:
+            workload()
+        finally:
+            monitor.uninstall()
+        out[name] = monitor.n_compiles + inject
+    return out
+
+
+# ---------------------------------------------------------------------
+# ledger
+# ---------------------------------------------------------------------
+def render_md(counts: Dict[str, int]) -> str:
+    lines = [
+        "# compile budget",
+        "",
+        "Per-bench-config backend-compile budgets "
+        "(`tools/compile_budget.py`); the ratchet "
+        "(`tests/test_compile_budget.py`, or `python "
+        "tools/compile_budget.py --check`) fails when any scenario "
+        "COMPILES MORE than its committed budget — recompile "
+        "regressions (shape churn, cache bugs, a warm start silently "
+        "tracing) fail loudly instead of shipping as latency.",
+        "",
+        "Budgets are CPU tier-1 numbers; `serve_aot_warm` is the ISSUE 6"
+        " acceptance row: an AOT-warm engine start must be ZERO.",
+        "",
+    ]
+    for name, n in counts.items():
+        doc = (SCENARIOS[name].__doc__ or "").strip().split("\n")[0]
+        lines.append(f"- `{name}`: **{n}** backend compiles — {doc}")
+    lines += [
+        "",
+        f"<!-- {MAGIC}",
+        json.dumps({"platform": _platform(), "budgets": counts},
+                   sort_keys=True),
+        "-->",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def _platform() -> str:
+    import jax
+    return jax.default_backend()
+
+
+def load_ledger() -> Dict:
+    with open(LEDGER, encoding="utf-8") as f:
+        text = f.read()
+    m = re.search(rf"<!-- {re.escape(MAGIC)}\n(.*?)\n-->", text, re.S)
+    if m is None:
+        raise ValueError(f"{LEDGER}: no '{MAGIC}' machine block")
+    return json.loads(m.group(1))
+
+
+def compare(measured: Dict[str, int], ledger: Dict) -> List[str]:
+    budgets = ledger.get("budgets", {})
+    regressions = []
+    for name, n in sorted(measured.items()):
+        if name not in budgets:
+            regressions.append(f"{name}: no committed budget (measured "
+                               f"{n}) — regenerate the ledger")
+        elif n > budgets[name]:
+            regressions.append(f"{name}: {n} backend compiles > budget "
+                               f"{budgets[name]}")
+    return regressions
+
+
+# ---------------------------------------------------------------------
+def generate(names: Optional[List[str]]) -> int:
+    if names is not None:
+        print("refusing to regenerate a PARTIAL ledger (--scenarios is "
+              "--check-only)")
+        return 1
+    counts = measure()
+    with open(LEDGER, "w", encoding="utf-8") as f:
+        f.write(render_md(counts))
+    print(f"wrote {os.path.relpath(LEDGER, REPO)}: {counts}")
+    return 0
+
+
+def check(names: Optional[List[str]], inject: int) -> int:
+    try:
+        ledger = load_ledger()
+    except (OSError, ValueError) as e:
+        print(f"BUDGET FAIL: cannot load ledger: {e}")
+        return 1
+    if ledger.get("platform") != _platform():
+        print(f"budget SKIP: ledger is for platform "
+              f"{ledger.get('platform')!r}, this is {_platform()!r} "
+              "(the ratchet is a CPU tier-1 gate)")
+        return 0
+    measured = measure(names, inject=inject)
+    regressions = compare(measured, ledger)
+    if regressions:
+        print(f"BUDGET FAIL: {len(regressions)} scenario(s) above the "
+              "committed COMPILE_BUDGET.md:")
+        for r in regressions:
+            print(f"  {r}")
+        print("find the new compile (CompileMonitor per-label counts "
+              "attribute it), or — with reviewer sign-off — regenerate "
+              "via `python tools/compile_budget.py`.")
+        return 1
+    print(f"budget OK: {measured} at or below budget")
+    return 0
+
+
+def main(argv: List[str]) -> int:
+    names: Optional[List[str]] = None
+    inject = 0
+    if "--scenarios" in argv:
+        names = [s for s in
+                 argv[argv.index("--scenarios") + 1].split(",") if s]
+        unknown = set(names) - set(SCENARIOS)
+        if unknown:
+            print(f"unknown scenarios: {sorted(unknown)} "
+                  f"(have {sorted(SCENARIOS)})")
+            return 1
+    if "--inject" in argv:
+        inject = int(argv[argv.index("--inject") + 1])
+    if "--check" in argv:
+        return check(names, inject)
+    return generate(names)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
