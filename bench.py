@@ -174,6 +174,40 @@ def _serve_aot_warm_extra(cfg, params, eng, ttft_cold, *, mb, nb, t0,
         return {"aot_error": f"{type(e).__name__}: {e}"}
 
 
+def _serve_loadgen_extra(eng, on_accel, *, t0, new):
+    """Poisson-load row for the serve config (ISSUE 7): open-loop
+    seeded arrivals through the streaming front-end, reporting p50/p99
+    TTFT, per-output-token latency, tokens/s, goodput-under-SLO, and
+    the zero-leak check.  Reuses the drained (compile-warm) engine so
+    the row measures the serve loop, not tracing.  Never fails the row —
+    errors land in extra.loadgen_error."""
+    try:
+        from paddle_tpu.serving import (AdmissionConfig, LoadGenConfig,
+                                        PoissonLoadGenerator,
+                                        ServingFrontend)
+
+        if on_accel:
+            lg = LoadGenConfig(n_requests=32, rate_rps=8.0, seed=0,
+                               prompt_len=(t0 // 4, t0),
+                               max_new_tokens=(new // 3, new),
+                               sampled_fraction=0.25,
+                               cancel_fraction=0.1,
+                               slo_ttft_s=2.0, slo_tpot_s=0.25)
+        else:
+            lg = LoadGenConfig(n_requests=16, rate_rps=100.0, seed=0,
+                               prompt_len=(3, t0),
+                               max_new_tokens=(3, new),
+                               sampled_fraction=0.25,
+                               cancel_fraction=0.1,
+                               slo_ttft_s=5.0, slo_tpot_s=1.0)
+        fe = ServingFrontend(eng,
+                             admission=AdmissionConfig(max_queue_len=64))
+        report = PoissonLoadGenerator(fe, lg).run()
+        return {"loadgen": report.to_dict()}
+    except Exception as e:
+        return {"loadgen_error": f"{type(e).__name__}: {e}"}
+
+
 def _train_aot_warm_extra(step_fn, state, ids, labels, ttfs_cold):
     """Cold-vs-warm for the llama train row: serialize the (undonated
     re-jit of the) train step, deserialize, and time load + first step
@@ -392,6 +426,8 @@ def run_config_bench(config: str):
         out["extra"].update(_serve_aot_warm_extra(
             cfg, params, eng, ttft_cold, mb=mb, nb=nb, t0=t0, new=new,
             rng=rng))
+        out["extra"].update(_serve_loadgen_extra(eng, on_accel, t0=t0,
+                                                 new=new))
     elif config == "decode":
         # inference: autoregressive decode through the KV-cache decoder
         # (prefill + lax.scan step loop; Pallas MMHA on TPU) — the

@@ -24,9 +24,12 @@ executables are safe (see artifact.donation_deserialize_safe) — on the
 known-broken jax-0.4.37 CPU path the exported steps are compiled
 UNDONATED (identical numerics, double-buffered pools).
 
-Not covered: the per-request SAMPLER program is jitted over the varying
-sampled-sub-batch width and stays a runtime compile; greedy decode — the
-fleet-restart hot path — is fully AOT.
+Sampler coverage (ISSUE 7): the engine samples every sub-batch at the
+FIXED decode width ``max_batch`` (rows padded; vmap keeps real rows
+independent of padding), so exactly one sampler program exists per
+engine geometry and it is exported here next to the decode step — a
+warm-started engine with per-request sampling enabled performs zero
+backend compiles (pinned by the ``serve_aot_warm_sampled`` budget row).
 """
 
 from __future__ import annotations
@@ -47,6 +50,7 @@ __all__ = ["export_engine", "load_engine_artifacts", "engine_config"]
 
 _DECODE = "decode"
 _FILL = "chunk_fill_{c}"
+_SAMPLER = "sampler"
 
 
 def engine_config(engine) -> Dict[str, Any]:
@@ -80,6 +84,19 @@ def _fill_args(engine, size: int) -> Tuple:
             jnp.asarray(np.zeros((size,), np.int32)), jnp.int32(1))
 
 
+def _sampler_args(engine) -> Tuple:
+    """The fixed-width sampler call signature (``_sample_rows`` pads
+    every sub-batch to ``max_batch`` rows)."""
+    B = engine.B
+    V = int(engine.params["head"].shape[-1])
+    return (jnp.asarray(np.zeros((B, V), np.float32)),
+            jnp.asarray(np.zeros((B,), np.int32)),
+            jnp.asarray(np.zeros((B,), np.int32)),
+            jnp.asarray(np.ones((B,), np.float32)),
+            jnp.asarray(np.zeros((B,), np.int32)),
+            jnp.asarray(np.zeros((B,), np.float32)))
+
+
 def export_engine(engine, directory: str, *,
                   buckets: Optional[ShapeBucketRegistry] = None,
                   registry=None) -> ArtifactStore:
@@ -107,16 +124,26 @@ def export_engine(engine, directory: str, *,
                                ).lower(*args).compile()
             store.put(_FILL.format(c=c), compiled, args,
                       donate_argnums=donate)
+
+        # per-request sampling runs at the fixed decode width, so ONE
+        # program covers every sampled sub-batch (never donated — the
+        # sampler owns no buffers)
+        from ..inference.serving import build_sampler
+        args = _sampler_args(engine)
+        compiled = jax.jit(build_sampler()).lower(*args).compile()
+        store.put(_SAMPLER, compiled, args)
     return store
 
 
 def load_engine_artifacts(engine, directory: str, *, registry=None):
     """Verify + deserialize the serve executables for ``engine``.
 
-    Returns ``(decode_step, {bucket: fill}, ShapeBucketRegistry)``;
-    raises an :class:`~paddle_tpu.aot.artifact.AotError` subclass on
-    version skew, geometry mismatch, corruption, or a donation-unsafe
-    artifact — the engine falls back to fresh compiles."""
+    Returns ``(decode_step, {bucket: fill}, ShapeBucketRegistry,
+    sampler)``; raises an :class:`~paddle_tpu.aot.artifact.AotError`
+    subclass on version skew, geometry mismatch, corruption, or a
+    donation-unsafe artifact — the engine falls back to fresh
+    compiles.  An artifact directory from before the sampler export is
+    a manifest mismatch (re-export), not a silent half-warm start."""
     store = ArtifactStore(directory, registry=registry)
     store.check_env()
     store.check_config(engine_config(engine))
@@ -133,6 +160,11 @@ def load_engine_artifacts(engine, directory: str, *, registry=None):
         raise AotManifestMismatchError(
             f"{directory}: decode-step signature drifted from this "
             "engine's call shapes — re-export")
+    if not store.matches_signature(_SAMPLER, _sampler_args(engine)):
+        raise AotManifestMismatchError(
+            f"{directory}: sampler signature drifted from this engine's "
+            "fixed decode width — re-export")
     decode = store.get(_DECODE)
     fills = {c: store.get(_FILL.format(c=c)) for c in breg.chunk_sizes}
-    return decode, fills, breg
+    sampler = store.get(_SAMPLER)
+    return decode, fills, breg, sampler

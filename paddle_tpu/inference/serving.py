@@ -33,7 +33,7 @@ import numpy as np
 from ..models.llama import _rotate_half
 from ..ops.paged_kv import paged_append, paged_decode_attention
 
-__all__ = ["ContinuousBatchingEngine", "GenRequest"]
+__all__ = ["ContinuousBatchingEngine", "GenRequest", "build_sampler"]
 
 
 class _RefPool:
@@ -60,14 +60,25 @@ class _RefPool:
 
     def share(self, phys: List[int]) -> None:
         for p in phys:
+            if p not in self.ref:
+                raise RuntimeError(
+                    f"KV-pool accounting bug: share() of block {p} that "
+                    "holds no live reference (freed or never acquired)")
             self.ref[p] += 1
 
     def release(self, phys: List[int]) -> None:
         for p in phys:
-            self.ref[p] -= 1
-            if self.ref[p] == 0:
+            r = self.ref.get(p, 0)
+            if r <= 0:
+                raise RuntimeError(
+                    f"KV-pool accounting bug: release() of block {p} "
+                    "with no live reference (double free) — a scheduling "
+                    "path released the same pages twice")
+            if r == 1:
                 del self.ref[p]
                 self._free.append(p)
+            else:
+                self.ref[p] = r - 1
 
 
 @dataclass
@@ -84,6 +95,33 @@ class GenRequest:
     # index of the first EOS in ``out`` (set by the scheduler the step the
     # token is appended — O(1) per step instead of rescanning the list)
     eos_pos: Optional[int] = None
+
+
+def build_sampler():
+    """Row-vmapped fold-in + filter + categorical program shared by the
+    engine's runtime sampler and the AOT exporter (``aot/serve.py``) —
+    the deserialized program must be the very function the engine would
+    have jitted.  HF sequential-warper semantics: top-p mass is computed
+    over the top-k-FILTERED distribution, not the raw one."""
+
+    def one(logits, seed, position, temperature, top_k, top_p):
+        key = jax.random.fold_in(jax.random.key(seed), position)
+        x = logits.astype(jnp.float32) / temperature
+        srt = jnp.sort(x)[::-1]                  # descending
+        # traced ranks must be POSITIVE take indices — a traced
+        # negative index clamps to 0 under jit and would
+        # silently disable the filter
+        kth = jnp.take(srt, jnp.maximum(top_k, 1) - 1)
+        x = jnp.where((top_k > 0) & (x < kth), -jnp.inf, x)
+        srt2 = jnp.sort(x)[::-1]                 # filtered dist
+        probs = jax.nn.softmax(srt2)
+        cum = jnp.cumsum(probs)
+        cidx = jnp.sum(cum < top_p)
+        cutoff = jnp.take(srt2, cidx)
+        x = jnp.where((top_p > 0.0) & (x < cutoff), -jnp.inf, x)
+        return jax.random.categorical(key, x)
+
+    return jax.vmap(one)
 
 
 def _make_rms_ffn(cfg):
@@ -201,12 +239,13 @@ class ContinuousBatchingEngine:
         self.aot_loaded = False
         self.aot_error: Optional[str] = None
         self._step = None
+        self._sampler_fn = None
         if aot_dir is not None:
             from ..aot.artifact import AotError
             from ..aot.serve import load_engine_artifacts
             try:
-                self._step, self._bucket_fills, self._buckets = \
-                    load_engine_artifacts(self, aot_dir)
+                (self._step, self._bucket_fills, self._buckets,
+                 self._sampler_fn) = load_engine_artifacts(self, aot_dir)
                 self.aot_loaded = True
             except AotError as e:
                 # fresh-compile fallback, loudly: the reason stays on
@@ -439,42 +478,45 @@ class ContinuousBatchingEngine:
         of batch composition and admission timing."""
         if req.temperature is None or req.temperature <= 0.0:
             return int(logits.argmax())
-        tok = self._sampler()(jnp.asarray(logits)[None],
-                              jnp.asarray([req.seed], jnp.int32),
-                              jnp.asarray([position], jnp.int32),
-                              jnp.asarray([req.temperature], jnp.float32),
-                              jnp.asarray([req.top_k or 0], jnp.int32),
-                              jnp.asarray([req.top_p or 0.0],
-                                          jnp.float32))
-        return int(np.asarray(tok)[0])
+        return int(self._sample_rows([req], np.asarray(logits)[None],
+                                     [position])[0])
 
     def _sampler(self):
-        """One jitted row-vmapped fold-in + filter + categorical program
-        — the whole sampled sub-batch runs in a single dispatch per step.
-        HF sequential-warper semantics: top-p mass is computed over the
-        top-k-FILTERED distribution, not the raw one."""
-        fn = getattr(self, "_sampler_fn", None)
-        if fn is None:
-            def one(logits, seed, position, temperature, top_k, top_p):
-                key = jax.random.fold_in(jax.random.key(seed), position)
-                x = logits.astype(jnp.float32) / temperature
-                srt = jnp.sort(x)[::-1]                  # descending
-                # traced ranks must be POSITIVE take indices — a traced
-                # negative index clamps to 0 under jit and would
-                # silently disable the filter
-                kth = jnp.take(srt, jnp.maximum(top_k, 1) - 1)
-                x = jnp.where((top_k > 0) & (x < kth), -jnp.inf, x)
-                srt2 = jnp.sort(x)[::-1]                 # filtered dist
-                probs = jax.nn.softmax(srt2)
-                cum = jnp.cumsum(probs)
-                cidx = jnp.sum(cum < top_p)
-                cutoff = jnp.take(srt2, cidx)
-                x = jnp.where((top_p > 0.0) & (x < cutoff), -jnp.inf, x)
-                return jax.random.categorical(key, x)
+        """The compiled fixed-width sampler: AOT-loaded when the engine
+        warm-started, else jitted once."""
+        if self._sampler_fn is None:
+            self._sampler_fn = jax.jit(build_sampler())
+        return self._sampler_fn
 
-            fn = jax.jit(jax.vmap(one))
-            self._sampler_fn = fn
-        return fn
+    def _sample_rows(self, reqs: List[GenRequest], logits_rows,
+                     positions) -> np.ndarray:
+        """Sample one token per request (rows aligned with ``reqs``).
+
+        Rows are PADDED to the full decode width ``max_batch`` so every
+        call — any sampled sub-batch size AND the single-row admission
+        path — runs ONE compiled program instead of one per distinct
+        width.  That one program is what ``aot/serve.py`` serializes, so
+        warm-started engines sample with zero backend compiles.  Each
+        row is computed independently (vmap), so padding cannot change
+        a real row's token."""
+        n = len(reqs)
+        lg = np.zeros((self.B, logits_rows.shape[-1]), np.float32)
+        lg[:n] = logits_rows
+        seeds = np.zeros((self.B,), np.int32)
+        pos = np.zeros((self.B,), np.int32)
+        temps = np.ones((self.B,), np.float32)   # pad rows: no div-by-0
+        topk = np.zeros((self.B,), np.int32)
+        topp = np.zeros((self.B,), np.float32)
+        pos[:n] = np.asarray(positions, np.int32)
+        for i, r in enumerate(reqs):
+            seeds[i] = r.seed
+            temps[i] = r.temperature
+            topk[i] = r.top_k or 0
+            topp[i] = r.top_p or 0.0
+        toks = self._sampler()(jnp.asarray(lg), jnp.asarray(seeds),
+                               jnp.asarray(pos), jnp.asarray(temps),
+                               jnp.asarray(topk), jnp.asarray(topp))
+        return np.asarray(toks)[:n]
 
     def _blocks_needed(self, n_tokens: int) -> int:
         return -(-n_tokens // self.BS)
@@ -641,6 +683,7 @@ class ContinuousBatchingEngine:
         self.slot_pages[slot] = []
         self.block_table[slot, :] = -1
         self.lengths[slot] = 0
+        self.tokens[slot] = 0
         self.slots[slot] = None
 
     def _retire(self, slot: int) -> None:
@@ -652,7 +695,17 @@ class ContinuousBatchingEngine:
     def cancel(self, req_id: int) -> bool:
         """Abort a queued or in-flight request.  Its pages free
         immediately; no result is reported.  Returns False when the id
-        is unknown or already finished."""
+        is unknown or already finished.
+
+        Accounting contract (regression-pinned by
+        test_serving_engine.py::test_cancel_accounting_*): a WAITING
+        request holds no page references, so removal from the queue is
+        the whole operation; a SCHEDULED request holds exactly one
+        reference per page in its table (including prefix-shared pages,
+        whose extra references live in the prefix index) and
+        ``_free_slot`` releases each exactly once — the ``_RefPool``
+        raises on any double free, so a drift here fails loudly instead
+        of corrupting another request's KV."""
         for i, req in enumerate(self.queue):
             if req.req_id == req_id:
                 del self.queue[i]
@@ -693,16 +746,11 @@ class ContinuousBatchingEngine:
         picks: Dict[int, int] = {}
         if sampled:
             # ONE dispatch + sync for the whole sampled sub-batch
-            reqs = [self.slots[s] for s in sampled]
-            toks = self._sampler()(
-                jnp.asarray(self.last_logits[sampled]),
-                jnp.asarray([r.seed for r in reqs], jnp.int32),
-                jnp.asarray([int(self.lengths[s]) for s in sampled],
-                            jnp.int32),
-                jnp.asarray([r.temperature for r in reqs], jnp.float32),
-                jnp.asarray([r.top_k or 0 for r in reqs], jnp.int32),
-                jnp.asarray([r.top_p or 0.0 for r in reqs], jnp.float32))
-            picks = dict(zip(sampled, np.asarray(toks).tolist()))
+            toks = self._sample_rows(
+                [self.slots[s] for s in sampled],
+                self.last_logits[sampled],
+                [int(self.lengths[s]) for s in sampled])
+            picks = dict(zip(sampled, toks.tolist()))
         for s in active:
             req = self.slots[s]
             tok = picks.get(s)
@@ -720,6 +768,54 @@ class ContinuousBatchingEngine:
         while self.queue or any(s is not None for s in self.slots):
             results.update(self.step())
         return results
+
+    # ------------------------------------------------------------------
+    # serve-path introspection (paddle_tpu/serving front-end + telemetry)
+    # ------------------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        """Requests admitted into the engine but not yet scheduled."""
+        return len(self.queue)
+
+    @property
+    def active_requests(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def batch_occupancy(self) -> float:
+        """Fraction of decode-batch slots currently running a request."""
+        return self.active_requests / float(self.B)
+
+    def kv_utilization(self) -> float:
+        """Fraction of KV pool blocks holding live references (slots or
+        prefix index)."""
+        return 1.0 - self.alloc.free_blocks / float(self.alloc.num_blocks)
+
+    def kv_leak_report(self) -> Dict[str, int]:
+        """Cross-check the refcount pool against the structures that are
+        supposed to hold its references (slot tables + prefix index).
+
+        ``leaked`` counts blocks whose refcount disagrees with the
+        holders, plus holder entries with no refcount; ``unaccounted``
+        counts blocks that are neither free nor referenced.  Both must
+        be zero after any drain — asserted by the loadgen smoke and the
+        cancellation regression tests."""
+        held: Dict[int, int] = {}
+        for pages in self.slot_pages:
+            for p in pages:
+                held[p] = held.get(p, 0) + 1
+        for p in self.prefix_index.values():
+            held[p] = held.get(p, 0) + 1
+        leaked = sum(1 for p, r in self.alloc.ref.items()
+                     if held.get(p, 0) != r)
+        leaked += sum(1 for p in held if p not in self.alloc.ref)
+        return {
+            "free_blocks": self.alloc.free_blocks,
+            "index_blocks": len(self.prefix_index),
+            "slot_blocks": sum(len(p) for p in self.slot_pages),
+            "leaked": leaked,
+            "unaccounted": (self.alloc.num_blocks - self.alloc.free_blocks
+                            - len(self.alloc.ref)),
+        }
 
     def aot_stats(self) -> Dict[str, object]:
         """Warm-start observability for bench rows/telemetry: whether

@@ -1,0 +1,31 @@
+"""Streaming serving front-end (ISSUE 7).
+
+The production face of the continuous-batching engine: a per-request
+lifecycle with streaming token delivery, SLO-aware admission control
+and deadlines, a seeded open-loop Poisson load generator, and the
+serve-path metric catalogue over the PR 5 telemetry registry.
+
+Layering::
+
+    serving.PoissonLoadGenerator      offered load + SLO report
+            │
+    serving.ServingFrontend           lifecycle/streams/admission
+            │
+    inference.ContinuousBatchingEngine   batch scheduler + paged KV
+            │
+    aot.export_engine / aot_dir       zero-compile warm start
+
+See ``docs/serving.md`` for the state machine, the streaming API, the
+admission knobs, and the metric catalogue.
+"""
+
+from .frontend import (AdmissionConfig, RequestAborted, RequestHandle,
+                       RequestRejected, RequestState, ServingFrontend)
+from .loadgen import LoadGenConfig, LoadReport, PoissonLoadGenerator
+from .metrics import ServeMetrics
+
+__all__ = [
+    "AdmissionConfig", "LoadGenConfig", "LoadReport",
+    "PoissonLoadGenerator", "RequestAborted", "RequestHandle",
+    "RequestRejected", "RequestState", "ServeMetrics", "ServingFrontend",
+]

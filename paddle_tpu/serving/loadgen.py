@@ -1,0 +1,260 @@
+"""Open-loop Poisson load generator for the serving front-end.
+
+Open loop is the honest serving benchmark: arrivals follow a seeded
+Poisson process whose times do NOT depend on how fast the server
+responds (a closed loop — next request after the previous reply — lets
+a slow server throttle its own offered load and flatters every latency
+percentile).  The generator submits at the planned arrival times,
+pumps the front-end between arrivals, and reports the SLO-facing
+numbers the bench row carries: p50/p95/p99 TTFT, per-output-token
+latency, tokens/s, and goodput-under-SLO.
+
+Everything here is seeded host code: request content, budgets, which
+requests sample, and which get cancelled are all deterministic
+functions of ``LoadGenConfig.seed``, so token outputs are reproducible
+run-to-run (the engine pins per-request results independent of batch
+composition).  Wall-clock only feeds TIMINGS, never traced code.
+
+Usage::
+
+    eng = ContinuousBatchingEngine(cfg, params, ...)
+    fe = ServingFrontend(eng)
+    report = PoissonLoadGenerator(fe, LoadGenConfig(
+        n_requests=64, rate_rps=32.0, seed=0)).run()
+    print(report.to_dict())
+
+After the drain the generator cross-checks the engine's KV pool
+(``kv_leak_report``) — a run with cancellations and timeouts must end
+with zero leaked blocks.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .frontend import RequestHandle, RequestState, ServingFrontend
+
+__all__ = ["LoadGenConfig", "LoadReport", "PoissonLoadGenerator"]
+
+
+def _span(v: Union[int, Tuple[int, int]]) -> Tuple[int, int]:
+    if isinstance(v, int):
+        return (v, v)
+    lo, hi = int(v[0]), int(v[1])
+    if not 1 <= lo <= hi:
+        raise ValueError(f"bad range {v!r}: need 1 <= lo <= hi")
+    return (lo, hi)
+
+
+@dataclass(frozen=True)
+class LoadGenConfig:
+    """Workload shape + SLOs.  ``prompt_len`` / ``max_new_tokens`` take
+    an int or an inclusive ``(lo, hi)`` range."""
+
+    n_requests: int = 32
+    rate_rps: float = 16.0             # Poisson arrival rate
+    seed: int = 0
+    prompt_len: Union[int, Tuple[int, int]] = (4, 12)
+    max_new_tokens: Union[int, Tuple[int, int]] = (4, 16)
+    sampled_fraction: float = 0.0      # fraction using temperature>0
+    temperature: float = 0.8
+    top_k: Optional[int] = 20
+    eos_token_id: Optional[int] = None
+    slo_ttft_s: float = 2.0
+    slo_tpot_s: float = 0.5
+    deadline_s: Optional[float] = None
+    max_queue_time_s: Optional[float] = None
+    cancel_fraction: float = 0.0       # fraction cancelled mid-stream
+    cancel_after_tokens: int = 2
+
+
+@dataclass
+class _Planned:
+    at: float                          # arrival offset from run start
+    prompt: np.ndarray
+    max_new: int
+    sampled: bool
+    seed: int
+    cancel: bool
+
+
+@dataclass
+class LoadReport:
+    """Aggregate + per-request results of one loadgen run.
+
+    ``ttft`` / ``tpot`` dicts carry ``p50/p95/p99/mean`` over FINISHED
+    requests (None when nothing finished); ``goodput_rps`` counts only
+    finished requests that met BOTH SLOs."""
+
+    n_requests: int
+    finished: int
+    rejected: int
+    cancelled: int
+    timed_out: int
+    duration_s: float
+    total_streamed_tokens: int
+    tokens_per_s: float
+    ttft_s: Optional[Dict[str, float]]
+    tpot_s: Optional[Dict[str, float]]
+    goodput_rps: float
+    goodput_tokens_per_s: float
+    slo: Dict[str, float]
+    kv_leaks: Dict[str, int]
+    per_request: List[Dict[str, Any]] = field(default_factory=list)
+
+    def to_dict(self, include_requests: bool = False) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "n_requests": self.n_requests, "finished": self.finished,
+            "rejected": self.rejected, "cancelled": self.cancelled,
+            "timed_out": self.timed_out,
+            "duration_s": round(self.duration_s, 4),
+            "total_streamed_tokens": self.total_streamed_tokens,
+            "tokens_per_s": round(self.tokens_per_s, 2),
+            "ttft_s": self.ttft_s, "tpot_s": self.tpot_s,
+            "goodput_rps": round(self.goodput_rps, 3),
+            "goodput_tokens_per_s": round(self.goodput_tokens_per_s, 2),
+            "slo": self.slo,
+            "kv_leaked_blocks": (self.kv_leaks["leaked"]
+                                 + self.kv_leaks["unaccounted"]),
+        }
+        if include_requests:
+            d["per_request"] = self.per_request
+        return d
+
+
+def _pcts(vals: List[float]) -> Optional[Dict[str, float]]:
+    if not vals:
+        return None
+    a = np.asarray(vals, np.float64)
+    return {"p50": round(float(np.percentile(a, 50)), 6),
+            "p95": round(float(np.percentile(a, 95)), 6),
+            "p99": round(float(np.percentile(a, 99)), 6),
+            "mean": round(float(a.mean()), 6)}
+
+
+class PoissonLoadGenerator:
+    """Drives a :class:`ServingFrontend` with a seeded open-loop Poisson
+    arrival process and reports latency/goodput percentiles."""
+
+    def __init__(self, frontend: ServingFrontend,
+                 config: Optional[LoadGenConfig] = None, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.frontend = frontend
+        self.config = config or LoadGenConfig()
+        self._clock = clock
+        self._sleep = sleep
+
+    def plan(self) -> List[_Planned]:
+        """The run's deterministic request schedule (pure function of
+        the config seed and the engine's vocab size)."""
+        cfg = self.config
+        rng = np.random.default_rng(cfg.seed)
+        arrivals = np.cumsum(
+            rng.exponential(1.0 / cfg.rate_rps, cfg.n_requests))
+        vocab = int(self.frontend.engine.cfg.vocab_size)
+        plo, phi = _span(cfg.prompt_len)
+        nlo, nhi = _span(cfg.max_new_tokens)
+        out: List[_Planned] = []
+        for i in range(cfg.n_requests):
+            t0 = int(rng.integers(plo, phi + 1))
+            out.append(_Planned(
+                at=float(arrivals[i]),
+                prompt=rng.integers(0, vocab, (t0,)).astype(np.int32),
+                max_new=int(rng.integers(nlo, nhi + 1)),
+                sampled=bool(rng.random() < cfg.sampled_fraction),
+                seed=int(rng.integers(0, 2 ** 31 - 1)),
+                cancel=bool(rng.random() < cfg.cancel_fraction)))
+        return out
+
+    def _submit(self, p: _Planned) -> RequestHandle:
+        cfg = self.config
+        return self.frontend.submit(
+            p.prompt, p.max_new, eos_token_id=cfg.eos_token_id,
+            temperature=cfg.temperature if p.sampled else 0.0,
+            top_k=cfg.top_k if p.sampled else None, seed=p.seed,
+            deadline_s=cfg.deadline_s,
+            max_queue_time_s=cfg.max_queue_time_s)
+
+    def run(self) -> LoadReport:
+        cfg = self.config
+        plan = self.plan()
+        handles: List[Optional[RequestHandle]] = [None] * len(plan)
+        t0 = self._clock()
+        next_up = 0
+        while True:
+            now = self._clock() - t0
+            while next_up < len(plan) and plan[next_up].at <= now:
+                handles[next_up] = self._submit(plan[next_up])
+                next_up += 1
+            # deterministic mid-stream cancellations: fire once the
+            # request has streamed cancel_after_tokens tokens
+            for h, p in zip(handles, plan):
+                if (h is not None and p.cancel
+                        and not h.state.terminal
+                        and h.n_streamed >= cfg.cancel_after_tokens):
+                    h.cancel()
+            live = any(h is not None and not h.state.terminal
+                       for h in handles)
+            if live:
+                self.frontend.step()
+            elif next_up < len(plan):
+                gap = plan[next_up].at - (self._clock() - t0)
+                if gap > 0:
+                    self._sleep(min(gap, 0.005))
+            else:
+                break
+        duration = max(self._clock() - t0, 1e-9)
+        return self._report(handles, duration)
+
+    def _report(self, handles: List[Optional[RequestHandle]],
+                duration: float) -> LoadReport:
+        cfg = self.config
+        ttfts: List[float] = []
+        tpots: List[float] = []
+        counts = {s: 0 for s in RequestState}
+        total_tokens = 0
+        good = 0
+        good_tokens = 0
+        per_req: List[Dict[str, Any]] = []
+        for h in handles:
+            if h is None:
+                continue
+            counts[h.state] += 1
+            k = h.n_streamed
+            total_tokens += k
+            rec: Dict[str, Any] = {"req_id": h.req_id,
+                                   "state": h.state.value,
+                                   "n_tokens": k}
+            if h.ttft_s is not None:
+                rec["ttft_s"] = round(h.ttft_s, 6)
+            if h.state is RequestState.FINISHED:
+                ttfts.append(h.ttft_s)
+                tpot = 0.0
+                if k > 1:
+                    tpot = (h.finish_t - h.first_token_t) / (k - 1)
+                    tpots.append(tpot)
+                rec["tpot_s"] = round(tpot, 6)
+                if h.ttft_s <= cfg.slo_ttft_s and tpot <= cfg.slo_tpot_s:
+                    good += 1
+                    good_tokens += k
+            per_req.append(rec)
+        return LoadReport(
+            n_requests=cfg.n_requests,
+            finished=counts[RequestState.FINISHED],
+            rejected=counts[RequestState.REJECTED],
+            cancelled=counts[RequestState.CANCELLED],
+            timed_out=counts[RequestState.TIMED_OUT],
+            duration_s=duration,
+            total_streamed_tokens=total_tokens,
+            tokens_per_s=total_tokens / duration,
+            ttft_s=_pcts(ttfts), tpot_s=_pcts(tpots),
+            goodput_rps=good / duration,
+            goodput_tokens_per_s=good_tokens / duration,
+            slo={"ttft_s": cfg.slo_ttft_s, "tpot_s": cfg.slo_tpot_s},
+            kv_leaks=self.frontend.engine.kv_leak_report(),
+            per_request=per_req)

@@ -1,0 +1,141 @@
+"""Serve-path telemetry: the metric facade the streaming front-end and
+the load generator record through.
+
+Everything goes to the PR 5 :class:`~paddle_tpu.observability.
+MetricsRegistry` (the process-wide ``REGISTRY`` by default), so the
+serve metrics ride the existing sinks unchanged: the JSONL stream, the
+Prometheus dump, and — load-bearing for incident forensics — the
+:class:`~paddle_tpu.observability.FlightRecorder` ring, which means a
+crash anywhere in the process captures the last N ``serve`` lifecycle
+events (submits, rejects, timeouts, cancels, finishes) in its black-box
+dump with no extra wiring.
+
+Metric catalogue (all names under ``serve.``; docs/serving.md):
+
+===============================  =========  =============================
+name                             kind       meaning
+===============================  =========  =============================
+serve.submitted_total            counter    requests accepted by admission
+serve.rejected_total             counter    requests refused at submit
+serve.timeouts_total             counter    deadline / max_queue_time kills
+serve.cancelled_total            counter    client-initiated cancels
+serve.finished_total             counter    requests that ran to completion
+serve.tokens_streamed_total      counter    tokens delivered to handles
+serve.queue_depth                gauge      engine waiting-queue length
+serve.batch_occupancy            gauge      busy decode slots / max_batch
+serve.kv_utilization             gauge      1 - free_blocks / num_blocks
+serve.kv_free_blocks             gauge      free pool pages right now
+serve.ttft_secs                  histogram  submit -> first streamed token
+serve.tpot_secs                  histogram  inter-token latency (decode)
+serve.e2e_secs                   histogram  submit -> finish (FINISHED only)
+serve.backpressure_wait_secs     histogram  producer blocked on full stream
+===============================  =========  =============================
+
+Every recording entry point checks ``registry.enabled`` first, so a
+front-end without telemetry pays one branch per call (the PR 5
+zero-cost-disabled contract).  All of this is host-side scheduler code,
+never traced — the tracelint ratchet pins this package at zero TL001
+findings.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..observability import REGISTRY, MetricsRegistry
+
+__all__ = ["ServeMetrics"]
+
+
+class ServeMetrics:
+    """Thin, enabled-guarded facade over the metrics registry."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._reg = REGISTRY if registry is None else registry
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self._reg
+
+    @property
+    def enabled(self) -> bool:
+        return self._reg.enabled
+
+    # -- lifecycle events ----------------------------------------------
+    def event(self, action: str, **fields) -> None:
+        if self._reg.enabled:
+            self._reg.event("serve", action=action, **fields)
+
+    def on_submit(self, req_id: int, prompt_len: int,
+                  max_new_tokens: int) -> None:
+        if not self._reg.enabled:
+            return
+        self._reg.counter("serve.submitted_total").inc()
+        self._reg.event("serve", action="submit", req_id=req_id,
+                        prompt_len=prompt_len,
+                        max_new_tokens=max_new_tokens)
+
+    def on_reject(self, reason: str) -> None:
+        if not self._reg.enabled:
+            return
+        self._reg.counter("serve.rejected_total").inc()
+        self._reg.event("serve", action="reject", reason=reason[:200])
+
+    def on_timeout(self, req_id: int, phase: str) -> None:
+        if not self._reg.enabled:
+            return
+        self._reg.counter("serve.timeouts_total").inc()
+        self._reg.event("serve", action="timeout", req_id=req_id,
+                        phase=phase)
+
+    def on_cancel(self, req_id: int) -> None:
+        if not self._reg.enabled:
+            return
+        self._reg.counter("serve.cancelled_total").inc()
+        self._reg.event("serve", action="cancel", req_id=req_id)
+
+    def on_finish(self, req_id: int, e2e_s: float, n_tokens: int) -> None:
+        if not self._reg.enabled:
+            return
+        self._reg.counter("serve.finished_total").inc()
+        self._reg.histogram("serve.e2e_secs", unit="s").record(e2e_s)
+        self._reg.event("serve", action="finish", req_id=req_id,
+                        e2e_s=round(e2e_s, 6), n_tokens=n_tokens)
+
+    # -- token stream ---------------------------------------------------
+    def on_first_token(self, req_id: int, ttft_s: float) -> None:
+        if not self._reg.enabled:
+            return
+        self._reg.counter("serve.tokens_streamed_total").inc()
+        self._reg.histogram("serve.ttft_secs", unit="s").record(ttft_s)
+        self._reg.event("serve", action="first_token", req_id=req_id,
+                        ttft_s=round(ttft_s, 6))
+
+    def on_tokens(self, n: int, tpot_s: float) -> None:
+        """``n`` decode tokens whose mean inter-arrival was ``tpot_s``."""
+        if not self._reg.enabled:
+            return
+        self._reg.counter("serve.tokens_streamed_total").inc(n)
+        h = self._reg.histogram("serve.tpot_secs", unit="s")
+        for _ in range(n):
+            h.record(tpot_s)
+
+    def on_backpressure(self, waited_s: float) -> None:
+        if not self._reg.enabled:
+            return
+        self._reg.histogram("serve.backpressure_wait_secs",
+                            unit="s").record(waited_s)
+
+    # -- gauges ---------------------------------------------------------
+    def publish_engine(self, engine) -> None:
+        """Refresh the point-in-time gauges from engine state (called
+        once per scheduler iteration, not per token)."""
+        if not self._reg.enabled:
+            return
+        self._reg.gauge("serve.queue_depth").set(engine.queue_depth)
+        self._reg.gauge("serve.batch_occupancy").set(
+            engine.batch_occupancy())
+        self._reg.gauge("serve.kv_utilization").set(
+            engine.kv_utilization())
+        self._reg.gauge("serve.kv_free_blocks").set(
+            engine.alloc.free_blocks)

@@ -128,6 +128,32 @@ def test_bucketed_prefill_matches_legacy_engine(serve_setup):
         np.testing.assert_array_equal(out[rid], ref)
 
 
+def test_engine_aot_warm_sampled_zero_compiles(serve_setup):
+    """ISSUE 7 satellite: sampling runs at the fixed decode width, so
+    the exported sampler program covers EVERY sampled sub-batch — a
+    warm-started engine serving sampled requests records zero backend
+    compiles and reproduces the fresh engine's sampled tokens exactly."""
+    cfg, params, prompts, aot_dir, _fresh = serve_setup
+    sampling = dict(temperature=0.8, top_k=16, top_p=0.9)
+    ref_eng = _engine(cfg, params)
+    rids = [ref_eng.add_request(p, 4, seed=i + 1, **sampling)
+            for i, p in enumerate(prompts)]
+    ref = ref_eng.run_to_completion()
+
+    monitor = CompileMonitor().install()
+    try:
+        eng = _engine(cfg, params, aot_dir=aot_dir)
+        assert eng.aot_loaded, eng.aot_error
+        wids = [eng.add_request(p, 4, seed=i + 1, **sampling)
+                for i, p in enumerate(prompts)]
+        warm = eng.run_to_completion()
+    finally:
+        monitor.uninstall()
+    assert monitor.n_compiles == 0, monitor.summary()
+    for rid, wid in zip(rids, wids):
+        np.testing.assert_array_equal(warm[wid], ref[rid])
+
+
 def test_engine_config_mismatch_falls_back_with_event(serve_setup):
     """A geometry change (different pool size) must fall back to fresh
     compiles — cleanly, with the reason on the engine and an `aot`

@@ -47,6 +47,14 @@ def test_aot_warm_start_is_zero_compiles(measured):
     assert measured["serve_aot_warm"] == 0, measured
 
 
+def test_aot_warm_sampled_is_zero_compiles(measured):
+    """ISSUE 7 acceptance: the sampled-decode path is AOT-covered too —
+    a warm-started engine serving temperature/top-k requests performs
+    zero backend compiles (the fixed-width sampler program loads from
+    the artifact instead of jitting)."""
+    assert measured["serve_aot_warm_sampled"] == 0, measured
+
+
 def test_every_scenario_has_a_budget(measured):
     budgets = compile_budget.load_ledger()["budgets"]
     assert set(measured) <= set(budgets), (set(measured), set(budgets))

@@ -316,6 +316,103 @@ def test_moe_engine_with_prefix_cache(model):
     np.testing.assert_array_equal(res[b], want)
 
 
+def _assert_pool_consistent(eng):
+    """Full _RefPool invariant: every block is free XOR referenced, and
+    each refcount equals (slots holding it) + (1 if prefix-indexed)."""
+    held = {}
+    for pages in eng.slot_pages:
+        for p in pages:
+            held[p] = held.get(p, 0) + 1
+    for p in eng.prefix_index.values():
+        held[p] = held.get(p, 0) + 1
+    free = set(eng.alloc._free)
+    for p, r in eng.alloc.ref.items():
+        assert p not in free, f"block {p} free AND ref={r}"
+        assert held.get(p, 0) == r, \
+            f"block {p}: ref={r}, holders={held.get(p, 0)}"
+    for p in held:
+        assert p in eng.alloc.ref, f"block {p} held but unreferenced"
+    assert len(free) + len(eng.alloc.ref) == eng.alloc.num_blocks
+    rep = eng.kv_leak_report()
+    assert rep["leaked"] == 0 and rep["unaccounted"] == 0, rep
+
+
+def test_cancel_accounting_queued_phase(model):
+    """ISSUE 7 regression: a WAITING request holds no page references —
+    cancelling it must not touch the pool, and the invariant must hold
+    through the subsequent drain."""
+    cfg, params = model
+    prefix = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    p1 = np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (3,))
+                         .astype(np.int32)])
+    p2 = np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (5,))
+                         .astype(np.int32)])
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=1,
+                                   block_size=8, num_blocks=8)
+    a = eng.add_request(p1, 8)
+    b = eng.add_request(p2, 8)           # queued: slot busy after step
+    eng.step()
+    free_before = eng.alloc.free_blocks
+    refs_before = dict(eng.alloc.ref)
+    assert eng.cancel(b)                 # waiting-queue phase
+    assert eng.alloc.free_blocks == free_before
+    assert eng.alloc.ref == refs_before  # untouched: no refs were held
+    _assert_pool_consistent(eng)
+    out = eng.run_to_completion()
+    assert a in out and b not in out
+    _assert_pool_consistent(eng)
+
+
+def test_cancel_accounting_scheduled_phase_prefix_shared(model):
+    """ISSUE 7 regression: cancelling a SCHEDULED request that reuses
+    prefix-cached blocks must release each of its references exactly
+    once — shared pages stay alive for the index (and other hitters),
+    private pages return to the free list."""
+    cfg, params = model
+    prefix = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    p1 = np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (3,))
+                         .astype(np.int32)])
+    p2 = np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (5,))
+                         .astype(np.int32)])
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=2,
+                                   block_size=8, num_blocks=16)
+    a = eng.add_request(p1, 6)
+    eng.run_to_completion()              # indexes the 2 prefix blocks
+    _assert_pool_consistent(eng)
+    b = eng.add_request(p2, 6)           # admits via prefix-cache hit
+    eng.step()
+    assert eng.stats["prefix_blocks_reused"] >= 2
+    shared = [eng.prefix_index[k] for k in eng.prefix_index]
+    assert any(r >= 2 for p, r in eng.alloc.ref.items() if p in shared)
+    assert eng.cancel(b)                 # scheduled phase, mid-stream
+    _assert_pool_consistent(eng)
+    # shared pages survive with exactly the index's reference
+    for p in shared:
+        assert eng.alloc.ref.get(p) == 1, eng.alloc.ref
+    # the same prefix must still hit from the intact index
+    c = eng.add_request(p2, 6)
+    out = eng.run_to_completion()
+    assert c in out
+    _assert_pool_consistent(eng)
+
+
+def test_refpool_double_free_raises(model):
+    """The pool refuses accounting drift loudly: releasing or sharing a
+    block with no live reference is a typed error, not silent KV
+    corruption of whoever owns the re-handed-out page."""
+    from paddle_tpu.inference.serving import _RefPool
+    pool = _RefPool(4)
+    got = pool.acquire(2)
+    pool.release(got)
+    with pytest.raises(RuntimeError, match="double free"):
+        pool.release(got)
+    with pytest.raises(RuntimeError, match="no live reference"):
+        pool.share(got)
+    # still serviceable after the failed calls
+    assert pool.free_blocks == 4
+    assert pool.acquire(4) is not None
+
+
 def test_cancel_queued_and_active(model):
     cfg, params = model
     p = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)

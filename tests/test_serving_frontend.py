@@ -1,0 +1,380 @@
+"""Streaming serving front-end (ISSUE 7): request lifecycle, streaming
+delivery, SLO-aware admission, deadlines, and the Poisson loadgen.
+
+Load-bearing contracts (tier-1 — this is the serve path the "millions
+of users" pillar is judged on):
+
+* tokens received through the stream are BIT-IDENTICAL to the batch
+  ``run_to_completion()`` results for the same request (greedy and
+  sampled), and they arrive while the request is RUNNING — not at
+  retire;
+* a slow consumer backpressures a bounded stream without dropping or
+  reordering tokens;
+* deadline expiry mid-decode frees the engine slot (and its refcounted
+  KV pages) within one scheduler iteration;
+* admission control rejects instead of queueing unboundedly;
+* a seeded loadgen run with cancellations and timeouts drains with
+  ZERO leaked KV blocks.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu import parallel as dist
+from paddle_tpu.inference.serving import ContinuousBatchingEngine
+from paddle_tpu.models.llama import build_llama_train_step, llama_tiny
+from paddle_tpu.observability import MemorySink, REGISTRY
+from paddle_tpu.parallel.topology import HybridTopology, set_topology
+from paddle_tpu.serving import (AdmissionConfig, LoadGenConfig,
+                                PoissonLoadGenerator, RequestAborted,
+                                RequestRejected, RequestState,
+                                ServingFrontend)
+
+rng = np.random.default_rng(0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama_tiny()
+    topo = dist.init_topology(devices=jax.devices()[:1])
+    _, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1)
+    params = init_fn(0)["params"]
+    set_topology(HybridTopology())
+    return cfg, params
+
+
+def _engine(model, **kw):
+    cfg, params = model
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("prefill_buckets", (8,))
+    return ContinuousBatchingEngine(cfg, params, **kw)
+
+
+def _prompt(model, n):
+    return rng.integers(0, model[0].vocab_size, (n,)).astype(np.int32)
+
+
+def _assert_no_leaks(eng):
+    rep = eng.kv_leak_report()
+    assert rep["leaked"] == 0 and rep["unaccounted"] == 0, rep
+
+
+# ---------------------------------------------------------------------
+# streaming semantics
+# ---------------------------------------------------------------------
+def test_stream_bit_identical_to_batch(model):
+    """Streamed token ids == batch run_to_completion ids, for greedy AND
+    sampled requests with the same seeds."""
+    prompts = [_prompt(model, n) for n in (5, 9, 3)]
+    kwargs = [dict(), dict(temperature=0.8, top_k=20, seed=7), dict()]
+
+    ref_eng = _engine(model)
+    rids = [ref_eng.add_request(p, 6, **kw)
+            for p, kw in zip(prompts, kwargs)]
+    ref = ref_eng.run_to_completion()
+
+    fe = ServingFrontend(_engine(model))
+    handles = [fe.submit(p, 6, **kw) for p, kw in zip(prompts, kwargs)]
+    streamed = [list(h) for h in handles]   # iteration drives the pump
+    for h, toks, rid, p in zip(handles, streamed, rids, prompts):
+        assert h.state is RequestState.FINISHED
+        full = np.concatenate([p, np.asarray(toks, np.int32)])
+        np.testing.assert_array_equal(full, ref[rid])
+        np.testing.assert_array_equal(h.result(), ref[rid])
+    _assert_no_leaks(fe.engine)
+
+
+def test_tokens_stream_before_retire(model):
+    """Tokens must be observable while the request is still RUNNING —
+    delivery per engine step, not a result dump at retirement."""
+    fe = ServingFrontend(_engine(model))
+    h = fe.submit(_prompt(model, 5), 8)
+    fe.step()
+    assert h.state is RequestState.RUNNING
+    assert h.n_streamed >= 1          # prefill's first token, streamed
+    seen_running = h.n_streamed
+    h.result()
+    assert h.state is RequestState.FINISHED
+    assert h.n_streamed == 8 and seen_running < 8
+
+
+def test_slow_consumer_backpressure_no_drop_no_reorder(model):
+    """Bounded stream + threaded driver: a consumer slower than the
+    producer blocks the producer (recorded backpressure wait) and still
+    receives every token in order."""
+    REGISTRY.reset()
+    REGISTRY.enable()
+    try:
+        fe = ServingFrontend(_engine(model), stream_capacity=2,
+                             backpressure_timeout_s=30.0)
+        fe.start()
+        try:
+            p = _prompt(model, 6)
+            h = fe.submit(p, 12)
+            got = []
+            for tok in h:
+                time.sleep(0.03)               # slower than decode
+                got.append(tok)
+        finally:
+            fe.stop()
+        solo = _engine(model, max_batch=1)
+        rid = solo.add_request(p, 12)
+        want = solo.run_to_completion()[rid]
+        np.testing.assert_array_equal(np.asarray(got, np.int32),
+                                      want[len(p):])
+        assert h.backpressure_wait_s > 0.0
+        hist = REGISTRY.get("serve.backpressure_wait_secs")
+        assert hist is not None and hist.count >= 1
+    finally:
+        REGISTRY.disable()
+        REGISTRY.reset()
+
+
+def test_cancel_mid_stream_frees_blocks(model):
+    """handle.cancel() mid-decode frees the slot + refcounted pages at
+    once; the batchmate's output is unaffected (still bit-identical to
+    its solo run)."""
+    pa, pb = _prompt(model, 5), _prompt(model, 9)
+    solo = _engine(model, max_batch=1)
+    rid = solo.add_request(pb, 6)
+    want = solo.run_to_completion()[rid]
+
+    fe = ServingFrontend(_engine(model))
+    ha = fe.submit(pa, 40)
+    hb = fe.submit(pb, 6)
+    fe.step()
+    fe.step()
+    assert ha.n_streamed >= 2
+    assert ha.cancel()
+    assert not ha.cancel()                     # idempotent-false
+    assert ha.state is RequestState.CANCELLED
+    assert fe.engine.active_requests == 1      # only hb keeps a slot
+    fe.run_until_drained(timeout_s=120)
+    np.testing.assert_array_equal(hb.result(), want)
+    with pytest.raises(RequestAborted):
+        ha.result()
+    _assert_no_leaks(fe.engine)
+
+
+# ---------------------------------------------------------------------
+# deadlines / shedding
+# ---------------------------------------------------------------------
+def test_deadline_mid_decode_frees_slot_within_one_step(model):
+    now = [0.0]
+    fe = ServingFrontend(_engine(model), clock=lambda: now[0])
+    h = fe.submit(_prompt(model, 5), 50, deadline_s=10.0)
+    fe.step()
+    fe.step()
+    assert h.state is RequestState.RUNNING and h.n_streamed >= 1
+    before = h.n_streamed
+    now[0] = 11.0
+    fe.step()                                   # ONE iteration
+    assert h.state is RequestState.TIMED_OUT
+    assert h.reason == "deadline"
+    assert fe.engine.active_requests == 0       # slot freed
+    assert h.n_streamed >= before               # partial stream kept
+    _assert_no_leaks(fe.engine)
+
+
+def test_max_queue_time_sheds_waiting_request(model):
+    """A request that cannot get a slot within its queue budget is shed
+    as TIMED_OUT without ever running; the running request finishes."""
+    now = [0.0]
+    fe = ServingFrontend(_engine(model, max_batch=1),
+                         clock=lambda: now[0])
+    h1 = fe.submit(_prompt(model, 5), 30)
+    h2 = fe.submit(_prompt(model, 4), 4, max_queue_time_s=5.0)
+    fe.step()
+    assert h2.state is RequestState.QUEUED and h2.n_streamed == 0
+    now[0] = 6.0
+    fe.step()
+    assert h2.state is RequestState.TIMED_OUT
+    assert h2.reason == "max_queue_time"
+    assert h1.state is RequestState.RUNNING     # untouched
+    h1.cancel()
+    _assert_no_leaks(fe.engine)
+
+
+# ---------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------
+def test_admission_rejects_when_queue_full(model):
+    REGISTRY.reset()
+    REGISTRY.enable()
+    try:
+        fe = ServingFrontend(
+            _engine(model, max_batch=1),
+            admission=AdmissionConfig(max_queue_len=1))
+        h1 = fe.submit(_prompt(model, 5), 20)   # will occupy the slot
+        fe.step()
+        h2 = fe.submit(_prompt(model, 5), 4)    # waits (1 queued)
+        h3 = fe.submit(_prompt(model, 5), 4)    # over max_queue_len
+        assert h3.state is RequestState.REJECTED
+        assert "queue full" in h3.reason
+        with pytest.raises(RequestRejected):
+            h3.result()
+        with pytest.raises(RequestRejected):
+            next(iter(h3))
+        assert REGISTRY.get("serve.rejected_total").value == 1
+        assert h2.state is not RequestState.REJECTED
+        fe.close()                               # cancels h1/h2
+        _assert_no_leaks(fe.engine)
+    finally:
+        REGISTRY.disable()
+        REGISTRY.reset()
+
+
+def test_admission_rejects_on_kv_demand(model):
+    fe = ServingFrontend(
+        _engine(model, num_blocks=8),
+        admission=AdmissionConfig(kv_demand_factor=1.0))
+    h1 = fe.submit(_prompt(model, 8), 24)       # 4 of 8 blocks
+    h2 = fe.submit(_prompt(model, 8), 24)       # 8 of 8: at the cap
+    h3 = fe.submit(_prompt(model, 8), 8)        # over 1.0x demand
+    assert h3.state is RequestState.REJECTED
+    assert "kv pool saturated" in h3.reason
+    assert h1.state is not RequestState.REJECTED
+    assert h2.state is not RequestState.REJECTED
+    fe.close()
+    _assert_no_leaks(fe.engine)
+
+
+def test_impossible_request_is_rejected_not_raised(model):
+    """A request no drain could ever admit (more pages than the pool)
+    is load-shedding territory for a front door: REJECTED handle, not
+    an exception mid-traffic.  Malformed requests still raise."""
+    fe = ServingFrontend(_engine(model, num_blocks=4, max_batch=1))
+    h = fe.submit(np.zeros(24, np.int32), 24)
+    assert h.state is RequestState.REJECTED
+    with pytest.raises(ValueError):
+        fe.submit(np.zeros(0, np.int32), 4)     # empty prompt: a bug
+    with pytest.raises(ValueError):
+        fe.submit(np.zeros(4, np.int32), 0)     # zero budget: a bug
+
+
+# ---------------------------------------------------------------------
+# telemetry + crash behavior
+# ---------------------------------------------------------------------
+def test_serve_telemetry_gauges_and_events(model):
+    REGISTRY.reset()
+    REGISTRY.enable()
+    sink = MemorySink()
+    REGISTRY.add_sink(sink)
+    try:
+        fe = ServingFrontend(_engine(model))
+        h = fe.submit(_prompt(model, 5), 4)
+        fe.run_until_drained(timeout_s=120)
+        assert h.state is RequestState.FINISHED
+        assert REGISTRY.get("serve.submitted_total").value == 1
+        assert REGISTRY.get("serve.finished_total").value == 1
+        assert REGISTRY.get("serve.tokens_streamed_total").value == 4
+        assert REGISTRY.get("serve.ttft_secs").count == 1
+        occ = REGISTRY.get("serve.batch_occupancy")
+        util = REGISTRY.get("serve.kv_utilization")
+        assert occ is not None and occ.value == 0.0     # drained
+        assert util is not None and 0.0 <= util.value <= 1.0
+        actions = [r.get("action") for r in sink.records
+                   if r.get("kind") == "serve"]
+        for expected in ("submit", "first_token", "finish"):
+            assert expected in actions, actions
+    finally:
+        REGISTRY.remove_sink(sink)
+        REGISTRY.disable()
+        REGISTRY.reset()
+
+
+def test_engine_crash_aborts_streams(model):
+    """An engine failure mid-pump surfaces on the frontend AND
+    terminates every live handle — consumers never hang on a dead
+    scheduler."""
+    fe = ServingFrontend(_engine(model))
+    h = fe.submit(_prompt(model, 5), 8)
+    fe.step()
+
+    def boom():
+        raise RuntimeError("injected engine failure")
+
+    fe.engine.step = boom
+    with pytest.raises(RuntimeError, match="injected"):
+        fe.step()
+    assert fe.error is not None
+    assert h.state is RequestState.CANCELLED
+    assert "frontend crashed" in h.reason
+    with pytest.raises(RequestAborted):
+        h.result()
+
+
+# ---------------------------------------------------------------------
+# loadgen smoke (the CI acceptance scenario)
+# ---------------------------------------------------------------------
+def _run_loadgen(model, seed=3):
+    fe = ServingFrontend(
+        _engine(model, num_blocks=48),
+        admission=AdmissionConfig(max_queue_len=64))
+    gen = PoissonLoadGenerator(fe, LoadGenConfig(
+        n_requests=24, rate_rps=300.0, seed=seed, prompt_len=(3, 10),
+        max_new_tokens=(3, 8), sampled_fraction=0.25,
+        cancel_fraction=0.2, cancel_after_tokens=2,
+        slo_ttft_s=60.0, slo_tpot_s=30.0))
+    return fe, gen.run()
+
+
+def test_loadgen_smoke_deterministic_no_leaks(model):
+    """Fixed-seed Poisson run with mid-stream cancellations: nonzero
+    streamed tokens, every request reaches a terminal state, zero
+    leaked KV blocks after drain, and the report carries the percentile
+    fields the bench row publishes."""
+    fe, rep = _run_loadgen(model)
+    assert rep.total_streamed_tokens > 0
+    assert (rep.finished + rep.rejected + rep.cancelled
+            + rep.timed_out) == rep.n_requests
+    assert rep.finished > 0 and rep.cancelled > 0
+    assert rep.kv_leaks["leaked"] == 0
+    assert rep.kv_leaks["unaccounted"] == 0
+    assert fe.engine.active_requests == 0 and fe.engine.queue_depth == 0
+    assert rep.ttft_s is not None
+    for key in ("p50", "p95", "p99"):
+        assert rep.ttft_s[key] > 0.0
+    assert rep.tokens_per_s > 0.0
+    assert rep.goodput_rps > 0.0          # generous SLOs: all good
+    d = rep.to_dict()
+    assert d["kv_leaked_blocks"] == 0 and "goodput_rps" in d
+
+
+def test_loadgen_with_timeouts_drains_clean(model):
+    """ISSUE 7 acceptance: a run where load shedding actually fires —
+    queue-time budgets kill waiting requests mid-traffic — still drains
+    with zero leaked KV blocks and every request terminal."""
+    fe = ServingFrontend(_engine(model, num_blocks=48))
+    gen = PoissonLoadGenerator(fe, LoadGenConfig(
+        n_requests=30, rate_rps=500.0, seed=11, prompt_len=(4, 10),
+        max_new_tokens=(8, 16), cancel_fraction=0.1,
+        max_queue_time_s=0.1, slo_ttft_s=60.0, slo_tpot_s=30.0))
+    rep = gen.run()
+    assert (rep.finished + rep.rejected + rep.cancelled
+            + rep.timed_out) == rep.n_requests
+    assert rep.finished >= 1          # head of line always runs
+    assert rep.timed_out >= 1         # a 500 rps burst on 2 slots sheds
+    assert rep.kv_leaks["leaked"] == 0
+    assert rep.kv_leaks["unaccounted"] == 0
+    assert fe.engine.active_requests == 0 and fe.engine.queue_depth == 0
+
+
+def test_loadgen_outputs_reproducible(model):
+    """Same seed twice: the same requests finish with the same token
+    ids (wall-clock shifts scheduling, but the engine pins per-request
+    results independent of batch composition)."""
+    _, rep1 = _run_loadgen(model, seed=5)
+    _, rep2 = _run_loadgen(model, seed=5)
+    states1 = [r["state"] for r in rep1.per_request]
+    states2 = [r["state"] for r in rep2.per_request]
+    assert states1 == states2
+    for r1, r2 in zip(rep1.per_request, rep2.per_request):
+        if r1["state"] == "FINISHED" and r2["state"] == "FINISHED":
+            assert r1["n_tokens"] == r2["n_tokens"]
