@@ -19,11 +19,13 @@ The recompile-budget ratchet over this subsystem lives in
 ``docs/aot.md`` for the artifact layout and policies.
 """
 
-from .artifact import (AotArtifactCorruptError, AotDonationError,
-                       AotError, AotManifestMismatchError, ArtifactStore,
+from .artifact import (LATEST_POINTER, AotArtifactCorruptError,
+                       AotDonationError, AotError,
+                       AotManifestMismatchError, ArtifactStore,
                        args_signature, config_hash,
                        donation_deserialize_safe, environment_fingerprint,
-                       export_compiled)
+                       export_compiled, new_generation,
+                       resolve_artifact_dir)
 from .buckets import DEFAULT_CHUNK_BUCKETS, ShapeBucketRegistry
 from .serve import engine_config, export_engine, load_engine_artifacts
 from .train import (AotTrainStep, export_jit_apply, export_train_step,
@@ -33,7 +35,8 @@ __all__ = [
     "AotError", "AotArtifactCorruptError", "AotManifestMismatchError",
     "AotDonationError", "ArtifactStore", "args_signature", "config_hash",
     "donation_deserialize_safe", "environment_fingerprint",
-    "export_compiled",
+    "export_compiled", "new_generation", "resolve_artifact_dir",
+    "LATEST_POINTER",
     "DEFAULT_CHUNK_BUCKETS", "ShapeBucketRegistry",
     "engine_config", "export_engine", "load_engine_artifacts",
     "AotTrainStep", "export_jit_apply", "export_train_step",

@@ -48,11 +48,15 @@ __all__ = [
     "AotError", "AotArtifactCorruptError", "AotManifestMismatchError",
     "AotDonationError", "ArtifactStore", "environment_fingerprint",
     "donation_deserialize_safe", "config_hash", "args_signature",
-    "fresh_backend_compile", "MANIFEST_MAGIC",
+    "fresh_backend_compile", "MANIFEST_MAGIC", "LATEST_POINTER",
+    "new_generation", "resolve_artifact_dir",
 ]
 
 MANIFEST_MAGIC = "paddle_tpu.aot.v1"
 _MANIFEST = "manifest.json"
+#: rotation-root pointer file naming the live generation subdirectory
+LATEST_POINTER = "latest"
+_GEN_PREFIX = "gen-"
 
 #: (platform, jax.__version__) pairs where deserialized DONATED
 #: executables are known to mis-execute (ISSUE 2 / CHANGES PR 2).
@@ -156,6 +160,74 @@ def _sig_matches(entry_sig, args) -> bool:
     return entry_sig == [td, leaves] or tuple(entry_sig) == (td, leaves)
 
 
+# ---------------------------------------------------------------------
+# rotation roots (ISSUE 8): long-lived fleets re-export artifacts on
+# every jax upgrade / geometry change; a ROOT directory holds numbered
+# generation subdirs plus a LATEST pointer published atomically through
+# framework.io, and gc() prunes old generations without ever touching
+# the one the pointer names
+# ---------------------------------------------------------------------
+def _generation_dirs(root: str) -> List[str]:
+    """Generation subdirectory names under ``root``, oldest first."""
+    try:
+        names = os.listdir(root)
+    except FileNotFoundError:
+        return []
+    gens = []
+    for n in names:
+        if n.startswith(_GEN_PREFIX) and os.path.isdir(
+                os.path.join(root, n)):
+            try:
+                gens.append((int(n[len(_GEN_PREFIX):]), n))
+            except ValueError:
+                continue
+    return [n for _, n in sorted(gens)]
+
+
+def new_generation(root: str, registry=None) -> "ArtifactStore":
+    """Create the next ``gen-NNNN`` subdirectory under a rotation root
+    and return an :class:`ArtifactStore` for it.  The generation is
+    INVISIBLE to loaders until :meth:`ArtifactStore.publish` moves the
+    ``latest`` pointer (write -> verify-by-construction -> publish, the
+    checkpoint-manager recipe)."""
+    gens = _generation_dirs(root)
+    nxt = 1 + (int(gens[-1][len(_GEN_PREFIX):]) if gens else 0)
+    d = os.path.join(root, f"{_GEN_PREFIX}{nxt:04d}")
+    os.makedirs(d, exist_ok=True)
+    return ArtifactStore(d, registry=registry)
+
+
+def read_latest(root: str) -> Optional[str]:
+    """The generation directory the ``latest`` pointer names, or None
+    when ``root`` is not a rotation root."""
+    try:
+        with open(os.path.join(root, LATEST_POINTER),
+                  encoding="utf-8") as f:
+            name = f.read().strip()
+    except (FileNotFoundError, NotADirectoryError):
+        return None
+    return os.path.join(root, os.path.basename(name)) if name else None
+
+
+def resolve_artifact_dir(path: str) -> str:
+    """Loader-side rotation awareness: a plain artifact directory
+    resolves to itself; a rotation root resolves through its ``latest``
+    pointer.  A pointer naming a missing generation is corruption (the
+    pointer is published atomically AFTER the generation's manifest, so
+    this can only mean someone deleted the live generation)."""
+    if os.path.exists(os.path.join(path, _MANIFEST)):
+        return path
+    pointed = read_latest(path)
+    if pointed is None:
+        return path
+    if not os.path.exists(os.path.join(pointed, _MANIFEST)):
+        raise AotArtifactCorruptError(
+            f"{path}: latest pointer names {os.path.basename(pointed)!r}"
+            " but that generation has no manifest — the live generation "
+            "was deleted out from under the pointer; re-export")
+    return pointed
+
+
 class ArtifactStore:
     """One artifact directory: a CRC'd manifest plus serialized
     executables, written atomically (framework.io durability seams) and
@@ -228,6 +300,56 @@ class ArtifactStore:
         atomic_write_bytes(
             json.dumps(self._manifest, indent=1, default=str).encode(),
             os.path.join(self.directory, _MANIFEST))
+
+    # -- rotation ------------------------------------------------------
+    def publish(self, keep_last: Optional[int] = None) -> str:
+        """Point the parent rotation root's ``latest`` at THIS
+        (fully written) generation — atomically, via the same
+        ``framework.io`` seam as checkpoint publishes, so a crash
+        mid-publish leaves the previous pointer intact and loadable.
+        With ``keep_last``, old generations are pruned afterwards
+        (pointer FIRST, then gc: the window where both generations
+        exist is the safe direction).  Returns the root."""
+        if not self.exists():
+            raise AotError(f"{self.directory}: publish() before any "
+                           "executable was put — nothing to point at")
+        root = os.path.dirname(os.path.abspath(self.directory))
+        from ..framework.io import atomic_write_bytes
+        atomic_write_bytes(
+            os.path.basename(self.directory).encode(),
+            os.path.join(root, LATEST_POINTER))
+        self._event("publish", generation=os.path.basename(
+            self.directory))
+        if keep_last is not None:
+            ArtifactStore(root, registry=self._registry).gc(
+                keep_last=keep_last)
+        return root
+
+    def gc(self, keep_last: int) -> List[str]:
+        """Prune old generations under this ROOT directory, keeping the
+        ``keep_last`` newest — and, unconditionally, whichever one the
+        ``latest`` pointer names (pointer-last semantics: the pointer is
+        the source of truth, age is not).  Returns removed paths."""
+        if keep_last < 1:
+            raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+        import shutil
+        root = self.directory
+        gens = _generation_dirs(root)
+        pointed = read_latest(root)
+        keep = set(gens[-keep_last:])
+        if pointed is not None:
+            keep.add(os.path.basename(pointed))
+        removed = []
+        for name in gens:
+            if name in keep:
+                continue
+            path = os.path.join(root, name)
+            shutil.rmtree(path, ignore_errors=True)
+            removed.append(path)
+        if removed:
+            self._event("gc", removed=len(removed),
+                        kept=sorted(keep))
+        return removed
 
     # -- read side -----------------------------------------------------
     def exists(self) -> bool:

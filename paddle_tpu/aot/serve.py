@@ -30,6 +30,13 @@ independent of padding), so exactly one sampler program exists per
 engine geometry and it is exported here next to the decode step — a
 warm-started engine with per-request sampling enabled performs zero
 backend compiles (pinned by the ``serve_aot_warm_sampled`` budget row).
+
+Speculative decoding (ISSUE 8): a speculating engine
+(``spec_config=``) owns exactly two more fixed geometries — the
+``[max_batch, window]`` draft and the ``[max_batch, k+1]`` verify —
+exported as ``spec_draft`` / ``spec_verify`` with the spec geometry in
+the config hash, so warm speculative serving is also zero backend
+compiles (``serve_spec_warm`` budget row).
 """
 
 from __future__ import annotations
@@ -51,13 +58,18 @@ __all__ = ["export_engine", "load_engine_artifacts", "engine_config"]
 _DECODE = "decode"
 _FILL = "chunk_fill_{c}"
 _SAMPLER = "sampler"
+_DRAFT = "spec_draft"
+_VERIFY = "spec_verify"
 
 
 def engine_config(engine) -> Dict[str, Any]:
     """Everything the compiled serve programs are specialized to:
-    model config, batch/pool geometry, and the weight-tree signature."""
+    model config, batch/pool geometry, the weight-tree signature, and
+    (when speculating) the draft/verify geometry — an artifact exported
+    without speculation can never half-warm-start a speculating engine,
+    it is a config mismatch and a clean fallback."""
     params_td, params_leaves = args_signature((engine.params,))
-    return {
+    cfg = {
         "kind": "continuous_batching_engine",
         "model": dataclasses.asdict(engine.cfg),
         "max_batch": engine.B,
@@ -68,6 +80,13 @@ def engine_config(engine) -> Dict[str, Any]:
         "params_treedef": params_td,
         "params_leaves": params_leaves,
     }
+    if engine.spec_config is not None:
+        spec = dict(engine.spec_config.manifest())
+        dtd, dleaves = args_signature((engine.spec_config.draft_params,))
+        spec["draft_params_treedef"] = dtd
+        spec["draft_params_leaves"] = dleaves
+        cfg["spec"] = spec
+    return cfg
 
 
 def _decode_args(engine) -> Tuple:
@@ -82,6 +101,23 @@ def _fill_args(engine, size: int) -> Tuple:
     return (engine.params, engine.pool_k, engine.pool_v,
             jnp.asarray(engine.block_table[0]), jnp.int32(0),
             jnp.asarray(np.zeros((size,), np.int32)), jnp.int32(1))
+
+
+def _draft_args(engine) -> Tuple:
+    """The fixed [max_batch, window] draft call signature."""
+    sc = engine.spec_config
+    return (sc.draft_params,
+            jnp.asarray(np.zeros((engine.B, sc.window), np.int32)),
+            jnp.asarray(np.zeros((engine.B,), np.int32)))
+
+
+def _verify_args(engine) -> Tuple:
+    """The fixed [max_batch, k+1] verify call signature (pools + page
+    table exactly as the decode step takes them)."""
+    sc = engine.spec_config
+    return (engine.params, engine.pool_k, engine.pool_v,
+            jnp.asarray(engine.block_table), jnp.asarray(engine.lengths),
+            jnp.asarray(np.zeros((engine.B, sc.k + 1), np.int32)))
 
 
 def _sampler_args(engine) -> Tuple:
@@ -99,15 +135,27 @@ def _sampler_args(engine) -> Tuple:
 
 def export_engine(engine, directory: str, *,
                   buckets: Optional[ShapeBucketRegistry] = None,
+                  rotate: bool = False, keep_last: Optional[int] = None,
                   registry=None) -> ArtifactStore:
     """Trace, lower, compile, and serialize the engine's decode step
-    plus one bucketed chunk-fill per declared prefill bucket."""
+    plus one bucketed chunk-fill per declared prefill bucket (and, for
+    a speculating engine, the draft + verify programs).
+
+    With ``rotate=True``, ``directory`` is a rotation ROOT: the export
+    lands in a fresh ``gen-NNNN`` subdirectory and is published through
+    the atomic ``latest`` pointer once complete (``keep_last`` prunes
+    older generations) — loaders passing the root as ``aot_dir`` follow
+    the pointer."""
     breg = buckets or getattr(engine, "_buckets", None) or \
         ShapeBucketRegistry(DEFAULT_CHUNK_BUCKETS)
     if breg.max_batch is None:
         breg = ShapeBucketRegistry(breg.chunk_sizes, max_batch=engine.B)
     donate = (1, 2) if donation_deserialize_safe() else ()
-    store = ArtifactStore(directory, registry=registry)
+    if rotate:
+        from .artifact import new_generation
+        store = new_generation(directory, registry=registry)
+    else:
+        store = ArtifactStore(directory, registry=registry)
     store.begin(config=engine_config(engine),
                 buckets=breg.to_manifest())
 
@@ -132,6 +180,26 @@ def export_engine(engine, directory: str, *,
         args = _sampler_args(engine)
         compiled = jax.jit(build_sampler()).lower(*args).compile()
         store.put(_SAMPLER, compiled, args)
+
+        # speculative decode (ISSUE 8): the windowed draft and the
+        # fixed-width K+1 verify are one program each per engine
+        # geometry — exported so a speculating warm start is zero
+        # backend compiles (serve_spec_warm budget row)
+        if engine.spec_config is not None:
+            from ..spec_decode import (build_draft_program,
+                                       build_verify_program)
+            sc = engine.spec_config
+            args = _draft_args(engine)
+            compiled = jax.jit(build_draft_program(
+                sc.draft_cfg, sc.window)).lower(*args).compile()
+            store.put(_DRAFT, compiled, args)
+            args = _verify_args(engine)
+            compiled = jax.jit(
+                build_verify_program(engine._build_step()),
+                donate_argnums=donate).lower(*args).compile()
+            store.put(_VERIFY, compiled, args, donate_argnums=donate)
+    if rotate:
+        store.publish(keep_last=keep_last)
     return store
 
 
@@ -139,11 +207,16 @@ def load_engine_artifacts(engine, directory: str, *, registry=None):
     """Verify + deserialize the serve executables for ``engine``.
 
     Returns ``(decode_step, {bucket: fill}, ShapeBucketRegistry,
-    sampler)``; raises an :class:`~paddle_tpu.aot.artifact.AotError`
-    subclass on version skew, geometry mismatch, corruption, or a
-    donation-unsafe artifact — the engine falls back to fresh
-    compiles.  An artifact directory from before the sampler export is
-    a manifest mismatch (re-export), not a silent half-warm start."""
+    sampler, spec_programs)`` — ``spec_programs`` is ``{}`` for a
+    non-speculating engine, else ``{"draft": ..., "verify": ...}``;
+    raises an :class:`~paddle_tpu.aot.artifact.AotError` subclass on
+    version skew, geometry mismatch, corruption, or a donation-unsafe
+    artifact — the engine falls back to fresh compiles.  An artifact
+    directory from before the sampler (or, for a speculating engine,
+    spec-program) export is a manifest mismatch (re-export), not a
+    silent half-warm start."""
+    from .artifact import resolve_artifact_dir
+    directory = resolve_artifact_dir(directory)
     store = ArtifactStore(directory, registry=registry)
     store.check_env()
     store.check_config(engine_config(engine))
@@ -167,4 +240,18 @@ def load_engine_artifacts(engine, directory: str, *, registry=None):
     decode = store.get(_DECODE)
     fills = {c: store.get(_FILL.format(c=c)) for c in breg.chunk_sizes}
     sampler = store.get(_SAMPLER)
-    return decode, fills, breg, sampler
+    spec = {}
+    if engine.spec_config is not None:
+        # the config hash already pinned the spec geometry; still match
+        # the call signatures so a drifted draft-param tree fails here
+        # (typed) rather than at first dispatch
+        if not store.matches_signature(_DRAFT, _draft_args(engine)):
+            raise AotManifestMismatchError(
+                f"{directory}: draft signature drifted from this "
+                "engine's spec geometry — re-export")
+        if not store.matches_signature(_VERIFY, _verify_args(engine)):
+            raise AotManifestMismatchError(
+                f"{directory}: verify signature drifted from this "
+                "engine's spec geometry — re-export")
+        spec = {"draft": store.get(_DRAFT), "verify": store.get(_VERIFY)}
+    return decode, fills, breg, sampler, spec

@@ -83,11 +83,16 @@ def train_config(model, args: Tuple) -> Dict[str, Any]:
 
 def export_train_step(model, inputs, labels, directory: str, *,
                       donate: Optional[bool] = None,
+                      rotate: bool = False,
+                      keep_last: Optional[int] = None,
                       registry=None) -> ArtifactStore:
     """Trace, lower, compile, and serialize the prepared ``model``'s
     jitted train step for one example batch shape — both the first-step
     (per-name optimizer state) and steady-state (fused state)
-    programs."""
+    programs.  ``rotate=True`` exports into a fresh generation under a
+    rotation ROOT and publishes the atomic ``latest`` pointer
+    (``keep_last`` prunes old generations); ``Model.prepare(aot_dir=
+    root)`` then follows the pointer."""
     if model._optimizer is None:
         raise ValueError("export_train_step needs a prepared Model "
                          "(call prepare(optimizer=..., loss=...) first)")
@@ -96,7 +101,11 @@ def export_train_step(model, inputs, labels, directory: str, *,
     donate_argnums = (0, 1, 2) if donate else ()
     jit_step = model._build_jit_step(donate=donate)
     args_init = _example_args(model, inputs, labels)
-    store = ArtifactStore(directory, registry=registry)
+    if rotate:
+        from .artifact import new_generation
+        store = new_generation(directory, registry=registry)
+    else:
+        store = ArtifactStore(directory, registry=registry)
     store.begin(config=train_config(model, args_init))
 
     with fresh_backend_compile():
@@ -112,6 +121,8 @@ def export_train_step(model, inputs, labels, directory: str, *,
         compiled = jit_step.lower(*args_steady).compile()
         store.put(_STEADY, compiled, args_steady,
                   donate_argnums=donate_argnums)
+    if rotate:
+        store.publish(keep_last=keep_last)
     return store
 
 
@@ -143,10 +154,13 @@ class AotTrainStep:
 
 def load_train_step(model, directory: str, *, registry=None
                     ) -> AotTrainStep:
-    """Verify + deserialize the train-step artifacts for ``model``.
-    Raises an AotError subclass (skew/corrupt/donation-refused) — the
-    Model falls back to a fresh ``jax.jit``."""
-    store = ArtifactStore(directory, registry=registry)
+    """Verify + deserialize the train-step artifacts for ``model``
+    (``directory`` may be a rotation root — the ``latest`` pointer is
+    followed).  Raises an AotError subclass (skew/corrupt/donation-
+    refused) — the Model falls back to a fresh ``jax.jit``."""
+    from .artifact import resolve_artifact_dir
+    store = ArtifactStore(resolve_artifact_dir(directory),
+                          registry=registry)
     store.check_env()
     return AotTrainStep(model, store)
 

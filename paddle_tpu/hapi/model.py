@@ -82,7 +82,9 @@ class Model:
         of GradScaler kwargs (optionally under a ``"scaler"`` key).
 
         ``aot_dir`` warm-starts the jitted train step from a compile
-        artifact written by ``paddle_tpu.aot.export_train_step``:
+        artifact written by ``paddle_tpu.aot.export_train_step`` (a
+        rotation ROOT — generations + ``latest`` pointer — resolves
+        through the pointer):
         matching calls run the DESERIALIZED executable (no trace/lower/
         backend-compile at first step); version skew, corruption, a
         donation-unsafe artifact, or a signature the artifacts don't

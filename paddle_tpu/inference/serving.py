@@ -172,10 +172,20 @@ class ContinuousBatchingEngine:
       aot_dir: warm-start from a compile-artifact directory written by
         ``paddle_tpu.aot.export_engine`` — the decode step and the
         bucketed chunk fills are DESERIALIZED (zero backend compiles)
-        instead of traced.  Any manifest mismatch (version skew,
-        geometry drift, corruption, donation-unsafe artifact) falls
-        back to fresh compiles with an ``aot`` telemetry event; the
-        reason is kept on ``self.aot_error``.
+        instead of traced.  A rotation ROOT (a directory holding
+        generation subdirs plus a ``latest`` pointer, see
+        ``aot.artifact``) is followed through the pointer.  Any
+        manifest mismatch (version skew, geometry drift, corruption,
+        donation-unsafe artifact) falls back to fresh compiles with an
+        ``aot`` telemetry event; the reason is kept on
+        ``self.aot_error``.
+      spec_config: a :class:`~paddle_tpu.spec_decode.SpecDecodeConfig`
+        enabling speculative decoding — every decode iteration drafts
+        ``k`` tokens per active request and verifies them in one
+        fixed-width program (``spec_decode/``).  Greedy outputs are
+        bit-identical to ``spec_config=None``; sampled outputs follow
+        the same target law via rejection sampling.  ``spec_stats()``
+        exposes acceptance counters.
 
     The engine keeps its own page table rather than reusing
     ops/paged_kv.PagedKVCache: that class sizes its table [B, num_blocks]
@@ -188,7 +198,8 @@ class ContinuousBatchingEngine:
                  block_size: int = 16, num_blocks: int = 256,
                  max_blocks_per_seq: Optional[int] = None,
                  enable_prefix_caching: bool = True,
-                 prefill_buckets=None, aot_dir: Optional[str] = None):
+                 prefill_buckets=None, aot_dir: Optional[str] = None,
+                 spec_config=None):
         if getattr(cfg, "moe_num_experts", 0) and \
                 getattr(cfg, "moe_router", "topk") != "topk":
             raise NotImplementedError("decode serves token-choice only")
@@ -240,12 +251,26 @@ class ContinuousBatchingEngine:
         self.aot_error: Optional[str] = None
         self._step = None
         self._sampler_fn = None
+        self._spec = None
+        self.spec_config = spec_config
+        # decode-phase accounting (extra.spec bench row).
+        # decode_slot_steps counts PER-SLOT decode iterations so that
+        # engine_steps_per_token is exactly 1.0 for baseline decode
+        # regardless of batching — only accepted speculation pushes it
+        # below 1.0.
+        self.decode_steps = 0
+        self.decode_slot_steps = 0
+        self.decode_tokens = 0
+        _spec_programs = {}
+        if spec_config is not None:
+            spec_config.validate_against(cfg)
         if aot_dir is not None:
             from ..aot.artifact import AotError
             from ..aot.serve import load_engine_artifacts
             try:
                 (self._step, self._bucket_fills, self._buckets,
-                 self._sampler_fn) = load_engine_artifacts(self, aot_dir)
+                 self._sampler_fn, _spec_programs) = \
+                    load_engine_artifacts(self, aot_dir)
                 self.aot_loaded = True
             except AotError as e:
                 # fresh-compile fallback, loudly: the reason stays on
@@ -265,6 +290,12 @@ class ContinuousBatchingEngine:
             # iteration and the old buffers must not stay live
             self._step = jax.jit(self._build_step(),
                                  donate_argnums=(1, 2))
+        if spec_config is not None:
+            from ..spec_decode import SpecDecodeRunner
+            self._spec = SpecDecodeRunner(
+                self, spec_config,
+                draft_fn=_spec_programs.get("draft"),
+                verify_fn=_spec_programs.get("verify"))
         self.last_logits: Optional[np.ndarray] = None   # [B, V] debug/test
 
     # ------------------------------------------------------------------
@@ -734,6 +765,19 @@ class ContinuousBatchingEngine:
             out = self.finished
             self.finished = {}
             return out
+        if self._spec is not None and self._spec.config.enabled:
+            # speculative decode: draft K, verify K+1 in one dispatch,
+            # commit the accepted prefix (spec_decode/runner.py) —
+            # greedy output is bit-identical to the baseline branch
+            pre = sum(len(self.slots[s].out) for s in active)
+            self._spec.run_decode(active)
+            self.decode_steps += 1
+            self.decode_slot_steps += len(active)
+            self.decode_tokens += \
+                sum(len(self.slots[s].out) for s in active) - pre
+            out = self.finished
+            self.finished = {}
+            return out
         self.pool_k, self.pool_v, logits = self._step(
             self.params, self.pool_k, self.pool_v,
             jnp.asarray(self.block_table), jnp.asarray(self.lengths),
@@ -758,6 +802,9 @@ class ContinuousBatchingEngine:
                 tok = int(self.last_logits[s].argmax())
             self._append_tok(req, int(tok))
             self.tokens[s] = int(tok)
+        self.decode_steps += 1
+        self.decode_slot_steps += len(active)
+        self.decode_tokens += len(active)
         out = self.finished
         self.finished = {}
         return out
@@ -816,6 +863,23 @@ class ContinuousBatchingEngine:
             "unaccounted": (self.alloc.num_blocks - self.alloc.free_blocks
                             - len(self.alloc.ref)),
         }
+
+    def spec_stats(self) -> Optional[Dict[str, object]]:
+        """Speculation counters for bench rows / serve telemetry, or
+        None when the engine decodes baseline (no ``spec_config``).
+        ``engine_steps_per_token`` counts per-slot decode iterations
+        per decode token, so baseline decode measures exactly 1.0 at
+        any batch size — < 1.0 is accepted speculation, nothing else."""
+        if self._spec is None:
+            return None
+        s: Dict[str, object] = dict(self._spec.stats)
+        s["enabled"] = self._spec.config.enabled
+        s["k"] = self._spec.config.k
+        s["acceptance_rate"] = self._spec.acceptance_rate
+        s["engine_steps_per_token"] = (
+            self.decode_slot_steps / self.decode_tokens
+            if self.decode_tokens else None)
+        return s
 
     def aot_stats(self) -> Dict[str, object]:
         """Warm-start observability for bench rows/telemetry: whether

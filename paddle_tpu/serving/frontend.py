@@ -43,6 +43,12 @@ Request lifecycle state machine::
   flight recorder (a registry sink) captures the serve event ring on
   any crash, and a driver-thread crash additionally dumps it explicitly
   and aborts every live stream so consumers never hang.
+* **Speculative decoding** — construct the engine with
+  ``spec_config=`` (``paddle_tpu/spec_decode``) and the front-end
+  serves over the draft/verify decode loop unchanged: greedy streams
+  stay bit-identical (pinned), multi-token commits arrive as ordinary
+  per-step deliveries, and the ``serve.spec.*`` gauges ride
+  :meth:`ServeMetrics.publish_engine`.
 """
 
 from __future__ import annotations

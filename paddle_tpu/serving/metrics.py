@@ -31,6 +31,23 @@ serve.e2e_secs                   histogram  submit -> finish (FINISHED only)
 serve.backpressure_wait_secs     histogram  producer blocked on full stream
 ===============================  =========  =============================
 
+Speculative-decode rows (``serve.spec.*``, live only when the engine
+has a ``spec_config``; counters recorded by ``spec_decode/runner.py``,
+gauges refreshed here per scheduler iteration; docs/spec_decode.md):
+
+================================  =========  ============================
+serve.spec.steps_total            counter    draft/verify/commit rounds
+serve.spec.proposed_total         counter    draft tokens proposed
+serve.spec.accepted_total         counter    proposals verify accepted
+serve.spec.emitted_total          counter    tokens committed via spec
+serve.spec.rollback_pages_total   counter    pages holding rolled-back KV
+serve.spec.accepted_per_step      histogram  accepted per slot per round
+serve.spec.acceptance_rate        gauge      cumulative accepted/proposed
+serve.spec.steps_per_token        gauge      per-slot decode steps/token
+                                             (baseline == 1.0; < 1.0 is
+                                             the speculation win)
+================================  =========  ============================
+
 Every recording entry point checks ``registry.enabled`` first, so a
 front-end without telemetry pays one branch per call (the PR 5
 zero-cost-disabled contract).  All of this is host-side scheduler code,
@@ -139,3 +156,12 @@ class ServeMetrics:
             engine.kv_utilization())
         self._reg.gauge("serve.kv_free_blocks").set(
             engine.alloc.free_blocks)
+        spec = engine.spec_stats() if hasattr(engine, "spec_stats") \
+            else None
+        if spec is not None:
+            if spec["acceptance_rate"] is not None:
+                self._reg.gauge("serve.spec.acceptance_rate").set(
+                    spec["acceptance_rate"])
+            if spec["engine_steps_per_token"] is not None:
+                self._reg.gauge("serve.spec.steps_per_token").set(
+                    spec["engine_steps_per_token"])

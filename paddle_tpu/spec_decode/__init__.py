@@ -1,0 +1,41 @@
+"""Speculative decoding for the continuous-batching serve path (ISSUE 8).
+
+Decode is step-latency-bound: every generated token costs one full
+target-model decode dispatch.  Speculative decoding amortizes that cost
+— a small DRAFT model proposes K tokens per active request, and ONE
+fixed-width VERIFY program scores all K+1 positions against the target
+model's paged KV, so each engine step can commit several tokens.
+
+The subsystem is lossless by construction:
+
+* greedy requests: a proposal is accepted iff it equals the target's
+  argmax at that position, and the verify program is a ``lax.scan`` of
+  the engine's own decode-step body — its logits are BIT-IDENTICAL to
+  sequential baseline decode, so the emitted stream is too (pinned);
+* sampled requests: proposals are verified with rejection sampling
+  (`sampling.py`), which provably preserves the target distribution
+  for ANY proposal distribution — the draft can only change speed,
+  never outputs.
+
+Wiring: ``ContinuousBatchingEngine(spec_config=SpecDecodeConfig(...))``
+routes every decode iteration through :class:`SpecDecodeRunner`;
+rejected tails roll back by length (their KV writes fall beyond the
+committed length, are masked by every subsequent attention, and get
+overwritten by the next append at the same positions), while the
+refcounted page pool keeps its exactly-once release accounting through
+cancels and retires mid-speculation (``kv_leak_report`` stays zero —
+regression-pinned).  The draft and verify executables are AOT-exported
+next to the decode step (``aot/serve.py``) so a warm spec-decode start
+performs ZERO backend compiles (``serve_spec_warm`` budget row).
+"""
+
+from .config import SpecDecodeConfig
+from .draft import build_draft_program
+from .runner import SpecDecodeRunner
+from .sampling import spec_sample_chain, warp_probs
+from .verify import build_verify_program
+
+__all__ = [
+    "SpecDecodeConfig", "SpecDecodeRunner", "build_draft_program",
+    "build_verify_program", "spec_sample_chain", "warp_probs",
+]

@@ -1,0 +1,80 @@
+"""Speculation knobs: which draft model, how far to speculate.
+
+The config is engine-level (one draft serves every request in the
+batch) because the verify program is specialized to ``[max_batch, k+1]``
+— per-request K would mean one compiled program per distinct K, exactly
+the shape churn the AOT subsystem exists to kill.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+__all__ = ["SpecDecodeConfig"]
+
+
+@dataclass
+class SpecDecodeConfig:
+    """Draft/verify speculation parameters.
+
+    draft_cfg / draft_params:
+        A Llama-family config + param pytree (``wte``/``head``/``lnf_w``
+        + stacked ``blocks``, the train-step layout) for the DRAFT
+        model.  Must share the target's vocabulary — draft token ids
+        are fed straight into the target's verify program.  The draft
+        runs as a windowed dense recompute (``draft.py``), so it needs
+        no KV pool of its own and no per-request state; a cancel or
+        rollback costs nothing on the draft side.
+    k:
+        Draft tokens proposed per engine step (the verify width is
+        ``k + 1``: the fed token plus k proposals).
+    window:
+        Draft context window in tokens.  The draft re-reads only the
+        last ``window`` tokens of prompt+output each proposal — a
+        fixed ``[max_batch, window]`` geometry, one compiled program.
+    enabled:
+        Master switch; False constructs the runner but decodes through
+        the baseline single-token step (A/B and incident rollback knob).
+    """
+
+    draft_cfg: Any
+    draft_params: Any
+    k: int = 4
+    window: int = 16
+    enabled: bool = True
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"spec_decode k must be >= 1, got {self.k}")
+        if self.window < 2:
+            raise ValueError(
+                f"spec_decode window must be >= 2, got {self.window} "
+                "(the draft needs at least the fed token plus context)")
+
+    def validate_against(self, target_cfg) -> None:
+        """The one compatibility rule that matters: token ids the draft
+        emits must mean the same thing to the target."""
+        if self.draft_cfg.vocab_size != target_cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab ({self.draft_cfg.vocab_size}) != target "
+                f"vocab ({target_cfg.vocab_size}) — speculative proposals "
+                "would be meaningless token ids")
+        if (self.draft_cfg.max_position_embeddings
+                < target_cfg.max_position_embeddings):
+            raise ValueError(
+                "draft max_position_embeddings "
+                f"({self.draft_cfg.max_position_embeddings}) < target's "
+                f"({target_cfg.max_position_embeddings}) — the windowed "
+                "draft rotates by ABSOLUTE position, so its RoPE table "
+                "must cover every position the target can serve")
+
+    def manifest(self) -> Dict[str, Any]:
+        """The spec geometry an AOT artifact is specialized to (the
+        draft PARAM VALUES ride in the signature check, not the hash)."""
+        return {
+            "k": self.k,
+            "window": self.window,
+            "draft_model": dataclasses.asdict(self.draft_cfg),
+        }

@@ -1,0 +1,109 @@
+"""The draft model: a windowed dense-recompute Llama forward.
+
+Proposing K tokens per engine step must not introduce per-request
+state (a draft KV pool would need its own paging, rollback, and leak
+accounting) or shape churn (one program per context length is the
+compile storm AOT kills).  So the draft is STATELESS: each proposal
+re-runs a small dense forward over the last ``window`` tokens of
+prompt+output, right-aligned in a fixed ``[max_batch, window]`` buffer
+— one compiled geometry for the whole serve lifetime, exported next to
+the decode step by ``aot/serve.py``.  Recompute is the right trade at
+draft scale: the draft exists because it is tiny, and ``window`` is
+small (default 16), so a proposal costs one [B, W] forward of a model
+chosen to be ~10x smaller than the target.
+
+The window is assembled host-side (``assemble_windows``): row ``b``
+holds the last ``min(ctx_b, W)`` tokens right-aligned, zero-padded on
+the left; positions and the causal+validity mask come from ``ctx_lens``
+inside the traced program, so RoPE phases match the tokens' ABSOLUTE
+positions (a left-truncated window still rotates token t by angle(t)).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["build_draft_program", "assemble_windows"]
+
+
+def build_draft_program(cfg, window: int):
+    """Returns ``draft(params, win [B, W] int32, ctx_lens [B] int32) ->
+    proposals [B] int32``: the greedy next token at each row's last
+    valid slot.  The argmax lives INSIDE the program (not an op-by-op
+    host call) so a warm-started engine drafts with zero backend
+    compiles — and only ``[B]`` ints cross the host boundary per
+    proposal, not ``[B, V]`` logits.  Rows with ``ctx_lens == 0``
+    (inactive engine slots) produce garbage tokens the scheduler never
+    reads."""
+    from ..inference.serving import _make_rms_ffn
+    from ..models.generation import _dense_masked_attention
+    from ..models.llama import _rope_cos_sin, _rotate_half
+    W = window
+    H, Hkv, D = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    cos_full, sin_full = _rope_cos_sin(
+        cfg.max_position_embeddings, D, cfg.rope_theta,
+        jnp.dtype(cfg.dtype), getattr(cfg, "rope_scaling", None))
+    scale = 1.0 / (D ** 0.5)
+    rms, ffn = _make_rms_ffn(cfg)
+
+    def draft(params, win, ctx_lens):
+        from ..models.generation import _collapse_blocks
+        B = win.shape[0]
+        blocks = _collapse_blocks(params["blocks"])
+        # slot i of the window holds absolute position ctx - W + i;
+        # pad slots clamp to 0 and are masked out below
+        pos = jnp.maximum(
+            ctx_lens[:, None] - W + jnp.arange(W)[None, :], 0)  # [B, W]
+        valid = jnp.arange(W)[None, :] >= (W - ctx_lens[:, None])
+        x = jnp.take(params["wte"], win, axis=0)               # [B, W, h]
+        cos = jnp.take(cos_full, pos, axis=0)                  # [B, W, D]
+        sin = jnp.take(sin_full, pos, axis=0)
+        # causal within the window AND both ends valid
+        causal = jnp.tril(jnp.ones((W, W), bool))
+        mask = (causal[None, None] & valid[:, None, None, :]
+                & valid[:, None, :, None])                     # [B,1,W,W]
+
+        def rope(t):                                           # [B,W,*,D]
+            return t * cos[:, :, None, :] \
+                + _rotate_half(t) * sin[:, :, None, :]
+
+        def body(carry, lp):
+            x = carry
+            y = rms(x, lp["ln1_w"])
+            q = (y @ lp["q_w"]).reshape(B, W, H, D)
+            k = (y @ lp["k_w"]).reshape(B, W, Hkv, D)
+            v = (y @ lp["v_w"]).reshape(B, W, Hkv, D)
+            q, k = rope(q), rope(k)
+            attn = _dense_masked_attention(q, k, v, mask, scale)
+            x = x + attn.reshape(B, W, -1) @ lp["o_w"]
+            x = x + ffn(lp, rms(x, lp["ln2_w"]))
+            return x, None
+
+        x, _ = jax.lax.scan(body, x, blocks)
+        xf = rms(x[:, -1], params["lnf_w"])                    # last slot
+        logits = jnp.einsum("bh,hv->bv", xf, params["head"],
+                            preferred_element_type=jnp.float32)
+        return jnp.argmax(logits, -1).astype(jnp.int32)
+
+    return draft
+
+
+def assemble_windows(seqs: Sequence[Sequence[int]], window: int,
+                     max_batch: int) -> tuple:
+    """Host-side window packing: ``(win [max_batch, W] int32,
+    ctx_lens [max_batch] int32)`` from per-slot token sequences (empty
+    sequence = inactive slot)."""
+    win = np.zeros((max_batch, window), np.int32)
+    ctx = np.zeros((max_batch,), np.int32)
+    for b, seq in enumerate(seqs):
+        n = len(seq)
+        ctx[b] = n
+        if n == 0:
+            continue
+        tail: List[int] = list(seq[-window:])
+        win[b, window - len(tail):] = np.asarray(tail, np.int32)
+    return win, ctx

@@ -1,0 +1,193 @@
+"""Host-side draft/verify/commit orchestration for one engine.
+
+One :class:`SpecDecodeRunner` hangs off a
+``ContinuousBatchingEngine`` (constructed when ``spec_config=`` is
+passed) and replaces the engine's single-token decode iteration:
+
+    draft xK  ──►  verify (one [B, K+1] dispatch)  ──►  commit/rollback
+
+Commit is per-slot host logic: greedy slots accept a proposal iff it
+equals the target argmax at that position (bit-identical stream —
+verify logits ARE baseline step logits, see ``verify.py``); sampled
+slots run the rejection chain of ``sampling.py`` against the warped
+target law.  Emission respects the exact baseline stop rules (first
+EOS, ``max_new_tokens``) token by token, so the streaming front-end
+never sees a token the baseline would not have streamed.
+
+State machine per decode iteration (docs/spec_decode.md):
+
+    DRAFT    k greedy proposals per active slot (windowed recompute;
+             inactive slots ride along as masked rows)
+    VERIFY   one fixed-width program writes K+1 KV positions per slot
+             and returns the K+1 next-token logit rows
+    COMMIT   per slot: accepted prefix + one correction/bonus token is
+             appended (stopping at EOS/budget); ``lengths`` advances by
+             exactly the appended count
+    ROLLBACK the rejected tail's KV writes sit beyond the committed
+             length: masked by every later attention, overwritten by
+             the next append — pages stay owned by the slot, so the
+             refcount pool never moves on rollback (``kv_leak_report``
+             stays zero through cancels mid-speculation, pinned)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import SpecDecodeConfig
+from .draft import assemble_windows, build_draft_program
+from .sampling import spec_sample_chain, warp_probs
+from .verify import build_verify_program
+
+__all__ = ["SpecDecodeRunner"]
+
+
+class SpecDecodeRunner:
+    """Speculative decode driver bound to one engine instance."""
+
+    def __init__(self, engine, config: SpecDecodeConfig, *,
+                 draft_fn=None, verify_fn=None):
+        config.validate_against(engine.cfg)
+        self.engine = engine
+        self.config = config
+        # AOT warm start hands in deserialized executables; otherwise
+        # jit lazily (an engine that never decodes never compiles them)
+        self._draft_fn = draft_fn
+        self._verify_fn = verify_fn
+        self.stats: Dict[str, int] = {
+            "spec_steps": 0, "proposed": 0, "accepted": 0,
+            "emitted": 0, "rollback_pages": 0,
+        }
+
+    # -- compiled programs ---------------------------------------------
+    def draft_fn(self):
+        if self._draft_fn is None:
+            self._draft_fn = jax.jit(build_draft_program(
+                self.config.draft_cfg, self.config.window))
+        return self._draft_fn
+
+    def verify_fn(self):
+        if self._verify_fn is None:
+            # pools are donated exactly like the decode step: verify IS
+            # the decode step, iterated
+            self._verify_fn = jax.jit(
+                build_verify_program(self.engine._build_step()),
+                donate_argnums=(1, 2))
+        return self._verify_fn
+
+    @property
+    def acceptance_rate(self) -> Optional[float]:
+        if self.stats["proposed"] == 0:
+            return None
+        return self.stats["accepted"] / self.stats["proposed"]
+
+    # -- one decode iteration ------------------------------------------
+    def run_decode(self, active: List[int]) -> None:
+        """Advance every active slot by 1..K+1 tokens (in place of the
+        engine's single-token decode)."""
+        eng = self.engine
+        K = self.config.k
+
+        # DRAFT: K greedy proposals per slot off the windowed recompute
+        seqs: List[List[int]] = []
+        for s in range(eng.B):
+            req = eng.slots[s]
+            seqs.append([] if req is None
+                        else req.prompt.tolist() + req.out)
+        proposals = np.zeros((eng.B, K), np.int32)
+        draft = self.draft_fn()
+        for i in range(K):
+            win, ctx = assemble_windows(seqs, self.config.window, eng.B)
+            tok = np.asarray(draft(self.config.draft_params,
+                                   jnp.asarray(win), jnp.asarray(ctx)),
+                             np.int32)
+            proposals[:, i] = tok
+            for s in active:
+                seqs[s].append(int(tok[s]))
+
+        # VERIFY: one fixed-width dispatch appends K+1 KV positions per
+        # slot and scores them against the target
+        tokens_mat = np.zeros((eng.B, K + 1), np.int32)
+        tokens_mat[:, 0] = eng.tokens
+        tokens_mat[:, 1:] = proposals
+        pre_lengths = eng.lengths.copy()
+        eng.pool_k, eng.pool_v, logits = self.verify_fn()(
+            eng.params, eng.pool_k, eng.pool_v,
+            jnp.asarray(eng.block_table), jnp.asarray(pre_lengths),
+            jnp.asarray(tokens_mat))
+        logits = np.asarray(logits)                     # [B, K+1, V]
+        eng.last_logits = logits[:, 0]
+
+        # COMMIT / ROLLBACK per slot
+        step_accepted = step_emitted = step_rollback = 0
+        for s in active:
+            req = eng.slots[s]
+            ell = int(pre_lengths[s])
+            if (req.temperature or 0.0) > 0.0:
+                p_dists = [warp_probs(logits[s, i], req.temperature,
+                                      req.top_k, req.top_p)
+                           for i in range(K + 1)]
+                emitted, _ = spec_sample_chain(
+                    p_dists, proposals[s].tolist(), seed=req.seed,
+                    start_position=ell + 1)
+            else:
+                emitted = []
+                for i in range(K + 1):
+                    want = int(logits[s, i].argmax())
+                    emitted.append(want)
+                    if i == K or want != int(proposals[s, i]):
+                        break
+            appended = 0
+            for t in emitted:
+                eng._append_tok(req, int(t))
+                appended += 1
+                if req.eos_pos is not None \
+                        or len(req.out) >= req.max_new_tokens:
+                    break
+            # commit: KV is live for the fed token plus the first
+            # appended-1 emitted tokens; everything past that is the
+            # rolled-back tail
+            eng.lengths[s] = ell + appended
+            eng.tokens[s] = int(req.out[-1])
+            accepted = sum(1 for i in range(min(appended, K))
+                           if emitted[i] == int(proposals[s, i]))
+            rollback = self._stale_pages(ell + appended, ell + K + 1,
+                                         eng.BS)
+            step_accepted += accepted
+            step_emitted += appended
+            step_rollback += rollback
+            self.stats["proposed"] += K
+            self.stats["accepted"] += accepted
+            self.stats["emitted"] += appended
+            self.stats["rollback_pages"] += rollback
+        self.stats["spec_steps"] += 1
+        self._record(active, step_accepted, step_emitted, step_rollback)
+
+    @staticmethod
+    def _stale_pages(committed_end: int, written_end: int,
+                     block_size: int) -> int:
+        """Pages containing KV positions [committed_end, written_end)
+        that the commit rolled back (stale until overwritten)."""
+        if written_end <= committed_end:
+            return 0
+        return (written_end - 1) // block_size \
+            - committed_end // block_size + 1
+
+    def _record(self, active: List[int], step_accepted: int,
+                step_emitted: int, step_rollback: int) -> None:
+        from ..observability import REGISTRY
+        if not REGISTRY.enabled:
+            return
+        REGISTRY.counter("serve.spec.steps_total").inc()
+        REGISTRY.counter("serve.spec.proposed_total").inc(
+            self.config.k * len(active))
+        REGISTRY.counter("serve.spec.accepted_total").inc(step_accepted)
+        REGISTRY.counter("serve.spec.emitted_total").inc(step_emitted)
+        REGISTRY.counter("serve.spec.rollback_pages_total").inc(
+            step_rollback)
+        REGISTRY.histogram("serve.spec.accepted_per_step").record(
+            step_accepted / max(len(active), 1))

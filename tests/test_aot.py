@@ -235,6 +235,104 @@ def test_missing_manifest_is_mismatch(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# rotation roots + GC (ISSUE 8 satellite)
+# ---------------------------------------------------------------------
+def _copy_generation(aot_dir, root, name):
+    """A published generation without recompiling: clone an exported
+    artifact dir under the rotation root."""
+    import shutil
+    gen = os.path.join(str(root), name)
+    shutil.copytree(aot_dir, gen)
+    return ArtifactStore(gen)
+
+
+def test_rotation_publish_resolve_and_gc(serve_setup, tmp_path):
+    """Loaders passing the ROOT as aot_dir follow the atomic `latest`
+    pointer; publish(keep_last=N) prunes generations beyond N."""
+    cfg, params, prompts, aot_dir, fresh = serve_setup
+    root = tmp_path / "root"
+    root.mkdir()
+    _copy_generation(aot_dir, root, "gen-0001").publish()
+    eng = _engine(cfg, params, aot_dir=str(root))
+    assert eng.aot_loaded, eng.aot_error
+    rid = eng.add_request(prompts[0], 4)
+    np.testing.assert_array_equal(eng.run_to_completion()[rid],
+                                  list(fresh.values())[0])
+
+    _copy_generation(aot_dir, root, "gen-0002").publish(keep_last=2)
+    _copy_generation(aot_dir, root, "gen-0003").publish(keep_last=2)
+    names = sorted(os.listdir(root))
+    assert names == ["gen-0002", "gen-0003", "latest"], names
+    assert (root / "latest").read_text().strip() == "gen-0003"
+    eng2 = _engine(cfg, params, aot_dir=str(root))
+    assert eng2.aot_loaded, eng2.aot_error
+
+
+def test_gc_never_removes_pointed_generation(serve_setup, tmp_path):
+    """Pointer-last semantics: the generation `latest` names survives
+    GC regardless of age — age prunes, the pointer decides liveness."""
+    cfg, params, _prompts, aot_dir, _fresh = serve_setup
+    root = tmp_path / "root"
+    root.mkdir()
+    oldest = _copy_generation(aot_dir, root, "gen-0001")
+    _copy_generation(aot_dir, root, "gen-0002")
+    _copy_generation(aot_dir, root, "gen-0003")
+    oldest.publish()                      # pointer at the OLDEST
+    removed = ArtifactStore(str(root)).gc(keep_last=1)
+    assert [os.path.basename(r) for r in removed] == ["gen-0002"]
+    assert sorted(os.listdir(root)) == ["gen-0001", "gen-0003", "latest"]
+    eng = _engine(cfg, params, aot_dir=str(root))
+    assert eng.aot_loaded, eng.aot_error  # still serves the pointed gen
+    with pytest.raises(ValueError, match="keep_last"):
+        ArtifactStore(str(root)).gc(keep_last=0)
+
+
+def test_pointer_publish_crash_keeps_previous_live(serve_setup, tmp_path,
+                                                   monkeypatch):
+    """A crash at pointer-publish time (tests/faults.py failed-rename
+    injector) leaves the PREVIOUS pointer intact and loadable — the
+    checkpoint-manager durability recipe, reused."""
+    from faults import SimulatedCrash, fail_replace
+    cfg, params, _prompts, aot_dir, _fresh = serve_setup
+    root = tmp_path / "root"
+    root.mkdir()
+    _copy_generation(aot_dir, root, "gen-0001").publish()
+    gen2 = _copy_generation(aot_dir, root, "gen-0002")
+    with fail_replace(monkeypatch, failures=1):
+        with pytest.raises(SimulatedCrash):
+            gen2.publish()
+    assert (root / "latest").read_text().strip() == "gen-0001"
+    eng = _engine(cfg, params, aot_dir=str(root))
+    assert eng.aot_loaded, eng.aot_error
+    gen2.publish()                        # retry succeeds
+    assert (root / "latest").read_text().strip() == "gen-0002"
+
+
+def test_rotation_bitrot_and_dangling_pointer_fall_back_typed(
+        serve_setup, tmp_path):
+    """Bit-rot on the pointed generation's manifest, or a pointer whose
+    generation was deleted, is a typed fallback — never a wrong
+    program, and the engine still serves via fresh compiles."""
+    cfg, params, prompts, aot_dir, _fresh = serve_setup
+    root = tmp_path / "root"
+    root.mkdir()
+    gen = _copy_generation(aot_dir, root, "gen-0001")
+    gen.publish()
+    corrupt_file(os.path.join(gen.directory, "manifest.json"), offset=8)
+    eng = _engine(cfg, params, aot_dir=str(root))
+    assert not eng.aot_loaded and "manifest" in eng.aot_error
+    rid = eng.add_request(prompts[0], 2)
+    assert rid in eng.run_to_completion()
+
+    root2 = tmp_path / "root2"
+    root2.mkdir()
+    (root2 / "latest").write_text("gen-0042")
+    eng2 = _engine(cfg, params, aot_dir=str(root2))
+    assert not eng2.aot_loaded
+    assert "deleted out from under" in eng2.aot_error
+
+
+# ---------------------------------------------------------------------
 # train step (hapi Model)
 # ---------------------------------------------------------------------
 class _MLP(nn.Layer):
@@ -283,6 +381,31 @@ def test_train_step_roundtrip_bit_identical(tmp_path):
     assert monitor.n_compiles == 0, monitor.summary()
     for n, p in aot.network.named_parameters():
         np.testing.assert_array_equal(want[n], np.asarray(p._value))
+
+
+def test_train_step_rotation_root_resolves_and_rotates(tmp_path):
+    """Model.prepare(aot_dir=ROOT) follows the `latest` pointer; a
+    re-export with keep_last=1 prunes the old generation and the next
+    prepare picks up the new one — the fleet upgrade loop."""
+    x, y = _batch()
+    root = str(tmp_path / "train_root")
+    export_train_step(_make_model(), [x], [y], root, rotate=True,
+                      keep_last=1)
+    assert sorted(os.listdir(root)) == ["gen-0001", "latest"]
+    m = _make_model(aot_dir=root)
+    monitor = CompileMonitor().install()
+    try:
+        m.train_batch([x], [y])
+    finally:
+        monitor.uninstall()
+    assert m._aot_error is None
+    assert monitor.n_compiles == 0, monitor.summary()
+    export_train_step(_make_model(), [x], [y], root, rotate=True,
+                      keep_last=1)
+    assert sorted(os.listdir(root)) == ["gen-0002", "latest"]
+    m2 = _make_model(aot_dir=root)
+    losses, _ = m2.train_batch([x], [y])
+    assert m2._aot_error is None and np.isfinite(losses[0])
 
 
 def test_train_step_unknown_signature_falls_back(tmp_path):

@@ -55,6 +55,13 @@ def test_aot_warm_sampled_is_zero_compiles(measured):
     assert measured["serve_aot_warm_sampled"] == 0, measured
 
 
+def test_spec_warm_start_is_zero_compiles(measured):
+    """ISSUE 8 acceptance: a warm-started SPECULATING engine —
+    deserialized draft, verify, decode, fill, and sampler programs,
+    greedy and sampled requests — performs zero backend compiles."""
+    assert measured["serve_spec_warm"] == 0, measured
+
+
 def test_every_scenario_has_a_budget(measured):
     budgets = compile_budget.load_ledger()["budgets"]
     assert set(measured) <= set(budgets), (set(measured), set(budgets))

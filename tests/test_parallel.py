@@ -13,6 +13,36 @@ from paddle_tpu import parallel as dist
 from paddle_tpu.parallel.topology import HybridTopology, set_topology
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _no_persistent_compile_cache():
+    """Deflake (ISSUE 8 satellite): this jax/XLA:CPU build (0.4.37)
+    mis-executes DONATED programs DESERIALIZED from the persistent
+    compilation cache (the ISSUE 2 bug, see test_fault_tolerance.py and
+    aot/artifact.py).  DistributedEngine's train step donates
+    params/buffers/opt-state, and every test here builds several
+    bit-for-bit identical tiny step programs — so warm reruns load the
+    broken deserialize path and the 'sharded == single-device' numerics
+    drift by ~1e-2 with a DIFFERENT test failing each run (the drifting
+    tier-1 failing set the roadmap tracked).  Opting the module out of
+    the cache makes the programs fresh-compile, which is bit-exact.
+
+    The flag alone is not enough mid-suite: ``is_cache_used`` memoizes
+    its decision at the first compile of the process (see
+    aot/artifact.py:fresh_backend_compile), so a pytest process that
+    already compiled with the cache enabled ignores the flag — the memo
+    must be reset on entry (and on exit, so later modules re-enable)."""
+    import jax
+    from jax._src import compilation_cache as _cc
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    _cc.reset_cache()         # drop the is-cache-used memo
+    jax.clear_caches()        # drop executables already deserialized
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+    _cc.reset_cache()
+
+
 @pytest.fixture(autouse=True)
 def reset_topology():
     yield

@@ -413,6 +413,40 @@ def test_refpool_double_free_raises(model):
     assert pool.acquire(4) is not None
 
 
+def test_cancel_mid_speculation_accounting(model):
+    """ISSUE 8 regression (extends the ISSUE 7 exactly-once suite): a
+    speculating slot's KV contains rolled-back tail writes and shares
+    prefix pages; cancelling it mid-speculation must satisfy the FULL
+    pool invariant (each refcount == holders), keep the prefix index
+    serving other requests, and leave the engine leak-free after
+    drain."""
+    from paddle_tpu.spec_decode import SpecDecodeConfig
+    cfg, params = model
+    prefix = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    p1 = np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (3,))
+                         .astype(np.int32)])
+    p2 = np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (5,))
+                         .astype(np.int32)])
+    eng = ContinuousBatchingEngine(
+        cfg, params, max_batch=2, block_size=8, num_blocks=16,
+        spec_config=SpecDecodeConfig(draft_cfg=cfg, draft_params=params,
+                                     k=3, window=12))
+    a = eng.add_request(p1, 6)
+    eng.run_to_completion()              # indexes the 2 prefix blocks
+    _assert_pool_consistent(eng)
+    b = eng.add_request(p2, 24)          # admits via prefix-cache hit
+    eng.step()
+    eng.step()                           # speculating over shared pages
+    assert eng.spec_stats()["spec_steps"] >= 1
+    assert eng.stats["prefix_blocks_reused"] >= 2
+    assert eng.cancel(b)                 # cancel MID-speculation
+    _assert_pool_consistent(eng)
+    c = eng.add_request(p2, 6)           # prefix index still serves
+    out = eng.run_to_completion()
+    assert c in out and b not in out
+    _assert_pool_consistent(eng)
+
+
 def test_cancel_queued_and_active(model):
     cfg, params = model
     p = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)

@@ -1,0 +1,378 @@
+"""Speculative decoding subsystem (ISSUE 8): losslessness, rollback
+accounting, AOT warm start, and serve-loop integration.
+
+The load-bearing contracts (tier-1):
+
+* greedy speculative decode emits BIT-IDENTICAL token streams to
+  baseline greedy decode — through the engine batch API and through
+  ``ServingFrontend`` (same seeds), for a good draft AND an adversarial
+  one (the draft moves speed, never outputs);
+* sampled speculative decode preserves the target distribution exactly
+  (the rejection-sampling identity, pinned on the pure chain);
+* rollback never moves the refcount pool: ``kv_leak_report`` is zero
+  after rollback-heavy runs, including cancels mid-speculation;
+* an AOT warm start of a speculating engine performs ZERO backend
+  compiles and reproduces fresh-compile tokens bit-for-bit.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu import parallel as dist
+from paddle_tpu.inference.serving import ContinuousBatchingEngine
+from paddle_tpu.models.llama import build_llama_train_step, llama_tiny
+from paddle_tpu.observability import CompileMonitor, REGISTRY
+from paddle_tpu.parallel.topology import HybridTopology, set_topology
+from paddle_tpu.serving import RequestState, ServingFrontend
+from paddle_tpu.spec_decode import (SpecDecodeConfig, spec_sample_chain,
+                                    warp_probs)
+from paddle_tpu.spec_decode.sampling import position_rng
+
+rng = np.random.default_rng(0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama_tiny()
+    topo = dist.init_topology(devices=jax.devices()[:1])
+    _, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1)
+    params = init_fn(0)["params"]
+    # an ADVERSARIAL draft: same architecture, independent random init —
+    # its proposals almost never match the target, so every accept-path
+    # corner (0 accepted, corrections, full rollback) gets exercised
+    _, init2 = build_llama_train_step(cfg, topo, num_microbatches=1)
+    weak_draft = init2(1)["params"]
+    set_topology(HybridTopology())
+    return cfg, params, weak_draft
+
+
+def _spec_cfg(model, self_draft=True, **kw):
+    cfg, params, weak = model
+    kw.setdefault("k", 3)
+    kw.setdefault("window", 12)
+    return SpecDecodeConfig(draft_cfg=cfg,
+                            draft_params=params if self_draft else weak,
+                            **kw)
+
+
+def _engine(model, spec=None, **kw):
+    cfg, params, _ = model
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 64)
+    return ContinuousBatchingEngine(cfg, params, spec_config=spec, **kw)
+
+
+def _prompts(model, ns=(5, 9, 3)):
+    return [rng.integers(0, model[0].vocab_size, (n,)).astype(np.int32)
+            for n in ns]
+
+
+def _no_leaks(eng):
+    rep = eng.kv_leak_report()
+    assert rep["leaked"] == 0 and rep["unaccounted"] == 0, rep
+
+
+# ---------------------------------------------------------------------
+# losslessness: greedy is bit-identical
+# ---------------------------------------------------------------------
+@pytest.mark.parametrize("self_draft", [True, False])
+def test_greedy_spec_bit_identical_to_baseline(model, self_draft):
+    """The pinned contract, engine level: same token arrays with and
+    without speculation, whether the draft is good (self-draft, high
+    acceptance) or adversarial (random init, ~zero acceptance)."""
+    prompts = _prompts(model)
+    base_eng = _engine(model)
+    rids = [base_eng.add_request(p, 6) for p in prompts]
+    base = base_eng.run_to_completion()
+
+    eng = _engine(model, spec=_spec_cfg(model, self_draft))
+    rids2 = [eng.add_request(p, 6) for p in prompts]
+    got = eng.run_to_completion()
+    for r1, r2 in zip(rids, rids2):
+        np.testing.assert_array_equal(base[r1], got[r2])
+    stats = eng.spec_stats()
+    assert stats["spec_steps"] > 0
+    if self_draft:
+        assert stats["acceptance_rate"] > 0.0
+        assert stats["engine_steps_per_token"] < 1.0
+    else:
+        # baseline-equivalent cost, still correct
+        assert stats["engine_steps_per_token"] == 1.0
+    _no_leaks(eng)
+
+
+def test_greedy_spec_bit_identical_through_frontend(model):
+    """The ISSUE 8 pinned acceptance test: greedy streams through
+    ``ServingFrontend`` are bit-identical with speculation on vs off,
+    token by token (not just the final arrays), with eos cut-off
+    honored mid-speculation."""
+    prompts = _prompts(model, ns=(5, 9, 3, 7))
+    fe_off = ServingFrontend(_engine(model))
+    off = [list(fe_off.submit(p, 8)) for p in prompts]
+    # pick an eos that actually appears mid-stream for one request, so
+    # the spec commit loop's early stop is exercised against baseline
+    eos = off[0][3]
+    fe_off2 = ServingFrontend(_engine(model))
+    off_eos = list(fe_off2.submit(prompts[0], 8, eos_token_id=eos))
+
+    fe_on = ServingFrontend(_engine(model, spec=_spec_cfg(model)))
+    on = [list(fe_on.submit(p, 8)) for p in prompts]
+    assert on == off
+    fe_on2 = ServingFrontend(_engine(model, spec=_spec_cfg(model)))
+    on_eos = list(fe_on2.submit(prompts[0], 8, eos_token_id=eos))
+    assert on_eos == off_eos
+    assert on_eos[-1] == eos and eos not in on_eos[:-1]
+    for fe in (fe_on, fe_on2):
+        assert fe.engine.spec_stats()["spec_steps"] > 0
+        _no_leaks(fe.engine)
+
+
+def test_sampled_spec_matches_request_law_and_is_deterministic(model):
+    """Sampled spec decode: per-request determinism by seed, divergence
+    across seeds, and independence from batch composition (the engine's
+    standing guarantee, now through the spec path)."""
+    cfg, params, _ = model
+    prompt = _prompts(model, ns=(6,))[0]
+
+    def run(batchmates, seed):
+        eng = _engine(model, spec=_spec_cfg(model))
+        rid = eng.add_request(prompt, 6, temperature=0.8, top_k=20,
+                              seed=seed)
+        for bp in batchmates:
+            eng.add_request(bp, 4)
+        out = eng.run_to_completion()[rid]
+        _no_leaks(eng)
+        return out
+
+    solo = run([], seed=7)
+    np.testing.assert_array_equal(solo, run([], seed=7))
+    mate = _prompts(model, ns=(9,))[0]
+    np.testing.assert_array_equal(solo, run([mate], seed=7))
+    assert not np.array_equal(solo, run([], seed=8))
+
+
+# ---------------------------------------------------------------------
+# rejection-sampling identity (the sampled-losslessness pin)
+# ---------------------------------------------------------------------
+def test_rejection_sampling_identity_one_hot_draft():
+    """Greedy-draft (one-hot q) chain: the emitted first token follows
+    EXACTLY the target law p, however wrong the proposal is.  This is
+    the distribution-level half of the pinned acceptance criterion."""
+    p = np.array([0.5, 0.25, 0.125, 0.0625, 0.0625])
+    proposal = 3                       # a LOW-probability proposal
+    counts = np.zeros(5)
+    n = 20000
+    for seed in range(n):
+        emitted, _ = spec_sample_chain([p, p], [proposal], seed=seed,
+                                       start_position=11)
+        counts[emitted[0]] += 1
+    tv = 0.5 * np.abs(counts / n - p).sum()
+    assert tv < 0.02, (tv, counts / n)
+
+
+def test_rejection_sampling_identity_full_q():
+    """General-q rejection (the textbook identity): accept w.p.
+    min(1, p/q), residual norm(max(p-q, 0)) — still exactly p."""
+    p = np.array([0.1, 0.6, 0.1, 0.2])
+    q = np.array([0.7, 0.1, 0.1, 0.1])   # badly mismatched draft law
+    counts = np.zeros(4)
+    n = 20000
+    for seed in range(n):
+        rg = position_rng(seed, 0)
+        x = int(rg.choice(4, p=q))       # proposal ~ q
+        emitted, _ = spec_sample_chain([p, p], [x], q_dists=[q],
+                                       seed=seed, start_position=5)
+        counts[emitted[0]] += 1
+    tv = 0.5 * np.abs(counts / n - p).sum()
+    assert tv < 0.02, (tv, counts / n)
+
+
+def test_chain_acceptance_and_bonus_semantics():
+    """Deterministic corners: a proposal with p(x)=1 always accepts and
+    the bonus draws from the K+1-th dist; p(x)=0 always rejects with a
+    residual that masks the proposal out."""
+    sure = np.array([0.0, 1.0, 0.0])
+    emitted, accepted = spec_sample_chain([sure, sure], [1], seed=3)
+    assert accepted == 1 and emitted == [1, 1]
+    p = np.array([0.5, 0.0, 0.5])
+    for seed in range(32):
+        emitted, accepted = spec_sample_chain([p, p], [1], seed=seed)
+        assert accepted == 0 and len(emitted) == 1
+        assert emitted[0] in (0, 2)      # residual masked the proposal
+
+
+def test_warp_probs_matches_sampler_semantics():
+    """warp_probs mirrors build_sampler's HF sequential-warper filters
+    (the regression cases pinned on the jax sampler in
+    test_serving_engine.py, replayed on the host law)."""
+    logits = np.full((32,), -10.0, np.float32)
+    logits[5], logits[9] = 4.0, 3.9
+    p = warp_probs(logits, 1.0, 2, None)
+    assert set(np.nonzero(p)[0]) == {5, 9}
+    np.testing.assert_allclose(p.sum(), 1.0, rtol=1e-12)
+    # sequential semantics: top-p over the top-k-FILTERED mass
+    logits = np.zeros((32,), np.float32)
+    logits[5], logits[9] = 8.0, 4.0
+    p = warp_probs(logits, 1.0, 2, 0.95)
+    assert set(np.nonzero(p)[0]) == {5}
+    # temperature-only: plain softmax
+    p = warp_probs(np.array([0.0, np.log(3.0)]), 1.0, None, None)
+    np.testing.assert_allclose(p, [0.25, 0.75], atol=1e-12)
+
+
+# ---------------------------------------------------------------------
+# rollback + pool accounting (engine hardening)
+# ---------------------------------------------------------------------
+def test_cancel_mid_speculation_no_leak(model):
+    """ISSUE 8 hardening: cancelling mid-speculation (the slot's KV
+    already contains rolled-back tail writes) releases every page
+    exactly once and the batchmate's stream is unaffected."""
+    prompts = _prompts(model, ns=(5, 9))
+    base_eng = _engine(model, max_batch=1)
+    rid = base_eng.add_request(prompts[1], 8)
+    want = base_eng.run_to_completion()[rid]
+
+    eng = _engine(model, spec=_spec_cfg(model))
+    a = eng.add_request(prompts[0], 40)
+    b = eng.add_request(prompts[1], 8)
+    eng.step()
+    eng.step()
+    assert eng.spec_stats()["spec_steps"] >= 1
+    assert eng.cancel(a)                   # mid-speculation cancel
+    _no_leaks(eng)
+    out = eng.run_to_completion()
+    np.testing.assert_array_equal(out[b], want)
+    _no_leaks(eng)
+    assert eng.alloc.free_blocks + len(eng.prefix_index) \
+        == eng.alloc.num_blocks
+
+
+def test_rollback_heavy_run_with_cancels_drains_clean(model):
+    """The adversarial draft rejects nearly everything — every step is
+    rollback-heavy — while cancels land mid-stream; after drain the
+    refcount pool cross-check must be exactly clean."""
+    from paddle_tpu.serving import LoadGenConfig, PoissonLoadGenerator
+    eng = _engine(model, spec=_spec_cfg(model, self_draft=False),
+                  num_blocks=48)
+    fe = ServingFrontend(eng)
+    rep = PoissonLoadGenerator(fe, LoadGenConfig(
+        n_requests=12, rate_rps=300.0, seed=5, prompt_len=(3, 10),
+        max_new_tokens=(3, 8), sampled_fraction=0.25,
+        cancel_fraction=0.3, cancel_after_tokens=1,
+        slo_ttft_s=60.0, slo_tpot_s=30.0)).run()
+    assert rep.cancelled > 0 and rep.finished > 0
+    assert rep.kv_leaks["leaked"] == 0
+    assert rep.kv_leaks["unaccounted"] == 0
+    stats = eng.spec_stats()
+    assert stats["rollback_pages"] > 0     # speculation actually rolled back
+    _no_leaks(eng)
+
+
+def test_spec_disabled_knob_runs_baseline_path(model):
+    """enabled=False is the incident rollback switch: construction
+    succeeds, decode takes the baseline branch, stats say so."""
+    prompts = _prompts(model)
+    eng = _engine(model, spec=_spec_cfg(model, enabled=False))
+    rids = [eng.add_request(p, 5) for p in prompts]
+    got = eng.run_to_completion()
+    base = _engine(model)
+    rids2 = [base.add_request(p, 5) for p in prompts]
+    want = base.run_to_completion()
+    for r1, r2 in zip(rids, rids2):
+        np.testing.assert_array_equal(want[r2], got[r1])
+    stats = eng.spec_stats()
+    assert stats["enabled"] is False and stats["spec_steps"] == 0
+    assert stats["engine_steps_per_token"] == 1.0
+
+
+def test_spec_config_validation(model):
+    cfg, params, _ = model
+    import dataclasses
+    bad_vocab = dataclasses.replace(cfg, vocab_size=cfg.vocab_size + 1)
+    with pytest.raises(ValueError, match="vocab"):
+        _engine(model, spec=SpecDecodeConfig(draft_cfg=bad_vocab,
+                                             draft_params=params))
+    bad_pos = dataclasses.replace(
+        cfg, max_position_embeddings=cfg.max_position_embeddings // 2)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        _engine(model, spec=SpecDecodeConfig(draft_cfg=bad_pos,
+                                             draft_params=params))
+    with pytest.raises(ValueError, match="k must be"):
+        SpecDecodeConfig(draft_cfg=cfg, draft_params=params, k=0)
+
+
+# ---------------------------------------------------------------------
+# serve telemetry
+# ---------------------------------------------------------------------
+def test_spec_metrics_reach_registry(model):
+    REGISTRY.reset()
+    REGISTRY.enable()
+    try:
+        fe = ServingFrontend(_engine(model, spec=_spec_cfg(model)))
+        h = fe.submit(_prompts(model, ns=(5,))[0], 6)
+        fe.run_until_drained(timeout_s=120)
+        assert h.state is RequestState.FINISHED
+        assert REGISTRY.get("serve.spec.steps_total").value >= 1
+        assert REGISTRY.get("serve.spec.proposed_total").value >= 3
+        acc = REGISTRY.get("serve.spec.acceptance_rate")
+        spt = REGISTRY.get("serve.spec.steps_per_token")
+        assert acc is not None and 0.0 <= acc.value <= 1.0
+        assert spt is not None and 0.0 < spt.value <= 1.0
+        assert REGISTRY.get("serve.spec.accepted_per_step").count >= 1
+    finally:
+        REGISTRY.disable()
+        REGISTRY.reset()
+
+
+# ---------------------------------------------------------------------
+# AOT warm start (zero compiles, bit-identical)
+# ---------------------------------------------------------------------
+def test_spec_aot_warm_start_zero_compiles_bit_identical(model, tmp_path):
+    from paddle_tpu.aot import export_engine
+    prompts = _prompts(model)
+
+    def mk(aot_dir=None):
+        return _engine(model, spec=_spec_cfg(model),
+                       prefill_buckets=(8,), aot_dir=aot_dir)
+
+    aot_dir = str(tmp_path / "spec_aot")
+    export_engine(mk(), aot_dir)
+
+    fresh = mk()
+    rids = [fresh.add_request(p, 6) for p in prompts]
+    want = fresh.run_to_completion()
+
+    monitor = CompileMonitor().install()
+    try:
+        warm = mk(aot_dir=aot_dir)
+        rids2 = [warm.add_request(p, 6) for p in prompts]
+        got = warm.run_to_completion()
+    finally:
+        monitor.uninstall()
+    assert warm.aot_loaded, warm.aot_error
+    assert monitor.n_compiles == 0, monitor.n_compiles
+    for r1, r2 in zip(rids, rids2):
+        np.testing.assert_array_equal(want[r1], got[r2])
+    assert warm.spec_stats()["spec_steps"] > 0
+
+
+def test_spec_engine_rejects_prespec_artifacts(model, tmp_path):
+    """An artifact dir exported WITHOUT speculation must be a clean
+    config-mismatch fallback for a speculating engine — never a
+    half-warm start missing the draft/verify programs."""
+    from paddle_tpu.aot import export_engine
+    aot_dir = str(tmp_path / "nospec_aot")
+    export_engine(_engine(model, prefill_buckets=(8,)), aot_dir)
+    eng = _engine(model, spec=_spec_cfg(model), prefill_buckets=(8,),
+                  aot_dir=aot_dir)
+    assert not eng.aot_loaded
+    assert eng.aot_error is not None
+    # ... and it still serves correctly via fresh compiles
+    p = _prompts(model, ns=(5,))[0]
+    rid = eng.add_request(p, 4)
+    out = eng.run_to_completion()[rid]
+    base = _engine(model, prefill_buckets=(8,))
+    rid2 = base.add_request(p, 4)
+    np.testing.assert_array_equal(base.run_to_completion()[rid2], out)

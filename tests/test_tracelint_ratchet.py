@@ -79,6 +79,21 @@ def test_serving_has_zero_tl001_tl006():
             assert n == 0, f"baseline carries {rule} debt in {path}"
 
 
+def test_spec_decode_has_zero_tl001_tl006():
+    """ISSUE 8 contract: speculative decoding is host-side scheduling
+    around two traced programs — no host-sync in traced code (TL001;
+    the draft/verify closures must stay pure) and no silent broad
+    excepts (TL006; a swallowed commit/rollback error would corrupt the
+    accepted-prefix accounting) — live scan AND committed ledger."""
+    tree = "paddle_tpu/spec_decode/"
+    live = [f for f in _current_findings()
+            if f.rule in ("TL001", "TL006") and f.path.startswith(tree)]
+    assert live == [], [f.format() for f in live]
+    for (rule, path), n in baseline_mod.load().items():
+        if rule in ("TL001", "TL006") and path.startswith(tree):
+            assert n == 0, f"baseline carries {rule} debt in {path}"
+
+
 def test_core_subsystems_have_zero_tl006():
     """The ISSUE 4 triage contract: checkpoint/, io/, optimizer/ and
     parallel/ carry NO un-triaged silent-except debt — in the live scan

@@ -61,11 +61,16 @@ def _tiny_llama():
     return cfg, params, prompts
 
 
-def _engine(cfg, params, aot_dir=None):
+def _engine(cfg, params, aot_dir=None, spec=False):
     from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    spec_config = None
+    if spec:
+        from paddle_tpu.spec_decode import SpecDecodeConfig
+        spec_config = SpecDecodeConfig(draft_cfg=cfg, draft_params=params,
+                                       k=3, window=12)
     return ContinuousBatchingEngine(
         cfg, params, max_batch=2, block_size=8, num_blocks=64,
-        prefill_buckets=(8,), aot_dir=aot_dir)
+        prefill_buckets=(8,), aot_dir=aot_dir, spec_config=spec_config)
 
 
 def gpt_train() -> Callable[[], None]:
@@ -151,11 +156,42 @@ def serve_aot_warm_sampled() -> Callable[[], None]:
     return workload
 
 
+def serve_spec_warm() -> Callable[[], None]:
+    """Speculative decode warm start (ISSUE 8): the draft and the
+    fixed-width K+1 verify are exported next to the decode step, and
+    the runner keeps every per-proposal op (argmax included) inside
+    those programs — budget is ZERO backend compiles, like the other
+    warm rows."""
+    import tempfile
+    from paddle_tpu.aot.serve import export_engine
+
+    cfg, params, prompts = _tiny_llama()
+    aot_dir = tempfile.mkdtemp(prefix="aot_budget_spec_")
+    export_engine(_engine(cfg, params, spec=True), aot_dir)
+
+    def workload():
+        eng = _engine(cfg, params, aot_dir=aot_dir, spec=True)
+        for i, p in enumerate(prompts):
+            # one sampled request: spec rejection sampling must not
+            # compile anything either
+            eng.add_request(p, 4, temperature=0.7 if i == 0 else 0.0,
+                            top_k=8 if i == 0 else None, seed=i + 1)
+        eng.run_to_completion()
+        if not eng.aot_loaded:
+            raise RuntimeError(f"warm start fell back: {eng.aot_error}")
+        if eng.spec_stats()["spec_steps"] < 1:
+            raise RuntimeError("spec decode never ran — the scenario "
+                               "is not measuring the speculative path")
+
+    return workload
+
+
 SCENARIOS: Dict[str, Callable[[], Callable[[], None]]] = {
     "gpt_train": gpt_train,
     "serve_fresh": serve_fresh,
     "serve_aot_warm": serve_aot_warm,
     "serve_aot_warm_sampled": serve_aot_warm_sampled,
+    "serve_spec_warm": serve_spec_warm,
 }
 
 
@@ -197,8 +233,10 @@ def render_md(counts: Dict[str, int]) -> str:
         "tracing) fail loudly instead of shipping as latency.",
         "",
         "Budgets are CPU tier-1 numbers; `serve_aot_warm` is the ISSUE 6"
-        " acceptance row and `serve_aot_warm_sampled` the ISSUE 7 one: "
-        "an AOT-warm engine start must be ZERO, greedy or sampled.",
+        " acceptance row, `serve_aot_warm_sampled` the ISSUE 7 one, and "
+        "`serve_spec_warm` the ISSUE 8 one: an AOT-warm engine start "
+        "must be ZERO backend compiles — greedy, sampled, or "
+        "speculative.",
         "",
     ]
     for name, n in counts.items():
