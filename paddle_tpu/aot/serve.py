@@ -77,6 +77,12 @@ def engine_config(engine) -> Dict[str, Any]:
         "max_blocks_per_seq": engine.MB,
         "num_blocks": engine.alloc.num_blocks,
         "pool_dtype": str(engine.pool_k.dtype),
+        # the ISSUE 9 fusion knob changes which kernel tier a RE-compile
+        # of the decode step would take, so a warm start must not cross
+        # it — an artifact exported fused never half-warms an unfused
+        # engine (and vice versa)
+        "decode_block_fused": bool(getattr(engine, "fused_decode_block",
+                                           True)),
         "params_treedef": params_td,
         "params_leaves": params_leaves,
     }

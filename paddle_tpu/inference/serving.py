@@ -30,8 +30,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.llama import _rotate_half
-from ..ops.paged_kv import paged_append, paged_decode_attention
+from ..ops.decode_block import make_norm_ffn as _make_rms_ffn  # noqa: F401
+#   ^ the norm/FFN closure pair moved to ops/decode_block.py (ISSUE 9)
+#     so the decode step, the chunk fill, and the spec-decode draft all
+#     read one definition; the old name stays importable for callers.
 
 __all__ = ["ContinuousBatchingEngine", "GenRequest", "build_sampler"]
 
@@ -124,35 +126,6 @@ def build_sampler():
     return jax.vmap(one)
 
 
-def _make_rms_ffn(cfg):
-    """One source for the per-layer RMSNorm and FFN closures shared by
-    the decode step and the prefix-cache chunk fill — the two compiled
-    paths must never drift numerically (same convention as
-    generation._dense_masked_attention)."""
-    eps = cfg.rms_norm_eps
-    moe = getattr(cfg, "moe_num_experts", 0)
-
-    def rms(x, w):
-        ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1,
-                      keepdims=True)
-        return (x * jax.lax.rsqrt(ms + eps).astype(x.dtype)) * w
-
-    def ffn(lp, y):
-        if moe:
-            from ..parallel.moe import moe_swiglu_ffn_grouped
-            out = moe_swiglu_ffn_grouped(
-                y, lp["router_w"], lp["e_gate"], lp["e_up"],
-                lp["e_down"], top_k=cfg.moe_top_k)
-            if getattr(cfg, "moe_num_shared_experts", 0):
-                out = out + (jax.nn.silu(y @ lp["s_gate"])
-                             * (y @ lp["s_up"])) @ lp["s_down"]
-            return out
-        return (jax.nn.silu(y @ lp["gate_w"])
-                * (y @ lp["up_w"])) @ lp["down_w"]
-
-    return rms, ffn
-
-
 class ContinuousBatchingEngine:
     """Llama-family continuous-batching engine (greedy by default,
     per-request sampling via temperature/top_k/top_p on add_request).
@@ -179,6 +152,14 @@ class ContinuousBatchingEngine:
         donation-unsafe artifact) falls back to fresh compiles with an
         ``aot`` telemetry event; the reason is kept on
         ``self.aot_error``.
+      fused_decode_block: route every per-layer decode (and the
+        spec-decode verify scan, which wraps the same step closure)
+        through the fused block op ``ops/decode_block.py`` (ISSUE 9).
+        On the CPU/reference tier the fused op IS the per-op chain —
+        greedy output is bit-identical either way (pinned) — while on
+        TPU it dispatches to the VMEM-resident Pallas megakernel when
+        the layer geometry fits (per-op fallback otherwise).  The knob
+        is covered by the AOT artifact config hash (docs/aot.md).
       spec_config: a :class:`~paddle_tpu.spec_decode.SpecDecodeConfig`
         enabling speculative decoding — every decode iteration drafts
         ``k`` tokens per active request and verifies them in one
@@ -199,7 +180,7 @@ class ContinuousBatchingEngine:
                  max_blocks_per_seq: Optional[int] = None,
                  enable_prefix_caching: bool = True,
                  prefill_buckets=None, aot_dir: Optional[str] = None,
-                 spec_config=None):
+                 fused_decode_block: bool = True, spec_config=None):
         if getattr(cfg, "moe_num_experts", 0) and \
                 getattr(cfg, "moe_router", "topk") != "topk":
             raise NotImplementedError("decode serves token-choice only")
@@ -212,6 +193,7 @@ class ContinuousBatchingEngine:
                 "'linear' or 'llama3' scaling for serving")
         self.cfg = cfg
         self.params = params
+        self.fused_decode_block = bool(fused_decode_block)
         self.B = max_batch
         self.BS = block_size
         self.MB = max_blocks_per_seq or \
@@ -305,15 +287,21 @@ class ContinuousBatchingEngine:
         cfg = self.cfg
         from ..models.llama import _rope_cos_sin
         from ..models.generation import _collapse_blocks
-        H, Hkv, D = cfg.num_heads, cfg.kv_heads, cfg.head_dim
-        BS = self.BS
+        from ..ops.decode_block import decode_block, decode_block_spec
+        D = cfg.head_dim
         cos_full, sin_full = _rope_cos_sin(
             cfg.max_position_embeddings, D, cfg.rope_theta,
             jnp.dtype(cfg.dtype), getattr(cfg, "rope_scaling", None))
-        rms, ffn = _make_rms_ffn(cfg)
+        rms, moe_ffn = _make_rms_ffn(cfg)
+        spec = decode_block_spec(cfg, self.BS)
+        ffn_override = moe_ffn if getattr(cfg, "moe_num_experts", 0) \
+            else None
+        # fused on: auto tier (per-op reference on CPU — bit-identical —
+        # Pallas megakernel on TPU when the geometry fits); off: the
+        # per-op composition, always
+        backend = None if self.fused_decode_block else "xla"
 
         def step(params, pool_k, pool_v, bt, lengths, tokens):
-            B = tokens.shape[0]
             blocks = _collapse_blocks(params["blocks"])
             x = jnp.take(params["wte"], tokens, axis=0)       # [B, h]
             # per-slot rope position = current length (0-based slot of
@@ -321,22 +309,12 @@ class ContinuousBatchingEngine:
             cos = jnp.take(cos_full, lengths, axis=0)         # [B, D]
             sin = jnp.take(sin_full, lengths, axis=0)
 
-            def rope1(t):                                     # [B, h?, D]
-                return t * cos[:, None, :] \
-                    + _rotate_half(t) * sin[:, None, :]
-
             def body(carry, inp):
                 x = carry
                 lp, pk, pv = inp
-                y = rms(x, lp["ln1_w"])
-                q = (y @ lp["q_w"]).reshape(B, H, D)
-                k = (y @ lp["k_w"]).reshape(B, Hkv, D)
-                v = (y @ lp["v_w"]).reshape(B, Hkv, D)
-                q, k = rope1(q), rope1(k)
-                pk, pv = paged_append(pk, pv, k, v, bt, lengths, BS)
-                attn = paged_decode_attention(q, pk, pv, bt, lengths + 1)
-                x = x + attn.reshape(B, -1) @ lp["o_w"]
-                x = x + ffn(lp, rms(x, lp["ln2_w"]))
+                x, pk, pv = decode_block(
+                    x, lp, pk, pv, bt, lengths, cos, sin, spec=spec,
+                    ffn=ffn_override, backend=backend)
                 return x, (pk, pv)
 
             x, (pk2, pv2) = jax.lax.scan(body, x,
@@ -364,15 +342,18 @@ class ContinuousBatchingEngine:
         identical to the unpadded call."""
         cfg = self.cfg
         from ..models.llama import _rope_cos_sin
-        from ..models.generation import (_collapse_blocks,
-                                         _dense_masked_attention)
-        H, Hkv, D = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        from ..models.generation import _collapse_blocks
+        from ..ops.decode_block import decode_block_spec, prefill_block_xla
+        D = cfg.head_dim
         BS = self.BS
         cos_full, sin_full = _rope_cos_sin(
             cfg.max_position_embeddings, D, cfg.rope_theta,
             jnp.dtype(cfg.dtype), getattr(cfg, "rope_scaling", None))
         scale = 1.0 / (D ** 0.5)
-        rms, ffn = _make_rms_ffn(cfg)
+        rms, moe_ffn = _make_rms_ffn(cfg)
+        spec = decode_block_spec(cfg, BS)
+        ffn_override = moe_ffn if getattr(cfg, "moe_num_experts", 0) \
+            else None
 
         def fill(params, pool_k, pool_v, bt_row, start, toks, valid=None):
             # toks [Ts]; bt_row [MB]; start: prefix length
@@ -391,28 +372,12 @@ class ContinuousBatchingEngine:
             jpos = jnp.arange(bt_row.shape[0] * BS)[None, None, None, :]
             mask = jpos <= pos[None, None, :, None]
 
-            def rope1(t):                                    # [1,Ts,*,D]
-                return t * cos[None, :, None, :] \
-                    + _rotate_half(t) * sin[None, :, None, :]
-
             def body(carry, inp):
                 x = carry
                 lp, pk, pv = inp
-                y = rms(x, lp["ln1_w"])
-                q = (y @ lp["q_w"]).reshape(1, Ts, H, D)
-                k = (y @ lp["k_w"]).reshape(1, Ts, Hkv, D)
-                v = (y @ lp["v_w"]).reshape(1, Ts, Hkv, D)
-                q, k = rope1(q), rope1(k)
-                pk = pk.at[blk, off].set(k[0])
-                pv = pv.at[blk, off].set(v[0])
-                k_all = jnp.take(pk, jnp.maximum(bt_row, 0), axis=0)
-                v_all = jnp.take(pv, jnp.maximum(bt_row, 0), axis=0)
-                k_all = k_all.reshape(1, -1, Hkv, D)
-                v_all = v_all.reshape(1, -1, Hkv, D)
-                attn = _dense_masked_attention(
-                    q, k_all, v_all, mask, scale).reshape(1, Ts, -1)
-                x = x + attn @ lp["o_w"]
-                x = x + ffn(lp, rms(x, lp["ln2_w"]))
+                x, pk, pv = prefill_block_xla(
+                    x, lp, pk, pv, blk, off, bt_row, mask, cos, sin,
+                    spec=spec, ffn=ffn_override, scale=scale)
                 return x, (pk, pv)
 
             x, (pk2, pv2) = jax.lax.scan(body, x,

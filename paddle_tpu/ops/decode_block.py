@@ -1,0 +1,383 @@
+"""Fused decode-step transformer block (ROADMAP item 2, ISSUE 9).
+
+The serving decode hot loop used to run one token through a CHAIN of
+per-op kernels — norm, three projections, RoPE, paged append, paged
+decode attention, out-projection, norm again, the FFN matmuls — and on
+memory-bound hardware every boundary between them is a round-trip of the
+``[B, H]`` residual stream through HBM.  ClusterFusion-style block
+fusion (PAPERS.md) removes those round-trips by keeping the token's
+residual stream on-chip across the WHOLE layer: the only HBM traffic
+left is the weights (which must stream once regardless) and the paged
+KV pages the attention reads.
+
+:func:`decode_block` is that layer body behind one API, in the same
+three-tier shape as the PR 3 fused CE head:
+
+* **XLA reference tier** (``backend="xla"``): the exact per-op
+  composition the engine ran before — same ops, same order, same
+  dtypes — so fusing on the CPU tier-1 lane is BIT-IDENTICAL to the
+  per-op baseline (pinned by tests/test_decode_block.py and the engine
+  greedy bit-identity test).  This is also the anchor the Pallas tier
+  is value-compared against.
+* **Pallas TPU megakernel** (``backend="pallas"``,
+  ``ops/pallas/decode_block.py``): one kernel per layer holding the
+  residual stream, q/k/v, and the online-softmax state in VMEM scratch;
+  KV pages are DMA-gathered from the pool through the engine's block
+  table.  Page-chunk size comes from the ``ops/pallas/autotune``
+  registry under the ``"decode_block"`` key.
+* **graceful fallback**: geometry outside the kernel's limits (head
+  dim, weights that cannot fit VMEM, MoE FFNs) auto-dispatches to the
+  reference tier; forcing ``backend="pallas"`` raises the typed
+  :class:`DecodeBlockUnsupportedError` instead of failing inside the
+  kernel.
+
+Both serving compiled paths route through this module (the decode step
+via :func:`decode_block`, the chunked prefill fill via
+:func:`prefill_block_xla`), and :func:`make_norm_ffn` is the single
+source for the norm/FFN closures they and the spec-decode draft share —
+the numerics of every compiled serve program come from one file.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .paged_kv import (paged_append, paged_decode_attention,
+                       validate_paged_decode_geometry)
+
+__all__ = ["DecodeBlockSpec", "DecodeBlockUnsupportedError", "decode_block",
+           "decode_block_spec", "decode_block_unsupported_reason",
+           "hbm_traffic_per_token", "make_norm", "make_ffn",
+           "make_norm_ffn", "prefill_block_xla", "rotate_half"]
+
+
+class DecodeBlockUnsupportedError(ValueError):
+    """Raised when ``backend="pallas"`` is forced on a geometry the
+    megakernel does not support (auto dispatch falls back silently)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeBlockSpec:
+    """Static shape/variant description of one transformer layer's
+    decode step.  Covers the Llama family (RMSNorm, split q/k/v, RoPE,
+    SwiGLU) and the GPT family (LayerNorm with bias, fused qkv, learned
+    positions — no RoPE — and a GELU MLP)."""
+    hidden: int
+    num_heads: int
+    kv_heads: int
+    head_dim: int
+    block_size: int                   # KV page size (pool geometry)
+    norm: str = "rms"                 # "rms" | "ln"
+    activation: str = "swiglu"        # "swiglu" | "gelu"
+    eps: float = 1e-5
+    rope: bool = True
+    fused_qkv: bool = False           # GPT layout: qkv_w/qkv_b
+    bias: bool = False                # GPT layout: proj/fc biases
+
+    def __post_init__(self):
+        if self.norm not in ("rms", "ln"):
+            raise ValueError(f"norm must be 'rms' or 'ln', got {self.norm!r}")
+        if self.activation not in ("swiglu", "gelu"):
+            raise ValueError("activation must be 'swiglu' or 'gelu', got "
+                             f"{self.activation!r}")
+        if self.fused_qkv and self.kv_heads != self.num_heads:
+            raise ValueError(
+                "fused_qkv implies MHA (one [H, 3*H] projection); got "
+                f"num_heads={self.num_heads}, kv_heads={self.kv_heads}")
+
+
+def decode_block_spec(cfg, block_size: int) -> DecodeBlockSpec:
+    """Spec for a model config: Llama-family configs (``rms_norm_eps``)
+    map to rms/SwiGLU/RoPE, GPT-family (``layer_norm_eps``) to
+    ln/GELU/fused-qkv."""
+    if hasattr(cfg, "rms_norm_eps"):
+        return DecodeBlockSpec(
+            hidden=cfg.hidden_size, num_heads=cfg.num_heads,
+            kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+            block_size=block_size, norm="rms", activation="swiglu",
+            eps=cfg.rms_norm_eps, rope=True)
+    return DecodeBlockSpec(
+        hidden=cfg.hidden_size, num_heads=cfg.num_heads,
+        kv_heads=cfg.num_heads, head_dim=cfg.head_dim,
+        block_size=block_size, norm="ln", activation="gelu",
+        eps=cfg.layer_norm_eps, rope=False, fused_qkv=True, bias=True)
+
+
+def rotate_half(x):
+    """RoPE rotate-half convention ([-x2, x1]); identical math to the
+    model-side helper so the fused and per-op paths cannot drift."""
+    d2 = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., d2:], x[..., :d2]], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# shared closures: ONE source for the norm and FFN numerics of every
+# compiled serve program (decode step, chunk fill, spec-decode draft)
+# ---------------------------------------------------------------------------
+def make_norm(spec: DecodeBlockSpec) -> Callable:
+    """``norm(x, w, b=None)`` — fp32 statistics, scale applied in the
+    input dtype (the convention every serving path has always used)."""
+    eps = spec.eps
+    if spec.norm == "rms":
+        def norm(x, w, b=None):
+            ms = jnp.mean(jnp.square(x.astype(jnp.float32)), -1,
+                          keepdims=True)
+            return (x * jax.lax.rsqrt(ms + eps).astype(x.dtype)) * w
+        return norm
+
+    def norm(x, w, b=None):
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, -1, keepdims=True)
+        var = jnp.var(x32, -1, keepdims=True)
+        out = (x32 - mu) * jax.lax.rsqrt(var + eps)
+        return out.astype(x.dtype) * w + b
+    return norm
+
+
+def make_ffn(spec: DecodeBlockSpec) -> Callable:
+    """``ffn(lp, y)`` for the dense FFN variants (MoE callers pass
+    their own closure through ``decode_block(ffn=...)``)."""
+    if spec.activation == "swiglu":
+        def ffn(lp, y):
+            return (jax.nn.silu(y @ lp["gate_w"])
+                    * (y @ lp["up_w"])) @ lp["down_w"]
+        return ffn
+
+    def ffn(lp, y):
+        return jax.nn.gelu(y @ lp["fc1_w"] + lp["fc1_b"],
+                           approximate=True) @ lp["fc2_w"] + lp["fc2_b"]
+    return ffn
+
+
+def make_norm_ffn(cfg):
+    """The Llama-engine (norm, ffn) closure pair — formerly
+    ``inference.serving._make_rms_ffn``, now housed with the block op so
+    the decode step, the chunk fill, and the spec-decode draft all read
+    one definition.  Handles the MoE FFN variants the fused kernel does
+    not (those route through the reference tier)."""
+    moe = getattr(cfg, "moe_num_experts", 0)
+    spec = DecodeBlockSpec(
+        hidden=cfg.hidden_size, num_heads=cfg.num_heads,
+        kv_heads=cfg.kv_heads, head_dim=cfg.head_dim, block_size=1,
+        norm="rms", activation="swiglu", eps=cfg.rms_norm_eps)
+    norm = make_norm(spec)
+    if not moe:
+        return norm, make_ffn(spec)
+
+    def ffn(lp, y):
+        from ..parallel.moe import moe_swiglu_ffn_grouped
+        out = moe_swiglu_ffn_grouped(
+            y, lp["router_w"], lp["e_gate"], lp["e_up"],
+            lp["e_down"], top_k=cfg.moe_top_k)
+        if getattr(cfg, "moe_num_shared_experts", 0):
+            out = out + (jax.nn.silu(y @ lp["s_gate"])
+                         * (y @ lp["s_up"])) @ lp["s_down"]
+        return out
+
+    return norm, ffn
+
+
+# ---------------------------------------------------------------------------
+# tier 1: XLA reference — the exact per-op composition (bit anchor)
+# ---------------------------------------------------------------------------
+def _qkv(y, lp, spec: DecodeBlockSpec, leading):
+    """Project the normed stream into per-head q/k/v."""
+    H, Hkv, D = spec.num_heads, spec.kv_heads, spec.head_dim
+    if spec.fused_qkv:
+        qkv = y @ lp["qkv_w"] + lp["qkv_b"]
+        qkv = qkv.reshape(*leading, H, 3 * D)
+        return jnp.split(qkv, 3, axis=-1)
+    q = (y @ lp["q_w"]).reshape(*leading, H, D)
+    k = (y @ lp["k_w"]).reshape(*leading, Hkv, D)
+    v = (y @ lp["v_w"]).reshape(*leading, Hkv, D)
+    return q, k, v
+
+
+def _proj_w(lp, spec: DecodeBlockSpec):
+    return lp["proj_w"] if spec.fused_qkv else lp["o_w"]
+
+
+def decode_block_xla(x, lp, pool_k, pool_v, block_table, lengths, cos, sin,
+                     *, spec: DecodeBlockSpec, ffn=None):
+    """Reference tier: one decode token per sequence through the
+    layer's per-op chain.  ``x`` [B, H]; ``cos``/``sin`` [B, D] rows at
+    each sequence's absolute position (ignored when ``spec.rope`` is
+    off); returns ``(x_out, pool_k, pool_v)`` with the new token's KV
+    appended.  This is byte-for-byte the composition the engine's
+    ``_build_step`` inlined before ISSUE 9 — the bit-identity anchor."""
+    B = x.shape[0]
+    norm = make_norm(spec)
+    ffn = ffn or make_ffn(spec)
+    y = norm(x, lp["ln1_w"], lp.get("ln1_b"))
+    q, k, v = _qkv(y, lp, spec, (B,))
+    if spec.rope:
+        def rope1(t):                                     # [B, h?, D]
+            return t * cos[:, None, :] + rotate_half(t) * sin[:, None, :]
+        q, k = rope1(q), rope1(k)
+    pool_k, pool_v = paged_append(pool_k, pool_v, k, v, block_table,
+                                  lengths, spec.block_size)
+    attn = paged_decode_attention(q, pool_k, pool_v, block_table,
+                                  lengths + 1)
+    proj = attn.reshape(B, -1) @ _proj_w(lp, spec)
+    x = x + (proj + lp["proj_b"] if spec.bias else proj)
+    x = x + ffn(lp, norm(x, lp["ln2_w"], lp.get("ln2_b")))
+    return x, pool_k, pool_v
+
+
+def prefill_block_xla(x, lp, pool_k, pool_v, blk, off, bt_row, mask, cos,
+                      sin, *, spec: DecodeBlockSpec, ffn=None,
+                      scale: Optional[float] = None):
+    """The chunk-fill layer body (``Ts`` prompt tokens of ONE sequence
+    against the paged pool): same per-op chain as :func:`decode_block_xla`
+    but with a dense masked attention over the sequence's gathered pages
+    and a positional scatter (``blk``/``off`` [Ts]) instead of the
+    single-token append.  Shares every numeric closure with the decode
+    step so the two compiled paths cannot drift (the pre-ISSUE 9
+    contract of ``_make_rms_ffn``, now op-level)."""
+    from ..models.generation import _dense_masked_attention
+    Ts = x.shape[1]
+    H, Hkv, D = spec.num_heads, spec.kv_heads, spec.head_dim
+    norm = make_norm(spec)
+    ffn = ffn or make_ffn(spec)
+    s = scale if scale is not None else 1.0 / (D ** 0.5)
+    y = norm(x, lp["ln1_w"], lp.get("ln1_b"))
+    q, k, v = _qkv(y, lp, spec, (1, Ts))
+    if spec.rope:
+        def rope1(t):                                    # [1, Ts, *, D]
+            return t * cos[None, :, None, :] \
+                + rotate_half(t) * sin[None, :, None, :]
+        q, k = rope1(q), rope1(k)
+    pool_k = pool_k.at[blk, off].set(k[0])
+    pool_v = pool_v.at[blk, off].set(v[0])
+    k_all = jnp.take(pool_k, jnp.maximum(bt_row, 0), axis=0)
+    v_all = jnp.take(pool_v, jnp.maximum(bt_row, 0), axis=0)
+    k_all = k_all.reshape(1, -1, Hkv, D)
+    v_all = v_all.reshape(1, -1, Hkv, D)
+    attn = _dense_masked_attention(q, k_all, v_all, mask,
+                                   s).reshape(1, Ts, -1)
+    proj = attn @ _proj_w(lp, spec)
+    x = x + (proj + lp["proj_b"] if spec.bias else proj)
+    x = x + ffn(lp, norm(x, lp["ln2_w"], lp.get("ln2_b")))
+    return x, pool_k, pool_v
+
+
+# ---------------------------------------------------------------------------
+# HBM-traffic model (docs/performance.md + bench.py --config decode_block)
+# ---------------------------------------------------------------------------
+# residual-stream HBM round-trips per layer in the PER-OP decode chain:
+# norm1, qkv-in, rope q/k, attention out, o-proj + residual, norm2,
+# gate/up in, down + residual — each boundary re-reads and re-writes the
+# [B, H]-class activations the fused kernel keeps in VMEM.
+PER_OP_STREAM_ROUND_TRIPS = 8
+
+
+def hbm_traffic_per_token(spec: DecodeBlockSpec, ffn_size: int,
+                          batch: int, itemsize: int) -> dict:
+    """Modelled HBM bytes per decode step per LAYER: both paths stream
+    the weights and the KV pages once (unavoidable); the per-op chain
+    additionally round-trips the residual stream at every fusion
+    boundary, the fused kernel only reads ``x`` once and writes
+    ``x_out`` once.  The CPU tier-1 proxy is compute-bound, so this
+    model — not its wall clock — is the memory-bound-hardware-facing
+    claim (docs/performance.md)."""
+    H, Hq, Hkv, D, F = (spec.hidden, spec.num_heads, spec.kv_heads,
+                        spec.head_dim, ffn_size)
+    if spec.fused_qkv:
+        attn_w = H * 3 * H + 3 * H + Hq * D * H + H
+        ffn_w = H * F + F + F * H + H
+    else:
+        attn_w = H * (Hq + 2 * Hkv) * D + Hq * D * H
+        ffn_w = 2 * H * F + F * H
+    norm_w = 2 * H * (2 if spec.bias else 1)
+    weights = (attn_w + ffn_w + norm_w) * itemsize
+    stream = batch * H * itemsize
+    return {
+        "weights_bytes": weights,
+        "per_op_bytes": weights + PER_OP_STREAM_ROUND_TRIPS * 2 * stream,
+        "fused_bytes": weights + 2 * stream,
+    }
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+def _pallas_platform() -> bool:
+    """Same dispatch rule as every other kernel: real accelerator,
+    forced interpret (CPU correctness lane), or forced Mosaic compile."""
+    from ..core.flags import FLAGS
+    if FLAGS.pallas_interpret or FLAGS.pallas_force_compile:
+        return True
+    try:
+        return jax.devices()[0].platform.lower() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def decode_block_unsupported_reason(spec: DecodeBlockSpec, lp,
+                                    pool_k) -> Optional[str]:
+    """None when the Pallas megakernel can run this layer, else a
+    human-readable reason (the typed-fallback signal).  Limits are the
+    kernel's own: the whole layer's weights plus the page-chunk staging
+    buffers must fit the VMEM budget, and head_dim is capped by the
+    attention scratch layout."""
+    from .pallas.decode_block import unsupported_reason
+    return unsupported_reason(spec, lp, pool_k)
+
+
+def decode_block(x, lp, pool_k, pool_v, block_table, lengths, cos, sin, *,
+                 spec: DecodeBlockSpec, ffn=None,
+                 backend: Optional[str] = None):
+    """One fused transformer layer for one decode token per sequence.
+
+    ``x``: [B, H] residual stream; ``lp``: the layer's weight dict
+    (Llama ``q_w/k_w/v_w/o_w/ln*_w/gate_w/up_w/down_w`` or GPT
+    ``qkv_w/qkv_b/proj_w/proj_b/ln*_{w,b}/fc*_{w,b}``); ``pool_k/v``:
+    [NB, BS, Hkv, D] paged KV pools; ``block_table``: [B, MB];
+    ``lengths``: [B] tokens already stored; ``cos``/``sin``: [B, D]
+    RoPE rows at each sequence's absolute position.  Returns
+    ``(x_out [B, H], pool_k, pool_v)``.
+
+    ``backend``: ``"xla"`` = per-op reference tier (bit-identical to
+    the pre-fusion engine), ``"pallas"`` = the VMEM-resident megakernel
+    (raises :class:`DecodeBlockUnsupportedError` outside its limits),
+    ``None`` = pallas on TPU when the geometry fits, else the reference
+    tier.  ``ffn``: optional FFN closure override (MoE) — reference
+    tier only.
+
+    Contract caveat (both tiers, engine-invisible): a row whose CURRENT
+    page (``block_table[b, lengths[b] // BS]``) is unmapped (-1)
+    produces tier-dependent garbage — the per-op chain attends the
+    clamped page-0 pool rows, the kernel folds the new token from VMEM.
+    The engine never exposes such rows (pages are mapped for a
+    request's full budget at admission; inactive slots' outputs are
+    never read), so engine/stream/spec outputs stay bit-identical
+    across tiers — the tier-1 pins.  Tier parity is only claimed for
+    rows with a mapped current page.
+    """
+    validate_paged_decode_geometry(
+        (x.shape[0], spec.num_heads, spec.head_dim), pool_k, pool_v,
+        block_table, lengths, op="decode_block")
+    if backend is None:
+        backend = "pallas" if (
+            ffn is None and _pallas_platform()
+            and decode_block_unsupported_reason(spec, lp, pool_k) is None
+        ) else "xla"
+    if backend == "pallas":
+        if ffn is not None:
+            raise DecodeBlockUnsupportedError(
+                "decode_block: custom FFN closures (MoE) run the "
+                "reference tier only")
+        reason = decode_block_unsupported_reason(spec, lp, pool_k)
+        if reason is not None:
+            raise DecodeBlockUnsupportedError(f"decode_block: {reason}")
+        from .pallas.decode_block import decode_block_pallas
+        return decode_block_pallas(x, lp, pool_k, pool_v, block_table,
+                                   lengths, cos, sin, spec=spec)
+    if backend != "xla":
+        raise ValueError(f"unknown backend {backend!r}")
+    return decode_block_xla(x, lp, pool_k, pool_v, block_table, lengths,
+                            cos, sin, spec=spec, ffn=ffn)

@@ -21,10 +21,66 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["BlockAllocator", "PagedKVCache", "paged_decode_attention",
-           "paged_append"]
+__all__ = ["BlockAllocator", "PagedKVCache", "PagedKVGeometryError",
+           "paged_decode_attention", "paged_append",
+           "validate_paged_decode_geometry"]
 
 NEG_INF = -1e30
+
+
+class PagedKVGeometryError(ValueError):
+    """A model/pool geometry the paged decode path cannot serve.
+
+    Raised at TRACE time with the offending shapes spelled out, instead
+    of the bare XLA shape-mismatch error that used to surface deep
+    inside the attention einsum when (say) a config's head_dim drifted
+    from the pool it was paired with.  The fused decode-block op's
+    fallback tier keys off the same validation (ISSUE 9)."""
+
+
+def validate_paged_decode_geometry(q, pool_k, pool_v, block_table,
+                                   lengths, *, op: str =
+                                   "paged_decode_attention") -> None:
+    """Shape/dtype contract of one paged decode step.
+
+    ``q`` may be the [B, Hq, D] query array or its shape tuple.  All
+    checks are static (trace-safe); violations raise
+    :class:`PagedKVGeometryError` naming the offending geometry."""
+    q_shape = tuple(q if isinstance(q, (tuple, list)) else q.shape)
+    if len(q_shape) != 3:
+        raise PagedKVGeometryError(
+            f"{op}: q must be [B, Hq, D] (one token per sequence), got "
+            f"shape {q_shape}")
+    B, Hq, D = q_shape
+    if pool_k.ndim != 4 or pool_v.ndim != 4:
+        raise PagedKVGeometryError(
+            f"{op}: pools must be [num_blocks, block_size, Hkv, D], got "
+            f"k {tuple(pool_k.shape)} / v {tuple(pool_v.shape)}")
+    if tuple(pool_k.shape) != tuple(pool_v.shape):
+        raise PagedKVGeometryError(
+            f"{op}: k/v pools disagree: {tuple(pool_k.shape)} vs "
+            f"{tuple(pool_v.shape)}")
+    NB, BS, Hkv, Dp = pool_k.shape
+    if Dp != D:
+        raise PagedKVGeometryError(
+            f"{op}: head_dim mismatch — q has D={D}, the KV pool was "
+            f"built with D={Dp} (pool {tuple(pool_k.shape)})")
+    if BS < 1:
+        raise PagedKVGeometryError(
+            f"{op}: block_size must be >= 1, pool has {BS}")
+    if Hkv < 1 or Hq % Hkv != 0:
+        raise PagedKVGeometryError(
+            f"{op}: q heads ({Hq}) must be a positive multiple of kv "
+            f"heads ({Hkv}) — GQA groups must divide evenly")
+    bt_shape = tuple(np.shape(block_table))
+    if len(bt_shape) != 2 or bt_shape[0] != B:
+        raise PagedKVGeometryError(
+            f"{op}: block_table must be [B={B}, max_blocks], got "
+            f"{bt_shape}")
+    len_shape = tuple(np.shape(lengths))
+    if len_shape != (B,):
+        raise PagedKVGeometryError(
+            f"{op}: lengths must be [B={B}], got {len_shape}")
 
 
 class BlockAllocator:
@@ -125,7 +181,13 @@ def paged_decode_attention(q, pool_k, pool_v, block_table, lengths,
     producing [B, MB, BS, H, D] views; XLA fuses the mask+softmax chain
     behind it, so HBM traffic is the same as a contiguous cache of length
     MB*BS.
+
+    Raises :class:`PagedKVGeometryError` (trace time, offending shapes
+    in the message) when the q/pool/table geometry is inconsistent —
+    head_dim drift, non-dividing GQA groups, mis-sized tables.
     """
+    validate_paged_decode_geometry(q, pool_k, pool_v, block_table,
+                                   lengths)
     B, Hq, D = q.shape
     NB, BS, Hkv, _ = pool_k.shape
     MB = block_table.shape[1]

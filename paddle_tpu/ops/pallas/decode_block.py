@@ -1,0 +1,403 @@
+"""Pallas TPU megakernel for the fused decode-step transformer block.
+
+One kernel invocation runs ONE layer for one decode token per sequence:
+norm → qkv projection → RoPE at the absolute position → paged-KV
+attention over the engine's block table → out-projection + residual →
+norm → FFN → residual.  The ``[1, H]`` residual stream, the projected
+q/k/v, and the online-softmax state live in VMEM scratch for the whole
+layer — the only HBM traffic is the weights (streamed once), the KV
+pages the attention DMA-gathers through the block table, and the final
+``[1, H]`` write-back.  Per-op decode pays ~2 reads + 2 writes of the
+residual stream per fusion boundary on top of that; this kernel pays
+zero (docs/performance.md has the per-token byte math).
+
+Shape of the kernel:
+
+* grid ``(B, nt)`` — one sequence per outer step, ``nt`` page-chunks of
+  the sequence's block-table row inner; scratch accumulators carry the
+  flash-style online softmax across chunks (same scheme as
+  ``decode_attention.py``).
+* the prologue (norm/qkv/rope) runs at chunk 0, writing q and the new
+  token's k/v to scratch; every chunk DMA-copies its pages from the
+  ``ANY``-space pools into VMEM staging buffers and folds them into the
+  softmax state; the epilogue at the last chunk folds in the CURRENT
+  token's k/v (the pool append happens host-side after the kernel, so
+  the value math matches the per-op order append-then-attend), then
+  runs out-proj, norm, FFN and both residual adds.
+* pages per chunk is the autotuned knob (``"decode_block"`` key in
+  ``ops/pallas/autotune``).
+
+Limits (the dispatch in ``ops/decode_block.py`` falls back to the
+reference tier outside them, or raises the typed error when the kernel
+is forced): the layer's full weight set plus the page staging buffers
+must fit :data:`VMEM_BUDGET_BYTES`, and ``head_dim`` is capped at
+:data:`MAX_HEAD_DIM`.  Models past the budget (7B-class layers) need
+the multi-core fusion of FlashFuser — single-kernel fusion is the
+small/draft-model and distilled-serving tier.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .common import NEG_INF, use_interpret
+
+__all__ = ["decode_block_pallas", "tune_decode_block",
+           "unsupported_reason", "VMEM_BUDGET_BYTES", "MAX_HEAD_DIM"]
+
+# layer weights + page staging + scratch must fit comfortably under a
+# v4/v5 core's ~16 MB VMEM; module attr so tests/operators can tune it
+VMEM_BUDGET_BYTES = 12 * 2 ** 20
+MAX_HEAD_DIM = 256
+DEFAULT_PAGES = 8
+_PAGE_CANDIDATES = (1, 2, 4, 8, 16)
+
+
+class _Meta(NamedTuple):
+    hidden: int
+    num_heads: int
+    kv_heads: int
+    head_dim: int
+    block_size: int
+    norm: str
+    activation: str
+    eps: float
+    rope: bool
+    fused_qkv: bool
+    bias: bool
+    pages: int           # pages staged per attention chunk
+    nt: int              # number of chunks (grid inner length)
+    mb: int              # block-table width
+    scale: float
+
+
+def _weight_names(spec) -> Tuple[str, ...]:
+    if spec.fused_qkv:
+        return ("ln1_w", "ln1_b", "qkv_w", "qkv_b", "proj_w", "proj_b",
+                "ln2_w", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b")
+    return ("ln1_w", "q_w", "k_w", "v_w", "o_w", "ln2_w", "gate_w",
+            "up_w", "down_w")
+
+
+def _scratch_bytes(spec, pages: int, pool_itemsize: int) -> int:
+    Hq, Hkv, D, BS = (spec.num_heads, spec.kv_heads, spec.head_dim,
+                      spec.block_size)
+    stage = 2 * pages * BS * Hkv * D * pool_itemsize
+    f32 = 4 * (2 * Hq * D + 2 * Hkv * D + 2 * Hq)
+    return stage + f32
+
+
+def unsupported_reason(spec, lp, pool_k) -> Optional[str]:
+    """None when this layer fits the kernel, else the reason (the
+    ``ops/decode_block.py`` dispatch signal)."""
+    D = spec.head_dim
+    if D > MAX_HEAD_DIM:
+        return f"head_dim {D} exceeds the kernel cap {MAX_HEAD_DIM}"
+    if spec.rope and D % 2:
+        return f"rotate-half RoPE needs an even head_dim, got {D}"
+    names = _weight_names(spec)
+    missing = [n for n in names if n not in lp]
+    if missing:
+        return (f"layer dict lacks {missing} — not a dense "
+                f"{spec.activation} block (MoE FFNs run the reference "
+                "tier)")
+    wbytes = sum(lp[n].size * lp[n].dtype.itemsize for n in names)
+    need = wbytes + _scratch_bytes(spec, 1, pool_k.dtype.itemsize)
+    if need > VMEM_BUDGET_BYTES:
+        return (f"layer needs ~{need / 2**20:.1f} MB VMEM "
+                f"({wbytes / 2**20:.1f} MB weights) > budget "
+                f"{VMEM_BUDGET_BYTES / 2**20:.1f} MB — multi-core "
+                "fusion territory, per-op tier serves it")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+def _norm_rows(x, w, b, meta: _Meta):
+    """fp32 row norm ([1, H]) matching the reference-tier closures."""
+    if meta.norm == "rms":
+        ms = jnp.mean(x * x, axis=-1, keepdims=True)
+        return x * jax.lax.rsqrt(ms + meta.eps) * w[None, :]
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    return xc * jax.lax.rsqrt(var + meta.eps) * w[None, :] + b[None, :]
+
+
+def _mm(a32, w_ref):
+    """[1, n] fp32 × weight ref [n, m] → [1, m] fp32 (MXU dot in the
+    weight's storage dtype, fp32 accumulation — the per-op precision)."""
+    w = w_ref[:]
+    return jax.lax.dot_general(a32.astype(w.dtype), w,
+                               (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _rot_half(x):
+    d2 = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., d2:], x[..., :d2]], axis=-1)
+
+
+def _kernel(*refs, meta: _Meta):
+    nw = 12 if meta.fused_qkv else 9
+    bt_ref, len_ref, x_ref, cos_ref, sin_ref = refs[:5]
+    w = dict(zip(("ln1_w", "ln1_b", "qkv_w", "qkv_b", "proj_w", "proj_b",
+                  "ln2_w", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b")
+                 if meta.fused_qkv else
+                 ("ln1_w", "q_w", "k_w", "v_w", "o_w", "ln2_w", "gate_w",
+                  "up_w", "down_w"), refs[5:5 + nw]))
+    pool_k_ref, pool_v_ref = refs[5 + nw:7 + nw]
+    x_out_ref, kn_ref, vn_ref = refs[7 + nw:10 + nw]
+    (q_scr, kn_scr, vn_scr, m_scr, l_scr, acc_scr, kbuf, vbuf,
+     sem) = refs[10 + nw:]
+
+    b = pl.program_id(0)
+    jt = pl.program_id(1)
+    Hq, Hkv, D = meta.num_heads, meta.kv_heads, meta.head_dim
+    G = Hq // Hkv
+    P, BS = meta.pages, meta.block_size
+    length = len_ref[b]
+
+    # ---- prologue: norm1 + qkv + rope, once per sequence -------------
+    @pl.when(jt == 0)
+    def _pro():
+        x = x_ref[:].astype(jnp.float32)                    # [1, H]
+        y = _norm_rows(x, w["ln1_w"][:],
+                       w["ln1_b"][:] if meta.fused_qkv else None, meta)
+        if meta.fused_qkv:
+            z = _mm(y, w["qkv_w"]) + w["qkv_b"][:][None, :]
+            z = z.reshape(Hq, 3 * D)
+            q, k, v = z[:, :D], z[:, D:2 * D], z[:, 2 * D:]
+        else:
+            q = _mm(y, w["q_w"]).reshape(Hq, D)
+            k = _mm(y, w["k_w"]).reshape(Hkv, D)
+            v = _mm(y, w["v_w"]).reshape(Hkv, D)
+        if meta.rope:
+            cos = cos_ref[:].astype(jnp.float32)            # [1, D]
+            sin = sin_ref[:].astype(jnp.float32)
+            q = q * cos + _rot_half(q) * sin
+            k = k * cos + _rot_half(k) * sin
+        q_scr[:] = q
+        kn_scr[:] = k
+        vn_scr[:] = v
+        kn_ref[:] = k.reshape(1, Hkv, D).astype(kn_ref.dtype)
+        vn_ref[:] = v.reshape(1, Hkv, D).astype(vn_ref.dtype)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # ---- attention chunk: DMA this chunk's pages, fold into the online
+    # softmax (previously-stored tokens only; mask is t < length) ------
+    def _page_copies(p):
+        idx = jnp.minimum(jt * P + p, meta.mb - 1)
+        phys = jnp.maximum(bt_ref[b, idx], 0)
+        return (pltpu.make_async_copy(pool_k_ref.at[phys], kbuf.at[p],
+                                      sem.at[p, 0]),
+                pltpu.make_async_copy(pool_v_ref.at[phys], vbuf.at[p],
+                                      sem.at[p, 1]))
+
+    for p in range(P):
+        ck, cv = _page_copies(p)
+        ck.start()
+        cv.start()
+    for p in range(P):
+        ck, cv = _page_copies(p)
+        ck.wait()
+        cv.wait()
+
+    k_all = kbuf[:].reshape(P * BS, Hkv, D).astype(jnp.float32)
+    v_all = vbuf[:].reshape(P * BS, Hkv, D).astype(jnp.float32)
+    t_pos = jt * (P * BS) + jax.lax.broadcasted_iota(
+        jnp.int32, (1, P * BS), 1)                          # [1, T]
+    valid = t_pos < length
+    for kv in range(Hkv):
+        sl = slice(kv * G, (kv + 1) * G)
+        qh = q_scr[sl]                                      # [G, D]
+        s = jax.lax.dot_general(qh, k_all[:, kv, :],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = jnp.where(valid, s * meta.scale, NEG_INF)       # [G, T]
+        m_prev = m_scr[sl]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        pw = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[sl] = alpha * l_scr[sl] + jnp.sum(pw, axis=1,
+                                                keepdims=True)
+        acc_scr[sl] = acc_scr[sl] * alpha + jax.lax.dot_general(
+            pw, v_all[:, kv, :], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[sl] = m_new
+
+    # ---- epilogue: fold the CURRENT token, then proj/norm/FFN --------
+    @pl.when(jt == meta.nt - 1)
+    def _epi():
+        attn = jnp.zeros((Hq, D), jnp.float32)
+        for kv in range(Hkv):
+            sl = slice(kv * G, (kv + 1) * G)
+            qh = q_scr[sl]
+            s_new = jnp.sum(qh * kn_scr[kv][None, :], axis=1,
+                            keepdims=True) * meta.scale     # [G, 1]
+            m_prev = m_scr[sl]
+            m_f = jnp.maximum(m_prev, s_new)
+            alpha = jnp.exp(m_prev - m_f)
+            p_new = jnp.exp(s_new - m_f)
+            l_f = alpha * l_scr[sl] + p_new
+            acc_f = acc_scr[sl] * alpha \
+                + p_new * vn_scr[kv][None, :]
+            attn = attn.at[sl].set(acc_f / jnp.maximum(l_f, 1e-30))
+        x = x_ref[:].astype(jnp.float32)                    # [1, H]
+        proj = _mm(attn.reshape(1, Hq * D), w["proj_w" if meta.fused_qkv
+                                              else "o_w"])
+        if meta.bias:
+            proj = proj + w["proj_b"][:][None, :]
+        x2 = x + proj
+        y2 = _norm_rows(x2, w["ln2_w"][:],
+                        w["ln2_b"][:] if meta.fused_qkv else None, meta)
+        if meta.activation == "swiglu":
+            f = jax.nn.silu(_mm(y2, w["gate_w"])) * _mm(y2, w["up_w"])
+            o = _mm(f, w["down_w"])
+        else:
+            h = jax.nn.gelu(_mm(y2, w["fc1_w"]) + w["fc1_b"][:][None, :],
+                            approximate=True)
+            o = _mm(h, w["fc2_w"]) + w["fc2_b"][:][None, :]
+        x_out_ref[:] = (x2 + o).astype(x_out_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# host wrapper + autotune
+# ---------------------------------------------------------------------------
+def _fitting_candidates(spec, mb: int, pool_itemsize: int,
+                        wbytes: int) -> Tuple[int, ...]:
+    cands = tuple(
+        p for p in _PAGE_CANDIDATES
+        if p <= max(mb, 1)
+        and wbytes + _scratch_bytes(spec, p, pool_itemsize)
+        <= VMEM_BUDGET_BYTES)
+    return cands or (1,)
+
+
+def _tuned_pages(spec, lp, pool_k, mb: int, args) -> int:
+    from .autotune import FLAGS, lookup, pick
+    wbytes = sum(lp[n].size * lp[n].dtype.itemsize
+                 for n in _weight_names(spec))
+    cands = _fitting_candidates(spec, mb, pool_k.dtype.itemsize, wbytes)
+    default = max(p for p in cands if p <= DEFAULT_PAGES)
+    key = (spec.hidden, spec.num_heads, spec.kv_heads, spec.head_dim,
+           spec.block_size, mb, spec.activation, str(pool_k.dtype))
+    if not FLAGS.use_autotune:
+        return default
+    if isinstance(args[0], jax.core.Tracer):
+        return lookup("decode_block", key, default)
+
+    def run(cand):
+        return jax.jit(functools.partial(_call, spec=spec,
+                                         pages=int(cand)))
+
+    return int(pick("decode_block", key, cands, run, args, default))
+
+
+def _call(x, lp, pool_k, pool_v, block_table, lengths, cos, sin, *,
+          spec, pages: int):
+    """Build + invoke the pallas_call for a fixed page-chunk size;
+    returns (x_out, k_new, v_new) — the pool append happens in
+    :func:`decode_block_pallas` so pool semantics match the per-op
+    tier exactly."""
+    B, H = x.shape
+    Hq, Hkv, D = spec.num_heads, spec.kv_heads, spec.head_dim
+    BS = spec.block_size
+    mb = block_table.shape[1]
+    nt = -(-mb // pages)
+    names = _weight_names(spec)
+    meta = _Meta(hidden=H, num_heads=Hq, kv_heads=Hkv, head_dim=D,
+                 block_size=BS, norm=spec.norm,
+                 activation=spec.activation, eps=spec.eps,
+                 rope=spec.rope, fused_qkv=spec.fused_qkv,
+                 bias=spec.bias, pages=pages, nt=nt, mb=mb,
+                 scale=1.0 / (D ** 0.5))
+
+    def wspec(arr):
+        if arr.ndim == 1:
+            return pl.BlockSpec((arr.shape[0],), lambda b, j: (0,))
+        return pl.BlockSpec(arr.shape, lambda b, j: (0,) * arr.ndim)
+
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),       # block table
+        pl.BlockSpec(memory_space=pltpu.SMEM),       # lengths
+        pl.BlockSpec((1, H), lambda b, j: (b, 0)),   # x row
+        pl.BlockSpec((1, D), lambda b, j: (b, 0)),   # cos row
+        pl.BlockSpec((1, D), lambda b, j: (b, 0)),   # sin row
+        *[wspec(lp[n]) for n in names],
+        pl.BlockSpec(memory_space=pltpu.ANY),        # pool_k
+        pl.BlockSpec(memory_space=pltpu.ANY),        # pool_v
+    ]
+    out_specs = [
+        pl.BlockSpec((1, H), lambda b, j: (b, 0)),
+        pl.BlockSpec((1, Hkv, D), lambda b, j: (b, 0, 0)),
+        pl.BlockSpec((1, Hkv, D), lambda b, j: (b, 0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((B, H), x.dtype),
+        jax.ShapeDtypeStruct((B, Hkv, D), pool_k.dtype),
+        jax.ShapeDtypeStruct((B, Hkv, D), pool_v.dtype),
+    ]
+    scratch = [
+        pltpu.VMEM((Hq, D), jnp.float32),            # q
+        pltpu.VMEM((Hkv, D), jnp.float32),           # new k
+        pltpu.VMEM((Hkv, D), jnp.float32),           # new v
+        pltpu.VMEM((Hq, 1), jnp.float32),            # running max
+        pltpu.VMEM((Hq, 1), jnp.float32),            # running sum
+        pltpu.VMEM((Hq, D), jnp.float32),            # attn accumulator
+        pltpu.VMEM((pages, BS, Hkv, D), pool_k.dtype),
+        pltpu.VMEM((pages, BS, Hkv, D), pool_v.dtype),
+        pltpu.SemaphoreType.DMA((pages, 2)),
+    ]
+    cos2 = jnp.zeros((B, D), x.dtype) if cos is None else cos
+    sin2 = jnp.zeros((B, D), x.dtype) if sin is None else sin
+    return pl.pallas_call(
+        functools.partial(_kernel, meta=meta),
+        grid=(B, nt),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=use_interpret(),
+    )(jnp.asarray(block_table, jnp.int32),
+      jnp.asarray(lengths, jnp.int32), x, cos2, sin2,
+      *[lp[n] for n in names], pool_k, pool_v)
+
+
+def decode_block_pallas(x, lp, pool_k, pool_v, block_table, lengths, cos,
+                        sin, *, spec, pages: Optional[int] = None):
+    """The megakernel tier of ``ops.decode_block.decode_block`` —
+    returns ``(x_out, pool_k, pool_v)`` with the new token's KV
+    appended (append runs host-side on the kernel's k/v outputs, so the
+    pool contents are IDENTICAL to the per-op tier's
+    ``paged_append``)."""
+    from ..paged_kv import paged_append
+    if pages is None:
+        pages = _tuned_pages(spec, lp, pool_k, block_table.shape[1],
+                             (x, lp, pool_k, pool_v, block_table,
+                              lengths, cos, sin))
+    x_out, k_new, v_new = _call(x, lp, pool_k, pool_v, block_table,
+                                lengths, cos, sin, spec=spec,
+                                pages=int(pages))
+    pool_k, pool_v = paged_append(pool_k, pool_v, k_new, v_new,
+                                  block_table, lengths, spec.block_size)
+    return x_out, pool_k, pool_v
+
+
+def tune_decode_block(x, lp, pool_k, pool_v, block_table, lengths, cos,
+                      sin, *, spec):
+    """Eagerly time the page-chunk candidates for this geometry and
+    cache the winner under the ``"decode_block"`` autotune key
+    (FLAGS.use_autotune must be on) — run once at engine warmup; traced
+    calls then read the cache."""
+    return decode_block_pallas(x, lp, pool_k, pool_v, block_table,
+                               lengths, cos, sin, spec=spec)

@@ -92,6 +92,35 @@ except Exception:
 import pytest
 
 
+def disable_persistent_compile_cache():
+    """Opt the calling module out of the persistent XLA compilation
+    cache; returns a restore callable.
+
+    This jax/XLA:CPU build (0.4.37) mis-executes DONATED programs
+    DESERIALIZED from the persistent compilation cache (the ISSUE 2 bug
+    — see aot/artifact.py:fresh_backend_compile and the PR 8
+    test_parallel.py deflake).  Modules whose tests compile bit-for-bit
+    identical donating programs hit the broken deserialize path on warm
+    reruns and drift nondeterministically; a module-scoped autouse
+    fixture built on this helper makes every compile fresh (bit-exact).
+
+    The flag alone is not enough mid-suite: ``is_cache_used`` memoizes
+    its decision at the first compile of the process, so the memo must
+    be reset on entry — and on exit, so later modules re-enable."""
+    from jax._src import compilation_cache as _cc
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    _cc.reset_cache()         # drop the is-cache-used memo
+    jax.clear_caches()        # drop executables already deserialized
+
+    def restore():
+        jax.config.update("jax_compilation_cache_dir", prev)
+        _cc.reset_cache()
+
+    return restore
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Bound in-process compiled-executable accumulation: a full slow-lane

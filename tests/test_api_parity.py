@@ -2,6 +2,15 @@
 ``__all__`` for nn, nn.functional, optimizer, and distribution so the tail
 can't regress.  The reference __init__ files are read directly — if the
 snapshot moves, the ratchet moves with it.
+
+ISSUE 9 triage note: this module was on the suspected
+compile-cache-flake list (PR 8), but its failures are unrelated — the
+``/root/reference`` paddle snapshot does not exist in this container,
+so every parametrized read fails with FileNotFoundError before any jax
+program compiles.  The donated-deserialize cache opt-out is therefore
+NOT applied here (there is nothing for it to fix); the module skips
+itself cleanly when the snapshot is absent instead of erroring 35
+times.
 """
 
 import re
@@ -13,6 +22,10 @@ REF = pathlib.Path("/root/reference/python/paddle")
 
 
 def ref_all(relpath):
+    if not REF.exists():
+        # same convention as test_aux_packages's reference-tree probe:
+        # the ratchet can only measure where the snapshot is mounted
+        pytest.skip("reference tree not mounted")
     src = (REF / relpath).read_text()
     m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
     assert m, f"no __all__ in {relpath}"

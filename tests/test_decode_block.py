@@ -1,0 +1,429 @@
+"""Fused decode-step block op (ISSUE 9): value parity vs the per-op
+composition across GPT and Llama block variants, the Pallas interpret
+tier, autotune cache roundtrip, geometry fallback, engine greedy
+bit-identity with fusion on/off (engine + ServingFrontend stream,
+spec-decode enabled and disabled), and the typed paged-KV geometry
+errors the fallback tier keys off."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core.flags import FLAGS, set_flags
+from paddle_tpu.ops.decode_block import (DecodeBlockSpec,
+                                         DecodeBlockUnsupportedError,
+                                         decode_block, decode_block_spec,
+                                         decode_block_unsupported_reason,
+                                         make_norm_ffn)
+from paddle_tpu.ops.paged_kv import (PagedKVGeometryError, paged_append,
+                                     paged_decode_attention)
+
+rng = np.random.default_rng(7)
+
+
+def _w(*shape, dtype=np.float32, scale=0.1):
+    return jnp.asarray(rng.standard_normal(shape).astype(np.float32)
+                       * scale, dtype=dtype)
+
+
+def _llama_layer(H, Hq, Hkv, D, F, dtype, tied_norms=False):
+    ln1 = _w(H, dtype=dtype, scale=1.0) + 1.0
+    lp = {"ln1_w": ln1, "q_w": _w(H, Hq * D, dtype=dtype),
+          "k_w": _w(H, Hkv * D, dtype=dtype),
+          "v_w": _w(H, Hkv * D, dtype=dtype),
+          "o_w": _w(Hq * D, H, dtype=dtype),
+          "ln2_w": ln1 if tied_norms else _w(H, dtype=dtype,
+                                             scale=1.0) + 1.0,
+          "gate_w": _w(H, F, dtype=dtype), "up_w": _w(H, F, dtype=dtype),
+          "down_w": _w(F, H, dtype=dtype)}
+    return lp
+
+
+def _gpt_layer(H, Hq, D, F, dtype):
+    return {"ln1_w": _w(H, dtype=dtype, scale=1.0) + 1.0,
+            "ln1_b": _w(H, dtype=dtype),
+            "qkv_w": _w(H, 3 * H, dtype=dtype),
+            "qkv_b": _w(3 * H, dtype=dtype),
+            "proj_w": _w(H, H, dtype=dtype), "proj_b": _w(H, dtype=dtype),
+            "ln2_w": _w(H, dtype=dtype, scale=1.0) + 1.0,
+            "ln2_b": _w(H, dtype=dtype),
+            "fc1_w": _w(H, F, dtype=dtype), "fc1_b": _w(F, dtype=dtype),
+            "fc2_w": _w(F, H, dtype=dtype), "fc2_b": _w(H, dtype=dtype)}
+
+
+def _geometry(B=3, MB=6, NB=16, BS=4, Hkv=2, dtype=np.float32, D=8):
+    pool_k = _w(NB, BS, Hkv, D, dtype=dtype)
+    pool_v = _w(NB, BS, Hkv, D, dtype=dtype)
+    bt = np.full((B, MB), -1, np.int32)
+    bt[0, :3] = [2, 5, 7]
+    bt[1, :2] = [1, 4]
+    bt[2, 0] = 9
+    lengths = np.array([9, 5, 0], np.int32)[:B]
+    return pool_k, pool_v, jnp.asarray(bt), jnp.asarray(lengths)
+
+
+def _per_op_reference(x, lp, pool_k, pool_v, bt, lengths, cos, sin, spec):
+    """The pre-ISSUE-9 per-op chain, written out independently of the op
+    module (norm/rope/FFN inline) — what decode_block must reproduce."""
+    B = x.shape[0]
+    Hq, Hkv, D = spec.num_heads, spec.kv_heads, spec.head_dim
+
+    def norm(x_, w, b=None):
+        if spec.norm == "rms":
+            ms = jnp.mean(jnp.square(x_.astype(jnp.float32)), -1,
+                          keepdims=True)
+            return (x_ * jax.lax.rsqrt(ms + spec.eps).astype(x_.dtype)) * w
+        x32 = x_.astype(jnp.float32)
+        mu = jnp.mean(x32, -1, keepdims=True)
+        var = jnp.var(x32, -1, keepdims=True)
+        return ((x32 - mu) * jax.lax.rsqrt(var + spec.eps)
+                ).astype(x_.dtype) * w + b
+
+    y = norm(x, lp["ln1_w"], lp.get("ln1_b"))
+    if spec.fused_qkv:
+        qkv = (y @ lp["qkv_w"] + lp["qkv_b"]).reshape(B, Hq, 3 * D)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+    else:
+        q = (y @ lp["q_w"]).reshape(B, Hq, D)
+        k = (y @ lp["k_w"]).reshape(B, Hkv, D)
+        v = (y @ lp["v_w"]).reshape(B, Hkv, D)
+    if spec.rope:
+        def rot(t):
+            d2 = t.shape[-1] // 2
+            return jnp.concatenate([-t[..., d2:], t[..., :d2]], -1)
+
+        q = q * cos[:, None, :] + rot(q) * sin[:, None, :]
+        k = k * cos[:, None, :] + rot(k) * sin[:, None, :]
+    pk, pv = paged_append(pool_k, pool_v, k, v, bt, lengths,
+                          spec.block_size)
+    attn = paged_decode_attention(q, pk, pv, bt, lengths + 1)
+    proj = attn.reshape(B, -1) @ (lp["proj_w"] if spec.fused_qkv
+                                  else lp["o_w"])
+    x = x + (proj + lp["proj_b"] if spec.bias else proj)
+    y2 = norm(x, lp["ln2_w"], lp.get("ln2_b"))
+    if spec.activation == "swiglu":
+        f = (jax.nn.silu(y2 @ lp["gate_w"]) * (y2 @ lp["up_w"])) \
+            @ lp["down_w"]
+    else:
+        f = jax.nn.gelu(y2 @ lp["fc1_w"] + lp["fc1_b"],
+                        approximate=True) @ lp["fc2_w"] + lp["fc2_b"]
+    return x + f, pk, pv
+
+
+def _variant(kind, dtype):
+    H, D, BS = 32, 8, 4
+    if kind == "llama_gqa":
+        Hq, Hkv, F = 4, 2, 48
+        spec = DecodeBlockSpec(hidden=H, num_heads=Hq, kv_heads=Hkv,
+                               head_dim=D, block_size=BS, norm="rms",
+                               activation="swiglu", eps=1e-5, rope=True)
+        lp = _llama_layer(H, Hq, Hkv, D, F, dtype)
+    elif kind == "llama_mha_tied":
+        Hq = Hkv = 4
+        spec = DecodeBlockSpec(hidden=H, num_heads=Hq, kv_heads=Hkv,
+                               head_dim=D, block_size=BS, norm="rms",
+                               activation="swiglu", eps=1e-5, rope=True)
+        lp = _llama_layer(H, Hq, Hkv, D, 48, dtype, tied_norms=True)
+    else:                                        # gpt: ln + gelu + bias
+        Hq = Hkv = 4
+        spec = DecodeBlockSpec(hidden=H, num_heads=Hq, kv_heads=Hq,
+                               head_dim=D, block_size=BS, norm="ln",
+                               activation="gelu", eps=1e-5, rope=False,
+                               fused_qkv=True, bias=True)
+        lp = _gpt_layer(H, Hq, D, 48, dtype)
+    pool_k, pool_v, bt, lengths = _geometry(Hkv=Hkv, dtype=dtype, D=D)
+    x = _w(3, H, dtype=dtype, scale=0.5)
+    cos = _w(3, D, dtype=dtype, scale=1.0) if spec.rope else None
+    sin = _w(3, D, dtype=dtype, scale=1.0) if spec.rope else None
+    return spec, lp, x, pool_k, pool_v, bt, lengths, cos, sin
+
+
+VARIANTS = ("llama_gqa", "llama_mha_tied", "gpt")
+DTYPES = (np.float32, jnp.bfloat16)
+
+
+# ---------------------------------------------------------------------------
+# tier parity
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("kind", VARIANTS)
+@pytest.mark.parametrize("dtype", DTYPES, ids=("fp32", "bf16"))
+def test_xla_tier_bit_identical_to_per_op(kind, dtype):
+    spec, lp, x, pk, pv, bt, ln, cos, sin = _variant(kind, dtype)
+    ref = _per_op_reference(x, lp, pk, pv, bt, ln, cos, sin, spec)
+    got = decode_block(x, lp, pk, pv, bt, ln, cos, sin, spec=spec,
+                       backend="xla")
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r, np.float32),
+                                      np.asarray(g, np.float32))
+
+
+@pytest.mark.parametrize("kind", VARIANTS)
+@pytest.mark.parametrize("dtype", DTYPES, ids=("fp32", "bf16"))
+def test_pallas_tier_value_parity(kind, dtype):
+    spec, lp, x, pk, pv, bt, ln, cos, sin = _variant(kind, dtype)
+    ref = _per_op_reference(x, lp, pk, pv, bt, ln, cos, sin, spec)
+    old = FLAGS.pallas_interpret
+    set_flags({"pallas_interpret": True})
+    try:
+        got = decode_block(x, lp, pk, pv, bt, ln, cos, sin, spec=spec,
+                           backend="pallas")
+        # the traced path the engine's scan takes
+        jit_got = jax.jit(lambda *a: decode_block(
+            *a, spec=spec, backend="pallas"))(x, lp, pk, pv, bt, ln,
+                                              cos, sin)
+    finally:
+        set_flags({"pallas_interpret": old})
+    tol = dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 \
+        else dict(rtol=1e-5, atol=1e-5)
+    for r, g, jg in zip(ref, got, jit_got):
+        np.testing.assert_allclose(np.asarray(g, np.float32),
+                                   np.asarray(r, np.float32), **tol)
+        np.testing.assert_allclose(np.asarray(jg, np.float32),
+                                   np.asarray(r, np.float32), **tol)
+
+
+def test_auto_dispatch_off_tpu_is_reference_tier():
+    """With no TPU and no interpret flag, auto dispatch must take the
+    per-op tier — the CPU tier-1 bit-identity story."""
+    spec, lp, x, pk, pv, bt, ln, cos, sin = _variant("llama_gqa",
+                                                     np.float32)
+    ref = decode_block(x, lp, pk, pv, bt, ln, cos, sin, spec=spec,
+                       backend="xla")
+    got = decode_block(x, lp, pk, pv, bt, ln, cos, sin, spec=spec)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+
+
+# ---------------------------------------------------------------------------
+# geometry limits / typed fallback
+# ---------------------------------------------------------------------------
+def test_unsupported_head_dim_reason_and_raise():
+    H, Hq, Hkv, D, F = 16, 2, 2, 512, 24     # D past the kernel cap
+    spec = DecodeBlockSpec(hidden=H, num_heads=Hq, kv_heads=Hkv,
+                           head_dim=D, block_size=4, norm="rms",
+                           activation="swiglu", eps=1e-5, rope=True)
+    lp = _llama_layer(H, Hq, Hkv, D, F, np.float32)
+    pk, pv, bt, lengths = _geometry(Hkv=Hkv, D=D)
+    x = _w(3, H)
+    cos, sin = _w(3, D), _w(3, D)
+    reason = decode_block_unsupported_reason(spec, lp, pk)
+    assert reason is not None and "head_dim" in reason
+    with pytest.raises(DecodeBlockUnsupportedError, match="head_dim"):
+        decode_block(x, lp, pk, pv, bt, lengths, cos, sin, spec=spec,
+                     backend="pallas")
+    # auto dispatch silently takes the reference tier instead
+    ref = decode_block(x, lp, pk, pv, bt, lengths, cos, sin, spec=spec,
+                       backend="xla")
+    old = FLAGS.pallas_interpret
+    set_flags({"pallas_interpret": True})
+    try:
+        got = decode_block(x, lp, pk, pv, bt, lengths, cos, sin,
+                           spec=spec)
+    finally:
+        set_flags({"pallas_interpret": old})
+    np.testing.assert_array_equal(np.asarray(ref[0]), np.asarray(got[0]))
+
+
+def test_unsupported_vmem_budget(monkeypatch):
+    from paddle_tpu.ops.pallas import decode_block as pdb
+    spec, lp, x, pk, pv, bt, ln, cos, sin = _variant("llama_gqa",
+                                                     np.float32)
+    assert decode_block_unsupported_reason(spec, lp, pk) is None
+    monkeypatch.setattr(pdb, "VMEM_BUDGET_BYTES", 128)
+    reason = decode_block_unsupported_reason(spec, lp, pk)
+    assert reason is not None and "VMEM" in reason
+    # auto dispatch silently falls back to the reference tier
+    old = FLAGS.pallas_interpret
+    set_flags({"pallas_interpret": True})
+    try:
+        got = decode_block(x, lp, pk, pv, bt, ln, cos, sin, spec=spec)
+    finally:
+        set_flags({"pallas_interpret": old})
+    ref = decode_block(x, lp, pk, pv, bt, ln, cos, sin, spec=spec,
+                       backend="xla")
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+
+
+def test_moe_ffn_override_forces_reference_tier():
+    spec, lp, x, pk, pv, bt, ln, cos, sin = _variant("llama_gqa",
+                                                     np.float32)
+    with pytest.raises(DecodeBlockUnsupportedError, match="FFN"):
+        decode_block(x, lp, pk, pv, bt, ln, cos, sin, spec=spec,
+                     ffn=lambda lp_, y: y, backend="pallas")
+
+
+def test_paged_geometry_typed_errors():
+    """Satellite: paged_decode_attention raises the typed geometry error
+    naming the offending shapes instead of an einsum shape mismatch."""
+    pool_k, pool_v, bt, lengths = _geometry()
+    q_bad_d = _w(3, 4, 16)                    # pool has D=8
+    with pytest.raises(PagedKVGeometryError, match="head_dim mismatch"):
+        paged_decode_attention(q_bad_d, pool_k, pool_v, bt, lengths)
+    q_bad_g = _w(3, 3, 8)                     # 3 q heads on 2 kv heads
+    with pytest.raises(PagedKVGeometryError, match="multiple"):
+        paged_decode_attention(q_bad_g, pool_k, pool_v, bt, lengths)
+    q = _w(3, 4, 8)
+    with pytest.raises(PagedKVGeometryError, match="block_table"):
+        paged_decode_attention(q, pool_k, pool_v, bt[:2], lengths)
+    with pytest.raises(PagedKVGeometryError, match="lengths"):
+        paged_decode_attention(q, pool_k, pool_v, bt, lengths[:2])
+    with pytest.raises(PagedKVGeometryError, match="pools"):
+        paged_decode_attention(q, pool_k, pool_v[:, :2], bt, lengths)
+
+
+# ---------------------------------------------------------------------------
+# autotune
+# ---------------------------------------------------------------------------
+def test_autotune_cache_roundtrip(tmp_path):
+    from paddle_tpu.ops.pallas import autotune
+    from paddle_tpu.ops.pallas.decode_block import tune_decode_block
+    spec, lp, x, pk, pv, bt, ln, cos, sin = _variant("llama_gqa",
+                                                     np.float32)
+    path = tmp_path / "at.json"
+    old = FLAGS.pallas_interpret
+    set_flags({"use_autotune": True, "autotune_cache_file": str(path),
+               "pallas_interpret": True})
+    try:
+        autotune.clear_cache()
+        out = tune_decode_block(x, lp, pk, pv, bt, ln, cos, sin,
+                                spec=spec)
+        key = (spec.hidden, spec.num_heads, spec.kv_heads, spec.head_dim,
+               spec.block_size, bt.shape[1], spec.activation,
+               str(pk.dtype))
+        won = autotune.lookup("decode_block", key, None)
+        assert won is not None and int(won) >= 1
+        # the winner persisted to disk for later processes
+        import json
+        with open(path) as f:
+            on_disk = json.load(f)
+        assert any(k.startswith("decode_block|") for k in on_disk), on_disk
+        assert int(won) in [int(v) for k, v in on_disk.items()
+                            if k.startswith("decode_block|")]
+        ref = decode_block(x, lp, pk, pv, bt, ln, cos, sin, spec=spec,
+                           backend="xla")
+        np.testing.assert_allclose(np.asarray(out[0]),
+                                   np.asarray(ref[0]), rtol=1e-5,
+                                   atol=1e-5)
+    finally:
+        set_flags({"use_autotune": False, "autotune_cache_file": "",
+                   "pallas_interpret": old})
+        autotune.clear_cache()
+
+
+# ---------------------------------------------------------------------------
+# engine / serve-path bit-identity (the acceptance pins)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_serving():
+    from paddle_tpu import parallel as dist
+    from paddle_tpu.models.llama import build_llama_train_step, llama_tiny
+    from paddle_tpu.parallel.topology import HybridTopology, set_topology
+    cfg = llama_tiny()
+    topo = dist.init_topology(devices=jax.devices()[:1])
+    _, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1)
+    params = init_fn(0)["params"]
+    set_topology(HybridTopology())
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 9, 17)]
+    return cfg, params, prompts
+
+
+def _engine(cfg, params, fused, spec=False, **kw):
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    spec_config = None
+    if spec:
+        from paddle_tpu.spec_decode import SpecDecodeConfig
+        spec_config = SpecDecodeConfig(draft_cfg=cfg, draft_params=params,
+                                       k=2, window=8)
+    return ContinuousBatchingEngine(
+        cfg, params, max_batch=2, block_size=8, num_blocks=64,
+        fused_decode_block=fused, spec_config=spec_config, **kw)
+
+
+def _drain(eng, prompts, sampled=False):
+    for i, p in enumerate(prompts):
+        eng.add_request(p, 6,
+                        temperature=0.7 if (sampled and i == 1) else 0.0,
+                        top_k=8 if (sampled and i == 1) else None,
+                        seed=i)
+    return eng.run_to_completion()
+
+
+def test_engine_greedy_bit_identity_fused_on_off(tiny_serving):
+    cfg, params, prompts = tiny_serving
+    a = _drain(_engine(cfg, params, fused=True), prompts, sampled=True)
+    b = _drain(_engine(cfg, params, fused=False), prompts, sampled=True)
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_frontend_stream_bit_identity_fused_on_off(tiny_serving):
+    from paddle_tpu.serving import ServingFrontend
+    cfg, params, prompts = tiny_serving
+
+    def stream(fused):
+        fe = ServingFrontend(_engine(cfg, params, fused=fused))
+        handles = [fe.submit(p, max_new_tokens=6) for p in prompts]
+        return [list(h) for h in handles]
+
+    assert stream(True) == stream(False)
+
+
+def test_spec_decode_verify_bit_identity_on_fused_path(tiny_serving):
+    """The verify program wraps the engine's (now fused) step closure;
+    greedy speculative output must stay bit-identical to baseline
+    decode — fused on and off, spec on and off: all four agree."""
+    cfg, params, prompts = tiny_serving
+    runs = {(fused, spec): _drain(_engine(cfg, params, fused=fused,
+                                          spec=spec), prompts)
+            for fused in (True, False) for spec in (True, False)}
+    base = runs[(False, False)]
+    for key, out in runs.items():
+        assert set(out) == set(base), key
+        for k in base:
+            np.testing.assert_array_equal(out[k], base[k], err_msg=str(key))
+
+
+def test_aot_warm_start_covers_fusion_knob(tiny_serving, tmp_path):
+    """The artifact config hash covers the knob: a fused export warm
+    starts a fused engine bit-identically, and an UNFUSED engine
+    pointed at the fused artifact falls back cleanly (no half-warm)."""
+    from paddle_tpu.aot.serve import export_engine
+    cfg, params, prompts = tiny_serving
+    eng = _engine(cfg, params, fused=True, prefill_buckets=(8,))
+    export_engine(eng, str(tmp_path))
+    warm = _engine(cfg, params, fused=True, prefill_buckets=(8,),
+                   aot_dir=str(tmp_path))
+    assert warm.aot_loaded
+    a = _drain(warm, prompts)
+    b = _drain(_engine(cfg, params, fused=True, prefill_buckets=(8,)),
+               prompts)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+    cold = _engine(cfg, params, fused=False, prefill_buckets=(8,),
+                   aot_dir=str(tmp_path))
+    assert not cold.aot_loaded
+    assert cold.aot_error is not None
+
+
+def test_make_norm_ffn_matches_legacy_alias():
+    """serving._make_rms_ffn must stay importable and be the op-module
+    closure source (the draft program imports it)."""
+    from paddle_tpu.inference.serving import _make_rms_ffn
+    assert _make_rms_ffn is make_norm_ffn
+
+
+def test_decode_block_spec_from_configs():
+    from paddle_tpu.models.llama import llama_tiny
+    s = decode_block_spec(llama_tiny(), 8)
+    assert (s.norm, s.activation, s.rope, s.fused_qkv) == \
+        ("rms", "swiglu", True, False)
+    from paddle_tpu.models.gpt import GPTConfig
+    g = decode_block_spec(GPTConfig(vocab_size=64, hidden_size=32,
+                                    num_layers=1, num_heads=4,
+                                    max_position_embeddings=32), 8)
+    assert (g.norm, g.activation, g.rope, g.fused_qkv, g.bias) == \
+        ("ln", "gelu", False, True, True)

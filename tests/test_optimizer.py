@@ -7,6 +7,20 @@ import paddle_tpu as pt
 from paddle_tpu import nn, optimizer
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _no_persistent_compile_cache():
+    """ISSUE 9 satellite: the PR 8 donated-deserialize opt-out, applied
+    to the Lamb convergence suspect.  Finding: the Lamb-kw8-500 failure
+    reproduces in ISOLATION with the cache opted out too — a genuine
+    convergence shortfall on that problem, NOT the compile-cache bug;
+    the opt-out stays to keep the cache out of the equation."""
+    from conftest import disable_persistent_compile_cache
+
+    restore = disable_persistent_compile_cache()
+    yield
+    restore()
+
+
 def _quadratic_problem():
     target = np.array([1.0, -2.0, 3.0], np.float32)
     p = pt.Parameter(np.zeros(3, np.float32))

@@ -30,17 +30,15 @@ def _no_persistent_compile_cache():
     its decision at the first compile of the process (see
     aot/artifact.py:fresh_backend_compile), so a pytest process that
     already compiled with the cache enabled ignores the flag — the memo
-    must be reset on entry (and on exit, so later modules re-enable)."""
-    import jax
-    from jax._src import compilation_cache as _cc
+    must be reset on entry (and on exit, so later modules re-enable).
+    The mechanics live in conftest.disable_persistent_compile_cache
+    (ISSUE 9 applied the same opt-out to the other suspected
+    modules)."""
+    from conftest import disable_persistent_compile_cache
 
-    prev = jax.config.jax_compilation_cache_dir
-    jax.config.update("jax_compilation_cache_dir", None)
-    _cc.reset_cache()         # drop the is-cache-used memo
-    jax.clear_caches()        # drop executables already deserialized
+    restore = disable_persistent_compile_cache()
     yield
-    jax.config.update("jax_compilation_cache_dir", prev)
-    _cc.reset_cache()
+    restore()
 
 
 @pytest.fixture(autouse=True)

@@ -12,6 +12,23 @@ from paddle_tpu import profiler as prof
 from paddle_tpu.amp import debugging as dbg
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _no_persistent_compile_cache():
+    """ISSUE 9 satellite: the PR 8 donated-deserialize opt-out, applied
+    to the profiler device-rows suspect.  Finding: it does NOT deflake
+    this module — a varying subset of the device-row tests
+    (device_statistics_rows / merged_timeline / summary overview) still
+    fails in ISOLATION with the cache opted out, so the root cause is
+    the CPU backend's unreliable device-side event emission (inherent
+    run-to-run nondeterminism), not the compile-cache bug.  The opt-out
+    stays to keep the cache out of the equation."""
+    from conftest import disable_persistent_compile_cache
+
+    restore = disable_persistent_compile_cache()
+    yield
+    restore()
+
+
 class TestScheduler:
     def test_make_scheduler(self):
         sched = prof.make_scheduler(closed=1, ready=1, record=2, repeat=1)

@@ -22,6 +22,22 @@ from paddle_tpu.parallel.api import (Partial, ProcessMesh, Replicate, Shard,
                                      shard_tensor)
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _no_persistent_compile_cache():
+    """ISSUE 9 satellite: the PR 8 donated-deserialize opt-out, applied
+    to the reshard matrix (suspected of sharing the test_parallel root
+    cause).  Finding: it does NOT deflake this module — the two
+    failures (s_to_r allgather, nd-mesh cross-axis) reproduce in
+    ISOLATION with the cache opted out, across repeat runs — a genuine
+    reshard defect, not the compile-cache bug.  The opt-out stays so
+    the cache is ruled out as a variable while the defect is tracked."""
+    from conftest import disable_persistent_compile_cache
+
+    restore = disable_persistent_compile_cache()
+    yield
+    restore()
+
+
 def _np(x):
     return np.asarray(x._value)
 

@@ -94,6 +94,23 @@ def test_spec_decode_has_zero_tl001_tl006():
             assert n == 0, f"baseline carries {rule} debt in {path}"
 
 
+def test_decode_block_has_zero_tl001_tl006():
+    """ISSUE 9 contract: the fused decode-block op (dispatch module AND
+    Pallas kernel) sits on the hottest serve path — no host-sync in
+    traced code (TL001; one ``.item()`` in the layer body would sync
+    every layer of every decode step) and no silent broad excepts
+    (TL006; a swallowed dispatch error would silently serve the wrong
+    tier) — live scan AND committed ledger."""
+    files = ("paddle_tpu/ops/decode_block.py",
+             "paddle_tpu/ops/pallas/decode_block.py")
+    live = [f for f in _current_findings()
+            if f.rule in ("TL001", "TL006") and f.path.endswith(files)]
+    assert live == [], [f.format() for f in live]
+    for (rule, path), n in baseline_mod.load().items():
+        if rule in ("TL001", "TL006") and path.endswith(files):
+            assert n == 0, f"baseline carries {rule} debt in {path}"
+
+
 def test_core_subsystems_have_zero_tl006():
     """The ISSUE 4 triage contract: checkpoint/, io/, optimizer/ and
     parallel/ carry NO un-triaged silent-except debt — in the live scan

@@ -11,6 +11,21 @@ from paddle_tpu.distributed.auto_tuner import (AutoTuner, TuneConfig,
 from paddle_tpu.incubate import autograd as ia
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _no_persistent_compile_cache():
+    """ISSUE 9 satellite: the PR 8 donated-deserialize opt-out, applied
+    to the fused_attention_grad suspect.  Finding: the failure
+    reproduces in ISOLATION with the cache opted out too (CHANGES.md
+    PR 6 already observed it failing identically in isolation) — a
+    genuine numeric gap in that grad path, NOT the compile-cache bug;
+    the opt-out stays to keep the cache out of the equation."""
+    from conftest import disable_persistent_compile_cache
+
+    restore = disable_persistent_compile_cache()
+    yield
+    restore()
+
+
 class TestAutoTuner:
     def test_candidates_factor_device_count(self):
         cands = default_candidates(8, global_batch_size=32, num_layers=8,
