@@ -1,6 +1,10 @@
-"""tracelint — trace-safety static analysis for jit/shard_map/donation
-code (``python -m paddle_tpu.analysis``; rule catalogue in
-``docs/static_analysis.md``; committed debt ledger in TRACELINT.md).
+"""Static analysis: tracelint (TL — trace safety for jit/shard_map/
+donation code) + kernellint (KL — Pallas-kernel safety on the shared
+VMEM cost model in ``analysis/kernel/cost.py``).
+
+``python -m paddle_tpu.analysis`` runs both; ``--select KL`` is the
+kernel lane.  Rule catalogues in ``docs/static_analysis.md``;
+committed debt ledgers in TRACELINT.md / KERNELLINT.md (both empty).
 """
 
 from .core import (Finding, Module, Rule, all_rules, collect_files,

@@ -9,10 +9,12 @@ Modes:
 * output:    human (default) or ``--json``
   (``{"version": 1, "findings": [...], "counts": {...}}``)
 
-When a committed TRACELINT.md exists (override: ``--baseline PATH``,
-opt out: ``--no-baseline``) the exit code reports the RATCHET, not raw
-findings: 0 at-or-below baseline, 2 above.  Without a baseline, any
-finding exits 1.
+When committed ledgers exist (TRACELINT.md for TL rules, KERNELLINT.md
+for KL rules; override: ``--baseline PATH``, opt out:
+``--no-baseline``) the exit code reports the RATCHET against their
+union, not raw findings: 0 at-or-below baseline, 2 above.  Without a
+baseline, any finding exits 1.  ``--select`` accepts prefixes: the
+kernellint lane is ``--select KL``.
 """
 
 from __future__ import annotations
@@ -63,7 +65,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--diff", metavar="REF",
                     help="analyze only .py files changed vs the git ref")
     ap.add_argument("--select", metavar="IDS",
-                    help="comma-separated rule ids (e.g. TL001,TL006)")
+                    help="comma-separated rule ids or prefixes "
+                         "(e.g. TL001,TL006 — or KL for every "
+                         "kernellint rule)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
     ap.add_argument("--baseline", metavar="PATH",
@@ -93,16 +97,22 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     select = None
     if args.select:
-        select = {t.strip() for t in args.select.split(",") if t.strip()}
+        tokens = {t.strip() for t in args.select.split(",") if t.strip()}
+        # a token is an exact id or a prefix: "KL" selects every
+        # kernellint rule, "TL00" every tracelint rule
+        select = {r.id for r in core.all_rules()
+                  if any(r.id == t or r.id.startswith(t)
+                         for t in tokens)}
 
     findings = core.run(paths, select=select)
 
     regressions: Optional[List[str]] = None
-    base_path = args.baseline or (
-        baseline_mod.default_path()
-        if os.path.exists(baseline_mod.default_path()) else None)
-    if base_path and not args.no_baseline:
-        base = baseline_mod.load(base_path)
+    if args.baseline:
+        base_paths = [args.baseline]
+    else:
+        base_paths = baseline_mod.existing_ledgers()
+    if base_paths and not args.no_baseline:
+        base = baseline_mod.load_merged(base_paths)
         if select:
             base = {k: v for k, v in base.items() if k[0] in select}
         regressions = baseline_mod.compare(
@@ -114,7 +124,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             "findings": [f.to_json() for f in findings],
             "counts": {rule: sum(1 for f in findings if f.rule == rule)
                        for rule in sorted({f.rule for f in findings})},
-            "baseline": base_path if regressions is not None else None,
+            "baseline": (base_paths if regressions is not None
+                         else None),
             "above_baseline": regressions or [],
         }
         print(json.dumps(payload, indent=1))
@@ -125,9 +136,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         if regressions is None:
             print(f"tracelint: {n} finding{'s' if n != 1 else ''}")
         else:
+            names = ", ".join(os.path.relpath(p, core.repo_root())
+                              for p in base_paths)
             print(f"tracelint: {n} finding{'s' if n != 1 else ''}, "
-                  f"{len(regressions)} above baseline "
-                  f"({os.path.relpath(base_path, core.repo_root())})")
+                  f"{len(regressions)} above baseline ({names})")
             for r in regressions:
                 print(f"  ABOVE BASELINE: {r}")
 

@@ -1,0 +1,241 @@
+"""The shared VMEM cost model (KL001) — static analysis AND runtime.
+
+Everything that needs to know whether a Pallas working set fits on-chip
+reads THIS module:
+
+* the KL001 rule checks statically-extracted block/scratch shapes
+  against :func:`budget_bytes`;
+* ``ops/pallas/decode_block.py``'s fusion-fallback gate
+  (``unsupported_reason`` → ``DecodeBlockUnsupportedError``) computes
+  its working set with :func:`decode_block_vmem`;
+* ``ops/pallas``'s autotune candidate filters
+  (``decode_block._fitting_candidates``, ``linear_ce._tuned_blocks``)
+  drop configs :func:`fits` rejects before ever timing them.
+
+Before ISSUE 10 the budget lived as a hand-maintained
+``VMEM_BUDGET_BYTES = 12MB`` constant inside the decode-block kernel
+plus an ad-hoc try/except skip in the autotuner; the static analyzer
+could not see either.  Now there is one table and one estimator, so the
+number the lint proves things about is the number the serving dispatch
+enforces.
+
+The byte model is the sum of per-grid-step VMEM residents: one block
+per (in_spec, out_spec) with a block shape (``None`` dims count 1;
+``SMEM``/``ANY`` specs don't occupy VMEM) plus every ``pltpu.VMEM``
+scratch entry.  It deliberately does NOT model Mosaic's (8, 128) tile
+padding or double-buffering of streamed blocks — both round UP, so the
+documented contract is: the estimate is within ``MODEL_TOLERANCE`` of
+the kernel's declared allocation (pinned by tests/test_kernel_cost.py
+against interpret-mode-captured block+scratch bytes), and the safety
+margin for padding/double-buffering lives in ``SAFETY_FRACTION``.
+
+No jax imports: the analyzer and the CI ratchet run this on a bare
+interpreter; runtime callers pass plain ints and dtype strings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+__all__ = [
+    "VMEM_BYTES_PER_CORE", "SAFETY_FRACTION", "DEFAULT_GENERATION",
+    "MAX_HEAD_DIM", "MODEL_TOLERANCE", "budget_bytes", "fits",
+    "generation_from_device_kind", "itemsize", "Buffer", "vmem_bytes",
+    "decode_block_vmem", "decode_block_unsupported_reason",
+    "linear_ce_vmem", "linear_ce_fits",
+]
+
+# Physical per-core VMEM by TPU generation (the Pallas guide's ~16 MB
+# figure for v4/v5; v6e doubles it).  "interpret" is the CPU tier-1
+# lane: budgeted like v4 so the dispatch decisions tier-1 pins are the
+# ones real hardware makes.
+VMEM_BYTES_PER_CORE: Dict[str, int] = {
+    "v4": 16 * 2 ** 20,
+    "v5e": 16 * 2 ** 20,
+    "v5p": 16 * 2 ** 20,
+    "v6e": 32 * 2 ** 20,
+    "interpret": 16 * 2 ** 20,
+}
+
+# Fraction of physical VMEM a single kernel's declared working set may
+# claim.  The remainder absorbs what the closed form does not model:
+# Mosaic (8, 128) tile padding, pipeline double-buffering of streamed
+# blocks, and compiler-internal temporaries.  0.75 * 16 MB reproduces
+# the pre-ISSUE-10 hand constant (12 MB) exactly.
+SAFETY_FRACTION = 0.75
+
+DEFAULT_GENERATION = "v4"
+
+# Attention-scratch layout cap carried over from the decode-block
+# kernel (one (head, D) row must fit a VMEM register tile fan-out).
+MAX_HEAD_DIM = 256
+
+# Documented tolerance for static-estimate vs kernel-declared bytes
+# (tests/test_kernel_cost.py pins decode_block and linear_ce to it).
+MODEL_TOLERANCE = 0.02
+
+_ITEMSIZE = {
+    "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1, "float8_e4m3": 1,
+}
+
+
+def itemsize(dtype) -> int:
+    """Bytes per element for a dtype given as string or anything whose
+    ``str()`` names one ("bfloat16", ``jnp.float32``, ``np.dtype``)."""
+    s = str(dtype)
+    s = s.rsplit(".", 1)[-1].strip("'\"<>")   # "<class 'jax...bfloat16'>"
+    if s in _ITEMSIZE:
+        return _ITEMSIZE[s]
+    for name, n in _ITEMSIZE.items():
+        if name in s:
+            return n
+    raise ValueError(f"unknown dtype {dtype!r} for itemsize")
+
+
+def generation_from_device_kind(kind: str) -> str:
+    """Map a jax ``device_kind`` string to a budget-table key; unknown
+    kinds get the conservative default generation."""
+    k = kind.lower()
+    for gen in ("v6e", "v5p", "v5e", "v4"):
+        if gen in k:
+            return gen
+    return DEFAULT_GENERATION
+
+
+def budget_bytes(generation: Optional[str] = None) -> int:
+    """Usable single-kernel VMEM budget for a generation (the ONE
+    number every fusion/validity decision compares against)."""
+    gen = generation or DEFAULT_GENERATION
+    if gen not in VMEM_BYTES_PER_CORE:
+        raise KeyError(f"unknown TPU generation {gen!r}; have "
+                       f"{sorted(VMEM_BYTES_PER_CORE)}")
+    return int(VMEM_BYTES_PER_CORE[gen] * SAFETY_FRACTION)
+
+
+def fits(total_bytes: int, generation: Optional[str] = None) -> bool:
+    return total_bytes <= budget_bytes(generation)
+
+
+@dataclasses.dataclass(frozen=True)
+class Buffer:
+    """One VMEM-resident buffer: a per-grid-step block or a scratch
+    allocation.  ``None`` dims (Pallas squeezed block dims) count 1."""
+    name: str
+    shape: Tuple[Optional[int], ...]
+    itemsize: int
+
+    @property
+    def bytes(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= 1 if d is None else int(d)
+        return n * self.itemsize
+
+
+def vmem_bytes(buffers: Iterable[Buffer]) -> int:
+    """Total declared VMEM of a kernel invocation: per-grid-step input/
+    output blocks plus scratch accumulators/staging."""
+    return sum(b.bytes for b in buffers)
+
+
+# ---------------------------------------------------------------------------
+# decode_block: the fused decode-step megakernel (ops/pallas/decode_block)
+# ---------------------------------------------------------------------------
+def decode_block_vmem(*, hidden: int, num_heads: int, kv_heads: int,
+                      head_dim: int, block_size: int, pages: int,
+                      weight_bytes: int, pool_itemsize: int,
+                      x_itemsize: int = 4) -> Dict[str, int]:
+    """Byte breakdown of one decode_block kernel invocation.
+
+    Mirrors ``ops/pallas/decode_block._call`` exactly: the layer's full
+    weight set streams into VMEM as whole-array blocks
+    (``weight_bytes``), ``pages`` KV pages stage per attention chunk
+    (k + v), the online-softmax state is fp32 scratch, and the residual
+    stream/RoPE rows/outputs are one-row blocks.  Keys: ``weights``,
+    ``staging``, ``scratch``, ``io``, ``total``.
+    """
+    Hq, Hkv, D, BS = num_heads, kv_heads, head_dim, block_size
+    staging = 2 * pages * BS * Hkv * D * pool_itemsize
+    # fp32 scratch: q (Hq, D) + acc (Hq, D) + new k/v (2 * Hkv * D)
+    # + running max/sum (2 * Hq)
+    scratch = 4 * (2 * Hq * D + 2 * Hkv * D + 2 * Hq)
+    io = vmem_bytes([
+        Buffer("x", (1, hidden), x_itemsize),
+        Buffer("cos", (1, D), x_itemsize),
+        Buffer("sin", (1, D), x_itemsize),
+        Buffer("x_out", (1, hidden), x_itemsize),
+        Buffer("k_new", (1, Hkv, D), pool_itemsize),
+        Buffer("v_new", (1, Hkv, D), pool_itemsize),
+    ])
+    total = weight_bytes + staging + scratch + io
+    return {"weights": weight_bytes, "staging": staging,
+            "scratch": scratch, "io": io, "total": total}
+
+
+def decode_block_unsupported_reason(
+        *, hidden: int, num_heads: int, kv_heads: int, head_dim: int,
+        block_size: int, rope: bool, weight_bytes: int,
+        pool_itemsize: int, x_itemsize: int = 4,
+        budget: Optional[int] = None,
+        generation: Optional[str] = None) -> Optional[str]:
+    """None when one decode_block layer fits the kernel's limits, else
+    a human-readable reason — the runtime fusion-fallback signal
+    (``DecodeBlockUnsupportedError`` when the kernel is forced) and the
+    KL001 ground truth, from one formula."""
+    D = head_dim
+    if D > MAX_HEAD_DIM:
+        return f"head_dim {D} exceeds the kernel cap {MAX_HEAD_DIM}"
+    if rope and D % 2:
+        return f"rotate-half RoPE needs an even head_dim, got {D}"
+    limit = budget if budget is not None else budget_bytes(generation)
+    est = decode_block_vmem(
+        hidden=hidden, num_heads=num_heads, kv_heads=kv_heads,
+        head_dim=D, block_size=block_size, pages=1,
+        weight_bytes=weight_bytes, pool_itemsize=pool_itemsize,
+        x_itemsize=x_itemsize)
+    if est["total"] > limit:
+        return (f"layer needs ~{est['total'] / 2**20:.1f} MB VMEM "
+                f"({est['weights'] / 2**20:.1f} MB weights) > budget "
+                f"{limit / 2**20:.1f} MB — multi-core fusion "
+                "territory, per-op tier serves it")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# linear_ce: the fused CE head forward kernel (ops/pallas/linear_ce)
+# ---------------------------------------------------------------------------
+def linear_ce_vmem(*, block_rows: int, chunk: int, hidden: int,
+                   x_itemsize: int = 4, w_itemsize: int = 4) -> Dict[str, int]:
+    """Byte breakdown of one linear_ce forward invocation per grid
+    step, mirroring ``ops/pallas/linear_ce._fwd``: an activation row
+    block, a vocab-chunk weight block, the label column, two fp32
+    outputs and four fp32 online-softmax scratch columns."""
+    br, C, H = block_rows, chunk, hidden
+    blocks = vmem_bytes([
+        Buffer("x", (br, H), x_itemsize),
+        Buffer("w", (C, H), w_itemsize),
+        Buffer("labels", (br, 1), 4),
+        Buffer("nll", (br, 1), 4),
+        Buffer("lse", (br, 1), 4),
+    ])
+    scratch = 4 * br * 4
+    return {"blocks": blocks, "scratch": scratch,
+            "total": blocks + scratch}
+
+
+def linear_ce_fits(block_rows: int, chunk: int, hidden: int,
+                   x_itemsize: int = 4, w_itemsize: int = 4,
+                   generation: Optional[str] = None) -> bool:
+    """Autotune validity: can a (block_rows, chunk) candidate's working
+    set ever fit?  ``_tuned_blocks`` filters candidates through this
+    BEFORE timing them — a config this rejects would only die inside
+    Mosaic on hardware, after burning a compile."""
+    return fits(linear_ce_vmem(block_rows=block_rows, chunk=chunk,
+                               hidden=hidden, x_itemsize=x_itemsize,
+                               w_itemsize=w_itemsize)["total"],
+                generation)

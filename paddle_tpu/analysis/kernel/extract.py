@@ -1,0 +1,429 @@
+"""Static model of ``pl.pallas_call`` sites (the KL rules' substrate).
+
+For every call whose callee tail is ``pallas_call`` the extractor
+records, per site: the kernel function (resolved through
+``functools.partial``), the grid rank and element expressions, every
+in/out ``BlockSpec`` (block shape, memory space, index-map arity and
+returned rank), the ``scratch_shapes`` entries (kind/shape/dtype), and
+the ``out_shape`` dtypes.
+
+Shape expressions are resolved with a *sound constant evaluator*: only
+module-level constants and single-assignment locals of the enclosing
+function fold (plus ``min``/``max``/``len``/arithmetic/``pl.cdiv`` over
+folded values).  Anything runtime-dependent stays ``None`` — the rules
+treat ``None`` dims as "cannot prove", never as a guess, so a KL001
+overflow finding is a proof, not a heuristic.  (Function parameter
+*defaults* are deliberately NOT folded: a caller can override them.)
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import core
+
+__all__ = ["BlockSpecInfo", "ScratchInfo", "PallasSite", "extract_sites",
+           "kernel_closure", "ConstEnv"]
+
+_MAX_FOLD_DEPTH = 32
+
+
+class ConstEnv:
+    """Lazy constant-folding environment: module-level assignments plus
+    the enclosing function's single-assignment locals.  Names assigned
+    more than once (or augmented) are ambiguous and never fold."""
+
+    def __init__(self, module: core.Module,
+                 func: Optional[ast.AST] = None):
+        self._exprs: Dict[str, Optional[ast.AST]] = {}
+        self._memo: Dict[str, Optional[object]] = {}
+        self._collect(module.tree, top_only=True)
+        if func is not None:
+            self._collect(func, top_only=False)
+
+    def _collect(self, root: ast.AST, top_only: bool) -> None:
+        body = root.body if top_only else list(ast.walk(root))
+        for node in body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                # second sighting -> ambiguous
+                self._exprs[name] = (None if name in self._exprs
+                                     else node.value)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Tuple) \
+                    and isinstance(node.value, ast.Tuple) \
+                    and len(node.targets[0].elts) == len(node.value.elts):
+                # `a, b = x, y` — positional unpack of a literal tuple
+                for tgt, val in zip(node.targets[0].elts,
+                                    node.value.elts):
+                    if isinstance(tgt, ast.Name):
+                        self._exprs[tgt.id] = (None if tgt.id
+                                               in self._exprs else val)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) \
+                    and isinstance(getattr(node, "target", None), ast.Name):
+                self._exprs[node.target.id] = None
+            elif isinstance(node, ast.For) \
+                    and isinstance(node.target, ast.Name):
+                self._exprs[node.target.id] = None
+
+    def expr_of(self, name: str) -> Optional[ast.AST]:
+        """The defining expression of a single-assignment name (None
+        when unknown or ambiguous) — lets structural checks look
+        through one level of naming (``nt = -(-mb // pages)``)."""
+        return self._exprs.get(name)
+
+    def lookup(self, name: str, depth: int = 0):
+        if name in self._memo:
+            return self._memo[name]
+        self._memo[name] = None            # cycle guard
+        expr = self._exprs.get(name)
+        if expr is not None:
+            self._memo[name] = self.fold(expr, depth + 1)
+        return self._memo[name]
+
+    def fold(self, node: ast.AST, depth: int = 0):
+        """Fold an expression to an int / tuple of folded values, or
+        ``None`` when it cannot be proven constant."""
+        if depth > _MAX_FOLD_DEPTH:
+            return None
+        if isinstance(node, ast.Constant):
+            v = node.value
+            return v if isinstance(v, (int, float)) or v is None else None
+        if isinstance(node, ast.Name):
+            return self.lookup(node.id, depth)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(self.fold(e, depth + 1) for e in node.elts)
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            v = self.fold(node.operand, depth + 1)
+            return -v if isinstance(v, (int, float)) else None
+        if isinstance(node, ast.BinOp):
+            a = self.fold(node.left, depth + 1)
+            b = self.fold(node.right, depth + 1)
+            if not isinstance(a, (int, float)) \
+                    or not isinstance(b, (int, float)):
+                return None
+            try:
+                if isinstance(node.op, ast.Add):
+                    return a + b
+                if isinstance(node.op, ast.Sub):
+                    return a - b
+                if isinstance(node.op, ast.Mult):
+                    return a * b
+                if isinstance(node.op, ast.FloorDiv):
+                    return a // b
+                if isinstance(node.op, ast.Mod):
+                    return a % b
+                if isinstance(node.op, ast.Pow):
+                    return a ** b if abs(b) < 64 else None
+            except (ZeroDivisionError, OverflowError):
+                return None
+            return None
+        if isinstance(node, ast.Call):
+            tail = core.tail_name(node.func)
+            args = [self.fold(a, depth + 1) for a in node.args]
+            nums = [a for a in args if isinstance(a, (int, float))]
+            if tail in ("min", "max") and args and len(nums) == len(args):
+                return (min if tail == "min" else max)(nums)
+            if tail == "len" and len(args) == 1 \
+                    and isinstance(args[0], tuple):
+                return len(args[0])
+            if tail == "cdiv" and len(nums) == 2 and nums[1]:
+                return -(-int(nums[0]) // int(nums[1]))
+            return None
+        if isinstance(node, ast.Subscript):
+            base = self.fold(node.value, depth + 1)
+            idx = self.fold(node.slice, depth + 1)
+            if isinstance(base, tuple) and isinstance(idx, int) \
+                    and -len(base) <= idx < len(base):
+                return base[idx]
+            return None
+        return None
+
+
+@dataclasses.dataclass
+class BlockSpecInfo:
+    node: ast.AST                          # anchor for findings
+    known: bool                            # parsed a BlockSpec call
+    shape: Optional[Tuple] = None          # folded dims (None entries =
+    #                                        unproven or squeezed-dim)
+    shape_len: Optional[int] = None        # syntactic rank of the tuple
+    memory_space: str = "vmem"             # vmem | smem | any | unknown
+    index_map_arity: Optional[int] = None
+    index_map_rank: Optional[int] = None   # len of the returned tuple
+
+    @property
+    def resolved_shape(self) -> Optional[Tuple[Optional[int], ...]]:
+        if not self.known or self.shape is None:
+            return None
+        return tuple(d if isinstance(d, int) or d is None else None
+                     for d in self.shape)
+
+
+@dataclasses.dataclass
+class ScratchInfo:
+    node: ast.AST
+    kind: str                              # vmem | smem | sem | unknown
+    shape: Optional[Tuple] = None
+    dtype: Optional[str] = None
+
+
+@dataclasses.dataclass
+class PallasSite:
+    module: core.Module
+    call: ast.Call
+    kernel_name: Optional[str]
+    kernel_fn: Optional[ast.AST]
+    grid_rank: Optional[int]
+    grid_elems: List[ast.AST]
+    grid_has_cdiv: bool
+    in_specs: List[BlockSpecInfo]
+    in_specs_complete: bool                # no Starred / dynamic entries
+    out_specs: List[BlockSpecInfo]
+    out_specs_complete: bool
+    out_dtypes: List[Optional[str]]
+    scratch: List[ScratchInfo]
+    scratch_complete: bool
+    env: ConstEnv
+
+    @property
+    def lineno(self) -> int:
+        return self.call.lineno
+
+
+_DTYPE_TAILS = {
+    "float32", "float64", "float16", "bfloat16", "int8", "int16",
+    "int32", "int64", "uint8", "uint16", "uint32", "uint64", "bool_",
+    "float8_e4m3fn", "float8_e5m2", "float8_e4m3",
+}
+
+
+def _dtype_str(node: ast.AST) -> Optional[str]:
+    """'float32' for jnp.float32-style references, None for runtime
+    dtypes (``x.dtype``)."""
+    tail = core.tail_name(node)
+    if tail in _DTYPE_TAILS:
+        return "bool" if tail == "bool_" else tail
+    return None
+
+
+def _parse_blockspec(node: ast.AST, env: ConstEnv) -> BlockSpecInfo:
+    if not (isinstance(node, ast.Call)
+            and core.tail_name(node.func) == "BlockSpec"):
+        return BlockSpecInfo(node=node, known=False)
+    info = BlockSpecInfo(node=node, known=True)
+    shape_node = node.args[0] if node.args else None
+    index_map = node.args[1] if len(node.args) > 1 else None
+    for kw in node.keywords:
+        if kw.arg == "block_shape":
+            shape_node = kw.value
+        elif kw.arg == "index_map":
+            index_map = kw.value
+        elif kw.arg == "memory_space":
+            tail = core.tail_name(kw.value).lower()
+            info.memory_space = tail if tail in ("smem", "any", "vmem") \
+                else "unknown"
+    if shape_node is not None:
+        if isinstance(shape_node, (ast.Tuple, ast.List)):
+            info.shape_len = len(shape_node.elts)
+            info.shape = tuple(env.fold(e) for e in shape_node.elts)
+        else:
+            folded = env.fold(shape_node)
+            if isinstance(folded, tuple):
+                info.shape_len = len(folded)
+                info.shape = folded
+    if isinstance(index_map, ast.Lambda):
+        a = index_map.args
+        info.index_map_arity = len(a.args) + len(a.posonlyargs)
+        if isinstance(index_map.body, (ast.Tuple, ast.List)):
+            info.index_map_rank = len(index_map.body.elts)
+    return info
+
+
+def _parse_spec_list(node: Optional[ast.AST], env: ConstEnv
+                     ) -> Tuple[List[BlockSpecInfo], bool]:
+    """(specs, complete): ``complete`` is False when the list carries a
+    Starred / comprehension element, so positional arity is unknown."""
+    if node is None:
+        return [], False
+    if isinstance(node, (ast.Tuple, ast.List)):
+        specs, complete = [], True
+        for e in node.elts:
+            if isinstance(e, ast.Starred):
+                complete = False
+                continue
+            specs.append(_parse_blockspec(e, env))
+        return specs, complete
+    if isinstance(node, ast.Call):           # single BlockSpec
+        return [_parse_blockspec(node, env)], True
+    return [], False
+
+
+def _parse_scratch(node: Optional[ast.AST], env: ConstEnv
+                   ) -> Tuple[List[ScratchInfo], bool]:
+    if node is None:
+        return [], True
+    # `[pltpu.VMEM(...)] * 4` folds structurally
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        for lst, n in ((node.left, node.right), (node.right, node.left)):
+            if isinstance(lst, (ast.Tuple, ast.List)):
+                reps = env.fold(n)
+                if isinstance(reps, int) and 0 <= reps <= 64:
+                    inner, complete = _parse_scratch(lst, env)
+                    return inner * reps, complete
+        return [], False
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return [], False
+    out, complete = [], True
+    for e in node.elts:
+        if isinstance(e, ast.Starred):
+            complete = False
+            continue
+        if isinstance(e, ast.Call):
+            tail = core.tail_name(e.func)
+            dotted = core.dotted_name(e.func)
+            if tail in ("VMEM", "SMEM"):
+                shape = env.fold(e.args[0]) if e.args else None
+                dtype = _dtype_str(e.args[1]) if len(e.args) > 1 else None
+                out.append(ScratchInfo(
+                    node=e, kind=tail.lower(),
+                    shape=shape if isinstance(shape, tuple) else None,
+                    dtype=dtype))
+                continue
+            if tail == "DMA" or "SemaphoreType" in dotted:
+                out.append(ScratchInfo(node=e, kind="sem"))
+                continue
+        out.append(ScratchInfo(node=e, kind="unknown"))
+        complete = False
+    return out, complete
+
+
+def _kernel_ref(node: Optional[ast.AST]) -> Optional[str]:
+    """Kernel function name from the first pallas_call argument,
+    through ``functools.partial``."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Call) and core.tail_name(node.func) == "partial":
+        return _kernel_ref(node.args[0]) if node.args else None
+    name = core.tail_name(node)
+    return name or None
+
+
+_CDIV_TAILS = ("cdiv",)
+
+
+def _is_cdiv(node: ast.AST, env: ConstEnv, depth: int = 0) -> bool:
+    """`pl.cdiv(a, b)` or the `-(-a // b)` idiom — looked up through
+    single-assignment names — with an unprovable quotient (a
+    provably-dividing grid is not an edge hazard)."""
+    if depth > 8 or node is None:
+        return False
+    if isinstance(node, ast.Name):
+        return _is_cdiv(env.expr_of(node.id), env, depth + 1)
+    if isinstance(node, ast.Call) \
+            and core.tail_name(node.func) in _CDIV_TAILS:
+        return env.fold(node) is None
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub) \
+            and isinstance(node.operand, ast.BinOp) \
+            and isinstance(node.operand.op, ast.FloorDiv) \
+            and isinstance(node.operand.left, ast.UnaryOp) \
+            and isinstance(node.operand.left.op, ast.USub):
+        return env.fold(node) is None
+    return False
+
+
+def _out_dtypes(node: Optional[ast.AST], env: ConstEnv
+                ) -> List[Optional[str]]:
+    if node is None:
+        return []
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    out: List[Optional[str]] = []
+    for e in elts:
+        if isinstance(e, ast.Call) \
+                and core.tail_name(e.func) == "ShapeDtypeStruct" \
+                and len(e.args) > 1:
+            out.append(_dtype_str(e.args[1]))
+        else:
+            out.append(None)
+    return out
+
+
+def extract_sites(module: core.Module) -> List[PallasSite]:
+    """All pallas_call sites in a module (cached on the Module)."""
+    cached = getattr(module, "_pallas_sites", None)
+    if cached is not None:
+        return cached
+
+    # enclosing function map
+    enclosing: Dict[ast.AST, ast.AST] = {}
+    for fn in ast.walk(module.tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(fn):
+                enclosing.setdefault(sub, fn)
+
+    sites: List[PallasSite] = []
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call)
+                and core.tail_name(node.func) == "pallas_call"):
+            continue
+        env = ConstEnv(module, enclosing.get(node))
+
+        def deref(v, _env=env):
+            """kwargs are often locals (`in_specs=in_specs`): follow
+            one level of single-assignment naming."""
+            seen = 0
+            while isinstance(v, ast.Name) and seen < 8:
+                nxt = _env.expr_of(v.id)
+                if nxt is None:
+                    return v
+                v, seen = nxt, seen + 1
+            return v
+
+        kw = {k.arg: deref(k.value) for k in node.keywords if k.arg}
+        grid = kw.get("grid")
+        grid_elems = list(grid.elts) if isinstance(
+            grid, (ast.Tuple, ast.List)) else ([grid] if grid else [])
+        grid_rank = len(grid_elems) if grid_elems else None
+        in_specs, in_complete = _parse_spec_list(kw.get("in_specs"), env)
+        out_specs, out_complete = _parse_spec_list(kw.get("out_specs"), env)
+        scratch, scratch_complete = _parse_scratch(
+            kw.get("scratch_shapes"), env)
+        kname = _kernel_ref(node.args[0] if node.args else None)
+        sites.append(PallasSite(
+            module=module, call=node, kernel_name=kname,
+            kernel_fn=module.functions.get(kname) if kname else None,
+            grid_rank=grid_rank, grid_elems=grid_elems,
+            grid_has_cdiv=any(_is_cdiv(g, env) for g in grid_elems),
+            in_specs=in_specs, in_specs_complete=in_complete,
+            out_specs=out_specs, out_specs_complete=out_complete,
+            out_dtypes=_out_dtypes(kw.get("out_shape"), env),
+            scratch=scratch, scratch_complete=scratch_complete,
+            env=env))
+    module._pallas_sites = sites
+    return sites
+
+
+def kernel_closure(site: PallasSite) -> List[ast.AST]:
+    """The kernel function plus every module-local function it
+    transitively calls by bare name — the body the KL003/KL004 body
+    checks scan."""
+    if site.kernel_fn is None:
+        return []
+    mod = site.module
+    seen = {site.kernel_name}
+    out = [site.kernel_fn]
+    frontier = [site.kernel_fn]
+    while frontier:
+        fn = frontier.pop()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in mod.functions \
+                    and node.func.id not in seen:
+                seen.add(node.func.id)
+                callee = mod.functions[node.func.id]
+                out.append(callee)
+                frontier.append(callee)
+    return out

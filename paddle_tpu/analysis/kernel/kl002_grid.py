@@ -1,0 +1,82 @@
+"""KL002 — grid/BlockSpec structural consistency.
+
+Three checks, all exact (no bounds involved):
+
+* an index-map lambda whose arity differs from the grid rank — Pallas
+  calls index maps with one argument per grid axis, so this fails at
+  trace time on TPU but can silently "work" in hand-rolled interpret
+  shims;
+* an index map returning a coordinate tuple whose length differs from
+  the block rank — the classic copy-paste bug when a block gains a
+  dimension;
+* ``pl.program_id(axis)`` with a constant axis outside the grid rank
+  reachable from the kernel body.
+
+Divisibility of array extents by block shapes is deliberately NOT a
+static check here: every host wrapper in this repo pads to a block
+multiple or derives the grid from the padded extent, and the
+edge-masking discipline for ceil-divided grids is KL003's job.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import core
+from .extract import extract_sites, kernel_closure
+
+
+@core.register
+class GridBlockRule(core.Rule):
+    id = "KL002"
+    name = "grid-blockspec-mismatch"
+    severity = "error"
+    doc = ("a BlockSpec index map's arity or returned rank disagrees "
+           "with the pallas_call grid/block rank, or the kernel reads "
+           "pl.program_id(axis) past the grid rank")
+    hint = ("index maps take one arg per grid axis and return one "
+            "coordinate per block dim; program_id axes are "
+            "0..grid_rank-1")
+
+    def _spec_findings(self, module, site, spec, role):
+        if not spec.known:
+            return
+        if spec.index_map_arity is not None \
+                and site.grid_rank is not None \
+                and spec.index_map_arity != site.grid_rank:
+            yield self.finding(
+                module, spec.node,
+                f"{role} index map takes {spec.index_map_arity} "
+                f"arg(s) but the grid has rank {site.grid_rank}")
+        if spec.index_map_rank is not None \
+                and spec.shape_len is not None \
+                and spec.index_map_rank != spec.shape_len:
+            yield self.finding(
+                module, spec.node,
+                f"{role} index map returns {spec.index_map_rank} "
+                f"coordinate(s) for a rank-{spec.shape_len} block")
+
+    def check(self, module):
+        for site in extract_sites(module):
+            for spec in site.in_specs:
+                yield from self._spec_findings(module, site, spec,
+                                               "in_spec")
+            for spec in site.out_specs:
+                yield from self._spec_findings(module, site, spec,
+                                               "out_spec")
+            if site.grid_rank is None:
+                continue
+            for fn in kernel_closure(site):
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) \
+                            and core.tail_name(node.func) == "program_id" \
+                            and node.args \
+                            and isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, int) \
+                            and node.args[0].value >= site.grid_rank:
+                        yield self.finding(
+                            module, node,
+                            f"pl.program_id({node.args[0].value}) in "
+                            f"kernel `{site.kernel_name}` but the grid "
+                            f"at line {site.lineno} has rank "
+                            f"{site.grid_rank}")

@@ -1,0 +1,130 @@
+"""KL004 — accumulation-dtype hazards inside kernels.
+
+bf16 has 8 mantissa bits: a reduction carried in bf16 across grid
+steps loses the small addends long before the sum is done, and on the
+MXU a dot without ``preferred_element_type`` accumulates in the INPUT
+dtype.  The repo convention (every shipped kernel) is: dots say
+``preferred_element_type=jnp.float32`` and running state lives in fp32
+VMEM scratch, cast once on the final store.
+
+Two exact checks on the kernel's transitive body:
+
+* a ``dot_general``/``dot`` call (or a bare ``@``) without
+  ``preferred_element_type`` — the input-dtype-accumulation hazard;
+* a VMEM scratch buffer declared in a 16-bit dtype that the kernel
+  accumulates into (``ref[...] += ...`` or a self-referencing
+  ``ref[...] = f(ref[...])`` update), resolved by mapping the kernel's
+  positional signature onto (inputs, outputs, scratch) — only when the
+  signature and spec lists are complete enough to make the mapping a
+  fact.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import core
+from .extract import extract_sites, kernel_closure
+
+_DOT_TAILS = {"dot_general", "dot"}
+_HALF_DTYPES = {"bfloat16", "float16"}
+
+
+def _scratch_param_names(site):
+    """{param name -> ScratchInfo} when the positional mapping is
+    provable, else {}."""
+    fn = site.kernel_fn
+    if fn is None or not isinstance(fn, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+        return {}
+    if not (site.in_specs_complete and site.out_specs_complete
+            and site.scratch_complete):
+        return {}
+    a = fn.args
+    if a.vararg or a.kwarg or a.kwonlyargs:
+        return {}
+    params = [p.arg for p in (a.posonlyargs + a.args)]
+    n_in, n_out, n_scr = (len(site.in_specs), len(site.out_specs),
+                          len(site.scratch))
+    if len(params) != n_in + n_out + n_scr or n_scr == 0:
+        return {}
+    return dict(zip(params[n_in + n_out:], site.scratch))
+
+
+@core.register
+class AccumDtypeRule(core.Rule):
+    id = "KL004"
+    name = "accum-dtype-hazard"
+    severity = "warning"
+    doc = ("a kernel dot lacks preferred_element_type (accumulates in "
+           "the input dtype — bf16 on serving paths), or a reduction "
+           "is carried in a 16-bit VMEM scratch buffer instead of "
+           "fp32")
+    hint = ("pass preferred_element_type=jnp.float32 to every kernel "
+            "dot; keep running softmax/matmul state in fp32 scratch "
+            "and cast once on the final store")
+
+    def _body_dot_findings(self, module, site):
+        for fn in kernel_closure(site):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and core.tail_name(node.func) in _DOT_TAILS:
+                    if not any(k.arg == "preferred_element_type"
+                               for k in node.keywords):
+                        yield self.finding(
+                            module, node,
+                            f"`{core.tail_name(node.func)}` in kernel "
+                            f"`{site.kernel_name}` has no "
+                            "preferred_element_type — accumulates in "
+                            "the input dtype")
+                elif isinstance(node, ast.BinOp) \
+                        and isinstance(node.op, ast.MatMult):
+                    yield self.finding(
+                        module, node,
+                        f"bare `@` matmul in kernel "
+                        f"`{site.kernel_name}` accumulates in the "
+                        "input dtype; use lax.dot_general with "
+                        "preferred_element_type")
+
+    def _half_scratch_findings(self, module, site):
+        half = {name: scr
+                for name, scr in _scratch_param_names(site).items()
+                if scr.dtype in _HALF_DTYPES}
+        if not half:
+            return
+        fn = site.kernel_fn
+        for node in ast.walk(fn):
+            target = value = None
+            if isinstance(node, ast.AugAssign):
+                target, value = node.target, None
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            if not (isinstance(target, ast.Subscript)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id in half):
+                continue
+            name = target.value.id
+            if value is not None:
+                # plain store is fine; only self-referencing updates
+                # (ref = f(ref)) carry the reduction in bf16
+                reads_self = any(
+                    isinstance(n, ast.Name) and n.id == name
+                    for n in ast.walk(value))
+                if not reads_self:
+                    continue
+            yield self.finding(
+                module, node,
+                f"reduction carried in 16-bit VMEM scratch `{name}` "
+                f"({half[name].dtype}) in kernel "
+                f"`{site.kernel_name}` — accumulate in an fp32 "
+                "scratch and cast on the final store")
+
+    def check(self, module):
+        seen = set()            # helpers shared by several sites
+        for site in extract_sites(module):
+            for f in self._body_dot_findings(module, site):
+                key = (f.line, f.col)
+                if key not in seen:
+                    seen.add(key)
+                    yield f
+            yield from self._half_scratch_findings(module, site)

@@ -1,0 +1,95 @@
+"""KL006 — interpret-parity test coverage for public kernel entry
+points.
+
+The container has no TPU, so the interpret lane is the ONLY place a
+Pallas kernel's numerics are ever executed before hardware (ROADMAP
+item 2 remainder).  A public kernel entry point that no tier-1 test
+references is therefore completely unvalidated code — exactly the
+state ``quant_linear.weight_only_matmul_int4`` shipped in
+(referenced only by the TPU-hardware and Mosaic-cross-lowering lanes,
+both skipped in this container) until the ISSUE 10 parity tests.
+
+The rule: every ``__all__`` name of an ``ops/pallas`` kernel module
+that is bound to a function must appear (as a word) somewhere under
+``tests/`` — excluding the hardware/lowering lanes, which prove
+nothing on the interpret tier.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .. import core
+
+_SKIP_MODULES = ("autotune.py", "common.py", "__init__.py")
+# lanes that skip off-TPU: a reference there is not interpret coverage
+_EXCLUDED_TEST_FILES = ("test_pallas_hw.py", "test_pallas_tpu_lowering.py")
+
+
+def _module_all(module: core.Module):
+    for node in module.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "__all__" \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            return node, [e.value for e in node.value.elts
+                          if isinstance(e, ast.Constant)
+                          and isinstance(e.value, str)]
+    return None, []
+
+
+@core.register
+class InterpretParityRule(core.Rule):
+    id = "KL006"
+    name = "interpret-parity-gap"
+    severity = "warning"
+    doc = ("a public ops/pallas kernel entry point (__all__ function) "
+           "is referenced by no tests/ module outside the "
+           "hardware/lowering lanes — its numerics never execute in "
+           "this container")
+    hint = ("add an interpret-tier parity test vs a dense reference "
+            "(fp32/bf16 tolerance tiers, see tests/test_fused_head.py "
+            "TestPallasTier), or demote the name from __all__")
+
+    def __init__(self):
+        self._corpus = None
+
+    def prepare(self, modules):
+        tests_dir = os.path.join(core.repo_root(), "tests")
+        chunks = []
+        if os.path.isdir(tests_dir):
+            for root, dirs, names in os.walk(tests_dir):
+                # *_fixtures trees are analyzed, never run — a name
+                # there is not coverage (and the KL006 fixtures would
+                # otherwise self-reference)
+                dirs[:] = [d for d in dirs if d != "__pycache__"
+                           and not d.endswith("_fixtures")]
+                for n in sorted(names):
+                    if n.endswith(".py") and n not in _EXCLUDED_TEST_FILES:
+                        try:
+                            with open(os.path.join(root, n),
+                                      encoding="utf-8") as f:
+                                chunks.append(f.read())
+                        except OSError:
+                            pass
+        self._corpus = "\n".join(chunks)
+
+    def check(self, module):
+        rel = module.rel
+        if "ops/pallas/" not in rel or rel.endswith(_SKIP_MODULES):
+            return
+        all_node, names = _module_all(module)
+        if not names or self._corpus is None:
+            return
+        for name in names:
+            fn = module.functions.get(name)
+            if fn is None:          # constants/re-exports: not entry points
+                continue
+            if not re.search(rf"\b{re.escape(name)}\b", self._corpus):
+                yield self.finding(
+                    module, fn,
+                    f"public kernel entry point `{name}` has no "
+                    "interpret-tier tests/ reference — unvalidated in "
+                    "this container")

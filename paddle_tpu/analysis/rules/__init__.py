@@ -13,3 +13,14 @@ from . import tl005_collectives    # noqa: F401
 from . import tl006_excepts        # noqa: F401
 from . import tl007_pytree         # noqa: F401
 from . import tl008_notimpl        # noqa: F401
+from . import tl009_partition_specs  # noqa: F401
+
+# kernellint (KL) rules live beside their cost model in ../kernel but
+# register in the same engine: one CLI, one suppression syntax, one
+# ratchet machinery — a separate KERNELLINT.md ledger.
+from ..kernel import kl001_vmem        # noqa: F401
+from ..kernel import kl002_grid        # noqa: F401
+from ..kernel import kl003_masking     # noqa: F401
+from ..kernel import kl004_accum       # noqa: F401
+from ..kernel import kl005_autotune    # noqa: F401
+from ..kernel import kl006_parity      # noqa: F401

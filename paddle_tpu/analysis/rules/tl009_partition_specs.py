@@ -1,0 +1,94 @@
+"""TL009 — shard_map/pjit PartitionSpec axis-name drift.
+
+TL005 guards collective CALL SITES (``psum(x, "mp")``); this rule
+extends the same vocabulary check to the sharding DECLARATIONS that
+route arrays onto mesh axes: ``in_specs=``/``out_specs=`` of
+``shard_map`` and ``in_shardings=``/``out_shardings=`` of ``pjit``.
+A ``P("modelp")`` against a mesh whose axes are ``("dp", "mp")``
+fails at trace time at best; under ``check_vma=False`` manual meshes
+it can silently replicate a tensor that was meant to be sharded —
+costing memory and, for donated buffers, correctness.
+
+The axis vocabulary is shared with TL005 (``*_AXIS`` module constants
+plus every mesh ``axis_names=(...)``/``make_mesh((..), (names))``
+entry in the scanned tree): a string literal inside a
+PartitionSpec/``P(...)`` constructor in those keyword positions that
+matches no known axis is drift or a typo.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import core
+from .tl005_collectives import CollectiveAxisRule
+
+_WRAPPERS = {"shard_map", "pjit", "jit"}   # jit: in_/out_shardings
+_SPEC_KWARGS = {"in_specs", "out_specs", "in_shardings", "out_shardings"}
+_SPEC_CTORS = {"PartitionSpec", "P"}
+
+
+@core.register
+class PartitionSpecAxisRule(core.Rule):
+    id = "TL009"
+    name = "partition-spec-axis-drift"
+    severity = "warning"
+    doc = ("a shard_map/pjit in_specs/out_specs PartitionSpec names an "
+           "axis matching no *_AXIS constant or mesh axis_names entry "
+           "in the scanned tree")
+    hint = ("use the topology constants (parallel/topology.py MP_AXIS "
+            "et al.) in PartitionSpecs — or add the new axis to the "
+            "mesh that names it")
+
+    def __init__(self):
+        self.vocab = set()
+
+    def prepare(self, modules):
+        # one vocabulary with TL005: axis constants + mesh axis names
+        collector = CollectiveAxisRule()
+        collector.prepare(modules)
+        self.vocab = set(collector.vocab)
+        # make_mesh((2,), ("mp",)) passes names positionally
+        for mod in modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) \
+                        and core.tail_name(node.func) == "make_mesh" \
+                        and len(node.args) >= 2 \
+                        and isinstance(node.args[1],
+                                       (ast.Tuple, ast.List)):
+                    for e in node.args[1].elts:
+                        if isinstance(e, ast.Constant) \
+                                and isinstance(e.value, str):
+                            self.vocab.add(e.value)
+
+    def _spec_axis_literals(self, node: ast.AST):
+        """(expr, value) string literals inside PartitionSpec/P
+        constructors anywhere under ``node``."""
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Call)
+                    and core.tail_name(sub.func) in _SPEC_CTORS):
+                continue
+            for arg in list(sub.args) + [k.value for k in sub.keywords]:
+                elts = arg.elts if isinstance(
+                    arg, (ast.Tuple, ast.List)) else [arg]
+                for e in elts:
+                    if isinstance(e, ast.Constant) \
+                            and isinstance(e.value, str):
+                        yield e, e.value
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and core.tail_name(node.func) in _WRAPPERS):
+                continue
+            for kw in node.keywords:
+                if kw.arg not in _SPEC_KWARGS:
+                    continue
+                for expr, value in self._spec_axis_literals(kw.value):
+                    if value not in self.vocab:
+                        yield self.finding(
+                            module, expr,
+                            f"{core.tail_name(node.func)} "
+                            f"{kw.arg} names axis {value!r} which "
+                            "matches no *_AXIS constant or mesh "
+                            "axis_names in the scanned tree")

@@ -1,6 +1,8 @@
 """Pallas TPU fused kernels (SURVEY §2.6 porting list)."""
 
-from .flash_attention import flash_attention, flash_attention_fwd  # noqa: F401
+from .flash_attention import (  # noqa: F401
+    flash_attention, flash_attention_fwd, flash_attention_with_lse,
+)
 from .fused import (  # noqa: F401
     fused_bias_act, fused_dropout_add, fused_softmax_mask, swiglu,
 )

@@ -95,15 +95,24 @@ def _time_once(fn: Callable, args) -> float:
 
 def pick(name: str, key: Tuple, candidates: Sequence[Any],
          run: Callable[[Any], Callable], args,
-         default: Any) -> Any:
+         default: Any,
+         valid: Optional[Callable[[Any], bool]] = None) -> Any:
     """Return the winning candidate for (name, key).
 
     ``run(candidate)`` returns a callable taking ``args``; each candidate is
     timed once per unseen key when FLAGS.use_autotune is on, else
     ``default`` is returned immediately.  Winners persist in the process
-    cache (+ optional JSON file)."""
+    cache (+ optional JSON file).
+
+    ``valid``: an optional static validity predicate — kernels pass the
+    shared VMEM cost model here (``analysis/kernel/cost.py``, ISSUE 10)
+    so configs that provably cannot fit on-chip are rejected up front
+    instead of burning a compile to fail inside Mosaic.  The
+    try/except below still catches what only the compiler can know."""
     if not FLAGS.use_autotune or len(candidates) <= 1:
         return default
+    if valid is not None:
+        candidates = [c for c in candidates if valid(c)] or [default]
     _load_disk()
     ck = cache_key(name, key)
     if ck in _CACHE:

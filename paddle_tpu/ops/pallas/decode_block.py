@@ -46,15 +46,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...analysis.kernel import cost
 from .common import NEG_INF, use_interpret
 
 __all__ = ["decode_block_pallas", "tune_decode_block",
            "unsupported_reason", "VMEM_BUDGET_BYTES", "MAX_HEAD_DIM"]
 
-# layer weights + page staging + scratch must fit comfortably under a
-# v4/v5 core's ~16 MB VMEM; module attr so tests/operators can tune it
-VMEM_BUDGET_BYTES = 12 * 2 ** 20
-MAX_HEAD_DIM = 256
+# Both limits come from the shared cost model (ISSUE 10): the number
+# the static analyzer (KL001) proves things about is the number this
+# dispatch enforces.  Kept as module attrs so tests/operators can tune
+# the budget without touching the global table.
+VMEM_BUDGET_BYTES = cost.budget_bytes()
+MAX_HEAD_DIM = cost.MAX_HEAD_DIM
 DEFAULT_PAGES = 8
 _PAGE_CANDIDATES = (1, 2, 4, 8, 16)
 
@@ -85,22 +88,23 @@ def _weight_names(spec) -> Tuple[str, ...]:
             "up_w", "down_w")
 
 
-def _scratch_bytes(spec, pages: int, pool_itemsize: int) -> int:
-    Hq, Hkv, D, BS = (spec.num_heads, spec.kv_heads, spec.head_dim,
-                      spec.block_size)
-    stage = 2 * pages * BS * Hkv * D * pool_itemsize
-    f32 = 4 * (2 * Hq * D + 2 * Hkv * D + 2 * Hq)
-    return stage + f32
+def _vmem_total(spec, pages: int, wbytes: int, pool_itemsize: int,
+                x_itemsize: int) -> int:
+    """One layer invocation's VMEM bytes — the shared cost model's
+    number (analysis/kernel/cost.py), never a local formula."""
+    return cost.decode_block_vmem(
+        hidden=spec.hidden, num_heads=spec.num_heads,
+        kv_heads=spec.kv_heads, head_dim=spec.head_dim,
+        block_size=spec.block_size, pages=pages, weight_bytes=wbytes,
+        pool_itemsize=pool_itemsize, x_itemsize=x_itemsize)["total"]
 
 
 def unsupported_reason(spec, lp, pool_k) -> Optional[str]:
     """None when this layer fits the kernel, else the reason (the
-    ``ops/decode_block.py`` dispatch signal)."""
-    D = spec.head_dim
-    if D > MAX_HEAD_DIM:
-        return f"head_dim {D} exceeds the kernel cap {MAX_HEAD_DIM}"
-    if spec.rope and D % 2:
-        return f"rotate-half RoPE needs an even head_dim, got {D}"
+    ``ops/decode_block.py`` dispatch signal).  Layout checks (a dense
+    layer dict) live here; every byte/cap limit is delegated to the
+    shared cost model so the static KL001 analysis and this runtime
+    gate cannot drift."""
     names = _weight_names(spec)
     missing = [n for n in names if n not in lp]
     if missing:
@@ -108,13 +112,13 @@ def unsupported_reason(spec, lp, pool_k) -> Optional[str]:
                 f"{spec.activation} block (MoE FFNs run the reference "
                 "tier)")
     wbytes = sum(lp[n].size * lp[n].dtype.itemsize for n in names)
-    need = wbytes + _scratch_bytes(spec, 1, pool_k.dtype.itemsize)
-    if need > VMEM_BUDGET_BYTES:
-        return (f"layer needs ~{need / 2**20:.1f} MB VMEM "
-                f"({wbytes / 2**20:.1f} MB weights) > budget "
-                f"{VMEM_BUDGET_BYTES / 2**20:.1f} MB — multi-core "
-                "fusion territory, per-op tier serves it")
-    return None
+    return cost.decode_block_unsupported_reason(
+        hidden=spec.hidden, num_heads=spec.num_heads,
+        kv_heads=spec.kv_heads, head_dim=spec.head_dim,
+        block_size=spec.block_size, rope=spec.rope, weight_bytes=wbytes,
+        pool_itemsize=pool_k.dtype.itemsize,
+        x_itemsize=lp[names[0]].dtype.itemsize,
+        budget=VMEM_BUDGET_BYTES)
 
 
 # ---------------------------------------------------------------------------
@@ -273,12 +277,15 @@ def _kernel(*refs, meta: _Meta):
 # ---------------------------------------------------------------------------
 # host wrapper + autotune
 # ---------------------------------------------------------------------------
-def _fitting_candidates(spec, mb: int, pool_itemsize: int,
-                        wbytes: int) -> Tuple[int, ...]:
+def _fitting_candidates(spec, mb: int, pool_itemsize: int, wbytes: int,
+                        x_itemsize: int) -> Tuple[int, ...]:
+    """Page-chunk candidates the cost model says can fit — the
+    provably-overflowing ones never reach the tuner (KL005's runtime
+    half)."""
     cands = tuple(
         p for p in _PAGE_CANDIDATES
         if p <= max(mb, 1)
-        and wbytes + _scratch_bytes(spec, p, pool_itemsize)
+        and _vmem_total(spec, p, wbytes, pool_itemsize, x_itemsize)
         <= VMEM_BUDGET_BYTES)
     return cands or (1,)
 
@@ -287,7 +294,9 @@ def _tuned_pages(spec, lp, pool_k, mb: int, args) -> int:
     from .autotune import FLAGS, lookup, pick
     wbytes = sum(lp[n].size * lp[n].dtype.itemsize
                  for n in _weight_names(spec))
-    cands = _fitting_candidates(spec, mb, pool_k.dtype.itemsize, wbytes)
+    x_isz = lp[_weight_names(spec)[0]].dtype.itemsize
+    cands = _fitting_candidates(spec, mb, pool_k.dtype.itemsize, wbytes,
+                                x_isz)
     default = max(p for p in cands if p <= DEFAULT_PAGES)
     key = (spec.hidden, spec.num_heads, spec.kv_heads, spec.head_dim,
            spec.block_size, mb, spec.activation, str(pool_k.dtype))
@@ -300,7 +309,10 @@ def _tuned_pages(spec, lp, pool_k, mb: int, args) -> int:
         return jax.jit(functools.partial(_call, spec=spec,
                                          pages=int(cand)))
 
-    return int(pick("decode_block", key, cands, run, args, default))
+    return int(pick("decode_block", key, cands, run, args, default,
+                    valid=lambda p: _vmem_total(
+                        spec, int(p), wbytes, pool_k.dtype.itemsize,
+                        x_isz) <= VMEM_BUDGET_BYTES))
 
 
 def _call(x, lp, pool_k, pool_v, block_table, lengths, cos, sin, *,

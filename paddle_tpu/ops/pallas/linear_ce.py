@@ -64,7 +64,13 @@ def _pad_rows(a, br):
 
 
 def _tuned_blocks(x2, w, labels2, meta: _Meta) -> Tuple[int, int]:
-    """(block_rows, chunk) via the autotune cache; explicit sizes win."""
+    """(block_rows, chunk) via the autotune cache; explicit sizes win.
+
+    Candidates are filtered through the shared VMEM cost model
+    (``analysis/kernel/cost.py``) before timing: a (block_rows, chunk)
+    whose per-grid-step working set cannot fit the budget at this
+    hidden size never reaches the tuner (KL005's runtime half)."""
+    from ...analysis.kernel import cost
     from .autotune import FLAGS, lookup, pick
     T, H = x2.shape
     V = w.shape[0]
@@ -79,8 +85,13 @@ def _tuned_blocks(x2, w, labels2, meta: _Meta) -> Tuple[int, int]:
         m = meta._replace(block_rows=br, chunk=c)
         return jax.jit(lambda a, b, l: _fwd(a, b, l, m)[0])
 
+    def fits(cand):
+        br, c = cand
+        return cost.linear_ce_fits(br, c, H, x2.dtype.itemsize,
+                                   w.dtype.itemsize)
+
     return pick("linear_ce", key, _BLOCK_CANDIDATES, run,
-                (x2, w, labels2), DEFAULT_BLOCKS)
+                (x2, w, labels2), DEFAULT_BLOCKS, valid=fits)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,37 @@
+"""KL001 negative: small constant blocks fit easily, and
+runtime-dependent dims must never be guessed into a finding."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BM = 256
+
+
+def _kernel(x_ref, o_ref, acc):
+    o_ref[:] = x_ref[:]
+
+
+def small(x):
+    return pl.pallas_call(
+        _kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((BM, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((BM, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((4 * BM, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((BM, 128), jnp.float32)],
+    )(x)
+
+
+def runtime_shaped(x):
+    # H is runtime-dependent: provable lower bound stays tiny even if
+    # the true working set could be huge — no finding, by design
+    R, H = x.shape
+    return pl.pallas_call(
+        _kernel,
+        grid=(R // 8,),
+        in_specs=[pl.BlockSpec((8, H), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, H), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, H), x.dtype),
+        scratch_shapes=[pltpu.VMEM((8, H), jnp.float32)],
+    )(x)

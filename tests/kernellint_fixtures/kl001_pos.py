@@ -1,0 +1,26 @@
+"""KL001 positive: constant-folded working set provably past the
+budget — 4 x (4096, 4096) fp32 scratch is 256 MB against a 12 MB
+budget, and the blocks are constant too."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BM, BN = 4096, 4096
+
+
+def _kernel(x_ref, o_ref, a_scr, b_scr, c_scr, d_scr):
+    o_ref[:] = x_ref[:]
+
+
+def oversized(x):
+    return pl.pallas_call(
+        _kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((BM, BN), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((BM, BN), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((4 * BM, 4 * BN), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((BM, BN), jnp.float32)] * 4,
+    )(x)

@@ -1,0 +1,20 @@
+"""KL002 negative: arities, coordinate counts and program_id axes all
+agree with the grid."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    j = pl.program_id(1)
+    o_ref[:] = x_ref[:] * j
+
+
+def good(x):
+    return pl.pallas_call(
+        _kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((32, 512), jnp.float32),
+    )(x)

@@ -1,0 +1,20 @@
+"""KL002 positive: index-map arity vs grid rank, index-map coordinate
+count vs block rank, and an out-of-range program_id."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    k = pl.program_id(2)          # grid is rank 2
+    o_ref[:] = x_ref[:] * k
+
+
+def bad(x):
+    return pl.pallas_call(
+        _kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],       # arity 1
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, 0, 0)),  # 3 coords
+        out_shape=jax.ShapeDtypeStruct((32, 512), jnp.float32),
+    )(x)

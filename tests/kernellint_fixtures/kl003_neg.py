@@ -1,0 +1,45 @@
+"""KL003 negative: the same ceil-divided grid, but the kernel masks
+the overhang with an iota position stream (the linear_ce pattern);
+and a non-cdiv grid needs no mask at all."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _masked_kernel(x_ref, o_ref, acc, *, V, chunk):
+    j = pl.program_id(1)
+    x = x_ref[:]
+    cols = j * chunk + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    x = jnp.where(cols < V, x, 0.0)
+    acc[:] += jnp.sum(x, axis=1, keepdims=True)
+    o_ref[:] = acc[:]
+
+
+def masked_sum(x, chunk):
+    import functools
+    R, V = x.shape
+    nv = pl.cdiv(V, chunk)
+    return pl.pallas_call(
+        functools.partial(_masked_kernel, V=V, chunk=chunk),
+        grid=(1, nv),
+        in_specs=[pl.BlockSpec((R, chunk), lambda i, j: (0, j))],
+        out_specs=pl.BlockSpec((R, 1), lambda i, j: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, 1), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((R, 1), jnp.float32)],
+    )(x)
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def dividing_grid(x):
+    R, V = x.shape
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(R // 8,),
+        in_specs=[pl.BlockSpec((8, V), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, V), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, V), x.dtype),
+    )(x)

@@ -1,0 +1,26 @@
+"""KL003 positive: ceil-divided grid, kernel folds the tile with no
+mask — the overhang rows silently enter the sum."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, o_ref, acc):
+    acc[:] += jnp.sum(x_ref[:], axis=1, keepdims=True)
+    o_ref[:] = acc[:]
+
+
+def unmasked_sum(x, chunk):
+    R, V = x.shape
+    nv = pl.cdiv(V, chunk)
+    return pl.pallas_call(
+        _kernel,
+        grid=(1, nv),
+        in_specs=[pl.BlockSpec((R, chunk), lambda i, j: (0, j))],
+        out_specs=pl.BlockSpec((R, 1), lambda i, j: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, 1), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((R, 1), jnp.float32)],
+    )(x)

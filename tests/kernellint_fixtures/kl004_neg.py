@@ -1,0 +1,28 @@
+"""KL004 negative: fp32-accumulated dot, fp32 scratch carry, and a
+bf16 scratch that is only STORED to (no reduction) is fine."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, w_ref, o_ref, acc, stage):
+    part = jax.lax.dot_general(x_ref[:], w_ref[:],
+                               (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    acc[:] += part
+    stage[:] = x_ref[:]              # plain store, not a reduction
+    o_ref[:] = acc[:].astype(o_ref.dtype)
+
+
+def good_accum(x, w):
+    return pl.pallas_call(
+        _kernel,
+        grid=(1, 4),
+        in_specs=[pl.BlockSpec((128, 128), lambda i, j: (0, j)),
+                  pl.BlockSpec((128, 128), lambda i, j: (j, 0))],
+        out_specs=pl.BlockSpec((128, 128), lambda i, j: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((128, 128), jnp.bfloat16),
+        scratch_shapes=[pltpu.VMEM((128, 128), jnp.float32),
+                        pltpu.VMEM((128, 128), jnp.bfloat16)],
+    )(x, w)

@@ -1,0 +1,25 @@
+"""KL004 positive: a kernel dot with no preferred_element_type, and a
+reduction carried in a bf16 VMEM scratch."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, w_ref, o_ref, acc):
+    part = jax.lax.dot_general(x_ref[:], w_ref[:],
+                               (((1,), (0,)), ((), ())))   # input dtype!
+    acc[:] += part.astype(acc.dtype)                       # bf16 carry
+    o_ref[:] = acc[:]
+
+
+def bad_accum(x, w):
+    return pl.pallas_call(
+        _kernel,
+        grid=(1, 4),
+        in_specs=[pl.BlockSpec((128, 128), lambda i, j: (0, j)),
+                  pl.BlockSpec((128, 128), lambda i, j: (j, 0))],
+        out_specs=pl.BlockSpec((128, 128), lambda i, j: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((128, 128), jnp.bfloat16),
+        scratch_shapes=[pltpu.VMEM((128, 128), jnp.bfloat16)],
+    )(x, w)

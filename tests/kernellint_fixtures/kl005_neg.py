@@ -1,0 +1,15 @@
+"""KL005 negative: the candidates tuple is registered — pick at
+warmup, lookup at trace time, one key string."""
+import jax
+
+_BLOCK_CANDIDATES = ((128, 128), (256, 128), (256, 256))
+DEFAULT_BLOCK = (128, 128)
+
+
+def tuned_block(x, args):
+    from paddle_tpu.ops.pallas.autotune import lookup, pick
+    key = (x.shape, str(x.dtype))
+    if isinstance(x, jax.core.Tracer):
+        return lookup("fixture_kernel", key, DEFAULT_BLOCK)
+    return pick("fixture_kernel", key, _BLOCK_CANDIDATES,
+                lambda c: (lambda *a: None), args, DEFAULT_BLOCK)

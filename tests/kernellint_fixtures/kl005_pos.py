@@ -1,0 +1,23 @@
+"""KL005 positive: a candidates tuple with no autotune registration —
+the knob can never leave its default."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_BLOCK_CANDIDATES = ((128, 128), (256, 128), (256, 256))
+DEFAULT_BLOCK = 128
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def run(x):
+    b = DEFAULT_BLOCK               # the sweep above is dead code
+    return pl.pallas_call(
+        _kernel,
+        grid=(x.shape[0] // b,),
+        in_specs=[pl.BlockSpec((b, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((b, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

@@ -1,0 +1,11 @@
+"""KL006 negative: the public entry point is referenced from tests/
+(``decode_attention`` has interpret-tier coverage), and non-function
+``__all__`` names (re-exported constants) are out of scope."""
+
+SOME_EXPORTED_CONSTANT = 7
+
+__all__ = ["decode_attention", "SOME_EXPORTED_CONSTANT"]
+
+
+def decode_attention(q, k_cache, v_cache, lengths):
+    return q

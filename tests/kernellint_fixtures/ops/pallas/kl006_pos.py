@@ -1,0 +1,9 @@
+"""KL006 positive: a public kernel entry point no tests/ module
+references (the fixture path contains ops/pallas/ so the rule is in
+scope; *_fixtures trees are excluded from the coverage corpus)."""
+
+__all__ = ["totally_unreferenced_kernel_entry"]
+
+
+def totally_unreferenced_kernel_entry(x):
+    return x

@@ -350,3 +350,40 @@ def test_incubate_api_dispatch():
     assert oq.shape == [1, 8, 2, 16]
     s = IF.swiglu(pt.to_tensor(rng.normal(size=(4, 32)).astype(np.float32)))
     assert s.shape == [4, 16]
+
+
+# -- forward-only flash entry points (ISSUE 10 KL006 parity coverage) ----
+def test_flash_attention_fwd_entry_matches_dense():
+    """`flash_attention_fwd` (the F.scaled_dot_product_attention
+    dispatch entry) == the dense reference, fp32 and bf16 tiers."""
+    B, S, H, D = 2, 128, 2, 32
+    q = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    v = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    got = np.asarray(pk.flash_attention_fwd(q, k, v, None, True))
+    exp = np.asarray(_sdpa_ref(q, k, v, causal=True))
+    np.testing.assert_allclose(got, exp, rtol=2e-3, atol=2e-3)
+    qb, kb, vb = (jnp.asarray(t, jnp.bfloat16) for t in (q, k, v))
+    got_b = np.asarray(pk.flash_attention_fwd(qb, kb, vb, None, True),
+                       np.float32)
+    np.testing.assert_allclose(got_b, exp, rtol=2e-2, atol=2e-2)
+
+
+def test_flash_attention_with_lse_matches_dense():
+    """`flash_attention_with_lse` (the ring-attention building block):
+    out == dense reference AND lse == the dense log-sum-exp of the
+    scaled logits, in the documented [B, Hq, Sq, 1] fp32 layout."""
+    B, S, H, D = 1, 128, 2, 32
+    q = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    v = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    out, lse = pk.flash_attention_with_lse(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), _sdpa_ref(q, k, v),
+                               rtol=2e-3, atol=2e-3)
+    qt = jnp.swapaxes(jnp.asarray(q), 1, 2).astype(jnp.float32)
+    kt = jnp.swapaxes(jnp.asarray(k), 1, 2).astype(jnp.float32)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(D)
+    ref_lse = jax.nn.logsumexp(logits, axis=-1)[..., None]
+    assert lse.shape == (B, H, S, 1) and lse.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               rtol=1e-3, atol=1e-3)

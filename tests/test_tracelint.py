@@ -21,7 +21,7 @@ FIXTURES = os.path.join(HERE, "tracelint_fixtures")
 REPO = os.path.dirname(HERE)
 
 RULE_IDS = ("TL001", "TL002", "TL003", "TL004", "TL005", "TL006",
-            "TL007", "TL008")
+            "TL007", "TL008", "TL009")
 
 
 def run_fixture(name, select=None):
@@ -84,6 +84,15 @@ def test_tl005_names_the_drifted_axis():
     msgs = " ".join(f.message for f in findings)
     assert "'modelp'" in msgs and "'tensor'" in msgs
     assert len(findings) == 2
+
+
+def test_tl009_names_the_drifted_spec_axis():
+    findings = run_fixture("tl009_pos.py", select={"TL009"})
+    msgs = " ".join(f.message for f in findings)
+    assert "'modelp'" in msgs and "'tensor'" in msgs
+    assert len(findings) == 2           # the declared P("dp") passes
+    assert {"in_specs" in f.message or "out_specs" in f.message
+            for f in findings} == {True}
 
 
 # -- suppressions -------------------------------------------------------

@@ -1,0 +1,25 @@
+"""TL009 negative: every partition-spec axis literal is declared —
+via a *_AXIS constant, mesh axis_names, or positional make_mesh
+names — and constant-threaded specs never use raw literals."""
+import jax
+from jax.sharding import PartitionSpec as P
+
+MP_AXIS = "mp"
+mesh = jax.make_mesh((2, 2), axis_names=("dp", "mp"))
+mesh2 = jax.make_mesh((2,), ("sep",))
+
+
+def local(x, w):
+    return x @ w
+
+
+f = jax.shard_map(local, mesh=mesh,
+                  in_specs=(P("dp", MP_AXIS), P()),
+                  out_specs=P("mp"))
+
+g = jax.shard_map(local, mesh=mesh2, in_specs=(P("sep"), P()),
+                  out_specs=P())
+
+# PartitionSpecs OUTSIDE shard_map/pjit spec kwargs are not this
+# rule's business (sharding constraints have their own context)
+standalone = P("anything_goes_here")

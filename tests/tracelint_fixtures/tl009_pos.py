@@ -1,0 +1,18 @@
+"""TL009 positive: shard_map/pjit partition specs naming axes the
+scanned tree never declares."""
+import jax
+from jax.sharding import PartitionSpec as P
+
+MP_AXIS = "mp"
+mesh = jax.make_mesh((2, 2), axis_names=("dp", "mp"))
+
+
+def local(x, w):
+    return x @ w
+
+
+f = jax.shard_map(local, mesh=mesh,
+                  in_specs=(P("modelp", None), P()),     # typo'd axis
+                  out_specs=P(None, "tensor"))           # drifted axis
+
+g = jax.jit(local, in_shardings=(P("dp"), P("dp")))      # fine: declared
