@@ -135,11 +135,13 @@ def _layer_train_bench(net, x, y, steps: int, items_per_step: float,
 
 
 def _serve_aot_warm_extra(cfg, params, eng, ttft_cold, *, mb, nb, t0,
-                          new, rng):
+                          new, rng, aot_dir_out=None):
     """Cold-vs-warm start measurement for the serve row (ISSUE 6):
     export the engine's compile artifacts, warm-start a second engine
     from them, and report TTFT + backend-compile counts + bucket
-    hit/miss for both.  Never fails the row — errors land in
+    hit/miss for both.  ``aot_dir_out`` (a dict) receives the export
+    directory so later rows (extra.resilience) reuse the artifacts
+    instead of re-exporting.  Never fails the row — errors land in
     extra.aot_error."""
     try:
         import tempfile
@@ -149,6 +151,8 @@ def _serve_aot_warm_extra(cfg, params, eng, ttft_cold, *, mb, nb, t0,
 
         aot_dir = tempfile.mkdtemp(prefix="bench_aot_serve_")
         export_engine(eng, aot_dir)
+        if aot_dir_out is not None:
+            aot_dir_out["dir"] = aot_dir
         monitor = CompileMonitor().install()
         try:
             t_w = time.perf_counter()
@@ -272,6 +276,131 @@ def _serve_spec_extra(cfg, params, eng_off, *, mb, nb, on_accel, t0,
         }}
     except Exception as e:
         return {"spec_error": f"{type(e).__name__}: {e}"}
+
+
+def _serve_resilience_extra(cfg, params, *, mb, nb, on_accel, t0, new,
+                            aot_dir):
+    """Resilience row for the serve config (ISSUE 11), all on
+    compile-warm engines (reusing the artifacts the aot_warm row
+    exported): crash-recovery time-to-resume (AOT-warm rebuild +
+    replay, zero backend compiles — the serve_recovery_warm budget
+    row), preemption spill/restore seconds, and high-priority goodput
+    with vs without injected chaos.  Never fails the row — errors land
+    in extra.resilience_error."""
+    try:
+        from paddle_tpu.aot.serve import warm_engine_factory
+        from paddle_tpu.observability import CompileMonitor
+        from paddle_tpu.serving import (AdmissionConfig, LoadGenConfig,
+                                        PoissonLoadGenerator,
+                                        RetryPolicy, ServingFrontend,
+                                        SupervisedEngine)
+
+        if aot_dir is None:
+            raise RuntimeError("no AOT artifacts from the aot_warm row")
+        rng = np.random.default_rng(3)
+        factory = warm_engine_factory(cfg, params, aot_dir=aot_dir,
+                                      max_batch=mb, block_size=16,
+                                      num_blocks=nb)
+
+        # -- crash-recovery time-to-resume on a warm fleet ------------
+        sup = SupervisedEngine(factory,
+                               policy=RetryPolicy(backoff_base_s=0.0),
+                               sleep=lambda s: None)
+        for i in range(min(3, mb + 1)):
+            sup.add_request(
+                rng.integers(0, cfg.vocab_size, (t0,)).astype(np.int32),
+                new, temperature=0.7 if i == 0 else 0.0,
+                top_k=8 if i == 0 else None, seed=i + 1)
+        sup.step()
+        sup.step()
+        inner, real = sup.engine, sup.engine.step
+
+        def crash_once():
+            inner.step = real
+            raise RuntimeError("bench-injected crash")
+
+        inner.step = crash_once
+        monitor = CompileMonitor().install()
+        try:
+            t_c = time.perf_counter()
+            sup.step()                    # teardown + rebuild + replay
+            sup.step()                    # first post-recovery tokens
+            time_to_resume = time.perf_counter() - t_c
+        finally:
+            monitor.uninstall()
+        recovery_compiles = monitor.n_compiles
+        sup.run_to_completion()
+
+        # -- preemption save/restore under forced page pressure -------
+        small = factory()                 # warm engine, tight by theft
+        small.add_request(
+            rng.integers(0, cfg.vocab_size, (t0,)).astype(np.int32),
+            new, priority=0)
+        small.step()
+        stolen = small.alloc.acquire(small.alloc.free_blocks)
+        try:
+            small.add_request(
+                rng.integers(0, cfg.vocab_size, (t0,)).astype(np.int32),
+                new, priority=5)
+            small.step()                  # saturated: must preempt
+        finally:
+            if stolen:
+                small.alloc.release(stolen)
+        small.run_to_completion()
+        pstats = small.resilience_stats()
+
+        # -- high-priority goodput: chaos A/B -------------------------
+        lg = LoadGenConfig(
+            n_requests=12 if not on_accel else 32,
+            rate_rps=100.0 if not on_accel else 8.0, seed=4,
+            prompt_len=(3, t0), max_new_tokens=(3, new),
+            sampled_fraction=0.25, cancel_fraction=0.1,
+            priorities=(0, 10), priority_weights=(0.6, 0.4),
+            slo_ttft_s=5.0 if not on_accel else 2.0,
+            slo_tpot_s=1.0 if not on_accel else 0.25)
+
+        def run_chaos(chaos):
+            s = SupervisedEngine(
+                factory, policy=RetryPolicy(backoff_base_s=0.0),
+                sleep=lambda x: None)
+            fe = ServingFrontend(
+                s, admission=AdmissionConfig(max_queue_len=64))
+            if chaos:
+                eng, step = s.engine, s.engine.step
+                state = {"n": 0}
+
+                def flaky():
+                    state["n"] += 1
+                    if state["n"] == 5:
+                        raise RuntimeError("bench chaos crash")
+                    return step()
+
+                eng.step = flaky
+            rep = PoissonLoadGenerator(fe, lg).run()
+            return rep, s
+
+        rep_chaos, s_chaos = run_chaos(True)
+        rep_calm, _ = run_chaos(False)
+        hi_chaos = (rep_chaos.by_priority or {}).get(10, {})
+        hi_calm = (rep_calm.by_priority or {}).get(10, {})
+        return {"resilience": {
+            "recovery_time_to_resume_s": round(time_to_resume, 4),
+            "recovery_backend_compiles": recovery_compiles,
+            "recoveries": sup.stats["recoveries"],
+            "replayed_requests": sup.stats["replayed_requests"],
+            "preemptions": pstats["preemptions"],
+            "restores": pstats["restores"],
+            "preempt_save_secs": round(pstats["spill_save_secs"], 4),
+            "preempt_restore_secs": round(
+                pstats["spill_restore_secs"], 4),
+            "hi_goodput_rps_chaos": hi_chaos.get("goodput_rps"),
+            "hi_goodput_rps_calm": hi_calm.get("goodput_rps"),
+            "chaos_recoveries": s_chaos.stats["recoveries"],
+            "chaos_kv_leaked_blocks":
+                rep_chaos.to_dict()["kv_leaked_blocks"],
+        }}
+    except Exception as e:
+        return {"resilience_error": f"{type(e).__name__}: {e}"}
 
 
 def _serve_decode_block_extra(cfg, params, eng_fused, *, mb, nb, on_accel,
@@ -554,9 +683,10 @@ def run_config_bench(config: str):
                       "model": "llama_7b-width L4 proxy serving"
                                if on_accel else "llama_tiny CPU proxy"},
         }
+        aot_dir_out = {}
         out["extra"].update(_serve_aot_warm_extra(
             cfg, params, eng, ttft_cold, mb=mb, nb=nb, t0=t0, new=new,
-            rng=rng))
+            rng=rng, aot_dir_out=aot_dir_out))
         out["extra"].update(_serve_loadgen_extra(eng, on_accel, t0=t0,
                                                  new=new))
         out["extra"].update(_serve_decode_block_extra(
@@ -565,6 +695,9 @@ def run_config_bench(config: str):
         out["extra"].update(_serve_spec_extra(
             cfg, params, eng, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
             new=new))
+        out["extra"].update(_serve_resilience_extra(
+            cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
+            new=new, aot_dir=aot_dir_out.get("dir")))
     elif config == "decode":
         # inference: autoregressive decode through the KV-cache decoder
         # (prefill + lax.scan step loop; Pallas MMHA on TPU) — the

@@ -53,7 +53,8 @@ from .artifact import (ArtifactStore, AotManifestMismatchError,
                        fresh_backend_compile)
 from .buckets import DEFAULT_CHUNK_BUCKETS, ShapeBucketRegistry
 
-__all__ = ["export_engine", "load_engine_artifacts", "engine_config"]
+__all__ = ["export_engine", "load_engine_artifacts", "engine_config",
+           "warm_engine_factory"]
 
 _DECODE = "decode"
 _FILL = "chunk_fill_{c}"
@@ -207,6 +208,31 @@ def export_engine(engine, directory: str, *,
     if rotate:
         store.publish(keep_last=keep_last)
     return store
+
+
+def warm_engine_factory(cfg, params, *, aot_dir: str,
+                        require_warm: bool = True, **engine_kwargs):
+    """Zero-arg engine factory for the resilience supervisor
+    (``serving.SupervisedEngine``): every call constructs a
+    ``ContinuousBatchingEngine`` warm-started from ``aot_dir``, so a
+    crash-recovery rebuild deserializes every compiled program instead
+    of tracing — the ``serve_recovery_warm`` compile-budget row pins
+    that rebuild at ZERO backend compiles.
+
+    With ``require_warm`` (the default for a factory whose whole point
+    is compile-free rebuilds), a fallback to fresh compiles raises
+    instead of silently re-tracing under traffic."""
+    def factory():
+        from ..inference.serving import ContinuousBatchingEngine
+        eng = ContinuousBatchingEngine(cfg, params, aot_dir=aot_dir,
+                                       **engine_kwargs)
+        if require_warm and not eng.aot_loaded:
+            raise RuntimeError(
+                f"warm engine factory fell back to fresh compiles: "
+                f"{eng.aot_error}")
+        return eng
+
+    return factory
 
 
 def load_engine_artifacts(engine, directory: str, *, registry=None):

@@ -93,6 +93,10 @@ class GenRequest:
     top_k: Optional[int] = None
     top_p: Optional[float] = None
     seed: int = 0
+    # scheduling class (ISSUE 11): higher admits first; under
+    # saturation strictly-lower-priority RUNNING work is preempted
+    # (KV spilled to host RAM, resumed bit-identically later)
+    priority: int = 0
     out: List[int] = field(default_factory=list)
     # index of the first EOS in ``out`` (set by the scheduler the step the
     # token is appended — O(1) per step instead of rescanning the list)
@@ -167,6 +171,15 @@ class ContinuousBatchingEngine:
         bit-identical to ``spec_config=None``; sampled outputs follow
         the same target law via rejection sampling.  ``spec_stats()``
         exposes acceptance counters.
+      enable_preemption: priority classes with preemption (ISSUE 11).
+        Requests carry a ``priority`` (``add_request(priority=)``);
+        admission serves the highest class first, and under KV/batch
+        saturation the scheduler evicts strictly-lower-priority running
+        requests — committed KV pages spill to a CRC-checked host-RAM
+        tier (``serving/resilience.py``) and restore into fresh blocks
+        on re-admission, bit-identically.  With uniform priorities
+        (the default) nothing is ever preempted, so the knob is inert
+        for existing workloads.
 
     The engine keeps its own page table rather than reusing
     ops/paged_kv.PagedKVCache: that class sizes its table [B, num_blocks]
@@ -180,7 +193,8 @@ class ContinuousBatchingEngine:
                  max_blocks_per_seq: Optional[int] = None,
                  enable_prefix_caching: bool = True,
                  prefill_buckets=None, aot_dir: Optional[str] = None,
-                 fused_decode_block: bool = True, spec_config=None):
+                 fused_decode_block: bool = True, spec_config=None,
+                 enable_preemption: bool = True):
         if getattr(cfg, "moe_num_experts", 0) and \
                 getattr(cfg, "moe_router", "topk") != "topk":
             raise NotImplementedError("decode serves token-choice only")
@@ -221,6 +235,14 @@ class ContinuousBatchingEngine:
         self.queue: "collections.deque[GenRequest]" = collections.deque()
         self.finished: Dict[int, np.ndarray] = {}
         self._next_id = 0
+        # priority preemption (ISSUE 11): spilled-KV snapshots for
+        # preempted requests, keyed by req_id (serving/resilience.py
+        # owns the snapshot/restore machinery + CRC conventions)
+        self.enable_preemption = bool(enable_preemption)
+        self._spill: Dict[int, object] = {}
+        self.resilience = {"preemptions": 0, "restores": 0,
+                           "spill_save_secs": 0.0,
+                           "spill_restore_secs": 0.0}
         # LRU-bounded (a serving workload with many distinct prompt
         # lengths must not retain unboundedly many XLA executables)
         from ..utils.lru import LRUCache
@@ -440,7 +462,7 @@ class ContinuousBatchingEngine:
                     temperature: float = 0.0,
                     top_k: Optional[int] = None,
                     top_p: Optional[float] = None,
-                    seed: int = 0) -> int:
+                    seed: int = 0, priority: int = 0) -> int:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("prompt must contain at least one token "
@@ -462,7 +484,8 @@ class ContinuousBatchingEngine:
             raise ValueError("request exceeds max_position_embeddings")
         req = GenRequest(self._next_id, prompt, max_new_tokens,
                          eos_token_id, temperature=temperature,
-                         top_k=top_k, top_p=top_p, seed=seed)
+                         top_k=top_k, top_p=top_p, seed=seed,
+                         priority=int(priority))
         self._next_id += 1
         self.queue.append(req)
         return req.req_id
@@ -582,16 +605,214 @@ class ContinuousBatchingEngine:
             self.alloc.share([table[b]])
             self.stats["prefix_blocks_registered"] += 1
 
-    def _admit(self) -> None:
-        """Admit queued requests into free slots while pages allow.
-        On a prefix-cache hit the shared pages are reused and only the
-        SUFFIX runs (paged chunk fill); cold prompts prefill densely and
-        their KV moves into the pool pages."""
+    def _best_waiting_index(self) -> Optional[int]:
+        """Queue index of the next request to admit: highest priority
+        wins; FIFO within a priority class (queue position is arrival
+        order — a preempted request re-enters at the FRONT, so it
+        resumes before later arrivals of its own class)."""
+        best, best_key = None, None
+        for i, r in enumerate(self.queue):
+            key = (-r.priority, i)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
+    def _releasable_pages(self, slot: int) -> int:
+        """Pages preempting ``slot`` would actually free: pages whose
+        only live reference is the slot's (prefix-shared pages survive
+        in the index and free nothing)."""
+        return sum(1 for p in self.slot_pages[slot]
+                   if self.alloc.ref.get(p) == 1)
+
+    def _preempt_for_priority(self) -> None:
+        """Evict lowest-priority RUNNING work for a strictly-higher-
+        priority waiter when the batch/pool is saturated (ROADMAP
+        2(c)).  One victim per pass, bounded by the batch width; a
+        victim is only taken when eviction can actually make the
+        waiter admissible (a slot opens, and the victims' private
+        pages can close the page shortfall), so low-priority work is
+        never spilled pointlessly."""
+        for _ in range(self.B):
+            idx = self._best_waiting_index()
+            if idx is None:
+                return
+            cand = self.queue[idx]
+            snap = self._spill.get(cand.req_id)
+            need = snap.num_blocks if snap is not None else \
+                self._blocks_needed(len(cand.prompt) + cand.max_new_tokens)
+            evictable = sum(1 for p in self.prefix_index.values()
+                            if self.alloc.ref.get(p) == 1)
+            have_slot = any(s is None for s in self.slots)
+            if have_slot and self.alloc.free_blocks + evictable >= need:
+                return                 # admissible without eviction
+            victims = [s for s in range(self.B)
+                       if self.slots[s] is not None
+                       and self.slots[s].priority < cand.priority]
+            if not victims:
+                return
+            releasable = sum(self._releasable_pages(s) for s in victims)
+            if (self.alloc.free_blocks + evictable + releasable) < need:
+                return                 # eviction could never admit cand
+            # cheapest spill first: lowest priority, then fewest
+            # committed KV positions, then slot index (deterministic)
+            victims.sort(key=lambda s: (self.slots[s].priority,
+                                        int(self.lengths[s]), s))
+            self.preempt(victims[0])
+
+    def preempt(self, slot: int) -> int:
+        """Evict the RUNNING request in ``slot`` for later resumption:
+        snapshot its committed KV pages + decode cursor to the host-RAM
+        spill tier (CRC-checked — ``serving/resilience.py``), release
+        its pool references through the ordinary ``_free_slot`` path,
+        and requeue it at the FRONT of the waiting queue.  The resumed
+        stream is bit-identical to an unpreempted run: restore puts the
+        exact page bytes into fresh blocks and the sampler is keyed by
+        (seed, absolute position), so neither eviction nor re-admission
+        can change a token (pinned by tests/test_serving_resilience.py).
+        Returns the preempted request id."""
+        req = self.slots[slot]
+        if req is None:
+            raise ValueError(f"slot {slot} is not running a request")
+        import time
+        from ..serving.resilience import snapshot_slot
+        t0 = time.perf_counter()
+        snap = snapshot_slot(self, slot)
+        self._spill[req.req_id] = snap
+        self._free_slot(slot)
+        self.queue.appendleft(req)
+        dt = time.perf_counter() - t0
+        self.resilience["preemptions"] += 1
+        self.resilience["spill_save_secs"] += dt
+        from ..observability import REGISTRY
+        if REGISTRY.enabled:
+            REGISTRY.counter("serve.resilience.preemptions_total").inc()
+            REGISTRY.gauge("serve.resilience.spilled_bytes").set(
+                self.spilled_bytes)
+            REGISTRY.histogram("serve.resilience.preempt_save_secs",
+                               unit="s").record(dt)
+            REGISTRY.event("serve", action="preempt", req_id=req.req_id,
+                           priority=req.priority,
+                           committed=int(snap.length))
+        return req.req_id
+
+    def _restore_preempted(self, slot: int, req: GenRequest, idx: int,
+                           snap) -> bool:
+        """Re-admit a preempted request: fresh blocks, spilled KV bytes
+        scattered back, decode cursor restored — no recompute, no new
+        first token.  False when the pool cannot host it yet."""
+        import time
+        from ..serving.resilience import restore_into_slot
+        priv = self._acquire_with_eviction(snap.num_blocks)
+        if priv is None:
+            return False
+        del self.queue[idx]
+        self.block_table[slot, :] = -1
+        self.block_table[slot, :snap.num_blocks] = priv
+        self.slot_pages[slot] = priv
+        t0 = time.perf_counter()
+        try:
+            restore_into_slot(self, slot, snap)
+        except BaseException:
+            # exactly-once release; the snapshot is unusable, so the
+            # request is DROPPED from this engine (a supervising
+            # wrapper replays it from its committed prefix instead)
+            self.alloc.release(self.slot_pages[slot])
+            self.slot_pages[slot] = []
+            self.block_table[slot, :] = -1
+            del self._spill[req.req_id]
+            raise
+        del self._spill[req.req_id]
+        self.slots[slot] = req
+        self.lengths[slot] = snap.length
+        self.tokens[slot] = snap.next_token
+        dt = time.perf_counter() - t0
+        self.resilience["restores"] += 1
+        self.resilience["spill_restore_secs"] += dt
+        from ..observability import REGISTRY
+        if REGISTRY.enabled:
+            REGISTRY.counter("serve.resilience.restores_total").inc()
+            REGISTRY.gauge("serve.resilience.spilled_bytes").set(
+                self.spilled_bytes)
+            REGISTRY.histogram("serve.resilience.preempt_restore_secs",
+                               unit="s").record(dt)
+            REGISTRY.event("serve", action="restore", req_id=req.req_id,
+                           priority=req.priority,
+                           committed=int(snap.length))
+        return True
+
+    def _prefill_into_slot(self, slot: int, req: GenRequest,
+                           L: int) -> np.ndarray:
+        """Run the prompt into the slot's (already mapped) pages and
+        return next-token logits — the three prefill tiers the admission
+        path chooses between.  Extracted so the fault-injection harness
+        (tests/faults.py) has one seam for crash-mid-prefill."""
         from ..models.generation import build_llama_decoder
+        T0 = len(req.prompt)
+        table = self.slot_pages[slot]
+        if self._buckets is not None:
+            # declared-bucket prefill (cold prompts AND cache-hit
+            # suffixes): fixed chunk programs, no per-length jit
+            return self._fill_prompt_bucketed(slot, req, L * self.BS)
+        if L:
+            # suffix-only prefill against the cached pages
+            suffix = req.prompt[L * self.BS:]
+            fill = self._chunk_fill(len(suffix))
+            self.pool_k, self.pool_v, logits = fill(
+                self.params, self.pool_k, self.pool_v,
+                jnp.asarray(self.block_table[slot]),
+                jnp.int32(L * self.BS), jnp.asarray(suffix))
+            return logits
+        # dense prefill, jitted once per distinct prompt length
+        jprefill = self._prefill_cache.get(T0)
+        if jprefill is None:
+            prefill, _ = build_llama_decoder(self.cfg, T0,
+                                             use_pallas=False)
+            jprefill = jax.jit(prefill)
+            self._prefill_cache.put(T0, jprefill)
+        cache, logits = jprefill(self.params, req.prompt[None, :])
+        # move prompt KV into the pool pages ON DEVICE with ONE
+        # scatter per pool; the padded tail of the last page
+        # holds zeros, masked by lengths
+        nb = self._blocks_needed(T0)
+        pad = nb * self.BS - T0
+        kc, vc = cache["k"][:, 0], cache["v"][:, 0]
+        pages = np.asarray(table[:nb])
+
+        def paged_view(x):             # [L, nb, BS, Hkv, D]
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            return x.reshape(x.shape[0], nb, self.BS,
+                             *x.shape[2:])
+
+        self.pool_k = self.pool_k.at[:, pages].set(
+            paged_view(kc).astype(self.pool_k.dtype))
+        self.pool_v = self.pool_v.at[:, pages].set(
+            paged_view(vc).astype(self.pool_v.dtype))
+        return logits
+
+    def _admit(self) -> None:
+        """Admit waiting requests into free slots while pages allow,
+        highest priority first (FIFO within a class).  On a
+        prefix-cache hit the shared pages are reused and only the
+        SUFFIX runs (paged chunk fill); cold prompts prefill densely
+        and their KV moves into the pool pages; a PREEMPTED request
+        restores its spilled KV into fresh blocks instead of
+        recomputing.  Under saturation, strictly-lower-priority running
+        requests are evicted for higher-priority waiters
+        (``_preempt_for_priority``)."""
+        if self.enable_preemption:
+            self._preempt_for_priority()
         for slot in range(self.B):
-            if not self.queue or self.slots[slot] is not None:
+            if self.slots[slot] is not None:
                 continue
-            req = self.queue[0]
+            idx = self._best_waiting_index()
+            if idx is None:
+                break
+            req = self.queue[idx]
+            snap = self._spill.get(req.req_id)
+            if snap is not None:
+                if not self._restore_preempted(slot, req, idx, snap):
+                    break              # head-of-line waits for pages
+                continue
             T0 = len(req.prompt)
             total = T0 + req.max_new_tokens
             need = self._blocks_needed(total)
@@ -604,54 +825,27 @@ class ContinuousBatchingEngine:
                 self.alloc.release(shared)
                 break                      # head-of-line waits for pages
             self.stats["prefix_blocks_reused"] += L
-            self.queue.popleft()
+            del self.queue[idx]
             table = shared + priv
             self.block_table[slot, :] = -1
             self.block_table[slot, :need] = table
             self.slot_pages[slot] = table
-
-            if self._buckets is not None:
-                # declared-bucket prefill (cold prompts AND cache-hit
-                # suffixes): fixed chunk programs, no per-length jit
-                logits = self._fill_prompt_bucketed(slot, req,
-                                                    L * self.BS)
-            elif L:
-                # suffix-only prefill against the cached pages
-                suffix = req.prompt[L * self.BS:]
-                fill = self._chunk_fill(len(suffix))
-                self.pool_k, self.pool_v, logits = fill(
-                    self.params, self.pool_k, self.pool_v,
-                    jnp.asarray(self.block_table[slot]),
-                    jnp.int32(L * self.BS), jnp.asarray(suffix))
-            else:
-                # dense prefill, jitted once per distinct prompt length
-                jprefill = self._prefill_cache.get(T0)
-                if jprefill is None:
-                    prefill, _ = build_llama_decoder(self.cfg, T0,
-                                                     use_pallas=False)
-                    jprefill = jax.jit(prefill)
-                    self._prefill_cache.put(T0, jprefill)
-                cache, logits = jprefill(self.params, req.prompt[None, :])
-                # move prompt KV into the pool pages ON DEVICE with ONE
-                # scatter per pool; the padded tail of the last page
-                # holds zeros, masked by lengths
-                nb = self._blocks_needed(T0)
-                pad = nb * self.BS - T0
-                kc, vc = cache["k"][:, 0], cache["v"][:, 0]
-                pages = np.asarray(table[:nb])
-
-                def paged_view(x):             # [L, nb, BS, Hkv, D]
-                    x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
-                    return x.reshape(x.shape[0], nb, self.BS,
-                                     *x.shape[2:])
-
-                self.pool_k = self.pool_k.at[:, pages].set(
-                    paged_view(kc).astype(self.pool_k.dtype))
-                self.pool_v = self.pool_v.at[:, pages].set(
-                    paged_view(vc).astype(self.pool_v.dtype))
-            self._register_prefix(req.prompt, table)
-            first = self._pick_token(req, np.asarray(logits)[0],
-                                     position=T0)
+            try:
+                logits = self._prefill_into_slot(slot, req, L)
+                self._register_prefix(req.prompt, table)
+                first = self._pick_token(req, np.asarray(logits)[0],
+                                         position=T0)
+            except BaseException:
+                # exactly-once page release (ISSUE 11 hardening): the
+                # slot never went live, so neither cancel() nor a later
+                # drain can see these references — drop them here, and
+                # keep the request WAITING so a retrying caller (or a
+                # supervisor replay) still owns it
+                self.alloc.release(self.slot_pages[slot])
+                self.slot_pages[slot] = []
+                self.block_table[slot, :] = -1
+                self.queue.appendleft(req)
+                raise
             self._append_tok(req, first)
             self.slots[slot] = req
             self.lengths[slot] = T0
@@ -705,6 +899,9 @@ class ContinuousBatchingEngine:
         for i, req in enumerate(self.queue):
             if req.req_id == req_id:
                 del self.queue[i]
+                # a preempted waiter holds no pool references, but its
+                # spilled host-RAM snapshot must not outlive it
+                self._spill.pop(req_id, None)
                 return True
         for slot in range(self.B):
             req = self.slots[slot]
@@ -775,9 +972,14 @@ class ContinuousBatchingEngine:
         return out
 
     def run_to_completion(self) -> Dict[int, np.ndarray]:
-        """Drive steps until queue and batch drain; returns all results."""
+        """Drive steps until queue and batch drain; returns all results.
+        ``self.finished`` is part of the liveness condition: a step that
+        raised AFTER retiring a request (e.g. a typed spill-restore
+        failure during admission) strands that result in ``finished``,
+        and a later drain must still deliver it."""
         results: Dict[int, np.ndarray] = {}
-        while self.queue or any(s is not None for s in self.slots):
+        while self.queue or self.finished \
+                or any(s is not None for s in self.slots):
             results.update(self.step())
         return results
 
@@ -828,6 +1030,20 @@ class ContinuousBatchingEngine:
             "unaccounted": (self.alloc.num_blocks - self.alloc.free_blocks
                             - len(self.alloc.ref)),
         }
+
+    @property
+    def spilled_bytes(self) -> int:
+        """Host-RAM bytes currently held by preempted-request KV
+        snapshots (the spill tier)."""
+        return sum(s.nbytes for s in self._spill.values())
+
+    def resilience_stats(self) -> Dict[str, object]:
+        """Preemption-side resilience counters for bench rows / serve
+        telemetry (the supervisor adds the crash-recovery side)."""
+        s: Dict[str, object] = dict(self.resilience)
+        s["spilled_requests"] = len(self._spill)
+        s["spilled_bytes"] = self.spilled_bytes
+        return s
 
     def spec_stats(self) -> Optional[Dict[str, object]]:
         """Speculation counters for bench rows / serve telemetry, or

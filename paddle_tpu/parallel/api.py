@@ -136,10 +136,19 @@ import functools
 @functools.lru_cache(maxsize=128)
 def _resolve_partial(reduce_type: str, dst_sharding):
     """Compiled-once Partial resolver: fold the hidden leading contribution
-    dim with the placement's reduce op, constrained to the destination
+    dim with the placement's reduce op, pinned to the destination
     sharding (XLA lowers this to the all-reduce / reduce-scatter the
     reference's p_to_r / p_to_s emit).  lru-cached so a per-step reshard
-    doesn't re-trace."""
+    doesn't re-trace.
+
+    The destination MUST be pinned via ``out_shardings``, not a
+    ``with_sharding_constraint`` on the returned value: jit without
+    ``out_shardings`` compiles with
+    ``allow_spmd_sharding_propagation_to_output=true``, and under that
+    flag XLA's partitioner may override (or gather+slice-elide) a
+    root-position constraint — the dst placement silently doesn't
+    happen (root cause of the ISSUE 11 reshard-matrix triage; jax
+    0.4.37)."""
     import jax.numpy as jnp
     reducers = {"sum": jnp.sum, "avg": jnp.mean, "mean": jnp.mean,
                 "max": jnp.max, "min": jnp.min}
@@ -148,10 +157,9 @@ def _resolve_partial(reduce_type: str, dst_sharding):
     except KeyError:
         raise ValueError(f"unsupported Partial reduce_type {reduce_type!r}")
 
-    @jax.jit
+    @functools.partial(jax.jit, out_shardings=dst_sharding)
     def resolve(v):
-        return jax.lax.with_sharding_constraint(red(v, axis=0),
-                                                dst_sharding)
+        return red(v, axis=0)
 
     return resolve
 

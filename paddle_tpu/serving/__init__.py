@@ -15,6 +15,11 @@ Layering::
             │
     aot.export_engine / aot_dir       zero-compile warm start
 
+Resilience (ISSUE 11): ``serving/resilience.py`` adds priority
+preemption with CRC-checked host-RAM KV spill/restore and the
+:class:`SupervisedEngine` crash wrapper (retry/backoff, AOT-warm
+rebuild + deterministic replay, circuit breaker).
+
 See ``docs/serving.md`` for the state machine, the streaming API, the
 admission knobs, and the metric catalogue.
 """
@@ -23,9 +28,15 @@ from .frontend import (AdmissionConfig, RequestAborted, RequestHandle,
                        RequestRejected, RequestState, ServingFrontend)
 from .loadgen import LoadGenConfig, LoadReport, PoissonLoadGenerator
 from .metrics import ServeMetrics
+from .resilience import (EngineCrashError, KVSnapshot,
+                         RecoveryExhaustedError, ResilienceError,
+                         RetryPolicy, SpillCorruptError, SupervisedEngine,
+                         TransientStepError)
 
 __all__ = [
-    "AdmissionConfig", "LoadGenConfig", "LoadReport",
-    "PoissonLoadGenerator", "RequestAborted", "RequestHandle",
-    "RequestRejected", "RequestState", "ServeMetrics", "ServingFrontend",
+    "AdmissionConfig", "EngineCrashError", "KVSnapshot", "LoadGenConfig",
+    "LoadReport", "PoissonLoadGenerator", "RecoveryExhaustedError",
+    "RequestAborted", "RequestHandle", "RequestRejected", "RequestState",
+    "ResilienceError", "RetryPolicy", "ServeMetrics", "ServingFrontend",
+    "SpillCorruptError", "SupervisedEngine", "TransientStepError",
 ]

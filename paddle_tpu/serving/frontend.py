@@ -376,6 +376,7 @@ class ServingFrontend:
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0, top_k: Optional[int] = None,
                top_p: Optional[float] = None, seed: int = 0,
+               priority: int = 0,
                deadline_s: Optional[float] = None,
                max_queue_time_s: Optional[float] = None,
                stream_capacity=_UNSET,
@@ -397,7 +398,7 @@ class ServingFrontend:
                     rid = self.engine.add_request(
                         prompt, max_new_tokens, eos_token_id,
                         temperature=temperature, top_k=top_k,
-                        top_p=top_p, seed=seed)
+                        top_p=top_p, seed=seed, priority=priority)
                 except ValueError as e:
                     if len(prompt) < 1 or max_new_tokens < 1:
                         raise                      # malformed, not load

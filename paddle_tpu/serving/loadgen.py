@@ -70,6 +70,12 @@ class LoadGenConfig:
     max_queue_time_s: Optional[float] = None
     cancel_fraction: float = 0.0       # fraction cancelled mid-stream
     cancel_after_tokens: int = 2
+    # mixed-priority traffic (ISSUE 11): each request draws its
+    # priority class from ``priorities`` (seeded; ``priority_weights``
+    # biases the draw).  With the default single class the engine's
+    # preemption machinery is inert and reports carry no breakdown.
+    priorities: Tuple[int, ...] = (0,)
+    priority_weights: Optional[Tuple[float, ...]] = None
 
 
 @dataclass
@@ -80,6 +86,7 @@ class _Planned:
     sampled: bool
     seed: int
     cancel: bool
+    priority: int = 0
 
 
 @dataclass
@@ -105,6 +112,10 @@ class LoadReport:
     slo: Dict[str, float]
     kv_leaks: Dict[str, int]
     per_request: List[Dict[str, Any]] = field(default_factory=list)
+    # per-priority-class breakdown (ISSUE 11), only for mixed-priority
+    # runs: the chaos invariant is that the HIGH class keeps its
+    # goodput while the low class is shed/preempted
+    by_priority: Optional[Dict[int, Dict[str, Any]]] = None
 
     def to_dict(self, include_requests: bool = False) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -121,6 +132,8 @@ class LoadReport:
             "kv_leaked_blocks": (self.kv_leaks["leaked"]
                                  + self.kv_leaks["unaccounted"]),
         }
+        if self.by_priority is not None:
+            d["by_priority"] = self.by_priority
         if include_requests:
             d["per_request"] = self.per_request
         return d
@@ -148,6 +161,9 @@ class PoissonLoadGenerator:
         self.config = config or LoadGenConfig()
         self._clock = clock
         self._sleep = sleep
+        # handles of the most recent run() — chaos tests assert stream
+        # invariants (no drop/dup/reorder) directly on them
+        self.last_handles: List[Optional[RequestHandle]] = []
 
     def plan(self) -> List[_Planned]:
         """The run's deterministic request schedule (pure function of
@@ -159,6 +175,11 @@ class PoissonLoadGenerator:
         vocab = int(self.frontend.engine.cfg.vocab_size)
         plo, phi = _span(cfg.prompt_len)
         nlo, nhi = _span(cfg.max_new_tokens)
+        prios = list(cfg.priorities)
+        weights = None
+        if cfg.priority_weights is not None:
+            w = np.asarray(cfg.priority_weights, np.float64)
+            weights = w / w.sum()
         out: List[_Planned] = []
         for i in range(cfg.n_requests):
             t0 = int(rng.integers(plo, phi + 1))
@@ -168,7 +189,8 @@ class PoissonLoadGenerator:
                 max_new=int(rng.integers(nlo, nhi + 1)),
                 sampled=bool(rng.random() < cfg.sampled_fraction),
                 seed=int(rng.integers(0, 2 ** 31 - 1)),
-                cancel=bool(rng.random() < cfg.cancel_fraction)))
+                cancel=bool(rng.random() < cfg.cancel_fraction),
+                priority=int(rng.choice(prios, p=weights))))
         return out
 
     def _submit(self, p: _Planned) -> RequestHandle:
@@ -177,7 +199,7 @@ class PoissonLoadGenerator:
             p.prompt, p.max_new, eos_token_id=cfg.eos_token_id,
             temperature=cfg.temperature if p.sampled else 0.0,
             top_k=cfg.top_k if p.sampled else None, seed=p.seed,
-            deadline_s=cfg.deadline_s,
+            priority=p.priority, deadline_s=cfg.deadline_s,
             max_queue_time_s=cfg.max_queue_time_s)
 
     def run(self) -> LoadReport:
@@ -209,10 +231,12 @@ class PoissonLoadGenerator:
             else:
                 break
         duration = max(self._clock() - t0, 1e-9)
-        return self._report(handles, duration)
+        self.last_handles = handles
+        return self._report(handles, duration, plan)
 
     def _report(self, handles: List[Optional[RequestHandle]],
-                duration: float) -> LoadReport:
+                duration: float,
+                plan: Optional[List[_Planned]] = None) -> LoadReport:
         cfg = self.config
         ttfts: List[float] = []
         tpots: List[float] = []
@@ -221,15 +245,30 @@ class PoissonLoadGenerator:
         good = 0
         good_tokens = 0
         per_req: List[Dict[str, Any]] = []
+        prio_of = {} if plan is None else {
+            id(h): p.priority for h, p in zip(handles, plan)
+            if h is not None}
+        by_prio: Dict[int, Dict[str, Any]] = {}
         for h in handles:
             if h is None:
                 continue
             counts[h.state] += 1
             k = h.n_streamed
             total_tokens += k
+            prio = prio_of.get(id(h), 0)
+            pc = by_prio.setdefault(prio, {
+                "n": 0, "finished": 0, "rejected": 0, "cancelled": 0,
+                "timed_out": 0, "good": 0, "good_tokens": 0})
+            pc["n"] += 1
+            for st, key in ((RequestState.FINISHED, "finished"),
+                            (RequestState.REJECTED, "rejected"),
+                            (RequestState.CANCELLED, "cancelled"),
+                            (RequestState.TIMED_OUT, "timed_out")):
+                if h.state is st:
+                    pc[key] += 1
             rec: Dict[str, Any] = {"req_id": h.req_id,
                                    "state": h.state.value,
-                                   "n_tokens": k}
+                                   "n_tokens": k, "priority": prio}
             if h.ttft_s is not None:
                 rec["ttft_s"] = round(h.ttft_s, 6)
             if h.state is RequestState.FINISHED:
@@ -242,7 +281,22 @@ class PoissonLoadGenerator:
                 if h.ttft_s <= cfg.slo_ttft_s and tpot <= cfg.slo_tpot_s:
                     good += 1
                     good_tokens += k
+                    pc["good"] += 1
+                    pc["good_tokens"] += k
             per_req.append(rec)
+        by_priority = None
+        if len(by_prio) > 1:
+            by_priority = {}
+            for prio, pc in sorted(by_prio.items()):
+                by_priority[prio] = {
+                    "n": pc["n"], "finished": pc["finished"],
+                    "rejected": pc["rejected"],
+                    "cancelled": pc["cancelled"],
+                    "timed_out": pc["timed_out"],
+                    "goodput_rps": round(pc["good"] / duration, 3),
+                    "goodput_tokens_per_s": round(
+                        pc["good_tokens"] / duration, 2),
+                }
         return LoadReport(
             n_requests=cfg.n_requests,
             finished=counts[RequestState.FINISHED],
@@ -257,4 +311,4 @@ class PoissonLoadGenerator:
             goodput_tokens_per_s=good_tokens / duration,
             slo={"ttft_s": cfg.slo_ttft_s, "tpot_s": cfg.slo_tpot_s},
             kv_leaks=self.frontend.engine.kv_leak_report(),
-            per_request=per_req)
+            per_request=per_req, by_priority=by_priority)

@@ -48,6 +48,27 @@ serve.spec.steps_per_token        gauge      per-slot decode steps/token
                                              the speculation win)
 ================================  =========  ============================
 
+Resilience rows (``serve.resilience.*``; counters/histograms recorded
+by ``inference/serving.py`` preemption hooks and
+``serving/resilience.py``'s :class:`SupervisedEngine`; gauges refreshed
+here per scheduler iteration; docs/serving.md):
+
+========================================  =========  ==================
+serve.resilience.preemptions_total        counter    running requests evicted (KV spilled)
+serve.resilience.restores_total           counter    preempted requests resumed
+serve.resilience.spilled_bytes            gauge      host-RAM KV spill tier size
+serve.resilience.spilled_requests         gauge      requests currently spilled
+serve.resilience.preempt_save_secs        histogram  snapshot+spill latency
+serve.resilience.preempt_restore_secs     histogram  restore-into-fresh-blocks latency
+serve.resilience.transient_retries_total  counter    retried transient step faults
+serve.resilience.slow_steps_total         counter    steps past the slow-step budget
+serve.resilience.crashes_total            counter    declared engine crashes
+serve.resilience.recoveries_total         counter    successful rebuild+replay cycles
+serve.resilience.replayed_requests_total  counter    requests replayed across crashes
+serve.resilience.recovery_secs            histogram  teardown->replayed latency
+serve.resilience.circuit_open_total       counter    recoveries refused (breaker open)
+========================================  =========  ==================
+
 Every recording entry point checks ``registry.enabled`` first, so a
 front-end without telemetry pays one branch per call (the PR 5
 zero-cost-disabled contract).  All of this is host-side scheduler code,
@@ -165,3 +186,10 @@ class ServeMetrics:
             if spec["engine_steps_per_token"] is not None:
                 self._reg.gauge("serve.spec.steps_per_token").set(
                     spec["engine_steps_per_token"])
+        res = engine.resilience_stats() \
+            if hasattr(engine, "resilience_stats") else None
+        if res is not None:
+            self._reg.gauge("serve.resilience.spilled_bytes").set(
+                res["spilled_bytes"])
+            self._reg.gauge("serve.resilience.spilled_requests").set(
+                res["spilled_requests"])

@@ -1,0 +1,544 @@
+"""Serving resilience (ISSUE 11): KV spill/restore for priority
+preemption, and a supervising engine wrapper that survives step faults.
+
+The serve stack before this module had exactly one failure mode: an
+engine exception aborted every live stream (the front-end's typed
+abort-all path).  This module adds the two layers between "fine" and
+"abort everything":
+
+* **KV snapshot / restore** — the page-level save→verify→publish
+  discipline of ``checkpoint/`` applied to the serving KV pool, but
+  into a host-RAM spill tier instead of disk.  ``snapshot_slot`` reads
+  a running slot's committed KV pages off the device and CRC32-stamps
+  them (the ``framework/io.py`` manifest convention); ``restore_into_
+  slot`` verifies the checksums and scatters the exact bytes into
+  fresh blocks.  Because the engine's decode reads KV only through the
+  block table and the sampler is keyed by (seed, absolute position), a
+  preempt/restore cycle is **bit-identical** to an unpreempted run —
+  pinned by tests/test_serving_resilience.py.
+
+* :class:`SupervisedEngine` — a drop-in engine wrapper (the
+  ``ServingFrontend`` drives it unchanged) with three escalation
+  levels:
+
+  1. **transient faults** (:class:`TransientStepError`) retry the step
+     with bounded exponential backoff;
+  2. a **declared crash** (any other ``Exception``, retries exhausted,
+     or a run of slow steps past ``RetryPolicy.slow_step_s``) tears
+     the engine down, rebuilds it through the caller's factory — AOT-
+     warm factories (``aot.serve.warm_engine_factory``) rebuild with
+     ZERO backend compiles, ratcheted by the ``serve_recovery_warm``
+     budget row — and **replays every live request from its committed
+     token prefix**: the replayed request's prompt is
+     ``original prompt + tokens already streamed``, so the resumed
+     stream continues gap-free and (greedy / seeded-sampled)
+     bit-identically, invisible to the consumer;
+  3. a **circuit breaker** (``max_restarts`` within
+     ``restart_window_s``) raises :class:`RecoveryExhaustedError`,
+     which lands in the front-end's existing crash path: flight-ring
+     dump + typed abort of every live stream.
+
+  Every recovery dumps the flight-recorder ring (the serve event ring
+  is the post-mortem timeline) and records the ``serve.resilience.*``
+  metric family.
+
+``BaseException`` faults (``KeyboardInterrupt``, the checkpoint
+harness's ``SimulatedCrash``) are never swallowed — supervision is for
+engine faults, not for the process being killed.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..observability import REGISTRY
+
+__all__ = [
+    "EngineCrashError", "KVSnapshot", "RecoveryExhaustedError",
+    "ResilienceError", "RetryPolicy", "SpillCorruptError",
+    "SupervisedEngine", "TransientStepError", "restore_into_slot",
+    "snapshot_slot",
+]
+
+
+class ResilienceError(RuntimeError):
+    """Base for typed resilience failures."""
+
+
+class SpillCorruptError(ResilienceError):
+    """A spilled KV snapshot failed its CRC check at restore time.  The
+    snapshot (and, on a bare engine, the request) is dropped — a
+    supervising wrapper replays the request from its committed token
+    prefix instead, so nothing is lost above the supervisor."""
+
+
+class TransientStepError(RuntimeError):
+    """A step fault the supervisor should RETRY (bounded backoff)
+    rather than treat as an engine crash — the fault-injection marker
+    for retryable conditions (tests/faults.py raises it)."""
+
+
+class EngineCrashError(RuntimeError):
+    """A declared engine crash: the supervisor tears down, rebuilds,
+    and replays.  Any non-transient ``Exception`` escaping
+    ``engine.step()`` is treated the same way; this type exists so
+    policies (slow-step escalation) and injectors can declare one
+    explicitly."""
+
+
+class RecoveryExhaustedError(ResilienceError):
+    """The restart circuit breaker opened: more than
+    ``RetryPolicy.max_restarts`` rebuilds inside
+    ``restart_window_s``.  Escalates to the front-end's typed
+    abort-all path (every live stream gets a terminal state)."""
+
+
+# ---------------------------------------------------------------------
+# KV spill tier: page snapshots with the checkpoint CRC convention
+# ---------------------------------------------------------------------
+@dataclass
+class KVSnapshot:
+    """One preempted request's committed serving state, held in host
+    RAM: the exact bytes of its committed KV pages plus the decode
+    cursor (committed length + pending fed token).  The sampler needs
+    no extra state — it is keyed by (seed, absolute position), both of
+    which the request/cursor already carry."""
+
+    req_id: int
+    length: int                # committed KV positions
+    next_token: int            # pending fed token (decode cursor)
+    num_blocks: int            # full table width to re-acquire
+    k_pages: np.ndarray        # [L, used_pages, BS, Hkv, D]
+    v_pages: np.ndarray
+    crc_k: int = 0
+    crc_v: int = 0
+
+    def __post_init__(self):
+        if not self.crc_k and not self.crc_v:
+            self.crc_k = zlib.crc32(self.k_pages.tobytes())
+            self.crc_v = zlib.crc32(self.v_pages.tobytes())
+
+    @property
+    def nbytes(self) -> int:
+        return self.k_pages.nbytes + self.v_pages.nbytes
+
+    def verify(self) -> None:
+        """Raise :class:`SpillCorruptError` unless the page bytes still
+        match their spill-time checksums (framework/io.py convention:
+        every array member carries a CRC32, verified on read)."""
+        if zlib.crc32(self.k_pages.tobytes()) != self.crc_k or \
+                zlib.crc32(self.v_pages.tobytes()) != self.crc_v:
+            raise SpillCorruptError(
+                f"spilled KV snapshot for request {self.req_id} failed "
+                "its CRC check — host-RAM bit-rot or a write raced the "
+                "spill; the request must be replayed from its committed "
+                "token prefix")
+
+
+def snapshot_slot(engine, slot: int) -> KVSnapshot:
+    """Read the committed KV pages of a RUNNING slot off the device and
+    CRC-stamp them.  Only pages holding committed positions
+    (``ceil(length / block_size)``) are copied — pages reserved for the
+    not-yet-generated tail carry no state worth saving (any stale bytes
+    there are masked by ``lengths`` exactly as on a fresh slot)."""
+    import jax
+    import jax.numpy as jnp
+    req = engine.slots[slot]
+    length = int(engine.lengths[slot])
+    used = -(-length // engine.BS)
+    pages = engine.slot_pages[slot]
+    idx = jnp.asarray(np.asarray(pages[:used], np.int32))
+    k = np.asarray(jax.device_get(engine.pool_k[:, idx]))
+    v = np.asarray(jax.device_get(engine.pool_v[:, idx]))
+    return KVSnapshot(req_id=req.req_id, length=length,
+                      next_token=int(engine.tokens[slot]),
+                      num_blocks=len(pages), k_pages=k, v_pages=v)
+
+
+def restore_into_slot(engine, slot: int, snap: KVSnapshot) -> None:
+    """Verify and scatter a snapshot's page bytes into the slot's
+    freshly acquired blocks (``engine.slot_pages[slot]``).  The
+    device→host→device round trip preserves bytes exactly, so decode
+    resumed from the restored pages is bit-identical to one that was
+    never preempted."""
+    import jax.numpy as jnp
+    snap.verify()
+    used = snap.k_pages.shape[1]
+    pages = jnp.asarray(
+        np.asarray(engine.slot_pages[slot][:used], np.int32))
+    engine.pool_k = engine.pool_k.at[:, pages].set(
+        jnp.asarray(snap.k_pages))
+    engine.pool_v = engine.pool_v.at[:, pages].set(
+        jnp.asarray(snap.v_pages))
+
+
+# ---------------------------------------------------------------------
+# supervised engine: retry / rebuild / replay
+# ---------------------------------------------------------------------
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Escalation knobs for :class:`SupervisedEngine`.
+
+    max_retries:
+        Transient-fault retries per step before escalating to a
+        declared crash.
+    backoff_base_s / backoff_factor / backoff_max_s:
+        Bounded exponential backoff between transient retries
+        (``base * factor**(attempt-1)``, capped).
+    slow_step_s:
+        A step slower than this counts as a slow step (None disables
+        the detector — wall-clock on a shared CI host is noisy).
+    slow_steps_to_crash:
+        Consecutive slow steps that escalate to a declared crash (a
+        hung-but-not-dead engine must not stall streams forever).
+    max_restarts / restart_window_s:
+        Circuit breaker: more than ``max_restarts`` rebuilds within the
+        window raises :class:`RecoveryExhaustedError` instead of
+        rebuilding again.
+    """
+
+    max_retries: int = 3
+    backoff_base_s: float = 0.02
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 1.0
+    slow_step_s: Optional[float] = None
+    slow_steps_to_crash: int = 3
+    max_restarts: int = 3
+    restart_window_s: float = 60.0
+
+
+@dataclass
+class _Tracked:
+    """Supervisor bookkeeping for one live request.  ``req`` is the
+    OUTER GenRequest — the object the caller (and the front-end's
+    stream delivery) holds.  Before any crash the inner engine runs
+    that very object; after a rebuild ``inner`` is the replayed
+    request inside the fresh engine and newly committed tokens are
+    bridged into ``req`` so consumers never notice the splice."""
+
+    req: object
+    kwargs: Dict[str, object]
+    max_new: int
+    priority: int
+    inner: object = None
+    inner_rid: int = -1
+    base: int = 0               # outer tokens committed before replay
+
+
+class SupervisedEngine:
+    """Crash-supervised wrapper around a ``ContinuousBatchingEngine``.
+
+    Args:
+      factory: zero-arg callable building a fresh engine.  Use an AOT-
+        warm factory (``aot.serve.warm_engine_factory``) so rebuilds
+        deserialize every compiled program instead of tracing — the
+        ``serve_recovery_warm`` compile-budget row pins recovery at
+        ZERO backend compiles.
+      policy: :class:`RetryPolicy` escalation knobs.
+      registry: metrics registry (defaults to the process registry).
+      clock / sleep: injectable time sources (tests drive backoff and
+        the circuit-breaker window without real waiting).
+
+    The wrapper duck-types the engine surface the serving front-end
+    uses (``add_request`` / ``cancel`` / ``step`` / ``queue`` /
+    introspection helpers), so ``ServingFrontend(SupervisedEngine(...))``
+    serves streams that survive engine crashes.
+    """
+
+    def __init__(self, factory: Callable[[], object], *,
+                 policy: Optional[RetryPolicy] = None, registry=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._factory = factory
+        self.policy = policy or RetryPolicy()
+        self._reg = REGISTRY if registry is None else registry
+        self._clock = clock
+        self._sleep = sleep
+        self.engine = factory()
+        self._tracked: "collections.OrderedDict[int, _Tracked]" = \
+            collections.OrderedDict()
+        self._pending_finished: Dict[int, np.ndarray] = {}
+        self._restart_times: "collections.deque[float]" = \
+            collections.deque()
+        self._consecutive_slow = 0
+        self.last_error: Optional[BaseException] = None
+        self.stats: Dict[str, int] = {
+            "transient_retries": 0, "slow_steps": 0, "crashes": 0,
+            "recoveries": 0, "replayed_requests": 0, "circuit_opens": 0,
+        }
+
+    # -- engine surface -------------------------------------------------
+    def add_request(self, prompt_ids, max_new_tokens: int,
+                    eos_token_id: Optional[int] = None, *,
+                    temperature: float = 0.0,
+                    top_k: Optional[int] = None,
+                    top_p: Optional[float] = None,
+                    seed: int = 0, priority: int = 0) -> int:
+        rid = self.engine.add_request(
+            prompt_ids, max_new_tokens, eos_token_id,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            seed=seed, priority=priority)
+        req = next(r for r in reversed(self.engine.queue)
+                   if r.req_id == rid)
+        self._tracked[rid] = _Tracked(
+            req=req,
+            kwargs={"eos_token_id": eos_token_id,
+                    "temperature": temperature, "top_k": top_k,
+                    "top_p": top_p, "seed": seed},
+            max_new=int(max_new_tokens), priority=int(priority),
+            inner=req, inner_rid=rid)
+        return rid
+
+    def cancel(self, req_id: int) -> bool:
+        t = self._tracked.pop(req_id, None)
+        if t is None:
+            # unknown or already finished — keep engine semantics
+            return self.engine.cancel(req_id)
+        self._pending_finished.pop(req_id, None)
+        self.engine.cancel(t.inner_rid)
+        return True
+
+    def step(self) -> Dict[int, np.ndarray]:
+        """One supervised scheduler iteration: retry transients with
+        backoff, recover declared crashes via rebuild + replay, then
+        hand back newly finished requests keyed by their ORIGINAL ids."""
+        p = self.policy
+        attempt = 0
+        while True:
+            t0 = self._clock()
+            try:
+                finished = self.engine.step()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except TransientStepError as e:
+                attempt += 1
+                self.stats["transient_retries"] += 1
+                if self._reg.enabled:
+                    self._reg.counter(
+                        "serve.resilience.transient_retries_total").inc()
+                self._event("retry", attempt=attempt,
+                            error=f"{type(e).__name__}: {e}"[:200])
+                if attempt > p.max_retries:
+                    self._recover(e)
+                    return self._absorb({})
+                self._sleep(min(
+                    p.backoff_base_s * p.backoff_factor ** (attempt - 1),
+                    p.backoff_max_s))
+                continue
+            except Exception as e:
+                self._recover(e)
+                return self._absorb({})
+            dt = self._clock() - t0
+            if p.slow_step_s is not None and dt > p.slow_step_s:
+                self._consecutive_slow += 1
+                self.stats["slow_steps"] += 1
+                if self._reg.enabled:
+                    self._reg.counter(
+                        "serve.resilience.slow_steps_total").inc()
+                self._event("slow_step", secs=round(dt, 4),
+                            consecutive=self._consecutive_slow)
+                if self._consecutive_slow >= p.slow_steps_to_crash:
+                    n = self._consecutive_slow
+                    self._consecutive_slow = 0
+                    self._recover(EngineCrashError(
+                        f"{n} consecutive steps slower than "
+                        f"{p.slow_step_s}s — declaring the engine hung"))
+                    return self._absorb({})
+            else:
+                self._consecutive_slow = 0
+            return self._absorb(finished)
+
+    def run_to_completion(self) -> Dict[int, np.ndarray]:
+        """Drive supervised steps until every tracked request resolves."""
+        results: Dict[int, np.ndarray] = {}
+        while self._tracked or self._pending_finished:
+            results.update(self.step())
+        return results
+
+    # -- introspection (front-end / loadgen / bench surface) ------------
+    @property
+    def queue(self):
+        return self.engine.queue
+
+    @property
+    def slots(self):
+        return self.engine.slots
+
+    @property
+    def alloc(self):
+        return self.engine.alloc
+
+    @property
+    def cfg(self):
+        return self.engine.cfg
+
+    @property
+    def queue_depth(self) -> int:
+        return self.engine.queue_depth
+
+    @property
+    def active_requests(self) -> int:
+        return self.engine.active_requests
+
+    @property
+    def live_requests(self) -> int:
+        return len(self._tracked)
+
+    def _blocks_needed(self, n_tokens: int) -> int:
+        return self.engine._blocks_needed(n_tokens)
+
+    def batch_occupancy(self) -> float:
+        return self.engine.batch_occupancy()
+
+    def kv_utilization(self) -> float:
+        return self.engine.kv_utilization()
+
+    def kv_leak_report(self) -> Dict[str, int]:
+        return self.engine.kv_leak_report()
+
+    def spec_stats(self):
+        return self.engine.spec_stats()
+
+    def aot_stats(self):
+        return self.engine.aot_stats()
+
+    def resilience_stats(self) -> Dict[str, object]:
+        """Engine preemption counters merged with the supervisor's
+        crash-recovery counters — one dict for bench rows / gauges."""
+        s: Dict[str, object] = dict(self.engine.resilience_stats())
+        s.update(self.stats)
+        s["restarts_in_window"] = len(self._restart_times)
+        return s
+
+    def __getattr__(self, name):
+        # anything not supervised is plain engine surface
+        if name == "engine":     # not set yet: don't recurse
+            raise AttributeError(name)
+        return getattr(self.engine, name)
+
+    # -- internals ------------------------------------------------------
+    def _absorb(self, finished: Dict[int, np.ndarray]
+                ) -> Dict[int, np.ndarray]:
+        """Bridge replayed requests' fresh tokens into the outer
+        request objects and translate finished ids back to the
+        caller's originals."""
+        for t in self._tracked.values():
+            if t.inner is t.req:
+                continue
+            bridged = len(t.req.out) - t.base
+            new = t.inner.out[bridged:]
+            if new:
+                t.req.out.extend(int(x) for x in new)
+            if t.inner.eos_pos is not None and t.req.eos_pos is None:
+                t.req.eos_pos = t.base + t.inner.eos_pos
+        out: Dict[int, np.ndarray] = {}
+        for rid, t in list(self._tracked.items()):
+            if t.inner_rid not in finished:
+                continue
+            arr = finished.pop(t.inner_rid)
+            if t.inner is not t.req:
+                # exact final sync (retire may have truncated at eos)
+                t.req.out = t.req.out[:t.base] + [int(x)
+                                                  for x in t.inner.out]
+                arr = np.concatenate(
+                    [t.req.prompt, np.asarray(t.req.out, np.int32)])
+            out[rid] = arr
+            del self._tracked[rid]
+        out.update(finished)        # untracked passthrough (defensive)
+        if self._pending_finished:
+            out.update(self._pending_finished)
+            self._pending_finished = {}
+        return out
+
+    def _recover(self, exc: BaseException) -> None:
+        """Declared crash: circuit-breaker check, flight dump, rebuild
+        through the factory, replay every live request from its
+        committed token prefix."""
+        p = self.policy
+        now = self._clock()
+        self.last_error = exc
+        self.stats["crashes"] += 1
+        if self._reg.enabled:
+            self._reg.counter("serve.resilience.crashes_total").inc()
+        self._event("crash", error=f"{type(exc).__name__}: {exc}"[:300])
+        self._dump_flight(
+            f"engine recovery: {type(exc).__name__}: {exc}")
+        while self._restart_times and \
+                now - self._restart_times[0] > p.restart_window_s:
+            self._restart_times.popleft()
+        if len(self._restart_times) >= p.max_restarts:
+            self.stats["circuit_opens"] += 1
+            if self._reg.enabled:
+                self._reg.counter(
+                    "serve.resilience.circuit_open_total").inc()
+            self._event("circuit_open",
+                        restarts=len(self._restart_times))
+            raise RecoveryExhaustedError(
+                f"{len(self._restart_times)} engine restarts within "
+                f"{p.restart_window_s}s — circuit breaker open; last "
+                f"error: {type(exc).__name__}: {exc}") from exc
+        self._restart_times.append(now)
+        t0 = self._clock()
+        self.engine = None          # drop pools before rebuilding
+        self.engine = self._factory()
+        replayed = 0
+        for rid, t in list(self._tracked.items()):
+            req = t.req
+            if req.eos_pos is not None or len(req.out) >= t.max_new:
+                # crashed between producing the final token and the
+                # retire: synthesize the terminal result from the
+                # committed prefix (eos truncation included)
+                if req.eos_pos is not None:
+                    req.out = req.out[:req.eos_pos + 1]
+                self._pending_finished[rid] = np.concatenate(
+                    [req.prompt, np.asarray(req.out, np.int32)])
+                del self._tracked[rid]
+                continue
+            committed = np.concatenate(
+                [req.prompt, np.asarray(req.out, np.int32)]) \
+                if req.out else req.prompt
+            kw = t.kwargs
+            inner_rid = self.engine.add_request(
+                committed, t.max_new - len(req.out),
+                kw["eos_token_id"], temperature=kw["temperature"],
+                top_k=kw["top_k"], top_p=kw["top_p"], seed=kw["seed"],
+                priority=t.priority)
+            t.inner = next(r for r in reversed(self.engine.queue)
+                           if r.req_id == inner_rid)
+            t.inner_rid = inner_rid
+            t.base = len(req.out)
+            replayed += 1
+        dt = self._clock() - t0
+        self.stats["recoveries"] += 1
+        self.stats["replayed_requests"] += replayed
+        if self._reg.enabled:
+            self._reg.counter("serve.resilience.recoveries_total").inc()
+            self._reg.counter(
+                "serve.resilience.replayed_requests_total").inc(replayed)
+            self._reg.histogram("serve.resilience.recovery_secs",
+                                unit="s").record(dt)
+        self._event("recovered", replayed=replayed, secs=round(dt, 6))
+
+    def _event(self, action: str, **fields) -> None:
+        if self._reg.enabled:
+            self._reg.event("serve", action=f"resilience_{action}",
+                            **fields)
+
+    def _dump_flight(self, reason: str) -> None:
+        """Flight-ring post-mortem on every recovery — the serve event
+        ring around the crash is the incident timeline."""
+        try:
+            from ..observability.flight_recorder import FlightRecorder
+            for sink in self._reg.sinks:
+                if isinstance(sink, FlightRecorder) \
+                        and sink.directory is not None:
+                    sink.dump(reason)
+        except Exception as dump_err:   # the dump must not mask recovery
+            self._event("flight_dump_failed",
+                        error=str(dump_err)[:200])

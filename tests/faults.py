@@ -1,6 +1,8 @@
-"""Fault-injection harness for checkpoint durability tests (ISSUE 2).
+"""Fault-injection harness: checkpoint durability (ISSUE 2) and the
+serve-path chaos injectors (ISSUE 11).
 
-Simulates the two ways a preemption can interrupt ``framework.io.save``:
+Checkpoint side — simulates the two ways a preemption can interrupt
+``framework.io.save``:
 
 * :func:`crash_mid_write` — the process dies while the checkpoint's temp
   file is being written: only the first ``at_bytes`` bytes ever reach the
@@ -14,17 +16,48 @@ Both patch the narrow seams ``framework.io`` exposes for exactly this
 purpose (``_write_bytes`` / ``_replace``) rather than global ``os``
 state, so the rest of the test process keeps working.  ``corrupt_file``
 models post-crash bit-rot on an already-published checkpoint.
+
+Serve side — chaos injectors for the continuous-batching engine and its
+resilience supervisor (``paddle_tpu/serving/resilience.py``).  Each
+wraps a narrow instance seam (``engine.step``, the extracted
+``engine._prefill_into_slot``, the spec runner's ``run_decode``) so one
+engine misbehaves while the rest of the process keeps working:
+
+* :func:`fail_step_n` — declared crash (or any exception) at decode
+  step N, before or after the real step runs (``where="after"`` models
+  a crash that loses the step's return value but not its committed
+  tokens).
+* :func:`transient_step_faults` — the next ``n`` steps raise
+  :class:`~paddle_tpu.serving.resilience.TransientStepError` before any
+  work happens; the supervisor's retry/backoff path must absorb them.
+* :func:`exhaust_kv_pool` — steals free pool blocks (down to ``leave``)
+  so admission saturates and priority preemption has to fire.
+* :func:`slow_steps` — adds latency to the next ``n`` steps (drives the
+  supervisor's slow-step escalation).
+* :func:`crash_mid_prefill` — raises inside the prefill AFTER the
+  request's pages are mapped; the admission path must release them
+  exactly once (the ISSUE 11 engine-hardening regression).
+* :func:`crash_mid_speculation` — raises inside the spec-decode
+  draft/verify round.
+
+The serve exceptions are ordinary ``Exception`` subclasses (unlike
+:class:`SimulatedCrash`): a supervisor is SUPPOSED to catch and recover
+from them, while the checkpoint kill must never be swallowed.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import time
 
 from paddle_tpu.framework import io as fio
 
-__all__ = ["SimulatedCrash", "crash_mid_write", "fail_replace",
-           "corrupt_file", "truncate_file"]
+__all__ = ["InjectedEngineCrash", "SimulatedCrash", "corrupt_file",
+           "crash_mid_prefill", "crash_mid_speculation",
+           "crash_mid_write", "exhaust_kv_pool", "fail_replace",
+           "fail_step_n", "slow_steps", "transient_step_faults",
+           "truncate_file"]
 
 
 class SimulatedCrash(BaseException):
@@ -93,3 +126,159 @@ def truncate_file(path: str, keep_bytes: int) -> None:
     """Cut a published file short (torn write / partial flush model)."""
     with open(path, "r+b") as f:
         f.truncate(keep_bytes)
+
+
+# ---------------------------------------------------------------------
+# serve-path chaos injectors (ISSUE 11)
+# ---------------------------------------------------------------------
+class InjectedEngineCrash(RuntimeError):
+    """A declared engine crash injected by the chaos harness.  An
+    ordinary ``Exception`` on purpose: the resilience supervisor is
+    expected to catch it, tear the engine down, and replay."""
+
+
+@contextlib.contextmanager
+def fail_step_n(engine, n: int = 1, *, exc_type=InjectedEngineCrash,
+                where: str = "before"):
+    """Raise ``exc_type`` on the ``n``-th ``engine.step()`` call (1-
+    based).  ``where="before"`` faults before the step runs (nothing
+    committed); ``where="after"`` runs the real step first and then
+    raises — the crash loses the step's RETURN VALUE (newly finished
+    requests) but not the tokens it committed, the nastiest recovery
+    case.  Yields a stats dict (``stats['crashed']``)."""
+    assert where in ("before", "after"), where
+    real = engine.step
+    stats = {"calls": 0, "crashed": 0}
+
+    def patched():
+        stats["calls"] += 1
+        if stats["calls"] == n:
+            stats["crashed"] += 1
+            if where == "after":
+                real()
+            raise exc_type(f"injected crash at step {n} ({where})")
+        return real()
+
+    engine.step = patched
+    try:
+        yield stats
+    finally:
+        # the engine object may have been torn down and rebuilt by a
+        # supervisor; only unpatch if OUR wrapper is still installed
+        if getattr(engine, "step", None) is patched:
+            engine.step = real
+
+
+@contextlib.contextmanager
+def transient_step_faults(engine, n: int = 1, *, exc_type=None):
+    """The next ``n`` ``engine.step()`` calls raise a transient fault
+    BEFORE any work happens (a retry re-runs the identical step).
+    Defaults to :class:`TransientStepError` so the supervisor's
+    bounded-backoff retry path absorbs them."""
+    if exc_type is None:
+        from paddle_tpu.serving.resilience import TransientStepError
+        exc_type = TransientStepError
+    real = engine.step
+    stats = {"raised": 0}
+
+    def patched():
+        if stats["raised"] < n:
+            stats["raised"] += 1
+            raise exc_type(
+                f"injected transient fault {stats['raised']}/{n}")
+        return real()
+
+    engine.step = patched
+    try:
+        yield stats
+    finally:
+        if getattr(engine, "step", None) is patched:
+            engine.step = real
+
+
+@contextlib.contextmanager
+def exhaust_kv_pool(engine, *, leave: int = 0):
+    """Steal free KV pool blocks (down to ``leave``) for the duration:
+    admission saturates, head-of-line requests wait, and priority
+    preemption has a reason to fire.  The stolen blocks are returned on
+    exit, so drain-time leak checks stay meaningful."""
+    n = max(engine.alloc.free_blocks - leave, 0)
+    stolen = engine.alloc.acquire(n) if n else []
+    try:
+        yield {"stolen": len(stolen or [])}
+    finally:
+        if stolen:
+            engine.alloc.release(stolen)
+
+
+@contextlib.contextmanager
+def slow_steps(engine, extra_s: float, n: int = 1):
+    """Add ``extra_s`` of host latency to the next ``n`` steps (models
+    a hung DMA / a swapping host; drives the supervisor's slow-step
+    escalation)."""
+    real = engine.step
+    stats = {"slowed": 0}
+
+    def patched():
+        if stats["slowed"] < n:
+            stats["slowed"] += 1
+            time.sleep(extra_s)
+        return real()
+
+    engine.step = patched
+    try:
+        yield stats
+    finally:
+        if getattr(engine, "step", None) is patched:
+            engine.step = real
+
+
+@contextlib.contextmanager
+def crash_mid_prefill(engine, *, exc_type=InjectedEngineCrash,
+                      crashes: int = 1):
+    """Raise from inside the prefill of the next ``crashes`` admissions
+    — AFTER the request's pages are mapped into the slot, the exact
+    window where a sloppy scheduler would leak or double-free them.
+    The admission path must release the pages exactly once and keep
+    the request waiting (regression-pinned in test_serving_engine)."""
+    real = engine._prefill_into_slot
+    stats = {"crashed": 0}
+
+    def patched(slot, req, L):
+        if stats["crashed"] < crashes:
+            stats["crashed"] += 1
+            raise exc_type(
+                f"injected crash mid-prefill of request {req.req_id}")
+        return real(slot, req, L)
+
+    engine._prefill_into_slot = patched
+    try:
+        yield stats
+    finally:
+        if getattr(engine, "_prefill_into_slot", None) is patched:
+            engine._prefill_into_slot = real
+
+
+@contextlib.contextmanager
+def crash_mid_speculation(engine, *, exc_type=InjectedEngineCrash,
+                          crashes: int = 1):
+    """Raise from inside the next ``crashes`` speculative decode rounds
+    (the engine must have a ``spec_config``).  Fires before the round
+    commits, so recovery replays from the last committed prefix."""
+    runner = engine._spec
+    assert runner is not None, "engine is not speculating"
+    real = runner.run_decode
+    stats = {"crashed": 0}
+
+    def patched(active):
+        if stats["crashed"] < crashes:
+            stats["crashed"] += 1
+            raise exc_type("injected crash mid-speculation")
+        return real(active)
+
+    runner.run_decode = patched
+    try:
+        yield stats
+    finally:
+        if getattr(runner, "run_decode", None) is patched:
+            runner.run_decode = real

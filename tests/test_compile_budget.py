@@ -62,6 +62,15 @@ def test_spec_warm_start_is_zero_compiles(measured):
     assert measured["serve_spec_warm"] == 0, measured
 
 
+def test_recovery_warm_is_zero_compiles(measured):
+    """ISSUE 11 acceptance: a crash-recovery rebuild from an AOT-warm
+    factory — teardown, fresh engine, replay of every live request
+    from its committed prefix (greedy AND sampled) — performs zero
+    backend compiles.  A restart must never pay tracing under
+    traffic."""
+    assert measured["serve_recovery_warm"] == 0, measured
+
+
 def test_every_scenario_has_a_budget(measured):
     budgets = compile_budget.load_ledger()["budgets"]
     assert set(measured) <= set(budgets), (set(measured), set(budgets))

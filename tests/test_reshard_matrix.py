@@ -51,17 +51,28 @@ def _mesh_2d():
 
 
 def _hlo_for(src_spec, dst_spec, mesh, shape=(8, 16), reduce_hidden=False):
-    """Compile `constrain(x, dst)` with input sharded `src`; return HLO."""
+    """Compile a src-sharded -> dst-PINNED transfer; return its HLO.
+
+    The dst placement is pinned with ``out_shardings`` (what ``reshard``
+    semantically guarantees: the OUTPUT carries the dst placement).  It
+    must not be probed with a bare ``with_sharding_constraint`` on the
+    jit root: without ``out_shardings`` jax compiles with
+    ``allow_spmd_sharding_propagation_to_output=true`` and XLA may keep
+    the input sharding at the root (eliding the transfer entirely) — on
+    jax 0.4.37 that turned the s_to_r and nd-mesh probes into no-op
+    ``copy`` modules with no collectives, the root cause of the two
+    long-standing failures here (and of a real defect in
+    ``api._resolve_partial``, fixed the same way)."""
     s_src = NamedSharding(mesh.mesh, src_spec)
     s_dst = NamedSharding(mesh.mesh, dst_spec)
 
     def f(x):
         if reduce_hidden:
             x = jnp.sum(x, axis=0)
-        return jax.lax.with_sharding_constraint(x, s_dst)
+        return x
 
     x = jax.ShapeDtypeStruct(shape, jnp.float32, sharding=s_src)
-    return jax.jit(f).lower(x).compile().as_text()
+    return jax.jit(f, out_shardings=s_dst).lower(x).compile().as_text()
 
 
 def _collectives(hlo):

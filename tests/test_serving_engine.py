@@ -447,6 +447,73 @@ def test_cancel_mid_speculation_accounting(model):
     _assert_pool_consistent(eng)
 
 
+def test_prefill_crash_releases_pages_exactly_once(model):
+    """ISSUE 11 engine hardening: a crash INSIDE the prefill — after
+    the request's pages are mapped into the slot but before it goes
+    live — must release those pages exactly once and keep the request
+    waiting.  Covers the phase the queued/scheduled cancel regressions
+    above cannot reach (the slot is half-built, so neither ``cancel``
+    nor ``kv_leak_report`` can see its references)."""
+    import faults
+    cfg, params = model
+    p = rng.integers(0, cfg.vocab_size, (12,)).astype(np.int32)
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=2,
+                                   block_size=8, num_blocks=16)
+    a = eng.add_request(p, 6)
+    free_before = eng.alloc.free_blocks
+    with faults.crash_mid_prefill(eng) as stats:
+        with pytest.raises(faults.InjectedEngineCrash):
+            eng.step()
+    assert stats["crashed"] == 1
+    assert eng.alloc.free_blocks == free_before   # exactly-once release
+    _assert_pool_consistent(eng)
+    # the request is still WAITING: a retry (injector exhausted) runs
+    # it to completion with the result an uninjected engine produces
+    assert eng.queue and eng.queue[0].req_id == a
+    cold = ContinuousBatchingEngine(cfg, params, max_batch=2,
+                                    block_size=8, num_blocks=16)
+    cold.add_request(p, 6)
+    want = list(cold.run_to_completion().values())[0]
+    res = eng.run_to_completion()
+    np.testing.assert_array_equal(res[a], want)
+    _assert_pool_consistent(eng)
+
+
+def test_prefill_crash_with_prefix_shared_pages(model):
+    """Same phase, nastier accounting: the crashed admission reused
+    prefix-cached blocks (slot took extra references on shared pages).
+    The release must drop exactly the slot's references — the index's
+    stay live and keep serving later requests."""
+    import faults
+    cfg, params = model
+    prefix = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    p1 = np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (3,))
+                         .astype(np.int32)])
+    p2 = np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (5,))
+                         .astype(np.int32)])
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=2,
+                                   block_size=8, num_blocks=16)
+    eng.add_request(p1, 6)
+    eng.run_to_completion()              # indexes the 2 prefix blocks
+    _assert_pool_consistent(eng)
+    shared = list(eng.prefix_index.values())
+    b = eng.add_request(p2, 6)           # admits via prefix-cache hit
+    with faults.crash_mid_prefill(eng):
+        with pytest.raises(faults.InjectedEngineCrash):
+            eng.step()
+    _assert_pool_consistent(eng)
+    for pg in shared:                    # index refs survived, exactly
+        assert eng.alloc.ref.get(pg) == 1, eng.alloc.ref
+    # cancel of the still-waiting request is the queued-phase path
+    assert eng.cancel(b)
+    _assert_pool_consistent(eng)
+    c = eng.add_request(p2, 6)           # the intact index still hits
+    out = eng.run_to_completion()
+    assert c in out
+    assert eng.stats["prefix_blocks_reused"] >= 2
+    _assert_pool_consistent(eng)
+
+
 def test_cancel_queued_and_active(model):
     cfg, params = model
     p = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)

@@ -94,6 +94,22 @@ def test_spec_decode_has_zero_tl001_tl006():
             assert n == 0, f"baseline carries {rule} debt in {path}"
 
 
+def test_serving_resilience_has_zero_tl001_tl006():
+    """ISSUE 11 contract: the resilience layer (KV spill/restore +
+    supervised recovery) is host-side scheduler code around compiled
+    programs — no host-sync in traced code (TL001) and no silent broad
+    excepts (TL006; a swallowed restore/replay error would silently
+    lose a stream the whole subsystem exists to preserve) — live scan
+    AND committed ledger."""
+    files = ("paddle_tpu/serving/resilience.py",)
+    live = [f for f in _current_findings()
+            if f.rule in ("TL001", "TL006") and f.path.endswith(files)]
+    assert live == [], [f.format() for f in live]
+    for (rule, path), n in baseline_mod.load().items():
+        if rule in ("TL001", "TL006") and path.endswith(files):
+            assert n == 0, f"baseline carries {rule} debt in {path}"
+
+
 def test_decode_block_has_zero_tl001_tl006():
     """ISSUE 9 contract: the fused decode-block op (dispatch module AND
     Pallas kernel) sits on the hottest serve path — no host-sync in
