@@ -638,10 +638,24 @@ class ContinuousBatchingEngine:
                 return
             cand = self.queue[idx]
             snap = self._spill.get(cand.req_id)
-            need = snap.num_blocks if snap is not None else \
-                self._blocks_needed(len(cand.prompt) + cand.max_new_tokens)
+            if snap is not None:
+                need, shared = snap.num_blocks, ()
+            else:
+                # admission reuses the waiter's cached prefix pages and
+                # acquires only the remainder — the shortfall tests
+                # must see the same need, or a saturated pool would
+                # spill a low-priority tenant for a waiter that was
+                # already admissible via shared prefix pages
+                L, shared = self._cached_prefix(cand.prompt)
+                need = self._blocks_needed(
+                    len(cand.prompt) + cand.max_new_tokens) - L
+            shared_set = set(shared)
+            # the waiter's own prefix pages are counted in ``need``
+            # already, and admission pins them before acquiring — they
+            # are not evictable headroom on top of that
             evictable = sum(1 for p in self.prefix_index.values()
-                            if self.alloc.ref.get(p) == 1)
+                            if self.alloc.ref.get(p) == 1
+                            and p not in shared_set)
             have_slot = any(s is None for s in self.slots)
             if have_slot and self.alloc.free_blocks + evictable >= need:
                 return                 # admissible without eviction

@@ -227,8 +227,27 @@ class _Tracked:
     max_new: int
     priority: int
     inner: object = None
-    inner_rid: int = -1
     base: int = 0               # outer tokens committed before replay
+
+
+class _DeadEngine:
+    """Sentinel installed when a recovery rebuild itself fails: every
+    engine-surface access raises the typed circuit-breaker error
+    instead of ``AttributeError`` on ``None``, so callers that keep
+    driving the wrapper after the escalation still land in the
+    front-end's typed abort-all path."""
+
+    def __init__(self, cause: BaseException):
+        object.__setattr__(self, "_cause", cause)
+
+    def __getattr__(self, name):
+        cause = object.__getattribute__(self, "_cause")
+        err = RecoveryExhaustedError(
+            "engine rebuild failed during crash recovery — the "
+            f"supervisor has no live engine; rebuild error: "
+            f"{type(cause).__name__}: {cause}")
+        err.__cause__ = cause
+        raise err
 
 
 class SupervisedEngine:
@@ -261,6 +280,14 @@ class SupervisedEngine:
         self._clock = clock
         self._sleep = sleep
         self.engine = factory()
+        # The supervisor owns the caller-visible id space: a rebuilt
+        # engine restarts ITS counter at 0, so reusing inner rids would
+        # collide with still-live outer ids after any pre-crash request
+        # finished.  Outer ids are monotone and never reused; each
+        # inner GenRequest is re-keyed to its outer id on creation, so
+        # the inner engine's finished/spill/cancel bookkeeping (all
+        # keyed off ``req.req_id``) speaks outer ids too.
+        self._next_outer_id = 0
         self._tracked: "collections.OrderedDict[int, _Tracked]" = \
             collections.OrderedDict()
         self._pending_finished: Dict[int, np.ndarray] = {}
@@ -271,6 +298,7 @@ class SupervisedEngine:
         self.stats: Dict[str, int] = {
             "transient_retries": 0, "slow_steps": 0, "crashes": 0,
             "recoveries": 0, "replayed_requests": 0, "circuit_opens": 0,
+            "rebuild_failures": 0,
         }
 
     # -- engine surface -------------------------------------------------
@@ -280,28 +308,39 @@ class SupervisedEngine:
                     top_k: Optional[int] = None,
                     top_p: Optional[float] = None,
                     seed: int = 0, priority: int = 0) -> int:
-        rid = self.engine.add_request(
+        inner_rid = self.engine.add_request(
             prompt_ids, max_new_tokens, eos_token_id,
             temperature=temperature, top_k=top_k, top_p=top_p,
             seed=seed, priority=priority)
         req = next(r for r in reversed(self.engine.queue)
-                   if r.req_id == rid)
+                   if r.req_id == inner_rid)
+        rid = self._next_outer_id
+        self._next_outer_id += 1
+        req.req_id = rid        # re-key to the supervisor's id space
         self._tracked[rid] = _Tracked(
             req=req,
             kwargs={"eos_token_id": eos_token_id,
                     "temperature": temperature, "top_k": top_k,
                     "top_p": top_p, "seed": seed},
             max_new=int(max_new_tokens), priority=int(priority),
-            inner=req, inner_rid=rid)
+            inner=req)
         return rid
 
     def cancel(self, req_id: int) -> bool:
+        if self._pending_finished.pop(req_id, None) is not None:
+            # terminal result synthesized during a recovery but not yet
+            # delivered: cancelling drops the delivery — and must NOT
+            # fall through to the engine, whose id space never held
+            # this request after the rebuild
+            return True
         t = self._tracked.pop(req_id, None)
         if t is None:
-            # unknown or already finished — keep engine semantics
-            return self.engine.cancel(req_id)
-        self._pending_finished.pop(req_id, None)
-        self.engine.cancel(t.inner_rid)
+            # unknown or already finished.  Never forward an untracked
+            # outer id into the engine: after a rebuild the inner
+            # counter restarted, so a stale outer id could name (and
+            # cancel) an unrelated request
+            return False
+        self.engine.cancel(req_id)
         return True
 
     def step(self) -> Dict[int, np.ndarray]:
@@ -316,6 +355,8 @@ class SupervisedEngine:
                 finished = self.engine.step()
             except (KeyboardInterrupt, SystemExit):
                 raise
+            except RecoveryExhaustedError:
+                raise       # breaker already open (dead-engine access)
             except TransientStepError as e:
                 attempt += 1
                 self.stats["transient_retries"] += 1
@@ -439,9 +480,11 @@ class SupervisedEngine:
                 t.req.eos_pos = t.base + t.inner.eos_pos
         out: Dict[int, np.ndarray] = {}
         for rid, t in list(self._tracked.items()):
-            if t.inner_rid not in finished:
+            # inner requests are re-keyed to their outer ids at
+            # creation, so the engine's finished dict speaks outer ids
+            if rid not in finished:
                 continue
-            arr = finished.pop(t.inner_rid)
+            arr = finished.pop(rid)
             if t.inner is not t.req:
                 # exact final sync (retire may have truncated at eos)
                 t.req.out = t.req.out[:t.base] + [int(x)
@@ -485,8 +528,32 @@ class SupervisedEngine:
                 f"error: {type(exc).__name__}: {exc}") from exc
         self._restart_times.append(now)
         t0 = self._clock()
-        self.engine = None          # drop pools before rebuilding
-        self.engine = self._factory()
+        # drop the crashed engine's pools before rebuilding; the
+        # sentinel (not None) keeps every engine-surface access typed
+        # if the rebuild itself fails
+        self.engine = _DeadEngine(exc)
+        try:
+            rebuilt = self._factory()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as build_err:
+            self.engine = _DeadEngine(build_err)
+            self.stats["rebuild_failures"] += 1
+            self.stats["circuit_opens"] += 1
+            if self._reg.enabled:
+                self._reg.counter(
+                    "serve.resilience.rebuild_failures_total").inc()
+                self._reg.counter(
+                    "serve.resilience.circuit_open_total").inc()
+            self._event("rebuild_failed",
+                        error=f"{type(build_err).__name__}: "
+                              f"{build_err}"[:300])
+            raise RecoveryExhaustedError(
+                "engine rebuild failed during crash recovery — "
+                "escalating to the typed abort-all path; rebuild "
+                f"error: {type(build_err).__name__}: {build_err}"
+            ) from build_err
+        self.engine = rebuilt
         replayed = 0
         for rid, t in list(self._tracked.items()):
             req = t.req
@@ -511,7 +578,7 @@ class SupervisedEngine:
                 priority=t.priority)
             t.inner = next(r for r in reversed(self.engine.queue)
                            if r.req_id == inner_rid)
-            t.inner_rid = inner_rid
+            t.inner.req_id = rid    # replayed under the same outer id
             t.base = len(req.out)
             replayed += 1
         dt = self._clock() - t0

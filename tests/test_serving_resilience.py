@@ -147,6 +147,37 @@ def test_preemption_under_kv_pressure(model):
     _assert_no_leaks(eng)
 
 
+def test_prefix_shared_waiter_admits_without_preemption(model):
+    """A high-priority waiter whose prompt shares a cached prefix with
+    a RUNNING request only needs its private remainder (admission reuses
+    the shared pages); the preemption shortfall tests must see that
+    reduced need, or a saturated pool spills a low-priority tenant for
+    a waiter that was already admissible."""
+    base = _prompt(model, 16)                  # two full 8-token blocks
+    p_x = np.concatenate([base, _prompt(model, 2)])
+    p_y = _prompt(model, 9)
+    p_h = np.concatenate([base, _prompt(model, 4)])
+    want_x = _solo_result(model, p_x, 6)
+    want_h = _solo_result(model, p_h, 4)
+    # X: 3 blocks, Y: 2 blocks, H: 3 blocks but 2 shared with X's
+    # indexed prompt prefix -> 1 private; pool of 6 leaves exactly that
+    # 1 free block once X and Y are running
+    eng = _engine(model, max_batch=3, num_blocks=6)
+    x = eng.add_request(p_x, 6, priority=0)
+    y = eng.add_request(p_y, 7, priority=0)
+    eng.step()
+    assert eng.alloc.free_blocks == 1
+    h = eng.add_request(p_h, 4, priority=5)
+    eng.step()
+    assert eng.resilience_stats()["preemptions"] == 0
+    assert any(s is not None and s.req_id == h for s in eng.slots)
+    res = eng.run_to_completion()
+    np.testing.assert_array_equal(res[x], want_x)
+    np.testing.assert_array_equal(res[h], want_h)
+    assert y in res
+    _assert_no_leaks(eng)
+
+
 def test_uniform_priority_never_preempts(model):
     """With one priority class the whole machinery is inert — saturated
     admission degrades to the pre-ISSUE head-of-line wait."""
@@ -383,6 +414,87 @@ def test_circuit_breaker_falls_back_to_abort_all(model):
     assert h.state is RequestState.CANCELLED
     with pytest.raises(RequestAborted):
         h.result()
+
+
+def test_submit_after_recovery_ids_never_collide(model):
+    """The supervisor owns the caller-visible id space: after a crash
+    the rebuilt engine restarts its counter and the replay consumes its
+    low ids, so a post-recovery submit must NOT be handed an id equal
+    to a still-live tracked request's (that would overwrite its
+    bookkeeping and cross-wire the two streams)."""
+    pA, pB, pC = _prompt(model, 9), _prompt(model, 10), _prompt(model, 8)
+    want_b = _solo_result(model, pB, 10)
+    want_c = _solo_result(model, pC, 6)
+    sup = SupervisedEngine(lambda: _engine(model),
+                           policy=_fast_policy(), sleep=lambda s: None)
+    results = {}
+    a = sup.add_request(pA, 2)
+    b = sup.add_request(pB, 10)
+    while a not in results:               # a finishes BEFORE the crash
+        results.update(sup.step())
+    with faults.fail_step_n(sup.engine, 1):
+        results.update(sup.step())        # crash + recovery, b replayed
+    assert sup.stats["recoveries"] == 1
+    c = sup.add_request(pC, 6)
+    assert c not in (a, b), (a, b, c)
+    results.update(sup.run_to_completion())
+    np.testing.assert_array_equal(results[b], want_b)
+    np.testing.assert_array_equal(results[c], want_c)
+    _assert_no_leaks(sup)
+
+
+def test_cancel_synthesized_result_after_recovery(model):
+    """A request whose terminal result was synthesized during recovery
+    (it finished inside the crashed step) lives only in the
+    supervisor's pending buffer.  A cancel landing in the window before
+    the next absorb must drop that delivery — and must NOT forward the
+    stale outer id into the rebuilt engine, whose inner id space could
+    name an unrelated replayed request."""
+    pA, pB = _prompt(model, 9), _prompt(model, 10)
+    want_b = _solo_result(model, pB, 8)
+    sup = SupervisedEngine(lambda: _engine(model),
+                           policy=_fast_policy(), sleep=lambda s: None)
+    a = sup.add_request(pA, 2)
+    b = sup.add_request(pB, 8)
+    sup.step()                            # a's budget fills this step
+    # recovery with a's budget already met synthesizes its terminal
+    # result into the pending buffer and replays only b (this is the
+    # pre-absorb window a concurrent cancel can land in)
+    sup._recover(faults.InjectedEngineCrash("synthesize a"))
+    assert sup.stats["recoveries"] == 1
+    assert a in sup._pending_finished and a not in sup._tracked
+    assert sup.cancel(a) is True          # drops the pending delivery
+    assert sup.cancel(a) is False         # idempotent / unknown ids
+    res = sup.run_to_completion()
+    assert a not in res                   # never delivered after cancel
+    np.testing.assert_array_equal(res[b], want_b)
+    _assert_no_leaks(sup)
+
+
+def test_rebuild_failure_is_typed(model):
+    """A factory that fails during recovery (e.g. an AOT-warm factory
+    whose artifact store went away) escalates with the TYPED
+    circuit-breaker error, and every later wrapper call stays typed —
+    never an AttributeError on a half-torn-down supervisor."""
+    built = []
+
+    def factory():
+        if built:
+            raise RuntimeError("artifact store unreachable")
+        built.append(1)
+        return _engine(model)
+
+    sup = SupervisedEngine(factory, policy=_fast_policy(),
+                           sleep=lambda s: None)
+    sup.add_request(_prompt(model, 9), 8)
+    with faults.fail_step_n(sup.engine, 1):
+        with pytest.raises(RecoveryExhaustedError):
+            sup.run_to_completion()
+    assert sup.stats["rebuild_failures"] == 1
+    with pytest.raises(RecoveryExhaustedError):
+        sup.step()
+    with pytest.raises(RecoveryExhaustedError):
+        sup.queue_depth
 
 
 def test_crash_mid_prefill_recovers_under_supervisor(model):
