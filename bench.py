@@ -403,6 +403,116 @@ def _serve_resilience_extra(cfg, params, *, mb, nb, on_accel, t0, new,
         return {"resilience_error": f"{type(e).__name__}: {e}"}
 
 
+def _serve_fleet_extra(cfg, params, *, mb, nb, on_accel, t0, new,
+                       aot_dir):
+    """Fleet row for the serve config (ISSUE 12), on compile-warm
+    replicas reusing the aot_warm row's artifacts: goodput of N=2/4
+    data-parallel replicas vs a single supervised engine under the
+    same seeded load, re-placement recovery-time-to-resume after a
+    replica kill, fleet backend-compile count (must be zero — the
+    fleet_warm budget row), and the zero-leak check.  Never fails the
+    row — errors land in extra.fleet_error."""
+    try:
+        from paddle_tpu.aot.serve import warm_engine_factory
+        from paddle_tpu.observability import CompileMonitor
+        from paddle_tpu.serving import (AdmissionConfig, EngineRouter,
+                                        LoadGenConfig,
+                                        PoissonLoadGenerator,
+                                        RetryPolicy, ServingFrontend,
+                                        SupervisedEngine)
+
+        if aot_dir is None:
+            raise RuntimeError("no AOT artifacts from the aot_warm row")
+        rng = np.random.default_rng(6)
+        factory = warm_engine_factory(cfg, params, aot_dir=aot_dir,
+                                      max_batch=mb, block_size=16,
+                                      num_blocks=nb)
+        lg = LoadGenConfig(
+            n_requests=16 if not on_accel else 48,
+            rate_rps=150.0 if not on_accel else 16.0, seed=8,
+            prompt_len=(3, t0), max_new_tokens=(3, new),
+            sampled_fraction=0.25, cancel_fraction=0.1,
+            burst_rate_rps=600.0 if not on_accel else 64.0,
+            burst_fraction=0.25,
+            slo_ttft_s=5.0 if not on_accel else 2.0,
+            slo_tpot_s=1.0 if not on_accel else 0.25)
+
+        def run_fleet(n):
+            if n == 1:
+                eng = SupervisedEngine(
+                    factory, policy=RetryPolicy(backoff_base_s=0.0),
+                    sleep=lambda s: None)
+            else:
+                eng = EngineRouter(
+                    [factory] * n,
+                    policy=RetryPolicy(backoff_base_s=0.0),
+                    sleep=lambda s: None)
+            fe = ServingFrontend(
+                eng, admission=AdmissionConfig(max_queue_len=64))
+            rep = PoissonLoadGenerator(fe, lg).run()
+            leaks = rep.to_dict()["kv_leaked_blocks"]
+            return rep, eng, leaks
+
+        monitor = CompileMonitor().install()
+        try:
+            rep1, _, leaks1 = run_fleet(1)
+            rep2, r2, leaks2 = run_fleet(2)
+            rep4, r4, leaks4 = run_fleet(4)
+        finally:
+            monitor.uninstall()
+        fleet_compiles = monitor.n_compiles
+
+        # -- re-placement recovery-time-to-resume ---------------------
+        router = EngineRouter([factory, factory],
+                              policy=RetryPolicy(backoff_base_s=0.0),
+                              sleep=lambda s: None)
+        rids = [router.add_request(
+            rng.integers(0, cfg.vocab_size, (t0,)).astype(np.int32),
+            new, temperature=0.7 if i == 0 else 0.0,
+            top_k=8 if i == 0 else None, seed=i + 1)
+            for i in range(min(3, mb + 1))]
+        router.step()
+        router.step()
+        victim = next(p.replica for p in router._placements.values())
+        moved = [rid for rid, p in router._placements.items()
+                 if p.replica == victim]
+        before = {rid: len(router._placements[rid].req.out)
+                  for rid in moved}
+        t_k = time.perf_counter()
+        router.kill_replica(victim, "bench replica kill")
+        while any(rid in router._placements
+                  and len(router._placements[rid].req.out)
+                  <= before[rid] for rid in moved):
+            router.step()
+        time_to_resume = time.perf_counter() - t_k
+        router.run_to_completion()
+        assert rids
+
+        return {"fleet": {
+            "replicas": [1, 2, 4],
+            "tokens_per_s": [round(rep1.tokens_per_s, 2),
+                             round(rep2.tokens_per_s, 2),
+                             round(rep4.tokens_per_s, 2)],
+            "goodput_rps": [round(rep1.goodput_rps, 3),
+                            round(rep2.goodput_rps, 3),
+                            round(rep4.goodput_rps, 3)],
+            "fleet_backend_compiles": fleet_compiles,
+            "replacement_time_to_resume_s": round(time_to_resume, 4),
+            "replaced_requests": len(moved),
+            "kv_leaked_blocks": leaks1 + leaks2 + leaks4,
+            "by_replica_n2": rep2.by_replica,
+            "deaths": router.stats["deaths"],
+            "replacements": router.stats["replacements"],
+            "note": "CPU proxy replicas share one core, so N>1 cannot "
+                    "beat N=1 wall-clock here; the fleet win on real "
+                    "hardware is N devices — this row proves zero "
+                    "compiles, placement spread, and re-placement "
+                    "latency, not CPU throughput",
+        }}
+    except Exception as e:
+        return {"fleet_error": f"{type(e).__name__}: {e}"}
+
+
 def _serve_decode_block_extra(cfg, params, eng_fused, *, mb, nb, on_accel,
                               t0, new):
     """Fused-vs-per-op decode A/B for the serve row (ISSUE 9): the same
@@ -696,6 +806,9 @@ def run_config_bench(config: str):
             cfg, params, eng, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
             new=new))
         out["extra"].update(_serve_resilience_extra(
+            cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
+            new=new, aot_dir=aot_dir_out.get("dir")))
+        out["extra"].update(_serve_fleet_extra(
             cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
             new=new, aot_dir=aot_dir_out.get("dir")))
     elif config == "decode":

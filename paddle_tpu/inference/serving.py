@@ -194,7 +194,7 @@ class ContinuousBatchingEngine:
                  enable_prefix_caching: bool = True,
                  prefill_buckets=None, aot_dir: Optional[str] = None,
                  fused_decode_block: bool = True, spec_config=None,
-                 enable_preemption: bool = True):
+                 enable_preemption: bool = True, spill_tier=None):
         if getattr(cfg, "moe_num_experts", 0) and \
                 getattr(cfg, "moe_router", "topk") != "topk":
             raise NotImplementedError("decode serves token-choice only")
@@ -215,8 +215,16 @@ class ContinuousBatchingEngine:
         L = cfg.num_layers
         kvh, hd = cfg.kv_heads, cfg.head_dim
         dt = jnp.dtype(cfg.dtype)
-        self.pool_k = jnp.zeros((L, num_blocks, block_size, kvh, hd), dt)
-        self.pool_v = jnp.zeros_like(self.pool_k)
+        # pools are built from HOST zeros through the same pool-shaped
+        # copy op the preemption restore path uses (jnp.array of a
+        # numpy array = convert_element_type executable), so a restore
+        # under traffic hits a compiled-at-construction op instead of
+        # tracing one — the fleet_warm budget row pins serve-path
+        # compiles at zero
+        self.pool_k = jnp.array(
+            np.zeros((L, num_blocks, block_size, kvh, hd), dt))
+        self.pool_v = jnp.array(
+            np.zeros((L, num_blocks, block_size, kvh, hd), dt))
         self.block_table = np.full((max_batch, self.MB), -1, np.int32)
         self.lengths = np.zeros((max_batch,), np.int32)
         self.tokens = np.zeros((max_batch,), np.int32)
@@ -237,12 +245,19 @@ class ContinuousBatchingEngine:
         self._next_id = 0
         # priority preemption (ISSUE 11): spilled-KV snapshots for
         # preempted requests, keyed by req_id (serving/resilience.py
-        # owns the snapshot/restore machinery + CRC conventions)
+        # owns the snapshot/restore machinery + CRC conventions).  The
+        # tier is BOUNDED (ISSUE 12): pass a capacity-limited
+        # ``SpillTier`` and an over-cap spill evicts the oldest
+        # snapshot, demoting its request to replay-from-prefix.
         self.enable_preemption = bool(enable_preemption)
-        self._spill: Dict[int, object] = {}
+        if spill_tier is None:
+            from ..serving.resilience import SpillTier
+            spill_tier = SpillTier()
+        self._spill = spill_tier
         self.resilience = {"preemptions": 0, "restores": 0,
                            "spill_save_secs": 0.0,
-                           "spill_restore_secs": 0.0}
+                           "spill_restore_secs": 0.0,
+                           "spill_evictions": 0, "prefix_replays": 0}
         # LRU-bounded (a serving workload with many distinct prompt
         # lengths must not retain unboundedly many XLA executables)
         from ..utils.lru import LRUCache
@@ -691,7 +706,7 @@ class ContinuousBatchingEngine:
         from ..serving.resilience import snapshot_slot
         t0 = time.perf_counter()
         snap = snapshot_slot(self, slot)
-        self._spill[req.req_id] = snap
+        self._spill_put(req.req_id, snap)
         self._free_slot(slot)
         self.queue.appendleft(req)
         dt = time.perf_counter() - t0
@@ -708,6 +723,52 @@ class ContinuousBatchingEngine:
                            priority=req.priority,
                            committed=int(snap.length))
         return req.req_id
+
+    def _spill_put(self, req_id: int, snap) -> None:
+        """Insert a snapshot into the (possibly capacity-bounded) spill
+        tier.  Snapshots evicted to honor the cap DEMOTE their request
+        to replay-from-prefix: the request keeps waiting in the queue
+        with its committed tokens, and admission recomputes its KV from
+        that prefix (``_replay_into_slot``) — a typed event plus the
+        ``serve.resilience.spill_evictions_total`` counter per victim,
+        never silent host-memory growth."""
+        from ..observability import REGISTRY
+        for rid in self._spill.put(req_id, snap):
+            self.resilience["spill_evictions"] += 1
+            if REGISTRY.enabled:
+                REGISTRY.counter(
+                    "serve.resilience.spill_evictions_total").inc()
+                REGISTRY.event("serve", action="spill_evict", req_id=rid,
+                               tier_bytes=self._spill.nbytes,
+                               cap_bytes=self._spill.capacity_bytes)
+
+    def spill_compatible(self, snap) -> bool:
+        """Whether a KV snapshot from another engine can restore into
+        THIS pool: identical page geometry (layers, block size, kv
+        heads, head dim, dtype) and a table wide enough to hold it —
+        the precondition for cross-replica snapshot transplant
+        (``serving/fleet.py``)."""
+        return (snap.k_pages.shape[0] == self.pool_k.shape[0]
+                and snap.k_pages.shape[2:] == self.pool_k.shape[2:]
+                and snap.k_pages.dtype == self.pool_k.dtype
+                and snap.num_blocks <= self.MB)
+
+    def adopt_preempted(self, req: GenRequest, snap) -> None:
+        """Transplant a preempted request (committed tokens + spilled
+        KV snapshot) extracted from ANOTHER engine of identical
+        geometry: the snapshot enters this engine's spill tier and the
+        request joins the FRONT of the queue, so admission restores the
+        exact page bytes into fresh local blocks — same path as a local
+        preemption, bit-identical resumption."""
+        if not self.spill_compatible(snap):
+            raise ValueError(
+                "KV snapshot geometry does not match this engine's pool "
+                f"(snapshot pages {snap.k_pages.shape}, pool "
+                f"{self.pool_k.shape})")
+        if req.req_id in self._spill:
+            raise ValueError(f"request {req.req_id} already spilled here")
+        self.queue.appendleft(req)
+        self._spill_put(req.req_id, snap)
 
     def _restore_preempted(self, slot: int, req: GenRequest, idx: int,
                            snap) -> bool:
@@ -752,6 +813,58 @@ class ContinuousBatchingEngine:
             REGISTRY.event("serve", action="restore", req_id=req.req_id,
                            priority=req.priority,
                            committed=int(snap.length))
+        return True
+
+    def _replay_into_slot(self, slot: int, req: GenRequest,
+                          idx: int) -> bool:
+        """Re-admit a preempted request whose KV snapshot is GONE (the
+        bounded spill tier evicted it): recompute the committed KV by
+        prefilling the committed token prefix ``prompt + out[:-1]`` and
+        resume the decode cursor at the pending token ``out[-1]``.
+
+        Prefill-computed KV is bit-identical to decode-computed KV (the
+        foundation of prefix caching and crash replay, pinned since
+        ISSUE 11), so demotion costs prefill FLOPs, never tokens.  The
+        final-position logits are discarded — they would only
+        re-produce ``out[-1]``, which is already committed.  False when
+        the pool cannot host the request yet."""
+        committed = np.concatenate(
+            [req.prompt, np.asarray(req.out[:-1], np.int32)]) \
+            if len(req.out) > 1 else req.prompt
+        need = self._blocks_needed(len(req.prompt) + req.max_new_tokens)
+        L, shared = self._cached_prefix(committed)
+        self.alloc.share(shared)
+        priv = self._acquire_with_eviction(need - L)
+        if priv is None:
+            self.alloc.release(shared)
+            return False
+        self.stats["prefix_blocks_reused"] += L
+        del self.queue[idx]
+        table = shared + priv
+        self.block_table[slot, :] = -1
+        self.block_table[slot, :need] = table
+        self.slot_pages[slot] = table
+        shadow = GenRequest(req.req_id, committed, 1, None)
+        try:
+            self._prefill_into_slot(slot, shadow, L)
+            self._register_prefix(req.prompt, table)
+        except BaseException:
+            # exactly-once release, same contract as the fresh path
+            self.alloc.release(self.slot_pages[slot])
+            self.slot_pages[slot] = []
+            self.block_table[slot, :] = -1
+            self.queue.appendleft(req)
+            raise
+        self.slots[slot] = req
+        self.lengths[slot] = len(committed)
+        self.tokens[slot] = req.out[-1]
+        self.resilience["prefix_replays"] += 1
+        from ..observability import REGISTRY
+        if REGISTRY.enabled:
+            REGISTRY.counter(
+                "serve.resilience.prefix_replays_total").inc()
+            REGISTRY.event("serve", action="prefix_replay",
+                           req_id=req.req_id, committed=len(committed))
         return True
 
     def _prefill_into_slot(self, slot: int, req: GenRequest,
@@ -825,6 +938,12 @@ class ContinuousBatchingEngine:
             snap = self._spill.get(req.req_id)
             if snap is not None:
                 if not self._restore_preempted(slot, req, idx, snap):
+                    break              # head-of-line waits for pages
+                continue
+            if req.out:
+                # preempted, but the bounded spill tier evicted the
+                # snapshot: demoted to replay-from-prefix
+                if not self._replay_into_slot(slot, req, idx):
                     break              # head-of-line waits for pages
                 continue
             T0 = len(req.prompt)

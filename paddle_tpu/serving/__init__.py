@@ -24,19 +24,22 @@ See ``docs/serving.md`` for the state machine, the streaming API, the
 admission knobs, and the metric catalogue.
 """
 
+from .fleet import EngineRouter, FleetExhaustedError, ReplicaState
 from .frontend import (AdmissionConfig, RequestAborted, RequestHandle,
                        RequestRejected, RequestState, ServingFrontend)
 from .loadgen import LoadGenConfig, LoadReport, PoissonLoadGenerator
 from .metrics import ServeMetrics
-from .resilience import (EngineCrashError, KVSnapshot,
+from .resilience import (EngineCrashError, KVSnapshot, PortableRequest,
                          RecoveryExhaustedError, ResilienceError,
-                         RetryPolicy, SpillCorruptError, SupervisedEngine,
-                         TransientStepError)
+                         RetryPolicy, SpillCorruptError, SpillTier,
+                         SupervisedEngine, TransientStepError)
 
 __all__ = [
-    "AdmissionConfig", "EngineCrashError", "KVSnapshot", "LoadGenConfig",
-    "LoadReport", "PoissonLoadGenerator", "RecoveryExhaustedError",
-    "RequestAborted", "RequestHandle", "RequestRejected", "RequestState",
-    "ResilienceError", "RetryPolicy", "ServeMetrics", "ServingFrontend",
-    "SpillCorruptError", "SupervisedEngine", "TransientStepError",
+    "AdmissionConfig", "EngineCrashError", "EngineRouter",
+    "FleetExhaustedError", "KVSnapshot", "LoadGenConfig", "LoadReport",
+    "PoissonLoadGenerator", "PortableRequest", "RecoveryExhaustedError",
+    "ReplicaState", "RequestAborted", "RequestHandle", "RequestRejected",
+    "RequestState", "ResilienceError", "RetryPolicy", "ServeMetrics",
+    "ServingFrontend", "SpillCorruptError", "SpillTier",
+    "SupervisedEngine", "TransientStepError",
 ]

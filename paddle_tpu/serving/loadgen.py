@@ -76,6 +76,21 @@ class LoadGenConfig:
     # preemption machinery is inert and reports carry no breakdown.
     priorities: Tuple[int, ...] = (0,)
     priority_weights: Optional[Tuple[float, ...]] = None
+    # bursty arrivals (ISSUE 12): each inter-arrival gap is drawn at
+    # ``burst_rate_rps`` with probability ``burst_fraction`` (seeded) —
+    # a two-state modulated Poisson process whose bursts stress
+    # admission and fleet placement without changing the mean shape of
+    # calm traffic.  Disabled by default (identical draw sequence to
+    # the pre-ISSUE plan, so existing seeds reproduce unchanged).
+    burst_rate_rps: Optional[float] = None
+    burst_fraction: float = 0.0
+    # scripted replica kill (ISSUE 12): once ``kill_after_requests``
+    # requests have been SUBMITTED (a deterministic trigger — wall
+    # clock never decides), the generator kills fleet replica
+    # ``kill_replica`` via ``router.kill_replica``.  Requires the
+    # frontend to drive an ``EngineRouter``.
+    kill_replica: Optional[int] = None
+    kill_after_requests: int = 0
 
 
 @dataclass
@@ -116,6 +131,10 @@ class LoadReport:
     # runs: the chaos invariant is that the HIGH class keeps its
     # goodput while the low class is shed/preempted
     by_priority: Optional[Dict[int, Dict[str, Any]]] = None
+    # per-replica breakdown (ISSUE 12), only when the frontend drives
+    # an EngineRouter: each request is attributed to the replica that
+    # FINISHED it (its final placement after any re-placement)
+    by_replica: Optional[Dict[int, Dict[str, Any]]] = None
 
     def to_dict(self, include_requests: bool = False) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -134,6 +153,8 @@ class LoadReport:
         }
         if self.by_priority is not None:
             d["by_priority"] = self.by_priority
+        if self.by_replica is not None:
+            d["by_replica"] = self.by_replica
         if include_requests:
             d["per_request"] = self.per_request
         return d
@@ -170,8 +191,14 @@ class PoissonLoadGenerator:
         the config seed and the engine's vocab size)."""
         cfg = self.config
         rng = np.random.default_rng(cfg.seed)
-        arrivals = np.cumsum(
-            rng.exponential(1.0 / cfg.rate_rps, cfg.n_requests))
+        gaps = rng.exponential(1.0 / cfg.rate_rps, cfg.n_requests)
+        if cfg.burst_rate_rps is not None and cfg.burst_fraction > 0.0:
+            bursty = rng.random(cfg.n_requests) < cfg.burst_fraction
+            gaps = np.where(
+                bursty,
+                rng.exponential(1.0 / cfg.burst_rate_rps,
+                                cfg.n_requests), gaps)
+        arrivals = np.cumsum(gaps)
         vocab = int(self.frontend.engine.cfg.vocab_size)
         plo, phi = _span(cfg.prompt_len)
         nlo, nhi = _span(cfg.max_new_tokens)
@@ -204,15 +231,28 @@ class PoissonLoadGenerator:
 
     def run(self) -> LoadReport:
         cfg = self.config
+        if cfg.kill_replica is not None \
+                and not hasattr(self.frontend.engine, "kill_replica"):
+            raise ValueError(
+                "kill_replica is a fleet scenario — the frontend must "
+                "drive an EngineRouter")
         plan = self.plan()
         handles: List[Optional[RequestHandle]] = [None] * len(plan)
         t0 = self._clock()
         next_up = 0
+        killed = False
         while True:
             now = self._clock() - t0
             while next_up < len(plan) and plan[next_up].at <= now:
                 handles[next_up] = self._submit(plan[next_up])
                 next_up += 1
+            if (cfg.kill_replica is not None and not killed
+                    and next_up >= cfg.kill_after_requests):
+                # deterministic chaos: the kill fires at a submission
+                # count, never at a wall-clock time
+                self.frontend.engine.kill_replica(
+                    cfg.kill_replica, reason="loadgen scripted kill")
+                killed = True
             # deterministic mid-stream cancellations: fire once the
             # request has streamed cancel_after_tokens tokens
             for h, p in zip(handles, plan):
@@ -249,12 +289,29 @@ class PoissonLoadGenerator:
             id(h): p.priority for h, p in zip(handles, plan)
             if h is not None}
         by_prio: Dict[int, Dict[str, Any]] = {}
+        eng = self.frontend.engine
+        replica_of = getattr(eng, "replica_of", None)
+        by_rep: Dict[int, Dict[str, Any]] = {}
         for h in handles:
             if h is None:
                 continue
             counts[h.state] += 1
             k = h.n_streamed
             total_tokens += k
+            if replica_of is not None and h.req_id is not None:
+                ridx = replica_of(h.req_id)
+                if ridx is not None:
+                    rc = by_rep.setdefault(ridx, {
+                        "n": 0, "finished": 0, "cancelled": 0,
+                        "timed_out": 0, "tokens": 0})
+                    rc["n"] += 1
+                    rc["tokens"] += k
+                    for st, key in (
+                            (RequestState.FINISHED, "finished"),
+                            (RequestState.CANCELLED, "cancelled"),
+                            (RequestState.TIMED_OUT, "timed_out")):
+                        if h.state is st:
+                            rc[key] += 1
             prio = prio_of.get(id(h), 0)
             pc = by_prio.setdefault(prio, {
                 "n": 0, "finished": 0, "rejected": 0, "cancelled": 0,
@@ -311,4 +368,6 @@ class PoissonLoadGenerator:
             goodput_tokens_per_s=good_tokens / duration,
             slo={"ttft_s": cfg.slo_ttft_s, "tpot_s": cfg.slo_tpot_s},
             kv_leaks=self.frontend.engine.kv_leak_report(),
-            per_request=per_req, by_priority=by_priority)
+            per_request=per_req, by_priority=by_priority,
+            by_replica={k: by_rep[k] for k in sorted(by_rep)}
+            if by_rep else None)

@@ -60,6 +60,8 @@ serve.resilience.spilled_bytes            gauge      host-RAM KV spill tier size
 serve.resilience.spilled_requests         gauge      requests currently spilled
 serve.resilience.preempt_save_secs        histogram  snapshot+spill latency
 serve.resilience.preempt_restore_secs     histogram  restore-into-fresh-blocks latency
+serve.resilience.spill_evictions_total    counter    snapshots evicted by the bounded tier
+serve.resilience.prefix_replays_total     counter    demoted requests replayed from prefix
 serve.resilience.transient_retries_total  counter    retried transient step faults
 serve.resilience.slow_steps_total         counter    steps past the slow-step budget
 serve.resilience.crashes_total            counter    declared engine crashes
@@ -68,6 +70,28 @@ serve.resilience.replayed_requests_total  counter    requests replayed across cr
 serve.resilience.recovery_secs            histogram  teardown->replayed latency
 serve.resilience.circuit_open_total       counter    recoveries refused (breaker open)
 ========================================  =========  ==================
+
+Fleet rows (``serve.fleet.*``, live only when the front-end drives an
+``EngineRouter``; counters recorded by ``serving/fleet.py``, gauges
+refreshed here per scheduler iteration from ``fleet_stats()``;
+docs/serving.md).  The per-replica ``serve.*`` state rolls up into the
+fleet gauges — one flight-ring dump shows the whole fleet's health at
+the crash:
+
+==========================================  =========  ==============
+serve.fleet.replicas                        gauge      fleet size (incl. dead)
+serve.fleet.healthy / degraded /            gauge      health census by state
+  draining / dead
+serve.fleet.queue_depth                     gauge      summed replica queues
+serve.fleet.batch_occupancy                 gauge      mean over live replicas
+serve.fleet.kv_utilization                  gauge      aggregate pool pressure
+serve.fleet.placements_total                counter    requests placed
+serve.fleet.replacements_total              counter    cross-replica re-placements
+serve.fleet.snapshot_migrations_total       counter    re-placements that moved KV bytes
+serve.fleet.rebalanced_total                counter    stuck waiters migrated
+serve.fleet.replica_deaths_total            counter    replicas declared dead
+serve.fleet.drains_total                    counter    graceful drains started
+==========================================  =========  ==============
 
 Every recording entry point checks ``registry.enabled`` first, so a
 front-end without telemetry pays one branch per call (the PR 5
@@ -193,3 +217,15 @@ class ServeMetrics:
                 res["spilled_bytes"])
             self._reg.gauge("serve.resilience.spilled_requests").set(
                 res["spilled_requests"])
+        fleet = engine.fleet_stats() \
+            if hasattr(engine, "fleet_stats") else None
+        if fleet is not None:
+            g = self._reg.gauge
+            g("serve.fleet.replicas").set(fleet["replicas"])
+            for state in ("healthy", "degraded", "draining", "dead"):
+                g(f"serve.fleet.{state}").set(fleet[state])
+            g("serve.fleet.queue_depth").set(fleet["queue_depth"])
+            g("serve.fleet.batch_occupancy").set(
+                fleet["batch_occupancy"])
+            g("serve.fleet.kv_utilization").set(
+                fleet["kv_utilization"])

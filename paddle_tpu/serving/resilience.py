@@ -60,10 +60,10 @@ import numpy as np
 from ..observability import REGISTRY
 
 __all__ = [
-    "EngineCrashError", "KVSnapshot", "RecoveryExhaustedError",
-    "ResilienceError", "RetryPolicy", "SpillCorruptError",
-    "SupervisedEngine", "TransientStepError", "restore_into_slot",
-    "snapshot_slot",
+    "EngineCrashError", "KVSnapshot", "PortableRequest",
+    "RecoveryExhaustedError", "ResilienceError", "RetryPolicy",
+    "SpillCorruptError", "SpillTier", "SupervisedEngine",
+    "TransientStepError", "restore_into_slot", "snapshot_slot",
 ]
 
 
@@ -146,16 +146,22 @@ def snapshot_slot(engine, slot: int) -> KVSnapshot:
     CRC-stamp them.  Only pages holding committed positions
     (``ceil(length / block_size)``) are copied — pages reserved for the
     not-yet-generated tail carry no state worth saving (any stale bytes
-    there are masked by ``lengths`` exactly as on a fresh slot)."""
-    import jax
-    import jax.numpy as jnp
+    there are masked by ``lengths`` exactly as on a fresh slot).
+
+    The gather runs HOST-side (one pool transfer + numpy indexing)
+    rather than as a traced ``pool[:, idx]``: a device gather is an
+    op-by-op backend compile per distinct page count, which would break
+    the fleet's zero-compile contract (``fleet_warm`` budget row) the
+    first time a drain spilled an unseen length.  Spill/restore are
+    rare, host-bound control-plane events; the extra copy is the cheap
+    side of that trade."""
     req = engine.slots[slot]
     length = int(engine.lengths[slot])
     used = -(-length // engine.BS)
     pages = engine.slot_pages[slot]
-    idx = jnp.asarray(np.asarray(pages[:used], np.int32))
-    k = np.asarray(jax.device_get(engine.pool_k[:, idx]))
-    v = np.asarray(jax.device_get(engine.pool_v[:, idx]))
+    idx = np.asarray(pages[:used], np.int64)
+    k = np.asarray(engine.pool_k)[:, idx].copy()
+    v = np.asarray(engine.pool_v)[:, idx].copy()
     return KVSnapshot(req_id=req.req_id, length=length,
                       next_token=int(engine.tokens[slot]),
                       num_blocks=len(pages), k_pages=k, v_pages=v)
@@ -166,16 +172,108 @@ def restore_into_slot(engine, slot: int, snap: KVSnapshot) -> None:
     freshly acquired blocks (``engine.slot_pages[slot]``).  The
     device→host→device round trip preserves bytes exactly, so decode
     resumed from the restored pages is bit-identical to one that was
-    never preempted."""
+    never preempted.  Host-side scatter for the same zero-compile
+    reason as :func:`snapshot_slot`."""
     import jax.numpy as jnp
     snap.verify()
     used = snap.k_pages.shape[1]
-    pages = jnp.asarray(
-        np.asarray(engine.slot_pages[slot][:used], np.int32))
-    engine.pool_k = engine.pool_k.at[:, pages].set(
-        jnp.asarray(snap.k_pages))
-    engine.pool_v = engine.pool_v.at[:, pages].set(
-        jnp.asarray(snap.v_pages))
+    pages = np.asarray(engine.slot_pages[slot][:used], np.int64)
+    pk = np.asarray(engine.pool_k).copy()
+    pv = np.asarray(engine.pool_v).copy()
+    pk[:, pages] = snap.k_pages
+    pv[:, pages] = snap.v_pages
+    # jnp.array (owned copy), NOT jax.device_put/jnp.asarray: both can
+    # zero-copy ALIAS the numpy buffer on CPU, and the decode step
+    # DONATES the pools — XLA reusing memory numpy still owns is a
+    # use-after-free.  The copy runs through a pool-shaped
+    # convert_element_type executable that the engine pre-warms at
+    # construction, so restores under traffic stay at zero backend
+    # compiles (fleet_warm budget row).
+    engine.pool_k = jnp.array(pk)
+    engine.pool_v = jnp.array(pv)
+
+
+# ---------------------------------------------------------------------
+# bounded host-RAM spill tier (ISSUE 12 satellite)
+# ---------------------------------------------------------------------
+class SpillTier:
+    """Bounded host-RAM store for spilled :class:`KVSnapshot` objects,
+    shared by priority preemption and graceful drain.
+
+    Host RAM is a real resource: a saturated fleet preempting
+    long-context requests could otherwise grow the spill tier without
+    limit until the OS kills the serving process — a worse failure than
+    the one preemption avoids.  ``capacity_bytes`` caps the tier;
+    inserting past the cap EVICTS snapshots (``policy="evict-oldest"``
+    — the snapshot spilled longest ago is the one whose request has
+    waited longest and is cheapest to recompute relative to its wait).
+    An evicted request is NOT lost: it is demoted to
+    **replay-from-prefix** — the engine's admission path detects a
+    queued request with committed tokens but no snapshot and recomputes
+    its KV from the committed token prefix (bit-identical, just paid in
+    prefill FLOPs instead of host bytes).  Every eviction is a typed
+    ``spill_evict`` event plus the
+    ``serve.resilience.spill_evictions_total`` counter.
+
+    The dict-like surface (``tier[rid]``, ``rid in tier``, ``pop``,
+    ``del``) keeps the engine's bookkeeping unchanged; only the
+    capacity-checked :meth:`put` differs from a plain dict.
+    """
+
+    def __init__(self, capacity_bytes: Optional[int] = None,
+                 policy: str = "evict-oldest"):
+        if policy != "evict-oldest":
+            raise ValueError(f"unknown spill policy {policy!r} "
+                             "(have: evict-oldest)")
+        if capacity_bytes is not None and capacity_bytes < 0:
+            raise ValueError("capacity_bytes must be >= 0 or None")
+        self.capacity_bytes = capacity_bytes
+        self.policy = policy
+        self._snaps: "collections.OrderedDict[int, KVSnapshot]" = \
+            collections.OrderedDict()
+        self.evictions = 0
+
+    @property
+    def nbytes(self) -> int:
+        return sum(s.nbytes for s in self._snaps.values())
+
+    def put(self, req_id: int, snap: KVSnapshot) -> list:
+        """Insert a snapshot; returns the req_ids EVICTED to make room
+        (possibly including ``req_id`` itself when one snapshot alone
+        exceeds the cap).  The caller demotes evicted requests to
+        replay-from-prefix and records the typed event."""
+        self._snaps[req_id] = snap
+        evicted = []
+        if self.capacity_bytes is not None:
+            while self._snaps and self.nbytes > self.capacity_bytes:
+                rid, _ = self._snaps.popitem(last=False)
+                evicted.append(rid)
+                self.evictions += 1
+        return evicted
+
+    def get(self, req_id: int, default=None):
+        return self._snaps.get(req_id, default)
+
+    def pop(self, req_id: int, *default):
+        return self._snaps.pop(req_id, *default)
+
+    def values(self):
+        return self._snaps.values()
+
+    def keys(self):
+        return self._snaps.keys()
+
+    def __getitem__(self, req_id: int) -> KVSnapshot:
+        return self._snaps[req_id]
+
+    def __delitem__(self, req_id: int) -> None:
+        del self._snaps[req_id]
+
+    def __contains__(self, req_id: int) -> bool:
+        return req_id in self._snaps
+
+    def __len__(self) -> int:
+        return len(self._snaps)
 
 
 # ---------------------------------------------------------------------
@@ -228,6 +326,31 @@ class _Tracked:
     priority: int
     inner: object = None
     base: int = 0               # outer tokens committed before replay
+
+
+@dataclass
+class PortableRequest:
+    """A live request lifted OUT of one supervised engine so another
+    replica can carry it (the fleet router's re-placement currency —
+    ``serving/fleet.py``).  ``out`` is the committed token prefix the
+    consumer has already (or could have) seen; ``snapshot`` is the
+    CRC-checked KV page bytes when the source replica was healthy
+    enough to spill them (page bytes are replica-agnostic: any engine
+    with the same geometry can scatter them into fresh blocks), or
+    None — in which case the target replays from the committed token
+    prefix instead (bit-identical either way, the snapshot just saves
+    the prefill recompute)."""
+
+    prompt: np.ndarray
+    out: list
+    kwargs: Dict[str, object]
+    max_new: int
+    priority: int
+    snapshot: Optional[KVSnapshot] = None
+
+    @property
+    def done(self) -> bool:
+        return len(self.out) >= self.max_new
 
 
 class _DeadEngine:
@@ -463,21 +586,113 @@ class SupervisedEngine:
             raise AttributeError(name)
         return getattr(self.engine, name)
 
+    # -- cross-replica re-placement surface (serving/fleet.py) ----------
+    def extract_request(self, req_id: int) -> Optional[PortableRequest]:
+        """Lift a live request out of this engine for re-placement on
+        another replica (the fleet router's drain/rebalance path).
+
+        A RUNNING request is preempted first — its committed KV pages
+        spill through the ordinary CRC-checked snapshot path — so the
+        returned :class:`PortableRequest` carries the page bytes and
+        the target replica can restore them instead of recomputing.
+        The request stops existing here (no terminal state is
+        delivered); the caller owns its continuation.  Returns None
+        for unknown / already-finished ids (a pending synthesized
+        result is NOT extractable — collect it from ``step()``)."""
+        t = self._tracked.pop(req_id, None)
+        if t is None:
+            return None
+        self._bridge(t)                 # fold any unabsorbed tokens in
+        eng = self.engine
+        for slot in range(eng.B):
+            r = eng.slots[slot]
+            if r is not None and r.req_id == req_id:
+                eng.preempt(slot)       # snapshot committed KV first
+                break
+        snap = eng._spill.pop(req_id, None)
+        eng.cancel(req_id)              # queued by now; frees nothing
+        return PortableRequest(
+            prompt=t.req.prompt, out=list(t.req.out),
+            kwargs=dict(t.kwargs), max_new=t.max_new,
+            priority=t.priority, snapshot=snap)
+
+    def adopt_request(self, portable: PortableRequest) -> int:
+        """Admit a request extracted from ANOTHER replica, resuming it
+        under a fresh id in this supervisor's id space.
+
+        With a KV snapshot (same pool geometry — all replicas of one
+        fleet are built from one factory), the page bytes are seeded
+        into this engine's spill tier and admission restores them into
+        fresh blocks exactly as if the preemption had happened here: no
+        recompute, bit-identical.  Without one, the request is replayed
+        from its committed token prefix (the crash-recovery machinery's
+        path — also bit-identical)."""
+        kw = portable.kwargs
+        snap = portable.snapshot
+        if snap is not None and self.engine.spill_compatible(snap):
+            from ..inference.serving import GenRequest
+            rid = self._next_outer_id
+            self._next_outer_id += 1
+            req = GenRequest(
+                rid, portable.prompt, portable.max_new,
+                kw["eos_token_id"], temperature=kw["temperature"],
+                top_k=kw["top_k"], top_p=kw["top_p"], seed=kw["seed"],
+                priority=portable.priority)
+            req.out = [int(x) for x in portable.out]
+            if kw["eos_token_id"] is not None \
+                    and kw["eos_token_id"] in req.out:
+                # keep the retire contract for a committed eos the
+                # source had not retired yet
+                req.eos_pos = req.out.index(kw["eos_token_id"])
+            snap.req_id = rid           # re-keyed to this id space
+            self.engine.adopt_preempted(req, snap)
+            self._tracked[rid] = _Tracked(
+                req=req, kwargs=dict(kw), max_new=portable.max_new,
+                priority=portable.priority, inner=req)
+            return rid
+        committed = np.concatenate(
+            [portable.prompt, np.asarray(portable.out, np.int32)]) \
+            if portable.out else portable.prompt
+        return self.add_request(
+            committed, portable.max_new - len(portable.out),
+            kw["eos_token_id"], temperature=kw["temperature"],
+            top_k=kw["top_k"], top_p=kw["top_p"], seed=kw["seed"],
+            priority=portable.priority)
+
+    def take_pending_result(self, req_id: int) -> Optional[np.ndarray]:
+        """Pop a terminal result synthesized during a recovery but not
+        yet delivered through ``step()`` (the drain path collects these
+        directly instead of extracting a request that no longer
+        exists)."""
+        return self._pending_finished.pop(req_id, None)
+
+    def tracked_request(self, req_id: int):
+        """The live outer ``GenRequest`` for ``req_id`` (tokens
+        accumulate here across this engine's internal crash replays),
+        or None once terminal."""
+        t = self._tracked.get(req_id)
+        return None if t is None else t.req
+
     # -- internals ------------------------------------------------------
+    def _bridge(self, t: _Tracked) -> None:
+        """Fold a replayed request's fresh inner tokens into its outer
+        object (no-op before any crash, when inner IS the outer)."""
+        if t.inner is t.req:
+            return
+        bridged = len(t.req.out) - t.base
+        new = t.inner.out[bridged:]
+        if new:
+            t.req.out.extend(int(x) for x in new)
+        if t.inner.eos_pos is not None and t.req.eos_pos is None:
+            t.req.eos_pos = t.base + t.inner.eos_pos
+
     def _absorb(self, finished: Dict[int, np.ndarray]
                 ) -> Dict[int, np.ndarray]:
         """Bridge replayed requests' fresh tokens into the outer
         request objects and translate finished ids back to the
         caller's originals."""
         for t in self._tracked.values():
-            if t.inner is t.req:
-                continue
-            bridged = len(t.req.out) - t.base
-            new = t.inner.out[bridged:]
-            if new:
-                t.req.out.extend(int(x) for x in new)
-            if t.inner.eos_pos is not None and t.req.eos_pos is None:
-                t.req.eos_pos = t.base + t.inner.eos_pos
+            self._bridge(t)
         out: Dict[int, np.ndarray] = {}
         for rid, t in list(self._tracked.items()):
             # inner requests are re-keyed to their outer ids at

@@ -40,6 +40,14 @@ engine misbehaves while the rest of the process keeps working:
 * :func:`crash_mid_speculation` — raises inside the spec-decode
   draft/verify round.
 
+Fleet side (ISSUE 12, ``serving/fleet.py``):
+
+* :func:`kill_replica_after_steps` — kill one router replica at a
+  deterministic fleet-step count (mid-stream re-placement).
+* :func:`persistent_replica_crash` — a replica that crashes on every
+  step, rebuilds included, until its circuit breaker opens — the
+  organic ``RecoveryExhaustedError`` death the router absorbs.
+
 The serve exceptions are ordinary ``Exception`` subclasses (unlike
 :class:`SimulatedCrash`): a supervisor is SUPPOSED to catch and recover
 from them, while the checkpoint kill must never be swallowed.
@@ -56,8 +64,9 @@ from paddle_tpu.framework import io as fio
 __all__ = ["InjectedEngineCrash", "SimulatedCrash", "corrupt_file",
            "crash_mid_prefill", "crash_mid_speculation",
            "crash_mid_write", "exhaust_kv_pool", "fail_replace",
-           "fail_step_n", "slow_steps", "transient_step_faults",
-           "truncate_file"]
+           "fail_step_n", "kill_replica_after_steps",
+           "persistent_replica_crash", "slow_steps",
+           "transient_step_faults", "truncate_file"]
 
 
 class SimulatedCrash(BaseException):
@@ -231,6 +240,62 @@ def slow_steps(engine, extra_s: float, n: int = 1):
     finally:
         if getattr(engine, "step", None) is patched:
             engine.step = real
+
+
+# ---------------------------------------------------------------------
+# fleet chaos injectors (ISSUE 12)
+# ---------------------------------------------------------------------
+@contextlib.contextmanager
+def kill_replica_after_steps(router, idx: int, n: int):
+    """Kill fleet replica ``idx`` after the router's ``n``-th
+    ``step()`` call (1-based) — a replica dying MID-STREAM, the fleet
+    analogue of :func:`fail_step_n`.  Deterministic: the trigger is a
+    step count, never wall clock.  Yields a stats dict
+    (``stats['killed']``)."""
+    real = router.step
+    stats = {"calls": 0, "killed": 0}
+
+    def patched():
+        stats["calls"] += 1
+        if stats["calls"] == n:
+            stats["killed"] += 1
+            router.kill_replica(idx, reason=f"injected kill at fleet "
+                                            f"step {n}")
+        return real()
+
+    router.step = patched
+    try:
+        yield stats
+    finally:
+        if getattr(router, "step", None) is patched:
+            router.step = real
+
+
+def persistent_replica_crash(sup, *, exc_type=InjectedEngineCrash):
+    """Make a supervised replica crash on every step FOREVER: the
+    current engine faults, and the supervisor's rebuild factory is
+    wrapped so every fresh engine faults too — the supervisor burns
+    through its restart budget until the circuit breaker opens
+    (``RecoveryExhaustedError``), the organic replica-death path the
+    fleet router must absorb.  Returns a stats dict
+    (``stats['crashes']``).  Permanently poisons the supervisor (this
+    models a dead host, not a transient)."""
+    stats = {"crashes": 0}
+
+    def boom():
+        stats["crashes"] += 1
+        raise exc_type("persistent injected fault")
+
+    real_factory = sup._factory
+
+    def crashing_factory():
+        eng = real_factory()
+        eng.step = boom
+        return eng
+
+    sup.engine.step = boom
+    sup._factory = crashing_factory
+    return stats
 
 
 @contextlib.contextmanager

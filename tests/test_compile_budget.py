@@ -71,6 +71,16 @@ def test_recovery_warm_is_zero_compiles(measured):
     assert measured["serve_recovery_warm"] == 0, measured
 
 
+def test_fleet_warm_is_zero_compiles(measured):
+    """ISSUE 12 acceptance: an EngineRouter whose replicas all load
+    the same AOT artifact generation — fleet cold-start, greedy AND
+    sampled traffic, a replica kill with cross-replica re-placement,
+    add_replica, and a graceful drain with KV-snapshot transplant —
+    performs zero backend compiles.  Fleet operations must never trace
+    under traffic."""
+    assert measured["fleet_warm"] == 0, measured
+
+
 def test_every_scenario_has_a_budget(measured):
     budgets = compile_budget.load_ledger()["budgets"]
     assert set(measured) <= set(budgets), (set(measured), set(budgets))

@@ -1,0 +1,725 @@
+"""Fleet-level resilience (ISSUE 12): the health-checked multi-replica
+router, cross-replica re-placement, graceful drain, the bounded spill
+tier, and fleet chaos.
+
+Load-bearing contracts (tier-1):
+
+* the router duck-types the engine surface — ``ServingFrontend`` and
+  the loadgen drive a fleet unchanged, and per-request results are
+  BIT-IDENTICAL to a solo run (placement must never change tokens);
+* a replica killed mid-stream re-places every live request onto a
+  healthy replica and replays from the committed token prefix —
+  greedy, sampled, and mid-speculation streams all bit-identical,
+  gap-free, duplicate-free;
+* graceful drain stops placement, moves live requests (KV snapshots
+  transplant — no recompute), tears the replica down with a ZERO
+  KV-leak report, and the drained replica takes no further traffic;
+* admission rejects only when NO healthy replica can admit;
+  all-replicas-dead escalates typed into the front-end's abort-all;
+* the bounded SpillTier evicts oldest under its byte cap and the
+  evicted request is demoted to replay-from-prefix, bit-identically;
+* fleet chaos (scripted replica kill under mixed-priority bursty
+  Poisson load) drains with zero leaked blocks on every surviving
+  replica and intact streams, reproducibly.
+"""
+
+import numpy as np
+import pytest
+
+import faults
+import jax
+
+from paddle_tpu import parallel as dist
+from paddle_tpu.inference.serving import ContinuousBatchingEngine
+from paddle_tpu.models.llama import build_llama_train_step, llama_tiny
+from paddle_tpu.observability import REGISTRY
+from paddle_tpu.parallel.topology import HybridTopology, set_topology
+from paddle_tpu.serving import (AdmissionConfig, EngineRouter,
+                                FleetExhaustedError, LoadGenConfig,
+                                PoissonLoadGenerator, ReplicaState,
+                                RequestAborted, RequestState, RetryPolicy,
+                                ServingFrontend, SpillTier)
+from paddle_tpu.spec_decode import SpecDecodeConfig
+
+rng = np.random.default_rng(12)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama_tiny()
+    topo = dist.init_topology(devices=jax.devices()[:1])
+    _, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1)
+    params = init_fn(0)["params"]
+    set_topology(HybridTopology())
+    return cfg, params
+
+
+def _factory(model, **kw):
+    cfg, params = model
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("prefill_buckets", (8,))
+
+    def factory():
+        return ContinuousBatchingEngine(cfg, params, **kw)
+
+    return factory
+
+
+def _router(model, n=2, *, policy=None, admission=None, factory=None,
+            **kw):
+    f = factory or _factory(model, **kw)
+    return EngineRouter([f] * n,
+                        policy=policy or RetryPolicy(backoff_base_s=0.0),
+                        admission=admission, sleep=lambda s: None)
+
+
+def _prompt(model, n):
+    return rng.integers(0, model[0].vocab_size, (n,)).astype(np.int32)
+
+
+def _solo_result(model, prompt, max_new, **kw):
+    """The request's tokens run alone on a roomy engine — the
+    bit-identity anchor every fleet path is compared against."""
+    eng = _factory(model, max_batch=1, num_blocks=64)()
+    rid = eng.add_request(prompt, max_new, **kw)
+    return eng.run_to_completion()[rid]
+
+
+def _assert_no_leaks(router):
+    rep = router.kv_leak_report()
+    assert rep["leaked"] == 0 and rep["unaccounted"] == 0, rep
+    for idx, final in router.fleet_stats()["drain_reports"].items():
+        assert final["leaked"] == 0 and final["unaccounted"] == 0, \
+            (idx, final)
+
+
+# ---------------------------------------------------------------------
+# placement + admission
+# ---------------------------------------------------------------------
+def test_fleet_bit_identical_to_solo(model):
+    """Placement spreads work across replicas without changing a single
+    token (greedy AND sampled)."""
+    prompts = [_prompt(model, n) for n in (9, 10, 7, 12)]
+    kw = [dict(), dict(temperature=0.8, top_k=8, seed=11), dict(),
+          dict(temperature=0.9, top_k=6, seed=5)]
+    want = [_solo_result(model, p, 8, **k) for p, k in zip(prompts, kw)]
+    router = _router(model, n=2)
+    rids = [router.add_request(p, 8, **k) for p, k in zip(prompts, kw)]
+    res = router.run_to_completion()
+    for rid, w in zip(rids, want):
+        np.testing.assert_array_equal(res[rid], w)
+    # both replicas actually served
+    used = {router.replica_of(rid) for rid in rids}
+    assert used == {0, 1}, used
+    assert router.stats["placements"] == 4
+    _assert_no_leaks(router)
+
+
+def test_least_loaded_placement_prefers_idle_replica(model):
+    """A saturated replica stops receiving new work while an idle one
+    exists (KV-aware least-loaded)."""
+    router = _router(model, n=2)
+    # four requests fill replica-0 and replica-1 evenly (2 slots each);
+    # submit them one by one and check alternating placement
+    rids = [router.add_request(_prompt(model, 8), 6) for _ in range(4)]
+    reps = [router.replica_of(r) for r in rids]
+    assert sorted(reps) == [0, 0, 1, 1], reps
+    assert reps[0] != reps[1], reps        # second went to the idle one
+    router.run_to_completion()
+    _assert_no_leaks(router)
+
+
+def test_admission_rejects_only_when_no_replica_admits(model):
+    """With one replica past the queue bound and one below it, the
+    fleet still admits; only when EVERY placeable replica fails the
+    check does submit reject (typed, via the front-end)."""
+    router = _router(model, n=2,
+                     admission=AdmissionConfig(max_queue_len=2))
+    fe = ServingFrontend(router)
+    # the per-replica bound is 2 waiting requests; least-loaded
+    # placement balances, so submits 3 and 4 land on the replica still
+    # UNDER the bound (reject-only-when-none-admits), and submit 5
+    # finds both at the bound
+    handles = [fe.submit(_prompt(model, 8), 4) for _ in range(4)]
+    assert all(h.state is not RequestState.REJECTED for h in handles)
+    assert {router.replica_of(h.req_id) for h in handles} == {0, 1}
+    h = fe.submit(_prompt(model, 8), 4)
+    assert h.state is RequestState.REJECTED
+    assert "no healthy replica" in h.reason
+    fe.run_until_drained(timeout_s=120)
+    _assert_no_leaks(router)
+
+
+def test_malformed_requests_still_raise(model):
+    router = _router(model, n=2)
+    with pytest.raises(ValueError):
+        router.add_request(np.zeros((0,), np.int32), 4)
+    with pytest.raises(ValueError):
+        router.add_request(_prompt(model, 4), 0)
+
+
+# ---------------------------------------------------------------------
+# replica death: cross-replica re-placement
+# ---------------------------------------------------------------------
+def test_replica_kill_mid_stream_bit_identity_greedy(model):
+    p1, p2, p3 = _prompt(model, 9), _prompt(model, 10), _prompt(model, 7)
+    want = [_solo_result(model, p, 10) for p in (p1, p2, p3)]
+    router = _router(model, n=2)
+    rids = [router.add_request(p, 10) for p in (p1, p2, p3)]
+    router.step()
+    router.step()
+    victim = router._placements[rids[0]].replica
+    router.kill_replica(victim, "chaos")
+    assert router.replica_state(victim) is ReplicaState.DEAD
+    res = router.run_to_completion()
+    for rid, w in zip(rids, want):
+        np.testing.assert_array_equal(res[rid], w)
+    assert router.stats["deaths"] == 1
+    assert router.stats["replacements"] >= 1
+    _assert_no_leaks(router)
+
+
+def test_replica_kill_mid_stream_bit_identity_sampled(model):
+    """Sampled streams re-place bit-identically: the sampler is keyed
+    by (seed, absolute position), both invariant under replay on a
+    different replica."""
+    p1 = _prompt(model, 9)
+    kw = dict(temperature=0.9, top_k=6, seed=321)
+    want = _solo_result(model, p1, 12, **kw)
+    router = _router(model, n=2)
+    a = router.add_request(p1, 12, **kw)
+    router.step()
+    router.step()
+    router.kill_replica(router._placements[a].replica, "chaos")
+    res = router.run_to_completion()
+    np.testing.assert_array_equal(res[a], want)
+    _assert_no_leaks(router)
+
+
+def test_replica_kill_mid_speculation_bit_identity(model):
+    """Killing a SPECULATING replica mid-round re-places from the last
+    committed prefix — the resumed stream equals the uninjected
+    speculative run (itself pinned == baseline)."""
+    cfg, params = model
+
+    def spec_factory():
+        return ContinuousBatchingEngine(
+            cfg, params, max_batch=2, block_size=8, num_blocks=64,
+            prefill_buckets=(8,),
+            spec_config=SpecDecodeConfig(draft_cfg=cfg,
+                                         draft_params=params,
+                                         k=3, window=12))
+
+    p1 = _prompt(model, 9)
+    want = _solo_result(model, p1, 10)
+    router = EngineRouter([spec_factory, spec_factory],
+                          policy=RetryPolicy(backoff_base_s=0.0),
+                          sleep=lambda s: None)
+    a = router.add_request(p1, 10)
+    router.step()                           # admitted + first spec round
+    router.kill_replica(router._placements[a].replica, "chaos")
+    res = router.run_to_completion()
+    np.testing.assert_array_equal(res[a], want)
+    _assert_no_leaks(router)
+
+
+def test_organic_replica_death_via_circuit_breaker(model):
+    """A replica whose supervisor exhausts its restart budget raises
+    RecoveryExhaustedError inside router.step(); the router absorbs it
+    as a death and the stream finishes on the survivor."""
+    p1 = _prompt(model, 9)
+    want = _solo_result(model, p1, 10)
+    router = _router(model, n=2,
+                     policy=RetryPolicy(backoff_base_s=0.0,
+                                        max_restarts=1))
+    a = router.add_request(p1, 10)
+    router.step()
+    victim = router._placements[a].replica
+    faults.persistent_replica_crash(router.replicas[victim].sup)
+    res = router.run_to_completion()
+    assert router.replica_state(victim) is ReplicaState.DEAD
+    assert router.stats["deaths"] == 1
+    np.testing.assert_array_equal(res[a], want)
+    _assert_no_leaks(router)
+
+
+def test_frontend_stream_seamless_across_replica_kill(model):
+    """Front-end consumers see ONE gap-free in-order stream across a
+    replica death (the fleet analogue of the ISSUE 11 seamless-crash
+    pin)."""
+    p1 = _prompt(model, 9)
+    want = _solo_result(model, p1, 10)
+    router = _router(model, n=2)
+    fe = ServingFrontend(router)
+    h = fe.submit(p1, 10)
+    it = iter(h)
+    got = [next(it), next(it)]
+    router.kill_replica(router._placements[h.req_id].replica, "chaos")
+    got.extend(it)
+    assert h.state is RequestState.FINISHED
+    np.testing.assert_array_equal(np.asarray(got, np.int32),
+                                  want[len(p1):])
+    np.testing.assert_array_equal(h.result(), want)
+    _assert_no_leaks(router)
+
+
+def test_all_replicas_dead_aborts_all_streams_typed(model):
+    """The last replica dying lands in the front-end's typed abort-all
+    path: every live handle gets a terminal state, no consumer hangs."""
+    router = _router(model, n=2)
+    fe = ServingFrontend(router)
+    h = fe.submit(_prompt(model, 9), 8)
+    fe.step()
+    router.kill_replica(0, "chaos-0")
+    with pytest.raises(FleetExhaustedError):
+        router.kill_replica(1, "chaos-1")
+    with pytest.raises((FleetExhaustedError, RequestAborted)):
+        fe.run_until_drained(timeout_s=30)
+    assert h.state.terminal
+    with pytest.raises(RequestAborted):
+        h.result()
+
+
+def test_death_between_final_token_and_delivery_synthesizes(model):
+    """A replica dying after a request's budget is met but before the
+    result is delivered synthesizes the terminal result from the
+    committed prefix — no re-placement, no duplicate."""
+    p1 = _prompt(model, 9)
+    want = _solo_result(model, p1, 2)
+    router = _router(model, n=2)
+    a = router.add_request(p1, 2)
+    router.step()                     # prefill token 1
+    router.step()                     # decode token 2: budget met
+    # the request may already have retired; if it is still tracked its
+    # tokens are committed — kill now
+    if a in router._placements:
+        router.kill_replica(router._placements[a].replica, "kill")
+        res = router.run_to_completion()
+        assert router.stats["synthesized"] >= 1
+    else:
+        res = router.run_to_completion()
+    np.testing.assert_array_equal(
+        res[a] if a in res else want, want)
+
+
+# ---------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------
+def test_drain_replaces_live_requests_and_tears_down(model):
+    """drain(): placement stops, running requests spill and transplant
+    their KV snapshots to the survivor (no recompute), the drained
+    replica ends with a zero-leak report and takes no further
+    traffic."""
+    prompts = [_prompt(model, n) for n in (9, 10, 7, 12)]
+    want = [_solo_result(model, p, 8) for p in prompts]
+    router = _router(model, n=2)
+    rids = [router.add_request(p, 8) for p in prompts]
+    router.step()
+    router.step()
+    router.drain(0)
+    assert router.replica_state(0) is ReplicaState.DEAD
+    assert router.stats["drains"] == 1
+    assert router.stats["snapshot_migrations"] >= 1   # KV bytes moved
+    final = router.fleet_stats()["drain_reports"][0]
+    assert final["leaked"] == 0 and final["unaccounted"] == 0, final
+    # new traffic only lands on the survivor
+    extra = router.add_request(_prompt(model, 6), 4)
+    assert router.replica_of(extra) == 1
+    res = router.run_to_completion()
+    for rid, w in zip(rids, want):
+        np.testing.assert_array_equal(res[rid], w)
+    assert extra in res
+    _assert_no_leaks(router)
+
+
+def test_drain_run_out_mode_finishes_then_tears_down(model):
+    """run_out drain: live requests finish IN PLACE; teardown happens
+    once the replica runs dry, and placement stops immediately."""
+    p1 = _prompt(model, 9)
+    want = _solo_result(model, p1, 8)
+    router = _router(model, n=2)
+    a = router.add_request(p1, 8)
+    router.step()
+    src = router._placements[a].replica
+    router.drain(src, mode="run_out")
+    assert router.replica_state(src) is ReplicaState.DRAINING
+    b = router.add_request(_prompt(model, 7), 4)
+    assert router.replica_of(b) != src     # placement stopped
+    res = router.run_to_completion()
+    np.testing.assert_array_equal(res[a], want)
+    assert router.replica_state(src) is ReplicaState.DEAD
+    assert router.fleet_stats()["drain_reports"][src]["leaked"] == 0
+    _assert_no_leaks(router)
+
+
+def test_crash_during_drain_still_completes(model):
+    """A DRAINING replica dying mid-drain (run_out mode, persistent
+    fault) falls back to death re-placement: streams still finish
+    bit-identically on the survivor."""
+    p1 = _prompt(model, 9)
+    want = _solo_result(model, p1, 10)
+    router = _router(model, n=2,
+                     policy=RetryPolicy(backoff_base_s=0.0,
+                                        max_restarts=1))
+    a = router.add_request(p1, 10)
+    router.step()
+    src = router._placements[a].replica
+    router.drain(src, mode="run_out")
+    faults.persistent_replica_crash(router.replicas[src].sup)
+    res = router.run_to_completion()
+    assert router.replica_state(src) is ReplicaState.DEAD
+    assert router.stats["deaths"] == 1
+    np.testing.assert_array_equal(res[a], want)
+    _assert_no_leaks(router)
+
+
+def test_drain_of_budget_met_request_synthesizes(model):
+    """The engine retires at the START of the next step, so right
+    after a step a slot can hold a request whose budget is already met.
+    Draining that replica must synthesize its terminal result (there
+    is nothing left to run — adopting it would be a zero-budget
+    replay), not explode or duplicate."""
+    p1 = _prompt(model, 9)
+    want = _solo_result(model, p1, 2)
+    router = _router(model, n=2)
+    a = router.add_request(p1, 2)
+    router.step()                      # prefill: token 1
+    src = router._placements[a].replica
+    # drive ONLY the source replica so the router never absorbs the
+    # retire — the budget-met request still sits in its slot
+    router.replicas[src].sup.engine.step()
+    assert len(router._placements[a].obj.out) >= 2
+    router.drain(src)
+    res = router.run_to_completion()
+    assert router.stats["synthesized"] >= 1
+    np.testing.assert_array_equal(res[a], want)
+    _assert_no_leaks(router)
+
+
+def test_cannot_drain_last_live_replica(model):
+    router = _router(model, n=2)
+    router.drain(0)
+    with pytest.raises(ValueError, match="last live replica"):
+        router.drain(1)
+
+
+def test_rolling_restart_add_replica(model):
+    """The rolling-restart recipe: drain old, add fresh, drain the
+    other old — traffic never stops, every stream bit-identical."""
+    prompts = [_prompt(model, n) for n in (9, 10, 7)]
+    want = [_solo_result(model, p, 8) for p in prompts]
+    router = _router(model, n=2)
+    rids = [router.add_request(p, 8) for p in prompts]
+    router.step()
+    router.drain(0)
+    idx = router.add_replica(_factory(model))
+    assert idx == 2
+    router.step()
+    router.drain(1)
+    res = router.run_to_completion()
+    for rid, w in zip(rids, want):
+        np.testing.assert_array_equal(res[rid], w)
+    states = [router.replica_state(i) for i in range(3)]
+    assert states[:2] == [ReplicaState.DEAD, ReplicaState.DEAD]
+    assert states[2] in (ReplicaState.HEALTHY, ReplicaState.DEGRADED)
+    _assert_no_leaks(router)
+
+
+# ---------------------------------------------------------------------
+# health states + rebalancing
+# ---------------------------------------------------------------------
+def test_crash_degrades_then_heals(model):
+    """An intra-replica crash (absorbed by its supervisor) marks the
+    replica DEGRADED; enough clean steps heal it back to HEALTHY."""
+    router = _router(model, n=2)
+    router.heal_after_steps = 3
+    a = router.add_request(_prompt(model, 9), 12)
+    router.step()
+    victim = router._placements[a].replica
+    with faults.fail_step_n(router.replicas[victim].sup.engine, 1):
+        router.step()
+    assert router.replica_state(victim) is ReplicaState.DEGRADED
+    router.run_to_completion()
+    while router.replica_state(victim) is ReplicaState.DEGRADED:
+        router.step()                   # idle steps are clean steps
+    assert router.replica_state(victim) is ReplicaState.HEALTHY
+    _assert_no_leaks(router)
+
+
+def test_degraded_replica_only_takes_overflow(model):
+    """New work avoids a DEGRADED replica while a HEALTHY one can
+    admit, but a DEGRADED fleet still serves (degraded beats
+    rejected)."""
+    router = _router(model, n=2)
+    a = router.add_request(_prompt(model, 8), 10)
+    router.step()
+    victim = router._placements[a].replica
+    other = 1 - victim
+    with faults.fail_step_n(router.replicas[victim].sup.engine, 1):
+        router.step()
+    assert router.replica_state(victim) is ReplicaState.DEGRADED
+    rids = [router.add_request(_prompt(model, 6), 4) for _ in range(2)]
+    assert all(router.replica_of(r) == other for r in rids), \
+        [router.replica_of(r) for r in rids]
+    router.run_to_completion()
+    _assert_no_leaks(router)
+
+
+def test_rebalance_moves_stuck_spilled_request(model):
+    """Cross-replica re-placement of preempted/spilled work (ROADMAP
+    2(b)): a low-priority request preempted on a saturated replica
+    migrates — snapshot and all — to an idle replica instead of
+    waiting out the high-priority tenant."""
+    cfg, params = model
+    p_lo, p_hi = _prompt(model, 9), _prompt(model, 10)
+    want_lo = _solo_result(model, p_lo, 10)
+    # replica geometry too tight for two requests at once
+    small = _factory(model, max_batch=1, num_blocks=4)
+    router = EngineRouter([small, small],
+                          policy=RetryPolicy(backoff_base_s=0.0),
+                          sleep=lambda s: None)
+    a = router.add_request(p_lo, 10, priority=0)
+    router.step()
+    src = router._placements[a].replica
+    # a high-priority arrival on the SAME replica preempts the tenant
+    # (pin placement by saturating the other replica's queue view)
+    b = router.replicas[src].sup.add_request(p_hi, 8, priority=5)
+    rid_b = router._next_id
+    router._next_id += 1
+    from paddle_tpu.serving.fleet import _Placement
+    obj = router.replicas[src].sup.tracked_request(b)
+    router._placements[rid_b] = _Placement(
+        req=obj, kwargs=dict(eos_token_id=None, temperature=0.0,
+                             top_k=None, top_p=None, seed=0),
+        max_new=8, priority=5, blocks=router._blocks_needed(18),
+        replica=src, sid=b, obj=obj, base=0)
+    router._by_sid[(src, b)] = rid_b
+    router.step()                          # preemption fires on src
+    assert router.replicas[src].sup.resilience_stats()[
+        "preemptions"] >= 1
+    res = router.run_to_completion()
+    assert router.stats["rebalanced"] >= 1, router.stats
+    np.testing.assert_array_equal(res[a], want_lo)
+    assert rid_b in res
+    _assert_no_leaks(router)
+
+
+# ---------------------------------------------------------------------
+# bounded spill tier (satellite — fleet-shared)
+# ---------------------------------------------------------------------
+def test_spill_tier_eviction_demotes_to_replay(model):
+    """A SpillTier too small for the snapshot evicts it at preemption;
+    the demoted request replays from its committed token prefix on
+    re-admission — bit-identical, typed counter, no host-RAM growth."""
+    cfg, params = model
+    p_lo, p_hi = _prompt(model, 9), _prompt(model, 10)
+    want_lo = _solo_result(model, p_lo, 10)
+    tier = SpillTier(capacity_bytes=0)     # nothing fits: always demote
+    eng = ContinuousBatchingEngine(
+        cfg, params, max_batch=1, block_size=8, num_blocks=4,
+        prefill_buckets=(8,), spill_tier=tier)
+    a = eng.add_request(p_lo, 10, priority=0)
+    eng.step()
+    eng.step()
+    b = eng.add_request(p_hi, 8, priority=5)
+    res = eng.run_to_completion()
+    stats = eng.resilience_stats()
+    assert stats["preemptions"] >= 1, stats
+    assert stats["spill_evictions"] >= 1, stats
+    assert stats["prefix_replays"] >= 1, stats
+    assert stats["restores"] == 0          # snapshot never survived
+    assert tier.evictions >= 1 and tier.nbytes == 0
+    np.testing.assert_array_equal(res[a], want_lo)
+    assert b in res
+    rep = eng.kv_leak_report()
+    assert rep["leaked"] == 0 and rep["unaccounted"] == 0
+
+
+def test_spill_tier_bounds_bytes_evict_oldest(model):
+    """With room for one snapshot, spilling a second evicts the OLDEST
+    (first-spilled); both demoted/kept requests still finish
+    bit-identically under a supervisor-style drain."""
+    cfg, params = model
+    p1, p2, p_hi = (_prompt(model, 9), _prompt(model, 11),
+                    _prompt(model, 10))
+    want1 = _solo_result(model, p1, 8)
+    want2 = _solo_result(model, p2, 8)
+    probe = ContinuousBatchingEngine(
+        cfg, params, max_batch=1, block_size=8, num_blocks=8,
+        prefill_buckets=(8,))
+    probe.add_request(p1, 8)
+    probe.step()
+    from paddle_tpu.serving.resilience import snapshot_slot
+    one_snap = snapshot_slot(probe, 0).nbytes
+    tier = SpillTier(capacity_bytes=int(one_snap * 1.5))
+    eng = ContinuousBatchingEngine(
+        cfg, params, max_batch=2, block_size=8, num_blocks=6,
+        prefill_buckets=(8,), enable_prefix_caching=False,
+        spill_tier=tier)
+    a = eng.add_request(p1, 8, priority=0)
+    b = eng.add_request(p2, 8, priority=0)
+    eng.step()
+    h = eng.add_request(p_hi, 12, priority=5)
+    res = eng.run_to_completion()
+    stats = eng.resilience_stats()
+    if stats["preemptions"] >= 2:
+        assert stats["spill_evictions"] >= 1, stats
+        assert tier.nbytes <= tier.capacity_bytes
+    np.testing.assert_array_equal(res[a], want1)
+    np.testing.assert_array_equal(res[b], want2)
+    assert h in res
+    rep = eng.kv_leak_report()
+    assert rep["leaked"] == 0 and rep["unaccounted"] == 0
+
+
+def test_spill_tier_validates_config():
+    with pytest.raises(ValueError):
+        SpillTier(policy="evict-newest")
+    with pytest.raises(ValueError):
+        SpillTier(capacity_bytes=-1)
+
+
+# ---------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------
+def test_fleet_metrics_family(model):
+    """serve.fleet.* counters and gauges record placements, deaths,
+    re-placements, drains, and the health census."""
+    REGISTRY.reset()
+    REGISTRY.enable()
+    try:
+        router = _router(model, n=2)
+        fe = ServingFrontend(router)
+        h1 = fe.submit(_prompt(model, 9), 8)
+        h2 = fe.submit(_prompt(model, 10), 8)
+        fe.step()
+        router.kill_replica(router._placements[h1.req_id].replica,
+                            "chaos")
+        fe.run_until_drained(timeout_s=120)
+        assert REGISTRY.get("serve.fleet.placements_total").value == 2
+        assert REGISTRY.get("serve.fleet.replica_deaths_total").value == 1
+        assert REGISTRY.get("serve.fleet.replacements_total").value >= 1
+        assert REGISTRY.get("serve.fleet.replicas").value == 2
+        assert REGISTRY.get("serve.fleet.dead").value == 1
+        assert h1.state is RequestState.FINISHED
+        assert h2.state is RequestState.FINISHED
+    finally:
+        REGISTRY.disable()
+        REGISTRY.reset()
+
+
+# ---------------------------------------------------------------------
+# fleet chaos
+# ---------------------------------------------------------------------
+def _fleet_chaos_run(model, *, seed, kill_replica, kill_after,
+                     n_requests=16):
+    router = _router(model, n=2)
+    fe = ServingFrontend(router)
+    lg = PoissonLoadGenerator(fe, LoadGenConfig(
+        n_requests=n_requests, rate_rps=200.0, seed=seed,
+        prompt_len=(3, 10), max_new_tokens=(3, 8),
+        sampled_fraction=0.25, cancel_fraction=0.1,
+        priorities=(0, 10), priority_weights=(0.6, 0.4),
+        burst_rate_rps=800.0, burst_fraction=0.3,
+        kill_replica=kill_replica, kill_after_requests=kill_after,
+        slo_ttft_s=60.0, slo_tpot_s=30.0))
+    report = lg.run()
+    return report, lg, router
+
+
+def _stream_invariants(handles):
+    for h in handles:
+        if h is None or h.state is not RequestState.FINISHED:
+            continue
+        res = h.result()
+        np.testing.assert_array_equal(
+            np.asarray(h.tokens(), np.int32), res[len(h.prompt):])
+
+
+def test_fleet_chaos_replica_kill_under_load(model):
+    """Tier-1 fleet chaos smoke: bursty mixed-priority Poisson traffic
+    with mid-stream cancels and a scripted replica kill.  Invariants:
+    zero leaked KV blocks on every surviving replica, no dropped /
+    duplicated / reordered tokens, the per-replica breakdown shows
+    both replicas served, and most traffic still finishes."""
+    report, lg, router = _fleet_chaos_run(model, seed=5, kill_replica=0,
+                                          kill_after=6)
+    d = report.to_dict()
+    assert d["kv_leaked_blocks"] == 0, d
+    assert router.replica_state(0) is ReplicaState.DEAD
+    assert router.stats["deaths"] == 1
+    _stream_invariants(lg.last_handles)
+    # per-replica breakdown: every placed request attributed; the
+    # survivor carried the fleet after the kill (whether any request
+    # FINISHED on replica 0 before dying is seed-dependent)
+    assert report.by_replica is not None
+    assert set(report.by_replica) <= {0, 1} and 1 in report.by_replica
+    placed = sum(1 for h in lg.last_handles
+                 if h is not None and h.req_id is not None)
+    assert sum(rc["n"] for rc in report.by_replica.values()) == placed
+    assert report.finished >= report.n_requests // 2
+    _assert_no_leaks(router)
+
+
+def test_fleet_chaos_is_reproducible(model):
+    """Fleet chaos outputs are a pure function of the seeds: same
+    config + same scripted kill => identical streamed tokens for every
+    finished request."""
+    r1, lg1, _ = _fleet_chaos_run(model, seed=9, kill_replica=1,
+                                  kill_after=5, n_requests=12)
+    toks1 = {h.req_id: list(h.tokens()) for h in lg1.last_handles if h}
+    r2, lg2, _ = _fleet_chaos_run(model, seed=9, kill_replica=1,
+                                  kill_after=5, n_requests=12)
+    toks2 = {h.req_id: list(h.tokens()) for h in lg2.last_handles if h}
+    fin1 = {h.req_id for h in lg1.last_handles
+            if h and h.state is RequestState.FINISHED}
+    fin2 = {h.req_id for h in lg2.last_handles
+            if h and h.state is RequestState.FINISHED}
+    assert fin1 == fin2
+    for rid in fin1:
+        assert toks1[rid] == toks2[rid]
+
+
+def test_fleet_kill_streams_match_unkilled_run(model):
+    """The acceptance pin: re-placed streams are bit-identical to an
+    UNKILLED run of the same seeded traffic (kill costs wall-clock,
+    never tokens) — greedy and sampled requests both present."""
+    ref, lg_ref, _ = _fleet_chaos_run(model, seed=13, kill_replica=None,
+                                      kill_after=0, n_requests=12)
+    ref_toks = {h.req_id: list(h.tokens())
+                for h in lg_ref.last_handles
+                if h and h.state is RequestState.FINISHED}
+    rep, lg, router = _fleet_chaos_run(model, seed=13, kill_replica=0,
+                                       kill_after=5, n_requests=12)
+    assert router.stats["deaths"] == 1
+    kill_toks = {h.req_id: list(h.tokens())
+                 for h in lg.last_handles
+                 if h and h.state is RequestState.FINISHED}
+    # every request finished in BOTH runs must carry identical tokens
+    for rid in set(ref_toks) & set(kill_toks):
+        assert ref_toks[rid] == kill_toks[rid], rid
+    assert len(set(ref_toks) & set(kill_toks)) >= len(ref_toks) // 2
+    _assert_no_leaks(router)
+
+
+@pytest.mark.slow
+def test_fleet_chaos_soak_goodput(model):
+    """Soak: a replica kill under sustained mixed-priority load — the
+    surviving replica absorbs the work, high-priority completions
+    match the calm run (re-placement conserves work; chaos costs
+    wall-clock, not completions)."""
+    ref, lg_ref, _ = _fleet_chaos_run(model, seed=21, kill_replica=None,
+                                      kill_after=0, n_requests=40)
+    hi_ref = ref.by_priority[10]
+    rep, lg, router = _fleet_chaos_run(model, seed=21, kill_replica=0,
+                                       kill_after=10, n_requests=40)
+    d = rep.to_dict()
+    assert d["kv_leaked_blocks"] == 0, d
+    _stream_invariants(lg.last_handles)
+    hi = rep.by_priority[10]
+    assert hi["finished"] + hi["cancelled"] == hi["n"], hi
+    assert hi["finished"] >= hi_ref["finished"] - hi_ref["cancelled"]
+    assert rep.finished >= ref.finished - 2
+    _assert_no_leaks(router)

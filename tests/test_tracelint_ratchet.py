@@ -94,6 +94,21 @@ def test_spec_decode_has_zero_tl001_tl006():
             assert n == 0, f"baseline carries {rule} debt in {path}"
 
 
+def test_serving_fleet_has_zero_tl001_tl006():
+    """ISSUE 12 contract: the multi-replica router is pure host-side
+    scheduling over supervised engines — no host-sync in traced code
+    (TL001) and no silent broad excepts (TL006; a swallowed death /
+    drain / re-placement error would strand streams the fleet layer
+    exists to keep alive) — live scan AND committed ledger."""
+    files = ("paddle_tpu/serving/fleet.py",)
+    live = [f for f in _current_findings()
+            if f.rule in ("TL001", "TL006") and f.path.endswith(files)]
+    assert live == [], [f.format() for f in live]
+    for (rule, path), n in baseline_mod.load().items():
+        if rule in ("TL001", "TL006") and path.endswith(files):
+            assert n == 0, f"baseline carries {rule} debt in {path}"
+
+
 def test_serving_resilience_has_zero_tl001_tl006():
     """ISSUE 11 contract: the resilience layer (KV spill/restore +
     supervised recovery) is host-side scheduler code around compiled

@@ -231,6 +231,57 @@ def serve_recovery_warm() -> Callable[[], None]:
     return workload
 
 
+def fleet_warm() -> Callable[[], None]:
+    """Fleet cold-start + chaos on warm replicas (ISSUE 12): an
+    EngineRouter builds every replica from the same AOT artifact
+    generation, serves greedy AND sampled traffic, loses a replica
+    mid-stream (cross-replica re-placement replays on the survivor's
+    deserialized programs), and gracefully drains another after a
+    replacement joins.  Budget is ZERO backend compiles — fleet
+    cold-start, death re-placement, and drain transplant must never
+    trace under traffic."""
+    import tempfile
+    from paddle_tpu.aot.serve import export_engine, warm_engine_factory
+    from paddle_tpu.serving import EngineRouter, RetryPolicy
+
+    cfg, params, prompts = _tiny_llama()
+    aot_dir = tempfile.mkdtemp(prefix="aot_budget_fleet_")
+    export_engine(_engine(cfg, params), aot_dir)
+    factory = warm_engine_factory(cfg, params, aot_dir=aot_dir,
+                                  max_batch=2, block_size=8,
+                                  num_blocks=64, prefill_buckets=(8,))
+
+    def workload():
+        router = EngineRouter(
+            [factory, factory],
+            policy=RetryPolicy(backoff_base_s=0.0),
+            sleep=lambda s: None)
+        rids = [router.add_request(
+            p, 6, temperature=0.7 if i == 0 else 0.0,
+            top_k=8 if i == 0 else None, seed=i + 1)
+            for i, p in enumerate(prompts)]
+        router.step()
+        router.step()
+        victim = next(r.replica for r in router._placements.values())
+        router.kill_replica(victim, "budget scenario kill")
+        router.step()
+        survivor = next(r.idx for r in router.replicas if r.live)
+        router.add_replica(factory)
+        router.drain(survivor)
+        res = router.run_to_completion()
+        if set(res) != set(rids):
+            raise RuntimeError("fleet scenario lost requests")
+        if router.stats["deaths"] != 1 or router.stats["drains"] != 1:
+            raise RuntimeError("fleet scenario never exercised "
+                               "death + drain")
+        for rep in router.replicas:
+            if rep.live and not rep.sup.aot_loaded:
+                raise RuntimeError("a fleet replica fell back to fresh "
+                                   f"compiles: {rep.sup.aot_error}")
+
+    return workload
+
+
 SCENARIOS: Dict[str, Callable[[], Callable[[], None]]] = {
     "gpt_train": gpt_train,
     "serve_fresh": serve_fresh,
@@ -238,6 +289,7 @@ SCENARIOS: Dict[str, Callable[[], Callable[[], None]]] = {
     "serve_aot_warm_sampled": serve_aot_warm_sampled,
     "serve_spec_warm": serve_spec_warm,
     "serve_recovery_warm": serve_recovery_warm,
+    "fleet_warm": fleet_warm,
 }
 
 
@@ -280,10 +332,12 @@ def render_md(counts: Dict[str, int]) -> str:
         "",
         "Budgets are CPU tier-1 numbers; `serve_aot_warm` is the ISSUE 6"
         " acceptance row, `serve_aot_warm_sampled` the ISSUE 7 one, "
-        "`serve_spec_warm` the ISSUE 8 one, and `serve_recovery_warm` "
-        "the ISSUE 11 one: an AOT-warm engine start must be ZERO "
-        "backend compiles — greedy, sampled, speculative, or rebuilt "
-        "mid-traffic by crash recovery (replay included).",
+        "`serve_spec_warm` the ISSUE 8 one, `serve_recovery_warm` the "
+        "ISSUE 11 one, and `fleet_warm` the ISSUE 12 one: an AOT-warm "
+        "engine start must be ZERO backend compiles — greedy, sampled, "
+        "speculative, rebuilt mid-traffic by crash recovery (replay "
+        "included), or serving as a fleet replica through a replica "
+        "kill, cross-replica re-placement, and a graceful drain.",
         "",
     ]
     for name, n in counts.items():
