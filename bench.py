@@ -513,6 +513,118 @@ def _serve_fleet_extra(cfg, params, *, mb, nb, on_accel, t0, new,
         return {"fleet_error": f"{type(e).__name__}: {e}"}
 
 
+def _serve_http_extra(cfg, params, *, mb, nb, on_accel, t0, new,
+                      aot_dir):
+    """HTTP/SSE wire row for the serve config (ISSUE 13), on a
+    compile-warm engine reusing the aot_warm row's artifacts: the SAME
+    seeded loadgen run in-process vs over real localhost sockets (the
+    wire tax on goodput/ttft), a disconnect storm riding the wire run
+    (drained at zero leaks), and the wire backend-compile count (must
+    be zero — the serve_http_warm budget row).  Never fails the row —
+    errors land in extra.http_error."""
+    try:
+        import socket
+
+        from paddle_tpu.observability import CompileMonitor
+        from paddle_tpu.serving import (AdmissionConfig,
+                                        HttpServingServer, LoadGenConfig,
+                                        PoissonLoadGenerator,
+                                        ServingFrontend)
+        from paddle_tpu.serving.http import HttpTransport
+        from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+        if aot_dir is None:
+            raise RuntimeError("no AOT artifacts from the aot_warm row")
+        rng = np.random.default_rng(9)
+        lg = LoadGenConfig(
+            n_requests=16 if not on_accel else 48,
+            rate_rps=150.0 if not on_accel else 16.0, seed=9,
+            prompt_len=(3, t0), max_new_tokens=(3, new),
+            sampled_fraction=0.25, cancel_fraction=0.1,
+            slo_ttft_s=5.0 if not on_accel else 2.0,
+            slo_tpot_s=1.0 if not on_accel else 0.25)
+
+        def warm_engine():
+            return ContinuousBatchingEngine(
+                cfg, params, max_batch=mb, block_size=16,
+                num_blocks=nb, prefill_buckets=(t0,), aot_dir=aot_dir)
+
+        # in-process baseline
+        fe1 = ServingFrontend(warm_engine(),
+                              admission=AdmissionConfig(max_queue_len=64))
+        rep_inproc = PoissonLoadGenerator(fe1, lg).run()
+
+        # the same plan over real sockets + a disconnect storm
+        monitor = CompileMonitor().install()
+        try:
+            fe2 = ServingFrontend(
+                warm_engine(),
+                admission=AdmissionConfig(max_queue_len=64))
+            srv = HttpServingServer(fe2, heartbeat_s=0.02,
+                                    retry_grace_s=0.0).start()
+            try:
+                tp = HttpTransport("127.0.0.1", srv.port, server=srv)
+                gen = PoissonLoadGenerator(None, lg, transport=tp)
+                import threading
+
+                def storm():
+                    for i in range(4):
+                        body = json.dumps({
+                            "prompt_ids": rng.integers(
+                                0, cfg.vocab_size,
+                                (3,)).astype(np.int32).tolist(),
+                            "max_new_tokens": new}).encode()
+                        try:
+                            s = socket.create_connection(
+                                ("127.0.0.1", srv.port), timeout=10)
+                            s.sendall(
+                                b"POST /v1/generate HTTP/1.1\r\n"
+                                b"Host: b\r\nContent-Type: "
+                                b"application/json\r\nContent-Length: "
+                                + str(len(body)).encode()
+                                + b"\r\nConnection: close\r\n\r\n"
+                                + body)
+                            s.recv(128)
+                            s.close()
+                        except OSError:
+                            return
+                st = threading.Thread(target=storm, daemon=True)
+                st.start()
+                rep_wire = gen.run()
+                st.join(timeout=30.0)
+                shutdown = srv.begin_shutdown(reason="bench done")
+            finally:
+                srv._httpd.server_close()
+        finally:
+            monitor.uninstall()
+
+        return {"http": {
+            "tokens_per_s": {
+                "inproc": round(rep_inproc.tokens_per_s, 2),
+                "wire": round(rep_wire.tokens_per_s, 2)},
+            "goodput_rps": {
+                "inproc": round(rep_inproc.goodput_rps, 3),
+                "wire": round(rep_wire.goodput_rps, 3)},
+            "ttft_p50_s": {
+                "inproc": None if rep_inproc.ttft_s is None
+                else rep_inproc.ttft_s["p50"],
+                "wire": None if rep_wire.ttft_s is None
+                else rep_wire.ttft_s["p50"]},
+            "wire_backend_compiles": monitor.n_compiles,
+            "kv_leaked_blocks": rep_wire.to_dict()["kv_leaked_blocks"],
+            "shutdown_drain_secs": shutdown["drain_secs"],
+            "shutdown_kv_leaked_blocks": shutdown["kv_leaked_blocks"],
+            "disconnect_storm_conns": 4,
+            "note": "wire and in-process runs offer the identical "
+                    "seeded request sequence (pinned by "
+                    "test_serving_http) — deltas are the HTTP/SSE tax "
+                    "plus CPU contention from the storm, not workload "
+                    "drift",
+        }}
+    except Exception as e:
+        return {"http_error": f"{type(e).__name__}: {e}"}
+
+
 def _serve_decode_block_extra(cfg, params, eng_fused, *, mb, nb, on_accel,
                               t0, new):
     """Fused-vs-per-op decode A/B for the serve row (ISSUE 9): the same
@@ -809,6 +921,9 @@ def run_config_bench(config: str):
             cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
             new=new, aot_dir=aot_dir_out.get("dir")))
         out["extra"].update(_serve_fleet_extra(
+            cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
+            new=new, aot_dir=aot_dir_out.get("dir")))
+        out["extra"].update(_serve_http_extra(
             cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
             new=new, aot_dir=aot_dir_out.get("dir")))
     elif config == "decode":

@@ -7,6 +7,8 @@ serve-path metric catalogue over the PR 5 telemetry registry.
 
 Layering::
 
+    serving.http.HttpServingServer    HTTP/SSE network front door
+            │
     serving.PoissonLoadGenerator      offered load + SLO report
             │
     serving.ServingFrontend           lifecycle/streams/admission
@@ -14,6 +16,12 @@ Layering::
     inference.ContinuousBatchingEngine   batch scheduler + paged KV
             │
     aot.export_engine / aot_dir       zero-compile warm start
+
+The wire (ISSUE 13): ``serving/http.py`` serves the front-end over
+stdlib HTTP/SSE — disconnect-safe streaming, slow-client isolation,
+``request_id`` idempotent retry with committed-prefix replay, graceful
+SIGTERM drain, and a typed status mapping of the whole terminal-state
+lattice (``python -m paddle_tpu.serving.http --model llama_tiny``).
 
 Resilience (ISSUE 11): ``serving/resilience.py`` adds priority
 preemption with CRC-checked host-RAM KV spill/restore and the
@@ -27,6 +35,7 @@ admission knobs, and the metric catalogue.
 from .fleet import EngineRouter, FleetExhaustedError, ReplicaState
 from .frontend import (AdmissionConfig, RequestAborted, RequestHandle,
                        RequestRejected, RequestState, ServingFrontend)
+from .http import HttpServingServer
 from .loadgen import LoadGenConfig, LoadReport, PoissonLoadGenerator
 from .metrics import ServeMetrics
 from .resilience import (EngineCrashError, KVSnapshot, PortableRequest,
@@ -36,9 +45,10 @@ from .resilience import (EngineCrashError, KVSnapshot, PortableRequest,
 
 __all__ = [
     "AdmissionConfig", "EngineCrashError", "EngineRouter",
-    "FleetExhaustedError", "KVSnapshot", "LoadGenConfig", "LoadReport",
-    "PoissonLoadGenerator", "PortableRequest", "RecoveryExhaustedError",
-    "ReplicaState", "RequestAborted", "RequestHandle", "RequestRejected",
+    "FleetExhaustedError", "HttpServingServer", "KVSnapshot",
+    "LoadGenConfig", "LoadReport", "PoissonLoadGenerator",
+    "PortableRequest", "RecoveryExhaustedError", "ReplicaState",
+    "RequestAborted", "RequestHandle", "RequestRejected",
     "RequestState", "ResilienceError", "RetryPolicy", "ServeMetrics",
     "ServingFrontend", "SpillCorruptError", "SpillTier",
     "SupervisedEngine", "TransientStepError",

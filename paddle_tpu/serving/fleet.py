@@ -218,6 +218,26 @@ class EngineRouter:
                     if r.live and r.state is ReplicaState.DEGRADED]
         return healthy + degraded
 
+    def placeable(self) -> bool:
+        """Readiness predicate: can the fleet accept NEW work right now
+        — is at least one replica HEALTHY or DEGRADED?  DRAINING and
+        DEAD replicas keep existing streams alive but take no new
+        placements, so a fleet of only those is not ready.  This is the
+        load-balancer answer ``GET /readyz`` (serving/http.py) serves."""
+        return bool(self._placeable())
+
+    def health_census(self) -> Dict[str, int]:
+        """Structured replica-health counts, one key per
+        :class:`ReplicaState` value (``HEALTHY`` / ``DEGRADED`` /
+        ``DRAINING`` / ``DEAD``) plus ``total`` — the readiness and
+        metrics endpoints read fleet state through this instead of
+        poking ``_replicas``."""
+        census = {s.value: 0 for s in ReplicaState}
+        for r in self._replicas:
+            census[r.state.value] += 1
+        census["total"] = len(self._replicas)
+        return census
+
     # ------------------------------------------------------------------
     # placement
     # ------------------------------------------------------------------
@@ -715,10 +735,9 @@ class EngineRouter:
         """The ``serve.fleet.*`` rollup: health census, aggregate load,
         re-placement / drain / death counters, per-replica breakdown,
         and drained replicas' final leak reports."""
-        by_state = {s.value: 0 for s in ReplicaState}
+        by_state = self.health_census()
         per_replica = []
         for r in self._replicas:
-            by_state[r.state.value] += 1
             row: Dict[str, object] = {"replica": r.idx,
                                       "state": r.state.value}
             if r.live:

@@ -236,6 +236,56 @@ class RequestHandle:
             # no driver thread: the consumer IS the scheduler
             self._fe.step()
 
+    def stream_from(self, start: int = 0, *, poll_s: float = 0.05,
+                    idle_cb: Optional[Callable[[], None]] = None):
+        """Yield ``(index, token)`` pairs beginning at stream index
+        ``start`` — the re-attachable consumer surface the HTTP/SSE wire
+        is built on (``serving/http.py``): a retried connection replays
+        the committed prefix from index 0 and then continues live,
+        instead of double-submitting the request.
+
+        Unlike ``__next__`` (one shared cursor), the caller owns the
+        position; the shared backpressure cursor only ever advances
+        (``max``), so an attached replay can never re-arm backpressure
+        for tokens the producer already delivered.  Tokens are grabbed
+        a chunk at a time and yielded OUTSIDE the handle lock — a slow
+        socket write never blocks the delivering driver thread.
+
+        ``idle_cb`` runs (outside the lock) roughly every ``poll_s``
+        while no new token is available — the wire uses it for SSE
+        heartbeats, which is also how a dead client socket is noticed
+        while the stream is idle.  Terminates when the request reaches
+        a terminal state (raising like ``__next__`` for abnormal
+        terminals once every committed token has been yielded)."""
+        i = start
+        while True:
+            chunk: List[int] = []
+            st = None
+            with self._cond:
+                if i < len(self._tokens):
+                    chunk = self._tokens[i:]
+                    if len(self._tokens) > self._cursor:
+                        self._cursor = len(self._tokens)
+                        self._cond.notify_all()
+                else:
+                    st = self._state
+                    if st is RequestState.FINISHED:
+                        return
+                    if st in _TERMINAL:
+                        self._raise_if_aborted(st)
+                    if self._fe._driver_alive():
+                        self._cond.wait(poll_s)
+            if chunk:
+                for tok in chunk:
+                    yield i, tok
+                    i += 1
+                continue
+            if idle_cb is not None:
+                idle_cb()
+            if st is not None and not self._fe._driver_alive():
+                # no driver thread: the consumer IS the scheduler
+                self._fe.step()
+
     def __repr__(self) -> str:
         return (f"RequestHandle(id={self.req_id}, "
                 f"state={self._state.value}, "

@@ -172,19 +172,50 @@ def _pcts(vals: List[float]) -> Optional[Dict[str, float]]:
 
 class PoissonLoadGenerator:
     """Drives a :class:`ServingFrontend` with a seeded open-loop Poisson
-    arrival process and reports latency/goodput percentiles."""
+    arrival process and reports latency/goodput percentiles.
 
-    def __init__(self, frontend: ServingFrontend,
+    ``transport=`` (ISSUE 13) swaps the submission path: instead of
+    calling ``frontend.submit`` in-process, every planned request goes
+    through the transport (``serving.http.HttpTransport`` — the real
+    HTTP/SSE wire).  The PLAN is identical either way (a pure function
+    of the seed and vocab, consumed through one kwargs builder), so a
+    wire run offers the exact same request sequence — content, budgets,
+    sampling, cancels — as the in-process run with the same seed;
+    pinned by tests/test_serving_http.py."""
+
+    def __init__(self, frontend: Optional[ServingFrontend],
                  config: Optional[LoadGenConfig] = None, *,
+                 transport=None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep):
+        if frontend is None and transport is None:
+            raise ValueError("need a frontend or a transport")
         self.frontend = frontend
+        self.transport = transport
         self.config = config or LoadGenConfig()
         self._clock = clock
         self._sleep = sleep
         # handles of the most recent run() — chaos tests assert stream
         # invariants (no drop/dup/reorder) directly on them
         self.last_handles: List[Optional[RequestHandle]] = []
+
+    def _vocab_size(self) -> int:
+        if self.transport is not None:
+            return int(self.transport.vocab_size)
+        return int(self.frontend.engine.cfg.vocab_size)
+
+    def request_kwargs(self, p: _Planned) -> dict:
+        """The ONE planned-request → submit-kwargs mapping, shared by
+        the in-process and wire transports (the reproducibility pin
+        compares exactly this)."""
+        cfg = self.config
+        return dict(
+            prompt_ids=p.prompt, max_new_tokens=p.max_new,
+            eos_token_id=cfg.eos_token_id,
+            temperature=cfg.temperature if p.sampled else 0.0,
+            top_k=cfg.top_k if p.sampled else None, seed=p.seed,
+            priority=p.priority, deadline_s=cfg.deadline_s,
+            max_queue_time_s=cfg.max_queue_time_s)
 
     def plan(self) -> List[_Planned]:
         """The run's deterministic request schedule (pure function of
@@ -199,7 +230,7 @@ class PoissonLoadGenerator:
                 rng.exponential(1.0 / cfg.burst_rate_rps,
                                 cfg.n_requests), gaps)
         arrivals = np.cumsum(gaps)
-        vocab = int(self.frontend.engine.cfg.vocab_size)
+        vocab = self._vocab_size()
         plo, phi = _span(cfg.prompt_len)
         nlo, nhi = _span(cfg.max_new_tokens)
         prios = list(cfg.priorities)
@@ -221,18 +252,19 @@ class PoissonLoadGenerator:
         return out
 
     def _submit(self, p: _Planned) -> RequestHandle:
-        cfg = self.config
+        kwargs = self.request_kwargs(p)
+        if self.transport is not None:
+            return self.transport.submit(**kwargs)
         return self.frontend.submit(
-            p.prompt, p.max_new, eos_token_id=cfg.eos_token_id,
-            temperature=cfg.temperature if p.sampled else 0.0,
-            top_k=cfg.top_k if p.sampled else None, seed=p.seed,
-            priority=p.priority, deadline_s=cfg.deadline_s,
-            max_queue_time_s=cfg.max_queue_time_s)
+            kwargs.pop("prompt_ids"), kwargs.pop("max_new_tokens"),
+            **kwargs)
 
     def run(self) -> LoadReport:
         cfg = self.config
         if cfg.kill_replica is not None \
-                and not hasattr(self.frontend.engine, "kill_replica"):
+                and (self.frontend is None
+                     or not hasattr(self.frontend.engine,
+                                    "kill_replica")):
             raise ValueError(
                 "kill_replica is a fleet scenario — the frontend must "
                 "drive an EngineRouter")
@@ -263,13 +295,18 @@ class PoissonLoadGenerator:
             live = any(h is not None and not h.state.terminal
                        for h in handles)
             if live:
-                self.frontend.step()
+                if self.transport is not None:
+                    self.transport.pump(self._sleep)
+                else:
+                    self.frontend.step()
             elif next_up < len(plan):
                 gap = plan[next_up].at - (self._clock() - t0)
                 if gap > 0:
                     self._sleep(min(gap, 0.005))
             else:
                 break
+        if self.transport is not None:
+            self.transport.drain()
         duration = max(self._clock() - t0, 1e-9)
         self.last_handles = handles
         return self._report(handles, duration, plan)
@@ -289,7 +326,7 @@ class PoissonLoadGenerator:
             id(h): p.priority for h, p in zip(handles, plan)
             if h is not None}
         by_prio: Dict[int, Dict[str, Any]] = {}
-        eng = self.frontend.engine
+        eng = None if self.frontend is None else self.frontend.engine
         replica_of = getattr(eng, "replica_of", None)
         by_rep: Dict[int, Dict[str, Any]] = {}
         for h in handles:
@@ -367,7 +404,9 @@ class PoissonLoadGenerator:
             goodput_rps=good / duration,
             goodput_tokens_per_s=good_tokens / duration,
             slo={"ttft_s": cfg.slo_ttft_s, "tpot_s": cfg.slo_tpot_s},
-            kv_leaks=self.frontend.engine.kv_leak_report(),
+            kv_leaks=(self.transport.kv_leak_report()
+                      if self.transport is not None
+                      else self.frontend.engine.kv_leak_report()),
             per_request=per_req, by_priority=by_priority,
             by_replica={k: by_rep[k] for k in sorted(by_rep)}
             if by_rep else None)

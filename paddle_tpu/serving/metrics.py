@@ -93,6 +93,25 @@ serve.fleet.replica_deaths_total            counter    replicas declared dead
 serve.fleet.drains_total                    counter    graceful drains started
 ==========================================  =========  ==============
 
+HTTP wire rows (``serve.http.*``, live only when requests arrive over
+the network front door — ``serving/http.py``; docs/serving.md).  The
+wire is where real traffic's failures originate, so every failure mode
+the server absorbs is a counter:
+
+==========================================  =========  ==============
+serve.http.connections_total                counter    accepted HTTP connections
+serve.http.active_connections               gauge      connections being served now
+serve.http.requests_total                   counter    /v1/generate bodies parsed
+serve.http.disconnect_cancels_total         counter    mid-stream client disconnects
+                                                       that cancelled the request
+serve.http.dedup_hits_total                 counter    retries attached to a live or
+                                                       finished stream (no double submit)
+serve.http.write_stall_timeouts_total       counter    SSE writes past the per-connection
+                                                       deadline (stalled reader isolated)
+serve.http.abandoned_total                  counter    graced disconnects never retried
+serve.http.shutdown_drain_secs              histogram  SIGTERM -> drained latency
+==========================================  =========  ==============
+
 Every recording entry point checks ``registry.enabled`` first, so a
 front-end without telemetry pays one branch per call (the PR 5
 zero-cost-disabled contract).  All of this is host-side scheduler code,
@@ -187,6 +206,58 @@ class ServeMetrics:
             return
         self._reg.histogram("serve.backpressure_wait_secs",
                             unit="s").record(waited_s)
+
+    # -- HTTP wire (serving/http.py) -------------------------------------
+    def on_connection(self, active: int, *, opened: bool) -> None:
+        """A connection opened or closed; ``active`` is the server's
+        live-connection count AFTER the change (the gauge value)."""
+        if not self._reg.enabled:
+            return
+        if opened:
+            self._reg.counter("serve.http.connections_total").inc()
+        self._reg.gauge("serve.http.active_connections").set(active)
+
+    def on_http_request(self) -> None:
+        if self._reg.enabled:
+            self._reg.counter("serve.http.requests_total").inc()
+
+    def on_disconnect_cancel(self, req_id, n_streamed: int) -> None:
+        if not self._reg.enabled:
+            return
+        self._reg.counter("serve.http.disconnect_cancels_total").inc()
+        self._reg.event("serve", action="http_disconnect_cancel",
+                        req_id=req_id, n_streamed=n_streamed)
+
+    def on_dedup_hit(self, request_id: str, live: bool) -> None:
+        if not self._reg.enabled:
+            return
+        self._reg.counter("serve.http.dedup_hits_total").inc()
+        self._reg.event("serve", action="http_dedup_hit",
+                        request_id=str(request_id)[:100], live=live)
+
+    def on_write_stall(self, req_id, waited_s: float) -> None:
+        if not self._reg.enabled:
+            return
+        self._reg.counter("serve.http.write_stall_timeouts_total").inc()
+        self._reg.event("serve", action="http_write_stall",
+                        req_id=req_id, waited_s=round(waited_s, 4))
+
+    def on_abandoned(self, request_id: str) -> None:
+        if not self._reg.enabled:
+            return
+        self._reg.counter("serve.http.abandoned_total").inc()
+        self._reg.event("serve", action="http_abandoned",
+                        request_id=str(request_id)[:100])
+
+    def on_shutdown_drain(self, secs: float, drained: int,
+                          cancelled: int) -> None:
+        if not self._reg.enabled:
+            return
+        self._reg.histogram("serve.http.shutdown_drain_secs",
+                            unit="s").record(secs)
+        self._reg.event("serve", action="http_shutdown_drain",
+                        secs=round(secs, 4), drained=drained,
+                        cancelled=cancelled)
 
     # -- gauges ---------------------------------------------------------
     def publish_engine(self, engine) -> None:

@@ -48,6 +48,25 @@ Fleet side (ISSUE 12, ``serving/fleet.py``):
   step, rebuilds included, until its circuit breaker opens — the
   organic ``RecoveryExhaustedError`` death the router absorbs.
 
+Wire side (ISSUE 13, ``serving/http.py``) — raw-socket chaos clients
+for the HTTP/SSE front door.  These speak TCP directly (no
+``http.client``) so they can misbehave in ways a well-formed client
+cannot:
+
+* :func:`http_disconnect_mid_stream` — open an SSE stream, read N
+  token events, then close the socket hard (optionally with an RST via
+  ``SO_LINGER``) — the server must cancel the request and free its KV
+  pages.
+* :func:`http_stalled_reader` — open a stream with a tiny receive
+  buffer and never read: the TCP window closes and the server's write
+  deadline must isolate the stall without touching batchmates.
+* :func:`http_partial_line_writes` — dribble the request bytes a few
+  at a time (slow/fragmenting client); the server must parse it like
+  any other request.
+* :func:`connect_then_abandon_flood` — open many connections that send
+  little or nothing and vanish; the server must shed them without
+  leaking threads or submitting anything.
+
 The serve exceptions are ordinary ``Exception`` subclasses (unlike
 :class:`SimulatedCrash`): a supervisor is SUPPOSED to catch and recover
 from them, while the checkpoint kill must never be swallowed.
@@ -61,12 +80,14 @@ import time
 
 from paddle_tpu.framework import io as fio
 
-__all__ = ["InjectedEngineCrash", "SimulatedCrash", "corrupt_file",
+__all__ = ["InjectedEngineCrash", "SimulatedCrash",
+           "connect_then_abandon_flood", "corrupt_file",
            "crash_mid_prefill", "crash_mid_speculation",
            "crash_mid_write", "exhaust_kv_pool", "fail_replace",
-           "fail_step_n", "kill_replica_after_steps",
-           "persistent_replica_crash", "slow_steps",
-           "transient_step_faults", "truncate_file"]
+           "fail_step_n", "http_disconnect_mid_stream",
+           "http_partial_line_writes", "http_stalled_reader",
+           "kill_replica_after_steps", "persistent_replica_crash",
+           "slow_steps", "transient_step_faults", "truncate_file"]
 
 
 class SimulatedCrash(BaseException):
@@ -296,6 +317,137 @@ def persistent_replica_crash(sup, *, exc_type=InjectedEngineCrash):
     sup.engine.step = boom
     sup._factory = crashing_factory
     return stats
+
+
+# ---------------------------------------------------------------------
+# wire chaos clients (ISSUE 13): raw-socket misbehavior against the
+# HTTP/SSE front door
+# ---------------------------------------------------------------------
+def _generate_request_bytes(payload: dict, path: str = "/v1/generate"
+                            ) -> bytes:
+    import json
+    body = json.dumps(payload).encode()
+    return (f"POST {path} HTTP/1.1\r\n"
+            f"Host: chaos\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: close\r\n\r\n").encode() + body
+
+
+def _read_sse_tokens(f, n_tokens: int, *, collect=None):
+    """Read a raw HTTP response stream until ``n_tokens`` SSE ``token``
+    events arrived (headers are skipped); returns the token ids read."""
+    import json
+    # headers
+    while True:
+        line = f.readline()
+        if not line or line in (b"\r\n", b"\n"):
+            break
+    toks = []
+    event = None
+    while len(toks) < n_tokens:
+        line = f.readline()
+        if not line:
+            break
+        line = line.rstrip(b"\r\n")
+        if line.startswith(b"event:"):
+            event = line.split(b":", 1)[1].strip()
+        elif line.startswith(b"data:") and event == b"token":
+            toks.append(int(json.loads(line.split(b":", 1)[1])["t"]))
+            if collect is not None:
+                collect.append(toks[-1])
+            event = None
+    return toks
+
+
+def http_disconnect_mid_stream(host: str, port: int, payload: dict, *,
+                               after_tokens: int = 2,
+                               rst: bool = False,
+                               timeout_s: float = 30.0):
+    """Open an SSE generate stream, read ``after_tokens`` token events,
+    then vanish: plain ``close()`` (FIN) or, with ``rst``, an abortive
+    close (``SO_LINGER`` 0 → RST, which fails the server's very next
+    write).  Returns the token ids read before the disconnect."""
+    import socket as _socket
+    s = _socket.create_connection((host, port), timeout=timeout_s)
+    try:
+        s.sendall(_generate_request_bytes(payload))
+        f = s.makefile("rb")
+        toks = _read_sse_tokens(f, after_tokens)
+        if rst:
+            s.setsockopt(_socket.SOL_SOCKET, _socket.SO_LINGER,
+                         __import__("struct").pack("ii", 1, 0))
+    finally:
+        s.close()
+    return toks
+
+
+def http_stalled_reader(host: str, port: int, payload: dict, *,
+                        rcvbuf: int = 1024, timeout_s: float = 30.0):
+    """Open a generate stream and STOP READING: the tiny ``SO_RCVBUF``
+    (set before connect so the window is small from the handshake)
+    fills, the TCP window closes, and the server's per-connection write
+    deadline has to fire.  Returns the open socket — the caller owns
+    closing it (keeping it open is the whole point of the stall)."""
+    import socket as _socket
+    s = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    s.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, rcvbuf)
+    s.settimeout(timeout_s)
+    s.connect((host, port))
+    s.sendall(_generate_request_bytes(payload))
+    return s
+
+
+def http_partial_line_writes(host: str, port: int, payload: dict, *,
+                             chunk: int = 7, delay_s: float = 0.002,
+                             timeout_s: float = 30.0):
+    """Send a well-formed generate request a few bytes at a time
+    (request line, headers, and body all fragmented mid-line — the
+    slow/fragmenting-client model).  Reads the full response; returns
+    ``(status_code, raw_response_bytes)``."""
+    import socket as _socket
+    data = _generate_request_bytes(payload)
+    s = _socket.create_connection((host, port), timeout=timeout_s)
+    try:
+        for i in range(0, len(data), chunk):
+            s.sendall(data[i:i + chunk])
+            time.sleep(delay_s)
+        raw = b""
+        while True:
+            got = s.recv(65536)
+            if not got:
+                break
+            raw += got
+    finally:
+        s.close()
+    status = int(raw.split(b" ", 2)[1]) if raw.startswith(b"HTTP/") else 0
+    return status, raw
+
+
+def connect_then_abandon_flood(host: str, port: int, n: int = 20, *,
+                               partial_bytes: bytes = b"POST /v1/gen",
+                               timeout_s: float = 5.0) -> int:
+    """Open ``n`` connections that send at most a partial request line
+    and disappear (half the flood sends nothing at all).  Returns the
+    number of sockets opened; the server must shed every one without
+    submitting a request or wedging a handler thread."""
+    import socket as _socket
+    opened = 0
+    for i in range(n):
+        try:
+            s = _socket.create_connection((host, port),
+                                          timeout=timeout_s)
+        except OSError:
+            continue
+        opened += 1
+        try:
+            if i % 2 == 0 and partial_bytes:
+                s.sendall(partial_bytes)
+        except OSError:
+            pass          # flood sockets are fire-and-forget by design
+        finally:
+            s.close()
+    return opened
 
 
 @contextlib.contextmanager

@@ -81,6 +81,15 @@ def test_fleet_warm_is_zero_compiles(measured):
     assert measured["fleet_warm"] == 0, measured
 
 
+def test_http_warm_is_zero_compiles(measured):
+    """ISSUE 13 acceptance: the HTTP/SSE front door on an AOT-warm
+    engine — server cold-start, greedy AND sampled traffic over real
+    localhost sockets, a mid-stream client disconnect, and a graceful
+    shutdown — performs zero backend compiles.  The wire is host-side
+    plumbing; it must never trace."""
+    assert measured["serve_http_warm"] == 0, measured
+
+
 def test_every_scenario_has_a_budget(measured):
     budgets = compile_budget.load_ledger()["budgets"]
     assert set(measured) <= set(budgets), (set(measured), set(budgets))

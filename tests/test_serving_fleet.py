@@ -131,6 +131,45 @@ def test_least_loaded_placement_prefers_idle_replica(model):
     _assert_no_leaks(router)
 
 
+def test_placeable_predicate_and_health_census(model):
+    """ISSUE 13 satellite: ``placeable()`` / ``health_census()`` are
+    the public readiness surface — ``/readyz`` and ``/metrics`` read
+    fleet state through them, never through private fields.  The
+    census tracks every transition of the health state machine, and
+    placeability flips exactly when the last HEALTHY/DEGRADED replica
+    leaves the placement pool."""
+    router = _router(model, n=2)
+    assert router.placeable() is True
+    census = router.health_census()
+    assert census == {"HEALTHY": 2, "DEGRADED": 0, "DRAINING": 0,
+                      "DEAD": 0, "total": 2}
+    # a degraded replica still takes (overflow) placements
+    router._replicas[0].state = ReplicaState.DEGRADED
+    assert router.placeable() is True
+    assert router.health_census()["DEGRADED"] == 1
+    router._replicas[0].state = ReplicaState.HEALTHY
+    # draining: keeps running, takes no NEW work
+    rid = router.add_request(_prompt(model, 6), 4)
+    router.step()
+    router.drain(0, mode="run_out")
+    census = router.health_census()
+    # replica 0 is DRAINING until it runs dry (or already DEAD if it
+    # held nothing) — either way it left the placement pool
+    assert census["HEALTHY"] == 1
+    assert census["DRAINING"] + census["DEAD"] == 1
+    assert router.placeable() is True      # replica 1 still takes work
+    router.run_to_completion()
+    # kill the survivor: nothing placeable, census all accounted
+    live = [r.idx for r in router.replicas if r.live]
+    for idx in live:
+        router.kill_replica(idx, "census test")
+    assert router.placeable() is False
+    census = router.health_census()
+    assert census["DEAD"] == 2 and census["total"] == 2
+    assert census["HEALTHY"] == census["DEGRADED"] == 0
+    assert rid is not None
+
+
 def test_admission_rejects_only_when_no_replica_admits(model):
     """With one replica past the queue bound and one below it, the
     fleet still admits; only when EVERY placeable replica fails the

@@ -79,6 +79,21 @@ def test_serving_has_zero_tl001_tl006():
             assert n == 0, f"baseline carries {rule} debt in {path}"
 
 
+def test_serving_http_has_zero_tl001_tl006():
+    """ISSUE 13 contract: the HTTP/SSE front door is pure host-side
+    connection plumbing over the frontend — no host-sync in traced
+    code (TL001) and no silent broad excepts (TL006; a swallowed
+    disconnect/stall/shutdown error would leak the very KV pages the
+    wire layer exists to free) — live scan AND committed ledger."""
+    files = ("paddle_tpu/serving/http.py",)
+    live = [f for f in _current_findings()
+            if f.rule in ("TL001", "TL006") and f.path.endswith(files)]
+    assert live == [], [f.format() for f in live]
+    for (rule, path), n in baseline_mod.load().items():
+        if rule in ("TL001", "TL006") and path.endswith(files):
+            assert n == 0, f"baseline carries {rule} debt in {path}"
+
+
 def test_spec_decode_has_zero_tl001_tl006():
     """ISSUE 8 contract: speculative decoding is host-side scheduling
     around two traced programs — no host-sync in traced code (TL001;

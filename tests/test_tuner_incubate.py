@@ -117,6 +117,44 @@ class TestFusedLayers:
         assert np.isfinite(y.numpy()).all()
 
     def test_fused_attention_grad(self):
+        """Gradients flow through the whole fused block — qkv, the
+        output projection, AND the epilogue LN params.
+
+        The loss must NOT be a bare ``out.sum()``: the block ends in a
+        post-LN (normalize_before=False) whose scale initializes to 1,
+        and a uniform cotangent is exactly in that LayerNorm Jacobian's
+        null space — ``dx = inv*(w·g - mean(w·g) - xhat·mean(w·g·xhat))``
+        vanishes identically when ``w·g`` is constant (mean(xhat)=0).
+        Every mathematically-exact backward therefore produces
+        qkv/linear grads of literally 0.0 there; only fp rounding noise
+        in a non-analytic implementation makes them "nonzero".  A
+        seeded non-uniform weighting keeps the cotangent out of the
+        null space, so the assertion tests grad FLOW, not noise."""
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+        pt.seed(1)
+        net = FusedMultiHeadAttention(16, 2, dropout_rate=0.0,
+                                      attn_dropout_rate=0.0)
+        rng = np.random.default_rng(1)
+        x = pt.to_tensor(rng.standard_normal(
+            (2, 4, 16)).astype(np.float32))
+        w = pt.to_tensor(rng.standard_normal(
+            (2, 4, 16)).astype(np.float32))
+        out = net(x)
+        (out * w).sum().backward()
+        assert net.qkv_weight.grad is not None
+        assert np.abs(net.qkv_weight.grad.numpy()).sum() > 0
+        assert np.abs(net.linear_weight.grad.numpy()).sum() > 0
+        assert np.abs(net.ln_scale.grad.numpy()).sum() > 0
+
+    def test_fused_attention_uniform_cotangent_null_space(self):
+        """The property that made the old assertion unsatisfiable: with
+        a uniform cotangent and unit LN scale, the analytic LayerNorm
+        backward annihilates the upstream gradient (exactly in real
+        arithmetic; to fp32 rounding noise in practice — orders of
+        magnitude below any real gradient).  A non-negligible qkv grad
+        here would mean the backward picked up spurious terms; the
+        weighted-loss test above is where genuine grad FLOW is
+        asserted."""
         from paddle_tpu.incubate.nn import FusedMultiHeadAttention
         pt.seed(1)
         net = FusedMultiHeadAttention(16, 2, dropout_rate=0.0,
@@ -126,7 +164,10 @@ class TestFusedLayers:
         out = net(x)
         out.sum().backward()
         assert net.qkv_weight.grad is not None
-        assert np.abs(net.qkv_weight.grad.numpy()).sum() > 0
+        # noise floor: the weighted-loss variant measures ~1e0-1e2 here
+        assert np.abs(net.qkv_weight.grad.numpy()).max() < 1e-5
+        # the LN's own params DO see the uniform cotangent
+        assert np.abs(net.ln_bias.grad.numpy()).sum() > 0
 
 
 class TestIncubateFusedLayers:

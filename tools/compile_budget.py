@@ -282,6 +282,74 @@ def fleet_warm() -> Callable[[], None]:
     return workload
 
 
+def serve_http_warm() -> Callable[[], None]:
+    """HTTP front door on a warm engine (ISSUE 13): server cold-start
+    from AOT artifacts, greedy AND sampled traffic over real localhost
+    sockets, one mid-stream client disconnect, and a graceful shutdown
+    with a zero-leak report — ZERO backend compiles; the wire layer is
+    host-side plumbing and must never trace."""
+    import tempfile
+    from paddle_tpu.aot.serve import export_engine
+
+    cfg, params, prompts = _tiny_llama()
+    aot_dir = tempfile.mkdtemp(prefix="aot_budget_http_")
+    export_engine(_engine(cfg, params), aot_dir)
+
+    def workload():
+        import http.client
+        import socket
+
+        from paddle_tpu.serving import HttpServingServer, ServingFrontend
+        from paddle_tpu.serving.http import iter_sse
+
+        eng = _engine(cfg, params, aot_dir=aot_dir)
+        fe = ServingFrontend(eng)
+        srv = HttpServingServer(fe, heartbeat_s=0.02,
+                                retry_grace_s=0.0).start()
+        try:
+            for i, p in enumerate(prompts[:2]):
+                payload = {"prompt_ids": p.tolist(),
+                           "max_new_tokens": 4}
+                if i == 0:       # one sampled request through the
+                    payload.update(temperature=0.7, top_k=8,
+                                   seed=i + 1)  # warm sampler program
+                conn = http.client.HTTPConnection(
+                    srv.host, srv.port, timeout=120)
+                conn.request("POST", "/v1/generate",
+                             json.dumps(payload),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    raise RuntimeError(f"generate failed: "
+                                       f"{resp.status} {resp.read()}")
+                events = [e for e, _ in iter_sse(resp)]
+                conn.close()
+                if "done" not in events:
+                    raise RuntimeError(f"no terminal event: {events}")
+            # one mid-stream client disconnect: read a few bytes of the
+            # stream, vanish — the server must cancel and free
+            body = json.dumps({"prompt_ids": prompts[2].tolist(),
+                               "max_new_tokens": 16}).encode()
+            s = socket.create_connection((srv.host, srv.port),
+                                         timeout=30)
+            s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: b\r\n"
+                      b"Content-Type: application/json\r\n"
+                      b"Content-Length: " + str(len(body)).encode()
+                      + b"\r\nConnection: close\r\n\r\n" + body)
+            s.recv(256)
+            s.close()
+            report = srv.begin_shutdown(reason="budget scenario")
+            if report["kv_leaked_blocks"] != 0:
+                raise RuntimeError(f"leaked: {report}")
+            if not eng.aot_loaded:
+                raise RuntimeError(
+                    f"warm start fell back: {eng.aot_error}")
+        finally:
+            srv._httpd.server_close()
+
+    return workload
+
+
 SCENARIOS: Dict[str, Callable[[], Callable[[], None]]] = {
     "gpt_train": gpt_train,
     "serve_fresh": serve_fresh,
@@ -290,6 +358,7 @@ SCENARIOS: Dict[str, Callable[[], Callable[[], None]]] = {
     "serve_spec_warm": serve_spec_warm,
     "serve_recovery_warm": serve_recovery_warm,
     "fleet_warm": fleet_warm,
+    "serve_http_warm": serve_http_warm,
 }
 
 
@@ -333,11 +402,14 @@ def render_md(counts: Dict[str, int]) -> str:
         "Budgets are CPU tier-1 numbers; `serve_aot_warm` is the ISSUE 6"
         " acceptance row, `serve_aot_warm_sampled` the ISSUE 7 one, "
         "`serve_spec_warm` the ISSUE 8 one, `serve_recovery_warm` the "
-        "ISSUE 11 one, and `fleet_warm` the ISSUE 12 one: an AOT-warm "
-        "engine start must be ZERO backend compiles — greedy, sampled, "
-        "speculative, rebuilt mid-traffic by crash recovery (replay "
-        "included), or serving as a fleet replica through a replica "
-        "kill, cross-replica re-placement, and a graceful drain.",
+        "ISSUE 11 one, `fleet_warm` the ISSUE 12 one, and "
+        "`serve_http_warm` the ISSUE 13 one: an AOT-warm engine start "
+        "must be ZERO backend compiles — greedy, sampled, speculative, "
+        "rebuilt mid-traffic by crash recovery (replay included), "
+        "serving as a fleet replica through a replica kill, "
+        "cross-replica re-placement, and a graceful drain, or serving "
+        "real sockets through the HTTP front door with a mid-stream "
+        "disconnect and a graceful shutdown.",
         "",
     ]
     for name, n in counts.items():
