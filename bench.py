@@ -625,6 +625,90 @@ def _serve_http_extra(cfg, params, *, mb, nb, on_accel, t0, new,
         return {"http_error": f"{type(e).__name__}: {e}"}
 
 
+def _serve_prefix_extra(cfg, params, *, mb, nb, on_accel, t0, new,
+                        aot_dir):
+    """Cross-request prefix-cache A/B for the serve config (ISSUE 14),
+    on compile-warm engines reusing the aot_warm row's artifacts: the
+    SAME seeded multi-tenant shared-prefix loadgen run with the cache
+    on vs off, reporting TTFT p50/p99, prefill-tokens-computed (the
+    direct FLOP savings), hit rate, offload/restore counts, and the
+    zero-leak check.  Never fails the row — errors land in
+    extra.prefix_cache_error."""
+    try:
+        from paddle_tpu.inference.serving import ContinuousBatchingEngine
+        from paddle_tpu.observability import CompileMonitor
+        from paddle_tpu.serving import (AdmissionConfig, LoadGenConfig,
+                                        PoissonLoadGenerator,
+                                        ServingFrontend)
+        from paddle_tpu.serving.prefix_cache import PrefixCacheConfig
+
+        if aot_dir is None:
+            raise RuntimeError("no AOT artifacts from the aot_warm row")
+        lg = LoadGenConfig(
+            n_requests=16 if not on_accel else 48,
+            rate_rps=150.0 if not on_accel else 16.0, seed=14,
+            prompt_len=(3, t0), max_new_tokens=(3, new),
+            sampled_fraction=0.25, cancel_fraction=0.1,
+            # tenant prefixes must span >= 1 full 16-token KV block or
+            # nothing is block-aligned enough to cache
+            tenants=3, tenant_prefix_len=(2 * t0, 4 * t0),
+            tenant_reuse_prob=0.8,
+            slo_ttft_s=5.0 if not on_accel else 2.0,
+            slo_tpot_s=1.0 if not on_accel else 0.25)
+
+        def run(cache_on):
+            eng = ContinuousBatchingEngine(
+                cfg, params, max_batch=mb, block_size=16,
+                num_blocks=nb, prefill_buckets=(t0,), aot_dir=aot_dir,
+                enable_prefix_caching=cache_on,
+                prefix_cache_config=PrefixCacheConfig(
+                    offload_capacity_bytes=1 << 26) if cache_on
+                else None)
+            fe = ServingFrontend(
+                eng, admission=AdmissionConfig(max_queue_len=64))
+            rep = PoissonLoadGenerator(fe, lg).run()
+            return rep, eng
+
+        monitor = CompileMonitor().install()
+        try:
+            rep_on, eng_on = run(True)
+        finally:
+            monitor.uninstall()
+        rep_off, _ = run(False)
+        ps = eng_on.prefix_stats()
+        d_on, d_off = rep_on.to_dict(), rep_off.to_dict()
+        return {"prefix_cache": {
+            "ttft_p50_s": {
+                "cache_on": None if rep_on.ttft_s is None
+                else rep_on.ttft_s["p50"],
+                "cache_off": None if rep_off.ttft_s is None
+                else rep_off.ttft_s["p50"]},
+            "ttft_p99_s": {
+                "cache_on": None if rep_on.ttft_s is None
+                else rep_on.ttft_s["p99"],
+                "cache_off": None if rep_off.ttft_s is None
+                else rep_off.ttft_s["p99"]},
+            "prefill_tokens_computed": {
+                "cache_on": (rep_on.prefix or {}).get(
+                    "prefill_tokens_computed"),
+                "cache_off": (rep_off.prefix or {}).get(
+                    "prefill_tokens_computed")},
+            "hit_rate": (rep_on.prefix or {}).get("hit_rate"),
+            "hit_tokens": (rep_on.prefix or {}).get("hit_tokens"),
+            "offloads": ps["offloads"], "restores": ps["restores"],
+            "goodput_rps": {"cache_on": d_on["goodput_rps"],
+                            "cache_off": d_off["goodput_rps"]},
+            "by_tenant": d_on.get("by_tenant"),
+            "cache_backend_compiles": monitor.n_compiles,
+            "kv_leaked_blocks": d_on["kv_leaked_blocks"],
+            "note": "one-core CPU proxy: prefill-tokens-computed and "
+                    "hit rate are the signal; TTFT deltas only track "
+                    "them loosely when the whole run shares one core",
+        }}
+    except Exception as e:
+        return {"prefix_cache_error": f"{type(e).__name__}: {e}"}
+
+
 def _serve_decode_block_extra(cfg, params, eng_fused, *, mb, nb, on_accel,
                               t0, new):
     """Fused-vs-per-op decode A/B for the serve row (ISSUE 9): the same
@@ -924,6 +1008,9 @@ def run_config_bench(config: str):
             cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
             new=new, aot_dir=aot_dir_out.get("dir")))
         out["extra"].update(_serve_http_extra(
+            cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
+            new=new, aot_dir=aot_dir_out.get("dir")))
+        out["extra"].update(_serve_prefix_extra(
             cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
             new=new, aot_dir=aot_dir_out.get("dir")))
     elif config == "decode":

@@ -84,6 +84,14 @@ def engine_config(engine) -> Dict[str, Any]:
         # engine (and vice versa)
         "decode_block_fused": bool(getattr(engine, "fused_decode_block",
                                            True)),
+        # the cross-request prefix cache (ISSUE 14) never changes a
+        # compiled program, so its POLICY knobs (offload capacity,
+        # enabled flag) stay out of the hash — but the block-key SCHEME
+        # defines what a cached chain means, and a scheme bump must
+        # invalidate warm starts rather than let two generations
+        # disagree about prefix identity
+        "prefix_scheme": type(engine.prefix_cache).SCHEME
+        if hasattr(engine, "prefix_cache") else None,
         "params_treedef": params_td,
         "params_leaves": params_leaves,
     }

@@ -35,7 +35,23 @@ from ..ops.decode_block import make_norm_ffn as _make_rms_ffn  # noqa: F401
 #     so the decode step, the chunk fill, and the spec-decode draft all
 #     read one definition; the old name stays importable for callers.
 
-__all__ = ["ContinuousBatchingEngine", "GenRequest", "build_sampler"]
+__all__ = ["ContinuousBatchingEngine", "GenRequest", "build_sampler",
+           "derive_sample_seed"]
+
+
+def derive_sample_seed(seed: int, sample_idx: int) -> int:
+    """Deterministic per-sample seed for n>1 parallel sampling (ROADMAP
+    5(b)): sample 0 keeps the request's own seed (so ``n=1`` is exactly
+    the single-request path), later samples hash (seed, sample_idx) —
+    the per-sample stream is then keyed (seed, sample_idx, absolute
+    position) end to end, and ``submit(n=k)`` is bit-identical to k
+    independent submits carrying these derived seeds (pinned by
+    tests/test_prefix_cache.py)."""
+    if sample_idx == 0:
+        return int(seed)
+    import zlib
+    return int(zlib.crc32(
+        np.asarray([seed, sample_idx], np.int64).tobytes()) & 0x7FFFFFFF)
 
 
 class _RefPool:
@@ -180,6 +196,13 @@ class ContinuousBatchingEngine:
         on re-admission, bit-identically.  With uniform priorities
         (the default) nothing is ever preempted, so the knob is inert
         for existing workloads.
+      prefix_cache_config: a :class:`~paddle_tpu.serving.prefix_cache.
+        PrefixCacheConfig` tuning the cross-request prefix cache
+        (ISSUE 14) — most importantly ``offload_capacity_bytes``, the
+        bounded host-RAM tier that parks evicted prefix pages as
+        CRC-checked byte copies and restores them by exact-byte scatter
+        (no recompute) on the next hit.  Default policy (no offload)
+        matches the pre-ISSUE-14 drop-on-eviction behavior.
 
     The engine keeps its own page table rather than reusing
     ops/paged_kv.PagedKVCache: that class sizes its table [B, num_blocks]
@@ -194,7 +217,8 @@ class ContinuousBatchingEngine:
                  enable_prefix_caching: bool = True,
                  prefill_buckets=None, aot_dir: Optional[str] = None,
                  fused_decode_block: bool = True, spec_config=None,
-                 enable_preemption: bool = True, spill_tier=None):
+                 enable_preemption: bool = True, spill_tier=None,
+                 prefix_cache_config=None):
         if getattr(cfg, "moe_num_experts", 0) and \
                 getattr(cfg, "moe_router", "topk") != "topk":
             raise NotImplementedError("decode serves token-choice only")
@@ -230,15 +254,20 @@ class ContinuousBatchingEngine:
         self.tokens = np.zeros((max_batch,), np.int32)
         self.alloc = _RefPool(num_blocks)
         self.slot_pages: List[List[int]] = [[] for _ in range(max_batch)]
-        # automatic prefix caching: exact prompt-prefix bytes (block
-        # aligned) -> phys page; the index holds one reference per entry
-        # and is evicted LRU under page pressure
+        # cross-request prefix caching (ISSUE 14): a radix tree over
+        # committed prompt pages, keyed by chained block digests; the
+        # cache holds one pool reference per resident block, evicted
+        # LRU (leaf-first) under page pressure — optionally into a
+        # bounded CRC-checked host-RAM offload tier that restores by
+        # exact-byte scatter instead of recompute
+        from ..serving.prefix_cache import PrefixCache
         self.enable_prefix_caching = bool(enable_prefix_caching)
-        self.prefix_index: "collections.OrderedDict[bytes, int]" = \
-            collections.OrderedDict()
+        self.prefix_cache = PrefixCache(block_size,
+                                        config=prefix_cache_config)
         self.stats = {"prefix_blocks_reused": 0,
                       "prefix_blocks_registered": 0,
-                      "pages_allocated": 0}
+                      "pages_allocated": 0,
+                      "prefill_tokens_computed": 0}
         self.slots: List[Optional[GenRequest]] = [None] * max_batch
         self.queue: "collections.deque[GenRequest]" = collections.deque()
         self.finished: Dict[int, np.ndarray] = {}
@@ -555,70 +584,176 @@ class ContinuousBatchingEngine:
     def _blocks_needed(self, n_tokens: int) -> int:
         return -(-n_tokens // self.BS)
 
+    @property
+    def prefix_index(self) -> "collections.OrderedDict[bytes, int]":
+        """Compatibility view of the HBM-resident tier of the prefix
+        cache: ``{chained block digest: phys page}``, LRU order — the
+        leak report and the pool-invariant tests read this; the live
+        structure is the radix tree (``self.prefix_cache``)."""
+        return collections.OrderedDict(self.prefix_cache.resident_items())
+
     def _cached_prefix(self, prompt: np.ndarray):
-        """Longest indexed block-aligned prefix.  When the prompt is an
-        exact multiple of BS, at least one block is left uncached so the
-        suffix prefill has >= 1 token to produce next-token logits."""
+        """Longest cached block-aligned prefix: ``(resident_blocks,
+        resident_pages, offloaded_nodes)``.  Resident pages are claimed
+        via ``_RefPool.share``; offloaded nodes restore by exact-byte
+        scatter into freshly acquired pages (``_restore_offloaded``).
+        When the prompt is an exact multiple of BS, at least one block
+        is left uncached so the suffix prefill has >= 1 token to
+        produce next-token logits."""
         if not self.enable_prefix_caching:
-            return 0, []
+            return 0, [], []
         full = len(prompt) // self.BS
         lookup = full - 1 if len(prompt) % self.BS == 0 else full
-        shared: List[int] = []
-        for key in self._block_keys(prompt, lookup):
-            phys = self.prefix_index.get(key)
-            if phys is None:
-                break
-            self.prefix_index.move_to_end(key)
-            shared.append(phys)
-        return len(shared), shared
+        pages, off = self.prefix_cache.walk(
+            self._block_keys(prompt, lookup))
+        return len(pages), pages, off
 
     def _block_keys(self, prompt: np.ndarray, n: int) -> List[bytes]:
-        """Chained per-block digests (the vLLM scheme): key_b =
-        H(key_{b-1} || block_b tokens) — O(T0) total instead of the
-        O(T0^2) cumulative-bytes keys, same exact-prefix semantics."""
-        import hashlib
-        keys, prev = [], b""
-        for b in range(n):
-            h = hashlib.sha1(
-                prev + prompt[b * self.BS:(b + 1) * self.BS].tobytes())
-            prev = h.digest()
-            keys.append(prev)
-        return keys
+        """Chained per-block digests — ONE definition shared with the
+        fleet router's affinity summaries
+        (``serving.prefix_cache.block_keys``)."""
+        return self.prefix_cache.keys_for(prompt, n)
+
+    def prefix_match_blocks(self, keys: List[bytes]) -> int:
+        """Longest cached chain prefix for a precomputed key list,
+        WITHOUT touching cache recency or refcounts — the read-only
+        summary ``EngineRouter`` consults for prefix-affinity
+        placement."""
+        if not self.enable_prefix_caching:
+            return 0
+        return self.prefix_cache.match_blocks(keys)
 
     def _acquire_with_eviction(self, n: int) -> Optional[List[int]]:
-        """Acquire pages, LRU-evicting prefix-index entries on
-        pressure.  Only entries whose page is held SOLELY by the index
-        (ref == 1) are evicted — popping a shared entry frees nothing and
-        would throw away prefixes other requests still hit.  Callers must
-        take their own reference on reused pages BEFORE acquiring, or an
-        evicted twin of a 'shared' page could be handed back as private
-        and the chunk fill would overwrite cached prefix KV."""
+        """Acquire pages, LRU-evicting prefix-cache blocks on pressure
+        (leaf-first, so surviving chains stay walkable).  Only blocks
+        whose page is held SOLELY by the cache (ref == 1) are evicted —
+        evicting a shared block frees nothing and would throw away
+        prefixes other requests still hit.  With an offload budget the
+        victim's exact page bytes park in the host-RAM tier before the
+        page is released (restored by scatter on the next hit).
+        Callers must take their own reference on reused pages BEFORE
+        acquiring, or an evicted twin of a 'shared' page could be
+        handed back as private and the chunk fill would overwrite
+        cached prefix KV."""
         while True:
             got = self.alloc.acquire(n)
             if got is not None:
                 self.stats["pages_allocated"] += n
                 return got
-            evictable = next(
-                (k for k, p in self.prefix_index.items()
-                 if self.alloc.ref.get(p) == 1), None)
-            if evictable is None:
+            node = self.prefix_cache.evictable(
+                lambda p: self.alloc.ref.get(p, 0))
+            if node is None:
                 return None
-            self.alloc.release([self.prefix_index.pop(evictable)])
+            self._evict_prefix_block(node)
+
+    def _evict_prefix_block(self, node) -> None:
+        """Evict one resident cache block: offload its exact page bytes
+        to the bounded host tier when configured (host-side gather —
+        the same zero-compile convention as ``snapshot_slot``), then
+        release the cache's pool reference."""
+        cache = self.prefix_cache
+        if cache.wants_offload:
+            k = np.asarray(self.pool_k)[:, node.phys].copy()
+            v = np.asarray(self.pool_v)[:, node.phys].copy()
+            phys = cache.evict(node, k, v)
+        else:
+            phys = cache.evict(node)
+        self.alloc.release([phys])
+        from ..observability import REGISTRY
+        if REGISTRY.enabled:
+            REGISTRY.counter("serve.prefix.evictions_total").inc()
+            if cache.wants_offload:
+                REGISTRY.counter("serve.prefix.offloads_total").inc()
+                REGISTRY.gauge("serve.prefix.offloaded_bytes").set(
+                    cache.host_bytes)
+
+    def _restore_offloaded(self, off, priv: List[int]) -> int:
+        """Scatter offloaded prefix blocks' exact bytes into the first
+        ``len(off)`` freshly acquired private pages, promoting each back
+        to the resident tier (the cache takes a reference, exactly as
+        if the block had never left HBM).  A CRC failure stops the
+        restore at that block — typed event, ``restore_failures``
+        counter — and the caller recomputes the remaining suffix by
+        ordinary prefill: bit-rot costs FLOPs, never tokens.  Returns
+        the number of blocks restored.  One host round trip total; the
+        device copy runs through the pool-shaped op pre-warmed at
+        construction (zero backend compiles, the ``serve_prefix_warm``
+        budget row)."""
+        if not off:
+            return 0
+        from ..observability import REGISTRY
+        from ..serving.resilience import SpillCorruptError
+        pk = pv = None
+        restored = 0
+        for j, node in enumerate(off):
+            try:
+                node.verify()
+            except SpillCorruptError as e:
+                self.prefix_cache.drop_host(node)
+                if REGISTRY.enabled:
+                    REGISTRY.counter(
+                        "serve.prefix.restore_failures_total").inc()
+                    REGISTRY.event("serve", action="prefix_bitrot",
+                                   depth=int(node.depth),
+                                   error=str(e)[:200])
+                break
+            if pk is None:
+                pk = np.asarray(self.pool_k).copy()
+                pv = np.asarray(self.pool_v).copy()
+            pk[:, priv[j]] = node.k_bytes
+            pv[:, priv[j]] = node.v_bytes
+            self.prefix_cache.promote(node, priv[j])
+            self.alloc.share([priv[j]])
+            restored += 1
+        if pk is not None:
+            # owned copies, never aliases: the decode step donates the
+            # pools (see restore_into_slot for the full rationale)
+            self.pool_k = jnp.array(pk)
+            self.pool_v = jnp.array(pv)
+        if restored and REGISTRY.enabled:
+            REGISTRY.counter("serve.prefix.restores_total").inc(restored)
+            REGISTRY.gauge("serve.prefix.offloaded_bytes").set(
+                self.prefix_cache.host_bytes)
+        return restored
+
+    def _note_prefix_lookup(self, hit_blocks: int) -> None:
+        """Account one admission-time cache consultation (miss or
+        hit).  ``hit_blocks`` counts resident + restored blocks whose
+        compute the suffix prefill will skip."""
+        s = self.prefix_cache.stats
+        s["lookups"] += 1
+        from ..observability import REGISTRY
+        if REGISTRY.enabled:
+            REGISTRY.counter("serve.prefix.lookups_total").inc()
+        if hit_blocks:
+            s["hits"] += 1
+            s["hit_blocks"] += hit_blocks
+            s["hit_tokens"] += hit_blocks * self.BS
+            if REGISTRY.enabled:
+                REGISTRY.counter("serve.prefix.hits_total").inc()
+                REGISTRY.counter("serve.prefix.hit_tokens_total").inc(
+                    hit_blocks * self.BS)
 
     def _register_prefix(self, prompt: np.ndarray,
                          table: List[int]) -> None:
-        """Index every read-only (full, decode-untouched) prompt block.
-        Decode writes start at position len(prompt), so all ``full``
-        blocks are immutable for the sequence's lifetime."""
+        """Insert every read-only (full, decode-untouched) prompt block
+        into the radix tree — the cache parks one pool reference per
+        new block, so retirement releases only the slot's references
+        and the prefix outlives the request.  Decode writes start at
+        position len(prompt), so all ``full`` blocks are immutable for
+        the sequence's lifetime."""
         if not self.enable_prefix_caching:
             return
-        for b, key in enumerate(self._block_keys(prompt,
-                                                 len(prompt) // self.BS)):
-            if key in self.prefix_index:
-                continue
-            self.prefix_index[key] = table[b]
-            self.alloc.share([table[b]])
-            self.stats["prefix_blocks_registered"] += 1
+        full = len(prompt) // self.BS
+        took = self.prefix_cache.insert(self._block_keys(prompt, full),
+                                        table[:full])
+        if took:
+            self.alloc.share(took)
+            self.stats["prefix_blocks_registered"] += len(took)
+            from ..observability import REGISTRY
+            if REGISTRY.enabled:
+                REGISTRY.counter("serve.prefix.inserts_total").inc(
+                    len(took))
 
     def _best_waiting_index(self) -> Optional[int]:
         """Queue index of the next request to admit: highest priority
@@ -660,8 +795,10 @@ class ContinuousBatchingEngine:
                 # acquires only the remainder — the shortfall tests
                 # must see the same need, or a saturated pool would
                 # spill a low-priority tenant for a waiter that was
-                # already admissible via shared prefix pages
-                L, shared = self._cached_prefix(cand.prompt)
+                # already admissible via shared prefix pages (offloaded
+                # blocks still consume fresh pages, so they stay in
+                # ``need``)
+                L, shared, _off = self._cached_prefix(cand.prompt)
                 need = self._blocks_needed(
                     len(cand.prompt) + cand.max_new_tokens) - L
             shared_set = set(shared)
@@ -832,13 +969,15 @@ class ContinuousBatchingEngine:
             [req.prompt, np.asarray(req.out[:-1], np.int32)]) \
             if len(req.out) > 1 else req.prompt
         need = self._blocks_needed(len(req.prompt) + req.max_new_tokens)
-        L, shared = self._cached_prefix(committed)
+        L, shared, off = self._cached_prefix(committed)
         self.alloc.share(shared)
         priv = self._acquire_with_eviction(need - L)
         if priv is None:
             self.alloc.release(shared)
             return False
-        self.stats["prefix_blocks_reused"] += L
+        restored = self._restore_offloaded(off, priv)
+        self._note_prefix_lookup(L + restored)
+        self.stats["prefix_blocks_reused"] += L + restored
         del self.queue[idx]
         table = shared + priv
         self.block_table[slot, :] = -1
@@ -846,7 +985,7 @@ class ContinuousBatchingEngine:
         self.slot_pages[slot] = table
         shadow = GenRequest(req.req_id, committed, 1, None)
         try:
-            self._prefill_into_slot(slot, shadow, L)
+            self._prefill_into_slot(slot, shadow, L + restored)
             self._register_prefix(req.prompt, table)
         except BaseException:
             # exactly-once release, same contract as the fresh path
@@ -875,6 +1014,10 @@ class ContinuousBatchingEngine:
         (tests/faults.py) has one seam for crash-mid-prefill."""
         from ..models.generation import build_llama_decoder
         T0 = len(req.prompt)
+        # the honest prefill-cost meter the cache A/B bench reads:
+        # tokens whose KV this admission actually computes (cache hits
+        # and offload restores shrink it; padding never counts)
+        self.stats["prefill_tokens_computed"] += T0 - L * self.BS
         table = self.slot_pages[slot]
         if self._buckets is not None:
             # declared-bucket prefill (cold prompts AND cache-hit
@@ -949,7 +1092,7 @@ class ContinuousBatchingEngine:
             T0 = len(req.prompt)
             total = T0 + req.max_new_tokens
             need = self._blocks_needed(total)
-            L, shared = self._cached_prefix(req.prompt)
+            L, shared, off = self._cached_prefix(req.prompt)
             # take the slot's reference FIRST: eviction under pressure
             # must never free (and re-hand-out) a page we are reusing
             self.alloc.share(shared)
@@ -957,14 +1100,19 @@ class ContinuousBatchingEngine:
             if priv is None:
                 self.alloc.release(shared)
                 break                      # head-of-line waits for pages
-            self.stats["prefix_blocks_reused"] += L
+            # offloaded continuation: exact bytes scatter into the
+            # leading private pages (no recompute); a CRC failure
+            # cleanly demotes the rest to ordinary suffix prefill
+            restored = self._restore_offloaded(off, priv)
+            self._note_prefix_lookup(L + restored)
+            self.stats["prefix_blocks_reused"] += L + restored
             del self.queue[idx]
             table = shared + priv
             self.block_table[slot, :] = -1
             self.block_table[slot, :need] = table
             self.slot_pages[slot] = table
             try:
-                logits = self._prefill_into_slot(slot, req, L)
+                logits = self._prefill_into_slot(slot, req, L + restored)
                 self._register_prefix(req.prompt, table)
                 first = self._pick_token(req, np.asarray(logits)[0],
                                          position=T0)
@@ -1176,6 +1324,21 @@ class ContinuousBatchingEngine:
         s: Dict[str, object] = dict(self.resilience)
         s["spilled_requests"] = len(self._spill)
         s["spilled_bytes"] = self.spilled_bytes
+        return s
+
+    def prefix_stats(self) -> Dict[str, object]:
+        """Cross-request prefix-cache counters and point-in-time state
+        for bench rows / the ``serve.prefix.*`` gauges
+        (``ServeMetrics.publish_engine``)."""
+        s: Dict[str, object] = dict(self.prefix_cache.stats)
+        s["enabled"] = self.enable_prefix_caching
+        s["cached_blocks"] = self.prefix_cache.resident_blocks
+        s["offloaded_blocks"] = self.prefix_cache.offloaded_blocks
+        s["offloaded_bytes"] = self.prefix_cache.host_bytes
+        s["prefill_tokens_computed"] = \
+            self.stats["prefill_tokens_computed"]
+        lk = s["lookups"]
+        s["hit_rate"] = (s["hits"] / lk) if lk else None
         return s
 
     def spec_stats(self) -> Optional[Dict[str, object]]:

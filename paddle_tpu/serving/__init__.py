@@ -28,6 +28,14 @@ preemption with CRC-checked host-RAM KV spill/restore and the
 :class:`SupervisedEngine` crash wrapper (retry/backoff, AOT-warm
 rebuild + deterministic replay, circuit breaker).
 
+Prefix cache (ISSUE 14): ``serving/prefix_cache.py`` promotes the
+engine's within-batch prefix sharing to a cross-request radix tree
+over committed KV pages with a bounded CRC-checked host-RAM offload
+tier; ``EngineRouter`` placement learns prefix affinity (route to the
+replica already holding the prefix, anti-herd capped), and
+``ServingFrontend.submit(n=k)`` fans one prompt out to k
+refcount-shared parallel samples.
+
 See ``docs/serving.md`` for the state machine, the streaming API, the
 admission knobs, and the metric catalogue.
 """
@@ -38,6 +46,7 @@ from .frontend import (AdmissionConfig, RequestAborted, RequestHandle,
 from .http import HttpServingServer
 from .loadgen import LoadGenConfig, LoadReport, PoissonLoadGenerator
 from .metrics import ServeMetrics
+from .prefix_cache import PrefixCache, PrefixCacheConfig
 from .resilience import (EngineCrashError, KVSnapshot, PortableRequest,
                          RecoveryExhaustedError, ResilienceError,
                          RetryPolicy, SpillCorruptError, SpillTier,
@@ -47,7 +56,8 @@ __all__ = [
     "AdmissionConfig", "EngineCrashError", "EngineRouter",
     "FleetExhaustedError", "HttpServingServer", "KVSnapshot",
     "LoadGenConfig", "LoadReport", "PoissonLoadGenerator",
-    "PortableRequest", "RecoveryExhaustedError", "ReplicaState",
+    "PortableRequest", "PrefixCache", "PrefixCacheConfig",
+    "RecoveryExhaustedError", "ReplicaState",
     "RequestAborted", "RequestHandle", "RequestRejected",
     "RequestState", "ResilienceError", "RetryPolicy", "ServeMetrics",
     "ServingFrontend", "SpillCorruptError", "SpillTier",

@@ -14,7 +14,10 @@ resilience stack works unchanged at fleet scale::
     router = EngineRouter([factory] * 4)          # 4 warm replicas
     fe = ServingFrontend(router)                  # unchanged
 
-* **Placement** is KV-aware least-loaded: among replicas whose health
+* **Placement** is prefix-affine, KV-aware least-loaded: a request
+  whose prompt shares a cached prefix routes to the replica whose
+  radix tree already holds it (deepest match wins, bounded by an
+  anti-herd cap — ISSUE 14); otherwise, among replicas whose health
   admits traffic, the one with the least (queue + running) work wins,
   KV-pool utilization breaking ties.  The router-level
   :class:`~paddle_tpu.serving.frontend.AdmissionConfig` rejects only
@@ -144,13 +147,27 @@ class EngineRouter:
         degraded) replica passes it.
       heal_after_steps: consecutive clean supervised steps before a
         DEGRADED replica is HEALTHY again.
+      prefix_affinity: route a request sharing a cached prefix to the
+        replica already holding it (ISSUE 14): placement consults each
+        candidate's radix-tree summary (``prefix_match_blocks`` over
+        the request's chained block digests) and the deepest match
+        wins, least-loaded as tiebreak — a cache hit skips the shared
+        prefix's prefill entirely, so affinity beats raw load balance
+        whenever a prefix is actually cached.
+      affinity_load_slack: the anti-herd cap — the affinity replica is
+        taken only while its outstanding work (queue + running) exceeds
+        the least-loaded candidate's by at most this many requests;
+        past the cap the load balancer wins (counter
+        ``affinity_capped``), so a popular system prompt can never
+        starve the fleet onto one replica.
       registry / clock / sleep: forwarded to each supervisor.
     """
 
     def __init__(self, factories: Sequence[Callable[[], object]], *,
                  policy: Optional[RetryPolicy] = None,
                  admission: Optional[AdmissionConfig] = None,
-                 heal_after_steps: int = 8, registry=None,
+                 heal_after_steps: int = 8, prefix_affinity: bool = True,
+                 affinity_load_slack: int = 2, registry=None,
                  clock=None, sleep=None):
         if not factories:
             raise ValueError("EngineRouter needs at least one replica "
@@ -158,6 +175,8 @@ class EngineRouter:
         self.policy = policy
         self.admission = admission or AdmissionConfig()
         self.heal_after_steps = int(heal_after_steps)
+        self.prefix_affinity = bool(prefix_affinity)
+        self.affinity_load_slack = int(affinity_load_slack)
         self._reg = REGISTRY if registry is None else registry
         self._sup_kwargs = {}
         if clock is not None:
@@ -180,7 +199,7 @@ class EngineRouter:
         self.stats: Dict[str, int] = {
             "placements": 0, "replacements": 0, "rebalanced": 0,
             "snapshot_migrations": 0, "deaths": 0, "drains": 0,
-            "synthesized": 0,
+            "synthesized": 0, "affinity_hits": 0, "affinity_capped": 0,
         }
 
     # ------------------------------------------------------------------
@@ -269,16 +288,60 @@ class EngineRouter:
         return (eng.queue_depth + eng.active_requests,
                 round(eng.kv_utilization(), 6), rep.idx)
 
-    def _pick_replica(self, need: int,
-                      exclude: Optional[int] = None) -> Optional[_Replica]:
+    def _prefix_keys(self, prompt: np.ndarray) -> Optional[List[bytes]]:
+        """The request's chained block digests (computed ONCE per
+        placement; every replica summary is consulted with the same
+        list), or None when affinity is off / the prompt spans no full
+        block."""
+        if not self.prefix_affinity:
+            return None
+        from .prefix_cache import block_keys
+        full = len(prompt) // self._block_size
+        lookup = full - 1 if full and len(prompt) % self._block_size == 0 \
+            else full
+        if lookup <= 0:
+            return None
+        return block_keys(prompt, lookup, self._block_size)
+
+    def _pick_replica(self, need: int, exclude: Optional[int] = None,
+                      prefix_keys: Optional[List[bytes]] = None
+                      ) -> Optional[_Replica]:
         """Least-loaded admitting replica, HEALTHY tier strictly before
-        DEGRADED — degraded replicas take new work only as overflow."""
+        DEGRADED — degraded replicas take new work only as overflow.
+        With ``prefix_keys``, prefix affinity runs first within the
+        tier: the deepest cached-chain match wins (least-loaded
+        tiebreak) unless the anti-herd cap says the affinity target is
+        already ``affinity_load_slack`` requests busier than the
+        least-loaded candidate — then load balance wins."""
         for state in (ReplicaState.HEALTHY, ReplicaState.DEGRADED):
             cands = [r for r in self._replicas
                      if r.live and r.state is state and r.idx != exclude
                      and self._replica_admits(r, need)]
-            if cands:
-                return min(cands, key=self._load_key)
+            if not cands:
+                continue
+            best = min(cands, key=self._load_key)
+            if prefix_keys:
+                matched = [(r.sup.prefix_match_blocks(prefix_keys), r)
+                           for r in cands]
+                aff = [(m, r) for m, r in matched if m > 0]
+                if aff:
+                    _, target = min(
+                        aff, key=lambda t: (-t[0],) + self._load_key(t[1]))
+                    t_load = (target.sup.queue_depth
+                              + target.sup.active_requests)
+                    b_load = best.sup.queue_depth + best.sup.active_requests
+                    if target is best or \
+                            t_load <= b_load + self.affinity_load_slack:
+                        self.stats["affinity_hits"] += 1
+                        if self._reg.enabled:
+                            self._reg.counter(
+                                "serve.fleet.affinity_hits_total").inc()
+                        return target
+                    self.stats["affinity_capped"] += 1
+                    if self._reg.enabled:
+                        self._reg.counter(
+                            "serve.fleet.affinity_capped_total").inc()
+            return best
         return None
 
     def add_request(self, prompt_ids, max_new_tokens: int,
@@ -299,7 +362,8 @@ class EngineRouter:
         if not self._live():
             raise ValueError("no live replica in the fleet")
         need = self._blocks_needed(len(prompt) + max_new_tokens)
-        rep = self._pick_replica(need)
+        rep = self._pick_replica(need,
+                                 prefix_keys=self._prefix_keys(prompt))
         if rep is None:
             raise ValueError(
                 f"no healthy replica can admit: demand {need} blocks "
@@ -497,7 +561,9 @@ class EngineRouter:
             if portable.snapshot is not None \
             else self._blocks_needed(
                 len(portable.prompt) + portable.max_new)
-        target = self._pick_replica(need, exclude=p.replica)
+        target = self._pick_replica(
+            need, exclude=p.replica,
+            prefix_keys=self._prefix_keys(portable.prompt))
         if target is None:
             # admission knobs must not strand an ALREADY-admitted
             # request: fall back to any live replica, least loaded
@@ -726,6 +792,23 @@ class EngineRouter:
         keys.setdefault("spilled_bytes", 0)
         keys.setdefault("spilled_requests", 0)
         return keys
+
+    def prefix_stats(self) -> Dict[str, object]:
+        """Fleet-wide prefix-cache rollup: summed per-replica counters
+        plus the router's own affinity counters (``hit_rate`` is
+        recomputed over the summed lookups, never averaged)."""
+        total: Dict[str, object] = {}
+        for r in self._live():
+            for k, v in r.sup.prefix_stats().items():
+                if k == "hit_rate" or isinstance(v, bool):
+                    continue
+                if isinstance(v, (int, float)):
+                    total[k] = total.get(k, 0) + v
+        lk = total.get("lookups", 0)
+        total["hit_rate"] = (total.get("hits", 0) / lk) if lk else None
+        total["affinity_hits"] = self.stats["affinity_hits"]
+        total["affinity_capped"] = self.stats["affinity_capped"]
+        return total
 
     def aot_stats(self) -> Dict[str, object]:
         return {f"replica{r.idx}": r.sup.aot_stats()

@@ -425,7 +425,7 @@ class ServingFrontend:
     def submit(self, prompt_ids, max_new_tokens: int, *,
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0, top_k: Optional[int] = None,
-               top_p: Optional[float] = None, seed: int = 0,
+               top_p: Optional[float] = None, seed: int = 0, n: int = 1,
                priority: int = 0,
                deadline_s: Optional[float] = None,
                max_queue_time_s: Optional[float] = None,
@@ -434,7 +434,36 @@ class ServingFrontend:
         """Admit one request.  Never raises for load reasons — an
         over-capacity submit returns a handle already in REJECTED (the
         caller's fast-fail signal); genuinely malformed requests
-        (empty prompt, zero budget) still raise ``ValueError``."""
+        (empty prompt, zero budget) still raise ``ValueError``.
+
+        ``n > 1`` (ROADMAP 5(b)) fans the request out to n parallel
+        samples sharing ONE prompt KV: every sample is an ordinary
+        engine request whose prompt pages are refcount-shared through
+        the cross-request prefix cache (the first sample prefills and
+        registers, the rest claim the cached pages — zero new compiled
+        programs, the sampler is already padded per geometry), and each
+        streams on its own PRNG stream keyed (seed, sample_idx,
+        absolute position) via
+        :func:`~paddle_tpu.inference.serving.derive_sample_seed`.
+        Returns a LIST of n handles (bit-identical to n independent
+        submits carrying the derived seeds — pinned by
+        tests/test_prefix_cache.py)."""
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        if n > 1:
+            if temperature is None or temperature <= 0.0:
+                raise ValueError(
+                    "n > 1 parallel sampling needs temperature > 0 — "
+                    "n greedy samples of one prompt are n identical "
+                    "streams")
+            from ..inference.serving import derive_sample_seed
+            return [self.submit(
+                prompt_ids, max_new_tokens, eos_token_id=eos_token_id,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=derive_sample_seed(seed, i), priority=priority,
+                deadline_s=deadline_s, max_queue_time_s=max_queue_time_s,
+                stream_capacity=stream_capacity, on_token=on_token)
+                for i in range(n)]
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         cap = self._cap if stream_capacity is _UNSET else stream_capacity
         with self._lock:

@@ -1049,6 +1049,15 @@ class HttpTransport:
         return {"free_blocks": -1, "index_blocks": -1, "slot_blocks": -1,
                 "leaked": 0, "unaccounted": 0}
 
+    def prefix_stats(self) -> Optional[Dict[str, Any]]:
+        """Co-located server's prefix-cache counters (the loadgen's
+        ``LoadReport.prefix`` section), or None over a real network —
+        hit-rate is then read server-side from ``serve.prefix.*``."""
+        if self.server is None:
+            return None
+        fn = getattr(self.server.frontend.engine, "prefix_stats", None)
+        return fn() if callable(fn) else None
+
 
 # ---------------------------------------------------------------------
 # CLI: python -m paddle_tpu.serving.http --model llama_tiny --port 8821

@@ -91,6 +91,19 @@ class LoadGenConfig:
     # frontend to drive an ``EngineRouter``.
     kill_replica: Optional[int] = None
     kill_after_requests: int = 0
+    # multi-tenant shared-prefix traffic (ISSUE 14): a seeded pool of
+    # ``tenants`` system prompts (each ``tenant_prefix_len`` tokens);
+    # every planned request draws a tenant and, with probability
+    # ``tenant_reuse_prob``, PREPENDS that tenant's shared prompt to
+    # its random user suffix — the workload shape the cross-request
+    # prefix cache exists for.  With ``tenants=0`` (the default) no
+    # extra RNG draws happen, so pre-ISSUE-14 seeds reproduce their
+    # exact request sequences.  The tenant pool is part of the plan (a
+    # pure function of the seed), so in-process and HTTP-transport runs
+    # offer identical sequences (the PR 13 pin).
+    tenants: int = 0
+    tenant_prefix_len: Union[int, Tuple[int, int]] = 16
+    tenant_reuse_prob: float = 1.0
 
 
 @dataclass
@@ -102,6 +115,7 @@ class _Planned:
     seed: int
     cancel: bool
     priority: int = 0
+    tenant: Optional[int] = None       # set when a shared prefix applied
 
 
 @dataclass
@@ -135,6 +149,14 @@ class LoadReport:
     # an EngineRouter: each request is attributed to the replica that
     # FINISHED it (its final placement after any re-placement)
     by_replica: Optional[Dict[int, Dict[str, Any]]] = None
+    # prefix-cache effectiveness over THIS run (ISSUE 14): counter
+    # deltas from the engine's prefix_stats(), only when the serving
+    # stack exposes them
+    prefix: Optional[Dict[str, Any]] = None
+    # per-tenant goodput-under-SLO (ISSUE 14), only for multi-tenant
+    # runs: the fairness invariant is that a shared system prompt buys
+    # its tenant TTFT, not the fleet a hot spot
+    by_tenant: Optional[Dict[int, Dict[str, Any]]] = None
 
     def to_dict(self, include_requests: bool = False) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -155,6 +177,10 @@ class LoadReport:
             d["by_priority"] = self.by_priority
         if self.by_replica is not None:
             d["by_replica"] = self.by_replica
+        if self.prefix is not None:
+            d["prefix"] = self.prefix
+        if self.by_tenant is not None:
+            d["by_tenant"] = self.by_tenant
         if include_requests:
             d["per_request"] = self.per_request
         return d
@@ -238,17 +264,35 @@ class PoissonLoadGenerator:
         if cfg.priority_weights is not None:
             w = np.asarray(cfg.priority_weights, np.float64)
             weights = w / w.sum()
+        # multi-tenant shared prefixes (ISSUE 14): the seeded tenant
+        # pool is drawn FIRST, then per-request tenancy — all inside
+        # the ``tenants`` gate so tenantless configs keep their exact
+        # pre-ISSUE-14 draw sequence
+        tenant_prompts: List[np.ndarray] = []
+        if cfg.tenants > 0:
+            tlo, thi = _span(cfg.tenant_prefix_len)
+            for _ in range(cfg.tenants):
+                tl = int(rng.integers(tlo, thi + 1))
+                tenant_prompts.append(
+                    rng.integers(0, vocab, (tl,)).astype(np.int32))
         out: List[_Planned] = []
         for i in range(cfg.n_requests):
             t0 = int(rng.integers(plo, phi + 1))
+            prompt = rng.integers(0, vocab, (t0,)).astype(np.int32)
+            tenant: Optional[int] = None
+            if cfg.tenants > 0:
+                t = int(rng.integers(0, cfg.tenants))
+                if bool(rng.random() < cfg.tenant_reuse_prob):
+                    tenant = t
+                    prompt = np.concatenate([tenant_prompts[t], prompt])
             out.append(_Planned(
-                at=float(arrivals[i]),
-                prompt=rng.integers(0, vocab, (t0,)).astype(np.int32),
+                at=float(arrivals[i]), prompt=prompt,
                 max_new=int(rng.integers(nlo, nhi + 1)),
                 sampled=bool(rng.random() < cfg.sampled_fraction),
                 seed=int(rng.integers(0, 2 ** 31 - 1)),
                 cancel=bool(rng.random() < cfg.cancel_fraction),
-                priority=int(rng.choice(prios, p=weights))))
+                priority=int(rng.choice(prios, p=weights)),
+                tenant=tenant))
         return out
 
     def _submit(self, p: _Planned) -> RequestHandle:
@@ -270,6 +314,7 @@ class PoissonLoadGenerator:
                 "drive an EngineRouter")
         plan = self.plan()
         handles: List[Optional[RequestHandle]] = [None] * len(plan)
+        ps0 = self._prefix_stats()
         t0 = self._clock()
         next_up = 0
         killed = False
@@ -309,11 +354,45 @@ class PoissonLoadGenerator:
             self.transport.drain()
         duration = max(self._clock() - t0, 1e-9)
         self.last_handles = handles
-        return self._report(handles, duration, plan)
+        return self._report(handles, duration, plan,
+                            prefix=self._prefix_delta(ps0))
+
+    def _prefix_stats(self) -> Optional[Dict[str, Any]]:
+        """The serving stack's prefix-cache counters (engine, router,
+        or co-located HTTP server), or None when unavailable (remote
+        wire without a co-located server)."""
+        src: Any = self.transport if self.transport is not None \
+            else self.frontend.engine
+        fn = getattr(src, "prefix_stats", None)
+        return fn() if callable(fn) else None
+
+    def _prefix_delta(self,
+                      before: Optional[Dict[str, Any]]
+                      ) -> Optional[Dict[str, Any]]:
+        """Counter deltas over this run (the report must not attribute
+        a warm engine's lifetime hits to one scenario)."""
+        after = self._prefix_stats()
+        if after is None:
+            return None
+        before = before or {}
+        delta: Dict[str, Any] = {}
+        for k in ("lookups", "hits", "hit_tokens", "inserts",
+                  "evictions", "offloads", "restores",
+                  "restore_failures", "prefill_tokens_computed"):
+            if k in after:
+                delta[k] = int(after[k]) - int(before.get(k, 0))
+        lk = delta.get("lookups", 0)
+        delta["hit_rate"] = round(delta["hits"] / lk, 4) if lk else None
+        for k in ("cached_blocks", "offloaded_blocks",
+                  "offloaded_bytes"):
+            if k in after:
+                delta[k] = after[k]          # point-in-time, not delta
+        return delta
 
     def _report(self, handles: List[Optional[RequestHandle]],
                 duration: float,
-                plan: Optional[List[_Planned]] = None) -> LoadReport:
+                plan: Optional[List[_Planned]] = None,
+                prefix: Optional[Dict[str, Any]] = None) -> LoadReport:
         cfg = self.config
         ttfts: List[float] = []
         tpots: List[float] = []
@@ -325,7 +404,11 @@ class PoissonLoadGenerator:
         prio_of = {} if plan is None else {
             id(h): p.priority for h, p in zip(handles, plan)
             if h is not None}
+        tenant_of = {} if plan is None else {
+            id(h): p.tenant for h, p in zip(handles, plan)
+            if h is not None and p.tenant is not None}
         by_prio: Dict[int, Dict[str, Any]] = {}
+        by_ten: Dict[int, Dict[str, Any]] = {}
         eng = None if self.frontend is None else self.frontend.engine
         replica_of = getattr(eng, "replica_of", None)
         by_rep: Dict[int, Dict[str, Any]] = {}
@@ -360,13 +443,26 @@ class PoissonLoadGenerator:
                             (RequestState.TIMED_OUT, "timed_out")):
                 if h.state is st:
                     pc[key] += 1
+            tenant = tenant_of.get(id(h))
+            tc = None
+            if tenant is not None:
+                tc = by_ten.setdefault(tenant, {
+                    "n": 0, "finished": 0, "good": 0, "good_tokens": 0,
+                    "ttfts": []})
+                tc["n"] += 1
+                if h.state is RequestState.FINISHED:
+                    tc["finished"] += 1
             rec: Dict[str, Any] = {"req_id": h.req_id,
                                    "state": h.state.value,
                                    "n_tokens": k, "priority": prio}
+            if tenant is not None:
+                rec["tenant"] = tenant
             if h.ttft_s is not None:
                 rec["ttft_s"] = round(h.ttft_s, 6)
             if h.state is RequestState.FINISHED:
                 ttfts.append(h.ttft_s)
+                if tc is not None:
+                    tc["ttfts"].append(h.ttft_s)
                 tpot = 0.0
                 if k > 1:
                     tpot = (h.finish_t - h.first_token_t) / (k - 1)
@@ -377,6 +473,9 @@ class PoissonLoadGenerator:
                     good_tokens += k
                     pc["good"] += 1
                     pc["good_tokens"] += k
+                    if tc is not None:
+                        tc["good"] += 1
+                        tc["good_tokens"] += k
             per_req.append(rec)
         by_priority = None
         if len(by_prio) > 1:
@@ -390,6 +489,17 @@ class PoissonLoadGenerator:
                     "goodput_rps": round(pc["good"] / duration, 3),
                     "goodput_tokens_per_s": round(
                         pc["good_tokens"] / duration, 2),
+                }
+        by_tenant = None
+        if by_ten:
+            by_tenant = {}
+            for t, tc in sorted(by_ten.items()):
+                by_tenant[t] = {
+                    "n": tc["n"], "finished": tc["finished"],
+                    "goodput_rps": round(tc["good"] / duration, 3),
+                    "goodput_tokens_per_s": round(
+                        tc["good_tokens"] / duration, 2),
+                    "ttft_s": _pcts(tc["ttfts"]),
                 }
         return LoadReport(
             n_requests=cfg.n_requests,
@@ -409,4 +519,5 @@ class PoissonLoadGenerator:
                       else self.frontend.engine.kv_leak_report()),
             per_request=per_req, by_priority=by_priority,
             by_replica={k: by_rep[k] for k in sorted(by_rep)}
-            if by_rep else None)
+            if by_rep else None,
+            prefix=prefix, by_tenant=by_tenant)

@@ -93,6 +93,30 @@ serve.fleet.replica_deaths_total            counter    replicas declared dead
 serve.fleet.drains_total                    counter    graceful drains started
 ==========================================  =========  ==============
 
+Prefix-cache rows (``serve.prefix.*``, ISSUE 14; counters recorded by
+``inference/serving.py`` admission/eviction hooks, gauges refreshed
+here per scheduler iteration from ``prefix_stats()``;
+docs/serving.md).  Lookups count admission-time cache consultations
+(hit or miss); hit_tokens are prompt tokens whose prefill the cache
+skipped — the direct prefill-FLOP savings meter:
+
+==========================================  =========  ==============
+serve.prefix.lookups_total                  counter    admissions that consulted the cache
+serve.prefix.hits_total                     counter    admissions claiming >= 1 cached block
+serve.prefix.hit_tokens_total               counter    prompt tokens NOT re-prefilled
+serve.prefix.inserts_total                  counter    blocks registered into the radix tree
+serve.prefix.evictions_total                counter    resident blocks evicted under pressure
+serve.prefix.offloads_total                 counter    evicted blocks parked in host RAM
+serve.prefix.restores_total                 counter    offloaded blocks restored by byte scatter
+serve.prefix.restore_failures_total         counter    CRC failures at restore (recompute fallback)
+serve.prefix.cached_blocks                  gauge      HBM-resident cached blocks
+serve.prefix.offloaded_blocks               gauge      host-RAM tier blocks
+serve.prefix.offloaded_bytes                gauge      host-RAM tier size
+serve.prefix.hit_rate                       gauge      cumulative hits / lookups
+serve.fleet.affinity_hits_total             counter    placements won by prefix affinity
+serve.fleet.affinity_capped_total           counter    affinity overridden by the anti-herd cap
+==========================================  =========  ==============
+
 HTTP wire rows (``serve.http.*``, live only when requests arrive over
 the network front door — ``serving/http.py``; docs/serving.md).  The
 wire is where real traffic's failures originate, so every failure mode
@@ -288,6 +312,20 @@ class ServeMetrics:
                 res["spilled_bytes"])
             self._reg.gauge("serve.resilience.spilled_requests").set(
                 res["spilled_requests"])
+        prefix = engine.prefix_stats() \
+            if hasattr(engine, "prefix_stats") else None
+        if prefix is not None:
+            # .get defaults: an all-dead fleet's rollup has no replica
+            # rows to sum, and gauges must still publish zeros
+            g = self._reg.gauge
+            g("serve.prefix.cached_blocks").set(
+                prefix.get("cached_blocks", 0))
+            g("serve.prefix.offloaded_blocks").set(
+                prefix.get("offloaded_blocks", 0))
+            g("serve.prefix.offloaded_bytes").set(
+                prefix.get("offloaded_bytes", 0))
+            if prefix.get("hit_rate") is not None:
+                g("serve.prefix.hit_rate").set(prefix["hit_rate"])
         fleet = engine.fleet_stats() \
             if hasattr(engine, "fleet_stats") else None
         if fleet is not None:

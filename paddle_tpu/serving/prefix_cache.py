@@ -1,0 +1,362 @@
+"""Cross-request prefix cache (ISSUE 14): a radix tree over committed
+KV pages, with a bounded CRC-checked host-RAM offload tier.
+
+The engine's within-batch prefix index (``inference/serving.py``) keeps
+prompt pages alive between requests of ONE engine, but it is a flat
+exact-key map with drop-on-eviction semantics: page pressure throws the
+prefix away, and a fleet router has no way to ask "who holds this
+prefix?".  This module promotes it to a real cache subsystem:
+
+* **Radix/trie index** — one node per token BLOCK, keyed by the chained
+  per-block digest (``block_keys``: ``key_b = H(key_{b-1} || tokens_b)``
+  — the vLLM scheme, O(T) total).  Because each key commits to the whole
+  chain before it, child links ARE prefix extension: walking the trie
+  along a prompt's block keys yields the longest cached page-aligned
+  prefix.  Node payloads are either an HBM-resident pool page (the cache
+  holds one ``_RefPool`` reference, taken/released by the ENGINE — the
+  cache never touches the pool itself) or an offloaded host-RAM byte
+  copy.
+* **Two-tier eviction** — under pool pressure the engine asks for an
+  eviction victim: least-recently-used resident node first, preferring
+  nodes with no resident children (leaf-first keeps chains walkable).
+  With an offload budget (``PrefixCacheConfig.offload_capacity_bytes``)
+  the victim's exact page bytes are parked in the bounded host tier,
+  CRC32-stamped with the same convention as the preemption spill format
+  (``serving/resilience.KVSnapshot``); past the budget the OLDEST host
+  block is dropped entirely.  An offloaded prefix restores by exact-byte
+  scatter into fresh blocks — no recompute — and a CRC failure at
+  restore time is a typed :class:`~paddle_tpu.serving.resilience.
+  SpillCorruptError` that the engine downgrades to a clean recompute of
+  the remaining suffix, never silent corruption.
+* **Placement summaries** — :meth:`PrefixCache.match_blocks` answers
+  "how many leading blocks of this chain do you hold?" without touching
+  LRU state; the fleet router consults it per replica to route a
+  request sharing a cached prefix to the replica already holding it
+  (``serving/fleet.py`` prefix affinity).
+
+Everything here is host-side scheduler state — nothing is traced, and
+the restore path reuses the engine's pre-warmed pool-shaped copy op, so
+cache hits, evictions, offloads, and restores all run at ZERO backend
+compiles (the ``serve_prefix_warm`` COMPILE_BUDGET.md row pins this).
+See docs/serving.md ("Cross-request prefix cache") for the policy
+description and the ``serve.prefix.*`` metric catalogue.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import zlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PrefixCache", "PrefixCacheConfig", "block_keys"]
+
+# bump when the block-key scheme or cached-page semantics change: the
+# AOT serve manifest records it (aot/serve.engine_config), so artifact
+# generations and engines always agree on what a cached chain means
+SCHEME = "sha1-chain/v1"
+
+
+def block_keys(tokens: np.ndarray, n: int, block_size: int) -> List[bytes]:
+    """Chained per-block digests over the first ``n`` blocks of
+    ``tokens``: ``key_b = H(key_{b-1} || block_b bytes)`` — O(T) total
+    instead of O(T^2) cumulative-bytes keys, same exact-prefix
+    semantics.  The ONE hashing definition shared by the engine's
+    admission walk and the router's affinity summaries (they must agree
+    byte-for-byte or affinity would route on phantom prefixes)."""
+    tokens = np.asarray(tokens, np.int32)
+    keys: List[bytes] = []
+    prev = b""
+    for b in range(n):
+        h = hashlib.sha1(
+            prev + tokens[b * block_size:(b + 1) * block_size].tobytes())
+        prev = h.digest()
+        keys.append(prev)
+    return keys
+
+
+@dataclass(frozen=True)
+class PrefixCacheConfig:
+    """Policy knobs for the cross-request prefix cache.
+
+    offload_capacity_bytes:
+        Host-RAM budget for the offload tier.  0 (the default) disables
+        offload entirely — eviction under pool pressure then DROPS the
+        prefix (the pre-ISSUE-14 behavior), paying recompute on the
+        next hit instead of host bytes.  Past the budget the oldest
+        offloaded block is dropped (evict-oldest, the SpillTier
+        convention).  The knob is pure policy: it never changes a
+        compiled program, so it is NOT part of the AOT config hash
+        (only the key ``SCHEME`` is).
+    """
+
+    offload_capacity_bytes: int = 0
+
+    def __post_init__(self):
+        if self.offload_capacity_bytes < 0:
+            raise ValueError("offload_capacity_bytes must be >= 0")
+
+
+@dataclass
+class _Node:
+    """One cached token block.  Exactly one of three payload states:
+    RESIDENT (``phys`` set — the cache holds one pool reference, owned
+    by the engine), OFFLOADED (``k_bytes``/``v_bytes`` set — exact page
+    bytes in host RAM, CRC-stamped), or a bare placeholder (neither —
+    kept only while it still has children; lookups stop at it)."""
+
+    key: bytes
+    parent: Optional["_Node"]
+    depth: int
+    children: Dict[bytes, "_Node"] = field(default_factory=dict)
+    phys: Optional[int] = None
+    k_bytes: Optional[np.ndarray] = None
+    v_bytes: Optional[np.ndarray] = None
+    crc_k: int = 0
+    crc_v: int = 0
+
+    @property
+    def resident(self) -> bool:
+        return self.phys is not None
+
+    @property
+    def offloaded(self) -> bool:
+        return self.k_bytes is not None
+
+    @property
+    def host_nbytes(self) -> int:
+        if self.k_bytes is None:
+            return 0
+        return self.k_bytes.nbytes + self.v_bytes.nbytes
+
+    def verify(self) -> None:
+        """Raise :class:`SpillCorruptError` unless the offloaded bytes
+        still match their offload-time checksums (the KVSnapshot/
+        framework-io convention: every spilled array carries a CRC32,
+        verified on read)."""
+        from .resilience import SpillCorruptError
+        if zlib.crc32(self.k_bytes.tobytes()) != self.crc_k or \
+                zlib.crc32(self.v_bytes.tobytes()) != self.crc_v:
+            raise SpillCorruptError(
+                f"offloaded prefix block {self.key.hex()[:12]} (depth "
+                f"{self.depth}) failed its CRC check — host-RAM bit-rot; "
+                "the suffix must be recomputed from the last good block")
+
+
+class PrefixCache:
+    """Radix tree over committed KV pages, keyed by token-block content.
+
+    The ENGINE owns the refcount pool; this class only records which
+    page a resident node parks and hands victims back for the engine to
+    release — so the ``_RefPool`` exactly-once accounting (and its
+    loud double-free errors) stay the single source of truth.
+
+    Args:
+      block_size: the engine's KV page size in tokens.
+      config: :class:`PrefixCacheConfig` policy knobs.
+    """
+
+    SCHEME = SCHEME
+
+    def __init__(self, block_size: int,
+                 config: Optional[PrefixCacheConfig] = None):
+        self.BS = int(block_size)
+        self.config = config or PrefixCacheConfig()
+        self._root = _Node(key=b"", parent=None, depth=-1)
+        # LRU maps are keyed by id(node): node identity, never digest —
+        # a dropped-and-reinserted chain must not collide with a
+        # detached twin still awaiting cleanup
+        self._lru: "collections.OrderedDict[int, _Node]" = \
+            collections.OrderedDict()
+        self._host_lru: "collections.OrderedDict[int, _Node]" = \
+            collections.OrderedDict()
+        self.host_bytes = 0
+        self.stats: Dict[str, int] = {
+            "lookups": 0, "hits": 0, "hit_blocks": 0, "hit_tokens": 0,
+            "inserts": 0, "evictions": 0, "offloads": 0, "restores": 0,
+            "restore_failures": 0, "offload_drops": 0,
+        }
+
+    # -- introspection --------------------------------------------------
+    @property
+    def resident_blocks(self) -> int:
+        return len(self._lru)
+
+    @property
+    def offloaded_blocks(self) -> int:
+        return len(self._host_lru)
+
+    @property
+    def wants_offload(self) -> bool:
+        """Whether eviction should bother capturing page bytes."""
+        return self.config.offload_capacity_bytes > 0
+
+    def resident_items(self) -> List[Tuple[bytes, int]]:
+        """(key, phys) of every resident node, LRU order (oldest
+        first) — the engine's ``prefix_index`` compatibility view and
+        the leak report read this."""
+        return [(n.key, n.phys) for n in self._lru.values()]
+
+    def keys_for(self, prompt: np.ndarray, n: int) -> List[bytes]:
+        return block_keys(prompt, n, self.BS)
+
+    # -- lookup ---------------------------------------------------------
+    def walk(self, keys: List[bytes]) -> Tuple[List[int], List["_Node"]]:
+        """Longest cached chain prefix for ``keys``: returns
+        ``(resident_pages, offloaded_nodes)``.  Residents strictly
+        precede offloaded nodes (leaf-first eviction keeps resident
+        nodes a rooted prefix of every chain); the walk stops at the
+        first uncached or placeholder node.  Touches LRU recency for
+        every node visited."""
+        pages: List[int] = []
+        off: List[_Node] = []
+        node = self._root
+        for key in keys:
+            child = node.children.get(key)
+            if child is None:
+                break
+            if child.resident:
+                if off:
+                    break   # defensive: never hand out a torn chain
+                pages.append(child.phys)
+                self._lru.move_to_end(id(child))
+            elif child.offloaded:
+                off.append(child)
+                self._host_lru.move_to_end(id(child))
+            else:
+                break       # placeholder: chain broken here
+            node = child
+        return pages, off
+
+    def match_blocks(self, keys: List[bytes]) -> int:
+        """Longest cached chain prefix WITHOUT touching LRU state — the
+        read-only summary the fleet router's prefix-affinity placement
+        consults per replica."""
+        node, n = self._root, 0
+        for key in keys:
+            child = node.children.get(key)
+            if child is None or not (child.resident or child.offloaded):
+                break
+            n += 1
+            node = child
+        return n
+
+    # -- insert ---------------------------------------------------------
+    def insert(self, keys: List[bytes], pages: List[int]) -> List[int]:
+        """Register ``keys[i] -> pages[i]`` as resident nodes; returns
+        the pages the cache took NEW custody of — the caller must take
+        one pool reference on each (``alloc.share``) so the page
+        survives the slot that computed it.  Blocks already resident
+        are skipped (their existing page keeps serving; recency is
+        refreshed); an offloaded twin is superseded by the freshly
+        computed page (the host copy is dropped)."""
+        node = self._root
+        took: List[int] = []
+        for key, phys in zip(keys, pages):
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(key=key, parent=node, depth=node.depth + 1)
+                node.children[key] = child
+            if child.resident:
+                self._lru.move_to_end(id(child))
+            else:
+                if child.offloaded:
+                    self._drop_host(child, detach=False)
+                child.phys = phys
+                self._lru[id(child)] = child
+                took.append(phys)
+                self.stats["inserts"] += 1
+            node = child
+        return took
+
+    # -- eviction / offload ---------------------------------------------
+    def evictable(self, refcount: Callable[[int], int]
+                  ) -> Optional["_Node"]:
+        """The next eviction victim: the least-recently-used resident
+        node whose page the cache alone holds (``refcount(phys) == 1``),
+        preferring nodes with no resident children so chains stay
+        walkable; when only mid-chain nodes qualify, the oldest of
+        those is returned (liveness beats chain integrity — the
+        orphaned descendants remain individually evictable).  None when
+        nothing can be freed."""
+        fallback: Optional[_Node] = None
+        for node in self._lru.values():
+            if refcount(node.phys) != 1:
+                continue
+            if any(c.resident for c in node.children.values()):
+                if fallback is None:
+                    fallback = node
+                continue
+            return node
+        return fallback
+
+    def evict(self, node: "_Node",
+              k_bytes: Optional[np.ndarray] = None,
+              v_bytes: Optional[np.ndarray] = None) -> int:
+        """Drop ``node``'s residency and return its page for the caller
+        to release.  With page bytes (and an offload budget) the block
+        parks in the host tier instead of vanishing — CRC-stamped, and
+        bounded by dropping the OLDEST host block past the budget."""
+        phys = node.phys
+        node.phys = None
+        del self._lru[id(node)]
+        self.stats["evictions"] += 1
+        if k_bytes is not None and self.wants_offload:
+            node.k_bytes = k_bytes
+            node.v_bytes = v_bytes
+            node.crc_k = zlib.crc32(k_bytes.tobytes())
+            node.crc_v = zlib.crc32(v_bytes.tobytes())
+            self._host_lru[id(node)] = node
+            self.host_bytes += node.host_nbytes
+            self.stats["offloads"] += 1
+            cap = self.config.offload_capacity_bytes
+            while self.host_bytes > cap and self._host_lru:
+                oldest = next(iter(self._host_lru.values()))
+                self._drop_host(oldest)
+                self.stats["offload_drops"] += 1
+        else:
+            self._detach_if_bare(node)
+        return phys
+
+    def promote(self, node: "_Node", phys: int) -> None:
+        """An offloaded node's bytes were scattered into fresh page
+        ``phys``: make it resident again (the caller takes the cache's
+        pool reference) and drop the host copy."""
+        self._drop_host(node, detach=False)
+        node.phys = phys
+        self._lru[id(node)] = node
+        self.stats["restores"] += 1
+
+    def drop_host(self, node: "_Node") -> None:
+        """Discard an offloaded node's bytes (CRC failure at restore
+        time): the block — and everything cached below it — can no
+        longer be served without recompute."""
+        self.stats["restore_failures"] += 1
+        self._drop_host(node)
+
+    # -- internals ------------------------------------------------------
+    def _drop_host(self, node: "_Node", detach: bool = True) -> None:
+        if node.offloaded:
+            self.host_bytes -= node.host_nbytes
+            node.k_bytes = None
+            node.v_bytes = None
+            node.crc_k = node.crc_v = 0
+            self._host_lru.pop(id(node), None)
+        if detach:
+            self._detach_if_bare(node)
+
+    def _detach_if_bare(self, node: "_Node") -> None:
+        """Unlink payload-less childless nodes from the tree, walking
+        up while the parent becomes bare too (placeholders must not
+        accumulate)."""
+        while node is not self._root and node.parent is not None \
+                and not node.resident and not node.offloaded \
+                and not node.children:
+            parent = node.parent
+            if parent.children.get(node.key) is node:
+                del parent.children[node.key]
+            node.parent = None
+            node = parent

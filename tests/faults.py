@@ -82,7 +82,8 @@ from paddle_tpu.framework import io as fio
 
 __all__ = ["InjectedEngineCrash", "SimulatedCrash",
            "connect_then_abandon_flood", "corrupt_file",
-           "crash_mid_prefill", "crash_mid_speculation",
+           "corrupt_offloaded_prefix", "crash_mid_prefill",
+           "crash_mid_speculation",
            "crash_mid_write", "exhaust_kv_pool", "fail_replace",
            "fail_step_n", "http_disconnect_mid_stream",
            "http_partial_line_writes", "http_stalled_reader",
@@ -448,6 +449,23 @@ def connect_then_abandon_flood(host: str, port: int, n: int = 20, *,
         finally:
             s.close()
     return opened
+
+
+def corrupt_offloaded_prefix(engine, n: int = 1) -> int:
+    """Flip bytes inside up to ``n`` of the prefix cache's OFFLOADED
+    host-RAM blocks (oldest first) — the bit-rot model for the ISSUE 14
+    offload tier, mirroring :func:`corrupt_file` for checkpoints.  The
+    CRCs are left stale, so the next restore of a corrupted block must
+    fail typed (``SpillCorruptError`` internally, a ``prefix_bitrot``
+    event + ``restore_failures`` counter externally) and fall back to
+    recomputing the suffix.  Returns the number of blocks corrupted."""
+    done = 0
+    for node in engine.prefix_cache._host_lru.values():
+        if done >= n:
+            break
+        node.k_bytes.view("uint8").reshape(-1)[:2] ^= 0xAD
+        done += 1
+    return done
 
 
 @contextlib.contextmanager

@@ -81,6 +81,16 @@ def test_fleet_warm_is_zero_compiles(measured):
     assert measured["fleet_warm"] == 0, measured
 
 
+def test_prefix_warm_is_zero_compiles(measured):
+    """ISSUE 14 acceptance: the cross-request prefix cache on an
+    AOT-warm engine — shared-prefix hits (greedy and sampled, suffix
+    prefill through the declared buckets), an eviction into the
+    host-RAM offload tier, and an offload restore by exact-byte
+    scatter — performs zero backend compiles.  The cache is host-side
+    bookkeeping; a hit must never cost tracing."""
+    assert measured["serve_prefix_warm"] == 0, measured
+
+
 def test_http_warm_is_zero_compiles(measured):
     """ISSUE 13 acceptance: the HTTP/SSE front door on an AOT-warm
     engine — server cold-start, greedy AND sampled traffic over real

@@ -1,0 +1,620 @@
+"""Cross-request prefix cache (ISSUE 14): radix tree over committed KV
+pages, host-RAM offload tier, n>1 shared-prompt sampling, and
+prefix-affinity fleet placement.
+
+The load-bearing guarantee everywhere: a cache HIT changes which pages
+a request reads, never which tokens it emits — cache-hit streams are
+bit-identical to cold-miss streams for identical seeds (greedy,
+sampled, spec-decode on/off, over the HTTP wire), and every chaos path
+(preempt/restore, crash replay, drain transplant, eviction under
+pressure, offload bit-rot) drains at zero leaked KV blocks under the
+full ``_RefPool`` invariant."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+import faults
+
+from paddle_tpu import parallel as dist
+from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                          derive_sample_seed)
+from paddle_tpu.models.llama import build_llama_train_step, llama_tiny
+from paddle_tpu.parallel.topology import HybridTopology, set_topology
+from paddle_tpu.serving.prefix_cache import (PrefixCache,
+                                             PrefixCacheConfig,
+                                             block_keys)
+
+rng = np.random.default_rng(14)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama_tiny()
+    topo = dist.init_topology(devices=jax.devices()[:1])
+    _, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1)
+    params = init_fn(0)["params"]
+    set_topology(HybridTopology())
+    return cfg, params
+
+
+def _engine(model, *, offload=True, **kw):
+    cfg, params = model
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 64)
+    if offload and "prefix_cache_config" not in kw:
+        kw["prefix_cache_config"] = PrefixCacheConfig(
+            offload_capacity_bytes=1 << 24)
+    return ContinuousBatchingEngine(cfg, params, **kw)
+
+
+def _cold(model, prompt, max_new, **req_kw):
+    """The cold-miss reference stream: caching disabled entirely."""
+    eng = _engine(model, offload=False, max_batch=1,
+                  enable_prefix_caching=False)
+    rid = eng.add_request(prompt, max_new, **req_kw)
+    return eng.run_to_completion()[rid]
+
+
+def _prompt(n, base=None):
+    p = rng.integers(0, 128, (n,)).astype(np.int32)
+    return p if base is None else np.concatenate([base, p])
+
+
+def _assert_pool_consistent(eng):
+    """Full _RefPool invariant: every block free XOR referenced, each
+    refcount == (slots holding it) + (1 if cache-resident)."""
+    held = {}
+    for pages in eng.slot_pages:
+        for p in pages:
+            held[p] = held.get(p, 0) + 1
+    for p in eng.prefix_index.values():
+        held[p] = held.get(p, 0) + 1
+    free = set(eng.alloc._free)
+    for p, r in eng.alloc.ref.items():
+        assert p not in free, f"block {p} free AND ref={r}"
+        assert held.get(p, 0) == r, \
+            f"block {p}: ref={r}, holders={held.get(p, 0)}"
+    for p in held:
+        assert p in eng.alloc.ref, f"block {p} held but unreferenced"
+    rep = eng.kv_leak_report()
+    assert rep["leaked"] == 0 and rep["unaccounted"] == 0, rep
+
+
+# ---------------------------------------------------------------------
+# bit-identity: hit == cold miss
+# ---------------------------------------------------------------------
+def test_cache_hit_bit_identical_greedy_and_sampled(model):
+    """Requests claiming cached prefix pages (greedy AND seeded-
+    sampled) stream exactly the cold-miss tokens."""
+    shared = _prompt(16)
+    p1, p2, p3 = _prompt(5, shared), _prompt(3, shared), _prompt(4, shared)
+    eng = _engine(model)
+    a = eng.add_request(p1, 5)
+    res = eng.run_to_completion()
+    assert eng.stats["prefix_blocks_registered"] >= 2
+    b = eng.add_request(p2, 5)
+    c = eng.add_request(p3, 6, temperature=0.8, top_k=20, seed=7)
+    res.update(eng.run_to_completion())
+    assert eng.stats["prefix_blocks_reused"] >= 4
+    ps = eng.prefix_stats()
+    assert ps["hits"] >= 2 and ps["hit_tokens"] >= 32
+    np.testing.assert_array_equal(res[a], _cold(model, p1, 5))
+    np.testing.assert_array_equal(res[b], _cold(model, p2, 5))
+    np.testing.assert_array_equal(
+        res[c], _cold(model, p3, 6, temperature=0.8, top_k=20, seed=7))
+    _assert_pool_consistent(eng)
+
+
+def test_cache_hit_bit_identical_spec_decode_on_off(model):
+    """Spec-decode composes with the cache: a speculative engine's
+    cache-hit stream equals both its own cold stream and the baseline
+    (spec-off) engine's — and rollback never corrupts cached pages."""
+    from paddle_tpu.spec_decode import SpecDecodeConfig
+    cfg, params = model
+    shared = _prompt(16)
+    p = _prompt(4, shared)
+    want = _cold(model, p, 8)
+    spec = _engine(model, spec_config=SpecDecodeConfig(
+        draft_cfg=cfg, draft_params=params, k=3, window=12))
+    a = spec.add_request(p, 8)
+    res = spec.run_to_completion()
+    b = spec.add_request(p, 8)           # full-prefix hit, speculating
+    res.update(spec.run_to_completion())
+    assert spec.stats["prefix_blocks_reused"] >= 2
+    assert spec.spec_stats()["spec_steps"] >= 1
+    np.testing.assert_array_equal(res[a], want)
+    np.testing.assert_array_equal(res[b], want)
+    _assert_pool_consistent(spec)
+
+
+def test_cache_hit_bit_identical_over_http_wire(model):
+    """The wire pin: shared-prefix requests served over real localhost
+    SSE sockets stream the cold-miss tokens (the cache must be
+    invisible at every layer of the stack)."""
+    import http.client
+
+    from paddle_tpu.serving import HttpServingServer, ServingFrontend
+    from paddle_tpu.serving.http import iter_sse
+
+    shared = _prompt(16)
+    p1, p2 = _prompt(5, shared), _prompt(3, shared)
+    eng = _engine(model)
+    fe = ServingFrontend(eng)
+    srv = HttpServingServer(fe, heartbeat_s=0.02,
+                            retry_grace_s=0.0).start()
+    try:
+        outs = []
+        for p in (p1, p2):
+            conn = http.client.HTTPConnection(srv.host, srv.port,
+                                              timeout=120)
+            conn.request("POST", "/v1/generate",
+                         json.dumps({"prompt_ids": p.tolist(),
+                                     "max_new_tokens": 5}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200, resp.read()
+            toks = [d["t"] for e, d in iter_sse(resp) if e == "token"]
+            conn.close()
+            outs.append(toks)
+        assert eng.prefix_stats()["hits"] >= 1
+        for p, toks in zip((p1, p2), outs):
+            np.testing.assert_array_equal(
+                toks, _cold(model, p, 5)[len(p):])
+    finally:
+        srv.begin_shutdown(reason="test done")
+        srv._httpd.server_close()
+    _assert_pool_consistent(eng)
+
+
+# ---------------------------------------------------------------------
+# offload tier: evict -> host RAM -> restore by exact-byte scatter
+# ---------------------------------------------------------------------
+def test_offload_restore_bit_identical_leak_free(model):
+    """Eviction under pool pressure parks the prefix in host RAM; the
+    next hit restores the exact bytes into fresh blocks (restores
+    counted, no recompute of those blocks) and streams cold-miss
+    tokens."""
+    A = _prompt(21)
+    want = _cold(model, A, 4)
+    eng = _engine(model, max_batch=1)
+    a = eng.add_request(A, 4)
+    res = eng.run_to_completion()
+    stolen = eng.alloc.acquire(eng.alloc.free_blocks)
+    try:
+        b = eng.add_request(_prompt(9), 4)   # forces evict -> offload
+        res.update(eng.run_to_completion())
+    finally:
+        eng.alloc.release(stolen)
+    ps = eng.prefix_stats()
+    assert ps["evictions"] >= 2 and ps["offloads"] >= 2, ps
+    assert ps["offloaded_blocks"] >= 2 and ps["offloaded_bytes"] > 0
+    c = eng.add_request(A, 4)                # restore path
+    res.update(eng.run_to_completion())
+    ps = eng.prefix_stats()
+    assert ps["restores"] >= 2, ps
+    np.testing.assert_array_equal(res[a], want)
+    np.testing.assert_array_equal(res[c], want)
+    assert b in res
+    _assert_pool_consistent(eng)
+
+
+def test_offload_bitrot_typed_fallback_recomputes(model):
+    """Host-RAM bit-rot in an offloaded block: restore fails its CRC
+    (typed, counted), the corrupt block is dropped, and the request
+    recomputes the suffix — identical tokens, zero leaks, and the
+    cache keeps serving afterwards."""
+    A = _prompt(21)
+    want = _cold(model, A, 4)
+    eng = _engine(model, max_batch=1)
+    eng.add_request(A, 4)
+    eng.run_to_completion()
+    stolen = eng.alloc.acquire(eng.alloc.free_blocks)
+    try:
+        eng.add_request(_prompt(9), 4)
+        eng.run_to_completion()
+    finally:
+        eng.alloc.release(stolen)
+    assert eng.prefix_stats()["offloaded_blocks"] >= 2
+    assert faults.corrupt_offloaded_prefix(eng, n=8) >= 2
+    c = eng.add_request(A, 4)
+    res = eng.run_to_completion()
+    ps = eng.prefix_stats()
+    assert ps["restore_failures"] >= 1, ps
+    np.testing.assert_array_equal(res[c], want)
+    _assert_pool_consistent(eng)
+    # the recomputed blocks re-registered: the next hit is resident
+    d = eng.add_request(A, 4)
+    res = eng.run_to_completion()
+    np.testing.assert_array_equal(res[d], want)
+    assert eng.prefix_stats()["restore_failures"] == ps["restore_failures"]
+    _assert_pool_consistent(eng)
+
+
+def test_eviction_under_pressure_drains_leak_free(model):
+    """A pool far smaller than the working set: every admission evicts
+    someone else's prefix (offloading it), the host tier stays under
+    its cap, and every drain satisfies the full pool invariant."""
+    eng = _engine(model, max_batch=1, num_blocks=6,
+                  prefix_cache_config=PrefixCacheConfig(
+                      offload_capacity_bytes=1 << 16))
+    prompts = [_prompt(16) for _ in range(5)]
+    res = {}
+    for p in prompts + prompts:          # second pass re-hits/restores
+        rid = eng.add_request(p, 3)
+        res.update(eng.run_to_completion())
+        assert rid in res
+        _assert_pool_consistent(eng)
+        cap = eng.prefix_cache.config.offload_capacity_bytes
+        assert eng.prefix_cache.host_bytes <= cap
+    ps = eng.prefix_stats()
+    assert ps["evictions"] >= 1
+    for p, want in ((p, _cold(model, p, 3)) for p in prompts[:2]):
+        e2 = _engine(model, max_batch=1)
+        rid = e2.add_request(p, 3)
+        np.testing.assert_array_equal(
+            e2.run_to_completion()[rid], want)
+
+
+# ---------------------------------------------------------------------
+# interaction with PRs 11-13: preempt, crash replay, drain transplant
+# ---------------------------------------------------------------------
+def test_cache_reclaimed_before_preemption_fires(model):
+    """Pool pressure reclaims cache-parked pages BEFORE spilling any
+    running request: with enough evictable prefix blocks, a
+    high-priority arrival admits by eviction alone (zero preemptions),
+    and everything stays bit-identical."""
+    shared = _prompt(16)
+    p_lo, p_hi = _prompt(9), _prompt(10)
+    want_lo = _cold(model, p_lo, 8)
+    want_hi = _cold(model, p_hi, 4)
+    eng = _engine(model, num_blocks=16)
+    eng.add_request(_prompt(3, shared), 3)
+    eng.run_to_completion()              # parks 2 blocks, cache-only refs
+    a = eng.add_request(p_lo, 8, priority=0)
+    eng.step()
+    with faults.exhaust_kv_pool(eng):
+        b = eng.add_request(p_hi, 4, priority=5)
+        eng.step()                       # evicts cache, not the tenant
+        assert eng.resilience_stats()["preemptions"] == 0
+        assert eng.prefix_stats()["evictions"] >= 1
+    res = eng.run_to_completion()
+    np.testing.assert_array_equal(res[a], want_lo)
+    np.testing.assert_array_equal(res[b], want_hi)
+    _assert_pool_consistent(eng)
+
+
+def test_preempt_restore_composes_with_cache(model):
+    """A preempted-and-restored request whose table mixes cache-shared
+    and private pages resumes bit-identically, and the cache keeps its
+    references through the spill/restore cycle."""
+    shared = _prompt(16)
+    p_lo, p_hi = _prompt(3, shared), _prompt(10)
+    want_lo = _cold(model, p_lo, 10)
+    want_hi = _cold(model, p_hi, 6)
+    eng = _engine(model, num_blocks=8, offload=False)
+    eng.add_request(_prompt(2, shared), 2)
+    eng.run_to_completion()
+    a = eng.add_request(p_lo, 10, priority=0)
+    eng.step()
+    assert eng.stats["prefix_blocks_reused"] >= 2
+    with faults.exhaust_kv_pool(eng):
+        b = eng.add_request(p_hi, 6, priority=5)
+        eng.step()                       # must preempt the low tenant
+        assert eng.resilience_stats()["preemptions"] >= 1
+    res = eng.run_to_completion()
+    assert eng.resilience_stats()["restores"] >= 1 \
+        or eng.resilience_stats()["prefix_replays"] >= 1
+    np.testing.assert_array_equal(res[a], want_lo)
+    np.testing.assert_array_equal(res[b], want_hi)
+    _assert_pool_consistent(eng)
+
+
+def test_crash_replay_composes_with_cache(model):
+    """A supervised crash mid-stream with shared-prefix traffic: the
+    rebuilt engine replays from committed prefixes (its fresh cache
+    re-registers them) and streams stay bit-identical, zero leaks."""
+    from paddle_tpu.serving import RetryPolicy, SupervisedEngine
+    cfg, params = model
+    shared = _prompt(16)
+    p1, p2 = _prompt(5, shared), _prompt(3, shared)
+    want1, want2 = _cold(model, p1, 8), _cold(model, p2, 8)
+
+    def factory():
+        return _engine(model)
+
+    sup = SupervisedEngine(factory, policy=RetryPolicy(backoff_base_s=0.0),
+                           sleep=lambda s: None)
+    a = sup.add_request(p1, 8)
+    b = sup.add_request(p2, 8)
+    sup.step()
+    sup.step()
+    with faults.fail_step_n(sup.engine, 1):
+        res = sup.run_to_completion()
+    assert sup.stats["recoveries"] == 1
+    np.testing.assert_array_equal(res[a], want1)
+    np.testing.assert_array_equal(res[b], want2)
+    _assert_pool_consistent(sup.engine)
+
+
+def test_drain_transplant_composes_with_cache(model):
+    """Graceful drain with KV-snapshot transplant while both replicas
+    hold prefix caches: streams complete bit-identically and the
+    surviving replica drains leak-free."""
+    from paddle_tpu.serving import EngineRouter, RetryPolicy
+    shared = _prompt(16)
+    prompts = [_prompt(3, shared), _prompt(5, shared), _prompt(4)]
+    wants = [_cold(model, p, 8) for p in prompts]
+
+    def factory():
+        return _engine(model)
+
+    router = EngineRouter([factory, factory],
+                          policy=RetryPolicy(backoff_base_s=0.0),
+                          sleep=lambda s: None)
+    rids = [router.add_request(p, 8) for p in prompts]
+    router.step()
+    router.step()
+    victim = next(p.replica for p in router._placements.values())
+    router.drain(victim)                 # mode="replace": transplant
+    res = router.run_to_completion()
+    for rid, want in zip(rids, wants):
+        np.testing.assert_array_equal(res[rid], want)
+    leak = router.kv_leak_report()
+    assert leak["leaked"] == 0 and leak["unaccounted"] == 0
+    for rep in router.replicas:
+        if rep.final_leak is not None:
+            assert rep.final_leak["leaked"] == 0
+
+
+# ---------------------------------------------------------------------
+# n>1 parallel sampling sharing one prompt KV (ROADMAP 5b)
+# ---------------------------------------------------------------------
+def test_n_parallel_sampling_bit_identical_and_shared(model):
+    """submit(n=k) fans out to k refcount-shared samples, each
+    bit-identical to an independent submit carrying its derived seed —
+    and the shared prompt pages are claimed through the cache (one
+    prefill, k-1 hits)."""
+    from paddle_tpu.serving import ServingFrontend
+    prompt = _prompt(19)
+    eng = _engine(model, max_batch=3)
+    fe = ServingFrontend(eng)
+    hs = fe.submit(prompt, 6, temperature=0.8, top_k=20, seed=11, n=3)
+    assert isinstance(hs, list) and len(hs) == 3
+    fe.run_until_drained()
+    results = [h.result() for h in hs]
+    assert eng.stats["prefix_blocks_reused"] >= 4   # 2 hits x 2 blocks
+    for i, got in enumerate(results):
+        want = _cold(model, prompt, 6, temperature=0.8, top_k=20,
+                     seed=derive_sample_seed(11, i))
+        np.testing.assert_array_equal(got, want)
+    assert derive_sample_seed(11, 0) == 11          # n=1 unchanged
+    _assert_pool_consistent(eng)
+
+
+def test_n_sampling_rejects_greedy_fanout(model):
+    from paddle_tpu.serving import ServingFrontend
+    fe = ServingFrontend(_engine(model))
+    with pytest.raises(ValueError, match="temperature"):
+        fe.submit(_prompt(8), 4, n=3)
+    with pytest.raises(ValueError, match="n must be"):
+        fe.submit(_prompt(8), 4, n=0)
+
+
+# ---------------------------------------------------------------------
+# fleet prefix affinity + anti-herd cap
+# ---------------------------------------------------------------------
+def test_router_prefix_affinity_routes_to_holder(model):
+    """A request sharing a cached prefix routes to the replica already
+    holding it even when least-loaded would pick another."""
+    from paddle_tpu.serving import EngineRouter
+    shared = _prompt(16)
+
+    def factory():
+        return _engine(model)
+
+    router = EngineRouter([factory, factory])
+    # occupy replica 0 so the prefix lands on replica 1
+    filler = router.add_request(_prompt(9), 12)
+    router.step()
+    warm = router.add_request(_prompt(3, shared), 3)
+    router.step()
+    assert router.replica_of(warm) == 1
+    res = router.run_to_completion()
+    assert filler in res and warm in res
+    # both replicas now idle: least-loaded alone would pick replica 0
+    p_hit = _prompt(5, shared)
+    hit = router.add_request(p_hit, 3)
+    assert router.replica_of(hit) == 1
+    assert router.stats["affinity_hits"] >= 1
+    res = router.run_to_completion()
+    np.testing.assert_array_equal(res[hit], _cold(model, p_hit, 3))
+
+
+def test_affinity_anti_herd_cap(model):
+    """The anti-herd cap: when the prefix holder is already slack+1
+    requests busier than the least-loaded replica, load balance wins
+    and the cap counter records the override."""
+    from paddle_tpu.serving import EngineRouter
+    shared = _prompt(16)
+
+    def factory():
+        return _engine(model)
+
+    router = EngineRouter([factory, factory], affinity_load_slack=0)
+    filler = router.add_request(_prompt(9), 16)
+    router.step()
+    warm = router.add_request(_prompt(3, shared), 3)
+    router.step()
+    assert router.replica_of(warm) == 1
+    # keep replica 1 busy past the slack while replica 0 is free
+    busy = [router.add_request(_prompt(4, shared), 16)]
+    router.step()
+    assert router.replica_of(busy[0]) == 1      # affinity while level
+    router.cancel(filler)
+    router.step()                               # replica 0 now idle
+    capped = router.add_request(_prompt(6, shared), 3)
+    assert router.replica_of(capped) == 0
+    assert router.stats["affinity_capped"] >= 1
+    for rid in busy:
+        router.cancel(rid)
+    router.run_to_completion()
+
+
+# ---------------------------------------------------------------------
+# loadgen multi-tenant shared-prefix scenarios
+# ---------------------------------------------------------------------
+def test_loadgen_multitenant_prefix_report(model):
+    from paddle_tpu.serving import (LoadGenConfig, PoissonLoadGenerator,
+                                    ServingFrontend)
+    eng = _engine(model)
+    fe = ServingFrontend(eng)
+    lg = LoadGenConfig(n_requests=8, rate_rps=500.0, seed=3,
+                       prompt_len=(3, 6), max_new_tokens=(2, 4),
+                       tenants=2, tenant_prefix_len=16,
+                       tenant_reuse_prob=1.0,
+                       slo_ttft_s=30.0, slo_tpot_s=30.0)
+    rep = PoissonLoadGenerator(fe, lg).run()
+    d = rep.to_dict()
+    assert d["kv_leaked_blocks"] == 0
+    assert rep.prefix is not None and rep.prefix["hits"] >= 1
+    assert rep.prefix["hit_rate"] is not None
+    assert rep.prefix["prefill_tokens_computed"] > 0
+    assert rep.by_tenant is not None
+    assert sum(tc["n"] for tc in rep.by_tenant.values()) == 8
+    for tc in rep.by_tenant.values():
+        assert "goodput_rps" in tc and "ttft_s" in tc
+
+
+def test_loadgen_plan_identical_in_process_vs_transport(model):
+    """The PR 13 pin extended to tenants: the multi-tenant plan is a
+    pure function of the seed + vocab, so a wire run offers the exact
+    request sequence the in-process run does."""
+    from paddle_tpu.serving import (LoadGenConfig, PoissonLoadGenerator,
+                                    ServingFrontend)
+    cfg, _ = model
+    eng = _engine(model)
+    lg = LoadGenConfig(n_requests=6, seed=5, tenants=2,
+                       tenant_prefix_len=(8, 16), tenant_reuse_prob=0.7)
+
+    class _StubTransport:
+        vocab_size = cfg.vocab_size
+
+    p_in = PoissonLoadGenerator(ServingFrontend(eng), lg).plan()
+    p_wire = PoissonLoadGenerator(None, lg,
+                                  transport=_StubTransport()).plan()
+    assert len(p_in) == len(p_wire) == 6
+    for a, b in zip(p_in, p_wire):
+        np.testing.assert_array_equal(a.prompt, b.prompt)
+        assert (a.at, a.max_new, a.sampled, a.seed, a.cancel,
+                a.priority, a.tenant) == \
+               (b.at, b.max_new, b.sampled, b.seed, b.cancel,
+                b.priority, b.tenant)
+
+
+def test_loadgen_tenantless_plan_unchanged(model):
+    """tenants=0 must not consume any extra RNG draws: pre-ISSUE-14
+    seeds keep their exact request sequences (the draw order is pinned
+    by comparing against a config that merely ADDS the tenant knobs at
+    their disabled defaults)."""
+    from paddle_tpu.serving import LoadGenConfig, PoissonLoadGenerator
+    cfg, _ = model
+
+    class _Stub:
+        vocab_size = cfg.vocab_size
+
+    base = LoadGenConfig(n_requests=5, seed=9)
+    explicit = LoadGenConfig(n_requests=5, seed=9, tenants=0,
+                             tenant_prefix_len=999,
+                             tenant_reuse_prob=0.0)
+    a = PoissonLoadGenerator(None, base, transport=_Stub()).plan()
+    b = PoissonLoadGenerator(None, explicit, transport=_Stub()).plan()
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x.prompt, y.prompt)
+        assert x.seed == y.seed and x.tenant is y.tenant is None
+
+
+# ---------------------------------------------------------------------
+# radix-tree internals + AOT manifest coverage
+# ---------------------------------------------------------------------
+def test_radix_internals_leaf_first_eviction_and_host_cap():
+    toks = np.arange(12, dtype=np.int32)
+    keys = block_keys(toks, 3, 4)
+    assert keys == block_keys(toks, 3, 4)           # deterministic
+    assert block_keys(toks[:8], 2, 4) == keys[:2]   # chained prefixes
+    blk = np.zeros((2, 4, 1, 2), np.float32)
+    cache = PrefixCache(4, PrefixCacheConfig(
+        offload_capacity_bytes=3 * blk.nbytes))
+    assert cache.insert(keys, [10, 11, 12]) == [10, 11, 12]
+    assert cache.insert(keys, [10, 11, 12]) == []   # idempotent
+    pages, off = cache.walk(keys)
+    assert pages == [10, 11, 12] and off == []
+    assert cache.match_blocks(keys) == 3
+    assert cache.match_blocks(block_keys(np.arange(1, 13,
+                                                   dtype=np.int32),
+                                         3, 4)) == 0
+    refs = {10: 1, 11: 1, 12: 1}
+    # leaf first: depth-2 node wins although depth-0 is older
+    victim = cache.evictable(lambda p: refs[p])
+    assert victim.phys == 12
+    assert cache.evict(victim, blk + 1, blk + 2) == 12
+    pages, off = cache.walk(keys)
+    assert pages == [10, 11] and len(off) == 1
+    assert cache.match_blocks(keys) == 3            # offload still counts
+    # a shared mid-chain page is not evictable; its parent becomes the
+    # (fallback) victim only when nothing leaf-like qualifies
+    refs[11] = 2
+    assert cache.evictable(lambda p: refs[p]).phys == 10
+    # host cap (3 blk-arrays): a second 2-array offload overflows it,
+    # dropping the OLDEST host block
+    v2 = cache.evictable(lambda p: refs[p])
+    cache.evict(v2, blk.copy(), blk.copy())
+    assert cache.offloaded_blocks == 1
+    assert cache.stats["offload_drops"] == 1
+    assert cache.host_bytes <= cache.config.offload_capacity_bytes
+
+
+def test_radix_bitrot_verify_and_promote():
+    from paddle_tpu.serving.resilience import SpillCorruptError
+    toks = np.arange(8, dtype=np.int32)
+    keys = block_keys(toks, 2, 4)
+    blk = np.ones((2, 4, 1, 2), np.float32)
+    cache = PrefixCache(4, PrefixCacheConfig(
+        offload_capacity_bytes=1 << 20))
+    cache.insert(keys, [3, 4])
+    node = cache.evictable(lambda p: 1)
+    cache.evict(node, blk.copy(), blk.copy())
+    node.verify()                                  # intact bytes pass
+    node.k_bytes[0, 0, 0, 0] += 1.0
+    with pytest.raises(SpillCorruptError, match="CRC"):
+        node.verify()
+    cache.drop_host(node)
+    assert cache.stats["restore_failures"] == 1
+    assert cache.offloaded_blocks == 0
+    # the surviving resident node still serves and can be promoted
+    # through an offload/restore round trip
+    n2 = cache.evictable(lambda p: 1)
+    cache.evict(n2, blk.copy(), blk.copy())
+    n2.verify()
+    cache.promote(n2, 9)
+    pages, off = cache.walk(keys[:1])
+    assert pages == [9] and off == []
+    assert cache.stats["restores"] == 1 and cache.host_bytes == 0
+
+
+def test_aot_manifest_covers_prefix_scheme(model):
+    """The serve config hash records the block-key scheme: a future
+    scheme bump invalidates warm starts instead of letting two
+    generations disagree about prefix identity — and policy knobs
+    (offload capacity) deliberately stay OUT, so capacity changes
+    never force a re-export."""
+    from paddle_tpu.aot.serve import engine_config
+    e1 = _engine(model)
+    c1 = engine_config(e1)
+    assert c1["prefix_scheme"] == PrefixCache.SCHEME == "sha1-chain/v1"
+    e2 = _engine(model, prefix_cache_config=PrefixCacheConfig(
+        offload_capacity_bytes=123456))
+    assert engine_config(e2) == c1

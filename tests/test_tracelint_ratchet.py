@@ -140,6 +140,22 @@ def test_serving_resilience_has_zero_tl001_tl006():
             assert n == 0, f"baseline carries {rule} debt in {path}"
 
 
+def test_prefix_cache_has_zero_tl001_tl006():
+    """ISSUE 14 contract: the cross-request prefix cache is host-side
+    scheduler state around the paged pool — no host-sync in traced
+    code (TL001; the radix tree must never be consulted from inside a
+    compiled program) and no silent broad excepts (TL006; a swallowed
+    offload/restore error would silently serve corrupt KV bytes as a
+    cache hit) — live scan AND committed ledger."""
+    files = ("paddle_tpu/serving/prefix_cache.py",)
+    live = [f for f in _current_findings()
+            if f.rule in ("TL001", "TL006") and f.path.endswith(files)]
+    assert live == [], [f.format() for f in live]
+    for (rule, path), n in baseline_mod.load().items():
+        if rule in ("TL001", "TL006") and path.endswith(files):
+            assert n == 0, f"baseline carries {rule} debt in {path}"
+
+
 def test_decode_block_has_zero_tl001_tl006():
     """ISSUE 9 contract: the fused decode-block op (dispatch module AND
     Pallas kernel) sits on the hottest serve path — no host-sync in

@@ -350,6 +350,68 @@ def serve_http_warm() -> Callable[[], None]:
     return workload
 
 
+def serve_prefix_warm() -> Callable[[], None]:
+    """Cross-request prefix cache on a warm engine (ISSUE 14):
+    shared-prefix hits (suffix-only prefill through the declared
+    buckets, greedy AND sampled), eviction under pool pressure into
+    the host-RAM offload tier, and an offload restore by exact-byte
+    scatter — ZERO backend compiles; every cache operation is
+    host-side bookkeeping plus the pre-warmed pool-shaped copy op."""
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu.aot.serve import export_engine
+
+    cfg, params, _prompts = _tiny_llama()
+    aot_dir = tempfile.mkdtemp(prefix="aot_budget_prefix_")
+    export_engine(_engine(cfg, params), aot_dir)
+
+    def workload():
+        from paddle_tpu.inference.serving import ContinuousBatchingEngine
+        from paddle_tpu.serving.prefix_cache import PrefixCacheConfig
+
+        eng = ContinuousBatchingEngine(
+            cfg, params, max_batch=2, block_size=8, num_blocks=64,
+            prefill_buckets=(8,), aot_dir=aot_dir,
+            prefix_cache_config=PrefixCacheConfig(
+                offload_capacity_bytes=1 << 24))
+        rng = np.random.default_rng(5)
+        shared = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+        tail = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+        eng.add_request(np.concatenate([shared, tail]), 4)
+        eng.run_to_completion()              # registers the 2 blocks
+        # shared-prefix hit, sampled: the warm sampler serves hits too
+        eng.add_request(np.concatenate([shared, tail[:2]]), 4,
+                        temperature=0.7, top_k=8, seed=3)
+        eng.run_to_completion()
+        if eng.prefix_stats()["hits"] < 1:
+            raise RuntimeError("scenario never hit the prefix cache")
+        # pool pressure: eviction must offload the cached prefix
+        stolen = eng.alloc.acquire(eng.alloc.free_blocks)
+        try:
+            eng.add_request(
+                rng.integers(0, cfg.vocab_size, (9,)).astype(np.int32),
+                4)
+            eng.run_to_completion()
+        finally:
+            eng.alloc.release(stolen)
+        # offload restore: exact bytes scatter back, no recompute
+        eng.add_request(np.concatenate([shared, tail]), 4)
+        eng.run_to_completion()
+        ps = eng.prefix_stats()
+        if ps["offloads"] < 1 or ps["restores"] < 1:
+            raise RuntimeError(
+                f"scenario never offloaded/restored: {ps}")
+        rep = eng.kv_leak_report()
+        if rep["leaked"] or rep["unaccounted"]:
+            raise RuntimeError(f"scenario leaked KV blocks: {rep}")
+        if not eng.aot_loaded:
+            raise RuntimeError(f"warm start fell back: {eng.aot_error}")
+
+    return workload
+
+
 SCENARIOS: Dict[str, Callable[[], Callable[[], None]]] = {
     "gpt_train": gpt_train,
     "serve_fresh": serve_fresh,
@@ -359,6 +421,7 @@ SCENARIOS: Dict[str, Callable[[], Callable[[], None]]] = {
     "serve_recovery_warm": serve_recovery_warm,
     "fleet_warm": fleet_warm,
     "serve_http_warm": serve_http_warm,
+    "serve_prefix_warm": serve_prefix_warm,
 }
 
 
