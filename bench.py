@@ -709,6 +709,140 @@ def _serve_prefix_extra(cfg, params, *, mb, nb, on_accel, t0, new,
         return {"prefix_cache_error": f"{type(e).__name__}: {e}"}
 
 
+def _serve_quant_extra(cfg, params, *, mb, nb, on_accel, t0, new):
+    """Quantized-serving A/B for the serve config (ISSUE 16): the SAME
+    seeded request sequence through three engines — bf16 (baseline),
+    int8 weight-only, and int8 weights + int8 paged-KV — reporting
+    tokens/s and the modelled HBM bytes/token both for weights (the
+    decode is weight-bandwidth-bound) and per KV page, plus a CAPACITY
+    row: at an identical pool byte budget, how many sequences can run
+    concurrently on bf16 vs int8 KV pages (the ~2x admission win that
+    motivates KV quantization).  Never fails the row — errors land in
+    extra.quant_error."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.analysis.kernel.cost import \
+            decode_block_weight_bytes
+        from paddle_tpu.inference.serving import ContinuousBatchingEngine
+        from paddle_tpu.models.llama import (build_llama_train_step,
+                                             llama_tiny)
+        from paddle_tpu.ops.paged_kv import kv_page_bytes
+        from paddle_tpu.quantization import ServeQuantConfig
+        from paddle_tpu import parallel as dist
+
+        def run(qc):
+            eng = ContinuousBatchingEngine(
+                cfg, params, max_batch=mb, block_size=16,
+                num_blocks=nb, prefill_buckets=(t0,), quant_config=qc)
+            r = np.random.default_rng(16)
+            for _ in range(3 if not on_accel else 8):
+                eng.add_request(
+                    r.integers(0, cfg.vocab_size, (t0,)).astype(
+                        np.int32), new)
+            eng.step()                    # compile warm-up iteration
+            warm = sum(len(q.out) for q in eng.slots if q is not None)
+            t_start = time.perf_counter()
+            res = eng.run_to_completion()
+            dt = time.perf_counter() - t_start
+            toks = sum(len(v) - t0 for v in res.values()) - warm
+            rep = eng.kv_leak_report()
+            if rep["leaked"] or rep["unaccounted"]:
+                raise RuntimeError(f"quant A/B leaked KV: {rep}")
+            return round(toks / dt, 1)
+
+        def wbytes(weight_dtype):
+            per_layer = decode_block_weight_bytes(
+                hidden=cfg.hidden_size, num_heads=cfg.num_heads,
+                kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+                ffn_hidden=cfg.intermediate_size, arch="llama",
+                weight_dtype=weight_dtype,
+                itemsize_=jnp.dtype(cfg.dtype).itemsize)
+            return per_layer * cfg.num_layers
+
+        # the baseline column is labelled by the config's ACTUAL dtype
+        # (the CPU-proxy serve row runs fp32) so the bytes columns
+        # never overclaim the compression ratio
+        base = str(jnp.dtype(cfg.dtype))
+        kv_isz = jnp.dtype(cfg.dtype).itemsize
+        ab = {
+            "baseline_dtype": base,
+            "tokens_per_s": {
+                base: run(None),
+                "int8_weights": run(ServeQuantConfig(
+                    weight_dtype="int8")),
+                "int8_weights_int8_kv": run(ServeQuantConfig(
+                    weight_dtype="int8", kv_dtype="int8"))},
+            "weight_bytes_per_token": {
+                base: wbytes(None), "int8": wbytes("int8"),
+                "int4": wbytes("int4")},
+            "kv_bytes_per_page_per_layer": {
+                base: kv_page_bytes(16, cfg.kv_heads, cfg.head_dim,
+                                    dtype_itemsize=kv_isz),
+                "int8": kv_page_bytes(16, cfg.kv_heads, cfg.head_dim,
+                                      dtype_itemsize=kv_isz,
+                                      kv_quant=True)},
+        }
+
+        # capacity at FIXED pool bytes: head_dim-64 geometry (the
+        # serving-relevant regime — at tiny head_dim the fp32 scale
+        # overhead eats the win, docs/performance.md has the math)
+        ccfg = llama_tiny(hidden_size=128, num_heads=2, num_kv_heads=2,
+                          num_layers=2, dtype="bfloat16")
+        topo = dist.init_topology(devices=jax.devices()[:1])
+        _, init_fn = build_llama_train_step(ccfg, topo,
+                                            num_microbatches=1)
+        cparams = init_fn(0)["params"]
+        page_bf16 = kv_page_bytes(16, ccfg.kv_heads, ccfg.head_dim,
+                                  dtype_itemsize=2)
+        page_int8 = kv_page_bytes(16, ccfg.kv_heads, ccfg.head_dim,
+                                  dtype_itemsize=2, kv_quant=True)
+        budget = 16 * page_bf16 * ccfg.num_layers * 2   # 16 bf16 pages
+
+        def capacity(kv_quant):
+            # 24-token prompts + 8 new tokens = exactly 2 blocks per
+            # sequence held across 8 decode steps, so peak concurrency
+            # is block-bound, not batch-bound: min(16, blocks // 2)
+            page = page_int8 if kv_quant else page_bf16
+            blocks = budget // (page * ccfg.num_layers * 2)
+            qc = ServeQuantConfig(kv_dtype="int8") if kv_quant else None
+            eng = ContinuousBatchingEngine(
+                ccfg, cparams, max_batch=16, block_size=16,
+                num_blocks=int(blocks), prefill_buckets=(32,),
+                quant_config=qc)
+            r = np.random.default_rng(8)
+            for _ in range(16):
+                eng.add_request(
+                    r.integers(0, ccfg.vocab_size, (24,)).astype(
+                        np.int32), 8)
+            peak = 0
+            while eng.queue or eng.finished \
+                    or any(s is not None for s in eng.slots):
+                eng.step()
+                peak = max(peak, eng.active_requests)
+            rep = eng.kv_leak_report()
+            if rep["leaked"] or rep["unaccounted"]:
+                raise RuntimeError(f"capacity row leaked KV: {rep}")
+            return int(blocks), peak
+
+        blk_b, conc_b = capacity(False)
+        blk_q, conc_q = capacity(True)
+        ab["capacity_at_fixed_pool_bytes"] = {
+            "pool_bytes": budget, "head_dim": ccfg.head_dim,
+            "blocks": {"bf16": blk_b, "int8_kv": blk_q},
+            "concurrent_seqs": {"bf16": conc_b, "int8_kv": conc_q},
+            "ratio": round(conc_q / conc_b, 2),
+        }
+        ab["kv_leaked_blocks"] = 0
+        ab["note"] = ("one-core CPU proxy: the bytes/token and "
+                      "capacity columns are the memory-bound-hardware "
+                      "claim; CPU tokens/s deltas mostly measure "
+                      "dequant FLOPs, not the HBM streaming win")
+        return {"quant": ab}
+    except Exception as e:
+        return {"quant_error": f"{type(e).__name__}: {e}"}
+
+
 def _serve_decode_block_extra(cfg, params, eng_fused, *, mb, nb, on_accel,
                               t0, new):
     """Fused-vs-per-op decode A/B for the serve row (ISSUE 9): the same
@@ -1013,6 +1147,9 @@ def run_config_bench(config: str):
         out["extra"].update(_serve_prefix_extra(
             cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
             new=new, aot_dir=aot_dir_out.get("dir")))
+        out["extra"].update(_serve_quant_extra(
+            cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
+            new=new))
     elif config == "decode":
         # inference: autoregressive decode through the KV-cache decoder
         # (prefill + lax.scan step loop; Pallas MMHA on TPU) — the

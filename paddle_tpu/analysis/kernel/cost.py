@@ -42,7 +42,8 @@ __all__ = [
     "VMEM_BYTES_PER_CORE", "SAFETY_FRACTION", "DEFAULT_GENERATION",
     "MAX_HEAD_DIM", "MODEL_TOLERANCE", "budget_bytes", "fits",
     "generation_from_device_kind", "itemsize", "Buffer", "vmem_bytes",
-    "decode_block_vmem", "decode_block_unsupported_reason",
+    "decode_block_vmem", "decode_block_weight_bytes",
+    "decode_block_unsupported_reason",
     "linear_ce_vmem", "linear_ce_fits",
 ]
 
@@ -149,7 +150,8 @@ def vmem_bytes(buffers: Iterable[Buffer]) -> int:
 def decode_block_vmem(*, hidden: int, num_heads: int, kv_heads: int,
                       head_dim: int, block_size: int, pages: int,
                       weight_bytes: int, pool_itemsize: int,
-                      x_itemsize: int = 4) -> Dict[str, int]:
+                      x_itemsize: int = 4,
+                      kv_quant: bool = False) -> Dict[str, int]:
     """Byte breakdown of one decode_block kernel invocation.
 
     Mirrors ``ops/pallas/decode_block._call`` exactly: the layer's full
@@ -158,29 +160,105 @@ def decode_block_vmem(*, hidden: int, num_heads: int, kv_heads: int,
     (k + v), the online-softmax state is fp32 scratch, and the residual
     stream/RoPE rows/outputs are one-row blocks.  Keys: ``weights``,
     ``staging``, ``scratch``, ``io``, ``total``.
+
+    With ``kv_quant`` the pool is int8 data plus per-(token, head) fp32
+    scales: the staging tier gains a scale row per page (k + v) and the
+    kernel emits fp32 ``k_new``/``v_new`` (the host quantizes on
+    append), so ``pool_itemsize`` must be 1 and the new-KV io rows are
+    fp32.
     """
     Hq, Hkv, D, BS = num_heads, kv_heads, head_dim, block_size
     staging = 2 * pages * BS * Hkv * D * pool_itemsize
+    if kv_quant:
+        # per-(token, head) fp32 scale pages staged alongside the int8
+        # data pages (ops/paged_kv.QuantizedKVPool layout)
+        staging += 2 * pages * BS * Hkv * 4
     # fp32 scratch: q (Hq, D) + acc (Hq, D) + new k/v (2 * Hkv * D)
     # + running max/sum (2 * Hq)
     scratch = 4 * (2 * Hq * D + 2 * Hkv * D + 2 * Hq)
+    new_kv_itemsize = 4 if kv_quant else pool_itemsize
     io = vmem_bytes([
         Buffer("x", (1, hidden), x_itemsize),
         Buffer("cos", (1, D), x_itemsize),
         Buffer("sin", (1, D), x_itemsize),
         Buffer("x_out", (1, hidden), x_itemsize),
-        Buffer("k_new", (1, Hkv, D), pool_itemsize),
-        Buffer("v_new", (1, Hkv, D), pool_itemsize),
+        Buffer("k_new", (1, Hkv, D), new_kv_itemsize),
+        Buffer("v_new", (1, Hkv, D), new_kv_itemsize),
     ])
     total = weight_bytes + staging + scratch + io
     return {"weights": weight_bytes, "staging": staging,
             "scratch": scratch, "io": io, "total": total}
 
 
+def _quantized_matmul_bytes(k: int, n: int, weight_dtype: Optional[str],
+                            group_size: int, itemsize_: int) -> int:
+    """Stored bytes of one (K, N) matmul weight under weight-only
+    quantization — the ``nn.quant.weight_quantize`` layout: int8 keeps
+    K*N one-byte codes, int4 packs two codes per byte along K (halves
+    packing, ceil(K/2) rows), and every matmul carries fp32 scales —
+    one per output channel (``group_size == -1``) or one per
+    (K-group, channel)."""
+    if weight_dtype is None:
+        return k * n * itemsize_
+    groups = 1 if group_size in (-1, None, 0) else -(-k // int(group_size))
+    scale = groups * n * 4
+    if weight_dtype == "int8":
+        return k * n + scale
+    if weight_dtype == "int4":
+        return -(-k // 2) * n + scale
+    raise ValueError(f"unknown weight_dtype {weight_dtype!r} "
+                     "(want None, 'int8' or 'int4')")
+
+
+def decode_block_weight_bytes(*, hidden: int, num_heads: int,
+                              kv_heads: int, head_dim: int,
+                              ffn_hidden: int, arch: str = "llama",
+                              fused_qkv: bool = False, bias: bool = False,
+                              weight_dtype: Optional[str] = None,
+                              group_size: int = -1,
+                              itemsize_: int = 4) -> int:
+    """Closed-form bytes of one decode-block layer's weight set, with
+    optional weight-only quantization — the static side of the fusion
+    envelope proof (``decode_block_unsupported_reason`` admits widths
+    under int8/int4 that fall back at full width).
+
+    Matmul weights quantize (int8: 1 B/code; int4: packed halves,
+    ceil(K/2) rows; + fp32 scales per channel or per (group, channel));
+    norm weights and biases stay at ``itemsize_`` — exactly what
+    ``quantization.serve.quantize_params_for_serving`` produces.
+    """
+    H, Hq, Hkv, D, F = hidden, num_heads, kv_heads, head_dim, ffn_hidden
+
+    def mm(k, n):
+        return _quantized_matmul_bytes(k, n, weight_dtype, group_size,
+                                       itemsize_)
+
+    if fused_qkv:
+        qkv = mm(H, (Hq + 2 * Hkv) * D)
+    else:
+        qkv = mm(H, Hq * D) + 2 * mm(H, Hkv * D)
+    total = qkv + mm(Hq * D, H)
+    if arch == "llama":
+        total += 2 * mm(H, F) + mm(F, H)          # gate, up, down
+        total += 2 * H * itemsize_                # ln1_w, ln2_w
+    elif arch == "gpt":
+        total += mm(H, F) + mm(F, H)              # fc, proj
+        total += 2 * H * itemsize_                # ln1_w, ln2_w
+    else:
+        raise ValueError(f"unknown arch {arch!r}")
+    if bias:
+        # qkv + o + fc/proj (+ up/gate-less llama has no bias path, but
+        # the spec permits it symmetrically) and the layernorm biases
+        nb = (Hq + 2 * Hkv) * D + H + F + H + 2 * H
+        total += nb * itemsize_
+    return total
+
+
 def decode_block_unsupported_reason(
         *, hidden: int, num_heads: int, kv_heads: int, head_dim: int,
         block_size: int, rope: bool, weight_bytes: int,
         pool_itemsize: int, x_itemsize: int = 4,
+        kv_quant: bool = False,
         budget: Optional[int] = None,
         generation: Optional[str] = None) -> Optional[str]:
     """None when one decode_block layer fits the kernel's limits, else
@@ -197,7 +275,7 @@ def decode_block_unsupported_reason(
         hidden=hidden, num_heads=num_heads, kv_heads=kv_heads,
         head_dim=D, block_size=block_size, pages=1,
         weight_bytes=weight_bytes, pool_itemsize=pool_itemsize,
-        x_itemsize=x_itemsize)
+        x_itemsize=x_itemsize, kv_quant=kv_quant)
     if est["total"] > limit:
         return (f"layer needs ~{est['total'] / 2**20:.1f} MB VMEM "
                 f"({est['weights'] / 2**20:.1f} MB weights) > budget "

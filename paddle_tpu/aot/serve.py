@@ -69,7 +69,12 @@ def engine_config(engine) -> Dict[str, Any]:
     (when speculating) the draft/verify geometry — an artifact exported
     without speculation can never half-warm-start a speculating engine,
     it is a config mismatch and a clean fallback."""
+    from ..ops.paged_kv import is_quantized_pool
     params_td, params_leaves = args_signature((engine.params,))
+    pool_k = engine.pool_k
+    pool_dtype = (f"{pool_k.data.dtype}+{pool_k.scale.dtype}-scale"
+                  if is_quantized_pool(pool_k) else str(pool_k.dtype))
+    qc = getattr(engine, "quant_config", None)
     cfg = {
         "kind": "continuous_batching_engine",
         "model": dataclasses.asdict(engine.cfg),
@@ -77,7 +82,12 @@ def engine_config(engine) -> Dict[str, Any]:
         "block_size": engine.BS,
         "max_blocks_per_seq": engine.MB,
         "num_blocks": engine.alloc.num_blocks,
-        "pool_dtype": str(engine.pool_k.dtype),
+        "pool_dtype": pool_dtype,
+        # the quantization config changes the compiled programs (weight
+        # leaf layout, dequant matmuls, pool pytree) AND the params
+        # signature — hash it explicitly so an artifact exported at one
+        # quantization can never half-warm-start another (ISSUE 16)
+        "quant": qc.describe() if qc is not None else None,
         # the ISSUE 9 fusion knob changes which kernel tier a RE-compile
         # of the decode step would take, so a warm start must not cross
         # it — an artifact exported fused never half-warms an unfused

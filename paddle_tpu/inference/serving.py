@@ -203,6 +203,21 @@ class ContinuousBatchingEngine:
         CRC-checked byte copies and restores them by exact-byte scatter
         (no recompute) on the next hit.  Default policy (no offload)
         matches the pre-ISSUE-14 drop-on-eviction behavior.
+      quant_config: a :class:`~paddle_tpu.quantization.ServeQuantConfig`
+        enabling quantized serving (ISSUE 16).  ``weight_dtype``
+        ("int8"/"int4", optionally grouped) serves weight-only
+        quantized block matmuls: ``params`` may be a pre-exported tree
+        (``quantization.quantize_params_for_serving``) or a full-width
+        tree, which is PTQ-exported at construction.  ``kv_dtype``
+        ("int8") stores the paged KV pool as int8 codes with
+        per-(token, head) fp32 scales (``ops.paged_kv.
+        QuantizedKVPool``) — roughly halving KV bytes/token at
+        head_dim 64+, so the same pool admits ~2x the concurrent
+        sequences.  Greedy decode stays bit-identical WITHIN a quant
+        config across every serve path (fused/unfused, spec-decode,
+        prefix-cache hit, preempt/restore); the config is covered by
+        the AOT ``engine_config`` hash so a warm start can never
+        half-load a mismatched quantization.
 
     The engine keeps its own page table rather than reusing
     ops/paged_kv.PagedKVCache: that class sizes its table [B, num_blocks]
@@ -218,10 +233,15 @@ class ContinuousBatchingEngine:
                  prefill_buckets=None, aot_dir: Optional[str] = None,
                  fused_decode_block: bool = True, spec_config=None,
                  enable_preemption: bool = True, spill_tier=None,
-                 prefix_cache_config=None):
+                 prefix_cache_config=None, quant_config=None):
         if getattr(cfg, "moe_num_experts", 0) and \
                 getattr(cfg, "moe_router", "topk") != "topk":
             raise NotImplementedError("decode serves token-choice only")
+        if quant_config is not None and quant_config.quantized_weights \
+                and getattr(cfg, "moe_num_experts", 0):
+            raise NotImplementedError(
+                "weight-quantized serving covers dense FFNs only — the "
+                "MoE expert matmuls keep full-width weights (ROADMAP)")
         rs = getattr(cfg, "rope_scaling", None)
         if rs and rs.get("rope_type", rs.get("type")) == "dynamic":
             raise NotImplementedError(
@@ -230,6 +250,15 @@ class ContinuousBatchingEngine:
                 "which would mis-scale every shorter sequence — use "
                 "'linear' or 'llama3' scaling for serving")
         self.cfg = cfg
+        self.quant_config = quant_config
+        if quant_config is not None and quant_config.quantized_weights \
+                and not any(k.endswith("__q")
+                            for k in params["blocks"]):
+            # full-width tree handed to a quantized engine: PTQ-export
+            # it here (absmax scales); calibrated trees come in already
+            # exported via quantize_params_for_serving(thresholds=...)
+            from ..quantization.serve import quantize_params_for_serving
+            params = quantize_params_for_serving(params, quant_config)
         self.params = params
         self.fused_decode_block = bool(fused_decode_block)
         self.B = max_batch
@@ -244,11 +273,17 @@ class ContinuousBatchingEngine:
         # numpy array = convert_element_type executable), so a restore
         # under traffic hits a compiled-at-construction op instead of
         # tracing one — the fleet_warm budget row pins serve-path
-        # compiles at zero
-        self.pool_k = jnp.array(
-            np.zeros((L, num_blocks, block_size, kvh, hd), dt))
-        self.pool_v = jnp.array(
-            np.zeros((L, num_blocks, block_size, kvh, hd), dt))
+        # compiles at zero.  A quantized-KV config builds an int8
+        # QuantizedKVPool (codes + per-(token, head) fp32 scales).
+        from ..ops.paged_kv import zeros_kv_pool
+        self._kv_quant = quant_config is not None \
+            and quant_config.quantized_kv
+        self.pool_k = zeros_kv_pool(
+            (L, num_blocks, block_size, kvh, hd), dt,
+            kv_quant=self._kv_quant)
+        self.pool_v = zeros_kv_pool(
+            (L, num_blocks, block_size, kvh, hd), dt,
+            kv_quant=self._kv_quant)
         self.block_table = np.full((max_batch, self.MB), -1, np.int32)
         self.lengths = np.zeros((max_batch,), np.int32)
         self.tokens = np.zeros((max_batch,), np.int32)
@@ -349,6 +384,16 @@ class ContinuousBatchingEngine:
     # ------------------------------------------------------------------
     # compiled per-iteration decode over every slot
     # ------------------------------------------------------------------
+    def _quant_kw(self):
+        """The weight-quantization fields every block spec in this
+        engine is built with — ONE source so the decode step, the chunk
+        fills, and the spec-decode verify always agree."""
+        qc = self.quant_config
+        if qc is None or not qc.quantized_weights:
+            return {}
+        return {"weight_dtype": qc.weight_dtype,
+                "group_size": qc.group_size}
+
     def _build_step(self):
         cfg = self.cfg
         from ..models.llama import _rope_cos_sin
@@ -359,7 +404,7 @@ class ContinuousBatchingEngine:
             cfg.max_position_embeddings, D, cfg.rope_theta,
             jnp.dtype(cfg.dtype), getattr(cfg, "rope_scaling", None))
         rms, moe_ffn = _make_rms_ffn(cfg)
-        spec = decode_block_spec(cfg, self.BS)
+        spec = decode_block_spec(cfg, self.BS, **self._quant_kw())
         ffn_override = moe_ffn if getattr(cfg, "moe_num_experts", 0) \
             else None
         # fused on: auto tier (per-op reference on CPU — bit-identical —
@@ -417,7 +462,7 @@ class ContinuousBatchingEngine:
             jnp.dtype(cfg.dtype), getattr(cfg, "rope_scaling", None))
         scale = 1.0 / (D ** 0.5)
         rms, moe_ffn = _make_rms_ffn(cfg)
-        spec = decode_block_spec(cfg, BS)
+        spec = decode_block_spec(cfg, BS, **self._quant_kw())
         ffn_override = moe_ffn if getattr(cfg, "moe_num_experts", 0) \
             else None
 
@@ -432,8 +477,9 @@ class ContinuousBatchingEngine:
             if valid is not None:
                 # bucketed call: padded rows scatter out of range (the
                 # update is dropped) so stale pool pages stay intact
+                from ..ops.paged_kv import pool_geometry
                 blk = jnp.where(jnp.arange(Ts) < valid, blk,
-                                pool_k.shape[1])
+                                pool_geometry(pool_k)[0])
             off = pos % BS
             jpos = jnp.arange(bt_row.shape[0] * BS)[None, None, None, :]
             mask = jpos <= pos[None, None, :, None]
@@ -653,9 +699,18 @@ class ContinuousBatchingEngine:
         release the cache's pool reference."""
         cache = self.prefix_cache
         if cache.wants_offload:
-            k = np.asarray(self.pool_k)[:, node.phys].copy()
-            v = np.asarray(self.pool_v)[:, node.phys].copy()
-            phys = cache.evict(node, k, v)
+            if self._kv_quant:
+                # int8 pages travel with their per-(token, head) fp32
+                # scales — both CRC-stamped, both restored by scatter
+                k = np.asarray(self.pool_k.data)[:, node.phys].copy()
+                v = np.asarray(self.pool_v.data)[:, node.phys].copy()
+                ks = np.asarray(self.pool_k.scale)[:, node.phys].copy()
+                vs = np.asarray(self.pool_v.scale)[:, node.phys].copy()
+                phys = cache.evict(node, k, v, ks, vs)
+            else:
+                k = np.asarray(self.pool_k)[:, node.phys].copy()
+                v = np.asarray(self.pool_v)[:, node.phys].copy()
+                phys = cache.evict(node, k, v)
         else:
             phys = cache.evict(node)
         self.alloc.release([phys])
@@ -683,11 +738,21 @@ class ContinuousBatchingEngine:
             return 0
         from ..observability import REGISTRY
         from ..serving.resilience import SpillCorruptError
-        pk = pv = None
+        pk = pv = pks = pvs = None
         restored = 0
         for j, node in enumerate(off):
             try:
                 node.verify()
+                if (node.k_scale is not None) != self._kv_quant:
+                    # an offloaded block whose quantization disagrees
+                    # with the pool (e.g. restored cache state from a
+                    # differently-configured engine) can never scatter
+                    # — same typed demotion as bit-rot: recompute the
+                    # suffix, never corrupt the pool
+                    raise SpillCorruptError(
+                        f"offloaded prefix block {node.key.hex()[:12]} "
+                        "quantization does not match this engine's KV "
+                        "pool — demoting to suffix recompute")
             except SpillCorruptError as e:
                 self.prefix_cache.drop_host(node)
                 if REGISTRY.enabled:
@@ -698,18 +763,34 @@ class ContinuousBatchingEngine:
                                    error=str(e)[:200])
                 break
             if pk is None:
-                pk = np.asarray(self.pool_k).copy()
-                pv = np.asarray(self.pool_v).copy()
+                if self._kv_quant:
+                    pk = np.asarray(self.pool_k.data).copy()
+                    pv = np.asarray(self.pool_v.data).copy()
+                    pks = np.asarray(self.pool_k.scale).copy()
+                    pvs = np.asarray(self.pool_v.scale).copy()
+                else:
+                    pk = np.asarray(self.pool_k).copy()
+                    pv = np.asarray(self.pool_v).copy()
             pk[:, priv[j]] = node.k_bytes
             pv[:, priv[j]] = node.v_bytes
+            if self._kv_quant:
+                pks[:, priv[j]] = node.k_scale
+                pvs[:, priv[j]] = node.v_scale
             self.prefix_cache.promote(node, priv[j])
             self.alloc.share([priv[j]])
             restored += 1
         if pk is not None:
             # owned copies, never aliases: the decode step donates the
             # pools (see restore_into_slot for the full rationale)
-            self.pool_k = jnp.array(pk)
-            self.pool_v = jnp.array(pv)
+            if self._kv_quant:
+                from ..ops.paged_kv import QuantizedKVPool
+                self.pool_k = QuantizedKVPool(jnp.array(pk),
+                                              jnp.array(pks))
+                self.pool_v = QuantizedKVPool(jnp.array(pv),
+                                              jnp.array(pvs))
+            else:
+                self.pool_k = jnp.array(pk)
+                self.pool_v = jnp.array(pv)
         if restored and REGISTRY.enabled:
             REGISTRY.counter("serve.prefix.restores_total").inc(restored)
             REGISTRY.gauge("serve.prefix.offloaded_bytes").set(
@@ -884,10 +965,16 @@ class ContinuousBatchingEngine:
         THIS pool: identical page geometry (layers, block size, kv
         heads, head dim, dtype) and a table wide enough to hold it —
         the precondition for cross-replica snapshot transplant
-        (``serving/fleet.py``)."""
-        return (snap.k_pages.shape[0] == self.pool_k.shape[0]
-                and snap.k_pages.shape[2:] == self.pool_k.shape[2:]
-                and snap.k_pages.dtype == self.pool_k.dtype
+        (``serving/fleet.py``).  Quantized pools additionally require
+        the snapshot to carry per-page scales (and vice versa) — an
+        int8 snapshot can never scatter into a bf16 pool."""
+        if (getattr(snap, "k_scale", None) is not None) != \
+                self._kv_quant:
+            return False
+        ref = self.pool_k.data if self._kv_quant else self.pool_k
+        return (snap.k_pages.shape[0] == ref.shape[0]
+                and snap.k_pages.shape[2:] == ref.shape[2:]
+                and snap.k_pages.dtype == ref.dtype
                 and snap.num_blocks <= self.MB)
 
     def adopt_preempted(self, req: GenRequest, snap) -> None:
@@ -898,10 +985,11 @@ class ContinuousBatchingEngine:
         exact page bytes into fresh local blocks — same path as a local
         preemption, bit-identical resumption."""
         if not self.spill_compatible(snap):
+            pshape = (self.pool_k.data if self._kv_quant
+                      else self.pool_k).shape
             raise ValueError(
                 "KV snapshot geometry does not match this engine's pool "
-                f"(snapshot pages {snap.k_pages.shape}, pool "
-                f"{self.pool_k.shape})")
+                f"(snapshot pages {snap.k_pages.shape}, pool {pshape})")
         if req.req_id in self._spill:
             raise ValueError(f"request {req.req_id} already spilled here")
         self.queue.appendleft(req)
@@ -1023,8 +1111,14 @@ class ContinuousBatchingEngine:
             # declared-bucket prefill (cold prompts AND cache-hit
             # suffixes): fixed chunk programs, no per-length jit
             return self._fill_prompt_bucketed(slot, req, L * self.BS)
-        if L:
-            # suffix-only prefill against the cached pages
+        if L or self.quant_config is not None:
+            # suffix-only prefill against the cached pages.  Quantized
+            # engines route COLD prompts here too (start=0): the dense
+            # tier below computes full-width KV and scatters it into
+            # the pool raw, which would skip both the quantized matmul
+            # path and the pool's code+scale layout — one prefill tier
+            # for every quant admission keeps greedy output
+            # bit-identical across cold/hit/replay paths
             suffix = req.prompt[L * self.BS:]
             fill = self._chunk_fill(len(suffix))
             self.pool_k, self.pool_v, logits = fill(

@@ -46,12 +46,13 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from .paged_kv import (paged_append, paged_decode_attention,
+from .paged_kv import (QuantizedKVPool, dequantize_kv, is_quantized_pool,
+                       paged_append, paged_decode_attention, quantize_kv,
                        validate_paged_decode_geometry)
 
 __all__ = ["DecodeBlockSpec", "DecodeBlockUnsupportedError", "decode_block",
            "decode_block_spec", "decode_block_unsupported_reason",
-           "hbm_traffic_per_token", "make_norm", "make_ffn",
+           "hbm_traffic_per_token", "make_norm", "make_ffn", "make_mm",
            "make_norm_ffn", "prefill_block_xla", "rotate_half"]
 
 
@@ -77,6 +78,12 @@ class DecodeBlockSpec:
     rope: bool = True
     fused_qkv: bool = False           # GPT layout: qkv_w/qkv_b
     bias: bool = False                # GPT layout: proj/fc biases
+    # weight-only quantization: matmul weights live in ``lp`` as
+    # ``<name>__q`` int8 codes (int4: halves-packed nibbles) plus
+    # ``<name>__s`` fp32 scales — the nn.quant/quantization.serve
+    # export layout.  Norm gains and biases stay full width.
+    weight_dtype: Optional[str] = None   # None | "int8" | "int4"
+    group_size: int = -1                 # -1 | 64 | 128 (scale grouping)
 
     def __post_init__(self):
         if self.norm not in ("rms", "ln"):
@@ -88,23 +95,37 @@ class DecodeBlockSpec:
             raise ValueError(
                 "fused_qkv implies MHA (one [H, 3*H] projection); got "
                 f"num_heads={self.num_heads}, kv_heads={self.kv_heads}")
+        if self.weight_dtype not in (None, "int8", "int4"):
+            raise ValueError("weight_dtype must be None, 'int8' or "
+                             f"'int4', got {self.weight_dtype!r}")
+        if self.group_size not in (-1, 64, 128):
+            raise ValueError(f"group_size must be -1/64/128, got "
+                             f"{self.group_size}")
+        if self.weight_dtype is None and self.group_size != -1:
+            raise ValueError("group_size requires weight_dtype")
 
 
-def decode_block_spec(cfg, block_size: int) -> DecodeBlockSpec:
+def decode_block_spec(cfg, block_size: int,
+                      weight_dtype: Optional[str] = None,
+                      group_size: int = -1) -> DecodeBlockSpec:
     """Spec for a model config: Llama-family configs (``rms_norm_eps``)
     map to rms/SwiGLU/RoPE, GPT-family (``layer_norm_eps``) to
-    ln/GELU/fused-qkv."""
+    ln/GELU/fused-qkv.  ``weight_dtype``/``group_size`` select the
+    weight-only quantized variant (params must carry ``__q``/``__s``
+    leaves from ``quantization.serve.quantize_params_for_serving``)."""
     if hasattr(cfg, "rms_norm_eps"):
         return DecodeBlockSpec(
             hidden=cfg.hidden_size, num_heads=cfg.num_heads,
             kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
             block_size=block_size, norm="rms", activation="swiglu",
-            eps=cfg.rms_norm_eps, rope=True)
+            eps=cfg.rms_norm_eps, rope=True,
+            weight_dtype=weight_dtype, group_size=group_size)
     return DecodeBlockSpec(
         hidden=cfg.hidden_size, num_heads=cfg.num_heads,
         kv_heads=cfg.num_heads, head_dim=cfg.head_dim,
         block_size=block_size, norm="ln", activation="gelu",
-        eps=cfg.layer_norm_eps, rope=False, fused_qkv=True, bias=True)
+        eps=cfg.layer_norm_eps, rope=False, fused_qkv=True, bias=True,
+        weight_dtype=weight_dtype, group_size=group_size)
 
 
 def rotate_half(x):
@@ -138,32 +159,72 @@ def make_norm(spec: DecodeBlockSpec) -> Callable:
     return norm
 
 
+def make_mm(spec: DecodeBlockSpec) -> Callable:
+    """``mm(lp, name, y)`` — the ONE matmul closure of every reference-
+    tier serve program.  Full width: ``y @ lp[name]``.  Weight-only
+    quantized: dequantizing matmul over the export layout — per-channel
+    scales post-multiply the int-code matmul (fp32 accumulation), grouped
+    scales dequantize the weight tile first (a per-channel post-multiply
+    cannot represent per-K-group scales) — the same split
+    ``ops/pallas/quant_linear._block_scale`` makes, so the Pallas tier
+    mirrors this structure."""
+    if spec.weight_dtype is None:
+        def mm(lp, name, y):
+            return y @ lp[name]
+        return mm
+    wdt, gs = spec.weight_dtype, spec.group_size
+
+    def mm(lp, name, y):
+        from ..nn.quant import _group_expand, _unpack_int4
+        wq, s = lp[name + "__q"], lp[name + "__s"]
+        K = y.shape[-1]
+        if wdt == "int4":
+            wq = _unpack_int4(wq, K)
+        y32 = y.astype(jnp.float32)
+        s32 = s.astype(jnp.float32)
+        if gs == -1:
+            out = (y32 @ wq.astype(jnp.float32)) * s32
+        else:
+            out = y32 @ (wq.astype(jnp.float32)
+                         * _group_expand(s32, K, gs))
+        return out.astype(y.dtype)
+    return mm
+
+
 def make_ffn(spec: DecodeBlockSpec) -> Callable:
     """``ffn(lp, y)`` for the dense FFN variants (MoE callers pass
     their own closure through ``decode_block(ffn=...)``)."""
+    mm = make_mm(spec)
     if spec.activation == "swiglu":
         def ffn(lp, y):
-            return (jax.nn.silu(y @ lp["gate_w"])
-                    * (y @ lp["up_w"])) @ lp["down_w"]
+            return mm(lp, "down_w", jax.nn.silu(mm(lp, "gate_w", y))
+                      * mm(lp, "up_w", y))
         return ffn
 
     def ffn(lp, y):
-        return jax.nn.gelu(y @ lp["fc1_w"] + lp["fc1_b"],
-                           approximate=True) @ lp["fc2_w"] + lp["fc2_b"]
+        return mm(lp, "fc2_w", jax.nn.gelu(
+            mm(lp, "fc1_w", y) + lp["fc1_b"],
+            approximate=True)) + lp["fc2_b"]
     return ffn
 
 
-def make_norm_ffn(cfg):
+def make_norm_ffn(cfg, weight_dtype: Optional[str] = None,
+                  group_size: int = -1):
     """The Llama-engine (norm, ffn) closure pair — formerly
     ``inference.serving._make_rms_ffn``, now housed with the block op so
     the decode step, the chunk fill, and the spec-decode draft all read
     one definition.  Handles the MoE FFN variants the fused kernel does
     not (those route through the reference tier)."""
     moe = getattr(cfg, "moe_num_experts", 0)
+    if moe and weight_dtype is not None:
+        raise NotImplementedError(
+            "weight-only quantization is not supported with MoE FFNs "
+            "(expert banks are not wired into the PTQ export)")
     spec = DecodeBlockSpec(
         hidden=cfg.hidden_size, num_heads=cfg.num_heads,
         kv_heads=cfg.kv_heads, head_dim=cfg.head_dim, block_size=1,
-        norm="rms", activation="swiglu", eps=cfg.rms_norm_eps)
+        norm="rms", activation="swiglu", eps=cfg.rms_norm_eps,
+        weight_dtype=weight_dtype, group_size=group_size)
     norm = make_norm(spec)
     if not moe:
         return norm, make_ffn(spec)
@@ -184,21 +245,22 @@ def make_norm_ffn(cfg):
 # ---------------------------------------------------------------------------
 # tier 1: XLA reference — the exact per-op composition (bit anchor)
 # ---------------------------------------------------------------------------
-def _qkv(y, lp, spec: DecodeBlockSpec, leading):
+def _qkv(y, lp, spec: DecodeBlockSpec, leading, mm=None):
     """Project the normed stream into per-head q/k/v."""
     H, Hkv, D = spec.num_heads, spec.kv_heads, spec.head_dim
+    mm = mm or make_mm(spec)
     if spec.fused_qkv:
-        qkv = y @ lp["qkv_w"] + lp["qkv_b"]
+        qkv = mm(lp, "qkv_w", y) + lp["qkv_b"]
         qkv = qkv.reshape(*leading, H, 3 * D)
         return jnp.split(qkv, 3, axis=-1)
-    q = (y @ lp["q_w"]).reshape(*leading, H, D)
-    k = (y @ lp["k_w"]).reshape(*leading, Hkv, D)
-    v = (y @ lp["v_w"]).reshape(*leading, Hkv, D)
+    q = mm(lp, "q_w", y).reshape(*leading, H, D)
+    k = mm(lp, "k_w", y).reshape(*leading, Hkv, D)
+    v = mm(lp, "v_w", y).reshape(*leading, Hkv, D)
     return q, k, v
 
 
-def _proj_w(lp, spec: DecodeBlockSpec):
-    return lp["proj_w"] if spec.fused_qkv else lp["o_w"]
+def _proj(attn, lp, spec: DecodeBlockSpec, mm):
+    return mm(lp, "proj_w" if spec.fused_qkv else "o_w", attn)
 
 
 def decode_block_xla(x, lp, pool_k, pool_v, block_table, lengths, cos, sin,
@@ -211,9 +273,10 @@ def decode_block_xla(x, lp, pool_k, pool_v, block_table, lengths, cos, sin,
     ``_build_step`` inlined before ISSUE 9 — the bit-identity anchor."""
     B = x.shape[0]
     norm = make_norm(spec)
+    mm = make_mm(spec)
     ffn = ffn or make_ffn(spec)
     y = norm(x, lp["ln1_w"], lp.get("ln1_b"))
-    q, k, v = _qkv(y, lp, spec, (B,))
+    q, k, v = _qkv(y, lp, spec, (B,), mm)
     if spec.rope:
         def rope1(t):                                     # [B, h?, D]
             return t * cos[:, None, :] + rotate_half(t) * sin[:, None, :]
@@ -222,7 +285,7 @@ def decode_block_xla(x, lp, pool_k, pool_v, block_table, lengths, cos, sin,
                                   lengths, spec.block_size)
     attn = paged_decode_attention(q, pool_k, pool_v, block_table,
                                   lengths + 1)
-    proj = attn.reshape(B, -1) @ _proj_w(lp, spec)
+    proj = _proj(attn.reshape(B, -1), lp, spec, mm)
     x = x + (proj + lp["proj_b"] if spec.bias else proj)
     x = x + ffn(lp, norm(x, lp["ln2_w"], lp.get("ln2_b")))
     return x, pool_k, pool_v
@@ -242,24 +305,40 @@ def prefill_block_xla(x, lp, pool_k, pool_v, blk, off, bt_row, mask, cos,
     Ts = x.shape[1]
     H, Hkv, D = spec.num_heads, spec.kv_heads, spec.head_dim
     norm = make_norm(spec)
+    mm = make_mm(spec)
     ffn = ffn or make_ffn(spec)
     s = scale if scale is not None else 1.0 / (D ** 0.5)
     y = norm(x, lp["ln1_w"], lp.get("ln1_b"))
-    q, k, v = _qkv(y, lp, spec, (1, Ts))
+    q, k, v = _qkv(y, lp, spec, (1, Ts), mm)
     if spec.rope:
         def rope1(t):                                    # [1, Ts, *, D]
             return t * cos[None, :, None, :] \
                 + rotate_half(t) * sin[None, :, None, :]
         q, k = rope1(q), rope1(k)
-    pool_k = pool_k.at[blk, off].set(k[0])
-    pool_v = pool_v.at[blk, off].set(v[0])
-    k_all = jnp.take(pool_k, jnp.maximum(bt_row, 0), axis=0)
-    v_all = jnp.take(pool_v, jnp.maximum(bt_row, 0), axis=0)
+    if is_quantized_pool(pool_k):
+        kq, ks = quantize_kv(k[0])
+        vq, vs = quantize_kv(v[0])
+        pool_k = QuantizedKVPool(data=pool_k.data.at[blk, off].set(kq),
+                                 scale=pool_k.scale.at[blk, off].set(ks))
+        pool_v = QuantizedKVPool(data=pool_v.data.at[blk, off].set(vq),
+                                 scale=pool_v.scale.at[blk, off].set(vs))
+        bt0 = jnp.maximum(bt_row, 0)
+        k_all = dequantize_kv(jnp.take(pool_k.data, bt0, axis=0),
+                              jnp.take(pool_k.scale, bt0, axis=0),
+                              dtype=k.dtype)
+        v_all = dequantize_kv(jnp.take(pool_v.data, bt0, axis=0),
+                              jnp.take(pool_v.scale, bt0, axis=0),
+                              dtype=v.dtype)
+    else:
+        pool_k = pool_k.at[blk, off].set(k[0])
+        pool_v = pool_v.at[blk, off].set(v[0])
+        k_all = jnp.take(pool_k, jnp.maximum(bt_row, 0), axis=0)
+        v_all = jnp.take(pool_v, jnp.maximum(bt_row, 0), axis=0)
     k_all = k_all.reshape(1, -1, Hkv, D)
     v_all = v_all.reshape(1, -1, Hkv, D)
     attn = _dense_masked_attention(q, k_all, v_all, mask,
                                    s).reshape(1, Ts, -1)
-    proj = attn @ _proj_w(lp, spec)
+    proj = _proj(attn, lp, spec, mm)
     x = x + (proj + lp["proj_b"] if spec.bias else proj)
     x = x + ffn(lp, norm(x, lp["ln2_w"], lp.get("ln2_b")))
     return x, pool_k, pool_v

@@ -15,17 +15,99 @@ allocate/extend/release so unrelated sequences share the pool.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["BlockAllocator", "PagedKVCache", "PagedKVGeometryError",
-           "paged_decode_attention", "paged_append",
-           "validate_paged_decode_geometry"]
+           "QuantizedKVPool", "paged_decode_attention", "paged_append",
+           "validate_paged_decode_geometry", "quantize_kv",
+           "dequantize_kv", "kv_page_bytes", "zeros_kv_pool",
+           "pool_geometry", "is_quantized_pool"]
 
 NEG_INF = -1e30
+
+# Scale floor for int8 KV quantization (all-zero rows — fresh pool
+# pages — must not divide by zero; codes stay 0 and dequantize to 0).
+KV_SCALE_EPS = 1e-8
+
+
+class QuantizedKVPool(NamedTuple):
+    """An int8 paged-KV pool: ``data`` holds the codes with the SAME
+    logical shape a full-width pool has (``[..., NB, BS, Hkv, D]``),
+    ``scale`` one fp32 absmax/127 scale per (page, token, kv-head)
+    (``[..., NB, BS, Hkv]``).
+
+    Scales are per-TOKEN, not per-page: a page-wide absmax would have to
+    grow monotonically as tokens append, and a rejected spec-decode
+    draft that raised it would retroactively requantize every committed
+    token in the page — breaking the greedy bit-identity pin.  Per-token
+    scales are append-local: rollback overwrites both code row and scale
+    row in place, so committed tokens never change representation.
+
+    A NamedTuple is a JAX pytree, so quantized pools flow through
+    ``jax.jit`` donation, ``lax.scan`` carries (spec-decode verify), and
+    the engine's jitted step without any special casing.
+    """
+    data: jnp.ndarray
+    scale: jnp.ndarray
+
+
+def is_quantized_pool(pool) -> bool:
+    return isinstance(pool, QuantizedKVPool)
+
+
+def pool_geometry(pool):
+    """(num_blocks, block_size, kv_heads, head_dim) of a [NB, BS, Hkv,
+    D]-shaped pool, full-width or quantized."""
+    arr = pool.data if isinstance(pool, QuantizedKVPool) else pool
+    return tuple(arr.shape[-4:])
+
+
+def quantize_kv(kv):
+    """[..., H, D] new-token rows -> (int8 codes, fp32 scale [..., H]),
+    per-(token, head) absmax — THE quantization both the XLA tier's
+    append and the engine's host-side restore/prefill scatters use, so
+    pool contents are bit-identical no matter which path wrote them."""
+    kf = jnp.asarray(kv).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(kf), axis=-1)
+    scale = jnp.maximum(absmax, KV_SCALE_EPS) / 127.0
+    codes = jnp.clip(jnp.round(kf / scale[..., None]), -127, 127
+                     ).astype(jnp.int8)
+    return codes, scale
+
+
+def dequantize_kv(data, scale, dtype=jnp.float32):
+    """int8 codes [..., H, D] + scale [..., H] -> dequantized [..., H,
+    D] in ``dtype``."""
+    return (jnp.asarray(data).astype(jnp.float32)
+            * jnp.asarray(scale).astype(jnp.float32)[..., None]
+            ).astype(dtype)
+
+
+def kv_page_bytes(block_size: int, kv_heads: int, head_dim: int,
+                  *, dtype_itemsize: int = 2,
+                  kv_quant: bool = False) -> int:
+    """Bytes ONE pool page (k or v, one layer) occupies — the capacity
+    denominator of the bench's quant capacity row.  Quantized pages pay
+    1 B/element codes plus a 4 B fp32 scale per (token, head)."""
+    elems = block_size * kv_heads * head_dim
+    if kv_quant:
+        return elems + block_size * kv_heads * 4
+    return elems * dtype_itemsize
+
+
+def zeros_kv_pool(shape, dtype, *, kv_quant: bool = False):
+    """A fresh OWNED pool (``jnp.array`` of host zeros — safe to donate;
+    never hand ``device_put``/``asarray`` views to the engine's donated
+    args).  ``shape`` is the full-width ``[..., NB, BS, Hkv, D]``."""
+    if kv_quant:
+        return QuantizedKVPool(
+            data=jnp.array(np.zeros(shape, np.int8)),
+            scale=jnp.array(np.zeros(shape[:-1], np.float32)))
+    return jnp.array(np.zeros(shape, dtype))
 
 
 class PagedKVGeometryError(ValueError):
@@ -52,6 +134,24 @@ def validate_paged_decode_geometry(q, pool_k, pool_v, block_table,
             f"{op}: q must be [B, Hq, D] (one token per sequence), got "
             f"shape {q_shape}")
     B, Hq, D = q_shape
+    kq, vq = is_quantized_pool(pool_k), is_quantized_pool(pool_v)
+    if kq != vq:
+        raise PagedKVGeometryError(
+            f"{op}: k/v pools disagree on quantization — k is "
+            f"{'int8' if kq else 'full-width'}, v is "
+            f"{'int8' if vq else 'full-width'}")
+    if kq:
+        for name, p in (("k", pool_k), ("v", pool_v)):
+            if p.data.dtype != jnp.int8:
+                raise PagedKVGeometryError(
+                    f"{op}: quantized {name} pool data must be int8, "
+                    f"got {p.data.dtype}")
+            if tuple(p.scale.shape) != tuple(p.data.shape[:-1]):
+                raise PagedKVGeometryError(
+                    f"{op}: quantized {name} pool scale must be per "
+                    f"(page, token, head) {tuple(p.data.shape[:-1])}, "
+                    f"got {tuple(p.scale.shape)}")
+        pool_k, pool_v = pool_k.data, pool_v.data
     if pool_k.ndim != 4 or pool_v.ndim != 4:
         raise PagedKVGeometryError(
             f"{op}: pools must be [num_blocks, block_size, Hkv, D], got "
@@ -149,9 +249,15 @@ def paged_append(pool_k, pool_v, k_new, v_new, block_table, lengths,
                  block_size: int):
     """Scatter this step's per-sequence k/v token into its current page.
 
-    pool_k/pool_v: [NB, BS, H, D]; k_new/v_new: [B, H, D];
-    block_table: [B, MB] int32; lengths: [B] (tokens already stored).
-    Returns updated (pool_k, pool_v).
+    pool_k/pool_v: [NB, BS, H, D] (or :class:`QuantizedKVPool`);
+    k_new/v_new: [B, H, D]; block_table: [B, MB] int32; lengths: [B]
+    (tokens already stored).  Returns updated (pool_k, pool_v) of the
+    same representation.
+
+    Quantized pools quantize the incoming rows per (token, head)
+    (:func:`quantize_kv`) and scatter code row + scale row to the same
+    (page, offset) — both are overwritten together on rollback, so a
+    token's representation is fixed the moment it commits.
     """
     lengths = jnp.asarray(lengths)
     bt = jnp.asarray(block_table)
@@ -162,7 +268,18 @@ def paged_append(pool_k, pool_v, k_new, v_new, block_table, lengths,
     # unmapped page (-1) must not wrap to the pool's last block and
     # corrupt another sequence: route it out of bounds so the scatter
     # drops it (callers are expected to ensure_capacity first)
-    phys = jnp.where(phys < 0, pool_k.shape[0], phys)
+    nb = (pool_k.data if is_quantized_pool(pool_k) else pool_k).shape[0]
+    phys = jnp.where(phys < 0, nb, phys)
+    if is_quantized_pool(pool_k):
+        kq, ks = quantize_kv(k_new)
+        vq, vs = quantize_kv(v_new)
+        pool_k = QuantizedKVPool(
+            data=pool_k.data.at[phys, off].set(kq, mode="drop"),
+            scale=pool_k.scale.at[phys, off].set(ks, mode="drop"))
+        pool_v = QuantizedKVPool(
+            data=pool_v.data.at[phys, off].set(vq, mode="drop"),
+            scale=pool_v.scale.at[phys, off].set(vs, mode="drop"))
+        return pool_k, pool_v
     pool_k = pool_k.at[phys, off].set(k_new, mode="drop")
     pool_v = pool_v.at[phys, off].set(v_new, mode="drop")
     return pool_k, pool_v
@@ -189,13 +306,22 @@ def paged_decode_attention(q, pool_k, pool_v, block_table, lengths,
     validate_paged_decode_geometry(q, pool_k, pool_v, block_table,
                                    lengths)
     B, Hq, D = q.shape
-    NB, BS, Hkv, _ = pool_k.shape
+    NB, BS, Hkv, _ = pool_geometry(pool_k)
     MB = block_table.shape[1]
     G = Hq // Hkv
     s = scale if scale is not None else 1.0 / math.sqrt(D)
     bt = jnp.maximum(jnp.asarray(block_table), 0)     # -1 -> page 0 (masked)
-    k = jnp.take(pool_k, bt, axis=0)                  # [B, MB, BS, Hkv, D]
-    v = jnp.take(pool_v, bt, axis=0)
+    if is_quantized_pool(pool_k):
+        # gather codes + scales, dequantize to fp32 views; the rest of
+        # the math is EXACTLY the full-width path's (the gathered pages
+        # are already fp32, so the einsum/softmax chain is shared)
+        k = dequantize_kv(jnp.take(pool_k.data, bt, axis=0),
+                          jnp.take(pool_k.scale, bt, axis=0))
+        v = dequantize_kv(jnp.take(pool_v.data, bt, axis=0),
+                          jnp.take(pool_v.scale, bt, axis=0))
+    else:
+        k = jnp.take(pool_k, bt, axis=0)              # [B, MB, BS, Hkv, D]
+        v = jnp.take(pool_v, bt, axis=0)
     k = k.reshape(B, MB * BS, Hkv, D)
     v = v.reshape(B, MB * BS, Hkv, D)
     qg = q.reshape(B, Hkv, G, D)

@@ -47,6 +47,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ...analysis.kernel import cost
+from ..paged_kv import KV_SCALE_EPS, is_quantized_pool
 from .common import NEG_INF, use_interpret
 
 __all__ = ["decode_block_pallas", "tune_decode_block",
@@ -78,6 +79,17 @@ class _Meta(NamedTuple):
     nt: int              # number of chunks (grid inner length)
     mb: int              # block-table width
     scale: float
+    weight_dtype: Optional[str] = None   # weight-only quant storage
+    group_size: int = -1                 # scale grouping along K
+    kv_quant: bool = False               # int8 pool + fp32 scale pages
+    param_keys: Tuple[str, ...] = ()     # actual lp keys, ref order
+
+
+# The matmul weights of both layouts — the leaves weight-only
+# quantization replaces with ``__q``/``__s`` pairs (norm gains and
+# biases always stream full width).
+_MATMUL_NAMES = frozenset(("q_w", "k_w", "v_w", "o_w", "gate_w", "up_w",
+                           "down_w", "qkv_w", "proj_w", "fc1_w", "fc2_w"))
 
 
 def _weight_names(spec) -> Tuple[str, ...]:
@@ -88,15 +100,34 @@ def _weight_names(spec) -> Tuple[str, ...]:
             "up_w", "down_w")
 
 
+def _param_keys(spec) -> Tuple[str, ...]:
+    """The layer-dict keys the kernel streams, in ref order: matmul
+    weights expand to (codes, scales) pairs under weight-only quant."""
+    wdt = getattr(spec, "weight_dtype", None)
+    keys = []
+    for n in _weight_names(spec):
+        if wdt is not None and n in _MATMUL_NAMES:
+            keys.extend((n + "__q", n + "__s"))
+        else:
+            keys.append(n)
+    return tuple(keys)
+
+
 def _vmem_total(spec, pages: int, wbytes: int, pool_itemsize: int,
-                x_itemsize: int) -> int:
+                x_itemsize: int, kv_quant: bool = False) -> int:
     """One layer invocation's VMEM bytes — the shared cost model's
     number (analysis/kernel/cost.py), never a local formula."""
     return cost.decode_block_vmem(
         hidden=spec.hidden, num_heads=spec.num_heads,
         kv_heads=spec.kv_heads, head_dim=spec.head_dim,
         block_size=spec.block_size, pages=pages, weight_bytes=wbytes,
-        pool_itemsize=pool_itemsize, x_itemsize=x_itemsize)["total"]
+        pool_itemsize=pool_itemsize, x_itemsize=x_itemsize,
+        kv_quant=kv_quant)["total"]
+
+
+def _pool_itemsize(pool_k) -> int:
+    return (pool_k.data.dtype.itemsize if is_quantized_pool(pool_k)
+            else pool_k.dtype.itemsize)
 
 
 def unsupported_reason(spec, lp, pool_k) -> Optional[str]:
@@ -104,20 +135,29 @@ def unsupported_reason(spec, lp, pool_k) -> Optional[str]:
     ``ops/decode_block.py`` dispatch signal).  Layout checks (a dense
     layer dict) live here; every byte/cap limit is delegated to the
     shared cost model so the static KL001 analysis and this runtime
-    gate cannot drift."""
-    names = _weight_names(spec)
-    missing = [n for n in names if n not in lp]
+    gate cannot drift.
+
+    Weight bytes are measured from the ACTUAL leaves — under
+    weight-only quant the ``__q`` int8 codes (int4: packed nibbles,
+    half the rows) plus fp32 ``__s`` scales, which is how int8/int4
+    provably admits layer widths whose full-width weights overflow the
+    budget (the fusion-envelope pin)."""
+    keys = _param_keys(spec)
+    missing = [n for n in keys if n not in lp]
     if missing:
         return (f"layer dict lacks {missing} — not a dense "
-                f"{spec.activation} block (MoE FFNs run the reference "
-                "tier)")
-    wbytes = sum(lp[n].size * lp[n].dtype.itemsize for n in names)
+                f"{spec.activation} block"
+                + (" in the quantized export layout"
+                   if getattr(spec, "weight_dtype", None) else
+                   " (MoE FFNs run the reference tier)"))
+    wbytes = sum(lp[n].size * lp[n].dtype.itemsize for n in keys)
     return cost.decode_block_unsupported_reason(
         hidden=spec.hidden, num_heads=spec.num_heads,
         kv_heads=spec.kv_heads, head_dim=spec.head_dim,
         block_size=spec.block_size, rope=spec.rope, weight_bytes=wbytes,
-        pool_itemsize=pool_k.dtype.itemsize,
-        x_itemsize=lp[names[0]].dtype.itemsize,
+        pool_itemsize=_pool_itemsize(pool_k),
+        x_itemsize=lp[keys[0]].dtype.itemsize,
+        kv_quant=is_quantized_pool(pool_k),
         budget=VMEM_BUDGET_BYTES)
 
 
@@ -144,23 +184,60 @@ def _mm(a32, w_ref):
                                preferred_element_type=jnp.float32)
 
 
+def _dot32(a32, w32):
+    return jax.lax.dot_general(a32, w32, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _mm_quant(a32, q_ref, s_ref, meta: "_Meta"):
+    """Dequant-in-kernel matmul over the ``quant_linear`` scale layout:
+    per-channel scales post-multiply the int-code dot (fp32 accum),
+    grouped scales dequantize the VMEM-resident tile first — the same
+    split the reference tier's ``make_mm`` makes, so the two tiers share
+    one numeric structure."""
+    K = a32.shape[-1]
+    wq = q_ref[:]
+    if meta.weight_dtype == "int4":
+        # halves packing: rows [0, K/2) in the low nibble, [K/2, K) in
+        # the high nibble; arithmetic shifts sign-extend
+        lo = (wq << 4).astype(jnp.int8) >> 4
+        hi = wq >> 4
+        wq = jnp.concatenate([lo, hi], axis=0)[:K]
+    s = s_ref[:].astype(jnp.float32)
+    if meta.group_size == -1:
+        return _dot32(a32, wq.astype(jnp.float32)) * s[None, :]
+    srow = jnp.repeat(s, meta.group_size, axis=0)[:K]
+    return _dot32(a32, wq.astype(jnp.float32) * srow)
+
+
+def _mmw(a32, w, name, meta: "_Meta"):
+    """Matmul against logical weight ``name`` — full width or the
+    quantized (codes, scales) pair, decided by the spec."""
+    if meta.weight_dtype is None:
+        return _mm(a32, w[name])
+    return _mm_quant(a32, w[name + "__q"], w[name + "__s"], meta)
+
+
 def _rot_half(x):
     d2 = x.shape[-1] // 2
     return jnp.concatenate([-x[..., d2:], x[..., :d2]], axis=-1)
 
 
 def _kernel(*refs, meta: _Meta):
-    nw = 12 if meta.fused_qkv else 9
+    nw = len(meta.param_keys)
+    np_ = 4 if meta.kv_quant else 2
     bt_ref, len_ref, x_ref, cos_ref, sin_ref = refs[:5]
-    w = dict(zip(("ln1_w", "ln1_b", "qkv_w", "qkv_b", "proj_w", "proj_b",
-                  "ln2_w", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b")
-                 if meta.fused_qkv else
-                 ("ln1_w", "q_w", "k_w", "v_w", "o_w", "ln2_w", "gate_w",
-                  "up_w", "down_w"), refs[5:5 + nw]))
-    pool_k_ref, pool_v_ref = refs[5 + nw:7 + nw]
-    x_out_ref, kn_ref, vn_ref = refs[7 + nw:10 + nw]
-    (q_scr, kn_scr, vn_scr, m_scr, l_scr, acc_scr, kbuf, vbuf,
-     sem) = refs[10 + nw:]
+    w = dict(zip(meta.param_keys, refs[5:5 + nw]))
+    pool_refs = refs[5 + nw:5 + nw + np_]
+    x_out_ref, kn_ref, vn_ref = refs[5 + nw + np_:8 + nw + np_]
+    if meta.kv_quant:
+        pool_k_ref, pool_v_ref, pool_ks_ref, pool_vs_ref = pool_refs
+        (q_scr, kn_scr, vn_scr, m_scr, l_scr, acc_scr, kbuf, vbuf,
+         ksbuf, vsbuf, sem) = refs[8 + nw + np_:]
+    else:
+        pool_k_ref, pool_v_ref = pool_refs
+        (q_scr, kn_scr, vn_scr, m_scr, l_scr, acc_scr, kbuf, vbuf,
+         sem) = refs[8 + nw + np_:]
 
     b = pl.program_id(0)
     jt = pl.program_id(1)
@@ -176,21 +253,36 @@ def _kernel(*refs, meta: _Meta):
         y = _norm_rows(x, w["ln1_w"][:],
                        w["ln1_b"][:] if meta.fused_qkv else None, meta)
         if meta.fused_qkv:
-            z = _mm(y, w["qkv_w"]) + w["qkv_b"][:][None, :]
+            z = _mmw(y, w, "qkv_w", meta) + w["qkv_b"][:][None, :]
             z = z.reshape(Hq, 3 * D)
             q, k, v = z[:, :D], z[:, D:2 * D], z[:, 2 * D:]
         else:
-            q = _mm(y, w["q_w"]).reshape(Hq, D)
-            k = _mm(y, w["k_w"]).reshape(Hkv, D)
-            v = _mm(y, w["v_w"]).reshape(Hkv, D)
+            q = _mmw(y, w, "q_w", meta).reshape(Hq, D)
+            k = _mmw(y, w, "k_w", meta).reshape(Hkv, D)
+            v = _mmw(y, w, "v_w", meta).reshape(Hkv, D)
         if meta.rope:
             cos = cos_ref[:].astype(jnp.float32)            # [1, D]
             sin = sin_ref[:].astype(jnp.float32)
             q = q * cos + _rot_half(q) * sin
             k = k * cos + _rot_half(k) * sin
         q_scr[:] = q
-        kn_scr[:] = k
-        vn_scr[:] = v
+        if meta.kv_quant:
+            # fold the int8-ROUND-TRIPPED new-token k/v: the host-side
+            # append quantizes these rows into the pool, so attending
+            # the stored value (not the full-precision one) keeps this
+            # step bit-consistent with the XLA tier and with what every
+            # future step reads back
+            ks = jnp.maximum(jnp.max(jnp.abs(k), axis=-1,
+                                     keepdims=True),
+                             KV_SCALE_EPS) / 127.0
+            vs = jnp.maximum(jnp.max(jnp.abs(v), axis=-1,
+                                     keepdims=True),
+                             KV_SCALE_EPS) / 127.0
+            kn_scr[:] = jnp.clip(jnp.round(k / ks), -127, 127) * ks
+            vn_scr[:] = jnp.clip(jnp.round(v / vs), -127, 127) * vs
+        else:
+            kn_scr[:] = k
+            vn_scr[:] = v
         kn_ref[:] = k.reshape(1, Hkv, D).astype(kn_ref.dtype)
         vn_ref[:] = v.reshape(1, Hkv, D).astype(vn_ref.dtype)
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
@@ -202,22 +294,35 @@ def _kernel(*refs, meta: _Meta):
     def _page_copies(p):
         idx = jnp.minimum(jt * P + p, meta.mb - 1)
         phys = jnp.maximum(bt_ref[b, idx], 0)
-        return (pltpu.make_async_copy(pool_k_ref.at[phys], kbuf.at[p],
-                                      sem.at[p, 0]),
-                pltpu.make_async_copy(pool_v_ref.at[phys], vbuf.at[p],
-                                      sem.at[p, 1]))
+        copies = [pltpu.make_async_copy(pool_k_ref.at[phys], kbuf.at[p],
+                                        sem.at[p, 0]),
+                  pltpu.make_async_copy(pool_v_ref.at[phys], vbuf.at[p],
+                                        sem.at[p, 1])]
+        if meta.kv_quant:
+            # per-(token, head) fp32 scale rows ride the same page walk
+            copies += [pltpu.make_async_copy(pool_ks_ref.at[phys],
+                                             ksbuf.at[p], sem.at[p, 2]),
+                       pltpu.make_async_copy(pool_vs_ref.at[phys],
+                                             vsbuf.at[p], sem.at[p, 3])]
+        return copies
 
     for p in range(P):
-        ck, cv = _page_copies(p)
-        ck.start()
-        cv.start()
+        for c in _page_copies(p):
+            c.start()
     for p in range(P):
-        ck, cv = _page_copies(p)
-        ck.wait()
-        cv.wait()
+        for c in _page_copies(p):
+            c.wait()
 
-    k_all = kbuf[:].reshape(P * BS, Hkv, D).astype(jnp.float32)
-    v_all = vbuf[:].reshape(P * BS, Hkv, D).astype(jnp.float32)
+    if meta.kv_quant:
+        k_all = (kbuf[:].astype(jnp.float32)
+                 * ksbuf[:].astype(jnp.float32)[..., None])
+        v_all = (vbuf[:].astype(jnp.float32)
+                 * vsbuf[:].astype(jnp.float32)[..., None])
+        k_all = k_all.reshape(P * BS, Hkv, D)
+        v_all = v_all.reshape(P * BS, Hkv, D)
+    else:
+        k_all = kbuf[:].reshape(P * BS, Hkv, D).astype(jnp.float32)
+        v_all = vbuf[:].reshape(P * BS, Hkv, D).astype(jnp.float32)
     t_pos = jt * (P * BS) + jax.lax.broadcasted_iota(
         jnp.int32, (1, P * BS), 1)                          # [1, T]
     valid = t_pos < length
@@ -257,20 +362,21 @@ def _kernel(*refs, meta: _Meta):
                 + p_new * vn_scr[kv][None, :]
             attn = attn.at[sl].set(acc_f / jnp.maximum(l_f, 1e-30))
         x = x_ref[:].astype(jnp.float32)                    # [1, H]
-        proj = _mm(attn.reshape(1, Hq * D), w["proj_w" if meta.fused_qkv
-                                              else "o_w"])
+        proj = _mmw(attn.reshape(1, Hq * D), w,
+                    "proj_w" if meta.fused_qkv else "o_w", meta)
         if meta.bias:
             proj = proj + w["proj_b"][:][None, :]
         x2 = x + proj
         y2 = _norm_rows(x2, w["ln2_w"][:],
                         w["ln2_b"][:] if meta.fused_qkv else None, meta)
         if meta.activation == "swiglu":
-            f = jax.nn.silu(_mm(y2, w["gate_w"])) * _mm(y2, w["up_w"])
-            o = _mm(f, w["down_w"])
+            f = jax.nn.silu(_mmw(y2, w, "gate_w", meta)) \
+                * _mmw(y2, w, "up_w", meta)
+            o = _mmw(f, w, "down_w", meta)
         else:
-            h = jax.nn.gelu(_mm(y2, w["fc1_w"]) + w["fc1_b"][:][None, :],
-                            approximate=True)
-            o = _mm(h, w["fc2_w"]) + w["fc2_b"][:][None, :]
+            h = jax.nn.gelu(_mmw(y2, w, "fc1_w", meta)
+                            + w["fc1_b"][:][None, :], approximate=True)
+            o = _mmw(h, w, "fc2_w", meta) + w["fc2_b"][:][None, :]
         x_out_ref[:] = (x2 + o).astype(x_out_ref.dtype)
 
 
@@ -278,28 +384,34 @@ def _kernel(*refs, meta: _Meta):
 # host wrapper + autotune
 # ---------------------------------------------------------------------------
 def _fitting_candidates(spec, mb: int, pool_itemsize: int, wbytes: int,
-                        x_itemsize: int) -> Tuple[int, ...]:
+                        x_itemsize: int,
+                        kv_quant: bool = False) -> Tuple[int, ...]:
     """Page-chunk candidates the cost model says can fit — the
     provably-overflowing ones never reach the tuner (KL005's runtime
-    half)."""
+    half).  Quantized candidates (int8/int4 weights, int8 KV) filter
+    through the dtype-aware model the same way."""
     cands = tuple(
         p for p in _PAGE_CANDIDATES
         if p <= max(mb, 1)
-        and _vmem_total(spec, p, wbytes, pool_itemsize, x_itemsize)
-        <= VMEM_BUDGET_BYTES)
+        and _vmem_total(spec, p, wbytes, pool_itemsize, x_itemsize,
+                        kv_quant) <= VMEM_BUDGET_BYTES)
     return cands or (1,)
 
 
 def _tuned_pages(spec, lp, pool_k, mb: int, args) -> int:
     from .autotune import FLAGS, lookup, pick
-    wbytes = sum(lp[n].size * lp[n].dtype.itemsize
-                 for n in _weight_names(spec))
-    x_isz = lp[_weight_names(spec)[0]].dtype.itemsize
-    cands = _fitting_candidates(spec, mb, pool_k.dtype.itemsize, wbytes,
-                                x_isz)
+    keys = _param_keys(spec)
+    wbytes = sum(lp[n].size * lp[n].dtype.itemsize for n in keys)
+    x_isz = lp[keys[0]].dtype.itemsize
+    kvq = is_quantized_pool(pool_k)
+    p_isz = _pool_itemsize(pool_k)
+    pool_dt = ("int8+scale" if kvq else str(pool_k.dtype))
+    cands = _fitting_candidates(spec, mb, p_isz, wbytes, x_isz, kvq)
     default = max(p for p in cands if p <= DEFAULT_PAGES)
     key = (spec.hidden, spec.num_heads, spec.kv_heads, spec.head_dim,
-           spec.block_size, mb, spec.activation, str(pool_k.dtype))
+           spec.block_size, mb, spec.activation, pool_dt,
+           getattr(spec, "weight_dtype", None),
+           getattr(spec, "group_size", -1))
     if not FLAGS.use_autotune:
         return default
     if isinstance(args[0], jax.core.Tracer):
@@ -311,8 +423,8 @@ def _tuned_pages(spec, lp, pool_k, mb: int, args) -> int:
 
     return int(pick("decode_block", key, cands, run, args, default,
                     valid=lambda p: _vmem_total(
-                        spec, int(p), wbytes, pool_k.dtype.itemsize,
-                        x_isz) <= VMEM_BUDGET_BYTES))
+                        spec, int(p), wbytes, p_isz, x_isz, kvq)
+                    <= VMEM_BUDGET_BYTES))
 
 
 def _call(x, lp, pool_k, pool_v, block_table, lengths, cos, sin, *,
@@ -326,29 +438,38 @@ def _call(x, lp, pool_k, pool_v, block_table, lengths, cos, sin, *,
     BS = spec.block_size
     mb = block_table.shape[1]
     nt = -(-mb // pages)
-    names = _weight_names(spec)
+    keys = _param_keys(spec)
+    kvq = is_quantized_pool(pool_k)
     meta = _Meta(hidden=H, num_heads=Hq, kv_heads=Hkv, head_dim=D,
                  block_size=BS, norm=spec.norm,
                  activation=spec.activation, eps=spec.eps,
                  rope=spec.rope, fused_qkv=spec.fused_qkv,
                  bias=spec.bias, pages=pages, nt=nt, mb=mb,
-                 scale=1.0 / (D ** 0.5))
+                 scale=1.0 / (D ** 0.5),
+                 weight_dtype=getattr(spec, "weight_dtype", None),
+                 group_size=getattr(spec, "group_size", -1),
+                 kv_quant=kvq, param_keys=keys)
 
     def wspec(arr):
         if arr.ndim == 1:
             return pl.BlockSpec((arr.shape[0],), lambda b, j: (0,))
         return pl.BlockSpec(arr.shape, lambda b, j: (0,) * arr.ndim)
 
+    n_pool = 4 if kvq else 2
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),       # block table
         pl.BlockSpec(memory_space=pltpu.SMEM),       # lengths
         pl.BlockSpec((1, H), lambda b, j: (b, 0)),   # x row
         pl.BlockSpec((1, D), lambda b, j: (b, 0)),   # cos row
         pl.BlockSpec((1, D), lambda b, j: (b, 0)),   # sin row
-        *[wspec(lp[n]) for n in names],
-        pl.BlockSpec(memory_space=pltpu.ANY),        # pool_k
-        pl.BlockSpec(memory_space=pltpu.ANY),        # pool_v
+        *[wspec(lp[n]) for n in keys],
+        pl.BlockSpec(memory_space=pltpu.ANY),        # pool_k (codes)
+        pl.BlockSpec(memory_space=pltpu.ANY),        # pool_v (codes)
+        *[pl.BlockSpec(memory_space=pltpu.ANY)] * (n_pool - 2),  # kv scales
     ]
+    # quantized pools output fp32 k/v rows (the host paged_append
+    # re-quantizes them, so pool contents match the reference tier's)
+    kv_dt = jnp.float32 if kvq else pool_k.dtype
     out_specs = [
         pl.BlockSpec((1, H), lambda b, j: (b, 0)),
         pl.BlockSpec((1, Hkv, D), lambda b, j: (b, 0, 0)),
@@ -356,9 +477,10 @@ def _call(x, lp, pool_k, pool_v, block_table, lengths, cos, sin, *,
     ]
     out_shape = [
         jax.ShapeDtypeStruct((B, H), x.dtype),
-        jax.ShapeDtypeStruct((B, Hkv, D), pool_k.dtype),
-        jax.ShapeDtypeStruct((B, Hkv, D), pool_v.dtype),
+        jax.ShapeDtypeStruct((B, Hkv, D), kv_dt),
+        jax.ShapeDtypeStruct((B, Hkv, D), kv_dt),
     ]
+    pool_dt = pool_k.data.dtype if kvq else pool_k.dtype
     scratch = [
         pltpu.VMEM((Hq, D), jnp.float32),            # q
         pltpu.VMEM((Hkv, D), jnp.float32),           # new k
@@ -366,10 +488,16 @@ def _call(x, lp, pool_k, pool_v, block_table, lengths, cos, sin, *,
         pltpu.VMEM((Hq, 1), jnp.float32),            # running max
         pltpu.VMEM((Hq, 1), jnp.float32),            # running sum
         pltpu.VMEM((Hq, D), jnp.float32),            # attn accumulator
-        pltpu.VMEM((pages, BS, Hkv, D), pool_k.dtype),
-        pltpu.VMEM((pages, BS, Hkv, D), pool_v.dtype),
-        pltpu.SemaphoreType.DMA((pages, 2)),
+        pltpu.VMEM((pages, BS, Hkv, D), pool_dt),
+        pltpu.VMEM((pages, BS, Hkv, D), pool_dt),
     ]
+    if kvq:
+        scratch += [
+            pltpu.VMEM((pages, BS, Hkv), jnp.float32),   # k scales
+            pltpu.VMEM((pages, BS, Hkv), jnp.float32),   # v scales
+        ]
+    pools = ((pool_k.data, pool_v.data, pool_k.scale, pool_v.scale)
+             if kvq else (pool_k, pool_v))
     cos2 = jnp.zeros((B, D), x.dtype) if cos is None else cos
     sin2 = jnp.zeros((B, D), x.dtype) if sin is None else sin
     return pl.pallas_call(
@@ -378,11 +506,12 @@ def _call(x, lp, pool_k, pool_v, block_table, lengths, cos, sin, *,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        scratch_shapes=scratch,
+        scratch_shapes=[*scratch,
+                        pltpu.SemaphoreType.DMA((pages, n_pool))],
         interpret=use_interpret(),
     )(jnp.asarray(block_table, jnp.int32),
       jnp.asarray(lengths, jnp.int32), x, cos2, sin2,
-      *[lp[n] for n in names], pool_k, pool_v)
+      *[lp[n] for n in keys], *pools)
 
 
 def decode_block_pallas(x, lp, pool_k, pool_v, block_table, lengths, cos,

@@ -22,6 +22,10 @@ from .ptq import PTQ  # noqa: F401
 from .export import (  # noqa: F401
     QuantizedLinear, convert_to_deploy, export_quantized,
 )
+from .serve import (  # noqa: F401
+    ServeQuantConfig, quantize_params_for_serving,
+    calibrate_weight_thresholds, dequantize_block_weight,
+)
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "BaseQuanter",
            "BaseObserver", "quanter", "get_quanter", "register_quanter",
@@ -29,4 +33,6 @@ __all__ = ["QuantConfig", "QAT", "PTQ", "BaseQuanter",
            "AbsMaxObserver", "EMAAbsMaxObserver",
            "PerChannelAbsMaxObserver", "HistPercentileObserver",
            "GroupWiseWeightObserver", "quant_dequant",
-           "QuantizedLinear", "convert_to_deploy", "export_quantized"]
+           "QuantizedLinear", "convert_to_deploy", "export_quantized",
+           "ServeQuantConfig", "quantize_params_for_serving",
+           "calibrate_weight_thresholds", "dequantize_block_weight"]

@@ -115,6 +115,11 @@ class _Node:
     phys: Optional[int] = None
     k_bytes: Optional[np.ndarray] = None
     v_bytes: Optional[np.ndarray] = None
+    # quantized pools (ISSUE 16): k_bytes/v_bytes hold int8 codes and
+    # the per-(token, head) fp32 scales park here; the CRCs chain over
+    # codes THEN scales (the KVSnapshot convention)
+    k_scale: Optional[np.ndarray] = None
+    v_scale: Optional[np.ndarray] = None
     crc_k: int = 0
     crc_v: int = 0
 
@@ -130,7 +135,17 @@ class _Node:
     def host_nbytes(self) -> int:
         if self.k_bytes is None:
             return 0
-        return self.k_bytes.nbytes + self.v_bytes.nbytes
+        n = self.k_bytes.nbytes + self.v_bytes.nbytes
+        if self.k_scale is not None:
+            n += self.k_scale.nbytes + self.v_scale.nbytes
+        return n
+
+    @staticmethod
+    def _crc(pages: np.ndarray, scale: Optional[np.ndarray]) -> int:
+        crc = zlib.crc32(pages.tobytes())
+        if scale is not None:
+            crc = zlib.crc32(scale.tobytes(), crc)
+        return crc
 
     def verify(self) -> None:
         """Raise :class:`SpillCorruptError` unless the offloaded bytes
@@ -138,8 +153,8 @@ class _Node:
         framework-io convention: every spilled array carries a CRC32,
         verified on read)."""
         from .resilience import SpillCorruptError
-        if zlib.crc32(self.k_bytes.tobytes()) != self.crc_k or \
-                zlib.crc32(self.v_bytes.tobytes()) != self.crc_v:
+        if self._crc(self.k_bytes, self.k_scale) != self.crc_k or \
+                self._crc(self.v_bytes, self.v_scale) != self.crc_v:
             raise SpillCorruptError(
                 f"offloaded prefix block {self.key.hex()[:12]} (depth "
                 f"{self.depth}) failed its CRC check — host-RAM bit-rot; "
@@ -295,11 +310,15 @@ class PrefixCache:
 
     def evict(self, node: "_Node",
               k_bytes: Optional[np.ndarray] = None,
-              v_bytes: Optional[np.ndarray] = None) -> int:
+              v_bytes: Optional[np.ndarray] = None,
+              k_scale: Optional[np.ndarray] = None,
+              v_scale: Optional[np.ndarray] = None) -> int:
         """Drop ``node``'s residency and return its page for the caller
         to release.  With page bytes (and an offload budget) the block
         parks in the host tier instead of vanishing — CRC-stamped, and
-        bounded by dropping the OLDEST host block past the budget."""
+        bounded by dropping the OLDEST host block past the budget.
+        Quantized pools pass the page's fp32 scales alongside the int8
+        codes; both are stamped and restored together."""
         phys = node.phys
         node.phys = None
         del self._lru[id(node)]
@@ -307,8 +326,10 @@ class PrefixCache:
         if k_bytes is not None and self.wants_offload:
             node.k_bytes = k_bytes
             node.v_bytes = v_bytes
-            node.crc_k = zlib.crc32(k_bytes.tobytes())
-            node.crc_v = zlib.crc32(v_bytes.tobytes())
+            node.k_scale = k_scale
+            node.v_scale = v_scale
+            node.crc_k = node._crc(k_bytes, k_scale)
+            node.crc_v = node._crc(v_bytes, v_scale)
             self._host_lru[id(node)] = node
             self.host_bytes += node.host_nbytes
             self.stats["offloads"] += 1
@@ -343,6 +364,8 @@ class PrefixCache:
             self.host_bytes -= node.host_nbytes
             node.k_bytes = None
             node.v_bytes = None
+            node.k_scale = None
+            node.v_scale = None
             node.crc_k = node.crc_v = 0
             self._host_lru.pop(id(node), None)
         if detach:

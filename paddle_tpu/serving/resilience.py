@@ -116,24 +116,39 @@ class KVSnapshot:
     num_blocks: int            # full table width to re-acquire
     k_pages: np.ndarray        # [L, used_pages, BS, Hkv, D]
     v_pages: np.ndarray
+    # quantized pools (ISSUE 16): k_pages/v_pages hold the int8 codes
+    # and the per-(token, head) fp32 scales ride here — the CRCs chain
+    # over codes THEN scales, so bit-rot in either is caught
+    k_scale: Optional[np.ndarray] = None   # [L, used_pages, BS, Hkv]
+    v_scale: Optional[np.ndarray] = None
     crc_k: int = 0
     crc_v: int = 0
 
     def __post_init__(self):
         if not self.crc_k and not self.crc_v:
-            self.crc_k = zlib.crc32(self.k_pages.tobytes())
-            self.crc_v = zlib.crc32(self.v_pages.tobytes())
+            self.crc_k = self._crc(self.k_pages, self.k_scale)
+            self.crc_v = self._crc(self.v_pages, self.v_scale)
+
+    @staticmethod
+    def _crc(pages: np.ndarray, scale: Optional[np.ndarray]) -> int:
+        crc = zlib.crc32(pages.tobytes())
+        if scale is not None:
+            crc = zlib.crc32(scale.tobytes(), crc)
+        return crc
 
     @property
     def nbytes(self) -> int:
-        return self.k_pages.nbytes + self.v_pages.nbytes
+        n = self.k_pages.nbytes + self.v_pages.nbytes
+        if self.k_scale is not None:
+            n += self.k_scale.nbytes + self.v_scale.nbytes
+        return n
 
     def verify(self) -> None:
         """Raise :class:`SpillCorruptError` unless the page bytes still
         match their spill-time checksums (framework/io.py convention:
         every array member carries a CRC32, verified on read)."""
-        if zlib.crc32(self.k_pages.tobytes()) != self.crc_k or \
-                zlib.crc32(self.v_pages.tobytes()) != self.crc_v:
+        if self._crc(self.k_pages, self.k_scale) != self.crc_k or \
+                self._crc(self.v_pages, self.v_scale) != self.crc_v:
             raise SpillCorruptError(
                 f"spilled KV snapshot for request {self.req_id} failed "
                 "its CRC check — host-RAM bit-rot or a write raced the "
@@ -155,16 +170,25 @@ def snapshot_slot(engine, slot: int) -> KVSnapshot:
     first time a drain spilled an unseen length.  Spill/restore are
     rare, host-bound control-plane events; the extra copy is the cheap
     side of that trade."""
+    from ..ops.paged_kv import is_quantized_pool
     req = engine.slots[slot]
     length = int(engine.lengths[slot])
     used = -(-length // engine.BS)
     pages = engine.slot_pages[slot]
     idx = np.asarray(pages[:used], np.int64)
-    k = np.asarray(engine.pool_k)[:, idx].copy()
-    v = np.asarray(engine.pool_v)[:, idx].copy()
+    ks = vs = None
+    if is_quantized_pool(engine.pool_k):
+        k = np.asarray(engine.pool_k.data)[:, idx].copy()
+        v = np.asarray(engine.pool_v.data)[:, idx].copy()
+        ks = np.asarray(engine.pool_k.scale)[:, idx].copy()
+        vs = np.asarray(engine.pool_v.scale)[:, idx].copy()
+    else:
+        k = np.asarray(engine.pool_k)[:, idx].copy()
+        v = np.asarray(engine.pool_v)[:, idx].copy()
     return KVSnapshot(req_id=req.req_id, length=length,
                       next_token=int(engine.tokens[slot]),
-                      num_blocks=len(pages), k_pages=k, v_pages=v)
+                      num_blocks=len(pages), k_pages=k, v_pages=v,
+                      k_scale=ks, v_scale=vs)
 
 
 def restore_into_slot(engine, slot: int, snap: KVSnapshot) -> None:
@@ -175,13 +199,19 @@ def restore_into_slot(engine, slot: int, snap: KVSnapshot) -> None:
     never preempted.  Host-side scatter for the same zero-compile
     reason as :func:`snapshot_slot`."""
     import jax.numpy as jnp
+
+    from ..ops.paged_kv import QuantizedKVPool, is_quantized_pool
     snap.verify()
+    quant = is_quantized_pool(engine.pool_k)
+    if (snap.k_scale is not None) != quant:
+        raise SpillCorruptError(
+            f"KV snapshot for request {snap.req_id} "
+            f"{'carries' if snap.k_scale is not None else 'lacks'} "
+            "quantization scales but the engine's pool "
+            f"{'is' if quant else 'is not'} quantized — the snapshot "
+            "cannot scatter; replay from the committed token prefix")
     used = snap.k_pages.shape[1]
     pages = np.asarray(engine.slot_pages[slot][:used], np.int64)
-    pk = np.asarray(engine.pool_k).copy()
-    pv = np.asarray(engine.pool_v).copy()
-    pk[:, pages] = snap.k_pages
-    pv[:, pages] = snap.v_pages
     # jnp.array (owned copy), NOT jax.device_put/jnp.asarray: both can
     # zero-copy ALIAS the numpy buffer on CPU, and the decode step
     # DONATES the pools — XLA reusing memory numpy still owns is a
@@ -189,6 +219,22 @@ def restore_into_slot(engine, slot: int, snap: KVSnapshot) -> None:
     # convert_element_type executable that the engine pre-warms at
     # construction, so restores under traffic stay at zero backend
     # compiles (fleet_warm budget row).
+    if quant:
+        pk = np.asarray(engine.pool_k.data).copy()
+        pv = np.asarray(engine.pool_v.data).copy()
+        pks = np.asarray(engine.pool_k.scale).copy()
+        pvs = np.asarray(engine.pool_v.scale).copy()
+        pk[:, pages] = snap.k_pages
+        pv[:, pages] = snap.v_pages
+        pks[:, pages] = snap.k_scale
+        pvs[:, pages] = snap.v_scale
+        engine.pool_k = QuantizedKVPool(jnp.array(pk), jnp.array(pks))
+        engine.pool_v = QuantizedKVPool(jnp.array(pv), jnp.array(pvs))
+        return
+    pk = np.asarray(engine.pool_k).copy()
+    pv = np.asarray(engine.pool_v).copy()
+    pk[:, pages] = snap.k_pages
+    pv[:, pages] = snap.v_pages
     engine.pool_k = jnp.array(pk)
     engine.pool_v = jnp.array(pv)
 

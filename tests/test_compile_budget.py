@@ -91,6 +91,17 @@ def test_prefix_warm_is_zero_compiles(measured):
     assert measured["serve_prefix_warm"] == 0, measured
 
 
+def test_quant_warm_is_zero_compiles(measured):
+    """ISSUE 16 acceptance: a QUANTIZED engine (int8 weight-only
+    matmuls + int8 paged KV) warm-started from an artifact exported at
+    the same quant config — greedy and sampled traffic, a shared-prefix
+    hit on int8 pages, and a preempt/restore cycle through the
+    codes+scales spill format — performs zero backend compiles.  PTQ
+    export is host-side numpy and dequant lives inside the exported
+    programs, so quantization must never add tracing."""
+    assert measured["serve_quant_warm"] == 0, measured
+
+
 def test_http_warm_is_zero_compiles(measured):
     """ISSUE 13 acceptance: the HTTP/SSE front door on an AOT-warm
     engine — server cold-start, greedy AND sampled traffic over real

@@ -291,7 +291,7 @@ def test_autotune_cache_roundtrip(tmp_path):
                                 spec=spec)
         key = (spec.hidden, spec.num_heads, spec.kv_heads, spec.head_dim,
                spec.block_size, bt.shape[1], spec.activation,
-               str(pk.dtype))
+               str(pk.dtype), None, -1)   # unquantized: weight_dtype/group
         won = autotune.lookup("decode_block", key, None)
         assert won is not None and int(won) >= 1
         # the winner persisted to disk for later processes

@@ -177,6 +177,136 @@ def test_decode_block_bf16_pools_shrink_staging(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# quantized decode_block (ISSUE 16): the dtype-aware model vs capture
+# ---------------------------------------------------------------------------
+def _quantize_case(qc, kv_quant=False):
+    from paddle_tpu.ops.decode_block import DecodeBlockSpec
+    from paddle_tpu.ops.paged_kv import QuantizedKVPool, quantize_kv
+    from paddle_tpu.ops.pallas.decode_block import _MATMUL_NAMES
+    from paddle_tpu.quantization.serve import _quantize_matrix
+    spec, lp, x, pk, pv, bt, ln, cos, sin = _decode_case()
+    spec = DecodeBlockSpec(
+        hidden=spec.hidden, num_heads=spec.num_heads,
+        kv_heads=spec.kv_heads, head_dim=spec.head_dim,
+        block_size=spec.block_size, norm="rms", activation="swiglu",
+        eps=1e-5, rope=True, weight_dtype=qc.weight_dtype,
+        group_size=qc.group_size)
+    qlp = {}
+    for n, v in lp.items():
+        if n in _MATMUL_NAMES:
+            q, s = _quantize_matrix(np.asarray(v, np.float32), qc)
+            qlp[n + "__q"] = jnp.asarray(q)
+            qlp[n + "__s"] = jnp.asarray(s)
+        else:
+            qlp[n] = v
+    if kv_quant:
+        pk = QuantizedKVPool(*quantize_kv(pk))
+        pv = QuantizedKVPool(*quantize_kv(pv))
+    return spec, qlp, x, pk, pv, bt, ln, cos, sin
+
+
+@pytest.mark.parametrize("wdt,gs", [("int8", -1), ("int8", 64),
+                                    ("int4", 64)])
+def test_decode_block_quant_weights_estimate_matches_measured(
+        monkeypatch, wdt, gs):
+    """Static ``decode_block_vmem`` with quantized weight bytes ==
+    the interpret-captured declaration: int8 codes stream at 1 B,
+    int4 at half rows, scales ride along fp32 — within
+    MODEL_TOLERANCE.  (The test geometry's K=32/48 rows round up to
+    one 64-group, so gs=64 exercises the grouped layout.)"""
+    from paddle_tpu.ops.pallas.decode_block import (_param_keys,
+                                                    decode_block_pallas)
+    from paddle_tpu.quantization import ServeQuantConfig
+    qc = ServeQuantConfig(weight_dtype=wdt, group_size=gs)
+    spec, qlp, x, pk, pv, bt, ln, cos, sin = _quantize_case(qc)
+    cap = _Capture()
+    cap.install(monkeypatch)
+    out, _, _ = decode_block_pallas(x, qlp, pk, pv, bt, ln, cos, sin,
+                                    spec=spec, pages=2)
+    assert np.isfinite(np.asarray(out)).all()
+    measured = cap.measured_bytes(0)
+    wbytes = sum(qlp[n].size * qlp[n].dtype.itemsize
+                 for n in _param_keys(spec))
+    est = cost.decode_block_vmem(
+        hidden=spec.hidden, num_heads=spec.num_heads,
+        kv_heads=spec.kv_heads, head_dim=spec.head_dim,
+        block_size=spec.block_size, pages=2, weight_bytes=wbytes,
+        pool_itemsize=4, x_itemsize=4)
+    assert _rel_diff(est["total"], measured) <= cost.MODEL_TOLERANCE, (
+        f"static {est} vs measured {measured}")
+    # and the closed-form weight-bytes model matches the actual leaves
+    F = qlp["gate_w__q"].shape[-1]
+    assert cost.decode_block_weight_bytes(
+        hidden=spec.hidden, num_heads=spec.num_heads,
+        kv_heads=spec.kv_heads, head_dim=spec.head_dim, ffn_hidden=F,
+        weight_dtype=wdt, group_size=gs, itemsize_=4) == wbytes
+
+
+def test_decode_block_kv_quant_estimate_matches_measured(monkeypatch):
+    """int8 KV pools: codes stage at 1 B/elt plus fp32 scale rows per
+    page, and the new-token k/v io rows stay fp32 — the model tracks
+    the 4-buffer DMA within MODEL_TOLERANCE."""
+    from paddle_tpu.ops.pallas.decode_block import (_param_keys,
+                                                    decode_block_pallas)
+    from paddle_tpu.quantization import ServeQuantConfig
+    qc = ServeQuantConfig(weight_dtype="int8", kv_dtype="int8")
+    spec, qlp, x, pk, pv, bt, ln, cos, sin = _quantize_case(
+        qc, kv_quant=True)
+    cap = _Capture()
+    cap.install(monkeypatch)
+    decode_block_pallas(x, qlp, pk, pv, bt, ln, cos, sin, spec=spec,
+                        pages=2)
+    measured = cap.measured_bytes(0)
+    wbytes = sum(qlp[n].size * qlp[n].dtype.itemsize
+                 for n in _param_keys(spec))
+    est = cost.decode_block_vmem(
+        hidden=spec.hidden, num_heads=spec.num_heads,
+        kv_heads=spec.kv_heads, head_dim=spec.head_dim,
+        block_size=spec.block_size, pages=2, weight_bytes=wbytes,
+        pool_itemsize=1, x_itemsize=4, kv_quant=True)
+    assert _rel_diff(est["total"], measured) <= cost.MODEL_TOLERANCE, (
+        f"static {est} vs measured {measured}")
+    # the scale staging is real: the kv_quant estimate exceeds the
+    # same geometry priced without it at int8 pool itemsize
+    plain = cost.decode_block_vmem(
+        hidden=spec.hidden, num_heads=spec.num_heads,
+        kv_heads=spec.kv_heads, head_dim=spec.head_dim,
+        block_size=spec.block_size, pages=2, weight_bytes=wbytes,
+        pool_itemsize=1, x_itemsize=4)
+    assert est["staging"] > plain["staging"]
+
+
+def test_autotune_candidates_use_dtype_aware_model():
+    """The pages-candidate filter prices quantized weights through the
+    dtype-aware model: a llama-7B-width layer admits NO candidates at
+    bf16 but a non-empty set under int8 weight storage."""
+    from paddle_tpu.ops.decode_block import DecodeBlockSpec
+    from paddle_tpu.ops.pallas.decode_block import (VMEM_BUDGET_BYTES,
+                                                    _fitting_candidates,
+                                                    _vmem_total)
+    W = dict(hidden=896, num_heads=14, kv_heads=2, head_dim=64)
+    bf16 = DecodeBlockSpec(block_size=4, norm="rms",
+                           activation="swiglu", eps=1e-5, rope=True,
+                           **W)
+    wb_bf16 = cost.decode_block_weight_bytes(
+        ffn_hidden=2432, itemsize_=2, **W)
+    wb_int8 = cost.decode_block_weight_bytes(
+        ffn_hidden=2432, weight_dtype="int8", itemsize_=2, **W)
+    # bf16: NOTHING fits (the (1,) return is the filter's floor, and
+    # even that candidate prices over budget — dispatch falls back
+    # before the tuner ever runs it)
+    assert _fitting_candidates(bf16, 8, 2, wb_bf16, 2) == (1,)
+    assert _vmem_total(bf16, 1, wb_bf16, 2, 2) > VMEM_BUDGET_BYTES
+    int8 = DecodeBlockSpec(block_size=4, norm="rms",
+                           activation="swiglu", eps=1e-5, rope=True,
+                           weight_dtype="int8", **W)
+    cands = _fitting_candidates(int8, 8, 2, wb_int8, 2)
+    assert len(cands) >= 2, cands      # real fits, not the floor
+    assert all(_vmem_total(int8, p, wb_int8, 2, 2)
+               <= VMEM_BUDGET_BYTES for p in cands)
+
+
+# ---------------------------------------------------------------------------
 # linear_ce: static estimate vs captured kernel declaration
 # ---------------------------------------------------------------------------
 def test_linear_ce_static_estimate_matches_measured(monkeypatch):

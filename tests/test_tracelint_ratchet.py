@@ -156,6 +156,22 @@ def test_prefix_cache_has_zero_tl001_tl006():
             assert n == 0, f"baseline carries {rule} debt in {path}"
 
 
+def test_quantization_serve_has_zero_tl001_tl006():
+    """ISSUE 16 contract: the serving PTQ export path is host-side
+    numpy by design (a traced quantize would recompile every engine
+    construction — the serve_quant_warm budget row pins zero) — no
+    host-sync in traced code (TL001) and no silent broad excepts
+    (TL006; a swallowed export error would silently serve unquantized
+    or half-quantized weights) — live scan AND committed ledger."""
+    files = ("paddle_tpu/quantization/serve.py",)
+    live = [f for f in _current_findings()
+            if f.rule in ("TL001", "TL006") and f.path.endswith(files)]
+    assert live == [], [f.format() for f in live]
+    for (rule, path), n in baseline_mod.load().items():
+        if rule in ("TL001", "TL006") and path.endswith(files):
+            assert n == 0, f"baseline carries {rule} debt in {path}"
+
+
 def test_decode_block_has_zero_tl001_tl006():
     """ISSUE 9 contract: the fused decode-block op (dispatch module AND
     Pallas kernel) sits on the hottest serve path — no host-sync in

@@ -412,6 +412,65 @@ def serve_prefix_warm() -> Callable[[], None]:
     return workload
 
 
+def serve_quant_warm() -> Callable[[], None]:
+    """Quantized serving on a warm engine (ISSUE 16): int8 weight-only
+    matmuls + int8 paged-KV pool (per-token scales), warm-started from
+    an AOT artifact exported at the SAME quant config — greedy AND
+    sampled traffic, a shared-prefix cache hit, and one priority
+    preempt/restore cycle through the quantized spill format.  ZERO
+    backend compiles: dequant runs inside the exported programs and
+    every spill/restore copy is the pool-shaped op pre-warmed at
+    construction."""
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu.aot.serve import export_engine
+    from paddle_tpu.quantization import ServeQuantConfig
+
+    cfg, params, _prompts = _tiny_llama()
+    qc = ServeQuantConfig(weight_dtype="int8", kv_dtype="int8")
+
+    def build(aot_dir=None):
+        from paddle_tpu.inference.serving import ContinuousBatchingEngine
+        return ContinuousBatchingEngine(
+            cfg, params, max_batch=2, block_size=8, num_blocks=64,
+            prefill_buckets=(8,), aot_dir=aot_dir, quant_config=qc)
+
+    aot_dir = tempfile.mkdtemp(prefix="aot_budget_quant_")
+    export_engine(build(), aot_dir)
+
+    def workload():
+        eng = build(aot_dir=aot_dir)
+        rng = np.random.default_rng(7)
+        shared = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+        tail = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+        eng.add_request(np.concatenate([shared, tail]), 4)
+        eng.run_to_completion()             # registers the prefix
+        # shared-prefix hit + a sampled request, both on int8 KV pages
+        eng.add_request(np.concatenate([shared, tail[:2]]), 4)
+        eng.add_request(tail, 6, temperature=0.7, top_k=8, seed=3)
+        eng.step()
+        # one preempt/restore through the quantized (codes + scales)
+        # spill format mid-traffic
+        slot = next(s for s in range(eng.B)
+                    if eng.slots[s] is not None)
+        eng.preempt(slot)
+        eng.run_to_completion()
+        if eng.prefix_stats()["hits"] < 1:
+            raise RuntimeError("scenario never hit the prefix cache")
+        if eng.resilience["restores"] < 1:
+            raise RuntimeError("scenario never restored a preempted "
+                               "request")
+        rep = eng.kv_leak_report()
+        if rep["leaked"] or rep["unaccounted"]:
+            raise RuntimeError(f"scenario leaked KV blocks: {rep}")
+        if not eng.aot_loaded:
+            raise RuntimeError(f"warm start fell back: {eng.aot_error}")
+
+    return workload
+
+
 SCENARIOS: Dict[str, Callable[[], Callable[[], None]]] = {
     "gpt_train": gpt_train,
     "serve_fresh": serve_fresh,
@@ -422,6 +481,7 @@ SCENARIOS: Dict[str, Callable[[], Callable[[], None]]] = {
     "fleet_warm": fleet_warm,
     "serve_http_warm": serve_http_warm,
     "serve_prefix_warm": serve_prefix_warm,
+    "serve_quant_warm": serve_quant_warm,
 }
 
 
@@ -465,14 +525,19 @@ def render_md(counts: Dict[str, int]) -> str:
         "Budgets are CPU tier-1 numbers; `serve_aot_warm` is the ISSUE 6"
         " acceptance row, `serve_aot_warm_sampled` the ISSUE 7 one, "
         "`serve_spec_warm` the ISSUE 8 one, `serve_recovery_warm` the "
-        "ISSUE 11 one, `fleet_warm` the ISSUE 12 one, and "
-        "`serve_http_warm` the ISSUE 13 one: an AOT-warm engine start "
-        "must be ZERO backend compiles — greedy, sampled, speculative, "
-        "rebuilt mid-traffic by crash recovery (replay included), "
-        "serving as a fleet replica through a replica kill, "
-        "cross-replica re-placement, and a graceful drain, or serving "
-        "real sockets through the HTTP front door with a mid-stream "
-        "disconnect and a graceful shutdown.",
+        "ISSUE 11 one, `fleet_warm` the ISSUE 12 one, "
+        "`serve_http_warm` the ISSUE 13 one, `serve_prefix_warm` the "
+        "ISSUE 14 one, and `serve_quant_warm` the ISSUE 16 one: an "
+        "AOT-warm engine start must be ZERO backend compiles — greedy, "
+        "sampled, speculative, rebuilt mid-traffic by crash recovery "
+        "(replay included), serving as a fleet replica through a "
+        "replica kill, cross-replica re-placement, and a graceful "
+        "drain, serving real sockets through the HTTP front door with "
+        "a mid-stream disconnect and a graceful shutdown, serving "
+        "shared-prefix traffic through the cross-request prefix cache "
+        "with hits, an eviction-to-offload, and an offload restore, or "
+        "serving int8-quantized weights and KV pages end-to-end with a "
+        "preempt/restore through the codes+scales spill format.",
         "",
     ]
     for name, n in counts.items():
