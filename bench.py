@@ -948,6 +948,136 @@ def _train_aot_warm_extra(step_fn, state, ids, labels, ttfs_cold):
         return {"aot_error": f"{type(e).__name__}: {e}"}
 
 
+def _train_elastic_bench(devices, on_accel, rng):
+    """`--config train` (ISSUE 17): elastic-training recovery after a
+    mid-run worker kill on a dp-N mesh — time-to-resume cold (fresh
+    reshape compile + export) vs AOT-warm (per-topology artifact
+    deserialize), throughput before the kill and after the dp N→N−1
+    reshape, and the carryover accounting (steps lost/replayed)."""
+    import tempfile
+
+    import jax
+
+    n = len(jax.devices())
+    if not on_accel and n < 8:
+        # a 1-device parent can't measure an 8→7 reshape: re-exec the
+        # measurement in a forced-8-virtual-device child (the tier-1
+        # simulation mesh) and pass its row through
+        import subprocess
+        env = dict(os.environ, _BENCH_CHILD="1", BENCH_CONFIG="train",
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8 "
+                             "--xla_cpu_enable_concurrency_optimized_"
+                             "scheduler=false")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, env=env, timeout=600)
+        line = next((ln for ln in reversed(proc.stdout.splitlines())
+                     if ln.startswith("{")), None)
+        if line is None:
+            raise RuntimeError(
+                f"8-device elastic child produced no row (rc="
+                f"{proc.returncode}): {proc.stderr[-300:]}")
+        return json.loads(line)
+
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.observability import CompileMonitor
+    from paddle_tpu.parallel import ElasticTrainer, WorkerLostError
+    from paddle_tpu.parallel.topology import HybridTopology, set_topology
+
+    dp = min(8, n)
+    batch = dp * (dp - 1)          # divisible by dp AND dp-1 (8→7: 56)
+    feat, hidden, classes = 64, 128, 10
+
+    def data_fn(step):
+        r = np.random.default_rng(1000 + step)
+        return (r.standard_normal((batch, feat)).astype("float32"),
+                r.integers(0, classes, (batch,)).astype("int64"))
+
+    def make_trainer(aot_dir):
+        topo = HybridTopology(dp=dp, devices=jax.devices()[:dp])
+        set_topology(topo)
+        pt.seed(11)
+        net = nn.Sequential(nn.Linear(feat, hidden), nn.ReLU(),
+                            nn.Linear(hidden, classes))
+        opt = pt.optimizer.Adam(parameters=net.parameters(),
+                                learning_rate=1e-2)
+        return ElasticTrainer(net, opt, nn.CrossEntropyLoss(), data_fn,
+                              topology=topo, sharding_stage=2,
+                              rng_seed=7, aot_dir=aot_dir)
+
+    def arm_kill(tr):
+        eng, real = tr.engine, tr.engine.train_batch
+        at = eng._step_count
+
+        def patched(inputs, labels=None, rng=None):
+            if eng._step_count == at:
+                eng.train_batch = real
+                raise WorkerLostError("bench kill", lost_index=dp - 1,
+                                      axis="dp")
+            return real(inputs, labels, rng=rng)
+
+        eng.train_batch = patched
+
+    def rate(tr, steps=3):
+        t0 = time.perf_counter()
+        tr.run(steps)
+        return steps * batch / (time.perf_counter() - t0)
+
+    aot_dir = tempfile.mkdtemp(prefix="bench_elastic_")
+    try:
+        # phase 1 — COLD: empty store, so the post-kill reshape pays
+        # the fresh compile (+ export, which seeds phase 2's warm path)
+        tr = make_trainer(aot_dir)
+        tr.run(2)
+        before = rate(tr)
+        arm_kill(tr)
+        tr.step()                    # kill → reshape → re-run the step
+        recovery_cold = tr.last_recovery_s
+        after = rate(tr)
+        steps_lost = tr.steps_replayed
+        carry = tr.steps_replayed == 0
+
+        # phase 2 — AOT-WARM: both meshes' entries exist; the resume
+        # and the reshape must be pure deserializes (zero compiles)
+        tr2 = make_trainer(aot_dir)
+        with CompileMonitor() as mon:
+            tr2.run(2)
+            arm_kill(tr2)
+            tr2.step()
+        recovery_warm = tr2.last_recovery_s
+        warm_compiles = mon.n_compiles
+    finally:
+        set_topology(HybridTopology())
+
+    return {
+        "metric": "elastic_train_samples_per_sec",
+        "value": round(after, 1),
+        "unit": "samples/s", "vs_baseline": 0.0,
+        "extra": {
+            "device": str(devices[0]), "batch": batch,
+            "mesh": f"dp{dp}->dp{dict(tr.topo.degrees)['dp']}",
+            "elastic": {
+                "samples_per_s_before_kill": round(before, 1),
+                "samples_per_s_after_reshape": round(after, 1),
+                "recovery_time_to_resume_s_cold": round(recovery_cold, 3),
+                "recovery_time_to_resume_s_aot_warm":
+                    round(recovery_warm, 3),
+                "warm_backend_compiles": warm_compiles,
+                "steps_lost": steps_lost,
+                "carryover": carry,
+                "note": "virtual XLA host devices share ONE CPU core: "
+                        "the per-step rates measure framework+XLA "
+                        "overhead (a smaller mesh can even be faster), "
+                        "not chip throughput; the accelerator-facing "
+                        "numbers are the cold-vs-warm recovery gap "
+                        "(compile vs deserialize) and "
+                        "warm_backend_compiles=0",
+            }}}
+
+
 def run_config_bench(config: str):
     """BASELINE configs 1/2/3/5 (VERDICT r3 item 5): every BASELINE.md row
     gets a measured number — full shapes on the accelerator, scaled-down
@@ -1417,6 +1547,8 @@ def run_config_bench(config: str):
                               "program (speedup ~1.0 expected); the "
                               "hbm model is the accelerator-facing win"},
         }
+    elif config == "train":
+        out = _train_elastic_bench(devices, on_accel, rng)
     else:
         raise SystemExit(f"unknown --config {config!r}")
     if err_note:
@@ -1708,6 +1840,7 @@ def _exit_by_row(d) -> None:
 
 if __name__ == "__main__":
     # --config lenet|resnet50|bert|llama|moe|serve|decode|optimizer|loss
+    #          |train
     # selects a BASELINE row / subsystem benchmark; no flag = the
     # flagship GPT metric (driver contract: ONE JSON line).
     if "--config" in sys.argv:

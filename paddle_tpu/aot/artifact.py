@@ -268,6 +268,15 @@ class ArtifactStore:
         }
         return self
 
+    def extend(self) -> "ArtifactStore":
+        """Reopen this store's ON-DISK manifest for appending — the
+        per-topology elastic exports grow one store incrementally (a
+        reshape adds the new mesh's programs next to the old ones)
+        instead of `begin()`-resetting it."""
+        if self._manifest is None:
+            self._manifest = self.manifest()
+        return self
+
     def put(self, name: str, compiled, example_args: Tuple, *,
             donate_argnums: Tuple[int, ...] = ()) -> None:
         """Serialize one compiled executable (``jax.jit(f).lower(*args)

@@ -33,7 +33,8 @@ from .artifact import (ArtifactStore, _sig_matches, args_signature,
                        donation_deserialize_safe, fresh_backend_compile)
 
 __all__ = ["export_train_step", "load_train_step", "AotTrainStep",
-           "export_jit_apply"]
+           "export_jit_apply", "engine_topology_key", "export_engine_step",
+           "load_engine_step", "AotEngineStep"]
 
 _INIT = "train_step_init"
 _STEADY = "train_step"
@@ -163,6 +164,121 @@ def load_train_step(model, directory: str, *, registry=None
                           registry=registry)
     store.check_env()
     return AotTrainStep(model, store)
+
+
+# ----------------------------------------------------------------------
+# per-topology DistributedEngine steps (ISSUE 17 elastic training)
+# ----------------------------------------------------------------------
+_ENGINE_PREFIX = "engine_step@"
+
+
+def engine_topology_key(topo) -> str:
+    """Stable artifact-entry key for a mesh, e.g.
+    ``pp1-dp4-sharding1-sep1-mp1@d0.1.2.3`` — one AOT store holds one
+    entry per mesh the elastic trainer has ever run at, so a resume at
+    ANY previously-seen mesh is a pure deserialize.  The key includes
+    the device ids, not just the axis degrees: a serialized executable
+    bakes in its device assignment, and a dp3 mesh over survivors
+    {0,1,3} cannot serve a dp3 mesh over {0,1,2}."""
+    from ..parallel.topology import AXIS_ORDER
+    degrees = "-".join(f"{a}{topo.axis_size(a)}" for a in AXIS_ORDER)
+    devs = ".".join(str(d.id) for d in topo.mesh.devices.flat)
+    return f"{degrees}@d{devs}"
+
+
+def engine_config(engine) -> Dict[str, Any]:
+    """Store-level config for an engine-step artifact store.  Deliberately
+    topology-free: topologies live in the per-entry names, so a reshape
+    EXTENDS the store instead of invalidating it."""
+    return {
+        "kind": "engine_train_step",
+        "network": type(engine.network).__name__,
+        "optimizer": type(engine.optimizer).__name__,
+        "loss": (type(engine.loss_fn).__name__
+                 if engine.loss_fn is not None else None),
+        "sharding_stage": engine.sharding_stage,
+        "amp": engine.amp_dtype,
+        "skip_nonfinite": bool(engine.skip_nonfinite),
+    }
+
+
+def _engine_example_args(engine, inputs, labels) -> Tuple:
+    """The exact ``DistributedEngine._step_fn`` call signature for one
+    example batch (state must already be sharded)."""
+    params, buffers, opt_state = engine._state
+    inputs_p, labels_p = engine.place_batch(inputs, labels)
+    lr = engine.optimizer.get_lr()
+    return (params, buffers, opt_state, engine._step_count + 1, lr,
+            _example_rng(), inputs_p, labels_p)
+
+
+def export_engine_step(engine, inputs, labels, directory: str, *,
+                       donate: Optional[bool] = None,
+                       registry=None):
+    """Compile + serialize ``engine``'s SPMD train step under its
+    topology's entry name.  An existing store is EXTENDED (other
+    topologies' entries are kept), so the elastic trainer accumulates
+    one entry per mesh it reshapes through.  Returns ``(store,
+    compiled)`` — the freshly compiled executable is handed back so the
+    caller can install it directly and the export costs no second
+    compile."""
+    if donate is None:
+        donate = donation_deserialize_safe()
+    if engine._state is None:
+        engine.shard_state()
+    jitted = engine.build_train_step(donate=donate)
+    args = _engine_example_args(engine, inputs, labels)
+    store = ArtifactStore(directory, registry=registry)
+    if store.exists():
+        store.extend()
+    else:
+        store.begin(config=engine_config(engine))
+    name = _ENGINE_PREFIX + engine_topology_key(engine.topo)
+    with fresh_backend_compile():
+        compiled = jitted.lower(*args).compile()
+    store.put(name, compiled, args,
+              donate_argnums=(0, 1, 2) if donate else ())
+    return store, compiled
+
+
+class AotEngineStep:
+    """Drop-in for ``DistributedEngine._step_fn``: runs the deserialized
+    executable while the call signature matches the recorded one,
+    fresh-jitting (once, with a telemetry event) on divergence — e.g. a
+    batch-shape change the artifacts don't cover."""
+
+    def __init__(self, engine, store: ArtifactStore, sig, fn):
+        self._engine = engine
+        self._store = store
+        self._sig = sig
+        self._fn = fn
+        self._fresh = None
+
+    def __call__(self, *args):
+        if self._fresh is None and _sig_matches(self._sig, args):
+            return self._fn(*args)
+        if self._fresh is None:
+            self._store._event("signature_fallback", name="engine_step")
+            # build_train_step re-points engine._step_fn at the fresh
+            # jit, so later train_batch calls skip this dispatch
+            self._fresh = self._engine.build_train_step()
+        return self._fresh(*args)
+
+
+def load_engine_step(engine, directory: str, *, registry=None
+                     ) -> AotEngineStep:
+    """Verify + deserialize the engine-step entry matching ``engine``'s
+    CURRENT topology.  Raises an AotError subclass when the store, this
+    environment, or this topology's entry is unusable — callers fall
+    back to a fresh jit (one bounded compile)."""
+    from .artifact import resolve_artifact_dir
+    store = ArtifactStore(resolve_artifact_dir(directory),
+                          registry=registry)
+    store.check_env()
+    store.check_config(engine_config(engine))
+    name = _ENGINE_PREFIX + engine_topology_key(engine.topo)
+    entry = store.entry(name)
+    return AotEngineStep(engine, store, entry["in_sig"], store.get(name))
 
 
 def export_jit_apply(opt, params, grads, state, directory: str, *,

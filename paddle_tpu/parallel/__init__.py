@@ -38,4 +38,10 @@ from .sharding import ShardingStage, group_sharded_parallel  # noqa: F401
 from .topology import HybridTopology, get_topology, init_topology, set_topology  # noqa: F401
 from .pipeline import LayerDesc, PipelineLayer, SharedLayerDesc, spmd_pipeline  # noqa: F401
 from . import checkpoint  # noqa: F401
-from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    TopologyMismatchError, load_state_dict, save_state_dict,
+)
+from . import elastic  # noqa: F401
+from .elastic import (  # noqa: F401
+    CollectiveTimeoutError, ElasticPolicy, ElasticTrainer, WorkerLostError,
+)

@@ -9,12 +9,23 @@ GSPMD sharding is the "dist_attr"), written per-process as .npz; load
 assembles each *target* shard from whichever saved chunks overlap it, so
 any source topology loads onto any destination topology (dp8 -> mp2pp2
 etc.).  Works single-process (full arrays) as the degenerate case.
+
+Hardened (ISSUE 17): every file lands via the ``framework.io`` atomic-save
+convention (same-dir temp + fsync + rename — a crash mid-save never leaves
+a torn shard at the destination), every chunk carries a CRC32 verified on
+read, assembly REFUSES partially-covered targets (a missing shard raises
+``CheckpointCorruptError``, never zero-fills), and a checkpoint saved with
+``topology=`` records a mesh manifest: loading it under a different
+topology requires ``reshape=True`` or raises :class:`TopologyMismatchError`
+— a silent wrong-topology scatter is the SDC of checkpointing.
 """
 
 from __future__ import annotations
 
+import io as _io
 import json
 import os
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -22,9 +33,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..framework.io import CheckpointCorruptError, atomic_write_bytes
 
-__all__ = ["save_state_dict", "load_state_dict",
-           "clear_async_save_task_queue"]
+__all__ = ["save_state_dict", "load_state_dict", "read_topology_manifest",
+           "TopologyMismatchError", "clear_async_save_task_queue"]
+
+
+class TopologyMismatchError(RuntimeError):
+    """A sharded checkpoint recorded a mesh-topology manifest that does
+    not match the topology it is being loaded under, and the caller did
+    not opt into an explicit reshape (``load_state_dict(...,
+    reshape=True)``)."""
 
 # -- async save (reference distributed/checkpoint/save_state_dict.py
 #    async_save=True + async_save_queue / clear_async_save_task_queue) ----
@@ -125,11 +144,16 @@ def _index_to_offsets(index: Tuple[slice, ...], shape) -> List[List[int]]:
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
-                    async_save: bool = False) -> None:
+                    async_save: bool = False, topology=None) -> None:
     """Write each value's addressable shards + global metadata under
     ``path``.  Multi-process: every process writes its own shard file and
     its own metadata slice; process 0's metadata merge happens at load time
     (all metadata_*.json files are read).
+
+    ``topology=`` (a :class:`~.topology.HybridTopology`) stamps a mesh
+    manifest into the metadata; a later :func:`load_state_dict` under a
+    DIFFERENT topology then demands an explicit ``reshape=True`` instead
+    of silently resharding (ISSUE 17 elastic-training contract).
 
     ``async_save=True`` (reference async checkpoint): device->host shard
     copies happen NOW (so training can mutate the arrays immediately),
@@ -137,6 +161,9 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     ``clear_async_save_task_queue()`` joins all pending writes."""
     rank = getattr(jax, "process_index", lambda: 0)()
     meta, arrays = _snapshot(_flatten(state_dict), rank)
+    if topology is not None:
+        meta["topology"] = {"degrees": dict(topology.degrees),
+                            "world_size": int(topology.world_size)}
     if async_save:
         _drain_done()
         _join_same_path(path)
@@ -172,10 +199,12 @@ def _snapshot(flat: Dict[str, Any], rank: int):
             seen.add(hkey)
             chunk_id = len(meta["chunks"])
             name = f"c{chunk_id}"
-            arrays[name] = np.asarray(shard.data)
+            host = np.asarray(shard.data)
+            arrays[name] = host
             meta["chunks"].append({
                 "key": key, "npz": f"shard_rank{rank}.npz",
                 "name": name, "offsets": offs,
+                "crc32": zlib.crc32(np.ascontiguousarray(host).tobytes()),
             })
     return meta, arrays
 
@@ -183,32 +212,70 @@ def _snapshot(flat: Dict[str, Any], rank: int):
 def _write_snapshot(path: str, rank: int, meta, arrays,
                     coordinator_rank: int) -> None:
     os.makedirs(path, exist_ok=True)
-    np.savez(os.path.join(path, f"shard_rank{rank}.npz"), **arrays)
-    with open(os.path.join(path, f"metadata_rank{rank}.json"), "w") as f:
-        json.dump(meta, f)
+    # atomic-save convention (framework.io): build in memory, land via
+    # same-dir temp + fsync + rename — a kill at any byte leaves either
+    # the old shard or no shard, never a torn one
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    atomic_write_bytes(buf.getvalue(),
+                       os.path.join(path, f"shard_rank{rank}.npz"))
+    atomic_write_bytes(json.dumps(meta).encode(),
+                       os.path.join(path, f"metadata_rank{rank}.json"))
     if rank == coordinator_rank:
         # single merged view for tooling; load() reads the per-rank files
-        with open(os.path.join(path, _META), "w") as f:
-            json.dump({"format": "paddle_tpu.dist_checkpoint.v1"}, f)
+        atomic_write_bytes(
+            json.dumps({"format": "paddle_tpu.dist_checkpoint.v1"}).encode(),
+            os.path.join(path, _META))
 
 
-def _read_all_meta(path: str) -> Tuple[Dict, List[Dict]]:
-    arrays, chunks = {}, []
+def _read_all_meta(path: str) -> Tuple[Dict, List[Dict], Optional[Dict]]:
+    arrays, chunks, topo = {}, [], None
     for fn in sorted(os.listdir(path)):
         if fn.startswith("metadata_rank") and fn.endswith(".json"):
             with open(os.path.join(path, fn)) as f:
                 m = json.load(f)
             arrays.update(m["arrays"])
             chunks.extend(m["chunks"])
+            topo = m.get("topology", topo)
     if not arrays:
         raise FileNotFoundError(f"no checkpoint metadata under {path!r}")
-    return arrays, chunks
+    return arrays, chunks, topo
 
 
-def _assemble(target_shape, target_off, chunks, loaders) -> np.ndarray:
+def read_topology_manifest(path: str) -> Optional[Dict]:
+    """The mesh-topology manifest a checkpoint was saved under (``None``
+    for legacy/manifest-free checkpoints)."""
+    return _read_all_meta(path)[2]
+
+
+def _chunk_data(ch: Dict, loaders) -> np.ndarray:
+    """One saved chunk's host array, CRC-verified when the chunk carries
+    a checksum (legacy chunks without one load unverified)."""
+    try:
+        data = loaders[ch["npz"]][ch["name"]]
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"checkpoint chunk {ch['name']!r} of {ch['key']!r} unreadable "
+            f"from {ch['npz']}: {type(e).__name__}: {e}") from e
+    if "crc32" in ch:
+        got = zlib.crc32(np.ascontiguousarray(data).tobytes())
+        if got != ch["crc32"]:
+            raise CheckpointCorruptError(
+                f"checkpoint chunk {ch['name']!r} of {ch['key']!r} failed "
+                f"CRC32 (stored {ch['crc32']}, read {got}) — bit-rot or a "
+                "torn write; restore from an older checkpoint")
+    return data
+
+
+def _assemble(target_shape, target_off, chunks, loaders,
+              key: str = "?") -> np.ndarray:
     """Fill a buffer of target_shape located at target_off (per-dim
-    [start,stop]) from overlapping saved chunks."""
+    [start,stop]) from overlapping saved chunks.  Every cell of the
+    target must be covered by some chunk — a partially-covered target
+    (missing shard file / truncated metadata) raises instead of silently
+    zero-filling."""
     buf = None
+    covered = None
     for ch in chunks:
         offs = ch["offsets"]
         inter = []
@@ -221,30 +288,64 @@ def _assemble(target_shape, target_off, chunks, loaders) -> np.ndarray:
             inter.append((s, e))
         if not ok:
             continue
-        data = loaders[ch["npz"]][ch["name"]]
+        data = _chunk_data(ch, loaders)
         if buf is None:
             dt = data.dtype
             buf = np.zeros([te - ts for ts, te in target_off], dt)
+            covered = np.zeros(buf.shape, dtype=bool)
         src = tuple(slice(s - cs, e - cs) for (s, e), (cs, ce)
                     in zip(inter, offs))
         dst = tuple(slice(s - ts, e - ts) for (s, e), (ts, te)
                     in zip(inter, target_off))
         buf[dst] = data[src]
+        covered[dst] = True
     if buf is None:
-        raise ValueError("no saved chunk overlaps the requested shard")
+        raise CheckpointCorruptError(
+            f"no saved chunk overlaps the requested shard of {key!r}")
+    if not covered.all():
+        missing = int(covered.size - covered.sum())
+        raise CheckpointCorruptError(
+            f"checkpoint shard of {key!r} only partially covered by saved "
+            f"chunks ({missing}/{covered.size} cells missing) — a shard "
+            "file is absent or its metadata was truncated")
     return buf
 
 
 def load_state_dict(state_dict: Dict[str, Any], path: str,
-                    process_group=None) -> None:
+                    process_group=None, *, reshape: bool = False,
+                    topology=None) -> None:
     """In-place load: every Tensor/array in ``state_dict`` is filled from
-    the checkpoint, resharded to its CURRENT sharding."""
-    saved_arrays, chunks = _read_all_meta(path)
+    the checkpoint, resharded to its CURRENT sharding.
+
+    When the checkpoint carries a topology manifest (saved with
+    ``topology=``) and the loading topology differs, the reshard must be
+    requested EXPLICITLY with ``reshape=True`` — otherwise a typed
+    :class:`TopologyMismatchError` is raised.  ``topology`` defaults to
+    the process-global topology."""
+    saved_arrays, chunks, saved_topo = _read_all_meta(path)
+    if saved_topo is not None and not reshape:
+        from .topology import get_topology
+        topo = topology if topology is not None else get_topology()
+        here = {"degrees": {k: int(v) for k, v in topo.degrees.items()},
+                "world_size": int(topo.world_size)}
+        saved = {"degrees": {k: int(v)
+                             for k, v in saved_topo["degrees"].items()},
+                 "world_size": int(saved_topo["world_size"])}
+        if here != saved:
+            raise TopologyMismatchError(
+                f"checkpoint {path!r} was saved under topology "
+                f"{saved} but is being loaded under {here}; pass "
+                "reshape=True to reshard explicitly")
     by_key: Dict[str, List[Dict]] = {}
     for ch in chunks:
         by_key.setdefault(ch["key"], []).append(ch)
-    loaders = {fn: np.load(os.path.join(path, fn))
-               for fn in {c["npz"] for c in chunks}}
+    try:
+        loaders = {fn: np.load(os.path.join(path, fn))
+                   for fn in {c["npz"] for c in chunks}}
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"checkpoint shard file unreadable under {path!r}: "
+            f"{type(e).__name__}: {e}") from e
 
     flat = _flatten(state_dict)
     for key, val in flat.items():
@@ -260,13 +361,13 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
             for d in sharding.addressable_devices:
                 idx = sharding.addressable_devices_indices_map(gshape)[d]
                 offs = _index_to_offsets(idx, gshape)
-                local = _assemble(gshape, offs, by_key[key], loaders)
+                local = _assemble(gshape, offs, by_key[key], loaders, key)
                 pieces.append(jax.device_put(local, d))
             new = jax.make_array_from_single_device_arrays(
                 gshape, sharding, pieces)
         else:
-            full = _assemble(gshape,
-                             [[0, s] for s in gshape], by_key[key], loaders)
+            full = _assemble(gshape, [[0, s] for s in gshape],
+                             by_key[key], loaders, key)
             new = jnp.asarray(full)
             if isinstance(v, jax.Array):
                 new = jax.device_put(new, v.sharding)

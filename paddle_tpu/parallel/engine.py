@@ -45,7 +45,8 @@ class DistributedEngine:
                  topology: Optional[HybridTopology] = None,
                  sharding_stage: int = 0,
                  recompute: bool = False,
-                 amp_dtype: Optional[str] = None):
+                 amp_dtype: Optional[str] = None,
+                 skip_nonfinite: bool = False):
         self.network = network
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -53,6 +54,9 @@ class DistributedEngine:
         self.sharding_stage = sharding_stage
         self.recompute = recompute
         self.amp_dtype = amp_dtype
+        self.skip_nonfinite = skip_nonfinite
+        self.grad_hook: Optional[Callable] = None
+        self.last_skipped = False
         self._step_fn = None
         self._eval_fn = None
         self._state = None          # (params, buffers, opt_state)
@@ -130,12 +134,14 @@ class DistributedEngine:
                 if self.topo.axis_size(a) > 1]
         return P(tuple(axes) if len(axes) > 1 else axes[0]) if axes else P()
 
-    def build_train_step(self):
+    def build_train_step(self, donate: bool = True):
         net = self.network
         opt = self.optimizer
         loss_fn = self.loss_fn
         trainable_names = self._trainable
         amp_dtype = self.amp_dtype
+        skip_nonfinite = self.skip_nonfinite
+        grad_hook = self.grad_hook
 
         buffer_names = {n for n, b in net.named_buffers() if b is not None}
 
@@ -173,11 +179,23 @@ class DistributedEngine:
                                    if self.recompute else compute_loss)
             (loss_v, new_buffers), grads = jax.value_and_grad(
                 loss_fn_maybe_remat, has_aux=True)(train_params)
+            if grad_hook is not None:
+                # chaos seam: a traced grads->grads transform (e.g. the SDC
+                # bit-flip injector), gated on step_no so it never retraces
+                grads = grad_hook(grads, step_no)
             new_train, new_opt = opt.apply_gradients(
                 train_params, grads, opt_state, lr, step_no)
             new_params = dict(params)
             new_params.update(new_train)
             kept = {n: new_buffers.get(n, v) for n, v in buffers.items()}
+            if skip_nonfinite:
+                from ..checkpoint.step_guard import (guard_select,
+                                                     nonfinite_guard)
+                ok = nonfinite_guard(loss_v, grads)
+                new_params = guard_select(ok, new_params, dict(params))
+                kept = guard_select(ok, kept, dict(buffers))
+                new_opt = guard_select(ok, new_opt, opt_state)
+                return new_params, kept, new_opt, loss_v, ok
             return new_params, kept, new_opt, loss_v
 
         named_params = dict(self.network.named_parameters())
@@ -191,22 +209,22 @@ class DistributedEngine:
 
         # data args take their sharding from device_put in train_batch (the
         # arity of inputs/labels varies per model, so no fixed specs here)
+        out_sh = (param_sh, buffer_sh, opt_sh, repl)
+        if skip_nonfinite:
+            out_sh = out_sh + (repl,)
         self._step_fn = jax.jit(
             step,
-            donate_argnums=(0, 1, 2),
+            donate_argnums=(0, 1, 2) if donate else (),
             in_shardings=(param_sh, buffer_sh, opt_sh, None, None, None,
                           None, None),
-            out_shardings=(param_sh, buffer_sh, opt_sh, repl),
+            out_shardings=out_sh,
         )
         return self._step_fn
 
-    # ------------------------------------------------------------------
-    def train_batch(self, inputs, labels=None):
-        if self._state is None:
-            self.shard_state()
-        if self._step_fn is None:
-            self.build_train_step()
-        params, buffers, opt_state = self._state
+    def place_batch(self, inputs, labels=None):
+        """Stage one batch onto the mesh per the data spec — the same
+        placement ``train_batch`` performs, exposed so AOT exporters can
+        build the exact call signature."""
         data_sh = self._sharding(self._data_spec())
         inputs = [jax.device_put(
             v._value if isinstance(v, Tensor) else jnp.asarray(v), data_sh)
@@ -216,11 +234,32 @@ class DistributedEngine:
             v._value if isinstance(v, Tensor) else jnp.asarray(v), data_sh)
             for v in (labels if isinstance(labels, (list, tuple))
                       else ([labels] if labels is not None else []))]
+        return inputs, labels
+
+    # ------------------------------------------------------------------
+    def train_batch(self, inputs, labels=None, rng=None):
+        if self._state is None:
+            self.shard_state()
+        if self._step_fn is None:
+            self.build_train_step()
+        params, buffers, opt_state = self._state
+        inputs, labels = self.place_batch(inputs, labels)
         lr = self.optimizer.get_lr()
-        rng = next_rng_key()
-        params, buffers, opt_state, loss = self._step_fn(
+        if rng is None:
+            # default: the global stream.  Elastic/replay callers pass an
+            # explicit per-step key (fold_in of the run key and the global
+            # step) so a resumed trajectory is bit-identical regardless of
+            # how many keys were drawn before the restart.
+            rng = next_rng_key()
+        out = self._step_fn(
             params, buffers, opt_state, self._step_count + 1, lr, rng,
             inputs, labels)
+        if self.skip_nonfinite:
+            params, buffers, opt_state, loss, ok = out
+            self.last_skipped = not bool(np.asarray(jax.device_get(ok)))
+        else:
+            params, buffers, opt_state, loss = out
+            self.last_skipped = False
         self._state = (params, buffers, opt_state)
         self._step_count += 1
         self.optimizer._scheduler_step()
@@ -242,3 +281,64 @@ class DistributedEngine:
     def state_dict(self):
         self.sync_state_to_layer()
         return self.network.state_dict()
+
+    # ------------------------------------------------------------------
+    # elastic state carryover (parallel/elastic.py)
+    # ------------------------------------------------------------------
+    def host_state(self):
+        """Gather the full (unsharded) training state to host numpy.
+
+        Single-process meshes keep every shard addressable, so a plain
+        ``device_get`` of the global array reassembles it; the result is
+        topology-free and can be re-staged onto ANY mesh by
+        :meth:`load_host_state` — the gather-and-repartition half of the
+        elastic reshape (ZeRO os_g state is reconstructible from the
+        survivors whenever the arrays are still replicated across some
+        other axis, which a host-local gather subsumes)."""
+        if self._state is None:
+            self.shard_state()
+        params, buffers, opt_state = self._state
+
+        def _np(tree):
+            return jax.tree_util.tree_map(
+                lambda v: np.asarray(jax.device_get(v)), tree)
+
+        return {
+            "params": _np(params),
+            "buffers": _np(buffers),
+            "opt_state": _np(opt_state) if opt_state is not None else None,
+            "step_count": self._step_count,
+        }
+
+    def load_host_state(self, host_state):
+        """Re-stage a :meth:`host_state` snapshot onto THIS engine's mesh.
+
+        The repartition half of the elastic reshape: specs are re-derived
+        for the current topology and every leaf is ``device_put`` per its
+        new spec.  Unlike :meth:`shard_state` this injects the carried
+        optimizer slots instead of calling ``optimizer.init_state`` (fresh
+        moments would silently reset Adam)."""
+        if not self.param_specs:
+            self._derive_specs()
+        params = {n: jax.device_put(v, self._sharding(self.param_specs[n]))
+                  for n, v in host_state["params"].items()}
+        buffers = {n: jax.device_put(v, self._sharding(P()))
+                   for n, v in host_state["buffers"].items()}
+        for n, p in self.network.named_parameters():
+            if n in params:
+                p._value = params[n]
+        for n, b in self.network.named_buffers():
+            if b is not None and n in buffers:
+                b._value = buffers[n]
+        opt_state = None
+        if host_state.get("opt_state") is not None:
+            specs = self._opt_state_specs(host_state["opt_state"])
+            opt_state = {
+                pname: {sname: jax.device_put(
+                    v, self._sharding(specs[pname][sname]))
+                    for sname, v in slots.items()}
+                for pname, slots in host_state["opt_state"].items()}
+            self.opt_specs = specs
+        self._state = (params, buffers, opt_state)
+        self._step_count = int(host_state.get("step_count", 0))
+        return self._state

@@ -67,6 +67,23 @@ cannot:
   little or nothing and vanish; the server must shed them without
   leaking threads or submitting anything.
 
+Training side (ISSUE 17, ``parallel/elastic.py``) — chaos injectors for
+the elastic trainer, all deterministic in the trainer's global step:
+
+* :func:`kill_worker_at_step` — a typed ``WorkerLostError`` (with the
+  lost device's flat mesh index) when the trainer reaches step N: the
+  reshape-with-carryover / restore-and-replay path must fire.
+* :func:`slow_worker` — adds host latency to the next ``n`` steps
+  (drives the straggler DEGRADED state and, past the step deadline,
+  the deadline-strike escalation).
+* :func:`transient_collective_failure` — ``CollectiveTimeoutError``
+  for the first ``failures`` attempts of step N; the bounded-backoff
+  retry path must absorb them without advancing state.
+* :func:`flip_gradient_bits` — silent data corruption: at step N one
+  gradient element's exponent field is forced to all-ones INSIDE the
+  traced step (worst-case SDC); the StepGuard composition must skip
+  the update, not commit it.
+
 The serve exceptions are ordinary ``Exception`` subclasses (unlike
 :class:`SimulatedCrash`): a supervisor is SUPPOSED to catch and recover
 from them, while the checkpoint kill must never be swallowed.
@@ -85,10 +102,13 @@ __all__ = ["InjectedEngineCrash", "SimulatedCrash",
            "corrupt_offloaded_prefix", "crash_mid_prefill",
            "crash_mid_speculation",
            "crash_mid_write", "exhaust_kv_pool", "fail_replace",
-           "fail_step_n", "http_disconnect_mid_stream",
+           "fail_step_n", "flip_gradient_bits",
+           "http_disconnect_mid_stream",
            "http_partial_line_writes", "http_stalled_reader",
-           "kill_replica_after_steps", "persistent_replica_crash",
-           "slow_steps", "transient_step_faults", "truncate_file"]
+           "kill_replica_after_steps", "kill_worker_at_step",
+           "persistent_replica_crash", "slow_steps", "slow_worker",
+           "transient_collective_failure", "transient_step_faults",
+           "truncate_file"]
 
 
 class SimulatedCrash(BaseException):
@@ -517,3 +537,134 @@ def crash_mid_speculation(engine, *, exc_type=InjectedEngineCrash,
     finally:
         if getattr(runner, "run_decode", None) is patched:
             runner.run_decode = real
+
+
+# ---------------------------------------------------------------------
+# training chaos injectors (ISSUE 17, parallel/elastic.py)
+# ---------------------------------------------------------------------
+@contextlib.contextmanager
+def kill_worker_at_step(trainer, step: int, *, lost_index: int = 0,
+                        axis: str = "dp", once: bool = True):
+    """Raise a typed ``WorkerLostError`` (flat mesh index
+    ``lost_index`` on ``axis``) when the trainer dispatches the step
+    whose global index is ``step`` — before any state commits, so the
+    reshaped mesh re-executes the identical step.  The patch rides the
+    CURRENT engine instance; the post-reshape engine is a new object
+    and comes up clean (exactly one worker dies)."""
+    from paddle_tpu.parallel.elastic import WorkerLostError
+    eng = trainer.engine
+    real = eng.train_batch
+    stats = {"fired": 0}
+
+    def patched(inputs, labels=None, rng=None):
+        if eng._step_count == step and (not once or stats["fired"] == 0):
+            stats["fired"] += 1
+            raise WorkerLostError(
+                f"injected device loss at step {step}",
+                lost_index=lost_index, axis=axis)
+        return real(inputs, labels, rng=rng)
+
+    eng.train_batch = patched
+    try:
+        yield stats
+    finally:
+        if getattr(eng, "train_batch", None) is patched:
+            eng.train_batch = real
+
+
+@contextlib.contextmanager
+def slow_worker(trainer, extra_s: float, n: int = 1):
+    """Add ``extra_s`` of host latency to the next ``n`` training steps
+    (a swapping host / thermally-throttled chip): the straggler window
+    must flag DEGRADED, and past the step deadline the strike counter
+    escalates to a declared loss."""
+    eng = trainer.engine
+    real = eng.train_batch
+    stats = {"slowed": 0}
+
+    def patched(inputs, labels=None, rng=None):
+        if stats["slowed"] < n:
+            stats["slowed"] += 1
+            time.sleep(extra_s)
+        return real(inputs, labels, rng=rng)
+
+    eng.train_batch = patched
+    try:
+        yield stats
+    finally:
+        if getattr(eng, "train_batch", None) is patched:
+            eng.train_batch = real
+
+
+@contextlib.contextmanager
+def transient_collective_failure(trainer, step: int, *, failures: int = 1,
+                                 lost_index=None, axis: str = "dp"):
+    """``CollectiveTimeoutError`` for the first ``failures`` attempts of
+    global step ``step``, then the real step runs: the bounded-backoff
+    retry path must absorb the fault without advancing any state and
+    without a reshape."""
+    from paddle_tpu.parallel.elastic import CollectiveTimeoutError
+    eng = trainer.engine
+    real = eng.train_batch
+    stats = {"raised": 0}
+
+    def patched(inputs, labels=None, rng=None):
+        if eng._step_count == step and stats["raised"] < failures:
+            stats["raised"] += 1
+            raise CollectiveTimeoutError(
+                f"injected collective timeout "
+                f"{stats['raised']}/{failures} at step {step}",
+                lost_index=lost_index, axis=axis)
+        return real(inputs, labels, rng=rng)
+
+    eng.train_batch = patched
+    try:
+        yield stats
+    finally:
+        if getattr(eng, "train_batch", None) is patched:
+            eng.train_batch = real
+
+
+@contextlib.contextmanager
+def flip_gradient_bits(trainer, step: int):
+    """Silent data corruption INSIDE the traced step: at global step
+    ``step`` the first gradient leaf's element [0,...] has its fp32
+    exponent field forced to all-ones (→ ±inf/NaN — the worst-case
+    undetected bit-flip).  Gated on the traced ``step_no`` operand, so
+    the injection costs zero recompiles; the engine's in-graph
+    StepGuard must where-select the poisoned update away and report
+    ``last_skipped``.  The step program is rebuilt on entry AND exit so
+    no artifact or live executable retains the poison; the trainer's
+    AOT warm path is suspended for the duration (a loaded artifact has
+    no hook woven in — and a poisoned program must never be exported)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    eng = trainer.engine
+    aot_dir = trainer.aot_dir
+    trainer.aot_dir = None
+    stats = {"step": step}
+
+    def hook(grads, step_no):
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        x = leaves[0].astype(jnp.float32)
+        idx = (0,) * x.ndim
+        bits = lax.bitcast_convert_type(x, jnp.uint32)
+        poisoned_bits = bits.at[idx].set(
+            bits[idx] | jnp.uint32(0x7F800000))
+        poisoned = lax.bitcast_convert_type(
+            poisoned_bits, jnp.float32).astype(leaves[0].dtype)
+        leaves = [jnp.where(step_no == step + 1, poisoned, leaves[0])
+                  ] + leaves[1:]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    eng.grad_hook = hook
+    eng._step_fn = None     # retrace with the hook woven in
+    try:
+        yield stats
+    finally:
+        trainer.aot_dir = aot_dir
+        if trainer.engine is eng and eng.grad_hook is hook:
+            eng.grad_hook = None
+            eng._step_fn = None

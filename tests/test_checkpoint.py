@@ -77,6 +77,91 @@ def test_missing_key_raises(tmp_path):
                            str(tmp_path))
 
 
+class TestHardenedCheckpoint:
+    """ISSUE 17 satellite: per-shard CRC32, mesh-topology manifest, and
+    preemption/bit-rot fault injection — a damaged or torn checkpoint
+    must fail TYPED (``CheckpointCorruptError`` /
+    ``TopologyMismatchError``), never zero-fill, and a failed overwrite
+    must leave the previous checkpoint loadable with zero stranded
+    state."""
+
+    def _save(self, path, value, topology=None):
+        ck.save_state_dict(
+            {"w": pt.Tensor(jnp.full((4, 4), value, jnp.float32))},
+            str(path), topology=topology)
+
+    def _load_w(self, path, **kw):
+        sd = {"w": pt.Tensor(jnp.zeros((4, 4), jnp.float32))}
+        ck.load_state_dict(sd, str(path), **kw)
+        return np.asarray(sd["w"]._value)
+
+    def test_topology_manifest_roundtrip(self, tmp_path):
+        from paddle_tpu.parallel.topology import HybridTopology
+        topo = HybridTopology(dp=2)
+        self._save(tmp_path, 1.0, topology=topo)
+        m = ck.read_topology_manifest(str(tmp_path))
+        assert m["world_size"] == 2
+        assert m["degrees"]["dp"] == 2
+
+    def test_topology_mismatch_is_typed(self, tmp_path):
+        """Loading under a different mesh demands an explicit
+        ``reshape=True`` — silent resharding of an elastic run's
+        checkpoint would mask a wrong-topology resume."""
+        from paddle_tpu.parallel.topology import HybridTopology
+        self._save(tmp_path, 3.0, topology=HybridTopology(dp=2))
+        with pytest.raises(ck.TopologyMismatchError):
+            self._load_w(tmp_path, topology=HybridTopology(dp=4))
+        # same topology needs no flag; different + explicit reshape ok
+        w = self._load_w(tmp_path, topology=HybridTopology(dp=2))
+        np.testing.assert_array_equal(w, 3.0)
+        w = self._load_w(tmp_path, topology=HybridTopology(dp=4),
+                         reshape=True)
+        np.testing.assert_array_equal(w, 3.0)
+
+    def test_bitrot_is_typed(self, tmp_path):
+        from faults import corrupt_file
+        from paddle_tpu.framework.io import CheckpointCorruptError
+        self._save(tmp_path, 1.0)
+        corrupt_file(str(tmp_path / "shard_rank0.npz"), offset=200)
+        with pytest.raises(CheckpointCorruptError):
+            self._load_w(tmp_path)
+
+    def test_missing_shard_is_typed(self, tmp_path):
+        import os
+        from paddle_tpu.framework.io import CheckpointCorruptError
+        self._save(tmp_path, 1.0)
+        os.remove(tmp_path / "shard_rank0.npz")
+        with pytest.raises(CheckpointCorruptError):
+            self._load_w(tmp_path)
+
+    def test_crash_mid_write_keeps_old_checkpoint(self, tmp_path,
+                                                  monkeypatch):
+        from faults import SimulatedCrash, crash_mid_write
+        self._save(tmp_path, 1.0)
+        with crash_mid_write(monkeypatch) as stats:
+            with pytest.raises(SimulatedCrash):
+                self._save(tmp_path, 2.0)
+        assert stats["crashed"] == 1
+        # old checkpoint intact, no stranded temp files
+        np.testing.assert_array_equal(self._load_w(tmp_path), 1.0)
+        assert not list(tmp_path.glob(".tmp-*"))
+        self._save(tmp_path, 2.0)        # retry succeeds
+        np.testing.assert_array_equal(self._load_w(tmp_path), 2.0)
+
+    def test_failed_rename_keeps_old_checkpoint(self, tmp_path,
+                                                monkeypatch):
+        from faults import SimulatedCrash, fail_replace
+        self._save(tmp_path, 1.0)
+        with fail_replace(monkeypatch) as stats:
+            with pytest.raises(SimulatedCrash):
+                self._save(tmp_path, 5.0)
+        assert stats["failed"] == 1
+        np.testing.assert_array_equal(self._load_w(tmp_path), 1.0)
+        assert not list(tmp_path.glob(".tmp-*"))
+        self._save(tmp_path, 5.0)
+        np.testing.assert_array_equal(self._load_w(tmp_path), 5.0)
+
+
 class TestAsyncSave:
     """Reference async checkpoint (save_state_dict.py async_save_queue):
     shard copies synchronous, disk writes on a background thread."""

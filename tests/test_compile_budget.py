@@ -111,6 +111,16 @@ def test_http_warm_is_zero_compiles(measured):
     assert measured["serve_http_warm"] == 0, measured
 
 
+def test_train_elastic_warm_is_zero_compiles(measured):
+    """ISSUE 17 acceptance: an elastic trainer resumed at a
+    previously-seen mesh loads its per-topology AOT entry, and a
+    worker kill whose survivor mesh has also been seen reshapes onto
+    the already-exported entry — zero backend compiles for the resume
+    AND the reshape.  Any compile here means the warm rebuild silently
+    fell back to tracing."""
+    assert measured["train_elastic_warm"] == 0, measured
+
+
 def test_every_scenario_has_a_budget(measured):
     budgets = compile_budget.load_ledger()["budgets"]
     assert set(measured) <= set(budgets), (set(measured), set(budgets))

@@ -116,3 +116,26 @@ class TestDegree4Dryrun:
         assert r.returncode == 0, r.stderr[-800:]
         assert "'mp': 4" in r.stdout and "'pp': 4" in r.stdout \
             and "'sharding': 4" in r.stdout, r.stdout
+
+
+class TestElasticDryrun:
+    """ISSUE 17: one worker-kill per mesh axis through the FULL driver
+    -gate path — the ElasticTrainer reshapes over the survivors and the
+    post-reshape losses stay finite (subprocess with its own
+    virtual-device mesh, like the multichip dryruns)."""
+
+    def test_8_device_elastic_kill_per_axis(self):
+        import subprocess, sys, os
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "from __graft_entry__ import dryrun_elastic; "
+             "dryrun_elastic(8)"],
+            cwd=repo, capture_output=True, text=True, timeout=560)
+        assert r.returncode == 0, r.stderr[-800:]
+        assert "kill axis=dp 8->7" in r.stdout, r.stdout
+        assert "kill axis=sharding 8->7" in r.stdout, r.stdout
+        assert "kill axis=pp 2->1" in r.stdout, r.stdout
+        # the sharding kill loses un-reconstructible ZeRO shards: it
+        # must take the checkpoint-restore + replay path
+        assert "carryover=False replayed=1" in r.stdout, r.stdout

@@ -189,6 +189,22 @@ def test_decode_block_has_zero_tl001_tl006():
             assert n == 0, f"baseline carries {rule} debt in {path}"
 
 
+def test_parallel_elastic_has_zero_tl001_tl006():
+    """ISSUE 17 contract: the elastic trainer is host-side supervision
+    around the engine's compiled step — no host-sync in traced code
+    (TL001; the SDC guard must stay an in-graph where-select, never a
+    host check per step) and no silent broad excepts (TL006; a
+    swallowed reshape/restore error would resume training on corrupt
+    or stale state) — live scan AND committed ledger."""
+    files = ("paddle_tpu/parallel/elastic.py",)
+    live = [f for f in _current_findings()
+            if f.rule in ("TL001", "TL006") and f.path.endswith(files)]
+    assert live == [], [f.format() for f in live]
+    for (rule, path), n in baseline_mod.load().items():
+        if rule in ("TL001", "TL006") and path.endswith(files):
+            assert n == 0, f"baseline carries {rule} debt in {path}"
+
+
 def test_core_subsystems_have_zero_tl006():
     """The ISSUE 4 triage contract: checkpoint/, io/, optimizer/ and
     parallel/ carry NO un-triaged silent-except debt — in the live scan

@@ -35,6 +35,16 @@ from typing import Callable, Dict, List, Optional
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# standalone runs need the tier-1 virtual 8-device mesh (conftest.py sets
+# the same flags for pytest) — `train_elastic_warm` reshapes a dp2 mesh.
+# Must happen before the first jax import, i.e. before any scenario setup.
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+        " --xla_cpu_enable_concurrency_optimized_scheduler=false").strip()
+
 LEDGER = os.path.join(REPO, "COMPILE_BUDGET.md")
 MAGIC = "compile-budget v1"
 
@@ -471,6 +481,78 @@ def serve_quant_warm() -> Callable[[], None]:
     return workload
 
 
+def train_elastic_warm() -> Callable[[], None]:
+    """Elastic-training warm rebuild (ISSUE 17): an ElasticTrainer
+    resumed at a previously-seen mesh loads its per-topology AOT entry
+    — then survives a worker kill whose survivor mesh has ALSO been
+    seen.  Budget is ZERO backend compiles for BOTH: the same-topology
+    resume and the reshape onto an already-exported survivor entry.
+    Setup pays the two bounded cold exports (dp2, then the dp1
+    survivor mesh via an injected loss); the workload replays the whole
+    resume-kill-reshape-continue sequence warm."""
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.parallel import ElasticTrainer, WorkerLostError
+    from paddle_tpu.parallel.topology import HybridTopology, set_topology
+
+    def data_fn(step):
+        r = np.random.default_rng(1000 + step)
+        return (r.standard_normal((12, 16)).astype("float32"),
+                r.integers(0, 4, (12,)).astype("int64"))
+
+    def make_trainer(aot_dir):
+        topo = HybridTopology(dp=2)
+        set_topology(topo)
+        pt.seed(11)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 4))
+        opt = pt.optimizer.Adam(parameters=net.parameters(),
+                                learning_rate=1e-2)
+        return ElasticTrainer(net, opt, nn.CrossEntropyLoss(), data_fn,
+                              topology=topo, sharding_stage=2,
+                              rng_seed=7, aot_dir=aot_dir)
+
+    def kill_and_continue(tr):
+        eng, real = tr.engine, tr.engine.train_batch
+        fired = [0]
+
+        def patched(inputs, labels=None, rng=None):
+            if eng._step_count == 2 and not fired[0]:
+                fired[0] = 1
+                raise WorkerLostError("injected device loss",
+                                      lost_index=1, axis="dp")
+            return real(inputs, labels, rng=rng)
+
+        eng.train_batch = patched
+        tr.run(2)                    # step 2 killed → dp1, steps 2,3
+
+    aot_dir = tempfile.mkdtemp(prefix="aot_budget_elastic_")
+    try:
+        tr = make_trainer(aot_dir)   # cold: exports the dp2 entry,
+        tr.run(2)                    # then the dp1 survivor entry
+        kill_and_continue(tr)
+    finally:
+        set_topology(HybridTopology())
+
+    def workload():
+        try:
+            tr = make_trainer(aot_dir)
+            tr.run(2)                # warm same-topology resume
+            kill_and_continue(tr)    # reshape onto the seen survivor
+            if tr.reshapes != 1 or tr.topo.world_size != 1:
+                raise RuntimeError(
+                    f"scenario never reshaped: reshapes={tr.reshapes} "
+                    f"world_size={tr.topo.world_size}")
+        finally:
+            set_topology(HybridTopology())
+
+    return workload
+
+
 SCENARIOS: Dict[str, Callable[[], Callable[[], None]]] = {
     "gpt_train": gpt_train,
     "serve_fresh": serve_fresh,
@@ -482,6 +564,7 @@ SCENARIOS: Dict[str, Callable[[], Callable[[], None]]] = {
     "serve_http_warm": serve_http_warm,
     "serve_prefix_warm": serve_prefix_warm,
     "serve_quant_warm": serve_quant_warm,
+    "train_elastic_warm": train_elastic_warm,
 }
 
 
@@ -537,7 +620,11 @@ def render_md(counts: Dict[str, int]) -> str:
         "shared-prefix traffic through the cross-request prefix cache "
         "with hits, an eviction-to-offload, and an offload restore, or "
         "serving int8-quantized weights and KV pages end-to-end with a "
-        "preempt/restore through the codes+scales spill format.",
+        "preempt/restore through the codes+scales spill format.  "
+        "`train_elastic_warm` is the ISSUE 17 training-side row: an "
+        "elastic trainer resumed at a previously-seen mesh — and "
+        "reshaped by a worker kill onto an already-exported survivor "
+        "mesh — performs zero backend compiles for both transitions.",
         "",
     ]
     for name, n in counts.items():
