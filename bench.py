@@ -908,6 +908,74 @@ def _serve_decode_block_extra(cfg, params, eng_fused, *, mb, nb, on_accel,
         return {"decode_block_error": f"{type(e).__name__}: {e}"}
 
 
+def _serve_prefill_extra(cfg, params, *, mb, nb, on_accel, t0, new):
+    """Fused-vs-per-op chunked-prefill A/B for the serve row (ISSUE 18):
+    the same seeded Poisson load through a compile-warm fused-prefill
+    engine (``fused_prefill=True``, the default) and a per-op one,
+    reporting TTFT p50/p99 both ways plus the per-chunk HBM-traffic
+    model.  Never fails the row — errors land in extra.prefill_error."""
+    try:
+        from paddle_tpu.inference.serving import ContinuousBatchingEngine
+        from paddle_tpu.ops.decode_block import (decode_block_spec,
+                                                 hbm_traffic_per_chunk)
+        from paddle_tpu.serving import (AdmissionConfig, LoadGenConfig,
+                                        PoissonLoadGenerator,
+                                        ServingFrontend)
+
+        lg = LoadGenConfig(
+            n_requests=16 if not on_accel else 32,
+            rate_rps=100.0 if not on_accel else 8.0, seed=18,
+            prompt_len=(3, t0), max_new_tokens=(3, new),
+            sampled_fraction=0.25, cancel_fraction=0.1,
+            slo_ttft_s=5.0 if not on_accel else 2.0,
+            slo_tpot_s=1.0 if not on_accel else 0.25)
+
+        def warm_engine(fused):
+            eng = ContinuousBatchingEngine(
+                cfg, params, max_batch=mb, block_size=16, num_blocks=nb,
+                prefill_buckets=(t0,), fused_prefill=fused)
+            # compile-warm the bucket fill, decode and the sampler so
+            # the A/B measures serving, not tracing
+            eng.add_request(np.arange(1, t0 + 1, dtype=np.int32), 4)
+            eng.add_request(np.arange(1, t0 + 1, dtype=np.int32), 4,
+                            temperature=0.7, top_k=8, seed=1)
+            eng.run_to_completion()
+            return eng
+
+        reps = {}
+        for fused in (True, False):
+            eng = warm_engine(fused)
+            reps[fused] = PoissonLoadGenerator(
+                ServingFrontend(eng, admission=AdmissionConfig(
+                    max_queue_len=64)), lg).run().to_dict()
+            rep = eng.kv_leak_report()
+            if rep["leaked"] or rep["unaccounted"]:
+                raise RuntimeError(f"prefill A/B leaked KV: {rep}")
+        spec = decode_block_spec(cfg, 16)
+        model = hbm_traffic_per_chunk(
+            spec, cfg.intermediate_size, t0, nb // max(mb, 1),
+            np.dtype(cfg.dtype).itemsize)
+        return {"prefill": {
+            "ttft_p50_fused": (reps[True]["ttft_s"] or {}).get("p50"),
+            "ttft_p99_fused": (reps[True]["ttft_s"] or {}).get("p99"),
+            "ttft_p50_per_op": (reps[False]["ttft_s"] or {}).get("p50"),
+            "ttft_p99_per_op": (reps[False]["ttft_s"] or {}).get("p99"),
+            "tokens_per_s_fused": reps[True]["tokens_per_s"],
+            "tokens_per_s_per_op": reps[False]["tokens_per_s"],
+            "kv_leaked_blocks": reps[True]["kv_leaked_blocks"],
+            "hbm_model_per_layer_per_chunk": model,
+            # the CPU proxy runs the SAME XLA ops both ways (the fused
+            # op's reference tier IS the per-op chain) on one core, so
+            # TTFT is ~1:1 here; the modelled stream-bytes gap is the
+            # memory-bound-hardware-facing win (docs/performance.md)
+            "note": "CPU proxy is compute-bound and bit-identical both "
+                    "ways; the fused win is the modelled HBM stream "
+                    "traffic, realized on memory-bound accelerators",
+        }}
+    except Exception as e:
+        return {"prefill_error": f"{type(e).__name__}: {e}"}
+
+
 def _train_aot_warm_extra(step_fn, state, ids, labels, ttfs_cold):
     """Cold-vs-warm for the llama train row: serialize the (undonated
     re-jit of the) train step, deserialize, and time load + first step
@@ -1280,6 +1348,9 @@ def run_config_bench(config: str):
         out["extra"].update(_serve_quant_extra(
             cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
             new=new))
+        out["extra"].update(_serve_prefill_extra(
+            cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
+            new=new))
     elif config == "decode":
         # inference: autoregressive decode through the KV-cache decoder
         # (prefill + lax.scan step loop; Pallas MMHA on TPU) — the
@@ -1542,6 +1613,116 @@ def run_config_bench(config: str):
                       "heads": f"{Hq}q/{Hkv}kv", "head_dim": D,
                       "ffn": F, "dtype": str(jnp.dtype(dt)),
                       "hbm_model_per_layer_at_max_batch": model,
+                      "device": str(devices[0]),
+                      "note": "CPU proxy: both tiers are the same XLA "
+                              "program (speedup ~1.0 expected); the "
+                              "hbm model is the accelerator-facing win"},
+        }
+    elif config == "prefill":
+        # fused chunked-prefill microbench (ISSUE 18): a jitted L-layer
+        # chunk fill built from ops/decode_block.prefill_block, fused
+        # tier vs the per-op reference tier, across chunk lengths.  On
+        # the CPU proxy both tiers lower to the same XLA ops (the
+        # reference tier IS the fused op's CPU path), so wall clock is
+        # ~1:1 and the per-chunk HBM-traffic model carries the claim;
+        # on TPU the fused tier dispatches the Pallas prefill
+        # megakernel with double-buffered page DMA when the layer and
+        # chunk fit VMEM.
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.decode_block import (DecodeBlockSpec,
+                                                 hbm_traffic_per_chunk,
+                                                 prefill_block)
+
+        if on_accel:
+            H, Hq, Hkv, D, F, L = 2048, 16, 8, 128, 5504, 4
+            BS, MB, NB = 16, 64, 512
+            chunks, reps, dt = (64, 128, 256), 10, jnp.bfloat16
+        else:
+            H, Hq, Hkv, D, F, L = 64, 4, 2, 16, 128, 2
+            BS, MB, NB = 8, 16, 64
+            chunks, reps, dt = (8, 16, 32), 5, jnp.float32
+        spec = DecodeBlockSpec(hidden=H, num_heads=Hq, kv_heads=Hkv,
+                               head_dim=D, block_size=BS, norm="rms",
+                               activation="swiglu", eps=1e-5, rope=True)
+
+        def mk(*s):
+            return jnp.asarray(
+                rng.standard_normal(s).astype(np.float32) * 0.05, dt)
+
+        lp = {"ln1_w": mk(L, H) + 1.0, "q_w": mk(L, H, Hq * D),
+              "k_w": mk(L, H, Hkv * D), "v_w": mk(L, H, Hkv * D),
+              "o_w": mk(L, Hq * D, H), "ln2_w": mk(L, H) + 1.0,
+              "gate_w": mk(L, H, F), "up_w": mk(L, H, F),
+              "down_w": mk(L, F, H)}
+        pool_k = mk(L, NB, BS, Hkv, D)
+        pool_v = mk(L, NB, BS, Hkv, D)
+
+        def build(backend, start):
+            def fill(x, lp, pk, pv, blk, off, bt_row, mask, cos, sin):
+                def body(carry, inp):
+                    x = carry
+                    layer, k, v = inp
+                    x, k, v = prefill_block(
+                        x, layer, k, v, blk, off, bt_row, mask, cos,
+                        sin, spec=spec, start=start, backend=backend)
+                    return x, (k, v)
+
+                x, (pk2, pv2) = jax.lax.scan(body, x, (lp, pk, pv))
+                return x, pk2, pv2
+
+            return jax.jit(fill)
+
+        rows = {}
+        for Ts in chunks:
+            start = Ts                      # one committed chunk ahead
+            bt_row = np.full((MB,), -1, np.int32)
+            n_blk = -(-(start + Ts) // BS)
+            bt_row[:n_blk] = rng.permutation(NB)[:n_blk]
+            bt_row = jnp.asarray(bt_row)
+            pos = start + jnp.arange(Ts)
+            blk = jnp.take(jnp.maximum(bt_row, 0), pos // BS)
+            off = pos % BS
+            mask = jnp.arange(MB * BS)[None, None, None, :] \
+                <= pos[None, None, :, None]
+            x = mk(1, Ts, H)
+            cos, sin = mk(Ts, D), mk(Ts, D)
+            args = (x, lp, pool_k, pool_v, blk, off, bt_row, mask,
+                    cos, sin)
+
+            def timeit(fn):
+                o = fn(*args)
+                jax.block_until_ready(o)
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    o = fn(*args)
+                jax.block_until_ready(o)
+                return (time.perf_counter() - t0) / reps
+
+            t_op = timeit(build("xla", start))
+            t_fused = timeit(build(None, start))
+            hbm = hbm_traffic_per_chunk(spec, F, Ts, MB,
+                                        jnp.dtype(dt).itemsize)
+            rows[f"T{Ts}"] = {
+                "per_op_ms": round(t_op * 1e3, 3),
+                "fused_ms": round(t_fused * 1e3, 3),
+                "speedup": round(t_op / t_fused, 3),
+                "fused_tokens_per_s": round(Ts / t_fused, 1),
+                "hbm_bytes_per_chunk_per_op": hbm["per_op_bytes"],
+                "hbm_bytes_per_chunk_fused": hbm["fused_bytes"],
+            }
+        big = rows[f"T{chunks[-1]}"]
+        model = hbm_traffic_per_chunk(spec, F, chunks[-1], MB,
+                                      jnp.dtype(dt).itemsize)
+        out = {
+            "metric": "prefill_block_tokens_per_sec",
+            "value": big["fused_tokens_per_s"],
+            "unit": "tokens/s/chip",
+            "vs_baseline": big["speedup"],
+            "extra": {"rows": rows, "layers": L, "hidden": H,
+                      "heads": f"{Hq}q/{Hkv}kv", "head_dim": D,
+                      "ffn": F, "dtype": str(jnp.dtype(dt)),
+                      "hbm_model_per_layer_at_max_chunk": model,
                       "device": str(devices[0]),
                       "note": "CPU proxy: both tiers are the same XLA "
                               "program (speedup ~1.0 expected); the "

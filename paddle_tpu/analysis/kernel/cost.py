@@ -40,10 +40,12 @@ from typing import Dict, Iterable, Optional, Sequence, Tuple
 
 __all__ = [
     "VMEM_BYTES_PER_CORE", "SAFETY_FRACTION", "DEFAULT_GENERATION",
-    "MAX_HEAD_DIM", "MODEL_TOLERANCE", "budget_bytes", "fits",
+    "MAX_HEAD_DIM", "MODEL_TOLERANCE", "DMA_STAGING_SLOTS",
+    "budget_bytes", "fits",
     "generation_from_device_kind", "itemsize", "Buffer", "vmem_bytes",
     "decode_block_vmem", "decode_block_weight_bytes",
     "decode_block_unsupported_reason",
+    "prefill_block_vmem", "prefill_block_unsupported_reason",
     "linear_ce_vmem", "linear_ce_fits",
 ]
 
@@ -145,8 +147,26 @@ def vmem_bytes(buffers: Iterable[Buffer]) -> int:
 
 
 # ---------------------------------------------------------------------------
-# decode_block: the fused decode-step megakernel (ops/pallas/decode_block)
+# decode_block / prefill_block: the fused block megakernels (ops/pallas)
 # ---------------------------------------------------------------------------
+# Both block kernels stage KV pages through a revolving two-slot buffer
+# (start the NEXT page-chunk's DMA while the current one accumulates),
+# so the declared staging allocation is 2x the per-chunk footprint.
+DMA_STAGING_SLOTS = 2
+
+
+def _page_staging_bytes(pages: int, block_size: int, kv_heads: int,
+                        head_dim: int, pool_itemsize: int,
+                        kv_quant: bool) -> int:
+    """Declared bytes of the double-buffered page staging tier: k + v
+    data pages per slot, plus per-(token, head) fp32 scale rows when the
+    pool is quantized (ops/paged_kv.QuantizedKVPool layout)."""
+    per_chunk = 2 * pages * block_size * kv_heads * head_dim * pool_itemsize
+    if kv_quant:
+        per_chunk += 2 * pages * block_size * kv_heads * 4
+    return DMA_STAGING_SLOTS * per_chunk
+
+
 def decode_block_vmem(*, hidden: int, num_heads: int, kv_heads: int,
                       head_dim: int, block_size: int, pages: int,
                       weight_bytes: int, pool_itemsize: int,
@@ -157,9 +177,11 @@ def decode_block_vmem(*, hidden: int, num_heads: int, kv_heads: int,
     Mirrors ``ops/pallas/decode_block._call`` exactly: the layer's full
     weight set streams into VMEM as whole-array blocks
     (``weight_bytes``), ``pages`` KV pages stage per attention chunk
-    (k + v), the online-softmax state is fp32 scratch, and the residual
-    stream/RoPE rows/outputs are one-row blocks.  Keys: ``weights``,
-    ``staging``, ``scratch``, ``io``, ``total``.
+    (k + v, two revolving DMA slots so the next chunk's copy overlaps
+    the current chunk's accumulation), the online-softmax state is fp32
+    scratch, and the residual stream/RoPE rows/outputs are one-row
+    blocks.  Keys: ``weights``, ``staging``, ``scratch``, ``io``,
+    ``total``.
 
     With ``kv_quant`` the pool is int8 data plus per-(token, head) fp32
     scales: the staging tier gains a scale row per page (k + v) and the
@@ -168,11 +190,8 @@ def decode_block_vmem(*, hidden: int, num_heads: int, kv_heads: int,
     fp32.
     """
     Hq, Hkv, D, BS = num_heads, kv_heads, head_dim, block_size
-    staging = 2 * pages * BS * Hkv * D * pool_itemsize
-    if kv_quant:
-        # per-(token, head) fp32 scale pages staged alongside the int8
-        # data pages (ops/paged_kv.QuantizedKVPool layout)
-        staging += 2 * pages * BS * Hkv * 4
+    staging = _page_staging_bytes(pages, BS, Hkv, D, pool_itemsize,
+                                  kv_quant)
     # fp32 scratch: q (Hq, D) + acc (Hq, D) + new k/v (2 * Hkv * D)
     # + running max/sum (2 * Hq)
     scratch = 4 * (2 * Hq * D + 2 * Hkv * D + 2 * Hq)
@@ -280,6 +299,73 @@ def decode_block_unsupported_reason(
         return (f"layer needs ~{est['total'] / 2**20:.1f} MB VMEM "
                 f"({est['weights'] / 2**20:.1f} MB weights) > budget "
                 f"{limit / 2**20:.1f} MB — multi-core fusion "
+                "territory, per-op tier serves it")
+    return None
+
+
+def prefill_block_vmem(*, hidden: int, num_heads: int, kv_heads: int,
+                       head_dim: int, block_size: int, pages: int,
+                       chunk: int, weight_bytes: int, pool_itemsize: int,
+                       x_itemsize: int = 4,
+                       kv_quant: bool = False) -> Dict[str, int]:
+    """Byte breakdown of one prefill_block kernel invocation — the
+    chunked-prefill twin of :func:`decode_block_vmem`.
+
+    Mirrors ``ops/pallas/prefill_block._call``: the same whole-array
+    weight blocks and double-buffered page staging as the decode
+    kernel, but the resident tile is ``chunk`` prompt tokens instead of
+    one — q/new-k/new-v/acc scratch and the io blocks all scale by
+    ``chunk``, and the in-chunk causal attention runs over the same
+    scratch the epilogue folds.  Keys: ``weights``, ``staging``,
+    ``scratch``, ``io``, ``total``.
+    """
+    Hq, Hkv, D, BS = num_heads, kv_heads, head_dim, block_size
+    staging = _page_staging_bytes(pages, BS, Hkv, D, pool_itemsize,
+                                  kv_quant)
+    # fp32 scratch, all carrying the chunk-tile dim: q (Hq, chunk, D)
+    # + acc (Hq, chunk, D) + new k/v (2 * Hkv * chunk * D) + running
+    # max/sum (2 * Hq * chunk) — the decode formula times the tile
+    scratch = 4 * chunk * (2 * Hq * D + 2 * Hkv * D + 2 * Hq)
+    new_kv_itemsize = 4 if kv_quant else pool_itemsize
+    io = vmem_bytes([
+        Buffer("x", (chunk, hidden), x_itemsize),
+        Buffer("cos", (chunk, D), x_itemsize),
+        Buffer("sin", (chunk, D), x_itemsize),
+        Buffer("x_out", (chunk, hidden), x_itemsize),
+        Buffer("k_new", (chunk, Hkv, D), new_kv_itemsize),
+        Buffer("v_new", (chunk, Hkv, D), new_kv_itemsize),
+    ])
+    total = weight_bytes + staging + scratch + io
+    return {"weights": weight_bytes, "staging": staging,
+            "scratch": scratch, "io": io, "total": total}
+
+
+def prefill_block_unsupported_reason(
+        *, hidden: int, num_heads: int, kv_heads: int, head_dim: int,
+        block_size: int, chunk: int, rope: bool, weight_bytes: int,
+        pool_itemsize: int, x_itemsize: int = 4,
+        kv_quant: bool = False,
+        budget: Optional[int] = None,
+        generation: Optional[str] = None) -> Optional[str]:
+    """None when one prefill_block chunk fits the kernel's limits, else
+    a human-readable reason — the runtime fusion-fallback signal
+    (``PrefillBlockUnsupportedError`` when the kernel is forced), from
+    the same formula the autotune validity filter reads."""
+    D = head_dim
+    if D > MAX_HEAD_DIM:
+        return f"head_dim {D} exceeds the kernel cap {MAX_HEAD_DIM}"
+    if rope and D % 2:
+        return f"rotate-half RoPE needs an even head_dim, got {D}"
+    limit = budget if budget is not None else budget_bytes(generation)
+    est = prefill_block_vmem(
+        hidden=hidden, num_heads=num_heads, kv_heads=kv_heads,
+        head_dim=D, block_size=block_size, pages=1, chunk=chunk,
+        weight_bytes=weight_bytes, pool_itemsize=pool_itemsize,
+        x_itemsize=x_itemsize, kv_quant=kv_quant)
+    if est["total"] > limit:
+        return (f"chunk of {chunk} needs ~{est['total'] / 2**20:.1f} MB "
+                f"VMEM ({est['weights'] / 2**20:.1f} MB weights) > "
+                f"budget {limit / 2**20:.1f} MB — multi-core fusion "
                 "territory, per-op tier serves it")
     return None
 

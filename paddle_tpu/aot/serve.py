@@ -94,6 +94,11 @@ def engine_config(engine) -> Dict[str, Any]:
         # engine (and vice versa)
         "decode_block_fused": bool(getattr(engine, "fused_decode_block",
                                            True)),
+        # likewise the ISSUE 18 prefill-fusion knob: it changes which
+        # kernel tier a RE-compile of the chunk fills would take, so an
+        # artifact exported unfused must never half-warm a fused engine
+        "prefill_block_fused": bool(getattr(engine, "fused_prefill",
+                                            True)),
         # the cross-request prefix cache (ISSUE 14) never changes a
         # compiled program, so its POLICY knobs (offload capacity,
         # enabled flag) stay out of the hash — but the block-key SCHEME

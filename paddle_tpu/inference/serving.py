@@ -180,6 +180,15 @@ class ContinuousBatchingEngine:
         TPU it dispatches to the VMEM-resident Pallas megakernel when
         the layer geometry fits (per-op fallback otherwise).  The knob
         is covered by the AOT artifact config hash (docs/aot.md).
+      fused_prefill: route every chunk-fill layer (bucketed prompt
+        fills AND prefix-cache suffix fills) through the fused prefill
+        block op ``ops/decode_block.prefill_block`` (ISSUE 18).  On the
+        CPU/reference tier the fused op IS the per-op chain — greedy
+        output is bit-identical either way (pinned) — while on TPU it
+        dispatches to the VMEM-resident Pallas prefill megakernel with
+        double-buffered page DMA when the layer geometry and chunk
+        length fit (per-op fallback otherwise).  The knob is covered by
+        the AOT artifact config hash (docs/aot.md).
       spec_config: a :class:`~paddle_tpu.spec_decode.SpecDecodeConfig`
         enabling speculative decoding — every decode iteration drafts
         ``k`` tokens per active request and verifies them in one
@@ -231,7 +240,8 @@ class ContinuousBatchingEngine:
                  max_blocks_per_seq: Optional[int] = None,
                  enable_prefix_caching: bool = True,
                  prefill_buckets=None, aot_dir: Optional[str] = None,
-                 fused_decode_block: bool = True, spec_config=None,
+                 fused_decode_block: bool = True,
+                 fused_prefill: bool = True, spec_config=None,
                  enable_preemption: bool = True, spill_tier=None,
                  prefix_cache_config=None, quant_config=None):
         if getattr(cfg, "moe_num_experts", 0) and \
@@ -261,6 +271,7 @@ class ContinuousBatchingEngine:
             params = quantize_params_for_serving(params, quant_config)
         self.params = params
         self.fused_decode_block = bool(fused_decode_block)
+        self.fused_prefill = bool(fused_prefill)
         self.B = max_batch
         self.BS = block_size
         self.MB = max_blocks_per_seq or \
@@ -454,7 +465,7 @@ class ContinuousBatchingEngine:
         cfg = self.cfg
         from ..models.llama import _rope_cos_sin
         from ..models.generation import _collapse_blocks
-        from ..ops.decode_block import decode_block_spec, prefill_block_xla
+        from ..ops.decode_block import decode_block_spec, prefill_block
         D = cfg.head_dim
         BS = self.BS
         cos_full, sin_full = _rope_cos_sin(
@@ -465,6 +476,10 @@ class ContinuousBatchingEngine:
         spec = decode_block_spec(cfg, BS, **self._quant_kw())
         ffn_override = moe_ffn if getattr(cfg, "moe_num_experts", 0) \
             else None
+        # fused on: auto tier (per-op reference on CPU — bit-identical —
+        # Pallas prefill megakernel on TPU when the geometry and chunk
+        # length fit); off: the per-op composition, always
+        backend = None if self.fused_prefill else "xla"
 
         def fill(params, pool_k, pool_v, bt_row, start, toks, valid=None):
             # toks [Ts]; bt_row [MB]; start: prefix length
@@ -487,9 +502,10 @@ class ContinuousBatchingEngine:
             def body(carry, inp):
                 x = carry
                 lp, pk, pv = inp
-                x, pk, pv = prefill_block_xla(
+                x, pk, pv = prefill_block(
                     x, lp, pk, pv, blk, off, bt_row, mask, cos, sin,
-                    spec=spec, ffn=ffn_override, scale=scale)
+                    spec=spec, start=start, ffn=ffn_override,
+                    scale=scale, backend=backend)
                 return x, (pk, pv)
 
             x, (pk2, pv2) = jax.lax.scan(body, x,

@@ -50,15 +50,24 @@ from .paged_kv import (QuantizedKVPool, dequantize_kv, is_quantized_pool,
                        paged_append, paged_decode_attention, quantize_kv,
                        validate_paged_decode_geometry)
 
-__all__ = ["DecodeBlockSpec", "DecodeBlockUnsupportedError", "decode_block",
+__all__ = ["DecodeBlockSpec", "DecodeBlockUnsupportedError",
+           "PrefillBlockUnsupportedError", "decode_block",
            "decode_block_spec", "decode_block_unsupported_reason",
-           "hbm_traffic_per_token", "make_norm", "make_ffn", "make_mm",
-           "make_norm_ffn", "prefill_block_xla", "rotate_half"]
+           "hbm_traffic_per_chunk", "hbm_traffic_per_token", "make_norm",
+           "make_ffn", "make_mm",
+           "make_norm_ffn", "prefill_block", "prefill_block_xla",
+           "prefill_block_unsupported_reason", "rotate_half"]
 
 
 class DecodeBlockUnsupportedError(ValueError):
     """Raised when ``backend="pallas"`` is forced on a geometry the
     megakernel does not support (auto dispatch falls back silently)."""
+
+
+class PrefillBlockUnsupportedError(ValueError):
+    """Raised when ``backend="pallas"`` is forced on a chunk-fill
+    geometry the prefill megakernel does not support (auto dispatch
+    falls back silently to the reference tier)."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -363,6 +372,17 @@ def hbm_traffic_per_token(spec: DecodeBlockSpec, ffn_size: int,
     ``x_out`` once.  The CPU tier-1 proxy is compute-bound, so this
     model — not its wall clock — is the memory-bound-hardware-facing
     claim (docs/performance.md)."""
+    weights = _layer_weight_stream_bytes(spec, ffn_size, itemsize)
+    stream = batch * spec.hidden * itemsize
+    return {
+        "weights_bytes": weights,
+        "per_op_bytes": weights + PER_OP_STREAM_ROUND_TRIPS * 2 * stream,
+        "fused_bytes": weights + 2 * stream,
+    }
+
+
+def _layer_weight_stream_bytes(spec: DecodeBlockSpec, ffn_size: int,
+                               itemsize: int) -> int:
     H, Hq, Hkv, D, F = (spec.hidden, spec.num_heads, spec.kv_heads,
                         spec.head_dim, ffn_size)
     if spec.fused_qkv:
@@ -372,12 +392,38 @@ def hbm_traffic_per_token(spec: DecodeBlockSpec, ffn_size: int,
         attn_w = H * (Hq + 2 * Hkv) * D + Hq * D * H
         ffn_w = 2 * H * F + F * H
     norm_w = 2 * H * (2 if spec.bias else 1)
-    weights = (attn_w + ffn_w + norm_w) * itemsize
-    stream = batch * H * itemsize
+    return (attn_w + ffn_w + norm_w) * itemsize
+
+
+def hbm_traffic_per_chunk(spec: DecodeBlockSpec, ffn_size: int,
+                          chunk: int, mb: int, itemsize: int,
+                          pool_itemsize: Optional[int] = None,
+                          pages: int = 1) -> dict:
+    """Modelled HBM bytes per LAYER for one ``[chunk]``-token prefill
+    tile: both paths stream the weights, gather the row's committed KV
+    pages, and scatter the chunk's new KV once (unavoidable); the
+    per-op chain additionally round-trips the ``[chunk, H]`` residual
+    stream at every fusion boundary, the fused megakernel keeps it in
+    VMEM for the whole layer.  The double-buffered page DMA changes no
+    byte count — it hides the copy LATENCY of every page-chunk after
+    the first behind the previous chunk's flash-attention fold
+    (``dma_overlap_fraction`` of the gather bytes arrive under
+    compute); docs/performance.md walks the math."""
+    weights = _layer_weight_stream_bytes(spec, ffn_size, itemsize)
+    psz = itemsize if pool_itemsize is None else pool_itemsize
+    stream = chunk * spec.hidden * itemsize
+    page_gather = 2 * mb * spec.block_size * spec.kv_heads \
+        * spec.head_dim * psz
+    kv_scatter = 2 * chunk * spec.kv_heads * spec.head_dim * psz
+    shared = weights + page_gather + kv_scatter
+    nt = max(1, -(-mb // max(1, pages)))
     return {
         "weights_bytes": weights,
-        "per_op_bytes": weights + PER_OP_STREAM_ROUND_TRIPS * 2 * stream,
-        "fused_bytes": weights + 2 * stream,
+        "page_gather_bytes": page_gather,
+        "kv_scatter_bytes": kv_scatter,
+        "per_op_bytes": shared + PER_OP_STREAM_ROUND_TRIPS * 2 * stream,
+        "fused_bytes": shared + 2 * stream,
+        "dma_overlap_fraction": round(1.0 - 1.0 / nt, 4),
     }
 
 
@@ -460,3 +506,69 @@ def decode_block(x, lp, pool_k, pool_v, block_table, lengths, cos, sin, *,
         raise ValueError(f"unknown backend {backend!r}")
     return decode_block_xla(x, lp, pool_k, pool_v, block_table, lengths,
                             cos, sin, spec=spec, ffn=ffn)
+
+
+def prefill_block_unsupported_reason(spec: DecodeBlockSpec, lp, pool_k,
+                                     chunk: int) -> Optional[str]:
+    """None when the prefill megakernel can run this layer at this
+    chunk length, else a human-readable reason (the typed-fallback
+    signal).  Limits are the kernel's own: the whole layer's weights
+    plus the double-buffered page staging plus the chunk-tile scratch
+    must fit the VMEM budget, and head_dim is capped by the attention
+    scratch layout — all read from the shared cost model."""
+    from .pallas.prefill_block import unsupported_reason
+    return unsupported_reason(spec, lp, pool_k, chunk)
+
+
+def prefill_block(x, lp, pool_k, pool_v, blk, off, bt_row, mask, cos,
+                  sin, *, spec: DecodeBlockSpec, start=None, ffn=None,
+                  scale: Optional[float] = None,
+                  backend: Optional[str] = None):
+    """One fused transformer layer for ``Ts`` prompt tokens of ONE
+    sequence against the paged pool — the chunked-prefill twin of
+    :func:`decode_block`, same three-tier dispatch.
+
+    ``x``: [1, Ts, H] residual tile; ``blk``/``off``: [Ts] positional
+    scatter targets; ``bt_row``: [MB] block-table row; ``mask``:
+    [1, 1, Ts, MB*BS] causal mask (reference tier); ``cos``/``sin``:
+    [Ts, D] RoPE rows at the tile's absolute positions; ``start``: the
+    committed-prefix length (``pos = start + arange(Ts)``) — required
+    by the Pallas tier, which derives the causal/committed masking from
+    it instead of the dense ``mask``.  Returns
+    ``(x_out [1, Ts, H], pool_k, pool_v)`` with the tile's KV written.
+
+    ``backend``: ``"xla"`` = the per-op reference chain
+    (:func:`prefill_block_xla`, bit-identical to the pre-fusion
+    engine), ``"pallas"`` = the VMEM-resident megakernel (raises
+    :class:`PrefillBlockUnsupportedError` outside its limits),
+    ``None`` = pallas on TPU when ``start`` is given and the geometry
+    fits, else the reference tier.  ``ffn``: optional FFN closure
+    override (MoE) — reference tier only."""
+    if backend is None:
+        backend = "pallas" if (
+            ffn is None and start is not None and _pallas_platform()
+            and prefill_block_unsupported_reason(
+                spec, lp, pool_k, x.shape[1]) is None
+        ) else "xla"
+    if backend == "pallas":
+        if ffn is not None:
+            raise PrefillBlockUnsupportedError(
+                "prefill_block: custom FFN closures (MoE) run the "
+                "reference tier only")
+        if start is None:
+            raise PrefillBlockUnsupportedError(
+                "prefill_block: the Pallas tier needs the committed-"
+                "prefix length (start=)")
+        reason = prefill_block_unsupported_reason(spec, lp, pool_k,
+                                                  x.shape[1])
+        if reason is not None:
+            raise PrefillBlockUnsupportedError(f"prefill_block: {reason}")
+        from .pallas.prefill_block import prefill_block_pallas
+        return prefill_block_pallas(x, lp, pool_k, pool_v, blk, off,
+                                    bt_row, mask, cos, sin, spec=spec,
+                                    start=start, scale=scale)
+    if backend != "xla":
+        raise ValueError(f"unknown backend {backend!r}")
+    return prefill_block_xla(x, lp, pool_k, pool_v, blk, off, bt_row,
+                             mask, cos, sin, spec=spec, ffn=ffn,
+                             scale=scale)

@@ -18,12 +18,15 @@ Shape of the kernel:
   flash-style online softmax across chunks (same scheme as
   ``decode_attention.py``).
 * the prologue (norm/qkv/rope) runs at chunk 0, writing q and the new
-  token's k/v to scratch; every chunk DMA-copies its pages from the
-  ``ANY``-space pools into VMEM staging buffers and folds them into the
-  softmax state; the epilogue at the last chunk folds in the CURRENT
-  token's k/v (the pool append happens host-side after the kernel, so
-  the value math matches the per-op order append-then-attend), then
-  runs out-proj, norm, FFN and both residual adds.
+  token's k/v to scratch; pages DMA-copy from the ``ANY``-space pools
+  into a revolving TWO-SLOT staging buffer — each grid step starts the
+  NEXT chunk's copies into the other slot before waiting on its own, so
+  the page DMA overlaps the flash accumulation (the cost model's 2x
+  staging term, ``cost.DMA_STAGING_SLOTS``); the epilogue at the last
+  chunk folds in the CURRENT token's k/v (the pool append happens
+  host-side after the kernel, so the value math matches the per-op
+  order append-then-attend), then runs out-proj, norm, FFN and both
+  residual adds.
 * pages per chunk is the autotuned knob (``"decode_block"`` key in
   ``ops/pallas/autotune``).
 
@@ -289,40 +292,56 @@ def _kernel(*refs, meta: _Meta):
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # ---- attention chunk: DMA this chunk's pages, fold into the online
-    # softmax (previously-stored tokens only; mask is t < length) ------
-    def _page_copies(p):
-        idx = jnp.minimum(jt * P + p, meta.mb - 1)
-        phys = jnp.maximum(bt_ref[b, idx], 0)
-        copies = [pltpu.make_async_copy(pool_k_ref.at[phys], kbuf.at[p],
-                                        sem.at[p, 0]),
-                  pltpu.make_async_copy(pool_v_ref.at[phys], vbuf.at[p],
-                                        sem.at[p, 1])]
-        if meta.kv_quant:
-            # per-(token, head) fp32 scale rows ride the same page walk
-            copies += [pltpu.make_async_copy(pool_ks_ref.at[phys],
-                                             ksbuf.at[p], sem.at[p, 2]),
-                       pltpu.make_async_copy(pool_vs_ref.at[phys],
-                                             vsbuf.at[p], sem.at[p, 3])]
+    # ---- attention chunk: double-buffered page DMA — chunk jt's copies
+    # were started one grid step earlier (chunk 0's in the prologue
+    # step); start chunk jt+1's into the OTHER slot before waiting, so
+    # the next pages stream while this chunk's flash accumulation runs -
+    def _page_copies(ct, slot):
+        copies = []
+        for p in range(P):
+            idx = jnp.minimum(ct * P + p, meta.mb - 1)
+            phys = jnp.maximum(bt_ref[b, idx], 0)
+            copies += [pltpu.make_async_copy(pool_k_ref.at[phys],
+                                             kbuf.at[slot, p],
+                                             sem.at[slot, p, 0]),
+                       pltpu.make_async_copy(pool_v_ref.at[phys],
+                                             vbuf.at[slot, p],
+                                             sem.at[slot, p, 1])]
+            if meta.kv_quant:
+                # per-(token, head) fp32 scale rows ride the page walk
+                copies += [pltpu.make_async_copy(pool_ks_ref.at[phys],
+                                                 ksbuf.at[slot, p],
+                                                 sem.at[slot, p, 2]),
+                           pltpu.make_async_copy(pool_vs_ref.at[phys],
+                                                 vsbuf.at[slot, p],
+                                                 sem.at[slot, p, 3])]
         return copies
 
-    for p in range(P):
-        for c in _page_copies(p):
+    slot = jax.lax.rem(jt, 2)
+
+    @pl.when(jt == 0)
+    def _warm_dma():
+        for c in _page_copies(0, 0):
             c.start()
-    for p in range(P):
-        for c in _page_copies(p):
-            c.wait()
+
+    @pl.when(jt + 1 < meta.nt)
+    def _start_next():
+        for c in _page_copies(jt + 1, jax.lax.rem(jt + 1, 2)):
+            c.start()
+
+    for c in _page_copies(jt, slot):
+        c.wait()
 
     if meta.kv_quant:
-        k_all = (kbuf[:].astype(jnp.float32)
-                 * ksbuf[:].astype(jnp.float32)[..., None])
-        v_all = (vbuf[:].astype(jnp.float32)
-                 * vsbuf[:].astype(jnp.float32)[..., None])
+        k_all = (kbuf[slot].astype(jnp.float32)
+                 * ksbuf[slot].astype(jnp.float32)[..., None])
+        v_all = (vbuf[slot].astype(jnp.float32)
+                 * vsbuf[slot].astype(jnp.float32)[..., None])
         k_all = k_all.reshape(P * BS, Hkv, D)
         v_all = v_all.reshape(P * BS, Hkv, D)
     else:
-        k_all = kbuf[:].reshape(P * BS, Hkv, D).astype(jnp.float32)
-        v_all = vbuf[:].reshape(P * BS, Hkv, D).astype(jnp.float32)
+        k_all = kbuf[slot].reshape(P * BS, Hkv, D).astype(jnp.float32)
+        v_all = vbuf[slot].reshape(P * BS, Hkv, D).astype(jnp.float32)
     t_pos = jt * (P * BS) + jax.lax.broadcasted_iota(
         jnp.int32, (1, P * BS), 1)                          # [1, T]
     valid = t_pos < length
@@ -383,6 +402,16 @@ def _kernel(*refs, meta: _Meta):
 # ---------------------------------------------------------------------------
 # host wrapper + autotune
 # ---------------------------------------------------------------------------
+def _floor_candidates(cands) -> Tuple[int, ...]:
+    """The ONE candidate-floor convention both block kernels share
+    (decode_block here, prefill_block in its twin module): when the fit
+    filter rejects every page-chunk size, degrade to single-page
+    staging rather than returning an empty tuple — whether the kernel
+    runs at all is the ``unsupported_reason`` gate's decision, never an
+    empty candidate list's."""
+    return tuple(cands) or (1,)
+
+
 def _fitting_candidates(spec, mb: int, pool_itemsize: int, wbytes: int,
                         x_itemsize: int,
                         kv_quant: bool = False) -> Tuple[int, ...]:
@@ -395,7 +424,7 @@ def _fitting_candidates(spec, mb: int, pool_itemsize: int, wbytes: int,
         if p <= max(mb, 1)
         and _vmem_total(spec, p, wbytes, pool_itemsize, x_itemsize,
                         kv_quant) <= VMEM_BUDGET_BYTES)
-    return cands or (1,)
+    return _floor_candidates(cands)
 
 
 def _tuned_pages(spec, lp, pool_k, mb: int, args) -> int:
@@ -488,13 +517,16 @@ def _call(x, lp, pool_k, pool_v, block_table, lengths, cos, sin, *,
         pltpu.VMEM((Hq, 1), jnp.float32),            # running max
         pltpu.VMEM((Hq, 1), jnp.float32),            # running sum
         pltpu.VMEM((Hq, D), jnp.float32),            # attn accumulator
-        pltpu.VMEM((pages, BS, Hkv, D), pool_dt),
-        pltpu.VMEM((pages, BS, Hkv, D), pool_dt),
+        # two revolving DMA slots (cost.DMA_STAGING_SLOTS): chunk jt
+        # accumulates out of slot jt % 2 while jt+1 streams into the
+        # other
+        pltpu.VMEM((2, pages, BS, Hkv, D), pool_dt),
+        pltpu.VMEM((2, pages, BS, Hkv, D), pool_dt),
     ]
     if kvq:
         scratch += [
-            pltpu.VMEM((pages, BS, Hkv), jnp.float32),   # k scales
-            pltpu.VMEM((pages, BS, Hkv), jnp.float32),   # v scales
+            pltpu.VMEM((2, pages, BS, Hkv), jnp.float32),   # k scales
+            pltpu.VMEM((2, pages, BS, Hkv), jnp.float32),   # v scales
         ]
     pools = ((pool_k.data, pool_v.data, pool_k.scale, pool_v.scale)
              if kvq else (pool_k, pool_v))
@@ -507,7 +539,7 @@ def _call(x, lp, pool_k, pool_v, block_table, lengths, cos, sin, *,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[*scratch,
-                        pltpu.SemaphoreType.DMA((pages, n_pool))],
+                        pltpu.SemaphoreType.DMA((2, pages, n_pool))],
         interpret=use_interpret(),
     )(jnp.asarray(block_table, jnp.int32),
       jnp.asarray(lengths, jnp.int32), x, cos2, sin2,

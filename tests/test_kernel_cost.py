@@ -433,3 +433,157 @@ def test_no_second_hardcoded_vmem_constant():
         "\n" + "\n".join(offenders))
     # and the one true table does live in cost.py
     assert cost.VMEM_BYTES_PER_CORE["v4"] == 16 * 2 ** 20
+
+
+# ---------------------------------------------------------------------------
+# prefill_block (ISSUE 18): static estimate vs captured declaration
+# ---------------------------------------------------------------------------
+def _prefill_case(dtype=np.float32, Ts=7, start=5):
+    from paddle_tpu.ops.decode_block import DecodeBlockSpec
+    H, Hq, Hkv, D, F, BS, MB, NB = 32, 4, 2, 8, 48, 4, 6, 16
+    spec = DecodeBlockSpec(hidden=H, num_heads=Hq, kv_heads=Hkv,
+                           head_dim=D, block_size=BS, norm="rms",
+                           activation="swiglu", eps=1e-5, rope=True)
+
+    def w(*shape):
+        return jnp.asarray(
+            rng.standard_normal(shape).astype(np.float32) * 0.1, dtype)
+
+    lp = {"ln1_w": w(H) + 1.0, "q_w": w(H, Hq * D), "k_w": w(H, Hkv * D),
+          "v_w": w(H, Hkv * D), "o_w": w(Hq * D, H), "ln2_w": w(H) + 1.0,
+          "gate_w": w(H, F), "up_w": w(H, F), "down_w": w(F, H)}
+    pool_k, pool_v = w(NB, BS, Hkv, D), w(NB, BS, Hkv, D)
+    bt_row = jnp.asarray(np.array([2, 5, 7, -1, -1, -1], np.int32))
+    pos = start + jnp.arange(Ts)
+    blk = jnp.take(jnp.maximum(bt_row, 0), pos // BS)
+    off = pos % BS
+    mask = jnp.arange(MB * BS)[None, None, None, :] \
+        <= pos[None, None, :, None]
+    x = w(1, Ts, H)
+    cos, sin = w(Ts, D), w(Ts, D)
+    return spec, lp, x, pool_k, pool_v, blk, off, bt_row, mask, cos, sin
+
+
+@pytest.mark.parametrize("pages", [1, 2])
+def test_prefill_block_static_estimate_matches_measured(monkeypatch,
+                                                        pages):
+    from paddle_tpu.ops.pallas.decode_block import _weight_names
+    from paddle_tpu.ops.pallas.prefill_block import prefill_block_pallas
+    spec, lp, x, pk, pv, blk, off, bt, mask, cos, sin = _prefill_case()
+    cap = _Capture()
+    cap.install(monkeypatch)
+    out, _, _ = prefill_block_pallas(x, lp, pk, pv, blk, off, bt, mask,
+                                     cos, sin, spec=spec, start=5,
+                                     pages=pages)
+    assert np.isfinite(np.asarray(out)).all()
+    assert len(cap.calls) == 1
+    measured = cap.measured_bytes(0)
+    wbytes = sum(lp[n].size * lp[n].dtype.itemsize
+                 for n in _weight_names(spec))
+    est = cost.prefill_block_vmem(
+        hidden=spec.hidden, num_heads=spec.num_heads,
+        kv_heads=spec.kv_heads, head_dim=spec.head_dim,
+        block_size=spec.block_size, pages=pages, chunk=x.shape[1],
+        weight_bytes=wbytes, pool_itemsize=pk.dtype.itemsize,
+        x_itemsize=x.dtype.itemsize)
+    assert _rel_diff(est["total"], measured) <= cost.MODEL_TOLERANCE, (
+        f"static {est} vs measured {measured}")
+    # the staging term is double-buffered: DMA_STAGING_SLOTS revolving
+    # copies of the page-chunk live in VMEM at once
+    per_chunk = 2 * pages * spec.block_size * spec.kv_heads \
+        * spec.head_dim * pk.dtype.itemsize
+    assert est["staging"] == cost.DMA_STAGING_SLOTS * per_chunk
+
+
+def test_prefill_block_kv_quant_estimate_matches_measured(monkeypatch):
+    from paddle_tpu.ops.paged_kv import QuantizedKVPool, quantize_kv
+    from paddle_tpu.ops.pallas.decode_block import _weight_names
+    from paddle_tpu.ops.pallas.prefill_block import prefill_block_pallas
+    spec, lp, x, pk, pv, blk, off, bt, mask, cos, sin = _prefill_case()
+    pk = QuantizedKVPool(*quantize_kv(pk))
+    pv = QuantizedKVPool(*quantize_kv(pv))
+    cap = _Capture()
+    cap.install(monkeypatch)
+    prefill_block_pallas(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                         spec=spec, start=5, pages=2)
+    measured = cap.measured_bytes(0)
+    wbytes = sum(lp[n].size * lp[n].dtype.itemsize
+                 for n in _weight_names(spec))
+    est = cost.prefill_block_vmem(
+        hidden=spec.hidden, num_heads=spec.num_heads,
+        kv_heads=spec.kv_heads, head_dim=spec.head_dim,
+        block_size=spec.block_size, pages=2, chunk=x.shape[1],
+        weight_bytes=wbytes, pool_itemsize=1, x_itemsize=4,
+        kv_quant=True)
+    assert _rel_diff(est["total"], measured) <= cost.MODEL_TOLERANCE, (
+        f"static {est} vs measured {measured}")
+
+
+def test_prefill_unsupported_reason_uses_cost_model():
+    """The PrefillBlockUnsupportedError signal is the cost model's
+    verdict: the threshold moves exactly with the estimate's total,
+    and the pinned llama-7B-width layer (H=896/F=2432 bf16) is over
+    budget on weights alone."""
+    from paddle_tpu.ops.pallas.decode_block import _weight_names
+    from paddle_tpu.ops.pallas.prefill_block import unsupported_reason
+    spec, lp, x, pk, pv, blk, off, bt, mask, cos, sin = _prefill_case()
+    assert unsupported_reason(spec, lp, pk, x.shape[1]) is None
+    wbytes = sum(lp[n].size * lp[n].dtype.itemsize
+                 for n in _weight_names(spec))
+    kw = dict(hidden=spec.hidden, num_heads=spec.num_heads,
+              kv_heads=spec.kv_heads, head_dim=spec.head_dim,
+              block_size=spec.block_size, chunk=x.shape[1],
+              rope=spec.rope, weight_bytes=wbytes, pool_itemsize=4,
+              x_itemsize=4)
+    est = cost.prefill_block_vmem(
+        hidden=spec.hidden, num_heads=spec.num_heads,
+        kv_heads=spec.kv_heads, head_dim=spec.head_dim,
+        block_size=spec.block_size, pages=1, chunk=x.shape[1],
+        weight_bytes=wbytes, pool_itemsize=4, x_itemsize=4)
+    reason = cost.prefill_block_unsupported_reason(
+        budget=est["total"] - 1, **kw)
+    assert reason is not None and "VMEM" in reason
+    assert cost.prefill_block_unsupported_reason(
+        budget=est["total"], **kw) is None
+    # the pinned serve width: bf16 weights alone blow the real budget
+    W = dict(hidden=896, num_heads=14, kv_heads=2, head_dim=64)
+    wb_bf16 = cost.decode_block_weight_bytes(
+        ffn_hidden=2432, itemsize_=2, **W)
+    reason = cost.prefill_block_unsupported_reason(
+        block_size=8, chunk=64, rope=True, weight_bytes=wb_bf16,
+        pool_itemsize=2, x_itemsize=2, **W)
+    assert reason is not None and "VMEM" in reason
+
+
+def test_prefill_autotune_candidates_use_dtype_aware_model():
+    """The prefill pages-candidate filter prices through the same
+    dtype-aware model AND shares the decode kernel's floor convention
+    (ONE `_floor_candidates`, not a second copy)."""
+    from paddle_tpu.ops.decode_block import DecodeBlockSpec
+    from paddle_tpu.ops.pallas import decode_block as pdb
+    from paddle_tpu.ops.pallas import prefill_block as ppf
+    assert ppf._floor_candidates is pdb._floor_candidates
+    W = dict(hidden=896, num_heads=14, kv_heads=2, head_dim=64)
+    bf16 = DecodeBlockSpec(block_size=8, norm="rms",
+                           activation="swiglu", eps=1e-5, rope=True,
+                           **W)
+    wb_bf16 = cost.decode_block_weight_bytes(
+        ffn_hidden=2432, itemsize_=2, **W)
+    wb_int8 = cost.decode_block_weight_bytes(
+        ffn_hidden=2432, weight_dtype="int8", itemsize_=2, **W)
+    # bf16: nothing fits — the (1,) return is the shared floor, and
+    # even that candidate prices over budget (dispatch falls back
+    # before the tuner ever runs it)
+    assert ppf._fitting_candidates(bf16, 64, 8, 2, wb_bf16, 2) == (1,)
+    assert ppf._vmem_total(bf16, 1, 64, wb_bf16, 2, 2) \
+        > pdb.VMEM_BUDGET_BYTES
+    int8 = DecodeBlockSpec(block_size=8, norm="rms",
+                           activation="swiglu", eps=1e-5, rope=True,
+                           weight_dtype="int8", **W)
+    cands = ppf._fitting_candidates(int8, 64, 8, 2, wb_int8, 2)
+    assert len(cands) >= 2, cands      # real fits, not the floor
+    assert all(ppf._vmem_total(int8, p, 64, wb_int8, 2, 2)
+               <= pdb.VMEM_BUDGET_BYTES for p in cands)
+    # longer chunks shrink what fits: the model is chunk-aware
+    assert len(ppf._fitting_candidates(int8, 2048, 8, 2, wb_int8, 2)) \
+        <= len(cands)

@@ -72,7 +72,8 @@ def test_decode_block_vmem_breakdown_adds_up():
         pages=2, weight_bytes=1000, pool_itemsize=2, x_itemsize=4)
     assert est["total"] == (est["weights"] + est["staging"]
                             + est["scratch"] + est["io"])
-    assert est["staging"] == 2 * 2 * 8 * 2 * 16 * 2
+    # double-buffered: DMA_STAGING_SLOTS revolving copies of k+v pages
+    assert est["staging"] == cost.DMA_STAGING_SLOTS * 2 * 2 * 8 * 2 * 16 * 2
     # doubling pages doubles ONLY staging
     est2 = cost.decode_block_vmem(
         hidden=64, num_heads=4, kv_heads=2, head_dim=16, block_size=8,

@@ -1,0 +1,623 @@
+"""Fused chunked-prefill block op (ISSUE 18): XLA tier bit-identity vs
+the inline per-op chain, Pallas interpret-tier value parity (eager and
+jitted), typed geometry/VMEM/MoE fallbacks, the "prefill_block"
+autotune cache roundtrip, quantized-weight and int8-KV parity, and the
+serve-path acceptance pins — engine greedy, frontend stream,
+spec-decode, HTTP/SSE wire, prefix-cache suffix fill, and the AOT
+``fused_prefill`` knob — all bit-identical with fusion on and off."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core.flags import FLAGS, set_flags
+from paddle_tpu.ops.decode_block import (DecodeBlockSpec,
+                                         PrefillBlockUnsupportedError,
+                                         prefill_block,
+                                         prefill_block_unsupported_reason,
+                                         prefill_block_xla)
+from paddle_tpu.ops.paged_kv import (QuantizedKVPool, is_quantized_pool,
+                                     quantize_kv)
+from paddle_tpu.ops.pallas import prefill_block as ppf
+from paddle_tpu.ops.pallas.prefill_block import (prefill_block_pallas,
+                                                 tune_prefill_block,
+                                                 unsupported_reason)
+
+rng = np.random.default_rng(18)
+
+
+def _w(*shape, dtype=np.float32, scale=0.1):
+    return jnp.asarray(rng.standard_normal(shape).astype(np.float32)
+                       * scale, dtype=dtype)
+
+
+def _llama_layer(H, Hq, Hkv, D, F, dtype):
+    return {"ln1_w": _w(H, dtype=dtype, scale=1.0) + 1.0,
+            "q_w": _w(H, Hq * D, dtype=dtype),
+            "k_w": _w(H, Hkv * D, dtype=dtype),
+            "v_w": _w(H, Hkv * D, dtype=dtype),
+            "o_w": _w(Hq * D, H, dtype=dtype),
+            "ln2_w": _w(H, dtype=dtype, scale=1.0) + 1.0,
+            "gate_w": _w(H, F, dtype=dtype), "up_w": _w(H, F, dtype=dtype),
+            "down_w": _w(F, H, dtype=dtype)}
+
+
+def _gpt_layer(H, Hq, D, F, dtype):
+    return {"ln1_w": _w(H, dtype=dtype, scale=1.0) + 1.0,
+            "ln1_b": _w(H, dtype=dtype),
+            "qkv_w": _w(H, 3 * H, dtype=dtype),
+            "qkv_b": _w(3 * H, dtype=dtype),
+            "proj_w": _w(H, H, dtype=dtype), "proj_b": _w(H, dtype=dtype),
+            "ln2_w": _w(H, dtype=dtype, scale=1.0) + 1.0,
+            "ln2_b": _w(H, dtype=dtype),
+            "fc1_w": _w(H, F, dtype=dtype), "fc1_b": _w(F, dtype=dtype),
+            "fc2_w": _w(F, H, dtype=dtype), "fc2_b": _w(H, dtype=dtype)}
+
+
+def _case(kind, dtype, Ts=7, start=5, MB=6, NB=16, BS=4):
+    """One sequence's chunk fill: ``Ts`` prompt tokens at absolute
+    positions ``start + [0, Ts)`` against a pool holding ``start``
+    committed tokens in the sequence's block-table row (plus unrelated
+    junk everywhere else — both tiers must ignore it)."""
+    H, D = 32, 8
+    if kind == "llama_gqa":
+        Hq, Hkv, F = 4, 2, 48
+        spec = DecodeBlockSpec(hidden=H, num_heads=Hq, kv_heads=Hkv,
+                               head_dim=D, block_size=BS, norm="rms",
+                               activation="swiglu", eps=1e-5, rope=True)
+        lp = _llama_layer(H, Hq, Hkv, D, F, dtype)
+    else:                                        # gpt: ln + gelu + bias
+        Hq = Hkv = 4
+        spec = DecodeBlockSpec(hidden=H, num_heads=Hq, kv_heads=Hq,
+                               head_dim=D, block_size=BS, norm="ln",
+                               activation="gelu", eps=1e-5, rope=False,
+                               fused_qkv=True, bias=True)
+        lp = _gpt_layer(H, Hq, D, 48, dtype)
+    pool_k = _w(NB, BS, Hkv, D, dtype=dtype)
+    pool_v = _w(NB, BS, Hkv, D, dtype=dtype)
+    bt_row = np.full((MB,), -1, np.int32)
+    nb = -(-(start + Ts) // BS)
+    bt_row[:nb] = [2, 5, 7, 9, 11, 13][:nb]
+    bt_row = jnp.asarray(bt_row)
+    pos = start + jnp.arange(Ts)
+    blk = jnp.take(jnp.maximum(bt_row, 0), pos // BS)
+    off = pos % BS
+    jpos = jnp.arange(MB * BS)[None, None, None, :]
+    mask = jpos <= pos[None, None, :, None]
+    x = _w(1, Ts, H, dtype=dtype, scale=0.5)
+    cos = _w(Ts, D, dtype=dtype, scale=1.0) if spec.rope else None
+    sin = _w(Ts, D, dtype=dtype, scale=1.0) if spec.rope else None
+    return spec, lp, x, pool_k, pool_v, blk, off, bt_row, mask, cos, sin
+
+
+def _per_op_reference(x, lp, pool_k, pool_v, blk, off, bt_row, mask, cos,
+                      sin, spec):
+    """The pre-ISSUE-18 per-op chunk-fill chain, written out
+    independently of the op module — what prefill_block must
+    reproduce bit-for-bit at the XLA tier."""
+    _, Ts, _ = x.shape
+    Hq, Hkv, D = spec.num_heads, spec.kv_heads, spec.head_dim
+
+    def norm(x_, w, b=None):
+        if spec.norm == "rms":
+            ms = jnp.mean(jnp.square(x_.astype(jnp.float32)), -1,
+                          keepdims=True)
+            return (x_ * jax.lax.rsqrt(ms + spec.eps).astype(x_.dtype)) * w
+        x32 = x_.astype(jnp.float32)
+        mu = jnp.mean(x32, -1, keepdims=True)
+        var = jnp.var(x32, -1, keepdims=True)
+        return ((x32 - mu) * jax.lax.rsqrt(var + spec.eps)
+                ).astype(x_.dtype) * w + b
+
+    y = norm(x, lp["ln1_w"], lp.get("ln1_b"))
+    if spec.fused_qkv:
+        qkv = (y @ lp["qkv_w"] + lp["qkv_b"]).reshape(1, Ts, Hq, 3 * D)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+    else:
+        q = (y @ lp["q_w"]).reshape(1, Ts, Hq, D)
+        k = (y @ lp["k_w"]).reshape(1, Ts, Hkv, D)
+        v = (y @ lp["v_w"]).reshape(1, Ts, Hkv, D)
+    if spec.rope:
+        def rot(t):
+            d2 = t.shape[-1] // 2
+            return jnp.concatenate([-t[..., d2:], t[..., :d2]], -1)
+
+        q = q * cos[None, :, None, :] + rot(q) * sin[None, :, None, :]
+        k = k * cos[None, :, None, :] + rot(k) * sin[None, :, None, :]
+    pool_k = pool_k.at[blk, off].set(k[0])
+    pool_v = pool_v.at[blk, off].set(v[0])
+    k_all = jnp.take(pool_k, jnp.maximum(bt_row, 0),
+                     axis=0).reshape(1, -1, Hkv, D)
+    v_all = jnp.take(pool_v, jnp.maximum(bt_row, 0),
+                     axis=0).reshape(1, -1, Hkv, D)
+    rep = Hq // Hkv
+    if rep > 1:
+        k_all = jnp.repeat(k_all, rep, axis=2)
+        v_all = jnp.repeat(v_all, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_all) * (1.0 / D ** 0.5)
+    logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+    p = jax.nn.softmax(logits, -1).astype(q.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_all).reshape(1, Ts, -1)
+    proj = attn @ (lp["proj_w"] if spec.fused_qkv else lp["o_w"])
+    x = x + (proj + lp["proj_b"] if spec.bias else proj)
+    y2 = norm(x, lp["ln2_w"], lp.get("ln2_b"))
+    if spec.activation == "swiglu":
+        f = (jax.nn.silu(y2 @ lp["gate_w"]) * (y2 @ lp["up_w"])) \
+            @ lp["down_w"]
+    else:
+        f = jax.nn.gelu(y2 @ lp["fc1_w"] + lp["fc1_b"],
+                        approximate=True) @ lp["fc2_w"] + lp["fc2_b"]
+    return x + f, pool_k, pool_v
+
+
+VARIANTS = ("llama_gqa", "gpt")
+DTYPES = (np.float32, jnp.bfloat16)
+
+
+# ---------------------------------------------------------------------------
+# tier parity
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("kind", VARIANTS)
+@pytest.mark.parametrize("dtype", DTYPES, ids=("fp32", "bf16"))
+def test_xla_tier_bit_identical_to_per_op(kind, dtype):
+    spec, lp, x, pk, pv, blk, off, bt, mask, cos, sin = _case(kind, dtype)
+    ref = _per_op_reference(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                            spec)
+    got = prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                        spec=spec, start=5, backend="xla")
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r, np.float32),
+                                      np.asarray(g, np.float32))
+
+
+@pytest.mark.parametrize("kind", VARIANTS)
+@pytest.mark.parametrize("dtype", DTYPES, ids=("fp32", "bf16"))
+def test_pallas_tier_value_parity(kind, dtype):
+    spec, lp, x, pk, pv, blk, off, bt, mask, cos, sin = _case(kind, dtype)
+    ref = _per_op_reference(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                            spec)
+    old = FLAGS.pallas_interpret
+    set_flags({"pallas_interpret": True})
+    try:
+        got = prefill_block_pallas(x, lp, pk, pv, blk, off, bt, mask,
+                                   cos, sin, spec=spec, start=5)
+        # the traced path the engine's scan takes
+        jit_got = jax.jit(lambda *a: prefill_block(
+            *a, spec=spec, start=5, backend="pallas"))(
+                x, lp, pk, pv, blk, off, bt, mask, cos, sin)
+    finally:
+        set_flags({"pallas_interpret": old})
+    tol = dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 \
+        else dict(rtol=1e-5, atol=1e-5)
+    for r, g, jg in zip(ref, got, jit_got):
+        np.testing.assert_allclose(np.asarray(g, np.float32),
+                                   np.asarray(r, np.float32), **tol)
+        np.testing.assert_allclose(np.asarray(jg, np.float32),
+                                   np.asarray(r, np.float32), **tol)
+
+
+@pytest.mark.parametrize("start,Ts", [(0, 8), (3, 1), (11, 9)])
+def test_pallas_tier_parity_across_chunk_geometries(start, Ts):
+    """Cold prefill (start=0), a single-token tail chunk, and a chunk
+    crossing several page boundaries all agree with the per-op chain."""
+    spec, lp, x, pk, pv, blk, off, bt, mask, cos, sin = _case(
+        "llama_gqa", np.float32, Ts=Ts, start=start)
+    ref = _per_op_reference(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                            spec)
+    old = FLAGS.pallas_interpret
+    set_flags({"pallas_interpret": True})
+    try:
+        got = prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                            spec=spec, start=start, backend="pallas")
+    finally:
+        set_flags({"pallas_interpret": old})
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_auto_dispatch_off_tpu_is_reference_tier():
+    """With no TPU and no interpret flag, auto dispatch must take the
+    per-op tier — the CPU tier-1 bit-identity story."""
+    spec, lp, x, pk, pv, blk, off, bt, mask, cos, sin = _case(
+        "llama_gqa", np.float32)
+    ref = prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                        spec=spec, start=5, backend="xla")
+    got = prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                        spec=spec, start=5)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+
+
+# ---------------------------------------------------------------------------
+# geometry limits / typed fallback
+# ---------------------------------------------------------------------------
+def test_unsupported_head_dim_reason_and_raise():
+    H, Hq, Hkv, D, F = 16, 2, 2, 512, 24     # D past the kernel cap
+    spec = DecodeBlockSpec(hidden=H, num_heads=Hq, kv_heads=Hkv,
+                           head_dim=D, block_size=4, norm="rms",
+                           activation="swiglu", eps=1e-5, rope=True)
+    lp = _llama_layer(H, Hq, Hkv, D, F, np.float32)
+    pk = _w(16, 4, Hkv, D)
+    pv = _w(16, 4, Hkv, D)
+    bt = jnp.asarray(np.array([2, 5, 7, -1, -1, -1], np.int32))
+    Ts, start = 7, 5
+    pos = start + jnp.arange(Ts)
+    blk, off = jnp.take(jnp.maximum(bt, 0), pos // 4), pos % 4
+    mask = jnp.arange(6 * 4)[None, None, None, :] \
+        <= pos[None, None, :, None]
+    x = _w(1, Ts, H)
+    cos, sin = _w(Ts, D), _w(Ts, D)
+    reason = unsupported_reason(spec, lp, pk, Ts)
+    assert reason is not None and "head_dim" in reason
+    assert prefill_block_unsupported_reason(spec, lp, pk, Ts) == reason
+    with pytest.raises(PrefillBlockUnsupportedError, match="head_dim"):
+        prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                      spec=spec, start=start, backend="pallas")
+    # auto dispatch silently takes the reference tier instead
+    ref = prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                        spec=spec, start=start, backend="xla")
+    old = FLAGS.pallas_interpret
+    set_flags({"pallas_interpret": True})
+    try:
+        got = prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                            spec=spec, start=start)
+    finally:
+        set_flags({"pallas_interpret": old})
+    np.testing.assert_array_equal(np.asarray(ref[0]), np.asarray(got[0]))
+
+
+def test_unsupported_vmem_budget(monkeypatch):
+    spec, lp, x, pk, pv, blk, off, bt, mask, cos, sin = _case(
+        "llama_gqa", np.float32)
+    assert unsupported_reason(spec, lp, pk, x.shape[1]) is None
+    monkeypatch.setattr(ppf, "VMEM_BUDGET_BYTES", 128)
+    reason = unsupported_reason(spec, lp, pk, x.shape[1])
+    assert reason is not None and "VMEM" in reason
+    with pytest.raises(PrefillBlockUnsupportedError, match="VMEM"):
+        prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                      spec=spec, start=5, backend="pallas")
+    # auto dispatch silently falls back to the reference tier
+    old = FLAGS.pallas_interpret
+    set_flags({"pallas_interpret": True})
+    try:
+        got = prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                            spec=spec, start=5)
+    finally:
+        set_flags({"pallas_interpret": old})
+    ref = prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                        spec=spec, start=5, backend="xla")
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+
+
+def test_moe_ffn_override_forces_reference_tier():
+    spec, lp, x, pk, pv, blk, off, bt, mask, cos, sin = _case(
+        "llama_gqa", np.float32)
+    with pytest.raises(PrefillBlockUnsupportedError, match="FFN"):
+        prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                      spec=spec, start=5, ffn=lambda lp_, y: y,
+                      backend="pallas")
+    # auto dispatch with an FFN override silently runs the reference
+    ref = prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                        spec=spec, start=5, ffn=lambda lp_, y: y * 0,
+                        backend="xla")
+    old = FLAGS.pallas_interpret
+    set_flags({"pallas_interpret": True})
+    try:
+        got = prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                            spec=spec, start=5, ffn=lambda lp_, y: y * 0)
+    finally:
+        set_flags({"pallas_interpret": old})
+    np.testing.assert_array_equal(np.asarray(ref[0]), np.asarray(got[0]))
+
+
+def test_missing_start_forces_reference_tier():
+    """The kernel derives causality from the committed-prefix length;
+    forcing the Pallas tier without it is a typed error, and auto
+    dispatch runs the reference tier."""
+    spec, lp, x, pk, pv, blk, off, bt, mask, cos, sin = _case(
+        "llama_gqa", np.float32)
+    with pytest.raises(PrefillBlockUnsupportedError,
+                       match="committed-prefix"):
+        prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                      spec=spec, backend="pallas")
+    old = FLAGS.pallas_interpret
+    set_flags({"pallas_interpret": True})
+    try:
+        got = prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                            spec=spec)
+    finally:
+        set_flags({"pallas_interpret": old})
+    ref = prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                        spec=spec, backend="xla")
+    np.testing.assert_array_equal(np.asarray(ref[0]), np.asarray(got[0]))
+
+
+# ---------------------------------------------------------------------------
+# quantized weights / int8 KV pages
+# ---------------------------------------------------------------------------
+QUANT_CASES = (("int8", -1), ("int8", 64), ("int4", 64))
+
+
+@pytest.mark.parametrize("wd,gs", QUANT_CASES,
+                         ids=lambda v: str(v))
+def test_quant_weights_pallas_matches_xla(wd, gs):
+    """Dequant-in-kernel == dequant-in-XLA for every storage layout the
+    weight-only decode path ships (per-channel int8, grouped int8,
+    int4 nibbles)."""
+    from paddle_tpu.ops.pallas.decode_block import _MATMUL_NAMES
+    from paddle_tpu.quantization import ServeQuantConfig
+    from paddle_tpu.quantization.serve import _quantize_matrix
+    spec, lp, x, pk, pv, blk, off, bt, mask, cos, sin = _case(
+        "llama_gqa", np.float32)
+    qspec = DecodeBlockSpec(
+        hidden=spec.hidden, num_heads=spec.num_heads,
+        kv_heads=spec.kv_heads, head_dim=spec.head_dim,
+        block_size=spec.block_size, norm=spec.norm,
+        activation=spec.activation, eps=spec.eps, rope=spec.rope,
+        weight_dtype=wd, group_size=gs)
+    qc = ServeQuantConfig(weight_dtype=wd, group_size=gs)
+    qlp = {}
+    for n, v in lp.items():
+        if n in _MATMUL_NAMES:
+            q, s = _quantize_matrix(np.asarray(v, np.float32), qc)
+            qlp[n + "__q"], qlp[n + "__s"] = jnp.asarray(q), jnp.asarray(s)
+        else:
+            qlp[n] = v
+    assert unsupported_reason(qspec, qlp, pk, x.shape[1]) is None
+    old = FLAGS.pallas_interpret
+    set_flags({"pallas_interpret": True})
+    try:
+        a = prefill_block(x, qlp, pk, pv, blk, off, bt, mask, cos, sin,
+                          spec=qspec, start=5, backend="pallas")
+    finally:
+        set_flags({"pallas_interpret": old})
+    b = prefill_block(x, qlp, pk, pv, blk, off, bt, mask, cos, sin,
+                      spec=qspec, start=5, backend="xla")
+    for g, r in zip(a, b):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_int8_kv_pool_pallas_matches_xla():
+    """Quantized pool: the kernel dequantizes staged pages with their
+    scales AND quantize-roundtrips the in-chunk k/v exactly as the
+    XLA tier's scatter-then-gather does; the host-side scatter writes
+    identical codes."""
+    spec, lp, x, pk, pv, blk, off, bt, mask, cos, sin = _case(
+        "llama_gqa", np.float32)
+    pk = QuantizedKVPool(*quantize_kv(pk))
+    pv = QuantizedKVPool(*quantize_kv(pv))
+    assert is_quantized_pool(pk)
+    old = FLAGS.pallas_interpret
+    set_flags({"pallas_interpret": True})
+    try:
+        a, ak, av = prefill_block(x, lp, pk, pv, blk, off, bt, mask,
+                                  cos, sin, spec=spec, start=5,
+                                  backend="pallas")
+    finally:
+        set_flags({"pallas_interpret": old})
+    b, bk, bv = prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos,
+                              sin, spec=spec, start=5, backend="xla")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-5)
+    # identical int8 codes and scales in the committed pool
+    np.testing.assert_array_equal(np.asarray(ak.data), np.asarray(bk.data))
+    np.testing.assert_array_equal(np.asarray(av.data), np.asarray(bv.data))
+    np.testing.assert_allclose(np.asarray(ak.scale), np.asarray(bk.scale),
+                               rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# autotune
+# ---------------------------------------------------------------------------
+def test_autotune_cache_roundtrip(tmp_path):
+    from paddle_tpu.ops.pallas import autotune
+    spec, lp, x, pk, pv, blk, off, bt, mask, cos, sin = _case(
+        "llama_gqa", np.float32)
+    path = tmp_path / "at.json"
+    old = FLAGS.pallas_interpret
+    set_flags({"use_autotune": True, "autotune_cache_file": str(path),
+               "pallas_interpret": True})
+    try:
+        autotune.clear_cache()
+        out = tune_prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos,
+                                 sin, spec=spec, start=5)
+        key = (x.shape[1], spec.hidden, spec.num_heads, spec.kv_heads,
+               spec.head_dim, spec.block_size, bt.shape[0],
+               spec.activation, str(pk.dtype), None, -1)
+        won = autotune.lookup("prefill_block", key, None)
+        assert won is not None and int(won) >= 1
+        # the winner persisted to disk for later processes
+        with open(path) as f:
+            on_disk = json.load(f)
+        assert any(k.startswith("prefill_block|") for k in on_disk), \
+            on_disk
+        assert int(won) in [int(v) for k, v in on_disk.items()
+                            if k.startswith("prefill_block|")]
+        ref = prefill_block(x, lp, pk, pv, blk, off, bt, mask, cos, sin,
+                            spec=spec, start=5, backend="xla")
+        np.testing.assert_allclose(np.asarray(out[0]),
+                                   np.asarray(ref[0]), rtol=1e-5,
+                                   atol=1e-5)
+    finally:
+        set_flags({"use_autotune": False, "autotune_cache_file": "",
+                   "pallas_interpret": old})
+        autotune.clear_cache()
+
+
+# ---------------------------------------------------------------------------
+# engine / serve-path bit-identity (the acceptance pins)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_serving():
+    from paddle_tpu import parallel as dist
+    from paddle_tpu.models.llama import build_llama_train_step, llama_tiny
+    from paddle_tpu.parallel.topology import HybridTopology, set_topology
+    cfg = llama_tiny()
+    topo = dist.init_topology(devices=jax.devices()[:1])
+    _, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1)
+    params = init_fn(0)["params"]
+    set_topology(HybridTopology())
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 9, 17)]
+    return cfg, params, prompts
+
+
+def _engine(cfg, params, fused, spec=False, **kw):
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    spec_config = None
+    if spec:
+        from paddle_tpu.spec_decode import SpecDecodeConfig
+        spec_config = SpecDecodeConfig(draft_cfg=cfg, draft_params=params,
+                                       k=2, window=8)
+    return ContinuousBatchingEngine(
+        cfg, params, max_batch=2, block_size=8, num_blocks=64,
+        fused_prefill=fused, spec_config=spec_config, **kw)
+
+
+def _drain(eng, prompts, sampled=False):
+    for i, p in enumerate(prompts):
+        eng.add_request(p, 6,
+                        temperature=0.7 if (sampled and i == 1) else 0.0,
+                        top_k=8 if (sampled and i == 1) else None,
+                        seed=i)
+    out = eng.run_to_completion()
+    rep = eng.kv_leak_report()
+    assert rep["leaked"] == 0 and rep["unaccounted"] == 0, rep
+    return out
+
+
+def test_engine_greedy_bit_identity_fused_on_off(tiny_serving):
+    cfg, params, prompts = tiny_serving
+    a = _drain(_engine(cfg, params, fused=True), prompts, sampled=True)
+    b = _drain(_engine(cfg, params, fused=False), prompts, sampled=True)
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_frontend_stream_bit_identity_fused_on_off(tiny_serving):
+    from paddle_tpu.serving import ServingFrontend
+    cfg, params, prompts = tiny_serving
+
+    def stream(fused):
+        fe = ServingFrontend(_engine(cfg, params, fused=fused))
+        handles = [fe.submit(p, max_new_tokens=6) for p in prompts]
+        return [list(h) for h in handles]
+
+    assert stream(True) == stream(False)
+
+
+def test_spec_decode_bit_identity_on_fused_prefill(tiny_serving):
+    """Greedy speculative output must stay bit-identical to baseline
+    decode — fused prefill on and off, spec on and off: all four
+    agree."""
+    cfg, params, prompts = tiny_serving
+    runs = {(fused, spec): _drain(_engine(cfg, params, fused=fused,
+                                          spec=spec), prompts)
+            for fused in (True, False) for spec in (True, False)}
+    base = runs[(False, False)]
+    for key, out in runs.items():
+        assert set(out) == set(base), key
+        for k in base:
+            np.testing.assert_array_equal(out[k], base[k],
+                                          err_msg=str(key))
+
+
+def test_http_sse_wire_bit_identity_fused_on_off(tiny_serving):
+    """The wire pin: token streams served over real localhost HTTP/SSE
+    from a fused-prefill engine == the unfused in-process engine."""
+    from paddle_tpu.serving import HttpServingServer, ServingFrontend
+    from paddle_tpu.serving.http import iter_sse
+    import http.client
+    cfg, params, prompts = tiny_serving
+    ref_eng = _engine(cfg, params, fused=False)
+    rids = [ref_eng.add_request(p, 6) for p in prompts[:2]]
+    ref = ref_eng.run_to_completion()
+
+    fe = ServingFrontend(_engine(cfg, params, fused=True))
+    srv = HttpServingServer(fe, heartbeat_s=0.1)
+    with srv:
+        for rid, p in zip(rids, prompts[:2]):
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=120.0)
+            try:
+                conn.request("POST", "/v1/generate",
+                             json.dumps({"prompt_ids": p.tolist(),
+                                         "max_new_tokens": 6}),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 200, resp.read()
+                toks, done = {}, None
+                for event, data in iter_sse(resp):
+                    if event == "token":
+                        toks[data["i"]] = data["t"]
+                    else:
+                        done = (event, data)
+                        break
+            finally:
+                conn.close()
+            assert done is not None and done[0] == "done" \
+                and done[1]["state"] == "FINISHED"
+            full = np.concatenate(
+                [p, np.asarray([toks[i] for i in sorted(toks)],
+                               np.int32)])
+            np.testing.assert_array_equal(full, ref[rid])
+        rep = fe.engine.kv_leak_report()
+        assert rep["leaked"] == 0 and rep["unaccounted"] == 0, rep
+
+
+def test_prefix_cache_suffix_fill_bit_identity(tiny_serving):
+    """A prefix-cache hit runs ONLY the suffix through the chunk fill
+    (start > 0) — the path the megakernel's committed-page pass serves.
+    Hits must stay bit-identical with fusion on and off."""
+    cfg, params, _ = tiny_serving
+    base = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    suffixes = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+                for n in (5, 9)]
+
+    def run(fused):
+        eng = _engine(cfg, params, fused=fused)
+        warm = eng.add_request(np.concatenate([base, suffixes[0]]), 6)
+        out = {warm: eng.run_to_completion()[warm]}
+        hits = [eng.add_request(np.concatenate([base, s]), 6)
+                for s in suffixes]
+        res = eng.run_to_completion()
+        out.update({r: res[r] for r in hits})
+        assert eng.stats["prefix_blocks_reused"] >= 2, eng.stats
+        rep = eng.kv_leak_report()
+        assert rep["leaked"] == 0 and rep["unaccounted"] == 0, rep
+        return out
+
+    a, b = run(True), run(False)
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_aot_warm_start_covers_prefill_knob(tiny_serving, tmp_path):
+    """The artifact config hash covers ``fused_prefill``: a fused
+    export warm starts a fused engine bit-identically, and an engine
+    with the knob FLIPPED refuses the artifact (no half-warm fused
+    engine serving unfused-compiled programs or vice versa)."""
+    from paddle_tpu.aot.serve import export_engine
+    cfg, params, prompts = tiny_serving
+    eng = _engine(cfg, params, fused=True, prefill_buckets=(8,))
+    export_engine(eng, str(tmp_path))
+    warm = _engine(cfg, params, fused=True, prefill_buckets=(8,),
+                   aot_dir=str(tmp_path))
+    assert warm.aot_loaded
+    a = _drain(warm, prompts)
+    b = _drain(_engine(cfg, params, fused=True, prefill_buckets=(8,)),
+               prompts)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+    cold = _engine(cfg, params, fused=False, prefill_buckets=(8,),
+                   aot_dir=str(tmp_path))
+    assert not cold.aot_loaded
+    assert cold.aot_error is not None

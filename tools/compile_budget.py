@@ -422,6 +422,74 @@ def serve_prefix_warm() -> Callable[[], None]:
     return workload
 
 
+def serve_prefill_warm() -> Callable[[], None]:
+    """Fused chunked prefill on a warm engine (ISSUE 18): the
+    fused_prefill=True export (the engine's default chunk-fill path)
+    warm-starts an engine that serves bucketed fills at several prompt
+    lengths (greedy AND sampled), a prefix-cache hit running ONLY the
+    suffix through the chunk fill, and one explicit preempt/restore —
+    ZERO backend compiles.  The knob is covered by the engine_config
+    hash: a flipped-knob engine REFUSES the artifact instead of
+    half-warming (checked in setup)."""
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu.aot.serve import export_engine
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    cfg, params, prompts = _tiny_llama()
+    aot_dir = tempfile.mkdtemp(prefix="aot_budget_prefill_")
+    export_engine(_engine(cfg, params), aot_dir)
+    flipped = ContinuousBatchingEngine(
+        cfg, params, max_batch=2, block_size=8, num_blocks=64,
+        prefill_buckets=(8,), aot_dir=aot_dir, fused_prefill=False)
+    if flipped.aot_loaded or flipped.aot_error is None:
+        raise RuntimeError(
+            "a flipped fused_prefill knob accepted the fused artifact")
+
+    def workload():
+        from paddle_tpu.serving.prefix_cache import PrefixCacheConfig
+
+        eng = ContinuousBatchingEngine(
+            cfg, params, max_batch=2, block_size=8, num_blocks=64,
+            prefill_buckets=(8,), aot_dir=aot_dir,
+            prefix_cache_config=PrefixCacheConfig(
+                offload_capacity_bytes=1 << 24))
+        rng = np.random.default_rng(18)
+        # bucketed fills: single-chunk and multi-chunk prompt lengths
+        for i, p in enumerate(prompts):
+            eng.add_request(p, 4, temperature=0.7 if i == 1 else 0.0,
+                            top_k=8 if i == 1 else None, seed=i)
+        eng.run_to_completion()
+        # prefix-cache hit: ONLY the suffix runs through the chunk fill
+        shared = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+        tail = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+        eng.add_request(np.concatenate([shared, tail]), 4)
+        eng.run_to_completion()
+        eng.add_request(np.concatenate([shared, tail[:3]]), 4)
+        eng.run_to_completion()
+        if eng.prefix_stats()["hits"] < 1:
+            raise RuntimeError("scenario never hit the prefix cache")
+        # one preempt/restore: the replay prefill re-runs the committed
+        # prefix through the same warm bucketed fills
+        eng.add_request(prompts[2], 6)
+        eng.step()
+        eng.preempt(0)
+        eng.run_to_completion()
+        rs = eng.resilience_stats()
+        if rs["preemptions"] < 1 or rs["restores"] < 1:
+            raise RuntimeError(
+                f"scenario never preempted/restored: {rs}")
+        rep = eng.kv_leak_report()
+        if rep["leaked"] or rep["unaccounted"]:
+            raise RuntimeError(f"scenario leaked KV blocks: {rep}")
+        if not eng.aot_loaded:
+            raise RuntimeError(f"warm start fell back: {eng.aot_error}")
+
+    return workload
+
+
 def serve_quant_warm() -> Callable[[], None]:
     """Quantized serving on a warm engine (ISSUE 16): int8 weight-only
     matmuls + int8 paged-KV pool (per-token scales), warm-started from
@@ -563,6 +631,7 @@ SCENARIOS: Dict[str, Callable[[], Callable[[], None]]] = {
     "fleet_warm": fleet_warm,
     "serve_http_warm": serve_http_warm,
     "serve_prefix_warm": serve_prefix_warm,
+    "serve_prefill_warm": serve_prefill_warm,
     "serve_quant_warm": serve_quant_warm,
     "train_elastic_warm": train_elastic_warm,
 }
@@ -618,9 +687,13 @@ def render_md(counts: Dict[str, int]) -> str:
         "drain, serving real sockets through the HTTP front door with "
         "a mid-stream disconnect and a graceful shutdown, serving "
         "shared-prefix traffic through the cross-request prefix cache "
-        "with hits, an eviction-to-offload, and an offload restore, or "
+        "with hits, an eviction-to-offload, and an offload restore, "
         "serving int8-quantized weights and KV pages end-to-end with a "
-        "preempt/restore through the codes+scales spill format.  "
+        "preempt/restore through the codes+scales spill format, or — "
+        "`serve_prefill_warm`, the ISSUE 18 row — serving the fused "
+        "chunked-prefill path (the `fused_prefill` knob, covered by "
+        "the artifact config hash) through bucketed fills, a "
+        "prefix-cache suffix fill, and a preempt/restore.  "
         "`train_elastic_warm` is the ISSUE 17 training-side row: an "
         "elastic trainer resumed at a previously-seen mesh — and "
         "reshaped by a worker kill onto an already-exported survivor "
