@@ -1,10 +1,13 @@
 """Static analysis: tracelint (TL — trace safety for jit/shard_map/
 donation code) + kernellint (KL — Pallas-kernel safety on the shared
-VMEM cost model in ``analysis/kernel/cost.py``).
+VMEM cost model in ``analysis/kernel/cost.py``) + locklint (LK —
+thread/lock safety on the thread-role model in
+``analysis/threads/model.py``).
 
-``python -m paddle_tpu.analysis`` runs both; ``--select KL`` is the
-kernel lane.  Rule catalogues in ``docs/static_analysis.md``;
-committed debt ledgers in TRACELINT.md / KERNELLINT.md (both empty).
+``python -m paddle_tpu.analysis`` runs all three; ``--select KL`` is
+the kernel lane, ``--select LK`` the concurrency lane.  Rule
+catalogues in ``docs/static_analysis.md``; committed debt ledgers in
+TRACELINT.md / KERNELLINT.md / LOCKLINT.md (all empty).
 """
 
 from .core import (Finding, Module, Rule, all_rules, collect_files,

@@ -118,6 +118,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         regressions = baseline_mod.compare(
             baseline_mod.counts(findings), base)
 
+    # label the summary line by lane: a single-tool --select prints
+    # that tool's name, anything mixed keeps the engine's default
+    tools = {prefix: tool for _, prefix, tool in baseline_mod.LEDGERS}
+    prefixes = {rid[:2] for rid in select} if select else set()
+    label = tools.get(prefixes.pop(), "tracelint") if len(prefixes) == 1 \
+        else "tracelint"
+
     if args.as_json:
         payload = {
             "version": 1,
@@ -134,11 +141,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f.format())
         n = len(findings)
         if regressions is None:
-            print(f"tracelint: {n} finding{'s' if n != 1 else ''}")
+            print(f"{label}: {n} finding{'s' if n != 1 else ''}")
         else:
             names = ", ".join(os.path.relpath(p, core.repo_root())
                               for p in base_paths)
-            print(f"tracelint: {n} finding{'s' if n != 1 else ''}, "
+            print(f"{label}: {n} finding{'s' if n != 1 else ''}, "
                   f"{len(regressions)} above baseline ({names})")
             for r in regressions:
                 print(f"  ABOVE BASELINE: {r}")

@@ -17,13 +17,14 @@ runtime only pays for later (recompiles, silent staleness, donation
 corruption), which is exactly why it is checked at review time.
 
 Suppressions use one syntax everywhere (including the NOTIMPL backend
-and the KL kernel rules; ``kernellint:`` is an accepted alias for KL
-suppressions so kernel files read naturally):
+and the KL kernel rules; ``kernellint:`` / ``locklint:`` are accepted
+aliases for KL / LK suppressions so those files read naturally):
 
 * ``# tracelint: disable=TL001,TL004`` on the finding's line
 * ``# tracelint: disable`` on the line — every rule
 * ``# tracelint: disable-file=TL006`` anywhere — whole file
-* ``# kernellint: disable=KL006`` — same semantics, either spelling
+* ``# kernellint: disable=KL006`` — same semantics, any spelling
+* ``# locklint: disable=LK005`` — same semantics, any spelling
 
 A suppression should carry a justification in the same comment or the
 line above; ``docs/static_analysis.md`` documents the convention.
@@ -53,10 +54,10 @@ TRACE_WRAPPERS = {
 }
 
 _SUPPRESS_RE = re.compile(
-    r"#\s*(?:tracelint|kernellint):\s*disable(?:-file)?\s*"
+    r"#\s*(?:tracelint|kernellint|locklint):\s*disable(?:-file)?\s*"
     r"(?:=\s*([A-Z0-9, ]+))?")
 _SUPPRESS_FILE_RE = re.compile(
-    r"#\s*(?:tracelint|kernellint):\s*disable-file\s*=\s*([A-Z0-9, ]+)")
+    r"#\s*(?:tracelint|kernellint|locklint):\s*disable-file\s*=\s*([A-Z0-9, ]+)")
 
 
 def repo_root() -> str:
@@ -207,7 +208,8 @@ class Module:
         line_dis: Dict[int, Optional[Set[str]]] = {}
         file_dis: Set[str] = set()
         for i, text in enumerate(self.lines, start=1):
-            if "tracelint" not in text and "kernellint" not in text:
+            if "tracelint" not in text and "kernellint" not in text \
+                    and "locklint" not in text:
                 continue
             mf = _SUPPRESS_FILE_RE.search(text)
             if mf:

@@ -24,3 +24,12 @@ from ..kernel import kl003_masking     # noqa: F401
 from ..kernel import kl004_accum       # noqa: F401
 from ..kernel import kl005_autotune    # noqa: F401
 from ..kernel import kl006_parity      # noqa: F401
+
+# locklint (LK) rules live beside their thread-role model in ../threads;
+# same engine, same suppression syntax, a separate LOCKLINT.md ledger.
+from ..threads import lk001_shared_state  # noqa: F401
+from ..threads import lk002_blocking      # noqa: F401
+from ..threads import lk003_lock_order    # noqa: F401
+from ..threads import lk004_cv_wait       # noqa: F401
+from ..threads import lk005_finalizers    # noqa: F401
+from ..threads import lk006_thread_leak   # noqa: F401

@@ -1,0 +1,36 @@
+"""locklint (LK) — static concurrency safety for the threaded surface.
+
+The serving arc (PRs 7, 11-13) turned the repo into a multi-threaded
+system: frontend driver threads, HTTP handler threads, housekeeper and
+shutdown threads, the AsyncCheckpointer writer, device/host
+prefetchers, elastic heartbeat loops.  Every one of those PRs fixed at
+least one hand-found threading bug; locklint machine-checks the
+invariants those fixes established, the way tracelint checks trace
+purity and kernellint checks Pallas kernels.
+
+``model.py`` builds the shared facts per module — lock definitions
+(``self._lock = threading.Lock()``), thread roles (entry points from
+``threading.Thread(target=...)``, handler-class methods, ``__del__``/
+``atexit`` finalizers), per-scope held-lock tracking through nested
+``with lock:`` blocks, and the project-wide lock-acquisition-order
+graph — and the six LK rules hang off it:
+
+* LK001 — shared mutable attribute written from ≥2 thread roles with
+  no common lock
+* LK002 — blocking call under a held lock (the PR 13 "driver thread
+  never touches a socket" invariant, generalized)
+* LK003 — lock-acquisition-order cycle in the project-wide graph
+* LK004 — condition-variable ``wait`` not guarded by a ``while`` loop
+* LK005 — finalizer touching locked state or joining threads
+* LK006 — thread started without a reachable ``join`` on shutdown
+
+Suppress with ``# locklint: disable=LKxxx`` plus a justification; the
+debt ledger is ``LOCKLINT.md`` (empty — any finding is above
+baseline).  The LK003 graph is validated by execution through
+``observability.traced_lock.TracedLock`` (see tests/test_locklint.py),
+the way KL001's cost model is validated by interpret-mode byte capture.
+"""
+
+from . import model  # noqa: F401
+
+__all__ = ["model"]

@@ -1,0 +1,71 @@
+"""LK001 — shared mutable attribute written from ≥2 thread roles with
+no common lock.
+
+The bug class behind most of the hand-found serving races: an instance
+attribute that both a background thread (driver, worker, housekeeper)
+and an externally-driven caller write, with no lock covering both
+sides.  Under the GIL a single reference store is atomic, but
+read-modify-write sequences (``+=``, swap-and-clear, flag check →
+assign) interleave freely — the exact shape of the lost-exception race
+the device prefetcher shipped with (fixed in this PR, regression test
+in tests/test_locklint.py).
+
+Writes inside ``__init__`` are construction-time (happens-before
+publication) and don't count.  The finalizer role is discounted here:
+``__del__`` ordering hazards are LK005's domain, and counting it would
+flag every ``__del__ → close()`` teardown path twice.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from .. import core
+from . import model
+
+_SETUP = {"__init__", "__new__", "__post_init__", "__init_subclass__"}
+
+
+@core.register
+class SharedStateRule(core.Rule):
+    id = "LK001"
+    name = "unlocked-shared-state"
+    severity = "error"
+    doc = ("instance attribute written from two or more thread roles "
+           "with no lock held in common across the write sites")
+    hint = ("guard every write with one lock (the owning object's), or "
+            "confine the attribute to a single thread role; suppress "
+            "with '# locklint: disable=LK001' + justification if the "
+            "writes are provably ordered another way")
+
+    def check(self, module: core.Module):
+        mm = model.get_model(module)
+        grouped: Dict[Tuple[str, str], List[model.WriteSite]] = {}
+        for w in mm.writes:
+            if w.attr.isupper():
+                continue
+            fname = getattr(w.func, "name", "") if w.func is not None else ""
+            if fname in _SETUP:
+                continue
+            grouped.setdefault((w.cls, w.attr), []).append(w)
+        for (cls, attr), sites in sorted(grouped.items()):
+            roles = set()
+            lock_sets = []
+            witnesses = []
+            for s in sites:
+                site_roles = mm.roles_of(s.func) - {model.ROLE_FINALIZER}
+                if not site_roles:
+                    continue                  # finalizer-only path
+                roles |= site_roles
+                lock_sets.append({ref.id for ref in s.held})
+                witnesses.append(s)
+            if len(roles) < 2 or not witnesses:
+                continue
+            if set.intersection(*lock_sets):
+                continue                      # one lock covers every write
+            first = min(witnesses, key=lambda s: getattr(s.node, "lineno", 1))
+            yield self.finding(
+                module, first.node,
+                f"'{cls}.{attr}' is written from thread roles "
+                f"{{{', '.join(sorted(roles))}}} with no common lock "
+                f"({len(witnesses)} write sites)")

@@ -1,0 +1,126 @@
+"""LK002 — blocking call under a held lock.
+
+The PR 13 invariant ("the driver thread never touches a socket")
+generalized: while a lock is held, nothing unbounded may block — a
+slow peer, a full queue, or a stuck engine step turns lock contention
+into a system-wide stall, and if the blocked operation needs another
+thread that wants the same lock, into a deadlock.  The serving stack's
+``_Delivery`` pattern (mutate handles OUTSIDE the scheduler lock) and
+``stream_from``'s lock-released yields exist precisely to satisfy this.
+
+Flagged while ≥1 lock is held:
+
+* ``time.sleep`` / ``socket.create_connection``
+* socket ops (``sendall``/``recv``/``recvfrom``/``accept``; ``read``/
+  ``readline``/``write``/``flush`` on ``rfile``/``wfile``/``sock``/
+  ``conn`` receivers)
+* ``engine.step`` — one step is an unbounded device round-trip
+* ``block_until_ready``
+* ``queue.get()`` / ``queue.put(item)`` on a known ``queue.Queue``
+  attribute, with no timeout
+* ``.join()`` on a known thread attribute, with no timeout
+* ``Event.wait()`` with no timeout
+
+A ``Condition.wait`` under its *own* condition is the CV idiom (wait
+releases the lock) and is LK004's domain, not a finding here.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import core
+from . import model
+
+_SOCKET_METHODS = {"sendall", "recv", "recvfrom", "accept"}
+_SOCKET_FILE_METHODS = {"read", "readline", "write", "flush"}
+_SOCKET_RECEIVERS = {"rfile", "wfile", "sock", "conn", "connection"}
+
+
+def _has_timeout(call: ast.Call, max_pos: int) -> bool:
+    """True if the call passes a timeout (kwarg, or enough positional
+    args to reach the timeout slot)."""
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    return len(call.args) >= max_pos
+
+
+def _self_attr(fn: ast.AST) -> str:
+    """``self.X.m`` -> ``X`` (else '')."""
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Attribute) \
+            and isinstance(fn.value.value, ast.Name) \
+            and fn.value.value.id == "self":
+        return fn.value.attr
+    return ""
+
+
+def blocking_reason(mm: model.ModuleModel, site: model.CallSite) -> str:
+    """Why this call is unbounded-blocking, or '' if it isn't."""
+    call = site.node
+    fn = call.func
+    tail = core.tail_name(fn)
+    resolved = mm.module.resolve(fn)
+    if resolved == "time.sleep":
+        return "time.sleep"
+    if tail == "create_connection" and resolved.startswith("socket."):
+        return "socket.create_connection"
+    if tail in _SOCKET_METHODS:
+        return f"socket .{tail}"
+    recv_tail = core.tail_name(fn.value) if isinstance(fn, ast.Attribute) \
+        else ""
+    if tail in _SOCKET_FILE_METHODS and recv_tail in _SOCKET_RECEIVERS:
+        return f"socket file .{tail} on '{recv_tail}'"
+    if tail == "connect" and recv_tail in _SOCKET_RECEIVERS:
+        return "socket .connect"
+    if tail == "step" and recv_tail == "engine":
+        return "engine.step (unbounded device round-trip)"
+    if tail == "block_until_ready":
+        return "block_until_ready"
+    cm = mm.classes.get(site.cls)
+    attr = _self_attr(fn)
+    if cm is not None and attr:
+        if tail == "get" and attr in cm.queue_attrs \
+                and not _has_timeout(call, max_pos=2):
+            return f"queue .get() on 'self.{attr}' with no timeout"
+        if tail == "put" and attr in cm.queue_attrs \
+                and not _has_timeout(call, max_pos=3):
+            return f"queue .put() on 'self.{attr}' with no timeout"
+        if tail == "join" and attr in cm.thread_attrs \
+                and not _has_timeout(call, max_pos=1):
+            return f"thread .join() on 'self.{attr}' with no timeout"
+        if tail == "wait" and attr in cm.event_attrs \
+                and not _has_timeout(call, max_pos=1):
+            return f"Event .wait() on 'self.{attr}' with no timeout"
+    return ""
+
+
+@core.register
+class BlockingUnderLockRule(core.Rule):
+    id = "LK002"
+    name = "blocking-under-lock"
+    severity = "error"
+    doc = ("unbounded blocking call (socket, sleep, engine.step, "
+           "no-timeout queue/join/wait) while a lock is held")
+    hint = ("move the blocking call outside the lock (collect work "
+            "under the lock, act after releasing — the _Delivery "
+            "pattern), or bound it with a timeout")
+
+    def check(self, module: core.Module):
+        mm = model.get_model(module)
+        for site in mm.calls:
+            if not site.held:
+                continue
+            # Condition.wait under its own condition: the CV idiom
+            fn = site.node.func
+            if core.tail_name(fn) == "wait" \
+                    and isinstance(fn, ast.Attribute):
+                ref = mm.resolve_lock(fn.value, site.cls)
+                if ref is not None and ref.kind == "condition" \
+                        and any(h.id == ref.id for h in site.held):
+                    continue
+            reason = blocking_reason(mm, site)
+            if reason:
+                held = ", ".join(h.id.split("::")[-1] for h in site.held)
+                yield self.finding(
+                    module, site.node,
+                    f"{reason} while holding [{held}]")

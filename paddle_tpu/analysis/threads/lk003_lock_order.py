@@ -1,0 +1,66 @@
+"""LK003 — lock-acquisition-order cycle in the project-wide graph.
+
+Two threads acquiring the same pair of locks in opposite orders is the
+classic ABBA deadlock; it needs no unlucky timing to be wrong, only to
+exist.  The model builds a directed graph over every lock the
+structure pass can identify: an edge A→B for each ``with B:`` nested
+inside a held A (same function), plus one level of call closure — a
+call made while holding A, resolved to a concrete callee (same-class
+``self.m()``, module function, or ``self.attr.m()`` through an
+annotated attribute type), contributes A→⟨each lock the callee
+acquires at its own top level⟩.  Any strongly-connected component with
+more than one lock is a potential deadlock; each edge inside one is
+reported where it is witnessed.
+
+The same graph is the reference for the runtime cross-check:
+``observability.traced_lock.TracedLock`` records the acquisition
+order a live threaded-serving test actually executes, and the test
+asserts every observed edge is present here (the static graph is an
+over-approximation of execution, never the reverse).
+"""
+
+from __future__ import annotations
+
+import types
+from typing import List, Sequence, Set
+
+from .. import core
+from . import model
+
+
+@core.register
+class LockOrderRule(core.Rule):
+    id = "LK003"
+    name = "lock-order-cycle"
+    severity = "error"
+    doc = ("a cycle in the project-wide lock-acquisition-order graph "
+           "(nested `with` blocks + one level of call closure): two "
+           "threads taking the locks in opposite orders can deadlock")
+    hint = ("pick one global order for the locks involved and acquire "
+            "in that order everywhere, or collapse them into one lock")
+
+    def __init__(self):
+        self._project: model.ProjectModel = None  # set in prepare()
+        self._cyclic: List[Set[str]] = []
+
+    def prepare(self, modules: Sequence[core.Module]) -> None:
+        self._project = model.ProjectModel(modules)
+        self._cyclic = [set(c) for c in self._project.cycles()]
+
+    def check(self, module: core.Module):
+        if self._project is None or not self._cyclic:
+            return
+        for (a, b), (rel, line) in sorted(self._project.edges.items(),
+                                          key=lambda kv: kv[1][1]):
+            if rel != module.rel:
+                continue
+            for comp in self._cyclic:
+                if a in comp and b in comp:
+                    order = " -> ".join(sorted(comp))
+                    yield self.finding(
+                        module,
+                        types.SimpleNamespace(lineno=line, col_offset=0),
+                        f"acquisition edge {a.split('::')[-1]} -> "
+                        f"{b.split('::')[-1]} participates in a "
+                        f"lock-order cycle [{order}]")
+                    break

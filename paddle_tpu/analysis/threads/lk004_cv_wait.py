@@ -1,0 +1,39 @@
+"""LK004 — condition-variable ``wait`` not guarded by a ``while`` loop.
+
+``Condition.wait`` can return spuriously, and a ``notify_all`` can
+wake a thread whose predicate a third thread already consumed — so
+the predicate must be re-checked in a loop, never assumed from the
+wakeup.  ``if not ready: cond.wait()`` is the textbook missed-wakeup
+bug; ``while True: ... cond.wait(t)`` with in-loop re-checks (the
+RequestHandle pattern in serving/frontend.py) is fine, because the
+loop re-evaluates state every iteration.  Only ``while`` counts as a
+guard: a ``for`` body does not re-check a predicate after a wakeup.
+"""
+
+from __future__ import annotations
+
+from .. import core
+from . import model
+
+
+@core.register
+class CvWaitRule(core.Rule):
+    id = "LK004"
+    name = "unguarded-cv-wait"
+    severity = "error"
+    doc = ("Condition.wait() outside a while loop: spurious wakeups "
+           "and consumed notifications make the post-wait state "
+           "unknowable without re-checking the predicate in a loop")
+    hint = ("wrap the wait in 'while not <predicate>: cond.wait(...)' "
+            "(or an equivalent re-checking while loop)")
+
+    def check(self, module: core.Module):
+        mm = model.get_model(module)
+        for w in mm.waits:
+            if w.in_while:
+                continue
+            yield self.finding(
+                module, w.node,
+                f"wait() on condition '{w.lock.cls}.{w.lock.attr}' is "
+                f"not inside a while loop — the woken predicate is "
+                f"never re-checked")

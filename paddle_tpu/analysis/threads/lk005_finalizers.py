@@ -1,0 +1,102 @@
+"""LK005 — finalizer touching locked state or joining threads.
+
+``__del__`` (and ``atexit`` handlers) run at an arbitrary point in an
+arbitrary thread — possibly during interpreter shutdown when module
+globals are already torn down, possibly while another thread holds the
+very lock the finalizer wants.  A finalizer that acquires locks, joins
+threads, or does queue handoff is therefore a shutdown race by
+construction.  This formalizes what six TL006-suppressed
+``except Exception: pass`` blocks used to stand in for: the sites that
+*deliberately* run a best-effort ``close()`` from ``__del__`` now
+carry an explicit ``# locklint: disable=LK005`` with a per-site
+justification, instead of hiding behind the broad-except suppression.
+
+The walk is transitive through the model's resolved call targets (the
+``__del__ → close() → join`` chain), matching how the roles propagate.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from .. import core
+from . import model
+
+
+def _finalizer_hazard(mm: model.ModuleModel,
+                      entry: ast.AST) -> Optional[str]:
+    """First hazard reachable from ``entry`` (a finalizer function),
+    or None."""
+    reached: Set[int] = set()
+    frontier = [entry]
+    while frontier:
+        f = frontier.pop()
+        if id(f) in reached:
+            continue
+        reached.add(id(f))
+        frontier.extend(mm.call_targets(id(f)))
+    for acq in mm.acquisitions:
+        if acq.func is not None and id(acq.func) in reached:
+            owner = f"{acq.lock.cls}.{acq.lock.attr}" if acq.lock.cls \
+                else acq.lock.attr
+            return f"acquires lock '{owner}'"
+    for site in mm.calls:
+        if site.func is None or id(site.func) not in reached:
+            continue
+        fn = site.node.func
+        tail = core.tail_name(fn)
+        if tail not in ("join", "put", "get"):
+            continue
+        if not (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Attribute)
+                and isinstance(fn.value.value, ast.Name)
+                and fn.value.value.id == "self"):
+            continue
+        attr = fn.value.attr
+        cm = mm.classes.get(site.cls)
+        if cm is None:
+            continue
+        if tail == "join" and attr in cm.thread_attrs:
+            return f"joins thread 'self.{attr}'"
+        if tail in ("put", "get") and attr in cm.queue_attrs:
+            return f"does queue .{tail}() on 'self.{attr}'"
+    return None
+
+
+@core.register
+class FinalizerRule(core.Rule):
+    id = "LK005"
+    name = "finalizer-touches-locked-state"
+    severity = "warning"
+    doc = ("__del__ / atexit finalizer (transitively) acquires locks, "
+           "joins threads, or does queue handoff — a shutdown race: "
+           "finalizers run at arbitrary points in arbitrary threads, "
+           "possibly after module teardown")
+    hint = ("prefer explicit close()/context-manager lifecycles; if "
+            "the __del__ is a deliberate best-effort backstop, keep it "
+            "idempotent + exception-swallowing and suppress with "
+            "'# locklint: disable=LK005' + a per-site justification")
+
+    def check(self, module: core.Module):
+        mm = model.get_model(module)
+        for cm in mm.classes.values():
+            fin = cm.methods.get("__del__")
+            if fin is None:
+                continue
+            hazard = _finalizer_hazard(mm, fin)
+            if hazard:
+                yield self.finding(
+                    module, fin,
+                    f"'{cm.name}.__del__' {hazard} — finalizers race "
+                    f"interpreter shutdown and every other thread")
+        for name in sorted(mm.atexit_targets):
+            fn = mm.module.functions.get(name)
+            if fn is None:
+                continue
+            hazard = _finalizer_hazard(mm, fn)
+            if hazard:
+                yield self.finding(
+                    module, fn,
+                    f"atexit handler '{name}' {hazard} — atexit runs "
+                    f"during interpreter shutdown")

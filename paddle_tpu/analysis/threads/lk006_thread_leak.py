@@ -1,0 +1,65 @@
+"""LK006 — thread started without a reachable join on the shutdown
+path.
+
+A thread nobody joins outlives the object that started it: close()
+returns while the worker still runs, tests leak threads into each
+other, and daemon threads get killed mid-write at interpreter exit.
+Every long-lived thread in this codebase pairs its ``start()`` with a
+``join`` somewhere on the owner's shutdown path (``stop()``/
+``close()``), usually with a bounded timeout; this rule checks the
+pairing exists.
+
+Resolution is lexical within the module: a thread bound to ``self.X``
+needs a ``self.X.join(...)`` (or ``t = self.X; t.join(...)`` — the
+single-assignment alias the model tracks), a local binding needs a
+join on that name, and an unbound ``threading.Thread(...).start()``
+can never be joined at all.  Deliberate fire-and-forget threads (a
+signal-triggered shutdown thread that must not be waited on) get a
+justified ``# locklint: disable=LK006``.
+"""
+
+from __future__ import annotations
+
+from .. import core
+from . import model
+
+
+@core.register
+class ThreadLeakRule(core.Rule):
+    id = "LK006"
+    name = "unjoined-thread"
+    severity = "warning"
+    doc = ("threading.Thread created with no join() on its binding "
+           "anywhere in the module: the shutdown path cannot wait for "
+           "it, so it leaks past close()")
+    hint = ("keep a reference and join it (bounded timeout) from the "
+            "owner's stop()/close(); suppress with "
+            "'# locklint: disable=LK006' + justification for "
+            "deliberate fire-and-forget threads")
+
+    def check(self, module: core.Module):
+        mm = model.get_model(module)
+        # attribute binds match joins by trailing attribute name too:
+        # `srv._serve_thread = Thread(...)` is cleared by a
+        # `self._serve_thread.join()` elsewhere in the module — the
+        # receiver spelling differs across methods but the slot is one
+        join_tails = {t.rsplit(".", 1)[-1] for t in mm.join_targets}
+        for ts in mm.threads:
+            if ts.bind and ts.bind in mm.join_targets:
+                continue
+            if "." in ts.bind \
+                    and ts.bind.rsplit(".", 1)[-1] in join_tails:
+                continue
+            role, target = mm._thread_role(ts.node)
+            what = f"thread '{role[7:]}'" if role != "thread:anonymous" \
+                else "thread"
+            if not ts.bind:
+                yield self.finding(
+                    module, ts.node,
+                    f"{what} is started without binding the Thread "
+                    f"object — it can never be joined")
+            else:
+                yield self.finding(
+                    module, ts.node,
+                    f"{what} bound to '{ts.bind}' is never joined in "
+                    f"this module — no shutdown path waits for it")

@@ -1,0 +1,815 @@
+"""Shared thread-role / lock model the LK rules hang off.
+
+Built once per :class:`~paddle_tpu.analysis.core.Module` (cached by
+module identity) in two passes:
+
+1. **Structure pass** — per class: which ``self.X`` attributes hold
+   locks (``threading.Lock/RLock/Condition/Semaphore``), threads,
+   queues, events; which attributes carry a known class type (from
+   ``self.X = param`` where the ``__init__`` parameter is annotated, or
+   ``self.X = ClassName(...)``); plus module-level lock variables and
+   handler classes (bases named ``*RequestHandler`` / ``ThreadingMixIn``).
+
+2. **Semantic pass** — a context-carrying recursive walk recording, for
+   every statement, the stack of held locks (entered ``with lock:``
+   blocks), and from it: lock acquisitions (with the held stack at
+   entry — the edges of the LK003 order graph), call sites under held
+   locks (LK002 and the one-level call closure), attribute write sites
+   (LK001), condition ``wait`` calls and whether a ``while`` loop
+   guards them (LK004), ``Thread(...)`` creations and ``.join()`` sites
+   (LK006), and ``atexit.register`` targets (LK005).
+
+Thread **roles** are then propagated: ``threading.Thread(target=...)``
+entry points, handler-class methods, and ``__del__``/``atexit``
+finalizers seed their role; every public function seeds ``main`` (any
+externally-driven thread).  Roles flow transitively through bare-name
+calls within the module — the same resolution the tracelint
+reachability pass uses — so a private helper reached only from a
+driver loop carries only the driver's role.
+
+Lock identity is ``<module-rel>::<Class>.<attr>`` (or ``::<name>`` for
+module-level locks) — the same ids ``observability.traced_lock`` uses,
+so the static LK003 graph and the runtime-observed acquisition order
+compare directly.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .. import core
+
+LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition",
+              "Semaphore": "semaphore", "BoundedSemaphore": "semaphore"}
+QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+
+ROLE_MAIN = "main"
+ROLE_HANDLER = "handler"
+ROLE_FINALIZER = "finalizer"
+
+_HANDLER_BASE_HINTS = ("RequestHandler", "ThreadingMixIn")
+
+
+@dataclasses.dataclass(frozen=True)
+class LockRef:
+    """One lock object, identified by where it is defined."""
+    module: str          # repo-relative path of the defining module
+    cls: str             # owning class name, "" for module-level
+    attr: str            # attribute / variable name
+    kind: str            # lock | rlock | condition | semaphore
+
+    @property
+    def id(self) -> str:
+        owner = f"{self.cls}.{self.attr}" if self.cls else self.attr
+        return f"{self.module}::{owner}"
+
+
+@dataclasses.dataclass
+class Acquisition:
+    lock: LockRef
+    node: ast.AST                  # the with-item context expression
+    func: Optional[ast.AST]        # enclosing function (None at module level)
+    held_before: Tuple[LockRef, ...]
+
+
+@dataclasses.dataclass
+class CallSite:
+    node: ast.Call
+    func: Optional[ast.AST]
+    held: Tuple[LockRef, ...]
+    cls: str                       # enclosing class name or ""
+    recv_type: str = ""            # receiver's class-name tail, if typed
+
+
+@dataclasses.dataclass
+class WriteSite:
+    cls: str
+    attr: str
+    node: ast.AST
+    func: Optional[ast.AST]
+    held: Tuple[LockRef, ...]
+
+
+@dataclasses.dataclass
+class WaitSite:
+    lock: LockRef                  # the condition being waited on
+    node: ast.Call
+    func: Optional[ast.AST]
+    held: Tuple[LockRef, ...]
+    in_while: bool                 # a while loop encloses the wait
+
+
+@dataclasses.dataclass
+class ThreadSite:
+    node: ast.Call                 # the threading.Thread(...) call
+    func: Optional[ast.AST]
+    cls: str                       # enclosing class name or ""
+    bind: str                      # "self.X" / "X" / "" (unbound)
+    daemon: bool
+
+
+class ClassModel:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.name = node.name
+        self.lock_attrs: Dict[str, str] = {}     # attr -> kind
+        self.thread_attrs: Set[str] = set()
+        self.queue_attrs: Set[str] = set()
+        self.event_attrs: Set[str] = set()
+        self.attr_types: Dict[str, str] = {}     # attr -> class-name tail
+        self.methods: Dict[str, ast.AST] = {}
+        self.is_handler = any(
+            h in core.tail_name(b) for b in node.bases
+            for h in _HANDLER_BASE_HINTS)
+
+
+def _ctor_tail(value: ast.AST) -> str:
+    if isinstance(value, ast.Call):
+        return core.tail_name(value.func)
+    return ""
+
+
+class ModuleModel:
+    """All LK-relevant facts for one module."""
+
+    def __init__(self, module: core.Module):
+        self.module = module
+        self.classes: Dict[str, ClassModel] = {}
+        self.module_locks: Dict[str, str] = {}         # name -> kind
+        self.acquisitions: List[Acquisition] = []
+        self.calls: List[CallSite] = []
+        self.writes: List[WriteSite] = []
+        self.waits: List[WaitSite] = []
+        self.threads: List[ThreadSite] = []
+        self.join_targets: Set[str] = set()            # "self.X" / "X" joined
+        self.atexit_targets: Set[str] = set()          # bare function names
+        self.func_calls: Dict[int, Set[str]] = {}      # id(func) -> callees
+        # id(func) -> callee keys: ("cls", Class, m) for self/typed-attr
+        # calls resolved in-module, ("name", m) for everything the
+        # receiver leaves open, ("extern",) for calls that provably
+        # leave the module (typed attr of a non-project class)
+        self.func_call_targets: Dict[int, Set[Tuple]] = {}
+        self.func_class: Dict[int, str] = {}           # id(func) -> class name
+        self.func_index: Dict[int, ast.AST] = {}       # id(func) -> node
+        self.nested_funcs: Set[int] = set()            # defs inside a def
+        self._by_name: Dict[str, List[ast.AST]] = {}
+        self.roles: Dict[int, Set[str]] = {}           # id(func) -> roles
+        self.role_of_entry: Dict[int, Set[str]] = {}
+        self._structure_pass()
+        _SemanticWalker(self).walk()
+        for fn in self.func_index.values():
+            self._by_name.setdefault(getattr(fn, "name", ""), []).append(fn)
+        self._propagate_roles()
+
+    # -- structure ------------------------------------------------------
+    def _structure_pass(self) -> None:
+        mod = self.module
+        for node in mod.tree.body:
+            tgt = val = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                tgt, val = node.target, node.value
+            if isinstance(tgt, ast.Name):
+                kind = LOCK_CTORS.get(_ctor_tail(val))
+                if kind:
+                    self.module_locks[tgt.id] = kind
+        for cnode in ast.walk(mod.tree):
+            if not isinstance(cnode, ast.ClassDef):
+                continue
+            cm = ClassModel(cnode)
+            self.classes[cm.name] = cm
+            for sub in cnode.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    cm.methods[sub.name] = sub
+            init = cm.methods.get("__init__")
+            ann: Dict[str, str] = {}
+            if init is not None:
+                for a in list(init.args.args) + list(init.args.kwonlyargs):
+                    if a.annotation is not None:
+                        t = core.tail_name(a.annotation)
+                        if not t and isinstance(a.annotation, ast.Constant) \
+                                and isinstance(a.annotation.value, str):
+                            t = a.annotation.value.split(".")[-1]
+                        if t:
+                            ann[a.arg] = t
+            for m in cm.methods.values():
+                for node in ast.walk(m):
+                    tgt = val = anno = None
+                    if isinstance(node, ast.Assign) \
+                            and len(node.targets) == 1:
+                        tgt, val = node.targets[0], node.value
+                    elif isinstance(node, ast.AnnAssign):
+                        tgt, val, anno = node.target, node.value, \
+                            node.annotation
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        continue
+                    tail = _ctor_tail(val) if val is not None else ""
+                    kind = LOCK_CTORS.get(tail)
+                    if kind:
+                        cm.lock_attrs[tgt.attr] = kind
+                    elif tail == "Thread":
+                        cm.thread_attrs.add(tgt.attr)
+                    elif tail in QUEUE_CTORS:
+                        cm.queue_attrs.add(tgt.attr)
+                    elif tail == "Event":
+                        cm.event_attrs.add(tgt.attr)
+                    elif tail and tail[0].isupper() \
+                            and tgt.attr not in cm.attr_types:
+                        cm.attr_types[tgt.attr] = tail
+                    elif isinstance(val, ast.Name) and val.id in ann:
+                        cm.attr_types[tgt.attr] = ann[val.id]
+                    elif anno is not None \
+                            and tgt.attr not in cm.attr_types:
+                        # `self.x: Dict[...] = {}` — the annotation tail
+                        # types the attribute (Dict/List/... count: they
+                        # prove the receiver is not a project class)
+                        t = core.tail_name(anno)
+                        if not t and isinstance(anno, ast.Subscript):
+                            t = core.tail_name(anno.value)
+                        if t and t[0].isupper():
+                            cm.attr_types[tgt.attr] = t
+
+    # -- lock resolution ------------------------------------------------
+    def resolve_lock(self, expr: ast.AST, cls: str,
+                     project: Optional["ProjectModel"] = None
+                     ) -> Optional[LockRef]:
+        """``self.X`` / module-level ``X`` / ``self.A.B`` (via the
+        annotated type of ``A``) -> LockRef, else None."""
+        rel = self.module.rel
+        if isinstance(expr, ast.Name):
+            kind = self.module_locks.get(expr.id)
+            if kind:
+                return LockRef(rel, "", expr.id, kind)
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id == "self" and cls:
+            cm = self.classes.get(cls)
+            if cm and expr.attr in cm.lock_attrs:
+                return LockRef(rel, cls, expr.attr, cm.lock_attrs[expr.attr])
+            return None
+        # self.A.B — B on the annotated/constructed type of attribute A
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self" and cls:
+            cm = self.classes.get(cls)
+            tname = cm.attr_types.get(base.attr) if cm else None
+            if not tname:
+                return None
+            if tname in self.classes:
+                tcm = self.classes[tname]
+                if expr.attr in tcm.lock_attrs:
+                    return LockRef(rel, tname, expr.attr,
+                                   tcm.lock_attrs[expr.attr])
+            elif project is not None and tname in project.class_index:
+                omm, tcm = project.class_index[tname]
+                if expr.attr in tcm.lock_attrs:
+                    return LockRef(omm.module.rel, tname, expr.attr,
+                                   tcm.lock_attrs[expr.attr])
+        return None
+
+    # -- roles ----------------------------------------------------------
+    def _thread_role(self, call: ast.Call) -> Tuple[str, Optional[str]]:
+        """(role name, target bare name or None) for a Thread(...) call."""
+        target = None
+        label = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = core.tail_name(kw.value)
+            elif kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                label = kw.value.value
+        role = f"thread:{label or target or 'anonymous'}"
+        return role, target
+
+    def _propagate_roles(self) -> None:
+        entries: List[Tuple[ast.AST, str]] = []
+        for ts in self.threads:
+            role, target = self._thread_role(ts.node)
+            if not target:
+                continue
+            fn = None
+            cm = self.classes.get(ts.cls) if ts.cls else None
+            if cm is not None and target in cm.methods:
+                fn = cm.methods[target]
+            elif target in self.module.functions:
+                fn = self.module.functions[target]
+            if fn is not None:
+                entries.append((fn, role))
+        for cm in self.classes.values():
+            fin = cm.methods.get("__del__")
+            if fin is not None:
+                entries.append((fin, ROLE_FINALIZER))
+            if cm.is_handler:
+                for m in cm.methods.values():
+                    entries.append((m, ROLE_HANDLER))
+        for name in self.atexit_targets:
+            fn = self.module.functions.get(name)
+            if fn is not None:
+                entries.append((fn, ROLE_FINALIZER))
+        # main: every public function/method not owned by a handler
+        # class — nested defs are only callable through their enclosing
+        # function, so they inherit roles via propagation instead
+        for fid, fn in self.func_index.items():
+            if fid in self.nested_funcs:
+                continue
+            name = getattr(fn, "name", "")
+            cls = self.func_class.get(fid, "")
+            cm = self.classes.get(cls)
+            public = not name.startswith("_") or (
+                name.startswith("__") and name.endswith("__")
+                and name != "__del__")
+            if public and not (cm and cm.is_handler):
+                entries.append((fn, ROLE_MAIN))
+        # propagate each role through resolved call targets: precise for
+        # self/typed-attr calls, bare-name over-approximation otherwise
+        for fn, role in entries:
+            frontier = [fn]
+            seen: Set[int] = set()
+            while frontier:
+                f = frontier.pop()
+                if id(f) in seen:
+                    continue
+                seen.add(id(f))
+                self.roles.setdefault(id(f), set()).add(role)
+                frontier.extend(self.call_targets(id(f)))
+
+    def call_targets(self, fid: int) -> List[ast.AST]:
+        """In-module function nodes a function's calls can reach."""
+        out: List[ast.AST] = []
+        for key in self.func_call_targets.get(fid, ()):
+            if key[0] == "cls":
+                cm = self.classes.get(key[1])
+                got = cm.methods.get(key[2]) if cm else None
+                if got is not None:
+                    out.append(got)
+            elif key[0] == "name":
+                out.extend(self._by_name.get(key[1], ()))
+        return out
+
+    def roles_of(self, func: Optional[ast.AST]) -> Set[str]:
+        if func is None:
+            return {ROLE_MAIN}
+        return self.roles.get(id(func), {ROLE_MAIN})
+
+
+class _SemanticWalker:
+    """Recursive statement walker carrying (class, function, held-locks,
+    while-depth) context."""
+
+    def __init__(self, mm: ModuleModel):
+        self.mm = mm
+        self.cls = ""
+        self.func: Optional[ast.AST] = None
+        self.held: List[LockRef] = []
+        self.while_depth = 0
+        self.locals: Dict[str, ast.AST] = {}    # single-assign local -> value
+        self.param_types: Dict[str, str] = {}   # annotated param -> type tail
+
+    def walk(self) -> None:
+        for stmt in self.mm.module.tree.body:
+            self._stmt(stmt)
+
+    # -- dispatch -------------------------------------------------------
+    def _stmt(self, node: ast.AST) -> None:
+        mm = self.mm
+        if isinstance(node, ast.ClassDef):
+            prev_cls, prev_fn = self.cls, self.func
+            self.cls, self.func = node.name, None
+            for sub in node.body:
+                self._stmt(sub)
+            self.cls, self.func = prev_cls, prev_fn
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mm.func_index[id(node)] = node
+            mm.func_class[id(node)] = self.cls
+            mm.func_calls.setdefault(id(node), set())
+            if self.func is not None:
+                mm.nested_funcs.add(id(node))
+            prev_fn, prev_held, prev_while = \
+                self.func, self.held, self.while_depth
+            prev_locals, prev_params = self.locals, self.param_types
+            self.func, self.held, self.while_depth = node, [], 0
+            self.locals = {}
+            self.param_types = {}
+            for a in list(node.args.args) + list(node.args.kwonlyargs):
+                if a.annotation is not None:
+                    t = core.tail_name(a.annotation)
+                    if not t and isinstance(a.annotation, ast.Subscript):
+                        t = core.tail_name(a.annotation.value)
+                    if t:
+                        self.param_types[a.arg] = t
+            for sub in node.body:
+                self._stmt(sub)
+            self.func, self.held, self.while_depth = \
+                prev_fn, prev_held, prev_while
+            self.locals, self.param_types = prev_locals, prev_params
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[LockRef] = []
+            for item in node.items:
+                self._expr(item.context_expr)
+                ref = mm.resolve_lock(item.context_expr, self.cls)
+                if ref is None and isinstance(item.context_expr, ast.Name):
+                    alias = self.locals.get(item.context_expr.id)
+                    if alias is not None:
+                        ref = mm.resolve_lock(alias, self.cls)
+                if ref is not None:
+                    mm.acquisitions.append(Acquisition(
+                        ref, item.context_expr, self.func,
+                        tuple(self.held)))
+                    self.held.append(ref)
+                    acquired.append(ref)
+            for sub in node.body:
+                self._stmt(sub)
+            for _ in acquired:
+                self.held.pop()
+            return
+        if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            self._expr(getattr(node, "test", None)
+                       or getattr(node, "iter", None))
+            # only a while loop re-checks its predicate after a wakeup,
+            # so only While counts as the LK004 guard
+            guard = isinstance(node, ast.While)
+            self.while_depth += 1 if guard else 0
+            for sub in node.body:
+                self._stmt(sub)
+            self.while_depth -= 1 if guard else 0
+            for sub in node.orelse:
+                self._stmt(sub)
+            return
+        if isinstance(node, ast.Assign):
+            self._expr(node.value)
+            self._record_write_targets(node.targets, node)
+            bind = ""
+            if len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    # single-assignment alias tracking only: a rebound
+                    # name no longer resolves (conservative)
+                    if tgt.id in self.locals:
+                        self.locals[tgt.id] = ast.Constant(value=None)
+                    else:
+                        self.locals[tgt.id] = node.value
+                    bind = tgt.id
+                elif isinstance(tgt, ast.Attribute):
+                    bind = core.dotted_name(tgt)
+            self._maybe_thread(node.value, bind_name=bind)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._expr(node.value)
+            self._record_write_targets([node.target], node)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._expr(node.value)
+                self._record_write_targets([node.target], node)
+                self._maybe_thread(
+                    node.value,
+                    bind_name=core.dotted_name(node.target) or "")
+            return
+        if isinstance(node, ast.Expr):
+            self._maybe_thread(node.value, bind_name="")
+            self._expr(node.value)
+            return
+        if isinstance(node, (ast.Return, ast.Raise)):
+            self._expr(getattr(node, "value", None)
+                       or getattr(node, "exc", None))
+            return
+        # generic statements (If / Try / ...): recurse into child
+        # statements, except-handler bodies, and expressions
+        for field in ast.iter_child_nodes(node):
+            if isinstance(field, ast.stmt):
+                self._stmt(field)
+            elif isinstance(field, ast.ExceptHandler):
+                self._expr(field.type)
+                for sub in field.body:
+                    self._stmt(sub)
+            else:
+                self._expr(field)
+
+    def _record_write_targets(self, targets: Sequence[ast.AST],
+                              node: ast.AST) -> None:
+        for tgt in targets:
+            for t in self._flatten_target(tgt):
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" and self.cls:
+                    self.mm.writes.append(WriteSite(
+                        self.cls, t.attr, node, self.func,
+                        tuple(self.held)))
+                elif isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Attribute) \
+                        and isinstance(t.value.value, ast.Name) \
+                        and t.value.value.id == "self" and self.cls:
+                    self.mm.writes.append(WriteSite(
+                        self.cls, t.value.attr, node, self.func,
+                        tuple(self.held)))
+
+    @staticmethod
+    def _flatten_target(tgt: ast.AST) -> Iterable[ast.AST]:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                yield from _SemanticWalker._flatten_target(e)
+        else:
+            yield tgt
+
+    def _maybe_thread(self, value: ast.AST, bind_name: str) -> None:
+        if not isinstance(value, ast.Call):
+            return
+        # chained `threading.Thread(...).start()` — unbound by
+        # construction, so the bind name is dropped regardless
+        if core.tail_name(value.func) == "start" \
+                and isinstance(value.func, ast.Attribute) \
+                and isinstance(value.func.value, ast.Call) \
+                and core.tail_name(value.func.value.func) == "Thread":
+            value, bind_name = value.func.value, ""
+        if core.tail_name(value.func) == "Thread":
+            daemon = any(
+                kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True for kw in value.keywords)
+            self.mm.threads.append(ThreadSite(
+                value, self.func, self.cls, bind_name, daemon))
+
+    # -- expressions ----------------------------------------------------
+    _MUTATORS = {"append", "extend", "pop", "popitem", "popleft",
+                 "update", "add", "remove", "discard", "clear",
+                 "insert", "setdefault", "appendleft"}
+
+    def _expr(self, node: Optional[ast.AST]) -> None:
+        if node is None:
+            return
+        for call in [n for n in ast.walk(node) if isinstance(n, ast.Call)]:
+            self._call(call)
+
+    def _typed_key(self, tname: str, tail: str) -> Optional[Tuple]:
+        """Callee key for a method call on a receiver of known type
+        ``tname`` — in-module class dispatches precisely, any other
+        known type (dict, Queue, socket, ...) provably leaves the
+        module."""
+        if not tname:
+            return None
+        if tname in self.mm.classes:
+            return ("cls", tname, tail)
+        return ("extern",)
+
+    def _callee_key(self, fn: ast.AST) -> Tuple:
+        tail = core.tail_name(fn)
+        if isinstance(fn, ast.Name):
+            return ("name", tail)
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            if isinstance(recv, ast.Name) and recv.id == "self" \
+                    and self.cls:
+                return ("cls", self.cls, tail)
+            if isinstance(recv, ast.Name):
+                key = self._typed_key(
+                    self.param_types.get(recv.id, ""), tail)
+                if key is None:
+                    alias = self.locals.get(recv.id)
+                    t = _ctor_tail(alias) if alias is not None else ""
+                    if t and t[0].isupper():
+                        key = self._typed_key(t, tail)
+                if key is not None:
+                    return key
+            if isinstance(recv, ast.Attribute) \
+                    and isinstance(recv.value, ast.Name) \
+                    and recv.value.id == "self" and self.cls:
+                cm = self.mm.classes.get(self.cls)
+                tname = cm.attr_types.get(recv.attr) if cm else None
+                if tname:
+                    # typed attribute: in-module class -> that method
+                    # only; any other type provably leaves the module
+                    if tname in self.mm.classes:
+                        return ("cls", tname, tail)
+                    return ("extern",)
+            return ("name", tail)
+        return ("extern",)
+
+    def _recv_type(self, fn: ast.AST) -> str:
+        """Class-name tail of a method call's receiver, when the walker
+        can type it: parameter annotations, single-assignment local
+        constructor aliases, and annotated ``self.X`` attributes."""
+        if not isinstance(fn, ast.Attribute):
+            return ""
+        recv = fn.value
+        if isinstance(recv, ast.Name) and recv.id != "self":
+            t = self.param_types.get(recv.id, "")
+            if not t:
+                alias = self.locals.get(recv.id)
+                t = _ctor_tail(alias) if alias is not None else ""
+            return t if t and t[0].isupper() else ""
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self" and self.cls:
+            cm = self.mm.classes.get(self.cls)
+            return (cm.attr_types.get(recv.attr, "") if cm else "")
+        return ""
+
+    def _call(self, call: ast.Call) -> None:
+        mm = self.mm
+        tail = core.tail_name(call.func)
+        if self.func is not None:
+            mm.func_calls.setdefault(id(self.func), set()).add(tail)
+            mm.func_call_targets.setdefault(id(self.func), set()).add(
+                self._callee_key(call.func))
+        mm.calls.append(CallSite(call, self.func, tuple(self.held),
+                                 self.cls, self._recv_type(call.func)))
+        fn = call.func
+        # atexit.register(f) — the finalizer role's other entry point
+        if tail == "register" \
+                and mm.module.resolve(fn).startswith("atexit."):
+            if call.args:
+                mm.atexit_targets.add(core.tail_name(call.args[0]))
+        # mutating method call on self.X counts as a write to X
+        if tail in self._MUTATORS and isinstance(fn, ast.Attribute) \
+                and isinstance(fn.value, ast.Attribute) \
+                and isinstance(fn.value.value, ast.Name) \
+                and fn.value.value.id == "self" and self.cls:
+            mm.writes.append(WriteSite(
+                self.cls, fn.value.attr, call, self.func,
+                tuple(self.held)))
+        # condition-variable wait
+        if tail == "wait" and isinstance(fn, ast.Attribute):
+            ref = mm.resolve_lock(fn.value, self.cls)
+            if ref is not None and ref.kind == "condition":
+                mm.waits.append(WaitSite(ref, call, self.func,
+                                         tuple(self.held),
+                                         self.while_depth > 0))
+        # join sites, for LK006 (thread joined somewhere in the module)
+        if tail == "join" and isinstance(fn, ast.Attribute):
+            recv = fn.value
+            name = core.dotted_name(recv)
+            if name:
+                mm.join_targets.add(name)
+                if isinstance(recv, ast.Name):
+                    alias = self.locals.get(recv.id)
+                    aname = core.dotted_name(alias) if alias is not None \
+                        else ""
+                    if aname:
+                        mm.join_targets.add(aname)
+
+
+# cached per-module models, keyed by module identity (modules are
+# parsed once per run, so id() is stable for a run's lifetime)
+_MODEL_CACHE: Dict[int, ModuleModel] = {}
+
+
+def get_model(module: core.Module) -> ModuleModel:
+    key = id(module)
+    got = _MODEL_CACHE.get(key)
+    if got is None or got.module is not module:
+        got = _MODEL_CACHE[key] = ModuleModel(module)
+    return got
+
+
+class ProjectModel:
+    """Cross-module facts: the class index and the LK003 lock-order
+    graph (nested acquisitions + one level of call closure)."""
+
+    def __init__(self, modules: Sequence[core.Module]):
+        self.models = [get_model(m) for m in modules]
+        self.class_index: Dict[str, Tuple[ModuleModel, ClassModel]] = {}
+        for mm in self.models:
+            for cm in mm.classes.values():
+                self.class_index.setdefault(cm.name, (mm, cm))
+        # function index: bare name -> [(model, class name, func node)]
+        self.func_index: Dict[str, List[Tuple[ModuleModel, str, ast.AST]]] \
+            = {}
+        for mm in self.models:
+            for fid, fn in mm.func_index.items():
+                self.func_index.setdefault(
+                    getattr(fn, "name", ""), []).append(
+                    (mm, mm.func_class.get(fid, ""), fn))
+        # direct acquisitions per function
+        self.func_acqs: Dict[int, List[Acquisition]] = {}
+        for mm in self.models:
+            for acq in mm.acquisitions:
+                if acq.func is not None:
+                    self.func_acqs.setdefault(id(acq.func), []).append(acq)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        self._build_graph()
+
+    # -- graph ----------------------------------------------------------
+    def _add_edge(self, src: LockRef, dst: LockRef, rel: str,
+                  line: int) -> None:
+        if src.id == dst.id:
+            return                       # RLock re-entry, not an ordering
+        self.edges.setdefault((src.id, dst.id), (rel, line))
+
+    def _callees(self, mm: ModuleModel, site: CallSite
+                 ) -> List[ast.AST]:
+        """Precise one-level callee resolution: same-class ``self.m()``,
+        module/global functions by bare name, and typed receivers — the
+        walker records a receiver's class-name tail on the CallSite from
+        parameter annotations, local constructor aliases, and annotated
+        ``self.X`` attributes.  Unresolvable receivers resolve to
+        nothing — the graph prefers soundness-per-edge over recall."""
+        fn = site.node.func
+        tail = core.tail_name(fn)
+        out: List[ast.AST] = []
+        if isinstance(fn, ast.Name):
+            got = mm.module.functions.get(tail)
+            if got is not None:
+                out.append(got)
+            return out
+        if not isinstance(fn, ast.Attribute):
+            return out
+        recv = fn.value
+        if isinstance(recv, ast.Name) and recv.id == "self" and site.cls:
+            cm = mm.classes.get(site.cls)
+            if cm and tail in cm.methods:
+                out.append(cm.methods[tail])
+            return out
+        # typed receiver (handle._finish(), self.frontend.submit(), ...)
+        if site.recv_type and site.recv_type in self.class_index:
+            _, tcm = self.class_index[site.recv_type]
+            if tail in tcm.methods:
+                out.append(tcm.methods[tail])
+        return out
+
+    def _build_graph(self) -> None:
+        for mm in self.models:
+            rel = mm.module.rel
+            for acq in mm.acquisitions:
+                if acq.held_before:
+                    self._add_edge(acq.held_before[-1], acq.lock, rel,
+                                   getattr(acq.node, "lineno", 1))
+            for site in mm.calls:
+                if not site.held:
+                    continue
+                for callee in self._callees(mm, site):
+                    for acq in self.func_acqs.get(id(callee), ()):
+                        if not acq.held_before:   # callee's own top level
+                            self._add_edge(
+                                site.held[-1], acq.lock, rel,
+                                getattr(site.node, "lineno", 1))
+
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles in the lock-order graph (one per SCC with
+        ≥2 nodes or a self-loop), as lock-id lists."""
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            for w in graph.get(v, ()):
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        return sccs
+
+
+def build_project_graph(paths: Sequence[str]
+                        ) -> Dict[Tuple[str, str], Tuple[str, int]]:
+    """The static LK003 edge set for ``paths`` — the reference the
+    TracedLock runtime cross-check compares observed order against.
+
+    Relative paths that don't exist under the caller's cwd resolve
+    against the repo root: a silently-empty graph would invert the
+    cross-check's contract (observed ⊆ static) into a vacuous pass
+    of its converse."""
+    root = core.repo_root()
+    resolved = [p if os.path.isabs(p) or os.path.exists(p)
+                else os.path.join(root, p) for p in paths]
+    missing = [p for p in resolved if not os.path.exists(p)]
+    if missing:
+        raise FileNotFoundError(
+            f"build_project_graph: no such path(s): {missing}")
+    modules = [m for m in (core.load_module(f)
+                           for f in core.collect_files(resolved)) if m]
+    return ProjectModel(modules).edges

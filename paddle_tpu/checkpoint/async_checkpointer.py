@@ -154,7 +154,11 @@ class AsyncCheckpointer:
         self.close()
         return False
 
-    def __del__(self):
+    # Deliberate best-effort backstop: close() is idempotent, bounds
+    # both the idle wait and the join, and never joins the current
+    # thread — dropping it would truncate an in-flight async save when
+    # a checkpointer is abandoned without close().
+    def __del__(self):  # locklint: disable=LK005
         try:
             self.close(timeout=5.0)
         # finalizer racing interpreter shutdown: anything may be torn down

@@ -79,11 +79,21 @@ class KVServer(socketserver.ThreadingTCPServer):
 
     def __init__(self, addr: Tuple[str, int]):
         self.state = _State()
+        self._serve_thread: Optional[threading.Thread] = None
         super().__init__(addr, _Handler)
 
     @property
     def port(self) -> int:
         return self.server_address[1]
+
+    def stop(self) -> None:
+        """Stop serving, close the listening socket, and join the
+        accept thread (pairs with ``start_server``).  Idempotent."""
+        self.shutdown()
+        self.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5.0)
+            self._serve_thread = None
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -103,8 +113,9 @@ class _Handler(socketserver.StreamRequestHandler):
 
 def start_server(host: str = "127.0.0.1", port: int = 0) -> KVServer:
     srv = KVServer((host, port))
-    t = threading.Thread(target=srv.serve_forever, daemon=True)
-    t.start()
+    srv._serve_thread = threading.Thread(target=srv.serve_forever,
+                                         daemon=True)
+    srv._serve_thread.start()
     return srv
 
 

@@ -356,6 +356,14 @@ class PodController:
 
     # -- top-level ---------------------------------------------------------
     def run(self) -> int:
+        try:
+            return self._run()
+        finally:
+            self.kv.close()
+            if self.server is not None:
+                self.server.stop()      # joins the KV accept thread
+
+    def _run(self) -> int:
         attempt = 0
         while True:
             epoch, rank, ranks = self.rendezvous()

@@ -208,7 +208,10 @@ def rpc_async(to: str, fn: Callable, args: Tuple = (), kwargs=None,
         except Exception as e:
             fut.set_exception(e)
 
-    threading.Thread(target=work, daemon=True).start()
+    # Deliberate fire-and-forget: the Future is the join point (every
+    # result()/wait() bounds it); the socket call itself is bounded by
+    # ``timeout``, so the thread cannot outlive its caller's interest.
+    threading.Thread(target=work, daemon=True).start()  # locklint: disable=LK006
     return fut
 
 
@@ -220,7 +223,10 @@ def rpc_sync(to: str, fn: Callable, args: Tuple = (), kwargs=None,
 def shutdown() -> None:
     """Stop this worker's agent (reference rpc.shutdown)."""
     server = _state.pop("server", None)
+    thread = _state.pop("thread", None)
     if server is not None:
         server.shutdown()
         server.server_close()
+    if thread is not None:
+        thread.join(timeout=5.0)
     _state.clear()

@@ -65,6 +65,11 @@ class _DevicePrefetcher:
         self._closed = threading.Event()
         self._close_lock = threading.Lock()
         self._joined = False
+        # _exc crosses the producer→consumer thread boundary: the
+        # producer stores, the consumer swaps it out (take-once).  Both
+        # must happen under _exc_lock or a concurrent close/next can
+        # lose the exception and truncate the epoch silently.
+        self._exc_lock = threading.Lock()
         self._exc: Optional[BaseException] = None
         self._sharding = sharding
         self._convert = convert
@@ -142,7 +147,8 @@ class _DevicePrefetcher:
                 if not self._enqueue(self._stage_with_retry(item)):
                     return                   # consumer closed early
         except BaseException as e:           # propagate to consumer
-            self._exc = e
+            with self._exc_lock:
+                self._exc = e
         finally:
             self._enqueue(self._END)
 
@@ -168,7 +174,8 @@ class _DevicePrefetcher:
             item = self._q.get()
         if item is self._END:
             self.close()
-            exc, self._exc = self._exc, None
+            with self._exc_lock:
+                exc, self._exc = self._exc, None
             if exc is not None:
                 raise exc        # exactly once; later nexts StopIterate
             raise StopIteration
@@ -193,7 +200,10 @@ class _DevicePrefetcher:
             self._thread.join(timeout=5.0)
             self._joined = not self._thread.is_alive()
 
-    def __del__(self):
+    # Deliberate best-effort backstop for abandoned iterators: close()
+    # is idempotent, never joins the current thread, and bounds the
+    # join — acceptable to run from a finalizer.
+    def __del__(self):  # locklint: disable=LK005
         try:
             self.close()
         # finalizer racing interpreter shutdown: anything may be torn down
@@ -257,7 +267,10 @@ class _PrefetchIterator:
                         return  # consumer closed early
                     token += 1
             except BaseException as e:  # propagate to consumer
-                self._exc = e
+                # _slots_lock doubles as the _exc guard: the consumer
+                # swaps it out under the same lock (take-once handoff)
+                with self._slots_lock:
+                    self._exc = e
             finally:
                 self._ring.close()
 
@@ -278,7 +291,10 @@ class _PrefetchIterator:
             # under a live waiter
             self._ring.leak()
 
-    def __del__(self):
+    # Deliberate best-effort backstop: close() is idempotent and its
+    # join is bounded; skipping it would use-after-free the native ring
+    # when an iterator is abandoned mid-epoch.
+    def __del__(self):  # locklint: disable=LK005
         try:
             self.close()
         # finalizer racing interpreter shutdown: anything may be torn down
@@ -292,7 +308,8 @@ class _PrefetchIterator:
         token = self._ring.pop()
         if token is None:
             self.close()
-            exc, self._exc = self._exc, None
+            with self._slots_lock:
+                exc, self._exc = self._exc, None
             if exc is not None:
                 raise exc        # exactly once; later nexts StopIterate
             raise StopIteration
@@ -367,7 +384,10 @@ class DataLoader:
             self._pool.shutdown()
             self._pool = None
 
-    def __del__(self):
+    # Deliberate best-effort backstop: _release_pool() forwards to the
+    # worker pool's idempotent shutdown (bounded joins + terminate
+    # fallback) so abandoned loaders don't leak worker processes.
+    def __del__(self):  # locklint: disable=LK005
         try:
             self._release_pool()
         # finalizer racing interpreter shutdown: anything may be torn down

@@ -338,7 +338,11 @@ class WorkerPool:
                 q.close()
             self._result_q.close()
 
-    def __del__(self):
+    # Deliberate best-effort backstop: shutdown() is idempotent, its
+    # joins are bounded with a terminate() fallback, and it unlinks the
+    # shared-memory segments of undelivered batches — skipping it on an
+    # abandoned pool would leak worker processes AND /dev/shm segments.
+    def __del__(self):  # locklint: disable=LK005
         try:
             self.shutdown()
         # finalizer racing interpreter shutdown: anything may be torn down

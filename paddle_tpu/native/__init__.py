@@ -183,7 +183,10 @@ class TokenRing:
             return int(self._lib.pt_ring_size(self._ring))
         return self._q.qsize()
 
-    def __del__(self):
+    # Touches only the ctypes handle — no Python locks, threads, or
+    # queues — so LK005 stays silent here by construction; the disable
+    # documents that this finalizer was audited, not just missed.
+    def __del__(self):  # locklint: disable=LK005
         if getattr(self, "_ring", None) is not None:
             try:
                 self._lib.pt_ring_close(self._ring)

@@ -18,6 +18,10 @@ The measurement layer every perf/robustness PR is judged against:
   recompile counts and trace→lower→compile durations.
 * :class:`TelemetrySession` / :func:`observe` — the one knob that wires
   all of the above; ``Model.fit(observe=True)`` uses it.
+* :class:`TracedLock` / :class:`LockOrderRecorder` — test-time lock
+  wrapper recording acquisition order, asserted against the static
+  LK003 lock-order graph (``analysis/threads``) so runtime-only
+  acquisition paths can't introduce an unmodeled deadlock.
 
 All recording is host-side, outside traced code — a metrics call inside
 a jit region is a TL001 hazard by construction, and the tracelint
@@ -32,10 +36,12 @@ from .flight_recorder import FlightRecorder
 from .compile_monitor import CompileMonitor
 from .hw import estimate_mfu, peak_flops_per_chip
 from .session import TelemetrySession, observe
+from .traced_lock import LockOrderRecorder, TracedLock
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "JsonlSink", "MemorySink", "write_prometheus", "FlightRecorder",
     "CompileMonitor", "TelemetrySession", "observe",
     "estimate_mfu", "peak_flops_per_chip",
+    "LockOrderRecorder", "TracedLock",
 ]

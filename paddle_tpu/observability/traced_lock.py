@@ -1,0 +1,171 @@
+"""TracedLock — a debug lock wrapper that records acquisition order.
+
+The static side of the story lives in ``analysis/threads``: LK003
+builds a project-wide lock-order graph from nested ``with lock:``
+blocks plus one level of call closure, and fails the lint when the
+graph has a cycle.  Static analysis can miss orders that only occur
+through indirection (callbacks, ``getattr`` dispatch, locks passed as
+arguments), so this module provides the runtime cross-check: wrap the
+real locks in ``TracedLock`` during a test, drive the threaded
+surface, and assert that every *observed* acquisition edge is present
+in the static graph —
+
+    edges = model.build_project_graph(["paddle_tpu/serving"])
+    rec = LockOrderRecorder()
+    fe._lock = TracedLock(fe._lock, "paddle_tpu/serving/frontend.py"
+                          "::ServingFrontend._lock", rec)
+    ...drive requests...
+    assert rec.edges() <= set(edges)      # and rec.cycles() == []
+
+Lock names use the same ``<module-rel>::<Class>.<attr>`` ids the
+static model assigns, so the two sides compare directly.  The
+recorder keeps a per-thread stack of currently-held names and records
+an edge (innermost-held → newly-acquired) on every acquisition, the
+exact rule the static graph uses; re-entrant re-acquisition of the
+same name (RLock) is not an ordering and is skipped.
+
+This is a test-time tool: the wrapper costs a dict update per
+acquisition and is never installed in production paths.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["LockOrderRecorder", "TracedLock"]
+
+
+class LockOrderRecorder:
+    """Collects (held → acquired) edges across every TracedLock that
+    shares this recorder; thread-safe."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._held = threading.local()      # per-thread stack of names
+        # (src, dst) -> first witness (thread name); insertion-ordered
+        self._edges: Dict[Tuple[str, str], str] = {}
+        self._acquired: Set[str] = set()    # every name ever acquired
+
+    # -- called by TracedLock ------------------------------------------
+    def on_acquire(self, name: str) -> None:
+        stack: List[str] = getattr(self._held, "stack", None) or []
+        self._held.stack = stack
+        with self._mu:
+            self._acquired.add(name)
+            if stack and stack[-1] != name:   # RLock re-entry: no edge
+                self._edges.setdefault(
+                    (stack[-1], name), threading.current_thread().name)
+        stack.append(name)
+
+    def on_release(self, name: str) -> None:
+        stack: List[str] = getattr(self._held, "stack", None) or []
+        # release order can differ from acquisition order (lock handoff
+        # idioms); drop the innermost matching entry
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                break
+
+    # -- assertions -----------------------------------------------------
+    def edges(self) -> Set[Tuple[str, str]]:
+        with self._mu:
+            return set(self._edges)
+
+    def witness(self, edge: Tuple[str, str]) -> Optional[str]:
+        """Thread name that first produced ``edge`` (for diagnostics)."""
+        with self._mu:
+            return self._edges.get(edge)
+
+    def acquired(self) -> Set[str]:
+        with self._mu:
+            return set(self._acquired)
+
+    def cycles(self) -> List[List[str]]:
+        """Cycles among the OBSERVED edges (should always be empty —
+        an observed cycle is a latent deadlock even if no run hangs)."""
+        edges = self.edges()
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        out: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            for w in graph.get(v, ()):
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    out.append(sorted(comp))
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        return out
+
+
+class TracedLock:
+    """Transparent wrapper over a Lock / RLock / Condition that reports
+    acquisition order to a :class:`LockOrderRecorder`.
+
+    ``name`` should be the static model's lock id
+    (``<module-rel>::<Class>.<attr>``) so observed edges compare
+    directly against ``analysis.threads.model.build_project_graph``.
+    Non-locking attributes (``wait``/``notify``/... on a Condition)
+    pass through untouched — ``Condition.wait`` releases and reacquires
+    internally, which is not an *ordering* event between locks.
+    """
+
+    def __init__(self, inner, name: str, recorder: LockOrderRecorder):
+        self._inner = inner
+        self._name = name
+        self._recorder = recorder
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._recorder.on_acquire(self._name)
+        return got
+
+    def release(self):
+        self._recorder.on_release(self._name)
+        return self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc_info):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, item):
+        # Condition surface (wait / notify / notify_all / wait_for) and
+        # anything else delegates to the wrapped primitive
+        return getattr(self._inner, item)
+
+    def __repr__(self):
+        return f"TracedLock({self._name!r}, {self._inner!r})"

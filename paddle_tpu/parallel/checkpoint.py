@@ -102,7 +102,11 @@ def _join_same_path(path: str) -> None:
     _async_tasks[:] = [t for t in _async_tasks if t.path != ap]
 
 
-def clear_async_save_task_queue() -> None:
+# Joining writer threads from atexit is deliberate: the alternative is
+# truncated shard files on interpreter exit.  The writers are plain
+# non-daemon threads doing bounded file IO, and the join order (pop
+# from the front) cannot deadlock — there are no locks to invert.
+def clear_async_save_task_queue() -> None:  # locklint: disable=LK005
     """Block until every pending async checkpoint write finishes; raises
     if any write failed (reference clear_async_save_task_queue)."""
     while _async_tasks:

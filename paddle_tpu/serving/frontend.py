@@ -323,7 +323,9 @@ class RequestHandle:
                 self._tokens.append(t)
                 delivered.append(t)
                 self._cond.notify_all()
-        self.backpressure_wait_s += waited
+            # accumulate inside the cond: metrics_snapshot reads this
+            # from whatever thread scrapes it, and += is two racy ops
+            self.backpressure_wait_s += waited
         if self.on_token is not None:
             for t in delivered:
                 self.on_token(self, t)
@@ -535,7 +537,12 @@ class ServingFrontend:
             now = self._clock()
             self._expire(now, deliveries)
             try:
-                finished = self.engine.step()
+                # The scheduler lock IS the engine serialization point:
+                # step() mutates engine batch state, and every other
+                # engine touch (submit's admission, drain) already goes
+                # through _lock.  Callers never block on _lock for the
+                # step duration — they use the handle condvars.
+                finished = self.engine.step()  # locklint: disable=LK002
             except BaseException as e:
                 self._crash(e)
                 raise
@@ -711,7 +718,8 @@ class ServingFrontend:
         """Engine-step failure: record, dump the serve ring for
         post-mortem, and abort every live stream so consumers get a
         terminal state instead of hanging."""
-        self.error = exc
+        with self._lock:       # re-entrant from step(); health_snapshot
+            self.error = exc   # reads error from other threads
         self.metrics.event("crash",
                            error=f"{type(exc).__name__}: {exc}")
         try:

@@ -311,7 +311,10 @@ class HttpServingServer:
         CLI path).  The handler returns immediately; the drain runs on
         a background thread so the signal context stays trivial."""
         def _on_signal(signum, frame):
-            threading.Thread(
+            # Deliberate fire-and-forget: the signal context must return
+            # immediately and nothing can wait on this thread — the
+            # drain itself signals completion via _drain_done.
+            threading.Thread(  # locklint: disable=LK006
                 target=self.begin_shutdown,
                 kwargs={"reason": signal.Signals(signum).name},
                 name="serving-http-shutdown", daemon=True).start()
@@ -358,6 +361,15 @@ class HttpServingServer:
             time.sleep(0.01)
         self._stop_housekeeper.set()
         self._httpd.shutdown()
+        # shutdown() returns once serve_forever exits; join the worker
+        # threads so close() never returns with live threads behind it
+        # (current-thread guard: begin_shutdown may run ON them)
+        if self._serve_thread is not None \
+                and self._serve_thread is not threading.current_thread():
+            self._serve_thread.join(timeout=5.0)
+        if self._housekeeper is not None \
+                and self._housekeeper is not threading.current_thread():
+            self._housekeeper.join(timeout=5.0)
         self.frontend.close(cancel_pending=True)
         leak = self.frontend.engine.kv_leak_report()
         drain_secs = time.monotonic() - t0
@@ -374,7 +386,8 @@ class HttpServingServer:
             "kv_leaked_blocks": leak["leaked"] + leak["unaccounted"],
         }
         self.metrics.on_shutdown_drain(drain_secs, drained, cancelled)
-        self._drain_report = report
+        with self._lock:   # concurrent callers read it after the event
+            self._drain_report = report
         self._drain_done.set()
         return dict(report)
 

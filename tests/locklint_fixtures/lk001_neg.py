@@ -1,0 +1,22 @@
+"""LK001 negative: every cross-role write happens under the same
+lock, so the roles share a guard."""
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._status = "idle"           # __init__ writes are exempt
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        with self._lock:
+            self._status = "running"
+
+    def poke(self):
+        with self._lock:
+            self._status = "poked"
+
+    def close(self):
+        self._thread.join(timeout=1.0)

@@ -1,0 +1,20 @@
+"""LK001 positive: ``_status`` is written by both the public (main)
+surface and the worker thread with no common lock."""
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._status = "idle"
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        self._status = "running"        # thread-role write, unlocked
+
+    def poke(self):
+        self._status = "poked"          # main-role write, unlocked
+
+    def close(self):
+        self._thread.join(timeout=1.0)

@@ -1,0 +1,32 @@
+"""LK002 negative: blocking work happens outside the lock (collect
+under the lock, act after releasing), bounded waits are fine, and the
+condition-variable wait-under-its-own-condition idiom is exempt."""
+import queue
+import threading
+import time
+
+
+class Sender:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._q = queue.Queue()
+        self.sock = sock
+        self.ready = False
+
+    def send(self, data):
+        with self._lock:
+            payload = bytes(data)       # stage under the lock...
+        self.sock.sendall(payload)      # ...send after releasing
+
+    def nap(self):
+        time.sleep(0.01)                # not under any lock
+
+    def take(self):
+        with self._lock:
+            return self._q.get(timeout=0.5)    # bounded wait
+
+    def wait_ready(self):
+        with self._cond:
+            while not self.ready:
+                self._cond.wait(0.1)    # the CV idiom: exempt

@@ -1,0 +1,24 @@
+"""LK002 positive: three shapes of blocking call under a held lock —
+socket send, time.sleep, and an unbounded queue get."""
+import queue
+import threading
+import time
+
+
+class Sender:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self.sock = sock
+
+    def send(self, data):
+        with self._lock:
+            self.sock.sendall(data)     # network write under the lock
+
+    def nap(self):
+        with self._lock:
+            time.sleep(1.0)             # sleep under the lock
+
+    def take(self):
+        with self._lock:
+            return self._q.get()        # unbounded get under the lock

@@ -1,0 +1,23 @@
+"""LK003 negative: both paths acquire in the same global order (one
+directly nested, one through a call — the one-level closure sees
+both), so the order graph stays acyclic."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def deposit(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def withdraw(self):
+        with self._a:
+            self._log()
+
+    def _log(self):
+        with self._b:
+            pass

@@ -1,0 +1,19 @@
+"""LK003 positive: two locks acquired in opposite orders on two code
+paths — the classic ABBA deadlock."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def deposit(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def withdraw(self):
+        with self._b:
+            with self._a:
+                pass

@@ -1,0 +1,15 @@
+"""LK004 negative: the wait sits in a while loop that re-checks the
+predicate after every wakeup."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.ready = False
+
+    def take(self):
+        with self._cond:
+            while not self.ready:
+                self._cond.wait(0.1)
+            return 1

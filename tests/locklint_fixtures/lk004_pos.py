@@ -1,0 +1,16 @@
+"""LK004 positive: ``if not ready: cond.wait()`` — the textbook
+missed-wakeup bug (spurious wakeups / consumed notifications are
+never re-checked)."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.ready = False
+
+    def take(self):
+        with self._cond:
+            if not self.ready:
+                self._cond.wait()
+            return 1

@@ -1,0 +1,15 @@
+"""LK005 negative: the finalizer touches only plain object state — no
+locks, no thread joins, no queue handoff."""
+
+
+class Plain:
+    def __init__(self):
+        self._fh = None
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __del__(self):
+        self.close()

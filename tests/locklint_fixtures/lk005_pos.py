@@ -1,0 +1,34 @@
+"""LK005 positive: a ``__del__`` whose transitive close() both
+acquires a lock and joins a thread, plus an atexit handler acquiring a
+module lock."""
+import atexit
+import threading
+
+_tasks = []
+_reg_lock = threading.Lock()
+
+
+def _drain():
+    with _reg_lock:
+        _tasks.clear()
+
+
+atexit.register(_drain)
+
+
+class Holder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        with self._lock:
+            pass
+        self._thread.join(timeout=1.0)
+
+    def __del__(self):
+        self.close()

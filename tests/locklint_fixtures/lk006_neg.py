@@ -1,0 +1,22 @@
+"""LK006 negative: every started thread has a join on its binding
+somewhere on the owner's shutdown path (local aliases count)."""
+import threading
+
+
+class Owner:
+    def start(self):
+        self._thread = threading.Thread(target=self._run, name="pump",
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._thread.join(timeout=1.0)
+
+
+def run_once(job):
+    t = threading.Thread(target=job)
+    t.start()
+    t.join(timeout=5.0)

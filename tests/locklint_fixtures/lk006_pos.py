@@ -1,0 +1,17 @@
+"""LK006 positive: a bound thread nobody ever joins, and an unbound
+``Thread(...).start()`` that can never be joined at all."""
+import threading
+
+
+class Owner:
+    def start(self):
+        self._thread = threading.Thread(target=self._run, name="pump",
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+
+def fire(job):
+    threading.Thread(target=job, daemon=True).start()

@@ -1,0 +1,455 @@
+"""locklint unit tests: the thread-role/lock model, per-rule fixtures,
+suppressions, the CLI lane, the TracedLock recorder, and chaos
+regression tests for the real races the ISSUE 19 triage fixed.
+
+Fixture files under tests/locklint_fixtures/ are ANALYZED, never
+imported.  CPU-only; the chaos lanes exercise real threads but every
+wait is bounded.
+"""
+
+import ast
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis import core
+from paddle_tpu.analysis.threads import model as tm
+from paddle_tpu.analysis.threads.lk002_blocking import blocking_reason
+from paddle_tpu.observability import LockOrderRecorder, TracedLock
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "locklint_fixtures")
+REPO = os.path.dirname(HERE)
+
+LK_IDS = ("LK001", "LK002", "LK003", "LK004", "LK005", "LK006")
+
+
+def fixture_path(rid, kind):
+    return os.path.join(FIXTURES, f"{rid.lower()}_{kind}.py")
+
+
+def run_fixture(rid, kind):
+    return core.run([fixture_path(rid, kind)], select={rid})
+
+
+def _mm(src):
+    mod = core.Module("x.py", "x.py", src, ast.parse(src))
+    return tm.ModuleModel(mod)
+
+
+def _fid(mm, name):
+    for fid, fn in mm.func_index.items():
+        if getattr(fn, "name", "") == name:
+            return fid
+    raise AssertionError(f"no function {name!r} in model")
+
+
+def _roles(mm, name):
+    return mm.roles.get(_fid(mm, name), set())
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+# -- registry -----------------------------------------------------------
+
+def test_lk_rules_registered_with_metadata():
+    ids = [r.id for r in core.all_rules()]
+    for rid in LK_IDS:
+        assert rid in ids
+    for rule in core.all_rules():
+        if rule.id.startswith("LK"):
+            assert rule.severity in core.SEVERITIES
+            assert rule.doc and rule.hint and rule.name
+
+
+# -- the thread-role / lock model ---------------------------------------
+
+def test_lock_identity_and_nested_acquisition():
+    mm = _mm(textwrap.dedent("""
+        import threading
+
+        _GLOBAL = threading.Lock()
+
+
+        class Inner:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+
+        class Outer:
+            def __init__(self, inner: Inner):
+                self._lock = threading.RLock()
+                self._inner = inner
+
+            def use(self):
+                with self._lock:
+                    with self._inner._cond:
+                        pass
+
+            def top(self):
+                with _GLOBAL:
+                    pass
+    """))
+    assert mm.module_locks == {"_GLOBAL": "lock"}
+    assert mm.classes["Outer"].lock_attrs == {"_lock": "rlock"}
+    # annotated __init__ param types the attribute
+    assert mm.classes["Outer"].attr_types["_inner"] == "Inner"
+    acqs = {a.lock.id: a for a in mm.acquisitions}
+    assert "x.py::Outer._lock" in acqs
+    assert "x.py::_GLOBAL" in acqs
+    # self.A.B resolves through the annotated type of A, and the nested
+    # acquisition carries the held stack (the LK003 edge source)
+    inner = acqs["x.py::Inner._cond"]
+    assert inner.lock.kind == "condition"
+    assert [l.id for l in inner.held_before] == ["x.py::Outer._lock"]
+
+
+def test_thread_handler_finalizer_and_main_roles():
+    mm = _mm(textwrap.dedent("""
+        import threading
+
+
+        class Pump:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._run,
+                                                name="pump")
+
+            def start(self):
+                self._thread.start()
+
+            def _run(self):
+                self._step()
+
+            def _step(self):
+                pass
+
+            def __del__(self):
+                pass
+
+
+        class Echo(BaseRequestHandler):
+            def handle(self):
+                pass
+
+
+        def outer():
+            def inner():
+                pass
+            inner()
+    """))
+    # Thread(target=...) seeds its role and it flows through calls
+    assert "thread:pump" in _roles(mm, "_run")
+    assert "thread:pump" in _roles(mm, "_step")
+    # private helpers reached only from the thread do NOT carry main
+    assert tm.ROLE_MAIN not in _roles(mm, "_step")
+    assert tm.ROLE_MAIN in _roles(mm, "start")
+    # handler classes (RequestHandler base hint) mark every method
+    assert tm.ROLE_HANDLER in _roles(mm, "handle")
+    assert tm.ROLE_MAIN not in _roles(mm, "handle")
+    assert tm.ROLE_FINALIZER in _roles(mm, "__del__")
+    # nested defs are not main entry points themselves — they inherit
+    # the enclosing function's roles via propagation
+    assert _fid(mm, "inner") in mm.nested_funcs
+    assert tm.ROLE_MAIN in _roles(mm, "inner")
+
+
+def test_callsite_receiver_typing():
+    mm = _mm(textwrap.dedent("""
+        import threading
+
+
+        class Helper:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                with self._lock:
+                    pass
+
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def run(self, req: dict):
+                req.get("x")
+                h = Helper()
+                with self._lock:
+                    h.poke()
+    """))
+    calls = {core.tail_name(c.node.func): c for c in mm.calls}
+    # a local constructor alias types the receiver
+    assert calls["poke"].recv_type == "Helper"
+    targets = mm.func_call_targets[_fid(mm, "run")]
+    assert ("cls", "Helper", "poke") in targets
+    # a dict-annotated parameter provably leaves the module — the call
+    # must NOT fall into the bare-name over-approximation
+    assert ("extern",) in targets
+    assert ("name", "get") not in targets
+
+
+def test_project_graph_edge_through_typed_alias(tmp_path):
+    p = tmp_path / "aliased.py"
+    p.write_text(textwrap.dedent("""
+        import threading
+
+
+        class Helper:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                with self._lock:
+                    pass
+
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def run(self):
+                h = Helper()
+                with self._lock:
+                    h.poke()
+    """))
+    edges = tm.build_project_graph([str(p)])
+    assert any(a.endswith("::Owner._lock") and b.endswith("::Helper._lock")
+               for a, b in edges), sorted(edges)
+
+
+# -- LK002 blocking classification --------------------------------------
+
+def test_blocking_reason_bounded_vs_unbounded():
+    mm = _mm(textwrap.dedent("""
+        import queue
+        import threading
+        import time
+
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+                self._done = threading.Event()
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                pass
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1.0)
+                    self._q.get()
+                    self._thread.join()
+                    self._done.wait()
+
+            def ok(self):
+                with self._lock:
+                    self._q.get(timeout=0.5)
+                    self._thread.join(timeout=1.0)
+                    self._done.wait(0.1)
+    """))
+    bad = [blocking_reason(mm, c) for c in mm.calls
+           if c.held and getattr(c.func, "name", "") == "bad"]
+    ok = [blocking_reason(mm, c) for c in mm.calls
+          if c.held and getattr(c.func, "name", "") == "ok"]
+    assert len(bad) == 4 and all(bad), bad
+    assert "time.sleep" in bad
+    assert len(ok) == 3 and not any(ok), ok
+
+
+# -- per-rule fixtures --------------------------------------------------
+
+@pytest.mark.parametrize("rid", LK_IDS)
+def test_rule_fires_on_positive_fixture(rid):
+    findings = run_fixture(rid, "pos")
+    assert findings, f"{rid} found nothing in its positive fixture"
+    assert {f.rule for f in findings} == {rid}
+
+
+@pytest.mark.parametrize("rid", LK_IDS)
+def test_rule_quiet_on_negative_fixture(rid):
+    findings = run_fixture(rid, "neg")
+    assert not findings, [f.format() for f in findings]
+
+
+def test_lk003_message_names_the_cycle():
+    findings = run_fixture("LK003", "pos")
+    msgs = " ".join(f.message for f in findings)
+    assert "lock-order" in msgs or "cycle" in msgs
+
+
+def test_locklint_suppression_same_line(tmp_path):
+    bad = tmp_path / "suppressed.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+        import time
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    # reviewed: the sleep IS the serialization point here
+                    time.sleep(0.5)  # locklint: disable=LK002
+    """))
+    assert core.run([str(bad)], select={"LK002"}) == []
+
+
+# -- the CLI lane -------------------------------------------------------
+
+def test_cli_select_lk_prefix_expands():
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--select", "LK",
+         "--no-baseline", "--json", fixture_path("LK002", "pos")],
+        capture_output=True, text=True, cwd=REPO)
+    import json
+    payload = json.loads(proc.stdout)
+    assert proc.returncode == 1
+    assert set(payload["counts"]) == {"LK002"}
+
+
+# -- TracedLock / LockOrderRecorder -------------------------------------
+
+def test_recorder_edges_and_rlock_reentry():
+    rec = LockOrderRecorder()
+    a = TracedLock(threading.Lock(), "m.py::A", rec)
+    b = TracedLock(threading.RLock(), "m.py::B", rec)
+    with a:
+        with b:
+            with b:                     # RLock re-entry: not an ordering
+                pass
+    assert rec.edges() == {("m.py::A", "m.py::B")}
+    assert rec.acquired() == {"m.py::A", "m.py::B"}
+    assert rec.witness(("m.py::A", "m.py::B"))
+    assert rec.cycles() == []
+
+
+def test_recorder_out_of_order_release():
+    rec = LockOrderRecorder()
+    a = TracedLock(threading.Lock(), "A", rec)
+    b = TracedLock(threading.Lock(), "B", rec)
+    c = TracedLock(threading.Lock(), "C", rec)
+    a.acquire()
+    b.acquire()
+    a.release()                         # lock-handoff: A released first
+    c.acquire()                         # innermost held is B, not A
+    b.release()
+    c.release()
+    assert ("B", "C") in rec.edges()
+    assert ("A", "C") not in rec.edges()
+
+
+def test_recorder_detects_observed_cycle():
+    rec = LockOrderRecorder()
+    a = TracedLock(threading.Lock(), "A", rec)
+    b = TracedLock(threading.Lock(), "B", rec)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert rec.cycles() == [["A", "B"]]
+
+
+def test_traced_condition_passthrough():
+    rec = LockOrderRecorder()
+    cond = TracedLock(threading.Condition(), "C", rec)
+    with cond:
+        assert cond.wait(timeout=0.01) is False
+        cond.notify_all()
+    assert rec.acquired() == {"C"}
+    assert rec.edges() == set()
+
+
+# -- chaos regression tests for the races the triage fixed --------------
+
+class TestConcurrencyRegressions:
+    def test_device_prefetcher_exception_never_lost(self):
+        """The producer's except and the consumer's take-once swap share
+        _exc_lock: across many producer-crash timings the exception
+        surfaces on the consumer EXACTLY once, never silently truncating
+        the epoch (the LK001 race on _DevicePrefetcher._exc)."""
+        from paddle_tpu.io.dataloader import _DevicePrefetcher
+        for k in range(25):
+            def produce(k=k):
+                for i in range(k % 3):
+                    yield np.ones(2, np.float32)
+                raise ValueError(f"boom{k}")
+            pf = _DevicePrefetcher(produce, size=1)
+            items = excs = 0
+            while True:
+                try:
+                    next(pf)
+                    items += 1
+                except ValueError:
+                    excs += 1
+                except StopIteration:
+                    break
+            assert excs == 1 and items == k % 3, (k, items, excs)
+
+    def test_prefetch_iterator_exception_never_lost(self):
+        """Same contract for the native-ring prefetcher: _slots_lock
+        doubles as the _exc guard (the LK001 race on
+        _PrefetchIterator._exc)."""
+        from paddle_tpu.io.dataloader import _PrefetchIterator
+        for k in range(25):
+            def produce(k=k):
+                for i in range(k % 3):
+                    yield i
+                raise ValueError(f"boom{k}")
+            it = _PrefetchIterator(produce, 1, lambda x: x)
+            items = excs = 0
+            while True:
+                try:
+                    next(it)
+                    items += 1
+                except ValueError:
+                    excs += 1
+                except StopIteration:
+                    break
+            assert excs == 1 and items == k % 3, (k, items, excs)
+
+    def test_rpc_shutdown_joins_agent_thread(self):
+        """rpc.shutdown() joins the serve_forever thread instead of
+        abandoning it (the LK006 leak on rpc init)."""
+        from paddle_tpu.distributed import rpc
+        ep = f"127.0.0.1:{_free_port()}"
+        rpc.init_rpc("solo", rank=0, world_size=1, master_endpoint=ep)
+        t = rpc._state["thread"]
+        assert t.is_alive()
+        rpc.shutdown()
+        assert not t.is_alive()
+        assert not rpc._state
+
+    def test_kv_server_stop_joins_accept_thread(self):
+        """KVServer.stop() closes the socket AND joins the accept
+        thread; idempotent (the LK006 leak on launch.kv.start_server)."""
+        from paddle_tpu.distributed.launch import kv
+        srv = kv.start_server()
+        t = srv._serve_thread
+        assert t is not None and t.is_alive()
+        client = kv.KVClient(f"127.0.0.1:{srv.port}")
+        try:
+            client.set("lk", "1")
+            assert client.get("lk") == "1"
+        finally:
+            client.close()
+        srv.stop()
+        assert not t.is_alive()
+        srv.stop()                      # second stop: no-op

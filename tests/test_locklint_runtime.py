@@ -1,0 +1,160 @@
+"""TracedLock runtime cross-check (ISSUE 19 acceptance): wrap the real
+serving locks in TracedLock, drive the threaded frontend + HTTP server
+through accepted AND rejected requests, and assert that every OBSERVED
+lock-acquisition edge is present in the static LK003 graph — and that
+the observed graph is acyclic.
+
+Static analysis can miss orders that only occur through indirection;
+this test proves the two sides agree on the serving stack's real
+ordering: handler threads take the server lock before the scheduler
+lock, and the scheduler lock before a handle's condition variable
+(the admission-reject path).  Also pins the ISSUE 19 LK006 fix: the
+accept and housekeeper threads are joined dead by close().
+"""
+
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu import parallel as dist
+from paddle_tpu.analysis.threads import model as tm
+from paddle_tpu.aot.serve import export_engine
+from paddle_tpu.inference.serving import ContinuousBatchingEngine
+from paddle_tpu.models.llama import build_llama_train_step, llama_tiny
+from paddle_tpu.observability import LockOrderRecorder, TracedLock
+from paddle_tpu.parallel.topology import HybridTopology, set_topology
+from paddle_tpu.serving import (AdmissionConfig, HttpServingServer,
+                                ServingFrontend)
+from paddle_tpu.serving import frontend as frontend_mod
+from paddle_tpu.serving.http import iter_sse
+
+import json
+import http.client
+
+rng = np.random.default_rng(0)
+
+GEOM = dict(max_batch=2, block_size=8, num_blocks=64,
+            prefill_buckets=(8,))
+
+FRONTEND_LOCK = "paddle_tpu/serving/frontend.py::ServingFrontend._lock"
+HANDLE_COND = "paddle_tpu/serving/frontend.py::RequestHandle._cond"
+HTTP_LOCK = "paddle_tpu/serving/http.py::HttpServingServer._lock"
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama_tiny()
+    topo = dist.init_topology(devices=jax.devices()[:1])
+    _, init_fn = build_llama_train_step(cfg, topo, num_microbatches=1)
+    params = init_fn(0)["params"]
+    set_topology(HybridTopology())
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def aot_dir(model):
+    cfg, params = model
+    d = tempfile.mkdtemp(prefix="locklint_aot_")
+    export_engine(ContinuousBatchingEngine(cfg, params, **GEOM), d)
+    return d
+
+
+def _engine(model, aot_dir, **kw):
+    cfg, params = model
+    geom = dict(GEOM)
+    geom.update(kw)
+    return ContinuousBatchingEngine(cfg, params, aot_dir=aot_dir, **geom)
+
+
+def _post(port, path, payload, timeout=60.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, json.dumps(payload),
+                 {"Content-Type": "application/json"})
+    return conn, conn.getresponse()
+
+
+def _instrument(fe, srv, rec):
+    fe._lock = TracedLock(fe._lock, FRONTEND_LOCK, rec)
+    srv._lock = TracedLock(srv._lock, HTTP_LOCK, rec)
+
+
+def test_static_graph_contains_serving_spine():
+    """The static LK003 graph knows the serving stack's lock ordering
+    without running anything: server lock → scheduler lock (typed-attr
+    call closure) and scheduler lock → handle condvar (the reject path,
+    through a local constructor alias)."""
+    edges = set(tm.build_project_graph(["paddle_tpu/serving"]))
+    assert (HTTP_LOCK, FRONTEND_LOCK) in edges, sorted(edges)
+    assert (FRONTEND_LOCK, HANDLE_COND) in edges, sorted(edges)
+
+
+def test_observed_lock_order_within_static_graph(model, aot_dir,
+                                                 monkeypatch):
+    static = set(tm.build_project_graph(["paddle_tpu/serving"]))
+    rec = LockOrderRecorder()
+
+    # every RequestHandle's condvar reports to the recorder under the
+    # static model's lock id
+    orig_init = frontend_mod.RequestHandle.__init__
+
+    def traced_init(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        self._cond = TracedLock(self._cond, HANDLE_COND, rec)
+
+    monkeypatch.setattr(frontend_mod.RequestHandle, "__init__",
+                        traced_init)
+
+    prompt = rng.integers(0, model[0].vocab_size, (5,)).astype(np.int32)
+
+    # lane 1: an accepted, fully streamed SSE request (handler thread →
+    # server lock → scheduler lock; driver thread streams tokens)
+    fe = ServingFrontend(_engine(model, aot_dir))
+    srv = HttpServingServer(fe, heartbeat_s=0.1)
+    _instrument(fe, srv, rec)
+    with srv:
+        accept_t, housekeeper_t = srv._serve_thread, srv._housekeeper
+        conn, resp = _post(srv.port, "/v1/generate",
+                           {"prompt_ids": prompt.tolist(),
+                            "max_new_tokens": 4})
+        try:
+            assert resp.status == 200
+            events = [e for e, _ in iter_sse(resp)]
+            assert events[-1] == "done"
+        finally:
+            conn.close()
+    # the ISSUE 19 LK006 fix: close() joins the accept loop and the
+    # housekeeper, not just the driver
+    assert not accept_t.is_alive()
+    assert not housekeeper_t.is_alive()
+
+    # lane 2: an admission-rejected request — _finish runs under the
+    # scheduler lock, taking the handle condvar (the deepest edge)
+    fe2 = ServingFrontend(_engine(model, aot_dir),
+                          admission=AdmissionConfig(max_queue_len=0))
+    srv2 = HttpServingServer(fe2)
+    _instrument(fe2, srv2, rec)
+    with srv2:
+        conn, resp = _post(srv2.port, "/v1/generate",
+                           {"prompt_ids": prompt.tolist(),
+                            "max_new_tokens": 4, "stream": False})
+        try:
+            assert resp.status == 429
+            assert json.loads(resp.read())["state"] == "REJECTED"
+        finally:
+            conn.close()
+
+    observed = rec.edges()
+    # the drive actually produced the interesting orderings
+    assert (HTTP_LOCK, FRONTEND_LOCK) in observed
+    assert (FRONTEND_LOCK, HANDLE_COND) in observed
+    assert rec.acquired() >= {HTTP_LOCK, FRONTEND_LOCK, HANDLE_COND}
+    # THE cross-check: nothing observed at runtime is missing from the
+    # static LK003 graph, and the observed order itself is acyclic
+    extra = observed - static
+    assert not extra, (
+        "runtime observed lock orderings the static graph misses: "
+        + "; ".join(f"{a} -> {b} (thread {rec.witness((a, b))})"
+                    for a, b in sorted(extra)))
+    assert rec.cycles() == []

@@ -23,7 +23,7 @@ def _tpu_available():
     import time
     # recent probe-loop verdict avoids re-paying the wedged-tunnel timeout
     log = os.path.join(os.path.dirname(__file__), "..", "tools",
-                       "tpu_probe.log")
+                       "out", "tpu_probe.log")
     try:
         last = json.loads(open(log).read().strip().splitlines()[-1])
         ts = time.mktime(time.strptime(last["ts"], "%Y-%m-%dT%H:%M:%SZ"))

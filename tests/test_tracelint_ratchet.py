@@ -6,6 +6,7 @@ same comparison `python tools/tracelint_baseline.py --check` runs
 standalone (pre-commit style).
 """
 
+import functools
 import os
 import subprocess
 import sys
@@ -20,8 +21,15 @@ CORE_TREES = ("paddle_tpu/checkpoint/", "paddle_tpu/io/",
               "paddle_tpu/optimizer/", "paddle_tpu/parallel/")
 
 
+@functools.lru_cache(maxsize=1)
+def _scan_once():
+    # the committed tree is immutable for the lifetime of the test run;
+    # one full scan serves every ratchet assertion below
+    return tuple(core.run(default_paths()))
+
+
 def _current_findings():
-    return core.run(default_paths())
+    return list(_scan_once())
 
 
 def test_package_at_or_below_baseline():

@@ -323,7 +323,9 @@ def main() -> None:
                          "error": f"timeout {int(time.time() - t0)}s"})
         print(f"[{name}] done in {time.time() - t0:.0f}s", file=sys.stderr)
 
-    with open(os.path.join(REPO, "tools", "bench_proxy.json"), "w") as f:
+    out_dir = os.path.join(REPO, "tools", "out")
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "bench_proxy.json"), "w") as f:
         json.dump(rows, f, indent=1)
     _write_md(rows)
 

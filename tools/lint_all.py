@@ -1,0 +1,50 @@
+"""Run every static-analysis ratchet in one invocation.
+
+``python tools/lint_all.py`` analyzes the lint surface ONCE (one
+parse, one rule pass) and checks each ledger's ratchet —
+TRACELINT.md (TL), KERNELLINT.md (KL), LOCKLINT.md (LK) — printing a
+one-line verdict per ledger.  Exit status is non-zero if any lane is
+above its committed baseline.  This is the pre-push / CI entry point;
+the per-tool scripts (``tracelint_baseline.py`` etc.) remain for
+regenerating individual ledgers.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.analysis import baseline, core       # noqa: E402
+from paddle_tpu.analysis.cli import default_paths    # noqa: E402
+
+
+def run_all() -> int:
+    findings = core.run(default_paths())
+    failed = 0
+    for fname, prefix, tool in baseline.LEDGERS:
+        lane = [f for f in findings if f.rule.startswith(prefix)]
+        path = os.path.join(baseline.repo_root(), fname)
+        try:
+            base = baseline.load(path)
+        except (OSError, ValueError) as e:
+            print(f"{tool}: FAIL — cannot load {fname}: {e}")
+            failed += 1
+            continue
+        regressions = baseline.compare(baseline.counts(lane), base)
+        if regressions:
+            print(f"{tool}: FAIL — {len(regressions)} (rule, file) "
+                  f"pairs above {fname}:")
+            for r in regressions:
+                print(f"  {r}")
+            failed += 1
+        else:
+            print(f"{tool}: OK — {len(lane)} findings, none above "
+                  f"{fname}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run_all())

@@ -1,25 +1,32 @@
 """TPU chip-acquisition probe (VERDICT r2 item 1; auto-seize r4 item 1a).
 
 Runs ``jax.devices()`` in a subprocess under a wall-clock timeout and
-appends a timestamped JSON line to ``tools/tpu_probe.log``. Run this
-repeatedly through the round; the log is the evidence trail either way.
+appends a timestamped JSON line to ``tools/out/tpu_probe.log``. Run
+this repeatedly through the round; the log is the evidence trail
+either way.
 
 On the FIRST successful probe (``--seize``, the default when run as a
 script), it immediately runs the full hardware evidence suite with zero
 human latency:
-  1. ``bench.py``                    -> tools/bench_tpu.json
-  2. ``bench_sweep.py``              -> tools/bench_sweep_tpu.json
-  3. ``pytest tests -m tpu``         -> tools/pytest_tpu.log
+  1. ``bench.py``                    -> tools/out/bench_tpu.json
+  2. ``bench_sweep.py``              -> tools/out/bench_sweep_tpu.json
+  3. ``pytest tests -m tpu``         -> tools/out/pytest_tpu.log
 and appends a results section to BASELINE.md.  A sentinel file
-(tools/tpu_seized.json) prevents double-runs.
+(tools/out/tpu_seized.json) prevents double-runs.
+
+Everything under ``tools/out/`` is gitignored: the committed evidence
+is the BASELINE.md section (plus the autotune cache when this suite
+refreshed it) — raw artifacts stay out of the repository.
 """
 import json, os, subprocess, sys, time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SENTINEL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "tpu_seized.json")
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(TOOLS, "out")
+os.makedirs(OUT, exist_ok=True)
+SENTINEL = os.path.join(OUT, "tpu_seized.json")
 
-LOG = os.path.join(os.path.dirname(__file__), "tpu_probe.log")
+LOG = os.path.join(OUT, "tpu_probe.log")
 # one source for the bench.py --config rows the seize suite runs AND
 # whose artifacts it commits — keep these in lockstep by construction
 BENCH_CONFIGS = ("lenet", "resnet50", "bert", "llama", "decode",
@@ -82,9 +89,10 @@ def probe(timeout=240):
 
 def seize(tag=""):
     """Run the full hardware-evidence suite once the chip is reachable.
-    Idempotent via the sentinel file; every artifact lands in tools/ and
-    BASELINE.md so the round's evidence exists even if the tunnel wedges
-    again minutes later.
+    Idempotent via the sentinel file; every artifact lands in the
+    gitignored ``tools/out/`` and the results summary in BASELINE.md,
+    so the round's evidence exists even if the tunnel wedges again
+    minutes later.
 
     ``tag``: names a measurement generation (e.g. ``r4b`` after a kernel
     change) — each tag gets its own sentinel + artifact suffix, so the
@@ -93,7 +101,7 @@ def seize(tag=""):
     if os.path.exists(sentinel):
         return
     suffix = f"_{tag}" if tag else ""
-    tdir = os.path.dirname(os.path.abspath(__file__))
+    tdir = OUT
     suite_t0 = time.time()
     results = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                "tag": tag, "status": "in_progress"}
@@ -117,7 +125,7 @@ def seize(tag=""):
             # repo as evidence (ops/pallas/autotune.py merge-writes it);
             # later windows skip the timed sweeps entirely
             env.setdefault("PADDLE_TPU_AUTOTUNE_CACHE",
-                           os.path.join(tdir, "autotune_cache.json"))
+                           os.path.join(TOOLS, "autotune_cache.json"))
             r = subprocess.run(cmd, capture_output=True, text=True,
                                timeout=timeout, cwd=REPO, env=env)
             # keep .json artifacts pure JSON; stderr goes to a .log sibling
@@ -215,28 +223,18 @@ def seize(tag=""):
                 f" at {results['ts']})\n\n```json\n"
                 + json.dumps(results, indent=1) + "\n```\n")
     try:
-        # commit ONLY the artifacts this function produced — never the
-        # whole working tree (edits may be in progress)
-        artifacts = ["BASELINE.md", os.path.relpath(sentinel, REPO),
-                     "tools/tpu_probe.log"]
+        # commit ONLY what this function produced that belongs in git:
+        # the BASELINE.md summary and (when fresh) the autotune table.
+        # Raw bench/probe artifacts stay in the gitignored tools/out/.
+        artifacts = ["BASELINE.md"]
         # commit the autotune table only if THIS suite wrote it (the env
         # default points here unless the operator overrode it, and a
         # stale file from an aborted run must not pass as fresh evidence)
-        at_cache = os.path.join(tdir, "autotune_cache.json")
+        at_cache = os.path.join(TOOLS, "autotune_cache.json")
         if (os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE", at_cache)
                 == at_cache and os.path.exists(at_cache)
                 and os.path.getmtime(at_cache) >= suite_t0):
             artifacts.append("tools/autotune_cache.json")
-        # exact names this run wrote — a glob would sweep in stale
-        # artifacts left behind by aborted runs of OTHER tags
-        produced = [f"bench_tpu{suffix}.json",
-                    f"bench_sweep_tpu{suffix}.json",
-                    f"pytest_tpu{suffix}.log"]
-        produced += [f"bench_tpu_{c}{suffix}.json"
-                     for c in BENCH_CONFIGS]
-        produced += [f + ".stderr.log" for f in list(produced)]
-        artifacts += [os.path.join("tools", f) for f in produced
-                      if os.path.exists(os.path.join(tdir, f))]
         subprocess.run(["git", "add", "--"] + artifacts, cwd=REPO,
                        timeout=60)
         subprocess.run(["git", "commit", "-m",
