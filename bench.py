@@ -976,6 +976,77 @@ def _serve_prefill_extra(cfg, params, *, mb, nb, on_accel, t0, new):
         return {"prefill_error": f"{type(e).__name__}: {e}"}
 
 
+def _serve_tracing_extra(cfg, params, *, mb, nb, on_accel, t0, new):
+    """Span-tracer overhead A/B for the serve row (ISSUE 20): the same
+    seeded Poisson load through a compile-warm engine with the request
+    tracer off and on, reporting tokens/s and TTFT p50 both ways plus
+    the traced run's per-phase TTFT/TPOT attribution.  The acceptance
+    bar is <2% throughput overhead (docs/observability.md).  Never
+    fails the row — errors land in extra.tracing_error."""
+    from paddle_tpu.observability.tracing import TRACER
+
+    was_enabled = TRACER.enabled
+    try:
+        from paddle_tpu.inference.serving import ContinuousBatchingEngine
+        from paddle_tpu.serving import (AdmissionConfig, LoadGenConfig,
+                                        PoissonLoadGenerator,
+                                        ServingFrontend)
+
+        lg = LoadGenConfig(
+            n_requests=16 if not on_accel else 32,
+            rate_rps=100.0 if not on_accel else 8.0, seed=20,
+            prompt_len=(3, t0), max_new_tokens=(3, new),
+            sampled_fraction=0.25, cancel_fraction=0.1,
+            slo_ttft_s=5.0 if not on_accel else 2.0,
+            slo_tpot_s=1.0 if not on_accel else 0.25)
+
+        def run_once(traced):
+            eng = ContinuousBatchingEngine(
+                cfg, params, max_batch=mb, block_size=16, num_blocks=nb,
+                prefill_buckets=(t0,))
+            # compile-warm the bucket fill, decode and the sampler so
+            # the A/B measures serving, not XLA compiles
+            eng.add_request(np.arange(1, t0 + 1, dtype=np.int32), 4)
+            eng.add_request(np.arange(1, t0 + 1, dtype=np.int32), 4,
+                            temperature=0.7, top_k=8, seed=1)
+            eng.run_to_completion()
+            if traced:
+                TRACER.enable()
+                TRACER.reset()
+            else:
+                TRACER.disable()
+            rep = PoissonLoadGenerator(
+                ServingFrontend(eng, admission=AdmissionConfig(
+                    max_queue_len=64)), lg).run().to_dict()
+            leak = eng.kv_leak_report()
+            if leak["leaked"] or leak["unaccounted"]:
+                raise RuntimeError(f"tracing A/B leaked KV: {leak}")
+            return rep
+
+        rep_off = run_once(False)
+        rep_on = run_once(True)
+        tps_off = rep_off["tokens_per_s"]
+        tps_on = rep_on["tokens_per_s"]
+        return {"tracing": {
+            "tokens_per_s_off": tps_off,
+            "tokens_per_s_on": tps_on,
+            "overhead_pct": round(
+                (tps_off - tps_on) / tps_off * 100.0, 2)
+            if tps_off else None,
+            "ttft_p50_off": (rep_off["ttft_s"] or {}).get("p50"),
+            "ttft_p50_on": (rep_on["ttft_s"] or {}).get("p50"),
+            "kv_leaked_blocks": rep_on["kv_leaked_blocks"],
+            "attribution": rep_on.get("attribution"),
+        }}
+    except Exception as e:
+        return {"tracing_error": f"{type(e).__name__}: {e}"}
+    finally:
+        if was_enabled:
+            TRACER.enable()
+        else:
+            TRACER.disable()
+
+
 def _train_aot_warm_extra(step_fn, state, ids, labels, ttfs_cold):
     """Cold-vs-warm for the llama train row: serialize the (undonated
     re-jit of the) train step, deserialize, and time load + first step
@@ -1349,6 +1420,9 @@ def run_config_bench(config: str):
             cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
             new=new))
         out["extra"].update(_serve_prefill_extra(
+            cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
+            new=new))
+        out["extra"].update(_serve_tracing_extra(
             cfg, params, mb=mb, nb=nb, on_accel=on_accel, t0=t0,
             new=new))
     elif config == "decode":

@@ -603,6 +603,19 @@ class Model:
             skipped=self._last_step_skipped,
             consecutive_skips=(guard.consecutive if guard else 0),
             skipped_total=(guard.total_skipped if guard else 0))
+        from ..observability.tracing import TRACER
+        if TRACER.enabled:
+            # training twin of the serve-path request trace: one span
+            # per trained batch on the process-wide training timeline
+            tr = TRACER.train_trace()
+            t1 = tr.now()
+            # the first step can predate the lazily-created trace
+            # (compile time): clamp into the trace window, keep the
+            # true duration in secs=
+            tr.add("train_step", max(t1 - step_secs, 0.0), t1,
+                   step=self._step_count, loss=float(loss),
+                   secs=round(step_secs, 6),
+                   skipped=bool(self._last_step_skipped))
 
     # -- fault tolerance machinery (checkpoint/) -----------------------
     def _checkpoint_payload(self, epoch: int, step_in_epoch: int,

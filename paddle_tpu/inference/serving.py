@@ -23,6 +23,7 @@ pinned by tests/test_serving_engine.py against a batch-of-one engine.
 from __future__ import annotations
 
 import collections
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -594,7 +595,25 @@ class ContinuousBatchingEngine:
                          priority=int(priority))
         self._next_id += 1
         self.queue.append(req)
+        # request tracing (ISSUE 20): adopt the ambient trace the
+        # frontend/supervisor activated around this call; the queue
+        # mark becomes the queue_wait span at admission
+        from ..observability.tracing import TRACER
+        if TRACER.enabled:
+            tr = TRACER.current()
+            if tr is not None:
+                req.trace = tr
+                tr.mark("enqueued")
         return req.req_id
+
+    @staticmethod
+    def _trace_of(req: "GenRequest"):
+        """The request's live trace, or None (tracing disabled, or the
+        request was submitted with no trace active)."""
+        from ..observability.tracing import TRACER
+        if not TRACER.enabled:
+            return None
+        return getattr(req, "trace", None)
 
     def _pick_token(self, req: GenRequest, logits: np.ndarray,
                     position: int) -> int:
@@ -938,12 +957,18 @@ class ContinuousBatchingEngine:
             raise ValueError(f"slot {slot} is not running a request")
         import time
         from ..serving.resilience import snapshot_slot
+        tr = self._trace_of(req)
+        t_sp = tr.now() if tr is not None else 0.0
         t0 = time.perf_counter()
         snap = snapshot_slot(self, slot)
         self._spill_put(req.req_id, snap)
         self._free_slot(slot)
         self.queue.appendleft(req)
         dt = time.perf_counter() - t0
+        if tr is not None:
+            tr.add("preempt_spill", t_sp, tr.now(),
+                   committed=int(snap.length), priority=req.priority)
+            tr.mark("enqueued")    # queue_wait resumes until re-admission
         self.resilience["preemptions"] += 1
         self.resilience["spill_save_secs"] += dt
         from ..observability import REGISTRY
@@ -1022,6 +1047,12 @@ class ContinuousBatchingEngine:
         if priv is None:
             return False
         del self.queue[idx]
+        tr = self._trace_of(req)
+        if tr is not None:
+            t_rs = tr.now()
+            tq = tr.take_mark("enqueued")
+            if tq is not None:
+                tr.add("queue_wait", tq, t_rs)
         self.block_table[slot, :] = -1
         self.block_table[slot, :snap.num_blocks] = priv
         self.slot_pages[slot] = priv
@@ -1054,6 +1085,9 @@ class ContinuousBatchingEngine:
             REGISTRY.event("serve", action="restore", req_id=req.req_id,
                            priority=req.priority,
                            committed=int(snap.length))
+        if tr is not None:
+            tr.add("preempt_restore", t_rs, tr.now(),
+                   committed=int(snap.length))
         return True
 
     def _replay_into_slot(self, slot: int, req: GenRequest,
@@ -1083,6 +1117,12 @@ class ContinuousBatchingEngine:
         self._note_prefix_lookup(L + restored)
         self.stats["prefix_blocks_reused"] += L + restored
         del self.queue[idx]
+        tr = self._trace_of(req)
+        if tr is not None:
+            t_rp = tr.now()
+            tq = tr.take_mark("enqueued")
+            if tq is not None:
+                tr.add("queue_wait", tq, t_rp)
         table = shared + priv
         self.block_table[slot, :] = -1
         self.block_table[slot, :need] = table
@@ -1108,6 +1148,10 @@ class ContinuousBatchingEngine:
                 "serve.resilience.prefix_replays_total").inc()
             REGISTRY.event("serve", action="prefix_replay",
                            req_id=req.req_id, committed=len(committed))
+        if tr is not None:
+            tr.add("prefix_replay", t_rp, tr.now(),
+                   committed=len(committed),
+                   cached_blocks=L + restored)
         return True
 
     def _prefill_into_slot(self, slot: int, req: GenRequest,
@@ -1210,6 +1254,8 @@ class ContinuousBatchingEngine:
             if priv is None:
                 self.alloc.release(shared)
                 break                      # head-of-line waits for pages
+            tr = self._trace_of(req)
+            t_rs = tr.now() if tr is not None else 0.0
             # offloaded continuation: exact bytes scatter into the
             # leading private pages (no recompute); a CRC failure
             # cleanly demotes the rest to ordinary suffix prefill
@@ -1217,6 +1263,18 @@ class ContinuousBatchingEngine:
             self._note_prefix_lookup(L + restored)
             self.stats["prefix_blocks_reused"] += L + restored
             del self.queue[idx]
+            if tr is not None:
+                tq = tr.take_mark("enqueued")
+                if tq is not None:
+                    tr.add("queue_wait", tq, t_rs)
+                if off:
+                    tr.add("prefix_restore", t_rs, tr.now(),
+                           blocks=restored)
+                if L + restored:
+                    tr.event("prefix_hit", cached_blocks=L,
+                             restored_blocks=restored,
+                             tokens_skipped=(L + restored) * self.BS)
+                t_pf = tr.now()
             table = shared + priv
             self.block_table[slot, :] = -1
             self.block_table[slot, :need] = table
@@ -1236,7 +1294,14 @@ class ContinuousBatchingEngine:
                 self.slot_pages[slot] = []
                 self.block_table[slot, :] = -1
                 self.queue.appendleft(req)
+                if tr is not None:
+                    tr.add("prefill", t_pf, tr.now(), tokens=T0,
+                           error=True)
+                    tr.mark("enqueued")   # still waiting (retry/replay)
                 raise
+            if tr is not None:
+                tr.add("prefill", t_pf, tr.now(), tokens=T0,
+                       cached_tokens=(L + restored) * self.BS)
             self._append_tok(req, first)
             self.slots[slot] = req
             self.lengths[slot] = T0
@@ -1318,12 +1383,27 @@ class ContinuousBatchingEngine:
             out = self.finished
             self.finished = {}
             return out
+        from ..observability.tracing import TRACER
+        _tracing = TRACER.enabled
         if self._spec is not None and self._spec.config.enabled:
             # speculative decode: draft K, verify K+1 in one dispatch,
             # commit the accepted prefix (spec_decode/runner.py) —
             # greedy output is bit-identical to the baseline branch
             pre = sum(len(self.slots[s].out) for s in active)
+            pre_by_slot = {s: len(self.slots[s].out) for s in active} \
+                if _tracing else None
+            m0 = time.monotonic() if _tracing else 0.0
             self._spec.run_decode(active)
+            if _tracing:
+                m1 = time.monotonic()
+                for s in active:
+                    r = self.slots[s]
+                    tr = self._trace_of(r) if r is not None else None
+                    if tr is not None:
+                        tr.add("spec_decode_step",
+                               m0 - tr.mono_t0, m1 - tr.mono_t0,
+                               batch=len(active),
+                               committed=len(r.out) - pre_by_slot[s])
             self.decode_steps += 1
             self.decode_slot_steps += len(active)
             self.decode_tokens += \
@@ -1331,6 +1411,7 @@ class ContinuousBatchingEngine:
             out = self.finished
             self.finished = {}
             return out
+        m0 = time.monotonic() if _tracing else 0.0
         self.pool_k, self.pool_v, logits = self._step(
             self.params, self.pool_k, self.pool_v,
             jnp.asarray(self.block_table), jnp.asarray(self.lengths),
@@ -1355,6 +1436,13 @@ class ContinuousBatchingEngine:
                 tok = int(self.last_logits[s].argmax())
             self._append_tok(req, int(tok))
             self.tokens[s] = int(tok)
+        if _tracing:
+            m1 = time.monotonic()
+            for s in active:
+                tr = self._trace_of(self.slots[s])
+                if tr is not None:
+                    tr.add("decode_step", m0 - tr.mono_t0,
+                           m1 - tr.mono_t0, batch=len(active))
         self.decode_steps += 1
         self.decode_slot_steps += len(active)
         self.decode_tokens += len(active)

@@ -18,6 +18,12 @@ The measurement layer every perf/robustness PR is judged against:
   recompile counts and trace→lower→compile durations.
 * :class:`TelemetrySession` / :func:`observe` — the one knob that wires
   all of the above; ``Model.fit(observe=True)`` uses it.
+* :class:`SpanTracer` / :data:`TRACER` — end-to-end request tracing
+  (ISSUE 20): per-request span timelines across wire → router →
+  engine, with chrome-trace export and per-phase latency-budget
+  attribution.  Disabled by default, one-boolean short-circuit like
+  the registry; SLO-violating requests keep their span tree in the
+  flight ring (``docs/observability.md``).
 * :class:`TracedLock` / :class:`LockOrderRecorder` — test-time lock
   wrapper recording acquisition order, asserted against the static
   LK003 lock-order graph (``analysis/threads``) so runtime-only
@@ -37,6 +43,8 @@ from .compile_monitor import CompileMonitor
 from .hw import estimate_mfu, peak_flops_per_chip
 from .session import TelemetrySession, observe
 from .traced_lock import LockOrderRecorder, TracedLock
+from .tracing import (Span, SpanTracer, Trace, TRACER, attribution,
+                      export_chrome, write_spans_jsonl)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
@@ -44,4 +52,6 @@ __all__ = [
     "CompileMonitor", "TelemetrySession", "observe",
     "estimate_mfu", "peak_flops_per_chip",
     "LockOrderRecorder", "TracedLock",
+    "Span", "SpanTracer", "Trace", "TRACER", "attribution",
+    "export_chrome", "write_spans_jsonl",
 ]

@@ -433,6 +433,17 @@ class ElasticTrainer:
                     world_size=new_topo.world_size,
                     recovery_s=round(self.last_recovery_s, 4),
                     cause=f"{type(err).__name__}: {err}")
+        from ..observability.tracing import TRACER
+        if TRACER.enabled:
+            tr = TRACER.train_trace()
+            t1 = tr.now()
+            # a reshape can predate the lazily-created trace: clamp
+            # into the trace window, keep the true duration in secs=
+            tr.add("reshape", max(t1 - self.last_recovery_s, 0.0), t1,
+                   carryover=bool(carry), replayed=int(replayed),
+                   secs=round(self.last_recovery_s, 6),
+                   world_size=int(new_topo.world_size),
+                   cause=type(err).__name__)
         self._step_times.clear()
         self._deadline_strikes = 0
         self._set_state(HEALTHY)

@@ -75,6 +75,7 @@ import numpy as np
 
 from ..inference.serving import GenRequest
 from ..observability import REGISTRY
+from ..observability.tracing import TRACER
 from .frontend import AdmissionConfig
 from .resilience import (PortableRequest, RecoveryExhaustedError,
                          ResilienceError, RetryPolicy, SupervisedEngine)
@@ -320,12 +321,13 @@ class EngineRouter:
             if not cands:
                 continue
             best = min(cands, key=self._load_key)
+            why, chosen, depth = "least_loaded", best, 0
             if prefix_keys:
                 matched = [(r.sup.prefix_match_blocks(prefix_keys), r)
                            for r in cands]
                 aff = [(m, r) for m, r in matched if m > 0]
                 if aff:
-                    _, target = min(
+                    m, target = min(
                         aff, key=lambda t: (-t[0],) + self._load_key(t[1]))
                     t_load = (target.sup.queue_depth
                               + target.sup.active_requests)
@@ -336,12 +338,22 @@ class EngineRouter:
                         if self._reg.enabled:
                             self._reg.counter(
                                 "serve.fleet.affinity_hits_total").inc()
-                        return target
-                    self.stats["affinity_capped"] += 1
-                    if self._reg.enabled:
-                        self._reg.counter(
-                            "serve.fleet.affinity_capped_total").inc()
-            return best
+                        why, chosen, depth = "affinity_hit", target, m
+                    else:
+                        self.stats["affinity_capped"] += 1
+                        if self._reg.enabled:
+                            self._reg.counter(
+                                "serve.fleet.affinity_capped_total").inc()
+                        why, depth = "affinity_capped", m
+            if TRACER.enabled:
+                # request tracing (ISSUE 20): the placement decision —
+                # replica chosen and WHY — as an instant on the ambient
+                # trace (active during submit and re-placement)
+                tr = TRACER.current()
+                if tr is not None:
+                    tr.event("placement", replica=chosen.idx, why=why,
+                             tier=state.value, matched_blocks=depth)
+            return chosen
         return None
 
     def add_request(self, prompt_ids, max_new_tokens: int,
@@ -561,25 +573,37 @@ class EngineRouter:
             if portable.snapshot is not None \
             else self._blocks_needed(
                 len(portable.prompt) + portable.max_new)
-        target = self._pick_replica(
-            need, exclude=p.replica,
-            prefix_keys=self._prefix_keys(portable.prompt))
-        if target is None:
-            # admission knobs must not strand an ALREADY-admitted
-            # request: fall back to any live replica, least loaded
-            cands = [r for r in self._live() if r.idx != p.replica] \
-                or self._live()
-            if not cands:
-                # keep the placement so the next step() still sees a
-                # live request on a dead fleet and escalates typed —
-                # the stream must abort, never silently vanish
-                self._placements[rid] = p
-                raise FleetExhaustedError(
-                    "every replica in the fleet is dead; request "
-                    f"{rid} cannot be re-placed")
-            target = min(cands, key=self._load_key)
-        sid = target.sup.adopt_request(portable)
+        # request tracing (ISSUE 20): re-place under the ORIGINAL trace
+        # (the router rid IS the frontend rid the tracer indexed), so a
+        # mid-stream replica kill keeps one trace_id across the move
+        tr = TRACER.lookup(rid=rid) if TRACER.enabled else None
+        t_mv = tr.now() if tr is not None else 0.0
+        src = p.replica
+        with TRACER.activating(tr):
+            target = self._pick_replica(
+                need, exclude=p.replica,
+                prefix_keys=self._prefix_keys(portable.prompt))
+            if target is None:
+                # admission knobs must not strand an ALREADY-admitted
+                # request: fall back to any live replica, least loaded
+                cands = [r for r in self._live() if r.idx != p.replica] \
+                    or self._live()
+                if not cands:
+                    # keep the placement so the next step() still sees a
+                    # live request on a dead fleet and escalates typed —
+                    # the stream must abort, never silently vanish
+                    self._placements[rid] = p
+                    raise FleetExhaustedError(
+                        "every replica in the fleet is dead; request "
+                        f"{rid} cannot be re-placed")
+                target = min(cands, key=self._load_key)
+            sid = target.sup.adopt_request(portable)
         obj = target.sup.tracked_request(sid)
+        if tr is not None:
+            tr.add("re_place", t_mv, tr.now(), from_replica=src,
+                   to_replica=target.idx, committed=len(out),
+                   snapshot=portable.snapshot is not None)
+            tr.meta["replayed"] = True
         p.replica = target.idx
         p.sid = sid
         p.obj = obj

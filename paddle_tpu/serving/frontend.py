@@ -63,6 +63,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from .metrics import ServeMetrics
+from ..observability.tracing import TRACER
 
 __all__ = ["AdmissionConfig", "RequestAborted", "RequestHandle",
            "RequestRejected", "RequestState", "ServingFrontend"]
@@ -146,6 +147,7 @@ class RequestHandle:
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.req_id: Optional[int] = None
+        self.trace = None          # the request's Trace when tracing is on
         self.submit_t = submit_t
         self.first_token_t: Optional[float] = None
         self.finish_t: Optional[float] = None
@@ -472,14 +474,23 @@ class ServingFrontend:
             now = self._clock()
             handle = RequestHandle(self, prompt, max_new_tokens, cap,
                                    now, on_token)
+            # request tracing (ISSUE 20): open the trace here — the
+            # outermost serve layer below the wire — and activate it
+            # around add_request so router/supervisor/engine spans land
+            # on it with no signature changes
+            tr = TRACER.begin(prompt_tokens=int(len(prompt)),
+                              max_new_tokens=int(max_new_tokens),
+                              priority=int(priority)) \
+                if TRACER.enabled else None
             reason = self._admission_reason(prompt, max_new_tokens)
             rid = None
             if reason is None:
                 try:
-                    rid = self.engine.add_request(
-                        prompt, max_new_tokens, eos_token_id,
-                        temperature=temperature, top_k=top_k,
-                        top_p=top_p, seed=seed, priority=priority)
+                    with TRACER.activating(tr):
+                        rid = self.engine.add_request(
+                            prompt, max_new_tokens, eos_token_id,
+                            temperature=temperature, top_k=top_k,
+                            top_p=top_p, seed=seed, priority=priority)
                 except ValueError as e:
                     if len(prompt) < 1 or max_new_tokens < 1:
                         raise                      # malformed, not load
@@ -488,8 +499,14 @@ class ServingFrontend:
                 handle._finish(RequestState.REJECTED, reason=reason,
                                now=now)
                 self.metrics.on_reject(reason)
+                if tr is not None:
+                    TRACER.finish(tr, "REJECTED", reason=reason,
+                                  registry=self.metrics.registry)
                 return handle
             handle.req_id = rid
+            if tr is not None:
+                TRACER.bind(tr, rid)
+                handle.trace = tr
             req = next(r for r in reversed(self.engine.queue)
                        if r.req_id == rid)
             ddl = deadline_s if deadline_s is not None \
@@ -523,6 +540,8 @@ class ServingFrontend:
             self.metrics.on_cancel(rid)
             self._publish()
         handle._finish(RequestState.CANCELLED, reason=reason, now=now)
+        self._finish_trace(handle.trace, "CANCELLED", handle.n_streamed,
+                           reason=reason)
         return True
 
     # ------------------------------------------------------------------
@@ -557,6 +576,12 @@ class ServingFrontend:
                         rec.handle.first_token_t = now
                         self.metrics.on_first_token(
                             rid, now - rec.handle.submit_t)
+                        tr = rec.handle.trace
+                        if tr is not None:
+                            # trace-relative TTFT: the window split
+                            # attribution() cuts the timeline at
+                            tr.meta["ttft_s"] = tr.now()
+                            tr.event("first_token")
                         if len(d.toks) > 1:
                             self.metrics.on_tokens(len(d.toks) - 1, 0.0)
                     else:
@@ -572,6 +597,7 @@ class ServingFrontend:
                     d.result = finished[rid]
                     self.metrics.on_finish(
                         rid, now - rec.handle.submit_t, n)
+                    self._finish_trace(rec.handle.trace, "FINISHED", n)
                 if d.toks or d.state is not None:
                     deliveries.append(d)
             self._publish()
@@ -697,6 +723,8 @@ class ServingFrontend:
                 rec, toks=toks, state=RequestState.TIMED_OUT,
                 reason=phase, now=now))
             self.metrics.on_timeout(rid, phase)
+            self._finish_trace(rec.handle.trace, "TIMED_OUT",
+                               len(rec.req.out), reason=phase)
 
     def _apply(self, deliveries: List[_Delivery]) -> None:
         block = threading.current_thread() is self._driver
@@ -714,6 +742,24 @@ class ServingFrontend:
     def _publish(self) -> None:
         self.metrics.publish_engine(self.engine)
 
+    def _finish_trace(self, tr, state: str, n_tokens: int = 0, *,
+                      reason: Optional[str] = None, **meta) -> None:
+        """Close a request trace on its terminal state: stamp the token
+        count and derived TPOT (decode seconds per post-first token),
+        then hand it to the tracer — which emits the span tree as a
+        ``trace`` exemplar event (FlightRecorder-visible) when the
+        request missed its SLO or ended abnormally."""
+        if tr is None:
+            return
+        ttft = tr.meta.get("ttft_s")
+        if ttft is not None and n_tokens > 1:
+            meta.setdefault("tpot_s",
+                            (tr.now() - ttft) / (n_tokens - 1))
+        if reason is not None:
+            meta.setdefault("reason", reason)
+        meta.setdefault("n_tokens", int(n_tokens))
+        TRACER.finish(tr, state, registry=self.metrics.registry, **meta)
+
     def _crash(self, exc: BaseException) -> None:
         """Engine-step failure: record, dump the serve ring for
         post-mortem, and abort every live stream so consumers get a
@@ -722,6 +768,17 @@ class ServingFrontend:
             self.error = exc   # reads error from other threads
         self.metrics.event("crash",
                            error=f"{type(exc).__name__}: {exc}")
+        with self._lock:
+            recs = list(self._recs.values())
+            self._recs.clear()
+        # close live traces FIRST: their span trees ride the ring as
+        # ``trace`` exemplar events, so the dump below is a post-mortem
+        # with timelines, not just counters
+        for rec in recs:
+            self._finish_trace(
+                rec.handle.trace, "CANCELLED", len(rec.req.out),
+                reason=f"frontend crashed: {type(exc).__name__}: {exc}",
+                crash=True)
         try:
             from ..observability.flight_recorder import FlightRecorder
             for sink in self.metrics.registry.sinks:
@@ -731,9 +788,6 @@ class ServingFrontend:
                               f"{type(exc).__name__}: {exc}")
         except Exception as dump_err:   # the dump must not mask exc
             self.metrics.event("crash_dump_failed", error=str(dump_err))
-        with self._lock:
-            recs = list(self._recs.values())
-            self._recs.clear()
         now = self._clock()
         for rec in recs:
             rec.done = True

@@ -463,6 +463,10 @@ class HttpServingServer:
                         request_id, live=not t.handle.state.terminal)
                     return t, True
             handle = self.frontend.submit(**kwargs)
+            if handle.trace is not None and request_id is not None:
+                # index the trace under the CLIENT id too, so
+                # GET /v1/trace/<request_id> resolves either id space
+                handle.trace.request_id = request_id
             t = _Tracked(handle=handle, request_id=request_id,
                          expires_t=time.monotonic() + self.dedup_window_s,
                          consumers=1)
@@ -641,6 +645,19 @@ class _RequestHandler(http.server.BaseHTTPRequestHandler):
                             None if payload["ready"]
                             else self._retry_after())
         elif self.path == "/metrics":
+            # publish-on-scrape: the engine gauges (kv_utilization,
+            # queue_depth, fleet census) are otherwise only fresh when
+            # a frontend step happens to run _publish — an idle server
+            # would serve Prometheus stale zeros forever
+            from .resilience import ResilienceError
+            fe = self.srv.frontend
+            try:
+                with fe._lock:
+                    fe._publish()
+            except ResilienceError:
+                # dead engine surface: scrape whatever gauges exist —
+                # the crash counters are the signal Prometheus needs
+                pass
             text = self.srv.metrics.registry.prometheus_text().encode()
             self.send_response(200)
             self.send_header("Content-Type",
@@ -649,8 +666,32 @@ class _RequestHandler(http.server.BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(text)
             self.wfile.flush()
+        elif self.path.startswith("/v1/trace/"):
+            self._trace_debug(self.path[len("/v1/trace/"):])
         else:
             self._send_json(404, {"error": f"unknown path {self.path}"})
+
+    def _trace_debug(self, key: str) -> None:
+        """``GET /v1/trace/<key>``: one request's span tree (live or
+        from the finished ring) as JSON — ``key`` is the server req_id,
+        the client request_id, or the trace_id (tried in that order)."""
+        from ..observability.tracing import TRACER
+        if not TRACER.enabled:
+            self._send_json(404, {
+                "error": "tracing is disabled (enable "
+                         "paddle_tpu.observability.TRACER)"})
+            return
+        tr = None
+        if key.isdigit():
+            tr = TRACER.lookup(rid=int(key))
+        if tr is None:
+            tr = TRACER.lookup(request_id=key)
+        if tr is None:
+            tr = TRACER.lookup(trace_id=key)
+        if tr is None:
+            self._send_json(404, {"error": f"no trace for {key!r}"})
+            return
+        self._send_json(200, tr.to_dict())
 
     # -- POST -----------------------------------------------------------
     def do_POST(self):
@@ -712,6 +753,14 @@ class _RequestHandler(http.server.BaseHTTPRequestHandler):
         else:
             self._blocking_json(tracked)
 
+    @staticmethod
+    def _with_trace(handle: RequestHandle,
+                    payload: Dict[str, Any]) -> Dict[str, Any]:
+        tr = getattr(handle, "trace", None)
+        if tr is not None:
+            payload["trace_id"] = tr.trace_id
+        return payload
+
     # -- blocking JSON mode ---------------------------------------------
     def _blocking_json(self, tracked: _Tracked) -> None:
         srv = self.srv
@@ -719,23 +768,27 @@ class _RequestHandler(http.server.BaseHTTPRequestHandler):
         try:
             try:
                 result = handle.result()
-                payload = {"state": "FINISHED",
-                           "req_id": handle.req_id,
-                           "tokens": handle.tokens(),
-                           "ids": np.asarray(result).tolist()}
+                payload = self._with_trace(handle, {
+                    "state": "FINISHED",
+                    "req_id": handle.req_id,
+                    "tokens": handle.tokens(),
+                    "ids": np.asarray(result).tolist()})
                 self._send_json(200, payload)
             except RequestRejected:
                 self._send_json(_reject_status(handle.reason or ""),
-                                {"state": "REJECTED",
-                                 "error": handle.reason},
+                                self._with_trace(handle, {
+                                    "state": "REJECTED",
+                                    "error": handle.reason}),
                                 self._retry_after())
             except RequestAborted as e:
                 code = _terminal_code(e.state, handle.reason)
                 hdrs = self._retry_after() if code == 503 else None
-                self._send_json(code, {"state": e.state.value,
-                                       "req_id": handle.req_id,
-                                       "reason": handle.reason,
-                                       "tokens": handle.tokens()},
+                self._send_json(code,
+                                self._with_trace(handle, {
+                                    "state": e.state.value,
+                                    "req_id": handle.req_id,
+                                    "reason": handle.reason,
+                                    "tokens": handle.tokens()}),
                                 hdrs)
         except (BrokenPipeError, ConnectionResetError,
                 socket.timeout, OSError):
@@ -751,6 +804,9 @@ class _RequestHandler(http.server.BaseHTTPRequestHandler):
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Connection", "close")
         self.send_header("X-Request-Id", str(handle.req_id))
+        tr = getattr(handle, "trace", None)
+        if tr is not None:
+            self.send_header("X-Trace-Id", tr.trace_id)
         if replayed:
             self.send_header("X-Replayed", "true")
         self.end_headers()
@@ -794,23 +850,23 @@ class _RequestHandler(http.server.BaseHTTPRequestHandler):
                     self._sse_event("token", ev)
                     last_write[0] = time.monotonic()
                 result = handle.result(timeout=30.0)
-                self._sse_event("done", {
+                self._sse_event("done", self._with_trace(handle, {
                     "state": "FINISHED", "req_id": handle.req_id,
                     "n": handle.n_streamed,
                     "tokens": handle.tokens(),
-                    "ids": np.asarray(result).tolist()})
+                    "ids": np.asarray(result).tolist()}))
             except RequestRejected:
-                self._sse_event("error", {
+                self._sse_event("error", self._with_trace(handle, {
                     "state": "REJECTED",
                     "code": _reject_status(handle.reason or ""),
-                    "reason": handle.reason})
+                    "reason": handle.reason}))
             except RequestAborted as e:
-                self._sse_event("error", {
+                self._sse_event("error", self._with_trace(handle, {
                     "state": e.state.value,
                     "code": _terminal_code(e.state, handle.reason),
                     "req_id": handle.req_id,
                     "reason": handle.reason,
-                    "n": handle.n_streamed})
+                    "n": handle.n_streamed}))
         except socket.timeout:
             srv.metrics.on_write_stall(handle.req_id, srv.io_timeout_s)
             srv.release(tracked, disconnected=True)
@@ -1145,7 +1201,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     from ..observability import REGISTRY
+    from ..observability.tracing import TRACER
     REGISTRY.enable()
+    TRACER.enable()
     fe = _build_frontend(args)
     server = HttpServingServer(fe, host=args.host, port=args.port,
                                drain_timeout_s=args.drain_timeout_s)

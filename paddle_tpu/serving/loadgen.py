@@ -157,6 +157,11 @@ class LoadReport:
     # runs: the fairness invariant is that a shared system prompt buys
     # its tenant TTFT, not the fleet a hot spot
     by_tenant: Optional[Dict[int, Dict[str, Any]]] = None
+    # per-phase latency-budget attribution (ISSUE 20), only when the
+    # span tracer is enabled: p50/p95 contribution of each engine
+    # phase (queue_wait, prefill, decode_step, ...) to TTFT and TPOT,
+    # so a p99 miss names the phase that ate the budget
+    attribution: Optional[Dict[str, Any]] = None
 
     def to_dict(self, include_requests: bool = False) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -181,6 +186,8 @@ class LoadReport:
             d["prefix"] = self.prefix
         if self.by_tenant is not None:
             d["by_tenant"] = self.by_tenant
+        if self.attribution is not None:
+            d["attribution"] = self.attribution
         if include_requests:
             d["per_request"] = self.per_request
         return d
@@ -305,6 +312,11 @@ class PoissonLoadGenerator:
 
     def run(self) -> LoadReport:
         cfg = self.config
+        from ..observability.tracing import TRACER
+        if TRACER.enabled:
+            # traced runs grade exemplars against THIS run's SLOs
+            TRACER.configure(slo_ttft_s=cfg.slo_ttft_s,
+                             slo_tpot_s=cfg.slo_tpot_s)
         if cfg.kill_replica is not None \
                 and (self.frontend is None
                      or not hasattr(self.frontend.engine,
@@ -501,6 +513,14 @@ class PoissonLoadGenerator:
                         tc["good_tokens"] / duration, 2),
                     "ttft_s": _pcts(tc["ttfts"]),
                 }
+        attrib = None
+        from ..observability.tracing import TRACER, attribution
+        if TRACER.enabled:
+            traces = [t for t in (getattr(h, "trace", None)
+                                  for h in handles if h is not None)
+                      if t is not None]
+            if traces:
+                attrib = attribution(traces)
         return LoadReport(
             n_requests=cfg.n_requests,
             finished=counts[RequestState.FINISHED],
@@ -520,4 +540,4 @@ class PoissonLoadGenerator:
             per_request=per_req, by_priority=by_priority,
             by_replica={k: by_rep[k] for k in sorted(by_rep)}
             if by_rep else None,
-            prefix=prefix, by_tenant=by_tenant)
+            prefix=prefix, by_tenant=by_tenant, attribution=attrib)

@@ -58,6 +58,7 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from ..observability import REGISTRY
+from ..observability.tracing import TRACER
 
 __all__ = [
     "EngineCrashError", "KVSnapshot", "PortableRequest",
@@ -691,6 +692,14 @@ class SupervisedEngine:
                 # source had not retired yet
                 req.eos_pos = req.out.index(kw["eos_token_id"])
             snap.req_id = rid           # re-keyed to this id space
+            if TRACER.enabled:
+                # adopt under the ambient trace (the fleet's re-place
+                # path activates the original request's trace): no
+                # add_request runs on this path, so stamp it here
+                atr = TRACER.current()
+                if atr is not None:
+                    req.trace = atr
+                    atr.mark("enqueued")
             self.engine.adopt_preempted(req, snap)
             self._tracked[rid] = _Tracked(
                 req=req, kwargs=dict(kw), max_new=portable.max_new,
@@ -832,15 +841,27 @@ class SupervisedEngine:
                 [req.prompt, np.asarray(req.out, np.int32)]) \
                 if req.out else req.prompt
             kw = t.kwargs
-            inner_rid = self.engine.add_request(
-                committed, t.max_new - len(req.out),
-                kw["eos_token_id"], temperature=kw["temperature"],
-                top_k=kw["top_k"], top_p=kw["top_p"], seed=kw["seed"],
-                priority=t.priority)
+            # request tracing (ISSUE 20): replay under the ORIGINAL
+            # trace — the fresh inner GenRequest adopts it through the
+            # ambient channel, so the post-crash spans (queue_wait,
+            # replay prefill, decode) stay on one trace_id
+            tr = getattr(req, "trace", None) if TRACER.enabled else None
+            t_rp = tr.now() if tr is not None else 0.0
+            with TRACER.activating(tr):
+                inner_rid = self.engine.add_request(
+                    committed, t.max_new - len(req.out),
+                    kw["eos_token_id"], temperature=kw["temperature"],
+                    top_k=kw["top_k"], top_p=kw["top_p"],
+                    seed=kw["seed"], priority=t.priority)
             t.inner = next(r for r in reversed(self.engine.queue)
                            if r.req_id == inner_rid)
             t.inner.req_id = rid    # replayed under the same outer id
             t.base = len(req.out)
+            if tr is not None:
+                tr.add("crash_replay", t_rp, tr.now(),
+                       committed=int(len(committed)),
+                       error=f"{type(exc).__name__}")
+                tr.meta["replayed"] = True
             replayed += 1
         dt = self._clock() - t0
         self.stats["recoveries"] += 1

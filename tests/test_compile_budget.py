@@ -102,6 +102,15 @@ def test_quant_warm_is_zero_compiles(measured):
     assert measured["serve_quant_warm"] == 0, measured
 
 
+def test_trace_warm_is_zero_compiles(measured):
+    """ISSUE 20 acceptance: the span tracer enabled around greedy,
+    sampled, prefix-hit and preempt/restore traffic on an AOT-warm
+    engine performs zero backend compiles, exactly.  Spans are
+    host-side monotonic-clock bookkeeping; turning tracing on must
+    never change what the accelerator executes."""
+    assert measured["serve_trace_warm"] == 0, measured
+
+
 def test_http_warm_is_zero_compiles(measured):
     """ISSUE 13 acceptance: the HTTP/SSE front door on an AOT-warm
     engine — server cold-start, greedy AND sampled traffic over real

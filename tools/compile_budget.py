@@ -490,6 +490,84 @@ def serve_prefill_warm() -> Callable[[], None]:
     return workload
 
 
+def serve_trace_warm() -> Callable[[], None]:
+    """End-to-end request tracing on a warm engine (ISSUE 20): the
+    span tracer enabled around greedy, sampled, shared-prefix-hit and
+    preempt/restore traffic through the streaming frontend — ZERO
+    backend compiles.  Every span is host-side monotonic-clock
+    bookkeeping; turning tracing on must never change what the
+    accelerator executes."""
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu.aot.serve import export_engine
+
+    cfg, params, prompts = _tiny_llama()
+    aot_dir = tempfile.mkdtemp(prefix="aot_budget_trace_")
+    export_engine(_engine(cfg, params), aot_dir)
+
+    def workload():
+        from paddle_tpu.inference.serving import ContinuousBatchingEngine
+        from paddle_tpu.observability.tracing import TRACER
+        from paddle_tpu.serving import AdmissionConfig, ServingFrontend
+        from paddle_tpu.serving.prefix_cache import PrefixCacheConfig
+
+        eng = ContinuousBatchingEngine(
+            cfg, params, max_batch=2, block_size=8, num_blocks=64,
+            prefill_buckets=(8,), aot_dir=aot_dir,
+            prefix_cache_config=PrefixCacheConfig())
+        fe = ServingFrontend(
+            eng, admission=AdmissionConfig(max_queue_len=64))
+        TRACER.enable()
+        TRACER.reset()
+        try:
+            rng = np.random.default_rng(20)
+            shared = rng.integers(0, cfg.vocab_size,
+                                  (16,)).astype(np.int32)
+            tail = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+            h1 = fe.submit(np.concatenate([shared, tail]), 4)
+            while not h1.state.terminal:
+                fe.step()                    # registers the prefix
+            # shared-prefix hit + a sampled request, both traced
+            h2 = fe.submit(np.concatenate([shared, tail[:2]]), 4)
+            h3 = fe.submit(tail, 4, temperature=0.7, top_k=8, seed=3)
+            while not (h2.state.terminal and h3.state.terminal):
+                fe.step()
+            # one preempt/restore mid-traffic: spill + restore spans
+            h4 = fe.submit(prompts[2], 6)
+            fe.step()
+            eng.preempt(next(s for s in range(eng.B)
+                             if eng.slots[s] is not None))
+            while not h4.state.terminal:
+                fe.step()
+            if eng.prefix_stats()["hits"] < 1:
+                raise RuntimeError("scenario never hit the prefix cache")
+            if eng.resilience["restores"] < 1:
+                raise RuntimeError("scenario never restored a preempted "
+                                   "request")
+            done = TRACER.done_traces()
+            if len(done) != 4:
+                raise RuntimeError(
+                    f"expected 4 finished traces, got {len(done)}")
+            names = {s.name for t in done for s in t.snapshot()}
+            for need in ("queue_wait", "prefill", "decode_step",
+                         "preempt_spill", "preempt_restore"):
+                if need not in names:
+                    raise RuntimeError(f"no {need} span traced: {names}")
+            rep = eng.kv_leak_report()
+            if rep["leaked"] or rep["unaccounted"]:
+                raise RuntimeError(f"scenario leaked KV blocks: {rep}")
+            if not eng.aot_loaded:
+                raise RuntimeError(
+                    f"warm start fell back: {eng.aot_error}")
+        finally:
+            TRACER.disable()
+            TRACER.reset()
+
+    return workload
+
+
 def serve_quant_warm() -> Callable[[], None]:
     """Quantized serving on a warm engine (ISSUE 16): int8 weight-only
     matmuls + int8 paged-KV pool (per-token scales), warm-started from
@@ -632,6 +710,7 @@ SCENARIOS: Dict[str, Callable[[], Callable[[], None]]] = {
     "serve_http_warm": serve_http_warm,
     "serve_prefix_warm": serve_prefix_warm,
     "serve_prefill_warm": serve_prefill_warm,
+    "serve_trace_warm": serve_trace_warm,
     "serve_quant_warm": serve_quant_warm,
     "train_elastic_warm": train_elastic_warm,
 }
@@ -694,6 +773,10 @@ def render_md(counts: Dict[str, int]) -> str:
         "chunked-prefill path (the `fused_prefill` knob, covered by "
         "the artifact config hash) through bucketed fills, a "
         "prefix-cache suffix fill, and a preempt/restore.  "
+        "`serve_trace_warm` is the ISSUE 20 row: the request span "
+        "tracer enabled around greedy, sampled, prefix-hit and "
+        "preempt/restore traffic adds zero backend compiles — spans "
+        "are host-side bookkeeping, never a shape change.  "
         "`train_elastic_warm` is the ISSUE 17 training-side row: an "
         "elastic trainer resumed at a previously-seen mesh — and "
         "reshaped by a worker kill onto an already-exported survivor "

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Render request-trace JSONL into per-phase latency-budget tables.
+
+Input is one JSON object per line in the ``Trace.to_dict()`` shape —
+what ``paddle_tpu.observability.tracing.write_spans_jsonl`` emits, what
+``GET /v1/trace/<id>`` returns, and what an SLO-exemplar event carries
+in its ``trace`` field.  Pure stdlib on purpose: the tool must open a
+flight dump on a laptop without the framework (or jax) installed.
+
+    python tools/trace_report.py traces.jsonl
+    python tools/trace_report.py traces.jsonl --trace <trace_id>
+
+The default view is the attribution table (per-phase p50/p95/sum
+contribution to TTFT and TPOT, mirroring ``LoadReport.attribution``);
+``--trace`` renders one request's span waterfall instead.
+"""
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+
+def load_traces(path: str) -> List[Dict[str, Any]]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            # exemplar event records wrap the trace dict
+            if "trace" in d and "spans" not in d:
+                d = d["trace"]
+            out.append(d)
+    return out
+
+
+def _pct(vals: Sequence[float], q: float) -> float:
+    """Linear-interpolated percentile (numpy 'linear' method), stdlib."""
+    xs = sorted(vals)
+    if len(xs) == 1:
+        return xs[0]
+    pos = (len(xs) - 1) * q / 100.0
+    lo = int(pos)
+    frac = pos - lo
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] * (1.0 - frac) + xs[hi] * frac
+
+
+def phase_totals(trace: Dict[str, Any], t_lo: float,
+                 t_hi: Optional[float]) -> Dict[str, float]:
+    """Per-phase span time clipped to the [t_lo, t_hi] window, in
+    seconds relative to trace start (the to_dict convention)."""
+    totals: Dict[str, float] = {}
+    for s in trace.get("spans", ()):
+        t0, t1 = float(s["t0_s"]), float(s["t1_s"])
+        lo = max(t0, t_lo)
+        hi = t1 if t_hi is None else min(t1, t_hi)
+        if hi > lo:
+            totals[s["name"]] = totals.get(s["name"], 0.0) + (hi - lo)
+    return totals
+
+
+def attribution(traces: List[Dict[str, Any]],
+                pcts: Sequence[int] = (50, 95)) -> Dict[str, Any]:
+    """Per-phase contribution to TTFT and TPOT across traces — the
+    JSONL-side twin of ``tracing.attribution`` (which works on live
+    Trace objects)."""
+    ttft_by: Dict[str, List[float]] = {}
+    tpot_by: Dict[str, List[float]] = {}
+    n = 0
+    for tr in traces:
+        meta = tr.get("meta") or {}
+        ttft = meta.get("ttft_s")
+        dur = tr.get("duration_s")
+        if ttft is None or dur is None:
+            continue
+        n += 1
+        head = phase_totals(tr, 0.0, float(ttft))
+        explained = sum(head.values())
+        gap = max(float(ttft) - explained, 0.0)
+        if gap > 0.0:
+            head["unattributed"] = gap
+        for k, v in head.items():
+            ttft_by.setdefault(k, []).append(v)
+        for k, v in phase_totals(tr, float(ttft), float(dur)).items():
+            tpot_by.setdefault(k, []).append(v)
+
+    def digest(by: Dict[str, List[float]]) -> Dict[str, Any]:
+        return {k: {**{f"p{q}": round(_pct(vs, q), 6) for q in pcts},
+                    "sum": round(sum(vs), 6)}
+                for k, vs in sorted(by.items())}
+
+    return {"n_traced": n, "ttft": digest(ttft_by),
+            "tpot": digest(tpot_by)}
+
+
+def render_attribution(traces: List[Dict[str, Any]],
+                       pcts: Sequence[int] = (50, 95)) -> str:
+    states: Dict[str, int] = {}
+    for tr in traces:
+        st = tr.get("state") or "live"
+        states[st] = states.get(st, 0) + 1
+    att = attribution(traces, pcts)
+    lines = [
+        f"{len(traces)} traces ("
+        + ", ".join(f"{v} {k}" for k, v in sorted(states.items()))
+        + f") · {att['n_traced']} with TTFT"]
+    cols = [f"p{q}" for q in pcts] + ["sum"]
+    for window in ("ttft", "tpot"):
+        rows = att[window]
+        if not rows:
+            continue
+        lines.append("")
+        lines.append(f"{window.upper()} attribution (s)".ljust(30)
+                     + "".join(c.rjust(12) for c in cols))
+        order = sorted(rows, key=lambda k: -rows[k]["sum"])
+        for name in order:
+            d = rows[name]
+            lines.append(
+                ("  " + name).ljust(30)
+                + "".join(f"{d[c]:12.6f}" for c in cols))
+    return "\n".join(lines)
+
+
+def render_timeline(tr: Dict[str, Any], width: int = 48) -> str:
+    dur = float(tr.get("duration_s") or 0.0) or max(
+        [float(s["t1_s"]) for s in tr.get("spans", ())] or [0.0])
+    meta = tr.get("meta") or {}
+    head = [f"trace {tr.get('trace_id')} [{tr.get('state') or 'live'}]"
+            f" rid={tr.get('rid')} dur={dur:.6f}s"]
+    keys = ("ttft_s", "tpot_s", "n_tokens", "reason", "replayed",
+            "exemplar")
+    kv = {k: meta[k] for k in keys if k in meta}
+    if kv:
+        head.append("  " + "  ".join(f"{k}={v}" for k, v in kv.items()))
+    lines = head
+    for s in tr.get("spans", ()):
+        t0, t1 = float(s["t0_s"]), float(s["t1_s"])
+        a = int(t0 / dur * width) if dur else 0
+        b = int(t1 / dur * width) if dur else 0
+        bar = " " * a + ("█" * max(b - a, 1) if t1 > t0 else "▏")
+        attrs = s.get("attrs") or {}
+        tail = ("  " + " ".join(f"{k}={v}" for k, v in attrs.items())
+                if attrs else "")
+        lines.append(f"  {s['name']:<16} |{bar:<{width}}| "
+                     f"{t0:9.6f}→{t1:9.6f} ({t1 - t0:.6f}s){tail}")
+    if tr.get("dropped_spans"):
+        lines.append(f"  … {tr['dropped_spans']} spans dropped "
+                     f"(ring full)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/trace_report.py",
+        description="per-phase latency-budget attribution from request-"
+                    "trace JSONL (docs/observability.md)")
+    ap.add_argument("path", help="JSONL of Trace.to_dict() lines")
+    ap.add_argument("--trace", default=None, metavar="ID",
+                    help="render one trace's span waterfall (trace_id, "
+                         "rid, or request_id)")
+    ap.add_argument("--pcts", default="50,95",
+                    help="percentile columns (default: 50,95)")
+    args = ap.parse_args(argv)
+    traces = load_traces(args.path)
+    if not traces:
+        print(f"no traces in {args.path}", file=sys.stderr)
+        return 1
+    if args.trace is not None:
+        want = args.trace
+        for tr in traces:
+            if want in (tr.get("trace_id"), str(tr.get("rid")),
+                        tr.get("request_id")):
+                print(render_timeline(tr))
+                return 0
+        print(f"no trace {want!r} in {args.path}", file=sys.stderr)
+        return 1
+    pcts = [int(p) for p in args.pcts.split(",") if p]
+    print(render_attribution(traces, pcts))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
